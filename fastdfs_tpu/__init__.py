@@ -1,0 +1,29 @@
+"""fastdfs_tpu — a TPU-native distributed file-storage framework.
+
+A ground-up rebuild of the capabilities of FastDFS (reference:
+``xigui2013/fastdfs``, a C tracker/storage/client distributed file system)
+with a TPU-accelerated content-dedup engine on the storage upload path.
+
+Layout (mirrors SURVEY.md §1's layer map, re-designed TPU-first):
+
+- ``fastdfs_tpu.common``   — L1: wire protocol, file-ID codec, config, CRC32.
+  (reference: ``common/fdfs_proto.h``, ``common/fdfs_global.c``)
+- ``fastdfs_tpu.ops``      — JAX/Pallas compute kernels: gear-hash CDC,
+  batched SHA1, MinHash.  (no reference equivalent; replaces the scalar
+  CRC32 loop in ``storage/storage_dio.c:dio_write_file()``)
+- ``fastdfs_tpu.dedup``    — the dedup engine + digest/ANN indexes, single
+  chip and mesh-sharded.
+- ``fastdfs_tpu.parallel`` — device mesh, shardings, collectives.
+- ``fastdfs_tpu.client``   — Python client speaking the binary TCP protocol
+  (reference: ``client/storage_client.c``, ``client/tracker_client.c``).
+- ``native/``              — C++ tracker daemon, storage daemon and client
+  library (reference: ``tracker/``, ``storage/``, ``client/``).
+
+The wire protocol is *FastDFS-shaped*: the reference mount was empty at
+survey time (see SURVEY.md provenance warning), so numeric constants follow
+the documented upstream layout but are not guaranteed byte-compatible.
+"""
+
+__version__ = "0.1.0"
+
+FDFS_TPU_VERSION = __version__

@@ -1,0 +1,231 @@
+"""Self-describing file-ID codec.
+
+Reference: FastDFS file IDs (``group1/M00/02/44/<base64>.ext``) encode
+everything needed to locate and validate a file with **no metadata
+database**: group name, store-path index, two-level subdirectory, and a
+base64 blob packing source-storage IP, create timestamp, file size (with
+flag bits) and CRC32.  Reference anchors:
+``storage/storage_service.c:storage_gen_filename()``,
+``common/fdfs_global.c:fdfs_check_data_filename()``,
+``client/storage_client.c:fdfs_get_file_info()``.
+
+Blob layout (20 bytes, big-endian, mirrors the upstream field order):
+
+    [0:4]   source storage IPv4 (packed)
+    [4:8]   create timestamp (uint32 unix seconds)
+    [8:16]  file-size field: flags | uniquifier | true size (see below)
+    [16:20] CRC32 of the file content
+
+File-size field (int64):
+    bit 62          appender-file flag   (upstream: FDFS_APPENDER_FILE_SIZE)
+    bit 61          trunk-file flag      (upstream: FDFS_TRUNK_FILE_MARK_SIZE)
+    bit 60          slave-file flag
+    bits 48..59     12-bit uniquifier (per-server upload counter slice; keeps
+                    IDs unique when ip+ts+crc collide)
+    bits 0..47      true file size (256 TiB max)
+
+Base64 uses the URL-safe alphabet (``-``/``_``) without padding: 20 bytes →
+exactly 27 chars (= upstream FDFS_FILENAME_BASE64_LENGTH).  The alphabet is
+FastDFS-*shaped*, not guaranteed bit-compatible (reference mount was empty
+at survey time — SURVEY.md provenance warning).
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import posixpath
+import re
+import struct
+from dataclasses import dataclass
+
+from fastdfs_tpu.common.protocol import FILENAME_BASE64_LENGTH
+
+STORAGE_DATA_DIR_FORMAT = "%02X"
+DEFAULT_SUBDIR_COUNT = 256
+
+_SIZE_MASK = (1 << 48) - 1
+_UNIQ_SHIFT = 48
+_UNIQ_MASK = 0xFFF
+FLAG_SLAVE = 1 << 60
+FLAG_TRUNK = 1 << 61
+FLAG_APPENDER = 1 << 62
+
+_BLOB_STRUCT = struct.Struct(">IIqI")
+_FILE_ID_RE = re.compile(
+    r"^(?P<group>[^/]{1,16})/M(?P<path>[0-9A-F]{2})/"
+    r"(?P<sub1>[0-9A-F]{2})/(?P<sub2>[0-9A-F]{2})/"
+    r"(?P<b64>[A-Za-z0-9_-]{27})(?P<ext>\.[^/.]{1,6})?$"
+)
+
+
+@dataclass(frozen=True)
+class FileInfo:
+    """Decoded identity facts carried inside a file ID."""
+
+    source_ip: str
+    create_timestamp: int
+    file_size: int
+    crc32: int
+    uniquifier: int = 0
+    appender: bool = False
+    trunk: bool = False
+    slave: bool = False
+
+
+@dataclass(frozen=True)
+class FileId:
+    """Parsed structural parts of a file ID string."""
+
+    group: str
+    store_path_index: int
+    subdir1: int
+    subdir2: int
+    filename: str  # "<27 b64 chars>[.ext]"
+
+    @property
+    def remote_filename(self) -> str:
+        """The part after the group name (what the storage protocol carries)."""
+        return posixpath.join(
+            f"M{self.store_path_index:02X}",
+            STORAGE_DATA_DIR_FORMAT % self.subdir1,
+            STORAGE_DATA_DIR_FORMAT % self.subdir2,
+            self.filename,
+        )
+
+    def __str__(self) -> str:
+        return f"{self.group}/{self.remote_filename}"
+
+
+def pack_ip(ip: str) -> int:
+    a, b, c, d = (int(x) for x in ip.split("."))
+    for part in (a, b, c, d):
+        if not 0 <= part <= 255:
+            raise ValueError(f"bad IPv4 address {ip!r}")
+    return (a << 24) | (b << 16) | (c << 8) | d
+
+
+def unpack_ip(n: int) -> str:
+    return f"{(n >> 24) & 0xFF}.{(n >> 16) & 0xFF}.{(n >> 8) & 0xFF}.{n & 0xFF}"
+
+
+def _b64encode(blob: bytes) -> str:
+    return base64.urlsafe_b64encode(blob).rstrip(b"=").decode("ascii")
+
+
+def _b64decode(s: str) -> bytes:
+    pad = (-len(s)) % 4
+    return base64.urlsafe_b64decode(s + "=" * pad)
+
+
+def subdirs_for_blob(blob: bytes, subdir_count: int = DEFAULT_SUBDIR_COUNT) -> tuple[int, int]:
+    """Deterministic two-level subdirectory spread from the packed blob.
+
+    Reference: upstream spreads files over ``subdir_count_per_path²``
+    directories (``storage/storage_func.c:storage_make_data_dirs()``); the
+    chosen pair is a pure function of the blob so any party holding the ID
+    can compute the on-disk path.
+    """
+    h = binascii.crc32(blob)
+    return ((h >> 16) & 0xFF) % subdir_count, (h & 0xFF) % subdir_count
+
+
+def encode_file_id(
+    group: str,
+    store_path_index: int,
+    source_ip: str,
+    create_timestamp: int,
+    file_size: int,
+    crc32: int,
+    ext: str = "",
+    uniquifier: int = 0,
+    appender: bool = False,
+    trunk: bool = False,
+    slave: bool = False,
+    subdir_count: int = DEFAULT_SUBDIR_COUNT,
+) -> str:
+    """Build a file-ID string (reference: storage_gen_filename())."""
+    if not re.fullmatch(r"[^/]{1,16}", group):
+        raise ValueError(f"bad group name: {group!r}")
+    ext = ext.lstrip(".")
+    if ext and not re.fullmatch(r"[^/.]{1,6}", ext):
+        raise ValueError(f"bad ext name: {ext!r}")
+    if not 0 <= store_path_index <= 0xFF:
+        raise ValueError(f"store_path_index out of range: {store_path_index}")
+    if not 0 <= file_size <= _SIZE_MASK:
+        raise ValueError(f"file_size out of range: {file_size}")
+    if not 0 <= uniquifier <= _UNIQ_MASK:
+        raise ValueError(f"uniquifier out of range: {uniquifier}")
+    size_field = file_size | (uniquifier << _UNIQ_SHIFT)
+    if appender:
+        size_field |= FLAG_APPENDER
+    if trunk:
+        size_field |= FLAG_TRUNK
+    if slave:
+        size_field |= FLAG_SLAVE
+    blob = _BLOB_STRUCT.pack(
+        pack_ip(source_ip), create_timestamp & 0xFFFFFFFF, size_field, crc32 & 0xFFFFFFFF
+    )
+    sub1, sub2 = subdirs_for_blob(blob, subdir_count)
+    name = _b64encode(blob)
+    assert len(name) == FILENAME_BASE64_LENGTH
+    if ext:
+        name += "." + ext
+    return (
+        f"{group}/M{store_path_index:02X}/"
+        f"{STORAGE_DATA_DIR_FORMAT % sub1}/{STORAGE_DATA_DIR_FORMAT % sub2}/{name}"
+    )
+
+
+def decode_file_id(
+    file_id: str, subdir_count: int = DEFAULT_SUBDIR_COUNT
+) -> tuple[FileId, FileInfo]:
+    """Parse and validate a file-ID string; inverse of :func:`encode_file_id`.
+
+    Reference: ``fdfs_check_data_filename()`` + client-side
+    ``fdfs_get_file_info()`` — download needs no index lookup because the ID
+    itself names the group, path, and content facts.
+    """
+    m = _FILE_ID_RE.match(file_id)
+    if m is None:
+        raise ValueError(f"malformed file id: {file_id!r}")
+    b64 = m.group("b64")
+    blob = _b64decode(b64)
+    ip_n, ts, size_field, crc = _BLOB_STRUCT.unpack(blob)
+    fid = FileId(
+        group=m.group("group"),
+        store_path_index=int(m.group("path"), 16),
+        subdir1=int(m.group("sub1"), 16),
+        subdir2=int(m.group("sub2"), 16),
+        filename=b64 + (m.group("ext") or ""),
+    )
+    expect = subdirs_for_blob(blob, subdir_count)
+    if expect != (fid.subdir1, fid.subdir2):
+        raise ValueError(
+            f"file id subdirs {fid.subdir1:02X}/{fid.subdir2:02X} do not match "
+            f"blob hash {expect[0]:02X}/{expect[1]:02X}"
+        )
+    info = FileInfo(
+        source_ip=unpack_ip(ip_n),
+        create_timestamp=ts,
+        file_size=size_field & _SIZE_MASK,
+        crc32=crc,
+        uniquifier=(size_field >> _UNIQ_SHIFT) & _UNIQ_MASK,
+        appender=bool(size_field & FLAG_APPENDER),
+        trunk=bool(size_field & FLAG_TRUNK),
+        slave=bool(size_field & FLAG_SLAVE),
+    )
+    return fid, info
+
+
+def local_path(base_path: str, remote_filename: str) -> str:
+    """Map a remote filename (``M00/XX/YY/name``) to the on-disk path
+    ``<base_path>/data/XX/YY/name`` for the store path it names.
+
+    Reference: storage daemons keep each store path's payload under
+    ``<store_path>/data/`` (storage_func.c:storage_make_data_dirs()).
+    """
+    parts = remote_filename.split("/")
+    if len(parts) != 4 or not parts[0].startswith("M"):
+        raise ValueError(f"malformed remote filename: {remote_filename!r}")
+    return posixpath.join(base_path, "data", parts[1], parts[2], parts[3])

@@ -1,0 +1,284 @@
+"""Binary wire protocol: header framing, opcodes, field widths.
+
+Reference: ``common/fdfs_proto.h`` in xigui2013/fastdfs — the 10-byte
+``TrackerHeader { char pkg_len[8]; char cmd; char status; }`` with a
+big-endian int64 body length, plus the ``TRACKER_PROTO_CMD_*`` /
+``STORAGE_PROTO_CMD_*`` opcode tables.
+
+Provenance note (SURVEY.md §2.5): the reference mount was empty at survey
+time, so opcode *values* follow the documented upstream layout
+(high-confidence reconstruction) and the protocol is FastDFS-*shaped*
+rather than certified byte-compatible.  Within this framework the values
+below ARE the contract: the C++ daemons in ``native/`` generate their
+opcode table from this module (see ``native/gen_protocol_header.py``).
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass
+
+# ---------------------------------------------------------------------------
+# Field widths (reference: common/fdfs_proto.h constants)
+# ---------------------------------------------------------------------------
+
+GROUP_NAME_MAX_LEN = 16          # FDFS_GROUP_NAME_MAX_LEN
+IP_ADDRESS_SIZE = 16             # IP_ADDRESS_SIZE (dotted-quad + NUL)
+FILE_EXT_NAME_MAX_LEN = 6        # FDFS_FILE_EXT_NAME_MAX_LEN
+FILENAME_BASE64_LENGTH = 27      # FDFS_FILENAME_BASE64_LENGTH (20 raw bytes)
+STORAGE_ID_MAX_SIZE = 16
+PROTO_PKG_LEN_SIZE = 8
+MAX_META_NAME_LEN = 64
+MAX_META_VALUE_LEN = 256
+
+# Metadata wire separators (reference: fdfs_proto.h FDFS_RECORD_SEPARATOR /
+# FDFS_FIELD_SEPARATOR).
+RECORD_SEPARATOR = b"\x01"
+FIELD_SEPARATOR = b"\x02"
+
+HEADER_SIZE = PROTO_PKG_LEN_SIZE + 2  # 8B len + 1B cmd + 1B status
+
+_HEADER_STRUCT = struct.Struct(">qBB")
+
+
+class TrackerCmd(enum.IntEnum):
+    """Tracker-port opcodes (reference: fdfs_proto.h TRACKER_PROTO_CMD_*)."""
+
+    # storage -> tracker (cluster management)
+    STORAGE_JOIN = 81
+    QUIT = 82
+    STORAGE_BEAT = 83
+    STORAGE_REPORT_DISK_USAGE = 84
+    STORAGE_REPLICA_CHG = 85
+    STORAGE_SYNC_SRC_REQ = 86
+    STORAGE_SYNC_DEST_REQ = 87
+    STORAGE_SYNC_NOTIFY = 88
+    STORAGE_SYNC_REPORT = 89
+    STORAGE_SYNC_DEST_QUERY = 79
+    STORAGE_REPORT_IP_CHANGED = 78
+    STORAGE_CHANGELOG_REQ = 77
+    STORAGE_PARAMETER_REQ = 76
+
+    # client -> tracker (ops / listing)
+    SERVER_LIST_ONE_GROUP = 90
+    SERVER_LIST_ALL_GROUPS = 91
+    SERVER_LIST_STORAGE = 92
+    SERVER_DELETE_STORAGE = 93
+    SERVER_SET_TRUNK_SERVER = 94
+
+    # client -> tracker (service queries; reference: tracker_deal_service_query_*)
+    SERVICE_QUERY_STORE_WITHOUT_GROUP_ONE = 101
+    SERVICE_QUERY_FETCH_ONE = 102
+    SERVICE_QUERY_UPDATE = 103
+    SERVICE_QUERY_STORE_WITH_GROUP_ONE = 104
+    SERVICE_QUERY_FETCH_ALL = 105
+    SERVICE_QUERY_STORE_WITHOUT_GROUP_ALL = 106
+    SERVICE_QUERY_STORE_WITH_GROUP_ALL = 107
+
+    RESP = 100
+    ACTIVE_TEST = 111
+
+    # tracker <-> tracker (leader election; reference: tracker_relationship.c)
+    TRACKER_GET_STATUS = 70
+    TRACKER_GET_SYS_FILES_START = 61
+    TRACKER_GET_SYS_FILES_END = 62
+    TRACKER_GET_ONE_SYS_FILE = 63
+    TRACKER_PING_LEADER = 71
+    TRACKER_NOTIFY_NEXT_LEADER = 72
+    TRACKER_COMMIT_NEXT_LEADER = 73
+
+
+class StorageCmd(enum.IntEnum):
+    """Storage-port opcodes (reference: fdfs_proto.h STORAGE_PROTO_CMD_*)."""
+
+    UPLOAD_FILE = 11
+    DELETE_FILE = 12
+    SET_METADATA = 13
+    DOWNLOAD_FILE = 14
+    GET_METADATA = 15
+    SYNC_CREATE_FILE = 16
+    SYNC_DELETE_FILE = 17
+    SYNC_UPDATE_FILE = 18
+    SYNC_CREATE_LINK = 19
+    CREATE_LINK = 20
+    UPLOAD_SLAVE_FILE = 21
+    QUERY_FILE_INFO = 22
+    UPLOAD_APPENDER_FILE = 23
+    APPEND_FILE = 24
+    SYNC_APPEND_FILE = 25
+    FETCH_ONE_PATH_BINLOG = 26
+
+    # trunk subsystem (reference: storage/trunk_mgr/)
+    TRUNK_ALLOC_SPACE = 27
+    TRUNK_ALLOC_CONFIRM = 28
+    TRUNK_FREE_SPACE = 29
+    TRUNK_SYNC_BINLOG = 30
+    TRUNK_GET_BINLOG_SIZE = 31
+    TRUNK_DELETE_BINLOG_MARKS = 32
+    TRUNK_TRUNCATE_BINLOG_FILE = 33
+
+    MODIFY_FILE = 34
+    SYNC_MODIFY_FILE = 35
+    TRUNCATE_FILE = 36
+    SYNC_TRUNCATE_FILE = 37
+
+    # fastdfs_tpu extension: dedup-engine sidecar RPCs (no reference
+    # equivalent; carried on the same framing so the C++ daemons reuse one
+    # codec).  Values chosen clear of the upstream table.
+    DEDUP_FINGERPRINT = 120
+    DEDUP_QUERY = 121
+    DEDUP_COMMIT = 122
+
+    RESP = 100
+    ACTIVE_TEST = 111
+
+
+class Status(enum.IntEnum):
+    """Header status byte: 0 = OK, otherwise an errno-style code."""
+
+    OK = 0
+    ENOENT = 2
+    EIO = 5
+    EBUSY = 16
+    EEXIST = 17
+    EINVAL = 22
+    ENOSPC = 28
+    ECONNREFUSED = 111
+    EALREADY = 114
+
+
+class StorageStatus(enum.IntEnum):
+    """Storage-server lifecycle states held by the tracker.
+
+    Reference: ``tracker/tracker_types.h`` FDFS_STORAGE_STATUS_* (values
+    flagged "verify" in SURVEY.md §3.4).
+    """
+
+    INIT = 0
+    WAIT_SYNC = 1
+    SYNCING = 2
+    IP_CHANGED = 3
+    DELETED = 4
+    OFFLINE = 5
+    ONLINE = 6
+    ACTIVE = 7
+    RECOVERY = 9
+    NONE = 99
+
+
+class StoreLookup(enum.IntEnum):
+    """Upload group-selection policy (reference: tracker.conf store_lookup)."""
+
+    ROUND_ROBIN = 0
+    SPECIFIED_GROUP = 1
+    LOAD_BALANCE = 2
+
+
+class StorePathPolicy(enum.IntEnum):
+    """Store-path selection inside one server (reference: storage.conf
+    store_path_mode? — upstream ``tracker.conf store_path`` 0=rr, 2=load
+    balance)."""
+
+    ROUND_ROBIN = 0
+    LOAD_BALANCE = 2
+
+
+class DownloadServer(enum.IntEnum):
+    """Replica-selection policy for reads (reference: tracker.conf
+    download_server)."""
+
+    ROUND_ROBIN = 0
+    SOURCE_FIRST = 1
+
+
+@dataclass(frozen=True)
+class Header:
+    """Decoded wire header (reference: fdfs_proto.h TrackerHeader)."""
+
+    pkg_len: int
+    cmd: int
+    status: int = 0
+
+    def pack(self) -> bytes:
+        return _HEADER_STRUCT.pack(self.pkg_len, self.cmd, self.status)
+
+
+def pack_header(pkg_len: int, cmd: int, status: int = 0) -> bytes:
+    """Encode the 10-byte header: int64-BE body length, cmd, status.
+
+    Reference: ``fdfs_proto.c`` fills TrackerHeader via ``long2buff``.
+    """
+    return _HEADER_STRUCT.pack(pkg_len, cmd, status)
+
+
+def unpack_header(buf: bytes) -> Header:
+    if len(buf) < HEADER_SIZE:
+        raise ValueError(f"short header: {len(buf)} < {HEADER_SIZE}")
+    pkg_len, cmd, status = _HEADER_STRUCT.unpack_from(buf)
+    if pkg_len < 0:
+        raise ValueError(f"negative pkg_len {pkg_len}")
+    return Header(pkg_len=pkg_len, cmd=cmd, status=status)
+
+
+def long2buff(n: int) -> bytes:
+    """Encode an int64 big-endian (reference: shared_func.c long2buff())."""
+    return struct.pack(">q", n)
+
+
+def buff2long(buf: bytes, offset: int = 0) -> int:
+    """Decode a big-endian int64 (reference: shared_func.c buff2long())."""
+    return struct.unpack_from(">q", buf, offset)[0]
+
+
+def pack_group_name(group: str) -> bytes:
+    """Fixed-width group-name field: NUL-padded to 16 bytes."""
+    raw = group.encode("utf-8")
+    if len(raw) > GROUP_NAME_MAX_LEN:
+        raise ValueError(f"group name too long: {group!r}")
+    return raw.ljust(GROUP_NAME_MAX_LEN, b"\x00")
+
+
+def unpack_group_name(buf: bytes) -> str:
+    return buf[:GROUP_NAME_MAX_LEN].rstrip(b"\x00").decode("utf-8")
+
+
+def pack_ext_name(ext: str) -> bytes:
+    """Fixed-width file-extension field (6 bytes, NUL-padded)."""
+    raw = ext.encode("utf-8")
+    if len(raw) > FILE_EXT_NAME_MAX_LEN:
+        raise ValueError(f"ext name too long: {ext!r}")
+    return raw.ljust(FILE_EXT_NAME_MAX_LEN, b"\x00")
+
+
+def unpack_ext_name(buf: bytes) -> str:
+    return buf[:FILE_EXT_NAME_MAX_LEN].rstrip(b"\x00").decode("utf-8")
+
+
+def pack_metadata(meta: dict[str, str]) -> bytes:
+    """Serialize metadata key/values with \\x02 field and \\x01 record
+    separators (reference: fdfs_proto.h FDFS_FIELD/RECORD_SEPARATOR,
+    client/storage_client.c fdfs_pack_metadata())."""
+    if not meta:
+        return b""
+    recs = []
+    for k, v in sorted(meta.items()):
+        kb, vb = k.encode("utf-8"), v.encode("utf-8")
+        if FIELD_SEPARATOR in kb or RECORD_SEPARATOR in kb:
+            raise ValueError(f"metadata key contains separator: {k!r}")
+        if FIELD_SEPARATOR in vb or RECORD_SEPARATOR in vb:
+            raise ValueError(f"metadata value contains separator: {v!r}")
+        recs.append(kb + FIELD_SEPARATOR + vb)
+    return RECORD_SEPARATOR.join(recs)
+
+
+def unpack_metadata(buf: bytes) -> dict[str, str]:
+    if not buf:
+        return {}
+    meta: dict[str, str] = {}
+    for rec in buf.split(RECORD_SEPARATOR):
+        if not rec:
+            continue
+        k, _, v = rec.partition(FIELD_SEPARATOR)
+        meta[k.decode("utf-8")] = v.decode("utf-8")
+    return meta

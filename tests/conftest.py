@@ -1,0 +1,21 @@
+"""Test harness config.
+
+Forces JAX onto a virtual 8-device CPU mesh so multi-chip shardings
+(dp/tp/sp over jax.sharding.Mesh) are exercised without TPU hardware, per
+the driver contract.  Must run before jax initializes its backends, hence
+the env mutation at import time.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)

@@ -1,0 +1,122 @@
+"""File-ID codec round-trip tests (SURVEY.md §4: 'file-ID codec round-trip'
+is the first unit test the rebuild must add)."""
+
+import random
+
+import pytest
+
+from fastdfs_tpu.common import fileid as F
+
+
+def test_roundtrip_basic():
+    fid_str = F.encode_file_id(
+        "group1", 0, "192.168.1.102", 1_406_000_000, 30790, 0xFCEF_EF3C, ext="jpg"
+    )
+    fid, info = F.decode_file_id(fid_str)
+    assert fid.group == "group1"
+    assert fid.store_path_index == 0
+    assert fid.filename.endswith(".jpg")
+    assert str(fid) == fid_str
+    assert info.source_ip == "192.168.1.102"
+    assert info.create_timestamp == 1_406_000_000
+    assert info.file_size == 30790
+    assert info.crc32 == 0xFCEF_EF3C
+    assert not info.appender and not info.trunk and not info.slave
+
+
+def test_base64_length_is_27():
+    fid_str = F.encode_file_id("g", 3, "10.0.0.1", 0, 0, 0)
+    name = fid_str.rsplit("/", 1)[1]
+    assert len(name) == 27  # FDFS_FILENAME_BASE64_LENGTH
+
+
+def test_flags_and_uniquifier():
+    fid_str = F.encode_file_id(
+        "group2", 255, "10.1.2.3", 1_700_000_000, (1 << 48) - 1, 0,
+        ext="bin", uniquifier=0xABC, appender=True,
+    )
+    _, info = F.decode_file_id(fid_str)
+    assert info.appender and not info.trunk
+    assert info.uniquifier == 0xABC
+    assert info.file_size == (1 << 48) - 1
+
+    fid_str2 = F.encode_file_id("g", 0, "1.2.3.4", 5, 6, 7, trunk=True, slave=True)
+    _, info2 = F.decode_file_id(fid_str2)
+    assert info2.trunk and info2.slave and not info2.appender
+
+
+def test_fuzz_roundtrip():
+    rng = random.Random(1234)
+    for _ in range(200):
+        ip = ".".join(str(rng.randrange(256)) for _ in range(4))
+        ts = rng.randrange(2**32)
+        size = rng.randrange(2**48)
+        crc = rng.randrange(2**32)
+        uniq = rng.randrange(2**12)
+        fid_str = F.encode_file_id("group9", rng.randrange(256), ip, ts, size,
+                                   crc, ext="dat", uniquifier=uniq)
+        fid, info = F.decode_file_id(fid_str)
+        assert (info.source_ip, info.create_timestamp, info.file_size,
+                info.crc32, info.uniquifier) == (ip, ts, size, crc, uniq)
+        assert 0 <= fid.subdir1 < 256 and 0 <= fid.subdir2 < 256
+
+
+def test_malformed_ids_rejected():
+    good = F.encode_file_id("group1", 0, "1.2.3.4", 1, 2, 3, ext="txt")
+    for bad in (
+        "",
+        "group1/M00/00/00",
+        good.replace("/M", "/X"),
+        good + "/extra",
+        "toolonggroupname01/M00/00/00/" + "A" * 27,
+    ):
+        with pytest.raises(ValueError):
+            F.decode_file_id(bad)
+
+
+def test_tampered_subdirs_rejected():
+    # Subdirs are a pure function of the blob; a tampered path must not decode.
+    good = F.encode_file_id("group1", 0, "1.2.3.4", 1, 2, 3)
+    parts = good.split("/")
+    parts[2] = "%02X" % ((int(parts[2], 16) + 1) % 256)
+    with pytest.raises(ValueError):
+        F.decode_file_id("/".join(parts))
+
+
+def test_encode_rejects_undecodable_inputs():
+    # encode must enforce the decoder's grammar (review finding).
+    with pytest.raises(ValueError):
+        F.encode_file_id("group1", 0, "1.2.3.4", 1, 2, 3, ext="tar.gz")
+    with pytest.raises(ValueError):
+        F.encode_file_id("group1", 0, "1.2.3.4", 1, 2, 3, ext="toolong7")
+    with pytest.raises(ValueError):
+        F.encode_file_id("g/1", 0, "1.2.3.4", 1, 2, 3)
+    with pytest.raises(ValueError):
+        F.encode_file_id("x" * 17, 0, "1.2.3.4", 1, 2, 3)
+    with pytest.raises(ValueError):
+        F.encode_file_id("g", 0, "1.2.3.4", 1, 2, 3, uniquifier=0x1000)
+    with pytest.raises(ValueError):
+        F.encode_file_id("g", 256, "1.2.3.4", 1, 2, 3)
+
+
+def test_nondefault_subdir_count_roundtrip():
+    fid_str = F.encode_file_id("g", 0, "1.2.3.4", 1, 2, 3, subdir_count=16)
+    fid, _ = F.decode_file_id(fid_str, subdir_count=16)
+    assert fid.subdir1 < 16 and fid.subdir2 < 16
+
+
+def test_ip_pack_unpack():
+    for ip in ("0.0.0.0", "255.255.255.255", "192.168.1.1"):
+        assert F.unpack_ip(F.pack_ip(ip)) == ip
+    with pytest.raises(ValueError):
+        F.pack_ip("256.1.1.1")
+
+
+def test_local_path():
+    fid, _ = F.decode_file_id(
+        F.encode_file_id("group1", 0, "1.2.3.4", 1, 2, 3, ext="jpg"))
+    p = F.local_path("/var/fdfs/path0", fid.remote_filename)
+    assert p.startswith("/var/fdfs/path0/data/")
+    assert p.endswith(fid.filename)
+    with pytest.raises(ValueError):
+        F.local_path("/x", "no/such/shape")

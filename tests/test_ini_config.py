@@ -1,0 +1,108 @@
+"""INI config reader tests (reference behavior: libfastcommon
+ini_file_reader.c — repeated keys, #include, size/duration suffixes)."""
+
+import pytest
+
+from fastdfs_tpu.common.ini_config import IniConfig
+
+
+def test_basic_parse():
+    cfg = IniConfig.loads(
+        """
+        # tracker settings
+        disabled = false
+        port = 22122
+        bind_addr =
+        store_lookup = 2
+        """
+    )
+    assert cfg.get_int("port") == 22122
+    assert cfg.get_bool("disabled") is False
+    assert cfg.get("bind_addr") == ""
+    assert cfg.get_int("store_lookup") == 2
+    assert "port" in cfg and "nope" not in cfg
+
+
+def test_repeated_keys():
+    cfg = IniConfig.loads(
+        """
+        tracker_server = 10.0.0.1:22122
+        tracker_server = 10.0.0.2:22122
+        store_path0 = /data/fdfs0
+        store_path1 = /data/fdfs1
+        """
+    )
+    assert cfg.get_all("tracker_server") == ["10.0.0.1:22122", "10.0.0.2:22122"]
+    assert cfg.get("tracker_server") == "10.0.0.2:22122"
+
+
+def test_sizes_and_durations():
+    cfg = IniConfig.loads(
+        """
+        buff_size = 256KB
+        trunk_file_size = 64MB
+        heart_beat_interval = 30
+        sync_wait_msec = 5m
+        rotate = 1d
+        """
+    )
+    assert cfg.get_bytes("buff_size") == 256 * 1024
+    assert cfg.get_bytes("trunk_file_size") == 64 << 20
+    assert cfg.get_seconds("heart_beat_interval") == 30
+    assert cfg.get_seconds("sync_wait_msec") == 300
+    assert cfg.get_seconds("rotate") == 86400
+    assert cfg.get_bytes("missing", 7) == 7
+    assert cfg.get_seconds("missing", 9) == 9
+
+
+def test_bad_values_raise():
+    cfg = IniConfig.loads("x = notabool\ny = 12QQ\n")
+    with pytest.raises(ValueError):
+        cfg.get_bool("x")
+    with pytest.raises(ValueError):
+        cfg.get_bytes("y")
+
+
+def test_include(tmp_path):
+    (tmp_path / "base.conf").write_text("port = 22122\nshared = base\n")
+    (tmp_path / "main.conf").write_text(
+        "#include base.conf\nshared = main\nextra = 1\n"
+    )
+    cfg = IniConfig.load(str(tmp_path / "main.conf"))
+    assert cfg.get_int("port") == 22122
+    assert cfg.get("shared") == "main"  # later wins
+    assert cfg.get_int("extra") == 1
+
+
+def test_diamond_include_is_legal(tmp_path):
+    # a.conf and b.conf both include shared.conf — not a cycle.
+    (tmp_path / "shared.conf").write_text("common = 1\n")
+    (tmp_path / "a.conf").write_text("#include shared.conf\na = 1\n")
+    (tmp_path / "b.conf").write_text("#include shared.conf\nb = 1\n")
+    (tmp_path / "main.conf").write_text("#include a.conf\n#include b.conf\n")
+    cfg = IniConfig.load(str(tmp_path / "main.conf"))
+    assert cfg.get_all("common") == ["1", "1"]
+
+
+def test_include_like_comment_is_not_directive():
+    # '#includes are resolved...' is a comment, not an #include.
+    cfg = IniConfig.loads("#includes are resolved relative to this file\nx = 1\n")
+    assert cfg.get_int("x") == 1
+
+
+def test_uppercase_duration_suffix():
+    cfg = IniConfig.loads("interval = 5M\n")
+    assert cfg.get_seconds("interval") == 300
+
+
+def test_include_cycle_rejected(tmp_path):
+    (tmp_path / "a.conf").write_text("#include b.conf\n")
+    (tmp_path / "b.conf").write_text("#include a.conf\n")
+    with pytest.raises(ValueError):
+        IniConfig.load(str(tmp_path / "a.conf"))
+
+
+def test_sections_flattened():
+    cfg = IniConfig.loads("[global]\nport = 1\n[other]\nname = x\n")
+    assert cfg.get_int("port") == 1
+    assert cfg.get("name") == "x"

@@ -1,0 +1,94 @@
+"""Golden-byte tests for the wire protocol (SURVEY.md §4: 'protocol golden
+bytes' are contract tests the reference never had)."""
+
+import pytest
+
+from fastdfs_tpu.common import protocol as P
+
+
+def test_header_roundtrip():
+    raw = P.pack_header(1234567890123, P.StorageCmd.UPLOAD_FILE, 0)
+    assert len(raw) == P.HEADER_SIZE == 10
+    h = P.unpack_header(raw)
+    assert h.pkg_len == 1234567890123
+    assert h.cmd == 11
+    assert h.status == 0
+
+
+def test_header_golden_bytes():
+    # 8B big-endian int64 length, then cmd, then status
+    # (reference: fdfs_proto.h TrackerHeader).
+    raw = P.pack_header(0x0102030405060708, 0x0B, 0x16)
+    assert raw == bytes([1, 2, 3, 4, 5, 6, 7, 8, 0x0B, 0x16])
+
+
+def test_header_short_buffer_rejected():
+    with pytest.raises(ValueError):
+        P.unpack_header(b"\x00" * 9)
+
+
+def test_header_negative_len_rejected():
+    raw = P.pack_header(-1, 1, 0)
+    with pytest.raises(ValueError):
+        P.unpack_header(raw)
+
+
+def test_long2buff_roundtrip():
+    for n in (0, 1, 255, 1 << 40, -(1 << 40), 2**63 - 1, -(2**63)):
+        assert P.buff2long(P.long2buff(n)) == n
+
+
+def test_long2buff_golden():
+    assert P.long2buff(1) == b"\x00\x00\x00\x00\x00\x00\x00\x01"
+
+
+def test_opcode_values_match_survey():
+    # Spot-check the table in SURVEY.md §2.5 — these values are the contract
+    # the C++ daemons generate their header from.
+    assert P.TrackerCmd.STORAGE_JOIN == 81
+    assert P.TrackerCmd.STORAGE_BEAT == 83
+    assert P.TrackerCmd.SERVICE_QUERY_STORE_WITHOUT_GROUP_ONE == 101
+    assert P.TrackerCmd.SERVICE_QUERY_FETCH_ONE == 102
+    assert P.TrackerCmd.RESP == 100
+    assert P.TrackerCmd.ACTIVE_TEST == 111
+    assert P.StorageCmd.UPLOAD_FILE == 11
+    assert P.StorageCmd.DELETE_FILE == 12
+    assert P.StorageCmd.DOWNLOAD_FILE == 14
+    assert P.StorageCmd.SYNC_CREATE_FILE == 16
+    assert P.StorageCmd.UPLOAD_APPENDER_FILE == 23
+    assert P.StorageCmd.APPEND_FILE == 24
+    assert P.StorageCmd.TRUNCATE_FILE == 36
+
+
+def test_group_name_fields():
+    raw = P.pack_group_name("group1")
+    assert len(raw) == 16
+    assert P.unpack_group_name(raw) == "group1"
+    with pytest.raises(ValueError):
+        P.pack_group_name("x" * 17)
+
+
+def test_ext_name_fields():
+    assert P.unpack_ext_name(P.pack_ext_name("jpg")) == "jpg"
+    with pytest.raises(ValueError):
+        P.pack_ext_name("toolong7")
+
+
+def test_metadata_roundtrip():
+    meta = {"width": "1024", "height": "768", "author": "yq"}
+    raw = P.pack_metadata(meta)
+    assert P.unpack_metadata(raw) == meta
+    assert P.unpack_metadata(b"") == {}
+    assert P.pack_metadata({}) == b""
+
+
+def test_metadata_separator_bytes():
+    raw = P.pack_metadata({"a": "1", "b": "2"})
+    assert raw == b"a\x021\x01b\x022"
+
+
+def test_metadata_separators_in_key_or_value_rejected():
+    with pytest.raises(ValueError):
+        P.pack_metadata({"a\x01b": "1"})
+    with pytest.raises(ValueError):
+        P.pack_metadata({"k": "a\x02c"})
