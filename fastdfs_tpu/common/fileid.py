@@ -57,6 +57,10 @@ _FILE_ID_RE = re.compile(
     r"(?P<sub1>[0-9A-F]{2})/(?P<sub2>[0-9A-F]{2})/"
     r"(?P<b64>[A-Za-z0-9_-]{27})(?P<ext>\.[^/.]{1,6})?$"
 )
+_REMOTE_NAME_RE = re.compile(
+    r"^M[0-9A-F]{2}/[0-9A-F]{2}/[0-9A-F]{2}/"
+    r"[A-Za-z0-9_-]{27}(\.[^/.]{1,6})?$"
+)
 
 
 @dataclass(frozen=True)
@@ -145,10 +149,13 @@ def encode_file_id(
     subdir_count: int = DEFAULT_SUBDIR_COUNT,
 ) -> str:
     """Build a file-ID string (reference: storage_gen_filename())."""
-    if not re.fullmatch(r"[^/]{1,16}", group):
+    # Byte-length limits match the fixed-width wire fields
+    # (protocol.pack_group_name / pack_ext_name) so every minted ID is
+    # transmittable.
+    if not group or "/" in group or len(group.encode("utf-8")) > 16:
         raise ValueError(f"bad group name: {group!r}")
     ext = ext.lstrip(".")
-    if ext and not re.fullmatch(r"[^/.]{1,6}", ext):
+    if ext and (("/" in ext) or ("." in ext) or len(ext.encode("utf-8")) > 6):
         raise ValueError(f"bad ext name: {ext!r}")
     if not 0 <= store_path_index <= 0xFF:
         raise ValueError(f"store_path_index out of range: {store_path_index}")
@@ -225,7 +232,10 @@ def local_path(base_path: str, remote_filename: str) -> str:
     Reference: storage daemons keep each store path's payload under
     ``<store_path>/data/`` (storage_func.c:storage_make_data_dirs()).
     """
-    parts = remote_filename.split("/")
-    if len(parts) != 4 or not parts[0].startswith("M"):
+    # Strict grammar — remote filenames arrive over the wire, so anything
+    # loose here is a path traversal (``M00/../../etc`` must not escape).
+    m = _REMOTE_NAME_RE.match(remote_filename)
+    if m is None:
         raise ValueError(f"malformed remote filename: {remote_filename!r}")
+    parts = remote_filename.split("/")
     return posixpath.join(base_path, "data", parts[1], parts[2], parts[3])

@@ -38,9 +38,12 @@ class IniConfig:
         return cfg
 
     @classmethod
-    def loads(cls, text: str) -> "IniConfig":
+    def loads(cls, text: str, base_dir: str | None = None) -> "IniConfig":
+        """Parse from a string.  ``#include`` directives are rejected unless
+        ``base_dir`` says where to resolve them (a bare string has no
+        containing file to be relative to)."""
         cfg = cls()
-        cfg._parse_lines(text.splitlines(), base_dir=".", seen=set())
+        cfg._parse_lines(text.splitlines(), base_dir=base_dir, seen=set())
         return cfg
 
     def _load_file(self, path: str, seen: set[str]) -> None:
@@ -57,12 +60,16 @@ class IniConfig:
         finally:
             seen.discard(real)
 
-    def _parse_lines(self, lines: Iterable[str], base_dir: str, seen: set[str]) -> None:
+    def _parse_lines(self, lines: Iterable[str], base_dir: str | None,
+                     seen: set[str]) -> None:
         for raw in lines:
             line = raw.strip()
             if not line or line.startswith(("#", ";")):
                 m = re.match(r"#include\s+(\S.*)$", line)
                 if m:
+                    if base_dir is None:
+                        raise ValueError(
+                            "#include in a string config: pass base_dir to loads()")
                     self._load_file(os.path.join(base_dir, m.group(1).strip()), seen)
                 continue
             if re.fullmatch(r"\[[^\]]*\]", line):

@@ -1,0 +1,15 @@
+"""Dedup engine: CDC chunking → fingerprints → exact/near-dup verdicts.
+
+This is the storage-plugin payload (the rebuild's analogue of the hook
+point in the reference's ``storage/storage_func.h``): the storage upload
+path hands incoming bytes to :class:`DedupEngine` and gets back per-chunk
+write/skip verdicts plus near-duplicate candidates for the tracker index.
+"""
+
+from fastdfs_tpu.dedup.index import ExactDigestIndex, MinHashLSHIndex  # noqa: F401
+from fastdfs_tpu.dedup.engine import (  # noqa: F401
+    DedupConfig,
+    DedupEngine,
+    IngestReport,
+    ChunkRecord,
+)

@@ -1,0 +1,145 @@
+"""Digest indexes: exact (SHA1) and near-dup (MinHash + LSH banding).
+
+The exact index is the dedup verdict authority; the LSH index serves the
+tracker-side near-duplicate queries (north star: "tracker's file-id index
+backed by a jax.numpy cosine/MinHash similarity search").  Both snapshot to
+disk — the new stateful component SURVEY.md §5 says checkpoint/resume must
+cover (the reference's restart-safety is binlogs + ``.dat`` files; the
+dedup index gets the same treatment).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ExactDigestIndex:
+    """digest bytes → opaque ref (chunk locator / file id)."""
+
+    def __init__(self) -> None:
+        self._map: dict[bytes, Any] = {}
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def lookup(self, digest: bytes):
+        return self._map.get(digest)
+
+    def lookup_batch(self, digests: Sequence[bytes]) -> list[Any]:
+        return [self._map.get(d) for d in digests]
+
+    def insert(self, digest: bytes, ref: Any) -> bool:
+        """Insert if absent; returns True when this digest was new."""
+        if digest in self._map:
+            return False
+        self._map[digest] = ref
+        return True
+
+    def remove(self, digest: bytes) -> bool:
+        return self._map.pop(digest, None) is not None
+
+    # -- persistence (checkpoint/resume parity; SURVEY.md §5) -------------
+
+    def save(self, path: str) -> None:
+        digests = np.frombuffer(b"".join(self._map.keys()), dtype=np.uint8)
+        refs = np.array([json.dumps(v) for v in self._map.values()], dtype=object)
+        np.savez_compressed(path, digests=digests, refs=refs, allow_pickle=True)
+
+    @classmethod
+    def load(cls, path: str) -> "ExactDigestIndex":
+        data = np.load(path, allow_pickle=True)
+        idx = cls()
+        raw = data["digests"].tobytes()
+        refs = data["refs"]
+        for i in range(len(refs)):
+            idx._map[raw[i * 20:(i + 1) * 20]] = json.loads(str(refs[i]))
+        return idx
+
+
+class MinHashLSHIndex:
+    """Near-duplicate index: LSH band buckets over MinHash signatures.
+
+    ``num_perms = bands * rows``.  A query hashes each signature band;
+    items sharing any band bucket become candidates, then the true
+    signature-agreement score is computed vectorized against the stored
+    signature matrix (TPU/CPU via jnp) and thresholded.
+    """
+
+    def __init__(self, num_perms: int = 64, bands: int = 16) -> None:
+        if num_perms % bands:
+            raise ValueError(f"bands {bands} must divide num_perms {num_perms}")
+        self.num_perms = num_perms
+        self.bands = bands
+        self.rows = num_perms // bands
+        self._buckets: list[dict[bytes, list[int]]] = [{} for _ in range(bands)]
+        self._sigs = np.zeros((0, num_perms), dtype=np.uint32)
+        self._refs: list[Any] = []
+
+    def __len__(self) -> int:
+        return len(self._refs)
+
+    def _band_keys(self, sig: np.ndarray) -> list[bytes]:
+        return [sig[b * self.rows:(b + 1) * self.rows].tobytes()
+                for b in range(self.bands)]
+
+    def add(self, sig: np.ndarray, ref: Any) -> int:
+        sig = np.asarray(sig, dtype=np.uint32)
+        if sig.shape != (self.num_perms,):
+            raise ValueError(f"signature shape {sig.shape} != ({self.num_perms},)")
+        item = len(self._refs)
+        self._refs.append(ref)
+        self._sigs = np.concatenate([self._sigs, sig[None, :]], axis=0)
+        for b, key in enumerate(self._band_keys(sig)):
+            self._buckets[b].setdefault(key, []).append(item)
+        return item
+
+    def query(self, sig: np.ndarray, top_k: int = 5,
+              min_similarity: float = 0.5) -> list[tuple[Any, float]]:
+        """Top-k near-dup candidates with signature-agreement scores."""
+        sig = np.asarray(sig, dtype=np.uint32)
+        cand: set[int] = set()
+        for b, key in enumerate(self._band_keys(sig)):
+            cand.update(self._buckets[b].get(key, ()))
+        if not cand:
+            return []
+        ids = np.fromiter(cand, dtype=np.int64)
+        scores = np.asarray(
+            jnp.mean(jnp.asarray(self._sigs[ids]) == jnp.asarray(sig)[None, :],
+                     axis=1, dtype=jnp.float32))
+        order = np.argsort(-scores)[:top_k]
+        return [(self._refs[int(ids[i])], float(scores[i]))
+                for i in order if scores[i] >= min_similarity]
+
+    @property
+    def signatures(self) -> np.ndarray:
+        """The (N, P) stored signature matrix (for sharded/mesh queries)."""
+        return self._sigs
+
+    # -- persistence ------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        np.savez_compressed(
+            path, sigs=self._sigs,
+            refs=np.array([json.dumps(r) for r in self._refs], dtype=object),
+            num_perms=self.num_perms, bands=self.bands)
+
+    @classmethod
+    def load(cls, path: str) -> "MinHashLSHIndex":
+        data = np.load(path, allow_pickle=True)
+        idx = cls(int(data["num_perms"]), int(data["bands"]))
+        for sig, ref in zip(data["sigs"], data["refs"]):
+            idx.add(sig, json.loads(str(ref)))
+        return idx
+
+
+def atomic_save(obj, path: str) -> None:
+    """Write-then-rename snapshot (reference: tracker_save_storages() writes
+    ``.dat`` files the same way for crash consistency)."""
+    tmp = path + ".tmp.npz"
+    obj.save(tmp)
+    os.replace(tmp, path if path.endswith(".npz") else path + ".npz")

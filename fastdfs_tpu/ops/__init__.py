@@ -1,0 +1,23 @@
+"""TPU compute kernels for the dedup engine.
+
+These replace the scalar per-byte CRC32 loop on the reference's upload path
+(``storage/storage_dio.c:dio_write_file()``) with batched, vectorized
+fingerprinting: content-defined chunking (gear rolling hash), SHA1 digests,
+and MinHash signatures — jax.numpy first, Pallas for the hot ops.
+"""
+
+from fastdfs_tpu.ops.gear_cdc import (  # noqa: F401
+    GEAR_TABLE,
+    gear_hashes,
+    gear_hashes_ref,
+    select_cuts,
+    chunk_stream,
+    chunk_stream_ref,
+)
+from fastdfs_tpu.ops.sha1 import sha1_batch, sha1_hex  # noqa: F401
+from fastdfs_tpu.ops.minhash import (  # noqa: F401
+    shingle_hashes,
+    minhash_signature,
+    minhash_batch,
+    estimate_jaccard,
+)

@@ -1,0 +1,164 @@
+"""Content-defined chunking via a gear rolling hash, position-parallel.
+
+Replaces the sequential chunk loop of the reference upload path
+(``storage/storage_dio.c:dio_write_file()`` — ``buff_size`` chunks with a
+CRC32 carried across iterations) with TPU-parallel chunking.
+
+The serial gear hash is ``h = (h << 1) + gear[b[i]]`` with a cut candidate
+wherever ``h & mask == 0``.  Because ``<< 1`` pushes a byte's contribution
+out of a 32-bit register after 32 steps, ``h`` at position ``i`` depends
+only on the trailing 32-byte window:
+
+    h[i] = sum_{k=0..31} gear[b[i-k]] << k        (mod 2^32)
+
+which is computable *independently per position* — 32 shifted adds over the
+whole buffer, fully vectorized on TPU lanes.  No seam reconciliation is
+needed for the hash itself; the only sequential part is greedy cut
+*selection* under min/max chunk-size constraints, which runs over the
+sparse candidate list on the host.
+
+Cut-point equality with the canonical serial algorithm (which resets the
+hash at each chunk start) holds whenever ``min_size >= 32``: every position
+eligible for a cut is at least ``min_size`` bytes past the previous cut, so
+the 32-byte window never straddles a chunk boundary.  This is the
+"blockwise CDC with seam fixup" design from SURVEY.md §5, validated
+property-based in ``tests/test_gear_cdc.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Deterministic 256-entry gear table; fixed seed so every node in a cluster
+# (and the CPU reference path) chunks identically.
+_GEAR_SEED = 0x9E3779B9
+GEAR_TABLE = np.random.RandomState(_GEAR_SEED & 0x7FFFFFFF).randint(
+    0, 1 << 32, size=256, dtype=np.uint64
+).astype(np.uint32)
+
+WINDOW = 32
+
+# Default chunking geometry (bytes).  avg 8 KiB => 13 mask bits.
+DEFAULT_MIN_SIZE = 2048
+DEFAULT_AVG_BITS = 13
+DEFAULT_MAX_SIZE = 65536
+
+
+def gear_hashes_ref(data: bytes | np.ndarray) -> np.ndarray:
+    """Serial CPU reference: windowed gear hash at every position."""
+    buf = np.frombuffer(bytes(data), dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else np.asarray(data, dtype=np.uint8)
+    out = np.zeros(len(buf), dtype=np.uint32)
+    h = np.uint32(0)
+    with np.errstate(over="ignore"):
+        for i, b in enumerate(buf):
+            h = np.uint32(h << np.uint32(1)) + GEAR_TABLE[b]
+            out[i] = h
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=())
+def gear_hashes(data: jax.Array) -> jax.Array:
+    """Position-parallel gear hashes: ``h[i]`` for every byte position.
+
+    ``data`` is uint8 of shape ``(n,)``; returns uint32 ``(n,)`` equal to the
+    serial rolling value at each position (exactly, for all positions).
+    """
+    g = jnp.asarray(GEAR_TABLE)[data.astype(jnp.int32)]  # (n,) uint32
+    h = g
+    for k in range(1, WINDOW):
+        shifted = jnp.roll(g, k).at[:k].set(0)  # g[i-k], zero for i<k
+        h = h + (shifted << np.uint32(k))
+    return h
+
+
+def candidate_mask(hashes: jax.Array, avg_bits: int = DEFAULT_AVG_BITS) -> jax.Array:
+    """Boolean cut-candidate mask: positions where the low ``avg_bits`` of
+    the gear hash are zero (expected chunk size ``2**avg_bits``)."""
+    mask = np.uint32((1 << avg_bits) - 1)
+    return (hashes & mask) == 0
+
+
+def select_cuts(
+    candidates: np.ndarray,
+    n: int,
+    min_size: int = DEFAULT_MIN_SIZE,
+    max_size: int = DEFAULT_MAX_SIZE,
+) -> list[int]:
+    """Greedy cut selection under min/max chunk-size constraints.
+
+    ``candidates`` are sorted candidate positions (cut *after* byte ``i``,
+    i.e. chunk end ``i + 1``).  Returns exclusive end offsets of every chunk
+    (final offset is ``n``).  Sequential but sparse — O(#cuts log #cands) on
+    the host.
+    """
+    if min_size < WINDOW:
+        raise ValueError(f"min_size must be >= {WINDOW} for cut-point "
+                         f"equality with the serial reference")
+    cuts: list[int] = []
+    cand = np.asarray(candidates, dtype=np.int64)
+    last = 0
+    while n - last > max_size or (n - last >= min_size and len(cand)):
+        lo = np.searchsorted(cand, last + min_size - 1, side="left")
+        hi = np.searchsorted(cand, last + max_size - 1, side="right")
+        if lo < hi:
+            cut = int(cand[lo]) + 1
+        elif n - last > max_size:
+            cut = last + max_size
+        else:
+            break
+        cuts.append(cut)
+        last = cut
+    if last < n:
+        cuts.append(n)
+    return cuts
+
+
+def chunk_stream(
+    data: bytes,
+    min_size: int = DEFAULT_MIN_SIZE,
+    avg_bits: int = DEFAULT_AVG_BITS,
+    max_size: int = DEFAULT_MAX_SIZE,
+) -> list[int]:
+    """TPU-parallel CDC: returns exclusive chunk end offsets for ``data``."""
+    if not data:
+        return []
+    arr = jnp.frombuffer(data, dtype=jnp.uint8)
+    hashes = gear_hashes(arr)
+    cand = np.flatnonzero(np.asarray(candidate_mask(hashes, avg_bits)))
+    return select_cuts(cand, len(data), min_size, max_size)
+
+
+def chunk_stream_ref(
+    data: bytes,
+    min_size: int = DEFAULT_MIN_SIZE,
+    avg_bits: int = DEFAULT_AVG_BITS,
+    max_size: int = DEFAULT_MAX_SIZE,
+) -> list[int]:
+    """Canonical serial CDC (hash reset at each chunk start) — the CPU
+    referee for cut-point equality tests."""
+    if min_size < WINDOW:
+        raise ValueError(f"min_size must be >= {WINDOW}")
+    mask = np.uint32((1 << avg_bits) - 1)
+    table = GEAR_TABLE
+    buf = np.frombuffer(data, dtype=np.uint8)
+    n = len(buf)
+    cuts: list[int] = []
+    last = 0
+    h = np.uint32(0)
+    pos = 0
+    with np.errstate(over="ignore"):
+        while pos < n:
+            h = np.uint32(h << np.uint32(1)) + table[buf[pos]]
+            size = pos - last + 1
+            if (size >= min_size and (h & mask) == 0) or size >= max_size:
+                cuts.append(pos + 1)
+                last = pos + 1
+                h = np.uint32(0)
+            pos += 1
+    if last < n:
+        cuts.append(n)
+    return cuts

@@ -1,0 +1,96 @@
+"""MinHash near-duplicate fingerprints on TPU.
+
+The tracker-side near-dup index (north star: "tracker's file-id index
+backed by a jax.numpy cosine/MinHash similarity search") needs a compact
+per-chunk signature whose agreement rate estimates Jaccard similarity of
+the underlying shingle sets.  Pipeline:
+
+1. byte shingles of size ``k`` hashed with a polynomial hash (vectorized
+   as ``k`` shifted multiply-adds — same trick as the gear window);
+2. ``P`` universal-hash permutations ``h_j(x) = a_j * x + b_j`` over
+   uint32 (odd ``a_j``; multiply-shift family), min-reduced over shingle
+   positions → signature ``(P,)`` uint32;
+3. signature agreement fraction ≈ Jaccard(J) of shingle sets.
+
+No reference equivalent — upstream FastDFS has only exact CRC32 (SURVEY.md
+§0 north-star note).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_SHINGLE = 5
+DEFAULT_PERMS = 64
+
+_MINHASH_SEED = 0x5F3759DF
+_POLY_B = np.uint32(0x01000193)  # FNV-32 prime as shingle-hash base
+
+
+def _perm_constants(num_perms: int) -> tuple[np.ndarray, np.ndarray]:
+    rng = np.random.RandomState(_MINHASH_SEED & 0x7FFFFFFF)
+    a = (rng.randint(0, 1 << 31, size=num_perms, dtype=np.uint64) * 2 + 1).astype(np.uint32)
+    b = rng.randint(0, 1 << 32, size=num_perms, dtype=np.uint64).astype(np.uint32)
+    return a, b
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def shingle_hashes(data: jax.Array, k: int = DEFAULT_SHINGLE) -> jax.Array:
+    """Polynomial hashes of all ``k``-byte shingles of uint8 ``(n,)`` data.
+
+    Returns uint32 ``(n,)``; entry ``i`` hashes ``data[i : i+k]`` and the
+    trailing ``k-1`` entries (incomplete windows) are masked to the hash of
+    the shorter suffix — callers slice ``[: n-k+1]`` for exact semantics.
+    """
+    d = data.astype(jnp.uint32)
+    h = jnp.zeros_like(d)
+    for j in range(k):
+        shifted = jnp.roll(d, -j).at[-j:].set(0) if j else d
+        h = h * _POLY_B + shifted
+    return h
+
+
+@functools.partial(jax.jit, static_argnames=("num_perms",))
+def minhash_signature(hashes: jax.Array, num_perms: int = DEFAULT_PERMS,
+                      valid: jax.Array | None = None) -> jax.Array:
+    """MinHash signature of a set of shingle hashes.
+
+    ``hashes``: uint32 ``(m,)``.  ``valid``: optional bool ``(m,)`` mask
+    (padded positions excluded).  Returns uint32 ``(num_perms,)``.
+    """
+    a, b = _perm_constants(num_perms)
+    hv = hashes[None, :] * jnp.asarray(a)[:, None] + jnp.asarray(b)[:, None]
+    if valid is not None:
+        hv = jnp.where(valid[None, :], hv, jnp.uint32(0xFFFFFFFF))
+    return hv.min(axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("num_perms", "k"))
+def minhash_batch(data: jax.Array, lengths: jax.Array,
+                  num_perms: int = DEFAULT_PERMS,
+                  k: int = DEFAULT_SHINGLE) -> jax.Array:
+    """Signatures for a batch of chunks: uint8 ``(N, L)`` + lengths ``(N,)``
+    → uint32 ``(N, num_perms)``."""
+
+    def one(row, ln):
+        h = shingle_hashes(row, k)
+        pos = jnp.arange(row.shape[0], dtype=jnp.int32)
+        valid = pos <= (ln - k)  # complete shingles only
+        # Degenerate chunks shorter than k hash their zero-padded window.
+        valid = jnp.where(ln >= k, valid, pos < jnp.maximum(ln, 1))
+        return minhash_signature(h, num_perms, valid)
+
+    return jax.vmap(one)(jnp.asarray(data, dtype=jnp.uint8),
+                         jnp.asarray(lengths, dtype=jnp.int32))
+
+
+def estimate_jaccard(sig_a: jax.Array, sig_b: jax.Array) -> jax.Array:
+    """Agreement fraction of two signatures ≈ Jaccard similarity.
+
+    Broadcasts: ``(…, P)`` vs ``(…, P)`` → ``(…,)`` float32.
+    """
+    return (sig_a == sig_b).mean(axis=-1).astype(jnp.float32)

@@ -1,0 +1,125 @@
+"""Batched SHA1 on TPU lanes.
+
+SHA1's 80 rounds are strictly sequential *within* a message, so the TPU
+formulation parallelizes *across* chunks: every vector lane carries one
+chunk's state and all lanes step through the rounds together (SURVEY.md §7
+step 6a).  Per-chunk Merkle–Damgård padding (0x80, zeros, 64-bit bit
+length) is applied with iota masks so variable-length chunks batch into one
+fixed-shape call; blocks past a chunk's padded length leave its state
+untouched.
+
+Replaces the reference's per-byte scalar CRC32 loop on the upload path
+(``storage/storage_dio.c:dio_write_file()``) as the exact-dedup fingerprint.
+Bit-exactness against ``hashlib.sha1`` is enforced in ``tests/test_sha1.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_H0 = np.array([0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0],
+               dtype=np.uint32)
+_K = np.array([0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xCA62C1D6], dtype=np.uint32)
+
+
+def _rotl(x: jax.Array, n: int) -> jax.Array:
+    return (x << np.uint32(n)) | (x >> np.uint32(32 - n))
+
+
+def _compress_block(state: jax.Array, words: jax.Array) -> jax.Array:
+    """One SHA1 compression: ``state`` (N,5) uint32, ``words`` (N,16) uint32."""
+    w = [words[:, t] for t in range(16)]
+    for t in range(16, 80):
+        w.append(_rotl(w[t - 3] ^ w[t - 8] ^ w[t - 14] ^ w[t - 16], 1))
+    a, b, c, d, e = (state[:, i] for i in range(5))
+    for t in range(80):
+        if t < 20:
+            f = (b & c) | (jnp.bitwise_not(b) & d)
+        elif t < 40:
+            f = b ^ c ^ d
+        elif t < 60:
+            f = (b & c) | (b & d) | (c & d)
+        else:
+            f = b ^ c ^ d
+        tmp = _rotl(a, 5) + f + e + jnp.uint32(_K[t // 20]) + w[t]
+        a, b, c, d, e = tmp, a, _rotl(b, 30), c, d
+    return state + jnp.stack([a, b, c, d, e], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def _sha1_padded(data: jax.Array, lengths: jax.Array, max_len: int) -> jax.Array:
+    n = data.shape[0]
+    max_blocks = (max_len + 8) // 64 + 1
+    padded_len = max_blocks * 64
+
+    buf = jnp.zeros((n, padded_len), dtype=jnp.uint8)
+    buf = buf.at[:, : data.shape[1]].set(data)
+
+    idx = jnp.arange(padded_len, dtype=jnp.int32)[None, :]        # (1,P)
+    lens = lengths.astype(jnp.int32)[:, None]                     # (N,1)
+    n_blocks = (lens + 8) // 64 + 1                               # (N,1)
+    msg_end = n_blocks * 64
+
+    buf = jnp.where(idx < lens, buf, 0)
+    buf = jnp.where(idx == lens, jnp.uint8(0x80), buf)
+
+    # 64-bit big-endian bit length in the last 8 bytes of the final block.
+    bitlen_lo = (lens.astype(jnp.uint32) << 3)
+    bitlen_hi = (lens.astype(jnp.uint32) >> 29)
+    byte_pos = idx - (msg_end - 8)                                # 0..7 in field
+    in_field = (byte_pos >= 0) & (byte_pos < 8)
+    shift = jnp.where(byte_pos < 4, (3 - jnp.clip(byte_pos, 0, 3)) * 8,
+                      (7 - jnp.clip(byte_pos, 4, 7)) * 8).astype(jnp.uint32)
+    word = jnp.where(byte_pos < 4, bitlen_hi, bitlen_lo)
+    len_byte = ((word >> shift) & jnp.uint32(0xFF)).astype(jnp.uint8)
+    buf = jnp.where(in_field, len_byte, buf)
+
+    # Pack big-endian 4-byte words: (N, max_blocks, 16).
+    quads = buf.reshape(n, max_blocks, 16, 4).astype(jnp.uint32)
+    words = ((quads[..., 0] << 24) | (quads[..., 1] << 16)
+             | (quads[..., 2] << 8) | quads[..., 3])
+
+    state0 = jnp.broadcast_to(jnp.asarray(_H0), (n, 5)).astype(jnp.uint32)
+
+    def step(state, xs):
+        block_idx, block_words = xs
+        new_state = _compress_block(state, block_words)
+        active = (block_idx < n_blocks[:, 0])[:, None]
+        return jnp.where(active, new_state, state), None
+
+    block_ids = jnp.arange(max_blocks, dtype=jnp.int32)
+    final, _ = jax.lax.scan(step, state0, (block_ids, words.transpose(1, 0, 2)))
+    return final
+
+
+def sha1_batch(data, lengths=None) -> jax.Array:
+    """SHA1 digests for a batch of chunks.
+
+    ``data``: uint8 array ``(N, L)`` (rows zero-padded past each chunk's
+    length).  ``lengths``: int array ``(N,)`` of true byte lengths (defaults
+    to L for every row).  Returns uint32 ``(N, 5)`` digest words.
+    """
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    if data.ndim != 2:
+        raise ValueError(f"expected (N, L) batch, got shape {data.shape}")
+    if lengths is None:
+        lengths = jnp.full((data.shape[0],), data.shape[1], dtype=jnp.int32)
+    else:
+        lengths = jnp.asarray(lengths, dtype=jnp.int32)
+    return _sha1_padded(data, lengths, int(data.shape[1]))
+
+
+def sha1_hex(digest_words) -> str:
+    """Render one (5,) uint32 digest row as the canonical 40-char hex."""
+    return b"".join(int(w).to_bytes(4, "big") for w in np.asarray(digest_words)).hex()
+
+
+def digest_bytes(digest_words) -> bytes:
+    """(…,5) uint32 digest rows → 20-byte big-endian digests (ndarray of
+    object-free bytes for the index layer)."""
+    arr = np.asarray(digest_words, dtype=np.uint32)
+    return arr.astype(">u4").tobytes()

@@ -1,0 +1,24 @@
+"""Device-mesh parallelism for the dedup data plane.
+
+The reference's parallelism is threads + point-to-point TCP (SURVEY.md
+§2.8); the TPU-native equivalents here are XLA collectives over a
+``jax.sharding.Mesh``:
+
+- **sp** (sequence parallel): a long byte stream is split into contiguous
+  blocks, one per device; the gear rolling hash needs a 31-byte halo from
+  the previous block, exchanged with ``ppermute`` (the ring-attention
+  analogue for CDC — SURVEY.md §5 "long-context").
+- **dp** (data parallel): chunk batches sharded across devices; digest
+  all-gather builds the replicated exact index view.
+- **tp** (tensor parallel): the MinHash permutation axis sharded across
+  devices; ``all_gather`` reassembles full signatures.
+
+Control plane (tracker protocol, client data path) stays TCP — it is a
+storage wire protocol, not a tensor exchange.
+"""
+
+from fastdfs_tpu.parallel.mesh import make_mesh, factorize_devices  # noqa: F401
+from fastdfs_tpu.parallel.ingest_step import (  # noqa: F401
+    distributed_ingest_step,
+    make_ingest_step,
+)

@@ -1,0 +1,153 @@
+"""The distributed ingest step: the framework's "full training step".
+
+One jitted ``shard_map`` over a (dp, sp, tp) mesh runs the whole upload
+fingerprint pipeline with real shardings and collectives:
+
+1. **CDC, sequence-parallel (sp)** — each device holds one contiguous block
+   of every stream; the gear hash's 31-byte window straddles block seams,
+   so each device ``ppermute``-sends its trailing window to the next
+   device (ring halo exchange) and computes exact per-position hashes for
+   its block.  Cut candidates come out bit-identical to the single-device
+   path (tested).
+2. **Fingerprints, data-parallel (dp)** — the chunk batch is row-sharded;
+   each device runs batched SHA1 on its rows, then the digests are
+   ``all_gather``-ed (the "cross-node digest all-gather" of BASELINE
+   config 5).
+3. **MinHash, tensor-parallel (tp)** — the permutation axis is sharded;
+   each device computes ``P/tp`` signature lanes, reassembled with
+   ``all_gather``.
+4. **Index query (dp + pmax)** — the signature index is row-sharded over
+   dp; every device scores the (gathered) query signatures against its
+   shard and the global best similarity is reduced with ``pmax``.
+
+There is no SGD here — a storage system's "step" is ingest — but the
+sharding roles are the real ones: dp=batch, sp=sequence(byte stream),
+tp=feature(hash lanes).  Pipeline parallelism is intentionally absent: the
+reference's 5-stage upload pipeline (SURVEY.md §2.8) is an *async host*
+pipeline (nio→dio→binlog→sync), which maps to overlapping host↔device
+streams, not to device-staged layers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fastdfs_tpu.ops.gear_cdc import GEAR_TABLE, WINDOW
+from fastdfs_tpu.ops.minhash import _perm_constants, shingle_hashes
+from fastdfs_tpu.ops.sha1 import _sha1_padded
+
+HALO = WINDOW - 1
+
+
+def _gear_from_g(g: jax.Array) -> jax.Array:
+    """Windowed gear hash over pre-gathered table values ``g`` (n,)."""
+    h = g
+    for k in range(1, WINDOW):
+        shifted = jnp.roll(g, k).at[:k].set(0)
+        h = h + (shifted << np.uint32(k))
+    return h
+
+
+def make_ingest_step(mesh: Mesh, num_perms: int = 64, avg_bits: int = 13,
+                     shingle: int = 5):
+    """Build the jitted distributed ingest step for ``mesh``.
+
+    Returns ``step(stream, chunk_batch, chunk_lens, index_sigs)`` where
+
+    - ``stream``: uint8 ``(B, sp, block_len)`` — B byte streams, each split
+      into ``sp`` contiguous blocks (global stream = concat along axis 1);
+    - ``chunk_batch``: uint8 ``(N, L)``; ``chunk_lens``: int32 ``(N,)``;
+    - ``index_sigs``: uint32 ``(M, num_perms)`` — the near-dup index shard
+      rows (M across dp);
+
+    and returns ``(cand_mask (B, sp, block_len) bool, digests (N, 5),
+    sigs (N, num_perms), best_sim (N,))``.
+    """
+    dp = mesh.shape["dp"]
+    sp = mesh.shape["sp"]
+    tp = mesh.shape["tp"]
+    if num_perms % tp:
+        raise ValueError(f"num_perms {num_perms} must divide by tp {tp}")
+    p_local = num_perms // tp
+    a_full, b_full = _perm_constants(num_perms)
+    mask_val = np.uint32((1 << avg_bits) - 1)
+    table = jnp.asarray(GEAR_TABLE)
+
+    def step_local(stream, chunk_batch, chunk_lens, index_sigs):
+        # ---- stage 1: sequence-parallel CDC with ring halo exchange -----
+        # local stream: (B_loc, 1, block_len) — the sp axis is fully split.
+        blk = stream[:, 0, :]                       # (B_loc, L_blk) uint8
+        g = table[blk.astype(jnp.int32)]            # gear values
+        tail = g[:, -HALO:]                         # my trailing window
+        sp_idx = jax.lax.axis_index("sp")
+        # ring: device i sends tail to i+1 (its successor holds the next block)
+        prev_tail = jax.lax.ppermute(
+            tail, "sp", [(i, (i + 1) % sp) for i in range(sp)])
+        # first block has no predecessor: zero its halo contributions
+        prev_tail = jnp.where(sp_idx == 0, jnp.uint32(0), prev_tail)
+        g_ext = jnp.concatenate([prev_tail, g], axis=1)
+        h = jax.vmap(_gear_from_g)(g_ext)[:, HALO:]  # (B_loc, L_blk)
+        cand = ((h & mask_val) == 0)[:, None, :]     # restore the sp axis
+
+        # ---- stage 2: data-parallel SHA1 + digest all-gather ------------
+        digests_loc = _sha1_padded(chunk_batch, chunk_lens,
+                                   int(chunk_batch.shape[1]))  # (N_loc, 5)
+        digests = jax.lax.all_gather(digests_loc, "dp", axis=0, tiled=True)
+
+        # ---- stage 3: tensor-parallel MinHash ---------------------------
+        tp_idx = jax.lax.axis_index("tp")
+        a = jax.lax.dynamic_slice(jnp.asarray(a_full), (tp_idx * p_local,), (p_local,))
+        b = jax.lax.dynamic_slice(jnp.asarray(b_full), (tp_idx * p_local,), (p_local,))
+
+        def one_sig(row, ln):
+            sh = shingle_hashes(row, shingle)
+            pos = jnp.arange(row.shape[0], dtype=jnp.int32)
+            valid = jnp.where(ln >= shingle, pos <= ln - shingle,
+                              pos < jnp.maximum(ln, 1))
+            hv = sh[None, :] * a[:, None] + b[:, None]
+            hv = jnp.where(valid[None, :], hv, jnp.uint32(0xFFFFFFFF))
+            return hv.min(axis=1)                    # (p_local,)
+
+        sigs_loc = jax.vmap(one_sig)(chunk_batch, chunk_lens)  # (N_loc, p_local)
+        sigs_full = jax.lax.all_gather(sigs_loc, "tp", axis=1, tiled=True)
+        sigs = jax.lax.all_gather(sigs_full, "dp", axis=0, tiled=True)  # (N, P)
+
+        # ---- stage 4: dp-sharded index query + global pmax --------------
+        # index_sigs local: (M_loc, P); score all N queries vs my shard.
+        eq = sigs[:, None, :] == index_sigs[None, :, :]          # (N, M_loc, P)
+        scores = eq.mean(axis=2, dtype=jnp.float32)
+        local_best = jnp.max(scores, axis=1, initial=0.0)        # 0.0 if M_loc==0
+        best = jax.lax.pmax(local_best, "dp")                    # (N,)
+        return cand, digests, sigs, best
+
+    sharded = jax.shard_map(
+        step_local,
+        mesh=mesh,
+        in_specs=(P("dp", "sp", None), P("dp", None), P("dp"), P("dp", None)),
+        out_specs=(P("dp", "sp", None), P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+@functools.cache
+def _cached_step(mesh_key, num_perms, avg_bits, shingle):
+    mesh, _ = mesh_key
+    return make_ingest_step(mesh, num_perms, avg_bits, shingle)
+
+
+def distributed_ingest_step(mesh: Mesh, stream, chunk_batch, chunk_lens,
+                            index_sigs, num_perms: int = 64,
+                            avg_bits: int = 13, shingle: int = 5):
+    """Convenience wrapper: build (cached) and run the step on ``mesh``."""
+    step = _cached_step((mesh, str(mesh.devices.tolist())), num_perms,
+                        avg_bits, shingle)
+    return step(jnp.asarray(stream, dtype=jnp.uint8),
+                jnp.asarray(chunk_batch, dtype=jnp.uint8),
+                jnp.asarray(chunk_lens, dtype=jnp.int32),
+                jnp.asarray(index_sigs, dtype=jnp.uint32))

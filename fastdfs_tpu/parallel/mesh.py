@@ -1,0 +1,39 @@
+"""Mesh construction: factor a device count into (dp, sp, tp) axes."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def factorize_devices(n: int, num_axes: int = 3) -> tuple[int, ...]:
+    """Split ``n`` devices into ``num_axes`` near-equal power factors.
+
+    8 → (2, 2, 2); 4 → (2, 2, 1); 2 → (2, 1, 1); 1 → (1, 1, 1);
+    6 → (3, 2, 1); 12 → (3, 2, 2).  Earlier axes get the larger factors
+    (dp first: chunk batches are the abundant parallelism).
+    """
+    factors = []
+    rem = n
+    for d in range(2, rem + 1):
+        while rem % d == 0:
+            factors.append(d)
+            rem //= d
+    factors.sort(reverse=True)
+    axes = [1] * num_axes
+    for f in factors:
+        axes[int(np.argmin(axes))] *= f
+    return tuple(sorted(axes, reverse=True))
+
+
+def make_mesh(n_devices: int | None = None,
+              axis_names: tuple[str, ...] = ("dp", "sp", "tp")) -> Mesh:
+    """Build a Mesh over the first ``n_devices`` jax devices."""
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else n_devices
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, have {len(devs)}")
+    shape = factorize_devices(n, len(axis_names))
+    arr = np.array(devs[:n]).reshape(shape)
+    return Mesh(arr, axis_names)

@@ -9,12 +9,24 @@ the env mutation at import time.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The machine image forces JAX_PLATFORMS=axon (real TPU via tunnel) through
+# a sitecustomize hook, so a plain setdefault is not enough — override hard.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (after env mutation, before backend init)
+
+jax.config.update("jax_platforms", "cpu")
+
+# The suite is compile-dominated (many bucket shapes); persist compiled
+# executables across runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_fastdfs_tpu")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:

@@ -120,3 +120,28 @@ def test_local_path():
     assert p.endswith(fid.filename)
     with pytest.raises(ValueError):
         F.local_path("/x", "no/such/shape")
+
+
+def test_local_path_rejects_traversal():
+    # remote filenames come off the wire; '..' segments must never escape
+    # the store path (review finding).
+    for evil in (
+        "M00/../../passwd",
+        "M00/00/../xxxxxxxxxxxxxxxxxxxxxxxxxxx",
+        "M00/00/00/../../../../etc/passwd",
+        "M00/0G/00/" + "A" * 27,
+        "M00/00/00/..",
+    ):
+        with pytest.raises(ValueError):
+            F.local_path("/var/fdfs/p0", evil)
+
+
+def test_encode_enforces_wire_byte_lengths():
+    # multi-byte UTF-8 is limited by encoded bytes (the wire field width),
+    # not characters (review finding).
+    ok = F.encode_file_id("g", 0, "1.2.3.4", 1, 2, 3, ext="ééé")  # 6 bytes: fits
+    assert ok.endswith(".ééé")
+    with pytest.raises(ValueError):
+        F.encode_file_id("g", 0, "1.2.3.4", 1, 2, 3, ext="éééé")  # 8 bytes
+    with pytest.raises(ValueError):
+        F.encode_file_id("ééééééééé", 0, "1.2.3.4", 1, 2, 3)  # 18 bytes

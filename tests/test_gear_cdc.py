@@ -1,0 +1,101 @@
+"""Cut-point equality: the TPU position-parallel CDC must produce byte-for-
+byte identical chunk boundaries to the canonical serial algorithm
+(SURVEY.md §7 'hard parts': validate cut-point equality property-based,
+early)."""
+
+import numpy as np
+import pytest
+
+from fastdfs_tpu.ops import gear_cdc as G
+
+
+def _random_bytes(rng, n):
+    return rng.randint(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def test_gear_hash_matches_serial_reference():
+    rng = np.random.RandomState(7)
+    data = _random_bytes(rng, 4096)
+    par = np.asarray(G.gear_hashes(np.frombuffer(data, dtype=np.uint8)))
+    ref = G.gear_hashes_ref(data)
+    np.testing.assert_array_equal(par, ref)
+
+
+def test_gear_hash_short_inputs():
+    rng = np.random.RandomState(8)
+    for n in (1, 2, 31, 32, 33):
+        data = _random_bytes(rng, n)
+        par = np.asarray(G.gear_hashes(np.frombuffer(data, dtype=np.uint8)))
+        np.testing.assert_array_equal(par, G.gear_hashes_ref(data))
+
+
+@pytest.mark.parametrize("seed,n", [(1, 1 << 16), (2, 100_000), (3, 65536 + 17)])
+def test_cut_point_equality_random(seed, n):
+    rng = np.random.RandomState(seed)
+    data = _random_bytes(rng, n)
+    assert G.chunk_stream(data) == G.chunk_stream_ref(data)
+
+
+def test_cut_point_equality_low_entropy():
+    # Runs of constant bytes stress the max_size forced-cut path: a constant
+    # window yields a constant hash, so either every position is a candidate
+    # or none is.
+    data = b"\x00" * 50_000 + b"ab" * 10_000 + b"\xff" * 30_000
+    assert G.chunk_stream(data) == G.chunk_stream_ref(data)
+
+
+def test_cut_point_equality_duplicated_content():
+    rng = np.random.RandomState(11)
+    seg = _random_bytes(rng, 20_000)
+    data = seg + _random_bytes(rng, 5_000) + seg  # dedup-shaped input
+    assert G.chunk_stream(data) == G.chunk_stream_ref(data)
+
+
+def test_chunk_invariants():
+    rng = np.random.RandomState(12)
+    data = _random_bytes(rng, 200_000)
+    cuts = G.chunk_stream(data)
+    assert cuts[-1] == len(data)
+    assert cuts == sorted(set(cuts))
+    last = 0
+    for c in cuts[:-1]:
+        assert G.DEFAULT_MIN_SIZE <= c - last <= G.DEFAULT_MAX_SIZE
+        last = c
+    assert cuts[-1] - last <= G.DEFAULT_MAX_SIZE  # tail may be < min
+
+
+def test_chunks_content_defined():
+    # Shifting content by inserting a prefix must re-find the same interior
+    # boundaries (the whole point of CDC vs fixed-size chunking).
+    rng = np.random.RandomState(13)
+    body = _random_bytes(rng, 150_000)
+    cuts_a = G.chunk_stream(body)
+    prefix = _random_bytes(rng, 1_000)
+    cuts_b = G.chunk_stream(prefix + body)
+    ends_a = {c for c in cuts_a[:-1]}
+    ends_b = {c - len(prefix) for c in cuts_b[:-1]}
+    # After the cut streams re-synchronize, boundaries coincide.
+    shared = ends_a & ends_b
+    assert len(shared) >= max(1, len(ends_a) - 3)
+
+
+def test_empty_and_tiny_streams():
+    assert G.chunk_stream(b"") == []
+    assert G.chunk_stream(b"x") == [1]
+    assert G.chunk_stream_ref(b"x") == [1]
+    small = b"y" * (G.DEFAULT_MIN_SIZE - 1)
+    assert G.chunk_stream(small) == [len(small)] == G.chunk_stream_ref(small)
+
+
+def test_min_size_floor_enforced():
+    with pytest.raises(ValueError):
+        G.select_cuts(np.array([100]), 1000, min_size=16)
+    with pytest.raises(ValueError):
+        G.chunk_stream_ref(b"x" * 100, min_size=8)
+
+
+def test_custom_geometry():
+    rng = np.random.RandomState(14)
+    data = _random_bytes(rng, 50_000)
+    kw = dict(min_size=64, avg_bits=8, max_size=1024)
+    assert G.chunk_stream(data, **kw) == G.chunk_stream_ref(data, **kw)

@@ -84,6 +84,14 @@ def test_diamond_include_is_legal(tmp_path):
     assert cfg.get_all("common") == ["1", "1"]
 
 
+def test_loads_include_needs_base_dir(tmp_path):
+    with pytest.raises(ValueError):
+        IniConfig.loads("#include extra.conf\n")
+    (tmp_path / "extra.conf").write_text("x = 7\n")
+    cfg = IniConfig.loads("#include extra.conf\n", base_dir=str(tmp_path))
+    assert cfg.get_int("x") == 7
+
+
 def test_include_like_comment_is_not_directive():
     # '#includes are resolved...' is a comment, not an #include.
     cfg = IniConfig.loads("#includes are resolved relative to this file\nx = 1\n")

@@ -1,0 +1,97 @@
+"""MinHash signature properties: determinism, similarity monotonicity, and
+Jaccard-estimate accuracy vs the exact set computation."""
+
+import numpy as np
+
+from fastdfs_tpu.ops import minhash as M
+
+
+def _sig(data: bytes, perms=64, k=5):
+    arr = np.frombuffer(data, dtype=np.uint8)
+    batch = arr[None, :]
+    return np.asarray(M.minhash_batch(batch, np.array([len(data)]), perms, k))[0]
+
+
+def _exact_jaccard(a: bytes, b: bytes, k=5):
+    sa = {a[i:i + k] for i in range(len(a) - k + 1)}
+    sb = {b[i:i + k] for i in range(len(b) - k + 1)}
+    return len(sa & sb) / len(sa | sb)
+
+
+def test_identical_data_identical_signature():
+    rng = np.random.RandomState(1)
+    data = rng.randint(0, 256, size=4096, dtype=np.uint8).tobytes()
+    assert np.array_equal(_sig(data), _sig(data))
+
+
+def test_signature_is_order_sensitive_set_semantics():
+    # Same shingle multiset => same signature regardless of chunk framing.
+    data = b"abcdefghij" * 200
+    rot = data[10:] + data[:10]  # same shingle set (it's periodic)
+    a, b = _sig(data), _sig(rot)
+    assert np.mean(a == b) > 0.9
+
+
+def test_similar_vs_dissimilar():
+    rng = np.random.RandomState(2)
+    base = rng.randint(0, 256, size=8192, dtype=np.uint8)
+    near = base.copy()
+    near[100:110] = rng.randint(0, 256, size=10, dtype=np.uint8)  # tiny edit
+    far = rng.randint(0, 256, size=8192, dtype=np.uint8)
+
+    sim_near = float(np.mean(_sig(base.tobytes()) == _sig(near.tobytes())))
+    sim_far = float(np.mean(_sig(base.tobytes()) == _sig(far.tobytes())))
+    assert sim_near > 0.9
+    assert sim_far < 0.2
+
+
+def test_jaccard_estimate_tracks_exact():
+    rng = np.random.RandomState(3)
+    base = rng.randint(0, 256, size=4096, dtype=np.uint8)
+    for frac in (0.0, 0.25, 0.5):
+        other = base.copy()
+        n_edit = int(len(base) * frac)
+        if n_edit:
+            other[:n_edit] = rng.randint(0, 256, size=n_edit, dtype=np.uint8)
+        exact = _exact_jaccard(base.tobytes(), other.tobytes())
+        est = float(np.mean(_sig(base.tobytes(), perms=256) ==
+                            _sig(other.tobytes(), perms=256)))
+        assert abs(est - exact) < 0.12, (frac, exact, est)
+
+
+def test_batch_matches_single():
+    rng = np.random.RandomState(4)
+    chunks = [rng.randint(0, 256, size=n, dtype=np.uint8).tobytes()
+              for n in (100, 2000, 4096)]
+    L = max(len(c) for c in chunks)
+    batch = np.zeros((len(chunks), L), dtype=np.uint8)
+    lens = np.array([len(c) for c in chunks], dtype=np.int32)
+    for i, c in enumerate(chunks):
+        batch[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+    sigs = np.asarray(M.minhash_batch(batch, lens))
+    for i, c in enumerate(chunks):
+        assert np.array_equal(sigs[i], _sig(c))
+
+
+def test_padding_does_not_leak_into_signature():
+    data = b"hello world, hello dedup" * 50
+    arr = np.frombuffer(data, dtype=np.uint8)
+    a = np.asarray(M.minhash_batch(arr[None, :], np.array([len(data)])))[0]
+    padded = np.zeros((1, len(data) + 512), dtype=np.uint8)
+    padded[0, : len(data)] = arr
+    b = np.asarray(M.minhash_batch(padded, np.array([len(data)])))[0]
+    assert np.array_equal(a, b)
+
+
+def test_tiny_chunks_do_not_crash():
+    for n in (1, 3, 4, 5):
+        data = bytes(range(n))
+        sig = _sig(data)
+        assert sig.shape == (64,)
+
+
+def test_estimate_jaccard_shape():
+    a = np.zeros((3, 64), dtype=np.uint32)
+    b = np.zeros((3, 64), dtype=np.uint32)
+    out = np.asarray(M.estimate_jaccard(a, b))
+    assert out.shape == (3,) and np.all(out == 1.0)

@@ -1,0 +1,67 @@
+"""Bit-exactness of the batched TPU SHA1 against hashlib (SURVEY.md §7:
+'keep a bit-exact CPU cross-check in tests')."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from fastdfs_tpu.ops.sha1 import sha1_batch, sha1_hex, digest_bytes
+
+
+def _pad_batch(chunks):
+    max_len = max((len(c) for c in chunks), default=0) or 1
+    batch = np.zeros((len(chunks), max_len), dtype=np.uint8)
+    lens = np.zeros(len(chunks), dtype=np.int32)
+    for i, c in enumerate(chunks):
+        batch[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+        lens[i] = len(c)
+    return batch, lens
+
+
+def test_known_vectors():
+    batch, lens = _pad_batch([b"abc", b""])
+    out = np.asarray(sha1_batch(batch, lens))
+    assert sha1_hex(out[0]) == "a9993e364706816aba3e25717850c26c9cd0d89d"
+    assert sha1_hex(out[1]) == "da39a3ee5e6b4b0d3255bfef95601890afd80709"
+
+
+@pytest.mark.parametrize("length", [0, 1, 55, 56, 57, 63, 64, 65, 119, 120,
+                                    121, 127, 128, 1000, 4096])
+def test_padding_edges(length):
+    rng = np.random.RandomState(length)
+    data = rng.randint(0, 256, size=length, dtype=np.uint8).tobytes()
+    batch, lens = _pad_batch([data])
+    out = np.asarray(sha1_batch(batch, lens))
+    assert sha1_hex(out[0]) == hashlib.sha1(data).hexdigest()
+
+
+def test_mixed_length_batch():
+    rng = np.random.RandomState(42)
+    chunks = [rng.randint(0, 256, size=rng.randint(0, 5000), dtype=np.uint8).tobytes()
+              for _ in range(32)]
+    batch, lens = _pad_batch(chunks)
+    out = np.asarray(sha1_batch(batch, lens))
+    for i, c in enumerate(chunks):
+        assert sha1_hex(out[i]) == hashlib.sha1(c).hexdigest()
+
+
+def test_default_lengths_full_rows():
+    rng = np.random.RandomState(5)
+    batch = rng.randint(0, 256, size=(4, 256), dtype=np.uint8)
+    out = np.asarray(sha1_batch(batch))
+    for i in range(4):
+        assert sha1_hex(out[i]) == hashlib.sha1(batch[i].tobytes()).hexdigest()
+
+
+def test_digest_bytes_layout():
+    batch, lens = _pad_batch([b"abc"])
+    out = np.asarray(sha1_batch(batch, lens))
+    raw = digest_bytes(out[0])
+    assert raw == hashlib.sha1(b"abc").digest()
+    assert len(raw) == 20
+
+
+def test_rejects_bad_shape():
+    with pytest.raises(ValueError):
+        sha1_batch(np.zeros(10, dtype=np.uint8))
