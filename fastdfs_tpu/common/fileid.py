@@ -52,14 +52,17 @@ FLAG_TRUNK = 1 << 61
 FLAG_APPENDER = 1 << 62
 
 _BLOB_STRUCT = struct.Struct(">IIqI")
+# \Z (not $) so trailing newlines never sneak past; whitespace and control
+# characters are excluded from group/ext classes — these strings arrive over
+# the wire and end up in filesystem paths and logs.
 _FILE_ID_RE = re.compile(
-    r"^(?P<group>[^/]{1,16})/M(?P<path>[0-9A-F]{2})/"
+    r"^(?P<group>[^\s/]{1,16})/M(?P<path>[0-9A-F]{2})/"
     r"(?P<sub1>[0-9A-F]{2})/(?P<sub2>[0-9A-F]{2})/"
-    r"(?P<b64>[A-Za-z0-9_-]{27})(?P<ext>\.[^/.]{1,6})?$"
+    r"(?P<b64>[A-Za-z0-9_-]{27})(?P<ext>\.[^\s/.]{1,6})?\Z"
 )
 _REMOTE_NAME_RE = re.compile(
     r"^M[0-9A-F]{2}/[0-9A-F]{2}/[0-9A-F]{2}/"
-    r"[A-Za-z0-9_-]{27}(\.[^/.]{1,6})?$"
+    r"[A-Za-z0-9_-]{27}(\.[^\s/.]{1,6})?\Z"
 )
 
 
@@ -152,10 +155,12 @@ def encode_file_id(
     # Byte-length limits match the fixed-width wire fields
     # (protocol.pack_group_name / pack_ext_name) so every minted ID is
     # transmittable.
-    if not group or "/" in group or len(group.encode("utf-8")) > 16:
+    if (not group or len(group.encode("utf-8")) > 16
+            or any(c == "/" or c.isspace() or ord(c) < 0x20 for c in group)):
         raise ValueError(f"bad group name: {group!r}")
     ext = ext.lstrip(".")
-    if ext and (("/" in ext) or ("." in ext) or len(ext.encode("utf-8")) > 6):
+    if ext and (len(ext.encode("utf-8")) > 6 or any(
+            c in "/." or c.isspace() or ord(c) < 0x20 for c in ext)):
         raise ValueError(f"bad ext name: {ext!r}")
     if not 0 <= store_path_index <= 0xFF:
         raise ValueError(f"store_path_index out of range: {store_path_index}")

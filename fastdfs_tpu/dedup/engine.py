@@ -26,7 +26,7 @@ import numpy as np
 from fastdfs_tpu.dedup.index import ExactDigestIndex, MinHashLSHIndex
 from fastdfs_tpu.ops import gear_cdc
 from fastdfs_tpu.ops.minhash import DEFAULT_PERMS, DEFAULT_SHINGLE, minhash_batch
-from fastdfs_tpu.ops.sha1 import sha1_batch
+from fastdfs_tpu.ops.sha1 import digest_bytes, sha1_batch
 
 
 @dataclass(frozen=True)
@@ -143,11 +143,17 @@ class DedupEngine:
         if not spans:
             return report
 
-        raw = digests.astype(">u4").tobytes()
+        raw = digest_bytes(digests)
+        # Repeats *within* this stream must judge as duplicates even on a
+        # dry run, so track first-seen digests locally too.
+        seen_here: dict[bytes, list] = {}
         for i, (off, ln) in enumerate(spans):
             dig = raw[i * 20:(i + 1) * 20]
             existing = self.exact.lookup(dig)
             if existing is None:
+                existing = seen_here.get(dig)
+            if existing is None:
+                seen_here[dig] = [file_ref, off]
                 if update_index:
                     self.exact.insert(dig, [file_ref, off])
                 report.chunks.append(ChunkRecord(off, ln, dig, duplicate=False))

@@ -48,11 +48,11 @@ class ExactDigestIndex:
     def save(self, path: str) -> None:
         digests = np.frombuffer(b"".join(self._map.keys()), dtype=np.uint8)
         refs = np.array([json.dumps(v) for v in self._map.values()], dtype=object)
-        np.savez_compressed(path, digests=digests, refs=refs, allow_pickle=True)
+        _atomic_savez(path, digests=digests, refs=refs)
 
     @classmethod
     def load(cls, path: str) -> "ExactDigestIndex":
-        data = np.load(path, allow_pickle=True)
+        data = np.load(_npz_path(path), allow_pickle=True)
         idx = cls()
         raw = data["digests"].tobytes()
         refs = data["refs"]
@@ -77,7 +77,10 @@ class MinHashLSHIndex:
         self.bands = bands
         self.rows = num_perms // bands
         self._buckets: list[dict[bytes, list[int]]] = [{} for _ in range(bands)]
-        self._sigs = np.zeros((0, num_perms), dtype=np.uint32)
+        # Rows accumulate in a list (O(1) amortized add); the dense matrix is
+        # materialized lazily and cached for queries.
+        self._rows: list[np.ndarray] = []
+        self._sigs_cache: np.ndarray | None = None
         self._refs: list[Any] = []
 
     def __len__(self) -> int:
@@ -93,7 +96,8 @@ class MinHashLSHIndex:
             raise ValueError(f"signature shape {sig.shape} != ({self.num_perms},)")
         item = len(self._refs)
         self._refs.append(ref)
-        self._sigs = np.concatenate([self._sigs, sig[None, :]], axis=0)
+        self._rows.append(sig)
+        self._sigs_cache = None
         for b, key in enumerate(self._band_keys(sig)):
             self._buckets[b].setdefault(key, []).append(item)
         return item
@@ -108,8 +112,9 @@ class MinHashLSHIndex:
         if not cand:
             return []
         ids = np.fromiter(cand, dtype=np.int64)
+        sigs = self.signatures
         scores = np.asarray(
-            jnp.mean(jnp.asarray(self._sigs[ids]) == jnp.asarray(sig)[None, :],
+            jnp.mean(jnp.asarray(sigs[ids]) == jnp.asarray(sig)[None, :],
                      axis=1, dtype=jnp.float32))
         order = np.argsort(-scores)[:top_k]
         return [(self._refs[int(ids[i])], float(scores[i]))
@@ -118,28 +123,42 @@ class MinHashLSHIndex:
     @property
     def signatures(self) -> np.ndarray:
         """The (N, P) stored signature matrix (for sharded/mesh queries)."""
-        return self._sigs
+        if self._sigs_cache is None:
+            self._sigs_cache = (np.stack(self._rows) if self._rows
+                                else np.zeros((0, self.num_perms), np.uint32))
+        return self._sigs_cache
 
     # -- persistence ------------------------------------------------------
 
     def save(self, path: str) -> None:
-        np.savez_compressed(
-            path, sigs=self._sigs,
+        _atomic_savez(
+            path, sigs=self.signatures,
             refs=np.array([json.dumps(r) for r in self._refs], dtype=object),
             num_perms=self.num_perms, bands=self.bands)
 
     @classmethod
     def load(cls, path: str) -> "MinHashLSHIndex":
-        data = np.load(path, allow_pickle=True)
+        data = np.load(_npz_path(path), allow_pickle=True)
         idx = cls(int(data["num_perms"]), int(data["bands"]))
-        for sig, ref in zip(data["sigs"], data["refs"]):
-            idx.add(sig, json.loads(str(ref)))
+        sigs = np.asarray(data["sigs"], dtype=np.uint32)
+        idx._rows = list(sigs)
+        idx._sigs_cache = sigs if len(sigs) else None
+        idx._refs = [json.loads(str(r)) for r in data["refs"]]
+        for item, sig in enumerate(idx._rows):
+            for b, key in enumerate(idx._band_keys(sig)):
+                idx._buckets[b].setdefault(key, []).append(item)
         return idx
 
 
-def atomic_save(obj, path: str) -> None:
+def _npz_path(path: str) -> str:
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def _atomic_savez(path: str, **arrays) -> None:
     """Write-then-rename snapshot (reference: tracker_save_storages() writes
-    ``.dat`` files the same way for crash consistency)."""
-    tmp = path + ".tmp.npz"
-    obj.save(tmp)
-    os.replace(tmp, path if path.endswith(".npz") else path + ".npz")
+    its ``.dat`` files the same way for crash consistency)."""
+    final = _npz_path(path)
+    tmp = final + ".tmp"
+    np.savez_compressed(tmp, **arrays)
+    # np.savez appends .npz to paths without it.
+    os.replace(tmp + ".npz", final)

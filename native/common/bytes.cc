@@ -1,0 +1,225 @@
+#include "common/bytes.h"
+
+#include <array>
+#include <cstring>
+
+namespace fdfs {
+
+void PutInt64BE(int64_t v, uint8_t* out) {
+  uint64_t u = static_cast<uint64_t>(v);
+  for (int i = 7; i >= 0; --i) {
+    out[i] = static_cast<uint8_t>(u & 0xFF);
+    u >>= 8;
+  }
+}
+
+int64_t GetInt64BE(const uint8_t* in) {
+  uint64_t u = 0;
+  for (int i = 0; i < 8; ++i) u = (u << 8) | in[i];
+  return static_cast<int64_t>(u);
+}
+
+void PutInt32BE(uint32_t v, uint8_t* out) {
+  out[0] = static_cast<uint8_t>(v >> 24);
+  out[1] = static_cast<uint8_t>(v >> 16);
+  out[2] = static_cast<uint8_t>(v >> 8);
+  out[3] = static_cast<uint8_t>(v);
+}
+
+uint32_t GetInt32BE(const uint8_t* in) {
+  return (static_cast<uint32_t>(in[0]) << 24) |
+         (static_cast<uint32_t>(in[1]) << 16) |
+         (static_cast<uint32_t>(in[2]) << 8) | in[3];
+}
+
+// -- base64url ------------------------------------------------------------
+
+static const char kB64Alphabet[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789-_";
+
+std::string Base64UrlEncode(const uint8_t* data, size_t len) {
+  std::string out;
+  out.reserve((len * 4 + 2) / 3);
+  size_t i = 0;
+  for (; i + 3 <= len; i += 3) {
+    uint32_t v = (data[i] << 16) | (data[i + 1] << 8) | data[i + 2];
+    out.push_back(kB64Alphabet[(v >> 18) & 63]);
+    out.push_back(kB64Alphabet[(v >> 12) & 63]);
+    out.push_back(kB64Alphabet[(v >> 6) & 63]);
+    out.push_back(kB64Alphabet[v & 63]);
+  }
+  size_t rem = len - i;
+  if (rem == 1) {
+    uint32_t v = data[i] << 16;
+    out.push_back(kB64Alphabet[(v >> 18) & 63]);
+    out.push_back(kB64Alphabet[(v >> 12) & 63]);
+  } else if (rem == 2) {
+    uint32_t v = (data[i] << 16) | (data[i + 1] << 8);
+    out.push_back(kB64Alphabet[(v >> 18) & 63]);
+    out.push_back(kB64Alphabet[(v >> 12) & 63]);
+    out.push_back(kB64Alphabet[(v >> 6) & 63]);
+  }
+  return out;
+}
+
+static std::array<int8_t, 256> BuildB64Rev() {
+  std::array<int8_t, 256> rev;
+  rev.fill(-1);
+  for (int i = 0; i < 64; ++i) rev[static_cast<uint8_t>(kB64Alphabet[i])] = i;
+  return rev;
+}
+
+bool Base64UrlDecode(std::string_view s, std::string* out) {
+  static const std::array<int8_t, 256> rev = BuildB64Rev();
+  if (s.size() % 4 == 1) return false;  // impossible length
+  out->clear();
+  out->reserve(s.size() * 3 / 4);
+  uint32_t acc = 0;
+  int bits = 0;
+  for (char c : s) {
+    int8_t v = rev[static_cast<uint8_t>(c)];
+    if (v < 0) return false;
+    acc = (acc << 6) | static_cast<uint32_t>(v);
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out->push_back(static_cast<char>((acc >> bits) & 0xFF));
+    }
+  }
+  return true;
+}
+
+// -- crc32 (IEEE, table-driven) -------------------------------------------
+
+static std::array<uint32_t, 256> BuildCrcTable() {
+  std::array<uint32_t, 256> t;
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    t[i] = c;
+  }
+  return t;
+}
+
+uint32_t Crc32(const void* data, size_t len, uint32_t seed) {
+  static const std::array<uint32_t, 256> table = BuildCrcTable();
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; ++i) c = table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// -- sha1 -----------------------------------------------------------------
+
+static inline uint32_t Rotl(uint32_t x, int n) {
+  return (x << n) | (x >> (32 - n));
+}
+
+static void Sha1Compress(uint32_t h[5], const uint8_t block[64]) {
+  uint32_t w[80];
+  for (int t = 0; t < 16; ++t) {
+    w[t] = (static_cast<uint32_t>(block[t * 4]) << 24) |
+           (static_cast<uint32_t>(block[t * 4 + 1]) << 16) |
+           (static_cast<uint32_t>(block[t * 4 + 2]) << 8) | block[t * 4 + 3];
+  }
+  for (int t = 16; t < 80; ++t)
+    w[t] = Rotl(w[t - 3] ^ w[t - 8] ^ w[t - 14] ^ w[t - 16], 1);
+  uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4];
+  for (int t = 0; t < 80; ++t) {
+    uint32_t f, k;
+    if (t < 20) {
+      f = (b & c) | (~b & d);
+      k = 0x5A827999u;
+    } else if (t < 40) {
+      f = b ^ c ^ d;
+      k = 0x6ED9EBA1u;
+    } else if (t < 60) {
+      f = (b & c) | (b & d) | (c & d);
+      k = 0x8F1BBCDCu;
+    } else {
+      f = b ^ c ^ d;
+      k = 0xCA62C1D6u;
+    }
+    uint32_t tmp = Rotl(a, 5) + f + e + k + w[t];
+    e = d;
+    d = c;
+    c = Rotl(b, 30);
+    b = a;
+    a = tmp;
+  }
+  h[0] += a;
+  h[1] += b;
+  h[2] += c;
+  h[3] += d;
+  h[4] += e;
+}
+
+Sha1Stream::Sha1Stream() : total_(0), buf_len_(0) {
+  h_[0] = 0x67452301u;
+  h_[1] = 0xEFCDAB89u;
+  h_[2] = 0x98BADCFEu;
+  h_[3] = 0x10325476u;
+  h_[4] = 0xC3D2E1F0u;
+}
+
+void Sha1Stream::Update(const void* data, size_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  total_ += len;
+  if (buf_len_ > 0) {
+    size_t need = 64 - buf_len_;
+    size_t take = len < need ? len : need;
+    std::memcpy(buf_ + buf_len_, p, take);
+    buf_len_ += take;
+    p += take;
+    len -= take;
+    if (buf_len_ == 64) {
+      Sha1Compress(h_, buf_);
+      buf_len_ = 0;
+    }
+  }
+  while (len >= 64) {
+    Sha1Compress(h_, p);
+    p += 64;
+    len -= 64;
+  }
+  if (len > 0) {
+    std::memcpy(buf_, p, len);
+    buf_len_ = len;
+  }
+}
+
+Sha1Digest Sha1Stream::Final() {
+  uint64_t bit_len = total_ * 8;
+  uint8_t pad = 0x80;
+  Update(&pad, 1);
+  uint8_t zero = 0;
+  while (buf_len_ != 56) Update(&zero, 1);
+  uint8_t len_bytes[8];
+  for (int i = 7; i >= 0; --i) {
+    len_bytes[i] = static_cast<uint8_t>(bit_len & 0xFF);
+    bit_len >>= 8;
+  }
+  // Update() counts these toward total_, but bit_len is already latched.
+  Update(len_bytes, 8);
+  Sha1Digest d;
+  for (int i = 0; i < 5; ++i) PutInt32BE(h_[i], d.bytes + i * 4);
+  return d;
+}
+
+Sha1Digest Sha1(const void* data, size_t len) {
+  Sha1Stream s;
+  s.Update(data, len);
+  return s.Final();
+}
+
+std::string Sha1Digest::Hex() const {
+  static const char* kHex = "0123456789abcdef";
+  std::string out(40, '0');
+  for (int i = 0; i < 20; ++i) {
+    out[i * 2] = kHex[bytes[i] >> 4];
+    out[i * 2 + 1] = kHex[bytes[i] & 0xF];
+  }
+  return out;
+}
+
+}  // namespace fdfs

@@ -1,0 +1,52 @@
+// Byte-level codecs shared by the daemons: big-endian int64 framing,
+// URL-safe base64 (file-ID alphabet), CRC32, SHA1.
+//
+// Reference equivalents: libfastcommon shared_func.c (long2buff/buff2long),
+// base64.c (file-ID codec), hash.c CRC32, md5.c/sha1 analogues.  Must stay
+// bit-compatible with fastdfs_tpu/common (cross-checked by
+// tests/test_native_common.py golden vectors).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+#include <string_view>
+
+namespace fdfs {
+
+// -- endian framing (reference: shared_func.c long2buff/buff2long) --------
+void PutInt64BE(int64_t v, uint8_t* out);
+int64_t GetInt64BE(const uint8_t* in);
+void PutInt32BE(uint32_t v, uint8_t* out);
+uint32_t GetInt32BE(const uint8_t* in);
+
+// -- URL-safe base64, no padding (file-ID codec; 20 bytes -> 27 chars) ----
+std::string Base64UrlEncode(const uint8_t* data, size_t len);
+// Returns false on invalid input characters or impossible length.
+bool Base64UrlDecode(std::string_view s, std::string* out);
+
+// -- CRC32 (IEEE, zlib-compatible; reference: hash.c crc32) ---------------
+uint32_t Crc32(const void* data, size_t len, uint32_t seed = 0);
+
+// -- SHA1 (dedup CPU baseline path) ---------------------------------------
+struct Sha1Digest {
+  uint8_t bytes[20];
+  std::string Hex() const;
+};
+Sha1Digest Sha1(const void* data, size_t len);
+
+// Incremental SHA1 for streamed uploads (chunked dio writes).
+class Sha1Stream {
+ public:
+  Sha1Stream();
+  void Update(const void* data, size_t len);
+  Sha1Digest Final();
+
+ private:
+  uint32_t h_[5];
+  uint64_t total_;
+  uint8_t buf_[64];
+  size_t buf_len_;
+};
+
+}  // namespace fdfs

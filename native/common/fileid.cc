@@ -1,0 +1,204 @@
+#include "common/fileid.h"
+
+#include <cctype>
+#include <cstdio>
+#include <cstring>
+
+#include "common/bytes.h"
+#include "common/protocol_gen.h"
+
+namespace fdfs {
+
+namespace {
+
+constexpr int kBlobSize = 20;
+
+void PackBlob(const EncodeFileIdArgs& a, uint8_t out[kBlobSize]) {
+  uint64_t size_field = (a.file_size & kFileSizeMask) |
+                        (static_cast<uint64_t>(a.uniquifier & kUniqMask)
+                         << kUniqShift);
+  if (a.appender) size_field |= kFlagAppender;
+  if (a.trunk) size_field |= kFlagTrunk;
+  if (a.slave) size_field |= kFlagSlave;
+  PutInt32BE(a.source_ip, out);
+  PutInt32BE(a.create_timestamp, out + 4);
+  PutInt64BE(static_cast<int64_t>(size_field), out + 8);
+  PutInt32BE(a.crc32, out + 16);
+}
+
+void SubdirsForBlob(const uint8_t blob[kBlobSize], int subdir_count,
+                    int* sub1, int* sub2) {
+  uint32_t h = Crc32(blob, kBlobSize);
+  *sub1 = static_cast<int>((h >> 16) & 0xFF) % subdir_count;
+  *sub2 = static_cast<int>(h & 0xFF) % subdir_count;
+}
+
+bool IsHex2(std::string_view s) {
+  // Uppercase hex only, matching the Python grammar [0-9A-F]{2}.
+  auto ok = [](char c) {
+    return (c >= '0' && c <= '9') || (c >= 'A' && c <= 'F');
+  };
+  return s.size() == 2 && ok(s[0]) && ok(s[1]);
+}
+
+bool IsB64Name(std::string_view s) {
+  if (s.size() != static_cast<size_t>(kFilenameBase64Length)) return false;
+  for (char c : s) {
+    if (!(std::isalnum(static_cast<uint8_t>(c)) || c == '-' || c == '_'))
+      return false;
+  }
+  return true;
+}
+
+bool IsExt(std::string_view s) {  // without dot
+  if (s.empty() || s.size() > static_cast<size_t>(kFileExtNameMaxLen))
+    return false;
+  for (char c : s) {
+    // No separators, whitespace, or control bytes — these strings land in
+    // filesystem paths and logs.
+    uint8_t u = static_cast<uint8_t>(c);
+    if (c == '/' || c == '.' || u <= 0x20 || u == 0x7F) return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+std::string FileIdParts::RemoteFilename() const {
+  char buf[16];
+  std::snprintf(buf, sizeof(buf), "M%02X/%02X/%02X/", store_path_index,
+                subdir1, subdir2);
+  return std::string(buf) + filename;
+}
+
+std::string FileIdParts::FullId() const { return group + "/" + RemoteFilename(); }
+
+std::optional<std::string> EncodeFileId(const EncodeFileIdArgs& a) {
+  if (a.group.empty() ||
+      a.group.size() > static_cast<size_t>(kGroupNameMaxLen) ||
+      a.group.find('/') != std::string_view::npos)
+    return std::nullopt;
+  if (!a.ext.empty() && !IsExt(a.ext)) return std::nullopt;
+  if (a.store_path_index < 0 || a.store_path_index > 0xFF) return std::nullopt;
+  if (a.file_size > kFileSizeMask) return std::nullopt;
+  if (a.uniquifier < 0 || static_cast<uint64_t>(a.uniquifier) > kUniqMask)
+    return std::nullopt;
+
+  uint8_t blob[kBlobSize];
+  PackBlob(a, blob);
+  int sub1, sub2;
+  SubdirsForBlob(blob, a.subdir_count, &sub1, &sub2);
+
+  char prefix[40];
+  std::snprintf(prefix, sizeof(prefix), "/M%02X/%02X/%02X/",
+                a.store_path_index, sub1, sub2);
+  std::string out(a.group);
+  out += prefix;
+  out += Base64UrlEncode(blob, kBlobSize);
+  if (!a.ext.empty()) {
+    out += '.';
+    out.append(a.ext);
+  }
+  return out;
+}
+
+std::optional<FileIdParts> DecodeFileId(std::string_view id, int subdir_count) {
+  // group/Mxx/aa/bb/name[.ext]
+  size_t s0 = id.find('/');
+  if (s0 == std::string_view::npos || s0 == 0 ||
+      s0 > static_cast<size_t>(kGroupNameMaxLen))
+    return std::nullopt;
+  std::string_view rest = id.substr(s0 + 1);
+
+  if (rest.size() < 10 || rest[0] != 'M') return std::nullopt;
+  std::string_view mpart = rest.substr(1, 2);
+  std::string_view sub1p = rest.substr(4, 2);
+  std::string_view sub2p = rest.substr(7, 2);
+  if (rest[3] != '/' || rest[6] != '/' || rest[9] != '/') return std::nullopt;
+  if (!IsHex2(mpart) || !IsHex2(sub1p) || !IsHex2(sub2p)) return std::nullopt;
+  std::string_view name = rest.substr(10);
+
+  std::string_view b64 = name;
+  std::string_view ext;
+  size_t dot = name.find('.');
+  if (dot != std::string_view::npos) {
+    b64 = name.substr(0, dot);
+    ext = name.substr(dot + 1);
+    if (!IsExt(ext)) return std::nullopt;
+    if (ext.find('.') != std::string_view::npos) return std::nullopt;
+  }
+  if (!IsB64Name(b64)) return std::nullopt;
+
+  std::string blob;
+  if (!Base64UrlDecode(b64, &blob) || blob.size() != kBlobSize)
+    return std::nullopt;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(blob.data());
+
+  FileIdParts parts;
+  parts.group = std::string(id.substr(0, s0));
+  parts.store_path_index = std::stoi(std::string(mpart), nullptr, 16);
+  parts.subdir1 = std::stoi(std::string(sub1p), nullptr, 16);
+  parts.subdir2 = std::stoi(std::string(sub2p), nullptr, 16);
+  parts.filename = std::string(name);
+
+  int want1, want2;
+  SubdirsForBlob(p, subdir_count, &want1, &want2);
+  if (want1 != parts.subdir1 || want2 != parts.subdir2) return std::nullopt;
+
+  parts.source_ip = GetInt32BE(p);
+  parts.create_timestamp = GetInt32BE(p + 4);
+  uint64_t size_field = static_cast<uint64_t>(GetInt64BE(p + 8));
+  parts.crc32 = GetInt32BE(p + 16);
+  parts.file_size = size_field & kFileSizeMask;
+  parts.uniquifier = static_cast<int>((size_field >> kUniqShift) & kUniqMask);
+  parts.appender = (size_field & kFlagAppender) != 0;
+  parts.trunk = (size_field & kFlagTrunk) != 0;
+  parts.slave = (size_field & kFlagSlave) != 0;
+  return parts;
+}
+
+std::optional<std::string> LocalPath(std::string_view base_path,
+                                     std::string_view rf) {
+  // Mxx/aa/bb/name[.ext] — strict; wire input must never escape base_path.
+  if (rf.size() < 10 || rf[0] != 'M' || rf[3] != '/' || rf[6] != '/' ||
+      rf[9] != '/')
+    return std::nullopt;
+  if (!IsHex2(rf.substr(1, 2)) || !IsHex2(rf.substr(4, 2)) ||
+      !IsHex2(rf.substr(7, 2)))
+    return std::nullopt;
+  std::string_view name = rf.substr(10);
+  std::string_view b64 = name;
+  size_t dot = name.find('.');
+  if (dot != std::string_view::npos) {
+    b64 = name.substr(0, dot);
+    if (!IsExt(name.substr(dot + 1))) return std::nullopt;
+  }
+  if (!IsB64Name(b64)) return std::nullopt;
+
+  std::string out(base_path);
+  out += "/data/";
+  out.append(rf.substr(4, 2));
+  out += '/';
+  out.append(rf.substr(7, 2));
+  out += '/';
+  out.append(name);
+  return out;
+}
+
+uint32_t PackIp(std::string_view dotted) {
+  unsigned a, b, c, d;
+  if (std::sscanf(std::string(dotted).c_str(), "%u.%u.%u.%u", &a, &b, &c,
+                  &d) != 4)
+    return 0;
+  if (a > 255 || b > 255 || c > 255 || d > 255) return 0;
+  return (a << 24) | (b << 16) | (c << 8) | d;
+}
+
+std::string UnpackIp(uint32_t ip) {
+  char buf[20];
+  std::snprintf(buf, sizeof(buf), "%u.%u.%u.%u", (ip >> 24) & 0xFF,
+                (ip >> 16) & 0xFF, (ip >> 8) & 0xFF, ip & 0xFF);
+  return buf;
+}
+
+}  // namespace fdfs

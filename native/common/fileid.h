@@ -1,0 +1,75 @@
+// Self-describing file-ID codec, bit-compatible with
+// fastdfs_tpu/common/fileid.py (cross-checked by golden tests).
+//
+// Reference: storage/storage_service.c:storage_gen_filename(),
+// common/fdfs_global.c:fdfs_check_data_filename().
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <string_view>
+
+namespace fdfs {
+
+inline constexpr uint64_t kFileSizeMask = (1ULL << 48) - 1;
+inline constexpr int kUniqShift = 48;
+inline constexpr uint64_t kUniqMask = 0xFFF;
+inline constexpr uint64_t kFlagSlave = 1ULL << 60;
+inline constexpr uint64_t kFlagTrunk = 1ULL << 61;
+inline constexpr uint64_t kFlagAppender = 1ULL << 62;
+inline constexpr int kDefaultSubdirCount = 256;
+
+struct FileIdParts {
+  std::string group;
+  int store_path_index = 0;
+  int subdir1 = 0;
+  int subdir2 = 0;
+  std::string filename;  // 27 b64 chars + optional .ext
+
+  // Decoded blob facts.
+  uint32_t source_ip = 0;  // packed IPv4
+  uint32_t create_timestamp = 0;
+  uint64_t file_size = 0;
+  uint32_t crc32 = 0;
+  int uniquifier = 0;
+  bool appender = false;
+  bool trunk = false;
+  bool slave = false;
+
+  std::string RemoteFilename() const;  // "Mxx/aa/bb/name[.ext]"
+  std::string FullId() const;          // "group/Mxx/aa/bb/name[.ext]"
+};
+
+struct EncodeFileIdArgs {
+  std::string_view group;
+  int store_path_index = 0;
+  uint32_t source_ip = 0;  // packed IPv4 (use PackIp)
+  uint32_t create_timestamp = 0;
+  uint64_t file_size = 0;
+  uint32_t crc32 = 0;
+  std::string_view ext;  // without dot; may be empty
+  int uniquifier = 0;
+  bool appender = false;
+  bool trunk = false;
+  bool slave = false;
+  int subdir_count = kDefaultSubdirCount;
+};
+
+// Returns empty optional on invalid args (bad group/ext length, ranges).
+std::optional<std::string> EncodeFileId(const EncodeFileIdArgs& args);
+
+// Full-ID parse+validate (group/Mxx/aa/bb/b64[.ext]); nullopt if malformed
+// or the subdir pair does not match the blob hash.
+std::optional<FileIdParts> DecodeFileId(std::string_view file_id,
+                                        int subdir_count = kDefaultSubdirCount);
+
+// Strict wire-grammar check for "Mxx/aa/bb/name[.ext]" (path-traversal
+// guard); returns local path "<base>/data/aa/bb/name" or nullopt.
+std::optional<std::string> LocalPath(std::string_view base_path,
+                                     std::string_view remote_filename);
+
+uint32_t PackIp(std::string_view dotted);  // 0 on parse failure ("0.0.0.0" ok)
+std::string UnpackIp(uint32_t ip);
+
+}  // namespace fdfs

@@ -1,0 +1,49 @@
+#include "common/log.h"
+
+#include <ctime>
+#include <mutex>
+
+namespace fdfs {
+
+namespace {
+LogLevel g_level = LogLevel::kInfo;
+FILE* g_out = nullptr;  // nullptr => stderr
+std::mutex g_mu;
+const char* kNames[] = {"DEBUG", "INFO", "WARN", "ERROR"};
+}  // namespace
+
+void LogSetLevel(LogLevel level) { g_level = level; }
+LogLevel LogGetLevel() { return g_level; }
+
+void LogSetFile(const std::string& path) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g_out != nullptr) {
+    fclose(g_out);
+    g_out = nullptr;
+  }
+  if (!path.empty()) g_out = fopen(path.c_str(), "a");
+}
+
+void LogV(LogLevel level, const char* fmt, va_list ap) {
+  if (static_cast<int>(level) < static_cast<int>(g_level)) return;
+  char ts[32];
+  time_t now = time(nullptr);
+  struct tm tmv;
+  localtime_r(&now, &tmv);
+  strftime(ts, sizeof(ts), "%Y-%m-%d %H:%M:%S", &tmv);
+  std::lock_guard<std::mutex> lk(g_mu);
+  FILE* out = g_out != nullptr ? g_out : stderr;
+  fprintf(out, "[%s] %s ", ts, kNames[static_cast<int>(level)]);
+  vfprintf(out, fmt, ap);
+  fputc('\n', out);
+  fflush(out);
+}
+
+void Log(LogLevel level, const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  LogV(level, fmt, ap);
+  va_end(ap);
+}
+
+}  // namespace fdfs

@@ -1,0 +1,26 @@
+// Leveled logger (reference: libfastcommon logger.c — leveled, rotating;
+// rotation is deferred to later rounds, level filtering + timestamps now).
+#pragma once
+
+#include <cstdarg>
+#include <cstdio>
+#include <string>
+
+namespace fdfs {
+
+enum class LogLevel : int { kDebug = 0, kInfo = 1, kWarn = 2, kError = 3 };
+
+void LogSetLevel(LogLevel level);
+void LogSetFile(const std::string& path);  // empty => stderr
+LogLevel LogGetLevel();
+
+void LogV(LogLevel level, const char* fmt, va_list ap);
+void Log(LogLevel level, const char* fmt, ...)
+    __attribute__((format(printf, 2, 3)));
+
+#define FDFS_LOG_DEBUG(...) ::fdfs::Log(::fdfs::LogLevel::kDebug, __VA_ARGS__)
+#define FDFS_LOG_INFO(...) ::fdfs::Log(::fdfs::LogLevel::kInfo, __VA_ARGS__)
+#define FDFS_LOG_WARN(...) ::fdfs::Log(::fdfs::LogLevel::kWarn, __VA_ARGS__)
+#define FDFS_LOG_ERROR(...) ::fdfs::Log(::fdfs::LogLevel::kError, __VA_ARGS__)
+
+}  // namespace fdfs

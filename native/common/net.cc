@@ -1,0 +1,258 @@
+#include "common/net.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <vector>
+
+namespace fdfs {
+
+int64_t NowMs() {
+  struct timeval tv;
+  gettimeofday(&tv, nullptr);
+  return static_cast<int64_t>(tv.tv_sec) * 1000 + tv.tv_usec / 1000;
+}
+
+bool SetNonBlocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  return flags >= 0 && fcntl(fd, F_SETFL, flags | O_NONBLOCK) == 0;
+}
+
+int TcpListen(const std::string& bind_addr, int port, std::string* error) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    *error = std::string("socket: ") + strerror(errno);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind_addr.empty() || bind_addr == "0.0.0.0") {
+    addr.sin_addr.s_addr = INADDR_ANY;
+  } else if (inet_pton(AF_INET, bind_addr.c_str(), &addr.sin_addr) != 1) {
+    *error = "bad bind address: " + bind_addr;
+    close(fd);
+    return -1;
+  }
+  if (bind(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) != 0) {
+    *error = std::string("bind: ") + strerror(errno);
+    close(fd);
+    return -1;
+  }
+  if (listen(fd, 128) != 0) {
+    *error = std::string("listen: ") + strerror(errno);
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+int TcpConnect(const std::string& host, int port, int timeout_ms,
+               std::string* error) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    *error = std::string("socket: ") + strerror(errno);
+    return -1;
+  }
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    *error = "bad address: " + host;
+    close(fd);
+    return -1;
+  }
+  SetNonBlocking(fd);
+  int rc = connect(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr));
+  if (rc != 0 && errno != EINPROGRESS) {
+    *error = std::string("connect: ") + strerror(errno);
+    close(fd);
+    return -1;
+  }
+  if (rc != 0) {
+    struct pollfd pfd = {fd, POLLOUT, 0};
+    rc = poll(&pfd, 1, timeout_ms);
+    if (rc <= 0) {
+      *error = rc == 0 ? "connect timeout" : strerror(errno);
+      close(fd);
+      return -1;
+    }
+    int err = 0;
+    socklen_t len = sizeof(err);
+    getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+    if (err != 0) {
+      *error = std::string("connect: ") + strerror(err);
+      close(fd);
+      return -1;
+    }
+  }
+  // Back to blocking for simple request/response use.
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags & ~O_NONBLOCK);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+bool SendAll(int fd, const void* data, size_t len, int timeout_ms) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  while (len > 0) {
+    struct pollfd pfd = {fd, POLLOUT, 0};
+    int rc = poll(&pfd, 1, timeout_ms);
+    if (rc <= 0) return false;
+    ssize_t n = send(fd, p, len, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && (errno == EAGAIN || errno == EINTR)) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool RecvAll(int fd, void* data, size_t len, int timeout_ms) {
+  uint8_t* p = static_cast<uint8_t*>(data);
+  while (len > 0) {
+    struct pollfd pfd = {fd, POLLIN, 0};
+    int rc = poll(&pfd, 1, timeout_ms);
+    if (rc <= 0) return false;
+    ssize_t n = recv(fd, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && (errno == EAGAIN || errno == EINTR)) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+static std::string AddrIp(const struct sockaddr_in& a) {
+  char buf[INET_ADDRSTRLEN] = {0};
+  inet_ntop(AF_INET, &a.sin_addr, buf, sizeof(buf));
+  return buf;
+}
+
+std::string PeerIp(int fd) {
+  struct sockaddr_in a;
+  socklen_t len = sizeof(a);
+  if (getpeername(fd, reinterpret_cast<struct sockaddr*>(&a), &len) != 0)
+    return "";
+  return AddrIp(a);
+}
+
+std::string SockIp(int fd) {
+  struct sockaddr_in a;
+  socklen_t len = sizeof(a);
+  if (getsockname(fd, reinterpret_cast<struct sockaddr*>(&a), &len) != 0)
+    return "";
+  return AddrIp(a);
+}
+
+// -- EventLoop ------------------------------------------------------------
+
+EventLoop::EventLoop() { epfd_ = epoll_create1(EPOLL_CLOEXEC); }
+
+EventLoop::~EventLoop() {
+  if (epfd_ >= 0) close(epfd_);
+}
+
+bool EventLoop::Add(int fd, uint32_t events, FdCallback cb) {
+  struct epoll_event ev;
+  memset(&ev, 0, sizeof(ev));
+  ev.events = events;
+  ev.data.fd = fd;
+  if (epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev) != 0) return false;
+  fd_cbs_[fd] = std::move(cb);
+  return true;
+}
+
+bool EventLoop::Mod(int fd, uint32_t events) {
+  struct epoll_event ev;
+  memset(&ev, 0, sizeof(ev));
+  ev.events = events;
+  ev.data.fd = fd;
+  return epoll_ctl(epfd_, EPOLL_CTL_MOD, fd, &ev) == 0;
+}
+
+void EventLoop::Del(int fd) {
+  epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
+  fd_cbs_.erase(fd);
+}
+
+int EventLoop::AddTimer(int interval_ms, TimerCallback cb, bool repeat) {
+  int id = next_timer_id_++;
+  timers_[id] = Timer{NowMs() + interval_ms, interval_ms, std::move(cb), repeat};
+  return id;
+}
+
+void EventLoop::CancelTimer(int timer_id) { timers_.erase(timer_id); }
+
+int EventLoop::NextTimeoutMs() const {
+  if (timers_.empty()) return 1000;
+  int64_t now = NowMs();
+  int64_t next = INT64_MAX;
+  for (const auto& [id, t] : timers_)
+    if (t.deadline_ms < next) next = t.deadline_ms;
+  int64_t d = next - now;
+  if (d < 0) return 0;
+  if (d > 1000) return 1000;
+  return static_cast<int>(d);
+}
+
+void EventLoop::FireTimers() {
+  int64_t now = NowMs();
+  std::vector<int> fired;
+  for (auto& [id, t] : timers_)
+    if (t.deadline_ms <= now) fired.push_back(id);
+  for (int id : fired) {
+    auto it = timers_.find(id);
+    if (it == timers_.end()) continue;
+    TimerCallback cb = it->second.cb;  // copy: cb may cancel/add timers
+    if (it->second.repeat) {
+      it->second.deadline_ms = now + it->second.interval_ms;
+    } else {
+      timers_.erase(it);
+    }
+    cb();
+  }
+}
+
+void EventLoop::Run() {
+  running_ = true;
+  std::vector<struct epoll_event> events(256);
+  while (running_) {
+    int n = epoll_wait(epfd_, events.data(), static_cast<int>(events.size()),
+                       NextTimeoutMs());
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    for (int i = 0; i < n; ++i) {
+      auto it = fd_cbs_.find(events[i].data.fd);
+      if (it != fd_cbs_.end()) {
+        FdCallback cb = it->second;  // copy: cb may Del() the fd
+        cb(events[i].events);
+      }
+    }
+    FireTimers();
+  }
+}
+
+void EventLoop::Stop() { running_ = false; }
+
+}  // namespace fdfs

@@ -1,0 +1,75 @@
+// Sockets + epoll event loop.
+//
+// Reference equivalents: libfastcommon ioevent.c/ioevent_loop.c (the epoll
+// abstraction driving every nio loop) and sockopt.c (tcprecvdata_nb /
+// tcpsenddata_nb, connect-with-timeout).  Server loops are non-blocking
+// epoll; outbound connections (sync threads, tracker-report threads,
+// client library) use blocking sockets with timeouts, mirroring the
+// reference's split.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <string>
+#include <unordered_map>
+
+namespace fdfs {
+
+// -- blocking socket helpers (sockopt.c analogues) ------------------------
+bool SetNonBlocking(int fd);
+int TcpListen(const std::string& bind_addr, int port, std::string* error);
+// Blocking connect with timeout (ms); returns fd or -1.
+int TcpConnect(const std::string& host, int port, int timeout_ms,
+               std::string* error);
+// Blocking send/recv of exactly len bytes with per-call timeout; false on
+// error/EOF/timeout.
+bool SendAll(int fd, const void* data, size_t len, int timeout_ms);
+bool RecvAll(int fd, void* data, size_t len, int timeout_ms);
+std::string PeerIp(int fd);
+std::string SockIp(int fd);
+
+// -- epoll loop (ioevent_loop.c analogue) ---------------------------------
+class EventLoop {
+ public:
+  using FdCallback = std::function<void(uint32_t events)>;
+  using TimerCallback = std::function<void()>;
+
+  EventLoop();
+  ~EventLoop();
+  EventLoop(const EventLoop&) = delete;
+  EventLoop& operator=(const EventLoop&) = delete;
+
+  bool Add(int fd, uint32_t events, FdCallback cb);
+  bool Mod(int fd, uint32_t events);
+  void Del(int fd);
+
+  // Repeating timer (sched_thread.c analogue: binlog flush, beat, stat
+  // write all hang off these).  Returns a timer id.
+  int AddTimer(int interval_ms, TimerCallback cb, bool repeat = true);
+  void CancelTimer(int timer_id);
+
+  void Run();   // until Stop()
+  void Stop();
+  bool running() const { return running_; }
+
+ private:
+  void FireTimers();
+  int NextTimeoutMs() const;
+
+  int epfd_;
+  bool running_ = false;
+  std::unordered_map<int, FdCallback> fd_cbs_;
+  struct Timer {
+    int64_t deadline_ms;
+    int interval_ms;
+    TimerCallback cb;
+    bool repeat;
+  };
+  std::map<int, Timer> timers_;  // id -> timer
+  int next_timer_id_ = 1;
+};
+
+int64_t NowMs();
+
+}  // namespace fdfs

@@ -1,0 +1,174 @@
+// Unit tests for the C++ common layer (no gtest in the image — plain
+// CHECK macros; non-zero exit on failure).
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "common/bytes.h"
+#include "common/fileid.h"
+#include "common/ini.h"
+#include "common/protocol_gen.h"
+
+static int g_failures = 0;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      ++g_failures;                                                   \
+    }                                                                 \
+  } while (0)
+
+#define CHECK_EQ(a, b) CHECK((a) == (b))
+
+using namespace fdfs;
+
+static void TestEndian() {
+  uint8_t buf[8];
+  PutInt64BE(0x0102030405060708LL, buf);
+  CHECK_EQ(buf[0], 1);
+  CHECK_EQ(buf[7], 8);
+  CHECK_EQ(GetInt64BE(buf), 0x0102030405060708LL);
+  PutInt64BE(-1, buf);
+  CHECK_EQ(GetInt64BE(buf), -1);
+}
+
+static void TestBase64() {
+  const uint8_t data[] = {0xDE, 0xAD, 0xBE, 0xEF, 0x00};
+  std::string enc = Base64UrlEncode(data, sizeof(data));
+  std::string dec;
+  CHECK(Base64UrlDecode(enc, &dec));
+  CHECK_EQ(dec.size(), sizeof(data));
+  CHECK_EQ(std::memcmp(dec.data(), data, sizeof(data)), 0);
+  CHECK(!Base64UrlDecode("a+b", &dec));  // '+' not in url-safe alphabet
+  CHECK(!Base64UrlDecode("abcde", &dec));  // impossible length (5 % 4 == 1)
+}
+
+static void TestCrc32() {
+  // zlib golden: crc32(b"123456789") == 0xCBF43926
+  CHECK_EQ(Crc32("123456789", 9), 0xCBF43926u);
+  CHECK_EQ(Crc32("", 0), 0u);
+}
+
+static void TestSha1() {
+  CHECK_EQ(Sha1("abc", 3).Hex(),
+           std::string("a9993e364706816aba3e25717850c26c9cd0d89d"));
+  CHECK_EQ(Sha1("", 0).Hex(),
+           std::string("da39a3ee5e6b4b0d3255bfef95601890afd80709"));
+  // streamed == one-shot across buffer boundaries
+  std::string big(1000, 'x');
+  Sha1Stream s;
+  s.Update(big.data(), 37);
+  s.Update(big.data() + 37, 63);
+  s.Update(big.data() + 100, 900);
+  CHECK_EQ(s.Final().Hex(), Sha1(big.data(), big.size()).Hex());
+}
+
+static void TestFileId() {
+  EncodeFileIdArgs a;
+  a.group = "group1";
+  a.store_path_index = 0;
+  a.source_ip = PackIp("192.168.1.102");
+  a.create_timestamp = 1406000000;
+  a.file_size = 30790;
+  a.crc32 = 0xFCEFEF3Cu;
+  a.ext = "jpg";
+  a.uniquifier = 42;
+  auto id = EncodeFileId(a);
+  CHECK(id.has_value());
+  auto parts = DecodeFileId(*id);
+  CHECK(parts.has_value());
+  CHECK_EQ(parts->group, std::string("group1"));
+  CHECK_EQ(UnpackIp(parts->source_ip), std::string("192.168.1.102"));
+  CHECK_EQ(parts->create_timestamp, 1406000000u);
+  CHECK_EQ(parts->file_size, 30790u);
+  CHECK_EQ(parts->crc32, 0xFCEFEF3Cu);
+  CHECK_EQ(parts->uniquifier, 42);
+  CHECK(!parts->appender);
+  CHECK_EQ(parts->FullId(), *id);
+
+  // flags
+  a.appender = true;
+  auto id2 = EncodeFileId(a);
+  auto p2 = DecodeFileId(*id2);
+  CHECK(p2.has_value() && p2->appender);
+
+  // tampering
+  std::string bad = *id;
+  bad[bad.size() - 5] = bad[bad.size() - 5] == 'A' ? 'B' : 'A';
+  CHECK(!DecodeFileId(bad).has_value());
+
+  // invalid encode args
+  EncodeFileIdArgs e = a;
+  e.group = "this-group-name-is-way-too-long";
+  CHECK(!EncodeFileId(e).has_value());
+  e = a;
+  e.ext = "tar.gz";
+  CHECK(!EncodeFileId(e).has_value());
+  e = a;
+  e.uniquifier = 0x1000;
+  CHECK(!EncodeFileId(e).has_value());
+}
+
+static void TestLocalPath() {
+  EncodeFileIdArgs a;
+  a.group = "g";
+  a.source_ip = PackIp("1.2.3.4");
+  a.create_timestamp = 1;
+  a.file_size = 2;
+  a.crc32 = 3;
+  a.ext = "txt";
+  auto id = EncodeFileId(a);
+  auto parts = DecodeFileId(*id);
+  auto lp = LocalPath("/var/p0", parts->RemoteFilename());
+  CHECK(lp.has_value());
+  CHECK(lp->rfind("/var/p0/data/", 0) == 0);
+  CHECK(!LocalPath("/var/p0", "M00/../../passwd").has_value());
+  CHECK(!LocalPath("/var/p0", "M00/00/00/../../../etc/passwd").has_value());
+  CHECK(!LocalPath("/var/p0", "no/such/shape/x").has_value());
+}
+
+static void TestIni() {
+  IniConfig cfg;
+  std::string err;
+  CHECK(cfg.LoadString(
+      "# comment\nport = 22122\ndisabled=false\n"
+      "tracker_server = 10.0.0.1:22122\ntracker_server = 10.0.0.2:22122\n"
+      "buff_size = 256KB\ninterval = 5m\n[section]\nname=x\n",
+      &err));
+  CHECK_EQ(cfg.GetInt("port", 0), 22122);
+  CHECK(!cfg.GetBool("disabled", true));
+  CHECK_EQ(cfg.GetAll("tracker_server").size(), 2u);
+  CHECK_EQ(cfg.GetBytes("buff_size", 0), 256 * 1024);
+  CHECK_EQ(cfg.GetSeconds("interval", 0), 300);
+  CHECK_EQ(cfg.GetStr("name", ""), std::string("x"));
+  CHECK(!cfg.Has("nope"));
+  IniConfig inc;
+  CHECK(!inc.LoadString("#include other.conf\n", &err));  // no base dir
+}
+
+static void TestProtocolConstants() {
+  CHECK_EQ(static_cast<int>(TrackerCmd::kStorageJoin), 81);
+  CHECK_EQ(static_cast<int>(TrackerCmd::kServiceQueryStoreWithoutGroupOne), 101);
+  CHECK_EQ(static_cast<int>(StorageCmd::kUploadFile), 11);
+  CHECK_EQ(static_cast<int>(StorageCmd::kResp), 100);
+  CHECK_EQ(kHeaderSize, 10);
+}
+
+int main() {
+  TestEndian();
+  TestBase64();
+  TestCrc32();
+  TestSha1();
+  TestFileId();
+  TestLocalPath();
+  TestIni();
+  TestProtocolConstants();
+  if (g_failures == 0) {
+    std::printf("common_test: ALL PASS\n");
+    return 0;
+  }
+  std::printf("common_test: %d FAILURES\n", g_failures);
+  return 1;
+}

@@ -1,0 +1,88 @@
+// Cross-language golden-check CLI: pytest drives this binary and compares
+// against fastdfs_tpu/common (tests/test_native_common.py).
+//
+// Usage:
+//   fdfs_codec encode <group> <spi> <ip> <ts> <size> <crc> <ext> <uniq>
+//   fdfs_codec decode <file_id>
+//   fdfs_codec sha1            (stdin -> hex)
+//   fdfs_codec crc32           (stdin -> decimal)
+//   fdfs_codec b64e <hex>      (hex bytes -> base64url)
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "common/bytes.h"
+#include "common/fileid.h"
+
+using namespace fdfs;
+
+static std::string ReadStdin() {
+  std::string out;
+  char buf[65536];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), stdin)) > 0) out.append(buf, n);
+  return out;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s encode|decode|sha1|crc32|b64e ...\n", argv[0]);
+    return 2;
+  }
+  std::string cmd = argv[1];
+  if (cmd == "encode" && argc == 10) {
+    EncodeFileIdArgs a;
+    a.group = argv[2];
+    a.store_path_index = atoi(argv[3]);
+    a.source_ip = PackIp(argv[4]);
+    a.create_timestamp = static_cast<uint32_t>(strtoull(argv[5], nullptr, 10));
+    a.file_size = strtoull(argv[6], nullptr, 10);
+    a.crc32 = static_cast<uint32_t>(strtoull(argv[7], nullptr, 10));
+    a.ext = argv[8][0] == '-' ? "" : argv[8];
+    a.uniquifier = atoi(argv[9]);
+    auto id = EncodeFileId(a);
+    if (!id.has_value()) {
+      fprintf(stderr, "encode failed\n");
+      return 1;
+    }
+    printf("%s\n", id->c_str());
+    return 0;
+  }
+  if (cmd == "decode" && argc == 3) {
+    auto p = DecodeFileId(argv[2]);
+    if (!p.has_value()) {
+      fprintf(stderr, "decode failed\n");
+      return 1;
+    }
+    printf("group=%s spi=%d ip=%s ts=%u size=%llu crc=%u uniq=%d app=%d trunk=%d slave=%d\n",
+           p->group.c_str(), p->store_path_index, UnpackIp(p->source_ip).c_str(),
+           p->create_timestamp, static_cast<unsigned long long>(p->file_size),
+           p->crc32, p->uniquifier, p->appender ? 1 : 0, p->trunk ? 1 : 0,
+           p->slave ? 1 : 0);
+    return 0;
+  }
+  if (cmd == "sha1") {
+    std::string data = ReadStdin();
+    printf("%s\n", Sha1(data.data(), data.size()).Hex().c_str());
+    return 0;
+  }
+  if (cmd == "crc32") {
+    std::string data = ReadStdin();
+    printf("%u\n", Crc32(data.data(), data.size()));
+    return 0;
+  }
+  if (cmd == "b64e" && argc == 3) {
+    std::string hex = argv[2];
+    std::vector<uint8_t> raw;
+    for (size_t i = 0; i + 1 < hex.size(); i += 2) {
+      raw.push_back(static_cast<uint8_t>(
+          strtoul(hex.substr(i, 2).c_str(), nullptr, 16)));
+    }
+    printf("%s\n", Base64UrlEncode(raw.data(), raw.size()).c_str());
+    return 0;
+  }
+  fprintf(stderr, "bad arguments\n");
+  return 2;
+}

@@ -92,6 +92,47 @@ def test_empty_stream():
     assert r.size == 0 and r.chunks == [] and r.dedup_ratio == 0.0
 
 
+def test_dry_run_sees_in_stream_repeats():
+    # update_index=False must still judge repeats within the same stream
+    # (review finding: dedup estimation was systematically low).
+    data = b"z" * (1024 * 4)  # constant -> identical forced-max chunks
+    eng = DedupEngine(CFG)
+    r = eng.ingest(data, "dry", update_index=False)
+    r2 = DedupEngine(CFG).ingest(data, "wet", update_index=True)
+    assert r.bytes_duplicate == r2.bytes_duplicate > 0
+    assert len(eng.exact) == 0
+
+
+def test_snapshot_paths_without_npz_suffix(tmp_path):
+    # save/load must round-trip whatever path the caller passed
+    # (review finding: np.savez appends .npz, np.load did not).
+    rng = np.random.RandomState(70)
+    data = _rand(rng, 8_000)
+    eng = DedupEngine(CFG)
+    eng.ingest(data, "f1")
+    ep, np_ = str(tmp_path / "exact"), str(tmp_path / "near")
+    eng.save(ep, np_)
+    eng2 = DedupEngine.load(ep, np_, CFG)
+    assert eng2.ingest(data, "f2").dedup_ratio == 1.0
+    # no stray temp files left behind (atomic write-then-rename)
+    leftovers = [p.name for p in tmp_path.iterdir() if ".tmp" in p.name]
+    assert leftovers == []
+
+
+def test_lsh_query_after_load_matches(tmp_path):
+    idx = MinHashLSHIndex(64, 16)
+    rng = np.random.RandomState(71)
+    sigs = rng.randint(0, 2**32, size=(20, 64), dtype=np.uint64).astype(np.uint32)
+    for i, s in enumerate(sigs):
+        idx.add(s, f"ref{i}")
+    idx.save(str(tmp_path / "lsh"))
+    idx2 = MinHashLSHIndex.load(str(tmp_path / "lsh"))
+    assert len(idx2) == 20
+    got = idx2.query(sigs[7], top_k=1, min_similarity=0.9)
+    assert got and got[0][0] == "ref7" and got[0][1] == 1.0
+    assert np.array_equal(idx2.signatures, idx.signatures)
+
+
 def test_engine_snapshot_roundtrip(tmp_path):
     rng = np.random.RandomState(7)
     data = _rand(rng, 15_000)

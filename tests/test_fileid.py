@@ -131,6 +131,8 @@ def test_local_path_rejects_traversal():
         "M00/00/00/../../../../etc/passwd",
         "M00/0G/00/" + "A" * 27,
         "M00/00/00/..",
+        "M00/00/00/" + "A" * 27 + "\n",          # trailing newline ($ vs \Z)
+        "M00/00/00/" + "A" * 27 + ".e\nx",       # newline inside ext
     ):
         with pytest.raises(ValueError):
             F.local_path("/var/fdfs/p0", evil)

@@ -1,0 +1,103 @@
+"""Cross-language golden checks: the C++ common layer must be bit-compatible
+with fastdfs_tpu/common (file IDs minted by the C++ storage daemon must
+decode in the Python client and vice versa)."""
+
+import hashlib
+import os
+import random
+import subprocess
+import zlib
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD = os.path.join(REPO, "native", "build")
+CODEC = os.path.join(BUILD, "fdfs_codec")
+COMMON_TEST = os.path.join(BUILD, "common_test")
+
+
+def _ensure_built():
+    if os.path.exists(CODEC) and os.path.exists(COMMON_TEST):
+        return
+    subprocess.run(["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD,
+                    "-G", "Ninja"], check=True, capture_output=True)
+    subprocess.run(["ninja", "-C", BUILD], check=True, capture_output=True)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    _ensure_built()
+
+
+def _run(*args, stdin: bytes = b"") -> str:
+    out = subprocess.run([CODEC, *args], input=stdin, capture_output=True,
+                         check=True)
+    return out.stdout.decode().strip()
+
+
+def test_cpp_unit_tests_pass():
+    subprocess.run([COMMON_TEST], check=True, capture_output=True)
+
+
+def test_generated_protocol_header_current():
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "native"))
+    import gen_protocol
+    with open(os.path.join(REPO, "native", "common", "protocol_gen.h")) as fh:
+        assert fh.read() == gen_protocol.generate(), (
+            "protocol_gen.h is stale; run native/gen_protocol.py")
+
+
+def test_file_id_cpp_encode_python_decode():
+    from fastdfs_tpu.common.fileid import decode_file_id
+    fid = _run("encode", "group1", "0", "192.168.1.102", "1406000000",
+               "30790", "4243582780", "jpg", "42")
+    parsed, info = decode_file_id(fid)
+    assert parsed.group == "group1"
+    assert info.source_ip == "192.168.1.102"
+    assert info.create_timestamp == 1406000000
+    assert info.file_size == 30790
+    assert info.crc32 == 4243582780
+    assert info.uniquifier == 42
+
+
+def test_file_id_python_encode_cpp_decode():
+    from fastdfs_tpu.common.fileid import encode_file_id
+    fid = encode_file_id("grp", 7, "10.1.2.3", 1700000000, 123456, 999,
+                         ext="dat", uniquifier=17)
+    out = _run("decode", fid)
+    assert "group=grp" in out and "spi=7" in out
+    assert "ip=10.1.2.3" in out and "ts=1700000000" in out
+    assert "size=123456" in out and "crc=999" in out and "uniq=17" in out
+
+
+def test_file_id_fuzz_cross():
+    from fastdfs_tpu.common.fileid import decode_file_id
+    rng = random.Random(77)
+    for _ in range(20):
+        ip = ".".join(str(rng.randrange(256)) for _ in range(4))
+        ts, size = rng.randrange(2**32), rng.randrange(2**48)
+        crc, uniq = rng.randrange(2**32), rng.randrange(2**12)
+        fid = _run("encode", "g9", "3", ip, str(ts), str(size), str(crc),
+                   "bin", str(uniq))
+        _, info = decode_file_id(fid)
+        assert (info.source_ip, info.create_timestamp, info.file_size,
+                info.crc32, info.uniquifier) == (ip, ts, size, crc, uniq)
+
+
+def test_sha1_matches():
+    data = os.urandom(100_000)
+    assert _run("sha1", stdin=data) == hashlib.sha1(data).hexdigest()
+
+
+def test_crc32_matches_zlib():
+    data = os.urandom(50_000)
+    assert int(_run("crc32", stdin=data)) == zlib.crc32(data)
+
+
+def test_base64_matches():
+    import base64
+    raw = os.urandom(20)
+    got = _run("b64e", raw.hex())
+    want = base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+    assert got == want
