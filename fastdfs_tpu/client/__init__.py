@@ -1,0 +1,7 @@
+"""Client library (reference: ``client/`` — fdfs_client.h, tracker_client.c,
+storage_client.c).  Pure-Python implementation of the binary TCP protocol;
+the C++ daemons are the servers."""
+
+from fastdfs_tpu.client.storage_client import StorageClient  # noqa: F401
+from fastdfs_tpu.client.tracker_client import TrackerClient  # noqa: F401
+from fastdfs_tpu.client.client import FdfsClient  # noqa: F401

@@ -1,0 +1,104 @@
+"""High-level client: the two-hop tracker→storage dance.
+
+Reference: ``client/fdfs_client.h`` + client_func.c — fdfs_client_init()
+from client.conf (tracker_server list), then every operation queries a
+tracker for a storage target and talks to it directly.
+"""
+
+from __future__ import annotations
+
+import random
+
+from fastdfs_tpu.client.storage_client import RemoteFileInfo, StorageClient
+from fastdfs_tpu.client.tracker_client import TrackerClient
+from fastdfs_tpu.common.ini_config import IniConfig
+
+
+class FdfsClient:
+    """Tracker-routed client (reference: storage_upload_by_filename1 flow
+    in SURVEY.md §3.1)."""
+
+    def __init__(self, tracker_addrs: list[str] | str, timeout: float = 30.0):
+        if isinstance(tracker_addrs, str):
+            tracker_addrs = [tracker_addrs]
+        if not tracker_addrs:
+            raise ValueError("need at least one tracker address")
+        self.trackers = [_parse_addr(a) for a in tracker_addrs]
+        self.timeout = timeout
+
+    @classmethod
+    def from_conf(cls, conf_path: str) -> "FdfsClient":
+        cfg = IniConfig.load(conf_path)
+        addrs = cfg.get_all("tracker_server")
+        return cls(addrs, timeout=float(cfg.get_seconds("network_timeout", 30)))
+
+    def _tracker(self) -> TrackerClient:
+        # Random start + failover (reference: tracker_get_connection's
+        # round-robin over the tracker group).
+        addrs = self.trackers[:]
+        random.shuffle(addrs)
+        last_err: Exception | None = None
+        for host, port in addrs:
+            try:
+                return TrackerClient(host, port, self.timeout)
+            except OSError as e:
+                last_err = e
+        raise ConnectionError(f"no tracker reachable: {last_err}")
+
+    # -- operations --------------------------------------------------------
+
+    def upload_buffer(self, data: bytes, ext: str = "",
+                      group: str | None = None, appender: bool = False) -> str:
+        with self._tracker() as t:
+            tgt = t.query_store(group)
+        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+            return s.upload_buffer(data, ext=ext,
+                                   store_path_index=tgt.store_path_index,
+                                   appender=appender)
+
+    def download_to_buffer(self, file_id: str, offset: int = 0,
+                           length: int = 0) -> bytes:
+        with self._tracker() as t:
+            tgt = t.query_fetch(file_id)
+        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+            return s.download_to_buffer(file_id, offset, length)
+
+    def delete_file(self, file_id: str) -> None:
+        with self._tracker() as t:
+            tgt = t.query_update(file_id)
+        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+            s.delete_file(file_id)
+
+    def query_file_info(self, file_id: str) -> RemoteFileInfo:
+        with self._tracker() as t:
+            tgt = t.query_fetch(file_id)
+        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+            return s.query_file_info(file_id)
+
+    def set_metadata(self, file_id: str, meta: dict[str, str],
+                     merge: bool = False) -> None:
+        with self._tracker() as t:
+            tgt = t.query_update(file_id)
+        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+            s.set_metadata(file_id, meta, merge)
+
+    def get_metadata(self, file_id: str) -> dict[str, str]:
+        with self._tracker() as t:
+            tgt = t.query_fetch(file_id)
+        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+            return s.get_metadata(file_id)
+
+    def list_groups(self) -> list[dict]:
+        with self._tracker() as t:
+            return t.list_groups()
+
+    def list_storages(self, group: str) -> list[dict]:
+        with self._tracker() as t:
+            return t.list_storages(group)
+
+
+def _parse_addr(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"bad tracker address {addr!r} (want host:port)")
+    return host, int(port)

@@ -1,0 +1,72 @@
+"""Low-level framed connection (reference: libfastcommon sockopt.c
+tcprecvdata_nb/tcpsenddata_nb + fdfs_proto.c fdfs_recv_response)."""
+
+from __future__ import annotations
+
+import socket
+
+from fastdfs_tpu.common.protocol import HEADER_SIZE, Header, pack_header, unpack_header
+
+
+class ProtocolError(Exception):
+    pass
+
+
+class StatusError(ProtocolError):
+    """Non-zero status byte in a response header."""
+
+    def __init__(self, status: int, context: str = ""):
+        self.status = status
+        super().__init__(f"server returned status {status}"
+                         + (f" ({context})" if context else ""))
+
+
+class Connection:
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.host, self.port = host, port
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- framing -----------------------------------------------------------
+
+    def send_request(self, cmd: int, body: bytes = b"",
+                     body_len: int | None = None) -> None:
+        self.sock.sendall(pack_header(
+            len(body) if body_len is None else body_len, cmd) + body)
+
+    def send_raw(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
+    def recv_exact(self, n: int) -> bytes:
+        chunks = []
+        got = 0
+        while got < n:
+            chunk = self.sock.recv(min(n - got, 256 * 1024))
+            if not chunk:
+                raise ProtocolError("connection closed mid-message")
+            chunks.append(chunk)
+            got += len(chunk)
+        return b"".join(chunks)
+
+    def recv_header(self) -> Header:
+        return unpack_header(self.recv_exact(HEADER_SIZE))
+
+    def recv_response(self, context: str = "") -> bytes:
+        """Header + body; raises StatusError on non-zero status."""
+        hdr = self.recv_header()
+        body = self.recv_exact(hdr.pkg_len) if hdr.pkg_len else b""
+        if hdr.status != 0:
+            raise StatusError(hdr.status, context)
+        return body

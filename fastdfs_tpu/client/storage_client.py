@@ -1,0 +1,152 @@
+"""Storage-daemon client: the data-path API.
+
+Reference: ``client/storage_client.c`` — storage_do_upload_file(),
+storage_download_file_ex(), storage_delete_file(), metadata get/set,
+fdfs_get_file_info().  Wire layouts match the C++ daemon in
+``native/storage/server.cc`` (FastDFS-shaped, not byte-compatible with
+upstream — see SURVEY.md provenance warning).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from dataclasses import dataclass
+
+from fastdfs_tpu.client.conn import Connection, ProtocolError
+from fastdfs_tpu.common.protocol import (
+    GROUP_NAME_MAX_LEN,
+    StorageCmd,
+    long2buff,
+    buff2long,
+    pack_ext_name,
+    pack_group_name,
+    pack_metadata,
+    unpack_group_name,
+    unpack_metadata,
+)
+
+AUTO_STORE_PATH = 0xFF
+
+
+@dataclass(frozen=True)
+class RemoteFileInfo:
+    file_size: int
+    create_timestamp: int
+    crc32: int
+    source_ip: str
+
+
+class StorageClient:
+    """One storage server connection (context manager)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.conn = Connection(host, port, timeout)
+
+    def close(self) -> None:
+        self.conn.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- uploads -----------------------------------------------------------
+
+    def upload_buffer(self, data: bytes, ext: str = "",
+                      store_path_index: int = AUTO_STORE_PATH,
+                      appender: bool = False) -> str:
+        """Upload bytes; returns the file ID (``group/Mxx/aa/bb/name.ext``).
+
+        Wire (reference storage_do_upload_file): 1B store-path index
+        (0xFF = server picks), 8B file size, 6B ext, then the body.
+        """
+        cmd = (StorageCmd.UPLOAD_APPENDER_FILE if appender
+               else StorageCmd.UPLOAD_FILE)
+        fixed = bytes([store_path_index]) + long2buff(len(data)) + pack_ext_name(ext)
+        self.conn.send_request(cmd, fixed + data)
+        body = self.conn.recv_response("upload")
+        if len(body) <= GROUP_NAME_MAX_LEN:
+            raise ProtocolError(f"short upload response: {len(body)}")
+        group = unpack_group_name(body[:GROUP_NAME_MAX_LEN])
+        remote = body[GROUP_NAME_MAX_LEN:].decode()
+        return f"{group}/{remote}"
+
+    def upload_file(self, path: str, ext: str | None = None, **kw) -> str:
+        if ext is None:
+            ext = os.path.splitext(path)[1].lstrip(".")[:6]
+        with open(path, "rb") as fh:
+            return self.upload_buffer(fh.read(), ext=ext, **kw)
+
+    # -- downloads ---------------------------------------------------------
+
+    def download_to_buffer(self, file_id: str, offset: int = 0,
+                           length: int = 0) -> bytes:
+        """Download (part of) a file.  length 0 = to EOF."""
+        group, remote = _split_id(file_id)
+        body = (long2buff(offset) + long2buff(length)
+                + pack_group_name(group) + remote.encode())
+        self.conn.send_request(StorageCmd.DOWNLOAD_FILE, body)
+        return self.conn.recv_response("download")
+
+    def download_to_file(self, file_id: str, local_path: str,
+                         offset: int = 0, length: int = 0) -> int:
+        data = self.download_to_buffer(file_id, offset, length)
+        with open(local_path, "wb") as fh:
+            fh.write(data)
+        return len(data)
+
+    # -- delete / info -----------------------------------------------------
+
+    def delete_file(self, file_id: str) -> None:
+        group, remote = _split_id(file_id)
+        self.conn.send_request(StorageCmd.DELETE_FILE,
+                               pack_group_name(group) + remote.encode())
+        self.conn.recv_response("delete")
+
+    def query_file_info(self, file_id: str) -> RemoteFileInfo:
+        group, remote = _split_id(file_id)
+        self.conn.send_request(StorageCmd.QUERY_FILE_INFO,
+                               pack_group_name(group) + remote.encode())
+        body = self.conn.recv_response("query_file_info")
+        if len(body) < 40:
+            raise ProtocolError(f"short query response: {len(body)}")
+        return RemoteFileInfo(
+            file_size=buff2long(body, 0),
+            create_timestamp=buff2long(body, 8),
+            crc32=buff2long(body, 16) & 0xFFFFFFFF,
+            source_ip=body[24:40].rstrip(b"\x00").decode(),
+        )
+
+    # -- metadata ----------------------------------------------------------
+
+    def set_metadata(self, file_id: str, meta: dict[str, str],
+                     merge: bool = False) -> None:
+        group, remote = _split_id(file_id)
+        flag = b"M" if merge else b"O"
+        name = remote.encode()
+        body = (pack_group_name(group) + flag + long2buff(len(name)) + name
+                + pack_metadata(meta))
+        self.conn.send_request(StorageCmd.SET_METADATA, body)
+        self.conn.recv_response("set_metadata")
+
+    def get_metadata(self, file_id: str) -> dict[str, str]:
+        group, remote = _split_id(file_id)
+        self.conn.send_request(StorageCmd.GET_METADATA,
+                               pack_group_name(group) + remote.encode())
+        return unpack_metadata(self.conn.recv_response("get_metadata"))
+
+    # -- misc --------------------------------------------------------------
+
+    def active_test(self) -> bool:
+        self.conn.send_request(StorageCmd.ACTIVE_TEST)
+        self.conn.recv_response("active_test")
+        return True
+
+
+def _split_id(file_id: str) -> tuple[str, str]:
+    group, sep, remote = file_id.partition("/")
+    if not sep or not remote:
+        raise ValueError(f"malformed file id: {file_id!r}")
+    return group, remote

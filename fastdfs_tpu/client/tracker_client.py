@@ -1,0 +1,110 @@
+"""Tracker client: cluster queries.
+
+Reference: ``client/tracker_client.c`` — tracker_query_storage_store(),
+tracker_query_storage_fetch(), tracker_list_groups().  Hot-path queries are
+fixed-width binary; list/monitor responses are JSON (this rebuild's
+FastDFS-shaped protocol, served by ``native/tracker/``).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from fastdfs_tpu.client.conn import Connection, ProtocolError
+from fastdfs_tpu.common.protocol import (
+    GROUP_NAME_MAX_LEN,
+    IP_ADDRESS_SIZE,
+    TrackerCmd,
+    buff2long,
+    pack_group_name,
+    unpack_group_name,
+)
+
+
+@dataclass(frozen=True)
+class StoreTarget:
+    group: str
+    ip: str
+    port: int
+    store_path_index: int
+
+
+@dataclass(frozen=True)
+class FetchTarget:
+    ip: str
+    port: int
+
+
+class TrackerClient:
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.conn = Connection(host, port, timeout)
+
+    def close(self) -> None:
+        self.conn.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- service queries (upload/download routing) -------------------------
+
+    def query_store(self, group: str | None = None) -> StoreTarget:
+        """Which storage should take an upload (reference:
+        tracker_query_storage_store).  Resp: 16B group + 16B ip + 8B port +
+        1B store path index."""
+        if group is None:
+            self.conn.send_request(TrackerCmd.SERVICE_QUERY_STORE_WITHOUT_GROUP_ONE)
+        else:
+            self.conn.send_request(TrackerCmd.SERVICE_QUERY_STORE_WITH_GROUP_ONE,
+                                   pack_group_name(group))
+        body = self.conn.recv_response("query_store")
+        if len(body) < GROUP_NAME_MAX_LEN + IP_ADDRESS_SIZE + 9:
+            raise ProtocolError(f"short query_store response: {len(body)}")
+        return StoreTarget(
+            group=unpack_group_name(body[:16]),
+            ip=body[16:32].rstrip(b"\x00").decode(),
+            port=buff2long(body, 32),
+            store_path_index=body[40],
+        )
+
+    def _query_fetch(self, cmd: int, file_id: str) -> FetchTarget:
+        group, _, remote = file_id.partition("/")
+        body = pack_group_name(group) + remote.encode()
+        self.conn.send_request(cmd, body)
+        resp = self.conn.recv_response("query_fetch")
+        if len(resp) < IP_ADDRESS_SIZE + 8:
+            raise ProtocolError(f"short query_fetch response: {len(resp)}")
+        return FetchTarget(ip=resp[:16].rstrip(b"\x00").decode(),
+                           port=buff2long(resp, 16))
+
+    def query_fetch(self, file_id: str) -> FetchTarget:
+        """Which replica can serve a read (sync-timestamp-safe routing)."""
+        return self._query_fetch(TrackerCmd.SERVICE_QUERY_FETCH_ONE, file_id)
+
+    def query_update(self, file_id: str) -> FetchTarget:
+        """Which server takes mutations (metadata/delete) for this file."""
+        return self._query_fetch(TrackerCmd.SERVICE_QUERY_UPDATE, file_id)
+
+    # -- monitor / ops (JSON responses) ------------------------------------
+
+    def list_groups(self) -> list[dict]:
+        self.conn.send_request(TrackerCmd.SERVER_LIST_ALL_GROUPS)
+        return json.loads(self.conn.recv_response("list_groups") or b"[]")
+
+    def list_storages(self, group: str) -> list[dict]:
+        self.conn.send_request(TrackerCmd.SERVER_LIST_STORAGE,
+                               pack_group_name(group))
+        return json.loads(self.conn.recv_response("list_storages") or b"[]")
+
+    def delete_storage(self, group: str, ip: str, port: int) -> None:
+        body = pack_group_name(group) + f"{ip}:{port}".encode()
+        self.conn.send_request(TrackerCmd.SERVER_DELETE_STORAGE, body)
+        self.conn.recv_response("delete_storage")
+
+    def active_test(self) -> bool:
+        self.conn.send_request(TrackerCmd.ACTIVE_TEST)
+        self.conn.recv_response("active_test")
+        return True

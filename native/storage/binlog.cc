@@ -1,0 +1,230 @@
+#include "storage/binlog.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+
+#include "common/log.h"
+#include "storage/store.h"
+
+namespace fdfs {
+
+namespace {
+constexpr char kExtraSep = '\x02';
+}
+
+std::string FormatBinlogRecord(const BinlogRecord& rec) {
+  std::string line = std::to_string(rec.timestamp);
+  line += ' ';
+  line += rec.op;
+  line += ' ';
+  line += rec.filename;
+  if (!rec.extra.empty()) {
+    line += kExtraSep;
+    line += rec.extra;
+  }
+  line += '\n';
+  return line;
+}
+
+std::optional<BinlogRecord> ParseBinlogRecord(const std::string& line) {
+  size_t s1 = line.find(' ');
+  if (s1 == std::string::npos || s1 == 0) return std::nullopt;
+  if (s1 + 2 >= line.size() || line[s1 + 2] != ' ') return std::nullopt;
+  BinlogRecord rec;
+  char* end = nullptr;
+  rec.timestamp = std::strtoll(line.c_str(), &end, 10);
+  if (end != line.c_str() + s1) return std::nullopt;
+  rec.op = line[s1 + 1];
+  std::string rest = line.substr(s1 + 3);
+  while (!rest.empty() && (rest.back() == '\n' || rest.back() == '\r'))
+    rest.pop_back();
+  if (rest.empty()) return std::nullopt;
+  size_t sep = rest.find(kExtraSep);
+  if (sep != std::string::npos) {
+    rec.filename = rest.substr(0, sep);
+    rec.extra = rest.substr(sep + 1);
+  } else {
+    rec.filename = rest;
+  }
+  return rec;
+}
+
+// -- writer ---------------------------------------------------------------
+
+std::string BinlogWriter::FilePath(int file_index) const {
+  char name[32];
+  std::snprintf(name, sizeof(name), "/binlog.%03d", file_index);
+  return dir_ + name;
+}
+
+bool BinlogWriter::Init(const std::string& base_dir, int64_t rotate_size,
+                        std::string* error) {
+  dir_ = base_dir;
+  rotate_size_ = rotate_size;
+  if (!MakeDirs(dir_)) {
+    *error = "mkdir " + dir_ + " failed";
+    return false;
+  }
+  // Resume at the highest existing binlog file.
+  file_index_ = 0;
+  for (int i = 0; i < 1000; ++i) {
+    struct stat st;
+    if (stat(FilePath(i).c_str(), &st) == 0) {
+      file_index_ = i;
+    } else {
+      break;
+    }
+  }
+  return OpenCurrent(error);
+}
+
+bool BinlogWriter::OpenCurrent(std::string* error) {
+  if (fd_ >= 0) close(fd_);
+  fd_ = open(FilePath(file_index_).c_str(), O_CREAT | O_WRONLY | O_APPEND, 0644);
+  if (fd_ < 0) {
+    *error = "open " + FilePath(file_index_) + ": " + strerror(errno);
+    return false;
+  }
+  struct stat st;
+  fstat(fd_, &st);
+  offset_ = st.st_size;
+  return true;
+}
+
+bool BinlogWriter::Append(char op, const std::string& filename,
+                          const std::string& extra) {
+  if (fd_ < 0) return false;
+  BinlogRecord rec;
+  rec.timestamp = static_cast<int64_t>(time(nullptr));
+  rec.op = op;
+  rec.filename = filename;
+  rec.extra = extra;
+  std::string line = FormatBinlogRecord(rec);
+  ssize_t n = write(fd_, line.data(), line.size());
+  if (n != static_cast<ssize_t>(line.size())) {
+    FDFS_LOG_ERROR("binlog write failed: %s", strerror(errno));
+    return false;
+  }
+  offset_ += n;
+  if (rotate_size_ > 0 && offset_ >= rotate_size_) {
+    ++file_index_;
+    std::string err;
+    if (!OpenCurrent(&err)) {
+      FDFS_LOG_ERROR("binlog rotate failed: %s", err.c_str());
+      return false;
+    }
+  }
+  return true;
+}
+
+void BinlogWriter::Position(int* file_index, int64_t* offset) const {
+  *file_index = file_index_;
+  *offset = offset_;
+}
+
+void BinlogWriter::Flush() {
+  if (fd_ >= 0) fdatasync(fd_);
+}
+
+void BinlogWriter::Close() {
+  if (fd_ >= 0) {
+    close(fd_);
+    fd_ = -1;
+  }
+}
+
+// -- reader ---------------------------------------------------------------
+
+bool BinlogReader::Init(const std::string& dir, const std::string& mark_path,
+                        std::string* error) {
+  dir_ = dir;
+  mark_path_ = mark_path;
+  file_index_ = 0;
+  offset_ = 0;
+  records_read_ = 0;
+  // Mark format (reference .mark files): "file_index offset records\n".
+  FILE* f = fopen(mark_path.c_str(), "r");
+  if (f != nullptr) {
+    long long off = 0, recs = 0;
+    if (fscanf(f, "%d %lld %lld", &file_index_, &off, &recs) == 3) {
+      offset_ = off;
+      records_read_ = recs;
+    }
+    fclose(f);
+  }
+  (void)error;
+  return true;
+}
+
+bool BinlogReader::FillBuf() {
+  if (fd_ < 0) {
+    char name[32];
+    std::snprintf(name, sizeof(name), "/binlog.%03d", file_index_);
+    fd_ = open((dir_ + name).c_str(), O_RDONLY);
+    if (fd_ < 0) return false;
+    lseek(fd_, offset_, SEEK_SET);
+  }
+  char tmp[65536];
+  ssize_t n = read(fd_, tmp, sizeof(tmp));
+  if (n <= 0) {
+    // Possibly rotated: if the next file exists and we are at EOF of the
+    // current, advance.
+    char next_name[32];
+    std::snprintf(next_name, sizeof(next_name), "/binlog.%03d", file_index_ + 1);
+    struct stat st;
+    if (stat((dir_ + next_name).c_str(), &st) == 0) {
+      // Only advance when the current file has no unread bytes.
+      struct stat cur;
+      if (fstat(fd_, &cur) == 0 && offset_ >= cur.st_size) {
+        close(fd_);
+        fd_ = -1;
+        ++file_index_;
+        offset_ = 0;
+        return FillBuf();
+      }
+    }
+    return false;
+  }
+  buf_.append(tmp, static_cast<size_t>(n));
+  return true;
+}
+
+std::optional<BinlogRecord> BinlogReader::Next() {
+  for (;;) {
+    size_t nl = buf_.find('\n', buf_pos_);
+    if (nl == std::string::npos) {
+      buf_.erase(0, buf_pos_);
+      buf_pos_ = 0;
+      if (!FillBuf()) return std::nullopt;
+      continue;
+    }
+    std::string line = buf_.substr(buf_pos_, nl - buf_pos_ + 1);
+    buf_pos_ = nl + 1;
+    offset_ += static_cast<int64_t>(line.size());
+    auto rec = ParseBinlogRecord(line);
+    if (rec.has_value()) {
+      ++records_read_;
+      return rec;
+    }
+    FDFS_LOG_WARN("skipping malformed binlog line: %s", line.c_str());
+  }
+}
+
+bool BinlogReader::SaveMark() {
+  std::string tmp = mark_path_ + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (f == nullptr) return false;
+  fprintf(f, "%d %lld %lld\n", file_index_, static_cast<long long>(offset_),
+          static_cast<long long>(records_read_));
+  fclose(f);
+  return rename(tmp.c_str(), mark_path_.c_str()) == 0;
+}
+
+}  // namespace fdfs

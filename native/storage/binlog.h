@@ -1,0 +1,85 @@
+// Binlog writer/reader: the durable replication log.
+//
+// Reference: storage/storage_sync.c — storage_binlog_write() appends
+// "<timestamp> <op_char> <filename>\n" records to data/sync/binlog.NNN
+// (rotating at a fixed size); per-peer sync threads tail it via
+// "<ip>_<port>.mark" cursor files.  Op chars: source ops are uppercase
+// (C reate, D elete, U pdate, A ppend, M odify, T runcate, L ink), replica
+// replays are lowercase.
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+
+namespace fdfs {
+
+constexpr char kBinlogOpCreate = 'C';
+constexpr char kBinlogOpDelete = 'D';
+constexpr char kBinlogOpUpdate = 'U';
+constexpr char kBinlogOpAppend = 'A';
+constexpr char kBinlogOpModify = 'M';
+constexpr char kBinlogOpTruncate = 'T';
+constexpr char kBinlogOpLink = 'L';
+
+struct BinlogRecord {
+  int64_t timestamp = 0;
+  char op = 0;
+  std::string filename;  // remote filename "Mxx/aa/bb/name[.ext]"
+  // 'L' (link) records carry "filename\x02src_filename".
+  std::string extra;
+};
+
+std::string FormatBinlogRecord(const BinlogRecord& rec);
+std::optional<BinlogRecord> ParseBinlogRecord(const std::string& line);
+
+class BinlogWriter {
+ public:
+  // base_dir: <base_path>/data/sync; creates binlog.000 etc.
+  bool Init(const std::string& base_dir, int64_t rotate_size, std::string* error);
+  bool Append(char op, const std::string& filename,
+              const std::string& extra = "");
+  // Current write position (file_index, offset) — what a fully-caught-up
+  // reader would hold.
+  void Position(int* file_index, int64_t* offset) const;
+  std::string FilePath(int file_index) const;
+  int file_index() const { return file_index_; }
+  void Flush();
+  void Close();
+
+ private:
+  bool OpenCurrent(std::string* error);
+  std::string dir_;
+  int64_t rotate_size_ = 0;
+  int file_index_ = 0;
+  int64_t offset_ = 0;
+  int fd_ = -1;
+};
+
+// Sequential reader with a persistent cursor (mark file).
+class BinlogReader {
+ public:
+  // mark_path: cursor file; binlog dir as in writer.
+  bool Init(const std::string& dir, const std::string& mark_path,
+            std::string* error);
+  // Next record, or nullopt when caught up.  Advances the in-memory
+  // cursor; call SaveMark() to persist.
+  std::optional<BinlogRecord> Next();
+  bool SaveMark();
+  int file_index() const { return file_index_; }
+  int64_t offset() const { return offset_; }
+  int64_t records_read() const { return records_read_; }
+
+ private:
+  std::string dir_;
+  std::string mark_path_;
+  int file_index_ = 0;
+  int64_t offset_ = 0;
+  int64_t records_read_ = 0;
+  int fd_ = -1;
+  std::string buf_;
+  size_t buf_pos_ = 0;
+  bool FillBuf();
+};
+
+}  // namespace fdfs

@@ -1,0 +1,59 @@
+#include "storage/config.h"
+
+namespace fdfs {
+
+bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
+  group_name = ini.GetStr("group_name", group_name);
+  bind_addr = ini.GetStr("bind_addr", "");
+  port = static_cast<int>(ini.GetInt("port", port));
+  base_path = ini.GetStr("base_path", "");
+  if (base_path.empty()) {
+    *error = "base_path is required";
+    return false;
+  }
+  store_paths.clear();
+  int n = static_cast<int>(ini.GetInt("store_path_count", 0));
+  if (n == 0) {
+    // Upstream default: store_path0 defaults to base_path.
+    auto sp0 = ini.Get("store_path0");
+    store_paths.push_back(sp0.has_value() && !sp0->empty() ? *sp0 : base_path);
+  } else {
+    for (int i = 0; i < n; ++i) {
+      auto v = ini.Get("store_path" + std::to_string(i));
+      if (!v.has_value() || v->empty()) {
+        *error = "store_path" + std::to_string(i) + " missing";
+        return false;
+      }
+      store_paths.push_back(*v);
+    }
+  }
+  if (store_paths.size() > 256) {
+    *error = "too many store paths (max 256)";
+    return false;
+  }
+  subdir_count_per_path =
+      static_cast<int>(ini.GetInt("subdir_count_per_path", subdir_count_per_path));
+  if (subdir_count_per_path < 1 || subdir_count_per_path > 256) {
+    *error = "subdir_count_per_path must be in [1,256]";
+    return false;
+  }
+  buff_size = static_cast<int>(ini.GetBytes("buff_size", buff_size));
+  network_timeout_ms =
+      static_cast<int>(ini.GetSeconds("network_timeout", 30) * 1000);
+  tracker_servers = ini.GetAll("tracker_server");
+  heart_beat_interval_s =
+      static_cast<int>(ini.GetSeconds("heart_beat_interval", 30));
+  stat_report_interval_s =
+      static_cast<int>(ini.GetSeconds("stat_report_interval", 60));
+  sync_interval_ms = static_cast<int>(ini.GetInt("sync_interval_ms", 100));
+  dedup_mode = ini.GetStr("dedup_mode", "none");
+  if (dedup_mode != "none" && dedup_mode != "cpu" && dedup_mode != "sidecar") {
+    *error = "dedup_mode must be none|cpu|sidecar";
+    return false;
+  }
+  dedup_sidecar = ini.GetStr("dedup_sidecar", "");
+  log_level = ini.GetStr("log_level", "info");
+  return true;
+}
+
+}  // namespace fdfs

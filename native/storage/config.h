@@ -1,0 +1,38 @@
+// Storage daemon configuration (reference: conf/storage.conf parsed by
+// storage/storage_func.c:storage_load_from_conf_file()).
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "common/ini.h"
+
+namespace fdfs {
+
+struct StorageConfig {
+  std::string group_name = "group1";
+  std::string bind_addr;           // empty = all interfaces
+  int port = 23000;
+  std::string base_path;           // logs, stat, sync state
+  std::vector<std::string> store_paths;  // store_path0..N (data roots)
+  // Pre-created data-dir fan-out per store path.  NOTE: the subdir *spread*
+  // inside file IDs is a protocol constant (always mod 256, see
+  // common/fileid.h) so clients can validate IDs without knowing server
+  // config; this knob only controls how much of the fan-out Init
+  // pre-creates (the rest is mkdir'd lazily).
+  int subdir_count_per_path = 256;
+  int buff_size = 256 * 1024;      // chunked IO size
+  int network_timeout_ms = 30000;
+  std::vector<std::string> tracker_servers;  // "ip:port"
+  int heart_beat_interval_s = 30;
+  int stat_report_interval_s = 60;
+  int sync_interval_ms = 100;      // binlog tail poll when idle
+  std::string dedup_mode = "none"; // none | cpu | sidecar
+  std::string dedup_sidecar;       // unix socket path when mode=sidecar
+  std::string log_level = "info";
+
+  // Parse + validate; false with *error on problems.
+  bool Load(const IniConfig& ini, std::string* error);
+};
+
+}  // namespace fdfs

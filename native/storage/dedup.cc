@@ -1,0 +1,171 @@
+#include "storage/dedup.h"
+
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cstdio>
+
+#include "common/bytes.h"
+#include "common/log.h"
+#include "common/net.h"
+#include "common/protocol_gen.h"
+
+namespace fdfs {
+
+// -- CpuDedup -------------------------------------------------------------
+
+CpuDedup::CpuDedup(std::string snapshot_path)
+    : snapshot_path_(std::move(snapshot_path)) {}
+
+DedupPlugin::Verdict CpuDedup::Judge(const std::string& sha1_hex, int64_t) {
+  Verdict v;
+  auto it = by_digest_.find(sha1_hex);
+  if (it != by_digest_.end()) {
+    v.duplicate = true;
+    v.dup_of = it->second;
+  }
+  return v;
+}
+
+void CpuDedup::Commit(const std::string& sha1_hex, const std::string& file_id) {
+  by_digest_.emplace(sha1_hex, file_id);  // first writer wins
+  by_file_[file_id] = sha1_hex;
+}
+
+void CpuDedup::Forget(const std::string& file_id) {
+  auto it = by_file_.find(file_id);
+  if (it == by_file_.end()) return;
+  auto dit = by_digest_.find(it->second);
+  // Only drop the digest entry if it still names this file (another file
+  // with identical bytes may have replaced it as the canonical copy).
+  if (dit != by_digest_.end() && dit->second == file_id) by_digest_.erase(dit);
+  by_file_.erase(it);
+}
+
+bool CpuDedup::Save() {
+  std::string tmp = snapshot_path_ + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (f == nullptr) return false;
+  for (const auto& [digest, id] : by_digest_)
+    fprintf(f, "%s %s\n", digest.c_str(), id.c_str());
+  fclose(f);
+  return rename(tmp.c_str(), snapshot_path_.c_str()) == 0;
+}
+
+bool CpuDedup::LoadSnapshot() {
+  FILE* f = fopen(snapshot_path_.c_str(), "r");
+  if (f == nullptr) return true;  // no snapshot yet
+  char digest[64], id[512];
+  while (fscanf(f, "%63s %511s", digest, id) == 2) {
+    by_digest_[digest] = id;
+    by_file_[id] = digest;
+  }
+  fclose(f);
+  FDFS_LOG_INFO("dedup(cpu): loaded %zu digests from snapshot",
+                by_digest_.size());
+  return true;
+}
+
+// -- SidecarDedup ---------------------------------------------------------
+
+SidecarDedup::SidecarDedup(std::string socket_path)
+    : socket_path_(std::move(socket_path)) {}
+
+SidecarDedup::~SidecarDedup() {
+  if (fd_ >= 0) close(fd_);
+}
+
+bool SidecarDedup::EnsureConnected() {
+  if (fd_ >= 0) return true;
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  struct sockaddr_un addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, socket_path_.c_str(), sizeof(addr.sun_path) - 1);
+  if (connect(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return false;
+  }
+  fd_ = fd;
+  return true;
+}
+
+bool SidecarDedup::Rpc(uint8_t cmd, const std::string& body, std::string* resp,
+                       uint8_t* status) {
+  if (!EnsureConnected()) return false;
+  uint8_t hdr[kHeaderSize];
+  PutInt64BE(static_cast<int64_t>(body.size()), hdr);
+  hdr[8] = cmd;
+  hdr[9] = 0;
+  if (!SendAll(fd_, hdr, sizeof(hdr), 5000) ||
+      !SendAll(fd_, body.data(), body.size(), 5000) ||
+      !RecvAll(fd_, hdr, sizeof(hdr), 5000)) {
+    close(fd_);
+    fd_ = -1;
+    return false;
+  }
+  int64_t len = GetInt64BE(hdr);
+  *status = hdr[9];
+  if (len < 0 || len > (1 << 20)) {  // sidecar replies are tiny; fail open
+    FDFS_LOG_WARN("dedup(sidecar): bogus response length %lld",
+                  static_cast<long long>(len));
+    close(fd_);
+    fd_ = -1;
+    return false;
+  }
+  resp->resize(static_cast<size_t>(len));
+  if (len > 0 && !RecvAll(fd_, resp->data(), resp->size(), 5000)) {
+    close(fd_);
+    fd_ = -1;
+    return false;
+  }
+  return true;
+}
+
+DedupPlugin::Verdict SidecarDedup::Judge(const std::string& sha1_hex, int64_t) {
+  Verdict v;
+  std::string resp;
+  uint8_t status = 0;
+  if (!Rpc(static_cast<uint8_t>(StorageCmd::kDedupQuery), sha1_hex, &resp,
+           &status)) {
+    FDFS_LOG_WARN("dedup(sidecar): unreachable, treating as unique");
+    return v;  // fail open
+  }
+  if (status == 0 && !resp.empty()) {
+    v.duplicate = true;
+    v.dup_of = resp;
+  }
+  return v;
+}
+
+void SidecarDedup::Commit(const std::string& sha1_hex,
+                          const std::string& file_id) {
+  std::string resp;
+  uint8_t status = 0;
+  Rpc(static_cast<uint8_t>(StorageCmd::kDedupCommit), sha1_hex + " " + file_id,
+      &resp, &status);
+}
+
+void SidecarDedup::Forget(const std::string& file_id) {
+  std::string resp;
+  uint8_t status = 0;
+  Rpc(static_cast<uint8_t>(StorageCmd::kDedupFingerprint),
+      std::string("forget ") + file_id, &resp, &status);
+}
+
+std::unique_ptr<DedupPlugin> MakeDedupPlugin(const std::string& mode,
+                                             const std::string& base_path,
+                                             const std::string& sidecar_path) {
+  if (mode == "cpu") {
+    auto p = std::make_unique<CpuDedup>(base_path + "/data/dedup_index.dat");
+    p->LoadSnapshot();
+    return p;
+  }
+  if (mode == "sidecar") return std::make_unique<SidecarDedup>(sidecar_path);
+  return nullptr;  // none
+}
+
+}  // namespace fdfs

@@ -1,0 +1,82 @@
+// Dedup plugin boundary on the upload path.
+//
+// This is the rebuild's analogue of the reference's storage-plugin hook in
+// storage/storage_func.h (north star: "gated behind the existing
+// storage-plugin hook so the classic C path remains the default").  The
+// daemon streams every uploaded byte through an incremental SHA1 when a
+// plugin is active; the plugin judges duplicates and the daemon commits
+// unique bytes (dup files become hardlinks + an 'L' binlog record).
+//
+// Modes: none (classic CRC32-only path), cpu (in-process digest map),
+// sidecar (TPU dedup engine over a unix socket — the JAX/Pallas path).
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <unordered_map>
+
+namespace fdfs {
+
+class DedupPlugin {
+ public:
+  virtual ~DedupPlugin() = default;
+
+  struct Verdict {
+    bool duplicate = false;
+    std::string dup_of;  // existing file id (full "group/M.." form)
+  };
+
+  virtual Verdict Judge(const std::string& sha1_hex, int64_t file_size) = 0;
+  virtual void Commit(const std::string& sha1_hex, const std::string& file_id) = 0;
+  virtual void Forget(const std::string& file_id) = 0;  // on delete
+  virtual bool Save() { return true; }   // snapshot (checkpoint/resume)
+  virtual const char* Name() const = 0;
+};
+
+// CPU baseline: exact SHA1 digest map, snapshotted to
+// <base_path>/data/dedup_index.dat (atomic write-then-rename).
+class CpuDedup : public DedupPlugin {
+ public:
+  explicit CpuDedup(std::string snapshot_path);
+  Verdict Judge(const std::string& sha1_hex, int64_t file_size) override;
+  void Commit(const std::string& sha1_hex, const std::string& file_id) override;
+  void Forget(const std::string& file_id) override;
+  bool Save() override;
+  const char* Name() const override { return "cpu"; }
+  bool LoadSnapshot();
+  size_t size() const { return by_digest_.size(); }
+
+ private:
+  std::string snapshot_path_;
+  std::unordered_map<std::string, std::string> by_digest_;  // sha1 -> file id
+  std::unordered_map<std::string, std::string> by_file_;    // file id -> sha1
+};
+
+// Sidecar: TPU dedup engine process over a unix-domain socket, speaking
+// the DEDUP_* opcodes on the standard framing (see
+// fastdfs_tpu/dedup/sidecar.py).  Falls open (treats everything as unique)
+// when the sidecar is unreachable, so uploads never block on the
+// accelerator path.
+class SidecarDedup : public DedupPlugin {
+ public:
+  explicit SidecarDedup(std::string socket_path);
+  ~SidecarDedup() override;
+  Verdict Judge(const std::string& sha1_hex, int64_t file_size) override;
+  void Commit(const std::string& sha1_hex, const std::string& file_id) override;
+  void Forget(const std::string& file_id) override;
+  const char* Name() const override { return "sidecar"; }
+
+ private:
+  bool EnsureConnected();
+  bool Rpc(uint8_t cmd, const std::string& body, std::string* resp,
+           uint8_t* status);
+  std::string socket_path_;
+  int fd_ = -1;
+};
+
+std::unique_ptr<DedupPlugin> MakeDedupPlugin(const std::string& mode,
+                                             const std::string& base_path,
+                                             const std::string& sidecar_path);
+
+}  // namespace fdfs

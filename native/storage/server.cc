@@ -1,0 +1,890 @@
+#include "storage/server.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/sendfile.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cstdio>
+
+#include "common/fileid.h"
+#include "common/log.h"
+#include "common/protocol_gen.h"
+
+namespace fdfs {
+
+namespace {
+
+constexpr int64_t kMaxInlineBody = 64LL << 20;  // non-streamed body cap
+constexpr int64_t kBinlogRotateSize = 64LL << 20;
+constexpr size_t kIoBufSize = 256 * 1024;
+
+std::string GroupFromField(const uint8_t* p) {
+  size_t n = 0;
+  while (n < static_cast<size_t>(kGroupNameMaxLen) && p[n] != 0) ++n;
+  return std::string(reinterpret_cast<const char*>(p), n);
+}
+
+std::string ExtFromField(const uint8_t* p) {
+  size_t n = 0;
+  while (n < static_cast<size_t>(kFileExtNameMaxLen) && p[n] != 0) ++n;
+  return std::string(reinterpret_cast<const char*>(p), n);
+}
+
+std::string PackGroupField(const std::string& group) {
+  std::string out(kGroupNameMaxLen, '\0');
+  memcpy(out.data(), group.data(),
+         std::min(group.size(), static_cast<size_t>(kGroupNameMaxLen)));
+  return out;
+}
+
+}  // namespace
+
+StorageServer::StorageServer(StorageConfig cfg) : cfg_(std::move(cfg)) {}
+
+StorageServer::~StorageServer() {
+  for (auto& [fd, c] : conns_) {
+    if (c->file_fd >= 0) close(c->file_fd);
+    if (c->send_fd >= 0) close(c->send_fd);
+    close(fd);
+  }
+  if (listen_fd_ >= 0) close(listen_fd_);
+}
+
+bool StorageServer::Init(std::string* error) {
+  if (!MakeDirs(cfg_.base_path + "/data") || !MakeDirs(cfg_.base_path + "/logs")) {
+    *error = "cannot create base_path dirs under " + cfg_.base_path;
+    return false;
+  }
+  if (!store_.Init(cfg_, error)) return false;
+  if (!binlog_.Init(cfg_.base_path + "/data/sync", kBinlogRotateSize, error))
+    return false;
+  dedup_ = MakeDedupPlugin(cfg_.dedup_mode, cfg_.base_path, cfg_.dedup_sidecar);
+
+  listen_fd_ = TcpListen(cfg_.bind_addr, cfg_.port, error);
+  if (listen_fd_ < 0) return false;
+  SetNonBlocking(listen_fd_);
+  loop_.Add(listen_fd_, EPOLLIN, [this](uint32_t ev) { OnAccept(ev); });
+
+  // Periodic maintenance (reference: sched_thread entries — binlog flush,
+  // stat write, dedup snapshot).
+  loop_.AddTimer(1000, [this]() { binlog_.Flush(); });
+  loop_.AddTimer(60 * 1000, [this]() {
+    if (dedup_ != nullptr) dedup_->Save();
+  });
+
+  FDFS_LOG_INFO("storage daemon up: group=%s port=%d store_paths=%d dedup=%s",
+                cfg_.group_name.c_str(), cfg_.port, store_.store_path_count(),
+                dedup_ != nullptr ? dedup_->Name() : "none");
+  return true;
+}
+
+void StorageServer::Run() { loop_.Run(); }
+
+void StorageServer::Stop() {
+  if (dedup_ != nullptr) dedup_->Save();
+  binlog_.Flush();
+  loop_.Stop();
+}
+
+std::string StorageServer::MyIp() const {
+  return my_ip_.empty() ? "127.0.0.1" : my_ip_;
+}
+
+void StorageServer::DumpState() {
+  FDFS_LOG_INFO(
+      "state dump: conns=%zu upload=%lld/%lld download=%lld/%lld "
+      "delete=%lld/%lld dedup_hits=%lld saved=%lldB binlog=%d",
+      conns_.size(), static_cast<long long>(stats_.success_upload),
+      static_cast<long long>(stats_.total_upload),
+      static_cast<long long>(stats_.success_download),
+      static_cast<long long>(stats_.total_download),
+      static_cast<long long>(stats_.success_delete),
+      static_cast<long long>(stats_.total_delete),
+      static_cast<long long>(stats_.dedup_hits),
+      static_cast<long long>(stats_.dedup_bytes_saved), binlog_.file_index());
+}
+
+// -- nio ------------------------------------------------------------------
+
+void StorageServer::OnAccept(uint32_t) {
+  for (;;) {
+    int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      FDFS_LOG_WARN("accept: %s", strerror(errno));
+      return;
+    }
+    SetNonBlocking(fd);
+    if (my_ip_.empty()) my_ip_ = SockIp(fd);
+    auto conn = std::make_unique<Conn>();
+    conn->fd = fd;
+    Conn* raw = conn.get();
+    conns_[fd] = std::move(conn);
+    loop_.Add(fd, EPOLLIN, [this, raw](uint32_t ev) { OnConnEvent(raw->fd, ev); });
+  }
+}
+
+void StorageServer::OnConnEvent(int fd, uint32_t events) {
+  auto it = conns_.find(fd);
+  if (it == conns_.end()) return;
+  Conn* c = it->second.get();
+  if (events & (EPOLLHUP | EPOLLERR)) {
+    CloseConn(c);
+    return;
+  }
+  if (events & EPOLLOUT) {
+    if (!WriteConn(c)) return;
+  }
+  if (events & EPOLLIN) ReadConn(c);
+}
+
+void StorageServer::CloseConn(Conn* c) {
+  if (c->file_fd >= 0) {
+    close(c->file_fd);
+    if (!c->tmp_path.empty()) unlink(c->tmp_path.c_str());
+  }
+  if (c->send_fd >= 0) close(c->send_fd);
+  int fd = c->fd;
+  loop_.Del(fd);
+  close(fd);
+  conns_.erase(fd);
+}
+
+void StorageServer::ResetForNextRequest(Conn* c) {
+  c->state = ConnState::kRecvHeader;
+  c->header_got = 0;
+  c->fixed.clear();
+  c->fixed_need = 0;
+  c->pkg_len = 0;
+  c->cmd = 0;
+  c->body_consumed = 0;
+  c->close_after_send = false;
+  c->file_fd = -1;
+  c->tmp_path.clear();
+  c->file_remaining = 0;
+  c->file_size = 0;
+  c->ext.clear();
+  c->hashing = false;
+  c->replica_op = 0;
+  c->sync_remote.clear();
+  c->out.clear();
+  c->out_off = 0;
+  c->send_fd = -1;
+  c->send_off = 0;
+  c->send_remaining = 0;
+}
+
+void StorageServer::RespondError(Conn* c, uint8_t status) {
+  // An early error can leave unread request bytes on the socket; a keepalive
+  // reuse would parse them as the next header.  Close after flushing.
+  if (c->body_consumed < c->pkg_len) c->close_after_send = true;
+  Respond(c, status);
+}
+
+void StorageServer::Respond(Conn* c, uint8_t status, const std::string& body) {
+  c->out.resize(kHeaderSize);
+  PutInt64BE(static_cast<int64_t>(body.size()),
+             reinterpret_cast<uint8_t*>(c->out.data()));
+  c->out[8] = static_cast<char>(StorageCmd::kResp);
+  c->out[9] = static_cast<char>(status);
+  c->out += body;
+  c->out_off = 0;
+  c->state = ConnState::kSend;
+  WriteConn(c);
+}
+
+void StorageServer::RespondFile(Conn* c, uint8_t status, int file_fd,
+                                int64_t offset, int64_t count) {
+  c->out.resize(kHeaderSize);
+  PutInt64BE(count, reinterpret_cast<uint8_t*>(c->out.data()));
+  c->out[8] = static_cast<char>(StorageCmd::kResp);
+  c->out[9] = static_cast<char>(status);
+  c->out_off = 0;
+  c->send_fd = file_fd;
+  c->send_off = offset;
+  c->send_remaining = count;
+  c->state = ConnState::kSend;
+  WriteConn(c);
+}
+
+bool StorageServer::WriteConn(Conn* c) {
+  // 1) buffered bytes
+  while (c->out_off < c->out.size()) {
+    ssize_t n = send(c->fd, c->out.data() + c->out_off,
+                     c->out.size() - c->out_off, MSG_NOSIGNAL);
+    if (n > 0) {
+      c->out_off += static_cast<size_t>(n);
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      loop_.Mod(c->fd, EPOLLIN | EPOLLOUT);
+      return true;
+    }
+    if (n < 0 && errno == EINTR) continue;
+    CloseConn(c);
+    return false;
+  }
+  // 2) file payload via sendfile
+  while (c->send_remaining > 0) {
+    off_t off = c->send_off;
+    size_t chunk = static_cast<size_t>(
+        std::min<int64_t>(c->send_remaining, 1 << 20));
+    ssize_t n = sendfile(c->fd, c->send_fd, &off, chunk);
+    if (n > 0) {
+      c->send_off = off;
+      c->send_remaining -= n;
+      stats_.bytes_downloaded += n;
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      loop_.Mod(c->fd, EPOLLIN | EPOLLOUT);
+      return true;
+    }
+    if (n < 0 && errno == EINTR) continue;
+    CloseConn(c);
+    return false;
+  }
+  if (c->state == ConnState::kSend) {
+    if (c->send_fd >= 0) {
+      close(c->send_fd);
+      c->send_fd = -1;
+    }
+    if (c->close_after_send) {
+      CloseConn(c);
+      return false;
+    }
+    loop_.Mod(c->fd, EPOLLIN);
+    ResetForNextRequest(c);
+  }
+  return true;
+}
+
+void StorageServer::ReadConn(Conn* c) {
+  char buf[kIoBufSize];
+  const int fd = c->fd;
+  for (;;) {
+    // Handlers (OnHeaderComplete/OnFixedComplete/OnFileComplete and the
+    // Respond path) may CloseConn() and free *c — re-check liveness before
+    // every state-machine step.
+    auto alive = conns_.find(fd);
+    if (alive == conns_.end() || alive->second.get() != c) return;
+    switch (c->state) {
+      case ConnState::kRecvHeader: {
+        ssize_t n = recv(c->fd, c->header + c->header_got,
+                         kHeaderSize - c->header_got, 0);
+        if (n == 0) {
+          CloseConn(c);
+          return;
+        }
+        if (n < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+          if (errno == EINTR) continue;
+          CloseConn(c);
+          return;
+        }
+        c->header_got += static_cast<size_t>(n);
+        if (c->header_got == static_cast<size_t>(kHeaderSize))
+          OnHeaderComplete(c);
+        break;
+      }
+      case ConnState::kRecvFixed: {
+        size_t want = c->fixed_need - c->fixed.size();
+        ssize_t n = recv(c->fd, buf, std::min(want, sizeof(buf)), 0);
+        if (n == 0) {
+          CloseConn(c);
+          return;
+        }
+        if (n < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+          if (errno == EINTR) continue;
+          CloseConn(c);
+          return;
+        }
+        c->fixed.append(buf, static_cast<size_t>(n));
+        c->body_consumed += n;
+        if (c->fixed.size() == c->fixed_need) OnFixedComplete(c);
+        break;
+      }
+      case ConnState::kRecvFile: {
+        size_t want = static_cast<size_t>(
+            std::min<int64_t>(c->file_remaining, sizeof(buf)));
+        ssize_t n = recv(c->fd, buf, want, 0);
+        if (n == 0) {
+          CloseConn(c);
+          return;
+        }
+        if (n < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+          if (errno == EINTR) continue;
+          CloseConn(c);
+          return;
+        }
+        if (c->hashing) {
+          c->sha1.Update(buf, static_cast<size_t>(n));
+        }
+        c->crc32 = Crc32(buf, static_cast<size_t>(n), c->crc32);
+        ssize_t w = write(c->file_fd, buf, static_cast<size_t>(n));
+        if (w != n) {
+          FDFS_LOG_ERROR("tmp write failed: %s", strerror(errno));
+          close(c->file_fd);
+          c->file_fd = -1;
+          unlink(c->tmp_path.c_str());
+          RespondError(c, static_cast<uint8_t>(5 /*EIO*/));
+          return;
+        }
+        c->file_remaining -= n;
+        c->body_consumed += n;
+        stats_.bytes_uploaded += n;
+        if (c->file_remaining == 0) {
+          OnFileComplete(c);
+          // Response path takes over; stop reading until reset.
+          if (c->state == ConnState::kSend) return;
+        }
+        break;
+      }
+      case ConnState::kSend:
+        return;  // not reading while a response is in flight
+    }
+  }
+}
+
+// -- dispatch -------------------------------------------------------------
+
+void StorageServer::OnHeaderComplete(Conn* c) {
+  c->pkg_len = GetInt64BE(c->header);
+  c->cmd = c->header[8];
+  if (c->pkg_len < 0) {
+    FDFS_LOG_WARN("negative pkg_len from %s", PeerIp(c->fd).c_str());
+    CloseConn(c);
+    return;
+  }
+  auto cmd = static_cast<StorageCmd>(c->cmd);
+  switch (cmd) {
+    case StorageCmd::kActiveTest:
+      if (c->pkg_len != 0) {
+        CloseConn(c);
+        return;
+      }
+      Respond(c, 0);
+      return;
+    case StorageCmd::kUploadFile:
+    case StorageCmd::kUploadAppenderFile:
+      stats_.total_upload++;
+      if (c->pkg_len < 15) {
+        RespondError(c, 22 /*EINVAL*/);
+        return;
+      }
+      c->fixed_need = 15;  // 1B spi + 8B size + 6B ext
+      c->state = ConnState::kRecvFixed;
+      return;
+    case StorageCmd::kSyncCreateFile:
+      c->fixed_need = 32;  // 16B group + 8B name_len + 8B size, then name
+      c->state = ConnState::kRecvFixed;
+      return;
+    case StorageCmd::kDownloadFile:
+    case StorageCmd::kDeleteFile:
+    case StorageCmd::kQueryFileInfo:
+    case StorageCmd::kSetMetadata:
+    case StorageCmd::kGetMetadata:
+    case StorageCmd::kSyncDeleteFile:
+    case StorageCmd::kSyncCreateLink:
+      if (c->pkg_len > kMaxInlineBody) {
+        CloseConn(c);
+        return;
+      }
+      c->fixed_need = static_cast<size_t>(c->pkg_len);
+      if (c->fixed_need == 0) {
+        Respond(c, 22 /*EINVAL*/);
+        return;
+      }
+      c->state = ConnState::kRecvFixed;
+      return;
+    default:
+      FDFS_LOG_WARN("unknown cmd %d from %s", c->cmd, PeerIp(c->fd).c_str());
+      RespondError(c, 22 /*EINVAL*/);
+      return;
+  }
+}
+
+void StorageServer::OnFixedComplete(Conn* c) {
+  auto cmd = static_cast<StorageCmd>(c->cmd);
+  switch (cmd) {
+    case StorageCmd::kUploadFile:
+    case StorageCmd::kUploadAppenderFile:
+      if (!BeginUpload(c)) return;
+      c->state = ConnState::kRecvFile;
+      if (c->file_remaining == 0) OnFileComplete(c);  // zero-byte upload
+      return;
+    case StorageCmd::kSyncCreateFile: {
+      // Two-stage fixed read: prefix then name.
+      const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+      int64_t name_len = GetInt64BE(p + kGroupNameMaxLen);
+      int64_t size = GetInt64BE(p + kGroupNameMaxLen + 8);
+      if (c->fixed.size() == 32) {
+        if (name_len <= 0 || name_len > 512 || size < 0 ||
+            c->pkg_len != 32 + name_len + size) {
+          RespondError(c, 22);
+          return;
+        }
+        c->fixed_need = 32 + static_cast<size_t>(name_len);
+        return;  // keep reading the name
+      }
+      std::string group = GroupFromField(p);
+      c->sync_remote = c->fixed.substr(32);
+      c->file_size = size;
+      c->file_remaining = size;
+      if (group != cfg_.group_name ||
+          !LocalPath(store_.store_path(0), c->sync_remote).has_value()) {
+        RespondError(c, 22);
+        return;
+      }
+      int spi = 0;
+      sscanf(c->sync_remote.c_str(), "M%02X/", &spi);
+      if (spi >= store_.store_path_count()) {
+        RespondError(c, 22);
+        return;
+      }
+      c->store_path_index = spi;
+      c->tmp_path = store_.NewTmpPath(spi);
+      c->file_fd = open(c->tmp_path.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644);
+      if (c->file_fd < 0) {
+        RespondError(c, 5);
+        return;
+      }
+      c->state = ConnState::kRecvFile;
+      if (c->file_remaining == 0) OnFileComplete(c);
+      return;
+    }
+    case StorageCmd::kDownloadFile:
+      HandleDownload(c);
+      return;
+    case StorageCmd::kDeleteFile:
+    case StorageCmd::kSyncDeleteFile:
+      HandleDelete(c);
+      return;
+    case StorageCmd::kQueryFileInfo:
+      HandleQueryFileInfo(c);
+      return;
+    case StorageCmd::kSetMetadata:
+      HandleSetMetadata(c);
+      return;
+    case StorageCmd::kGetMetadata:
+      HandleGetMetadata(c);
+      return;
+    case StorageCmd::kSyncCreateLink: {
+      // body: 16B group + target_remote \x02 src_remote
+      const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+      if (c->fixed.size() <= static_cast<size_t>(kGroupNameMaxLen)) {
+        Respond(c, 22);
+        return;
+      }
+      std::string group = GroupFromField(p);
+      std::string rest = c->fixed.substr(kGroupNameMaxLen);
+      size_t sep = rest.find('\x02');
+      if (group != cfg_.group_name || sep == std::string::npos) {
+        Respond(c, 22);
+        return;
+      }
+      std::string target = rest.substr(0, sep);
+      std::string src = rest.substr(sep + 1);
+      std::string tl = ResolveLocal(group, target);
+      std::string sl = ResolveLocal(group, src);
+      if (tl.empty() || sl.empty()) {
+        Respond(c, 22);
+        return;
+      }
+      StoreManager::EnsureParentDirs(tl);
+      if (link(sl.c_str(), tl.c_str()) != 0 && errno != EEXIST) {
+        Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
+        return;
+      }
+      binlog_.Append('l', target, src);
+      Respond(c, 0);
+      return;
+    }
+    default:
+      Respond(c, 22);
+      return;
+  }
+}
+
+void StorageServer::OnFileComplete(Conn* c) {
+  if (static_cast<StorageCmd>(c->cmd) == StorageCmd::kSyncCreateFile) {
+    // Replica write: place at the exact remote filename from the source.
+    close(c->file_fd);
+    c->file_fd = -1;
+    std::string local = ResolveLocal(cfg_.group_name, c->sync_remote);
+    if (local.empty()) {
+      unlink(c->tmp_path.c_str());
+      Respond(c, 22);
+      return;
+    }
+    StoreManager::EnsureParentDirs(local);
+    if (rename(c->tmp_path.c_str(), local.c_str()) != 0) {
+      unlink(c->tmp_path.c_str());
+      Respond(c, 5);
+      return;
+    }
+    binlog_.Append('c', c->sync_remote);
+    Respond(c, 0);
+    return;
+  }
+  FinishUpload(c);
+}
+
+// -- handlers -------------------------------------------------------------
+
+bool StorageServer::BeginUpload(Conn* c) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  int spi = p[0];
+  int64_t size = GetInt64BE(p + 1);
+  c->ext = ExtFromField(p + 9);
+  if (size < 0 || c->pkg_len != 15 + size) {
+    RespondError(c, 22);
+    return false;
+  }
+  if (spi == 0xFF) {
+    spi = store_.PickStorePath();
+  } else if (spi >= store_.store_path_count()) {
+    RespondError(c, 22);
+    return false;
+  }
+  c->store_path_index = spi;
+  c->file_size = size;
+  c->file_remaining = size;
+  c->crc32 = 0;
+  c->hashing = dedup_ != nullptr;
+  if (c->hashing) c->sha1 = Sha1Stream();
+  c->tmp_path = store_.NewTmpPath(spi);
+  c->file_fd = open(c->tmp_path.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644);
+  if (c->file_fd < 0) {
+    FDFS_LOG_ERROR("open %s: %s", c->tmp_path.c_str(), strerror(errno));
+    RespondError(c, 5);
+    return false;
+  }
+  return true;
+}
+
+std::string StorageServer::MintFileId(int spi, int64_t size, uint32_t crc,
+                                      const std::string& ext, bool appender) {
+  EncodeFileIdArgs a;
+  a.group = cfg_.group_name;
+  a.store_path_index = spi;
+  a.source_ip = PackIp(MyIp());
+  a.create_timestamp = static_cast<uint32_t>(time(nullptr));
+  a.file_size = static_cast<uint64_t>(size);
+  a.crc32 = crc;
+  a.ext = ext;
+  a.uniquifier = store_.NextUniquifier();
+  a.appender = appender;
+  auto id = EncodeFileId(a);
+  return id.has_value() ? *id : "";
+}
+
+void StorageServer::FinishUpload(Conn* c) {
+  close(c->file_fd);
+  c->file_fd = -1;
+  bool appender =
+      static_cast<StorageCmd>(c->cmd) == StorageCmd::kUploadAppenderFile;
+
+  std::string digest;
+  if (c->hashing) digest = c->sha1.Final().Hex();
+
+  // Dedup verdict (plugin boundary; appender files are mutable => exempt).
+  if (dedup_ != nullptr && !appender) {
+    auto verdict = dedup_->Judge(digest, c->file_size);
+    if (verdict.duplicate) {
+      auto dup = DecodeFileId(verdict.dup_of);
+      if (dup.has_value() && dup->group == cfg_.group_name &&
+          dup->store_path_index < store_.store_path_count()) {
+        int spi = dup->store_path_index;
+        std::string id = MintFileId(spi, c->file_size, c->crc32, c->ext, false);
+        auto parts = DecodeFileId(id);
+        std::string new_local =
+            LocalPath(store_.store_path(spi), parts->RemoteFilename()).value();
+        std::string dup_local =
+            LocalPath(store_.store_path(spi), dup->RemoteFilename()).value();
+        StoreManager::EnsureParentDirs(new_local);
+        if (link(dup_local.c_str(), new_local.c_str()) == 0) {
+          unlink(c->tmp_path.c_str());
+          c->tmp_path.clear();
+          stats_.dedup_hits++;
+          stats_.dedup_bytes_saved += c->file_size;
+          stats_.success_upload++;
+          stats_.last_source_update = time(nullptr);
+          binlog_.Append(kBinlogOpLink, parts->RemoteFilename(),
+                         dup->RemoteFilename());
+          Respond(c, 0, PackGroupField(cfg_.group_name) + parts->RemoteFilename());
+          return;
+        }
+        // Stale mapping (canonical copy deleted): fall through to a normal
+        // store and let Commit repoint the digest.
+        dedup_->Forget(verdict.dup_of);
+      }
+    }
+  }
+
+  std::string id = MintFileId(c->store_path_index, c->file_size, c->crc32,
+                              c->ext, appender);
+  if (id.empty()) {
+    unlink(c->tmp_path.c_str());
+    Respond(c, 22);
+    return;
+  }
+  auto parts = DecodeFileId(id);
+  std::string local = LocalPath(store_.store_path(c->store_path_index),
+                                parts->RemoteFilename())
+                          .value();
+  StoreManager::EnsureParentDirs(local);
+  if (rename(c->tmp_path.c_str(), local.c_str()) != 0) {
+    FDFS_LOG_ERROR("rename %s -> %s: %s", c->tmp_path.c_str(), local.c_str(),
+                   strerror(errno));
+    unlink(c->tmp_path.c_str());
+    Respond(c, 5);
+    return;
+  }
+  c->tmp_path.clear();
+  if (dedup_ != nullptr && !appender) dedup_->Commit(digest, id);
+  binlog_.Append(kBinlogOpCreate, parts->RemoteFilename());
+  stats_.success_upload++;
+  stats_.last_source_update = time(nullptr);
+  Respond(c, 0, PackGroupField(cfg_.group_name) + parts->RemoteFilename());
+}
+
+std::string StorageServer::ResolveLocal(const std::string& group,
+                                        const std::string& remote) const {
+  if (group != cfg_.group_name) return "";
+  int spi = 0;
+  if (remote.size() < 3 || sscanf(remote.c_str(), "M%02X/", &spi) != 1)
+    return "";
+  if (spi >= store_.store_path_count()) return "";
+  auto lp = LocalPath(store_.store_path(spi), remote);
+  return lp.has_value() ? *lp : "";
+}
+
+void StorageServer::HandleDownload(Conn* c) {
+  stats_.total_download++;
+  // body: 8B offset + 8B count + 16B group + remote_filename
+  if (c->fixed.size() < 16 + 16 + 10) {
+    Respond(c, 22);
+    return;
+  }
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  int64_t offset = GetInt64BE(p);
+  int64_t count = GetInt64BE(p + 8);
+  std::string group = GroupFromField(p + 16);
+  std::string remote = c->fixed.substr(32);
+  std::string local = ResolveLocal(group, remote);
+  if (local.empty() || offset < 0 || count < 0) {
+    Respond(c, 22);
+    return;
+  }
+  int fd = open(local.c_str(), O_RDONLY);
+  if (fd < 0) {
+    Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
+    return;
+  }
+  struct stat st;
+  fstat(fd, &st);
+  if (offset > st.st_size) {
+    close(fd);
+    Respond(c, 22);
+    return;
+  }
+  int64_t avail = st.st_size - offset;
+  if (count == 0 || count > avail) count = avail;
+  stats_.success_download++;
+  RespondFile(c, 0, fd, offset, count);
+}
+
+void StorageServer::HandleDelete(Conn* c) {
+  bool replica = static_cast<StorageCmd>(c->cmd) == StorageCmd::kSyncDeleteFile;
+  if (!replica) stats_.total_delete++;
+  if (c->fixed.size() < 16 + 10) {
+    Respond(c, 22);
+    return;
+  }
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  std::string group = GroupFromField(p);
+  std::string remote = c->fixed.substr(16);
+  std::string local = ResolveLocal(group, remote);
+  if (local.empty()) {
+    Respond(c, 22);
+    return;
+  }
+  if (unlink(local.c_str()) != 0) {
+    Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
+    return;
+  }
+  unlink((local + "-m").c_str());  // metadata sidecar, if any
+  if (dedup_ != nullptr) dedup_->Forget(group + "/" + remote);
+  binlog_.Append(replica ? 'd' : kBinlogOpDelete, remote);
+  if (!replica) {
+    stats_.success_delete++;
+    stats_.last_source_update = time(nullptr);
+  }
+  Respond(c, 0);
+}
+
+void StorageServer::HandleQueryFileInfo(Conn* c) {
+  stats_.total_query++;
+  if (c->fixed.size() < 16 + 10) {
+    Respond(c, 22);
+    return;
+  }
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  std::string group = GroupFromField(p);
+  std::string remote = c->fixed.substr(16);
+  std::string local = ResolveLocal(group, remote);
+  if (local.empty()) {
+    Respond(c, 22);
+    return;
+  }
+  struct stat st;
+  if (stat(local.c_str(), &st) != 0) {
+    Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
+    return;
+  }
+  // Identity facts come from the ID itself (no-metadata-database design).
+  auto parts = DecodeFileId(group + "/" + remote);
+  if (!parts.has_value()) {
+    Respond(c, 22);
+    return;
+  }
+  std::string body(40, '\0');
+  uint8_t* out = reinterpret_cast<uint8_t*>(body.data());
+  PutInt64BE(st.st_size, out);
+  PutInt64BE(parts->create_timestamp, out + 8);
+  PutInt64BE(parts->crc32, out + 16);
+  std::string ip = UnpackIp(parts->source_ip);
+  memcpy(out + 24, ip.data(), std::min<size_t>(ip.size(), 15));
+  stats_.success_query++;
+  Respond(c, 0, body);
+}
+
+void StorageServer::HandleSetMetadata(Conn* c) {
+  stats_.total_set_meta++;
+  // body: 16B group + 1B flag(O/M) + 8B name_len + name + metadata
+  if (c->fixed.size() < 16 + 1 + 8) {
+    Respond(c, 22);
+    return;
+  }
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  std::string group = GroupFromField(p);
+  char flag = static_cast<char>(p[16]);
+  int64_t name_len = GetInt64BE(p + 17);
+  if (name_len <= 0 || name_len > 512 ||
+      c->fixed.size() < 25 + static_cast<size_t>(name_len)) {
+    Respond(c, 22);
+    return;
+  }
+  std::string remote = c->fixed.substr(25, static_cast<size_t>(name_len));
+  std::string meta = c->fixed.substr(25 + static_cast<size_t>(name_len));
+  std::string local = ResolveLocal(group, remote);
+  if (local.empty() || (flag != 'O' && flag != 'M')) {
+    Respond(c, 22);
+    return;
+  }
+  struct stat st;
+  if (stat(local.c_str(), &st) != 0) {
+    Respond(c, 2);
+    return;
+  }
+  std::string meta_path = local + "-m";
+  if (flag == 'M') {
+    // merge: existing records kept unless overwritten
+    FILE* f = fopen(meta_path.c_str(), "r");
+    if (f != nullptr) {
+      std::string old;
+      char buf[4096];
+      size_t n;
+      while ((n = fread(buf, 1, sizeof(buf), f)) > 0) old.append(buf, n);
+      fclose(f);
+      // naive merge: parse both, new wins
+      auto parse = [](const std::string& s) {
+        std::unordered_map<std::string, std::string> m;
+        size_t pos = 0;
+        while (pos < s.size()) {
+          size_t rec_end = s.find('\x01', pos);
+          if (rec_end == std::string::npos) rec_end = s.size();
+          std::string rec = s.substr(pos, rec_end - pos);
+          size_t sep = rec.find('\x02');
+          if (sep != std::string::npos)
+            m[rec.substr(0, sep)] = rec.substr(sep + 1);
+          pos = rec_end + 1;
+        }
+        return m;
+      };
+      auto merged = parse(old);
+      for (auto& [k, v] : parse(meta)) merged[k] = v;
+      std::string out;
+      for (auto& [k, v] : merged) {
+        if (!out.empty()) out += '\x01';
+        out += k + '\x02' + v;
+      }
+      meta = out;
+    }
+  }
+  std::string tmp = meta_path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (f == nullptr) {
+    Respond(c, 5);
+    return;
+  }
+  fwrite(meta.data(), 1, meta.size(), f);
+  fclose(f);
+  if (rename(tmp.c_str(), meta_path.c_str()) != 0) {
+    Respond(c, 5);
+    return;
+  }
+  binlog_.Append(kBinlogOpUpdate, remote);
+  stats_.success_set_meta++;
+  stats_.last_source_update = time(nullptr);
+  Respond(c, 0);
+}
+
+void StorageServer::HandleGetMetadata(Conn* c) {
+  stats_.total_get_meta++;
+  if (c->fixed.size() < 16 + 10) {
+    Respond(c, 22);
+    return;
+  }
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  std::string group = GroupFromField(p);
+  std::string remote = c->fixed.substr(16);
+  std::string local = ResolveLocal(group, remote);
+  if (local.empty()) {
+    Respond(c, 22);
+    return;
+  }
+  FILE* f = fopen((local + "-m").c_str(), "r");
+  std::string meta;
+  if (f != nullptr) {
+    char buf[4096];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof(buf), f)) > 0) meta.append(buf, n);
+    fclose(f);
+  } else {
+    struct stat st;
+    if (stat(local.c_str(), &st) != 0) {
+      Respond(c, 2);
+      return;
+    }
+  }
+  stats_.success_get_meta++;
+  Respond(c, 0, meta);
+}
+
+void StorageServer::HandleAppend(Conn* c) {
+  // Appender-file append lands in a later milestone (SURVEY §2.2 appender
+  // ops); the opcode is reserved and politely refused for now.
+  stats_.total_append++;
+  Respond(c, 22);
+}
+
+}  // namespace fdfs

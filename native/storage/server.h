@@ -1,0 +1,141 @@
+// Storage daemon: epoll nio loop + request handlers + upload pipeline.
+//
+// Reference map (SURVEY.md §2.2):
+// - connection state machine / stage flags → storage/storage_nio.c
+//   (client_sock_read/client_sock_write, FDFS_STORAGE_STAGE_NIO_*)
+// - per-command handlers → storage/storage_service.c
+//   (storage_deal_task, storage_upload_file, storage_server_download_file…)
+// - chunked disk IO with rolling checksum → storage/storage_dio.c
+//   (dio_write_file: the loop the dedup plugin instruments)
+// - binlog on every mutation → storage/storage_sync.c:storage_binlog_write
+#pragma once
+
+#include <sys/epoll.h>
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <unordered_map>
+
+#include "common/bytes.h"
+#include "common/protocol_gen.h"
+#include "common/net.h"
+#include "storage/binlog.h"
+#include "storage/config.h"
+#include "storage/dedup.h"
+#include "storage/store.h"
+
+namespace fdfs {
+
+// Per-op counters (reference: FDFSStorageStat in tracker/tracker_types.h,
+// reported to the tracker with each beat).
+struct StorageStats {
+  int64_t total_upload = 0, success_upload = 0;
+  int64_t total_download = 0, success_download = 0;
+  int64_t total_delete = 0, success_delete = 0;
+  int64_t total_append = 0, success_append = 0;
+  int64_t total_set_meta = 0, success_set_meta = 0;
+  int64_t total_get_meta = 0, success_get_meta = 0;
+  int64_t total_query = 0, success_query = 0;
+  int64_t dedup_hits = 0;
+  int64_t dedup_bytes_saved = 0;
+  int64_t bytes_uploaded = 0, bytes_downloaded = 0;
+  int64_t last_source_update = 0;  // ts of last client-originated mutation
+};
+
+class StorageServer {
+ public:
+  explicit StorageServer(StorageConfig cfg);
+  ~StorageServer();
+
+  bool Init(std::string* error);
+  void Run();
+  void Stop();
+  EventLoop& loop() { return loop_; }
+  const StorageStats& stats() const { return stats_; }
+  const StorageConfig& config() const { return cfg_; }
+  BinlogWriter& binlog() { return binlog_; }
+  void DumpState();  // SIGUSR1 analogue of storage_dump.c
+
+ private:
+  enum class ConnState { kRecvHeader, kRecvFixed, kRecvFile, kSend };
+
+  struct Conn {
+    int fd = -1;
+    ConnState state = ConnState::kRecvHeader;
+    // recv
+    uint8_t header[kHeaderSize];
+    size_t header_got = 0;
+    int64_t pkg_len = 0;
+    uint8_t cmd = 0;
+    std::string fixed;          // in-memory body (or fixed prefix for upload)
+    size_t fixed_need = 0;
+    int64_t body_consumed = 0;  // bytes of pkg_len read so far
+    bool close_after_send = false;  // early error left unread request bytes
+    // upload streaming
+    int file_fd = -1;
+    std::string tmp_path;
+    int64_t file_remaining = 0;
+    int64_t file_size = 0;
+    int store_path_index = 0;
+    std::string ext;
+    Sha1Stream sha1;
+    uint32_t crc32 = 0;
+    bool hashing = false;
+    uint8_t replica_op = 0;     // set for SYNC_* ops (no binlog re-emit)
+    std::string sync_remote;    // target remote filename for SYNC_CREATE
+    // send
+    std::string out;
+    size_t out_off = 0;
+    int send_fd = -1;
+    int64_t send_off = 0;
+    int64_t send_remaining = 0;
+  };
+
+  // -- nio ---------------------------------------------------------------
+  void OnAccept(uint32_t events);
+  void OnConnEvent(int fd, uint32_t events);
+  void ReadConn(Conn* c);
+  bool WriteConn(Conn* c);          // false => conn closed
+  void CloseConn(Conn* c);
+  void ResetForNextRequest(Conn* c);
+  void Respond(Conn* c, uint8_t status, const std::string& body = "");
+  // Error response that may leave unread request bytes: closes after send.
+  void RespondError(Conn* c, uint8_t status);
+  void RespondFile(Conn* c, uint8_t status, int file_fd, int64_t offset,
+                   int64_t count);
+
+  // -- dispatch ----------------------------------------------------------
+  void OnHeaderComplete(Conn* c);
+  void OnFixedComplete(Conn* c);
+  void OnFileComplete(Conn* c);
+
+  // -- handlers (storage_service.c analogues) ----------------------------
+  bool BeginUpload(Conn* c);        // parse fixed, open tmp file
+  void FinishUpload(Conn* c);       // mint id, dedup, commit, binlog
+  void HandleDownload(Conn* c);
+  void HandleDelete(Conn* c);
+  void HandleQueryFileInfo(Conn* c);
+  void HandleSetMetadata(Conn* c);
+  void HandleGetMetadata(Conn* c);
+  void HandleAppend(Conn* c);
+
+  std::string MintFileId(int spi, int64_t size, uint32_t crc,
+                         const std::string& ext, bool appender);
+  // Resolve "group/remote" or "remote" to a local path; empty on error.
+  std::string ResolveLocal(const std::string& group,
+                           const std::string& remote) const;
+  std::string MyIp() const;
+
+  StorageConfig cfg_;
+  StoreManager store_;
+  BinlogWriter binlog_;
+  std::unique_ptr<DedupPlugin> dedup_;
+  EventLoop loop_;
+  int listen_fd_ = -1;
+  std::unordered_map<int, std::unique_ptr<Conn>> conns_;
+  StorageStats stats_;
+  std::string my_ip_;
+};
+
+}  // namespace fdfs

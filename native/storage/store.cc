@@ -1,0 +1,89 @@
+#include "storage/store.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+
+#include "common/log.h"
+
+namespace fdfs {
+
+bool MakeDirs(const std::string& path) {
+  std::string cur;
+  for (size_t i = 0; i < path.size(); ++i) {
+    if (path[i] == '/' && !cur.empty()) {
+      if (mkdir(cur.c_str(), 0755) != 0 && errno != EEXIST) return false;
+    }
+    cur.push_back(path[i]);
+  }
+  if (!cur.empty() && mkdir(cur.c_str(), 0755) != 0 && errno != EEXIST)
+    return false;
+  return true;
+}
+
+bool StoreManager::EnsureParentDirs(const std::string& path) {
+  size_t pos = path.find_last_of('/');
+  if (pos == std::string::npos) return true;
+  return MakeDirs(path.substr(0, pos));
+}
+
+bool StoreManager::Init(const StorageConfig& cfg, std::string* error) {
+  paths_ = cfg.store_paths;
+  subdir_count_ = cfg.subdir_count_per_path;
+  for (const std::string& p : paths_) {
+    std::string data = p + "/data";
+    std::string flag = data + "/.data_init_flag";
+    struct stat st;
+    if (stat(flag.c_str(), &st) == 0) continue;  // already initialized
+    // Pre-create the two-level fan-out (reference:
+    // storage_make_data_dirs()).
+    for (int i = 0; i < subdir_count_; ++i) {
+      char sub[64];
+      std::snprintf(sub, sizeof(sub), "%s/%02X", data.c_str(), i);
+      if (!MakeDirs(sub)) {
+        *error = std::string("mkdir ") + sub + ": " + strerror(errno);
+        return false;
+      }
+      for (int j = 0; j < subdir_count_; ++j) {
+        char sub2[80];
+        std::snprintf(sub2, sizeof(sub2), "%s/%02X", sub, j);
+        if (mkdir(sub2, 0755) != 0 && errno != EEXIST) {
+          *error = std::string("mkdir ") + sub2 + ": " + strerror(errno);
+          return false;
+        }
+      }
+    }
+    if (!MakeDirs(p + "/tmp")) {
+      *error = "mkdir " + p + "/tmp failed";
+      return false;
+    }
+    int fd = open(flag.c_str(), O_CREAT | O_WRONLY, 0644);
+    if (fd < 0) {
+      *error = "create " + flag + " failed";
+      return false;
+    }
+    close(fd);
+    FDFS_LOG_INFO("initialized data dirs under %s (%d^2 subdirs)", p.c_str(),
+                  subdir_count_);
+  }
+  return true;
+}
+
+int StoreManager::PickStorePath() {
+  int i = next_path_;
+  next_path_ = (next_path_ + 1) % static_cast<int>(paths_.size());
+  return i;
+}
+
+std::string StoreManager::NewTmpPath(int spi) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "/tmp/upload_%d_%u", getpid(),
+                tmp_seq_.fetch_add(1));
+  return paths_[static_cast<size_t>(spi)] + buf;
+}
+
+}  // namespace fdfs

@@ -1,0 +1,46 @@
+// Store-path management: data-dir layout, tmp files, uniquifier counter.
+//
+// Reference: storage/storage_func.c — storage_func_init() /
+// storage_make_data_dirs() create <store_path>/data with
+// subdir_count_per_path² two-level dirs on first boot (".data_init_flag"
+// bookkeeping), and tmp space for in-flight uploads.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "storage/config.h"
+
+namespace fdfs {
+
+class StoreManager {
+ public:
+  bool Init(const StorageConfig& cfg, std::string* error);
+
+  int PickStorePath();  // round-robin (reference: store_path rr policy)
+  int store_path_count() const { return static_cast<int>(paths_.size()); }
+  const std::string& store_path(int i) const { return paths_[i]; }
+  int subdir_count() const { return subdir_count_; }
+
+  // Fresh tmp path for an in-flight upload on store path spi.
+  std::string NewTmpPath(int spi);
+  // 12-bit rolling uniquifier for file-ID minting.
+  int NextUniquifier() { return static_cast<int>(uniq_.fetch_add(1) & 0xFFF); }
+
+  // Ensure the two-level subdir for a local file path exists (lazy backstop;
+  // Init pre-creates the full fan-out).
+  static bool EnsureParentDirs(const std::string& path);
+
+ private:
+  std::vector<std::string> paths_;
+  int subdir_count_ = 256;
+  std::atomic<uint32_t> uniq_{0};
+  std::atomic<uint32_t> tmp_seq_{0};
+  int next_path_ = 0;
+};
+
+bool MakeDirs(const std::string& path);  // mkdir -p
+
+}  // namespace fdfs

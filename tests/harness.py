@@ -1,0 +1,108 @@
+"""Localhost cluster harness: spawn C++ daemons as subprocesses.
+
+SURVEY.md §4: every port and path is config, so a pytest harness can spin
+up 1 tracker + N storages on localhost — the multi-node testing story the
+reference only supported manually.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD = os.path.join(REPO, "native", "build")
+STORAGED = os.path.join(BUILD, "fdfs_storaged")
+TRACKERD = os.path.join(BUILD, "fdfs_trackerd")
+
+
+def ensure_native_built(targets: tuple[str, ...] = ()) -> None:
+    missing = [t for t in (STORAGED, *targets) if not os.path.exists(t)]
+    if not missing:
+        return
+    subprocess.run(["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD,
+                    "-G", "Ninja"], check=True, capture_output=True)
+    subprocess.run(["ninja", "-C", BUILD], check=True, capture_output=True)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_port(port: int, timeout: float = 10.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.05)
+    raise TimeoutError(f"port {port} never came up")
+
+
+class Daemon:
+    def __init__(self, binary: str, conf_path: str, port: int):
+        self.proc = subprocess.Popen(
+            [binary, conf_path],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        self.port = port
+        try:
+            wait_port(port)
+        except TimeoutError:
+            self.proc.kill()
+            out, err = self.proc.communicate()
+            raise RuntimeError(
+                f"daemon failed to start:\nstdout: {out.decode()}\n"
+                f"stderr: {err.decode()}")
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+
+    @property
+    def stderr_text(self) -> str:
+        return self.proc.stderr.read().decode() if self.proc.stderr else ""
+
+
+def make_storage_conf(base_dir: str, port: int, group: str = "group1",
+                      trackers: list[str] | None = None,
+                      subdirs: int = 4, dedup_mode: str = "none",
+                      dedup_sidecar: str = "", extra: str = "") -> str:
+    conf = os.path.join(base_dir, "storage.conf")
+    lines = [
+        f"group_name = {group}",
+        "bind_addr = 127.0.0.1",
+        f"port = {port}",
+        f"base_path = {base_dir}",
+        f"store_path0 = {base_dir}",
+        f"subdir_count_per_path = {subdirs}",
+        f"dedup_mode = {dedup_mode}",
+        "log_level = debug",
+    ]
+    if dedup_sidecar:
+        lines.append(f"dedup_sidecar = {dedup_sidecar}")
+    for t in trackers or []:
+        lines.append(f"tracker_server = {t}")
+    if extra:
+        lines.append(extra)
+    with open(conf, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return conf
+
+
+def start_storage(tmp_path, port: int | None = None, **kw) -> Daemon:
+    ensure_native_built()
+    port = port or free_port()
+    base = str(tmp_path)
+    os.makedirs(base, exist_ok=True)
+    conf = make_storage_conf(base, port, **kw)
+    return Daemon(STORAGED, conf, port)

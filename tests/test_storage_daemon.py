@@ -1,0 +1,182 @@
+"""Integration: C++ storage daemon driven by the Python client (the
+minimum end-to-end slice of SURVEY.md §7 step 2)."""
+
+import hashlib
+import os
+import socket
+import zlib
+
+import pytest
+
+from fastdfs_tpu.client import StorageClient
+from fastdfs_tpu.client.conn import StatusError
+from fastdfs_tpu.common.fileid import decode_file_id
+from tests.harness import start_storage
+
+
+@pytest.fixture(scope="module")
+def storage(tmp_path_factory):
+    d = start_storage(tmp_path_factory.mktemp("storage"))
+    yield d
+    d.stop()
+
+
+@pytest.fixture()
+def client(storage):
+    c = StorageClient("127.0.0.1", storage.port)
+    yield c
+    c.close()
+
+
+def test_active_test(client):
+    assert client.active_test()
+
+
+def test_upload_download_roundtrip(client):
+    data = os.urandom(100_000)
+    fid = client.upload_buffer(data, ext="bin")
+    assert fid.startswith("group1/M00/")
+    got = client.download_to_buffer(fid)
+    assert got == data
+
+
+def test_file_id_self_describing(client):
+    data = b"hello dedup world" * 100
+    fid = client.upload_buffer(data, ext="txt")
+    parsed, info = decode_file_id(fid)
+    assert info.file_size == len(data)
+    assert info.crc32 == zlib.crc32(data)
+    assert parsed.filename.endswith(".txt")
+
+
+def test_range_download(client):
+    data = bytes(range(256)) * 100
+    fid = client.upload_buffer(data)
+    assert client.download_to_buffer(fid, offset=100, length=50) == data[100:150]
+    assert client.download_to_buffer(fid, offset=25000) == data[25000:]
+    assert client.download_to_buffer(fid, offset=0, length=0) == data
+
+
+def test_zero_byte_file(client):
+    fid = client.upload_buffer(b"", ext="nul")
+    assert client.download_to_buffer(fid) == b""
+    info = client.query_file_info(fid)
+    assert info.file_size == 0
+
+
+def test_query_file_info(client):
+    data = os.urandom(5000)
+    fid = client.upload_buffer(data, ext="dat")
+    info = client.query_file_info(fid)
+    assert info.file_size == 5000
+    assert info.crc32 == zlib.crc32(data)
+    assert info.source_ip == "127.0.0.1"
+
+
+def test_delete(client):
+    fid = client.upload_buffer(b"delete me")
+    client.delete_file(fid)
+    with pytest.raises(StatusError) as ei:
+        client.download_to_buffer(fid)
+    assert ei.value.status == 2  # ENOENT
+    with pytest.raises(StatusError):
+        client.delete_file(fid)  # double delete
+
+
+def test_metadata_roundtrip(client):
+    fid = client.upload_buffer(b"with meta", ext="jpg")
+    assert client.get_metadata(fid) == {}
+    client.set_metadata(fid, {"width": "1024", "author": "yq"})
+    assert client.get_metadata(fid) == {"width": "1024", "author": "yq"}
+    # merge keeps old keys, overwrites changed ones
+    client.set_metadata(fid, {"width": "2048", "color": "rgb"}, merge=True)
+    assert client.get_metadata(fid) == {
+        "width": "2048", "author": "yq", "color": "rgb"}
+    # overwrite replaces everything
+    client.set_metadata(fid, {"only": "this"})
+    assert client.get_metadata(fid) == {"only": "this"}
+
+
+def test_download_nonexistent(client):
+    with pytest.raises(StatusError) as ei:
+        client.download_to_buffer(
+            "group1/M00/00/00/AAAAAAAAAAAAAAAAAAAAAAAAAAA.bin")
+    assert ei.value.status in (2, 22)
+
+
+def test_wrong_group_rejected(client):
+    fid = client.upload_buffer(b"grouped")
+    other = "other" + fid[fid.index("/"):]
+    with pytest.raises(StatusError) as ei:
+        client.download_to_buffer(other)
+    assert ei.value.status == 22
+
+
+def test_traversal_rejected_on_wire(client):
+    from fastdfs_tpu.common.protocol import StorageCmd, pack_group_name
+    client.conn.send_request(
+        StorageCmd.DOWNLOAD_FILE,
+        b"\x00" * 16 + pack_group_name("group1") + b"M00/../../etc/passwd")
+    with pytest.raises(StatusError) as ei:
+        client.conn.recv_response()
+    assert ei.value.status == 22
+
+
+def test_many_files_sequential(client):
+    ids = []
+    for i in range(20):
+        ids.append(client.upload_buffer(f"file number {i}".encode(), ext="txt"))
+    assert len(set(ids)) == 20  # no collisions
+    for i, fid in enumerate(ids):
+        assert client.download_to_buffer(fid) == f"file number {i}".encode()
+
+
+def test_large_file_streams(client):
+    data = os.urandom(8 << 20)  # 8 MB exercises chunked recv/send
+    fid = client.upload_buffer(data, ext="big")
+    got = client.download_to_buffer(fid)
+    assert hashlib.sha1(got).digest() == hashlib.sha1(data).digest()
+
+
+def test_concurrent_connections(storage):
+    clients = [StorageClient("127.0.0.1", storage.port) for _ in range(8)]
+    try:
+        fids = [c.upload_buffer(f"conn {i}".encode()) for i, c in enumerate(clients)]
+        for i, (c, fid) in enumerate(zip(clients, fids)):
+            assert c.download_to_buffer(fid) == f"conn {i}".encode()
+    finally:
+        for c in clients:
+            c.close()
+
+
+def test_garbage_header_closes_conn(storage):
+    with socket.create_connection(("127.0.0.1", storage.port), timeout=5) as s:
+        s.sendall(b"\xff" * 10)  # negative pkg_len
+        assert s.recv(1) == b""  # server closes
+
+
+def test_early_error_closes_instead_of_desync(storage):
+    # An error response sent before the body is consumed must not leave the
+    # connection parsing body bytes as headers (review finding).
+    from fastdfs_tpu.client.conn import ProtocolError
+    c = StorageClient("127.0.0.1", storage.port)
+    try:
+        with pytest.raises(StatusError) as ei:
+            c.upload_buffer(b"A" * 100, store_path_index=5)  # only path 0 exists
+        assert ei.value.status == 22
+        # server closed the conn after flushing the error
+        with pytest.raises((StatusError, ProtocolError, OSError)):
+            c.active_test()
+    finally:
+        c.close()
+    # a fresh connection is unaffected
+    with StorageClient("127.0.0.1", storage.port) as c2:
+        assert c2.active_test()
+
+
+def test_keepalive_multiple_requests(client):
+    # many requests on one connection (the nio state machine resets cleanly)
+    for i in range(10):
+        fid = client.upload_buffer(f"keepalive {i}".encode())
+        assert client.download_to_buffer(fid) == f"keepalive {i}".encode()
+        client.delete_file(fid)
