@@ -1,0 +1,141 @@
+"""CLI tools over the client library.
+
+Reference: the L4 tools in ``client/`` — fdfs_upload_file.c,
+fdfs_download_file.c, fdfs_delete_file.c, fdfs_file_info.c,
+fdfs_monitor.c (cluster status), fdfs_test.c (full-API smoke).
+
+Usage:  python -m fastdfs_tpu.cli <tool> <client.conf|tracker host:port> [args]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from fastdfs_tpu.client import FdfsClient
+from fastdfs_tpu.common.fileid import decode_file_id
+
+
+def _client(conf_or_addr: str) -> FdfsClient:
+    if os.path.exists(conf_or_addr):
+        return FdfsClient.from_conf(conf_or_addr)
+    return FdfsClient(conf_or_addr)
+
+
+def cmd_upload(c: FdfsClient, args: list[str]) -> int:
+    if not args:
+        print("usage: upload <tracker> <local_file> [ext]", file=sys.stderr)
+        return 2
+    path = args[0]
+    ext = args[1] if len(args) > 1 else os.path.splitext(path)[1].lstrip(".")[:6]
+    with open(path, "rb") as fh:
+        fid = c.upload_buffer(fh.read(), ext=ext)
+    print(fid)
+    return 0
+
+
+def cmd_download(c: FdfsClient, args: list[str]) -> int:
+    if not args:
+        print("usage: download <tracker> <file_id> [local_path]", file=sys.stderr)
+        return 2
+    fid = args[0]
+    out = args[1] if len(args) > 1 else os.path.basename(fid)
+    data = c.download_to_buffer(fid)
+    with open(out, "wb") as fh:
+        fh.write(data)
+    print(f"{out}: {len(data)} bytes")
+    return 0
+
+
+def cmd_delete(c: FdfsClient, args: list[str]) -> int:
+    if not args:
+        print("usage: delete <tracker> <file_id>", file=sys.stderr)
+        return 2
+    c.delete_file(args[0])
+    print("deleted")
+    return 0
+
+
+def cmd_file_info(c: FdfsClient, args: list[str]) -> int:
+    """Client-side ID decode + server-side query (fdfs_file_info.c)."""
+    if not args:
+        print("usage: file_info <tracker> <file_id>", file=sys.stderr)
+        return 2
+    fid, info = decode_file_id(args[0])
+    print(f"group: {fid.group}\nstore path: M{fid.store_path_index:02X}")
+    print(f"source ip: {info.source_ip}\ncreate time: {info.create_timestamp}")
+    print(f"file size: {info.file_size}\ncrc32: {info.crc32:08X}")
+    print(f"appender: {info.appender}  trunk: {info.trunk}  slave: {info.slave}")
+    remote = c.query_file_info(args[0])
+    print(f"server-reported size: {remote.file_size}")
+    return 0
+
+
+def cmd_monitor(c: FdfsClient, args: list[str]) -> int:
+    """Cluster topology + per-storage counters (fdfs_monitor.c)."""
+    groups = c.list_groups()
+    print(f"group count: {len(groups)}")
+    for g in groups:
+        print(f"\nGroup: {g['name']}  members={g['members']} "
+              f"active={g['active']} free={g['free_mb']}MB")
+        for s in c.list_storages(g["name"]):
+            print(f"  {s['ip']}:{s['port']} status={s['status']} "
+                  f"upload={s['upload'][1]}/{s['upload'][0]} "
+                  f"download={s['download'][1]}/{s['download'][0]} "
+                  f"delete={s['delete'][1]}/{s['delete'][0]} "
+                  f"dedup_hits={s['dedup_hits']} "
+                  f"saved={s['dedup_bytes_saved']}B "
+                  f"disk={s['free_mb']}/{s['total_mb']}MB")
+    return 0
+
+
+def cmd_test(c: FdfsClient, args: list[str]) -> int:
+    """Full-API smoke (fdfs_test.c): upload + metadata + query + download +
+    delete."""
+    data = os.urandom(10000)
+    fid = c.upload_buffer(data, ext="bin")
+    print(f"upload: {fid}")
+    c.set_metadata(fid, {"from": "fdfs_test", "len": str(len(data))})
+    print(f"metadata: {c.get_metadata(fid)}")
+    info = c.query_file_info(fid)
+    print(f"file info: size={info.file_size} ip={info.source_ip}")
+    assert c.download_to_buffer(fid) == data
+    print("download: OK")
+    c.delete_file(fid)
+    print("delete: OK")
+    return 0
+
+
+def cmd_groups_json(c: FdfsClient, args: list[str]) -> int:
+    print(json.dumps(c.list_groups(), indent=2))
+    return 0
+
+
+TOOLS = {
+    "upload": cmd_upload,
+    "download": cmd_download,
+    "delete": cmd_delete,
+    "file_info": cmd_file_info,
+    "monitor": cmd_monitor,
+    "test": cmd_test,
+    "groups_json": cmd_groups_json,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 2 or argv[0] not in TOOLS:
+        print(f"usage: python -m fastdfs_tpu.cli <{'|'.join(TOOLS)}> "
+              "<client.conf|tracker_host:port> [args...]", file=sys.stderr)
+        return 2
+    tool, conf = argv[0], argv[1]
+    try:
+        return TOOLS[tool](_client(conf), argv[2:])
+    except Exception as e:  # CLI surface: print, nonzero exit
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

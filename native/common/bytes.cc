@@ -1,9 +1,22 @@
 #include "common/bytes.h"
 
+#include <algorithm>
 #include <array>
 #include <cstring>
 
 namespace fdfs {
+
+void PutFixedField(std::string* out, std::string_view s, size_t width) {
+  std::string f(width, '\0');
+  std::memcpy(f.data(), s.data(), std::min(s.size(), width - 1));
+  *out += f;
+}
+
+std::string GetFixedField(const uint8_t* p, size_t width) {
+  size_t n = 0;
+  while (n < width && p[n] != 0) ++n;
+  return std::string(reinterpret_cast<const char*>(p), n);
+}
 
 void PutInt64BE(int64_t v, uint8_t* out) {
   uint64_t u = static_cast<uint64_t>(v);

@@ -14,6 +14,10 @@
 
 namespace fdfs {
 
+// -- fixed-width NUL-padded string fields (group/ip wire fields) ----------
+void PutFixedField(std::string* out, std::string_view s, size_t width);
+std::string GetFixedField(const uint8_t* p, size_t width);
+
 // -- endian framing (reference: shared_func.c long2buff/buff2long) --------
 void PutInt64BE(int64_t v, uint8_t* out);
 int64_t GetInt64BE(const uint8_t* in);

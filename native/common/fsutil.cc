@@ -1,0 +1,27 @@
+#include "common/fsutil.h"
+
+#include <errno.h>
+#include <sys/stat.h>
+
+namespace fdfs {
+
+bool MakeDirs(const std::string& path) {
+  std::string cur;
+  for (size_t i = 0; i < path.size(); ++i) {
+    if (path[i] == '/' && !cur.empty()) {
+      if (mkdir(cur.c_str(), 0755) != 0 && errno != EEXIST) return false;
+    }
+    cur.push_back(path[i]);
+  }
+  if (!cur.empty() && mkdir(cur.c_str(), 0755) != 0 && errno != EEXIST)
+    return false;
+  return true;
+}
+
+bool EnsureParentDirs(const std::string& path) {
+  size_t pos = path.find_last_of('/');
+  if (pos == std::string::npos) return true;
+  return MakeDirs(path.substr(0, pos));
+}
+
+}  // namespace fdfs

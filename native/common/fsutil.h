@@ -1,0 +1,11 @@
+// Filesystem helpers shared by daemons.
+#pragma once
+
+#include <string>
+
+namespace fdfs {
+
+bool MakeDirs(const std::string& path);          // mkdir -p
+bool EnsureParentDirs(const std::string& path);  // mkdir -p dirname(path)
+
+}  // namespace fdfs

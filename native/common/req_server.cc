@@ -1,0 +1,153 @@
+#include "common/req_server.h"
+
+#include <errno.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include "common/bytes.h"
+#include "common/log.h"
+
+namespace fdfs {
+
+RequestServer::~RequestServer() {
+  for (auto& [fd, c] : conns_) close(fd);
+  if (listen_fd_ >= 0) close(listen_fd_);
+}
+
+bool RequestServer::Listen(const std::string& bind_addr, int port,
+                           std::string* error) {
+  listen_fd_ = TcpListen(bind_addr, port, error);
+  if (listen_fd_ < 0) return false;
+  SetNonBlocking(listen_fd_);
+  loop_->Add(listen_fd_, EPOLLIN, [this](uint32_t ev) { OnAccept(ev); });
+  return true;
+}
+
+void RequestServer::OnAccept(uint32_t) {
+  for (;;) {
+    int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return;
+    SetNonBlocking(fd);
+    auto conn = std::make_unique<Conn>();
+    conn->fd = fd;
+    conn->peer_ip = PeerIp(fd);
+    conns_[fd] = std::move(conn);
+    loop_->Add(fd, EPOLLIN, [this, fd](uint32_t ev) { OnConnEvent(fd, ev); });
+  }
+}
+
+void RequestServer::OnConnEvent(int fd, uint32_t events) {
+  auto it = conns_.find(fd);
+  if (it == conns_.end()) return;
+  Conn* c = it->second.get();
+  if (events & (EPOLLHUP | EPOLLERR)) {
+    CloseConn(c);
+    return;
+  }
+  if (events & EPOLLOUT) {
+    if (!FlushConn(c)) return;
+  }
+  if (events & EPOLLIN) ReadConn(c);
+}
+
+void RequestServer::CloseConn(Conn* c) {
+  int fd = c->fd;
+  loop_->Del(fd);
+  close(fd);
+  conns_.erase(fd);
+}
+
+bool RequestServer::FlushConn(Conn* c) {
+  while (c->out_off < c->out.size()) {
+    ssize_t n = send(c->fd, c->out.data() + c->out_off,
+                     c->out.size() - c->out_off, MSG_NOSIGNAL);
+    if (n > 0) {
+      c->out_off += static_cast<size_t>(n);
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      // EPOLLOUT only: with EPOLLIN still armed, unread pipelined bytes
+      // would wake the level-triggered loop in a busy spin until the peer
+      // drains the response.
+      loop_->Mod(c->fd, EPOLLOUT);
+      return true;
+    }
+    if (n < 0 && errno == EINTR) continue;
+    CloseConn(c);
+    return false;
+  }
+  if (!c->out.empty()) {
+    c->out.clear();
+    c->out_off = 0;
+    loop_->Mod(c->fd, EPOLLIN);
+  }
+  return true;
+}
+
+void RequestServer::ReadConn(Conn* c) {
+  const int fd = c->fd;
+  char buf[65536];
+  for (;;) {
+    auto alive = conns_.find(fd);
+    if (alive == conns_.end() || alive->second.get() != c) return;
+    if (!c->out.empty()) return;  // response in flight; no pipelining
+    if (!c->in_body) {
+      ssize_t n = recv(c->fd, c->header + c->header_got,
+                       kHeaderSize - c->header_got, 0);
+      if (n == 0) {
+        CloseConn(c);
+        return;
+      }
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        if (errno == EINTR) continue;
+        CloseConn(c);
+        return;
+      }
+      c->header_got += static_cast<size_t>(n);
+      if (c->header_got < static_cast<size_t>(kHeaderSize)) continue;
+      c->pkg_len = GetInt64BE(c->header);
+      c->cmd = c->header[8];
+      if (c->pkg_len < 0 || c->pkg_len > max_body_) {
+        CloseConn(c);
+        return;
+      }
+      c->in_body = true;
+      c->body.clear();
+      if (c->pkg_len == 0) Dispatch(c);
+    } else {
+      size_t want = static_cast<size_t>(c->pkg_len) - c->body.size();
+      ssize_t n = recv(c->fd, buf, std::min(want, sizeof(buf)), 0);
+      if (n == 0) {
+        CloseConn(c);
+        return;
+      }
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        if (errno == EINTR) continue;
+        CloseConn(c);
+        return;
+      }
+      c->body.append(buf, static_cast<size_t>(n));
+      if (c->body.size() == static_cast<size_t>(c->pkg_len)) Dispatch(c);
+    }
+  }
+}
+
+void RequestServer::Dispatch(Conn* c) {
+  auto [status, resp] = handler_(c->cmd, c->body, c->peer_ip);
+  c->header_got = 0;
+  c->in_body = false;
+  c->body.clear();
+  c->out.resize(kHeaderSize);
+  PutInt64BE(static_cast<int64_t>(resp.size()),
+             reinterpret_cast<uint8_t*>(c->out.data()));
+  c->out[8] = static_cast<char>(TrackerCmd::kResp);
+  c->out[9] = static_cast<char>(status);
+  c->out += resp;
+  c->out_off = 0;
+  FlushConn(c);
+}
+
+}  // namespace fdfs

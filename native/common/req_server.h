@@ -1,0 +1,64 @@
+// Inline request/response server on the epoll loop — for daemons whose
+// bodies are small and fully buffered (the tracker; the dedup sidecar
+// mirror of this lives in Python).  The storage daemon has its own state
+// machine because uploads/downloads stream.
+//
+// Reference: tracker/tracker_service.c — work threads decode a
+// TrackerHeader, dispatch on cmd, and write one response.
+#pragma once
+
+#include <sys/epoll.h>
+
+#include <cstdint>
+#include <functional>
+#include <memory>
+#include <string>
+#include <unordered_map>
+
+#include "common/net.h"
+#include "common/protocol_gen.h"
+
+namespace fdfs {
+
+class RequestServer {
+ public:
+  // Handler: (cmd, body, peer_ip) -> (status, response_body).
+  using Handler = std::function<std::pair<uint8_t, std::string>(
+      uint8_t cmd, const std::string& body, const std::string& peer_ip)>;
+
+  RequestServer(EventLoop* loop, Handler handler, int64_t max_body = 16 << 20)
+      : loop_(loop), handler_(std::move(handler)), max_body_(max_body) {}
+  ~RequestServer();
+
+  bool Listen(const std::string& bind_addr, int port, std::string* error);
+  int listen_fd() const { return listen_fd_; }
+
+ private:
+  struct Conn {
+    int fd = -1;
+    std::string peer_ip;
+    uint8_t header[kHeaderSize];
+    size_t header_got = 0;
+    int64_t pkg_len = 0;
+    uint8_t cmd = 0;
+    bool in_body = false;
+    std::string body;
+    std::string out;
+    size_t out_off = 0;
+  };
+
+  void OnAccept(uint32_t events);
+  void OnConnEvent(int fd, uint32_t events);
+  void ReadConn(Conn* c);
+  bool FlushConn(Conn* c);
+  void CloseConn(Conn* c);
+  void Dispatch(Conn* c);
+
+  EventLoop* loop_;
+  Handler handler_;
+  int64_t max_body_;
+  int listen_fd_ = -1;
+  std::unordered_map<int, std::unique_ptr<Conn>> conns_;
+};
+
+}  // namespace fdfs

@@ -70,6 +70,13 @@ bool StorageServer::Init(std::string* error) {
   SetNonBlocking(listen_fd_);
   loop_.Add(listen_fd_, EPOLLIN, [this](uint32_t ev) { OnAccept(ev); });
 
+  if (!cfg_.tracker_servers.empty()) {
+    reporter_ = std::make_unique<TrackerReporter>(
+        cfg_, [this](int64_t out[20]) { stats_.Snapshot(out); },
+        PeersCallback());  // sync manager subscribes in a later milestone
+    reporter_->Start();
+  }
+
   // Periodic maintenance (reference: sched_thread entries — binlog flush,
   // stat write, dedup snapshot).
   loop_.AddTimer(1000, [this]() { binlog_.Flush(); });
@@ -86,12 +93,18 @@ bool StorageServer::Init(std::string* error) {
 void StorageServer::Run() { loop_.Run(); }
 
 void StorageServer::Stop() {
+  // Persist first: joining reporter threads can take up to one bounded
+  // tracker-RPC timeout, and durability must not ride on that.
   if (dedup_ != nullptr) dedup_->Save();
   binlog_.Flush();
+  if (reporter_ != nullptr) reporter_->Stop();
   loop_.Stop();
 }
 
 std::string StorageServer::MyIp() const {
+  if (reporter_ != nullptr) return reporter_->my_ip();
+  if (!cfg_.bind_addr.empty() && cfg_.bind_addr != "0.0.0.0")
+    return cfg_.bind_addr;
   return my_ip_.empty() ? "127.0.0.1" : my_ip_;
 }
 

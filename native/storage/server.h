@@ -12,6 +12,7 @@
 
 #include <sys/epoll.h>
 
+#include <atomic>
 #include <cstdint>
 #include <memory>
 #include <string>
@@ -24,23 +25,39 @@
 #include "storage/config.h"
 #include "storage/dedup.h"
 #include "storage/store.h"
+#include "storage/tracker_client.h"
 
 namespace fdfs {
 
 // Per-op counters (reference: FDFSStorageStat in tracker/tracker_types.h,
-// reported to the tracker with each beat).
+// reported to the tracker with each beat).  Atomics: written by the nio
+// loop, snapshotted by the tracker-reporter thread.
 struct StorageStats {
-  int64_t total_upload = 0, success_upload = 0;
-  int64_t total_download = 0, success_download = 0;
-  int64_t total_delete = 0, success_delete = 0;
-  int64_t total_append = 0, success_append = 0;
-  int64_t total_set_meta = 0, success_set_meta = 0;
-  int64_t total_get_meta = 0, success_get_meta = 0;
-  int64_t total_query = 0, success_query = 0;
-  int64_t dedup_hits = 0;
-  int64_t dedup_bytes_saved = 0;
-  int64_t bytes_uploaded = 0, bytes_downloaded = 0;
-  int64_t last_source_update = 0;  // ts of last client-originated mutation
+  std::atomic<int64_t> total_upload{0}, success_upload{0};
+  std::atomic<int64_t> total_download{0}, success_download{0};
+  std::atomic<int64_t> total_delete{0}, success_delete{0};
+  std::atomic<int64_t> total_append{0}, success_append{0};
+  std::atomic<int64_t> total_set_meta{0}, success_set_meta{0};
+  std::atomic<int64_t> total_get_meta{0}, success_get_meta{0};
+  std::atomic<int64_t> total_query{0}, success_query{0};
+  std::atomic<int64_t> dedup_hits{0};
+  std::atomic<int64_t> dedup_bytes_saved{0};
+  std::atomic<int64_t> bytes_uploaded{0}, bytes_downloaded{0};
+  std::atomic<int64_t> last_source_update{0};  // ts of last client mutation
+
+  // Beat-blob layout (shared contract with tracker/cluster.cc JSON).
+  void Snapshot(int64_t out[20]) const {
+    out[0] = total_upload; out[1] = success_upload;
+    out[2] = total_download; out[3] = success_download;
+    out[4] = total_delete; out[5] = success_delete;
+    out[6] = total_append; out[7] = success_append;
+    out[8] = total_set_meta; out[9] = success_set_meta;
+    out[10] = total_get_meta; out[11] = success_get_meta;
+    out[12] = total_query; out[13] = success_query;
+    out[14] = bytes_uploaded; out[15] = bytes_downloaded;
+    out[16] = dedup_hits; out[17] = dedup_bytes_saved;
+    out[18] = last_source_update; out[19] = 0;
+  }
 };
 
 class StorageServer {
@@ -55,6 +72,7 @@ class StorageServer {
   const StorageStats& stats() const { return stats_; }
   const StorageConfig& config() const { return cfg_; }
   BinlogWriter& binlog() { return binlog_; }
+  TrackerReporter* reporter() { return reporter_.get(); }
   void DumpState();  // SIGUSR1 analogue of storage_dump.c
 
  private:
@@ -131,6 +149,7 @@ class StorageServer {
   StoreManager store_;
   BinlogWriter binlog_;
   std::unique_ptr<DedupPlugin> dedup_;
+  std::unique_ptr<TrackerReporter> reporter_;
   EventLoop loop_;
   int listen_fd_ = -1;
   std::unordered_map<int, std::unique_ptr<Conn>> conns_;

@@ -12,25 +12,6 @@
 
 namespace fdfs {
 
-bool MakeDirs(const std::string& path) {
-  std::string cur;
-  for (size_t i = 0; i < path.size(); ++i) {
-    if (path[i] == '/' && !cur.empty()) {
-      if (mkdir(cur.c_str(), 0755) != 0 && errno != EEXIST) return false;
-    }
-    cur.push_back(path[i]);
-  }
-  if (!cur.empty() && mkdir(cur.c_str(), 0755) != 0 && errno != EEXIST)
-    return false;
-  return true;
-}
-
-bool StoreManager::EnsureParentDirs(const std::string& path) {
-  size_t pos = path.find_last_of('/');
-  if (pos == std::string::npos) return true;
-  return MakeDirs(path.substr(0, pos));
-}
-
 bool StoreManager::Init(const StorageConfig& cfg, std::string* error) {
   paths_ = cfg.store_paths;
   subdir_count_ = cfg.subdir_count_per_path;

@@ -11,6 +11,7 @@
 #include <string>
 #include <vector>
 
+#include "common/fsutil.h"
 #include "storage/config.h"
 
 namespace fdfs {
@@ -31,7 +32,9 @@ class StoreManager {
 
   // Ensure the two-level subdir for a local file path exists (lazy backstop;
   // Init pre-creates the full fan-out).
-  static bool EnsureParentDirs(const std::string& path);
+  static bool EnsureParentDirs(const std::string& path) {
+    return ::fdfs::EnsureParentDirs(path);
+  }
 
  private:
   std::vector<std::string> paths_;
@@ -40,7 +43,5 @@ class StoreManager {
   std::atomic<uint32_t> tmp_seq_{0};
   int next_path_ = 0;
 };
-
-bool MakeDirs(const std::string& path);  // mkdir -p
 
 }  // namespace fdfs

@@ -1,0 +1,266 @@
+#include "storage/tracker_client.h"
+
+#include <string.h>
+#include <sys/statvfs.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+
+#include "common/bytes.h"
+#include "common/log.h"
+#include "common/net.h"
+#include "common/protocol_gen.h"
+
+namespace fdfs {
+
+namespace {
+
+// Tracker RPCs are tiny; cap the blocking timeout so daemon shutdown never
+// waits out the full data-path network_timeout on a dead tracker.
+constexpr int kTrackerRpcTimeoutMs = 5000;
+
+void AppendInt64(std::string* out, int64_t v) {
+  char buf[8];
+  PutInt64BE(v, reinterpret_cast<uint8_t*>(buf));
+  out->append(buf, 8);
+}
+
+bool Rpc(int fd, uint8_t cmd, const std::string& body, std::string* resp,
+         uint8_t* status, int timeout_ms) {
+  uint8_t hdr[kHeaderSize];
+  PutInt64BE(static_cast<int64_t>(body.size()), hdr);
+  hdr[8] = cmd;
+  hdr[9] = 0;
+  if (!SendAll(fd, hdr, sizeof(hdr), timeout_ms) ||
+      !SendAll(fd, body.data(), body.size(), timeout_ms) ||
+      !RecvAll(fd, hdr, sizeof(hdr), timeout_ms))
+    return false;
+  int64_t len = GetInt64BE(hdr);
+  *status = hdr[9];
+  if (len < 0 || len > (16 << 20)) return false;
+  resp->resize(static_cast<size_t>(len));
+  if (len > 0 && !RecvAll(fd, resp->data(), resp->size(), timeout_ms))
+    return false;
+  return true;
+}
+
+bool SplitAddr(const std::string& addr, std::string* host, int* port) {
+  size_t colon = addr.rfind(':');
+  if (colon == std::string::npos || colon == 0) return false;
+  *host = addr.substr(0, colon);
+  *port = atoi(addr.c_str() + colon + 1);
+  return *port > 0;
+}
+
+}  // namespace
+
+TrackerReporter::TrackerReporter(StorageConfig cfg, StatsSnapshotFn stats_fn,
+                                 PeersCallback peers_cb)
+    : cfg_(std::move(cfg)), stats_fn_(std::move(stats_fn)),
+      peers_cb_(std::move(peers_cb)) {
+  // A configured bind address IS this server's identity (required for
+  // same-host clusters, where every daemon gets its own loopback IP —
+  // upstream forbids two group members per IP for the same reason).
+  if (!cfg_.bind_addr.empty() && cfg_.bind_addr != "0.0.0.0")
+    my_ip_ = cfg_.bind_addr;
+}
+
+TrackerReporter::~TrackerReporter() { Stop(); }
+
+void TrackerReporter::Start() {
+  for (const std::string& addr : cfg_.tracker_servers) {
+    std::string host;
+    int port;
+    if (!SplitAddr(addr, &host, &port)) {
+      FDFS_LOG_ERROR("bad tracker_server %s", addr.c_str());
+      continue;
+    }
+    threads_.emplace_back(&TrackerReporter::ThreadMain, this, host, port);
+  }
+}
+
+void TrackerReporter::Stop() {
+  stop_ = true;
+  for (auto& t : threads_)
+    if (t.joinable()) t.join();
+  threads_.clear();
+}
+
+std::string TrackerReporter::my_ip() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return my_ip_.empty() ? "127.0.0.1" : my_ip_;
+}
+
+std::vector<PeerInfo> TrackerReporter::peers() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return peers_;
+}
+
+void TrackerReporter::ReportSyncProgress(const std::string& dest_ip,
+                                         int dest_port, int64_t ts) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (auto& r : pending_sync_reports_) {
+    if (r.dest_ip == dest_ip && r.dest_port == dest_port) {
+      r.ts = std::max(r.ts, ts);
+      return;
+    }
+  }
+  pending_sync_reports_.push_back({dest_ip, dest_port, ts});
+}
+
+bool TrackerReporter::ParsePeers(const std::string& body) {
+  if (body.size() < 8) return false;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(body.data());
+  int64_t count = GetInt64BE(p);
+  const size_t rec = kIpAddressSize + 8 + 1;
+  // Divide, don't multiply: count * rec could wrap size_t and pass the
+  // bound check on a hostile length.
+  if (count < 0 || static_cast<size_t>(count) > (body.size() - 8) / rec)
+    return false;
+  std::vector<PeerInfo> peers;
+  for (int64_t i = 0; i < count; ++i) {
+    const uint8_t* q = p + 8 + i * rec;
+    PeerInfo pi;
+    pi.ip = GetFixedField(q, kIpAddressSize);
+    pi.port = static_cast<int>(GetInt64BE(q + kIpAddressSize));
+    pi.status = q[kIpAddressSize + 8];
+    peers.push_back(std::move(pi));
+  }
+  bool changed;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    changed = peers != peers_;
+    peers_ = peers;
+  }
+  if (changed && peers_cb_) peers_cb_(peers);
+  return true;
+}
+
+bool TrackerReporter::DoJoin(int fd, const std::string&) {
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  PutFixedField(&body, my_ip(), kIpAddressSize);
+  AppendInt64(&body, cfg_.port);
+  AppendInt64(&body, static_cast<int64_t>(cfg_.store_paths.size()));
+  std::string resp;
+  uint8_t status;
+  if (!Rpc(fd, static_cast<uint8_t>(TrackerCmd::kStorageJoin), body, &resp,
+           &status, kTrackerRpcTimeoutMs) ||
+      status != 0)
+    return false;
+  return ParsePeers(resp);
+}
+
+bool TrackerReporter::DoBeat(int fd) {
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  PutFixedField(&body, my_ip(), kIpAddressSize);
+  AppendInt64(&body, cfg_.port);
+  int64_t stats[20] = {0};
+  if (stats_fn_) stats_fn_(stats);
+  for (int i = 0; i < 20; ++i) AppendInt64(&body, stats[i]);
+  std::string resp;
+  uint8_t status;
+  if (!Rpc(fd, static_cast<uint8_t>(TrackerCmd::kStorageBeat), body, &resp,
+           &status, kTrackerRpcTimeoutMs))
+    return false;
+  if (status != 0) return false;  // tracker lost us: re-JOIN
+  ParsePeers(resp);
+
+  // Flush pending sync-progress reports (source-side, SURVEY §2.2 sync).
+  std::vector<SyncProgress> reports;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    reports.swap(pending_sync_reports_);
+  }
+  for (const auto& r : reports) {
+    std::string sbody;
+    PutFixedField(&sbody, cfg_.group_name, kGroupNameMaxLen);
+    PutFixedField(&sbody, my_ip(), kIpAddressSize);
+    AppendInt64(&sbody, cfg_.port);
+    PutFixedField(&sbody, r.dest_ip, kIpAddressSize);
+    AppendInt64(&sbody, r.dest_port);
+    AppendInt64(&sbody, r.ts);
+    std::string sresp;
+    uint8_t sstatus;
+    Rpc(fd, static_cast<uint8_t>(TrackerCmd::kStorageSyncReport), sbody,
+        &sresp, &sstatus, kTrackerRpcTimeoutMs);
+  }
+  return true;
+}
+
+bool TrackerReporter::DoDiskReport(int fd) {
+  struct statvfs sv;
+  int64_t total_mb = 0, free_mb = 0;
+  if (statvfs(cfg_.store_paths[0].c_str(), &sv) == 0) {
+    total_mb = static_cast<int64_t>(sv.f_blocks) * sv.f_frsize >> 20;
+    free_mb = static_cast<int64_t>(sv.f_bavail) * sv.f_frsize >> 20;
+  }
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  PutFixedField(&body, my_ip(), kIpAddressSize);
+  AppendInt64(&body, cfg_.port);
+  AppendInt64(&body, total_mb);
+  AppendInt64(&body, free_mb);
+  std::string resp;
+  uint8_t status;
+  return Rpc(fd, static_cast<uint8_t>(TrackerCmd::kStorageReportDiskUsage),
+             body, &resp, &status, kTrackerRpcTimeoutMs);
+}
+
+void TrackerReporter::ThreadMain(std::string host, int port) {
+  int fd = -1;
+  bool joined = false;
+  int64_t last_beat = 0, last_disk = 0;
+  while (!stop_) {
+    if (fd < 0) {
+      std::string err;
+      fd = TcpConnect(host, port, 3000, &err);
+      if (fd < 0) {
+        for (int i = 0; i < 20 && !stop_; ++i) usleep(100 * 1000);
+        continue;
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (my_ip_.empty()) my_ip_ = SockIp(fd);
+      }
+      joined = false;
+    }
+    int64_t now = time(nullptr);
+    bool ok = true;
+    if (!joined) {
+      ok = DoJoin(fd, host);
+      if (ok) {
+        joined = true;
+        last_beat = now;
+        FDFS_LOG_INFO("joined tracker %s:%d as %s:%d", host.c_str(), port,
+                      my_ip().c_str(), cfg_.port);
+        ok = DoDiskReport(fd);
+        last_disk = now;
+      }
+    } else if (now - last_beat >= cfg_.heart_beat_interval_s) {
+      ok = DoBeat(fd);
+      if (!ok) joined = false;  // status!=0 or IO error: rejoin
+      last_beat = now;
+    } else if (now - last_disk >= cfg_.stat_report_interval_s) {
+      ok = DoDiskReport(fd);
+      last_disk = now;
+    }
+    if (!ok && fd >= 0 && !joined) {
+      close(fd);
+      fd = -1;
+      continue;
+    }
+    usleep(200 * 1000);
+  }
+  if (fd >= 0) {
+    // Polite QUIT (reference: tracker_quit on shutdown).
+    std::string resp;
+    uint8_t status;
+    Rpc(fd, static_cast<uint8_t>(TrackerCmd::kQuit), "", &resp, &status, 1000);
+    close(fd);
+  }
+}
+
+}  // namespace fdfs

@@ -1,0 +1,72 @@
+// Storage-side tracker reporting threads.
+//
+// Reference: storage/tracker_client_thread.c — one thread per tracker:
+// JOIN on connect, heartbeats (TRACKER_PROTO_CMD_STORAGE_BEAT) carrying the
+// stat blob, periodic disk-usage reports; the peer list in each response
+// drives the sync threads (spawn/kill on membership change).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "storage/config.h"
+
+namespace fdfs {
+
+struct PeerInfo {
+  std::string ip;
+  int port = 0;
+  int status = 0;
+  std::string Addr() const { return ip + ":" + std::to_string(port); }
+  bool operator==(const PeerInfo& o) const {
+    return ip == o.ip && port == o.port;
+  }
+};
+
+// Thread-safe stat snapshot provider (filled by the nio loop).
+using StatsSnapshotFn = std::function<void(int64_t out[20])>;
+using PeersCallback = std::function<void(const std::vector<PeerInfo>&)>;
+
+class TrackerReporter {
+ public:
+  TrackerReporter(StorageConfig cfg, StatsSnapshotFn stats_fn,
+                  PeersCallback peers_cb);
+  ~TrackerReporter();
+
+  void Start();
+  void Stop();
+  // Source->tracker sync progress report (called by sync threads).
+  void ReportSyncProgress(const std::string& dest_ip, int dest_port,
+                          int64_t ts);
+  std::string my_ip() const;
+  std::vector<PeerInfo> peers() const;
+
+ private:
+  void ThreadMain(std::string host, int port);
+  bool DoJoin(int fd, const std::string& tracker_host);
+  bool DoBeat(int fd);
+  bool DoDiskReport(int fd);
+  bool ParsePeers(const std::string& body);
+
+  StorageConfig cfg_;
+  StatsSnapshotFn stats_fn_;
+  PeersCallback peers_cb_;
+  std::atomic<bool> stop_{false};
+  std::vector<std::thread> threads_;
+  mutable std::mutex mu_;
+  std::string my_ip_;
+  std::vector<PeerInfo> peers_;
+  struct SyncProgress {
+    std::string dest_ip;
+    int dest_port;
+    int64_t ts;
+  };
+  std::vector<SyncProgress> pending_sync_reports_;
+};
+
+}  // namespace fdfs

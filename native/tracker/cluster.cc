@@ -1,0 +1,369 @@
+#include "tracker/cluster.h"
+
+#include <cstdio>
+#include <cstring>
+
+#include "common/fileid.h"
+#include "common/log.h"
+#include "common/protocol_gen.h"
+
+namespace fdfs {
+
+namespace {
+constexpr int kActive = static_cast<int>(StorageStatus::kActive);
+constexpr int kOffline = static_cast<int>(StorageStatus::kOffline);
+constexpr int kDeleted = static_cast<int>(StorageStatus::kDeleted);
+}  // namespace
+
+int GroupInfo::ActiveCount() const {
+  int n = 0;
+  for (const auto& [addr, s] : storages)
+    if (s.status == kActive) ++n;
+  return n;
+}
+
+int64_t GroupInfo::FreeMb() const {
+  // Group capacity == min over active members (full replication).
+  int64_t mn = -1;
+  for (const auto& [addr, s] : storages) {
+    if (s.status != kActive) continue;
+    if (mn < 0 || s.free_mb < mn) mn = s.free_mb;
+  }
+  return mn < 0 ? 0 : mn;
+}
+
+GroupInfo* Cluster::FindGroup(const std::string& name) {
+  auto it = groups_.find(name);
+  return it == groups_.end() ? nullptr : &it->second;
+}
+
+StorageNode* Cluster::FindNode(const std::string& group,
+                               const std::string& addr) {
+  GroupInfo* g = FindGroup(group);
+  if (g == nullptr) return nullptr;
+  auto it = g->storages.find(addr);
+  return it == g->storages.end() ? nullptr : &it->second;
+}
+
+std::optional<std::vector<StorageNode>> Cluster::Join(
+    const std::string& group, const std::string& ip, int port,
+    int store_path_count, int64_t now) {
+  GroupInfo& g = groups_[group];
+  g.name = group;
+  std::string addr = ip + ":" + std::to_string(port);
+  // One member per IP: the file-ID source field identifies servers by IP
+  // alone, so a second port on the same IP would corrupt read routing.
+  for (const auto& [a, s] : g.storages) {
+    if (s.ip == ip && s.port != port) {
+      FDFS_LOG_WARN("join rejected: %s already in group %s as %s",
+                    addr.c_str(), group.c_str(), a.c_str());
+      return std::nullopt;
+    }
+  }
+  StorageNode& node = g.storages[addr];
+  bool fresh = node.join_time == 0;
+  node.ip = ip;
+  node.port = port;
+  node.store_path_count = store_path_count;
+  node.status = kActive;
+  node.last_beat = now;
+  if (fresh) node.join_time = now;
+  FDFS_LOG_INFO("storage %s %s group %s (members=%zu)", addr.c_str(),
+                fresh ? "joined" : "rejoined", group.c_str(),
+                g.storages.size());
+  return Peers(group, addr);
+}
+
+std::vector<StorageNode> Cluster::Peers(const std::string& group,
+                                        const std::string& exclude) const {
+  std::vector<StorageNode> out;
+  auto it = groups_.find(group);
+  if (it == groups_.end()) return out;
+  for (const auto& [addr, s] : it->second.storages)
+    if (addr != exclude && s.status != kDeleted) out.push_back(s);
+  return out;
+}
+
+bool Cluster::Beat(const std::string& group, const std::string& ip, int port,
+                   const int64_t* stats, int64_t now) {
+  StorageNode* n = FindNode(group, ip + ":" + std::to_string(port));
+  if (n == nullptr) return false;  // must JOIN first
+  n->last_beat = now;
+  if (n->status == kOffline) {
+    FDFS_LOG_INFO("storage %s back ONLINE in group %s", n->Addr().c_str(),
+                  group.c_str());
+  }
+  n->status = kActive;
+  if (stats != nullptr)
+    memcpy(n->stats, stats, sizeof(int64_t) * kBeatStatCount);
+  return true;
+}
+
+bool Cluster::UpdateDiskUsage(const std::string& group, const std::string& ip,
+                              int port, int64_t total_mb, int64_t free_mb) {
+  StorageNode* n = FindNode(group, ip + ":" + std::to_string(port));
+  if (n == nullptr) return false;
+  n->total_mb = total_mb;
+  n->free_mb = free_mb;
+  return true;
+}
+
+bool Cluster::SyncReport(const std::string& group, const std::string& src,
+                         const std::string& dest, int64_t ts) {
+  StorageNode* n = FindNode(group, dest);
+  if (n == nullptr) return false;
+  int64_t& cur = n->synced_from[src];
+  if (ts > cur) cur = ts;
+  return true;
+}
+
+int Cluster::CheckAlive(int64_t now, int64_t timeout_s) {
+  int transitions = 0;
+  for (auto& [gname, g] : groups_) {
+    for (auto& [addr, s] : g.storages) {
+      if (s.status == kActive && now - s.last_beat > timeout_s) {
+        s.status = kOffline;
+        ++transitions;
+        FDFS_LOG_WARN("storage %s in group %s OFFLINE (silent %llds)",
+                      addr.c_str(), gname.c_str(),
+                      static_cast<long long>(now - s.last_beat));
+      }
+    }
+  }
+  return transitions;
+}
+
+bool Cluster::DeleteStorage(const std::string& group, const std::string& addr) {
+  GroupInfo* g = FindGroup(group);
+  if (g == nullptr) return false;
+  auto it = g->storages.find(addr);
+  if (it == g->storages.end()) return false;
+  if (it->second.status == kActive) return false;  // only non-active removable
+  g->storages.erase(it);
+  return true;
+}
+
+// -- routing --------------------------------------------------------------
+
+std::optional<StoreTarget> Cluster::QueryStore(const std::string& group_hint) {
+  // Pick a group by policy over groups with >=1 ACTIVE member.
+  std::vector<GroupInfo*> candidates;
+  for (auto& [name, g] : groups_)
+    if (g.ActiveCount() > 0) candidates.push_back(&g);
+  if (candidates.empty()) return std::nullopt;
+
+  GroupInfo* g = nullptr;
+  if (!group_hint.empty()) {
+    g = FindGroup(group_hint);
+    if (g == nullptr || g->ActiveCount() == 0) return std::nullopt;
+  } else if (store_lookup_ == 1 && !store_group_.empty()) {
+    g = FindGroup(store_group_);
+    if (g == nullptr || g->ActiveCount() == 0) return std::nullopt;
+  } else if (store_lookup_ == 2) {
+    // load balance: most free space (reference: store_lookup=2)
+    for (GroupInfo* c : candidates)
+      if (g == nullptr || c->FreeMb() > g->FreeMb()) g = c;
+  } else {
+    g = candidates[rr_group_++ % candidates.size()];
+  }
+
+  // Round-robin over ACTIVE members of the group.
+  std::vector<const StorageNode*> active;
+  for (const auto& [addr, s] : g->storages)
+    if (s.status == kActive) active.push_back(&s);
+  if (active.empty()) return std::nullopt;
+  const StorageNode* pick = active[g->rr_write++ % active.size()];
+  StoreTarget t;
+  t.group = g->name;
+  t.ip = pick->ip;
+  t.port = pick->port;
+  t.store_path_index = 0xFF;
+  return t;
+}
+
+std::optional<StoreTarget> Cluster::QueryFetch(const std::string& group,
+                                               const std::string& remote) {
+  GroupInfo* g = FindGroup(group);
+  if (g == nullptr) return std::nullopt;
+  auto parts = DecodeFileId(group + "/" + remote);
+  if (!parts.has_value()) return std::nullopt;
+  std::string source_ip = UnpackIp(parts->source_ip);
+  int64_t create_ts = parts->create_timestamp;
+
+  // Candidates: the source server itself, or any replica whose synced_from
+  // the source has passed the file's create time (SURVEY §3.2 routing).
+  std::vector<const StorageNode*> ok;
+  for (const auto& [addr, s] : g->storages) {
+    if (s.status != kActive) continue;
+    if (s.ip == source_ip) {
+      ok.push_back(&s);
+      continue;
+    }
+    for (const auto& [src, ts] : s.synced_from) {
+      if (src.rfind(source_ip + ":", 0) == 0 && ts >= create_ts) {
+        ok.push_back(&s);
+        break;
+      }
+    }
+  }
+  if (ok.empty()) return std::nullopt;
+  const StorageNode* pick = ok[g->rr_read++ % ok.size()];
+  StoreTarget t;
+  t.group = group;
+  t.ip = pick->ip;
+  t.port = pick->port;
+  return t;
+}
+
+std::optional<StoreTarget> Cluster::QueryUpdate(const std::string& group,
+                                                const std::string& remote) {
+  // Mutations go to the source server when alive (reference:
+  // tracker_deal_service_query_fetch_update update path).
+  GroupInfo* g = FindGroup(group);
+  if (g == nullptr) return std::nullopt;
+  auto parts = DecodeFileId(group + "/" + remote);
+  if (!parts.has_value()) return std::nullopt;
+  std::string source_ip = UnpackIp(parts->source_ip);
+  for (const auto& [addr, s] : g->storages) {
+    if (s.status == kActive && s.ip == source_ip) {
+      StoreTarget t;
+      t.group = group;
+      t.ip = s.ip;
+      t.port = s.port;
+      return t;
+    }
+  }
+  return QueryFetch(group, remote);  // source down: any synced replica
+}
+
+// -- introspection --------------------------------------------------------
+
+static void AppendStorageJson(std::string* out, const StorageNode& s) {
+  char buf[1024];
+  std::snprintf(
+      buf, sizeof(buf),
+      "{\"ip\":\"%s\",\"port\":%d,\"status\":%d,\"store_paths\":%d,"
+      "\"join_time\":%lld,\"last_beat\":%lld,\"total_mb\":%lld,"
+      "\"free_mb\":%lld,\"upload\":[%lld,%lld],\"download\":[%lld,%lld],"
+      "\"delete\":[%lld,%lld],\"dedup_hits\":%lld,\"dedup_bytes_saved\":%lld}",
+      s.ip.c_str(), s.port, s.status, s.store_path_count,
+      static_cast<long long>(s.join_time), static_cast<long long>(s.last_beat),
+      static_cast<long long>(s.total_mb), static_cast<long long>(s.free_mb),
+      static_cast<long long>(s.stats[0]), static_cast<long long>(s.stats[1]),
+      static_cast<long long>(s.stats[2]), static_cast<long long>(s.stats[3]),
+      static_cast<long long>(s.stats[4]), static_cast<long long>(s.stats[5]),
+      static_cast<long long>(s.stats[16]),
+      static_cast<long long>(s.stats[17]));
+  *out += buf;
+}
+
+std::string Cluster::GroupsJson() const {
+  std::string out = "[";
+  bool first = true;
+  for (const auto& [name, g] : groups_) {
+    if (!first) out += ",";
+    first = false;
+    char buf[256];
+    std::snprintf(buf, sizeof(buf),
+                  "{\"name\":\"%s\",\"members\":%zu,\"active\":%d,"
+                  "\"free_mb\":%lld}",
+                  name.c_str(), g.storages.size(), g.ActiveCount(),
+                  static_cast<long long>(g.FreeMb()));
+    out += buf;
+  }
+  return out + "]";
+}
+
+std::string Cluster::StoragesJson(const std::string& group) const {
+  auto it = groups_.find(group);
+  std::string out = "[";
+  if (it != groups_.end()) {
+    bool first = true;
+    for (const auto& [addr, s] : it->second.storages) {
+      if (!first) out += ",";
+      first = false;
+      AppendStorageJson(&out, s);
+    }
+  }
+  return out + "]";
+}
+
+// -- persistence ----------------------------------------------------------
+
+bool Cluster::Save(const std::string& path) const {
+  std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (f == nullptr) return false;
+  for (const auto& [gname, g] : groups_) {
+    fprintf(f, "group %s\n", gname.c_str());
+    for (const auto& [addr, s] : g.storages) {
+      fprintf(f, "storage %s %d %d %d %lld %lld %lld %lld", s.ip.c_str(),
+              s.port, s.status, s.store_path_count,
+              static_cast<long long>(s.join_time),
+              static_cast<long long>(s.last_beat),
+              static_cast<long long>(s.total_mb),
+              static_cast<long long>(s.free_mb));
+      for (int i = 0; i < kBeatStatCount; ++i)
+        fprintf(f, " %lld", static_cast<long long>(s.stats[i]));
+      fprintf(f, "\n");
+      for (const auto& [src, ts] : s.synced_from)
+        fprintf(f, "sync %s %s %lld\n", addr.c_str(), src.c_str(),
+                static_cast<long long>(ts));
+    }
+  }
+  fclose(f);
+  return rename(tmp.c_str(), path.c_str()) == 0;
+}
+
+bool Cluster::Load(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (f == nullptr) return true;  // nothing saved yet
+  char line[2048];
+  std::string cur_group;
+  while (fgets(line, sizeof(line), f) != nullptr) {
+    char a[256], b[256];
+    if (sscanf(line, "group %255s", a) == 1) {
+      cur_group = a;
+      groups_[cur_group].name = cur_group;
+      continue;
+    }
+    StorageNode s;
+    long long jt, lb, tm, fm;
+    int consumed = 0;
+    if (sscanf(line, "storage %255s %d %d %d %lld %lld %lld %lld%n", a,
+               &s.port, &s.status, &s.store_path_count, &jt, &lb, &tm, &fm,
+               &consumed) == 8 &&
+        !cur_group.empty()) {
+      s.ip = a;
+      s.join_time = jt;
+      s.last_beat = lb;
+      s.total_mb = tm;
+      s.free_mb = fm;
+      const char* p = line + consumed;
+      for (int i = 0; i < kBeatStatCount; ++i) {
+        long long v = 0;
+        int adv = 0;
+        if (sscanf(p, " %lld%n", &v, &adv) == 1) {
+          s.stats[i] = v;
+          p += adv;
+        }
+      }
+      // Survivors of a tracker restart start OFFLINE until they beat again.
+      if (s.status == kActive) s.status = kOffline;
+      groups_[cur_group].storages[s.Addr()] = s;
+      continue;
+    }
+    long long ts;
+    if (sscanf(line, "sync %255s %255s %lld", a, b, &ts) == 3 &&
+        !cur_group.empty()) {
+      auto it = groups_[cur_group].storages.find(a);
+      if (it != groups_[cur_group].storages.end())
+        it->second.synced_from[b] = ts;
+    }
+  }
+  fclose(f);
+  FDFS_LOG_INFO("cluster state loaded: %zu groups", groups_.size());
+  return true;
+}
+
+}  // namespace fdfs

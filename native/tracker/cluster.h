@@ -1,0 +1,112 @@
+// Tracker in-memory cluster state — THE tracker brain.
+//
+// Reference: tracker/tracker_mem.c (tracker_mem_init, tracker_mem_add_
+// storage, tracker_get_writable_storage, tracker_mem_get_storage_by_
+// filename) + tracker/tracker_types.h (FDFSGroupInfo, FDFSStorageDetail).
+// Groups hold storages; uploads are spread across groups by policy; reads
+// are routed only to replicas whose sync timestamp from the file's source
+// server has passed the file's create time (sync-timestamp vectors).
+//
+// Honest divergence from upstream: a joining server goes straight to
+// ACTIVE instead of INIT/WAIT_SYNC/SYNCING — read safety is carried
+// entirely by the sync-timestamp routing rule (a new replica has no
+// synced_from entries, so it serves only files it sourced itself until
+// peers report sync progress).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace fdfs {
+
+constexpr int kBeatStatCount = 20;  // int64 slots in the beat stats blob
+
+struct StorageNode {
+  std::string ip;
+  int port = 0;
+  int status = 7;  // StorageStatus::kActive
+  int store_path_count = 1;
+  int64_t join_time = 0;
+  int64_t last_beat = 0;
+  int64_t total_mb = 0;
+  int64_t free_mb = 0;
+  int64_t stats[kBeatStatCount] = {0};
+  // "ip:port" of a source peer -> timestamp this node has synced up to.
+  std::map<std::string, int64_t> synced_from;
+
+  std::string Addr() const { return ip + ":" + std::to_string(port); }
+};
+
+struct GroupInfo {
+  std::string name;
+  std::map<std::string, StorageNode> storages;  // key "ip:port"
+  size_t rr_write = 0;
+  size_t rr_read = 0;
+
+  int ActiveCount() const;
+  int64_t FreeMb() const;
+};
+
+struct StoreTarget {
+  std::string group, ip;
+  int port = 0;
+  int store_path_index = 0xFF;  // 0xFF = storage picks
+};
+
+class Cluster {
+ public:
+  // store_lookup: 0 round-robin, 1 specified group, 2 load balance.
+  explicit Cluster(int store_lookup = 0, std::string store_group = "")
+      : store_lookup_(store_lookup), store_group_(std::move(store_group)) {}
+
+  // -- membership (tracker_mem_add_storage / beats) ----------------------
+  // nullopt: rejected (another member already owns this IP on a different
+  // port — file-ID source identity is IP-only, so one member per IP).
+  std::optional<std::vector<StorageNode>> Join(const std::string& group,
+                                               const std::string& ip, int port,
+                                               int store_path_count,
+                                               int64_t now);
+  bool Beat(const std::string& group, const std::string& ip, int port,
+            const int64_t* stats, int64_t now);
+  bool UpdateDiskUsage(const std::string& group, const std::string& ip,
+                       int port, int64_t total_mb, int64_t free_mb);
+  // Source "src" reports dest has synced its binlog through ts.
+  bool SyncReport(const std::string& group, const std::string& src_addr,
+                  const std::string& dest_addr, int64_t ts);
+  // Heartbeat-timeout state machine (tracker_mem_check_alive): ACTIVE
+  // nodes silent past `timeout_s` go OFFLINE; returns # transitions.
+  int CheckAlive(int64_t now, int64_t timeout_s);
+  bool DeleteStorage(const std::string& group, const std::string& addr);
+
+  // -- routing (tracker_get_writable_storage & co.) ----------------------
+  std::optional<StoreTarget> QueryStore(const std::string& group_hint);
+  std::optional<StoreTarget> QueryFetch(const std::string& group,
+                                        const std::string& remote);
+  std::optional<StoreTarget> QueryUpdate(const std::string& group,
+                                         const std::string& remote);
+
+  // -- introspection (fdfs_monitor feed; JSON) ---------------------------
+  std::string GroupsJson() const;
+  std::string StoragesJson(const std::string& group) const;
+
+  // -- persistence (tracker_save_storages analogue) ----------------------
+  bool Save(const std::string& path) const;
+  bool Load(const std::string& path);
+
+  std::vector<StorageNode> Peers(const std::string& group,
+                                 const std::string& exclude_addr) const;
+  GroupInfo* FindGroup(const std::string& name);
+  size_t group_count() const { return groups_.size(); }
+
+ private:
+  StorageNode* FindNode(const std::string& group, const std::string& addr);
+  std::map<std::string, GroupInfo> groups_;
+  int store_lookup_;
+  std::string store_group_;
+  size_t rr_group_ = 0;
+};
+
+}  // namespace fdfs

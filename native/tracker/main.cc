@@ -1,0 +1,70 @@
+// fdfs_trackerd — tracker daemon launcher.
+// Reference: tracker/fdfs_trackerd.c:main().
+#include <signal.h>
+
+#include <cstdio>
+
+#include "common/ini.h"
+#include "common/log.h"
+#include "tracker/server.h"
+
+static volatile sig_atomic_t g_stop_flag = 0;
+static volatile sig_atomic_t g_dump_flag = 0;
+
+static void OnSignal(int sig) {
+  if (sig == SIGUSR1) {
+    g_dump_flag = 1;
+  } else {
+    g_stop_flag = 1;
+  }
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <tracker.conf>\n", argv[0]);
+    return 2;
+  }
+  fdfs::IniConfig ini;
+  std::string err;
+  if (!ini.LoadFile(argv[1], &err)) {
+    std::fprintf(stderr, "config error: %s\n", err.c_str());
+    return 1;
+  }
+  fdfs::TrackerConfig cfg;
+  cfg.bind_addr = ini.GetStr("bind_addr", "");
+  cfg.port = static_cast<int>(ini.GetInt("port", 22122));
+  cfg.base_path = ini.GetStr("base_path", "");
+  cfg.store_lookup = static_cast<int>(ini.GetInt("store_lookup", 0));
+  cfg.store_group = ini.GetStr("store_group", "");
+  cfg.check_active_interval_s =
+      static_cast<int>(ini.GetSeconds("check_active_interval", 100));
+  cfg.save_interval_s = static_cast<int>(ini.GetSeconds("save_interval", 30));
+  cfg.log_level = ini.GetStr("log_level", "info");
+  if (cfg.base_path.empty()) {
+    std::fprintf(stderr, "config error: base_path is required\n");
+    return 1;
+  }
+  if (cfg.log_level == "debug") fdfs::LogSetLevel(fdfs::LogLevel::kDebug);
+  else if (cfg.log_level == "warn") fdfs::LogSetLevel(fdfs::LogLevel::kWarn);
+  else if (cfg.log_level == "error") fdfs::LogSetLevel(fdfs::LogLevel::kError);
+
+  fdfs::TrackerServer server(cfg);
+  if (!server.Init(&err)) {
+    std::fprintf(stderr, "init error: %s\n", err.c_str());
+    return 1;
+  }
+  signal(SIGINT, OnSignal);
+  signal(SIGTERM, OnSignal);
+  signal(SIGUSR1, OnSignal);
+  signal(SIGPIPE, SIG_IGN);
+  server.loop().AddTimer(200, [&server]() {
+    if (g_dump_flag) {
+      g_dump_flag = 0;
+      server.DumpState();
+    }
+    if (g_stop_flag) server.Stop();
+  });
+  server.Run();
+  FDFS_LOG_INFO("tracker daemon shut down");
+  return 0;
+}

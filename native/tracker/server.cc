@@ -1,0 +1,211 @@
+#include "tracker/server.h"
+
+#include <time.h>
+
+#include <cstring>
+
+#include "common/bytes.h"
+#include "common/log.h"
+#include "common/protocol_gen.h"
+#include "common/fsutil.h"
+
+namespace fdfs {
+
+namespace {
+
+std::string FixedGroup(const uint8_t* p) {
+  return GetFixedField(p, kGroupNameMaxLen);
+}
+
+std::string FixedIp(const uint8_t* p) { return GetFixedField(p, kIpAddressSize); }
+
+std::string PackPeers(const std::vector<StorageNode>& peers) {
+  std::string out(8, '\0');
+  PutInt64BE(static_cast<int64_t>(peers.size()),
+             reinterpret_cast<uint8_t*>(out.data()));
+  for (const StorageNode& p : peers) {
+    PutFixedField(&out, p.ip, kIpAddressSize);
+    char pbuf[8];
+    PutInt64BE(p.port, reinterpret_cast<uint8_t*>(pbuf));
+    out.append(pbuf, 8);
+    out.push_back(static_cast<char>(p.status));
+  }
+  return out;
+}
+
+std::string PackStoreTarget(const StoreTarget& t) {
+  std::string out;
+  PutFixedField(&out, t.group, kGroupNameMaxLen);
+  PutFixedField(&out, t.ip, kIpAddressSize);
+  char pbuf[8];
+  PutInt64BE(t.port, reinterpret_cast<uint8_t*>(pbuf));
+  out.append(pbuf, 8);
+  out.push_back(static_cast<char>(t.store_path_index));
+  return out;
+}
+
+std::string PackFetchTarget(const StoreTarget& t) {
+  std::string out;
+  PutFixedField(&out, t.ip, kIpAddressSize);
+  char pbuf[8];
+  PutInt64BE(t.port, reinterpret_cast<uint8_t*>(pbuf));
+  out.append(pbuf, 8);
+  return out;
+}
+
+}  // namespace
+
+TrackerServer::TrackerServer(TrackerConfig cfg) : cfg_(std::move(cfg)) {}
+
+bool TrackerServer::Init(std::string* error) {
+  if (!MakeDirs(cfg_.base_path + "/data")) {
+    *error = "cannot create " + cfg_.base_path + "/data";
+    return false;
+  }
+  cluster_ = std::make_unique<Cluster>(cfg_.store_lookup, cfg_.store_group);
+  state_path_ = cfg_.base_path + "/data/storage_servers.dat";
+  cluster_->Load(state_path_);
+
+  server_ = std::make_unique<RequestServer>(
+      &loop_, [this](uint8_t cmd, const std::string& body,
+                     const std::string& peer) { return Handle(cmd, body, peer); });
+  if (!server_->Listen(cfg_.bind_addr, cfg_.port, error)) return false;
+
+  loop_.AddTimer(1000, [this]() {
+    cluster_->CheckAlive(time(nullptr), cfg_.check_active_interval_s);
+  });
+  loop_.AddTimer(cfg_.save_interval_s * 1000,
+                 [this]() { cluster_->Save(state_path_); });
+
+  FDFS_LOG_INFO("tracker daemon up: port=%d store_lookup=%d", cfg_.port,
+                cfg_.store_lookup);
+  return true;
+}
+
+void TrackerServer::Run() { loop_.Run(); }
+
+void TrackerServer::Stop() {
+  cluster_->Save(state_path_);
+  loop_.Stop();
+}
+
+void TrackerServer::DumpState() {
+  FDFS_LOG_INFO("tracker state: %s", cluster_->GroupsJson().c_str());
+}
+
+std::pair<uint8_t, std::string> TrackerServer::Handle(
+    uint8_t cmd, const std::string& body, const std::string& peer_ip) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(body.data());
+  int64_t now = time(nullptr);
+  switch (static_cast<TrackerCmd>(cmd)) {
+    case TrackerCmd::kActiveTest:
+    case TrackerCmd::kQuit:
+      return {0, ""};
+
+    case TrackerCmd::kStorageJoin: {
+      // 16B group + 16B ip + 8B port + 8B store_path_count
+      if (body.size() < 48) return {22, ""};
+      std::string group = FixedGroup(p);
+      std::string ip = FixedIp(p + 16);
+      if (ip.empty()) ip = peer_ip;
+      int64_t port = GetInt64BE(p + 32);
+      int64_t spc = GetInt64BE(p + 40);
+      if (group.empty() || port <= 0 || port > 65535 || spc < 1 || spc > 256)
+        return {22, ""};
+      auto peers = cluster_->Join(group, ip, static_cast<int>(port),
+                                  static_cast<int>(spc), now);
+      if (!peers.has_value()) return {114 /*EALREADY*/, ""};
+      return {0, PackPeers(*peers)};
+    }
+
+    case TrackerCmd::kStorageBeat: {
+      // 16B group + 16B ip + 8B port [+ kBeatStatCount x 8B stats]
+      if (body.size() < 40) return {22, ""};
+      std::string group = FixedGroup(p);
+      std::string ip = FixedIp(p + 16);
+      int64_t port = GetInt64BE(p + 32);
+      int64_t stats[kBeatStatCount] = {0};
+      const int64_t* sp = nullptr;
+      if (body.size() >= 40 + 8 * kBeatStatCount) {
+        for (int i = 0; i < kBeatStatCount; ++i)
+          stats[i] = GetInt64BE(p + 40 + 8 * i);
+        sp = stats;
+      }
+      if (!cluster_->Beat(group, ip, static_cast<int>(port), sp, now))
+        return {2, ""};  // unknown: storage must re-JOIN
+      auto peers = cluster_->Peers(group, ip + ":" + std::to_string(port));
+      return {0, PackPeers(peers)};
+    }
+
+    case TrackerCmd::kStorageReportDiskUsage: {
+      if (body.size() < 56) return {22, ""};
+      std::string group = FixedGroup(p);
+      std::string ip = FixedIp(p + 16);
+      int64_t port = GetInt64BE(p + 32);
+      if (!cluster_->UpdateDiskUsage(group, ip, static_cast<int>(port),
+                                     GetInt64BE(p + 40), GetInt64BE(p + 48)))
+        return {2, ""};
+      return {0, ""};
+    }
+
+    case TrackerCmd::kStorageSyncReport: {
+      // 16B group + 16B src_ip + 8B src_port + 16B dest_ip + 8B dest_port + 8B ts
+      if (body.size() < 72) return {22, ""};
+      std::string group = FixedGroup(p);
+      std::string src = FixedIp(p + 16) + ":" +
+                        std::to_string(GetInt64BE(p + 32));
+      std::string dest = FixedIp(p + 40) + ":" +
+                         std::to_string(GetInt64BE(p + 56));
+      if (!cluster_->SyncReport(group, src, dest, GetInt64BE(p + 64)))
+        return {2, ""};
+      return {0, ""};
+    }
+
+    case TrackerCmd::kServiceQueryStoreWithoutGroupOne: {
+      auto t = cluster_->QueryStore("");
+      if (!t.has_value()) return {2, ""};
+      return {0, PackStoreTarget(*t)};
+    }
+
+    case TrackerCmd::kServiceQueryStoreWithGroupOne: {
+      if (body.size() < 16) return {22, ""};
+      auto t = cluster_->QueryStore(FixedGroup(p));
+      if (!t.has_value()) return {2, ""};
+      return {0, PackStoreTarget(*t)};
+    }
+
+    case TrackerCmd::kServiceQueryFetchOne:
+    case TrackerCmd::kServiceQueryUpdate: {
+      if (body.size() < 16 + 10) return {22, ""};
+      std::string group = FixedGroup(p);
+      std::string remote = body.substr(16);
+      auto t = static_cast<TrackerCmd>(cmd) == TrackerCmd::kServiceQueryFetchOne
+                   ? cluster_->QueryFetch(group, remote)
+                   : cluster_->QueryUpdate(group, remote);
+      if (!t.has_value()) return {2, ""};
+      return {0, PackFetchTarget(*t)};
+    }
+
+    case TrackerCmd::kServerListAllGroups:
+      return {0, cluster_->GroupsJson()};
+
+    case TrackerCmd::kServerListStorage: {
+      if (body.size() < 16) return {22, ""};
+      return {0, cluster_->StoragesJson(FixedGroup(p))};
+    }
+
+    case TrackerCmd::kServerDeleteStorage: {
+      if (body.size() < 17) return {22, ""};
+      std::string group = FixedGroup(p);
+      std::string addr = body.substr(16);
+      if (!cluster_->DeleteStorage(group, addr)) return {16 /*EBUSY*/, ""};
+      return {0, ""};
+    }
+
+    default:
+      FDFS_LOG_WARN("tracker: unknown cmd %d from %s", cmd, peer_ip.c_str());
+      return {22, ""};
+  }
+}
+
+}  // namespace fdfs

@@ -1,0 +1,51 @@
+// Tracker daemon service: dispatch + schedules.
+//
+// Reference: tracker/tracker_service.c (tracker_deal_task and the
+// tracker_deal_* handler per opcode) + tracker/fdfs_trackerd.c (main).
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "common/net.h"
+#include "common/req_server.h"
+#include "tracker/cluster.h"
+
+namespace fdfs {
+
+struct TrackerConfig {
+  std::string bind_addr;
+  int port = 22122;
+  std::string base_path;
+  int store_lookup = 0;        // 0 rr, 1 specified, 2 load-balance
+  std::string store_group;
+  // Beat timeout => OFFLINE.  Must exceed the storage heartbeat default
+  // (30s); upstream uses 100s.
+  int check_active_interval_s = 100;
+  int save_interval_s = 30;
+  std::string log_level = "info";
+};
+
+class TrackerServer {
+ public:
+  explicit TrackerServer(TrackerConfig cfg);
+  bool Init(std::string* error);
+  void Run();
+  void Stop();
+  EventLoop& loop() { return loop_; }
+  Cluster& cluster() { return *cluster_; }
+  void DumpState();  // SIGUSR1 (tracker_dump.c analogue)
+
+ private:
+  std::pair<uint8_t, std::string> Handle(uint8_t cmd, const std::string& body,
+                                         const std::string& peer_ip);
+
+  TrackerConfig cfg_;
+  std::unique_ptr<Cluster> cluster_;
+  EventLoop loop_;
+  std::unique_ptr<RequestServer> server_;
+  std::string state_path_;
+};
+
+}  // namespace fdfs

@@ -33,25 +33,27 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def wait_port(port: int, timeout: float = 10.0) -> None:
+def wait_port(port: int, timeout: float = 10.0, host: str = "127.0.0.1") -> None:
     deadline = time.time() + timeout
     while time.time() < deadline:
         try:
-            with socket.create_connection(("127.0.0.1", port), timeout=1):
+            with socket.create_connection((host, port), timeout=1):
                 return
         except OSError:
             time.sleep(0.05)
-    raise TimeoutError(f"port {port} never came up")
+    raise TimeoutError(f"{host}:{port} never came up")
 
 
 class Daemon:
-    def __init__(self, binary: str, conf_path: str, port: int):
+    def __init__(self, binary: str, conf_path: str, port: int,
+                 ip: str = "127.0.0.1"):
         self.proc = subprocess.Popen(
             [binary, conf_path],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE)
         self.port = port
+        self.ip = ip
         try:
-            wait_port(port)
+            wait_port(port, host=ip)
         except TimeoutError:
             self.proc.kill()
             out, err = self.proc.communicate()
@@ -76,11 +78,12 @@ class Daemon:
 def make_storage_conf(base_dir: str, port: int, group: str = "group1",
                       trackers: list[str] | None = None,
                       subdirs: int = 4, dedup_mode: str = "none",
-                      dedup_sidecar: str = "", extra: str = "") -> str:
+                      dedup_sidecar: str = "", extra: str = "",
+                      ip: str = "127.0.0.1") -> str:
     conf = os.path.join(base_dir, "storage.conf")
     lines = [
         f"group_name = {group}",
-        "bind_addr = 127.0.0.1",
+        f"bind_addr = {ip}",
         f"port = {port}",
         f"base_path = {base_dir}",
         f"store_path0 = {base_dir}",
@@ -99,10 +102,39 @@ def make_storage_conf(base_dir: str, port: int, group: str = "group1",
     return conf
 
 
-def start_storage(tmp_path, port: int | None = None, **kw) -> Daemon:
+def start_storage(tmp_path, port: int | None = None, ip: str = "127.0.0.1",
+                  **kw) -> Daemon:
     ensure_native_built()
     port = port or free_port()
     base = str(tmp_path)
     os.makedirs(base, exist_ok=True)
-    conf = make_storage_conf(base, port, **kw)
-    return Daemon(STORAGED, conf, port)
+    conf = make_storage_conf(base, port, ip=ip, **kw)
+    return Daemon(STORAGED, conf, port, ip=ip)
+
+
+def make_tracker_conf(base_dir: str, port: int, store_lookup: int = 0,
+                      check_active: int = 3, extra: str = "") -> str:
+    conf = os.path.join(base_dir, "tracker.conf")
+    lines = [
+        "bind_addr = 127.0.0.1",
+        f"port = {port}",
+        f"base_path = {base_dir}",
+        f"store_lookup = {store_lookup}",
+        f"check_active_interval = {check_active}",
+        "save_interval = 2",
+        "log_level = debug",
+    ]
+    if extra:
+        lines.append(extra)
+    with open(conf, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return conf
+
+
+def start_tracker(tmp_path, port: int | None = None, **kw) -> Daemon:
+    ensure_native_built((TRACKERD,))
+    port = port or free_port()
+    base = str(tmp_path)
+    os.makedirs(base, exist_ok=True)
+    conf = make_tracker_conf(base, port, **kw)
+    return Daemon(TRACKERD, conf, port)

@@ -1,0 +1,161 @@
+"""Integration: tracker + storages + the client two-hop dance
+(SURVEY.md §7 step 3: 1 tracker + 2 storages as subprocesses)."""
+
+import time
+
+import pytest
+
+from fastdfs_tpu.client import FdfsClient, StorageClient, TrackerClient
+from fastdfs_tpu.client.conn import StatusError
+from tests.harness import free_port, start_storage, start_tracker
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tracker = start_tracker(tmp_path_factory.mktemp("tracker"))
+    taddr = f"127.0.0.1:{tracker.port}"
+    s1 = start_storage(tmp_path_factory.mktemp("s1"), trackers=[taddr],
+                       extra=HB, ip="127.0.0.2")
+    s2 = start_storage(tmp_path_factory.mktemp("s2"), trackers=[taddr],
+                       extra=HB, ip="127.0.0.3")
+    # wait for both to join
+    deadline = time.time() + 10
+    with TrackerClient("127.0.0.1", tracker.port) as t:
+        while time.time() < deadline:
+            groups = t.list_groups()
+            if groups and groups[0]["active"] == 2:
+                break
+            time.sleep(0.2)
+        else:
+            raise RuntimeError(f"storages never joined: {groups}")
+    yield {"tracker": tracker, "s1": s1, "s2": s2}
+    for d in (s1, s2, tracker):
+        d.stop()
+
+
+@pytest.fixture()
+def fdfs(cluster):
+    return FdfsClient(f"127.0.0.1:{cluster['tracker'].port}")
+
+
+def test_list_groups_and_storages(cluster):
+    with TrackerClient("127.0.0.1", cluster["tracker"].port) as t:
+        groups = t.list_groups()
+        assert len(groups) == 1
+        assert groups[0]["name"] == "group1"
+        assert groups[0]["members"] == 2 and groups[0]["active"] == 2
+        storages = t.list_storages("group1")
+        assert len(storages) == 2
+        ports = {s["port"] for s in storages}
+        assert ports == {cluster["s1"].port, cluster["s2"].port}
+        # disk usage got reported
+        assert all(s["total_mb"] > 0 for s in storages)
+
+
+def test_query_store_round_robin(cluster):
+    with TrackerClient("127.0.0.1", cluster["tracker"].port) as t:
+        picks = {t.query_store().port for _ in range(8)}
+    assert picks == {cluster["s1"].port, cluster["s2"].port}
+
+
+def test_two_hop_upload_download(fdfs):
+    data = b"routed through the tracker " * 500
+    fid = fdfs.upload_buffer(data, ext="bin")
+    assert fid.startswith("group1/")
+    assert fdfs.download_to_buffer(fid) == data
+    info = fdfs.query_file_info(fid)
+    assert info.file_size == len(data)
+
+
+def test_fetch_routes_to_source_before_sync(cluster, fdfs):
+    # Without replication (later milestone), reads must route to the source
+    # server only — the sync-timestamp rule keeps unsynced replicas out.
+    data = b"only on the source"
+    fid = fdfs.upload_buffer(data)
+    with TrackerClient("127.0.0.1", cluster["tracker"].port) as t:
+        for _ in range(6):
+            tgt = t.query_fetch(fid)
+            with StorageClient(tgt.ip, tgt.port) as s:
+                assert s.download_to_buffer(fid) == data
+
+
+def test_query_update_routes_to_source(cluster, fdfs):
+    fid = fdfs.upload_buffer(b"update me")
+    fdfs.set_metadata(fid, {"a": "1"})
+    assert fdfs.get_metadata(fid) == {"a": "1"}
+    fdfs.delete_file(fid)
+    with pytest.raises(StatusError):
+        fdfs.download_to_buffer(fid)
+
+
+def test_group_hint_honored(cluster, fdfs):
+    fid = fdfs.upload_buffer(b"to group1", group="group1")
+    assert fid.startswith("group1/")
+    with TrackerClient("127.0.0.1", cluster["tracker"].port) as t:
+        with pytest.raises(StatusError) as ei:
+            t.query_store("nosuchgroup")
+        assert ei.value.status == 2
+
+
+def test_offline_detection_and_rejoin(tmp_path_factory):
+    tracker = start_tracker(tmp_path_factory.mktemp("t2"), check_active=2)
+    taddr = f"127.0.0.1:{tracker.port}"
+    s = start_storage(tmp_path_factory.mktemp("s3"), trackers=[taddr], extra=HB)
+    try:
+        with TrackerClient("127.0.0.1", tracker.port) as t:
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if t.list_groups() and t.list_groups()[0]["active"] == 1:
+                    break
+                time.sleep(0.2)
+            # kill the storage; tracker must mark it OFFLINE
+            s.stop()
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                g = t.list_groups()
+                if g and g[0]["active"] == 0:
+                    break
+                time.sleep(0.3)
+            else:
+                raise AssertionError(f"never went offline: {t.list_groups()}")
+            # no write target now
+            with pytest.raises(StatusError) as ei:
+                t.query_store()
+            assert ei.value.status == 2
+    finally:
+        s.stop()
+        tracker.stop()
+
+
+def test_tracker_state_survives_restart(tmp_path_factory):
+    tdir = tmp_path_factory.mktemp("t3")
+    port = free_port()
+    tracker = start_tracker(tdir, port=port)
+    taddr = f"127.0.0.1:{port}"
+    s = start_storage(tmp_path_factory.mktemp("s4"), trackers=[taddr], extra=HB)
+    try:
+        with TrackerClient("127.0.0.1", port) as t:
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if t.list_groups() and t.list_groups()[0]["active"] == 1:
+                    break
+                time.sleep(0.2)
+        time.sleep(2.5)  # let the save timer persist state
+        tracker.stop()
+        tracker = start_tracker(tdir, port=port)
+        with TrackerClient("127.0.0.1", port) as t:
+            g = t.list_groups()
+            assert g and g[0]["members"] == 1  # remembered across restart
+            # storage re-beats within ~1s and comes back active
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if t.list_groups()[0]["active"] == 1:
+                    break
+                time.sleep(0.3)
+            else:
+                raise AssertionError("storage never re-activated after restart")
+    finally:
+        s.stop()
+        tracker.stop()
