@@ -42,6 +42,22 @@ std::string PackGroupField(const std::string& group) {
   return out;
 }
 
+// Atomic metadata-sidecar write (tmp + rename).  A partial write must not
+// report success: the sync sender advances its mark on status 0 and never
+// retries.
+bool WriteSidecarAtomic(const std::string& meta_path, const std::string& meta) {
+  std::string tmp = meta_path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (f == nullptr) return false;
+  bool ok = fwrite(meta.data(), 1, meta.size(), f) == meta.size();
+  ok = (fclose(f) == 0) && ok;
+  if (!ok || rename(tmp.c_str(), meta_path.c_str()) != 0) {
+    unlink(tmp.c_str());
+    return false;
+  }
+  return true;
+}
+
 }  // namespace
 
 StorageServer::StorageServer(StorageConfig cfg) : cfg_(std::move(cfg)) {}
@@ -71,9 +87,20 @@ bool StorageServer::Init(std::string* error) {
   loop_.Add(listen_fd_, EPOLLIN, [this](uint32_t ev) { OnAccept(ev); });
 
   if (!cfg_.tracker_servers.empty()) {
+    // Sync manager first: the reporter's peer lists drive its thread pool.
+    SyncCallbacks scbs;
+    scbs.resolve_local = [this](const std::string& remote) {
+      return ResolveLocal(cfg_.group_name, remote);
+    };
+    scbs.report = [this](const std::string& ip, int port, int64_t ts) {
+      if (reporter_ != nullptr) reporter_->ReportSyncProgress(ip, port, ts);
+    };
+    sync_ = std::make_unique<SyncManager>(cfg_, std::move(scbs));
     reporter_ = std::make_unique<TrackerReporter>(
         cfg_, [this](int64_t out[20]) { stats_.Snapshot(out); },
-        PeersCallback());  // sync manager subscribes in a later milestone
+        [this](const std::vector<PeerInfo>& peers) {
+          sync_->UpdatePeers(peers);
+        });
     reporter_->Start();
   }
 
@@ -97,6 +124,7 @@ void StorageServer::Stop() {
   // tracker-RPC timeout, and durability must not ride on that.
   if (dedup_ != nullptr) dedup_->Save();
   binlog_.Flush();
+  if (sync_ != nullptr) sync_->Stop();  // persists .mark cursors
   if (reporter_ != nullptr) reporter_->Stop();
   loop_.Stop();
 }
@@ -185,6 +213,7 @@ void StorageServer::ResetForNextRequest(Conn* c) {
   c->hashing = false;
   c->replica_op = 0;
   c->sync_remote.clear();
+  c->range_offset = 0;
   c->out.clear();
   c->out_off = 0;
   c->send_fd = -1;
@@ -399,6 +428,11 @@ void StorageServer::OnHeaderComplete(Conn* c) {
       c->fixed_need = 32;  // 16B group + 8B name_len + 8B size, then name
       c->state = ConnState::kRecvFixed;
       return;
+    case StorageCmd::kSyncAppendFile:
+    case StorageCmd::kSyncModifyFile:
+      c->fixed_need = 40;  // 16B group + 8B name_len + 8B off + 8B len, name
+      c->state = ConnState::kRecvFixed;
+      return;
     case StorageCmd::kDownloadFile:
     case StorageCmd::kDeleteFile:
     case StorageCmd::kQueryFileInfo:
@@ -406,6 +440,8 @@ void StorageServer::OnHeaderComplete(Conn* c) {
     case StorageCmd::kGetMetadata:
     case StorageCmd::kSyncDeleteFile:
     case StorageCmd::kSyncCreateLink:
+    case StorageCmd::kSyncUpdateFile:
+    case StorageCmd::kSyncTruncateFile:
       if (c->pkg_len > kMaxInlineBody) {
         CloseConn(c);
         return;
@@ -473,6 +509,18 @@ void StorageServer::OnFixedComplete(Conn* c) {
       if (c->file_remaining == 0) OnFileComplete(c);
       return;
     }
+    case StorageCmd::kSyncAppendFile:
+    case StorageCmd::kSyncModifyFile:
+      if (!BeginSyncRange(c)) return;
+      if (c->state == ConnState::kRecvFile && c->file_remaining == 0)
+        OnFileComplete(c);
+      return;
+    case StorageCmd::kSyncUpdateFile:
+      HandleSyncUpdate(c);
+      return;
+    case StorageCmd::kSyncTruncateFile:
+      HandleSyncTruncate(c);
+      return;
     case StorageCmd::kDownloadFile:
       HandleDownload(c);
       return;
@@ -527,7 +575,20 @@ void StorageServer::OnFixedComplete(Conn* c) {
 }
 
 void StorageServer::OnFileComplete(Conn* c) {
-  if (static_cast<StorageCmd>(c->cmd) == StorageCmd::kSyncCreateFile) {
+  auto cmd = static_cast<StorageCmd>(c->cmd);
+  if (cmd == StorageCmd::kSyncAppendFile || cmd == StorageCmd::kSyncModifyFile) {
+    close(c->file_fd);
+    c->file_fd = -1;
+    char extra[48];
+    snprintf(extra, sizeof(extra), "%lld %lld",
+             static_cast<long long>(c->range_offset),
+             static_cast<long long>(c->file_size));
+    binlog_.Append(cmd == StorageCmd::kSyncAppendFile ? 'a' : 'm',
+                   c->sync_remote, extra);
+    Respond(c, 0);
+    return;
+  }
+  if (cmd == StorageCmd::kSyncCreateFile) {
     // Replica write: place at the exact remote filename from the source.
     close(c->file_fd);
     c->file_fd = -1;
@@ -843,15 +904,7 @@ void StorageServer::HandleSetMetadata(Conn* c) {
       meta = out;
     }
   }
-  std::string tmp = meta_path + ".tmp";
-  FILE* f = fopen(tmp.c_str(), "w");
-  if (f == nullptr) {
-    Respond(c, 5);
-    return;
-  }
-  fwrite(meta.data(), 1, meta.size(), f);
-  fclose(f);
-  if (rename(tmp.c_str(), meta_path.c_str()) != 0) {
+  if (!WriteSidecarAtomic(meta_path, meta)) {
     Respond(c, 5);
     return;
   }
@@ -891,6 +944,121 @@ void StorageServer::HandleGetMetadata(Conn* c) {
   }
   stats_.success_get_meta++;
   Respond(c, 0, meta);
+}
+
+// SYNC_APPEND_FILE / SYNC_MODIFY_FILE replica replay: writes a byte range
+// into an existing file at an exact offset.  Two-stage fixed read like
+// SYNC_CREATE; the range bytes then stream through kRecvFile straight into
+// the target (no tmp file — replay is idempotent: a duplicate delivery
+// rewrites the same bytes at the same offset).
+bool StorageServer::BeginSyncRange(Conn* c) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  int64_t name_len = GetInt64BE(p + kGroupNameMaxLen);
+  int64_t offset = GetInt64BE(p + kGroupNameMaxLen + 8);
+  int64_t length = GetInt64BE(p + kGroupNameMaxLen + 16);
+  if (c->fixed.size() == 40) {
+    if (name_len <= 0 || name_len > 512 || offset < 0 || length < 0 ||
+        c->pkg_len != 40 + name_len + length) {
+      RespondError(c, 22);
+      return false;
+    }
+    c->fixed_need = 40 + static_cast<size_t>(name_len);
+    return true;  // keep reading the name (still kRecvFixed)
+  }
+  std::string group = GroupFromField(p);
+  c->sync_remote = c->fixed.substr(40);
+  std::string local = ResolveLocal(group, c->sync_remote);
+  if (local.empty()) {
+    RespondError(c, 22);
+    return false;
+  }
+  int fd = open(local.c_str(), O_WRONLY);
+  if (fd < 0) {
+    RespondError(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
+    return false;
+  }
+  struct stat st;
+  fstat(fd, &st);
+  if (offset > st.st_size) {  // gap — out-of-order replay
+    close(fd);
+    RespondError(c, 22);
+    return false;
+  }
+  if (lseek(fd, offset, SEEK_SET) != offset) {
+    close(fd);
+    RespondError(c, 5);
+    return false;
+  }
+  c->file_fd = fd;
+  c->range_offset = offset;
+  c->file_size = length;
+  c->file_remaining = length;
+  c->state = ConnState::kRecvFile;
+  return true;
+}
+
+// SYNC_UPDATE_FILE replica replay: refresh the metadata sidecar.
+void StorageServer::HandleSyncUpdate(Conn* c) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  if (c->fixed.size() < 32) {
+    Respond(c, 22);
+    return;
+  }
+  std::string group = GroupFromField(p);
+  int64_t name_len = GetInt64BE(p + kGroupNameMaxLen);
+  int64_t meta_len = GetInt64BE(p + kGroupNameMaxLen + 8);
+  if (name_len <= 0 || name_len > 512 || meta_len < 0 ||
+      c->fixed.size() != 32 + static_cast<size_t>(name_len + meta_len)) {
+    Respond(c, 22);
+    return;
+  }
+  std::string remote = c->fixed.substr(32, static_cast<size_t>(name_len));
+  std::string meta = c->fixed.substr(32 + static_cast<size_t>(name_len));
+  std::string local = ResolveLocal(group, remote);
+  if (local.empty()) {
+    Respond(c, 22);
+    return;
+  }
+  struct stat st;
+  if (stat(local.c_str(), &st) != 0) {
+    Respond(c, 2);
+    return;
+  }
+  if (!WriteSidecarAtomic(local + "-m", meta)) {
+    Respond(c, 5);
+    return;
+  }
+  binlog_.Append('u', remote);
+  Respond(c, 0);
+}
+
+// SYNC_TRUNCATE_FILE replica replay.
+void StorageServer::HandleSyncTruncate(Conn* c) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  if (c->fixed.size() < 32) {
+    Respond(c, 22);
+    return;
+  }
+  std::string group = GroupFromField(p);
+  int64_t name_len = GetInt64BE(p + kGroupNameMaxLen);
+  int64_t new_size = GetInt64BE(p + kGroupNameMaxLen + 8);
+  if (name_len <= 0 || name_len > 512 || new_size < 0 ||
+      c->fixed.size() != 32 + static_cast<size_t>(name_len)) {
+    Respond(c, 22);
+    return;
+  }
+  std::string remote = c->fixed.substr(32);
+  std::string local = ResolveLocal(group, remote);
+  if (local.empty()) {
+    Respond(c, 22);
+    return;
+  }
+  if (truncate(local.c_str(), new_size) != 0) {
+    Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
+    return;
+  }
+  binlog_.Append('t', remote, std::to_string(new_size));
+  Respond(c, 0);
 }
 
 void StorageServer::HandleAppend(Conn* c) {

@@ -25,6 +25,7 @@
 #include "storage/config.h"
 #include "storage/dedup.h"
 #include "storage/store.h"
+#include "storage/sync.h"
 #include "storage/tracker_client.h"
 
 namespace fdfs {
@@ -102,6 +103,7 @@ class StorageServer {
     bool hashing = false;
     uint8_t replica_op = 0;     // set for SYNC_* ops (no binlog re-emit)
     std::string sync_remote;    // target remote filename for SYNC_CREATE
+    int64_t range_offset = 0;   // append/modify replay write position
     // send
     std::string out;
     size_t out_off = 0;
@@ -137,6 +139,9 @@ class StorageServer {
   void HandleSetMetadata(Conn* c);
   void HandleGetMetadata(Conn* c);
   void HandleAppend(Conn* c);
+  void HandleSyncUpdate(Conn* c);
+  bool BeginSyncRange(Conn* c);     // SYNC_APPEND / SYNC_MODIFY prefix parse
+  void HandleSyncTruncate(Conn* c);
 
   std::string MintFileId(int spi, int64_t size, uint32_t crc,
                          const std::string& ext, bool appender);
@@ -150,6 +155,7 @@ class StorageServer {
   BinlogWriter binlog_;
   std::unique_ptr<DedupPlugin> dedup_;
   std::unique_ptr<TrackerReporter> reporter_;
+  std::unique_ptr<SyncManager> sync_;
   EventLoop loop_;
   int listen_fd_ = -1;
   std::unordered_map<int, std::unique_ptr<Conn>> conns_;

@@ -1,0 +1,439 @@
+#include "storage/sync.h"
+
+#include <ctype.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+
+#include "common/bytes.h"
+#include "common/log.h"
+#include "common/net.h"
+#include "common/protocol_gen.h"
+
+namespace fdfs {
+
+namespace {
+
+constexpr int kIoTimeoutMs = 30 * 1000;
+constexpr int kConnectTimeoutMs = 3000;
+constexpr int kMarkSaveEvery = 64;  // records between SaveMark() calls
+
+// One request/response over the storage sync connection.  The peer's
+// response body is always empty for SYNC_* ops; status carries the verdict.
+bool SyncRpcHeaderOnly(int fd, uint8_t* status, int timeout_ms) {
+  uint8_t hdr[kHeaderSize];
+  if (!RecvAll(fd, hdr, sizeof(hdr), timeout_ms)) return false;
+  int64_t len = GetInt64BE(hdr);
+  *status = hdr[9];
+  if (len < 0 || len > (1 << 20)) return false;
+  if (len > 0) {
+    std::string drain(static_cast<size_t>(len), '\0');
+    if (!RecvAll(fd, drain.data(), drain.size(), timeout_ms)) return false;
+  }
+  return true;
+}
+
+bool SendHeader(int fd, uint8_t cmd, int64_t pkg_len) {
+  uint8_t hdr[kHeaderSize];
+  PutInt64BE(pkg_len, hdr);
+  hdr[8] = cmd;
+  hdr[9] = 0;
+  return SendAll(fd, hdr, sizeof(hdr), kIoTimeoutMs);
+}
+
+// Streams [offset, offset+count) of local_fd to the socket.
+bool SendFileBytes(int fd, int local_fd, int64_t offset, int64_t count) {
+  char buf[256 * 1024];
+  if (lseek(local_fd, offset, SEEK_SET) != offset) return false;
+  while (count > 0) {
+    size_t want = static_cast<size_t>(
+        std::min<int64_t>(count, static_cast<int64_t>(sizeof(buf))));
+    ssize_t n = read(local_fd, buf, want);
+    if (n <= 0) return false;
+    if (!SendAll(fd, buf, static_cast<size_t>(n), kIoTimeoutMs)) return false;
+    count -= n;
+  }
+  return true;
+}
+
+}  // namespace
+
+SyncManager::SyncManager(const StorageConfig& cfg, SyncCallbacks cbs)
+    : cfg_(cfg), cbs_(std::move(cbs)),
+      sync_dir_(cfg.base_path + "/data/sync") {}
+
+SyncManager::~SyncManager() { Stop(); }
+
+void SyncManager::UpdatePeers(const std::vector<PeerInfo>& peers) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (stopped_) return;  // a post-Stop heartbeat must not respawn workers
+  // Retire workers whose peer vanished from the group.  Joined in Stop(),
+  // not here: the caller is a reporter thread and a join could block a
+  // heartbeat behind an in-flight multi-GB replay.
+  for (auto it = workers_.begin(); it != workers_.end();) {
+    bool still = false;
+    for (const auto& p : peers) still |= (p.Addr() == it->first);
+    if (still) {
+      ++it;
+    } else {
+      it->second->stop = true;
+      retired_.push_back(std::move(it->second));
+      it = workers_.erase(it);
+    }
+  }
+  // Spawn workers for new peers.
+  for (const auto& p : peers) {
+    if (p.port == cfg_.port && p.ip == cfg_.bind_addr) continue;  // self
+    if (workers_.count(p.Addr())) continue;
+    auto w = std::make_unique<Worker>();
+    w->ip = p.ip;
+    w->port = p.port;
+    Worker* raw = w.get();
+    w->thread = std::thread(&SyncManager::WorkerMain, this, raw);
+    workers_[p.Addr()] = std::move(w);
+    FDFS_LOG_INFO("sync thread spawned for peer %s", p.Addr().c_str());
+  }
+}
+
+void SyncManager::Stop() {
+  std::map<std::string, std::unique_ptr<Worker>> workers;
+  std::vector<std::unique_ptr<Worker>> retired;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stopped_ = true;
+    workers.swap(workers_);
+    retired.swap(retired_);
+  }
+  for (auto& [addr, w] : workers) w->stop = true;
+  for (auto& [addr, w] : workers)
+    if (w->thread.joinable()) w->thread.join();
+  for (auto& w : retired)
+    if (w->thread.joinable()) w->thread.join();
+}
+
+std::vector<SyncPeerState> SyncManager::States() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<SyncPeerState> out;
+  for (const auto& [addr, w] : workers_) {
+    SyncPeerState s;
+    s.addr = addr;
+    s.connected = w->connected;
+    s.synced_ts = w->synced_ts;
+    s.records_synced = w->records_synced;
+    s.records_skipped = w->records_skipped;
+    out.push_back(std::move(s));
+  }
+  return out;
+}
+
+void SyncManager::WorkerMain(Worker* w) {
+  const std::string mark_path =
+      sync_dir_ + "/" + w->ip + "_" + std::to_string(w->port) + ".mark";
+  BinlogReader reader;
+  std::string err;
+  reader.Init(sync_dir_, mark_path, &err);  // fresh peer => position 0
+
+  int fd = -1;
+  std::optional<BinlogRecord> pending;
+  int backoff_ms = 100;
+  int since_save = 0;
+
+  while (!w->stop) {
+    if (fd < 0) {
+      fd = TcpConnect(w->ip, w->port, kConnectTimeoutMs, &err);
+      if (fd < 0) {
+        w->connected = false;
+        for (int i = 0; i < backoff_ms / 50 && !w->stop; ++i)
+          usleep(50 * 1000);
+        backoff_ms = std::min(backoff_ms * 2, 5000);
+        continue;
+      }
+      w->connected = true;
+      backoff_ms = 100;
+    }
+
+    if (!pending.has_value()) pending = reader.Next();
+    if (!pending.has_value()) {
+      // Caught up: persist the cursor and idle-poll the binlog.
+      if (since_save > 0) {
+        reader.SaveMark();
+        since_save = 0;
+      }
+      int wait = std::max(cfg_.sync_interval_ms, 20);
+      for (int i = 0; i < wait / 20 && !w->stop; ++i) usleep(20 * 1000);
+      continue;
+    }
+
+    // Replica-replay records (lowercase) are never re-forwarded — that is
+    // what stops create/delete floods from circulating the group forever.
+    if (islower(static_cast<unsigned char>(pending->op))) {
+      pending.reset();
+      continue;
+    }
+
+    if (!Replay(w, &fd, *pending)) {
+      // Transient failure: reconnect and retry this same record.
+      if (fd >= 0) {
+        close(fd);
+        fd = -1;
+      }
+      w->connected = false;
+      continue;
+    }
+    w->synced_ts = pending->timestamp;
+    w->records_synced++;
+    if (cbs_.report) cbs_.report(w->ip, w->port, pending->timestamp);
+    pending.reset();
+    if (++since_save >= kMarkSaveEvery) {
+      reader.SaveMark();
+      since_save = 0;
+    }
+  }
+  reader.SaveMark();
+  if (fd >= 0) close(fd);
+}
+
+bool SyncManager::Replay(Worker* w, int* fd, const BinlogRecord& rec) {
+  bool skipped = false;
+  bool ok;
+  switch (rec.op) {
+    case kBinlogOpCreate:
+      ok = ReplayCreate(*fd, rec, &skipped);
+      break;
+    case kBinlogOpDelete:
+      ok = ReplayDelete(*fd, rec, &skipped);
+      break;
+    case kBinlogOpUpdate:
+      ok = ReplayUpdate(*fd, rec, &skipped);
+      break;
+    case kBinlogOpLink:
+      ok = ReplayLink(*fd, rec, &skipped);
+      break;
+    case kBinlogOpAppend:
+      ok = ReplayRange(*fd, static_cast<uint8_t>(StorageCmd::kSyncAppendFile),
+                       rec, &skipped);
+      break;
+    case kBinlogOpModify:
+      ok = ReplayRange(*fd, static_cast<uint8_t>(StorageCmd::kSyncModifyFile),
+                       rec, &skipped);
+      break;
+    case kBinlogOpTruncate:
+      ok = ReplayTruncate(*fd, rec, &skipped);
+      break;
+    default:
+      FDFS_LOG_WARN("sync %s: unknown op '%c' for %s — skipping",
+                    w->ip.c_str(), rec.op, rec.filename.c_str());
+      skipped = true;
+      ok = true;
+      break;
+  }
+  if (ok && skipped) w->records_skipped++;
+  return ok;
+}
+
+// 'C': whole-file copy.  Wire: 16B group + 8B name_len + 8B size + name +
+// bytes (the receiver's kSyncCreateFile layout in server.cc).
+bool SyncManager::ReplayCreate(int fd, const BinlogRecord& rec,
+                               bool* skipped) {
+  std::string local = cbs_.resolve_local(rec.filename);
+  int local_fd = local.empty() ? -1 : open(local.c_str(), O_RDONLY);
+  if (local_fd < 0) {
+    // Deleted (or never resolvable) since the record was written: the later
+    // 'D' record — or nothing at all — is the correct end state on the peer.
+    *skipped = true;
+    return true;
+  }
+  struct stat st;
+  fstat(local_fd, &st);
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  uint8_t num[8];
+  PutInt64BE(static_cast<int64_t>(rec.filename.size()), num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  PutInt64BE(st.st_size, num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  body += rec.filename;
+
+  bool ok = SendHeader(fd, static_cast<uint8_t>(StorageCmd::kSyncCreateFile),
+                       static_cast<int64_t>(body.size()) + st.st_size) &&
+            SendAll(fd, body.data(), body.size(), kIoTimeoutMs) &&
+            SendFileBytes(fd, local_fd, 0, st.st_size);
+  close(local_fd);
+  uint8_t status = 0;
+  if (!ok || !SyncRpcHeaderOnly(fd, &status, kIoTimeoutMs)) return false;
+  if (status != 0) {
+    FDFS_LOG_WARN("sync create %s rejected by peer: status %d — skipping",
+                  rec.filename.c_str(), status);
+    *skipped = true;
+  }
+  return true;
+}
+
+// 'D': 16B group + remote filename.
+bool SyncManager::ReplayDelete(int fd, const BinlogRecord& rec,
+                               bool* skipped) {
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  body += rec.filename;
+  if (!SendHeader(fd, static_cast<uint8_t>(StorageCmd::kSyncDeleteFile),
+                  static_cast<int64_t>(body.size())) ||
+      !SendAll(fd, body.data(), body.size(), kIoTimeoutMs))
+    return false;
+  uint8_t status = 0;
+  if (!SyncRpcHeaderOnly(fd, &status, kIoTimeoutMs)) return false;
+  // ENOENT (2) on the peer is fine — it never had the file (e.g. created
+  // and deleted before this peer's full-sync reached the create).
+  if (status != 0 && status != 2) {
+    FDFS_LOG_WARN("sync delete %s: peer status %d — skipping",
+                  rec.filename.c_str(), status);
+  }
+  *skipped = (status != 0);
+  return true;
+}
+
+// 'U': metadata sidecar refresh.  Wire: 16B group + 8B name_len +
+// 8B meta_len + name + meta bytes (receiver kSyncUpdateFile).
+bool SyncManager::ReplayUpdate(int fd, const BinlogRecord& rec,
+                               bool* skipped) {
+  std::string local = cbs_.resolve_local(rec.filename);
+  if (local.empty()) {
+    *skipped = true;
+    return true;
+  }
+  std::string meta;
+  FILE* f = fopen((local + "-m").c_str(), "r");
+  if (f != nullptr) {
+    char buf[4096];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof(buf), f)) > 0) meta.append(buf, n);
+    fclose(f);
+  }
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  uint8_t num[8];
+  PutInt64BE(static_cast<int64_t>(rec.filename.size()), num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  PutInt64BE(static_cast<int64_t>(meta.size()), num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  body += rec.filename;
+  body += meta;
+  if (!SendHeader(fd, static_cast<uint8_t>(StorageCmd::kSyncUpdateFile),
+                  static_cast<int64_t>(body.size())) ||
+      !SendAll(fd, body.data(), body.size(), kIoTimeoutMs))
+    return false;
+  uint8_t status = 0;
+  if (!SyncRpcHeaderOnly(fd, &status, kIoTimeoutMs)) return false;
+  *skipped = (status != 0);
+  return true;
+}
+
+// 'L': dedup/slave hard link.  Wire: 16B group + target \x02 src
+// (receiver kSyncCreateLink).
+bool SyncManager::ReplayLink(int fd, const BinlogRecord& rec, bool* skipped) {
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  body += rec.filename;
+  body += '\x02';
+  body += rec.extra;
+  if (!SendHeader(fd, static_cast<uint8_t>(StorageCmd::kSyncCreateLink),
+                  static_cast<int64_t>(body.size())) ||
+      !SendAll(fd, body.data(), body.size(), kIoTimeoutMs))
+    return false;
+  uint8_t status = 0;
+  if (!SyncRpcHeaderOnly(fd, &status, kIoTimeoutMs)) return false;
+  if (status != 0) {
+    FDFS_LOG_WARN("sync link %s -> %s: peer status %d — skipping",
+                  rec.filename.c_str(), rec.extra.c_str(), status);
+    *skipped = true;
+  }
+  return true;
+}
+
+// 'A'/'M': byte-range replay.  The binlog extra is "offset length" (both
+// sides of this protocol are ours; upstream resends whole appender files).
+// Wire: 16B group + 8B name_len + 8B offset + 8B length + name + bytes.
+bool SyncManager::ReplayRange(int fd, uint8_t cmd, const BinlogRecord& rec,
+                              bool* skipped) {
+  int64_t offset = -1, length = -1;
+  if (sscanf(rec.extra.c_str(), "%lld %lld", reinterpret_cast<long long*>(&offset),
+             reinterpret_cast<long long*>(&length)) != 2 ||
+      offset < 0 || length < 0) {
+    FDFS_LOG_WARN("sync range %s: bad extra '%s' — skipping",
+                  rec.filename.c_str(), rec.extra.c_str());
+    *skipped = true;
+    return true;
+  }
+  std::string local = cbs_.resolve_local(rec.filename);
+  int local_fd = local.empty() ? -1 : open(local.c_str(), O_RDONLY);
+  if (local_fd < 0) {
+    *skipped = true;
+    return true;
+  }
+  struct stat st;
+  fstat(local_fd, &st);
+  if (offset + length > st.st_size) {
+    // Truncated since; later binlog records hold the final state.
+    close(local_fd);
+    *skipped = true;
+    return true;
+  }
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  uint8_t num[8];
+  PutInt64BE(static_cast<int64_t>(rec.filename.size()), num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  PutInt64BE(offset, num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  PutInt64BE(length, num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  body += rec.filename;
+
+  bool ok = SendHeader(fd, cmd,
+                       static_cast<int64_t>(body.size()) + length) &&
+            SendAll(fd, body.data(), body.size(), kIoTimeoutMs) &&
+            SendFileBytes(fd, local_fd, offset, length);
+  close(local_fd);
+  uint8_t status = 0;
+  if (!ok || !SyncRpcHeaderOnly(fd, &status, kIoTimeoutMs)) return false;
+  if (status != 0) {
+    FDFS_LOG_WARN("sync range %s @%lld+%lld: peer status %d — skipping",
+                  rec.filename.c_str(), static_cast<long long>(offset),
+                  static_cast<long long>(length), status);
+    *skipped = true;
+  }
+  return true;
+}
+
+// 'T': extra is "new_size".  Wire: 16B group + 8B name_len + 8B new_size +
+// name (receiver kSyncTruncateFile).
+bool SyncManager::ReplayTruncate(int fd, const BinlogRecord& rec,
+                                 bool* skipped) {
+  int64_t new_size = -1;
+  if (sscanf(rec.extra.c_str(), "%lld",
+             reinterpret_cast<long long*>(&new_size)) != 1 ||
+      new_size < 0) {
+    *skipped = true;
+    return true;
+  }
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  uint8_t num[8];
+  PutInt64BE(static_cast<int64_t>(rec.filename.size()), num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  PutInt64BE(new_size, num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  body += rec.filename;
+  if (!SendHeader(fd, static_cast<uint8_t>(StorageCmd::kSyncTruncateFile),
+                  static_cast<int64_t>(body.size())) ||
+      !SendAll(fd, body.data(), body.size(), kIoTimeoutMs))
+    return false;
+  uint8_t status = 0;
+  if (!SyncRpcHeaderOnly(fd, &status, kIoTimeoutMs)) return false;
+  *skipped = (status != 0);
+  return true;
+}
+
+}  // namespace fdfs

@@ -1,0 +1,98 @@
+// Replication sender: per-peer sync threads tailing the binlog.
+//
+// Reference: storage/storage_sync.c — storage_sync_thread_entrance() tails
+// data/sync/binlog.NNN through a "<ip>_<port>.mark" cursor, replays each
+// source-op record on the group peer via STORAGE_PROTO_CMD_SYNC_* and
+// reports the synced-through timestamp to the tracker (which gates read
+// routing on it, tracker_mem_get_storage_by_filename()).
+//
+// Honest divergence from upstream: there is no SYNC_SRC_REQ/DEST_REQ
+// negotiation (tracker_deal_storage_sync_* in tracker_service.c).  A peer
+// first seen simply gets a fresh mark at position 0, so the full binlog
+// history replays to it — the same end state as upstream's need_sync_old
+// full-sync, without the three-way handshake.  Lowercase (replica-replay)
+// records are never forwarded, which is what terminates the flood.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "storage/binlog.h"
+#include "storage/config.h"
+#include "storage/tracker_client.h"
+
+namespace fdfs {
+
+struct SyncCallbacks {
+  // remote filename "Mxx/aa/bb/name" -> local path ("" when unresolvable).
+  std::function<std::string(const std::string&)> resolve_local;
+  // Source-side progress report feeding the tracker's sync-timestamp
+  // vectors (TrackerReporter::ReportSyncProgress).
+  std::function<void(const std::string& ip, int port, int64_t ts)> report;
+};
+
+struct SyncPeerState {
+  std::string addr;
+  bool connected = false;
+  int64_t synced_ts = 0;
+  int64_t records_synced = 0;
+  int64_t records_skipped = 0;
+};
+
+class SyncManager {
+ public:
+  SyncManager(const StorageConfig& cfg, SyncCallbacks cbs);
+  ~SyncManager();
+
+  // Reconcile sync threads with the tracker-reported peer list: spawn for
+  // new peers, retire threads for vanished ones.  Thread-safe (called from
+  // reporter threads).
+  void UpdatePeers(const std::vector<PeerInfo>& peers);
+  void Stop();
+  std::vector<SyncPeerState> States() const;
+
+ private:
+  struct Worker {
+    std::string ip;
+    int port = 0;
+    std::thread thread;
+    std::atomic<bool> stop{false};
+    std::atomic<bool> connected{false};
+    std::atomic<int64_t> synced_ts{0};
+    std::atomic<int64_t> records_synced{0};
+    std::atomic<int64_t> records_skipped{0};
+  };
+
+  void WorkerMain(Worker* w);
+  // Replays one record on the peer.  Returns true when the record is done
+  // with (synced OR permanently unreplayable => skip); false on transient
+  // IO failure (caller reconnects and retries the same record).
+  bool Replay(Worker* w, int* fd, const BinlogRecord& rec);
+  bool ReplayCreate(int fd, const BinlogRecord& rec, bool* skipped);
+  bool ReplayDelete(int fd, const BinlogRecord& rec, bool* skipped);
+  bool ReplayUpdate(int fd, const BinlogRecord& rec, bool* skipped);
+  bool ReplayLink(int fd, const BinlogRecord& rec, bool* skipped);
+  bool ReplayRange(int fd, uint8_t cmd, const BinlogRecord& rec,
+                   bool* skipped);
+  bool ReplayTruncate(int fd, const BinlogRecord& rec, bool* skipped);
+
+  StorageConfig cfg_;
+  SyncCallbacks cbs_;
+  std::string sync_dir_;
+  mutable std::mutex mu_;
+  bool stopped_ = false;
+  std::map<std::string, std::unique_ptr<Worker>> workers_;  // key "ip:port"
+  // Workers whose peer vanished: stop-flagged immediately, joined in
+  // Stop()/dtor — never on the reporter thread, whose heartbeats must not
+  // block behind an in-flight transfer.
+  std::vector<std::unique_ptr<Worker>> retired_;
+};
+
+}  // namespace fdfs

@@ -1,0 +1,201 @@
+"""Integration: intra-group replication (SURVEY.md §7 step 4).
+
+Reference semantics under test (storage/storage_sync.c):
+- every source mutation (C/D/U/L) lands in the binlog and is replayed on
+  every group peer by per-peer sync threads with .mark cursors;
+- a brand-new group member receives the FULL binlog history (upstream's
+  need_sync_old full-sync; here: a fresh mark starts at position 0);
+- the tracker routes reads to a replica only after the source has reported
+  the replica's synced-through timestamp past the file's create time
+  (tracker/tracker_mem.c:tracker_mem_get_storage_by_filename).
+"""
+
+import time
+
+import pytest
+
+from fastdfs_tpu.client import FdfsClient, StorageClient, TrackerClient
+from fastdfs_tpu.client.conn import StatusError
+from fastdfs_tpu.common.fileid import decode_file_id
+from tests.harness import start_storage, start_tracker
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+
+S1_IP, S2_IP = "127.0.0.2", "127.0.0.3"
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tracker = start_tracker(tmp_path_factory.mktemp("tracker"))
+    taddr = f"127.0.0.1:{tracker.port}"
+    s1 = start_storage(tmp_path_factory.mktemp("s1"), trackers=[taddr],
+                       extra=HB, ip=S1_IP)
+    s2 = start_storage(tmp_path_factory.mktemp("s2"), trackers=[taddr],
+                       extra=HB, ip=S2_IP)
+    deadline = time.time() + 15
+    with TrackerClient("127.0.0.1", tracker.port) as t:
+        while time.time() < deadline:
+            groups = t.list_groups()
+            if groups and groups[0]["active"] == 2:
+                break
+            time.sleep(0.2)
+        else:
+            raise RuntimeError(f"storages never joined: {groups}")
+    yield {"tracker": tracker, "s1": s1, "s2": s2}
+    for d in (s1, s2, tracker):
+        d.stop()
+
+
+@pytest.fixture()
+def fdfs(cluster):
+    return FdfsClient(f"127.0.0.1:{cluster['tracker'].port}")
+
+
+def _peer_of(cluster, fid):
+    """(source_daemon, replica_daemon) for a file id."""
+    src_ip = decode_file_id(fid)[1].source_ip
+    if src_ip == S1_IP:
+        return cluster["s1"], cluster["s2"]
+    assert src_ip == S2_IP
+    return cluster["s2"], cluster["s1"]
+
+
+def _poll(fn, timeout=15.0, interval=0.1):
+    """Run fn until it returns non-None/doesn't raise, or time out."""
+    deadline = time.time() + timeout
+    last_exc = None
+    while time.time() < deadline:
+        try:
+            got = fn()
+            if got is not None:
+                return got
+        except Exception as exc:  # noqa: BLE001 — polled condition
+            last_exc = exc
+        time.sleep(interval)
+    if last_exc is not None:
+        raise AssertionError(f"poll timed out; last error: {last_exc!r}")
+    raise AssertionError("poll timed out")
+
+
+def test_upload_replicates_to_peer(cluster, fdfs):
+    data = b"replicate me " * 1000
+    fid = fdfs.upload_buffer(data, ext="bin")
+    _, replica = _peer_of(cluster, fid)
+    got = _poll(lambda: StorageClient(replica.ip, replica.port)
+                .download_to_buffer(fid))
+    assert got == data
+
+
+def test_delete_replicates_to_peer(cluster, fdfs):
+    fid = fdfs.upload_buffer(b"short-lived")
+    _, replica = _peer_of(cluster, fid)
+    _poll(lambda: StorageClient(replica.ip, replica.port)
+          .download_to_buffer(fid))
+    fdfs.delete_file(fid)
+
+    def gone():
+        try:
+            StorageClient(replica.ip, replica.port).download_to_buffer(fid)
+            return None  # still there
+        except StatusError as e:
+            assert e.status == 2
+            return True
+
+    assert _poll(gone)
+
+
+def test_metadata_replicates_to_peer(cluster, fdfs):
+    fid = fdfs.upload_buffer(b"with metadata")
+    fdfs.set_metadata(fid, {"width": "1024", "height": "768"})
+    _, replica = _peer_of(cluster, fid)
+
+    def meta_synced():
+        m = StorageClient(replica.ip, replica.port).get_metadata(fid)
+        return m if m == {"width": "1024", "height": "768"} else None
+
+    assert _poll(meta_synced)
+
+
+def test_tracker_routes_reads_to_replica_after_sync(cluster, fdfs):
+    data = b"read from either"
+    fid = fdfs.upload_buffer(data)
+    _, replica = _peer_of(cluster, fid)
+    _poll(lambda: StorageClient(replica.ip, replica.port)
+          .download_to_buffer(fid))
+
+    # Sync progress reaches the tracker with the next heartbeat (1s here);
+    # after that, fetch routing must round-robin over BOTH servers.
+    def both_routed():
+        with TrackerClient("127.0.0.1", cluster["tracker"].port) as t:
+            picks = {t.query_fetch(fid).ip for _ in range(8)}
+        return picks if picks == {S1_IP, S2_IP} else None
+
+    assert _poll(both_routed)
+    # And the data is identical wherever the tracker sends us.
+    for _ in range(4):
+        assert fdfs.download_to_buffer(fid) == data
+
+
+def test_late_joiner_receives_full_history(tmp_path_factory):
+    """A server added to a live group full-syncs everything that ever
+    happened (upstream: SYNC_DEST_REQ + need_sync_old replay)."""
+    tracker = start_tracker(tmp_path_factory.mktemp("t-late"))
+    taddr = f"127.0.0.1:{tracker.port}"
+    s1 = start_storage(tmp_path_factory.mktemp("s1-late"), trackers=[taddr],
+                       extra=HB, ip=S1_IP)
+    s2 = None
+    try:
+        fdfs = FdfsClient(taddr)
+        _poll(lambda: fdfs.list_groups()[0]["active"] == 1 or None)
+        blobs = {}
+        for i in range(10):
+            data = f"historical file {i}".encode() * 50
+            blobs[fdfs.upload_buffer(data, ext="txt")] = data
+        deleted = list(blobs)[3]
+        fdfs.delete_file(deleted)
+        del blobs[deleted]
+
+        s2 = start_storage(tmp_path_factory.mktemp("s2-late"),
+                           trackers=[taddr], extra=HB, ip=S2_IP)
+
+        def all_synced():
+            c = StorageClient(S2_IP, s2.port)
+            for fid, data in blobs.items():
+                if c.download_to_buffer(fid) != data:
+                    return None
+            return True
+
+        assert _poll(all_synced, timeout=20)
+        # The deleted file must NOT have been resurrected on the late joiner
+        # (its create replays, then its delete replays — order preserved).
+        with pytest.raises(StatusError):
+            StorageClient(S2_IP, s2.port).download_to_buffer(deleted)
+    finally:
+        for d in (s2, s1, tracker):
+            if d is not None:
+                d.stop()
+
+
+def test_mark_files_written(cluster, fdfs):
+    fid = fdfs.upload_buffer(b"cursor check")
+    source, replica = _peer_of(cluster, fid)
+    _poll(lambda: StorageClient(replica.ip, replica.port)
+          .download_to_buffer(fid))
+    # The source's sync thread persists its cursor every batch/idle pass.
+    import glob
+    import os
+    base = None
+    # source daemon base dir == its conf dir (harness layout)
+    with open(os.path.join(os.path.dirname(source.proc.args[1]),
+                           "storage.conf")) as fh:
+        for line in fh:
+            if line.startswith("base_path"):
+                base = line.split("=", 1)[1].strip()
+    marks = glob.glob(os.path.join(base, "data", "sync", "*.mark"))
+    _poll(lambda: glob.glob(
+        os.path.join(base, "data", "sync", "*.mark")) or None)
+    marks = glob.glob(os.path.join(base, "data", "sync", "*.mark"))
+    assert marks, "no .mark cursor files on the source"
+    with open(marks[0]) as fh:
+        idx, off, recs = fh.read().split()
+    assert int(recs) >= 1 and int(off) > 0
