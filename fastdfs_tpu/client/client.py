@@ -88,6 +88,39 @@ class FdfsClient:
         with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
             return s.get_metadata(file_id)
 
+    def upload_appender_buffer(self, data: bytes, ext: str = "",
+                               group: str | None = None) -> str:
+        return self.upload_buffer(data, ext=ext, group=group, appender=True)
+
+    def append_buffer(self, file_id: str, data: bytes) -> None:
+        """Append to an appender file (routed to the source server, like
+        every mutation — reference query_fetch_update update path)."""
+        with self._tracker() as t:
+            tgt = t.query_update(file_id)
+        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+            s.append_buffer(file_id, data)
+
+    def modify_buffer(self, file_id: str, offset: int, data: bytes) -> None:
+        with self._tracker() as t:
+            tgt = t.query_update(file_id)
+        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+            s.modify_buffer(file_id, offset, data)
+
+    def truncate_file(self, file_id: str, new_size: int = 0) -> None:
+        with self._tracker() as t:
+            tgt = t.query_update(file_id)
+        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+            s.truncate_file(file_id, new_size)
+
+    def upload_slave_buffer(self, master_id: str, prefix: str, data: bytes,
+                            ext: str = "") -> str:
+        """Slave files live on the master's server (same name stem ⇒ same
+        group and path), so route via query_update on the master."""
+        with self._tracker() as t:
+            tgt = t.query_update(master_id)
+        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+            return s.upload_slave_buffer(master_id, prefix, data, ext)
+
     def list_groups(self) -> list[dict]:
         with self._tracker() as t:
             return t.list_groups()

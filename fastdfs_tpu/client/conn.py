@@ -24,8 +24,14 @@ class StatusError(ProtocolError):
 class Connection:
     def __init__(self, host: str, port: int, timeout: float = 30.0):
         self.host, self.port = host, port
-        self.sock = socket.create_connection((host, port), timeout=timeout)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.timeout = timeout
+        self.sock = self._connect()
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
 
     def close(self) -> None:
         try:
@@ -43,8 +49,17 @@ class Connection:
 
     def send_request(self, cmd: int, body: bytes = b"",
                      body_len: int | None = None) -> None:
-        self.sock.sendall(pack_header(
-            len(body) if body_len is None else body_len, cmd) + body)
+        # The server closes a connection after an error response that left
+        # request bytes unread (it cannot resync mid-stream).  A request
+        # boundary is the one safe place to reconnect, so retry once — the
+        # same recovery the reference's connection pool performs.
+        hdr = pack_header(len(body) if body_len is None else body_len, cmd)
+        try:
+            self.sock.sendall(hdr + body)
+        except OSError:
+            self.close()
+            self.sock = self._connect()
+            self.sock.sendall(hdr + body)
 
     def send_raw(self, data: bytes) -> None:
         self.sock.sendall(data)

@@ -22,6 +22,7 @@ from fastdfs_tpu.common.protocol import (
     pack_ext_name,
     pack_group_name,
     pack_metadata,
+    pack_prefix_name,
     unpack_group_name,
     unpack_metadata,
 )
@@ -78,6 +79,66 @@ class StorageClient:
             ext = os.path.splitext(path)[1].lstrip(".")[:6]
         with open(path, "rb") as fh:
             return self.upload_buffer(fh.read(), ext=ext, **kw)
+
+    def upload_slave_buffer(self, master_id: str, prefix: str, data: bytes,
+                            ext: str = "") -> str:
+        """Upload a derived file addressed by the master's ID + a prefix
+        (reference storage_upload_slave_file, cmd 21): the slave lands at
+        ``<master stem><prefix>.<ext>`` so clients can reconstruct its ID
+        from the master ID alone.
+
+        Wire: 16B group + 8B master_len + 8B size + 16B prefix + 6B ext +
+        master_name + body.
+        """
+        group, remote = _split_id(master_id)
+        name = remote.encode()
+        body = (pack_group_name(group) + long2buff(len(name))
+                + long2buff(len(data)) + pack_prefix_name(prefix)
+                + pack_ext_name(ext) + name + data)
+        self.conn.send_request(StorageCmd.UPLOAD_SLAVE_FILE, body)
+        resp = self.conn.recv_response("upload_slave")
+        if len(resp) <= GROUP_NAME_MAX_LEN:
+            raise ProtocolError(f"short upload response: {len(resp)}")
+        return (f"{unpack_group_name(resp[:GROUP_NAME_MAX_LEN])}/"
+                f"{resp[GROUP_NAME_MAX_LEN:].decode()}")
+
+    # -- appender-file mutations -------------------------------------------
+
+    def append_buffer(self, file_id: str, data: bytes) -> None:
+        """Append bytes to an appender file (cmd APPEND_FILE).
+
+        Wire: 16B group + 8B name_len + 8B length + name + body.
+        """
+        group, remote = _split_id(file_id)
+        name = remote.encode()
+        body = (pack_group_name(group) + long2buff(len(name))
+                + long2buff(len(data)) + name + data)
+        self.conn.send_request(StorageCmd.APPEND_FILE, body)
+        self.conn.recv_response("append")
+
+    def modify_buffer(self, file_id: str, offset: int, data: bytes) -> None:
+        """Overwrite bytes at ``offset`` inside an appender file (MODIFY_FILE).
+
+        Wire: 16B group + 8B name_len + 8B offset + 8B length + name + body.
+        """
+        group, remote = _split_id(file_id)
+        name = remote.encode()
+        body = (pack_group_name(group) + long2buff(len(name))
+                + long2buff(offset) + long2buff(len(data)) + name + data)
+        self.conn.send_request(StorageCmd.MODIFY_FILE, body)
+        self.conn.recv_response("modify")
+
+    def truncate_file(self, file_id: str, new_size: int = 0) -> None:
+        """Truncate an appender file to ``new_size`` (TRUNCATE_FILE).
+
+        Wire: 16B group + 8B name_len + 8B new_size + name.
+        """
+        group, remote = _split_id(file_id)
+        name = remote.encode()
+        body = (pack_group_name(group) + long2buff(len(name))
+                + long2buff(new_size) + name)
+        self.conn.send_request(StorageCmd.TRUNCATE_FILE, body)
+        self.conn.recv_response("truncate")
 
     # -- downloads ---------------------------------------------------------
 

@@ -55,14 +55,19 @@ _BLOB_STRUCT = struct.Struct(">IIqI")
 # \Z (not $) so trailing newlines never sneak past; whitespace and control
 # characters are excluded from group/ext classes — these strings arrive over
 # the wire and end up in filesystem paths and logs.
+# Prefix/ext character class mirrors the C++ codec (IsExt/IsSlavePrefix in
+# fileid.cc): excludes '/', '.', whitespace AND all control bytes ≤ 0x20
+# plus 0x7F, so both languages accept exactly the same IDs.
+_SAFE = r"[^\s/.\x00-\x20\x7f]"
 _FILE_ID_RE = re.compile(
     r"^(?P<group>[^\s/]{1,16})/M(?P<path>[0-9A-F]{2})/"
     r"(?P<sub1>[0-9A-F]{2})/(?P<sub2>[0-9A-F]{2})/"
-    r"(?P<b64>[A-Za-z0-9_-]{27})(?P<ext>\.[^\s/.]{1,6})?\Z"
+    r"(?P<b64>[A-Za-z0-9_-]{27})(?P<prefix>" + _SAFE + r"{1,16})?"
+    r"(?P<ext>\." + _SAFE + r"{1,6})?\Z"
 )
 _REMOTE_NAME_RE = re.compile(
     r"^M[0-9A-F]{2}/[0-9A-F]{2}/[0-9A-F]{2}/"
-    r"[A-Za-z0-9_-]{27}(\.[^\s/.]{1,6})?\Z"
+    r"[A-Za-z0-9_-]{27}(" + _SAFE + r"{1,16})?(\." + _SAFE + r"{1,6})?\Z"
 )
 
 
@@ -204,12 +209,13 @@ def decode_file_id(
     b64 = m.group("b64")
     blob = _b64decode(b64)
     ip_n, ts, size_field, crc = _BLOB_STRUCT.unpack(blob)
+    prefix = m.group("prefix") or ""
     fid = FileId(
         group=m.group("group"),
         store_path_index=int(m.group("path"), 16),
         subdir1=int(m.group("sub1"), 16),
         subdir2=int(m.group("sub2"), 16),
-        filename=b64 + (m.group("ext") or ""),
+        filename=b64 + prefix + (m.group("ext") or ""),
     )
     expect = subdirs_for_blob(blob, subdir_count)
     if expect != (fid.subdir1, fid.subdir2):
@@ -225,7 +231,9 @@ def decode_file_id(
         uniquifier=(size_field >> _UNIQ_SHIFT) & _UNIQ_MASK,
         appender=bool(size_field & FLAG_APPENDER),
         trunk=bool(size_field & FLAG_TRUNK),
-        slave=bool(size_field & FLAG_SLAVE),
+        # A non-empty prefix after the base64 stem IS the slave marker
+        # (reference: slave names are "<master stem><prefix>.<ext>").
+        slave=bool(size_field & FLAG_SLAVE) or bool(prefix),
     )
     return fid, info
 

@@ -26,6 +26,7 @@ from dataclasses import dataclass
 GROUP_NAME_MAX_LEN = 16          # FDFS_GROUP_NAME_MAX_LEN
 IP_ADDRESS_SIZE = 16             # IP_ADDRESS_SIZE (dotted-quad + NUL)
 FILE_EXT_NAME_MAX_LEN = 6        # FDFS_FILE_EXT_NAME_MAX_LEN
+FILE_PREFIX_MAX_LEN = 16         # FDFS_FILE_PREFIX_MAX_LEN (slave names)
 FILENAME_BASE64_LENGTH = 27      # FDFS_FILENAME_BASE64_LENGTH (20 raw bytes)
 STORAGE_ID_MAX_SIZE = 16
 PROTO_PKG_LEN_SIZE = 8
@@ -249,6 +250,20 @@ def pack_ext_name(ext: str) -> bytes:
     if len(raw) > FILE_EXT_NAME_MAX_LEN:
         raise ValueError(f"ext name too long: {ext!r}")
     return raw.ljust(FILE_EXT_NAME_MAX_LEN, b"\x00")
+
+
+def pack_prefix_name(prefix: str) -> bytes:
+    """Fixed-width slave-file prefix field (16 bytes, NUL-padded).
+
+    Character rules mirror the C++ codec's IsSlavePrefix (fileid.cc): no
+    separators, dots, whitespace, or control bytes — the prefix lands in
+    filesystem paths, so reject client-side what the server would refuse.
+    """
+    raw = prefix.encode("utf-8")
+    if not raw or len(raw) > FILE_PREFIX_MAX_LEN or any(
+            b <= 0x20 or b == 0x7F or b in b"/." for b in raw):
+        raise ValueError(f"bad slave prefix: {prefix!r}")
+    return raw.ljust(FILE_PREFIX_MAX_LEN, b"\x00")
 
 
 def unpack_ext_name(buf: bytes) -> str:

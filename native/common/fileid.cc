@@ -62,6 +62,18 @@ bool IsExt(std::string_view s) {  // without dot
   return true;
 }
 
+// Slave-file name prefix appended to the master's 27-char base64 stem
+// (reference: FDFS_FILE_PREFIX_MAX_LEN; names like "<stem>_150x150.jpg").
+bool IsSlavePrefix(std::string_view s) {
+  if (s.empty() || s.size() > static_cast<size_t>(kFilePrefixMaxLen))
+    return false;
+  for (char c : s) {
+    uint8_t u = static_cast<uint8_t>(c);
+    if (c == '/' || c == '.' || u <= 0x20 || u == 0x7F) return false;
+  }
+  return true;
+}
+
 }  // namespace
 
 std::string FileIdParts::RemoteFilename() const {
@@ -118,16 +130,23 @@ std::optional<FileIdParts> DecodeFileId(std::string_view id, int subdir_count) {
   if (!IsHex2(mpart) || !IsHex2(sub1p) || !IsHex2(sub2p)) return std::nullopt;
   std::string_view name = rest.substr(10);
 
-  std::string_view b64 = name;
+  std::string_view stem = name;  // name without .ext
   std::string_view ext;
   size_t dot = name.find('.');
   if (dot != std::string_view::npos) {
-    b64 = name.substr(0, dot);
+    stem = name.substr(0, dot);
     ext = name.substr(dot + 1);
     if (!IsExt(ext)) return std::nullopt;
     if (ext.find('.') != std::string_view::npos) return std::nullopt;
   }
+  // Slave-file names carry a prefix after the master's fixed-length base64
+  // stem: "<27 b64 chars><prefix>[.ext]".
+  if (stem.size() < static_cast<size_t>(kFilenameBase64Length))
+    return std::nullopt;
+  std::string_view b64 = stem.substr(0, kFilenameBase64Length);
+  std::string_view prefix = stem.substr(kFilenameBase64Length);
   if (!IsB64Name(b64)) return std::nullopt;
+  if (!prefix.empty() && !IsSlavePrefix(prefix)) return std::nullopt;
 
   std::string blob;
   if (!Base64UrlDecode(b64, &blob) || blob.size() != kBlobSize)
@@ -140,6 +159,7 @@ std::optional<FileIdParts> DecodeFileId(std::string_view id, int subdir_count) {
   parts.subdir1 = std::stoi(std::string(sub1p), nullptr, 16);
   parts.subdir2 = std::stoi(std::string(sub2p), nullptr, 16);
   parts.filename = std::string(name);
+  parts.prefix = std::string(prefix);
 
   int want1, want2;
   SubdirsForBlob(p, subdir_count, &want1, &want2);
@@ -153,7 +173,7 @@ std::optional<FileIdParts> DecodeFileId(std::string_view id, int subdir_count) {
   parts.uniquifier = static_cast<int>((size_field >> kUniqShift) & kUniqMask);
   parts.appender = (size_field & kFlagAppender) != 0;
   parts.trunk = (size_field & kFlagTrunk) != 0;
-  parts.slave = (size_field & kFlagSlave) != 0;
+  parts.slave = (size_field & kFlagSlave) != 0 || !prefix.empty();
   return parts;
 }
 
@@ -167,13 +187,17 @@ std::optional<std::string> LocalPath(std::string_view base_path,
       !IsHex2(rf.substr(7, 2)))
     return std::nullopt;
   std::string_view name = rf.substr(10);
-  std::string_view b64 = name;
+  std::string_view stem = name;
   size_t dot = name.find('.');
   if (dot != std::string_view::npos) {
-    b64 = name.substr(0, dot);
+    stem = name.substr(0, dot);
     if (!IsExt(name.substr(dot + 1))) return std::nullopt;
   }
-  if (!IsB64Name(b64)) return std::nullopt;
+  if (stem.size() < static_cast<size_t>(kFilenameBase64Length))
+    return std::nullopt;
+  if (!IsB64Name(stem.substr(0, kFilenameBase64Length))) return std::nullopt;
+  std::string_view prefix = stem.substr(kFilenameBase64Length);
+  if (!prefix.empty() && !IsSlavePrefix(prefix)) return std::nullopt;
 
   std::string out(base_path);
   out += "/data/";

@@ -25,7 +25,8 @@ struct FileIdParts {
   int store_path_index = 0;
   int subdir1 = 0;
   int subdir2 = 0;
-  std::string filename;  // 27 b64 chars + optional .ext
+  std::string filename;  // 27 b64 chars + optional slave prefix + .ext
+  std::string prefix;    // slave-file name prefix ("" for master files)
 
   // Decoded blob facts.
   uint32_t source_ip = 0;  // packed IPv4

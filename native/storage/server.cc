@@ -185,10 +185,7 @@ void StorageServer::OnConnEvent(int fd, uint32_t events) {
 }
 
 void StorageServer::CloseConn(Conn* c) {
-  if (c->file_fd >= 0) {
-    close(c->file_fd);
-    if (!c->tmp_path.empty()) unlink(c->tmp_path.c_str());
-  }
+  AbortFileOp(c);  // disconnect mid-op: same rollback as an explicit error
   if (c->send_fd >= 0) close(c->send_fd);
   int fd = c->fd;
   loop_.Del(fd);
@@ -197,6 +194,7 @@ void StorageServer::CloseConn(Conn* c) {
 }
 
 void StorageServer::ResetForNextRequest(Conn* c) {
+  ReleaseBusy(c);  // normally already released; guards every exit path
   c->state = ConnState::kRecvHeader;
   c->header_got = 0;
   c->fixed.clear();
@@ -214,6 +212,9 @@ void StorageServer::ResetForNextRequest(Conn* c) {
   c->replica_op = 0;
   c->sync_remote.clear();
   c->range_offset = 0;
+  c->slave_prefix.clear();
+  c->discarding = false;
+  c->pending_status = 0;
   c->out.clear();
   c->out_off = 0;
   c->send_fd = -1;
@@ -221,11 +222,57 @@ void StorageServer::ResetForNextRequest(Conn* c) {
   c->send_remaining = 0;
 }
 
+bool StorageServer::AcquireBusy(Conn* c, const std::string& remote) {
+  if (busy_files_.count(remote)) return false;
+  busy_files_.insert(remote);
+  c->busy_key = remote;
+  return true;
+}
+
+void StorageServer::ReleaseBusy(Conn* c) {
+  if (!c->busy_key.empty()) {
+    busy_files_.erase(c->busy_key);
+    c->busy_key.clear();
+  }
+}
+
+void StorageServer::AbortFileOp(Conn* c) {
+  // Failure/abort cleanup for any in-flight file write.  In-place range
+  // writes (append/modify, no tmp file) roll back appends by truncating to
+  // the pre-op size so a retry or replica replay never sees partial bytes;
+  // a partial modify rewrites existing content and has no undo, but the
+  // binlog record is only emitted on success, so replicas stay on the old
+  // content either way.
+  if (c->file_fd >= 0) {
+    if (c->tmp_path.empty()) {
+      auto cmd = static_cast<StorageCmd>(c->cmd);
+      if (cmd == StorageCmd::kAppendFile || cmd == StorageCmd::kSyncAppendFile)
+        ftruncate(c->file_fd, c->range_offset);
+    }
+    close(c->file_fd);
+    c->file_fd = -1;
+    if (!c->tmp_path.empty()) {
+      unlink(c->tmp_path.c_str());
+      c->tmp_path.clear();
+    }
+  }
+  ReleaseBusy(c);
+}
+
 void StorageServer::RespondError(Conn* c, uint8_t status) {
   // An early error can leave unread request bytes on the socket; a keepalive
-  // reuse would parse them as the next header.  Close after flushing.
-  if (c->body_consumed < c->pkg_len) c->close_after_send = true;
-  Respond(c, status);
+  // reuse would parse them as the next header.  Drain and discard them, then
+  // send the error — the connection stays usable (the reference's client
+  // pool would otherwise have to reconnect after every rejected request).
+  AbortFileOp(c);
+  if (c->body_consumed >= c->pkg_len) {
+    Respond(c, status);
+    return;
+  }
+  c->discarding = true;
+  c->pending_status = status;
+  c->file_remaining = c->pkg_len - c->body_consumed;
+  c->state = ConnState::kRecvFile;
 }
 
 void StorageServer::Respond(Conn* c, uint8_t status, const std::string& body) {
@@ -366,22 +413,25 @@ void StorageServer::ReadConn(Conn* c) {
           CloseConn(c);
           return;
         }
-        if (c->hashing) {
-          c->sha1.Update(buf, static_cast<size_t>(n));
-        }
-        c->crc32 = Crc32(buf, static_cast<size_t>(n), c->crc32);
-        ssize_t w = write(c->file_fd, buf, static_cast<size_t>(n));
-        if (w != n) {
-          FDFS_LOG_ERROR("tmp write failed: %s", strerror(errno));
-          close(c->file_fd);
-          c->file_fd = -1;
-          unlink(c->tmp_path.c_str());
-          RespondError(c, static_cast<uint8_t>(5 /*EIO*/));
-          return;
-        }
+        // Account before any failure handling: these bytes left the socket,
+        // so a drain triggered below must not wait for them again.
         c->file_remaining -= n;
         c->body_consumed += n;
-        stats_.bytes_uploaded += n;
+        if (!c->discarding) {
+          if (c->hashing) {
+            c->sha1.Update(buf, static_cast<size_t>(n));
+          }
+          c->crc32 = Crc32(buf, static_cast<size_t>(n), c->crc32);
+          ssize_t w = write(c->file_fd, buf, static_cast<size_t>(n));
+          if (w != n) {
+            FDFS_LOG_ERROR("tmp write failed: %s", strerror(errno));
+            RespondError(c, static_cast<uint8_t>(5 /*EIO*/));
+            // RespondError flips to drain mode unless the body is already
+            // fully consumed, in which case it responded and reset.
+            continue;
+          }
+          stats_.bytes_uploaded += n;
+        }
         if (c->file_remaining == 0) {
           OnFileComplete(c);
           // Response path takes over; stop reading until reset.
@@ -433,6 +483,22 @@ void StorageServer::OnHeaderComplete(Conn* c) {
       c->fixed_need = 40;  // 16B group + 8B name_len + 8B off + 8B len, name
       c->state = ConnState::kRecvFixed;
       return;
+    case StorageCmd::kAppendFile:
+      stats_.total_append++;
+      c->fixed_need = 32;  // 16B group + 8B name_len + 8B append_len, name
+      c->state = ConnState::kRecvFixed;
+      return;
+    case StorageCmd::kModifyFile:
+      stats_.total_append++;
+      c->fixed_need = 40;  // 16B group + 8B name_len + 8B off + 8B len, name
+      c->state = ConnState::kRecvFixed;
+      return;
+    case StorageCmd::kUploadSlaveFile:
+      stats_.total_upload++;
+      // 16B group + 8B master_len + 8B size + 16B prefix + 6B ext, master
+      c->fixed_need = 16 + 8 + 8 + 16 + 6;
+      c->state = ConnState::kRecvFixed;
+      return;
     case StorageCmd::kDownloadFile:
     case StorageCmd::kDeleteFile:
     case StorageCmd::kQueryFileInfo:
@@ -442,6 +508,8 @@ void StorageServer::OnHeaderComplete(Conn* c) {
     case StorageCmd::kSyncCreateLink:
     case StorageCmd::kSyncUpdateFile:
     case StorageCmd::kSyncTruncateFile:
+    case StorageCmd::kTruncateFile:
+    case StorageCmd::kCreateLink:
       if (c->pkg_len > kMaxInlineBody) {
         CloseConn(c);
         return;
@@ -515,11 +583,23 @@ void StorageServer::OnFixedComplete(Conn* c) {
       if (c->state == ConnState::kRecvFile && c->file_remaining == 0)
         OnFileComplete(c);
       return;
+    case StorageCmd::kAppendFile:
+    case StorageCmd::kModifyFile:
+      if (!BeginClientRange(c)) return;
+      if (c->state == ConnState::kRecvFile && c->file_remaining == 0)
+        OnFileComplete(c);
+      return;
+    case StorageCmd::kUploadSlaveFile:
+      if (!BeginSlaveUpload(c)) return;
+      if (c->state == ConnState::kRecvFile && c->file_remaining == 0)
+        OnFileComplete(c);
+      return;
     case StorageCmd::kSyncUpdateFile:
       HandleSyncUpdate(c);
       return;
     case StorageCmd::kSyncTruncateFile:
-      HandleSyncTruncate(c);
+    case StorageCmd::kTruncateFile:
+      HandleTruncate(c);
       return;
     case StorageCmd::kDownloadFile:
       HandleDownload(c);
@@ -537,37 +617,10 @@ void StorageServer::OnFixedComplete(Conn* c) {
     case StorageCmd::kGetMetadata:
       HandleGetMetadata(c);
       return;
-    case StorageCmd::kSyncCreateLink: {
-      // body: 16B group + target_remote \x02 src_remote
-      const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
-      if (c->fixed.size() <= static_cast<size_t>(kGroupNameMaxLen)) {
-        Respond(c, 22);
-        return;
-      }
-      std::string group = GroupFromField(p);
-      std::string rest = c->fixed.substr(kGroupNameMaxLen);
-      size_t sep = rest.find('\x02');
-      if (group != cfg_.group_name || sep == std::string::npos) {
-        Respond(c, 22);
-        return;
-      }
-      std::string target = rest.substr(0, sep);
-      std::string src = rest.substr(sep + 1);
-      std::string tl = ResolveLocal(group, target);
-      std::string sl = ResolveLocal(group, src);
-      if (tl.empty() || sl.empty()) {
-        Respond(c, 22);
-        return;
-      }
-      StoreManager::EnsureParentDirs(tl);
-      if (link(sl.c_str(), tl.c_str()) != 0 && errno != EEXIST) {
-        Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
-        return;
-      }
-      binlog_.Append('l', target, src);
-      Respond(c, 0);
+    case StorageCmd::kSyncCreateLink:
+    case StorageCmd::kCreateLink:
+      HandleCreateLink(c);
       return;
-    }
     default:
       Respond(c, 22);
       return;
@@ -575,17 +628,36 @@ void StorageServer::OnFixedComplete(Conn* c) {
 }
 
 void StorageServer::OnFileComplete(Conn* c) {
+  if (c->discarding) {  // rejected request: body drained, send the verdict
+    Respond(c, c->pending_status);
+    return;
+  }
   auto cmd = static_cast<StorageCmd>(c->cmd);
-  if (cmd == StorageCmd::kSyncAppendFile || cmd == StorageCmd::kSyncModifyFile) {
+  if (cmd == StorageCmd::kSyncAppendFile || cmd == StorageCmd::kSyncModifyFile ||
+      cmd == StorageCmd::kAppendFile || cmd == StorageCmd::kModifyFile) {
     close(c->file_fd);
     c->file_fd = -1;
+    ReleaseBusy(c);
     char extra[48];
     snprintf(extra, sizeof(extra), "%lld %lld",
              static_cast<long long>(c->range_offset),
              static_cast<long long>(c->file_size));
-    binlog_.Append(cmd == StorageCmd::kSyncAppendFile ? 'a' : 'm',
+    bool append =
+        cmd == StorageCmd::kSyncAppendFile || cmd == StorageCmd::kAppendFile;
+    bool source =
+        cmd == StorageCmd::kAppendFile || cmd == StorageCmd::kModifyFile;
+    binlog_.Append(source ? (append ? kBinlogOpAppend : kBinlogOpModify)
+                          : (append ? 'a' : 'm'),
                    c->sync_remote, extra);
+    if (source) {
+      stats_.success_append++;
+      stats_.last_source_update = time(nullptr);
+    }
     Respond(c, 0);
+    return;
+  }
+  if (cmd == StorageCmd::kUploadSlaveFile) {
+    FinishSlaveUpload(c);
     return;
   }
   if (cmd == StorageCmd::kSyncCreateFile) {
@@ -972,6 +1044,12 @@ bool StorageServer::BeginSyncRange(Conn* c) {
     RespondError(c, 22);
     return false;
   }
+  if (!AcquireBusy(c, c->sync_remote)) {
+    // The sync sender retries transiently-failed records, so EBUSY here
+    // (client append racing the replay) resolves itself on the next pass.
+    RespondError(c, 16 /*EBUSY*/);
+    return false;
+  }
   int fd = open(local.c_str(), O_WRONLY);
   if (fd < 0) {
     RespondError(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
@@ -1032,8 +1110,12 @@ void StorageServer::HandleSyncUpdate(Conn* c) {
   Respond(c, 0);
 }
 
-// SYNC_TRUNCATE_FILE replica replay.
-void StorageServer::HandleSyncTruncate(Conn* c) {
+// TRUNCATE_FILE (client, appender files only) and SYNC_TRUNCATE_FILE
+// (replica replay).  Same wire: 16B group + 8B name_len + 8B new_size +
+// name.  Reference: storage_service.c:storage_server_truncate_file().
+void StorageServer::HandleTruncate(Conn* c) {
+  bool source = static_cast<StorageCmd>(c->cmd) == StorageCmd::kTruncateFile;
+  if (source) stats_.total_append++;
   const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
   if (c->fixed.size() < 32) {
     Respond(c, 22);
@@ -1053,19 +1135,205 @@ void StorageServer::HandleSyncTruncate(Conn* c) {
     Respond(c, 22);
     return;
   }
+  if (source) {
+    // Only appender files are mutable (reference: EPERM on regular files).
+    auto parts = DecodeFileId(group + "/" + remote);
+    if (!parts.has_value() || !parts->appender) {
+      Respond(c, 1 /*EPERM*/);
+      return;
+    }
+  }
   if (truncate(local.c_str(), new_size) != 0) {
     Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
     return;
   }
-  binlog_.Append('t', remote, std::to_string(new_size));
+  binlog_.Append(source ? kBinlogOpTruncate : 't', remote,
+                 std::to_string(new_size));
+  if (source) {
+    stats_.success_append++;
+    stats_.last_source_update = time(nullptr);
+  }
   Respond(c, 0);
 }
 
-void StorageServer::HandleAppend(Conn* c) {
-  // Appender-file append lands in a later milestone (SURVEY §2.2 appender
-  // ops); the opcode is reserved and politely refused for now.
-  stats_.total_append++;
-  Respond(c, 22);
+// APPEND_FILE / MODIFY_FILE: client-side mutation of an appender file.
+// APPEND wire:  16B group + 8B name_len + 8B length + name + bytes.
+// MODIFY wire:  16B group + 8B name_len + 8B offset + 8B length + name +
+// bytes.  Reference: storage_service.c:storage_append_file() /
+// storage_modify_file().
+bool StorageServer::BeginClientRange(Conn* c) {
+  bool is_append = static_cast<StorageCmd>(c->cmd) == StorageCmd::kAppendFile;
+  const size_t prefix = is_append ? 32 : 40;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  int64_t name_len = GetInt64BE(p + kGroupNameMaxLen);
+  int64_t offset = is_append ? -1 : GetInt64BE(p + kGroupNameMaxLen + 8);
+  int64_t length = GetInt64BE(p + kGroupNameMaxLen + (is_append ? 8 : 16));
+  if (c->fixed.size() == prefix) {
+    if (name_len <= 0 || name_len > 512 || length < 0 ||
+        (!is_append && offset < 0) ||
+        c->pkg_len != static_cast<int64_t>(prefix) + name_len + length) {
+      RespondError(c, 22);
+      return false;
+    }
+    c->fixed_need = prefix + static_cast<size_t>(name_len);
+    return true;  // keep reading the name
+  }
+  std::string group = GroupFromField(p);
+  c->sync_remote = c->fixed.substr(prefix);
+  std::string local = ResolveLocal(group, c->sync_remote);
+  auto parts = DecodeFileId(group + "/" + c->sync_remote);
+  if (local.empty() || !parts.has_value() || !parts->appender) {
+    RespondError(c, 1 /*EPERM: not an appender file*/);
+    return false;
+  }
+  if (!AcquireBusy(c, c->sync_remote)) {
+    RespondError(c, 16 /*EBUSY: concurrent mutation of this file*/);
+    return false;
+  }
+  int fd = open(local.c_str(), O_WRONLY);
+  if (fd < 0) {
+    RespondError(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
+    return false;
+  }
+  struct stat st;
+  fstat(fd, &st);
+  if (offset < 0) offset = st.st_size;  // append lands at EOF
+  if (offset > st.st_size) {
+    close(fd);
+    RespondError(c, 22);
+    return false;
+  }
+  if (lseek(fd, offset, SEEK_SET) != offset) {
+    close(fd);
+    RespondError(c, 5);
+    return false;
+  }
+  c->file_fd = fd;
+  c->range_offset = offset;
+  c->file_size = length;
+  c->file_remaining = length;
+  c->state = ConnState::kRecvFile;
+  return true;
+}
+
+// UPLOAD_SLAVE_FILE: store a derived file under the master's name stem
+// plus a prefix ("<stem><prefix>.<ext>"), so clients can address it from
+// the master ID alone.  Wire: 16B group + 8B master_len + 8B size +
+// 16B prefix + 6B ext + master_name + bytes.  Reference:
+// storage_service.c:storage_upload_slave_file() (cmd 21).
+bool StorageServer::BeginSlaveUpload(Conn* c) {
+  const size_t kPrefixLen = 16 + 8 + 8 + 16 + 6;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  int64_t master_len = GetInt64BE(p + kGroupNameMaxLen);
+  int64_t size = GetInt64BE(p + kGroupNameMaxLen + 8);
+  if (c->fixed.size() == kPrefixLen) {
+    if (master_len <= 0 || master_len > 512 || size < 0 ||
+        c->pkg_len != static_cast<int64_t>(kPrefixLen) + master_len + size) {
+      RespondError(c, 22);
+      return false;
+    }
+    c->fixed_need = kPrefixLen + static_cast<size_t>(master_len);
+    return true;
+  }
+  std::string group = GroupFromField(p);
+  c->slave_prefix = GetFixedField(p + kGroupNameMaxLen + 16, 16);
+  c->ext = ExtFromField(p + kGroupNameMaxLen + 32);
+  std::string master = c->fixed.substr(kPrefixLen);
+  std::string master_local = ResolveLocal(group, master);
+  auto parts = DecodeFileId(group + "/" + master);
+  struct stat st;
+  if (master_local.empty() || !parts.has_value() ||
+      c->slave_prefix.empty() || !parts->prefix.empty() /*no slave-of-slave*/ ||
+      stat(master_local.c_str(), &st) != 0) {
+    RespondError(c, 22);
+    return false;
+  }
+  // Derived name: master path with "<stem><prefix>[.ext]" as the filename.
+  size_t slash = master.rfind('/');
+  size_t dot = master.find('.', slash);
+  std::string stem = dot == std::string::npos ? master : master.substr(0, dot);
+  c->sync_remote = stem + c->slave_prefix;
+  if (!c->ext.empty()) c->sync_remote += "." + c->ext;
+  if (ResolveLocal(group, c->sync_remote).empty()) {
+    RespondError(c, 22);  // prefix/ext failed name validation
+    return false;
+  }
+  sscanf(c->sync_remote.c_str(), "M%02X/", &c->store_path_index);
+  c->file_size = size;
+  c->file_remaining = size;
+  c->crc32 = 0;
+  c->hashing = false;
+  c->tmp_path = store_.NewTmpPath(c->store_path_index);
+  c->file_fd = open(c->tmp_path.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644);
+  if (c->file_fd < 0) {
+    RespondError(c, 5);
+    return false;
+  }
+  c->state = ConnState::kRecvFile;
+  return true;
+}
+
+void StorageServer::FinishSlaveUpload(Conn* c) {
+  close(c->file_fd);
+  c->file_fd = -1;
+  std::string local = ResolveLocal(cfg_.group_name, c->sync_remote);
+  StoreManager::EnsureParentDirs(local);
+  // A slave name is deterministic — refuse to silently clobber an existing
+  // slave (reference returns EEXIST).
+  struct stat st;
+  if (stat(local.c_str(), &st) == 0) {
+    unlink(c->tmp_path.c_str());
+    c->tmp_path.clear();
+    Respond(c, 17 /*EEXIST*/);
+    return;
+  }
+  if (rename(c->tmp_path.c_str(), local.c_str()) != 0) {
+    unlink(c->tmp_path.c_str());
+    c->tmp_path.clear();
+    Respond(c, 5);
+    return;
+  }
+  c->tmp_path.clear();
+  binlog_.Append(kBinlogOpCreate, c->sync_remote);
+  stats_.success_upload++;
+  stats_.last_source_update = time(nullptr);
+  Respond(c, 0, PackGroupField(cfg_.group_name) + c->sync_remote);
+}
+
+// CREATE_LINK (client, cmd 20) and SYNC_CREATE_LINK (replica replay).
+// Body: 16B group + target_remote \x02 src_remote; creates a hard link so
+// the target shares the source's bytes (the dedup path uses the same
+// mechanism internally).
+void StorageServer::HandleCreateLink(Conn* c) {
+  bool source = static_cast<StorageCmd>(c->cmd) == StorageCmd::kCreateLink;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  if (c->fixed.size() <= static_cast<size_t>(kGroupNameMaxLen)) {
+    Respond(c, 22);
+    return;
+  }
+  std::string group = GroupFromField(p);
+  std::string rest = c->fixed.substr(kGroupNameMaxLen);
+  size_t sep = rest.find('\x02');
+  if (group != cfg_.group_name || sep == std::string::npos) {
+    Respond(c, 22);
+    return;
+  }
+  std::string target = rest.substr(0, sep);
+  std::string src = rest.substr(sep + 1);
+  std::string tl = ResolveLocal(group, target);
+  std::string sl = ResolveLocal(group, src);
+  if (tl.empty() || sl.empty()) {
+    Respond(c, 22);
+    return;
+  }
+  StoreManager::EnsureParentDirs(tl);
+  if (link(sl.c_str(), tl.c_str()) != 0 && errno != EEXIST) {
+    Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
+    return;
+  }
+  binlog_.Append(source ? kBinlogOpLink : 'l', target, src);
+  if (source) stats_.last_source_update = time(nullptr);
+  Respond(c, 0);
 }
 
 }  // namespace fdfs

@@ -17,6 +17,7 @@
 #include <memory>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 
 #include "common/bytes.h"
 #include "common/protocol_gen.h"
@@ -104,6 +105,10 @@ class StorageServer {
     uint8_t replica_op = 0;     // set for SYNC_* ops (no binlog re-emit)
     std::string sync_remote;    // target remote filename for SYNC_CREATE
     int64_t range_offset = 0;   // append/modify replay write position
+    std::string slave_prefix;   // UPLOAD_SLAVE_FILE name prefix
+    bool discarding = false;    // draining a rejected request's body bytes
+    uint8_t pending_status = 0; // error to send once the drain completes
+    std::string busy_key;       // in-place-mutated file this conn holds
     // send
     std::string out;
     size_t out_off = 0;
@@ -120,8 +125,14 @@ class StorageServer {
   void CloseConn(Conn* c);
   void ResetForNextRequest(Conn* c);
   void Respond(Conn* c, uint8_t status, const std::string& body = "");
-  // Error response that may leave unread request bytes: closes after send.
+  // Error response that may leave unread request bytes: drains them (the
+  // connection stays usable) and rolls back any in-flight file write.
   void RespondError(Conn* c, uint8_t status);
+  void AbortFileOp(Conn* c);
+  // Per-file writer exclusion for streamed in-place mutations: two appends
+  // to one appender file interleaving across epoll rounds would corrupt it.
+  bool AcquireBusy(Conn* c, const std::string& remote);
+  void ReleaseBusy(Conn* c);
   void RespondFile(Conn* c, uint8_t status, int file_fd, int64_t offset,
                    int64_t count);
 
@@ -138,10 +149,13 @@ class StorageServer {
   void HandleQueryFileInfo(Conn* c);
   void HandleSetMetadata(Conn* c);
   void HandleGetMetadata(Conn* c);
-  void HandleAppend(Conn* c);
+  bool BeginClientRange(Conn* c);   // APPEND_FILE / MODIFY_FILE
+  void HandleTruncate(Conn* c);     // TRUNCATE_FILE (+ sync replay path)
+  bool BeginSlaveUpload(Conn* c);   // UPLOAD_SLAVE_FILE prefix parse
+  void FinishSlaveUpload(Conn* c);
+  void HandleCreateLink(Conn* c);   // CREATE_LINK + SYNC_CREATE_LINK
   void HandleSyncUpdate(Conn* c);
   bool BeginSyncRange(Conn* c);     // SYNC_APPEND / SYNC_MODIFY prefix parse
-  void HandleSyncTruncate(Conn* c);
 
   std::string MintFileId(int spi, int64_t size, uint32_t crc,
                          const std::string& ext, bool appender);
@@ -159,6 +173,7 @@ class StorageServer {
   EventLoop loop_;
   int listen_fd_ = -1;
   std::unordered_map<int, std::unique_ptr<Conn>> conns_;
+  std::unordered_set<std::string> busy_files_;  // remote names being mutated
   StorageStats stats_;
   std::string my_ip_;
 };

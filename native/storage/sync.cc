@@ -398,6 +398,7 @@ bool SyncManager::ReplayRange(int fd, uint8_t cmd, const BinlogRecord& rec,
   close(local_fd);
   uint8_t status = 0;
   if (!ok || !SyncRpcHeaderOnly(fd, &status, kIoTimeoutMs)) return false;
+  if (status == 16 /*EBUSY: peer-side writer lock*/) return false;  // retry
   if (status != 0) {
     FDFS_LOG_WARN("sync range %s @%lld+%lld: peer status %d — skipping",
                   rec.filename.c_str(), static_cast<long long>(offset),

@@ -1,0 +1,218 @@
+"""Appender files, modify/truncate, slave files (SURVEY §2.2 appender ops,
+§3.5 call stack; reference storage_service.c:storage_append_file() /
+storage_modify_file() / storage_server_truncate_file() /
+storage_upload_slave_file())."""
+
+import time
+
+import pytest
+
+from fastdfs_tpu.client import FdfsClient, StorageClient, TrackerClient
+from fastdfs_tpu.client.conn import StatusError
+from fastdfs_tpu.common.fileid import decode_file_id
+from tests.harness import start_storage, start_tracker
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+S1_IP, S2_IP = "127.0.0.2", "127.0.0.3"
+
+
+@pytest.fixture(scope="module")
+def storage(tmp_path_factory):
+    d = start_storage(tmp_path_factory.mktemp("appender_storage"))
+    yield d
+    d.stop()
+
+
+@pytest.fixture()
+def sc(storage):
+    with StorageClient("127.0.0.1", storage.port) as c:
+        yield c
+
+
+def test_appender_lifecycle(sc):
+    fid = sc.upload_buffer(b"part1-", ext="log", appender=True)
+    _, info = decode_file_id(fid)
+    assert info.appender
+
+    sc.append_buffer(fid, b"part2-")
+    sc.append_buffer(fid, b"part3")
+    assert sc.download_to_buffer(fid) == b"part1-part2-part3"
+
+    # modify: overwrite bytes inside the file
+    sc.modify_buffer(fid, 0, b"PART1")
+    assert sc.download_to_buffer(fid)[:5] == b"PART1"
+
+    # truncate back to the first section
+    sc.truncate_file(fid, 6)
+    assert sc.download_to_buffer(fid) == b"PART1-"
+
+    # truncate to zero, append again
+    sc.truncate_file(fid, 0)
+    sc.append_buffer(fid, b"fresh")
+    assert sc.download_to_buffer(fid) == b"fresh"
+
+
+def test_append_empty_and_large(sc):
+    fid = sc.upload_buffer(b"", appender=True)
+    sc.append_buffer(fid, b"")  # zero-byte append is a no-op, not an error
+    big = bytes(range(256)) * 4096  # 1 MiB
+    sc.append_buffer(fid, big)
+    assert sc.download_to_buffer(fid) == big
+
+
+def test_mutations_rejected_on_regular_file(sc):
+    fid = sc.upload_buffer(b"immutable")
+    for op in (lambda: sc.append_buffer(fid, b"x"),
+               lambda: sc.modify_buffer(fid, 0, b"x"),
+               lambda: sc.truncate_file(fid, 0)):
+        with pytest.raises(StatusError) as ei:
+            op()
+        assert ei.value.status == 1  # EPERM
+
+
+def test_concurrent_append_excluded(storage, sc):
+    """Two appends interleaving across epoll rounds would corrupt the file;
+    the server holds a per-file writer lock and rejects the second with
+    EBUSY while the first is mid-stream."""
+    import socket
+
+    from fastdfs_tpu.common.protocol import (
+        StorageCmd, long2buff, pack_group_name, pack_header)
+
+    fid = sc.upload_buffer(b"base-", appender=True)
+    group, remote = fid.split("/", 1)
+    name = remote.encode()
+    payload = b"X" * 4096
+    body = (pack_group_name(group) + long2buff(len(name))
+            + long2buff(len(payload)) + name + payload)
+
+    a = socket.create_connection(("127.0.0.1", storage.port), timeout=5)
+    try:
+        # A: header + fixed prefix + name + HALF the payload, then stall.
+        cut = len(body) - 2048
+        a.sendall(pack_header(len(body), StorageCmd.APPEND_FILE) + body[:cut])
+        time.sleep(0.3)  # let the server enter the streaming state
+        # B: full append on another connection -> EBUSY (16)
+        with pytest.raises(StatusError) as ei:
+            sc.append_buffer(fid, b"loser")
+        assert ei.value.status == 16
+        # A finishes; its append lands intact.
+        a.sendall(body[cut:])
+        hdr = b""
+        while len(hdr) < 10:
+            hdr += a.recv(10 - len(hdr))
+        assert hdr[9] == 0
+    finally:
+        a.close()
+    assert sc.download_to_buffer(fid) == b"base-" + payload
+    # lock released: appends work again
+    sc.append_buffer(fid, b"-tail")
+    assert sc.download_to_buffer(fid).endswith(b"-tail")
+
+
+def test_modify_beyond_eof_rejected(sc):
+    fid = sc.upload_buffer(b"12345", appender=True)
+    with pytest.raises(StatusError) as ei:
+        sc.modify_buffer(fid, 100, b"x")
+    assert ei.value.status == 22
+
+
+def test_slave_upload_download(sc):
+    master = sc.upload_buffer(b"master bytes", ext="jpg")
+    slave = sc.upload_slave_buffer(master, "_150x150", b"thumb bytes",
+                                   ext="jpg")
+    # Deterministic name: master stem + prefix + ext.
+    stem = master.rsplit(".", 1)[0]
+    assert slave == f"{stem}_150x150.jpg"
+    assert sc.download_to_buffer(slave) == b"thumb bytes"
+    _, info = decode_file_id(slave)
+    assert info.slave
+    # master unchanged
+    assert sc.download_to_buffer(master) == b"master bytes"
+
+
+def test_slave_duplicate_and_missing_master(sc):
+    master = sc.upload_buffer(b"m", ext="png")
+    sc.upload_slave_buffer(master, "-t", b"x", ext="png")
+    with pytest.raises(StatusError) as ei:
+        sc.upload_slave_buffer(master, "-t", b"y", ext="png")
+    assert ei.value.status == 17  # EEXIST
+    # no slave-of-slave
+    with pytest.raises(StatusError):
+        sc.upload_slave_buffer(f"{master.rsplit('.', 1)[0]}-t.png", "-u",
+                               b"z", ext="png")
+    # missing master
+    bogus = master.replace("group1", "group1")  # same id, delete first
+    sc.delete_file(master)
+    with pytest.raises(StatusError):
+        sc.upload_slave_buffer(bogus, "-v", b"z", ext="png")
+
+
+def _poll(fn, timeout=15.0):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            got = fn()
+            if got is not None:
+                return got
+        except Exception as exc:  # noqa: BLE001
+            last = exc
+        time.sleep(0.1)
+    raise AssertionError(f"poll timed out; last: {last!r}")
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tracker = start_tracker(tmp_path_factory.mktemp("app_tracker"))
+    taddr = f"127.0.0.1:{tracker.port}"
+    s1 = start_storage(tmp_path_factory.mktemp("app_s1"), trackers=[taddr],
+                       extra=HB, ip=S1_IP)
+    s2 = start_storage(tmp_path_factory.mktemp("app_s2"), trackers=[taddr],
+                       extra=HB, ip=S2_IP)
+    with TrackerClient("127.0.0.1", tracker.port) as t:
+        _poll(lambda: (t.list_groups() and
+                       t.list_groups()[0]["active"] == 2) or None)
+    yield {"tracker": tracker, "s1": s1, "s2": s2}
+    for d in (s1, s2, tracker):
+        d.stop()
+
+
+def _replica_of(cluster, fid):
+    src_ip = decode_file_id(fid)[1].source_ip
+    return cluster["s2"] if src_ip == S1_IP else cluster["s1"]
+
+
+def test_append_modify_truncate_replicate(cluster):
+    fdfs = FdfsClient(f"127.0.0.1:{cluster['tracker'].port}")
+    fid = fdfs.upload_appender_buffer(b"AAA-", ext="log")
+    fdfs.append_buffer(fid, b"BBB-")
+    fdfs.modify_buffer(fid, 0, b"aaa")
+    fdfs.truncate_file(fid, 7)
+    want = b"aaa-BBB"
+    assert fdfs.download_to_buffer(fid) == want
+
+    replica = _replica_of(cluster, fid)
+
+    def synced():
+        got = StorageClient(replica.ip, replica.port).download_to_buffer(fid)
+        return True if got == want else None
+
+    assert _poll(synced)
+
+
+def test_slave_replicates(cluster):
+    fdfs = FdfsClient(f"127.0.0.1:{cluster['tracker'].port}")
+    master = fdfs.upload_buffer(b"the master", ext="jpg")
+    slave = fdfs.upload_slave_buffer(master, "_small", b"the slave",
+                                     ext="jpg")
+    replica = _replica_of(cluster, master)
+
+    def synced():
+        c = StorageClient(replica.ip, replica.port)
+        if c.download_to_buffer(slave) == b"the slave" and \
+           c.download_to_buffer(master) == b"the master":
+            return True
+        return None
+
+    assert _poll(synced)
