@@ -88,11 +88,72 @@ class TrackerClient:
         """Which server takes mutations (metadata/delete) for this file."""
         return self._query_fetch(TrackerCmd.SERVICE_QUERY_UPDATE, file_id)
 
+    def _parse_target_list(self, resp: bytes) -> tuple[str, int, list[FetchTarget]]:
+        """ALL-variant reply: 16B group + 1B path idx + 8B count + count x
+        (16B ip + 8B port)."""
+        if len(resp) < GROUP_NAME_MAX_LEN + 9:
+            raise ProtocolError(f"short target-list response: {len(resp)}")
+        group = unpack_group_name(resp[:16])
+        path_idx = resp[16]
+        count = buff2long(resp, 17)
+        rec = IP_ADDRESS_SIZE + 8
+        if count < 0 or count > (len(resp) - 25) // rec:
+            raise ProtocolError(f"bad target-list count {count}")
+        targets = []
+        for i in range(count):
+            off = 25 + i * rec
+            targets.append(FetchTarget(
+                ip=resp[off:off + 16].rstrip(b"\x00").decode(),
+                port=buff2long(resp, off + 16)))
+        return group, path_idx, targets
+
+    def query_store_all(self, group: str | None = None) \
+            -> tuple[str, list[FetchTarget]]:
+        """All writable storages of the picked group (reference:
+        QUERY_STORE_WITHOUT_GROUP_ALL 106 / WITH_GROUP_ALL 107 — the client
+        retries among them)."""
+        if group is None:
+            self.conn.send_request(
+                TrackerCmd.SERVICE_QUERY_STORE_WITHOUT_GROUP_ALL)
+        else:
+            self.conn.send_request(
+                TrackerCmd.SERVICE_QUERY_STORE_WITH_GROUP_ALL,
+                pack_group_name(group))
+        g, _, targets = self._parse_target_list(
+            self.conn.recv_response("query_store_all"))
+        return g, targets
+
+    def query_fetch_all(self, file_id: str) -> list[FetchTarget]:
+        """Every replica currently safe to read this file (reference:
+        QUERY_FETCH_ALL 105)."""
+        group, _, remote = file_id.partition("/")
+        self.conn.send_request(TrackerCmd.SERVICE_QUERY_FETCH_ALL,
+                               pack_group_name(group) + remote.encode())
+        _, _, targets = self._parse_target_list(
+            self.conn.recv_response("query_fetch_all"))
+        return targets
+
     # -- monitor / ops (JSON responses) ------------------------------------
 
     def list_groups(self) -> list[dict]:
         self.conn.send_request(TrackerCmd.SERVER_LIST_ALL_GROUPS)
         return json.loads(self.conn.recv_response("list_groups") or b"[]")
+
+    def list_one_group(self, group: str) -> dict:
+        self.conn.send_request(TrackerCmd.SERVER_LIST_ONE_GROUP,
+                               pack_group_name(group))
+        return json.loads(self.conn.recv_response("list_one_group") or b"{}")
+
+    def get_parameters(self) -> dict[str, str]:
+        """Cluster-global storage parameters (storage_param_getter.c)."""
+        self.conn.send_request(TrackerCmd.STORAGE_PARAMETER_REQ)
+        text = self.conn.recv_response("get_parameters").decode()
+        out: dict[str, str] = {}
+        for line in text.splitlines():
+            key, _, value = line.partition("=")
+            if key and _:
+                out[key] = value
+        return out
 
     def list_storages(self, group: str) -> list[dict]:
         self.conn.send_request(TrackerCmd.SERVER_LIST_STORAGE,

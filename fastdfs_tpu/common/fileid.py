@@ -58,7 +58,11 @@ _BLOB_STRUCT = struct.Struct(">IIqI")
 # Prefix/ext character class mirrors the C++ codec (IsExt/IsSlavePrefix in
 # fileid.cc): excludes '/', '.', whitespace AND all control bytes ≤ 0x20
 # plus 0x7F, so both languages accept exactly the same IDs.
-_SAFE = r"[^\s/.\x00-\x20\x7f]"
+# Byte-class mirror of native/common/fileid.cc (IsSlavePrefix/ext check):
+# reject '/', '.', control bytes, space, DEL — and nothing else.  A Unicode
+# class like \s would also reject U+00A0/U+3000 etc., splitting the codec
+# from the C++ side, which compares raw bytes only.
+_SAFE = r"[^/.\x00-\x20\x7f]"
 _FILE_ID_RE = re.compile(
     r"^(?P<group>[^\s/]{1,16})/M(?P<path>[0-9A-F]{2})/"
     r"(?P<sub1>[0-9A-F]{2})/(?P<sub2>[0-9A-F]{2})/"

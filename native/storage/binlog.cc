@@ -101,6 +101,12 @@ bool BinlogWriter::OpenCurrent(std::string* error) {
 bool BinlogWriter::Append(char op, const std::string& filename,
                           const std::string& extra) {
   if (fd_ < 0) return false;
+  // in_flight_ MUST cover the stamp→write window; see Quiescent().
+  struct InFlight {
+    std::atomic<int>* n;
+    explicit InFlight(std::atomic<int>* p) : n(p) { n->fetch_add(1); }
+    ~InFlight() { n->fetch_sub(1); }
+  } guard(&in_flight_);
   BinlogRecord rec;
   rec.timestamp = static_cast<int64_t>(time(nullptr));
   rec.op = op;

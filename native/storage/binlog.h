@@ -8,6 +8,7 @@
 // replays are lowercase.
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <optional>
 #include <string>
@@ -46,6 +47,11 @@ class BinlogWriter {
   int file_index() const { return file_index_; }
   void Flush();
   void Close();
+  // True when no Append sits between its timestamp capture and its write()
+  // completing — the only window where a record stamped in a PAST second
+  // can still be invisible to a reader at EOF.  Sync threads gate their
+  // caught-up "synced through now-1" reports on this.
+  bool Quiescent() const { return in_flight_.load() == 0; }
 
  private:
   bool OpenCurrent(std::string* error);
@@ -54,6 +60,7 @@ class BinlogWriter {
   int file_index_ = 0;
   int64_t offset_ = 0;
   int fd_ = -1;
+  std::atomic<int> in_flight_{0};
 };
 
 // Sequential reader with a persistent cursor (mark file).

@@ -95,6 +95,7 @@ bool StorageServer::Init(std::string* error) {
     scbs.report = [this](const std::string& ip, int port, int64_t ts) {
       if (reporter_ != nullptr) reporter_->ReportSyncProgress(ip, port, ts);
     };
+    scbs.binlog_quiescent = [this]() { return binlog_.Quiescent(); };
     sync_ = std::make_unique<SyncManager>(cfg_, std::move(scbs));
     reporter_ = std::make_unique<TrackerReporter>(
         cfg_, [this](int64_t out[20]) { stats_.Snapshot(out); },
@@ -476,29 +477,24 @@ void StorageServer::OnHeaderComplete(Conn* c) {
       return;
     case StorageCmd::kSyncCreateFile:
       c->fixed_need = 32;  // 16B group + 8B name_len + 8B size, then name
-      c->state = ConnState::kRecvFixed;
-      return;
+      break;
     case StorageCmd::kSyncAppendFile:
     case StorageCmd::kSyncModifyFile:
       c->fixed_need = 40;  // 16B group + 8B name_len + 8B off + 8B len, name
-      c->state = ConnState::kRecvFixed;
-      return;
+      break;
     case StorageCmd::kAppendFile:
       stats_.total_append++;
       c->fixed_need = 32;  // 16B group + 8B name_len + 8B append_len, name
-      c->state = ConnState::kRecvFixed;
-      return;
+      break;
     case StorageCmd::kModifyFile:
       stats_.total_append++;
       c->fixed_need = 40;  // 16B group + 8B name_len + 8B off + 8B len, name
-      c->state = ConnState::kRecvFixed;
-      return;
+      break;
     case StorageCmd::kUploadSlaveFile:
       stats_.total_upload++;
       // 16B group + 8B master_len + 8B size + 16B prefix + 6B ext, master
       c->fixed_need = 16 + 8 + 8 + 16 + 6;
-      c->state = ConnState::kRecvFixed;
-      return;
+      break;
     case StorageCmd::kDownloadFile:
     case StorageCmd::kDeleteFile:
     case StorageCmd::kQueryFileInfo:
@@ -526,6 +522,14 @@ void StorageServer::OnHeaderComplete(Conn* c) {
       RespondError(c, 22 /*EINVAL*/);
       return;
   }
+  // Fixed-prefix commands that broke out of the switch: the declared body
+  // must at least cover the fixed prefix, or the reader would swallow the
+  // next pipelined request's header as fixed data (protocol desync).
+  if (c->pkg_len < static_cast<int64_t>(c->fixed_need)) {
+    RespondError(c, 22 /*EINVAL*/);
+    return;
+  }
+  c->state = ConnState::kRecvFixed;
 }
 
 void StorageServer::OnFixedComplete(Conn* c) {
@@ -1142,6 +1146,14 @@ void StorageServer::HandleTruncate(Conn* c) {
       Respond(c, 1 /*EPERM*/);
       return;
     }
+  }
+  // A truncate racing a mid-stream append/modify on the same file would
+  // punch holes past the new EOF and desync the binlog from reality; the
+  // per-file busy lock covers every mutation, truncate included.
+  // (Released by ResetForNextRequest on every exit path.)
+  if (!AcquireBusy(c, remote)) {
+    Respond(c, 16 /*EBUSY*/);
+    return;
   }
   if (truncate(local.c_str(), new_size) != 0) {
     Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));

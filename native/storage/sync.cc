@@ -162,6 +162,21 @@ void SyncManager::WorkerMain(Worker* w) {
         reader.SaveMark();
         since_save = 0;
       }
+      // Caught-up progress report: the peer has everything this source
+      // produced through the PREVIOUS second.  `now` itself would race an
+      // in-flight upload (binlog appends are unbuffered write()s, so a
+      // record invisible at this EOF check normally stamps >= now), and
+      // the quiescence gate closes the residual window where an Append
+      // already captured a past-second stamp but hasn't hit the file yet.
+      // Keeps read routing fresh and completes the tracker's full-sync
+      // promotion even when the binlog is empty (upstream: sync_old_done
+      // bookkeeping).
+      int64_t safe = time(nullptr) - 1;
+      if (cbs_.report && safe > w->synced_ts &&
+          (!cbs_.binlog_quiescent || cbs_.binlog_quiescent())) {
+        w->synced_ts = safe;
+        cbs_.report(w->ip, w->port, safe);
+      }
       int wait = std::max(cfg_.sync_interval_ms, 20);
       for (int i = 0; i < wait / 20 && !w->stop; ++i) usleep(20 * 1000);
       continue;

@@ -36,6 +36,9 @@ struct SyncCallbacks {
   // Source-side progress report feeding the tracker's sync-timestamp
   // vectors (TrackerReporter::ReportSyncProgress).
   std::function<void(const std::string& ip, int port, int64_t ts)> report;
+  // BinlogWriter::Quiescent — gates the caught-up wall-clock report (a
+  // stamp captured before an unfinished write could be in a past second).
+  std::function<bool()> binlog_quiescent;
 };
 
 struct SyncPeerState {

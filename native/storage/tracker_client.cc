@@ -149,7 +149,61 @@ bool TrackerReporter::DoJoin(int fd, const std::string&) {
            &status, kTrackerRpcTimeoutMs) ||
       status != 0)
     return false;
-  return ParsePeers(resp);
+  if (!ParsePeers(resp)) return false;
+  DoParameterReq(fd);
+  DoSyncDestReq(fd);
+  return true;
+}
+
+void TrackerReporter::DoSyncDestReq(int fd) {
+  // Ask who should full-sync us (tracker side decides WAIT_SYNC→SYNCING→
+  // ACTIVE; replication itself is source-driven, so the answer is
+  // informational here — the negotiation is what arms the promotion).
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  PutFixedField(&body, my_ip(), kIpAddressSize);
+  AppendInt64(&body, cfg_.port);
+  std::string resp;
+  uint8_t status;
+  if (!Rpc(fd, static_cast<uint8_t>(TrackerCmd::kStorageSyncDestReq), body,
+           &resp, &status, kTrackerRpcTimeoutMs) ||
+      status != 0)
+    return;
+  if (resp.size() >= kIpAddressSize + 16) {
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(resp.data());
+    FDFS_LOG_INFO("full-sync source assigned: %s:%lld until_ts=%lld",
+                  GetFixedField(p, kIpAddressSize).c_str(),
+                  static_cast<long long>(GetInt64BE(p + kIpAddressSize)),
+                  static_cast<long long>(GetInt64BE(p + kIpAddressSize + 8)));
+  }
+}
+
+void TrackerReporter::DoParameterReq(int fd) {
+  std::string resp;
+  uint8_t status;
+  if (!Rpc(fd, static_cast<uint8_t>(TrackerCmd::kStorageParameterReq), "",
+           &resp, &status, kTrackerRpcTimeoutMs) ||
+      status != 0)
+    return;
+  std::map<std::string, std::string> params;
+  size_t pos = 0;
+  while (pos < resp.size()) {
+    size_t nl = resp.find('\n', pos);
+    std::string line = resp.substr(pos, nl == std::string::npos
+                                            ? std::string::npos
+                                            : nl - pos);
+    pos = nl == std::string::npos ? resp.size() : nl + 1;
+    size_t eq = line.find('=');
+    if (eq != std::string::npos && eq > 0)
+      params[line.substr(0, eq)] = line.substr(eq + 1);
+  }
+  std::lock_guard<std::mutex> lk(mu_);
+  cluster_params_ = std::move(params);
+}
+
+std::map<std::string, std::string> TrackerReporter::cluster_params() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return cluster_params_;
 }
 
 bool TrackerReporter::DoBeat(int fd) {

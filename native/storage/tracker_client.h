@@ -9,6 +9,7 @@
 #include <atomic>
 #include <cstdint>
 #include <functional>
+#include <map>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -45,12 +46,17 @@ class TrackerReporter {
                           int64_t ts);
   std::string my_ip() const;
   std::vector<PeerInfo> peers() const;
+  // Cluster-global params fetched from the tracker at join
+  // (storage_param_getter.c analogue); empty until first successful join.
+  std::map<std::string, std::string> cluster_params() const;
 
  private:
   void ThreadMain(std::string host, int port);
   bool DoJoin(int fd, const std::string& tracker_host);
   bool DoBeat(int fd);
   bool DoDiskReport(int fd);
+  void DoSyncDestReq(int fd);
+  void DoParameterReq(int fd);
   bool ParsePeers(const std::string& body);
 
   StorageConfig cfg_;
@@ -67,6 +73,7 @@ class TrackerReporter {
     int64_t ts;
   };
   std::vector<SyncProgress> pending_sync_reports_;
+  std::map<std::string, std::string> cluster_params_;
 };
 
 }  // namespace fdfs

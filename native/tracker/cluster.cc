@@ -13,6 +13,8 @@ namespace {
 constexpr int kActive = static_cast<int>(StorageStatus::kActive);
 constexpr int kOffline = static_cast<int>(StorageStatus::kOffline);
 constexpr int kDeleted = static_cast<int>(StorageStatus::kDeleted);
+constexpr int kWaitSync = static_cast<int>(StorageStatus::kWaitSync);
+constexpr int kSyncing = static_cast<int>(StorageStatus::kSyncing);
 }  // namespace
 
 int GroupInfo::ActiveCount() const {
@@ -65,7 +67,14 @@ std::optional<std::vector<StorageNode>> Cluster::Join(
   node.ip = ip;
   node.port = port;
   node.store_path_count = store_path_count;
-  node.status = kActive;
+  // A brand-new server in a non-empty group must full-sync before serving
+  // (WAIT_SYNC; promoted via SyncDestReq/SyncReport).  A known server
+  // re-joining keeps an in-flight sync state; anything else goes ACTIVE.
+  if (fresh && g.storages.size() > 1) {
+    node.status = kWaitSync;
+  } else if (node.status != kWaitSync && node.status != kSyncing) {
+    node.status = kActive;
+  }
   node.last_beat = now;
   if (fresh) node.join_time = now;
   FDFS_LOG_INFO("storage %s %s group %s (members=%zu)", addr.c_str(),
@@ -93,7 +102,8 @@ bool Cluster::Beat(const std::string& group, const std::string& ip, int port,
     FDFS_LOG_INFO("storage %s back ONLINE in group %s", n->Addr().c_str(),
                   group.c_str());
   }
-  n->status = kActive;
+  // A beat never promotes a full-syncing server — only sync progress does.
+  if (n->status != kWaitSync && n->status != kSyncing) n->status = kActive;
   if (stats != nullptr)
     memcpy(n->stats, stats, sizeof(int64_t) * kBeatStatCount);
   return true;
@@ -114,6 +124,71 @@ bool Cluster::SyncReport(const std::string& group, const std::string& src,
   if (n == nullptr) return false;
   int64_t& cur = n->synced_from[src];
   if (ts > cur) cur = ts;
+  // Full-sync completion: once the assigned source has replayed history
+  // past the negotiated until-timestamp, the dest starts serving
+  // (upstream: sync_old_done flips in the source's mark, dest→ACTIVE).
+  if ((n->status == kSyncing || n->status == kWaitSync) &&
+      n->sync_src_addr == src && ts >= n->sync_until_ts) {
+    n->status = kActive;
+    FDFS_LOG_INFO("storage %s full-sync complete (src=%s ts=%lld): ACTIVE",
+                  dest.c_str(), src.c_str(), static_cast<long long>(ts));
+  }
+  return true;
+}
+
+int Cluster::SyncDestReq(const std::string& group,
+                         const std::string& dest_addr, int64_t now,
+                         StorageNode* src, int64_t* until_ts) {
+  StorageNode* n = FindNode(group, dest_addr);
+  if (n == nullptr) return -1;
+  if (n->status != kWaitSync && n->status != kSyncing) return 1;  // settled
+  // Source pick: the longest-standing ACTIVE peer (upstream prefers the
+  // server with the greatest sync authority; join order is our proxy).
+  GroupInfo* g = FindGroup(group);
+  const StorageNode* pick = nullptr;
+  for (const auto& [addr, s] : g->storages) {
+    if (addr == dest_addr || s.status != kActive) continue;
+    if (pick == nullptr || s.join_time < pick->join_time) pick = &s;
+  }
+  if (pick == nullptr) {
+    // No ACTIVE peer to copy from — this is effectively the first usable
+    // server in the group; there is nothing to wait for.
+    n->status = kActive;
+    n->sync_src_addr.clear();
+    n->sync_until_ts = 0;
+    return 1;
+  }
+  // Idempotent re-ask keeps the original until_ts (a crashed dest must not
+  // move its own goalpost forward and miss files created in between).
+  if (n->sync_src_addr != pick->Addr() || n->sync_until_ts == 0) {
+    n->sync_src_addr = pick->Addr();
+    n->sync_until_ts = now;
+  }
+  n->status = kSyncing;
+  *src = *pick;
+  *until_ts = n->sync_until_ts;
+  return 0;
+}
+
+std::optional<int64_t> Cluster::SyncSrcReq(const std::string& group,
+                                           const std::string& src_addr,
+                                           const std::string& dest_addr) const {
+  auto git = groups_.find(group);
+  if (git == groups_.end()) return std::nullopt;
+  auto it = git->second.storages.find(dest_addr);
+  if (it == git->second.storages.end()) return std::nullopt;
+  if (it->second.sync_src_addr != src_addr) return std::nullopt;
+  return it->second.sync_until_ts;
+}
+
+bool Cluster::SyncNotify(const std::string& group,
+                         const std::string& dest_addr) {
+  StorageNode* n = FindNode(group, dest_addr);
+  if (n == nullptr) return false;
+  if (n->status == kWaitSync || n->status == kSyncing) {
+    n->status = kActive;
+    FDFS_LOG_INFO("storage %s promoted ACTIVE by sync notify", dest_addr.c_str());
+  }
   return true;
 }
 
@@ -127,6 +202,35 @@ int Cluster::CheckAlive(int64_t now, int64_t timeout_s) {
         FDFS_LOG_WARN("storage %s in group %s OFFLINE (silent %llds)",
                       addr.c_str(), gname.c_str(),
                       static_cast<long long>(now - s.last_beat));
+      }
+    }
+    // A syncing dest whose assigned source died would otherwise wait
+    // forever (promotion requires a report FROM that source).  Re-point it
+    // at a live peer; if it has become the group's only member (operator
+    // deleted the dead source), there is nothing left to copy — promote.
+    for (auto& [addr, s] : g.storages) {
+      if (s.status != kSyncing && s.status != kWaitSync) continue;
+      if (g.storages.size() == 1) {
+        s.status = kActive;
+        s.sync_src_addr.clear();
+        FDFS_LOG_WARN("storage %s promoted ACTIVE: sole group member",
+                      addr.c_str());
+        continue;
+      }
+      if (s.sync_src_addr.empty()) continue;  // negotiation not started yet
+      auto src_it = g.storages.find(s.sync_src_addr);
+      if (src_it != g.storages.end() && src_it->second.status == kActive)
+        continue;
+      const StorageNode* pick = nullptr;
+      for (const auto& [a2, s2] : g.storages) {
+        if (a2 == addr || s2.status != kActive) continue;
+        if (pick == nullptr || s2.join_time < pick->join_time) pick = &s2;
+      }
+      if (pick != nullptr) {
+        FDFS_LOG_WARN("full-sync source %s for %s is gone: reassigned to %s",
+                      s.sync_src_addr.c_str(), addr.c_str(),
+                      pick->Addr().c_str());
+        s.sync_src_addr = pick->Addr();  // original until_ts stays
       }
     }
   }
@@ -181,19 +285,13 @@ std::optional<StoreTarget> Cluster::QueryStore(const std::string& group_hint) {
   return t;
 }
 
-std::optional<StoreTarget> Cluster::QueryFetch(const std::string& group,
-                                               const std::string& remote) {
-  GroupInfo* g = FindGroup(group);
-  if (g == nullptr) return std::nullopt;
-  auto parts = DecodeFileId(group + "/" + remote);
-  if (!parts.has_value()) return std::nullopt;
-  std::string source_ip = UnpackIp(parts->source_ip);
-  int64_t create_ts = parts->create_timestamp;
-
-  // Candidates: the source server itself, or any replica whose synced_from
-  // the source has passed the file's create time (SURVEY §3.2 routing).
+// Candidates for a read: the source server itself, or any replica whose
+// synced_from the source has passed the file's create time (SURVEY §3.2
+// routing).  Shared by the ONE (round-robin pick) and ALL variants.
+static std::vector<const StorageNode*> FetchCandidates(
+    const GroupInfo& g, const std::string& source_ip, int64_t create_ts) {
   std::vector<const StorageNode*> ok;
-  for (const auto& [addr, s] : g->storages) {
+  for (const auto& [addr, s] : g.storages) {
     if (s.status != kActive) continue;
     if (s.ip == source_ip) {
       ok.push_back(&s);
@@ -206,6 +304,17 @@ std::optional<StoreTarget> Cluster::QueryFetch(const std::string& group,
       }
     }
   }
+  return ok;
+}
+
+std::optional<StoreTarget> Cluster::QueryFetch(const std::string& group,
+                                               const std::string& remote) {
+  GroupInfo* g = FindGroup(group);
+  if (g == nullptr) return std::nullopt;
+  auto parts = DecodeFileId(group + "/" + remote);
+  if (!parts.has_value()) return std::nullopt;
+  auto ok = FetchCandidates(*g, UnpackIp(parts->source_ip),
+                            parts->create_timestamp);
   if (ok.empty()) return std::nullopt;
   const StorageNode* pick = ok[g->rr_read++ % ok.size()];
   StoreTarget t;
@@ -213,6 +322,44 @@ std::optional<StoreTarget> Cluster::QueryFetch(const std::string& group,
   t.ip = pick->ip;
   t.port = pick->port;
   return t;
+}
+
+std::vector<StoreTarget> Cluster::QueryFetchAll(const std::string& group,
+                                                const std::string& remote) {
+  std::vector<StoreTarget> out;
+  GroupInfo* g = FindGroup(group);
+  if (g == nullptr) return out;
+  auto parts = DecodeFileId(group + "/" + remote);
+  if (!parts.has_value()) return out;
+  for (const StorageNode* s :
+       FetchCandidates(*g, UnpackIp(parts->source_ip),
+                       parts->create_timestamp)) {
+    StoreTarget t;
+    t.group = group;
+    t.ip = s->ip;
+    t.port = s->port;
+    out.push_back(std::move(t));
+  }
+  return out;
+}
+
+std::vector<StoreTarget> Cluster::QueryStoreAll(const std::string& group_hint) {
+  // Same group pick as QueryStore, but every ACTIVE member is returned
+  // (upstream QUERY_STORE_*_ALL: client chooses / retries among them).
+  std::vector<StoreTarget> out;
+  auto one = QueryStore(group_hint);
+  if (!one.has_value()) return out;
+  GroupInfo* g = FindGroup(one->group);
+  for (const auto& [addr, s] : g->storages) {
+    if (s.status != kActive) continue;
+    StoreTarget t;
+    t.group = g->name;
+    t.ip = s.ip;
+    t.port = s.port;
+    t.store_path_index = 0xFF;
+    out.push_back(std::move(t));
+  }
+  return out;
 }
 
 std::optional<StoreTarget> Cluster::QueryUpdate(const std::string& group,
@@ -257,21 +404,30 @@ static void AppendStorageJson(std::string* out, const StorageNode& s) {
   *out += buf;
 }
 
+static std::string GroupJson(const GroupInfo& g) {
+  char buf[256];
+  std::snprintf(buf, sizeof(buf),
+                "{\"name\":\"%s\",\"members\":%zu,\"active\":%d,"
+                "\"free_mb\":%lld}",
+                g.name.c_str(), g.storages.size(), g.ActiveCount(),
+                static_cast<long long>(g.FreeMb()));
+  return buf;
+}
+
 std::string Cluster::GroupsJson() const {
   std::string out = "[";
   bool first = true;
   for (const auto& [name, g] : groups_) {
     if (!first) out += ",";
     first = false;
-    char buf[256];
-    std::snprintf(buf, sizeof(buf),
-                  "{\"name\":\"%s\",\"members\":%zu,\"active\":%d,"
-                  "\"free_mb\":%lld}",
-                  name.c_str(), g.storages.size(), g.ActiveCount(),
-                  static_cast<long long>(g.FreeMb()));
-    out += buf;
+    out += GroupJson(g);
   }
   return out + "]";
+}
+
+std::string Cluster::OneGroupJson(const std::string& group) const {
+  auto it = groups_.find(group);
+  return it == groups_.end() ? "{}" : GroupJson(it->second);
 }
 
 std::string Cluster::StoragesJson(const std::string& group) const {
@@ -309,6 +465,10 @@ bool Cluster::Save(const std::string& path) const {
       for (const auto& [src, ts] : s.synced_from)
         fprintf(f, "sync %s %s %lld\n", addr.c_str(), src.c_str(),
                 static_cast<long long>(ts));
+      if (!s.sync_src_addr.empty())
+        fprintf(f, "syncsrc %s %s %lld\n", addr.c_str(),
+                s.sync_src_addr.c_str(),
+                static_cast<long long>(s.sync_until_ts));
     }
   }
   fclose(f);
@@ -359,6 +519,15 @@ bool Cluster::Load(const std::string& path) {
       auto it = groups_[cur_group].storages.find(a);
       if (it != groups_[cur_group].storages.end())
         it->second.synced_from[b] = ts;
+      continue;
+    }
+    if (sscanf(line, "syncsrc %255s %255s %lld", a, b, &ts) == 3 &&
+        !cur_group.empty()) {
+      auto it = groups_[cur_group].storages.find(a);
+      if (it != groups_[cur_group].storages.end()) {
+        it->second.sync_src_addr = b;
+        it->second.sync_until_ts = ts;
+      }
     }
   }
   fclose(f);

@@ -7,11 +7,14 @@
 // are routed only to replicas whose sync timestamp from the file's source
 // server has passed the file's create time (sync-timestamp vectors).
 //
-// Honest divergence from upstream: a joining server goes straight to
-// ACTIVE instead of INIT/WAIT_SYNC/SYNCING — read safety is carried
-// entirely by the sync-timestamp routing rule (a new replica has no
-// synced_from entries, so it serves only files it sourced itself until
-// peers report sync progress).
+// Status lifecycle (tracker_mem.c join/offline state machine): a brand-new
+// server joining a group that already has members enters WAIT_SYNC; its
+// SYNC_DEST_REQ picks a source peer + until-timestamp (WAIT_SYNC→SYNCING);
+// it is promoted to ACTIVE once the source's sync reports pass the
+// until-timestamp (upstream: sync_old_done in the source's mark file) or on
+// an explicit SYNC_NOTIFY.  Read safety is additionally carried by the
+// sync-timestamp routing rule (a replica serves only files whose source
+// has reported sync progress past the file's create time).
 #pragma once
 
 #include <cstdint>
@@ -36,6 +39,10 @@ struct StorageNode {
   int64_t stats[kBeatStatCount] = {0};
   // "ip:port" of a source peer -> timestamp this node has synced up to.
   std::map<std::string, int64_t> synced_from;
+  // Full-sync negotiation (SYNC_DEST_REQ): assigned source + the timestamp
+  // this node must sync past before promotion to ACTIVE.
+  std::string sync_src_addr;
+  int64_t sync_until_ts = 0;
 
   std::string Addr() const { return ip + ":" + std::to_string(port); }
 };
@@ -81,15 +88,33 @@ class Cluster {
   int CheckAlive(int64_t now, int64_t timeout_s);
   bool DeleteStorage(const std::string& group, const std::string& addr);
 
+  // -- full-sync negotiation (tracker_deal_storage_sync_* analogues) -----
+  // New server asks who should full-sync it.  Returns: 0 = source assigned
+  // (*src/*until filled, dest WAIT_SYNC→SYNCING); 1 = no source needed
+  // (first server in group; dest promoted ACTIVE); -1 = unknown dest.
+  int SyncDestReq(const std::string& group, const std::string& dest_addr,
+                  int64_t now, StorageNode* src, int64_t* until_ts);
+  // Source peer asks whether it is the assigned full-sync source for dest.
+  std::optional<int64_t> SyncSrcReq(const std::string& group,
+                                    const std::string& src_addr,
+                                    const std::string& dest_addr) const;
+  // Dest (or its source) declares old-data sync done: promote to ACTIVE.
+  bool SyncNotify(const std::string& group, const std::string& dest_addr);
+
   // -- routing (tracker_get_writable_storage & co.) ----------------------
   std::optional<StoreTarget> QueryStore(const std::string& group_hint);
   std::optional<StoreTarget> QueryFetch(const std::string& group,
                                         const std::string& remote);
   std::optional<StoreTarget> QueryUpdate(const std::string& group,
                                          const std::string& remote);
+  // ALL-variant queries (cmds 105/106/107): every valid candidate at once.
+  std::vector<StoreTarget> QueryFetchAll(const std::string& group,
+                                         const std::string& remote);
+  std::vector<StoreTarget> QueryStoreAll(const std::string& group_hint);
 
   // -- introspection (fdfs_monitor feed; JSON) ---------------------------
   std::string GroupsJson() const;
+  std::string OneGroupJson(const std::string& group) const;
   std::string StoragesJson(const std::string& group) const;
 
   // -- persistence (tracker_save_storages analogue) ----------------------

@@ -40,6 +40,12 @@ int main(int argc, char** argv) {
       static_cast<int>(ini.GetSeconds("check_active_interval", 100));
   cfg.save_interval_s = static_cast<int>(ini.GetSeconds("save_interval", 30));
   cfg.log_level = ini.GetStr("log_level", "info");
+  cfg.use_trunk_file = ini.GetBool("use_trunk_file", false);
+  cfg.slot_min_size = static_cast<int>(ini.GetInt("slot_min_size", 256));
+  cfg.slot_max_size =
+      static_cast<int>(ini.GetInt("slot_max_size", 16 * 1024 * 1024));
+  cfg.trunk_file_size = ini.GetInt("trunk_file_size", 64LL * 1024 * 1024);
+  cfg.reserved_storage_space_mb = ini.GetInt("reserved_storage_space", 0);
   if (cfg.base_path.empty()) {
     std::fprintf(stderr, "config error: base_path is required\n");
     return 1;

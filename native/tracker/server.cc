@@ -53,6 +53,24 @@ std::string PackFetchTarget(const StoreTarget& t) {
   return out;
 }
 
+// ALL-variant replies: 16B group + 1B path idx + 8B count + count x
+// (16B ip + 8B port).
+std::string PackTargetList(const std::string& group, uint8_t path_idx,
+                           const std::vector<StoreTarget>& ts) {
+  std::string out;
+  PutFixedField(&out, group, kGroupNameMaxLen);
+  out.push_back(static_cast<char>(path_idx));
+  char buf[8];
+  PutInt64BE(static_cast<int64_t>(ts.size()), reinterpret_cast<uint8_t*>(buf));
+  out.append(buf, 8);
+  for (const StoreTarget& t : ts) {
+    PutFixedField(&out, t.ip, kIpAddressSize);
+    PutInt64BE(t.port, reinterpret_cast<uint8_t*>(buf));
+    out.append(buf, 8);
+  }
+  return out;
+}
+
 }  // namespace
 
 TrackerServer::TrackerServer(TrackerConfig cfg) : cfg_(std::move(cfg)) {}
@@ -172,6 +190,98 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       auto t = cluster_->QueryStore(FixedGroup(p));
       if (!t.has_value()) return {2, ""};
       return {0, PackStoreTarget(*t)};
+    }
+
+    case TrackerCmd::kServiceQueryStoreWithoutGroupAll:
+    case TrackerCmd::kServiceQueryStoreWithGroupAll: {
+      std::string hint;
+      if (static_cast<TrackerCmd>(cmd) ==
+          TrackerCmd::kServiceQueryStoreWithGroupAll) {
+        if (body.size() < 16) return {22, ""};
+        hint = FixedGroup(p);
+      }
+      auto ts = cluster_->QueryStoreAll(hint);
+      if (ts.empty()) return {2, ""};
+      return {0, PackTargetList(ts[0].group, 0xFF, ts)};
+    }
+
+    case TrackerCmd::kServiceQueryFetchAll: {
+      if (body.size() < 16 + 10) return {22, ""};
+      std::string group = FixedGroup(p);
+      auto ts = cluster_->QueryFetchAll(group, body.substr(16));
+      if (ts.empty()) return {2, ""};
+      return {0, PackTargetList(group, 0, ts)};
+    }
+
+    case TrackerCmd::kStorageSyncDestReq: {
+      // New server asks for a full-sync source: 16B group + 16B ip + 8B port.
+      // Resp: empty (no source needed) or 16B src_ip + 8B src_port + 8B
+      // until_ts.
+      if (body.size() < 40) return {22, ""};
+      std::string group = FixedGroup(p);
+      std::string dest =
+          FixedIp(p + 16) + ":" + std::to_string(GetInt64BE(p + 32));
+      StorageNode src;
+      int64_t until = 0;
+      int rc = cluster_->SyncDestReq(group, dest, now, &src, &until);
+      if (rc < 0) return {2, ""};
+      if (rc == 1) return {0, ""};
+      std::string out;
+      PutFixedField(&out, src.ip, kIpAddressSize);
+      char buf[8];
+      PutInt64BE(src.port, reinterpret_cast<uint8_t*>(buf));
+      out.append(buf, 8);
+      PutInt64BE(until, reinterpret_cast<uint8_t*>(buf));
+      out.append(buf, 8);
+      return {0, out};
+    }
+
+    case TrackerCmd::kStorageSyncSrcReq: {
+      // Source asks whether it owns dest's full-sync: 16B group + 16B
+      // src_ip + 8B src_port + 16B dest_ip + 8B dest_port.  Resp: 8B
+      // until_ts, or status ENOENT when not the assigned source.
+      if (body.size() < 64) return {22, ""};
+      std::string group = FixedGroup(p);
+      std::string src =
+          FixedIp(p + 16) + ":" + std::to_string(GetInt64BE(p + 32));
+      std::string dest =
+          FixedIp(p + 40) + ":" + std::to_string(GetInt64BE(p + 56));
+      auto until = cluster_->SyncSrcReq(group, src, dest);
+      if (!until.has_value()) return {2, ""};
+      std::string out(8, '\0');
+      PutInt64BE(*until, reinterpret_cast<uint8_t*>(out.data()));
+      return {0, out};
+    }
+
+    case TrackerCmd::kStorageSyncNotify: {
+      // Full-sync done declaration: 16B group + 16B ip + 8B port.
+      if (body.size() < 40) return {22, ""};
+      std::string group = FixedGroup(p);
+      std::string dest =
+          FixedIp(p + 16) + ":" + std::to_string(GetInt64BE(p + 32));
+      if (!cluster_->SyncNotify(group, dest)) return {2, ""};
+      return {0, ""};
+    }
+
+    case TrackerCmd::kStorageParameterReq: {
+      // Cluster-global params every group member must agree on
+      // (storage_param_getter.c).  INI-style text body.
+      char buf[512];
+      std::snprintf(
+          buf, sizeof(buf),
+          "store_lookup=%d\ncheck_active_interval=%d\n"
+          "use_trunk_file=%d\nslot_min_size=%d\nslot_max_size=%d\n"
+          "trunk_file_size=%lld\nreserved_storage_space=%lld\n",
+          cfg_.store_lookup, cfg_.check_active_interval_s,
+          cfg_.use_trunk_file ? 1 : 0, cfg_.slot_min_size, cfg_.slot_max_size,
+          static_cast<long long>(cfg_.trunk_file_size),
+          static_cast<long long>(cfg_.reserved_storage_space_mb));
+      return {0, buf};
+    }
+
+    case TrackerCmd::kServerListOneGroup: {
+      if (body.size() < 16) return {22, ""};
+      return {0, cluster_->OneGroupJson(FixedGroup(p))};
     }
 
     case TrackerCmd::kServiceQueryFetchOne:

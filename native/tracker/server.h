@@ -25,6 +25,13 @@ struct TrackerConfig {
   int check_active_interval_s = 100;
   int save_interval_s = 30;
   std::string log_level = "info";
+  // Cluster-global storage parameters served via kStorageParameterReq
+  // (storage_param_getter.c: every group member must agree on these).
+  bool use_trunk_file = false;
+  int slot_min_size = 256;             // bytes; files below never trunked
+  int slot_max_size = 16 * 1024 * 1024;  // files above stored flat
+  int64_t trunk_file_size = 64LL * 1024 * 1024;
+  int64_t reserved_storage_space_mb = 0;
 };
 
 class TrackerServer {

@@ -147,3 +147,20 @@ def test_encode_enforces_wire_byte_lengths():
         F.encode_file_id("g", 0, "1.2.3.4", 1, 2, 3, ext="éééé")  # 8 bytes
     with pytest.raises(ValueError):
         F.encode_file_id("ééééééééé", 0, "1.2.3.4", 1, 2, 3)  # 18 bytes
+
+
+def test_slave_prefix_unicode_whitespace_parity():
+    # The codec must be a byte-class mirror of the C++ side: U+00A0 (and
+    # other Unicode-only whitespace) is a legal prefix byte sequence there,
+    # so the Python decoder must accept it too (code-review regression:
+    # the old regex used \s in str mode).
+    from fastdfs_tpu.common.fileid import decode_file_id, encode_file_id
+
+    base = encode_file_id("group1", 0, "10.0.0.9", 1700000000, 123, 0xABCD,
+                          ext="jpg", slave=True)
+    stem, ext = base.rsplit(".", 1)
+    fid = stem + "\u00a0x." + ext  # server-minted slave name with NBSP
+    fileid, info = decode_file_id(fid)
+    assert fileid.group == "group1"
+    assert "\u00a0x" in fileid.filename
+    assert info.slave

@@ -176,3 +176,53 @@ def test_keepalive_multiple_requests(client):
         fid = client.upload_buffer(f"keepalive {i}".encode())
         assert client.download_to_buffer(fid) == f"keepalive {i}".encode()
         client.delete_file(fid)
+
+
+def test_short_fixed_prefix_no_desync(storage):
+    # APPEND_FILE whose declared pkg_len is smaller than the fixed prefix
+    # must be rejected and drained — not satisfied by swallowing the next
+    # request's header (code-review regression: fixed_need > pkg_len).
+    from fastdfs_tpu.common.protocol import StorageCmd, long2buff
+    with socket.create_connection(("127.0.0.1", storage.port), timeout=5) as s:
+        body = b"0123456789"  # 10 bytes, but APPEND_FILE prefix needs 32
+        s.sendall(long2buff(len(body)) + bytes([StorageCmd.APPEND_FILE, 0]))
+        s.sendall(body)
+        hdr = b""
+        while len(hdr) < 10:
+            chunk = s.recv(10 - len(hdr))
+            assert chunk, "server closed instead of responding"
+            hdr += chunk
+        assert hdr[9] == 22
+    # connection-level reuse after the rejection
+    with StorageClient("127.0.0.1", storage.port) as c:
+        assert c.active_test()
+
+
+def test_truncate_requires_busy_lock(storage, client):
+    # A truncate issued while another connection streams an append to the
+    # same appender file must get EBUSY, not interleave (code-review
+    # regression: truncate bypassed the per-file busy lock).
+    from fastdfs_tpu.common.protocol import (StorageCmd, long2buff,
+                                             pack_group_name)
+    fid = client.upload_buffer(b"seed", appender=True)
+    group, remote = fid.split("/", 1)
+    name = remote.encode()
+    # Hand-rolled STALLED append: declare 64 payload bytes, send only 8.
+    prefix = pack_group_name(group) + long2buff(len(name)) + long2buff(64)
+    with socket.create_connection(("127.0.0.1", storage.port), timeout=5) as s:
+        s.sendall(long2buff(len(prefix) + len(name) + 64) +
+                  bytes([StorageCmd.APPEND_FILE, 0]) + prefix + name + b"x" * 8)
+        # busy lock is now held by the in-flight append
+        with pytest.raises(StatusError) as ei:
+            client.truncate_file(fid, 0)
+        assert ei.value.status == 16  # EBUSY
+        # finish the append; the lock releases and truncate goes through
+        s.sendall(b"x" * 56)
+        hdr = b""
+        while len(hdr) < 10:
+            chunk = s.recv(10 - len(hdr))
+            assert chunk
+            hdr += chunk
+        assert hdr[9] == 0
+    client.truncate_file(fid, 4)
+    assert client.download_to_buffer(fid) == b"seed"

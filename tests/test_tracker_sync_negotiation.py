@@ -1,0 +1,187 @@
+"""Integration: full-sync negotiation + ALL-variant queries + params.
+
+Reference semantics under test (tracker/tracker_service.c handlers):
+- SYNC_DEST_REQ(87): a brand-new member of a non-empty group enters
+  WAIT_SYNC, is assigned a source peer + until-timestamp (-> SYNCING), and
+  is promoted ACTIVE once the source's sync reports pass the timestamp
+  (upstream: sync_old_done bookkeeping in storage/storage_sync.c marks);
+- SYNC_SRC_REQ(86): only the assigned source gets a non-error answer;
+- QUERY_STORE_*_ALL(106/107) / QUERY_FETCH_ALL(105): every candidate at
+  once (client/tracker_client.c: tracker_query_storage_store_list /
+  tracker_query_storage_fetch_all);
+- LIST_ONE_GROUP(90) and PARAMETER_REQ(76) (storage_param_getter.c).
+"""
+
+import socket
+import struct
+import time
+
+import pytest
+
+from fastdfs_tpu.client import FdfsClient, TrackerClient
+from fastdfs_tpu.common.protocol import (
+    StorageStatus,
+    TrackerCmd,
+    long2buff,
+    pack_group_name,
+)
+from tests.harness import start_storage, start_tracker
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+
+S1_IP, S2_IP = "127.0.0.4", "127.0.0.5"
+
+
+def _wait_active(tracker_port, n, timeout=20):
+    deadline = time.time() + timeout
+    with TrackerClient("127.0.0.1", tracker_port) as t:
+        while time.time() < deadline:
+            groups = t.list_groups()
+            if groups and groups[0]["active"] == n:
+                return
+            time.sleep(0.2)
+    raise RuntimeError(f"never reached {n} active: {groups}")
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tracker = start_tracker(tmp_path_factory.mktemp("tracker"))
+    taddr = f"127.0.0.1:{tracker.port}"
+    s1 = start_storage(tmp_path_factory.mktemp("s1"), trackers=[taddr],
+                       extra=HB, ip=S1_IP)
+    _wait_active(tracker.port, 1)
+    # Seed history BEFORE the second member exists: its full-sync must
+    # carry these files before it may serve reads.
+    fdfs = FdfsClient(taddr)
+    fids = [fdfs.upload_buffer(f"pre-join file {i}".encode(), ext="txt")
+            for i in range(5)]
+    s2 = start_storage(tmp_path_factory.mktemp("s2"), trackers=[taddr],
+                       extra=HB, ip=S2_IP)
+    yield {"tracker": tracker, "s1": s1, "s2": s2, "fids": fids,
+           "taddr": taddr}
+    for d in (s1, s2, tracker):
+        d.stop()
+
+
+def test_new_member_promoted_via_sync_reports(cluster):
+    """The second member must pass through the full-sync state machine and
+    come out ACTIVE without any manual notify."""
+    _wait_active(cluster["tracker"].port, 2)
+    with TrackerClient("127.0.0.1", cluster["tracker"].port) as t:
+        storages = t.list_storages("group1")
+    by_ip = {s["ip"]: s for s in storages}
+    assert by_ip[S2_IP]["status"] == StorageStatus.ACTIVE
+
+
+def test_history_replayed_to_new_member(cluster):
+    _wait_active(cluster["tracker"].port, 2)
+    fdfs = FdfsClient(cluster["taddr"])
+    # Eventually every pre-join file is servable from EITHER replica:
+    # query_fetch_all must list both once sync timestamps pass create times.
+    deadline = time.time() + 15
+    with TrackerClient("127.0.0.1", cluster["tracker"].port) as t:
+        while time.time() < deadline:
+            counts = [len(t.query_fetch_all(fid)) for fid in cluster["fids"]]
+            if all(c == 2 for c in counts):
+                break
+            time.sleep(0.3)
+        else:
+            raise AssertionError(f"replicas never caught up: {counts}")
+    for fid in cluster["fids"]:
+        assert fdfs.download_to_buffer(fid).startswith(b"pre-join file")
+
+
+def test_query_store_all(cluster):
+    _wait_active(cluster["tracker"].port, 2)
+    with TrackerClient("127.0.0.1", cluster["tracker"].port) as t:
+        group, targets = t.query_store_all()
+        assert group == "group1"
+        assert {x.ip for x in targets} == {S1_IP, S2_IP}
+        group, targets = t.query_store_all("group1")
+        assert group == "group1" and len(targets) == 2
+
+
+def test_list_one_group(cluster):
+    with TrackerClient("127.0.0.1", cluster["tracker"].port) as t:
+        g = t.list_one_group("group1")
+        assert g["name"] == "group1" and g["members"] == 2
+        assert t.list_one_group("nope") == {}
+
+
+def test_get_parameters(cluster):
+    with TrackerClient("127.0.0.1", cluster["tracker"].port) as t:
+        params = t.get_parameters()
+    assert params["use_trunk_file"] == "0"
+    assert int(params["trunk_file_size"]) == 64 * 1024 * 1024
+    assert "store_lookup" in params and "slot_min_size" in params
+
+
+def _raw_rpc(port, cmd, body):
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as sk:
+        sk.sendall(long2buff(len(body)) + bytes([cmd, 0]) + body)
+        hdr = b""
+        while len(hdr) < 10:
+            chunk = sk.recv(10 - len(hdr))
+            assert chunk
+            hdr += chunk
+        (length,) = struct.unpack(">q", hdr[:8])
+        status = hdr[9]
+        resp = b""
+        while len(resp) < length:
+            chunk = sk.recv(length - len(resp))
+            assert chunk
+            resp += chunk
+        return status, resp
+
+
+def test_sync_src_req_only_assigned_source(cluster):
+    """SYNC_SRC_REQ answers the assigned source and nobody else."""
+    _wait_active(cluster["tracker"].port, 2)
+    tport = cluster["tracker"].port
+    s1p, s2p = cluster["s1"].port, cluster["s2"].port
+
+    def src_req(src_ip, src_port, dest_ip, dest_port):
+        body = (pack_group_name("group1") +
+                src_ip.encode().ljust(16, b"\x00") + long2buff(src_port) +
+                dest_ip.encode().ljust(16, b"\x00") + long2buff(dest_port))
+        return _raw_rpc(tport, TrackerCmd.STORAGE_SYNC_SRC_REQ, body)
+
+    # s1 was the assigned full-sync source for s2.
+    status, resp = src_req(S1_IP, s1p, S2_IP, s2p)
+    assert status == 0 and len(resp) == 8
+    (until,) = struct.unpack(">q", resp)
+    assert until > 0
+    # The reverse direction was never negotiated.
+    status, _ = src_req(S2_IP, s2p, S1_IP, s1p)
+    assert status != 0
+
+
+def test_sync_notify_promotes(tmp_path_factory):
+    """An explicit SYNC_NOTIFY promotes a stuck syncing member (the escape
+    hatch when the source dies mid-full-sync)."""
+    tracker = start_tracker(tmp_path_factory.mktemp("tn"))
+    taddr = f"127.0.0.1:{tracker.port}"
+    s1 = start_storage(tmp_path_factory.mktemp("sn1"), trackers=[taddr],
+                       extra=HB, ip="127.0.0.6")
+    try:
+        _wait_active(tracker.port, 1)
+        # Fabricate a WAIT_SYNC member by joining a fake storage directly.
+        body = (pack_group_name("group1") +
+                b"127.0.0.7".ljust(16, b"\x00") + long2buff(23000) +
+                long2buff(1))
+        status, _ = _raw_rpc(tracker.port, TrackerCmd.STORAGE_JOIN, body)
+        assert status == 0
+        with TrackerClient("127.0.0.1", tracker.port) as t:
+            by_ip = {s["ip"]: s for s in t.list_storages("group1")}
+            assert by_ip["127.0.0.7"]["status"] == StorageStatus.WAIT_SYNC
+        notify = (pack_group_name("group1") +
+                  b"127.0.0.7".ljust(16, b"\x00") + long2buff(23000))
+        status, _ = _raw_rpc(tracker.port, TrackerCmd.STORAGE_SYNC_NOTIFY,
+                             notify)
+        assert status == 0
+        with TrackerClient("127.0.0.1", tracker.port) as t:
+            by_ip = {s["ip"]: s for s in t.list_storages("group1")}
+            assert by_ip["127.0.0.7"]["status"] == StorageStatus.ACTIVE
+    finally:
+        s1.stop()
+        tracker.stop()
