@@ -76,6 +76,34 @@ _REMOTE_NAME_RE = re.compile(
 
 
 @dataclass(frozen=True)
+class TrunkLocation:
+    """Slot location inside a trunk file (reference: FDFSTrunkFullInfo in
+    storage/trunk_mgr/trunk_shared.h).  Carried in trunk file IDs as an
+    extra 16-char base64 segment after the 27-char stem — the analogue of
+    upstream's longer trunk logic filenames."""
+
+    trunk_id: int    # trunk file number within the store path
+    offset: int      # slot start (its 24-byte header) in the trunk file
+    alloc_size: int  # whole slot size including the header
+
+
+TRUNK_SUFFIX_LENGTH = 16  # base64(12 bytes)
+_TRUNK_STRUCT = struct.Struct(">III")
+
+
+def encode_trunk_suffix(loc: TrunkLocation) -> str:
+    return _b64encode(_TRUNK_STRUCT.pack(loc.trunk_id, loc.offset,
+                                         loc.alloc_size))
+
+
+def decode_trunk_suffix(suffix: str) -> TrunkLocation:
+    if len(suffix) != TRUNK_SUFFIX_LENGTH:
+        raise ValueError(f"bad trunk suffix length: {len(suffix)}")
+    raw = _b64decode(suffix)
+    return TrunkLocation(*_TRUNK_STRUCT.unpack(raw))
+
+
+@dataclass(frozen=True)
 class FileInfo:
     """Decoded identity facts carried inside a file ID."""
 
@@ -87,6 +115,7 @@ class FileInfo:
     appender: bool = False
     trunk: bool = False
     slave: bool = False
+    trunk_loc: TrunkLocation | None = None
 
 
 @dataclass(frozen=True)
@@ -158,6 +187,7 @@ def encode_file_id(
     appender: bool = False,
     trunk: bool = False,
     slave: bool = False,
+    trunk_loc: TrunkLocation | None = None,
     subdir_count: int = DEFAULT_SUBDIR_COUNT,
 ) -> str:
     """Build a file-ID string (reference: storage_gen_filename())."""
@@ -187,9 +217,13 @@ def encode_file_id(
     blob = _BLOB_STRUCT.pack(
         pack_ip(source_ip), create_timestamp & 0xFFFFFFFF, size_field, crc32 & 0xFFFFFFFF
     )
+    if trunk != (trunk_loc is not None):
+        raise ValueError("trunk flag requires trunk_loc (and vice versa)")
     sub1, sub2 = subdirs_for_blob(blob, subdir_count)
     name = _b64encode(blob)
     assert len(name) == FILENAME_BASE64_LENGTH
+    if trunk_loc is not None:
+        name += encode_trunk_suffix(trunk_loc)
     if ext:
         name += "." + ext
     return (
@@ -227,6 +261,17 @@ def decode_file_id(
             f"file id subdirs {fid.subdir1:02X}/{fid.subdir2:02X} do not match "
             f"blob hash {expect[0]:02X}/{expect[1]:02X}"
         )
+    trunk = bool(size_field & FLAG_TRUNK)
+    trunk_loc = None
+    if trunk:
+        # The chars after the stem are the trunk location, not a slave
+        # prefix (disambiguated by the blob flag, as upstream does by the
+        # longer trunk filename length).
+        try:
+            trunk_loc = decode_trunk_suffix(prefix)
+        except (ValueError, binascii.Error) as e:
+            raise ValueError(f"bad trunk suffix in {file_id!r}") from e
+        prefix = ""
     info = FileInfo(
         source_ip=unpack_ip(ip_n),
         create_timestamp=ts,
@@ -234,10 +279,11 @@ def decode_file_id(
         crc32=crc,
         uniquifier=(size_field >> _UNIQ_SHIFT) & _UNIQ_MASK,
         appender=bool(size_field & FLAG_APPENDER),
-        trunk=bool(size_field & FLAG_TRUNK),
+        trunk=trunk,
         # A non-empty prefix after the base64 stem IS the slave marker
         # (reference: slave names are "<master stem><prefix>.<ext>").
-        slave=bool(size_field & FLAG_SLAVE) or bool(prefix),
+        slave=not trunk and (bool(size_field & FLAG_SLAVE) or bool(prefix)),
+        trunk_loc=trunk_loc,
     )
     return fid, info
 

@@ -39,6 +39,15 @@ void PutInt32BE(uint32_t v, uint8_t* out) {
   out[3] = static_cast<uint8_t>(v);
 }
 
+void PutInt16BE(uint16_t v, uint8_t* out) {
+  out[0] = static_cast<uint8_t>(v >> 8);
+  out[1] = static_cast<uint8_t>(v);
+}
+
+uint16_t GetInt16BE(const uint8_t* in) {
+  return static_cast<uint16_t>((static_cast<uint16_t>(in[0]) << 8) | in[1]);
+}
+
 uint32_t GetInt32BE(const uint8_t* in) {
   return (static_cast<uint32_t>(in[0]) << 24) |
          (static_cast<uint32_t>(in[1]) << 16) |

@@ -23,6 +23,8 @@ void PutInt64BE(int64_t v, uint8_t* out);
 int64_t GetInt64BE(const uint8_t* in);
 void PutInt32BE(uint32_t v, uint8_t* out);
 uint32_t GetInt32BE(const uint8_t* in);
+void PutInt16BE(uint16_t v, uint8_t* out);
+uint16_t GetInt16BE(const uint8_t* in);
 
 // -- URL-safe base64, no padding (file-ID codec; 20 bytes -> 27 chars) ----
 std::string Base64UrlEncode(const uint8_t* data, size_t len);

@@ -76,6 +76,27 @@ bool IsSlavePrefix(std::string_view s) {
 
 }  // namespace
 
+std::string EncodeTrunkSuffix(const TrunkLocation& loc) {
+  uint8_t raw[12];
+  PutInt32BE(loc.trunk_id, raw);
+  PutInt32BE(loc.offset, raw + 4);
+  PutInt32BE(loc.alloc_size, raw + 8);
+  return Base64UrlEncode(raw, sizeof(raw));
+}
+
+std::optional<TrunkLocation> DecodeTrunkSuffix(std::string_view suffix) {
+  if (suffix.size() != static_cast<size_t>(kTrunkSuffixLength))
+    return std::nullopt;
+  std::string raw;
+  if (!Base64UrlDecode(suffix, &raw) || raw.size() != 12) return std::nullopt;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(raw.data());
+  TrunkLocation loc;
+  loc.trunk_id = GetInt32BE(p);
+  loc.offset = GetInt32BE(p + 4);
+  loc.alloc_size = GetInt32BE(p + 8);
+  return loc;
+}
+
 std::string FileIdParts::RemoteFilename() const {
   char buf[16];
   std::snprintf(buf, sizeof(buf), "M%02X/%02X/%02X/", store_path_index,
@@ -95,6 +116,7 @@ std::optional<std::string> EncodeFileId(const EncodeFileIdArgs& a) {
   if (a.file_size > kFileSizeMask) return std::nullopt;
   if (a.uniquifier < 0 || static_cast<uint64_t>(a.uniquifier) > kUniqMask)
     return std::nullopt;
+  if (a.trunk != (a.trunk_loc != nullptr)) return std::nullopt;
 
   uint8_t blob[kBlobSize];
   PackBlob(a, blob);
@@ -107,6 +129,7 @@ std::optional<std::string> EncodeFileId(const EncodeFileIdArgs& a) {
   std::string out(a.group);
   out += prefix;
   out += Base64UrlEncode(blob, kBlobSize);
+  if (a.trunk_loc != nullptr) out += EncodeTrunkSuffix(*a.trunk_loc);
   if (!a.ext.empty()) {
     out += '.';
     out.append(a.ext);
@@ -173,6 +196,16 @@ std::optional<FileIdParts> DecodeFileId(std::string_view id, int subdir_count) {
   parts.uniquifier = static_cast<int>((size_field >> kUniqShift) & kUniqMask);
   parts.appender = (size_field & kFlagAppender) != 0;
   parts.trunk = (size_field & kFlagTrunk) != 0;
+  if (parts.trunk) {
+    // The chars after the stem are the trunk location, not a slave prefix
+    // (disambiguated by the blob flag, as upstream does by name length).
+    auto loc = DecodeTrunkSuffix(prefix);
+    if (!loc.has_value()) return std::nullopt;
+    parts.trunk_loc = *loc;
+    parts.prefix.clear();
+    parts.slave = false;
+    return parts;
+  }
   parts.slave = (size_field & kFlagSlave) != 0 || !prefix.empty();
   return parts;
 }

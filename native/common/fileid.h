@@ -20,6 +20,21 @@ inline constexpr uint64_t kFlagTrunk = 1ULL << 61;
 inline constexpr uint64_t kFlagAppender = 1ULL << 62;
 inline constexpr int kDefaultSubdirCount = 256;
 
+// Location of a small file packed inside a trunk file (reference:
+// FDFSTrunkFullInfo in storage/trunk_mgr/trunk_shared.h; trunk IDs carry it
+// as an extra 16-char base64 segment after the 27-char stem, the analogue
+// of upstream's longer FDFS_TRUNK_LOGIC_FILENAME_LENGTH names).
+struct TrunkLocation {
+  uint32_t trunk_id = 0;    // trunk file number within the store path
+  uint32_t offset = 0;      // slot start (its 24-byte header) in the file
+  uint32_t alloc_size = 0;  // whole slot size including the header
+};
+
+inline constexpr int kTrunkSuffixLength = 16;  // base64(12 bytes)
+
+std::string EncodeTrunkSuffix(const TrunkLocation& loc);
+std::optional<TrunkLocation> DecodeTrunkSuffix(std::string_view suffix);
+
 struct FileIdParts {
   std::string group;
   int store_path_index = 0;
@@ -27,6 +42,7 @@ struct FileIdParts {
   int subdir2 = 0;
   std::string filename;  // 27 b64 chars + optional slave prefix + .ext
   std::string prefix;    // slave-file name prefix ("" for master files)
+  std::optional<TrunkLocation> trunk_loc;  // set iff trunk flag present
 
   // Decoded blob facts.
   uint32_t source_ip = 0;  // packed IPv4
@@ -52,8 +68,9 @@ struct EncodeFileIdArgs {
   std::string_view ext;  // without dot; may be empty
   int uniquifier = 0;
   bool appender = false;
-  bool trunk = false;
+  bool trunk = false;   // requires trunk_loc
   bool slave = false;
+  const TrunkLocation* trunk_loc = nullptr;
   int subdir_count = kDefaultSubdirCount;
 };
 

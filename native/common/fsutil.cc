@@ -25,3 +25,19 @@ bool EnsureParentDirs(const std::string& path) {
 }
 
 }  // namespace fdfs
+
+namespace fdfs {
+
+bool ReadWholeFile(const std::string& path, std::string* out) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (f == nullptr) return false;
+  out->clear();
+  char buf[65536];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0) out->append(buf, n);
+  bool ok = !ferror(f);
+  fclose(f);
+  return ok;
+}
+
+}  // namespace fdfs

@@ -7,5 +7,6 @@ namespace fdfs {
 
 bool MakeDirs(const std::string& path);          // mkdir -p
 bool EnsureParentDirs(const std::string& path);  // mkdir -p dirname(path)
+bool ReadWholeFile(const std::string& path, std::string* out);
 
 }  // namespace fdfs

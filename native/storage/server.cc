@@ -96,6 +96,38 @@ bool StorageServer::Init(std::string* error) {
       if (reporter_ != nullptr) reporter_->ReportSyncProgress(ip, port, ts);
     };
     scbs.binlog_quiescent = [this]() { return binlog_.Quiescent(); };
+    scbs.open_content =
+        [this](const std::string& remote) -> std::optional<ContentHandle> {
+      auto parts = DecodeFileId(cfg_.group_name + "/" + remote);
+      if (parts.has_value() && parts->trunk_loc.has_value()) {
+        const TrunkLocation& loc = *parts->trunk_loc;
+        std::string path = TrunkFilePath(store_.store_path(0), loc.trunk_id);
+        int fd = open(path.c_str(), O_RDONLY);
+        if (fd < 0) return std::nullopt;
+        auto h = ReadSlotHeader(fd, loc.offset);
+        if (!h.has_value() || h->type != kTrunkSlotData ||
+            h->alloc_size != loc.alloc_size ||
+            h->file_size != parts->file_size ||
+            h->crc32 != parts->crc32) {
+          close(fd);
+          return std::nullopt;
+        }
+        ContentHandle out;
+        out.fd = fd;
+        out.offset = loc.offset + kTrunkHeaderSize;
+        out.size = h->file_size;
+        return out;
+      }
+      std::string local = ResolveLocal(cfg_.group_name, remote);
+      int fd = local.empty() ? -1 : open(local.c_str(), O_RDONLY);
+      if (fd < 0) return std::nullopt;
+      struct stat st;
+      fstat(fd, &st);
+      ContentHandle out;
+      out.fd = fd;
+      out.size = st.st_size;
+      return out;
+    };
     sync_ = std::make_unique<SyncManager>(cfg_, std::move(scbs));
     reporter_ = std::make_unique<TrackerReporter>(
         cfg_, [this](int64_t out[20]) { stats_.Snapshot(out); },
@@ -108,6 +140,7 @@ bool StorageServer::Init(std::string* error) {
   // Periodic maintenance (reference: sched_thread entries — binlog flush,
   // stat write, dedup snapshot).
   loop_.AddTimer(1000, [this]() { binlog_.Flush(); });
+  loop_.AddTimer(1000, [this]() { RefreshClusterParams(); });
   loop_.AddTimer(60 * 1000, [this]() {
     if (dedup_ != nullptr) dedup_->Save();
   });
@@ -506,6 +539,9 @@ void StorageServer::OnHeaderComplete(Conn* c) {
     case StorageCmd::kSyncTruncateFile:
     case StorageCmd::kTruncateFile:
     case StorageCmd::kCreateLink:
+    case StorageCmd::kTrunkAllocSpace:
+    case StorageCmd::kTrunkAllocConfirm:
+    case StorageCmd::kTrunkFreeSpace:
       if (c->pkg_len > kMaxInlineBody) {
         CloseConn(c);
         return;
@@ -621,6 +657,11 @@ void StorageServer::OnFixedComplete(Conn* c) {
     case StorageCmd::kGetMetadata:
       HandleGetMetadata(c);
       return;
+    case StorageCmd::kTrunkAllocSpace:
+    case StorageCmd::kTrunkAllocConfirm:
+    case StorageCmd::kTrunkFreeSpace:
+      HandleTrunkRpc(c);
+      return;
     case StorageCmd::kSyncCreateLink:
     case StorageCmd::kCreateLink:
       HandleCreateLink(c);
@@ -668,6 +709,48 @@ void StorageServer::OnFileComplete(Conn* c) {
     // Replica write: place at the exact remote filename from the source.
     close(c->file_fd);
     c->file_fd = -1;
+    auto tparts = DecodeFileId(cfg_.group_name + "/" + c->sync_remote);
+    if (tparts.has_value() && tparts->trunk_loc.has_value()) {
+      // Trunk replica: same (id, offset) slot as the source — the ID
+      // encodes the location, so layouts must match byte-for-byte.
+      // Staleness guard: if the slot already holds a DIFFERENT live file
+      // (it was freed via the allocator RPC and reused before this replay
+      // arrived), this create is for an already-deleted file — skip it
+      // rather than clobber the new occupant.
+      {
+        std::string tp = TrunkFilePath(store_.store_path(0),
+                                       tparts->trunk_loc->trunk_id);
+        int gfd = open(tp.c_str(), O_RDONLY);
+        if (gfd >= 0) {
+          auto gh = ReadSlotHeader(gfd, tparts->trunk_loc->offset);
+          close(gfd);
+          if (gh.has_value() && gh->type == kTrunkSlotData &&
+              gh->file_size != 0 &&
+              (gh->file_size != tparts->file_size ||
+               gh->crc32 != tparts->crc32)) {
+            FDFS_LOG_WARN("stale trunk create %s skipped (slot reused)",
+                          c->sync_remote.c_str());
+            unlink(c->tmp_path.c_str());
+            Respond(c, 0);
+            return;
+          }
+        }
+      }
+      std::string payload, err;
+      if (!ReadWholeFile(c->tmp_path, &payload) ||
+          !WriteSlotPayload(store_.store_path(0), *tparts->trunk_loc,
+                            payload, tparts->crc32, &err)) {
+        FDFS_LOG_ERROR("trunk replica write %s: %s", c->sync_remote.c_str(),
+                       err.c_str());
+        unlink(c->tmp_path.c_str());
+        Respond(c, 5);
+        return;
+      }
+      unlink(c->tmp_path.c_str());
+      binlog_.Append('c', c->sync_remote);
+      Respond(c, 0);
+      return;
+    }
     std::string local = ResolveLocal(cfg_.group_name, c->sync_remote);
     if (local.empty()) {
       unlink(c->tmp_path.c_str());
@@ -721,7 +804,8 @@ bool StorageServer::BeginUpload(Conn* c) {
 }
 
 std::string StorageServer::MintFileId(int spi, int64_t size, uint32_t crc,
-                                      const std::string& ext, bool appender) {
+                                      const std::string& ext, bool appender,
+                                      const TrunkLocation* trunk_loc) {
   EncodeFileIdArgs a;
   a.group = cfg_.group_name;
   a.store_path_index = spi;
@@ -732,8 +816,183 @@ std::string StorageServer::MintFileId(int spi, int64_t size, uint32_t crc,
   a.ext = ext;
   a.uniquifier = store_.NextUniquifier();
   a.appender = appender;
+  a.trunk = trunk_loc != nullptr;
+  a.trunk_loc = trunk_loc;
   auto id = EncodeFileId(a);
   return id.has_value() ? *id : "";
+}
+
+// -- trunk integration ----------------------------------------------------
+
+void StorageServer::RefreshClusterParams() {
+  if (reporter_ == nullptr) return;
+  auto params = reporter_->cluster_params();
+  auto get = [&params](const char* key, int64_t dflt) {
+    auto it = params.find(key);
+    return it == params.end() ? dflt : atoll(it->second.c_str());
+  };
+  trunk_enabled_ = get("use_trunk_file", 0) != 0;
+  slot_min_size_ = get("slot_min_size", slot_min_size_);
+  slot_max_size_ = get("slot_max_size", slot_max_size_);
+  trunk_file_size_ = get("trunk_file_size", trunk_file_size_);
+  auto [tip, tport] = reporter_->trunk_server();
+  trunk_ip_ = tip;
+  trunk_port_ = tport;
+  bool am_trunk = trunk_enabled_ && !trunk_ip_.empty() &&
+                  trunk_ip_ == MyIp() && trunk_port_ == cfg_.port;
+  if (am_trunk && trunk_alloc_ == nullptr) {
+    auto alloc = std::make_unique<TrunkAllocator>();
+    std::string err;
+    if (alloc->Init(store_.store_path(0), trunk_file_size_, &err)) {
+      trunk_alloc_ = std::move(alloc);
+      FDFS_LOG_INFO("this server is now the trunk server (%d trunk files, "
+                    "%lld free bytes)",
+                    trunk_alloc_->trunk_file_count(),
+                    static_cast<long long>(trunk_alloc_->free_bytes()));
+    } else {
+      FDFS_LOG_ERROR("trunk allocator init failed: %s", err.c_str());
+      am_trunk = false;
+    }
+  }
+  is_trunk_server_ = am_trunk;
+}
+
+bool StorageServer::TrunkEligible(int64_t size) const {
+  return trunk_enabled_ && size >= slot_min_size_ && size < slot_max_size_ &&
+         (is_trunk_server_ || trunk_port_ > 0);
+}
+
+std::optional<TrunkLocation> StorageServer::TrunkAlloc(int64_t payload_size) {
+  if (is_trunk_server_ && trunk_alloc_ != nullptr)
+    return trunk_alloc_->Alloc(payload_size);
+  if (trunk_port_ > 0)
+    return TrunkAllocRpc(trunk_ip_, trunk_port_, cfg_.group_name,
+                         payload_size, 5000);
+  return std::nullopt;
+}
+
+void StorageServer::TrunkFree(const TrunkLocation& loc) {
+  if (is_trunk_server_ && trunk_alloc_ != nullptr) {
+    trunk_alloc_->Free(loc);
+    return;
+  }
+  // Not the trunk server: free OUR copy of the slot on disk, then return
+  // it to the group allocator.  (The RPC frees the trunk server's copy;
+  // remaining replicas free theirs via the 'd' binlog replay.)
+  MarkSlotFree(store_.store_path(0), loc);
+  if (trunk_port_ > 0) {
+    if (!TrunkFreeRpc(trunk_ip_, trunk_port_, cfg_.group_name, loc, 5000))
+      FDFS_LOG_WARN("trunk free RPC failed (id=%u off=%u): slot leaked until "
+                    "the free-block checker reclaims it",
+                    loc.trunk_id, loc.offset);
+  }
+}
+
+std::string StorageServer::TrunkStoreUpload(Conn* c) {
+  auto loc = TrunkAlloc(c->file_size);
+  if (!loc.has_value()) return "";
+  std::string payload;
+  if (!ReadWholeFile(c->tmp_path, &payload) ||
+      static_cast<int64_t>(payload.size()) != c->file_size) {
+    TrunkFree(*loc);
+    return "";
+  }
+  std::string err;
+  if (!WriteSlotPayload(store_.store_path(0), *loc, payload, c->crc32,
+                        &err)) {
+    FDFS_LOG_ERROR("trunk slot write: %s", err.c_str());
+    TrunkFree(*loc);
+    return "";
+  }
+  // Trunk files always live under store path 0 (see trunk.h divergences).
+  std::string id = MintFileId(0, c->file_size, c->crc32, c->ext,
+                              /*appender=*/false, &*loc);
+  if (id.empty()) {
+    TrunkFree(*loc);
+    return "";
+  }
+  if (!is_trunk_server_)
+    TrunkConfirmRpc(trunk_ip_, trunk_port_, cfg_.group_name, *loc, 5000);
+  return id;
+}
+
+void StorageServer::HandleTrunkRpc(Conn* c) {
+  auto cmd = static_cast<StorageCmd>(c->cmd);
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  if (c->fixed.size() < 16 + 8 ||
+      GroupFromField(p) != cfg_.group_name) {
+    Respond(c, 22);
+    return;
+  }
+  if (!is_trunk_server_ || trunk_alloc_ == nullptr) {
+    Respond(c, 1 /*EPERM: not the trunk server*/);
+    return;
+  }
+  if (cmd == StorageCmd::kTrunkAllocSpace) {
+    int64_t size = GetInt64BE(p + 16);
+    if (size <= 0 || size >= slot_max_size_) {
+      Respond(c, 22);
+      return;
+    }
+    auto loc = trunk_alloc_->Alloc(size);
+    if (!loc.has_value()) {
+      Respond(c, 28 /*ENOSPC*/);
+      return;
+    }
+    std::string out(12, '\0');
+    uint8_t* q = reinterpret_cast<uint8_t*>(out.data());
+    PutInt32BE(loc->trunk_id, q);
+    PutInt32BE(loc->offset, q + 4);
+    PutInt32BE(loc->alloc_size, q + 8);
+    Respond(c, 0, out);
+    return;
+  }
+  if (c->fixed.size() < 16 + 12) {
+    Respond(c, 22);
+    return;
+  }
+  TrunkLocation loc;
+  loc.trunk_id = GetInt32BE(p + 16);
+  loc.offset = GetInt32BE(p + 20);
+  loc.alloc_size = GetInt32BE(p + 24);
+  if (cmd == StorageCmd::kTrunkAllocConfirm) {
+    // Allocation was durable at alloc time (see trunk.h divergences).
+    Respond(c, 0);
+    return;
+  }
+  Respond(c, trunk_alloc_->Free(loc) ? 0 : 22);
+}
+
+void StorageServer::HandleTrunkDownload(Conn* c, const FileIdParts& parts,
+                                        int64_t offset, int64_t count) {
+  const TrunkLocation& loc = *parts.trunk_loc;
+  std::string path = TrunkFilePath(store_.store_path(0), loc.trunk_id);
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) {
+    Respond(c, 2);
+    return;
+  }
+  auto h = ReadSlotHeader(fd, loc.offset);
+  // Full identity check (size AND crc): a reused slot can coincide in
+  // size with the deleted file; serving the new occupant's bytes under
+  // the old ID would be cross-file content disclosure.
+  if (!h.has_value() || h->type != kTrunkSlotData ||
+      h->alloc_size != loc.alloc_size || h->file_size != parts.file_size ||
+      h->crc32 != parts.crc32) {
+    close(fd);
+    Respond(c, 2);  // slot reused or freed: the file is gone
+    return;
+  }
+  int64_t size = h->file_size;
+  if (offset > size) {
+    close(fd);
+    Respond(c, 22);
+    return;
+  }
+  int64_t avail = size - offset;
+  if (count == 0 || count > avail) count = avail;
+  stats_.success_download++;
+  RespondFile(c, 0, fd, loc.offset + kTrunkHeaderSize + offset, count);
 }
 
 void StorageServer::FinishUpload(Conn* c) {
@@ -776,6 +1035,23 @@ void StorageServer::FinishUpload(Conn* c) {
         // store and let Commit repoint the digest.
         dedup_->Forget(verdict.dup_of);
       }
+    }
+  }
+
+  // Small-file packing (SURVEY §2.3): eligible uploads go into a trunk
+  // slot instead of their own inode; failure falls back to a flat file.
+  if (!appender && TrunkEligible(c->file_size)) {
+    std::string tid = TrunkStoreUpload(c);
+    if (!tid.empty()) {
+      unlink(c->tmp_path.c_str());
+      c->tmp_path.clear();
+      auto tparts = DecodeFileId(tid);
+      if (dedup_ != nullptr) dedup_->Commit(digest, tid);
+      binlog_.Append(kBinlogOpCreate, tparts->RemoteFilename());
+      stats_.success_upload++;
+      stats_.last_source_update = time(nullptr);
+      Respond(c, 0, PackGroupField(cfg_.group_name) + tparts->RemoteFilename());
+      return;
     }
   }
 
@@ -829,8 +1105,22 @@ void StorageServer::HandleDownload(Conn* c) {
   int64_t count = GetInt64BE(p + 8);
   std::string group = GroupFromField(p + 16);
   std::string remote = c->fixed.substr(32);
+  if (offset < 0 || count < 0) {
+    Respond(c, 22);
+    return;
+  }
+  // Trunk files are served out of their slot, not an inode of their own.
+  auto tparts = DecodeFileId(group + "/" + remote);
+  if (tparts.has_value() && tparts->trunk_loc.has_value()) {
+    if (group != cfg_.group_name) {
+      Respond(c, 22);
+      return;
+    }
+    HandleTrunkDownload(c, *tparts, offset, count);
+    return;
+  }
   std::string local = ResolveLocal(group, remote);
-  if (local.empty() || offset < 0 || count < 0) {
+  if (local.empty()) {
     Respond(c, 22);
     return;
   }
@@ -862,6 +1152,47 @@ void StorageServer::HandleDelete(Conn* c) {
   const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
   std::string group = GroupFromField(p);
   std::string remote = c->fixed.substr(16);
+  auto tparts = DecodeFileId(group + "/" + remote);
+  if (tparts.has_value() && tparts->trunk_loc.has_value()) {
+    // Trunk delete: release the slot.  The header's identity facts must
+    // match the deleting ID — an async 'd' replay arriving after the slot
+    // was reused must NOT free the new occupant.
+    if (group != cfg_.group_name) {
+      Respond(c, 22);
+      return;
+    }
+    std::string tpath =
+        TrunkFilePath(store_.store_path(0), tparts->trunk_loc->trunk_id);
+    int tfd = open(tpath.c_str(), O_RDONLY);
+    std::optional<TrunkSlotHeader> h;
+    if (tfd >= 0) {
+      h = ReadSlotHeader(tfd, tparts->trunk_loc->offset);
+      close(tfd);
+    }
+    bool live = h.has_value() && h->type == kTrunkSlotData &&
+                h->alloc_size == tparts->trunk_loc->alloc_size &&
+                h->file_size == tparts->file_size &&
+                h->crc32 == tparts->crc32;
+    if (replica) {
+      // Replay: free our local copy if this exact file still occupies the
+      // slot; otherwise it is already gone (or reused) — both fine.
+      if (live) MarkSlotFree(store_.store_path(0), *tparts->trunk_loc);
+      binlog_.Append('d', remote);
+      Respond(c, 0);
+      return;
+    }
+    if (!live) {
+      Respond(c, 2);
+      return;
+    }
+    TrunkFree(*tparts->trunk_loc);
+    if (dedup_ != nullptr) dedup_->Forget(group + "/" + remote);
+    binlog_.Append(kBinlogOpDelete, remote);
+    stats_.success_delete++;
+    stats_.last_source_update = time(nullptr);
+    Respond(c, 0);
+    return;
+  }
   std::string local = ResolveLocal(group, remote);
   if (local.empty()) {
     Respond(c, 22);
@@ -890,21 +1221,41 @@ void StorageServer::HandleQueryFileInfo(Conn* c) {
   const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
   std::string group = GroupFromField(p);
   std::string remote = c->fixed.substr(16);
-  std::string local = ResolveLocal(group, remote);
-  if (local.empty()) {
-    Respond(c, 22);
-    return;
-  }
-  struct stat st;
-  if (stat(local.c_str(), &st) != 0) {
-    Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
-    return;
-  }
   // Identity facts come from the ID itself (no-metadata-database design).
   auto parts = DecodeFileId(group + "/" + remote);
   if (!parts.has_value()) {
     Respond(c, 22);
     return;
+  }
+  struct stat st;
+  if (parts->trunk_loc.has_value()) {
+    // Header-only stat: size + full identity check without touching the
+    // payload bytes.
+    std::string tp =
+        TrunkFilePath(store_.store_path(0), parts->trunk_loc->trunk_id);
+    int tfd = open(tp.c_str(), O_RDONLY);
+    std::optional<TrunkSlotHeader> h;
+    if (tfd >= 0) {
+      h = ReadSlotHeader(tfd, parts->trunk_loc->offset);
+      close(tfd);
+    }
+    if (!h.has_value() || h->type != kTrunkSlotData ||
+        h->alloc_size != parts->trunk_loc->alloc_size ||
+        h->file_size != parts->file_size || h->crc32 != parts->crc32) {
+      Respond(c, 2);
+      return;
+    }
+    st.st_size = static_cast<off_t>(h->file_size);
+  } else {
+    std::string local = ResolveLocal(group, remote);
+    if (local.empty()) {
+      Respond(c, 22);
+      return;
+    }
+    if (stat(local.c_str(), &st) != 0) {
+      Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
+      return;
+    }
   }
   std::string body(40, '\0');
   uint8_t* out = reinterpret_cast<uint8_t*>(body.data());

@@ -28,6 +28,7 @@
 #include "storage/store.h"
 #include "storage/sync.h"
 #include "storage/tracker_client.h"
+#include "storage/trunk.h"
 
 namespace fdfs {
 
@@ -158,7 +159,21 @@ class StorageServer {
   bool BeginSyncRange(Conn* c);     // SYNC_APPEND / SYNC_MODIFY prefix parse
 
   std::string MintFileId(int spi, int64_t size, uint32_t crc,
-                         const std::string& ext, bool appender);
+                         const std::string& ext, bool appender,
+                         const TrunkLocation* trunk_loc = nullptr);
+  // -- trunk integration (storage/trunk_mgr analogues) -------------------
+  void RefreshClusterParams();       // 1s timer: params + trunk role
+  bool TrunkEligible(int64_t size) const;
+  // Allocate a slot locally (trunk server) or via RPC; nullopt => caller
+  // falls back to a flat file.
+  std::optional<TrunkLocation> TrunkAlloc(int64_t payload_size);
+  void TrunkFree(const TrunkLocation& loc);
+  // Store tmp-file content into a trunk slot and mint the ID; "" on
+  // failure (caller falls back to flat).
+  std::string TrunkStoreUpload(Conn* c);
+  void HandleTrunkRpc(Conn* c);      // cmds 27/28/29 server side
+  void HandleTrunkDownload(Conn* c, const FileIdParts& parts, int64_t offset,
+                           int64_t count);
   // Resolve "group/remote" or "remote" to a local path; empty on error.
   std::string ResolveLocal(const std::string& group,
                            const std::string& remote) const;
@@ -176,6 +191,16 @@ class StorageServer {
   std::unordered_set<std::string> busy_files_;  // remote names being mutated
   StorageStats stats_;
   std::string my_ip_;
+
+  // Trunk state (cluster-global params from the tracker; SURVEY §2.3).
+  bool trunk_enabled_ = false;
+  int64_t slot_min_size_ = 256;
+  int64_t slot_max_size_ = 16 * 1024 * 1024;
+  int64_t trunk_file_size_ = 64LL * 1024 * 1024;
+  std::string trunk_ip_;
+  int trunk_port_ = 0;
+  bool is_trunk_server_ = false;
+  std::unique_ptr<TrunkAllocator> trunk_alloc_;
 };
 
 }  // namespace fdfs

@@ -253,30 +253,42 @@ bool SyncManager::Replay(Worker* w, int* fd, const BinlogRecord& rec) {
 // bytes (the receiver's kSyncCreateFile layout in server.cc).
 bool SyncManager::ReplayCreate(int fd, const BinlogRecord& rec,
                                bool* skipped) {
-  std::string local = cbs_.resolve_local(rec.filename);
-  int local_fd = local.empty() ? -1 : open(local.c_str(), O_RDONLY);
-  if (local_fd < 0) {
-    // Deleted (or never resolvable) since the record was written: the later
-    // 'D' record — or nothing at all — is the correct end state on the peer.
-    *skipped = true;
-    return true;
+  ContentHandle h;
+  if (cbs_.open_content) {
+    auto got = cbs_.open_content(rec.filename);
+    if (!got.has_value()) {
+      // Deleted (or never resolvable) since the record was written: the
+      // later 'D' record — or nothing — is the correct end state on the
+      // peer.
+      *skipped = true;
+      return true;
+    }
+    h = *got;
+  } else {
+    std::string local = cbs_.resolve_local(rec.filename);
+    h.fd = local.empty() ? -1 : open(local.c_str(), O_RDONLY);
+    if (h.fd < 0) {
+      *skipped = true;
+      return true;
+    }
+    struct stat st;
+    fstat(h.fd, &st);
+    h.size = st.st_size;
   }
-  struct stat st;
-  fstat(local_fd, &st);
   std::string body;
   PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
   uint8_t num[8];
   PutInt64BE(static_cast<int64_t>(rec.filename.size()), num);
   body.append(reinterpret_cast<char*>(num), 8);
-  PutInt64BE(st.st_size, num);
+  PutInt64BE(h.size, num);
   body.append(reinterpret_cast<char*>(num), 8);
   body += rec.filename;
 
   bool ok = SendHeader(fd, static_cast<uint8_t>(StorageCmd::kSyncCreateFile),
-                       static_cast<int64_t>(body.size()) + st.st_size) &&
+                       static_cast<int64_t>(body.size()) + h.size) &&
             SendAll(fd, body.data(), body.size(), kIoTimeoutMs) &&
-            SendFileBytes(fd, local_fd, 0, st.st_size);
-  close(local_fd);
+            SendFileBytes(fd, h.fd, h.offset, h.size);
+  close(h.fd);
   uint8_t status = 0;
   if (!ok || !SyncRpcHeaderOnly(fd, &status, kIoTimeoutMs)) return false;
   if (status != 0) {

@@ -30,9 +30,20 @@
 
 namespace fdfs {
 
+// Readable byte range backing a remote filename (flat file or trunk slot).
+// The sync sender streams [offset, offset+size) from fd and closes it.
+struct ContentHandle {
+  int fd = -1;
+  int64_t offset = 0;
+  int64_t size = 0;
+};
+
 struct SyncCallbacks {
   // remote filename "Mxx/aa/bb/name" -> local path ("" when unresolvable).
   std::function<std::string(const std::string&)> resolve_local;
+  // Trunk-aware content opener used by create replay; nullopt = the file
+  // is gone (the later 'D' record is the correct end state on the peer).
+  std::function<std::optional<ContentHandle>(const std::string&)> open_content;
   // Source-side progress report feeding the tracker's sync-timestamp
   // vectors (TrackerReporter::ReportSyncProgress).
   std::function<void(const std::string& ip, int port, int64_t ts)> report;

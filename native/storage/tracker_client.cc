@@ -127,14 +127,32 @@ bool TrackerReporter::ParsePeers(const std::string& body) {
     pi.status = q[kIpAddressSize + 8];
     peers.push_back(std::move(pi));
   }
+  // Optional trailer: the group's elected trunk server (beat responses).
+  size_t tail = 8 + static_cast<size_t>(count) * rec;
+  std::string tip;
+  int tport = 0;
+  if (body.size() >= tail + kIpAddressSize + 8) {
+    const uint8_t* q = p + tail;
+    tip = GetFixedField(q, kIpAddressSize);
+    tport = static_cast<int>(GetInt64BE(q + kIpAddressSize));
+  }
   bool changed;
   {
     std::lock_guard<std::mutex> lk(mu_);
     changed = peers != peers_;
     peers_ = peers;
+    if (tport > 0 || !tip.empty()) {
+      trunk_ip_ = tip;
+      trunk_port_ = tport;
+    }
   }
   if (changed && peers_cb_) peers_cb_(peers);
   return true;
+}
+
+std::pair<std::string, int> TrackerReporter::trunk_server() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return {trunk_ip_, trunk_port_};
 }
 
 bool TrackerReporter::DoJoin(int fd, const std::string&) {

@@ -49,6 +49,8 @@ class TrackerReporter {
   // Cluster-global params fetched from the tracker at join
   // (storage_param_getter.c analogue); empty until first successful join.
   std::map<std::string, std::string> cluster_params() const;
+  // Group's elected trunk server from the latest beat ("" / 0 when none).
+  std::pair<std::string, int> trunk_server() const;
 
  private:
   void ThreadMain(std::string host, int port);
@@ -74,6 +76,8 @@ class TrackerReporter {
   };
   std::vector<SyncProgress> pending_sync_reports_;
   std::map<std::string, std::string> cluster_params_;
+  std::string trunk_ip_;
+  int trunk_port_ = 0;
 };
 
 }  // namespace fdfs

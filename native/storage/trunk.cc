@@ -1,0 +1,474 @@
+#include "storage/trunk.h"
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+
+#include "common/bytes.h"
+#include "common/fsutil.h"
+#include "common/log.h"
+#include "common/net.h"
+#include "common/protocol_gen.h"
+
+namespace fdfs {
+
+namespace {
+
+int64_t AlignSlot(int64_t payload_size) {
+  int64_t need = payload_size + kTrunkHeaderSize;
+  return (need + kTrunkAlignment - 1) / kTrunkAlignment * kTrunkAlignment;
+}
+
+void PackHeader(const TrunkSlotHeader& h, uint8_t out[kTrunkHeaderSize]) {
+  PutInt16BE(kTrunkMagic, out);
+  out[2] = static_cast<uint8_t>(h.type);
+  out[3] = 0;
+  PutInt32BE(h.alloc_size, out + 4);
+  PutInt32BE(h.file_size, out + 8);
+  PutInt32BE(h.crc32, out + 12);
+  PutInt32BE(h.mtime, out + 16);
+  PutInt32BE(0, out + 20);  // reserved
+}
+
+bool UnpackHeader(const uint8_t in[kTrunkHeaderSize], TrunkSlotHeader* h) {
+  if (GetInt16BE(in) != kTrunkMagic) return false;
+  h->type = static_cast<char>(in[2]);
+  if (h->type != kTrunkSlotData && h->type != kTrunkSlotFree) return false;
+  h->alloc_size = GetInt32BE(in + 4);
+  h->file_size = GetInt32BE(in + 8);
+  h->crc32 = GetInt32BE(in + 12);
+  h->mtime = GetInt32BE(in + 16);
+  return true;
+}
+
+int OpenTrunkFd(const std::string& store_path, uint32_t trunk_id,
+                bool create) {
+  std::string path = TrunkFilePath(store_path, trunk_id);
+  if (create) {
+    std::string dir = path.substr(0, path.rfind('/'));
+    MakeDirs(dir);
+  }
+  return open(path.c_str(), create ? (O_RDWR | O_CREAT) : O_RDWR, 0644);
+}
+
+}  // namespace
+
+std::string TrunkFilePath(const std::string& store_path, uint32_t trunk_id) {
+  char buf[40];
+  std::snprintf(buf, sizeof(buf), "/data/trunk/%02X/%06u.tk",
+                trunk_id & 0xFF, trunk_id);
+  return store_path + buf;
+}
+
+bool WriteSlotHeader(int fd, int64_t offset, const TrunkSlotHeader& h) {
+  uint8_t buf[kTrunkHeaderSize];
+  PackHeader(h, buf);
+  return pwrite(fd, buf, sizeof(buf), offset) ==
+         static_cast<ssize_t>(sizeof(buf));
+}
+
+std::optional<TrunkSlotHeader> ReadSlotHeader(int fd, int64_t offset) {
+  uint8_t buf[kTrunkHeaderSize];
+  if (pread(fd, buf, sizeof(buf), offset) !=
+      static_cast<ssize_t>(sizeof(buf)))
+    return std::nullopt;
+  TrunkSlotHeader h;
+  if (!UnpackHeader(buf, &h)) return std::nullopt;
+  return h;
+}
+
+bool WriteSlotPayload(const std::string& store_path, const TrunkLocation& loc,
+                      const std::string& payload, uint32_t crc32,
+                      std::string* error) {
+  if (payload.size() + kTrunkHeaderSize > loc.alloc_size) {
+    *error = "payload does not fit the slot";
+    return false;
+  }
+  int fd = OpenTrunkFd(store_path, loc.trunk_id, /*create=*/true);
+  if (fd < 0) {
+    *error = std::string("open trunk file: ") + strerror(errno);
+    return false;
+  }
+  // Replicas may land here before any local allocation ever happened:
+  // extend the sparse file so the slot exists at the replicated offset.
+  struct stat st;
+  fstat(fd, &st);
+  int64_t end = static_cast<int64_t>(loc.offset) + loc.alloc_size;
+  if (st.st_size < end && ftruncate(fd, end) != 0) {
+    *error = std::string("extend trunk file: ") + strerror(errno);
+    close(fd);
+    return false;
+  }
+  TrunkSlotHeader h;
+  h.type = kTrunkSlotData;
+  h.alloc_size = loc.alloc_size;
+  h.file_size = static_cast<uint32_t>(payload.size());
+  h.crc32 = crc32;
+  h.mtime = static_cast<uint32_t>(time(nullptr));
+  bool ok = WriteSlotHeader(fd, loc.offset, h) &&
+            pwrite(fd, payload.data(), payload.size(),
+                   loc.offset + kTrunkHeaderSize) ==
+                static_cast<ssize_t>(payload.size());
+  if (!ok) *error = std::string("slot write: ") + strerror(errno);
+  close(fd);
+  return ok;
+}
+
+std::optional<std::string> ReadSlotPayload(const std::string& store_path,
+                                           const TrunkLocation& loc,
+                                           int64_t expect_file_size) {
+  int fd = OpenTrunkFd(store_path, loc.trunk_id, /*create=*/false);
+  if (fd < 0) return std::nullopt;
+  auto h = ReadSlotHeader(fd, loc.offset);
+  if (!h.has_value() || h->type != kTrunkSlotData ||
+      h->alloc_size != loc.alloc_size ||
+      (expect_file_size >= 0 &&
+       h->file_size != static_cast<uint32_t>(expect_file_size))) {
+    close(fd);
+    return std::nullopt;
+  }
+  std::string out(h->file_size, '\0');
+  ssize_t n = pread(fd, out.data(), out.size(), loc.offset + kTrunkHeaderSize);
+  close(fd);
+  if (n != static_cast<ssize_t>(out.size())) return std::nullopt;
+  return out;
+}
+
+bool MarkSlotFree(const std::string& store_path, const TrunkLocation& loc) {
+  int fd = OpenTrunkFd(store_path, loc.trunk_id, /*create=*/false);
+  if (fd < 0) return false;
+  auto h = ReadSlotHeader(fd, loc.offset);
+  // Already-free slots are rejected: a duplicate/replayed FREE would
+  // otherwise push a second pool entry and the same byte range would be
+  // handed to two different uploads (double-alloc corruption).
+  if (!h.has_value() || h->type != kTrunkSlotData ||
+      h->alloc_size != loc.alloc_size) {
+    close(fd);
+    return false;
+  }
+  h->type = kTrunkSlotFree;
+  h->file_size = 0;
+  h->crc32 = 0;
+  bool ok = WriteSlotHeader(fd, loc.offset, *h);
+  close(fd);
+  return ok;
+}
+
+// -- allocator ------------------------------------------------------------
+
+bool TrunkAllocator::Init(const std::string& store_path,
+                          int64_t trunk_file_size, std::string* error) {
+  std::lock_guard<std::mutex> lk(mu_);
+  store_path_ = store_path;
+  trunk_file_size_ = trunk_file_size;
+  return ScanRebuildLocked(error);
+}
+
+bool TrunkAllocator::ScanFileLocked(
+    uint32_t trunk_id, const std::string& path,
+    std::map<int64_t, std::vector<Block>>* pool) const {
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  struct stat st;
+  fstat(fd, &st);
+  int64_t off = 0;
+  while (off + kTrunkHeaderSize <= st.st_size) {
+    auto h = ReadSlotHeader(fd, off);
+    if (!h.has_value() || h->alloc_size < kTrunkHeaderSize ||
+        off + h->alloc_size > st.st_size) {
+      // Torn header chain (crash mid-split): everything from here on is
+      // unreachable by any handed-out ID, so reclaim it as one free block.
+      int64_t rest = st.st_size - off;
+      if (rest >= kTrunkMinSplit) {
+        TrunkSlotHeader fh;
+        fh.type = kTrunkSlotFree;
+        fh.alloc_size = static_cast<uint32_t>(rest);
+        int wfd = open(path.c_str(), O_WRONLY);
+        if (wfd >= 0) {
+          WriteSlotHeader(wfd, off, fh);
+          close(wfd);
+        }
+        (*pool)[rest].push_back(
+            {trunk_id, static_cast<uint32_t>(off)});
+        FDFS_LOG_WARN("trunk %06u: torn chain at %lld, reclaimed %lld bytes",
+                      trunk_id, static_cast<long long>(off),
+                      static_cast<long long>(rest));
+      }
+      break;
+    }
+    if (h->type == kTrunkSlotFree)
+      (*pool)[h->alloc_size].push_back(
+          {trunk_id, static_cast<uint32_t>(off)});
+    off += h->alloc_size;
+  }
+  close(fd);
+  return true;
+}
+
+bool TrunkAllocator::ScanRebuildLocked(std::string* error) {
+  free_.clear();
+  next_id_ = 0;
+  std::string root = store_path_ + "/data/trunk";
+  MakeDirs(root);
+  DIR* d = opendir(root.c_str());
+  if (d == nullptr) {
+    *error = "opendir " + root;
+    return false;
+  }
+  int files = 0;
+  struct dirent* sub;
+  while ((sub = readdir(d)) != nullptr) {
+    if (sub->d_name[0] == '.') continue;
+    std::string subdir = root + "/" + sub->d_name;
+    DIR* d2 = opendir(subdir.c_str());
+    if (d2 == nullptr) continue;
+    struct dirent* de;
+    while ((de = readdir(d2)) != nullptr) {
+      unsigned id;
+      if (sscanf(de->d_name, "%06u.tk", &id) != 1) continue;
+      if (ScanFileLocked(id, subdir + "/" + de->d_name, &free_)) {
+        ++files;
+        next_id_ = std::max(next_id_, id + 1);
+      }
+    }
+    closedir(d2);
+  }
+  closedir(d);
+  int64_t fb = 0;
+  for (const auto& [size, blocks] : free_) fb += size * blocks.size();
+  FDFS_LOG_INFO("trunk allocator: %d files scanned, %lld free bytes, next=%u",
+                files, static_cast<long long>(fb), next_id_);
+  return true;
+}
+
+std::optional<TrunkLocation> TrunkAllocator::CreateTrunkFileLocked(
+    std::string* error) {
+  uint32_t id = next_id_++;
+  int fd = OpenTrunkFd(store_path_, id, /*create=*/true);
+  if (fd < 0) {
+    *error = std::string("create trunk file: ") + strerror(errno);
+    return std::nullopt;
+  }
+  // Sparse pre-allocation (reference: trunk_create_file_advance pre-creates
+  // 64 MB files) with one whole-file free block.
+  TrunkSlotHeader h;
+  h.type = kTrunkSlotFree;
+  h.alloc_size = static_cast<uint32_t>(trunk_file_size_);
+  bool ok = ftruncate(fd, trunk_file_size_) == 0 && WriteSlotHeader(fd, 0, h);
+  close(fd);
+  if (!ok) {
+    *error = std::string("init trunk file: ") + strerror(errno);
+    return std::nullopt;
+  }
+  TrunkLocation loc;
+  loc.trunk_id = id;
+  loc.offset = 0;
+  loc.alloc_size = static_cast<uint32_t>(trunk_file_size_);
+  return loc;
+}
+
+std::optional<TrunkLocation> TrunkAllocator::Alloc(int64_t payload_size) {
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t need = AlignSlot(payload_size);
+  if (need > trunk_file_size_) return std::nullopt;
+
+  auto it = free_.lower_bound(need);  // best fit
+  TrunkLocation block;
+  if (it == free_.end()) {
+    std::string err;
+    auto fresh = CreateTrunkFileLocked(&err);
+    if (!fresh.has_value()) {
+      FDFS_LOG_ERROR("trunk alloc: %s", err.c_str());
+      return std::nullopt;
+    }
+    block = *fresh;
+  } else {
+    block.trunk_id = it->second.back().trunk_id;
+    block.offset = it->second.back().offset;
+    block.alloc_size = static_cast<uint32_t>(it->first);
+    it->second.pop_back();
+    if (it->second.empty()) free_.erase(it);
+  }
+
+  int fd = OpenTrunkFd(store_path_, block.trunk_id, /*create=*/false);
+  if (fd < 0) return std::nullopt;
+  int64_t remainder = static_cast<int64_t>(block.alloc_size) - need;
+  uint32_t used = static_cast<uint32_t>(need);
+  if (remainder >= kTrunkMinSplit) {
+    TrunkSlotHeader fh;
+    fh.type = kTrunkSlotFree;
+    fh.alloc_size = static_cast<uint32_t>(remainder);
+    if (!WriteSlotHeader(fd, block.offset + need, fh)) {
+      close(fd);
+      return std::nullopt;
+    }
+    free_[remainder].push_back({block.trunk_id, block.offset +
+                                                    static_cast<uint32_t>(need)});
+  } else {
+    used = block.alloc_size;  // tiny remainder stays padding in this slot
+  }
+  // The 'D' header makes the allocation durable — a rebuilt allocator will
+  // never hand this slot out again.
+  TrunkSlotHeader dh;
+  dh.type = kTrunkSlotData;
+  dh.alloc_size = used;
+  dh.mtime = static_cast<uint32_t>(time(nullptr));
+  bool ok = WriteSlotHeader(fd, block.offset, dh);
+  close(fd);
+  if (!ok) return std::nullopt;
+  TrunkLocation out;
+  out.trunk_id = block.trunk_id;
+  out.offset = block.offset;
+  out.alloc_size = used;
+  return out;
+}
+
+bool TrunkAllocator::Free(const TrunkLocation& loc) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!MarkSlotFree(store_path_, loc)) return false;
+  free_[loc.alloc_size].push_back({loc.trunk_id, loc.offset});
+  return true;
+}
+
+int64_t TrunkAllocator::free_bytes() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t fb = 0;
+  for (const auto& [size, blocks] : free_) fb += size * blocks.size();
+  return fb;
+}
+
+int TrunkAllocator::trunk_file_count() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return static_cast<int>(next_id_);
+}
+
+int TrunkAllocator::VerifyFreeMap(std::string* report) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::map<int64_t, std::vector<Block>> disk;
+  for (uint32_t id = 0; id < next_id_; ++id)
+    ScanFileLocked(id, TrunkFilePath(store_path_, id), &disk);
+  auto count = [](const std::map<int64_t, std::vector<Block>>& m) {
+    size_t n = 0;
+    for (const auto& [s, v] : m) n += v.size();
+    return n;
+  };
+  int mismatches = 0;
+  for (const auto& [size, blocks] : disk) {
+    auto it = free_.find(size);
+    size_t have = it == free_.end() ? 0 : it->second.size();
+    if (have != blocks.size())
+      mismatches += static_cast<int>(
+          std::max(have, blocks.size()) - std::min(have, blocks.size()));
+  }
+  for (const auto& [size, blocks] : free_)
+    if (disk.find(size) == disk.end())
+      mismatches += static_cast<int>(blocks.size());
+  if (report != nullptr) {
+    char buf[160];
+    std::snprintf(buf, sizeof(buf),
+                  "disk_free_blocks=%zu pool_free_blocks=%zu mismatches=%d",
+                  count(disk), count(free_), mismatches);
+    *report = buf;
+  }
+  return mismatches;
+}
+
+// -- trunk server RPCs ----------------------------------------------------
+
+namespace {
+
+constexpr int64_t kRpcMax = 4096;
+
+bool TrunkRpc(const std::string& ip, int port, uint8_t cmd,
+              const std::string& body, std::string* resp, uint8_t* status,
+              int timeout_ms) {
+  std::string err;
+  int fd = TcpConnect(ip, port, timeout_ms, &err);
+  if (fd < 0) return false;
+  uint8_t hdr[kHeaderSize];
+  PutInt64BE(static_cast<int64_t>(body.size()), hdr);
+  hdr[8] = cmd;
+  hdr[9] = 0;
+  bool ok = SendAll(fd, hdr, sizeof(hdr), timeout_ms) &&
+            SendAll(fd, body.data(), body.size(), timeout_ms) &&
+            RecvAll(fd, hdr, sizeof(hdr), timeout_ms);
+  if (ok) {
+    int64_t len = GetInt64BE(hdr);
+    *status = hdr[9];
+    if (len < 0 || len > kRpcMax) {
+      ok = false;
+    } else {
+      resp->resize(static_cast<size_t>(len));
+      if (len > 0) ok = RecvAll(fd, resp->data(), resp->size(), timeout_ms);
+    }
+  }
+  close(fd);
+  return ok;
+}
+
+std::string PackLoc(const TrunkLocation& loc) {
+  std::string out(12, '\0');
+  uint8_t* p = reinterpret_cast<uint8_t*>(out.data());
+  PutInt32BE(loc.trunk_id, p);
+  PutInt32BE(loc.offset, p + 4);
+  PutInt32BE(loc.alloc_size, p + 8);
+  return out;
+}
+
+}  // namespace
+
+std::optional<TrunkLocation> TrunkAllocRpc(const std::string& ip, int port,
+                                           const std::string& group,
+                                           int64_t payload_size,
+                                           int timeout_ms) {
+  std::string body;
+  PutFixedField(&body, group, kGroupNameMaxLen);
+  char num[8];
+  PutInt64BE(payload_size, reinterpret_cast<uint8_t*>(num));
+  body.append(num, 8);
+  std::string resp;
+  uint8_t status = 0;
+  if (!TrunkRpc(ip, port, static_cast<uint8_t>(StorageCmd::kTrunkAllocSpace),
+                body, &resp, &status, timeout_ms) ||
+      status != 0 || resp.size() < 12)
+    return std::nullopt;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(resp.data());
+  TrunkLocation loc;
+  loc.trunk_id = GetInt32BE(p);
+  loc.offset = GetInt32BE(p + 4);
+  loc.alloc_size = GetInt32BE(p + 8);
+  return loc;
+}
+
+bool TrunkConfirmRpc(const std::string& ip, int port, const std::string& group,
+                     const TrunkLocation& loc, int timeout_ms) {
+  std::string body;
+  PutFixedField(&body, group, kGroupNameMaxLen);
+  body += PackLoc(loc);
+  std::string resp;
+  uint8_t status = 0;
+  return TrunkRpc(ip, port,
+                  static_cast<uint8_t>(StorageCmd::kTrunkAllocConfirm), body,
+                  &resp, &status, timeout_ms) &&
+         status == 0;
+}
+
+bool TrunkFreeRpc(const std::string& ip, int port, const std::string& group,
+                  const TrunkLocation& loc, int timeout_ms) {
+  std::string body;
+  PutFixedField(&body, group, kGroupNameMaxLen);
+  body += PackLoc(loc);
+  std::string resp;
+  uint8_t status = 0;
+  return TrunkRpc(ip, port,
+                  static_cast<uint8_t>(StorageCmd::kTrunkFreeSpace), body,
+                  &resp, &status, timeout_ms) &&
+         status == 0;
+}
+
+}  // namespace fdfs

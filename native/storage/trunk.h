@@ -1,0 +1,128 @@
+// Trunk small-file packing: slot IO + free-slot allocator + alloc RPCs.
+//
+// Reference map (SURVEY.md §2.3, storage/trunk_mgr/):
+// - slot codec + slot header + read/write inside a trunk file
+//   → trunk_shared.c (trunk_file_info_encode/decode, trunk_file_get_content)
+// - free-slot allocator with split on alloc → trunk_mem.c
+//   (trunk_alloc_space/trunk_free_space, AVL trees per slot size)
+// - non-trunk-server members RPC the group's elected trunk server
+//   → trunk_client.c (trunk_client_trunk_alloc_space)
+//
+// Honest divergences from upstream, chosen for the rebuild:
+// - Allocator state is derived entirely from the slot headers on disk
+//   (ScanRebuild at boot / failover) instead of a trunk binlog + snapshot
+//   (upstream trunk_sync.c / storage_trunk_init).  The headers are the
+//   ground truth upstream's free-block checker validates against; scanning
+//   them removes an entire class of snapshot/replay divergence bugs and
+//   makes trunk-server failover the same code path as a normal boot.
+// - Trunk files always live under store path 0 (upstream lets the
+//   allocator spread them over store paths; the file-ID still reserves the
+//   M%02X slot so this can be widened later).
+// - Allocation is durable at alloc time; TRUNK_ALLOC_CONFIRM (28) is an
+//   acknowledgement and a failed writer frees explicitly (29), where
+//   upstream tracks unconfirmed allocations.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "common/fileid.h"
+
+namespace fdfs {
+
+constexpr int kTrunkHeaderSize = 24;
+constexpr uint16_t kTrunkMagic = 0xFD54;
+constexpr char kTrunkSlotData = 'D';
+constexpr char kTrunkSlotFree = 'F';
+constexpr int64_t kTrunkAlignment = 256;   // slot sizes rounded up to this
+constexpr int64_t kTrunkMinSplit = 1024;   // smaller remainders stay padding
+
+// 24-byte on-disk slot header at each block start.
+struct TrunkSlotHeader {
+  char type = kTrunkSlotFree;   // 'D' data | 'F' free
+  uint32_t alloc_size = 0;      // whole block incl. this header
+  uint32_t file_size = 0;       // payload bytes ('D' only)
+  uint32_t crc32 = 0;
+  uint32_t mtime = 0;
+};
+
+// data/trunk/<id&0xFF as %02X>/<id as %06u>.tk under a store path — the
+// path is a pure function of the id so replicas place content identically.
+std::string TrunkFilePath(const std::string& store_path, uint32_t trunk_id);
+
+bool WriteSlotHeader(int fd, int64_t offset, const TrunkSlotHeader& h);
+std::optional<TrunkSlotHeader> ReadSlotHeader(int fd, int64_t offset);
+
+// Write header + payload into the trunk file for `loc`, creating/extending
+// the file when needed (replica replay path; also used by the source after
+// a successful Alloc).  Verifies payload fits the slot.
+bool WriteSlotPayload(const std::string& store_path, const TrunkLocation& loc,
+                      const std::string& payload, uint32_t crc32,
+                      std::string* error);
+
+// Read back the payload for `loc` ('D' slot with matching sizes).
+std::optional<std::string> ReadSlotPayload(const std::string& store_path,
+                                           const TrunkLocation& loc,
+                                           int64_t expect_file_size);
+
+// Mark the slot free on disk (delete path; replicas do only this — the
+// allocator pool lives on the trunk server).
+bool MarkSlotFree(const std::string& store_path, const TrunkLocation& loc);
+
+// Free-slot allocator run by the group's elected trunk server.
+// Thread-safe (the nio loop allocates; tests poke it directly).
+class TrunkAllocator {
+ public:
+  // Scans every trunk file's header chain to rebuild the free pool.
+  bool Init(const std::string& store_path, int64_t trunk_file_size,
+            std::string* error);
+
+  // Reserve a slot able to hold `payload_size` bytes (+header).  Writes the
+  // 'D' header (and any split remainder's 'F' header) before returning, so
+  // a rebuilt allocator never double-allocates a handed-out slot.
+  std::optional<TrunkLocation> Alloc(int64_t payload_size);
+
+  // Return a slot to the pool (and mark it free on disk).
+  bool Free(const TrunkLocation& loc);
+
+  int64_t free_bytes() const;
+  int trunk_file_count() const;
+
+  // Free-block checker (trunk_free_block_checker.c analogue): re-scan the
+  // headers and compare with the in-memory pool; returns the number of
+  // mismatched blocks (0 = consistent).
+  int VerifyFreeMap(std::string* report) const;
+
+ private:
+  struct Block {
+    uint32_t trunk_id;
+    uint32_t offset;
+  };
+  bool ScanRebuildLocked(std::string* error);
+  bool ScanFileLocked(uint32_t trunk_id, const std::string& path,
+                      std::map<int64_t, std::vector<Block>>* pool) const;
+  std::optional<TrunkLocation> CreateTrunkFileLocked(std::string* error);
+
+  mutable std::mutex mu_;
+  std::string store_path_;
+  int64_t trunk_file_size_ = 0;
+  uint32_t next_id_ = 0;
+  // size -> blocks of exactly that size (best-fit via lower_bound).
+  std::map<int64_t, std::vector<Block>> free_;
+};
+
+// -- trunk server RPCs (storage <-> elected trunk server, cmds 27-29) ----
+std::optional<TrunkLocation> TrunkAllocRpc(const std::string& ip, int port,
+                                           const std::string& group,
+                                           int64_t payload_size,
+                                           int timeout_ms);
+bool TrunkConfirmRpc(const std::string& ip, int port, const std::string& group,
+                     const TrunkLocation& loc, int timeout_ms);
+bool TrunkFreeRpc(const std::string& ip, int port, const std::string& group,
+                  const TrunkLocation& loc, int timeout_ms);
+
+}  // namespace fdfs

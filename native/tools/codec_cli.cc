@@ -3,6 +3,8 @@
 //
 // Usage:
 //   fdfs_codec encode <group> <spi> <ip> <ts> <size> <crc> <ext> <uniq>
+//   fdfs_codec encode-trunk <group> <spi> <ip> <ts> <size> <crc> <ext>
+//                <uniq> <trunk_id> <offset> <alloc_size>
 //   fdfs_codec decode <file_id>
 //   fdfs_codec sha1            (stdin -> hex)
 //   fdfs_codec crc32           (stdin -> decimal)
@@ -50,17 +52,45 @@ int main(int argc, char** argv) {
     printf("%s\n", id->c_str());
     return 0;
   }
+  if (cmd == "encode-trunk" && argc == 13) {
+    EncodeFileIdArgs a;
+    a.group = argv[2];
+    a.store_path_index = atoi(argv[3]);
+    a.source_ip = PackIp(argv[4]);
+    a.create_timestamp = static_cast<uint32_t>(strtoull(argv[5], nullptr, 10));
+    a.file_size = strtoull(argv[6], nullptr, 10);
+    a.crc32 = static_cast<uint32_t>(strtoull(argv[7], nullptr, 10));
+    a.ext = argv[8][0] == '-' ? "" : argv[8];
+    a.uniquifier = atoi(argv[9]);
+    TrunkLocation loc;
+    loc.trunk_id = static_cast<uint32_t>(strtoull(argv[10], nullptr, 10));
+    loc.offset = static_cast<uint32_t>(strtoull(argv[11], nullptr, 10));
+    loc.alloc_size = static_cast<uint32_t>(strtoull(argv[12], nullptr, 10));
+    a.trunk = true;
+    a.trunk_loc = &loc;
+    auto id = EncodeFileId(a);
+    if (!id.has_value()) {
+      fprintf(stderr, "encode failed\n");
+      return 1;
+    }
+    printf("%s\n", id->c_str());
+    return 0;
+  }
   if (cmd == "decode" && argc == 3) {
     auto p = DecodeFileId(argv[2]);
     if (!p.has_value()) {
       fprintf(stderr, "decode failed\n");
       return 1;
     }
-    printf("group=%s spi=%d ip=%s ts=%u size=%llu crc=%u uniq=%d app=%d trunk=%d slave=%d\n",
+    printf("group=%s spi=%d ip=%s ts=%u size=%llu crc=%u uniq=%d app=%d trunk=%d slave=%d",
            p->group.c_str(), p->store_path_index, UnpackIp(p->source_ip).c_str(),
            p->create_timestamp, static_cast<unsigned long long>(p->file_size),
            p->crc32, p->uniquifier, p->appender ? 1 : 0, p->trunk ? 1 : 0,
            p->slave ? 1 : 0);
+    if (p->trunk_loc.has_value())
+      printf(" tid=%u toff=%u talloc=%u", p->trunk_loc->trunk_id,
+             p->trunk_loc->offset, p->trunk_loc->alloc_size);
+    printf("\n");
     return 0;
   }
   if (cmd == "sha1") {

@@ -181,6 +181,46 @@ std::optional<int64_t> Cluster::SyncSrcReq(const std::string& group,
   return it->second.sync_until_ts;
 }
 
+void Cluster::EnsureTrunkServer(GroupInfo* g) {
+  if (!trunk_enabled_) return;
+  if (!g->trunk_addr.empty()) {
+    auto it = g->storages.find(g->trunk_addr);
+    if (it != g->storages.end() && it->second.status == kActive) return;
+  }
+  // Longest-standing ACTIVE member wins (stable choice across trackers).
+  const StorageNode* pick = nullptr;
+  for (const auto& [addr, s] : g->storages) {
+    if (s.status != kActive) continue;
+    if (pick == nullptr || s.join_time < pick->join_time) pick = &s;
+  }
+  std::string chosen = pick == nullptr ? "" : pick->Addr();
+  if (chosen != g->trunk_addr) {
+    FDFS_LOG_INFO("group %s trunk server: %s -> %s", g->name.c_str(),
+                  g->trunk_addr.empty() ? "(none)" : g->trunk_addr.c_str(),
+                  chosen.empty() ? "(none)" : chosen.c_str());
+    g->trunk_addr = chosen;
+  }
+}
+
+std::string Cluster::TrunkServer(const std::string& group) {
+  GroupInfo* g = FindGroup(group);
+  if (g == nullptr) return "";
+  EnsureTrunkServer(g);
+  return g->trunk_addr;
+}
+
+bool Cluster::SetTrunkServer(const std::string& group,
+                             const std::string& addr) {
+  GroupInfo* g = FindGroup(group);
+  if (g == nullptr) return false;
+  auto it = g->storages.find(addr);
+  if (it == g->storages.end() || it->second.status != kActive) return false;
+  g->trunk_addr = addr;
+  FDFS_LOG_INFO("group %s trunk server set to %s by operator", group.c_str(),
+                addr.c_str());
+  return true;
+}
+
 bool Cluster::SyncNotify(const std::string& group,
                          const std::string& dest_addr) {
   StorageNode* n = FindNode(group, dest_addr);
@@ -405,12 +445,12 @@ static void AppendStorageJson(std::string* out, const StorageNode& s) {
 }
 
 static std::string GroupJson(const GroupInfo& g) {
-  char buf[256];
+  char buf[320];
   std::snprintf(buf, sizeof(buf),
                 "{\"name\":\"%s\",\"members\":%zu,\"active\":%d,"
-                "\"free_mb\":%lld}",
+                "\"free_mb\":%lld,\"trunk_server\":\"%s\"}",
                 g.name.c_str(), g.storages.size(), g.ActiveCount(),
-                static_cast<long long>(g.FreeMb()));
+                static_cast<long long>(g.FreeMb()), g.trunk_addr.c_str());
   return buf;
 }
 
@@ -452,6 +492,8 @@ bool Cluster::Save(const std::string& path) const {
   if (f == nullptr) return false;
   for (const auto& [gname, g] : groups_) {
     fprintf(f, "group %s\n", gname.c_str());
+    if (!g.trunk_addr.empty())
+      fprintf(f, "trunk %s\n", g.trunk_addr.c_str());
     for (const auto& [addr, s] : g.storages) {
       fprintf(f, "storage %s %d %d %d %lld %lld %lld %lld", s.ip.c_str(),
               s.port, s.status, s.store_path_count,
@@ -487,6 +529,10 @@ bool Cluster::Load(const std::string& path) {
       groups_[cur_group].name = cur_group;
       continue;
     }
+    if (sscanf(line, "trunk %255s", a) == 1 && !cur_group.empty()) {
+      groups_[cur_group].trunk_addr = a;
+      continue;
+    }
     StorageNode s;
     long long jt, lb, tm, fm;
     int consumed = 0;
@@ -514,13 +560,8 @@ bool Cluster::Load(const std::string& path) {
       continue;
     }
     long long ts;
-    if (sscanf(line, "sync %255s %255s %lld", a, b, &ts) == 3 &&
-        !cur_group.empty()) {
-      auto it = groups_[cur_group].storages.find(a);
-      if (it != groups_[cur_group].storages.end())
-        it->second.synced_from[b] = ts;
-      continue;
-    }
+    // "syncsrc" MUST be tried before "sync": sscanf's literal 'sync'
+    // matches the prefix of 'syncsrc' and would mis-parse those lines.
     if (sscanf(line, "syncsrc %255s %255s %lld", a, b, &ts) == 3 &&
         !cur_group.empty()) {
       auto it = groups_[cur_group].storages.find(a);
@@ -528,6 +569,13 @@ bool Cluster::Load(const std::string& path) {
         it->second.sync_src_addr = b;
         it->second.sync_until_ts = ts;
       }
+      continue;
+    }
+    if (sscanf(line, "sync %255s %255s %lld", a, b, &ts) == 3 &&
+        !cur_group.empty()) {
+      auto it = groups_[cur_group].storages.find(a);
+      if (it != groups_[cur_group].storages.end())
+        it->second.synced_from[b] = ts;
     }
   }
   fclose(f);

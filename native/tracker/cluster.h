@@ -52,6 +52,10 @@ struct GroupInfo {
   std::map<std::string, StorageNode> storages;  // key "ip:port"
   size_t rr_write = 0;
   size_t rr_read = 0;
+  // Elected trunk server "ip:port" (empty when trunk is off or the group
+  // has no ACTIVE member).  Reference: the tracker leader decides the
+  // per-group trunk server (tracker_relationship.c / SetTrunkServer 94).
+  std::string trunk_addr;
 
   int ActiveCount() const;
   int64_t FreeMb() const;
@@ -66,8 +70,10 @@ struct StoreTarget {
 class Cluster {
  public:
   // store_lookup: 0 round-robin, 1 specified group, 2 load balance.
-  explicit Cluster(int store_lookup = 0, std::string store_group = "")
-      : store_lookup_(store_lookup), store_group_(std::move(store_group)) {}
+  explicit Cluster(int store_lookup = 0, std::string store_group = "",
+                   bool trunk_enabled = false)
+      : store_lookup_(store_lookup), store_group_(std::move(store_group)),
+        trunk_enabled_(trunk_enabled) {}
 
   // -- membership (tracker_mem_add_storage / beats) ----------------------
   // nullopt: rejected (another member already owns this IP on a different
@@ -101,6 +107,13 @@ class Cluster {
   // Dest (or its source) declares old-data sync done: promote to ACTIVE.
   bool SyncNotify(const std::string& group, const std::string& dest_addr);
 
+  // -- trunk server election (leader decides; SURVEY §2.1/§2.3) ----------
+  // Current trunk server for the group ("" when none); elects/repairs on
+  // demand so callers always see a live choice when one is possible.
+  std::string TrunkServer(const std::string& group);
+  // Operator override (SERVER_SET_TRUNK_SERVER 94); target must be ACTIVE.
+  bool SetTrunkServer(const std::string& group, const std::string& addr);
+
   // -- routing (tracker_get_writable_storage & co.) ----------------------
   std::optional<StoreTarget> QueryStore(const std::string& group_hint);
   std::optional<StoreTarget> QueryFetch(const std::string& group,
@@ -128,9 +141,11 @@ class Cluster {
 
  private:
   StorageNode* FindNode(const std::string& group, const std::string& addr);
+  void EnsureTrunkServer(GroupInfo* g);
   std::map<std::string, GroupInfo> groups_;
   int store_lookup_;
   std::string store_group_;
+  bool trunk_enabled_;
   size_t rr_group_ = 0;
 };
 

@@ -80,7 +80,8 @@ bool TrackerServer::Init(std::string* error) {
     *error = "cannot create " + cfg_.base_path + "/data";
     return false;
   }
-  cluster_ = std::make_unique<Cluster>(cfg_.store_lookup, cfg_.store_group);
+  cluster_ = std::make_unique<Cluster>(cfg_.store_lookup, cfg_.store_group,
+                                       cfg_.use_trunk_file);
   state_path_ = cfg_.base_path + "/data/storage_servers.dat";
   cluster_->Load(state_path_);
 
@@ -152,7 +153,22 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       if (!cluster_->Beat(group, ip, static_cast<int>(port), sp, now))
         return {2, ""};  // unknown: storage must re-JOIN
       auto peers = cluster_->Peers(group, ip + ":" + std::to_string(port));
-      return {0, PackPeers(peers)};
+      // Trailer: the group's elected trunk server (zeros when trunk is
+      // off) — how every member learns where to RPC slot allocations.
+      std::string out = PackPeers(peers);
+      std::string taddr = cluster_->TrunkServer(group);
+      std::string tip;
+      int64_t tport = 0;
+      size_t colon = taddr.rfind(':');
+      if (colon != std::string::npos) {
+        tip = taddr.substr(0, colon);
+        tport = atoll(taddr.c_str() + colon + 1);
+      }
+      PutFixedField(&out, tip, kIpAddressSize);
+      char pbuf[8];
+      PutInt64BE(tport, reinterpret_cast<uint8_t*>(pbuf));
+      out.append(pbuf, 8);
+      return {0, out};
     }
 
     case TrackerCmd::kStorageReportDiskUsage: {
@@ -282,6 +298,15 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
     case TrackerCmd::kServerListOneGroup: {
       if (body.size() < 16) return {22, ""};
       return {0, cluster_->OneGroupJson(FixedGroup(p))};
+    }
+
+    case TrackerCmd::kServerSetTrunkServer: {
+      // 16B group + "ip:port" — operator override of the elected trunk
+      // server (fdfs_monitor's set_trunk_server).
+      if (body.size() < 17) return {22, ""};
+      if (!cluster_->SetTrunkServer(FixedGroup(p), body.substr(16)))
+        return {2, ""};
+      return {0, ""};
     }
 
     case TrackerCmd::kServiceQueryFetchOne:

@@ -40,9 +40,12 @@ def test_flags_and_uniquifier():
     assert info.uniquifier == 0xABC
     assert info.file_size == (1 << 48) - 1
 
-    fid_str2 = F.encode_file_id("g", 0, "1.2.3.4", 5, 6, 7, trunk=True, slave=True)
+    loc = F.TrunkLocation(trunk_id=9, offset=1 << 20, alloc_size=4096)
+    fid_str2 = F.encode_file_id("g", 0, "1.2.3.4", 5, 6, 7, trunk=True,
+                                trunk_loc=loc)
     _, info2 = F.decode_file_id(fid_str2)
-    assert info2.trunk and info2.slave and not info2.appender
+    assert info2.trunk and not info2.appender and not info2.slave
+    assert info2.trunk_loc == loc
 
 
 def test_fuzz_roundtrip():

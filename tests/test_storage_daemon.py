@@ -212,7 +212,10 @@ def test_truncate_requires_busy_lock(storage, client):
     with socket.create_connection(("127.0.0.1", storage.port), timeout=5) as s:
         s.sendall(long2buff(len(prefix) + len(name) + 64) +
                   bytes([StorageCmd.APPEND_FILE, 0]) + prefix + name + b"x" * 8)
-        # busy lock is now held by the in-flight append
+        # The busy lock is taken when the server parses the prefix on its
+        # next epoll round — give it a moment before poking the lock.
+        import time as _time
+        _time.sleep(0.5)
         with pytest.raises(StatusError) as ei:
             client.truncate_file(fid, 0)
         assert ei.value.status == 16  # EBUSY
