@@ -63,15 +63,19 @@ _BLOB_STRUCT = struct.Struct(">IIqI")
 # class like \s would also reject U+00A0/U+3000 etc., splitting the codec
 # from the C++ side, which compares raw bytes only.
 _SAFE = r"[^/.\x00-\x20\x7f]"
+# Prefix cap is 2x the slave max: trunk IDs carry a 16-char slot-location
+# segment first, optionally followed by a slave prefix (slave-of-trunk-
+# master names).  Non-trunk IDs are re-checked against the 16 cap after
+# the blob decode.
 _FILE_ID_RE = re.compile(
     r"^(?P<group>[^\s/]{1,16})/M(?P<path>[0-9A-F]{2})/"
     r"(?P<sub1>[0-9A-F]{2})/(?P<sub2>[0-9A-F]{2})/"
-    r"(?P<b64>[A-Za-z0-9_-]{27})(?P<prefix>" + _SAFE + r"{1,16})?"
+    r"(?P<b64>[A-Za-z0-9_-]{27})(?P<prefix>" + _SAFE + r"{1,32})?"
     r"(?P<ext>\." + _SAFE + r"{1,6})?\Z"
 )
 _REMOTE_NAME_RE = re.compile(
     r"^M[0-9A-F]{2}/[0-9A-F]{2}/[0-9A-F]{2}/"
-    r"[A-Za-z0-9_-]{27}(" + _SAFE + r"{1,16})?(\." + _SAFE + r"{1,6})?\Z"
+    r"[A-Za-z0-9_-]{27}(" + _SAFE + r"{1,32})?(\." + _SAFE + r"{1,6})?\Z"
 )
 
 
@@ -264,14 +268,22 @@ def decode_file_id(
     trunk = bool(size_field & FLAG_TRUNK)
     trunk_loc = None
     if trunk:
-        # The chars after the stem are the trunk location, not a slave
-        # prefix (disambiguated by the blob flag, as upstream does by the
-        # longer trunk filename length).
+        # Trunk IDs: first 16 post-stem chars are the slot location
+        # (disambiguated by the blob flag, as upstream does by the longer
+        # trunk filename length); any remainder is a slave prefix — such a
+        # slave is stored FLAT, so its trunk_loc stays None (the location
+        # names the master's slot, not this file).
+        if len(prefix) < TRUNK_SUFFIX_LENGTH:
+            raise ValueError(f"trunk id missing location: {file_id!r}")
         try:
-            trunk_loc = decode_trunk_suffix(prefix)
+            loc = decode_trunk_suffix(prefix[:TRUNK_SUFFIX_LENGTH])
         except (ValueError, binascii.Error) as e:
             raise ValueError(f"bad trunk suffix in {file_id!r}") from e
-        prefix = ""
+        prefix = prefix[TRUNK_SUFFIX_LENGTH:]
+        if not prefix:
+            trunk_loc = loc
+    elif len(prefix) > 16:
+        raise ValueError(f"slave prefix too long: {file_id!r}")
     info = FileInfo(
         source_ip=unpack_ip(ip_n),
         create_timestamp=ts,
@@ -282,7 +294,7 @@ def decode_file_id(
         trunk=trunk,
         # A non-empty prefix after the base64 stem IS the slave marker
         # (reference: slave names are "<master stem><prefix>.<ext>").
-        slave=not trunk and (bool(size_field & FLAG_SLAVE) or bool(prefix)),
+        slave=bool(prefix) or (not trunk and bool(size_field & FLAG_SLAVE)),
         trunk_loc=trunk_loc,
     )
     return fid, info

@@ -169,7 +169,11 @@ std::optional<FileIdParts> DecodeFileId(std::string_view id, int subdir_count) {
   std::string_view b64 = stem.substr(0, kFilenameBase64Length);
   std::string_view prefix = stem.substr(kFilenameBase64Length);
   if (!IsB64Name(b64)) return std::nullopt;
-  if (!prefix.empty() && !IsSlavePrefix(prefix)) return std::nullopt;
+  // Prefix grammar is validated after the blob decode: trunk IDs carry a
+  // 16-char location segment first, optionally followed by a slave prefix
+  // (slave-of-trunk-master names), so the cap here is 2x the slave max.
+  if (prefix.size() > 2 * static_cast<size_t>(kFilePrefixMaxLen))
+    return std::nullopt;
 
   std::string blob;
   if (!Base64UrlDecode(b64, &blob) || blob.size() != kBlobSize)
@@ -197,15 +201,25 @@ std::optional<FileIdParts> DecodeFileId(std::string_view id, int subdir_count) {
   parts.appender = (size_field & kFlagAppender) != 0;
   parts.trunk = (size_field & kFlagTrunk) != 0;
   if (parts.trunk) {
-    // The chars after the stem are the trunk location, not a slave prefix
+    // Trunk IDs: the first 16 chars after the stem are the slot location
     // (disambiguated by the blob flag, as upstream does by name length).
-    auto loc = DecodeTrunkSuffix(prefix);
+    // Anything beyond is a slave prefix: a slave derived from a trunk-
+    // packed master inherits the full master stem, but the slave ITSELF
+    // is stored flat — so trunk_loc is cleared for it (the loc names the
+    // master's slot, not this file).
+    if (prefix.size() < static_cast<size_t>(kTrunkSuffixLength))
+      return std::nullopt;
+    auto loc = DecodeTrunkSuffix(prefix.substr(0, kTrunkSuffixLength));
     if (!loc.has_value()) return std::nullopt;
-    parts.trunk_loc = *loc;
-    parts.prefix.clear();
-    parts.slave = false;
+    std::string_view slave_prefix = prefix.substr(kTrunkSuffixLength);
+    if (!slave_prefix.empty() && !IsSlavePrefix(slave_prefix))
+      return std::nullopt;
+    parts.prefix = std::string(slave_prefix);
+    parts.slave = !slave_prefix.empty();
+    if (!parts.slave) parts.trunk_loc = *loc;
     return parts;
   }
+  if (!prefix.empty() && !IsSlavePrefix(prefix)) return std::nullopt;
   parts.slave = (size_field & kFlagSlave) != 0 || !prefix.empty();
   return parts;
 }
@@ -230,7 +244,14 @@ std::optional<std::string> LocalPath(std::string_view base_path,
     return std::nullopt;
   if (!IsB64Name(stem.substr(0, kFilenameBase64Length))) return std::nullopt;
   std::string_view prefix = stem.substr(kFilenameBase64Length);
-  if (!prefix.empty() && !IsSlavePrefix(prefix)) return std::nullopt;
+  // Grammar-only guard (no blob decode here): allow trunk suffix + slave
+  // prefix, i.e. up to 2x the plain slave cap of safe characters.
+  if (prefix.size() > 2 * static_cast<size_t>(kFilePrefixMaxLen))
+    return std::nullopt;
+  for (char ch : prefix) {
+    uint8_t u = static_cast<uint8_t>(ch);
+    if (ch == '/' || ch == '.' || u <= 0x20 || u == 0x7F) return std::nullopt;
+  }
 
   std::string out(base_path);
   out += "/data/";

@@ -234,3 +234,27 @@ bool BinlogReader::SaveMark() {
 }
 
 }  // namespace fdfs
+
+namespace fdfs {
+
+std::string CollectOnePathBinlog(const std::string& sync_dir, int spi) {
+  char want[8];
+  std::snprintf(want, sizeof(want), "M%02X/", spi);
+  std::string out;
+  for (int idx = 0;; ++idx) {
+    char name[32];
+    std::snprintf(name, sizeof(name), "/binlog.%03d", idx);
+    FILE* f = fopen((sync_dir + name).c_str(), "r");
+    if (f == nullptr) break;
+    char line[4096];
+    while (fgets(line, sizeof(line), f) != nullptr) {
+      auto rec = ParseBinlogRecord(line);
+      if (!rec.has_value()) continue;
+      if (rec->filename.rfind(want, 0) == 0) out += line;
+    }
+    fclose(f);
+  }
+  return out;
+}
+
+}  // namespace fdfs

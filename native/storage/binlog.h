@@ -63,6 +63,12 @@ class BinlogWriter {
   std::atomic<int> in_flight_{0};
 };
 
+// One-path binlog extraction (FETCH_ONE_PATH_BINLOG 26, the feed for disk
+// recovery): every record in the sync dir whose filename lives on store
+// path `spi`, as raw binlog lines.  Reference:
+// storage/storage_sync.c:fdfs_binlog_reader (one-path filter mode).
+std::string CollectOnePathBinlog(const std::string& sync_dir, int spi);
+
 // Sequential reader with a persistent cursor (mark file).
 class BinlogReader {
  public:

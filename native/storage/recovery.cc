@@ -1,0 +1,378 @@
+#include "storage/recovery.h"
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <set>
+
+#include "common/bytes.h"
+#include "common/fileid.h"
+#include "common/fsutil.h"
+#include "common/log.h"
+#include "common/net.h"
+#include "common/protocol_gen.h"
+#include "storage/binlog.h"
+#include "storage/trunk.h"
+
+namespace fdfs {
+
+namespace {
+
+constexpr int kRpcTimeoutMs = 10000;
+
+bool Rpc(int fd, uint8_t cmd, const std::string& body, std::string* resp,
+         uint8_t* status, int64_t max_resp) {
+  uint8_t hdr[kHeaderSize];
+  PutInt64BE(static_cast<int64_t>(body.size()), hdr);
+  hdr[8] = cmd;
+  hdr[9] = 0;
+  if (!SendAll(fd, hdr, sizeof(hdr), kRpcTimeoutMs) ||
+      !SendAll(fd, body.data(), body.size(), kRpcTimeoutMs) ||
+      !RecvAll(fd, hdr, sizeof(hdr), kRpcTimeoutMs))
+    return false;
+  int64_t len = GetInt64BE(hdr);
+  *status = hdr[9];
+  if (len < 0 || len > max_resp) return false;
+  resp->resize(static_cast<size_t>(len));
+  if (len > 0 && !RecvAll(fd, resp->data(), resp->size(), kRpcTimeoutMs))
+    return false;
+  return true;
+}
+
+bool HasMarkFiles(const std::string& sync_dir) {
+  DIR* d = opendir(sync_dir.c_str());
+  if (d == nullptr) return false;
+  bool found = false;
+  struct dirent* de;
+  while ((de = readdir(d)) != nullptr) {
+    std::string name = de->d_name;
+    if (name.size() > 5 && name.rfind(".mark") == name.size() - 5) {
+      found = true;
+      break;
+    }
+  }
+  closedir(d);
+  return found;
+}
+
+}  // namespace
+
+RecoveryManager::RecoveryManager(const StorageConfig& cfg,
+                                 TrackerReporter* reporter,
+                                 StoreManager* store)
+    : cfg_(cfg), reporter_(reporter), store_(store),
+      marker_path_(cfg.base_path + "/data/.recovery") {}
+
+RecoveryManager::~RecoveryManager() { Stop(); }
+
+void RecoveryManager::Stop() {
+  stop_ = true;
+  if (thread_.joinable()) thread_.join();
+}
+
+bool RecoveryManager::NeedsRecovery(bool data_was_fresh) const {
+  struct stat st;
+  if (stat(marker_path_.c_str(), &st) == 0) return true;  // unfinished
+  return data_was_fresh && HasMarkFiles(cfg_.base_path + "/data/sync");
+}
+
+void RecoveryManager::Start() {
+  int fd = open(marker_path_.c_str(), O_CREAT | O_WRONLY, 0644);
+  if (fd >= 0) close(fd);
+  FDFS_LOG_WARN("disk recovery: starting background rebuild");
+  running_ = true;
+  thread_ = std::thread(&RecoveryManager::ThreadMain, this);
+}
+
+bool RecoveryManager::TrackerRpc(uint8_t cmd, const std::string& body,
+                                 std::string* resp, uint8_t* status) {
+  for (const std::string& addr : cfg_.tracker_servers) {
+    size_t colon = addr.rfind(':');
+    if (colon == std::string::npos) continue;
+    std::string err;
+    int fd = TcpConnect(addr.substr(0, colon), atoi(addr.c_str() + colon + 1),
+                        3000, &err);
+    if (fd < 0) continue;
+    bool ok = Rpc(fd, cmd, body, resp, status, 4096);
+    close(fd);
+    if (ok) return true;
+  }
+  return false;
+}
+
+void RecoveryManager::ThreadMain() {
+  // Wait for the reporter to join a tracker and learn the peer list.
+  std::vector<PeerInfo> peers;
+  for (int i = 0; i < 300 && !stop_; ++i) {
+    peers = reporter_->peers();
+    if (!peers.empty()) break;
+    usleep(100 * 1000);
+  }
+
+  std::string self;
+  PutFixedField(&self, cfg_.group_name, kGroupNameMaxLen);
+  PutFixedField(&self, reporter_->my_ip(), kIpAddressSize);
+  {
+    char num[8];
+    PutInt64BE(cfg_.port, reinterpret_cast<uint8_t*>(num));
+    self.append(num, 8);
+  }
+
+  // Re-enter full-sync, then rebuild; every failure retries with backoff
+  // (a dead source is re-negotiated each round).  Going ACTIVE with a
+  // wiped disk is never an option, so this loop runs until it succeeds,
+  // the group turns out to be source-less (sole member), or shutdown.
+  (void)peers;
+  int backoff_ms = 1000;
+  while (!stop_) {
+    // Negotiate a source.  EAGAIN: peers exist but none ACTIVE yet
+    // (whole-group restart) — wait for one to come up.
+    std::string resp;
+    PeerInfo source;
+    bool have_source = false;
+    bool settled = false;
+    while (!stop_) {
+      uint8_t status = 0;
+      if (!TrackerRpc(static_cast<uint8_t>(TrackerCmd::kStorageSyncDestQuery),
+                      self, &resp, &status)) {
+        usleep(500 * 1000);  // no tracker reachable yet
+        continue;
+      }
+      if (status == 11) {  // EAGAIN
+        usleep(500 * 1000);
+        continue;
+      }
+      if (status == 0 && resp.size() >= kIpAddressSize + 16) {
+        const uint8_t* p = reinterpret_cast<const uint8_t*>(resp.data());
+        source.ip = GetFixedField(p, kIpAddressSize);
+        source.port = static_cast<int>(GetInt64BE(p + kIpAddressSize));
+        have_source = true;
+      } else {
+        settled = true;  // sole member (tracker promoted us): nothing to do
+      }
+      break;
+    }
+    if (stop_ || settled) break;
+    if (!have_source) continue;
+
+    FDFS_LOG_INFO("disk recovery: rebuilding from %s:%d", source.ip.c_str(),
+                  source.port);
+    bool all_ok = true;
+    for (int spi = 0; spi < store_->store_path_count() && !stop_; ++spi)
+      all_ok = RecoverPath(source, spi) && all_ok;
+    if (all_ok) break;
+    FDFS_LOG_WARN("disk recovery round failed: retrying in %d ms",
+                  backoff_ms);
+    for (int i = 0; i < backoff_ms / 100 && !stop_; ++i) usleep(100 * 1000);
+    backoff_ms = std::min(backoff_ms * 2, 30000);
+  }
+
+  if (!stop_) {
+    reporter_->set_recovering(false);  // future re-joins are normal again
+    std::string nresp;
+    uint8_t nstatus = 0;
+    TrackerRpc(static_cast<uint8_t>(TrackerCmd::kStorageSyncNotify), self,
+               &nresp, &nstatus);
+    unlink(marker_path_.c_str());
+    FDFS_LOG_INFO("disk recovery complete: %lld files restored, %lld skipped",
+                  static_cast<long long>(files_recovered_.load()),
+                  static_cast<long long>(files_skipped_.load()));
+  }
+  running_ = false;
+}
+
+bool RecoveryManager::FetchOnePathBinlog(const PeerInfo& peer, int spi,
+                                         std::string* lines) {
+  std::string err;
+  int fd = TcpConnect(peer.ip, peer.port, 3000, &err);
+  if (fd < 0) return false;
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  body.push_back(static_cast<char>(spi));
+  uint8_t status = 0;
+  bool ok = Rpc(fd, static_cast<uint8_t>(StorageCmd::kFetchOnePathBinlog),
+                body, lines, &status, 1LL << 31);
+  close(fd);
+  return ok && status == 0;
+}
+
+bool RecoveryManager::DownloadToFile(const PeerInfo& peer,
+                                     const std::string& remote,
+                                     const std::string& dest_path,
+                                     bool* missing) {
+  // Streamed, not buffered: recovered files can be arbitrarily large (the
+  // size field is 48 bits) and must never have to fit in memory.
+  *missing = false;
+  std::string err;
+  int fd = TcpConnect(peer.ip, peer.port, 3000, &err);
+  if (fd < 0) return false;
+  std::string body(16, '\0');  // 8B offset 0 + 8B count 0 (whole file)
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  body += remote;
+  uint8_t hdr[kHeaderSize];
+  PutInt64BE(static_cast<int64_t>(body.size()), hdr);
+  hdr[8] = static_cast<uint8_t>(StorageCmd::kDownloadFile);
+  hdr[9] = 0;
+  bool ok = SendAll(fd, hdr, sizeof(hdr), kRpcTimeoutMs) &&
+            SendAll(fd, body.data(), body.size(), kRpcTimeoutMs) &&
+            RecvAll(fd, hdr, sizeof(hdr), kRpcTimeoutMs);
+  if (!ok) {
+    close(fd);
+    return false;
+  }
+  int64_t len = GetInt64BE(hdr);
+  uint8_t status = hdr[9];
+  if (status != 0 || len < 0) {
+    close(fd);
+    *missing = true;
+    return status == 2;  // ENOENT: deleted since the record — skip is fine
+  }
+  int out = open(dest_path.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644);
+  if (out < 0) {
+    close(fd);
+    return false;
+  }
+  char buf[256 * 1024];
+  int64_t left = len;
+  while (left > 0 && !stop_) {
+    size_t want = static_cast<size_t>(
+        std::min<int64_t>(left, static_cast<int64_t>(sizeof(buf))));
+    if (!RecvAll(fd, buf, want, kRpcTimeoutMs) ||
+        write(out, buf, want) != static_cast<ssize_t>(want)) {
+      close(out);
+      close(fd);
+      unlink(dest_path.c_str());
+      return false;
+    }
+    left -= static_cast<int64_t>(want);
+  }
+  close(out);
+  close(fd);
+  if (left > 0) {  // stop_ interrupted mid-stream
+    unlink(dest_path.c_str());
+    return false;
+  }
+  return true;
+}
+
+bool RecoveryManager::FetchMetadata(const PeerInfo& peer,
+                                    const std::string& remote,
+                                    std::string* meta) {
+  std::string err;
+  int fd = TcpConnect(peer.ip, peer.port, 3000, &err);
+  if (fd < 0) return false;
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  body += remote;
+  uint8_t status = 0;
+  bool ok = Rpc(fd, static_cast<uint8_t>(StorageCmd::kGetMetadata), body,
+                meta, &status, 16 << 20);
+  close(fd);
+  return ok && status == 0 && !meta->empty();
+}
+
+bool RecoveryManager::StoreRecovered(const std::string& remote,
+                                     const std::string& tmp_path) {
+  auto parts = DecodeFileId(cfg_.group_name + "/" + remote);
+  if (parts.has_value() && parts->trunk_loc.has_value()) {
+    // Trunk slots are bounded by slot_max_size; reading the staged file
+    // back into memory is fine here.
+    std::string content, err;
+    if (!ReadWholeFile(tmp_path, &content) ||
+        !WriteSlotPayload(store_->store_path(0), *parts->trunk_loc, content,
+                          parts->crc32, &err)) {
+      FDFS_LOG_ERROR("recovery trunk write %s: %s", remote.c_str(),
+                     err.c_str());
+      unlink(tmp_path.c_str());
+      return false;
+    }
+    unlink(tmp_path.c_str());
+    return true;
+  }
+  int spi = 0;
+  sscanf(remote.c_str(), "M%02X/", &spi);
+  if (spi >= store_->store_path_count()) {
+    unlink(tmp_path.c_str());
+    return false;
+  }
+  auto local = LocalPath(store_->store_path(spi), remote);
+  if (!local.has_value()) {
+    unlink(tmp_path.c_str());
+    return false;
+  }
+  StoreManager::EnsureParentDirs(*local);
+  if (rename(tmp_path.c_str(), local->c_str()) != 0) {
+    unlink(tmp_path.c_str());
+    return false;
+  }
+  return true;
+}
+
+bool RecoveryManager::RecoverPath(const PeerInfo& peer, int spi) {
+  std::string lines;
+  if (!FetchOnePathBinlog(peer, spi, &lines)) {
+    FDFS_LOG_ERROR("recovery: fetch one-path binlog (path %d) from %s:%d "
+                   "failed", spi, peer.ip.c_str(), peer.port);
+    return false;
+  }
+  // Unique filenames, in first-seen order; every op type names a file that
+  // should exist now unless later deleted (the peer answers ENOENT then).
+  std::set<std::string> seen;
+  std::vector<std::string> files;
+  size_t pos = 0;
+  while (pos < lines.size()) {
+    size_t nl = lines.find('\n', pos);
+    std::string line = lines.substr(pos, nl == std::string::npos
+                                             ? std::string::npos
+                                             : nl - pos + 1);
+    pos = nl == std::string::npos ? lines.size() : nl + 1;
+    auto rec = ParseBinlogRecord(line);
+    if (!rec.has_value()) continue;
+    if (rec->op == 'D' || rec->op == 'd') continue;  // gone; skip fast
+    if (seen.insert(rec->filename).second) files.push_back(rec->filename);
+  }
+  FDFS_LOG_INFO("recovery: path %d has %zu candidate files", spi,
+                files.size());
+  bool all_ok = true;
+  for (const std::string& remote : files) {
+    if (stop_) return false;
+    std::string staged = store_->NewTmpPath(spi);
+    bool missing = false;
+    if (!DownloadToFile(peer, remote, staged, &missing)) {
+      FDFS_LOG_WARN("recovery: download %s failed", remote.c_str());
+      all_ok = false;
+      continue;
+    }
+    if (missing) {  // deleted on the peer since the record was written
+      files_skipped_++;
+      continue;
+    }
+    if (!StoreRecovered(remote, staged)) {
+      all_ok = false;
+      continue;
+    }
+    std::string meta;
+    if (FetchMetadata(peer, remote, &meta)) {
+      auto local = LocalPath(store_->store_path(spi), remote);
+      if (local.has_value()) {
+        EnsureParentDirs(*local);
+        std::string mtmp = *local + "-m.rec";
+        FILE* f = fopen(mtmp.c_str(), "w");
+        if (f != nullptr) {
+          fwrite(meta.data(), 1, meta.size(), f);
+          fclose(f);
+          rename(mtmp.c_str(), (*local + "-m").c_str());
+        }
+      }
+    }
+    files_recovered_++;
+  }
+  return all_ok;
+}
+
+}  // namespace fdfs

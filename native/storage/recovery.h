@@ -1,0 +1,71 @@
+// Disk recovery: rebuild a wiped/replaced store path from a group peer.
+//
+// Reference: storage/storage_disk_recovery.c —
+// storage_disk_recovery_start() fetches the one-path binlog from a peer
+// (STORAGE_PROTO_CMD_FETCH_ONE_PATH_BINLOG) and re-downloads every file it
+// lists; the recovering server is held out of read routing (status
+// RECOVERY upstream; WAIT_SYNC/SYNCING here via the tracker's re-enter-
+// sync handshake) until it declares done.
+//
+// Honest divergences: upstream restores CREATE_LINK files as links; the
+// rebuild re-downloads the content (a full copy — correct bytes, more
+// space).  Metadata sidecars are restored via GET_METADATA from the peer.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "storage/config.h"
+#include "storage/store.h"
+#include "storage/tracker_client.h"
+
+namespace fdfs {
+
+class RecoveryManager {
+ public:
+  RecoveryManager(const StorageConfig& cfg, TrackerReporter* reporter,
+                  StoreManager* store);
+  ~RecoveryManager();
+
+  // Whether recovery is needed: a store path was freshly (re-)initialized
+  // although this server had previously joined a group (sync marks
+  // exist), or a prior recovery never finished (.recovery marker).
+  // Decided BEFORE the reporter joins so the JOIN can carry the
+  // recovering flag (the node must never pass through ACTIVE with a
+  // wiped disk).
+  bool NeedsRecovery(bool data_was_fresh) const;
+  // Start the background rebuild (call only when NeedsRecovery).
+  void Start();
+  void Stop();
+  bool running() const { return running_; }
+  int64_t files_recovered() const { return files_recovered_; }
+  int64_t files_skipped() const { return files_skipped_; }
+
+ private:
+  void ThreadMain();
+  // One tracker RPC against any responsive configured tracker.
+  bool TrackerRpc(uint8_t cmd, const std::string& body, std::string* resp,
+                  uint8_t* status);
+  bool RecoverPath(const PeerInfo& peer, int spi);
+  bool FetchOnePathBinlog(const PeerInfo& peer, int spi, std::string* lines);
+  bool DownloadToFile(const PeerInfo& peer, const std::string& remote,
+                      const std::string& dest_path, bool* missing);
+  bool FetchMetadata(const PeerInfo& peer, const std::string& remote,
+                     std::string* meta);
+  bool StoreRecovered(const std::string& remote, const std::string& tmp_path);
+
+  StorageConfig cfg_;
+  TrackerReporter* reporter_;
+  StoreManager* store_;
+  std::string marker_path_;
+  std::thread thread_;
+  std::atomic<bool> stop_{false};
+  std::atomic<bool> running_{false};
+  std::atomic<int64_t> files_recovered_{0};
+  std::atomic<int64_t> files_skipped_{0};
+};
+
+}  // namespace fdfs

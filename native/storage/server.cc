@@ -134,7 +134,17 @@ bool StorageServer::Init(std::string* error) {
         [this](const std::vector<PeerInfo>& peers) {
           sync_->UpdatePeers(peers);
         });
+    // Disk recovery (storage_disk_recovery.c): a wiped store path on a
+    // server with prior sync state rebuilds itself from a group peer in
+    // the background.  Decided BEFORE the first JOIN so the recovering
+    // flag rides it — the node must never pass through ACTIVE (and take
+    // reads for files it no longer has) on its way into recovery.
+    recovery_ = std::make_unique<RecoveryManager>(cfg_, reporter_.get(),
+                                                  &store_);
+    bool needs_recovery = recovery_->NeedsRecovery(store_.any_path_was_fresh());
+    reporter_->set_recovering(needs_recovery);
     reporter_->Start();
+    if (needs_recovery) recovery_->Start();
   }
 
   // Periodic maintenance (reference: sched_thread entries — binlog flush,
@@ -158,6 +168,7 @@ void StorageServer::Stop() {
   // tracker-RPC timeout, and durability must not ride on that.
   if (dedup_ != nullptr) dedup_->Save();
   binlog_.Flush();
+  if (recovery_ != nullptr) recovery_->Stop();
   if (sync_ != nullptr) sync_->Stop();  // persists .mark cursors
   if (reporter_ != nullptr) reporter_->Stop();
   loop_.Stop();
@@ -542,6 +553,7 @@ void StorageServer::OnHeaderComplete(Conn* c) {
     case StorageCmd::kTrunkAllocSpace:
     case StorageCmd::kTrunkAllocConfirm:
     case StorageCmd::kTrunkFreeSpace:
+    case StorageCmd::kFetchOnePathBinlog:
       if (c->pkg_len > kMaxInlineBody) {
         CloseConn(c);
         return;
@@ -661,6 +673,9 @@ void StorageServer::OnFixedComplete(Conn* c) {
     case StorageCmd::kTrunkAllocConfirm:
     case StorageCmd::kTrunkFreeSpace:
       HandleTrunkRpc(c);
+      return;
+    case StorageCmd::kFetchOnePathBinlog:
+      HandleFetchOnePathBinlog(c);
       return;
     case StorageCmd::kSyncCreateLink:
     case StorageCmd::kCreateLink:
@@ -862,12 +877,20 @@ bool StorageServer::TrunkEligible(int64_t size) const {
          (is_trunk_server_ || trunk_port_ > 0);
 }
 
+// Trunk RPC timeout: these calls run synchronously on the nio loop (as
+// upstream's do on its service threads), so a dead trunk server stalls
+// this event loop for at most this long before the upload falls back to a
+// flat file.  The beat trailer clears a dead trunk server within ~1
+// heartbeat, so the stall is one-shot, but an async alloc path would
+// remove it entirely.
+constexpr int kTrunkRpcTimeoutMs = 1000;
+
 std::optional<TrunkLocation> StorageServer::TrunkAlloc(int64_t payload_size) {
   if (is_trunk_server_ && trunk_alloc_ != nullptr)
     return trunk_alloc_->Alloc(payload_size);
   if (trunk_port_ > 0)
     return TrunkAllocRpc(trunk_ip_, trunk_port_, cfg_.group_name,
-                         payload_size, 5000);
+                         payload_size, kTrunkRpcTimeoutMs);
   return std::nullopt;
 }
 
@@ -881,7 +904,8 @@ void StorageServer::TrunkFree(const TrunkLocation& loc) {
   // remaining replicas free theirs via the 'd' binlog replay.)
   MarkSlotFree(store_.store_path(0), loc);
   if (trunk_port_ > 0) {
-    if (!TrunkFreeRpc(trunk_ip_, trunk_port_, cfg_.group_name, loc, 5000))
+    if (!TrunkFreeRpc(trunk_ip_, trunk_port_, cfg_.group_name, loc,
+                      kTrunkRpcTimeoutMs))
       FDFS_LOG_WARN("trunk free RPC failed (id=%u off=%u): slot leaked until "
                     "the free-block checker reclaims it",
                     loc.trunk_id, loc.offset);
@@ -912,7 +936,8 @@ std::string StorageServer::TrunkStoreUpload(Conn* c) {
     return "";
   }
   if (!is_trunk_server_)
-    TrunkConfirmRpc(trunk_ip_, trunk_port_, cfg_.group_name, *loc, 5000);
+    TrunkConfirmRpc(trunk_ip_, trunk_port_, cfg_.group_name, *loc,
+                    kTrunkRpcTimeoutMs);
   return id;
 }
 
@@ -961,6 +986,46 @@ void StorageServer::HandleTrunkRpc(Conn* c) {
     return;
   }
   Respond(c, trunk_alloc_->Free(loc) ? 0 : 22);
+}
+
+bool StorageServer::RemoteExists(const std::string& group,
+                                 const std::string& remote,
+                                 const std::string& local) {
+  auto parts = DecodeFileId(group + "/" + remote);
+  if (parts.has_value() && parts->trunk_loc.has_value()) {
+    std::string tp =
+        TrunkFilePath(store_.store_path(0), parts->trunk_loc->trunk_id);
+    int fd = open(tp.c_str(), O_RDONLY);
+    if (fd < 0) return false;
+    auto h = ReadSlotHeader(fd, parts->trunk_loc->offset);
+    close(fd);
+    return h.has_value() && h->type == kTrunkSlotData &&
+           h->alloc_size == parts->trunk_loc->alloc_size &&
+           h->file_size == parts->file_size && h->crc32 == parts->crc32;
+  }
+  struct stat st;
+  return stat(local.c_str(), &st) == 0;
+}
+
+// FETCH_ONE_PATH_BINLOG (26): every binlog record whose file lives on the
+// requested store path, as raw lines — the feed a recovering peer replays
+// to re-download its wiped disk (storage_disk_recovery.c).
+void StorageServer::HandleFetchOnePathBinlog(Conn* c) {
+  if (c->fixed.size() < 17) {
+    Respond(c, 22);
+    return;
+  }
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  if (GroupFromField(p) != cfg_.group_name) {
+    Respond(c, 22);
+    return;
+  }
+  int spi = static_cast<uint8_t>(c->fixed[16]);
+  if (spi >= store_.store_path_count()) {
+    Respond(c, 22);
+    return;
+  }
+  Respond(c, 0, CollectOnePathBinlog(cfg_.base_path + "/data/sync", spi));
 }
 
 void StorageServer::HandleTrunkDownload(Conn* c, const FileIdParts& parts,
@@ -1173,10 +1238,12 @@ void StorageServer::HandleDelete(Conn* c) {
                 h->alloc_size == tparts->trunk_loc->alloc_size &&
                 h->file_size == tparts->file_size &&
                 h->crc32 == tparts->crc32;
+    std::string sidecar = ResolveLocal(group, remote);
     if (replica) {
       // Replay: free our local copy if this exact file still occupies the
       // slot; otherwise it is already gone (or reused) — both fine.
       if (live) MarkSlotFree(store_.store_path(0), *tparts->trunk_loc);
+      if (!sidecar.empty()) unlink((sidecar + "-m").c_str());
       binlog_.Append('d', remote);
       Respond(c, 0);
       return;
@@ -1186,6 +1253,7 @@ void StorageServer::HandleDelete(Conn* c) {
       return;
     }
     TrunkFree(*tparts->trunk_loc);
+    if (!sidecar.empty()) unlink((sidecar + "-m").c_str());
     if (dedup_ != nullptr) dedup_->Forget(group + "/" + remote);
     binlog_.Append(kBinlogOpDelete, remote);
     stats_.success_delete++;
@@ -1291,8 +1359,7 @@ void StorageServer::HandleSetMetadata(Conn* c) {
     Respond(c, 22);
     return;
   }
-  struct stat st;
-  if (stat(local.c_str(), &st) != 0) {
+  if (!RemoteExists(group, remote, local)) {
     Respond(c, 2);
     return;
   }
@@ -1331,6 +1398,9 @@ void StorageServer::HandleSetMetadata(Conn* c) {
       meta = out;
     }
   }
+  // Trunk files have no flat write that would have created the fan-out
+  // dir their sidecar lives in.
+  StoreManager::EnsureParentDirs(meta_path);
   if (!WriteSidecarAtomic(meta_path, meta)) {
     Respond(c, 5);
     return;
@@ -1362,12 +1432,9 @@ void StorageServer::HandleGetMetadata(Conn* c) {
     size_t n;
     while ((n = fread(buf, 1, sizeof(buf), f)) > 0) meta.append(buf, n);
     fclose(f);
-  } else {
-    struct stat st;
-    if (stat(local.c_str(), &st) != 0) {
-      Respond(c, 2);
-      return;
-    }
+  } else if (!RemoteExists(group, remote, local)) {
+    Respond(c, 2);
+    return;
   }
   stats_.success_get_meta++;
   Respond(c, 0, meta);
@@ -1452,11 +1519,11 @@ void StorageServer::HandleSyncUpdate(Conn* c) {
     Respond(c, 22);
     return;
   }
-  struct stat st;
-  if (stat(local.c_str(), &st) != 0) {
+  if (!RemoteExists(group, remote, local)) {
     Respond(c, 2);
     return;
   }
+  StoreManager::EnsureParentDirs(local + "-m");
   if (!WriteSidecarAtomic(local + "-m", meta)) {
     Respond(c, 5);
     return;
@@ -1604,10 +1671,9 @@ bool StorageServer::BeginSlaveUpload(Conn* c) {
   std::string master = c->fixed.substr(kPrefixLen);
   std::string master_local = ResolveLocal(group, master);
   auto parts = DecodeFileId(group + "/" + master);
-  struct stat st;
   if (master_local.empty() || !parts.has_value() ||
       c->slave_prefix.empty() || !parts->prefix.empty() /*no slave-of-slave*/ ||
-      stat(master_local.c_str(), &st) != 0) {
+      !RemoteExists(group, master, master_local) /*trunk-aware*/) {
     RespondError(c, 22);
     return false;
   }

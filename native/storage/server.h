@@ -25,6 +25,7 @@
 #include "storage/binlog.h"
 #include "storage/config.h"
 #include "storage/dedup.h"
+#include "storage/recovery.h"
 #include "storage/store.h"
 #include "storage/sync.h"
 #include "storage/tracker_client.h"
@@ -172,11 +173,16 @@ class StorageServer {
   // failure (caller falls back to flat).
   std::string TrunkStoreUpload(Conn* c);
   void HandleTrunkRpc(Conn* c);      // cmds 27/28/29 server side
+  void HandleFetchOnePathBinlog(Conn* c);  // cmd 26 (disk-recovery feed)
   void HandleTrunkDownload(Conn* c, const FileIdParts& parts, int64_t offset,
                            int64_t count);
   // Resolve "group/remote" or "remote" to a local path; empty on error.
   std::string ResolveLocal(const std::string& group,
                            const std::string& remote) const;
+  // Existence check that understands trunk names: flat inode present, or
+  // the trunk slot is live with this ID's exact identity.
+  bool RemoteExists(const std::string& group, const std::string& remote,
+                    const std::string& local);
   std::string MyIp() const;
 
   StorageConfig cfg_;
@@ -185,6 +191,7 @@ class StorageServer {
   std::unique_ptr<DedupPlugin> dedup_;
   std::unique_ptr<TrackerReporter> reporter_;
   std::unique_ptr<SyncManager> sync_;
+  std::unique_ptr<RecoveryManager> recovery_;
   EventLoop loop_;
   int listen_fd_ = -1;
   std::unordered_map<int, std::unique_ptr<Conn>> conns_;

@@ -20,6 +20,7 @@ bool StoreManager::Init(const StorageConfig& cfg, std::string* error) {
     std::string flag = data + "/.data_init_flag";
     struct stat st;
     if (stat(flag.c_str(), &st) == 0) continue;  // already initialized
+    any_fresh_ = true;
     // Pre-create the two-level fan-out (reference:
     // storage_make_data_dirs()).
     for (int i = 0; i < subdir_count_; ++i) {

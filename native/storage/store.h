@@ -21,6 +21,10 @@ class StoreManager {
   bool Init(const StorageConfig& cfg, std::string* error);
 
   int PickStorePath();  // round-robin (reference: store_path rr policy)
+  // True when Init created at least one data dir from scratch — on a
+  // server with prior sync state this means the disk was wiped/replaced
+  // (disk-recovery trigger, storage_disk_recovery.c).
+  bool any_path_was_fresh() const { return any_fresh_; }
   int store_path_count() const { return static_cast<int>(paths_.size()); }
   const std::string& store_path(int i) const { return paths_[i]; }
   int subdir_count() const { return subdir_count_; }
@@ -42,6 +46,7 @@ class StoreManager {
   std::atomic<uint32_t> uniq_{0};
   std::atomic<uint32_t> tmp_seq_{0};
   int next_path_ = 0;
+  bool any_fresh_ = false;
 };
 
 }  // namespace fdfs

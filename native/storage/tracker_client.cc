@@ -128,10 +128,15 @@ bool TrackerReporter::ParsePeers(const std::string& body) {
     peers.push_back(std::move(pi));
   }
   // Optional trailer: the group's elected trunk server (beat responses).
+  // A zeroed trailer means "no trunk server right now" and MUST clear the
+  // cache — keeping a dead address would burn a connect timeout on every
+  // small upload forever.  Only a response with no trailer at all (JOIN)
+  // leaves the cache untouched.
   size_t tail = 8 + static_cast<size_t>(count) * rec;
+  bool have_trailer = body.size() >= tail + kIpAddressSize + 8;
   std::string tip;
   int tport = 0;
-  if (body.size() >= tail + kIpAddressSize + 8) {
+  if (have_trailer) {
     const uint8_t* q = p + tail;
     tip = GetFixedField(q, kIpAddressSize);
     tport = static_cast<int>(GetInt64BE(q + kIpAddressSize));
@@ -141,7 +146,7 @@ bool TrackerReporter::ParsePeers(const std::string& body) {
     std::lock_guard<std::mutex> lk(mu_);
     changed = peers != peers_;
     peers_ = peers;
-    if (tport > 0 || !tip.empty()) {
+    if (have_trailer) {
       trunk_ip_ = tip;
       trunk_port_ = tport;
     }
@@ -161,6 +166,7 @@ bool TrackerReporter::DoJoin(int fd, const std::string&) {
   PutFixedField(&body, my_ip(), kIpAddressSize);
   AppendInt64(&body, cfg_.port);
   AppendInt64(&body, static_cast<int64_t>(cfg_.store_paths.size()));
+  AppendInt64(&body, recovering_ ? 1 : 0);  // flags: bit0 = disk recovery
   std::string resp;
   uint8_t status;
   if (!Rpc(fd, static_cast<uint8_t>(TrackerCmd::kStorageJoin), body, &resp,
@@ -169,7 +175,9 @@ bool TrackerReporter::DoJoin(int fd, const std::string&) {
     return false;
   if (!ParsePeers(resp)) return false;
   DoParameterReq(fd);
-  DoSyncDestReq(fd);
+  // During disk recovery the negotiation belongs to the recovery thread
+  // (SYNC_DEST_QUERY with held promotion), not the join path.
+  if (!recovering_) DoSyncDestReq(fd);
   return true;
 }
 

@@ -41,6 +41,11 @@ class TrackerReporter {
 
   void Start();
   void Stop();
+  // Disk recovery in progress: JOINs carry the recovering flag (tracker
+  // holds the node in WAIT_SYNC) and the join-time sync negotiation is
+  // left to the recovery thread.  Cleared when the rebuild completes.
+  void set_recovering(bool v) { recovering_ = v; }
+  bool recovering() const { return recovering_; }
   // Source->tracker sync progress report (called by sync threads).
   void ReportSyncProgress(const std::string& dest_ip, int dest_port,
                           int64_t ts);
@@ -65,6 +70,7 @@ class TrackerReporter {
   StatsSnapshotFn stats_fn_;
   PeersCallback peers_cb_;
   std::atomic<bool> stop_{false};
+  std::atomic<bool> recovering_{false};
   std::vector<std::thread> threads_;
   mutable std::mutex mu_;
   std::string my_ip_;

@@ -296,31 +296,45 @@ std::optional<TrunkLocation> TrunkAllocator::Alloc(int64_t payload_size) {
   }
 
   int fd = OpenTrunkFd(store_path_, block.trunk_id, /*create=*/false);
-  if (fd < 0) return std::nullopt;
-  int64_t remainder = static_cast<int64_t>(block.alloc_size) - need;
-  uint32_t used = static_cast<uint32_t>(need);
-  if (remainder >= kTrunkMinSplit) {
-    TrunkSlotHeader fh;
-    fh.type = kTrunkSlotFree;
-    fh.alloc_size = static_cast<uint32_t>(remainder);
-    if (!WriteSlotHeader(fd, block.offset + need, fh)) {
-      close(fd);
-      return std::nullopt;
-    }
-    free_[remainder].push_back({block.trunk_id, block.offset +
-                                                    static_cast<uint32_t>(need)});
-  } else {
-    used = block.alloc_size;  // tiny remainder stays padding in this slot
+  if (fd < 0) {
+    // Popped block goes back on ANY failure — a transient EIO must not
+    // leak capacity from the pool until the next scan-rebuild.
+    free_[block.alloc_size].push_back({block.trunk_id, block.offset});
+    return std::nullopt;
   }
-  // The 'D' header makes the allocation durable — a rebuilt allocator will
-  // never hand this slot out again.
+  int64_t remainder = static_cast<int64_t>(block.alloc_size) - need;
+  uint32_t used = remainder >= kTrunkMinSplit
+                      ? static_cast<uint32_t>(need)
+                      : block.alloc_size;  // tiny remainder stays padding
+  // 'D' header FIRST: it makes the allocation durable (a rebuilt
+  // allocator will never hand this slot out again), and ordering it
+  // before the split keeps every failure path a clean whole-block
+  // restore.
   TrunkSlotHeader dh;
   dh.type = kTrunkSlotData;
   dh.alloc_size = used;
   dh.mtime = static_cast<uint32_t>(time(nullptr));
-  bool ok = WriteSlotHeader(fd, block.offset, dh);
+  if (!WriteSlotHeader(fd, block.offset, dh)) {
+    close(fd);
+    free_[block.alloc_size].push_back({block.trunk_id, block.offset});
+    return std::nullopt;
+  }
+  if (used != block.alloc_size) {
+    TrunkSlotHeader fh;
+    fh.type = kTrunkSlotFree;
+    fh.alloc_size = static_cast<uint32_t>(remainder);
+    if (!WriteSlotHeader(fd, block.offset + need, fh)) {
+      // Pool still owns the remainder (Alloc never re-reads headers); the
+      // missing 'F' header only matters to a future scan-rebuild, whose
+      // torn-chain reclaim recovers exactly this extent.
+      FDFS_LOG_WARN("trunk %06u: split header write failed at %lld",
+                    block.trunk_id,
+                    static_cast<long long>(block.offset + need));
+    }
+    free_[remainder].push_back(
+        {block.trunk_id, block.offset + static_cast<uint32_t>(need)});
+  }
   close(fd);
-  if (!ok) return std::nullopt;
   TrunkLocation out;
   out.trunk_id = block.trunk_id;
   out.offset = block.offset;

@@ -49,7 +49,7 @@ StorageNode* Cluster::FindNode(const std::string& group,
 
 std::optional<std::vector<StorageNode>> Cluster::Join(
     const std::string& group, const std::string& ip, int port,
-    int store_path_count, int64_t now) {
+    int store_path_count, int64_t now, bool recovering) {
   GroupInfo& g = groups_[group];
   g.name = group;
   std::string addr = ip + ":" + std::to_string(port);
@@ -68,9 +68,15 @@ std::optional<std::vector<StorageNode>> Cluster::Join(
   node.port = port;
   node.store_path_count = store_path_count;
   // A brand-new server in a non-empty group must full-sync before serving
-  // (WAIT_SYNC; promoted via SyncDestReq/SyncReport).  A known server
-  // re-joining keeps an in-flight sync state; anything else goes ACTIVE.
-  if (fresh && g.storages.size() > 1) {
+  // (WAIT_SYNC; promoted via SyncDestReq/SyncReport).  A disk-recovering
+  // server is likewise held out of routing until its explicit done-notify.
+  // A known server re-joining keeps an in-flight sync state; anything
+  // else goes ACTIVE.
+  if (recovering) {
+    node.status = kWaitSync;
+    node.sync_src_addr.clear();  // no auto-promotion path while rebuilding
+    node.sync_until_ts = 0;
+  } else if (fresh && g.storages.size() > 1) {
     node.status = kWaitSync;
   } else if (node.status != kWaitSync && node.status != kSyncing) {
     node.status = kActive;
@@ -219,6 +225,32 @@ bool Cluster::SetTrunkServer(const std::string& group,
   FDFS_LOG_INFO("group %s trunk server set to %s by operator", group.c_str(),
                 addr.c_str());
   return true;
+}
+
+int Cluster::ReenterSync(const std::string& group,
+                         const std::string& dest_addr, int64_t now,
+                         StorageNode* src) {
+  StorageNode* n = FindNode(group, dest_addr);
+  if (n == nullptr) return -1;
+  n->synced_from.clear();  // wiped disk: nothing previously synced survives
+  n->status = kWaitSync;
+  n->sync_src_addr.clear();
+  n->sync_until_ts = 0;
+  int64_t until = 0;
+  int rc = SyncDestReq(group, dest_addr, now, src, &until);
+  if (rc == 0) {
+    // Hold promotion for the explicit done-notify: the source's caught-up
+    // reports only cover NEW writes, not the re-download of history.
+    n->sync_until_ts = INT64_MAX / 2;
+  } else if (rc == 1 && FindGroup(group)->storages.size() > 1) {
+    // No ACTIVE source YET, but peers exist (whole-group restart): the
+    // wiped node must NOT go ACTIVE — an empty disk would take reads and
+    // even win trunk-server election.  Hold WAIT_SYNC; the recovery
+    // thread retries until a peer comes up.
+    n->status = kWaitSync;
+    return 2;
+  }
+  return rc;
 }
 
 bool Cluster::SyncNotify(const std::string& group,

@@ -78,10 +78,13 @@ class Cluster {
   // -- membership (tracker_mem_add_storage / beats) ----------------------
   // nullopt: rejected (another member already owns this IP on a different
   // port — file-ID source identity is IP-only, so one member per IP).
+  // recovering: the server is rebuilding a wiped disk — hold it in
+  // WAIT_SYNC (never ACTIVE) until its recovery declares done.
   std::optional<std::vector<StorageNode>> Join(const std::string& group,
                                                const std::string& ip, int port,
                                                int store_path_count,
-                                               int64_t now);
+                                               int64_t now,
+                                               bool recovering = false);
   bool Beat(const std::string& group, const std::string& ip, int port,
             const int64_t* stats, int64_t now);
   bool UpdateDiskUsage(const std::string& group, const std::string& ip,
@@ -106,6 +109,13 @@ class Cluster {
                                     const std::string& dest_addr) const;
   // Dest (or its source) declares old-data sync done: promote to ACTIVE.
   bool SyncNotify(const std::string& group, const std::string& dest_addr);
+  // Disk recovery (storage_disk_recovery.c): a member whose data was wiped
+  // re-enters full-sync — synced_from cleared (its replicas are gone), a
+  // source assigned, and promotion held until its explicit SyncNotify
+  // (sentinel until_ts; auto-promotion via sync reports must not fire
+  // while it is still re-downloading).  Return codes as SyncDestReq.
+  int ReenterSync(const std::string& group, const std::string& dest_addr,
+                  int64_t now, StorageNode* src);
 
   // -- trunk server election (leader decides; SURVEY §2.1/§2.3) ----------
   // Current trunk server for the group ("" when none); elects/repairs on

@@ -122,17 +122,19 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       return {0, ""};
 
     case TrackerCmd::kStorageJoin: {
-      // 16B group + 16B ip + 8B port + 8B store_path_count
+      // 16B group + 16B ip + 8B port + 8B store_path_count [+ 8B flags:
+      // bit0 = disk recovery in progress]
       if (body.size() < 48) return {22, ""};
       std::string group = FixedGroup(p);
       std::string ip = FixedIp(p + 16);
       if (ip.empty()) ip = peer_ip;
       int64_t port = GetInt64BE(p + 32);
       int64_t spc = GetInt64BE(p + 40);
+      bool recovering = body.size() >= 56 && (GetInt64BE(p + 48) & 1) != 0;
       if (group.empty() || port <= 0 || port > 65535 || spc < 1 || spc > 256)
         return {22, ""};
       auto peers = cluster_->Join(group, ip, static_cast<int>(port),
-                                  static_cast<int>(spc), now);
+                                  static_cast<int>(spc), now, recovering);
       if (!peers.has_value()) return {114 /*EALREADY*/, ""};
       return {0, PackPeers(*peers)};
     }
@@ -266,6 +268,28 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       if (!until.has_value()) return {2, ""};
       std::string out(8, '\0');
       PutInt64BE(*until, reinterpret_cast<uint8_t*>(out.data()));
+      return {0, out};
+    }
+
+    case TrackerCmd::kStorageSyncDestQuery: {
+      // Disk recovery re-entry: 16B group + 16B ip + 8B port.  Same reply
+      // shape as SYNC_DEST_REQ.
+      if (body.size() < 40) return {22, ""};
+      std::string group = FixedGroup(p);
+      std::string dest =
+          FixedIp(p + 16) + ":" + std::to_string(GetInt64BE(p + 32));
+      StorageNode src;
+      int rc = cluster_->ReenterSync(group, dest, now, &src);
+      if (rc < 0) return {2, ""};
+      if (rc == 2) return {11 /*EAGAIN: no live source yet, retry*/, ""};
+      if (rc == 1) return {0, ""};
+      std::string out;
+      PutFixedField(&out, src.ip, kIpAddressSize);
+      char buf[8];
+      PutInt64BE(src.port, reinterpret_cast<uint8_t*>(buf));
+      out.append(buf, 8);
+      PutInt64BE(0, reinterpret_cast<uint8_t*>(buf));
+      out.append(buf, 8);
       return {0, out};
     }
 

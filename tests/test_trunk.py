@@ -190,3 +190,34 @@ def test_delete_by_non_trunk_server_frees_its_own_copy(cluster):
         # copy freed synchronously, no replication involved).
         with pytest.raises(StatusError):
             c.download_to_buffer(fid)
+
+
+def test_metadata_on_trunk_file(cluster, fdfs):
+    """Regression: metadata ops must work on trunk files (existence check
+    via slot header, sidecar dirs created on demand)."""
+    fid = fdfs.upload_buffer(b"m" * 2000, ext="jpg")
+    _, info = decode_file_id(fid)
+    assert info.trunk
+    fdfs.set_metadata(fid, {"width": "800", "height": "600"})
+    assert fdfs.get_metadata(fid) == {"width": "800", "height": "600"}
+    fdfs.set_metadata(fid, {"width": "1024"}, merge=True)
+    got = fdfs.get_metadata(fid)
+    assert got["width"] == "1024" and got["height"] == "600"
+    fdfs.delete_file(fid)
+    with pytest.raises(StatusError):
+        fdfs.get_metadata(fid)
+
+
+def test_slave_of_trunk_master(cluster, fdfs):
+    """A slave derived from a trunk-packed master: the slave name inherits
+    the master's full stem (incl. trunk location segment) but the slave
+    itself is stored flat — both must download correctly."""
+    master = fdfs.upload_buffer(b"M" * 3000, ext="jpg")
+    _, minfo = decode_file_id(master)
+    assert minfo.trunk
+    slave = fdfs.upload_slave_buffer(master, "_150x150", b"S" * 500,
+                                     ext="jpg")
+    _, sinfo = decode_file_id(slave)
+    assert sinfo.slave and sinfo.trunk_loc is None  # flat storage
+    assert fdfs.download_to_buffer(slave) == b"S" * 500
+    assert fdfs.download_to_buffer(master) == b"M" * 3000
