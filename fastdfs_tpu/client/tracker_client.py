@@ -169,3 +169,15 @@ class TrackerClient:
         self.conn.send_request(TrackerCmd.ACTIVE_TEST)
         self.conn.recv_response("active_test")
         return True
+
+    def get_tracker_status(self) -> dict:
+        """Multi-tracker relationship probe (TRACKER_GET_STATUS 70):
+        whether this tracker is the leader and who it believes leads."""
+        self.conn.send_request(TrackerCmd.TRACKER_GET_STATUS)
+        resp = self.conn.recv_response("get_tracker_status")
+        if len(resp) < 1 + IP_ADDRESS_SIZE + 8:
+            raise ProtocolError(f"short tracker status: {len(resp)}")
+        ip = resp[1:17].rstrip(b"\x00").decode()
+        port = buff2long(resp, 17)
+        leader = f"{ip}:{port}" if ip and port > 0 else ""
+        return {"am_leader": resp[0] == 1, "leader": leader}

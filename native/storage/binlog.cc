@@ -237,11 +237,13 @@ bool BinlogReader::SaveMark() {
 
 namespace fdfs {
 
-std::string CollectOnePathBinlog(const std::string& sync_dir, int spi) {
+std::string CollectOnePathBinlog(const std::string& sync_dir, int spi,
+                                 int64_t offset, int64_t max_bytes) {
   char want[8];
   std::snprintf(want, sizeof(want), "M%02X/", spi);
   std::string out;
-  for (int idx = 0;; ++idx) {
+  int64_t filtered_pos = 0;  // byte position within the filtered stream
+  for (int idx = 0; static_cast<int64_t>(out.size()) < max_bytes; ++idx) {
     char name[32];
     std::snprintf(name, sizeof(name), "/binlog.%03d", idx);
     FILE* f = fopen((sync_dir + name).c_str(), "r");
@@ -250,7 +252,11 @@ std::string CollectOnePathBinlog(const std::string& sync_dir, int spi) {
     while (fgets(line, sizeof(line), f) != nullptr) {
       auto rec = ParseBinlogRecord(line);
       if (!rec.has_value()) continue;
-      if (rec->filename.rfind(want, 0) == 0) out += line;
+      if (rec->filename.rfind(want, 0) != 0) continue;
+      int64_t len = static_cast<int64_t>(strlen(line));
+      if (filtered_pos >= offset) out.append(line, len);
+      filtered_pos += len;
+      if (static_cast<int64_t>(out.size()) >= max_bytes) break;
     }
     fclose(f);
   }

@@ -64,10 +64,13 @@ class BinlogWriter {
 };
 
 // One-path binlog extraction (FETCH_ONE_PATH_BINLOG 26, the feed for disk
-// recovery): every record in the sync dir whose filename lives on store
-// path `spi`, as raw binlog lines.  Reference:
+// recovery): records in the sync dir whose filename lives on store path
+// `spi`, as raw binlog lines — paged by byte offset into the FILTERED
+// stream so neither side ever buffers the whole history (a page always
+// ends on a record boundary; a short page means end).  Reference:
 // storage/storage_sync.c:fdfs_binlog_reader (one-path filter mode).
-std::string CollectOnePathBinlog(const std::string& sync_dir, int spi);
+std::string CollectOnePathBinlog(const std::string& sync_dir, int spi,
+                                 int64_t offset, int64_t max_bytes);
 
 // Sequential reader with a persistent cursor (mark file).
 class BinlogReader {

@@ -89,20 +89,28 @@ void RecoveryManager::Start() {
   thread_ = std::thread(&RecoveryManager::ThreadMain, this);
 }
 
-bool RecoveryManager::TrackerRpc(uint8_t cmd, const std::string& body,
-                                 std::string* resp, uint8_t* status) {
+// One RPC against EVERY configured tracker (each holds its own copy of
+// this node's sync state and must see the re-enter query / done-notify).
+// Returns per-tracker (reached, status, body); reached=false rows have
+// undefined status/body.
+std::vector<RecoveryManager::TrackerReply> RecoveryManager::TrackerRpcAll(
+    uint8_t cmd, const std::string& body) {
+  std::vector<TrackerReply> out;
   for (const std::string& addr : cfg_.tracker_servers) {
+    TrackerReply r;
     size_t colon = addr.rfind(':');
-    if (colon == std::string::npos) continue;
-    std::string err;
-    int fd = TcpConnect(addr.substr(0, colon), atoi(addr.c_str() + colon + 1),
-                        3000, &err);
-    if (fd < 0) continue;
-    bool ok = Rpc(fd, cmd, body, resp, status, 4096);
-    close(fd);
-    if (ok) return true;
+    if (colon != std::string::npos) {
+      std::string err;
+      int fd = TcpConnect(addr.substr(0, colon),
+                          atoi(addr.c_str() + colon + 1), 3000, &err);
+      if (fd >= 0) {
+        r.reached = Rpc(fd, cmd, body, &r.body, &r.status, 4096);
+        close(fd);
+      }
+    }
+    out.push_back(std::move(r));
   }
-  return false;
+  return out;
 }
 
 void RecoveryManager::ThreadMain() {
@@ -127,35 +135,41 @@ void RecoveryManager::ThreadMain() {
   // (a dead source is re-negotiated each round).  Going ACTIVE with a
   // wiped disk is never an option, so this loop runs until it succeeds,
   // the group turns out to be source-less (sole member), or shutdown.
+  // Each round queries EVERY tracker (arming each one's hold) and only
+  // two outcomes terminate the negotiation: a source (status 0 + body)
+  // or every reachable tracker answering "settled" (status 0, empty
+  // body).  Anything else — tracker down, unknown node because our JOIN
+  // has not landed there yet (status 2), or EAGAIN (11) — retries:
+  // misreading an error as "settled" would promote a wiped node.
   (void)peers;
   int backoff_ms = 1000;
   while (!stop_) {
-    // Negotiate a source.  EAGAIN: peers exist but none ACTIVE yet
-    // (whole-group restart) — wait for one to come up.
-    std::string resp;
     PeerInfo source;
     bool have_source = false;
     bool settled = false;
     while (!stop_) {
-      uint8_t status = 0;
-      if (!TrackerRpc(static_cast<uint8_t>(TrackerCmd::kStorageSyncDestQuery),
-                      self, &resp, &status)) {
-        usleep(500 * 1000);  // no tracker reachable yet
-        continue;
+      auto replies = TrackerRpcAll(
+          static_cast<uint8_t>(TrackerCmd::kStorageSyncDestQuery), self);
+      int reached = 0, settled_count = 0;
+      for (const TrackerReply& r : replies) {
+        if (!r.reached) continue;
+        ++reached;
+        if (r.status == 0 && r.body.size() >= kIpAddressSize + 16 &&
+            !have_source) {
+          const uint8_t* p = reinterpret_cast<const uint8_t*>(r.body.data());
+          source.ip = GetFixedField(p, kIpAddressSize);
+          source.port = static_cast<int>(GetInt64BE(p + kIpAddressSize));
+          have_source = true;
+        } else if (r.status == 0) {
+          ++settled_count;
+        }
       }
-      if (status == 11) {  // EAGAIN
-        usleep(500 * 1000);
-        continue;
+      if (have_source) break;
+      if (reached > 0 && settled_count == reached) {
+        settled = true;
+        break;
       }
-      if (status == 0 && resp.size() >= kIpAddressSize + 16) {
-        const uint8_t* p = reinterpret_cast<const uint8_t*>(resp.data());
-        source.ip = GetFixedField(p, kIpAddressSize);
-        source.port = static_cast<int>(GetInt64BE(p + kIpAddressSize));
-        have_source = true;
-      } else {
-        settled = true;  // sole member (tracker promoted us): nothing to do
-      }
-      break;
+      usleep(500 * 1000);
     }
     if (stop_ || settled) break;
     if (!have_source) continue;
@@ -174,10 +188,10 @@ void RecoveryManager::ThreadMain() {
 
   if (!stop_) {
     reporter_->set_recovering(false);  // future re-joins are normal again
-    std::string nresp;
-    uint8_t nstatus = 0;
-    TrackerRpc(static_cast<uint8_t>(TrackerCmd::kStorageSyncNotify), self,
-               &nresp, &nstatus);
+    // Done-notify to EVERY tracker: each holds this node in WAIT_SYNC
+    // independently, and one left un-notified would exclude the node
+    // from its read routing forever.
+    TrackerRpcAll(static_cast<uint8_t>(TrackerCmd::kStorageSyncNotify), self);
     unlink(marker_path_.c_str());
     FDFS_LOG_INFO("disk recovery complete: %lld files restored, %lld skipped",
                   static_cast<long long>(files_recovered_.load()),
@@ -186,31 +200,51 @@ void RecoveryManager::ThreadMain() {
   running_ = false;
 }
 
-bool RecoveryManager::FetchOnePathBinlog(const PeerInfo& peer, int spi,
-                                         std::string* lines) {
+bool RecoveryManager::EnsurePeerConn(const PeerInfo& peer, int* fd) {
+  if (*fd >= 0) return true;
   std::string err;
-  int fd = TcpConnect(peer.ip, peer.port, 3000, &err);
-  if (fd < 0) return false;
-  std::string body;
-  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
-  body.push_back(static_cast<char>(spi));
-  uint8_t status = 0;
-  bool ok = Rpc(fd, static_cast<uint8_t>(StorageCmd::kFetchOnePathBinlog),
-                body, lines, &status, 1LL << 31);
-  close(fd);
-  return ok && status == 0;
+  *fd = TcpConnect(peer.ip, peer.port, 3000, &err);
+  return *fd >= 0;
 }
 
-bool RecoveryManager::DownloadToFile(const PeerInfo& peer,
+bool RecoveryManager::FetchOnePathBinlog(const PeerInfo& peer, int* fd,
+                                         int spi, std::string* lines) {
+  // Paged pull: a page shorter than the server's window is the end (a
+  // non-final page is always filled to >= the window; an exactly-full
+  // final page just costs one extra empty-page roundtrip).
+  constexpr int64_t kPageFloor = 8 << 20;  // == server kPageBytes
+  lines->clear();
+  for (;;) {
+    if (!EnsurePeerConn(peer, fd)) return false;
+    std::string body;
+    PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+    body.push_back(static_cast<char>(spi));
+    char num[8];
+    PutInt64BE(static_cast<int64_t>(lines->size()),
+               reinterpret_cast<uint8_t*>(num));
+    body.append(num, 8);
+    std::string page;
+    uint8_t status = 0;
+    if (!Rpc(*fd, static_cast<uint8_t>(StorageCmd::kFetchOnePathBinlog),
+             body, &page, &status, 64 << 20) ||
+        status != 0) {
+      close(*fd);
+      *fd = -1;
+      return false;
+    }
+    lines->append(page);
+    if (static_cast<int64_t>(page.size()) < kPageFloor) return true;
+  }
+}
+
+bool RecoveryManager::DownloadToFile(const PeerInfo& peer, int* fd,
                                      const std::string& remote,
                                      const std::string& dest_path,
                                      bool* missing) {
   // Streamed, not buffered: recovered files can be arbitrarily large (the
   // size field is 48 bits) and must never have to fit in memory.
   *missing = false;
-  std::string err;
-  int fd = TcpConnect(peer.ip, peer.port, 3000, &err);
-  if (fd < 0) return false;
+  if (!EnsurePeerConn(peer, fd)) return false;
   std::string body(16, '\0');  // 8B offset 0 + 8B count 0 (whole file)
   PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
   body += remote;
@@ -218,23 +252,25 @@ bool RecoveryManager::DownloadToFile(const PeerInfo& peer,
   PutInt64BE(static_cast<int64_t>(body.size()), hdr);
   hdr[8] = static_cast<uint8_t>(StorageCmd::kDownloadFile);
   hdr[9] = 0;
-  bool ok = SendAll(fd, hdr, sizeof(hdr), kRpcTimeoutMs) &&
-            SendAll(fd, body.data(), body.size(), kRpcTimeoutMs) &&
-            RecvAll(fd, hdr, sizeof(hdr), kRpcTimeoutMs);
+  bool ok = SendAll(*fd, hdr, sizeof(hdr), kRpcTimeoutMs) &&
+            SendAll(*fd, body.data(), body.size(), kRpcTimeoutMs) &&
+            RecvAll(*fd, hdr, sizeof(hdr), kRpcTimeoutMs);
   if (!ok) {
-    close(fd);
+    close(*fd);
+    *fd = -1;
     return false;
   }
   int64_t len = GetInt64BE(hdr);
   uint8_t status = hdr[9];
   if (status != 0 || len < 0) {
-    close(fd);
+    // Error responses carry no body; the connection stays in sync.
     *missing = true;
     return status == 2;  // ENOENT: deleted since the record — skip is fine
   }
   int out = open(dest_path.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644);
   if (out < 0) {
-    close(fd);
+    close(*fd);
+    *fd = -1;
     return false;
   }
   char buf[256 * 1024];
@@ -242,38 +278,41 @@ bool RecoveryManager::DownloadToFile(const PeerInfo& peer,
   while (left > 0 && !stop_) {
     size_t want = static_cast<size_t>(
         std::min<int64_t>(left, static_cast<int64_t>(sizeof(buf))));
-    if (!RecvAll(fd, buf, want, kRpcTimeoutMs) ||
+    if (!RecvAll(*fd, buf, want, kRpcTimeoutMs) ||
         write(out, buf, want) != static_cast<ssize_t>(want)) {
       close(out);
-      close(fd);
+      close(*fd);
+      *fd = -1;
       unlink(dest_path.c_str());
       return false;
     }
     left -= static_cast<int64_t>(want);
   }
   close(out);
-  close(fd);
   if (left > 0) {  // stop_ interrupted mid-stream
+    close(*fd);
+    *fd = -1;
     unlink(dest_path.c_str());
     return false;
   }
   return true;
 }
 
-bool RecoveryManager::FetchMetadata(const PeerInfo& peer,
+bool RecoveryManager::FetchMetadata(const PeerInfo& peer, int* fd,
                                     const std::string& remote,
                                     std::string* meta) {
-  std::string err;
-  int fd = TcpConnect(peer.ip, peer.port, 3000, &err);
-  if (fd < 0) return false;
+  if (!EnsurePeerConn(peer, fd)) return false;
   std::string body;
   PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
   body += remote;
   uint8_t status = 0;
-  bool ok = Rpc(fd, static_cast<uint8_t>(StorageCmd::kGetMetadata), body,
-                meta, &status, 16 << 20);
-  close(fd);
-  return ok && status == 0 && !meta->empty();
+  if (!Rpc(*fd, static_cast<uint8_t>(StorageCmd::kGetMetadata), body, meta,
+           &status, 16 << 20)) {
+    close(*fd);
+    *fd = -1;
+    return false;
+  }
+  return status == 0 && !meta->empty();
 }
 
 bool RecoveryManager::StoreRecovered(const std::string& remote,
@@ -314,10 +353,12 @@ bool RecoveryManager::StoreRecovered(const std::string& remote,
 }
 
 bool RecoveryManager::RecoverPath(const PeerInfo& peer, int spi) {
+  int conn = -1;
   std::string lines;
-  if (!FetchOnePathBinlog(peer, spi, &lines)) {
+  if (!FetchOnePathBinlog(peer, &conn, spi, &lines)) {
     FDFS_LOG_ERROR("recovery: fetch one-path binlog (path %d) from %s:%d "
                    "failed", spi, peer.ip.c_str(), peer.port);
+    if (conn >= 0) close(conn);
     return false;
   }
   // Unique filenames, in first-seen order; every op type names a file that
@@ -340,10 +381,10 @@ bool RecoveryManager::RecoverPath(const PeerInfo& peer, int spi) {
                 files.size());
   bool all_ok = true;
   for (const std::string& remote : files) {
-    if (stop_) return false;
+    if (stop_) break;
     std::string staged = store_->NewTmpPath(spi);
     bool missing = false;
-    if (!DownloadToFile(peer, remote, staged, &missing)) {
+    if (!DownloadToFile(peer, &conn, remote, staged, &missing)) {
       FDFS_LOG_WARN("recovery: download %s failed", remote.c_str());
       all_ok = false;
       continue;
@@ -357,7 +398,7 @@ bool RecoveryManager::RecoverPath(const PeerInfo& peer, int spi) {
       continue;
     }
     std::string meta;
-    if (FetchMetadata(peer, remote, &meta)) {
+    if (FetchMetadata(peer, &conn, remote, &meta)) {
       auto local = LocalPath(store_->store_path(spi), remote);
       if (local.has_value()) {
         EnsureParentDirs(*local);
@@ -372,7 +413,8 @@ bool RecoveryManager::RecoverPath(const PeerInfo& peer, int spi) {
     }
     files_recovered_++;
   }
-  return all_ok;
+  if (conn >= 0) close(conn);
+  return all_ok && !stop_;
 }
 
 }  // namespace fdfs

@@ -45,15 +45,27 @@ class RecoveryManager {
   int64_t files_skipped() const { return files_skipped_; }
 
  private:
+  struct TrackerReply {
+    bool reached = false;
+    uint8_t status = 0;
+    std::string body;
+  };
   void ThreadMain();
-  // One tracker RPC against any responsive configured tracker.
-  bool TrackerRpc(uint8_t cmd, const std::string& body, std::string* resp,
-                  uint8_t* status);
+  // One RPC against every configured tracker (each holds independent
+  // sync state for this node).
+  std::vector<TrackerReply> TrackerRpcAll(uint8_t cmd,
+                                          const std::string& body);
   bool RecoverPath(const PeerInfo& peer, int spi);
-  bool FetchOnePathBinlog(const PeerInfo& peer, int spi, std::string* lines);
-  bool DownloadToFile(const PeerInfo& peer, const std::string& remote,
+  // All peer RPCs reuse one keepalive connection (*fd, -1 = closed);
+  // callees reconnect once on IO failure.  Millions of small files would
+  // otherwise pay a TCP handshake per file (twice, with metadata).
+  bool EnsurePeerConn(const PeerInfo& peer, int* fd);
+  bool FetchOnePathBinlog(const PeerInfo& peer, int* fd, int spi,
+                          std::string* lines);
+  bool DownloadToFile(const PeerInfo& peer, int* fd,
+                      const std::string& remote,
                       const std::string& dest_path, bool* missing);
-  bool FetchMetadata(const PeerInfo& peer, const std::string& remote,
+  bool FetchMetadata(const PeerInfo& peer, int* fd, const std::string& remote,
                      std::string* meta);
   bool StoreRecovered(const std::string& remote, const std::string& tmp_path);
 

@@ -1007,10 +1007,14 @@ bool StorageServer::RemoteExists(const std::string& group,
   return stat(local.c_str(), &st) == 0;
 }
 
-// FETCH_ONE_PATH_BINLOG (26): every binlog record whose file lives on the
+// FETCH_ONE_PATH_BINLOG (26): binlog records whose file lives on the
 // requested store path, as raw lines — the feed a recovering peer replays
-// to re-download its wiped disk (storage_disk_recovery.c).
+// to re-download its wiped disk (storage_disk_recovery.c).  Paged: the
+// optional request offset indexes the FILTERED stream and a short (or
+// empty) page signals the end, so a multi-year binlog never has to fit
+// in one response.
 void StorageServer::HandleFetchOnePathBinlog(Conn* c) {
+  constexpr int64_t kPageBytes = 8 << 20;
   if (c->fixed.size() < 17) {
     Respond(c, 22);
     return;
@@ -1025,7 +1029,13 @@ void StorageServer::HandleFetchOnePathBinlog(Conn* c) {
     Respond(c, 22);
     return;
   }
-  Respond(c, 0, CollectOnePathBinlog(cfg_.base_path + "/data/sync", spi));
+  int64_t offset = c->fixed.size() >= 25 ? GetInt64BE(p + 17) : 0;
+  if (offset < 0) {
+    Respond(c, 22);
+    return;
+  }
+  Respond(c, 0, CollectOnePathBinlog(cfg_.base_path + "/data/sync", spi,
+                                     offset, kPageBytes));
 }
 
 void StorageServer::HandleTrunkDownload(Conn* c, const FileIdParts& parts,

@@ -99,6 +99,10 @@ std::vector<PeerInfo> TrackerReporter::peers() const {
 
 void TrackerReporter::ReportSyncProgress(const std::string& dest_ip,
                                          int dest_port, int64_t ts) {
+  // Cumulative latest-timestamp map, NOT a drain queue: every tracker's
+  // beat sends the full current vector.  A drain queue would deliver each
+  // report to whichever tracker thread flushed first and starve the
+  // others' read routing (multi-tracker clusters).
   std::lock_guard<std::mutex> lk(mu_);
   for (auto& r : pending_sync_reports_) {
     if (r.dest_ip == dest_ip && r.dest_port == dest_port) {
@@ -248,11 +252,12 @@ bool TrackerReporter::DoBeat(int fd) {
   if (status != 0) return false;  // tracker lost us: re-JOIN
   ParsePeers(resp);
 
-  // Flush pending sync-progress reports (source-side, SURVEY §2.2 sync).
+  // Send the current sync-progress vector (source-side, SURVEY §2.2
+  // sync).  Copied, not drained — see ReportSyncProgress.
   std::vector<SyncProgress> reports;
   {
     std::lock_guard<std::mutex> lk(mu_);
-    reports.swap(pending_sync_reports_);
+    reports = pending_sync_reports_;
   }
   for (const auto& r : reports) {
     std::string sbody;

@@ -193,11 +193,13 @@ void Cluster::EnsureTrunkServer(GroupInfo* g) {
     auto it = g->storages.find(g->trunk_addr);
     if (it != g->storages.end() && it->second.status == kActive) return;
   }
-  // Longest-standing ACTIVE member wins (stable choice across trackers).
+  // Lowest ACTIVE member address wins: a pure function of shared state,
+  // so every tracker elects the SAME trunk server without coordination
+  // (join timestamps would diverge across trackers' local clocks).
   const StorageNode* pick = nullptr;
   for (const auto& [addr, s] : g->storages) {
     if (s.status != kActive) continue;
-    if (pick == nullptr || s.join_time < pick->join_time) pick = &s;
+    if (pick == nullptr || addr < pick->Addr()) pick = &s;
   }
   std::string chosen = pick == nullptr ? "" : pick->Addr();
   if (chosen != g->trunk_addr) {

@@ -46,6 +46,7 @@ int main(int argc, char** argv) {
       static_cast<int>(ini.GetInt("slot_max_size", 16 * 1024 * 1024));
   cfg.trunk_file_size = ini.GetInt("trunk_file_size", 64LL * 1024 * 1024);
   cfg.reserved_storage_space_mb = ini.GetInt("reserved_storage_space", 0);
+  cfg.tracker_peers = ini.GetAll("tracker_server");
   if (cfg.base_path.empty()) {
     std::fprintf(stderr, "config error: base_path is required\n");
     return 1;

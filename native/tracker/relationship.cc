@@ -1,0 +1,233 @@
+#include "tracker/relationship.h"
+
+#include <stdlib.h>
+#include <unistd.h>
+
+#include <algorithm>
+
+#include "common/bytes.h"
+#include "common/log.h"
+#include "common/net.h"
+#include "common/protocol_gen.h"
+
+namespace fdfs {
+
+namespace {
+
+constexpr int kRpcTimeoutMs = 2000;
+constexpr int kPingFailureLimit = 3;
+
+bool SplitAddr(const std::string& addr, std::string* host, int* port) {
+  size_t colon = addr.rfind(':');
+  if (colon == std::string::npos || colon == 0) return false;
+  *host = addr.substr(0, colon);
+  *port = atoi(addr.c_str() + colon + 1);
+  return *port > 0;
+}
+
+bool Rpc(const std::string& addr, uint8_t cmd, const std::string& body,
+         std::string* resp, uint8_t* status) {
+  std::string host;
+  int port;
+  if (!SplitAddr(addr, &host, &port)) return false;
+  std::string err;
+  int fd = TcpConnect(host, port, kRpcTimeoutMs, &err);
+  if (fd < 0) return false;
+  uint8_t hdr[kHeaderSize];
+  PutInt64BE(static_cast<int64_t>(body.size()), hdr);
+  hdr[8] = cmd;
+  hdr[9] = 0;
+  bool ok = SendAll(fd, hdr, sizeof(hdr), kRpcTimeoutMs) &&
+            SendAll(fd, body.data(), body.size(), kRpcTimeoutMs) &&
+            RecvAll(fd, hdr, sizeof(hdr), kRpcTimeoutMs);
+  if (ok) {
+    int64_t len = GetInt64BE(hdr);
+    *status = hdr[9];
+    if (len < 0 || len > 4096) {
+      ok = false;
+    } else {
+      resp->resize(static_cast<size_t>(len));
+      if (len > 0) ok = RecvAll(fd, resp->data(), resp->size(), kRpcTimeoutMs);
+    }
+  }
+  close(fd);
+  return ok;
+}
+
+std::string PackAddr(const std::string& addr) {
+  std::string host;
+  int port = 0;
+  SplitAddr(addr, &host, &port);
+  std::string out;
+  PutFixedField(&out, host, kIpAddressSize);
+  char buf[8];
+  PutInt64BE(port, reinterpret_cast<uint8_t*>(buf));
+  out.append(buf, 8);
+  return out;
+}
+
+std::string UnpackAddr(const uint8_t* p) {
+  std::string ip = GetFixedField(p, kIpAddressSize);
+  int64_t port = GetInt64BE(p + kIpAddressSize);
+  if (ip.empty() || port <= 0) return "";
+  return ip + ":" + std::to_string(port);
+}
+
+}  // namespace
+
+RelationshipManager::RelationshipManager(std::string my_addr,
+                                         std::vector<std::string> peers)
+    : my_addr_(std::move(my_addr)), peers_([&] {
+        std::vector<std::string> out;
+        for (std::string& p : peers)
+          if (p != my_addr_) out.push_back(std::move(p));
+        return out;
+      }()) {}
+
+RelationshipManager::~RelationshipManager() { Stop(); }
+
+void RelationshipManager::Start() {
+  if (peers_.empty()) {
+    // Single-tracker cluster: this tracker IS the leader, no thread.
+    std::lock_guard<std::mutex> lk(mu_);
+    leader_addr_ = my_addr_;
+    return;
+  }
+  thread_ = std::thread(&RelationshipManager::ThreadMain, this);
+}
+
+void RelationshipManager::Stop() {
+  stop_ = true;
+  if (thread_.joinable()) thread_.join();
+}
+
+bool RelationshipManager::am_leader() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return leader_addr_ == my_addr_;
+}
+
+std::string RelationshipManager::leader_addr() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return leader_addr_;
+}
+
+std::string RelationshipManager::PackStatus() const {
+  std::string leader = leader_addr();
+  std::string out(1, leader == my_addr_ ? '\x01' : '\x00');
+  out += PackAddr(leader.empty() ? "0.0.0.0:0" : leader);
+  return out;
+}
+
+void RelationshipManager::OnNotifyNextLeader(const std::string& addr) {
+  std::lock_guard<std::mutex> lk(mu_);
+  pending_leader_ = addr;
+}
+
+bool RelationshipManager::OnCommitNextLeader(const std::string& addr) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (pending_leader_ != addr) return false;
+  if (leader_addr_ != addr) {
+    FDFS_LOG_INFO("tracker leader committed: %s%s", addr.c_str(),
+                  addr == my_addr_ ? " (this tracker)" : "");
+  }
+  leader_addr_ = addr;
+  ping_failures_ = 0;
+  return true;
+}
+
+bool RelationshipManager::QueryPeerStatus(const std::string& addr,
+                                          bool* is_leader,
+                                          std::string* their_leader) const {
+  std::string resp;
+  uint8_t status = 0;
+  if (!Rpc(addr, static_cast<uint8_t>(TrackerCmd::kTrackerGetStatus), "",
+           &resp, &status) ||
+      status != 0 || resp.size() < 1 + kIpAddressSize + 8)
+    return false;
+  *is_leader = resp[0] != '\x00';
+  *their_leader =
+      UnpackAddr(reinterpret_cast<const uint8_t*>(resp.data()) + 1);
+  return true;
+}
+
+bool RelationshipManager::SendLeaderCmd(const std::string& addr, uint8_t cmd,
+                                        const std::string& leader) const {
+  std::string resp;
+  uint8_t status = 0;
+  return Rpc(addr, cmd, PackAddr(leader), &resp, &status) && status == 0;
+}
+
+bool RelationshipManager::PingLeaderOnce(const std::string& addr) const {
+  std::string resp;
+  uint8_t status = 0;
+  return Rpc(addr, static_cast<uint8_t>(TrackerCmd::kTrackerPingLeader),
+             PackAddr(my_addr_), &resp, &status) &&
+         status == 0;
+}
+
+void RelationshipManager::RunElection() {
+  // Candidates: self + every responsive peer.  If any candidate already
+  // claims leadership, adopt it (don't fight a settled cluster);
+  // otherwise the lowest ip:port wins (upstream's rule) and the winner —
+  // when it is us — notifies + commits to everyone.
+  std::vector<std::string> candidates = {my_addr_};
+  std::string claimed;
+  for (const std::string& p : peers_) {
+    if (stop_) return;
+    bool is_leader = false;
+    std::string their_leader;
+    if (!QueryPeerStatus(p, &is_leader, &their_leader)) continue;
+    candidates.push_back(p);
+    if (is_leader) claimed = p;
+  }
+  std::string winner =
+      claimed.empty() ? *std::min_element(candidates.begin(), candidates.end())
+                      : claimed;
+  if (winner == my_addr_) {
+    for (const std::string& p : peers_) {
+      if (stop_) return;
+      SendLeaderCmd(p, static_cast<uint8_t>(TrackerCmd::kTrackerNotifyNextLeader),
+                    my_addr_);
+      SendLeaderCmd(p, static_cast<uint8_t>(TrackerCmd::kTrackerCommitNextLeader),
+                    my_addr_);
+    }
+  }
+  std::lock_guard<std::mutex> lk(mu_);
+  if (leader_addr_ != winner)
+    FDFS_LOG_INFO("tracker leader elected: %s%s", winner.c_str(),
+                  winner == my_addr_ ? " (this tracker)" : "");
+  leader_addr_ = winner;
+  ping_failures_ = 0;
+}
+
+void RelationshipManager::ThreadMain() {
+  while (!stop_) {
+    std::string leader = leader_addr();
+    if (leader.empty()) {
+      RunElection();
+    } else if (leader != my_addr_) {
+      if (PingLeaderOnce(leader)) {
+        std::lock_guard<std::mutex> lk(mu_);
+        ping_failures_ = 0;
+      } else {
+        int fails;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          fails = ++ping_failures_;
+        }
+        if (fails >= kPingFailureLimit) {
+          FDFS_LOG_WARN("tracker leader %s unresponsive (%d pings): "
+                        "re-electing", leader.c_str(), fails);
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            leader_addr_.clear();
+          }
+          RunElection();
+        }
+      }
+    }
+    for (int i = 0; i < 10 && !stop_; ++i) usleep(100 * 1000);
+  }
+}
+
+}  // namespace fdfs

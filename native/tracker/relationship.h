@@ -1,0 +1,68 @@
+// Multi-tracker relationship: leader election among tracker peers.
+//
+// Reference: tracker/tracker_relationship.c —
+// relationship_thread_entrance(): trackers exchange status
+// (TRACKER_PROTO_CMD_TRACKER_GET_STATUS), the lowest ip:port among
+// responsive candidates becomes leader via NOTIFY_NEXT_LEADER +
+// COMMIT_NEXT_LEADER, followers ping the leader and re-elect on loss.
+//
+// What leadership buys in this rebuild: a designated coordinator that
+// monitor tooling can find (GET_STATUS), matching upstream's protocol.
+// Cluster decisions that upstream routes through the leader (per-group
+// trunk server) are made deterministically from shared state here
+// (lowest ACTIVE member address), so every tracker reaches the same
+// answer without coordination — a tpu-rebuild simplification that keeps
+// the election protocol-visible but removes it from the correctness path.
+#pragma once
+
+#include <atomic>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace fdfs {
+
+class RelationshipManager {
+ public:
+  // peers: every tracker in the cluster, "ip:port", including self.
+  RelationshipManager(std::string my_addr, std::vector<std::string> peers);
+  ~RelationshipManager();
+
+  void Start();
+  void Stop();
+
+  bool am_leader() const;
+  std::string leader_addr() const;
+
+  // -- handler backends (TrackerServer dispatch calls these) -------------
+  // GET_STATUS (70): 1B am_leader + 16B leader_ip + 8B leader_port.
+  std::string PackStatus() const;
+  // PING_LEADER (71): true iff this tracker currently claims leadership.
+  bool OnPingLeader() const { return am_leader(); }
+  // NOTIFY_NEXT_LEADER (72) / COMMIT_NEXT_LEADER (73).
+  void OnNotifyNextLeader(const std::string& addr);
+  // false when the commit names an addr that was never notified (upstream
+  // rejects a mismatched commit).
+  bool OnCommitNextLeader(const std::string& addr);
+
+ private:
+  void ThreadMain();
+  void RunElection();
+  bool QueryPeerStatus(const std::string& addr, bool* is_leader,
+                       std::string* their_leader) const;
+  bool SendLeaderCmd(const std::string& addr, uint8_t cmd,
+                     const std::string& leader) const;
+  bool PingLeaderOnce(const std::string& addr) const;
+
+  const std::string my_addr_;
+  const std::vector<std::string> peers_;  // excluding self after ctor
+  std::atomic<bool> stop_{false};
+  std::thread thread_;
+  mutable std::mutex mu_;
+  std::string leader_addr_;
+  std::string pending_leader_;
+  int ping_failures_ = 0;
+};
+
+}  // namespace fdfs

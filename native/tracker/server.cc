@@ -96,6 +96,43 @@ bool TrackerServer::Init(std::string* error) {
   loop_.AddTimer(cfg_.save_interval_s * 1000,
                  [this]() { cluster_->Save(state_path_); });
 
+  // Multi-tracker relationship (tracker_relationship.c): leader election
+  // among the configured tracker peers.  Identity resolution order: an
+  // explicit bind address; else the UNIQUE tracker_server entry with our
+  // port (multi-host configs where each host binds all interfaces); else
+  // loopback.  A wrong self-identity would leave this tracker in its own
+  // candidate list twice (or never), which is how split-brain starts —
+  // refuse ambiguous configs instead.
+  std::string my_ip;
+  if (!cfg_.bind_addr.empty() && cfg_.bind_addr != "0.0.0.0") {
+    my_ip = cfg_.bind_addr;
+  } else {
+    std::string suffix = ":" + std::to_string(cfg_.port);
+    int matches = 0;
+    for (const std::string& p : cfg_.tracker_peers) {
+      if (p.size() > suffix.size() &&
+          p.compare(p.size() - suffix.size(), suffix.size(), suffix) == 0) {
+        ++matches;
+        my_ip = p.substr(0, p.size() - suffix.size());
+      }
+    }
+    if (matches != 1) {
+      if (!cfg_.tracker_peers.empty())
+        FDFS_LOG_ERROR(
+            "cannot identify this tracker among %zu tracker_server entries "
+            "(%d match port %d): set bind_addr explicitly",
+            cfg_.tracker_peers.size(), matches, cfg_.port);
+      if (matches > 1) {
+        *error = "ambiguous tracker identity: set bind_addr";
+        return false;
+      }
+      my_ip = "127.0.0.1";
+    }
+  }
+  relationship_ = std::make_unique<RelationshipManager>(
+      my_ip + ":" + std::to_string(cfg_.port), cfg_.tracker_peers);
+  relationship_->Start();
+
   FDFS_LOG_INFO("tracker daemon up: port=%d store_lookup=%d", cfg_.port,
                 cfg_.store_lookup);
   return true;
@@ -105,6 +142,7 @@ void TrackerServer::Run() { loop_.Run(); }
 
 void TrackerServer::Stop() {
   cluster_->Save(state_path_);
+  if (relationship_ != nullptr) relationship_->Stop();
   loop_.Stop();
 }
 
@@ -322,6 +360,31 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
     case TrackerCmd::kServerListOneGroup: {
       if (body.size() < 16) return {22, ""};
       return {0, cluster_->OneGroupJson(FixedGroup(p))};
+    }
+
+    case TrackerCmd::kTrackerGetStatus:
+      return {0, relationship_->PackStatus()};
+
+    case TrackerCmd::kTrackerPingLeader:
+      // A follower pings whoever it believes leads; a non-leader answer
+      // (EFAULT-ish status) tells it to re-elect.
+      return {relationship_->OnPingLeader() ? uint8_t{0} : uint8_t{2}, ""};
+
+    case TrackerCmd::kTrackerNotifyNextLeader:
+    case TrackerCmd::kTrackerCommitNextLeader: {
+      if (body.size() < kIpAddressSize + 8) return {22, ""};
+      std::string ip = FixedIp(p);
+      int64_t lport = GetInt64BE(p + kIpAddressSize);
+      if (ip.empty() || lport <= 0) return {22, ""};
+      std::string addr = ip + ":" + std::to_string(lport);
+      if (static_cast<TrackerCmd>(cmd) ==
+          TrackerCmd::kTrackerNotifyNextLeader) {
+        relationship_->OnNotifyNextLeader(addr);
+        return {0, ""};
+      }
+      return {relationship_->OnCommitNextLeader(addr) ? uint8_t{0}
+                                                      : uint8_t{22},
+              ""};
     }
 
     case TrackerCmd::kServerSetTrunkServer: {

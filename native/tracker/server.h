@@ -7,10 +7,12 @@
 #include <cstdint>
 #include <memory>
 #include <string>
+#include <vector>
 
 #include "common/net.h"
 #include "common/req_server.h"
 #include "tracker/cluster.h"
+#include "tracker/relationship.h"
 
 namespace fdfs {
 
@@ -32,6 +34,9 @@ struct TrackerConfig {
   int slot_max_size = 16 * 1024 * 1024;  // files above stored flat
   int64_t trunk_file_size = 64LL * 1024 * 1024;
   int64_t reserved_storage_space_mb = 0;
+  // Every tracker in the cluster ("ip:port", including this one) for the
+  // multi-tracker relationship (tracker_relationship.c).  Empty = single.
+  std::vector<std::string> tracker_peers;
 };
 
 class TrackerServer {
@@ -42,6 +47,7 @@ class TrackerServer {
   void Stop();
   EventLoop& loop() { return loop_; }
   Cluster& cluster() { return *cluster_; }
+  RelationshipManager* relationship() { return relationship_.get(); }
   void DumpState();  // SIGUSR1 (tracker_dump.c analogue)
 
  private:
@@ -50,6 +56,7 @@ class TrackerServer {
 
   TrackerConfig cfg_;
   std::unique_ptr<Cluster> cluster_;
+  std::unique_ptr<RelationshipManager> relationship_;
   EventLoop loop_;
   std::unique_ptr<RequestServer> server_;
   std::string state_path_;

@@ -1,0 +1,140 @@
+"""Integration: multi-tracker relationship (SURVEY.md §2.1).
+
+Reference semantics under test (tracker/tracker_relationship.c):
+- trackers exchange status (TRACKER_GET_STATUS 70) and elect the lowest
+  ip:port as leader (NOTIFY/COMMIT_NEXT_LEADER 72/73);
+- followers ping the leader (PING_LEADER 71) and promote a new one when
+  it dies;
+- storages report to EVERY tracker (one reporter thread each), so any
+  tracker can route uploads AND sync-timestamp-safe downloads;
+- the per-group trunk server decision is identical on every tracker.
+"""
+
+import time
+
+import pytest
+
+from fastdfs_tpu.client import FdfsClient, TrackerClient
+from tests.harness import free_port, make_tracker_conf, start_storage, \
+    start_tracker
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+S1_IP, S2_IP = "127.0.0.41", "127.0.0.42"
+
+
+def _wait(cond, timeout=25, interval=0.3):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(interval)
+    return None
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    pa, pb = sorted((free_port(), free_port()))
+    peers = f"tracker_server = 127.0.0.1:{pa}\n" \
+            f"tracker_server = 127.0.0.1:{pb}"
+    ta = start_tracker(tmp_path_factory.mktemp("ta"), port=pa, extra=peers)
+    tb = start_tracker(tmp_path_factory.mktemp("tb"), port=pb, extra=peers)
+    taddrs = [f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"]
+    s1 = start_storage(tmp_path_factory.mktemp("s1"), trackers=taddrs,
+                       extra=HB, ip=S1_IP)
+    s2 = start_storage(tmp_path_factory.mktemp("s2"), trackers=taddrs,
+                       extra=HB, ip=S2_IP)
+    for port in (pa, pb):
+        with TrackerClient("127.0.0.1", port) as t:
+            assert _wait(lambda: t.list_groups() and
+                         t.list_groups()[0]["active"] == 2), \
+                f"storages never joined tracker :{port}"
+    yield {"ta": ta, "tb": tb, "pa": pa, "pb": pb, "s1": s1, "s2": s2}
+    for d in (s1, s2, ta, tb):
+        d.stop()
+
+
+def test_lowest_addr_becomes_leader(cluster):
+    pa, pb = cluster["pa"], cluster["pb"]
+    expect = f"127.0.0.1:{pa}"  # pa < pb by construction
+
+    def settled():
+        views = []
+        for port in (pa, pb):
+            with TrackerClient("127.0.0.1", port) as t:
+                views.append(t.get_tracker_status())
+        if all(v["leader"] == expect for v in views):
+            return views
+        return None
+
+    views = _wait(settled)
+    assert views, "leader never settled"
+    assert views[0]["am_leader"] and not views[1]["am_leader"]
+
+
+def test_both_trackers_route_reads_and_writes(cluster):
+    """Storages beat + sync-report to every tracker: each tracker can do
+    the full two-hop dance independently."""
+    fids = []
+    for port in (cluster["pa"], cluster["pb"]):
+        f = FdfsClient(f"127.0.0.1:{port}")
+        fid = f.upload_buffer(f"via tracker {port}".encode())
+        assert f.download_to_buffer(fid) == f"via tracker {port}".encode()
+        fids.append(fid)
+    # Cross-check: each file eventually readable via the OTHER tracker,
+    # from BOTH replicas (sync vectors flow to both trackers).
+    for port in (cluster["pa"], cluster["pb"]):
+        with TrackerClient("127.0.0.1", port) as t:
+            assert _wait(lambda: all(
+                len(t.query_fetch_all(fid)) == 2 for fid in fids)), \
+                f"tracker :{port} sync vectors never caught up"
+
+
+def test_follower_promotes_on_leader_death(tmp_path_factory):
+    pa, pb = sorted((free_port(), free_port()))
+    peers = f"tracker_server = 127.0.0.1:{pa}\n" \
+            f"tracker_server = 127.0.0.1:{pb}"
+    ta = start_tracker(tmp_path_factory.mktemp("fa"), port=pa, extra=peers)
+    tb = start_tracker(tmp_path_factory.mktemp("fb"), port=pb, extra=peers)
+    try:
+        with TrackerClient("127.0.0.1", pb) as t:
+            assert _wait(lambda: t.get_tracker_status()["leader"]
+                         == f"127.0.0.1:{pa}")
+        ta.stop()  # kill the leader
+        with TrackerClient("127.0.0.1", pb) as t:
+            assert _wait(lambda: t.get_tracker_status()["am_leader"],
+                         timeout=30), "follower never promoted itself"
+    finally:
+        ta.stop()
+        tb.stop()
+
+
+def test_trunk_server_consistent_across_trackers(tmp_path_factory):
+    pa, pb = sorted((free_port(), free_port()))
+    trunk = "use_trunk_file = 1\nslot_min_size = 64\n" \
+            "trunk_file_size = 1048576\n"
+    peers = trunk + f"tracker_server = 127.0.0.1:{pa}\n" \
+                    f"tracker_server = 127.0.0.1:{pb}"
+    ta = start_tracker(tmp_path_factory.mktemp("ca"), port=pa, extra=peers)
+    tb = start_tracker(tmp_path_factory.mktemp("cb"), port=pb, extra=peers)
+    taddrs = [f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"]
+    s1 = start_storage(tmp_path_factory.mktemp("cs1"), trackers=taddrs,
+                       extra=HB, ip="127.0.0.43")
+    s2 = start_storage(tmp_path_factory.mktemp("cs2"), trackers=taddrs,
+                       extra=HB, ip="127.0.0.44")
+    try:
+        def both_elected():
+            picks = set()
+            for port in (pa, pb):
+                with TrackerClient("127.0.0.1", port) as t:
+                    g = t.list_one_group("group1")
+                    if not g.get("trunk_server") or g["active"] != 2:
+                        return None
+                    picks.add(g["trunk_server"])
+            return picks if len(picks) == 1 else None
+
+        picks = _wait(both_elected)
+        assert picks, "trackers disagreed on (or never elected) trunk server"
+    finally:
+        for d in (s1, s2, ta, tb):
+            d.stop()
