@@ -112,6 +112,60 @@ def cmd_groups_json(c: FdfsClient, args: list[str]) -> int:
     return 0
 
 
+def cmd_append(c: FdfsClient, args: list[str]) -> int:
+    """fdfs_append_file: append a local file to an appender file."""
+    if len(args) < 2:
+        print("usage: append <tracker> <appender_file_id> <local_file>",
+              file=sys.stderr)
+        return 2
+    with open(args[1], "rb") as fh:
+        c.append_buffer(args[0], fh.read())
+    print("appended")
+    return 0
+
+
+def cmd_upload_appender(c: FdfsClient, args: list[str]) -> int:
+    """fdfs_upload_appender: create an appender file."""
+    if not args:
+        print("usage: upload_appender <tracker> <local_file> [ext]",
+              file=sys.stderr)
+        return 2
+    ext = args[1] if len(args) > 1 else os.path.splitext(args[0])[1].lstrip(".")[:6]
+    with open(args[0], "rb") as fh:
+        print(c.upload_appender_buffer(fh.read(), ext=ext))
+    return 0
+
+
+def cmd_delete_server(c: FdfsClient, args: list[str]) -> int:
+    """fdfs_monitor's delete-server action (non-active members only)."""
+    if len(args) < 2:
+        print("usage: delete_server <tracker> <group> <ip:port>",
+              file=sys.stderr)
+        return 2
+    ip, _, port = args[1].partition(":")
+    c.delete_storage(args[0], ip, int(port))
+    print("deleted")
+    return 0
+
+
+def cmd_set_trunk_server(c: FdfsClient, args: list[str]) -> int:
+    """fdfs_monitor's set-trunk-server action."""
+    if len(args) < 2:
+        print("usage: set_trunk_server <tracker> <group> <ip:port>",
+              file=sys.stderr)
+        return 2
+    ip, _, port = args[1].partition(":")
+    c.set_trunk_server(args[0], ip, int(port))
+    print("trunk server set")
+    return 0
+
+
+def cmd_tracker_status(c: FdfsClient, args: list[str]) -> int:
+    """Multi-tracker relationship probe (leader + role)."""
+    print(json.dumps(c.tracker_status()))
+    return 0
+
+
 TOOLS = {
     "upload": cmd_upload,
     "download": cmd_download,
@@ -120,6 +174,11 @@ TOOLS = {
     "monitor": cmd_monitor,
     "test": cmd_test,
     "groups_json": cmd_groups_json,
+    "append": cmd_append,
+    "upload_appender": cmd_upload_appender,
+    "delete_server": cmd_delete_server,
+    "set_trunk_server": cmd_set_trunk_server,
+    "tracker_status": cmd_tracker_status,
 }
 
 

@@ -125,6 +125,18 @@ class FdfsClient:
         with self._tracker() as t:
             return t.list_groups()
 
+    def delete_storage(self, group: str, ip: str, port: int) -> None:
+        with self._tracker() as t:
+            t.delete_storage(group, ip, port)
+
+    def set_trunk_server(self, group: str, ip: str, port: int) -> None:
+        with self._tracker() as t:
+            t.set_trunk_server(group, ip, port)
+
+    def tracker_status(self) -> dict:
+        with self._tracker() as t:
+            return t.get_tracker_status()
+
     def list_storages(self, group: str) -> list[dict]:
         with self._tracker() as t:
             return t.list_storages(group)

@@ -165,6 +165,12 @@ class TrackerClient:
         self.conn.send_request(TrackerCmd.SERVER_DELETE_STORAGE, body)
         self.conn.recv_response("delete_storage")
 
+    def set_trunk_server(self, group: str, ip: str, port: int) -> None:
+        """Operator override of the elected trunk server (cmd 94)."""
+        body = pack_group_name(group) + f"{ip}:{port}".encode()
+        self.conn.send_request(TrackerCmd.SERVER_SET_TRUNK_SERVER, body)
+        self.conn.recv_response("set_trunk_server")
+
     def active_test(self) -> bool:
         self.conn.send_request(TrackerCmd.ACTIVE_TEST)
         self.conn.recv_response("active_test")

@@ -1,0 +1,152 @@
+#include "common/http_token.h"
+
+#include <cstring>
+
+namespace fdfs {
+
+namespace {
+
+// MD5 (RFC 1321).  Straightforward 64-round implementation over 512-bit
+// blocks; little-endian word loads/stores as the spec requires.
+struct Md5Ctx {
+  uint32_t a = 0x67452301, b = 0xefcdab89, c = 0x98badcfe, d = 0x10325476;
+  uint64_t total_len = 0;
+  uint8_t buf[64];
+  size_t buf_len = 0;
+};
+
+constexpr uint32_t kK[64] = {
+    0xd76aa478, 0xe8c7b756, 0x242070db, 0xc1bdceee, 0xf57c0faf, 0x4787c62a,
+    0xa8304613, 0xfd469501, 0x698098d8, 0x8b44f7af, 0xffff5bb1, 0x895cd7be,
+    0x6b901122, 0xfd987193, 0xa679438e, 0x49b40821, 0xf61e2562, 0xc040b340,
+    0x265e5a51, 0xe9b6c7aa, 0xd62f105d, 0x02441453, 0xd8a1e681, 0xe7d3fbc8,
+    0x21e1cde6, 0xc33707d6, 0xf4d50d87, 0x455a14ed, 0xa9e3e905, 0xfcefa3f8,
+    0x676f02d9, 0x8d2a4c8a, 0xfffa3942, 0x8771f681, 0x6d9d6122, 0xfde5380c,
+    0xa4beea44, 0x4bdecfa9, 0xf6bb4b60, 0xbebfbc70, 0x289b7ec6, 0xeaa127fa,
+    0xd4ef3085, 0x04881d05, 0xd9d4d039, 0xe6db99e5, 0x1fa27cf8, 0xc4ac5665,
+    0xf4292244, 0x432aff97, 0xab9423a7, 0xfc93a039, 0x655b59c3, 0x8f0ccc92,
+    0xffeff47d, 0x85845dd1, 0x6fa87e4f, 0xfe2ce6e0, 0xa3014314, 0x4e0811a1,
+    0xf7537e82, 0xbd3af235, 0x2ad7d2bb, 0xeb86d391};
+
+constexpr int kShift[64] = {7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22,
+                            7, 12, 17, 22, 5, 9,  14, 20, 5, 9,  14, 20,
+                            5, 9,  14, 20, 5, 9,  14, 20, 4, 11, 16, 23,
+                            4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23,
+                            6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21,
+                            6, 10, 15, 21};
+
+uint32_t Rotl(uint32_t x, int n) { return (x << n) | (x >> (32 - n)); }
+
+void Md5Block(Md5Ctx* ctx, const uint8_t* p) {
+  uint32_t m[16];
+  for (int i = 0; i < 16; ++i) {
+    m[i] = static_cast<uint32_t>(p[4 * i]) |
+           (static_cast<uint32_t>(p[4 * i + 1]) << 8) |
+           (static_cast<uint32_t>(p[4 * i + 2]) << 16) |
+           (static_cast<uint32_t>(p[4 * i + 3]) << 24);
+  }
+  uint32_t a = ctx->a, b = ctx->b, c = ctx->c, d = ctx->d;
+  for (int i = 0; i < 64; ++i) {
+    uint32_t f;
+    int g;
+    if (i < 16) {
+      f = (b & c) | (~b & d);
+      g = i;
+    } else if (i < 32) {
+      f = (d & b) | (~d & c);
+      g = (5 * i + 1) & 15;
+    } else if (i < 48) {
+      f = b ^ c ^ d;
+      g = (3 * i + 5) & 15;
+    } else {
+      f = c ^ (b | ~d);
+      g = (7 * i) & 15;
+    }
+    uint32_t tmp = d;
+    d = c;
+    c = b;
+    b = b + Rotl(a + f + kK[i] + m[g], kShift[i]);
+    a = tmp;
+  }
+  ctx->a += a;
+  ctx->b += b;
+  ctx->c += c;
+  ctx->d += d;
+}
+
+void Md5Update(Md5Ctx* ctx, const uint8_t* data, size_t len) {
+  ctx->total_len += len;
+  while (len > 0) {
+    size_t take = 64 - ctx->buf_len;
+    if (take > len) take = len;
+    memcpy(ctx->buf + ctx->buf_len, data, take);
+    ctx->buf_len += take;
+    data += take;
+    len -= take;
+    if (ctx->buf_len == 64) {
+      Md5Block(ctx, ctx->buf);
+      ctx->buf_len = 0;
+    }
+  }
+}
+
+void Md5Final(Md5Ctx* ctx, uint8_t out[16]) {
+  uint64_t bit_len = ctx->total_len * 8;
+  uint8_t pad = 0x80;
+  Md5Update(ctx, &pad, 1);
+  uint8_t zero = 0;
+  while (ctx->buf_len != 56) Md5Update(ctx, &zero, 1);
+  uint8_t len_le[8];
+  for (int i = 0; i < 8; ++i)
+    len_le[i] = static_cast<uint8_t>(bit_len >> (8 * i));
+  Md5Update(ctx, len_le, 8);
+  uint32_t words[4] = {ctx->a, ctx->b, ctx->c, ctx->d};
+  for (int i = 0; i < 4; ++i)
+    for (int j = 0; j < 4; ++j)
+      out[4 * i + j] = static_cast<uint8_t>(words[i] >> (8 * j));
+}
+
+}  // namespace
+
+std::string Md5Hex(std::string_view data) {
+  Md5Ctx ctx;
+  Md5Update(&ctx, reinterpret_cast<const uint8_t*>(data.data()), data.size());
+  uint8_t digest[16];
+  Md5Final(&ctx, digest);
+  static const char* hex = "0123456789abcdef";
+  std::string out(32, '0');
+  for (int i = 0; i < 16; ++i) {
+    out[2 * i] = hex[digest[i] >> 4];
+    out[2 * i + 1] = hex[digest[i] & 0xF];
+  }
+  return out;
+}
+
+std::string HttpGenToken(std::string_view file_uri, std::string_view secret,
+                         int64_t ts) {
+  std::string buf;
+  buf.reserve(file_uri.size() + secret.size() + 20);
+  buf.append(file_uri);
+  buf.append(secret);
+  buf.append(std::to_string(ts));
+  return Md5Hex(buf);
+}
+
+bool HttpCheckToken(std::string_view token, std::string_view file_uri,
+                    std::string_view secret, int64_t ts, int64_t now,
+                    int64_t ttl_seconds) {
+  if (ttl_seconds > 0) {
+    int64_t age = now >= ts ? now - ts : ts - now;
+    if (age > ttl_seconds) return false;
+  }
+  std::string want = HttpGenToken(file_uri, secret, ts);
+  if (token.size() != want.size()) return false;
+  // Constant-shape comparison: no early exit on the first wrong byte.
+  unsigned diff = 0;
+  for (size_t i = 0; i < want.size(); ++i)
+    diff |= static_cast<unsigned char>(token[i]) ^
+            static_cast<unsigned char>(want[i]);
+  return diff == 0;
+}
+
+}  // namespace fdfs

@@ -1,0 +1,28 @@
+// Anti-leech HTTP token + MD5.
+//
+// Reference: common/fdfs_http_shared.c — fdfs_http_gen_token() /
+// fdfs_http_check_token(): token = md5(file_uri + secret_key + ts) as a
+// 32-char lowercase hex string, carried as "?token=...&ts=..." by the web
+// edge (fastdfs-nginx-module); a token is valid while |now - ts| is within
+// the configured ttl.  MD5 implemented from the RFC 1321 algorithm.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <string_view>
+
+namespace fdfs {
+
+// 32-char lowercase hex MD5 of `data`.
+std::string Md5Hex(std::string_view data);
+
+// token = md5(file_uri + secret_key + decimal(ts)).
+std::string HttpGenToken(std::string_view file_uri, std::string_view secret,
+                         int64_t ts);
+
+// Constant-shape check: token matches AND ts is within ttl of now.
+bool HttpCheckToken(std::string_view token, std::string_view file_uri,
+                    std::string_view secret, int64_t ts, int64_t now,
+                    int64_t ttl_seconds);
+
+}  // namespace fdfs

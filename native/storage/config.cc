@@ -53,6 +53,7 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
   }
   dedup_sidecar = ini.GetStr("dedup_sidecar", "");
   log_level = ini.GetStr("log_level", "info");
+  use_access_log = ini.GetBool("use_access_log", false);
   return true;
 }
 

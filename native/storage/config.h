@@ -30,6 +30,9 @@ struct StorageConfig {
   std::string dedup_mode = "none"; // none | cpu | sidecar
   std::string dedup_sidecar;       // unix socket path when mode=sidecar
   std::string log_level = "info";
+  // Per-request access log (storage.conf:use_access_log): op, client ip,
+  // status, bytes, cost in µs — logs/access.log.
+  bool use_access_log = false;
 
   // Parse + validate; false with *error on problems.
   bool Load(const IniConfig& ini, std::string* error);

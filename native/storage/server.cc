@@ -6,6 +6,7 @@
 #include <sys/sendfile.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/time.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -149,8 +150,23 @@ bool StorageServer::Init(std::string* error) {
 
   // Periodic maintenance (reference: sched_thread entries — binlog flush,
   // stat write, dedup snapshot).
+  // Per-request access log (storage.conf:use_access_log).
+  if (cfg_.use_access_log) {
+    std::string path = cfg_.base_path + "/logs/access.log";
+    access_log_ = fopen(path.c_str(), "a");
+    if (access_log_ == nullptr)
+      FDFS_LOG_WARN("cannot open access log %s", path.c_str());
+  }
+  // Restart-safe op counters (storage_write_to_stat_file analogue).
+  stat_path_ = cfg_.base_path + "/data/storage_stat.dat";
+  stats_.LoadFromFile(stat_path_);
+
   loop_.AddTimer(1000, [this]() { binlog_.Flush(); });
   loop_.AddTimer(1000, [this]() { RefreshClusterParams(); });
+  loop_.AddTimer(10 * 1000, [this]() {
+    stats_.SaveToFile(stat_path_);
+    if (access_log_ != nullptr) fflush(access_log_);
+  });
   loop_.AddTimer(60 * 1000, [this]() {
     if (dedup_ != nullptr) dedup_->Save();
   });
@@ -167,6 +183,11 @@ void StorageServer::Stop() {
   // Persist first: joining reporter threads can take up to one bounded
   // tracker-RPC timeout, and durability must not ride on that.
   if (dedup_ != nullptr) dedup_->Save();
+  if (!stat_path_.empty()) stats_.SaveToFile(stat_path_);
+  if (access_log_ != nullptr) {
+    fclose(access_log_);
+    access_log_ = nullptr;
+  }
   binlog_.Flush();
   if (recovery_ != nullptr) recovery_->Stop();
   if (sync_ != nullptr) sync_->Stop();  // persists .mark cursors
@@ -321,6 +342,7 @@ void StorageServer::RespondError(Conn* c, uint8_t status) {
 }
 
 void StorageServer::Respond(Conn* c, uint8_t status, const std::string& body) {
+  LogAccess(c, status, static_cast<int64_t>(body.size()));
   c->out.resize(kHeaderSize);
   PutInt64BE(static_cast<int64_t>(body.size()),
              reinterpret_cast<uint8_t*>(c->out.data()));
@@ -332,8 +354,23 @@ void StorageServer::Respond(Conn* c, uint8_t status, const std::string& body) {
   WriteConn(c);
 }
 
+void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
+  if (access_log_ == nullptr || c->req_start_us == 0) return;
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  int64_t now_us =
+      static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+  // "<epoch.sec> <client_ip> <cmd> <status> <bytes> <cost_us>"
+  fprintf(access_log_, "%lld %s %d %d %lld %lld\n",
+          static_cast<long long>(time(nullptr)), c->peer_ip.c_str(), c->cmd,
+          status, static_cast<long long>(bytes),
+          static_cast<long long>(now_us - c->req_start_us));
+  c->req_start_us = 0;  // one line per request
+}
+
 void StorageServer::RespondFile(Conn* c, uint8_t status, int file_fd,
                                 int64_t offset, int64_t count) {
+  LogAccess(c, status, count);
   c->out.resize(kHeaderSize);
   PutInt64BE(count, reinterpret_cast<uint8_t*>(c->out.data()));
   c->out[8] = static_cast<char>(StorageCmd::kResp);
@@ -495,6 +532,15 @@ void StorageServer::ReadConn(Conn* c) {
 void StorageServer::OnHeaderComplete(Conn* c) {
   c->pkg_len = GetInt64BE(c->header);
   c->cmd = c->header[8];
+  if (access_log_ != nullptr) {
+    // Monotonic clock for the cost pair: a wall-clock (NTP) step mid-
+    // request would log negative/garbage latencies.
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    c->req_start_us =
+        static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+    if (c->peer_ip.empty()) c->peer_ip = PeerIp(c->fd);
+  }
   if (c->pkg_len < 0) {
     FDFS_LOG_WARN("negative pkg_len from %s", PeerIp(c->fd).c_str());
     CloseConn(c);
@@ -986,6 +1032,38 @@ void StorageServer::HandleTrunkRpc(Conn* c) {
     return;
   }
   Respond(c, trunk_alloc_->Free(loc) ? 0 : 22);
+}
+
+bool StorageStats::SaveToFile(const std::string& path) const {
+  int64_t v[20];
+  Snapshot(v);
+  std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (f == nullptr) return false;
+  for (int i = 0; i < 20; ++i)
+    fprintf(f, "%lld\n", static_cast<long long>(v[i]));
+  fclose(f);
+  return rename(tmp.c_str(), path.c_str()) == 0;
+}
+
+bool StorageStats::LoadFromFile(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (f == nullptr) return false;
+  long long v[20] = {0};
+  for (int i = 0; i < 20; ++i)
+    if (fscanf(f, "%lld", &v[i]) != 1) break;
+  fclose(f);
+  total_upload = v[0]; success_upload = v[1];
+  total_download = v[2]; success_download = v[3];
+  total_delete = v[4]; success_delete = v[5];
+  total_append = v[6]; success_append = v[7];
+  total_set_meta = v[8]; success_set_meta = v[9];
+  total_get_meta = v[10]; success_get_meta = v[11];
+  total_query = v[12]; success_query = v[13];
+  bytes_uploaded = v[14]; bytes_downloaded = v[15];
+  dedup_hits = v[16]; dedup_bytes_saved = v[17];
+  last_source_update = v[18];
+  return true;
 }
 
 bool StorageServer::RemoteExists(const std::string& group,

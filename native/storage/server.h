@@ -13,6 +13,7 @@
 #include <sys/epoll.h>
 
 #include <atomic>
+#include <cstdio>
 #include <cstdint>
 #include <memory>
 #include <string>
@@ -48,6 +49,11 @@ struct StorageStats {
   std::atomic<int64_t> dedup_bytes_saved{0};
   std::atomic<int64_t> bytes_uploaded{0}, bytes_downloaded{0};
   std::atomic<int64_t> last_source_update{0};  // ts of last client mutation
+
+  // Restart-safe counters (reference: storage_write_to_stat_file() /
+  // data/storage_stat.dat).
+  bool SaveToFile(const std::string& path) const;
+  bool LoadFromFile(const std::string& path);
 
   // Beat-blob layout (shared contract with tracker/cluster.cc JSON).
   void Snapshot(int64_t out[20]) const {
@@ -117,6 +123,9 @@ class StorageServer {
     int send_fd = -1;
     int64_t send_off = 0;
     int64_t send_remaining = 0;
+    // access log bookkeeping
+    int64_t req_start_us = 0;
+    std::string peer_ip;
   };
 
   // -- nio ---------------------------------------------------------------
@@ -137,6 +146,9 @@ class StorageServer {
   void ReleaseBusy(Conn* c);
   void RespondFile(Conn* c, uint8_t status, int file_fd, int64_t offset,
                    int64_t count);
+  // Access log (storage.conf:use_access_log; reference: the per-request
+  // "op client_ip status bytes cost_us" lines storage_service.c emits).
+  void LogAccess(Conn* c, uint8_t status, int64_t bytes);
 
   // -- dispatch ----------------------------------------------------------
   void OnHeaderComplete(Conn* c);
@@ -208,6 +220,8 @@ class StorageServer {
   int trunk_port_ = 0;
   bool is_trunk_server_ = false;
   std::unique_ptr<TrunkAllocator> trunk_alloc_;
+  FILE* access_log_ = nullptr;
+  std::string stat_path_;
 };
 
 }  // namespace fdfs

@@ -1,6 +1,7 @@
 #include "storage/tracker_client.h"
 
 #include <string.h>
+#include <sys/stat.h>
 #include <sys/statvfs.h>
 #include <time.h>
 #include <unistd.h>
@@ -69,6 +70,20 @@ TrackerReporter::TrackerReporter(StorageConfig cfg, StatsSnapshotFn stats_fn,
 TrackerReporter::~TrackerReporter() { Stop(); }
 
 void TrackerReporter::Start() {
+  // Snapshot the persisted identity before ANY thread can rewrite it.
+  {
+    FILE* f = fopen((cfg_.base_path + "/data/.server_identity").c_str(), "r");
+    if (f != nullptr) {
+      char ip[64] = {0};
+      int port = 0;
+      if (fscanf(f, "%63s %d", ip, &port) == 2) {
+        std::lock_guard<std::mutex> lk(mu_);
+        recorded_ip_ = ip;
+        recorded_port_ = port;
+      }
+      fclose(f);
+    }
+  }
   for (const std::string& addr : cfg_.tracker_servers) {
     std::string host;
     int port;
@@ -113,7 +128,8 @@ void TrackerReporter::ReportSyncProgress(const std::string& dest_ip,
   pending_sync_reports_.push_back({dest_ip, dest_port, ts});
 }
 
-bool TrackerReporter::ParsePeers(const std::string& body) {
+bool TrackerReporter::ParsePeers(const std::string& body,
+                                 bool* peers_changed) {
   if (body.size() < 8) return false;
   const uint8_t* p = reinterpret_cast<const uint8_t*>(body.data());
   int64_t count = GetInt64BE(p);
@@ -145,18 +161,25 @@ bool TrackerReporter::ParsePeers(const std::string& body) {
     tip = GetFixedField(q, kIpAddressSize);
     tport = static_cast<int>(GetInt64BE(q + kIpAddressSize));
   }
-  bool changed;
   {
     std::lock_guard<std::mutex> lk(mu_);
-    changed = peers != peers_;
+    if (peers_changed != nullptr) *peers_changed = peers != peers_;
     peers_ = peers;
     if (have_trailer) {
       trunk_ip_ = tip;
       trunk_port_ = tport;
     }
   }
-  if (changed && peers_cb_) peers_cb_(peers);
   return true;
+}
+
+void TrackerReporter::NotifyPeersChanged() {
+  std::vector<PeerInfo> peers;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    peers = peers_;
+  }
+  if (peers_cb_) peers_cb_(peers);
 }
 
 std::pair<std::string, int> TrackerReporter::trunk_server() const {
@@ -164,7 +187,8 @@ std::pair<std::string, int> TrackerReporter::trunk_server() const {
   return {trunk_ip_, trunk_port_};
 }
 
-bool TrackerReporter::DoJoin(int fd, const std::string&) {
+bool TrackerReporter::DoJoin(int fd, int64_t* chlog_off) {
+  CheckIpChanged(fd);
   std::string body;
   PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
   PutFixedField(&body, my_ip(), kIpAddressSize);
@@ -177,12 +201,94 @@ bool TrackerReporter::DoJoin(int fd, const std::string&) {
            &status, kTrackerRpcTimeoutMs) ||
       status != 0)
     return false;
-  if (!ParsePeers(resp)) return false;
+  bool changed = false;
+  if (!ParsePeers(resp, &changed)) return false;
+  PersistIdentity();
   DoParameterReq(fd);
+  // Rename cursors BEFORE workers spawn for renamed addresses.
+  DoChangelogReq(fd, chlog_off);
+  if (changed) NotifyPeersChanged();
   // During disk recovery the negotiation belongs to the recovery thread
   // (SYNC_DEST_QUERY with held promotion), not the join path.
   if (!recovering_) DoSyncDestReq(fd);
   return true;
+}
+
+void TrackerReporter::CheckIpChanged(int fd) {
+  // Uses the identity snapshot from Start(), NOT the file: each tracker
+  // thread must independently send the rename (PersistIdentity rewrites
+  // the file after the first join, which would silence the others).
+  std::string old_ip;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    old_ip = recorded_ip_;
+    if (recorded_port_ != cfg_.port) return;  // port change = new identity
+  }
+  if (old_ip.empty() || my_ip() == old_ip) return;
+  FDFS_LOG_WARN("own IP changed %s -> %s: asking tracker to rewrite",
+                old_ip.c_str(), my_ip().c_str());
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  PutFixedField(&body, old_ip, kIpAddressSize);
+  PutFixedField(&body, my_ip(), kIpAddressSize);
+  AppendInt64(&body, cfg_.port);
+  std::string resp;
+  uint8_t status;
+  Rpc(fd, static_cast<uint8_t>(TrackerCmd::kStorageReportIpChanged), body,
+      &resp, &status, kTrackerRpcTimeoutMs);
+  // ENOENT (already renamed / unknown) is fine — JOIN follows either way.
+}
+
+void TrackerReporter::PersistIdentity() {
+  std::string path = cfg_.base_path + "/data/.server_identity";
+  std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (f == nullptr) return;
+  fprintf(f, "%s %d\n", my_ip().c_str(), cfg_.port);
+  fclose(f);
+  rename(tmp.c_str(), path.c_str());
+}
+
+void TrackerReporter::DoChangelogReq(int fd, int64_t* chlog_off) {
+  std::string body(8, '\0');
+  PutInt64BE(*chlog_off, reinterpret_cast<uint8_t*>(body.data()));
+  std::string resp;
+  uint8_t status;
+  if (!Rpc(fd, static_cast<uint8_t>(TrackerCmd::kStorageChangelogReq), body,
+           &resp, &status, kTrackerRpcTimeoutMs) ||
+      status != 0 || resp.empty())
+    return;
+  *chlog_off += static_cast<int64_t>(resp.size());
+  // Lines: "<ts> <group> <old_ip:port> <new_ip:port>" — rename our sync
+  // cursors for renamed peers so their replication position survives.
+  std::string sync_dir = cfg_.base_path + "/data/sync";
+  size_t pos = 0;
+  while (pos < resp.size()) {
+    size_t nl = resp.find('\n', pos);
+    std::string line = resp.substr(pos, nl == std::string::npos
+                                            ? std::string::npos
+                                            : nl - pos);
+    pos = nl == std::string::npos ? resp.size() : nl + 1;
+    char grp[64], olda[128], newa[128];
+    long long ts;
+    if (sscanf(line.c_str(), "%lld %63s %127s %127s", &ts, grp, olda,
+               newa) != 4 ||
+        cfg_.group_name != grp)
+      continue;
+    auto mark_name = [](std::string addr) {
+      size_t colon = addr.rfind(':');
+      if (colon != std::string::npos) addr[colon] = '_';
+      return addr + ".mark";
+    };
+    std::string from = sync_dir + "/" + mark_name(olda);
+    std::string to = sync_dir + "/" + mark_name(newa);
+    struct stat st;
+    if (stat(from.c_str(), &st) == 0 && stat(to.c_str(), &st) != 0) {
+      if (rename(from.c_str(), to.c_str()) == 0)
+        FDFS_LOG_INFO("renamed sync cursor %s -> %s (peer IP change)",
+                      from.c_str(), to.c_str());
+    }
+  }
 }
 
 void TrackerReporter::DoSyncDestReq(int fd) {
@@ -236,7 +342,7 @@ std::map<std::string, std::string> TrackerReporter::cluster_params() const {
   return cluster_params_;
 }
 
-bool TrackerReporter::DoBeat(int fd) {
+bool TrackerReporter::DoBeat(int fd, int64_t* chlog_off) {
   std::string body;
   PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
   PutFixedField(&body, my_ip(), kIpAddressSize);
@@ -250,7 +356,15 @@ bool TrackerReporter::DoBeat(int fd) {
            &status, kTrackerRpcTimeoutMs))
     return false;
   if (status != 0) return false;  // tracker lost us: re-JOIN
-  ParsePeers(resp);
+  bool changed = false;
+  ParsePeers(resp, &changed);
+  if (changed) {
+    // A changed peer list may be a renamed peer: apply the changelog
+    // first so its sync cursor is renamed before a fresh worker (with a
+    // zero-position mark) would be spawned for the "new" address.
+    DoChangelogReq(fd, chlog_off);
+    NotifyPeersChanged();
+  }
 
   // Send the current sync-progress vector (source-side, SURVEY §2.2
   // sync).  Copied, not drained — see ReportSyncProgress.
@@ -298,6 +412,7 @@ void TrackerReporter::ThreadMain(std::string host, int port) {
   int fd = -1;
   bool joined = false;
   int64_t last_beat = 0, last_disk = 0;
+  int64_t chlog_off = 0;  // per-tracker changelog resume cursor
   while (!stop_) {
     if (fd < 0) {
       std::string err;
@@ -315,7 +430,7 @@ void TrackerReporter::ThreadMain(std::string host, int port) {
     int64_t now = time(nullptr);
     bool ok = true;
     if (!joined) {
-      ok = DoJoin(fd, host);
+      ok = DoJoin(fd, &chlog_off);
       if (ok) {
         joined = true;
         last_beat = now;
@@ -325,7 +440,7 @@ void TrackerReporter::ThreadMain(std::string host, int port) {
         last_disk = now;
       }
     } else if (now - last_beat >= cfg_.heart_beat_interval_s) {
-      ok = DoBeat(fd);
+      ok = DoBeat(fd, &chlog_off);
       if (!ok) joined = false;  // status!=0 or IO error: rejoin
       last_beat = now;
     } else if (now - last_disk >= cfg_.stat_report_interval_s) {

@@ -59,12 +59,26 @@ class TrackerReporter {
 
  private:
   void ThreadMain(std::string host, int port);
-  bool DoJoin(int fd, const std::string& tracker_host);
-  bool DoBeat(int fd);
+  // chlog_off: per-tracker changelog resume offset (each tracker keeps an
+  // independent changelog file, so the cursor lives in its thread).
+  bool DoJoin(int fd, int64_t* chlog_off);
+  bool DoBeat(int fd, int64_t* chlog_off);
   bool DoDiskReport(int fd);
   void DoSyncDestReq(int fd);
   void DoParameterReq(int fd);
-  bool ParsePeers(const std::string& body);
+  // IP-changed dealer (storage_ip_changed_dealer.c): compare the
+  // persisted identity with the current one and ask the tracker to
+  // rewrite us before joining; afterwards persist the new identity.
+  void CheckIpChanged(int fd);
+  void PersistIdentity();
+  // Apply the tracker's identity changelog: rename local sync-mark
+  // cursors for peers whose IP moved (storage_changelog_req).  MUST run
+  // before NotifyPeersChanged spawns a sync worker for a renamed peer —
+  // a fresh zero-position mark would win over the rename and re-replay
+  // the whole binlog.
+  void DoChangelogReq(int fd, int64_t* chlog_off);
+  bool ParsePeers(const std::string& body, bool* peers_changed = nullptr);
+  void NotifyPeersChanged();
 
   StorageConfig cfg_;
   StatsSnapshotFn stats_fn_;
@@ -84,6 +98,12 @@ class TrackerReporter {
   std::map<std::string, std::string> cluster_params_;
   std::string trunk_ip_;
   int trunk_port_ = 0;
+  // Identity recorded at process start (read once, BEFORE any thread
+  // rewrites the identity file): every tracker thread must send the
+  // rename RPC from the same old->new view, or slower threads would read
+  // the already-updated file and skip it.
+  std::string recorded_ip_;
+  int recorded_port_ = 0;
 };
 
 }  // namespace fdfs

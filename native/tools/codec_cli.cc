@@ -8,6 +8,8 @@
 //   fdfs_codec decode <file_id>
 //   fdfs_codec sha1            (stdin -> hex)
 //   fdfs_codec crc32           (stdin -> decimal)
+//   fdfs_codec md5             (stdin -> hex)
+//   fdfs_codec token <uri> <secret> <ts>   (anti-leech token)
 //   fdfs_codec b64e <hex>      (hex bytes -> base64url)
 #include <cstdio>
 #include <cstdlib>
@@ -17,6 +19,7 @@
 
 #include "common/bytes.h"
 #include "common/fileid.h"
+#include "common/http_token.h"
 
 using namespace fdfs;
 
@@ -101,6 +104,17 @@ int main(int argc, char** argv) {
   if (cmd == "crc32") {
     std::string data = ReadStdin();
     printf("%u\n", Crc32(data.data(), data.size()));
+    return 0;
+  }
+  if (cmd == "md5") {
+    std::string data = ReadStdin();
+    printf("%s\n", Md5Hex(data).c_str());
+    return 0;
+  }
+  if (cmd == "token" && argc == 5) {
+    printf("%s\n", HttpGenToken(argv[2], argv[3],
+                                strtoll(argv[4], nullptr, 10))
+                       .c_str());
     return 0;
   }
   if (cmd == "b64e" && argc == 3) {

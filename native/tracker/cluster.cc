@@ -311,6 +311,38 @@ int Cluster::CheckAlive(int64_t now, int64_t timeout_s) {
   return transitions;
 }
 
+bool Cluster::RenameStorage(const std::string& group,
+                            const std::string& old_addr,
+                            const std::string& new_ip, int port) {
+  GroupInfo* g = FindGroup(group);
+  if (g == nullptr) return false;
+  auto it = g->storages.find(old_addr);
+  if (it == g->storages.end()) return false;
+  std::string new_addr = new_ip + ":" + std::to_string(port);
+  if (new_addr == old_addr) return true;
+  if (g->storages.count(new_addr)) return false;  // identity collision
+  StorageNode node = std::move(it->second);
+  g->storages.erase(it);
+  node.ip = new_ip;
+  node.port = port;
+  g->storages[new_addr] = std::move(node);
+  // Rewrite every reference to the old identity.
+  for (auto& [addr2, s] : g->storages) {
+    auto sf = s.synced_from.find(old_addr);
+    if (sf != s.synced_from.end()) {
+      int64_t ts = sf->second;
+      s.synced_from.erase(sf);
+      int64_t& cur = s.synced_from[new_addr];
+      if (ts > cur) cur = ts;
+    }
+    if (s.sync_src_addr == old_addr) s.sync_src_addr = new_addr;
+  }
+  if (g->trunk_addr == old_addr) g->trunk_addr = new_addr;
+  FDFS_LOG_INFO("storage %s renamed to %s in group %s", old_addr.c_str(),
+                new_addr.c_str(), group.c_str());
+  return true;
+}
+
 bool Cluster::DeleteStorage(const std::string& group, const std::string& addr) {
   GroupInfo* g = FindGroup(group);
   if (g == nullptr) return false;
@@ -459,15 +491,17 @@ std::optional<StoreTarget> Cluster::QueryUpdate(const std::string& group,
 
 // -- introspection --------------------------------------------------------
 
-static void AppendStorageJson(std::string* out, const StorageNode& s) {
-  char buf[1024];
+static void AppendStorageJson(std::string* out, const StorageNode& s,
+                              const std::string& id) {
+  char buf[1100];
   std::snprintf(
       buf, sizeof(buf),
-      "{\"ip\":\"%s\",\"port\":%d,\"status\":%d,\"store_paths\":%d,"
+      "{\"id\":\"%s\",\"ip\":\"%s\",\"port\":%d,\"status\":%d,"
+      "\"store_paths\":%d,"
       "\"join_time\":%lld,\"last_beat\":%lld,\"total_mb\":%lld,"
       "\"free_mb\":%lld,\"upload\":[%lld,%lld],\"download\":[%lld,%lld],"
       "\"delete\":[%lld,%lld],\"dedup_hits\":%lld,\"dedup_bytes_saved\":%lld}",
-      s.ip.c_str(), s.port, s.status, s.store_path_count,
+      id.c_str(), s.ip.c_str(), s.port, s.status, s.store_path_count,
       static_cast<long long>(s.join_time), static_cast<long long>(s.last_beat),
       static_cast<long long>(s.total_mb), static_cast<long long>(s.free_mb),
       static_cast<long long>(s.stats[0]), static_cast<long long>(s.stats[1]),
@@ -512,7 +546,7 @@ std::string Cluster::StoragesJson(const std::string& group) const {
     for (const auto& [addr, s] : it->second.storages) {
       if (!first) out += ",";
       first = false;
-      AppendStorageJson(&out, s);
+      AppendStorageJson(&out, s, StorageIdForIp(s.ip));
     }
   }
   return out + "]";

@@ -96,6 +96,11 @@ class Cluster {
   // nodes silent past `timeout_s` go OFFLINE; returns # transitions.
   int CheckAlive(int64_t now, int64_t timeout_s);
   bool DeleteStorage(const std::string& group, const std::string& addr);
+  // IP-changed dealer (storage_ip_changed_dealer.c): move a member to a
+  // new IP preserving its state; every reference (peers' synced_from
+  // keys, sync sources, trunk server) is rewritten.
+  bool RenameStorage(const std::string& group, const std::string& old_addr,
+                     const std::string& new_ip, int port);
 
   // -- full-sync negotiation (tracker_deal_storage_sync_* analogues) -----
   // New server asks who should full-sync it.  Returns: 0 = source assigned
@@ -135,6 +140,16 @@ class Cluster {
                                          const std::string& remote);
   std::vector<StoreTarget> QueryStoreAll(const std::string& group_hint);
 
+  // Server-ID alias table (storage_ids.conf): ip -> stable id, shown by
+  // the monitor feed.
+  void SetStorageIds(std::map<std::string, std::string> ip_to_id) {
+    storage_ids_ = std::move(ip_to_id);
+  }
+  std::string StorageIdForIp(const std::string& ip) const {
+    auto it = storage_ids_.find(ip);
+    return it == storage_ids_.end() ? "" : it->second;
+  }
+
   // -- introspection (fdfs_monitor feed; JSON) ---------------------------
   std::string GroupsJson() const;
   std::string OneGroupJson(const std::string& group) const;
@@ -153,6 +168,7 @@ class Cluster {
   StorageNode* FindNode(const std::string& group, const std::string& addr);
   void EnsureTrunkServer(GroupInfo* g);
   std::map<std::string, GroupInfo> groups_;
+  std::map<std::string, std::string> storage_ids_;  // ip -> id
   int store_lookup_;
   std::string store_group_;
   bool trunk_enabled_;

@@ -47,6 +47,8 @@ int main(int argc, char** argv) {
   cfg.trunk_file_size = ini.GetInt("trunk_file_size", 64LL * 1024 * 1024);
   cfg.reserved_storage_space_mb = ini.GetInt("reserved_storage_space", 0);
   cfg.tracker_peers = ini.GetAll("tracker_server");
+  cfg.use_storage_id = ini.GetBool("use_storage_id", false);
+  cfg.storage_ids_file = ini.GetStr("storage_ids_filename", "");
   if (cfg.base_path.empty()) {
     std::fprintf(stderr, "config error: base_path is required\n");
     return 1;

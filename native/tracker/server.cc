@@ -82,7 +82,28 @@ bool TrackerServer::Init(std::string* error) {
   }
   cluster_ = std::make_unique<Cluster>(cfg_.store_lookup, cfg_.store_group,
                                        cfg_.use_trunk_file);
+  if (cfg_.use_storage_id && !cfg_.storage_ids_file.empty()) {
+    // storage_ids.conf: "<id> <group> <ip>" per line (fdfs_shared_func.c:
+    // fdfs_get_storage_ids_from_tracker_group table format).
+    std::map<std::string, std::string> ids;
+    FILE* f = fopen(cfg_.storage_ids_file.c_str(), "r");
+    if (f != nullptr) {
+      char line[256], id[64], grp[64], ip[64];
+      while (fgets(line, sizeof(line), f) != nullptr) {
+        if (line[0] == '#') continue;
+        if (sscanf(line, "%63s %63s %63s", id, grp, ip) == 3) ids[ip] = id;
+      }
+      fclose(f);
+      FDFS_LOG_INFO("loaded %zu storage ids from %s", ids.size(),
+                    cfg_.storage_ids_file.c_str());
+    } else {
+      *error = "cannot open storage_ids file " + cfg_.storage_ids_file;
+      return false;
+    }
+    cluster_->SetStorageIds(std::move(ids));
+  }
   state_path_ = cfg_.base_path + "/data/storage_servers.dat";
+  changelog_path_ = cfg_.base_path + "/data/changelog.dat";
   cluster_->Load(state_path_);
 
   server_ = std::make_unique<RequestServer>(
@@ -93,8 +114,22 @@ bool TrackerServer::Init(std::string* error) {
   loop_.AddTimer(1000, [this]() {
     cluster_->CheckAlive(time(nullptr), cfg_.check_active_interval_s);
   });
-  loop_.AddTimer(cfg_.save_interval_s * 1000,
-                 [this]() { cluster_->Save(state_path_); });
+  loop_.AddTimer(cfg_.save_interval_s * 1000, [this]() {
+    cluster_->Save(state_path_);
+    // Periodic status file (tracker_write_status_file analogue).
+    std::string tmp = cfg_.base_path + "/data/tracker_status.dat.tmp";
+    FILE* f = fopen(tmp.c_str(), "w");
+    if (f != nullptr) {
+      fprintf(f, "ts=%lld\nleader=%s\nam_leader=%d\ngroups=%zu\n",
+              static_cast<long long>(time(nullptr)),
+              relationship_ ? relationship_->leader_addr().c_str() : "",
+              relationship_ && relationship_->am_leader() ? 1 : 0,
+              cluster_->group_count());
+      fclose(f);
+      rename(tmp.c_str(),
+             (cfg_.base_path + "/data/tracker_status.dat").c_str());
+    }
+  });
 
   // Multi-tracker relationship (tracker_relationship.c): leader election
   // among the configured tracker peers.  Identity resolution order: an
@@ -360,6 +395,50 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
     case TrackerCmd::kServerListOneGroup: {
       if (body.size() < 16) return {22, ""};
       return {0, cluster_->OneGroupJson(FixedGroup(p))};
+    }
+
+    case TrackerCmd::kStorageReportIpChanged: {
+      // 16B group + 16B old_ip + 16B new_ip + 8B port — the storage's own
+      // IP moved; rewrite its identity, log to the changelog so peers can
+      // rename their sync cursors (storage_ip_changed_dealer.c /
+      // storage_changelog_req).
+      if (body.size() < 56) return {22, ""};
+      std::string group = FixedGroup(p);
+      std::string old_ip = FixedIp(p + 16);
+      std::string new_ip = FixedIp(p + 32);
+      int64_t sport = GetInt64BE(p + 48);
+      if (old_ip.empty() || new_ip.empty() || sport <= 0 || sport > 65535)
+        return {22, ""};
+      std::string old_addr = old_ip + ":" + std::to_string(sport);
+      if (!cluster_->RenameStorage(group, old_addr, new_ip,
+                                   static_cast<int>(sport)))
+        return {2, ""};
+      FILE* f = fopen(changelog_path_.c_str(), "a");
+      if (f != nullptr) {
+        fprintf(f, "%lld %s %s %s:%lld\n", static_cast<long long>(now),
+                group.c_str(), old_addr.c_str(), new_ip.c_str(),
+                static_cast<long long>(sport));
+        fclose(f);
+      }
+      cluster_->Save(state_path_);
+      return {0, ""};
+    }
+
+    case TrackerCmd::kStorageChangelogReq: {
+      // Identity changelog since byte `offset` (8B, optional; 0 = all).
+      int64_t offset = body.size() >= 8 ? GetInt64BE(p) : 0;
+      std::string text;
+      FILE* f = fopen(changelog_path_.c_str(), "r");
+      if (f != nullptr) {
+        if (offset > 0) fseek(f, static_cast<long>(offset), SEEK_SET);
+        char buf[4096];
+        size_t n;
+        while ((n = fread(buf, 1, sizeof(buf), f)) > 0 &&
+               text.size() < (4U << 20))
+          text.append(buf, n);
+        fclose(f);
+      }
+      return {0, text};
     }
 
     case TrackerCmd::kTrackerGetStatus:

@@ -37,6 +37,11 @@ struct TrackerConfig {
   // Every tracker in the cluster ("ip:port", including this one) for the
   // multi-tracker relationship (tracker_relationship.c).  Empty = single.
   std::vector<std::string> tracker_peers;
+  // Server-ID aliasing (tracker.conf:use_storage_id + storage_ids.conf
+  // "<id> <group> <ip>" lines): stable operator-facing names for storages
+  // whose IPs may change.
+  bool use_storage_id = false;
+  std::string storage_ids_file;
 };
 
 class TrackerServer {
@@ -60,6 +65,7 @@ class TrackerServer {
   EventLoop loop_;
   std::unique_ptr<RequestServer> server_;
   std::string state_path_;
+  std::string changelog_path_;  // identity changes (storage_changelog_req)
 };
 
 }  // namespace fdfs
