@@ -1,0 +1,113 @@
+"""Pallas TPU kernel for MinHash signatures.
+
+The XLA path materializes the (num_perms, L) permuted-hash plane per
+chunk, so it is HBM-bound (~4 GB/s marginal on a v5e).  This kernel
+streams the shingle-hash sequence once and keeps the running minima of
+all permutations in registers, leaving pure VPU work: per position,
+``num_perms`` multiply-add-min triples.
+
+Masking trick: instead of a per-position validity select inside the hot
+loop, the XLA prep replaces every invalid position's hash with the
+chunk's position-0 hash.  MinHash is a set minimum — duplicating an
+element that is already in the set changes nothing — so the kernel can
+run unmasked and still produce signatures bit-identical to the masked
+XLA path (enforced by tests/test_minhash.py).
+
+Layout mirrors pallas_sha1: chunks one-per-lane on (SUB, 128) tiles,
+grid ``(chunk_tiles, position_blocks)`` with the signature accumulator
+revisited across the sequential position axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from fastdfs_tpu.ops.minhash import (DEFAULT_PERMS, DEFAULT_SHINGLE,
+                                     _perm_constants, shingle_hashes)
+
+LANE = 128
+DEFAULT_SUB = 16
+POS_BLOCK = 64  # positions consumed per grid step
+
+
+def _make_kernel(num_perms: int):
+    a_np, b_np = _perm_constants(num_perms)
+
+    def kernel(h_ref, state_ref):
+        pb = pl.program_id(1)
+
+        @pl.when(pb == 0)
+        def _():
+            for j in range(num_perms):
+                state_ref[j, 0] = jnp.full(state_ref.shape[2:], 0xFFFFFFFF,
+                                           dtype=jnp.uint32)
+
+        def body(g, sigs):
+            h = h_ref[0, 0, g]
+            return tuple(
+                jnp.minimum(sigs[j],
+                            h * jnp.uint32(a_np[j]) + jnp.uint32(b_np[j]))
+                for j in range(num_perms))
+
+        sigs = tuple(state_ref[j, 0] for j in range(num_perms))
+        sigs = jax.lax.fori_loop(0, h_ref.shape[2], body, sigs)
+        for j in range(num_perms):
+            state_ref[j, 0] = sigs[j]
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_perms", "k", "sub", "interpret"))
+def minhash_batch_pallas(data, lengths, num_perms: int = DEFAULT_PERMS,
+                         k: int = DEFAULT_SHINGLE, sub: int = DEFAULT_SUB,
+                         interpret: bool = False):
+    """Pallas-path twin of ops.minhash.minhash_batch: uint8 (N, L) +
+    int32 (N,) → uint32 (N, num_perms) signatures (bit-identical)."""
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    lengths = jnp.asarray(lengths, dtype=jnp.int32)
+    n, L = data.shape
+
+    h = jax.vmap(lambda row: shingle_hashes(row, k))(data)  # (N, L) uint32
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    lens = lengths[:, None]
+    valid = pos <= (lens - k)
+    valid = jnp.where(lens >= k, valid, pos < jnp.maximum(lens, 1))
+    # Duplicate-element masking: invalid positions re-contribute the
+    # chunk's (always-valid) position-0 hash, which cannot change the min.
+    h = jnp.where(valid, h, h[:, :1])
+
+    # Pad chunks to (sub,128) tiles and positions to POS_BLOCK multiples.
+    # Padded POSITIONS reuse the same duplicate-element trick (any other
+    # fill value would be permuted into arbitrary words that could win a
+    # minimum); padded CHUNK rows are sliced off the result, any value.
+    tile = sub * LANE
+    n_pad = (-n) % tile
+    l_pad = (-L) % POS_BLOCK
+    if l_pad:
+        h = jnp.concatenate(
+            [h, jnp.broadcast_to(h[:, :1], (h.shape[0], l_pad))], axis=1)
+    if n_pad:
+        h = jnp.pad(h, ((0, n_pad), (0, 0)))
+    n_tiles = (n + n_pad) // tile
+    pb = (L + l_pad) // POS_BLOCK
+
+    h_t = (h.reshape(n_tiles, sub, LANE, pb, POS_BLOCK)
+           .transpose(0, 3, 4, 1, 2))  # (T, PB, G, sub, 128)
+
+    out = pl.pallas_call(
+        _make_kernel(num_perms),
+        grid=(n_tiles, pb),
+        in_specs=[pl.BlockSpec((1, 1, POS_BLOCK, sub, LANE),
+                               lambda i, p: (i, p, 0, 0, 0))],
+        out_specs=pl.BlockSpec((num_perms, 1, sub, LANE),
+                               lambda i, p: (0, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((num_perms, n_tiles, sub, LANE),
+                                       jnp.uint32),
+        interpret=interpret,
+    )(h_t)
+    return out.reshape(num_perms, -1).T[:n]  # (N, num_perms)
