@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import random
 
+from fastdfs_tpu.client.conn import StatusError
 from fastdfs_tpu.client.storage_client import RemoteFileInfo, StorageClient
 from fastdfs_tpu.client.tracker_client import TrackerClient
 from fastdfs_tpu.common.ini_config import IniConfig
@@ -130,8 +131,28 @@ class FdfsClient:
             t.delete_storage(group, ip, port)
 
     def set_trunk_server(self, group: str, ip: str, port: int) -> None:
+        # The override must land on the tracker LEADER (followers refuse
+        # with EBUSY=16 rather than proxying): ask any tracker who leads,
+        # target it, and fall back to trying each tracker in turn.
         with self._tracker() as t:
-            t.set_trunk_server(group, ip, port)
+            leader = t.get_tracker_status().get("leader", "")
+        if leader:
+            try:
+                host, _, p = leader.rpartition(":")
+                with TrackerClient(host, int(p), self.timeout) as t:
+                    t.set_trunk_server(group, ip, port)
+                    return
+            except (OSError, StatusError):
+                pass
+        last: Exception | None = None
+        for host, p in self.trackers:
+            try:
+                with TrackerClient(host, p, self.timeout) as t:
+                    t.set_trunk_server(group, ip, port)
+                    return
+            except (OSError, StatusError) as e:
+                last = e
+        raise last if last else ConnectionError("no tracker accepted override")
 
     def tracker_status(self) -> dict:
         with self._tracker() as t:

@@ -88,6 +88,10 @@ class TrackerCmd(enum.IntEnum):
     TRACKER_PING_LEADER = 71
     TRACKER_NOTIFY_NEXT_LEADER = 72
     TRACKER_COMMIT_NEXT_LEADER = 73
+    # fastdfs_tpu extension: followers fetch the per-group trunk-server
+    # decision from the elected tracker leader instead of electing locally
+    # (upstream: only the leader calls tracker_mem_find_trunk_server).
+    TRACKER_GET_TRUNK_SERVER = 74
 
 
 class StorageCmd(enum.IntEnum):

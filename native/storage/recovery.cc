@@ -82,11 +82,88 @@ bool RecoveryManager::NeedsRecovery(bool data_was_fresh) const {
 }
 
 void RecoveryManager::Start() {
-  int fd = open(marker_path_.c_str(), O_CREAT | O_WRONLY, 0644);
-  if (fd >= 0) close(fd);
+  // The marker doubles as a phase record: "fetch" (data still being
+  // rebuilt) vs "notify" (data complete, done-notify not yet acked by
+  // every tracker).  A restart in the notify phase must NOT redo the
+  // fetch — only finish telling the trackers.
+  if (ReadMarkerPhase() != "notify") WriteMarkerPhase("fetch");
   FDFS_LOG_WARN("disk recovery: starting background rebuild");
   running_ = true;
   thread_ = std::thread(&RecoveryManager::ThreadMain, this);
+}
+
+std::string RecoveryManager::ReadMarkerPhase() const {
+  FILE* f = fopen(marker_path_.c_str(), "r");
+  if (f == nullptr) return "";
+  char buf[32] = {0};
+  size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+  fclose(f);
+  std::string s(buf, n);
+  while (!s.empty() && (s.back() == '\n' || s.back() == ' ')) s.pop_back();
+  return s;
+}
+
+void RecoveryManager::WriteMarkerPhase(const std::string& phase) const {
+  // A lost marker is NOT fail-safe: a crash mid-fetch with no marker
+  // rejoins "healthy" and the tracker clears its recovery hold for a
+  // half-rebuilt node.  Never fail silently here.
+  std::string tmp = marker_path_ + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (f == nullptr || fputs(phase.c_str(), f) == EOF ||
+      fflush(f) != 0 || fsync(fileno(f)) != 0) {
+    FDFS_LOG_ERROR("recovery marker write %s FAILED (%s): a crash before "
+                   "completion may rejoin a half-rebuilt node as healthy",
+                   tmp.c_str(), strerror(errno));
+    if (f != nullptr) fclose(f);
+    return;
+  }
+  fclose(f);
+  if (rename(tmp.c_str(), marker_path_.c_str()) != 0)
+    FDFS_LOG_ERROR("recovery marker rename %s: %s", marker_path_.c_str(),
+                   strerror(errno));
+}
+
+// Done-notify to EVERY tracker, retrying each until it acks: a tracker
+// unreachable at completion would otherwise hold this node in WAIT_SYNC
+// (with the sentinel sync_until_ts) and exclude it from that tracker's
+// read routing indefinitely.  Re-sends to already-acked trackers are
+// idempotent, so acks are simply accumulated across rounds.
+bool RecoveryManager::NotifyAllTrackers(const std::string& self) {
+  std::vector<bool> acked(cfg_.tracker_servers.size(), false);
+  int backoff_ms = 500;
+  int unreachable_rounds = 0;
+  while (!stop_) {
+    auto replies =
+        TrackerRpcAll(static_cast<uint8_t>(TrackerCmd::kStorageSyncNotify),
+                      self);
+    bool all = true, progress = false;
+    for (size_t i = 0; i < replies.size(); ++i) {
+      if (replies[i].reached && replies[i].status == 0) {
+        if (!acked[i]) progress = true;
+        acked[i] = true;
+      }
+      if (!acked[i]) all = false;
+    }
+    if (all) return true;
+    // Bound the loop for permanently-decommissioned trackers left in the
+    // config: once every *reachable* tracker has acked and the remainder
+    // stayed dark for many rounds, declare done — a held tracker that
+    // later returns clears the hold itself when our healthy (non-
+    // recovering) JOIN arrives (Cluster::Join sentinel path).
+    bool rest_unreachable = true;
+    for (size_t i = 0; i < replies.size(); ++i)
+      if (!acked[i] && replies[i].reached) rest_unreachable = false;
+    unreachable_rounds = (rest_unreachable && !progress)
+                             ? unreachable_rounds + 1 : 0;
+    if (unreachable_rounds >= 20) {
+      FDFS_LOG_WARN("disk recovery: done-notify gave up on unreachable "
+                    "tracker(s); their holds clear on our next JOIN");
+      return true;
+    }
+    for (int i = 0; i < backoff_ms / 100 && !stop_; ++i) usleep(100 * 1000);
+    backoff_ms = std::min(backoff_ms * 2, 10000);
+  }
+  return false;
 }
 
 // One RPC against EVERY configured tracker (each holds its own copy of
@@ -129,6 +206,19 @@ void RecoveryManager::ThreadMain() {
     char num[8];
     PutInt64BE(cfg_.port, reinterpret_cast<uint8_t*>(num));
     self.append(num, 8);
+  }
+
+  // Restart mid-notify: the data fetch already completed, only the
+  // done-notify to the trackers is outstanding.
+  if (ReadMarkerPhase() == "notify") {
+    FDFS_LOG_WARN("disk recovery: resuming done-notify phase");
+    reporter_->set_recovering(false);
+    if (NotifyAllTrackers(self)) {
+      unlink(marker_path_.c_str());
+      FDFS_LOG_INFO("disk recovery: done-notify completed");
+    }
+    running_ = false;
+    return;
   }
 
   // Re-enter full-sync, then rebuild; every failure retries with backoff
@@ -187,15 +277,15 @@ void RecoveryManager::ThreadMain() {
   }
 
   if (!stop_) {
+    WriteMarkerPhase("notify");  // fetch done; survives a crash mid-notify
     reporter_->set_recovering(false);  // future re-joins are normal again
-    // Done-notify to EVERY tracker: each holds this node in WAIT_SYNC
-    // independently, and one left un-notified would exclude the node
-    // from its read routing forever.
-    TrackerRpcAll(static_cast<uint8_t>(TrackerCmd::kStorageSyncNotify), self);
-    unlink(marker_path_.c_str());
-    FDFS_LOG_INFO("disk recovery complete: %lld files restored, %lld skipped",
-                  static_cast<long long>(files_recovered_.load()),
-                  static_cast<long long>(files_skipped_.load()));
+    if (NotifyAllTrackers(self)) {
+      unlink(marker_path_.c_str());
+      FDFS_LOG_INFO("disk recovery complete: %lld files restored, %lld "
+                    "skipped",
+                    static_cast<long long>(files_recovered_.load()),
+                    static_cast<long long>(files_skipped_.load()));
+    }
   }
   running_ = false;
 }

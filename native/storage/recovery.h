@@ -55,6 +55,13 @@ class RecoveryManager {
   // sync state for this node).
   std::vector<TrackerReply> TrackerRpcAll(uint8_t cmd,
                                           const std::string& body);
+  // Marker phase record: "fetch" while data is being rebuilt, "notify"
+  // once complete but with done-notify acks still outstanding.
+  std::string ReadMarkerPhase() const;
+  void WriteMarkerPhase(const std::string& phase) const;
+  // Retry the done-notify against every tracker until each acks (or
+  // shutdown); returns true when all acked.
+  bool NotifyAllTrackers(const std::string& self);
   bool RecoverPath(const PeerInfo& peer, int spi);
   // All peer RPCs reuse one keepalive connection (*fd, -1 = closed);
   // callees reconnect once on IO failure.  Millions of small files would

@@ -899,13 +899,57 @@ void StorageServer::RefreshClusterParams() {
   auto [tip, tport] = reporter_->trunk_server();
   trunk_ip_ = tip;
   trunk_port_ = tport;
+  // Slot alloc_size fields are uint32: a trunk_file_size >= 4GiB would
+  // silently truncate the initial whole-file free block and corrupt the
+  // allocator's view.  Refuse and disable trunk rather than corrupt
+  // (latched log: this refires every param tick).
+  if (trunk_enabled_ && trunk_file_size_ >= (4LL << 30)) {
+    if (!trunk_size_err_logged_) {
+      FDFS_LOG_ERROR("trunk_file_size %lld >= 4GiB unsupported: trunk "
+                     "disabled", static_cast<long long>(trunk_file_size_));
+      trunk_size_err_logged_ = true;
+    }
+    trunk_enabled_ = false;
+  }
   bool am_trunk = trunk_enabled_ && !trunk_ip_.empty() &&
                   trunk_ip_ == MyIp() && trunk_port_ == cfg_.port;
+  // A zeroed trailer means "unknown" (e.g. the reporting tracker briefly
+  // cannot reach its leader), not "role lost": hold the current role
+  // rather than flapping, which would void slots handed out but not yet
+  // written.  A genuine move always names a different server.
+  if (trunk_enabled_ && trunk_ip_.empty()) am_trunk = is_trunk_server_;
+  // Any tick without the role cancels an armed-but-unexpired grace:
+  // otherwise a role flap during the grace leaves a stale (expired)
+  // deadline that would skip the grace entirely on the next regain.
+  if (!am_trunk) trunk_regain_not_before_ = 0;
+  if (am_trunk && !is_trunk_server_) {
+    if (held_trunk_role_before_) {
+      // REGAINING the role: slots allocated by the interim trunk server
+      // may still be replicating here; a rescan now would list them free
+      // and hand them out again (silent data loss).  Wait out a grace
+      // period first, then rebuild the pool from a fresh disk scan.
+      // (Replication lag beyond the grace is a residual risk; the
+      // complete fix is an allocation epoch checked in the trunk RPC.)
+      if (trunk_regain_not_before_ == 0) {
+        trunk_regain_not_before_ = time(nullptr) + kTrunkRegainGraceS;
+        FDFS_LOG_WARN("trunk role regained: holding %d s for in-flight "
+                      "interim allocations before rescan",
+                      kTrunkRegainGraceS);
+      }
+      if (time(nullptr) < trunk_regain_not_before_) {
+        is_trunk_server_ = false;  // serve flat-file fallback meanwhile
+        return;
+      }
+    }
+    trunk_alloc_.reset();  // always rescan on a false->true transition
+  }
   if (am_trunk && trunk_alloc_ == nullptr) {
     auto alloc = std::make_unique<TrunkAllocator>();
     std::string err;
     if (alloc->Init(store_.store_path(0), trunk_file_size_, &err)) {
       trunk_alloc_ = std::move(alloc);
+      held_trunk_role_before_ = true;
+      trunk_regain_not_before_ = 0;
       FDFS_LOG_INFO("this server is now the trunk server (%d trunk files, "
                     "%lld free bytes)",
                     trunk_alloc_->trunk_file_count(),
@@ -914,6 +958,10 @@ void StorageServer::RefreshClusterParams() {
       FDFS_LOG_ERROR("trunk allocator init failed: %s", err.c_str());
       am_trunk = false;
     }
+  } else if (!am_trunk && is_trunk_server_) {
+    trunk_alloc_.reset();  // role genuinely moved: the pool goes stale the
+                           // moment the new trunk server starts allocating
+    trunk_regain_not_before_ = 0;
   }
   is_trunk_server_ = am_trunk;
 }

@@ -219,6 +219,13 @@ class StorageServer {
   std::string trunk_ip_;
   int trunk_port_ = 0;
   bool is_trunk_server_ = false;
+  // Role-regain safety: after losing and regaining the trunk role, hold
+  // this many seconds before rescanning (interim allocations may still be
+  // replicating in); see RefreshClusterParams.
+  static constexpr int kTrunkRegainGraceS = 3;
+  bool held_trunk_role_before_ = false;
+  int64_t trunk_regain_not_before_ = 0;
+  bool trunk_size_err_logged_ = false;
   std::unique_ptr<TrunkAllocator> trunk_alloc_;
   FILE* access_log_ = nullptr;
   std::string stat_path_;

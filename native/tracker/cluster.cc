@@ -76,6 +76,18 @@ std::optional<std::vector<StorageNode>> Cluster::Join(
     node.status = kWaitSync;
     node.sync_src_addr.clear();  // no auto-promotion path while rebuilding
     node.sync_until_ts = 0;
+  } else if ((node.status == kWaitSync || node.status == kSyncing) &&
+             node.sync_until_ts == kRecoveryHoldSentinel) {
+    // Held for disk recovery, but the node rejoined WITHOUT the
+    // recovering flag: its rebuild finished and only the done-notify
+    // failed to reach this tracker.  Clear the hold — otherwise a
+    // tracker that was down at notify time excludes the node from its
+    // read routing forever.
+    FDFS_LOG_INFO("storage %s rejoined healthy: clearing recovery hold",
+                  addr.c_str());
+    node.status = kActive;
+    node.sync_until_ts = 0;
+    node.sync_src_addr.clear();
   } else if (fresh && g.storages.size() > 1) {
     node.status = kWaitSync;
   } else if (node.status != kWaitSync && node.status != kSyncing) {
@@ -217,6 +229,24 @@ std::string Cluster::TrunkServer(const std::string& group) {
   return g->trunk_addr;
 }
 
+void Cluster::AdoptTrunkServer(const std::string& group,
+                               const std::string& addr) {
+  GroupInfo* g = FindGroup(group);
+  if (g == nullptr) return;
+  if (g->trunk_addr != addr) {
+    FDFS_LOG_INFO("group %s trunk server adopted from leader: %s -> %s",
+                  g->name.c_str(),
+                  g->trunk_addr.empty() ? "(none)" : g->trunk_addr.c_str(),
+                  addr.empty() ? "(none)" : addr.c_str());
+    g->trunk_addr = addr;
+  }
+}
+
+std::string Cluster::CurrentTrunkAddr(const std::string& group) const {
+  auto it = groups_.find(group);
+  return it == groups_.end() ? "" : it->second.trunk_addr;
+}
+
 bool Cluster::SetTrunkServer(const std::string& group,
                              const std::string& addr) {
   GroupInfo* g = FindGroup(group);
@@ -243,7 +273,7 @@ int Cluster::ReenterSync(const std::string& group,
   if (rc == 0) {
     // Hold promotion for the explicit done-notify: the source's caught-up
     // reports only cover NEW writes, not the re-download of history.
-    n->sync_until_ts = INT64_MAX / 2;
+    n->sync_until_ts = kRecoveryHoldSentinel;
   } else if (rc == 1 && FindGroup(group)->storages.size() > 1) {
     // No ACTIVE source YET, but peers exist (whole-group restart): the
     // wiped node must NOT go ACTIVE — an empty disk would take reads and

@@ -27,6 +27,10 @@ namespace fdfs {
 
 constexpr int kBeatStatCount = 20;  // int64 slots in the beat stats blob
 
+// sync_until_ts value marking a disk-recovery hold: promotion waits for the
+// node's explicit done-notify (or a healthy re-JOIN), never sync reports.
+constexpr int64_t kRecoveryHoldSentinel = INT64_MAX / 2;
+
 struct StorageNode {
   std::string ip;
   int port = 0;
@@ -125,9 +129,16 @@ class Cluster {
   // -- trunk server election (leader decides; SURVEY §2.1/§2.3) ----------
   // Current trunk server for the group ("" when none); elects/repairs on
   // demand so callers always see a live choice when one is possible.
+  // ONLY the tracker leader may call this: ACTIVE sets can transiently
+  // differ across trackers, and two trackers electing independently can
+  // hand two storages the same slot space (double allocation).
   std::string TrunkServer(const std::string& group);
   // Operator override (SERVER_SET_TRUNK_SERVER 94); target must be ACTIVE.
   bool SetTrunkServer(const std::string& group, const std::string& addr);
+  // Follower-side: adopt the leader's decision verbatim (no election).
+  void AdoptTrunkServer(const std::string& group, const std::string& addr);
+  // Read the current value without electing (followers, introspection).
+  std::string CurrentTrunkAddr(const std::string& group) const;
 
   // -- routing (tracker_get_writable_storage & co.) ----------------------
   std::optional<StoreTarget> QueryStore(const std::string& group_hint);

@@ -53,6 +53,22 @@ int main(int argc, char** argv) {
     std::fprintf(stderr, "config error: base_path is required\n");
     return 1;
   }
+  // Trunk slot sizes travel as uint32 on disk: >= 4GiB would silently
+  // truncate the whole-file free block.  Fail fast at load instead.
+  if (cfg.use_trunk_file) {
+    if (cfg.trunk_file_size >= (4LL << 30)) {
+      std::fprintf(stderr, "config error: trunk_file_size must be < 4GiB\n");
+      return 1;
+    }
+    if (cfg.slot_max_size >= cfg.trunk_file_size) {
+      // A slot can never exceed its trunk file; clamp (the common case is
+      // a small trunk_file_size with the default slot_max_size).
+      cfg.slot_max_size = static_cast<int>(cfg.trunk_file_size / 2);
+      std::fprintf(stderr,
+                   "config warning: slot_max_size >= trunk_file_size, "
+                   "clamped to %d\n", cfg.slot_max_size);
+    }
+  }
   if (cfg.log_level == "debug") fdfs::LogSetLevel(fdfs::LogLevel::kDebug);
   else if (cfg.log_level == "warn") fdfs::LogSetLevel(fdfs::LogLevel::kWarn);
   else if (cfg.log_level == "error") fdfs::LogSetLevel(fdfs::LogLevel::kError);

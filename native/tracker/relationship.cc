@@ -26,20 +26,21 @@ bool SplitAddr(const std::string& addr, std::string* host, int* port) {
 }
 
 bool Rpc(const std::string& addr, uint8_t cmd, const std::string& body,
-         std::string* resp, uint8_t* status) {
+         std::string* resp, uint8_t* status,
+         int timeout_ms = kRpcTimeoutMs) {
   std::string host;
   int port;
   if (!SplitAddr(addr, &host, &port)) return false;
   std::string err;
-  int fd = TcpConnect(host, port, kRpcTimeoutMs, &err);
+  int fd = TcpConnect(host, port, timeout_ms, &err);
   if (fd < 0) return false;
   uint8_t hdr[kHeaderSize];
   PutInt64BE(static_cast<int64_t>(body.size()), hdr);
   hdr[8] = cmd;
   hdr[9] = 0;
-  bool ok = SendAll(fd, hdr, sizeof(hdr), kRpcTimeoutMs) &&
-            SendAll(fd, body.data(), body.size(), kRpcTimeoutMs) &&
-            RecvAll(fd, hdr, sizeof(hdr), kRpcTimeoutMs);
+  bool ok = SendAll(fd, hdr, sizeof(hdr), timeout_ms) &&
+            SendAll(fd, body.data(), body.size(), timeout_ms) &&
+            RecvAll(fd, hdr, sizeof(hdr), timeout_ms);
   if (ok) {
     int64_t len = GetInt64BE(hdr);
     *status = hdr[9];
@@ -47,7 +48,7 @@ bool Rpc(const std::string& addr, uint8_t cmd, const std::string& body,
       ok = false;
     } else {
       resp->resize(static_cast<size_t>(len));
-      if (len > 0) ok = RecvAll(fd, resp->data(), resp->size(), kRpcTimeoutMs);
+      if (len > 0) ok = RecvAll(fd, resp->data(), resp->size(), timeout_ms);
     }
   }
   close(fd);
@@ -133,6 +134,14 @@ bool RelationshipManager::OnCommitNextLeader(const std::string& addr) {
   leader_addr_ = addr;
   ping_failures_ = 0;
   return true;
+}
+
+bool RelationshipManager::RpcLeader(uint8_t cmd, const std::string& body,
+                                    std::string* resp, uint8_t* status,
+                                    int timeout_ms) const {
+  std::string leader = leader_addr();
+  if (leader.empty() || leader == my_addr_) return false;
+  return Rpc(leader, cmd, body, resp, status, timeout_ms);
 }
 
 bool RelationshipManager::QueryPeerStatus(const std::string& addr,

@@ -45,6 +45,11 @@ class RelationshipManager {
   // false when the commit names an addr that was never notified (upstream
   // rejects a mismatched commit).
   bool OnCommitNextLeader(const std::string& addr);
+  // One RPC to the current leader (false when leaderless or self-led);
+  // used by followers to fetch leader-only decisions (trunk server).
+  // Callers on an event loop must pass a short timeout: this blocks.
+  bool RpcLeader(uint8_t cmd, const std::string& body, std::string* resp,
+                 uint8_t* status, int timeout_ms = 2000) const;
 
  private:
   void ThreadMain();

@@ -175,6 +175,38 @@ bool TrackerServer::Init(std::string* error) {
 
 void TrackerServer::Run() { loop_.Run(); }
 
+std::string TrackerServer::ResolveTrunkServer(const std::string& group) {
+  if (!cfg_.use_trunk_file) return "";  // never poll for a disabled feature
+  if (relationship_ == nullptr || relationship_->am_leader())
+    return cluster_->TrunkServer(group);
+  // Follower: refresh the adopted value from the leader at most once a
+  // second (beats are frequent); an unreachable leader keeps the last
+  // adopted answer — stale-but-consistent beats fresh-but-divergent.
+  // The throttle stamp advances on failure too: a down leader must not
+  // turn every storage beat into a blocking connect on this loop.
+  int64_t now_ms = NowMs();
+  int64_t& fetched = trunk_fetched_ms_[group];
+  if (now_ms - fetched >= 1000) {
+    fetched = now_ms;
+    std::string body;
+    PutFixedField(&body, group, kGroupNameMaxLen);
+    std::string resp;
+    uint8_t status = 0;
+    // Short timeout: this blocks the event loop.  On failure, back off
+    // ~10s so a dead leader costs one brief stall per window, not one
+    // per storage beat.
+    if (relationship_->RpcLeader(
+            static_cast<uint8_t>(TrackerCmd::kTrackerGetTrunkServer), body,
+            &resp, &status, /*timeout_ms=*/300) &&
+        status == 0) {
+      cluster_->AdoptTrunkServer(group, resp);
+    } else {
+      fetched = now_ms + 9000;
+    }
+  }
+  return cluster_->CurrentTrunkAddr(group);
+}
+
 void TrackerServer::Stop() {
   cluster_->Save(state_path_);
   if (relationship_ != nullptr) relationship_->Stop();
@@ -231,7 +263,7 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       // Trailer: the group's elected trunk server (zeros when trunk is
       // off) — how every member learns where to RPC slot allocations.
       std::string out = PackPeers(peers);
-      std::string taddr = cluster_->TrunkServer(group);
+      std::string taddr = ResolveTrunkServer(group);
       std::string tip;
       int64_t tport = 0;
       size_t colon = taddr.rfind(':');
@@ -468,11 +500,26 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
 
     case TrackerCmd::kServerSetTrunkServer: {
       // 16B group + "ip:port" — operator override of the elected trunk
-      // server (fdfs_monitor's set_trunk_server).
+      // server (fdfs_monitor's set_trunk_server).  The override must land
+      // on the leader (where elections are decided, or the next repair
+      // would silently revert it); a follower refuses with EBUSY rather
+      // than proxying — two trackers with crossed leader views would
+      // proxy to each other and stall both event loops.
       if (body.size() < 17) return {22, ""};
+      if (relationship_ != nullptr && !relationship_->am_leader())
+        return {16 /*EBUSY: not the leader*/, ""};
       if (!cluster_->SetTrunkServer(FixedGroup(p), body.substr(16)))
         return {2, ""};
       return {0, ""};
+    }
+
+    case TrackerCmd::kTrackerGetTrunkServer: {
+      // 16B group -> "ip:port" (leader-only: a follower answering from
+      // its own view would reintroduce the divergence this cmd removes).
+      if (body.size() < 16) return {22, ""};
+      if (relationship_ != nullptr && !relationship_->am_leader())
+        return {16 /*EBUSY*/, ""};
+      return {0, cluster_->TrunkServer(FixedGroup(p))};
     }
 
     case TrackerCmd::kServiceQueryFetchOne:

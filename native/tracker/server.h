@@ -5,6 +5,7 @@
 #pragma once
 
 #include <cstdint>
+#include <map>
 #include <memory>
 #include <string>
 #include <vector>
@@ -58,8 +59,14 @@ class TrackerServer {
  private:
   std::pair<uint8_t, std::string> Handle(uint8_t cmd, const std::string& body,
                                          const std::string& peer_ip);
+  // Trunk-server resolution for the beat trailer: the leader elects, a
+  // follower adopts the leader's answer (cached briefly) and NEVER elects
+  // locally — independent elections from transiently-diverged ACTIVE sets
+  // can double-allocate trunk slots.
+  std::string ResolveTrunkServer(const std::string& group);
 
   TrackerConfig cfg_;
+  std::map<std::string, int64_t> trunk_fetched_ms_;  // follower cache age
   std::unique_ptr<Cluster> cluster_;
   std::unique_ptr<RelationshipManager> relationship_;
   EventLoop loop_;
