@@ -39,6 +39,11 @@ class DedupConfig:
     lsh_bands: int = 16
     near_dup_threshold: float = 0.5
     near_dup_top_k: int = 5
+    # Fixed row tile per jitted batch: chunks are processed in groups of
+    # exactly this many rows (last group padded), so each pow2 length
+    # bucket compiles exactly ONE XLA shape — a varying chunk count would
+    # otherwise retrace per distinct N and dominate wall-clock.
+    row_tile: int = 256
 
 
 @dataclass
@@ -119,19 +124,40 @@ class DedupEngine:
         for i, (off, ln) in enumerate(spans):
             by_bucket.setdefault(_bucket_len(ln, cfg.min_size, cfg.max_size), []).append(i)
 
+        # Fixed (row_tile, blen) shapes: one compile per bucket, ever.
+        tile = cfg.row_tile
         for blen, idxs in sorted(by_bucket.items()):
-            batch = np.zeros((len(idxs), blen), dtype=np.uint8)
-            lens = np.zeros(len(idxs), dtype=np.int32)
-            for row, i in enumerate(idxs):
-                off, ln = spans[i]
-                batch[row, :ln] = arr[off:off + ln]
-                lens[row] = ln
-            d = np.asarray(sha1_batch(batch, lens))
-            s = np.asarray(minhash_batch(batch, lens, cfg.num_perms, cfg.shingle))
-            for row, i in enumerate(idxs):
-                digests[i] = d[row]
-                sigs[i] = s[row]
+            for start in range(0, len(idxs), tile):
+                group = idxs[start:start + tile]
+                batch = np.zeros((tile, blen), dtype=np.uint8)
+                lens = np.zeros(tile, dtype=np.int32)
+                for row, i in enumerate(group):
+                    off, ln = spans[i]
+                    batch[row, :ln] = arr[off:off + ln]
+                    lens[row] = ln
+                d = np.asarray(sha1_batch(batch, lens))
+                s = np.asarray(minhash_batch(batch, lens, cfg.num_perms,
+                                             cfg.shingle))
+                for row, i in enumerate(group):
+                    digests[i] = d[row]
+                    sigs[i] = s[row]
         return spans, digests, sigs
+
+    def warmup(self) -> None:
+        """Compile every jitted shape the fingerprint path can hit (one
+        per pow2 length bucket) so the first real upload never pays a
+        trace.  Call once at process start (the sidecar does, before it
+        binds its socket)."""
+        cfg = self.config
+        blen = max(cfg.min_size, 1)
+        while True:
+            batch = np.zeros((cfg.row_tile, blen), dtype=np.uint8)
+            lens = np.ones(cfg.row_tile, dtype=np.int32)
+            np.asarray(sha1_batch(batch, lens))
+            np.asarray(minhash_batch(batch, lens, cfg.num_perms, cfg.shingle))
+            if blen >= cfg.max_size:
+                break
+            blen = min(blen << 1, cfg.max_size)
 
     # -- stateful ingest ---------------------------------------------------
 

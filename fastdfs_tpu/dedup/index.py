@@ -118,7 +118,20 @@ class MinHashLSHIndex:
                      axis=1, dtype=jnp.float32))
         order = np.argsort(-scores)[:top_k]
         return [(self._refs[int(ids[i])], float(scores[i]))
-                for i in order if scores[i] >= min_similarity]
+                for i in order
+                if scores[i] >= min_similarity
+                and self._refs[int(ids[i])] is not None]
+
+    def remove(self, ref: Any) -> int:
+        """Tombstone every item carrying ``ref`` (deleted file).  Bucket
+        entries and signature rows stay (append-only ids); queries skip
+        tombstones.  Returns the number of items removed."""
+        n = 0
+        for i, r in enumerate(self._refs):
+            if r == ref:
+                self._refs[i] = None
+                n += 1
+        return n
 
     @property
     def signatures(self) -> np.ndarray:

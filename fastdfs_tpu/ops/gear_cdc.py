@@ -123,13 +123,22 @@ def chunk_stream(
     avg_bits: int = DEFAULT_AVG_BITS,
     max_size: int = DEFAULT_MAX_SIZE,
 ) -> list[int]:
-    """TPU-parallel CDC: returns exclusive chunk end offsets for ``data``."""
+    """TPU-parallel CDC: returns exclusive chunk end offsets for ``data``.
+
+    The buffer is zero-padded to the next power of two before the jitted
+    hash pass: XLA compiles once per pow2 shape instead of once per file
+    size, and trailing padding cannot affect ``h[i]`` for real positions
+    (each depends only on the 32 bytes ending at ``i``).
+    """
     if not data:
         return []
-    arr = jnp.frombuffer(data, dtype=jnp.uint8)
-    hashes = gear_hashes(arr)
+    n = len(data)
+    padded = 1 << max(12, (n - 1).bit_length())  # >= 4 KiB, pow2
+    buf = np.zeros(padded, dtype=np.uint8)
+    buf[:n] = np.frombuffer(data, dtype=np.uint8)
+    hashes = np.asarray(gear_hashes(jnp.asarray(buf)))[:n]
     cand = np.flatnonzero(np.asarray(candidate_mask(hashes, avg_bits)))
-    return select_cuts(cand, len(data), min_size, max_size)
+    return select_cuts(cand, n, min_size, max_size)
 
 
 def chunk_stream_ref(

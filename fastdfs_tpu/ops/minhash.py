@@ -54,6 +54,10 @@ def shingle_hashes(data: jax.Array, k: int = DEFAULT_SHINGLE) -> jax.Array:
     return h
 
 
+_MIN_BLOCK = 512  # positions per scan step: keeps the (P, block)
+                  # permuted-hash tile resident instead of an O(P*L) array
+
+
 @functools.partial(jax.jit, static_argnames=("num_perms",))
 def minhash_signature(hashes: jax.Array, num_perms: int = DEFAULT_PERMS,
                       valid: jax.Array | None = None) -> jax.Array:
@@ -61,12 +65,31 @@ def minhash_signature(hashes: jax.Array, num_perms: int = DEFAULT_PERMS,
 
     ``hashes``: uint32 ``(m,)``.  ``valid``: optional bool ``(m,)`` mask
     (padded positions excluded).  Returns uint32 ``(num_perms,)``.
+
+    Computed as a running min over position blocks (lax.scan): the
+    naive ``(P, m)`` permuted matrix is never materialized, so memory is
+    O(P * block) regardless of chunk length.
     """
     a, b = _perm_constants(num_perms)
-    hv = hashes[None, :] * jnp.asarray(a)[:, None] + jnp.asarray(b)[:, None]
-    if valid is not None:
-        hv = jnp.where(valid[None, :], hv, jnp.uint32(0xFFFFFFFF))
-    return hv.min(axis=1)
+    av = jnp.asarray(a)[:, None]
+    bv = jnp.asarray(b)[:, None]
+    m = hashes.shape[0]
+    pad = (-m) % _MIN_BLOCK
+    h = jnp.pad(hashes, (0, pad))
+    v = (jnp.pad(valid, (0, pad)) if valid is not None
+         else jnp.pad(jnp.ones((m,), dtype=bool), (0, pad)))
+    h_blocks = h.reshape(-1, _MIN_BLOCK)
+    v_blocks = v.reshape(-1, _MIN_BLOCK)
+
+    def body(carry, hv_block):
+        hb, vb = hv_block
+        perm = hb[None, :] * av + bv                      # (P, block)
+        perm = jnp.where(vb[None, :], perm, jnp.uint32(0xFFFFFFFF))
+        return jnp.minimum(carry, perm.min(axis=1)), None
+
+    init = jnp.full((num_perms,), 0xFFFFFFFF, dtype=jnp.uint32)
+    sig, _ = jax.lax.scan(body, init, (h_blocks, v_blocks))
+    return sig
 
 
 @functools.partial(jax.jit, static_argnames=("num_perms", "k"))

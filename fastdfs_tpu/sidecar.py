@@ -1,0 +1,275 @@
+"""Dedup sidecar: the TPU fingerprint engine behind the storage daemon.
+
+This is the server half of the daemon's ``dedup_mode = sidecar`` plugin
+(C++ client: ``native/storage/dedup.cc:SidecarDedup``): a unix-socket
+service speaking the standard 10-byte framing with the DEDUP_* opcodes.
+The storage daemon streams each chunk-eligible upload through cmd 120 and
+writes only the chunks its content-addressed store has never seen — this
+process supplies the cut-points and digests, computed by the JAX/TPU
+pipeline (position-parallel gear CDC + batched SHA1; the replacement for
+the scalar CRC32 loop in the reference's
+``storage/storage_dio.c:dio_write_file()``).
+
+Opcodes
+-------
+* ``DEDUP_FINGERPRINT`` (120): body = 8B BE base_offset + raw segment
+  bytes.  Response: 8B BE chunk count, then per chunk 8B BE offset +
+  8B BE length + 20B raw SHA1.  Also feeds the MinHash near-dup index
+  with the segment's file signature (pending until commit).
+* ``DEDUP_QUERY`` (121): body = 40-hex whole-file SHA1.  Response: the
+  canonical file id if known (whole-file dedup for sub-threshold files).
+* ``DEDUP_COMMIT`` (122): text body, one of
+  ``commitfile <sha1hex> <file_id>`` | ``commitchunks <file_id>`` |
+  ``forget <file_id>``.
+
+State: whole-file digest map + the DedupEngine's exact/LSH indexes;
+snapshotted to ``<state_dir>/sidecar_*.json`` on SIGTERM and every
+``--snapshot-interval`` seconds.
+
+Run: ``python -m fastdfs_tpu.sidecar --socket /path/dedup.sock``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from fastdfs_tpu.common.protocol import HEADER_SIZE, StorageCmd, unpack_header
+from fastdfs_tpu.dedup.engine import DedupConfig, DedupEngine
+
+_I64 = struct.Struct(">q")
+
+
+def _pack_header(pkg_len: int, cmd: int, status: int = 0) -> bytes:
+    return struct.pack(">qBB", pkg_len, cmd, status)
+
+
+class DedupSidecar:
+    """Unix-socket dedup service around a :class:`DedupEngine`.
+
+    One engine (and one TPU context) serves every daemon connection;
+    engine calls are serialized under a lock — batching happens inside
+    the engine's bucketed jit calls, not across requests.
+    """
+
+    def __init__(self, socket_path: str, state_dir: str | None = None,
+                 config: DedupConfig | None = None) -> None:
+        self.socket_path = socket_path
+        self.state_dir = state_dir
+        self.engine = DedupEngine(config)
+        self.files: dict[str, str] = {}       # whole-file sha1 -> file id
+        self.by_file: dict[str, str] = {}     # file id -> sha1
+        self._pending_sigs: dict[int, np.ndarray] = {}  # conn id -> file sig
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._listener: socket.socket | None = None
+        self.stats = {"fingerprint_bytes": 0, "chunks": 0, "requests": 0}
+        if state_dir:
+            self._load_state()
+
+    # -- state -------------------------------------------------------------
+
+    def _state_paths(self) -> tuple[str, str, str]:
+        d = self.state_dir or "."
+        return (os.path.join(d, "sidecar_files.json"),
+                os.path.join(d, "sidecar_exact.npz"),
+                os.path.join(d, "sidecar_near.npz"))
+
+    def _load_state(self) -> None:
+        files_p, exact_p, near_p = self._state_paths()
+        if os.path.exists(files_p):
+            with open(files_p) as fh:
+                self.files = json.load(fh)
+            self.by_file = {v: k for k, v in self.files.items()}
+        if os.path.exists(exact_p) and os.path.exists(near_p):
+            self.engine = DedupEngine.load(exact_p, near_p,
+                                           self.engine.config)
+
+    def save_state(self) -> None:
+        if not self.state_dir:
+            return
+        files_p, exact_p, near_p = self._state_paths()
+        with self._lock:
+            tmp = files_p + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(self.files, fh)
+            os.replace(tmp, files_p)
+            self.engine.save(exact_p, near_p)
+
+    # -- request handlers --------------------------------------------------
+
+    def _fingerprint(self, conn_id: int, body: bytes) -> tuple[int, bytes]:
+        if len(body) < 8:
+            return 22, b""
+        base_offset = _I64.unpack_from(body)[0]
+        data = body[8:]
+        with self._lock:
+            spans, digests, sigs = self.engine.fingerprint(data)
+            raw = np.asarray(digests, dtype=">u4").tobytes()
+            out = [_I64.pack(len(spans))]
+            for i, (off, ln) in enumerate(spans):
+                out.append(_I64.pack(base_offset + off))
+                out.append(_I64.pack(ln))
+                out.append(raw[i * 20:(i + 1) * 20])
+                # Exact chunk index: remembers which file first carried a
+                # digest (near-dup attribution; the byte-level dedup
+                # decision lives in the daemon's content-addressed store).
+                dig = raw[i * 20:(i + 1) * 20]
+                if self.engine.exact.lookup(dig) is None:
+                    self.engine.exact.insert(dig, ["(pending)", off])
+            if len(spans):
+                sig = np.asarray(sigs).min(axis=0)
+                prev = self._pending_sigs.get(conn_id)
+                self._pending_sigs[conn_id] = (
+                    sig if prev is None else np.minimum(prev, sig))
+            self.stats["fingerprint_bytes"] += len(data)
+            self.stats["chunks"] += len(spans)
+        return 0, b"".join(out)
+
+    def _query(self, body: bytes) -> tuple[int, bytes]:
+        sha1_hex = body.decode("ascii", "replace").strip()
+        with self._lock:
+            fid = self.files.get(sha1_hex)
+        return 0, fid.encode() if fid else b""
+
+    def _commit(self, conn_id: int, body: bytes) -> tuple[int, bytes]:
+        parts = body.decode("utf-8", "replace").split()
+        if not parts:
+            return 22, b""
+        with self._lock:
+            if parts[0] == "commitfile" and len(parts) == 3:
+                self.files.setdefault(parts[1], parts[2])
+                self.by_file[parts[2]] = parts[1]
+                return 0, b""
+            if parts[0] == "commitchunks" and len(parts) == 2:
+                sig = self._pending_sigs.pop(conn_id, None)
+                if sig is not None:
+                    self.engine.near.add(sig, parts[1])
+                return 0, b""
+            if parts[0] == "forget" and len(parts) == 2:
+                sha1 = self.by_file.pop(parts[1], None)
+                if sha1 is not None and self.files.get(sha1) == parts[1]:
+                    del self.files[sha1]
+                self.engine.near.remove(parts[1])
+                return 0, b""
+        return 22, b""
+
+    # -- server loop -------------------------------------------------------
+
+    def _serve_conn(self, conn: socket.socket, conn_id: int) -> None:
+        try:
+            while not self._stop.is_set():
+                hdr = self._recv_exact(conn, HEADER_SIZE)
+                if hdr is None:
+                    return
+                h = unpack_header(hdr)
+                if h.pkg_len < 0 or h.pkg_len > (1 << 31):
+                    return
+                body = self._recv_exact(conn, h.pkg_len) if h.pkg_len else b""
+                if body is None:
+                    return
+                self.stats["requests"] += 1
+                if h.cmd == StorageCmd.DEDUP_FINGERPRINT:
+                    status, resp = self._fingerprint(conn_id, body)
+                elif h.cmd == StorageCmd.DEDUP_QUERY:
+                    status, resp = self._query(body)
+                elif h.cmd == StorageCmd.DEDUP_COMMIT:
+                    status, resp = self._commit(conn_id, body)
+                elif h.cmd == StorageCmd.ACTIVE_TEST:
+                    status, resp = 0, b""
+                else:
+                    status, resp = 22, b""
+                conn.sendall(_pack_header(len(resp),
+                                          StorageCmd.RESP, status) + resp)
+        except OSError:
+            pass
+        finally:
+            with self._lock:
+                self._pending_sigs.pop(conn_id, None)
+            conn.close()
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> bytes | None:
+        buf = bytearray()
+        while len(buf) < n:
+            got = conn.recv(n - len(buf))
+            if not got:
+                return None
+            buf.extend(got)
+        return bytes(buf)
+
+    def serve_forever(self, ready_event: threading.Event | None = None,
+                      snapshot_interval: float = 60.0) -> None:
+        try:
+            os.unlink(self.socket_path)
+        except FileNotFoundError:
+            pass
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.socket_path)
+        self._listener.listen(16)
+        self._listener.settimeout(0.5)
+        if ready_event is not None:
+            ready_event.set()
+        next_snap = time.monotonic() + snapshot_interval
+        conn_seq = 0
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                if time.monotonic() >= next_snap:
+                    self.save_state()
+                    next_snap = time.monotonic() + snapshot_interval
+                continue
+            except OSError:
+                break
+            conn_seq += 1
+            threading.Thread(target=self._serve_conn,
+                             args=(conn, conn_seq), daemon=True).start()
+        self.save_state()
+        self._listener.close()
+        try:
+            os.unlink(self.socket_path)
+        except FileNotFoundError:
+            pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description="fastdfs_tpu dedup sidecar")
+    ap.add_argument("--socket", required=True, help="unix socket path")
+    ap.add_argument("--state-dir", default=None,
+                    help="snapshot dir (checkpoint/resume)")
+    ap.add_argument("--snapshot-interval", type=float, default=60.0)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu for tests; this "
+                         "image pins JAX_PLATFORMS=axon via sitecustomize, "
+                         "so only jax.config.update overrides reliably)")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    sidecar = DedupSidecar(args.socket, state_dir=args.state_dir)
+    signal.signal(signal.SIGTERM, lambda *_: sidecar.stop())
+    signal.signal(signal.SIGINT, lambda *_: sidecar.stop())
+    t0 = time.monotonic()
+    sidecar.engine.warmup()  # compile all shapes BEFORE accepting traffic
+    print(f"dedup sidecar warmed in {time.monotonic() - t0:.1f}s, "
+          f"listening on {args.socket}", flush=True)
+    sidecar.serve_forever(snapshot_interval=args.snapshot_interval)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,50 @@
+#include "common/cdc.h"
+
+#include "common/gear_gen.h"
+
+namespace fdfs {
+
+GearChunker::GearChunker(int64_t min_size, int avg_bits, int64_t max_size)
+    : min_size_(min_size),
+      mask_(static_cast<uint32_t>((1u << avg_bits) - 1)),
+      max_size_(max_size) {}
+
+void GearChunker::Feed(const uint8_t* data, size_t n,
+                       std::vector<int64_t>* cuts) {
+  // Exactly the serial reference: h = (h << 1) + gear[b]; cut when the
+  // chunk reaches min_size and (h & mask) == 0, or at max_size; h resets
+  // at each chunk start.
+  uint32_t h = h_;
+  int64_t pos = pos_, start = chunk_start_;
+  for (size_t i = 0; i < n; ++i) {
+    h = (h << 1) + kGearTable[data[i]];
+    int64_t size = pos - start + 1;
+    if ((size >= min_size_ && (h & mask_) == 0) || size >= max_size_) {
+      cuts->push_back(pos + 1);
+      start = pos + 1;
+      h = 0;
+    }
+    ++pos;
+  }
+  h_ = h;
+  pos_ = pos;
+  chunk_start_ = start;
+}
+
+void GearChunker::Finish(std::vector<int64_t>* cuts) {
+  if (chunk_start_ < pos_) cuts->push_back(pos_);
+  chunk_start_ = pos_;
+  h_ = 0;
+}
+
+std::vector<int64_t> GearChunkStream(const uint8_t* data, size_t n,
+                                     int64_t min_size, int avg_bits,
+                                     int64_t max_size) {
+  std::vector<int64_t> cuts;
+  GearChunker ck(min_size, avg_bits, max_size);
+  ck.Feed(data, n, &cuts);
+  ck.Finish(&cuts);
+  return cuts;
+}
+
+}  // namespace fdfs

@@ -1,0 +1,49 @@
+// Content-defined chunking: serial gear rolling hash.
+//
+// CPU-path twin of fastdfs_tpu/ops/gear_cdc.py (the TPU position-parallel
+// formulation).  Cut-points are IDENTICAL to the Python serial reference
+// (`chunk_stream_ref`) and — for min_size >= window — to the TPU path, so
+// every node in a cluster chunks every byte stream the same way.
+// Cross-language equality is enforced by tests/test_chunk_cdc.py via the
+// codec CLI.
+//
+// Reference anchor: this replaces the sequential buff_size loop of
+// storage/storage_dio.c:dio_write_file() with content-defined spans.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+namespace fdfs {
+
+// Exclusive chunk end offsets for data[0..n) (final offset is n; empty
+// input -> empty vector).  Semantics: hash resets at each chunk start; a
+// position cuts when chunk size >= min_size and the low avg_bits of the
+// gear hash are zero, or unconditionally at max_size.
+std::vector<int64_t> GearChunkStream(const uint8_t* data, size_t n,
+                                     int64_t min_size, int avg_bits,
+                                     int64_t max_size);
+
+// Streaming form: carries the rolling state across Feed() calls so a
+// multi-gigabyte upload never needs a contiguous buffer.  Offsets
+// returned are absolute within the stream.
+class GearChunker {
+ public:
+  GearChunker(int64_t min_size, int avg_bits, int64_t max_size);
+
+  // Consume a segment; appends any cut offsets found to *cuts.
+  void Feed(const uint8_t* data, size_t n, std::vector<int64_t>* cuts);
+  // End of stream: appends the final partial-chunk offset, if any.
+  void Finish(std::vector<int64_t>* cuts);
+
+ private:
+  int64_t min_size_;
+  uint32_t mask_;
+  int64_t max_size_;
+  uint32_t h_ = 0;
+  int64_t pos_ = 0;       // absolute stream position
+  int64_t chunk_start_ = 0;
+};
+
+}  // namespace fdfs

@@ -1,0 +1,301 @@
+#include "storage/chunkstore.h"
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+
+#include "common/bytes.h"
+#include "common/log.h"
+
+namespace fdfs {
+
+namespace {
+
+constexpr char kRecipeMagic[8] = {'F', 'D', 'F', 'S', 'R', 'C', 'P', '1'};
+
+bool IsHex40(const std::string& s) {
+  if (s.size() != 40) return false;
+  for (char c : s)
+    if (!((c >= '0' && c <= '9') || (c >= 'a' && c <= 'f'))) return false;
+  return true;
+}
+
+}  // namespace
+
+// -- recipe codec ---------------------------------------------------------
+// Layout: 8B magic, 8B logical_size BE, 8B chunk_count BE, then per chunk
+// 20B raw digest + 8B length BE.  Offsets are implicit (cumulative).
+
+bool WriteRecipeFile(const std::string& path, const Recipe& r,
+                     std::string* err) {
+  std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (f == nullptr) {
+    *err = "open " + tmp + ": " + strerror(errno);
+    return false;
+  }
+  std::string buf(kRecipeMagic, sizeof(kRecipeMagic));
+  uint8_t num[8];
+  PutInt64BE(r.logical_size, num);
+  buf.append(reinterpret_cast<char*>(num), 8);
+  PutInt64BE(static_cast<int64_t>(r.chunks.size()), num);
+  buf.append(reinterpret_cast<char*>(num), 8);
+  for (const RecipeEntry& e : r.chunks) {
+    for (size_t i = 0; i < 40; i += 2) {
+      buf.push_back(static_cast<char>(
+          strtoul(e.digest_hex.substr(i, 2).c_str(), nullptr, 16)));
+    }
+    PutInt64BE(e.length, num);
+    buf.append(reinterpret_cast<char*>(num), 8);
+  }
+  bool ok = fwrite(buf.data(), 1, buf.size(), f) == buf.size() &&
+            fflush(f) == 0 && fsync(fileno(f)) == 0;
+  fclose(f);
+  if (!ok || rename(tmp.c_str(), path.c_str()) != 0) {
+    *err = "write " + path + ": " + strerror(errno);
+    unlink(tmp.c_str());
+    return false;
+  }
+  return true;
+}
+
+std::optional<Recipe> ReadRecipeFile(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (f == nullptr) return std::nullopt;
+  char hdr[24];
+  if (fread(hdr, 1, sizeof(hdr), f) != sizeof(hdr) ||
+      memcmp(hdr, kRecipeMagic, sizeof(kRecipeMagic)) != 0) {
+    fclose(f);
+    return std::nullopt;
+  }
+  Recipe r;
+  r.logical_size = GetInt64BE(reinterpret_cast<uint8_t*>(hdr) + 8);
+  int64_t count = GetInt64BE(reinterpret_cast<uint8_t*>(hdr) + 16);
+  if (count < 0 || count > (1 << 26)) {  // 64M chunks ~= 0.5 PB file
+    fclose(f);
+    return std::nullopt;
+  }
+  static const char* kHex = "0123456789abcdef";
+  r.chunks.reserve(static_cast<size_t>(count));
+  for (int64_t i = 0; i < count; ++i) {
+    uint8_t rec[28];
+    if (fread(rec, 1, sizeof(rec), f) != sizeof(rec)) {
+      fclose(f);
+      return std::nullopt;
+    }
+    RecipeEntry e;
+    e.digest_hex.resize(40);
+    for (int b = 0; b < 20; ++b) {
+      e.digest_hex[2 * b] = kHex[rec[b] >> 4];
+      e.digest_hex[2 * b + 1] = kHex[rec[b] & 0xF];
+    }
+    e.length = GetInt64BE(rec + 20);
+    if (e.length < 0) {
+      fclose(f);
+      return std::nullopt;
+    }
+    r.chunks.push_back(std::move(e));
+  }
+  fclose(f);
+  return r;
+}
+
+// -- store ----------------------------------------------------------------
+
+ChunkStore::ChunkStore(std::string store_path)
+    : store_path_(std::move(store_path)) {}
+
+std::string ChunkStore::ChunkPath(const std::string& digest_hex) const {
+  return store_path_ + "/data/chunks/" + digest_hex.substr(0, 2) + "/" +
+         digest_hex.substr(2, 2) + "/" + digest_hex;
+}
+
+bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
+                           size_t len, bool* existed, std::string* err) {
+  std::string path = ChunkPath(digest_hex);
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = refs_.find(digest_hex);
+  if (it != refs_.end()) {
+    it->second++;
+    *existed = true;
+    return true;
+  }
+  // First reference: write the payload (write-if-absent; a leftover file
+  // from a crashed write is simply overwritten — content-addressed, so
+  // same digest => same bytes).
+  std::string dir1 = store_path_ + "/data/chunks";
+  std::string dir2 = dir1 + "/" + digest_hex.substr(0, 2);
+  std::string dir3 = dir2 + "/" + digest_hex.substr(2, 2);
+  mkdir(dir1.c_str(), 0755);
+  mkdir(dir2.c_str(), 0755);
+  mkdir(dir3.c_str(), 0755);
+  std::string tmp = path + ".tmp";
+  int fd = open(tmp.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644);
+  if (fd < 0) {
+    *err = "open " + tmp + ": " + strerror(errno);
+    return false;
+  }
+  size_t off = 0;
+  while (off < len) {
+    ssize_t w = write(fd, data + off, len - off);
+    if (w <= 0) {
+      *err = "write " + tmp + ": " + strerror(errno);
+      close(fd);
+      unlink(tmp.c_str());
+      return false;
+    }
+    off += static_cast<size_t>(w);
+  }
+  close(fd);
+  if (rename(tmp.c_str(), path.c_str()) != 0) {
+    *err = "rename " + path + ": " + strerror(errno);
+    unlink(tmp.c_str());
+    return false;
+  }
+  refs_[digest_hex] = 1;
+  unique_bytes_ += static_cast<int64_t>(len);
+  *existed = false;
+  return true;
+}
+
+bool ChunkStore::RefAll(const Recipe& r) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (const RecipeEntry& e : r.chunks)
+    if (refs_.find(e.digest_hex) == refs_.end()) return false;
+  for (const RecipeEntry& e : r.chunks) refs_[e.digest_hex]++;
+  return true;
+}
+
+void ChunkStore::UnrefAll(const Recipe& r) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (const RecipeEntry& e : r.chunks) {
+    auto it = refs_.find(e.digest_hex);
+    if (it == refs_.end()) continue;
+    if (--it->second <= 0) {
+      unlink(ChunkPath(e.digest_hex).c_str());
+      unique_bytes_ -= e.length;
+      refs_.erase(it);
+    }
+  }
+}
+
+bool ChunkStore::ReadChunk(const std::string& digest_hex, int64_t expect_len,
+                           std::string* out) const {
+  int fd = open(ChunkPath(digest_hex).c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  out->resize(static_cast<size_t>(expect_len));
+  size_t off = 0;
+  while (off < out->size()) {
+    ssize_t r = read(fd, out->data() + off, out->size() - off);
+    if (r <= 0) {
+      close(fd);
+      return false;
+    }
+    off += static_cast<size_t>(r);
+  }
+  close(fd);
+  return true;
+}
+
+int64_t ChunkStore::unique_chunks() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return static_cast<int64_t>(refs_.size());
+}
+
+int64_t ChunkStore::unique_bytes() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return unique_bytes_;
+}
+
+namespace {
+
+void WalkRecipes(const std::string& dir,
+                 std::unordered_map<std::string, int64_t>* refs,
+                 std::unordered_map<std::string, int64_t>* lens) {
+  DIR* d = opendir(dir.c_str());
+  if (d == nullptr) return;
+  struct dirent* de;
+  while ((de = readdir(d)) != nullptr) {
+    std::string name = de->d_name;
+    if (name == "." || name == "..") continue;
+    std::string path = dir + "/" + name;
+    struct stat st;
+    if (stat(path.c_str(), &st) != 0) continue;
+    if (S_ISDIR(st.st_mode)) {
+      if (name != "chunks" && name != "sync" && name != "tmp")
+        WalkRecipes(path, refs, lens);
+    } else if (name.size() > 4 &&
+               name.compare(name.size() - 4, 4, ".rcp") == 0) {
+      auto r = ReadRecipeFile(path);
+      if (!r.has_value()) {
+        FDFS_LOG_WARN("unreadable recipe %s ignored", path.c_str());
+        continue;
+      }
+      for (const RecipeEntry& e : r->chunks) {
+        (*refs)[e.digest_hex]++;
+        (*lens)[e.digest_hex] = e.length;
+      }
+    }
+  }
+  closedir(d);
+}
+
+}  // namespace
+
+void ChunkStore::RebuildFromRecipes() {
+  std::unordered_map<std::string, int64_t> refs, lens;
+  WalkRecipes(store_path_ + "/data", &refs, &lens);
+
+  // GC pass: any chunk file not named by a recipe is an orphan from a
+  // crash between chunk write and recipe write (or after a delete that
+  // crashed mid-unref) — safe to drop.
+  int64_t orphans = 0, bytes = 0;
+  std::string croot = store_path_ + "/data/chunks";
+  DIR* d1 = opendir(croot.c_str());
+  if (d1 != nullptr) {
+    struct dirent* e1;
+    while ((e1 = readdir(d1)) != nullptr) {
+      if (e1->d_name[0] == '.') continue;
+      std::string l1 = croot + "/" + e1->d_name;
+      DIR* d2 = opendir(l1.c_str());
+      if (d2 == nullptr) continue;
+      struct dirent* e2;
+      while ((e2 = readdir(d2)) != nullptr) {
+        if (e2->d_name[0] == '.') continue;
+        std::string l2 = l1 + "/" + e2->d_name;
+        DIR* d3 = opendir(l2.c_str());
+        if (d3 == nullptr) continue;
+        struct dirent* e3;
+        while ((e3 = readdir(d3)) != nullptr) {
+          std::string name = e3->d_name;
+          if (name[0] == '.') continue;
+          if (!IsHex40(name) || refs.find(name) == refs.end()) {
+            unlink((l2 + "/" + name).c_str());
+            ++orphans;
+          }
+        }
+        closedir(d3);
+      }
+      closedir(d2);
+    }
+    closedir(d1);
+  }
+
+  std::lock_guard<std::mutex> lk(mu_);
+  refs_ = std::move(refs);
+  unique_bytes_ = 0;
+  for (const auto& [dig, n] : refs_) unique_bytes_ += lens[dig];
+  bytes = unique_bytes_;
+  if (!refs_.empty() || orphans > 0)
+    FDFS_LOG_INFO("chunk store: %zu unique chunks (%lld bytes), %lld "
+                  "orphans collected",
+                  refs_.size(), static_cast<long long>(bytes),
+                  static_cast<long long>(orphans));
+}
+
+}  // namespace fdfs

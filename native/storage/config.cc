@@ -52,6 +52,10 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
     return false;
   }
   dedup_sidecar = ini.GetStr("dedup_sidecar", "");
+  dedup_chunk_threshold = ini.GetBytes("dedup_chunk_threshold", 64 * 1024);
+  dedup_segment_bytes =
+      ini.GetBytes("dedup_segment_bytes", 64LL * 1024 * 1024);
+  if (dedup_segment_bytes < (1 << 20)) dedup_segment_bytes = 1 << 20;
   log_level = ini.GetStr("log_level", "info");
   use_access_log = ini.GetBool("use_access_log", false);
   return true;

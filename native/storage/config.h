@@ -29,6 +29,13 @@ struct StorageConfig {
   int sync_interval_ms = 100;      // binlog tail poll when idle
   std::string dedup_mode = "none"; // none | cpu | sidecar
   std::string dedup_sidecar;       // unix socket path when mode=sidecar
+  // Chunk-level dedup threshold: uploads >= this many bytes are CDC-
+  // chunked into the content-addressed chunk store (recipe file on disk);
+  // smaller files use whole-file dedup.  0 disables chunking.
+  int64_t dedup_chunk_threshold = 64 * 1024;
+  // Segment size for streaming fingerprint RPCs (CDC restarts per
+  // segment so a multi-GB upload never needs a contiguous buffer).
+  int64_t dedup_segment_bytes = 64LL * 1024 * 1024;
   std::string log_level = "info";
   // Per-request access log (storage.conf:use_access_log): op, client ip,
   // status, bytes, cost in µs — logs/access.log.

@@ -8,6 +8,8 @@
 #include <cstdio>
 
 #include "common/bytes.h"
+#include "common/cdc.h"
+#include "common/gear_gen.h"
 #include "common/log.h"
 #include "common/net.h"
 #include "common/protocol_gen.h"
@@ -54,6 +56,24 @@ bool CpuDedup::Save() {
   return rename(tmp.c_str(), snapshot_path_.c_str()) == 0;
 }
 
+bool CpuDedup::FingerprintChunks(const char* data, size_t len,
+                                 int64_t base_offset,
+                                 std::vector<ChunkFp>* out) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(data);
+  std::vector<int64_t> cuts = GearChunkStream(
+      p, len, kCdcDefaultMinSize, kCdcDefaultAvgBits, kCdcDefaultMaxSize);
+  int64_t last = 0;
+  for (int64_t cut : cuts) {
+    ChunkFp fp;
+    fp.offset = base_offset + last;
+    fp.length = cut - last;
+    fp.digest_hex = Sha1(data + last, static_cast<size_t>(cut - last)).Hex();
+    out->push_back(std::move(fp));
+    last = cut;
+  }
+  return true;
+}
+
 bool CpuDedup::LoadSnapshot() {
   FILE* f = fopen(snapshot_path_.c_str(), "r");
   if (f == nullptr) return true;  // no snapshot yet
@@ -94,22 +114,25 @@ bool SidecarDedup::EnsureConnected() {
 }
 
 bool SidecarDedup::Rpc(uint8_t cmd, const std::string& body, std::string* resp,
-                       uint8_t* status) {
+                       uint8_t* status, int64_t max_resp) {
   if (!EnsureConnected()) return false;
+  // Generous timeout for fingerprint segments (first TPU compile of a new
+  // bucket shape can take tens of seconds); everything else is instant.
+  const int timeout_ms = 60000;
   uint8_t hdr[kHeaderSize];
   PutInt64BE(static_cast<int64_t>(body.size()), hdr);
   hdr[8] = cmd;
   hdr[9] = 0;
-  if (!SendAll(fd_, hdr, sizeof(hdr), 5000) ||
-      !SendAll(fd_, body.data(), body.size(), 5000) ||
-      !RecvAll(fd_, hdr, sizeof(hdr), 5000)) {
+  if (!SendAll(fd_, hdr, sizeof(hdr), timeout_ms) ||
+      !SendAll(fd_, body.data(), body.size(), timeout_ms) ||
+      !RecvAll(fd_, hdr, sizeof(hdr), timeout_ms)) {
     close(fd_);
     fd_ = -1;
     return false;
   }
   int64_t len = GetInt64BE(hdr);
   *status = hdr[9];
-  if (len < 0 || len > (1 << 20)) {  // sidecar replies are tiny; fail open
+  if (len < 0 || len > max_resp) {
     FDFS_LOG_WARN("dedup(sidecar): bogus response length %lld",
                   static_cast<long long>(len));
     close(fd_);
@@ -117,7 +140,7 @@ bool SidecarDedup::Rpc(uint8_t cmd, const std::string& body, std::string* resp,
     return false;
   }
   resp->resize(static_cast<size_t>(len));
-  if (len > 0 && !RecvAll(fd_, resp->data(), resp->size(), 5000)) {
+  if (len > 0 && !RecvAll(fd_, resp->data(), resp->size(), timeout_ms)) {
     close(fd_);
     fd_ = -1;
     return false;
@@ -145,14 +168,71 @@ void SidecarDedup::Commit(const std::string& sha1_hex,
                           const std::string& file_id) {
   std::string resp;
   uint8_t status = 0;
-  Rpc(static_cast<uint8_t>(StorageCmd::kDedupCommit), sha1_hex + " " + file_id,
-      &resp, &status);
+  Rpc(static_cast<uint8_t>(StorageCmd::kDedupCommit),
+      "commitfile " + sha1_hex + " " + file_id, &resp, &status);
 }
 
 void SidecarDedup::Forget(const std::string& file_id) {
   std::string resp;
   uint8_t status = 0;
-  Rpc(static_cast<uint8_t>(StorageCmd::kDedupFingerprint),
+  Rpc(static_cast<uint8_t>(StorageCmd::kDedupCommit),
+      std::string("forget ") + file_id, &resp, &status);
+}
+
+// Fingerprint RPC (cmd 120): request body is the raw segment prefixed by
+// an 8B BE base_offset; response is 8B BE chunk_count then per chunk
+// 8B offset + 8B length + 20B raw digest.
+bool SidecarDedup::FingerprintChunks(const char* data, size_t len,
+                                     int64_t base_offset,
+                                     std::vector<ChunkFp>* out) {
+  std::string body;
+  body.reserve(8 + len);
+  uint8_t num[8];
+  PutInt64BE(base_offset, num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  body.append(data, len);
+  std::string resp;
+  uint8_t status = 0;
+  if (!Rpc(static_cast<uint8_t>(StorageCmd::kDedupFingerprint), body, &resp,
+           &status, /*max_resp=*/256 << 20) ||
+      status != 0 || resp.size() < 8) {
+    FDFS_LOG_WARN("dedup(sidecar): fingerprint unavailable, storing flat");
+    return false;
+  }
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(resp.data());
+  int64_t count = GetInt64BE(p);
+  if (count < 0 || resp.size() != 8 + static_cast<size_t>(count) * 36)
+    return false;
+  static const char* kHex = "0123456789abcdef";
+  int64_t covered = 0;
+  for (int64_t i = 0; i < count; ++i) {
+    const uint8_t* rec = p + 8 + i * 36;
+    ChunkFp fp;
+    fp.offset = GetInt64BE(rec);
+    fp.length = GetInt64BE(rec + 8);
+    if (fp.length <= 0 || fp.offset != base_offset + covered) return false;
+    fp.digest_hex.resize(40);
+    for (int b = 0; b < 20; ++b) {
+      fp.digest_hex[2 * b] = kHex[rec[16 + b] >> 4];
+      fp.digest_hex[2 * b + 1] = kHex[rec[16 + b] & 0xF];
+    }
+    covered += fp.length;
+    out->push_back(std::move(fp));
+  }
+  return covered == static_cast<int64_t>(len);
+}
+
+void SidecarDedup::CommitChunked(const std::string& file_id) {
+  std::string resp;
+  uint8_t status = 0;
+  Rpc(static_cast<uint8_t>(StorageCmd::kDedupCommit),
+      std::string("commitchunks ") + file_id, &resp, &status);
+}
+
+void SidecarDedup::ForgetChunked(const std::string& file_id) {
+  std::string resp;
+  uint8_t status = 0;
+  Rpc(static_cast<uint8_t>(StorageCmd::kDedupCommit),
       std::string("forget ") + file_id, &resp, &status);
 }
 

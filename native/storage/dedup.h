@@ -2,21 +2,37 @@
 //
 // This is the rebuild's analogue of the reference's storage-plugin hook in
 // storage/storage_func.h (north star: "gated behind the existing
-// storage-plugin hook so the classic C path remains the default").  The
-// daemon streams every uploaded byte through an incremental SHA1 when a
-// plugin is active; the plugin judges duplicates and the daemon commits
-// unique bytes (dup files become hardlinks + an 'L' binlog record).
+// storage-plugin hook so the classic C path remains the default").  Two
+// granularities:
 //
-// Modes: none (classic CRC32-only path), cpu (in-process digest map),
-// sidecar (TPU dedup engine over a unix socket — the JAX/Pallas path).
+//  * Whole-file (Judge/Commit/Forget): files below the chunking threshold
+//    are judged by their stream SHA1; duplicates become hardlinks + an 'L'
+//    binlog record.
+//  * Chunk-level (FingerprintChunks): larger streams are content-defined
+//    chunked and per-chunk fingerprinted; the daemon then writes only
+//    chunks its ChunkStore has never seen and a small recipe file.  The
+//    fingerprinting is the accelerated part — the sidecar runs CDC +
+//    batched SHA1 + MinHash on the TPU (fastdfs_tpu/sidecar.py); the cpu
+//    plugin is the serial C++ referee with identical cut-points.
+//
+// Modes: none (classic CRC32-only path), cpu (in-process), sidecar (TPU
+// engine over a unix socket).  The sidecar path FAILS OPEN: uploads never
+// block on the accelerator — unreachable sidecar means store-flat.
 #pragma once
 
 #include <cstdint>
 #include <memory>
 #include <string>
 #include <unordered_map>
+#include <vector>
 
 namespace fdfs {
+
+struct ChunkFp {
+  int64_t offset = 0;
+  int64_t length = 0;
+  std::string digest_hex;  // 40-char lowercase SHA1 of the chunk bytes
+};
 
 class DedupPlugin {
  public:
@@ -27,15 +43,34 @@ class DedupPlugin {
     std::string dup_of;  // existing file id (full "group/M.." form)
   };
 
+  // -- whole-file granularity --------------------------------------------
   virtual Verdict Judge(const std::string& sha1_hex, int64_t file_size) = 0;
   virtual void Commit(const std::string& sha1_hex, const std::string& file_id) = 0;
   virtual void Forget(const std::string& file_id) = 0;  // on delete
   virtual bool Save() { return true; }   // snapshot (checkpoint/resume)
   virtual const char* Name() const = 0;
+
+  // -- chunk granularity -------------------------------------------------
+  // CDC + per-chunk SHA1 over one SEGMENT of an upload stream.  Segments
+  // are independently chunked (CDC restarts at segment boundaries) so a
+  // multi-GB file never needs a contiguous buffer; `base_offset` shifts
+  // the reported chunk offsets to absolute stream positions.  Returns
+  // false when chunk fingerprinting is unavailable (caller stores flat).
+  virtual bool FingerprintChunks(const char* data, size_t len,
+                                 int64_t base_offset,
+                                 std::vector<ChunkFp>* out) {
+    (void)data; (void)len; (void)base_offset; (void)out;
+    return false;
+  }
+  // Chunked-file lifecycle notifications (near-dup index bookkeeping in
+  // the sidecar; no-ops for the cpu plugin — its ChunkStore IS the index).
+  virtual void CommitChunked(const std::string& file_id) { (void)file_id; }
+  virtual void ForgetChunked(const std::string& file_id) { (void)file_id; }
 };
 
 // CPU baseline: exact SHA1 digest map, snapshotted to
-// <base_path>/data/dedup_index.dat (atomic write-then-rename).
+// <base_path>/data/dedup_index.dat (atomic write-then-rename); chunk
+// fingerprints via the serial gear CDC (common/cdc.h).
 class CpuDedup : public DedupPlugin {
  public:
   explicit CpuDedup(std::string snapshot_path);
@@ -44,6 +79,8 @@ class CpuDedup : public DedupPlugin {
   void Forget(const std::string& file_id) override;
   bool Save() override;
   const char* Name() const override { return "cpu"; }
+  bool FingerprintChunks(const char* data, size_t len, int64_t base_offset,
+                         std::vector<ChunkFp>* out) override;
   bool LoadSnapshot();
   size_t size() const { return by_digest_.size(); }
 
@@ -55,9 +92,8 @@ class CpuDedup : public DedupPlugin {
 
 // Sidecar: TPU dedup engine process over a unix-domain socket, speaking
 // the DEDUP_* opcodes on the standard framing (see
-// fastdfs_tpu/dedup/sidecar.py).  Falls open (treats everything as unique)
-// when the sidecar is unreachable, so uploads never block on the
-// accelerator path.
+// fastdfs_tpu/sidecar.py).  Falls open (treats everything as unique /
+// unchunkable) when the sidecar is unreachable.
 class SidecarDedup : public DedupPlugin {
  public:
   explicit SidecarDedup(std::string socket_path);
@@ -66,11 +102,15 @@ class SidecarDedup : public DedupPlugin {
   void Commit(const std::string& sha1_hex, const std::string& file_id) override;
   void Forget(const std::string& file_id) override;
   const char* Name() const override { return "sidecar"; }
+  bool FingerprintChunks(const char* data, size_t len, int64_t base_offset,
+                         std::vector<ChunkFp>* out) override;
+  void CommitChunked(const std::string& file_id) override;
+  void ForgetChunked(const std::string& file_id) override;
 
  private:
   bool EnsureConnected();
   bool Rpc(uint8_t cmd, const std::string& body, std::string* resp,
-           uint8_t* status);
+           uint8_t* status, int64_t max_resp = 1 << 20);
   std::string socket_path_;
   int fd_ = -1;
 };

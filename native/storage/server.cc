@@ -81,6 +81,15 @@ bool StorageServer::Init(std::string* error) {
   if (!binlog_.Init(cfg_.base_path + "/data/sync", kBinlogRotateSize, error))
     return false;
   dedup_ = MakeDedupPlugin(cfg_.dedup_mode, cfg_.base_path, cfg_.dedup_sidecar);
+  if (dedup_ != nullptr && cfg_.dedup_chunk_threshold > 0) {
+    // Chunk-level dedup: one content-addressed store per store path;
+    // refcounts rebuilt from recipes (doubles as orphan GC).
+    for (int i = 0; i < store_.store_path_count(); ++i) {
+      chunk_stores_.push_back(
+          std::make_unique<ChunkStore>(store_.store_path(i)));
+      chunk_stores_.back()->RebuildFromRecipes();
+    }
+  }
 
   listen_fd_ = TcpListen(cfg_.bind_addr, cfg_.port, error);
   if (listen_fd_ < 0) return false;
@@ -120,13 +129,16 @@ bool StorageServer::Init(std::string* error) {
         return out;
       }
       std::string local = ResolveLocal(cfg_.group_name, remote);
-      int fd = local.empty() ? -1 : open(local.c_str(), O_RDONLY);
+      if (local.empty()) return std::nullopt;
+      // Logical open: plain file, or chunk recipe materialized into an
+      // unlinked temp fd — replication always ships logical bytes (the
+      // peer re-chunks under its own dedup config).
+      int64_t size = 0;
+      int fd = OpenLogical(local, &size);
       if (fd < 0) return std::nullopt;
-      struct stat st;
-      fstat(fd, &st);
       ContentHandle out;
       out.fd = fd;
-      out.size = st.st_size;
+      out.size = size;
       return out;
     };
     sync_ = std::make_unique<SyncManager>(cfg_, std::move(scbs));
@@ -819,6 +831,23 @@ void StorageServer::OnFileComplete(Conn* c) {
       return;
     }
     StoreManager::EnsureParentDirs(local);
+    // Replicas dedup too: chunk-eligible synced files go through the
+    // chunk store (same cut-points cluster-wide), others stay flat.
+    struct stat st;
+    if (stat(c->tmp_path.c_str(), &st) == 0 && ChunkEligible(st.st_size)) {
+      int spi = 0;
+      sscanf(c->sync_remote.c_str(), "M%02X/", &spi);
+      int64_t saved = 0, hits = 0;
+      if (StoreChunkedFromTmp(c->tmp_path, spi, st.st_size, local + ".rcp",
+                              &saved, &hits)) {
+        unlink(c->tmp_path.c_str());
+        stats_.dedup_hits += hits;
+        stats_.dedup_bytes_saved += saved;
+        binlog_.Append('c', c->sync_remote);
+        Respond(c, 0);
+        return;
+      }
+    }
     if (rename(c->tmp_path.c_str(), local.c_str()) != 0) {
       unlink(c->tmp_path.c_str());
       Respond(c, 5);
@@ -1130,7 +1159,8 @@ bool StorageServer::RemoteExists(const std::string& group,
            h->file_size == parts->file_size && h->crc32 == parts->crc32;
   }
   struct stat st;
-  return stat(local.c_str(), &st) == 0;
+  return stat(local.c_str(), &st) == 0 ||
+         stat((local + ".rcp").c_str(), &st) == 0;  // chunk recipe
 }
 
 // FETCH_ONE_PATH_BINLOG (26): binlog records whose file lives on the
@@ -1204,6 +1234,39 @@ void StorageServer::FinishUpload(Conn* c) {
 
   std::string digest;
   if (c->hashing) digest = c->sha1.Final().Hex();
+
+  // Chunk-level dedup (north star): large uploads are CDC-chunked, the
+  // chunks fingerprinted (on the TPU in sidecar mode), and only bytes the
+  // chunk store has never seen are written — the file itself becomes a
+  // small recipe.  Appenders stay flat (mutable).  Failure of any kind
+  // falls through to the classic flat store.
+  if (!appender && ChunkEligible(c->file_size)) {
+    std::string id = MintFileId(c->store_path_index, c->file_size, c->crc32,
+                                c->ext, false);
+    std::optional<FileIdParts> parts;
+    if (!id.empty()) parts = DecodeFileId(id);
+    if (parts.has_value()) {
+      std::string local = LocalPath(store_.store_path(c->store_path_index),
+                                    parts->RemoteFilename())
+                              .value();
+      StoreManager::EnsureParentDirs(local);
+      int64_t saved = 0, hits = 0;
+      if (StoreChunkedFromTmp(c->tmp_path, c->store_path_index, c->file_size,
+                              local + ".rcp", &saved, &hits)) {
+        unlink(c->tmp_path.c_str());
+        c->tmp_path.clear();
+        stats_.dedup_hits += hits;
+        stats_.dedup_bytes_saved += saved;
+        dedup_->CommitChunked(cfg_.group_name + "/" + parts->RemoteFilename());
+        binlog_.Append(kBinlogOpCreate, parts->RemoteFilename());
+        stats_.success_upload++;
+        stats_.last_source_update = time(nullptr);
+        Respond(c, 0,
+                PackGroupField(cfg_.group_name) + parts->RemoteFilename());
+        return;
+      }
+    }
+  }
 
   // Dedup verdict (plugin boundary; appender files are mutable => exempt).
   if (dedup_ != nullptr && !appender) {
@@ -1294,6 +1357,155 @@ std::string StorageServer::ResolveLocal(const std::string& group,
   return lp.has_value() ? *lp : "";
 }
 
+// -- chunk-level dedup (north star) ---------------------------------------
+
+bool StorageServer::ChunkEligible(int64_t size) const {
+  return dedup_ != nullptr && cfg_.dedup_chunk_threshold > 0 &&
+         size >= cfg_.dedup_chunk_threshold && !chunk_stores_.empty();
+}
+
+ChunkStore* StorageServer::StoreForLocal(const std::string& local) {
+  for (int i = 0; i < store_.store_path_count() &&
+                  i < static_cast<int>(chunk_stores_.size()); ++i) {
+    const std::string& sp = store_.store_path(i);
+    if (local.compare(0, sp.size(), sp) == 0) return chunk_stores_[i].get();
+  }
+  return nullptr;
+}
+
+bool StorageServer::StoreChunkedFromTmp(const std::string& tmp_path, int spi,
+                                        int64_t size,
+                                        const std::string& rcp_path,
+                                        int64_t* saved_bytes,
+                                        int64_t* chunk_hits) {
+  if (spi >= static_cast<int>(chunk_stores_.size())) return false;
+  ChunkStore* cs = chunk_stores_[spi].get();
+  int fd = open(tmp_path.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+
+  Recipe recipe;
+  recipe.logical_size = size;
+  std::string seg;
+  int64_t seg_base = 0;
+  bool ok = true;
+  while (ok && seg_base < size) {
+    int64_t want = std::min<int64_t>(cfg_.dedup_segment_bytes,
+                                     size - seg_base);
+    seg.resize(static_cast<size_t>(want));
+    int64_t got = 0;
+    while (got < want) {
+      ssize_t r = read(fd, seg.data() + got, want - got);
+      if (r <= 0) break;
+      got += r;
+    }
+    if (got != want) {
+      ok = false;
+      break;
+    }
+    // Fingerprint this segment (accelerated in sidecar mode: CDC +
+    // batched SHA1 run on the TPU); then write only unseen chunks.
+    std::vector<ChunkFp> fps;
+    if (!dedup_->FingerprintChunks(seg.data(), seg.size(), seg_base, &fps)) {
+      ok = false;  // fingerprinting unavailable: caller stores flat
+      break;
+    }
+    for (const ChunkFp& fp : fps) {
+      bool existed = false;
+      std::string err;
+      if (!cs->PutAndRef(fp.digest_hex,
+                         seg.data() + (fp.offset - seg_base), fp.length,
+                         &existed, &err)) {
+        FDFS_LOG_ERROR("chunk store: %s", err.c_str());
+        ok = false;
+        break;
+      }
+      if (existed) {
+        *saved_bytes += fp.length;
+        ++*chunk_hits;
+      }
+      recipe.chunks.push_back({fp.digest_hex, fp.length});
+    }
+    seg_base += want;
+  }
+  close(fd);
+  std::string err;
+  if (!ok || !WriteRecipeFile(rcp_path, recipe, &err)) {
+    if (!ok) {
+      // Roll back references taken so far; untouched chunks stay for
+      // other recipes, newly-written orphans fall to the startup GC.
+      cs->UnrefAll(recipe);
+    } else {
+      FDFS_LOG_ERROR("recipe write: %s", err.c_str());
+      cs->UnrefAll(recipe);
+    }
+    return false;
+  }
+  return true;
+}
+
+int64_t StorageServer::LogicalSize(const std::string& local) const {
+  struct stat st;
+  if (stat(local.c_str(), &st) == 0) return st.st_size;
+  auto r = ReadRecipeFile(local + ".rcp");
+  return r.has_value() ? r->logical_size : -1;
+}
+
+int StorageServer::OpenLogical(const std::string& local, int64_t* size) {
+  int fd = open(local.c_str(), O_RDONLY);
+  if (fd >= 0) {
+    struct stat st;
+    fstat(fd, &st);
+    *size = st.st_size;
+    return fd;
+  }
+  auto r = ReadRecipeFile(local + ".rcp");
+  if (!r.has_value()) return -1;
+  ChunkStore* cs = StoreForLocal(local);
+  if (cs == nullptr) return -1;
+  // Materialize into an unlinked temp file: downstream sendfile paths
+  // (downloads, sync replication) keep working unchanged, and the bytes
+  // are reclaimed automatically on close.
+  std::string tmp = local + ".assm." + std::to_string(getpid());
+  fd = open(tmp.c_str(), O_CREAT | O_RDWR | O_TRUNC, 0600);
+  if (fd < 0) return -1;
+  unlink(tmp.c_str());
+  std::string chunk;
+  for (const RecipeEntry& e : r->chunks) {
+    if (!cs->ReadChunk(e.digest_hex, e.length, &chunk)) {
+      FDFS_LOG_ERROR("missing chunk %s for %s", e.digest_hex.c_str(),
+                     local.c_str());
+      close(fd);
+      return -1;
+    }
+    size_t off = 0;
+    while (off < chunk.size()) {
+      ssize_t w = write(fd, chunk.data() + off, chunk.size() - off);
+      if (w <= 0) {
+        close(fd);
+        return -1;
+      }
+      off += static_cast<size_t>(w);
+    }
+  }
+  *size = r->logical_size;
+  lseek(fd, 0, SEEK_SET);
+  return fd;
+}
+
+int StorageServer::RemoveLogical(const std::string& local,
+                                 const std::string& file_ref) {
+  if (unlink(local.c_str()) == 0) return 0;
+  if (errno != ENOENT) return 5;
+  std::string rcp = local + ".rcp";
+  auto r = ReadRecipeFile(rcp);
+  if (!r.has_value()) return 2;
+  if (unlink(rcp.c_str()) != 0) return errno == ENOENT ? 2 : 5;
+  ChunkStore* cs = StoreForLocal(local);
+  if (cs != nullptr) cs->UnrefAll(*r);
+  if (dedup_ != nullptr) dedup_->ForgetChunked(file_ref);
+  return 0;
+}
+
 void StorageServer::HandleDownload(Conn* c) {
   stats_.total_download++;
   // body: 8B offset + 8B count + 16B group + remote_filename
@@ -1325,19 +1537,20 @@ void StorageServer::HandleDownload(Conn* c) {
     Respond(c, 22);
     return;
   }
-  int fd = open(local.c_str(), O_RDONLY);
+  // Logical open: plain inode, or a chunk recipe reassembled into an
+  // unlinked temp fd (chunk-level dedup).
+  int64_t size = 0;
+  int fd = OpenLogical(local, &size);
   if (fd < 0) {
-    Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
+    Respond(c, 2);
     return;
   }
-  struct stat st;
-  fstat(fd, &st);
-  if (offset > st.st_size) {
+  if (offset > size) {
     close(fd);
     Respond(c, 22);
     return;
   }
-  int64_t avail = st.st_size - offset;
+  int64_t avail = size - offset;
   if (count == 0 || count > avail) count = avail;
   stats_.success_download++;
   RespondFile(c, 0, fd, offset, count);
@@ -1402,8 +1615,9 @@ void StorageServer::HandleDelete(Conn* c) {
     Respond(c, 22);
     return;
   }
-  if (unlink(local.c_str()) != 0) {
-    Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
+  int rc = RemoveLogical(local, group + "/" + remote);
+  if (rc != 0) {
+    Respond(c, static_cast<uint8_t>(rc));
     return;
   }
   unlink((local + "-m").c_str());  // metadata sidecar, if any
@@ -1456,10 +1670,12 @@ void StorageServer::HandleQueryFileInfo(Conn* c) {
       Respond(c, 22);
       return;
     }
-    if (stat(local.c_str(), &st) != 0) {
-      Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
+    int64_t lsize = LogicalSize(local);  // plain stat or recipe header
+    if (lsize < 0) {
+      Respond(c, 2);
       return;
     }
+    st.st_size = static_cast<off_t>(lsize);
   }
   std::string body(40, '\0');
   uint8_t* out = reinterpret_cast<uint8_t*>(body.data());
@@ -1893,8 +2109,26 @@ void StorageServer::HandleCreateLink(Conn* c) {
   }
   StoreManager::EnsureParentDirs(tl);
   if (link(sl.c_str(), tl.c_str()) != 0 && errno != EEXIST) {
-    Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
-    return;
+    // Chunked source: "linking" means duplicating the (tiny) recipe and
+    // taking a reference on each chunk.
+    bool linked = false;
+    if (errno == ENOENT) {
+      auto r = ReadRecipeFile(sl + ".rcp");
+      ChunkStore* cs = StoreForLocal(sl);
+      if (r.has_value() && cs != nullptr && cs->RefAll(*r)) {
+        std::string err;
+        if (WriteRecipeFile(tl + ".rcp", *r, &err)) {
+          linked = true;
+        } else {
+          cs->UnrefAll(*r);
+          FDFS_LOG_ERROR("link recipe copy: %s", err.c_str());
+        }
+      }
+    }
+    if (!linked) {
+      Respond(c, static_cast<uint8_t>(errno == ENOENT ? 2 : 5));
+      return;
+    }
   }
   binlog_.Append(source ? kBinlogOpLink : 'l', target, src);
   if (source) stats_.last_source_update = time(nullptr);

@@ -24,6 +24,7 @@
 #include "common/protocol_gen.h"
 #include "common/net.h"
 #include "storage/binlog.h"
+#include "storage/chunkstore.h"
 #include "storage/config.h"
 #include "storage/dedup.h"
 #include "storage/recovery.h"
@@ -197,10 +198,34 @@ class StorageServer {
                     const std::string& local);
   std::string MyIp() const;
 
+  // -- chunk-level dedup (north star; chunkstore.h) ----------------------
+  // Whether this upload takes the chunked path (plugin active, chunking
+  // enabled, size over threshold).
+  bool ChunkEligible(int64_t size) const;
+  ChunkStore* StoreForLocal(const std::string& local);
+  // Chunk the tmp file via the dedup plugin, write unique chunks into the
+  // store-path's chunk store, and write the recipe at `rcp_path`.
+  // *saved_bytes accumulates duplicate-chunk bytes.  False => caller
+  // stores the file flat (fingerprinting unavailable or IO error).
+  bool StoreChunkedFromTmp(const std::string& tmp_path, int spi,
+                           int64_t size, const std::string& rcp_path,
+                           int64_t* saved_bytes, int64_t* chunk_hits);
+  // Open the logical content at `local`: a plain fd, or a recipe
+  // materialized into an unlinked temp file.  -1 when missing.
+  int OpenLogical(const std::string& local, int64_t* size);
+  // Logical size without opening (plain stat or recipe header); -1 when
+  // missing.
+  int64_t LogicalSize(const std::string& local) const;
+  // Delete logical content: plain unlink, or recipe removal + chunk
+  // unref.  Returns errno-style status (0 ok, 2 missing, 5 io).
+  int RemoveLogical(const std::string& local, const std::string& file_ref);
+
   StorageConfig cfg_;
   StoreManager store_;
   BinlogWriter binlog_;
   std::unique_ptr<DedupPlugin> dedup_;
+  // One content-addressed chunk store per store path (chunk-level dedup).
+  std::vector<std::unique_ptr<ChunkStore>> chunk_stores_;
   std::unique_ptr<TrackerReporter> reporter_;
   std::unique_ptr<SyncManager> sync_;
   std::unique_ptr<RecoveryManager> recovery_;

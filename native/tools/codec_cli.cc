@@ -11,6 +11,9 @@
 //   fdfs_codec md5             (stdin -> hex)
 //   fdfs_codec token <uri> <secret> <ts>   (anti-leech token)
 //   fdfs_codec b64e <hex>      (hex bytes -> base64url)
+//   fdfs_codec cdc <min> <avg_bits> <max> [seg]  (stdin -> cut offsets,
+//                one per line; seg tests the streaming chunker by feeding
+//                seg-byte segments)
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -18,6 +21,7 @@
 #include <vector>
 
 #include "common/bytes.h"
+#include "common/cdc.h"
 #include "common/fileid.h"
 #include "common/http_token.h"
 
@@ -115,6 +119,24 @@ int main(int argc, char** argv) {
     printf("%s\n", HttpGenToken(argv[2], argv[3],
                                 strtoll(argv[4], nullptr, 10))
                        .c_str());
+    return 0;
+  }
+  if (cmd == "cdc" && (argc == 5 || argc == 6)) {
+    std::string data = ReadStdin();
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(data.data());
+    std::vector<int64_t> cuts;
+    if (argc == 6) {
+      size_t seg = strtoull(argv[5], nullptr, 10);
+      GearChunker ck(strtoll(argv[2], nullptr, 10), atoi(argv[3]),
+                     strtoll(argv[4], nullptr, 10));
+      for (size_t off = 0; off < data.size(); off += seg)
+        ck.Feed(p + off, std::min(seg, data.size() - off), &cuts);
+      ck.Finish(&cuts);
+    } else {
+      cuts = GearChunkStream(p, data.size(), strtoll(argv[2], nullptr, 10),
+                             atoi(argv[3]), strtoll(argv[4], nullptr, 10));
+    }
+    for (int64_t c : cuts) printf("%lld\n", static_cast<long long>(c));
     return 0;
   }
   if (cmd == "b64e" && argc == 3) {
