@@ -1,11 +1,22 @@
 #!/usr/bin/env python
 """Headline benchmark: dedup-ingest fingerprint throughput, GB/s per chip.
 
-Measures the TPU upload-path fingerprint pipeline (batched SHA1 + MinHash
-over resident chunk batches — the compute that replaces the reference's
-scalar CRC32 loop in ``storage/storage_dio.c:dio_write_file()``) in
-steady state, and compares against the single-core CPU baseline
-(hashlib SHA1, the reference-style scalar path) on identical data.
+Measures the TPU upload-path fingerprint pipeline — the fused Pallas
+SHA1 + MinHash survivor-sketch kernels over chunk batches, the compute
+that replaces the reference's scalar CRC32 loop in
+``storage/storage_dio.c:dio_write_file()`` — in steady state, and
+compares against the single-core CPU baseline (hashlib SHA1, the
+reference-style scalar path) on identical data.
+
+Methodology (breakdown in tools/PROFILE_r03.md): 512 MB batches with a
+depth-``PIPELINE`` dispatch pipeline.  On this machine the TPU sits
+behind the axon tunnel, which adds ~5-10 ms of per-dispatch overhead
+and ~65 ms of round-trip fence latency; pipelining dispatches and
+fencing once amortizes both, exactly as the storage daemon's streaming
+ingest does (batches from concurrent uploads queue on the device).  The
+final ``device_get`` of every batch's digests+signatures is the fence —
+digests must return to the host to drive the dedup index, so it is also
+the realistic cost boundary.
 
 Prints ONE JSON line:
   {"metric": "dedup_ingest_GBps_per_chip", "value": N, "unit": "GB/s",
@@ -19,43 +30,45 @@ import time
 
 import numpy as np
 
+CHUNK_KB = 64
+N_CHUNKS = 8192      # 512 MB per dispatch
+PIPELINE = 8
 
-def _bench_tpu(chunk_kb: int = 64, n_chunks: int = 2048, iters: int = 8) -> float:
+
+def _bench_tpu() -> float:
     import jax
 
-    from fastdfs_tpu.ops.minhash import minhash_batch
-    from fastdfs_tpu.ops.sha1 import sha1_batch
+    from fastdfs_tpu.ops.pallas_minhash import minhash_batch_pallas
+    from fastdfs_tpu.ops.pallas_sha1 import sha1_batch_pallas
 
-    L = chunk_kb * 1024
+    L = CHUNK_KB * 1024
     rng = np.random.RandomState(0)
-    chunks = rng.randint(0, 256, size=(n_chunks, L), dtype=np.uint8)
-    lens = np.full(n_chunks, L, dtype=np.int32)
+    chunks = rng.randint(0, 256, size=(N_CHUNKS, L), dtype=np.uint8)
+    lens = np.full(N_CHUNKS, L, dtype=np.int32)
 
     dev_chunks = jax.device_put(chunks)
     dev_lens = jax.device_put(lens)
+    jax.block_until_ready((dev_chunks, dev_lens))
 
     @jax.jit
     def step(c, ln):
-        return sha1_batch(c, ln), minhash_batch(c, ln)
+        return sha1_batch_pallas(c, ln, L), minhash_batch_pallas(c, ln)
 
     # warmup/compile (and force one full execution)
     jax.device_get(step(dev_chunks, dev_lens))
 
-    # On the axon remote backend block_until_ready returns before the
-    # execution really finishes, so the only trustworthy fence is fetching
-    # the outputs — which is also what the real upload pipeline does
-    # (digests return to the host to drive the dedup index).
-    times = []
-    for _ in range(iters):
+    rates = []
+    for _ in range(5):
         t0 = time.perf_counter()
-        jax.device_get(step(dev_chunks, dev_lens))
-        times.append(time.perf_counter() - t0)
-    dt = sorted(times)[len(times) // 2]  # median steady-state
-    return n_chunks * L / dt / 1e9
+        outs = [step(dev_chunks, dev_lens) for _ in range(PIPELINE)]
+        jax.device_get(outs)  # the only trustworthy fence on this backend
+        dt = (time.perf_counter() - t0) / PIPELINE
+        rates.append(N_CHUNKS * L / dt / 1e9)
+    return sorted(rates)[len(rates) // 2]  # median steady-state round
 
 
-def _bench_cpu(chunk_kb: int = 64, n_chunks: int = 256) -> float:
-    L = chunk_kb * 1024
+def _bench_cpu(n_chunks: int = 256) -> float:
+    L = CHUNK_KB * 1024
     rng = np.random.RandomState(0)
     data = rng.randint(0, 256, size=(n_chunks, L), dtype=np.uint8)
     rows = [row.tobytes() for row in data]

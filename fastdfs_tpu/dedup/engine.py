@@ -19,6 +19,7 @@ near-dup detection works at file granularity without rehashing the file.
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -27,6 +28,14 @@ from fastdfs_tpu.dedup.index import ExactDigestIndex, MinHashLSHIndex
 from fastdfs_tpu.ops import gear_cdc
 from fastdfs_tpu.ops.minhash import DEFAULT_PERMS, DEFAULT_SHINGLE, minhash_batch
 from fastdfs_tpu.ops.sha1 import digest_bytes, sha1_batch
+
+
+def _tpu_available() -> bool:
+    import jax
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
 
 
 @dataclass(frozen=True)
@@ -44,6 +53,9 @@ class DedupConfig:
     # bucket compiles exactly ONE XLA shape — a varying chunk count would
     # otherwise retrace per distinct N and dominate wall-clock.
     row_tile: int = 256
+    # None = auto: Pallas kernels on TPU, XLA reference elsewhere.  The
+    # two paths are bit-identical (tests/test_pallas_kernels.py).
+    use_pallas: bool | None = None
 
 
 @dataclass
@@ -96,6 +108,27 @@ class DedupEngine:
         self.config = config or DedupConfig()
         self.exact = ExactDigestIndex()
         self.near = MinHashLSHIndex(self.config.num_perms, self.config.lsh_bands)
+        use_pallas = self.config.use_pallas
+        if use_pallas is None:
+            # The survivor kernel is specialized to the default shingle
+            # width; other widths take the (bit-identical) XLA reference.
+            use_pallas = _tpu_available() and self.config.shingle == 5
+        self._use_pallas = use_pallas
+
+    def _fingerprint_batch(self, batch: np.ndarray, lens: np.ndarray):
+        """Dispatch one (row_tile, blen) batch; returns device arrays
+        (futures) so callers can overlap multiple buckets in flight."""
+        cfg = self.config
+        if self._use_pallas:
+            from fastdfs_tpu.ops.pallas_minhash import minhash_batch_pallas
+            from fastdfs_tpu.ops.pallas_sha1 import sha1_batch_pallas
+            sub = max(1, min(16, batch.shape[0] // 128))
+            d = sha1_batch_pallas(batch, lens, int(batch.shape[1]), sub=sub)
+            s = minhash_batch_pallas(batch, lens, cfg.num_perms, cfg.shingle)
+        else:
+            d = sha1_batch(batch, lens)
+            s = minhash_batch(batch, lens, cfg.num_perms, cfg.shingle)
+        return d, s
 
     # -- pure compute ------------------------------------------------------
 
@@ -125,7 +158,21 @@ class DedupEngine:
             by_bucket.setdefault(_bucket_len(ln, cfg.min_size, cfg.max_size), []).append(i)
 
         # Fixed (row_tile, blen) shapes: one compile per bucket, ever.
+        # A bounded in-flight window (double buffering, SURVEY.md §7.6d)
+        # overlaps device work on batch B with host packing of B+1 while
+        # keeping device memory O(depth * batch) regardless of stream size.
         tile = cfg.row_tile
+        depth = 4
+        pending: deque[tuple[list[int], object, object]] = deque()
+
+        def drain_one() -> None:
+            group, d, s = pending.popleft()
+            d = np.asarray(d)
+            s = np.asarray(s)
+            for row, i in enumerate(group):
+                digests[i] = d[row]
+                sigs[i] = s[row]
+
         for blen, idxs in sorted(by_bucket.items()):
             for start in range(0, len(idxs), tile):
                 group = idxs[start:start + tile]
@@ -135,12 +182,11 @@ class DedupEngine:
                     off, ln = spans[i]
                     batch[row, :ln] = arr[off:off + ln]
                     lens[row] = ln
-                d = np.asarray(sha1_batch(batch, lens))
-                s = np.asarray(minhash_batch(batch, lens, cfg.num_perms,
-                                             cfg.shingle))
-                for row, i in enumerate(group):
-                    digests[i] = d[row]
-                    sigs[i] = s[row]
+                pending.append((group, *self._fingerprint_batch(batch, lens)))
+                if len(pending) > depth:
+                    drain_one()
+        while pending:
+            drain_one()
         return spans, digests, sigs
 
     def warmup(self) -> None:
@@ -153,8 +199,8 @@ class DedupEngine:
         while True:
             batch = np.zeros((cfg.row_tile, blen), dtype=np.uint8)
             lens = np.ones(cfg.row_tile, dtype=np.int32)
-            np.asarray(sha1_batch(batch, lens))
-            np.asarray(minhash_batch(batch, lens, cfg.num_perms, cfg.shingle))
+            d, s = self._fingerprint_batch(batch, lens)
+            np.asarray(d), np.asarray(s)
             if blen >= cfg.max_size:
                 break
             blen = min(blen << 1, cfg.max_size)

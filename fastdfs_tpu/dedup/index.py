@@ -17,6 +17,13 @@ from typing import Any, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from fastdfs_tpu.ops.minhash import EMPTY
+
+# Bumped whenever the signature spec changes (v2 = the survivor sketch,
+# round 3); snapshots carry it so a stale index fails loudly instead of
+# silently scoring noise against incompatible signatures.
+SIG_SPEC_VERSION = 2
+
 
 class ExactDigestIndex:
     """digest bytes → opaque ref (chunk locator / file id)."""
@@ -91,9 +98,15 @@ class MinHashLSHIndex:
                 for b in range(self.bands)]
 
     def add(self, sig: np.ndarray, ref: Any) -> int:
+        """Insert; returns the item id, or -1 for an all-``EMPTY``
+        signature (a chunk/file with no sketch survivors carries no
+        similarity information — indexing it would make every such item
+        a spurious 1.0-score near-dup of every other)."""
         sig = np.asarray(sig, dtype=np.uint32)
         if sig.shape != (self.num_perms,):
             raise ValueError(f"signature shape {sig.shape} != ({self.num_perms},)")
+        if (sig == EMPTY).all():
+            return -1
         item = len(self._refs)
         self._refs.append(ref)
         self._rows.append(sig)
@@ -106,6 +119,8 @@ class MinHashLSHIndex:
               min_similarity: float = 0.5) -> list[tuple[Any, float]]:
         """Top-k near-dup candidates with signature-agreement scores."""
         sig = np.asarray(sig, dtype=np.uint32)
+        if (sig == EMPTY).all():
+            return []
         cand: set[int] = set()
         for b, key in enumerate(self._band_keys(sig)):
             cand.update(self._buckets[b].get(key, ()))
@@ -147,11 +162,19 @@ class MinHashLSHIndex:
         _atomic_savez(
             path, sigs=self.signatures,
             refs=np.array([json.dumps(r) for r in self._refs], dtype=object),
-            num_perms=self.num_perms, bands=self.bands)
+            num_perms=self.num_perms, bands=self.bands,
+            sig_spec=SIG_SPEC_VERSION)
 
     @classmethod
     def load(cls, path: str) -> "MinHashLSHIndex":
         data = np.load(_npz_path(path), allow_pickle=True)
+        spec = int(data["sig_spec"]) if "sig_spec" in data else 1
+        if spec != SIG_SPEC_VERSION:
+            raise ValueError(
+                f"near-dup index snapshot {path!r} holds spec-v{spec} "
+                f"signatures, this build computes spec-v{SIG_SPEC_VERSION}; "
+                "the sets are not comparable — delete the snapshot and "
+                "re-ingest (exact dedup state is unaffected)")
         idx = cls(int(data["num_perms"]), int(data["bands"]))
         sigs = np.asarray(data["sigs"], dtype=np.uint32)
         idx._rows = list(sigs)

@@ -17,6 +17,7 @@ from fastdfs_tpu.ops.gear_cdc import (  # noqa: F401
 from fastdfs_tpu.ops.sha1 import sha1_batch, sha1_hex  # noqa: F401
 from fastdfs_tpu.ops.minhash import (  # noqa: F401
     shingle_hashes,
+    survivor_segmin,
     minhash_signature,
     minhash_batch,
     estimate_jaccard,

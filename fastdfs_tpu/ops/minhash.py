@@ -1,19 +1,46 @@
-"""MinHash near-duplicate fingerprints on TPU.
+"""MinHash near-duplicate fingerprints on TPU (v2 "survivor sketch" spec).
 
 The tracker-side near-dup index (north star: "tracker's file-id index
 backed by a jax.numpy cosine/MinHash similarity search") needs a compact
 per-chunk signature whose agreement rate estimates Jaccard similarity of
-the underlying shingle sets.  Pipeline:
+the underlying shingle sets.  The v1 spec permuted EVERY shingle hash
+through all ``P`` universal hashes — ``P`` multiply-add-min triples per
+byte, ~192 vector ops/byte, which capped the whole ingest pipeline at
+~2.9 GB/s on a v5e chip (see tools/PROFILE_r03.md).  The v2 spec is a
+TPU-first two-stage sketch with identical set semantics:
 
-1. byte shingles of size ``k`` hashed with a polynomial hash (vectorized
-   as ``k`` shifted multiply-adds — same trick as the gear window);
-2. ``P`` universal-hash permutations ``h_j(x) = a_j * x + b_j`` over
-   uint32 (odd ``a_j``; multiply-shift family), min-reduced over shingle
-   positions → signature ``(P,)`` uint32;
-3. signature agreement fraction ≈ Jaccard(J) of shingle sets.
+1. **Shingle hashes** — polynomial hash of every ``k``-byte window
+   (unchanged from v1);
+2. **Survivor sampling** — keep only hashes with ``h & SAMPLE_MASK == 0``
+   (rate 1/256).  Sampling is keyed on the VALUE, so it is invariant to
+   where content sits in the stream: two near-duplicate chunks sample
+   (almost exactly) the same elements.  Jaccard of the sampled sets is an
+   unbiased estimate of Jaccard of the full sets;
+3. **Segment-min compaction** — the sparse survivors are compacted to a
+   dense ``NUM_SEGMENTS``-wide vector ``z`` by taking the min surviving
+   hash per segment (``segment = word_index mod NUM_SEGMENTS``; empty
+   segments hold ``EMPTY``).  When two survivors share a segment the
+   larger is dropped (~1-11% of survivors depending on chunk size) —
+   a small position-dependent thinning that both the CPU reference and
+   the TPU kernel apply identically;
+4. **Permutation MinHash over survivors** — ``P`` universal-hash
+   permutations ``h_j(x) = a_j * x + b_j`` min-reduced over the ~256
+   survivors instead of all ~65k positions.  Signature agreement
+   fraction ≈ Jaccard of the survivor (≈ shingle) sets.
 
-No reference equivalent — upstream FastDFS has only exact CRC32 (SURVEY.md
-§0 north-star note).
+Why this is the TPU shape of the problem: stage 2+3 are one cheap pass
+(compare + select + min) that shrinks the element count 64-256x, so the
+expensive ``P``-way permutation work runs on 1/64th of the data and the
+whole sketch drops from ~192 to ~25 vector ops per ingested byte.
+
+A chunk with no survivors signs as all-``EMPTY``; ``EMPTY`` is neutral
+in element-wise mins, so file-level signatures (min over chunk
+signatures) remain "MinHash of the union of the chunks' survivor sets".
+
+No reference equivalent — upstream FastDFS has only exact CRC32
+(SURVEY.md §0 north-star note).  Bit-exactness of the Pallas twin
+(``ops/pallas_minhash.py``) against this reference is enforced by
+``tests/test_pallas_kernels.py``.
 """
 
 from __future__ import annotations
@@ -26,6 +53,10 @@ import numpy as np
 
 DEFAULT_SHINGLE = 5
 DEFAULT_PERMS = 64
+
+SAMPLE_MASK = np.uint32(0xFF)   # keep h iff (h & SAMPLE_MASK) == 0: rate 1/256
+NUM_SEGMENTS = 1024             # z width; segment = word_index % NUM_SEGMENTS
+EMPTY = np.uint32(0xFFFFFFFF)   # empty-segment sentinel, neutral under min
 
 _MINHASH_SEED = 0x5F3759DF
 _POLY_B = np.uint32(0x01000193)  # FNV-32 prime as shingle-hash base
@@ -54,6 +85,40 @@ def shingle_hashes(data: jax.Array, k: int = DEFAULT_SHINGLE) -> jax.Array:
     return h
 
 
+def _valid_mask(n: int, lengths: jax.Array, k: int) -> jax.Array:
+    """(N, n) bool: complete-shingle positions (degenerate chunks shorter
+    than ``k`` hash their zero-padded window at positions < max(len, 1))."""
+    pos = jnp.arange(n, dtype=jnp.int32)[None, :]
+    lens = lengths.astype(jnp.int32)[:, None]
+    valid = pos <= (lens - k)
+    return jnp.where(lens >= k, valid, pos < jnp.maximum(lens, 1))
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def survivor_segmin(data: jax.Array, lengths: jax.Array,
+                    k: int = DEFAULT_SHINGLE) -> jax.Array:
+    """Stages 1-3 of the sketch: uint8 ``(N, L)`` + lengths ``(N,)`` →
+    uint32 ``(N, NUM_SEGMENTS)`` survivor vector ``z``.
+
+    ``z[s]`` is the smallest surviving shingle hash whose byte position
+    ``p`` satisfies ``(p // 4) % NUM_SEGMENTS == s`` (word-granular
+    striding, so ``z`` is independent of the padded container length),
+    or ``EMPTY`` when no survivor maps to ``s``.
+    """
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    lengths = jnp.asarray(lengths, dtype=jnp.int32)
+    n, L = data.shape
+    block = 4 * NUM_SEGMENTS
+    pad = (-L) % block
+    h = jax.vmap(lambda row: shingle_hashes(row, k))(
+        jnp.pad(data, ((0, 0), (0, pad))))
+    surv = _valid_mask(L + pad, lengths, k) & ((h & SAMPLE_MASK) == 0)
+    hm = jnp.where(surv, h, EMPTY)
+    # position p = block*b + 4*s + r  →  word p//4 = NUM_SEGMENTS*b + s,
+    # so a plain reshape groups positions by segment.
+    return hm.reshape(n, (L + pad) // block, NUM_SEGMENTS, 4).min(axis=(1, 3))
+
+
 _MIN_BLOCK = 512  # positions per scan step: keeps the (P, block)
                   # permuted-hash tile resident instead of an O(P*L) array
 
@@ -61,14 +126,15 @@ _MIN_BLOCK = 512  # positions per scan step: keeps the (P, block)
 @functools.partial(jax.jit, static_argnames=("num_perms",))
 def minhash_signature(hashes: jax.Array, num_perms: int = DEFAULT_PERMS,
                       valid: jax.Array | None = None) -> jax.Array:
-    """MinHash signature of a set of shingle hashes.
+    """MinHash signature of a set of element hashes (stage 4).
 
     ``hashes``: uint32 ``(m,)``.  ``valid``: optional bool ``(m,)`` mask
-    (padded positions excluded).  Returns uint32 ``(num_perms,)``.
+    (excluded positions contribute nothing).  Returns uint32
+    ``(num_perms,)``; all-invalid input signs as all-``EMPTY``.
 
     Computed as a running min over position blocks (lax.scan): the
     naive ``(P, m)`` permuted matrix is never materialized, so memory is
-    O(P * block) regardless of chunk length.
+    O(P * block) regardless of input length.
     """
     a, b = _perm_constants(num_perms)
     av = jnp.asarray(a)[:, None]
@@ -84,10 +150,10 @@ def minhash_signature(hashes: jax.Array, num_perms: int = DEFAULT_PERMS,
     def body(carry, hv_block):
         hb, vb = hv_block
         perm = hb[None, :] * av + bv                      # (P, block)
-        perm = jnp.where(vb[None, :], perm, jnp.uint32(0xFFFFFFFF))
+        perm = jnp.where(vb[None, :], perm, EMPTY)
         return jnp.minimum(carry, perm.min(axis=1)), None
 
-    init = jnp.full((num_perms,), 0xFFFFFFFF, dtype=jnp.uint32)
+    init = jnp.full((num_perms,), EMPTY, dtype=jnp.uint32)
     sig, _ = jax.lax.scan(body, init, (h_blocks, v_blocks))
     return sig
 
@@ -97,18 +163,15 @@ def minhash_batch(data: jax.Array, lengths: jax.Array,
                   num_perms: int = DEFAULT_PERMS,
                   k: int = DEFAULT_SHINGLE) -> jax.Array:
     """Signatures for a batch of chunks: uint8 ``(N, L)`` + lengths ``(N,)``
-    → uint32 ``(N, num_perms)``."""
+    → uint32 ``(N, num_perms)``.
 
-    def one(row, ln):
-        h = shingle_hashes(row, k)
-        pos = jnp.arange(row.shape[0], dtype=jnp.int32)
-        valid = pos <= (ln - k)  # complete shingles only
-        # Degenerate chunks shorter than k hash their zero-padded window.
-        valid = jnp.where(ln >= k, valid, pos < jnp.maximum(ln, 1))
-        return minhash_signature(h, num_perms, valid)
-
-    return jax.vmap(one)(jnp.asarray(data, dtype=jnp.uint8),
-                         jnp.asarray(lengths, dtype=jnp.int32))
+    CONTRACT: rows must be zero past their length (shared with
+    ``sha1_batch``); the survivor stage hashes padded windows and relies
+    on the validity mask to exclude them.
+    """
+    z = survivor_segmin(data, lengths, k)
+    return jax.vmap(
+        lambda zr: minhash_signature(zr, num_perms, zr != EMPTY))(z)
 
 
 def estimate_jaccard(sig_a: jax.Array, sig_b: jax.Array) -> jax.Array:

@@ -1,21 +1,32 @@
-"""Pallas TPU kernel for MinHash signatures.
+"""Pallas TPU kernel for the MinHash survivor sketch (spec v2).
 
-The XLA path materializes the (num_perms, L) permuted-hash plane per
-chunk, so it is HBM-bound (~4 GB/s marginal on a v5e).  This kernel
-streams the shingle-hash sequence once and keeps the running minima of
-all permutations in registers, leaving pure VPU work: per position,
-``num_perms`` multiply-add-min triples.
+Implements stages 1-3 of ``ops/minhash.py``'s sketch — shingle hashing,
+value-keyed survivor sampling, segment-min compaction — as ONE fused
+kernel that reads each ingested byte exactly once.  The XLA formulation
+pays ~20 HBM-bound vector ops per byte just to materialize the shingle
+hashes (measured ~15-19 ms per 128 MB on a v5e; tools/PROFILE_r03.md);
+this kernel keeps everything in registers and emits only the tiny
+``(8, 128)`` survivor plane per chunk.
 
-Masking trick: instead of a per-position validity select inside the hot
-loop, the XLA prep replaces every invalid position's hash with the
-chunk's position-0 hash.  MinHash is a set minimum — duplicating an
-element that is already in the set changes nothing — so the kernel can
-run unmasked and still produce signatures bit-identical to the masked
-XLA path (enforced by tests/test_minhash.py).
+Layout: one chunk per grid step.  The chunk's bytes are viewed as a
+``(R, 128)`` plane of little-endian uint32 words (position-major:
+word ``q`` sits at row ``q // 128``, lane ``q % 128``).  Byte windows
+are rebuilt from aligned words only — each shingle phase ``r`` (byte
+offset mod 4) combines a word with its successor ``W1``, so no
+byte-misaligned loads exist anywhere.  ``W1`` itself is two lane/sublane
+rotations plus a select.
 
-Layout mirrors pallas_sha1: chunks one-per-lane on (SUB, 128) tiles,
-grid ``(chunk_tiles, position_blocks)`` with the signature accumulator
-revisited across the sequential position axis.
+Unsigned-min legalization: Mosaic has no vector ``arith.minui``, so the
+running minima are kept in int32 with the bias trick
+(``min_u(x, y) == min_s(x ^ 0x80000000, y ^ 0x80000000) ^ 0x80000000``);
+the caller un-biases with one XLA xor.
+
+Stage 4 (the P-way permutation over the ~256 survivors) is shared
+verbatim with the XLA reference (``minhash_signature``) — it touches
+1/256th of the data, so it is not worth a kernel, and sharing the code
+makes bit-exactness of the full pipeline structural rather than
+incidental.  Enforced by tests/test_pallas_kernels.py (interpret mode on
+CPU, the real kernel on TPU).
 """
 
 from __future__ import annotations
@@ -24,90 +35,109 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-from fastdfs_tpu.ops.minhash import (DEFAULT_PERMS, DEFAULT_SHINGLE,
-                                     _perm_constants, shingle_hashes)
+from fastdfs_tpu.ops.minhash import (DEFAULT_PERMS, DEFAULT_SHINGLE, EMPTY,
+                                     NUM_SEGMENTS, SAMPLE_MASK, _POLY_B,
+                                     minhash_signature)
 
 LANE = 128
-DEFAULT_SUB = 16
-POS_BLOCK = 64  # positions consumed per grid step
+_BIAS = np.int32(np.uint32(0x80000000).astype(np.int64) - (1 << 32))  # -2^31
 
 
-def _make_kernel(num_perms: int):
-    a_np, b_np = _perm_constants(num_perms)
+def _survivor_kernel(k: int, R: int):
+    """Kernel over one chunk: words (1, R, 128) u32 + len (1, 1) i32 →
+    biased survivor plane (1, 8, 128) i32."""
+    if k != 5:
+        raise NotImplementedError("survivor kernel is specialized to k=5")
 
-    def kernel(h_ref, state_ref):
-        pb = pl.program_id(1)
+    def kernel(lens_ref, w_ref, out_ref):
+        W = w_ref[0]                                   # (R, 128) uint32
+        ln = lens_ref[pl.program_id(0)]
 
-        @pl.when(pb == 0)
-        def _():
-            for j in range(num_perms):
-                state_ref[j, 0] = jnp.full(state_ref.shape[2:], 0xFFFFFFFF,
-                                           dtype=jnp.uint32)
+        # W1[q] = W[q+1] in flattened row-major word order: lane roll -1,
+        # with lane 127 taking the next row's lane 0 (row+lane roll).
+        r1 = jnp.concatenate([W[:, 1:], W[:, :1]], axis=1)
+        rr = jnp.concatenate([W[1:, :], W[:1, :]], axis=0)
+        r01 = jnp.concatenate([rr[:, 1:], rr[:, :1]], axis=1)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (R, LANE), 1)
+        W1 = jnp.where(lane < LANE - 1, r1, r01)
+        # Wrapped garbage in the last word's windows only reaches
+        # positions p >= 4*NW - 4 > len - k, which the mask excludes.
 
-        def body(g, sigs):
-            h = h_ref[0, 0, g]
-            return tuple(
-                jnp.minimum(sigs[j],
-                            h * jnp.uint32(a_np[j]) + jnp.uint32(b_np[j]))
-                for j in range(num_perms))
+        row = jax.lax.broadcasted_iota(jnp.int32, (R, LANE), 0)
+        q4 = (row * LANE + lane) * 4                   # byte position of r=0
+        # Valid positions are p <= bound (scalar select only: Mosaic has no
+        # vector-of-bool select): complete shingles, or the degenerate
+        # hash-the-padded-window rule for chunks shorter than k.
+        bound = jnp.where(ln >= k, ln - k, jnp.maximum(ln, 1) - 1)
+        B = _POLY_B
+        m = jnp.full((R, LANE), 0x7FFFFFFF, dtype=jnp.int32)
+        for r in range(4):
+            if r == 0:
+                x = W
+                b4 = W1 & jnp.uint32(0xFF)
+            else:
+                x = (W >> jnp.uint32(8 * r)) | (W1 << jnp.uint32(32 - 8 * r))
+                b4 = (W1 >> jnp.uint32(8 * r)) & jnp.uint32(0xFF)
+            h = x & jnp.uint32(0xFF)
+            h = h * B + ((x >> jnp.uint32(8)) & jnp.uint32(0xFF))
+            h = h * B + ((x >> jnp.uint32(16)) & jnp.uint32(0xFF))
+            h = h * B + (x >> jnp.uint32(24))
+            h = h * B + b4
+            p = q4 + r
+            surv = (p <= bound) & ((h & jnp.uint32(SAMPLE_MASK)) == 0)
+            hb = h.astype(jnp.int32) ^ _BIAS           # biased unsigned order
+            m = jnp.minimum(m, jnp.where(surv, hb, jnp.int32(0x7FFFFFFF)))
 
-        sigs = tuple(state_ref[j, 0] for j in range(num_perms))
-        sigs = jax.lax.fori_loop(0, h_ref.shape[2], body, sigs)
-        for j in range(num_perms):
-            state_ref[j, 0] = sigs[j]
+        # segment = word q mod NUM_SEGMENTS = 128 * (row mod 8) + lane.
+        out_ref[0] = jnp.min(m.reshape(R // 8, 8, LANE), axis=0)
 
     return kernel
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("num_perms", "k", "sub", "interpret"))
-def minhash_batch_pallas(data, lengths, num_perms: int = DEFAULT_PERMS,
-                         k: int = DEFAULT_SHINGLE, sub: int = DEFAULT_SUB,
-                         interpret: bool = False):
-    """Pallas-path twin of ops.minhash.minhash_batch: uint8 (N, L) +
-    int32 (N,) → uint32 (N, num_perms) signatures (bit-identical)."""
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def survivor_segmin_pallas(data, lengths, k: int = DEFAULT_SHINGLE,
+                           interpret: bool = False):
+    """Pallas twin of ops.minhash.survivor_segmin: uint8 (N, L) + int32 (N,)
+    → uint32 (N, NUM_SEGMENTS), bit-identical.
+
+    CONTRACT (shared with sha1_batch): rows are zero past their length.
+    """
     data = jnp.asarray(data, dtype=jnp.uint8)
     lengths = jnp.asarray(lengths, dtype=jnp.int32)
     n, L = data.shape
-
-    h = jax.vmap(lambda row: shingle_hashes(row, k))(data)  # (N, L) uint32
-    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
-    lens = lengths[:, None]
-    valid = pos <= (lens - k)
-    valid = jnp.where(lens >= k, valid, pos < jnp.maximum(lens, 1))
-    # Duplicate-element masking: invalid positions re-contribute the
-    # chunk's (always-valid) position-0 hash, which cannot change the min.
-    h = jnp.where(valid, h, h[:, :1])
-
-    # Pad chunks to (sub,128) tiles and positions to POS_BLOCK multiples.
-    # Padded POSITIONS reuse the same duplicate-element trick (any other
-    # fill value would be permuted into arbitrary words that could win a
-    # minimum); padded CHUNK rows are sliced off the result, any value.
-    tile = sub * LANE
-    n_pad = (-n) % tile
-    l_pad = (-L) % POS_BLOCK
-    if l_pad:
-        h = jnp.concatenate(
-            [h, jnp.broadcast_to(h[:, :1], (h.shape[0], l_pad))], axis=1)
-    if n_pad:
-        h = jnp.pad(h, ((0, n_pad), (0, 0)))
-    n_tiles = (n + n_pad) // tile
-    pb = (L + l_pad) // POS_BLOCK
-
-    h_t = (h.reshape(n_tiles, sub, LANE, pb, POS_BLOCK)
-           .transpose(0, 3, 4, 1, 2))  # (T, PB, G, sub, 128)
+    block = 4 * NUM_SEGMENTS
+    pad = (-L) % block
+    if pad:
+        data = jnp.pad(data, ((0, 0), (0, pad)))
+    NW = (L + pad) // 4
+    R = NW // LANE                                      # multiple of 8
+    words = jax.lax.bitcast_convert_type(
+        data.reshape(n, R, LANE, 4), jnp.uint32)        # (N, R, 128)
 
     out = pl.pallas_call(
-        _make_kernel(num_perms),
-        grid=(n_tiles, pb),
-        in_specs=[pl.BlockSpec((1, 1, POS_BLOCK, sub, LANE),
-                               lambda i, p: (i, p, 0, 0, 0))],
-        out_specs=pl.BlockSpec((num_perms, 1, sub, LANE),
-                               lambda i, p: (0, i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((num_perms, n_tiles, sub, LANE),
-                                       jnp.uint32),
+        _survivor_kernel(k, R),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n,),
+            in_specs=[pl.BlockSpec((1, R, LANE), lambda i, lens_ref: (i, 0, 0))],
+            out_specs=pl.BlockSpec((1, 8, LANE), lambda i, lens_ref: (i, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, 8, LANE), jnp.int32),
         interpret=interpret,
-    )(h_t)
-    return out.reshape(num_perms, -1).T[:n]  # (N, num_perms)
+    )(lengths, words)
+    z = jax.lax.bitcast_convert_type(out, jnp.uint32) ^ jnp.uint32(0x80000000)
+    return z.reshape(n, NUM_SEGMENTS)
+
+
+@functools.partial(jax.jit, static_argnames=("num_perms", "k", "interpret"))
+def minhash_batch_pallas(data, lengths, num_perms: int = DEFAULT_PERMS,
+                         k: int = DEFAULT_SHINGLE, interpret: bool = False):
+    """Pallas-path twin of ops.minhash.minhash_batch: uint8 (N, L) +
+    int32 (N,) → uint32 (N, num_perms) signatures (bit-identical)."""
+    z = survivor_segmin_pallas(data, lengths, k, interpret)
+    return jax.vmap(
+        lambda zr: minhash_signature(zr, num_perms, zr != EMPTY))(z)

@@ -16,8 +16,10 @@ accumulator per tile, so a tile's state never leaves VMEM between its
 blocks.  Chunks with fewer blocks than the tile's max are masked per
 block, which lets variable-length chunks share one fixed-shape launch.
 
-Bit-exactness vs hashlib is enforced by tests/test_sha1.py (interpret
-mode on CPU, the real kernel on TPU).
+Bit-exactness vs hashlib and vs the XLA reference is enforced by
+tests/test_pallas_kernels.py (interpret mode on CPU; the real kernel
+runs in bench.py and on the TPU sidecar via
+DedupEngine._fingerprint_batch).
 """
 
 from __future__ import annotations

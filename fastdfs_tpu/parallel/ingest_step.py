@@ -38,7 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fastdfs_tpu.ops.gear_cdc import GEAR_TABLE, WINDOW
-from fastdfs_tpu.ops.minhash import _perm_constants, shingle_hashes
+from fastdfs_tpu.ops.minhash import EMPTY, _perm_constants, survivor_segmin
 from fastdfs_tpu.ops.sha1 import _sha1_padded
 
 HALO = WINDOW - 1
@@ -99,21 +99,19 @@ def make_ingest_step(mesh: Mesh, num_perms: int = 64, avg_bits: int = 13,
                                    int(chunk_batch.shape[1]))  # (N_loc, 5)
         digests = jax.lax.all_gather(digests_loc, "dp", axis=0, tiled=True)
 
-        # ---- stage 3: tensor-parallel MinHash ---------------------------
+        # ---- stage 3: tensor-parallel MinHash (v2 survivor sketch) ------
         tp_idx = jax.lax.axis_index("tp")
         a = jax.lax.dynamic_slice(jnp.asarray(a_full), (tp_idx * p_local,), (p_local,))
         b = jax.lax.dynamic_slice(jnp.asarray(b_full), (tp_idx * p_local,), (p_local,))
 
-        def one_sig(row, ln):
-            sh = shingle_hashes(row, shingle)
-            pos = jnp.arange(row.shape[0], dtype=jnp.int32)
-            valid = jnp.where(ln >= shingle, pos <= ln - shingle,
-                              pos < jnp.maximum(ln, 1))
-            hv = sh[None, :] * a[:, None] + b[:, None]
-            hv = jnp.where(valid[None, :], hv, jnp.uint32(0xFFFFFFFF))
+        z = survivor_segmin(chunk_batch, chunk_lens, shingle)  # (N_loc, S)
+
+        def one_sig(zr):
+            hv = zr[None, :] * a[:, None] + b[:, None]
+            hv = jnp.where((zr != EMPTY)[None, :], hv, EMPTY)
             return hv.min(axis=1)                    # (p_local,)
 
-        sigs_loc = jax.vmap(one_sig)(chunk_batch, chunk_lens)  # (N_loc, p_local)
+        sigs_loc = jax.vmap(one_sig)(z)              # (N_loc, p_local)
         sigs_full = jax.lax.all_gather(sigs_loc, "tp", axis=1, tiled=True)
         sigs = jax.lax.all_gather(sigs_full, "dp", axis=0, tiled=True)  # (N, P)
 

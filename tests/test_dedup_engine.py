@@ -186,3 +186,32 @@ def test_cuts_match_reference_through_engine():
     cuts = [off + ln for off, ln in spans]
     assert cuts == gear_cdc.chunk_stream_ref(data, CFG.min_size, CFG.avg_bits,
                                              CFG.max_size)
+
+
+def test_empty_signature_is_not_indexed_and_never_matches():
+    # A no-survivor sketch carries no similarity information; indexing it
+    # would make every such item a 1.0-score "near-dup" of every other.
+    from fastdfs_tpu.dedup.index import MinHashLSHIndex
+    from fastdfs_tpu.ops.minhash import EMPTY
+
+    idx = MinHashLSHIndex(64, 16)
+    empty = np.full(64, EMPTY, dtype=np.uint32)
+    assert idx.add(empty, "a") == -1
+    assert len(idx) == 0
+    assert idx.query(empty) == []
+    real = np.arange(64, dtype=np.uint32)
+    assert idx.add(real, "b") == 0
+    assert idx.query(empty) == []
+
+
+def test_stale_signature_spec_snapshot_rejected(tmp_path):
+    # v1 snapshots (no sig_spec field) hold incompatible signatures; the
+    # load must fail loudly instead of silently scoring noise.
+    from fastdfs_tpu.dedup.index import MinHashLSHIndex
+
+    p = str(tmp_path / "near.npz")
+    np.savez_compressed(
+        p, sigs=np.zeros((1, 64), np.uint32),
+        refs=np.array(['"x"'], dtype=object), num_perms=64, bands=16)
+    with pytest.raises(ValueError, match="spec-v1"):
+        MinHashLSHIndex.load(p)

@@ -1,4 +1,5 @@
-"""MinHash signature properties: determinism, similarity monotonicity, and
+"""MinHash survivor-sketch (spec v2) properties: determinism, container
+independence, shift robustness, similarity monotonicity, and
 Jaccard-estimate accuracy vs the exact set computation."""
 
 import numpy as np
@@ -20,34 +21,52 @@ def _exact_jaccard(a: bytes, b: bytes, k=5):
 
 def test_identical_data_identical_signature():
     rng = np.random.RandomState(1)
-    data = rng.randint(0, 256, size=4096, dtype=np.uint8).tobytes()
+    data = rng.randint(0, 256, size=16384, dtype=np.uint8).tobytes()
     assert np.array_equal(_sig(data), _sig(data))
 
 
-def test_signature_is_order_sensitive_set_semantics():
-    # Same shingle multiset => same signature regardless of chunk framing.
-    data = b"abcdefghij" * 200
-    rot = data[10:] + data[:10]  # same shingle set (it's periodic)
-    a, b = _sig(data), _sig(rot)
-    assert np.mean(a == b) > 0.9
+def test_container_length_does_not_change_sketch():
+    # z is defined on word_index mod NUM_SEGMENTS, so the same content in
+    # a larger zero-padded container yields the identical survivor vector.
+    rng = np.random.RandomState(9)
+    data = rng.randint(0, 256, size=10000, dtype=np.uint8)
+    lens = np.array([10000], dtype=np.int32)
+    small = np.zeros((1, 12288), dtype=np.uint8)
+    small[0, :10000] = data
+    big = np.zeros((1, 65536), dtype=np.uint8)
+    big[0, :10000] = data
+    za = np.asarray(M.survivor_segmin(small, lens))
+    zb = np.asarray(M.survivor_segmin(big, lens))
+    assert np.array_equal(za, zb)
+
+
+def test_shifted_content_mostly_agrees():
+    # Survivor sampling is keyed on hash VALUES, so rotating the content
+    # keeps (almost) the same survivor set; only segment-collision
+    # thinning (position-dependent, ~10% at this density) differs.
+    rng = np.random.RandomState(2)
+    base = rng.randint(0, 256, size=65536, dtype=np.uint8).tobytes()
+    rot = base[10:] + base[:10]
+    sim = float(np.mean(_sig(base) == _sig(rot)))
+    assert sim > 0.6, sim
 
 
 def test_similar_vs_dissimilar():
     rng = np.random.RandomState(2)
-    base = rng.randint(0, 256, size=8192, dtype=np.uint8)
+    base = rng.randint(0, 256, size=16384, dtype=np.uint8)
     near = base.copy()
     near[100:110] = rng.randint(0, 256, size=10, dtype=np.uint8)  # tiny edit
-    far = rng.randint(0, 256, size=8192, dtype=np.uint8)
+    far = rng.randint(0, 256, size=16384, dtype=np.uint8)
 
     sim_near = float(np.mean(_sig(base.tobytes()) == _sig(near.tobytes())))
     sim_far = float(np.mean(_sig(base.tobytes()) == _sig(far.tobytes())))
-    assert sim_near > 0.9
-    assert sim_far < 0.2
+    assert sim_near > 0.85, sim_near
+    assert sim_far < 0.2, sim_far
 
 
 def test_jaccard_estimate_tracks_exact():
     rng = np.random.RandomState(3)
-    base = rng.randint(0, 256, size=4096, dtype=np.uint8)
+    base = rng.randint(0, 256, size=32768, dtype=np.uint8)
     for frac in (0.0, 0.25, 0.5):
         other = base.copy()
         n_edit = int(len(base) * frac)
@@ -88,6 +107,17 @@ def test_tiny_chunks_do_not_crash():
         data = bytes(range(n))
         sig = _sig(data)
         assert sig.shape == (64,)
+
+
+def test_empty_signature_is_neutral_in_file_level_min():
+    # A no-survivor chunk signs all-EMPTY, which must not perturb the
+    # file-level signature (elementwise min over chunk signatures).
+    rng = np.random.RandomState(6)
+    data = rng.randint(0, 256, size=(1, 16384), dtype=np.uint8)
+    lens = np.array([16384], dtype=np.int32)
+    sig = np.asarray(M.minhash_batch(data, lens))[0]
+    empty = np.full_like(sig, M.EMPTY)
+    assert np.array_equal(np.minimum(sig, empty), sig)
 
 
 def test_estimate_jaccard_shape():
