@@ -19,6 +19,7 @@ near-dup detection works at file granularity without rehashing the file.
 
 from __future__ import annotations
 
+import hashlib
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -27,7 +28,7 @@ import numpy as np
 from fastdfs_tpu.dedup.index import ExactDigestIndex, MinHashLSHIndex
 from fastdfs_tpu.ops import gear_cdc
 from fastdfs_tpu.ops.minhash import DEFAULT_PERMS, DEFAULT_SHINGLE, minhash_batch
-from fastdfs_tpu.ops.sha1 import digest_bytes, sha1_batch
+from fastdfs_tpu.ops.sha1 import digest_bytes
 
 
 def _tpu_available() -> bool:
@@ -126,7 +127,15 @@ class DedupEngine:
             d = sha1_batch_pallas(batch, lens, int(batch.shape[1]), sub=sub)
             s = minhash_batch_pallas(batch, lens, cfg.num_perms, cfg.shingle)
         else:
-            d = sha1_batch(batch, lens)
+            # Host path: hashlib per row.  The XLA sha1_batch exists as the
+            # jittable reference (tests/test_sha1.py) but its 80-round
+            # unrolled graph costs ~2 minutes of XLA-CPU compile per bucket
+            # shape, while hashlib runs at ~1 GB/s with none — off the TPU
+            # the scalar loop IS the right tool.
+            d = np.zeros((batch.shape[0], 5), dtype=np.uint32)
+            for i in range(batch.shape[0]):
+                dig = hashlib.sha1(batch[i, :lens[i]].tobytes()).digest()
+                d[i] = np.frombuffer(dig, dtype=">u4")
             s = minhash_batch(batch, lens, cfg.num_perms, cfg.shingle)
         return d, s
 

@@ -12,15 +12,22 @@ the scalar CRC32 loop in the reference's
 
 Opcodes
 -------
-* ``DEDUP_FINGERPRINT`` (120): body = 8B BE base_offset + raw segment
-  bytes.  Response: 8B BE chunk count, then per chunk 8B BE offset +
-  8B BE length + 20B raw SHA1.  Also feeds the MinHash near-dup index
-  with the segment's file signature (pending until commit).
+* ``DEDUP_FINGERPRINT`` (120): body = 8B BE session id + 8B BE
+  base_offset + raw segment bytes.  Response: 8B BE chunk count, then
+  per chunk 8B BE offset + 8B BE length + 20B raw SHA1.  The session id
+  (minted by the daemon per upload — ``SidecarDedup::BeginChunked``)
+  scopes ALL pending state: the accumulated file signature and the
+  per-chunk digest attributions stay buffered under the session until
+  commit/abort, so concurrent uploads cannot interleave and nothing
+  provisional ever reaches the indexes or their snapshots.
 * ``DEDUP_QUERY`` (121): body = 40-hex whole-file SHA1.  Response: the
   canonical file id if known (whole-file dedup for sub-threshold files).
 * ``DEDUP_COMMIT`` (122): text body, one of
-  ``commitfile <sha1hex> <file_id>`` | ``commitchunks <file_id>`` |
-  ``forget <file_id>``.
+  ``commitfile <sha1hex> <file_id>`` |
+  ``commitchunks <session> <file_id>`` | ``abort <session>`` |
+  ``forget <file_id>``.  ``abort`` is sent on flat-fallback or a failed
+  upload; sessions older than ``_SESSION_TTL`` seconds are reaped in
+  case a daemon dies without either message.
 
 State: whole-file digest map + the DedupEngine's exact/LSH indexes;
 snapshotted to ``<state_dir>/sidecar_*.json`` on SIGTERM and every
@@ -47,9 +54,30 @@ from fastdfs_tpu.dedup.engine import DedupConfig, DedupEngine
 
 _I64 = struct.Struct(">q")
 
+_SESSION_TTL = 600.0  # seconds before an uncommitted session is reaped
+
+
+class _Session:
+    """Pending per-upload state: accumulated file signature + the digest
+    attributions to insert (with the real file id) at commit time."""
+
+    __slots__ = ("sig", "digests", "touched")
+
+    def __init__(self) -> None:
+        self.sig: np.ndarray | None = None
+        self.digests: list[tuple[bytes, int]] = []  # (raw digest, offset)
+        self.touched = time.monotonic()
+
 
 def _pack_header(pkg_len: int, cmd: int, status: int = 0) -> bytes:
     return struct.pack(">qBB", pkg_len, cmd, status)
+
+
+def _parse_session(token: str) -> int:
+    try:
+        return int(token)
+    except ValueError:
+        return -1
 
 
 class DedupSidecar:
@@ -67,7 +95,7 @@ class DedupSidecar:
         self.engine = DedupEngine(config)
         self.files: dict[str, str] = {}       # whole-file sha1 -> file id
         self.by_file: dict[str, str] = {}     # file id -> sha1
-        self._pending_sigs: dict[int, np.ndarray] = {}  # conn id -> file sig
+        self._sessions: dict[int, _Session] = {}  # session id -> pending
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._listener: socket.socket | None = None
@@ -90,8 +118,22 @@ class DedupSidecar:
                 self.files = json.load(fh)
             self.by_file = {v: k for k, v in self.files.items()}
         if os.path.exists(exact_p) and os.path.exists(near_p):
-            self.engine = DedupEngine.load(exact_p, near_p,
-                                           self.engine.config)
+            try:
+                self.engine = DedupEngine.load(exact_p, near_p,
+                                               self.engine.config)
+            except ValueError as e:
+                # A stale-spec near-dup snapshot must not brick the
+                # sidecar (which would fail-open EVERY upload to flat
+                # storage): keep the exact index, restart the near index.
+                print(f"dedup sidecar: dropping near-dup snapshot ({e}); "
+                      "exact dedup state retained", flush=True)
+                from fastdfs_tpu.dedup.index import ExactDigestIndex
+                fresh = DedupEngine(self.engine.config)
+                try:
+                    fresh.exact = ExactDigestIndex.load(exact_p)
+                except Exception:
+                    pass
+                self.engine = fresh
 
     def save_state(self) -> None:
         if not self.state_dir:
@@ -106,30 +148,31 @@ class DedupSidecar:
 
     # -- request handlers --------------------------------------------------
 
-    def _fingerprint(self, conn_id: int, body: bytes) -> tuple[int, bytes]:
-        if len(body) < 8:
+    def _fingerprint(self, body: bytes) -> tuple[int, bytes]:
+        if len(body) < 16:
             return 22, b""
-        base_offset = _I64.unpack_from(body)[0]
-        data = body[8:]
+        session_id = _I64.unpack_from(body)[0]
+        base_offset = _I64.unpack_from(body, 8)[0]
+        data = body[16:]
         with self._lock:
             spans, digests, sigs = self.engine.fingerprint(data)
+            sess = self._sessions.setdefault(session_id, _Session())
+            sess.touched = time.monotonic()
             raw = np.asarray(digests, dtype=">u4").tobytes()
             out = [_I64.pack(len(spans))]
             for i, (off, ln) in enumerate(spans):
                 out.append(_I64.pack(base_offset + off))
                 out.append(_I64.pack(ln))
-                out.append(raw[i * 20:(i + 1) * 20])
-                # Exact chunk index: remembers which file first carried a
-                # digest (near-dup attribution; the byte-level dedup
-                # decision lives in the daemon's content-addressed store).
+                # Digest attribution (which file first carried a chunk,
+                # for near-dup reporting) stays buffered in the session
+                # until commit binds the real file id — the index never
+                # sees provisional entries.
                 dig = raw[i * 20:(i + 1) * 20]
-                if self.engine.exact.lookup(dig) is None:
-                    self.engine.exact.insert(dig, ["(pending)", off])
+                out.append(dig)
+                sess.digests.append((dig, base_offset + off))
             if len(spans):
                 sig = np.asarray(sigs).min(axis=0)
-                prev = self._pending_sigs.get(conn_id)
-                self._pending_sigs[conn_id] = (
-                    sig if prev is None else np.minimum(prev, sig))
+                sess.sig = sig if sess.sig is None else np.minimum(sess.sig, sig)
             self.stats["fingerprint_bytes"] += len(data)
             self.stats["chunks"] += len(spans)
         return 0, b"".join(out)
@@ -140,7 +183,7 @@ class DedupSidecar:
             fid = self.files.get(sha1_hex)
         return 0, fid.encode() if fid else b""
 
-    def _commit(self, conn_id: int, body: bytes) -> tuple[int, bytes]:
+    def _commit(self, body: bytes) -> tuple[int, bytes]:
         parts = body.decode("utf-8", "replace").split()
         if not parts:
             return 22, b""
@@ -149,10 +192,18 @@ class DedupSidecar:
                 self.files.setdefault(parts[1], parts[2])
                 self.by_file[parts[2]] = parts[1]
                 return 0, b""
-            if parts[0] == "commitchunks" and len(parts) == 2:
-                sig = self._pending_sigs.pop(conn_id, None)
-                if sig is not None:
-                    self.engine.near.add(sig, parts[1])
+            if parts[0] == "commitchunks" and len(parts) == 3:
+                sess = self._sessions.pop(_parse_session(parts[1]), None)
+                if sess is not None:
+                    file_id = parts[2]
+                    for dig, off in sess.digests:
+                        if self.engine.exact.lookup(dig) is None:
+                            self.engine.exact.insert(dig, [file_id, off])
+                    if sess.sig is not None:
+                        self.engine.near.add(sess.sig, file_id)
+                return 0, b""
+            if parts[0] == "abort" and len(parts) == 2:
+                self._sessions.pop(_parse_session(parts[1]), None)
                 return 0, b""
             if parts[0] == "forget" and len(parts) == 2:
                 sha1 = self.by_file.pop(parts[1], None)
@@ -162,9 +213,20 @@ class DedupSidecar:
                 return 0, b""
         return 22, b""
 
+    def _reap_stale_sessions(self) -> None:
+        cutoff = time.monotonic() - _SESSION_TTL
+        with self._lock:
+            stale = [s for s, sess in self._sessions.items()
+                     if sess.touched < cutoff]
+            for s in stale:
+                del self._sessions[s]
+        if stale:
+            print(f"dedup sidecar: reaped {len(stale)} stale sessions",
+                  flush=True)
+
     # -- server loop -------------------------------------------------------
 
-    def _serve_conn(self, conn: socket.socket, conn_id: int) -> None:
+    def _serve_conn(self, conn: socket.socket) -> None:
         try:
             while not self._stop.is_set():
                 hdr = self._recv_exact(conn, HEADER_SIZE)
@@ -178,11 +240,11 @@ class DedupSidecar:
                     return
                 self.stats["requests"] += 1
                 if h.cmd == StorageCmd.DEDUP_FINGERPRINT:
-                    status, resp = self._fingerprint(conn_id, body)
+                    status, resp = self._fingerprint(body)
                 elif h.cmd == StorageCmd.DEDUP_QUERY:
                     status, resp = self._query(body)
                 elif h.cmd == StorageCmd.DEDUP_COMMIT:
-                    status, resp = self._commit(conn_id, body)
+                    status, resp = self._commit(body)
                 elif h.cmd == StorageCmd.ACTIVE_TEST:
                     status, resp = 0, b""
                 else:
@@ -192,8 +254,6 @@ class DedupSidecar:
         except OSError:
             pass
         finally:
-            with self._lock:
-                self._pending_sigs.pop(conn_id, None)
             conn.close()
 
     @staticmethod
@@ -219,20 +279,19 @@ class DedupSidecar:
         if ready_event is not None:
             ready_event.set()
         next_snap = time.monotonic() + snapshot_interval
-        conn_seq = 0
         while not self._stop.is_set():
             try:
                 conn, _ = self._listener.accept()
             except socket.timeout:
                 if time.monotonic() >= next_snap:
                     self.save_state()
+                    self._reap_stale_sessions()
                     next_snap = time.monotonic() + snapshot_interval
                 continue
             except OSError:
                 break
-            conn_seq += 1
             threading.Thread(target=self._serve_conn,
-                             args=(conn, conn_seq), daemon=True).start()
+                             args=(conn,), daemon=True).start()
         self.save_state()
         self._listener.close()
         try:

@@ -5,6 +5,7 @@
 #include <sys/un.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cstdio>
 
 #include "common/bytes.h"
@@ -56,8 +57,8 @@ bool CpuDedup::Save() {
   return rename(tmp.c_str(), snapshot_path_.c_str()) == 0;
 }
 
-bool CpuDedup::FingerprintChunks(const char* data, size_t len,
-                                 int64_t base_offset,
+bool CpuDedup::FingerprintChunks(int64_t /*session*/, const char* data,
+                                 size_t len, int64_t base_offset,
                                  std::vector<ChunkFp>* out) {
   const uint8_t* p = reinterpret_cast<const uint8_t*>(data);
   std::vector<int64_t> cuts = GearChunkStream(
@@ -179,15 +180,29 @@ void SidecarDedup::Forget(const std::string& file_id) {
       std::string("forget ") + file_id, &resp, &status);
 }
 
+// Sessions scope the sidecar's pending per-upload state.  The id embeds
+// the daemon pid (multiple daemons may share one sidecar) and draws from
+// one PROCESS-WIDE counter — the server holds two SidecarDedup instances
+// (main loop + recovery thread), and per-instance counters would mint
+// colliding ids for exactly the concurrent-upload case sessions exist
+// to separate.
+int64_t SidecarDedup::BeginChunked() {
+  static std::atomic<int64_t> counter{0};
+  return (static_cast<int64_t>(getpid()) << 32) |
+         (counter.fetch_add(1, std::memory_order_relaxed) + 1);
+}
+
 // Fingerprint RPC (cmd 120): request body is the raw segment prefixed by
-// an 8B BE base_offset; response is 8B BE chunk_count then per chunk
-// 8B offset + 8B length + 20B raw digest.
-bool SidecarDedup::FingerprintChunks(const char* data, size_t len,
-                                     int64_t base_offset,
+// 8B BE session id + 8B BE base_offset; response is 8B BE chunk_count
+// then per chunk 8B offset + 8B length + 20B raw digest.
+bool SidecarDedup::FingerprintChunks(int64_t session, const char* data,
+                                     size_t len, int64_t base_offset,
                                      std::vector<ChunkFp>* out) {
   std::string body;
-  body.reserve(8 + len);
+  body.reserve(16 + len);
   uint8_t num[8];
+  PutInt64BE(session, num);
+  body.append(reinterpret_cast<char*>(num), 8);
   PutInt64BE(base_offset, num);
   body.append(reinterpret_cast<char*>(num), 8);
   body.append(data, len);
@@ -222,11 +237,19 @@ bool SidecarDedup::FingerprintChunks(const char* data, size_t len,
   return covered == static_cast<int64_t>(len);
 }
 
-void SidecarDedup::CommitChunked(const std::string& file_id) {
+void SidecarDedup::CommitChunked(int64_t session, const std::string& file_id) {
   std::string resp;
   uint8_t status = 0;
   Rpc(static_cast<uint8_t>(StorageCmd::kDedupCommit),
-      std::string("commitchunks ") + file_id, &resp, &status);
+      "commitchunks " + std::to_string(session) + " " + file_id, &resp,
+      &status);
+}
+
+void SidecarDedup::AbortChunked(int64_t session) {
+  std::string resp;
+  uint8_t status = 0;
+  Rpc(static_cast<uint8_t>(StorageCmd::kDedupCommit),
+      "abort " + std::to_string(session), &resp, &status);
 }
 
 void SidecarDedup::ForgetChunked(const std::string& file_id) {

@@ -51,20 +51,31 @@ class DedupPlugin {
   virtual const char* Name() const = 0;
 
   // -- chunk granularity -------------------------------------------------
+  // One chunked upload = one SESSION: BeginChunked() mints an id that
+  // scopes all pending fingerprint state (file signature, digest
+  // attributions) until CommitChunked binds it to the final file id or
+  // AbortChunked discards it (flat-fallback, failed upload).  Explicit
+  // sessions — not connection identity — so concurrent uploads over one
+  // plugin and multi-threaded daemons (work_threads > 1) cannot
+  // interleave state.
+  virtual int64_t BeginChunked() { return 0; }
   // CDC + per-chunk SHA1 over one SEGMENT of an upload stream.  Segments
   // are independently chunked (CDC restarts at segment boundaries) so a
   // multi-GB file never needs a contiguous buffer; `base_offset` shifts
   // the reported chunk offsets to absolute stream positions.  Returns
   // false when chunk fingerprinting is unavailable (caller stores flat).
-  virtual bool FingerprintChunks(const char* data, size_t len,
-                                 int64_t base_offset,
+  virtual bool FingerprintChunks(int64_t session, const char* data,
+                                 size_t len, int64_t base_offset,
                                  std::vector<ChunkFp>* out) {
-    (void)data; (void)len; (void)base_offset; (void)out;
+    (void)session; (void)data; (void)len; (void)base_offset; (void)out;
     return false;
   }
   // Chunked-file lifecycle notifications (near-dup index bookkeeping in
   // the sidecar; no-ops for the cpu plugin — its ChunkStore IS the index).
-  virtual void CommitChunked(const std::string& file_id) { (void)file_id; }
+  virtual void CommitChunked(int64_t session, const std::string& file_id) {
+    (void)session; (void)file_id;
+  }
+  virtual void AbortChunked(int64_t session) { (void)session; }
   virtual void ForgetChunked(const std::string& file_id) { (void)file_id; }
 };
 
@@ -79,7 +90,8 @@ class CpuDedup : public DedupPlugin {
   void Forget(const std::string& file_id) override;
   bool Save() override;
   const char* Name() const override { return "cpu"; }
-  bool FingerprintChunks(const char* data, size_t len, int64_t base_offset,
+  bool FingerprintChunks(int64_t session, const char* data, size_t len,
+                         int64_t base_offset,
                          std::vector<ChunkFp>* out) override;
   bool LoadSnapshot();
   size_t size() const { return by_digest_.size(); }
@@ -102,9 +114,12 @@ class SidecarDedup : public DedupPlugin {
   void Commit(const std::string& sha1_hex, const std::string& file_id) override;
   void Forget(const std::string& file_id) override;
   const char* Name() const override { return "sidecar"; }
-  bool FingerprintChunks(const char* data, size_t len, int64_t base_offset,
+  int64_t BeginChunked() override;
+  bool FingerprintChunks(int64_t session, const char* data, size_t len,
+                         int64_t base_offset,
                          std::vector<ChunkFp>* out) override;
-  void CommitChunked(const std::string& file_id) override;
+  void CommitChunked(int64_t session, const std::string& file_id) override;
+  void AbortChunked(int64_t session) override;
   void ForgetChunked(const std::string& file_id) override;
 
  private:

@@ -435,6 +435,18 @@ bool RecoveryManager::StoreRecovered(const std::string& remote,
     return false;
   }
   StoreManager::EnsureParentDirs(*local);
+  // Dedup parity with the upload/sync paths: chunk-eligible recovered
+  // files go through the chunk store (recipe + content-addressed chunks)
+  // so a rebuilt node deduplicates like its peers; failure of any kind
+  // falls back to the flat copy.
+  struct stat st;
+  if (chunked_store_ && chunk_threshold_ > 0 &&
+      stat(tmp_path.c_str(), &st) == 0 && st.st_size >= chunk_threshold_) {
+    if (chunked_store_(tmp_path, spi, st.st_size, remote)) {
+      unlink(tmp_path.c_str());
+      return true;
+    }
+  }
   if (rename(tmp_path.c_str(), local->c_str()) != 0) {
     unlink(tmp_path.c_str());
     return false;

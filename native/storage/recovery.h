@@ -14,6 +14,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <functional>
 #include <string>
 #include <thread>
 #include <vector>
@@ -37,6 +38,18 @@ class RecoveryManager {
   // recovering flag (the node must never pass through ACTIVE with a
   // wiped disk).
   bool NeedsRecovery(bool data_was_fresh) const;
+  // Chunk-dedup parity: recovered files at or above `threshold` bytes are
+  // routed through the server's chunk store exactly like uploaded/synced
+  // ones (fn(tmp_path, spi, size, remote) -> stored?).  Unset or failing
+  // hook falls back to the flat rename.
+  using ChunkedStoreFn = std::function<bool(
+      const std::string& tmp_path, int spi, int64_t size,
+      const std::string& remote)>;
+  void SetChunkedStore(ChunkedStoreFn fn, int64_t threshold) {
+    chunked_store_ = std::move(fn);
+    chunk_threshold_ = threshold;
+  }
+
   // Start the background rebuild (call only when NeedsRecovery).
   void Start();
   void Stop();
@@ -85,6 +98,8 @@ class RecoveryManager {
   std::atomic<bool> running_{false};
   std::atomic<int64_t> files_recovered_{0};
   std::atomic<int64_t> files_skipped_{0};
+  ChunkedStoreFn chunked_store_;
+  int64_t chunk_threshold_ = 0;
 };
 
 }  // namespace fdfs

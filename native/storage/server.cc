@@ -154,6 +154,34 @@ bool StorageServer::Init(std::string* error) {
     // reads for files it no longer has) on its way into recovery.
     recovery_ = std::make_unique<RecoveryManager>(cfg_, reporter_.get(),
                                                   &store_);
+    // Recovered files dedup exactly like synced/uploaded ones: a rebuilt
+    // node must not silently lose chunk-level dedup (its chunk store
+    // would stay empty while peers dedup).  The hook runs on the
+    // recovery thread, so it gets its OWN plugin instance (ChunkStore is
+    // internally locked; the plugins are not).
+    if (dedup_ != nullptr && !chunk_stores_.empty()) {
+      // Only the sidecar plugin needs a per-thread instance (it owns a
+      // socket fd); CpuDedup's FingerprintChunks is stateless, and a
+      // second CpuDedup would pointlessly re-load the digest snapshot.
+      if (cfg_.dedup_mode == "sidecar")
+        recovery_dedup_ = MakeDedupPlugin(cfg_.dedup_mode, cfg_.base_path,
+                                          cfg_.dedup_sidecar);
+      DedupPlugin* rec_plugin =
+          recovery_dedup_ != nullptr ? recovery_dedup_.get() : dedup_.get();
+      recovery_->SetChunkedStore(
+          [this, rec_plugin](const std::string& tmp, int spi, int64_t size,
+                             const std::string& remote) {
+            auto local = LocalPath(store_.store_path(spi), remote);
+            if (!local.has_value()) return false;
+            StoreManager::EnsureParentDirs(*local);
+            int64_t saved = 0, hits = 0;
+            return ChunkedStoreWith(rec_plugin, tmp, spi, size,
+                                    *local + ".rcp",
+                                    cfg_.group_name + "/" + remote, &saved,
+                                    &hits);
+          },
+          cfg_.dedup_chunk_threshold);
+    }
     bool needs_recovery = recovery_->NeedsRecovery(store_.any_path_was_fresh());
     reporter_->set_recovering(needs_recovery);
     reporter_->Start();
@@ -839,6 +867,7 @@ void StorageServer::OnFileComplete(Conn* c) {
       sscanf(c->sync_remote.c_str(), "M%02X/", &spi);
       int64_t saved = 0, hits = 0;
       if (StoreChunkedFromTmp(c->tmp_path, spi, st.st_size, local + ".rcp",
+                              cfg_.group_name + "/" + c->sync_remote,
                               &saved, &hits)) {
         unlink(c->tmp_path.c_str());
         stats_.dedup_hits += hits;
@@ -1252,12 +1281,13 @@ void StorageServer::FinishUpload(Conn* c) {
       StoreManager::EnsureParentDirs(local);
       int64_t saved = 0, hits = 0;
       if (StoreChunkedFromTmp(c->tmp_path, c->store_path_index, c->file_size,
-                              local + ".rcp", &saved, &hits)) {
+                              local + ".rcp",
+                              cfg_.group_name + "/" + parts->RemoteFilename(),
+                              &saved, &hits)) {
         unlink(c->tmp_path.c_str());
         c->tmp_path.clear();
         stats_.dedup_hits += hits;
         stats_.dedup_bytes_saved += saved;
-        dedup_->CommitChunked(cfg_.group_name + "/" + parts->RemoteFilename());
         binlog_.Append(kBinlogOpCreate, parts->RemoteFilename());
         stats_.success_upload++;
         stats_.last_source_update = time(nullptr);
@@ -1376,13 +1406,28 @@ ChunkStore* StorageServer::StoreForLocal(const std::string& local) {
 bool StorageServer::StoreChunkedFromTmp(const std::string& tmp_path, int spi,
                                         int64_t size,
                                         const std::string& rcp_path,
+                                        const std::string& file_ref,
                                         int64_t* saved_bytes,
                                         int64_t* chunk_hits) {
+  return ChunkedStoreWith(dedup_.get(), tmp_path, spi, size, rcp_path,
+                          file_ref, saved_bytes, chunk_hits);
+}
+
+bool StorageServer::ChunkedStoreWith(DedupPlugin* plugin,
+                                     const std::string& tmp_path, int spi,
+                                     int64_t size, const std::string& rcp_path,
+                                     const std::string& file_ref,
+                                     int64_t* saved_bytes,
+                                     int64_t* chunk_hits) {
   if (spi >= static_cast<int>(chunk_stores_.size())) return false;
   ChunkStore* cs = chunk_stores_[spi].get();
   int fd = open(tmp_path.c_str(), O_RDONLY);
   if (fd < 0) return false;
 
+  // One upload = one fingerprint session; committed to `file_ref` on
+  // success, aborted on any failure so the plugin never leaks pending
+  // state into the next upload (flat-fallback included).
+  const int64_t session = plugin->BeginChunked();
   Recipe recipe;
   recipe.logical_size = size;
   std::string seg;
@@ -1405,7 +1450,8 @@ bool StorageServer::StoreChunkedFromTmp(const std::string& tmp_path, int spi,
     // Fingerprint this segment (accelerated in sidecar mode: CDC +
     // batched SHA1 run on the TPU); then write only unseen chunks.
     std::vector<ChunkFp> fps;
-    if (!dedup_->FingerprintChunks(seg.data(), seg.size(), seg_base, &fps)) {
+    if (!plugin->FingerprintChunks(session, seg.data(), seg.size(), seg_base,
+                                   &fps)) {
       ok = false;  // fingerprinting unavailable: caller stores flat
       break;
     }
@@ -1430,16 +1476,14 @@ bool StorageServer::StoreChunkedFromTmp(const std::string& tmp_path, int spi,
   close(fd);
   std::string err;
   if (!ok || !WriteRecipeFile(rcp_path, recipe, &err)) {
-    if (!ok) {
-      // Roll back references taken so far; untouched chunks stay for
-      // other recipes, newly-written orphans fall to the startup GC.
-      cs->UnrefAll(recipe);
-    } else {
-      FDFS_LOG_ERROR("recipe write: %s", err.c_str());
-      cs->UnrefAll(recipe);
-    }
+    if (ok) FDFS_LOG_ERROR("recipe write: %s", err.c_str());
+    // Roll back references taken so far; untouched chunks stay for
+    // other recipes, newly-written orphans fall to the startup GC.
+    cs->UnrefAll(recipe);
+    plugin->AbortChunked(session);
     return false;
   }
+  plugin->CommitChunked(session, file_ref);
   return true;
 }
 

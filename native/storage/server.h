@@ -209,7 +209,14 @@ class StorageServer {
   // stores the file flat (fingerprinting unavailable or IO error).
   bool StoreChunkedFromTmp(const std::string& tmp_path, int spi,
                            int64_t size, const std::string& rcp_path,
+                           const std::string& file_ref,
                            int64_t* saved_bytes, int64_t* chunk_hits);
+  // Same, against an explicit plugin (the recovery thread uses its own
+  // instance — the plugins are not thread-safe, the ChunkStore is).
+  bool ChunkedStoreWith(DedupPlugin* plugin, const std::string& tmp_path,
+                        int spi, int64_t size, const std::string& rcp_path,
+                        const std::string& file_ref, int64_t* saved_bytes,
+                        int64_t* chunk_hits);
   // Open the logical content at `local`: a plain fd, or a recipe
   // materialized into an unlinked temp file.  -1 when missing.
   int OpenLogical(const std::string& local, int64_t* size);
@@ -224,6 +231,7 @@ class StorageServer {
   StoreManager store_;
   BinlogWriter binlog_;
   std::unique_ptr<DedupPlugin> dedup_;
+  std::unique_ptr<DedupPlugin> recovery_dedup_;  // recovery-thread instance
   // One content-addressed chunk store per store path (chunk-level dedup).
   std::vector<std::unique_ptr<ChunkStore>> chunk_stores_;
   std::unique_ptr<TrackerReporter> reporter_;

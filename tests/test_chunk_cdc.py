@@ -1,0 +1,115 @@
+"""Cross-language CDC cut-point equality.
+
+Every node in a cluster — the C++ daemon's serial chunker
+(``native/common/cdc.cc``, built from the generated gear table), the
+streaming ``GearChunker`` it uses segment-by-segment on the upload path,
+and the Python/TPU position-parallel chunker
+(``fastdfs_tpu/ops/gear_cdc.py``) — must produce IDENTICAL cut-points,
+or chunk-level dedup silently degrades to nothing cluster-wide.  This
+file pins that property on random and adversarial buffers, and keeps
+the generated C++ header in lockstep with the Python source of truth
+(``native/gen_gear.py`` regen + diff).
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from fastdfs_tpu.ops.gear_cdc import (DEFAULT_AVG_BITS, DEFAULT_MAX_SIZE,
+                                      DEFAULT_MIN_SIZE, WINDOW, chunk_stream,
+                                      chunk_stream_ref)
+
+from harness import ensure_native_built  # noqa: E402  (tests dir on sys.path)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CODEC = os.path.join(REPO, "native", "build", "fdfs_codec")
+
+GEOM = (DEFAULT_MIN_SIZE, DEFAULT_AVG_BITS, DEFAULT_MAX_SIZE)
+SMALL_GEOM = (64, 6, 1024)  # dense cuts: exercises min/max clamps hard
+
+
+def _cpp_cuts(data: bytes, geom, seg: int | None = None) -> list[int]:
+    ensure_native_built()
+    args = [CODEC, "cdc", str(geom[0]), str(geom[1]), str(geom[2])]
+    if seg is not None:
+        args.append(str(seg))
+    out = subprocess.run(args, input=data, stdout=subprocess.PIPE,
+                         check=True).stdout
+    return [int(line) for line in out.split() if line.strip()]
+
+
+def _buffers():
+    rng = np.random.RandomState(42)
+    yield "random_200k", rng.randint(0, 256, 200_000, dtype=np.uint8).tobytes()
+    yield "zeros", bytes(150_000)
+    yield "ones", b"\xff" * 100_000
+    yield "periodic", (b"abcdefgh" * 20_000)
+    yield "ramp", (np.arange(120_000) % 256).astype(np.uint8).tobytes()
+    text = (b"the quick brown fox jumps over the lazy dog. " * 3000)
+    yield "text", text
+    # hostile: random with embedded long runs (forces max_size cuts next
+    # to content cuts)
+    hostile = bytearray(rng.randint(0, 256, 180_000, dtype=np.uint8).tobytes())
+    hostile[30_000:90_000] = b"\x00" * 60_000
+    yield "runs", bytes(hostile)
+    yield "tiny", b"x" * (WINDOW + 3)
+    yield "empty", b""
+
+
+@pytest.mark.parametrize("name,data", list(_buffers()),
+                         ids=[n for n, _ in _buffers()])
+def test_python_parallel_matches_serial_reference(name, data):
+    for geom in (GEOM, SMALL_GEOM):
+        if geom[0] < WINDOW:
+            continue
+        assert chunk_stream(data, *geom) == chunk_stream_ref(data, *geom), (
+            name, geom)
+
+
+@pytest.mark.parametrize("name,data", list(_buffers()),
+                         ids=[n for n, _ in _buffers()])
+def test_cpp_oneshot_matches_python(name, data):
+    cuts_py = chunk_stream_ref(data, *GEOM)
+    assert _cpp_cuts(data, GEOM) == cuts_py, name
+
+
+@pytest.mark.parametrize("seg", [1 << 12, 1 << 16, 100_001])
+def test_cpp_streaming_chunker_matches_oneshot(seg):
+    # The daemon chunks uploads segment-by-segment (GearChunker); feeding
+    # arbitrary segment sizes must not move any cut-point.
+    rng = np.random.RandomState(7)
+    data = rng.randint(0, 256, 300_000, dtype=np.uint8).tobytes()
+    one_shot = _cpp_cuts(data, GEOM)
+    assert _cpp_cuts(data, GEOM, seg=seg) == one_shot
+    assert one_shot == chunk_stream_ref(data, *GEOM)
+
+
+def test_cut_geometry_invariants():
+    rng = np.random.RandomState(9)
+    data = rng.randint(0, 256, 500_000, dtype=np.uint8).tobytes()
+    cuts = chunk_stream_ref(data, *GEOM)
+    assert cuts[-1] == len(data)
+    last = 0
+    for c in cuts:
+        ln = c - last
+        assert 0 < ln <= DEFAULT_MAX_SIZE
+        # every chunk except possibly the final one respects min_size
+        if c != len(data):
+            assert ln >= DEFAULT_MIN_SIZE
+        last = c
+
+
+def test_generated_gear_header_is_current():
+    # native/common/gear_gen.h is generated from the Python gear table;
+    # a drifted checkin would silently split the cluster's cut-points.
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "gen_gear", os.path.join(REPO, "native", "gen_gear.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    with open(os.path.join(REPO, "native", "common", "gear_gen.h")) as fh:
+        assert fh.read() == mod.generate(), (
+            "native/common/gear_gen.h is stale: rerun native/gen_gear.py")
