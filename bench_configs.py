@@ -1,0 +1,529 @@
+#!/usr/bin/env python
+"""The five graded benchmark configs (BASELINE.json:configs) + the
+recall@1 referee.
+
+One driver, one JSON artifact per config under ``bench_artifacts/``:
+
+  1. single storage node, 256 KB random chunks, exact dedup — through the
+     REAL daemon (tracker + storage subprocesses, dedup_mode=cpu), with
+     the scalar CRC32/SHA1 single-core loop as the CPU baseline column;
+  2. single node, gear rolling-hash CDC over a text corpus — daemon
+     ingest plus isolated chunker rates (C++ serial, Python/TPU parallel);
+  3. 1 tracker + 2-storage group, SHA1 exact dedup over mixed binaries —
+     ingest + full intra-group replication wait;
+  4. MinHash near-duplicate detection on synthetic web-crawl HTML
+     (shingle 5) — **the recall referee**: the accelerated path's top-1
+     near-dup for every query is compared against the CPU reference
+     pipeline's top-1 (target recall@1 >= 0.98, BASELINE.json:north_star);
+  5. 4-node storage group analogue: the distributed ingest step (dp=4
+     over a virtual 8-device mesh) with cross-node digest all-gather +
+     sharded near-dup query + pmax reduction.
+
+Sizes: the nominal corpus sizes in BASELINE.json (1/10/50/100/500 GB)
+target a production cluster; this harness runs on one machine, so each
+config takes ``--scale`` (default well under the nominal size, recorded
+in the artifact as scaled_bytes vs nominal_bytes) and ``--full`` restores
+the nominal size.  Throughput numbers are steady-state rates, so they
+transfer across scale; dedup ratios are properties of the generator at
+any size.
+
+Run:  python bench_configs.py [--config N] [--scale F] [--out DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import zlib
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+
+NOMINAL = {1: 1 << 30, 2: 10 << 30, 3: 50 << 30, 4: 100 << 30,
+           5: 500 << 30}
+DEFAULT_SCALE = {1: 0.25, 2: 1 / 32.0, 3: 1 / 64.0, 4: 1 / 400.0,
+                 5: 1 / 2000.0}
+
+
+def emit(out_dir: str, config: int, payload: dict) -> None:
+    payload = {"config": config, **payload}
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"config{config}.json")
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({"config": config,
+                      **{k: payload[k] for k in payload
+                         if isinstance(payload[k], (int, float, str, bool))}}))
+
+
+def _upload_retry(cli, data, timeout=25.0, **kw):
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return cli.upload_buffer(data, **kw)
+        except Exception:
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.5)
+
+
+def _cluster(tmp, n_storages=1, dedup_mode="cpu"):
+    from harness import free_port, start_storage, start_tracker
+
+    from fastdfs_tpu.client.client import FdfsClient
+
+    tr = start_tracker(os.path.join(tmp, "tr"))
+    sts = []
+    for i in range(n_storages):
+        ip = "127.0.0.1" if n_storages == 1 else f"127.0.0.{60 + i}"
+        sts.append(start_storage(os.path.join(tmp, f"st{i}"),
+                                 port=free_port(), ip=ip,
+                                 trackers=[f"127.0.0.1:{tr.port}"],
+                                 dedup_mode=dedup_mode, extra=HB))
+    cli = FdfsClient([f"127.0.0.1:{tr.port}"])
+    return tr, sts, cli
+
+
+def _stop(tr, sts):
+    for s in sts:
+        s.stop()
+    tr.stop()
+
+
+def _storage_rows(cli):
+    return cli._tracker().list_storages("group1")
+
+
+# ---------------------------------------------------------------------------
+
+def config1(out_dir: str, scale: float) -> None:
+    """256 KB random chunks, exact dedup, through the real daemon."""
+    total = int(NOMINAL[1] * scale)
+    piece = 256 << 10
+    n = max(total // piece, 8)
+    rng = np.random.RandomState(1)
+    uniques = [rng.randint(0, 256, piece, dtype=np.uint8).tobytes()
+               for _ in range(max(n // 2, 1))]
+
+    # CPU baseline: the reference's scalar per-byte loops, one core.
+    sample = b"".join(uniques[:min(64, len(uniques))])
+    t0 = time.perf_counter()
+    zlib.crc32(sample)
+    crc_gbps = len(sample) / (time.perf_counter() - t0) / 1e9
+    t0 = time.perf_counter()
+    hashlib.sha1(sample)
+    sha_gbps = len(sample) / (time.perf_counter() - t0) / 1e9
+
+    tmp = tempfile.mkdtemp(prefix="bench_c1_")
+    tr, sts, cli = _cluster(tmp)
+    try:
+        _upload_retry(cli, uniques[0], ext="bin")  # wait-in
+        t0 = time.perf_counter()
+        sent = 0
+        i = 0
+        while sent < total:
+            cli.upload_buffer(uniques[i % len(uniques)], ext="bin")
+            sent += piece
+            i += 1
+        dt = time.perf_counter() - t0
+        rows = _storage_rows(cli)
+        emit(out_dir, 1, {
+            "description": "single node, 256KB random chunks, exact dedup",
+            "nominal_bytes": NOMINAL[1], "scaled_bytes": total,
+            "uploads": i, "seconds": round(dt, 3),
+            "daemon_ingest_GBps": round(sent / dt / 1e9, 4),
+            "uploads_per_sec": round(i / dt, 1),
+            "cpu_crc32_GBps": round(crc_gbps, 3),
+            "cpu_sha1_GBps": round(sha_gbps, 3),
+            "dedup_bytes_saved": int(rows[0].get("dedup_bytes_saved", 0))
+            if rows else 0,
+        })
+    finally:
+        _stop(tr, sts)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _text_corpus(total: int, seed=2) -> list[bytes]:
+    """Web-text-like corpus with realistic cross-document repetition:
+    fresh prose mixed with SHARED SECTIONS (boilerplate, quoted/syndicated
+    passages) that recur across documents — the structure CDC dedup
+    exists to exploit (sentence-level repetition alone never survives
+    ~8 KB chunking)."""
+    rng = random.Random(seed)
+    words = [f"w{j}" for j in range(5000)]
+
+    def prose(n_bytes: int) -> bytes:
+        out = bytearray()
+        while len(out) < n_bytes:
+            out += (" ".join(rng.choices(words, k=rng.randint(6, 18)))
+                    + ". ").encode()
+        return bytes(out)
+
+    shared_sections = [prose(rng.randint(32 << 10, 128 << 10))
+                       for _ in range(24)]
+    docs = []
+    made = 0
+    while made < total:
+        doc = bytearray()
+        target = rng.randint(1 << 20, 8 << 20)
+        while len(doc) < target:
+            if rng.random() < 0.5:
+                doc += rng.choice(shared_sections)
+            else:
+                doc += prose(rng.randint(16 << 10, 64 << 10))
+        docs.append(bytes(doc))
+        made += len(doc)
+    return docs
+
+
+def config2(out_dir: str, scale: float) -> None:
+    """Gear CDC on a text corpus: daemon ingest + isolated chunker rates."""
+    from fastdfs_tpu.ops.gear_cdc import chunk_stream_ref
+
+    total = int(NOMINAL[2] * scale)
+    docs = _text_corpus(total)
+
+    # isolated chunkers on one doc
+    sample = docs[0]
+    t0 = time.perf_counter()
+    cuts = chunk_stream_ref(sample)
+    py_serial_gbps = len(sample) / (time.perf_counter() - t0) / 1e9
+    codec = os.path.join(REPO, "native", "build", "fdfs_codec")
+    cpp_gbps = None
+    if os.path.exists(codec):
+        t0 = time.perf_counter()
+        subprocess.run([codec, "cdc", "2048", "13", "65536"], input=sample,
+                       stdout=subprocess.DEVNULL, check=True)
+        cpp_gbps = len(sample) / (time.perf_counter() - t0) / 1e9
+
+    tmp = tempfile.mkdtemp(prefix="bench_c2_")
+    tr, sts, cli = _cluster(tmp)
+    try:
+        _upload_retry(cli, docs[0][:65536], ext="txt")
+        t0 = time.perf_counter()
+        sent = 0
+        for d in docs:
+            cli.upload_buffer(d, ext="txt")
+            sent += len(d)
+        dt = time.perf_counter() - t0
+        rows = _storage_rows(cli)
+        saved = int(rows[0].get("dedup_bytes_saved", 0)) if rows else 0
+        emit(out_dir, 2, {
+            "description": "single node, gear CDC on text corpus",
+            "nominal_bytes": NOMINAL[2], "scaled_bytes": sent,
+            "docs": len(docs), "chunks_sample": len(cuts),
+            "seconds": round(dt, 3),
+            "daemon_ingest_GBps": round(sent / dt / 1e9, 4),
+            "chunker_cpp_GBps": round(cpp_gbps, 3) if cpp_gbps else None,
+            "chunker_py_serial_GBps": round(py_serial_gbps, 4),
+            "dedup_bytes_saved": saved,
+            "dedup_ratio": round(saved / sent, 4) if sent else 0.0,
+        })
+    finally:
+        _stop(tr, sts)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _mixed_binaries(total: int, seed=3) -> list[bytes]:
+    """Mixed binaries: random payloads, zero runs, and shared library-like
+    blocks reused across files (realistic exact-dedup bait)."""
+    rng = np.random.RandomState(seed)
+    shared_blocks = [rng.randint(0, 256, 1 << 18, dtype=np.uint8).tobytes()
+                     for _ in range(16)]
+    files = []
+    made = 0
+    while made < total:
+        parts = []
+        target = int(rng.randint(1 << 20, 4 << 20))
+        size = 0
+        while size < target:
+            kind = rng.randint(4)
+            if kind == 0:
+                b = shared_blocks[rng.randint(len(shared_blocks))]
+            elif kind == 1:
+                b = bytes(1 << 17)
+            else:
+                b = rng.randint(0, 256, 1 << 17, dtype=np.uint8).tobytes()
+            parts.append(b)
+            size += len(b)
+        files.append(b"".join(parts))
+        made += size
+    return files
+
+
+def config3(out_dir: str, scale: float) -> None:
+    """2-storage group: exact dedup + full intra-group replication."""
+    total = int(NOMINAL[3] * scale)
+    files = _mixed_binaries(total)
+
+    tmp = tempfile.mkdtemp(prefix="bench_c3_")
+    tr, sts, cli = _cluster(tmp, n_storages=2)
+    try:
+        t = cli._tracker()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            groups = t.list_groups()
+            if groups and groups[0]["active"] == 2:
+                break
+            time.sleep(0.5)
+        t0 = time.perf_counter()
+        fids = []
+        sent = 0
+        for f in files:
+            fids.append(cli.upload_buffer(f, ext="bin"))
+            sent += len(f)
+        ingest_dt = time.perf_counter() - t0
+        # wait for full replication (2 replicas per file)
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if all(len(t.query_fetch_all(fid)) == 2 for fid in fids):
+                break
+            time.sleep(0.5)
+        repl_dt = time.perf_counter() - t0
+        rows = _storage_rows(cli)
+        emit(out_dir, 3, {
+            "description": "1 tracker + 2 storages, SHA1 exact dedup, "
+                           "mixed binaries, full replication",
+            "nominal_bytes": NOMINAL[3], "scaled_bytes": sent,
+            "files": len(files),
+            "ingest_seconds": round(ingest_dt, 3),
+            "ingest_GBps": round(sent / ingest_dt / 1e9, 4),
+            "replicated_seconds": round(repl_dt, 3),
+            "replicated_GBps": round(2 * sent / repl_dt / 1e9, 4),
+            "dedup_bytes_saved_per_node": [
+                int(r.get("dedup_bytes_saved", 0)) for r in rows],
+        })
+    finally:
+        _stop(tr, sts)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _html_corpus(total: int, seed=4):
+    """Synthetic web-crawl: base pages + near-duplicate variants (small
+    in-place edits), the workload MinHash near-dup detection exists for.
+    Returns (docs, lens, ground_truth) with ground_truth[i] = base index
+    of variant i (or -1 for bases)."""
+    rng = random.Random(seed)
+    words = [f"tok{j}" for j in range(8000)]
+    L = 64 << 10
+    n_docs = max(total // L, 16)
+    n_base = max(n_docs // 4, 4)
+    docs = np.zeros((n_docs, L), dtype=np.uint8)
+    truth = np.full(n_docs, -1, dtype=np.int64)
+
+    def page(body: str) -> bytes:
+        html = (f"<html><head><title>p</title></head><body>{body}"
+                "</body></html>").encode()
+        return (html + b" " * L)[:L]
+
+    nprng = np.random.RandomState(seed)
+    for b in range(n_base):
+        body = " ".join(rng.choices(words, k=L // 8))
+        docs[b] = np.frombuffer(page(body), dtype=np.uint8)
+    for i in range(n_base, n_docs):
+        b = rng.randrange(n_base)
+        row = docs[b].copy()
+        # near-dup variant: ~0.5% of the page overwritten in short
+        # in-place spans (typo/edit model)
+        for _ in range(max(L // (200 * 16), 1)):
+            p = nprng.randint(0, L - 16)
+            row[p:p + 16] = nprng.randint(97, 123, 16, dtype=np.uint8)
+        docs[i] = row
+        truth[i] = b
+    lens = np.full(n_docs, L, dtype=np.int32)
+    return docs, lens, truth
+
+
+def config4(out_dir: str, scale: float) -> None:
+    """MinHash near-dup on HTML — the recall@1 referee (TPU vs CPU)."""
+    import jax
+
+    from fastdfs_tpu.dedup.index import MinHashLSHIndex
+    from fastdfs_tpu.ops.minhash import minhash_batch
+    from fastdfs_tpu.ops.streaming import stream_batches
+
+    total = int(NOMINAL[4] * scale)
+    docs, lens, truth = _html_corpus(total)
+    n_docs = len(docs)
+    on_tpu = jax.default_backend() == "tpu"
+
+    # accelerated path: Pallas kernels fed by double-buffered host→device
+    # streaming (ops/streaming.py)
+    if on_tpu:
+        from fastdfs_tpu.ops.pallas_minhash import minhash_batch_pallas
+        step = jax.jit(lambda c, ln: minhash_batch_pallas(c, ln))
+    else:
+        step = jax.jit(lambda c, ln: minhash_batch(c, ln))
+    B = 256
+    batches = [(docs[i:i + B], lens[i:i + B]) for i in range(0, n_docs, B)]
+    t0 = time.perf_counter()
+    sigs_acc = np.concatenate(list(stream_batches(iter(batches), step,
+                                                  depth=3)))
+    acc_dt = time.perf_counter() - t0
+
+    # device-resident rate (isolates the kernels from the host link —
+    # on this machine the TPU sits behind a ~27 MB/s tunnel, so the
+    # streamed figure above is a property of the link, not the chip;
+    # see tools/PROFILE_r03.md)
+    resident_gbps = None
+    if on_tpu:
+        import jax as _jax
+        db, dl = _jax.device_put(batches[0][0]), _jax.device_put(batches[0][1])
+        _jax.block_until_ready((db, dl))
+        _jax.device_get(step(db, dl))
+        t0 = time.perf_counter()
+        K = 8
+        _jax.device_get([step(db, dl) for _ in range(K)])
+        resident_gbps = K * batches[0][0].size / (time.perf_counter() - t0) / 1e9
+
+    # CPU reference pipeline (the referee's ground truth) — forced onto
+    # the host backend so it is an independent run even on a TPU process
+    cpu_dev = jax.local_devices(backend="cpu")[0]
+    t0 = time.perf_counter()
+    with jax.default_device(cpu_dev):
+        sigs_cpu = np.concatenate(
+            [np.asarray(minhash_batch(b, ln)) for b, ln in batches])
+    cpu_dt = time.perf_counter() - t0
+
+    def top1(sigs):
+        """index of each variant's best match among the base pages."""
+        idx = MinHashLSHIndex(64, 16)
+        n_base = int((truth == -1).sum())
+        for b in range(n_base):
+            idx.add(sigs[b], b)
+        out = {}
+        for q in range(n_base, n_docs):
+            got = idx.query(sigs[q], top_k=1, min_similarity=0.0)
+            out[q] = got[0][0] if got else None
+        return out
+
+    # index scoring is thousands of tiny ops — keep them off the (remote)
+    # accelerator, where per-dispatch latency would dominate
+    with jax.default_device(cpu_dev):
+        acc_top, cpu_top = top1(sigs_acc), top1(sigs_cpu)
+    queries = [q for q in cpu_top]
+    agree = sum(1 for q in queries if acc_top[q] == cpu_top[q])
+    recall_vs_cpu = agree / len(queries) if queries else 1.0
+    correct = sum(1 for q in queries if cpu_top[q] == truth[q])
+    emit(out_dir, 4, {
+        "description": "MinHash near-dup on synthetic web-crawl HTML, "
+                       "shingle 5 — recall@1 referee",
+        "nominal_bytes": NOMINAL[4], "scaled_bytes": int(docs.size),
+        "docs": n_docs, "queries": len(queries),
+        "backend": jax.default_backend(),
+        "bitexact_signatures": bool(np.array_equal(sigs_acc, sigs_cpu)),
+        "recall_at_1_vs_cpu_baseline": round(recall_vs_cpu, 4),
+        "recall_target": 0.98,
+        "recall_pass": recall_vs_cpu >= 0.98,
+        "cpu_reference_top1_accuracy_vs_truth": round(
+            correct / len(queries), 4) if queries else None,
+        "accelerated_sig_GBps_streamed": round(docs.size / acc_dt / 1e9, 4),
+        "accelerated_sig_GBps_resident": round(resident_gbps, 4)
+        if resident_gbps else None,
+        "cpu_sig_GBps": round(docs.size / cpu_dt / 1e9, 4),
+    })
+
+
+def config5(out_dir: str, scale: float) -> None:
+    """4-node-group analogue on the virtual mesh: distributed ingest step
+    with digest all-gather + sharded index query + pmax."""
+    if os.environ.get("_BENCH_C5_CHILD") != "1":
+        # needs a fresh process: the mesh must be CPU devices, and jax may
+        # already be initialized on the TPU backend in this one
+        env = dict(os.environ)
+        env["_BENCH_C5_CHILD"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8").strip()
+        subprocess.run([sys.executable, os.path.abspath(__file__),
+                        "--config", "5", "--scale", str(scale),
+                        "--out", out_dir], check=True, env=env, cwd=REPO)
+        return
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/jax_cache_fastdfs_c5")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    from fastdfs_tpu.parallel import distributed_ingest_step, make_mesh
+
+    # The virtual mesh measures SCALING STRUCTURE (shardings compile and
+    # the collectives run), not kernel speed — 8 emulated devices share
+    # this machine's one core, so shapes are kept small (the XLA-CPU
+    # compile of the sharded SHA1 graph grows brutally with row count)
+    # and the byte count is what those iterations actually processed.
+    mesh = make_mesh(8)  # (dp=2,sp=2,tp=2); dp x sp = 4-way node analogue
+    rng = np.random.RandomState(5)
+    N, L, M = 32, 2 << 10, 256
+    stream = rng.randint(0, 256, (8, mesh.shape["sp"], 8192), np.uint8)
+    index_sigs = rng.randint(0, 2 ** 32, (M, 64), np.uint64).astype(np.uint32)
+
+    chunks = rng.randint(0, 256, (N, L), np.uint8)
+    lens = np.full(N, L, np.int32)
+    # warm/compile
+    out = distributed_ingest_step(mesh, stream, chunks, lens, index_sigs)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    done = 0
+    it = 0
+    while it < 16:
+        out = distributed_ingest_step(mesh, stream, chunks, lens, index_sigs)
+        jax.block_until_ready(out)
+        done += N * L + stream.size
+        it += 1
+    dt = time.perf_counter() - t0
+    cand, digests, sigs, best = (np.asarray(x) for x in out)
+    emit(out_dir, 5, {
+        "description": "4-node analogue: dp/sp/tp mesh ingest step with "
+                       "digest all-gather + sharded near-dup query + pmax",
+        "nominal_bytes": NOMINAL[5], "scaled_bytes": done,
+        "mesh": dict(mesh.shape), "iterations": it,
+        "seconds": round(dt, 3),
+        "aggregate_GBps": round(done / dt / 1e9, 6),
+        "steps_per_sec": round(it / dt, 3),
+        "note": "8 emulated devices share one physical core; this config "
+                "validates that the multi-chip shardings compile and the "
+                "collectives (digest all-gather, tp sig all-gather, dp "
+                "pmax) produce correct shapes — absolute rate is not "
+                "meaningful under emulation",
+        "digests_shape": list(digests.shape),
+        "sigs_shape": list(sigs.shape),
+        "best_sim_finite": bool(np.isfinite(best).all()),
+    })
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=0,
+                    help="which config (1-5); 0 = all")
+    ap.add_argument("--scale", type=float, default=None,
+                    help="fraction of the nominal corpus size")
+    ap.add_argument("--full", action="store_true",
+                    help="run the nominal (BASELINE.json) sizes")
+    ap.add_argument("--out", default=os.path.join(REPO, "bench_artifacts"))
+    args = ap.parse_args()
+
+    fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+    which = [args.config] if args.config else [1, 2, 3, 4, 5]
+    for c in which:
+        scale = 1.0 if args.full else (
+            args.scale if args.scale is not None else DEFAULT_SCALE[c])
+        fns[c](args.out, scale)
+
+
+if __name__ == "__main__":
+    main()

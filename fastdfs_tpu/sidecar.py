@@ -121,10 +121,11 @@ class DedupSidecar:
             try:
                 self.engine = DedupEngine.load(exact_p, near_p,
                                                self.engine.config)
-            except ValueError as e:
-                # A stale-spec near-dup snapshot must not brick the
-                # sidecar (which would fail-open EVERY upload to flat
-                # storage): keep the exact index, restart the near index.
+            except Exception as e:
+                # A stale-spec, truncated, or otherwise unreadable
+                # snapshot must not brick the sidecar (which would
+                # fail-open EVERY upload to flat storage): keep whatever
+                # exact state loads, restart the near index.
                 print(f"dedup sidecar: dropping near-dup snapshot ({e}); "
                       "exact dedup state retained", flush=True)
                 from fastdfs_tpu.dedup.index import ExactDigestIndex

@@ -438,9 +438,11 @@ bool RecoveryManager::StoreRecovered(const std::string& remote,
   // Dedup parity with the upload/sync paths: chunk-eligible recovered
   // files go through the chunk store (recipe + content-addressed chunks)
   // so a rebuilt node deduplicates like its peers; failure of any kind
-  // falls back to the flat copy.
+  // falls back to the flat copy.  Appenders stay flat everywhere
+  // (mutable: later APPEND/MODIFY ops open the flat file in place).
   struct stat st;
   if (chunked_store_ && chunk_threshold_ > 0 &&
+      !(parts.has_value() && parts->appender) &&
       stat(tmp_path.c_str(), &st) == 0 && st.st_size >= chunk_threshold_) {
     if (chunked_store_(tmp_path, spi, st.st_size, remote)) {
       unlink(tmp_path.c_str());

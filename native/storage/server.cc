@@ -861,8 +861,11 @@ void StorageServer::OnFileComplete(Conn* c) {
     StoreManager::EnsureParentDirs(local);
     // Replicas dedup too: chunk-eligible synced files go through the
     // chunk store (same cut-points cluster-wide), others stay flat.
+    // Appenders stay flat everywhere (mutable: later SYNC_APPEND/MODIFY
+    // ops open the flat file in place — a recipe would break them).
     struct stat st;
-    if (stat(c->tmp_path.c_str(), &st) == 0 && ChunkEligible(st.st_size)) {
+    if (!(tparts.has_value() && tparts->appender) &&
+        stat(c->tmp_path.c_str(), &st) == 0 && ChunkEligible(st.st_size)) {
       int spi = 0;
       sscanf(c->sync_remote.c_str(), "M%02X/", &spi);
       int64_t saved = 0, hits = 0;

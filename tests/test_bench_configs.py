@@ -1,0 +1,27 @@
+"""Smoke the graded benchmark configs at minuscule scale so the driver
+(bench_configs.py) cannot silently rot: config 1 exercises the real
+daemon path, config 4 the recall referee (CPU vs CPU here — the TPU run
+is the checked-in artifact)."""
+
+import json
+import os
+
+import bench_configs as bc
+
+
+def test_config1_smoke(tmp_path):
+    bc.config1(str(tmp_path), scale=0.002)  # ~2 MB, a handful of uploads
+    with open(os.path.join(str(tmp_path), "config1.json")) as fh:
+        art = json.load(fh)
+    assert art["daemon_ingest_GBps"] > 0
+    assert art["uploads"] >= 8
+    assert art["cpu_sha1_GBps"] > 0
+
+
+def test_config4_referee_smoke(tmp_path):
+    bc.config4(str(tmp_path), scale=0.00002)  # ~2 MB of HTML docs
+    with open(os.path.join(str(tmp_path), "config4.json")) as fh:
+        art = json.load(fh)
+    assert art["bitexact_signatures"] is True
+    assert art["recall_at_1_vs_cpu_baseline"] >= 0.98
+    assert art["recall_pass"] is True

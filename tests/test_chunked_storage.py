@@ -467,3 +467,51 @@ def test_sidecar_survives_stale_near_snapshot(tmp_path):
     sc = DedupSidecar(os.path.join(state, "s.sock"), state_dir=state)
     assert len(sc.engine.near) == 0
     assert sc.engine.exact.lookup(b"\x01" * 20) is not None
+
+
+def test_appender_files_stay_flat_on_replica(tmp_path_factory):
+    """Appenders are mutable and must never become recipes — not on the
+    source, not via sync on the replica — or later appends fail there."""
+    from fastdfs_tpu.client import TrackerClient
+    from harness import free_port
+
+    s1_ip, s2_ip = "127.0.0.51", "127.0.0.52"
+    tracker = start_tracker(tmp_path_factory.mktemp("tr"))
+    taddr = f"127.0.0.1:{tracker.port}"
+    s1dir = tmp_path_factory.mktemp("s1")
+    s2dir = tmp_path_factory.mktemp("s2")
+    s1 = start_storage(s1dir, trackers=[taddr], dedup_mode="cpu", extra=HB,
+                       ip=s1_ip)
+    s2 = start_storage(s2dir, port=free_port(), trackers=[taddr],
+                       dedup_mode="cpu", extra=HB, ip=s2_ip)
+    t = TrackerClient("127.0.0.1", tracker.port)
+    cli = FdfsClient([taddr])
+    try:
+        assert _wait(lambda: t.list_groups() and
+                     t.list_groups()[0]["active"] == 2, timeout=25)
+        head = random.Random(13).randbytes(128 << 10)  # >= chunk threshold
+        fid = None
+        deadline = time.time() + 20
+        while fid is None and time.time() < deadline:
+            try:
+                fid = cli.upload_appender_buffer(head, ext="log")
+            except Exception:
+                time.sleep(0.5)
+        tail = b"appended-after-sync" * 100
+        assert _wait(lambda: len(t.query_fetch_all(fid)) == 2, timeout=30)
+        # both nodes hold it FLAT (no recipe), even though it is
+        # chunk-eligible by size
+        for d in (s1dir, s2dir):
+            assert _recipe_for(str(d), fid) is None, str(d)
+            assert _flat_for(str(d), fid) is not None, str(d)
+        cli.append_buffer(fid, tail)
+        # the append replicates and both copies serve the full content
+        from fastdfs_tpu.client import StorageClient
+        for ip, d in ((s1_ip, s1), (s2_ip, s2)):
+            sc = StorageClient(ip, d.port)
+            assert _wait(lambda: sc.download_to_buffer(fid) == head + tail,
+                         timeout=20), ip
+    finally:
+        s2.stop()
+        s1.stop()
+        tracker.stop()
