@@ -13,7 +13,10 @@ import subprocess
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BUILD = os.path.join(REPO, "native", "build")
+# FDFS_NATIVE_BUILD selects an alternate build tree (the sanitizer
+# builds from tools/run_sanitizers.sh use native/build-asan etc.).
+BUILD = os.path.join(REPO, os.environ.get("FDFS_NATIVE_BUILD",
+                                          os.path.join("native", "build")))
 STORAGED = os.path.join(BUILD, "fdfs_storaged")
 TRACKERD = os.path.join(BUILD, "fdfs_trackerd")
 
@@ -22,8 +25,20 @@ def ensure_native_built(targets: tuple[str, ...] = ()) -> None:
     missing = [t for t in (STORAGED, *targets) if not os.path.exists(t)]
     if not missing:
         return
-    subprocess.run(["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD,
-                    "-G", "Ninja"], check=True, capture_output=True)
+    cmake = ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD,
+             "-G", "Ninja"]
+    # An alternate tree implies a sanitizer build (tools/run_sanitizers.sh
+    # naming); configuring it without -DSANITIZE would silently produce
+    # uninstrumented binaries that "pass" the sanitizer suite.
+    base = os.path.basename(BUILD)
+    if base.startswith("build-"):
+        kind = {"asan": "address", "tsan": "thread",
+                "ubsan": "undefined"}.get(base[len("build-"):])
+        if kind is None:
+            raise RuntimeError(
+                f"unknown sanitizer build dir {base!r}: build it explicitly")
+        cmake.append(f"-DSANITIZE={kind}")
+    subprocess.run(cmake, check=True, capture_output=True)
     subprocess.run(["ninja", "-C", BUILD], check=True, capture_output=True)
 
 

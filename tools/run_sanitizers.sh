@@ -1,0 +1,46 @@
+#!/bin/bash
+# Build the native core under ASan and TSan and run the daemon-facing
+# pytest suite against each build (SURVEY.md §5: "ASan/TSan CI targets
+# for the C++ core" — the reference has none; the rebuild's threaded
+# storage daemon needs them).
+#
+# Usage: tools/run_sanitizers.sh [asan|tsan|both] [pytest args...]
+# The harness picks up the instrumented binaries via FDFS_NATIVE_BUILD.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODE="${1:-both}"
+shift || true
+if [ "$#" -gt 0 ]; then
+  PYTEST_ARGS=("$@")
+else
+  PYTEST_ARGS=(tests/test_storage_daemon.py tests/test_tracker_daemon.py
+    tests/test_replication.py tests/test_trunk.py
+    tests/test_chunked_storage.py tests/test_disk_recovery.py
+    tests/test_multi_tracker.py)
+fi
+
+run_one() {
+  local san="$1" dir="native/build-$1"
+  echo "=== $san: configure + build ==="
+  cmake -S native -B "$dir" -G Ninja -DSANITIZE="$2" \
+        -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
+  ninja -C "$dir"
+  echo "=== $san: daemon suite ==="
+  # halt_on_error keeps a failing daemon loud; leak detection stays on
+  # for asan (daemons shut down cleanly in the harness).
+  if [ "$san" = tsan ]; then
+    export TSAN_OPTIONS="halt_on_error=1"
+  else
+    export ASAN_OPTIONS="halt_on_error=1:detect_leaks=1"
+  fi
+  FDFS_NATIVE_BUILD="$dir" python -m pytest "${PYTEST_ARGS[@]}" -x -q
+}
+
+case "$MODE" in
+  asan) run_one asan address ;;
+  tsan) run_one tsan thread ;;
+  both) run_one asan address && run_one tsan thread ;;
+  *) echo "usage: $0 [asan|tsan|both] [pytest args...]" >&2; exit 2 ;;
+esac
+echo "sanitizer suite: PASS ($MODE)"
