@@ -8,6 +8,7 @@
 #include <poll.h>
 #include <string.h>
 #include <sys/epoll.h>
+#include <sys/eventfd.h>
 #include <sys/socket.h>
 #include <sys/time.h>
 #include <unistd.h>
@@ -165,10 +166,51 @@ std::string SockIp(int fd) {
 
 // -- EventLoop ------------------------------------------------------------
 
-EventLoop::EventLoop() { epfd_ = epoll_create1(EPOLL_CLOEXEC); }
+EventLoop::EventLoop() {
+  epfd_ = epoll_create1(EPOLL_CLOEXEC);
+  wake_fd_ = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+  if (wake_fd_ >= 0) {
+    struct epoll_event ev;
+    memset(&ev, 0, sizeof(ev));
+    ev.events = EPOLLIN;
+    ev.data.fd = wake_fd_;
+    epoll_ctl(epfd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+  }
+}
 
 EventLoop::~EventLoop() {
+  if (wake_fd_ >= 0) close(wake_fd_);
   if (epfd_ >= 0) close(epfd_);
+}
+
+void EventLoop::Post(std::function<void()> fn) {
+  {
+    std::lock_guard<std::mutex> lk(post_mu_);
+    posted_.push_back(std::move(fn));
+  }
+  uint64_t one = 1;
+  if (wake_fd_ >= 0) {
+    ssize_t n = write(wake_fd_, &one, sizeof(one));
+    (void)n;  // EAGAIN just means a wakeup is already pending
+  }
+}
+
+void EventLoop::DrainPosted() {
+  if (wake_fd_ >= 0) {
+    uint64_t junk;
+    while (read(wake_fd_, &junk, sizeof(junk)) > 0) {
+    }
+  }
+  for (;;) {
+    std::function<void()> fn;
+    {
+      std::lock_guard<std::mutex> lk(post_mu_);
+      if (posted_.empty()) break;
+      fn = std::move(posted_.front());
+      posted_.pop_front();
+    }
+    fn();
+  }
 }
 
 bool EventLoop::Add(int fd, uint32_t events, FdCallback cb) {
@@ -235,7 +277,7 @@ void EventLoop::FireTimers() {
 void EventLoop::Run() {
   running_ = true;
   std::vector<struct epoll_event> events(256);
-  while (running_) {
+  while (!stop_.load(std::memory_order_acquire)) {
     int n = epoll_wait(epfd_, events.data(), static_cast<int>(events.size()),
                        NextTimeoutMs());
     if (n < 0) {
@@ -243,16 +285,27 @@ void EventLoop::Run() {
       break;
     }
     for (int i = 0; i < n; ++i) {
+      if (events[i].data.fd == wake_fd_) continue;  // drained below
       auto it = fd_cbs_.find(events[i].data.fd);
       if (it != fd_cbs_.end()) {
         FdCallback cb = it->second;  // copy: cb may Del() the fd
         cb(events[i].events);
       }
     }
+    DrainPosted();
     FireTimers();
   }
+  DrainPosted();  // don't strand posted work at shutdown
+  running_ = false;
 }
 
-void EventLoop::Stop() { running_ = false; }
+void EventLoop::Stop() {
+  stop_.store(true, std::memory_order_release);
+  uint64_t one = 1;
+  if (wake_fd_ >= 0) {
+    ssize_t n = write(wake_fd_, &one, sizeof(one));  // wake epoll_wait
+    (void)n;
+  }
+}
 
 }  // namespace fdfs

@@ -8,9 +8,12 @@
 // reference's split.
 #pragma once
 
+#include <atomic>
 #include <cstdint>
+#include <deque>
 #include <functional>
 #include <map>
+#include <mutex>
 #include <string>
 #include <unordered_map>
 
@@ -40,9 +43,17 @@ class EventLoop {
   EventLoop(const EventLoop&) = delete;
   EventLoop& operator=(const EventLoop&) = delete;
 
+  // Add/Mod/Del must run on the loop's own thread; Post is the one
+  // thread-safe entry (reference: the pipe-notify handoff between the
+  // accept thread and the nio work threads in storage/storage_nio.c:
+  // storage_recv_notify_read()).
   bool Add(int fd, uint32_t events, FdCallback cb);
   bool Mod(int fd, uint32_t events);
   void Del(int fd);
+
+  // Run `fn` on the loop thread (wakes the loop; callable from any
+  // thread, including before Run()).  Also makes Stop() cross-thread.
+  void Post(std::function<void()> fn);
 
   // Repeating timer (sched_thread.c analogue: binlog flush, beat, stat
   // write all hang off these).  Returns a timer id.
@@ -55,10 +66,17 @@ class EventLoop {
 
  private:
   void FireTimers();
+  void DrainPosted();
   int NextTimeoutMs() const;
 
   int epfd_;
-  bool running_ = false;
+  int wake_fd_ = -1;  // eventfd: Post()/cross-thread Stop() wakeups
+  std::mutex post_mu_;
+  std::deque<std::function<void()>> posted_;
+  std::atomic<bool> running_{false};
+  // Separate latch so a Stop() that lands BEFORE the loop thread reaches
+  // Run() still wins (Run must not overwrite it).
+  std::atomic<bool> stop_{false};
   std::unordered_map<int, FdCallback> fd_cbs_;
   struct Timer {
     int64_t deadline_ms;

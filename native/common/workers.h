@@ -1,0 +1,80 @@
+// Blocking-queue worker pool — the disk-IO thread analogue.
+//
+// Reference: storage/storage_dio.c — dedicated reader/writer threads per
+// store path pull tasks from blocking queues (dio_thread_entrance), so
+// slow file IO never stalls the nio event loops.  Here the storage
+// server runs one pool per store path for chunk-store writes,
+// fingerprint RPCs, trunk allocation RPCs, and deletes; completions are
+// posted back to the owning connection's EventLoop.
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace fdfs {
+
+class WorkerPool {
+ public:
+  explicit WorkerPool(int threads) {
+    if (threads < 1) threads = 1;
+    for (int i = 0; i < threads; ++i)
+      threads_.emplace_back([this] { Main(); });
+  }
+
+  ~WorkerPool() { Stop(); }
+
+  void Submit(std::function<void()> fn) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (stopping_) return;
+      queue_.push_back(std::move(fn));
+    }
+    cv_.notify_one();
+  }
+
+  // Drain-then-join: queued tasks still run (a queued chunk write must
+  // finish or roll back before the process exits).
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (stopping_) return;
+      stopping_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : threads_)
+      if (t.joinable()) t.join();
+    threads_.clear();
+  }
+
+  size_t pending() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return queue_.size();
+  }
+
+ private:
+  void Main() {
+    for (;;) {
+      std::function<void()> fn;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return stopping_ || !queue_.empty(); });
+        if (queue_.empty()) return;  // stopping and drained
+        fn = std::move(queue_.front());
+        queue_.pop_front();
+      }
+      fn();
+    }
+  }
+
+  mutable std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::function<void()>> queue_;
+  std::vector<std::thread> threads_;
+  bool stopping_ = false;
+};
+
+}  // namespace fdfs

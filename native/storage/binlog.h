@@ -9,6 +9,7 @@
 #pragma once
 
 #include <atomic>
+#include <mutex>
 #include <cstdint>
 #include <optional>
 #include <string>
@@ -61,6 +62,7 @@ class BinlogWriter {
   int64_t offset_ = 0;
   int fd_ = -1;
   std::atomic<int> in_flight_{0};
+  mutable std::mutex mu_;  // appends come from every nio/dio thread
 };
 
 // One-path binlog extraction (FETCH_ONE_PATH_BINLOG 26, the feed for disk

@@ -46,6 +46,13 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
   stat_report_interval_s =
       static_cast<int>(ini.GetSeconds("stat_report_interval", 60));
   sync_interval_ms = static_cast<int>(ini.GetInt("sync_interval_ms", 100));
+  work_threads = static_cast<int>(ini.GetInt("work_threads", work_threads));
+  if (work_threads < 1) work_threads = 1;
+  if (work_threads > 64) work_threads = 64;
+  disk_writer_threads = static_cast<int>(
+      ini.GetInt("disk_writer_threads", disk_writer_threads));
+  if (disk_writer_threads < 1) disk_writer_threads = 1;
+  if (disk_writer_threads > 64) disk_writer_threads = 64;
   dedup_mode = ini.GetStr("dedup_mode", "none");
   if (dedup_mode != "none" && dedup_mode != "cpu" && dedup_mode != "sidecar") {
     *error = "dedup_mode must be none|cpu|sidecar";

@@ -23,6 +23,14 @@ struct StorageConfig {
   int subdir_count_per_path = 256;
   int buff_size = 256 * 1024;      // chunked IO size
   int network_timeout_ms = 30000;
+  // nio work threads (reference storage.conf:work_threads /
+  // storage_nio.c): connections are distributed round-robin over this
+  // many event loops.  1 = everything on the main loop.
+  int work_threads = 4;
+  // dio pool size PER STORE PATH (reference storage.conf:
+  // disk_writer_threads / storage_dio.c): chunk-store writes,
+  // fingerprint RPCs, trunk allocation, and deletes run here.
+  int disk_writer_threads = 2;
   std::vector<std::string> tracker_servers;  // "ip:port"
   int heart_beat_interval_s = 30;
   int stat_report_interval_s = 60;

@@ -24,6 +24,7 @@ CpuDedup::CpuDedup(std::string snapshot_path)
 
 DedupPlugin::Verdict CpuDedup::Judge(const std::string& sha1_hex, int64_t) {
   Verdict v;
+  std::lock_guard<std::mutex> lk(mu_);
   auto it = by_digest_.find(sha1_hex);
   if (it != by_digest_.end()) {
     v.duplicate = true;
@@ -33,11 +34,13 @@ DedupPlugin::Verdict CpuDedup::Judge(const std::string& sha1_hex, int64_t) {
 }
 
 void CpuDedup::Commit(const std::string& sha1_hex, const std::string& file_id) {
+  std::lock_guard<std::mutex> lk(mu_);
   by_digest_.emplace(sha1_hex, file_id);  // first writer wins
   by_file_[file_id] = sha1_hex;
 }
 
 void CpuDedup::Forget(const std::string& file_id) {
+  std::lock_guard<std::mutex> lk(mu_);
   auto it = by_file_.find(file_id);
   if (it == by_file_.end()) return;
   auto dit = by_digest_.find(it->second);
@@ -48,6 +51,7 @@ void CpuDedup::Forget(const std::string& file_id) {
 }
 
 bool CpuDedup::Save() {
+  std::lock_guard<std::mutex> lk(mu_);
   std::string tmp = snapshot_path_ + ".tmp";
   FILE* f = fopen(tmp.c_str(), "w");
   if (f == nullptr) return false;
@@ -116,6 +120,10 @@ bool SidecarDedup::EnsureConnected() {
 
 bool SidecarDedup::Rpc(uint8_t cmd, const std::string& body, std::string* resp,
                        uint8_t* status, int64_t max_resp) {
+  // One request/response at a time on the shared fd; concurrent callers
+  // (nio threads) queue here — the sidecar itself serializes engine work
+  // anyway, so this adds no extra critical path.
+  std::lock_guard<std::mutex> lk(mu_);
   if (!EnsureConnected()) return false;
   // Generous timeout for fingerprint segments (first TPU compile of a new
   // bucket shape can take tens of seconds); everything else is instant.

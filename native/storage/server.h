@@ -16,9 +16,14 @@
 #include <cstdio>
 #include <cstdint>
 #include <memory>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <unordered_set>
+#include <vector>
+
+#include "common/workers.h"
 
 #include "common/bytes.h"
 #include "common/protocol_gen.h"
@@ -89,6 +94,19 @@ class StorageServer {
  private:
   enum class ConnState { kRecvHeader, kRecvFixed, kRecvFile, kSend };
 
+  struct NioThread;  // one epoll loop + its connections (storage_nio.c)
+
+  // Streaming source for recipe (chunked-file) downloads: chunks are read
+  // one at a time as the socket drains, so a multi-GB logical file never
+  // occupies memory or stalls the loop (the reference's dio read loop).
+  struct RecipeStream {
+    Recipe recipe;
+    ChunkStore* cs = nullptr;
+    size_t idx = 0;          // next recipe entry
+    int64_t skip = 0;        // bytes to skip inside entry `idx` (range start)
+    int64_t remaining = 0;   // logical bytes still to send
+  };
+
   struct Conn {
     int fd = -1;
     ConnState state = ConnState::kRecvHeader;
@@ -124,14 +142,32 @@ class StorageServer {
     int send_fd = -1;
     int64_t send_off = 0;
     int64_t send_remaining = 0;
+    std::unique_ptr<RecipeStream> rstream;  // chunked download source
+    // threading
+    NioThread* owner = nullptr;   // the nio loop this conn lives on
+    bool async_pending = false;   // a dio worker owns the request right now
+    bool dead = false;            // closed while async_pending: zombie
     // access log bookkeeping
     int64_t req_start_us = 0;
     std::string peer_ip;
   };
 
+  struct NioThread {
+    std::unique_ptr<EventLoop> loop;
+    std::thread thread;
+    std::unordered_map<int, std::unique_ptr<Conn>> conns;  // loop-thread only
+    std::vector<std::unique_ptr<Conn>> zombies;            // await dio done
+  };
+
   // -- nio ---------------------------------------------------------------
+  EventLoop* ConnLoop(Conn* c) { return c->owner ? c->owner->loop.get() : &loop_; }
+  void AdoptConn(NioThread* t, int fd);   // runs on t's loop thread
+  // Hand the rest of the current request to the store path's dio pool;
+  // `work` runs on a worker (it may build a response via Respond but must
+  // not touch the socket/epoll), then the conn resumes on its loop.
+  void OffloadToDio(Conn* c, int spi, std::function<void()> work);
   void OnAccept(uint32_t events);
-  void OnConnEvent(int fd, uint32_t events);
+  void OnConnEvent(Conn* c, uint32_t events);
   void ReadConn(Conn* c);
   bool WriteConn(Conn* c);          // false => conn closed
   void CloseConn(Conn* c);
@@ -155,6 +191,8 @@ class StorageServer {
   void OnHeaderComplete(Conn* c);
   void OnFixedComplete(Conn* c);
   void OnFileComplete(Conn* c);
+  void SyncCreateComplete(Conn* c);  // replica create (dio worker)
+  void DeleteWork(Conn* c);          // delete body (dio worker)
 
   // -- handlers (storage_service.c analogues) ----------------------------
   bool BeginUpload(Conn* c);        // parse fixed, open tmp file
@@ -237,14 +275,29 @@ class StorageServer {
   std::unique_ptr<TrackerReporter> reporter_;
   std::unique_ptr<SyncManager> sync_;
   std::unique_ptr<RecoveryManager> recovery_;
-  EventLoop loop_;
+  EventLoop loop_;                      // main: accept + timers
   int listen_fd_ = -1;
-  std::unordered_map<int, std::unique_ptr<Conn>> conns_;
+  // nio work threads (storage.conf:work_threads); connections are
+  // assigned round-robin at accept and live on one loop for their
+  // whole lifetime (reference: storage_nio.c per-thread epoll loops).
+  std::vector<std::unique_ptr<NioThread>> nio_;
+  size_t next_nio_ = 0;                 // main-loop only (accept)
+  std::atomic<int64_t> conn_count_{0};
+  // dio pools, one per store path (storage.conf:disk_writer_threads;
+  // reference: storage_dio.c per-path reader/writer queues).
+  std::vector<std::unique_ptr<WorkerPool>> dio_pools_;
+  std::mutex busy_mu_;
   std::unordered_set<std::string> busy_files_;  // remote names being mutated
+  std::mutex log_mu_;                   // access_log_ writes
   StorageStats stats_;
   std::string my_ip_;
 
   // Trunk state (cluster-global params from the tracker; SURVEY §2.3).
+  // Guarded by trunk_mu_: mutated by the main-loop param timer, read by
+  // every nio/dio thread.  Handlers copy the shared_ptr under the lock
+  // and use the allocator outside it (the allocator locks internally);
+  // the timer swaps the pointer, never mutates a live allocator.
+  mutable std::mutex trunk_mu_;
   bool trunk_enabled_ = false;
   int64_t slot_min_size_ = 256;
   int64_t slot_max_size_ = 16 * 1024 * 1024;
@@ -259,7 +312,7 @@ class StorageServer {
   bool held_trunk_role_before_ = false;
   int64_t trunk_regain_not_before_ = 0;
   bool trunk_size_err_logged_ = false;
-  std::unique_ptr<TrunkAllocator> trunk_alloc_;
+  std::shared_ptr<TrunkAllocator> trunk_alloc_;
   FILE* access_log_ = nullptr;
   std::string stat_path_;
 };

@@ -56,9 +56,11 @@ bool StoreManager::Init(const StorageConfig& cfg, std::string* error) {
 }
 
 int StoreManager::PickStorePath() {
-  int i = next_path_;
-  next_path_ = (next_path_ + 1) % static_cast<int>(paths_.size());
-  return i;
+  // Round-robin across nio work threads; wrap with a plain mod (the
+  // counter only feeds distribution, exact fairness does not matter).
+  return static_cast<int>(
+      next_path_.fetch_add(1, std::memory_order_relaxed) %
+      static_cast<uint64_t>(paths_.size()));
 }
 
 std::string StoreManager::NewTmpPath(int spi) {

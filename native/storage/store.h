@@ -45,7 +45,7 @@ class StoreManager {
   int subdir_count_ = 256;
   std::atomic<uint32_t> uniq_{0};
   std::atomic<uint32_t> tmp_seq_{0};
-  int next_path_ = 0;
+  std::atomic<uint64_t> next_path_{0};
   bool any_fresh_ = false;
 };
 

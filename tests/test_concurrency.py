@@ -1,0 +1,147 @@
+"""Data-path concurrency (nio work threads + dio pools + streamed recipe
+downloads — reference storage_nio.c / storage_dio.c).
+
+The round-2 daemon was one epoll thread: a big chunked download
+materialized the whole logical file before its first byte and every
+other connection waited.  These tests pin the fixes: slow multi-MB
+chunked downloads in flight must not stall small uploads, and the
+single-threaded configuration must still work.
+"""
+
+import concurrent.futures
+import random
+import socket
+import struct
+import time
+
+import pytest
+
+from harness import start_storage, start_tracker
+
+from fastdfs_tpu.client.client import FdfsClient
+from fastdfs_tpu.common.protocol import StorageCmd
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+
+
+def _upload_retry(cli, data, timeout=20.0, **kw):
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return cli.upload_buffer(data, **kw)
+        except Exception:
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.5)
+
+
+def _slow_download(addr, fid, expect, pace_s=0.05, chunk=1 << 16):
+    """Trickle-read a download, holding the response stream open for
+    seconds; returns True when the bytes matched."""
+    group, remote = fid.split("/", 1)
+    body = (struct.pack(">qq", 0, 0) +
+            group.encode().ljust(16, b"\x00") + remote.encode())
+    s = socket.create_connection(addr, timeout=30)
+    try:
+        s.sendall(struct.pack(">qBB", len(body),
+                              StorageCmd.DOWNLOAD_FILE, 0) + body)
+        hdr = b""
+        while len(hdr) < 10:
+            got = s.recv(10 - len(hdr))
+            assert got, "EOF in header"
+            hdr += got
+        length, _, status = struct.unpack(">qBB", hdr)
+        assert status == 0, status
+        received = bytearray()
+        while len(received) < length:
+            got = s.recv(min(chunk, length - len(received)))
+            if not got:
+                return False
+            received += got
+            time.sleep(pace_s)  # trickle: keep the stream open
+        return bytes(received) == expect
+    finally:
+        s.close()
+
+
+def test_slow_chunked_download_does_not_block_uploads(tmp_path):
+    tr = start_tracker(str(tmp_path / "tr"))
+    st = start_storage(str(tmp_path / "st"),
+                       trackers=[f"127.0.0.1:{tr.port}"],
+                       dedup_mode="cpu", extra=HB)
+    cli = FdfsClient([f"127.0.0.1:{tr.port}"])
+    try:
+        rng = random.Random(21)
+        big = rng.randbytes(24 << 20)  # chunked (threshold 64 KB)
+        fid_big = _upload_retry(cli, big, ext="bin")
+        addr = ("127.0.0.1", st.port)
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=4) as ex:
+            # three trickle-readers hold chunked downloads open for
+            # several seconds each
+            downloads = [ex.submit(_slow_download, addr, fid_big, big,
+                                   0.01, 1 << 17) for _ in range(3)]
+            time.sleep(0.5)  # ensure the streams are mid-flight
+            # concurrent small uploads must stay fast
+            lat = []
+            for i in range(8):
+                small = rng.randbytes(8 << 10)
+                t0 = time.perf_counter()
+                fid = cli.upload_buffer(small, ext="bin")
+                lat.append(time.perf_counter() - t0)
+                assert cli.download_to_buffer(fid) == small
+            assert all(f.result(timeout=120) for f in downloads)
+        worst = max(lat)
+        assert worst < 2.0, f"small upload stalled {worst:.2f}s behind " \
+                            "an in-flight chunked download"
+    finally:
+        st.stop()
+        tr.stop()
+
+
+@pytest.mark.parametrize("threads", [1, 4])
+def test_work_thread_configs(tmp_path, threads):
+    tr = start_tracker(str(tmp_path / "tr"))
+    st = start_storage(str(tmp_path / "st"),
+                       trackers=[f"127.0.0.1:{tr.port}"],
+                       dedup_mode="cpu",
+                       extra=HB + f"\nwork_threads = {threads}\n"
+                                  "disk_writer_threads = 1\n")
+    cli = FdfsClient([f"127.0.0.1:{tr.port}"])
+    try:
+        rng = random.Random(threads)
+        payloads = [rng.randbytes(200 << 10) for _ in range(4)]
+        fids = [_upload_retry(cli, b, ext="bin") for b in payloads]
+        for fid, b in zip(fids, payloads):
+            assert cli.download_to_buffer(fid) == b
+        cli.delete_file(fids[0])
+        assert cli.download_to_buffer(fids[1]) == payloads[1]
+    finally:
+        st.stop()
+        tr.stop()
+
+
+def test_parallel_uploads_all_land(tmp_path):
+    # many concurrent client connections across the nio threads
+    tr = start_tracker(str(tmp_path / "tr"))
+    st = start_storage(str(tmp_path / "st"),
+                       trackers=[f"127.0.0.1:{tr.port}"],
+                       dedup_mode="cpu", extra=HB)
+    taddr = f"127.0.0.1:{tr.port}"
+    try:
+        _upload_retry(FdfsClient([taddr]), b"warm" * 100, ext="bin")
+        rng = random.Random(33)
+        payloads = [rng.randbytes((64 << 10) + i * 1111) for i in range(12)]
+
+        def one(data):
+            c = FdfsClient([taddr])   # own connection per thread
+            fid = c.upload_buffer(data, ext="bin")
+            return fid, c.download_to_buffer(fid) == data
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=6) as ex:
+            results = list(ex.map(one, payloads))
+        assert all(ok for _, ok in results)
+        assert len({fid for fid, _ in results}) == len(payloads)
+    finally:
+        st.stop()
+        tr.stop()
