@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import random
 
-from fastdfs_tpu.client.conn import StatusError
+from fastdfs_tpu.client.conn import ConnectionPool, ProtocolError, StatusError
 from fastdfs_tpu.client.storage_client import RemoteFileInfo, StorageClient
 from fastdfs_tpu.client.tracker_client import TrackerClient
 from fastdfs_tpu.common.ini_config import IniConfig
@@ -19,19 +19,29 @@ class FdfsClient:
     """Tracker-routed client (reference: storage_upload_by_filename1 flow
     in SURVEY.md §3.1)."""
 
-    def __init__(self, tracker_addrs: list[str] | str, timeout: float = 30.0):
+    def __init__(self, tracker_addrs: list[str] | str, timeout: float = 30.0,
+                 use_pool: bool = True):
         if isinstance(tracker_addrs, str):
             tracker_addrs = [tracker_addrs]
         if not tracker_addrs:
             raise ValueError("need at least one tracker address")
         self.trackers = [_parse_addr(a) for a in tracker_addrs]
         self.timeout = timeout
+        # Pooled, health-checked connections per endpoint (reference:
+        # connection_pool.c / client.conf:use_connection_pool); every
+        # operation borrows and parks instead of reconnecting twice.
+        self.pool = ConnectionPool() if use_pool else None
 
     @classmethod
     def from_conf(cls, conf_path: str) -> "FdfsClient":
         cfg = IniConfig.load(conf_path)
         addrs = cfg.get_all("tracker_server")
-        return cls(addrs, timeout=float(cfg.get_seconds("network_timeout", 30)))
+        return cls(addrs, timeout=float(cfg.get_seconds("network_timeout", 30)),
+                   use_pool=bool(cfg.get_bool("use_connection_pool", True)))
+
+    def close(self) -> None:
+        if self.pool is not None:
+            self.pool.close_all()
 
     def _tracker(self) -> TrackerClient:
         # Random start + failover (reference: tracker_get_connection's
@@ -41,52 +51,78 @@ class FdfsClient:
         last_err: Exception | None = None
         for host, port in addrs:
             try:
+                if self.pool is not None:
+                    conn = self.pool.acquire(host, port, self.timeout)
+                    return TrackerClient(host, port, self.timeout,
+                                         conn=conn, release=self.pool.release)
                 return TrackerClient(host, port, self.timeout)
             except OSError as e:
                 last_err = e
         raise ConnectionError(f"no tracker reachable: {last_err}")
 
+    def _with_tracker(self, fn):
+        """Run ``fn(tracker_client)``; a pooled connection to a
+        silently-dead tracker passes the borrow check and fails only
+        inside the operation, so on transport failure purge that
+        endpoint's idle set and fail over (up to one pass per tracker —
+        the pre-pool behavior, where connect-time errors drove the
+        failover loop)."""
+        attempts = max(len(self.trackers), 1) + 1
+        last: Exception | None = None
+        for _ in range(attempts):
+            t = self._tracker()
+            endpoint = (t.conn.host, t.conn.port)
+            try:
+                with t:
+                    return fn(t)
+            except (OSError, ProtocolError) as e:
+                last = e
+                if self.pool is not None:
+                    self.pool.purge(*endpoint)
+        raise last if last is not None else ConnectionError("no tracker")
+
+    def _storage(self, tgt) -> StorageClient:
+        if self.pool is not None:
+            conn = self.pool.acquire(tgt.ip, tgt.port, self.timeout)
+            return StorageClient(tgt.ip, tgt.port, self.timeout,
+                                 conn=conn, release=self.pool.release)
+        return StorageClient(tgt.ip, tgt.port, self.timeout)
+
     # -- operations --------------------------------------------------------
 
     def upload_buffer(self, data: bytes, ext: str = "",
                       group: str | None = None, appender: bool = False) -> str:
-        with self._tracker() as t:
-            tgt = t.query_store(group)
-        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+        tgt = self._with_tracker(lambda t: t.query_store(group))
+        with self._storage(tgt) as s:
             return s.upload_buffer(data, ext=ext,
                                    store_path_index=tgt.store_path_index,
                                    appender=appender)
 
     def download_to_buffer(self, file_id: str, offset: int = 0,
                            length: int = 0) -> bytes:
-        with self._tracker() as t:
-            tgt = t.query_fetch(file_id)
-        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+        tgt = self._with_tracker(lambda t: t.query_fetch(file_id))
+        with self._storage(tgt) as s:
             return s.download_to_buffer(file_id, offset, length)
 
     def delete_file(self, file_id: str) -> None:
-        with self._tracker() as t:
-            tgt = t.query_update(file_id)
-        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+        tgt = self._with_tracker(lambda t: t.query_update(file_id))
+        with self._storage(tgt) as s:
             s.delete_file(file_id)
 
     def query_file_info(self, file_id: str) -> RemoteFileInfo:
-        with self._tracker() as t:
-            tgt = t.query_fetch(file_id)
-        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+        tgt = self._with_tracker(lambda t: t.query_fetch(file_id))
+        with self._storage(tgt) as s:
             return s.query_file_info(file_id)
 
     def set_metadata(self, file_id: str, meta: dict[str, str],
                      merge: bool = False) -> None:
-        with self._tracker() as t:
-            tgt = t.query_update(file_id)
-        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+        tgt = self._with_tracker(lambda t: t.query_update(file_id))
+        with self._storage(tgt) as s:
             s.set_metadata(file_id, meta, merge)
 
     def get_metadata(self, file_id: str) -> dict[str, str]:
-        with self._tracker() as t:
-            tgt = t.query_fetch(file_id)
-        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+        tgt = self._with_tracker(lambda t: t.query_fetch(file_id))
+        with self._storage(tgt) as s:
             return s.get_metadata(file_id)
 
     def upload_appender_buffer(self, data: bytes, ext: str = "",
@@ -96,46 +132,39 @@ class FdfsClient:
     def append_buffer(self, file_id: str, data: bytes) -> None:
         """Append to an appender file (routed to the source server, like
         every mutation — reference query_fetch_update update path)."""
-        with self._tracker() as t:
-            tgt = t.query_update(file_id)
-        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+        tgt = self._with_tracker(lambda t: t.query_update(file_id))
+        with self._storage(tgt) as s:
             s.append_buffer(file_id, data)
 
     def modify_buffer(self, file_id: str, offset: int, data: bytes) -> None:
-        with self._tracker() as t:
-            tgt = t.query_update(file_id)
-        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+        tgt = self._with_tracker(lambda t: t.query_update(file_id))
+        with self._storage(tgt) as s:
             s.modify_buffer(file_id, offset, data)
 
     def truncate_file(self, file_id: str, new_size: int = 0) -> None:
-        with self._tracker() as t:
-            tgt = t.query_update(file_id)
-        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+        tgt = self._with_tracker(lambda t: t.query_update(file_id))
+        with self._storage(tgt) as s:
             s.truncate_file(file_id, new_size)
 
     def upload_slave_buffer(self, master_id: str, prefix: str, data: bytes,
                             ext: str = "") -> str:
         """Slave files live on the master's server (same name stem ⇒ same
         group and path), so route via query_update on the master."""
-        with self._tracker() as t:
-            tgt = t.query_update(master_id)
-        with StorageClient(tgt.ip, tgt.port, self.timeout) as s:
+        tgt = self._with_tracker(lambda t: t.query_update(master_id))
+        with self._storage(tgt) as s:
             return s.upload_slave_buffer(master_id, prefix, data, ext)
 
     def list_groups(self) -> list[dict]:
-        with self._tracker() as t:
-            return t.list_groups()
+        return self._with_tracker(lambda t: t.list_groups())
 
     def delete_storage(self, group: str, ip: str, port: int) -> None:
-        with self._tracker() as t:
-            t.delete_storage(group, ip, port)
+        self._with_tracker(lambda t: t.delete_storage(group, ip, port))
 
     def set_trunk_server(self, group: str, ip: str, port: int) -> None:
         # The override must land on the tracker LEADER (followers refuse
         # with EBUSY=16 rather than proxying): ask any tracker who leads,
         # target it, and fall back to trying each tracker in turn.
-        with self._tracker() as t:
-            leader = t.get_tracker_status().get("leader", "")
+        leader = self._with_tracker(lambda t: t.get_tracker_status().get("leader", ""))
         if leader:
             try:
                 host, _, p = leader.rpartition(":")
@@ -155,12 +184,10 @@ class FdfsClient:
         raise last if last else ConnectionError("no tracker accepted override")
 
     def tracker_status(self) -> dict:
-        with self._tracker() as t:
-            return t.get_tracker_status()
+        return self._with_tracker(lambda t: t.get_tracker_status())
 
     def list_storages(self, group: str) -> list[dict]:
-        with self._tracker() as t:
-            return t.list_storages(group)
+        return self._with_tracker(lambda t: t.list_storages(group))
 
 
 def _parse_addr(addr: str) -> tuple[str, int]:

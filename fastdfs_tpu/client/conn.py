@@ -1,9 +1,14 @@
-"""Low-level framed connection (reference: libfastcommon sockopt.c
-tcprecvdata_nb/tcpsenddata_nb + fdfs_proto.c fdfs_recv_response)."""
+"""Low-level framed connection + connection pool (reference:
+libfastcommon sockopt.c tcprecvdata_nb/tcpsenddata_nb, fdfs_proto.c
+fdfs_recv_response, and connection_pool.c for the pooling)."""
 
 from __future__ import annotations
 
+import select
 import socket
+import threading
+import time
+from collections import deque
 
 from fastdfs_tpu.common.protocol import HEADER_SIZE, Header, pack_header, unpack_header
 
@@ -25,6 +30,9 @@ class Connection:
     def __init__(self, host: str, port: int, timeout: float = 30.0):
         self.host, self.port = host, port
         self.timeout = timeout
+        # Set on any mid-message failure: the stream cannot be resynced,
+        # so a pool must discard rather than reuse this connection.
+        self.broken = False
         self.sock = self._connect()
 
     def _connect(self) -> socket.socket:
@@ -59,17 +67,31 @@ class Connection:
         except OSError:
             self.close()
             self.sock = self._connect()
-            self.sock.sendall(hdr + body)
+            self.broken = False
+            try:
+                self.sock.sendall(hdr + body)
+            except OSError:
+                self.broken = True
+                raise
 
     def send_raw(self, data: bytes) -> None:
-        self.sock.sendall(data)
+        try:
+            self.sock.sendall(data)
+        except OSError:
+            self.broken = True
+            raise
 
     def recv_exact(self, n: int) -> bytes:
         chunks = []
         got = 0
         while got < n:
-            chunk = self.sock.recv(min(n - got, 256 * 1024))
+            try:
+                chunk = self.sock.recv(min(n - got, 256 * 1024))
+            except OSError:
+                self.broken = True
+                raise
             if not chunk:
+                self.broken = True
                 raise ProtocolError("connection closed mid-message")
             chunks.append(chunk)
             got += len(chunk)
@@ -85,3 +107,87 @@ class Connection:
         if hdr.status != 0:
             raise StatusError(hdr.status, context)
         return body
+
+
+class ConnectionPool:
+    """Endpoint-keyed pool of idle connections with borrow-time health
+    checks (reference: libfastcommon connection_pool.c,
+    ``g_use_connection_pool``).
+
+    A request/response protocol leaves a healthy connection quiet between
+    operations, so an idle socket that polls readable has either been
+    closed by the peer (EOF) or desynced (stray bytes) — both discard.
+    Connections marked ``broken`` by mid-message failures are never
+    pooled.  Thread-safe; callers acquire/release around each operation.
+    """
+
+    def __init__(self, max_idle_per_endpoint: int = 8,
+                 max_idle_seconds: float = 300.0):
+        self.max_idle_per_endpoint = max_idle_per_endpoint
+        self.max_idle_seconds = max_idle_seconds
+        self._idle: dict[tuple[str, int], deque] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def acquire(self, host: str, port: int,
+                timeout: float = 30.0) -> Connection:
+        now = time.monotonic()
+        while True:
+            with self._lock:
+                q = self._idle.get((host, port))
+                entry = q.popleft() if q else None
+            if entry is None:
+                break
+            conn, parked_at = entry
+            if now - parked_at > self.max_idle_seconds or not _quiet(conn):
+                conn.close()
+                continue
+            with self._lock:
+                self.hits += 1
+            return conn
+        with self._lock:
+            self.misses += 1
+        return Connection(host, port, timeout)
+
+    def release(self, conn: Connection) -> None:
+        if conn.broken:
+            conn.close()
+            return
+        key = (conn.host, conn.port)
+        with self._lock:
+            q = self._idle.setdefault(key, deque())
+            if len(q) >= self.max_idle_per_endpoint:
+                oldest, _ = q.popleft()
+                oldest.close()
+            q.append((conn, time.monotonic()))
+
+    def purge(self, host: str, port: int) -> None:
+        """Drop every idle connection to one endpoint (called after an
+        operation on a pooled connection fails: a silently-dead peer
+        passes the borrow-time check, so its siblings are suspect)."""
+        with self._lock:
+            q = self._idle.pop((host, port), None)
+        for conn, _ in (q or ()):
+            conn.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            queues = list(self._idle.values())
+            self._idle.clear()
+        for q in queues:
+            for conn, _ in q:
+                conn.close()
+
+    def idle_count(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._idle.values())
+
+
+def _quiet(conn: Connection) -> bool:
+    """True when the idle socket shows no pending data/EOF (reusable)."""
+    try:
+        readable, _, _ = select.select([conn.sock], [], [], 0)
+        return not readable
+    except (OSError, ValueError):
+        return False

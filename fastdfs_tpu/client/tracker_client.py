@@ -37,11 +37,22 @@ class FetchTarget:
 
 
 class TrackerClient:
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self.conn = Connection(host, port, timeout)
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 conn: Connection | None = None, release=None):
+        # `conn`/`release` inject a pooled connection (ConnectionPool):
+        # close() then parks it instead of closing the socket.
+        self.conn = conn if conn is not None else Connection(host, port, timeout)
+        self._release = release
 
     def close(self) -> None:
-        self.conn.close()
+        conn, self.conn = self.conn, None
+        if conn is None:
+            return  # idempotent: the pool may already own the socket
+        if self._release is not None:
+            release, self._release = self._release, None
+            release(conn)
+        else:
+            conn.close()
 
     def __enter__(self):
         return self

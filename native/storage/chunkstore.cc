@@ -125,6 +125,16 @@ bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
     *existed = true;
     return true;
   }
+  auto d = deferred_.find(digest_hex);
+  if (d != deferred_.end()) {
+    // Zero-ref but still on disk (a pinned stream deferred the unlink):
+    // resurrect instead of rewriting, cancelling the deferral — its
+    // bytes were never subtracted from unique_bytes_.
+    deferred_.erase(d);
+    refs_[digest_hex] = 1;
+    *existed = true;
+    return true;
+  }
   // First reference: write the payload (write-if-absent; a leftover file
   // from a crashed write is simply overwritten — content-addressed, so
   // same digest => same bytes).
@@ -177,9 +187,40 @@ void ChunkStore::UnrefAll(const Recipe& r) {
     auto it = refs_.find(e.digest_hex);
     if (it == refs_.end()) continue;
     if (--it->second <= 0) {
-      unlink(ChunkPath(e.digest_hex).c_str());
-      unique_bytes_ -= e.length;
       refs_.erase(it);
+      if (pins_.count(e.digest_hex)) {
+        // An in-flight download still streams this chunk: defer the
+        // unlink to the last UnpinRecipe.
+        deferred_[e.digest_hex] = e.length;
+      } else {
+        unlink(ChunkPath(e.digest_hex).c_str());
+        unique_bytes_ -= e.length;
+      }
+    }
+  }
+}
+
+void ChunkStore::PinRecipe(const Recipe& r) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (const RecipeEntry& e : r.chunks) pins_[e.digest_hex]++;
+}
+
+void ChunkStore::UnpinRecipe(const Recipe& r) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (const RecipeEntry& e : r.chunks) {
+    auto it = pins_.find(e.digest_hex);
+    if (it == pins_.end()) continue;
+    if (--it->second <= 0) {
+      pins_.erase(it);
+      auto d = deferred_.find(e.digest_hex);
+      if (d != deferred_.end()) {
+        // ...unless the chunk was re-added while the stream ran.
+        if (refs_.find(e.digest_hex) == refs_.end()) {
+          unlink(ChunkPath(e.digest_hex).c_str());
+          unique_bytes_ -= d->second;
+        }
+        deferred_.erase(d);
+      }
     }
   }
 }

@@ -73,6 +73,14 @@ class ChunkStore {
   bool ReadChunk(const std::string& digest_hex, int64_t expect_len,
                  std::string* out) const;
 
+  // Transient stream pins: an in-flight chunked download holds a pin per
+  // recipe entry so a concurrent delete cannot unlink bytes it is still
+  // sending (POSIX open-fd semantics for flat files, recreated here).
+  // A pinned chunk whose refcount hits zero defers its unlink until the
+  // last pin drops.  Pins are RAM-only — a crash loses only streams.
+  void PinRecipe(const Recipe& r);
+  void UnpinRecipe(const Recipe& r);
+
   std::string ChunkPath(const std::string& digest_hex) const;
 
   int64_t unique_chunks() const;
@@ -82,6 +90,8 @@ class ChunkStore {
   std::string store_path_;
   mutable std::mutex mu_;
   std::unordered_map<std::string, int64_t> refs_;
+  std::unordered_map<std::string, int64_t> pins_;      // in-flight streams
+  std::unordered_map<std::string, int64_t> deferred_;  // digest -> length
   int64_t unique_bytes_ = 0;
 };
 

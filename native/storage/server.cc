@@ -1823,6 +1823,8 @@ void StorageServer::HandleDownload(Conn* c) {
   }
   rs->skip = skip;
   rs->recipe = std::move(*r);
+  cs->PinRecipe(rs->recipe);
+  rs->pinned = true;
   stats_.success_download++;
   LogAccess(c, 0, count);
   c->out.resize(kHeaderSize);

@@ -105,6 +105,13 @@ class StorageServer {
     size_t idx = 0;          // next recipe entry
     int64_t skip = 0;        // bytes to skip inside entry `idx` (range start)
     int64_t remaining = 0;   // logical bytes still to send
+    bool pinned = false;
+    // Pins (ChunkStore::PinRecipe) keep the chunks on disk while the
+    // stream is in flight even if the file is deleted concurrently —
+    // the POSIX open-fd guarantee flat files get from sendfile.
+    ~RecipeStream() {
+      if (pinned && cs != nullptr) cs->UnpinRecipe(recipe);
+    }
   };
 
   struct Conn {

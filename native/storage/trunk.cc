@@ -398,31 +398,76 @@ namespace {
 
 constexpr int64_t kRpcMax = 4096;
 
-bool TrunkRpc(const std::string& ip, int port, uint8_t cmd,
-              const std::string& body, std::string* resp, uint8_t* status,
-              int timeout_ms) {
-  std::string err;
-  int fd = TcpConnect(ip, port, timeout_ms, &err);
-  if (fd < 0) return false;
+// Pooled connection to the elected trunk server (reference:
+// connection_pool.c — the daemon used to open a fresh TCP connection
+// per allocation RPC).  One cached fd PER THREAD: trunk RPCs run on
+// every nio/dio worker, and a process-global fd would serialize all of
+// them on one mutex held across network IO.  The cache survives across
+// calls and reconnects when the endpoint moves or the socket dies.
+struct TrunkRpcCache {
+  std::string ip;
+  int port = 0;
+  int fd = -1;
+  ~TrunkRpcCache() {
+    if (fd >= 0) close(fd);
+  }
+};
+thread_local TrunkRpcCache g_trunk_rpc;
+
+bool TrunkRpcExchange(int fd, uint8_t cmd, const std::string& body,
+                      std::string* resp, uint8_t* status, int timeout_ms) {
   uint8_t hdr[kHeaderSize];
   PutInt64BE(static_cast<int64_t>(body.size()), hdr);
   hdr[8] = cmd;
   hdr[9] = 0;
-  bool ok = SendAll(fd, hdr, sizeof(hdr), timeout_ms) &&
-            SendAll(fd, body.data(), body.size(), timeout_ms) &&
-            RecvAll(fd, hdr, sizeof(hdr), timeout_ms);
-  if (ok) {
-    int64_t len = GetInt64BE(hdr);
-    *status = hdr[9];
-    if (len < 0 || len > kRpcMax) {
-      ok = false;
-    } else {
-      resp->resize(static_cast<size_t>(len));
-      if (len > 0) ok = RecvAll(fd, resp->data(), resp->size(), timeout_ms);
-    }
+  if (!SendAll(fd, hdr, sizeof(hdr), timeout_ms) ||
+      !SendAll(fd, body.data(), body.size(), timeout_ms) ||
+      !RecvAll(fd, hdr, sizeof(hdr), timeout_ms))
+    return false;
+  int64_t len = GetInt64BE(hdr);
+  *status = hdr[9];
+  if (len < 0 || len > kRpcMax) return false;
+  resp->resize(static_cast<size_t>(len));
+  return len == 0 || RecvAll(fd, resp->data(), resp->size(), timeout_ms);
+}
+
+bool TrunkRpc(const std::string& ip, int port, uint8_t cmd,
+              const std::string& body, std::string* resp, uint8_t* status,
+              int timeout_ms) {
+  bool reused = g_trunk_rpc.fd >= 0 && g_trunk_rpc.ip == ip &&
+                g_trunk_rpc.port == port;
+  if (g_trunk_rpc.fd >= 0 && !reused) {
+    close(g_trunk_rpc.fd);  // trunk server moved
+    g_trunk_rpc.fd = -1;
   }
-  close(fd);
-  return ok;
+  if (g_trunk_rpc.fd < 0) {
+    std::string err;
+    g_trunk_rpc.fd = TcpConnect(ip, port, timeout_ms, &err);
+    if (g_trunk_rpc.fd < 0) return false;
+    g_trunk_rpc.ip = ip;
+    g_trunk_rpc.port = port;
+    reused = false;
+  }
+  if (TrunkRpcExchange(g_trunk_rpc.fd, cmd, body, resp, status, timeout_ms))
+    return true;
+  close(g_trunk_rpc.fd);
+  g_trunk_rpc.fd = -1;
+  // A REUSED connection may simply have gone stale (trunk server
+  // restarted): reconnect and retry the whole exchange once.  A fresh
+  // connection's failure is real — and no blind retry after a recv-side
+  // failure could double-allocate a slot, so the retry happens only via
+  // this single reconnect path.
+  if (!reused) return false;
+  std::string err;
+  g_trunk_rpc.fd = TcpConnect(ip, port, timeout_ms, &err);
+  if (g_trunk_rpc.fd < 0) return false;
+  g_trunk_rpc.ip = ip;
+  g_trunk_rpc.port = port;
+  if (TrunkRpcExchange(g_trunk_rpc.fd, cmd, body, resp, status, timeout_ms))
+    return true;
+  close(g_trunk_rpc.fd);
+  g_trunk_rpc.fd = -1;
+  return false;
 }
 
 std::string PackLoc(const TrunkLocation& loc) {

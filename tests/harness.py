@@ -153,3 +153,16 @@ def start_tracker(tmp_path, port: int | None = None, **kw) -> Daemon:
     os.makedirs(base, exist_ok=True)
     conf = make_tracker_conf(base, port, **kw)
     return Daemon(TRACKERD, conf, port)
+
+
+def upload_retry(cli, data, timeout=20.0, **kw):
+    """Upload with retries while a fresh daemon joins/activates (the
+    tracker refuses query_store until the storage reports in)."""
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return cli.upload_buffer(data, **kw)
+        except Exception:
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.5)

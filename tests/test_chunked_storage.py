@@ -30,7 +30,7 @@ import time
 import numpy as np
 import pytest
 
-from harness import start_storage, start_tracker, wait_port  # noqa: E402
+from harness import upload_retry, start_storage, start_tracker, wait_port  # noqa: E402
 
 from fastdfs_tpu.client.client import FdfsClient
 
@@ -67,16 +67,6 @@ def _flat_for(base, fid):
         if os.path.isfile(p)]
     return hits[0] if hits else None
 
-
-def _upload_retry(cli, data, timeout=20.0, **kw):
-    deadline = time.time() + timeout
-    while True:
-        try:
-            return cli.upload_buffer(data, **kw)
-        except Exception:
-            if time.time() >= deadline:
-                raise
-            time.sleep(0.5)
 
 
 def _wait(pred, timeout=15.0, every=0.3):
@@ -141,7 +131,7 @@ def test_chunked_upload_dedups_and_gc(tmp_path, mode):
     st_base = os.path.join(str(tmp_path), "st")
     try:
         a, b = _mk_payloads()
-        fa = _upload_retry(cli, a, ext="bin")
+        fa = upload_retry(cli, a, ext="bin")
         fb = cli.upload_buffer(b, ext="bin")
 
         # stored as recipes, not flat files
@@ -190,7 +180,7 @@ def test_restart_rebuilds_refcounts_and_collects_orphans(tmp_path):
     st_base = os.path.join(str(tmp_path), "st")
     try:
         a, b = _mk_payloads(seed=3)
-        fa = _upload_retry(cli, a, ext="bin")
+        fa = upload_retry(cli, a, ext="bin")
         fb = cli.upload_buffer(b, ext="bin")
 
         # plant an orphan chunk (crash leftover: written but never named
@@ -237,7 +227,7 @@ def test_sidecar_down_at_boot_fails_open(tmp_path):
     st_base = os.path.join(str(tmp_path), "st")
     try:
         a, _ = _mk_payloads(seed=5)
-        fa = _upload_retry(cli, a, ext="bin")
+        fa = upload_retry(cli, a, ext="bin")
         assert _flat_for(st_base, fa) is not None
         assert _recipe_for(st_base, fa) is None
         assert cli.download_to_buffer(fa) == a
@@ -252,7 +242,7 @@ def test_sidecar_killed_mid_service_fails_open(tmp_path):
     st_base = os.path.join(str(tmp_path), "st")
     try:
         a, b = _mk_payloads(seed=7)
-        fa = _upload_retry(cli, a, ext="bin")
+        fa = upload_retry(cli, a, ext="bin")
         assert _recipe_for(st_base, fa) is not None  # chunked while alive
 
         sidecar.kill()
@@ -276,7 +266,7 @@ def test_sidecar_snapshot_save_load(tmp_path):
     tr, st, cli = _cluster(tmp_path, "sidecar", sock)
     try:
         a, b = _mk_payloads(seed=9)
-        fa = _upload_retry(cli, a, ext="bin")
+        fa = upload_retry(cli, a, ext="bin")
         _ = cli.upload_buffer(b, ext="bin")
 
         sidecar.send_signal(signal.SIGTERM)
@@ -407,7 +397,7 @@ def test_recovery_rebuilds_chunked(tmp_path_factory):
         assert _wait(lambda: t.list_groups() and
                      t.list_groups()[0]["active"] == 2, timeout=25)
         a, b = _mk_payloads(seed=11)
-        fa = _upload_retry(cli, a, ext="bin")
+        fa = upload_retry(cli, a, ext="bin")
         fb = cli.upload_buffer(b, ext="bin")
         assert _wait(lambda: all(
             len(t.query_fetch_all(f)) == 2 for f in (fa, fb)), timeout=30), \

@@ -16,23 +16,13 @@ import time
 
 import pytest
 
-from harness import start_storage, start_tracker
+from harness import upload_retry, start_storage, start_tracker
 
 from fastdfs_tpu.client.client import FdfsClient
 from fastdfs_tpu.common.protocol import StorageCmd
 
 HB = "heart_beat_interval = 1\nstat_report_interval = 1"
 
-
-def _upload_retry(cli, data, timeout=20.0, **kw):
-    deadline = time.time() + timeout
-    while True:
-        try:
-            return cli.upload_buffer(data, **kw)
-        except Exception:
-            if time.time() >= deadline:
-                raise
-            time.sleep(0.5)
 
 
 def _slow_download(addr, fid, expect, pace_s=0.05, chunk=1 << 16):
@@ -73,7 +63,7 @@ def test_slow_chunked_download_does_not_block_uploads(tmp_path):
     try:
         rng = random.Random(21)
         big = rng.randbytes(24 << 20)  # chunked (threshold 64 KB)
-        fid_big = _upload_retry(cli, big, ext="bin")
+        fid_big = upload_retry(cli, big, ext="bin")
         addr = ("127.0.0.1", st.port)
 
         with concurrent.futures.ThreadPoolExecutor(max_workers=4) as ex:
@@ -111,7 +101,7 @@ def test_work_thread_configs(tmp_path, threads):
     try:
         rng = random.Random(threads)
         payloads = [rng.randbytes(200 << 10) for _ in range(4)]
-        fids = [_upload_retry(cli, b, ext="bin") for b in payloads]
+        fids = [upload_retry(cli, b, ext="bin") for b in payloads]
         for fid, b in zip(fids, payloads):
             assert cli.download_to_buffer(fid) == b
         cli.delete_file(fids[0])
@@ -129,7 +119,7 @@ def test_parallel_uploads_all_land(tmp_path):
                        dedup_mode="cpu", extra=HB)
     taddr = f"127.0.0.1:{tr.port}"
     try:
-        _upload_retry(FdfsClient([taddr]), b"warm" * 100, ext="bin")
+        upload_retry(FdfsClient([taddr]), b"warm" * 100, ext="bin")
         rng = random.Random(33)
         payloads = [rng.randbytes((64 << 10) + i * 1111) for i in range(12)]
 
@@ -142,6 +132,44 @@ def test_parallel_uploads_all_land(tmp_path):
             results = list(ex.map(one, payloads))
         assert all(ok for _, ok in results)
         assert len({fid for fid, _ in results}) == len(payloads)
+    finally:
+        st.stop()
+        tr.stop()
+
+
+def test_delete_during_chunked_download_completes(tmp_path):
+    # An in-flight chunked download pins its chunks (ChunkStore stream
+    # pins): deleting the file mid-stream must not truncate the reader —
+    # the POSIX open-fd guarantee flat files get from sendfile.
+    import glob
+    import os
+
+    tr = start_tracker(str(tmp_path / "tr"))
+    st = start_storage(str(tmp_path / "st"),
+                       trackers=[f"127.0.0.1:{tr.port}"],
+                       dedup_mode="cpu", extra=HB)
+    cli = FdfsClient([f"127.0.0.1:{tr.port}"])
+    try:
+        rng = random.Random(55)
+        big = rng.randbytes(8 << 20)
+        fid = upload_retry(cli, big, ext="bin")
+        addr = ("127.0.0.1", st.port)
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
+            dl = ex.submit(_slow_download, addr, fid, big, 0.01, 1 << 17)
+            time.sleep(0.3)          # stream mid-flight
+            cli.delete_file(fid)     # concurrent delete
+            assert dl.result(timeout=120), \
+                "chunked download truncated by concurrent delete"
+        # once the stream finished, the deferred chunk GC completes
+
+        def chunks_left():
+            return [f for f in glob.glob(os.path.join(
+                str(tmp_path / "st"), "data", "chunks", "**", "*"),
+                recursive=True) if os.path.isfile(f)]
+        deadline = time.time() + 10
+        while time.time() < deadline and chunks_left():
+            time.sleep(0.3)
+        assert chunks_left() == [], "pinned chunks never collected"
     finally:
         st.stop()
         tr.stop()

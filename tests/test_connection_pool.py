@@ -1,0 +1,111 @@
+"""Client connection pooling (reference: libfastcommon
+connection_pool.c / client.conf:use_connection_pool): operations reuse
+pooled per-endpoint connections, broken or stale sockets are discarded
+at borrow time, and failover still works with a tracker down."""
+
+import random
+import time
+
+from harness import upload_retry, free_port, start_storage, start_tracker
+
+from fastdfs_tpu.client.client import FdfsClient
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+
+
+
+def test_operations_reuse_pooled_connections(tmp_path):
+    tr = start_tracker(str(tmp_path / "tr"))
+    st = start_storage(str(tmp_path / "st"),
+                       trackers=[f"127.0.0.1:{tr.port}"],
+                       dedup_mode="cpu", extra=HB)
+    cli = FdfsClient([f"127.0.0.1:{tr.port}"])
+    try:
+        rng = random.Random(1)
+        payloads = [rng.randbytes(20_000 + i) for i in range(10)]
+        fids = [upload_retry(cli, payloads[0], ext="bin")]
+        fids += [cli.upload_buffer(b, ext="bin") for b in payloads[1:]]
+        for fid, b in zip(fids, payloads):
+            assert cli.download_to_buffer(fid) == b
+        # each op = 1 tracker + 1 storage exchange; after warmup nearly
+        # all borrows must be pool hits, with a bounded idle set
+        assert cli.pool.hits > cli.pool.misses * 3, \
+            (cli.pool.hits, cli.pool.misses)
+        assert cli.pool.idle_count() <= 4
+        # and the pool never confuses endpoints: ops still correct after
+        # interleaving deletes
+        cli.delete_file(fids[0])
+        assert cli.download_to_buffer(fids[1]) == payloads[1]
+    finally:
+        cli.close()
+        st.stop()
+        tr.stop()
+
+
+def test_stale_pooled_connection_discarded_on_restart(tmp_path):
+    tr = start_tracker(str(tmp_path / "tr"))
+    st = start_storage(str(tmp_path / "st"),
+                       trackers=[f"127.0.0.1:{tr.port}"],
+                       dedup_mode="cpu", extra=HB)
+    cli = FdfsClient([f"127.0.0.1:{tr.port}"])
+    try:
+        data = random.Random(2).randbytes(30_000)
+        fid = upload_retry(cli, data, ext="bin")
+        assert cli.pool.idle_count() > 0
+        # restart the storage daemon: every parked storage socket is dead
+        port = st.port
+        st.stop()
+        st2 = start_storage(str(tmp_path / "st"), port=port,
+                            trackers=[f"127.0.0.1:{tr.port}"],
+                            dedup_mode="cpu", extra=HB)
+        try:
+            deadline = time.time() + 20
+            got = None
+            while time.time() < deadline:
+                try:
+                    got = cli.download_to_buffer(fid)
+                    break
+                except Exception:
+                    time.sleep(0.5)
+            assert got == data
+        finally:
+            st2.stop()
+    finally:
+        cli.close()
+        st.stop()
+        tr.stop()
+
+
+def test_pool_survives_tracker_death(tmp_path):
+    # two trackers; pooled connections to the dead one are discarded and
+    # failover reaches the survivor
+    t1 = start_tracker(str(tmp_path / "t1"))
+    t2_port = free_port()
+    t2 = start_tracker(str(tmp_path / "t2"), port=t2_port,
+                       extra=f"tracker_server = 127.0.0.1:{t1.port}")
+    st = start_storage(str(tmp_path / "st"),
+                       trackers=[f"127.0.0.1:{t1.port}",
+                                 f"127.0.0.1:{t2_port}"],
+                       dedup_mode="cpu", extra=HB)
+    cli = FdfsClient([f"127.0.0.1:{t1.port}", f"127.0.0.1:{t2_port}"])
+    try:
+        data = random.Random(3).randbytes(25_000)
+        fid = upload_retry(cli, data, ext="bin", timeout=30)
+        t1.stop()  # kill one tracker; parked connections to it are dead
+        deadline = time.time() + 20
+        ok = False
+        while time.time() < deadline:
+            try:
+                ok = cli.download_to_buffer(fid) == data
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert ok, "client did not fail over with pooled connections"
+        # and uploads keep working through the surviving tracker
+        fid2 = upload_retry(cli, data + b"x", ext="bin")
+        assert cli.download_to_buffer(fid2) == data + b"x"
+    finally:
+        cli.close()
+        st.stop()
+        t2.stop()
+        t1.stop()
