@@ -114,14 +114,15 @@ class StorageCmd(enum.IntEnum):
     SYNC_APPEND_FILE = 25
     FETCH_ONE_PATH_BINLOG = 26
 
-    # trunk subsystem (reference: storage/trunk_mgr/)
+    # trunk subsystem (reference: storage/trunk_mgr/).  Opcodes 30-33
+    # (upstream's trunk_sync.c binlog-shipping protocol) are deliberately
+    # ABSENT: this rebuild replicates trunk slot writes through the main
+    # binlog (op 'c'/'d' with trunk file-IDs, tests/test_trunk.py), so a
+    # second replication channel would be dead surface.  The values stay
+    # reserved for wire compatibility.
     TRUNK_ALLOC_SPACE = 27
     TRUNK_ALLOC_CONFIRM = 28
     TRUNK_FREE_SPACE = 29
-    TRUNK_SYNC_BINLOG = 30
-    TRUNK_GET_BINLOG_SIZE = 31
-    TRUNK_DELETE_BINLOG_MARKS = 32
-    TRUNK_TRUNCATE_BINLOG_FILE = 33
 
     MODIFY_FILE = 34
     SYNC_MODIFY_FILE = 35

@@ -1,5 +1,10 @@
 #include "common/log.h"
 
+#include "common/fsutil.h"
+
+#include <sys/stat.h>
+
+#include <cstdio>
 #include <ctime>
 #include <mutex>
 
@@ -8,8 +13,45 @@ namespace fdfs {
 namespace {
 LogLevel g_level = LogLevel::kInfo;
 FILE* g_out = nullptr;  // nullptr => stderr
+std::string g_path;
+int64_t g_rotate_bytes = 256LL << 20;  // 0 = no size rotation
+bool g_rotate_daily = true;
+int64_t g_written = 0;   // bytes since open (approximate)
+int g_open_day = -1;     // yday at open
 std::mutex g_mu;
 const char* kNames[] = {"DEBUG", "INFO", "WARN", "ERROR"};
+
+int TodayYday() {
+  time_t now = time(nullptr);
+  struct tm tmv;
+  localtime_r(&now, &tmv);
+  return tmv.tm_year * 1000 + tmv.tm_yday;
+}
+
+// Rotate-if-due; g_mu held.  Rename to <path>.<YYYYMMDD-HHMMSS> and
+// reopen fresh (reference: logger.c rotate_everyday + rotate_size).
+void MaybeRotateLocked() {
+  if (g_out == nullptr || g_path.empty()) return;
+  bool by_size = g_rotate_bytes > 0 && g_written >= g_rotate_bytes;
+  bool by_day = g_rotate_daily && g_open_day != TodayYday();
+  if (!by_size && !by_day) return;
+  fclose(g_out);
+  g_out = nullptr;
+  char stamp[32];
+  time_t now = time(nullptr);
+  struct tm tmv;
+  localtime_r(&now, &tmv);
+  strftime(stamp, sizeof(stamp), "%Y%m%d-%H%M%S", &tmv);
+  // Uniquify: two rotations in one second must not clobber each other.
+  std::string target = g_path + "." + stamp;
+  struct stat st;
+  for (int n = 1; stat(target.c_str(), &st) == 0 && n < 1000; ++n)
+    target = g_path + "." + stamp + "." + std::to_string(n);
+  rename(g_path.c_str(), target.c_str());
+  g_out = fopen(g_path.c_str(), "a");
+  g_written = 0;
+  g_open_day = TodayYday();
+}
 }  // namespace
 
 void LogSetLevel(LogLevel level) { g_level = level; }
@@ -21,7 +63,31 @@ void LogSetFile(const std::string& path) {
     fclose(g_out);
     g_out = nullptr;
   }
-  if (!path.empty()) g_out = fopen(path.c_str(), "a");
+  g_path = path;
+  g_written = 0;
+  g_open_day = TodayYday();
+  if (!path.empty()) {
+    g_out = fopen(path.c_str(), "a");
+    struct stat st;
+    if (g_out != nullptr && stat(path.c_str(), &st) == 0)
+      g_written = st.st_size;
+  }
+}
+
+void LogSetRotation(int64_t max_bytes, bool daily) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_rotate_bytes = max_bytes;
+  g_rotate_daily = daily;
+}
+
+void LogSetupFileSink(const std::string& base_path,
+                      const std::string& log_file, int64_t rotate_size) {
+  if (log_file.empty()) return;  // stderr sink
+  MakeDirs(base_path + "/logs");
+  std::string lp = log_file[0] == '/' ? log_file
+                                      : base_path + "/logs/" + log_file;
+  LogSetFile(lp);
+  LogSetRotation(rotate_size);
 }
 
 void LogV(LogLevel level, const char* fmt, va_list ap) {
@@ -32,11 +98,13 @@ void LogV(LogLevel level, const char* fmt, va_list ap) {
   localtime_r(&now, &tmv);
   strftime(ts, sizeof(ts), "%Y-%m-%d %H:%M:%S", &tmv);
   std::lock_guard<std::mutex> lk(g_mu);
+  MaybeRotateLocked();
   FILE* out = g_out != nullptr ? g_out : stderr;
-  fprintf(out, "[%s] %s ", ts, kNames[static_cast<int>(level)]);
-  vfprintf(out, fmt, ap);
+  int n = fprintf(out, "[%s] %s ", ts, kNames[static_cast<int>(level)]);
+  n += vfprintf(out, fmt, ap);
   fputc('\n', out);
   fflush(out);
+  if (g_out != nullptr && n > 0) g_written += n + 1;
 }
 
 void Log(LogLevel level, const char* fmt, ...) {

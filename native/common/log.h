@@ -1,5 +1,5 @@
-// Leveled logger (reference: libfastcommon logger.c — leveled, rotating;
-// rotation is deferred to later rounds, level filtering + timestamps now).
+// Leveled logger with size/day rotation (reference: libfastcommon
+// logger.c — log_set_rotate_size / rotate_everyday).
 #pragma once
 
 #include <cstdarg>
@@ -12,6 +12,14 @@ enum class LogLevel : int { kDebug = 0, kInfo = 1, kWarn = 2, kError = 3 };
 
 void LogSetLevel(LogLevel level);
 void LogSetFile(const std::string& path);  // empty => stderr
+// Rotation policy for the file sink: rotate when the file exceeds
+// max_bytes (0 = no size rotation) or when the calendar day changes
+// (daily = true).  The old file is renamed <path>.<YYYYMMDD-HHMMSS>.
+void LogSetRotation(int64_t max_bytes, bool daily = true);
+// Convenience used by both daemons: empty log_file = keep stderr;
+// relative paths land under <base_path>/logs/.
+void LogSetupFileSink(const std::string& base_path,
+                      const std::string& log_file, int64_t rotate_size);
 LogLevel LogGetLevel();
 
 void LogV(LogLevel level, const char* fmt, va_list ap);

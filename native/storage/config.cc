@@ -64,6 +64,8 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
       ini.GetBytes("dedup_segment_bytes", 64LL * 1024 * 1024);
   if (dedup_segment_bytes < (1 << 20)) dedup_segment_bytes = 1 << 20;
   log_level = ini.GetStr("log_level", "info");
+  log_file = ini.GetStr("log_file", "");
+  log_rotate_size = ini.GetBytes("log_rotate_size", log_rotate_size);
   use_access_log = ini.GetBool("use_access_log", false);
   return true;
 }

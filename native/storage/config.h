@@ -45,6 +45,10 @@ struct StorageConfig {
   // segment so a multi-GB upload never needs a contiguous buffer).
   int64_t dedup_segment_bytes = 64LL * 1024 * 1024;
   std::string log_level = "info";
+  // Optional file sink (empty = stderr) with size/day rotation
+  // (reference: logger.c; base_path-relative paths allowed).
+  std::string log_file;
+  int64_t log_rotate_size = 256LL << 20;
   // Per-request access log (storage.conf:use_access_log): op, client ip,
   // status, bytes, cost in µs — logs/access.log.
   bool use_access_log = false;

@@ -9,6 +9,7 @@
 #include <cstring>
 
 #include "common/ini.h"
+#include "common/fsutil.h"
 #include "common/log.h"
 #include "storage/config.h"
 #include "storage/server.h"
@@ -45,6 +46,7 @@ int main(int argc, char** argv) {
   if (cfg.log_level == "debug") fdfs::LogSetLevel(fdfs::LogLevel::kDebug);
   else if (cfg.log_level == "warn") fdfs::LogSetLevel(fdfs::LogLevel::kWarn);
   else if (cfg.log_level == "error") fdfs::LogSetLevel(fdfs::LogLevel::kError);
+  fdfs::LogSetupFileSink(cfg.base_path, cfg.log_file, cfg.log_rotate_size);
 
   fdfs::StorageServer server(cfg);
   if (!server.Init(&err)) {

@@ -225,6 +225,23 @@ bool StorageServer::Init(std::string* error) {
   loop_.AddTimer(60 * 1000, [this]() {
     if (dedup_ != nullptr) dedup_->Save();
   });
+  // Trunk maintenance (reference: trunk_create_file_advance + the
+  // free-block checker driving compaction): keep one trunk file's worth
+  // of pre-created free space ahead of demand and reclaim fully-free
+  // files beyond the reserve.  Trunk-server role only.
+  loop_.AddTimer(30 * 1000, [this]() {
+    std::shared_ptr<TrunkAllocator> alloc;
+    int64_t tfs;
+    {
+      std::lock_guard<std::mutex> lk(trunk_mu_);
+      if (!is_trunk_server_) return;
+      alloc = trunk_alloc_;
+      tfs = trunk_file_size_;
+    }
+    if (alloc == nullptr) return;
+    alloc->EnsureFreeReserve(tfs);
+    alloc->ReclaimEmptyFiles(/*keep=*/1);
+  });
 
   FDFS_LOG_INFO("storage daemon up: group=%s port=%d store_paths=%d dedup=%s",
                 cfg_.group_name.c_str(), cfg_.port, store_.store_path_count(),
@@ -316,6 +333,12 @@ void StorageServer::AdoptConn(NioThread* t, int fd) {
   t->loop->Add(fd, EPOLLIN, [this, raw](uint32_t ev) { OnConnEvent(raw, ev); });
 }
 
+static int64_t MonoUs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
 void StorageServer::OffloadToDio(Conn* c, int spi, std::function<void()> work) {
   WorkerPool* pool = nullptr;
   if (!dio_pools_.empty()) {
@@ -328,6 +351,7 @@ void StorageServer::OffloadToDio(Conn* c, int spi, std::function<void()> work) {
     return;
   }
   c->async_pending = true;
+  if (access_log_ != nullptr) c->work_start_us = MonoUs();
   EventLoop* loop = ConnLoop(c);
   // Drop the fd from epoll while a worker owns the request: with
   // level-triggered epoll a readable/HUP'd socket would otherwise
@@ -423,6 +447,8 @@ void StorageServer::ResetForNextRequest(Conn* c) {
   c->send_off = 0;
   c->send_remaining = 0;
   c->rstream.reset();
+  c->recv_done_us = 0;
+  c->work_start_us = 0;
 }
 
 bool StorageServer::AcquireBusy(Conn* c, const std::string& remote) {
@@ -498,16 +524,24 @@ void StorageServer::Respond(Conn* c, uint8_t status, const std::string& body) {
 void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
   if (access_log_ == nullptr || c->req_start_us == 0) return;
   std::lock_guard<std::mutex> lk(log_mu_);
-  struct timespec ts;
-  clock_gettime(CLOCK_MONOTONIC, &ts);
-  int64_t now_us =
-      static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
-  // "<epoch.sec> <client_ip> <cmd> <status> <bytes> <cost_us>"
-  fprintf(access_log_, "%lld %s %d %d %lld %lld\n",
+  int64_t now_us = MonoUs();
+  // "<epoch.sec> <client_ip> <cmd> <status> <bytes> <cost_us>
+  //  <recv_us> <work_us>" — per-stage split (SURVEY.md §5): recv = body
+  // receive window, work = dio-stage time (fingerprint + chunk/disk
+  // writes), both 0 when the stage did not occur.
+  int64_t recv_us =
+      c->recv_done_us > 0 ? c->recv_done_us - c->req_start_us : 0;
+  int64_t work_us =
+      c->work_start_us > 0 ? now_us - c->work_start_us : 0;
+  fprintf(access_log_, "%lld %s %d %d %lld %lld %lld %lld\n",
           static_cast<long long>(time(nullptr)), c->peer_ip.c_str(), c->cmd,
           status, static_cast<long long>(bytes),
-          static_cast<long long>(now_us - c->req_start_us));
+          static_cast<long long>(now_us - c->req_start_us),
+          static_cast<long long>(recv_us),
+          static_cast<long long>(work_us));
   c->req_start_us = 0;  // one line per request
+  c->recv_done_us = 0;
+  c->work_start_us = 0;
 }
 
 void StorageServer::RespondFile(Conn* c, uint8_t status, int file_fd,
@@ -916,6 +950,7 @@ void StorageServer::OnFixedComplete(Conn* c) {
 }
 
 void StorageServer::OnFileComplete(Conn* c) {
+  if (access_log_ != nullptr) c->recv_done_us = MonoUs();
   if (c->discarding) {  // rejected request: body drained, send the verdict
     Respond(c, c->pending_status);
     return;
@@ -1119,6 +1154,7 @@ void StorageServer::RefreshClusterParams() {
   auto [tip, tport] = reporter_->trunk_server();
   trunk_ip_ = tip;
   trunk_port_ = tport;
+  trunk_epoch_ = reporter_->trunk_epoch();
   // Slot alloc_size fields are uint32: a trunk_file_size >= 4GiB would
   // silently truncate the initial whole-file free block and corrupt the
   // allocator's view.  Refuse and disable trunk rather than corrupt
@@ -1204,15 +1240,17 @@ std::optional<TrunkLocation> StorageServer::TrunkAlloc(int64_t payload_size) {
   std::shared_ptr<TrunkAllocator> alloc;
   std::string ip;
   int port = 0;
+  int64_t epoch = 0;
   {
     std::lock_guard<std::mutex> lk(trunk_mu_);
     if (is_trunk_server_) alloc = trunk_alloc_;
     ip = trunk_ip_;
     port = trunk_port_;
+    epoch = trunk_epoch_;
   }
   if (alloc != nullptr) return alloc->Alloc(payload_size);
   if (port > 0)
-    return TrunkAllocRpc(ip, port, cfg_.group_name, payload_size,
+    return TrunkAllocRpc(ip, port, cfg_.group_name, payload_size, epoch,
                          kTrunkRpcTimeoutMs);
   return std::nullopt;
 }
@@ -1221,11 +1259,13 @@ void StorageServer::TrunkFree(const TrunkLocation& loc) {
   std::shared_ptr<TrunkAllocator> alloc;
   std::string trunk_ip;
   int trunk_port = 0;
+  int64_t epoch = 0;
   {
     std::lock_guard<std::mutex> lk(trunk_mu_);
     if (is_trunk_server_) alloc = trunk_alloc_;
     trunk_ip = trunk_ip_;
     trunk_port = trunk_port_;
+    epoch = trunk_epoch_;
   }
   if (alloc != nullptr) {
     alloc->Free(loc);
@@ -1236,7 +1276,7 @@ void StorageServer::TrunkFree(const TrunkLocation& loc) {
   // remaining replicas free theirs via the 'd' binlog replay.)
   MarkSlotFree(store_.store_path(0), loc);
   if (trunk_port > 0) {
-    if (!TrunkFreeRpc(trunk_ip, trunk_port, cfg_.group_name, loc,
+    if (!TrunkFreeRpc(trunk_ip, trunk_port, cfg_.group_name, loc, epoch,
                       kTrunkRpcTimeoutMs))
       FDFS_LOG_WARN("trunk free RPC failed (id=%u off=%u): slot leaked until "
                     "the free-block checker reclaims it",
@@ -1270,13 +1310,15 @@ std::string StorageServer::TrunkStoreUpload(Conn* c) {
   bool am_trunk;
   std::string tip;
   int tport;
+  int64_t tepoch;
   {
     std::lock_guard<std::mutex> lk(trunk_mu_);
     am_trunk = is_trunk_server_;
     tip = trunk_ip_;
     tport = trunk_port_;
+    tepoch = trunk_epoch_;
   }
-  if (!am_trunk) TrunkConfirmRpc(tip, tport, cfg_.group_name, *loc,
+  if (!am_trunk) TrunkConfirmRpc(tip, tport, cfg_.group_name, *loc, tepoch,
                                  kTrunkRpcTimeoutMs);
   return id;
 }
@@ -1291,13 +1333,36 @@ void StorageServer::HandleTrunkRpc(Conn* c) {
   }
   std::shared_ptr<TrunkAllocator> alloc;
   int64_t slot_max;
+  int64_t my_epoch;
   {
     std::lock_guard<std::mutex> lk(trunk_mu_);
     if (is_trunk_server_) alloc = trunk_alloc_;
     slot_max = slot_max_size_;
+    my_epoch = trunk_epoch_;
   }
   if (alloc == nullptr) {
     Respond(c, 1 /*EPERM: not the trunk server*/);
+    return;
+  }
+  // Epoch fencing: the RPC's trailing 8 bytes carry the caller's trunk
+  // epoch (tracker-bumped on every role change).  A mismatch means a
+  // stale trunk server serving after the role moved, or a stale client
+  // — either way refuse (the caller falls back to a flat file) instead
+  // of allocating a slot another server also thinks it owns.
+  bool is_alloc = cmd == StorageCmd::kTrunkAllocSpace;
+  size_t base = is_alloc ? 16u + 8u : 16u + 12u;
+  if (c->fixed.size() < base + 8) {
+    // The epoch is MANDATORY — an optional fence is no fence.
+    Respond(c, 22);
+    return;
+  }
+  int64_t caller_epoch = GetInt64BE(
+      reinterpret_cast<const uint8_t*>(c->fixed.data()) + base);
+  if (caller_epoch != my_epoch) {
+    FDFS_LOG_WARN("trunk RPC epoch mismatch (caller %lld, mine %lld): "
+                  "refusing", static_cast<long long>(caller_epoch),
+                  static_cast<long long>(my_epoch));
+    Respond(c, 16 /*EBUSY: stale role*/);
     return;
   }
   if (cmd == StorageCmd::kTrunkAllocSpace) {

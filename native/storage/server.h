@@ -154,8 +154,11 @@ class StorageServer {
     NioThread* owner = nullptr;   // the nio loop this conn lives on
     bool async_pending = false;   // a dio worker owns the request right now
     bool dead = false;            // closed while async_pending: zombie
-    // access log bookkeeping
+    // access log bookkeeping (per-stage timings, SURVEY.md §5: the
+    // rebuild logs recv/work splits, not just the total)
     int64_t req_start_us = 0;
+    int64_t recv_done_us = 0;   // body fully received (recv stage end)
+    int64_t work_start_us = 0;  // dio-stage begin (fingerprint/write)
     std::string peer_ip;
   };
 
@@ -311,6 +314,7 @@ class StorageServer {
   int64_t trunk_file_size_ = 64LL * 1024 * 1024;
   std::string trunk_ip_;
   int trunk_port_ = 0;
+  int64_t trunk_epoch_ = 0;  // fencing token (see trunk.h RPC note)
   bool is_trunk_server_ = false;
   // Role-regain safety: after losing and regaining the trunk role, hold
   // this many seconds before rescanning (interim allocations may still be

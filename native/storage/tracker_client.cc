@@ -156,10 +156,13 @@ bool TrackerReporter::ParsePeers(const std::string& body,
   bool have_trailer = body.size() >= tail + kIpAddressSize + 8;
   std::string tip;
   int tport = 0;
+  int64_t tepoch = 0;
   if (have_trailer) {
     const uint8_t* q = p + tail;
     tip = GetFixedField(q, kIpAddressSize);
     tport = static_cast<int>(GetInt64BE(q + kIpAddressSize));
+    if (body.size() >= tail + kIpAddressSize + 16)
+      tepoch = GetInt64BE(q + kIpAddressSize + 8);
   }
   {
     std::lock_guard<std::mutex> lk(mu_);
@@ -168,6 +171,7 @@ bool TrackerReporter::ParsePeers(const std::string& body,
     if (have_trailer) {
       trunk_ip_ = tip;
       trunk_port_ = tport;
+      trunk_epoch_ = tepoch;
     }
   }
   return true;
@@ -185,6 +189,11 @@ void TrackerReporter::NotifyPeersChanged() {
 std::pair<std::string, int> TrackerReporter::trunk_server() const {
   std::lock_guard<std::mutex> lk(mu_);
   return {trunk_ip_, trunk_port_};
+}
+
+int64_t TrackerReporter::trunk_epoch() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return trunk_epoch_;
 }
 
 bool TrackerReporter::DoJoin(int fd, int64_t* chlog_off) {

@@ -56,6 +56,7 @@ class TrackerReporter {
   std::map<std::string, std::string> cluster_params() const;
   // Group's elected trunk server from the latest beat ("" / 0 when none).
   std::pair<std::string, int> trunk_server() const;
+  int64_t trunk_epoch() const;  // fencing token for trunk RPCs
 
  private:
   void ThreadMain(std::string host, int port);
@@ -98,6 +99,7 @@ class TrackerReporter {
   std::map<std::string, std::string> cluster_params_;
   std::string trunk_ip_;
   int trunk_port_ = 0;
+  int64_t trunk_epoch_ = 0;
   // Identity recorded at process start (read once, BEFORE any thread
   // rewrites the identity file): every tracker thread must send the
   // rename RPC from the same old->new view, or slower threads would read

@@ -8,6 +8,8 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <unordered_map>
+#include <unordered_set>
 
 #include "common/bytes.h"
 #include "common/fsutil.h"
@@ -269,6 +271,7 @@ std::optional<TrunkLocation> TrunkAllocator::CreateTrunkFileLocked(
   loc.trunk_id = id;
   loc.offset = 0;
   loc.alloc_size = static_cast<uint32_t>(trunk_file_size_);
+  clean_files_.insert(id);
   return loc;
 }
 
@@ -295,6 +298,7 @@ std::optional<TrunkLocation> TrunkAllocator::Alloc(int64_t payload_size) {
     if (it->second.empty()) free_.erase(it);
   }
 
+  clean_files_.erase(block.trunk_id);  // a peer may now learn of this file
   int fd = OpenTrunkFd(store_path_, block.trunk_id, /*create=*/false);
   if (fd < 0) {
     // Popped block goes back on ANY failure — a transient EIO must not
@@ -340,6 +344,58 @@ std::optional<TrunkLocation> TrunkAllocator::Alloc(int64_t payload_size) {
   out.offset = block.offset;
   out.alloc_size = used;
   return out;
+}
+
+int TrunkAllocator::EnsureFreeReserve(int64_t min_free_bytes) {
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t have = 0;
+  for (const auto& [size, blocks] : free_)
+    have += size * static_cast<int64_t>(blocks.size());
+  int created = 0;
+  while (have < min_free_bytes) {
+    std::string err;
+    auto loc = CreateTrunkFileLocked(&err);
+    if (!loc.has_value()) {
+      FDFS_LOG_WARN("trunk pre-allocation stopped: %s", err.c_str());
+      break;
+    }
+    free_[loc->alloc_size].push_back({loc->trunk_id, loc->offset});
+    have += loc->alloc_size;
+    ++created;
+  }
+  return created;
+}
+
+int TrunkAllocator::ReclaimEmptyFiles(int keep) {
+  std::lock_guard<std::mutex> lk(mu_);
+  // A trunk file is reclaimable when its free blocks cover every byte
+  // (frees are not merged, so sum per trunk id).
+  std::unordered_map<uint32_t, int64_t> free_per_file;
+  for (const auto& [size, blocks] : free_)
+    for (const Block& b : blocks) free_per_file[b.trunk_id] += size;
+  std::vector<uint32_t> empty;
+  for (const auto& [id, bytes] : free_per_file)
+    if (bytes >= trunk_file_size_ && clean_files_.count(id)) empty.push_back(id);
+  if (static_cast<int>(empty.size()) <= keep) return 0;
+  std::sort(empty.begin(), empty.end());
+  // Keep the LOWEST ids as the hot reserve; reclaim the rest.
+  std::unordered_set<uint32_t> victims(empty.begin() + keep, empty.end());
+  for (auto it = free_.begin(); it != free_.end();) {
+    auto& blocks = it->second;
+    blocks.erase(std::remove_if(blocks.begin(), blocks.end(),
+                                [&](const Block& b) {
+                                  return victims.count(b.trunk_id) > 0;
+                                }),
+                 blocks.end());
+    it = blocks.empty() ? free_.erase(it) : std::next(it);
+  }
+  for (uint32_t id : victims) {
+    clean_files_.erase(id);
+    unlink(TrunkFilePath(store_path_, id).c_str());
+  }
+  FDFS_LOG_INFO("trunk compaction: reclaimed %zu empty trunk files",
+                victims.size());
+  return static_cast<int>(victims.size());
 }
 
 bool TrunkAllocator::Free(const TrunkLocation& loc) {
@@ -484,11 +540,13 @@ std::string PackLoc(const TrunkLocation& loc) {
 std::optional<TrunkLocation> TrunkAllocRpc(const std::string& ip, int port,
                                            const std::string& group,
                                            int64_t payload_size,
-                                           int timeout_ms) {
+                                           int64_t epoch, int timeout_ms) {
   std::string body;
   PutFixedField(&body, group, kGroupNameMaxLen);
   char num[8];
   PutInt64BE(payload_size, reinterpret_cast<uint8_t*>(num));
+  body.append(num, 8);
+  PutInt64BE(epoch, reinterpret_cast<uint8_t*>(num));
   body.append(num, 8);
   std::string resp;
   uint8_t status = 0;
@@ -505,10 +563,13 @@ std::optional<TrunkLocation> TrunkAllocRpc(const std::string& ip, int port,
 }
 
 bool TrunkConfirmRpc(const std::string& ip, int port, const std::string& group,
-                     const TrunkLocation& loc, int timeout_ms) {
+                     const TrunkLocation& loc, int64_t epoch, int timeout_ms) {
   std::string body;
   PutFixedField(&body, group, kGroupNameMaxLen);
   body += PackLoc(loc);
+  char num[8];
+  PutInt64BE(epoch, reinterpret_cast<uint8_t*>(num));
+  body.append(num, 8);
   std::string resp;
   uint8_t status = 0;
   return TrunkRpc(ip, port,
@@ -518,10 +579,13 @@ bool TrunkConfirmRpc(const std::string& ip, int port, const std::string& group,
 }
 
 bool TrunkFreeRpc(const std::string& ip, int port, const std::string& group,
-                  const TrunkLocation& loc, int timeout_ms) {
+                  const TrunkLocation& loc, int64_t epoch, int timeout_ms) {
   std::string body;
   PutFixedField(&body, group, kGroupNameMaxLen);
   body += PackLoc(loc);
+  char num[8];
+  PutInt64BE(epoch, reinterpret_cast<uint8_t*>(num));
+  body.append(num, 8);
   std::string resp;
   uint8_t status = 0;
   return TrunkRpc(ip, port,

@@ -26,6 +26,7 @@
 #include <cstdint>
 #include <map>
 #include <mutex>
+#include <unordered_set>
 #include <optional>
 #include <string>
 #include <vector>
@@ -97,6 +98,19 @@ class TrunkAllocator {
   // mismatched blocks (0 = consistent).
   int VerifyFreeMap(std::string* report) const;
 
+  // Pre-allocation (reference: trunk_create_file_advance): create fresh
+  // trunk files until at least `min_free_bytes` of pool capacity exists,
+  // so allocation bursts never pay file-creation latency inline.
+  // Returns the number of files created.
+  int EnsureFreeReserve(int64_t min_free_bytes);
+
+  // Compaction: unlink fully-free trunk files that were NEVER allocated
+  // from (pre-created reserve only; keeping `keep` as the hot reserve).
+  // Files that ever held a slot are excluded — their creation replicated
+  // to group peers via slot writes, and a local unlink would silently
+  // diverge the group's on-disk trunk sets.  Returns files reclaimed.
+  int ReclaimEmptyFiles(int keep = 1);
+
  private:
   struct Block {
     uint32_t trunk_id;
@@ -111,18 +125,27 @@ class TrunkAllocator {
   std::string store_path_;
   int64_t trunk_file_size_ = 0;
   uint32_t next_id_ = 0;
+  // Trunk ids created this run and never allocated from: the only files
+  // compaction may unlink (no peer has ever seen them).  Scan-rebuilt
+  // files are conservatively excluded.
+  std::unordered_set<uint32_t> clean_files_;
   // size -> blocks of exactly that size (best-fit via lower_bound).
   std::map<int64_t, std::vector<Block>> free_;
 };
 
 // -- trunk server RPCs (storage <-> elected trunk server, cmds 27-29) ----
+// Every RPC carries the caller's trunk EPOCH (the tracker bumps it on
+// each trunk-server change): the serving trunk server rejects a
+// mismatch, so neither a stale trunk server nor a stale client can
+// allocate against a moved role (the split-brain the round-2 advisor
+// flagged; the regain grace now only covers replication lag).
 std::optional<TrunkLocation> TrunkAllocRpc(const std::string& ip, int port,
                                            const std::string& group,
                                            int64_t payload_size,
-                                           int timeout_ms);
+                                           int64_t epoch, int timeout_ms);
 bool TrunkConfirmRpc(const std::string& ip, int port, const std::string& group,
-                     const TrunkLocation& loc, int timeout_ms);
+                     const TrunkLocation& loc, int64_t epoch, int timeout_ms);
 bool TrunkFreeRpc(const std::string& ip, int port, const std::string& group,
-                  const TrunkLocation& loc, int timeout_ms);
+                  const TrunkLocation& loc, int64_t epoch, int timeout_ms);
 
 }  // namespace fdfs

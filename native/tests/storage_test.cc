@@ -221,6 +221,46 @@ static void TestTrunkReplicaWrite() {
   CHECK(!ReadSlotPayload(dir, loc, 900).has_value());
 }
 
+static void TestTrunkReserveAndCompaction() {
+  std::string dir = TempDir();
+  TrunkAllocator alloc;
+  std::string err;
+  CHECK(alloc.Init(dir, 1 << 20, &err));
+  CHECK(alloc.trunk_file_count() == 0);
+
+  // Pre-allocation: demand a 3 MB reserve -> 3 fresh 1 MB trunk files,
+  // all free; idempotent once satisfied.
+  CHECK(alloc.EnsureFreeReserve(3 << 20) == 3);
+  CHECK(alloc.trunk_file_count() == 3);
+  CHECK(alloc.free_bytes() == 3 << 20);
+  CHECK(alloc.EnsureFreeReserve(3 << 20) == 0);
+
+  // Allocations now come from the reserve without creating files.
+  auto a = alloc.Alloc(4000);
+  CHECK(a.has_value());
+  CHECK(alloc.trunk_file_count() == 3);
+
+  // Compaction: with one slot live, exactly the OTHER fully-free files
+  // beyond the keep=1 reserve are reclaimed.
+  CHECK(alloc.ReclaimEmptyFiles(/*keep=*/1) == 1);
+  std::string report;
+  CHECK(alloc.VerifyFreeMap(&report) == 0);
+
+  // The live slot still reads back; freeing it makes its file
+  // reclaimable too (keep=0 clears everything).
+  std::string pa(4000, 'q');
+  CHECK(WriteSlotPayload(dir, *a, pa, 7, &err));
+  auto ra = ReadSlotPayload(dir, *a, 4000);
+  CHECK(ra.has_value() && *ra == pa);
+  CHECK(alloc.Free(*a));
+  CHECK(alloc.ReclaimEmptyFiles(/*keep=*/0) >= 1);
+
+  // A scan-rebuild of the compacted dir agrees with the pool.
+  TrunkAllocator alloc2;
+  CHECK(alloc2.Init(dir, 1 << 20, &err));
+  CHECK(alloc2.VerifyFreeMap(&report) == 0);
+}
+
 int main() {
   TestBinlogRecordCodec();
   TestBinlogWriteReadResume();
@@ -228,6 +268,7 @@ int main() {
   TestCpuDedup();
   TestStoreInit();
   TestTrunkAllocator();
+  TestTrunkReserveAndCompaction();
   TestTrunkReplicaWrite();
   if (g_failures == 0) {
     std::printf("storage_test: ALL PASS\n");

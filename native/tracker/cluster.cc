@@ -215,9 +215,12 @@ void Cluster::EnsureTrunkServer(GroupInfo* g) {
   }
   std::string chosen = pick == nullptr ? "" : pick->Addr();
   if (chosen != g->trunk_addr) {
-    FDFS_LOG_INFO("group %s trunk server: %s -> %s", g->name.c_str(),
+    g->trunk_epoch++;
+    FDFS_LOG_INFO("group %s trunk server: %s -> %s (epoch %lld)",
+                  g->name.c_str(),
                   g->trunk_addr.empty() ? "(none)" : g->trunk_addr.c_str(),
-                  chosen.empty() ? "(none)" : chosen.c_str());
+                  chosen.empty() ? "(none)" : chosen.c_str(),
+                  static_cast<long long>(g->trunk_epoch));
     g->trunk_addr = chosen;
   }
 }
@@ -230,16 +233,25 @@ std::string Cluster::TrunkServer(const std::string& group) {
 }
 
 void Cluster::AdoptTrunkServer(const std::string& group,
-                               const std::string& addr) {
+                               const std::string& addr, int64_t epoch) {
   GroupInfo* g = FindGroup(group);
   if (g == nullptr) return;
-  if (g->trunk_addr != addr) {
-    FDFS_LOG_INFO("group %s trunk server adopted from leader: %s -> %s",
-                  g->name.c_str(),
+  if (g->trunk_addr != addr || g->trunk_epoch != epoch) {
+    FDFS_LOG_INFO("group %s trunk server adopted from leader: %s -> %s "
+                  "(epoch %lld)", g->name.c_str(),
                   g->trunk_addr.empty() ? "(none)" : g->trunk_addr.c_str(),
-                  addr.empty() ? "(none)" : addr.c_str());
+                  addr.empty() ? "(none)" : addr.c_str(),
+                  static_cast<long long>(epoch));
     g->trunk_addr = addr;
+    // Followers mirror the LEADER's epoch (bumping locally would
+    // diverge the fencing token across trackers).
+    g->trunk_epoch = epoch;
   }
+}
+
+int64_t Cluster::TrunkEpoch(const std::string& group) const {
+  auto it = groups_.find(group);
+  return it == groups_.end() ? 0 : it->second.trunk_epoch;
 }
 
 std::string Cluster::CurrentTrunkAddr(const std::string& group) const {
@@ -253,9 +265,11 @@ bool Cluster::SetTrunkServer(const std::string& group,
   if (g == nullptr) return false;
   auto it = g->storages.find(addr);
   if (it == g->storages.end() || it->second.status != kActive) return false;
+  if (g->trunk_addr != addr) g->trunk_epoch++;
   g->trunk_addr = addr;
-  FDFS_LOG_INFO("group %s trunk server set to %s by operator", group.c_str(),
-                addr.c_str());
+  FDFS_LOG_INFO("group %s trunk server set to %s by operator (epoch %lld)",
+                group.c_str(), addr.c_str(),
+                static_cast<long long>(g->trunk_epoch));
   return true;
 }
 
@@ -590,8 +604,11 @@ bool Cluster::Save(const std::string& path) const {
   if (f == nullptr) return false;
   for (const auto& [gname, g] : groups_) {
     fprintf(f, "group %s\n", gname.c_str());
-    if (!g.trunk_addr.empty())
-      fprintf(f, "trunk %s\n", g.trunk_addr.c_str());
+    // "-" = no trunk server; the EPOCH is written regardless — fencing
+    // tokens must stay monotonic across tracker restarts.
+    fprintf(f, "trunk %s %lld\n",
+            g.trunk_addr.empty() ? "-" : g.trunk_addr.c_str(),
+            static_cast<long long>(g.trunk_epoch));
     for (const auto& [addr, s] : g.storages) {
       fprintf(f, "storage %s %d %d %d %lld %lld %lld %lld", s.ip.c_str(),
               s.port, s.status, s.store_path_count,
@@ -627,8 +644,11 @@ bool Cluster::Load(const std::string& path) {
       groups_[cur_group].name = cur_group;
       continue;
     }
-    if (sscanf(line, "trunk %255s", a) == 1 && !cur_group.empty()) {
-      groups_[cur_group].trunk_addr = a;
+    long long ep = 0;
+    if (sscanf(line, "trunk %255s %lld", a, &ep) >= 1 && !cur_group.empty() &&
+        strncmp(line, "trunk ", 6) == 0) {
+      groups_[cur_group].trunk_addr = strcmp(a, "-") == 0 ? "" : a;
+      groups_[cur_group].trunk_epoch = ep;
       continue;
     }
     StorageNode s;

@@ -56,6 +56,10 @@ struct GroupInfo {
   std::map<std::string, StorageNode> storages;  // key "ip:port"
   size_t rr_write = 0;
   size_t rr_read = 0;
+  // Bumped every time trunk_addr changes: the allocation fencing token
+  // (trunk RPCs carry it; a stale trunk server or stale client is
+  // rejected instead of silently allocating against a moved role).
+  int64_t trunk_epoch = 0;
   // Elected trunk server "ip:port" (empty when trunk is off or the group
   // has no ACTIVE member).  Reference: the tracker leader decides the
   // per-group trunk server (tracker_relationship.c / SetTrunkServer 94).
@@ -136,7 +140,9 @@ class Cluster {
   // Operator override (SERVER_SET_TRUNK_SERVER 94); target must be ACTIVE.
   bool SetTrunkServer(const std::string& group, const std::string& addr);
   // Follower-side: adopt the leader's decision verbatim (no election).
-  void AdoptTrunkServer(const std::string& group, const std::string& addr);
+  void AdoptTrunkServer(const std::string& group, const std::string& addr,
+                        int64_t epoch);
+  int64_t TrunkEpoch(const std::string& group) const;
   // Read the current value without electing (followers, introspection).
   std::string CurrentTrunkAddr(const std::string& group) const;
 

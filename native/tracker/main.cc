@@ -5,6 +5,7 @@
 #include <cstdio>
 
 #include "common/ini.h"
+#include "common/fsutil.h"
 #include "common/log.h"
 #include "tracker/server.h"
 
@@ -40,6 +41,8 @@ int main(int argc, char** argv) {
       static_cast<int>(ini.GetSeconds("check_active_interval", 100));
   cfg.save_interval_s = static_cast<int>(ini.GetSeconds("save_interval", 30));
   cfg.log_level = ini.GetStr("log_level", "info");
+  cfg.log_file = ini.GetStr("log_file", "");
+  cfg.log_rotate_size = ini.GetBytes("log_rotate_size", cfg.log_rotate_size);
   cfg.use_trunk_file = ini.GetBool("use_trunk_file", false);
   cfg.slot_min_size = static_cast<int>(ini.GetInt("slot_min_size", 256));
   cfg.slot_max_size =
@@ -72,6 +75,7 @@ int main(int argc, char** argv) {
   if (cfg.log_level == "debug") fdfs::LogSetLevel(fdfs::LogLevel::kDebug);
   else if (cfg.log_level == "warn") fdfs::LogSetLevel(fdfs::LogLevel::kWarn);
   else if (cfg.log_level == "error") fdfs::LogSetLevel(fdfs::LogLevel::kError);
+  fdfs::LogSetupFileSink(cfg.base_path, cfg.log_file, cfg.log_rotate_size);
 
   fdfs::TrackerServer server(cfg);
   if (!server.Init(&err)) {

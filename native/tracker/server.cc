@@ -199,7 +199,12 @@ std::string TrackerServer::ResolveTrunkServer(const std::string& group) {
             static_cast<uint8_t>(TrackerCmd::kTrackerGetTrunkServer), body,
             &resp, &status, /*timeout_ms=*/300) &&
         status == 0) {
-      cluster_->AdoptTrunkServer(group, resp);
+      size_t nl = resp.find('\n');
+      int64_t epoch = nl == std::string::npos
+                          ? cluster_->TrunkEpoch(group)
+                          : atoll(resp.c_str() + nl + 1);
+      cluster_->AdoptTrunkServer(
+          group, nl == std::string::npos ? resp : resp.substr(0, nl), epoch);
     } else {
       fetched = now_ms + 9000;
     }
@@ -274,6 +279,10 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       PutFixedField(&out, tip, kIpAddressSize);
       char pbuf[8];
       PutInt64BE(tport, reinterpret_cast<uint8_t*>(pbuf));
+      out.append(pbuf, 8);
+      // +8B trunk epoch: the allocation fencing token (see cluster.h).
+      PutInt64BE(cluster_->TrunkEpoch(group),
+                 reinterpret_cast<uint8_t*>(pbuf));
       out.append(pbuf, 8);
       return {0, out};
     }
@@ -519,7 +528,10 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       if (body.size() < 16) return {22, ""};
       if (relationship_ != nullptr && !relationship_->am_leader())
         return {16 /*EBUSY*/, ""};
-      return {0, cluster_->TrunkServer(FixedGroup(p))};
+      std::string grp = FixedGroup(p);
+      std::string taddr = cluster_->TrunkServer(grp);
+      return {0, taddr + "\n" +
+                     std::to_string(cluster_->TrunkEpoch(grp))};
     }
 
     case TrackerCmd::kServiceQueryFetchOne:

@@ -28,6 +28,8 @@ struct TrackerConfig {
   int check_active_interval_s = 100;
   int save_interval_s = 30;
   std::string log_level = "info";
+  std::string log_file;               // empty = stderr
+  int64_t log_rotate_size = 256LL << 20;
   // Cluster-global storage parameters served via kStorageParameterReq
   // (storage_param_getter.c: every group member must agree on these).
   bool use_trunk_file = false;

@@ -39,11 +39,14 @@ def test_access_log_lines(tmp_path_factory):
     assert os.path.exists(log_path)
     lines = open(log_path).read().strip().splitlines()
     assert len(lines) >= 2  # upload + download
-    # "<ts> <ip> <cmd> <status> <bytes> <cost_us>"
+    # "<ts> <ip> <cmd> <status> <bytes> <cost_us> <recv_us> <work_us>"
+    # — per-stage split (SURVEY.md §5): recv = body window, work = dio
     for line in lines:
-        ts, ip, cmd, status, nbytes, cost = line.split()
+        ts, ip, cmd, status, nbytes, cost, recv_us, work_us = line.split()
         assert int(ts) > 0 and ip == "127.0.0.1"
         assert int(status) == 0 and int(cost) >= 0
+        assert int(recv_us) >= 0 and int(work_us) >= 0
+        assert int(recv_us) <= int(cost) and int(work_us) <= int(cost)
     cmds = {int(l.split()[2]) for l in lines}
     assert 11 in cmds and 14 in cmds  # UPLOAD_FILE, DOWNLOAD_FILE
 
@@ -183,3 +186,26 @@ def test_cli_tools_end_to_end(tmp_path_factory, tmp_path):
     finally:
         s.stop()
         tracker.stop()
+
+
+def test_log_rotation_by_size(tmp_path_factory):
+    """logger.c parity: the file sink rotates when it exceeds
+    log_rotate_size (rotated copies keep a timestamp suffix)."""
+    import glob
+
+    base = tmp_path_factory.mktemp("rot")
+    extra = "log_file = storaged.log\nlog_rotate_size = 256"
+    port = free_port()
+    # each boot writes a few hundred bytes of INFO; with a 256-byte limit
+    # every restart's first write must rotate the previous file out
+    for _ in range(3):
+        storage = start_storage(base, port=port, extra=extra)
+        with StorageClient("127.0.0.1", port) as c:
+            c.upload_buffer(b"rotate me")
+        storage.stop()
+    logs = glob.glob(os.path.join(str(base), "logs", "storaged.log*"))
+    assert any(p.endswith("storaged.log") for p in logs)
+    rotated = [p for p in logs if not p.endswith("storaged.log")]
+    assert rotated, f"no rotated log files in {logs}"
+    for p in rotated:  # rotated names carry the timestamp suffix
+        assert os.path.basename(p).startswith("storaged.log.")

@@ -221,3 +221,67 @@ def test_slave_of_trunk_master(cluster, fdfs):
     assert sinfo.slave and sinfo.trunk_loc is None  # flat storage
     assert fdfs.download_to_buffer(slave) == b"S" * 500
     assert fdfs.download_to_buffer(master) == b"M" * 3000
+
+
+
+def test_trunk_rpc_epoch_fencing(tmp_path_factory):
+    """Trunk RPCs carry the tracker-bumped trunk epoch; a mismatched
+    caller (stale view of the role) is refused with EBUSY instead of
+    being handed a slot another server may also think it owns."""
+    import socket
+    import struct
+
+    from fastdfs_tpu.common.protocol import StorageCmd
+
+    tracker = start_tracker(tmp_path_factory.mktemp("tr"),
+                            extra="use_trunk_file = 1\n"
+                                  "slot_max_size = 262144\n"
+                                  "trunk_file_size = 1048576")
+    base = tmp_path_factory.mktemp("ep")
+    storage = start_storage(base, trackers=[f"127.0.0.1:{tracker.port}"],
+                            extra=HB)
+    cli = FdfsClient([f"127.0.0.1:{tracker.port}"])
+    try:
+        # trunk role + a first trunk upload prove the matched-epoch path
+        fid = None
+        deadline = time.time() + 25
+        while time.time() < deadline:
+            try:
+                fid = cli.upload_buffer(b"e" * 4096, ext="bin")
+                from fastdfs_tpu.common.fileid import decode_file_id
+                p, _ = decode_file_id(fid)
+                if p.trunk_loc is not None:
+                    break
+                cli.delete_file(fid)
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert fid is not None
+
+        def alloc_rpc(epoch):
+            body = b"group1".ljust(16, b"\x00") + struct.pack(">q", 4096)
+            body += struct.pack(">q", epoch)
+            s = socket.create_connection(("127.0.0.1", storage.port),
+                                         timeout=10)
+            try:
+                s.sendall(struct.pack(">qBB", len(body),
+                                      StorageCmd.TRUNK_ALLOC_SPACE, 0) + body)
+                hdr = b""
+                while len(hdr) < 10:
+                    got = s.recv(10 - len(hdr))
+                    assert got
+                    hdr += got
+                ln, _, status = struct.unpack(">qBB", hdr)
+                if ln:
+                    rest = b""
+                    while len(rest) < ln:
+                        rest += s.recv(ln - len(rest))
+                return status
+            finally:
+                s.close()
+
+        # a wildly stale epoch is refused with EBUSY(16)
+        assert alloc_rpc(999_999) == 16
+    finally:
+        storage.stop()
+        tracker.stop()
