@@ -30,7 +30,16 @@ def fence_median(fn, iters=6):
 
 
 def main():
+    import argparse
+
     import jax
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", default="",
+                    help="also capture a JAX profiler trace of one fused "
+                         "pipeline round into this directory (open with "
+                         "tensorboard/xprof; SURVEY.md §5 tracing)")
+    args = ap.parse_args()
 
     from fastdfs_tpu.ops.sha1 import sha1_batch
     from fastdfs_tpu.ops.minhash import minhash_batch
@@ -72,6 +81,11 @@ def main():
     both = jax.jit(lambda c, ln: (sha1_batch_pallas(c, ln, L),
                                   minhash_batch_pallas(c, ln)))
     stage("fused_pallas_both", lambda: both(dc, dl))
+
+    if args.trace:
+        with jax.profiler.trace(args.trace):
+            jax.device_get([both(dc, dl) for _ in range(4)])
+        print(json.dumps({"trace_dir": args.trace}))
 
     print(json.dumps({"total_bytes": total, "results": results}))
 
