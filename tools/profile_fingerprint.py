@@ -3,8 +3,10 @@
 
 Times each stage of the dedup fingerprint path in isolation on the real
 device (median of steady-state iters, full device_get fence), so the
-headline bench number is explainable instead of guessed at.  Run with
-no args; prints one JSON object per stage.  The round-3 breakdown that
+headline bench number is explainable instead of guessed at.  Prints one
+JSON object per stage, then a final summary object; ``--trace DIR``
+additionally captures a JAX profiler trace of the fused pipeline (one
+extra ``{"trace_dir": ...}`` line).  The round-3 breakdown that
 justified the bench.py rewrite is checked in at tools/PROFILE_r03.md.
 """
 
@@ -32,14 +34,14 @@ def fence_median(fn, iters=6):
 def main():
     import argparse
 
-    import jax
-
     ap = argparse.ArgumentParser()
     ap.add_argument("--trace", default="",
                     help="also capture a JAX profiler trace of one fused "
                          "pipeline round into this directory (open with "
                          "tensorboard/xprof; SURVEY.md §5 tracing)")
-    args = ap.parse_args()
+    args = ap.parse_args()  # before the heavy jax import: --help stays fast
+
+    import jax
 
     from fastdfs_tpu.ops.sha1 import sha1_batch
     from fastdfs_tpu.ops.minhash import minhash_batch
