@@ -168,6 +168,11 @@ class StorageServer {
     std::unordered_map<int, std::unique_ptr<Conn>> conns;  // loop-thread only
     std::vector<std::unique_ptr<Conn>> zombies;            // await dio done
   };
+  // Honest divergence from the reference's fast_task_queue.c pooled-task
+  // buffers: each Conn owns its recv/send std::strings, which retain
+  // their capacity across requests on a kept-alive connection — the
+  // steady-state allocation behavior of the pool without the free-list.
+  // The queue half of fast_task_queue maps to WorkerPool (workers.h).
 
   // -- nio ---------------------------------------------------------------
   EventLoop* ConnLoop(Conn* c) { return c->owner ? c->owner->loop.get() : &loop_; }
