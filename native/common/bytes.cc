@@ -184,6 +184,12 @@ Sha1Stream::Sha1Stream() : total_(0), buf_len_(0) {
   h_[4] = 0xC3D2E1F0u;
 }
 
+// SHA-NI hardware path (common/sha1_ni.cc, own TU: needs -msha);
+// resolved once — __builtin_cpu_supports reads cpuid.
+bool Sha1NiSupported();
+void Sha1NiCompress(uint32_t h[5], const uint8_t* data, size_t nblocks);
+static const bool kHaveSha1Ni = Sha1NiSupported();
+
 void Sha1Stream::Update(const void* data, size_t len) {
   const uint8_t* p = static_cast<const uint8_t*>(data);
   total_ += len;
@@ -195,14 +201,20 @@ void Sha1Stream::Update(const void* data, size_t len) {
     p += take;
     len -= take;
     if (buf_len_ == 64) {
-      Sha1Compress(h_, buf_);
+      if (kHaveSha1Ni) Sha1NiCompress(h_, buf_, 1);
+      else Sha1Compress(h_, buf_);
       buf_len_ = 0;
     }
   }
-  while (len >= 64) {
-    Sha1Compress(h_, p);
-    p += 64;
-    len -= 64;
+  if (len >= 64) {
+    size_t nblocks = len / 64;
+    if (kHaveSha1Ni) {
+      Sha1NiCompress(h_, p, nblocks);
+    } else {
+      for (size_t i = 0; i < nblocks; ++i) Sha1Compress(h_, p + i * 64);
+    }
+    p += nblocks * 64;
+    len -= nblocks * 64;
   }
   if (len > 0) {
     std::memcpy(buf_, p, len);
