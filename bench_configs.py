@@ -108,6 +108,25 @@ def _storage_rows(cli):
     return cli._tracker().list_storages("group1")
 
 
+def _settled_saved(cli, idx=0, timeout=20.0):
+    """dedup_bytes_saved after the beat-reported stat stops moving.
+
+    Storage stats reach the tracker on stat_report_interval (1 s here);
+    sampling right after the upload loop races the last report and the
+    missing tail scales with upload speed — two consecutive equal reads
+    make the number deterministic."""
+    last = -1
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rows = _storage_rows(cli)
+        cur = int(rows[idx].get("dedup_bytes_saved", 0)) if rows else 0
+        if cur == last:
+            return cur
+        last = cur
+        time.sleep(1.2)
+    return last
+
+
 # ---------------------------------------------------------------------------
 
 def config1(out_dir: str, scale: float) -> None:
@@ -153,7 +172,7 @@ def config1(out_dir: str, scale: float) -> None:
         with concurrent.futures.ThreadPoolExecutor(workers) as ex:
             sent = sum(ex.map(feed, range(workers)))
         dt = time.perf_counter() - t0
-        rows = _storage_rows(cli)
+        saved = _settled_saved(cli)
         emit(out_dir, 1, {
             "description": "single node, 256KB random chunks, exact dedup",
             "nominal_bytes": NOMINAL[1], "scaled_bytes": sent,
@@ -163,8 +182,7 @@ def config1(out_dir: str, scale: float) -> None:
             "uploads_per_sec": round(workers * per_worker / dt, 1),
             "cpu_crc32_GBps": round(crc_gbps, 3),
             "cpu_sha1_GBps": round(sha_gbps, 3),
-            "dedup_bytes_saved": int(rows[0].get("dedup_bytes_saved", 0))
-            if rows else 0,
+            "dedup_bytes_saved": saved,
         })
     finally:
         _stop(tr, sts)
@@ -234,8 +252,7 @@ def config2(out_dir: str, scale: float) -> None:
             cli.upload_buffer(d, ext="txt")
             sent += len(d)
         dt = time.perf_counter() - t0
-        rows = _storage_rows(cli)
-        saved = int(rows[0].get("dedup_bytes_saved", 0)) if rows else 0
+        saved = _settled_saved(cli)
         emit(out_dir, 2, {
             "description": "single node, gear CDC on text corpus",
             "nominal_bytes": NOMINAL[2], "scaled_bytes": sent,
@@ -308,6 +325,7 @@ def config3(out_dir: str, scale: float) -> None:
                 break
             time.sleep(0.5)
         repl_dt = time.perf_counter() - t0
+        _settled_saved(cli)
         rows = _storage_rows(cli)
         emit(out_dir, 3, {
             "description": "1 tracker + 2 storages, SHA1 exact dedup, "

@@ -9,7 +9,10 @@
 #include <cstddef>
 #include <cstdint>
 
-#if defined(__x86_64__)
+#if defined(__x86_64__) && defined(__SHA__)
+// __SHA__ keeps this gate consistent with the build flags: a platform
+// whose CMAKE_SYSTEM_PROCESSOR string missed the -msha branch compiles
+// the portable stubs below instead of failing on the intrinsics.
 #include <immintrin.h>
 
 namespace fdfs {
@@ -182,7 +185,7 @@ void Sha1NiCompress(uint32_t h[5], const uint8_t* data, size_t nblocks) {
 
 }  // namespace fdfs
 
-#else  // !__x86_64__
+#else  // !(__x86_64__ && __SHA__)
 
 namespace fdfs {
 bool Sha1NiSupported() { return false; }
