@@ -75,6 +75,17 @@ class FdfsClient:
             try:
                 with t:
                     return fn(t)
+            except StatusError as e:
+                # A non-zero application status (e.g. ENOENT) is a
+                # deterministic answer, not a transport failure: purging
+                # the pool and retrying every tracker would just repeat
+                # it.  EBUSY (16) is the exception — endpoint-specific
+                # load (max_connections refusal, non-leader) that another
+                # tracker may well answer; retry WITHOUT purging (the
+                # transport is fine).
+                if e.status != 16:
+                    raise
+                last = e
             except (OSError, ProtocolError) as e:
                 last = e
                 if self.pool is not None:

@@ -200,6 +200,21 @@ void ChunkStore::UnrefAll(const Recipe& r) {
   }
 }
 
+std::optional<Recipe> ChunkStore::ReadRecipeAndPin(const std::string& path) {
+  // The file read stays OUTSIDE mu_ (a cold read is milliseconds, and
+  // mu_ serializes every upload RefAll / delete UnrefAll across all dio
+  // threads); recipe files are immutable once renamed into place, so
+  // the verify-refs_-then-pin under the lock is what closes the race
+  // with a concurrent delete.
+  auto r = ReadRecipeFile(path);
+  if (!r.has_value()) return std::nullopt;
+  std::lock_guard<std::mutex> lk(mu_);
+  for (const RecipeEntry& e : r->chunks)
+    if (refs_.find(e.digest_hex) == refs_.end()) return std::nullopt;
+  for (const RecipeEntry& e : r->chunks) pins_[e.digest_hex]++;
+  return r;
+}
+
 void ChunkStore::PinRecipe(const Recipe& r) {
   std::lock_guard<std::mutex> lk(mu_);
   for (const RecipeEntry& e : r.chunks) pins_[e.digest_hex]++;

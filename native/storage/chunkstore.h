@@ -81,6 +81,15 @@ class ChunkStore {
   void PinRecipe(const Recipe& r);
   void UnpinRecipe(const Recipe& r);
 
+  // Read a recipe file and pin its chunks atomically w.r.t. UnrefAll: a
+  // delete landing between a plain ReadRecipeFile and PinRecipe could
+  // unref+unlink chunks the stream is about to send.  Under the store
+  // mutex: read, verify every chunk is still referenced, then pin.
+  // nullopt (no pins taken) when the recipe is gone or any chunk was
+  // already unreferenced — the caller fails the download with ENOENT
+  // before the first byte, not mid-stream.
+  std::optional<Recipe> ReadRecipeAndPin(const std::string& path);
+
   std::string ChunkPath(const std::string& digest_hex) const;
 
   int64_t unique_chunks() const;

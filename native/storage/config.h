@@ -25,12 +25,20 @@ struct StorageConfig {
   int network_timeout_ms = 30000;
   // nio work threads (reference storage.conf:work_threads /
   // storage_nio.c): connections are distributed round-robin over this
-  // many event loops.  1 = everything on the main loop.
+  // many event loops.  Init() always spawns this many dedicated nio
+  // threads (with 1, all connections share one nio thread; the main
+  // loop only accepts).
   int work_threads = 4;
   // dio pool size PER STORE PATH (reference storage.conf:
   // disk_writer_threads / storage_dio.c): chunk-store writes,
   // fingerprint RPCs, trunk allocation, and deletes run here.
   int disk_writer_threads = 2;
+  // Accept-time connection cap (reference storage.conf:max_connections /
+  // fast_task_queue.c — the task-buffer pool is the bound upstream; here
+  // the cap is explicit).  Past the cap the daemon answers one EBUSY
+  // response header and closes — a polite refusal the client surfaces as
+  // a status error instead of ECONNRESET.  0 = unlimited.
+  int max_connections = 256;
   std::vector<std::string> tracker_servers;  // "ip:port"
   int heart_beat_interval_s = 30;
   int stat_report_interval_s = 60;
