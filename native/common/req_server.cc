@@ -29,6 +29,18 @@ void RequestServer::OnAccept(uint32_t) {
     int fd = accept(listen_fd_, nullptr, nullptr);
     if (fd < 0) return;
     SetNonBlocking(fd);
+    if (max_connections_ > 0 &&
+        conns_.size() >= static_cast<size_t>(max_connections_)) {
+      // Polite refusal: a fresh socket's send buffer always takes the
+      // 10-byte header, so the client sees EBUSY instead of ECONNRESET.
+      uint8_t hdr[kHeaderSize] = {0};
+      hdr[8] = 100;  // kResp (same value tracker- and storage-side)
+      hdr[9] = 16;   // EBUSY
+      (void)!write(fd, hdr, sizeof(hdr));
+      close(fd);
+      refused_count_++;
+      continue;
+    }
     auto conn = std::make_unique<Conn>();
     conn->fd = fd;
     conn->peer_ip = PeerIp(fd);

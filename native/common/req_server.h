@@ -33,6 +33,11 @@ class RequestServer {
   bool Listen(const std::string& bind_addr, int port, std::string* error);
   int listen_fd() const { return listen_fd_; }
 
+  // Accept-time connection cap (reference tracker.conf:max_connections).
+  // Past the cap: one EBUSY response header, then close.  0 = unlimited.
+  void set_max_connections(int n) { max_connections_ = n; }
+  int64_t refused_count() const { return refused_count_; }
+
  private:
   struct Conn {
     int fd = -1;
@@ -58,6 +63,8 @@ class RequestServer {
   Handler handler_;
   int64_t max_body_;
   int listen_fd_ = -1;
+  int max_connections_ = 256;
+  int64_t refused_count_ = 0;
   std::unordered_map<int, std::unique_ptr<Conn>> conns_;
 };
 

@@ -53,6 +53,9 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
       ini.GetInt("disk_writer_threads", disk_writer_threads));
   if (disk_writer_threads < 1) disk_writer_threads = 1;
   if (disk_writer_threads > 64) disk_writer_threads = 64;
+  max_connections =
+      static_cast<int>(ini.GetInt("max_connections", max_connections));
+  if (max_connections < 0) max_connections = 0;
   dedup_mode = ini.GetStr("dedup_mode", "none");
   if (dedup_mode != "none" && dedup_mode != "cpu" && dedup_mode != "sidecar") {
     *error = "dedup_mode must be none|cpu|sidecar";

@@ -291,9 +291,11 @@ std::string StorageServer::MyIp() const {
 
 void StorageServer::DumpState() {
   FDFS_LOG_INFO(
-      "state dump: conns=%lld upload=%lld/%lld download=%lld/%lld "
-      "delete=%lld/%lld dedup_hits=%lld saved=%lldB binlog=%d",
+      "state dump: conns=%lld refused=%lld upload=%lld/%lld "
+      "download=%lld/%lld delete=%lld/%lld dedup_hits=%lld saved=%lldB "
+      "binlog=%d",
       static_cast<long long>(conn_count_.load()),
+      static_cast<long long>(refused_conn_count_.load()),
       static_cast<long long>(stats_.success_upload),
       static_cast<long long>(stats_.total_upload),
       static_cast<long long>(stats_.success_download),
@@ -315,9 +317,27 @@ void StorageServer::OnAccept(uint32_t) {
       return;
     }
     SetNonBlocking(fd);
+    if (cfg_.max_connections > 0 &&
+        conn_count_.load() >= cfg_.max_connections) {
+      // Polite refusal (reference: fast_task_queue pool exhaustion):
+      // one EBUSY response header, then close.  A fresh socket's send
+      // buffer always takes 10 bytes, so a blocking write is safe.
+      uint8_t hdr[kHeaderSize] = {0};
+      hdr[8] = static_cast<uint8_t>(StorageCmd::kResp);
+      hdr[9] = 16;  // EBUSY
+      (void)!write(fd, hdr, sizeof(hdr));
+      close(fd);
+      refused_conn_count_++;
+      continue;
+    }
     if (my_ip_.empty()) my_ip_ = SockIp(fd);
     // Round-robin handoff to a nio work thread (reference:
     // storage_nio.c pipe-notify from the accept thread).
+    // Count at accept time, not adoption: a connect burst drains the
+    // whole backlog here before any nio thread runs its posted
+    // AdoptConn, so a later increment would let the burst sail past the
+    // cap.  CloseConn owns the decrement.
+    conn_count_++;
     NioThread* t = nio_[next_nio_++ % nio_.size()].get();
     t->loop->Post([this, t, fd] { AdoptConn(t, fd); });
   }
@@ -328,8 +348,7 @@ void StorageServer::AdoptConn(NioThread* t, int fd) {
   conn->fd = fd;
   conn->owner = t;
   Conn* raw = conn.get();
-  t->conns[fd] = std::move(conn);
-  conn_count_++;
+  t->conns[fd] = std::move(conn);  // conn_count_ was taken at accept
   t->loop->Add(fd, EPOLLIN, [this, raw](uint32_t ev) { OnConnEvent(raw, ev); });
 }
 
@@ -399,6 +418,12 @@ void StorageServer::OnConnEvent(Conn* c, uint32_t events) {
 }
 
 void StorageServer::CloseConn(Conn* c) {
+  // Identity check FIRST: a hypothetical double-CloseConn after the fd
+  // was reused by a new conn must not close the stranger's fd or
+  // double-decrement the counter.
+  auto& conns = c->owner->conns;
+  auto it = conns.find(c->fd);
+  if (it == conns.end() || it->second.get() != c) return;
   AbortFileOp(c);  // disconnect mid-op: same rollback as an explicit error
   if (c->send_fd >= 0) close(c->send_fd);
   c->rstream.reset();
@@ -406,9 +431,6 @@ void StorageServer::CloseConn(Conn* c) {
   ConnLoop(c)->Del(fd);
   close(fd);
   conn_count_--;
-  auto& conns = c->owner->conns;
-  auto it = conns.find(fd);
-  if (it == conns.end() || it->second.get() != c) return;
   if (c->async_pending) {
     // A dio worker still references this conn: keep the object alive as
     // a zombie until its completion callback reaps it.
@@ -449,6 +471,13 @@ void StorageServer::ResetForNextRequest(Conn* c) {
   c->rstream.reset();
   c->recv_done_us = 0;
   c->work_start_us = 0;
+  // Bounded buffer budget (the other half of fast_task_queue's pooled
+  // buffers): a request with an unusually large in-memory body or
+  // response must not pin that capacity for the connection's lifetime —
+  // max_connections × retained buffers is the daemon's memory bound.
+  const size_t budget = static_cast<size_t>(cfg_.buff_size);
+  if (c->fixed.capacity() > budget) std::string().swap(c->fixed);
+  if (c->out.capacity() > budget) std::string().swap(c->out);
 }
 
 bool StorageServer::AcquireBusy(Conn* c, const std::string& remote) {
@@ -1860,18 +1889,26 @@ void StorageServer::HandleDownload(Conn* c) {
   // Chunk recipe: stream chunk-by-chunk as the socket drains — never
   // materialize the logical file (a multi-GB download must not stall
   // this loop's other connections).
-  auto r = ReadRecipeFile(local + ".rcp");
+  ChunkStore* cs = StoreForLocal(local);
+  if (cs == nullptr) {
+    // No chunk store for this path (dedup off).  If a recipe exists the
+    // file is REAL data from an earlier dedup_mode config — answer EIO
+    // (retryable) so disk recovery never mistakes it for deleted; with
+    // no recipe either, the file is simply gone: ENOENT, which recovery
+    // treats as "deleted on the peer, skip".
+    Respond(c, access((local + ".rcp").c_str(), F_OK) == 0 ? 5 : 2);
+    return;
+  }
+  // Read + pin under the store mutex: a delete between a plain read and
+  // a later pin could unlink chunks this stream is about to send.
+  auto r = cs->ReadRecipeAndPin(local + ".rcp");
   if (!r.has_value()) {
     Respond(c, 2);
     return;
   }
-  ChunkStore* cs = StoreForLocal(local);
-  if (cs == nullptr) {
-    Respond(c, 5);
-    return;
-  }
   int64_t size = r->logical_size;
   if (offset > size) {
+    cs->UnpinRecipe(*r);
     Respond(c, 22);
     return;
   }
@@ -1888,8 +1925,7 @@ void StorageServer::HandleDownload(Conn* c) {
   }
   rs->skip = skip;
   rs->recipe = std::move(*r);
-  cs->PinRecipe(rs->recipe);
-  rs->pinned = true;
+  rs->pinned = true;  // pinned by ReadRecipeAndPin above
   stats_.success_download++;
   LogAccess(c, 0, count);
   c->out.resize(kHeaderSize);

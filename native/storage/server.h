@@ -298,6 +298,7 @@ class StorageServer {
   std::vector<std::unique_ptr<NioThread>> nio_;
   size_t next_nio_ = 0;                 // main-loop only (accept)
   std::atomic<int64_t> conn_count_{0};
+  std::atomic<int64_t> refused_conn_count_{0};  // over max_connections
   // dio pools, one per store path (storage.conf:disk_writer_threads;
   // reference: storage_dio.c per-path reader/writer queues).
   std::vector<std::unique_ptr<WorkerPool>> dio_pools_;

@@ -40,6 +40,8 @@ int main(int argc, char** argv) {
   cfg.check_active_interval_s =
       static_cast<int>(ini.GetSeconds("check_active_interval", 100));
   cfg.save_interval_s = static_cast<int>(ini.GetSeconds("save_interval", 30));
+  cfg.max_connections =
+      static_cast<int>(ini.GetInt("max_connections", cfg.max_connections));
   cfg.log_level = ini.GetStr("log_level", "info");
   cfg.log_file = ini.GetStr("log_file", "");
   cfg.log_rotate_size = ini.GetBytes("log_rotate_size", cfg.log_rotate_size);

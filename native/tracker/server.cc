@@ -109,6 +109,7 @@ bool TrackerServer::Init(std::string* error) {
   server_ = std::make_unique<RequestServer>(
       &loop_, [this](uint8_t cmd, const std::string& body,
                      const std::string& peer) { return Handle(cmd, body, peer); });
+  server_->set_max_connections(cfg_.max_connections);
   if (!server_->Listen(cfg_.bind_addr, cfg_.port, error)) return false;
 
   loop_.AddTimer(1000, [this]() {

@@ -27,6 +27,9 @@ struct TrackerConfig {
   // (30s); upstream uses 100s.
   int check_active_interval_s = 100;
   int save_interval_s = 30;
+  // Accept-time connection cap (tracker.conf:max_connections upstream);
+  // past it the server answers one EBUSY header and closes.  0 = off.
+  int max_connections = 256;
   std::string log_level = "info";
   std::string log_file;               // empty = stderr
   int64_t log_rotate_size = 256LL << 20;

@@ -173,3 +173,91 @@ def test_delete_during_chunked_download_completes(tmp_path):
     finally:
         st.stop()
         tr.stop()
+
+
+def _recv_exact(sock, n, timeout=10.0):
+    sock.settimeout(timeout)
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError(f"peer closed after {len(buf)}/{n} bytes")
+        buf += part
+    return buf
+
+
+def _active_test(sock):
+    """One ACTIVE_TEST round-trip; proves the conn was adopted by a nio
+    thread (the accept-time cap reads the adopted-conn counter)."""
+    sock.sendall(struct.pack(">qBB", 0, StorageCmd.ACTIVE_TEST, 0))
+    hdr = _recv_exact(sock, 10)
+    assert hdr[9] == 0, f"active test failed: status {hdr[9]}"
+
+
+def test_max_connections_cap(tmp_path):
+    """Accept past max_connections must refuse politely: one EBUSY
+    response header, then close — and closing a held conn frees a slot
+    (reference: fast_task_queue.c pool exhaustion / max_connections)."""
+    tr = start_tracker(str(tmp_path / "tr"))
+    st = start_storage(str(tmp_path / "st"),
+                       trackers=[f"127.0.0.1:{tr.port}"],
+                       dedup_mode="cpu",
+                       extra=HB + "\nmax_connections = 3\nwork_threads = 4\n")
+    cli = FdfsClient([f"127.0.0.1:{tr.port}"], use_pool=False)
+    addr = ("127.0.0.1", st.port)
+    held = []
+    try:
+        fid = upload_retry(cli, b"cap" * 100, ext="bin")
+        time.sleep(0.5)  # let the server reap the upload's closed conn
+        for _ in range(3):
+            s = socket.create_connection(addr, timeout=10)
+            _active_test(s)
+            held.append(s)
+        # Fourth conn: the daemon answers an EBUSY header and closes.
+        over = socket.create_connection(addr, timeout=10)
+        hdr = _recv_exact(over, 10)
+        assert hdr[8] == 100 and hdr[9] == 16, f"expected EBUSY resp: {hdr!r}"
+        assert over.recv(1) == b""  # and then EOF
+        over.close()
+        # Freeing one slot lets a new connection in (HUP reap is prompt,
+        # but poll a little: the close must cross the loopback first).
+        held.pop().close()
+        deadline = time.time() + 10
+        while True:
+            s = socket.create_connection(addr, timeout=10)
+            hdr = _recv_or_none(s)
+            if hdr is None:  # no unsolicited EBUSY: a real slot
+                _active_test(s)
+                held.append(s)
+                break
+            s.close()
+            assert time.time() < deadline, "slot never freed after close"
+            time.sleep(0.2)
+        # The cap must not break normal service once conns drop.
+        for s in held:
+            s.close()
+        held.clear()
+        deadline = time.time() + 10
+        while True:
+            try:
+                assert cli.download_to_buffer(fid) == b"cap" * 100
+                break
+            except Exception:
+                assert time.time() < deadline
+                time.sleep(0.2)
+    finally:
+        for s in held:
+            s.close()
+        st.stop()
+        tr.stop()
+
+
+def _recv_or_none(sock, timeout=0.5):
+    """Read an unsolicited 10-byte refusal header if one arrives within
+    the timeout; None means the server kept the conn (a granted slot)."""
+    sock.settimeout(timeout)
+    try:
+        buf = sock.recv(10)
+    except socket.timeout:
+        return None
+    return buf or b""
