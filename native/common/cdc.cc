@@ -1,8 +1,143 @@
 #include "common/cdc.h"
 
+#include <algorithm>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
 #include "common/gear_gen.h"
 
 namespace fdfs {
+
+namespace {
+
+// The gear recurrence h = (h << 1) + gear[b] (mod 2^32) forgets any byte
+// more than 31 positions back: its contribution is shifted out entirely.
+// So at every position at least kGearWindow bytes past a chunk start,
+// the per-chunk hash (reset at each cut) EQUALS the no-reset running
+// hash of the whole stream.  With min_size >= kGearWindow — cut
+// positions are only ever examined at chunk sizes >= min_size — serial
+// cut-points can be reproduced from a position-parallel candidate scan:
+//   phase 1: flag every position whose windowed hash has the low
+//            avg_bits zero (data-parallel; AVX2 lanes below),
+//   phase 2: a sparse walk applying the min/max-size rules.
+// This is the host twin of the TPU formulation in
+// fastdfs_tpu/ops/gear_cdc.py (blockwise halo scan, SURVEY.md §5
+// vectorized-CDC), replacing the per-byte branchy loop that gated the
+// native upload path at ~0.4 GB/s.
+constexpr int kGearWindow = 32;
+
+// Scalar candidate scan: flags positions (absolute, = base + i) where
+// the no-reset hash has (h & mask) == 0.  Returns the carried hash.
+// Branch is ~never taken (1 in 2^avg_bits), so this also beats the
+// original loop, which computed a chunk size and tested two conditions
+// per byte.
+uint32_t ScanScalar(const uint8_t* data, size_t n, uint32_t h, uint32_t mask,
+                    int64_t base, std::vector<int64_t>* cands) {
+  for (size_t i = 0; i < n; ++i) {
+    h = (h << 1) + kGearTable[data[i]];
+    if ((h & mask) == 0) cands->push_back(base + static_cast<int64_t>(i));
+  }
+  return h;
+}
+
+#if defined(__x86_64__)
+
+bool HasAvx2() {
+  static const bool ok = __builtin_cpu_supports("avx2");
+  return ok;
+}
+
+// 16 lanes (2 x 8 dwords), lane L covering block [off + L*B, off + (L+1)*B)
+// of `data`; every lane pre-warms its hash on the kGearWindow bytes before
+// its block (flags discarded), which by the window property yields the
+// exact no-reset hash.  Requires off >= kGearWindow and B % 4 == 0.
+// Bytes arrive four-per-lane via one dword gather, then each byte's gear
+// entry via a table gather; two independent vectors keep gather latency
+// covered.  Candidates append out of lane order; the caller sorts.
+__attribute__((target("avx2")))
+void ScanAvx2(const uint8_t* data, size_t off, size_t B, uint32_t mask,
+              int64_t base, std::vector<int64_t>* cands) {
+  const __m256i vmask = _mm256_set1_epi32(static_cast<int>(mask));
+  const __m256i zero = _mm256_setzero_si256();
+  const __m256i byte_mask = _mm256_set1_epi32(0xFF);
+  alignas(32) int32_t idx0[8], idx1[8];
+  for (int L = 0; L < 8; ++L) {
+    idx0[L] = static_cast<int32_t>(off + static_cast<size_t>(L) * B);
+    idx1[L] = static_cast<int32_t>(off + static_cast<size_t>(L + 8) * B);
+  }
+  const __m256i start0 = _mm256_load_si256(reinterpret_cast<__m256i*>(idx0));
+  const __m256i start1 = _mm256_load_si256(reinterpret_cast<__m256i*>(idx1));
+  const int* tbl = reinterpret_cast<const int*>(kGearTable);
+  const int* base32 = reinterpret_cast<const int*>(data);
+
+  __m256i h0 = zero, h1 = zero;
+  for (int64_t j = -kGearWindow; j < static_cast<int64_t>(B); j += 4) {
+    const bool warmup = j < 0;
+    __m256i vj = _mm256_set1_epi32(static_cast<int>(j));
+    // One unaligned 32-bit word per lane, scale 1 (byte addressing).
+    __m256i w0 = _mm256_i32gather_epi32(base32, _mm256_add_epi32(start0, vj), 1);
+    __m256i w1 = _mm256_i32gather_epi32(base32, _mm256_add_epi32(start1, vj), 1);
+    for (int k = 0; k < 4; ++k) {
+      __m256i g0 = _mm256_i32gather_epi32(tbl, _mm256_and_si256(w0, byte_mask), 4);
+      __m256i g1 = _mm256_i32gather_epi32(tbl, _mm256_and_si256(w1, byte_mask), 4);
+      h0 = _mm256_add_epi32(_mm256_slli_epi32(h0, 1), g0);
+      h1 = _mm256_add_epi32(_mm256_slli_epi32(h1, 1), g1);
+      if (!warmup) {
+        int m0 = _mm256_movemask_ps(_mm256_castsi256_ps(
+            _mm256_cmpeq_epi32(_mm256_and_si256(h0, vmask), zero)));
+        int m1 = _mm256_movemask_ps(_mm256_castsi256_ps(
+            _mm256_cmpeq_epi32(_mm256_and_si256(h1, vmask), zero)));
+        if (m0 | m1) {  // rare: 1 lane in 2^avg_bits
+          size_t p = off + static_cast<size_t>(j) + static_cast<size_t>(k);
+          for (int L = 0; L < 8; ++L) {
+            if (m0 & (1 << L))
+              cands->push_back(base + static_cast<int64_t>(
+                  p + static_cast<size_t>(L) * B));
+            if (m1 & (1 << L))
+              cands->push_back(base + static_cast<int64_t>(
+                  p + static_cast<size_t>(L + 8) * B));
+          }
+        }
+      }
+      w0 = _mm256_srli_epi32(w0, 8);
+      w1 = _mm256_srli_epi32(w1, 8);
+    }
+  }
+}
+
+#endif  // __x86_64__
+
+// Candidate scan over data[0..n) at absolute stream offset `base`,
+// entering with carried no-reset hash h.  Returns the carried hash for
+// the next segment.  Appends candidates in increasing position order.
+uint32_t ScanCandidates(const uint8_t* data, size_t n, uint32_t h,
+                        uint32_t mask, int64_t base,
+                        std::vector<int64_t>* cands) {
+#if defined(__x86_64__)
+  // Lane cursors are int32 and each lane needs an in-buffer window
+  // before its block; small inputs stay scalar.
+  if (n >= 16 * 1024 && n < (1u << 31) && HasAvx2()) {
+    size_t head = kGearWindow;  // scalar, continues the carried hash
+    h = ScanScalar(data, head, h, mask, base, cands);
+    size_t B = ((n - head) / 16) & ~static_cast<size_t>(3);
+    size_t mid_end = head + 16 * B;
+    size_t before = cands->size();
+    ScanAvx2(data, head, B, mask, base, cands);
+    std::sort(cands->begin() + static_cast<ptrdiff_t>(before), cands->end());
+    // Tail: re-derive the hash by warming on the window before it.
+    std::vector<int64_t> discard;
+    uint32_t th = ScanScalar(data + mid_end - kGearWindow, kGearWindow, 0,
+                             0xFFFFFFFFu, 0, &discard);
+    return ScanScalar(data + mid_end, n - mid_end, th, mask,
+                      base + static_cast<int64_t>(mid_end), cands);
+  }
+#endif
+  return ScanScalar(data, n, h, mask, base, cands);
+}
+
+}  // namespace
 
 GearChunker::GearChunker(int64_t min_size, int avg_bits, int64_t max_size)
     : min_size_(min_size),
@@ -11,23 +146,54 @@ GearChunker::GearChunker(int64_t min_size, int avg_bits, int64_t max_size)
 
 void GearChunker::Feed(const uint8_t* data, size_t n,
                        std::vector<int64_t>* cuts) {
-  // Exactly the serial reference: h = (h << 1) + gear[b]; cut when the
-  // chunk reaches min_size and (h & mask) == 0, or at max_size; h resets
-  // at each chunk start.
-  uint32_t h = h_;
-  int64_t pos = pos_, start = chunk_start_;
-  for (size_t i = 0; i < n; ++i) {
-    h = (h << 1) + kGearTable[data[i]];
-    int64_t size = pos - start + 1;
-    if ((size >= min_size_ && (h & mask_) == 0) || size >= max_size_) {
-      cuts->push_back(pos + 1);
-      start = pos + 1;
-      h = 0;
+  if (min_size_ < kGearWindow) {
+    // Exactly the serial reference: h = (h << 1) + gear[b]; cut when the
+    // chunk reaches min_size and (h & mask) == 0, or at max_size; h
+    // resets at each chunk start.  (Below the window size the reset is
+    // observable, so the two-phase scan does not apply.)
+    uint32_t h = h_;
+    int64_t pos = pos_, start = chunk_start_;
+    for (size_t i = 0; i < n; ++i) {
+      h = (h << 1) + kGearTable[data[i]];
+      int64_t size = pos - start + 1;
+      if ((size >= min_size_ && (h & mask_) == 0) || size >= max_size_) {
+        cuts->push_back(pos + 1);
+        start = pos + 1;
+        h = 0;
+      }
+      ++pos;
     }
-    ++pos;
+    h_ = h;
+    pos_ = pos;
+    chunk_start_ = start;
+    return;
   }
-  h_ = h;
-  pos_ = pos;
+
+  // Two-phase path (min_size >= window): h_ carries the NO-RESET stream
+  // hash — by the window property it agrees with the serial per-chunk
+  // hash at every position the min-size rule allows to cut, so the cut
+  // sequence is identical to the serial reference.
+  cands_.clear();
+  h_ = ScanCandidates(data, n, h_, mask_, pos_, &cands_);
+  int64_t start = chunk_start_;
+  for (int64_t cand : cands_) {
+    int64_t o = cand + 1;  // cut offsets are exclusive ends
+    // Any full max_size span before this candidate cuts first (the
+    // serial hash reset that follows is unobservable at >= min_size).
+    while (o - start > max_size_) {
+      start += max_size_;
+      cuts->push_back(start);
+    }
+    if (o - start < min_size_) continue;
+    cuts->push_back(o);
+    start = o;
+  }
+  int64_t end = pos_ + static_cast<int64_t>(n);
+  while (end - start >= max_size_) {
+    start += max_size_;
+    cuts->push_back(start);
+  }
+  pos_ = end;
   chunk_start_ = start;
 }
 

@@ -41,9 +41,14 @@ class GearChunker {
   int64_t min_size_;
   uint32_t mask_;
   int64_t max_size_;
+  // For min_size >= the 32-byte gear window, h_ carries the NO-RESET
+  // stream hash (the two-phase candidate scan in cdc.cc); below the
+  // window it carries the serial per-chunk hash.  The two never mix
+  // within one chunker.
   uint32_t h_ = 0;
   int64_t pos_ = 0;       // absolute stream position
   int64_t chunk_start_ = 0;
+  std::vector<int64_t> cands_;  // phase-1 scratch, reused across Feeds
 };
 
 }  // namespace fdfs

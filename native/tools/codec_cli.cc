@@ -14,6 +14,8 @@
 //   fdfs_codec cdc <min> <avg_bits> <max> [seg]  (stdin -> cut offsets,
 //                one per line; seg tests the streaming chunker by feeding
 //                seg-byte segments)
+#include <time.h>
+
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -137,6 +139,31 @@ int main(int argc, char** argv) {
                              atoi(argv[3]), strtoll(argv[4], nullptr, 10));
     }
     for (int64_t c : cuts) printf("%lld\n", static_cast<long long>(c));
+    return 0;
+  }
+  if (cmd == "cdc-bench" && (argc == 5 || argc == 6)) {
+    // Times the chunker itself over stdin (repeat passes, best-of),
+    // excluding process startup and pipe reads — the number
+    // bench_configs.py records as chunker_cpp_GBps.
+    std::string data = ReadStdin();
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(data.data());
+    int64_t mn = strtoll(argv[2], nullptr, 10);
+    int avg = atoi(argv[3]);
+    int64_t mx = strtoll(argv[4], nullptr, 10);
+    int reps = argc == 6 ? atoi(argv[5]) : 5;
+    size_t cuts = GearChunkStream(p, data.size(), mn, avg, mx).size();  // warm
+    double best = 0;
+    for (int r = 0; r < reps; ++r) {
+      struct timespec a, b;
+      clock_gettime(CLOCK_MONOTONIC, &a);
+      cuts = GearChunkStream(p, data.size(), mn, avg, mx).size();
+      clock_gettime(CLOCK_MONOTONIC, &b);
+      double dt = (b.tv_sec - a.tv_sec) + (b.tv_nsec - a.tv_nsec) * 1e-9;
+      double gbps = data.size() / dt / 1e9;
+      if (gbps > best) best = gbps;
+    }
+    printf("{\"bytes\": %zu, \"cuts\": %zu, \"GBps\": %.4f}\n", data.size(),
+           cuts, best);
     return 0;
   }
   if (cmd == "b64e" && argc == 3) {

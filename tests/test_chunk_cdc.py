@@ -113,3 +113,35 @@ def test_generated_gear_header_is_current():
     with open(os.path.join(REPO, "native", "common", "gear_gen.h")) as fh:
         assert fh.read() == mod.generate(), (
             "native/common/gear_gen.h is stale: rerun native/gen_gear.py")
+
+
+def test_cpp_simd_path_matches_serial_reference():
+    """Buffers big enough for the AVX2 two-phase scan (>= 16 KB engages
+    it; multi-MB exercises full lanes + scalar head/tail) must cut
+    identically to the Python serial reference — including segmented
+    feeds whose boundaries land inside SIMD blocks."""
+    rng = np.random.RandomState(1234)
+    parts = [
+        rng.randint(0, 256, 8 << 20, dtype=np.uint8).tobytes(),
+        bytes(1 << 20),                       # zero run: max_size cuts
+        rng.randint(0, 256, 3 << 20, dtype=np.uint8).tobytes(),
+        (b"lorem ipsum dolor sit amet " * 40_000),
+    ]
+    data = b"".join(parts)
+    ref = chunk_stream_ref(data, *GEOM)
+    assert _cpp_cuts(data, GEOM) == ref
+    # segment sizes straddling the 16 KB SIMD threshold and odd sizes
+    for seg in (8 << 10, 16 << 10, (1 << 20) + 13, 7 << 20):
+        assert _cpp_cuts(data, GEOM, seg=seg) == ref, f"seg={seg}"
+
+
+def test_cpp_simd_threshold_boundary_sizes():
+    """Exact buffer sizes around the scalar/SIMD dispatch boundary and
+    around lane-quantum remainders."""
+    rng = np.random.RandomState(99)
+    for n in (16 * 1024 - 1, 16 * 1024, 16 * 1024 + 1,
+              16 * 1024 + 32, 16 * 1024 + 95, 64 * 1024 + 7):
+        data = rng.randint(0, 256, n, dtype=np.uint8).tobytes()
+        assert _cpp_cuts(data, GEOM) == chunk_stream_ref(data, *GEOM), n
+        assert _cpp_cuts(data, SMALL_GEOM) == \
+            chunk_stream_ref(data, *SMALL_GEOM), n
