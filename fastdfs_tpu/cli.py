@@ -160,6 +160,22 @@ def cmd_set_trunk_server(c: FdfsClient, args: list[str]) -> int:
     return 0
 
 
+def cmd_near_dups(c: FdfsClient, args: list[str]) -> int:
+    """Ranked near-duplicates of a stored file from the dedup engine's
+    MinHash/LSH index (fastdfs_tpu extension; no reference equivalent —
+    the upstream tree has no similarity index at all)."""
+    if not args:
+        print("usage: near_dups <tracker> <file_id>", file=sys.stderr)
+        return 2
+    pairs = c.near_dups(args[0])
+    if not pairs:
+        print("no near-duplicates known")
+        return 0
+    for fid, score in pairs:
+        print(f"{score:.4f}  {fid}")
+    return 0
+
+
 def cmd_tracker_status(c: FdfsClient, args: list[str]) -> int:
     """Multi-tracker relationship probe (leader + role)."""
     print(json.dumps(c.tracker_status()))
@@ -179,6 +195,7 @@ TOOLS = {
     "delete_server": cmd_delete_server,
     "set_trunk_server": cmd_set_trunk_server,
     "tracker_status": cmd_tracker_status,
+    "near_dups": cmd_near_dups,
 }
 
 

@@ -125,6 +125,13 @@ class FdfsClient:
         with self._storage(tgt) as s:
             return s.query_file_info(file_id)
 
+    def near_dups(self, file_id: str) -> list[tuple[str, float]]:
+        """Ranked (file_id, score) near-duplicates of a stored file
+        (dedup-engine MinHash index; fastdfs_tpu extension)."""
+        tgt = self._with_tracker(lambda t: t.query_fetch(file_id))
+        with self._storage(tgt) as s:
+            return s.near_dups(file_id)
+
     def set_metadata(self, file_id: str, meta: dict[str, str],
                      merge: bool = False) -> None:
         tgt = self._with_tracker(lambda t: t.query_update(file_id))

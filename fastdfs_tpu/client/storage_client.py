@@ -13,7 +13,7 @@ import io
 import os
 from dataclasses import dataclass
 
-from fastdfs_tpu.client.conn import Connection, ProtocolError
+from fastdfs_tpu.client.conn import Connection, ProtocolError, StatusError
 from fastdfs_tpu.common.protocol import (
     GROUP_NAME_MAX_LEN,
     StorageCmd,
@@ -190,6 +190,30 @@ class StorageClient:
             crc32=buff2long(body, 16) & 0xFFFFFFFF,
             source_ip=body[24:40].rstrip(b"\x00").decode(),
         )
+
+    def near_dups(self, file_id: str) -> list[tuple[str, float]]:
+        """Ranked near-duplicates of a stored file from the dedup
+        engine's MinHash/LSH index (fastdfs_tpu extension, NEAR_DUPS=38).
+        Returns [] when the file carries no signature (ENODATA);
+        StatusError(95) when the dedup mode has no near index."""
+        group, remote = _split_id(file_id)
+        self.conn.send_request(StorageCmd.NEAR_DUPS,
+                               pack_group_name(group) + remote.encode())
+        try:
+            body = self.conn.recv_response("near_dups")
+        except StatusError as e:
+            if e.status == 61:  # ENODATA: indexed mode, unindexed file
+                return []
+            raise
+        out: list[tuple[str, float]] = []
+        for line in body.decode("utf-8", "replace").splitlines():
+            parts = line.rsplit(" ", 1)
+            if len(parts) == 2:
+                try:
+                    out.append((parts[0], float(parts[1])))
+                except ValueError:
+                    continue
+        return out
 
     # -- metadata ----------------------------------------------------------
 

@@ -128,6 +128,11 @@ class StorageCmd(enum.IntEnum):
     SYNC_MODIFY_FILE = 35
     TRUNCATE_FILE = 36
     SYNC_TRUNCATE_FILE = 37
+    # fastdfs_tpu extension (no reference equivalent): ranked near-dup
+    # report for a stored file, answered from the sidecar's MinHash/LSH
+    # index.  Body = 16B group + remote filename; response = text lines
+    # "<file_id> <score>".  ENOTSUP when the dedup mode has no near index.
+    NEAR_DUPS = 38
 
     # fastdfs_tpu extension: dedup-engine sidecar RPCs (no reference
     # equivalent; carried on the same framing so the C++ daemons reuse one
@@ -135,6 +140,7 @@ class StorageCmd(enum.IntEnum):
     DEDUP_FINGERPRINT = 120
     DEDUP_QUERY = 121
     DEDUP_COMMIT = 122
+    DEDUP_NEARDUPS = 123
 
     RESP = 100
     ACTIVE_TEST = 111
@@ -150,6 +156,8 @@ class Status(enum.IntEnum):
     EEXIST = 17
     EINVAL = 22
     ENOSPC = 28
+    ENODATA = 61
+    ENOTSUP = 95
     ECONNREFUSED = 111
     EALREADY = 114
 

@@ -50,6 +50,9 @@ class ExactDigestIndex:
     def remove(self, digest: bytes) -> bool:
         return self._map.pop(digest, None) is not None
 
+    def items(self):
+        return self._map.items()
+
     # -- persistence (checkpoint/resume parity; SURVEY.md §5) -------------
 
     def save(self, path: str) -> None:
@@ -89,6 +92,10 @@ class MinHashLSHIndex:
         self._rows: list[np.ndarray] = []
         self._sigs_cache: np.ndarray | None = None
         self._refs: list[Any] = []
+        # ref -> latest item id (hashable refs only), for O(1)
+        # signature_of — the production query path "what is <file_id>
+        # near?" enters by ref, not by signature.
+        self._by_ref: dict[Any, int] = {}
 
     def __len__(self) -> int:
         return len(self._refs)
@@ -111,6 +118,10 @@ class MinHashLSHIndex:
         self._refs.append(ref)
         self._rows.append(sig)
         self._sigs_cache = None
+        try:
+            self._by_ref[ref] = item
+        except TypeError:
+            pass  # unhashable ref: signature_of unsupported for it
         for b, key in enumerate(self._band_keys(sig)):
             self._buckets[b].setdefault(key, []).append(item)
         return item
@@ -146,7 +157,20 @@ class MinHashLSHIndex:
             if r == ref:
                 self._refs[i] = None
                 n += 1
+        try:
+            self._by_ref.pop(ref, None)
+        except TypeError:
+            pass
         return n
+
+    def signature_of(self, ref: Any) -> np.ndarray | None:
+        """Latest stored signature for ``ref`` (None when unindexed or
+        removed) — the entry point for ref-keyed near-dup queries."""
+        try:
+            i = self._by_ref.get(ref)
+        except TypeError:
+            return None
+        return self._rows[i] if i is not None else None
 
     @property
     def signatures(self) -> np.ndarray:
@@ -183,6 +207,12 @@ class MinHashLSHIndex:
         for item, sig in enumerate(idx._rows):
             for b, key in enumerate(idx._band_keys(sig)):
                 idx._buckets[b].setdefault(key, []).append(item)
+        for item, ref in enumerate(idx._refs):
+            if ref is not None:
+                try:
+                    idx._by_ref[ref] = item
+                except TypeError:
+                    pass
         return idx
 
 

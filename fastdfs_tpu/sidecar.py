@@ -28,6 +28,10 @@ Opcodes
   ``forget <file_id>``.  ``abort`` is sent on flat-fallback or a failed
   upload; sessions older than ``_SESSION_TTL`` seconds are reaped in
   case a daemon dies without either message.
+* ``DEDUP_NEARDUPS`` (123): body = file id text.  Response: ranked text
+  lines ``<file_id> <score>`` from the MinHash/LSH index (the operator
+  query surface behind the daemon's ``NEAR_DUPS`` command); status 61
+  when the file carries no signature.
 
 State: whole-file digest map + the DedupEngine's exact/LSH indexes;
 snapshotted to ``<state_dir>/sidecar_*.json`` on SIGTERM and every
@@ -100,8 +104,15 @@ class DedupSidecar:
         self._stop = threading.Event()
         self._listener: socket.socket | None = None
         self.stats = {"fingerprint_bytes": 0, "chunks": 0, "requests": 0}
+        # file id -> digests ATTRIBUTED to it in the exact index (the
+        # subset of its chunks it was first carrier of).  Lets `forget`
+        # prune exact attributions in O(chunks-of-file) instead of
+        # leaking them forever; rebuilt from the exact index on load, so
+        # snapshots carry no extra state.
+        self.attr_by_file: dict[str, list[bytes]] = {}
         if state_dir:
             self._load_state()
+        self._rebuild_attributions()
 
     # -- state -------------------------------------------------------------
 
@@ -135,6 +146,15 @@ class DedupSidecar:
                 except Exception:
                     pass
                 self.engine = fresh
+
+    def _rebuild_attributions(self) -> None:
+        self.attr_by_file.clear()
+        for dig, ref in self.engine.exact.items():
+            try:
+                fid = ref[0]
+            except (TypeError, IndexError, KeyError):
+                continue
+            self.attr_by_file.setdefault(fid, []).append(dig)
 
     def save_state(self) -> None:
         if not self.state_dir:
@@ -197,9 +217,12 @@ class DedupSidecar:
                 sess = self._sessions.pop(_parse_session(parts[1]), None)
                 if sess is not None:
                     file_id = parts[2]
+                    mine = self.attr_by_file.setdefault(file_id, [])
                     for dig, off in sess.digests:
-                        if self.engine.exact.lookup(dig) is None:
-                            self.engine.exact.insert(dig, [file_id, off])
+                        if self.engine.exact.insert(dig, [file_id, off]):
+                            mine.append(dig)
+                    if not mine:
+                        del self.attr_by_file[file_id]
                     if sess.sig is not None:
                         self.engine.near.add(sess.sig, file_id)
                 return 0, b""
@@ -211,8 +234,38 @@ class DedupSidecar:
                 if sha1 is not None and self.files.get(sha1) == parts[1]:
                     del self.files[sha1]
                 self.engine.near.remove(parts[1])
+                # Exact attributions for the deleted file leave the index
+                # too (they would otherwise accumulate in RAM + snapshots
+                # forever).  The daemon's ChunkStore owns true chunk
+                # refcounts; this index only answers "who first carried
+                # it", so dropping the tombstoned carrier is safe — a
+                # later upload of the same chunk re-attributes it.
+                for dig in self.attr_by_file.pop(parts[1], ()):
+                    ref = self.engine.exact.lookup(dig)
+                    if ref is not None and ref[0] == parts[1]:
+                        self.engine.exact.remove(dig)
                 return 0, b""
         return 22, b""
+
+    def _neardups(self, body: bytes) -> tuple[int, bytes]:
+        """Ranked near-dup report for a stored file id (the production
+        query surface for the LSH index; without it the index is
+        write-only).  Status 61 (ENODATA) when the file is unknown to the
+        near index — flat, whole-file-deduped, or never committed."""
+        file_id = body.decode("utf-8", "replace").strip()
+        if not file_id:
+            return 22, b""
+        with self._lock:
+            sig = self.engine.near.signature_of(file_id)
+            if sig is None:
+                return 61, b""
+            cfg = self.engine.config
+            pairs = self.engine.near.query(
+                sig, top_k=cfg.near_dup_top_k * 2 + 1,
+                min_similarity=cfg.near_dup_threshold)
+        lines = [f"{ref} {score:.4f}" for ref, score in pairs
+                 if ref != file_id][:self.engine.config.near_dup_top_k * 2]
+        return 0, "\n".join(lines).encode()
 
     def _reap_stale_sessions(self) -> None:
         cutoff = time.monotonic() - _SESSION_TTL
@@ -246,6 +299,8 @@ class DedupSidecar:
                     status, resp = self._query(body)
                 elif h.cmd == StorageCmd.DEDUP_COMMIT:
                     status, resp = self._commit(body)
+                elif h.cmd == StorageCmd.DEDUP_NEARDUPS:
+                    status, resp = self._neardups(body)
                 elif h.cmd == StorageCmd.ACTIVE_TEST:
                     status, resp = 0, b""
                 else:
@@ -267,6 +322,18 @@ class DedupSidecar:
             buf.extend(got)
         return bytes(buf)
 
+    def _housekeeping_loop(self, snapshot_interval: float) -> None:
+        """Snapshot + stale-session reaping on a dedicated timer thread:
+        a steadily-busy listener must not defer them (the accept-timeout
+        scheduling they used to ride starves under sustained traffic,
+        making crash loss unbounded instead of one snapshot interval)."""
+        while not self._stop.wait(snapshot_interval):
+            try:
+                self.save_state()
+            except OSError as e:
+                print(f"dedup sidecar: snapshot failed: {e}", flush=True)
+            self._reap_stale_sessions()
+
     def serve_forever(self, ready_event: threading.Event | None = None,
                       snapshot_interval: float = 60.0) -> None:
         try:
@@ -279,20 +346,21 @@ class DedupSidecar:
         self._listener.settimeout(0.5)
         if ready_event is not None:
             ready_event.set()
-        next_snap = time.monotonic() + snapshot_interval
+        housekeeper = threading.Thread(
+            target=self._housekeeping_loop, args=(snapshot_interval,),
+            daemon=True)
+        housekeeper.start()
         while not self._stop.is_set():
             try:
                 conn, _ = self._listener.accept()
             except socket.timeout:
-                if time.monotonic() >= next_snap:
-                    self.save_state()
-                    self._reap_stale_sessions()
-                    next_snap = time.monotonic() + snapshot_interval
                 continue
             except OSError:
                 break
             threading.Thread(target=self._serve_conn,
                              args=(conn,), daemon=True).start()
+        self._stop.set()
+        housekeeper.join(timeout=5.0)
         self.save_state()
         self._listener.close()
         try:

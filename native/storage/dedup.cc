@@ -267,6 +267,23 @@ void SidecarDedup::ForgetChunked(const std::string& file_id) {
       std::string("forget ") + file_id, &resp, &status);
 }
 
+bool SidecarDedup::NearDups(const std::string& file_id, std::string* out,
+                            bool* no_data) {
+  std::string resp;
+  uint8_t status = 0;
+  if (!Rpc(static_cast<uint8_t>(StorageCmd::kDedupNeardups), file_id, &resp,
+           &status))
+    return false;  // sidecar down: same ENOTSUP surface as mode=cpu
+  if (status == 61) {  // ENODATA: known mode, unindexed file
+    *no_data = true;
+    return true;
+  }
+  if (status != 0) return false;
+  *out = std::move(resp);
+  *no_data = false;
+  return true;
+}
+
 std::unique_ptr<DedupPlugin> MakeDedupPlugin(const std::string& mode,
                                              const std::string& base_path,
                                              const std::string& sidecar_path) {

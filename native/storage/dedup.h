@@ -78,6 +78,17 @@ class DedupPlugin {
   }
   virtual void AbortChunked(int64_t session) { (void)session; }
   virtual void ForgetChunked(const std::string& file_id) { (void)file_id; }
+
+  // Ranked near-dup report for a stored file (kNearDups command): *out
+  // gets text lines "<file_id> <score>".  Returns false when this mode
+  // has no near index (none/cpu — the caller answers ENOTSUP);
+  // *no_data=true when the mode supports it but the file carries no
+  // signature (ENODATA).
+  virtual bool NearDups(const std::string& file_id, std::string* out,
+                        bool* no_data) {
+    (void)file_id; (void)out; (void)no_data;
+    return false;
+  }
 };
 
 // CPU baseline: exact SHA1 digest map, snapshotted to
@@ -123,6 +134,8 @@ class SidecarDedup : public DedupPlugin {
   void CommitChunked(int64_t session, const std::string& file_id) override;
   void AbortChunked(int64_t session) override;
   void ForgetChunked(const std::string& file_id) override;
+  bool NearDups(const std::string& file_id, std::string* out,
+                bool* no_data) override;
 
  private:
   bool EnsureConnected();

@@ -833,6 +833,7 @@ void StorageServer::OnHeaderComplete(Conn* c) {
     case StorageCmd::kDownloadFile:
     case StorageCmd::kDeleteFile:
     case StorageCmd::kQueryFileInfo:
+    case StorageCmd::kNearDups:
     case StorageCmd::kSetMetadata:
     case StorageCmd::kGetMetadata:
     case StorageCmd::kSyncDeleteFile:
@@ -953,6 +954,9 @@ void StorageServer::OnFixedComplete(Conn* c) {
       return;
     case StorageCmd::kQueryFileInfo:
       HandleQueryFileInfo(c);
+      return;
+    case StorageCmd::kNearDups:
+      HandleNearDups(c);
       return;
     case StorageCmd::kSetMetadata:
       HandleSetMetadata(c);
@@ -2020,6 +2024,34 @@ void StorageServer::DeleteWork(Conn* c) {
     stats_.last_source_update = time(nullptr);
   }
   Respond(c, 0);
+}
+
+void StorageServer::HandleNearDups(Conn* c) {
+  // Operator near-dup query: "what is this file similar to?", answered
+  // from the dedup engine's MinHash/LSH index.  Body mirrors
+  // kQueryFileInfo (16B group + remote filename); response is ranked
+  // text lines "<file_id> <score>".  The sidecar RPC blocks, so the
+  // work leaves the nio loop.
+  if (c->fixed.size() < 16 + 10) {
+    Respond(c, 22);
+    return;
+  }
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  std::string group = GroupFromField(p);
+  if (group != cfg_.group_name) {
+    Respond(c, 22);
+    return;
+  }
+  OffloadToDio(c, 0, [this, c] {
+    std::string file_id = cfg_.group_name + "/" + c->fixed.substr(16);
+    std::string out;
+    bool no_data = false;
+    if (dedup_ == nullptr || !dedup_->NearDups(file_id, &out, &no_data)) {
+      Respond(c, 95);  // ENOTSUP: no near index in this dedup mode
+      return;
+    }
+    Respond(c, no_data ? 61 : 0, out);  // ENODATA: file carries no signature
+  });
 }
 
 void StorageServer::HandleQueryFileInfo(Conn* c) {

@@ -215,6 +215,7 @@ class StorageServer {
   void HandleDownload(Conn* c);
   void HandleDelete(Conn* c);
   void HandleQueryFileInfo(Conn* c);
+  void HandleNearDups(Conn* c);
   void HandleSetMetadata(Conn* c);
   void HandleGetMetadata(Conn* c);
   bool BeginClientRange(Conn* c);   // APPEND_FILE / MODIFY_FILE

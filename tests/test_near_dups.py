@@ -1,0 +1,210 @@
+"""Near-dup detection as a production surface (round-4 north-star item).
+
+The MinHash/LSH index used to be write-only in production: ingest
+computed near-dup reports, but no opcode, client call, or CLI ever read
+them back.  These tests pin the full operator path — sidecar opcode 123
+(`DEDUP_NEARDUPS`) → storage daemon command 38 (`NEAR_DUPS`) → client
+`near_dups()` / `cli.py near_dups` — plus the `forget` pruning that
+keeps exact attributions from accumulating forever, and the sidecar
+housekeeping thread that keeps snapshots flowing under sustained
+traffic (a busy listener starved the old accept-timeout scheduling).
+"""
+
+import json
+import os
+import socket
+import struct
+import sys
+import time
+
+import pytest
+
+from harness import upload_retry
+
+from test_chunked_storage import (_cluster, _mk_payloads, _start_sidecar,
+                                  _wait)
+
+from fastdfs_tpu.client.conn import StatusError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the daemon (sidecar mode)
+# ---------------------------------------------------------------------------
+
+def test_near_dups_end_to_end_sidecar(tmp_path):
+    sidecar, sock = _start_sidecar(tmp_path)
+    tr, st, cli = _cluster(tmp_path, "sidecar", sock)
+    try:
+        a, b = _mk_payloads(seed=7, shared_mb=2, tail_kb=64)
+        fa = upload_retry(cli, a, ext="bin")
+        fb = cli.upload_buffer(b, ext="bin")
+
+        # Similar files see each other, ranked with a score.
+        pairs = cli.near_dups(fa)
+        assert pairs, "no near-dups reported for a 2MB-shared pair"
+        ids = [fid for fid, _ in pairs]
+        assert fb in ids
+        score = dict(pairs)[fb]
+        assert 0.5 <= score <= 1.0, score
+        # ...and symmetrically.
+        assert fa in [fid for fid, _ in cli.near_dups(fb)]
+
+        # A small flat file has no signature: empty report, not an error.
+        small = upload_retry(cli, b"tiny" * 100, ext="txt")
+        assert cli.near_dups(small) == []
+
+        # CLI surface.
+        from fastdfs_tpu import cli as fdfs_cli
+        rc = fdfs_cli.main(["near_dups", f"127.0.0.1:{tr.port}", fa])
+        assert rc == 0
+
+        # Deleting the neighbour removes it from reports (tombstoned).
+        cli.delete_file(fb)
+        assert _wait(lambda: fb not in
+                     [fid for fid, _ in cli.near_dups(fa)], timeout=10), \
+            "deleted file still reported as near-dup"
+    finally:
+        cli.close()
+        st.stop()
+        tr.stop()
+        sidecar.kill()
+
+
+def test_near_dups_unsupported_in_cpu_mode(tmp_path):
+    tr, st, cli = _cluster(tmp_path, "cpu")
+    try:
+        a, _ = _mk_payloads(seed=9)
+        fa = upload_retry(cli, a, ext="bin")
+        with pytest.raises(StatusError) as ei:
+            cli.near_dups(fa)
+        assert ei.value.status == 95  # ENOTSUP
+    finally:
+        cli.close()
+        st.stop()
+        tr.stop()
+
+
+# ---------------------------------------------------------------------------
+# forget pruning (exact attributions must not accumulate forever)
+# ---------------------------------------------------------------------------
+
+def _mk_sidecar_obj(tmp_path, state=False):
+    from fastdfs_tpu.sidecar import DedupSidecar
+    sc = DedupSidecar(os.path.join(str(tmp_path), "x.sock"),
+                      state_dir=str(tmp_path) if state else None)
+    return sc
+
+
+def _ingest_file(sc, session, file_id, data):
+    body = struct.pack(">qq", session, 0) + data
+    status, _ = sc._fingerprint(body)
+    assert status == 0
+    status, _ = sc._commit(f"commitchunks {session} {file_id}".encode())
+    assert status == 0
+
+
+def test_forget_prunes_exact_attributions(tmp_path):
+    import numpy as np
+    sc = _mk_sidecar_obj(tmp_path)
+    rng = np.random.RandomState(3)
+    blob_a = rng.randint(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    blob_b = rng.randint(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    _ingest_file(sc, 1, "group1/M00/00/00/a.bin", blob_a)
+    n_after_a = len(sc.engine.exact)
+    assert n_after_a > 0
+    _ingest_file(sc, 2, "group1/M00/00/00/b.bin", blob_b)
+    n_after_b = len(sc.engine.exact)
+    assert n_after_b > n_after_a
+
+    # Forgetting b removes exactly b's attributions...
+    sc._commit(b"forget group1/M00/00/00/b.bin")
+    assert len(sc.engine.exact) == n_after_a
+    assert "group1/M00/00/00/b.bin" not in sc.attr_by_file
+    # ...and forgetting a empties the index.
+    sc._commit(b"forget group1/M00/00/00/a.bin")
+    assert len(sc.engine.exact) == 0
+
+    # Shared chunks stay attributed to their FIRST carrier only: a
+    # duplicate upload contributes no attributions, so forgetting the
+    # duplicate removes nothing.
+    _ingest_file(sc, 3, "group1/M00/00/00/a.bin", blob_a)
+    n = len(sc.engine.exact)
+    _ingest_file(sc, 4, "group1/M00/00/00/dup.bin", blob_a)
+    assert len(sc.engine.exact) == n
+    sc._commit(b"forget group1/M00/00/00/dup.bin")
+    assert len(sc.engine.exact) == n
+
+
+def test_attributions_rebuild_from_snapshot(tmp_path):
+    import numpy as np
+    sc = _mk_sidecar_obj(tmp_path, state=True)
+    rng = np.random.RandomState(4)
+    _ingest_file(sc, 1, "group1/M00/00/00/s.bin",
+                 rng.randint(0, 256, 1 << 20, dtype=np.uint8).tobytes())
+    n = len(sc.engine.exact)
+    sc.save_state()
+
+    sc2 = _mk_sidecar_obj(tmp_path, state=True)
+    assert len(sc2.engine.exact) == n
+    assert len(sc2.attr_by_file.get("group1/M00/00/00/s.bin", [])) == n
+    sc2._commit(b"forget group1/M00/00/00/s.bin")
+    assert len(sc2.engine.exact) == 0
+
+
+# ---------------------------------------------------------------------------
+# housekeeping under sustained traffic + crash-loss bound
+# ---------------------------------------------------------------------------
+
+def _sidecar_rpc(sock_path, cmd, body):
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(sock_path)
+    s.sendall(struct.pack(">qBB", len(body), cmd, 0) + body)
+    hdr = b""
+    while len(hdr) < 10:
+        part = s.recv(10 - len(hdr))
+        assert part, "sidecar closed mid-response"
+        hdr += part
+    ln = struct.unpack(">q", hdr[:8])[0]
+    resp = b""
+    while len(resp) < ln:
+        part = s.recv(ln - len(resp))
+        assert part
+        resp += part
+    s.close()
+    return hdr[9], resp
+
+
+def test_busy_sidecar_still_snapshots_and_crash_loss_is_bounded(tmp_path):
+    """The old serve loop only snapshotted inside accept()'s timeout
+    branch: a steadily-busy listener deferred save_state forever, so a
+    crash lost an unbounded window.  With the housekeeping thread, a
+    commit older than one snapshot interval survives SIGKILL."""
+    state = os.path.join(str(tmp_path), "state")
+    os.makedirs(state, exist_ok=True)
+    proc, sock = _start_sidecar(tmp_path, state_dir=state)
+    files_snap = os.path.join(state, "sidecar_files.json")
+    try:
+        # Commit a file, then keep the listener busy: a fresh connection
+        # + ACTIVE_TEST round-trip every 50 ms means accept() never
+        # times out (interval is 2 s).
+        _sidecar_rpc(sock, 122, b"commitfile " + b"ab" * 20 +
+                     b" group1/M00/00/00/early.bin")
+        t_commit = time.time()
+        while time.time() - t_commit < 5.0:
+            status, _ = _sidecar_rpc(sock, 111, b"")
+            assert status == 0
+            time.sleep(0.05)
+        # SIGKILL: no SIGTERM snapshot — only the periodic one can save us.
+        proc.kill()
+        proc.wait()
+        assert os.path.exists(files_snap), \
+            "busy sidecar never snapshotted (housekeeping starved)"
+        with open(files_snap) as fh:
+            files = json.load(fh)
+        assert "ab" * 20 in files, \
+            "commit older than 2x snapshot interval lost on SIGKILL"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
