@@ -25,49 +25,207 @@ from fastdfs_tpu.ops.minhash import EMPTY
 SIG_SPEC_VERSION = 2
 
 
+# Sentinel offset meaning "the ref is the carrier object itself, not a
+# [carrier, offset] pair" — kept for API generality; production refs are
+# always [file_ref, offset].
+_OFF_BARE = -(1 << 62)
+
+# Snapshot format version for the exact index (v2 = columnar arrays;
+# v1 = flat digest bytes + per-entry json refs).  load() reads both.
+_EXACT_SPEC = 2
+
+
 class ExactDigestIndex:
-    """digest bytes → opaque ref (chunk locator / file id)."""
+    """digest bytes → ``[carrier, offset]`` ref (chunk locator / file id),
+    engineered for tens of millions of entries.
+
+    A plain ``dict[bytes, list]`` costs ~200 B/entry — config 5's nominal
+    scale (~62M chunks) would need >12 GB of pure bookkeeping.  Instead:
+    an LSM-flavored layout with a sorted ``S20`` digest column plus
+    parallel ``int32`` carrier-id / ``int64`` offset columns (the BASE),
+    and a small dict DELTA for recent inserts, merged into the base when
+    it grows past a quarter of it.  ~36 B/entry steady-state, batch
+    lookups vectorize through ``np.searchsorted``, and snapshots are raw
+    column dumps (SHA1 digests are incompressible — no zlib pass).
+
+    Carrier objects (file ids) are interned in a side table, so the per
+    entry cost is independent of file-id length.  Removals tombstone
+    base rows (compacted at the next merge) and delete delta entries.
+    """
 
     def __init__(self) -> None:
-        self._map: dict[bytes, Any] = {}
+        self._base_dig = np.empty(0, dtype="S20")
+        self._base_carrier = np.empty(0, dtype=np.int32)
+        self._base_off = np.empty(0, dtype=np.int64)
+        self._base_dead = np.empty(0, dtype=bool)
+        self._dead = 0                                  # tombstoned rows
+        self._delta: dict[bytes, tuple[int, int]] = {}  # dig -> (cid, off)
+        self._carriers: list[Any] = []
+        self._carrier_ids: dict[Any, int] = {}
+        self._len = 0
 
     def __len__(self) -> int:
-        return len(self._map)
+        return self._len
+
+    # -- internals ---------------------------------------------------------
+
+    def _cid(self, carrier: Any) -> int:
+        i = self._carrier_ids.get(carrier)
+        if i is None:
+            i = len(self._carriers)
+            self._carriers.append(carrier)
+            self._carrier_ids[carrier] = i
+        return i
+
+    @staticmethod
+    def _decompose(ref: Any) -> tuple[Any, int]:
+        if (isinstance(ref, (list, tuple)) and len(ref) == 2
+                and isinstance(ref[1], (int, np.integer))):
+            return ref[0], int(ref[1])
+        return ref, _OFF_BARE
+
+    def _compose(self, cid: int, off: int) -> Any:
+        c = self._carriers[cid]
+        return c if off == _OFF_BARE else [c, off]
+
+    def _base_row(self, digest: bytes) -> int:
+        """Row index of a LIVE base entry, or -1."""
+        n = len(self._base_dig)
+        if n == 0:
+            return -1
+        i = int(np.searchsorted(self._base_dig, np.bytes_(digest)))
+        if i < n and self._base_dig[i] == digest and not self._base_dead[i]:
+            return i
+        return -1
+
+    def _merge(self) -> None:
+        """Fold the delta into the base (and compact tombstones)."""
+        alive = ~self._base_dead if self._dead else slice(None)
+        parts_d = [self._base_dig[alive]]
+        parts_c = [self._base_carrier[alive]]
+        parts_o = [self._base_off[alive]]
+        if self._delta:
+            nd = len(self._delta)
+            parts_d.append(np.fromiter(self._delta.keys(), dtype="S20",
+                                       count=nd))
+            vals = self._delta.values()
+            parts_c.append(np.fromiter((v[0] for v in vals), dtype=np.int32,
+                                       count=nd))
+            parts_o.append(np.fromiter((v[1] for v in self._delta.values()),
+                                       dtype=np.int64, count=nd))
+        dig = np.concatenate(parts_d)
+        order = np.argsort(dig, kind="stable")
+        self._base_dig = dig[order]
+        self._base_carrier = np.concatenate(parts_c)[order]
+        self._base_off = np.concatenate(parts_o)[order]
+        self._base_dead = np.zeros(len(dig), dtype=bool)
+        self._dead = 0
+        self._delta = {}
+
+    def _maybe_merge(self) -> None:
+        if len(self._delta) >= max(65536, len(self._base_dig) // 4):
+            self._merge()
+
+    # -- API ---------------------------------------------------------------
 
     def lookup(self, digest: bytes):
-        return self._map.get(digest)
+        v = self._delta.get(digest)
+        if v is not None:
+            return self._compose(v[0], v[1])
+        i = self._base_row(digest)
+        if i < 0:
+            return None
+        return self._compose(int(self._base_carrier[i]),
+                             int(self._base_off[i]))
 
     def lookup_batch(self, digests: Sequence[bytes]) -> list[Any]:
-        return [self._map.get(d) for d in digests]
+        """One vectorized searchsorted over the base for the whole batch
+        (the TPU engine judges chunks hundreds at a time)."""
+        out: list[Any] = [None] * len(digests)
+        if not digests:
+            return out
+        n = len(self._base_dig)
+        if n:
+            keys = np.array(list(digests), dtype="S20")
+            idx = np.searchsorted(self._base_dig, keys)
+            np.clip(idx, 0, n - 1, out=idx)
+            hit = (self._base_dig[idx] == keys) & ~self._base_dead[idx]
+            for j in np.nonzero(hit)[0]:
+                i = int(idx[j])
+                out[j] = self._compose(int(self._base_carrier[i]),
+                                       int(self._base_off[i]))
+        if self._delta:
+            for j, d in enumerate(digests):
+                v = self._delta.get(d)
+                if v is not None:
+                    out[j] = self._compose(v[0], v[1])
+        return out
 
     def insert(self, digest: bytes, ref: Any) -> bool:
         """Insert if absent; returns True when this digest was new."""
-        if digest in self._map:
+        if digest in self._delta or self._base_row(digest) >= 0:
             return False
-        self._map[digest] = ref
+        carrier, off = self._decompose(ref)
+        self._delta[digest] = (self._cid(carrier), off)
+        self._len += 1
+        self._maybe_merge()
         return True
 
     def remove(self, digest: bytes) -> bool:
-        return self._map.pop(digest, None) is not None
+        if self._delta.pop(digest, None) is not None:
+            self._len -= 1
+            return True
+        i = self._base_row(digest)
+        if i < 0:
+            return False
+        self._base_dead[i] = True
+        self._dead += 1
+        self._len -= 1
+        return True
 
     def items(self):
-        return self._map.items()
+        """Live (digest, ref) pairs — delta first, then base."""
+        for d, (cid, off) in self._delta.items():
+            yield d, self._compose(cid, off)
+        for i in range(len(self._base_dig)):
+            if not self._base_dead[i]:
+                yield bytes(self._base_dig[i]), self._compose(
+                    int(self._base_carrier[i]), int(self._base_off[i]))
 
     # -- persistence (checkpoint/resume parity; SURVEY.md §5) -------------
 
     def save(self, path: str) -> None:
-        digests = np.frombuffer(b"".join(self._map.keys()), dtype=np.uint8)
-        refs = np.array([json.dumps(v) for v in self._map.values()], dtype=object)
-        _atomic_savez(path, digests=digests, refs=refs)
+        self._merge()  # snapshot = one sorted columnar base
+        _atomic_savez(
+            path, compress=False,  # SHA1 columns are incompressible
+            digests=self._base_dig.view(np.uint8),
+            carrier_idx=self._base_carrier, offsets=self._base_off,
+            carriers=np.array([json.dumps(c) for c in self._carriers],
+                              dtype=object),
+            exact_spec=_EXACT_SPEC)
 
     @classmethod
     def load(cls, path: str) -> "ExactDigestIndex":
         data = np.load(_npz_path(path), allow_pickle=True)
         idx = cls()
-        raw = data["digests"].tobytes()
-        refs = data["refs"]
-        for i in range(len(refs)):
-            idx._map[raw[i * 20:(i + 1) * 20]] = json.loads(str(refs[i]))
+        if "exact_spec" not in data:  # v1: flat bytes + per-entry json refs
+            raw = data["digests"].tobytes()
+            refs = data["refs"]
+            for i in range(len(refs)):
+                idx.insert(raw[i * 20:(i + 1) * 20], json.loads(str(refs[i])))
+            return idx
+        idx._base_dig = np.ascontiguousarray(data["digests"]).view("S20")
+        idx._base_carrier = np.asarray(data["carrier_idx"], dtype=np.int32)
+        idx._base_off = np.asarray(data["offsets"], dtype=np.int64)
+        idx._base_dead = np.zeros(len(idx._base_dig), dtype=bool)
+        idx._carriers = [json.loads(str(c)) for c in data["carriers"]]
+        idx._carrier_ids = {}
+        for i, c in enumerate(idx._carriers):
+            try:
+                idx._carrier_ids[c] = i
+            except TypeError:  # unhashable carrier (e.g. json list)
+                pass
+        idx._len = len(idx._base_dig)
         return idx
 
 
@@ -220,11 +378,13 @@ def _npz_path(path: str) -> str:
     return path if path.endswith(".npz") else path + ".npz"
 
 
-def _atomic_savez(path: str, **arrays) -> None:
+def _atomic_savez(path: str, compress: bool = True, **arrays) -> None:
     """Write-then-rename snapshot (reference: tracker_save_storages() writes
-    its ``.dat`` files the same way for crash consistency)."""
+    its ``.dat`` files the same way for crash consistency).  compress=False
+    for columns that will not compress (e.g. SHA1 digests) — at tens of
+    millions of entries the zlib pass dominates snapshot time."""
     final = _npz_path(path)
     tmp = final + ".tmp"
-    np.savez_compressed(tmp, **arrays)
+    (np.savez_compressed if compress else np.savez)(tmp, **arrays)
     # np.savez appends .npz to paths without it.
     os.replace(tmp + ".npz", final)

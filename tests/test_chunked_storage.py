@@ -273,8 +273,9 @@ def test_sidecar_snapshot_save_load(tmp_path):
         assert sidecar.wait(timeout=60) == 0
 
         # snapshots exist and carry no provisional state
-        exact = np.load(str(state / "sidecar_exact.npz"), allow_pickle=True)
-        refs = [json.loads(str(r)) for r in exact["refs"]]
+        from fastdfs_tpu.dedup.index import ExactDigestIndex
+        exact = ExactDigestIndex.load(str(state / "sidecar_exact.npz"))
+        refs = [r for _, r in exact.items()]
         assert refs, "exact index snapshot is empty"
         assert all(r[0] != "(pending)" for r in refs), refs
         assert fa in {r[0] for r in refs}
@@ -337,8 +338,7 @@ def test_sidecar_sessions_interleave_and_abort(tmp_path):
     assert sc._sessions == {}
 
     # only A's attribution reached the indexes; nothing provisional
-    refs = {tuple(r) for r in
-            (sc.engine.exact._map[k] for k in sc.engine.exact._map)}
+    refs = {tuple(r) for _, r in sc.engine.exact.items()}
     assert refs and all(r[0] == "group1/M00/AA/AA/a.bin" for r in refs)
     assert len(sc.engine.near) == 1
 
