@@ -193,7 +193,7 @@ class StorageClient:
 
     def near_dups(self, file_id: str) -> list[tuple[str, float]]:
         """Ranked near-duplicates of a stored file from the dedup
-        engine's MinHash/LSH index (fastdfs_tpu extension, NEAR_DUPS=38).
+        engine's MinHash/LSH index (fastdfs_tpu extension, NEAR_DUPS=124).
         Returns [] when the file carries no signature (ENODATA);
         StatusError(95) when the dedup mode has no near index."""
         group, remote = _split_id(file_id)

@@ -128,19 +128,21 @@ class StorageCmd(enum.IntEnum):
     SYNC_MODIFY_FILE = 35
     TRUNCATE_FILE = 36
     SYNC_TRUNCATE_FILE = 37
-    # fastdfs_tpu extension (no reference equivalent): ranked near-dup
-    # report for a stored file, answered from the sidecar's MinHash/LSH
-    # index.  Body = 16B group + remote filename; response = text lines
-    # "<file_id> <score>".  ENOTSUP when the dedup mode has no near index.
-    NEAR_DUPS = 38
 
     # fastdfs_tpu extension: dedup-engine sidecar RPCs (no reference
     # equivalent; carried on the same framing so the C++ daemons reuse one
-    # codec).  Values chosen clear of the upstream table.
+    # codec).  Values chosen clear of the upstream table — later upstream
+    # releases keep assigning the 38+ range (e.g. 38 becomes
+    # REGENERATE_APPENDER_FILENAME), so ALL extensions live at 120+.
     DEDUP_FINGERPRINT = 120
     DEDUP_QUERY = 121
     DEDUP_COMMIT = 122
     DEDUP_NEARDUPS = 123
+    # Ranked near-dup report for a stored file, answered from the
+    # sidecar's MinHash/LSH index.  Body = 16B group + remote filename;
+    # response = text lines "<file_id> <score>".  ENOTSUP when the dedup
+    # mode has no near index.
+    NEAR_DUPS = 124
 
     RESP = 100
     ACTIVE_TEST = 111

@@ -93,8 +93,12 @@ class ExactDigestIndex:
         n = len(self._base_dig)
         if n == 0:
             return -1
-        i = int(np.searchsorted(self._base_dig, np.bytes_(digest)))
-        if i < n and self._base_dig[i] == digest and not self._base_dead[i]:
+        # The probe must be an S20 ARRAY scalar, not np.bytes_: only
+        # S20-to-S20 comparison gets NUL-padding semantics, so the ~1/256
+        # SHA1 digests ending in 0x00 still match their stored row.
+        q = np.array(digest, dtype="S20")
+        i = int(np.searchsorted(self._base_dig, q))
+        if i < n and self._base_dig[i] == q and not self._base_dead[i]:
             return i
         return -1
 
@@ -121,6 +125,28 @@ class ExactDigestIndex:
         self._base_dead = np.zeros(len(dig), dtype=bool)
         self._dead = 0
         self._delta = {}
+        self._compact_carriers()
+
+    def _compact_carriers(self) -> None:
+        """Drop forgotten (None-slotted) carriers and remap the base
+        carrier column — without this, create/forget churn leaks every
+        dead file-id string into RAM and every snapshot forever.  Only
+        runs on merge, when the delta is empty (its cids would otherwise
+        need remapping too)."""
+        if not any(c is None for c in self._carriers):
+            return
+        used = np.unique(self._base_carrier) if len(self._base_carrier) \
+            else np.empty(0, dtype=np.int32)
+        remap = np.full(len(self._carriers), -1, dtype=np.int32)
+        remap[used] = np.arange(len(used), dtype=np.int32)
+        self._base_carrier = remap[self._base_carrier]
+        self._carriers = [self._carriers[int(c)] for c in used]
+        self._carrier_ids = {}
+        for i, c in enumerate(self._carriers):
+            try:
+                self._carrier_ids[c] = i
+            except TypeError:
+                pass  # unhashable carrier (load() tolerates them too)
 
     def _maybe_merge(self) -> None:
         if len(self._delta) >= max(65536, len(self._base_dig) // 4):
@@ -184,13 +210,44 @@ class ExactDigestIndex:
         return True
 
     def items(self):
-        """Live (digest, ref) pairs — delta first, then base."""
+        """Live (digest, ref) pairs — delta first, then base.  Base
+        digests are re-padded to the full 20 bytes: numpy ``S20`` scalars
+        strip trailing NULs on extraction, which would silently shorten
+        ~1/256 SHA1 digests for byte-equality consumers."""
         for d, (cid, off) in self._delta.items():
             yield d, self._compose(cid, off)
         for i in range(len(self._base_dig)):
             if not self._base_dead[i]:
-                yield bytes(self._base_dig[i]), self._compose(
+                yield bytes(self._base_dig[i]).ljust(20, b"\0"), self._compose(
                     int(self._base_carrier[i]), int(self._base_off[i]))
+
+    def remove_by_carrier(self, carrier: Any) -> int:
+        """Tombstone every live entry attributed to ``carrier`` (a deleted
+        file id) — one vectorized mask over the base carrier column plus a
+        delta scan, so `forget` needs no per-file side table of digest
+        lists (which would reintroduce the per-entry object overhead this
+        columnar layout exists to avoid).  Returns the number removed."""
+        cid = self._carrier_ids.get(carrier)
+        if cid is None:
+            return 0
+        dead_delta = [d for d, v in self._delta.items() if v[0] == cid]
+        for d in dead_delta:
+            del self._delta[d]
+        n = len(dead_delta)
+        if len(self._base_dig):
+            hit = (self._base_carrier == cid) & ~self._base_dead
+            k = int(hit.sum())
+            if k:
+                self._base_dead[hit] = True
+                self._dead += k
+                n += k
+        self._len -= n
+        # Release the interned id now (the string itself at the next
+        # merge): churned file ids must not accumulate in the carrier
+        # table or its snapshots.
+        self._carriers[cid] = None
+        del self._carrier_ids[carrier]
+        return n
 
     # -- persistence (checkpoint/resume parity; SURVEY.md §5) -------------
 
@@ -250,10 +307,11 @@ class MinHashLSHIndex:
         self._rows: list[np.ndarray] = []
         self._sigs_cache: np.ndarray | None = None
         self._refs: list[Any] = []
-        # ref -> latest item id (hashable refs only), for O(1)
-        # signature_of — the production query path "what is <file_id>
-        # near?" enters by ref, not by signature.
-        self._by_ref: dict[Any, int] = {}
+        # ref -> ALL item ids carrying it (hashable refs only): O(1)
+        # signature_of (latest id) and O(items-of-ref) remove — a linear
+        # _refs scan per delete would make churn quadratic at the scale
+        # the exact index is engineered for.
+        self._ids_by_ref: dict[Any, list[int]] = {}
 
     def __len__(self) -> int:
         return len(self._refs)
@@ -277,9 +335,9 @@ class MinHashLSHIndex:
         self._rows.append(sig)
         self._sigs_cache = None
         try:
-            self._by_ref[ref] = item
+            self._ids_by_ref.setdefault(ref, []).append(item)
         except TypeError:
-            pass  # unhashable ref: signature_of unsupported for it
+            pass  # unhashable ref: signature_of/remove unsupported for it
         for b, key in enumerate(self._band_keys(sig)):
             self._buckets[b].setdefault(key, []).append(item)
         return item
@@ -310,25 +368,31 @@ class MinHashLSHIndex:
         """Tombstone every item carrying ``ref`` (deleted file).  Bucket
         entries and signature rows stay (append-only ids); queries skip
         tombstones.  Returns the number of items removed."""
-        n = 0
-        for i, r in enumerate(self._refs):
-            if r == ref:
-                self._refs[i] = None
-                n += 1
         try:
-            self._by_ref.pop(ref, None)
+            ids = self._ids_by_ref.pop(ref, None)
         except TypeError:
-            pass
-        return n
+            # Unhashable refs never enter the ref map — fall back to the
+            # linear scan so they still tombstone.
+            n = 0
+            for i, r in enumerate(self._refs):
+                if r == ref:
+                    self._refs[i] = None
+                    n += 1
+            return n
+        if not ids:
+            return 0
+        for i in ids:
+            self._refs[i] = None
+        return len(ids)
 
     def signature_of(self, ref: Any) -> np.ndarray | None:
         """Latest stored signature for ``ref`` (None when unindexed or
         removed) — the entry point for ref-keyed near-dup queries."""
         try:
-            i = self._by_ref.get(ref)
+            ids = self._ids_by_ref.get(ref)
         except TypeError:
             return None
-        return self._rows[i] if i is not None else None
+        return self._rows[ids[-1]] if ids else None
 
     @property
     def signatures(self) -> np.ndarray:
@@ -368,7 +432,7 @@ class MinHashLSHIndex:
         for item, ref in enumerate(idx._refs):
             if ref is not None:
                 try:
-                    idx._by_ref[ref] = item
+                    idx._ids_by_ref.setdefault(ref, []).append(item)
                 except TypeError:
                     pass
         return idx

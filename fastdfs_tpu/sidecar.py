@@ -25,9 +25,12 @@ Opcodes
 * ``DEDUP_COMMIT`` (122): text body, one of
   ``commitfile <sha1hex> <file_id>`` |
   ``commitchunks <session> <file_id>`` | ``abort <session>`` |
-  ``forget <file_id>``.  ``abort`` is sent on flat-fallback or a failed
-  upload; sessions older than ``_SESSION_TTL`` seconds are reaped in
-  case a daemon dies without either message.
+  ``forget <file_id>`` | ``stats``.  ``abort`` is sent on flat-fallback
+  or a failed upload; sessions older than ``_SESSION_TTL`` seconds are
+  reaped in case a daemon dies without either message.  ``stats``
+  returns the service counters as JSON (fingerprint_bytes, chunks,
+  requests, lock_wait_us, engine_us) — the bench harness reads it to
+  price the engine serialization.
 * ``DEDUP_NEARDUPS`` (123): body = file id text.  Response: ranked text
   lines ``<file_id> <score>`` from the MinHash/LSH index (the operator
   query surface behind the daemon's ``NEAR_DUPS`` command); status 61
@@ -103,16 +106,14 @@ class DedupSidecar:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._listener: socket.socket | None = None
-        self.stats = {"fingerprint_bytes": 0, "chunks": 0, "requests": 0}
-        # file id -> digests ATTRIBUTED to it in the exact index (the
-        # subset of its chunks it was first carrier of).  Lets `forget`
-        # prune exact attributions in O(chunks-of-file) instead of
-        # leaking them forever; rebuilt from the exact index on load, so
-        # snapshots carry no extra state.
-        self.attr_by_file: dict[str, list[bytes]] = {}
+        # lock_wait_us / engine_us price the one-engine-serialization
+        # design: lock_wait is time requests spent queued on _lock,
+        # engine is time actually inside engine.fingerprint.  Read via
+        # the `stats` commit subcommand (bench stage attribution).
+        self.stats = {"fingerprint_bytes": 0, "chunks": 0, "requests": 0,
+                      "lock_wait_us": 0, "engine_us": 0}
         if state_dir:
             self._load_state()
-        self._rebuild_attributions()
 
     # -- state -------------------------------------------------------------
 
@@ -147,15 +148,6 @@ class DedupSidecar:
                     pass
                 self.engine = fresh
 
-    def _rebuild_attributions(self) -> None:
-        self.attr_by_file.clear()
-        for dig, ref in self.engine.exact.items():
-            try:
-                fid = ref[0]
-            except (TypeError, IndexError, KeyError):
-                continue
-            self.attr_by_file.setdefault(fid, []).append(dig)
-
     def save_state(self) -> None:
         if not self.state_dir:
             return
@@ -175,8 +167,12 @@ class DedupSidecar:
         session_id = _I64.unpack_from(body)[0]
         base_offset = _I64.unpack_from(body, 8)[0]
         data = body[16:]
+        t_wait = time.monotonic()
         with self._lock:
+            t_held = time.monotonic()
             spans, digests, sigs = self.engine.fingerprint(data)
+            self.stats["lock_wait_us"] += int((t_held - t_wait) * 1e6)
+            self.stats["engine_us"] += int((time.monotonic() - t_held) * 1e6)
             sess = self._sessions.setdefault(session_id, _Session())
             sess.touched = time.monotonic()
             raw = np.asarray(digests, dtype=">u4").tobytes()
@@ -217,15 +213,13 @@ class DedupSidecar:
                 sess = self._sessions.pop(_parse_session(parts[1]), None)
                 if sess is not None:
                     file_id = parts[2]
-                    mine = self.attr_by_file.setdefault(file_id, [])
                     for dig, off in sess.digests:
-                        if self.engine.exact.insert(dig, [file_id, off]):
-                            mine.append(dig)
-                    if not mine:
-                        del self.attr_by_file[file_id]
+                        self.engine.exact.insert(dig, [file_id, off])
                     if sess.sig is not None:
                         self.engine.near.add(sess.sig, file_id)
                 return 0, b""
+            if parts[0] == "stats" and len(parts) == 1:
+                return 0, json.dumps(self.stats).encode()
             if parts[0] == "abort" and len(parts) == 2:
                 self._sessions.pop(_parse_session(parts[1]), None)
                 return 0, b""
@@ -239,11 +233,10 @@ class DedupSidecar:
                 # forever).  The daemon's ChunkStore owns true chunk
                 # refcounts; this index only answers "who first carried
                 # it", so dropping the tombstoned carrier is safe — a
-                # later upload of the same chunk re-attributes it.
-                for dig in self.attr_by_file.pop(parts[1], ()):
-                    ref = self.engine.exact.lookup(dig)
-                    if ref is not None and ref[0] == parts[1]:
-                        self.engine.exact.remove(dig)
+                # later upload of the same chunk re-attributes it.  One
+                # vectorized pass over the index's carrier column — no
+                # per-file digest-list side table in RAM.
+                self.engine.exact.remove_by_carrier(parts[1])
                 return 0, b""
         return 22, b""
 
@@ -328,11 +321,17 @@ class DedupSidecar:
         scheduling they used to ride starves under sustained traffic,
         making crash loss unbounded instead of one snapshot interval)."""
         while not self._stop.wait(snapshot_interval):
+            # Catch EVERYTHING: one bad snapshot attempt (OSError, but
+            # also numpy/json errors from racing state) must not kill the
+            # thread and silently disable snapshots + session reaping.
             try:
                 self.save_state()
-            except OSError as e:
+            except Exception as e:
                 print(f"dedup sidecar: snapshot failed: {e}", flush=True)
-            self._reap_stale_sessions()
+            try:
+                self._reap_stale_sessions()
+            except Exception as e:
+                print(f"dedup sidecar: session reap failed: {e}", flush=True)
 
     def serve_forever(self, ready_event: threading.Event | None = None,
                       snapshot_interval: float = 60.0) -> None:

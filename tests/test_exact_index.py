@@ -1,0 +1,228 @@
+"""ExactDigestIndex internals: the paths that guard every dedup verdict.
+
+The columnar sorted-base + delta layout (fastdfs_tpu/dedup/index.py) was
+engineered for tens of millions of entries; these tests drive the parts
+test-scale usage never reaches: the delta→base merge at the real 65,536
+threshold, tombstone compaction, delta-shadowing-base lookups at the
+boundary, the v1→v2 snapshot migration, and carrier-column pruning.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from fastdfs_tpu.dedup.index import ExactDigestIndex, MinHashLSHIndex
+
+
+def _digests(n: int, seed: int = 0) -> list[bytes]:
+    """n distinct 20-byte digests (sha1 of counters — realistic keys)."""
+    return [hashlib.sha1(f"{seed}:{i}".encode()).digest() for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# delta→base merge at the production threshold
+# ---------------------------------------------------------------------------
+
+def test_merge_triggers_at_real_threshold_and_preserves_lookups():
+    idx = ExactDigestIndex()
+    n = 65536 + 500  # crosses max(65536, base/4) with an empty base
+    digs = _digests(n)
+    for i, d in enumerate(digs):
+        assert idx.insert(d, [f"f{i % 97}", i])
+    # the merge must actually have happened (delta folded into the base)
+    assert len(idx._base_dig) >= 65536
+    assert len(idx._delta) < 65536
+    assert len(idx) == n
+    # spot-check lookups across both sides of the merge boundary
+    for i in (0, 1, 65535, 65536, n - 1, n // 2):
+        assert idx.lookup(digs[i]) == [f"f{i % 97}", i]
+    # batch lookup agrees with scalar lookup
+    sample = [digs[i] for i in range(0, n, 4096)]
+    assert idx.lookup_batch(sample) == [idx.lookup(d) for d in sample]
+    # no duplicate insertions slipped through
+    assert not idx.insert(digs[123], ["other", 0])
+    assert idx.lookup(digs[123]) == ["f" + str(123 % 97), 123]
+
+
+def test_merge_compacts_tombstones():
+    idx = ExactDigestIndex()
+    digs = _digests(1000)
+    for i, d in enumerate(digs):
+        idx.insert(d, ["carrier", i])
+    idx._merge()  # all in base
+    for d in digs[::3]:
+        assert idx.remove(d)
+    assert idx._dead == len(digs[::3])
+    idx._merge()
+    assert idx._dead == 0
+    assert not idx._base_dead.any()
+    assert len(idx._base_dig) == 1000 - len(digs[::3])
+    for i, d in enumerate(digs):
+        if i % 3 == 0:
+            assert idx.lookup(d) is None
+        else:
+            assert idx.lookup(d) == ["carrier", i]
+
+
+def test_removed_digest_can_be_reinserted_with_new_ref():
+    # delta shadows a tombstoned base row: the dedup engine re-attributes
+    # a chunk after its first carrier was deleted.
+    idx = ExactDigestIndex()
+    digs = _digests(100)
+    for i, d in enumerate(digs):
+        idx.insert(d, ["old", i])
+    idx._merge()
+    assert idx.remove(digs[50])
+    assert idx.insert(digs[50], ["new", 7])
+    assert idx.lookup(digs[50]) == ["new", 7]
+    # batch path must prefer the delta entry over the dead base row
+    assert idx.lookup_batch([digs[50], digs[51]]) == [["new", 7],
+                                                      ["old", 51]]
+    # and the state survives a merge
+    idx._merge()
+    assert idx.lookup(digs[50]) == ["new", 7]
+    assert len(idx) == 100
+
+
+# ---------------------------------------------------------------------------
+# snapshot formats
+# ---------------------------------------------------------------------------
+
+def test_v1_snapshot_migrates(tmp_path):
+    # v1 layout: flat digest bytes + per-entry json refs, no exact_spec
+    # marker (round-2 sidecars wrote these; load() must keep reading them).
+    import json
+
+    digs = _digests(257)
+    refs = [json.dumps([f"file{i}", i * 10]) for i in range(len(digs))]
+    p = str(tmp_path / "exact_v1.npz")
+    np.savez(p, digests=np.frombuffer(b"".join(digs), dtype=np.uint8),
+             refs=np.array(refs, dtype=object))
+    idx = ExactDigestIndex.load(p)
+    assert len(idx) == len(digs)
+    for i, d in enumerate(digs):
+        assert idx.lookup(d) == [f"file{i}", i * 10]
+
+
+def test_v2_snapshot_roundtrip_with_tombstones_and_delta(tmp_path):
+    idx = ExactDigestIndex()
+    digs = _digests(3000)
+    for i, d in enumerate(digs[:2000]):
+        idx.insert(d, ["a", i])
+    idx._merge()
+    for d in digs[:100]:
+        idx.remove(d)
+    for i, d in enumerate(digs[2000:]):  # fresh delta on top
+        idx.insert(d, ["b", i])
+    p = str(tmp_path / "exact_v2")
+    idx.save(p)
+    idx2 = ExactDigestIndex.load(p)
+    assert len(idx2) == len(idx)
+    assert idx2.lookup(digs[0]) is None
+    assert idx2.lookup(digs[150]) == ["a", 150]
+    assert idx2.lookup(digs[2500]) == ["b", 500]
+
+
+def test_items_pads_nul_terminated_digests():
+    # numpy S20 strips trailing NULs on extraction; items() must re-pad
+    # (~1/256 SHA1 digests end in 0x00 — silently shortened keys would
+    # miss byte-equality consumers).
+    idx = ExactDigestIndex()
+    d_nul = b"\x01" * 19 + b"\x00"
+    d_mid = b"\x02" * 10 + b"\x00" * 10
+    idx.insert(d_nul, ["x", 1])
+    idx.insert(d_mid, ["y", 2])
+    idx._merge()  # move into the base (the S20 column)
+    got = dict(idx.items())
+    assert d_nul in got and got[d_nul] == ["x", 1]
+    assert d_mid in got and got[d_mid] == ["y", 2]
+    assert all(len(k) == 20 for k in got)
+
+
+# ---------------------------------------------------------------------------
+# carrier-column pruning (forget path)
+# ---------------------------------------------------------------------------
+
+def test_remove_by_carrier_spans_delta_and_base():
+    idx = ExactDigestIndex()
+    digs = _digests(300)
+    for i, d in enumerate(digs[:200]):
+        idx.insert(d, ["gone" if i % 2 else "kept", i])
+    idx._merge()
+    for i, d in enumerate(digs[200:]):
+        idx.insert(d, ["gone" if i % 2 else "kept", 200 + i])
+    n_gone = sum(1 for i in range(200) if i % 2) + \
+        sum(1 for i in range(100) if i % 2)
+    assert idx.remove_by_carrier("gone") == n_gone
+    assert len(idx) == 300 - n_gone
+    assert idx.remove_by_carrier("gone") == 0      # idempotent
+    assert idx.remove_by_carrier("never-seen") == 0
+    for i, d in enumerate(digs[:200]):
+        assert (idx.lookup(d) is None) == bool(i % 2)
+    # survivors intact through a subsequent compaction
+    idx._merge()
+    assert idx.lookup(digs[0]) == ["kept", 0]
+    assert len(idx) == 300 - n_gone
+
+
+def test_carrier_churn_does_not_leak_interned_ids(tmp_path):
+    # create/forget cycles: forgotten file-id strings must leave the
+    # carrier table (and its snapshots), not accumulate forever.
+    idx = ExactDigestIndex()
+    for round_ in range(50):
+        digs = _digests(20, seed=round_)
+        for i, d in enumerate(digs):
+            idx.insert(d, [f"churn{round_}", i])
+        assert idx.remove_by_carrier(f"churn{round_}") == 20
+    idx.insert(_digests(1, seed=999)[0], ["survivor", 0])
+    idx._merge()
+    assert idx._carriers == ["survivor"]
+    assert len(idx) == 1
+    # snapshots carry only the live carrier
+    p = str(tmp_path / "churn")
+    idx.save(p)
+    idx2 = ExactDigestIndex.load(p)
+    assert idx2._carriers == ["survivor"]
+    assert idx2.lookup(_digests(1, seed=999)[0]) == ["survivor", 0]
+
+
+# ---------------------------------------------------------------------------
+# LSH remove via the ref map (no linear scan)
+# ---------------------------------------------------------------------------
+
+def test_lsh_remove_tombstones_all_items_of_ref():
+    rng = np.random.RandomState(9)
+    idx = MinHashLSHIndex(64, 16)
+    sigs = rng.randint(1, 2**32, (6, 64)).astype(np.uint32)
+    for k in range(4):
+        idx.add(sigs[k], "dup-file")
+    idx.add(sigs[4], "other")
+    assert idx.remove("dup-file") == 4
+    assert idx.remove("dup-file") == 0
+    assert idx.signature_of("dup-file") is None
+    assert idx.signature_of("other") is not None
+    # tombstoned items never surface in queries
+    got = idx.query(sigs[0], top_k=10, min_similarity=0.0)
+    assert all(ref != "dup-file" for ref, _ in got)
+    # re-adding after removal works and signature_of tracks the latest
+    idx.add(sigs[5], "dup-file")
+    assert (idx.signature_of("dup-file") == sigs[5]).all()
+
+
+def test_lsh_remove_roundtrips_through_snapshot(tmp_path):
+    rng = np.random.RandomState(10)
+    idx = MinHashLSHIndex(64, 16)
+    s1 = rng.randint(1, 2**32, 64).astype(np.uint32)
+    s2 = rng.randint(1, 2**32, 64).astype(np.uint32)
+    idx.add(s1, "a")
+    idx.add(s2, "b")
+    idx.remove("a")
+    p = str(tmp_path / "lsh")
+    idx.save(p)
+    idx2 = MinHashLSHIndex.load(p)
+    assert idx2.signature_of("a") is None
+    assert (idx2.signature_of("b") == s2).all()
+    assert idx2.remove("b") == 1
