@@ -138,6 +138,15 @@ class StorageCmd(enum.IntEnum):
     DEDUP_QUERY = 121
     DEDUP_COMMIT = 122
     DEDUP_NEARDUPS = 123
+    # Like DEDUP_FINGERPRINT, but the caller already ran CDC (the C++
+    # daemon's AVX2 gear chunker — same table, identical cut points) and
+    # ships the cut offsets with the bytes: body = 8B session + 8B
+    # base_offset + 8B n_cuts + n_cuts x 8B relative exclusive ends +
+    # raw segment.  The engine then skips its own chunking pass — on a
+    # host-limited link that halves the bytes the accelerator round-trip
+    # has to move (CDC is branchy scalar work the CPU does at GB/s; the
+    # hashing is the FLOP-heavy part that belongs on the TPU).
+    DEDUP_FINGERPRINT_CUTS = 125
     # Ranked near-dup report for a stored file, answered from the
     # sidecar's MinHash/LSH index.  Body = 16B group + remote filename;
     # response = text lines "<file_id> <score>".  ENOTSUP when the dedup

@@ -35,6 +35,11 @@ Opcodes
   lines ``<file_id> <score>`` from the MinHash/LSH index (the operator
   query surface behind the daemon's ``NEAR_DUPS`` command); status 61
   when the file carries no signature.
+* ``DEDUP_FINGERPRINT_CUTS`` (125): DEDUP_FINGERPRINT with the cut
+  offsets precomputed by the caller's native CDC (8B session + 8B
+  base_offset + 8B n_cuts + n_cuts x 8B ends + bytes) — the production
+  daemon path: chunking stays on the CPU (AVX2, identical cut points),
+  the accelerator round-trip only carries the hash work.
 
 State: whole-file digest map + the DedupEngine's exact/LSH indexes;
 snapshotted to ``<state_dir>/sidecar_*.json`` on SIGTERM and every
@@ -124,11 +129,37 @@ class DedupSidecar:
                 os.path.join(d, "sidecar_near.npz"))
 
     def _load_state(self) -> None:
+        from fastdfs_tpu.ops.gear_cdc import CDC_SPEC_VERSION
+
         files_p, exact_p, near_p = self._state_paths()
         if os.path.exists(files_p):
             with open(files_p) as fh:
-                self.files = json.load(fh)
+                blob = json.load(fh)
+            # Current format: {"cdc_spec": N, "files": {...}}; round-4
+            # snapshots were the flat files dict (spec 1 implicitly).
+            if isinstance(blob, dict) and "files" in blob:
+                spec = int(blob.get("cdc_spec", 1))
+                files = blob["files"]
+            else:
+                spec, files = 1, blob
+            if spec != CDC_SPEC_VERSION:
+                # Stale chunker spec: the same bytes now chunk at
+                # different offsets, so every stored chunk digest would
+                # miss — discard ALL dedup state (cold restart; recipes
+                # and reads are unaffected) instead of silently serving
+                # a dead index.
+                print(f"dedup sidecar: discarding snapshot built with "
+                      f"chunker spec v{spec} (current v{CDC_SPEC_VERSION})",
+                      flush=True)
+                return
+            self.files = files
             self.by_file = {v: k for k, v in self.files.items()}
+        elif os.path.exists(exact_p) or os.path.exists(near_p):
+            # Index snapshots without the files/spec record: unknown
+            # chunker spec — same discard rule.
+            print("dedup sidecar: discarding index snapshots with no "
+                  "chunker-spec record", flush=True)
+            return
         if os.path.exists(exact_p) and os.path.exists(near_p):
             try:
                 self.engine = DedupEngine.load(exact_p, near_p,
@@ -149,30 +180,62 @@ class DedupSidecar:
                 self.engine = fresh
 
     def save_state(self) -> None:
+        from fastdfs_tpu.ops.gear_cdc import CDC_SPEC_VERSION
+
         if not self.state_dir:
             return
         files_p, exact_p, near_p = self._state_paths()
         with self._lock:
             tmp = files_p + ".tmp"
             with open(tmp, "w") as fh:
-                json.dump(self.files, fh)
+                json.dump({"cdc_spec": CDC_SPEC_VERSION,
+                           "files": self.files}, fh)
             os.replace(tmp, files_p)
             self.engine.save(exact_p, near_p)
 
     # -- request handlers --------------------------------------------------
 
-    def _fingerprint(self, body: bytes) -> tuple[int, bytes]:
+    def _fingerprint(self, body: bytes, with_cuts: bool = False
+                     ) -> tuple[int, bytes]:
         if len(body) < 16:
             return 22, b""
         session_id = _I64.unpack_from(body)[0]
         base_offset = _I64.unpack_from(body, 8)[0]
-        data = body[16:]
+        cuts = None
+        if with_cuts:
+            # DEDUP_FINGERPRINT_CUTS: the daemon already ran the
+            # (identical) native CDC; body carries the cut offsets.
+            if len(body) < 24:
+                return 22, b""
+            n_cuts = _I64.unpack_from(body, 16)[0]
+            if n_cuts < 0 or 24 + 8 * n_cuts > len(body):
+                return 22, b""
+            cuts = [_I64.unpack_from(body, 24 + 8 * i)[0]
+                    for i in range(n_cuts)]
+            data = body[24 + 8 * n_cuts:]
+            # Cuts must exactly cover the payload: an empty cut list
+            # with data would "succeed" with zero chunks and a recipe
+            # covering none of the bytes.
+            if data:
+                if (not cuts or cuts[-1] != len(data)
+                        or any(c <= p for p, c in zip([0] + cuts, cuts))):
+                    return 22, b""
+            elif cuts:
+                return 22, b""
+        else:
+            data = body[16:]
+        # Pure compute OUTSIDE the lock: engine.fingerprint touches no
+        # index state (its docstring is the contract), and JAX dispatch
+        # is thread-safe — so concurrent daemon uploads overlap their
+        # device round-trips instead of queueing behind one global lock.
+        # Only session/stats/index mutation is serialized.
+        t_start = time.monotonic()
+        spans, digests, sigs = self.engine.fingerprint(data, cuts=cuts)
         t_wait = time.monotonic()
         with self._lock:
             t_held = time.monotonic()
-            spans, digests, sigs = self.engine.fingerprint(data)
             self.stats["lock_wait_us"] += int((t_held - t_wait) * 1e6)
-            self.stats["engine_us"] += int((time.monotonic() - t_held) * 1e6)
+            self.stats["engine_us"] += int((t_wait - t_start) * 1e6)
             sess = self._sessions.setdefault(session_id, _Session())
             sess.touched = time.monotonic()
             raw = np.asarray(digests, dtype=">u4").tobytes()
@@ -288,6 +351,8 @@ class DedupSidecar:
                 self.stats["requests"] += 1
                 if h.cmd == StorageCmd.DEDUP_FINGERPRINT:
                     status, resp = self._fingerprint(body)
+                elif h.cmd == StorageCmd.DEDUP_FINGERPRINT_CUTS:
+                    status, resp = self._fingerprint(body, with_cuts=True)
                 elif h.cmd == StorageCmd.DEDUP_QUERY:
                     status, resp = self._query(body)
                 elif h.cmd == StorageCmd.DEDUP_COMMIT:

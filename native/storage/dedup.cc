@@ -3,6 +3,7 @@
 #include <string.h>
 #include <sys/socket.h>
 #include <sys/un.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -99,62 +100,102 @@ SidecarDedup::SidecarDedup(std::string socket_path)
     : socket_path_(std::move(socket_path)) {}
 
 SidecarDedup::~SidecarDedup() {
-  if (fd_ >= 0) close(fd_);
+  for (int fd : pool_) close(fd);
 }
 
-bool SidecarDedup::EnsureConnected() {
-  if (fd_ >= 0) return true;
+static thread_local int64_t tls_dedup_lock_wait_us = 0;
+
+int64_t TakeDedupLockWaitUs() {
+  int64_t v = tls_dedup_lock_wait_us;
+  tls_dedup_lock_wait_us = 0;
+  return v;
+}
+
+static int64_t DedupMonoUs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+int SidecarDedup::AcquireFd(bool* pooled) {
+  {
+    // Only the pool-mutex wait counts as "lock wait" — connection setup
+    // below is transport cost, not serialization.
+    const int64_t t0 = DedupMonoUs();
+    std::lock_guard<std::mutex> lk(mu_);
+    tls_dedup_lock_wait_us += DedupMonoUs() - t0;
+    if (!pool_.empty()) {
+      int fd = pool_.back();
+      pool_.pop_back();
+      *pooled = true;
+      return fd;
+    }
+  }
+  *pooled = false;
   int fd = socket(AF_UNIX, SOCK_STREAM, 0);
-  if (fd < 0) return false;
+  if (fd < 0) return -1;
   struct sockaddr_un addr;
   memset(&addr, 0, sizeof(addr));
   addr.sun_family = AF_UNIX;
   strncpy(addr.sun_path, socket_path_.c_str(), sizeof(addr.sun_path) - 1);
   if (connect(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) != 0) {
     close(fd);
-    return false;
+    return -1;
   }
-  fd_ = fd;
-  return true;
+  return fd;
+}
+
+void SidecarDedup::ReleaseFd(int fd) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (static_cast<int>(pool_.size()) >= kMaxIdleFds) {
+    close(fd);
+    return;
+  }
+  pool_.push_back(fd);
 }
 
 bool SidecarDedup::Rpc(uint8_t cmd, const std::string& body, std::string* resp,
                        uint8_t* status, int64_t max_resp) {
-  // One request/response at a time on the shared fd; concurrent callers
-  // (nio threads) queue here — the sidecar itself serializes engine work
-  // anyway, so this adds no extra critical path.
-  std::lock_guard<std::mutex> lk(mu_);
-  if (!EnsureConnected()) return false;
-  // Generous timeout for fingerprint segments (first TPU compile of a new
-  // bucket shape can take tens of seconds); everything else is instant.
+  // Each RPC borrows its own pooled connection, so concurrent dio
+  // threads overlap their sidecar round-trips.  A failure on a POOLED
+  // fd retries once on a fresh connection: after a sidecar restart the
+  // pool holds up to kMaxIdleFds dead sockets, and without the retry
+  // each of those would fail one upload into the flat-store path.
   const int timeout_ms = 60000;
-  uint8_t hdr[kHeaderSize];
-  PutInt64BE(static_cast<int64_t>(body.size()), hdr);
-  hdr[8] = cmd;
-  hdr[9] = 0;
-  if (!SendAll(fd_, hdr, sizeof(hdr), timeout_ms) ||
-      !SendAll(fd_, body.data(), body.size(), timeout_ms) ||
-      !RecvAll(fd_, hdr, sizeof(hdr), timeout_ms)) {
-    close(fd_);
-    fd_ = -1;
-    return false;
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    bool pooled = false;
+    int fd = AcquireFd(&pooled);
+    if (fd < 0) return false;
+    uint8_t hdr[kHeaderSize];
+    PutInt64BE(static_cast<int64_t>(body.size()), hdr);
+    hdr[8] = cmd;
+    hdr[9] = 0;
+    // Generous timeout for fingerprint segments (first TPU compile of a
+    // new bucket shape can take tens of seconds); the rest is instant.
+    if (!SendAll(fd, hdr, sizeof(hdr), timeout_ms) ||
+        !SendAll(fd, body.data(), body.size(), timeout_ms) ||
+        !RecvAll(fd, hdr, sizeof(hdr), timeout_ms)) {
+      close(fd);
+      if (pooled) continue;  // stale pooled socket: retry fresh
+      return false;
+    }
+    int64_t len = GetInt64BE(hdr);
+    *status = hdr[9];
+    if (len < 0 || len > max_resp) {
+      FDFS_LOG_WARN("dedup(sidecar): bogus response length %lld",
+                    static_cast<long long>(len));
+      close(fd);
+      return false;
+    }
+    resp->resize(static_cast<size_t>(len));
+    if (len > 0 && !RecvAll(fd, resp->data(), resp->size(), timeout_ms)) {
+      close(fd);
+      return false;
+    }
+    ReleaseFd(fd);
+    return true;
   }
-  int64_t len = GetInt64BE(hdr);
-  *status = hdr[9];
-  if (len < 0 || len > max_resp) {
-    FDFS_LOG_WARN("dedup(sidecar): bogus response length %lld",
-                  static_cast<long long>(len));
-    close(fd_);
-    fd_ = -1;
-    return false;
-  }
-  resp->resize(static_cast<size_t>(len));
-  if (len > 0 && !RecvAll(fd_, resp->data(), resp->size(), timeout_ms)) {
-    close(fd_);
-    fd_ = -1;
-    return false;
-  }
-  return true;
+  return false;
 }
 
 DedupPlugin::Verdict SidecarDedup::Judge(const std::string& sha1_hex, int64_t) {
@@ -200,24 +241,38 @@ int64_t SidecarDedup::BeginChunked() {
          (counter.fetch_add(1, std::memory_order_relaxed) + 1);
 }
 
-// Fingerprint RPC (cmd 120): request body is the raw segment prefixed by
-// 8B BE session id + 8B BE base_offset; response is 8B BE chunk_count
-// then per chunk 8B offset + 8B length + 20B raw digest.
+// Fingerprint RPC (cmd 125): the daemon runs the native AVX2 CDC itself
+// (identical gear table => identical cut points) and ships the cut
+// offsets with the bytes — chunking is branchy scalar work the CPU does
+// at GB/s, while the accelerator round-trip carries only the FLOP-heavy
+// hash batches.  Request body: 8B BE session id + 8B BE base_offset +
+// 8B BE n_cuts + n_cuts x 8B relative exclusive ends + raw segment.
+// Response: 8B BE chunk_count then per chunk 8B offset + 8B length +
+// 20B raw digest.
 bool SidecarDedup::FingerprintChunks(int64_t session, const char* data,
                                      size_t len, int64_t base_offset,
                                      std::vector<ChunkFp>* out) {
+  std::vector<int64_t> cuts = GearChunkStream(
+      reinterpret_cast<const uint8_t*>(data), len, kCdcDefaultMinSize,
+      kCdcDefaultAvgBits, kCdcDefaultMaxSize);
   std::string body;
-  body.reserve(16 + len);
+  body.reserve(24 + cuts.size() * 8 + len);
   uint8_t num[8];
   PutInt64BE(session, num);
   body.append(reinterpret_cast<char*>(num), 8);
   PutInt64BE(base_offset, num);
   body.append(reinterpret_cast<char*>(num), 8);
+  PutInt64BE(static_cast<int64_t>(cuts.size()), num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  for (int64_t cut : cuts) {
+    PutInt64BE(cut, num);
+    body.append(reinterpret_cast<char*>(num), 8);
+  }
   body.append(data, len);
   std::string resp;
   uint8_t status = 0;
-  if (!Rpc(static_cast<uint8_t>(StorageCmd::kDedupFingerprint), body, &resp,
-           &status, /*max_resp=*/256 << 20) ||
+  if (!Rpc(static_cast<uint8_t>(StorageCmd::kDedupFingerprintCuts), body,
+           &resp, &status, /*max_resp=*/256 << 20) ||
       status != 0 || resp.size() < 8) {
     FDFS_LOG_WARN("dedup(sidecar): fingerprint unavailable, storing flat");
     return false;

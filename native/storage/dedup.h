@@ -138,16 +138,33 @@ class SidecarDedup : public DedupPlugin {
                 bool* no_data) override;
 
  private:
-  bool EnsureConnected();
+  // Connection pool: each in-flight RPC borrows its own fd, so
+  // concurrent dio threads overlap their sidecar round-trips instead of
+  // serializing on one shared connection (the sidecar itself only
+  // serializes index mutation, not fingerprint compute).  Up to
+  // kMaxIdleFds idle connections are retained.
+  static constexpr int kMaxIdleFds = 4;
+  // *pooled reports whether the fd came from the idle pool (a failure
+  // on it retries once on a fresh connection — pooled sockets go stale
+  // when the sidecar restarts).  -1 on connect failure.
+  int AcquireFd(bool* pooled);
+  void ReleaseFd(int fd);   // return a healthy fd to the pool
   bool Rpc(uint8_t cmd, const std::string& body, std::string* resp,
            uint8_t* status, int64_t max_resp = 1 << 20);
   std::string socket_path_;
-  std::mutex mu_;  // one RPC at a time per instance (shared fd)
-  int fd_ = -1;
+  std::mutex mu_;  // guards pool_
+  std::vector<int> pool_;
 };
 
 std::unique_ptr<DedupPlugin> MakeDedupPlugin(const std::string& mode,
                                              const std::string& base_path,
                                              const std::string& sidecar_path);
+
+// Thread-local sidecar lock-wait accounting: SidecarDedup adds the time
+// THIS thread spent queued on the connection-pool mutex (connection
+// setup is excluded — it is transport cost, not serialization).  The
+// upload path reads-and-clears it around its fingerprint calls to
+// attribute the wait per request in the access log.
+int64_t TakeDedupLockWaitUs();
 
 }  // namespace fdfs

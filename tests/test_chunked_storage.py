@@ -440,11 +440,19 @@ def test_recovery_rebuilds_chunked(tmp_path_factory):
 
 
 def test_sidecar_survives_stale_near_snapshot(tmp_path):
-    # A v1 (spec-less) near-dup snapshot must not brick the sidecar;
-    # exact state is retained, the near index restarts fresh.
+    # A spec-less (old-format) near-dup snapshot must not brick the
+    # sidecar; exact state is retained, the near index restarts fresh.
+    # (The files.json carries a CURRENT chunker-spec record here — a
+    # stale or missing spec discards everything instead, covered by
+    # test_stale_chunker_spec_state_is_discarded.)
+    import json
+
+    from fastdfs_tpu.ops.gear_cdc import CDC_SPEC_VERSION
     from fastdfs_tpu.sidecar import DedupSidecar
 
     state = str(tmp_path)
+    with open(os.path.join(state, "sidecar_files.json"), "w") as fh:
+        json.dump({"cdc_spec": CDC_SPEC_VERSION, "files": {}}, fh)
     np.savez_compressed(
         os.path.join(state, "sidecar_near.npz"),
         sigs=np.zeros((1, 64), np.uint32),
@@ -505,3 +513,37 @@ def test_appender_files_stay_flat_on_replica(tmp_path_factory):
         s2.stop()
         s1.stop()
         tracker.stop()
+
+
+def test_sidecar_restart_stale_pool_retries_and_still_chunks(tmp_path):
+    """After a sidecar restart the daemon's pooled connections are dead
+    sockets; the RPC layer must retry each on a fresh connection so the
+    next uploads still deduplicate instead of silently storing flat."""
+    sidecar, sock = _start_sidecar(tmp_path,
+                                   state_dir=os.path.join(str(tmp_path),
+                                                          "state"))
+    tr, st, cli = _cluster(tmp_path, "sidecar", sock)
+    try:
+        rng = np.random.RandomState(9)
+        data = rng.randint(0, 256, 2 << 20, dtype=np.uint8).tobytes()
+        upload_retry(cli, data, ext="bin")
+
+        sidecar.terminate()
+        sidecar.wait()
+        time.sleep(0.5)
+        sidecar, _ = _start_sidecar(tmp_path,
+                                    state_dir=os.path.join(str(tmp_path),
+                                                           "state"))
+
+        # identical content: if the retry path works, this upload chunks
+        # and every byte lands as a dedup hit
+        cli.upload_buffer(data, ext="bin")
+        assert _wait(lambda: any(
+            int(r.get("dedup_bytes_saved", 0)) >= len(data)
+            for r in cli._tracker().list_storages("group1")), timeout=20), \
+            "upload after sidecar restart stored flat (stale-fd retry broken)"
+    finally:
+        cli.close()
+        st.stop()
+        tr.stop()
+        sidecar.kill()

@@ -99,3 +99,15 @@ def test_custom_geometry():
     data = _random_bytes(rng, 50_000)
     kw = dict(min_size=64, avg_bits=8, max_size=1024)
     assert G.chunk_stream(data, **kw) == G.chunk_stream_ref(data, **kw)
+
+
+def test_sparse_candidate_overflow_falls_back_exactly():
+    # When the device-side candidate buffer is too small (forced here via
+    # _k_override), chunk_stream must recover through the dense-mask path
+    # and still produce the exact serial cut points.
+    from fastdfs_tpu.ops.gear_cdc import chunk_stream, chunk_stream_ref
+    rng = np.random.RandomState(11)
+    data = rng.randint(0, 256, 256 << 10, dtype=np.uint8).tobytes()
+    want = chunk_stream_ref(data)
+    assert chunk_stream(data, _k_override=2) == want   # forced overflow
+    assert chunk_stream(data) == want                  # normal sparse path

@@ -202,9 +202,74 @@ def test_busy_sidecar_still_snapshots_and_crash_loss_is_bounded(tmp_path):
         assert os.path.exists(files_snap), \
             "busy sidecar never snapshotted (housekeeping starved)"
         with open(files_snap) as fh:
-            files = json.load(fh)
+            files = json.load(fh)["files"]
         assert "ab" * 20 in files, \
             "commit older than 2x snapshot interval lost on SIGKILL"
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_fingerprint_with_precomputed_cuts_matches_plain(tmp_path):
+    # DEDUP_FINGERPRINT_CUTS (the daemon's native-CDC path) must produce
+    # the same spans and digests as the engine's own chunking, and
+    # reject inconsistent cut lists.
+    import numpy as np
+    from fastdfs_tpu.ops.gear_cdc import chunk_stream_ref
+    sc = _mk_sidecar_obj(tmp_path)
+    rng = np.random.RandomState(5)
+    data = rng.randint(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+
+    status, plain = sc._fingerprint(struct.pack(">qq", 1, 0) + data)
+    assert status == 0
+
+    cuts = chunk_stream_ref(data)
+    body = struct.pack(">qqq", 2, 0, len(cuts))
+    body += b"".join(struct.pack(">q", c) for c in cuts) + data
+    status, with_cuts = sc._fingerprint(body, with_cuts=True)
+    assert status == 0
+    assert with_cuts == plain
+
+    # malformed: final cut does not cover the data
+    bad = struct.pack(">qqq", 3, 0, 1) + struct.pack(">q", 17) + data
+    status, _ = sc._fingerprint(bad, with_cuts=True)
+    assert status == 22
+    # malformed: non-increasing cuts
+    bad = struct.pack(">qqq", 4, 0, 2) + struct.pack(">qq", 100, 100) + data
+    status, _ = sc._fingerprint(bad, with_cuts=True)
+    assert status == 22
+
+
+def test_stale_chunker_spec_state_is_discarded(tmp_path):
+    # Dedup state built under an older chunker spec chunks the same
+    # bytes at different offsets — a fresh sidecar must discard it (cold
+    # restart) instead of serving an index that can never hit again.
+    import numpy as np
+    sc = _mk_sidecar_obj(tmp_path, state=True)
+    rng = np.random.RandomState(6)
+    _ingest_file(sc, 1, "group1/M00/00/00/v.bin",
+                 rng.randint(0, 256, 1 << 20, dtype=np.uint8).tobytes())
+    sc._commit(b"commitfile " + b"cd" * 20 + b" group1/M00/00/00/w.bin")
+    sc.save_state()
+    n = len(sc.engine.exact)
+    assert n > 0
+
+    # same spec: state loads
+    sc2 = _mk_sidecar_obj(tmp_path, state=True)
+    assert len(sc2.engine.exact) == n
+    assert sc2.files
+
+    # rewrite the snapshot as if from an older spec
+    files_p = os.path.join(str(tmp_path), "sidecar_files.json")
+    blob = json.load(open(files_p))
+    blob["cdc_spec"] = 1
+    json.dump(blob, open(files_p, "w"))
+    sc3 = _mk_sidecar_obj(tmp_path, state=True)
+    assert len(sc3.engine.exact) == 0
+    assert not sc3.files
+
+    # round-4 format (flat files dict, no spec record): also discarded
+    json.dump({"aa" * 20: "group1/M00/00/00/old.bin"}, open(files_p, "w"))
+    sc4 = _mk_sidecar_obj(tmp_path, state=True)
+    assert len(sc4.engine.exact) == 0
+    assert not sc4.files
