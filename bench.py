@@ -10,17 +10,28 @@ reference-style scalar path) on identical data.
 
 Methodology (breakdown in tools/PROFILE_r03.md): 512 MB batches with a
 depth-``PIPELINE`` dispatch pipeline.  On this machine the TPU sits
-behind the axon tunnel, which adds ~5-10 ms of per-dispatch overhead
-and ~65 ms of round-trip fence latency; pipelining dispatches and
-fencing once amortizes both, exactly as the storage daemon's streaming
-ingest does (batches from concurrent uploads queue on the device).  The
-final ``device_get`` of every batch's digests+signatures is the fence —
-digests must return to the host to drive the dedup index, so it is also
-the realistic cost boundary.
+behind the axon tunnel, which adds per-dispatch overhead and round-trip
+fence latency; pipelining dispatches and fencing once amortizes both,
+exactly as the storage daemon's streaming ingest does (batches from
+concurrent uploads queue on the device).  The final ``device_get`` of
+every batch's digests+signatures is the fence — digests must return to
+the host to drive the dedup index, so it is also the realistic cost
+boundary.
+
+Dispersion discipline (round-4 lesson: single captures on this shared
+tunnel have ranged 3.35-8.34 GB/s): the bench runs at least MIN_ROUNDS
+rounds and keeps going until it has measured MIN_SECONDS of steady
+state (up to MAX_ROUNDS), reports the FULL distribution (min / median /
+max / relative IQR), and applies a documented contention rule —
+``contended = (max-min)/median > 0.30`` — so a capture that straddled a
+tunnel-contention episode says so in the artifact instead of
+masquerading as a clean number.  The headline value is the median
+round; under contention the median of the upper half is also reported
+(``value_uncontended``) as the steady-state estimate.
 
 Prints ONE JSON line:
   {"metric": "dedup_ingest_GBps_per_chip", "value": N, "unit": "GB/s",
-   "vs_baseline": N}
+   "vs_baseline": N, "dispersion": {...}, "contended": bool, ...}
 where vs_baseline is the speedup over the CPU hashlib baseline.
 """
 
@@ -33,9 +44,13 @@ import numpy as np
 CHUNK_KB = 64
 N_CHUNKS = 8192      # 512 MB per dispatch
 PIPELINE = 8
+MIN_ROUNDS = 7
+MAX_ROUNDS = 15
+MIN_SECONDS = 8.0    # minimum total measured wall-clock
+CONTENTION_SPREAD = 0.30  # (max-min)/median above this => contended
 
 
-def _bench_tpu() -> float:
+def _bench_tpu() -> dict:
     import jax
 
     from fastdfs_tpu.ops.pallas_minhash import minhash_batch_pallas
@@ -58,13 +73,42 @@ def _bench_tpu() -> float:
     jax.device_get(step(dev_chunks, dev_lens))
 
     rates = []
-    for _ in range(5):
+    t_total = 0.0
+    while len(rates) < MAX_ROUNDS and (len(rates) < MIN_ROUNDS or
+                                       t_total < MIN_SECONDS):
         t0 = time.perf_counter()
         outs = [step(dev_chunks, dev_lens) for _ in range(PIPELINE)]
         jax.device_get(outs)  # the only trustworthy fence on this backend
-        dt = (time.perf_counter() - t0) / PIPELINE
-        rates.append(N_CHUNKS * L / dt / 1e9)
-    return sorted(rates)[len(rates) // 2]  # median steady-state round
+        dt = time.perf_counter() - t0
+        t_total += dt
+        rates.append(N_CHUNKS * L * PIPELINE / dt / 1e9)
+
+    srt = sorted(rates)
+    n = len(srt)
+    median = srt[n // 2]
+    q1, q3 = srt[n // 4], srt[(3 * n) // 4]
+    spread = (srt[-1] - srt[0]) / median if median else 0.0
+    contended = spread > CONTENTION_SPREAD
+    out = {
+        "value": round(median, 4),
+        "rounds": n,
+        "measured_seconds": round(t_total, 2),
+        "dispersion": {
+            "min": round(srt[0], 4),
+            "median": round(median, 4),
+            "max": round(srt[-1], 4),
+            "iqr_rel": round((q3 - q1) / median, 4) if median else 0.0,
+            "spread_rel": round(spread, 4),
+        },
+        "contended": contended,
+        "contention_rule": f"(max-min)/median > {CONTENTION_SPREAD}",
+    }
+    if contended:
+        # Steady-state estimate when the capture straddled a contention
+        # episode: the slow rounds are tunnel stalls, not kernel time.
+        upper = srt[n // 2:]
+        out["value_uncontended"] = round(upper[len(upper) // 2], 4)
+    return out
 
 
 def _bench_cpu(n_chunks: int = 256) -> float:
@@ -80,13 +124,14 @@ def _bench_cpu(n_chunks: int = 256) -> float:
 
 
 def main() -> None:
-    tpu_gbps = _bench_tpu()
+    tpu = _bench_tpu()
     cpu_gbps = _bench_cpu()
     print(json.dumps({
         "metric": "dedup_ingest_GBps_per_chip",
-        "value": round(tpu_gbps, 4),
         "unit": "GB/s",
-        "vs_baseline": round(tpu_gbps / cpu_gbps, 4),
+        "vs_baseline": round(tpu["value"] / cpu_gbps, 4),
+        "cpu_baseline_GBps": round(cpu_gbps, 4),
+        **tpu,
     }))
 
 

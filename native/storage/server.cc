@@ -471,6 +471,10 @@ void StorageServer::ResetForNextRequest(Conn* c) {
   c->rstream.reset();
   c->recv_done_us = 0;
   c->work_start_us = 0;
+  c->fp_us = 0;
+  c->fp_lock_us = 0;
+  c->cswrite_us = 0;
+  c->binlog_us = 0;
   // Bounded buffer budget (the other half of fast_task_queue's pooled
   // buffers): a request with an unusually large in-memory body or
   // response must not pin that capacity for the connection's lifetime —
@@ -555,22 +559,35 @@ void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
   std::lock_guard<std::mutex> lk(log_mu_);
   int64_t now_us = MonoUs();
   // "<epoch.sec> <client_ip> <cmd> <status> <bytes> <cost_us>
-  //  <recv_us> <work_us>" — per-stage split (SURVEY.md §5): recv = body
-  // receive window, work = dio-stage time (fingerprint + chunk/disk
-  // writes), both 0 when the stage did not occur.
+  //  <recv_us> <work_us> <fp_us> <fp_lock_us> <cswrite_us> <binlog_us>"
+  // — per-stage split (SURVEY.md §5): recv = body receive window, work =
+  // dio-stage time, then the chunked-upload splits inside the work
+  // window (fingerprint wall, its sidecar-lock-wait share, chunk-store
+  // writes, binlog append).  Columns are 0 when a stage did not occur;
+  // tools/access_log_stages.py aggregates them into the bench stage
+  // table.
   int64_t recv_us =
       c->recv_done_us > 0 ? c->recv_done_us - c->req_start_us : 0;
   int64_t work_us =
       c->work_start_us > 0 ? now_us - c->work_start_us : 0;
-  fprintf(access_log_, "%lld %s %d %d %lld %lld %lld %lld\n",
+  fprintf(access_log_,
+          "%lld %s %d %d %lld %lld %lld %lld %lld %lld %lld %lld\n",
           static_cast<long long>(time(nullptr)), c->peer_ip.c_str(), c->cmd,
           status, static_cast<long long>(bytes),
           static_cast<long long>(now_us - c->req_start_us),
           static_cast<long long>(recv_us),
-          static_cast<long long>(work_us));
+          static_cast<long long>(work_us),
+          static_cast<long long>(c->fp_us),
+          static_cast<long long>(c->fp_lock_us),
+          static_cast<long long>(c->cswrite_us),
+          static_cast<long long>(c->binlog_us));
   c->req_start_us = 0;  // one line per request
   c->recv_done_us = 0;
   c->work_start_us = 0;
+  c->fp_us = 0;
+  c->fp_lock_us = 0;
+  c->cswrite_us = 0;
+  c->binlog_us = 0;
 }
 
 void StorageServer::RespondFile(Conn* c, uint8_t status, int file_fd,
@@ -1573,15 +1590,21 @@ void StorageServer::FinishUpload(Conn* c) {
                               .value();
       StoreManager::EnsureParentDirs(local);
       int64_t saved = 0, hits = 0;
+      ChunkStageUs st;
       if (StoreChunkedFromTmp(c->tmp_path, c->store_path_index, c->file_size,
                               local + ".rcp",
                               cfg_.group_name + "/" + parts->RemoteFilename(),
-                              &saved, &hits)) {
+                              &saved, &hits, &st)) {
         unlink(c->tmp_path.c_str());
         c->tmp_path.clear();
         stats_.dedup_hits += hits;
         stats_.dedup_bytes_saved += saved;
+        int64_t t_bl = MonoUs();
         binlog_.Append(kBinlogOpCreate, parts->RemoteFilename());
+        c->binlog_us = MonoUs() - t_bl;
+        c->fp_us = st.fp;
+        c->fp_lock_us = st.fp_lock;
+        c->cswrite_us = st.cs_write;
         stats_.success_upload++;
         stats_.last_source_update = time(nullptr);
         Respond(c, 0,
@@ -1663,7 +1686,9 @@ void StorageServer::FinishUpload(Conn* c) {
   }
   c->tmp_path.clear();
   if (dedup_ != nullptr && !appender) dedup_->Commit(digest, id);
+  int64_t t_bl = MonoUs();
   binlog_.Append(kBinlogOpCreate, parts->RemoteFilename());
+  c->binlog_us = MonoUs() - t_bl;
   stats_.success_upload++;
   stats_.last_source_update = time(nullptr);
   Respond(c, 0, PackGroupField(cfg_.group_name) + parts->RemoteFilename());
@@ -1701,9 +1726,10 @@ bool StorageServer::StoreChunkedFromTmp(const std::string& tmp_path, int spi,
                                         const std::string& rcp_path,
                                         const std::string& file_ref,
                                         int64_t* saved_bytes,
-                                        int64_t* chunk_hits) {
+                                        int64_t* chunk_hits,
+                                        ChunkStageUs* stage) {
   return ChunkedStoreWith(dedup_.get(), tmp_path, spi, size, rcp_path,
-                          file_ref, saved_bytes, chunk_hits);
+                          file_ref, saved_bytes, chunk_hits, stage);
 }
 
 bool StorageServer::ChunkedStoreWith(DedupPlugin* plugin,
@@ -1711,7 +1737,8 @@ bool StorageServer::ChunkedStoreWith(DedupPlugin* plugin,
                                      int64_t size, const std::string& rcp_path,
                                      const std::string& file_ref,
                                      int64_t* saved_bytes,
-                                     int64_t* chunk_hits) {
+                                     int64_t* chunk_hits,
+                                     ChunkStageUs* stage) {
   if (spi >= static_cast<int>(chunk_stores_.size())) return false;
   ChunkStore* cs = chunk_stores_[spi].get();
   int fd = open(tmp_path.c_str(), O_RDONLY);
@@ -1743,11 +1770,19 @@ bool StorageServer::ChunkedStoreWith(DedupPlugin* plugin,
     // Fingerprint this segment (accelerated in sidecar mode: CDC +
     // batched SHA1 run on the TPU); then write only unseen chunks.
     std::vector<ChunkFp> fps;
-    if (!plugin->FingerprintChunks(session, seg.data(), seg.size(), seg_base,
-                                   &fps)) {
+    int64_t t0 = MonoUs();
+    TakeDedupLockWaitUs();  // clear: attribute only this call's wait
+    bool fp_ok = plugin->FingerprintChunks(session, seg.data(), seg.size(),
+                                           seg_base, &fps);
+    if (stage != nullptr) {
+      stage->fp += MonoUs() - t0;
+      stage->fp_lock += TakeDedupLockWaitUs();
+    }
+    if (!fp_ok) {
       ok = false;  // fingerprinting unavailable: caller stores flat
       break;
     }
+    t0 = MonoUs();
     for (const ChunkFp& fp : fps) {
       bool existed = false;
       std::string err;
@@ -1764,6 +1799,7 @@ bool StorageServer::ChunkedStoreWith(DedupPlugin* plugin,
       }
       recipe.chunks.push_back({fp.digest_hex, fp.length});
     }
+    if (stage != nullptr) stage->cs_write += MonoUs() - t0;
     seg_base += want;
   }
   close(fd);

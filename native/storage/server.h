@@ -159,6 +159,13 @@ class StorageServer {
     int64_t req_start_us = 0;
     int64_t recv_done_us = 0;   // body fully received (recv stage end)
     int64_t work_start_us = 0;  // dio-stage begin (fingerprint/write)
+    // chunked-upload stage splits within the work window (0 when the
+    // request did not take that stage)
+    int64_t fp_us = 0;          // fingerprint wall (sidecar RPC / serial)
+    int64_t fp_lock_us = 0;     // share of fp_us spent queued on the
+                                // sidecar RPC mutex (engine serialization)
+    int64_t cswrite_us = 0;     // chunk-store writes
+    int64_t binlog_us = 0;      // binlog append
     std::string peer_ip;
   };
 
@@ -261,16 +268,26 @@ class StorageServer {
   // store-path's chunk store, and write the recipe at `rcp_path`.
   // *saved_bytes accumulates duplicate-chunk bytes.  False => caller
   // stores the file flat (fingerprinting unavailable or IO error).
+  // Per-upload stage attribution (access-log columns; the bench stage
+  // table): fingerprint wall time (sidecar RPC incl. lock wait in
+  // sidecar mode, serial CDC+SHA1 in cpu mode), the lock-wait share of
+  // it, and chunk-store write time.
+  struct ChunkStageUs {
+    int64_t fp = 0;
+    int64_t fp_lock = 0;
+    int64_t cs_write = 0;
+  };
   bool StoreChunkedFromTmp(const std::string& tmp_path, int spi,
                            int64_t size, const std::string& rcp_path,
                            const std::string& file_ref,
-                           int64_t* saved_bytes, int64_t* chunk_hits);
+                           int64_t* saved_bytes, int64_t* chunk_hits,
+                           ChunkStageUs* stage = nullptr);
   // Same, against an explicit plugin (the recovery thread uses its own
   // instance — the plugins are not thread-safe, the ChunkStore is).
   bool ChunkedStoreWith(DedupPlugin* plugin, const std::string& tmp_path,
                         int spi, int64_t size, const std::string& rcp_path,
                         const std::string& file_ref, int64_t* saved_bytes,
-                        int64_t* chunk_hits);
+                        int64_t* chunk_hits, ChunkStageUs* stage = nullptr);
   // Open the logical content at `local`: a plain fd, or a recipe
   // materialized into an unlinked temp file.  -1 when missing.
   int OpenLogical(const std::string& local, int64_t* size);

@@ -1,0 +1,465 @@
+// Native load-generation harness (reference: the `test/` directory —
+// test_upload.c / test_download.c / test_delete.c drive a live cluster
+// from N processes and write per-op `.result` records; combine_result.c
+// merges them into QPS + latency).  The rebuild's equivalent is one
+// binary with subcommands; concurrency is threads (each with its own
+// connections) and multiple processes compose the same way — `combine`
+// merges any number of result files.
+//
+// Result record format (one line per op):
+//   <start_us> <latency_us> <status> <bytes> <file_id>
+//
+// Usage:
+//   fdfs_load upload   <tracker ip:port> <n_ops> <size> <threads> <result>
+//                      [unique_payloads]   (0/absent = every op unique)
+//   fdfs_load download <tracker ip:port> <ids_file> <n_ops> <threads> <result>
+//   fdfs_load delete   <tracker ip:port> <ids_file> <threads> <result>
+//   fdfs_load combine  <result files...>     (prints one JSON line)
+//
+// `upload` also appends the minted file ids to <result>.ids for the
+// download/delete phases.
+#include <stdio.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <fstream>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common/bytes.h"
+#include "common/net.h"
+#include "common/protocol_gen.h"
+
+using namespace fdfs;
+
+namespace {
+
+constexpr int kTimeoutMs = 60000;
+
+int64_t MonoUs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+struct OpRecord {
+  int64_t start_us;
+  int64_t latency_us;
+  int status;  // 0 ok, errno-style otherwise; -1 = transport failure
+  int64_t bytes;
+  std::string file_id;
+};
+
+// One request/response on a blocking fd.  Returns false on transport
+// failure; *status carries the server's header status byte.
+bool Rpc(int fd, uint8_t cmd, const std::string& body, std::string* resp,
+         uint8_t* status) {
+  uint8_t hdr[kHeaderSize];
+  PutInt64BE(static_cast<int64_t>(body.size()), hdr);
+  hdr[8] = cmd;
+  hdr[9] = 0;
+  if (!SendAll(fd, hdr, sizeof(hdr), kTimeoutMs)) return false;
+  if (!body.empty() && !SendAll(fd, body.data(), body.size(), kTimeoutMs))
+    return false;
+  if (!RecvAll(fd, hdr, sizeof(hdr), kTimeoutMs)) return false;
+  int64_t len = GetInt64BE(hdr);
+  *status = hdr[9];
+  if (len < 0 || len > (1LL << 31)) return false;
+  resp->resize(static_cast<size_t>(len));
+  if (len > 0 && !RecvAll(fd, resp->data(), resp->size(), kTimeoutMs))
+    return false;
+  return true;
+}
+
+std::string PackGroup(const std::string& group) {
+  std::string out(16, '\0');
+  memcpy(out.data(), group.data(), std::min<size_t>(group.size(), 16));
+  return out;
+}
+
+bool SplitAddr(const std::string& addr, std::string* host, int* port) {
+  size_t c = addr.rfind(':');
+  if (c == std::string::npos) return false;
+  *host = addr.substr(0, c);
+  *port = atoi(addr.c_str() + c + 1);
+  return *port > 0;
+}
+
+bool SplitId(const std::string& file_id, std::string* group,
+             std::string* remote) {
+  size_t s = file_id.find('/');
+  if (s == std::string::npos) return false;
+  *group = file_id.substr(0, s);
+  *remote = file_id.substr(s + 1);
+  return true;
+}
+
+// A pooled connection to one peer; reconnects lazily after failures (the
+// reference load clients keep one connection per process the same way).
+class Peer {
+ public:
+  Peer(std::string host, int port) : host_(std::move(host)), port_(port) {}
+  ~Peer() { Close(); }
+  bool Call(uint8_t cmd, const std::string& body, std::string* resp,
+            uint8_t* status) {
+    for (int attempt = 0; attempt < 2; ++attempt) {
+      if (fd_ < 0) {
+        std::string err;
+        fd_ = TcpConnect(host_, port_, kTimeoutMs, &err);
+        if (fd_ < 0) return false;
+      }
+      if (Rpc(fd_, cmd, body, resp, status)) return true;
+      Close();  // stale/broken connection: one reconnect attempt
+    }
+    return false;
+  }
+  void Close() {
+    if (fd_ >= 0) {
+      close(fd_);
+      fd_ = -1;
+    }
+  }
+
+ private:
+  std::string host_;
+  int port_;
+  int fd_ = -1;
+};
+
+// tracker query_store (cmd 101): resp = 16B group + 16B ip + 8B port +
+// 1B store-path index.
+bool QueryStore(Peer* tracker, std::string* group, std::string* ip,
+                int* port, uint8_t* spi) {
+  std::string resp;
+  uint8_t status = 0;
+  if (!tracker->Call(
+          static_cast<uint8_t>(TrackerCmd::kServiceQueryStoreWithoutGroupOne),
+          "", &resp, &status) ||
+      status != 0 || resp.size() < 41)
+    return false;
+  *group = std::string(resp.c_str(), strnlen(resp.c_str(), 16));
+  *ip = std::string(resp.data() + 16, strnlen(resp.data() + 16, 16));
+  *port = static_cast<int>(
+      GetInt64BE(reinterpret_cast<const uint8_t*>(resp.data()) + 32));
+  *spi = static_cast<uint8_t>(resp[40]);
+  return true;
+}
+
+// tracker query_fetch/update (cmd 102/103): resp = 16B ip + 8B port.
+bool QueryFetch(Peer* tracker, uint8_t cmd, const std::string& file_id,
+                std::string* ip, int* port) {
+  std::string group, remote;
+  if (!SplitId(file_id, &group, &remote)) return false;
+  std::string resp;
+  uint8_t status = 0;
+  if (!tracker->Call(cmd, PackGroup(group) + remote, &resp, &status) ||
+      status != 0 || resp.size() < 24)
+    return false;
+  *ip = std::string(resp.data(), strnlen(resp.data(), 16));
+  *port = static_cast<int>(
+      GetInt64BE(reinterpret_cast<const uint8_t*>(resp.data()) + 16));
+  return true;
+}
+
+struct Shared {
+  std::string tracker_host;
+  int tracker_port = 0;
+  std::atomic<int64_t> next{0};
+  int64_t n_ops = 0;
+  int64_t size = 0;
+  int64_t unique = 0;  // 0 = every payload unique
+  std::vector<std::string> ids;  // download/delete input
+  std::mutex out_mu;
+  std::vector<OpRecord> records;
+};
+
+void Emit(Shared* sh, std::vector<OpRecord>* local) {
+  std::lock_guard<std::mutex> lk(sh->out_mu);
+  for (auto& r : *local) sh->records.push_back(std::move(r));
+  local->clear();
+}
+
+// Payload bytes for op i: xorshift stream seeded by the payload id, so
+// two ops with the same id upload IDENTICAL bytes (dedup-able) without
+// the driver storing any corpus in RAM.
+void FillPayload(int64_t payload_id, std::string* buf) {
+  uint64_t x = 0x9E3779B97F4A7C15ULL ^ (payload_id * 0xBF58476D1CE4E5B9ULL);
+  if (x == 0) x = 1;
+  for (size_t i = 0; i < buf->size(); i += 8) {
+    x ^= x << 13;
+    x ^= x >> 7;
+    x ^= x << 17;
+    size_t n = std::min<size_t>(8, buf->size() - i);
+    memcpy(buf->data() + i, &x, n);
+  }
+}
+
+void UploadWorker(Shared* sh) {
+  Peer tracker(sh->tracker_host, sh->tracker_port);
+  // One storage connection, re-resolved when the target changes (one
+  // group + round-robin tracker policies keep it stable in practice).
+  std::string cur_addr;
+  Peer* storage = nullptr;
+  std::string payload(static_cast<size_t>(sh->size), '\0');
+  std::vector<OpRecord> local;
+  for (;;) {
+    int64_t i = sh->next.fetch_add(1);
+    if (i >= sh->n_ops) break;
+    int64_t pid = sh->unique > 0 ? (i % sh->unique) : i;
+    FillPayload(pid, &payload);
+    OpRecord rec{MonoUs(), 0, -1, sh->size, ""};
+    std::string group, ip;
+    int port = 0;
+    uint8_t spi = 0;
+    if (QueryStore(&tracker, &group, &ip, &port, &spi)) {
+      std::string addr = ip + ":" + std::to_string(port);
+      if (storage == nullptr || addr != cur_addr) {
+        delete storage;
+        storage = new Peer(ip, port);
+        cur_addr = addr;
+      }
+      // upload wire: 1B spi, 8B size, 6B ext, body
+      std::string body;
+      body.reserve(15 + payload.size());
+      body.push_back(static_cast<char>(spi));
+      uint8_t num[8];
+      PutInt64BE(sh->size, num);
+      body.append(reinterpret_cast<char*>(num), 8);
+      body.append("bin\0\0\0", 6);
+      body += payload;
+      std::string resp;
+      uint8_t status = 0;
+      if (storage->Call(static_cast<uint8_t>(StorageCmd::kUploadFile), body,
+                        &resp, &status)) {
+        rec.status = status;
+        if (status == 0 && resp.size() > 16) {
+          std::string g(resp.c_str(), strnlen(resp.c_str(), 16));
+          rec.file_id = g + "/" + resp.substr(16);
+        }
+      }
+    }
+    rec.latency_us = MonoUs() - rec.start_us;
+    local.push_back(std::move(rec));
+    if (local.size() >= 1024) Emit(sh, &local);
+  }
+  Emit(sh, &local);
+  delete storage;
+}
+
+void DownloadWorker(Shared* sh) {
+  Peer tracker(sh->tracker_host, sh->tracker_port);
+  std::string cur_addr;
+  Peer* storage = nullptr;
+  std::vector<OpRecord> local;
+  for (;;) {
+    int64_t i = sh->next.fetch_add(1);
+    if (i >= sh->n_ops) break;
+    const std::string& fid = sh->ids[i % sh->ids.size()];
+    OpRecord rec{MonoUs(), 0, -1, 0, fid};
+    std::string ip;
+    int port = 0;
+    if (QueryFetch(&tracker,
+                   static_cast<uint8_t>(TrackerCmd::kServiceQueryFetchOne),
+                   fid, &ip, &port)) {
+      std::string addr = ip + ":" + std::to_string(port);
+      if (storage == nullptr || addr != cur_addr) {
+        delete storage;
+        storage = new Peer(ip, port);
+        cur_addr = addr;
+      }
+      std::string group, remote;
+      SplitId(fid, &group, &remote);
+      uint8_t num[16] = {0};  // offset 0, length 0 (= to EOF)
+      std::string body(reinterpret_cast<char*>(num), 16);
+      body += PackGroup(group) + remote;
+      std::string resp;
+      uint8_t status = 0;
+      if (storage->Call(static_cast<uint8_t>(StorageCmd::kDownloadFile),
+                        body, &resp, &status)) {
+        rec.status = status;
+        rec.bytes = static_cast<int64_t>(resp.size());
+      }
+    }
+    rec.latency_us = MonoUs() - rec.start_us;
+    local.push_back(std::move(rec));
+    if (local.size() >= 1024) Emit(sh, &local);
+  }
+  Emit(sh, &local);
+  delete storage;
+}
+
+void DeleteWorker(Shared* sh) {
+  Peer tracker(sh->tracker_host, sh->tracker_port);
+  std::string cur_addr;
+  Peer* storage = nullptr;
+  std::vector<OpRecord> local;
+  for (;;) {
+    int64_t i = sh->next.fetch_add(1);
+    if (i >= static_cast<int64_t>(sh->ids.size())) break;
+    const std::string& fid = sh->ids[i];
+    OpRecord rec{MonoUs(), 0, -1, 0, fid};
+    std::string ip;
+    int port = 0;
+    if (QueryFetch(&tracker,
+                   static_cast<uint8_t>(TrackerCmd::kServiceQueryUpdate),
+                   fid, &ip, &port)) {
+      std::string addr = ip + ":" + std::to_string(port);
+      if (storage == nullptr || addr != cur_addr) {
+        delete storage;
+        storage = new Peer(ip, port);
+        cur_addr = addr;
+      }
+      std::string group, remote;
+      SplitId(fid, &group, &remote);
+      std::string resp;
+      uint8_t status = 0;
+      if (storage->Call(static_cast<uint8_t>(StorageCmd::kDeleteFile),
+                        PackGroup(group) + remote, &resp, &status))
+        rec.status = status;
+    }
+    rec.latency_us = MonoUs() - rec.start_us;
+    local.push_back(std::move(rec));
+    if (local.size() >= 1024) Emit(sh, &local);
+  }
+  Emit(sh, &local);
+  delete storage;
+}
+
+bool WriteResults(const Shared& sh, const std::string& path, bool with_ids) {
+  std::ofstream out(path);
+  if (!out) return false;
+  std::ofstream ids;
+  if (with_ids) ids.open(path + ".ids");
+  for (const auto& r : sh.records) {
+    out << r.start_us << ' ' << r.latency_us << ' ' << r.status << ' '
+        << r.bytes << ' ' << r.file_id << '\n';
+    if (with_ids && r.status == 0 && !r.file_id.empty())
+      ids << r.file_id << '\n';
+  }
+  return true;
+}
+
+bool LoadIds(const std::string& path, std::vector<std::string>* ids) {
+  std::ifstream in(path);
+  if (!in) return false;
+  std::string line;
+  while (std::getline(in, line))
+    if (!line.empty()) ids->push_back(line);
+  return !ids->empty();
+}
+
+int RunWorkers(Shared* sh, int threads, void (*fn)(Shared*)) {
+  std::vector<std::thread> ts;
+  for (int t = 0; t < threads; ++t) ts.emplace_back(fn, sh);
+  for (auto& t : ts) t.join();
+  return 0;
+}
+
+int64_t Pct(const std::vector<int64_t>& sorted, double q) {
+  if (sorted.empty()) return 0;
+  size_t i = std::min(static_cast<size_t>(q * sorted.size()),
+                      sorted.size() - 1);
+  return sorted[i];
+}
+
+// combine: merge result files -> one JSON line (combine_result.c
+// analogue).  QPS uses the union wall-clock window (min start .. max
+// end) so multi-process runs aggregate honestly.
+int Combine(int argc, char** argv) {
+  std::vector<int64_t> lat;
+  int64_t errors = 0, bytes = 0, t_min = INT64_MAX, t_max = 0;
+  for (int a = 0; a < argc; ++a) {
+    std::ifstream in(argv[a]);
+    if (!in) {
+      fprintf(stderr, "cannot open %s\n", argv[a]);
+      return 1;
+    }
+    int64_t start, latency, b;
+    int status;
+    std::string rest;
+    while (in >> start >> latency >> status >> b) {
+      std::getline(in, rest);
+      lat.push_back(latency);
+      if (status != 0) errors++;
+      bytes += b;
+      t_min = std::min(t_min, start);
+      t_max = std::max(t_max, start + latency);
+    }
+  }
+  if (lat.empty()) {
+    printf("{\"ops\": 0}\n");
+    return 0;
+  }
+  std::sort(lat.begin(), lat.end());
+  double wall_s = static_cast<double>(t_max - t_min) / 1e6;
+  int64_t sum = 0;
+  for (int64_t v : lat) sum += v;
+  printf(
+      "{\"ops\": %zu, \"errors\": %lld, \"wall_seconds\": %.3f, "
+      "\"qps\": %.1f, \"bytes\": %lld, \"GBps\": %.4f, "
+      "\"lat_mean_us\": %lld, \"lat_p50_us\": %lld, \"lat_p95_us\": %lld, "
+      "\"lat_p99_us\": %lld, \"lat_max_us\": %lld}\n",
+      lat.size(), static_cast<long long>(errors), wall_s,
+      lat.size() / std::max(wall_s, 1e-9),
+      static_cast<long long>(bytes),
+      bytes / std::max(wall_s, 1e-9) / 1e9,
+      static_cast<long long>(sum / static_cast<int64_t>(lat.size())),
+      static_cast<long long>(Pct(lat, 0.50)),
+      static_cast<long long>(Pct(lat, 0.95)),
+      static_cast<long long>(Pct(lat, 0.99)),
+      static_cast<long long>(lat.back()));
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: fdfs_load upload|download|delete|combine ...\n");
+    return 2;
+  }
+  std::string mode = argv[1];
+  if (mode == "combine") return Combine(argc - 2, argv + 2);
+
+  Shared sh;
+  if (mode == "upload" && argc >= 7) {
+    if (!SplitAddr(argv[2], &sh.tracker_host, &sh.tracker_port)) return 2;
+    sh.n_ops = atoll(argv[3]);
+    sh.size = atoll(argv[4]);
+    int threads = atoi(argv[5]);
+    sh.unique = argc > 7 ? atoll(argv[7]) : 0;
+    RunWorkers(&sh, threads, UploadWorker);
+    return WriteResults(sh, argv[6], /*with_ids=*/true) ? 0 : 1;
+  }
+  if (mode == "download" && argc >= 7) {
+    if (!SplitAddr(argv[2], &sh.tracker_host, &sh.tracker_port)) return 2;
+    if (!LoadIds(argv[3], &sh.ids)) {
+      fprintf(stderr, "no ids in %s\n", argv[3]);
+      return 1;
+    }
+    sh.n_ops = atoll(argv[4]);
+    int threads = atoi(argv[5]);
+    RunWorkers(&sh, threads, DownloadWorker);
+    return WriteResults(sh, argv[6], /*with_ids=*/false) ? 0 : 1;
+  }
+  if (mode == "delete" && argc >= 6) {
+    if (!SplitAddr(argv[2], &sh.tracker_host, &sh.tracker_port)) return 2;
+    if (!LoadIds(argv[3], &sh.ids)) {
+      fprintf(stderr, "no ids in %s\n", argv[3]);
+      return 1;
+    }
+    sh.n_ops = static_cast<int64_t>(sh.ids.size());
+    int threads = atoi(argv[4]);
+    RunWorkers(&sh, threads, DeleteWorker);
+    return WriteResults(sh, argv[5], /*with_ids=*/false) ? 0 : 1;
+  }
+  fprintf(stderr, "bad arguments for %s\n", mode.c_str());
+  return 2;
+}

@@ -18,10 +18,39 @@ def test_config1_smoke(tmp_path):
     assert art["cpu_sha1_GBps"] > 0
 
 
+def test_config2_sidecar_smoke(tmp_path, monkeypatch):
+    # The north-star path end-to-end at tiny scale: daemon in
+    # dedup_mode=sidecar with a live sidecar (cpu backend here — the TPU
+    # run is the checked-in artifact), stage attribution from the access
+    # log, and the engine-serialization pricing from sidecar stats.
+    monkeypatch.setenv("BENCH_SIDECAR_PLATFORM", "cpu")
+    bc.config2(str(tmp_path), scale=0.0005)  # ~5 MB of text docs
+    with open(os.path.join(str(tmp_path), "config2.json")) as fh:
+        art = json.load(fh)
+    assert art["daemon_ingest_GBps"] > 0
+    sc = art["sidecar_mode"]
+    assert "error" not in sc, sc
+    assert sc["daemon_ingest_GBps"] > 0
+    assert sc["sidecar_platform"] == "cpu"
+    stats = sc["sidecar_stats"]
+    assert stats["fingerprint_bytes"] > 0
+    assert 0.0 <= stats["lock_wait_fraction"] <= 1.0
+    # the stage table attributes the upload path: fingerprint and
+    # chunk-store stages must be visible for chunked uploads
+    st = sc["upload_stages"]
+    assert st["count"] >= 1
+    assert st["stages_s"]["fp_us"] > 0
+    assert st["stages_s"]["cswrite_us"] >= 0
+    assert abs(sum(st["stage_share"].values()) - 1.0) < 0.05
+
+
 def test_config4_referee_smoke(tmp_path):
     bc.config4(str(tmp_path), scale=0.00002)  # ~2 MB of HTML docs
     with open(os.path.join(str(tmp_path), "config4.json")) as fh:
         art = json.load(fh)
-    assert art["bitexact_signatures"] is True
-    assert art["recall_at_1_vs_cpu_baseline"] >= 0.98
+    assert art["kernel_bitexact_pallas_vs_xla"] is True
+    assert art["distractors"] > 0  # the index contains adversarial bait
+    assert art["recall_at_1_vs_truth"] >= 0.98
+    assert art["recall_at_5_vs_truth"] >= art["recall_at_1_vs_truth"]
+    assert art["referee_top1_agreement_acc_vs_textbook"] >= 0.98
     assert art["recall_pass"] is True

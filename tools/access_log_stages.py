@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Aggregate the storage daemon's per-stage access log into a stage table.
+
+The daemon (storage.conf:use_access_log) writes one line per request to
+``<base_path>/logs/access.log``:
+
+    <epoch> <ip> <cmd> <status> <bytes> <cost_us> <recv_us> <work_us>
+    <fp_us> <fp_lock_us> <cswrite_us> <binlog_us>
+
+(native/storage/server.cc:LogAccess; older 8-column logs parse too, with
+zero stage splits).  This tool answers the question the raw ingest rate
+can't: WHERE does an upload's time go — network receive, fingerprinting
+(and how much of that is queueing on the sidecar's serialized engine),
+chunk-store writes, or the binlog — the attribution SURVEY.md §3.1 marks
+on the reference's ``dio_write_file()`` hot loop.
+
+Usage:  python tools/access_log_stages.py <access.log> [--json]
+Import: ``aggregate(path) -> dict``  (bench_configs embeds the result in
+its artifacts).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+CMD_NAMES = {
+    11: "upload", 12: "delete", 14: "download", 16: "sync_create",
+    21: "upload_slave", 22: "query_info", 23: "upload_appender",
+    24: "append", 26: "fetch_binlog", 34: "modify", 36: "truncate",
+    124: "near_dups",
+}
+
+STAGES = ["recv_us", "work_us", "fp_us", "fp_lock_us", "cswrite_us",
+          "binlog_us"]
+
+
+def _pct(sorted_vals: list[int], q: float) -> int:
+    if not sorted_vals:
+        return 0
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def aggregate(path: str) -> dict:
+    """Per-command stage totals, means, and latency percentiles."""
+    per_cmd: dict[int, dict] = {}
+    with open(path) as fh:
+        for line in fh:
+            f = line.split()
+            if len(f) < 8:
+                continue
+            try:
+                cmd, status = int(f[2]), int(f[3])
+                nums = [int(x) for x in f[4:12]]
+            except ValueError:
+                continue
+            nums += [0] * (8 - len(nums))  # old 8-column format
+            bytes_, cost = nums[0], nums[1]
+            stages = nums[2:8]
+            d = per_cmd.setdefault(cmd, {
+                "count": 0, "errors": 0, "bytes": 0, "cost_us": [],
+                **{s: 0 for s in STAGES}})
+            d["count"] += 1
+            d["errors"] += status != 0
+            d["bytes"] += bytes_
+            d["cost_us"].append(cost)
+            for name, v in zip(STAGES, stages):
+                d[name] += v
+    out = {}
+    for cmd, d in sorted(per_cmd.items()):
+        costs = sorted(d.pop("cost_us"))
+        total_cost = sum(costs)
+        n = d["count"]
+        row = {
+            "count": n, "errors": d["errors"], "bytes": d["bytes"],
+            "total_cost_s": round(total_cost / 1e6, 3),
+            "mean_us": total_cost // max(n, 1),
+            "p50_us": _pct(costs, 0.50),
+            "p95_us": _pct(costs, 0.95),
+            "p99_us": _pct(costs, 0.99),
+            "stages_s": {s: round(d[s] / 1e6, 3) for s in STAGES},
+            # share of total request time per stage ("other" = dispatch,
+            # response send, file-id mint, rename, ...)
+            "stage_share": {},
+        }
+        if total_cost > 0:
+            # fp_lock is a subset of fp; work contains fp+cswrite+binlog.
+            # Report the orthogonal decomposition of cost_us.
+            recv = d["recv_us"]
+            fp = d["fp_us"]
+            lock = d["fp_lock_us"]
+            cs = d["cswrite_us"]
+            bl = d["binlog_us"]
+            other_work = max(d["work_us"] - fp - cs - bl, 0)
+            pre = max(total_cost - d["recv_us"] - d["work_us"], 0)
+            for name, v in [("recv", recv), ("fp_rpc", fp - lock),
+                            ("fp_lock_wait", lock), ("cs_write", cs),
+                            ("binlog", bl), ("work_other", other_work),
+                            ("dispatch_other", pre)]:
+                row["stage_share"][name] = round(v / total_cost, 4)
+        out[CMD_NAMES.get(cmd, f"cmd{cmd}")] = row
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log", help="path to access.log")
+    ap.add_argument("--json", action="store_true", help="raw JSON output")
+    args = ap.parse_args()
+    agg = aggregate(args.log)
+    if args.json:
+        json.dump(agg, sys.stdout, indent=2)
+        print()
+        return 0
+    for op, row in agg.items():
+        print(f"{op}: n={row['count']} err={row['errors']} "
+              f"bytes={row['bytes']} mean={row['mean_us']}us "
+              f"p50={row['p50_us']}us p95={row['p95_us']}us "
+              f"p99={row['p99_us']}us")
+        shares = " ".join(f"{k}={v:.1%}" for k, v in
+                          row["stage_share"].items() if v > 0.0005)
+        if shares:
+            print(f"  {shares}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
