@@ -147,6 +147,20 @@ class StorageCmd(enum.IntEnum):
     # has to move (CDC is branchy scalar work the CPU does at GB/s; the
     # hashing is the FLOP-heavy part that belongs on the TPU).
     DEDUP_FINGERPRINT_CUTS = 125
+
+    # Chunk-aware replication (fastdfs_tpu extension; the reference ships
+    # every logical byte for every replica, storage_sync.c).  A sender
+    # whose file is stored as a recipe first asks the peer which chunks
+    # it lacks, then ships the recipe plus ONLY the missing chunk bytes:
+    #   SYNC_QUERY_CHUNKS: 16B group + 8B name_len + name + N x 20B raw
+    #     digests -> response body N bytes (0 = present, 1 = needed);
+    #     ENOTSUP when the peer has no chunk store (sender falls back to
+    #     the full-copy SYNC_CREATE_FILE).
+    #   SYNC_CREATE_RECIPE: 16B group + 8B name_len + 8B logical_size +
+    #     8B chunk_count + 8B payload_len + name + per chunk (20B digest
+    #     + 8B length + 1B needed) + concatenated needed chunk payloads.
+    SYNC_QUERY_CHUNKS = 126
+    SYNC_CREATE_RECIPE = 127
     # Ranked near-dup report for a stored file, answered from the
     # sidecar's MinHash/LSH index.  Body = 16B group + remote filename;
     # response = text lines "<file_id> <score>".  ENOTSUP when the dedup

@@ -246,14 +246,16 @@ Sha1Digest Sha1(const void* data, size_t len) {
   return s.Final();
 }
 
-std::string Sha1Digest::Hex() const {
+std::string BytesToHex(const uint8_t* data, size_t len) {
   static const char* kHex = "0123456789abcdef";
-  std::string out(40, '0');
-  for (int i = 0; i < 20; ++i) {
-    out[i * 2] = kHex[bytes[i] >> 4];
-    out[i * 2 + 1] = kHex[bytes[i] & 0xF];
+  std::string out(len * 2, '0');
+  for (size_t i = 0; i < len; ++i) {
+    out[i * 2] = kHex[data[i] >> 4];
+    out[i * 2 + 1] = kHex[data[i] & 0xF];
   }
   return out;
 }
+
+std::string Sha1Digest::Hex() const { return BytesToHex(bytes, 20); }
 
 }  // namespace fdfs

@@ -34,6 +34,9 @@ bool Base64UrlDecode(std::string_view s, std::string* out);
 // -- CRC32 (IEEE, zlib-compatible; reference: hash.c crc32) ---------------
 uint32_t Crc32(const void* data, size_t len, uint32_t seed = 0);
 
+// Raw bytes -> lowercase hex (digest wire/display form).
+std::string BytesToHex(const uint8_t* data, size_t len);
+
 // -- SHA1 (dedup CPU baseline path) ---------------------------------------
 struct Sha1Digest {
   uint8_t bytes[20];

@@ -181,6 +181,28 @@ bool ChunkStore::RefAll(const Recipe& r) {
   return true;
 }
 
+bool ChunkStore::Has(const std::string& digest_hex) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return refs_.find(digest_hex) != refs_.end();
+}
+
+std::string ChunkStore::HaveMask(
+    const std::vector<std::string>& digests) const {
+  std::string need(digests.size(), '\0');
+  std::lock_guard<std::mutex> lk(mu_);
+  for (size_t i = 0; i < digests.size(); ++i)
+    need[i] = refs_.find(digests[i]) != refs_.end() ? 0 : 1;
+  return need;
+}
+
+bool ChunkStore::RefOne(const std::string& digest_hex) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = refs_.find(digest_hex);
+  if (it == refs_.end()) return false;
+  it->second++;
+  return true;
+}
+
 void ChunkStore::UnrefAll(const Recipe& r) {
   std::lock_guard<std::mutex> lk(mu_);
   for (const RecipeEntry& e : r.chunks) {

@@ -69,6 +69,21 @@ class ChunkStore {
   // chunk is absent.
   bool RefAll(const Recipe& r);
 
+  // Is this chunk live (referenced by at least one recipe)?
+  bool Has(const std::string& digest_hex) const;
+
+  // Batched presence check under ONE lock acquisition: byte i of the
+  // result is 0 when digests[i] is live, 1 when it must be shipped.
+  // (The chunk-aware replication receiver runs this on the nio loop —
+  // per-digest locking would serialize against every concurrent
+  // upload's PutAndRef.)
+  std::string HaveMask(const std::vector<std::string>& digests) const;
+
+  // Take one reference on an already-live chunk; false when absent
+  // (the replication receiver then reports the race and the sender
+  // falls back to a full copy).
+  bool RefOne(const std::string& digest_hex);
+
   // Read one chunk fully into *out (resized).  False when missing/short.
   bool ReadChunk(const std::string& digest_hex, int64_t expect_len,
                  std::string* out) const;

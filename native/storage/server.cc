@@ -156,6 +156,28 @@ bool StorageServer::Init(std::string* error) {
       out.size = size;
       return out;
     };
+    // Chunk-aware replication hooks: recipe-stored files ship their
+    // recipe + only-missing chunks to peers instead of logical bytes.
+    scbs.pin_recipe =
+        [this](const std::string& remote) -> std::optional<Recipe> {
+      std::string local = ResolveLocal(cfg_.group_name, remote);
+      if (local.empty()) return std::nullopt;
+      ChunkStore* cs = StoreForLocal(local);
+      if (cs == nullptr) return std::nullopt;
+      return cs->ReadRecipeAndPin(local + ".rcp");
+    };
+    scbs.unpin_recipe = [this](const std::string& remote, const Recipe& r) {
+      std::string local = ResolveLocal(cfg_.group_name, remote);
+      ChunkStore* cs = local.empty() ? nullptr : StoreForLocal(local);
+      if (cs != nullptr) cs->UnpinRecipe(r);
+    };
+    scbs.read_chunk = [this](const std::string& remote,
+                             const std::string& digest_hex, int64_t len,
+                             std::string* out) {
+      std::string local = ResolveLocal(cfg_.group_name, remote);
+      ChunkStore* cs = local.empty() ? nullptr : StoreForLocal(local);
+      return cs != nullptr && cs->ReadChunk(digest_hex, len, out);
+    };
     sync_ = std::make_unique<SyncManager>(cfg_, std::move(scbs));
     reporter_ = std::make_unique<TrackerReporter>(
         cfg_, [this](int64_t out[20]) { stats_.Snapshot(out); },
@@ -559,11 +581,13 @@ void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
   std::lock_guard<std::mutex> lk(log_mu_);
   int64_t now_us = MonoUs();
   // "<epoch.sec> <client_ip> <cmd> <status> <bytes> <cost_us>
-  //  <recv_us> <work_us> <fp_us> <fp_lock_us> <cswrite_us> <binlog_us>"
-  // — per-stage split (SURVEY.md §5): recv = body receive window, work =
-  // dio-stage time, then the chunked-upload splits inside the work
-  // window (fingerprint wall, its sidecar-lock-wait share, chunk-store
-  // writes, binlog append).  Columns are 0 when a stage did not occur;
+  //  <recv_us> <work_us> <fp_us> <fp_lock_us> <cswrite_us> <binlog_us>
+  //  <req_bytes>" — per-stage split (SURVEY.md §5): recv = body receive
+  // window, work = dio-stage time, then the chunked-upload splits
+  // inside the work window (fingerprint wall, its sidecar-lock-wait
+  // share, chunk-store writes, binlog append); req_bytes = request body
+  // size (wire accounting — e.g. chunk-aware replication's savings show
+  // up here).  Columns are 0 when a stage did not occur;
   // tools/access_log_stages.py aggregates them into the bench stage
   // table.
   int64_t recv_us =
@@ -571,7 +595,7 @@ void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
   int64_t work_us =
       c->work_start_us > 0 ? now_us - c->work_start_us : 0;
   fprintf(access_log_,
-          "%lld %s %d %d %lld %lld %lld %lld %lld %lld %lld %lld\n",
+          "%lld %s %d %d %lld %lld %lld %lld %lld %lld %lld %lld %lld\n",
           static_cast<long long>(time(nullptr)), c->peer_ip.c_str(), c->cmd,
           status, static_cast<long long>(bytes),
           static_cast<long long>(now_us - c->req_start_us),
@@ -580,7 +604,8 @@ void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
           static_cast<long long>(c->fp_us),
           static_cast<long long>(c->fp_lock_us),
           static_cast<long long>(c->cswrite_us),
-          static_cast<long long>(c->binlog_us));
+          static_cast<long long>(c->binlog_us),
+          static_cast<long long>(c->pkg_len));
   c->req_start_us = 0;  // one line per request
   c->recv_done_us = 0;
   c->work_start_us = 0;
@@ -830,6 +855,12 @@ void StorageServer::OnHeaderComplete(Conn* c) {
     case StorageCmd::kSyncCreateFile:
       c->fixed_need = 32;  // 16B group + 8B name_len + 8B size, then name
       break;
+    case StorageCmd::kSyncCreateRecipe:
+      // 16B group + 8B name_len + 8B logical + 8B chunk_count +
+      // 8B payload_len, then name + chunk entries (inline), then the
+      // missing-chunk payloads (streamed to a tmp file).
+      c->fixed_need = 48;
+      break;
     case StorageCmd::kSyncAppendFile:
     case StorageCmd::kSyncModifyFile:
       c->fixed_need = 40;  // 16B group + 8B name_len + 8B off + 8B len, name
@@ -857,6 +888,7 @@ void StorageServer::OnHeaderComplete(Conn* c) {
     case StorageCmd::kSyncCreateLink:
     case StorageCmd::kSyncUpdateFile:
     case StorageCmd::kSyncTruncateFile:
+    case StorageCmd::kSyncQueryChunks:
     case StorageCmd::kTruncateFile:
     case StorageCmd::kCreateLink:
     case StorageCmd::kTrunkAllocSpace:
@@ -938,6 +970,51 @@ void StorageServer::OnFixedComplete(Conn* c) {
       if (c->file_remaining == 0) OnFileComplete(c);
       return;
     }
+    case StorageCmd::kSyncCreateRecipe: {
+      const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+      int64_t name_len = GetInt64BE(p + kGroupNameMaxLen);
+      int64_t logical = GetInt64BE(p + kGroupNameMaxLen + 8);
+      int64_t n_chunks = GetInt64BE(p + kGroupNameMaxLen + 16);
+      int64_t payload = GetInt64BE(p + kGroupNameMaxLen + 24);
+      if (c->fixed.size() == 48) {
+        if (name_len <= 0 || name_len > 512 || logical < 0 ||
+            n_chunks <= 0 || n_chunks > (1 << 22) || payload < 0 ||
+            c->pkg_len != 48 + name_len + n_chunks * 29 + payload ||
+            48 + name_len + n_chunks * 29 > kMaxInlineBody) {
+          RespondError(c, 22);
+          return;
+        }
+        c->fixed_need = static_cast<size_t>(48 + name_len + n_chunks * 29);
+        return;  // keep reading name + chunk entries
+      }
+      std::string group = GroupFromField(p);
+      c->sync_remote = c->fixed.substr(48, static_cast<size_t>(name_len));
+      c->file_size = payload;
+      c->file_remaining = payload;
+      if (group != cfg_.group_name ||
+          !LocalPath(store_.store_path(0), c->sync_remote).has_value()) {
+        RespondError(c, 22);
+        return;
+      }
+      int spi = 0;
+      sscanf(c->sync_remote.c_str(), "M%02X/", &spi);
+      if (spi >= store_.store_path_count() ||
+          spi >= static_cast<int>(chunk_stores_.size())) {
+        RespondError(c, 95 /*ENOTSUP: no chunk store for this path*/);
+        return;
+      }
+      c->store_path_index = spi;
+      c->tmp_path = store_.NewTmpPath(spi);
+      c->file_fd = open(c->tmp_path.c_str(), O_CREAT | O_WRONLY | O_TRUNC,
+                        0644);
+      if (c->file_fd < 0) {
+        RespondError(c, 5);
+        return;
+      }
+      c->state = ConnState::kRecvFile;
+      if (c->file_remaining == 0) OnFileComplete(c);
+      return;
+    }
     case StorageCmd::kSyncAppendFile:
     case StorageCmd::kSyncModifyFile:
       if (!BeginSyncRange(c)) return;
@@ -993,6 +1070,9 @@ void StorageServer::OnFixedComplete(Conn* c) {
     case StorageCmd::kCreateLink:
       HandleCreateLink(c);
       return;
+    case StorageCmd::kSyncQueryChunks:
+      HandleSyncQueryChunks(c);
+      return;
     default:
       Respond(c, 22);
       return;
@@ -1040,6 +1120,8 @@ void StorageServer::OnFileComplete(Conn* c) {
       FinishSlaveUpload(c);
     else if (wcmd == StorageCmd::kSyncCreateFile)
       SyncCreateComplete(c);
+    else if (wcmd == StorageCmd::kSyncCreateRecipe)
+      SyncRecipeComplete(c);
     else
       FinishUpload(c);
   });
@@ -1129,6 +1211,135 @@ void StorageServer::SyncCreateComplete(Conn* c) {
     Respond(c, 0);
     return;
   }
+}
+
+// SYNC_QUERY_CHUNKS (126): which of these digests does this node's
+// chunk store lack?  Phase 1 of chunk-aware replication; response body
+// is one byte per digest (0 = present, 1 = needed).
+void StorageServer::HandleSyncQueryChunks(Conn* c) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  if (c->fixed.size() < kGroupNameMaxLen + 8) {
+    Respond(c, 22);
+    return;
+  }
+  std::string group = GroupFromField(p);
+  int64_t name_len = GetInt64BE(p + kGroupNameMaxLen);
+  size_t base = kGroupNameMaxLen + 8;
+  if (group != cfg_.group_name || name_len <= 0 || name_len > 512 ||
+      c->fixed.size() < base + name_len ||
+      (c->fixed.size() - base - name_len) % 20 != 0) {
+    Respond(c, 22);
+    return;
+  }
+  std::string remote = c->fixed.substr(base, static_cast<size_t>(name_len));
+  int spi = 0;
+  sscanf(remote.c_str(), "M%02X/", &spi);
+  if (spi >= static_cast<int>(chunk_stores_.size())) {
+    Respond(c, 95 /*ENOTSUP: no chunk store*/);
+    return;
+  }
+  ChunkStore* cs = chunk_stores_[spi].get();
+  size_t n = (c->fixed.size() - base - name_len) / 20;
+  const uint8_t* digs = p + base + name_len;
+  std::vector<std::string> hex;
+  hex.reserve(n);
+  for (size_t i = 0; i < n; ++i) hex.push_back(BytesToHex(digs + i * 20, 20));
+  Respond(c, 0, cs->HaveMask(hex));
+}
+
+// SYNC_CREATE_RECIPE (127): phase 2 of chunk-aware replication — take a
+// reference on every chunk already present, write the shipped payloads
+// for the missing ones, and store the recipe.  All-or-nothing: any
+// failure rolls back taken refs and the sender falls back to the
+// full-copy SYNC_CREATE_FILE.
+void StorageServer::SyncRecipeComplete(Conn* c) {
+  close(c->file_fd);
+  c->file_fd = -1;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  int64_t name_len = GetInt64BE(p + kGroupNameMaxLen);
+  int64_t logical = GetInt64BE(p + kGroupNameMaxLen + 8);
+  int64_t n_chunks = GetInt64BE(p + kGroupNameMaxLen + 16);
+  std::string local = ResolveLocal(cfg_.group_name, c->sync_remote);
+  if (local.empty()) {
+    unlink(c->tmp_path.c_str());
+    Respond(c, 22);
+    return;
+  }
+  // Idempotent replay: already materialized (flat or recipe) => done.
+  struct stat st;
+  if (stat(local.c_str(), &st) == 0 ||
+      stat((local + ".rcp").c_str(), &st) == 0) {
+    unlink(c->tmp_path.c_str());
+    binlog_.Append('c', c->sync_remote);
+    Respond(c, 0);
+    return;
+  }
+  StoreManager::EnsureParentDirs(local);
+  ChunkStore* cs = chunk_stores_[c->store_path_index].get();
+  const uint8_t* entries = p + 48 + name_len;
+  int tmp_fd = open(c->tmp_path.c_str(), O_RDONLY);
+  if (tmp_fd < 0) {
+    unlink(c->tmp_path.c_str());
+    Respond(c, 5);
+    return;
+  }
+  Recipe recipe;
+  recipe.logical_size = logical;
+  int64_t saved = 0, hits = 0, covered = 0;
+  bool ok = true;
+  uint8_t fail_status = 5;
+  std::string payload;
+  for (int64_t i = 0; ok && i < n_chunks; ++i) {
+    const uint8_t* e = entries + i * 29;
+    std::string hex = BytesToHex(e, 20);
+    int64_t len = GetInt64BE(e + 20);
+    bool needed = e[28] != 0;
+    if (len <= 0) {
+      ok = false;
+      fail_status = 22;
+      break;
+    }
+    if (needed) {
+      payload.resize(static_cast<size_t>(len));
+      int64_t got = 0;
+      while (got < len) {
+        ssize_t r = read(tmp_fd, payload.data() + got, len - got);
+        if (r <= 0) break;
+        got += r;
+      }
+      bool existed = false;
+      std::string err;
+      if (got != len ||
+          !cs->PutAndRef(hex, payload.data(), len, &existed, &err)) {
+        ok = false;
+        break;
+      }
+    } else if (!cs->RefOne(hex)) {
+      // The chunk vanished between query and create (concurrent
+      // delete): report it and let the sender fall back to full copy.
+      ok = false;
+      break;
+    } else {
+      saved += len;
+      ++hits;
+    }
+    recipe.chunks.push_back({hex, len});
+    covered += len;
+  }
+  close(tmp_fd);
+  unlink(c->tmp_path.c_str());
+  c->tmp_path.clear();
+  std::string err;
+  if (!ok || covered != logical ||
+      !WriteRecipeFile(local + ".rcp", recipe, &err)) {
+    cs->UnrefAll(recipe);  // roll back what this replay referenced
+    Respond(c, ok ? (covered != logical ? 22 : 5) : fail_status);
+    return;
+  }
+  stats_.dedup_hits += hits;
+  stats_.dedup_bytes_saved += saved;
+  binlog_.Append('c', c->sync_remote);
+  Respond(c, 0);
 }
 
 // -- handlers -------------------------------------------------------------

@@ -214,6 +214,11 @@ class StorageServer {
   void OnFixedComplete(Conn* c);
   void OnFileComplete(Conn* c);
   void SyncCreateComplete(Conn* c);  // replica create (dio worker)
+  // Chunk-aware replication receiver (SYNC_QUERY_CHUNKS /
+  // SYNC_CREATE_RECIPE): answer which chunks are missing, then build
+  // the replica from refs + shipped payloads.
+  void HandleSyncQueryChunks(Conn* c);
+  void SyncRecipeComplete(Conn* c);  // dio worker
   void DeleteWork(Conn* c);          // delete body (dio worker)
 
   // -- handlers (storage_service.c analogues) ----------------------------

@@ -249,10 +249,128 @@ bool SyncManager::Replay(Worker* w, int* fd, const BinlogRecord& rec) {
   return ok;
 }
 
+// Chunk-aware create replay (SYNC_QUERY_CHUNKS + SYNC_CREATE_RECIPE):
+// ship the recipe and only the chunk bytes the peer lacks.  On a
+// dup-heavy corpus this moves ~unique bytes over the wire where the
+// full-copy path moves every logical byte AND makes the peer re-chunk +
+// re-fingerprint the lot (reference: storage_sync.c has no such mode —
+// every replica costs the full file).
+int SyncManager::TryReplayRecipe(int fd, const BinlogRecord& rec,
+                                 bool* skipped) {
+  if (!cbs_.pin_recipe || !cbs_.read_chunk) return 1;
+  auto rcp = cbs_.pin_recipe(rec.filename);
+  if (!rcp.has_value()) return 1;  // not stored as a recipe (or gone)
+  const Recipe& r = *rcp;
+  struct Unpin {  // chunks stay pinned across both phases
+    SyncManager* m;
+    const std::string& name;
+    const Recipe& r;
+    ~Unpin() {
+      if (m->cbs_.unpin_recipe) m->cbs_.unpin_recipe(name, r);
+    }
+  } unpin{this, rec.filename, r};
+
+  auto hex2raw = [](const std::string& hex, std::string* out) {
+    if (hex.size() != 40) return false;
+    out->reserve(out->size() + 20);
+    for (int i = 0; i < 40; i += 2) {
+      auto nib = [](char c) -> int {
+        if (c >= '0' && c <= '9') return c - '0';
+        if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+        if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+        return -1;
+      };
+      int hi = nib(hex[i]), lo = nib(hex[i + 1]);
+      if (hi < 0 || lo < 0) return false;
+      out->push_back(static_cast<char>((hi << 4) | lo));
+    }
+    return true;
+  };
+
+  // Phase 1: which chunks does the peer lack?
+  std::string q;
+  PutFixedField(&q, cfg_.group_name, kGroupNameMaxLen);
+  uint8_t num[8];
+  PutInt64BE(static_cast<int64_t>(rec.filename.size()), num);
+  q.append(reinterpret_cast<char*>(num), 8);
+  q += rec.filename;
+  for (const RecipeEntry& e : r.chunks)
+    if (!hex2raw(e.digest_hex, &q)) return 1;
+  if (!SendHeader(fd, static_cast<uint8_t>(StorageCmd::kSyncQueryChunks),
+                  static_cast<int64_t>(q.size())) ||
+      !SendAll(fd, q.data(), q.size(), kIoTimeoutMs))
+    return -1;
+  uint8_t hdr[kHeaderSize];
+  if (!RecvAll(fd, hdr, sizeof(hdr), kIoTimeoutMs)) return -1;
+  int64_t resp_len = GetInt64BE(hdr);
+  uint8_t status = hdr[9];
+  if (resp_len < 0 || resp_len > (1 << 26)) return -1;
+  std::string need(static_cast<size_t>(resp_len), '\0');
+  if (resp_len > 0 && !RecvAll(fd, need.data(), need.size(), kIoTimeoutMs))
+    return -1;
+  if (status != 0 ||
+      need.size() != r.chunks.size())  // peer can't (no chunk store / old)
+    return 1;
+
+  // Phase 2: recipe + missing chunk payloads (streamed, not buffered —
+  // an all-unique file would otherwise hold its full size in RAM).
+  int64_t payload_len = 0;
+  for (size_t i = 0; i < r.chunks.size(); ++i)
+    if (need[i]) payload_len += r.chunks[i].length;
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  PutInt64BE(static_cast<int64_t>(rec.filename.size()), num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  PutInt64BE(r.logical_size, num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  PutInt64BE(static_cast<int64_t>(r.chunks.size()), num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  PutInt64BE(payload_len, num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  body += rec.filename;
+  for (size_t i = 0; i < r.chunks.size(); ++i) {
+    if (!hex2raw(r.chunks[i].digest_hex, &body)) return 1;
+    PutInt64BE(r.chunks[i].length, num);
+    body.append(reinterpret_cast<char*>(num), 8);
+    body.push_back(need[i] ? 1 : 0);
+  }
+  if (!SendHeader(fd, static_cast<uint8_t>(StorageCmd::kSyncCreateRecipe),
+                  static_cast<int64_t>(body.size()) + payload_len) ||
+      !SendAll(fd, body.data(), body.size(), kIoTimeoutMs))
+    return -1;
+  std::string chunk;
+  for (size_t i = 0; i < r.chunks.size(); ++i) {
+    if (!need[i]) continue;
+    if (!cbs_.read_chunk(rec.filename, r.chunks[i].digest_hex,
+                         r.chunks[i].length, &chunk)) {
+      // Pinned chunks only vanish on real IO errors; the header is
+      // already on the wire, so abort the connection (caller retries).
+      FDFS_LOG_ERROR("sync recipe: chunk %s unreadable",
+                     r.chunks[i].digest_hex.c_str());
+      return -1;
+    }
+    if (!SendAll(fd, chunk.data(), chunk.size(), kIoTimeoutMs)) return -1;
+  }
+  if (!SyncRpcHeaderOnly(fd, &status, kIoTimeoutMs)) return -1;
+  if (status != 0) {
+    FDFS_LOG_WARN("sync recipe %s: peer status %d — falling back to "
+                  "full copy", rec.filename.c_str(), status);
+    return 1;
+  }
+  (void)skipped;
+  return 0;
+}
+
 // 'C': whole-file copy.  Wire: 16B group + 8B name_len + 8B size + name +
 // bytes (the receiver's kSyncCreateFile layout in server.cc).
 bool SyncManager::ReplayCreate(int fd, const BinlogRecord& rec,
                                bool* skipped) {
+  // Recipe-stored files replicate chunk-aware when possible; 1 = the
+  // file is flat/trunk/gone or the peer lacks the capability.
+  int rr = TryReplayRecipe(fd, rec, skipped);
+  if (rr == 0) return true;
+  if (rr < 0) return false;
+
   ContentHandle h;
   if (cbs_.open_content) {
     auto got = cbs_.open_content(rec.filename);

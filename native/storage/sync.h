@@ -25,6 +25,7 @@
 #include <vector>
 
 #include "storage/binlog.h"
+#include "storage/chunkstore.h"
 #include "storage/config.h"
 #include "storage/tracker_client.h"
 
@@ -50,6 +51,14 @@ struct SyncCallbacks {
   // BinlogWriter::Quiescent — gates the caught-up wall-clock report (a
   // stamp captured before an unfinished write could be in a past second).
   std::function<bool()> binlog_quiescent;
+  // Chunk-aware replication hooks (unset => every create ships logical
+  // bytes).  pin_recipe returns the file's recipe with its chunks
+  // PINNED (a concurrent delete cannot unlink bytes mid-send);
+  // unpin_recipe releases them; read_chunk reads one chunk's payload.
+  std::function<std::optional<Recipe>(const std::string& remote)> pin_recipe;
+  std::function<void(const std::string& remote, const Recipe&)> unpin_recipe;
+  std::function<bool(const std::string& remote, const std::string& digest_hex,
+                     int64_t len, std::string* out)> read_chunk;
 };
 
 struct SyncPeerState {
@@ -90,6 +99,10 @@ class SyncManager {
   // IO failure (caller reconnects and retries the same record).
   bool Replay(Worker* w, int* fd, const BinlogRecord& rec);
   bool ReplayCreate(int fd, const BinlogRecord& rec, bool* skipped);
+  // Chunk-aware create replay: recipe + only-missing chunks.  Returns
+  // 0 = replayed (or correctly skipped), 1 = fall back to the
+  // full-copy path, -1 = transport failure (caller reconnects).
+  int TryReplayRecipe(int fd, const BinlogRecord& rec, bool* skipped);
   bool ReplayDelete(int fd, const BinlogRecord& rec, bool* skipped);
   bool ReplayUpdate(int fd, const BinlogRecord& rec, bool* skipped);
   bool ReplayLink(int fd, const BinlogRecord& rec, bool* skipped);

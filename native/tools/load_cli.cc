@@ -214,7 +214,9 @@ void UploadWorker(Shared* sh) {
     if (i >= sh->n_ops) break;
     int64_t pid = sh->unique > 0 ? (i % sh->unique) : i;
     FillPayload(pid, &payload);
-    OpRecord rec{MonoUs(), 0, -1, sh->size, ""};
+    // bytes stays 0 unless the daemon ACCEPTED the upload — failed ops
+    // must not inflate combine's throughput.
+    OpRecord rec{MonoUs(), 0, -1, 0, ""};
     std::string group, ip;
     int port = 0;
     uint8_t spi = 0;
@@ -242,6 +244,7 @@ void UploadWorker(Shared* sh) {
         if (status == 0 && resp.size() > 16) {
           std::string g(resp.c_str(), strnlen(resp.c_str(), 16));
           rec.file_id = g + "/" + resp.substr(16);
+          rec.bytes = sh->size;
         }
       }
     }

@@ -39,14 +39,20 @@ def test_access_log_lines(tmp_path_factory):
     assert os.path.exists(log_path)
     lines = open(log_path).read().strip().splitlines()
     assert len(lines) >= 2  # upload + download
-    # "<ts> <ip> <cmd> <status> <bytes> <cost_us> <recv_us> <work_us>"
-    # — per-stage split (SURVEY.md §5): recv = body window, work = dio
+    # "<ts> <ip> <cmd> <status> <bytes> <cost_us> <recv_us> <work_us>
+    #  <fp_us> <fp_lock_us> <cswrite_us> <binlog_us> <req_bytes>" —
+    # per-stage split (SURVEY.md §5): recv = body window, work = dio,
+    # then the chunked-upload splits inside the work window.
     for line in lines:
-        ts, ip, cmd, status, nbytes, cost, recv_us, work_us = line.split()
+        (ts, ip, cmd, status, nbytes, cost, recv_us, work_us,
+         fp_us, fp_lock_us, cswrite_us, binlog_us, req_bytes) = line.split()
         assert int(ts) > 0 and ip == "127.0.0.1"
         assert int(status) == 0 and int(cost) >= 0
         assert int(recv_us) >= 0 and int(work_us) >= 0
         assert int(recv_us) <= int(cost) and int(work_us) <= int(cost)
+        assert int(fp_lock_us) <= int(fp_us) <= int(work_us)
+        assert int(cswrite_us) >= 0 and int(binlog_us) >= 0
+        assert int(req_bytes) >= 0
     cmds = {int(l.split()[2]) for l in lines}
     assert 11 in cmds and 14 in cmds  # UPLOAD_FILE, DOWNLOAD_FILE
 

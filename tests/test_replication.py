@@ -199,3 +199,83 @@ def test_mark_files_written(cluster, fdfs):
     with open(marks[0]) as fh:
         idx, off, recs = fh.read().split()
     assert int(recs) >= 1 and int(off) > 0
+
+
+def test_chunk_aware_replication_ships_only_missing_chunks(tmp_path_factory):
+    """Recipe-stored files replicate as recipe + missing chunks
+    (SYNC_QUERY_CHUNKS 126 / SYNC_CREATE_RECIPE 127): replicas read
+    byte-identical content while the wire carries ~unique bytes, not
+    every logical byte (the reference's storage_sync.c ships the lot)."""
+    import os
+    import random
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from access_log_stages import aggregate
+
+    tracker = start_tracker(tmp_path_factory.mktemp("catr"))
+    taddr = f"127.0.0.1:{tracker.port}"
+    bases = [tmp_path_factory.mktemp("cas1"), tmp_path_factory.mktemp("cas2")]
+    ips = ("127.0.0.23", "127.0.0.24")
+    extra = HB + "\nuse_access_log = true"
+    s1 = start_storage(bases[0], trackers=[taddr], extra=extra, ip=ips[0],
+                       dedup_mode="cpu")
+    s2 = start_storage(bases[1], trackers=[taddr], extra=extra, ip=ips[1],
+                       dedup_mode="cpu")
+    try:
+        with TrackerClient("127.0.0.1", tracker.port) as t:
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                groups = t.list_groups()
+                if groups and groups[0]["active"] == 2:
+                    break
+                time.sleep(0.2)
+        cli = FdfsClient(taddr)
+        rng = random.Random(17)
+        shared = rng.randbytes(3 << 20)
+        tail_a, tail_b = rng.randbytes(1 << 20), rng.randbytes(1 << 20)
+        a, b = shared + tail_a, shared + tail_b
+
+        fa = cli.upload_buffer(a, ext="bin")
+        with TrackerClient("127.0.0.1", tracker.port) as t:
+            assert _poll(lambda: len(t.query_fetch_all(fa)) == 2 or None,
+                         timeout=60), "a never fully replicated"
+        # Both nodes now hold `shared`'s chunks: b's replication must
+        # ship only its unique tail (+ recipe overhead).
+        fb = cli.upload_buffer(b, ext="bin")
+        with TrackerClient("127.0.0.1", tracker.port) as t:
+            assert _poll(lambda: len(t.query_fetch_all(fb)) == 2 or None,
+                         timeout=60), "b never fully replicated"
+
+        # byte-identical reads from BOTH nodes, directly
+        for ip, port in ((ips[0], s1.port), (ips[1], s2.port)):
+            with StorageClient(ip, port) as sc:
+                assert sc.download_to_buffer(fa) == a
+                assert sc.download_to_buffer(fb) == b
+        cli.close()
+    finally:
+        s1.stop()
+        s2.stop()
+        tracker.stop()
+
+    # Wire accounting from the access logs (13th column = request bytes).
+    sync_wire = 0
+    recipe_rows = 0
+    full_rows = 0
+    for base in bases:
+        agg = aggregate(os.path.join(str(base), "logs", "access.log"))
+        for op in ("sync_query_chunks", "sync_recipe"):
+            if op in agg:
+                sync_wire += agg[op]["req_bytes"]
+        recipe_rows += agg.get("sync_recipe", {}).get("count", 0)
+        full_rows += agg.get("sync_create", {}).get("count", 0)
+        assert agg.get("sync_recipe", {}).get("errors", 0) == 0
+    logical = len(a) + len(b)
+    assert recipe_rows == 2, (recipe_rows, full_rows)
+    assert full_rows == 0, "chunk-aware path was bypassed"
+    # full-copy replication would move `logical`; the recipe path moves
+    # a's bytes (first file: nothing to dedup against) + b's unique tail
+    # + per-chunk overhead — comfortably under 75%.
+    assert sync_wire < logical * 0.75, (sync_wire, logical)
+    assert sync_wire >= len(tail_b), (sync_wire, len(tail_b))

@@ -5,7 +5,7 @@ The daemon (storage.conf:use_access_log) writes one line per request to
 ``<base_path>/logs/access.log``:
 
     <epoch> <ip> <cmd> <status> <bytes> <cost_us> <recv_us> <work_us>
-    <fp_us> <fp_lock_us> <cswrite_us> <binlog_us>
+    <fp_us> <fp_lock_us> <cswrite_us> <binlog_us> <req_bytes>
 
 (native/storage/server.cc:LogAccess; older 8-column logs parse too, with
 zero stage splits).  This tool answers the question the raw ingest rate
@@ -29,7 +29,7 @@ CMD_NAMES = {
     11: "upload", 12: "delete", 14: "download", 16: "sync_create",
     21: "upload_slave", 22: "query_info", 23: "upload_appender",
     24: "append", 26: "fetch_binlog", 34: "modify", 36: "truncate",
-    124: "near_dups",
+    124: "near_dups", 126: "sync_query_chunks", 127: "sync_recipe",
 }
 
 STAGES = ["recv_us", "work_us", "fp_us", "fp_lock_us", "cswrite_us",
@@ -53,18 +53,20 @@ def aggregate(path: str) -> dict:
                 continue
             try:
                 cmd, status = int(f[2]), int(f[3])
-                nums = [int(x) for x in f[4:12]]
+                nums = [int(x) for x in f[4:13]]
             except ValueError:
                 continue
-            nums += [0] * (8 - len(nums))  # old 8-column format
+            nums += [0] * (9 - len(nums))  # older column counts
             bytes_, cost = nums[0], nums[1]
             stages = nums[2:8]
+            req_bytes = nums[8]
             d = per_cmd.setdefault(cmd, {
-                "count": 0, "errors": 0, "bytes": 0, "cost_us": [],
-                **{s: 0 for s in STAGES}})
+                "count": 0, "errors": 0, "bytes": 0, "req_bytes": 0,
+                "cost_us": [], **{s: 0 for s in STAGES}})
             d["count"] += 1
             d["errors"] += status != 0
             d["bytes"] += bytes_
+            d["req_bytes"] += req_bytes
             d["cost_us"].append(cost)
             for name, v in zip(STAGES, stages):
                 d[name] += v
@@ -75,6 +77,7 @@ def aggregate(path: str) -> dict:
         n = d["count"]
         row = {
             "count": n, "errors": d["errors"], "bytes": d["bytes"],
+            "req_bytes": d["req_bytes"],
             "total_cost_s": round(total_cost / 1e6, 3),
             "mean_us": total_cost // max(n, 1),
             "p50_us": _pct(costs, 0.50),
