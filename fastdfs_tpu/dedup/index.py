@@ -14,7 +14,6 @@ import json
 import os
 from typing import Any, Sequence
 
-import jax.numpy as jnp
 import numpy as np
 
 from fastdfs_tpu.ops.minhash import EMPTY
@@ -292,7 +291,7 @@ class MinHashLSHIndex:
     ``num_perms = bands * rows``.  A query hashes each signature band;
     items sharing any band bucket become candidates, then the true
     signature-agreement score is computed vectorized against the stored
-    signature matrix (TPU/CPU via jnp) and thresholded.
+    signature matrix (host numpy) and thresholded.
     """
 
     def __init__(self, num_perms: int = 64, bands: int = 16) -> None:
@@ -344,7 +343,15 @@ class MinHashLSHIndex:
 
     def query(self, sig: np.ndarray, top_k: int = 5,
               min_similarity: float = 0.5) -> list[tuple[Any, float]]:
-        """Top-k near-dup candidates with signature-agreement scores."""
+        """Top-k near-dup candidates with signature-agreement scores.
+
+        Scoring is plain numpy: a per-query candidate set is at most a
+        few thousand rows, where host vector ops win outright — eager
+        accelerator dispatch costs ~ms per op (tens of ms on a remote
+        backend), turning a retrieval sweep into dispatch overhead.  The
+        mesh-sharded query path uses the :attr:`signatures` matrix with
+        its own jitted collectives instead.
+        """
         sig = np.asarray(sig, dtype=np.uint32)
         if (sig == EMPTY).all():
             return []
@@ -355,9 +362,7 @@ class MinHashLSHIndex:
             return []
         ids = np.fromiter(cand, dtype=np.int64)
         sigs = self.signatures
-        scores = np.asarray(
-            jnp.mean(jnp.asarray(sigs[ids]) == jnp.asarray(sig)[None, :],
-                     axis=1, dtype=jnp.float32))
+        scores = (sigs[ids] == sig[None, :]).mean(axis=1, dtype=np.float32)
         order = np.argsort(-scores)[:top_k]
         return [(self._refs[int(ids[i])], float(scores[i]))
                 for i in order
