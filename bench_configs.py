@@ -101,11 +101,14 @@ def _cluster(tmp, n_storages=1, dedup_mode="cpu", sidecar_sock="",
     return tr, sts, cli
 
 
-def _start_sidecar(tmp: str, platform: str | None = None):
+def _start_sidecar(tmp: str, platform: str | None = None,
+                   stderr_path: str | None = None):
     """Launch the TPU dedup sidecar (fastdfs_tpu.sidecar) and wait for
     its warmup to finish.  platform=None keeps the process's default
     backend (the real TPU on this machine); "cpu" forces the host
-    backend (isolates the engine structure from the accelerator link)."""
+    backend (isolates the engine structure from the accelerator link).
+    stderr_path keeps the process's output for post-mortems (a sidecar
+    dying 40 minutes into a --full pass is undebuggable from DEVNULL)."""
     import socket as socketlib
 
     sock = os.path.join(tmp, "dedup.sock")
@@ -117,9 +120,15 @@ def _start_sidecar(tmp: str, platform: str | None = None):
         env["JAX_PLATFORMS"] = platform
         args += ["--platform", platform]
     os.makedirs(os.path.join(tmp, "sc_state"), exist_ok=True)
-    proc = subprocess.Popen(args, cwd=REPO, env=env,
-                            stdout=subprocess.DEVNULL,
-                            stderr=subprocess.DEVNULL)
+    if stderr_path:
+        with open(stderr_path, "w") as errdst:
+            proc = subprocess.Popen(args, cwd=REPO, env=env,
+                                    stdout=errdst,
+                                    stderr=subprocess.STDOUT)
+    else:
+        proc = subprocess.Popen(args, cwd=REPO, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
     # First-ever warmup compiles every bucket shape on the accelerator
     # (can take many minutes cold); the persistent compilation cache
     # makes every later start ~2 min.
@@ -184,23 +193,40 @@ def _with_sidecar(run_fn):
     {"error": ...} when the sidecar cannot come up."""
     platform = os.environ.get("BENCH_SIDECAR_PLATFORM") or None
     sc_tmp = tempfile.mkdtemp(prefix="bench_sc_")
+    # Per-launch log OUTSIDE the artifacts dir (a later config must not
+    # clobber the post-mortem of an earlier crash).
+    stderr_log = os.path.join(
+        tempfile.gettempdir(),
+        f"fastdfs_sidecar_{os.path.basename(sc_tmp)}.log")
+    result = None
     try:
-        sc_proc, sock = _start_sidecar(sc_tmp, platform=platform)
+        sc_proc, sock = _start_sidecar(sc_tmp, platform=platform,
+                                       stderr_path=stderr_log)
         try:
             result = run_fn(sock)
-            stats = _sidecar_stats(sock)
-            busy = stats.get("lock_wait_us", 0) + stats.get("engine_us", 1)
-            stats["lock_wait_fraction"] = round(
-                stats.get("lock_wait_us", 0) / max(busy, 1), 4)
-            result["sidecar_stats"] = stats
             result["sidecar_platform"] = platform or "tpu"
+            # Stats are best-effort: a sidecar that died mid-run must
+            # not discard the completed run's metrics (the daemon fails
+            # open, so the pass itself still finished).
+            try:
+                stats = _sidecar_stats(sock)
+                busy = (stats.get("lock_wait_us", 0)
+                        + stats.get("engine_us", 1))
+                stats["lock_wait_fraction"] = round(
+                    stats.get("lock_wait_us", 0) / max(busy, 1), 4)
+                result["sidecar_stats"] = stats
+            except OSError as e:
+                result["sidecar_stats_error"] = str(e)
+                result["sidecar_alive_at_end"] = sc_proc.poll() is None
+                result["sidecar_stderr_log"] = stderr_log
             return result
         finally:
             sc_proc.terminate()
             sc_proc.wait()
     except (RuntimeError, TimeoutError, OSError) as e:
-        # OSError: the sidecar died mid-run (stats socket refused/closed)
-        # — record the failure in the artifact, don't abort the bench.
+        if result is not None:
+            result["error"] = str(e)
+            return result
         return {"error": str(e)}
     finally:
         shutil.rmtree(sc_tmp, ignore_errors=True)
@@ -315,16 +341,35 @@ def _text_corpus(total: int, seed=2) -> list[bytes]:
     fresh prose mixed with SHARED SECTIONS (boilerplate, quoted/syndicated
     passages) that recur across documents — the structure CDC dedup
     exists to exploit (sentence-level repetition alone never survives
-    ~8 KB chunking)."""
+    ~8 KB chunking).
+
+    Prose is sampled vectorized (numpy word draws, one join per block):
+    the per-sentence Python loop capped corpus generation at ~1 MB/s,
+    which made the --full 10 GB run a multi-hour generator benchmark.
+    Every prose block remains i.i.d. fresh words — cross-document
+    repetition comes ONLY from the shared sections, as before.
+    """
     rng = random.Random(seed)
-    words = [f"w{j}" for j in range(5000)]
+    nprng = np.random.RandomState(seed)
+    words = np.array([f"w{j}" for j in range(5000)], dtype=object)
 
     def prose(n_bytes: int) -> bytes:
+        # sentence structure: a period roughly every 6-18 words; keep
+        # drawing until the requested size is actually covered (the mean
+        # emitted bytes/word is ~5.9 — a single under-provisioned draw
+        # would silently return short blocks and shift the shared/fresh
+        # byte mix dedup_ratio is measured on).
         out = bytearray()
         while len(out) < n_bytes:
-            out += (" ".join(rng.choices(words, k=rng.randint(6, 18)))
-                    + ". ").encode()
-        return bytes(out)
+            draw = words[nprng.randint(0, len(words),
+                                       max((n_bytes - len(out)) // 5 + 32,
+                                           16))]
+            i = 0
+            while i < len(draw) and len(out) < n_bytes:
+                k = rng.randint(6, 18)
+                out += " ".join(draw[i:i + k]).encode() + b". "
+                i += k
+        return bytes(out[:n_bytes])
 
     shared_sections = [prose(rng.randint(32 << 10, 128 << 10))
                        for _ in range(24)]

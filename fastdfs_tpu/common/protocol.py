@@ -40,6 +40,13 @@ FIELD_SEPARATOR = b"\x02"
 
 HEADER_SIZE = PROTO_PKG_LEN_SIZE + 2  # 8B len + 1B cmd + 1B status
 
+# Largest request body a daemon will buffer in memory (larger bodies
+# stream to disk, or the connection is closed).  A WIRE contract, not a
+# tuning knob: senders of inline-only commands (e.g. the chunk-aware
+# replication query) must size against it or their requests are
+# unparseable at the peer.
+MAX_INLINE_BODY = 64 << 20
+
 _HEADER_STRUCT = struct.Struct(">qBB")
 
 

@@ -20,7 +20,9 @@ namespace fdfs {
 
 namespace {
 
-constexpr int64_t kMaxInlineBody = 64LL << 20;  // non-streamed body cap
+// kMaxInlineBody (the non-streamed body cap) comes from protocol_gen.h:
+// it is a wire contract shared with senders (sync.cc sizes the
+// chunk-aware replication messages against it).
 constexpr int64_t kBinlogRotateSize = 64LL << 20;
 constexpr size_t kIoBufSize = 256 * 1024;
 

@@ -262,10 +262,12 @@ int SyncManager::TryReplayRecipe(int fd, const BinlogRecord& rec,
   if (!rcp.has_value()) return 1;  // not stored as a recipe (or gone)
   const Recipe& r = *rcp;
   // The query body (20 B/digest) and the create's inline entry block
-  // (29 B/chunk) must fit the receiver's inline-body cap, or it closes
-  // the connection and this record would retry forever.  Oversized
-  // recipes (~3M+ chunks) take the full-copy path instead.
-  if (static_cast<int64_t>(r.chunks.size()) * 29 + 1024 > (48LL << 20)) {
+  // (29 B/chunk) must fit the receiver's kMaxInlineBody, or it closes
+  // the connection and this record would retry forever.  The entry
+  // block is the binding constraint (29 B/chunk => ~2.3M chunks at the
+  // 64 MB cap); oversized recipes take the full-copy path instead.
+  if (48 + 1024 + static_cast<int64_t>(r.chunks.size()) * 29 >
+      kMaxInlineBody) {
     if (cbs_.unpin_recipe) cbs_.unpin_recipe(rec.filename, r);
     return 1;
   }
