@@ -115,7 +115,11 @@ def _start_sidecar(tmp: str, platform: str | None = None,
     env = dict(os.environ)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_fastdfs_tpu")
     args = [sys.executable, "-m", "fastdfs_tpu.sidecar", "--socket", sock,
-            "--state-dir", os.path.join(tmp, "sc_state")]
+            "--state-dir", os.path.join(tmp, "sc_state"),
+            # Generous watchdog: a --full pass on the leaky axon client
+            # strands ~2x the shipped bytes (PROFILE_r05); restart rather
+            # than OOM the box if a pass outgrows this.
+            "--max-rss-mb", "49152"]
     if platform:
         env["JAX_PLATFORMS"] = platform
         args += ["--platform", platform]

@@ -135,8 +135,15 @@ class DedupEngine:
         (futures) so callers can overlap multiple buckets in flight."""
         cfg = self.config
         if self._use_pallas:
+            import jax
+
             from fastdfs_tpu.ops.pallas_minhash import minhash_batch_pallas
             from fastdfs_tpu.ops.pallas_sha1 import sha1_batch_pallas
+            # ONE explicit transfer shared by both kernels: passing the
+            # numpy batch to each jit would convert (and, on a leaky
+            # remote client, strand) a separate host copy per kernel.
+            batch = jax.device_put(batch)
+            lens = jax.device_put(lens)
             sub = max(1, min(16, batch.shape[0] // 128))
             d = sha1_batch_pallas(batch, lens, int(batch.shape[1]), sub=sub)
             s = minhash_batch_pallas(batch, lens, cfg.num_perms, cfg.shingle)

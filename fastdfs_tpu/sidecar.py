@@ -56,6 +56,7 @@ import os
 import signal
 import socket
 import struct
+import sys
 import threading
 import time
 
@@ -111,6 +112,10 @@ class DedupSidecar:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._listener: socket.socket | None = None
+        # RSS watchdog (see _housekeeping_loop): 0 disables; restart_argv
+        # is the CLI argv to re-exec with.
+        self.max_rss_mb: int = 0
+        self.restart_argv: list[str] = []
         # lock_wait_us / engine_us price the one-engine-serialization
         # design: lock_wait is time requests spent queued on _lock,
         # engine is time actually inside engine.fingerprint.  Read via
@@ -380,23 +385,59 @@ class DedupSidecar:
             buf.extend(got)
         return bytes(buf)
 
+    @staticmethod
+    def _rss_mb() -> float:
+        try:
+            with open("/proc/self/status") as fh:
+                for line in fh:
+                    if line.startswith("VmRSS:"):
+                        return int(line.split()[1]) / 1024.0
+        except OSError:
+            pass
+        return 0.0
+
     def _housekeeping_loop(self, snapshot_interval: float) -> None:
         """Snapshot + stale-session reaping on a dedicated timer thread:
         a steadily-busy listener must not defer them (the accept-timeout
         scheduling they used to ride starves under sustained traffic,
-        making crash loss unbounded instead of one snapshot interval)."""
+        making crash loss unbounded instead of one snapshot interval).
+
+        Also the RSS watchdog: the experimental axon jax client strands
+        an unreleasable host copy of every device transfer (measured ~1x
+        bytes shipped; see tools/PROFILE_r05.md), so a long-lived
+        sidecar would eventually OOM the box.  Over the limit, the loop
+        snapshots state and re-execs the process in place — the daemon
+        side fails open and retries on fresh connections, so service
+        degrades to flat storage for the ~warmup window instead of
+        dying."""
         while not self._stop.wait(snapshot_interval):
             # Catch EVERYTHING: one bad snapshot attempt (OSError, but
             # also numpy/json errors from racing state) must not kill the
             # thread and silently disable snapshots + session reaping.
+            snap_ok = True
             try:
                 self.save_state()
             except Exception as e:
+                snap_ok = False
                 print(f"dedup sidecar: snapshot failed: {e}", flush=True)
             try:
                 self._reap_stale_sessions()
             except Exception as e:
                 print(f"dedup sidecar: session reap failed: {e}", flush=True)
+            # Re-exec ONLY on the back of a successful snapshot — losing
+            # everything since the last good one would make crash loss
+            # unbounded, the exact failure bound this loop guarantees.
+            if snap_ok and self.max_rss_mb > 0 and self.restart_argv:
+                rss = self._rss_mb()
+                if rss > self.max_rss_mb:
+                    print(f"dedup sidecar: rss {rss:.0f} MB > limit "
+                          f"{self.max_rss_mb} MB — re-exec (state saved)",
+                          flush=True)
+                    os.environ["FDFS_SIDECAR_RESTARTS"] = str(
+                        int(os.environ.get("FDFS_SIDECAR_RESTARTS", "0")) + 1)
+                    os.execv(sys.executable,
+                             [sys.executable, "-m", "fastdfs_tpu.sidecar",
+                              *self.restart_argv])
 
     def serve_forever(self, ready_event: threading.Event | None = None,
                       snapshot_interval: float = 60.0) -> None:
@@ -446,6 +487,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="force a jax platform (e.g. cpu for tests; this "
                          "image pins JAX_PLATFORMS=axon via sitecustomize, "
                          "so only jax.config.update overrides reliably)")
+    ap.add_argument("--max-rss-mb", type=int, default=24576,
+                    help="RSS watchdog: snapshot state and re-exec the "
+                         "process above this resident size (0 disables). "
+                         "Guards against client-side transfer leaks on "
+                         "experimental backends; the daemon fails open "
+                         "during the restart window.")
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -453,6 +500,19 @@ def main(argv: list[str] | None = None) -> int:
         jax.config.update("jax_platforms", args.platform)
 
     sidecar = DedupSidecar(args.socket, state_dir=args.state_dir)
+    # Restart-loop guard: a limit below the process's natural baseline
+    # (misconfiguration) would otherwise re-exec every tick forever,
+    # each cycle costing a warmup of degraded-to-flat service.  After
+    # two watchdog restarts the guard disables itself and stays up.
+    restarts = int(os.environ.get("FDFS_SIDECAR_RESTARTS", "0"))
+    if restarts >= 2 and args.max_rss_mb > 0:
+        print(f"dedup sidecar: {restarts} watchdog restarts — limit "
+              f"{args.max_rss_mb} MB looks below baseline; watchdog "
+              "DISABLED for this process", flush=True)
+        sidecar.max_rss_mb = 0
+    else:
+        sidecar.max_rss_mb = args.max_rss_mb
+    sidecar.restart_argv = list(argv) if argv is not None else sys.argv[1:]
     signal.signal(signal.SIGTERM, lambda *_: sidecar.stop())
     signal.signal(signal.SIGINT, lambda *_: sidecar.stop())
     t0 = time.monotonic()
