@@ -116,6 +116,7 @@ class DedupSidecar:
         # is the CLI argv to re-exec with.
         self.max_rss_mb: int = 0
         self.restart_argv: list[str] = []
+        self._started = time.monotonic()
         # lock_wait_us / engine_us price the one-engine-serialization
         # design: lock_wait is time requests spent queued on _lock,
         # engine is time actually inside engine.fingerprint.  Read via
@@ -430,11 +431,23 @@ class DedupSidecar:
             if snap_ok and self.max_rss_mb > 0 and self.restart_argv:
                 rss = self._rss_mb()
                 if rss > self.max_rss_mb:
+                    # A trip EARLY in the process's life means the limit
+                    # sits below the natural baseline (misconfiguration:
+                    # restarting cannot help — that's what the consecutive
+                    # counter and its disable guard catch).  A trip after
+                    # a long healthy run is the leak doing what leaks do;
+                    # resetting the counter keeps the watchdog alive for
+                    # the service's whole lifetime.
+                    uptime = time.monotonic() - self._started
+                    if uptime < 600.0:
+                        os.environ["FDFS_SIDECAR_RESTARTS"] = str(
+                            int(os.environ.get("FDFS_SIDECAR_RESTARTS",
+                                               "0")) + 1)
+                    else:
+                        os.environ["FDFS_SIDECAR_RESTARTS"] = "0"
                     print(f"dedup sidecar: rss {rss:.0f} MB > limit "
-                          f"{self.max_rss_mb} MB — re-exec (state saved)",
-                          flush=True)
-                    os.environ["FDFS_SIDECAR_RESTARTS"] = str(
-                        int(os.environ.get("FDFS_SIDECAR_RESTARTS", "0")) + 1)
+                          f"{self.max_rss_mb} MB after {uptime:.0f}s — "
+                          "re-exec (state saved)", flush=True)
                     os.execv(sys.executable,
                              [sys.executable, "-m", "fastdfs_tpu.sidecar",
                               *self.restart_argv])
