@@ -311,6 +311,7 @@ class MinHashLSHIndex:
         # _refs scan per delete would make churn quadratic at the scale
         # the exact index is engineered for.
         self._ids_by_ref: dict[Any, list[int]] = {}
+        self._dead = 0  # tombstoned rows (compacted when they dominate)
 
     def __len__(self) -> int:
         return len(self._refs)
@@ -370,25 +371,53 @@ class MinHashLSHIndex:
                 and self._refs[int(ids[i])] is not None]
 
     def remove(self, ref: Any) -> int:
-        """Tombstone every item carrying ``ref`` (deleted file).  Bucket
-        entries and signature rows stay (append-only ids); queries skip
-        tombstones.  Returns the number of items removed."""
+        """Tombstone every item carrying ``ref`` (deleted file); queries
+        skip tombstones.  When tombstones outnumber live rows the whole
+        index compacts (ids, rows, buckets rebuilt) — without this,
+        create/delete churn grows signature storage and band buckets
+        without bound.  Returns the number of items removed."""
         try:
             ids = self._ids_by_ref.pop(ref, None)
         except TypeError:
             # Unhashable refs never enter the ref map — fall back to the
             # linear scan so they still tombstone.
-            n = 0
-            for i, r in enumerate(self._refs):
-                if r == ref:
-                    self._refs[i] = None
-                    n += 1
-            return n
+            ids = [i for i, r in enumerate(self._refs) if r == ref]
+            for i in ids:
+                self._refs[i] = None
+            self._dead += len(ids)
+            self._maybe_compact()
+            return len(ids)
         if not ids:
             return 0
         for i in ids:
             self._refs[i] = None
+        self._dead += len(ids)
+        self._maybe_compact()
         return len(ids)
+
+    def _maybe_compact(self) -> None:
+        if self._dead <= max(len(self._refs) - self._dead, 1024):
+            return
+        live = [i for i, r in enumerate(self._refs) if r is not None]
+        self._refs = [self._refs[i] for i in live]
+        self._rows = [self._rows[i] for i in live]
+        self._sigs_cache = None
+        self._dead = 0
+        self._reindex()
+
+    def _reindex(self) -> None:
+        """Rebuild band buckets + the ref map from _refs/_rows (shared by
+        snapshot load and tombstone compaction)."""
+        self._buckets = [{} for _ in range(self.bands)]
+        self._ids_by_ref = {}
+        for item, (ref, sig) in enumerate(zip(self._refs, self._rows)):
+            for b, key in enumerate(self._band_keys(sig)):
+                self._buckets[b].setdefault(key, []).append(item)
+            if ref is not None:
+                try:
+                    self._ids_by_ref.setdefault(ref, []).append(item)
+                except TypeError:
+                    pass
 
     def signature_of(self, ref: Any) -> np.ndarray | None:
         """Latest stored signature for ``ref`` (None when unindexed or
@@ -431,15 +460,8 @@ class MinHashLSHIndex:
         idx._rows = list(sigs)
         idx._sigs_cache = sigs if len(sigs) else None
         idx._refs = [json.loads(str(r)) for r in data["refs"]]
-        for item, sig in enumerate(idx._rows):
-            for b, key in enumerate(idx._band_keys(sig)):
-                idx._buckets[b].setdefault(key, []).append(item)
-        for item, ref in enumerate(idx._refs):
-            if ref is not None:
-                try:
-                    idx._ids_by_ref.setdefault(ref, []).append(item)
-                except TypeError:
-                    pass
+        idx._reindex()
+        idx._dead = sum(1 for r in idx._refs if r is None)
         return idx
 
 

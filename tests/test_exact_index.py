@@ -226,3 +226,30 @@ def test_lsh_remove_roundtrips_through_snapshot(tmp_path):
     assert idx2.signature_of("a") is None
     assert (idx2.signature_of("b") == s2).all()
     assert idx2.remove("b") == 1
+
+
+def test_lsh_churn_compacts_tombstones():
+    # Sustained create/delete churn must not grow signature rows or band
+    # buckets without bound: once tombstones dominate, the index
+    # compacts and queries/signature_of still work.
+    rng = np.random.RandomState(12)
+    idx = MinHashLSHIndex(64, 16)
+    keep_sig = rng.randint(1, 2**32, 64).astype(np.uint32)
+    idx.add(keep_sig, "keeper")
+    for round_ in range(6):
+        refs = [f"churn{round_}:{i}" for i in range(600)]
+        for r in refs:
+            idx.add(rng.randint(1, 2**32, 64).astype(np.uint32), r)
+        for r in refs:
+            assert idx.remove(r) == 1
+    # rows bounded: far below the 3600 churned items
+    assert len(idx._rows) < 1300, len(idx._rows)
+    assert idx._dead < 1200
+    assert (idx.signature_of("keeper") == keep_sig).all()
+    got = idx.query(keep_sig, top_k=3, min_similarity=0.9)
+    assert got and got[0][0] == "keeper"
+    # bucket lists hold no dangling ids after compaction
+    n = len(idx._rows)
+    for b in idx._buckets:
+        for ids in b.values():
+            assert all(0 <= i < n for i in ids)
