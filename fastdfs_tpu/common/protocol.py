@@ -168,6 +168,19 @@ class StorageCmd(enum.IntEnum):
     #     + 8B length + 1B needed) + concatenated needed chunk payloads.
     SYNC_QUERY_CHUNKS = 126
     SYNC_CREATE_RECIPE = 127
+
+    # Chunk-aware disk recovery (fastdfs_tpu extension): the rebuilding
+    # node PULLS recipes and only the chunk bytes its store lacks,
+    # instead of re-downloading every logical byte (the reference's
+    # storage_disk_recovery.c fetches full files).
+    #   FETCH_RECIPE: 16B group + remote name -> 8B logical_size + 8B
+    #     chunk_count + per chunk (20B raw digest + 8B length); ENOENT
+    #     when the file is stored flat (caller downloads normally).
+    #   FETCH_CHUNK: 16B group + 8B name_len + name + 20B raw digest +
+    #     8B expect_len -> raw chunk bytes; ENOENT when the chunk is
+    #     gone (caller falls back to a full download of that file).
+    FETCH_RECIPE = 128
+    FETCH_CHUNK = 129
     # Ranked near-dup report for a stored file, answered from the
     # sidecar's MinHash/LSH index.  Body = 16B group + remote filename;
     # response = text lines "<file_id> <score>".  ENOTSUP when the dedup

@@ -258,4 +258,23 @@ std::string BytesToHex(const uint8_t* data, size_t len) {
 
 std::string Sha1Digest::Hex() const { return BytesToHex(bytes, 20); }
 
+bool HexToBytes(std::string_view hex, std::string* out) {
+  if (hex.size() % 2 != 0) return false;
+  auto nib = [](char c) -> int {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return -1;
+  };
+  std::string tmp;
+  tmp.reserve(hex.size() / 2);
+  for (size_t i = 0; i < hex.size(); i += 2) {
+    int hi = nib(hex[i]), lo = nib(hex[i + 1]);
+    if (hi < 0 || lo < 0) return false;
+    tmp.push_back(static_cast<char>((hi << 4) | lo));
+  }
+  out->append(tmp);
+  return true;
+}
+
 }  // namespace fdfs

@@ -36,6 +36,9 @@ uint32_t Crc32(const void* data, size_t len, uint32_t seed = 0);
 
 // Raw bytes -> lowercase hex (digest wire/display form).
 std::string BytesToHex(const uint8_t* data, size_t len);
+// Lowercase/uppercase hex -> raw bytes appended to *out; false on odd
+// length or non-hex characters (nothing appended then).
+bool HexToBytes(std::string_view hex, std::string* out);
 
 // -- SHA1 (dedup CPU baseline path) ---------------------------------------
 struct Sha1Digest {

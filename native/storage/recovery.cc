@@ -282,9 +282,10 @@ void RecoveryManager::ThreadMain() {
     if (NotifyAllTrackers(self)) {
       unlink(marker_path_.c_str());
       FDFS_LOG_INFO("disk recovery complete: %lld files restored, %lld "
-                    "skipped",
+                    "skipped, %lld chunks via the chunk-aware path",
                     static_cast<long long>(files_recovered_.load()),
-                    static_cast<long long>(files_skipped_.load()));
+                    static_cast<long long>(files_skipped_.load()),
+                    static_cast<long long>(chunks_pulled_.load()));
     }
   }
   running_ = false;
@@ -388,6 +389,83 @@ bool RecoveryManager::DownloadToFile(const PeerInfo& peer, int* fd,
   return true;
 }
 
+bool RecoveryManager::FetchRecipe(const PeerInfo& peer, int* fd,
+                                  const std::string& remote, Recipe* recipe,
+                                  bool* flat) {
+  *flat = false;
+  if (!EnsurePeerConn(peer, fd)) return false;
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  body += remote;
+  std::string resp;
+  uint8_t status = 0;
+  if (!Rpc(*fd, static_cast<uint8_t>(StorageCmd::kFetchRecipe), body, &resp,
+           &status, 64 << 20)) {
+    close(*fd);
+    *fd = -1;
+    return false;
+  }
+  if (status != 0) {
+    // ENOENT: flat (or gone — the later download answers that);
+    // anything else (old peer, EINVAL): also just download normally.
+    *flat = true;
+    return true;
+  }
+  if (resp.size() < 16) return false;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(resp.data());
+  recipe->logical_size = GetInt64BE(p);
+  int64_t n = GetInt64BE(p + 8);
+  // Divide, don't multiply: a huge n could wrap 28*n modulo 2^64 past
+  // the equality check and then blow up reserve()/the parse loop.
+  if (n <= 0 || static_cast<size_t>(n) != (resp.size() - 16) / 28 ||
+      (resp.size() - 16) % 28 != 0) {
+    *flat = true;  // malformed: be safe, take the full-download path
+    return true;
+  }
+  recipe->chunks.clear();
+  recipe->chunks.reserve(static_cast<size_t>(n));
+  int64_t covered = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* e = p + 16 + i * 28;
+    int64_t len = GetInt64BE(e + 20);
+    if (len <= 0) {
+      *flat = true;
+      return true;
+    }
+    recipe->chunks.push_back({BytesToHex(e, 20), len});
+    covered += len;
+  }
+  if (covered != recipe->logical_size) {
+    *flat = true;
+    return true;
+  }
+  return true;
+}
+
+bool RecoveryManager::FetchChunk(const PeerInfo& peer, int* fd,
+                                 const std::string& remote,
+                                 const std::string& digest_hex, int64_t len,
+                                 std::string* out) {
+  if (!EnsurePeerConn(peer, fd)) return false;
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  uint8_t num[8];
+  PutInt64BE(static_cast<int64_t>(remote.size()), num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  body += remote;
+  if (!HexToBytes(digest_hex, &body)) return false;
+  PutInt64BE(len, num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  uint8_t status = 0;
+  if (!Rpc(*fd, static_cast<uint8_t>(StorageCmd::kFetchChunk), body, out,
+           &status, 16 << 20)) {
+    close(*fd);
+    *fd = -1;
+    return false;
+  }
+  return status == 0 && static_cast<int64_t>(out->size()) == len;
+}
+
 bool RecoveryManager::FetchMetadata(const PeerInfo& peer, int* fd,
                                     const std::string& remote,
                                     std::string* meta) {
@@ -486,20 +564,39 @@ bool RecoveryManager::RecoverPath(const PeerInfo& peer, int spi) {
   bool all_ok = true;
   for (const std::string& remote : files) {
     if (stop_) break;
-    std::string staged = store_->NewTmpPath(spi);
-    bool missing = false;
-    if (!DownloadToFile(peer, &conn, remote, staged, &missing)) {
-      FDFS_LOG_WARN("recovery: download %s failed", remote.c_str());
-      all_ok = false;
-      continue;
+    // Chunk-aware pull first: recipe + only locally-missing chunk bytes
+    // (dup-heavy rebuilds re-fetch unique bytes once, not per file).
+    // Any failure — old peer, vanished chunk, local IO — falls back to
+    // the full-file download below.
+    bool stored = false;
+    if (recipe_recover_) {
+      Recipe r;
+      bool flat = false;
+      if (FetchRecipe(peer, &conn, remote, &r, &flat) && !flat) {
+        stored = recipe_recover_(
+            spi, remote, r,
+            [&](const std::string& hex, int64_t len, std::string* out) {
+              return FetchChunk(peer, &conn, remote, hex, len, out);
+            });
+        if (stored) chunks_pulled_ += static_cast<int64_t>(r.chunks.size());
+      }
     }
-    if (missing) {  // deleted on the peer since the record was written
-      files_skipped_++;
-      continue;
-    }
-    if (!StoreRecovered(remote, staged)) {
-      all_ok = false;
-      continue;
+    if (!stored) {
+      std::string staged = store_->NewTmpPath(spi);
+      bool missing = false;
+      if (!DownloadToFile(peer, &conn, remote, staged, &missing)) {
+        FDFS_LOG_WARN("recovery: download %s failed", remote.c_str());
+        all_ok = false;
+        continue;
+      }
+      if (missing) {  // deleted on the peer since the record was written
+        files_skipped_++;
+        continue;
+      }
+      if (!StoreRecovered(remote, staged)) {
+        all_ok = false;
+        continue;
+      }
     }
     std::string meta;
     if (FetchMetadata(peer, &conn, remote, &meta)) {

@@ -10,6 +10,10 @@
 // Honest divergences: upstream restores CREATE_LINK files as links; the
 // rebuild re-downloads the content (a full copy — correct bytes, more
 // space).  Metadata sidecars are restored via GET_METADATA from the peer.
+// Beyond upstream: recipe-stored files rebuild CHUNK-AWARE (FETCH_RECIPE
+// + FETCH_CHUNK pull only the chunk bytes the local store lacks), so a
+// dup-heavy path costs ~unique bytes of wire instead of every logical
+// byte; any failure falls back per-file to the full download.
 #pragma once
 
 #include <atomic>
@@ -19,6 +23,7 @@
 #include <thread>
 #include <vector>
 
+#include "storage/chunkstore.h"
 #include "storage/config.h"
 #include "storage/store.h"
 #include "storage/tracker_client.h"
@@ -50,12 +55,28 @@ class RecoveryManager {
     chunk_threshold_ = threshold;
   }
 
+  // Chunk-aware recovery: materialize `recipe` for `remote` on store
+  // path `spi`, taking refs on chunks already present locally and
+  // calling `fetch_chunk(digest_hex, len, out)` for the rest (the
+  // peer's FETCH_CHUNK).  Returns false on any failure — the caller
+  // then falls back to the full-file download.  Dup-heavy rebuilds move
+  // only unique bytes over the wire this way.
+  using FetchChunkFn = std::function<bool(
+      const std::string& digest_hex, int64_t len, std::string* out)>;
+  using RecipeRecoverFn = std::function<bool(
+      int spi, const std::string& remote, const Recipe& recipe,
+      const FetchChunkFn& fetch_chunk)>;
+  void SetRecipeRecover(RecipeRecoverFn fn) {
+    recipe_recover_ = std::move(fn);
+  }
+
   // Start the background rebuild (call only when NeedsRecovery).
   void Start();
   void Stop();
   bool running() const { return running_; }
   int64_t files_recovered() const { return files_recovered_; }
   int64_t files_skipped() const { return files_skipped_; }
+  int64_t chunks_pulled() const { return chunks_pulled_; }
 
  private:
   struct TrackerReply {
@@ -88,6 +109,14 @@ class RecoveryManager {
   bool FetchMetadata(const PeerInfo& peer, int* fd, const std::string& remote,
                      std::string* meta);
   bool StoreRecovered(const std::string& remote, const std::string& tmp_path);
+  // Chunk-aware pulls (FETCH_RECIPE / FETCH_CHUNK).  FetchRecipe returns
+  // false on transport failure; *flat = true when the peer stores the
+  // file flat (ENOENT) — download normally then.
+  bool FetchRecipe(const PeerInfo& peer, int* fd, const std::string& remote,
+                   Recipe* recipe, bool* flat);
+  bool FetchChunk(const PeerInfo& peer, int* fd, const std::string& remote,
+                  const std::string& digest_hex, int64_t len,
+                  std::string* out);
 
   StorageConfig cfg_;
   TrackerReporter* reporter_;
@@ -98,7 +127,9 @@ class RecoveryManager {
   std::atomic<bool> running_{false};
   std::atomic<int64_t> files_recovered_{0};
   std::atomic<int64_t> files_skipped_{0};
+  std::atomic<int64_t> chunks_pulled_{0};  // via the chunk-aware path
   ChunkedStoreFn chunked_store_;
+  RecipeRecoverFn recipe_recover_;
   int64_t chunk_threshold_ = 0;
 };
 

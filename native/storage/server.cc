@@ -220,6 +220,62 @@ bool StorageServer::Init(std::string* error) {
                                     &hits);
           },
           cfg_.dedup_chunk_threshold);
+      // Chunk-aware rebuild: pull the peer's recipe and only the chunk
+      // bytes this node's store lacks; all-or-nothing with ref rollback,
+      // falling back to the full download on any failure.
+      recovery_->SetRecipeRecover(
+          [this](int spi, const std::string& remote, const Recipe& r,
+                 const RecoveryManager::FetchChunkFn& fetch_chunk) {
+            if (spi >= static_cast<int>(chunk_stores_.size())) return false;
+            ChunkStore* cs = chunk_stores_[spi].get();
+            auto local = LocalPath(store_.store_path(spi), remote);
+            if (!local.has_value()) return false;
+            // Resumed recovery: both write paths are atomic
+            // (write-then-rename), so an existing file/recipe is
+            // complete — re-storing would only inflate chunk refs.
+            struct stat st;
+            if (stat(local->c_str(), &st) == 0 ||
+                stat((*local + ".rcp").c_str(), &st) == 0)
+              return true;
+            StoreManager::EnsureParentDirs(*local);
+            Recipe done;
+            done.logical_size = r.logical_size;
+            std::string payload;
+            for (const RecipeEntry& e : r.chunks) {
+              bool ok;
+              if (cs->RefOne(e.digest_hex)) {
+                ok = true;
+              } else if (fetch_chunk(e.digest_hex, e.length, &payload)) {
+                // The store is content-addressed: verify the payload IS
+                // its digest before admitting it, or a bit-rotted peer
+                // chunk would poison every future dedup hit against it.
+                if (Sha1(payload.data(), payload.size()).Hex() !=
+                    e.digest_hex) {
+                  FDFS_LOG_WARN("recovery: chunk %s failed digest check",
+                                e.digest_hex.c_str());
+                  ok = false;
+                } else {
+                  bool existed = false;
+                  std::string err;
+                  ok = cs->PutAndRef(e.digest_hex, payload.data(),
+                                     payload.size(), &existed, &err);
+                }
+              } else {
+                ok = false;
+              }
+              if (!ok) {
+                cs->UnrefAll(done);
+                return false;
+              }
+              done.chunks.push_back(e);
+            }
+            std::string err;
+            if (!WriteRecipeFile(*local + ".rcp", done, &err)) {
+              cs->UnrefAll(done);
+              return false;
+            }
+            return true;
+          });
     }
     bool needs_recovery = recovery_->NeedsRecovery(store_.any_path_was_fresh());
     reporter_->set_recovering(needs_recovery);
@@ -891,6 +947,8 @@ void StorageServer::OnHeaderComplete(Conn* c) {
     case StorageCmd::kSyncUpdateFile:
     case StorageCmd::kSyncTruncateFile:
     case StorageCmd::kSyncQueryChunks:
+    case StorageCmd::kFetchRecipe:
+    case StorageCmd::kFetchChunk:
     case StorageCmd::kTruncateFile:
     case StorageCmd::kCreateLink:
     case StorageCmd::kTrunkAllocSpace:
@@ -1075,6 +1133,12 @@ void StorageServer::OnFixedComplete(Conn* c) {
     case StorageCmd::kSyncQueryChunks:
       HandleSyncQueryChunks(c);
       return;
+    case StorageCmd::kFetchRecipe:
+      HandleFetchRecipe(c);
+      return;
+    case StorageCmd::kFetchChunk:
+      HandleFetchChunk(c);
+      return;
     default:
       Respond(c, 22);
       return;
@@ -1213,6 +1277,82 @@ void StorageServer::SyncCreateComplete(Conn* c) {
     Respond(c, 0);
     return;
   }
+}
+
+// FETCH_RECIPE (128): serve a recipe-stored file's chunk list to a
+// rebuilding peer (chunk-aware disk recovery).  ENOENT when the file is
+// flat/absent — the caller downloads logical bytes instead.
+void StorageServer::HandleFetchRecipe(Conn* c) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  if (c->fixed.size() <= kGroupNameMaxLen) {
+    Respond(c, 22);
+    return;
+  }
+  std::string group = GroupFromField(p);
+  std::string remote = c->fixed.substr(kGroupNameMaxLen);
+  std::string local = ResolveLocal(group, remote);
+  if (local.empty()) {
+    Respond(c, 22);
+    return;
+  }
+  auto r = ReadRecipeFile(local + ".rcp");
+  if (!r.has_value()) {
+    Respond(c, 2 /*ENOENT: flat or gone*/);
+    return;
+  }
+  std::string body;
+  uint8_t num[8];
+  PutInt64BE(r->logical_size, num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  PutInt64BE(static_cast<int64_t>(r->chunks.size()), num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  for (const RecipeEntry& e : r->chunks) {
+    if (!HexToBytes(e.digest_hex, &body)) {
+      Respond(c, 5);
+      return;
+    }
+    PutInt64BE(e.length, num);
+    body.append(reinterpret_cast<char*>(num), 8);
+  }
+  Respond(c, 0, body);
+}
+
+// FETCH_CHUNK (129): serve one chunk's payload by digest (chunk-aware
+// disk recovery).  ENOENT when the chunk is gone — the caller falls
+// back to a full download of that file.
+void StorageServer::HandleFetchChunk(Conn* c) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  if (c->fixed.size() < kGroupNameMaxLen + 8 + 1 + 28) {
+    Respond(c, 22);
+    return;
+  }
+  std::string group = GroupFromField(p);
+  int64_t name_len = GetInt64BE(p + kGroupNameMaxLen);
+  size_t base = kGroupNameMaxLen + 8;
+  if (group != cfg_.group_name || name_len <= 0 || name_len > 512 ||
+      c->fixed.size() != base + name_len + 28) {
+    Respond(c, 22);
+    return;
+  }
+  std::string remote = c->fixed.substr(base, static_cast<size_t>(name_len));
+  int spi = 0;
+  sscanf(remote.c_str(), "M%02X/", &spi);
+  if (spi >= static_cast<int>(chunk_stores_.size())) {
+    Respond(c, 95 /*ENOTSUP*/);
+    return;
+  }
+  const uint8_t* dig = p + base + name_len;
+  int64_t expect_len = GetInt64BE(dig + 20);
+  if (expect_len <= 0 || expect_len > (8 << 20)) {
+    Respond(c, 22);
+    return;
+  }
+  std::string out;
+  if (!chunk_stores_[spi]->ReadChunk(BytesToHex(dig, 20), expect_len, &out)) {
+    Respond(c, 2 /*ENOENT*/);
+    return;
+  }
+  Respond(c, 0, out);
 }
 
 // SYNC_QUERY_CHUNKS (126): which of these digests does this node's

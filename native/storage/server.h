@@ -219,6 +219,10 @@ class StorageServer {
   // the replica from refs + shipped payloads.
   void HandleSyncQueryChunks(Conn* c);
   void SyncRecipeComplete(Conn* c);  // dio worker
+  // Chunk-aware disk-recovery servers (FETCH_RECIPE / FETCH_CHUNK): let
+  // a rebuilding peer pull recipes and only the chunk bytes it lacks.
+  void HandleFetchRecipe(Conn* c);
+  void HandleFetchChunk(Conn* c);
   void DeleteWork(Conn* c);          // delete body (dio worker)
 
   // -- handlers (storage_service.c analogues) ----------------------------

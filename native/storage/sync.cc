@@ -280,23 +280,6 @@ int SyncManager::TryReplayRecipe(int fd, const BinlogRecord& rec,
     }
   } unpin{this, rec.filename, r};
 
-  auto hex2raw = [](const std::string& hex, std::string* out) {
-    if (hex.size() != 40) return false;
-    out->reserve(out->size() + 20);
-    for (int i = 0; i < 40; i += 2) {
-      auto nib = [](char c) -> int {
-        if (c >= '0' && c <= '9') return c - '0';
-        if (c >= 'a' && c <= 'f') return c - 'a' + 10;
-        if (c >= 'A' && c <= 'F') return c - 'A' + 10;
-        return -1;
-      };
-      int hi = nib(hex[i]), lo = nib(hex[i + 1]);
-      if (hi < 0 || lo < 0) return false;
-      out->push_back(static_cast<char>((hi << 4) | lo));
-    }
-    return true;
-  };
-
   // Phase 1: which chunks does the peer lack?
   std::string q;
   PutFixedField(&q, cfg_.group_name, kGroupNameMaxLen);
@@ -305,7 +288,7 @@ int SyncManager::TryReplayRecipe(int fd, const BinlogRecord& rec,
   q.append(reinterpret_cast<char*>(num), 8);
   q += rec.filename;
   for (const RecipeEntry& e : r.chunks)
-    if (!hex2raw(e.digest_hex, &q)) return 1;
+    if (!HexToBytes(e.digest_hex, &q)) return 1;
   if (!SendHeader(fd, static_cast<uint8_t>(StorageCmd::kSyncQueryChunks),
                   static_cast<int64_t>(q.size())) ||
       !SendAll(fd, q.data(), q.size(), kIoTimeoutMs))
@@ -339,7 +322,7 @@ int SyncManager::TryReplayRecipe(int fd, const BinlogRecord& rec,
   body.append(reinterpret_cast<char*>(num), 8);
   body += rec.filename;
   for (size_t i = 0; i < r.chunks.size(); ++i) {
-    if (!hex2raw(r.chunks[i].digest_hex, &body)) return 1;
+    if (!HexToBytes(r.chunks[i].digest_hex, &body)) return 1;
     PutInt64BE(r.chunks[i].length, num);
     body.append(reinterpret_cast<char*>(num), 8);
     body.push_back(need[i] ? 1 : 0);

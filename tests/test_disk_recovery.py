@@ -199,3 +199,79 @@ def test_whole_group_restart_holds_wiped_node(tmp_path_factory):
     finally:
         for d in (s1, s2, tracker):
             d.stop()
+
+
+def test_chunk_aware_recovery_pulls_only_unique_bytes(tmp_path_factory):
+    """A wiped node with chunk dedup rebuilds recipe-stored files by
+    pulling recipes + chunk payloads (FETCH_RECIPE 128 / FETCH_CHUNK
+    129); duplicate chunks cross the wire once, not once per file, and
+    no full-file DOWNLOAD_FILE is needed for chunked content."""
+    import random
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from access_log_stages import aggregate
+
+    tracker = start_tracker(tmp_path_factory.mktemp("catr"))
+    taddr = f"127.0.0.1:{tracker.port}"
+    s1dir = tmp_path_factory.mktemp("cas1")
+    s2dir = tmp_path_factory.mktemp("cas2")
+    extra = HB + "\nuse_access_log = true"
+    ips = ("127.0.0.33", "127.0.0.34")
+    s1 = start_storage(s1dir, trackers=[taddr], extra=extra, ip=ips[0],
+                       dedup_mode="cpu")
+    s2_port = free_port()
+    s2 = start_storage(s2dir, port=s2_port, trackers=[taddr], extra=extra,
+                       ip=ips[1], dedup_mode="cpu")
+    t = TrackerClient("127.0.0.1", tracker.port)
+    try:
+        assert _wait(lambda: t.list_groups() and
+                     t.list_groups()[0]["active"] == 2)
+        fdfs = FdfsClient(taddr)
+        rng = random.Random(41)
+        shared = rng.randbytes(1 << 20)
+        files = []
+        for i in range(4):  # 4 files sharing a 1MB prefix (dup-heavy)
+            data = shared + rng.randbytes(128 << 10)
+            files.append((fdfs.upload_buffer(data, ext="bin"), data))
+        assert _wait(lambda: all(
+            len(t.query_fetch_all(fid)) == 2 for fid, _ in files),
+            timeout=60), "seed data never fully replicated"
+
+        s2.stop()
+        data_dir = os.path.join(str(s2dir), "data")
+        for name in os.listdir(data_dir):
+            if name == "sync":
+                continue
+            p = os.path.join(data_dir, name)
+            shutil.rmtree(p) if os.path.isdir(p) else os.unlink(p)
+        # truncate s1's access log so the assertion sees only recovery
+        open(os.path.join(str(s1dir), "logs", "access.log"), "w").close()
+
+        conf = os.path.join(str(s2dir), "storage.conf")
+        s2 = Daemon(STORAGED, conf, s2_port, ip=ips[1])
+        assert _wait(lambda: all(
+            len(t.query_fetch_all(fid)) == 2 for fid, _ in files),
+            timeout=60), "recovery never completed"
+
+        # byte-identical reads directly from the rebuilt node
+        with StorageClient(ips[1], s2_port) as sc:
+            for fid, data in files:
+                assert sc.download_to_buffer(fid) == data
+    finally:
+        s2.stop()
+        s1.stop()
+        tracker.stop()
+
+    agg = aggregate(os.path.join(str(s1dir), "logs", "access.log"))
+    assert agg.get("cmd128", agg.get("fetch_recipe", {})).get("count", 0) >= 4
+    chunk_rows = agg.get("cmd129", agg.get("fetch_chunk", {}))
+    assert chunk_rows.get("count", 0) > 0
+    # wire discipline: chunk payload bytes served ~ unique bytes, far
+    # below the 4 * (1MB + 128KB) logical total; and no full-file
+    # download was needed for the chunked content
+    logical = sum(len(d) for _, d in files)
+    assert chunk_rows.get("bytes", 0) < logical * 0.55, \
+        (chunk_rows.get("bytes"), logical)
+    assert agg.get("download", {}).get("count", 0) == 0

@@ -30,6 +30,7 @@ CMD_NAMES = {
     21: "upload_slave", 22: "query_info", 23: "upload_appender",
     24: "append", 26: "fetch_binlog", 34: "modify", 36: "truncate",
     124: "near_dups", 126: "sync_query_chunks", 127: "sync_recipe",
+    128: "fetch_recipe", 129: "fetch_chunk",
 }
 
 STAGES = ["recv_us", "work_us", "fp_us", "fp_lock_us", "cswrite_us",
