@@ -176,9 +176,13 @@ class StorageCmd(enum.IntEnum):
     #   FETCH_RECIPE: 16B group + remote name -> 8B logical_size + 8B
     #     chunk_count + per chunk (20B raw digest + 8B length); ENOENT
     #     when the file is stored flat (caller downloads normally).
-    #   FETCH_CHUNK: 16B group + 8B name_len + name + 20B raw digest +
-    #     8B expect_len -> raw chunk bytes; ENOENT when the chunk is
-    #     gone (caller falls back to a full download of that file).
+    #   FETCH_CHUNK: 16B group + 8B name_len + name + 8B count +
+    #     count x (20B raw digest + 8B expect_len) -> the payloads
+    #     concatenated in request order (lengths are known from the
+    #     recipe).  BATCHED so a rebuild pays one round-trip per ~8 MB
+    #     of missing bytes, not one per ~8 KB chunk.  ENOENT when any
+    #     requested chunk is gone (caller falls back to a full download
+    #     of that file).
     FETCH_RECIPE = 128
     FETCH_CHUNK = 129
     # Ranked near-dup report for a stored file, answered from the

@@ -442,10 +442,14 @@ bool RecoveryManager::FetchRecipe(const PeerInfo& peer, int* fd,
   return true;
 }
 
-bool RecoveryManager::FetchChunk(const PeerInfo& peer, int* fd,
-                                 const std::string& remote,
-                                 const std::string& digest_hex, int64_t len,
-                                 std::string* out) {
+bool RecoveryManager::FetchChunks(const PeerInfo& peer, int* fd,
+                                  const std::string& remote,
+                                  const std::vector<RecipeEntry>& want,
+                                  std::string* out) {
+  if (want.empty()) {
+    out->clear();
+    return true;
+  }
   if (!EnsurePeerConn(peer, fd)) return false;
   std::string body;
   PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
@@ -453,17 +457,23 @@ bool RecoveryManager::FetchChunk(const PeerInfo& peer, int* fd,
   PutInt64BE(static_cast<int64_t>(remote.size()), num);
   body.append(reinterpret_cast<char*>(num), 8);
   body += remote;
-  if (!HexToBytes(digest_hex, &body)) return false;
-  PutInt64BE(len, num);
+  PutInt64BE(static_cast<int64_t>(want.size()), num);
   body.append(reinterpret_cast<char*>(num), 8);
+  int64_t total = 0;
+  for (const RecipeEntry& e : want) {
+    if (!HexToBytes(e.digest_hex, &body)) return false;
+    PutInt64BE(e.length, num);
+    body.append(reinterpret_cast<char*>(num), 8);
+    total += e.length;
+  }
   uint8_t status = 0;
   if (!Rpc(*fd, static_cast<uint8_t>(StorageCmd::kFetchChunk), body, out,
-           &status, 16 << 20)) {
+           &status, 17 << 20)) {
     close(*fd);
     *fd = -1;
     return false;
   }
-  return status == 0 && static_cast<int64_t>(out->size()) == len;
+  return status == 0 && static_cast<int64_t>(out->size()) == total;
 }
 
 bool RecoveryManager::FetchMetadata(const PeerInfo& peer, int* fd,
@@ -575,8 +585,8 @@ bool RecoveryManager::RecoverPath(const PeerInfo& peer, int spi) {
       if (FetchRecipe(peer, &conn, remote, &r, &flat) && !flat) {
         stored = recipe_recover_(
             spi, remote, r,
-            [&](const std::string& hex, int64_t len, std::string* out) {
-              return FetchChunk(peer, &conn, remote, hex, len, out);
+            [&](const std::vector<RecipeEntry>& want, std::string* out) {
+              return FetchChunks(peer, &conn, remote, want, out);
             });
         if (stored) chunks_pulled_ += static_cast<int64_t>(r.chunks.size());
       }

@@ -57,15 +57,16 @@ class RecoveryManager {
 
   // Chunk-aware recovery: materialize `recipe` for `remote` on store
   // path `spi`, taking refs on chunks already present locally and
-  // calling `fetch_chunk(digest_hex, len, out)` for the rest (the
-  // peer's FETCH_CHUNK).  Returns false on any failure — the caller
-  // then falls back to the full-file download.  Dup-heavy rebuilds move
-  // only unique bytes over the wire this way.
-  using FetchChunkFn = std::function<bool(
-      const std::string& digest_hex, int64_t len, std::string* out)>;
+  // calling `fetch_chunks(want, out)` — one BATCHED peer round-trip
+  // returning the payloads concatenated in `want` order — for the
+  // rest.  Returns false on any failure — the caller then falls back
+  // to the full-file download.  Dup-heavy rebuilds move only unique
+  // bytes over the wire this way.
+  using FetchChunksFn = std::function<bool(
+      const std::vector<RecipeEntry>& want, std::string* out)>;
   using RecipeRecoverFn = std::function<bool(
       int spi, const std::string& remote, const Recipe& recipe,
-      const FetchChunkFn& fetch_chunk)>;
+      const FetchChunksFn& fetch_chunks)>;
   void SetRecipeRecover(RecipeRecoverFn fn) {
     recipe_recover_ = std::move(fn);
   }
@@ -114,9 +115,8 @@ class RecoveryManager {
   // file flat (ENOENT) — download normally then.
   bool FetchRecipe(const PeerInfo& peer, int* fd, const std::string& remote,
                    Recipe* recipe, bool* flat);
-  bool FetchChunk(const PeerInfo& peer, int* fd, const std::string& remote,
-                  const std::string& digest_hex, int64_t len,
-                  std::string* out);
+  bool FetchChunks(const PeerInfo& peer, int* fd, const std::string& remote,
+                   const std::vector<RecipeEntry>& want, std::string* out);
 
   StorageConfig cfg_;
   TrackerReporter* reporter_;

@@ -221,11 +221,14 @@ bool StorageServer::Init(std::string* error) {
           },
           cfg_.dedup_chunk_threshold);
       // Chunk-aware rebuild: pull the peer's recipe and only the chunk
-      // bytes this node's store lacks; all-or-nothing with ref rollback,
-      // falling back to the full download on any failure.
+      // bytes this node's store lacks (batched, ~8 MB per round-trip —
+      // a per-chunk RPC would make low-dup rebuilds RTT-bound);
+      // all-or-nothing with ref rollback, falling back to the full
+      // download on any failure.
       recovery_->SetRecipeRecover(
-          [this](int spi, const std::string& remote, const Recipe& r,
-                 const RecoveryManager::FetchChunkFn& fetch_chunk) {
+          [this, rec_plugin](
+              int spi, const std::string& remote, const Recipe& r,
+              const RecoveryManager::FetchChunksFn& fetch_chunks) {
             if (spi >= static_cast<int>(chunk_stores_.size())) return false;
             ChunkStore* cs = chunk_stores_[spi].get();
             auto local = LocalPath(store_.store_path(spi), remote);
@@ -238,42 +241,65 @@ bool StorageServer::Init(std::string* error) {
                 stat((*local + ".rcp").c_str(), &st) == 0)
               return true;
             StoreManager::EnsureParentDirs(*local);
-            Recipe done;
+            Recipe done;  // every ref taken so far (rollback set)
             done.logical_size = r.logical_size;
-            std::string payload;
-            for (const RecipeEntry& e : r.chunks) {
-              bool ok;
-              if (cs->RefOne(e.digest_hex)) {
-                ok = true;
-              } else if (fetch_chunk(e.digest_hex, e.length, &payload)) {
-                // The store is content-addressed: verify the payload IS
-                // its digest before admitting it, or a bit-rotted peer
-                // chunk would poison every future dedup hit against it.
-                if (Sha1(payload.data(), payload.size()).Hex() !=
-                    e.digest_hex) {
-                  FDFS_LOG_WARN("recovery: chunk %s failed digest check",
-                                e.digest_hex.c_str());
-                  ok = false;
-                } else {
-                  bool existed = false;
-                  std::string err;
-                  ok = cs->PutAndRef(e.digest_hex, payload.data(),
-                                     payload.size(), &existed, &err);
-                }
-              } else {
-                ok = false;
-              }
-              if (!ok) {
-                cs->UnrefAll(done);
-                return false;
-              }
-              done.chunks.push_back(e);
-            }
-            std::string err;
-            if (!WriteRecipeFile(*local + ".rcp", done, &err)) {
+            auto fail = [&]() {
               cs->UnrefAll(done);
               return false;
+            };
+            // Pass 1: reference what this node already holds.
+            std::vector<RecipeEntry> missing;
+            for (const RecipeEntry& e : r.chunks) {
+              if (cs->RefOne(e.digest_hex))
+                done.chunks.push_back(e);
+              else
+                missing.push_back(e);
             }
+            // Pass 2: fetch the misses in bounded batches.
+            std::string payloads;
+            size_t i = 0;
+            while (i < missing.size()) {
+              std::vector<RecipeEntry> want;
+              int64_t batch_bytes = 0;
+              while (i < missing.size() && batch_bytes < (8 << 20)) {
+                want.push_back(missing[i]);
+                batch_bytes += missing[i].length;
+                ++i;
+              }
+              if (!fetch_chunks(want, &payloads)) return fail();
+              size_t off = 0;
+              for (const RecipeEntry& e : want) {
+                // Content-addressed store: verify the payload IS its
+                // digest before admitting it, or a bit-rotted peer
+                // chunk would poison every future dedup hit.
+                if (Sha1(payloads.data() + off,
+                         static_cast<size_t>(e.length))
+                        .Hex() != e.digest_hex) {
+                  FDFS_LOG_WARN("recovery: chunk %s failed digest check",
+                                e.digest_hex.c_str());
+                  return fail();
+                }
+                bool existed = false;
+                std::string err;
+                if (!cs->PutAndRef(e.digest_hex, payloads.data() + off,
+                                   static_cast<size_t>(e.length), &existed,
+                                   &err))
+                  return fail();
+                done.chunks.push_back(e);
+                off += static_cast<size_t>(e.length);
+              }
+            }
+            std::string err;
+            if (!WriteRecipeFile(*local + ".rcp", r, &err)) return fail();
+            // Sidecar mode: re-register the file with the dedup engine
+            // (near-dup signature + attributions) exactly as an upload
+            // would — zero extra wire, the bytes are local now.  The
+            // cpu plugin keeps its index in the chunk store itself, so
+            // re-fingerprinting there would be pure waste.
+            if (rec_plugin != nullptr &&
+                std::string(rec_plugin->Name()) == "sidecar")
+              ReindexRecovered(rec_plugin, *local,
+                               cfg_.group_name + "/" + remote);
             return true;
           });
     }
@@ -1279,6 +1305,44 @@ void StorageServer::SyncCreateComplete(Conn* c) {
   }
 }
 
+// Feed a recovered file's (locally assembled) bytes through the dedup
+// plugin in upload-sized segments so its near-dup signature and chunk
+// attributions re-enter the engine's indexes — a sidecar-mode rebuild
+// would otherwise leave every recovered file invisible to NEAR_DUPS
+// and un-forgettable on delete.  Best-effort: failures only cost index
+// coverage, never the recovered data.
+void StorageServer::ReindexRecovered(DedupPlugin* plugin,
+                                     const std::string& local,
+                                     const std::string& file_ref) {
+  int64_t size = 0;
+  int fd = OpenLogical(local, &size);
+  if (fd < 0) return;
+  const int64_t session = plugin->BeginChunked();
+  std::string seg;
+  int64_t base = 0;
+  bool ok = true;
+  while (ok && base < size) {
+    int64_t want = std::min<int64_t>(cfg_.dedup_segment_bytes, size - base);
+    seg.resize(static_cast<size_t>(want));
+    int64_t got = 0;
+    while (got < want) {
+      ssize_t r = read(fd, seg.data() + got, want - got);
+      if (r <= 0) break;
+      got += r;
+    }
+    std::vector<ChunkFp> fps;
+    ok = got == want &&
+         plugin->FingerprintChunks(session, seg.data(), seg.size(), base,
+                                   &fps);
+    base += want;
+  }
+  close(fd);
+  if (ok)
+    plugin->CommitChunked(session, file_ref);
+  else
+    plugin->AbortChunked(session);
+}
+
 // FETCH_RECIPE (128): serve a recipe-stored file's chunk list to a
 // rebuilding peer (chunk-aware disk recovery).  ENOENT when the file is
 // flat/absent — the caller downloads logical bytes instead.
@@ -1300,6 +1364,13 @@ void StorageServer::HandleFetchRecipe(Conn* c) {
     Respond(c, 2 /*ENOENT: flat or gone*/);
     return;
   }
+  // The client rejects recipe bodies over its 64 MB cap; don't build a
+  // multi-hundred-MB response it will discard (it falls back to the
+  // streamed full download for such files either way).
+  if (16 + r->chunks.size() * 28 > (48u << 20)) {
+    Respond(c, 2);
+    return;
+  }
   std::string body;
   uint8_t num[8];
   PutInt64BE(r->logical_size, num);
@@ -1317,12 +1388,13 @@ void StorageServer::HandleFetchRecipe(Conn* c) {
   Respond(c, 0, body);
 }
 
-// FETCH_CHUNK (129): serve one chunk's payload by digest (chunk-aware
-// disk recovery).  ENOENT when the chunk is gone — the caller falls
-// back to a full download of that file.
+// FETCH_CHUNK (129): serve a BATCH of chunk payloads by digest
+// (chunk-aware disk recovery; one round-trip per ~8 MB of missing
+// bytes, not one per chunk).  ENOENT when any requested chunk is gone
+// — the caller falls back to a full download of that file.
 void StorageServer::HandleFetchChunk(Conn* c) {
   const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
-  if (c->fixed.size() < kGroupNameMaxLen + 8 + 1 + 28) {
+  if (c->fixed.size() < kGroupNameMaxLen + 16 + 1 + 28) {
     Respond(c, 22);
     return;
   }
@@ -1330,7 +1402,7 @@ void StorageServer::HandleFetchChunk(Conn* c) {
   int64_t name_len = GetInt64BE(p + kGroupNameMaxLen);
   size_t base = kGroupNameMaxLen + 8;
   if (group != cfg_.group_name || name_len <= 0 || name_len > 512 ||
-      c->fixed.size() != base + name_len + 28) {
+      c->fixed.size() < base + name_len + 8) {
     Respond(c, 22);
     return;
   }
@@ -1341,16 +1413,39 @@ void StorageServer::HandleFetchChunk(Conn* c) {
     Respond(c, 95 /*ENOTSUP*/);
     return;
   }
-  const uint8_t* dig = p + base + name_len;
-  int64_t expect_len = GetInt64BE(dig + 20);
-  if (expect_len <= 0 || expect_len > (8 << 20)) {
+  const uint8_t* q = p + base + name_len;
+  int64_t count = GetInt64BE(q);
+  if (count <= 0 ||
+      static_cast<size_t>(count) !=
+          (c->fixed.size() - base - name_len - 8) / 28 ||
+      (c->fixed.size() - base - name_len - 8) % 28 != 0) {
+    Respond(c, 22);
+    return;
+  }
+  int64_t total = 0;
+  for (int64_t i = 0; i < count; ++i) {
+    int64_t len = GetInt64BE(q + 8 + i * 28 + 20);
+    if (len <= 0 || len > (8 << 20)) {
+      Respond(c, 22);
+      return;
+    }
+    total += len;
+  }
+  if (total > (16 << 20)) {  // batch cap: bounded response memory
     Respond(c, 22);
     return;
   }
   std::string out;
-  if (!chunk_stores_[spi]->ReadChunk(BytesToHex(dig, 20), expect_len, &out)) {
-    Respond(c, 2 /*ENOENT*/);
-    return;
+  out.reserve(static_cast<size_t>(total));
+  std::string one;
+  for (int64_t i = 0; i < count; ++i) {
+    const uint8_t* e = q + 8 + i * 28;
+    if (!chunk_stores_[spi]->ReadChunk(BytesToHex(e, 20), GetInt64BE(e + 20),
+                                       &one)) {
+      Respond(c, 2 /*ENOENT*/);
+      return;
+    }
+    out += one;
   }
   Respond(c, 0, out);
 }

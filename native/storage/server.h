@@ -223,6 +223,10 @@ class StorageServer {
   // a rebuilding peer pull recipes and only the chunk bytes it lacks.
   void HandleFetchRecipe(Conn* c);
   void HandleFetchChunk(Conn* c);
+  // Re-register a recovered file's signature/attributions with the
+  // dedup plugin (sidecar-mode rebuilds; bytes are local, wire cost 0).
+  void ReindexRecovered(DedupPlugin* plugin, const std::string& local,
+                        const std::string& file_ref);
   void DeleteWork(Conn* c);          // delete body (dio worker)
 
   // -- handlers (storage_service.c analogues) ----------------------------

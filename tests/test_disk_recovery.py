@@ -275,3 +275,81 @@ def test_chunk_aware_recovery_pulls_only_unique_bytes(tmp_path_factory):
     assert chunk_rows.get("bytes", 0) < logical * 0.55, \
         (chunk_rows.get("bytes"), logical)
     assert agg.get("download", {}).get("count", 0) == 0
+
+
+def test_sidecar_mode_recovery_reindexes_near_dups(tmp_path_factory):
+    """A sidecar-mode rebuild must re-register recovered files with its
+    (fresh) dedup engine: after wiping BOTH the data path and the
+    sidecar state, NEAR_DUPS on the rebuilt node still reports the
+    recovered neighbours (ReindexRecovered feeds the assembled bytes
+    back through the plugin)."""
+    import random
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_chunked_storage import _start_sidecar
+
+    tracker = start_tracker(tmp_path_factory.mktemp("nrtr"))
+    taddr = f"127.0.0.1:{tracker.port}"
+    s1dir = tmp_path_factory.mktemp("nrs1")
+    s2dir = tmp_path_factory.mktemp("nrs2")
+    sc1_dir = tmp_path_factory.mktemp("nrsc1")
+    sc2_dir = tmp_path_factory.mktemp("nrsc2")
+    os.makedirs(os.path.join(str(sc1_dir), "state"), exist_ok=True)
+    os.makedirs(os.path.join(str(sc2_dir), "state"), exist_ok=True)
+    sc1, sock1 = _start_sidecar(sc1_dir, state_dir=os.path.join(
+        str(sc1_dir), "state"))
+    sc2, sock2 = _start_sidecar(sc2_dir, state_dir=os.path.join(
+        str(sc2_dir), "state"))
+    ips = ("127.0.0.35", "127.0.0.36")
+    s1 = start_storage(s1dir, trackers=[taddr], extra=HB, ip=ips[0],
+                       dedup_mode="sidecar", dedup_sidecar=sock1)
+    s2_port = free_port()
+    s2 = start_storage(s2dir, port=s2_port, trackers=[taddr], extra=HB,
+                       ip=ips[1], dedup_mode="sidecar", dedup_sidecar=sock2)
+    t = TrackerClient("127.0.0.1", tracker.port)
+    try:
+        assert _wait(lambda: t.list_groups() and
+                     t.list_groups()[0]["active"] == 2)
+        fdfs = FdfsClient(taddr)
+        rng = random.Random(47)
+        shared = rng.randbytes(1 << 20)
+        fa = fdfs.upload_buffer(shared + rng.randbytes(64 << 10), ext="bin")
+        fb = fdfs.upload_buffer(shared + rng.randbytes(64 << 10), ext="bin")
+        assert _wait(lambda: all(
+            len(t.query_fetch_all(f)) == 2 for f in (fa, fb)), timeout=60)
+
+        # Wipe s2's data AND its sidecar's state: the rebuilt node's
+        # engine starts empty, so only recovery-time reindexing can
+        # repopulate it.
+        s2.stop()
+        sc2.kill()
+        sc2.wait()
+        data_dir = os.path.join(str(s2dir), "data")
+        for name in os.listdir(data_dir):
+            if name == "sync":
+                continue
+            p = os.path.join(data_dir, name)
+            shutil.rmtree(p) if os.path.isdir(p) else os.unlink(p)
+        shutil.rmtree(os.path.join(str(sc2_dir), "state"))
+        os.makedirs(os.path.join(str(sc2_dir), "state"))
+        sc2, _ = _start_sidecar(sc2_dir, state_dir=os.path.join(
+            str(sc2_dir), "state"))
+
+        conf = os.path.join(str(s2dir), "storage.conf")
+        s2 = Daemon(STORAGED, conf, s2_port, ip=ips[1])
+        assert _wait(lambda: all(
+            len(t.query_fetch_all(f)) == 2 for f in (fa, fb)), timeout=90), \
+            "recovery never completed"
+
+        # the REBUILT node's own near index knows the recovered pair
+        with StorageClient(ips[1], s2_port) as sc:
+            got = _wait(lambda: any(
+                r == fb for r, _ in sc.near_dups(fa)) or None, timeout=30)
+            assert got, "recovered files missing from the near-dup index"
+    finally:
+        s2.stop()
+        s1.stop()
+        tracker.stop()
+        sc1.kill()
+        sc2.kill()
