@@ -124,11 +124,28 @@ def _bench_cpu(n_chunks: int = 256) -> float:
 
 
 def main() -> None:
-    tpu = _bench_tpu()
+    # Backend failures (e.g. the round-5 "Unable to initialize backend
+    # 'axon'" RuntimeError when the TPU tunnel is down) degrade to a
+    # structured ok:false artifact instead of rc=1 + raw traceback: the
+    # BENCH_*.json the driver captures then says WHAT broke, and trend
+    # tooling can distinguish "backend down" from "kernel regressed".
+    try:
+        tpu = _bench_tpu()
+    except Exception as e:  # noqa: BLE001 — any init/compile/dispatch failure
+        err = f"{type(e).__name__}: {e}"
+        print(json.dumps({
+            "metric": "dedup_ingest_GBps_per_chip",
+            "unit": "GB/s",
+            "ok": False,
+            "error": err[:1000],
+            "value": None,
+        }))
+        return
     cpu_gbps = _bench_cpu()
     print(json.dumps({
         "metric": "dedup_ingest_GBps_per_chip",
         "unit": "GB/s",
+        "ok": True,
         "vs_baseline": round(tpu["value"] / cpu_gbps, 4),
         "cpu_baseline_GBps": round(cpu_gbps, 4),
         **tpu,

@@ -73,20 +73,29 @@ def cmd_file_info(c: FdfsClient, args: list[str]) -> int:
 
 
 def cmd_monitor(c: FdfsClient, args: list[str]) -> int:
-    """Cluster topology + per-storage counters (fdfs_monitor.c)."""
-    groups = c.list_groups()
-    print(f"group count: {len(groups)}")
-    for g in groups:
-        print(f"\nGroup: {g['name']}  members={g['members']} "
-              f"active={g['active']} free={g['free_mb']}MB")
-        for s in c.list_storages(g["name"]):
-            print(f"  {s['ip']}:{s['port']} status={s['status']} "
-                  f"upload={s['upload'][1]}/{s['upload'][0]} "
-                  f"download={s['download'][1]}/{s['download'][0]} "
-                  f"delete={s['delete'][1]}/{s['delete'][0]} "
-                  f"dedup_hits={s['dedup_hits']} "
-                  f"saved={s['dedup_bytes_saved']}B "
-                  f"disk={s['free_mb']}/{s['total_mb']}MB")
+    """Cluster health (fdfs_monitor.c analogue): tracker role, per-group
+    capacity, per-storage liveness with named beat stats, and each
+    daemon's per-opcode counters from its STAT registry.
+
+    Flags: --prometheus  emit text exposition format for scraping
+           --no-storage-stats  skip the per-daemon STAT round-trips
+           --group <name>      limit to one group
+    """
+    from fastdfs_tpu import monitor as M
+    group = None
+    if "--group" in args:
+        i = args.index("--group")
+        if i + 1 >= len(args) or args[i + 1].startswith("--"):
+            print("usage: monitor <tracker> [--group <name>] [--prometheus] "
+                  "[--no-storage-stats]", file=sys.stderr)
+            return 2
+        group = args[i + 1]
+    snap = M.gather(c, with_storage_stats="--no-storage-stats" not in args,
+                    group=group)
+    if "--prometheus" in args:
+        print(M.to_prometheus(snap), end="")
+    else:
+        print(M.render_text(snap))
     return 0
 
 

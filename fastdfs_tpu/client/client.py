@@ -11,7 +11,7 @@ import random
 
 from fastdfs_tpu.client.conn import ConnectionPool, ProtocolError, StatusError
 from fastdfs_tpu.client.storage_client import RemoteFileInfo, StorageClient
-from fastdfs_tpu.client.tracker_client import TrackerClient
+from fastdfs_tpu.client.tracker_client import FetchTarget, TrackerClient
 from fastdfs_tpu.common.ini_config import IniConfig
 
 
@@ -206,6 +206,16 @@ class FdfsClient:
 
     def list_storages(self, group: str) -> list[dict]:
         return self._with_tracker(lambda t: t.list_storages(group))
+
+    def cluster_stat(self, group: str | None = None) -> dict:
+        """Tracker-held cluster observability dump (role, groups,
+        per-storage liveness + named beat stats)."""
+        return self._with_tracker(lambda t: t.cluster_stat(group))
+
+    def storage_stat(self, ip: str, port: int) -> dict:
+        """One storage daemon's stats-registry snapshot (STAT opcode)."""
+        with self._storage(FetchTarget(ip=ip, port=port)) as s:
+            return s.stat()
 
 
 def _parse_addr(addr: str) -> tuple[str, int]:

@@ -10,6 +10,7 @@ upstream — see SURVEY.md provenance warning).
 from __future__ import annotations
 
 import io
+import json
 import os
 from dataclasses import dataclass
 
@@ -239,6 +240,13 @@ class StorageClient:
         self.conn.send_request(StorageCmd.ACTIVE_TEST)
         self.conn.recv_response("active_test")
         return True
+
+    def stat(self) -> dict:
+        """Stats-registry snapshot (STAT 130): per-opcode counters and
+        latency histograms, dedup/replication/recovery accounting.  Shape
+        per fastdfs_tpu.monitor.decode_registry."""
+        self.conn.send_request(StorageCmd.STAT)
+        return json.loads(self.conn.recv_response("stat") or b"{}")
 
 
 def _split_id(file_id: str) -> tuple[str, str]:

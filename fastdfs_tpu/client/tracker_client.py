@@ -166,6 +166,14 @@ class TrackerClient:
                 out[key] = value
         return out
 
+    def cluster_stat(self, group: str | None = None) -> dict:
+        """One-RPC observability dump (SERVER_CLUSTER_STAT 95): tracker
+        role/leader plus every group and storage with the full named
+        last-beat stat payload.  Optional group filter."""
+        body = pack_group_name(group) if group else b""
+        self.conn.send_request(TrackerCmd.SERVER_CLUSTER_STAT, body)
+        return json.loads(self.conn.recv_response("cluster_stat") or b"{}")
+
     def list_storages(self, group: str) -> list[dict]:
         self.conn.send_request(TrackerCmd.SERVER_LIST_STORAGE,
                                pack_group_name(group))

@@ -40,6 +40,43 @@ FIELD_SEPARATOR = b"\x02"
 
 HEADER_SIZE = PROTO_PKG_LEN_SIZE + 2  # 8B len + 1B cmd + 1B status
 
+# ---------------------------------------------------------------------------
+# Storage-beat stat blob (reference: FDFSStorageStat in tracker_types.h,
+# shipped to the tracker on every TRACKER_PROTO_CMD_STORAGE_BEAT).
+#
+# The beat body carries BEAT_STAT_COUNT big-endian int64 slots after the
+# identity prefix; slot i is named BEAT_STAT_FIELDS[i].  The C++ daemons
+# compile against the generated mirror (protocol_gen.h kBeatStatNames),
+# so the tracker's JSON stat feed and the Python monitor agree on every
+# field by construction.  Slots 0-18 are the storage's restart-persisted
+# op counters (storage_stat.dat); 19+ are live values sampled at beat
+# time.  Append-only: new fields go at the end (the tracker accepts
+# shorter blobs from older storages, missing slots read 0).
+# ---------------------------------------------------------------------------
+
+BEAT_STAT_FIELDS = (
+    "total_upload", "success_upload",
+    "total_download", "success_download",
+    "total_delete", "success_delete",
+    "total_append", "success_append",
+    "total_set_meta", "success_set_meta",
+    "total_get_meta", "success_get_meta",
+    "total_query", "success_query",
+    "bytes_uploaded", "bytes_downloaded",
+    "dedup_hits", "dedup_bytes_saved",
+    "last_source_update",
+    "connections",
+    "refused_connections",
+    "sync_lag_s",
+    "sync_bytes_saved_wire",
+    "recovery_chunks_fetched",
+    "recovery_chunks_local",
+    "recovery_files",
+    "fetch_chunk_batches",
+    "dedup_chunk_misses",
+)
+BEAT_STAT_COUNT = len(BEAT_STAT_FIELDS)
+
 # Largest request body a daemon will buffer in memory (larger bodies
 # stream to disk, or the connection is closed).  A WIRE contract, not a
 # tuning knob: senders of inline-only commands (e.g. the chunk-aware
@@ -74,6 +111,12 @@ class TrackerCmd(enum.IntEnum):
     SERVER_LIST_STORAGE = 92
     SERVER_DELETE_STORAGE = 93
     SERVER_SET_TRUNK_SERVER = 94
+    # fastdfs_tpu extension: one-RPC cluster observability dump — tracker
+    # role/leader plus every group and storage with the full named
+    # last-beat stat payload (JSON body; optional 16B group filter).
+    # Upstream's fdfs_monitor stitches this from LIST_ALL_GROUPS +
+    # LIST_STORAGE binary structs instead.
+    SERVER_CLUSTER_STAT = 95
 
     # client -> tracker (service queries; reference: tracker_deal_service_query_*)
     SERVICE_QUERY_STORE_WITHOUT_GROUP_ONE = 101
@@ -185,6 +228,14 @@ class StorageCmd(enum.IntEnum):
     #     of that file).
     FETCH_RECIPE = 128
     FETCH_CHUNK = 129
+    # Stats dump (fastdfs_tpu extension): empty body -> JSON snapshot of
+    # the daemon's stats registry (per-opcode counters and latency
+    # histograms, dedup hits/misses and bytes-saved-on-wire, per-peer
+    # binlog sync lag, recovery chunk accounting).  The shape is the
+    # registry contract: {"counters":{},"gauges":{},"histograms":{}} —
+    # decoded by fastdfs_tpu.monitor and covered by a cross-language
+    # golden test.
+    STAT = 130
     # Ranked near-dup report for a stored file, answered from the
     # sidecar's MinHash/LSH index.  Body = 16B group + remote filename;
     # response = text lines "<file_id> <score>".  ENOTSUP when the dedup

@@ -1,0 +1,273 @@
+"""Cluster observability: decode the STAT feeds, render `fdfs_monitor`
+output, and emit Prometheus text exposition.
+
+Reference: ``client/fdfs_monitor.c`` renders tracker-held per-storage
+stat structs; this rebuild gets the same data in one RPC
+(``TrackerCmd.SERVER_CLUSTER_STAT`` — tracker role, every group's
+capacity, every storage's liveness and named last-beat stat payload)
+plus a per-daemon registry dump (``StorageCmd.STAT`` — per-opcode
+counters and latency histograms, per-peer sync lag, dedup and recovery
+accounting).  The registry JSON shape is the cross-language contract
+covered by tests/test_monitor.py's golden check:
+
+    {"counters": {name: int}, "gauges": {name: int},
+     "histograms": {name: {"bounds": [...], "counts": [...],
+                           "sum": int, "count": int}}}
+
+histogram ``counts`` has ``len(bounds) + 1`` entries, NON-cumulative,
+last = overflow; ``bounds`` are inclusive upper bounds.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from fastdfs_tpu.common.protocol import BEAT_STAT_COUNT, BEAT_STAT_FIELDS
+
+
+# ---------------------------------------------------------------------------
+# decoding
+# ---------------------------------------------------------------------------
+
+def beat_stats(values: list[int]) -> dict[str, int]:
+    """Name a beat stat vector (missing tail slots read 0 — the wire
+    contract is append-only)."""
+    vals = list(values)[:BEAT_STAT_COUNT]
+    vals += [0] * (BEAT_STAT_COUNT - len(vals))
+    return dict(zip(BEAT_STAT_FIELDS, vals))
+
+
+def decode_registry(obj: dict) -> dict:
+    """Validate and normalize a native stats-registry snapshot.
+
+    Raises ValueError on shape violations so a truncated or foreign
+    payload fails loudly instead of rendering garbage.
+    """
+    if not isinstance(obj, dict):
+        raise ValueError(f"registry snapshot must be an object, got {type(obj)}")
+    out = {"counters": {}, "gauges": {}, "histograms": {}}
+    for section in ("counters", "gauges"):
+        for name, value in obj.get(section, {}).items():
+            if not isinstance(value, int):
+                raise ValueError(f"{section}[{name}] is not an int: {value!r}")
+            out[section][name] = value
+    for name, h in obj.get("histograms", {}).items():
+        bounds, counts = h.get("bounds"), h.get("counts")
+        if (not isinstance(bounds, list) or not isinstance(counts, list)
+                or len(counts) != len(bounds) + 1
+                or not all(isinstance(v, int) for v in bounds + counts)
+                or not isinstance(h.get("sum"), int)
+                or not isinstance(h.get("count"), int)):
+            raise ValueError(f"histograms[{name}] malformed: {h!r}")
+        if sum(counts) != h["count"]:
+            raise ValueError(
+                f"histograms[{name}]: bucket sum {sum(counts)} != count "
+                f"{h['count']}")
+        out["histograms"][name] = {
+            "bounds": list(bounds), "counts": list(counts),
+            "sum": h["sum"], "count": h["count"],
+        }
+    return out
+
+
+@dataclass
+class ClusterSnapshot:
+    """Everything the monitor shows: the tracker dump plus (best-effort)
+    each storage's own registry snapshot keyed by "ip:port"."""
+    now: int = 0
+    tracker: dict = field(default_factory=dict)
+    groups: list = field(default_factory=list)
+    storage_stats: dict[str, dict] = field(default_factory=dict)
+    storage_errors: dict[str, str] = field(default_factory=dict)
+
+
+def gather(client, with_storage_stats: bool = True,
+           group: str | None = None) -> ClusterSnapshot:
+    """Collect a full snapshot via an ``FdfsClient``.
+
+    ``group`` filters server-side (the tracker's 16B group filter), so
+    the per-storage STAT round-trips only touch that group's members.
+    The STAT calls are best-effort: a dead storage still appears in the
+    tracker section (that IS the liveness signal), with the error
+    recorded instead of its registry."""
+    cs = client.cluster_stat(group)
+    snap = ClusterSnapshot(now=cs.get("now", 0),
+                           tracker=cs.get("tracker", {}),
+                           groups=cs.get("groups", []))
+    if not with_storage_stats:
+        return snap
+    for g in snap.groups:
+        for s in g.get("storages", []):
+            addr = f"{s['ip']}:{s['port']}"
+            try:
+                snap.storage_stats[addr] = decode_registry(
+                    client.storage_stat(s["ip"], s["port"]))
+            except Exception as e:  # noqa: BLE001 — record, keep going
+                snap.storage_errors[addr] = f"{type(e).__name__}: {e}"
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# text rendering (fdfs_monitor.c analogue)
+# ---------------------------------------------------------------------------
+
+def render_text(snap: ClusterSnapshot) -> str:
+    t = snap.tracker
+    lines = [
+        f"tracker: leader={t.get('leader', '')!s} "
+        f"am_leader={t.get('am_leader', False)} "
+        f"groups={t.get('groups', len(snap.groups))}",
+        f"group count: {len(snap.groups)}",
+    ]
+    for g in snap.groups:
+        lines.append("")
+        lines.append(
+            f"Group: {g['name']}  members={g['members']} "
+            f"active={g['active']} free={g['free_mb']}MB "
+            f"trunk_server={g.get('trunk_server', '') or '-'}")
+        for s in g.get("storages", []):
+            addr = f"{s['ip']}:{s['port']}"
+            st = beat_stats_from_storage(s)
+            lines.append(
+                f"  {addr} {s.get('status_name', s['status'])} "
+                f"beat_age={s.get('beat_age_s', -1)}s "
+                f"disk={s['free_mb']}/{s['total_mb']}MB "
+                f"upload={st['success_upload']}/{st['total_upload']} "
+                f"download={st['success_download']}/{st['total_download']} "
+                f"delete={st['success_delete']}/{st['total_delete']} "
+                f"dedup_hits={st['dedup_hits']} "
+                f"saved={st['dedup_bytes_saved']}B "
+                f"wire_saved={st['sync_bytes_saved_wire']}B "
+                f"sync_lag={st['sync_lag_s']}s "
+                f"recovery={st['recovery_chunks_fetched']}f/"
+                f"{st['recovery_chunks_local']}l")
+            reg = snap.storage_stats.get(addr)
+            if reg is not None:
+                ops = []
+                for name, v in sorted(reg["counters"].items()):
+                    m = re.fullmatch(r"op\.(\w+)\.count", name)
+                    if m and v > 0:
+                        ops.append(f"{m.group(1)}={v}")
+                if ops:
+                    lines.append(f"    ops: {' '.join(ops)}")
+            err = snap.storage_errors.get(addr)
+            if err is not None:
+                lines.append(f"    stat error: {err}")
+    return "\n".join(lines)
+
+
+def beat_stats_from_storage(s: dict) -> dict[str, int]:
+    """Named beat stats from a cluster_stat storage entry; tolerates both
+    the named dict (native tracker) and a raw vector."""
+    st = s.get("stats", {})
+    if isinstance(st, list):
+        return beat_stats(st)
+    return {name: int(st.get(name, 0)) for name in BEAT_STAT_FIELDS}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(raw: str, prefix: str = "fdfs") -> str:
+    name = _NAME_RE.sub("_", raw)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return f"{prefix}_{name}"
+
+
+def _esc(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _labels(**kv) -> str:
+    inner = ",".join(f'{k}="{_esc(v)}"'
+                     for k, v in kv.items() if v is not None)
+    return "{" + inner + "}" if inner else ""
+
+
+def to_prometheus(snap: ClusterSnapshot, prefix: str = "fdfs") -> str:
+    """Text exposition format (one scrape = one cluster snapshot).
+
+    Beat stats become per-storage series labelled {group,storage};
+    registry counters/gauges keep their registry name (sanitized) with a
+    {storage} label; registry histograms become standard cumulative
+    ``_bucket{le=...}`` series."""
+    out: list[str] = []
+
+    def emit(name: str, mtype: str, samples: list[tuple[str, int | float]]):
+        out.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            out.append(f"{name}{labels} {value}")
+
+    t = snap.tracker
+    emit(f"{prefix}_tracker_is_leader", "gauge",
+         [(_labels(leader=t.get("leader", "")),
+           1 if t.get("am_leader") else 0)])
+    emit(f"{prefix}_group_active_storages", "gauge",
+         [(_labels(group=g["name"]), g["active"]) for g in snap.groups])
+    emit(f"{prefix}_group_free_mb", "gauge",
+         [(_labels(group=g["name"]), g["free_mb"]) for g in snap.groups])
+
+    storages = [(g, s) for g in snap.groups for s in g.get("storages", [])]
+    if storages:
+        emit(f"{prefix}_storage_status", "gauge",
+             [(_labels(group=g["name"], storage=f"{s['ip']}:{s['port']}"),
+               s["status"]) for g, s in storages])
+        emit(f"{prefix}_storage_beat_age_seconds", "gauge",
+             [(_labels(group=g["name"], storage=f"{s['ip']}:{s['port']}"),
+               s.get("beat_age_s", -1)) for g, s in storages])
+        for fname in BEAT_STAT_FIELDS:
+            mtype = "gauge" if fname in _BEAT_GAUGES else "counter"
+            emit(f"{prefix}_storage_{fname}", mtype,
+                 [(_labels(group=g["name"],
+                           storage=f"{s['ip']}:{s['port']}"),
+                   beat_stats_from_storage(s)[fname])
+                  for g, s in storages])
+
+    # Registry metrics must be grouped BY NAME across storages first: the
+    # text format allows exactly one TYPE line per metric name, and the
+    # multi-storage case would otherwise repeat it (scrapers reject the
+    # whole exposition on a duplicate TYPE line).
+    counters: dict[str, list] = {}
+    gauges: dict[str, list] = {}
+    hists: dict[str, list] = {}
+    for addr in sorted(snap.storage_stats):
+        reg = snap.storage_stats[addr]
+        for name, v in reg["counters"].items():
+            counters.setdefault(name, []).append((addr, v))
+        for name, v in reg["gauges"].items():
+            gauges.setdefault(name, []).append((addr, v))
+        for name, h in reg["histograms"].items():
+            hists.setdefault(name, []).append((addr, h))
+    for name in sorted(counters):
+        emit(_metric_name(name, prefix), "counter",
+             [(_labels(storage=addr), v) for addr, v in counters[name]])
+    for name in sorted(gauges):
+        emit(_metric_name(name, prefix), "gauge",
+             [(_labels(storage=addr), v) for addr, v in gauges[name]])
+    for name in sorted(hists):
+        base = _metric_name(name, prefix)
+        out.append(f"# TYPE {base} histogram")
+        for addr, h in hists[name]:
+            cum = 0
+            for bound, cnt in zip(h["bounds"], h["counts"]):
+                cum += cnt
+                out.append(f'{base}_bucket{_labels(storage=addr, le=bound)} '
+                           f"{cum}")
+            cum += h["counts"][-1]
+            out.append(f'{base}_bucket{_labels(storage=addr, le="+Inf")} '
+                       f"{cum}")
+            out.append(f"{base}_sum{_labels(storage=addr)} {h['sum']}")
+            out.append(f"{base}_count{_labels(storage=addr)} {h['count']}")
+    return "\n".join(out) + "\n"
+
+
+# Beat fields that are levels, not monotonic totals.
+_BEAT_GAUGES = frozenset({
+    "last_source_update", "connections", "sync_lag_s",
+})

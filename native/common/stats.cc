@@ -1,0 +1,167 @@
+#include "common/stats.h"
+
+#include <algorithm>
+
+namespace fdfs {
+
+namespace {
+
+// Registry names are dot/colon-separated identifiers, but the JSON must
+// stay valid even if a hostile peer address sneaks odd bytes into a
+// per-peer gauge name.
+void AppendJsonString(std::string* out, const std::string& s) {
+  out->push_back('"');
+  for (char ch : s) {
+    switch (ch) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(ch) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", ch & 0xFF);
+          *out += buf;
+        } else {
+          out->push_back(ch);
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void AppendInt(std::string* out, int64_t v) {
+  *out += std::to_string(v);
+}
+
+}  // namespace
+
+StatHistogram::StatHistogram(std::vector<int64_t> bounds)
+    : bounds_(std::move(bounds)),
+      counts_(new std::atomic<int64_t>[bounds_.size() + 1]) {
+  std::sort(bounds_.begin(), bounds_.end());
+  for (size_t i = 0; i <= bounds_.size(); ++i) counts_[i].store(0);
+}
+
+void StatHistogram::Observe(int64_t v) {
+  size_t i = std::upper_bound(bounds_.begin(), bounds_.end(),
+                              v - 1) -  // bound is inclusive: v <= bound
+             bounds_.begin();
+  counts_[i].fetch_add(1, std::memory_order_relaxed);
+  sum_.fetch_add(v, std::memory_order_relaxed);
+  count_.fetch_add(1, std::memory_order_relaxed);
+}
+
+StatsRegistry::Value* StatsRegistry::Counter(const std::string& name) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto& slot = counters_[name];
+  if (slot == nullptr) slot = std::make_unique<Value>(0);
+  return slot.get();
+}
+
+StatsRegistry::Value* StatsRegistry::Gauge(const std::string& name) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto& slot = gauges_[name];
+  if (slot == nullptr) slot = std::make_unique<Value>(0);
+  return slot.get();
+}
+
+void StatsRegistry::SetGauge(const std::string& name, int64_t v) {
+  Gauge(name)->store(v, std::memory_order_relaxed);
+}
+
+void StatsRegistry::GaugeFn(const std::string& name,
+                            std::function<int64_t()> fn) {
+  std::lock_guard<std::mutex> lk(mu_);
+  gauge_fns_[name] = std::move(fn);
+}
+
+StatHistogram* StatsRegistry::Histogram(const std::string& name,
+                                        std::vector<int64_t> bounds) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto& slot = histograms_[name];
+  if (slot == nullptr) slot = std::make_unique<StatHistogram>(std::move(bounds));
+  return slot.get();
+}
+
+std::string StatsRegistry::Json() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::string out = "{\"counters\":{";
+  bool first = true;
+  for (const auto& [name, v] : counters_) {
+    if (!first) out += ",";
+    first = false;
+    AppendJsonString(&out, name);
+    out += ":";
+    AppendInt(&out, v->load(std::memory_order_relaxed));
+  }
+  out += "},\"gauges\":{";
+  // Plain gauges and computed gauges share one namespace in the snapshot;
+  // both maps are sorted, so a two-way merge keeps the output ordered.
+  auto git = gauges_.begin();
+  auto fit = gauge_fns_.begin();
+  first = true;
+  while (git != gauges_.end() || fit != gauge_fns_.end()) {
+    bool take_gauge =
+        fit == gauge_fns_.end() ||
+        (git != gauges_.end() && git->first <= fit->first);
+    const std::string& name = take_gauge ? git->first : fit->first;
+    int64_t value;
+    if (take_gauge) {
+      value = git->second->load(std::memory_order_relaxed);
+      // A plain gauge shadowing a gauge-fn of the same name wins; skip
+      // the fn entry so the name appears once.
+      if (fit != gauge_fns_.end() && fit->first == name) ++fit;
+      ++git;
+    } else {
+      value = fit->second ? fit->second() : 0;
+      ++fit;
+    }
+    if (!first) out += ",";
+    first = false;
+    AppendJsonString(&out, name);
+    out += ":";
+    AppendInt(&out, value);
+  }
+  out += "},\"histograms\":{";
+  first = true;
+  for (const auto& [name, h] : histograms_) {
+    if (!first) out += ",";
+    first = false;
+    AppendJsonString(&out, name);
+    out += ":{\"bounds\":[";
+    for (size_t i = 0; i < h->bounds().size(); ++i) {
+      if (i) out += ",";
+      AppendInt(&out, h->bounds()[i]);
+    }
+    out += "],\"counts\":[";
+    for (size_t i = 0; i < h->bucket_total(); ++i) {
+      if (i) out += ",";
+      AppendInt(&out, h->bucket_count(i));
+    }
+    out += "],\"sum\":";
+    AppendInt(&out, h->sum());
+    out += ",\"count\":";
+    AppendInt(&out, h->count());
+    out += "}";
+  }
+  out += "}}";
+  return out;
+}
+
+std::vector<int64_t> StatsRegistry::LatencyBucketsUs() {
+  // 100us..10s in 1-2.5-5 steps: fine enough to separate the sidecar RPC
+  // (ms) from disk (100s of us) without hundreds of buckets.
+  return {100,     250,     500,     1000,    2500,    5000,    10000,
+          25000,   50000,   100000,  250000,  500000,  1000000, 2500000,
+          5000000, 10000000};
+}
+
+std::vector<int64_t> StatsRegistry::SizeBucketsBytes() {
+  return {1 << 10,  4 << 10,  16 << 10, 64 << 10,  256 << 10,
+          1 << 20,  4 << 20,  16 << 20, 64 << 20,  256 << 20,
+          1 << 30};
+}
+
+}  // namespace fdfs

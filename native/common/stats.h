@@ -1,0 +1,86 @@
+// Stats registry: named atomic counters/gauges + fixed-bucket histograms
+// with JSON snapshot serialization — the measurement surface both daemons
+// expose over the STAT opcodes (fastdfs_tpu.monitor decodes it; the shape
+// is covered by a cross-language golden test).
+//
+// Reference departure: upstream FastDFS hard-codes its stat struct
+// (FDFSStorageStat) and grows it by editing every serializer.  Here the
+// beat blob stays the compact fixed struct (protocol_gen.h kBeatStatNames)
+// while everything else — per-opcode latency, per-peer sync lag, recovery
+// accounting — lives in this registry, where adding a stat is one line at
+// the point that produces it.
+//
+// Concurrency: registration (find-or-create by name) takes a mutex;
+// increments and observations on the returned pointers are plain atomic
+// ops.  Hot paths register once at startup and cache the pointer, so the
+// steady state is lock-free.  Returned pointers stay valid for the
+// registry's lifetime (node-based map storage).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace fdfs {
+
+// Fixed upper-bound buckets plus an overflow bucket; Observe is wait-free.
+class StatHistogram {
+ public:
+  explicit StatHistogram(std::vector<int64_t> bounds);
+
+  void Observe(int64_t v);
+
+  const std::vector<int64_t>& bounds() const { return bounds_; }
+  int64_t bucket_count(size_t i) const { return counts_[i].load(); }
+  size_t bucket_total() const { return bounds_.size() + 1; }
+  int64_t sum() const { return sum_.load(); }
+  int64_t count() const { return count_.load(); }
+
+ private:
+  std::vector<int64_t> bounds_;  // sorted inclusive upper bounds
+  std::unique_ptr<std::atomic<int64_t>[]> counts_;  // bounds_.size() + 1
+  std::atomic<int64_t> sum_{0};
+  std::atomic<int64_t> count_{0};
+};
+
+class StatsRegistry {
+ public:
+  using Value = std::atomic<int64_t>;
+
+  // Find-or-create.  Counters are monotonic; gauges are set/overwritten.
+  Value* Counter(const std::string& name);
+  Value* Gauge(const std::string& name);
+  void SetGauge(const std::string& name, int64_t v);
+  // Gauge whose value is computed at snapshot time (mirrors live state —
+  // e.g. restart-persisted op totals — without double bookkeeping).  The
+  // callback runs under the registry mutex during Json(); it must not
+  // call back into this registry.
+  void GaugeFn(const std::string& name, std::function<int64_t()> fn);
+  StatHistogram* Histogram(const std::string& name,
+                           std::vector<int64_t> bounds);
+
+  // Deterministic snapshot (names sorted within each section):
+  //   {"counters":{...},"gauges":{...},
+  //    "histograms":{"n":{"bounds":[...],"counts":[...],"sum":S,"count":C}}}
+  // counts has bounds.size()+1 entries (last = overflow); buckets are
+  // NON-cumulative (the Prometheus emitter accumulates).
+  std::string Json() const;
+
+  // Shared bucket layouts so every latency/size histogram is comparable.
+  static std::vector<int64_t> LatencyBucketsUs();   // 100us .. 10s, log-ish
+  static std::vector<int64_t> SizeBucketsBytes();   // 1KiB .. 1GiB, x4
+
+ private:
+  mutable std::mutex mu_;
+  std::map<std::string, std::unique_ptr<Value>> counters_;
+  std::map<std::string, std::unique_ptr<Value>> gauges_;
+  std::map<std::string, std::function<int64_t()>> gauge_fns_;
+  std::map<std::string, std::unique_ptr<StatHistogram>> histograms_;
+};
+
+}  // namespace fdfs

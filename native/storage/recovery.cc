@@ -282,10 +282,12 @@ void RecoveryManager::ThreadMain() {
     if (NotifyAllTrackers(self)) {
       unlink(marker_path_.c_str());
       FDFS_LOG_INFO("disk recovery complete: %lld files restored, %lld "
-                    "skipped, %lld chunks via the chunk-aware path",
+                    "skipped, %lld chunks fetched over the wire, %lld "
+                    "satisfied by local refs",
                     static_cast<long long>(files_recovered_.load()),
                     static_cast<long long>(files_skipped_.load()),
-                    static_cast<long long>(chunks_pulled_.load()));
+                    static_cast<long long>(chunks_pulled_.load()),
+                    static_cast<long long>(chunks_local_.load()));
     }
   }
   running_ = false;
@@ -583,12 +585,17 @@ bool RecoveryManager::RecoverPath(const PeerInfo& peer, int spi) {
       Recipe r;
       bool flat = false;
       if (FetchRecipe(peer, &conn, remote, &r, &flat) && !flat) {
+        int64_t fetched = 0, local = 0;
         stored = recipe_recover_(
             spi, remote, r,
             [&](const std::vector<RecipeEntry>& want, std::string* out) {
               return FetchChunks(peer, &conn, remote, want, out);
-            });
-        if (stored) chunks_pulled_ += static_cast<int64_t>(r.chunks.size());
+            },
+            &fetched, &local);
+        if (stored) {
+          chunks_pulled_ += fetched;
+          chunks_local_ += local;
+        }
       }
     }
     if (!stored) {

@@ -64,9 +64,15 @@ class RecoveryManager {
   // bytes over the wire this way.
   using FetchChunksFn = std::function<bool(
       const std::vector<RecipeEntry>& want, std::string* out)>;
+  // The hook reports *chunks_fetched (pulled over the wire) and
+  // *chunks_local (satisfied by refs on chunks this node already held)
+  // so the recovery counters reflect wire traffic, not recipe sizes
+  // (ADVICE recovery.cc:591 — the old accounting charged every chunk of
+  // every recovered recipe as "pulled").
   using RecipeRecoverFn = std::function<bool(
       int spi, const std::string& remote, const Recipe& recipe,
-      const FetchChunksFn& fetch_chunks)>;
+      const FetchChunksFn& fetch_chunks, int64_t* chunks_fetched,
+      int64_t* chunks_local)>;
   void SetRecipeRecover(RecipeRecoverFn fn) {
     recipe_recover_ = std::move(fn);
   }
@@ -78,6 +84,7 @@ class RecoveryManager {
   int64_t files_recovered() const { return files_recovered_; }
   int64_t files_skipped() const { return files_skipped_; }
   int64_t chunks_pulled() const { return chunks_pulled_; }
+  int64_t chunks_local() const { return chunks_local_; }
 
  private:
   struct TrackerReply {
@@ -127,7 +134,8 @@ class RecoveryManager {
   std::atomic<bool> running_{false};
   std::atomic<int64_t> files_recovered_{0};
   std::atomic<int64_t> files_skipped_{0};
-  std::atomic<int64_t> chunks_pulled_{0};  // via the chunk-aware path
+  std::atomic<int64_t> chunks_pulled_{0};  // fetched over the wire
+  std::atomic<int64_t> chunks_local_{0};   // satisfied by local refs
   ChunkedStoreFn chunked_store_;
   RecipeRecoverFn recipe_recover_;
   int64_t chunk_threshold_ = 0;

@@ -25,6 +25,10 @@ namespace {
 // chunk-aware replication messages against it).
 constexpr int64_t kBinlogRotateSize = 64LL << 20;
 constexpr size_t kIoBufSize = 256 * 1024;
+// Per-chunk payload cap, shared by FETCH_CHUNK serving and the
+// SYNC_CREATE_RECIPE entry validation: no single declared chunk may make
+// a dio worker allocate more than this.
+constexpr int64_t kMaxChunkPayload = 8 << 20;
 
 std::string GroupFromField(const uint8_t* p) {
   size_t n = 0;
@@ -113,6 +117,10 @@ bool StorageServer::Init(std::string* error) {
     dio_pools_.push_back(
         std::make_unique<WorkerPool>(cfg_.disk_writer_threads));
 
+  // Stats registry before any subsystem that feeds it: handlers and the
+  // beat callback only touch pre-registered atomic pointers.
+  InitStatsRegistry();
+
   if (!cfg_.tracker_servers.empty()) {
     // Sync manager first: the reporter's peer lists drive its thread pool.
     SyncCallbacks scbs;
@@ -182,7 +190,7 @@ bool StorageServer::Init(std::string* error) {
     };
     sync_ = std::make_unique<SyncManager>(cfg_, std::move(scbs));
     reporter_ = std::make_unique<TrackerReporter>(
-        cfg_, [this](int64_t out[20]) { stats_.Snapshot(out); },
+        cfg_, [this](int64_t* out) { FillBeatStats(out); },
         [this](const std::vector<PeerInfo>& peers) {
           sync_->UpdatePeers(peers);
         });
@@ -228,7 +236,8 @@ bool StorageServer::Init(std::string* error) {
       recovery_->SetRecipeRecover(
           [this, rec_plugin](
               int spi, const std::string& remote, const Recipe& r,
-              const RecoveryManager::FetchChunksFn& fetch_chunks) {
+              const RecoveryManager::FetchChunksFn& fetch_chunks,
+              int64_t* chunks_fetched, int64_t* chunks_local) {
             if (spi >= static_cast<int>(chunk_stores_.size())) return false;
             ChunkStore* cs = chunk_stores_[spi].get();
             auto local = LocalPath(store_.store_path(spi), remote);
@@ -255,6 +264,11 @@ bool StorageServer::Init(std::string* error) {
               else
                 missing.push_back(e);
             }
+            // Honest wire accounting (ADVICE recovery.cc:591): only the
+            // misses cross the network; locally-ref'd chunks are the
+            // savings the chunk-aware path exists for.
+            *chunks_local = static_cast<int64_t>(done.chunks.size());
+            *chunks_fetched = static_cast<int64_t>(missing.size());
             // Pass 2: fetch the misses in bounded batches.
             std::string payloads;
             size_t i = 0;
@@ -410,6 +424,156 @@ void StorageServer::DumpState() {
       static_cast<long long>(stats_.total_delete),
       static_cast<long long>(stats_.dedup_hits),
       static_cast<long long>(stats_.dedup_bytes_saved), binlog_.file_index());
+}
+
+// -- stats registry -------------------------------------------------------
+
+namespace {
+
+// Opcodes this daemon serves, with their monitor-facing names.  Sidecar
+// RPC opcodes (DEDUP_*) are absent: the dedup engine answers those, not
+// this server.
+struct ServedOp {
+  StorageCmd cmd;
+  const char* name;
+};
+constexpr ServedOp kServedOps[] = {
+    {StorageCmd::kUploadFile, "upload_file"},
+    {StorageCmd::kUploadAppenderFile, "upload_appender_file"},
+    {StorageCmd::kUploadSlaveFile, "upload_slave_file"},
+    {StorageCmd::kDownloadFile, "download_file"},
+    {StorageCmd::kDeleteFile, "delete_file"},
+    {StorageCmd::kSetMetadata, "set_metadata"},
+    {StorageCmd::kGetMetadata, "get_metadata"},
+    {StorageCmd::kQueryFileInfo, "query_file_info"},
+    {StorageCmd::kAppendFile, "append_file"},
+    {StorageCmd::kModifyFile, "modify_file"},
+    {StorageCmd::kTruncateFile, "truncate_file"},
+    {StorageCmd::kCreateLink, "create_link"},
+    {StorageCmd::kNearDups, "near_dups"},
+    {StorageCmd::kActiveTest, "active_test"},
+    {StorageCmd::kStat, "stat"},
+    {StorageCmd::kSyncCreateFile, "sync_create_file"},
+    {StorageCmd::kSyncDeleteFile, "sync_delete_file"},
+    {StorageCmd::kSyncUpdateFile, "sync_update_file"},
+    {StorageCmd::kSyncCreateLink, "sync_create_link"},
+    {StorageCmd::kSyncAppendFile, "sync_append_file"},
+    {StorageCmd::kSyncModifyFile, "sync_modify_file"},
+    {StorageCmd::kSyncTruncateFile, "sync_truncate_file"},
+    {StorageCmd::kSyncQueryChunks, "sync_query_chunks"},
+    {StorageCmd::kSyncCreateRecipe, "sync_create_recipe"},
+    {StorageCmd::kFetchRecipe, "fetch_recipe"},
+    {StorageCmd::kFetchChunk, "fetch_chunk"},
+    {StorageCmd::kFetchOnePathBinlog, "fetch_one_path_binlog"},
+    {StorageCmd::kTrunkAllocSpace, "trunk_alloc_space"},
+    {StorageCmd::kTrunkAllocConfirm, "trunk_alloc_confirm"},
+    {StorageCmd::kTrunkFreeSpace, "trunk_free_space"},
+};
+
+}  // namespace
+
+void StorageServer::InitStatsRegistry() {
+  for (const ServedOp& op : kServedOps) {
+    std::string base = std::string("op.") + op.name;
+    OpStats& os = op_stats_[static_cast<uint8_t>(op.cmd)];
+    os.count = registry_.Counter(base + ".count");
+    os.errors = registry_.Counter(base + ".errors");
+    os.latency_us = registry_.Histogram(base + ".latency_us",
+                                        StatsRegistry::LatencyBucketsUs());
+  }
+  hist_upload_bytes_ = registry_.Histogram(
+      "upload.size_bytes", StatsRegistry::SizeBucketsBytes());
+  hist_download_bytes_ = registry_.Histogram(
+      "download.size_bytes", StatsRegistry::SizeBucketsBytes());
+  ctr_sync_bytes_saved_wire_ = registry_.Counter("sync.bytes_saved_wire");
+  ctr_sync_digest_mismatch_ = registry_.Counter("sync.digest_mismatch");
+  ctr_chunkfetch_batches_ = registry_.Counter("chunkfetch.batches");
+  ctr_chunkfetch_chunks_ = registry_.Counter("chunkfetch.chunks");
+  ctr_chunkfetch_bytes_ = registry_.Counter("chunkfetch.bytes");
+  ctr_dedup_chunk_hits_ = registry_.Counter("dedup.chunk_hits");
+  ctr_dedup_chunk_misses_ = registry_.Counter("dedup.chunk_misses");
+
+  // Snapshot-time mirrors of live state.  The restart-persisted op
+  // totals keep their wire names (kBeatStatNames) under "store." so the
+  // STAT JSON and the tracker's beat feed agree field-for-field.
+  static_assert(kBeatStatCount == 28, "update FillBeatStats + gauges");
+  for (int i = 0; i < StorageStats::kPersisted; ++i) {
+    registry_.GaugeFn(std::string("store.") + kBeatStatNames[i], [this, i] {
+      int64_t v[StorageStats::kPersisted] = {0};
+      stats_.Snapshot(v);
+      return v[i];
+    });
+  }
+  registry_.GaugeFn("server.connections",
+                    [this] { return conn_count_.load(); });
+  registry_.GaugeFn("server.refused_connections",
+                    [this] { return refused_conn_count_.load(); });
+  registry_.GaugeFn("binlog.file_index", [this] {
+    return static_cast<int64_t>(binlog_.file_index());
+  });
+  registry_.GaugeFn("sync.lag_s.max", [this] { return MaxSyncLagS(); });
+  registry_.GaugeFn("recovery.running", [this] {
+    return static_cast<int64_t>(recovery_ != nullptr && recovery_->running());
+  });
+  registry_.GaugeFn("recovery.chunks_fetched", [this] {
+    return recovery_ != nullptr ? recovery_->chunks_pulled() : int64_t{0};
+  });
+  registry_.GaugeFn("recovery.chunks_local", [this] {
+    return recovery_ != nullptr ? recovery_->chunks_local() : int64_t{0};
+  });
+  registry_.GaugeFn("recovery.files_recovered", [this] {
+    return recovery_ != nullptr ? recovery_->files_recovered() : int64_t{0};
+  });
+  registry_.GaugeFn("recovery.files_skipped", [this] {
+    return recovery_ != nullptr ? recovery_->files_skipped() : int64_t{0};
+  });
+}
+
+int64_t StorageServer::MaxSyncLagS() const {
+  if (sync_ == nullptr) return 0;
+  int64_t now = time(nullptr);
+  int64_t mx = 0;
+  for (const SyncPeerState& s : sync_->States()) {
+    if (s.synced_ts > 0 && now - s.synced_ts > mx) mx = now - s.synced_ts;
+  }
+  return mx;
+}
+
+std::string StorageServer::BuildStatsJson() {
+  // Per-peer replication gauges have dynamic names (peers come and go),
+  // so they are plain gauges refreshed at snapshot time; a retired
+  // peer's last values linger until restart, which monitoring treats as
+  // "last known", not a leak.
+  if (sync_ != nullptr) {
+    int64_t now = time(nullptr);
+    for (const SyncPeerState& s : sync_->States()) {
+      std::string base = "sync.peer." + s.addr;
+      registry_.SetGauge(base + ".connected", s.connected ? 1 : 0);
+      registry_.SetGauge(
+          base + ".lag_s",
+          s.synced_ts > 0 && now > s.synced_ts ? now - s.synced_ts : 0);
+      registry_.SetGauge(base + ".records_synced", s.records_synced);
+      registry_.SetGauge(base + ".records_skipped", s.records_skipped);
+    }
+  }
+  return registry_.Json();
+}
+
+void StorageServer::FillBeatStats(int64_t* out) {
+  for (int i = 0; i < kBeatStatCount; ++i) out[i] = 0;
+  stats_.Snapshot(out);  // slots [0, kPersisted)
+  out[19] = conn_count_.load();
+  out[20] = refused_conn_count_.load();
+  out[21] = MaxSyncLagS();
+  out[22] = ctr_sync_bytes_saved_wire_ != nullptr
+                ? ctr_sync_bytes_saved_wire_->load() : 0;
+  out[23] = recovery_ != nullptr ? recovery_->chunks_pulled() : 0;
+  out[24] = recovery_ != nullptr ? recovery_->chunks_local() : 0;
+  out[25] = recovery_ != nullptr ? recovery_->files_recovered() : 0;
+  out[26] = ctr_chunkfetch_batches_ != nullptr
+                ? ctr_chunkfetch_batches_->load() : 0;
+  out[27] = ctr_dedup_chunk_misses_ != nullptr
+                ? ctr_dedup_chunk_misses_->load() : 0;
 }
 
 // -- nio ------------------------------------------------------------------
@@ -661,9 +825,42 @@ void StorageServer::Respond(Conn* c, uint8_t status, const std::string& body) {
 }
 
 void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
-  if (access_log_ == nullptr || c->req_start_us == 0) return;
-  std::lock_guard<std::mutex> lk(log_mu_);
+  if (c->req_start_us == 0) return;  // one accounting pass per request
   int64_t now_us = MonoUs();
+  // Registry side (always on): per-opcode count/error/latency plus the
+  // transfer-size histograms.  Handles are pre-registered atomics —
+  // callable from nio loops and dio workers alike.
+  const OpStats& os = op_stats_[c->cmd];
+  if (os.count != nullptr) {
+    os.count->fetch_add(1, std::memory_order_relaxed);
+    if (status != 0) os.errors->fetch_add(1, std::memory_order_relaxed);
+    os.latency_us->Observe(now_us - c->req_start_us);
+  }
+  switch (static_cast<StorageCmd>(c->cmd)) {
+    case StorageCmd::kUploadFile:
+    case StorageCmd::kUploadAppenderFile:
+    case StorageCmd::kUploadSlaveFile:
+      if (status == 0 && hist_upload_bytes_ != nullptr)
+        hist_upload_bytes_->Observe(c->file_size);
+      break;
+    case StorageCmd::kDownloadFile:
+      if (status == 0 && hist_download_bytes_ != nullptr)
+        hist_download_bytes_->Observe(bytes);
+      break;
+    default:
+      break;
+  }
+  if (access_log_ == nullptr) {
+    c->req_start_us = 0;
+    c->recv_done_us = 0;
+    c->work_start_us = 0;
+    c->fp_us = 0;
+    c->fp_lock_us = 0;
+    c->cswrite_us = 0;
+    c->binlog_us = 0;
+    return;
+  }
+  std::lock_guard<std::mutex> lk(log_mu_);
   // "<epoch.sec> <client_ip> <cmd> <status> <bytes> <cost_us>
   //  <recv_us> <work_us> <fp_us> <fp_lock_us> <cswrite_us> <binlog_us>
   //  <req_bytes>" — per-stage split (SURVEY.md §5): recv = body receive
@@ -903,15 +1100,12 @@ void StorageServer::ReadConn(Conn* c) {
 void StorageServer::OnHeaderComplete(Conn* c) {
   c->pkg_len = GetInt64BE(c->header);
   c->cmd = c->header[8];
-  if (access_log_ != nullptr) {
-    // Monotonic clock for the cost pair: a wall-clock (NTP) step mid-
-    // request would log negative/garbage latencies.
-    struct timespec ts;
-    clock_gettime(CLOCK_MONOTONIC, &ts);
-    c->req_start_us =
-        static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
-    if (c->peer_ip.empty()) c->peer_ip = PeerIp(c->fd);
-  }
+  // Monotonic clock (a wall-clock/NTP step mid-request would log
+  // negative latencies).  Always stamped: the stats registry's
+  // per-opcode latency histograms run even without the access log.
+  c->req_start_us = MonoUs();
+  if (access_log_ != nullptr && c->peer_ip.empty())
+    c->peer_ip = PeerIp(c->fd);
   if (c->pkg_len < 0) {
     FDFS_LOG_WARN("negative pkg_len from %s", PeerIp(c->fd).c_str());
     CloseConn(c);
@@ -925,6 +1119,14 @@ void StorageServer::OnHeaderComplete(Conn* c) {
         return;
       }
       Respond(c, 0);
+      return;
+    case StorageCmd::kStat:
+      // Observability dump: empty body -> registry JSON snapshot.
+      if (c->pkg_len != 0) {
+        CloseConn(c);
+        return;
+      }
+      Respond(c, 0, BuildStatsJson());
       return;
     case StorageCmd::kUploadFile:
     case StorageCmd::kUploadAppenderFile:
@@ -1159,12 +1361,23 @@ void StorageServer::OnFixedComplete(Conn* c) {
     case StorageCmd::kSyncQueryChunks:
       HandleSyncQueryChunks(c);
       return;
-    case StorageCmd::kFetchRecipe:
-      HandleFetchRecipe(c);
+    case StorageCmd::kFetchRecipe: {
+      // Up to 16 MB of chunk/recipe disk reads per request: run on the
+      // file's store-path dio pool, not this nio event loop (a slow disk
+      // would stall every other connection on the loop).
+      int spi = 0;
+      if (c->fixed.size() >= 16 + 4)
+        sscanf(c->fixed.c_str() + 16, "M%02X/", &spi);
+      OffloadToDio(c, spi, [this, c] { HandleFetchRecipe(c); });
       return;
-    case StorageCmd::kFetchChunk:
-      HandleFetchChunk(c);
+    }
+    case StorageCmd::kFetchChunk: {
+      int spi = 0;
+      if (c->fixed.size() >= 24 + 4)
+        sscanf(c->fixed.c_str() + 24, "M%02X/", &spi);
+      OffloadToDio(c, spi, [this, c] { HandleFetchChunk(c); });
       return;
+    }
     default:
       Respond(c, 22);
       return;
@@ -1425,7 +1638,7 @@ void StorageServer::HandleFetchChunk(Conn* c) {
   int64_t total = 0;
   for (int64_t i = 0; i < count; ++i) {
     int64_t len = GetInt64BE(q + 8 + i * 28 + 20);
-    if (len <= 0 || len > (8 << 20)) {
+    if (len <= 0 || len > kMaxChunkPayload) {
       Respond(c, 22);
       return;
     }
@@ -1446,6 +1659,11 @@ void StorageServer::HandleFetchChunk(Conn* c) {
       return;
     }
     out += one;
+  }
+  if (ctr_chunkfetch_batches_ != nullptr) {
+    ctr_chunkfetch_batches_->fetch_add(1, std::memory_order_relaxed);
+    ctr_chunkfetch_chunks_->fetch_add(count, std::memory_order_relaxed);
+    ctr_chunkfetch_bytes_->fetch_add(total, std::memory_order_relaxed);
   }
   Respond(c, 0, out);
 }
@@ -1514,6 +1732,23 @@ void StorageServer::SyncRecipeComplete(Conn* c) {
   StoreManager::EnsureParentDirs(local);
   ChunkStore* cs = chunk_stores_[c->store_path_index].get();
   const uint8_t* entries = p + 48 + name_len;
+  // Validate every declared length BEFORE any side effects: an oversized
+  // entry (corrupt or hostile) must be rejected outright, not allowed to
+  // resize a multi-GB payload buffer on this dio worker; and no refs
+  // should be taken for a replay that is doomed anyway.
+  for (int64_t i = 0; i < n_chunks; ++i) {
+    int64_t len = GetInt64BE(entries + i * 29 + 20);
+    if (len <= 0 || len > kMaxChunkPayload) {
+      FDFS_LOG_WARN("sync recipe %s: chunk %lld declares %lld bytes "
+                    "(cap %lld): rejected", c->sync_remote.c_str(),
+                    static_cast<long long>(i), static_cast<long long>(len),
+                    static_cast<long long>(kMaxChunkPayload));
+      unlink(c->tmp_path.c_str());
+      c->tmp_path.clear();
+      Respond(c, 22);
+      return;
+    }
+  }
   int tmp_fd = open(c->tmp_path.c_str(), O_RDONLY);
   if (tmp_fd < 0) {
     unlink(c->tmp_path.c_str());
@@ -1529,13 +1764,8 @@ void StorageServer::SyncRecipeComplete(Conn* c) {
   for (int64_t i = 0; ok && i < n_chunks; ++i) {
     const uint8_t* e = entries + i * 29;
     std::string hex = BytesToHex(e, 20);
-    int64_t len = GetInt64BE(e + 20);
+    int64_t len = GetInt64BE(e + 20);  // validated above: (0, cap]
     bool needed = e[28] != 0;
-    if (len <= 0) {
-      ok = false;
-      fail_status = 22;
-      break;
-    }
     if (needed) {
       payload.resize(static_cast<size_t>(len));
       int64_t got = 0;
@@ -1543,6 +1773,19 @@ void StorageServer::SyncRecipeComplete(Conn* c) {
         ssize_t r = read(tmp_fd, payload.data() + got, len - got);
         if (r <= 0) break;
         got += r;
+      }
+      // Content-addressed store: the payload must BE its claimed digest
+      // before PutAndRef, or a bit-rotted peer chunk would poison every
+      // future dedup hit under that digest.  Failing the replay makes
+      // the sender fall back to the full-copy SYNC_CREATE_FILE.
+      if (got == len &&
+          Sha1(payload.data(), static_cast<size_t>(len)).Hex() != hex) {
+        FDFS_LOG_WARN("sync recipe %s: chunk %s failed digest check",
+                      c->sync_remote.c_str(), hex.c_str());
+        if (ctr_sync_digest_mismatch_ != nullptr)
+          ctr_sync_digest_mismatch_->fetch_add(1, std::memory_order_relaxed);
+        ok = false;
+        break;
       }
       bool existed = false;
       std::string err;
@@ -1575,6 +1818,10 @@ void StorageServer::SyncRecipeComplete(Conn* c) {
   }
   stats_.dedup_hits += hits;
   stats_.dedup_bytes_saved += saved;
+  // Wire accounting: `saved` bytes were ref'd locally instead of shipped
+  // by the replication sender — the chunk-aware protocol's whole point.
+  if (ctr_sync_bytes_saved_wire_ != nullptr)
+    ctr_sync_bytes_saved_wire_->fetch_add(saved, std::memory_order_relaxed);
   binlog_.Append('c', c->sync_remote);
   Respond(c, 0);
 }
@@ -1899,7 +2146,9 @@ void StorageServer::HandleTrunkRpc(Conn* c) {
 }
 
 bool StorageStats::SaveToFile(const std::string& path) const {
-  int64_t v[20];
+  // File keeps its historical 20-line shape (19 persisted counters + one
+  // spare) so stat files from earlier builds load unchanged.
+  int64_t v[20] = {0};
   Snapshot(v);
   std::string tmp = path + ".tmp";
   FILE* f = fopen(tmp.c_str(), "w");
@@ -2244,6 +2493,10 @@ bool StorageServer::ChunkedStoreWith(DedupPlugin* plugin,
       if (existed) {
         *saved_bytes += fp.length;
         ++*chunk_hits;
+        if (ctr_dedup_chunk_hits_ != nullptr)
+          ctr_dedup_chunk_hits_->fetch_add(1, std::memory_order_relaxed);
+      } else if (ctr_dedup_chunk_misses_ != nullptr) {
+        ctr_dedup_chunk_misses_->fetch_add(1, std::memory_order_relaxed);
       }
       recipe.chunks.push_back({fp.digest_hex, fp.length});
     }

@@ -12,6 +12,7 @@
 
 #include <sys/epoll.h>
 
+#include <array>
 #include <atomic>
 #include <cstdio>
 #include <cstdint>
@@ -23,6 +24,7 @@
 #include <unordered_set>
 #include <vector>
 
+#include "common/stats.h"
 #include "common/workers.h"
 
 #include "common/bytes.h"
@@ -61,8 +63,14 @@ struct StorageStats {
   bool SaveToFile(const std::string& path) const;
   bool LoadFromFile(const std::string& path);
 
-  // Beat-blob layout (shared contract with tracker/cluster.cc JSON).
-  void Snapshot(int64_t out[20]) const {
+  // Restart-persisted slot count: slots [0, kPersisted) of the beat blob
+  // (protocol_gen.h kBeatStatNames) come from this struct; the server's
+  // beat callback fills the live slots above it.
+  static constexpr int kPersisted = 19;
+
+  // Beat-blob prefix (shared contract with tracker/cluster.cc JSON).
+  // Writes exactly kPersisted slots; the caller owns the rest.
+  void Snapshot(int64_t* out) const {
     out[0] = total_upload; out[1] = success_upload;
     out[2] = total_download; out[3] = success_download;
     out[4] = total_delete; out[5] = success_delete;
@@ -72,7 +80,7 @@ struct StorageStats {
     out[12] = total_query; out[13] = success_query;
     out[14] = bytes_uploaded; out[15] = bytes_downloaded;
     out[16] = dedup_hits; out[17] = dedup_bytes_saved;
-    out[18] = last_source_update; out[19] = 0;
+    out[18] = last_source_update;
   }
 };
 
@@ -86,6 +94,7 @@ class StorageServer {
   void Stop();
   EventLoop& loop() { return loop_; }
   const StorageStats& stats() const { return stats_; }
+  StatsRegistry& registry() { return registry_; }
   const StorageConfig& config() const { return cfg_; }
   BinlogWriter& binlog() { return binlog_; }
   TrackerReporter* reporter() { return reporter_.get(); }
@@ -207,7 +216,22 @@ class StorageServer {
                    int64_t count);
   // Access log (storage.conf:use_access_log; reference: the per-request
   // "op client_ip status bytes cost_us" lines storage_service.c emits).
+  // Also the per-request accounting choke point: every response path runs
+  // through here exactly once (req_start_us guards re-entry), so the
+  // stats registry's per-opcode counters and latency histograms update
+  // here regardless of whether the access log is enabled.
   void LogAccess(Conn* c, uint8_t status, int64_t bytes);
+
+  // -- stats registry (common/stats.h; STAT opcode) ----------------------
+  // Pre-register per-opcode counters/histograms and the gauge mirrors of
+  // live state so hot paths only touch cached atomic pointers.
+  void InitStatsRegistry();
+  // Refresh snapshot-time gauges (per-peer sync lag) and serialize.
+  std::string BuildStatsJson();
+  // Beat callback: persisted prefix from stats_, live slots from the
+  // registry/subsystems (fills kBeatStatCount slots).
+  void FillBeatStats(int64_t* out);
+  int64_t MaxSyncLagS() const;
 
   // -- dispatch ----------------------------------------------------------
   void OnHeaderComplete(Conn* c);
@@ -337,6 +361,24 @@ class StorageServer {
   std::unordered_set<std::string> busy_files_;  // remote names being mutated
   std::mutex log_mu_;                   // access_log_ writes
   StorageStats stats_;
+  // Named-stat registry behind the STAT opcode.  Per-opcode handles are
+  // indexed by the raw cmd byte (O(1), no lock on the request path).
+  StatsRegistry registry_;
+  struct OpStats {
+    std::atomic<int64_t>* count = nullptr;
+    std::atomic<int64_t>* errors = nullptr;
+    StatHistogram* latency_us = nullptr;
+  };
+  std::array<OpStats, 256> op_stats_{};
+  StatHistogram* hist_upload_bytes_ = nullptr;
+  StatHistogram* hist_download_bytes_ = nullptr;
+  std::atomic<int64_t>* ctr_sync_bytes_saved_wire_ = nullptr;
+  std::atomic<int64_t>* ctr_sync_digest_mismatch_ = nullptr;
+  std::atomic<int64_t>* ctr_chunkfetch_batches_ = nullptr;
+  std::atomic<int64_t>* ctr_chunkfetch_chunks_ = nullptr;
+  std::atomic<int64_t>* ctr_chunkfetch_bytes_ = nullptr;
+  std::atomic<int64_t>* ctr_dedup_chunk_hits_ = nullptr;
+  std::atomic<int64_t>* ctr_dedup_chunk_misses_ = nullptr;
   std::string my_ip_;
 
   // Trunk state (cluster-global params from the tracker; SURVEY §2.3).

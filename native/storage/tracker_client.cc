@@ -356,9 +356,9 @@ bool TrackerReporter::DoBeat(int fd, int64_t* chlog_off) {
   PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
   PutFixedField(&body, my_ip(), kIpAddressSize);
   AppendInt64(&body, cfg_.port);
-  int64_t stats[20] = {0};
+  int64_t stats[kBeatStatCount] = {0};
   if (stats_fn_) stats_fn_(stats);
-  for (int i = 0; i < 20; ++i) AppendInt64(&body, stats[i]);
+  for (int i = 0; i < kBeatStatCount; ++i) AppendInt64(&body, stats[i]);
   std::string resp;
   uint8_t status;
   if (!Rpc(fd, static_cast<uint8_t>(TrackerCmd::kStorageBeat), body, &resp,

@@ -29,8 +29,9 @@ struct PeerInfo {
   }
 };
 
-// Thread-safe stat snapshot provider (filled by the nio loop).
-using StatsSnapshotFn = std::function<void(int64_t out[20])>;
+// Thread-safe stat snapshot provider: fills kBeatStatCount slots
+// (protocol_gen.h kBeatStatNames) for the beat blob.
+using StatsSnapshotFn = std::function<void(int64_t* out)>;
 using PeersCallback = std::function<void(const std::vector<PeerInfo>&)>;
 
 class TrackerReporter {

@@ -9,6 +9,7 @@
 #include "common/fileid.h"
 #include "common/ini.h"
 #include "common/protocol_gen.h"
+#include "common/stats.h"
 
 static int g_failures = 0;
 
@@ -153,7 +154,46 @@ static void TestProtocolConstants() {
   CHECK_EQ(static_cast<int>(TrackerCmd::kServiceQueryStoreWithoutGroupOne), 101);
   CHECK_EQ(static_cast<int>(StorageCmd::kUploadFile), 11);
   CHECK_EQ(static_cast<int>(StorageCmd::kResp), 100);
+  CHECK_EQ(static_cast<int>(StorageCmd::kStat), 130);
+  CHECK_EQ(static_cast<int>(TrackerCmd::kServerClusterStat), 95);
   CHECK_EQ(kHeaderSize, 10);
+  // Beat-blob naming contract: one name per slot, the named headline
+  // stats present (the Python side asserts the same list).
+  CHECK_EQ(kBeatStatCount, 28);
+  CHECK_EQ(std::string(kBeatStatNames[0]), std::string("total_upload"));
+  CHECK_EQ(std::string(kBeatStatNames[17]),
+           std::string("dedup_bytes_saved"));
+  CHECK_EQ(std::string(kBeatStatNames[21]), std::string("sync_lag_s"));
+  CHECK_EQ(std::string(kBeatStatNames[23]),
+           std::string("recovery_chunks_fetched"));
+}
+
+static void TestStatsRegistry() {
+  StatsRegistry reg;
+  reg.Counter("a.count")->fetch_add(3);
+  CHECK_EQ(reg.Counter("a.count")->load(), 3);  // find-or-create finds
+  reg.SetGauge("g", 42);
+  reg.GaugeFn("g.fn", [] { return int64_t{7}; });
+  StatHistogram* h = reg.Histogram("h", {10, 100, 1000});
+  h->Observe(5);
+  h->Observe(10);    // inclusive upper bound: first bucket
+  h->Observe(11);    // second bucket
+  h->Observe(5000);  // overflow
+  CHECK_EQ(h->count(), 4);
+  CHECK_EQ(h->sum(), 5 + 10 + 11 + 5000);
+  CHECK_EQ(h->bucket_count(0), 2);
+  CHECK_EQ(h->bucket_count(1), 1);
+  CHECK_EQ(h->bucket_count(2), 0);
+  CHECK_EQ(h->bucket_count(3), 1);
+  std::string json = reg.Json();
+  // Shape spot-checks (the full field-for-field check is the
+  // cross-language golden test via `fdfs_codec stats-json`).
+  CHECK(json.find("\"counters\":{\"a.count\":3}") != std::string::npos);
+  CHECK(json.find("\"g\":42") != std::string::npos);
+  CHECK(json.find("\"g.fn\":7") != std::string::npos);
+  CHECK(json.find("\"bounds\":[10,100,1000]") != std::string::npos);
+  CHECK(json.find("\"counts\":[2,1,0,1]") != std::string::npos);
+  CHECK(json.find("\"sum\":5026") != std::string::npos);
 }
 
 int main() {
@@ -165,6 +205,7 @@ int main() {
   TestLocalPath();
   TestIni();
   TestProtocolConstants();
+  TestStatsRegistry();
   if (g_failures == 0) {
     std::printf("common_test: ALL PASS\n");
     return 0;

@@ -1,0 +1,77 @@
+// Tracker-side unit tests (no gtest in the image — plain CHECK macros):
+// the cluster brain's observability surface.  A beat's 28-slot stat blob
+// must round-trip into ClusterStatJson under the generated field names —
+// the same JSON the Python monitor decodes (tests/test_monitor.py drives
+// the live-socket version of this).
+#include <cstdio>
+#include <string>
+
+#include "common/protocol_gen.h"
+#include "tracker/cluster.h"
+
+static int g_failures = 0;
+
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      ++g_failures;                                                        \
+    }                                                                      \
+  } while (0)
+
+#define CHECK_EQ(a, b) CHECK((a) == (b))
+
+using namespace fdfs;
+
+static void TestBeatStatsRoundTripJson() {
+  Cluster c;
+  CHECK(c.Join("group1", "10.0.0.1", 23000, 1, /*now=*/1000).has_value());
+  int64_t stats[kBeatStatCount];
+  for (int i = 0; i < kBeatStatCount; ++i) stats[i] = 100 + i;
+  CHECK(c.Beat("group1", "10.0.0.1", 23000, stats, kBeatStatCount, 1001));
+  CHECK(c.UpdateDiskUsage("group1", "10.0.0.1", 23000, 5000, 4000));
+
+  std::string json = c.ClusterStatJson(/*now=*/1003);
+  // Liveness: status name + beat age derived from last_beat.
+  CHECK(json.find("\"status_name\":\"ACTIVE\"") != std::string::npos);
+  CHECK(json.find("\"beat_age_s\":2") != std::string::npos);
+  CHECK(json.find("\"free_mb\":4000") != std::string::npos);
+  // Every beat slot appears under its generated name with its value.
+  for (int i = 0; i < kBeatStatCount; ++i) {
+    std::string want = std::string("\"") + kBeatStatNames[i] +
+                       "\":" + std::to_string(100 + i);
+    CHECK(json.find(want) != std::string::npos);
+  }
+  // Group filter.
+  CHECK_EQ(c.ClusterStatJson(1003, "nope"), std::string("[]"));
+  CHECK(c.ClusterStatJson(1003, "group1").find("group1") !=
+        std::string::npos);
+}
+
+static void TestShortBeatKeepsTail() {
+  // Append-only wire contract: an older storage's shorter blob must not
+  // zero the tail slots a newer beat already populated.
+  Cluster c;
+  CHECK(c.Join("g", "10.0.0.2", 23000, 1, 1000).has_value());
+  int64_t full[kBeatStatCount];
+  for (int i = 0; i < kBeatStatCount; ++i) full[i] = 7;
+  CHECK(c.Beat("g", "10.0.0.2", 23000, full, kBeatStatCount, 1001));
+  int64_t short20[20];
+  for (int i = 0; i < 20; ++i) short20[i] = 9;
+  CHECK(c.Beat("g", "10.0.0.2", 23000, short20, 20, 1002));
+  std::string json = c.ClusterStatJson(1002);
+  CHECK(json.find("\"total_upload\":9") != std::string::npos);
+  std::string tail = std::string("\"") + kBeatStatNames[20] + "\":7";
+  CHECK(json.find(tail) != std::string::npos);
+}
+
+int main() {
+  TestBeatStatsRoundTripJson();
+  TestShortBeatKeepsTail();
+  if (g_failures == 0) {
+    std::printf("tracker_test: ALL PASS\n");
+    return 0;
+  }
+  std::printf("tracker_test: %d FAILURES\n", g_failures);
+  return 1;
+}

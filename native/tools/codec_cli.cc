@@ -14,6 +14,9 @@
 //   fdfs_codec cdc <min> <avg_bits> <max> [seg]  (stdin -> cut offsets,
 //                one per line; seg tests the streaming chunker by feeding
 //                seg-byte segments)
+//   fdfs_codec stats-json      (golden stats-registry snapshot: fixed
+//                counters/gauges/histogram observations -> JSON, compared
+//                field-for-field against the Python decoder)
 #include <time.h>
 
 #include <cstdio>
@@ -26,6 +29,7 @@
 #include "common/cdc.h"
 #include "common/fileid.h"
 #include "common/http_token.h"
+#include "common/stats.h"
 
 using namespace fdfs;
 
@@ -164,6 +168,25 @@ int main(int argc, char** argv) {
     }
     printf("{\"bytes\": %zu, \"cuts\": %zu, \"GBps\": %.4f}\n", data.size(),
            cuts, best);
+    return 0;
+  }
+  if (cmd == "stats-json") {
+    // Fixed fixture — tests/test_monitor.py builds the same registry in
+    // Python and asserts every field decodes identically.
+    StatsRegistry reg;
+    reg.Counter("op.upload_file.count")->store(7);
+    reg.Counter("op.download_file.count")->store(3);
+    reg.Counter("sync.bytes_saved_wire")->store(1048576);
+    reg.SetGauge("server.connections", 2);
+    reg.SetGauge("sync.peer.127.0.0.1:23000.lag_s", 4);
+    reg.GaugeFn("store.total_upload", [] { return int64_t{9}; });
+    StatHistogram* h = reg.Histogram("op.upload_file.latency_us",
+                                     StatsRegistry::LatencyBucketsUs());
+    h->Observe(100);      // first bucket (inclusive bound)
+    h->Observe(101);      // second bucket
+    h->Observe(90000);    // 100000 bucket
+    h->Observe(99999999); // overflow
+    printf("%s\n", reg.Json().c_str());
     return 0;
   }
   if (cmd == "b64e" && argc == 3) {

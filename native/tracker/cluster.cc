@@ -112,7 +112,7 @@ std::vector<StorageNode> Cluster::Peers(const std::string& group,
 }
 
 bool Cluster::Beat(const std::string& group, const std::string& ip, int port,
-                   const int64_t* stats, int64_t now) {
+                   const int64_t* stats, int nstats, int64_t now) {
   StorageNode* n = FindNode(group, ip + ":" + std::to_string(port));
   if (n == nullptr) return false;  // must JOIN first
   n->last_beat = now;
@@ -122,8 +122,10 @@ bool Cluster::Beat(const std::string& group, const std::string& ip, int port,
   }
   // A beat never promotes a full-syncing server — only sync progress does.
   if (n->status != kWaitSync && n->status != kSyncing) n->status = kActive;
-  if (stats != nullptr)
-    memcpy(n->stats, stats, sizeof(int64_t) * kBeatStatCount);
+  if (stats != nullptr && nstats > 0) {
+    if (nstats > kBeatStatCount) nstats = kBeatStatCount;
+    memcpy(n->stats, stats, sizeof(int64_t) * nstats);
+  }
   return true;
 }
 
@@ -592,6 +594,92 @@ std::string Cluster::StoragesJson(const std::string& group) const {
       first = false;
       AppendStorageJson(&out, s, StorageIdForIp(s.ip));
     }
+  }
+  return out + "]";
+}
+
+// Group names, trunk addrs, and storage ids arrive off the wire as
+// arbitrary bytes; interpolating them raw would let one hostile JOIN
+// break cluster_stat's JSON for every monitor client.
+static std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char ch : s) {
+    if (ch == '"' || ch == '\\') {
+      out.push_back('\\');
+      out.push_back(ch);
+    } else if (static_cast<unsigned char>(ch) < 0x20) {
+      char buf[8];
+      std::snprintf(buf, sizeof(buf), "\\u%04x", ch & 0xFF);
+      out += buf;
+    } else {
+      out.push_back(ch);
+    }
+  }
+  return out;
+}
+
+static const char* StatusName(int status) {
+  switch (static_cast<StorageStatus>(status)) {
+    case StorageStatus::kInit: return "INIT";
+    case StorageStatus::kWaitSync: return "WAIT_SYNC";
+    case StorageStatus::kSyncing: return "SYNCING";
+    case StorageStatus::kIpChanged: return "IP_CHANGED";
+    case StorageStatus::kDeleted: return "DELETED";
+    case StorageStatus::kOffline: return "OFFLINE";
+    case StorageStatus::kOnline: return "ONLINE";
+    case StorageStatus::kActive: return "ACTIVE";
+    case StorageStatus::kRecovery: return "RECOVERY";
+    default: return "UNKNOWN";
+  }
+}
+
+std::string Cluster::ClusterStatJson(int64_t now,
+                                     const std::string& group) const {
+  std::string out = "[";
+  bool gfirst = true;
+  char buf[512];
+  for (const auto& [gname, g] : groups_) {
+    if (!group.empty() && gname != group) continue;
+    if (!gfirst) out += ",";
+    gfirst = false;
+    std::snprintf(buf, sizeof(buf),
+                  "{\"name\":\"%s\",\"members\":%zu,\"active\":%d,"
+                  "\"free_mb\":%lld,\"trunk_server\":\"%s\","
+                  "\"trunk_epoch\":%lld,\"storages\":[",
+                  JsonEscape(g.name).c_str(), g.storages.size(),
+                  g.ActiveCount(), static_cast<long long>(g.FreeMb()),
+                  JsonEscape(g.trunk_addr).c_str(),
+                  static_cast<long long>(g.trunk_epoch));
+    out += buf;
+    bool sfirst = true;
+    for (const auto& [addr, s] : g.storages) {
+      if (!sfirst) out += ",";
+      sfirst = false;
+      std::snprintf(
+          buf, sizeof(buf),
+          "{\"id\":\"%s\",\"ip\":\"%s\",\"port\":%d,\"status\":%d,"
+          "\"status_name\":\"%s\",\"store_paths\":%d,\"join_time\":%lld,"
+          "\"last_beat\":%lld,\"beat_age_s\":%lld,\"total_mb\":%lld,"
+          "\"free_mb\":%lld,\"stats\":{",
+          JsonEscape(StorageIdForIp(s.ip)).c_str(),
+          JsonEscape(s.ip).c_str(), s.port, s.status,
+          StatusName(s.status), s.store_path_count,
+          static_cast<long long>(s.join_time),
+          static_cast<long long>(s.last_beat),
+          static_cast<long long>(s.last_beat > 0 ? now - s.last_beat : -1),
+          static_cast<long long>(s.total_mb),
+          static_cast<long long>(s.free_mb));
+      out += buf;
+      for (int i = 0; i < kBeatStatCount; ++i) {
+        std::snprintf(buf, sizeof(buf), "%s\"%s\":%lld", i ? "," : "",
+                      kBeatStatNames[i],
+                      static_cast<long long>(s.stats[i]));
+        out += buf;
+      }
+      out += "}}";
+    }
+    out += "]}";
   }
   return out + "]";
 }

@@ -23,9 +23,9 @@
 #include <string>
 #include <vector>
 
-namespace fdfs {
+#include "common/protocol_gen.h"  // kBeatStatCount / kBeatStatNames
 
-constexpr int kBeatStatCount = 20;  // int64 slots in the beat stats blob
+namespace fdfs {
 
 // sync_until_ts value marking a disk-recovery hold: promotion waits for the
 // node's explicit done-notify (or a healthy re-JOIN), never sync reports.
@@ -93,8 +93,10 @@ class Cluster {
                                                int store_path_count,
                                                int64_t now,
                                                bool recovering = false);
+  // `stats` carries `nstats` beat slots (<= kBeatStatCount); a shorter
+  // blob from an older storage leaves the tail slots untouched.
   bool Beat(const std::string& group, const std::string& ip, int port,
-            const int64_t* stats, int64_t now);
+            const int64_t* stats, int nstats, int64_t now);
   bool UpdateDiskUsage(const std::string& group, const std::string& ip,
                        int port, int64_t total_mb, int64_t free_mb);
   // Source "src" reports dest has synced its binlog through ts.
@@ -171,6 +173,11 @@ class Cluster {
   std::string GroupsJson() const;
   std::string OneGroupJson(const std::string& group) const;
   std::string StoragesJson(const std::string& group) const;
+  // Full observability dump (SERVER_CLUSTER_STAT): every group with its
+  // capacity and every storage with liveness (status, beat age) and the
+  // complete named last-beat stat payload (kBeatStatNames).  `group`
+  // filters to one group when non-empty.
+  std::string ClusterStatJson(int64_t now, const std::string& group = "") const;
 
   // -- persistence (tracker_save_storages analogue) ----------------------
   bool Save(const std::string& path) const;

@@ -258,12 +258,16 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       int64_t port = GetInt64BE(p + 32);
       int64_t stats[kBeatStatCount] = {0};
       const int64_t* sp = nullptr;
-      if (body.size() >= 40 + 8 * kBeatStatCount) {
-        for (int i = 0; i < kBeatStatCount; ++i)
+      // Accept shorter blobs from older storages (append-only contract);
+      // missing tail slots stay at their last value.
+      int nstats = static_cast<int>(
+          std::min<size_t>((body.size() - 40) / 8, kBeatStatCount));
+      if (nstats > 0) {
+        for (int i = 0; i < nstats; ++i)
           stats[i] = GetInt64BE(p + 40 + 8 * i);
         sp = stats;
       }
-      if (!cluster_->Beat(group, ip, static_cast<int>(port), sp, now))
+      if (!cluster_->Beat(group, ip, static_cast<int>(port), sp, nstats, now))
         return {2, ""};  // unknown: storage must re-JOIN
       auto peers = cluster_->Peers(group, ip + ":" + std::to_string(port));
       // Trailer: the group's elected trunk server (zeros when trunk is
@@ -549,6 +553,25 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
 
     case TrackerCmd::kServerListAllGroups:
       return {0, cluster_->GroupsJson()};
+
+    case TrackerCmd::kServerClusterStat: {
+      // One-RPC observability dump: tracker role + every group/storage
+      // with the full named last-beat stat payload.  Optional 16B group
+      // filter in the body.
+      std::string group = body.size() >= 16 ? FixedGroup(p) : "";
+      std::string leader =
+          relationship_ != nullptr ? relationship_->leader_addr() : "";
+      char head[256];
+      std::snprintf(head, sizeof(head),
+                    "{\"now\":%lld,\"tracker\":{\"am_leader\":%s,"
+                    "\"leader\":\"%s\",\"groups\":%zu},\"groups\":",
+                    static_cast<long long>(now),
+                    relationship_ != nullptr && relationship_->am_leader()
+                        ? "true" : "false",
+                    leader.c_str(), cluster_->group_count());
+      return {0, std::string(head) + cluster_->ClusterStatJson(now, group) +
+                     "}"};
+    }
 
     case TrackerCmd::kServerListStorage: {
       if (body.size() < 16) return {22, ""};

@@ -62,19 +62,30 @@ def wait_port(port: int, timeout: float = 10.0, host: str = "127.0.0.1") -> None
 class Daemon:
     def __init__(self, binary: str, conf_path: str, port: int,
                  ip: str = "127.0.0.1"):
-        self.proc = subprocess.Popen(
-            [binary, conf_path],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        # Daemon output goes to FILES, never PIPE: with log_level=debug
+        # the daemons log to stderr, and an undrained 64 KB pipe buffer
+        # eventually BLOCKS the daemon mid-write (heartbeats stall, the
+        # tracker marks it OFFLINE, and tests that pass in isolation —
+        # fewer log lines — flake under suite load).
+        self._out_path = conf_path + ".stdout"
+        self._err_path = conf_path + ".stderr"
+        with open(self._out_path, "ab") as out_f, \
+                open(self._err_path, "ab") as err_f:
+            self.proc = subprocess.Popen(
+                [binary, conf_path], stdout=out_f, stderr=err_f)
         self.port = port
         self.ip = ip
         try:
-            wait_port(port, host=ip)
+            # Generous under suite load: a busy machine (sidecar JAX
+            # compiles in sibling tests) can stretch daemon startup well
+            # past an unloaded run's.
+            wait_port(port, host=ip, timeout=30.0)
         except TimeoutError:
             self.proc.kill()
-            out, err = self.proc.communicate()
+            self.proc.wait()
             raise RuntimeError(
-                f"daemon failed to start:\nstdout: {out.decode()}\n"
-                f"stderr: {err.decode()}")
+                f"daemon failed to start:\nstdout: {self.stdout_text}\n"
+                f"stderr: {self.stderr_text}")
 
     def stop(self) -> None:
         if self.proc.poll() is None:
@@ -85,9 +96,20 @@ class Daemon:
                 self.proc.kill()
                 self.proc.wait()
 
+    def _read(self, path: str) -> str:
+        try:
+            with open(path, "rb") as fh:
+                return fh.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    @property
+    def stdout_text(self) -> str:
+        return self._read(self._out_path)
+
     @property
     def stderr_text(self) -> str:
-        return self.proc.stderr.read().decode() if self.proc.stderr else ""
+        return self._read(self._err_path)
 
 
 def make_storage_conf(base_dir: str, port: int, group: str = "group1",

@@ -1,0 +1,335 @@
+"""Cluster observability: stats registry, STAT opcodes, monitor CLI.
+
+Three layers:
+- pure-Python contract tests (beat-stat naming, registry decoding,
+  Prometheus exposition format) — run everywhere;
+- a cross-language golden test: the C++ registry's JSON snapshot
+  (fdfs_codec stats-json) must decode field-for-field in Python;
+- integration: a live tracker+storage pair, a scripted
+  upload/download/delete run, and the assertion that the per-opcode
+  counters, dedup gauges, and the monitor CLI all show it.
+"""
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fastdfs_tpu import monitor as M
+from fastdfs_tpu.common.protocol import BEAT_STAT_COUNT, BEAT_STAT_FIELDS
+from tests.harness import (BUILD, REPO, STORAGED, TRACKERD, start_storage,
+                           start_tracker, upload_retry)
+
+_HAVE_TOOLCHAIN = (shutil.which("cmake") is not None
+                   and shutil.which("ninja") is not None)
+_HAVE_BINARIES = os.path.exists(STORAGED) and os.path.exists(TRACKERD)
+needs_native = pytest.mark.skipif(
+    not (_HAVE_TOOLCHAIN or _HAVE_BINARIES),
+    reason="no native toolchain and no prebuilt daemons")
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+
+
+# ---------------------------------------------------------------------------
+# beat-stat naming contract
+# ---------------------------------------------------------------------------
+
+def test_beat_stat_fields_shape():
+    assert BEAT_STAT_COUNT == len(BEAT_STAT_FIELDS) == 28
+    assert len(set(BEAT_STAT_FIELDS)) == BEAT_STAT_COUNT  # no dup names
+    # The issue's headline stats are first-class named fields, not logs.
+    for required in ("dedup_bytes_saved", "sync_lag_s",
+                     "recovery_chunks_fetched", "sync_bytes_saved_wire"):
+        assert required in BEAT_STAT_FIELDS
+
+
+def test_beat_stats_tolerates_short_and_long_vectors():
+    named = M.beat_stats([1, 2, 3])
+    assert named["total_upload"] == 1
+    assert named["success_upload"] == 2
+    assert named["dedup_chunk_misses"] == 0  # missing tail reads 0
+    named = M.beat_stats(list(range(BEAT_STAT_COUNT + 5)))  # future fields
+    assert named["dedup_chunk_misses"] == BEAT_STAT_COUNT - 1
+
+
+# ---------------------------------------------------------------------------
+# registry decoding
+# ---------------------------------------------------------------------------
+
+def _sample_registry() -> dict:
+    return {
+        "counters": {"op.upload_file.count": 4, "op.upload_file.errors": 1},
+        "gauges": {"server.connections": 2, "sync.peer.10.0.0.2:23000.lag_s": 7},
+        "histograms": {
+            "op.upload_file.latency_us": {
+                "bounds": [100, 1000, 10000],
+                "counts": [1, 2, 0, 1],
+                "sum": 120000,
+                "count": 4,
+            },
+        },
+    }
+
+
+def test_decode_registry_roundtrip():
+    reg = M.decode_registry(_sample_registry())
+    assert reg["counters"]["op.upload_file.count"] == 4
+    assert reg["histograms"]["op.upload_file.latency_us"]["count"] == 4
+
+
+def test_decode_registry_rejects_malformed():
+    bad = _sample_registry()
+    bad["histograms"]["op.upload_file.latency_us"]["counts"] = [1, 2, 0]
+    with pytest.raises(ValueError):
+        M.decode_registry(bad)
+    bad = _sample_registry()
+    bad["counters"]["x"] = "nope"
+    with pytest.raises(ValueError):
+        M.decode_registry(bad)
+    bad = _sample_registry()
+    bad["histograms"]["op.upload_file.latency_us"]["count"] = 99
+    with pytest.raises(ValueError):
+        M.decode_registry(bad)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})? (-?[0-9.]+(?:e[+-]?\d+)?)$')
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                      r"(counter|gauge|histogram|summary|untyped)$")
+
+
+def parse_exposition(text: str) -> dict[str, list[tuple[str, float]]]:
+    """Minimal strict parser for the Prometheus text format: every line
+    must be a TYPE comment or a well-formed sample."""
+    series: dict[str, list[tuple[str, float]]] = {}
+    typed: set[str] = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _TYPE_RE.match(line)
+            assert m, f"bad comment line: {line!r}"
+            # Real scrapers reject a second TYPE line for the same name.
+            assert m.group(1) not in typed, f"duplicate TYPE: {line!r}"
+            typed.add(m.group(1))
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, labels, value = m.group(1), m.group(2) or "", float(m.group(3))
+        if labels:
+            for lab in re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"', labels):
+                assert lab[0]
+        series.setdefault(name, []).append((labels, value))
+    return series
+
+
+def _snapshot() -> M.ClusterSnapshot:
+    stats = {name: 0 for name in BEAT_STAT_FIELDS}
+    stats.update(total_upload=5, success_upload=5, dedup_bytes_saved=1 << 20,
+                 sync_lag_s=3, recovery_chunks_fetched=11,
+                 recovery_chunks_local=29, sync_bytes_saved_wire=512)
+    return M.ClusterSnapshot(
+        now=1700000000,
+        tracker={"am_leader": True, "leader": "127.0.0.1:22122", "groups": 1},
+        groups=[{
+            "name": "group1", "members": 1, "active": 1, "free_mb": 1000,
+            "trunk_server": "",
+            "storages": [{
+                "ip": "127.0.0.1", "port": 23000, "status": 7,
+                "status_name": "ACTIVE", "beat_age_s": 1,
+                "total_mb": 2000, "free_mb": 1000, "stats": stats,
+            }],
+        }],
+        storage_stats={"127.0.0.1:23000": M.decode_registry(_sample_registry())},
+    )
+
+
+def test_prometheus_exposition_parses():
+    text = M.to_prometheus(_snapshot())
+    series = parse_exposition(text)
+    assert series["fdfs_tracker_is_leader"][0][1] == 1.0
+    assert series["fdfs_group_free_mb"][0] == ('{group="group1"}', 1000.0)
+    # Every beat field is exported per-storage with group+storage labels.
+    for fname in BEAT_STAT_FIELDS:
+        assert f"fdfs_storage_{fname}" in series, fname
+    assert series["fdfs_storage_dedup_bytes_saved"][0][1] == float(1 << 20)
+    assert series["fdfs_storage_sync_lag_s"][0][1] == 3.0
+    assert series["fdfs_storage_recovery_chunks_fetched"][0][1] == 11.0
+    # Registry metrics carry the storage label; histograms are cumulative.
+    assert series["fdfs_op_upload_file_count"][0][1] == 4.0
+    buckets = series["fdfs_op_upload_file_latency_us_bucket"]
+    values = [v for _, v in buckets]
+    assert values == sorted(values), "histogram buckets must be cumulative"
+    assert values[-1] == 4.0  # +Inf == count
+    assert series["fdfs_op_upload_file_latency_us_count"][0][1] == 4.0
+    assert series["fdfs_op_upload_file_latency_us_sum"][0][1] == 120000.0
+
+
+def test_prometheus_multi_storage_groups_by_metric_name():
+    # Two storages sharing registry metric names must still yield exactly
+    # one TYPE line per metric (parse_exposition rejects duplicates).
+    snap = _snapshot()
+    snap.storage_stats["127.0.0.2:23000"] = M.decode_registry(
+        _sample_registry())
+    series = parse_exposition(M.to_prometheus(snap))
+    assert len(series["fdfs_op_upload_file_count"]) == 2
+    assert len(series["fdfs_op_upload_file_latency_us_count"]) == 2
+
+
+def test_render_text_mentions_capacity_liveness_and_ops():
+    text = M.render_text(_snapshot())
+    assert "Group: group1" in text and "free=1000MB" in text
+    assert "ACTIVE" in text and "beat_age=1s" in text
+    assert "upload_file=4" in text  # per-opcode counter surfaced
+    assert "wire_saved=512B" in text
+    assert "recovery=11f/29l" in text
+
+
+# ---------------------------------------------------------------------------
+# cross-language golden: native registry JSON == Python decoder view
+# ---------------------------------------------------------------------------
+
+_LATENCY_BOUNDS = [100, 250, 500, 1000, 2500, 5000, 10000, 25000, 50000,
+                   100000, 250000, 500000, 1000000, 2500000, 5000000,
+                   10000000]
+
+
+def _ensure_codec() -> str:
+    codec = os.path.join(BUILD, "fdfs_codec")
+    # tracker_test is the staleness sentinel: an old build tree has the
+    # codec binary but not the stats-json subcommand this test drives.
+    if not (os.path.exists(codec)
+            and os.path.exists(os.path.join(BUILD, "tracker_test"))):
+        subprocess.run(["cmake", "-S", os.path.join(REPO, "native"), "-B",
+                        BUILD, "-G", "Ninja"], check=True, capture_output=True)
+        subprocess.run(["ninja", "-C", BUILD], check=True, capture_output=True)
+    return codec
+
+
+@needs_native
+def test_native_stats_json_golden():
+    codec = _ensure_codec()
+    out = subprocess.run([codec, "stats-json"], capture_output=True,
+                         check=True)
+    reg = M.decode_registry(json.loads(out.stdout))
+    assert reg["counters"] == {
+        "op.upload_file.count": 7,
+        "op.download_file.count": 3,
+        "sync.bytes_saved_wire": 1048576,
+    }
+    assert reg["gauges"] == {
+        "server.connections": 2,
+        "store.total_upload": 9,           # gauge-fn, evaluated at snapshot
+        "sync.peer.127.0.0.1:23000.lag_s": 4,
+    }
+    h = reg["histograms"]["op.upload_file.latency_us"]
+    assert h["bounds"] == _LATENCY_BOUNDS
+    expect = [0] * (len(_LATENCY_BOUNDS) + 1)
+    expect[0] = 1    # 100 lands in the inclusive first bucket
+    expect[1] = 1    # 101 spills to the second
+    expect[9] = 1    # 90000 <= 100000
+    expect[-1] = 1   # 99999999 overflows
+    assert h["counts"] == expect
+    assert h["sum"] == 100 + 101 + 90000 + 99999999
+    assert h["count"] == 4
+    # And the exposition built from it parses.
+    snap = M.ClusterSnapshot(storage_stats={"127.0.0.1:23000": reg})
+    parse_exposition(M.to_prometheus(snap))
+
+
+# ---------------------------------------------------------------------------
+# integration: live daemons, scripted traffic, monitor CLI
+# ---------------------------------------------------------------------------
+
+def _wait(cond, timeout=30, interval=0.3):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(interval)
+    return None
+
+
+@needs_native
+def test_stat_opcodes_and_monitor_cli(tmp_path):
+    from fastdfs_tpu.client import FdfsClient, StorageClient, TrackerClient
+
+    _ensure_codec()  # rebuild a pre-stats build tree before daemons start
+    tracker = start_tracker(os.path.join(str(tmp_path), "tr"))
+    taddr = f"127.0.0.1:{tracker.port}"
+    storage = start_storage(os.path.join(str(tmp_path), "st"),
+                            trackers=[taddr], dedup_mode="cpu", extra=HB)
+    cli = FdfsClient([taddr])
+    try:
+        data = os.urandom(30000)
+        fid = upload_retry(cli, data, ext="bin")
+        dup = cli.upload_buffer(data, ext="bin")   # whole-file dedup hit
+        assert cli.download_to_buffer(fid) == data
+        cli.delete_file(dup)
+
+        # -- storage-side STAT: per-opcode counters + latency histograms
+        with StorageClient("127.0.0.1", storage.port) as sc:
+            reg = M.decode_registry(sc.stat())
+        c = reg["counters"]
+        assert c["op.upload_file.count"] >= 2
+        assert c["op.download_file.count"] >= 1
+        assert c["op.delete_file.count"] >= 1
+        h = reg["histograms"]["op.upload_file.latency_us"]
+        assert h["count"] >= 2 and h["sum"] > 0
+        assert reg["histograms"]["upload.size_bytes"]["count"] >= 2
+        # dedup verdict: named gauges moved, not just log lines
+        assert reg["gauges"]["store.dedup_hits"] >= 1
+        assert reg["gauges"]["store.dedup_bytes_saved"] >= len(data)
+
+        # -- tracker-side cluster stat: capacity, liveness, beat payload
+        with TrackerClient("127.0.0.1", tracker.port) as tc:
+            cs = _wait(lambda: _beat_visible(tc))
+        assert cs, "beat stats never reached the tracker"
+        g = cs["groups"][0]
+        assert g["free_mb"] >= 0 and g["active"] == 1
+        s = g["storages"][0]
+        assert s["status_name"] == "ACTIVE"
+        assert 0 <= s["beat_age_s"] <= 30
+        named = M.beat_stats_from_storage(s)
+        assert named["total_upload"] >= 2
+        assert named["dedup_bytes_saved"] >= len(data)
+
+        # -- the CLI renders it (and --prometheus parses)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-m", "fastdfs_tpu.cli", "monitor", taddr],
+            capture_output=True, cwd=REPO, env=env, timeout=60)
+        assert out.returncode == 0, out.stderr.decode()
+        text = out.stdout.decode()
+        assert "Group: group1" in text and "ACTIVE" in text
+        assert re.search(r"upload_file=\d+", text), text
+        out = subprocess.run(
+            [sys.executable, "-m", "fastdfs_tpu.cli", "monitor", taddr,
+             "--prometheus"],
+            capture_output=True, cwd=REPO, env=env, timeout=60)
+        assert out.returncode == 0, out.stderr.decode()
+        series = parse_exposition(out.stdout.decode())
+        assert series["fdfs_storage_total_upload"][0][1] >= 2.0
+        assert "fdfs_op_upload_file_latency_us_bucket" in series
+    finally:
+        storage.stop()
+        tracker.stop()
+
+
+def _beat_visible(tc):
+    cs = tc.cluster_stat()
+    groups = cs.get("groups", [])
+    if not groups or not groups[0].get("storages"):
+        return None
+    named = M.beat_stats_from_storage(groups[0]["storages"][0])
+    return cs if named["total_upload"] >= 2 else None

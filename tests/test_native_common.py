@@ -14,10 +14,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BUILD = os.path.join(REPO, "native", "build")
 CODEC = os.path.join(BUILD, "fdfs_codec")
 COMMON_TEST = os.path.join(BUILD, "common_test")
+TRACKER_TEST = os.path.join(BUILD, "tracker_test")
 
 
 def _ensure_built():
-    if os.path.exists(CODEC) and os.path.exists(COMMON_TEST):
+    # TRACKER_TEST doubles as the staleness sentinel: a build tree from
+    # before the stats subsystem has codec+common_test but not it, and
+    # must be rebuilt (ninja is a no-op when already current).
+    if (os.path.exists(CODEC) and os.path.exists(COMMON_TEST)
+            and os.path.exists(TRACKER_TEST)):
         return
     subprocess.run(["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD,
                     "-G", "Ninja"], check=True, capture_output=True)
@@ -37,6 +42,12 @@ def _run(*args, stdin: bytes = b"") -> str:
 
 def test_cpp_unit_tests_pass():
     subprocess.run([COMMON_TEST], check=True, capture_output=True)
+
+
+def test_cpp_tracker_tests_pass():
+    # Built by the same configure pass; covers the beat-stats ->
+    # ClusterStatJson round-trip under the generated field names.
+    subprocess.run([TRACKER_TEST], check=True, capture_output=True)
 
 
 def test_generated_protocol_header_current():
