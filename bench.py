@@ -37,6 +37,9 @@ where vs_baseline is the speedup over the CPU hashlib baseline.
 
 import hashlib
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -123,16 +126,94 @@ def _bench_cpu(n_chunks: int = 256) -> float:
     return n_chunks * L / dt / 1e9
 
 
+def _bench_cpu_fallback() -> dict:
+    """CPU-backend measurement for the JAX_PLATFORMS=cpu retry: the
+    fingerprint pipeline as the cpu dedup mode actually runs it
+    (hashlib SHA1 + the jitted XLA MinHash — the Pallas kernels are
+    TPU-only, ops/sha1.py's XLA SHA1 costs minutes of compile on CPU).
+    Small fixed problem: the point is a parseable, honest number in the
+    artifact, not saturating a CPU."""
+    import jax
+
+    from fastdfs_tpu.ops.minhash import minhash_batch
+
+    L = CHUNK_KB * 1024
+    n = 128
+    rng = np.random.RandomState(0)
+    chunks = rng.randint(0, 256, size=(n, L), dtype=np.uint8)
+    lens = np.full(n, L, dtype=np.int32)
+    rows = [row.tobytes() for row in chunks]
+    np.asarray(minhash_batch(chunks, lens))  # compile outside the clock
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for row in rows:
+            hashlib.sha1(row).digest()
+        jax.block_until_ready(minhash_batch(chunks, lens))
+        rates.append(n * L / (time.perf_counter() - t0) / 1e9)
+    srt = sorted(rates)
+    return {
+        "value": round(srt[len(srt) // 2], 4),
+        "rounds": len(srt),
+        "backend": "cpu",
+        "dispersion": {"min": round(srt[0], 4), "median": round(srt[1], 4),
+                       "max": round(srt[-1], 4)},
+        "contended": False,
+    }
+
+
 def main() -> None:
+    # CPU-retry leg (see below): measure the CPU pipeline directly, the
+    # Pallas path cannot run on this backend.
+    if os.environ.get("_FDFS_BENCH_CPU_RETRY") == "1":
+        try:
+            out = _bench_cpu_fallback()
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "metric": "dedup_ingest_GBps_per_chip", "unit": "GB/s",
+                "ok": False, "error": f"{type(e).__name__}: {e}"[:1000],
+                "value": None,
+            }))
+            return
+        print(json.dumps({
+            "metric": "dedup_ingest_GBps_per_chip", "unit": "GB/s",
+            "ok": True, "vs_baseline": 1.0,
+            "cpu_baseline_GBps": out["value"], **out,
+        }))
+        return
+
     # Backend failures (e.g. the round-5 "Unable to initialize backend
     # 'axon'" RuntimeError when the TPU tunnel is down) degrade to a
-    # structured ok:false artifact instead of rc=1 + raw traceback: the
-    # BENCH_*.json the driver captures then says WHAT broke, and trend
-    # tooling can distinguish "backend down" from "kernel regressed".
+    # structured artifact instead of rc=1 + raw traceback.  Every round
+    # since r1 died this way with ok:false and NO numbers, so first
+    # retry ONCE with JAX_PLATFORMS=cpu in a fresh process (the backend
+    # is chosen at first jax init — flipping the env in-process is too
+    # late) and record the fallback; only if that also fails does the
+    # artifact degrade to ok:false.
     try:
         tpu = _bench_tpu()
     except Exception as e:  # noqa: BLE001 — any init/compile/dispatch failure
         err = f"{type(e).__name__}: {e}"
+        # One retry, ever: the marker env (not the JAX_PLATFORMS value —
+        # some images pre-force that to cpu, and the failure can be
+        # "Pallas needs a TPU" rather than "backend init") gates
+        # recursion, and the retry leg measures the CPU-appropriate
+        # pipeline instead of re-running the Pallas one.
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   _FDFS_BENCH_CPU_RETRY="1")
+        try:
+            ret = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                 env=env, capture_output=True, text=True,
+                                 timeout=600)
+            lines = ret.stdout.strip().splitlines()
+            retry = json.loads(lines[-1]) if lines else None
+        except Exception:  # noqa: BLE001 — fall through to ok:false
+            retry = None
+        if retry is not None and retry.get("ok"):
+            retry["fallback"] = "cpu"
+            retry["tpu_error"] = err[:500]
+            print(json.dumps(retry))
+            return
         print(json.dumps({
             "metric": "dedup_ingest_GBps_per_chip",
             "unit": "GB/s",

@@ -191,6 +191,68 @@ def cmd_tracker_status(c: FdfsClient, args: list[str]) -> int:
     return 0
 
 
+def cmd_trace(c: FdfsClient, args: list[str]) -> int:
+    """Distributed request tracing: run one traced upload through the
+    cluster, collect every node's span ring (TRACE_DUMP), stitch by
+    trace_id, and render the cross-node timeline.
+
+    Flags: --file <path>     trace an upload of this file (default: a
+                             random 256 KB payload, deleted afterwards)
+           --size <bytes>    random payload size for the default mode
+           --trace-id <hex>  skip the upload; render an existing trace
+                             from the cluster's rings
+           --wait <s>        settle time before collecting (default 1.5,
+                             lets the replication hop record sync spans)
+           --json            machine-readable span list instead of the
+                             timeline
+    """
+    import time as _time
+
+    from fastdfs_tpu import trace as T
+
+    def flag(name, default=None):
+        if name in args:
+            i = args.index(name)
+            if i + 1 < len(args):
+                return args[i + 1]
+        return default
+
+    trace_id = None
+    cleanup_fid = None
+    tracer = None
+    if flag("--trace-id") is not None:
+        trace_id = int(flag("--trace-id"), 16)
+    else:
+        if flag("--file") is not None:
+            with open(flag("--file"), "rb") as fh:
+                data = fh.read()
+            ext = os.path.splitext(flag("--file"))[1].lstrip(".")[:6]
+        else:
+            data = os.urandom(int(flag("--size", "262144")))
+            ext = "bin"
+            cleanup_fid = True
+        fid, tracer = T.traced_upload(c, data, ext=ext)
+        trace_id = tracer.trace_id
+        print(f"uploaded {fid}  trace_id={trace_id:016x}", file=sys.stderr)
+        _time.sleep(float(flag("--wait", "1.5")))  # let replication ship
+        if cleanup_fid:
+            try:
+                c.delete_file(fid)
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
+    spans, errors = T.collect_cluster_spans(c)
+    if tracer is not None:  # merge the client-side spans recorded locally
+        spans.extend(tracer.spans)
+    matched = [s for s in spans if s.trace_id == trace_id]
+    for node, err in errors.items():
+        print(f"warning: {node}: {err}", file=sys.stderr)
+    if "--json" in args:
+        print(T.spans_to_json(matched))
+    else:
+        print(T.render_timeline(matched, trace_id))
+    return 0 if matched else 1
+
+
 TOOLS = {
     "upload": cmd_upload,
     "download": cmd_download,
@@ -205,6 +267,7 @@ TOOLS = {
     "set_trunk_server": cmd_set_trunk_server,
     "tracker_status": cmd_tracker_status,
     "near_dups": cmd_near_dups,
+    "trace": cmd_trace,
 }
 
 

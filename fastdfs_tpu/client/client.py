@@ -31,6 +31,12 @@ class FdfsClient:
         # connection_pool.c / client.conf:use_connection_pool); every
         # operation borrows and parks instead of reconnecting twice.
         self.pool = ConnectionPool() if use_pool else None
+        # Distributed tracing: a fastdfs_tpu.trace.Tracer (or None).
+        # While set, every tracker/storage connection this client
+        # acquires carries the tracer's current wire context, so daemon
+        # spans stitch under the client's open span (trace.traced_upload
+        # installs one around a single operation).
+        self.tracer = None
 
     @classmethod
     def from_conf(cls, conf_path: str) -> "FdfsClient":
@@ -43,6 +49,9 @@ class FdfsClient:
         if self.pool is not None:
             self.pool.close_all()
 
+    def _wire_ctx(self):
+        return self.tracer.wire_ctx() if self.tracer is not None else None
+
     def _tracker(self) -> TrackerClient:
         # Random start + failover (reference: tracker_get_connection's
         # round-robin over the tracker group).
@@ -53,9 +62,12 @@ class FdfsClient:
             try:
                 if self.pool is not None:
                     conn = self.pool.acquire(host, port, self.timeout)
+                    conn.trace_ctx = self._wire_ctx()
                     return TrackerClient(host, port, self.timeout,
                                          conn=conn, release=self.pool.release)
-                return TrackerClient(host, port, self.timeout)
+                t = TrackerClient(host, port, self.timeout)
+                t.conn.trace_ctx = self._wire_ctx()
+                return t
             except OSError as e:
                 last_err = e
         raise ConnectionError(f"no tracker reachable: {last_err}")
@@ -95,9 +107,12 @@ class FdfsClient:
     def _storage(self, tgt) -> StorageClient:
         if self.pool is not None:
             conn = self.pool.acquire(tgt.ip, tgt.port, self.timeout)
+            conn.trace_ctx = self._wire_ctx()
             return StorageClient(tgt.ip, tgt.port, self.timeout,
                                  conn=conn, release=self.pool.release)
-        return StorageClient(tgt.ip, tgt.port, self.timeout)
+        s = StorageClient(tgt.ip, tgt.port, self.timeout)
+        s.conn.trace_ctx = self._wire_ctx()
+        return s
 
     # -- operations --------------------------------------------------------
 

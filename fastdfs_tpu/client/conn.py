@@ -33,6 +33,12 @@ class Connection:
         # Set on any mid-message failure: the stream cannot be resynced,
         # so a pool must discard rather than reuse this connection.
         self.broken = False
+        # Distributed tracing: when set (a fastdfs_tpu.trace.TraceContext),
+        # every request is prefixed with its TRACE_CTX frame so the
+        # daemon's spans stitch into the trace.  Sticky until cleared;
+        # the pool clears it on release so a parked connection never
+        # leaks one caller's trace onto the next.
+        self.trace_ctx = None
         self.sock = self._connect()
 
     def _connect(self) -> socket.socket:
@@ -62,6 +68,11 @@ class Connection:
         # boundary is the one safe place to reconnect, so retry once — the
         # same recovery the reference's connection pool performs.
         hdr = pack_header(len(body) if body_len is None else body_len, cmd)
+        if self.trace_ctx is not None:
+            # Prefix frame first: the daemon stashes the context and
+            # applies it to this request (it sends no response of its
+            # own, so request/response pairing is unchanged).
+            hdr = self.trace_ctx.frame() + hdr
         try:
             self.sock.sendall(hdr + body)
         except OSError:
@@ -151,6 +162,7 @@ class ConnectionPool:
         return Connection(host, port, timeout)
 
     def release(self, conn: Connection) -> None:
+        conn.trace_ctx = None  # a parked conn must not carry a stale trace
         if conn.broken:
             conn.close()
             return

@@ -248,6 +248,13 @@ class StorageClient:
         self.conn.send_request(StorageCmd.STAT)
         return json.loads(self.conn.recv_response("stat") or b"{}")
 
+    def trace_dump(self) -> dict:
+        """Span ring-buffer dump (TRACE_DUMP 131): this daemon's retained
+        request/replication/recovery spans.  Shape per
+        fastdfs_tpu.trace.decode_dump."""
+        self.conn.send_request(StorageCmd.TRACE_DUMP)
+        return json.loads(self.conn.recv_response("trace_dump") or b"{}")
+
 
 def _split_id(file_id: str) -> tuple[str, str]:
     group, sep, remote = file_id.partition("/")

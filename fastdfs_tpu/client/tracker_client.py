@@ -195,6 +195,12 @@ class TrackerClient:
         self.conn.recv_response("active_test")
         return True
 
+    def trace_dump(self) -> dict:
+        """Span ring-buffer dump (TRACE_DUMP 96): this tracker's retained
+        request spans.  Shape per fastdfs_tpu.trace.decode_dump."""
+        self.conn.send_request(TrackerCmd.TRACE_DUMP)
+        return json.loads(self.conn.recv_response("trace_dump") or b"{}")
+
     def get_tracker_status(self) -> dict:
         """Multi-tracker relationship probe (TRACKER_GET_STATUS 70):
         whether this tracker is the leader and who it believes leads."""

@@ -84,6 +84,39 @@ BEAT_STAT_COUNT = len(BEAT_STAT_FIELDS)
 # unparseable at the peer.
 MAX_INLINE_BODY = 64 << 20
 
+# ---------------------------------------------------------------------------
+# Trace context (fastdfs_tpu extension; no reference equivalent).
+#
+# A traced request is prefixed by one TRACE_CTX frame: a normal 10-byte
+# header with cmd=TRACE_CTX and pkg_len=TRACE_CTX_LEN, whose body is the
+# 16-byte context (8B trace_id + 4B parent span_id + 4B flags, all
+# big-endian).  The frame elicits NO response; the daemon stashes the
+# context on the connection and applies it to the NEXT request, whose
+# spans then stitch cross-node by trace_id.  Append-only wire contract:
+# an untraced request is byte-identical to the pre-trace protocol, so
+# old daemons and old clients interoperate untraced.
+# ---------------------------------------------------------------------------
+
+TRACE_CTX_LEN = 16
+TRACE_FLAG_SAMPLED = 1      # context carried an explicit client sample
+TRACE_FLAG_SLOW = 2         # span force-retained by the slow-request gate
+
+_TRACE_CTX_STRUCT = struct.Struct(">QII")
+
+
+def pack_trace_ctx(trace_id: int, span_id: int, flags: int = TRACE_FLAG_SAMPLED) -> bytes:
+    """16-byte TRACE_CTX frame body (big-endian, like every wire int)."""
+    return _TRACE_CTX_STRUCT.pack(trace_id & (2**64 - 1),
+                                  span_id & (2**32 - 1),
+                                  flags & (2**32 - 1))
+
+
+def unpack_trace_ctx(buf: bytes) -> tuple[int, int, int]:
+    """(trace_id, parent_span_id, flags) from a TRACE_CTX frame body."""
+    if len(buf) < TRACE_CTX_LEN:
+        raise ValueError(f"short trace ctx: {len(buf)} < {TRACE_CTX_LEN}")
+    return _TRACE_CTX_STRUCT.unpack_from(buf)
+
 _HEADER_STRUCT = struct.Struct(">qBB")
 
 
@@ -117,6 +150,10 @@ class TrackerCmd(enum.IntEnum):
     # Upstream's fdfs_monitor stitches this from LIST_ALL_GROUPS +
     # LIST_STORAGE binary structs instead.
     SERVER_CLUSTER_STAT = 95
+    # fastdfs_tpu extension: dump the tracker's span ring buffer (empty
+    # body -> JSON; shape per fastdfs_tpu.trace.decode_dump, covered by
+    # the fdfs_codec trace-json cross-language golden).
+    TRACE_DUMP = 96
 
     # client -> tracker (service queries; reference: tracker_deal_service_query_*)
     SERVICE_QUERY_STORE_WITHOUT_GROUP_ONE = 101
@@ -142,6 +179,11 @@ class TrackerCmd(enum.IntEnum):
     # decision from the elected tracker leader instead of electing locally
     # (upstream: only the leader calls tracker_mem_find_trunk_server).
     TRACKER_GET_TRUNK_SERVER = 74
+
+    # fastdfs_tpu extension: distributed-tracing context prefix frame
+    # (see TRACE_CTX_LEN above).  Deliberately the SAME value on both
+    # ports (StorageCmd.TRACE_CTX) so framing code is shared.
+    TRACE_CTX = 140
 
 
 class StorageCmd(enum.IntEnum):
@@ -236,6 +278,12 @@ class StorageCmd(enum.IntEnum):
     # decoded by fastdfs_tpu.monitor and covered by a cross-language
     # golden test.
     STAT = 130
+    # Span ring-buffer dump (fastdfs_tpu extension): empty body -> JSON
+    # {"role","port","spans":[...]} per fastdfs_tpu.trace.decode_dump
+    # (cross-language golden: fdfs_codec trace-json).
+    TRACE_DUMP = 131
+    # Trace-context prefix frame (same value as TrackerCmd.TRACE_CTX).
+    TRACE_CTX = 140
     # Ranked near-dup report for a stored file, answered from the
     # sidecar's MinHash/LSH index.  Body = 16B group + remote filename;
     # response = text lines "<file_id> <score>".  ENOTSUP when the dedup

@@ -74,13 +74,23 @@ WINDOW = 32
 _staging = threading.local()
 
 
-def staging_buffer(size: int) -> np.ndarray:
+def staging_buffer(size: int, slot: int = 0) -> np.ndarray:
+    """Reusable host staging buffer, keyed by (size, slot).
+
+    ``slot`` lets callers double-buffer: PJRT host-buffer donation
+    semantics are backend-dependent (some clients hold the host buffer
+    zero-copy until the transfer completes), so a caller that dispatches
+    tile N+1 before fetching tile N must rotate >= 2 slots per size or
+    risk overwriting bytes still in flight (ADVICE r5,
+    dedup/engine.py fingerprint()).
+    """
     bufs = getattr(_staging, "bufs", None)
     if bufs is None:
         bufs = _staging.bufs = {}
-    buf = bufs.get(size)
+    key = (size, slot)
+    buf = bufs.get(key)
     if buf is None:
-        buf = bufs[size] = np.zeros(size, dtype=np.uint8)
+        buf = bufs[key] = np.zeros(size, dtype=np.uint8)
     return buf
 
 # Default chunking geometry (bytes).  avg 8 KiB => 13 mask bits.

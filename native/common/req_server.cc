@@ -148,7 +148,26 @@ void RequestServer::ReadConn(Conn* c) {
 }
 
 void RequestServer::Dispatch(Conn* c) {
+  if (c->cmd == static_cast<uint8_t>(TrackerCmd::kTraceCtx)) {
+    // Prefix frame: stash the context for the NEXT request, send no
+    // response.  A malformed length cannot be resynced — close.
+    if (c->pkg_len != kTraceCtxLen) {
+      CloseConn(c);
+      return;
+    }
+    c->trace = ParseTraceCtx(reinterpret_cast<const uint8_t*>(c->body.data()));
+    c->header_got = 0;
+    c->in_body = false;
+    c->body.clear();
+    return;  // ReadConn keeps going: next bytes are the traced request
+  }
+  int64_t start_us = trace_hook_ ? TraceWallUs() : 0;
   auto [status, resp] = handler_(c->cmd, c->body, c->peer_ip);
+  if (trace_hook_) {
+    trace_hook_(c->cmd, c->trace, start_us, TraceWallUs() - start_us, status,
+                c->peer_ip);
+  }
+  c->trace = TraceCtx{};  // one request per prefix frame
   c->header_got = 0;
   c->in_body = false;
   c->body.clear();

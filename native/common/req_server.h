@@ -17,6 +17,7 @@
 
 #include "common/net.h"
 #include "common/protocol_gen.h"
+#include "common/trace.h"
 
 namespace fdfs {
 
@@ -25,6 +26,13 @@ class RequestServer {
   // Handler: (cmd, body, peer_ip) -> (status, response_body).
   using Handler = std::function<std::pair<uint8_t, std::string>(
       uint8_t cmd, const std::string& body, const std::string& peer_ip)>;
+  // Called after every dispatched request with the connection's trace
+  // context (invalid when untraced) and wall-clock timing; the owner
+  // decides whether to record a span / log a slow request.
+  using TraceHook = std::function<void(uint8_t cmd, const TraceCtx& ctx,
+                                       int64_t start_us, int64_t dur_us,
+                                       uint8_t status,
+                                       const std::string& peer_ip)>;
 
   RequestServer(EventLoop* loop, Handler handler, int64_t max_body = 16 << 20)
       : loop_(loop), handler_(std::move(handler)), max_body_(max_body) {}
@@ -37,6 +45,7 @@ class RequestServer {
   // Past the cap: one EBUSY response header, then close.  0 = unlimited.
   void set_max_connections(int n) { max_connections_ = n; }
   int64_t refused_count() const { return refused_count_; }
+  void set_trace_hook(TraceHook hook) { trace_hook_ = std::move(hook); }
 
  private:
   struct Conn {
@@ -50,6 +59,9 @@ class RequestServer {
     std::string body;
     std::string out;
     size_t out_off = 0;
+    // Trace context from a TRACE_CTX prefix frame; applies to (and is
+    // consumed by) the next dispatched request.
+    TraceCtx trace;
   };
 
   void OnAccept(uint32_t events);
@@ -61,6 +73,7 @@ class RequestServer {
 
   EventLoop* loop_;
   Handler handler_;
+  TraceHook trace_hook_;
   int64_t max_body_;
   int listen_fd_ = -1;
   int max_connections_ = 256;

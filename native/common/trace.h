@@ -1,0 +1,153 @@
+// Distributed request tracing: trace-context parsing and a lock-light
+// fixed-size span ring buffer — the per-daemon half of the tracing
+// pipeline (the Python half lives in fastdfs_tpu/trace.py).
+//
+// Wire contract (fastdfs_tpu.common.protocol): a traced request is
+// prefixed by one TRACE_CTX frame — a normal 10-byte header with
+// cmd=kTraceCtx and pkg_len=kTraceCtxLen whose body is 8B trace_id +
+// 4B parent span_id + 4B flags, all big-endian.  The frame elicits no
+// response; the daemon applies the context to the NEXT request on the
+// connection.  An untraced request is byte-identical to the pre-trace
+// protocol (append-only interop: old daemons/clients work untraced).
+//
+// Reference departure: upstream FastDFS has no request tracing at all —
+// its access log records only per-request totals.  Aggregate histograms
+// (stats.h, PR 1) cannot attribute ONE slow upload to CDC vs dio vs
+// binlog vs the replication hop; spans can.
+//
+// Concurrency: Record() claims a slot with a fetch_add and takes a
+// per-slot spinlock (acquire/release atomics, so TSan sees the
+// happens-before) only for the memcpy-sized critical section; Json()
+// takes each slot's lock briefly while copying.  No global lock, no
+// allocation on the record path.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace fdfs {
+
+// Decoded TRACE_CTX frame body.  trace_id 0 == "no context".
+struct TraceCtx {
+  uint64_t trace_id = 0;
+  uint32_t parent_span = 0;
+  uint32_t flags = 0;
+  bool valid() const { return trace_id != 0; }
+};
+
+constexpr uint32_t kTraceFlagSampled = 1;  // client asked for the trace
+constexpr uint32_t kTraceFlagSlow = 2;     // force-retained by slow gate
+
+TraceCtx ParseTraceCtx(const uint8_t* p);          // reads kTraceCtxLen bytes
+void SerializeTraceCtx(const TraceCtx& c, uint8_t* out);  // writes 16 bytes
+
+// The full on-wire prefix frame (header with cmd=kTraceCtx + 16B body);
+// out must hold kTraceCtxFrameLen bytes.  The single place the frame
+// layout lives — every native sender (replication, recovery) uses it.
+constexpr int kTraceCtxFrameLen = 10 /*kHeaderSize*/ + 16 /*kTraceCtxLen*/;
+void BuildTraceCtxFrame(const TraceCtx& c, uint8_t* out);
+
+// Wall-clock microseconds (CLOCK_REALTIME): spans from different nodes
+// must share a clock domain to stitch into one timeline.
+int64_t TraceWallUs();
+
+struct TraceSpan {
+  uint64_t trace_id = 0;
+  uint32_t span_id = 0;
+  uint32_t parent_id = 0;
+  int64_t start_us = 0;   // wall-clock epoch µs
+  int64_t dur_us = 0;
+  int32_t status = 0;     // errno-style response status (0 = OK)
+  uint32_t flags = 0;
+  char name[40] = {0};    // NUL-terminated stage name, e.g. "storage.upload_file"
+
+  void SetName(const char* n) {
+    std::strncpy(name, n, sizeof(name) - 1);
+    name[sizeof(name) - 1] = '\0';
+  }
+};
+
+class TraceRing {
+ public:
+  explicit TraceRing(size_t capacity);
+
+  // Process-unique (per ring) nonzero span id.
+  uint32_t NextSpanId() { return next_span_.fetch_add(1) | 0x80000000u; }
+  // Fresh trace id for daemon-originated traces (slow-request retention,
+  // recovery sessions): wall-time salted with the span counter so two
+  // daemons starting the same second do not collide in practice.
+  uint64_t NewTraceId();
+
+  void Record(const TraceSpan& s);
+
+  // JSON dump: {"role":"...","port":N,"spans":[...]} — spans sorted by
+  // start_us, trace/span ids as fixed-width hex strings (JSON numbers
+  // lose 64-bit precision in some decoders).
+  std::string Json(const std::string& role, int port) const;
+
+  int64_t recorded() const { return recorded_.load(); }
+  // Spans overwritten before any dump (ring wrapped past them).
+  int64_t dropped() const {
+    int64_t r = recorded_.load();
+    return r > static_cast<int64_t>(cap_) ? r - static_cast<int64_t>(cap_) : 0;
+  }
+  size_t capacity() const { return cap_; }
+
+ private:
+  struct Slot {
+    std::atomic<bool> locked{false};
+    bool used = false;
+    TraceSpan span;
+  };
+  void LockSlot(Slot* s) const {
+    while (s->locked.exchange(true, std::memory_order_acquire)) {
+    }
+  }
+  void UnlockSlot(Slot* s) const {
+    s->locked.store(false, std::memory_order_release);
+  }
+
+  size_t cap_;
+  std::unique_ptr<Slot[]> slots_;
+  std::atomic<uint64_t> head_{0};
+  std::atomic<int64_t> recorded_{0};
+  std::atomic<uint32_t> next_span_{1};
+};
+
+// One structured slow-request line: compact JSON (no spaces — the plain
+// access-log parser then skips it as a single token while
+// tools/access_log_stages.py --slow ingests it).
+std::string SlowRequestJson(const std::string& role, const char* op,
+                            const TraceSpan& root, const std::string& peer,
+                            int64_t bytes);
+
+// Bounded remote-filename -> TraceCtx map: remembers which recent
+// mutations were traced so the replication sender can propagate the
+// context onto the sync hop (the binlog format stays untouched).  A
+// record evicted before its sync ships simply replicates untraced —
+// tracing is best-effort observability, not a durability feature.
+class TraceCorrelator {
+ public:
+  explicit TraceCorrelator(size_t max_entries = 1024) : max_(max_entries) {}
+
+  void Put(const std::string& remote, const TraceCtx& ctx);
+  // Returns and ERASES the entry (one sync hop per peer would need
+  // per-peer copies; the first shipper wins — enough to stitch the
+  // acceptance path, and the map stays bounded under load).
+  bool Take(const std::string& remote, TraceCtx* out);
+  size_t size() const;
+
+ private:
+  mutable std::mutex mu_;
+  size_t max_;
+  uint64_t seq_ = 0;
+  std::map<std::string, std::pair<TraceCtx, uint64_t>> entries_;
+};
+
+}  // namespace fdfs

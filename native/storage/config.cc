@@ -70,6 +70,12 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
   log_file = ini.GetStr("log_file", "");
   log_rotate_size = ini.GetBytes("log_rotate_size", log_rotate_size);
   use_access_log = ini.GetBool("use_access_log", false);
+  trace_buffer_size =
+      static_cast<int>(ini.GetInt("trace_buffer_size", trace_buffer_size));
+  if (trace_buffer_size < 16) trace_buffer_size = 16;
+  slow_request_threshold_ms =
+      ini.GetInt("slow_request_threshold_ms", slow_request_threshold_ms);
+  if (slow_request_threshold_ms < 0) slow_request_threshold_ms = 0;
   return true;
 }
 

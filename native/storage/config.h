@@ -60,6 +60,13 @@ struct StorageConfig {
   // Per-request access log (storage.conf:use_access_log): op, client ip,
   // status, bytes, cost in µs — logs/access.log.
   bool use_access_log = false;
+  // Distributed tracing (common/trace.h): capacity of the span ring
+  // buffer dumped via StorageCmd::TRACE_DUMP, and the slow-request
+  // threshold — a request slower than this is span-retained even when
+  // untraced and logged as one structured JSON line.  0 disables the
+  // slow gate (traced requests still record).
+  int trace_buffer_size = 4096;
+  int64_t slow_request_threshold_ms = 1000;
 
   // Parse + validate; false with *error on problems.
   bool Load(const IniConfig& ini, std::string* error);

@@ -300,6 +300,27 @@ bool RecoveryManager::EnsurePeerConn(const PeerInfo& peer, int* fd) {
   return *fd >= 0;
 }
 
+bool RecoveryManager::SendTracePrefix(int fd) {
+  if (trace_ == nullptr || !cur_trace_.valid()) return true;
+  uint8_t frame[kTraceCtxFrameLen];
+  BuildTraceCtxFrame(cur_trace_, frame);
+  return SendAll(fd, frame, sizeof(frame), kRpcTimeoutMs);
+}
+
+void RecoveryManager::RecordFetchSpan(const char* name, int64_t start_us,
+                                      bool ok) {
+  if (trace_ == nullptr || !cur_trace_.valid()) return;
+  TraceSpan s;
+  s.trace_id = cur_trace_.trace_id;
+  s.span_id = trace_->NextSpanId();
+  s.parent_id = cur_trace_.parent_span;
+  s.start_us = start_us;
+  s.dur_us = TraceWallUs() - start_us;
+  s.status = ok ? 0 : 5 /*EIO*/;
+  s.SetName(name);
+  trace_->Record(s);
+}
+
 bool RecoveryManager::FetchOnePathBinlog(const PeerInfo& peer, int* fd,
                                          int spi, std::string* lines) {
   // Paged pull: a page shorter than the server's window is the end (a
@@ -338,6 +359,11 @@ bool RecoveryManager::DownloadToFile(const PeerInfo& peer, int* fd,
   // size field is 48 bits) and must never have to fit in memory.
   *missing = false;
   if (!EnsurePeerConn(peer, fd)) return false;
+  if (!SendTracePrefix(*fd)) {
+    close(*fd);
+    *fd = -1;
+    return false;
+  }
   std::string body(16, '\0');  // 8B offset 0 + 8B count 0 (whole file)
   PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
   body += remote;
@@ -396,6 +422,11 @@ bool RecoveryManager::FetchRecipe(const PeerInfo& peer, int* fd,
                                   bool* flat) {
   *flat = false;
   if (!EnsurePeerConn(peer, fd)) return false;
+  if (!SendTracePrefix(*fd)) {
+    close(*fd);
+    *fd = -1;
+    return false;
+  }
   std::string body;
   PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
   body += remote;
@@ -453,6 +484,11 @@ bool RecoveryManager::FetchChunks(const PeerInfo& peer, int* fd,
     return true;
   }
   if (!EnsurePeerConn(peer, fd)) return false;
+  if (!SendTracePrefix(*fd)) {
+    close(*fd);
+    *fd = -1;
+    return false;
+  }
   std::string body;
   PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
   uint8_t num[8];
@@ -576,6 +612,16 @@ bool RecoveryManager::RecoverPath(const PeerInfo& peer, int spi) {
   bool all_ok = true;
   for (const std::string& remote : files) {
     if (stop_) break;
+    // One trace per recovered file: fetch RPCs carry the context to the
+    // peer (its FETCH_* spans stitch in); the root span closes below.
+    int64_t t_file = 0;
+    if (trace_ != nullptr) {
+      cur_trace_.trace_id = trace_->NewTraceId();
+      cur_trace_.parent_span = trace_->NextSpanId();  // the file root span
+      cur_trace_.flags = 0;
+      t_file = TraceWallUs();
+    }
+    bool file_ok = true;
     // Chunk-aware pull first: recipe + only locally-missing chunk bytes
     // (dup-heavy rebuilds re-fetch unique bytes once, not per file).
     // Any failure — old peer, vanished chunk, local IO — falls back to
@@ -584,12 +630,18 @@ bool RecoveryManager::RecoverPath(const PeerInfo& peer, int spi) {
     if (recipe_recover_) {
       Recipe r;
       bool flat = false;
-      if (FetchRecipe(peer, &conn, remote, &r, &flat) && !flat) {
+      int64_t t0 = TraceWallUs();
+      bool got = FetchRecipe(peer, &conn, remote, &r, &flat);
+      RecordFetchSpan("recovery.fetch_recipe", t0, got);
+      if (got && !flat) {
         int64_t fetched = 0, local = 0;
         stored = recipe_recover_(
             spi, remote, r,
             [&](const std::vector<RecipeEntry>& want, std::string* out) {
-              return FetchChunks(peer, &conn, remote, want, out);
+              int64_t t1 = TraceWallUs();
+              bool ok = FetchChunks(peer, &conn, remote, want, out);
+              RecordFetchSpan("recovery.fetch_chunks", t1, ok);
+              return ok;
             },
             &fetched, &local);
         if (stored) {
@@ -601,19 +653,28 @@ bool RecoveryManager::RecoverPath(const PeerInfo& peer, int spi) {
     if (!stored) {
       std::string staged = store_->NewTmpPath(spi);
       bool missing = false;
-      if (!DownloadToFile(peer, &conn, remote, staged, &missing)) {
+      int64_t t0 = TraceWallUs();
+      bool got = DownloadToFile(peer, &conn, remote, staged, &missing);
+      RecordFetchSpan("recovery.download", t0, got);
+      if (!got) {
         FDFS_LOG_WARN("recovery: download %s failed", remote.c_str());
         all_ok = false;
+        CloseFileTrace(t_file, false);
         continue;
       }
       if (missing) {  // deleted on the peer since the record was written
         files_skipped_++;
+        CloseFileTrace(t_file, true);
         continue;
       }
       if (!StoreRecovered(remote, staged)) {
         all_ok = false;
-        continue;
+        file_ok = false;
       }
+    }
+    if (!file_ok) {
+      CloseFileTrace(t_file, false);
+      continue;
     }
     std::string meta;
     if (FetchMetadata(peer, &conn, remote, &meta)) {
@@ -630,9 +691,25 @@ bool RecoveryManager::RecoverPath(const PeerInfo& peer, int spi) {
       }
     }
     files_recovered_++;
+    CloseFileTrace(t_file, true);
   }
+  cur_trace_ = TraceCtx{};
   if (conn >= 0) close(conn);
   return all_ok && !stop_;
+}
+
+void RecoveryManager::CloseFileTrace(int64_t start_us, bool ok) {
+  if (trace_ == nullptr || !cur_trace_.valid()) return;
+  TraceSpan s;
+  s.trace_id = cur_trace_.trace_id;
+  s.span_id = cur_trace_.parent_span;  // the pre-allocated root id
+  s.parent_id = 0;
+  s.start_us = start_us;
+  s.dur_us = TraceWallUs() - start_us;
+  s.status = ok ? 0 : 5 /*EIO*/;
+  s.SetName("recovery.file");
+  trace_->Record(s);
+  cur_trace_ = TraceCtx{};
 }
 
 }  // namespace fdfs

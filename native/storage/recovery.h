@@ -23,6 +23,7 @@
 #include <thread>
 #include <vector>
 
+#include "common/trace.h"
 #include "storage/chunkstore.h"
 #include "storage/config.h"
 #include "storage/store.h"
@@ -77,6 +78,12 @@ class RecoveryManager {
     recipe_recover_ = std::move(fn);
   }
 
+  // Distributed tracing: each recovered file becomes one trace
+  // ("recovery.file" root + per-fetch child spans), its context
+  // prefixed onto the peer RPCs so the serving node's FETCH_RECIPE /
+  // FETCH_CHUNK / DOWNLOAD spans stitch cross-node.  null = untraced.
+  void SetTrace(TraceRing* ring) { trace_ = ring; }
+
   // Start the background rebuild (call only when NeedsRecovery).
   void Start();
   void Stop();
@@ -124,6 +131,13 @@ class RecoveryManager {
                    Recipe* recipe, bool* flat);
   bool FetchChunks(const PeerInfo& peer, int* fd, const std::string& remote,
                    const std::vector<RecipeEntry>& want, std::string* out);
+  // TRACE_CTX prefix frame for the next peer RPC (no-op when the
+  // current file is untraced); false = transport failure.
+  bool SendTracePrefix(int fd);
+  // Record a child span of the current file's trace (no-op untraced).
+  void RecordFetchSpan(const char* name, int64_t start_us, bool ok);
+  // Close (record) the current file's root span and clear the context.
+  void CloseFileTrace(int64_t start_us, bool ok);
 
   StorageConfig cfg_;
   TrackerReporter* reporter_;
@@ -139,6 +153,10 @@ class RecoveryManager {
   ChunkedStoreFn chunked_store_;
   RecipeRecoverFn recipe_recover_;
   int64_t chunk_threshold_ = 0;
+  // Recovery runs on ONE thread, so the current file's trace context
+  // needs no locking; parent_span holds the file's root span id.
+  TraceRing* trace_ = nullptr;
+  TraceCtx cur_trace_;
 };
 
 }  // namespace fdfs

@@ -117,6 +117,11 @@ bool StorageServer::Init(std::string* error) {
     dio_pools_.push_back(
         std::make_unique<WorkerPool>(cfg_.disk_writer_threads));
 
+  // Trace ring before the registry (its gauges read the ring) and before
+  // the sync/recovery subsystems (they record spans into it).
+  trace_ = std::make_unique<TraceRing>(
+      static_cast<size_t>(cfg_.trace_buffer_size));
+
   // Stats registry before any subsystem that feeds it: handlers and the
   // beat callback only touch pre-registered atomic pointers.
   InitStatsRegistry();
@@ -188,6 +193,12 @@ bool StorageServer::Init(std::string* error) {
       ChunkStore* cs = local.empty() ? nullptr : StoreForLocal(local);
       return cs != nullptr && cs->ReadChunk(digest_hex, len, out);
     };
+    // Trace stitching across the replication hop: the sender consumes
+    // the traced-mutation context for each record it ships (prefixing a
+    // TRACE_CTX frame so the peer's replay spans join the trace) and
+    // records its own sync.ship span here.
+    scbs.trace_corr = &trace_corr_;
+    scbs.trace_ring = trace_.get();
     sync_ = std::make_unique<SyncManager>(cfg_, std::move(scbs));
     reporter_ = std::make_unique<TrackerReporter>(
         cfg_, [this](int64_t* out) { FillBeatStats(out); },
@@ -201,6 +212,10 @@ bool StorageServer::Init(std::string* error) {
     // reads for files it no longer has) on its way into recovery.
     recovery_ = std::make_unique<RecoveryManager>(cfg_, reporter_.get(),
                                                   &store_);
+    // Each recovered file becomes its own trace (recovery.file root +
+    // per-fetch child spans), with the context propagated onto the peer
+    // so its FETCH_RECIPE/FETCH_CHUNK spans stitch cross-node.
+    recovery_->SetTrace(trace_.get());
     // Recovered files dedup exactly like synced/uploaded ones: a rebuilt
     // node must not silently lose chunk-level dedup (its chunk store
     // would stay empty while peers dedup).  The hook runs on the
@@ -464,6 +479,7 @@ constexpr ServedOp kServedOps[] = {
     {StorageCmd::kSyncCreateRecipe, "sync_create_recipe"},
     {StorageCmd::kFetchRecipe, "fetch_recipe"},
     {StorageCmd::kFetchChunk, "fetch_chunk"},
+    {StorageCmd::kTraceDump, "trace_dump"},
     {StorageCmd::kFetchOnePathBinlog, "fetch_one_path_binlog"},
     {StorageCmd::kTrunkAllocSpace, "trunk_alloc_space"},
     {StorageCmd::kTrunkAllocConfirm, "trunk_alloc_confirm"},
@@ -480,7 +496,17 @@ void StorageServer::InitStatsRegistry() {
     os.errors = registry_.Counter(base + ".errors");
     os.latency_us = registry_.Histogram(base + ".latency_us",
                                         StatsRegistry::LatencyBucketsUs());
+    op_names_[static_cast<uint8_t>(op.cmd)] = op.name;
   }
+  // Tracing health: ring throughput/overwrite pressure and the slow gate.
+  registry_.GaugeFn("trace.spans_recorded", [this] {
+    return trace_ != nullptr ? trace_->recorded() : int64_t{0};
+  });
+  registry_.GaugeFn("trace.spans_dropped", [this] {
+    return trace_ != nullptr ? trace_->dropped() : int64_t{0};
+  });
+  registry_.GaugeFn("trace.slow_requests",
+                    [this] { return slow_request_count_.load(); });
   hist_upload_bytes_ = registry_.Histogram(
       "upload.size_bytes", StatsRegistry::SizeBucketsBytes());
   hist_download_bytes_ = registry_.Histogram(
@@ -640,7 +666,7 @@ void StorageServer::OffloadToDio(Conn* c, int spi, std::function<void()> work) {
     return;
   }
   c->async_pending = true;
-  if (access_log_ != nullptr) c->work_start_us = MonoUs();
+  c->work_start_us = MonoUs();  // dio-stage begin (access log AND spans)
   EventLoop* loop = ConnLoop(c);
   // Drop the fd from epoll while a worker owns the request: with
   // level-triggered epoll a readable/HUP'd socket would otherwise
@@ -745,6 +771,9 @@ void StorageServer::ResetForNextRequest(Conn* c) {
   c->fp_lock_us = 0;
   c->cswrite_us = 0;
   c->binlog_us = 0;
+  c->trace_ctx = TraceCtx{};
+  c->traced = false;
+  c->trace_span = 0;
   // Bounded buffer budget (the other half of fast_task_queue's pooled
   // buffers): a request with an unusually large in-memory body or
   // response must not pin that capacity for the connection's lifetime —
@@ -850,6 +879,7 @@ void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
     default:
       break;
   }
+  RecordRequestSpans(c, status, now_us, bytes);
   if (access_log_ == nullptr) {
     c->req_start_us = 0;
     c->recv_done_us = 0;
@@ -894,6 +924,85 @@ void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
   c->fp_lock_us = 0;
   c->cswrite_us = 0;
   c->binlog_us = 0;
+}
+
+void StorageServer::RecordRequestSpans(Conn* c, uint8_t status,
+                                       int64_t now_us, int64_t bytes) {
+  if (trace_ == nullptr) return;
+  int64_t total_us = now_us - c->req_start_us;
+  int64_t slow_us = cfg_.slow_request_threshold_ms * 1000;
+  bool slow = slow_us > 0 && total_us >= slow_us;
+  if (!c->traced && !slow) return;
+
+  // Spans are stamped on the wall clock (cross-node stitching needs one
+  // clock domain); stage offsets come from the monotonic stamps the
+  // access log already keeps, anchored to the request's wall start.
+  int64_t wall_start = TraceWallUs() - total_us;
+  TraceSpan root;
+  root.trace_id = c->traced ? c->trace_ctx.trace_id : trace_->NewTraceId();
+  root.span_id = c->trace_span != 0 ? c->trace_span : trace_->NextSpanId();
+  root.parent_id = c->traced ? c->trace_ctx.parent_span : 0;
+  root.start_us = wall_start;
+  root.dur_us = total_us;
+  root.status = status;
+  root.flags =
+      (c->traced ? c->trace_ctx.flags : 0) | (slow ? kTraceFlagSlow : 0);
+  const char* opname =
+      op_names_[c->cmd] != nullptr ? op_names_[c->cmd] : "unknown";
+  char full[sizeof(root.name)];
+  std::snprintf(full, sizeof(full), "storage.%s", opname);
+  root.SetName(full);
+  trace_->Record(root);
+
+  auto child = [&](const char* name, int64_t start, int64_t dur) {
+    if (dur <= 0) return;
+    TraceSpan s;
+    s.trace_id = root.trace_id;
+    s.span_id = trace_->NextSpanId();
+    s.parent_id = root.span_id;
+    s.start_us = start;
+    s.dur_us = dur;
+    s.flags = root.flags;
+    s.SetName(name);
+    trace_->Record(s);
+  };
+  // recv = body receive window; the dio work window then decomposes into
+  // fingerprint -> chunk-store writes -> binlog (sequential in the
+  // handler, so their spans are laid out back-to-back).
+  int64_t recv_us =
+      c->recv_done_us > 0 ? c->recv_done_us - c->req_start_us : 0;
+  child("storage.recv", wall_start, recv_us);
+  int64_t work_wall = wall_start + (c->work_start_us > 0
+                                        ? c->work_start_us - c->req_start_us
+                                        : recv_us);
+  child("storage.fingerprint", work_wall, c->fp_us);
+  child("storage.cs_write", work_wall + c->fp_us, c->cswrite_us);
+  child("storage.binlog", work_wall + c->fp_us + c->cswrite_us, c->binlog_us);
+
+  if (slow) {
+    slow_request_count_.fetch_add(1, std::memory_order_relaxed);
+    std::string line =
+        SlowRequestJson("storage", root.name, root, c->peer_ip, bytes);
+    FDFS_LOG_WARN("%s", line.c_str());
+    if (access_log_ != nullptr) {
+      // One compact-JSON line amid the space-separated records: the
+      // plain column parser skips it, access_log_stages --slow reads it.
+      // Flushed immediately — slow requests are rare and the line is
+      // an operator signal, not bulk logging.
+      std::lock_guard<std::mutex> lk(log_mu_);
+      fprintf(access_log_, "%s\n", line.c_str());
+      fflush(access_log_);
+    }
+  }
+}
+
+void StorageServer::NoteTracedMutation(Conn* c, const std::string& remote) {
+  if (!c->traced || trace_ == nullptr) return;
+  TraceCtx ctx;
+  ctx.trace_id = c->trace_ctx.trace_id;
+  ctx.parent_span = c->trace_span;  // sync.ship nests under this request
+  ctx.flags = c->trace_ctx.flags;
+  trace_corr_.Put(remote, ctx);
 }
 
 void StorageServer::RespondFile(Conn* c, uint8_t status, int file_fd,
@@ -1104,8 +1213,7 @@ void StorageServer::OnHeaderComplete(Conn* c) {
   // negative latencies).  Always stamped: the stats registry's
   // per-opcode latency histograms run even without the access log.
   c->req_start_us = MonoUs();
-  if (access_log_ != nullptr && c->peer_ip.empty())
-    c->peer_ip = PeerIp(c->fd);
+  if (c->peer_ip.empty()) c->peer_ip = PeerIp(c->fd);
   if (c->pkg_len < 0) {
     FDFS_LOG_WARN("negative pkg_len from %s", PeerIp(c->fd).c_str());
     CloseConn(c);
@@ -1127,6 +1235,25 @@ void StorageServer::OnHeaderComplete(Conn* c) {
         return;
       }
       Respond(c, 0, BuildStatsJson());
+      return;
+    case StorageCmd::kTraceDump:
+      // Span ring dump: empty body -> {"role","port","spans":[...]}.
+      if (c->pkg_len != 0) {
+        CloseConn(c);
+        return;
+      }
+      Respond(c, 0, trace_->Json("storage", cfg_.port));
+      return;
+    case StorageCmd::kTraceCtx:
+      // Trace-context prefix frame: 16B body, NO response; the context
+      // applies to the next request on this connection.  A wrong length
+      // cannot be resynced mid-stream — close.
+      if (c->pkg_len != kTraceCtxLen) {
+        CloseConn(c);
+        return;
+      }
+      c->fixed_need = static_cast<size_t>(kTraceCtxLen);
+      c->state = ConnState::kRecvFixed;
       return;
     case StorageCmd::kUploadFile:
     case StorageCmd::kUploadAppenderFile:
@@ -1212,6 +1339,25 @@ void StorageServer::OnHeaderComplete(Conn* c) {
 void StorageServer::OnFixedComplete(Conn* c) {
   auto cmd = static_cast<StorageCmd>(c->cmd);
   switch (cmd) {
+    case StorageCmd::kTraceCtx: {
+      // Stash the context and allocate the next request's root span id
+      // (mutation paths correlate through it before LogAccess records
+      // the span).  Minimal reset — NOT ResetForNextRequest, which
+      // clears the trace fields — then keep reading: the very next
+      // bytes are the traced request's header.
+      c->trace_ctx =
+          ParseTraceCtx(reinterpret_cast<const uint8_t*>(c->fixed.data()));
+      c->traced = c->trace_ctx.valid();
+      c->trace_span = c->traced ? trace_->NextSpanId() : 0;
+      c->state = ConnState::kRecvHeader;
+      c->header_got = 0;
+      c->fixed.clear();
+      c->fixed_need = 0;
+      c->pkg_len = 0;
+      c->body_consumed = 0;
+      c->req_start_us = 0;
+      return;
+    }
     case StorageCmd::kUploadFile:
     case StorageCmd::kUploadAppenderFile:
       if (!BeginUpload(c)) return;
@@ -1385,7 +1531,7 @@ void StorageServer::OnFixedComplete(Conn* c) {
 }
 
 void StorageServer::OnFileComplete(Conn* c) {
-  if (access_log_ != nullptr) c->recv_done_us = MonoUs();
+  c->recv_done_us = MonoUs();  // recv-stage end (access log AND spans)
   if (c->discarding) {  // rejected request: body drained, send the verdict
     Respond(c, c->pending_status);
     return;
@@ -2302,6 +2448,7 @@ void StorageServer::FinishUpload(Conn* c) {
         c->fp_us = st.fp;
         c->fp_lock_us = st.fp_lock;
         c->cswrite_us = st.cs_write;
+        NoteTracedMutation(c, parts->RemoteFilename());
         stats_.success_upload++;
         stats_.last_source_update = time(nullptr);
         Respond(c, 0,
@@ -2335,6 +2482,7 @@ void StorageServer::FinishUpload(Conn* c) {
           stats_.last_source_update = time(nullptr);
           binlog_.Append(kBinlogOpLink, parts->RemoteFilename(),
                          dup->RemoteFilename());
+          NoteTracedMutation(c, parts->RemoteFilename());
           Respond(c, 0, PackGroupField(cfg_.group_name) + parts->RemoteFilename());
           return;
         }
@@ -2355,6 +2503,7 @@ void StorageServer::FinishUpload(Conn* c) {
       auto tparts = DecodeFileId(tid);
       if (dedup_ != nullptr) dedup_->Commit(digest, tid);
       binlog_.Append(kBinlogOpCreate, tparts->RemoteFilename());
+      NoteTracedMutation(c, tparts->RemoteFilename());
       stats_.success_upload++;
       stats_.last_source_update = time(nullptr);
       Respond(c, 0, PackGroupField(cfg_.group_name) + tparts->RemoteFilename());
@@ -2386,6 +2535,7 @@ void StorageServer::FinishUpload(Conn* c) {
   int64_t t_bl = MonoUs();
   binlog_.Append(kBinlogOpCreate, parts->RemoteFilename());
   c->binlog_us = MonoUs() - t_bl;
+  NoteTracedMutation(c, parts->RemoteFilename());
   stats_.success_upload++;
   stats_.last_source_update = time(nullptr);
   Respond(c, 0, PackGroupField(cfg_.group_name) + parts->RemoteFilename());

@@ -25,6 +25,7 @@
 #include <vector>
 
 #include "common/stats.h"
+#include "common/trace.h"
 #include "common/workers.h"
 
 #include "common/bytes.h"
@@ -176,6 +177,14 @@ class StorageServer {
     int64_t cswrite_us = 0;     // chunk-store writes
     int64_t binlog_us = 0;      // binlog append
     std::string peer_ip;
+    // Distributed tracing: context from a TRACE_CTX prefix frame,
+    // consumed by the next request (ResetForNextRequest clears it).
+    // trace_span is the request's root span id, allocated when the
+    // frame completes so mutation paths can correlate (binlog ->
+    // replication) before the span itself is recorded at LogAccess.
+    TraceCtx trace_ctx;
+    bool traced = false;
+    uint32_t trace_span = 0;
   };
 
   struct NioThread {
@@ -226,6 +235,15 @@ class StorageServer {
   // Pre-register per-opcode counters/histograms and the gauge mirrors of
   // live state so hot paths only touch cached atomic pointers.
   void InitStatsRegistry();
+  // -- tracing (common/trace.h; TRACE_CTX / TRACE_DUMP opcodes) ----------
+  // Retain this request's spans (root + stage children) when it is
+  // traced or exceeded the slow threshold; called from LogAccess (the
+  // per-request accounting choke point).
+  void RecordRequestSpans(Conn* c, uint8_t status, int64_t now_us,
+                          int64_t bytes);
+  // Remember a traced mutation's context keyed by remote filename so
+  // the replication sender stitches the sync hop into the same trace.
+  void NoteTracedMutation(Conn* c, const std::string& remote);
   // Refresh snapshot-time gauges (per-peer sync lag) and serialize.
   std::string BuildStatsJson();
   // Beat callback: persisted prefix from stats_, live slots from the
@@ -370,6 +388,15 @@ class StorageServer {
     StatHistogram* latency_us = nullptr;
   };
   std::array<OpStats, 256> op_stats_{};
+  // Monitor-facing opcode names (kServedOps), indexed by raw cmd byte —
+  // shared by the stats registry and span naming.
+  std::array<const char*, 256> op_names_{};
+  // Span ring behind TRACE_DUMP + the traced-mutation correlator feeding
+  // the replication sender.  slow_request_count_ backs the
+  // trace.slow_requests registry gauge.
+  std::unique_ptr<TraceRing> trace_;
+  TraceCorrelator trace_corr_;
+  std::atomic<int64_t> slow_request_count_{0};
   StatHistogram* hist_upload_bytes_ = nullptr;
   StatHistogram* hist_download_bytes_ = nullptr;
   std::atomic<int64_t>* ctr_sync_bytes_saved_wire_ = nullptr;

@@ -155,7 +155,23 @@ void SyncManager::WorkerMain(Worker* w) {
       backoff_ms = 100;
     }
 
-    if (!pending.has_value()) pending = reader.Next();
+    // Caught-up stamp and quiescence are captured BEFORE the EOF read.
+    // Order matters on a loaded box: stamping after the read leaves a
+    // preemption window (observed seconds long under 1-core suite load)
+    // in which a record can be appended AND stamped, yet be covered by
+    // the report — the tracker then routes reads to a replica that never
+    // received the file.  Stamped first, `safe` is provably earlier than
+    // the EOF read: any record with timestamp <= safe either was visible
+    // to the read, or was mid-append — which the quiescence check (also
+    // before the read) rules out, since in_flight covers the Append's
+    // stamp→write window and later appends stamp >= safe + 1.
+    int64_t safe = 0;
+    bool quiet = false;
+    if (!pending.has_value()) {
+      safe = time(nullptr) - 1;
+      quiet = !cbs_.binlog_quiescent || cbs_.binlog_quiescent();
+      pending = reader.Next();
+    }
     if (!pending.has_value()) {
       // Caught up: persist the cursor and idle-poll the binlog.
       if (since_save > 0) {
@@ -163,17 +179,10 @@ void SyncManager::WorkerMain(Worker* w) {
         since_save = 0;
       }
       // Caught-up progress report: the peer has everything this source
-      // produced through the PREVIOUS second.  `now` itself would race an
-      // in-flight upload (binlog appends are unbuffered write()s, so a
-      // record invisible at this EOF check normally stamps >= now), and
-      // the quiescence gate closes the residual window where an Append
-      // already captured a past-second stamp but hasn't hit the file yet.
-      // Keeps read routing fresh and completes the tracker's full-sync
-      // promotion even when the binlog is empty (upstream: sync_old_done
-      // bookkeeping).
-      int64_t safe = time(nullptr) - 1;
-      if (cbs_.report && safe > w->synced_ts &&
-          (!cbs_.binlog_quiescent || cbs_.binlog_quiescent())) {
+      // produced through the PREVIOUS second.  Keeps read routing fresh
+      // and completes the tracker's full-sync promotion even when the
+      // binlog is empty (upstream: sync_old_done bookkeeping).
+      if (cbs_.report && quiet && safe > w->synced_ts) {
         w->synced_ts = safe;
         cbs_.report(w->ip, w->port, safe);
       }
@@ -212,6 +221,28 @@ void SyncManager::WorkerMain(Worker* w) {
 }
 
 bool SyncManager::Replay(Worker* w, int* fd, const BinlogRecord& rec) {
+  // Trace stitching: a recently-traced mutation ships with a TRACE_CTX
+  // prefix frame so the peer's replica-replay spans join the original
+  // trace, and the sender records the hop as a "sync.ship" span.
+  TraceCtx ctx;
+  bool traced = cbs_.trace_corr != nullptr &&
+                cbs_.trace_corr->Take(rec.filename, &ctx) && ctx.valid();
+  uint32_t ship_span = 0;
+  int64_t t0 = 0;
+  if (traced && cbs_.trace_ring != nullptr) {
+    ship_span = cbs_.trace_ring->NextSpanId();
+    uint8_t frame[kTraceCtxFrameLen];
+    TraceCtx hop;
+    hop.trace_id = ctx.trace_id;
+    hop.parent_span = ship_span;  // peer spans nest under the ship span
+    hop.flags = ctx.flags;
+    BuildTraceCtxFrame(hop, frame);
+    if (!SendAll(*fd, frame, sizeof(frame), kIoTimeoutMs)) {
+      cbs_.trace_corr->Put(rec.filename, ctx);  // retry stays traced
+      return false;
+    }
+    t0 = TraceWallUs();
+  }
   bool skipped = false;
   bool ok;
   switch (rec.op) {
@@ -246,6 +277,22 @@ bool SyncManager::Replay(Worker* w, int* fd, const BinlogRecord& rec) {
       break;
   }
   if (ok && skipped) w->records_skipped++;
+  if (traced && cbs_.trace_ring != nullptr) {
+    if (ok) {
+      TraceSpan s;
+      s.trace_id = ctx.trace_id;
+      s.span_id = ship_span;
+      s.parent_id = ctx.parent_span;
+      s.start_us = t0;
+      s.dur_us = TraceWallUs() - t0;
+      s.status = skipped ? 2 /*ENOENT-ish: permanently unreplayable*/ : 0;
+      s.flags = ctx.flags;
+      s.SetName("sync.ship");
+      cbs_.trace_ring->Record(s);
+    } else {
+      cbs_.trace_corr->Put(rec.filename, ctx);  // reconnect + retry traced
+    }
+  }
   return ok;
 }
 

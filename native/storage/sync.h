@@ -24,6 +24,7 @@
 #include <thread>
 #include <vector>
 
+#include "common/trace.h"
 #include "storage/binlog.h"
 #include "storage/chunkstore.h"
 #include "storage/config.h"
@@ -59,6 +60,14 @@ struct SyncCallbacks {
   std::function<void(const std::string& remote, const Recipe&)> unpin_recipe;
   std::function<bool(const std::string& remote, const std::string& digest_hex,
                      int64_t len, std::string* out)> read_chunk;
+  // Distributed tracing (both may be null = untraced replication).  The
+  // correlator maps a recently-traced mutation's remote filename to its
+  // context; the sender consumes it, prefixes the replay with a
+  // TRACE_CTX frame (the peer's replica-replay spans join the trace),
+  // and records its own "sync.ship" span into the ring.  Transport
+  // failures restore the entry so the retried record stays traced.
+  TraceCorrelator* trace_corr = nullptr;
+  TraceRing* trace_ring = nullptr;
 };
 
 struct SyncPeerState {

@@ -4,12 +4,15 @@
 #include <cstdio>
 #include <cstring>
 #include <string>
+#include <thread>
+#include <vector>
 
 #include "common/bytes.h"
 #include "common/fileid.h"
 #include "common/ini.h"
 #include "common/protocol_gen.h"
 #include "common/stats.h"
+#include "common/trace.h"
 
 static int g_failures = 0;
 
@@ -196,6 +199,100 @@ static void TestStatsRegistry() {
   CHECK(json.find("\"sum\":5026") != std::string::npos);
 }
 
+static void TestTraceCtxWire() {
+  // Wire layout golden: 8B trace_id + 4B parent + 4B flags, big-endian —
+  // must match fastdfs_tpu.common.protocol.pack_trace_ctx byte-for-byte.
+  const uint8_t raw[16] = {0x01, 0x02, 0x03, 0x04, 0x05, 0x06, 0x07, 0x08,
+                           0xAA, 0xBB, 0xCC, 0xDD, 0x00, 0x00, 0x00, 0x03};
+  TraceCtx c = ParseTraceCtx(raw);
+  CHECK_EQ(c.trace_id, 0x0102030405060708ULL);
+  CHECK_EQ(c.parent_span, 0xAABBCCDDu);
+  CHECK_EQ(c.flags, 3u);
+  CHECK(c.valid());
+  uint8_t back[16];
+  SerializeTraceCtx(c, back);
+  CHECK_EQ(std::memcmp(raw, back, 16), 0);
+  CHECK(!TraceCtx{}.valid());
+  CHECK_EQ(static_cast<int>(StorageCmd::kTraceCtx),
+           static_cast<int>(TrackerCmd::kTraceCtx));  // shared framing
+  CHECK_EQ(static_cast<int>(StorageCmd::kTraceDump), 131);
+  CHECK_EQ(static_cast<int>(TrackerCmd::kTraceDump), 96);
+}
+
+static void TestTraceRing() {
+  TraceRing ring(4);
+  uint32_t a = ring.NextSpanId(), b = ring.NextSpanId();
+  CHECK(a != b && a != 0 && b != 0);
+  CHECK(ring.NewTraceId() != ring.NewTraceId());
+  for (int i = 0; i < 6; ++i) {  // wraps: 6 records into 4 slots
+    TraceSpan s;
+    s.trace_id = 0xABC0ULL + i;
+    s.span_id = static_cast<uint32_t>(i + 1);
+    s.start_us = 1000 + i;
+    s.dur_us = 10;
+    s.SetName(i % 2 ? "storage.recv" : "storage.upload_file");
+    ring.Record(s);
+  }
+  CHECK_EQ(ring.recorded(), 6);
+  CHECK_EQ(ring.dropped(), 2);
+  std::string json = ring.Json("storage", 23000);
+  CHECK(json.find("\"role\":\"storage\"") != std::string::npos);
+  CHECK(json.find("\"port\":23000") != std::string::npos);
+  // Oldest two overwritten; newest four present, sorted by start_us.
+  CHECK(json.find("\"start_us\":1000,") == std::string::npos);
+  CHECK(json.find("\"start_us\":1005,") != std::string::npos);
+  size_t p2 = json.find("\"start_us\":1002");
+  size_t p5 = json.find("\"start_us\":1005");
+  CHECK(p2 != std::string::npos && p2 < p5);
+  // Long names truncate, never overflow.
+  TraceSpan longname;
+  longname.trace_id = 1;
+  longname.SetName("this-name-is-way-longer-than-the-forty-byte-span-field");
+  CHECK_EQ(std::strlen(longname.name), sizeof(longname.name) - 1);
+}
+
+static void TestTraceRingThreaded() {
+  // Lock-light claim: concurrent recorders + a dumping reader must be
+  // data-race-free (tools/run_sanitizers.sh runs this under TSan).
+  TraceRing ring(64);
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 4; ++t) {
+    threads.emplace_back([&ring, t] {
+      for (int i = 0; i < 500; ++i) {
+        TraceSpan s;
+        s.trace_id = static_cast<uint64_t>(t) << 32 | i;
+        s.span_id = ring.NextSpanId();
+        s.start_us = i;
+        s.dur_us = 1;
+        s.SetName("storage.upload_file");
+        ring.Record(s);
+      }
+    });
+  }
+  std::thread reader([&ring] {
+    for (int i = 0; i < 50; ++i) (void)ring.Json("storage", 1);
+  });
+  for (auto& th : threads) th.join();
+  reader.join();
+  CHECK_EQ(ring.recorded(), 4 * 500);
+  CHECK(ring.Json("storage", 1).find("\"spans\":[") != std::string::npos);
+}
+
+static void TestTraceCorrelator() {
+  TraceCorrelator corr(2);
+  TraceCtx c1{1, 10, 1}, c2{2, 20, 1}, c3{3, 30, 1}, out;
+  corr.Put("M00/a", c1);
+  corr.Put("M00/b", c2);
+  corr.Put("M00/c", c3);  // evicts the oldest (M00/a)
+  CHECK_EQ(corr.size(), 2u);
+  CHECK(!corr.Take("M00/a", &out));
+  CHECK(corr.Take("M00/b", &out));
+  CHECK_EQ(out.trace_id, 2ULL);
+  CHECK(!corr.Take("M00/b", &out));  // Take consumes
+  CHECK(corr.Take("M00/c", &out));
+  CHECK_EQ(corr.size(), 0u);
+}
+
 int main() {
   TestEndian();
   TestBase64();
@@ -206,6 +303,10 @@ int main() {
   TestIni();
   TestProtocolConstants();
   TestStatsRegistry();
+  TestTraceCtxWire();
+  TestTraceRing();
+  TestTraceRingThreaded();
+  TestTraceCorrelator();
   if (g_failures == 0) {
     std::printf("common_test: ALL PASS\n");
     return 0;

@@ -17,6 +17,11 @@
 //   fdfs_codec stats-json      (golden stats-registry snapshot: fixed
 //                counters/gauges/histogram observations -> JSON, compared
 //                field-for-field against the Python decoder)
+//   fdfs_codec trace-json      (golden span-ring dump: fixed spans ->
+//                JSON, compared field-for-field against
+//                fastdfs_tpu.trace.decode_dump)
+//   fdfs_codec trace-ctx <hex32>  (parse a 16-byte TRACE_CTX body and
+//                print trace_id/parent/flags — wire-layout golden)
 #include <time.h>
 
 #include <cstdio>
@@ -30,6 +35,7 @@
 #include "common/fileid.h"
 #include "common/http_token.h"
 #include "common/stats.h"
+#include "common/trace.h"
 
 using namespace fdfs;
 
@@ -187,6 +193,59 @@ int main(int argc, char** argv) {
     h->Observe(90000);    // 100000 bucket
     h->Observe(99999999); // overflow
     printf("%s\n", reg.Json().c_str());
+    return 0;
+  }
+  if (cmd == "trace-json") {
+    // Fixed fixture — tests/test_trace.py builds the expected spans in
+    // Python and asserts every field decodes identically.
+    TraceRing ring(8);
+    TraceSpan root;
+    root.trace_id = 0x00F00DFACE12345ULL;
+    root.span_id = 0x80000001u;
+    root.parent_id = 0x10u;
+    root.start_us = 1700000000000000LL;
+    root.dur_us = 1500;
+    root.status = 0;
+    root.flags = 1;
+    root.SetName("storage.upload_file");
+    ring.Record(root);
+    TraceSpan child = root;
+    child.span_id = 0x80000002u;
+    child.parent_id = root.span_id;
+    child.start_us = root.start_us + 100;
+    child.dur_us = 900;
+    child.SetName("storage.fingerprint");
+    ring.Record(child);
+    TraceSpan slow;
+    slow.trace_id = 0xDEADBEEF00000001ULL;
+    slow.span_id = 0x80000003u;
+    slow.parent_id = 0;
+    slow.start_us = root.start_us - 50;
+    slow.dur_us = 2500000;
+    slow.status = 5;
+    slow.flags = 2;  // kTraceFlagSlow
+    slow.SetName("tracker.query_store");
+    ring.Record(slow);
+    printf("%s\n", ring.Json("storage", 23000).c_str());
+    return 0;
+  }
+  if (cmd == "trace-ctx" && argc == 3) {
+    std::string hex = argv[2];
+    uint8_t raw[16] = {0};
+    if (hex.size() != 32) {
+      fprintf(stderr, "want 32 hex chars\n");
+      return 1;
+    }
+    for (size_t i = 0; i < 16; ++i)
+      raw[i] = static_cast<uint8_t>(
+          strtoul(hex.substr(i * 2, 2).c_str(), nullptr, 16));
+    TraceCtx c = ParseTraceCtx(raw);
+    uint8_t back[16];
+    SerializeTraceCtx(c, back);
+    bool roundtrip = memcmp(raw, back, 16) == 0;
+    printf("trace_id=%016llx parent=%08x flags=%u roundtrip=%d\n",
+           static_cast<unsigned long long>(c.trace_id), c.parent_span,
+           c.flags, roundtrip ? 1 : 0);
     return 0;
   }
   if (cmd == "b64e" && argc == 3) {

@@ -54,6 +54,12 @@ int main(int argc, char** argv) {
   cfg.tracker_peers = ini.GetAll("tracker_server");
   cfg.use_storage_id = ini.GetBool("use_storage_id", false);
   cfg.storage_ids_file = ini.GetStr("storage_ids_filename", "");
+  cfg.trace_buffer_size = static_cast<int>(
+      ini.GetInt("trace_buffer_size", cfg.trace_buffer_size));
+  if (cfg.trace_buffer_size < 16) cfg.trace_buffer_size = 16;
+  cfg.slow_request_threshold_ms =
+      ini.GetInt("slow_request_threshold_ms", cfg.slow_request_threshold_ms);
+  if (cfg.slow_request_threshold_ms < 0) cfg.slow_request_threshold_ms = 0;
   if (cfg.base_path.empty()) {
     std::fprintf(stderr, "config error: base_path is required\n");
     return 1;

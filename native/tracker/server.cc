@@ -71,6 +71,28 @@ std::string PackTargetList(const std::string& group, uint8_t path_idx,
   return out;
 }
 
+// Monitor-facing span names for the tracker opcodes worth reading on a
+// timeline; everything else renders as "tracker.cmd<N>".
+const char* TrackerOpName(uint8_t cmd) {
+  switch (static_cast<TrackerCmd>(cmd)) {
+    case TrackerCmd::kStorageJoin: return "tracker.storage_join";
+    case TrackerCmd::kStorageBeat: return "tracker.storage_beat";
+    case TrackerCmd::kServiceQueryStoreWithoutGroupOne:
+    case TrackerCmd::kServiceQueryStoreWithGroupOne:
+      return "tracker.query_store";
+    case TrackerCmd::kServiceQueryStoreWithoutGroupAll:
+    case TrackerCmd::kServiceQueryStoreWithGroupAll:
+      return "tracker.query_store_all";
+    case TrackerCmd::kServiceQueryFetchOne: return "tracker.query_fetch";
+    case TrackerCmd::kServiceQueryFetchAll: return "tracker.query_fetch_all";
+    case TrackerCmd::kServiceQueryUpdate: return "tracker.query_update";
+    case TrackerCmd::kServerClusterStat: return "tracker.cluster_stat";
+    case TrackerCmd::kServerListAllGroups: return "tracker.list_groups";
+    case TrackerCmd::kStorageSyncReport: return "tracker.sync_report";
+    default: return nullptr;
+  }
+}
+
 }  // namespace
 
 TrackerServer::TrackerServer(TrackerConfig cfg) : cfg_(std::move(cfg)) {}
@@ -110,6 +132,37 @@ bool TrackerServer::Init(std::string* error) {
       &loop_, [this](uint8_t cmd, const std::string& body,
                      const std::string& peer) { return Handle(cmd, body, peer); });
   server_->set_max_connections(cfg_.max_connections);
+  // Span recording: one span per traced request (TRACE_CTX prefix) or
+  // per slow request (force-retained with kTraceFlagSlow + one
+  // structured JSON log line), dumped via kTraceDump.
+  trace_ = std::make_unique<TraceRing>(
+      static_cast<size_t>(cfg_.trace_buffer_size));
+  server_->set_trace_hook([this](uint8_t cmd, const TraceCtx& ctx,
+                                 int64_t start_us, int64_t dur_us,
+                                 uint8_t status, const std::string& peer) {
+    int64_t slow_us = cfg_.slow_request_threshold_ms * 1000;
+    bool slow = slow_us > 0 && dur_us >= slow_us;
+    if (!ctx.valid() && !slow) return;
+    TraceSpan s;
+    s.trace_id = ctx.valid() ? ctx.trace_id : trace_->NewTraceId();
+    s.span_id = trace_->NextSpanId();
+    s.parent_id = ctx.parent_span;
+    s.start_us = start_us;
+    s.dur_us = dur_us;
+    s.status = status;
+    s.flags = ctx.flags | (slow ? kTraceFlagSlow : 0);
+    const char* name = TrackerOpName(cmd);
+    char fallback[24];
+    if (name == nullptr) {
+      std::snprintf(fallback, sizeof(fallback), "tracker.cmd%d", cmd);
+      name = fallback;
+    }
+    s.SetName(name);
+    trace_->Record(s);
+    if (slow)
+      FDFS_LOG_WARN("%s",
+                    SlowRequestJson("tracker", s.name, s, peer, 0).c_str());
+  });
   if (!server_->Listen(cfg_.bind_addr, cfg_.port, error)) return false;
 
   loop_.AddTimer(1000, [this]() {
@@ -553,6 +606,12 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
 
     case TrackerCmd::kServerListAllGroups:
       return {0, cluster_->GroupsJson()};
+
+    case TrackerCmd::kTraceDump:
+      // Span ring dump (empty body).  Shape is the cross-language
+      // contract decoded by fastdfs_tpu.trace.decode_dump.
+      return {0, trace_ != nullptr ? trace_->Json("tracker", cfg_.port)
+                                   : "{\"role\":\"tracker\",\"spans\":[]}"};
 
     case TrackerCmd::kServerClusterStat: {
       // One-RPC observability dump: tracker role + every group/storage

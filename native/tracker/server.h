@@ -48,6 +48,12 @@ struct TrackerConfig {
   // whose IPs may change.
   bool use_storage_id = false;
   std::string storage_ids_file;
+  // Distributed tracing (common/trace.h): span ring capacity and the
+  // slow-request threshold — any request slower than this is recorded
+  // (even untraced) and logged as one structured JSON line.  0 = slow
+  // gate off.
+  int trace_buffer_size = 2048;
+  int64_t slow_request_threshold_ms = 1000;
 };
 
 class TrackerServer {
@@ -72,6 +78,7 @@ class TrackerServer {
 
   TrackerConfig cfg_;
   std::map<std::string, int64_t> trunk_fetched_ms_;  // follower cache age
+  std::unique_ptr<TraceRing> trace_;  // span buffer behind kTraceDump
   std::unique_ptr<Cluster> cluster_;
   std::unique_ptr<RelationshipManager> relationship_;
   EventLoop loop_;

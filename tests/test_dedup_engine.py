@@ -28,6 +28,29 @@ def test_fingerprint_digests_match_hashlib():
         assert raw[i * 20:(i + 1) * 20] == hashlib.sha1(data[off:off + ln]).digest()
 
 
+def test_fingerprint_multi_tile_digests_match_hashlib():
+    """Backend-pinning regression (ADVICE r5): dispatch MANY tiles per
+    bucket so the rotated staging buffers are reused across
+    asynchronously-dispatched batches — if a backend ever holds the host
+    buffer zero-copy past dispatch, a reused buffer would corrupt an
+    earlier tile's digests and this comparison fails loudly."""
+    # Tiny row tile => a few thousand chunks span dozens of tile groups
+    # per pow2 bucket, exercising slot reuse (tile_no % 2) many times.
+    cfg = DedupConfig(min_size=64, avg_bits=8, max_size=1024, row_tile=16)
+    rng = np.random.RandomState(7)
+    data = _rand(rng, 300_000)
+    eng = DedupEngine(cfg)
+    spans, digests, sigs = eng.fingerprint(data)
+    assert sum(ln for _, ln in spans) == len(data)
+    n_tiles = -(-len(spans) // cfg.row_tile)
+    assert n_tiles > 2 * 2, "input too small to exercise slot reuse"
+    raw = digests.astype(">u4").tobytes()
+    for i, (off, ln) in enumerate(spans):
+        assert raw[i * 20:(i + 1) * 20] == \
+            hashlib.sha1(data[off:off + ln]).digest(), f"chunk {i} corrupted"
+    assert sigs.shape == (len(spans), cfg.num_perms)
+
+
 def test_exact_dedup_same_file_twice():
     rng = np.random.RandomState(2)
     data = _rand(rng, 30_000)

@@ -64,7 +64,11 @@ def test_beat_stats_tolerates_short_and_long_vectors():
 def _sample_registry() -> dict:
     return {
         "counters": {"op.upload_file.count": 4, "op.upload_file.errors": 1},
-        "gauges": {"server.connections": 2, "sync.peer.10.0.0.2:23000.lag_s": 7},
+        "gauges": {"server.connections": 2, "sync.peer.10.0.0.2:23000.lag_s": 7,
+                   # tracing health (PR 2): ring throughput/overwrite
+                   # pressure and the slow-request gate
+                   "trace.spans_recorded": 12, "trace.spans_dropped": 3,
+                   "trace.slow_requests": 1},
         "histograms": {
             "op.upload_file.latency_us": {
                 "bounds": [100, 1000, 10000],
@@ -166,6 +170,11 @@ def test_prometheus_exposition_parses():
     assert series["fdfs_storage_recovery_chunks_fetched"][0][1] == 11.0
     # Registry metrics carry the storage label; histograms are cumulative.
     assert series["fdfs_op_upload_file_count"][0][1] == 4.0
+    # Trace-counter golden: the tracing gauges export per-storage.
+    assert series["fdfs_trace_spans_recorded"][0] == (
+        '{storage="127.0.0.1:23000"}', 12.0)
+    assert series["fdfs_trace_spans_dropped"][0][1] == 3.0
+    assert series["fdfs_trace_slow_requests"][0][1] == 1.0
     buckets = series["fdfs_op_upload_file_latency_us_bucket"]
     values = [v for _, v in buckets]
     assert values == sorted(values), "histogram buckets must be cumulative"
@@ -290,6 +299,9 @@ def test_stat_opcodes_and_monitor_cli(tmp_path):
         # dedup verdict: named gauges moved, not just log lines
         assert reg["gauges"]["store.dedup_hits"] >= 1
         assert reg["gauges"]["store.dedup_bytes_saved"] >= len(data)
+        # tracing health gauges are pre-registered (0 with no traces)
+        assert reg["gauges"]["trace.spans_recorded"] >= 0
+        assert reg["gauges"]["trace.slow_requests"] >= 0
 
         # -- tracker-side cluster stat: capacity, liveness, beat payload
         with TrackerClient("127.0.0.1", tracker.port) as tc:

@@ -14,9 +14,20 @@ can't: WHERE does an upload's time go — network receive, fingerprinting
 chunk-store writes, or the binlog — the attribution SURVEY.md §3.1 marks
 on the reference's ``dio_write_file()`` hot loop.
 
-Usage:  python tools/access_log_stages.py <access.log> [--json]
+The daemon's slow-request gate (storage.conf:slow_request_threshold_ms)
+additionally interleaves one compact-JSON line per slow request:
+
+    {"event":"slow_request","role":"storage","op":...,"trace_id":...,
+     "span_id":...,"start_us":...,"dur_us":...,"status":...,"peer":...,
+     "bytes":...}
+
+``aggregate`` skips those (a compact JSON line is a single token);
+``slow_requests`` ingests them, and ``--slow`` renders them with the
+``cli.py trace --trace-id`` command that drills into each one.
+
+Usage:  python tools/access_log_stages.py <access.log> [--json] [--slow]
 Import: ``aggregate(path) -> dict``  (bench_configs embeds the result in
-its artifacts).
+its artifacts); ``slow_requests(path) -> list[dict]``.
 """
 
 from __future__ import annotations
@@ -44,13 +55,32 @@ def _pct(sorted_vals: list[int], q: float) -> int:
     return sorted_vals[i]
 
 
+def slow_requests(path: str) -> list[dict]:
+    """The structured slow-request JSON lines, in file order.  Malformed
+    or non-slow JSON lines are skipped (the log interleaves formats)."""
+    out: list[dict] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("event") == "slow_request":
+                out.append(rec)
+    return out
+
+
 def aggregate(path: str) -> dict:
-    """Per-command stage totals, means, and latency percentiles."""
+    """Per-command stage totals, means, and latency percentiles.
+    Slow-request JSON lines are ignored here (see ``slow_requests``)."""
     per_cmd: dict[int, dict] = {}
     with open(path) as fh:
         for line in fh:
             f = line.split()
-            if len(f) < 8:
+            if len(f) < 8 or f[0].startswith("{"):
                 continue
             try:
                 cmd, status = int(f[2]), int(f[3])
@@ -112,7 +142,26 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("log", help="path to access.log")
     ap.add_argument("--json", action="store_true", help="raw JSON output")
+    ap.add_argument("--slow", action="store_true",
+                    help="show the structured slow-request lines instead")
     args = ap.parse_args()
+    if args.slow:
+        slow = slow_requests(args.log)
+        if args.json:
+            json.dump(slow, sys.stdout, indent=2)
+            print()
+            return 0
+        for rec in slow:
+            print(f"{rec.get('role', '?')} {rec.get('op', '?')} "
+                  f"dur={rec.get('dur_us', 0) / 1000:.1f}ms "
+                  f"status={rec.get('status', 0)} "
+                  f"peer={rec.get('peer', '')} "
+                  f"trace_id={rec.get('trace_id', '')}  "
+                  f"(drill in: cli.py trace <tracker> "
+                  f"--trace-id {rec.get('trace_id', '')})")
+        if not slow:
+            print("no slow-request records")
+        return 0
     agg = aggregate(args.log)
     if args.json:
         json.dump(agg, sys.stdout, indent=2)

@@ -17,7 +17,7 @@ else
   PYTEST_ARGS=(tests/test_storage_daemon.py tests/test_tracker_daemon.py
     tests/test_replication.py tests/test_trunk.py
     tests/test_chunked_storage.py tests/test_disk_recovery.py
-    tests/test_multi_tracker.py)
+    tests/test_multi_tracker.py tests/test_trace.py)
 fi
 
 run_one() {
@@ -26,6 +26,11 @@ run_one() {
   cmake -S native -B "$dir" -G Ninja -DSANITIZE="$2" \
         -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
   ninja -C "$dir"
+  echo "=== $san: native unit tests (incl. trace-ring concurrency) ==="
+  # common_test's TestTraceRingThreaded hammers the lock-light span ring
+  # from 4 recorders + a dumping reader — the TSan run is the proof the
+  # seqlock-free design is data-race-free, not just lucky.
+  "$dir/common_test"
   echo "=== $san: daemon suite ==="
   # halt_on_error keeps a failing daemon loud; leak detection stays on
   # for asan (daemons shut down cleanly in the harness).
