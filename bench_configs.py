@@ -53,9 +53,9 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 HB = "heart_beat_interval = 1\nstat_report_interval = 1"
 
 NOMINAL = {1: 1 << 30, 2: 10 << 30, 3: 50 << 30, 4: 100 << 30,
-           5: 500 << 30}
+           5: 500 << 30, 6: 10 << 30}
 DEFAULT_SCALE = {1: 0.25, 2: 1 / 32.0, 3: 1 / 64.0, 4: 1 / 40.0,
-                 5: 1 / 2000.0}
+                 5: 1 / 2000.0, 6: 1 / 256.0}
 
 
 def emit(out_dir: str, config: int, payload: dict) -> None:
@@ -1000,10 +1000,91 @@ def config5(out_dir: str, scale: float) -> None:
     })
 
 
+def config6(out_dir: str, scale: float) -> None:
+    """Wire-dedup on the ingest edge (PR 3): negotiated uploads
+    (UPLOAD_RECIPE/UPLOAD_CHUNKS) against a real daemon, recording
+    uploaded-vs-saved wire bytes.  CPU only — client CDC is the NumPy
+    gear path, digests hashlib, daemon dedup_mode=cpu — so the artifact
+    regenerates anywhere.
+
+    Three passes over one corpus of 256 KB blobs:
+      1. cold: every chunk is new — the negotiated path ships ~100%;
+      2. warm: byte-identical re-upload — ships ~0 (the acceptance bar);
+      3. edited: each blob's tail mutated — ships only the changed
+         chunks (the realistic mixed case).
+    """
+    import tempfile
+
+    total = int(NOMINAL[6] * scale)
+    blob = 256 << 10
+    n_files = max(total // blob, 4)
+    rng = np.random.RandomState(6)
+    corpus = [rng.randint(0, 256, blob, dtype=np.uint8).tobytes()
+              for _ in range(n_files)]
+    edited = []
+    for data in corpus:
+        buf = bytearray(data)
+        # rewrite the trailing ~12%: head chunks dedup, tail ships
+        cut = len(buf) - len(buf) // 8
+        buf[cut:] = rng.randint(0, 256, len(buf) - cut,
+                                dtype=np.uint8).tobytes()
+        edited.append(bytes(buf))
+
+    tmp = tempfile.mkdtemp(prefix="fdfs_cfg6_")
+    tr, sts, cli = _cluster(tmp, n_storages=1, dedup_mode="cpu")
+    try:
+        _upload_retry(cli, b"warmup " * 64)
+
+        def run_pass(files):
+            sent = 0
+            logical = 0
+            t0 = time.time()
+            for data in files:
+                stats = {}
+                cli.upload_buffer_dedup(data, ext="bin", min_dup_ratio=0,
+                                        stats=stats)
+                assert stats["fallback"] == "", stats
+                sent += stats["bytes_sent"]
+                logical += len(data)
+            return {"files": len(files), "logical_bytes": logical,
+                    "wire_bytes_sent": sent,
+                    "bytes_saved": logical - sent,
+                    "saved_ratio": round(1 - sent / logical, 4),
+                    "seconds": round(time.time() - t0, 3)}
+
+        cold = run_pass(corpus)
+        warm = run_pass(corpus)
+        part = run_pass(edited)
+
+        from fastdfs_tpu.client.client import StorageClient
+        with StorageClient(sts[0].ip, sts[0].port) as sc:
+            counters = sc.stat()["counters"]
+        ingest = {k: v for k, v in counters.items()
+                  if k.startswith("ingest.")}
+    finally:
+        cli.close()
+        for st in sts:
+            st.stop()
+        tr.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    emit(out_dir, 6, {
+        "description": "dedup-aware negotiated uploads: uploaded-vs-saved "
+                       "wire bytes on the ingest edge (cold / warm / "
+                       "tail-edited passes; CPU-only pipeline)",
+        "nominal_bytes": NOMINAL[6],
+        "scaled_bytes": sum(len(d) for d in corpus),
+        "cold": cold, "warm": warm, "edited": part,
+        "warm_saved_ratio": warm["saved_ratio"],
+        "ingest_counters": ingest,
+        "warm_pass_ok": warm["saved_ratio"] > 0.9,
+    })
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=0,
-                    help="which config (1-5); 0 = all")
+                    help="which config (1-6); 0 = all")
     ap.add_argument("--scale", type=float, default=None,
                     help="fraction of the nominal corpus size")
     ap.add_argument("--full", action="store_true",
@@ -1011,8 +1092,9 @@ def main() -> None:
     ap.add_argument("--out", default=os.path.join(REPO, "bench_artifacts"))
     args = ap.parse_args()
 
-    fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
-    which = [args.config] if args.config else [1, 2, 3, 4, 5]
+    fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
+           6: config6}
+    which = [args.config] if args.config else [1, 2, 3, 4, 5, 6]
     for c in which:
         scale = 1.0 if args.full else (
             args.scale if args.scale is not None else DEFAULT_SCALE[c])

@@ -25,13 +25,34 @@ def _client(conf_or_addr: str) -> FdfsClient:
 
 def cmd_upload(c: FdfsClient, args: list[str]) -> int:
     if not args:
-        print("usage: upload <tracker> <local_file> [ext]", file=sys.stderr)
+        print("usage: upload <tracker> [--dedup] <local_file> [ext]",
+              file=sys.stderr)
         return 2
+    dedup = args[0] == "--dedup"
+    if dedup:
+        args = args[1:]
+        if not args:
+            print("usage: upload <tracker> [--dedup] <local_file> [ext]",
+                  file=sys.stderr)
+            return 2
     path = args[0]
     ext = args[1] if len(args) > 1 else os.path.splitext(path)[1].lstrip(".")[:6]
     with open(path, "rb") as fh:
-        fid = c.upload_buffer(fh.read(), ext=ext)
-    print(fid)
+        data = fh.read()
+    if dedup:
+        # Negotiated upload: fingerprint locally, ship only chunks the
+        # daemon lacks; report the wire savings alongside the file ID.
+        stats: dict = {}
+        fid = c.upload_buffer_dedup(data, ext=ext, min_dup_ratio=0,
+                                    stats=stats)
+        print(fid)
+        sent = stats.get("bytes_sent", len(data))
+        print(f"wire: {sent}/{len(data)} bytes shipped"
+              + (f" (fallback: {stats['fallback']})"
+                 if stats.get("fallback") else ""), file=sys.stderr)
+    else:
+        fid = c.upload_buffer(data, ext=ext)
+        print(fid)
     return 0
 
 

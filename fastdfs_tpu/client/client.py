@@ -8,6 +8,7 @@ tracker for a storage target and talks to it directly.
 from __future__ import annotations
 
 import random
+from collections import OrderedDict
 
 from fastdfs_tpu.client.conn import ConnectionPool, ProtocolError, StatusError
 from fastdfs_tpu.client.storage_client import RemoteFileInfo, StorageClient
@@ -20,7 +21,10 @@ class FdfsClient:
     in SURVEY.md §3.1)."""
 
     def __init__(self, tracker_addrs: list[str] | str, timeout: float = 30.0,
-                 use_pool: bool = True):
+                 use_pool: bool = True, dedup_uploads: bool = False,
+                 dedup_min_bytes: int = 64 * 1024,
+                 dedup_min_ratio: float = 0.05,
+                 dedup_digest_cache: int = 1 << 16):
         if isinstance(tracker_addrs, str):
             tracker_addrs = [tracker_addrs]
         if not tracker_addrs:
@@ -37,13 +41,29 @@ class FdfsClient:
         # spans stitch under the client's open span (trace.traced_upload
         # installs one around a single operation).
         self.tracer = None
+        # Dedup-aware negotiated uploads (opt-in): when enabled,
+        # upload_buffer routes through upload_buffer_dedup.  The
+        # negotiation costs one extra round-trip, so small payloads
+        # (< dedup_min_bytes) and payloads whose ESTIMATED dup ratio —
+        # the fraction of chunk digests this client has uploaded
+        # recently (bounded LRU) — falls below dedup_min_ratio go
+        # straight to the classic single-RTT UPLOAD_FILE instead.
+        self.dedup_uploads = dedup_uploads
+        self.dedup_min_bytes = dedup_min_bytes
+        self.dedup_min_ratio = dedup_min_ratio
+        self._dedup_digest_cache = dedup_digest_cache
+        self._seen_digests: OrderedDict[bytes, None] = OrderedDict()
 
     @classmethod
     def from_conf(cls, conf_path: str) -> "FdfsClient":
         cfg = IniConfig.load(conf_path)
         addrs = cfg.get_all("tracker_server")
         return cls(addrs, timeout=float(cfg.get_seconds("network_timeout", 30)),
-                   use_pool=bool(cfg.get_bool("use_connection_pool", True)))
+                   use_pool=bool(cfg.get_bool("use_connection_pool", True)),
+                   dedup_uploads=bool(cfg.get_bool("dedup_uploads", False)),
+                   dedup_min_bytes=int(cfg.get_bytes("dedup_min_bytes",
+                                                     64 * 1024)),
+                   dedup_min_ratio=float(cfg.get("dedup_min_ratio", 0.05)))
 
     def close(self) -> None:
         if self.pool is not None:
@@ -118,11 +138,70 @@ class FdfsClient:
 
     def upload_buffer(self, data: bytes, ext: str = "",
                       group: str | None = None, appender: bool = False) -> str:
+        if self.dedup_uploads and not appender:
+            return self.upload_buffer_dedup(data, ext=ext, group=group)
+        return self._upload_buffer_plain(data, ext=ext, group=group,
+                                         appender=appender)
+
+    def _upload_buffer_plain(self, data: bytes, ext: str = "",
+                             group: str | None = None,
+                             appender: bool = False) -> str:
+        # The classic single-RTT path; also every dedup fallback's target
+        # (it must never re-enter the dedup gate, or a fallback recurses).
         tgt = self._with_tracker(lambda t: t.query_store(group))
         with self._storage(tgt) as s:
             return s.upload_buffer(data, ext=ext,
                                    store_path_index=tgt.store_path_index,
                                    appender=appender)
+
+    def _remember_digests(self, chunks) -> None:
+        cache = self._seen_digests
+        for _, digest in chunks:
+            cache[digest] = None
+            cache.move_to_end(digest)
+        while len(cache) > self._dedup_digest_cache:
+            cache.popitem(last=False)
+
+    def upload_buffer_dedup(self, data: bytes, ext: str = "",
+                            group: str | None = None,
+                            min_dup_ratio: float | None = None,
+                            stats: dict | None = None) -> str:
+        """Dedup-aware negotiated upload (UPLOAD_RECIPE/UPLOAD_CHUNKS):
+        fingerprint locally, then ship only chunks the storage daemon's
+        content-addressed store lacks — a warm re-upload moves ~0 data
+        bytes.  Falls back to a plain UPLOAD_FILE transparently when:
+
+        - the payload is small (< dedup_min_bytes — below the daemon's
+          chunking threshold the recipe cannot be stored anyway);
+        - the estimated dup ratio (recently-uploaded-digest LRU hit
+          fraction) is under ``min_dup_ratio`` — fresh content would pay
+          the extra round-trip for nothing (pass 0 to always negotiate);
+        - the daemon lacks the opcodes or a chunk store, or the session
+          fails mid-flight (StorageClient-level fallback).
+        """
+        if stats is None:
+            stats = {}
+        ratio_floor = (self.dedup_min_ratio if min_dup_ratio is None
+                       else min_dup_ratio)
+        if len(data) < self.dedup_min_bytes:
+            stats.update(fallback="small", bytes_sent=len(data))
+            return self._upload_buffer_plain(data, ext=ext, group=group)
+        from fastdfs_tpu.client.fingerprint import fingerprint_buffer
+        chunks = [(fp.length, fp.digest) for fp in fingerprint_buffer(data)]
+        if ratio_floor > 0:
+            hits = sum(1 for _, d in chunks if d in self._seen_digests)
+            estimate = hits / len(chunks) if chunks else 0.0
+            stats["estimated_dup_ratio"] = estimate
+            if estimate < ratio_floor:
+                self._remember_digests(chunks)
+                stats.update(fallback="low_estimate", bytes_sent=len(data))
+                return self._upload_buffer_plain(data, ext=ext, group=group)
+        self._remember_digests(chunks)
+        tgt = self._with_tracker(lambda t: t.query_store(group))
+        with self._storage(tgt) as s:
+            return s.upload_buffer_dedup(
+                data, ext=ext, store_path_index=tgt.store_path_index,
+                chunks=chunks, stats=stats)
 
     def download_to_buffer(self, file_id: str, offset: int = 0,
                            length: int = 0) -> bytes:

@@ -63,27 +63,60 @@ class Connection:
 
     def send_request(self, cmd: int, body: bytes = b"",
                      body_len: int | None = None) -> None:
+        """Send one framed request.
+
+        ``body`` is either bytes, or an ITERABLE of bytes segments (then
+        ``body_len`` — the total — is required): multi-GB uploads stream
+        through in bounded segments instead of materializing in memory.
+        """
         # The server closes a connection after an error response that left
         # request bytes unread (it cannot resync mid-stream).  A request
         # boundary is the one safe place to reconnect, so retry once — the
         # same recovery the reference's connection pool performs.
-        hdr = pack_header(len(body) if body_len is None else body_len, cmd)
+        streaming = not isinstance(body, (bytes, bytearray, memoryview))
+        if streaming and body_len is None:
+            raise ValueError("iterable body requires body_len")
+        hdr = pack_header((len(body) if body_len is None else body_len), cmd)
         if self.trace_ctx is not None:
             # Prefix frame first: the daemon stashes the context and
             # applies it to this request (it sends no response of its
             # own, so request/response pairing is unchanged).
             hdr = self.trace_ctx.frame() + hdr
+        first = hdr if streaming else hdr + bytes(body)
         try:
-            self.sock.sendall(hdr + body)
+            self.sock.sendall(first)
         except OSError:
+            # Nothing of a streamed body has been consumed yet (only the
+            # header went to the dead socket), so a single reconnect is
+            # still safe for both shapes.
             self.close()
             self.sock = self._connect()
             self.broken = False
             try:
-                self.sock.sendall(hdr + body)
+                self.sock.sendall(first)
             except OSError:
                 self.broken = True
                 raise
+        if streaming:
+            # Past the header there is no safe resend point: a partially
+            # streamed body on a reconnected socket would desync framing.
+            # ANY failure — socket error or the source iterable raising
+            # (e.g. a closed file wrapper) — marks the connection broken
+            # so the pool can never re-issue the desynced stream.
+            sent = 0
+            try:
+                for seg in body:
+                    if seg:
+                        self.sock.sendall(seg)
+                        sent += len(seg)
+            except BaseException:
+                self.broken = True
+                raise
+            if sent != body_len:
+                self.broken = True
+                raise ProtocolError(
+                    f"streaming body produced {sent} bytes, "
+                    f"declared {body_len}")
 
     def send_raw(self, data: bytes) -> None:
         try:

@@ -1,0 +1,114 @@
+"""Client-side chunk fingerprinting for dedup-aware negotiated uploads.
+
+The negotiated upload protocol (UPLOAD_RECIPE / UPLOAD_CHUNKS) moves the
+fingerprint work from the storage daemon to the ingest edge: the client
+chunks and hashes the payload locally, and only ships chunk bytes the
+daemon's content-addressed store has never seen.  Correctness therefore
+depends on the client producing the SAME cut points and digests as every
+daemon-side path:
+
+- cut points come from the shared gear CDC spec (``ops.gear_cdc``: one
+  generated table, 32-byte window, identical greedy selection) — the
+  NumPy twin ``chunk_stream_np`` on plain hosts, the JAX/Pallas
+  ``chunk_stream`` when a TPU backend is up;
+- digests are SHA1 over the raw chunk bytes — ``hashlib`` on plain
+  hosts (C speed, no batch to amortize), ``ops.sha1.sha1_batch`` on TPU
+  where the batched kernel amortizes the device round-trip.
+
+Like every dedup feature here, this is an optimization layer: a caller
+getting ``fingerprint_buffer`` wrong cannot corrupt the store (the
+daemon re-verifies SHA1(payload) == digest before admitting any byte).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from fastdfs_tpu.ops import gear_cdc
+
+
+@dataclass(frozen=True)
+class ChunkFingerprint:
+    length: int
+    digest: bytes  # 20-byte raw SHA1
+
+
+def _tpu_up() -> bool:
+    """True only when JAX is importable AND its default backend is a real
+    TPU — a thin client on a CPU host must not pay a JAX import/compile
+    just to fingerprint an upload."""
+    try:
+        import jax
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _digests_tpu(data: bytes, cuts: list[int]) -> list[bytes] | None:
+    """Batched SHA1 on the accelerator, bucketed by pow2 chunk length so
+    each shape compiles once (the dedup engine's discipline).  None on
+    any failure — the caller falls back to hashlib."""
+    try:
+        import numpy as np
+
+        from fastdfs_tpu.ops.sha1 import sha1_batch
+
+        out: list[bytes | None] = [None] * len(cuts)
+        by_bucket: dict[int, list[int]] = {}
+        start = 0
+        spans = []
+        for i, end in enumerate(cuts):
+            spans.append((start, end))
+            blen = 1
+            while blen < end - start:
+                blen <<= 1
+            by_bucket.setdefault(blen, []).append(i)
+            start = end
+        for blen, idxs in by_bucket.items():
+            batch = np.zeros((len(idxs), blen), dtype=np.uint8)
+            lens = np.zeros(len(idxs), dtype=np.int32)
+            for row, i in enumerate(idxs):
+                s, e = spans[i]
+                batch[row, : e - s] = np.frombuffer(data[s:e], dtype=np.uint8)
+                lens[row] = e - s
+            words = np.asarray(sha1_batch(batch, lens), dtype=np.uint32)
+            raw = words.astype(">u4").tobytes()
+            for row, i in enumerate(idxs):
+                out[i] = raw[row * 20 : row * 20 + 20]
+        return out  # type: ignore[return-value]
+    except Exception:
+        return None
+
+
+def fingerprint_buffer(
+    data: bytes,
+    min_size: int = gear_cdc.DEFAULT_MIN_SIZE,
+    avg_bits: int = gear_cdc.DEFAULT_AVG_BITS,
+    max_size: int = gear_cdc.DEFAULT_MAX_SIZE,
+) -> list[ChunkFingerprint]:
+    """CDC-chunk ``data`` and SHA1 each chunk, exactly as the daemons do.
+
+    Returns one :class:`ChunkFingerprint` per chunk, in stream order
+    (lengths sum to ``len(data)``).  Empty input -> empty list.
+    """
+    if not data:
+        return []
+    use_tpu = _tpu_up()
+    if use_tpu:
+        cuts = gear_cdc.chunk_stream(data, min_size, avg_bits, max_size)
+    else:
+        cuts = gear_cdc.chunk_stream_np(data, min_size, avg_bits, max_size)
+    digests = _digests_tpu(data, cuts) if use_tpu else None
+    if digests is None:
+        digests = []
+        start = 0
+        for end in cuts:
+            digests.append(hashlib.sha1(data[start:end]).digest())
+            start = end
+    out = []
+    start = 0
+    for end, dig in zip(cuts, digests):
+        out.append(ChunkFingerprint(length=end - start, digest=dig))
+        start = end
+    return out

@@ -9,14 +9,15 @@ upstream — see SURVEY.md provenance warning).
 
 from __future__ import annotations
 
-import io
 import json
 import os
+import zlib
 from dataclasses import dataclass
 
 from fastdfs_tpu.client.conn import Connection, ProtocolError, StatusError
 from fastdfs_tpu.common.protocol import (
     GROUP_NAME_MAX_LEN,
+    MAX_INLINE_BODY,
     StorageCmd,
     long2buff,
     buff2long,
@@ -29,6 +30,61 @@ from fastdfs_tpu.common.protocol import (
 )
 
 AUTO_STORE_PATH = 0xFF
+
+
+def _parse_upload_response(body: bytes) -> str:
+    """Decode the shared upload response shape (16B group + remote name)
+    into a file ID — one definition for every upload variant."""
+    if len(body) <= GROUP_NAME_MAX_LEN:
+        raise ProtocolError(f"short upload response: {len(body)}")
+    group = unpack_group_name(body[:GROUP_NAME_MAX_LEN])
+    return f"{group}/{body[GROUP_NAME_MAX_LEN:].decode()}"
+
+# Segment size for streamed request bodies (uploads read the source in
+# pieces this big, so a multi-GB file holds O(segment) client memory).
+UPLOAD_SEGMENT_BYTES = 1 << 20
+
+# Statuses that mean "this daemon cannot serve a negotiated upload" (95 =
+# ENOTSUP: no chunk store; 22 = EINVAL: an OLDER daemon rejecting the
+# unknown opcode) — the client falls back to a plain UPLOAD_FILE.
+_DEDUP_FALLBACK_STATUSES = (22, 95)
+
+
+def pack_upload_recipe(store_path_index: int, ext: str, crc32: int,
+                       logical_size: int,
+                       chunks: list[tuple[int, bytes]]) -> bytes:
+    """UPLOAD_RECIPE request body (phase 1 of the negotiated upload).
+
+    ``chunks`` is [(length, 20B raw sha1)] in stream order.  Wire: 1B
+    store-path index + 6B ext + 8B crc32 + 8B logical_size + 8B count +
+    per chunk (20B digest + 8B length) — the recipe entry encoding every
+    chunk-aware opcode shares.  Covered by the ``fdfs_codec ingest-wire``
+    cross-language golden.
+    """
+    parts = [bytes([store_path_index]), pack_ext_name(ext),
+             long2buff(crc32 & 0xFFFFFFFF), long2buff(logical_size),
+             long2buff(len(chunks))]
+    for length, digest in chunks:
+        if len(digest) != 20:
+            raise ValueError(f"digest must be 20 raw bytes, got {len(digest)}")
+        parts.append(digest)
+        parts.append(long2buff(length))
+    return b"".join(parts)
+
+
+def unpack_upload_recipe_resp(body: bytes, n_chunks: int) -> tuple[int, bytes]:
+    """(session_id, needed-bitmap) from an UPLOAD_RECIPE response; byte i
+    of the bitmap is 1 when chunk i must be shipped in phase 2."""
+    if len(body) != 8 + n_chunks:
+        raise ProtocolError(
+            f"bad UPLOAD_RECIPE response: {len(body)} != {8 + n_chunks}")
+    return buff2long(body), body[8:]
+
+
+def pack_upload_chunks_prefix(session_id: int, payload_len: int) -> bytes:
+    """UPLOAD_CHUNKS fixed prefix (phase 2): 8B session + 8B payload_len;
+    the needed chunks' payloads follow in recipe order."""
+    return long2buff(session_id) + long2buff(payload_len)
 
 
 @dataclass(frozen=True)
@@ -79,18 +135,131 @@ class StorageClient:
                else StorageCmd.UPLOAD_FILE)
         fixed = bytes([store_path_index]) + long2buff(len(data)) + pack_ext_name(ext)
         self.conn.send_request(cmd, fixed + data)
-        body = self.conn.recv_response("upload")
-        if len(body) <= GROUP_NAME_MAX_LEN:
-            raise ProtocolError(f"short upload response: {len(body)}")
-        group = unpack_group_name(body[:GROUP_NAME_MAX_LEN])
-        remote = body[GROUP_NAME_MAX_LEN:].decode()
-        return f"{group}/{remote}"
+        return _parse_upload_response(self.conn.recv_response("upload"))
+
+    def upload_stream(self, fh, size: int, ext: str = "",
+                      store_path_index: int = AUTO_STORE_PATH,
+                      appender: bool = False,
+                      segment: int = UPLOAD_SEGMENT_BYTES) -> str:
+        """Upload ``size`` bytes read from file object ``fh`` in bounded
+        segments — a multi-GB upload holds O(segment) client memory, not
+        O(file) (the body streams through ``conn.send_request``'s
+        iterable-body path)."""
+        cmd = (StorageCmd.UPLOAD_APPENDER_FILE if appender
+               else StorageCmd.UPLOAD_FILE)
+        fixed = bytes([store_path_index]) + long2buff(size) + pack_ext_name(ext)
+
+        def gen():
+            yield fixed
+            remaining = size
+            while remaining > 0:
+                seg = fh.read(min(segment, remaining))
+                if not seg:
+                    # Short source: the declared pkg_len cannot be
+                    # amended mid-stream; send_request flags the
+                    # connection broken and raises.
+                    return
+                remaining -= len(seg)
+                yield seg
+
+        self.conn.send_request(cmd, gen(), body_len=len(fixed) + size)
+        return _parse_upload_response(self.conn.recv_response("upload"))
 
     def upload_file(self, path: str, ext: str | None = None, **kw) -> str:
         if ext is None:
             ext = os.path.splitext(path)[1].lstrip(".")[:6]
+        size = os.path.getsize(path)
         with open(path, "rb") as fh:
-            return self.upload_buffer(fh.read(), ext=ext, **kw)
+            return self.upload_stream(fh, size, ext=ext, **kw)
+
+    # -- dedup-aware negotiated upload (UPLOAD_RECIPE / UPLOAD_CHUNKS) ----
+
+    def upload_buffer_dedup(self, data: bytes, ext: str = "",
+                            store_path_index: int = AUTO_STORE_PATH,
+                            chunks: list[tuple[int, bytes]] | None = None,
+                            stats: dict | None = None,
+                            segment: int = UPLOAD_SEGMENT_BYTES) -> str:
+        """Upload via the negotiated two-round-trip protocol: fingerprint
+        locally, ask the daemon which chunks it lacks, ship only those.
+
+        ``chunks`` short-circuits fingerprinting when the caller already
+        has [(length, 20B raw sha1)] (FdfsClient computes it once for its
+        dup-ratio estimate).  Falls back to a plain ``upload_buffer``
+        transparently when the daemon has no chunk store (ENOTSUP), is
+        too old to know the opcode (EINVAL), or the session fails
+        mid-flight — same file ID semantics either way.  ``stats`` (if
+        given) is updated with chunks_total / chunks_missing /
+        bytes_sent / fallback for accounting and tests.
+        """
+        if chunks is None:
+            from fastdfs_tpu.client.fingerprint import fingerprint_buffer
+            chunks = [(fp.length, fp.digest)
+                      for fp in fingerprint_buffer(data)]
+        if stats is None:
+            stats = {}
+        stats.update(chunks_total=len(chunks), chunks_missing=len(chunks),
+                     bytes_sent=len(data), fallback="")
+        if not chunks:  # empty payload: nothing to negotiate over
+            stats["fallback"] = "empty"
+            return self.upload_buffer(data, ext=ext,
+                                      store_path_index=store_path_index)
+        body = pack_upload_recipe(store_path_index, ext, zlib.crc32(data),
+                                  len(data), chunks)
+        if len(body) > MAX_INLINE_BODY:
+            # The daemon refuses (connection close, no status) inline
+            # bodies over the wire cap; a ~19 GB payload at the default
+            # chunk size gets there.  Gate locally and fall back.
+            stats["fallback"] = "recipe_too_large"
+            return self.upload_buffer(data, ext=ext,
+                                      store_path_index=store_path_index)
+        try:
+            self.conn.send_request(StorageCmd.UPLOAD_RECIPE, body)
+            resp = self.conn.recv_response("upload_recipe")
+        except StatusError as e:
+            if e.status in _DEDUP_FALLBACK_STATUSES:
+                stats["fallback"] = f"status{e.status}"
+                return self.upload_buffer(data, ext=ext,
+                                          store_path_index=store_path_index)
+            raise
+        session, needed = unpack_upload_recipe_resp(resp, len(chunks))
+
+        spans: list[tuple[int, int]] = []  # (offset, length) to ship
+        payload_len = 0
+        offset = 0
+        missing = 0
+        for (length, _), need in zip(chunks, needed):
+            if need:
+                spans.append((offset, length))
+                payload_len += length
+                missing += 1
+            offset += length
+
+        def gen():
+            yield pack_upload_chunks_prefix(session, payload_len)
+            for off, length in spans:
+                # Bounded segments even when one span is huge (max chunk
+                # is 8 MB, but keep the discipline uniform).
+                end = off + length
+                while off < end:
+                    yield data[off:min(off + segment, end)]
+                    off = min(off + segment, end)
+
+        try:
+            self.conn.send_request(StorageCmd.UPLOAD_CHUNKS, gen(),
+                                   body_len=16 + payload_len)
+            body = self.conn.recv_response("upload_chunks")
+        except StatusError as e:
+            # Session expired / chunk vanished mid-commit: the daemon
+            # rolled back; re-send the whole payload the classic way.
+            # Honest wire accounting: the failed attempt's payload bytes
+            # DID cross the wire on top of the plain re-send.
+            stats.update(fallback=f"commit_status{e.status}",
+                         chunks_missing=missing,
+                         bytes_sent=payload_len + len(data))
+            return self.upload_buffer(data, ext=ext,
+                                      store_path_index=store_path_index)
+        stats.update(chunks_missing=missing, bytes_sent=payload_len)
+        return _parse_upload_response(body)
 
     def upload_slave_buffer(self, master_id: str, prefix: str, data: bytes,
                             ext: str = "") -> str:
@@ -108,11 +277,7 @@ class StorageClient:
                 + long2buff(len(data)) + pack_prefix_name(prefix)
                 + pack_ext_name(ext) + name + data)
         self.conn.send_request(StorageCmd.UPLOAD_SLAVE_FILE, body)
-        resp = self.conn.recv_response("upload_slave")
-        if len(resp) <= GROUP_NAME_MAX_LEN:
-            raise ProtocolError(f"short upload response: {len(resp)}")
-        return (f"{unpack_group_name(resp[:GROUP_NAME_MAX_LEN])}/"
-                f"{resp[GROUP_NAME_MAX_LEN:].decode()}")
+        return _parse_upload_response(self.conn.recv_response("upload_slave"))
 
     # -- appender-file mutations -------------------------------------------
 

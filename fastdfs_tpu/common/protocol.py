@@ -282,6 +282,30 @@ class StorageCmd(enum.IntEnum):
     # {"role","port","spans":[...]} per fastdfs_tpu.trace.decode_dump
     # (cross-language golden: fdfs_codec trace-json).
     TRACE_DUMP = 131
+    # Dedup-aware negotiated upload (fastdfs_tpu extension; no reference
+    # equivalent — upstream ships every byte of every upload).  The
+    # client chunks + fingerprints locally (the same gear CDC + SHA1 the
+    # daemons run, so cut points agree cluster-wide) and only ships
+    # chunk bytes the storage's content-addressed ChunkStore lacks:
+    #   UPLOAD_RECIPE: 1B store_path_index (0xFF = server picks) + 6B
+    #     ext + 8B crc32 + 8B logical_size + 8B chunk_count + per chunk
+    #     (20B raw digest + 8B length) -> response 8B session_id +
+    #     chunk_count bytes (0 = present, 1 = needed), with the present
+    #     chunks PINNED server-side (PinRecipe discipline) until the
+    #     session commits, aborts, or times out.  ENOTSUP when the
+    #     daemon has no chunk store (client falls back to UPLOAD_FILE;
+    #     an OLDER daemon answers the unknown opcode with EINVAL, which
+    #     the client treats the same way).
+    #   UPLOAD_CHUNKS: 8B session_id + 8B payload_len + the needed
+    #     chunks' payloads concatenated in recipe order.  The daemon
+    #     verifies SHA1(payload) == digest per chunk (the replication
+    #     receiver's check), assembles the file via PutAndRef + refs +
+    #     recipe write, logs the binlog record, and answers exactly
+    #     like UPLOAD_FILE (16B group + remote filename).  ENOENT when
+    #     the session is unknown/expired (client falls back to a plain
+    #     upload).
+    UPLOAD_RECIPE = 132
+    UPLOAD_CHUNKS = 133
     # Trace-context prefix frame (same value as TrackerCmd.TRACE_CTX).
     TRACE_CTX = 140
     # Ranked near-dup report for a stored file, answered from the

@@ -243,6 +243,47 @@ def chunk_stream(
     return select_cuts(cand, n, min_size, max_size)
 
 
+def gear_hashes_np(data: bytes | np.ndarray) -> np.ndarray:
+    """Vectorized NumPy twin of :func:`gear_hashes` (same prefix-doubling
+    windowed sum, uint32 wraparound) — for hosts without an accelerator:
+    the client-side fingerprint path must not pay a per-byte Python loop
+    (``gear_hashes_ref``) or drag JAX into thin client processes."""
+    buf = (np.frombuffer(bytes(data), dtype=np.uint8)
+           if isinstance(data, (bytes, bytearray, memoryview))
+           else np.asarray(data, dtype=np.uint8))
+    with np.errstate(over="ignore"):
+        x = buf.astype(np.uint32) + np.uint32(1)
+        x ^= x >> np.uint32(16)
+        x *= np.uint32(0x85EBCA6B)
+        x ^= x >> np.uint32(13)
+        x *= np.uint32(0xC2B2AE35)
+        h = x ^ (x >> np.uint32(16))
+        w = 1
+        while w < WINDOW:
+            shifted = np.zeros_like(h)
+            shifted[w:] = h[:-w]
+            h = h + (shifted << np.uint32(w))
+            w <<= 1
+    return h
+
+
+def chunk_stream_np(
+    data: bytes,
+    min_size: int = DEFAULT_MIN_SIZE,
+    avg_bits: int = DEFAULT_AVG_BITS,
+    max_size: int = DEFAULT_MAX_SIZE,
+) -> list[int]:
+    """CPU-vectorized CDC with the exact cut points of ``chunk_stream`` /
+    ``chunk_stream_ref`` (same table, window, and selection rule)."""
+    n = len(data)
+    if n == 0:
+        return []
+    h = gear_hashes_np(data)
+    mask = np.uint32((1 << avg_bits) - 1)
+    candidates = np.nonzero((h & mask) == 0)[0]
+    return select_cuts(candidates, n, min_size, max_size)
+
+
 def chunk_stream_ref(
     data: bytes,
     min_size: int = DEFAULT_MIN_SIZE,

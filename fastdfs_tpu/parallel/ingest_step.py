@@ -123,13 +123,16 @@ def make_ingest_step(mesh: Mesh, num_perms: int = 64, avg_bits: int = 13,
         best = jax.lax.pmax(local_best, "dp")                    # (N,)
         return cand, digests, sigs, best
 
-    sharded = jax.shard_map(
-        step_local,
+    specs = dict(
         mesh=mesh,
         in_specs=(P("dp", "sp", None), P("dp", None), P("dp"), P("dp", None)),
         out_specs=(P("dp", "sp", None), P(), P(), P()),
-        check_vma=False,
     )
+    if hasattr(jax, "shard_map"):  # jax >= 0.6: top-level API, check_vma
+        sharded = jax.shard_map(step_local, **specs, check_vma=False)
+    else:  # older jax: experimental module, the flag is check_rep
+        from jax.experimental.shard_map import shard_map as _shard_map
+        sharded = _shard_map(step_local, **specs, check_rep=False)
     return jax.jit(sharded)
 
 

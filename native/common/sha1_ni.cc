@@ -13,12 +13,23 @@
 // __SHA__ keeps this gate consistent with the build flags: a platform
 // whose CMAKE_SYSTEM_PROCESSOR string missed the -msha branch compiles
 // the portable stubs below instead of failing on the intrinsics.
+#include <cpuid.h>
 #include <immintrin.h>
 
 namespace fdfs {
 
 bool Sha1NiSupported() {
-  return __builtin_cpu_supports("sha") && __builtin_cpu_supports("sse4.1");
+  // Raw cpuid rather than __builtin_cpu_supports("sha"): the "sha"
+  // feature name only exists in newer GCCs, and this gate must compile
+  // everywhere the intrinsics do.  Leaf 7/0 EBX bit 29 = SHA; leaf 1
+  // ECX bit 19 = SSE4.1.
+  unsigned eax = 0, ebx = 0, ecx = 0, edx = 0;
+  if (!__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx) ||
+      (ebx & (1u << 29)) == 0)
+    return false;
+  if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx) || (ecx & (1u << 19)) == 0)
+    return false;
+  return true;
 }
 
 // Process `nblocks` consecutive 64-byte blocks (canonical Intel SHA-NI

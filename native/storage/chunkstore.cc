@@ -237,6 +237,16 @@ std::optional<Recipe> ChunkStore::ReadRecipeAndPin(const std::string& path) {
   return r;
 }
 
+std::string ChunkStore::PinAndMask(const Recipe& r) {
+  std::string need(r.chunks.size(), '\0');
+  std::lock_guard<std::mutex> lk(mu_);
+  for (size_t i = 0; i < r.chunks.size(); ++i) {
+    need[i] = refs_.find(r.chunks[i].digest_hex) != refs_.end() ? 0 : 1;
+    pins_[r.chunks[i].digest_hex]++;
+  }
+  return need;
+}
+
 void ChunkStore::PinRecipe(const Recipe& r) {
   std::lock_guard<std::mutex> lk(mu_);
   for (const RecipeEntry& e : r.chunks) pins_[e.digest_hex]++;

@@ -88,6 +88,15 @@ class ChunkStore {
   bool ReadChunk(const std::string& digest_hex, int64_t expect_len,
                  std::string* out) const;
 
+  // Presence probe + pin in ONE lock acquisition, for the negotiated
+  // upload's phase-1 answer: byte i of the result is 0 when chunk i is
+  // live (and now pinned against unlink until the session's
+  // UnpinRecipe), 1 when the client must ship it.  A separate
+  // HaveMask-then-PinRecipe would let a delete unlink a "present" chunk
+  // in the gap; pinning absent digests is harmless (the unpin erases
+  // the entry), so every entry is pinned and the whole recipe unpins.
+  std::string PinAndMask(const Recipe& r);
+
   // Transient stream pins: an in-flight chunked download holds a pin per
   // recipe entry so a concurrent delete cannot unlink bytes it is still
   // sending (POSIX open-fd semantics for flat files, recreated here).

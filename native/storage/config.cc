@@ -66,6 +66,9 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
   dedup_segment_bytes =
       ini.GetBytes("dedup_segment_bytes", 64LL * 1024 * 1024);
   if (dedup_segment_bytes < (1 << 20)) dedup_segment_bytes = 1 << 20;
+  upload_session_timeout_s = static_cast<int>(
+      ini.GetSeconds("upload_session_timeout", upload_session_timeout_s));
+  if (upload_session_timeout_s < 1) upload_session_timeout_s = 1;
   log_level = ini.GetStr("log_level", "info");
   log_file = ini.GetStr("log_file", "");
   log_rotate_size = ini.GetBytes("log_rotate_size", log_rotate_size);

@@ -52,6 +52,13 @@ struct StorageConfig {
   // Segment size for streaming fingerprint RPCs (CDC restarts per
   // segment so a multi-GB upload never needs a contiguous buffer).
   int64_t dedup_segment_bytes = 64LL * 1024 * 1024;
+  // Negotiated-upload session lifetime: a client that sent
+  // UPLOAD_RECIPE but never completed UPLOAD_CHUNKS holds pins on the
+  // chunks its bitmap reported present; the sweep timer aborts (and
+  // unpins) sessions older than this, so a vanished client can never
+  // leak pins.  Must cover the client's think time between the two
+  // requests plus one payload upload.
+  int upload_session_timeout_s = 30;
   std::string log_level = "info";
   // Optional file sink (empty = stderr) with size/day rotation
   // (reference: logger.c; base_path-relative paths allowed).

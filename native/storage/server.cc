@@ -360,6 +360,11 @@ bool StorageServer::Init(std::string* error) {
   loop_.AddTimer(60 * 1000, [this]() {
     if (dedup_ != nullptr) dedup_->Save();
   });
+  // Negotiated-upload session sweep: a client that sent UPLOAD_RECIPE
+  // and vanished must not pin chunks forever (a pinned chunk defers its
+  // unlink on delete).  2s granularity against an upload_session_timeout
+  // measured in tens of seconds is plenty.
+  loop_.AddTimer(2000, [this]() { SweepIngestSessions(); });
   // Trunk maintenance (reference: trunk_create_file_advance + the
   // free-block checker driving compaction): keep one trunk file's worth
   // of pre-created free space ahead of demand and reclaim fully-free
@@ -477,6 +482,8 @@ constexpr ServedOp kServedOps[] = {
     {StorageCmd::kSyncTruncateFile, "sync_truncate_file"},
     {StorageCmd::kSyncQueryChunks, "sync_query_chunks"},
     {StorageCmd::kSyncCreateRecipe, "sync_create_recipe"},
+    {StorageCmd::kUploadRecipe, "upload_recipe"},
+    {StorageCmd::kUploadChunks, "upload_chunks"},
     {StorageCmd::kFetchRecipe, "fetch_recipe"},
     {StorageCmd::kFetchChunk, "fetch_chunk"},
     {StorageCmd::kTraceDump, "trace_dump"},
@@ -518,6 +525,18 @@ void StorageServer::InitStatsRegistry() {
   ctr_chunkfetch_bytes_ = registry_.Counter("chunkfetch.bytes");
   ctr_dedup_chunk_hits_ = registry_.Counter("dedup.chunk_hits");
   ctr_dedup_chunk_misses_ = registry_.Counter("dedup.chunk_misses");
+  // Negotiated uploads on the ingest edge (UPLOAD_RECIPE/UPLOAD_CHUNKS):
+  // bytes_saved_wire counts chunk bytes the client never shipped because
+  // the bitmap reported them present — the client-facing twin of
+  // sync.bytes_saved_wire.
+  ctr_ingest_recipe_uploads_ = registry_.Counter("ingest.recipe_uploads");
+  ctr_ingest_bytes_saved_wire_ =
+      registry_.Counter("ingest.bytes_saved_wire");
+  ctr_ingest_fallbacks_ = registry_.Counter("ingest.recipe_fallbacks");
+  registry_.GaugeFn("ingest.sessions_active", [this] {
+    std::lock_guard<std::mutex> lk(ingest_mu_);
+    return static_cast<int64_t>(ingest_sessions_.size());
+  });
 
   // Snapshot-time mirrors of live state.  The restart-persisted op
   // totals keep their wire names (kBeatStatNames) under "store." so the
@@ -771,6 +790,9 @@ void StorageServer::ResetForNextRequest(Conn* c) {
   c->fp_lock_us = 0;
   c->cswrite_us = 0;
   c->binlog_us = 0;
+  c->ingest_session = 0;
+  c->ingest_chunks_total = 0;
+  c->ingest_chunks_missing = 0;
   c->trace_ctx = TraceCtx{};
   c->traced = false;
   c->trace_span = 0;
@@ -869,6 +891,7 @@ void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
     case StorageCmd::kUploadFile:
     case StorageCmd::kUploadAppenderFile:
     case StorageCmd::kUploadSlaveFile:
+    case StorageCmd::kUploadChunks:  // file_size = logical, not wire bytes
       if (status == 0 && hist_upload_bytes_ != nullptr)
         hist_upload_bytes_->Observe(c->file_size);
       break;
@@ -879,44 +902,41 @@ void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
     default:
       break;
   }
-  RecordRequestSpans(c, status, now_us, bytes);
-  if (access_log_ == nullptr) {
-    c->req_start_us = 0;
-    c->recv_done_us = 0;
-    c->work_start_us = 0;
-    c->fp_us = 0;
-    c->fp_lock_us = 0;
-    c->cswrite_us = 0;
-    c->binlog_us = 0;
-    return;
+  if (access_log_ != nullptr) {
+    std::lock_guard<std::mutex> lk(log_mu_);
+    // "<epoch.sec> <client_ip> <cmd> <status> <bytes> <cost_us>
+    //  <recv_us> <work_us> <fp_us> <fp_lock_us> <cswrite_us> <binlog_us>
+    //  <req_bytes>" — per-stage split (SURVEY.md §5): recv = body receive
+    // window, work = dio-stage time, then the chunked-upload splits
+    // inside the work window (fingerprint wall, its sidecar-lock-wait
+    // share, chunk-store writes, binlog append); req_bytes = request body
+    // size (wire accounting — e.g. chunk-aware replication's savings show
+    // up here).  Columns are 0 when a stage did not occur;
+    // tools/access_log_stages.py aggregates them into the bench stage
+    // table.
+    int64_t recv_us =
+        c->recv_done_us > 0 ? c->recv_done_us - c->req_start_us : 0;
+    int64_t work_us =
+        c->work_start_us > 0 ? now_us - c->work_start_us : 0;
+    fprintf(access_log_,
+            "%lld %s %d %d %lld %lld %lld %lld %lld %lld %lld %lld %lld\n",
+            static_cast<long long>(time(nullptr)), c->peer_ip.c_str(), c->cmd,
+            status, static_cast<long long>(bytes),
+            static_cast<long long>(now_us - c->req_start_us),
+            static_cast<long long>(recv_us),
+            static_cast<long long>(work_us),
+            static_cast<long long>(c->fp_us),
+            static_cast<long long>(c->fp_lock_us),
+            static_cast<long long>(c->cswrite_us),
+            static_cast<long long>(c->binlog_us),
+            static_cast<long long>(c->pkg_len));
   }
-  std::lock_guard<std::mutex> lk(log_mu_);
-  // "<epoch.sec> <client_ip> <cmd> <status> <bytes> <cost_us>
-  //  <recv_us> <work_us> <fp_us> <fp_lock_us> <cswrite_us> <binlog_us>
-  //  <req_bytes>" — per-stage split (SURVEY.md §5): recv = body receive
-  // window, work = dio-stage time, then the chunked-upload splits
-  // inside the work window (fingerprint wall, its sidecar-lock-wait
-  // share, chunk-store writes, binlog append); req_bytes = request body
-  // size (wire accounting — e.g. chunk-aware replication's savings show
-  // up here).  Columns are 0 when a stage did not occur;
-  // tools/access_log_stages.py aggregates them into the bench stage
-  // table.
-  int64_t recv_us =
-      c->recv_done_us > 0 ? c->recv_done_us - c->req_start_us : 0;
-  int64_t work_us =
-      c->work_start_us > 0 ? now_us - c->work_start_us : 0;
-  fprintf(access_log_,
-          "%lld %s %d %d %lld %lld %lld %lld %lld %lld %lld %lld %lld\n",
-          static_cast<long long>(time(nullptr)), c->peer_ip.c_str(), c->cmd,
-          status, static_cast<long long>(bytes),
-          static_cast<long long>(now_us - c->req_start_us),
-          static_cast<long long>(recv_us),
-          static_cast<long long>(work_us),
-          static_cast<long long>(c->fp_us),
-          static_cast<long long>(c->fp_lock_us),
-          static_cast<long long>(c->cswrite_us),
-          static_cast<long long>(c->binlog_us),
-          static_cast<long long>(c->pkg_len));
+  // Spans AFTER the column line: the slow gate's immediate fflush then
+  // pushes this request's own access-log record out with the JSON line
+  // (a slow-flush that precedes the column write would publish a log in
+  // which the slow request has no parseable column row — observed as a
+  // fast-host race in the slow-gate integration test).
+  RecordRequestSpans(c, status, now_us, bytes);
   c->req_start_us = 0;  // one line per request
   c->recv_done_us = 0;
   c->work_start_us = 0;
@@ -978,6 +998,17 @@ void StorageServer::RecordRequestSpans(Conn* c, uint8_t status,
   child("storage.fingerprint", work_wall, c->fp_us);
   child("storage.cs_write", work_wall + c->fp_us, c->cswrite_us);
   child("storage.binlog", work_wall + c->fp_us + c->cswrite_us, c->binlog_us);
+  if (c->ingest_chunks_total > 0) {
+    // Negotiated-upload annotation: how much of the recipe actually
+    // crossed the wire (missing/total), spanning the request's work
+    // window so the timeline shows the split alongside the stages.
+    char ann[sizeof(TraceSpan{}.name)];
+    std::snprintf(ann, sizeof(ann), "ingest.chunks %lld/%lld",
+                  static_cast<long long>(c->ingest_chunks_missing),
+                  static_cast<long long>(c->ingest_chunks_total));
+    child(ann, work_wall,
+          c->work_start_us > 0 ? now_us - c->work_start_us : total_us);
+  }
 
   if (slow) {
     slow_request_count_.fetch_add(1, std::memory_order_relaxed);
@@ -1278,6 +1309,12 @@ void StorageServer::OnHeaderComplete(Conn* c) {
     case StorageCmd::kSyncModifyFile:
       c->fixed_need = 40;  // 16B group + 8B name_len + 8B off + 8B len, name
       break;
+    case StorageCmd::kUploadChunks:
+      // Negotiated upload phase 2: 8B session + 8B payload_len, then the
+      // missing-chunk payloads (streamed to a tmp file).
+      stats_.total_upload++;
+      c->fixed_need = 16;
+      break;
     case StorageCmd::kAppendFile:
       stats_.total_append++;
       c->fixed_need = 32;  // 16B group + 8B name_len + 8B append_len, name
@@ -1304,6 +1341,7 @@ void StorageServer::OnHeaderComplete(Conn* c) {
     case StorageCmd::kSyncQueryChunks:
     case StorageCmd::kFetchRecipe:
     case StorageCmd::kFetchChunk:
+    case StorageCmd::kUploadRecipe:
     case StorageCmd::kTruncateFile:
     case StorageCmd::kCreateLink:
     case StorageCmd::kTrunkAllocSpace:
@@ -1524,6 +1562,19 @@ void StorageServer::OnFixedComplete(Conn* c) {
       OffloadToDio(c, spi, [this, c] { HandleFetchChunk(c); });
       return;
     }
+    case StorageCmd::kUploadRecipe: {
+      // Chunk-store probe + pin: cheap, but it contends on the store
+      // mutex with every concurrent upload's PutAndRef — keep it off
+      // the nio loop like the other chunk-store servers.
+      int spi = c->fixed.empty() ? 0 : static_cast<uint8_t>(c->fixed[0]);
+      OffloadToDio(c, spi == 0xFF ? 0 : spi,
+                   [this, c] { HandleUploadRecipe(c); });
+      return;
+    }
+    case StorageCmd::kUploadChunks:
+      if (!BeginUploadChunks(c)) return;
+      if (c->file_remaining == 0) OnFileComplete(c);  // all chunks present
+      return;
     default:
       Respond(c, 22);
       return;
@@ -1573,6 +1624,8 @@ void StorageServer::OnFileComplete(Conn* c) {
       SyncCreateComplete(c);
     else if (wcmd == StorageCmd::kSyncCreateRecipe)
       SyncRecipeComplete(c);
+    else if (wcmd == StorageCmd::kUploadChunks)
+      UploadChunksComplete(c);
     else
       FinishUpload(c);
   });
@@ -1846,6 +1899,359 @@ void StorageServer::HandleSyncQueryChunks(Conn* c) {
   hex.reserve(n);
   for (size_t i = 0; i < n; ++i) hex.push_back(BytesToHex(digs + i * 20, 20));
   Respond(c, 0, cs->HaveMask(hex));
+}
+
+// UPLOAD_RECIPE (132): phase 1 of the dedup-aware negotiated upload.
+// The client chunked + fingerprinted locally; answer which chunks it
+// must ship (1 = needed), pin every present chunk so a concurrent
+// delete cannot unlink it before phase 2 references it, and park the
+// session.  ENOTSUP when this daemon has no chunk store — the client
+// falls back to a plain UPLOAD_FILE (an older daemon without this
+// opcode answers EINVAL, same client reaction).
+void StorageServer::HandleUploadRecipe(Conn* c) {
+  if (dedup_ == nullptr || chunk_stores_.empty()) {
+    if (ctr_ingest_fallbacks_ != nullptr)
+      ctr_ingest_fallbacks_->fetch_add(1, std::memory_order_relaxed);
+    Respond(c, 95 /*ENOTSUP*/);
+    return;
+  }
+  // body: 1B spi + 6B ext + 8B crc32 + 8B logical + 8B count + entries
+  constexpr size_t kPrefix = 1 + kFileExtNameMaxLen + 8 + 8 + 8;
+  if (c->fixed.size() < kPrefix + 28) {
+    Respond(c, 22);
+    return;
+  }
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  int spi = p[0];
+  std::string ext = ExtFromField(p + 1);
+  uint32_t crc = static_cast<uint32_t>(GetInt64BE(p + 7));
+  int64_t logical = GetInt64BE(p + 15);
+  int64_t n_chunks = GetInt64BE(p + 23);
+  if (spi == 0xFF) spi = store_.PickStorePath();
+  if (spi >= store_.store_path_count() ||
+      spi >= static_cast<int>(chunk_stores_.size())) {
+    Respond(c, 95 /*ENOTSUP: no chunk store for this path*/);
+    return;
+  }
+  if (logical < cfg_.dedup_chunk_threshold) {
+    // Server-authoritative chunking threshold (the plain path's
+    // ChunkEligible gate): a payload the daemon would store flat has no
+    // recipe to negotiate over.  ENOTSUP => the client falls back.
+    if (ctr_ingest_fallbacks_ != nullptr)
+      ctr_ingest_fallbacks_->fetch_add(1, std::memory_order_relaxed);
+    Respond(c, 95);
+    return;
+  }
+  // Amplification bound on client-controlled geometry: every CDC spec in
+  // the cluster cuts chunks well above this floor, so a recipe declaring
+  // more entries than <logical / floor> is hostile or corrupt — without
+  // the bound a 64 MB recipe of 1-byte chunks would pin and materialize
+  // millions of chunk-store files for a few MB of payload.
+  constexpr int64_t kMinNegotiatedChunk = 1024;
+  if (logical < 0 || n_chunks <= 0 || n_chunks > (1 << 22) ||
+      n_chunks > logical / kMinNegotiatedChunk + 1 ||
+      c->fixed.size() != kPrefix + static_cast<size_t>(n_chunks) * 28) {
+    Respond(c, 22);
+    return;
+  }
+  auto s = std::make_unique<UploadSession>();
+  s->recipe.logical_size = logical;
+  s->recipe.chunks.reserve(static_cast<size_t>(n_chunks));
+  int64_t covered = 0;
+  const uint8_t* e = p + kPrefix;
+  for (int64_t i = 0; i < n_chunks; ++i) {
+    int64_t len = GetInt64BE(e + i * 28 + 20);
+    // Same per-chunk cap as SYNC_CREATE_RECIPE: no declared entry may
+    // make the phase-2 worker allocate unboundedly.
+    if (len <= 0 || len > kMaxChunkPayload) {
+      Respond(c, 22);
+      return;
+    }
+    s->recipe.chunks.push_back({BytesToHex(e + i * 28, 20), len});
+    covered += len;
+  }
+  if (covered != logical) {
+    Respond(c, 22);
+    return;
+  }
+  s->id = next_ingest_session_.fetch_add(1);
+  s->spi = spi;
+  s->ext = std::move(ext);
+  s->crc32 = crc;
+  s->cs = chunk_stores_[spi].get();
+  // Probe + pin under ONE store-lock acquisition; from here the
+  // session's destructor owns the unpin.
+  s->needed = s->cs->PinAndMask(s->recipe);
+  int64_t missing = 0;
+  for (size_t i = 0; i < s->needed.size(); ++i) {
+    if (s->needed[i] != 0) {
+      ++missing;
+      s->needed_bytes += s->recipe.chunks[i].length;
+    }
+  }
+  s->deadline_s = time(nullptr) + cfg_.upload_session_timeout_s;
+  c->ingest_chunks_total = n_chunks;
+  c->ingest_chunks_missing = missing;
+  std::string body(8, '\0');
+  PutInt64BE(s->id, reinterpret_cast<uint8_t*>(body.data()));
+  body += s->needed;
+  {
+    std::lock_guard<std::mutex> lk(ingest_mu_);
+    ingest_sessions_[s->id] = std::move(s);
+  }
+  Respond(c, 0, body);
+}
+
+std::unique_ptr<StorageServer::UploadSession>
+StorageServer::TakeIngestSession(int64_t id) {
+  std::lock_guard<std::mutex> lk(ingest_mu_);
+  auto it = ingest_sessions_.find(id);
+  if (it == ingest_sessions_.end()) return nullptr;
+  auto s = std::move(it->second);
+  ingest_sessions_.erase(it);
+  return s;
+}
+
+void StorageServer::SweepIngestSessions() {
+  // Destruction (unpin) happens OUTSIDE ingest_mu_: UnpinRecipe takes
+  // the chunk-store mutex, and holding both here would order them
+  // against every handler path for no benefit.
+  std::vector<std::unique_ptr<UploadSession>> expired;
+  int64_t now = time(nullptr);
+  {
+    std::lock_guard<std::mutex> lk(ingest_mu_);
+    for (auto it = ingest_sessions_.begin(); it != ingest_sessions_.end();) {
+      if (it->second->deadline_s <= now) {
+        expired.push_back(std::move(it->second));
+        it = ingest_sessions_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  for (auto& s : expired) {
+    FDFS_LOG_WARN("negotiated upload session %lld expired "
+                  "(client vanished between RECIPE and CHUNKS): pins "
+                  "released",
+                  static_cast<long long>(s->id));
+    if (ctr_ingest_fallbacks_ != nullptr)
+      ctr_ingest_fallbacks_->fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
+// UPLOAD_CHUNKS (133) prefix parse on the nio loop: resolve the
+// session, validate the declared payload against what phase 1 computed,
+// and open the tmp file the missing-chunk bytes stream into.
+bool StorageServer::BeginUploadChunks(Conn* c) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  int64_t session_id = GetInt64BE(p);
+  int64_t payload_len = GetInt64BE(p + 8);
+  if (payload_len < 0 || c->pkg_len != 16 + payload_len) {
+    RespondError(c, 22);
+    return false;
+  }
+  int spi = -1;
+  int64_t expect = -1;
+  {
+    std::lock_guard<std::mutex> lk(ingest_mu_);
+    auto it = ingest_sessions_.find(session_id);
+    if (it != ingest_sessions_.end()) {
+      spi = it->second->spi;
+      expect = it->second->needed_bytes;
+      // Restart the expiry clock now that the payload is arriving: the
+      // phase-1 deadline covered the client's think time; without this
+      // bump the sweep would expire a session whose client is actively
+      // streaming a transfer longer than the timeout and force the
+      // whole payload onto the plain path (~2x wire).
+      it->second->deadline_s = time(nullptr) + cfg_.upload_session_timeout_s;
+    }
+  }
+  if (spi < 0) {
+    // Unknown or expired: the client falls back to a plain upload.  NOT
+    // counted as a fallback — an expired session was already counted by
+    // the sweep, and double-counting would skew the stuck-session
+    // diagnosis OPERATIONS.md builds on this counter.
+    RespondError(c, 2 /*ENOENT*/);
+    return false;
+  }
+  if (payload_len != expect) {
+    // Client/server disagree on what was missing: abort the session
+    // (its pins included) rather than assemble a wrong file.
+    TakeIngestSession(session_id).reset();
+    if (ctr_ingest_fallbacks_ != nullptr)
+      ctr_ingest_fallbacks_->fetch_add(1, std::memory_order_relaxed);
+    RespondError(c, 22);
+    return false;
+  }
+  c->ingest_session = session_id;
+  c->store_path_index = spi;
+  c->file_remaining = payload_len;
+  c->tmp_path = store_.NewTmpPath(spi);
+  c->file_fd = open(c->tmp_path.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644);
+  if (c->file_fd < 0) {
+    RespondError(c, 5);
+    return false;
+  }
+  c->state = ConnState::kRecvFile;
+  return true;
+}
+
+// UPLOAD_CHUNKS completion (dio worker): verify each shipped chunk IS
+// its claimed digest, write it via PutAndRef, reference the present
+// ones, store the recipe, and mint/answer the file ID exactly like
+// UPLOAD_FILE.  All-or-nothing with ref rollback; any failure makes
+// the client fall back to a plain upload.
+void StorageServer::UploadChunksComplete(Conn* c) {
+  close(c->file_fd);
+  c->file_fd = -1;
+  auto fail = [&](uint8_t status) {
+    if (ctr_ingest_fallbacks_ != nullptr)
+      ctr_ingest_fallbacks_->fetch_add(1, std::memory_order_relaxed);
+    if (!c->tmp_path.empty()) {
+      unlink(c->tmp_path.c_str());
+      c->tmp_path.clear();
+    }
+    Respond(c, status);
+  };
+  // One commit per session: taking it here also closes the race with a
+  // concurrent duplicate commit and the sweep timer.  The session (and
+  // its pins) dies at scope exit — AFTER the refs below are taken, so
+  // there is no unpinned-unreferenced window.
+  auto s = TakeIngestSession(c->ingest_session);
+  if (s == nullptr) {
+    fail(2 /*ENOENT: expired mid-stream*/);
+    return;
+  }
+  c->file_size = s->recipe.logical_size;  // upload-size histogram basis
+  c->ingest_chunks_total = static_cast<int64_t>(s->recipe.chunks.size());
+  int tmp_fd = open(c->tmp_path.c_str(), O_RDONLY);
+  if (tmp_fd < 0) {
+    fail(5);
+    return;
+  }
+  int64_t t0 = MonoUs();
+  Recipe done;  // refs taken so far (rollback set)
+  done.logical_size = s->recipe.logical_size;
+  int64_t saved = 0, hits = 0, missing = 0;
+  // The file ID's crc32 is identity metadata every consumer may check
+  // (trunk slots already do): compute it server-side over the logical
+  // stream — shipped chunks from the wire payload, present chunks read
+  // back from the store (local-disk cost, still far below re-shipping)
+  // — never trust the client's claim.
+  uint32_t crc = 0;
+  bool ok = true;
+  std::string payload;
+  for (size_t i = 0; ok && i < s->recipe.chunks.size(); ++i) {
+    const RecipeEntry& e = s->recipe.chunks[i];
+    if (s->needed[i] != 0) {
+      ++missing;
+      payload.resize(static_cast<size_t>(e.length));
+      int64_t got = 0;
+      while (got < e.length) {
+        ssize_t r = read(tmp_fd, payload.data() + got, e.length - got);
+        if (r <= 0) break;
+        got += r;
+      }
+      // Content-addressed store: the payload must BE its claimed digest
+      // before PutAndRef (same check the replication receiver runs) —
+      // the client computed these digests, and a buggy or hostile one
+      // must not poison future dedup hits under this digest.
+      if (got != e.length ||
+          Sha1(payload.data(), static_cast<size_t>(e.length)).Hex() !=
+              e.digest_hex) {
+        FDFS_LOG_WARN("negotiated upload: chunk %s failed digest check",
+                      e.digest_hex.c_str());
+        ok = false;
+        break;
+      }
+      bool existed = false;
+      std::string err;
+      if (!s->cs->PutAndRef(e.digest_hex, payload.data(),
+                            static_cast<size_t>(e.length), &existed, &err)) {
+        FDFS_LOG_ERROR("negotiated upload chunk store: %s", err.c_str());
+        ok = false;
+        break;
+      }
+      done.chunks.push_back(e);  // ref taken: in the rollback set
+    } else {
+      if (!s->cs->RefOne(e.digest_hex)) {
+        // Deleted between the bitmap and this commit (the pin only
+        // defers the unlink, it does not preserve the reference):
+        // report failure and let the client re-send the whole payload.
+        FDFS_LOG_WARN("negotiated upload: chunk %s vanished before commit",
+                      e.digest_hex.c_str());
+        ok = false;
+        break;
+      }
+      done.chunks.push_back(e);
+      if (!s->cs->ReadChunk(e.digest_hex, e.length, &payload)) {
+        FDFS_LOG_WARN("negotiated upload: chunk %s unreadable at commit",
+                      e.digest_hex.c_str());
+        ok = false;
+        break;
+      }
+      saved += e.length;
+      ++hits;
+    }
+    crc = Crc32(payload.data(), static_cast<size_t>(e.length), crc);
+  }
+  close(tmp_fd);
+  unlink(c->tmp_path.c_str());
+  c->tmp_path.clear();
+  c->ingest_chunks_missing = missing;
+  if (ok && crc != s->crc32)
+    FDFS_LOG_WARN("negotiated upload: client declared crc %u, content is %u "
+                  "(ID minted from content)", s->crc32, crc);
+  std::string id = ok ? MintFileId(s->spi, s->recipe.logical_size, crc,
+                                   s->ext, false)
+                      : "";
+  auto parts = id.empty() ? std::nullopt : DecodeFileId(id);
+  std::optional<std::string> local =
+      parts.has_value()
+          ? LocalPath(store_.store_path(s->spi), parts->RemoteFilename())
+          : std::nullopt;
+  std::string err;
+  if (!ok || !local.has_value()) {
+    s->cs->UnrefAll(done);
+    fail(ok ? 22 : 5);
+    return;
+  }
+  StoreManager::EnsureParentDirs(*local);
+  if (!WriteRecipeFile(*local + ".rcp", done, &err)) {
+    FDFS_LOG_ERROR("negotiated upload recipe write: %s", err.c_str());
+    s->cs->UnrefAll(done);
+    fail(5);
+    return;
+  }
+  c->cswrite_us = MonoUs() - t0;
+  stats_.dedup_hits += hits;
+  stats_.dedup_bytes_saved += saved;
+  if (ctr_dedup_chunk_hits_ != nullptr && hits > 0)
+    ctr_dedup_chunk_hits_->fetch_add(hits, std::memory_order_relaxed);
+  if (ctr_dedup_chunk_misses_ != nullptr && missing > 0)
+    ctr_dedup_chunk_misses_->fetch_add(missing, std::memory_order_relaxed);
+  // Wire accounting: `saved` bytes never left the client — the whole
+  // point of the negotiated path.
+  if (ctr_ingest_recipe_uploads_ != nullptr) {
+    ctr_ingest_recipe_uploads_->fetch_add(1, std::memory_order_relaxed);
+    ctr_ingest_bytes_saved_wire_->fetch_add(saved,
+                                            std::memory_order_relaxed);
+  }
+  int64_t t_bl = MonoUs();
+  binlog_.Append(kBinlogOpCreate, parts->RemoteFilename());
+  c->binlog_us = MonoUs() - t_bl;
+  NoteTracedMutation(c, parts->RemoteFilename());
+  // Sidecar mode keeps its near-dup/attribution index OUTSIDE the chunk
+  // store, and the client-side fingerprint pipeline never talked to it:
+  // feed the assembled bytes through the plugin exactly as a recovered
+  // file is (best-effort; the cpu plugin indexes in the chunk store
+  // itself, so re-fingerprinting there would be pure waste).
+  if (dedup_ != nullptr && std::string(dedup_->Name()) == "sidecar")
+    ReindexRecovered(dedup_.get(), *local,
+                     cfg_.group_name + "/" + parts->RemoteFilename());
+  stats_.success_upload++;
+  stats_.last_source_update = time(nullptr);
+  Respond(c, 0, PackGroupField(cfg_.group_name) + parts->RemoteFilename());
 }
 
 // SYNC_CREATE_RECIPE (127): phase 2 of chunk-aware replication — take a

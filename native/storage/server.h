@@ -124,6 +124,28 @@ class StorageServer {
     }
   };
 
+  // Negotiated-upload session (UPLOAD_RECIPE -> UPLOAD_CHUNKS): phase 1
+  // parked the parsed recipe here with a pin on every chunk (present
+  // ones must survive concurrent delete/GC until the commit references
+  // them).  Owned by ingest_sessions_ between the two requests; phase 2
+  // takes it out (one commit per session), and the sweep timer expires
+  // sessions whose client vanished.  The destructor unpins, so every
+  // exit path — commit, abort, timeout, shutdown — releases the pins.
+  struct UploadSession {
+    int64_t id = 0;
+    int spi = 0;
+    std::string ext;
+    uint32_t crc32 = 0;
+    Recipe recipe;           // full chunk list (lengths pre-validated)
+    std::string needed;      // phase-1 bitmap (1 = client ships)
+    int64_t needed_bytes = 0;
+    ChunkStore* cs = nullptr;
+    int64_t deadline_s = 0;  // wall-clock expiry (sweep timer)
+    ~UploadSession() {
+      if (cs != nullptr) cs->UnpinRecipe(recipe);
+    }
+  };
+
   struct Conn {
     int fd = -1;
     ConnState state = ConnState::kRecvHeader;
@@ -177,6 +199,12 @@ class StorageServer {
     int64_t cswrite_us = 0;     // chunk-store writes
     int64_t binlog_us = 0;      // binlog append
     std::string peer_ip;
+    // Negotiated upload (UPLOAD_CHUNKS): the session this request
+    // commits, plus the missing/total split RecordRequestSpans turns
+    // into the ingest.chunks trace annotation (set by both phases).
+    int64_t ingest_session = 0;
+    int64_t ingest_chunks_total = 0;
+    int64_t ingest_chunks_missing = 0;
     // Distributed tracing: context from a TRACE_CTX prefix frame,
     // consumed by the next request (ResetForNextRequest clears it).
     // trace_span is the request's root span id, allocated when the
@@ -265,6 +293,14 @@ class StorageServer {
   // a rebuilding peer pull recipes and only the chunk bytes it lacks.
   void HandleFetchRecipe(Conn* c);
   void HandleFetchChunk(Conn* c);
+  // Dedup-aware negotiated upload (UPLOAD_RECIPE / UPLOAD_CHUNKS; both
+  // run on the store path's dio pool): phase 1 probes + pins + parks a
+  // session, phase 2 verifies the shipped chunks and assembles the file.
+  void HandleUploadRecipe(Conn* c);    // dio worker
+  bool BeginUploadChunks(Conn* c);     // nio: parse prefix, open tmp
+  void UploadChunksComplete(Conn* c);  // dio worker
+  std::unique_ptr<UploadSession> TakeIngestSession(int64_t id);
+  void SweepIngestSessions();          // timer: expire vanished clients
   // Re-register a recovered file's signature/attributions with the
   // dedup plugin (sidecar-mode rebuilds; bytes are local, wire cost 0).
   void ReindexRecovered(DedupPlugin* plugin, const std::string& local,
@@ -406,6 +442,19 @@ class StorageServer {
   std::atomic<int64_t>* ctr_chunkfetch_bytes_ = nullptr;
   std::atomic<int64_t>* ctr_dedup_chunk_hits_ = nullptr;
   std::atomic<int64_t>* ctr_dedup_chunk_misses_ = nullptr;
+  // Negotiated-upload (ingest edge) accounting: completed recipe
+  // uploads, chunk bytes the client did NOT ship because the store
+  // already held them, and server-observable fallbacks (no chunk
+  // store, failed/expired sessions — the client then re-sends via
+  // plain UPLOAD_FILE).
+  std::atomic<int64_t>* ctr_ingest_recipe_uploads_ = nullptr;
+  std::atomic<int64_t>* ctr_ingest_bytes_saved_wire_ = nullptr;
+  std::atomic<int64_t>* ctr_ingest_fallbacks_ = nullptr;
+  // Parked phase-1 sessions keyed by id (ingest_mu_); swept by timer.
+  std::mutex ingest_mu_;
+  std::unordered_map<int64_t, std::unique_ptr<UploadSession>>
+      ingest_sessions_;
+  std::atomic<int64_t> next_ingest_session_{1};
   std::string my_ip_;
 
   // Trunk state (cluster-global params from the tracker; SURVEY §2.3).

@@ -248,6 +248,62 @@ int main(int argc, char** argv) {
            c.flags, roundtrip ? 1 : 0);
     return 0;
   }
+  if (cmd == "ingest-wire") {
+    // Fixed fixture for the negotiated-upload wire layout
+    // (UPLOAD_RECIPE request body, its response, the UPLOAD_CHUNKS
+    // prefix) — tests/test_dedup_upload.py builds the same bytes with
+    // the Python client's encoders and compares hex-for-hex, pinning
+    // the cross-language contract like trace-ctx does for tracing.
+    const char* payloads[3] = {nullptr, nullptr, nullptr};
+    std::string p0(1000, 'a'), p1(2000, 'b'), p2(3000, 'c');
+    payloads[0] = p0.data();
+    payloads[1] = p1.data();
+    payloads[2] = p2.data();
+    const size_t lens[3] = {p0.size(), p1.size(), p2.size()};
+    std::string body;
+    body.push_back(static_cast<char>(3));  // store path index
+    std::string ext = "bin";
+    ext.resize(6, '\0');
+    body += ext;
+    uint8_t num[8];
+    PutInt64BE(0x11223344, num);  // crc32 of the fixture (fixed)
+    body.append(reinterpret_cast<char*>(num), 8);
+    PutInt64BE(6000, num);  // logical size
+    body.append(reinterpret_cast<char*>(num), 8);
+    PutInt64BE(3, num);  // chunk count
+    body.append(reinterpret_cast<char*>(num), 8);
+    for (int i = 0; i < 3; ++i) {
+      Sha1Digest d = Sha1(payloads[i], lens[i]);
+      body.append(reinterpret_cast<const char*>(d.bytes), 20);
+      PutInt64BE(static_cast<int64_t>(lens[i]), num);
+      body.append(reinterpret_cast<char*>(num), 8);
+    }
+    auto hex = [](const std::string& s) {
+      static const char* k = "0123456789abcdef";
+      std::string out;
+      for (unsigned char c : s) {
+        out.push_back(k[c >> 4]);
+        out.push_back(k[c & 0xF]);
+      }
+      return out;
+    };
+    printf("request=%s\n", hex(body).c_str());
+    // Response: session 0x0102030405060708, chunk 1 present (0), the
+    // others needed (1).
+    std::string resp;
+    PutInt64BE(0x0102030405060708LL, num);
+    resp.append(reinterpret_cast<char*>(num), 8);
+    resp += std::string("\x01\x00\x01", 3);
+    printf("response=%s\n", hex(resp).c_str());
+    // Phase-2 prefix for that session: payload = chunks 0 + 2.
+    std::string pre;
+    PutInt64BE(0x0102030405060708LL, num);
+    pre.append(reinterpret_cast<char*>(num), 8);
+    PutInt64BE(static_cast<int64_t>(lens[0] + lens[2]), num);
+    pre.append(reinterpret_cast<char*>(num), 8);
+    printf("chunks_prefix=%s\n", hex(pre).c_str());
+    return 0;
+  }
   if (cmd == "b64e" && argc == 3) {
     std::string hex = argv[2];
     std::vector<uint8_t> raw;

@@ -44,6 +44,22 @@ def test_config2_sidecar_smoke(tmp_path, monkeypatch):
     assert abs(sum(st["stage_share"].values()) - 1.0) < 0.05
 
 
+def test_config6_wire_dedup_smoke(tmp_path):
+    # The ingest-edge wire-dedup scenario end-to-end at tiny scale: the
+    # warm (byte-identical re-upload) pass must ship ~nothing.
+    bc.config6(str(tmp_path), scale=0.0001)  # ~1 MB => 4 blobs
+    with open(os.path.join(str(tmp_path), "config6.json")) as fh:
+        art = json.load(fh)
+    assert art["cold"]["wire_bytes_sent"] > 0
+    assert art["warm"]["saved_ratio"] > 0.9
+    assert art["warm_pass_ok"] is True
+    # tail-edited blobs ship only the changed chunks: strictly between
+    # the cold (~0 saved) and warm (~all saved) passes
+    assert 0.0 < art["edited"]["saved_ratio"] < art["warm"]["saved_ratio"]
+    assert art["ingest_counters"]["ingest.recipe_uploads"] == 12
+    assert art["ingest_counters"]["ingest.bytes_saved_wire"] > 0
+
+
 def test_config4_referee_smoke(tmp_path):
     bc.config4(str(tmp_path), scale=0.00002)  # ~2 MB of HTML docs
     with open(os.path.join(str(tmp_path), "config4.json")) as fh:

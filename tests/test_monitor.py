@@ -63,8 +63,13 @@ def test_beat_stats_tolerates_short_and_long_vectors():
 
 def _sample_registry() -> dict:
     return {
-        "counters": {"op.upload_file.count": 4, "op.upload_file.errors": 1},
+        "counters": {"op.upload_file.count": 4, "op.upload_file.errors": 1,
+                     # negotiated-upload ingest accounting (PR 3)
+                     "ingest.recipe_uploads": 6,
+                     "ingest.bytes_saved_wire": 262144,
+                     "ingest.recipe_fallbacks": 2},
         "gauges": {"server.connections": 2, "sync.peer.10.0.0.2:23000.lag_s": 7,
+                   "ingest.sessions_active": 1,
                    # tracing health (PR 2): ring throughput/overwrite
                    # pressure and the slow-request gate
                    "trace.spans_recorded": 12, "trace.spans_dropped": 3,
@@ -175,6 +180,13 @@ def test_prometheus_exposition_parses():
         '{storage="127.0.0.1:23000"}', 12.0)
     assert series["fdfs_trace_spans_dropped"][0][1] == 3.0
     assert series["fdfs_trace_slow_requests"][0][1] == 1.0
+    # Negotiated-upload golden (PR 3): the ingest counters/gauge export
+    # per-storage so dashboards can chart client-side wire savings.
+    assert series["fdfs_ingest_recipe_uploads"][0] == (
+        '{storage="127.0.0.1:23000"}', 6.0)
+    assert series["fdfs_ingest_bytes_saved_wire"][0][1] == 262144.0
+    assert series["fdfs_ingest_recipe_fallbacks"][0][1] == 2.0
+    assert series["fdfs_ingest_sessions_active"][0][1] == 1.0
     buckets = series["fdfs_op_upload_file_latency_us_bucket"]
     values = [v for _, v in buckets]
     assert values == sorted(values), "histogram buckets must be cumulative"

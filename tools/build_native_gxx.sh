@@ -1,0 +1,51 @@
+#!/bin/bash
+# Fallback native build without cmake/ninja: mirrors native/CMakeLists.txt
+# with plain g++ (same sources, flags, and layout — binaries land in
+# native/build/ where tests/harness.py expects them).  Use when the
+# environment lacks the cmake toolchain; otherwise prefer
+# `cmake -S native -B native/build -G Ninja && ninja -C native/build`.
+set -euo pipefail
+cd "$(dirname "$0")/../native"
+
+FLAGS="-std=c++17 -O2 -g -Wall -Wextra -I."
+mkdir -p build/obj
+
+srcs_common="common/bytes.cc common/cdc.cc common/fileid.cc common/ini.cc
+  common/log.cc common/net.cc common/req_server.cc common/stats.cc
+  common/trace.cc common/fsutil.cc common/http_token.cc"
+srcs_storage="storage/chunkstore.cc storage/config.cc storage/store.cc
+  storage/binlog.cc storage/trunk.cc storage/recovery.cc storage/dedup.cc
+  storage/server.cc storage/sync.cc storage/tracker_client.cc"
+srcs_tracker="tracker/cluster.cc tracker/relationship.cc tracker/server.cc"
+
+pids=""
+for f in $srcs_common $srcs_storage $srcs_tracker; do
+  o="build/obj/$(echo "$f" | tr / _ | sed 's/\.cc$/.o/')"
+  g++ $FLAGS -c "$f" -o "$o" &
+  pids="$pids $!"
+done
+# SHA-NI TU gets its own ISA flags (runtime cpuid gate keeps it safe on
+# older hosts) — matches the fdfs_sha1ni OBJECT library in CMake.
+g++ $FLAGS -msha -mssse3 -msse4.1 -c common/sha1_ni.cc \
+  -o build/obj/common_sha1_ni.o &
+pids="$pids $!"
+for p in $pids; do wait "$p"; done
+
+ar rcs build/obj/libfdfs_common.a build/obj/common_*.o
+ar rcs build/obj/libfdfs_storage.a build/obj/storage_*.o
+ar rcs build/obj/libfdfs_tracker.a build/obj/tracker_*.o
+
+link() { g++ $FLAGS "$@" -lpthread; }
+link storage/main.cc build/obj/libfdfs_storage.a build/obj/libfdfs_common.a \
+  -o build/fdfs_storaged &
+link tracker/main.cc build/obj/libfdfs_tracker.a build/obj/libfdfs_common.a \
+  -o build/fdfs_trackerd &
+link tools/codec_cli.cc build/obj/libfdfs_common.a -o build/fdfs_codec &
+link tools/load_cli.cc build/obj/libfdfs_common.a -o build/fdfs_load &
+link tests/common_test.cc build/obj/libfdfs_common.a -o build/common_test &
+link tests/storage_test.cc build/obj/libfdfs_storage.a \
+  build/obj/libfdfs_common.a -o build/storage_test &
+link tests/tracker_test.cc build/obj/libfdfs_tracker.a \
+  build/obj/libfdfs_common.a -o build/tracker_test &
+wait
+echo "native build complete: $(ls build/fdfs_storaged build/fdfs_trackerd)"

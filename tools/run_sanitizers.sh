@@ -17,7 +17,8 @@ else
   PYTEST_ARGS=(tests/test_storage_daemon.py tests/test_tracker_daemon.py
     tests/test_replication.py tests/test_trunk.py
     tests/test_chunked_storage.py tests/test_disk_recovery.py
-    tests/test_multi_tracker.py tests/test_trace.py)
+    tests/test_multi_tracker.py tests/test_trace.py
+    tests/test_dedup_upload.py)
 fi
 
 run_one() {
@@ -34,6 +35,9 @@ run_one() {
   echo "=== $san: daemon suite ==="
   # halt_on_error keeps a failing daemon loud; leak detection stays on
   # for asan (daemons shut down cleanly in the harness).
+  # test_dedup_upload.py's concurrent-uploads-and-deletes test is the
+  # negotiated-upload session target: pin/ref races and the
+  # abort-timeout sweep run under TSan here.
   if [ "$san" = tsan ]; then
     export TSAN_OPTIONS="halt_on_error=1"
   else
