@@ -53,9 +53,9 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 HB = "heart_beat_interval = 1\nstat_report_interval = 1"
 
 NOMINAL = {1: 1 << 30, 2: 10 << 30, 3: 50 << 30, 4: 100 << 30,
-           5: 500 << 30, 6: 10 << 30}
+           5: 500 << 30, 6: 10 << 30, 7: 10 << 30}
 DEFAULT_SCALE = {1: 0.25, 2: 1 / 32.0, 3: 1 / 64.0, 4: 1 / 40.0,
-                 5: 1 / 2000.0, 6: 1 / 256.0}
+                 5: 1 / 2000.0, 6: 1 / 256.0, 7: 1 / 256.0}
 
 
 def emit(out_dir: str, config: int, payload: dict) -> None:
@@ -1081,10 +1081,107 @@ def config6(out_dir: str, scale: float) -> None:
     })
 
 
+def config7(out_dir: str, scale: float) -> None:
+    """Scrub overhead on foreground IO (PR 4): upload/download p50/p99
+    against a daemon whose integrity engine is continuously re-verifying
+    the chunk store, at scrub_bandwidth_mb_s in {off, 16, unlimited}.
+
+    Per mode: preload a chunk-store corpus, run back-to-back scrub
+    passes (scrub_interval_s=1) while timing foreground uploads and
+    range downloads, and record the scrubbed chunk/byte throughput so
+    the latency deltas can be priced against verify coverage.
+    """
+    import tempfile
+
+    total = int(NOMINAL[7] * scale)
+    blob = 256 << 10
+    n_preload = max(total // blob, 8)
+    n_ops = max(n_preload // 2, 10)
+    rng = np.random.RandomState(7)
+    preload = [rng.randint(0, 256, blob, dtype=np.uint8).tobytes()
+               for _ in range(n_preload)]
+
+    def pct(xs, q):
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        return xs[min(int(len(xs) * q), len(xs) - 1)]
+
+    modes = {"off": "scrub_interval_s = 0",
+             "bw16": "scrub_interval_s = 1\nscrub_bandwidth_mb_s = 16",
+             "unlimited": "scrub_interval_s = 1\nscrub_bandwidth_mb_s = 0"}
+    results = {}
+    for name, scrub_conf in modes.items():
+        tmp = tempfile.mkdtemp(prefix=f"fdfs_cfg7_{name}_")
+        tr, sts, cli = _cluster(tmp, n_storages=1, dedup_mode="cpu")
+        # _cluster's conf has no scrub keys; rewrite + restart with them.
+        from harness import STORAGED, Daemon, make_storage_conf
+
+        st = sts[0]
+        st.stop()
+        make_storage_conf(os.path.join(tmp, "st0"), st.port, ip=st.ip,
+                          trackers=[f"127.0.0.1:{tr.port}"],
+                          dedup_mode="cpu",
+                          extra=HB + "\n" + scrub_conf)
+        st = Daemon(STORAGED, os.path.join(tmp, "st0", "storage.conf"),
+                    st.port, ip=st.ip)
+        sts[0] = st
+        try:
+            _upload_retry(cli, b"warmup " * 64)
+            for data in preload:
+                cli.upload_buffer(data, ext="bin")
+            up_lat, down_lat = [], []
+            fid = cli.upload_buffer(preload[0][: blob // 2], ext="bin")
+            t_end = time.time() + max(3.0, n_ops * 0.05)
+            i = 0
+            while time.time() < t_end or i < n_ops:
+                payload = rng.randint(0, 256, 64 << 10,
+                                      dtype=np.uint8).tobytes()
+                t0 = time.time()
+                f = cli.upload_buffer(payload, ext="bin")
+                up_lat.append(time.time() - t0)
+                t0 = time.time()
+                cli.download_to_buffer(f)
+                down_lat.append(time.time() - t0)
+                cli.delete_file(f)
+                i += 1
+            cli.download_to_buffer(fid)
+            scrub = cli.scrub_status(st.ip, st.port)
+        finally:
+            cli.close()
+            for s in sts:
+                s.stop()
+            tr.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+        results[name] = {
+            "ops": len(up_lat),
+            "upload_p50_ms": round(pct(up_lat, 0.50) * 1e3, 3),
+            "upload_p99_ms": round(pct(up_lat, 0.99) * 1e3, 3),
+            "download_p50_ms": round(pct(down_lat, 0.50) * 1e3, 3),
+            "download_p99_ms": round(pct(down_lat, 0.99) * 1e3, 3),
+            "scrub_passes": scrub["passes"],
+            "chunks_verified": scrub["chunks_verified"],
+            "bytes_verified": scrub["bytes_verified"],
+            "chunks_corrupt": scrub["chunks_corrupt"],
+        }
+
+    emit(out_dir, 7, {
+        "description": "integrity-engine overhead: foreground upload/"
+                       "download p50/p99 with the scrubber off, paced at "
+                       "16 MB/s, and unpaced (back-to-back passes)",
+        "nominal_bytes": NOMINAL[7],
+        "scaled_bytes": n_preload * blob,
+        "modes": results,
+        "scrub_verified_ok": results["unlimited"]["chunks_verified"] > 0,
+        "no_false_corruption": all(m["chunks_corrupt"] == 0
+                                   for m in results.values()),
+    })
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=0,
-                    help="which config (1-6); 0 = all")
+                    help="which config (1-7); 0 = all")
     ap.add_argument("--scale", type=float, default=None,
                     help="fraction of the nominal corpus size")
     ap.add_argument("--full", action="store_true",
@@ -1093,8 +1190,8 @@ def main() -> None:
     args = ap.parse_args()
 
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6}
-    which = [args.config] if args.config else [1, 2, 3, 4, 5, 6]
+           6: config6, 7: config7}
+    which = [args.config] if args.config else [1, 2, 3, 4, 5, 6, 7]
     for c in which:
         scale = 1.0 if args.full else (
             args.scale if args.scale is not None else DEFAULT_SCALE[c])

@@ -274,6 +274,104 @@ def cmd_trace(c: FdfsClient, args: list[str]) -> int:
     return 0 if matched else 1
 
 
+def cmd_scrub(c: FdfsClient, args: list[str]) -> int:
+    """Integrity engine (anti-entropy) console: per-storage scrub status
+    from the SCRUB_STATUS blob, with optional kick and watch modes.
+
+    Flags: --kick          force a verify+repair+GC pass on every
+                           storage first (SCRUB_KICK)
+           --watch [s]     re-render every s seconds (default 2) until
+                           interrupted
+           --group <name>  limit to one group
+           --json          machine-readable {addr: {field: value}}
+    """
+    import time as _time
+
+    group = None
+    if "--group" in args:
+        i = args.index("--group")
+        if i + 1 >= len(args) or args[i + 1].startswith("--"):
+            print("usage: scrub <tracker> [--kick] [--watch [s]] "
+                  "[--group <name>] [--json]", file=sys.stderr)
+            return 2
+        group = args[i + 1]
+    interval = 0.0
+    if "--watch" in args:
+        i = args.index("--watch")
+        interval = 2.0
+        if i + 1 < len(args) and not args[i + 1].startswith("--"):
+            try:
+                interval = float(args[i + 1])
+            except ValueError:
+                pass
+
+    def storages():
+        cs = c.cluster_stat(group)
+        return [(s["ip"], s["port"])
+                for g in cs.get("groups", [])
+                for s in g.get("storages", [])]
+
+    members = storages()
+    if not members:
+        print("no storages known to the tracker", file=sys.stderr)
+        return 1
+    if "--kick" in args:
+        for ip, port in members:
+            try:
+                c.scrub_kick(ip, port)
+                print(f"kicked {ip}:{port}", file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 — keep kicking the rest
+                print(f"kick {ip}:{port} failed: {e}", file=sys.stderr)
+
+    def render_once() -> int:
+        rows: dict[str, dict] = {}
+        errors: dict[str, str] = {}
+        for ip, port in members:
+            addr = f"{ip}:{port}"
+            try:
+                rows[addr] = c.scrub_status(ip, port)
+            except Exception as e:  # noqa: BLE001 — a dead node is a row
+                errors[addr] = str(e)
+        if "--json" in args:
+            # Unreachable nodes appear as {"error": ...} entries, and any
+            # error makes the exit code nonzero — a monitoring consumer
+            # must never mistake a partial answer for a healthy cluster.
+            merged: dict[str, dict] = dict(rows)
+            merged.update({a: {"error": e} for a, e in errors.items()})
+            print(json.dumps(merged, indent=2, sort_keys=True))
+        else:
+            for addr, st in sorted(rows.items()):
+                state = "RUNNING" if st["running"] else "idle"
+                print(f"{addr}  {state}  passes={st['passes']} "
+                      f"progress={st['pass_chunks_done']}"
+                      f"/{st['pass_chunks_total']}")
+                print(f"  verified: {st['chunks_verified']} chunks "
+                      f"({st['bytes_verified']} bytes)   corrupt: "
+                      f"{st['chunks_corrupt']}  repaired: "
+                      f"{st['chunks_repaired']}  unrepairable: "
+                      f"{st['corrupt_unrepairable']}  quarantined: "
+                      f"{st['quarantined']}")
+                print(f"  gc: pending {st['gc_pending_chunks']} chunks "
+                      f"({st['gc_pending_bytes']} bytes)   reclaimed "
+                      f"{st['chunks_reclaimed']} chunks + "
+                      f"{st['recipes_reclaimed']} recipes "
+                      f"({st['bytes_reclaimed']} bytes)")
+            for addr, err in sorted(errors.items()):
+                print(f"{addr}  error: {err}")
+        return 0 if rows and not errors else 1
+
+    if interval <= 0:
+        return render_once()
+    try:
+        while True:
+            if "--json" not in args:  # keep --watch --json parseable
+                print(f"-- scrub @ {_time.strftime('%H:%M:%S')} --")
+            render_once()
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 TOOLS = {
     "upload": cmd_upload,
     "download": cmd_download,
@@ -289,6 +387,7 @@ TOOLS = {
     "tracker_status": cmd_tracker_status,
     "near_dups": cmd_near_dups,
     "trace": cmd_trace,
+    "scrub": cmd_scrub,
 }
 
 

@@ -311,6 +311,16 @@ class FdfsClient:
         with self._storage(FetchTarget(ip=ip, port=port)) as s:
             return s.stat()
 
+    def scrub_status(self, ip: str, port: int) -> dict[str, int]:
+        """One storage daemon's integrity-engine status (SCRUB_STATUS)."""
+        with self._storage(FetchTarget(ip=ip, port=port)) as s:
+            return s.scrub_status()
+
+    def scrub_kick(self, ip: str, port: int) -> None:
+        """Force a scrub pass on one storage daemon (SCRUB_KICK)."""
+        with self._storage(FetchTarget(ip=ip, port=port)) as s:
+            s.scrub_kick()
+
 
 def _parse_addr(addr: str) -> tuple[str, int]:
     host, _, port = addr.rpartition(":")

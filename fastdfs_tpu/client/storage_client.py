@@ -27,6 +27,7 @@ from fastdfs_tpu.common.protocol import (
     pack_prefix_name,
     unpack_group_name,
     unpack_metadata,
+    unpack_scrub_stats,
 )
 
 AUTO_STORE_PATH = 0xFF
@@ -419,6 +420,19 @@ class StorageClient:
         fastdfs_tpu.trace.decode_dump."""
         self.conn.send_request(StorageCmd.TRACE_DUMP)
         return json.loads(self.conn.recv_response("trace_dump") or b"{}")
+
+    def scrub_status(self) -> dict[str, int]:
+        """Integrity-engine status (SCRUB_STATUS 134): named scrub/GC
+        counters decoded from the fixed int64 blob (SCRUB_STAT_FIELDS).
+        StatusError(95) when the daemon has no chunk store to scrub."""
+        self.conn.send_request(StorageCmd.SCRUB_STATUS)
+        return unpack_scrub_stats(self.conn.recv_response("scrub_status"))
+
+    def scrub_kick(self) -> None:
+        """Force a verify+repair+GC pass now (SCRUB_KICK 135) — works
+        even when periodic scrubbing (scrub_interval_s) is off."""
+        self.conn.send_request(StorageCmd.SCRUB_KICK)
+        self.conn.recv_response("scrub_kick")
 
 
 def _split_id(file_id: str) -> tuple[str, str]:

@@ -77,6 +77,56 @@ BEAT_STAT_FIELDS = (
 )
 BEAT_STAT_COUNT = len(BEAT_STAT_FIELDS)
 
+# ---------------------------------------------------------------------------
+# Integrity-engine status blob (fastdfs_tpu extension; no reference
+# equivalent — upstream FastDFS never re-reads stored bytes).
+#
+# The ``StorageCmd.SCRUB_STATUS`` response body carries SCRUB_STAT_COUNT
+# big-endian int64 slots; slot i is named SCRUB_STAT_FIELDS[i].  The C++
+# daemon compiles against the generated mirror (protocol_gen.h
+# kScrubStatNames), and the layout is pinned by the ``fdfs_codec
+# scrub-status`` cross-language golden.  Append-only like the beat blob:
+# new fields go at the end, decoders read missing tail slots as 0.
+# ---------------------------------------------------------------------------
+
+SCRUB_STAT_FIELDS = (
+    "running",               # a verify/GC pass is in flight right now
+    "passes",                # completed passes since start
+    "pass_chunks_done",      # progress within the current pass
+    "pass_chunks_total",
+    "chunks_verified",       # cumulative re-hashed chunks
+    "bytes_verified",
+    "chunks_corrupt",        # digest mismatches found (incl. truncations)
+    "chunks_repaired",       # quarantined chunks restored from a replica
+    "corrupt_unrepairable",  # repair attempts with no replica serving it
+    "quarantined",           # currently quarantined (live refs, bytes aside)
+    "skipped_pinned",        # corrupt but pinned by an in-flight stream
+    "gc_pending_chunks",     # zero-ref chunks inside the grace window
+    "gc_pending_bytes",
+    "chunks_reclaimed",      # zero-ref chunks unlinked by GC sweeps
+    "bytes_reclaimed",       # chunk + recipe-sidecar bytes reclaimed
+    "recipes_reclaimed",     # recipe sidecar files deleted with their file
+    "last_pass_unix",
+    "last_pass_duration_us",
+)
+SCRUB_STAT_COUNT = len(SCRUB_STAT_FIELDS)
+
+
+def pack_scrub_stats(stats: dict[str, int]) -> bytes:
+    """SCRUB_STATUS response body from named values (tests/goldens; the
+    production encoder is the C++ daemon)."""
+    return b"".join(long2buff(int(stats.get(name, 0)))
+                    for name in SCRUB_STAT_FIELDS)
+
+
+def unpack_scrub_stats(buf: bytes) -> dict[str, int]:
+    """Name a SCRUB_STATUS blob; missing tail slots read 0 (the wire
+    contract is append-only, so an older daemon's shorter blob decodes)."""
+    n = len(buf) // 8
+    vals = [buff2long(buf, i * 8) for i in range(min(n, SCRUB_STAT_COUNT))]
+    vals += [0] * (SCRUB_STAT_COUNT - len(vals))
+    return dict(zip(SCRUB_STAT_FIELDS, vals))
+
 # Largest request body a daemon will buffer in memory (larger bodies
 # stream to disk, or the connection is closed).  A WIRE contract, not a
 # tuning knob: senders of inline-only commands (e.g. the chunk-aware
@@ -306,6 +356,23 @@ class StorageCmd(enum.IntEnum):
     #     upload).
     UPLOAD_RECIPE = 132
     UPLOAD_CHUNKS = 133
+    # Integrity engine (fastdfs_tpu extension; see native/storage/scrub.*).
+    #   SCRUB_STATUS: empty body -> SCRUB_STAT_COUNT big-endian int64
+    #     slots named by SCRUB_STAT_FIELDS (append-only; cross-language
+    #     golden: fdfs_codec scrub-status).  ENOTSUP when the daemon has
+    #     no chunk store (dedup off — nothing to scrub).
+    #   SCRUB_KICK: empty body -> status 0 once a verify+GC pass has been
+    #     scheduled (runs even when scrub_interval_s = 0, so operators
+    #     and tests can drive passes deterministically).
+    SCRUB_STATUS = 134
+    SCRUB_KICK = 135
+    # Sidecar RPC: batched chunk-integrity verify on the accelerator
+    # (ops/sha1.sha1_batch) for the storage scrubber.  Body = 8B count +
+    # count x (8B length + 20B expected raw SHA1) + the payloads
+    # concatenated; response = count bytes (0 = digest matches,
+    # 1 = mismatch).  The daemon falls back to its serial host SHA1 when
+    # the sidecar is unreachable — scrubbing never blocks on the TPU.
+    DEDUP_VERIFY = 136
     # Trace-context prefix frame (same value as TrackerCmd.TRACE_CTX).
     TRACE_CTX = 140
     # Ranked near-dup report for a stored file, answered from the

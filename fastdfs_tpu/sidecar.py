@@ -35,6 +35,12 @@ Opcodes
   lines ``<file_id> <score>`` from the MinHash/LSH index (the operator
   query surface behind the daemon's ``NEAR_DUPS`` command); status 61
   when the file carries no signature.
+* ``DEDUP_VERIFY`` (136): batched chunk-integrity verify for the storage
+  scrubber (``native/storage/scrub.cc``).  Body = 8B count + per chunk
+  (8B length + 20B expected raw SHA1) + payloads concatenated; response
+  = count bytes (0 = match, 1 = mismatch).  Hashing runs on the
+  accelerator via ``ops/sha1.sha1_batch``; the daemon falls back to its
+  serial host SHA1 when this RPC is unavailable.
 * ``DEDUP_FINGERPRINT_CUTS`` (125): DEDUP_FINGERPRINT with the cut
   offsets precomputed by the caller's native CDC (8B session + 8B
   base_offset + 8B n_cuts + n_cuts x 8B ends + bytes) — the production
@@ -309,6 +315,67 @@ class DedupSidecar:
                 return 0, b""
         return 22, b""
 
+    def _verify(self, body: bytes) -> tuple[int, bytes]:
+        """DEDUP_VERIFY (136): batched chunk-integrity check for the
+        storage scrubber.  Body = 8B count + count x (8B length + 20B
+        expected raw SHA1) + payloads concatenated; response = count
+        bytes (0 = match, 1 = mismatch).
+
+        Pure compute — no index or session state — so it runs entirely
+        outside the engine lock, on the accelerator via
+        ``ops/sha1.sha1_batch`` (one padded (N, L) batch per request)
+        with a hashlib fallback if the device path fails for any
+        reason: a verify answer must never be wrong, only slower.
+        """
+        if len(body) < 8:
+            return 22, b""
+        count = _I64.unpack_from(body)[0]
+        if count < 0 or 8 + count * 28 > len(body):
+            return 22, b""
+        lengths = []
+        digests = []
+        for i in range(count):
+            off = 8 + i * 28
+            ln = _I64.unpack_from(body, off)[0]
+            if ln < 0:
+                return 22, b""
+            lengths.append(ln)
+            digests.append(body[off + 8:off + 28])
+        payloads = body[8 + count * 28:]
+        if sum(lengths) != len(payloads):
+            return 22, b""
+        chunks = []
+        off = 0
+        for ln in lengths:
+            chunks.append(payloads[off:off + ln])
+            off += ln
+        got: list[bytes] = []
+        try:
+            got = self._batch_sha1(chunks)
+        except Exception as e:  # noqa: BLE001 — fall back to the host
+            print(f"dedup sidecar: batched verify fell back to hashlib "
+                  f"({type(e).__name__}: {e})", flush=True)
+        if len(got) != count:
+            import hashlib
+            got = [hashlib.sha1(c).digest() for c in chunks]
+        mask = bytes(0 if g == d else 1 for g, d in zip(got, digests))
+        return 0, mask
+
+    @staticmethod
+    def _batch_sha1(chunks: list[bytes]) -> list[bytes]:
+        """One sha1_batch dispatch over zero-padded rows (device path)."""
+        if not chunks:
+            return []
+        from fastdfs_tpu.ops.sha1 import digest_bytes, sha1_batch
+        max_len = max(len(c) for c in chunks)
+        batch = np.zeros((len(chunks), max(max_len, 1)), dtype=np.uint8)
+        lens = np.zeros((len(chunks),), dtype=np.int32)
+        for i, c in enumerate(chunks):
+            batch[i, :len(c)] = np.frombuffer(c, dtype=np.uint8)
+            lens[i] = len(c)
+        raw = digest_bytes(sha1_batch(batch, lens))
+        return [raw[i * 20:(i + 1) * 20] for i in range(len(chunks))]
+
     def _neardups(self, body: bytes) -> tuple[int, bytes]:
         """Ranked near-dup report for a stored file id (the production
         query surface for the LSH index; without it the index is
@@ -365,6 +432,8 @@ class DedupSidecar:
                     status, resp = self._commit(body)
                 elif h.cmd == StorageCmd.DEDUP_NEARDUPS:
                     status, resp = self._neardups(body)
+                elif h.cmd == StorageCmd.DEDUP_VERIFY:
+                    status, resp = self._verify(body)
                 elif h.cmd == StorageCmd.ACTIVE_TEST:
                     status, resp = 0, b""
                 else:

@@ -15,6 +15,8 @@
 
 #include <vector>
 
+#include "common/bytes.h"
+
 namespace fdfs {
 
 int64_t NowMs() {
@@ -139,6 +141,28 @@ bool RecvAll(int fd, void* data, size_t len, int timeout_ms) {
     p += n;
     len -= static_cast<size_t>(n);
   }
+  return true;
+}
+
+bool NetRpc(int fd, uint8_t cmd, const std::string& body, std::string* resp,
+            uint8_t* status, int64_t max_resp, int timeout_ms) {
+  // 10-byte header framing shared with protocol_gen.h kHeaderSize; kept
+  // as a literal here so net.{h,cc} stays below the generated header in
+  // the include graph.
+  uint8_t hdr[10];
+  PutInt64BE(static_cast<int64_t>(body.size()), hdr);
+  hdr[8] = cmd;
+  hdr[9] = 0;
+  if (!SendAll(fd, hdr, sizeof(hdr), timeout_ms)) return false;
+  if (!body.empty() && !SendAll(fd, body.data(), body.size(), timeout_ms))
+    return false;
+  if (!RecvAll(fd, hdr, sizeof(hdr), timeout_ms)) return false;
+  int64_t len = GetInt64BE(hdr);
+  *status = hdr[9];
+  if (len < 0 || len > max_resp) return false;
+  resp->resize(static_cast<size_t>(len));
+  if (len > 0 && !RecvAll(fd, resp->data(), resp->size(), timeout_ms))
+    return false;
   return true;
 }
 

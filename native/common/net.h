@@ -32,6 +32,15 @@ bool RecvAll(int fd, void* data, size_t len, int timeout_ms);
 std::string PeerIp(int fd);
 std::string SockIp(int fd);
 
+// One header-framed request/response on a blocking fd — the client side
+// of the shared 10-byte wire protocol (8B BE body length + cmd +
+// status).  The single implementation every native out-of-process
+// caller uses (replication, recovery, scrub repair, trunk RPCs, load
+// CLI).  Returns false on transport failure or a response body over
+// max_resp; *status carries the server's header status byte.
+bool NetRpc(int fd, uint8_t cmd, const std::string& body, std::string* resp,
+            uint8_t* status, int64_t max_resp, int timeout_ms);
+
 // -- epoll loop (ioevent_loop.c analogue) ---------------------------------
 class EventLoop {
  public:

@@ -8,6 +8,7 @@
 
 #include <cerrno>
 #include <cstdio>
+#include <ctime>
 
 #include "common/bytes.h"
 #include "common/log.h"
@@ -107,43 +108,26 @@ std::optional<Recipe> ReadRecipeFile(const std::string& path) {
 
 // -- store ----------------------------------------------------------------
 
-ChunkStore::ChunkStore(std::string store_path)
-    : store_path_(std::move(store_path)) {}
+ChunkStore::ChunkStore(std::string store_path, int64_t gc_grace_s)
+    : store_path_(std::move(store_path)),
+      gc_grace_s_(gc_grace_s < 0 ? 0 : gc_grace_s) {}
 
 std::string ChunkStore::ChunkPath(const std::string& digest_hex) const {
   return store_path_ + "/data/chunks/" + digest_hex.substr(0, 2) + "/" +
          digest_hex.substr(2, 2) + "/" + digest_hex;
 }
 
-bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
-                           size_t len, bool* existed, std::string* err) {
-  std::string path = ChunkPath(digest_hex);
-  std::lock_guard<std::mutex> lk(mu_);
-  auto it = refs_.find(digest_hex);
-  if (it != refs_.end()) {
-    it->second++;
-    *existed = true;
-    return true;
-  }
-  auto d = deferred_.find(digest_hex);
-  if (d != deferred_.end()) {
-    // Zero-ref but still on disk (a pinned stream deferred the unlink):
-    // resurrect instead of rewriting, cancelling the deferral — its
-    // bytes were never subtracted from unique_bytes_.
-    deferred_.erase(d);
-    refs_[digest_hex] = 1;
-    *existed = true;
-    return true;
-  }
-  // First reference: write the payload (write-if-absent; a leftover file
-  // from a crashed write is simply overwritten — content-addressed, so
-  // same digest => same bytes).
-  std::string dir1 = store_path_ + "/data/chunks";
-  std::string dir2 = dir1 + "/" + digest_hex.substr(0, 2);
-  std::string dir3 = dir2 + "/" + digest_hex.substr(2, 2);
-  mkdir(dir1.c_str(), 0755);
-  mkdir(dir2.c_str(), 0755);
-  mkdir(dir3.c_str(), 0755);
+std::string ChunkStore::QuarantinePath(const std::string& digest_hex) const {
+  return store_path_ + "/data/quarantine/" + digest_hex;
+}
+
+namespace {
+
+// Write-if-absent payload write (tmp + rename; a leftover file from a
+// crashed write is simply overwritten — content-addressed, so same
+// digest => same bytes).
+bool WriteChunkFile(const std::string& path, const char* data, size_t len,
+                    std::string* err) {
   std::string tmp = path + ".tmp";
   int fd = open(tmp.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644);
   if (fd < 0) {
@@ -167,7 +151,64 @@ bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
     unlink(tmp.c_str());
     return false;
   }
+  return true;
+}
+
+}  // namespace
+
+bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
+                           size_t len, bool* existed, std::string* err) {
+  std::string path = ChunkPath(digest_hex);
+  std::lock_guard<std::mutex> lk(mu_);
+  // Heal-on-upload: these bytes hash to the digest (every caller
+  // verifies before PutAndRef), so a quarantined chunk gets its good
+  // payload restored by ANY upload/replication that carries it.
+  // Best-effort — a failed restore leaves the chunk quarantined
+  // (downloads keep failing loudly) but never fails the upload, which
+  // historically never wrote in the already-present case.
+  auto heal = [&]() {
+    if (!quarantined_.count(digest_hex)) return;
+    std::string werr;
+    if (WriteChunkFile(path, data, len, &werr)) {
+      quarantined_.erase(digest_hex);
+      unlink(QuarantinePath(digest_hex).c_str());
+      FDFS_LOG_INFO("chunk %s healed by incoming payload",
+                    digest_hex.c_str());
+    } else {
+      FDFS_LOG_WARN("quarantined chunk %s heal failed: %s",
+                    digest_hex.c_str(), werr.c_str());
+    }
+  };
+  auto it = refs_.find(digest_hex);
+  if (it != refs_.end()) {
+    heal();
+    it->second++;
+    *existed = true;
+    return true;
+  }
+  auto z = zero_ref_.find(digest_hex);
+  if (z != zero_ref_.end()) {
+    // Zero-ref but still on disk (GC grace window, or a pinned stream
+    // deferring the unlink): resurrect instead of rewriting.
+    heal();
+    refs_[digest_hex] = 1;
+    lens_[digest_hex] = z->second.length;
+    unique_bytes_ += z->second.length;
+    zero_ref_bytes_ -= z->second.length;
+    zero_ref_.erase(z);
+    *existed = true;
+    return true;
+  }
+  // First reference: write the payload.
+  std::string dir1 = store_path_ + "/data/chunks";
+  std::string dir2 = dir1 + "/" + digest_hex.substr(0, 2);
+  std::string dir3 = dir2 + "/" + digest_hex.substr(2, 2);
+  mkdir(dir1.c_str(), 0755);
+  mkdir(dir2.c_str(), 0755);
+  mkdir(dir3.c_str(), 0755);
+  if (!WriteChunkFile(path, data, len, err)) return false;
   refs_[digest_hex] = 1;
+  lens_[digest_hex] = static_cast<int64_t>(len);
   unique_bytes_ += static_cast<int64_t>(len);
   *existed = false;
   return true;
@@ -191,7 +232,9 @@ std::string ChunkStore::HaveMask(
   std::string need(digests.size(), '\0');
   std::lock_guard<std::mutex> lk(mu_);
   for (size_t i = 0; i < digests.size(); ++i)
-    need[i] = refs_.find(digests[i]) != refs_.end() ? 0 : 1;
+    need[i] = refs_.find(digests[i]) != refs_.end() &&
+                      !quarantined_.count(digests[i])
+                  ? 0 : 1;
   return need;
 }
 
@@ -203,6 +246,29 @@ bool ChunkStore::RefOne(const std::string& digest_hex) {
   return true;
 }
 
+void ChunkStore::RetireLocked(const std::string& digest_hex,
+                              int64_t length) {
+  // mu_ held; refs_ entry already erased.  Eager mode (no GC grace)
+  // keeps the original semantics: unlink now unless an in-flight stream
+  // pins the chunk, in which case the zero_ref_ entry defers the unlink
+  // to the last UnpinRecipe.  With a grace window every zero-ref chunk
+  // parks for the scrubber's GcSweep.
+  unique_bytes_ -= length;
+  if (gc_grace_s_ == 0 && !pins_.count(digest_hex)) {
+    UnlinkRetiredLocked(digest_hex);
+    return;
+  }
+  zero_ref_[digest_hex] = ZeroRef{length, time(nullptr)};
+  zero_ref_bytes_ += length;
+}
+
+void ChunkStore::UnlinkRetiredLocked(const std::string& digest_hex) {
+  unlink(ChunkPath(digest_hex).c_str());
+  unlink(QuarantinePath(digest_hex).c_str());
+  quarantined_.erase(digest_hex);
+  lens_.erase(digest_hex);
+}
+
 void ChunkStore::UnrefAll(const Recipe& r) {
   std::lock_guard<std::mutex> lk(mu_);
   for (const RecipeEntry& e : r.chunks) {
@@ -210,14 +276,7 @@ void ChunkStore::UnrefAll(const Recipe& r) {
     if (it == refs_.end()) continue;
     if (--it->second <= 0) {
       refs_.erase(it);
-      if (pins_.count(e.digest_hex)) {
-        // An in-flight download still streams this chunk: defer the
-        // unlink to the last UnpinRecipe.
-        deferred_[e.digest_hex] = e.length;
-      } else {
-        unlink(ChunkPath(e.digest_hex).c_str());
-        unique_bytes_ -= e.length;
-      }
+      RetireLocked(e.digest_hex, e.length);
     }
   }
 }
@@ -241,7 +300,13 @@ std::string ChunkStore::PinAndMask(const Recipe& r) {
   std::string need(r.chunks.size(), '\0');
   std::lock_guard<std::mutex> lk(mu_);
   for (size_t i = 0; i < r.chunks.size(); ++i) {
-    need[i] = refs_.find(r.chunks[i].digest_hex) != refs_.end() ? 0 : 1;
+    // Quarantined chunks read as "needed": the client re-ships the
+    // bytes and PutAndRef heals the store.  The pin taken here also
+    // exempts the chunk from GcSweep and Quarantine for the session's
+    // lifetime — probe and pin share this one lock acquisition.
+    need[i] = refs_.find(r.chunks[i].digest_hex) != refs_.end() &&
+                      !quarantined_.count(r.chunks[i].digest_hex)
+                  ? 0 : 1;
     pins_[r.chunks[i].digest_hex]++;
   }
   return need;
@@ -259,14 +324,16 @@ void ChunkStore::UnpinRecipe(const Recipe& r) {
     if (it == pins_.end()) continue;
     if (--it->second <= 0) {
       pins_.erase(it);
-      auto d = deferred_.find(e.digest_hex);
-      if (d != deferred_.end()) {
-        // ...unless the chunk was re-added while the stream ran.
-        if (refs_.find(e.digest_hex) == refs_.end()) {
-          unlink(ChunkPath(e.digest_hex).c_str());
-          unique_bytes_ -= d->second;
-        }
-        deferred_.erase(d);
+      // Eager mode: the last pin drop completes a delete that was
+      // deferred mid-stream — unless the chunk was re-added while the
+      // stream ran (PutAndRef resurrection erased the zero_ref_ entry).
+      // With a GC grace the entry simply waits for GcSweep.
+      auto z = zero_ref_.find(e.digest_hex);
+      if (z != zero_ref_.end() && gc_grace_s_ == 0 &&
+          refs_.find(e.digest_hex) == refs_.end()) {
+        zero_ref_bytes_ -= z->second.length;
+        zero_ref_.erase(z);
+        UnlinkRetiredLocked(e.digest_hex);
       }
     }
   }
@@ -298,6 +365,130 @@ int64_t ChunkStore::unique_chunks() const {
 int64_t ChunkStore::unique_bytes() const {
   std::lock_guard<std::mutex> lk(mu_);
   return unique_bytes_;
+}
+
+int64_t ChunkStore::gc_pending_chunks() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return static_cast<int64_t>(zero_ref_.size());
+}
+
+int64_t ChunkStore::gc_pending_bytes() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return zero_ref_bytes_;
+}
+
+int64_t ChunkStore::quarantined_chunks() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return static_cast<int64_t>(quarantined_.size());
+}
+
+// -- integrity engine -----------------------------------------------------
+
+std::vector<ChunkStore::ChunkInfo> ChunkStore::SnapshotLive(
+    int prefix) const {
+  static const char* kHex = "0123456789abcdef";
+  char p0 = 0, p1 = 0;
+  if (prefix >= 0) {
+    p0 = kHex[(prefix >> 4) & 0xF];
+    p1 = kHex[prefix & 0xF];
+  }
+  std::vector<ChunkInfo> out;
+  std::lock_guard<std::mutex> lk(mu_);
+  if (prefix < 0) out.reserve(refs_.size());
+  for (const auto& [dig, n] : refs_) {
+    if (prefix >= 0 && (dig[0] != p0 || dig[1] != p1)) continue;
+    if (quarantined_.count(dig)) continue;
+    auto l = lens_.find(dig);
+    out.push_back({dig, l != lens_.end() ? l->second : 0});
+  }
+  return out;
+}
+
+std::vector<ChunkStore::ChunkInfo> ChunkStore::SnapshotQuarantined() const {
+  std::vector<ChunkInfo> out;
+  std::lock_guard<std::mutex> lk(mu_);
+  for (const std::string& dig : quarantined_) {
+    if (refs_.find(dig) == refs_.end()) continue;  // zero-ref: GC's problem
+    auto l = lens_.find(dig);
+    out.push_back({dig, l != lens_.end() ? l->second : 0});
+  }
+  return out;
+}
+
+bool ChunkStore::IsQuarantined(const std::string& digest_hex) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return quarantined_.count(digest_hex) != 0;
+}
+
+ChunkStore::QuarantineResult ChunkStore::Quarantine(
+    const std::string& digest_hex) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (refs_.find(digest_hex) == refs_.end())
+    return QuarantineResult::kGone;  // deleted since the snapshot
+  if (pins_.count(digest_hex)) return QuarantineResult::kPinned;
+  // Re-verify under the lock: the scrubber's verify read ran lock-free,
+  // so it may have raced a delete + re-upload of this digest and hashed
+  // a half-gone file.  No writer can interleave with this read, so a
+  // clean hash here is authoritative.
+  {
+    int fd = open(ChunkPath(digest_hex).c_str(), O_RDONLY);
+    if (fd >= 0) {
+      Sha1Stream sha;
+      char buf[65536];
+      ssize_t r;
+      while ((r = read(fd, buf, sizeof(buf))) > 0)
+        sha.Update(buf, static_cast<size_t>(r));
+      close(fd);
+      if (r == 0 && sha.Final().Hex() == digest_hex)
+        return QuarantineResult::kClean;
+    }
+  }
+  mkdir((store_path_ + "/data/quarantine").c_str(), 0755);
+  // A rename failure (e.g. the file already vanished) still marks the
+  // chunk quarantined: either way the bytes are not servable, and the
+  // mark is what routes re-uploads/repairs to the heal path.
+  if (rename(ChunkPath(digest_hex).c_str(),
+             QuarantinePath(digest_hex).c_str()) != 0 &&
+      errno != ENOENT)
+    FDFS_LOG_WARN("quarantine rename %s: %s", digest_hex.c_str(),
+                  strerror(errno));
+  quarantined_.insert(digest_hex);
+  return QuarantineResult::kQuarantined;
+}
+
+bool ChunkStore::RepairChunk(const std::string& digest_hex, const char* data,
+                             size_t len, std::string* err) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (refs_.find(digest_hex) == refs_.end()) {
+    *err = "no longer referenced";
+    return false;
+  }
+  if (!WriteChunkFile(ChunkPath(digest_hex), data, len, err)) return false;
+  quarantined_.erase(digest_hex);
+  unlink(QuarantinePath(digest_hex).c_str());
+  lens_[digest_hex] = static_cast<int64_t>(len);
+  return true;
+}
+
+int64_t ChunkStore::GcSweep(int64_t now_s, int64_t* bytes) {
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t reclaimed = 0;
+  for (auto it = zero_ref_.begin(); it != zero_ref_.end();) {
+    if (now_s - it->second.since_s < gc_grace_s_ ||
+        pins_.count(it->first)) {
+      // Inside the grace window, or pinned by an in-flight stream /
+      // phase-1 upload session — the pin probe shares this lock with
+      // the unlink, so PinAndMask can never lose the race.
+      ++it;
+      continue;
+    }
+    UnlinkRetiredLocked(it->first);
+    zero_ref_bytes_ -= it->second.length;
+    *bytes += it->second.length;
+    ++reclaimed;
+    it = zero_ref_.erase(it);
+  }
+  return reclaimed;
 }
 
 namespace {
@@ -339,10 +530,14 @@ void ChunkStore::RebuildFromRecipes() {
   std::unordered_map<std::string, int64_t> refs, lens;
   WalkRecipes(store_path_ + "/data", &refs, &lens);
 
-  // GC pass: any chunk file not named by a recipe is an orphan from a
-  // crash between chunk write and recipe write (or after a delete that
-  // crashed mid-unref) — safe to drop.
-  int64_t orphans = 0, bytes = 0;
+  // GC pass: any chunk file not named by a recipe is an orphan — a
+  // crash leftover, or (with a GC grace window) a deliberately-retired
+  // zero-ref chunk whose grace had not expired at shutdown.  Eager mode
+  // drops orphans on the spot (the original behavior); grace mode
+  // parks them in zero_ref_ aged by file mtime, so the grace window is
+  // crash-safe instead of resetting on every restart.
+  int64_t orphans = 0, parked = 0, bytes = 0;
+  std::unordered_map<std::string, ZeroRef> zero;
   std::string croot = store_path_ + "/data/chunks";
   DIR* d1 = opendir(croot.c_str());
   if (d1 != nullptr) {
@@ -362,8 +557,17 @@ void ChunkStore::RebuildFromRecipes() {
         while ((e3 = readdir(d3)) != nullptr) {
           std::string name = e3->d_name;
           if (name[0] == '.') continue;
-          if (!IsHex40(name) || refs.find(name) == refs.end()) {
-            unlink((l2 + "/" + name).c_str());
+          if (IsHex40(name) && refs.find(name) != refs.end()) continue;
+          std::string path = l2 + "/" + name;
+          struct stat st;
+          if (IsHex40(name) && gc_grace_s_ > 0 &&
+              stat(path.c_str(), &st) == 0) {
+            zero[name] = ZeroRef{static_cast<int64_t>(st.st_size),
+                                 static_cast<int64_t>(st.st_mtime)};
+            lens[name] = static_cast<int64_t>(st.st_size);
+            ++parked;
+          } else {
+            unlink(path.c_str());
             ++orphans;
           }
         }
@@ -374,16 +578,50 @@ void ChunkStore::RebuildFromRecipes() {
     closedir(d1);
   }
 
+  // Quarantine survives restarts: a referenced digest whose bytes sit in
+  // quarantine/ must keep reading as missing (and healable), or a
+  // restart would silently re-admit the corrupt state.  Unreferenced
+  // quarantine files are corrupt garbage nobody names — drop them.
+  std::unordered_set<std::string> quarantined;
+  std::string qroot = store_path_ + "/data/quarantine";
+  DIR* qd = opendir(qroot.c_str());
+  if (qd != nullptr) {
+    struct dirent* qe;
+    while ((qe = readdir(qd)) != nullptr) {
+      std::string name = qe->d_name;
+      if (name[0] == '.') continue;
+      if (IsHex40(name) && refs.find(name) != refs.end()) {
+        struct stat st;
+        if (stat(ChunkPath(name).c_str(), &st) == 0) {
+          // A healed copy already lives in chunks/ (crash between the
+          // repair write and the quarantine unlink): prefer it.
+          unlink((qroot + "/" + name).c_str());
+        } else {
+          quarantined.insert(name);
+        }
+      } else {
+        unlink((qroot + "/" + name).c_str());
+      }
+    }
+    closedir(qd);
+  }
+
   std::lock_guard<std::mutex> lk(mu_);
   refs_ = std::move(refs);
+  lens_ = std::move(lens);
+  zero_ref_ = std::move(zero);
+  quarantined_ = std::move(quarantined);
   unique_bytes_ = 0;
-  for (const auto& [dig, n] : refs_) unique_bytes_ += lens[dig];
+  zero_ref_bytes_ = 0;
+  for (const auto& [dig, n] : refs_) unique_bytes_ += lens_[dig];
+  for (const auto& [dig, z] : zero_ref_) zero_ref_bytes_ += z.length;
   bytes = unique_bytes_;
-  if (!refs_.empty() || orphans > 0)
+  if (!refs_.empty() || orphans > 0 || parked > 0 || !quarantined_.empty())
     FDFS_LOG_INFO("chunk store: %zu unique chunks (%lld bytes), %lld "
-                  "orphans collected",
+                  "orphans collected, %lld awaiting GC, %zu quarantined",
                   refs_.size(), static_cast<long long>(bytes),
-                  static_cast<long long>(orphans));
+                  static_cast<long long>(orphans),
+                  static_cast<long long>(parked), quarantined_.size());
 }
 
 }  // namespace fdfs

@@ -19,6 +19,22 @@
 // depends on a refcount file.  Single acquisition order: this class is
 // self-locked and calls nothing that locks.
 //
+// Integrity lifecycle (the anti-entropy subsystem in storage/scrub.h):
+//
+//  * Zero-ref GC.  With gc_grace_s == 0 (default) a chunk whose last
+//    reference drops is unlinked immediately (deferred only while a
+//    stream pin holds it — the original semantics).  With a grace
+//    window, zero-ref chunks park in zero_ref_ (bytes stay on disk,
+//    resurrectable by PutAndRef) until a GcSweep older than the grace
+//    reclaims them; the pin probe runs under the SAME lock as the
+//    unlink, so an upload session's PinAndMask can never lose a chunk
+//    to a sweep in the probe-to-pin gap.
+//  * Quarantine.  A scrub pass that finds bit-rot moves the bad bytes
+//    into <store_path>/data/quarantine/<digest> (never served again)
+//    while the refcount entry stays live; Have/PinAndMask report the
+//    chunk as missing so uploads re-ship the bytes, and PutAndRef /
+//    RepairChunk with verified payloads heal it in place.
+//
 // Reference anchor: replaces the inode-per-file write in
 // storage/storage_dio.c:dio_write_file() for deduplicated uploads.
 #pragma once
@@ -28,6 +44,7 @@
 #include <optional>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace fdfs {
@@ -49,7 +66,10 @@ std::optional<Recipe> ReadRecipeFile(const std::string& path);
 
 class ChunkStore {
  public:
-  explicit ChunkStore(std::string store_path);
+  // gc_grace_s: how long a zero-ref chunk's bytes linger on disk before
+  // a GcSweep may reclaim them (0 = unlink eagerly on the last unref,
+  // the pre-scrubber behavior).
+  explicit ChunkStore(std::string store_path, int64_t gc_grace_s = 0);
 
   // Scan every *.rcp under the data dir: rebuild refcounts and delete
   // orphaned chunk files.  Call once at startup, before serving.
@@ -115,17 +135,76 @@ class ChunkStore {
   std::optional<Recipe> ReadRecipeAndPin(const std::string& path);
 
   std::string ChunkPath(const std::string& digest_hex) const;
+  std::string QuarantinePath(const std::string& digest_hex) const;
+
+  // -- integrity engine (storage/scrub.*) --------------------------------
+  struct ChunkInfo {
+    std::string digest_hex;
+    int64_t length = 0;
+  };
+  // Live (referenced, non-quarantined) chunks for a verify pass.
+  // prefix -1 snapshots everything in one call; 0..255 filters to
+  // digests whose first byte equals it, so a scrubber walking the 256
+  // slices in turn holds the lock for one allocation-light filter scan
+  // at a time and never keeps a many-million-entry snapshot resident
+  // across an hours-long paced pass.
+  std::vector<ChunkInfo> SnapshotLive(int prefix = -1) const;
+  // Currently quarantined chunks still named by a recipe (repair targets).
+  std::vector<ChunkInfo> SnapshotQuarantined() const;
+  bool IsQuarantined(const std::string& digest_hex) const;
+
+  enum class QuarantineResult { kQuarantined, kGone, kPinned, kClean };
+  // Move a corrupt chunk's bytes aside so no download/replication path
+  // ever serves them again.  kPinned when an in-flight stream still
+  // holds the chunk (repair-in-place under a reader is not safe — the
+  // scrubber retries next pass); kGone when the chunk lost its last
+  // reference meanwhile; kClean when a re-read UNDER THE LOCK hashes
+  // correctly — the caller's lock-free verify read raced a delete +
+  // re-upload of the same digest, and the bytes on disk now are good
+  // (quarantining them would jail a freshly-written chunk).  Probe,
+  // re-verify, and rename happen in one lock acquisition, which no
+  // PutAndRef/UnrefAll can interleave.
+  QuarantineResult Quarantine(const std::string& digest_hex);
+  // Restore verified bytes for a still-referenced digest (replica
+  // repair).  False when the digest is no longer live (deleted — drop
+  // it) or the write fails.  The caller MUST have verified
+  // SHA1(data) == digest_hex.
+  bool RepairChunk(const std::string& digest_hex, const char* data,
+                   size_t len, std::string* err);
+  // Reclaim zero-ref chunks whose grace expired at `now_s`, skipping
+  // pinned ones — probe and unlink under one lock acquisition, so a
+  // concurrent PinAndMask either pinned the chunk first (sweep skips
+  // it) or finds it already gone (reports it as needed).  Returns the
+  // number of chunks unlinked; *bytes accumulates their sizes.
+  int64_t GcSweep(int64_t now_s, int64_t* bytes);
 
   int64_t unique_chunks() const;
   int64_t unique_bytes() const;
+  int64_t gc_pending_chunks() const;
+  int64_t gc_pending_bytes() const;
+  int64_t quarantined_chunks() const;
 
  private:
+  struct ZeroRef {
+    int64_t length = 0;
+    int64_t since_s = 0;  // wall clock of the last unref (or file mtime)
+  };
+  // mu_ held.  Park a zero-ref chunk for GC or unlink it eagerly
+  // (gc_grace_s_ == 0 and unpinned).
+  void RetireLocked(const std::string& digest_hex, int64_t length);
+  // mu_ held.  Unlink a zero-ref chunk's bytes (chunks/ and quarantine/).
+  void UnlinkRetiredLocked(const std::string& digest_hex);
+
   std::string store_path_;
+  int64_t gc_grace_s_ = 0;
   mutable std::mutex mu_;
   std::unordered_map<std::string, int64_t> refs_;
-  std::unordered_map<std::string, int64_t> pins_;      // in-flight streams
-  std::unordered_map<std::string, int64_t> deferred_;  // digest -> length
+  std::unordered_map<std::string, int64_t> lens_;  // digest -> byte length
+  std::unordered_map<std::string, int64_t> pins_;  // in-flight streams
+  std::unordered_map<std::string, ZeroRef> zero_ref_;  // awaiting GC
+  std::unordered_set<std::string> quarantined_;
   int64_t unique_bytes_ = 0;
+  int64_t zero_ref_bytes_ = 0;
 };
 
 }  // namespace fdfs

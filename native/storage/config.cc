@@ -79,6 +79,17 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
   slow_request_threshold_ms =
       ini.GetInt("slow_request_threshold_ms", slow_request_threshold_ms);
   if (slow_request_threshold_ms < 0) slow_request_threshold_ms = 0;
+  scrub_interval_s = static_cast<int>(
+      ini.GetSeconds("scrub_interval_s", scrub_interval_s));
+  if (scrub_interval_s < 0) scrub_interval_s = 0;
+  scrub_bandwidth_mb_s = static_cast<int>(
+      ini.GetInt("scrub_bandwidth_mb_s", scrub_bandwidth_mb_s));
+  if (scrub_bandwidth_mb_s < 0) scrub_bandwidth_mb_s = 0;
+  // 1 TB/s cap: keeps the pacing arithmetic far from int64 limits (a
+  // larger value is indistinguishable from unpaced anyway).
+  if (scrub_bandwidth_mb_s > (1 << 20)) scrub_bandwidth_mb_s = 1 << 20;
+  chunk_gc_grace_s = ini.GetSeconds("chunk_gc_grace_s", chunk_gc_grace_s);
+  if (chunk_gc_grace_s < 0) chunk_gc_grace_s = 0;
   return true;
 }
 

@@ -74,6 +74,16 @@ struct StorageConfig {
   // slow gate (traced requests still record).
   int trace_buffer_size = 4096;
   int64_t slow_request_threshold_ms = 1000;
+  // Integrity engine (storage/scrub.h).  scrub_interval_s: cadence of
+  // the background verify+repair+GC pass (0 = no periodic passes;
+  // SCRUB_KICK still forces one).  scrub_bandwidth_mb_s: verify read
+  // pace so scrubbing never starves foreground IO (0 = unlimited).
+  // chunk_gc_grace_s: how long a zero-ref chunk's bytes stay on disk
+  // before a GC pass may reclaim them (0 = unlink eagerly on delete,
+  // the pre-scrubber behavior).
+  int scrub_interval_s = 86400;
+  int scrub_bandwidth_mb_s = 0;
+  int64_t chunk_gc_grace_s = 0;
 
   // Parse + validate; false with *error on problems.
   bool Load(const IniConfig& ini, std::string* error);

@@ -339,6 +339,38 @@ bool SidecarDedup::NearDups(const std::string& file_id, std::string* out,
   return true;
 }
 
+bool SidecarDedup::VerifyChunks(const std::vector<ChunkFp>& chunks,
+                                const std::string& payloads,
+                                std::string* bad_mask) {
+  if (chunks.empty()) {
+    bad_mask->clear();
+    return true;
+  }
+  // kDedupVerify body: 8B count + count x (8B length + 20B raw digest)
+  // + the payloads concatenated; response = count bytes (0 ok / 1 bad).
+  std::string body;
+  uint8_t num[8];
+  PutInt64BE(static_cast<int64_t>(chunks.size()), num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  int64_t total = 0;
+  for (const ChunkFp& c : chunks) {
+    PutInt64BE(c.length, num);
+    body.append(reinterpret_cast<char*>(num), 8);
+    if (!HexToBytes(c.digest_hex, &body)) return false;
+    total += c.length;
+  }
+  if (total != static_cast<int64_t>(payloads.size())) return false;
+  body += payloads;
+  std::string resp;
+  uint8_t status = 0;
+  if (!Rpc(static_cast<uint8_t>(StorageCmd::kDedupVerify), body, &resp,
+           &status, static_cast<int64_t>(chunks.size()) + 1024) ||
+      status != 0 || resp.size() != chunks.size())
+    return false;  // sidecar down/old: caller verifies serially
+  *bad_mask = std::move(resp);
+  return true;
+}
+
 std::unique_ptr<DedupPlugin> MakeDedupPlugin(const std::string& mode,
                                              const std::string& base_path,
                                              const std::string& sidecar_path) {

@@ -89,6 +89,20 @@ class DedupPlugin {
     (void)file_id; (void)out; (void)no_data;
     return false;
   }
+
+  // Batched chunk-integrity verify for the scrubber (kDedupVerify RPC):
+  // `payloads` is each chunk's bytes concatenated in `chunks` order
+  // (lengths from ChunkFp::length; digests from digest_hex).  On
+  // success *bad_mask has one byte per chunk (0 = digest matches,
+  // 1 = mismatch).  Returns false when batched verification is
+  // unavailable (none/cpu modes, sidecar unreachable) — the caller
+  // falls back to its serial host SHA1.
+  virtual bool VerifyChunks(const std::vector<ChunkFp>& chunks,
+                            const std::string& payloads,
+                            std::string* bad_mask) {
+    (void)chunks; (void)payloads; (void)bad_mask;
+    return false;
+  }
 };
 
 // CPU baseline: exact SHA1 digest map, snapshotted to
@@ -136,6 +150,9 @@ class SidecarDedup : public DedupPlugin {
   void ForgetChunked(const std::string& file_id) override;
   bool NearDups(const std::string& file_id, std::string* out,
                 bool* no_data) override;
+  bool VerifyChunks(const std::vector<ChunkFp>& chunks,
+                    const std::string& payloads,
+                    std::string* bad_mask) override;
 
  private:
   // Connection pool: each in-flight RPC borrows its own fd, so

@@ -27,21 +27,7 @@ constexpr int kRpcTimeoutMs = 10000;
 
 bool Rpc(int fd, uint8_t cmd, const std::string& body, std::string* resp,
          uint8_t* status, int64_t max_resp) {
-  uint8_t hdr[kHeaderSize];
-  PutInt64BE(static_cast<int64_t>(body.size()), hdr);
-  hdr[8] = cmd;
-  hdr[9] = 0;
-  if (!SendAll(fd, hdr, sizeof(hdr), kRpcTimeoutMs) ||
-      !SendAll(fd, body.data(), body.size(), kRpcTimeoutMs) ||
-      !RecvAll(fd, hdr, sizeof(hdr), kRpcTimeoutMs))
-    return false;
-  int64_t len = GetInt64BE(hdr);
-  *status = hdr[9];
-  if (len < 0 || len > max_resp) return false;
-  resp->resize(static_cast<size_t>(len));
-  if (len > 0 && !RecvAll(fd, resp->data(), resp->size(), kRpcTimeoutMs))
-    return false;
-  return true;
+  return NetRpc(fd, cmd, body, resp, status, max_resp, kRpcTimeoutMs);
 }
 
 bool HasMarkFiles(const std::string& sync_dir) {

@@ -1,0 +1,418 @@
+#include "storage/scrub.h"
+
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+
+#include "common/bytes.h"
+#include "common/log.h"
+#include "common/net.h"
+
+namespace fdfs {
+
+namespace {
+
+constexpr int kRpcTimeoutMs = 10000;
+// Verify batch bounds: enough chunks per sidecar round-trip to amortize
+// the RPC, small enough that a batch never holds more than a few MB.
+constexpr size_t kBatchChunks = 64;
+constexpr int64_t kBatchBytes = 4 << 20;
+
+int64_t WallUs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+}  // namespace
+
+ScrubManager::ScrubManager(ScrubOptions opts, std::string group_name,
+                           std::vector<ChunkStore*> chunk_stores,
+                           PeerListFn peers, DedupPlugin* plugin,
+                           TraceRing* trace)
+    : opts_(opts), group_name_(std::move(group_name)),
+      stores_(std::move(chunk_stores)), peers_(std::move(peers)),
+      plugin_(plugin), trace_(trace) {}
+
+ScrubManager::~ScrubManager() { Stop(); }
+
+void ScrubManager::Start() {
+  thread_ = std::thread(&ScrubManager::ThreadMain, this);
+}
+
+void ScrubManager::Stop() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stop_) return;
+    stop_ = true;
+  }
+  cv_.notify_all();
+  if (thread_.joinable()) thread_.join();
+}
+
+void ScrubManager::Kick() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    kicked_ = true;
+  }
+  cv_.notify_all();
+}
+
+void ScrubManager::NoteRecipeReclaimed(int64_t bytes) {
+  recipes_reclaimed_.fetch_add(1, std::memory_order_relaxed);
+  bytes_reclaimed_.fetch_add(bytes, std::memory_order_relaxed);
+}
+
+void ScrubManager::FillStats(int64_t* out) const {
+  static_assert(kScrubStatCount == 18, "update StatValue + protocol.py");
+  for (int i = 0; i < kScrubStatCount; ++i) out[i] = StatValue(i);
+}
+
+int64_t ScrubManager::StatValue(int i) const {
+  switch (i) {  // kScrubStatNames order
+    case 0: return running_.load() ? 1 : 0;
+    case 1: return passes_.load();
+    case 2: return pass_chunks_done_.load();
+    case 3: return pass_chunks_total_.load();
+    case 4: return chunks_verified_.load();
+    case 5: return bytes_verified_.load();
+    case 6: return chunks_corrupt_.load();
+    case 7: return chunks_repaired_.load();
+    case 8: return corrupt_unrepairable_.load();
+    case 9: {
+      int64_t n = 0;
+      for (ChunkStore* cs : stores_) n += cs->quarantined_chunks();
+      return n;
+    }
+    case 10: return skipped_pinned_.load();
+    case 11: {
+      int64_t n = 0;
+      for (ChunkStore* cs : stores_) n += cs->gc_pending_chunks();
+      return n;
+    }
+    case 12: {
+      int64_t n = 0;
+      for (ChunkStore* cs : stores_) n += cs->gc_pending_bytes();
+      return n;
+    }
+    case 13: return chunks_reclaimed_.load();
+    case 14: return bytes_reclaimed_.load();
+    case 15: return recipes_reclaimed_.load();
+    case 16: return last_pass_unix_.load();
+    case 17: return last_pass_dur_us_.load();
+    default: return 0;
+  }
+}
+
+void ScrubManager::ThreadMain() {
+  std::unique_lock<std::mutex> lk(mu_);
+  while (!stop_) {
+    bool due;
+    if (opts_.interval_s > 0) {
+      due = !cv_.wait_for(lk, std::chrono::seconds(opts_.interval_s),
+                          [this] { return stop_ || kicked_; });
+    } else {
+      cv_.wait(lk, [this] { return stop_ || kicked_; });
+      due = false;
+    }
+    if (stop_) return;
+    due = due || kicked_;
+    kicked_ = false;
+    if (!due) continue;
+    lk.unlock();
+    RunPass();
+    lk.lock();
+  }
+}
+
+void ScrubManager::Pace(int64_t bytes_read, int64_t pass_start_us) {
+  if (opts_.bandwidth_bytes_s <= 0) return;
+  // Token bucket: the pass may only be `bytes_read / bw` seconds old.
+  // Divide before scaling to microseconds — bytes_read is cumulative
+  // over the pass, and `bytes * 1e6` would overflow int64 at ~9.2 TB
+  // (a plausible store), silently disabling pacing.
+  int64_t bw = opts_.bandwidth_bytes_s;
+  int64_t budget_us =
+      bytes_read / bw * 1000000 + (bytes_read % bw) * 1000000 / bw;
+  int64_t ahead_us = budget_us - (WallUs() - pass_start_us);
+  while (ahead_us > 0) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (stop_) return;
+    }
+    usleep(static_cast<useconds_t>(std::min<int64_t>(ahead_us, 50000)));
+    ahead_us = budget_us - (WallUs() - pass_start_us);
+  }
+}
+
+void ScrubManager::RunPass() {
+  running_ = true;
+  int64_t start_us = WallUs();
+  pass_chunks_done_ = 0;
+  pass_chunks_total_ = 0;
+  pass_ctx_ = TraceCtx{};
+  uint32_t root_span = 0;
+  if (trace_ != nullptr) {
+    pass_ctx_.trace_id = trace_->NewTraceId();
+    pass_ctx_.flags = kTraceFlagSampled;
+    root_span = trace_->NextSpanId();
+    pass_ctx_.parent_span = root_span;
+  }
+
+  // The progress denominator is the live-chunk count at pass start
+  // (approximate under churn — uploads and deletes move it).
+  for (ChunkStore* cs : stores_)
+    pass_chunks_total_ += cs->unique_chunks();
+
+  int64_t paced = 0;
+  bool aborted = false;
+  for (size_t spi = 0; spi < stores_.size() && !aborted; ++spi) {
+    ChunkStore* cs = stores_[spi];
+    // Repair-retry targets from EARLIER passes, snapshotted before the
+    // verify stage so a chunk quarantined in this pass (whose repair
+    // already ran in HandleCorrupt) is not attempted twice per pass.
+    auto retry = cs->SnapshotQuarantined();
+    // Walk the store in 256 digest-prefix slices: each slice is one
+    // short, allocation-light scan under the store lock, and a
+    // many-million-chunk store never holds a full snapshot resident
+    // across an hours-long paced pass.
+    for (int prefix = 0; prefix < 256 && !aborted; ++prefix) {
+      auto live = cs->SnapshotLive(prefix);
+      size_t i = 0;
+      while (i < live.size()) {
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          if (stop_) {
+            aborted = true;
+            break;
+          }
+        }
+        // One bounded batch: read payloads, then verify them together.
+        std::vector<ChunkStore::ChunkInfo> batch;
+        std::vector<std::string> payloads;
+        std::vector<char> bad;
+        int64_t batch_bytes = 0;
+        while (i < live.size() && batch.size() < kBatchChunks &&
+               batch_bytes < kBatchBytes) {
+          const auto& info = live[i++];
+          batch.push_back(info);
+          payloads.emplace_back();
+          // A missing or short chunk file is corruption too (truncation,
+          // lost write) — mark it bad without a digest round.
+          bad.push_back(
+              cs->ReadChunk(info.digest_hex, info.length, &payloads.back())
+                  ? 0 : 1);
+          batch_bytes += info.length;
+        }
+        paced += batch_bytes;
+        Pace(paced, start_us);
+        VerifyBatch(static_cast<int>(spi), batch, payloads, &bad);
+        for (size_t b = 0; b < batch.size(); ++b)
+          if (bad[b]) HandleCorrupt(static_cast<int>(spi), batch[b]);
+        chunks_verified_.fetch_add(static_cast<int64_t>(batch.size()),
+                                   std::memory_order_relaxed);
+        bytes_verified_.fetch_add(batch_bytes, std::memory_order_relaxed);
+        pass_chunks_done_.fetch_add(static_cast<int64_t>(batch.size()),
+                                    std::memory_order_relaxed);
+      }
+    }
+    if (aborted) break;
+
+    // Repair retry: chunks quarantined by an earlier pass (no replica
+    // had them then) get another chance every pass.
+    for (const auto& info : retry)
+      HandleCorrupt(static_cast<int>(spi), info, /*already_quarantined=*/true);
+
+    // GC sweep: reclaim zero-ref chunks past the grace window.
+    int64_t bytes = 0;
+    int64_t n = cs->GcSweep(time(nullptr), &bytes);
+    if (n > 0) {
+      chunks_reclaimed_.fetch_add(n, std::memory_order_relaxed);
+      bytes_reclaimed_.fetch_add(bytes, std::memory_order_relaxed);
+      FDFS_LOG_INFO("scrub gc: reclaimed %lld chunks (%lld bytes) on "
+                    "store path %zu",
+                    static_cast<long long>(n),
+                    static_cast<long long>(bytes), spi);
+    }
+  }
+
+  int64_t dur = WallUs() - start_us;
+  if (!aborted) {
+    passes_.fetch_add(1, std::memory_order_relaxed);
+    last_pass_unix_ = time(nullptr);
+    last_pass_dur_us_ = dur;
+  }
+  if (trace_ != nullptr && pass_ctx_.valid()) {
+    TraceSpan s;
+    s.trace_id = pass_ctx_.trace_id;
+    s.span_id = root_span;
+    s.parent_id = 0;
+    s.start_us = TraceWallUs() - dur;
+    s.dur_us = dur;
+    s.status = aborted ? 4 /*EINTR*/ : 0;
+    s.flags = kTraceFlagSampled;
+    s.SetName("scrub.pass");
+    trace_->Record(s);
+  }
+  running_ = false;
+}
+
+void ScrubManager::VerifyBatch(
+    int spi, const std::vector<ChunkStore::ChunkInfo>& infos,
+    const std::vector<std::string>& payloads, std::vector<char>* bad) {
+  (void)spi;
+  // Sidecar first: one DEDUP_VERIFY RPC hashes the whole batch with
+  // ops/sha1.sha1_batch on the accelerator.  Unreadable entries are
+  // already marked and excluded from the RPC.
+  if (plugin_ != nullptr) {
+    std::vector<ChunkFp> want;
+    std::string concat;
+    std::vector<size_t> idx;
+    for (size_t i = 0; i < infos.size(); ++i) {
+      if ((*bad)[i]) continue;
+      ChunkFp fp;
+      fp.length = infos[i].length;
+      fp.digest_hex = infos[i].digest_hex;
+      want.push_back(std::move(fp));
+      concat += payloads[i];
+      idx.push_back(i);
+    }
+    std::string mask;
+    if (!want.empty() && plugin_->VerifyChunks(want, concat, &mask) &&
+        mask.size() == want.size()) {
+      for (size_t k = 0; k < idx.size(); ++k)
+        if (mask[k]) (*bad)[idx[k]] = 1;
+      return;
+    }
+  }
+  // Serial host path (SHA-NI when the CPU has it).
+  for (size_t i = 0; i < infos.size(); ++i) {
+    if ((*bad)[i]) continue;
+    if (Sha1(payloads[i].data(), payloads[i].size()).Hex() !=
+        infos[i].digest_hex)
+      (*bad)[i] = 1;
+  }
+}
+
+void ScrubManager::HandleCorrupt(int spi, const ChunkStore::ChunkInfo& info,
+                                 bool already_quarantined) {
+  ChunkStore* cs = stores_[spi];
+  int64_t t0 = TraceWallUs();
+  int status = 0;
+  bool attempted = false;
+  if (already_quarantined && !cs->IsQuarantined(info.digest_hex))
+    return;  // healed (re-upload/repair) since the retry snapshot
+  if (!already_quarantined) {
+    switch (cs->Quarantine(info.digest_hex)) {
+      case ChunkStore::QuarantineResult::kGone:
+        return;  // deleted since the snapshot — nothing was corrupt
+      case ChunkStore::QuarantineResult::kClean:
+        // False alarm: the lock-free verify read raced a delete +
+        // re-upload; the authoritative under-lock re-hash is clean.
+        return;
+      case ChunkStore::QuarantineResult::kPinned:
+        // An in-flight stream or upload session still holds the chunk:
+        // repair-in-place under a reader is unsafe; retry next pass.
+        chunks_corrupt_.fetch_add(1, std::memory_order_relaxed);
+        skipped_pinned_.fetch_add(1, std::memory_order_relaxed);
+        FDFS_LOG_WARN("scrub: corrupt chunk %s is pinned by an in-flight "
+                      "stream; retrying next pass",
+                      info.digest_hex.c_str());
+        return;
+      case ChunkStore::QuarantineResult::kQuarantined:
+        chunks_corrupt_.fetch_add(1, std::memory_order_relaxed);
+        FDFS_LOG_WARN("scrub: chunk %s failed verification on store path "
+                      "%d — quarantined",
+                      info.digest_hex.c_str(), spi);
+        break;
+    }
+  }
+  std::string payload;
+  if (FetchFromReplica(spi, info.digest_hex, info.length, &payload)) {
+    attempted = true;
+    std::string err;
+    if (cs->RepairChunk(info.digest_hex, payload.data(), payload.size(),
+                        &err)) {
+      chunks_repaired_.fetch_add(1, std::memory_order_relaxed);
+      FDFS_LOG_INFO("scrub: chunk %s repaired from replica",
+                    info.digest_hex.c_str());
+    } else {
+      status = 5 /*EIO*/;
+      corrupt_unrepairable_.fetch_add(1, std::memory_order_relaxed);
+      FDFS_LOG_ERROR("scrub: chunk %s repair write failed: %s",
+                     info.digest_hex.c_str(), err.c_str());
+    }
+  } else {
+    attempted = true;
+    status = 2 /*ENOENT*/;
+    corrupt_unrepairable_.fetch_add(1, std::memory_order_relaxed);
+    FDFS_LOG_ERROR("scrub: chunk %s unrepairable — no replica serves it "
+                   "(stays quarantined; downloads of its files will fail "
+                   "rather than return bad bytes)",
+                   info.digest_hex.c_str());
+  }
+  if (attempted && trace_ != nullptr && pass_ctx_.valid()) {
+    TraceSpan s;
+    s.trace_id = pass_ctx_.trace_id;
+    s.span_id = trace_->NextSpanId();
+    s.parent_id = pass_ctx_.parent_span;
+    s.start_us = t0;
+    s.dur_us = TraceWallUs() - t0;
+    s.status = status;
+    s.flags = kTraceFlagSampled;
+    s.SetName("scrub.repair");
+    trace_->Record(s);
+  }
+}
+
+bool ScrubManager::FetchFromReplica(int spi, const std::string& digest_hex,
+                                    int64_t len, std::string* out) {
+  if (len <= 0 || peers_ == nullptr) return false;
+  char remote[16];
+  // FETCH_CHUNK routes by the "Mxx/" prefix of the remote name; the
+  // scrubber has no file name for a chunk, only its address, so a
+  // synthetic name carries the store-path index.
+  snprintf(remote, sizeof(remote), "M%02X/scrub", spi);
+  std::string body;
+  PutFixedField(&body, group_name_, kGroupNameMaxLen);
+  uint8_t num[8];
+  PutInt64BE(static_cast<int64_t>(strlen(remote)), num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  body += remote;
+  PutInt64BE(1, num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  if (!HexToBytes(digest_hex, &body)) return false;
+  PutInt64BE(len, num);
+  body.append(reinterpret_cast<char*>(num), 8);
+
+  for (const std::string& addr : peers_()) {
+    size_t colon = addr.rfind(':');
+    if (colon == std::string::npos) continue;
+    std::string err;
+    int fd = TcpConnect(addr.substr(0, colon),
+                        atoi(addr.c_str() + colon + 1), 3000, &err);
+    if (fd < 0) continue;
+    std::string resp;
+    uint8_t status = 0;
+    bool ok = NetRpc(fd, static_cast<uint8_t>(StorageCmd::kFetchChunk), body,
+                     &resp, &status, len + 1024, kRpcTimeoutMs);
+    close(fd);
+    if (!ok || status != 0 ||
+        static_cast<int64_t>(resp.size()) != len)
+      continue;
+    // Trust nothing off the wire: the replica may carry the same rot.
+    if (Sha1(resp.data(), resp.size()).Hex() != digest_hex) {
+      FDFS_LOG_WARN("scrub: replica %s served a mismatched payload for %s",
+                    addr.c_str(), digest_hex.c_str());
+      continue;
+    }
+    out->swap(resp);
+    return true;
+  }
+  return false;
+}
+
+}  // namespace fdfs

@@ -93,8 +93,8 @@ bool StorageServer::Init(std::string* error) {
     // Chunk-level dedup: one content-addressed store per store path;
     // refcounts rebuilt from recipes (doubles as orphan GC).
     for (int i = 0; i < store_.store_path_count(); ++i) {
-      chunk_stores_.push_back(
-          std::make_unique<ChunkStore>(store_.store_path(i)));
+      chunk_stores_.push_back(std::make_unique<ChunkStore>(
+          store_.store_path(i), cfg_.chunk_gc_grace_s));
       chunk_stores_.back()->RebuildFromRecipes();
     }
   }
@@ -338,6 +338,36 @@ bool StorageServer::Init(std::string* error) {
     if (needs_recovery) recovery_->Start();
   }
 
+  // Integrity engine: one background scrubber over every chunk store
+  // (verify -> quarantine -> replica repair -> zero-ref GC).  Created
+  // whenever chunk stores exist — with scrub_interval_s = 0 it only
+  // runs when SCRUB_KICK forces a pass, so operators and tests can
+  // drive deterministic passes on an otherwise-idle daemon.
+  if (!chunk_stores_.empty()) {
+    if (cfg_.dedup_mode == "sidecar")
+      scrub_dedup_ = MakeDedupPlugin(cfg_.dedup_mode, cfg_.base_path,
+                                     cfg_.dedup_sidecar);
+    ScrubOptions sopts;
+    sopts.interval_s = cfg_.scrub_interval_s;
+    sopts.bandwidth_bytes_s =
+        static_cast<int64_t>(cfg_.scrub_bandwidth_mb_s) << 20;
+    std::vector<ChunkStore*> stores;
+    for (auto& cs : chunk_stores_) stores.push_back(cs.get());
+    scrub_ = std::make_unique<ScrubManager>(
+        sopts, cfg_.group_name, std::move(stores),
+        [this]() {
+          // Replica addresses for FETCH_CHUNK repair: the sync peer
+          // list (every group member holds every chunk by design).
+          std::vector<std::string> out;
+          if (sync_ != nullptr)
+            for (const SyncPeerState& s : sync_->States())
+              out.push_back(s.addr);
+          return out;
+        },
+        scrub_dedup_.get(), trace_.get());
+    scrub_->Start();
+  }
+
   // Periodic maintenance (reference: sched_thread entries — binlog flush,
   // stat write, dedup snapshot).
   // Per-request access log (storage.conf:use_access_log).
@@ -408,6 +438,9 @@ void StorageServer::Stop() {
     access_log_ = nullptr;
   }
   binlog_.Flush();
+  // The scrubber may be mid-pass against the chunk stores; it checks
+  // its stop flag between batches, so this join is bounded.
+  if (scrub_ != nullptr) scrub_->Stop();
   if (recovery_ != nullptr) recovery_->Stop();
   if (sync_ != nullptr) sync_->Stop();  // persists .mark cursors
   if (reporter_ != nullptr) reporter_->Stop();
@@ -487,6 +520,8 @@ constexpr ServedOp kServedOps[] = {
     {StorageCmd::kFetchRecipe, "fetch_recipe"},
     {StorageCmd::kFetchChunk, "fetch_chunk"},
     {StorageCmd::kTraceDump, "trace_dump"},
+    {StorageCmd::kScrubStatus, "scrub_status"},
+    {StorageCmd::kScrubKick, "scrub_kick"},
     {StorageCmd::kFetchOnePathBinlog, "fetch_one_path_binlog"},
     {StorageCmd::kTrunkAllocSpace, "trunk_alloc_space"},
     {StorageCmd::kTrunkAllocConfirm, "trunk_alloc_confirm"},
@@ -572,6 +607,17 @@ void StorageServer::InitStatsRegistry() {
   registry_.GaugeFn("recovery.files_skipped", [this] {
     return recovery_ != nullptr ? recovery_->files_skipped() : int64_t{0};
   });
+  // Integrity engine: mirror the SCRUB_STATUS blob field-for-field so
+  // fdfs_monitor --prometheus exports scrub health without a second
+  // RPC.  Names follow the wire contract (kScrubStatNames) under the
+  // scrub. prefix; all zero when scrubbing is off (no chunk store).
+  for (int i = 0; i < kScrubStatCount; ++i) {
+    registry_.GaugeFn(std::string("scrub.") + kScrubStatNames[i],
+                      [this, i] {
+                        return scrub_ != nullptr ? scrub_->StatValue(i)
+                                                 : int64_t{0};
+                      });
+  }
 }
 
 int64_t StorageServer::MaxSyncLagS() const {
@@ -1274,6 +1320,42 @@ void StorageServer::OnHeaderComplete(Conn* c) {
         return;
       }
       Respond(c, 0, trace_->Json("storage", cfg_.port));
+      return;
+    case StorageCmd::kScrubStatus: {
+      // Integrity-engine status: empty body -> kScrubStatCount BE int64
+      // slots (kScrubStatNames).  Atomics + per-store gauge reads only,
+      // so serving it on the nio loop is fine.  ENOTSUP without a chunk
+      // store — there is nothing to scrub.
+      if (c->pkg_len != 0) {
+        CloseConn(c);
+        return;
+      }
+      if (scrub_ == nullptr) {
+        Respond(c, 95 /*ENOTSUP*/);
+        return;
+      }
+      int64_t vals[kScrubStatCount] = {0};
+      scrub_->FillStats(vals);
+      std::string body(kScrubStatCount * 8, '\0');
+      for (int i = 0; i < kScrubStatCount; ++i)
+        PutInt64BE(vals[i], reinterpret_cast<uint8_t*>(body.data()) + i * 8);
+      Respond(c, 0, body);
+      return;
+    }
+    case StorageCmd::kScrubKick:
+      // Force a verify+repair+GC pass (works even with periodic
+      // scrubbing off).  The kick only flips a flag under the scrub
+      // mutex — the pass itself runs on the scrub thread.
+      if (c->pkg_len != 0) {
+        CloseConn(c);
+        return;
+      }
+      if (scrub_ == nullptr) {
+        Respond(c, 95 /*ENOTSUP*/);
+        return;
+      }
+      scrub_->Kick();
+      Respond(c, 0);
       return;
     case StorageCmd::kTraceCtx:
       // Trace-context prefix frame: 16B body, NO response; the context
@@ -3124,16 +3206,33 @@ int StorageServer::OpenLogical(const std::string& local, int64_t* size) {
 
 int StorageServer::RemoveLogical(const std::string& local,
                                  const std::string& file_ref) {
-  if (unlink(local.c_str()) == 0) return 0;
-  if (errno != ENOENT) return 5;
+  // Delete the recipe sidecar WITH the file id and account its bytes to
+  // the integrity engine (scrub.bytes_reclaimed / recipes_reclaimed):
+  // the .rcp is real disk the delete reclaims, same as the chunks GC
+  // frees later.
+  auto drop_recipe = [this, &local, &file_ref](const std::string& rcp) {
+    struct stat st;
+    int64_t rcp_bytes = stat(rcp.c_str(), &st) == 0 ? st.st_size : 0;
+    auto r = ReadRecipeFile(rcp);
+    if (!r.has_value()) return 2;
+    if (unlink(rcp.c_str()) != 0 && errno != ENOENT) return 5;
+    ChunkStore* cs = StoreForLocal(local);
+    if (cs != nullptr) cs->UnrefAll(*r);
+    if (dedup_ != nullptr) dedup_->ForgetChunked(file_ref);
+    if (scrub_ != nullptr) scrub_->NoteRecipeReclaimed(rcp_bytes);
+    return 0;
+  };
   std::string rcp = local + ".rcp";
-  auto r = ReadRecipeFile(rcp);
-  if (!r.has_value()) return 2;
-  if (unlink(rcp.c_str()) != 0) return errno == ENOENT ? 2 : 5;
-  ChunkStore* cs = StoreForLocal(local);
-  if (cs != nullptr) cs->UnrefAll(*r);
-  if (dedup_ != nullptr) dedup_->ForgetChunked(file_ref);
-  return 0;
+  if (unlink(local.c_str()) == 0) {
+    // Flat inode gone; also clear any stale recipe sidecar left under
+    // the same name (belt-and-braces — the two should never coexist,
+    // but a leaked .rcp would hold chunk refs forever).
+    struct stat st;
+    if (stat(rcp.c_str(), &st) == 0) drop_recipe(rcp);
+    return 0;
+  }
+  if (errno != ENOENT) return 5;
+  return drop_recipe(rcp);
 }
 
 void StorageServer::HandleDownload(Conn* c) {

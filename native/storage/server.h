@@ -36,6 +36,7 @@
 #include "storage/config.h"
 #include "storage/dedup.h"
 #include "storage/recovery.h"
+#include "storage/scrub.h"
 #include "storage/store.h"
 #include "storage/sync.h"
 #include "storage/tracker_client.h"
@@ -396,6 +397,12 @@ class StorageServer {
   std::unique_ptr<DedupPlugin> recovery_dedup_;  // recovery-thread instance
   // One content-addressed chunk store per store path (chunk-level dedup).
   std::vector<std::unique_ptr<ChunkStore>> chunk_stores_;
+  // Integrity engine: background scrub/quarantine/repair/GC over the
+  // chunk stores (storage/scrub.h; SCRUB_STATUS / SCRUB_KICK opcodes).
+  // scrub_dedup_ is the scrub thread's own sidecar plugin instance for
+  // the batched DEDUP_VERIFY path (plugins are not thread-safe).
+  std::unique_ptr<DedupPlugin> scrub_dedup_;
+  std::unique_ptr<ScrubManager> scrub_;
   std::unique_ptr<TrackerReporter> reporter_;
   std::unique_ptr<SyncManager> sync_;
   std::unique_ptr<RecoveryManager> recovery_;

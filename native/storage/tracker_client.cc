@@ -29,21 +29,7 @@ void AppendInt64(std::string* out, int64_t v) {
 
 bool Rpc(int fd, uint8_t cmd, const std::string& body, std::string* resp,
          uint8_t* status, int timeout_ms) {
-  uint8_t hdr[kHeaderSize];
-  PutInt64BE(static_cast<int64_t>(body.size()), hdr);
-  hdr[8] = cmd;
-  hdr[9] = 0;
-  if (!SendAll(fd, hdr, sizeof(hdr), timeout_ms) ||
-      !SendAll(fd, body.data(), body.size(), timeout_ms) ||
-      !RecvAll(fd, hdr, sizeof(hdr), timeout_ms))
-    return false;
-  int64_t len = GetInt64BE(hdr);
-  *status = hdr[9];
-  if (len < 0 || len > (16 << 20)) return false;
-  resp->resize(static_cast<size_t>(len));
-  if (len > 0 && !RecvAll(fd, resp->data(), resp->size(), timeout_ms))
-    return false;
-  return true;
+  return NetRpc(fd, cmd, body, resp, status, 16 << 20, timeout_ms);
 }
 
 bool SplitAddr(const std::string& addr, std::string* host, int* port) {

@@ -8,7 +8,9 @@
 #include <cstring>
 #include <string>
 
+#include "common/bytes.h"
 #include "storage/binlog.h"
+#include "storage/chunkstore.h"
 #include "storage/dedup.h"
 #include "storage/store.h"
 #include "storage/trunk.h"
@@ -261,6 +263,213 @@ static void TestTrunkReserveAndCompaction() {
   CHECK(alloc2.VerifyFreeMap(&report) == 0);
 }
 
+// -- chunk-store integrity engine (scrub/GC/quarantine) --------------------
+
+static std::string Sha1HexOf(const std::string& data) {
+  return Sha1(data.data(), data.size()).Hex();
+}
+
+static std::string ChunkStoreDir() {
+  // ChunkStore expects the store path's data/ dir to exist (the daemon's
+  // StoreManager pre-creates it).
+  std::string dir = TempDir();
+  mkdir((dir + "/data").c_str(), 0755);
+  return dir;
+}
+
+static bool FileExists(const std::string& p) {
+  struct stat st;
+  return stat(p.c_str(), &st) == 0;
+}
+
+static void FlipFirstByte(const std::string& p) {
+  FILE* f = fopen(p.c_str(), "r+b");
+  CHECK(f != nullptr);
+  int c = fgetc(f);
+  fseek(f, 0, SEEK_SET);
+  fputc(c ^ 0xFF, f);
+  fclose(f);
+}
+
+static void TestChunkStoreGcGraceAndPins() {
+  std::string dir = ChunkStoreDir();
+  ChunkStore cs(dir, /*gc_grace_s=*/60);
+  std::string payload(4096, 'x');
+  std::string dig = Sha1HexOf(payload);
+  bool existed = false;
+  std::string err;
+  CHECK(cs.PutAndRef(dig, payload.data(), payload.size(), &existed, &err));
+  CHECK(!existed);
+
+  Recipe r;
+  r.logical_size = 4096;
+  r.chunks.push_back({dig, 4096});
+
+  // Grace mode: the last unref parks the chunk instead of unlinking.
+  cs.UnrefAll(r);
+  CHECK(FileExists(cs.ChunkPath(dig)));
+  CHECK(cs.gc_pending_chunks() == 1);
+  CHECK(cs.gc_pending_bytes() == 4096);
+
+  // Inside the grace window nothing is reclaimed.
+  int64_t bytes = 0;
+  CHECK(cs.GcSweep(time(nullptr), &bytes) == 0);
+  CHECK(bytes == 0);
+
+  // REGRESSION (ISSUE 4 satellite): a phase-1 upload session pins the
+  // chunk via PinAndMask — the pin probe runs under the SAME lock as
+  // the sweep's unlink, and a pinned zero-ref chunk must survive a
+  // sweep even past its grace.
+  std::string need = cs.PinAndMask(r);
+  CHECK(need.size() == 1);
+  CHECK(need[0] == 1);  // zero-ref reads as "needed" (client re-ships)
+  bytes = 0;
+  CHECK(cs.GcSweep(time(nullptr) + 3600, &bytes) == 0);
+  CHECK(FileExists(cs.ChunkPath(dig)));
+
+  // The session commits: PutAndRef resurrects the parked bytes without
+  // rewriting them.
+  CHECK(cs.PutAndRef(dig, payload.data(), payload.size(), &existed, &err));
+  CHECK(existed);
+  CHECK(cs.gc_pending_chunks() == 0);
+  cs.UnpinRecipe(r);
+  bytes = 0;
+  CHECK(cs.GcSweep(time(nullptr) + 3600, &bytes) == 0);  // live again
+
+  // Drop the ref for real: past the grace (and unpinned) the sweep
+  // reclaims bytes and count.
+  cs.UnrefAll(r);
+  bytes = 0;
+  CHECK(cs.GcSweep(time(nullptr) + 3600, &bytes) == 1);
+  CHECK(bytes == 4096);
+  CHECK(!FileExists(cs.ChunkPath(dig)));
+  CHECK(cs.gc_pending_chunks() == 0);
+}
+
+static void TestChunkStoreEagerModeUnchanged() {
+  // gc_grace_s == 0 keeps the original semantics: unlink on last unref.
+  std::string dir = ChunkStoreDir();
+  ChunkStore cs(dir, 0);
+  std::string payload(1024, 'y');
+  std::string dig = Sha1HexOf(payload);
+  bool existed = false;
+  std::string err;
+  CHECK(cs.PutAndRef(dig, payload.data(), payload.size(), &existed, &err));
+  Recipe r;
+  r.chunks.push_back({dig, 1024});
+  cs.UnrefAll(r);
+  CHECK(!FileExists(cs.ChunkPath(dig)));
+
+  // Pinned delete still defers to the last unpin (stream semantics).
+  CHECK(cs.PutAndRef(dig, payload.data(), payload.size(), &existed, &err));
+  cs.PinRecipe(r);
+  cs.UnrefAll(r);
+  CHECK(FileExists(cs.ChunkPath(dig)));
+  cs.UnpinRecipe(r);
+  CHECK(!FileExists(cs.ChunkPath(dig)));
+}
+
+static void TestChunkStoreQuarantineRepairHeal() {
+  std::string dir = ChunkStoreDir();
+  ChunkStore cs(dir, 0);
+  std::string payload(2048, 'q');
+  std::string dig = Sha1HexOf(payload);
+  bool existed = false;
+  std::string err;
+  CHECK(cs.PutAndRef(dig, payload.data(), payload.size(), &existed, &err));
+
+  // Pinned chunks are exempt from quarantine (repair-in-place under a
+  // live reader is unsafe).
+  Recipe r;
+  r.chunks.push_back({dig, 2048});
+  cs.PinRecipe(r);
+  CHECK(cs.Quarantine(dig) == ChunkStore::QuarantineResult::kPinned);
+  cs.UnpinRecipe(r);
+
+  // A clean chunk survives a false accusation: the under-lock re-hash
+  // overrules the caller (the lock-free verify read may have raced).
+  CHECK(cs.Quarantine(dig) == ChunkStore::QuarantineResult::kClean);
+  FlipFirstByte(cs.ChunkPath(dig));
+  CHECK(cs.Quarantine(dig) == ChunkStore::QuarantineResult::kQuarantined);
+  CHECK(!FileExists(cs.ChunkPath(dig)));
+  CHECK(FileExists(cs.QuarantinePath(dig)));
+  CHECK(cs.quarantined_chunks() == 1);
+  std::string back;
+  CHECK(!cs.ReadChunk(dig, 2048, &back));  // never served again
+  // Quarantined chunks read as missing so peers/clients re-ship bytes.
+  CHECK(cs.HaveMask({dig})[0] == 1);
+  // The live snapshot skips it; the quarantined snapshot names it.
+  CHECK(cs.SnapshotLive().empty());
+  CHECK(cs.SnapshotQuarantined().size() == 1);
+  CHECK(cs.SnapshotQuarantined()[0].length == 2048);
+
+  // Replica repair restores the bytes and clears the quarantine mark.
+  CHECK(cs.RepairChunk(dig, payload.data(), payload.size(), &err));
+  CHECK(cs.quarantined_chunks() == 0);
+  CHECK(!FileExists(cs.QuarantinePath(dig)));
+  CHECK(cs.ReadChunk(dig, 2048, &back));
+  CHECK(back == payload);
+
+  // Heal-on-upload: quarantine again, then a PutAndRef carrying the
+  // payload (dedup hit) restores the bytes as a side effect.
+  FlipFirstByte(cs.ChunkPath(dig));
+  CHECK(cs.Quarantine(dig) == ChunkStore::QuarantineResult::kQuarantined);
+  CHECK(cs.PutAndRef(dig, payload.data(), payload.size(), &existed, &err));
+  CHECK(existed);
+  CHECK(cs.quarantined_chunks() == 0);
+  CHECK(cs.ReadChunk(dig, 2048, &back));
+  CHECK(back == payload);
+
+  // A deleted chunk cannot be quarantined or repaired (kGone / false).
+  Recipe both;
+  both.chunks.push_back({dig, 2048});
+  both.chunks.push_back({dig, 2048});  // two refs taken above
+  cs.UnrefAll(both);
+  CHECK(cs.Quarantine(dig) == ChunkStore::QuarantineResult::kGone);
+  CHECK(!cs.RepairChunk(dig, payload.data(), payload.size(), &err));
+}
+
+static void TestChunkStoreRebuildParksOrphansAndKeepsQuarantine() {
+  std::string dir = ChunkStoreDir();
+  std::string payload(4096, 'r');
+  std::string dig = Sha1HexOf(payload);
+  {
+    ChunkStore cs(dir, 3600);
+    bool existed = false;
+    std::string err;
+    CHECK(cs.PutAndRef(dig, payload.data(), payload.size(), &existed, &err));
+    Recipe r;
+    r.logical_size = 4096;
+    r.chunks.push_back({dig, 4096});
+    CHECK(WriteRecipeFile(dir + "/data/f.rcp", r, &err));
+    // A second chunk never named by any recipe (an upload whose recipe
+    // write crashed, or a zero-ref chunk awaiting GC at shutdown).
+    std::string orphan(512, 'o');
+    std::string odig = Sha1HexOf(orphan);
+    CHECK(cs.PutAndRef(odig, orphan.data(), orphan.size(), &existed, &err));
+    // Quarantine the recipe's (corrupted) chunk, then "restart".
+    FlipFirstByte(cs.ChunkPath(dig));
+    CHECK(cs.Quarantine(dig) == ChunkStore::QuarantineResult::kQuarantined);
+  }
+  ChunkStore cs2(dir, 3600);
+  cs2.RebuildFromRecipes();
+  // The referenced chunk is still quarantined after restart (its bytes
+  // must not be re-admitted), and the orphan is parked for GC instead
+  // of dropped — the grace window is crash-safe.
+  CHECK(cs2.quarantined_chunks() == 1);
+  CHECK(cs2.unique_chunks() == 1);
+  CHECK(cs2.gc_pending_chunks() == 1);
+  CHECK(cs2.HaveMask({dig})[0] == 1);
+  std::string err;
+  CHECK(cs2.RepairChunk(dig, payload.data(), payload.size(), &err));
+  std::string back;
+  CHECK(cs2.ReadChunk(dig, 4096, &back));
+  CHECK(back == payload);
+  int64_t bytes = 0;
+  CHECK(cs2.GcSweep(time(nullptr) + 7200, &bytes) == 1);
+  CHECK(bytes == 512);
+}
+
 int main() {
   TestBinlogRecordCodec();
   TestBinlogWriteReadResume();
@@ -270,6 +479,10 @@ int main() {
   TestTrunkAllocator();
   TestTrunkReserveAndCompaction();
   TestTrunkReplicaWrite();
+  TestChunkStoreGcGraceAndPins();
+  TestChunkStoreEagerModeUnchanged();
+  TestChunkStoreQuarantineRepairHeal();
+  TestChunkStoreRebuildParksOrphansAndKeepsQuarantine();
   if (g_failures == 0) {
     std::printf("storage_test: ALL PASS\n");
     return 0;

@@ -22,6 +22,9 @@
 //                fastdfs_tpu.trace.decode_dump)
 //   fdfs_codec trace-ctx <hex32>  (parse a 16-byte TRACE_CTX body and
 //                print trace_id/parent/flags — wire-layout golden)
+//   fdfs_codec scrub-status    (golden SCRUB_STATUS blob: fixture value
+//                per kScrubStatNames slot + the hex wire encoding,
+//                compared field-for-field against the Python decoder)
 #include <time.h>
 
 #include <cstdio>
@@ -34,6 +37,7 @@
 #include "common/cdc.h"
 #include "common/fileid.h"
 #include "common/http_token.h"
+#include "common/protocol_gen.h"
 #include "common/stats.h"
 #include "common/trace.h"
 
@@ -302,6 +306,30 @@ int main(int argc, char** argv) {
     PutInt64BE(static_cast<int64_t>(lens[0] + lens[2]), num);
     pre.append(reinterpret_cast<char*>(num), 8);
     printf("chunks_prefix=%s\n", hex(pre).c_str());
+    return 0;
+  }
+  if (cmd == "scrub-status") {
+    // Cross-language golden for the SCRUB_STATUS wire layout: a fixed
+    // fixture value per slot, emitted in kScrubStatNames order both as
+    // name=value lines and as the hex-encoded wire blob.
+    // tests/test_scrub.py decodes the blob with
+    // fastdfs_tpu.common.protocol.unpack_scrub_stats and asserts every
+    // named field — pinning slot order AND count across languages.
+    std::string blob;
+    for (int i = 0; i < kScrubStatCount; ++i) {
+      int64_t v = 1000 + 13 * i;
+      uint8_t num[8];
+      PutInt64BE(v, num);
+      blob.append(reinterpret_cast<char*>(num), 8);
+      printf("%s=%lld\n", kScrubStatNames[i], static_cast<long long>(v));
+    }
+    static const char* kHex = "0123456789abcdef";
+    std::string hex;
+    for (unsigned char ch : blob) {
+      hex.push_back(kHex[ch >> 4]);
+      hex.push_back(kHex[ch & 0xF]);
+    }
+    printf("blob=%s\n", hex.c_str());
     return 0;
   }
   if (cmd == "b64e" && argc == 3) {

@@ -61,21 +61,7 @@ struct OpRecord {
 // failure; *status carries the server's header status byte.
 bool Rpc(int fd, uint8_t cmd, const std::string& body, std::string* resp,
          uint8_t* status) {
-  uint8_t hdr[kHeaderSize];
-  PutInt64BE(static_cast<int64_t>(body.size()), hdr);
-  hdr[8] = cmd;
-  hdr[9] = 0;
-  if (!SendAll(fd, hdr, sizeof(hdr), kTimeoutMs)) return false;
-  if (!body.empty() && !SendAll(fd, body.data(), body.size(), kTimeoutMs))
-    return false;
-  if (!RecvAll(fd, hdr, sizeof(hdr), kTimeoutMs)) return false;
-  int64_t len = GetInt64BE(hdr);
-  *status = hdr[9];
-  if (len < 0 || len > (1LL << 31)) return false;
-  resp->resize(static_cast<size_t>(len));
-  if (len > 0 && !RecvAll(fd, resp->data(), resp->size(), kTimeoutMs))
-    return false;
-  return true;
+  return NetRpc(fd, cmd, body, resp, status, 1LL << 31, kTimeoutMs);
 }
 
 std::string PackGroup(const std::string& group) {

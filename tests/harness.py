@@ -177,6 +177,37 @@ def start_tracker(tmp_path, port: int | None = None, **kw) -> Daemon:
     return Daemon(TRACKERD, conf, port)
 
 
+def chunk_files(base_dir: str) -> list[str]:
+    """Every content-addressed chunk payload file under a storage's base
+    dir (``<base>/data/chunks/<d0d1>/<d2d3>/<40-hex>``)."""
+    import glob
+    return sorted(
+        f for f in glob.glob(os.path.join(str(base_dir), "data", "chunks",
+                                          "*", "*", "*"))
+        if os.path.isfile(f) and len(os.path.basename(f)) == 40)
+
+
+def corrupt_chunk(base_dir: str, digest: str | None = None) -> tuple[str, str]:
+    """Flip one byte inside a stored chunk file — the bit-rot injection
+    for scrub tests.  Picks the first chunk on disk (or the named
+    ``digest``); returns ``(digest, path)``.  The file's length is
+    preserved so only the content hash betrays the damage."""
+    if digest is not None:
+        path = os.path.join(str(base_dir), "data", "chunks", digest[:2],
+                            digest[2:4], digest)
+        files = [path] if os.path.isfile(path) else []
+    else:
+        files = chunk_files(base_dir)
+    if not files:
+        raise FileNotFoundError(f"no chunk files under {base_dir}")
+    path = files[0]
+    with open(path, "r+b") as fh:
+        first = fh.read(1)
+        fh.seek(0)
+        fh.write(bytes([first[0] ^ 0xFF]))
+    return os.path.basename(path), path
+
+
 def upload_retry(cli, data, timeout=20.0, **kw):
     """Upload with retries while a fresh daemon joins/activates (the
     tracker refuses query_store until the storage reports in)."""

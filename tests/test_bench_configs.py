@@ -60,6 +60,24 @@ def test_config6_wire_dedup_smoke(tmp_path):
     assert art["ingest_counters"]["ingest.bytes_saved_wire"] > 0
 
 
+def test_config7_scrub_overhead_smoke(tmp_path):
+    # The integrity-engine overhead scenario end-to-end at tiny scale:
+    # all three bandwidth modes produce latency percentiles, the
+    # unpaced scrubber actually verified chunks while foreground ops
+    # ran, and nothing was falsely flagged corrupt.
+    bc.config7(str(tmp_path), scale=0.0002)  # ~2 MB preload
+    with open(os.path.join(str(tmp_path), "config7.json")) as fh:
+        art = json.load(fh)
+    assert set(art["modes"]) == {"off", "bw16", "unlimited"}
+    for mode in art["modes"].values():
+        assert mode["ops"] >= 10
+        assert mode["upload_p50_ms"] > 0
+        assert mode["download_p99_ms"] >= mode["download_p50_ms"]
+    assert art["modes"]["off"]["chunks_verified"] == 0
+    assert art["scrub_verified_ok"] is True
+    assert art["no_false_corruption"] is True
+
+
 def test_config4_referee_smoke(tmp_path):
     bc.config4(str(tmp_path), scale=0.00002)  # ~2 MB of HTML docs
     with open(os.path.join(str(tmp_path), "config4.json")) as fh:

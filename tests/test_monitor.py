@@ -73,7 +73,14 @@ def _sample_registry() -> dict:
                    # tracing health (PR 2): ring throughput/overwrite
                    # pressure and the slow-request gate
                    "trace.spans_recorded": 12, "trace.spans_dropped": 3,
-                   "trace.slow_requests": 1},
+                   "trace.slow_requests": 1,
+                   # integrity engine (PR 4): scrub/quarantine/GC health
+                   "scrub.chunks_verified": 500, "scrub.chunks_corrupt": 2,
+                   "scrub.chunks_repaired": 1,
+                   "scrub.corrupt_unrepairable": 1,
+                   "scrub.quarantined": 1, "scrub.gc_pending_bytes": 8192,
+                   "scrub.chunks_reclaimed": 9,
+                   "scrub.bytes_reclaimed": 73728},
         "histograms": {
             "op.upload_file.latency_us": {
                 "bounds": [100, 1000, 10000],
@@ -187,6 +194,15 @@ def test_prometheus_exposition_parses():
     assert series["fdfs_ingest_bytes_saved_wire"][0][1] == 262144.0
     assert series["fdfs_ingest_recipe_fallbacks"][0][1] == 2.0
     assert series["fdfs_ingest_sessions_active"][0][1] == 1.0
+    # Integrity-engine golden (PR 4): scrub health exports per-storage so
+    # dashboards can alert on corruption and chart reclaimed bytes.
+    assert series["fdfs_scrub_chunks_verified"][0] == (
+        '{storage="127.0.0.1:23000"}', 500.0)
+    assert series["fdfs_scrub_chunks_corrupt"][0][1] == 2.0
+    assert series["fdfs_scrub_chunks_repaired"][0][1] == 1.0
+    assert series["fdfs_scrub_corrupt_unrepairable"][0][1] == 1.0
+    assert series["fdfs_scrub_quarantined"][0][1] == 1.0
+    assert series["fdfs_scrub_bytes_reclaimed"][0][1] == 73728.0
     buckets = series["fdfs_op_upload_file_latency_us_bucket"]
     values = [v for _, v in buckets]
     assert values == sorted(values), "histogram buckets must be cumulative"
@@ -314,6 +330,11 @@ def test_stat_opcodes_and_monitor_cli(tmp_path):
         # tracing health gauges are pre-registered (0 with no traces)
         assert reg["gauges"]["trace.spans_recorded"] >= 0
         assert reg["gauges"]["trace.slow_requests"] >= 0
+        # integrity-engine gauges are pre-registered (PR 4: scrub.*
+        # mirrors the SCRUB_STATUS blob field-for-field)
+        for fname in ("passes", "chunks_verified", "chunks_corrupt",
+                      "bytes_reclaimed", "corrupt_unrepairable"):
+            assert reg["gauges"][f"scrub.{fname}"] >= 0
 
         # -- tracker-side cluster stat: capacity, liveness, beat payload
         with TrackerClient("127.0.0.1", tracker.port) as tc:

@@ -18,7 +18,7 @@ else
     tests/test_replication.py tests/test_trunk.py
     tests/test_chunked_storage.py tests/test_disk_recovery.py
     tests/test_multi_tracker.py tests/test_trace.py
-    tests/test_dedup_upload.py)
+    tests/test_dedup_upload.py tests/test_scrub.py)
 fi
 
 run_one() {
@@ -38,6 +38,10 @@ run_one() {
   # test_dedup_upload.py's concurrent-uploads-and-deletes test is the
   # negotiated-upload session target: pin/ref races and the
   # abort-timeout sweep run under TSan here.
+  # test_scrub.py's test_scrub_races_uploads_and_deletes is the
+  # integrity-engine target: scrub verify/quarantine/GC passes racing
+  # live uploads + eager deletes (the scrub thread vs dio workers on
+  # the chunk-store lock, and the pin-vs-GcSweep probe).
   if [ "$san" = tsan ]; then
     export TSAN_OPTIONS="halt_on_error=1"
   else
