@@ -57,15 +57,26 @@ def cmd_upload(c: FdfsClient, args: list[str]) -> int:
 
 
 def cmd_download(c: FdfsClient, args: list[str]) -> int:
+    usage = ("usage: download <tracker> [--parallel N] <file_id> "
+             "[local_path]")
+    parallel = 1
+    if args and args[0] == "--parallel":
+        if len(args) < 2 or not args[1].isdigit():
+            print(usage, file=sys.stderr)
+            return 2
+        parallel = int(args[1])
+        args = args[2:]
     if not args:
-        print("usage: download <tracker> <file_id> [local_path]", file=sys.stderr)
+        print(usage, file=sys.stderr)
         return 2
     fid = args[0]
     out = args[1] if len(args) > 1 else os.path.basename(fid)
-    data = c.download_to_buffer(fid)
-    with open(out, "wb") as fh:
-        fh.write(data)
-    print(f"{out}: {len(data)} bytes")
+    # Single-stream downloads go through download_stream (O(segment)
+    # client memory); --parallel N splits into jump-hash-routed ranges
+    # fetched concurrently across the group's replicas.
+    n = c.download_to_file(fid, out, parallel=parallel)
+    print(f"{out}: {n} bytes" + (f" (parallel={parallel})"
+                                 if parallel > 1 else ""))
     return 0
 
 

@@ -7,6 +7,8 @@ tracker for a storage target and talks to it directly.
 
 from __future__ import annotations
 
+import concurrent.futures
+import os
 import random
 from collections import OrderedDict
 
@@ -14,6 +16,7 @@ from fastdfs_tpu.client.conn import ConnectionPool, ProtocolError, StatusError
 from fastdfs_tpu.client.storage_client import RemoteFileInfo, StorageClient
 from fastdfs_tpu.client.tracker_client import FetchTarget, TrackerClient
 from fastdfs_tpu.common.ini_config import IniConfig
+from fastdfs_tpu.common.jumphash import replica_for_range
 
 
 class FdfsClient:
@@ -24,7 +27,9 @@ class FdfsClient:
                  use_pool: bool = True, dedup_uploads: bool = False,
                  dedup_min_bytes: int = 64 * 1024,
                  dedup_min_ratio: float = 0.05,
-                 dedup_digest_cache: int = 1 << 16):
+                 dedup_digest_cache: int = 1 << 16,
+                 parallel_downloads: int = 1,
+                 download_range_bytes: int = 4 << 20):
         if isinstance(tracker_addrs, str):
             tracker_addrs = [tracker_addrs]
         if not tracker_addrs:
@@ -53,6 +58,14 @@ class FdfsClient:
         self.dedup_min_ratio = dedup_min_ratio
         self._dedup_digest_cache = dedup_digest_cache
         self._seen_digests: OrderedDict[bytes, None] = OrderedDict()
+        # Parallel ranged downloads (opt-in): with parallel_downloads > 1
+        # every read over ~one range splits into download_range_bytes
+        # ranges fetched concurrently, each from the replica jump-hash
+        # picks for (file id, range index) — consistent across clients,
+        # so per-replica read caches accumulate hits.  Falls back to the
+        # classic single-stream download transparently on any failure.
+        self.parallel_downloads = max(int(parallel_downloads), 1)
+        self.download_range_bytes = max(int(download_range_bytes), 64 * 1024)
 
     @classmethod
     def from_conf(cls, conf_path: str) -> "FdfsClient":
@@ -63,7 +76,10 @@ class FdfsClient:
                    dedup_uploads=bool(cfg.get_bool("dedup_uploads", False)),
                    dedup_min_bytes=int(cfg.get_bytes("dedup_min_bytes",
                                                      64 * 1024)),
-                   dedup_min_ratio=float(cfg.get("dedup_min_ratio", 0.05)))
+                   dedup_min_ratio=float(cfg.get("dedup_min_ratio", 0.05)),
+                   parallel_downloads=int(cfg.get("parallel_downloads", 1)),
+                   download_range_bytes=int(
+                       cfg.get_bytes("download_range_bytes", 4 << 20)))
 
     def close(self) -> None:
         if self.pool is not None:
@@ -205,9 +221,115 @@ class FdfsClient:
 
     def download_to_buffer(self, file_id: str, offset: int = 0,
                            length: int = 0) -> bytes:
+        if self.parallel_downloads > 1:
+            return self.download_ranged(file_id, offset, length)
+        return self._download_single(file_id, offset, length)
+
+    def _download_single(self, file_id: str, offset: int = 0,
+                         length: int = 0) -> bytes:
+        # The classic one-connection path; also the ranged download's
+        # transparent fallback target (it must never re-enter the
+        # parallel gate, or a fallback recurses).
         tgt = self._with_tracker(lambda t: t.query_fetch(file_id))
         with self._storage(tgt) as s:
             return s.download_to_buffer(file_id, offset, length)
+
+    def download_stream(self, file_id: str, fh, offset: int = 0,
+                        length: int = 0) -> int:
+        """Stream (part of) a file into ``fh`` with O(segment) client
+        memory (StorageClient.download_stream underneath).  Returns the
+        byte count written."""
+        tgt = self._with_tracker(lambda t: t.query_fetch(file_id))
+        with self._storage(tgt) as s:
+            return s.download_stream(file_id, fh, offset, length)
+
+    def download_to_file(self, file_id: str, local_path: str,
+                         offset: int = 0, length: int = 0,
+                         parallel: int | None = None) -> int:
+        parallel = self.parallel_downloads if parallel is None else parallel
+        if parallel > 1:
+            # Ranged bytes land in memory first; the write-out still
+            # goes via temp + rename so a failed local write (ENOSPC,
+            # kill) can never truncate an existing file or leave a
+            # silently-partial one.
+            data = self.download_ranged(file_id, offset, length,
+                                        parallel=parallel)
+            tmp = f"{local_path}.part{os.getpid()}"
+            try:
+                with open(tmp, "wb") as fh:
+                    fh.write(data)
+                os.replace(tmp, local_path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            return len(data)
+        # Single stream: StorageClient owns the temp-file + rename
+        # discipline (one implementation of the no-partial-file rule).
+        tgt = self._with_tracker(lambda t: t.query_fetch(file_id))
+        with self._storage(tgt) as s:
+            return s.download_to_file(file_id, local_path, offset, length)
+
+    def download_ranged(self, file_id: str, offset: int = 0,
+                        length: int = 0, parallel: int | None = None,
+                        range_bytes: int | None = None) -> bytes:
+        """Parallel ranged download: split [offset, offset+length) into
+        download_range_bytes ranges and fetch them concurrently across
+        the group's read-safe replicas (tracker QUERY_FETCH_ALL), each
+        range from the replica ``jump_hash(file id, range index)`` picks
+        — the stateless consistent choice every client agrees on, so
+        per-replica hot-chunk caches accumulate hits (cache affinity).
+        Each worker lands its range directly in its slice of the shared
+        output buffer (DOWNLOAD_FILE's offset+count head fields carry
+        the range; every daemon generation serves them).  ANY failure —
+        an unreachable replica, a short/oversized body, a tracker too
+        old to list replicas — falls back transparently to the classic
+        single-stream download."""
+        parallel = self.parallel_downloads if parallel is None else parallel
+        range_bytes = (self.download_range_bytes if range_bytes is None
+                       else range_bytes)
+        if parallel <= 1:
+            return self._download_single(file_id, offset, length)
+        try:
+            replicas = self._with_tracker(
+                lambda t: t.query_fetch_all(file_id))
+            if not replicas:
+                raise ProtocolError("tracker listed no read replicas")
+            with self._storage(replicas[replica_for_range(
+                    file_id, 0, len(replicas))]) as s:
+                size = s.query_file_info(file_id).file_size
+            total = max(size - offset, 0)
+            if length:
+                total = min(total, length)
+            if total <= range_bytes:  # one range: no split to win from
+                return self._download_single(file_id, offset, length)
+            ranges = []
+            off = offset
+            while off < offset + total:
+                ln = min(range_bytes, offset + total - off)
+                ranges.append((len(ranges), off, ln))
+                off += ln
+            buf = bytearray(total)
+            mv = memoryview(buf)
+
+            def fetch(idx: int, off: int, ln: int) -> None:
+                tgt = replicas[replica_for_range(file_id, idx,
+                                                 len(replicas))]
+                with self._storage(tgt) as s:
+                    s.download_into(file_id,
+                                    mv[off - offset:off - offset + ln],
+                                    offset=off)
+
+            with concurrent.futures.ThreadPoolExecutor(
+                    min(parallel, len(ranges))) as ex:
+                futs = [ex.submit(fetch, *r) for r in ranges]
+                for f in futs:
+                    f.result()  # re-raise the first failure
+            return bytes(buf)
+        except Exception:  # noqa: BLE001 — transparent whole-file fallback
+            return self._download_single(file_id, offset, length)
 
     def delete_file(self, file_id: str) -> None:
         tgt = self._with_tracker(lambda t: t.query_update(file_id))

@@ -320,21 +320,54 @@ class StorageClient:
 
     # -- downloads ---------------------------------------------------------
 
-    def download_to_buffer(self, file_id: str, offset: int = 0,
-                           length: int = 0) -> bytes:
-        """Download (part of) a file.  length 0 = to EOF."""
+    def _send_download(self, file_id: str, offset: int, length: int) -> None:
         group, remote = _split_id(file_id)
         body = (long2buff(offset) + long2buff(length)
                 + pack_group_name(group) + remote.encode())
         self.conn.send_request(StorageCmd.DOWNLOAD_FILE, body)
+
+    def download_to_buffer(self, file_id: str, offset: int = 0,
+                           length: int = 0) -> bytes:
+        """Download (part of) a file.  length 0 = to EOF."""
+        self._send_download(file_id, offset, length)
         return self.conn.recv_response("download")
+
+    def download_stream(self, file_id: str, fh, offset: int = 0,
+                        length: int = 0,
+                        segment: int = UPLOAD_SEGMENT_BYTES) -> int:
+        """Download (part of) a file into file object ``fh`` in bounded
+        recv_into segments — O(segment) client memory however large the
+        file (the download-side mirror of ``upload_stream``).  Returns
+        the byte count written."""
+        self._send_download(file_id, offset, length)
+        return self.conn.recv_response_stream(fh, "download", segment)
+
+    def download_into(self, file_id: str, mv, offset: int = 0) -> None:
+        """Download EXACTLY len(mv) bytes at ``offset`` into a writable
+        buffer (memoryview/bytearray) — the zero-copy worker primitive of
+        the parallel ranged download (each worker lands its range
+        directly in its slice of the shared output buffer)."""
+        mv = memoryview(mv)
+        self._send_download(file_id, offset, len(mv))
+        self.conn.recv_response_into(mv, "download")
 
     def download_to_file(self, file_id: str, local_path: str,
                          offset: int = 0, length: int = 0) -> int:
-        data = self.download_to_buffer(file_id, offset, length)
-        with open(local_path, "wb") as fh:
-            fh.write(data)
-        return len(data)
+        # Stream into a temp file and rename on success: a failed or
+        # interrupted download must not truncate an existing local file
+        # or leave a silently-partial one.
+        tmp = f"{local_path}.part{os.getpid()}"
+        try:
+            with open(tmp, "wb") as fh:
+                n = self.download_stream(file_id, fh, offset, length)
+            os.replace(tmp, local_path)
+            return n
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     # -- delete / info -----------------------------------------------------
 

@@ -108,9 +108,22 @@ std::optional<Recipe> ReadRecipeFile(const std::string& path) {
 
 // -- store ----------------------------------------------------------------
 
-ChunkStore::ChunkStore(std::string store_path, int64_t gc_grace_s)
+ChunkStore::ChunkStore(std::string store_path, int64_t gc_grace_s,
+                       int64_t read_cache_bytes)
     : store_path_(std::move(store_path)),
-      gc_grace_s_(gc_grace_s < 0 ? 0 : gc_grace_s) {}
+      gc_grace_s_(gc_grace_s < 0 ? 0 : gc_grace_s) {
+  cache_.cap_bytes = read_cache_bytes < 0 ? 0 : read_cache_bytes;
+}
+
+int ChunkStore::StripeIndex(const std::string& digest_hex) {
+  // First hex nibble of the digest: SHA1 is uniform, so the 16 stripes
+  // load-balance by construction.  Non-hex input (never produced by the
+  // callers) still lands in a valid stripe.
+  char c = digest_hex.empty() ? '0' : digest_hex[0];
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  return 0;
+}
 
 std::string ChunkStore::ChunkPath(const std::string& digest_hex) const {
   return store_path_ + "/data/chunks/" + digest_hex.substr(0, 2) + "/" +
@@ -159,7 +172,8 @@ bool WriteChunkFile(const std::string& path, const char* data, size_t len,
 bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
                            size_t len, bool* existed, std::string* err) {
   std::string path = ChunkPath(digest_hex);
-  std::lock_guard<std::mutex> lk(mu_);
+  Stripe& st = StripeFor(digest_hex);
+  std::lock_guard<std::mutex> lk(st.mu);
   // Heal-on-upload: these bytes hash to the digest (every caller
   // verifies before PutAndRef), so a quarantined chunk gets its good
   // payload restored by ANY upload/replication that carries it.
@@ -167,11 +181,12 @@ bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
   // (downloads keep failing loudly) but never fails the upload, which
   // historically never wrote in the already-present case.
   auto heal = [&]() {
-    if (!quarantined_.count(digest_hex)) return;
+    if (!st.quarantined.count(digest_hex)) return;
     std::string werr;
     if (WriteChunkFile(path, data, len, &werr)) {
-      quarantined_.erase(digest_hex);
+      st.quarantined.erase(digest_hex);
       unlink(QuarantinePath(digest_hex).c_str());
+      CacheInvalidate(digest_hex);
       FDFS_LOG_INFO("chunk %s healed by incoming payload",
                     digest_hex.c_str());
     } else {
@@ -179,23 +194,23 @@ bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
                     digest_hex.c_str(), werr.c_str());
     }
   };
-  auto it = refs_.find(digest_hex);
-  if (it != refs_.end()) {
+  auto it = st.refs.find(digest_hex);
+  if (it != st.refs.end()) {
     heal();
     it->second++;
     *existed = true;
     return true;
   }
-  auto z = zero_ref_.find(digest_hex);
-  if (z != zero_ref_.end()) {
+  auto z = st.zero_ref.find(digest_hex);
+  if (z != st.zero_ref.end()) {
     // Zero-ref but still on disk (GC grace window, or a pinned stream
     // deferring the unlink): resurrect instead of rewriting.
     heal();
-    refs_[digest_hex] = 1;
-    lens_[digest_hex] = z->second.length;
+    st.refs[digest_hex] = 1;
+    st.lens[digest_hex] = z->second.length;
     unique_bytes_ += z->second.length;
     zero_ref_bytes_ -= z->second.length;
-    zero_ref_.erase(z);
+    st.zero_ref.erase(z);
     *existed = true;
     return true;
   }
@@ -207,133 +222,218 @@ bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
   mkdir(dir2.c_str(), 0755);
   mkdir(dir3.c_str(), 0755);
   if (!WriteChunkFile(path, data, len, err)) return false;
-  refs_[digest_hex] = 1;
-  lens_[digest_hex] = static_cast<int64_t>(len);
+  st.refs[digest_hex] = 1;
+  st.lens[digest_hex] = static_cast<int64_t>(len);
   unique_bytes_ += static_cast<int64_t>(len);
   *existed = false;
   return true;
 }
 
 bool ChunkStore::RefAll(const Recipe& r) {
-  std::lock_guard<std::mutex> lk(mu_);
+  // All-or-nothing across digests: lock every involved stripe together,
+  // in ascending index order (the ordered multi-stripe protocol), so no
+  // UnrefAll can interleave between the presence check and the refs.
+  bool involved[kStripes] = {};
+  for (const RecipeEntry& e : r.chunks) involved[StripeIndex(e.digest_hex)] = true;
+  std::array<std::unique_lock<std::mutex>, kStripes> locks;
+  for (int i = 0; i < kStripes; ++i)
+    if (involved[i]) locks[i] = std::unique_lock<std::mutex>(stripes_[i].mu);
   for (const RecipeEntry& e : r.chunks)
-    if (refs_.find(e.digest_hex) == refs_.end()) return false;
-  for (const RecipeEntry& e : r.chunks) refs_[e.digest_hex]++;
+    if (StripeFor(e.digest_hex).refs.find(e.digest_hex) ==
+        StripeFor(e.digest_hex).refs.end())
+      return false;
+  for (const RecipeEntry& e : r.chunks)
+    StripeFor(e.digest_hex).refs[e.digest_hex]++;
   return true;
 }
 
 bool ChunkStore::Has(const std::string& digest_hex) const {
-  std::lock_guard<std::mutex> lk(mu_);
-  return refs_.find(digest_hex) != refs_.end();
+  const Stripe& st = StripeFor(digest_hex);
+  std::lock_guard<std::mutex> lk(st.mu);
+  return st.refs.find(digest_hex) != st.refs.end();
 }
 
 std::string ChunkStore::HaveMask(
     const std::vector<std::string>& digests) const {
+  // One lock acquisition per stripe (not per digest): group the batch
+  // by stripe, then answer each stripe's subset under its lock.
   std::string need(digests.size(), '\0');
-  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<uint32_t> by_stripe[kStripes];
   for (size_t i = 0; i < digests.size(); ++i)
-    need[i] = refs_.find(digests[i]) != refs_.end() &&
-                      !quarantined_.count(digests[i])
-                  ? 0 : 1;
+    by_stripe[StripeIndex(digests[i])].push_back(static_cast<uint32_t>(i));
+  for (int s = 0; s < kStripes; ++s) {
+    if (by_stripe[s].empty()) continue;
+    const Stripe& st = stripes_[s];
+    std::lock_guard<std::mutex> lk(st.mu);
+    for (uint32_t i : by_stripe[s])
+      need[i] = st.refs.find(digests[i]) != st.refs.end() &&
+                        !st.quarantined.count(digests[i])
+                    ? 0 : 1;
+  }
   return need;
 }
 
 bool ChunkStore::RefOne(const std::string& digest_hex) {
-  std::lock_guard<std::mutex> lk(mu_);
-  auto it = refs_.find(digest_hex);
-  if (it == refs_.end()) return false;
+  Stripe& st = StripeFor(digest_hex);
+  std::lock_guard<std::mutex> lk(st.mu);
+  auto it = st.refs.find(digest_hex);
+  if (it == st.refs.end()) return false;
   it->second++;
   return true;
 }
 
-void ChunkStore::RetireLocked(const std::string& digest_hex,
+void ChunkStore::RetireLocked(Stripe& s, const std::string& digest_hex,
                               int64_t length) {
-  // mu_ held; refs_ entry already erased.  Eager mode (no GC grace)
-  // keeps the original semantics: unlink now unless an in-flight stream
-  // pins the chunk, in which case the zero_ref_ entry defers the unlink
-  // to the last UnpinRecipe.  With a grace window every zero-ref chunk
-  // parks for the scrubber's GcSweep.
+  // stripe mu held; refs entry already erased.  Eager mode (no GC
+  // grace) keeps the original semantics: unlink now unless an in-flight
+  // stream pins the chunk, in which case the zero_ref entry defers the
+  // unlink to the last UnpinRecipe.  With a grace window every zero-ref
+  // chunk parks for the scrubber's GcSweep.
   unique_bytes_ -= length;
-  if (gc_grace_s_ == 0 && !pins_.count(digest_hex)) {
-    UnlinkRetiredLocked(digest_hex);
+  if (gc_grace_s_ == 0 && !s.pins.count(digest_hex)) {
+    UnlinkRetiredLocked(s, digest_hex);
     return;
   }
-  zero_ref_[digest_hex] = ZeroRef{length, time(nullptr)};
+  s.zero_ref[digest_hex] = ZeroRef{length, time(nullptr)};
   zero_ref_bytes_ += length;
 }
 
-void ChunkStore::UnlinkRetiredLocked(const std::string& digest_hex) {
+void ChunkStore::UnlinkRetiredLocked(Stripe& s,
+                                     const std::string& digest_hex) {
   unlink(ChunkPath(digest_hex).c_str());
   unlink(QuarantinePath(digest_hex).c_str());
-  quarantined_.erase(digest_hex);
-  lens_.erase(digest_hex);
+  s.quarantined.erase(digest_hex);
+  s.lens.erase(digest_hex);
+  // Strict cache coherence: a swept chunk must never be served from the
+  // read cache (a later re-upload of the same digest re-admits it).
+  CacheInvalidate(digest_hex);
 }
 
 void ChunkStore::UnrefAll(const Recipe& r) {
-  std::lock_guard<std::mutex> lk(mu_);
   for (const RecipeEntry& e : r.chunks) {
-    auto it = refs_.find(e.digest_hex);
-    if (it == refs_.end()) continue;
+    Stripe& st = StripeFor(e.digest_hex);
+    std::lock_guard<std::mutex> lk(st.mu);
+    auto it = st.refs.find(e.digest_hex);
+    if (it == st.refs.end()) continue;
     if (--it->second <= 0) {
-      refs_.erase(it);
-      RetireLocked(e.digest_hex, e.length);
+      st.refs.erase(it);
+      RetireLocked(st, e.digest_hex, e.length);
     }
   }
 }
 
 std::optional<Recipe> ChunkStore::ReadRecipeAndPin(const std::string& path) {
-  // The file read stays OUTSIDE mu_ (a cold read is milliseconds, and
-  // mu_ serializes every upload RefAll / delete UnrefAll across all dio
-  // threads); recipe files are immutable once renamed into place, so
-  // the verify-refs_-then-pin under the lock is what closes the race
-  // with a concurrent delete.
+  // The file read needs no lock (recipe files are immutable once
+  // renamed into place); the verify-refs-then-pin per chunk under its
+  // stripe lock is what closes the race with a concurrent delete.  If
+  // any chunk already lost its references (the file is mid-delete) the
+  // pins taken so far roll back and the download fails with ENOENT
+  // before the first byte — never mid-stream.
   auto r = ReadRecipeFile(path);
   if (!r.has_value()) return std::nullopt;
-  std::lock_guard<std::mutex> lk(mu_);
-  for (const RecipeEntry& e : r->chunks)
-    if (refs_.find(e.digest_hex) == refs_.end()) return std::nullopt;
-  for (const RecipeEntry& e : r->chunks) pins_[e.digest_hex]++;
+  for (size_t i = 0; i < r->chunks.size(); ++i) {
+    Stripe& st = StripeFor(r->chunks[i].digest_hex);
+    std::unique_lock<std::mutex> lk(st.mu);
+    if (st.refs.find(r->chunks[i].digest_hex) == st.refs.end()) {
+      lk.unlock();
+      Recipe taken;
+      taken.chunks.assign(r->chunks.begin(), r->chunks.begin() + i);
+      UnpinRecipe(taken);
+      return std::nullopt;
+    }
+    st.pins[r->chunks[i].digest_hex]++;
+  }
   return r;
+}
+
+std::optional<Recipe> ChunkStore::ReadRecipeAndPinRange(
+    const std::string& path, int64_t offset, int64_t count,
+    int64_t* skip_out) {
+  auto full = ReadRecipeFile(path);
+  if (!full.has_value() || offset < 0) return std::nullopt;
+  // offset past EOF yields an EMPTY slice (no pins) rather than
+  // nullopt, so the caller can distinguish "gone" (ENOENT) from "bad
+  // range" (EINVAL) by logical_size.
+  int64_t want = full->logical_size - offset;
+  if (count > 0 && count < want) want = count;
+  // Locate the overlapping slice (one pass; the recipe is already in
+  // memory from the parse).
+  Recipe trimmed;
+  trimmed.logical_size = full->logical_size;
+  size_t first = 0;
+  int64_t skip = offset;
+  while (first < full->chunks.size() &&
+         skip >= full->chunks[first].length) {
+    skip -= full->chunks[first].length;
+    ++first;
+  }
+  size_t last = first;
+  int64_t covered = -skip;
+  while (last < full->chunks.size() && covered < want)
+    covered += full->chunks[last++].length;
+  trimmed.chunks.assign(full->chunks.begin() + first,
+                        full->chunks.begin() + last);
+  // Verify+pin per chunk with rollback, exactly like ReadRecipeAndPin.
+  for (size_t i = 0; i < trimmed.chunks.size(); ++i) {
+    Stripe& st = StripeFor(trimmed.chunks[i].digest_hex);
+    std::unique_lock<std::mutex> lk(st.mu);
+    if (st.refs.find(trimmed.chunks[i].digest_hex) == st.refs.end()) {
+      lk.unlock();
+      Recipe taken;
+      taken.chunks.assign(trimmed.chunks.begin(),
+                          trimmed.chunks.begin() + i);
+      UnpinRecipe(taken);
+      return std::nullopt;
+    }
+    st.pins[trimmed.chunks[i].digest_hex]++;
+  }
+  *skip_out = skip;
+  return trimmed;
 }
 
 std::string ChunkStore::PinAndMask(const Recipe& r) {
   std::string need(r.chunks.size(), '\0');
-  std::lock_guard<std::mutex> lk(mu_);
   for (size_t i = 0; i < r.chunks.size(); ++i) {
     // Quarantined chunks read as "needed": the client re-ships the
     // bytes and PutAndRef heals the store.  The pin taken here also
     // exempts the chunk from GcSweep and Quarantine for the session's
-    // lifetime — probe and pin share this one lock acquisition.
-    need[i] = refs_.find(r.chunks[i].digest_hex) != refs_.end() &&
-                      !quarantined_.count(r.chunks[i].digest_hex)
+    // lifetime — probe and pin share this one stripe-lock acquisition.
+    Stripe& st = StripeFor(r.chunks[i].digest_hex);
+    std::lock_guard<std::mutex> lk(st.mu);
+    need[i] = st.refs.find(r.chunks[i].digest_hex) != st.refs.end() &&
+                      !st.quarantined.count(r.chunks[i].digest_hex)
                   ? 0 : 1;
-    pins_[r.chunks[i].digest_hex]++;
+    st.pins[r.chunks[i].digest_hex]++;
   }
   return need;
 }
 
 void ChunkStore::PinRecipe(const Recipe& r) {
-  std::lock_guard<std::mutex> lk(mu_);
-  for (const RecipeEntry& e : r.chunks) pins_[e.digest_hex]++;
+  for (const RecipeEntry& e : r.chunks) {
+    Stripe& st = StripeFor(e.digest_hex);
+    std::lock_guard<std::mutex> lk(st.mu);
+    st.pins[e.digest_hex]++;
+  }
 }
 
 void ChunkStore::UnpinRecipe(const Recipe& r) {
-  std::lock_guard<std::mutex> lk(mu_);
   for (const RecipeEntry& e : r.chunks) {
-    auto it = pins_.find(e.digest_hex);
-    if (it == pins_.end()) continue;
+    Stripe& st = StripeFor(e.digest_hex);
+    std::lock_guard<std::mutex> lk(st.mu);
+    auto it = st.pins.find(e.digest_hex);
+    if (it == st.pins.end()) continue;
     if (--it->second <= 0) {
-      pins_.erase(it);
+      st.pins.erase(it);
       // Eager mode: the last pin drop completes a delete that was
       // deferred mid-stream — unless the chunk was re-added while the
-      // stream ran (PutAndRef resurrection erased the zero_ref_ entry).
+      // stream ran (PutAndRef resurrection erased the zero_ref entry).
       // With a GC grace the entry simply waits for GcSweep.
-      auto z = zero_ref_.find(e.digest_hex);
-      if (z != zero_ref_.end() && gc_grace_s_ == 0 &&
-          refs_.find(e.digest_hex) == refs_.end()) {
+      auto z = st.zero_ref.find(e.digest_hex);
+      if (z != st.zero_ref.end() && gc_grace_s_ == 0 &&
+          st.refs.find(e.digest_hex) == st.refs.end()) {
         zero_ref_bytes_ -= z->second.length;
-        zero_ref_.erase(z);
-        UnlinkRetiredLocked(e.digest_hex);
+        st.zero_ref.erase(z);
+        UnlinkRetiredLocked(st, e.digest_hex);
       }
     }
   }
@@ -357,29 +457,146 @@ bool ChunkStore::ReadChunk(const std::string& digest_hex, int64_t expect_len,
   return true;
 }
 
-int64_t ChunkStore::unique_chunks() const {
-  std::lock_guard<std::mutex> lk(mu_);
-  return static_cast<int64_t>(refs_.size());
+bool ChunkStore::ReadChunkSlice(const std::string& digest_hex,
+                                int64_t offset, int64_t len,
+                                char* dst) const {
+  int fd = open(ChunkPath(digest_hex).c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  int64_t got = 0;
+  while (got < len) {
+    ssize_t r = pread(fd, dst + got, static_cast<size_t>(len - got),
+                      offset + got);
+    if (r <= 0) {
+      close(fd);
+      return false;
+    }
+    got += r;
+  }
+  close(fd);
+  return true;
 }
 
-int64_t ChunkStore::unique_bytes() const {
-  std::lock_guard<std::mutex> lk(mu_);
-  return unique_bytes_;
+// -- hot-chunk read cache -------------------------------------------------
+
+std::shared_ptr<const std::string> ChunkStore::CacheGet(
+    const std::string& digest_hex) {
+  std::lock_guard<std::mutex> lk(cache_.mu);
+  auto it = cache_.index.find(digest_hex);
+  if (it == cache_.index.end()) return nullptr;
+  cache_.lru.splice(cache_.lru.begin(), cache_.lru, it->second);
+  return it->second->data;
+}
+
+void ChunkStore::CacheInsertIfLive(const std::string& digest_hex,
+                                   std::shared_ptr<const std::string> data) {
+  if (data == nullptr ||
+      static_cast<int64_t>(data->size()) > cache_.cap_bytes)
+    return;
+  // Re-check liveness UNDER the stripe lock: the disk read above ran
+  // lock-free, so it may have raced a Quarantine() or a delete's
+  // unlink.  Both invalidate under the stripe lock, so an insert gated
+  // by the same lock can never publish a stale entry past them.
+  Stripe& st = StripeFor(digest_hex);
+  std::lock_guard<std::mutex> slk(st.mu);
+  if (st.refs.find(digest_hex) == st.refs.end() ||
+      st.quarantined.count(digest_hex))
+    return;
+  std::lock_guard<std::mutex> lk(cache_.mu);
+  if (cache_.index.count(digest_hex)) return;  // racer inserted first
+  cache_.lru.push_front(CacheEntry{digest_hex, std::move(data)});
+  cache_.index[digest_hex] = cache_.lru.begin();
+  cache_.bytes += static_cast<int64_t>(cache_.lru.front().data->size());
+  while (cache_.bytes > cache_.cap_bytes && !cache_.lru.empty()) {
+    CacheEntry& victim = cache_.lru.back();
+    cache_.bytes -= static_cast<int64_t>(victim.data->size());
+    cache_.index.erase(victim.digest_hex);
+    cache_.lru.pop_back();  // in-flight spans keep the bytes via shared_ptr
+    cache_.evictions.fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
+void ChunkStore::CacheInvalidate(const std::string& digest_hex) {
+  if (cache_.cap_bytes <= 0) return;
+  std::lock_guard<std::mutex> lk(cache_.mu);
+  auto it = cache_.index.find(digest_hex);
+  if (it == cache_.index.end()) return;
+  cache_.bytes -= static_cast<int64_t>(it->second->data->size());
+  cache_.lru.erase(it->second);
+  cache_.index.erase(it);
+  cache_.invalidations.fetch_add(1, std::memory_order_relaxed);
+}
+
+void ChunkStore::CacheClear() {
+  std::lock_guard<std::mutex> lk(cache_.mu);
+  cache_.lru.clear();
+  cache_.index.clear();
+  cache_.bytes = 0;
+}
+
+std::shared_ptr<const std::string> ChunkStore::ReadChunkCached(
+    const std::string& digest_hex, int64_t expect_len, bool* hit) {
+  *hit = false;
+  if (cache_.cap_bytes <= 0) return nullptr;
+  auto p = CacheGet(digest_hex);
+  if (p != nullptr && static_cast<int64_t>(p->size()) == expect_len) {
+    *hit = true;
+    cache_.hits.fetch_add(1, std::memory_order_relaxed);
+    return p;
+  }
+  cache_.misses.fetch_add(1, std::memory_order_relaxed);
+  auto fresh = std::make_shared<std::string>();
+  if (!ReadChunk(digest_hex, expect_len, fresh.get())) return nullptr;
+  std::shared_ptr<const std::string> frozen = std::move(fresh);
+  CacheInsertIfLive(digest_hex, frozen);
+  return frozen;
+}
+
+std::shared_ptr<const std::string> ChunkStore::CacheLookup(
+    const std::string& digest_hex, int64_t expect_len) {
+  if (cache_.cap_bytes <= 0) return nullptr;
+  auto p = CacheGet(digest_hex);
+  if (p != nullptr && static_cast<int64_t>(p->size()) == expect_len) {
+    cache_.hits.fetch_add(1, std::memory_order_relaxed);
+    return p;
+  }
+  return nullptr;
+}
+
+int64_t ChunkStore::cache_bytes() const {
+  std::lock_guard<std::mutex> lk(cache_.mu);
+  return cache_.bytes;
+}
+
+int64_t ChunkStore::cache_chunks() const {
+  std::lock_guard<std::mutex> lk(cache_.mu);
+  return static_cast<int64_t>(cache_.lru.size());
+}
+
+int64_t ChunkStore::unique_chunks() const {
+  int64_t n = 0;
+  for (const Stripe& st : stripes_) {
+    std::lock_guard<std::mutex> lk(st.mu);
+    n += static_cast<int64_t>(st.refs.size());
+  }
+  return n;
 }
 
 int64_t ChunkStore::gc_pending_chunks() const {
-  std::lock_guard<std::mutex> lk(mu_);
-  return static_cast<int64_t>(zero_ref_.size());
-}
-
-int64_t ChunkStore::gc_pending_bytes() const {
-  std::lock_guard<std::mutex> lk(mu_);
-  return zero_ref_bytes_;
+  int64_t n = 0;
+  for (const Stripe& st : stripes_) {
+    std::lock_guard<std::mutex> lk(st.mu);
+    n += static_cast<int64_t>(st.zero_ref.size());
+  }
+  return n;
 }
 
 int64_t ChunkStore::quarantined_chunks() const {
-  std::lock_guard<std::mutex> lk(mu_);
-  return static_cast<int64_t>(quarantined_.size());
+  int64_t n = 0;
+  for (const Stripe& st : stripes_) {
+    std::lock_guard<std::mutex> lk(st.mu);
+    n += static_cast<int64_t>(st.quarantined.size());
+  }
+  return n;
 }
 
 // -- integrity engine -----------------------------------------------------
@@ -393,43 +610,55 @@ std::vector<ChunkStore::ChunkInfo> ChunkStore::SnapshotLive(
     p1 = kHex[prefix & 0xF];
   }
   std::vector<ChunkInfo> out;
-  std::lock_guard<std::mutex> lk(mu_);
-  if (prefix < 0) out.reserve(refs_.size());
-  for (const auto& [dig, n] : refs_) {
-    if (prefix >= 0 && (dig[0] != p0 || dig[1] != p1)) continue;
-    if (quarantined_.count(dig)) continue;
-    auto l = lens_.find(dig);
-    out.push_back({dig, l != lens_.end() ? l->second : 0});
+  // A byte prefix pins the stripe (stripe = high nibble), so a sliced
+  // scan holds exactly one stripe lock; a full snapshot walks the 16
+  // stripes one lock at a time (callers tolerate per-stripe tearing —
+  // they already tolerated churn after a monolithic snapshot).
+  int first = prefix >= 0 ? (prefix >> 4) & 0xF : 0;
+  int last = prefix >= 0 ? first : kStripes - 1;
+  for (int s = first; s <= last; ++s) {
+    const Stripe& st = stripes_[s];
+    std::lock_guard<std::mutex> lk(st.mu);
+    for (const auto& [dig, n] : st.refs) {
+      if (prefix >= 0 && (dig[0] != p0 || dig[1] != p1)) continue;
+      if (st.quarantined.count(dig)) continue;
+      auto l = st.lens.find(dig);
+      out.push_back({dig, l != st.lens.end() ? l->second : 0});
+    }
   }
   return out;
 }
 
 std::vector<ChunkStore::ChunkInfo> ChunkStore::SnapshotQuarantined() const {
   std::vector<ChunkInfo> out;
-  std::lock_guard<std::mutex> lk(mu_);
-  for (const std::string& dig : quarantined_) {
-    if (refs_.find(dig) == refs_.end()) continue;  // zero-ref: GC's problem
-    auto l = lens_.find(dig);
-    out.push_back({dig, l != lens_.end() ? l->second : 0});
+  for (const Stripe& st : stripes_) {
+    std::lock_guard<std::mutex> lk(st.mu);
+    for (const std::string& dig : st.quarantined) {
+      if (st.refs.find(dig) == st.refs.end()) continue;  // zero-ref: GC's
+      auto l = st.lens.find(dig);
+      out.push_back({dig, l != st.lens.end() ? l->second : 0});
+    }
   }
   return out;
 }
 
 bool ChunkStore::IsQuarantined(const std::string& digest_hex) const {
-  std::lock_guard<std::mutex> lk(mu_);
-  return quarantined_.count(digest_hex) != 0;
+  const Stripe& st = StripeFor(digest_hex);
+  std::lock_guard<std::mutex> lk(st.mu);
+  return st.quarantined.count(digest_hex) != 0;
 }
 
 ChunkStore::QuarantineResult ChunkStore::Quarantine(
     const std::string& digest_hex) {
-  std::lock_guard<std::mutex> lk(mu_);
-  if (refs_.find(digest_hex) == refs_.end())
+  Stripe& st = StripeFor(digest_hex);
+  std::lock_guard<std::mutex> lk(st.mu);
+  if (st.refs.find(digest_hex) == st.refs.end())
     return QuarantineResult::kGone;  // deleted since the snapshot
-  if (pins_.count(digest_hex)) return QuarantineResult::kPinned;
+  if (st.pins.count(digest_hex)) return QuarantineResult::kPinned;
   // Re-verify under the lock: the scrubber's verify read ran lock-free,
   // so it may have raced a delete + re-upload of this digest and hashed
-  // a half-gone file.  No writer can interleave with this read, so a
-  // clean hash here is authoritative.
+  // a half-gone file.  No writer of this digest can interleave with
+  // this read, so a clean hash here is authoritative.
   {
     int fd = open(ChunkPath(digest_hex).c_str(), O_RDONLY);
     if (fd >= 0) {
@@ -452,41 +681,52 @@ ChunkStore::QuarantineResult ChunkStore::Quarantine(
       errno != ENOENT)
     FDFS_LOG_WARN("quarantine rename %s: %s", digest_hex.c_str(),
                   strerror(errno));
-  quarantined_.insert(digest_hex);
+  st.quarantined.insert(digest_hex);
+  // Same-lock cache invalidation: after this returns, no download can
+  // serve the jailed bytes from the read cache (inserts re-check the
+  // quarantine mark under this lock).
+  CacheInvalidate(digest_hex);
   return QuarantineResult::kQuarantined;
 }
 
 bool ChunkStore::RepairChunk(const std::string& digest_hex, const char* data,
                              size_t len, std::string* err) {
-  std::lock_guard<std::mutex> lk(mu_);
-  if (refs_.find(digest_hex) == refs_.end()) {
+  Stripe& st = StripeFor(digest_hex);
+  std::lock_guard<std::mutex> lk(st.mu);
+  if (st.refs.find(digest_hex) == st.refs.end()) {
     *err = "no longer referenced";
     return false;
   }
   if (!WriteChunkFile(ChunkPath(digest_hex), data, len, err)) return false;
-  quarantined_.erase(digest_hex);
+  st.quarantined.erase(digest_hex);
   unlink(QuarantinePath(digest_hex).c_str());
-  lens_[digest_hex] = static_cast<int64_t>(len);
+  st.lens[digest_hex] = static_cast<int64_t>(len);
+  // The repaired payload hashes to the digest, so a cached copy would
+  // be byte-identical — but drop it anyway: the cache must never hold
+  // an entry that predates a quarantine episode.
+  CacheInvalidate(digest_hex);
   return true;
 }
 
 int64_t ChunkStore::GcSweep(int64_t now_s, int64_t* bytes) {
-  std::lock_guard<std::mutex> lk(mu_);
   int64_t reclaimed = 0;
-  for (auto it = zero_ref_.begin(); it != zero_ref_.end();) {
-    if (now_s - it->second.since_s < gc_grace_s_ ||
-        pins_.count(it->first)) {
-      // Inside the grace window, or pinned by an in-flight stream /
-      // phase-1 upload session — the pin probe shares this lock with
-      // the unlink, so PinAndMask can never lose the race.
-      ++it;
-      continue;
+  for (Stripe& st : stripes_) {
+    std::lock_guard<std::mutex> lk(st.mu);
+    for (auto it = st.zero_ref.begin(); it != st.zero_ref.end();) {
+      if (now_s - it->second.since_s < gc_grace_s_ ||
+          st.pins.count(it->first)) {
+        // Inside the grace window, or pinned by an in-flight stream /
+        // phase-1 upload session — the pin probe shares this stripe
+        // lock with the unlink, so PinAndMask can never lose the race.
+        ++it;
+        continue;
+      }
+      UnlinkRetiredLocked(st, it->first);
+      zero_ref_bytes_ -= it->second.length;
+      *bytes += it->second.length;
+      ++reclaimed;
+      it = st.zero_ref.erase(it);
     }
-    UnlinkRetiredLocked(it->first);
-    zero_ref_bytes_ -= it->second.length;
-    *bytes += it->second.length;
-    ++reclaimed;
-    it = zero_ref_.erase(it);
   }
   return reclaimed;
 }
@@ -534,7 +774,7 @@ void ChunkStore::RebuildFromRecipes() {
   // crash leftover, or (with a GC grace window) a deliberately-retired
   // zero-ref chunk whose grace had not expired at shutdown.  Eager mode
   // drops orphans on the spot (the original behavior); grace mode
-  // parks them in zero_ref_ aged by file mtime, so the grace window is
+  // parks them in zero_ref aged by file mtime, so the grace window is
   // crash-safe instead of resetting on every restart.
   int64_t orphans = 0, parked = 0, bytes = 0;
   std::unordered_map<std::string, ZeroRef> zero;
@@ -606,22 +846,43 @@ void ChunkStore::RebuildFromRecipes() {
     closedir(qd);
   }
 
-  std::lock_guard<std::mutex> lk(mu_);
-  refs_ = std::move(refs);
-  lens_ = std::move(lens);
-  zero_ref_ = std::move(zero);
-  quarantined_ = std::move(quarantined);
-  unique_bytes_ = 0;
-  zero_ref_bytes_ = 0;
-  for (const auto& [dig, n] : refs_) unique_bytes_ += lens_[dig];
-  for (const auto& [dig, z] : zero_ref_) zero_ref_bytes_ += z.length;
-  bytes = unique_bytes_;
-  if (!refs_.empty() || orphans > 0 || parked > 0 || !quarantined_.empty())
+  // Distribute the rebuilt maps into their stripes.  Startup runs
+  // before serving, but take the locks anyway — Rebuild is also called
+  // in tests against a store that already served.
+  size_t unique = 0;
+  int64_t ub = 0, zb = 0;
+  std::array<Stripe, kStripes> fresh;
+  for (auto& [dig, n] : refs) {
+    Stripe& st = fresh[StripeIndex(dig)];
+    st.refs[dig] = n;
+  }
+  for (auto& [dig, l] : lens) fresh[StripeIndex(dig)].lens[dig] = l;
+  for (auto& [dig, z] : zero) {
+    fresh[StripeIndex(dig)].zero_ref[dig] = z;
+    zb += z.length;
+  }
+  for (auto& dig : quarantined) fresh[StripeIndex(dig)].quarantined.insert(dig);
+  for (const auto& [dig, n] : refs) ub += lens[dig];
+  unique = refs.size();
+  for (int s = 0; s < kStripes; ++s) {
+    Stripe& st = stripes_[s];
+    std::lock_guard<std::mutex> lk(st.mu);
+    st.refs = std::move(fresh[s].refs);
+    st.lens = std::move(fresh[s].lens);
+    st.zero_ref = std::move(fresh[s].zero_ref);
+    st.quarantined = std::move(fresh[s].quarantined);
+    st.pins.clear();
+  }
+  unique_bytes_ = ub;
+  zero_ref_bytes_ = zb;
+  bytes = ub;
+  CacheClear();
+  if (unique > 0 || orphans > 0 || parked > 0 || !quarantined.empty())
     FDFS_LOG_INFO("chunk store: %zu unique chunks (%lld bytes), %lld "
                   "orphans collected, %lld awaiting GC, %zu quarantined",
-                  refs_.size(), static_cast<long long>(bytes),
+                  unique, static_cast<long long>(bytes),
                   static_cast<long long>(orphans),
-                  static_cast<long long>(parked), quarantined_.size());
+                  static_cast<long long>(parked), quarantined.size());
 }
 
 }  // namespace fdfs

@@ -16,30 +16,46 @@
 //
 // Refcounts are RAM-only and rebuilt by scanning every recipe at startup
 // (which doubles as orphan-chunk GC); crash-safety therefore never
-// depends on a refcount file.  Single acquisition order: this class is
-// self-locked and calls nothing that locks.
+// depends on a refcount file.
 //
-// Integrity lifecycle (the anti-entropy subsystem in storage/scrub.h):
+// Locking (the PR 5 read-path overhaul): the per-digest state (refs,
+// lengths, pins, zero-ref parking, quarantine marks) is SHARDED into
+// kStripes lock stripes keyed by the digest's first hex nibble, so
+// concurrent downloads, uploads, deletes, and the scrub pass stop
+// convoying on one mutex.  Every invariant from the integrity engine
+// era is PER-DIGEST (probe+pin in one acquisition, pin-vs-GC-unlink in
+// one acquisition, quarantine re-verify under the same lock as the
+// rename), so a single stripe lock preserves each of them; the only
+// cross-digest atomicity anywhere is RefAll's all-or-nothing check,
+// which takes its (few) stripes in ascending index order — the
+// deadlock-free ordered multi-stripe protocol.  ReadRecipeAndPin keeps
+// its fail-before-first-byte contract by verify+pin per chunk with
+// rollback: a delete interleaving mid-recipe makes the pin step find
+// the unref'd chunk and the whole download fails cleanly with no pins
+// held, exactly as the monolithic lock produced.  Aggregate byte/count
+// accounting is atomics.  This class is self-locked and calls nothing
+// that locks (the read cache has its own mutex, always acquired AFTER
+// a stripe lock, never before).
 //
-//  * Zero-ref GC.  With gc_grace_s == 0 (default) a chunk whose last
-//    reference drops is unlinked immediately (deferred only while a
-//    stream pin holds it — the original semantics).  With a grace
-//    window, zero-ref chunks park in zero_ref_ (bytes stay on disk,
-//    resurrectable by PutAndRef) until a GcSweep older than the grace
-//    reclaims them; the pin probe runs under the SAME lock as the
-//    unlink, so an upload session's PinAndMask can never lose a chunk
-//    to a sweep in the probe-to-pin gap.
-//  * Quarantine.  A scrub pass that finds bit-rot moves the bad bytes
-//    into <store_path>/data/quarantine/<digest> (never served again)
-//    while the refcount entry stays live; Have/PinAndMask report the
-//    chunk as missing so uploads re-ship the bytes, and PutAndRef /
-//    RepairChunk with verified payloads heal it in place.
+// Hot-chunk read cache: a bounded LRU of whole chunk payloads
+// (storage.conf:read_cache_mb; 0 = off) consulted by the download and
+// FETCH_CHUNK serving paths.  Entries are shared_ptr<const string>, so
+// an eviction or invalidation never frees bytes a response is still
+// scattering into the socket.  Strict coherence with mutation: inserts
+// re-check refs+quarantine UNDER the digest's stripe lock, and
+// Quarantine(), RepairChunk(), and the GC/delete unlink invalidate
+// under that same lock — a quarantined or swept chunk can never be
+// served from the cache afterward.
 //
 // Reference anchor: replaces the inode-per-file write in
 // storage/storage_dio.c:dio_write_file() for deduplicated uploads.
 #pragma once
 
+#include <array>
+#include <atomic>
 #include <cstdint>
+#include <list>
+#include <memory>
 #include <mutex>
 #include <optional>
 #include <string>
@@ -68,8 +84,10 @@ class ChunkStore {
  public:
   // gc_grace_s: how long a zero-ref chunk's bytes linger on disk before
   // a GcSweep may reclaim them (0 = unlink eagerly on the last unref,
-  // the pre-scrubber behavior).
-  explicit ChunkStore(std::string store_path, int64_t gc_grace_s = 0);
+  // the pre-scrubber behavior).  read_cache_bytes bounds the hot-chunk
+  // LRU read cache (0 = off).
+  explicit ChunkStore(std::string store_path, int64_t gc_grace_s = 0,
+                      int64_t read_cache_bytes = 0);
 
   // Scan every *.rcp under the data dir: rebuild refcounts and delete
   // orphaned chunk files.  Call once at startup, before serving.
@@ -86,17 +104,16 @@ class ChunkStore {
 
   // Take one additional reference per recipe entry (recipe duplication:
   // CREATE_LINK of a chunked file).  False (and no refs taken) if any
-  // chunk is absent.
+  // chunk is absent.  All-or-nothing across digests: the involved
+  // stripes are locked together in ascending index order.
   bool RefAll(const Recipe& r);
 
   // Is this chunk live (referenced by at least one recipe)?
   bool Has(const std::string& digest_hex) const;
 
-  // Batched presence check under ONE lock acquisition: byte i of the
-  // result is 0 when digests[i] is live, 1 when it must be shipped.
-  // (The chunk-aware replication receiver runs this on the nio loop —
-  // per-digest locking would serialize against every concurrent
-  // upload's PutAndRef.)
+  // Batched presence check, one lock acquisition PER STRIPE (not per
+  // digest): byte i of the result is 0 when digests[i] is live, 1 when
+  // it must be shipped.
   std::string HaveMask(const std::vector<std::string>& digests) const;
 
   // Take one reference on an already-live chunk; false when absent
@@ -108,10 +125,38 @@ class ChunkStore {
   bool ReadChunk(const std::string& digest_hex, int64_t expect_len,
                  std::string* out) const;
 
-  // Presence probe + pin in ONE lock acquisition, for the negotiated
-  // upload's phase-1 answer: byte i of the result is 0 when chunk i is
-  // live (and now pinned against unlink until the session's
-  // UnpinRecipe), 1 when the client must ship it.  A separate
+  // Positional read of [offset, offset+len) of a chunk's payload into
+  // dst (pread; no heap) — the cold-span path of the scatter-gather
+  // download assembly.  False when missing/short.
+  bool ReadChunkSlice(const std::string& digest_hex, int64_t offset,
+                      int64_t len, char* dst) const;
+
+  // -- hot-chunk read cache ----------------------------------------------
+  bool cache_enabled() const { return cache_.cap_bytes > 0; }
+  // Cache lookup + disk read-through + insert, for DOWNLOAD_FILE: the
+  // returned buffer is immutable and keep-alive (safe across eviction
+  // and invalidation).  *hit reports whether the cache served it.
+  // nullptr when the cache is off, the chunk is unreadable, or its size
+  // does not match expect_len.  Inserts re-check liveness/quarantine
+  // under the digest's stripe lock (see header comment).
+  std::shared_ptr<const std::string> ReadChunkCached(
+      const std::string& digest_hex, int64_t expect_len, bool* hit);
+  // Lookup WITHOUT read-through or insert, for FETCH_CHUNK (recovery /
+  // scrub-repair traffic must not evict client-hot chunks).
+  std::shared_ptr<const std::string> CacheLookup(
+      const std::string& digest_hex, int64_t expect_len);
+  int64_t cache_hits() const { return cache_.hits.load(); }
+  int64_t cache_misses() const { return cache_.misses.load(); }
+  int64_t cache_evictions() const { return cache_.evictions.load(); }
+  int64_t cache_invalidations() const { return cache_.invalidations.load(); }
+  int64_t cache_bytes() const;
+  int64_t cache_chunks() const;
+  int64_t cache_capacity_bytes() const { return cache_.cap_bytes; }
+
+  // Presence probe + pin in ONE stripe-lock acquisition per chunk, for
+  // the negotiated upload's phase-1 answer: byte i of the result is 0
+  // when chunk i is live (and now pinned against unlink until the
+  // session's UnpinRecipe), 1 when the client must ship it.  A separate
   // HaveMask-then-PinRecipe would let a delete unlink a "present" chunk
   // in the gap; pinning absent digests is harmless (the unpin erases
   // the entry), so every entry is pinned and the whole recipe unpins.
@@ -125,14 +170,27 @@ class ChunkStore {
   void PinRecipe(const Recipe& r);
   void UnpinRecipe(const Recipe& r);
 
-  // Read a recipe file and pin its chunks atomically w.r.t. UnrefAll: a
-  // delete landing between a plain ReadRecipeFile and PinRecipe could
-  // unref+unlink chunks the stream is about to send.  Under the store
-  // mutex: read, verify every chunk is still referenced, then pin.
-  // nullopt (no pins taken) when the recipe is gone or any chunk was
-  // already unreferenced — the caller fails the download with ENOENT
-  // before the first byte, not mid-stream.
+  // Read a recipe file and pin its chunks, failing before the first
+  // byte: each chunk is verified still-referenced and pinned under its
+  // stripe lock; if any chunk was already unreferenced (a concurrent
+  // delete), the pins taken so far roll back and the caller fails the
+  // download with ENOENT — never mid-stream.
   std::optional<Recipe> ReadRecipeAndPin(const std::string& path);
+
+  // Ranged variant for the parallel download client: pin (and return)
+  // ONLY the recipe entries overlapping [offset, offset+count) of the
+  // logical file (count 0 = to EOF) — a 4-range parallel download of a
+  // many-thousand-chunk file must not pay 4x full-recipe pin/unpin and
+  // skip scans.  The returned Recipe keeps the FULL logical_size but
+  // holds just the overlapping chunk slice; *skip_out is the byte
+  // offset inside its first entry.  UnpinRecipe on the returned
+  // (trimmed) recipe releases exactly the pins taken.  nullopt (no
+  // pins) when the recipe is gone or a chunk was unreferenced; offset
+  // PAST EOF returns an EMPTY slice instead, so the caller can tell
+  // "bad range" (EINVAL, by logical_size) from "gone" (ENOENT).
+  std::optional<Recipe> ReadRecipeAndPinRange(const std::string& path,
+                                              int64_t offset, int64_t count,
+                                              int64_t* skip_out);
 
   std::string ChunkPath(const std::string& digest_hex) const;
   std::string QuarantinePath(const std::string& digest_hex) const;
@@ -145,9 +203,9 @@ class ChunkStore {
   // Live (referenced, non-quarantined) chunks for a verify pass.
   // prefix -1 snapshots everything in one call; 0..255 filters to
   // digests whose first byte equals it, so a scrubber walking the 256
-  // slices in turn holds the lock for one allocation-light filter scan
-  // at a time and never keeps a many-million-entry snapshot resident
-  // across an hours-long paced pass.
+  // slices in turn holds one stripe lock for one allocation-light
+  // filter scan at a time and never keeps a many-million-entry
+  // snapshot resident across an hours-long paced pass.
   std::vector<ChunkInfo> SnapshotLive(int prefix = -1) const;
   // Currently quarantined chunks still named by a recipe (repair targets).
   std::vector<ChunkInfo> SnapshotQuarantined() const;
@@ -162,8 +220,9 @@ class ChunkStore {
   // correctly — the caller's lock-free verify read raced a delete +
   // re-upload of the same digest, and the bytes on disk now are good
   // (quarantining them would jail a freshly-written chunk).  Probe,
-  // re-verify, and rename happen in one lock acquisition, which no
-  // PutAndRef/UnrefAll can interleave.
+  // re-verify, rename, and read-cache invalidation happen in one
+  // stripe-lock acquisition, which no PutAndRef/UnrefAll of this
+  // digest can interleave.
   QuarantineResult Quarantine(const std::string& digest_hex);
   // Restore verified bytes for a still-referenced digest (replica
   // repair).  False when the digest is no longer live (deleted — drop
@@ -172,16 +231,16 @@ class ChunkStore {
   bool RepairChunk(const std::string& digest_hex, const char* data,
                    size_t len, std::string* err);
   // Reclaim zero-ref chunks whose grace expired at `now_s`, skipping
-  // pinned ones — probe and unlink under one lock acquisition, so a
-  // concurrent PinAndMask either pinned the chunk first (sweep skips
-  // it) or finds it already gone (reports it as needed).  Returns the
-  // number of chunks unlinked; *bytes accumulates their sizes.
+  // pinned ones — probe and unlink under one stripe-lock acquisition,
+  // so a concurrent PinAndMask either pinned the chunk first (sweep
+  // skips it) or finds it already gone (reports it as needed).
+  // Returns the number of chunks unlinked; *bytes accumulates sizes.
   int64_t GcSweep(int64_t now_s, int64_t* bytes);
 
   int64_t unique_chunks() const;
-  int64_t unique_bytes() const;
+  int64_t unique_bytes() const { return unique_bytes_.load(); }
   int64_t gc_pending_chunks() const;
-  int64_t gc_pending_bytes() const;
+  int64_t gc_pending_bytes() const { return zero_ref_bytes_.load(); }
   int64_t quarantined_chunks() const;
 
  private:
@@ -189,22 +248,62 @@ class ChunkStore {
     int64_t length = 0;
     int64_t since_s = 0;  // wall clock of the last unref (or file mtime)
   };
-  // mu_ held.  Park a zero-ref chunk for GC or unlink it eagerly
+  // One lock stripe: all per-digest state for digests whose first hex
+  // nibble selects this stripe lives here, guarded by `mu`.
+  struct Stripe {
+    mutable std::mutex mu;
+    std::unordered_map<std::string, int64_t> refs;
+    std::unordered_map<std::string, int64_t> lens;  // digest -> byte length
+    std::unordered_map<std::string, int64_t> pins;  // in-flight streams
+    std::unordered_map<std::string, ZeroRef> zero_ref;  // awaiting GC
+    std::unordered_set<std::string> quarantined;
+  };
+  static constexpr int kStripes = 16;
+  static int StripeIndex(const std::string& digest_hex);
+  Stripe& StripeFor(const std::string& digest_hex) {
+    return stripes_[StripeIndex(digest_hex)];
+  }
+  const Stripe& StripeFor(const std::string& digest_hex) const {
+    return stripes_[StripeIndex(digest_hex)];
+  }
+
+  // stripe mu held.  Park a zero-ref chunk for GC or unlink it eagerly
   // (gc_grace_s_ == 0 and unpinned).
-  void RetireLocked(const std::string& digest_hex, int64_t length);
-  // mu_ held.  Unlink a zero-ref chunk's bytes (chunks/ and quarantine/).
-  void UnlinkRetiredLocked(const std::string& digest_hex);
+  void RetireLocked(Stripe& s, const std::string& digest_hex,
+                    int64_t length);
+  // stripe mu held.  Unlink a zero-ref chunk's bytes (chunks/ and
+  // quarantine/) and invalidate any cached copy.
+  void UnlinkRetiredLocked(Stripe& s, const std::string& digest_hex);
+
+  // -- read cache internals ----------------------------------------------
+  struct CacheEntry {
+    std::string digest_hex;
+    std::shared_ptr<const std::string> data;
+  };
+  struct ReadCache {
+    int64_t cap_bytes = 0;
+    mutable std::mutex mu;
+    std::list<CacheEntry> lru;  // front = most recent
+    std::unordered_map<std::string, std::list<CacheEntry>::iterator> index;
+    int64_t bytes = 0;
+    std::atomic<int64_t> hits{0}, misses{0}, evictions{0},
+        invalidations{0};
+  };
+  std::shared_ptr<const std::string> CacheGet(const std::string& digest_hex);
+  // Insert (caller holds NO stripe lock; this re-takes the digest's
+  // stripe lock to re-check liveness — see header comment).
+  void CacheInsertIfLive(const std::string& digest_hex,
+                         std::shared_ptr<const std::string> data);
+  // stripe mu held (or startup): drop a digest's cached copy.
+  void CacheInvalidate(const std::string& digest_hex);
+  void CacheClear();
 
   std::string store_path_;
   int64_t gc_grace_s_ = 0;
-  mutable std::mutex mu_;
-  std::unordered_map<std::string, int64_t> refs_;
-  std::unordered_map<std::string, int64_t> lens_;  // digest -> byte length
-  std::unordered_map<std::string, int64_t> pins_;  // in-flight streams
-  std::unordered_map<std::string, ZeroRef> zero_ref_;  // awaiting GC
-  std::unordered_set<std::string> quarantined_;
-  int64_t unique_bytes_ = 0;
-  int64_t zero_ref_bytes_ = 0;
+  std::array<Stripe, kStripes> stripes_;
+  std::atomic<int64_t> unique_bytes_{0};
+  std::atomic<int64_t> zero_ref_bytes_{0};
+  mutable ReadCache cache_;
 };
 
 }  // namespace fdfs

@@ -90,6 +90,11 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
   if (scrub_bandwidth_mb_s > (1 << 20)) scrub_bandwidth_mb_s = 1 << 20;
   chunk_gc_grace_s = ini.GetSeconds("chunk_gc_grace_s", chunk_gc_grace_s);
   if (chunk_gc_grace_s < 0) chunk_gc_grace_s = 0;
+  read_cache_mb = static_cast<int>(ini.GetInt("read_cache_mb",
+                                              read_cache_mb));
+  if (read_cache_mb < 0) read_cache_mb = 0;
+  // 64 GB cap: the cache is per store path and RAM-resident.
+  if (read_cache_mb > (64 << 10)) read_cache_mb = 64 << 10;
   return true;
 }
 

@@ -84,6 +84,11 @@ struct StorageConfig {
   int scrub_interval_s = 86400;
   int scrub_bandwidth_mb_s = 0;
   int64_t chunk_gc_grace_s = 0;
+  // Hot-chunk read cache (per store path): bounded LRU of chunk
+  // payloads consulted by DOWNLOAD_FILE / FETCH_CHUNK, invalidated on
+  // quarantine and GC unlink (OPERATIONS.md "Read path, caching &
+  // parallel downloads").  0 disables it.
+  int read_cache_mb = 64;
 
   // Parse + validate; false with *error on problems.
   bool Load(const IniConfig& ini, std::string* error);

@@ -176,9 +176,11 @@ void ScrubManager::RunPass() {
     // already ran in HandleCorrupt) is not attempted twice per pass.
     auto retry = cs->SnapshotQuarantined();
     // Walk the store in 256 digest-prefix slices: each slice is one
-    // short, allocation-light scan under the store lock, and a
-    // many-million-chunk store never holds a full snapshot resident
-    // across an hours-long paced pass.
+    // short, allocation-light scan under a single stripe lock (slice
+    // prefix pins the stripe since the PR 5 sharding — the scrubber
+    // never contends with more than 1/16 of the foreground traffic),
+    // and a many-million-chunk store never holds a full snapshot
+    // resident across an hours-long paced pass.
     for (int prefix = 0; prefix < 256 && !aborted; ++prefix) {
       auto live = cs->SnapshotLive(prefix);
       size_t i = 0;

@@ -7,6 +7,7 @@
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/time.h>
+#include <sys/uio.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -94,7 +95,8 @@ bool StorageServer::Init(std::string* error) {
     // refcounts rebuilt from recipes (doubles as orphan GC).
     for (int i = 0; i < store_.store_path_count(); ++i) {
       chunk_stores_.push_back(std::make_unique<ChunkStore>(
-          store_.store_path(i), cfg_.chunk_gc_grace_s));
+          store_.store_path(i), cfg_.chunk_gc_grace_s,
+          static_cast<int64_t>(cfg_.read_cache_mb) << 20));
       chunk_stores_.back()->RebuildFromRecipes();
     }
   }
@@ -571,6 +573,36 @@ void StorageServer::InitStatsRegistry() {
   registry_.GaugeFn("ingest.sessions_active", [this] {
     std::lock_guard<std::mutex> lk(ingest_mu_);
     return static_cast<int64_t>(ingest_sessions_.size());
+  });
+  // Read path (PR 5): ranged-download traffic and the hot-chunk read
+  // cache, summed over the per-store-path chunk stores.
+  ctr_download_ranged_requests_ =
+      registry_.Counter("download.ranged_requests");
+  ctr_download_ranged_bytes_ = registry_.Counter("download.ranged_bytes");
+  auto cache_sum = [this](int64_t (ChunkStore::*fn)() const) {
+    int64_t n = 0;
+    for (const auto& cs : chunk_stores_) n += (cs.get()->*fn)();
+    return n;
+  };
+  registry_.GaugeFn("cache.hits",
+                    [cache_sum] { return cache_sum(&ChunkStore::cache_hits); });
+  registry_.GaugeFn("cache.misses", [cache_sum] {
+    return cache_sum(&ChunkStore::cache_misses);
+  });
+  registry_.GaugeFn("cache.evictions", [cache_sum] {
+    return cache_sum(&ChunkStore::cache_evictions);
+  });
+  registry_.GaugeFn("cache.invalidations", [cache_sum] {
+    return cache_sum(&ChunkStore::cache_invalidations);
+  });
+  registry_.GaugeFn("cache.bytes", [cache_sum] {
+    return cache_sum(&ChunkStore::cache_bytes);
+  });
+  registry_.GaugeFn("cache.chunks", [cache_sum] {
+    return cache_sum(&ChunkStore::cache_chunks);
+  });
+  registry_.GaugeFn("cache.capacity_bytes", [cache_sum] {
+    return cache_sum(&ChunkStore::cache_capacity_bytes);
   });
 
   // Snapshot-time mirrors of live state.  The restart-persisted op
@@ -1135,34 +1167,34 @@ bool StorageServer::WriteConn(Conn* c) {
       CloseConn(c);
       return false;
     }
-    // 3) recipe stream: refill the buffer one chunk-slice at a time as
-    // the socket drains — a multi-GB chunked download never occupies
-    // more than one chunk of memory and never stalls this loop's other
-    // connections (VERDICT r2 weak #5; reference: storage_dio.c reads).
-    if (c->rstream != nullptr && c->rstream->remaining > 0) {
+    // 3) recipe stream, scatter-gather (PR 5): flush the staged span
+    // batch via sendmsg, then refill — cache-hit spans reference the
+    // chunk store's shared LRU buffers (zero redundant copies), cold
+    // spans pread into the stream's pooled buffer.  A multi-GB chunked
+    // download never occupies more than one batch of memory and never
+    // stalls this loop's other connections (reference: storage_dio.c
+    // reads; VERDICT r2 weak #5).
+    if (c->rstream != nullptr) {
       RecipeStream* rs = c->rstream.get();
-      if (rs->idx >= rs->recipe.chunks.size()) {
-        FDFS_LOG_ERROR("recipe exhausted with %lld bytes unsent",
-                       static_cast<long long>(rs->remaining));
-        CloseConn(c);  // header already sent; abort is the only option
-        return false;
+      if (rs->HasPending()) {
+        switch (FlushRecipeSpans(c, rs)) {
+          case FlushResult::kBlocked:
+            ConnLoop(c)->Mod(c->fd, EPOLLIN | EPOLLOUT);
+            return true;
+          case FlushResult::kError:
+            CloseConn(c);
+            return false;
+          case FlushResult::kDone:
+            break;
+        }
       }
-      const RecipeEntry& e = rs->recipe.chunks[rs->idx];
-      std::string chunk;
-      if (!rs->cs->ReadChunk(e.digest_hex, e.length, &chunk)) {
-        FDFS_LOG_ERROR("missing chunk %s mid-download", e.digest_hex.c_str());
-        CloseConn(c);
-        return false;
+      if (rs->remaining > 0) {
+        if (!RefillRecipeSpans(rs)) {
+          CloseConn(c);  // header already sent; abort is the only option
+          return false;
+        }
+        continue;  // flush what we just staged
       }
-      int64_t avail = static_cast<int64_t>(chunk.size()) - rs->skip;
-      int64_t take = std::min<int64_t>(avail, rs->remaining);
-      c->out.assign(chunk.data() + rs->skip, static_cast<size_t>(take));
-      c->out_off = 0;
-      rs->remaining -= take;
-      rs->skip = 0;
-      rs->idx++;
-      stats_.bytes_downloaded += take;
-      continue;  // send what we just staged
     }
     break;
   }
@@ -1180,6 +1212,141 @@ bool StorageServer::WriteConn(Conn* c) {
     ResetForNextRequest(c);
   }
   return true;
+}
+
+bool StorageServer::RefillRecipeSpans(RecipeStream* rs) {
+  // One round stages up to kBatchBytes across up to kMaxSpans spans —
+  // enough to amortize the sendmsg syscall, small enough that a slow
+  // client never parks more than ~1 MB per connection (an 8 MB chunk is
+  // staged one bounded slice per round; the cache holds the whole chunk
+  // so later rounds hit).  Cold spans pread into the pooled buffer,
+  // which is sized ONCE per round before any span references it.
+  constexpr int64_t kBatchBytes = 1 << 20;
+  constexpr size_t kMaxSpans = 64;
+  rs->spans.clear();
+  rs->span_idx = 0;
+  rs->span_off = 0;
+  struct ColdRead {
+    size_t span;      // index into rs->spans
+    size_t entry;     // index into rs->recipe.chunks
+    int64_t file_off; // offset inside the chunk payload
+  };
+  ColdRead cold[kMaxSpans];
+  size_t n_cold = 0;
+  int64_t staged = 0;
+  size_t pool_bytes = 0;
+  while (rs->remaining - staged > 0 && rs->spans.size() < kMaxSpans &&
+         staged < kBatchBytes) {
+    if (rs->idx >= rs->recipe.chunks.size()) {
+      FDFS_LOG_ERROR("recipe exhausted with %lld bytes unsent",
+                     static_cast<long long>(rs->remaining - staged));
+      return false;
+    }
+    const RecipeEntry& e = rs->recipe.chunks[rs->idx];
+    int64_t avail = e.length - rs->skip;
+    if (avail <= 0) {  // zero-length or fully-skipped entry
+      rs->idx++;
+      rs->skip = 0;
+      continue;
+    }
+    int64_t take = std::min(
+        {avail, rs->remaining - staged, kBatchBytes - staged});
+    RecipeStream::Span sp;
+    // Cache path only for chunks that can actually LIVE in the cache:
+    // a chunk bigger than the whole cache would be re-read IN FULL on
+    // every staging round (the insert is always rejected), so it takes
+    // the pooled pread-slice path like the cache-off case.
+    std::shared_ptr<const std::string> buf;
+    if (rs->cs->cache_enabled() &&
+        e.length <= rs->cs->cache_capacity_bytes()) {
+      bool hit = false;
+      buf = rs->cs->ReadChunkCached(e.digest_hex, e.length, &hit);
+      if (buf == nullptr) {
+        // Unreadable (missing/short/jailed) — abort the stream.
+        FDFS_LOG_ERROR("missing chunk %s mid-download",
+                       e.digest_hex.c_str());
+        return false;
+      }
+    }
+    if (buf != nullptr) {
+      sp.owner = std::move(buf);
+      sp.off = static_cast<size_t>(rs->skip);
+      sp.len = static_cast<size_t>(take);
+    } else {
+      sp.off = pool_bytes;
+      sp.len = static_cast<size_t>(take);
+      cold[n_cold++] = ColdRead{rs->spans.size(), rs->idx, rs->skip};
+      pool_bytes += static_cast<size_t>(take);
+    }
+    rs->spans.push_back(std::move(sp));
+    staged += take;
+    if (take == avail) {
+      rs->idx++;
+      rs->skip = 0;
+    } else {
+      rs->skip += take;  // bounded mid-chunk stop; resume next round
+    }
+  }
+  // The pool is final-sized before any cold read, so span offsets into
+  // it stay valid for the whole round.
+  rs->pool.resize(pool_bytes);
+  for (size_t i = 0; i < n_cold; ++i) {
+    const RecipeEntry& e = rs->recipe.chunks[cold[i].entry];
+    RecipeStream::Span& sp = rs->spans[cold[i].span];
+    if (!rs->cs->ReadChunkSlice(e.digest_hex, cold[i].file_off,
+                                static_cast<int64_t>(sp.len),
+                                rs->pool.data() + sp.off)) {
+      FDFS_LOG_ERROR("missing chunk %s mid-download", e.digest_hex.c_str());
+      return false;
+    }
+  }
+  rs->remaining -= staged;
+  stats_.bytes_downloaded += staged;
+  return true;
+}
+
+StorageServer::FlushResult StorageServer::FlushRecipeSpans(
+    Conn* c, RecipeStream* rs) {
+  while (rs->HasPending()) {
+    struct iovec iov[64];
+    size_t n = 0;
+    size_t first_off = rs->span_off;
+    for (size_t i = rs->span_idx;
+         i < rs->spans.size() && n < sizeof(iov) / sizeof(iov[0]); ++i) {
+      const RecipeStream::Span& sp = rs->spans[i];
+      const char* base = sp.owner != nullptr ? sp.owner->data() + sp.off
+                                             : rs->pool.data() + sp.off;
+      iov[n].iov_base = const_cast<char*>(base + first_off);
+      iov[n].iov_len = sp.len - first_off;
+      first_off = 0;
+      ++n;
+    }
+    struct msghdr msg = {};
+    msg.msg_iov = iov;
+    msg.msg_iovlen = n;
+    ssize_t sent = sendmsg(c->fd, &msg, MSG_NOSIGNAL);
+    if (sent <= 0) {
+      if (sent < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
+        return FlushResult::kBlocked;
+      if (sent < 0 && errno == EINTR) continue;
+      return FlushResult::kError;
+    }
+    size_t left = static_cast<size_t>(sent);
+    while (left > 0) {
+      RecipeStream::Span& sp = rs->spans[rs->span_idx];
+      size_t span_left = sp.len - rs->span_off;
+      if (left < span_left) {
+        rs->span_off += left;
+        left = 0;
+      } else {
+        left -= span_left;
+        sp.owner.reset();  // release the cache ref as soon as it's sent
+        rs->span_idx++;
+        rs->span_off = 0;
+      }
+    }
+  }
+  return FlushResult::kDone;
 }
 
 void StorageServer::ReadConn(Conn* c) {
@@ -1934,8 +2101,15 @@ void StorageServer::HandleFetchChunk(Conn* c) {
   std::string one;
   for (int64_t i = 0; i < count; ++i) {
     const uint8_t* e = q + 8 + i * 28;
-    if (!chunk_stores_[spi]->ReadChunk(BytesToHex(e, 20), GetInt64BE(e + 20),
-                                       &one)) {
+    std::string dig = BytesToHex(e, 20);
+    int64_t len = GetInt64BE(e + 20);
+    // Consult the hot-chunk cache (lookup only — recovery/repair sweeps
+    // must not evict client-hot chunks by populating it).
+    if (auto cached = chunk_stores_[spi]->CacheLookup(dig, len)) {
+      out += *cached;
+      continue;
+    }
+    if (!chunk_stores_[spi]->ReadChunk(dig, len, &one)) {
       Respond(c, 2 /*ENOENT*/);
       return;
     }
@@ -3266,6 +3440,18 @@ void StorageServer::HandleDownload(Conn* c) {
     Respond(c, 22);
     return;
   }
+  // Ranged request = explicit offset or byte count (the parallel
+  // client splits one file into ranges; per-replica affinity makes the
+  // read caches accumulate hits).  Counted once per request, with the
+  // bytes actually served.
+  bool ranged = offset != 0 || count != 0;
+  auto note_ranged = [&](int64_t served) {
+    if (ranged && ctr_download_ranged_requests_ != nullptr) {
+      ctr_download_ranged_requests_->fetch_add(1, std::memory_order_relaxed);
+      ctr_download_ranged_bytes_->fetch_add(served,
+                                            std::memory_order_relaxed);
+    }
+  };
   int fd = open(local.c_str(), O_RDONLY);
   if (fd >= 0) {  // flat file: sendfile
     struct stat st;
@@ -3279,6 +3465,7 @@ void StorageServer::HandleDownload(Conn* c) {
     int64_t avail = size - offset;
     if (count == 0 || count > avail) count = avail;
     stats_.success_download++;
+    note_ranged(count);
     RespondFile(c, 0, fd, offset, count);
     return;
   }
@@ -3295,16 +3482,20 @@ void StorageServer::HandleDownload(Conn* c) {
     Respond(c, access((local + ".rcp").c_str(), F_OK) == 0 ? 5 : 2);
     return;
   }
-  // Read + pin under the store mutex: a delete between a plain read and
-  // a later pin could unlink chunks this stream is about to send.
-  auto r = cs->ReadRecipeAndPin(local + ".rcp");
+  // Read + pin-per-chunk (verify under the stripe lock): a delete
+  // between a plain read and a later pin could unlink chunks this
+  // stream is about to send.  Ranged requests pin ONLY the overlapping
+  // recipe slice — a 4-range parallel download of a many-thousand-chunk
+  // file must not pay 4x full-recipe pin/unpin.
+  int64_t skip = 0;
+  auto r = cs->ReadRecipeAndPinRange(local + ".rcp", offset, count, &skip);
   if (!r.has_value()) {
     Respond(c, 2);
     return;
   }
   int64_t size = r->logical_size;
   if (offset > size) {
-    cs->UnpinRecipe(*r);
+    cs->UnpinRecipe(*r);  // empty slice: no pins were taken
     Respond(c, 22);
     return;
   }
@@ -3313,16 +3504,11 @@ void StorageServer::HandleDownload(Conn* c) {
   auto rs = std::make_unique<RecipeStream>();
   rs->cs = cs;
   rs->remaining = count;
-  int64_t skip = offset;
-  while (rs->idx < r->chunks.size() &&
-         skip >= r->chunks[rs->idx].length) {
-    skip -= r->chunks[rs->idx].length;
-    rs->idx++;
-  }
   rs->skip = skip;
   rs->recipe = std::move(*r);
   rs->pinned = true;  // pinned by ReadRecipeAndPin above
   stats_.success_download++;
+  note_ranged(count);
   LogAccess(c, 0, count);
   c->out.resize(kHeaderSize);
   PutInt64BE(count, reinterpret_cast<uint8_t*>(c->out.data()));

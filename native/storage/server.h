@@ -107,16 +107,36 @@ class StorageServer {
 
   struct NioThread;  // one epoll loop + its connections (storage_nio.c)
 
-  // Streaming source for recipe (chunked-file) downloads: chunks are read
-  // one at a time as the socket drains, so a multi-GB logical file never
-  // occupies memory or stalls the loop (the reference's dio read loop).
+  // Streaming source for recipe (chunked-file) downloads, assembled
+  // scatter-gather (the PR 5 read-path overhaul): per refill round a
+  // bounded batch of spans is staged — cache-hit spans REFERENCE the
+  // read cache's shared buffers (zero copy), cold spans pread into one
+  // pooled buffer (reused across rounds; its capacity is the only
+  // steady-state allocation) — and the whole batch flushes to the
+  // socket via one sendmsg iovec per round.  A multi-GB logical file
+  // never occupies more than one batch of memory and never stalls the
+  // loop's other connections (the reference's dio read loop).
   struct RecipeStream {
+    struct Span {
+      // Cache-hit spans hold the cache entry alive via `owner` (an
+      // eviction or invalidation mid-send cannot free the bytes);
+      // cold spans index into `pool` (offset, not pointer — the pool
+      // resizes once per round BEFORE any span is flushed).
+      std::shared_ptr<const std::string> owner;
+      size_t off = 0;   // offset into *owner or pool
+      size_t len = 0;
+    };
     Recipe recipe;
     ChunkStore* cs = nullptr;
     size_t idx = 0;          // next recipe entry
     int64_t skip = 0;        // bytes to skip inside entry `idx` (range start)
     int64_t remaining = 0;   // logical bytes still to send
     bool pinned = false;
+    std::vector<Span> spans;   // current round, [span_idx..) unsent
+    size_t span_idx = 0;
+    size_t span_off = 0;       // progress inside spans[span_idx]
+    std::string pool;          // cold-read buffer for the current round
+    bool HasPending() const { return span_idx < spans.size(); }
     // Pins (ChunkStore::PinRecipe) keep the chunks on disk while the
     // stream is in flight even if the file is deleted concurrently —
     // the POSIX open-fd guarantee flat files get from sendfile.
@@ -242,6 +262,14 @@ class StorageServer {
   void CloseConn(Conn* c);
   void ResetForNextRequest(Conn* c);
   void Respond(Conn* c, uint8_t status, const std::string& body = "");
+  // Stage the next scatter-gather batch of a recipe download (cache
+  // lookups + pooled cold preads); false => a chunk vanished mid-stream
+  // (caller aborts the connection — the header already went out).
+  bool RefillRecipeSpans(RecipeStream* rs);
+  // Flush staged spans with sendmsg; same contract as WriteConn's other
+  // stages: true = keep going / parked on EPOLLOUT, false = conn closed.
+  enum class FlushResult { kDone, kBlocked, kError };
+  FlushResult FlushRecipeSpans(Conn* c, RecipeStream* rs);
   // Error response that may leave unread request bytes: drains them (the
   // connection stays usable) and rolls back any in-flight file write.
   void RespondError(Conn* c, uint8_t status);
@@ -457,6 +485,11 @@ class StorageServer {
   std::atomic<int64_t>* ctr_ingest_recipe_uploads_ = nullptr;
   std::atomic<int64_t>* ctr_ingest_bytes_saved_wire_ = nullptr;
   std::atomic<int64_t>* ctr_ingest_fallbacks_ = nullptr;
+  // Ranged downloads (the parallel client splits a file into ranges):
+  // requests with a nonzero offset or an explicit byte count, and the
+  // bytes they actually served.
+  std::atomic<int64_t>* ctr_download_ranged_requests_ = nullptr;
+  std::atomic<int64_t>* ctr_download_ranged_bytes_ = nullptr;
   // Parked phase-1 sessions keyed by id (ingest_mu_); swept by timer.
   std::mutex ingest_mu_;
   std::unordered_map<int64_t, std::unique_ptr<UploadSession>>

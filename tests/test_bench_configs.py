@@ -60,6 +60,24 @@ def test_config6_wire_dedup_smoke(tmp_path):
     assert art["ingest_counters"]["ingest.bytes_saved_wire"] > 0
 
 
+def test_config8_read_path_smoke(tmp_path):
+    # The read-path scenario end-to-end at tiny scale: both cache modes
+    # come up, the warm pass actually HITS the 64 MB cache, the parallel
+    # arm runs, and not one downloaded byte is wrong.  (The latency
+    # ordering itself is asserted on the checked-in artifact, not here —
+    # sub-ms p50s at smoke scale are noise.)
+    bc.config8(str(tmp_path), scale=0.0005)  # ~5 MB corpus
+    with open(os.path.join(str(tmp_path), "config8.json")) as fh:
+        art = json.load(fh)
+    assert art["wrong_bytes"] == 0
+    assert art["modes"]["cache0"]["cache_hits"] == 0
+    assert art["modes"]["cache64"]["cache_hits"] > 0
+    assert art["modes"]["cache64"]["warm"]["downloads"] >= 8
+    par = art["parallel"]
+    assert par is not None and par["parallel4_GBps"] > 0
+    assert par["single_GBps"] > 0 and par["host_cpus"] >= 1
+
+
 def test_config7_scrub_overhead_smoke(tmp_path):
     # The integrity-engine overhead scenario end-to-end at tiny scale:
     # all three bandwidth modes produce latency percentiles, the

@@ -67,9 +67,18 @@ def _sample_registry() -> dict:
                      # negotiated-upload ingest accounting (PR 3)
                      "ingest.recipe_uploads": 6,
                      "ingest.bytes_saved_wire": 262144,
-                     "ingest.recipe_fallbacks": 2},
+                     "ingest.recipe_fallbacks": 2,
+                     # ranged-download traffic (PR 5 parallel client)
+                     "download.ranged_requests": 8,
+                     "download.ranged_bytes": 4194304},
         "gauges": {"server.connections": 2, "sync.peer.10.0.0.2:23000.lag_s": 7,
                    "ingest.sessions_active": 1,
+                   # hot-chunk read cache (PR 5): hit/miss/eviction flow
+                   # and resident size vs capacity
+                   "cache.hits": 120, "cache.misses": 30,
+                   "cache.evictions": 4, "cache.invalidations": 2,
+                   "cache.bytes": 1048576, "cache.chunks": 16,
+                   "cache.capacity_bytes": 67108864,
                    # tracing health (PR 2): ring throughput/overwrite
                    # pressure and the slow-request gate
                    "trace.spans_recorded": 12, "trace.spans_dropped": 3,
@@ -203,6 +212,18 @@ def test_prometheus_exposition_parses():
     assert series["fdfs_scrub_corrupt_unrepairable"][0][1] == 1.0
     assert series["fdfs_scrub_quarantined"][0][1] == 1.0
     assert series["fdfs_scrub_bytes_reclaimed"][0][1] == 73728.0
+    # Read-path golden (PR 5): cache effectiveness and ranged-download
+    # traffic export per-storage so dashboards can chart hit ratios and
+    # parallel-client adoption.
+    assert series["fdfs_cache_hits"][0] == (
+        '{storage="127.0.0.1:23000"}', 120.0)
+    assert series["fdfs_cache_misses"][0][1] == 30.0
+    assert series["fdfs_cache_evictions"][0][1] == 4.0
+    assert series["fdfs_cache_invalidations"][0][1] == 2.0
+    assert series["fdfs_cache_bytes"][0][1] == 1048576.0
+    assert series["fdfs_cache_capacity_bytes"][0][1] == 67108864.0
+    assert series["fdfs_download_ranged_requests"][0][1] == 8.0
+    assert series["fdfs_download_ranged_bytes"][0][1] == 4194304.0
     buckets = series["fdfs_op_upload_file_latency_us_bucket"]
     values = [v for _, v in buckets]
     assert values == sorted(values), "histogram buckets must be cumulative"

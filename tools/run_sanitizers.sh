@@ -18,7 +18,8 @@ else
     tests/test_replication.py tests/test_trunk.py
     tests/test_chunked_storage.py tests/test_disk_recovery.py
     tests/test_multi_tracker.py tests/test_trace.py
-    tests/test_dedup_upload.py tests/test_scrub.py)
+    tests/test_dedup_upload.py tests/test_scrub.py
+    tests/test_read_path.py)
 fi
 
 run_one() {
@@ -32,6 +33,12 @@ run_one() {
   # from 4 recorders + a dumping reader — the TSan run is the proof the
   # seqlock-free design is data-race-free, not just lucky.
   "$dir/common_test"
+  # storage_test's TestChunkStoreStripedConcurrency hammers the
+  # digest-striped chunk store + hot-chunk read cache from concurrent
+  # uploaders/deleters, cached readers, pin sessions, and a
+  # quarantine/GC sweeper — the TSan proof of the PR 5 lock sharding
+  # and cache-coherence invariants.
+  "$dir/storage_test"
   echo "=== $san: daemon suite ==="
   # halt_on_error keeps a failing daemon loud; leak detection stays on
   # for asan (daemons shut down cleanly in the harness).
