@@ -63,6 +63,7 @@ def _bench_tpu() -> dict:
     chunks = rng.randint(0, 256, size=(N_CHUNKS, L), dtype=np.uint8)
     lens = np.full(N_CHUNKS, L, dtype=np.int32)
 
+    t_gen = time.perf_counter()
     dev_chunks = jax.device_put(chunks)
     dev_lens = jax.device_put(lens)
     jax.block_until_ready((dev_chunks, dev_lens))
@@ -72,7 +73,9 @@ def _bench_tpu() -> dict:
         return sha1_batch_pallas(c, ln, L), minhash_batch_pallas(c, ln)
 
     # warmup/compile (and force one full execution)
+    t_warm = time.perf_counter()
     jax.device_get(step(dev_chunks, dev_lens))
+    t_measure = time.perf_counter()
 
     rates = []
     t_total = 0.0
@@ -104,6 +107,15 @@ def _bench_tpu() -> dict:
         },
         "contended": contended,
         "contention_rule": f"(max-min)/median > {CONTENTION_SPREAD}",
+        # Evidence trail (ISSUE 6 satellite): per-phase wall-times, so a
+        # regressed headline number says WHERE the time moved (device
+        # transfer? compile? the measured loop itself?) instead of
+        # arriving as a bare rate.
+        "phase_wall_s": {
+            "device_put": round(t_warm - t_gen, 3),
+            "warmup_compile": round(t_measure - t_warm, 3),
+            "measure": round(time.perf_counter() - t_measure, 3),
+        },
     }
     if contended:
         # Steady-state estimate when the capture straddled a contention
@@ -221,7 +233,10 @@ def main() -> None:
             "value": None,
         }))
         return
+    t_cpu = time.perf_counter()
     cpu_gbps = _bench_cpu()
+    tpu["phase_wall_s"]["cpu_baseline"] = round(
+        time.perf_counter() - t_cpu, 3)
     print(json.dumps({
         "metric": "dedup_ingest_GBps_per_chip",
         "unit": "GB/s",

@@ -301,6 +301,46 @@ def _with_sidecar(run_fn):
         shutil.rmtree(sc_tmp, ignore_errors=True)
 
 
+_EVIDENCE_PREFIXES = ("op.", "nio.", "dio.", "cache.", "ingest.", "scrub.",
+                      "sync.", "store.", "events.", "download.")
+
+
+def _stats_evidence(cli) -> dict:
+    """Per-storage registry snapshot for the artifact evidence trail
+    (ISSUE 6 satellite): counters/gauges under the diagnostic prefixes
+    plus compact histogram summaries (count/sum), keyed by node addr.
+    Captured BEFORE and AFTER each measured phase, a regressed headline
+    number ships its daemon-side context — queue waits, cache flow,
+    dedup/scrub activity — instead of arriving as a bare rate (the
+    r03→r04 ingest-drop lesson).  Best-effort: a dead node is an error
+    entry, never a crashed bench."""
+    from fastdfs_tpu.client.client import StorageClient
+
+    out: dict = {}
+    try:
+        rows = _storage_rows(cli)
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)}
+    for r in rows:
+        addr = f"{r['ip']}:{r['port']}"
+        try:
+            with StorageClient(r["ip"], r["port"]) as sc:
+                reg = sc.stat()
+        except Exception as e:  # noqa: BLE001
+            out[addr] = {"error": str(e)}
+            continue
+        ev = {k: v for k, v in reg.get("counters", {}).items()
+              if k.startswith(_EVIDENCE_PREFIXES) and v}
+        ev.update({k: v for k, v in reg.get("gauges", {}).items()
+                   if k.startswith(_EVIDENCE_PREFIXES) and v})
+        for name, h in reg.get("histograms", {}).items():
+            if h.get("count"):
+                ev[name + ".count"] = h["count"]
+                ev[name + ".sum"] = h["sum"]
+        out[addr] = ev
+    return out
+
+
 def _stop(tr, sts):
     for s in sts:
         s.stop()
@@ -359,15 +399,23 @@ def config1(out_dir: str, scale: float) -> None:
         taddr = f"127.0.0.1:{tr.port}"
         threads = 4
         results = {}
+        evidence = {"before": _stats_evidence(cli)}
+        phase_wall = {}
         # upload phase: every payload uploaded ~twice (n//2 distinct)
         up_res = os.path.join(tmp, "up.result")
+        t_up = time.perf_counter()
         subprocess.run([load, "upload", taddr, str(n), str(piece),
                         str(threads), up_res, str(max(n // 2, 1))],
                        check=True)
+        phase_wall["upload"] = round(time.perf_counter() - t_up, 3)
+        evidence["after_upload"] = _stats_evidence(cli)
         # download phase: read the whole corpus back once
         down_res = os.path.join(tmp, "down.result")
+        t_down = time.perf_counter()
         subprocess.run([load, "download", taddr, up_res + ".ids", str(n),
                         str(threads), down_res], check=True)
+        phase_wall["download"] = round(time.perf_counter() - t_down, 3)
+        evidence["after"] = _stats_evidence(cli)
         for phase, res in (("upload", up_res), ("download", down_res)):
             out = subprocess.run([load, "combine", res],
                                  stdout=subprocess.PIPE, check=True).stdout
@@ -398,6 +446,8 @@ def config1(out_dir: str, scale: float) -> None:
             "dedup_bytes_saved": saved,
             "upload_stages": table.get("upload"),
             "download_stages": table.get("download"),
+            "phase_wall_s": phase_wall,
+            "daemon_stats": evidence,
         })
     finally:
         if tr is not None:
@@ -503,10 +553,12 @@ def _daemon_ingest(docs: list[bytes], dedup_mode: str, sidecar_sock: str = "",
             c.close()
             return done
 
+        evidence = {"before": _stats_evidence(cli)}
         t0 = time.perf_counter()
         with concurrent.futures.ThreadPoolExecutor(workers) as ex:
             sent = sum(ex.map(feed, range(workers)))
         dt = time.perf_counter() - t0
+        evidence["after"] = _stats_evidence(cli)
         saved = _settled_saved(cli)
         base = os.path.join(tmp, "st0")
         _stop(tr, sts)  # flush + close the access log before reading it
@@ -522,6 +574,8 @@ def _daemon_ingest(docs: list[bytes], dedup_mode: str, sidecar_sock: str = "",
             "dedup_bytes_saved": saved,
             "dedup_ratio": round(saved / sent, 4) if sent else 0.0,
             "upload_stages": table.get("upload"),
+            "phase_wall_s": {"ingest": round(dt, 3)},
+            "daemon_stats": evidence,
         }
     finally:
         if tr is not None:
@@ -616,6 +670,7 @@ def _config3_run(files: list[bytes], dedup_mode: str,
             if groups and groups[0]["active"] == 2:
                 break
             time.sleep(0.5)
+        evidence = {"before": _stats_evidence(cli)}
         t0 = time.perf_counter()
         fids = []
         sent = 0
@@ -623,6 +678,7 @@ def _config3_run(files: list[bytes], dedup_mode: str,
             fids.append(cli.upload_buffer(f, ext="bin"))
             sent += len(f)
         ingest_dt = time.perf_counter() - t0
+        evidence["after_ingest"] = _stats_evidence(cli)
         # wait for full replication (2 replicas per file)
         deadline = time.time() + 300
         while time.time() < deadline:
@@ -630,6 +686,7 @@ def _config3_run(files: list[bytes], dedup_mode: str,
                 break
             time.sleep(0.5)
         repl_dt = time.perf_counter() - t0
+        evidence["after"] = _stats_evidence(cli)
         _settled_saved(cli)
         rows = _storage_rows(cli)
         bases = [os.path.join(tmp, "st0"), os.path.join(tmp, "st1")]
@@ -657,6 +714,9 @@ def _config3_run(files: list[bytes], dedup_mode: str,
             "upload_stages_per_node": [tb.get("upload") for tb in tables],
             "sync_create_stages_per_node": [tb.get("sync_create")
                                             for tb in tables],
+            "phase_wall_s": {"ingest": round(ingest_dt, 3),
+                             "replication": round(repl_dt - ingest_dt, 3)},
+            "daemon_stats": evidence,
         }
     finally:
         if tr is not None:
@@ -1052,9 +1112,11 @@ def config6(out_dir: str, scale: float) -> None:
                     "saved_ratio": round(1 - sent / logical, 4),
                     "seconds": round(time.time() - t0, 3)}
 
+        evidence = {"before": _stats_evidence(cli)}
         cold = run_pass(corpus)
         warm = run_pass(corpus)
         part = run_pass(edited)
+        evidence["after"] = _stats_evidence(cli)
 
         from fastdfs_tpu.client.client import StorageClient
         with StorageClient(sts[0].ip, sts[0].port) as sc:
@@ -1077,6 +1139,9 @@ def config6(out_dir: str, scale: float) -> None:
         "cold": cold, "warm": warm, "edited": part,
         "warm_saved_ratio": warm["saved_ratio"],
         "ingest_counters": ingest,
+        "phase_wall_s": {"cold": cold["seconds"], "warm": warm["seconds"],
+                         "edited": part["seconds"]},
+        "daemon_stats": evidence,
         "warm_pass_ok": warm["saved_ratio"] > 0.9,
     })
 
@@ -1128,10 +1193,14 @@ def config7(out_dir: str, scale: float) -> None:
         sts[0] = st
         try:
             _upload_retry(cli, b"warmup " * 64)
+            t_pre = time.perf_counter()
             for data in preload:
                 cli.upload_buffer(data, ext="bin")
+            preload_s = round(time.perf_counter() - t_pre, 3)
+            evidence = {"before": _stats_evidence(cli)}
             up_lat, down_lat = [], []
             fid = cli.upload_buffer(preload[0][: blob // 2], ext="bin")
+            t_meas = time.perf_counter()
             t_end = time.time() + max(3.0, n_ops * 0.05)
             i = 0
             while time.time() < t_end or i < n_ops:
@@ -1146,6 +1215,8 @@ def config7(out_dir: str, scale: float) -> None:
                 cli.delete_file(f)
                 i += 1
             cli.download_to_buffer(fid)
+            measure_s = round(time.perf_counter() - t_meas, 3)
+            evidence["after"] = _stats_evidence(cli)
             scrub = cli.scrub_status(st.ip, st.port)
         finally:
             cli.close()
@@ -1163,6 +1234,8 @@ def config7(out_dir: str, scale: float) -> None:
             "chunks_verified": scrub["chunks_verified"],
             "bytes_verified": scrub["bytes_verified"],
             "chunks_corrupt": scrub["chunks_corrupt"],
+            "phase_wall_s": {"preload": preload_s, "measure": measure_s},
+            "daemon_stats": evidence,
         }
 
     emit(out_dir, 7, {
@@ -1236,16 +1309,21 @@ def config8(out_dir: str, scale: float) -> None:
         try:
             _upload_retry(cli, b"warmup " * 64)
             fids = [cli.upload_buffer(data, ext="bin") for data in corpus]
+            evidence = {"before": _stats_evidence(cli)}
             passes = {}
+            phase_wall = {}
             with StorageClient(st.ip, st.port) as sc:
                 for pass_name in ("cold", "warm"):
                     lat = []
+                    t_pass = time.perf_counter()
                     for fid, data in zip(fids, corpus):
                         t0 = time.perf_counter()
                         got = sc.download_to_buffer(fid)
                         lat.append(time.perf_counter() - t0)
                         if got != data:
                             wrong_bytes += 1
+                    phase_wall[pass_name] = round(
+                        time.perf_counter() - t_pass, 3)
                     passes[pass_name] = {
                         "downloads": len(lat),
                         "p50_ms": round(pct(lat, 0.50) * 1e3, 3),
@@ -1254,8 +1332,11 @@ def config8(out_dir: str, scale: float) -> None:
                                       / 1e9, 4),
                     }
                 g = sc.stat()["gauges"]
+            evidence["after"] = _stats_evidence(cli)
             results[name] = {
                 **passes,
+                "phase_wall_s": phase_wall,
+                "daemon_stats": evidence,
                 "cache_hits": g["cache.hits"],
                 "cache_misses": g["cache.misses"],
                 "cache_bytes": g["cache.bytes"],

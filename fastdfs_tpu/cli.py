@@ -131,6 +131,72 @@ def cmd_monitor(c: FdfsClient, args: list[str]) -> int:
     return 0
 
 
+def cmd_top(c: FdfsClient, args: list[str]) -> int:
+    """Live cluster saturation dashboard (fdfs_top): polls STAT +
+    SERVER_CLUSTER_STAT + EVENT_DUMP across every node on an interval,
+    computes delta RATES (ops/s, MB/s, cache hit %, nio loop-lag p99,
+    dio queue-wait p99 from histogram deltas), and renders a refreshing
+    per-node table plus a scrolling recent-events pane — the operator
+    console the load harness runs against.
+
+    Flags: --interval s   poll cadence (default 2)
+           --count N      render N frames then exit (0 = forever;
+                          scripts and tests use this)
+           --group <name> limit the storage rows to one group
+           --events N     events-pane depth (default 10)
+           --json         one machine-readable JSON object per frame
+                          instead of the table
+           --no-clear     never emit the ANSI clear (append frames)
+    """
+    import time as _time
+
+    from fastdfs_tpu import monitor as M
+
+    def flag(name, default=None):
+        if name in args:
+            i = args.index(name)
+            if i + 1 < len(args) and not args[i + 1].startswith("--"):
+                return args[i + 1]
+        return default
+
+    interval = float(flag("--interval", "2"))
+    count = int(flag("--count", "0"))
+    group = flag("--group")
+    max_events = int(flag("--events", "10"))
+    as_json = "--json" in args
+    clear = "--no-clear" not in args and not as_json and sys.stdout.isatty()
+
+    seen_seq: dict[str, int] = {}
+    recent: list[M.ClusterEvent] = []
+    prev = None
+    frames = 0
+    try:
+        while True:
+            cur = M.gather_top(c, group=group, seen_seq=seen_seq)
+            rates = M.top_rates(prev, cur)
+            recent.extend(sorted(cur.events, key=lambda e: e.ts_us))
+            del recent[:-200]  # bounded scrollback
+            if as_json:
+                print(json.dumps({
+                    "ts": cur.ts,
+                    "nodes": rates,
+                    "events": [vars(e) for e in cur.events],
+                }, sort_keys=True), flush=True)
+            else:
+                frame = M.render_top(cur, rates, recent, max_events)
+                if clear:
+                    print("\x1b[2J\x1b[H" + frame, flush=True)
+                else:
+                    print(frame, flush=True)
+            prev = cur
+            frames += 1
+            if count and frames >= count:
+                return 0
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_test(c: FdfsClient, args: list[str]) -> int:
     """Full-API smoke (fdfs_test.c): upload + metadata + query + download +
     delete."""
@@ -389,6 +455,7 @@ TOOLS = {
     "delete": cmd_delete,
     "file_info": cmd_file_info,
     "monitor": cmd_monitor,
+    "top": cmd_top,
     "test": cmd_test,
     "groups_json": cmd_groups_json,
     "append": cmd_append,

@@ -433,6 +433,11 @@ class FdfsClient:
         with self._storage(FetchTarget(ip=ip, port=port)) as s:
             return s.stat()
 
+    def storage_events(self, ip: str, port: int) -> dict:
+        """One storage daemon's flight-recorder dump (EVENT_DUMP)."""
+        with self._storage(FetchTarget(ip=ip, port=port)) as s:
+            return s.event_dump()
+
     def scrub_status(self, ip: str, port: int) -> dict[str, int]:
         """One storage daemon's integrity-engine status (SCRUB_STATUS)."""
         with self._storage(FetchTarget(ip=ip, port=port)) as s:

@@ -454,6 +454,14 @@ class StorageClient:
         self.conn.send_request(StorageCmd.TRACE_DUMP)
         return json.loads(self.conn.recv_response("trace_dump") or b"{}")
 
+    def event_dump(self) -> dict:
+        """Flight-recorder dump (EVENT_DUMP 137): this daemon's retained
+        structured cluster events (quarantines, GC sweeps, session
+        expiries, stalls, slow requests).  Shape per
+        fastdfs_tpu.monitor.decode_events."""
+        self.conn.send_request(StorageCmd.EVENT_DUMP)
+        return json.loads(self.conn.recv_response("event_dump") or b"{}")
+
     def scrub_status(self) -> dict[str, int]:
         """Integrity-engine status (SCRUB_STATUS 134): named scrub/GC
         counters decoded from the fixed int64 blob (SCRUB_STAT_FIELDS).

@@ -201,6 +201,19 @@ class TrackerClient:
         self.conn.send_request(TrackerCmd.TRACE_DUMP)
         return json.loads(self.conn.recv_response("trace_dump") or b"{}")
 
+    def stat(self) -> dict:
+        """The tracker's own stats-registry snapshot (STAT 97): event-loop
+        lag, dispatched ops, request accounting.  Same JSON contract as
+        the storage STAT (fastdfs_tpu.monitor.decode_registry)."""
+        self.conn.send_request(TrackerCmd.STAT)
+        return json.loads(self.conn.recv_response("stat") or b"{}")
+
+    def event_dump(self) -> dict:
+        """Flight-recorder dump (EVENT_DUMP 98): membership transitions
+        and slow requests.  Shape per fastdfs_tpu.monitor.decode_events."""
+        self.conn.send_request(TrackerCmd.EVENT_DUMP)
+        return json.loads(self.conn.recv_response("event_dump") or b"{}")
+
     def get_tracker_status(self) -> dict:
         """Multi-tracker relationship probe (TRACKER_GET_STATUS 70):
         whether this tracker is the leader and who it believes leads."""

@@ -204,6 +204,16 @@ class TrackerCmd(enum.IntEnum):
     # body -> JSON; shape per fastdfs_tpu.trace.decode_dump, covered by
     # the fdfs_codec trace-json cross-language golden).
     TRACE_DUMP = 96
+    # fastdfs_tpu extension: the tracker's own stats-registry snapshot
+    # (empty body -> the same {"counters","gauges","histograms"} JSON
+    # contract as StorageCmd.STAT) — event-loop lag, dispatched ops,
+    # request accounting.  `fdfs_top` polls this for the tracker row.
+    STAT = 97
+    # fastdfs_tpu extension: flight-recorder dump (empty body -> JSON
+    # {"role","port","events":[...]}; shape per
+    # fastdfs_tpu.monitor.decode_events, pinned by the fdfs_codec
+    # event-json cross-language golden).
+    EVENT_DUMP = 98
 
     # client -> tracker (service queries; reference: tracker_deal_service_query_*)
     SERVICE_QUERY_STORE_WITHOUT_GROUP_ONE = 101
@@ -373,6 +383,16 @@ class StorageCmd(enum.IntEnum):
     # 1 = mismatch).  The daemon falls back to its serial host SHA1 when
     # the sidecar is unreachable — scrubbing never blocks on the TPU.
     DEDUP_VERIFY = 136
+    # Flight-recorder dump (fastdfs_tpu extension): empty body -> JSON
+    # {"role","port","events":[{"seq","ts_us","severity","type","key",
+    # "detail"}]} — the daemon's bounded ring of structured cluster
+    # events (chunk quarantined/repaired/healed, GC sweeps, upload-
+    # session expiry, dedup fallbacks, replication stalls, slow
+    # requests, config anomalies).  Shape per
+    # fastdfs_tpu.monitor.decode_events; pinned by the fdfs_codec
+    # event-json cross-language golden.  Same contract as
+    # TrackerCmd.EVENT_DUMP.
+    EVENT_DUMP = 137
     # Trace-context prefix frame (same value as TrackerCmd.TRACE_CTX).
     TRACE_CTX = 140
     # Ranked near-dup report for a stored file, answered from the

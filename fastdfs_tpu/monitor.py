@@ -21,6 +21,7 @@ last = overflow; ``bounds`` are inclusive upper bounds.
 from __future__ import annotations
 
 import re
+import time
 from dataclasses import dataclass, field
 
 from fastdfs_tpu.common.protocol import BEAT_STAT_COUNT, BEAT_STAT_FIELDS
@@ -106,6 +107,280 @@ def gather(client, with_storage_stats: bool = True,
             except Exception as e:  # noqa: BLE001 — record, keep going
                 snap.storage_errors[addr] = f"{type(e).__name__}: {e}"
     return snap
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder decoding (EVENT_DUMP; native/common/eventlog.h)
+# ---------------------------------------------------------------------------
+
+_EVENT_SEVERITIES = ("info", "warn", "error")
+
+
+@dataclass(frozen=True)
+class ClusterEvent:
+    """One structured flight-recorder event."""
+    seq: int
+    ts_us: int
+    severity: str
+    type: str
+    key: str
+    detail: str
+    node: str = ""  # "role addr" of the daemon that recorded it
+
+
+def decode_events(obj: dict, node: str = "") -> list[ClusterEvent]:
+    """Validate and decode one daemon's EVENT_DUMP JSON.
+
+    Raises ValueError on shape violations so a truncated or foreign
+    payload fails loudly (same discipline as decode_registry).  Unknown
+    extra keys on an event are ignored — the wire contract is
+    append-only."""
+    if not isinstance(obj, dict) or not isinstance(obj.get("events"), list):
+        raise ValueError(f"event dump must have an events list: {obj!r}")
+    if node == "":
+        node = f"{obj.get('role', '')}:{obj.get('port', '')}"
+    out: list[ClusterEvent] = []
+    for e in obj["events"]:
+        try:
+            sev = str(e["severity"])
+            if sev not in _EVENT_SEVERITIES:
+                raise ValueError(f"unknown severity {sev!r}")
+            out.append(ClusterEvent(
+                seq=int(e["seq"]), ts_us=int(e["ts_us"]), severity=sev,
+                type=str(e["type"]), key=str(e["key"]),
+                detail=str(e.get("detail", "")), node=node))
+        except (KeyError, TypeError, ValueError) as err:
+            raise ValueError(f"malformed event {e!r}: {err}") from None
+    return out
+
+
+# ---------------------------------------------------------------------------
+# histogram delta quantiles (the fdfs_top math)
+# ---------------------------------------------------------------------------
+
+def hist_delta(prev: dict | None, cur: dict) -> dict:
+    """Bucket-wise delta of two registry histogram snapshots of the same
+    metric — the distribution of observations BETWEEN the two polls.
+    prev=None (first poll, or the daemon restarted and counts went
+    backwards) returns cur unchanged."""
+    if (prev is None or prev.get("bounds") != cur.get("bounds")
+            or prev.get("count", 0) > cur.get("count", 0)):
+        return cur
+    return {
+        "bounds": cur["bounds"],
+        "counts": [c - p for p, c in zip(prev["counts"], cur["counts"])],
+        "sum": cur["sum"] - prev["sum"],
+        "count": cur["count"] - prev["count"],
+    }
+
+
+def hist_quantile(h: dict, q: float) -> float | None:
+    """Upper-bound estimate of quantile ``q`` from a (delta) histogram:
+    the inclusive upper bound of the bucket the quantile falls in.
+    None when the histogram saw no observations; +inf when it falls in
+    the overflow bucket (beyond the last bound)."""
+    total = h.get("count", 0)
+    if total <= 0:
+        return None
+    rank = q * total
+    seen = 0
+    for bound, cnt in zip(h["bounds"], h["counts"]):
+        seen += cnt
+        if seen >= rank:
+            return float(bound)
+    return float("inf")
+
+
+# ---------------------------------------------------------------------------
+# fdfs_top: sampling, delta rates, rendering
+# ---------------------------------------------------------------------------
+
+@dataclass
+class NodeSample:
+    role: str                    # "tracker" | "storage"
+    addr: str                    # "ip:port"
+    registry: dict | None = None
+    error: str = ""
+
+
+@dataclass
+class TopSample:
+    """One fdfs_top poll: every node's registry + the merged new events."""
+    ts: float = 0.0
+    nodes: dict[str, NodeSample] = field(default_factory=dict)
+    events: list[ClusterEvent] = field(default_factory=list)
+    cluster: dict = field(default_factory=dict)
+
+
+def gather_top(client, group: str | None = None,
+               seen_seq: dict[str, int] | None = None) -> TopSample:
+    """Poll STAT + EVENT_DUMP across the cluster (trackers from the
+    client's config, storages from SERVER_CLUSTER_STAT).  Best-effort
+    like gather(): a dead node becomes a row with an error, never an
+    exception.  ``seen_seq`` (mutated in place) maps node -> last event
+    seq already consumed, so only NEW events land in the sample."""
+    from fastdfs_tpu.client.storage_client import StorageClient
+    from fastdfs_tpu.client.tracker_client import TrackerClient
+
+    if seen_seq is None:
+        seen_seq = {}
+    out = TopSample(ts=time.time())
+
+    def take_events(node: str, dump: dict) -> None:
+        evs = decode_events(dump, node)
+        last = seen_seq.get(node, 0)
+        fresh = [e for e in evs if e.seq > last]
+        if evs:
+            seen_seq[node] = max(e.seq for e in evs)
+        out.events.extend(fresh)
+
+    storages: list[tuple[str, int]] = []
+    for host, port in client.trackers:
+        addr = f"{host}:{port}"
+        node = NodeSample(role="tracker", addr=addr)
+        try:
+            with TrackerClient(host, port, client.timeout) as tc:
+                node.registry = decode_registry(tc.stat())
+                take_events(f"tracker {addr}", tc.event_dump())
+                if not out.cluster:
+                    out.cluster = tc.cluster_stat(group)
+                    for g in out.cluster.get("groups", []):
+                        for s in g.get("storages", []):
+                            storages.append((s["ip"], s["port"]))
+        except Exception as e:  # noqa: BLE001 — a dead node is a row
+            node.error = f"{type(e).__name__}: {e}"
+        out.nodes[f"tracker {addr}"] = node
+    for ip, port in sorted(set(storages)):
+        addr = f"{ip}:{port}"
+        node = NodeSample(role="storage", addr=addr)
+        try:
+            with StorageClient(ip, port, client.timeout) as sc:
+                node.registry = decode_registry(sc.stat())
+                take_events(f"storage {addr}", sc.event_dump())
+        except Exception as e:  # noqa: BLE001
+            node.error = f"{type(e).__name__}: {e}"
+        out.nodes[f"storage {addr}"] = node
+    return out
+
+
+def _counter_sum(reg: dict, pattern: re.Pattern) -> int:
+    return sum(v for name, v in reg["counters"].items()
+               if pattern.fullmatch(name))
+
+
+_OP_COUNT_RE = re.compile(r"op\.\w+\.count")
+_OP_ERROR_RE = re.compile(r"op\.\w+\.errors")
+
+
+def top_rates(prev: TopSample | None, cur: TopSample) -> dict[str, dict]:
+    """Per-node delta rates between two polls: ops/s, err/s, MB/s in and
+    out, cache hit %, loop-lag p99 and dio queue-wait p99 (µs, from
+    histogram deltas), plus instantaneous queue depth and connections.
+    With prev=None (first frame) every rate reads 0 — the gauges and
+    quantiles of the lifetime histograms still render."""
+    dt = max(cur.ts - prev.ts, 1e-3) if prev is not None else None
+    out: dict[str, dict] = {}
+    for node, s in cur.nodes.items():
+        if s.registry is None:
+            out[node] = {"error": s.error}
+            continue
+        reg = s.registry
+        p = prev.nodes.get(node) if prev is not None else None
+        preg = p.registry if p is not None and p.registry is not None else None
+
+        def counters(r): return r["counters"]
+        def gauge(r, name): return r["gauges"].get(name, 0)
+
+        def crate(cur_v: int, prev_v: int) -> float:
+            if dt is None or cur_v < prev_v:  # first frame / restart
+                return 0.0
+            return (cur_v - prev_v) / dt
+
+        if s.role == "tracker":
+            ops = counters(reg).get("server.requests", 0)
+            errs = counters(reg).get("server.errors", 0)
+            pops = counters(preg).get("server.requests", 0) if preg else 0
+            perrs = counters(preg).get("server.errors", 0) if preg else 0
+            up = down = pup = pdown = 0
+            hits = misses = phits = pmisses = 0
+        else:
+            ops = _counter_sum(reg, _OP_COUNT_RE)
+            errs = _counter_sum(reg, _OP_ERROR_RE)
+            pops = _counter_sum(preg, _OP_COUNT_RE) if preg else 0
+            perrs = _counter_sum(preg, _OP_ERROR_RE) if preg else 0
+            up, down = gauge(reg, "store.bytes_uploaded"), gauge(
+                reg, "store.bytes_downloaded")
+            pup = gauge(preg, "store.bytes_uploaded") if preg else 0
+            pdown = gauge(preg, "store.bytes_downloaded") if preg else 0
+            hits, misses = gauge(reg, "cache.hits"), gauge(reg, "cache.misses")
+            phits = gauge(preg, "cache.hits") if preg else 0
+            pmisses = gauge(preg, "cache.misses") if preg else 0
+
+        dh, dm = max(hits - phits, 0), max(misses - pmisses, 0)
+        lag = reg["histograms"].get("nio.loop_lag_us")
+        dio = reg["histograms"].get("dio.queue_wait_us")
+        plag = preg["histograms"].get("nio.loop_lag_us") if preg else None
+        pdio = preg["histograms"].get("dio.queue_wait_us") if preg else None
+        out[node] = {
+            "role": s.role,
+            "ops_s": round(crate(ops, pops), 1),
+            "err_s": round(crate(errs, perrs), 1),
+            "in_mb_s": round(crate(up, pup) / 1e6, 2),
+            "out_mb_s": round(crate(down, pdown) / 1e6, 2),
+            "cache_hit_pct": (round(100.0 * dh / (dh + dm), 1)
+                              if dh + dm > 0 else None),
+            "loop_p99_us": (hist_quantile(hist_delta(plag, lag), 0.99)
+                            if lag else None),
+            "dio_wait_p99_us": (hist_quantile(hist_delta(pdio, dio), 0.99)
+                                if dio else None),
+            "dio_depth": reg["gauges"].get("dio.queue_depth"),
+            "conns": reg["gauges"].get("nio.conns_active", 0),
+        }
+    return out
+
+
+def _fmt_us(v: float | None) -> str:
+    if v is None:
+        return "-"
+    if v == float("inf"):
+        return ">10s"
+    if v >= 1e6:
+        return f"{v / 1e6:.1f}s"
+    if v >= 1000:
+        return f"{v / 1000:.1f}ms"
+    return f"{v:.0f}us"
+
+
+def render_top(cur: TopSample, rates: dict[str, dict],
+               recent_events: list[ClusterEvent],
+               max_events: int = 10) -> str:
+    """The fdfs_top frame: a per-node saturation table + the scrolling
+    recent-events pane.  Pure string building so tests (and --json
+    consumers) can drive it headless."""
+    cols = (f"{'node':<32} {'ops/s':>8} {'err/s':>6} {'in MB/s':>8} "
+            f"{'out MB/s':>8} {'hit%':>6} {'loop p99':>9} {'dio p99':>9} "
+            f"{'depth':>5} {'conns':>5}")
+    lines = [time.strftime("fdfs_top  %H:%M:%S", time.localtime(cur.ts)),
+             cols, "-" * len(cols)]
+    for node, r in rates.items():
+        if "error" in r and "role" not in r:
+            lines.append(f"{node:<32} DOWN: {r['error']}")
+            continue
+        hit = "-" if r["cache_hit_pct"] is None else f"{r['cache_hit_pct']}"
+        depth = "-" if r["dio_depth"] is None else str(r["dio_depth"])
+        lines.append(
+            f"{node:<32} {r['ops_s']:>8} {r['err_s']:>6} {r['in_mb_s']:>8} "
+            f"{r['out_mb_s']:>8} {hit:>6} {_fmt_us(r['loop_p99_us']):>9} "
+            f"{_fmt_us(r['dio_wait_p99_us']):>9} {depth:>5} {r['conns']:>5}")
+    lines.append("")
+    lines.append(f"recent events (last {max_events}):")
+    for e in recent_events[-max_events:]:
+        ts = time.strftime("%H:%M:%S", time.localtime(e.ts_us / 1e6))
+        lines.append(f"  {ts} {e.severity.upper():<5} [{e.node}] "
+                     f"{e.type} {e.key} {e.detail}".rstrip())
+    if not recent_events:
+        lines.append("  (none)")
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,103 @@
+#include "common/eventlog.h"
+
+#include <algorithm>
+#include <vector>
+
+#include "common/trace.h"  // TraceWallUs: events share the span clock
+
+namespace fdfs {
+
+const char* EventSeverityName(uint8_t sev) {
+  switch (static_cast<EventSeverity>(sev)) {
+    case EventSeverity::kWarn: return "warn";
+    case EventSeverity::kError: return "error";
+    case EventSeverity::kInfo: default: return "info";
+  }
+}
+
+EventLog::EventLog(size_t capacity)
+    : cap_(capacity == 0 ? 1 : capacity), slots_(new Slot[cap_]) {}
+
+void EventLog::Record(EventSeverity sev, const char* type,
+                      const std::string& key, const std::string& detail) {
+  // seq doubles as the slot claim: head_ never resets, so a poller can
+  // dedup across dumps by remembering the last seq it rendered.
+  uint64_t seq = head_.fetch_add(1);
+  Slot* slot = &slots_[static_cast<size_t>(seq % cap_)];
+  ClusterEvent ev;
+  ev.seq = seq + 1;  // 1-based: "seq 0" never appears, simplifying dedup
+  ev.ts_us = TraceWallUs();
+  ev.severity = static_cast<uint8_t>(sev);
+  ev.SetType(type);
+  ev.SetKey(key.c_str());
+  ev.SetDetail(detail.c_str());
+  LockSlot(slot);
+  slot->ev = ev;
+  slot->used = true;
+  UnlockSlot(slot);
+  recorded_.fetch_add(1, std::memory_order_relaxed);
+}
+
+namespace {
+
+void AppendJsonString(std::string* out, const char* s) {
+  out->push_back('"');
+  for (; *s; ++s) {
+    char ch = *s;
+    switch (ch) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(ch) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", ch & 0xFF);
+          *out += buf;
+        } else {
+          out->push_back(ch);
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+}  // namespace
+
+std::string EventLog::Json(const std::string& role, int port) const {
+  std::vector<ClusterEvent> evs;
+  evs.reserve(cap_);
+  for (size_t i = 0; i < cap_; ++i) {
+    Slot* slot = &slots_[i];
+    LockSlot(slot);
+    if (slot->used) evs.push_back(slot->ev);
+    UnlockSlot(slot);
+  }
+  std::sort(evs.begin(), evs.end(),
+            [](const ClusterEvent& a, const ClusterEvent& b) {
+              return a.seq < b.seq;
+            });
+  std::string out = "{\"role\":";
+  AppendJsonString(&out, role.c_str());
+  out += ",\"port\":" + std::to_string(port) + ",\"events\":[";
+  bool first = true;
+  for (const ClusterEvent& ev : evs) {
+    if (!first) out += ",";
+    first = false;
+    out += "{\"seq\":" + std::to_string(ev.seq) +
+           ",\"ts_us\":" + std::to_string(ev.ts_us) + ",\"severity\":";
+    AppendJsonString(&out, EventSeverityName(ev.severity));
+    out += ",\"type\":";
+    AppendJsonString(&out, ev.type);
+    out += ",\"key\":";
+    AppendJsonString(&out, ev.key);
+    out += ",\"detail\":";
+    AppendJsonString(&out, ev.detail);
+    out += "}";
+  }
+  out += "]}";
+  return out;
+}
+
+}  // namespace fdfs

@@ -1,0 +1,101 @@
+// Cluster flight recorder: a lock-light bounded ring of structured
+// events — the discrete-occurrence complement of the stats registry's
+// aggregates (stats.h) and the trace ring's per-request spans (trace.h).
+// A quarantined chunk, an expired upload session, a replication stall,
+// or a config clamp is one EVENT with a timestamp, severity, and a
+// key/detail payload; operators read the recent ring via the EVENT_DUMP
+// opcodes (both daemons), the `fdfs_top` events pane, or a SIGUSR1 dump
+// to the daemon log for postmortems.
+//
+// Reference departure: upstream FastDFS scatters these occurrences as
+// free-text log lines; a postmortem then greps multi-GB logs.  Here the
+// last `capacity` events are always one RPC away, structured, and
+// cheap to poll (fdfs_top polls every ~2 s).
+//
+// Concurrency: same discipline as TraceRing — Record() claims a slot
+// with a fetch_add and takes a per-slot spinlock (acquire/release, so
+// TSan sees the happens-before) only for the bounded-copy critical
+// section; Json() takes each slot's lock briefly while copying.  No
+// global lock, no allocation on the record path.
+//
+// Wire contract (append-only; pinned by the `fdfs_codec event-json`
+// cross-language golden against fastdfs_tpu.monitor.decode_events):
+//   {"role":"storage"|"tracker","port":N,
+//    "events":[{"seq":N,"ts_us":N,"severity":"info"|"warn"|"error",
+//               "type":"chunk.quarantined","key":"...","detail":"..."}]}
+// Events are sorted by seq ascending; seq is process-monotonic so a
+// poller can dedup across dumps.  New object keys may be appended;
+// decoders ignore unknown keys.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <string>
+
+namespace fdfs {
+
+enum class EventSeverity : uint8_t { kInfo = 0, kWarn = 1, kError = 2 };
+
+struct ClusterEvent {
+  uint64_t seq = 0;       // process-monotonic (dedup handle for pollers)
+  int64_t ts_us = 0;      // wall-clock epoch µs (one clock domain with spans)
+  uint8_t severity = 0;   // EventSeverity
+  char type[24] = {0};    // dotted event name, e.g. "chunk.quarantined"
+  char key[64] = {0};     // the subject: digest, peer addr, session id...
+  char detail[128] = {0}; // free-form "k=v k=v" payload
+
+  void SetType(const char* s) { Copy(type, sizeof(type), s); }
+  void SetKey(const char* s) { Copy(key, sizeof(key), s); }
+  void SetDetail(const char* s) { Copy(detail, sizeof(detail), s); }
+
+ private:
+  static void Copy(char* dst, size_t cap, const char* s) {
+    std::strncpy(dst, s, cap - 1);
+    dst[cap - 1] = '\0';
+  }
+};
+
+class EventLog {
+ public:
+  explicit EventLog(size_t capacity);
+
+  // Record one event (over-long key/detail truncate; never allocates).
+  void Record(EventSeverity sev, const char* type, const std::string& key,
+              const std::string& detail = "");
+
+  // Dump the retained ring as the wire-contract JSON (sorted by seq).
+  std::string Json(const std::string& role, int port) const;
+
+  int64_t recorded() const { return recorded_.load(); }
+  // Events overwritten before any dump could see them (ring wrapped).
+  int64_t dropped() const {
+    int64_t r = recorded_.load();
+    return r > static_cast<int64_t>(cap_) ? r - static_cast<int64_t>(cap_) : 0;
+  }
+  size_t capacity() const { return cap_; }
+
+ private:
+  struct Slot {
+    std::atomic<bool> locked{false};
+    bool used = false;
+    ClusterEvent ev;
+  };
+  void LockSlot(Slot* s) const {
+    while (s->locked.exchange(true, std::memory_order_acquire)) {
+    }
+  }
+  void UnlockSlot(Slot* s) const {
+    s->locked.store(false, std::memory_order_release);
+  }
+
+  size_t cap_;
+  std::unique_ptr<Slot[]> slots_;
+  std::atomic<uint64_t> head_{0};
+  std::atomic<int64_t> recorded_{0};
+};
+
+const char* EventSeverityName(uint8_t sev);  // "info" | "warn" | "error"
+
+}  // namespace fdfs

@@ -219,12 +219,13 @@ void EventLoop::Post(std::function<void()> fn) {
   }
 }
 
-void EventLoop::DrainPosted() {
+int EventLoop::DrainPosted() {
   if (wake_fd_ >= 0) {
     uint64_t junk;
     while (read(wake_fd_, &junk, sizeof(junk)) > 0) {
     }
   }
+  int ran = 0;
   for (;;) {
     std::function<void()> fn;
     {
@@ -234,7 +235,9 @@ void EventLoop::DrainPosted() {
       posted_.pop_front();
     }
     fn();
+    ++ran;
   }
+  return ran;
 }
 
 bool EventLoop::Add(int fd, uint32_t events, FdCallback cb) {
@@ -280,11 +283,12 @@ int EventLoop::NextTimeoutMs() const {
   return static_cast<int>(d);
 }
 
-void EventLoop::FireTimers() {
+int EventLoop::FireTimers() {
   int64_t now = NowMs();
   std::vector<int> fired;
   for (auto& [id, t] : timers_)
     if (t.deadline_ms <= now) fired.push_back(id);
+  int ran = 0;
   for (int id : fired) {
     auto it = timers_.find(id);
     if (it == timers_.end()) continue;
@@ -295,7 +299,15 @@ void EventLoop::FireTimers() {
       timers_.erase(it);
     }
     cb();
+    ++ran;
   }
+  return ran;
+}
+
+int64_t MonoUs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
 }
 
 void EventLoop::Run() {
@@ -308,16 +320,26 @@ void EventLoop::Run() {
       if (errno == EINTR) continue;
       break;
     }
+    // Loop-lag clock starts when epoll_wait returns: everything until the
+    // next wait is callback time during which other ready fds stall.
+    int64_t t0 = iteration_hook_ ? MonoUs() : 0;
+    int dispatched = 0;
     for (int i = 0; i < n; ++i) {
       if (events[i].data.fd == wake_fd_) continue;  // drained below
       auto it = fd_cbs_.find(events[i].data.fd);
       if (it != fd_cbs_.end()) {
         FdCallback cb = it->second;  // copy: cb may Del() the fd
         cb(events[i].events);
+        ++dispatched;
       }
     }
-    DrainPosted();
-    FireTimers();
+    int worked = DrainPosted() + FireTimers();
+    // Skip iterations that ran NOTHING (pure timeout wakeups on an idle
+    // daemon would flood the lag histogram's first bucket with zeros) —
+    // but a slow timer or posted task stalls the loop exactly like a
+    // slow fd handler, so any callback activity counts as an iteration.
+    if (iteration_hook_ && (dispatched > 0 || n > 0 || worked > 0))
+      iteration_hook_(MonoUs() - t0, dispatched);
   }
   DrainPosted();  // don't strand posted work at shutdown
   running_ = false;

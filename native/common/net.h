@@ -69,17 +69,29 @@ class EventLoop {
   int AddTimer(int interval_ms, TimerCallback cb, bool repeat = true);
   void CancelTimer(int timer_id);
 
+  // Saturation instrumentation: called once per loop iteration that
+  // dispatched any work, with the time the loop spent INSIDE callbacks
+  // (busy_us — while it runs, every other ready fd on this loop is
+  // stalled; this is the event-loop lag a slow handler inflicts) and
+  // the number of fd events dispatched that round.  Set before Run()
+  // from the owning thread; the hook runs on the loop thread.
+  using IterationHook = std::function<void(int64_t busy_us, int n_events)>;
+  void set_iteration_hook(IterationHook hook) {
+    iteration_hook_ = std::move(hook);
+  }
+
   void Run();   // until Stop()
   void Stop();
   bool running() const { return running_; }
 
  private:
-  void FireTimers();
-  void DrainPosted();
+  int FireTimers();    // returns # timer callbacks fired
+  int DrainPosted();   // returns # posted fns run
   int NextTimeoutMs() const;
 
   int epfd_;
   int wake_fd_ = -1;  // eventfd: Post()/cross-thread Stop() wakeups
+  IterationHook iteration_hook_;
   std::mutex post_mu_;
   std::deque<std::function<void()>> posted_;
   std::atomic<bool> running_{false};
@@ -98,5 +110,10 @@ class EventLoop {
 };
 
 int64_t NowMs();
+// Monotonic microseconds — THE clock every latency/queue-wait
+// measurement shares (loop lag, dio queue wait, access-log stages).
+// One definition so the subtraction across producers can never mix
+// clock sources.
+int64_t MonoUs();
 
 }  // namespace fdfs

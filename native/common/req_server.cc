@@ -161,6 +161,7 @@ void RequestServer::Dispatch(Conn* c) {
     c->body.clear();
     return;  // ReadConn keeps going: next bytes are the traced request
   }
+  dispatched_count_++;
   int64_t start_us = trace_hook_ ? TraceWallUs() : 0;
   auto [status, resp] = handler_(c->cmd, c->body, c->peer_ip);
   if (trace_hook_) {

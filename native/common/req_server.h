@@ -46,6 +46,11 @@ class RequestServer {
   void set_max_connections(int n) { max_connections_ = n; }
   int64_t refused_count() const { return refused_count_; }
   void set_trace_hook(TraceHook hook) { trace_hook_ = std::move(hook); }
+  // Saturation gauges (ISSUE 6): live connections and requests served.
+  // Loop-thread values read by registry gauge-fns at snapshot time —
+  // the snapshot RPC itself runs on this loop, so no extra locking.
+  int64_t conn_count() const { return static_cast<int64_t>(conns_.size()); }
+  int64_t dispatched_count() const { return dispatched_count_; }
 
  private:
   struct Conn {
@@ -78,6 +83,7 @@ class RequestServer {
   int listen_fd_ = -1;
   int max_connections_ = 256;
   int64_t refused_count_ = 0;
+  int64_t dispatched_count_ = 0;
   std::unordered_map<int, std::unique_ptr<Conn>> conns_;
 };
 

@@ -85,6 +85,30 @@ StatHistogram* StatsRegistry::Histogram(const std::string& name,
   return slot.get();
 }
 
+int StatsRegistry::PruneGauges(const std::string& prefix,
+                               const std::vector<std::string>& keep) {
+  std::lock_guard<std::mutex> lk(mu_);
+  int removed = 0;
+  for (auto it = gauges_.lower_bound(prefix); it != gauges_.end();) {
+    const std::string& name = it->first;
+    if (name.compare(0, prefix.size(), prefix) != 0) break;
+    bool kept = false;
+    for (const std::string& k : keep) {
+      if (name.compare(0, k.size(), k) == 0) {
+        kept = true;
+        break;
+      }
+    }
+    if (kept) {
+      ++it;
+    } else {
+      it = gauges_.erase(it);
+      ++removed;
+    }
+  }
+  return removed;
+}
+
 std::string StatsRegistry::Json() const {
   std::lock_guard<std::mutex> lk(mu_);
   std::string out = "{\"counters\":{";

@@ -64,6 +64,17 @@ class StatsRegistry {
   StatHistogram* Histogram(const std::string& name,
                            std::vector<int64_t> bounds);
 
+  // Retire plain gauges under `prefix` whose full name is NOT in `keep`
+  // (registry-hygiene: per-peer "sync.peer.<addr>.*" gauges must die
+  // with their peer or a long-lived daemon grows unbounded metric
+  // cardinality).  Returns how many were removed.  ONLY safe for gauges
+  // set by name via SetGauge — removing one INVALIDATES any cached
+  // Gauge() pointer, so never prune names a hot path holds a handle to.
+  // keep entries are name PREFIXES (e.g. "sync.peer.10.0.0.2:23000."
+  // keeps that peer's whole gauge family).
+  int PruneGauges(const std::string& prefix,
+                  const std::vector<std::string>& keep);
+
   // Deterministic snapshot (names sorted within each section):
   //   {"counters":{...},"gauges":{...},
   //    "histograms":{"n":{"bounds":[...],"counts":[...],"sum":S,"count":C}}}

@@ -15,6 +15,9 @@
 #include <thread>
 #include <vector>
 
+#include "common/net.h"  // MonoUs: the shared latency clock
+#include "common/stats.h"
+
 namespace fdfs {
 
 class WorkerPool {
@@ -27,11 +30,22 @@ class WorkerPool {
 
   ~WorkerPool() { Stop(); }
 
+  // Saturation instrumentation (ISSUE 6): every task carries its enqueue
+  // timestamp; the dequeue observes queue wait (how long disk work sat
+  // behind other disk work — the dio saturation signal) and the return
+  // observes service time.  Histograms are registry-owned and shared
+  // across pools (their Observe is wait-free); either may be null.
+  void SetStats(StatHistogram* queue_wait_us, StatHistogram* service_us) {
+    std::lock_guard<std::mutex> lk(mu_);
+    hist_wait_ = queue_wait_us;
+    hist_service_ = service_us;
+  }
+
   void Submit(std::function<void()> fn) {
     {
       std::lock_guard<std::mutex> lk(mu_);
       if (stopping_) return;
-      queue_.push_back(std::move(fn));
+      queue_.push_back(Task{std::move(fn), MonoUs()});
     }
     cv_.notify_one();
   }
@@ -56,25 +70,39 @@ class WorkerPool {
   }
 
  private:
+  struct Task {
+    std::function<void()> fn;
+    int64_t enqueue_us = 0;
+  };
+
   void Main() {
     for (;;) {
-      std::function<void()> fn;
+      Task task;
+      StatHistogram* hw;
+      StatHistogram* hs;
       {
         std::unique_lock<std::mutex> lk(mu_);
         cv_.wait(lk, [this] { return stopping_ || !queue_.empty(); });
         if (queue_.empty()) return;  // stopping and drained
-        fn = std::move(queue_.front());
+        task = std::move(queue_.front());
         queue_.pop_front();
+        hw = hist_wait_;
+        hs = hist_service_;
       }
-      fn();
+      int64_t t0 = MonoUs();
+      if (hw != nullptr) hw->Observe(t0 - task.enqueue_us);
+      task.fn();
+      if (hs != nullptr) hs->Observe(MonoUs() - t0);
     }
   }
 
   mutable std::mutex mu_;
   std::condition_variable cv_;
-  std::deque<std::function<void()>> queue_;
+  std::deque<Task> queue_;
   std::vector<std::thread> threads_;
   bool stopping_ = false;
+  StatHistogram* hist_wait_ = nullptr;     // guarded by mu_ (read at dequeue)
+  StatHistogram* hist_service_ = nullptr;
 };
 
 }  // namespace fdfs

@@ -11,6 +11,7 @@
 #include <ctime>
 
 #include "common/bytes.h"
+#include "common/eventlog.h"
 #include "common/log.h"
 
 namespace fdfs {
@@ -189,6 +190,9 @@ bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
       CacheInvalidate(digest_hex);
       FDFS_LOG_INFO("chunk %s healed by incoming payload",
                     digest_hex.c_str());
+      if (events_ != nullptr)
+        events_->Record(EventSeverity::kInfo, "chunk.healed", digest_hex,
+                        "by=upload bytes=" + std::to_string(len));
     } else {
       FDFS_LOG_WARN("quarantined chunk %s heal failed: %s",
                     digest_hex.c_str(), werr.c_str());

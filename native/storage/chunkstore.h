@@ -89,6 +89,13 @@ class ChunkStore {
   explicit ChunkStore(std::string store_path, int64_t gc_grace_s = 0,
                       int64_t read_cache_bytes = 0);
 
+  // Flight recorder (common/eventlog.h; may stay null): the store
+  // reports heal-on-upload — a quarantined chunk restored by an
+  // incoming verified payload — so postmortems see the full
+  // quarantine -> heal lifecycle, not just the scrubber's half.  Set
+  // once at startup, before serving.
+  void set_events(class EventLog* events) { events_ = events; }
+
   // Scan every *.rcp under the data dir: rebuild refcounts and delete
   // orphaned chunk files.  Call once at startup, before serving.
   void RebuildFromRecipes();
@@ -300,6 +307,7 @@ class ChunkStore {
 
   std::string store_path_;
   int64_t gc_grace_s_ = 0;
+  class EventLog* events_ = nullptr;
   std::array<Stripe, kStripes> stripes_;
   std::atomic<int64_t> unique_bytes_{0};
   std::atomic<int64_t> zero_ref_bytes_{0};

@@ -3,6 +3,8 @@
 namespace fdfs {
 
 bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
+  anomalies.clear();
+  auto note = [this](const std::string& what) { anomalies.push_back(what); };
   group_name = ini.GetStr("group_name", group_name);
   bind_addr = ini.GetStr("bind_addr", "");
   port = static_cast<int>(ini.GetInt("port", port));
@@ -48,11 +50,17 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
   sync_interval_ms = static_cast<int>(ini.GetInt("sync_interval_ms", 100));
   work_threads = static_cast<int>(ini.GetInt("work_threads", work_threads));
   if (work_threads < 1) work_threads = 1;
-  if (work_threads > 64) work_threads = 64;
+  if (work_threads > 64) {
+    note("work_threads clamped to 64");
+    work_threads = 64;
+  }
   disk_writer_threads = static_cast<int>(
       ini.GetInt("disk_writer_threads", disk_writer_threads));
   if (disk_writer_threads < 1) disk_writer_threads = 1;
-  if (disk_writer_threads > 64) disk_writer_threads = 64;
+  if (disk_writer_threads > 64) {
+    note("disk_writer_threads clamped to 64");
+    disk_writer_threads = 64;
+  }
   max_connections =
       static_cast<int>(ini.GetInt("max_connections", max_connections));
   if (max_connections < 0) max_connections = 0;
@@ -87,14 +95,27 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
   if (scrub_bandwidth_mb_s < 0) scrub_bandwidth_mb_s = 0;
   // 1 TB/s cap: keeps the pacing arithmetic far from int64 limits (a
   // larger value is indistinguishable from unpaced anyway).
-  if (scrub_bandwidth_mb_s > (1 << 20)) scrub_bandwidth_mb_s = 1 << 20;
+  if (scrub_bandwidth_mb_s > (1 << 20)) {
+    note("scrub_bandwidth_mb_s clamped to 1 TB/s");
+    scrub_bandwidth_mb_s = 1 << 20;
+  }
   chunk_gc_grace_s = ini.GetSeconds("chunk_gc_grace_s", chunk_gc_grace_s);
   if (chunk_gc_grace_s < 0) chunk_gc_grace_s = 0;
   read_cache_mb = static_cast<int>(ini.GetInt("read_cache_mb",
                                               read_cache_mb));
   if (read_cache_mb < 0) read_cache_mb = 0;
   // 64 GB cap: the cache is per store path and RAM-resident.
-  if (read_cache_mb > (64 << 10)) read_cache_mb = 64 << 10;
+  if (read_cache_mb > (64 << 10)) {
+    note("read_cache_mb clamped to 64 GB");
+    read_cache_mb = 64 << 10;
+  }
+  event_buffer_size = static_cast<int>(
+      ini.GetInt("event_buffer_size", event_buffer_size));
+  if (event_buffer_size < 16) event_buffer_size = 16;
+  if (event_buffer_size > (1 << 20)) {
+    note("event_buffer_size clamped to 1M");
+    event_buffer_size = 1 << 20;
+  }
   return true;
 }
 

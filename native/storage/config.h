@@ -89,6 +89,14 @@ struct StorageConfig {
   // quarantine and GC unlink (OPERATIONS.md "Read path, caching &
   // parallel downloads").  0 disables it.
   int read_cache_mb = 64;
+  // Flight recorder (common/eventlog.h): capacity of the bounded ring
+  // of structured cluster events dumped via StorageCmd::EVENT_DUMP and
+  // on SIGUSR1 (OPERATIONS.md "Saturation & flight recorder").
+  int event_buffer_size = 1024;
+  // Config values Load() silently clamped or corrected — surfaced as
+  // "config.anomaly" flight-recorder events at startup so a daemon
+  // running on not-what-the-operator-wrote config is diagnosable.
+  std::vector<std::string> anomalies;
 
   // Parse + validate; false with *error on problems.
   bool Load(const IniConfig& ini, std::string* error);

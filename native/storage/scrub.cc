@@ -8,6 +8,7 @@
 #include <chrono>
 
 #include "common/bytes.h"
+#include "common/eventlog.h"
 #include "common/log.h"
 #include "common/net.h"
 
@@ -32,10 +33,10 @@ int64_t WallUs() {
 ScrubManager::ScrubManager(ScrubOptions opts, std::string group_name,
                            std::vector<ChunkStore*> chunk_stores,
                            PeerListFn peers, DedupPlugin* plugin,
-                           TraceRing* trace)
+                           TraceRing* trace, EventLog* events)
     : opts_(opts), group_name_(std::move(group_name)),
       stores_(std::move(chunk_stores)), peers_(std::move(peers)),
-      plugin_(plugin), trace_(trace) {}
+      plugin_(plugin), trace_(trace), events_(events) {}
 
 ScrubManager::~ScrubManager() { Stop(); }
 
@@ -238,6 +239,13 @@ void ScrubManager::RunPass() {
                     "store path %zu",
                     static_cast<long long>(n),
                     static_cast<long long>(bytes), spi);
+      if (events_ != nullptr) {
+        char key[8], detail[64];
+        snprintf(key, sizeof(key), "M%02zX", spi);
+        snprintf(detail, sizeof(detail), "chunks=%lld bytes=%lld",
+                 static_cast<long long>(n), static_cast<long long>(bytes));
+        events_->Record(EventSeverity::kInfo, "gc.sweep", key, detail);
+      }
     }
   }
 
@@ -329,6 +337,11 @@ void ScrubManager::HandleCorrupt(int spi, const ChunkStore::ChunkInfo& info,
         FDFS_LOG_WARN("scrub: chunk %s failed verification on store path "
                       "%d — quarantined",
                       info.digest_hex.c_str(), spi);
+        if (events_ != nullptr)
+          events_->Record(EventSeverity::kWarn, "chunk.quarantined",
+                          info.digest_hex,
+                          "spi=" + std::to_string(spi) +
+                              " bytes=" + std::to_string(info.length));
         break;
     }
   }
@@ -341,11 +354,19 @@ void ScrubManager::HandleCorrupt(int spi, const ChunkStore::ChunkInfo& info,
       chunks_repaired_.fetch_add(1, std::memory_order_relaxed);
       FDFS_LOG_INFO("scrub: chunk %s repaired from replica",
                     info.digest_hex.c_str());
+      if (events_ != nullptr)
+        events_->Record(EventSeverity::kInfo, "chunk.repaired",
+                        info.digest_hex,
+                        "spi=" + std::to_string(spi) + " by=replica");
     } else {
       status = 5 /*EIO*/;
       corrupt_unrepairable_.fetch_add(1, std::memory_order_relaxed);
       FDFS_LOG_ERROR("scrub: chunk %s repair write failed: %s",
                      info.digest_hex.c_str(), err.c_str());
+      if (events_ != nullptr)
+        events_->Record(EventSeverity::kError, "chunk.unrepairable",
+                        info.digest_hex, "spi=" + std::to_string(spi) +
+                                             " reason=repair_write_failed");
     }
   } else {
     attempted = true;
@@ -355,6 +376,10 @@ void ScrubManager::HandleCorrupt(int spi, const ChunkStore::ChunkInfo& info,
                    "(stays quarantined; downloads of its files will fail "
                    "rather than return bad bytes)",
                    info.digest_hex.c_str());
+    if (events_ != nullptr)
+      events_->Record(EventSeverity::kError, "chunk.unrepairable",
+                      info.digest_hex,
+                      "spi=" + std::to_string(spi) + " reason=no_replica");
   }
   if (attempted && trace_ != nullptr && pass_ctx_.valid()) {
     TraceSpan s;

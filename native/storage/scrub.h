@@ -60,10 +60,13 @@ class ScrubManager {
 
   // chunk_stores[i] serves store path i; plugin (may be null) supplies
   // the batched sidecar verify — it must be this thread's OWN instance
-  // (the plugins are not thread-safe; ChunkStore is).
+  // (the plugins are not thread-safe; ChunkStore is).  events (may be
+  // null) is the flight recorder: quarantine/repair/unrepairable/GC
+  // become structured cluster events alongside the log lines.
   ScrubManager(ScrubOptions opts, std::string group_name,
                std::vector<ChunkStore*> chunk_stores, PeerListFn peers,
-               DedupPlugin* plugin, TraceRing* trace);
+               DedupPlugin* plugin, TraceRing* trace,
+               class EventLog* events = nullptr);
   ~ScrubManager();
 
   void Start();
@@ -124,6 +127,7 @@ class ScrubManager {
   PeerListFn peers_;
   DedupPlugin* plugin_;
   TraceRing* trace_;
+  class EventLog* events_;
 
   std::thread thread_;
   std::mutex mu_;

@@ -89,6 +89,12 @@ bool StorageServer::Init(std::string* error) {
   if (!store_.Init(cfg_, error)) return false;
   if (!binlog_.Init(cfg_.base_path + "/data/sync", kBinlogRotateSize, error))
     return false;
+  // Flight recorder FIRST: every subsystem below may record into it
+  // (chunk-store heals, scrub quarantines, sync stalls, config clamps).
+  events_ = std::make_unique<EventLog>(
+      static_cast<size_t>(cfg_.event_buffer_size));
+  for (const std::string& a : cfg_.anomalies)
+    events_->Record(EventSeverity::kWarn, "config.anomaly", a);
   dedup_ = MakeDedupPlugin(cfg_.dedup_mode, cfg_.base_path, cfg_.dedup_sidecar);
   if (dedup_ != nullptr && cfg_.dedup_chunk_threshold > 0) {
     // Chunk-level dedup: one content-addressed store per store path;
@@ -97,6 +103,7 @@ bool StorageServer::Init(std::string* error) {
       chunk_stores_.push_back(std::make_unique<ChunkStore>(
           store_.store_path(i), cfg_.chunk_gc_grace_s,
           static_cast<int64_t>(cfg_.read_cache_mb) << 20));
+      chunk_stores_.back()->set_events(events_.get());
       chunk_stores_.back()->RebuildFromRecipes();
     }
   }
@@ -127,6 +134,20 @@ bool StorageServer::Init(std::string* error) {
   // Stats registry before any subsystem that feeds it: handlers and the
   // beat callback only touch pre-registered atomic pointers.
   InitStatsRegistry();
+
+  // Saturation telemetry (ISSUE 6): every nio event loop observes its
+  // per-iteration callback time into one shared loop-lag histogram (the
+  // stall a slow handler inflicts on every other conn of its loop), and
+  // the per-store-path dio pools observe queue wait + service time.
+  auto nio_hook = [this](int64_t busy_us, int n_events) {
+    hist_nio_lag_->Observe(busy_us);
+    if (n_events > 0)
+      ctr_nio_dispatched_->fetch_add(n_events, std::memory_order_relaxed);
+  };
+  loop_.set_iteration_hook(nio_hook);  // accept + timers loop
+  for (auto& t : nio_) t->loop->set_iteration_hook(nio_hook);
+  for (auto& pool : dio_pools_)
+    pool->SetStats(hist_dio_wait_, hist_dio_service_);
 
   if (!cfg_.tracker_servers.empty()) {
     // Sync manager first: the reporter's peer lists drive its thread pool.
@@ -201,6 +222,7 @@ bool StorageServer::Init(std::string* error) {
     // records its own sync.ship span here.
     scbs.trace_corr = &trace_corr_;
     scbs.trace_ring = trace_.get();
+    scbs.events = events_.get();
     sync_ = std::make_unique<SyncManager>(cfg_, std::move(scbs));
     reporter_ = std::make_unique<TrackerReporter>(
         cfg_, [this](int64_t* out) { FillBeatStats(out); },
@@ -366,7 +388,7 @@ bool StorageServer::Init(std::string* error) {
               out.push_back(s.addr);
           return out;
         },
-        scrub_dedup_.get(), trace_.get());
+        scrub_dedup_.get(), trace_.get(), events_.get());
     scrub_->Start();
   }
 
@@ -479,6 +501,13 @@ void StorageServer::DumpState() {
       static_cast<long long>(stats_.total_delete),
       static_cast<long long>(stats_.dedup_hits),
       static_cast<long long>(stats_.dedup_bytes_saved), binlog_.file_index());
+  // Flight-recorder dump for postmortems: SIGUSR1 lands the retained
+  // event ring in the daemon log as one JSON line (the same contract
+  // the EVENT_DUMP opcode serves; OPERATIONS.md "Saturation & flight
+  // recorder").
+  if (events_ != nullptr)
+    FDFS_LOG_INFO("event dump: %s",
+                  events_->Json("storage", cfg_.port).c_str());
 }
 
 // -- stats registry -------------------------------------------------------
@@ -522,6 +551,7 @@ constexpr ServedOp kServedOps[] = {
     {StorageCmd::kFetchRecipe, "fetch_recipe"},
     {StorageCmd::kFetchChunk, "fetch_chunk"},
     {StorageCmd::kTraceDump, "trace_dump"},
+    {StorageCmd::kEventDump, "event_dump"},
     {StorageCmd::kScrubStatus, "scrub_status"},
     {StorageCmd::kScrubKick, "scrub_kick"},
     {StorageCmd::kFetchOnePathBinlog, "fetch_one_path_binlog"},
@@ -542,6 +572,33 @@ void StorageServer::InitStatsRegistry() {
                                         StatsRegistry::LatencyBucketsUs());
     op_names_[static_cast<uint8_t>(op.cmd)] = op.name;
   }
+  // Saturation telemetry (ISSUE 6).  nio.loop_lag_us: per-iteration
+  // callback time of every nio event loop — the p99 here is how long a
+  // ready connection can wait behind other handlers, the queueing
+  // signal the multi-reactor refactor (ROADMAP item 5) will be judged
+  // against.  dio.queue_wait_us / dio.service_us: time disk work sat
+  // queued behind other disk work vs time actually serviced, across
+  // every store path's pool.
+  hist_nio_lag_ = registry_.Histogram("nio.loop_lag_us",
+                                      StatsRegistry::LatencyBucketsUs());
+  ctr_nio_dispatched_ = registry_.Counter("nio.dispatched_ops");
+  registry_.GaugeFn("nio.conns_active", [this] { return conn_count_.load(); });
+  hist_dio_wait_ = registry_.Histogram("dio.queue_wait_us",
+                                       StatsRegistry::LatencyBucketsUs());
+  hist_dio_service_ = registry_.Histogram("dio.service_us",
+                                          StatsRegistry::LatencyBucketsUs());
+  registry_.GaugeFn("dio.queue_depth", [this] {
+    int64_t n = 0;
+    for (const auto& p : dio_pools_) n += static_cast<int64_t>(p->pending());
+    return n;
+  });
+  // Flight-recorder health: throughput and ring-overwrite pressure.
+  registry_.GaugeFn("events.recorded", [this] {
+    return events_ != nullptr ? events_->recorded() : int64_t{0};
+  });
+  registry_.GaugeFn("events.dropped", [this] {
+    return events_ != nullptr ? events_->dropped() : int64_t{0};
+  });
   // Tracing health: ring throughput/overwrite pressure and the slow gate.
   registry_.GaugeFn("trace.spans_recorded", [this] {
     return trace_ != nullptr ? trace_->recorded() : int64_t{0};
@@ -664,13 +721,17 @@ int64_t StorageServer::MaxSyncLagS() const {
 
 std::string StorageServer::BuildStatsJson() {
   // Per-peer replication gauges have dynamic names (peers come and go),
-  // so they are plain gauges refreshed at snapshot time; a retired
-  // peer's last values linger until restart, which monitoring treats as
-  // "last known", not a leak.
+  // so they are plain gauges refreshed at snapshot time — and RETIRED
+  // when their peer leaves the group (ISSUE 6 registry hygiene: a
+  // long-lived daemon in a churning group must not grow unbounded
+  // metric cardinality; nothing caches pointers to these gauges, so
+  // pruning by name is safe).
   if (sync_ != nullptr) {
     int64_t now = time(nullptr);
+    std::vector<std::string> live;
     for (const SyncPeerState& s : sync_->States()) {
       std::string base = "sync.peer." + s.addr;
+      live.push_back(base + ".");
       registry_.SetGauge(base + ".connected", s.connected ? 1 : 0);
       registry_.SetGauge(
           base + ".lag_s",
@@ -678,6 +739,7 @@ std::string StorageServer::BuildStatsJson() {
       registry_.SetGauge(base + ".records_synced", s.records_synced);
       registry_.SetGauge(base + ".records_skipped", s.records_skipped);
     }
+    registry_.PruneGauges("sync.peer.", live);
   }
   return registry_.Json();
 }
@@ -745,11 +807,9 @@ void StorageServer::AdoptConn(NioThread* t, int fd) {
   t->loop->Add(fd, EPOLLIN, [this, raw](uint32_t ev) { OnConnEvent(raw, ev); });
 }
 
-static int64_t MonoUs() {
-  struct timespec ts;
-  clock_gettime(CLOCK_MONOTONIC, &ts);
-  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
-}
+// Per-request latency stamps use common/net.h MonoUs() — the same
+// clock WorkerPool and the loop-lag hook measure with, so queue-wait
+// subtractions across producers can never mix clock sources.
 
 void StorageServer::OffloadToDio(Conn* c, int spi, std::function<void()> work) {
   WorkerPool* pool = nullptr;
@@ -773,6 +833,14 @@ void StorageServer::OffloadToDio(Conn* c, int spi, std::function<void()> work) {
     // Worker context: `work` may Respond()/RespondError() — both only
     // BUILD the response while async_pending is set; the socket and
     // epoll are touched exclusively from the loop thread below.
+    // Queue-wait stamp: time between submit (work_start_us) and this
+    // pickup is saturation, not service — traced requests surface it as
+    // a dio.queue_wait child span (the conn is worker-owned while
+    // async_pending, so writing the field here is race-free).  Floor of
+    // 1µs: an idle pool can pick up within the clock tick, and a 0
+    // would suppress the child span — the timeline should always show
+    // the wait stage, even when it reads "~0".
+    c->dio_wait_us = std::max<int64_t>(MonoUs() - c->work_start_us, 1);
     work();
     loop->Post([this, c, loop] {
       c->async_pending = false;
@@ -871,6 +939,7 @@ void StorageServer::ResetForNextRequest(Conn* c) {
   c->ingest_session = 0;
   c->ingest_chunks_total = 0;
   c->ingest_chunks_missing = 0;
+  c->dio_wait_us = 0;
   c->trace_ctx = TraceCtx{};
   c->traced = false;
   c->trace_span = 0;
@@ -1018,6 +1087,7 @@ void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
   c->req_start_us = 0;  // one line per request
   c->recv_done_us = 0;
   c->work_start_us = 0;
+  c->dio_wait_us = 0;
   c->fp_us = 0;
   c->fp_lock_us = 0;
   c->cswrite_us = 0;
@@ -1065,17 +1135,22 @@ void StorageServer::RecordRequestSpans(Conn* c, uint8_t status,
     trace_->Record(s);
   };
   // recv = body receive window; the dio work window then decomposes into
-  // fingerprint -> chunk-store writes -> binlog (sequential in the
-  // handler, so their spans are laid out back-to-back).
+  // queue wait -> fingerprint -> chunk-store writes -> binlog
+  // (sequential in the handler, so their spans are laid out
+  // back-to-back).  dio.queue_wait is WAITING, not working — the span
+  // that makes a saturated dio pool visible on an fdfs_trace timeline.
   int64_t recv_us =
       c->recv_done_us > 0 ? c->recv_done_us - c->req_start_us : 0;
   child("storage.recv", wall_start, recv_us);
   int64_t work_wall = wall_start + (c->work_start_us > 0
                                         ? c->work_start_us - c->req_start_us
                                         : recv_us);
-  child("storage.fingerprint", work_wall, c->fp_us);
-  child("storage.cs_write", work_wall + c->fp_us, c->cswrite_us);
-  child("storage.binlog", work_wall + c->fp_us + c->cswrite_us, c->binlog_us);
+  child("dio.queue_wait", work_wall, c->dio_wait_us);
+  int64_t stage_wall = work_wall + c->dio_wait_us;
+  child("storage.fingerprint", stage_wall, c->fp_us);
+  child("storage.cs_write", stage_wall + c->fp_us, c->cswrite_us);
+  child("storage.binlog", stage_wall + c->fp_us + c->cswrite_us,
+        c->binlog_us);
   if (c->ingest_chunks_total > 0) {
     // Negotiated-upload annotation: how much of the recipe actually
     // crossed the wire (missing/total), spanning the request's work
@@ -1090,6 +1165,11 @@ void StorageServer::RecordRequestSpans(Conn* c, uint8_t status,
 
   if (slow) {
     slow_request_count_.fetch_add(1, std::memory_order_relaxed);
+    if (events_ != nullptr)
+      events_->Record(EventSeverity::kWarn, "request.slow", root.name,
+                      "peer=" + c->peer_ip +
+                          " dur_us=" + std::to_string(total_us) +
+                          " status=" + std::to_string(status));
     std::string line =
         SlowRequestJson("storage", root.name, root, c->peer_ip, bytes);
     FDFS_LOG_WARN("%s", line.c_str());
@@ -1487,6 +1567,15 @@ void StorageServer::OnHeaderComplete(Conn* c) {
         return;
       }
       Respond(c, 0, trace_->Json("storage", cfg_.port));
+      return;
+    case StorageCmd::kEventDump:
+      // Flight-recorder dump: empty body -> {"role","port","events":[...]}
+      // (fastdfs_tpu.monitor.decode_events; fdfs_codec event-json golden).
+      if (c->pkg_len != 0) {
+        CloseConn(c);
+        return;
+      }
+      Respond(c, 0, events_->Json("storage", cfg_.port));
       return;
     case StorageCmd::kScrubStatus: {
       // Integrity-engine status: empty body -> kScrubStatCount BE int64
@@ -2292,6 +2381,11 @@ void StorageServer::SweepIngestSessions() {
                   static_cast<long long>(s->id));
     if (ctr_ingest_fallbacks_ != nullptr)
       ctr_ingest_fallbacks_->fetch_add(1, std::memory_order_relaxed);
+    if (events_ != nullptr)
+      events_->Record(EventSeverity::kWarn, "ingest.session_expired",
+                      std::to_string(s->id),
+                      "chunks=" + std::to_string(s->recipe.chunks.size()) +
+                          " pinned_released=1");
   }
 }
 
@@ -2336,6 +2430,12 @@ bool StorageServer::BeginUploadChunks(Conn* c) {
     TakeIngestSession(session_id).reset();
     if (ctr_ingest_fallbacks_ != nullptr)
       ctr_ingest_fallbacks_->fetch_add(1, std::memory_order_relaxed);
+    if (events_ != nullptr)
+      events_->Record(EventSeverity::kWarn, "ingest.fallback",
+                      std::to_string(session_id),
+                      "phase=chunks reason=payload_mismatch declared=" +
+                          std::to_string(payload_len) +
+                          " expected=" + std::to_string(expect));
     RespondError(c, 22);
     return false;
   }
@@ -2363,6 +2463,11 @@ void StorageServer::UploadChunksComplete(Conn* c) {
   auto fail = [&](uint8_t status) {
     if (ctr_ingest_fallbacks_ != nullptr)
       ctr_ingest_fallbacks_->fetch_add(1, std::memory_order_relaxed);
+    if (events_ != nullptr)
+      events_->Record(EventSeverity::kWarn, "ingest.fallback",
+                      std::to_string(c->ingest_session),
+                      "phase=commit status=" + std::to_string(status) +
+                          " peer=" + c->peer_ip);
     if (!c->tmp_path.empty()) {
       unlink(c->tmp_path.c_str());
       c->tmp_path.clear();

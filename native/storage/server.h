@@ -24,6 +24,7 @@
 #include <unordered_set>
 #include <vector>
 
+#include "common/eventlog.h"
 #include "common/stats.h"
 #include "common/trace.h"
 #include "common/workers.h"
@@ -207,6 +208,11 @@ class StorageServer {
     NioThread* owner = nullptr;   // the nio loop this conn lives on
     bool async_pending = false;   // a dio worker owns the request right now
     bool dead = false;            // closed while async_pending: zombie
+    // How long THIS request sat in the dio queue before a worker picked
+    // it up (stamped by the worker; inside the work window).  Traced
+    // requests get it as a dio.queue_wait child span so fdfs_trace
+    // timelines separate waiting from working.
+    int64_t dio_wait_us = 0;
     // access log bookkeeping (per-stage timings, SURVEY.md §5: the
     // rebuild logs recv/work splits, not just the total)
     int64_t req_start_us = 0;
@@ -468,6 +474,17 @@ class StorageServer {
   std::unique_ptr<TraceRing> trace_;
   TraceCorrelator trace_corr_;
   std::atomic<int64_t> slow_request_count_{0};
+  // Flight recorder behind EVENT_DUMP + the SIGUSR1 dump (ISSUE 6):
+  // structured cluster events from the scrubber, chunk stores,
+  // replication sender, ingest sessions, the slow gate, and config
+  // anomalies.  Created in Init() before every subsystem that records.
+  std::unique_ptr<EventLog> events_;
+  // Saturation telemetry handles (nio loop lag / dio queue health),
+  // pre-registered so the per-iteration hook touches only atomics.
+  StatHistogram* hist_nio_lag_ = nullptr;
+  std::atomic<int64_t>* ctr_nio_dispatched_ = nullptr;
+  StatHistogram* hist_dio_wait_ = nullptr;
+  StatHistogram* hist_dio_service_ = nullptr;
   StatHistogram* hist_upload_bytes_ = nullptr;
   StatHistogram* hist_download_bytes_ = nullptr;
   std::atomic<int64_t>* ctr_sync_bytes_saved_wire_ = nullptr;

@@ -9,6 +9,7 @@
 #include <algorithm>
 
 #include "common/bytes.h"
+#include "common/eventlog.h"
 #include "common/log.h"
 #include "common/net.h"
 #include "common/protocol_gen.h"
@@ -141,16 +142,32 @@ void SyncManager::WorkerMain(Worker* w) {
   int backoff_ms = 100;
   int since_save = 0;
 
+  bool stall_noted = false;  // one event per outage, not per retry
   while (!w->stop) {
     if (fd < 0) {
       fd = TcpConnect(w->ip, w->port, kConnectTimeoutMs, &err);
       if (fd < 0) {
         w->connected = false;
+        // Flight recorder: the FIRST failed (re)connect of an outage is
+        // the stall signal; the exponential-backoff retries after it are
+        // noise the bounded ring should not drown in.
+        if (!stall_noted && cbs_.events != nullptr) {
+          stall_noted = true;
+          cbs_.events->Record(
+              EventSeverity::kWarn, "sync.stall",
+              w->ip + ":" + std::to_string(w->port),
+              pending.has_value() ? "reason=connect_failed mid_record=1"
+                                  : "reason=connect_failed");
+        }
         for (int i = 0; i < backoff_ms / 50 && !w->stop; ++i)
           usleep(50 * 1000);
         backoff_ms = std::min(backoff_ms * 2, 5000);
         continue;
       }
+      if (stall_noted && cbs_.events != nullptr)
+        cbs_.events->Record(EventSeverity::kInfo, "sync.resumed",
+                            w->ip + ":" + std::to_string(w->port));
+      stall_noted = false;
       w->connected = true;
       backoff_ms = 100;
     }
@@ -276,7 +293,16 @@ bool SyncManager::Replay(Worker* w, int* fd, const BinlogRecord& rec) {
       ok = true;
       break;
   }
-  if (ok && skipped) w->records_skipped++;
+  if (ok && skipped) {
+    w->records_skipped++;
+    // A permanently-unreplayable record (peer rejected it) left the
+    // replica without this mutation — worth a structured event, not
+    // just a buried WARN line.
+    if (cbs_.events != nullptr)
+      cbs_.events->Record(EventSeverity::kWarn, "sync.skip", rec.filename,
+                          "peer=" + w->ip + ":" + std::to_string(w->port) +
+                              " op=" + std::string(1, rec.op));
+  }
   if (traced && cbs_.trace_ring != nullptr) {
     if (ok) {
       TraceSpan s;

@@ -68,6 +68,10 @@ struct SyncCallbacks {
   // failures restore the entry so the retried record stays traced.
   TraceCorrelator* trace_corr = nullptr;
   TraceRing* trace_ring = nullptr;
+  // Flight recorder (may be null): replication stalls (peer connect
+  // failures / mid-replay transport drops) and permanently-skipped
+  // records become structured cluster events.
+  class EventLog* events = nullptr;
 };
 
 struct SyncPeerState {

@@ -1,5 +1,8 @@
 // Unit tests for the C++ common layer (no gtest in the image — plain
 // CHECK macros; non-zero exit on failure).
+#include <unistd.h>
+
+#include <atomic>
 #include <cassert>
 #include <cstdio>
 #include <cstring>
@@ -8,11 +11,14 @@
 #include <vector>
 
 #include "common/bytes.h"
+#include "common/eventlog.h"
 #include "common/fileid.h"
 #include "common/ini.h"
+#include "common/net.h"
 #include "common/protocol_gen.h"
 #include "common/stats.h"
 #include "common/trace.h"
+#include "common/workers.h"
 
 static int g_failures = 0;
 
@@ -293,6 +299,118 @@ static void TestTraceCorrelator() {
   CHECK_EQ(corr.size(), 0u);
 }
 
+static void TestEventLog() {
+  EventLog log(4);
+  log.Record(EventSeverity::kWarn, "chunk.quarantined", "digest1", "spi=0");
+  log.Record(EventSeverity::kInfo, "chunk.repaired", "digest1");
+  std::string json = log.Json("storage", 23000);
+  CHECK(json.find("\"role\":\"storage\"") != std::string::npos);
+  CHECK(json.find("\"type\":\"chunk.quarantined\"") != std::string::npos);
+  CHECK(json.find("\"severity\":\"warn\"") != std::string::npos);
+  CHECK(json.find("\"seq\":1") != std::string::npos);
+  CHECK_EQ(log.recorded(), 2);
+  CHECK_EQ(log.dropped(), 0);
+  // Ring wrap: capacity 4, record 6 — the oldest 2 are overwritten and
+  // the dump holds exactly seqs 3..6 in order.
+  for (int i = 0; i < 4; ++i)
+    log.Record(EventSeverity::kError, "gc.sweep", "M00",
+               "n=" + std::to_string(i));
+  CHECK_EQ(log.recorded(), 6);
+  CHECK_EQ(log.dropped(), 2);
+  json = log.Json("storage", 23000);
+  CHECK(json.find("\"seq\":1,") == std::string::npos);
+  CHECK(json.find("\"seq\":3") != std::string::npos);
+  CHECK(json.find("\"seq\":6") != std::string::npos);
+  // Hostile bytes in key/detail must still serialize as valid JSON
+  // (escaped), and over-long fields truncate instead of overflowing.
+  EventLog esc(2);
+  esc.Record(EventSeverity::kInfo, "config.anomaly", "a\"b\\c\nd",
+             std::string(500, 'x'));
+  json = esc.Json("tracker", 22122);
+  CHECK(json.find("a\\\"b\\\\c\\nd") != std::string::npos);
+  CHECK(json.find(std::string(127, 'x') + "\"") != std::string::npos);
+}
+
+static void TestEventLogThreaded() {
+  // Lock-light claim: concurrent recorders + a dumping reader must be
+  // data-race-free (tools/run_sanitizers.sh runs this under TSan) —
+  // the flight-recorder twin of TestTraceRingThreaded.
+  EventLog log(64);
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 4; ++t) {
+    threads.emplace_back([&log, t] {
+      for (int i = 0; i < 500; ++i)
+        log.Record(EventSeverity::kInfo, "request.slow",
+                   "t" + std::to_string(t), "i=" + std::to_string(i));
+    });
+  }
+  std::thread reader([&log] {
+    for (int i = 0; i < 50; ++i) (void)log.Json("storage", 1);
+  });
+  for (auto& th : threads) th.join();
+  reader.join();
+  CHECK_EQ(log.recorded(), 4 * 500);
+  CHECK(log.Json("storage", 1).find("\"events\":[") != std::string::npos);
+}
+
+static void TestEventLoopLagHook() {
+  // The iteration hook must observe the time spent inside callbacks: a
+  // deliberately-slow posted task shows up as loop lag >= its sleep.
+  EventLoop loop;
+  StatsRegistry reg;
+  StatHistogram* lag = reg.Histogram("nio.loop_lag_us",
+                                     StatsRegistry::LatencyBucketsUs());
+  std::atomic<int64_t> dispatched{0};
+  loop.set_iteration_hook([&](int64_t busy_us, int n_events) {
+    lag->Observe(busy_us);
+    dispatched.fetch_add(n_events);
+  });
+  loop.Post([] { usleep(20 * 1000); });
+  loop.Post([&loop] { loop.Stop(); });
+  loop.Run();
+  CHECK(lag->count() >= 1);
+  CHECK(lag->sum() >= 20000);  // the 20 ms stall is visible as lag
+}
+
+static void TestWorkerPoolQueueStats() {
+  StatsRegistry reg;
+  StatHistogram* wait = reg.Histogram("dio.queue_wait_us",
+                                      StatsRegistry::LatencyBucketsUs());
+  StatHistogram* service = reg.Histogram("dio.service_us",
+                                         StatsRegistry::LatencyBucketsUs());
+  WorkerPool pool(1);
+  pool.SetStats(wait, service);
+  // One slow task at the head of a 1-thread pool: the tasks behind it
+  // must observe queue wait >= its service time.
+  pool.Submit([] { usleep(30 * 1000); });
+  for (int i = 0; i < 3; ++i) pool.Submit([] {});
+  pool.Stop();  // drain-then-join
+  CHECK_EQ(service->count(), 4);
+  CHECK_EQ(wait->count(), 4);
+  CHECK(service->sum() >= 30000);
+  CHECK(wait->sum() >= 30000);  // the queued tasks sat behind the sleeper
+}
+
+static void TestStatsRegistryPruneGauges() {
+  StatsRegistry reg;
+  reg.SetGauge("sync.peer.10.0.0.2:23000.lag_s", 4);
+  reg.SetGauge("sync.peer.10.0.0.2:23000.connected", 1);
+  reg.SetGauge("sync.peer.10.0.0.3:23000.lag_s", 9);
+  reg.SetGauge("server.connections", 2);  // outside the prefix: untouched
+  // Peer .3 left the group: prune everything under sync.peer. except
+  // the surviving peer's family.
+  int removed = reg.PruneGauges("sync.peer.",
+                                {"sync.peer.10.0.0.2:23000."});
+  CHECK_EQ(removed, 1);
+  std::string json = reg.Json();
+  CHECK(json.find("10.0.0.3") == std::string::npos);
+  CHECK(json.find("sync.peer.10.0.0.2:23000.lag_s") != std::string::npos);
+  CHECK(json.find("server.connections") != std::string::npos);
+  // Re-appearing peer just re-registers (SetGauge is find-or-create).
+  reg.SetGauge("sync.peer.10.0.0.3:23000.lag_s", 1);
+  CHECK(reg.Json().find("10.0.0.3") != std::string::npos);
+}
+
 int main() {
   TestEndian();
   TestBase64();
@@ -307,6 +425,11 @@ int main() {
   TestTraceRing();
   TestTraceRingThreaded();
   TestTraceCorrelator();
+  TestEventLog();
+  TestEventLogThreaded();
+  TestEventLoopLagHook();
+  TestWorkerPoolQueueStats();
+  TestStatsRegistryPruneGauges();
   if (g_failures == 0) {
     std::printf("common_test: ALL PASS\n");
     return 0;

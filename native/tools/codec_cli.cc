@@ -35,6 +35,7 @@
 
 #include "common/bytes.h"
 #include "common/cdc.h"
+#include "common/eventlog.h"
 #include "common/fileid.h"
 #include "common/http_token.h"
 #include "common/protocol_gen.h"
@@ -306,6 +307,28 @@ int main(int argc, char** argv) {
     PutInt64BE(static_cast<int64_t>(lens[0] + lens[2]), num);
     pre.append(reinterpret_cast<char*>(num), 8);
     printf("chunks_prefix=%s\n", hex(pre).c_str());
+    return 0;
+  }
+  if (cmd == "event-json") {
+    // Fixed fixture — tests/test_observability.py decodes this with
+    // fastdfs_tpu.monitor.decode_events and asserts every field,
+    // pinning the EVENT_DUMP wire contract across languages (the
+    // flight-recorder twin of trace-json).
+    EventLog log(8);
+    log.Record(EventSeverity::kWarn, "chunk.quarantined",
+               "00112233445566778899aabbccddeeff00112233",
+               "spi=0 bytes=8192");
+    log.Record(EventSeverity::kInfo, "chunk.repaired",
+               "00112233445566778899aabbccddeeff00112233", "spi=0 by=replica");
+    log.Record(EventSeverity::kError, "chunk.unrepairable",
+               "ffeeddccbbaa99887766554433221100ffeeddcc",
+               "spi=1 reason=no_replica");
+    log.Record(EventSeverity::kWarn, "request.slow", "storage.upload_file",
+               "peer=10.0.0.9 dur_us=2500000 status=0");
+    // Escaping coverage: a hostile key must stay valid JSON.
+    log.Record(EventSeverity::kInfo, "config.anomaly",
+               "weird\"key\\with\nescapes", "detail=1");
+    printf("%s\n", log.Json("storage", 23000).c_str());
     return 0;
   }
   if (cmd == "scrub-status") {

@@ -3,6 +3,7 @@
 #include <cstdio>
 #include <cstring>
 
+#include "common/eventlog.h"
 #include "common/fileid.h"
 #include "common/log.h"
 #include "common/protocol_gen.h"
@@ -98,6 +99,11 @@ std::optional<std::vector<StorageNode>> Cluster::Join(
   FDFS_LOG_INFO("storage %s %s group %s (members=%zu)", addr.c_str(),
                 fresh ? "joined" : "rejoined", group.c_str(),
                 g.storages.size());
+  if (events_ != nullptr)
+    events_->Record(EventSeverity::kInfo,
+                    fresh ? "storage.joined" : "storage.rejoined", addr,
+                    "group=" + group +
+                        " members=" + std::to_string(g.storages.size()));
   return Peers(group, addr);
 }
 
@@ -119,6 +125,9 @@ bool Cluster::Beat(const std::string& group, const std::string& ip, int port,
   if (n->status == kOffline) {
     FDFS_LOG_INFO("storage %s back ONLINE in group %s", n->Addr().c_str(),
                   group.c_str());
+    if (events_ != nullptr)
+      events_->Record(EventSeverity::kInfo, "storage.online", n->Addr(),
+                      "group=" + group);
   }
   // A beat never promotes a full-syncing server — only sync progress does.
   if (n->status != kWaitSync && n->status != kSyncing) n->status = kActive;
@@ -322,6 +331,11 @@ int Cluster::CheckAlive(int64_t now, int64_t timeout_s) {
         FDFS_LOG_WARN("storage %s in group %s OFFLINE (silent %llds)",
                       addr.c_str(), gname.c_str(),
                       static_cast<long long>(now - s.last_beat));
+        if (events_ != nullptr)
+          events_->Record(
+              EventSeverity::kWarn, "storage.offline", addr,
+              "group=" + gname +
+                  " silent_s=" + std::to_string(now - s.last_beat));
       }
     }
     // A syncing dest whose assigned source died would otherwise wait

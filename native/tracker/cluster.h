@@ -83,6 +83,11 @@ class Cluster {
       : store_lookup_(store_lookup), store_group_(std::move(store_group)),
         trunk_enabled_(trunk_enabled) {}
 
+  // Flight recorder (may stay null): membership transitions — joins,
+  // beat-timeout OFFLINE, back-online — become structured cluster
+  // events behind TrackerCmd::kEventDump.  Set once before serving.
+  void set_events(class EventLog* events) { events_ = events; }
+
   // -- membership (tracker_mem_add_storage / beats) ----------------------
   // nullopt: rejected (another member already owns this IP on a different
   // port — file-ID source identity is IP-only, so one member per IP).
@@ -197,6 +202,7 @@ class Cluster {
   std::string store_group_;
   bool trunk_enabled_;
   size_t rr_group_ = 0;
+  class EventLog* events_ = nullptr;
 };
 
 }  // namespace fdfs

@@ -60,6 +60,13 @@ int main(int argc, char** argv) {
   cfg.slow_request_threshold_ms =
       ini.GetInt("slow_request_threshold_ms", cfg.slow_request_threshold_ms);
   if (cfg.slow_request_threshold_ms < 0) cfg.slow_request_threshold_ms = 0;
+  // Same clamps as the storage daemon's config loader: the ring is
+  // RAM-resident (each slot ~a few hundred bytes), so an absurd value
+  // must not turn into a startup-time bad_alloc.
+  int64_t ebs = ini.GetInt("event_buffer_size", cfg.event_buffer_size);
+  if (ebs < 16) ebs = 16;
+  if (ebs > (1 << 20)) ebs = 1 << 20;
+  cfg.event_buffer_size = static_cast<int>(ebs);
   if (cfg.base_path.empty()) {
     std::fprintf(stderr, "config error: base_path is required\n");
     return 1;

@@ -102,8 +102,44 @@ bool TrackerServer::Init(std::string* error) {
     *error = "cannot create " + cfg_.base_path + "/data";
     return false;
   }
+  // Flight recorder before the cluster brain: membership transitions
+  // record into it from the first JOIN on.
+  events_ = std::make_unique<EventLog>(
+      static_cast<size_t>(cfg_.event_buffer_size));
   cluster_ = std::make_unique<Cluster>(cfg_.store_lookup, cfg_.store_group,
                                        cfg_.use_trunk_file);
+  cluster_->set_events(events_.get());
+
+  // Saturation telemetry (ISSUE 6): the tracker's single nio loop is
+  // the whole daemon — a slow handler here stalls every beat and every
+  // routing query in the cluster.  Same registry contract as the
+  // storage STAT so fdfs_top renders one table for both roles.
+  hist_nio_lag_ = registry_.Histogram("nio.loop_lag_us",
+                                      StatsRegistry::LatencyBucketsUs());
+  ctr_nio_dispatched_ = registry_.Counter("nio.dispatched_ops");
+  registry_.GaugeFn("nio.conns_active", [this] {
+    return server_ != nullptr ? server_->conn_count() : int64_t{0};
+  });
+  ctr_requests_ = registry_.Counter("server.requests");
+  ctr_errors_ = registry_.Counter("server.errors");
+  hist_request_us_ = registry_.Histogram("server.request_us",
+                                         StatsRegistry::LatencyBucketsUs());
+  registry_.GaugeFn("server.refused_connections", [this] {
+    return server_ != nullptr ? server_->refused_count() : int64_t{0};
+  });
+  registry_.GaugeFn("events.recorded", [this] { return events_->recorded(); });
+  registry_.GaugeFn("events.dropped", [this] { return events_->dropped(); });
+  registry_.GaugeFn("trace.spans_recorded", [this] {
+    return trace_ != nullptr ? trace_->recorded() : int64_t{0};
+  });
+  registry_.GaugeFn("trace.spans_dropped", [this] {
+    return trace_ != nullptr ? trace_->dropped() : int64_t{0};
+  });
+  loop_.set_iteration_hook([this](int64_t busy_us, int n_events) {
+    hist_nio_lag_->Observe(busy_us);
+    if (n_events > 0)
+      ctr_nio_dispatched_->fetch_add(n_events, std::memory_order_relaxed);
+  });
   if (cfg_.use_storage_id && !cfg_.storage_ids_file.empty()) {
     // storage_ids.conf: "<id> <group> <ip>" per line (fdfs_shared_func.c:
     // fdfs_get_storage_ids_from_tracker_group table format).
@@ -140,6 +176,12 @@ bool TrackerServer::Init(std::string* error) {
   server_->set_trace_hook([this](uint8_t cmd, const TraceCtx& ctx,
                                  int64_t start_us, int64_t dur_us,
                                  uint8_t status, const std::string& peer) {
+    // Request accounting rides the per-dispatch hook (the tracker has
+    // no LogAccess choke point): aggregate count/errors/latency feeding
+    // the kStat registry and fdfs_top's tracker row.
+    ctr_requests_->fetch_add(1, std::memory_order_relaxed);
+    if (status != 0) ctr_errors_->fetch_add(1, std::memory_order_relaxed);
+    hist_request_us_->Observe(dur_us);
     int64_t slow_us = cfg_.slow_request_threshold_ms * 1000;
     bool slow = slow_us > 0 && dur_us >= slow_us;
     if (!ctx.valid() && !slow) return;
@@ -159,9 +201,13 @@ bool TrackerServer::Init(std::string* error) {
     }
     s.SetName(name);
     trace_->Record(s);
-    if (slow)
+    if (slow) {
       FDFS_LOG_WARN("%s",
                     SlowRequestJson("tracker", s.name, s, peer, 0).c_str());
+      events_->Record(EventSeverity::kWarn, "request.slow", s.name,
+                      "peer=" + peer + " dur_us=" + std::to_string(dur_us) +
+                          " status=" + std::to_string(status));
+    }
   });
   if (!server_->Listen(cfg_.bind_addr, cfg_.port, error)) return false;
 
@@ -274,6 +320,11 @@ void TrackerServer::Stop() {
 
 void TrackerServer::DumpState() {
   FDFS_LOG_INFO("tracker state: %s", cluster_->GroupsJson().c_str());
+  // SIGUSR1 postmortem dump: the retained event ring as one JSON line
+  // (the kEventDump contract), next to the cluster state.
+  if (events_ != nullptr)
+    FDFS_LOG_INFO("event dump: %s",
+                  events_->Json("tracker", cfg_.port).c_str());
 }
 
 std::pair<uint8_t, std::string> TrackerServer::Handle(
@@ -612,6 +663,18 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       // contract decoded by fastdfs_tpu.trace.decode_dump.
       return {0, trace_ != nullptr ? trace_->Json("tracker", cfg_.port)
                                    : "{\"role\":\"tracker\",\"spans\":[]}"};
+
+    case TrackerCmd::kStat:
+      // Stats-registry snapshot (empty body): same JSON contract as
+      // StorageCmd::kStat — the tracker's loop-lag/request telemetry.
+      return {0, registry_.Json()};
+
+    case TrackerCmd::kEventDump:
+      // Flight-recorder dump (empty body): membership transitions and
+      // slow requests, per fastdfs_tpu.monitor.decode_events.
+      return {0, events_ != nullptr
+                     ? events_->Json("tracker", cfg_.port)
+                     : "{\"role\":\"tracker\",\"events\":[]}"};
 
     case TrackerCmd::kServerClusterStat: {
       // One-RPC observability dump: tracker role + every group/storage

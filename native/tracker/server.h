@@ -10,8 +10,10 @@
 #include <string>
 #include <vector>
 
+#include "common/eventlog.h"
 #include "common/net.h"
 #include "common/req_server.h"
+#include "common/stats.h"
 #include "tracker/cluster.h"
 #include "tracker/relationship.h"
 
@@ -54,6 +56,10 @@ struct TrackerConfig {
   // gate off.
   int trace_buffer_size = 2048;
   int64_t slow_request_threshold_ms = 1000;
+  // Flight recorder (common/eventlog.h): capacity of the bounded ring
+  // of structured cluster events (membership transitions, slow
+  // requests) dumped via TrackerCmd::kEventDump and on SIGUSR1.
+  int event_buffer_size = 256;
 };
 
 class TrackerServer {
@@ -79,6 +85,18 @@ class TrackerServer {
   TrackerConfig cfg_;
   std::map<std::string, int64_t> trunk_fetched_ms_;  // follower cache age
   std::unique_ptr<TraceRing> trace_;  // span buffer behind kTraceDump
+  // Flight recorder behind kEventDump + the SIGUSR1 dump.
+  std::unique_ptr<EventLog> events_;
+  // Saturation telemetry behind the new kStat opcode (ISSUE 6): the
+  // tracker's event-loop lag, dispatched ops, live connections, and
+  // aggregate request accounting — same registry JSON contract as the
+  // storage daemon's STAT.
+  StatsRegistry registry_;
+  StatHistogram* hist_nio_lag_ = nullptr;
+  std::atomic<int64_t>* ctr_nio_dispatched_ = nullptr;
+  std::atomic<int64_t>* ctr_requests_ = nullptr;
+  std::atomic<int64_t>* ctr_errors_ = nullptr;
+  StatHistogram* hist_request_us_ = nullptr;
   std::unique_ptr<Cluster> cluster_;
   std::unique_ptr<RelationshipManager> relationship_;
   EventLoop loop_;

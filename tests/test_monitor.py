@@ -83,6 +83,10 @@ def _sample_registry() -> dict:
                    # pressure and the slow-request gate
                    "trace.spans_recorded": 12, "trace.spans_dropped": 3,
                    "trace.slow_requests": 1,
+                   # saturation telemetry (ISSUE 6): live conns, dio queue
+                   # depth, flight-recorder throughput
+                   "nio.conns_active": 2, "dio.queue_depth": 1,
+                   "events.recorded": 7, "events.dropped": 0,
                    # integrity engine (PR 4): scrub/quarantine/GC health
                    "scrub.chunks_verified": 500, "scrub.chunks_corrupt": 2,
                    "scrub.chunks_repaired": 1,
@@ -96,6 +100,26 @@ def _sample_registry() -> dict:
                 "counts": [1, 2, 0, 1],
                 "sum": 120000,
                 "count": 4,
+            },
+            # Saturation telemetry (ISSUE 6): event-loop lag + dio queue
+            # health export as standard cumulative histograms.
+            "nio.loop_lag_us": {
+                "bounds": [100, 1000, 10000],
+                "counts": [5, 1, 1, 0],
+                "sum": 13000,
+                "count": 7,
+            },
+            "dio.queue_wait_us": {
+                "bounds": [100, 1000, 10000],
+                "counts": [2, 0, 0, 1],
+                "sum": 50100,
+                "count": 3,
+            },
+            "dio.service_us": {
+                "bounds": [100, 1000, 10000],
+                "counts": [0, 3, 0, 0],
+                "sum": 1500,
+                "count": 3,
             },
         },
     }
@@ -230,6 +254,22 @@ def test_prometheus_exposition_parses():
     assert values[-1] == 4.0  # +Inf == count
     assert series["fdfs_op_upload_file_latency_us_count"][0][1] == 4.0
     assert series["fdfs_op_upload_file_latency_us_sum"][0][1] == 120000.0
+    # Saturation-telemetry golden (ISSUE 6): EVERY registry histogram —
+    # including the new nio.*/dio.* ones — exports cumulative
+    # _bucket{le=...}/_sum/_count series, and the gauges ride along.
+    for base, count, total in (("fdfs_nio_loop_lag_us", 7.0, 13000.0),
+                               ("fdfs_dio_queue_wait_us", 3.0, 50100.0),
+                               ("fdfs_dio_service_us", 3.0, 1500.0)):
+        bvals = [v for _, v in series[f"{base}_bucket"]]
+        assert bvals == sorted(bvals), f"{base} buckets must be cumulative"
+        assert bvals[-1] == count  # +Inf == count
+        assert series[f"{base}_count"][0][1] == count
+        assert series[f"{base}_sum"][0][1] == total
+    assert series["fdfs_dio_queue_wait_us_bucket"][0] == (
+        '{storage="127.0.0.1:23000",le="100"}', 2.0)
+    assert series["fdfs_nio_conns_active"][0][1] == 2.0
+    assert series["fdfs_dio_queue_depth"][0][1] == 1.0
+    assert series["fdfs_events_recorded"][0][1] == 7.0
 
 
 def test_prometheus_multi_storage_groups_by_metric_name():

@@ -12,7 +12,7 @@ mkdir -p build/obj
 
 srcs_common="common/bytes.cc common/cdc.cc common/fileid.cc common/ini.cc
   common/log.cc common/net.cc common/req_server.cc common/stats.cc
-  common/trace.cc common/fsutil.cc common/http_token.cc"
+  common/trace.cc common/eventlog.cc common/fsutil.cc common/http_token.cc"
 srcs_storage="storage/chunkstore.cc storage/config.cc storage/store.cc
   storage/binlog.cc storage/trunk.cc storage/recovery.cc storage/scrub.cc storage/dedup.cc
   storage/server.cc storage/sync.cc storage/tracker_client.cc"

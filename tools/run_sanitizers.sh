@@ -19,7 +19,7 @@ else
     tests/test_chunked_storage.py tests/test_disk_recovery.py
     tests/test_multi_tracker.py tests/test_trace.py
     tests/test_dedup_upload.py tests/test_scrub.py
-    tests/test_read_path.py)
+    tests/test_read_path.py tests/test_observability.py)
 fi
 
 run_one() {
@@ -32,6 +32,9 @@ run_one() {
   # common_test's TestTraceRingThreaded hammers the lock-light span ring
   # from 4 recorders + a dumping reader — the TSan run is the proof the
   # seqlock-free design is data-race-free, not just lucky.
+  # TestEventLogThreaded does the same for the flight recorder, and
+  # TestEventLoopLagHook/TestWorkerPoolQueueStats cover the ISSUE 6
+  # saturation instrumentation (loop-lag hook, dio queue histograms).
   "$dir/common_test"
   # storage_test's TestChunkStoreStripedConcurrency hammers the
   # digest-striped chunk store + hot-chunk read cache from concurrent
