@@ -405,6 +405,44 @@ class StorageCmd(enum.IntEnum):
     ACTIVE_TEST = 111
 
 
+# ---------------------------------------------------------------------------
+# Wire-contract annotations, consumed by native/gen_protocol.py when it
+# emits native/protocol_manifest.json (the machine-readable contract
+# tools/fdfs_lint.py checks the tree against).
+#
+# NO_WIRE_BODY names opcodes whose request AND response bodies are empty
+# or pure status — nothing to pin with a golden.  Every other opcode
+# carries a structured body; WIRE_GOLDENS maps those covered by an
+# `fdfs_codec <name>` cross-language golden fixture.  An opcode with a
+# wire body and no golden must be allowlisted (with a reason) in
+# tools/fdfs_lint.py's golden-coverage check — adding an opcode without
+# deciding its golden story fails the linter by design.
+# ---------------------------------------------------------------------------
+
+NO_WIRE_BODY = frozenset({
+    "TrackerCmd.QUIT",            # empty body, no response
+    "TrackerCmd.RESP",            # pseudo-opcode: the response header itself
+    "TrackerCmd.ACTIVE_TEST",     # empty ping, status-only answer
+    "StorageCmd.RESP",
+    "StorageCmd.ACTIVE_TEST",
+})
+
+WIRE_GOLDENS = {
+    "TrackerCmd.SERVER_CLUSTER_STAT": "stats-json",  # embeds beat-stat names
+    "TrackerCmd.TRACE_DUMP": "trace-json",
+    "TrackerCmd.STAT": "stats-json",
+    "TrackerCmd.EVENT_DUMP": "event-json",
+    "TrackerCmd.TRACE_CTX": "trace-ctx",
+    "StorageCmd.STAT": "stats-json",
+    "StorageCmd.TRACE_DUMP": "trace-json",
+    "StorageCmd.EVENT_DUMP": "event-json",
+    "StorageCmd.TRACE_CTX": "trace-ctx",
+    "StorageCmd.SCRUB_STATUS": "scrub-status",
+    "StorageCmd.UPLOAD_RECIPE": "ingest-wire",
+    "StorageCmd.UPLOAD_CHUNKS": "ingest-wire",
+}
+
+
 class Status(enum.IntEnum):
     """Header status byte: 0 = OK, otherwise an errno-style code."""
 

@@ -31,10 +31,9 @@ void EventLog::Record(EventSeverity sev, const char* type,
   ev.SetType(type);
   ev.SetKey(key.c_str());
   ev.SetDetail(detail.c_str());
-  LockSlot(slot);
+  SpinGuard guard(slot->lock);
   slot->ev = ev;
   slot->used = true;
-  UnlockSlot(slot);
   recorded_.fetch_add(1, std::memory_order_relaxed);
 }
 
@@ -70,9 +69,8 @@ std::string EventLog::Json(const std::string& role, int port) const {
   evs.reserve(cap_);
   for (size_t i = 0; i < cap_; ++i) {
     Slot* slot = &slots_[i];
-    LockSlot(slot);
+    SpinGuard guard(slot->lock);
     if (slot->used) evs.push_back(slot->ev);
-    UnlockSlot(slot);
   }
   std::sort(evs.begin(), evs.end(),
             [](const ClusterEvent& a, const ClusterEvent& b) {

@@ -29,6 +29,8 @@
 #pragma once
 
 #include <atomic>
+
+#include "common/lockrank.h"
 #include <cstdint>
 #include <cstring>
 #include <memory>
@@ -78,17 +80,10 @@ class EventLog {
 
  private:
   struct Slot {
-    std::atomic<bool> locked{false};
+    RankedSpinLock lock{LockRank::kEventSlot};
     bool used = false;
     ClusterEvent ev;
   };
-  void LockSlot(Slot* s) const {
-    while (s->locked.exchange(true, std::memory_order_acquire)) {
-    }
-  }
-  void UnlockSlot(Slot* s) const {
-    s->locked.store(false, std::memory_order_release);
-  }
 
   size_t cap_;
   std::unique_ptr<Slot[]> slots_;

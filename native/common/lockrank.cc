@@ -1,0 +1,111 @@
+#include "common/lockrank.h"
+
+#include <cstdio>
+#include <cstdlib>
+
+namespace fdfs {
+
+const char* LockRankName(LockRank r) {
+  switch (r) {
+    case LockRank::kTrunkRole: return "server.trunk_role";
+    case LockRank::kTrackerReporter: return "tracker_client.reporter";
+    case LockRank::kScrub: return "scrub.manager";
+    case LockRank::kRelationship: return "tracker.relationship";
+    case LockRank::kDedupEngine: return "dedup.engine";
+    case LockRank::kDedupPool: return "dedup.sidecar_pool";
+    case LockRank::kStatsRegistry: return "stats.registry";
+    case LockRank::kSync: return "sync.manager";
+    case LockRank::kChunkStripe: return "chunkstore.stripe";
+    case LockRank::kReadCache: return "chunkstore.read_cache";
+    case LockRank::kTrunkAlloc: return "trunk.allocator";
+    case LockRank::kBinlog: return "binlog.append";
+    case LockRank::kIngestSessions: return "server.ingest_sessions";
+    case LockRank::kBusyFiles: return "server.busy_files";
+    case LockRank::kWorkers: return "workers.pool";
+    case LockRank::kLoopPost: return "net.loop_post";
+    case LockRank::kTraceCorrelator: return "trace.correlator";
+    case LockRank::kAccessLog: return "server.access_log";
+    case LockRank::kTraceSlot: return "trace.ring_slot";
+    case LockRank::kEventSlot: return "eventlog.ring_slot";
+    case LockRank::kLog: return "log.global";
+    case LockRank::kToolOutput: return "tool.output";
+  }
+  return "unknown";
+}
+
+namespace lockrank_detail {
+
+namespace {
+
+struct Held {
+  const void* lock;
+  LockRank rank;
+  int order_key;
+};
+
+// Deep enough for the worst legitimate chain (RefAll's 16 ascending
+// stripes + a leaf or two); overflow is itself reported as a bug.
+constexpr int kMaxHeld = 24;
+thread_local Held t_held[kMaxHeld];
+thread_local int t_held_n = 0;
+
+[[noreturn]] void Die(const char* why, LockRank rank, int order_key) {
+  // Raw stderr, not FDFS_LOG: the logger's own mutex is rank-checked
+  // and the violating thread may already hold it.
+  fprintf(stderr,
+          "fdfs lockrank: %s acquiring %s (rank %u, key %d)\n",
+          why, LockRankName(rank), static_cast<unsigned>(rank), order_key);
+  fprintf(stderr, "fdfs lockrank: held by this thread (oldest first):\n");
+  for (int i = 0; i < t_held_n; ++i)
+    fprintf(stderr, "fdfs lockrank:   [%d] %s (rank %u, key %d)\n", i,
+            LockRankName(t_held[i].rank),
+            static_cast<unsigned>(t_held[i].rank), t_held[i].order_key);
+  fflush(stderr);
+  abort();
+}
+
+}  // namespace
+
+void PushOrDie(const void* lock, LockRank rank, int order_key) {
+  if (t_held_n >= kMaxHeld)
+    Die("held-lock stack overflow", rank, order_key);
+  for (int i = 0; i < t_held_n; ++i)
+    if (t_held[i].lock == lock)
+      Die("recursive acquisition", rank, order_key);
+  if (t_held_n > 0) {
+    const Held& top = t_held[t_held_n - 1];
+    if (rank < top.rank)
+      Die("rank inversion", rank, order_key);
+    if (rank == top.rank) {
+      // Same-rank nesting is legal ONLY for order-keyed locks taken in
+      // strictly ascending key order (the chunk-store ascending-stripe
+      // protocol, chunkstore.h RefAll).
+      if (order_key < 0 || top.order_key < 0 || order_key <= top.order_key)
+        Die("same-rank acquisition out of ascending key order", rank,
+            order_key);
+    }
+  }
+  t_held[t_held_n++] = Held{lock, rank, order_key};
+}
+
+void Pop(const void* lock) {
+  // Scan from the top: releases are almost always LIFO, but guard
+  // objects CAN unlock out of order (moved unique_locks), which is
+  // fine — only acquisition order is constrained.
+  for (int i = t_held_n - 1; i >= 0; --i) {
+    if (t_held[i].lock == lock) {
+      for (int j = i; j < t_held_n - 1; ++j) t_held[j] = t_held[j + 1];
+      --t_held_n;
+      return;
+    }
+  }
+  // Unlocking a lock we never pushed: try_lock raced, or a lock taken
+  // before enforcement began — ignore rather than abort (unlock cannot
+  // deadlock).
+}
+
+int HeldCount() { return t_held_n; }
+
+}  // namespace lockrank_detail
+
+}  // namespace fdfs

@@ -1,0 +1,221 @@
+// Lock-rank discipline: every mutex in the native tree is a RankedMutex
+// (or RankedSpinLock) carrying a documented rank, and under a
+// -DFDFS_LOCKRANK build each thread keeps a held-rank stack and ABORTS
+// (printing both lock sites) the moment any acquisition violates the
+// global order.  tools/fdfs_lint.py statically refuses raw std::mutex /
+// pthread_mutex_t members anywhere outside this header, so the rank
+// table below is, by construction, the complete lock inventory.
+//
+// Reference departure: upstream FastDFS orders its pthread mutexes by
+// convention only (storage_service.c vs trunk_mgr vs tracker_mem) and
+// re-derives the order per review.  Five PRs of growth here built a
+// 16-way striped chunk-store protocol, per-slot spin rings, and a dozen
+// component mutexes; ROADMAP items 1/2/5 (trunk slabs, multi-reactor
+// nio, rebalance) all multiply the lock sites.  This header makes the
+// ordering a compiled-in, machine-checked contract instead of reviewer
+// memory.
+//
+// The ordering rule: a thread may only acquire a lock whose rank is
+// STRICTLY GREATER than every rank it already holds.  Outermost locks
+// therefore get the lowest ranks and leaves (logging, stat slots) the
+// highest.  The single sanctioned exception is SAME-rank acquisition of
+// ORDER-KEYED locks in strictly ascending key order — the chunk-store
+// RefAll all-or-nothing protocol, which locks its digest stripes in
+// ascending stripe-index order (chunkstore.h).  A same-rank acquisition
+// with a non-ascending (or missing) order key aborts like any other
+// inversion.
+//
+// Rank table (also documented in OPERATIONS.md "Static analysis & lock
+// ranks"; keep the two in sync — fdfs_lint's conf/doc parity checks do
+// not cover this table, reviews do):
+//
+//   rank  name              owner / constraint that pins it
+//   ----  ----------------  ---------------------------------------------
+//    10   kTrunkRole        StorageServer::trunk_mu_ — held while reading
+//                           TrackerReporter state (RefreshClusterParams),
+//                           so it must order BEFORE kTrackerReporter.
+//    20   kTrackerReporter  TrackerReporter::mu_ (peer list, identity,
+//                           cluster params, pending sync reports).
+//    30   kScrub            ScrubManager::mu_ (stop/kick signalling only;
+//                           passes run with it released).
+//    40   kRelationship     RelationshipManager::mu_ (tracker leader
+//                           state; logs under it -> before kLog).
+//    50   kDedupEngine      CpuDedup::mu_ (digest maps).
+//    60   kDedupPool        SidecarDedup::mu_ (idle-fd pool).
+//    70   kStatsRegistry    StatsRegistry::mu_ — gauge-fn callbacks run
+//                           UNDER it and read sync lag, chunk-store
+//                           stripe aggregates, the read cache, worker
+//                           queue depths, and ingest sessions, so it
+//                           must order before ALL of those.
+//    80   kSync             SyncManager::mu_ (worker map / peer states;
+//                           read by the sync.lag_s.max gauge-fn, hence
+//                           after kStatsRegistry).
+//    90   kChunkStripe      ChunkStore::Stripe::mu, ORDER-KEYED by
+//                           stripe index: RefAll's all-or-nothing check
+//                           takes its stripes strictly ascending — the
+//                           one sanctioned same-rank multi-acquisition.
+//                           The zero-ref (GC) map lives inside each
+//                           stripe, so it shares this rank by design.
+//   100   kReadCache        ChunkStore::ReadCache::mu — always AFTER a
+//                           stripe lock (insert liveness re-check,
+//                           same-lock invalidation), never before.
+//   110   kTrunkAlloc       TrunkAllocator::mu_ (free-slot map; logs and
+//                           does disk IO under it by design).
+//   120   kBinlog           Binlog::mu_ (append serialization).
+//   130   kIngestSessions   StorageServer::ingest_mu_ (negotiated-upload
+//                           session map; read by a gauge-fn).
+//   140   kBusyFiles        StorageServer::busy_mu_ (per-file-id op
+//                           exclusion set).
+//   150   kWorkers          WorkerPool::mu_ (dio task queues; queue
+//                           depth read by a gauge-fn).
+//   160   kLoopPost         EventLoop::post_mu_ (cross-thread Post).
+//   170   kTraceCorrelator  TraceCorrelator::mu_ (remote -> ctx map).
+//   180   kAccessLog        StorageServer::log_mu_ (access.log writes).
+//   190   kTraceSlot        TraceRing per-slot spinlock (bounded-copy
+//                           critical sections only).
+//   200   kEventSlot        EventLog per-slot spinlock (recorded under
+//                           chunk-store stripe locks: heal-on-upload).
+//   210   kLog              logger global mutex — the ultimate leaf;
+//                           everything may log while holding anything.
+//   220   kToolOutput       CLI tools' output mutex (fdfs_load).
+//
+// Adding a mutex: pick the smallest rank strictly greater than every
+// lock that can be held when yours is acquired and strictly less than
+// every lock acquired while yours is held, add a row HERE and in
+// OPERATIONS.md, then run the daemon suite under
+// `tools/run_sanitizers.sh lockrank` — the runtime checker is the
+// authority on whether your reasoning matched the code.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+
+namespace fdfs {
+
+enum class LockRank : uint16_t {
+  kTrunkRole = 10,
+  kTrackerReporter = 20,
+  kScrub = 30,
+  kRelationship = 40,
+  kDedupEngine = 50,
+  kDedupPool = 60,
+  kStatsRegistry = 70,
+  kSync = 80,
+  kChunkStripe = 90,
+  kReadCache = 100,
+  kTrunkAlloc = 110,
+  kBinlog = 120,
+  kIngestSessions = 130,
+  kBusyFiles = 140,
+  kWorkers = 150,
+  kLoopPost = 160,
+  kTraceCorrelator = 170,
+  kAccessLog = 180,
+  kTraceSlot = 190,
+  kEventSlot = 200,
+  kLog = 210,
+  kToolOutput = 220,
+};
+
+const char* LockRankName(LockRank r);
+
+#ifdef FDFS_LOCKRANK
+inline constexpr bool kLockRankEnforced = true;
+#else
+inline constexpr bool kLockRankEnforced = false;
+#endif
+
+namespace lockrank_detail {
+// Per-thread held-lock bookkeeping (lockrank.cc).  Always compiled so a
+// mixed build cannot silently lose the checker; call sites compile the
+// calls in only under FDFS_LOCKRANK, so unchecked builds pay nothing.
+void PushOrDie(const void* lock, LockRank rank, int order_key);
+void Pop(const void* lock);
+// Test hook: how many locks the calling thread holds right now.
+int HeldCount();
+}  // namespace lockrank_detail
+
+// Drop-in std::mutex replacement satisfying BasicLockable/Lockable, so
+// std::lock_guard<RankedMutex> / std::unique_lock<RankedMutex> (and
+// std::condition_variable_any) work unchanged.  Unchecked builds add
+// two ints of storage and nothing on the lock path.
+class RankedMutex {
+ public:
+  explicit RankedMutex(LockRank rank, int order_key = -1)
+      : rank_(rank), order_key_(order_key) {}
+  RankedMutex(const RankedMutex&) = delete;
+  RankedMutex& operator=(const RankedMutex&) = delete;
+
+  // For rank groups constructed in arrays (the chunk-store stripes):
+  // assign the ascending-protocol key after construction, BEFORE any
+  // concurrent use.
+  void set_order_key(int k) { order_key_ = k; }
+
+  void lock() {
+#ifdef FDFS_LOCKRANK
+    lockrank_detail::PushOrDie(this, rank_, order_key_);
+#endif
+    mu_.lock();
+  }
+  bool try_lock() {
+    // try_lock cannot deadlock, but a successful acquisition still
+    // enters the held stack so LATER acquisitions are checked against
+    // it; an order violation via try_lock is reported like any other.
+    if (!mu_.try_lock()) return false;
+#ifdef FDFS_LOCKRANK
+    lockrank_detail::PushOrDie(this, rank_, order_key_);
+#endif
+    return true;
+  }
+  void unlock() {
+    mu_.unlock();
+#ifdef FDFS_LOCKRANK
+    lockrank_detail::Pop(this);
+#endif
+  }
+
+  LockRank rank() const { return rank_; }
+  int order_key() const { return order_key_; }
+
+ private:
+  std::mutex mu_;
+  LockRank rank_;
+  int order_key_;
+};
+
+// Ranked spinlock for the per-slot rings (trace.h, eventlog.h): the
+// same acquire/release atomics as before (TSan sees the
+// happens-before), now with the rank check in front.  Critical sections
+// must stay bounded copies — fdfs_lint's spin-region scan refuses
+// blocking syscalls between lock() and unlock().
+class RankedSpinLock {
+ public:
+  explicit RankedSpinLock(LockRank rank) : rank_(rank) {}
+  RankedSpinLock(const RankedSpinLock&) = delete;
+  RankedSpinLock& operator=(const RankedSpinLock&) = delete;
+
+  void lock() {
+#ifdef FDFS_LOCKRANK
+    lockrank_detail::PushOrDie(this, rank_, -1);
+#endif
+    while (locked_.exchange(true, std::memory_order_acquire)) {
+    }
+  }
+  void unlock() {
+    locked_.store(false, std::memory_order_release);
+#ifdef FDFS_LOCKRANK
+    lockrank_detail::Pop(this);
+#endif
+  }
+
+  LockRank rank() const { return rank_; }
+
+ private:
+  std::atomic<bool> locked_{false};
+  LockRank rank_;
+};
+
+using SpinGuard = std::lock_guard<RankedSpinLock>;
+
+}  // namespace fdfs

@@ -8,6 +8,8 @@
 #include <ctime>
 #include <mutex>
 
+#include "common/lockrank.h"
+
 namespace fdfs {
 
 namespace {
@@ -18,7 +20,7 @@ int64_t g_rotate_bytes = 256LL << 20;  // 0 = no size rotation
 bool g_rotate_daily = true;
 int64_t g_written = 0;   // bytes since open (approximate)
 int g_open_day = -1;     // yday at open
-std::mutex g_mu;
+RankedMutex g_mu{LockRank::kLog};
 const char* kNames[] = {"DEBUG", "INFO", "WARN", "ERROR"};
 
 int TodayYday() {
@@ -58,7 +60,7 @@ void LogSetLevel(LogLevel level) { g_level = level; }
 LogLevel LogGetLevel() { return g_level; }
 
 void LogSetFile(const std::string& path) {
-  std::lock_guard<std::mutex> lk(g_mu);
+  std::lock_guard<RankedMutex> lk(g_mu);
   if (g_out != nullptr) {
     fclose(g_out);
     g_out = nullptr;
@@ -75,7 +77,7 @@ void LogSetFile(const std::string& path) {
 }
 
 void LogSetRotation(int64_t max_bytes, bool daily) {
-  std::lock_guard<std::mutex> lk(g_mu);
+  std::lock_guard<RankedMutex> lk(g_mu);
   g_rotate_bytes = max_bytes;
   g_rotate_daily = daily;
 }
@@ -97,7 +99,7 @@ void LogV(LogLevel level, const char* fmt, va_list ap) {
   struct tm tmv;
   localtime_r(&now, &tmv);
   strftime(ts, sizeof(ts), "%Y-%m-%d %H:%M:%S", &tmv);
-  std::lock_guard<std::mutex> lk(g_mu);
+  std::lock_guard<RankedMutex> lk(g_mu);
   MaybeRotateLocked();
   FILE* out = g_out != nullptr ? g_out : stderr;
   int n = fprintf(out, "[%s] %s ", ts, kNames[static_cast<int>(level)]);

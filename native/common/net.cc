@@ -209,7 +209,7 @@ EventLoop::~EventLoop() {
 
 void EventLoop::Post(std::function<void()> fn) {
   {
-    std::lock_guard<std::mutex> lk(post_mu_);
+    std::lock_guard<RankedMutex> lk(post_mu_);
     posted_.push_back(std::move(fn));
   }
   uint64_t one = 1;
@@ -229,7 +229,7 @@ int EventLoop::DrainPosted() {
   for (;;) {
     std::function<void()> fn;
     {
-      std::lock_guard<std::mutex> lk(post_mu_);
+      std::lock_guard<RankedMutex> lk(post_mu_);
       if (posted_.empty()) break;
       fn = std::move(posted_.front());
       posted_.pop_front();

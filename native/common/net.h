@@ -12,6 +12,8 @@
 #include <cstdint>
 #include <deque>
 #include <functional>
+
+#include "common/lockrank.h"
 #include <map>
 #include <mutex>
 #include <string>
@@ -92,7 +94,7 @@ class EventLoop {
   int epfd_;
   int wake_fd_ = -1;  // eventfd: Post()/cross-thread Stop() wakeups
   IterationHook iteration_hook_;
-  std::mutex post_mu_;
+  RankedMutex post_mu_{LockRank::kLoopPost};
   std::deque<std::function<void()>> posted_;
   std::atomic<bool> running_{false};
   // Separate latch so a Stop() that lands BEFORE the loop thread reaches

@@ -54,14 +54,14 @@ void StatHistogram::Observe(int64_t v) {
 }
 
 StatsRegistry::Value* StatsRegistry::Counter(const std::string& name) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   auto& slot = counters_[name];
   if (slot == nullptr) slot = std::make_unique<Value>(0);
   return slot.get();
 }
 
 StatsRegistry::Value* StatsRegistry::Gauge(const std::string& name) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   auto& slot = gauges_[name];
   if (slot == nullptr) slot = std::make_unique<Value>(0);
   return slot.get();
@@ -73,13 +73,13 @@ void StatsRegistry::SetGauge(const std::string& name, int64_t v) {
 
 void StatsRegistry::GaugeFn(const std::string& name,
                             std::function<int64_t()> fn) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   gauge_fns_[name] = std::move(fn);
 }
 
 StatHistogram* StatsRegistry::Histogram(const std::string& name,
                                         std::vector<int64_t> bounds) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   auto& slot = histograms_[name];
   if (slot == nullptr) slot = std::make_unique<StatHistogram>(std::move(bounds));
   return slot.get();
@@ -87,7 +87,7 @@ StatHistogram* StatsRegistry::Histogram(const std::string& name,
 
 int StatsRegistry::PruneGauges(const std::string& prefix,
                                const std::vector<std::string>& keep) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   int removed = 0;
   for (auto it = gauges_.lower_bound(prefix); it != gauges_.end();) {
     const std::string& name = it->first;
@@ -110,7 +110,7 @@ int StatsRegistry::PruneGauges(const std::string& prefix,
 }
 
 std::string StatsRegistry::Json() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   std::string out = "{\"counters\":{";
   bool first = true;
   for (const auto& [name, v] : counters_) {

@@ -18,6 +18,8 @@
 #pragma once
 
 #include <atomic>
+
+#include "common/lockrank.h"
 #include <cstdint>
 #include <functional>
 #include <map>
@@ -87,7 +89,7 @@ class StatsRegistry {
   static std::vector<int64_t> SizeBucketsBytes();   // 1KiB .. 1GiB, x4
 
  private:
-  mutable std::mutex mu_;
+  mutable RankedMutex mu_{LockRank::kStatsRegistry};
   std::map<std::string, std::unique_ptr<Value>> counters_;
   std::map<std::string, std::unique_ptr<Value>> gauges_;
   std::map<std::string, std::function<int64_t()>> gauge_fns_;

@@ -74,10 +74,9 @@ uint64_t TraceRing::NewTraceId() {
 void TraceRing::Record(const TraceSpan& s) {
   size_t idx = static_cast<size_t>(head_.fetch_add(1)) % cap_;
   Slot* slot = &slots_[idx];
-  LockSlot(slot);
+  SpinGuard guard(slot->lock);
   slot->span = s;
   slot->used = true;
-  UnlockSlot(slot);
   recorded_.fetch_add(1, std::memory_order_relaxed);
 }
 
@@ -86,9 +85,8 @@ std::string TraceRing::Json(const std::string& role, int port) const {
   spans.reserve(cap_);
   for (size_t i = 0; i < cap_; ++i) {
     Slot* slot = &slots_[i];
-    LockSlot(slot);
+    SpinGuard guard(slot->lock);
     if (slot->used) spans.push_back(slot->span);
-    UnlockSlot(slot);
   }
   std::sort(spans.begin(), spans.end(),
             [](const TraceSpan& a, const TraceSpan& b) {
@@ -134,7 +132,7 @@ std::string SlowRequestJson(const std::string& role, const char* op,
 }
 
 void TraceCorrelator::Put(const std::string& remote, const TraceCtx& ctx) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   if (entries_.size() >= max_ && entries_.find(remote) == entries_.end()) {
     // Evict the oldest entry (smallest sequence stamp): a stale traced
     // mutation whose sync never shipped should yield to fresh ones.
@@ -147,7 +145,7 @@ void TraceCorrelator::Put(const std::string& remote, const TraceCtx& ctx) {
 }
 
 bool TraceCorrelator::Take(const std::string& remote, TraceCtx* out) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   auto it = entries_.find(remote);
   if (it == entries_.end()) return false;
   *out = it->second.first;
@@ -156,7 +154,7 @@ bool TraceCorrelator::Take(const std::string& remote, TraceCtx* out) {
 }
 
 size_t TraceCorrelator::size() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   return entries_.size();
 }
 

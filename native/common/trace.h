@@ -28,6 +28,8 @@
 #include <map>
 #include <memory>
 #include <mutex>
+
+#include "common/lockrank.h"
 #include <string>
 #include <vector>
 
@@ -101,17 +103,10 @@ class TraceRing {
 
  private:
   struct Slot {
-    std::atomic<bool> locked{false};
+    RankedSpinLock lock{LockRank::kTraceSlot};
     bool used = false;
     TraceSpan span;
   };
-  void LockSlot(Slot* s) const {
-    while (s->locked.exchange(true, std::memory_order_acquire)) {
-    }
-  }
-  void UnlockSlot(Slot* s) const {
-    s->locked.store(false, std::memory_order_release);
-  }
 
   size_t cap_;
   std::unique_ptr<Slot[]> slots_;
@@ -144,7 +139,7 @@ class TraceCorrelator {
   size_t size() const;
 
  private:
-  mutable std::mutex mu_;
+  mutable RankedMutex mu_{LockRank::kTraceCorrelator};
   size_t max_;
   uint64_t seq_ = 0;
   std::map<std::string, std::pair<TraceCtx, uint64_t>> entries_;

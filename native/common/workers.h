@@ -16,6 +16,7 @@
 #include <vector>
 
 #include "common/net.h"  // MonoUs: the shared latency clock
+#include "common/lockrank.h"
 #include "common/stats.h"
 
 namespace fdfs {
@@ -36,14 +37,14 @@ class WorkerPool {
   // observes service time.  Histograms are registry-owned and shared
   // across pools (their Observe is wait-free); either may be null.
   void SetStats(StatHistogram* queue_wait_us, StatHistogram* service_us) {
-    std::lock_guard<std::mutex> lk(mu_);
+    std::lock_guard<RankedMutex> lk(mu_);
     hist_wait_ = queue_wait_us;
     hist_service_ = service_us;
   }
 
   void Submit(std::function<void()> fn) {
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      std::lock_guard<RankedMutex> lk(mu_);
       if (stopping_) return;
       queue_.push_back(Task{std::move(fn), MonoUs()});
     }
@@ -54,7 +55,7 @@ class WorkerPool {
   // finish or roll back before the process exits).
   void Stop() {
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      std::lock_guard<RankedMutex> lk(mu_);
       if (stopping_) return;
       stopping_ = true;
     }
@@ -65,7 +66,7 @@ class WorkerPool {
   }
 
   size_t pending() const {
-    std::lock_guard<std::mutex> lk(mu_);
+    std::lock_guard<RankedMutex> lk(mu_);
     return queue_.size();
   }
 
@@ -81,7 +82,7 @@ class WorkerPool {
       StatHistogram* hw;
       StatHistogram* hs;
       {
-        std::unique_lock<std::mutex> lk(mu_);
+        std::unique_lock<RankedMutex> lk(mu_);
         cv_.wait(lk, [this] { return stopping_ || !queue_.empty(); });
         if (queue_.empty()) return;  // stopping and drained
         task = std::move(queue_.front());
@@ -96,8 +97,8 @@ class WorkerPool {
     }
   }
 
-  mutable std::mutex mu_;
-  std::condition_variable cv_;
+  mutable RankedMutex mu_{LockRank::kWorkers};
+  std::condition_variable_any cv_;
   std::deque<Task> queue_;
   std::vector<std::thread> threads_;
   bool stopping_ = false;

@@ -102,7 +102,7 @@ bool BinlogWriter::Append(char op, const std::string& filename,
                           const std::string& extra) {
   // Appends arrive from every nio work thread and the dio pools
   // (reference: the binlog write lock in storage/storage_sync.c).
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   if (fd_ < 0) return false;
   // in_flight_ MUST cover the stamp→write window; see Quiescent().
   struct InFlight {
@@ -134,18 +134,18 @@ bool BinlogWriter::Append(char op, const std::string& filename,
 }
 
 void BinlogWriter::Position(int* file_index, int64_t* offset) const {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   *file_index = file_index_;
   *offset = offset_;
 }
 
 void BinlogWriter::Flush() {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   if (fd_ >= 0) fdatasync(fd_);
 }
 
 void BinlogWriter::Close() {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   if (fd_ >= 0) {
     close(fd_);
     fd_ = -1;

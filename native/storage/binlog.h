@@ -10,6 +10,8 @@
 
 #include <atomic>
 #include <mutex>
+
+#include "common/lockrank.h"
 #include <cstdint>
 #include <optional>
 #include <string>
@@ -62,7 +64,7 @@ class BinlogWriter {
   int64_t offset_ = 0;
   int fd_ = -1;
   std::atomic<int> in_flight_{0};
-  mutable std::mutex mu_;  // appends come from every nio/dio thread
+  mutable RankedMutex mu_{LockRank::kBinlog};  // appends come from every nio/dio thread
 };
 
 // One-path binlog extraction (FETCH_ONE_PATH_BINLOG 26, the feed for disk

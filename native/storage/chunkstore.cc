@@ -114,6 +114,9 @@ ChunkStore::ChunkStore(std::string store_path, int64_t gc_grace_s,
     : store_path_(std::move(store_path)),
       gc_grace_s_(gc_grace_s < 0 ? 0 : gc_grace_s) {
   cache_.cap_bytes = read_cache_bytes < 0 ? 0 : read_cache_bytes;
+  // Stripe locks share one rank; the index is the ascending-protocol
+  // order key the FDFS_LOCKRANK checker validates RefAll against.
+  for (int i = 0; i < kStripes; ++i) stripes_[i].mu.set_order_key(i);
 }
 
 int ChunkStore::StripeIndex(const std::string& digest_hex) {
@@ -174,7 +177,7 @@ bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
                            size_t len, bool* existed, std::string* err) {
   std::string path = ChunkPath(digest_hex);
   Stripe& st = StripeFor(digest_hex);
-  std::lock_guard<std::mutex> lk(st.mu);
+  std::lock_guard<RankedMutex> lk(st.mu);
   // Heal-on-upload: these bytes hash to the digest (every caller
   // verifies before PutAndRef), so a quarantined chunk gets its good
   // payload restored by ANY upload/replication that carries it.
@@ -239,9 +242,9 @@ bool ChunkStore::RefAll(const Recipe& r) {
   // UnrefAll can interleave between the presence check and the refs.
   bool involved[kStripes] = {};
   for (const RecipeEntry& e : r.chunks) involved[StripeIndex(e.digest_hex)] = true;
-  std::array<std::unique_lock<std::mutex>, kStripes> locks;
+  std::array<std::unique_lock<RankedMutex>, kStripes> locks;
   for (int i = 0; i < kStripes; ++i)
-    if (involved[i]) locks[i] = std::unique_lock<std::mutex>(stripes_[i].mu);
+    if (involved[i]) locks[i] = std::unique_lock<RankedMutex>(stripes_[i].mu);
   for (const RecipeEntry& e : r.chunks)
     if (StripeFor(e.digest_hex).refs.find(e.digest_hex) ==
         StripeFor(e.digest_hex).refs.end())
@@ -253,7 +256,7 @@ bool ChunkStore::RefAll(const Recipe& r) {
 
 bool ChunkStore::Has(const std::string& digest_hex) const {
   const Stripe& st = StripeFor(digest_hex);
-  std::lock_guard<std::mutex> lk(st.mu);
+  std::lock_guard<RankedMutex> lk(st.mu);
   return st.refs.find(digest_hex) != st.refs.end();
 }
 
@@ -268,7 +271,7 @@ std::string ChunkStore::HaveMask(
   for (int s = 0; s < kStripes; ++s) {
     if (by_stripe[s].empty()) continue;
     const Stripe& st = stripes_[s];
-    std::lock_guard<std::mutex> lk(st.mu);
+    std::lock_guard<RankedMutex> lk(st.mu);
     for (uint32_t i : by_stripe[s])
       need[i] = st.refs.find(digests[i]) != st.refs.end() &&
                         !st.quarantined.count(digests[i])
@@ -279,7 +282,7 @@ std::string ChunkStore::HaveMask(
 
 bool ChunkStore::RefOne(const std::string& digest_hex) {
   Stripe& st = StripeFor(digest_hex);
-  std::lock_guard<std::mutex> lk(st.mu);
+  std::lock_guard<RankedMutex> lk(st.mu);
   auto it = st.refs.find(digest_hex);
   if (it == st.refs.end()) return false;
   it->second++;
@@ -316,7 +319,7 @@ void ChunkStore::UnlinkRetiredLocked(Stripe& s,
 void ChunkStore::UnrefAll(const Recipe& r) {
   for (const RecipeEntry& e : r.chunks) {
     Stripe& st = StripeFor(e.digest_hex);
-    std::lock_guard<std::mutex> lk(st.mu);
+    std::lock_guard<RankedMutex> lk(st.mu);
     auto it = st.refs.find(e.digest_hex);
     if (it == st.refs.end()) continue;
     if (--it->second <= 0) {
@@ -337,7 +340,7 @@ std::optional<Recipe> ChunkStore::ReadRecipeAndPin(const std::string& path) {
   if (!r.has_value()) return std::nullopt;
   for (size_t i = 0; i < r->chunks.size(); ++i) {
     Stripe& st = StripeFor(r->chunks[i].digest_hex);
-    std::unique_lock<std::mutex> lk(st.mu);
+    std::unique_lock<RankedMutex> lk(st.mu);
     if (st.refs.find(r->chunks[i].digest_hex) == st.refs.end()) {
       lk.unlock();
       Recipe taken;
@@ -380,7 +383,7 @@ std::optional<Recipe> ChunkStore::ReadRecipeAndPinRange(
   // Verify+pin per chunk with rollback, exactly like ReadRecipeAndPin.
   for (size_t i = 0; i < trimmed.chunks.size(); ++i) {
     Stripe& st = StripeFor(trimmed.chunks[i].digest_hex);
-    std::unique_lock<std::mutex> lk(st.mu);
+    std::unique_lock<RankedMutex> lk(st.mu);
     if (st.refs.find(trimmed.chunks[i].digest_hex) == st.refs.end()) {
       lk.unlock();
       Recipe taken;
@@ -403,7 +406,7 @@ std::string ChunkStore::PinAndMask(const Recipe& r) {
     // exempts the chunk from GcSweep and Quarantine for the session's
     // lifetime — probe and pin share this one stripe-lock acquisition.
     Stripe& st = StripeFor(r.chunks[i].digest_hex);
-    std::lock_guard<std::mutex> lk(st.mu);
+    std::lock_guard<RankedMutex> lk(st.mu);
     need[i] = st.refs.find(r.chunks[i].digest_hex) != st.refs.end() &&
                       !st.quarantined.count(r.chunks[i].digest_hex)
                   ? 0 : 1;
@@ -415,7 +418,7 @@ std::string ChunkStore::PinAndMask(const Recipe& r) {
 void ChunkStore::PinRecipe(const Recipe& r) {
   for (const RecipeEntry& e : r.chunks) {
     Stripe& st = StripeFor(e.digest_hex);
-    std::lock_guard<std::mutex> lk(st.mu);
+    std::lock_guard<RankedMutex> lk(st.mu);
     st.pins[e.digest_hex]++;
   }
 }
@@ -423,7 +426,7 @@ void ChunkStore::PinRecipe(const Recipe& r) {
 void ChunkStore::UnpinRecipe(const Recipe& r) {
   for (const RecipeEntry& e : r.chunks) {
     Stripe& st = StripeFor(e.digest_hex);
-    std::lock_guard<std::mutex> lk(st.mu);
+    std::lock_guard<RankedMutex> lk(st.mu);
     auto it = st.pins.find(e.digest_hex);
     if (it == st.pins.end()) continue;
     if (--it->second <= 0) {
@@ -484,7 +487,7 @@ bool ChunkStore::ReadChunkSlice(const std::string& digest_hex,
 
 std::shared_ptr<const std::string> ChunkStore::CacheGet(
     const std::string& digest_hex) {
-  std::lock_guard<std::mutex> lk(cache_.mu);
+  std::lock_guard<RankedMutex> lk(cache_.mu);
   auto it = cache_.index.find(digest_hex);
   if (it == cache_.index.end()) return nullptr;
   cache_.lru.splice(cache_.lru.begin(), cache_.lru, it->second);
@@ -501,11 +504,11 @@ void ChunkStore::CacheInsertIfLive(const std::string& digest_hex,
   // unlink.  Both invalidate under the stripe lock, so an insert gated
   // by the same lock can never publish a stale entry past them.
   Stripe& st = StripeFor(digest_hex);
-  std::lock_guard<std::mutex> slk(st.mu);
+  std::lock_guard<RankedMutex> slk(st.mu);
   if (st.refs.find(digest_hex) == st.refs.end() ||
       st.quarantined.count(digest_hex))
     return;
-  std::lock_guard<std::mutex> lk(cache_.mu);
+  std::lock_guard<RankedMutex> lk(cache_.mu);
   if (cache_.index.count(digest_hex)) return;  // racer inserted first
   cache_.lru.push_front(CacheEntry{digest_hex, std::move(data)});
   cache_.index[digest_hex] = cache_.lru.begin();
@@ -521,7 +524,7 @@ void ChunkStore::CacheInsertIfLive(const std::string& digest_hex,
 
 void ChunkStore::CacheInvalidate(const std::string& digest_hex) {
   if (cache_.cap_bytes <= 0) return;
-  std::lock_guard<std::mutex> lk(cache_.mu);
+  std::lock_guard<RankedMutex> lk(cache_.mu);
   auto it = cache_.index.find(digest_hex);
   if (it == cache_.index.end()) return;
   cache_.bytes -= static_cast<int64_t>(it->second->data->size());
@@ -531,7 +534,7 @@ void ChunkStore::CacheInvalidate(const std::string& digest_hex) {
 }
 
 void ChunkStore::CacheClear() {
-  std::lock_guard<std::mutex> lk(cache_.mu);
+  std::lock_guard<RankedMutex> lk(cache_.mu);
   cache_.lru.clear();
   cache_.index.clear();
   cache_.bytes = 0;
@@ -567,19 +570,19 @@ std::shared_ptr<const std::string> ChunkStore::CacheLookup(
 }
 
 int64_t ChunkStore::cache_bytes() const {
-  std::lock_guard<std::mutex> lk(cache_.mu);
+  std::lock_guard<RankedMutex> lk(cache_.mu);
   return cache_.bytes;
 }
 
 int64_t ChunkStore::cache_chunks() const {
-  std::lock_guard<std::mutex> lk(cache_.mu);
+  std::lock_guard<RankedMutex> lk(cache_.mu);
   return static_cast<int64_t>(cache_.lru.size());
 }
 
 int64_t ChunkStore::unique_chunks() const {
   int64_t n = 0;
   for (const Stripe& st : stripes_) {
-    std::lock_guard<std::mutex> lk(st.mu);
+    std::lock_guard<RankedMutex> lk(st.mu);
     n += static_cast<int64_t>(st.refs.size());
   }
   return n;
@@ -588,7 +591,7 @@ int64_t ChunkStore::unique_chunks() const {
 int64_t ChunkStore::gc_pending_chunks() const {
   int64_t n = 0;
   for (const Stripe& st : stripes_) {
-    std::lock_guard<std::mutex> lk(st.mu);
+    std::lock_guard<RankedMutex> lk(st.mu);
     n += static_cast<int64_t>(st.zero_ref.size());
   }
   return n;
@@ -597,7 +600,7 @@ int64_t ChunkStore::gc_pending_chunks() const {
 int64_t ChunkStore::quarantined_chunks() const {
   int64_t n = 0;
   for (const Stripe& st : stripes_) {
-    std::lock_guard<std::mutex> lk(st.mu);
+    std::lock_guard<RankedMutex> lk(st.mu);
     n += static_cast<int64_t>(st.quarantined.size());
   }
   return n;
@@ -622,7 +625,7 @@ std::vector<ChunkStore::ChunkInfo> ChunkStore::SnapshotLive(
   int last = prefix >= 0 ? first : kStripes - 1;
   for (int s = first; s <= last; ++s) {
     const Stripe& st = stripes_[s];
-    std::lock_guard<std::mutex> lk(st.mu);
+    std::lock_guard<RankedMutex> lk(st.mu);
     for (const auto& [dig, n] : st.refs) {
       if (prefix >= 0 && (dig[0] != p0 || dig[1] != p1)) continue;
       if (st.quarantined.count(dig)) continue;
@@ -636,7 +639,7 @@ std::vector<ChunkStore::ChunkInfo> ChunkStore::SnapshotLive(
 std::vector<ChunkStore::ChunkInfo> ChunkStore::SnapshotQuarantined() const {
   std::vector<ChunkInfo> out;
   for (const Stripe& st : stripes_) {
-    std::lock_guard<std::mutex> lk(st.mu);
+    std::lock_guard<RankedMutex> lk(st.mu);
     for (const std::string& dig : st.quarantined) {
       if (st.refs.find(dig) == st.refs.end()) continue;  // zero-ref: GC's
       auto l = st.lens.find(dig);
@@ -648,14 +651,14 @@ std::vector<ChunkStore::ChunkInfo> ChunkStore::SnapshotQuarantined() const {
 
 bool ChunkStore::IsQuarantined(const std::string& digest_hex) const {
   const Stripe& st = StripeFor(digest_hex);
-  std::lock_guard<std::mutex> lk(st.mu);
+  std::lock_guard<RankedMutex> lk(st.mu);
   return st.quarantined.count(digest_hex) != 0;
 }
 
 ChunkStore::QuarantineResult ChunkStore::Quarantine(
     const std::string& digest_hex) {
   Stripe& st = StripeFor(digest_hex);
-  std::lock_guard<std::mutex> lk(st.mu);
+  std::lock_guard<RankedMutex> lk(st.mu);
   if (st.refs.find(digest_hex) == st.refs.end())
     return QuarantineResult::kGone;  // deleted since the snapshot
   if (st.pins.count(digest_hex)) return QuarantineResult::kPinned;
@@ -696,7 +699,7 @@ ChunkStore::QuarantineResult ChunkStore::Quarantine(
 bool ChunkStore::RepairChunk(const std::string& digest_hex, const char* data,
                              size_t len, std::string* err) {
   Stripe& st = StripeFor(digest_hex);
-  std::lock_guard<std::mutex> lk(st.mu);
+  std::lock_guard<RankedMutex> lk(st.mu);
   if (st.refs.find(digest_hex) == st.refs.end()) {
     *err = "no longer referenced";
     return false;
@@ -715,7 +718,7 @@ bool ChunkStore::RepairChunk(const std::string& digest_hex, const char* data,
 int64_t ChunkStore::GcSweep(int64_t now_s, int64_t* bytes) {
   int64_t reclaimed = 0;
   for (Stripe& st : stripes_) {
-    std::lock_guard<std::mutex> lk(st.mu);
+    std::lock_guard<RankedMutex> lk(st.mu);
     for (auto it = st.zero_ref.begin(); it != st.zero_ref.end();) {
       if (now_s - it->second.since_s < gc_grace_s_ ||
           st.pins.count(it->first)) {
@@ -870,7 +873,7 @@ void ChunkStore::RebuildFromRecipes() {
   unique = refs.size();
   for (int s = 0; s < kStripes; ++s) {
     Stripe& st = stripes_[s];
-    std::lock_guard<std::mutex> lk(st.mu);
+    std::lock_guard<RankedMutex> lk(st.mu);
     st.refs = std::move(fresh[s].refs);
     st.lens = std::move(fresh[s].lens);
     st.zero_ref = std::move(fresh[s].zero_ref);

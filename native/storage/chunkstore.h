@@ -52,6 +52,8 @@
 #pragma once
 
 #include <array>
+
+#include "common/lockrank.h"
 #include <atomic>
 #include <cstdint>
 #include <list>
@@ -258,7 +260,7 @@ class ChunkStore {
   // One lock stripe: all per-digest state for digests whose first hex
   // nibble selects this stripe lives here, guarded by `mu`.
   struct Stripe {
-    mutable std::mutex mu;
+    mutable RankedMutex mu{LockRank::kChunkStripe};
     std::unordered_map<std::string, int64_t> refs;
     std::unordered_map<std::string, int64_t> lens;  // digest -> byte length
     std::unordered_map<std::string, int64_t> pins;  // in-flight streams
@@ -289,7 +291,7 @@ class ChunkStore {
   };
   struct ReadCache {
     int64_t cap_bytes = 0;
-    mutable std::mutex mu;
+    mutable RankedMutex mu{LockRank::kReadCache};
     std::list<CacheEntry> lru;  // front = most recent
     std::unordered_map<std::string, std::list<CacheEntry>::iterator> index;
     int64_t bytes = 0;

@@ -25,7 +25,7 @@ CpuDedup::CpuDedup(std::string snapshot_path)
 
 DedupPlugin::Verdict CpuDedup::Judge(const std::string& sha1_hex, int64_t) {
   Verdict v;
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   auto it = by_digest_.find(sha1_hex);
   if (it != by_digest_.end()) {
     v.duplicate = true;
@@ -35,13 +35,13 @@ DedupPlugin::Verdict CpuDedup::Judge(const std::string& sha1_hex, int64_t) {
 }
 
 void CpuDedup::Commit(const std::string& sha1_hex, const std::string& file_id) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   by_digest_.emplace(sha1_hex, file_id);  // first writer wins
   by_file_[file_id] = sha1_hex;
 }
 
 void CpuDedup::Forget(const std::string& file_id) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   auto it = by_file_.find(file_id);
   if (it == by_file_.end()) return;
   auto dit = by_digest_.find(it->second);
@@ -52,7 +52,7 @@ void CpuDedup::Forget(const std::string& file_id) {
 }
 
 bool CpuDedup::Save() {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   std::string tmp = snapshot_path_ + ".tmp";
   FILE* f = fopen(tmp.c_str(), "w");
   if (f == nullptr) return false;
@@ -122,7 +122,7 @@ int SidecarDedup::AcquireFd(bool* pooled) {
     // Only the pool-mutex wait counts as "lock wait" — connection setup
     // below is transport cost, not serialization.
     const int64_t t0 = DedupMonoUs();
-    std::lock_guard<std::mutex> lk(mu_);
+    std::lock_guard<RankedMutex> lk(mu_);
     tls_dedup_lock_wait_us += DedupMonoUs() - t0;
     if (!pool_.empty()) {
       int fd = pool_.back();
@@ -146,7 +146,7 @@ int SidecarDedup::AcquireFd(bool* pooled) {
 }
 
 void SidecarDedup::ReleaseFd(int fd) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   if (static_cast<int>(pool_.size()) >= kMaxIdleFds) {
     close(fd);
     return;

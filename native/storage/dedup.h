@@ -23,6 +23,8 @@
 #include <cstdint>
 #include <memory>
 #include <mutex>
+
+#include "common/lockrank.h"
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -124,7 +126,7 @@ class CpuDedup : public DedupPlugin {
 
  private:
   std::string snapshot_path_;
-  mutable std::mutex mu_;  // handlers run on every nio/dio thread
+  mutable RankedMutex mu_{LockRank::kDedupEngine};  // handlers run on every nio/dio thread
   std::unordered_map<std::string, std::string> by_digest_;  // sha1 -> file id
   std::unordered_map<std::string, std::string> by_file_;    // file id -> sha1
 };
@@ -169,7 +171,7 @@ class SidecarDedup : public DedupPlugin {
   bool Rpc(uint8_t cmd, const std::string& body, std::string* resp,
            uint8_t* status, int64_t max_resp = 1 << 20);
   std::string socket_path_;
-  std::mutex mu_;  // guards pool_
+  RankedMutex mu_{LockRank::kDedupPool};  // guards pool_
   std::vector<int> pool_;
 };
 

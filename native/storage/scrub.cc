@@ -46,7 +46,7 @@ void ScrubManager::Start() {
 
 void ScrubManager::Stop() {
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    std::lock_guard<RankedMutex> lk(mu_);
     if (stop_) return;
     stop_ = true;
   }
@@ -56,7 +56,7 @@ void ScrubManager::Stop() {
 
 void ScrubManager::Kick() {
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    std::lock_guard<RankedMutex> lk(mu_);
     kicked_ = true;
   }
   cv_.notify_all();
@@ -109,7 +109,7 @@ int64_t ScrubManager::StatValue(int i) const {
 }
 
 void ScrubManager::ThreadMain() {
-  std::unique_lock<std::mutex> lk(mu_);
+  std::unique_lock<RankedMutex> lk(mu_);
   while (!stop_) {
     bool due;
     if (opts_.interval_s > 0) {
@@ -141,7 +141,7 @@ void ScrubManager::Pace(int64_t bytes_read, int64_t pass_start_us) {
   int64_t ahead_us = budget_us - (WallUs() - pass_start_us);
   while (ahead_us > 0) {
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      std::lock_guard<RankedMutex> lk(mu_);
       if (stop_) return;
     }
     usleep(static_cast<useconds_t>(std::min<int64_t>(ahead_us, 50000)));
@@ -187,7 +187,7 @@ void ScrubManager::RunPass() {
       size_t i = 0;
       while (i < live.size()) {
         {
-          std::lock_guard<std::mutex> lk(mu_);
+          std::lock_guard<RankedMutex> lk(mu_);
           if (stop_) {
             aborted = true;
             break;

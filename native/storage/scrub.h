@@ -36,6 +36,8 @@
 #include <cstdint>
 #include <functional>
 #include <mutex>
+
+#include "common/lockrank.h"
 #include <string>
 #include <thread>
 #include <vector>
@@ -130,8 +132,8 @@ class ScrubManager {
   class EventLog* events_;
 
   std::thread thread_;
-  std::mutex mu_;
-  std::condition_variable cv_;
+  RankedMutex mu_{LockRank::kScrub};
+  std::condition_variable_any cv_;
   bool stop_ = false;
   bool kicked_ = false;
 

@@ -427,7 +427,7 @@ bool StorageServer::Init(std::string* error) {
     std::shared_ptr<TrunkAllocator> alloc;
     int64_t tfs;
     {
-      std::lock_guard<std::mutex> lk(trunk_mu_);
+      std::lock_guard<RankedMutex> lk(trunk_mu_);
       if (!is_trunk_server_) return;
       alloc = trunk_alloc_;
       tfs = trunk_file_size_;
@@ -628,7 +628,7 @@ void StorageServer::InitStatsRegistry() {
       registry_.Counter("ingest.bytes_saved_wire");
   ctr_ingest_fallbacks_ = registry_.Counter("ingest.recipe_fallbacks");
   registry_.GaugeFn("ingest.sessions_active", [this] {
-    std::lock_guard<std::mutex> lk(ingest_mu_);
+    std::lock_guard<RankedMutex> lk(ingest_mu_);
     return static_cast<int64_t>(ingest_sessions_.size());
   });
   // Read path (PR 5): ranged-download traffic and the hot-chunk read
@@ -953,7 +953,7 @@ void StorageServer::ResetForNextRequest(Conn* c) {
 }
 
 bool StorageServer::AcquireBusy(Conn* c, const std::string& remote) {
-  std::lock_guard<std::mutex> lk(busy_mu_);
+  std::lock_guard<RankedMutex> lk(busy_mu_);
   if (busy_files_.count(remote)) return false;
   busy_files_.insert(remote);
   c->busy_key = remote;
@@ -962,7 +962,7 @@ bool StorageServer::AcquireBusy(Conn* c, const std::string& remote) {
 
 void StorageServer::ReleaseBusy(Conn* c) {
   if (!c->busy_key.empty()) {
-    std::lock_guard<std::mutex> lk(busy_mu_);
+    std::lock_guard<RankedMutex> lk(busy_mu_);
     busy_files_.erase(c->busy_key);
     c->busy_key.clear();
   }
@@ -1050,7 +1050,7 @@ void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
       break;
   }
   if (access_log_ != nullptr) {
-    std::lock_guard<std::mutex> lk(log_mu_);
+    std::lock_guard<RankedMutex> lk(log_mu_);
     // "<epoch.sec> <client_ip> <cmd> <status> <bytes> <cost_us>
     //  <recv_us> <work_us> <fp_us> <fp_lock_us> <cswrite_us> <binlog_us>
     //  <req_bytes>" — per-stage split (SURVEY.md §5): recv = body receive
@@ -1178,7 +1178,7 @@ void StorageServer::RecordRequestSpans(Conn* c, uint8_t status,
       // plain column parser skips it, access_log_stages --slow reads it.
       // Flushed immediately — slow requests are rare and the line is
       // an operator signal, not bulk logging.
-      std::lock_guard<std::mutex> lk(log_mu_);
+      std::lock_guard<RankedMutex> lk(log_mu_);
       fprintf(access_log_, "%s\n", line.c_str());
       fflush(access_log_);
     }
@@ -2341,7 +2341,7 @@ void StorageServer::HandleUploadRecipe(Conn* c) {
   PutInt64BE(s->id, reinterpret_cast<uint8_t*>(body.data()));
   body += s->needed;
   {
-    std::lock_guard<std::mutex> lk(ingest_mu_);
+    std::lock_guard<RankedMutex> lk(ingest_mu_);
     ingest_sessions_[s->id] = std::move(s);
   }
   Respond(c, 0, body);
@@ -2349,7 +2349,7 @@ void StorageServer::HandleUploadRecipe(Conn* c) {
 
 std::unique_ptr<StorageServer::UploadSession>
 StorageServer::TakeIngestSession(int64_t id) {
-  std::lock_guard<std::mutex> lk(ingest_mu_);
+  std::lock_guard<RankedMutex> lk(ingest_mu_);
   auto it = ingest_sessions_.find(id);
   if (it == ingest_sessions_.end()) return nullptr;
   auto s = std::move(it->second);
@@ -2364,7 +2364,7 @@ void StorageServer::SweepIngestSessions() {
   std::vector<std::unique_ptr<UploadSession>> expired;
   int64_t now = time(nullptr);
   {
-    std::lock_guard<std::mutex> lk(ingest_mu_);
+    std::lock_guard<RankedMutex> lk(ingest_mu_);
     for (auto it = ingest_sessions_.begin(); it != ingest_sessions_.end();) {
       if (it->second->deadline_s <= now) {
         expired.push_back(std::move(it->second));
@@ -2403,7 +2403,7 @@ bool StorageServer::BeginUploadChunks(Conn* c) {
   int spi = -1;
   int64_t expect = -1;
   {
-    std::lock_guard<std::mutex> lk(ingest_mu_);
+    std::lock_guard<RankedMutex> lk(ingest_mu_);
     auto it = ingest_sessions_.find(session_id);
     if (it != ingest_sessions_.end()) {
       spi = it->second->spi;
@@ -2799,7 +2799,7 @@ void StorageServer::RefreshClusterParams() {
   // (TrunkEligible/TrunkAlloc/...), so the whole transition is one
   // critical section.  The allocator pointer is swapped, never mutated
   // live — handlers that copied the shared_ptr finish on the old pool.
-  std::lock_guard<std::mutex> lk(trunk_mu_);
+  std::lock_guard<RankedMutex> lk(trunk_mu_);
   auto params = reporter_->cluster_params();
   auto get = [&params](const char* key, int64_t dflt) {
     auto it = params.find(key);
@@ -2881,7 +2881,7 @@ void StorageServer::RefreshClusterParams() {
 }
 
 bool StorageServer::TrunkEligible(int64_t size) const {
-  std::lock_guard<std::mutex> lk(trunk_mu_);
+  std::lock_guard<RankedMutex> lk(trunk_mu_);
   return trunk_enabled_ && size >= slot_min_size_ && size < slot_max_size_ &&
          (is_trunk_server_ || trunk_port_ > 0);
 }
@@ -2900,7 +2900,7 @@ std::optional<TrunkLocation> StorageServer::TrunkAlloc(int64_t payload_size) {
   int port = 0;
   int64_t epoch = 0;
   {
-    std::lock_guard<std::mutex> lk(trunk_mu_);
+    std::lock_guard<RankedMutex> lk(trunk_mu_);
     if (is_trunk_server_) alloc = trunk_alloc_;
     ip = trunk_ip_;
     port = trunk_port_;
@@ -2919,7 +2919,7 @@ void StorageServer::TrunkFree(const TrunkLocation& loc) {
   int trunk_port = 0;
   int64_t epoch = 0;
   {
-    std::lock_guard<std::mutex> lk(trunk_mu_);
+    std::lock_guard<RankedMutex> lk(trunk_mu_);
     if (is_trunk_server_) alloc = trunk_alloc_;
     trunk_ip = trunk_ip_;
     trunk_port = trunk_port_;
@@ -2970,7 +2970,7 @@ std::string StorageServer::TrunkStoreUpload(Conn* c) {
   int tport;
   int64_t tepoch;
   {
-    std::lock_guard<std::mutex> lk(trunk_mu_);
+    std::lock_guard<RankedMutex> lk(trunk_mu_);
     am_trunk = is_trunk_server_;
     tip = trunk_ip_;
     tport = trunk_port_;
@@ -2993,7 +2993,7 @@ void StorageServer::HandleTrunkRpc(Conn* c) {
   int64_t slot_max;
   int64_t my_epoch;
   {
-    std::lock_guard<std::mutex> lk(trunk_mu_);
+    std::lock_guard<RankedMutex> lk(trunk_mu_);
     if (is_trunk_server_) alloc = trunk_alloc_;
     slot_max = slot_max_size_;
     my_epoch = trunk_epoch_;

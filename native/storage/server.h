@@ -18,6 +18,8 @@
 #include <cstdint>
 #include <memory>
 #include <mutex>
+
+#include "common/lockrank.h"
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -452,9 +454,9 @@ class StorageServer {
   // dio pools, one per store path (storage.conf:disk_writer_threads;
   // reference: storage_dio.c per-path reader/writer queues).
   std::vector<std::unique_ptr<WorkerPool>> dio_pools_;
-  std::mutex busy_mu_;
+  RankedMutex busy_mu_{LockRank::kBusyFiles};
   std::unordered_set<std::string> busy_files_;  // remote names being mutated
-  std::mutex log_mu_;                   // access_log_ writes
+  RankedMutex log_mu_{LockRank::kAccessLog};  // access_log_ writes
   StorageStats stats_;
   // Named-stat registry behind the STAT opcode.  Per-opcode handles are
   // indexed by the raw cmd byte (O(1), no lock on the request path).
@@ -508,7 +510,7 @@ class StorageServer {
   std::atomic<int64_t>* ctr_download_ranged_requests_ = nullptr;
   std::atomic<int64_t>* ctr_download_ranged_bytes_ = nullptr;
   // Parked phase-1 sessions keyed by id (ingest_mu_); swept by timer.
-  std::mutex ingest_mu_;
+  RankedMutex ingest_mu_{LockRank::kIngestSessions};
   std::unordered_map<int64_t, std::unique_ptr<UploadSession>>
       ingest_sessions_;
   std::atomic<int64_t> next_ingest_session_{1};
@@ -519,7 +521,7 @@ class StorageServer {
   // every nio/dio thread.  Handlers copy the shared_ptr under the lock
   // and use the allocator outside it (the allocator locks internally);
   // the timer swaps the pointer, never mutates a live allocator.
-  mutable std::mutex trunk_mu_;
+  mutable RankedMutex trunk_mu_{LockRank::kTrunkRole};
   bool trunk_enabled_ = false;
   int64_t slot_min_size_ = 256;
   int64_t slot_max_size_ = 16 * 1024 * 1024;

@@ -69,7 +69,7 @@ SyncManager::SyncManager(const StorageConfig& cfg, SyncCallbacks cbs)
 SyncManager::~SyncManager() { Stop(); }
 
 void SyncManager::UpdatePeers(const std::vector<PeerInfo>& peers) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   if (stopped_) return;  // a post-Stop heartbeat must not respawn workers
   // Retire workers whose peer vanished from the group.  Joined in Stop(),
   // not here: the caller is a reporter thread and a join could block a
@@ -103,7 +103,7 @@ void SyncManager::Stop() {
   std::map<std::string, std::unique_ptr<Worker>> workers;
   std::vector<std::unique_ptr<Worker>> retired;
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    std::lock_guard<RankedMutex> lk(mu_);
     stopped_ = true;
     workers.swap(workers_);
     retired.swap(retired_);
@@ -116,7 +116,7 @@ void SyncManager::Stop() {
 }
 
 std::vector<SyncPeerState> SyncManager::States() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   std::vector<SyncPeerState> out;
   for (const auto& [addr, w] : workers_) {
     SyncPeerState s;

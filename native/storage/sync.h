@@ -20,6 +20,8 @@
 #include <map>
 #include <memory>
 #include <mutex>
+
+#include "common/lockrank.h"
 #include <string>
 #include <thread>
 #include <vector>
@@ -126,7 +128,7 @@ class SyncManager {
   StorageConfig cfg_;
   SyncCallbacks cbs_;
   std::string sync_dir_;
-  mutable std::mutex mu_;
+  mutable RankedMutex mu_{LockRank::kSync};
   bool stopped_ = false;
   std::map<std::string, std::unique_ptr<Worker>> workers_;  // key "ip:port"
   // Workers whose peer vanished: stop-flagged immediately, joined in

@@ -63,7 +63,7 @@ void TrackerReporter::Start() {
       char ip[64] = {0};
       int port = 0;
       if (fscanf(f, "%63s %d", ip, &port) == 2) {
-        std::lock_guard<std::mutex> lk(mu_);
+        std::lock_guard<RankedMutex> lk(mu_);
         recorded_ip_ = ip;
         recorded_port_ = port;
       }
@@ -89,12 +89,12 @@ void TrackerReporter::Stop() {
 }
 
 std::string TrackerReporter::my_ip() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   return my_ip_.empty() ? "127.0.0.1" : my_ip_;
 }
 
 std::vector<PeerInfo> TrackerReporter::peers() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   return peers_;
 }
 
@@ -104,7 +104,7 @@ void TrackerReporter::ReportSyncProgress(const std::string& dest_ip,
   // beat sends the full current vector.  A drain queue would deliver each
   // report to whichever tracker thread flushed first and starve the
   // others' read routing (multi-tracker clusters).
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   for (auto& r : pending_sync_reports_) {
     if (r.dest_ip == dest_ip && r.dest_port == dest_port) {
       r.ts = std::max(r.ts, ts);
@@ -151,7 +151,7 @@ bool TrackerReporter::ParsePeers(const std::string& body,
       tepoch = GetInt64BE(q + kIpAddressSize + 8);
   }
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    std::lock_guard<RankedMutex> lk(mu_);
     if (peers_changed != nullptr) *peers_changed = peers != peers_;
     peers_ = peers;
     if (have_trailer) {
@@ -166,19 +166,19 @@ bool TrackerReporter::ParsePeers(const std::string& body,
 void TrackerReporter::NotifyPeersChanged() {
   std::vector<PeerInfo> peers;
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    std::lock_guard<RankedMutex> lk(mu_);
     peers = peers_;
   }
   if (peers_cb_) peers_cb_(peers);
 }
 
 std::pair<std::string, int> TrackerReporter::trunk_server() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   return {trunk_ip_, trunk_port_};
 }
 
 int64_t TrackerReporter::trunk_epoch() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   return trunk_epoch_;
 }
 
@@ -215,7 +215,7 @@ void TrackerReporter::CheckIpChanged(int fd) {
   // the file after the first join, which would silence the others).
   std::string old_ip;
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    std::lock_guard<RankedMutex> lk(mu_);
     old_ip = recorded_ip_;
     if (recorded_port_ != cfg_.port) return;  // port change = new identity
   }
@@ -328,12 +328,12 @@ void TrackerReporter::DoParameterReq(int fd) {
     if (eq != std::string::npos && eq > 0)
       params[line.substr(0, eq)] = line.substr(eq + 1);
   }
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   cluster_params_ = std::move(params);
 }
 
 std::map<std::string, std::string> TrackerReporter::cluster_params() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   return cluster_params_;
 }
 
@@ -365,7 +365,7 @@ bool TrackerReporter::DoBeat(int fd, int64_t* chlog_off) {
   // sync).  Copied, not drained — see ReportSyncProgress.
   std::vector<SyncProgress> reports;
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    std::lock_guard<RankedMutex> lk(mu_);
     reports = pending_sync_reports_;
   }
   for (const auto& r : reports) {
@@ -417,7 +417,7 @@ void TrackerReporter::ThreadMain(std::string host, int port) {
         continue;
       }
       {
-        std::lock_guard<std::mutex> lk(mu_);
+        std::lock_guard<RankedMutex> lk(mu_);
         if (my_ip_.empty()) my_ip_ = SockIp(fd);
       }
       joined = false;

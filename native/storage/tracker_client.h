@@ -11,6 +11,8 @@
 #include <functional>
 #include <map>
 #include <mutex>
+
+#include "common/lockrank.h"
 #include <string>
 #include <thread>
 #include <vector>
@@ -88,7 +90,7 @@ class TrackerReporter {
   std::atomic<bool> stop_{false};
   std::atomic<bool> recovering_{false};
   std::vector<std::thread> threads_;
-  mutable std::mutex mu_;
+  mutable RankedMutex mu_{LockRank::kTrackerReporter};
   std::string my_ip_;
   std::vector<PeerInfo> peers_;
   struct SyncProgress {

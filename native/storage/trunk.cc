@@ -165,7 +165,7 @@ bool MarkSlotFree(const std::string& store_path, const TrunkLocation& loc) {
 
 bool TrunkAllocator::Init(const std::string& store_path,
                           int64_t trunk_file_size, std::string* error) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   store_path_ = store_path;
   trunk_file_size_ = trunk_file_size;
   return ScanRebuildLocked(error);
@@ -276,7 +276,7 @@ std::optional<TrunkLocation> TrunkAllocator::CreateTrunkFileLocked(
 }
 
 std::optional<TrunkLocation> TrunkAllocator::Alloc(int64_t payload_size) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   int64_t need = AlignSlot(payload_size);
   if (need > trunk_file_size_) return std::nullopt;
 
@@ -347,7 +347,7 @@ std::optional<TrunkLocation> TrunkAllocator::Alloc(int64_t payload_size) {
 }
 
 int TrunkAllocator::EnsureFreeReserve(int64_t min_free_bytes) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   int64_t have = 0;
   for (const auto& [size, blocks] : free_)
     have += size * static_cast<int64_t>(blocks.size());
@@ -367,7 +367,7 @@ int TrunkAllocator::EnsureFreeReserve(int64_t min_free_bytes) {
 }
 
 int TrunkAllocator::ReclaimEmptyFiles(int keep) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   // A trunk file is reclaimable when its free blocks cover every byte
   // (frees are not merged, so sum per trunk id).
   std::unordered_map<uint32_t, int64_t> free_per_file;
@@ -399,26 +399,26 @@ int TrunkAllocator::ReclaimEmptyFiles(int keep) {
 }
 
 bool TrunkAllocator::Free(const TrunkLocation& loc) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   if (!MarkSlotFree(store_path_, loc)) return false;
   free_[loc.alloc_size].push_back({loc.trunk_id, loc.offset});
   return true;
 }
 
 int64_t TrunkAllocator::free_bytes() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   int64_t fb = 0;
   for (const auto& [size, blocks] : free_) fb += size * blocks.size();
   return fb;
 }
 
 int TrunkAllocator::trunk_file_count() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   return static_cast<int>(next_id_);
 }
 
 int TrunkAllocator::VerifyFreeMap(std::string* report) const {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   std::map<int64_t, std::vector<Block>> disk;
   for (uint32_t id = 0; id < next_id_; ++id)
     ScanFileLocked(id, TrunkFilePath(store_path_, id), &disk);

@@ -26,6 +26,8 @@
 #include <cstdint>
 #include <map>
 #include <mutex>
+
+#include "common/lockrank.h"
 #include <unordered_set>
 #include <optional>
 #include <string>
@@ -121,7 +123,7 @@ class TrunkAllocator {
                       std::map<int64_t, std::vector<Block>>* pool) const;
   std::optional<TrunkLocation> CreateTrunkFileLocked(std::string* error);
 
-  mutable std::mutex mu_;
+  mutable RankedMutex mu_{LockRank::kTrunkAlloc};
   std::string store_path_;
   int64_t trunk_file_size_ = 0;
   uint32_t next_id_ = 0;

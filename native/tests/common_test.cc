@@ -1,5 +1,7 @@
 // Unit tests for the C++ common layer (no gtest in the image — plain
 // CHECK macros; non-zero exit on failure).
+#include <signal.h>
+#include <sys/wait.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -14,6 +16,7 @@
 #include "common/eventlog.h"
 #include "common/fileid.h"
 #include "common/ini.h"
+#include "common/lockrank.h"
 #include "common/net.h"
 #include "common/protocol_gen.h"
 #include "common/stats.h"
@@ -411,7 +414,158 @@ static void TestStatsRegistryPruneGauges() {
   CHECK(reg.Json().find("10.0.0.3") != std::string::npos);
 }
 
-int main() {
+
+// -- lock-rank discipline (common/lockrank.h) ------------------------------
+
+static void TestRankedMutex() {
+  // Ascending-rank acquisition is legal and balances the held stack.
+  RankedMutex outer(LockRank::kScrub);
+  RankedMutex inner(LockRank::kLog);
+  {
+    std::lock_guard<RankedMutex> a(outer);
+    std::lock_guard<RankedMutex> b(inner);
+    if (kLockRankEnforced) CHECK_EQ(lockrank_detail::HeldCount(), 2);
+  }
+  if (kLockRankEnforced) CHECK_EQ(lockrank_detail::HeldCount(), 0);
+  // try_lock participates in the held stack like lock().
+  CHECK(outer.try_lock());  // NOLINT(lock-guard-discipline): testing the wrapper
+  if (kLockRankEnforced) CHECK_EQ(lockrank_detail::HeldCount(), 1);
+  outer.unlock();  // NOLINT(lock-guard-discipline)
+  // Same-rank ASCENDING order keys: the RefAll stripe protocol.
+  RankedMutex s2(LockRank::kChunkStripe, 2);
+  RankedMutex s5(LockRank::kChunkStripe, 5);
+  {
+    std::unique_lock<RankedMutex> lk2(s2);
+    std::unique_lock<RankedMutex> lk5(s5);
+    // Out-of-order RELEASE is fine — only acquisition order is ranked.
+    lk2.unlock();
+  }
+  if (kLockRankEnforced) CHECK_EQ(lockrank_detail::HeldCount(), 0);
+  CHECK_EQ(std::string(LockRankName(LockRank::kChunkStripe)),
+           "chunkstore.stripe");
+}
+
+static void TestRankedMutexThreaded() {
+  // 4 threads hammer a correctly-ordered two-lock chain plus a ranked
+  // spinlock; the TSan leg proves the checker's thread_local
+  // bookkeeping (and the spinlock's acquire/release) is race-free, and
+  // the counters prove mutual exclusion still holds through the wrapper.
+  RankedMutex a(LockRank::kStatsRegistry);
+  RankedMutex b(LockRank::kWorkers);
+  RankedSpinLock s(LockRank::kTraceSlot);
+  int both = 0;
+  int spun = 0;
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 4; ++t) {
+    threads.emplace_back([&] {
+      for (int i = 0; i < 1000; ++i) {
+        std::lock_guard<RankedMutex> la(a);
+        std::lock_guard<RankedMutex> lb(b);
+        ++both;
+        SpinGuard g(s);
+        ++spun;
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  CHECK_EQ(both, 4 * 1000);
+  CHECK_EQ(spun, 4 * 1000);
+}
+
+// Death-test driver: re-exec THIS binary with a violation flag (fork +
+// exec keeps the child single-threaded at birth, which the sanitizer
+// runtimes require), expect SIGABRT, and expect BOTH lock sites in the
+// report.
+static void ExpectChildAborts(const char* exe, const char* flag,
+                              const char* expect_a, const char* expect_b) {
+  int fds[2];
+  CHECK_EQ(pipe(fds), 0);
+  pid_t pid = fork();
+  CHECK(pid >= 0);
+  if (pid == 0) {
+    dup2(fds[1], 2);
+    close(fds[0]);
+    close(fds[1]);
+    execl(exe, exe, flag, static_cast<char*>(nullptr));
+    _exit(127);
+  }
+  close(fds[1]);
+  std::string err;
+  char buf[4096];
+  ssize_t r;
+  while ((r = read(fds[0], buf, sizeof(buf))) > 0)
+    err.append(buf, static_cast<size_t>(r));
+  close(fds[0]);
+  int st = 0;
+  waitpid(pid, &st, 0);
+  if (!(WIFSIGNALED(st) && WTERMSIG(st) == SIGABRT)) {
+    std::fprintf(stderr, "FAIL %s: child (%s) did not SIGABRT; stderr:\n%s\n",
+                 __FILE__, flag, err.c_str());
+    ++g_failures;
+    return;
+  }
+  CHECK(err.find(expect_a) != std::string::npos);
+  CHECK(err.find(expect_b) != std::string::npos);
+  CHECK(err.find("held by this thread") != std::string::npos);
+}
+
+static void TestRankedMutexInversionAborts(const char* exe) {
+  if (!kLockRankEnforced) {
+    std::printf("common_test: lockrank death tests SKIPPED "
+                "(build without -DFDFS_LOCKRANK)\n");
+    return;
+  }
+  // A thread acquiring a LOWER rank while holding a higher one must
+  // abort, reporting the acquiring lock AND the held stack.
+  ExpectChildAborts(exe, "--lockrank-inversion",
+                    "chunkstore.stripe", "log.global");
+  // The RefAll protocol specifically: same rank, DESCENDING stripe
+  // keys must abort even though ascending is sanctioned.
+  ExpectChildAborts(exe, "--lockrank-stripe-descend",
+                    "ascending", "chunkstore.stripe");
+  // Recursive acquisition of one instance is a deadlock in production;
+  // the checker turns it into a deterministic abort.
+  ExpectChildAborts(exe, "--lockrank-recursive",
+                    "recursive", "sync.manager");
+}
+
+// Child-process violation bodies (reached only via the flags above).
+static int RunLockRankViolation(const char* flag) {
+  if (std::strcmp(flag, "--lockrank-inversion") == 0) {
+    RankedMutex hi(LockRank::kLog);
+    RankedMutex lo(LockRank::kChunkStripe);
+    std::thread t([&] {
+      std::lock_guard<RankedMutex> a(hi);
+      std::lock_guard<RankedMutex> b(lo);  // rank 90 under rank 210: abort
+    });
+    t.join();
+  } else if (std::strcmp(flag, "--lockrank-stripe-descend") == 0) {
+    RankedMutex s5(LockRank::kChunkStripe, 5);
+    RankedMutex s2(LockRank::kChunkStripe, 2);
+    std::lock_guard<RankedMutex> a(s5);
+    std::lock_guard<RankedMutex> b(s2);  // descending keys: abort
+  } else if (std::strcmp(flag, "--lockrank-recursive") == 0) {
+    RankedMutex m(LockRank::kSync);
+    m.lock();  // NOLINT(lock-guard-discipline): deliberate violation
+    // On a checked build the second lock aborts in PushOrDie BEFORE
+    // touching the std::mutex; on an unchecked build it would be a
+    // genuine self-deadlock, so only attempt it when enforced.
+    if (kLockRankEnforced)
+      m.lock();  // NOLINT(lock-guard-discipline): recursive; checker aborts
+    m.unlock();  // NOLINT(lock-guard-discipline)
+  } else {
+    std::fprintf(stderr, "unknown flag %s\n", flag);
+    return 2;
+  }
+  // Only reachable when FDFS_LOCKRANK is compiled out.
+  std::printf("no abort\n");
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  if (argc > 1 && std::strncmp(argv[1], "--lockrank-", 11) == 0)
+    return RunLockRankViolation(argv[1]);
+
   TestEndian();
   TestBase64();
   TestCrc32();
@@ -430,6 +584,9 @@ int main() {
   TestEventLoopLagHook();
   TestWorkerPoolQueueStats();
   TestStatsRegistryPruneGauges();
+  TestRankedMutex();
+  TestRankedMutexThreaded();
+  TestRankedMutexInversionAborts(argv[0]);
   if (g_failures == 0) {
     std::printf("common_test: ALL PASS\n");
     return 0;

@@ -162,12 +162,12 @@ struct Shared {
   int64_t size = 0;
   int64_t unique = 0;  // 0 = every payload unique
   std::vector<std::string> ids;  // download/delete input
-  std::mutex out_mu;
+  RankedMutex out_mu{LockRank::kToolOutput};
   std::vector<OpRecord> records;
 };
 
 void Emit(Shared* sh, std::vector<OpRecord>* local) {
-  std::lock_guard<std::mutex> lk(sh->out_mu);
+  std::lock_guard<RankedMutex> lk(sh->out_mu);
   for (auto& r : *local) sh->records.push_back(std::move(r));
   local->clear();
 }

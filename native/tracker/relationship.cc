@@ -90,7 +90,7 @@ RelationshipManager::~RelationshipManager() { Stop(); }
 void RelationshipManager::Start() {
   if (peers_.empty()) {
     // Single-tracker cluster: this tracker IS the leader, no thread.
-    std::lock_guard<std::mutex> lk(mu_);
+    std::lock_guard<RankedMutex> lk(mu_);
     leader_addr_ = my_addr_;
     return;
   }
@@ -103,12 +103,12 @@ void RelationshipManager::Stop() {
 }
 
 bool RelationshipManager::am_leader() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   return leader_addr_ == my_addr_;
 }
 
 std::string RelationshipManager::leader_addr() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   return leader_addr_;
 }
 
@@ -120,12 +120,12 @@ std::string RelationshipManager::PackStatus() const {
 }
 
 void RelationshipManager::OnNotifyNextLeader(const std::string& addr) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   pending_leader_ = addr;
 }
 
 bool RelationshipManager::OnCommitNextLeader(const std::string& addr) {
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   if (pending_leader_ != addr) return false;
   if (leader_addr_ != addr) {
     FDFS_LOG_INFO("tracker leader committed: %s%s", addr.c_str(),
@@ -201,7 +201,7 @@ void RelationshipManager::RunElection() {
                     my_addr_);
     }
   }
-  std::lock_guard<std::mutex> lk(mu_);
+  std::lock_guard<RankedMutex> lk(mu_);
   if (leader_addr_ != winner)
     FDFS_LOG_INFO("tracker leader elected: %s%s", winner.c_str(),
                   winner == my_addr_ ? " (this tracker)" : "");
@@ -216,19 +216,19 @@ void RelationshipManager::ThreadMain() {
       RunElection();
     } else if (leader != my_addr_) {
       if (PingLeaderOnce(leader)) {
-        std::lock_guard<std::mutex> lk(mu_);
+        std::lock_guard<RankedMutex> lk(mu_);
         ping_failures_ = 0;
       } else {
         int fails;
         {
-          std::lock_guard<std::mutex> lk(mu_);
+          std::lock_guard<RankedMutex> lk(mu_);
           fails = ++ping_failures_;
         }
         if (fails >= kPingFailureLimit) {
           FDFS_LOG_WARN("tracker leader %s unresponsive (%d pings): "
                         "re-electing", leader.c_str(), fails);
           {
-            std::lock_guard<std::mutex> lk(mu_);
+            std::lock_guard<RankedMutex> lk(mu_);
             leader_addr_.clear();
           }
           RunElection();

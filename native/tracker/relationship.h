@@ -17,6 +17,8 @@
 
 #include <atomic>
 #include <mutex>
+
+#include "common/lockrank.h"
 #include <string>
 #include <thread>
 #include <vector>
@@ -64,7 +66,7 @@ class RelationshipManager {
   const std::vector<std::string> peers_;  // excluding self after ctor
   std::atomic<bool> stop_{false};
   std::thread thread_;
-  mutable std::mutex mu_;
+  mutable RankedMutex mu_{LockRank::kRelationship};
   std::string leader_addr_;
   std::string pending_leader_;
   int ping_failures_ = 0;

@@ -25,21 +25,39 @@ def ensure_native_built(targets: tuple[str, ...] = ()) -> None:
     missing = [t for t in (STORAGED, *targets) if not os.path.exists(t)]
     if not missing:
         return
-    cmake = ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD,
-             "-G", "Ninja"]
-    # An alternate tree implies a sanitizer build (tools/run_sanitizers.sh
-    # naming); configuring it without -DSANITIZE would silently produce
-    # uninstrumented binaries that "pass" the sanitizer suite.
+    # An alternate tree implies an instrumented build
+    # (tools/run_sanitizers.sh naming); configuring it without the
+    # matching flags would silently produce uninstrumented binaries that
+    # "pass" the sanitizer suite.  build-lockrank is TSan + the
+    # FDFS_LOCKRANK rank checker (common/lockrank.h).
     base = os.path.basename(BUILD)
+    sanitize, lockrank = "", False
     if base.startswith("build-"):
-        kind = {"asan": "address", "tsan": "thread",
-                "ubsan": "undefined"}.get(base[len("build-"):])
-        if kind is None:
-            raise RuntimeError(
-                f"unknown sanitizer build dir {base!r}: build it explicitly")
-        cmake.append(f"-DSANITIZE={kind}")
-    subprocess.run(cmake, check=True, capture_output=True)
-    subprocess.run(["ninja", "-C", BUILD], check=True, capture_output=True)
+        flavor = base[len("build-"):]
+        if flavor == "lockrank":
+            sanitize, lockrank = "thread", True
+        else:
+            sanitize = {"asan": "address", "tsan": "thread",
+                        "ubsan": "undefined"}.get(flavor, "")
+            if not sanitize:
+                raise RuntimeError(
+                    f"unknown sanitizer build dir {base!r}: "
+                    f"build it explicitly")
+    import shutil
+    if shutil.which("cmake") and shutil.which("ninja"):
+        cmake = ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD,
+                 "-G", "Ninja", f"-DSANITIZE={sanitize}",
+                 f"-DFDFS_LOCKRANK={'ON' if lockrank else 'OFF'}"]
+        subprocess.run(cmake, check=True, capture_output=True)
+        subprocess.run(["ninja", "-C", BUILD], check=True,
+                       capture_output=True)
+    else:
+        # cmake-less environments build through the mirrored g++ script.
+        env = dict(os.environ, BUILD_DIR=base, SANITIZE=sanitize,
+                   FDFS_LOCKRANK="1" if lockrank else "")
+        subprocess.run(
+            ["bash", os.path.join(REPO, "tools", "build_native_gxx.sh")],
+            check=True, capture_output=True, env=env)
 
 
 def free_port() -> int:

@@ -46,8 +46,9 @@ from fastdfs_tpu.ops import gear_cdc
 from tests.harness import (BUILD, Daemon, STORAGED, TRACKERD, free_port,
                            start_storage, start_tracker, upload_retry)
 
-_HAVE_TOOLCHAIN = (shutil.which("cmake") is not None
-                   and shutil.which("ninja") is not None)
+_HAVE_TOOLCHAIN = ((shutil.which("cmake") is not None
+                    and shutil.which("ninja") is not None)
+                   or shutil.which("g++") is not None)
 _HAVE_BINARIES = os.path.exists(STORAGED) and os.path.exists(TRACKERD)
 needs_native = pytest.mark.skipif(
     not (_HAVE_TOOLCHAIN or _HAVE_BINARIES),
@@ -131,17 +132,22 @@ def test_gen_protocol_rejects_opcode_collisions():
         sys.path.insert(0, native_dir)
     gen_protocol = importlib.import_module("gen_protocol")
 
+    # Python's Enum silently turns a duplicate value into an ALIAS (the
+    # silent failure mode the validation exists for); the check now
+    # lives at the MANIFEST layer, where every enumerator is plain data.
     class Collides(enum.IntEnum):
         A = 7
-        B = 7  # alias — the silent failure mode the assert exists for
+        B = 7
         C = 9
 
+    manifest = gen_protocol.build_manifest()
+    manifest["enums"]["Collides"] = [
+        {"name": n, "cpp": gen_protocol._cpp_name(n), "value": int(m.value)}
+        for n, m in Collides.__members__.items()]
     with pytest.raises(SystemExit, match="duplicate opcode.*A/B = 7"):
-        gen_protocol._assert_unique_values(Collides)
-    # the real enums must pass (and stay collision-free)
-    from fastdfs_tpu.common import protocol as P
-    for cls in (P.TrackerCmd, P.StorageCmd, P.StorageStatus):
-        gen_protocol._assert_unique_values(cls)
+        gen_protocol.validate_manifest(manifest)
+    # the real manifest must pass (and stay collision-free)
+    gen_protocol.validate_manifest(gen_protocol.build_manifest())
 
 
 # ---------------------------------------------------------------------------

@@ -25,8 +25,9 @@ from fastdfs_tpu.common.protocol import BEAT_STAT_COUNT, BEAT_STAT_FIELDS
 from tests.harness import (BUILD, REPO, STORAGED, TRACKERD, start_storage,
                            start_tracker, upload_retry)
 
-_HAVE_TOOLCHAIN = (shutil.which("cmake") is not None
-                   and shutil.which("ninja") is not None)
+_HAVE_TOOLCHAIN = ((shutil.which("cmake") is not None
+                    and shutil.which("ninja") is not None)
+                   or shutil.which("g++") is not None)
 _HAVE_BINARIES = os.path.exists(STORAGED) and os.path.exists(TRACKERD)
 needs_native = pytest.mark.skipif(
     not (_HAVE_TOOLCHAIN or _HAVE_BINARIES),
@@ -305,11 +306,8 @@ def _ensure_codec() -> str:
     codec = os.path.join(BUILD, "fdfs_codec")
     # tracker_test is the staleness sentinel: an old build tree has the
     # codec binary but not the stats-json subcommand this test drives.
-    if not (os.path.exists(codec)
-            and os.path.exists(os.path.join(BUILD, "tracker_test"))):
-        subprocess.run(["cmake", "-S", os.path.join(REPO, "native"), "-B",
-                        BUILD, "-G", "Ninja"], check=True, capture_output=True)
-        subprocess.run(["ninja", "-C", BUILD], check=True, capture_output=True)
+    from tests.harness import ensure_native_built
+    ensure_native_built((codec, os.path.join(BUILD, "tracker_test")))
     return codec
 
 

@@ -20,13 +20,10 @@ TRACKER_TEST = os.path.join(BUILD, "tracker_test")
 def _ensure_built():
     # TRACKER_TEST doubles as the staleness sentinel: a build tree from
     # before the stats subsystem has codec+common_test but not it, and
-    # must be rebuilt (ninja is a no-op when already current).
-    if (os.path.exists(CODEC) and os.path.exists(COMMON_TEST)
-            and os.path.exists(TRACKER_TEST)):
-        return
-    subprocess.run(["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD,
-                    "-G", "Ninja"], check=True, capture_output=True)
-    subprocess.run(["ninja", "-C", BUILD], check=True, capture_output=True)
+    # must be rebuilt.  harness.ensure_native_built picks cmake/ninja or
+    # the mirrored tools/build_native_gxx.sh, whichever the box has.
+    from tests.harness import ensure_native_built
+    ensure_native_built((CODEC, COMMON_TEST, TRACKER_TEST))
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -57,6 +54,19 @@ def test_generated_protocol_header_current():
     with open(os.path.join(REPO, "native", "common", "protocol_gen.h")) as fh:
         assert fh.read() == gen_protocol.generate(), (
             "protocol_gen.h is stale; run native/gen_protocol.py")
+
+
+def test_protocol_manifest_current():
+    # The manifest is the machine-readable contract fdfs_lint checks the
+    # tree against; a hand-edit (or a protocol.py change without
+    # regeneration) must fail loudly here, not drift silently.
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "native"))
+    import gen_protocol
+    with open(os.path.join(REPO, "native", "protocol_manifest.json")) as fh:
+        assert fh.read() == gen_protocol.manifest_json(
+            gen_protocol.build_manifest()), (
+            "protocol_manifest.json is stale; run native/gen_protocol.py")
 
 
 def test_file_id_cpp_encode_python_decode():

@@ -32,9 +32,9 @@ from tests.harness import (BUILD, REPO, STORAGED, TRACKERD, chunk_files,
                            corrupt_chunk, free_port, start_storage,
                            start_tracker, upload_retry)
 
-_HAVE_TOOLCHAIN = (shutil.which("cmake") is not None
-                   and shutil.which("ninja") is not None) or \
-    shutil.which("g++") is not None
+_HAVE_TOOLCHAIN = ((shutil.which("cmake") is not None
+                    and shutil.which("ninja") is not None)
+                   or shutil.which("g++") is not None)
 _HAVE_BINARIES = os.path.exists(STORAGED) and os.path.exists(TRACKERD)
 needs_native = pytest.mark.skipif(
     not (_HAVE_TOOLCHAIN or _HAVE_BINARIES),
@@ -249,16 +249,36 @@ def test_saturation_flight_recorder_and_top(tmp_path):
         assert any(w.parent_id in root_ids for w in waits)
 
         # -- inject bit-rot, kick scrub: events in EVENT_DUMP -------------
+        # Corrupt a chunk that BOTH storages already hold: under
+        # sanitizer/1-CPU load the sync worker can lag the load loop by
+        # tens of seconds, and corrupting a just-uploaded chunk the
+        # replica lacks makes every repair attempt legitimately
+        # 'no_replica' instead of exercising the repair path.
         victim = 0
-        dig, _path = corrupt_chunk(bases[victim])
+
+        def replicated_digest():
+            common = ({os.path.basename(p) for p in chunk_files(bases[0])}
+                      & {os.path.basename(p) for p in chunk_files(bases[1])})
+            return sorted(common)[0] if common else None
+
+        dig = _wait(replicated_digest, timeout=40)
+        assert dig, "no chunk replicated to both storages"
+        dig, _path = corrupt_chunk(bases[victim], digest=dig)
         ip, port = sts[victim].ip, sts[victim].port
         cli.scrub_kick(ip, port)
 
         def quarantine_event():
             evs = M.decode_events(cli.storage_events(ip, port))
             got = {e.type for e in evs}
-            return evs if {"chunk.quarantined", "chunk.repaired"} <= got \
-                else None
+            if {"chunk.quarantined", "chunk.repaired"} <= got:
+                return evs
+            # The group replica may not have received this chunk yet
+            # (sync lags behind under sanitizer/1-CPU load), making the
+            # first repair attempt 'unrepairable'.  Periodic scrubbing
+            # retries the repair every pass; with scrub_interval_s = 0
+            # each kick IS a pass, so keep kicking while we wait.
+            cli.scrub_kick(ip, port)
+            return None
         evs = _wait(quarantine_event, timeout=40)
         assert evs, f"events: {M.decode_events(cli.storage_events(ip, port))}"
         quar = [e for e in evs if e.type == "chunk.quarantined"]

@@ -27,9 +27,9 @@ from fastdfs_tpu.common.jumphash import jump_hash, replica_for_range
 from tests.harness import (STORAGED, TRACKERD, corrupt_chunk, free_port,
                            start_storage, start_tracker, upload_retry)
 
-_HAVE_TOOLCHAIN = (shutil.which("cmake") is not None
-                   and shutil.which("ninja") is not None) or \
-    shutil.which("g++") is not None
+_HAVE_TOOLCHAIN = ((shutil.which("cmake") is not None
+                    and shutil.which("ninja") is not None)
+                   or shutil.which("g++") is not None)
 _HAVE_BINARIES = os.path.exists(STORAGED) and os.path.exists(TRACKERD)
 needs_native = pytest.mark.skipif(
     not (_HAVE_TOOLCHAIN or _HAVE_BINARIES),

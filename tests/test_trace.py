@@ -21,8 +21,9 @@ from fastdfs_tpu.common import protocol as P
 from tests.harness import (BUILD, REPO, STORAGED, TRACKERD, start_storage,
                            start_tracker, upload_retry)
 
-_HAVE_TOOLCHAIN = (shutil.which("cmake") is not None
-                   and shutil.which("ninja") is not None)
+_HAVE_TOOLCHAIN = ((shutil.which("cmake") is not None
+                    and shutil.which("ninja") is not None)
+                   or shutil.which("g++") is not None)
 _HAVE_BINARIES = os.path.exists(STORAGED) and os.path.exists(TRACKERD)
 needs_native = pytest.mark.skipif(
     not (_HAVE_TOOLCHAIN or _HAVE_BINARIES),
@@ -158,10 +159,8 @@ def test_tracer_spans_nest_and_wire_ctx():
 
 def _ensure_codec() -> str:
     codec = os.path.join(BUILD, "fdfs_codec")
-    if not os.path.exists(codec) and _HAVE_TOOLCHAIN:
-        subprocess.run(["cmake", "-S", os.path.join(REPO, "native"), "-B",
-                        BUILD, "-G", "Ninja"], check=True, capture_output=True)
-        subprocess.run(["ninja", "-C", BUILD], check=True, capture_output=True)
+    from tests.harness import ensure_native_built
+    ensure_native_built((codec,))
     return codec
 
 

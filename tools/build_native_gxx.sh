@@ -4,15 +4,34 @@
 # native/build/ where tests/harness.py expects them).  Use when the
 # environment lacks the cmake toolchain; otherwise prefer
 # `cmake -S native -B native/build -G Ninja && ninja -C native/build`.
+#
+# Env knobs (mirroring the CMake cache options, so tools/run_sanitizers.sh
+# can drive either toolchain):
+#   BUILD_DIR=build-tsan       output tree under native/ (default: build)
+#   SANITIZE=address|thread|undefined
+#   FDFS_LOCKRANK=1            compile in the lock-rank order checker
 set -euo pipefail
 cd "$(dirname "$0")/../native"
 
+BUILD_DIR="${BUILD_DIR:-build}"
 FLAGS="-std=c++17 -O2 -g -Wall -Wextra -I."
-mkdir -p build/obj
+if [ -n "${SANITIZE:-}" ]; then
+  FLAGS="$FLAGS -fsanitize=$SANITIZE -fno-omit-frame-pointer"
+  if [ "$SANITIZE" = undefined ]; then
+    # UB must be loud: without this UBSan prints and continues, and a
+    # "passing" ubsan leg would mean nothing.
+    FLAGS="$FLAGS -fno-sanitize-recover=all"
+  fi
+fi
+if [ -n "${FDFS_LOCKRANK:-}" ] && [ "${FDFS_LOCKRANK}" != 0 ]; then
+  FLAGS="$FLAGS -DFDFS_LOCKRANK"
+fi
+mkdir -p "$BUILD_DIR/obj"
 
 srcs_common="common/bytes.cc common/cdc.cc common/fileid.cc common/ini.cc
-  common/log.cc common/net.cc common/req_server.cc common/stats.cc
-  common/trace.cc common/eventlog.cc common/fsutil.cc common/http_token.cc"
+  common/lockrank.cc common/log.cc common/net.cc common/req_server.cc
+  common/stats.cc common/trace.cc common/eventlog.cc common/fsutil.cc
+  common/http_token.cc"
 srcs_storage="storage/chunkstore.cc storage/config.cc storage/store.cc
   storage/binlog.cc storage/trunk.cc storage/recovery.cc storage/scrub.cc storage/dedup.cc
   storage/server.cc storage/sync.cc storage/tracker_client.cc"
@@ -20,32 +39,35 @@ srcs_tracker="tracker/cluster.cc tracker/relationship.cc tracker/server.cc"
 
 pids=""
 for f in $srcs_common $srcs_storage $srcs_tracker; do
-  o="build/obj/$(echo "$f" | tr / _ | sed 's/\.cc$/.o/')"
+  o="$BUILD_DIR/obj/$(echo "$f" | tr / _ | sed 's/\.cc$/.o/')"
   g++ $FLAGS -c "$f" -o "$o" &
   pids="$pids $!"
 done
 # SHA-NI TU gets its own ISA flags (runtime cpuid gate keeps it safe on
 # older hosts) — matches the fdfs_sha1ni OBJECT library in CMake.
 g++ $FLAGS -msha -mssse3 -msse4.1 -c common/sha1_ni.cc \
-  -o build/obj/common_sha1_ni.o &
+  -o "$BUILD_DIR/obj/common_sha1_ni.o" &
 pids="$pids $!"
 for p in $pids; do wait "$p"; done
 
-ar rcs build/obj/libfdfs_common.a build/obj/common_*.o
-ar rcs build/obj/libfdfs_storage.a build/obj/storage_*.o
-ar rcs build/obj/libfdfs_tracker.a build/obj/tracker_*.o
+ar rcs "$BUILD_DIR/obj/libfdfs_common.a" "$BUILD_DIR"/obj/common_*.o
+ar rcs "$BUILD_DIR/obj/libfdfs_storage.a" "$BUILD_DIR"/obj/storage_*.o
+ar rcs "$BUILD_DIR/obj/libfdfs_tracker.a" "$BUILD_DIR"/obj/tracker_*.o
 
 link() { g++ $FLAGS "$@" -lpthread; }
-link storage/main.cc build/obj/libfdfs_storage.a build/obj/libfdfs_common.a \
-  -o build/fdfs_storaged &
-link tracker/main.cc build/obj/libfdfs_tracker.a build/obj/libfdfs_common.a \
-  -o build/fdfs_trackerd &
-link tools/codec_cli.cc build/obj/libfdfs_common.a -o build/fdfs_codec &
-link tools/load_cli.cc build/obj/libfdfs_common.a -o build/fdfs_load &
-link tests/common_test.cc build/obj/libfdfs_common.a -o build/common_test &
-link tests/storage_test.cc build/obj/libfdfs_storage.a \
-  build/obj/libfdfs_common.a -o build/storage_test &
-link tests/tracker_test.cc build/obj/libfdfs_tracker.a \
-  build/obj/libfdfs_common.a -o build/tracker_test &
+link storage/main.cc "$BUILD_DIR/obj/libfdfs_storage.a" \
+  "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/fdfs_storaged" &
+link tracker/main.cc "$BUILD_DIR/obj/libfdfs_tracker.a" \
+  "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/fdfs_trackerd" &
+link tools/codec_cli.cc "$BUILD_DIR/obj/libfdfs_common.a" \
+  -o "$BUILD_DIR/fdfs_codec" &
+link tools/load_cli.cc "$BUILD_DIR/obj/libfdfs_common.a" \
+  -o "$BUILD_DIR/fdfs_load" &
+link tests/common_test.cc "$BUILD_DIR/obj/libfdfs_common.a" \
+  -o "$BUILD_DIR/common_test" &
+link tests/storage_test.cc "$BUILD_DIR/obj/libfdfs_storage.a" \
+  "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/storage_test" &
+link tests/tracker_test.cc "$BUILD_DIR/obj/libfdfs_tracker.a" \
+  "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/tracker_test" &
 wait
-echo "native build complete: $(ls build/fdfs_storaged build/fdfs_trackerd)"
+echo "native build complete: $(ls "$BUILD_DIR/fdfs_storaged" "$BUILD_DIR/fdfs_trackerd")"

@@ -1,0 +1,737 @@
+#!/usr/bin/env python
+"""fdfs_lint — static contract and lock-discipline linter for the tree.
+
+Five PRs of growth built correctness-critical structure that nothing
+machine-checked: four cross-language contracts (opcodes, the append-only
+stat blobs, conf keys, codec goldens) and a lock protocol (16-way
+digest-striped chunk store with an ascending multi-stripe rule, per-slot
+spin rings).  This linter makes each of them a named, fixture-tested
+check instead of reviewer memory.  The runtime half of the discipline is
+native/common/lockrank.h (the FDFS_LOCKRANK build); this file is the
+static half.
+
+Check classes (each provable-failable by tests/test_lint.py fixtures):
+
+  opcode-parity      protocol.py enums == protocol_manifest.json
+  header-parity      protocol_manifest.json == protocol_gen.h (enums and
+                     the generated kBeatStatNames/kScrubStatNames arrays)
+  stat-fields        BEAT/SCRUB stat blobs are append-only: the frozen
+                     prefix pinned below may never shrink, reorder, or
+                     rename
+  conf-parity        every key parsed by the daemons/client appears in
+                     the matching conf/*.conf sample (and the daemon keys
+                     in OPERATIONS.md), and every real `key = value` line
+                     in a sample is actually parsed by the code
+  golden-coverage    every opcode with a wire body carries an fdfs_codec
+                     golden (which must exist in codec_cli.cc and be
+                     referenced by a test) or an explicit allowlist entry
+  lock-raw-mutex     no raw std::mutex / pthread_mutex_t /
+                     std::condition_variable in native/ outside
+                     common/lockrank.h — every lock is a RankedMutex (or
+                     RankedSpinLock) with a documented rank
+  lock-guard-discipline  no bare .lock()/.unlock() calls on mutexes:
+                     locks are taken through std::lock_guard /
+                     std::unique_lock / SpinGuard only (guard variables
+                     named `lk`/`ulk` may re-lock — that is still
+                     guard-mediated)
+  spin-region-blocking   no blocking syscalls inside a SpinGuard-held
+                     region (per-slot ring spinlocks must stay
+                     bounded-copy critical sections)
+
+Usage:
+  python tools/fdfs_lint.py              # lint the repo, exit 1 on findings
+  python tools/fdfs_lint.py --list       # list check classes
+  python tools/fdfs_lint.py --only conf-parity [--only ...]
+  python tools/fdfs_lint.py --root DIR   # lint another tree (fixtures)
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@dataclass
+class Finding:
+    check: str
+    path: str       # repo-relative
+    line: int       # 1-based; 0 = whole file
+    message: str
+
+    def __str__(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.check}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# Frozen stat-field prefixes: the blobs are APPEND-ONLY wire contracts
+# (old decoders read missing tail slots as 0).  These are the fields
+# shipped as of this linter's introduction; grow them only by appending
+# to protocol.py AND appending the same name here.  Any rename, reorder,
+# or removal of a frozen slot breaks deployed decoders and fails here.
+# ---------------------------------------------------------------------------
+
+FROZEN_BEAT_PREFIX = (
+    "total_upload", "success_upload",
+    "total_download", "success_download",
+    "total_delete", "success_delete",
+    "total_append", "success_append",
+    "total_set_meta", "success_set_meta",
+    "total_get_meta", "success_get_meta",
+    "total_query", "success_query",
+    "bytes_uploaded", "bytes_downloaded",
+    "dedup_hits", "dedup_bytes_saved",
+    "last_source_update",
+    "connections",
+    "refused_connections",
+    "sync_lag_s",
+    "sync_bytes_saved_wire",
+    "recovery_chunks_fetched",
+    "recovery_chunks_local",
+    "recovery_files",
+    "fetch_chunk_batches",
+    "dedup_chunk_misses",
+)
+
+FROZEN_SCRUB_PREFIX = (
+    "running", "passes", "pass_chunks_done", "pass_chunks_total",
+    "chunks_verified", "bytes_verified", "chunks_corrupt",
+    "chunks_repaired", "corrupt_unrepairable", "quarantined",
+    "skipped_pinned", "gc_pending_chunks", "gc_pending_bytes",
+    "chunks_reclaimed", "bytes_reclaimed", "recipes_reclaimed",
+    "last_pass_unix", "last_pass_duration_us",
+)
+
+# ---------------------------------------------------------------------------
+# Opcodes with a wire body but no fdfs_codec golden.  Every entry is a
+# DECISION with a reason — adding an opcode without either a golden or a
+# row here fails golden-coverage, which is the point: new wire surface
+# must pick its pinning story in the same PR.
+# ---------------------------------------------------------------------------
+
+_FIXED_FIELDS = ("fixed header-framed fields (group/ip/int64 slots); "
+                 "exercised end-to-end by the live daemon suite")
+_JSON_LISTING = ("ops listing JSON consumed only by fastdfs_tpu.monitor; "
+                 "shape asserted by test_monitor.py against live daemons")
+_BEAT_CONTRACT = ("stat blob named by the GENERATED kBeatStatNames contract "
+                  "(protocol_gen.h == BEAT_STAT_FIELDS by construction)")
+_SIDE_CAR = ("sidecar-local RPC (unix socket, same-host); layout asserted "
+             "by the dedup engine suite")
+_REPLICATION = ("replication/recovery wire asserted byte-level by "
+                "test_replication.py / test_disk_recovery.py fixtures")
+
+GOLDEN_ALLOWLIST = {
+    # tracker: cluster management
+    "TrackerCmd.STORAGE_JOIN": _FIXED_FIELDS,
+    "TrackerCmd.STORAGE_BEAT": _BEAT_CONTRACT,
+    "TrackerCmd.STORAGE_REPORT_DISK_USAGE": _FIXED_FIELDS,
+    "TrackerCmd.STORAGE_REPLICA_CHG": _FIXED_FIELDS,
+    "TrackerCmd.STORAGE_SYNC_SRC_REQ": _FIXED_FIELDS,
+    "TrackerCmd.STORAGE_SYNC_DEST_REQ": _FIXED_FIELDS,
+    "TrackerCmd.STORAGE_SYNC_NOTIFY": _FIXED_FIELDS,
+    "TrackerCmd.STORAGE_SYNC_REPORT": _FIXED_FIELDS,
+    "TrackerCmd.STORAGE_SYNC_DEST_QUERY": _FIXED_FIELDS,
+    "TrackerCmd.STORAGE_REPORT_IP_CHANGED": _FIXED_FIELDS,
+    "TrackerCmd.STORAGE_CHANGELOG_REQ": _FIXED_FIELDS,
+    "TrackerCmd.STORAGE_PARAMETER_REQ": "key=value text; parsed by both "
+                                        "daemons, covered by trunk tests",
+    "TrackerCmd.SERVER_LIST_ONE_GROUP": _JSON_LISTING,
+    "TrackerCmd.SERVER_LIST_ALL_GROUPS": _JSON_LISTING,
+    "TrackerCmd.SERVER_LIST_STORAGE": _JSON_LISTING,
+    "TrackerCmd.SERVER_DELETE_STORAGE": _FIXED_FIELDS,
+    "TrackerCmd.SERVER_SET_TRUNK_SERVER": _FIXED_FIELDS,
+    "TrackerCmd.SERVICE_QUERY_STORE_WITHOUT_GROUP_ONE": _FIXED_FIELDS,
+    "TrackerCmd.SERVICE_QUERY_FETCH_ONE": _FIXED_FIELDS,
+    "TrackerCmd.SERVICE_QUERY_UPDATE": _FIXED_FIELDS,
+    "TrackerCmd.SERVICE_QUERY_STORE_WITH_GROUP_ONE": _FIXED_FIELDS,
+    "TrackerCmd.SERVICE_QUERY_FETCH_ALL": _FIXED_FIELDS,
+    "TrackerCmd.SERVICE_QUERY_STORE_WITHOUT_GROUP_ALL": _FIXED_FIELDS,
+    "TrackerCmd.SERVICE_QUERY_STORE_WITH_GROUP_ALL": _FIXED_FIELDS,
+    "TrackerCmd.TRACKER_GET_STATUS": _FIXED_FIELDS,
+    "TrackerCmd.TRACKER_GET_SYS_FILES_START": _FIXED_FIELDS,
+    "TrackerCmd.TRACKER_GET_SYS_FILES_END": _FIXED_FIELDS,
+    "TrackerCmd.TRACKER_GET_ONE_SYS_FILE": _FIXED_FIELDS,
+    "TrackerCmd.TRACKER_PING_LEADER": _FIXED_FIELDS,
+    "TrackerCmd.TRACKER_NOTIFY_NEXT_LEADER": _FIXED_FIELDS,
+    "TrackerCmd.TRACKER_COMMIT_NEXT_LEADER": _FIXED_FIELDS,
+    "TrackerCmd.TRACKER_GET_TRUNK_SERVER": _FIXED_FIELDS,
+    # storage: file service (upstream-shaped fixed fields)
+    "StorageCmd.UPLOAD_FILE": _FIXED_FIELDS,
+    "StorageCmd.DELETE_FILE": _FIXED_FIELDS,
+    "StorageCmd.SET_METADATA": _FIXED_FIELDS,
+    "StorageCmd.DOWNLOAD_FILE": _FIXED_FIELDS,
+    "StorageCmd.GET_METADATA": _FIXED_FIELDS,
+    "StorageCmd.SYNC_CREATE_FILE": _REPLICATION,
+    "StorageCmd.SYNC_DELETE_FILE": _REPLICATION,
+    "StorageCmd.SYNC_UPDATE_FILE": _REPLICATION,
+    "StorageCmd.SYNC_CREATE_LINK": _REPLICATION,
+    "StorageCmd.CREATE_LINK": _FIXED_FIELDS,
+    "StorageCmd.UPLOAD_SLAVE_FILE": _FIXED_FIELDS,
+    "StorageCmd.QUERY_FILE_INFO": _FIXED_FIELDS,
+    "StorageCmd.UPLOAD_APPENDER_FILE": _FIXED_FIELDS,
+    "StorageCmd.APPEND_FILE": _FIXED_FIELDS,
+    "StorageCmd.SYNC_APPEND_FILE": _REPLICATION,
+    "StorageCmd.FETCH_ONE_PATH_BINLOG": _FIXED_FIELDS,
+    "StorageCmd.TRUNK_ALLOC_SPACE": "epoch-fenced trunk RPC; slot layout "
+                                    "asserted by test_trunk.py",
+    "StorageCmd.TRUNK_ALLOC_CONFIRM": "see TRUNK_ALLOC_SPACE",
+    "StorageCmd.TRUNK_FREE_SPACE": "see TRUNK_ALLOC_SPACE",
+    "StorageCmd.MODIFY_FILE": _FIXED_FIELDS,
+    "StorageCmd.SYNC_MODIFY_FILE": _REPLICATION,
+    "StorageCmd.TRUNCATE_FILE": _FIXED_FIELDS,
+    "StorageCmd.SYNC_TRUNCATE_FILE": _REPLICATION,
+    "StorageCmd.DEDUP_FINGERPRINT": _SIDE_CAR,
+    "StorageCmd.DEDUP_QUERY": _SIDE_CAR,
+    "StorageCmd.DEDUP_COMMIT": _SIDE_CAR,
+    "StorageCmd.DEDUP_NEARDUPS": _SIDE_CAR,
+    "StorageCmd.DEDUP_FINGERPRINT_CUTS": _SIDE_CAR,
+    "StorageCmd.DEDUP_VERIFY": _SIDE_CAR,
+    "StorageCmd.SYNC_QUERY_CHUNKS": _REPLICATION,
+    "StorageCmd.SYNC_CREATE_RECIPE": _REPLICATION,
+    "StorageCmd.FETCH_RECIPE": _REPLICATION,
+    "StorageCmd.FETCH_CHUNK": _REPLICATION,
+    "StorageCmd.SCRUB_KICK": "empty body, status-only response; asserted "
+                             "by test_scrub.py",
+    "StorageCmd.NEAR_DUPS": "text lines '<file_id> <score>'; asserted by "
+                            "test_near_dups.py",
+}
+
+# conf keys whose parse site builds the key dynamically; map the literal
+# the extractor sees to the sample key that documents the family.
+_DYNAMIC_CONF_KEYS = {"store_path": "store_path0"}
+
+
+# ---------------------------------------------------------------------------
+# Small parsing helpers
+# ---------------------------------------------------------------------------
+
+def _read(root: str, rel: str) -> str | None:
+    try:
+        with open(os.path.join(root, rel), encoding="utf-8") as fh:
+            return fh.read()
+    except OSError:
+        return None
+
+
+def _need(root: str, rel: str, check: str,
+          out: list[Finding]) -> str | None:
+    text = _read(root, rel)
+    if text is None:
+        out.append(Finding(check, rel, 0, "file missing or unreadable"))
+    return text
+
+
+def _parse_py_enums(text: str) -> dict[str, dict[str, int]]:
+    """{'TrackerCmd': {'STORAGE_JOIN': 81, ...}, ...} via AST — the
+    linter never imports the tree it lints (fixture roots are plain
+    text, and a broken protocol.py must fail parse, not crash us)."""
+    tree = ast.parse(text)
+    out: dict[str, dict[str, int]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        members: dict[str, int] = {}
+        for stmt in node.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, int)):
+                members[stmt.targets[0].id] = stmt.value.value
+        if members:
+            out[node.name] = members
+    return out
+
+
+def _parse_py_str_tuple(text: str, name: str) -> list[str] | None:
+    tree = ast.parse(text)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+                and isinstance(node.value, ast.Tuple)):
+            vals = []
+            for elt in node.value.elts:
+                if not (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)):
+                    return None
+                vals.append(elt.value)
+            return vals
+    return None
+
+
+def _parse_header_enums(text: str) -> dict[str, dict[str, int]]:
+    """{'TrackerCmd': {'kStorageJoin': 81, ...}} from protocol_gen.h."""
+    out: dict[str, dict[str, int]] = {}
+    for m in re.finditer(
+            r"enum class (\w+)\s*:\s*\w+\s*\{([^}]*)\}", text):
+        members = {}
+        for em in re.finditer(r"(k\w+)\s*=\s*(\d+)\s*,", m.group(2)):
+            members[em.group(1)] = int(em.group(2))
+        out[m.group(1)] = members
+    return out
+
+
+def _parse_header_name_array(text: str, array: str) -> list[str] | None:
+    m = re.search(re.escape(array) + r"\[[^\]]*\]\s*=\s*\{([^}]*)\}", text)
+    if m is None:
+        return None
+    return re.findall(r'"([^"]+)"', m.group(1))
+
+
+def _strip_cc_comments(text: str) -> str:
+    """Drop // and /* */ comments, preserving line structure so finding
+    line numbers stay meaningful."""
+    text = re.sub(r"/\*.*?\*/",
+                  lambda m: re.sub(r"[^\n]", " ", m.group(0)),
+                  text, flags=re.S)
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def _native_sources(root: str) -> list[str]:
+    out = []
+    for base, dirs, files in os.walk(os.path.join(root, "native")):
+        dirs[:] = [d for d in dirs if not d.startswith("build")]
+        for f in files:
+            if f.endswith((".h", ".cc")):
+                out.append(os.path.relpath(os.path.join(base, f), root))
+    return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# Check classes
+# ---------------------------------------------------------------------------
+
+def check_opcode_parity(root: str) -> list[Finding]:
+    """protocol.py enum members == protocol_manifest.json entries."""
+    out: list[Finding] = []
+    proto = _need(root, "fastdfs_tpu/common/protocol.py", "opcode-parity", out)
+    mani = _need(root, "native/protocol_manifest.json", "opcode-parity", out)
+    if proto is None or mani is None:
+        return out
+    try:
+        manifest = json.loads(mani)
+    except ValueError as e:
+        out.append(Finding("opcode-parity", "native/protocol_manifest.json",
+                           0, f"unparseable JSON: {e}"))
+        return out
+    py_enums = _parse_py_enums(proto)
+    for enum_name in ("TrackerCmd", "StorageCmd", "StorageStatus"):
+        py = py_enums.get(enum_name)
+        entries = manifest.get("enums", {}).get(enum_name)
+        if py is None:
+            out.append(Finding("opcode-parity",
+                               "fastdfs_tpu/common/protocol.py", 0,
+                               f"enum {enum_name} not found"))
+            continue
+        if entries is None:
+            out.append(Finding("opcode-parity",
+                               "native/protocol_manifest.json", 0,
+                               f"enum {enum_name} missing from manifest"))
+            continue
+        mani_vals = {e["name"]: e["value"] for e in entries}
+        for name, value in py.items():
+            if name not in mani_vals:
+                out.append(Finding(
+                    "opcode-parity", "native/protocol_manifest.json", 0,
+                    f"{enum_name}.{name} in protocol.py but not in the "
+                    f"manifest — run native/gen_protocol.py"))
+            elif mani_vals[name] != value:
+                out.append(Finding(
+                    "opcode-parity", "native/protocol_manifest.json", 0,
+                    f"{enum_name}.{name} = {value} in protocol.py but "
+                    f"{mani_vals[name]} in the manifest"))
+        for name in mani_vals:
+            if name not in py:
+                out.append(Finding(
+                    "opcode-parity", "native/protocol_manifest.json", 0,
+                    f"{enum_name}.{name} in the manifest but not in "
+                    f"protocol.py"))
+    return out
+
+
+def check_header_parity(root: str) -> list[Finding]:
+    """protocol_manifest.json == protocol_gen.h (enums + stat-name
+    arrays).  Textual, so it works on fixture trees with no compiler."""
+    out: list[Finding] = []
+    mani = _need(root, "native/protocol_manifest.json", "header-parity", out)
+    header = _need(root, "native/common/protocol_gen.h", "header-parity", out)
+    if mani is None or header is None:
+        return out
+    try:
+        manifest = json.loads(mani)
+    except ValueError:
+        return out  # opcode-parity reports the parse failure
+    hdr_enums = _parse_header_enums(header)
+    for enum_name, entries in manifest.get("enums", {}).items():
+        hdr = hdr_enums.get(enum_name)
+        if hdr is None:
+            out.append(Finding("header-parity",
+                               "native/common/protocol_gen.h", 0,
+                               f"enum {enum_name} missing from header"))
+            continue
+        want = {e["cpp"]: e["value"] for e in entries}
+        for cpp, value in want.items():
+            if cpp not in hdr:
+                out.append(Finding(
+                    "header-parity", "native/common/protocol_gen.h", 0,
+                    f"{enum_name}::{cpp} in the manifest but not the "
+                    f"header — run native/gen_protocol.py"))
+            elif hdr[cpp] != value:
+                out.append(Finding(
+                    "header-parity", "native/common/protocol_gen.h", 0,
+                    f"{enum_name}::{cpp} = {hdr[cpp]} in the header but "
+                    f"{value} in the manifest"))
+        for cpp in hdr:
+            if cpp not in want:
+                out.append(Finding(
+                    "header-parity", "native/common/protocol_gen.h", 0,
+                    f"{enum_name}::{cpp} in the header but not the "
+                    f"manifest"))
+    for array, field in (("kBeatStatNames", "beat_stat_fields"),
+                         ("kScrubStatNames", "scrub_stat_fields")):
+        names = _parse_header_name_array(header, array)
+        want = manifest.get(field, [])
+        if names is None:
+            out.append(Finding("header-parity",
+                               "native/common/protocol_gen.h", 0,
+                               f"{array} array not found"))
+        elif names != want:
+            out.append(Finding(
+                "header-parity", "native/common/protocol_gen.h", 0,
+                f"{array} != manifest {field}: {names} vs {want}"))
+    return out
+
+
+def check_stat_fields(root: str) -> list[Finding]:
+    """The stat blobs are append-only: the frozen prefix pinned in this
+    linter may never shrink, reorder, or rename (protocol.py is checked
+    directly; opcode/header parity transfer the result to the other
+    artifacts)."""
+    out: list[Finding] = []
+    proto = _need(root, "fastdfs_tpu/common/protocol.py", "stat-fields", out)
+    if proto is None:
+        return out
+    for var, frozen in (("BEAT_STAT_FIELDS", FROZEN_BEAT_PREFIX),
+                        ("SCRUB_STAT_FIELDS", FROZEN_SCRUB_PREFIX)):
+        fields = _parse_py_str_tuple(proto, var)
+        if fields is None:
+            out.append(Finding("stat-fields",
+                               "fastdfs_tpu/common/protocol.py", 0,
+                               f"{var} tuple of string literals not found"))
+            continue
+        if tuple(fields[:len(frozen)]) != frozen:
+            for i, want in enumerate(frozen):
+                got = fields[i] if i < len(fields) else "<missing>"
+                if got != want:
+                    out.append(Finding(
+                        "stat-fields", "fastdfs_tpu/common/protocol.py", 0,
+                        f"{var}[{i}] is {got!r}, but the wire contract "
+                        f"froze it as {want!r} — the blob is append-only "
+                        f"(old decoders index by slot); append new fields "
+                        f"at the end instead"))
+                    break
+    return out
+
+
+_CONF_GET_RE = re.compile(
+    r'\b(?:ini|cfg)\s*\.\s*[Gg]et(?:Str|Int|Bool|Seconds|Bytes|All|'
+    r'_str|_int|_bool|_seconds|_bytes|_all)?\s*\(\s*"([a-z][a-z0-9_.]*)"')
+_CONF_KEY_RE = re.compile(r"^([a-z][a-z0-9_.]*)\s*=", re.M)
+_CONF_EXAMPLE_RE = re.compile(r"^# ([a-z][a-z0-9_.]*) = ", re.M)
+
+
+def _parsed_conf_keys(text: str) -> set[str]:
+    keys = set()
+    for m in _CONF_GET_RE.finditer(text):
+        keys.add(_DYNAMIC_CONF_KEYS.get(m.group(1), m.group(1)))
+    return keys
+
+
+def check_conf_parity(root: str) -> list[Finding]:
+    """Daemon/client conf keys <-> conf/*.conf samples <-> OPERATIONS.md.
+
+    Three rules per (parser sources, sample) pair:
+      1. every parsed key appears in the sample (a live `key = value`
+         line or a `# key = value` example — word match anywhere counts
+         as documented);
+      2. every live or example key line in the sample is actually parsed
+         by the code (no dead knobs);
+      3. daemon keys additionally appear in OPERATIONS.md.
+    """
+    out: list[Finding] = []
+    ops = _need(root, "OPERATIONS.md", "conf-parity", out)
+    targets = [
+        ("conf/storage.conf",
+         ["native/storage/config.cc"], True),
+        ("conf/tracker.conf",
+         ["native/tracker/main.cc"], True),
+        ("conf/client.conf",
+         ["fastdfs_tpu/client/client.py"], False),
+    ]
+    for sample_rel, src_rels, in_ops in targets:
+        sample = _need(root, sample_rel, "conf-parity", out)
+        if sample is None:
+            continue
+        parsed: set[str] = set()
+        for src_rel in src_rels:
+            src = _need(root, src_rel, "conf-parity", out)
+            if src is not None:
+                parsed |= _parsed_conf_keys(_strip_cc_comments(src)
+                                            if src_rel.endswith(".cc")
+                                            else src)
+        if not parsed:
+            continue
+        sample_keys = set(_CONF_KEY_RE.findall(sample)) | set(
+            _CONF_EXAMPLE_RE.findall(sample))
+        for key in sorted(parsed):
+            if not re.search(rf"\b{re.escape(key)}\b", sample):
+                out.append(Finding(
+                    "conf-parity", sample_rel, 0,
+                    f"key '{key}' is parsed by {'/'.join(src_rels)} but "
+                    f"never mentioned in the sample — document it (a "
+                    f"commented '# {key} = ...' example counts)"))
+            if in_ops and ops is not None and not re.search(
+                    rf"\b{re.escape(key)}\b", ops):
+                out.append(Finding(
+                    "conf-parity", "OPERATIONS.md", 0,
+                    f"daemon conf key '{key}' ({sample_rel}) is not "
+                    f"documented in OPERATIONS.md"))
+        for key in sorted(sample_keys - parsed):
+            line = next((i + 1 for i, ln in
+                         enumerate(sample.splitlines())
+                         if re.match(rf"#? ?{re.escape(key)}\s*=", ln)), 0)
+            out.append(Finding(
+                "conf-parity", sample_rel, line,
+                f"sample key '{key}' is parsed by none of "
+                f"{'/'.join(src_rels)} — a dead knob misleads operators; "
+                f"wire it up or delete the line"))
+    return out
+
+
+def check_golden_coverage(root: str) -> list[Finding]:
+    """Every opcode with a wire body has a cross-language golden or an
+    explicit allowlist entry; named goldens must exist as fdfs_codec
+    subcommands and be referenced by at least one test."""
+    out: list[Finding] = []
+    mani = _need(root, "native/protocol_manifest.json",
+                 "golden-coverage", out)
+    codec = _need(root, "native/tools/codec_cli.cc", "golden-coverage", out)
+    if mani is None:
+        return out
+    try:
+        manifest = json.loads(mani)
+    except ValueError:
+        return out
+    tests_text = ""
+    tests_dir = os.path.join(root, "tests")
+    if os.path.isdir(tests_dir):
+        for f in sorted(os.listdir(tests_dir)):
+            if f.endswith(".py"):
+                tests_text += _read(root, f"tests/{f}") or ""
+    checked_goldens: set[str] = set()
+    for enum_name in ("TrackerCmd", "StorageCmd"):
+        for e in manifest.get("enums", {}).get(enum_name, []):
+            qual = f"{enum_name}.{e['name']}"
+            if not e.get("wire_body"):
+                continue
+            golden = e.get("golden")
+            if golden is None:
+                if qual not in GOLDEN_ALLOWLIST:
+                    out.append(Finding(
+                        "golden-coverage", "native/protocol_manifest.json",
+                        0,
+                        f"{qual} has a wire body but neither an "
+                        f"fdfs_codec golden (protocol.WIRE_GOLDENS) nor a "
+                        f"GOLDEN_ALLOWLIST entry in tools/fdfs_lint.py — "
+                        f"decide its pinning story"))
+                continue
+            if golden in checked_goldens:
+                continue
+            checked_goldens.add(golden)
+            if codec is not None and f'"{golden}"' not in codec:
+                out.append(Finding(
+                    "golden-coverage", "native/tools/codec_cli.cc", 0,
+                    f"golden '{golden}' ({qual}) is not an fdfs_codec "
+                    f"subcommand"))
+            if tests_text and golden not in tests_text:
+                out.append(Finding(
+                    "golden-coverage", "tests", 0,
+                    f"golden '{golden}' ({qual}) is referenced by no test "
+                    f"under tests/ — an unexercised golden pins nothing"))
+    return out
+
+
+_RAW_MUTEX_RE = re.compile(
+    r"\b(std::(?:recursive_|shared_|timed_)?mutex\b"
+    r"|pthread_mutex_t\b|pthread_spinlock_t\b"
+    r"|std::condition_variable\b(?!_any))")
+
+
+def check_lock_raw_mutex(root: str) -> list[Finding]:
+    """Every lock in native/ is a RankedMutex/RankedSpinLock from
+    common/lockrank.h — a raw mutex has no rank and silently escapes the
+    FDFS_LOCKRANK checker.  (std::condition_variable is included: it
+    only pairs with a raw std::mutex; use std::condition_variable_any
+    over a RankedMutex.)"""
+    out: list[Finding] = []
+    for rel in _native_sources(root):
+        if rel.endswith(os.path.join("common", "lockrank.h")):
+            continue
+        text = _read(root, rel)
+        if text is None:
+            continue
+        raw_lines = text.splitlines()
+        for i, line in enumerate(_strip_cc_comments(text).splitlines(), 1):
+            m = _RAW_MUTEX_RE.search(line)
+            if m and not _nolint(raw_lines[i - 1], "lock-raw-mutex"):
+                out.append(Finding(
+                    "lock-raw-mutex", rel, i,
+                    f"raw {m.group(1)} — declare a RankedMutex with a "
+                    f"documented rank from common/lockrank.h instead"))
+    return out
+
+
+_BARE_LOCK_RE = re.compile(r"\b([A-Za-z_]\w*)\s*\.\s*(lock|unlock)\s*\(\s*\)")
+_PTHREAD_LOCK_RE = re.compile(r"\bpthread_(?:mutex|spin)_(?:lock|unlock|trylock)\s*\(")
+# Guard objects that may legitimately re-lock/unlock mid-scope
+# (std::unique_lock variables by repo convention: lk, lk2, ulk...).
+_GUARD_NAME_RE = re.compile(r"^(?:lk|ulk|ul)\w*$")
+
+
+def _nolint(raw_line: str, check: str) -> bool:
+    """clang-tidy-style suppression: `// NOLINT(<check>)` on the line.
+    Deliberate violations (the lock-rank death tests) stay visible and
+    greppable instead of being silently special-cased."""
+    return f"NOLINT({check})" in raw_line
+
+
+def check_lock_guard_discipline(root: str) -> list[Finding]:
+    """Locks are taken through scoped guards only.  A bare mu.lock()
+    orphans the lock on any early return/exception, and under
+    FDFS_LOCKRANK an unbalanced stack turns every later check into
+    noise.  unique_lock guard variables (named lk/ulk by convention) may
+    re-lock — still guard-owned."""
+    out: list[Finding] = []
+    for rel in _native_sources(root):
+        if rel.endswith(os.path.join("common", "lockrank.h")):
+            continue
+        text = _read(root, rel)
+        if text is None:
+            continue
+        raw_lines = text.splitlines()
+        for i, line in enumerate(_strip_cc_comments(text).splitlines(), 1):
+            if _nolint(raw_lines[i - 1], "lock-guard-discipline"):
+                continue
+            if _PTHREAD_LOCK_RE.search(line):
+                out.append(Finding(
+                    "lock-guard-discipline", rel, i,
+                    "pthread mutex call — use a scoped guard over a "
+                    "RankedMutex"))
+            for m in _BARE_LOCK_RE.finditer(line):
+                if _GUARD_NAME_RE.match(m.group(1)):
+                    continue
+                out.append(Finding(
+                    "lock-guard-discipline", rel, i,
+                    f"bare {m.group(1)}.{m.group(2)}() — take locks via "
+                    f"std::lock_guard/std::unique_lock/SpinGuard so early "
+                    f"returns cannot orphan them"))
+    return out
+
+
+_BLOCKING_CALL_RE = re.compile(
+    r"\b(open|openat|close|read|write|pread|pwrite|readv|writev|fsync|"
+    r"fdatasync|usleep|sleep|nanosleep|poll|select|epoll_wait|recv|send|"
+    r"recvmsg|sendmsg|recvfrom|sendto|connect|accept|accept4|fopen|"
+    r"fclose|fread|fwrite|fprintf|fflush|rename|unlink|mkdir|rmdir|"
+    r"stat|fstat|lstat|statvfs|opendir|readdir|closedir)\s*\(")
+
+
+def check_spin_region_blocking(root: str) -> list[Finding]:
+    """A RankedSpinLock critical section (SpinGuard scope) busy-waits
+    its contenders: a blocking syscall inside one turns every concurrent
+    Record() into a spin on a descheduled holder.  Scans each SpinGuard
+    declaration's enclosing brace scope for blocking calls."""
+    out: list[Finding] = []
+    for rel in _native_sources(root):
+        text = _read(root, rel)
+        if text is None:
+            continue
+        clean = _strip_cc_comments(text)
+        lines = clean.splitlines()
+        for i, line in enumerate(lines):
+            if "SpinGuard" not in line:
+                continue
+            depth = 0
+            for j in range(i, len(lines)):
+                scan = lines[j]
+                if j == i:
+                    scan = scan[scan.index("SpinGuard"):]
+                m = _BLOCKING_CALL_RE.search(scan)
+                if m:
+                    out.append(Finding(
+                        "spin-region-blocking", rel, j + 1,
+                        f"blocking call {m.group(1)}() inside the "
+                        f"SpinGuard region opened at line {i + 1} — slot "
+                        f"spinlocks must stay bounded-copy sections"))
+                depth += scan.count("{") - scan.count("}")
+                if depth < 0:
+                    break
+    return out
+
+
+CHECKS = {
+    "opcode-parity": check_opcode_parity,
+    "header-parity": check_header_parity,
+    "stat-fields": check_stat_fields,
+    "conf-parity": check_conf_parity,
+    "golden-coverage": check_golden_coverage,
+    "lock-raw-mutex": check_lock_raw_mutex,
+    "lock-guard-discipline": check_lock_guard_discipline,
+    "spin-region-blocking": check_spin_region_blocking,
+}
+
+
+def run(root: str, only: list[str] | None = None) -> list[Finding]:
+    names = only or list(CHECKS)
+    findings: list[Finding] = []
+    for name in names:
+        findings.extend(CHECKS[name](root))
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fdfs_lint",
+        description="static contract & lock-discipline linter")
+    ap.add_argument("--root", default=REPO,
+                    help="tree to lint (default: this repo)")
+    ap.add_argument("--only", action="append", choices=sorted(CHECKS),
+                    help="run only these check classes (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="list check classes and exit")
+    args = ap.parse_args(argv)
+    if args.list:
+        for name in CHECKS:
+            print(name)
+        return 0
+    findings = run(args.root, args.only)
+    for f in findings:
+        print(f)
+    n_checks = len(args.only or CHECKS)
+    if findings:
+        print(f"fdfs_lint: {len(findings)} finding(s) "
+              f"across {n_checks} check class(es)", file=sys.stderr)
+        return 1
+    print(f"fdfs_lint: OK ({n_checks} check classes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

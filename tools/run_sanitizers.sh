@@ -1,15 +1,28 @@
 #!/bin/bash
-# Build the native core under ASan and TSan and run the daemon-facing
-# pytest suite against each build (SURVEY.md §5: "ASan/TSan CI targets
-# for the C++ core" — the reference has none; the rebuild's threaded
-# storage daemon needs them).
+# Build the native core under the sanitizer matrix and run the
+# daemon-facing pytest suite against each build (SURVEY.md §5: "ASan/TSan
+# CI targets for the C++ core" — the reference has none; the rebuild's
+# threaded storage daemon needs them).
 #
-# Usage: tools/run_sanitizers.sh [asan|tsan|both] [pytest args...]
+# Usage: tools/run_sanitizers.sh [asan|tsan|ubsan|lockrank|all|both] [pytest args...]
+#
+#   asan      heap errors + leaks
+#   tsan      data races (slot rings, chunk-store stripes, worker pools)
+#   ubsan     undefined behavior, -fno-sanitize-recover (first report aborts)
+#   lockrank  TSan + -DFDFS_LOCKRANK: every RankedMutex acquisition checked
+#             against the per-thread held-rank stack; any lock-order
+#             violation aborts with both lock sites (common/lockrank.h).
+#             The native leg also runs the RankedMutex death tests.
+#   all       the full matrix, in the order above
+#   both      legacy alias for asan + tsan
+#
 # The harness picks up the instrumented binaries via FDFS_NATIVE_BUILD.
+# Builds use cmake/ninja when available and fall back to
+# tools/build_native_gxx.sh (same sources and flags) otherwise.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-MODE="${1:-both}"
+MODE="${1:-all}"
 shift || true
 if [ "$#" -gt 0 ]; then
   PYTEST_ARGS=("$@")
@@ -22,48 +35,63 @@ else
     tests/test_read_path.py tests/test_observability.py)
 fi
 
+build_tree() {
+  local dir="$1" sanitize="$2" lockrank="$3"
+  if command -v cmake >/dev/null && command -v ninja >/dev/null; then
+    local args=(-S native -B "$dir" -G Ninja
+                -DCMAKE_BUILD_TYPE=RelWithDebInfo
+                -DSANITIZE="$sanitize" -DFDFS_LOCKRANK="$lockrank")
+    cmake "${args[@]}" >/dev/null
+    ninja -C "$dir"
+  else
+    BUILD_DIR="$(basename "$dir")" SANITIZE="$sanitize" \
+      FDFS_LOCKRANK="$([ "$lockrank" = ON ] && echo 1 || echo "")" \
+      bash tools/build_native_gxx.sh >/dev/null
+  fi
+}
+
 run_one() {
-  local san="$1" dir="native/build-$1"
-  echo "=== $san: configure + build ==="
-  cmake -S native -B "$dir" -G Ninja -DSANITIZE="$2" \
-        -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
-  ninja -C "$dir"
-  echo "=== $san: native unit tests (incl. trace-ring concurrency) ==="
-  # common_test's TestTraceRingThreaded hammers the lock-light span ring
-  # from 4 recorders + a dumping reader — the TSan run is the proof the
-  # seqlock-free design is data-race-free, not just lucky.
-  # TestEventLogThreaded does the same for the flight recorder, and
-  # TestEventLoopLagHook/TestWorkerPoolQueueStats cover the ISSUE 6
-  # saturation instrumentation (loop-lag hook, dio queue histograms).
+  local flavor="$1" sanitize="$2" lockrank="${3:-OFF}"
+  local dir="native/build-$flavor"
+  echo "=== $flavor: configure + build (sanitize=$sanitize lockrank=$lockrank) ==="
+  build_tree "$dir" "$sanitize" "$lockrank"
+  echo "=== $flavor: native unit tests ==="
+  # common_test's TestTraceRingThreaded/TestEventLogThreaded hammer the
+  # lock-light rings from concurrent recorders + a dumping reader — the
+  # TSan run is the proof the design is data-race-free, not just lucky.
+  # TestRankedMutexThreaded does the same for the lock-rank checker's
+  # thread_local bookkeeping, and under the lockrank flavor the
+  # TestRankedMutexInversionAborts death tests prove a rank inversion
+  # (including a descending-stripe RefAll violation) aborts with both
+  # lock sites reported.
   "$dir/common_test"
   # storage_test's TestChunkStoreStripedConcurrency hammers the
   # digest-striped chunk store + hot-chunk read cache from concurrent
   # uploaders/deleters, cached readers, pin sessions, and a
-  # quarantine/GC sweeper — the TSan proof of the PR 5 lock sharding
-  # and cache-coherence invariants.
+  # quarantine/GC sweeper — under lockrank this also validates the
+  # ascending-stripe RefAll protocol at runtime.
   "$dir/storage_test"
-  echo "=== $san: daemon suite ==="
+  "$dir/tracker_test"
+  echo "=== $flavor: daemon suite ==="
   # halt_on_error keeps a failing daemon loud; leak detection stays on
   # for asan (daemons shut down cleanly in the harness).
-  # test_dedup_upload.py's concurrent-uploads-and-deletes test is the
-  # negotiated-upload session target: pin/ref races and the
-  # abort-timeout sweep run under TSan here.
-  # test_scrub.py's test_scrub_races_uploads_and_deletes is the
-  # integrity-engine target: scrub verify/quarantine/GC passes racing
-  # live uploads + eager deletes (the scrub thread vs dio workers on
-  # the chunk-store lock, and the pin-vs-GcSweep probe).
-  if [ "$san" = tsan ]; then
-    export TSAN_OPTIONS="halt_on_error=1"
-  else
-    export ASAN_OPTIONS="halt_on_error=1:detect_leaks=1"
-  fi
+  case "$sanitize" in
+    thread) export TSAN_OPTIONS="halt_on_error=1" ;;
+    address) export ASAN_OPTIONS="halt_on_error=1:detect_leaks=1" ;;
+    undefined) export UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1" ;;
+  esac
   FDFS_NATIVE_BUILD="$dir" python -m pytest "${PYTEST_ARGS[@]}" -x -q
 }
 
 case "$MODE" in
   asan) run_one asan address ;;
   tsan) run_one tsan thread ;;
+  ubsan) run_one ubsan undefined ;;
+  lockrank) run_one lockrank thread ON ;;
   both) run_one asan address && run_one tsan thread ;;
-  *) echo "usage: $0 [asan|tsan|both] [pytest args...]" >&2; exit 2 ;;
+  all) run_one asan address && run_one tsan thread \
+       && run_one ubsan undefined && run_one lockrank thread ON ;;
+  *) echo "usage: $0 [asan|tsan|ubsan|lockrank|all|both] [pytest args...]" >&2
+     exit 2 ;;
 esac
 echo "sanitizer suite: PASS ($MODE)"
