@@ -23,6 +23,16 @@ def _client(conf_or_addr: str) -> FdfsClient:
     return FdfsClient(conf_or_addr)
 
 
+def _flag(args: list[str], name: str, default: str | None = None):
+    """`--name value` lookup shared by the flag-taking subcommands; a
+    following token that is itself a flag does not count as a value."""
+    if name in args:
+        i = args.index(name)
+        if i + 1 < len(args) and not args[i + 1].startswith("--"):
+            return args[i + 1]
+    return default
+
+
 def cmd_upload(c: FdfsClient, args: list[str]) -> int:
     if not args:
         print("usage: upload <tracker> [--dedup] <local_file> [ext]",
@@ -144,30 +154,36 @@ def cmd_top(c: FdfsClient, args: list[str]) -> int:
                           scripts and tests use this)
            --group <name> limit the storage rows to one group
            --events N     events-pane depth (default 10)
+           --heat [N]     per-node hot-file pane (HEAT_TOP; top N rows,
+                          default 5)
            --json         one machine-readable JSON object per frame
                           instead of the table
            --no-clear     never emit the ANSI clear (append frames)
+
+    An ALERTS line appears whenever a node has active SLO breaches
+    (slo.breach events raise a rule, slo.recovered clears it; the
+    slo.breaches_active gauge backs the count for nodes whose breach
+    predates this fdfs_top's first frame).
     """
     import time as _time
 
     from fastdfs_tpu import monitor as M
 
     def flag(name, default=None):
-        if name in args:
-            i = args.index(name)
-            if i + 1 < len(args) and not args[i + 1].startswith("--"):
-                return args[i + 1]
-        return default
+        return _flag(args, name, default)
 
     interval = float(flag("--interval", "2"))
     count = int(flag("--count", "0"))
     group = flag("--group")
     max_events = int(flag("--events", "10"))
+    with_heat = "--heat" in args
+    heat_rows = int(flag("--heat", "5") or 5) if with_heat else 5
     as_json = "--json" in args
     clear = "--no-clear" not in args and not as_json and sys.stdout.isatty()
 
-    seen_seq: dict[str, int] = {}
+    seen_seq: dict[str, tuple[int, int]] = {}
     recent: list[M.ClusterEvent] = []
+    active_alerts: dict[str, set] = {}
     prev = None
     frames = 0
     try:
@@ -176,14 +192,52 @@ def cmd_top(c: FdfsClient, args: list[str]) -> int:
             rates = M.top_rates(prev, cur)
             recent.extend(sorted(cur.events, key=lambda e: e.ts_us))
             del recent[:-200]  # bounded scrollback
+            # Alert tracking: breach raises a rule on its node, recovery
+            # clears it (events are seq-deduped, so replays can't flap).
+            # Reconcile against the authoritative gauge BEFORE applying
+            # this frame's events: a daemon that restarted after a breach
+            # never emits slo.recovered (its evaluator state died with
+            # it), so a node whose live slo.breaches_active reads 0 has
+            # nothing red by definition.  Gauge-clear first, then events
+            # — a breach landing between the STAT and EVENT_DUMP calls
+            # still sticks.
+            for node, ns in cur.nodes.items():
+                if (ns.registry is not None and not
+                        ns.registry["gauges"].get("slo.breaches_active")):
+                    active_alerts.pop(node, None)
+            for e in sorted(cur.events, key=lambda ev: (ev.ts_us, ev.seq)):
+                if e.type == "slo.breach":
+                    active_alerts.setdefault(e.node, set()).add(e.key)
+                elif e.type == "slo.recovered":
+                    active_alerts.get(e.node, set()).discard(e.key)
+            alerts = {n: sorted(rules)
+                      for n, rules in active_alerts.items() if rules}
+            heat = None
+            if with_heat:
+                heat = {}
+                for node, ns in cur.nodes.items():
+                    if ns.role != "storage" or ns.registry is None:
+                        continue
+                    ip, _, port = ns.addr.rpartition(":")
+                    try:
+                        heat[node] = M.decode_heat(
+                            c.storage_heat_top(ip, int(port), heat_rows))
+                    except Exception:  # noqa: BLE001 — heat off / old node
+                        heat[node] = []
             if as_json:
                 print(json.dumps({
                     "ts": cur.ts,
                     "nodes": rates,
                     "events": [vars(e) for e in cur.events],
+                    "alerts": alerts,
+                    "heat": ({n: [vars(h) for h in hs]
+                              for n, hs in heat.items()}
+                             if heat is not None else None),
                 }, sort_keys=True), flush=True)
             else:
-                frame = M.render_top(cur, rates, recent, max_events)
+                frame = M.render_top(cur, rates, recent, max_events,
+                                     alerts=alerts, heat=heat,
+                                     heat_rows=heat_rows)
                 if clear:
                     print("\x1b[2J\x1b[H" + frame, flush=True)
                 else:
@@ -195,6 +249,68 @@ def cmd_top(c: FdfsClient, args: list[str]) -> int:
             _time.sleep(interval)
     except KeyboardInterrupt:
         return 0
+
+
+def cmd_report(c: FdfsClient, args: list[str]) -> int:
+    """fdfs_report: retrospective observability from the metrics
+    journals (METRICS_HISTORY) — per-node rate/latency time-series over
+    a window, the SLO breach timeline from the flight recorders, and
+    per-node hot-file tables (HEAT_TOP).  Works after a crash or
+    restart: the journal is on disk, so `--since <pre-crash>` replays
+    the telemetry that led into the failure.
+
+    Flags: --since <t>    window start: seconds-ago when < 10^7 (e.g.
+                          `--since 600` = the last 10 minutes), else an
+                          absolute unix-seconds stamp (as printed by
+                          `date +%s`).  Default: everything retained.
+           --group <name> limit to one group's storages
+           --rows N       intervals shown per node (default 12)
+           --heat-k N     heat rows requested/rendered (default 5)
+           --json         machine-readable dump instead of the tables
+    """
+    import time as _time
+
+    from fastdfs_tpu import monitor as M
+
+    def flag(name, default=None):
+        return _flag(args, name, default)
+
+    since_us = 0
+    raw_since = flag("--since")
+    if raw_since is not None:
+        v = float(raw_since)
+        if v <= 0:
+            print("--since must be positive", file=sys.stderr)
+            return 2
+        epoch_s = _time.time() - v if v < 1e7 else v
+        since_us = int(epoch_s * 1e6)
+    group = flag("--group")
+    rows = int(flag("--rows", "12"))
+    heat_k = int(flag("--heat-k", "5"))
+
+    data = M.gather_report(c, since_us=since_us, group=group, heat_k=heat_k)
+    if not data.history and data.errors:
+        # Nothing reachable carried a journal: that is a failure, not an
+        # empty report.
+        for node, err in sorted(data.errors.items()):
+            print(f"{node}  error: {err}", file=sys.stderr)
+        return 1
+    if "--json" in args:
+        print(json.dumps({
+            "since_us": data.since_us,
+            "series": {n: M.report_series(h)
+                       for n, h in data.history.items()},
+            "snapshots": {n: len(h) for n, h in data.history.items()},
+            "breaches": [vars(e) for e in
+                         M.breach_timeline(data.events, data.since_us,
+                                           data.history)],
+            "heat": {n: [vars(h) for h in hs]
+                     for n, hs in data.heat.items()},
+            "errors": data.errors,
+        }, sort_keys=True))
+    else:
+        print(M.render_report(data, max_rows=rows, heat_rows=heat_k))
+    return 0 if not data.errors else 1
 
 
 def cmd_test(c: FdfsClient, args: list[str]) -> int:
@@ -309,11 +425,7 @@ def cmd_trace(c: FdfsClient, args: list[str]) -> int:
     from fastdfs_tpu import trace as T
 
     def flag(name, default=None):
-        if name in args:
-            i = args.index(name)
-            if i + 1 < len(args):
-                return args[i + 1]
-        return default
+        return _flag(args, name, default)
 
     trace_id = None
     cleanup_fid = None
@@ -456,6 +568,7 @@ TOOLS = {
     "file_info": cmd_file_info,
     "monitor": cmd_monitor,
     "top": cmd_top,
+    "report": cmd_report,
     "test": cmd_test,
     "groups_json": cmd_groups_json,
     "append": cmd_append,

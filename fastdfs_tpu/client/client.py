@@ -438,6 +438,17 @@ class FdfsClient:
         with self._storage(FetchTarget(ip=ip, port=port)) as s:
             return s.event_dump()
 
+    def storage_metrics_history(self, ip: str, port: int,
+                                since_us: int = 0) -> dict:
+        """One storage daemon's metrics-journal window (METRICS_HISTORY)."""
+        with self._storage(FetchTarget(ip=ip, port=port)) as s:
+            return s.metrics_history(since_us)
+
+    def storage_heat_top(self, ip: str, port: int, k: int = 0) -> dict:
+        """One storage daemon's hot-file top-K (HEAT_TOP)."""
+        with self._storage(FetchTarget(ip=ip, port=port)) as s:
+            return s.heat_top(k)
+
     def scrub_status(self, ip: str, port: int) -> dict[str, int]:
         """One storage daemon's integrity-engine status (SCRUB_STATUS)."""
         with self._storage(FetchTarget(ip=ip, port=port)) as s:

@@ -462,6 +462,27 @@ class StorageClient:
         self.conn.send_request(StorageCmd.EVENT_DUMP)
         return json.loads(self.conn.recv_response("event_dump") or b"{}")
 
+    def metrics_history(self, since_us: int = 0) -> dict:
+        """Metrics-journal window dump (METRICS_HISTORY 138): every
+        retained registry snapshot with ts_us >= ``since_us`` (0 = the
+        whole ring — including snapshots from BEFORE the daemon's last
+        restart, which is the point).  Shape per
+        fastdfs_tpu.monitor.decode_metrics_history; StatusError(95)
+        when journaling is off (metrics_journal_mb = 0)."""
+        body = long2buff(since_us) if since_us else b""
+        self.conn.send_request(StorageCmd.METRICS_HISTORY, body)
+        return json.loads(self.conn.recv_response("metrics_history") or b"{}")
+
+    def heat_top(self, k: int = 0) -> dict:
+        """Hot-file top-K dump (HEAT_TOP 139): the daemon's
+        space-saving sketch ranked by request count, with per-op
+        request/byte splits.  k=0 uses the daemon's heat_top_k.  Shape
+        per fastdfs_tpu.monitor.decode_heat; StatusError(95) when the
+        sketch is off (heat_top_k = 0)."""
+        body = long2buff(k) if k else b""
+        self.conn.send_request(StorageCmd.HEAT_TOP, body)
+        return json.loads(self.conn.recv_response("heat_top") or b"{}")
+
     def scrub_status(self) -> dict[str, int]:
         """Integrity-engine status (SCRUB_STATUS 134): named scrub/GC
         counters decoded from the fixed int64 blob (SCRUB_STAT_FIELDS).

@@ -214,6 +214,17 @@ class TrackerClient:
         self.conn.send_request(TrackerCmd.EVENT_DUMP)
         return json.loads(self.conn.recv_response("event_dump") or b"{}")
 
+    def metrics_history(self, since_us: int = 0) -> dict:
+        """Metrics-journal window dump (METRICS_HISTORY 99): the
+        tracker's retained registry snapshots with ts_us >= since_us
+        (0 = all).  Shape per
+        fastdfs_tpu.monitor.decode_metrics_history; StatusError(95)
+        when journaling is off."""
+        from fastdfs_tpu.common.protocol import long2buff
+        body = long2buff(since_us) if since_us else b""
+        self.conn.send_request(TrackerCmd.METRICS_HISTORY, body)
+        return json.loads(self.conn.recv_response("metrics_history") or b"{}")
+
     def get_tracker_status(self) -> dict:
         """Multi-tracker relationship probe (TRACKER_GET_STATUS 70):
         whether this tracker is the leader and who it believes leads."""

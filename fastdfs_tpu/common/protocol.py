@@ -214,6 +214,15 @@ class TrackerCmd(enum.IntEnum):
     # fastdfs_tpu.monitor.decode_events, pinned by the fdfs_codec
     # event-json cross-language golden).
     EVENT_DUMP = 98
+    # fastdfs_tpu extension: metrics-journal window dump (the tracker's
+    # durable telemetry history; native/common/metrog.h).  Body = empty
+    # or 8B BE since-ts (epoch µs; 0 = everything retained) -> JSON
+    # {"role","port","snapshots":[{"ts_us",counters,gauges,histograms}]}
+    # per fastdfs_tpu.monitor.decode_metrics_history; pinned by the
+    # fdfs_codec metrics-history cross-language golden.  ENOTSUP when
+    # journaling is off (metrics_journal_mb = 0).  Same contract as
+    # StorageCmd.METRICS_HISTORY.
+    METRICS_HISTORY = 99
 
     # client -> tracker (service queries; reference: tracker_deal_service_query_*)
     SERVICE_QUERY_STORE_WITHOUT_GROUP_ONE = 101
@@ -393,6 +402,31 @@ class StorageCmd(enum.IntEnum):
     # event-json cross-language golden.  Same contract as
     # TrackerCmd.EVENT_DUMP.
     EVENT_DUMP = 137
+    # Metrics-journal window dump (fastdfs_tpu extension; see
+    # native/common/metrog.h): every daemon appends a delta-encoded,
+    # CRC-framed snapshot of its stats registry to a size-capped on-disk
+    # ring each SLO tick, so rate/quantile time-series survive a crash
+    # or restart.  Body = empty or 8B BE since-ts (epoch µs; 0 = all
+    # retained history) -> JSON {"role","port","snapshots":[{"ts_us",
+    # "counters","gauges","histograms"}]} — each snapshot is the full
+    # absolute registry view (the on-disk delta encoding is a storage
+    # detail, never on the wire).  Shape per
+    # fastdfs_tpu.monitor.decode_metrics_history; pinned by the
+    # fdfs_codec metrics-history cross-language golden.  ENOTSUP when
+    # journaling is off (metrics_journal_mb = 0).
+    METRICS_HISTORY = 138
+    # Hot-key heat telemetry (fastdfs_tpu extension; see
+    # native/common/heatsketch.h): a lock-striped space-saving top-K
+    # sketch fed from the request-accounting choke point, keyed by
+    # file-id for DOWNLOAD_FILE / uploads / FETCH_CHUNK, with per-op
+    # request and byte counts.  Body = empty or 8B BE k (0 = the
+    # daemon's heat_top_k default) -> JSON {"role","port","k","tracked",
+    # "touches","entries":[{"key","hits","err_bound","bytes","ops":
+    # {"download":{"count","bytes"},...}}]} sorted by hits descending.
+    # Shape per fastdfs_tpu.monitor.decode_heat; pinned by the
+    # fdfs_codec heat-top cross-language golden.  ENOTSUP when the
+    # sketch is off (heat_top_k = 0).
+    HEAT_TOP = 139
     # Trace-context prefix frame (same value as TrackerCmd.TRACE_CTX).
     TRACE_CTX = 140
     # Ranked near-dup report for a stored file, answered from the
@@ -432,10 +466,13 @@ WIRE_GOLDENS = {
     "TrackerCmd.TRACE_DUMP": "trace-json",
     "TrackerCmd.STAT": "stats-json",
     "TrackerCmd.EVENT_DUMP": "event-json",
+    "TrackerCmd.METRICS_HISTORY": "metrics-history",
     "TrackerCmd.TRACE_CTX": "trace-ctx",
     "StorageCmd.STAT": "stats-json",
     "StorageCmd.TRACE_DUMP": "trace-json",
     "StorageCmd.EVENT_DUMP": "event-json",
+    "StorageCmd.METRICS_HISTORY": "metrics-history",
+    "StorageCmd.HEAT_TOP": "heat-top",
     "StorageCmd.TRACE_CTX": "trace-ctx",
     "StorageCmd.SCRUB_STATUS": "scrub-status",
     "StorageCmd.UPLOAD_RECIPE": "ingest-wire",

@@ -155,6 +155,146 @@ def decode_events(obj: dict, node: str = "") -> list[ClusterEvent]:
 
 
 # ---------------------------------------------------------------------------
+# metrics-history decoding (METRICS_HISTORY; native/common/metrog.h)
+# ---------------------------------------------------------------------------
+
+def decode_metrics_history(obj: dict) -> list[dict]:
+    """Validate and decode one daemon's METRICS_HISTORY JSON into
+    ``[{"ts_us": int, "registry": <decode_registry shape>}, ...]``
+    (oldest first — the wire order).
+
+    Each snapshot is a full absolute registry view (the journal's
+    on-disk delta encoding never reaches the wire), so every snapshot
+    revalidates through decode_registry and the fdfs_top histogram math
+    applies between consecutive entries unchanged."""
+    if not isinstance(obj, dict) or not isinstance(obj.get("snapshots"), list):
+        raise ValueError(f"metrics history must have a snapshots list: "
+                         f"{type(obj)}")
+    out: list[dict] = []
+    for s in obj["snapshots"]:
+        if not isinstance(s, dict) or not isinstance(s.get("ts_us"), int):
+            raise ValueError(f"malformed snapshot: {s!r}")
+        out.append({"ts_us": s["ts_us"], "registry": decode_registry(s)})
+    # Wire order is journal APPEND order, which is causally correct even
+    # when the daemon's wall clock stepped backwards between ticks (NTP):
+    # keep it, don't sort, and don't reject — one odd ts pair must not
+    # cost the whole post-mortem window (report_series floors dt anyway).
+    return out
+
+
+# ---------------------------------------------------------------------------
+# heat decoding (HEAT_TOP; native/common/heatsketch.h)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class HeatEntry:
+    """One hot file-id from a daemon's space-saving sketch.  ``hits`` is
+    an overcount bounded by ``err_bound``: the true request count lies
+    in [hits - err_bound, hits]."""
+    key: str
+    hits: int
+    err_bound: int
+    bytes: int
+    err: int
+    ops: dict  # op name -> {"count": int, "bytes": int}
+
+
+def decode_heat(obj: dict) -> list[HeatEntry]:
+    """Validate and decode one daemon's HEAT_TOP JSON (entries arrive
+    sorted by hits descending; unknown extra keys are ignored — the
+    wire contract is append-only)."""
+    if not isinstance(obj, dict) or not isinstance(obj.get("entries"), list):
+        raise ValueError(f"heat dump must have an entries list: {obj!r}")
+    out: list[HeatEntry] = []
+    for e in obj["entries"]:
+        try:
+            ops = {}
+            for op, c in dict(e.get("ops", {})).items():
+                ops[str(op)] = {"count": int(c["count"]),
+                                "bytes": int(c["bytes"])}
+            out.append(HeatEntry(
+                key=str(e["key"]), hits=int(e["hits"]),
+                err_bound=int(e.get("err_bound", 0)),
+                bytes=int(e.get("bytes", 0)), err=int(e.get("err", 0)),
+                ops=ops))
+        except (KeyError, TypeError, ValueError) as err:
+            raise ValueError(f"malformed heat entry {e!r}: {err}") from None
+    if any(a.hits < b.hits for a, b in zip(out, out[1:])):
+        raise ValueError("heat entries not sorted by hits descending")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SLO rule table (mirror of native/common/sloeval.cc; the fdfs_codec
+# slo-conf golden pins the two parsers against each other)
+# ---------------------------------------------------------------------------
+
+# (name, threshold, clear) — breach when EWMA(reading) > threshold,
+# recover when EWMA <= clear.  Must stay field-identical to
+# SloEvaluator::DefaultRules().
+DEFAULT_SLO_RULES = (
+    ("error_rate_pct", 5.0, 2.5),
+    ("request_p99_ms", 1000.0, 500.0),
+    ("loop_lag_p99_ms", 250.0, 125.0),
+    ("dio_wait_p99_ms", 500.0, 250.0),
+    ("sync_lag_s", 300.0, 150.0),
+    ("scrub_unrepairable", 0.5, 0.25),
+    ("disk_fill_pct", 90.0, 85.0),
+)
+
+_SLO_TRUE = {"1", "yes", "true", "on"}
+
+
+def parse_slo_rules(text: str) -> list[tuple[str, float, float, bool]]:
+    """conf/slo.conf -> [(name, threshold, clear, enabled)], applying
+    ``<rule>_threshold`` / ``<rule>_clear`` / ``<rule>_enabled``
+    overrides onto DEFAULT_SLO_RULES exactly like the C++ loader
+    (including the proportional clear rescale when only the threshold
+    is overridden)."""
+    kv: dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, sep, value = line.partition("=")
+        if sep:
+            kv[key.strip()] = value.strip()
+
+    def fget(key: str) -> float | None:
+        # strtod semantics, like the C++ loader: parse the longest
+        # numeric PREFIX and ignore trailing garbage ("70%" -> 70.0,
+        # "300s" -> 300.0) — float() would reject those and silently
+        # report the compiled-in default for a threshold the daemon is
+        # actually enforcing.
+        m = re.match(r"\s*[-+]?(\d+\.?\d*|\.\d+)([eE][-+]?\d+)?",
+                     kv.get(key, ""))
+        return float(m.group(0)) if m else None
+
+    out = []
+    for name, dflt_threshold, dflt_clear in DEFAULT_SLO_RULES:
+        threshold = fget(f"{name}_threshold")
+        clear = fget(f"{name}_clear")
+        if threshold is None:
+            threshold = dflt_threshold
+            if clear is None:
+                clear = dflt_clear
+        elif clear is None:
+            clear = (threshold * (dflt_clear / dflt_threshold)
+                     if dflt_threshold > 0 else dflt_clear)
+        if clear > threshold:
+            clear = threshold
+        flag = kv.get(f"{name}_enabled", "").lower()
+        if flag in _SLO_TRUE:
+            enabled = True
+        elif flag in {"0", "no", "false", "off"}:
+            enabled = False
+        else:
+            enabled = True  # absent or unparseable: the C++ loader's default
+        out.append((name, threshold, clear, enabled))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # histogram delta quantiles (the fdfs_top math)
 # ---------------------------------------------------------------------------
 
@@ -162,33 +302,44 @@ def hist_delta(prev: dict | None, cur: dict) -> dict:
     """Bucket-wise delta of two registry histogram snapshots of the same
     metric — the distribution of observations BETWEEN the two polls.
     prev=None (first poll, or the daemon restarted and counts went
-    backwards) returns cur unchanged."""
+    backwards) returns cur unchanged.  Per-bucket deltas are CLAMPED at
+    0: a restart the total-count guard cannot see (more new
+    observations than the old lifetime had) must never render negative
+    bucket mass."""
     if (prev is None or prev.get("bounds") != cur.get("bounds")
             or prev.get("count", 0) > cur.get("count", 0)):
         return cur
+    counts = [max(c - p, 0) for p, c in zip(prev["counts"], cur["counts"])]
     return {
         "bounds": cur["bounds"],
-        "counts": [c - p for p, c in zip(prev["counts"], cur["counts"])],
-        "sum": cur["sum"] - prev["sum"],
-        "count": cur["count"] - prev["count"],
+        "counts": counts,
+        "sum": max(cur["sum"] - prev["sum"], 0),
+        "count": sum(counts),
     }
 
 
 def hist_quantile(h: dict, q: float) -> float | None:
     """Upper-bound estimate of quantile ``q`` from a (delta) histogram:
     the inclusive upper bound of the bucket the quantile falls in.
-    None when the histogram saw no observations; +inf when it falls in
-    the overflow bucket (beyond the last bound)."""
+
+    None — rendered as ``-`` — whenever no finite estimate exists: the
+    histogram saw no observations, carries no buckets at all, or the
+    quantile lands in the overflow bucket (all that is known there is
+    "beyond the last bound"; an inf-ish number formatted into a latency
+    column misleads more than it informs)."""
+    bounds, counts = h.get("bounds"), h.get("counts")
+    if not bounds or not counts:
+        return None
     total = h.get("count", 0)
     if total <= 0:
         return None
     rank = q * total
     seen = 0
-    for bound, cnt in zip(h["bounds"], h["counts"]):
+    for bound, cnt in zip(bounds, counts):
         seen += cnt
         if seen >= rank:
             return float(bound)
-    return float("inf")
+    return None  # overflow bucket: no finite upper bound exists
 
 
 # ---------------------------------------------------------------------------
@@ -213,12 +364,16 @@ class TopSample:
 
 
 def gather_top(client, group: str | None = None,
-               seen_seq: dict[str, int] | None = None) -> TopSample:
+               seen_seq: dict[str, tuple[int, int]] | None = None
+               ) -> TopSample:
     """Poll STAT + EVENT_DUMP across the cluster (trackers from the
     client's config, storages from SERVER_CLUSTER_STAT).  Best-effort
     like gather(): a dead node becomes a row with an error, never an
-    exception.  ``seen_seq`` (mutated in place) maps node -> last event
-    seq already consumed, so only NEW events land in the sample."""
+    exception.  ``seen_seq`` (mutated in place) maps node -> (seq,
+    ts_us) of the newest event already consumed, so only NEW events
+    land in the sample — with the ts doubling as an incarnation check
+    (a restarted daemon's ring reuses low seqs with different
+    timestamps)."""
     from fastdfs_tpu.client.storage_client import StorageClient
     from fastdfs_tpu.client.tracker_client import TrackerClient
 
@@ -228,10 +383,24 @@ def gather_top(client, group: str | None = None,
 
     def take_events(node: str, dump: dict) -> None:
         evs = decode_events(dump, node)
-        last = seen_seq.get(node, 0)
+        last, last_ts = seen_seq.get(node, (0, 0))
+        top = max((e.seq for e in evs), default=0)
+        # Restart detection: the ring (1-based process-monotonic seq)
+        # dies with the process, so after a restart everything in the
+        # dump is new and must NOT be filtered against the dead
+        # incarnation's high-water.  Two tells: the top seq regressed,
+        # or the event still sitting at our high-water seq carries a
+        # different timestamp than the one we consumed there.
+        restarted = bool(top) and top < last
+        if not restarted and last and last_ts:
+            marker = next((e for e in evs if e.seq == last), None)
+            restarted = marker is not None and marker.ts_us != last_ts
+        if restarted:
+            last = 0
         fresh = [e for e in evs if e.seq > last]
-        if evs:
-            seen_seq[node] = max(e.seq for e in evs)
+        if top:
+            newest = max(evs, key=lambda e: e.seq)
+            seen_seq[node] = (newest.seq, newest.ts_us)
         out.events.extend(fresh)
 
     storages: list[tuple[str, int]] = []
@@ -321,8 +490,15 @@ def top_rates(prev: TopSample | None, cur: TopSample) -> dict[str, dict]:
         dio = reg["histograms"].get("dio.queue_wait_us")
         plag = preg["histograms"].get("nio.loop_lag_us") if preg else None
         pdio = preg["histograms"].get("dio.queue_wait_us") if preg else None
+        # Counter reset = daemon restart between polls.  Every delta is
+        # clamped at 0 (crate/hist_delta do that), and the row carries an
+        # explicit flag so the operator sees WHY its rates read zero —
+        # a silently-zero row after a crash looks like "idle", which is
+        # the opposite of the truth.
+        restarted = preg is not None and (ops < pops or errs < perrs)
         out[node] = {
             "role": s.role,
+            "restarted": restarted,
             "ops_s": round(crate(ops, pops), 1),
             "err_s": round(crate(errs, perrs), 1),
             "in_mb_s": round(crate(up, pup) / 1e6, 2),
@@ -335,6 +511,7 @@ def top_rates(prev: TopSample | None, cur: TopSample) -> dict[str, dict]:
                                 if dio else None),
             "dio_depth": reg["gauges"].get("dio.queue_depth"),
             "conns": reg["gauges"].get("nio.conns_active", 0),
+            "slo_breaches": reg["gauges"].get("slo.breaches_active", 0),
         }
     return out
 
@@ -342,8 +519,6 @@ def top_rates(prev: TopSample | None, cur: TopSample) -> dict[str, dict]:
 def _fmt_us(v: float | None) -> str:
     if v is None:
         return "-"
-    if v == float("inf"):
-        return ">10s"
     if v >= 1e6:
         return f"{v / 1e6:.1f}s"
     if v >= 1000:
@@ -353,10 +528,14 @@ def _fmt_us(v: float | None) -> str:
 
 def render_top(cur: TopSample, rates: dict[str, dict],
                recent_events: list[ClusterEvent],
-               max_events: int = 10) -> str:
-    """The fdfs_top frame: a per-node saturation table + the scrolling
-    recent-events pane.  Pure string building so tests (and --json
-    consumers) can drive it headless."""
+               max_events: int = 10,
+               alerts: dict[str, list[str]] | None = None,
+               heat: dict[str, list["HeatEntry"]] | None = None,
+               heat_rows: int = 5) -> str:
+    """The fdfs_top frame: a per-node saturation table, an ALERTS line
+    (active SLO breaches per node), the scrolling recent-events pane,
+    and — with ``heat`` — a per-node hot-file pane.  Pure string
+    building so tests (and --json consumers) can drive it headless."""
     cols = (f"{'node':<32} {'ops/s':>8} {'err/s':>6} {'in MB/s':>8} "
             f"{'out MB/s':>8} {'hit%':>6} {'loop p99':>9} {'dio p99':>9} "
             f"{'depth':>5} {'conns':>5}")
@@ -368,10 +547,31 @@ def render_top(cur: TopSample, rates: dict[str, dict],
             continue
         hit = "-" if r["cache_hit_pct"] is None else f"{r['cache_hit_pct']}"
         depth = "-" if r["dio_depth"] is None else str(r["dio_depth"])
+        # A restarted daemon's rates read 0 by clamping; say why.
+        mark = "  RESTARTED" if r.get("restarted") else ""
         lines.append(
             f"{node:<32} {r['ops_s']:>8} {r['err_s']:>6} {r['in_mb_s']:>8} "
             f"{r['out_mb_s']:>8} {hit:>6} {_fmt_us(r['loop_p99_us']):>9} "
-            f"{_fmt_us(r['dio_wait_p99_us']):>9} {depth:>5} {r['conns']:>5}")
+            f"{_fmt_us(r['dio_wait_p99_us']):>9} {depth:>5} {r['conns']:>5}"
+            f"{mark}")
+    # ALERTS line: one glance answers "is anything red right now".
+    # Event-tracked alerts name their rules; nodes whose breach predates
+    # this fdfs_top (no slo.breach event seen, only the gauge) fall back
+    # to a count — summed over the NOT-already-named nodes only, so a
+    # live alert on one node cannot hide or double-count another's.
+    active = [(node, rules) for node, rules in sorted((alerts or {}).items())
+              if rules]
+    named = {node for node, _ in active}
+    breach_gauges = sum(r.get("slo_breaches") or 0
+                        for node, r in rates.items()
+                        if "role" in r and node not in named)
+    parts = [f"{node}: {','.join(rules)}" for node, rules in active]
+    if breach_gauges:
+        parts.append(f"{breach_gauges} pre-existing breach(es) "
+                     "(details in events pane)")
+    if parts:
+        lines.append("")
+        lines.append("ALERTS: " + "; ".join(parts))
     lines.append("")
     lines.append(f"recent events (last {max_events}):")
     for e in recent_events[-max_events:]:
@@ -380,7 +580,32 @@ def render_top(cur: TopSample, rates: dict[str, dict],
                      f"{e.type} {e.key} {e.detail}".rstrip())
     if not recent_events:
         lines.append("  (none)")
+    if heat is not None:
+        lines.append("")
+        lines.append(f"hot files (top {heat_rows} per node, "
+                     "hits / err-bound / MB / ops):")
+        lines.extend(_heat_table_lines(heat, heat_rows))
     return "\n".join(lines)
+
+
+def _heat_table_lines(heat: dict[str, list["HeatEntry"]],
+                      heat_rows: int) -> list[str]:
+    """Shared per-node hot-file table body — fdfs_top's --heat pane and
+    fdfs_report's heat section must render the same HeatEntry data
+    identically."""
+    lines: list[str] = []
+    for node in sorted(heat):
+        lines.append(f"  {node}:")
+        entries = heat[node][:heat_rows]
+        if not entries:
+            lines.append("    (none)")
+        for he in entries:
+            ops = " ".join(f"{op}={c['count']}"
+                           for op, c in sorted(he.ops.items())
+                           if c["count"] > 0)
+            lines.append(f"    {he.hits:>8} ±{he.err_bound:<6} "
+                         f"{he.bytes / 1e6:>8.1f}MB  {he.key}  [{ops}]")
+    return lines
 
 
 # ---------------------------------------------------------------------------
@@ -546,3 +771,226 @@ def to_prometheus(snap: ClusterSnapshot, prefix: str = "fdfs") -> str:
 _BEAT_GAUGES = frozenset({
     "last_source_update", "connections", "sync_lag_s",
 })
+
+
+# ---------------------------------------------------------------------------
+# fdfs_report: retrospective time-series from the metrics journal +
+# breach timeline + heat tables (cli.py report)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ReportData:
+    """Everything one fdfs_report run gathered, per node."""
+    since_us: int = 0
+    # node -> [{"ts_us", "registry"}, ...] (decode_metrics_history shape)
+    history: dict[str, list[dict]] = field(default_factory=dict)
+    # node -> [ClusterEvent, ...] (full EVENT_DUMP, slo.* filtered later)
+    events: dict[str, list[ClusterEvent]] = field(default_factory=dict)
+    # node -> [HeatEntry, ...] (storages only)
+    heat: dict[str, list[HeatEntry]] = field(default_factory=dict)
+    errors: dict[str, str] = field(default_factory=dict)
+
+
+def gather_report(client, since_us: int = 0, group: str | None = None,
+                  heat_k: int = 0) -> ReportData:
+    """Poll METRICS_HISTORY + EVENT_DUMP (+ HEAT_TOP on storages) across
+    the cluster.  Best-effort per node (a dead or journal-less node
+    becomes an errors entry), so a post-mortem of a half-up cluster
+    still reports everything reachable."""
+    from fastdfs_tpu.client.conn import StatusError
+    from fastdfs_tpu.client.storage_client import StorageClient
+    from fastdfs_tpu.client.tracker_client import TrackerClient
+
+    out = ReportData(since_us=since_us)
+    storages: list[tuple[str, int]] = []
+    for host, port in client.trackers:
+        node = f"tracker {host}:{port}"
+        try:
+            with TrackerClient(host, port, client.timeout) as tc:
+                if not storages:
+                    cs = tc.cluster_stat(group)
+                    for g in cs.get("groups", []):
+                        for s in g.get("storages", []):
+                            storages.append((s["ip"], s["port"]))
+                out.history[node] = decode_metrics_history(
+                    tc.metrics_history(since_us))
+                out.events[node] = decode_events(tc.event_dump(), node)
+        except StatusError as e:
+            out.errors[node] = ("no metrics journal (ENOTSUP)"
+                                if e.status == 95 else str(e))
+        except Exception as e:  # noqa: BLE001 — a dead node is a row
+            out.errors[node] = f"{type(e).__name__}: {e}"
+    for ip, port in sorted(set(storages)):
+        node = f"storage {ip}:{port}"
+        try:
+            with StorageClient(ip, port, client.timeout) as sc:
+                out.history[node] = decode_metrics_history(
+                    sc.metrics_history(since_us))
+                out.events[node] = decode_events(sc.event_dump(), node)
+                try:
+                    out.heat[node] = decode_heat(sc.heat_top(heat_k))
+                except StatusError as e:
+                    if e.status != 95:  # heat off is fine, else surface
+                        raise
+        except StatusError as e:
+            out.errors[node] = ("no metrics journal (ENOTSUP)"
+                                if e.status == 95 else str(e))
+        except Exception as e:  # noqa: BLE001
+            out.errors[node] = f"{type(e).__name__}: {e}"
+    return out
+
+
+_OP_LATENCY_RE = re.compile(r"op\.\w+\.latency_us")
+
+
+def report_series(history: list[dict]) -> list[dict]:
+    """Per-interval derived rows from one node's journal window: for
+    each consecutive snapshot pair, the interval's ops/s, err/s, MB/s
+    in/out, request p99, loop-lag p99 and dio-wait p99 (same delta math
+    as fdfs_top, applied retrospectively).  Counter resets inside the
+    window (the daemon restarted mid-journal) clamp to zero-rate rows
+    flagged ``restarted`` rather than rendering garbage."""
+    rows: list[dict] = []
+    for prev, cur in zip(history, history[1:]):
+        preg, reg = prev["registry"], cur["registry"]
+        dt = max((cur["ts_us"] - prev["ts_us"]) / 1e6, 1e-3)
+
+        ops = _counter_sum(reg, _OP_COUNT_RE) + reg["counters"].get(
+            "server.requests", 0)
+        pops = _counter_sum(preg, _OP_COUNT_RE) + preg["counters"].get(
+            "server.requests", 0)
+        errs = _counter_sum(reg, _OP_ERROR_RE) + reg["counters"].get(
+            "server.errors", 0)
+        perrs = _counter_sum(preg, _OP_ERROR_RE) + preg["counters"].get(
+            "server.errors", 0)
+        up = reg["gauges"].get("store.bytes_uploaded", 0)
+        pup = preg["gauges"].get("store.bytes_uploaded", 0)
+        down = reg["gauges"].get("store.bytes_downloaded", 0)
+        pdown = preg["gauges"].get("store.bytes_downloaded", 0)
+        restarted = ops < pops or errs < perrs
+
+        def rate(c, p):
+            return 0.0 if restarted or c < p else (c - p) / dt
+
+        # Merged per-op latency delta (all op histograms share bounds).
+        merged = None
+        for name, h in reg["histograms"].items():
+            if not (_OP_LATENCY_RE.fullmatch(name)
+                    or name == "server.request_us"):
+                continue
+            d = hist_delta(preg["histograms"].get(name), h)
+            if merged is None:
+                merged = {"bounds": list(d["bounds"]),
+                          "counts": list(d["counts"]),
+                          "sum": d["sum"], "count": d["count"]}
+            elif merged["bounds"] == d["bounds"]:
+                merged["counts"] = [a + b for a, b in
+                                    zip(merged["counts"], d["counts"])]
+                merged["sum"] += d["sum"]
+                merged["count"] += d["count"]
+
+        def p99(name):
+            h = reg["histograms"].get(name)
+            if h is None:
+                return None
+            return hist_quantile(
+                hist_delta(preg["histograms"].get(name), h), 0.99)
+
+        rows.append({
+            "ts_us": cur["ts_us"],
+            "dt_s": round(dt, 3),
+            "restarted": restarted,
+            "ops_s": round(rate(ops, pops), 1),
+            "err_s": round(rate(errs, perrs), 1),
+            "in_mb_s": round(rate(up, pup) / 1e6, 2),
+            "out_mb_s": round(rate(down, pdown) / 1e6, 2),
+            "req_p99_us": (hist_quantile(merged, 0.99)
+                           if merged is not None else None),
+            "loop_p99_us": p99("nio.loop_lag_us"),
+            "dio_wait_p99_us": p99("dio.queue_wait_us"),
+            "slo_breaches": reg["gauges"].get("slo.breaches_active", 0),
+        })
+    return rows
+
+
+def breach_timeline(events: dict[str, list[ClusterEvent]],
+                    since_us: int = 0,
+                    history: dict[str, list[dict]] | None = None
+                    ) -> list[ClusterEvent]:
+    """Every slo.breach / slo.recovered event across the cluster, time
+    ordered — the report's alert timeline.
+
+    The flight-recorder ring is RAM: a kill -9 takes its events with
+    it.  The journal survives, and it carries the slo.breaches_active
+    gauge per tick — so for any window OLDER than a node's oldest live
+    event (crash, restart, or ring wrap), breach/recovery transitions
+    are reconstructed from consecutive journal snapshots and appear as
+    synthesized entries (key ``breaches_active``, detail
+    ``source=journal``).  Live ring events always win inside their own
+    coverage window — they carry the rule name and readings."""
+    out = [e for evs in events.values() for e in evs
+           if e.type in ("slo.breach", "slo.recovered")
+           and e.ts_us >= since_us]
+    for node, hist in (history or {}).items():
+        live = events.get(node, [])
+        ring_start = min((e.ts_us for e in live), default=float("inf"))
+        for prev, cur in zip(hist, hist[1:]):
+            if cur["ts_us"] >= ring_start:
+                break  # the live ring covers it from here on
+            was = prev["registry"]["gauges"].get("slo.breaches_active", 0)
+            now = cur["registry"]["gauges"].get("slo.breaches_active", 0)
+            if now == was or cur["ts_us"] < since_us:
+                continue
+            out.append(ClusterEvent(
+                seq=0, ts_us=cur["ts_us"],
+                severity="error" if now > was else "info",
+                type="slo.breach" if now > was else "slo.recovered",
+                key="breaches_active",
+                detail=f"source=journal active={now}", node=node))
+    return sorted(out, key=lambda e: (e.ts_us, e.node, e.seq))
+
+
+def render_report(data: ReportData, max_rows: int = 12,
+                  heat_rows: int = 5) -> str:
+    """The fdfs_report text: per-node rate/latency time-series over the
+    journal window (last ``max_rows`` intervals), the SLO breach
+    timeline, and the per-node heat tables."""
+    lines: list[str] = []
+    for node in sorted(data.history):
+        rows = report_series(data.history[node])
+        lines.append(f"== {node}  ({len(data.history[node])} snapshots, "
+                     f"{len(rows)} intervals)")
+        if not rows:
+            lines.append("   (not enough history for rates)")
+            continue
+        cols = (f"   {'time':<8} {'ops/s':>8} {'err/s':>6} {'in MB/s':>8} "
+                f"{'out MB/s':>8} {'req p99':>9} {'loop p99':>9} "
+                f"{'dio p99':>9} {'slo':>4}")
+        lines.append(cols)
+        for r in rows[-max_rows:]:
+            ts = time.strftime("%H:%M:%S", time.localtime(r["ts_us"] / 1e6))
+            mark = " RESTARTED" if r["restarted"] else ""
+            lines.append(
+                f"   {ts:<8} {r['ops_s']:>8} {r['err_s']:>6} "
+                f"{r['in_mb_s']:>8} {r['out_mb_s']:>8} "
+                f"{_fmt_us(r['req_p99_us']):>9} "
+                f"{_fmt_us(r['loop_p99_us']):>9} "
+                f"{_fmt_us(r['dio_wait_p99_us']):>9} "
+                f"{r['slo_breaches']:>4}{mark}")
+    lines.append("")
+    lines.append("SLO breach timeline:")
+    timeline = breach_timeline(data.events, data.since_us, data.history)
+    if not timeline:
+        lines.append("  (no breaches in the window)")
+    for e in timeline:
+        ts = time.strftime("%H:%M:%S", time.localtime(e.ts_us / 1e6))
+        lines.append(f"  {ts} {e.severity.upper():<5} [{e.node}] "
+                     f"{e.type} {e.key} {e.detail}".rstrip())
+    if data.heat:
+        lines.append("")
+        lines.append(f"hot files (top {heat_rows} per node, "
+                     "hits / err-bound / MB / ops):")
+        lines.extend(_heat_table_lines(data.heat, heat_rows))
+    for node, err in sorted(data.errors.items()):
+        lines.append(f"{node}  error: {err}")
+    return "\n".join(lines)

@@ -258,6 +258,28 @@ std::string BytesToHex(const uint8_t* data, size_t len) {
 
 std::string Sha1Digest::Hex() const { return BytesToHex(bytes, 20); }
 
+void AppendJsonString(std::string* out, std::string_view s) {
+  out->push_back('"');
+  for (char ch : s) {
+    switch (ch) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(ch) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", ch & 0xFF);
+          *out += buf;
+        } else {
+          out->push_back(ch);
+        }
+    }
+  }
+  out->push_back('"');
+}
+
 bool HexToBytes(std::string_view hex, std::string* out) {
   if (hex.size() % 2 != 0) return false;
   auto nib = [](char c) -> int {

@@ -34,6 +34,12 @@ bool Base64UrlDecode(std::string_view s, std::string* out);
 // -- CRC32 (IEEE, zlib-compatible; reference: hash.c crc32) ---------------
 uint32_t Crc32(const void* data, size_t len, uint32_t seed = 0);
 
+// -- JSON string escaping (every hand-built wire-JSON emitter: STAT /
+// EVENT_DUMP / METRICS_HISTORY / HEAT_TOP).  Appends `s` quoted, with
+// ", \, \n, \r, \t escaped and other control bytes as \u00XX — one
+// definition so an escaping fix can never miss a wire surface.
+void AppendJsonString(std::string* out, std::string_view s);
+
 // Raw bytes -> lowercase hex (digest wire/display form).
 std::string BytesToHex(const uint8_t* data, size_t len);
 // Lowercase/uppercase hex -> raw bytes appended to *out; false on odd

@@ -3,6 +3,8 @@
 #include <algorithm>
 #include <vector>
 
+#include "common/bytes.h"
+
 #include "common/trace.h"  // TraceWallUs: events share the span clock
 
 namespace fdfs {
@@ -36,33 +38,6 @@ void EventLog::Record(EventSeverity sev, const char* type,
   slot->used = true;
   recorded_.fetch_add(1, std::memory_order_relaxed);
 }
-
-namespace {
-
-void AppendJsonString(std::string* out, const char* s) {
-  out->push_back('"');
-  for (; *s; ++s) {
-    char ch = *s;
-    switch (ch) {
-      case '"': *out += "\\\""; break;
-      case '\\': *out += "\\\\"; break;
-      case '\n': *out += "\\n"; break;
-      case '\r': *out += "\\r"; break;
-      case '\t': *out += "\\t"; break;
-      default:
-        if (static_cast<unsigned char>(ch) < 0x20) {
-          char buf[8];
-          snprintf(buf, sizeof(buf), "\\u%04x", ch & 0xFF);
-          *out += buf;
-        } else {
-          out->push_back(ch);
-        }
-    }
-  }
-  out->push_back('"');
-}
-
-}  // namespace
 
 std::string EventLog::Json(const std::string& role, int port) const {
   std::vector<ClusterEvent> evs;

@@ -1,0 +1,169 @@
+#include "common/heatsketch.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <mutex>
+
+#include "common/bytes.h"
+
+namespace fdfs {
+
+namespace {
+
+// FNV-1a: cheap, deterministic stripe routing (std::hash is
+// implementation-defined and the stripe split shows up in tests).
+uint64_t Fnv1a(const std::string& s) {
+  uint64_t h = 1469598103934665603ULL;
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+}  // namespace
+
+const char* HeatOpName(HeatOp op) {
+  switch (op) {
+    case HeatOp::kDownload: return "download";
+    case HeatOp::kUpload: return "upload";
+    case HeatOp::kFetchChunk: return "fetch_chunk";
+  }
+  return "unknown";
+}
+
+HeatSketch::HeatSketch(int capacity, int stripes)
+    : capacity_(capacity < 1 ? 1 : capacity),
+      n_stripes_(stripes < 1 ? 1 : stripes),
+      stripes_(new Stripe[static_cast<size_t>(n_stripes_)]) {}
+
+HeatSketch::Stripe* HeatSketch::StripeFor(const std::string& key) const {
+  return &stripes_[Fnv1a(key) % static_cast<uint64_t>(n_stripes_)];
+}
+
+void HeatSketch::Touch(const std::string& key, HeatOp op, int64_t bytes,
+                       bool error) {
+  Stripe* sp = StripeFor(key);
+  int oi = static_cast<int>(op);
+  if (oi < 0 || oi >= kHeatOpCount) return;
+  if (bytes < 0) bytes = 0;
+  std::lock_guard<RankedMutex> lk(sp->mu);
+  ++sp->touches;
+  auto it = sp->entries.find(key);
+  if (it == sp->entries.end()) {
+    if (static_cast<int>(sp->entries.size()) < capacity_) {
+      it = sp->entries.emplace(key, Entry{}).first;
+    } else {
+      // Space-saving replacement: the minimum-hits entry yields its
+      // slot; the newcomer inherits min+1 hits with min recorded as its
+      // possible overcount.  Byte/op splits restart (they are observed
+      // attributions, not estimates — inheriting them would fabricate
+      // traffic for a key that never saw it).
+      auto victim = sp->entries.begin();
+      for (auto e = sp->entries.begin(); e != sp->entries.end(); ++e)
+        if (e->second.hits < victim->second.hits) victim = e;
+      int64_t floor = victim->second.hits;
+      sp->entries.erase(victim);
+      ++sp->evictions;
+      Entry fresh;
+      fresh.hits = floor;  // +1 below with the real touch accounting
+      fresh.min_err = floor;
+      it = sp->entries.emplace(key, fresh).first;
+    }
+  }
+  Entry& e = it->second;
+  ++e.hits;
+  if (error) ++e.err;
+  e.bytes += bytes;
+  ++e.op_count[oi];
+  e.op_bytes[oi] += bytes;
+}
+
+std::vector<HeatSketch::TopEntry> HeatSketch::Top(int k) const {
+  std::vector<TopEntry> all;
+  for (int s = 0; s < n_stripes_; ++s) {
+    Stripe* sp = &stripes_[s];
+    std::lock_guard<RankedMutex> lk(sp->mu);
+    for (const auto& [key, e] : sp->entries) {
+      TopEntry t;
+      t.key = key;
+      t.hits = e.hits;
+      t.err_bound = e.min_err;
+      t.bytes = e.bytes;
+      t.err = e.err;
+      for (int i = 0; i < kHeatOpCount; ++i) {
+        t.op_count[i] = e.op_count[i];
+        t.op_bytes[i] = e.op_bytes[i];
+      }
+      all.push_back(std::move(t));
+    }
+  }
+  std::sort(all.begin(), all.end(), [](const TopEntry& a, const TopEntry& b) {
+    if (a.hits != b.hits) return a.hits > b.hits;
+    return a.key < b.key;  // deterministic ties (tests, goldens)
+  });
+  if (k > 0 && static_cast<size_t>(k) < all.size())
+    all.resize(static_cast<size_t>(k));
+  return all;
+}
+
+std::string HeatSketch::TopJson(const std::string& role, int port,
+                                int k) const {
+  std::vector<TopEntry> top = Top(k);
+  std::string out = "{\"role\":";
+  AppendJsonString(&out, role);
+  out += ",\"port\":" + std::to_string(port);
+  out += ",\"k\":" + std::to_string(static_cast<int64_t>(top.size()));
+  out += ",\"tracked\":" + std::to_string(tracked());
+  out += ",\"touches\":" + std::to_string(touches());
+  out += ",\"entries\":[";
+  bool first = true;
+  for (const TopEntry& t : top) {
+    if (!first) out += ",";
+    first = false;
+    out += "{\"key\":";
+    AppendJsonString(&out, t.key);
+    out += ",\"hits\":" + std::to_string(t.hits) +
+           ",\"err_bound\":" + std::to_string(t.err_bound) +
+           ",\"bytes\":" + std::to_string(t.bytes) +
+           ",\"err\":" + std::to_string(t.err) + ",\"ops\":{";
+    for (int i = 0; i < kHeatOpCount; ++i) {
+      if (i) out += ",";
+      AppendJsonString(&out, HeatOpName(static_cast<HeatOp>(i)));
+      out += ":{\"count\":" + std::to_string(t.op_count[i]) +
+             ",\"bytes\":" + std::to_string(t.op_bytes[i]) + "}";
+    }
+    out += "}}";
+  }
+  out += "]}";
+  return out;
+}
+
+int64_t HeatSketch::tracked() const {
+  int64_t n = 0;
+  for (int s = 0; s < n_stripes_; ++s) {
+    std::lock_guard<RankedMutex> lk(stripes_[s].mu);
+    n += static_cast<int64_t>(stripes_[s].entries.size());
+  }
+  return n;
+}
+
+int64_t HeatSketch::touches() const {
+  int64_t n = 0;
+  for (int s = 0; s < n_stripes_; ++s) {
+    std::lock_guard<RankedMutex> lk(stripes_[s].mu);
+    n += stripes_[s].touches;
+  }
+  return n;
+}
+
+int64_t HeatSketch::evictions() const {
+  int64_t n = 0;
+  for (int s = 0; s < n_stripes_; ++s) {
+    std::lock_guard<RankedMutex> lk(stripes_[s].mu);
+    n += stripes_[s].evictions;
+  }
+  return n;
+}
+
+}  // namespace fdfs

@@ -1,0 +1,106 @@
+// Hot-key heat telemetry: a lock-striped space-saving top-K sketch over
+// file-ids, fed from the storage daemon's per-request accounting choke
+// point (LogAccess) for downloads, uploads, and recovery chunk fetches.
+// Per-file popularity — the zipfian skew ROADMAP items 2/5 must survive
+// — becomes measurable per node and per group via the HEAT_TOP opcode
+// and the `fdfs_top --heat` pane, in O(K) memory however many distinct
+// file-ids pass through.
+//
+// Algorithm (Metwally et al. space-saving): each stripe tracks at most
+// `capacity` keys with (hits, err, bytes, per-op splits) plus a
+// per-entry `min_err` overcount bound.  A new key arriving at a full
+// stripe EVICTS the minimum-hits entry and inherits its count + 1, with
+// min_err recording how much of that count may belong to the evicted
+// history.  Guarantee: any key whose true frequency exceeds
+// touches/capacity is present, and hits - min_err <= true <= hits — the
+// accuracy bound OPERATIONS.md documents and the native unit test
+// checks against exact counts under zipfian load.
+//
+// Striping: keys partition across `stripes` independent sketches by
+// FNV-1a hash, each behind its own RankedMutex (LockRank::kHeatStripe),
+// so concurrent nio/dio threads touching different keys rarely contend
+// and a TopJson reader takes one stripe at a time (never nested — no
+// multi-stripe ordering protocol needed).  Effective per-node capacity
+// is stripes x capacity tracked keys answering top-K queries merged
+// across stripes, which only tightens the per-stripe bound.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common/lockrank.h"
+
+namespace fdfs {
+
+enum class HeatOp : uint8_t { kDownload = 0, kUpload = 1, kFetchChunk = 2 };
+constexpr int kHeatOpCount = 3;
+const char* HeatOpName(HeatOp op);  // "download" | "upload" | "fetch_chunk"
+
+class HeatSketch {
+ public:
+  // `capacity` = tracked keys PER STRIPE (the daemon passes its
+  // heat_top_k conf value); `stripes` trades contention for memory.
+  // Eviction from a full stripe scans all `capacity` entries for the
+  // min-hits victim under the stripe mutex, on the request path — the
+  // config clamp (1024) keeps that worst case a few µs; raise it only
+  // together with a stream-summary (O(1)-eviction) rework.
+  explicit HeatSketch(int capacity, int stripes = 8);
+
+  // Record one request against `key` (a file-id).  `bytes` = payload
+  // bytes served/accepted (0 on errors); `error` marks a non-zero
+  // response status.  Never allocates beyond the stripe's capacity.
+  void Touch(const std::string& key, HeatOp op, int64_t bytes, bool error);
+
+  // The HEAT_TOP response body: the merged top-`k` entries by hits
+  // descending (k <= 0 or > tracked clamps to what exists):
+  //   {"role":R,"port":P,"k":K,"tracked":N,"touches":N,"entries":[
+  //     {"key":...,"hits":H,"err_bound":E,"bytes":B,"err":Ne,
+  //      "ops":{"download":{"count":C,"bytes":B},...}}]}
+  // err_bound is the space-saving overcount bound (hits - err_bound is
+  // a guaranteed lower bound on the key's true frequency).
+  std::string TopJson(const std::string& role, int port, int k) const;
+
+  // Decoded top-k for native tests (key, hits, err_bound).
+  struct TopEntry {
+    std::string key;
+    int64_t hits = 0;
+    int64_t err_bound = 0;
+    int64_t bytes = 0;
+    int64_t err = 0;
+    int64_t op_count[kHeatOpCount] = {0, 0, 0};
+    int64_t op_bytes[kHeatOpCount] = {0, 0, 0};
+  };
+  std::vector<TopEntry> Top(int k) const;
+
+  int64_t tracked() const;   // distinct keys currently held
+  int64_t touches() const;   // lifetime Touch() calls
+  int64_t evictions() const; // space-saving replacements
+  int capacity() const { return capacity_; }
+
+ private:
+  struct Entry {
+    int64_t hits = 0;
+    int64_t err = 0;
+    int64_t bytes = 0;
+    int64_t min_err = 0;  // overcount inherited from evicted entries
+    int64_t op_count[kHeatOpCount] = {0, 0, 0};
+    int64_t op_bytes[kHeatOpCount] = {0, 0, 0};
+  };
+  struct Stripe {
+    mutable RankedMutex mu{LockRank::kHeatStripe};
+    std::unordered_map<std::string, Entry> entries;
+    int64_t touches = 0;
+    int64_t evictions = 0;
+  };
+
+  Stripe* StripeFor(const std::string& key) const;
+
+  int capacity_;
+  int n_stripes_;
+  std::unique_ptr<Stripe[]> stripes_;
+};
+
+}  // namespace fdfs

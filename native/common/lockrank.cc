@@ -14,6 +14,8 @@ const char* LockRankName(LockRank r) {
     case LockRank::kDedupEngine: return "dedup.engine";
     case LockRank::kDedupPool: return "dedup.sidecar_pool";
     case LockRank::kStatsRegistry: return "stats.registry";
+    case LockRank::kHeatStripe: return "heatsketch.stripe";
+    case LockRank::kMetricsJournal: return "metrog.journal";
     case LockRank::kSync: return "sync.manager";
     case LockRank::kChunkStripe: return "chunkstore.stripe";
     case LockRank::kReadCache: return "chunkstore.read_cache";

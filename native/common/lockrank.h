@@ -45,8 +45,20 @@
 //    70   kStatsRegistry    StatsRegistry::mu_ — gauge-fn callbacks run
 //                           UNDER it and read sync lag, chunk-store
 //                           stripe aggregates, the read cache, worker
-//                           queue depths, and ingest sessions, so it
+//                           queue depths, ingest sessions, the heat
+//                           sketch, and the metrics journal, so it
 //                           must order before ALL of those.
+//    72   kHeatStripe       HeatSketch::Stripe::mu (heatsketch.h) —
+//                           touched from the LogAccess choke point with
+//                           nothing held, and read by heat.* gauge-fns
+//                           (hence after kStatsRegistry).  Stripes are
+//                           taken one at a time, never nested.
+//    74   kMetricsJournal   MetricsJournal::mu_ (metrog.h) — append
+//                           (main-loop tick) and METRICS_HISTORY dumps
+//                           (nio loops) serialize file IO here; read by
+//                           the metrics.journal_* gauge-fns (hence
+//                           after kStatsRegistry).  Logs under it ->
+//                           before kLog.
 //    80   kSync             SyncManager::mu_ (worker map / peer states;
 //                           read by the sync.lag_s.max gauge-fn, hence
 //                           after kStatsRegistry).
@@ -101,6 +113,8 @@ enum class LockRank : uint16_t {
   kDedupEngine = 50,
   kDedupPool = 60,
   kStatsRegistry = 70,
+  kHeatStripe = 72,
+  kMetricsJournal = 74,
   kSync = 80,
   kChunkStripe = 90,
   kReadCache = 100,

@@ -1,0 +1,468 @@
+#include "common/metrog.h"
+
+#include <stdio.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <deque>
+
+#include "common/bytes.h"
+#include "common/fsutil.h"
+#include "common/log.h"
+
+namespace fdfs {
+
+namespace {
+
+constexpr char kMagic = 'J';
+constexpr uint8_t kFlagFull = 1;
+constexpr size_t kFrameHead = 1 + 1 + 4 + 8;  // magic, flags, len, ts
+constexpr size_t kFrameTail = 4;              // crc32
+// A record payload can never legitimately reach this (a registry is a
+// few thousand entries); a larger declared length is torn-tail garbage.
+constexpr uint32_t kMaxPayload = 16u << 20;
+
+// Scalar entry tags.  Tombstones delta-encode the ONLY removal path the
+// registry has — PruneGauges retiring a departed peer's gauges — so a
+// decoded window never resurrects dead series.
+constexpr uint8_t kTagCounter = 0;
+constexpr uint8_t kTagGauge = 1;
+constexpr uint8_t kTagCounterDead = 2;
+constexpr uint8_t kTagGaugeDead = 3;
+
+void PutVarint(uint64_t v, std::string* out) {
+  while (v >= 0x80) {
+    out->push_back(static_cast<char>(v | 0x80));
+    v >>= 7;
+  }
+  out->push_back(static_cast<char>(v));
+}
+
+bool GetVarint(const std::string& d, size_t* pos, uint64_t* v) {
+  *v = 0;
+  int shift = 0;
+  while (*pos < d.size() && shift <= 63) {
+    uint8_t b = static_cast<uint8_t>(d[*pos]);
+    ++*pos;
+    *v |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if ((b & 0x80) == 0) return true;
+    shift += 7;
+  }
+  return false;
+}
+
+uint64_t Zig(int64_t n) {
+  return (static_cast<uint64_t>(n) << 1) ^
+         static_cast<uint64_t>(n >> 63);
+}
+
+int64_t Unzig(uint64_t z) {
+  return static_cast<int64_t>(z >> 1) ^ -static_cast<int64_t>(z & 1);
+}
+
+void PutZig(int64_t v, std::string* out) { PutVarint(Zig(v), out); }
+
+bool GetZig(const std::string& d, size_t* pos, int64_t* v) {
+  uint64_t z;
+  if (!GetVarint(d, pos, &z)) return false;
+  *v = Unzig(z);
+  return true;
+}
+
+void PutName(const std::string& name, std::string* out) {
+  PutVarint(name.size(), out);
+  out->append(name);
+}
+
+bool GetName(const std::string& d, size_t* pos, std::string* name) {
+  uint64_t n;
+  if (!GetVarint(d, pos, &n) || n > 4096 || *pos + n > d.size())
+    return false;
+  name->assign(d, *pos, static_cast<size_t>(n));
+  *pos += static_cast<size_t>(n);
+  return true;
+}
+
+// One scalar section (counters or gauges) of a record payload.
+void EncodeScalars(uint8_t set_tag, uint8_t dead_tag,
+                   const std::map<std::string, int64_t>* prev,
+                   const std::map<std::string, int64_t>& cur,
+                   std::string* entries, uint64_t* n) {
+  for (const auto& [name, v] : cur) {
+    int64_t base = 0;
+    if (prev != nullptr) {
+      auto it = prev->find(name);
+      if (it != prev->end()) {
+        if (it->second == v) continue;  // unchanged: omit from the delta
+        base = it->second;
+      }
+    }
+    entries->push_back(static_cast<char>(set_tag));
+    PutName(name, entries);
+    PutZig(v - base, entries);
+    ++*n;
+  }
+  if (prev == nullptr) return;
+  for (const auto& [name, v] : *prev) {
+    (void)v;
+    if (cur.count(name)) continue;
+    entries->push_back(static_cast<char>(dead_tag));
+    PutName(name, entries);
+    ++*n;
+  }
+}
+
+bool HistChanged(const StatsSnapshot::Hist& a, const StatsSnapshot::Hist& b) {
+  return a.bounds != b.bounds || a.counts != b.counts || a.sum != b.sum;
+}
+
+int64_t FileBytes(const std::string& path) {
+  struct stat st;
+  return stat(path.c_str(), &st) == 0 ? static_cast<int64_t>(st.st_size) : 0;
+}
+
+std::string ReadWhole(const std::string& path) {
+  std::string out;
+  FILE* f = fopen(path.c_str(), "rb");
+  if (f == nullptr) return out;
+  char buf[65536];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0) out.append(buf, n);
+  fclose(f);
+  return out;
+}
+
+}  // namespace
+
+std::string MetricsJournal::EncodeRecord(const StatsSnapshot* prev,
+                                         const StatsSnapshot& cur,
+                                         int64_t ts_us) {
+  // Payload: [varint n_scalars][entries][varint n_hists][hist entries]
+  std::string scalars;
+  uint64_t n_scalars = 0;
+  EncodeScalars(kTagCounter, kTagCounterDead,
+                prev != nullptr ? &prev->counters : nullptr, cur.counters,
+                &scalars, &n_scalars);
+  EncodeScalars(kTagGauge, kTagGaugeDead,
+                prev != nullptr ? &prev->gauges : nullptr, cur.gauges,
+                &scalars, &n_scalars);
+  std::string hists;
+  uint64_t n_hists = 0;
+  for (const auto& [name, h] : cur.histograms) {
+    const StatsSnapshot::Hist* ph = nullptr;
+    if (prev != nullptr) {
+      auto it = prev->histograms.find(name);
+      if (it != prev->histograms.end()) {
+        if (!HistChanged(it->second, h)) continue;
+        // Same bounds: bucket-wise delta.  Changed bounds (never happens
+        // in practice — layouts are compile-time) fall back to absolute.
+        if (it->second.bounds == h.bounds &&
+            it->second.counts.size() == h.counts.size())
+          ph = &it->second;
+      }
+    }
+    PutName(name, &hists);
+    PutVarint(h.bounds.size(), &hists);
+    for (int64_t b : h.bounds) PutZig(b, &hists);
+    for (size_t i = 0; i < h.counts.size(); ++i)
+      PutZig(h.counts[i] - (ph != nullptr ? ph->counts[i] : 0), &hists);
+    PutZig(h.sum - (ph != nullptr ? ph->sum : 0), &hists);
+    ++n_hists;
+  }
+  std::string payload;
+  payload.reserve(scalars.size() + hists.size() + 16);
+  PutVarint(n_scalars, &payload);
+  payload += scalars;
+  PutVarint(n_hists, &payload);
+  payload += hists;
+
+  std::string frame;
+  frame.reserve(kFrameHead + payload.size() + kFrameTail);
+  frame.push_back(kMagic);
+  frame.push_back(static_cast<char>(prev == nullptr ? kFlagFull : 0));
+  uint8_t num[8];
+  PutInt32BE(static_cast<uint32_t>(payload.size()), num);
+  frame.append(reinterpret_cast<char*>(num), 4);
+  PutInt64BE(ts_us, num);
+  frame.append(reinterpret_cast<char*>(num), 8);
+  frame += payload;
+  uint32_t crc = Crc32(frame.data() + 1, frame.size() - 1);
+  PutInt32BE(crc, num);
+  frame.append(reinterpret_cast<char*>(num), 4);
+  return frame;
+}
+
+std::vector<std::pair<int64_t, StatsSnapshot>> MetricsJournal::DecodeBuffer(
+    const std::string& data, size_t* valid_bytes, size_t max_records) {
+  std::deque<std::pair<int64_t, StatsSnapshot>> out;
+  StatsSnapshot state;
+  bool have_state = false;
+  size_t off = 0;
+  while (off + kFrameHead + kFrameTail <= data.size()) {
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(data.data()) + off;
+    if (data[off] != kMagic) break;
+    uint8_t flags = p[1];
+    uint32_t len = GetInt32BE(p + 2);
+    int64_t ts_us = GetInt64BE(p + 6);
+    if (len > kMaxPayload ||
+        off + kFrameHead + len + kFrameTail > data.size())
+      break;
+    uint32_t want = GetInt32BE(p + kFrameHead + len);
+    if (Crc32(data.data() + off + 1, kFrameHead - 1 + len) != want) break;
+    std::string payload(data, off + kFrameHead, len);
+    bool full = (flags & kFlagFull) != 0;
+    // A delta with no prior state (the full head of this file was
+    // damaged or the chain starts mid-file) cannot be reconstructed —
+    // skip it but keep scanning: later full records restart the chain.
+    if (full || have_state) {
+      StatsSnapshot next = full ? StatsSnapshot{} : state;
+      size_t pos = 0;
+      uint64_t n = 0;
+      bool ok = GetVarint(payload, &pos, &n);
+      for (uint64_t i = 0; ok && i < n; ++i) {
+        if (pos >= payload.size()) { ok = false; break; }
+        uint8_t tag = static_cast<uint8_t>(payload[pos++]);
+        std::string name;
+        if (!GetName(payload, &pos, &name)) { ok = false; break; }
+        auto* section = (tag == kTagCounter || tag == kTagCounterDead)
+                            ? &next.counters : &next.gauges;
+        if (tag == kTagCounterDead || tag == kTagGaugeDead) {
+          section->erase(name);
+        } else if (tag == kTagCounter || tag == kTagGauge) {
+          int64_t dv;
+          if (!GetZig(payload, &pos, &dv)) { ok = false; break; }
+          (*section)[name] += dv;
+        } else {
+          ok = false;
+        }
+      }
+      uint64_t nh = 0;
+      ok = ok && GetVarint(payload, &pos, &nh);
+      for (uint64_t i = 0; ok && i < nh; ++i) {
+        std::string name;
+        uint64_t nb;
+        if (!GetName(payload, &pos, &name) ||
+            !GetVarint(payload, &pos, &nb) || nb > 4096) { ok = false; break; }
+        std::vector<int64_t> bounds(static_cast<size_t>(nb));
+        for (auto& b : bounds)
+          if (!GetZig(payload, &pos, &b)) { ok = false; break; }
+        if (!ok) break;
+        StatsSnapshot::Hist& hs = next.histograms[name];
+        if (hs.bounds != bounds) {
+          hs = StatsSnapshot::Hist{};  // new or re-bucketed: deltas-from-0
+          hs.bounds = bounds;
+          hs.counts.assign(bounds.size() + 1, 0);
+        }
+        hs.count = 0;
+        for (auto& c : hs.counts) {
+          int64_t dv;
+          if (!GetZig(payload, &pos, &dv)) { ok = false; break; }
+          c += dv;
+          hs.count += c;
+        }
+        int64_t ds;
+        ok = ok && GetZig(payload, &pos, &ds);
+        if (ok) hs.sum += ds;
+      }
+      if (!ok) break;  // payload damage inside a CRC-clean frame: stop
+      state = std::move(next);
+      have_state = true;
+      out.emplace_back(ts_us, state);
+      // Retention cap: the oldest snapshot falls off so decoding a big
+      // ring of tiny delta records can never materialize more than
+      // max_records full registries at once.
+      if (max_records != 0 && out.size() > max_records) out.pop_front();
+    }
+    off += kFrameHead + len + kFrameTail;
+  }
+  if (valid_bytes != nullptr) *valid_bytes = off;
+  return {std::make_move_iterator(out.begin()),
+          std::make_move_iterator(out.end())};
+}
+
+std::string MetricsJournal::SnapshotsJson(
+    const std::string& role, int port,
+    const std::vector<std::pair<int64_t, StatsSnapshot>>& snaps) {
+  std::string out = "{\"role\":";
+  AppendJsonString(&out, role);
+  out += ",\"port\":" + std::to_string(port) + ",\"snapshots\":[";
+  bool first_snap = true;
+  for (const auto& [ts_us, s] : snaps) {
+    if (!first_snap) out += ",";
+    first_snap = false;
+    out += "{\"ts_us\":" + std::to_string(ts_us) + ",";
+    auto scalar_section = [&out](const char* label,
+                                 const std::map<std::string, int64_t>& m) {
+      out += std::string("\"") + label + "\":{";
+      bool first = true;
+      for (const auto& [name, v] : m) {
+        if (!first) out += ",";
+        first = false;
+        AppendJsonString(&out, name);
+        out += ":" + std::to_string(v);
+      }
+      out += "}";
+    };
+    scalar_section("counters", s.counters);
+    out += ",";
+    scalar_section("gauges", s.gauges);
+    out += ",\"histograms\":{";
+    bool first = true;
+    for (const auto& [name, h] : s.histograms) {
+      if (!first) out += ",";
+      first = false;
+      AppendJsonString(&out, name);
+      out += ":{\"bounds\":[";
+      for (size_t i = 0; i < h.bounds.size(); ++i) {
+        if (i) out += ",";
+        out += std::to_string(h.bounds[i]);
+      }
+      out += "],\"counts\":[";
+      for (size_t i = 0; i < h.counts.size(); ++i) {
+        if (i) out += ",";
+        out += std::to_string(h.counts[i]);
+      }
+      out += "],\"sum\":" + std::to_string(h.sum) +
+             ",\"count\":" + std::to_string(h.count) + "}";
+    }
+    out += "}}";
+  }
+  out += "]}";
+  return out;
+}
+
+MetricsJournal::MetricsJournal(std::string dir, int64_t cap_bytes)
+    : dir_(std::move(dir)),
+      cap_bytes_(cap_bytes < (64 << 10) ? (64 << 10) : cap_bytes) {}
+
+MetricsJournal::~MetricsJournal() {
+  std::lock_guard<RankedMutex> lk(mu_);
+  if (f_ != nullptr) fclose(f_);
+  f_ = nullptr;
+}
+
+bool MetricsJournal::Open(std::string* error) {
+  std::lock_guard<RankedMutex> lk(mu_);
+  if (!MakeDirs(dir_)) {
+    *error = "cannot create metrics journal dir " + dir_;
+    return false;
+  }
+  // Torn-tail recovery: keep exactly the prefix of whole, CRC-clean
+  // frames; a kill -9 mid-append loses at most the in-flight record.
+  // Only valid_bytes matters here — retain one snapshot, not the ring.
+  std::string cur = ReadWhole(CurrentPath());
+  size_t valid = 0;
+  DecodeBuffer(cur, &valid, 1);
+  recovered_bytes_ = static_cast<int64_t>(cur.size() - valid);
+  if (valid < cur.size()) {
+    if (truncate(CurrentPath().c_str(), static_cast<off_t>(valid)) != 0) {
+      *error = "cannot truncate torn journal tail " + CurrentPath();
+      return false;
+    }
+    FDFS_LOG_WARN("metrics journal: truncated %lld torn byte(s) from %s",
+                  static_cast<long long>(recovered_bytes_),
+                  CurrentPath().c_str());
+  }
+  f_ = fopen(CurrentPath().c_str(), "ab");
+  if (f_ == nullptr) {
+    *error = "cannot open metrics journal " + CurrentPath();
+    return false;
+  }
+  cur_bytes_ = static_cast<int64_t>(valid);
+  rot_bytes_ = FileBytes(RotatedPath());
+  have_prev_ = false;  // first post-open record is full by construction
+  return true;
+}
+
+bool MetricsJournal::RotateIfNeeded() {
+  if (cur_bytes_ <= cap_bytes_ / 2) return true;
+  fclose(f_);
+  f_ = nullptr;
+  if (rename(CurrentPath().c_str(), RotatedPath().c_str()) != 0) {
+    FDFS_LOG_WARN("metrics journal: rotate rename failed: %s",
+                  strerror(errno));
+  }
+  rot_bytes_ = cur_bytes_;
+  f_ = fopen(CurrentPath().c_str(), "ab");
+  cur_bytes_ = 0;
+  have_prev_ = false;  // the fresh file must start with a full record
+  return f_ != nullptr;
+}
+
+void MetricsJournal::Append(int64_t ts_us, const StatsSnapshot& snap) {
+  std::lock_guard<RankedMutex> lk(mu_);
+  if (f_ == nullptr) return;
+  std::string frame =
+      EncodeRecord(have_prev_ ? &prev_ : nullptr, snap, ts_us);
+  // fflush pushes the frame into the kernel: a kill -9 after this point
+  // cannot lose it (only machine loss can, and the CRC framing makes a
+  // half-written frame recoverable either way).
+  if (fwrite(frame.data(), 1, frame.size(), f_) != frame.size() ||
+      fflush(f_) != 0) {
+    // ENOSPC/EIO mid-append: partial bytes may be in the file, and
+    // DecodeBuffer stops at the first bad frame WITHOUT resync — left
+    // in place they would hide every later record until rotation.
+    // Truncate back to the last good frame boundary and force the next
+    // append full, so one failed tick costs one record, not the ring.
+    FDFS_LOG_WARN("metrics journal: append failed: %s", strerror(errno));
+    fclose(f_);
+    f_ = nullptr;
+    if (truncate(CurrentPath().c_str(), static_cast<off_t>(cur_bytes_)) != 0)
+      FDFS_LOG_WARN("metrics journal: rollback truncate failed: %s",
+                    strerror(errno));
+    f_ = fopen(CurrentPath().c_str(), "ab");
+    have_prev_ = false;
+    return;
+  }
+  cur_bytes_ += static_cast<int64_t>(frame.size());
+  prev_ = snap;
+  have_prev_ = true;
+  ++appended_;
+  RotateIfNeeded();
+}
+
+std::vector<std::pair<int64_t, StatsSnapshot>> MetricsJournal::Decode(
+    int64_t since_ts_us) const {
+  // Read both ring files under the lock (a concurrent Append/rotation
+  // must not rename files between the two reads), but delta-decode
+  // OUTSIDE it: decode cost scales with the configured cap, and holding
+  // mu_ through it would stall the tick's Append — and with it the SLO
+  // evaluator — for the whole dump.
+  std::string rot, cur;
+  {
+    std::lock_guard<RankedMutex> lk(mu_);
+    rot = ReadWhole(RotatedPath());
+    cur = ReadWhole(CurrentPath());
+  }
+  std::vector<std::pair<int64_t, StatsSnapshot>> out;
+  for (const std::string* data : {&rot, &cur}) {
+    auto part = DecodeBuffer(*data);
+    for (auto& rec : part)
+      if (rec.first >= since_ts_us) out.push_back(std::move(rec));
+  }
+  // Per-file caps can leave up to 2x the budget after the merge; keep
+  // the newest — they are the window leading into whatever the
+  // post-mortem is about.
+  if (out.size() > kMaxDecodedSnapshots)
+    out.erase(out.begin(),
+              out.end() - static_cast<ptrdiff_t>(kMaxDecodedSnapshots));
+  return out;
+}
+
+std::string MetricsJournal::DumpJson(const std::string& role, int port,
+                                     int64_t since_ts_us) const {
+  return SnapshotsJson(role, port, Decode(since_ts_us));
+}
+
+int64_t MetricsJournal::appended() const {
+  std::lock_guard<RankedMutex> lk(mu_);
+  return appended_;
+}
+
+int64_t MetricsJournal::bytes_retained() const {
+  std::lock_guard<RankedMutex> lk(mu_);
+  return cur_bytes_ + rot_bytes_;
+}
+
+}  // namespace fdfs

@@ -1,0 +1,136 @@
+// Metrics history journal: a size-capped on-disk ring of periodic
+// stats-registry snapshots — the durable, retrospective complement of
+// the live STAT opcode (stats.h), the span ring (trace.h), and the
+// flight recorder (eventlog.h).  Every daemon appends one delta-encoded,
+// CRC-framed record per SLO tick; after a crash, kill -9, or restart the
+// retained window is still one METRICS_HISTORY RPC away, so `fdfs_report
+// --since <pre-crash>` can reconstruct the rate/p99 time-series that led
+// into the failure instead of starting observability from zero.
+//
+// Reference departure: upstream FastDFS persists only the cumulative
+// per-op totals (storage_stat.dat); every distribution and rate dies
+// with the process.  Here the whole registry — counters, gauges, and
+// histogram buckets — is journaled, and the journal is the data the
+// SLO evaluator (sloeval.h) and the load-harness verdicts are judged
+// against.
+//
+// On-disk layout (`<dir>/metrics.mj` current + `metrics.mj.0` rotated):
+// a sequence of framed records
+//
+//   'J' | u8 flags (bit0 = full snapshot) | u32 BE payload_len |
+//   s64 BE ts_us | payload | u32 BE crc32(flags..payload)
+//
+// The payload is a compact binary encoding of the snapshot: varint
+// lengths, zigzag-varint values.  A FULL record carries every entry
+// absolutely; a DELTA record carries only entries that changed since
+// the previous record (values as differences) plus tombstones for
+// scalars that disappeared (pruned per-peer gauges).  Every file begins
+// with a full record — rotation and reopen force one — so each file
+// decodes standalone and the ring can drop the older file whole.
+//
+// Torn-tail recovery: Open() scans the current file frame-by-frame and
+// truncates at the first bad magic/length/CRC — exactly the bytes a
+// kill -9 mid-append can leave — then forces the next append full
+// (rebuild-on-open, the RebuildFromRecipes philosophy).
+//
+// Rotation: when the current file exceeds cap_bytes/2 it renames over
+// the .0 file and a fresh current file starts with a full record, so
+// total disk stays <= cap_bytes and at least cap_bytes/2 of history
+// survives any single rotation.
+//
+// Concurrency: one RankedMutex (LockRank::kMetricsJournal) serializes
+// Append (the owning loop's tick timer) against DumpJson (any nio
+// thread serving METRICS_HISTORY) and the size gauges.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "common/lockrank.h"
+#include "common/stats.h"
+
+namespace fdfs {
+
+class MetricsJournal {
+ public:
+  // `dir` holds the journal files; `cap_bytes` bounds current + rotated
+  // together (minimum 64 KB so a single full record always fits).
+  MetricsJournal(std::string dir, int64_t cap_bytes);
+  ~MetricsJournal();
+
+  // Create the directory, recover the torn tail of an existing current
+  // file, and position for appends.  False + *error on IO failure.
+  bool Open(std::string* error);
+
+  // Append one snapshot stamped `ts_us` (wall-clock epoch µs — the
+  // span/event clock domain, so journal windows line up with traces and
+  // flight-recorder timelines).  Delta-encodes against the previous
+  // append; the first append after Open() or a rotation is full.
+  void Append(int64_t ts_us, const StatsSnapshot& snap);
+
+  // Decoding reconstructs every delta record into a FULL absolute
+  // snapshot (maps, several KB each), so a ring of few-hundred-byte
+  // delta records amplifies 10-100x from disk to memory.  This cap
+  // bounds what one dump materializes: only the NEWEST snapshots are
+  // retained (the oldest fall off the front), so the window leading
+  // into a failure — the post-mortem payload — always survives.  At
+  // the default 5 s tick, 4096 snapshots ≈ 5.7 hours.
+  static constexpr size_t kMaxDecodedSnapshots = 4096;
+
+  // The METRICS_HISTORY response body: the newest kMaxDecodedSnapshots
+  // retained snapshots with ts_us >= since_ts_us (0 = all),
+  // reconstructed to ABSOLUTE values, oldest first:
+  //   {"role":R,"port":P,"snapshots":[{"ts_us":T,"counters":{...},
+  //    "gauges":{...},"histograms":{n:{"bounds":[...],"counts":[...],
+  //    "sum":S,"count":C}}}]}
+  std::string DumpJson(const std::string& role, int port,
+                       int64_t since_ts_us) const;
+
+  // Decode both retained files (oldest first) into absolute snapshots —
+  // the dump path and the native unit tests share it.  Capped at the
+  // newest kMaxDecodedSnapshots across both files.
+  std::vector<std::pair<int64_t, StatsSnapshot>> Decode(
+      int64_t since_ts_us) const;
+
+  int64_t appended() const;     // records appended this process
+  int64_t bytes_retained() const;  // current + rotated file bytes
+  int64_t recovered_bytes() const { return recovered_bytes_; }
+
+  // Pure codec halves, exposed for unit tests and the fdfs_codec
+  // metrics-history golden: encode one record payload (absolute when
+  // prev == nullptr, delta otherwise) and the frame around it; decode a
+  // buffer of frames applying deltas onto running state.  `max_records`
+  // bounds how many decoded snapshots are RETAINED (newest win; 0 =
+  // unlimited) — the whole buffer is still scanned, so *valid_bytes
+  // covers every clean frame regardless.
+  static std::string EncodeRecord(const StatsSnapshot* prev,
+                                  const StatsSnapshot& cur, int64_t ts_us);
+  static std::vector<std::pair<int64_t, StatsSnapshot>> DecodeBuffer(
+      const std::string& data, size_t* valid_bytes = nullptr,
+      size_t max_records = kMaxDecodedSnapshots);
+  // Render snapshots as the METRICS_HISTORY wire JSON (shared by
+  // DumpJson and the codec golden, so the golden pins the real emitter).
+  static std::string SnapshotsJson(
+      const std::string& role, int port,
+      const std::vector<std::pair<int64_t, StatsSnapshot>>& snaps);
+
+ private:
+  bool RotateIfNeeded();        // under mu_
+  std::string CurrentPath() const { return dir_ + "/metrics.mj"; }
+  std::string RotatedPath() const { return dir_ + "/metrics.mj.0"; }
+
+  std::string dir_;
+  int64_t cap_bytes_;
+  mutable RankedMutex mu_{LockRank::kMetricsJournal};
+  FILE* f_ = nullptr;           // current file, append position at EOF
+  int64_t cur_bytes_ = 0;       // size of the current file
+  int64_t rot_bytes_ = 0;       // size of the rotated file
+  int64_t appended_ = 0;
+  int64_t recovered_bytes_ = 0;  // torn-tail bytes truncated at Open()
+  bool have_prev_ = false;       // next Append may delta-encode
+  StatsSnapshot prev_;           // state the next delta is relative to
+};
+
+}  // namespace fdfs

@@ -1,0 +1,260 @@
+#include "common/sloeval.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace fdfs {
+
+namespace {
+
+// `op.<name>.count` / `op.<name>.errors` on storage; the tracker's
+// aggregate `server.requests` / `server.errors` otherwise.  Summed so
+// one rule definition serves both roles.
+int64_t SumOps(const std::map<std::string, int64_t>& counters,
+               const char* suffix) {
+  int64_t n = 0;
+  size_t slen = strlen(suffix);
+  for (const auto& [name, v] : counters) {
+    if (name.size() > 3 + slen && name.compare(0, 3, "op.") == 0 &&
+        name.compare(name.size() - slen, slen, suffix) == 0)
+      n += v;
+  }
+  return n;
+}
+
+int64_t Scalar(const std::map<std::string, int64_t>& m,
+               const std::string& name, int64_t dflt = 0) {
+  auto it = m.find(name);
+  return it != m.end() ? it->second : dflt;
+}
+
+// Bucket-wise delta of every histogram whose name matches `match(name)`,
+// merged into one distribution (all latency histograms share
+// LatencyBucketsUs, so the merge is well-defined; a mismatched layout is
+// skipped rather than corrupting the merge).
+struct MergedDelta {
+  std::vector<int64_t> bounds;
+  std::vector<int64_t> counts;
+  int64_t total = 0;
+};
+
+template <typename Match>
+MergedDelta DeltaHists(const StatsSnapshot& prev, const StatsSnapshot& cur,
+                       Match match) {
+  MergedDelta out;
+  for (const auto& [name, h] : cur.histograms) {
+    if (!match(name)) continue;
+    if (out.bounds.empty()) {
+      out.bounds = h.bounds;
+      out.counts.assign(h.counts.size(), 0);
+    }
+    if (h.bounds != out.bounds || h.counts.size() != out.counts.size())
+      continue;
+    auto pit = prev.histograms.find(name);
+    const StatsSnapshot::Hist* ph =
+        (pit != prev.histograms.end() && pit->second.bounds == h.bounds &&
+         pit->second.counts.size() == h.counts.size())
+            ? &pit->second
+            : nullptr;
+    for (size_t i = 0; i < h.counts.size(); ++i) {
+      // Clamp at 0: a daemon restart between snapshots must read as "no
+      // data", never as negative bucket mass (the monitor-side
+      // hist_delta applies the same rule).
+      int64_t d = h.counts[i] - (ph != nullptr ? ph->counts[i] : 0);
+      if (d > 0) {
+        out.counts[i] += d;
+        out.total += d;
+      }
+    }
+  }
+  return out;
+}
+
+// Upper-bound p-quantile of a merged delta; overflow mass reads as 2x
+// the last bound ("worse than the scale measures" must still breach).
+bool DeltaQuantileUs(const MergedDelta& d, double q, double* out) {
+  if (d.total <= 0 || d.bounds.empty()) return false;
+  double rank = q * static_cast<double>(d.total);
+  int64_t seen = 0;
+  for (size_t i = 0; i < d.bounds.size(); ++i) {
+    seen += d.counts[i];
+    if (static_cast<double>(seen) >= rank) {
+      *out = static_cast<double>(d.bounds[i]);
+      return true;
+    }
+  }
+  *out = 2.0 * static_cast<double>(d.bounds.back());
+  return true;
+}
+
+double Fmt6g(double v, char* buf, size_t cap) {
+  snprintf(buf, cap, "%.6g", v);
+  return v;
+}
+
+}  // namespace
+
+std::vector<SloRule> SloEvaluator::DefaultRules() {
+  // threshold/clear pairs are the hysteresis band; rationale per rule in
+  // OPERATIONS.md "Telemetry history, SLOs & heat".
+  return {
+      {"error_rate_pct", 5.0, 2.5, true},      // % of requests failing
+      {"request_p99_ms", 1000.0, 500.0, true}, // op/server latency p99
+      {"loop_lag_p99_ms", 250.0, 125.0, true}, // nio event-loop stall p99
+      {"dio_wait_p99_ms", 500.0, 250.0, true}, // disk-queue wait p99
+      {"sync_lag_s", 300.0, 150.0, true},      // replication staleness
+      // "any unrepairable chunk": the gauge is an integer, so >= 1
+      // exceeds 0.5 on the very first EWMA sample, and the alert clears
+      // a few ticks after the count returns to 0.
+      {"scrub_unrepairable", 0.5, 0.25, true},
+      {"disk_fill_pct", 90.0, 85.0, true},     // fullest store path
+  };
+}
+
+std::vector<SloRule> SloEvaluator::LoadRules(const IniConfig& ini) {
+  std::vector<SloRule> rules = DefaultRules();
+  auto get_double = [&ini](const std::string& key, double* out) {
+    auto v = ini.Get(key);
+    if (!v.has_value() || v->empty()) return false;
+    char* end = nullptr;
+    double d = strtod(v->c_str(), &end);
+    if (end == v->c_str()) return false;
+    *out = d;
+    return true;
+  };
+  for (SloRule& r : rules) {
+    double dflt_threshold = r.threshold, dflt_clear = r.clear;
+    bool got_threshold = get_double(r.name + "_threshold", &r.threshold);
+    bool got_clear = get_double(r.name + "_clear", &r.clear);
+    if (got_threshold && !got_clear) {
+      // Keep the hysteresis band proportional to the default's so a
+      // one-key override cannot leave clear above the new threshold.
+      r.clear = dflt_threshold > 0
+                    ? r.threshold * (dflt_clear / dflt_threshold)
+                    : dflt_clear;
+    }
+    if (r.clear > r.threshold) r.clear = r.threshold;
+    r.enabled = ini.GetBool(r.name + "_enabled", r.enabled);
+  }
+  return rules;
+}
+
+bool SloEvaluator::ComputeReading(const std::string& name,
+                                  const StatsSnapshot& prev,
+                                  const StatsSnapshot& cur, double dt_s,
+                                  double* out) {
+  (void)dt_s;  // rules are ratios/quantiles/levels; rates divide here
+  if (name == "error_rate_pct") {
+    int64_t dops = (SumOps(cur.counters, ".count") +
+                    Scalar(cur.counters, "server.requests")) -
+                   (SumOps(prev.counters, ".count") +
+                    Scalar(prev.counters, "server.requests"));
+    int64_t derr = (SumOps(cur.counters, ".errors") +
+                    Scalar(cur.counters, "server.errors")) -
+                   (SumOps(prev.counters, ".errors") +
+                    Scalar(prev.counters, "server.errors"));
+    if (dops <= 0) return false;  // no traffic (or restart): skip tick
+    if (derr < 0) return false;   // counter reset mid-window
+    *out = 100.0 * static_cast<double>(derr) / static_cast<double>(dops);
+    return true;
+  }
+  if (name == "request_p99_ms") {
+    auto d = DeltaHists(prev, cur, [](const std::string& n) {
+      return (n.compare(0, 3, "op.") == 0 &&
+              n.size() > 11 &&
+              n.compare(n.size() - 11, 11, ".latency_us") == 0) ||
+             n == "server.request_us";
+    });
+    double us;
+    if (!DeltaQuantileUs(d, 0.99, &us)) return false;
+    *out = us / 1000.0;
+    return true;
+  }
+  if (name == "loop_lag_p99_ms" || name == "dio_wait_p99_ms") {
+    const char* hist = name == "loop_lag_p99_ms" ? "nio.loop_lag_us"
+                                                 : "dio.queue_wait_us";
+    auto d = DeltaHists(prev, cur,
+                        [hist](const std::string& n) { return n == hist; });
+    double us;
+    if (!DeltaQuantileUs(d, 0.99, &us)) return false;
+    *out = us / 1000.0;
+    return true;
+  }
+  if (name == "sync_lag_s") {
+    auto it = cur.gauges.find("sync.lag_s.max");
+    if (it == cur.gauges.end()) return false;
+    *out = static_cast<double>(it->second);
+    return true;
+  }
+  if (name == "scrub_unrepairable") {
+    auto it = cur.gauges.find("scrub.corrupt_unrepairable");
+    if (it == cur.gauges.end()) return false;
+    *out = static_cast<double>(it->second);
+    return true;
+  }
+  if (name == "disk_fill_pct") {
+    auto it = cur.gauges.find("store.disk_used_pct");
+    if (it == cur.gauges.end()) return false;
+    *out = static_cast<double>(it->second);
+    return true;
+  }
+  return false;  // unknown rule name: never fires
+}
+
+SloEvaluator::SloEvaluator(std::vector<SloRule> rules, EventLog* events)
+    : rules_spec_(rules), events_(events) {
+  for (SloRule& r : rules) {
+    RuleState st;
+    st.rule = std::move(r);
+    states_.push_back(std::move(st));
+  }
+}
+
+bool SloEvaluator::IsBreached(const std::string& name) const {
+  for (const RuleState& st : states_)
+    if (st.rule.name == name) return st.breached;
+  return false;
+}
+
+void SloEvaluator::Tick(const StatsSnapshot& prev, const StatsSnapshot& cur,
+                        double dt_s) {
+  int64_t active = 0;
+  for (RuleState& st : states_) {
+    if (!st.rule.enabled) continue;
+    double reading;
+    if (ComputeReading(st.rule.name, prev, cur, dt_s, &reading)) {
+      st.ewma = st.have_ewma ? kAlpha * reading + (1.0 - kAlpha) * st.ewma
+                             : reading;
+      st.have_ewma = true;
+      char vb[32], eb[32], tb[32];
+      if (!st.breached && st.ewma > st.rule.threshold) {
+        st.breached = true;
+        transitions_.fetch_add(1, std::memory_order_relaxed);
+        if (events_ != nullptr) {
+          Fmt6g(reading, vb, sizeof(vb));
+          Fmt6g(st.ewma, eb, sizeof(eb));
+          Fmt6g(st.rule.threshold, tb, sizeof(tb));
+          events_->Record(EventSeverity::kError, "slo.breach", st.rule.name,
+                          std::string("value=") + vb + " ewma=" + eb +
+                              " threshold=" + tb);
+        }
+      } else if (st.breached && st.ewma <= st.rule.clear) {
+        st.breached = false;
+        if (events_ != nullptr) {
+          Fmt6g(reading, vb, sizeof(vb));
+          Fmt6g(st.ewma, eb, sizeof(eb));
+          Fmt6g(st.rule.clear, tb, sizeof(tb));
+          events_->Record(EventSeverity::kInfo, "slo.recovered",
+                          st.rule.name,
+                          std::string("value=") + vb + " ewma=" + eb +
+                              " clear=" + tb);
+        }
+      }
+    }
+    if (st.breached) ++active;
+  }
+  breaches_.store(active, std::memory_order_relaxed);
+}
+
+}  // namespace fdfs

@@ -1,0 +1,112 @@
+// SLO/alert engine: a compiled-in rule table evaluated once per metrics
+// tick against EWMA-smoothed readings derived from consecutive
+// stats-registry snapshots (metrog.h supplies the cadence and the same
+// snapshots it journals).  Rule transitions emit structured
+// `slo.breach` / `slo.recovered` events into the flight recorder
+// (eventlog.h), so alerts flow through the existing EVENT_DUMP /
+// fdfs_top / SIGUSR1 machinery untouched, and an `slo.breaches_active`
+// gauge makes "is anything red right now" a single registry read.
+//
+// Reference departure: upstream FastDFS renders judgments nowhere — an
+// operator eyeballs fdfs_monitor at the right moment or misses the
+// event.  Here the daemon itself evaluates error rate, request p99,
+// loop lag, dio queue wait, sync lag, scrub health, and disk fill every
+// tick, with hysteresis so a value oscillating around the threshold
+// cannot flap alerts.
+//
+// Anti-flap design: each rule keeps an EWMA (alpha 0.5) of its reading;
+// it BREACHES when the EWMA exceeds `threshold` and RECOVERS only when
+// the EWMA falls to `clear` (strictly below threshold), so one noisy
+// sample neither raises nor clears an alert.  A reading can be
+// unavailable for a tick (metric absent on this role, no traffic in the
+// window) — the rule's state simply carries over.
+//
+// Defaults are compiled in (DefaultRules) and overridable per rule via
+// conf/slo.conf keys `<rule>_threshold`, `<rule>_clear`,
+// `<rule>_enabled` (see LoadRules; the file is named by the daemons'
+// `slo_rules_file` conf key).  The parse is pinned across languages by
+// the `fdfs_codec slo-conf` golden against
+// fastdfs_tpu.monitor.parse_slo_rules.
+//
+// Concurrency: Tick() runs on the owning daemon's main loop only (the
+// metrics timer); the one cross-thread reader is the breaches_active
+// gauge-fn, which reads a plain atomic — no lock, no new rank.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common/eventlog.h"
+#include "common/ini.h"
+#include "common/stats.h"
+
+namespace fdfs {
+
+struct SloRule {
+  std::string name;   // reading id, e.g. "error_rate_pct"
+  double threshold;   // breach when EWMA(reading) > threshold
+  double clear;       // recover when EWMA(reading) <= clear
+  bool enabled = true;
+};
+
+class SloEvaluator {
+ public:
+  // `events` may be null (unit tests); transitions are then state-only.
+  SloEvaluator(std::vector<SloRule> rules, EventLog* events);
+
+  // The compiled-in rule table (thresholds documented in OPERATIONS.md
+  // "Telemetry history, SLOs & heat" with per-rule rationale).
+  static std::vector<SloRule> DefaultRules();
+  // Defaults with conf/slo.conf overrides applied:
+  //   <rule>_threshold = <float>   (clear rescales proportionally when
+  //                                 not itself overridden)
+  //   <rule>_clear     = <float>
+  //   <rule>_enabled   = 0|1
+  static std::vector<SloRule> LoadRules(const IniConfig& ini);
+
+  // Derive rule `name`'s reading from two consecutive snapshots taken
+  // `dt_s` apart.  False when the metric is absent on this daemon or no
+  // traffic crossed the window (the rule then skips this tick).  A p99
+  // landing in a histogram's overflow bucket reads as 2x the last bound
+  // — "worse than the scale measures", which must still breach.
+  static bool ComputeReading(const std::string& name,
+                             const StatsSnapshot& prev,
+                             const StatsSnapshot& cur, double dt_s,
+                             double* out);
+
+  // Evaluate every rule once; emits slo.breach / slo.recovered events
+  // on transitions.  Main-loop only (single caller by contract).
+  void Tick(const StatsSnapshot& prev, const StatsSnapshot& cur,
+            double dt_s);
+
+  int64_t breaches_active() const {
+    return breaches_.load(std::memory_order_relaxed);
+  }
+  int64_t breach_transitions() const {
+    return transitions_.load(std::memory_order_relaxed);
+  }
+  const std::vector<SloRule>& rules() const { return rules_spec_; }
+
+  // Test hooks: per-rule state peek (name -> breached) for the native
+  // hysteresis unit tests.
+  bool IsBreached(const std::string& name) const;
+
+  static constexpr double kAlpha = 0.5;  // EWMA weight of the new sample
+
+ private:
+  struct RuleState {
+    SloRule rule;
+    double ewma = 0;
+    bool have_ewma = false;
+    bool breached = false;
+  };
+  std::vector<RuleState> states_;
+  std::vector<SloRule> rules_spec_;
+  EventLog* events_;
+  std::atomic<int64_t> breaches_{0};
+  std::atomic<int64_t> transitions_{0};
+};
+
+}  // namespace fdfs

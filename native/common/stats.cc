@@ -2,34 +2,11 @@
 
 #include <algorithm>
 
+#include "common/bytes.h"
+
 namespace fdfs {
 
 namespace {
-
-// Registry names are dot/colon-separated identifiers, but the JSON must
-// stay valid even if a hostile peer address sneaks odd bytes into a
-// per-peer gauge name.
-void AppendJsonString(std::string* out, const std::string& s) {
-  out->push_back('"');
-  for (char ch : s) {
-    switch (ch) {
-      case '"': *out += "\\\""; break;
-      case '\\': *out += "\\\\"; break;
-      case '\n': *out += "\\n"; break;
-      case '\r': *out += "\\r"; break;
-      case '\t': *out += "\\t"; break;
-      default:
-        if (static_cast<unsigned char>(ch) < 0x20) {
-          char buf[8];
-          snprintf(buf, sizeof(buf), "\\u%04x", ch & 0xFF);
-          *out += buf;
-        } else {
-          out->push_back(ch);
-        }
-    }
-  }
-  out->push_back('"');
-}
 
 void AppendInt(std::string* out, int64_t v) {
   *out += std::to_string(v);
@@ -172,6 +149,33 @@ std::string StatsRegistry::Json() const {
   }
   out += "}}";
   return out;
+}
+
+void StatsRegistry::Snapshot(StatsSnapshot* out) const {
+  out->counters.clear();
+  out->gauges.clear();
+  out->histograms.clear();
+  std::lock_guard<RankedMutex> lk(mu_);
+  for (const auto& [name, v] : counters_)
+    out->counters[name] = v->load(std::memory_order_relaxed);
+  for (const auto& [name, fn] : gauge_fns_)
+    out->gauges[name] = fn ? fn() : 0;
+  // Plain gauges overwrite same-named gauge-fns — the Json() shadowing
+  // rule, applied second so the plain value wins.
+  for (const auto& [name, v] : gauges_)
+    out->gauges[name] = v->load(std::memory_order_relaxed);
+  for (const auto& [name, h] : histograms_) {
+    StatsSnapshot::Hist hs;
+    hs.bounds = h->bounds();
+    hs.counts.resize(h->bucket_total());
+    hs.count = 0;
+    for (size_t i = 0; i < h->bucket_total(); ++i) {
+      hs.counts[i] = h->bucket_count(i);
+      hs.count += hs.counts[i];
+    }
+    hs.sum = h->sum();
+    out->histograms[name] = std::move(hs);
+  }
 }
 
 std::vector<int64_t> StatsRegistry::LatencyBucketsUs() {

@@ -50,6 +50,25 @@ class StatHistogram {
   std::atomic<int64_t> count_{0};
 };
 
+// Structured point-in-time view of a registry — the metrics journal's
+// input (metrog.h) and the SLO evaluator's reading surface (sloeval.h).
+// Gauge-fns are evaluated into plain values; histogram `count` is
+// DERIVED as the bucket sum so the decode-side invariant
+// sum(counts) == count holds even when the snapshot races concurrent
+// Observe() calls (count_ increments after the bucket, so a raw read
+// pair can disagree by the in-flight observation).
+struct StatsSnapshot {
+  struct Hist {
+    std::vector<int64_t> bounds;
+    std::vector<int64_t> counts;  // bounds.size() + 1, last = overflow
+    int64_t sum = 0;
+    int64_t count = 0;            // == sum of counts by construction
+  };
+  std::map<std::string, int64_t> counters;
+  std::map<std::string, int64_t> gauges;  // plain gauges + gauge-fns merged
+  std::map<std::string, Hist> histograms;
+};
+
 class StatsRegistry {
  public:
   using Value = std::atomic<int64_t>;
@@ -83,6 +102,12 @@ class StatsRegistry {
   // counts has bounds.size()+1 entries (last = overflow); buckets are
   // NON-cumulative (the Prometheus emitter accumulates).
   std::string Json() const;
+
+  // Structured snapshot (same content as Json(), as data): counters,
+  // plain gauges merged with evaluated gauge-fns (a plain gauge
+  // shadowing a gauge-fn of the same name wins, like Json()), and
+  // histogram bucket vectors with count derived from the buckets.
+  void Snapshot(StatsSnapshot* out) const;
 
   // Shared bucket layouts so every latency/size histogram is comparable.
   static std::vector<int64_t> LatencyBucketsUs();   // 100us .. 10s, log-ish

@@ -116,6 +116,32 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
     note("event_buffer_size clamped to 1M");
     event_buffer_size = 1 << 20;
   }
+  metrics_journal_mb = static_cast<int>(
+      ini.GetInt("metrics_journal_mb", metrics_journal_mb));
+  if (metrics_journal_mb < 0) metrics_journal_mb = 0;
+  // METRICS_HISTORY reads both ring files whole before decoding, so the
+  // cap is also a transient dump-memory bound (the decode itself is
+  // bounded at kMaxDecodedSnapshots full registries regardless of ring
+  // size).  256 MB of delta records is weeks of history — far past the
+  // point where `--since` windows, not ring depth, limit a post-mortem.
+  if (metrics_journal_mb > 256) {
+    note("metrics_journal_mb clamped to 256");
+    metrics_journal_mb = 256;
+  }
+  slo_eval_interval_s = static_cast<int>(
+      ini.GetSeconds("slo_eval_interval_s", slo_eval_interval_s));
+  if (slo_eval_interval_s < 0) slo_eval_interval_s = 0;
+  slo_rules_file = ini.GetStr("slo_rules_file", "");
+  heat_top_k = static_cast<int>(ini.GetInt("heat_top_k", heat_top_k));
+  if (heat_top_k < 0) heat_top_k = 0;
+  // heat_top_k is the sketch's PER-STRIPE capacity, and a full stripe
+  // evicts by scanning all its entries under the stripe mutex on the
+  // request path — 1024 keeps that scan a few µs while still tracking
+  // 8K keys per node (8 stripes), 32x the default.
+  if (heat_top_k > 1024) {
+    note("heat_top_k clamped to 1024");
+    heat_top_k = 1024;
+  }
   return true;
 }
 

@@ -93,6 +93,19 @@ struct StorageConfig {
   // of structured cluster events dumped via StorageCmd::EVENT_DUMP and
   // on SIGUSR1 (OPERATIONS.md "Saturation & flight recorder").
   int event_buffer_size = 1024;
+  // Telemetry history + SLOs + heat (OPERATIONS.md "Telemetry history,
+  // SLOs & heat").  metrics_journal_mb: on-disk cap of the metrics
+  // history ring (common/metrog.h) dumped via METRICS_HISTORY; 0
+  // disables journaling.  slo_eval_interval_s: cadence of the journal
+  // tick AND the SLO rule evaluation (common/sloeval.h); 0 disables
+  // both.  slo_rules_file: optional conf/slo.conf-style override of the
+  // compiled-in rule table (empty = defaults).  heat_top_k: tracked
+  // keys per stripe of the hot-file sketch (common/heatsketch.h)
+  // behind HEAT_TOP; 0 disables heat telemetry.
+  int metrics_journal_mb = 8;
+  int slo_eval_interval_s = 5;
+  std::string slo_rules_file;
+  int heat_top_k = 32;
   // Config values Load() silently clamped or corrected — surfaced as
   // "config.anomaly" flight-recorder events at startup so a daemon
   // running on not-what-the-operator-wrote config is diagnosable.

@@ -6,6 +6,7 @@
 #include <sys/sendfile.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/statvfs.h>
 #include <sys/time.h>
 #include <sys/uio.h>
 #include <time.h>
@@ -95,6 +96,45 @@ bool StorageServer::Init(std::string* error) {
       static_cast<size_t>(cfg_.event_buffer_size));
   for (const std::string& a : cfg_.anomalies)
     events_->Record(EventSeverity::kWarn, "config.anomaly", a);
+  // Telemetry history + SLOs + heat (ISSUE 8).  The journal opens (and
+  // recovers its torn tail) before the first tick; a failed open logs
+  // and disables journaling rather than killing the daemon —
+  // observability must never take the data path down with it.
+  if (cfg_.metrics_journal_mb > 0 && cfg_.slo_eval_interval_s > 0) {
+    metrics_ = std::make_unique<MetricsJournal>(
+        cfg_.base_path + "/data/metrics",
+        static_cast<int64_t>(cfg_.metrics_journal_mb) << 20);
+    std::string merr;
+    if (!metrics_->Open(&merr)) {
+      FDFS_LOG_WARN("metrics journal disabled: %s", merr.c_str());
+      events_->Record(EventSeverity::kWarn, "config.anomaly",
+                      "metrics journal disabled", merr);
+      metrics_.reset();
+    }
+  }
+  if (cfg_.slo_eval_interval_s > 0) {
+    std::vector<SloRule> rules;
+    if (!cfg_.slo_rules_file.empty()) {
+      IniConfig slo_ini;
+      std::string serr;
+      if (slo_ini.LoadFile(cfg_.slo_rules_file, &serr)) {
+        rules = SloEvaluator::LoadRules(slo_ini);
+      } else {
+        // A missing/bad override file falls back to defaults LOUDLY: an
+        // operator who wrote rules must not silently run without them.
+        FDFS_LOG_WARN("slo_rules_file %s: %s (using compiled-in defaults)",
+                      cfg_.slo_rules_file.c_str(), serr.c_str());
+        events_->Record(EventSeverity::kWarn, "config.anomaly",
+                        "slo_rules_file unreadable", serr);
+        rules = SloEvaluator::DefaultRules();
+      }
+    } else {
+      rules = SloEvaluator::DefaultRules();
+    }
+    slo_ = std::make_unique<SloEvaluator>(std::move(rules), events_.get());
+  }
+  if (cfg_.heat_top_k > 0)
+    heat_ = std::make_unique<HeatSketch>(cfg_.heat_top_k);
   dedup_ = MakeDedupPlugin(cfg_.dedup_mode, cfg_.base_path, cfg_.dedup_sidecar);
   if (dedup_ != nullptr && cfg_.dedup_chunk_threshold > 0) {
     // Chunk-level dedup: one content-addressed store per store path;
@@ -419,6 +459,11 @@ bool StorageServer::Init(std::string* error) {
   // unlink on delete).  2s granularity against an upload_session_timeout
   // measured in tens of seconds is plenty.
   loop_.AddTimer(2000, [this]() { SweepIngestSessions(); });
+  // Metrics tick: journal one registry snapshot and evaluate the SLO
+  // rule table against the previous tick (both conf-gated above).
+  if (cfg_.slo_eval_interval_s > 0 && (metrics_ != nullptr || slo_ != nullptr))
+    loop_.AddTimer(cfg_.slo_eval_interval_s * 1000,
+                   [this]() { MetricsTick(); });
   // Trunk maintenance (reference: trunk_create_file_advance + the
   // free-block checker driving compaction): keep one trunk file's worth
   // of pre-created free space ahead of demand and reclaim fully-free
@@ -552,6 +597,8 @@ constexpr ServedOp kServedOps[] = {
     {StorageCmd::kFetchChunk, "fetch_chunk"},
     {StorageCmd::kTraceDump, "trace_dump"},
     {StorageCmd::kEventDump, "event_dump"},
+    {StorageCmd::kMetricsHistory, "metrics_history"},
+    {StorageCmd::kHeatTop, "heat_top"},
     {StorageCmd::kScrubStatus, "scrub_status"},
     {StorageCmd::kScrubKick, "scrub_kick"},
     {StorageCmd::kFetchOnePathBinlog, "fetch_one_path_binlog"},
@@ -599,6 +646,43 @@ void StorageServer::InitStatsRegistry() {
   registry_.GaugeFn("events.dropped", [this] {
     return events_ != nullptr ? events_->dropped() : int64_t{0};
   });
+  // SLO engine: how many rules are red right now (the one-read health
+  // check fdfs_top's ALERTS line and scrapers key off).
+  registry_.GaugeFn("slo.breaches_active", [this] {
+    return slo_ != nullptr ? slo_->breaches_active() : int64_t{0};
+  });
+  registry_.GaugeFn("slo.breach_transitions", [this] {
+    return slo_ != nullptr ? slo_->breach_transitions() : int64_t{0};
+  });
+  // Metrics journal health: retained bytes vs the conf cap, and how
+  // many ticks this process has persisted.
+  registry_.GaugeFn("metrics.journal_bytes", [this] {
+    return metrics_ != nullptr ? metrics_->bytes_retained() : int64_t{0};
+  });
+  registry_.GaugeFn("metrics.journal_records", [this] {
+    return metrics_ != nullptr ? metrics_->appended() : int64_t{0};
+  });
+  // Heat sketch health: tracked keys and lifetime touches (the
+  // touches/capacity ratio bounds the sketch's overcount error).
+  registry_.GaugeFn("heat.tracked", [this] {
+    return heat_ != nullptr ? heat_->tracked() : int64_t{0};
+  });
+  registry_.GaugeFn("heat.touches", [this] {
+    return heat_ != nullptr ? heat_->touches() : int64_t{0};
+  });
+  registry_.GaugeFn("heat.evictions", [this] {
+    return heat_ != nullptr ? heat_->evictions() : int64_t{0};
+  });
+  // Fullest store path in percent — the disk_fill_pct SLO rule's input.
+  // The gauge-fn only reads the cache: gauge-fns run UNDER the registry
+  // mutex (Json/Snapshot), and a statvfs against a stalled disk or hung
+  // NFS mount can block for seconds — which would freeze every STAT,
+  // journal tick, and the nio loop serving them, exactly the saturation
+  // this layer exists to diagnose.  RefreshDiskUsedPct runs the real
+  // syscalls at startup, each metrics tick, and each beat.
+  RefreshDiskUsedPct();
+  registry_.GaugeFn("store.disk_used_pct",
+                    [this] { return disk_used_pct_.load(); });
   // Tracing health: ring throughput/overwrite pressure and the slow gate.
   registry_.GaugeFn("trace.spans_recorded", [this] {
     return trace_ != nullptr ? trace_->recorded() : int64_t{0};
@@ -719,32 +803,72 @@ int64_t StorageServer::MaxSyncLagS() const {
   return mx;
 }
 
-std::string StorageServer::BuildStatsJson() {
+void StorageServer::RefreshPeerGauges() {
   // Per-peer replication gauges have dynamic names (peers come and go),
   // so they are plain gauges refreshed at snapshot time — and RETIRED
   // when their peer leaves the group (ISSUE 6 registry hygiene: a
   // long-lived daemon in a churning group must not grow unbounded
   // metric cardinality; nothing caches pointers to these gauges, so
   // pruning by name is safe).
-  if (sync_ != nullptr) {
-    int64_t now = time(nullptr);
-    std::vector<std::string> live;
-    for (const SyncPeerState& s : sync_->States()) {
-      std::string base = "sync.peer." + s.addr;
-      live.push_back(base + ".");
-      registry_.SetGauge(base + ".connected", s.connected ? 1 : 0);
-      registry_.SetGauge(
-          base + ".lag_s",
-          s.synced_ts > 0 && now > s.synced_ts ? now - s.synced_ts : 0);
-      registry_.SetGauge(base + ".records_synced", s.records_synced);
-      registry_.SetGauge(base + ".records_skipped", s.records_skipped);
-    }
-    registry_.PruneGauges("sync.peer.", live);
+  if (sync_ == nullptr) return;
+  int64_t now = time(nullptr);
+  std::vector<std::string> live;
+  for (const SyncPeerState& s : sync_->States()) {
+    std::string base = "sync.peer." + s.addr;
+    live.push_back(base + ".");
+    registry_.SetGauge(base + ".connected", s.connected ? 1 : 0);
+    registry_.SetGauge(
+        base + ".lag_s",
+        s.synced_ts > 0 && now > s.synced_ts ? now - s.synced_ts : 0);
+    registry_.SetGauge(base + ".records_synced", s.records_synced);
+    registry_.SetGauge(base + ".records_skipped", s.records_skipped);
   }
+  registry_.PruneGauges("sync.peer.", live);
+}
+
+std::string StorageServer::BuildStatsJson() {
+  RefreshPeerGauges();
   return registry_.Json();
 }
 
+void StorageServer::RefreshDiskUsedPct() {
+  int64_t worst = 0;
+  for (int i = 0; i < store_.store_path_count(); ++i) {
+    struct statvfs vfs;
+    if (statvfs(store_.store_path(i).c_str(), &vfs) != 0 ||
+        vfs.f_blocks == 0)
+      continue;
+    int64_t pct = static_cast<int64_t>(
+        100.0 * (1.0 - static_cast<double>(vfs.f_bavail) /
+                           static_cast<double>(vfs.f_blocks)));
+    if (pct > worst) worst = pct;
+  }
+  disk_used_pct_.store(worst);
+}
+
+void StorageServer::MetricsTick() {
+  // One snapshot feeds both consumers: what the journal persists IS
+  // what the SLO engine judged, so a post-mortem can re-derive every
+  // breach from the retained history.
+  RefreshDiskUsedPct();
+  RefreshPeerGauges();
+  StatsSnapshot snap;
+  registry_.Snapshot(&snap);
+  int64_t now_mono = MonoUs();
+  if (metrics_ != nullptr) metrics_->Append(TraceWallUs(), snap);
+  if (slo_ != nullptr && have_tick_snap_) {
+    double dt_s = static_cast<double>(now_mono - last_tick_mono_us_) / 1e6;
+    slo_->Tick(last_tick_snap_, snap, dt_s > 0 ? dt_s : 1.0);
+  }
+  last_tick_snap_ = std::move(snap);
+  have_tick_snap_ = true;
+  last_tick_mono_us_ = now_mono;
+}
+
 void StorageServer::FillBeatStats(int64_t* out) {
+  // Beats run on the tracker-client thread: a safe place to refresh
+  // the disk gauge so it stays fresh even with the metrics tick off.
+  RefreshDiskUsedPct();
   for (int i = 0; i < kBeatStatCount; ++i) out[i] = 0;
   stats_.Snapshot(out);  // slots [0, kPersisted)
   out[19] = conn_count_.load();
@@ -1022,9 +1146,25 @@ void StorageServer::Respond(Conn* c, uint8_t status, const std::string& body) {
   if (!c->async_pending) WriteConn(c);
 }
 
+void StorageServer::NoteHeat(Conn* c, HeatOp op, const std::string& key) {
+  if (heat_ == nullptr) return;
+  c->heat_key = key;
+  c->heat_op = static_cast<uint8_t>(op);
+}
+
 void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
   if (c->req_start_us == 0) return;  // one accounting pass per request
   int64_t now_us = MonoUs();
+  // Heat telemetry: one Touch per request at the accounting choke point
+  // (handlers that resolved a file-id stamped heat_key).  Uploads
+  // attribute logical payload bytes; downloads/fetches the bytes served.
+  if (heat_ != nullptr && !c->heat_key.empty()) {
+    HeatOp hop = static_cast<HeatOp>(c->heat_op);
+    int64_t hb = 0;
+    if (status == 0)
+      hb = hop == HeatOp::kUpload ? c->file_size : (bytes > 0 ? bytes : 0);
+    heat_->Touch(c->heat_key, hop, hb, status != 0);
+  }
   // Registry side (always on): per-opcode count/error/latency plus the
   // transfer-size histograms.  Handles are pre-registered atomics —
   // callable from nio loops and dio workers alike.
@@ -1092,6 +1232,8 @@ void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
   c->fp_lock_us = 0;
   c->cswrite_us = 0;
   c->binlog_us = 0;
+  c->heat_key.clear();
+  c->heat_op = 0;
 }
 
 void StorageServer::RecordRequestSpans(Conn* c, uint8_t status,
@@ -1577,6 +1719,50 @@ void StorageServer::OnHeaderComplete(Conn* c) {
       }
       Respond(c, 0, events_->Json("storage", cfg_.port));
       return;
+    case StorageCmd::kMetricsHistory:
+      // Metrics-journal window dump: empty body = everything retained,
+      // 8B body = since-ts (epoch µs).  ENOTSUP when journaling is off
+      // (metrics_journal_mb = 0) so callers can tell "no journal" from
+      // "no history yet".
+      if (c->pkg_len != 0 && c->pkg_len != 8) {
+        CloseConn(c);
+        return;
+      }
+      if (metrics_ == nullptr) {
+        RespondError(c, 95 /*ENOTSUP*/);
+        return;
+      }
+      if (c->pkg_len == 0) {
+        // Reading + delta-decoding up to the whole journal ring is file
+        // I/O plus CPU that scales with metrics_journal_mb — run it on
+        // the dio pool, not this nio loop (a post-mortem query must not
+        // itself spike nio.loop_lag_us).
+        OffloadToDio(c, 0, [this, c] {
+          Respond(c, 0, metrics_->DumpJson("storage", cfg_.port, 0));
+        });
+        return;
+      }
+      c->fixed_need = 8;
+      c->state = ConnState::kRecvFixed;
+      return;
+    case StorageCmd::kHeatTop:
+      // Hot-key top-K dump: empty body = the daemon's heat_top_k,
+      // 8B body = explicit k.  ENOTSUP when the sketch is off.
+      if (c->pkg_len != 0 && c->pkg_len != 8) {
+        CloseConn(c);
+        return;
+      }
+      if (heat_ == nullptr) {
+        RespondError(c, 95 /*ENOTSUP*/);
+        return;
+      }
+      if (c->pkg_len == 0) {
+        Respond(c, 0, heat_->TopJson("storage", cfg_.port, cfg_.heat_top_k));
+        return;
+      }
+      c->fixed_need = 8;
+      c->state = ConnState::kRecvFixed;
+      return;
     case StorageCmd::kScrubStatus: {
       // Integrity-engine status: empty body -> kScrubStatCount BE int64
       // slots (kScrubStatNames).  Atomics + per-store gauge reads only,
@@ -1732,6 +1918,24 @@ void StorageServer::OnFixedComplete(Conn* c) {
       c->pkg_len = 0;
       c->body_consumed = 0;
       c->req_start_us = 0;
+      return;
+    }
+    case StorageCmd::kMetricsHistory: {
+      int64_t since = GetInt64BE(
+          reinterpret_cast<const uint8_t*>(c->fixed.data()));
+      // Journal read + decode off the nio loop, like the empty-body path.
+      OffloadToDio(c, 0, [this, c, since] {
+        Respond(c, 0, metrics_->DumpJson("storage", cfg_.port,
+                                         since < 0 ? 0 : since));
+      });
+      return;
+    }
+    case StorageCmd::kHeatTop: {
+      int64_t k = GetInt64BE(
+          reinterpret_cast<const uint8_t*>(c->fixed.data()));
+      if (k <= 0 || k > 65536) k = cfg_.heat_top_k;
+      Respond(c, 0, heat_->TopJson("storage", cfg_.port,
+                                   static_cast<int>(k)));
       return;
     }
     case StorageCmd::kUploadFile:
@@ -2157,6 +2361,7 @@ void StorageServer::HandleFetchChunk(Conn* c) {
     return;
   }
   std::string remote = c->fixed.substr(base, static_cast<size_t>(name_len));
+  NoteHeat(c, HeatOp::kFetchChunk, group + "/" + remote);
   int spi = 0;
   sscanf(remote.c_str(), "M%02X/", &spi);
   if (spi >= static_cast<int>(chunk_stores_.size())) {
@@ -2612,6 +2817,7 @@ void StorageServer::UploadChunksComplete(Conn* c) {
                      cfg_.group_name + "/" + parts->RemoteFilename());
   stats_.success_upload++;
   stats_.last_source_update = time(nullptr);
+  NoteHeat(c, HeatOp::kUpload, cfg_.group_name + "/" + parts->RemoteFilename());
   Respond(c, 0, PackGroupField(cfg_.group_name) + parts->RemoteFilename());
 }
 
@@ -3218,6 +3424,8 @@ void StorageServer::FinishUpload(Conn* c) {
         NoteTracedMutation(c, parts->RemoteFilename());
         stats_.success_upload++;
         stats_.last_source_update = time(nullptr);
+        NoteHeat(c, HeatOp::kUpload,
+                 cfg_.group_name + "/" + parts->RemoteFilename());
         Respond(c, 0,
                 PackGroupField(cfg_.group_name) + parts->RemoteFilename());
         return;
@@ -3250,6 +3458,8 @@ void StorageServer::FinishUpload(Conn* c) {
           binlog_.Append(kBinlogOpLink, parts->RemoteFilename(),
                          dup->RemoteFilename());
           NoteTracedMutation(c, parts->RemoteFilename());
+          NoteHeat(c, HeatOp::kUpload,
+                   cfg_.group_name + "/" + parts->RemoteFilename());
           Respond(c, 0, PackGroupField(cfg_.group_name) + parts->RemoteFilename());
           return;
         }
@@ -3273,6 +3483,8 @@ void StorageServer::FinishUpload(Conn* c) {
       NoteTracedMutation(c, tparts->RemoteFilename());
       stats_.success_upload++;
       stats_.last_source_update = time(nullptr);
+      NoteHeat(c, HeatOp::kUpload,
+               cfg_.group_name + "/" + tparts->RemoteFilename());
       Respond(c, 0, PackGroupField(cfg_.group_name) + tparts->RemoteFilename());
       return;
     }
@@ -3305,6 +3517,7 @@ void StorageServer::FinishUpload(Conn* c) {
   NoteTracedMutation(c, parts->RemoteFilename());
   stats_.success_upload++;
   stats_.last_source_update = time(nullptr);
+  NoteHeat(c, HeatOp::kUpload, cfg_.group_name + "/" + parts->RemoteFilename());
   Respond(c, 0, PackGroupField(cfg_.group_name) + parts->RemoteFilename());
 }
 
@@ -3530,6 +3743,9 @@ void StorageServer::HandleDownload(Conn* c) {
     Respond(c, 22);
     return;
   }
+  // Heat: every download attempt (including failures — a hot missing
+  // key is an operator signal too) counts against its file-id.
+  NoteHeat(c, HeatOp::kDownload, group + "/" + remote);
   // Trunk files are served out of their slot, not an inode of their own.
   auto tparts = DecodeFileId(group + "/" + remote);
   if (tparts.has_value() && tparts->trunk_loc.has_value()) {
@@ -4185,6 +4401,7 @@ void StorageServer::FinishSlaveUpload(Conn* c) {
   binlog_.Append(kBinlogOpCreate, c->sync_remote);
   stats_.success_upload++;
   stats_.last_source_update = time(nullptr);
+  NoteHeat(c, HeatOp::kUpload, cfg_.group_name + "/" + c->sync_remote);
   Respond(c, 0, PackGroupField(cfg_.group_name) + c->sync_remote);
 }
 

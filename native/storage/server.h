@@ -27,6 +27,9 @@
 #include <vector>
 
 #include "common/eventlog.h"
+#include "common/heatsketch.h"
+#include "common/metrog.h"
+#include "common/sloeval.h"
 #include "common/stats.h"
 #include "common/trace.h"
 #include "common/workers.h"
@@ -234,6 +237,11 @@ class StorageServer {
     int64_t ingest_session = 0;
     int64_t ingest_chunks_total = 0;
     int64_t ingest_chunks_missing = 0;
+    // Hot-key heat telemetry: handlers that resolve a file-id stamp it
+    // here (with the op class) so LogAccess — the accounting choke
+    // point — feeds the heat sketch exactly once per request.
+    std::string heat_key;
+    uint8_t heat_op = 0;  // HeatOp
     // Distributed tracing: context from a TRACE_CTX prefix frame,
     // consumed by the next request (ResetForNextRequest clears it).
     // trace_span is the request's root span id, allocated when the
@@ -295,6 +303,9 @@ class StorageServer {
   // stats registry's per-opcode counters and latency histograms update
   // here regardless of whether the access log is enabled.
   void LogAccess(Conn* c, uint8_t status, int64_t bytes);
+  // Stamp the request's heat-sketch attribution (file-id key + op
+  // class); LogAccess feeds the sketch from it exactly once.
+  void NoteHeat(Conn* c, HeatOp op, const std::string& key);
 
   // -- stats registry (common/stats.h; STAT opcode) ----------------------
   // Pre-register per-opcode counters/histograms and the gauge mirrors of
@@ -309,12 +320,26 @@ class StorageServer {
   // Remember a traced mutation's context keyed by remote filename so
   // the replication sender stitches the sync hop into the same trace.
   void NoteTracedMutation(Conn* c, const std::string& remote);
+  // Refresh the per-peer sync gauges (peers come and go, so these are
+  // plain gauges re-set — and pruned — at snapshot time) ahead of a
+  // STAT serialization or a metrics-journal tick.
+  void RefreshPeerGauges();
   // Refresh snapshot-time gauges (per-peer sync lag) and serialize.
   std::string BuildStatsJson();
+  // Metrics tick (slo_eval_interval_s): snapshot the registry, append
+  // to the metrics journal, and evaluate the SLO rule table against the
+  // previous tick's snapshot (common/metrog.h, common/sloeval.h).
+  void MetricsTick();
   // Beat callback: persisted prefix from stats_, live slots from the
   // registry/subsystems (fills kBeatStatCount slots).
   void FillBeatStats(int64_t* out);
   int64_t MaxSyncLagS() const;
+  // statvfs every store path and cache the fullest-path percentage.
+  // Called at startup, each metrics tick (main loop), and each beat
+  // (tracker-client thread) — NEVER from the store.disk_used_pct
+  // gauge-fn itself: gauge-fns run under the registry mutex on the nio
+  // loop, and statvfs on a stalled mount can block for seconds.
+  void RefreshDiskUsedPct();
 
   // -- dispatch ----------------------------------------------------------
   void OnHeaderComplete(Conn* c);
@@ -451,6 +476,7 @@ class StorageServer {
   size_t next_nio_ = 0;                 // main-loop only (accept)
   std::atomic<int64_t> conn_count_{0};
   std::atomic<int64_t> refused_conn_count_{0};  // over max_connections
+  std::atomic<int64_t> disk_used_pct_{0};       // RefreshDiskUsedPct cache
   // dio pools, one per store path (storage.conf:disk_writer_threads;
   // reference: storage_dio.c per-path reader/writer queues).
   std::vector<std::unique_ptr<WorkerPool>> dio_pools_;
@@ -481,6 +507,19 @@ class StorageServer {
   // replication sender, ingest sessions, the slow gate, and config
   // anomalies.  Created in Init() before every subsystem that records.
   std::unique_ptr<EventLog> events_;
+  // Telemetry history + SLO engine + heat sketch (ISSUE 8): the metrics
+  // journal persists one registry snapshot per tick (METRICS_HISTORY),
+  // the evaluator turns the same snapshots into slo.breach/recovered
+  // flight-recorder events, and the sketch ranks hot file-ids
+  // (HEAT_TOP).  Any may be null (conf-disabled).
+  std::unique_ptr<MetricsJournal> metrics_;
+  std::unique_ptr<SloEvaluator> slo_;
+  std::unique_ptr<HeatSketch> heat_;
+  // Previous tick's snapshot (main-loop only: the tick timer is the
+  // sole reader/writer) — the delta base for SLO readings.
+  StatsSnapshot last_tick_snap_;
+  bool have_tick_snap_ = false;
+  int64_t last_tick_mono_us_ = 0;
   // Saturation telemetry handles (nio loop lag / dio queue health),
   // pre-registered so the per-iteration hook touches only atomics.
   StatHistogram* hist_nio_lag_ = nullptr;

@@ -1,9 +1,12 @@
 // Unit tests for the C++ common layer (no gtest in the image — plain
 // CHECK macros; non-zero exit on failure).
 #include <signal.h>
+#include <stdlib.h>
+#include <sys/stat.h>
 #include <sys/wait.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <cassert>
 #include <cstdio>
@@ -15,10 +18,13 @@
 #include "common/bytes.h"
 #include "common/eventlog.h"
 #include "common/fileid.h"
+#include "common/heatsketch.h"
 #include "common/ini.h"
 #include "common/lockrank.h"
+#include "common/metrog.h"
 #include "common/net.h"
 #include "common/protocol_gen.h"
+#include "common/sloeval.h"
 #include "common/stats.h"
 #include "common/trace.h"
 #include "common/workers.h"
@@ -562,6 +568,321 @@ static int RunLockRankViolation(const char* flag) {
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// Metrics journal (common/metrog.h)
+// ---------------------------------------------------------------------------
+
+static StatsSnapshot MakeSnap(int64_t ops, int64_t errs, int64_t conns,
+                              std::vector<int64_t> lat_counts) {
+  StatsSnapshot s;
+  s.counters["op.upload_file.count"] = ops;
+  s.counters["op.upload_file.errors"] = errs;
+  s.gauges["server.connections"] = conns;
+  StatsSnapshot::Hist h;
+  h.bounds = {100, 1000, 10000};
+  h.counts = std::move(lat_counts);
+  h.count = 0;
+  for (int64_t c : h.counts) h.count += c;
+  h.sum = h.count * 10;
+  s.histograms["op.upload_file.latency_us"] = h;
+  return s;
+}
+
+static void TestMetricsRecordCodec() {
+  // Full -> delta -> delta chain with a new series, a tombstone, and
+  // histogram growth; DecodeBuffer must reconstruct absolutes exactly.
+  StatsSnapshot s1 = MakeSnap(10, 1, 3, {5, 2, 0, 0});
+  s1.gauges["sync.peer.10.0.0.2:23000.lag_s"] = 7;
+  StatsSnapshot s2 = MakeSnap(25, 1, 4, {5, 12, 3, 1});
+  s2.counters["op.download_file.count"] = 9;  // appears mid-stream
+  StatsSnapshot s3 = s2;                       // unchanged tick
+  std::string buf = MetricsJournal::EncodeRecord(nullptr, s1, 111);
+  buf += MetricsJournal::EncodeRecord(&s1, s2, 222);
+  buf += MetricsJournal::EncodeRecord(&s2, s3, 333);
+  size_t valid = 0;
+  auto recs = MetricsJournal::DecodeBuffer(buf, &valid);
+  CHECK_EQ(valid, buf.size());
+  CHECK_EQ(recs.size(), 3u);
+  CHECK_EQ(recs[0].first, 111);
+  CHECK(recs[0].second.counters == s1.counters);
+  CHECK(recs[0].second.gauges == s1.gauges);
+  CHECK(recs[1].second.counters == s2.counters);
+  // the pruned peer gauge died with the delta's tombstone
+  CHECK_EQ(recs[1].second.gauges.count("sync.peer.10.0.0.2:23000.lag_s"), 0u);
+  CHECK_EQ(recs[1].second.histograms["op.upload_file.latency_us"].count, 21);
+  CHECK_EQ(recs[1].second.histograms["op.upload_file.latency_us"].counts[1],
+           12);
+  CHECK(recs[2].second.counters == s3.counters);
+
+  // Torn tail: any truncation point inside the last frame drops exactly
+  // that record and keeps the prefix.
+  std::string torn = buf.substr(0, buf.size() - 3);
+  auto recs2 = MetricsJournal::DecodeBuffer(torn, &valid);
+  CHECK_EQ(recs2.size(), 2u);
+  CHECK(valid < torn.size());
+  // Corrupt one payload byte of the middle record: CRC rejects it and
+  // the scan stops there (a delta chain cannot skip records).
+  std::string flip = buf;
+  size_t first_len = MetricsJournal::EncodeRecord(nullptr, s1, 111).size();
+  flip[first_len + 20] ^= 0x5A;
+  auto recs3 = MetricsJournal::DecodeBuffer(flip, &valid);
+  CHECK_EQ(recs3.size(), 1u);
+
+  // Retention cap: only the NEWEST max_records snapshots are kept, the
+  // whole buffer still scans (valid covers every frame), and the
+  // survivors are exact absolutes even though their delta bases were
+  // dropped from the result.
+  auto recs4 = MetricsJournal::DecodeBuffer(buf, &valid, 2);
+  CHECK_EQ(valid, buf.size());
+  CHECK_EQ(recs4.size(), 2u);
+  CHECK_EQ(recs4[0].first, 222);
+  CHECK_EQ(recs4[1].first, 333);
+  CHECK(recs4[0].second.counters == s2.counters);
+  CHECK(recs4[1].second.counters == s3.counters);
+}
+
+static void TestMetricsJournalDiskAndTornTail() {
+  char tmpl[] = "/tmp/fdfs_metrog_XXXXXX";
+  CHECK(mkdtemp(tmpl) != nullptr);
+  std::string dir = tmpl;
+  std::string err;
+  {
+    MetricsJournal j(dir, 1 << 20);
+    CHECK(j.Open(&err));
+    for (int i = 1; i <= 5; ++i)
+      j.Append(1000 + i, MakeSnap(i * 10, i, i, {static_cast<int64_t>(i),
+                                                 0, 0, 0}));
+    CHECK_EQ(j.appended(), 5);
+    auto recs = j.Decode(0);
+    CHECK_EQ(recs.size(), 5u);
+    CHECK_EQ(recs[4].second.counters["op.upload_file.count"], 50);
+    // since-filter: only the records at/after the cut
+    CHECK_EQ(j.Decode(1004).size(), 2u);
+  }
+  // kill -9 analogue: chop bytes off the journal tail, reopen, and the
+  // intact prefix must survive while appends keep working.
+  std::string path = dir + "/metrics.mj";
+  struct stat st;
+  CHECK_EQ(stat(path.c_str(), &st), 0);
+  CHECK_EQ(truncate(path.c_str(), st.st_size - 5), 0);
+  {
+    MetricsJournal j(dir, 1 << 20);
+    CHECK(j.Open(&err));
+    CHECK(j.recovered_bytes() > 0);
+    auto recs = j.Decode(0);
+    CHECK_EQ(recs.size(), 4u);  // the torn record is gone, prefix intact
+    CHECK_EQ(recs[3].second.counters["op.upload_file.count"], 40);
+    // post-recovery appends start with a fresh full record
+    j.Append(2000, MakeSnap(99, 9, 9, {1, 1, 1, 1}));
+    auto recs2 = j.Decode(0);
+    CHECK_EQ(recs2.size(), 5u);
+    CHECK_EQ(recs2[4].second.counters["op.upload_file.count"], 99);
+  }
+  // Rotation: a tiny cap (clamped to 64 KB; rotate past 32 KB) with fat
+  // records must rotate without losing decodability, and total retained
+  // bytes must stay near the cap.
+  {
+    std::string dir2 = dir + "/rot";
+    MetricsJournal j(dir2, 1);  // clamps to 64 KB
+    CHECK(j.Open(&err));
+    for (int tick = 0; tick < 6; ++tick) {
+      StatsSnapshot s;
+      for (int k = 0; k < 3000; ++k)
+        s.gauges["g." + std::to_string(k)] = tick * 3000 + k;
+      j.Append(5000 + tick, s);
+    }
+    auto recs = j.Decode(0);
+    CHECK(!recs.empty());
+    CHECK_EQ(recs.back().first, 5005);
+    CHECK_EQ(recs.back().second.gauges.at("g.2999"), 5 * 3000 + 2999);
+    CHECK(j.bytes_retained() <= (128 << 10));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// SLO evaluator (common/sloeval.h)
+// ---------------------------------------------------------------------------
+
+static void TestSloReadings() {
+  StatsSnapshot prev = MakeSnap(100, 0, 3, {10, 0, 0, 0});
+  StatsSnapshot cur = MakeSnap(200, 10, 3, {10, 0, 99, 1});
+  double v = 0;
+  CHECK(SloEvaluator::ComputeReading("error_rate_pct", prev, cur, 1.0, &v));
+  CHECK_EQ(static_cast<int64_t>(v), 10);  // 10 errors / 100 ops
+  CHECK(SloEvaluator::ComputeReading("request_p99_ms", prev, cur, 1.0, &v));
+  CHECK_EQ(static_cast<int64_t>(v * 1000), 10000);  // p99 bucket <=10000us
+  // Overflow mass reads as 2x the last bound — still a breach signal.
+  StatsSnapshot over = MakeSnap(300, 10, 3, {10, 0, 99, 50});
+  CHECK(SloEvaluator::ComputeReading("request_p99_ms", cur, over, 1.0, &v));
+  CHECK_EQ(static_cast<int64_t>(v * 1000), 20000);
+  // No traffic in the window: the reading is unavailable, not zero.
+  CHECK(!SloEvaluator::ComputeReading("error_rate_pct", cur, cur, 1.0, &v));
+  // Gauge rules read current levels.
+  cur.gauges["scrub.corrupt_unrepairable"] = 2;
+  CHECK(SloEvaluator::ComputeReading("scrub_unrepairable", prev, cur, 1, &v));
+  CHECK_EQ(static_cast<int64_t>(v), 2);
+  CHECK(!SloEvaluator::ComputeReading("disk_fill_pct", prev, cur, 1, &v));
+}
+
+static void TestSloHysteresis() {
+  EventLog log(32);
+  SloEvaluator slo({{"error_rate_pct", 5.0, 2.5, true}}, &log);
+  auto snap_at = [](int64_t ops, int64_t errs) {
+    StatsSnapshot s;
+    s.counters["op.x.count"] = ops;
+    s.counters["op.x.errors"] = errs;
+    return s;
+  };
+  StatsSnapshot a = snap_at(0, 0), b = snap_at(100, 50);
+  slo.Tick(a, b, 1.0);  // reading 50% -> ewma 50 -> breach
+  CHECK(slo.IsBreached("error_rate_pct"));
+  CHECK_EQ(slo.breaches_active(), 1);
+  CHECK_EQ(slo.breach_transitions(), 1);
+  // One clean tick must NOT clear it (ewma 25 > clear 2.5): no flap.
+  StatsSnapshot c = snap_at(200, 50);
+  slo.Tick(b, c, 1.0);
+  CHECK(slo.IsBreached("error_rate_pct"));
+  // Sustained clean traffic decays the EWMA below clear -> recovered.
+  StatsSnapshot last = c;
+  for (int i = 0; i < 5; ++i) {
+    StatsSnapshot next = last;
+    next.counters["op.x.count"] += 100;
+    slo.Tick(last, next, 1.0);
+    last = next;
+  }
+  CHECK(!slo.IsBreached("error_rate_pct"));
+  CHECK_EQ(slo.breaches_active(), 0);
+  // Exactly one breach + one recovered event, in order.
+  std::string dump = log.Json("storage", 1);
+  CHECK(dump.find("slo.breach") != std::string::npos);
+  CHECK(dump.find("slo.recovered") != std::string::npos);
+  CHECK_EQ(log.recorded(), 2);
+}
+
+static void TestSloRuleOverrides() {
+  IniConfig ini;
+  std::string err;
+  CHECK(ini.LoadString("error_rate_pct_threshold = 1.0\n"
+                       "request_p99_ms_enabled = 0\n"
+                       "disk_fill_pct_threshold = 70\n"
+                       "disk_fill_pct_clear = 60\n",
+                       &err));
+  auto rules = SloEvaluator::LoadRules(ini);
+  bool saw_err = false, saw_p99 = false, saw_disk = false;
+  for (const SloRule& r : rules) {
+    if (r.name == "error_rate_pct") {
+      saw_err = true;
+      CHECK_EQ(static_cast<int64_t>(r.threshold * 10), 10);
+      // clear rescaled proportionally (default 5/2.5 -> 1/0.5)
+      CHECK_EQ(static_cast<int64_t>(r.clear * 10), 5);
+    }
+    if (r.name == "request_p99_ms") {
+      saw_p99 = true;
+      CHECK(!r.enabled);
+    }
+    if (r.name == "disk_fill_pct") {
+      saw_disk = true;
+      CHECK_EQ(static_cast<int64_t>(r.threshold), 70);
+      CHECK_EQ(static_cast<int64_t>(r.clear), 60);
+    }
+  }
+  CHECK(saw_err && saw_p99 && saw_disk);
+}
+
+// ---------------------------------------------------------------------------
+// Heat sketch (common/heatsketch.h)
+// ---------------------------------------------------------------------------
+
+static void TestHeatSketchExactWhenUnderCapacity() {
+  // Below capacity the sketch IS exact: counts, bytes, per-op splits,
+  // zero error bound.
+  HeatSketch sketch(8, 1);
+  for (int i = 0; i < 7; ++i) sketch.Touch("hot", HeatOp::kDownload, 10, false);
+  sketch.Touch("hot", HeatOp::kUpload, 100, false);
+  sketch.Touch("warm", HeatOp::kDownload, 5, false);
+  sketch.Touch("warm", HeatOp::kDownload, 0, true);  // one error
+  auto top = sketch.Top(2);
+  CHECK_EQ(top.size(), 2u);
+  CHECK_EQ(top[0].key, std::string("hot"));
+  CHECK_EQ(top[0].hits, 8);
+  CHECK_EQ(top[0].err_bound, 0);
+  CHECK_EQ(top[0].bytes, 170);
+  CHECK_EQ(top[0].op_count[0], 7);
+  CHECK_EQ(top[0].op_count[1], 1);
+  CHECK_EQ(top[1].key, std::string("warm"));
+  CHECK_EQ(top[1].hits, 2);
+  CHECK_EQ(top[1].err, 1);
+  // JSON shape smoke (full decode parity lives in the codec golden)
+  std::string js = sketch.TopJson("storage", 23000, 1);
+  CHECK(js.find("\"entries\":[{\"key\":\"hot\"") != std::string::npos);
+  CHECK(js.find("\"download\":{\"count\":7,\"bytes\":70}") !=
+        std::string::npos);
+}
+
+static void TestHeatSketchAccuracy() {
+  // Zipf-ish synthetic under real eviction pressure: a 64-key universe
+  // against 16x4 tracked slots.  The space-saving theorems must hold:
+  // hits is an overcount bounded by err_bound (hits >= true >=
+  // hits - err_bound), the true hottest key ranks first, and the exact
+  // top-5 surfaces in the sketch's top-5 (the acceptance bar the live
+  // test applies to HEAT_TOP under load_cli --zipf).
+  HeatSketch sketch(16, 4);
+  std::vector<int64_t> truth(64);
+  for (int i = 0; i < 64; ++i) truth[i] = 1000 / (i + 1);
+  // interleave rounds so eviction pressure is realistic, not sorted
+  for (int round = 0; round < 1000; ++round)
+    for (int i = 0; i < 64; ++i)
+      if (round < truth[i])
+        sketch.Touch("group1/M00/k" + std::to_string(i), HeatOp::kDownload,
+                     100, false);
+  int64_t total = 0;
+  for (int64_t t : truth) total += t;
+  CHECK_EQ(sketch.touches(), total);
+  auto top = sketch.Top(5);
+  CHECK_EQ(top.size(), 5u);
+  std::vector<std::string> top_keys;
+  for (const auto& e : top) top_keys.push_back(e.key);
+  for (int i = 0; i < 5; ++i) {
+    // exact top-5 ⊆ sketch top-5 (both are 5 long, so sets match)
+    std::string want = "group1/M00/k" + std::to_string(i);
+    CHECK(std::find(top_keys.begin(), top_keys.end(), want) !=
+          top_keys.end());
+  }
+  CHECK_EQ(top[0].key, std::string("group1/M00/k0"));
+  for (const auto& e : top) {
+    int idx = atoi(e.key.c_str() + strlen("group1/M00/k"));
+    CHECK(e.hits >= truth[idx]);                  // never undercounts
+    CHECK(e.hits - e.err_bound <= truth[idx]);    // honest error bound
+  }
+}
+
+static void TestHeatSketchThreaded() {
+  // TSan target: concurrent touchers on overlapping keys + a Top reader.
+  HeatSketch sketch(32, 4);
+  std::atomic<bool> stop{false};
+  std::thread reader([&] {
+    while (!stop.load()) (void)sketch.TopJson("storage", 1, 8);
+  });
+  std::vector<std::thread> writers;
+  for (int t = 0; t < 4; ++t) {
+    writers.emplace_back([&sketch, t] {
+      for (int i = 0; i < 20000; ++i)
+        sketch.Touch("k" + std::to_string((i * (t + 1)) % 97),
+                     static_cast<HeatOp>(i % kHeatOpCount), i % 512,
+                     i % 50 == 0);
+    });
+  }
+  for (auto& w : writers) w.join();
+  stop.store(true);
+  reader.join();
+  CHECK_EQ(sketch.touches(), 4 * 20000);
+  auto top = sketch.Top(0);
+  CHECK(!top.empty());
+  int64_t hits = 0;
+  for (const auto& e : top) hits += e.hits;
+  CHECK(hits >= 4 * 20000 / 2);  // bounded undercount from evictions only
+}
+
 int main(int argc, char** argv) {
   if (argc > 1 && std::strncmp(argv[1], "--lockrank-", 11) == 0)
     return RunLockRankViolation(argv[1]);
@@ -587,6 +908,14 @@ int main(int argc, char** argv) {
   TestRankedMutex();
   TestRankedMutexThreaded();
   TestRankedMutexInversionAborts(argv[0]);
+  TestMetricsRecordCodec();
+  TestMetricsJournalDiskAndTornTail();
+  TestSloReadings();
+  TestSloHysteresis();
+  TestSloRuleOverrides();
+  TestHeatSketchExactWhenUnderCapacity();
+  TestHeatSketchAccuracy();
+  TestHeatSketchThreaded();
   if (g_failures == 0) {
     std::printf("common_test: ALL PASS\n");
     return 0;

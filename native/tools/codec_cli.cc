@@ -25,6 +25,17 @@
 //   fdfs_codec scrub-status    (golden SCRUB_STATUS blob: fixture value
 //                per kScrubStatNames slot + the hex wire encoding,
 //                compared field-for-field against the Python decoder)
+//   fdfs_codec metrics-history (golden METRICS_HISTORY dump: fixed
+//                snapshots encoded through the journal's full/delta
+//                record codec, decoded back, and emitted as the wire
+//                JSON — line 2 reports the binary roundtrip verdict)
+//   fdfs_codec heat-top        (golden HEAT_TOP dump: a fixed Touch
+//                sequence through the space-saving sketch -> JSON,
+//                compared field-for-field against the Python decoder)
+//   fdfs_codec slo-conf        (stdin = slo.conf text; prints the
+//                normalized rule table "name threshold clear enabled"
+//                — pins conf/slo.conf parsing across languages against
+//                fastdfs_tpu.monitor.parse_slo_rules)
 #include <time.h>
 
 #include <cstdio>
@@ -37,8 +48,12 @@
 #include "common/cdc.h"
 #include "common/eventlog.h"
 #include "common/fileid.h"
+#include "common/heatsketch.h"
 #include "common/http_token.h"
+#include "common/ini.h"
+#include "common/metrog.h"
 #include "common/protocol_gen.h"
+#include "common/sloeval.h"
 #include "common/stats.h"
 #include "common/trace.h"
 
@@ -353,6 +368,94 @@ int main(int argc, char** argv) {
       hex.push_back(kHex[ch & 0xF]);
     }
     printf("blob=%s\n", hex.c_str());
+    return 0;
+  }
+  if (cmd == "metrics-history") {
+    // Fixed fixture — tests/test_report.py decodes line 1 with
+    // fastdfs_tpu.monitor.decode_metrics_history and asserts every
+    // field, pinning the METRICS_HISTORY wire contract.  The fixture
+    // deliberately exercises the journal's whole delta vocabulary:
+    // value deltas, a NEW series appearing mid-stream, a pruned gauge
+    // (tombstone), and histogram bucket growth.
+    StatsSnapshot s1;
+    s1.counters["op.upload_file.count"] = 10;
+    s1.counters["op.upload_file.errors"] = 1;
+    s1.gauges["server.connections"] = 3;
+    s1.gauges["sync.peer.10.0.0.2:23000.lag_s"] = 7;
+    StatsSnapshot::Hist h;
+    h.bounds = {100, 1000, 10000};
+    h.counts = {5, 2, 0, 0};
+    h.sum = 900;
+    h.count = 7;
+    s1.histograms["op.upload_file.latency_us"] = h;
+
+    StatsSnapshot s2 = s1;
+    s2.counters["op.upload_file.count"] = 25;
+    s2.counters["op.download_file.count"] = 4;  // new series
+    s2.gauges.erase("sync.peer.10.0.0.2:23000.lag_s");  // pruned peer
+    s2.histograms["op.upload_file.latency_us"].counts = {5, 12, 3, 1};
+    s2.histograms["op.upload_file.latency_us"].sum = 31337;
+    s2.histograms["op.upload_file.latency_us"].count = 21;
+
+    StatsSnapshot s3 = s2;
+    s3.gauges["server.connections"] = 0;
+
+    std::vector<std::pair<int64_t, StatsSnapshot>> snaps = {
+        {1700000000000000LL, s1},
+        {1700000005000000LL, s2},
+        {1700000010000000LL, s3},
+    };
+    std::string buf;
+    const StatsSnapshot* prev = nullptr;
+    for (const auto& [ts, s] : snaps) {
+      buf += MetricsJournal::EncodeRecord(prev, s, ts);
+      prev = &s;
+    }
+    size_t valid = 0;
+    auto back = MetricsJournal::DecodeBuffer(buf, &valid);
+    bool roundtrip = valid == buf.size() && back.size() == snaps.size();
+    for (size_t i = 0; roundtrip && i < snaps.size(); ++i) {
+      roundtrip = back[i].first == snaps[i].first &&
+                  back[i].second.counters == snaps[i].second.counters &&
+                  back[i].second.gauges == snaps[i].second.gauges;
+    }
+    printf("%s\n",
+           MetricsJournal::SnapshotsJson("storage", 23000, back).c_str());
+    printf("roundtrip=%d\n", roundtrip ? 1 : 0);
+    return roundtrip ? 0 : 1;
+  }
+  if (cmd == "heat-top") {
+    // Fixed fixture — tests/test_report.py decodes this with
+    // fastdfs_tpu.monitor.decode_heat and asserts ranking + per-op
+    // splits, pinning the HEAT_TOP wire contract.
+    HeatSketch sketch(8, 2);
+    const char* hot = "group1/M00/00/01/hotfile.bin";
+    const char* warm = "group1/M00/00/02/warmfile.bin";
+    const char* cold = "group1/M00/00/03/coldfile.bin";
+    for (int i = 0; i < 9; ++i)
+      sketch.Touch(hot, HeatOp::kDownload, 4096, false);
+    sketch.Touch(hot, HeatOp::kUpload, 8192, false);
+    for (int i = 0; i < 4; ++i)
+      sketch.Touch(warm, HeatOp::kDownload, 1024, false);
+    sketch.Touch(warm, HeatOp::kFetchChunk, 512, false);
+    sketch.Touch(cold, HeatOp::kDownload, 0, true);  // one failed read
+    printf("%s\n", sketch.TopJson("storage", 23000, 3).c_str());
+    return 0;
+  }
+  if (cmd == "slo-conf") {
+    // stdin = slo.conf text; output = the normalized rule table the
+    // daemons will actually run.  tests/test_report.py parses the same
+    // text with fastdfs_tpu.monitor.parse_slo_rules and compares line
+    // for line — threshold/clear rescaling and enable flags included.
+    IniConfig ini;
+    std::string err;
+    if (!ini.LoadString(ReadStdin(), &err)) {
+      fprintf(stderr, "bad slo conf: %s\n", err.c_str());
+      return 1;
+    }
+    for (const SloRule& r : SloEvaluator::LoadRules(ini))
+      printf("%s %.6g %.6g %d\n", r.name.c_str(), r.threshold, r.clear,
+             r.enabled ? 1 : 0);
     return 0;
   }
   if (cmd == "b64e" && argc == 3) {

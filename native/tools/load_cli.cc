@@ -13,11 +13,24 @@
 //   fdfs_load upload   <tracker ip:port> <n_ops> <size> <threads> <result>
 //                      [unique_payloads]   (0/absent = every op unique)
 //   fdfs_load download <tracker ip:port> <ids_file> <n_ops> <threads> <result>
+//                      [--zipf <s> [--zipf-keys N] [--zipf-seed S]]
 //   fdfs_load delete   <tracker ip:port> <ids_file> <threads> <result>
 //   fdfs_load combine  <result files...>     (prints one JSON line)
+//   fdfs_load zipf-sample <s> <keys> <n> [seed]   (prints n key indices,
+//                      one per line — the sampler the download mode
+//                      uses, exposed for deterministic unit tests)
 //
 // `upload` also appends the minted file ids to <result>.ids for the
 // download/delete phases.
+//
+// --zipf <s>: key-popularity mode for downloads (ISSUE 8 / ROADMAP
+// item 2's load harness seed).  Instead of round-robin over the ids
+// file, op i fetches the id Zipf(s) picks over a bounded key universe
+// (--zipf-keys, default min(1000, #ids); rank 1 = the FIRST id in the
+// file, weight 1/rank^s).  Sampling is keyed on the op index with a
+// fixed seed (--zipf-seed, default 42), so a run is DETERMINISTIC
+// regardless of thread count or interleaving — the heat-sketch
+// acceptance test replays the exact same skew every time.
 #include <stdio.h>
 #include <string.h>
 #include <time.h>
@@ -25,9 +38,11 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <fstream>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -154,6 +169,43 @@ bool QueryFetch(Peer* tracker, uint8_t cmd, const std::string& file_id,
   return true;
 }
 
+// Zipf(s) sampler over key ranks [0, n): rank r carries weight
+// 1/(r+1)^s.  Pick(i) hashes the op index through splitmix64 with a
+// fixed seed, so the i-th operation of a run always fetches the same
+// key — deterministic skew independent of thread scheduling.
+class ZipfPicker {
+ public:
+  ZipfPicker(double s, size_t n, uint64_t seed) : seed_(seed) {
+    cdf_.resize(n == 0 ? 1 : n);
+    double acc = 0;
+    for (size_t r = 0; r < cdf_.size(); ++r) {
+      acc += 1.0 / std::pow(static_cast<double>(r + 1), s);
+      cdf_[r] = acc;
+    }
+    total_ = acc;
+  }
+  size_t Pick(int64_t i) const {
+    uint64_t x = seed_ + 0x9E3779B97F4A7C15ULL * (static_cast<uint64_t>(i) + 1);
+    x ^= x >> 30;
+    x *= 0xBF58476D1CE4E5B9ULL;
+    x ^= x >> 27;
+    x *= 0x94D049BB133111EBULL;
+    x ^= x >> 31;
+    // 53-bit mantissa -> u in [0, total): never exactly total, so
+    // lower_bound always lands inside the table.
+    double u = static_cast<double>(x >> 11) *
+               (1.0 / 9007199254740992.0) * total_;
+    return static_cast<size_t>(
+        std::lower_bound(cdf_.begin(), cdf_.end(), u) - cdf_.begin());
+  }
+  size_t keys() const { return cdf_.size(); }
+
+ private:
+  uint64_t seed_;
+  std::vector<double> cdf_;
+  double total_ = 0;
+};
+
 struct Shared {
   std::string tracker_host;
   int tracker_port = 0;
@@ -162,6 +214,7 @@ struct Shared {
   int64_t size = 0;
   int64_t unique = 0;  // 0 = every payload unique
   std::vector<std::string> ids;  // download/delete input
+  std::unique_ptr<ZipfPicker> zipf;  // download key-popularity mode
   RankedMutex out_mu{LockRank::kToolOutput};
   std::vector<OpRecord> records;
 };
@@ -250,7 +303,10 @@ void DownloadWorker(Shared* sh) {
   for (;;) {
     int64_t i = sh->next.fetch_add(1);
     if (i >= sh->n_ops) break;
-    const std::string& fid = sh->ids[i % sh->ids.size()];
+    const std::string& fid =
+        sh->zipf != nullptr
+            ? sh->ids[sh->zipf->Pick(i) % sh->ids.size()]
+            : sh->ids[i % sh->ids.size()];
     OpRecord rec{MonoUs(), 0, -1, 0, fid};
     std::string ip;
     int port = 0;
@@ -411,11 +467,26 @@ int Combine(int argc, char** argv) {
 
 int main(int argc, char** argv) {
   if (argc < 2) {
-    fprintf(stderr, "usage: fdfs_load upload|download|delete|combine ...\n");
+    fprintf(stderr,
+            "usage: fdfs_load upload|download|delete|combine|zipf-sample ...\n");
     return 2;
   }
   std::string mode = argv[1];
   if (mode == "combine") return Combine(argc - 2, argv + 2);
+  if (mode == "zipf-sample" && (argc == 5 || argc == 6)) {
+    double s = atof(argv[2]);
+    int64_t keys = atoll(argv[3]);
+    int64_t n = atoll(argv[4]);
+    uint64_t seed = argc == 6 ? strtoull(argv[5], nullptr, 10) : 42;
+    if (s <= 0 || keys <= 0 || n <= 0) {
+      fprintf(stderr, "zipf-sample: s, keys, n must be positive\n");
+      return 2;
+    }
+    ZipfPicker picker(s, static_cast<size_t>(keys), seed);
+    for (int64_t i = 0; i < n; ++i)
+      printf("%zu\n", picker.Pick(i));
+    return 0;
+  }
 
   Shared sh;
   if (mode == "upload" && argc >= 7) {
@@ -435,6 +506,40 @@ int main(int argc, char** argv) {
     }
     sh.n_ops = atoll(argv[4]);
     int threads = atoi(argv[5]);
+    // Optional key-popularity mode: --zipf <s> [--zipf-keys N]
+    // [--zipf-seed S] after the positional args.
+    double zipf_s = 0;
+    int64_t zipf_keys = 0;
+    uint64_t zipf_seed = 42;
+    for (int a = 7; a < argc; ++a) {
+      std::string flag = argv[a];
+      if (flag == "--zipf" && a + 1 < argc) {
+        // A bad exponent must be an ERROR, not a silent fall-through to
+        // round-robin: this flag exists to measure skew, and "measured
+        // unskewed traffic believing it was zipfian" poisons the
+        // harness verdicts downstream.
+        char* end = nullptr;
+        zipf_s = strtod(argv[++a], &end);
+        if (end == argv[a] || zipf_s <= 0) {
+          fprintf(stderr, "--zipf wants a positive exponent, got %s\n",
+                  argv[a]);
+          return 2;
+        }
+      } else if (flag == "--zipf-keys" && a + 1 < argc) {
+        zipf_keys = atoll(argv[++a]);
+      } else if (flag == "--zipf-seed" && a + 1 < argc) {
+        zipf_seed = strtoull(argv[++a], nullptr, 10);
+      } else {
+        fprintf(stderr, "bad download flag %s\n", flag.c_str());
+        return 2;
+      }
+    }
+    if (zipf_s > 0) {
+      size_t universe = static_cast<size_t>(
+          zipf_keys > 0 ? zipf_keys : std::min<int64_t>(1000, sh.ids.size()));
+      if (universe > sh.ids.size()) universe = sh.ids.size();
+      sh.zipf = std::make_unique<ZipfPicker>(zipf_s, universe, zipf_seed);
+    }
     RunWorkers(&sh, threads, DownloadWorker);
     return WriteResults(sh, argv[6], /*with_ids=*/false) ? 0 : 1;
   }

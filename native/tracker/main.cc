@@ -67,6 +67,20 @@ int main(int argc, char** argv) {
   if (ebs < 16) ebs = 16;
   if (ebs > (1 << 20)) ebs = 1 << 20;
   cfg.event_buffer_size = static_cast<int>(ebs);
+  // Telemetry history + SLO evaluation.  Much tighter cap than the
+  // storage loader's: the tracker serves METRICS_HISTORY inline on its
+  // single event loop (no dio pool to offload to), so one dump's
+  // whole-ring read + CRC scan must never stall beats and routing
+  // queries for more than a few tens of ms — and the tracker registry
+  // is tiny, so 16 MB of delta records already holds weeks of history.
+  cfg.metrics_journal_mb = static_cast<int>(
+      ini.GetInt("metrics_journal_mb", cfg.metrics_journal_mb));
+  if (cfg.metrics_journal_mb < 0) cfg.metrics_journal_mb = 0;
+  if (cfg.metrics_journal_mb > 16) cfg.metrics_journal_mb = 16;
+  cfg.slo_eval_interval_s = static_cast<int>(
+      ini.GetSeconds("slo_eval_interval_s", cfg.slo_eval_interval_s));
+  if (cfg.slo_eval_interval_s < 0) cfg.slo_eval_interval_s = 0;
+  cfg.slo_rules_file = ini.GetStr("slo_rules_file", "");
   if (cfg.base_path.empty()) {
     std::fprintf(stderr, "config error: base_path is required\n");
     return 1;

@@ -110,6 +110,50 @@ bool TrackerServer::Init(std::string* error) {
                                        cfg_.use_trunk_file);
   cluster_->set_events(events_.get());
 
+  // Telemetry history + SLOs (ISSUE 8): the same journal/evaluator pair
+  // the storage daemon runs, minus the storage-only rules (their
+  // readings are simply absent from this registry, so they never fire).
+  if (cfg_.metrics_journal_mb > 0 && cfg_.slo_eval_interval_s > 0) {
+    metrics_ = std::make_unique<MetricsJournal>(
+        cfg_.base_path + "/data/metrics",
+        static_cast<int64_t>(cfg_.metrics_journal_mb) << 20);
+    std::string merr;
+    if (!metrics_->Open(&merr)) {
+      FDFS_LOG_WARN("metrics journal disabled: %s", merr.c_str());
+      events_->Record(EventSeverity::kWarn, "config.anomaly",
+                      "metrics journal disabled", merr);
+      metrics_.reset();
+    }
+  }
+  if (cfg_.slo_eval_interval_s > 0) {
+    std::vector<SloRule> rules;
+    if (!cfg_.slo_rules_file.empty()) {
+      IniConfig slo_ini;
+      std::string serr;
+      if (slo_ini.LoadFile(cfg_.slo_rules_file, &serr)) {
+        rules = SloEvaluator::LoadRules(slo_ini);
+      } else {
+        FDFS_LOG_WARN("slo_rules_file %s: %s (using compiled-in defaults)",
+                      cfg_.slo_rules_file.c_str(), serr.c_str());
+        events_->Record(EventSeverity::kWarn, "config.anomaly",
+                        "slo_rules_file unreadable", serr);
+        rules = SloEvaluator::DefaultRules();
+      }
+    } else {
+      rules = SloEvaluator::DefaultRules();
+    }
+    slo_ = std::make_unique<SloEvaluator>(std::move(rules), events_.get());
+  }
+  registry_.GaugeFn("slo.breaches_active", [this] {
+    return slo_ != nullptr ? slo_->breaches_active() : int64_t{0};
+  });
+  registry_.GaugeFn("metrics.journal_bytes", [this] {
+    return metrics_ != nullptr ? metrics_->bytes_retained() : int64_t{0};
+  });
+  registry_.GaugeFn("metrics.journal_records", [this] {
+    return metrics_ != nullptr ? metrics_->appended() : int64_t{0};
+  });
+
   // Saturation telemetry (ISSUE 6): the tracker's single nio loop is
   // the whole daemon — a slow handler here stalls every beat and every
   // routing query in the cluster.  Same registry contract as the
@@ -214,6 +258,9 @@ bool TrackerServer::Init(std::string* error) {
   loop_.AddTimer(1000, [this]() {
     cluster_->CheckAlive(time(nullptr), cfg_.check_active_interval_s);
   });
+  if (cfg_.slo_eval_interval_s > 0 && (metrics_ != nullptr || slo_ != nullptr))
+    loop_.AddTimer(cfg_.slo_eval_interval_s * 1000,
+                   [this]() { MetricsTick(); });
   loop_.AddTimer(cfg_.save_interval_s * 1000, [this]() {
     cluster_->Save(state_path_);
     // Periodic status file (tracker_write_status_file analogue).
@@ -274,6 +321,22 @@ bool TrackerServer::Init(std::string* error) {
 }
 
 void TrackerServer::Run() { loop_.Run(); }
+
+void TrackerServer::MetricsTick() {
+  // One snapshot feeds both consumers (journal + SLO engine), so a
+  // post-mortem can re-derive every breach from the retained history.
+  StatsSnapshot snap;
+  registry_.Snapshot(&snap);
+  int64_t now_mono = MonoUs();
+  if (metrics_ != nullptr) metrics_->Append(TraceWallUs(), snap);
+  if (slo_ != nullptr && have_tick_snap_) {
+    double dt_s = static_cast<double>(now_mono - last_tick_mono_us_) / 1e6;
+    slo_->Tick(last_tick_snap_, snap, dt_s > 0 ? dt_s : 1.0);
+  }
+  last_tick_snap_ = std::move(snap);
+  have_tick_snap_ = true;
+  last_tick_mono_us_ = now_mono;
+}
 
 std::string TrackerServer::ResolveTrunkServer(const std::string& group) {
   if (!cfg_.use_trunk_file) return "";  // never poll for a disabled feature
@@ -675,6 +738,21 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       return {0, events_ != nullptr
                      ? events_->Json("tracker", cfg_.port)
                      : "{\"role\":\"tracker\",\"events\":[]}"};
+
+    case TrackerCmd::kMetricsHistory: {
+      // Metrics-journal window dump: empty body = everything retained,
+      // 8B body = since-ts (epoch µs).  ENOTSUP with journaling off so
+      // callers can tell "no journal" from "no history yet".  Any other
+      // length is a malformed window, not "no window": the storage
+      // daemon rejects it too, and silently dumping the WHOLE ring —
+      // decoded inline on this single loop — for a client that asked
+      // for a narrow one is the worst possible reading.
+      if (body.size() != 0 && body.size() != 8) return {22 /*EINVAL*/, ""};
+      if (metrics_ == nullptr) return {95 /*ENOTSUP*/, ""};
+      int64_t since = body.size() == 8 ? GetInt64BE(p) : 0;
+      return {0, metrics_->DumpJson("tracker", cfg_.port,
+                                    since < 0 ? 0 : since)};
+    }
 
     case TrackerCmd::kServerClusterStat: {
       // One-RPC observability dump: tracker role + every group/storage

@@ -11,8 +11,10 @@
 #include <vector>
 
 #include "common/eventlog.h"
+#include "common/metrog.h"
 #include "common/net.h"
 #include "common/req_server.h"
+#include "common/sloeval.h"
 #include "common/stats.h"
 #include "tracker/cluster.h"
 #include "tracker/relationship.h"
@@ -60,6 +62,14 @@ struct TrackerConfig {
   // of structured cluster events (membership transitions, slow
   // requests) dumped via TrackerCmd::kEventDump and on SIGUSR1.
   int event_buffer_size = 256;
+  // Telemetry history + SLOs (OPERATIONS.md "Telemetry history, SLOs &
+  // heat"): on-disk cap of the metrics journal behind kMetricsHistory
+  // (0 = off), the journal/SLO tick cadence (0 = off), and an optional
+  // conf/slo.conf-style rule override file.  The tracker has no heat
+  // sketch — it routes by group, never by file-id payloads.
+  int metrics_journal_mb = 4;
+  int slo_eval_interval_s = 5;
+  std::string slo_rules_file;
 };
 
 class TrackerServer {
@@ -92,6 +102,15 @@ class TrackerServer {
   // aggregate request accounting — same registry JSON contract as the
   // storage daemon's STAT.
   StatsRegistry registry_;
+  // Telemetry history + SLO engine (ISSUE 8): the journal persists one
+  // registry snapshot per tick (kMetricsHistory dumps a window of
+  // them); the evaluator emits slo.breach/recovered into events_.
+  std::unique_ptr<MetricsJournal> metrics_;
+  std::unique_ptr<SloEvaluator> slo_;
+  StatsSnapshot last_tick_snap_;
+  bool have_tick_snap_ = false;
+  int64_t last_tick_mono_us_ = 0;
+  void MetricsTick();
   StatHistogram* hist_nio_lag_ = nullptr;
   std::atomic<int64_t>* ctr_nio_dispatched_ = nullptr;
   std::atomic<int64_t>* ctr_requests_ = nullptr;

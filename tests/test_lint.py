@@ -388,7 +388,8 @@ def test_manifest_golden_names_resolve():
                for enum in ("TrackerCmd", "StorageCmd")
                for e in mani["enums"][enum] if e.get("golden")}
     assert goldens == {"stats-json", "trace-json", "trace-ctx",
-                       "event-json", "scrub-status", "ingest-wire"}
+                       "event-json", "scrub-status", "ingest-wire",
+                       "metrics-history", "heat-top"}
 
 
 if __name__ == "__main__":

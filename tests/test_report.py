@@ -1,0 +1,672 @@
+"""Metrics history journal + SLO/alert engine + heat telemetry (ISSUE 8).
+
+Layers:
+- pure-Python contract tests: METRICS_HISTORY / HEAT_TOP decoding, the
+  SLO rule-table parser, the fdfs_report series math, the counter-reset
+  clamp + `restarted` flag, and the hardened hist_quantile edges;
+- cross-language goldens: `fdfs_codec metrics-history` (journal record
+  codec -> wire JSON), `fdfs_codec heat-top` (space-saving sketch ->
+  wire JSON), and `fdfs_codec slo-conf` (conf/slo.conf parsing parity);
+- `fdfs_load zipf-sample` determinism (the skewed-workload seed of
+  ROADMAP item 2's harness);
+- live acceptance on a 1-tracker/2-storage cluster: zipf downloads via
+  `fdfs_load download --zipf` rank the true hottest file in HEAT_TOP on
+  every loaded node (sketch counts aggregate to the sampler's exact
+  counts), an induced error overload raises slo.breach then
+  slo.recovered in EVENT_DUMP, and after a kill -9 + restart the
+  journal still answers `fdfs_report --since <pre-kill>` with the
+  pre-crash time-series INCLUDING the breach (journal-derived timeline,
+  since the event ring died with the process).
+
+The native halves (journal torn-tail recovery, sketch accuracy vs
+exact counts, EWMA hysteresis, threaded sketch) live in
+native/tests/common_test.cc and run under TSan + FDFS_LOCKRANK via
+tools/run_sanitizers.sh.
+"""
+
+import collections
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fastdfs_tpu import monitor as M
+from fastdfs_tpu.common import protocol as P
+from tests.harness import (BUILD, REPO, STORAGED, TRACKERD, Daemon,
+                           free_port, start_storage, start_tracker,
+                           upload_retry)
+
+_HAVE_TOOLCHAIN = ((shutil.which("cmake") is not None
+                    and shutil.which("ninja") is not None)
+                   or shutil.which("g++") is not None)
+_HAVE_BINARIES = os.path.exists(STORAGED) and os.path.exists(TRACKERD)
+needs_native = pytest.mark.skipif(
+    not (_HAVE_TOOLCHAIN or _HAVE_BINARIES),
+    reason="no native toolchain and no prebuilt daemons")
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+# Fast ticks + only the error-rate rule armed: host-dependent readings
+# (loop lag under sanitizers, the tmpfs fill level) must not inject
+# nondeterministic breaches into the acceptance assertions.
+TELEMETRY = (HB + "\nmetrics_journal_mb = 4\nslo_eval_interval_s = 1\n"
+             "heat_top_k = 16\n")
+SLO_RULES = ("error_rate_pct_threshold = 20\n"
+             "request_p99_ms_enabled = 0\n"
+             "loop_lag_p99_ms_enabled = 0\n"
+             "dio_wait_p99_ms_enabled = 0\n"
+             "sync_lag_s_enabled = 0\n"
+             "scrub_unrepairable_enabled = 0\n"
+             "disk_fill_pct_enabled = 0\n")
+
+
+def _wait(cond, timeout=30, interval=0.25):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(interval)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# wire contract
+# ---------------------------------------------------------------------------
+
+def test_report_opcodes():
+    assert P.StorageCmd.METRICS_HISTORY == 138
+    assert P.StorageCmd.HEAT_TOP == 139
+    assert P.TrackerCmd.METRICS_HISTORY == 99
+
+
+def _snap(ts_us, ops=0, errs=0, up=0, breaches=0, lag_counts=None):
+    h = {"bounds": [100, 1000, 10000], "counts": lag_counts or [0, 0, 0, 0]}
+    h["count"] = sum(h["counts"])
+    h["sum"] = h["count"] * 10
+    return {"ts_us": ts_us,
+            "counters": {"op.download_file.count": ops,
+                         "op.download_file.errors": errs},
+            "gauges": {"store.bytes_uploaded": up,
+                       "slo.breaches_active": breaches},
+            "histograms": {"op.download_file.latency_us": dict(h),
+                           "nio.loop_lag_us": dict(h)}}
+
+
+def test_decode_metrics_history_roundtrip_and_validation():
+    dump = {"role": "storage", "port": 23000,
+            "snapshots": [_snap(1000), _snap(2000, ops=5)]}
+    hist = M.decode_metrics_history(dump)
+    assert [h["ts_us"] for h in hist] == [1000, 2000]
+    assert hist[1]["registry"]["counters"]["op.download_file.count"] == 5
+    with pytest.raises(ValueError):
+        M.decode_metrics_history({"role": "storage"})  # no snapshots
+    with pytest.raises(ValueError):
+        M.decode_metrics_history({"snapshots": [{"counters": {}}]})  # no ts
+    # A backward wall-clock step on the daemon (NTP) writes one
+    # descending ts pair into the journal; the decode must TOLERATE it
+    # in append order — one odd pair must not cost the whole window.
+    hist = M.decode_metrics_history(
+        {"snapshots": [_snap(2000), _snap(1000)]})
+    assert [h["ts_us"] for h in hist] == [2000, 1000]
+    with pytest.raises(ValueError):  # registry shape violations surface
+        bad = _snap(1000)
+        bad["histograms"]["nio.loop_lag_us"]["count"] = 99
+        M.decode_metrics_history({"snapshots": [bad]})
+
+
+def test_decode_heat_roundtrip_and_validation():
+    dump = {"role": "storage", "port": 23000, "k": 2, "tracked": 2,
+            "touches": 12, "entries": [
+                {"key": "group1/M00/a", "hits": 10, "err_bound": 1,
+                 "bytes": 1000, "err": 0,
+                 "ops": {"download": {"count": 9, "bytes": 900},
+                         "upload": {"count": 1, "bytes": 100}},
+                 "future": 1},  # append-only: unknown keys ignored
+                {"key": "group1/M00/b", "hits": 2, "err_bound": 0,
+                 "bytes": 0, "err": 2, "ops": {}},
+            ]}
+    entries = M.decode_heat(dump)
+    assert entries[0].key == "group1/M00/a" and entries[0].hits == 10
+    assert entries[0].ops["download"]["count"] == 9
+    assert entries[1].err == 2
+    with pytest.raises(ValueError):
+        M.decode_heat({"role": "storage"})  # no entries
+    with pytest.raises(ValueError):
+        M.decode_heat({"entries": [{"hits": 1}]})  # no key
+    with pytest.raises(ValueError):  # must arrive sorted by hits desc
+        M.decode_heat({"entries": [
+            {"key": "a", "hits": 1, "ops": {}},
+            {"key": "b", "hits": 5, "ops": {}}]})
+
+
+def test_parse_slo_rules_defaults_and_overrides():
+    # No overrides: the compiled-in defaults verbatim.
+    rules = {r[0]: r for r in M.parse_slo_rules("")}
+    assert rules["error_rate_pct"] == ("error_rate_pct", 5.0, 2.5, True)
+    assert rules["scrub_unrepairable"] == (
+        "scrub_unrepairable", 0.5, 0.25, True)
+    # Threshold-only override rescales clear proportionally.
+    rules = {r[0]: r for r in M.parse_slo_rules(
+        "error_rate_pct_threshold = 1.0\n"
+        "request_p99_ms_enabled = no\n"
+        "disk_fill_pct_threshold = 70\ndisk_fill_pct_clear = 60\n")}
+    assert rules["error_rate_pct"][1:] == (1.0, 0.5, True)
+    assert rules["request_p99_ms"][3] is False
+    assert rules["disk_fill_pct"][1:] == (70.0, 60.0, True)
+    # clear can never exceed threshold.
+    rules = {r[0]: r for r in M.parse_slo_rules(
+        "sync_lag_s_threshold = 10\nsync_lag_s_clear = 99\n")}
+    assert rules["sync_lag_s"][1:3] == (10.0, 10.0)
+
+
+# ---------------------------------------------------------------------------
+# satellite: counter-reset clamping + the `restarted` flag
+# ---------------------------------------------------------------------------
+
+def _node_reg(ops, errs=0, lag_counts=None):
+    h = {"bounds": [100, 1000], "counts": lag_counts or [0, 0, 0]}
+    h["count"] = sum(h["counts"])
+    h["sum"] = h["count"] * 10
+    return {"counters": {"op.upload_file.count": ops,
+                         "op.upload_file.errors": errs},
+            "gauges": {"store.bytes_uploaded": 0, "store.bytes_downloaded": 0,
+                       "cache.hits": 0, "cache.misses": 0,
+                       "nio.conns_active": 1, "dio.queue_depth": 0},
+            "histograms": {"nio.loop_lag_us": h,
+                           "dio.queue_wait_us": dict(h)}}
+
+
+def test_top_rates_counter_reset_clamps_and_flags_restart():
+    """Satellite: a daemon restart between polls (cur < prev) must read
+    as zero rates with an explicit `restarted` flag — never negative
+    garbage."""
+    prev = M.TopSample(ts=100.0, nodes={
+        "storage a:1": M.NodeSample("storage", "a:1", _node_reg(500, 50)),
+    })
+    cur = M.TopSample(ts=102.0, nodes={
+        "storage a:1": M.NodeSample("storage", "a:1", _node_reg(30, 1)),
+    })
+    r = M.top_rates(prev, cur)["storage a:1"]
+    assert r["ops_s"] == 0.0 and r["err_s"] == 0.0
+    assert r["restarted"] is True
+    text = M.render_top(cur, M.top_rates(prev, cur), [])
+    assert "RESTARTED" in text
+    # No reset: normal deltas, no flag, no marker.
+    cur2 = M.TopSample(ts=104.0, nodes={
+        "storage a:1": M.NodeSample("storage", "a:1", _node_reg(40, 1)),
+    })
+    r2 = M.top_rates(cur, cur2)["storage a:1"]
+    assert r2["restarted"] is False and r2["ops_s"] == 5.0
+    assert "RESTARTED" not in M.render_top(cur2, M.top_rates(cur, cur2), [])
+
+
+def test_render_top_alerts_merge_event_and_gauge_backed():
+    """A live event-tracked alert on one node must not hide another
+    node's pre-existing breach that is visible only through its
+    slo.breaches_active gauge (its slo.breach event predates this
+    fdfs_top's first frame) — and a node already named by events must
+    not be double-counted by its own gauge."""
+    ra, rb = _node_reg(10), _node_reg(10)
+    ra["gauges"]["slo.breaches_active"] = 1  # same breach events name
+    rb["gauges"]["slo.breaches_active"] = 1  # gauge-only: event predates us
+    mk = lambda ts: M.TopSample(ts=ts, nodes={  # noqa: E731
+        "storage a:1": M.NodeSample("storage", "a:1", ra),
+        "storage b:2": M.NodeSample("storage", "b:2", rb)})
+    rates = M.top_rates(mk(100.0), mk(102.0))
+    text = M.render_top(mk(102.0), rates, [],
+                        alerts={"storage a:1": ["error_rate_pct"]})
+    assert "storage a:1: error_rate_pct" in text
+    assert "1 pre-existing breach(es)" in text
+    # No event-tracked alerts at all: the gauge fallback still renders.
+    text2 = M.render_top(mk(102.0), rates, [], alerts={})
+    assert "2 pre-existing breach(es)" in text2
+
+
+def test_hist_delta_clamps_hidden_reset():
+    """A restart the total-count guard cannot see (more new
+    observations than the old lifetime) must not produce negative
+    bucket mass."""
+    prev = {"bounds": [100, 1000], "counts": [0, 5, 0], "sum": 50,
+            "count": 5}
+    cur = {"bounds": [100, 1000], "counts": [6, 0, 0], "sum": 30,
+           "count": 6}
+    d = M.hist_delta(prev, cur)
+    assert d["counts"] == [6, 0, 0]
+    assert d["count"] == 6 and d["sum"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: hist_quantile edge hardening
+# ---------------------------------------------------------------------------
+
+def test_hist_quantile_edges_return_none_and_render_dash():
+    # zero observations
+    assert M.hist_quantile({"bounds": [1, 2], "counts": [0, 0, 0],
+                            "sum": 0, "count": 0}, 0.99) is None
+    # no buckets at all (malformed/foreign payload)
+    assert M.hist_quantile({"bounds": [], "counts": [], "sum": 0,
+                            "count": 0}, 0.5) is None
+    assert M.hist_quantile({}, 0.5) is None
+    # all mass in the overflow bucket: no finite upper bound exists
+    assert M.hist_quantile({"bounds": [100, 1000], "counts": [0, 0, 9],
+                            "sum": 90000, "count": 9}, 0.5) is None
+    # in-range quantiles still resolve
+    assert M.hist_quantile({"bounds": [100, 1000], "counts": [1, 0, 9],
+                            "sum": 0, "count": 10}, 0.05) == 100.0
+    # and the renderer shows '-' for every None
+    assert M._fmt_us(None) == "-"
+
+
+# ---------------------------------------------------------------------------
+# fdfs_report series math + journal-derived breach timeline
+# ---------------------------------------------------------------------------
+
+def test_report_series_rates_and_restart_flag():
+    hist = [
+        {"ts_us": 1_000_000, "registry": M.decode_registry(_snap(0, ops=0))},
+        {"ts_us": 3_000_000, "registry": M.decode_registry(
+            _snap(0, ops=20, errs=2, up=4_000_000,
+                  lag_counts=[0, 10, 0, 0]))},
+        # restart mid-window: counters reset
+        {"ts_us": 5_000_000, "registry": M.decode_registry(
+            _snap(0, ops=3, errs=0, up=0))},
+    ]
+    rows = M.report_series(hist)
+    assert len(rows) == 2
+    assert rows[0]["ops_s"] == 10.0 and rows[0]["err_s"] == 1.0
+    assert rows[0]["in_mb_s"] == 2.0
+    assert rows[0]["req_p99_us"] == 1000.0
+    assert rows[0]["restarted"] is False
+    assert rows[1]["restarted"] is True
+    assert rows[1]["ops_s"] == 0.0 and rows[1]["err_s"] == 0.0
+
+
+def test_breach_timeline_from_journal_survives_ring_loss():
+    """The crash case: the event ring died with the daemon, but the
+    journal carries slo.breaches_active per tick — the timeline must
+    reconstruct the breach/recovery from it."""
+    def reg(b):
+        return {"counters": {}, "gauges": {"slo.breaches_active": b},
+                "histograms": {}}
+    hist = {"storage x:1": [
+        {"ts_us": 1_000_000, "registry": reg(0)},
+        {"ts_us": 2_000_000, "registry": reg(1)},   # breach
+        {"ts_us": 3_000_000, "registry": reg(1)},
+        {"ts_us": 4_000_000, "registry": reg(0)},   # recovered
+    ]}
+    # ring empty (post-kill restart): everything synthesized
+    tl = M.breach_timeline({"storage x:1": []}, 0, hist)
+    assert [(e.type, e.ts_us) for e in tl] == [
+        ("slo.breach", 2_000_000), ("slo.recovered", 4_000_000)]
+    assert "source=journal" in tl[0].detail
+    # a live ring covering the window suppresses the synthesized copies
+    live = [M.ClusterEvent(seq=1, ts_us=1_500_000, severity="error",
+                           type="slo.breach", key="error_rate_pct",
+                           detail="", node="storage x:1")]
+    tl2 = M.breach_timeline({"storage x:1": live}, 0, hist)
+    assert [e.key for e in tl2] == ["error_rate_pct"]
+    # since-filter applies to both sources
+    assert M.breach_timeline({"storage x:1": []}, 3_500_000, hist)[0].type \
+        == "slo.recovered"
+
+
+# ---------------------------------------------------------------------------
+# cross-language goldens
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_native_metrics_history_golden():
+    codec = os.path.join(BUILD, "fdfs_codec")
+    out = subprocess.run([codec, "metrics-history"], capture_output=True,
+                         check=True)
+    lines = out.stdout.decode().splitlines()
+    assert lines[1] == "roundtrip=1"  # binary record codec round-trips
+    hist = M.decode_metrics_history(json.loads(lines[0]))
+    assert [h["ts_us"] for h in hist] == [
+        1700000000000000, 1700000005000000, 1700000010000000]
+    r0, r1, r2 = (h["registry"] for h in hist)
+    assert r0["counters"]["op.upload_file.count"] == 10
+    assert r0["gauges"]["sync.peer.10.0.0.2:23000.lag_s"] == 7
+    assert r0["histograms"]["op.upload_file.latency_us"]["counts"] == \
+        [5, 2, 0, 0]
+    # the delta record carried: value change, a NEW series, a tombstone
+    assert r1["counters"]["op.upload_file.count"] == 25
+    assert r1["counters"]["op.download_file.count"] == 4
+    assert "sync.peer.10.0.0.2:23000.lag_s" not in r1["gauges"]
+    h1 = r1["histograms"]["op.upload_file.latency_us"]
+    assert h1["counts"] == [5, 12, 3, 1] and h1["sum"] == 31337
+    assert h1["count"] == 21
+    assert r2["gauges"]["server.connections"] == 0
+
+
+@needs_native
+def test_native_heat_top_golden():
+    codec = os.path.join(BUILD, "fdfs_codec")
+    out = subprocess.run([codec, "heat-top"], capture_output=True, check=True)
+    dump = json.loads(out.stdout)
+    assert dump["role"] == "storage" and dump["port"] == 23000
+    assert dump["tracked"] == 3 and dump["touches"] == 16
+    entries = M.decode_heat(dump)
+    assert [e.key.rsplit("/", 1)[1] for e in entries] == [
+        "hotfile.bin", "warmfile.bin", "coldfile.bin"]
+    hot = entries[0]
+    assert hot.hits == 10 and hot.err_bound == 0
+    assert hot.ops["download"] == {"count": 9, "bytes": 9 * 4096}
+    assert hot.ops["upload"] == {"count": 1, "bytes": 8192}
+    warm = entries[1]
+    assert warm.ops["fetch_chunk"] == {"count": 1, "bytes": 512}
+    cold = entries[2]
+    assert cold.err == 1 and cold.bytes == 0
+
+
+@needs_native
+def test_native_slo_conf_golden():
+    """conf/slo.conf parsing parity: the C++ loader and the Python
+    mirror must produce the same normalized rule table for the same
+    text — including rescaling, clamping, and enable flags."""
+    codec = os.path.join(BUILD, "fdfs_codec")
+    fixture = ("# comment\n"
+               "error_rate_pct_threshold = 1.5\n"
+               "request_p99_ms_enabled = off\n"
+               "sync_lag_s_threshold = 10\n"
+               "sync_lag_s_clear = 99\n"
+               "disk_fill_pct_clear = 50\n"
+               # strtod semantics: trailing garbage after the numeric
+               # prefix is ignored by BOTH parsers, not rejected by one.
+               "loop_lag_p99_ms_threshold = 70%\n"
+               "dio_wait_p99_ms_threshold = 300s extra\n")
+    out = subprocess.run([codec, "slo-conf"], input=fixture.encode(),
+                         capture_output=True, check=True)
+    native = out.stdout.decode().splitlines()
+    python = [f"{n} {t:.6g} {c:.6g} {1 if e else 0}"
+              for n, t, c, e in M.parse_slo_rules(fixture)]
+    assert native == python
+    # and the empty override file reproduces the compiled-in defaults
+    out = subprocess.run([codec, "slo-conf"], input=b"",
+                         capture_output=True, check=True)
+    python = [f"{n} {t:.6g} {c:.6g} {1 if e else 0}"
+              for n, t, c, e in M.parse_slo_rules("")]
+    assert out.stdout.decode().splitlines() == python
+
+
+@needs_native
+def test_zipf_sample_deterministic_and_skewed():
+    """Satellite: load_cli's --zipf sampler is seed-deterministic
+    (thread-count independent by construction: op index keys the
+    sample) and actually skewed toward rank 0."""
+    load = os.path.join(BUILD, "fdfs_load")
+    a = subprocess.run([load, "zipf-sample", "1.1", "16", "3000", "7"],
+                       capture_output=True, check=True).stdout
+    b = subprocess.run([load, "zipf-sample", "1.1", "16", "3000", "7"],
+                       capture_output=True, check=True).stdout
+    assert a == b
+    other_seed = subprocess.run([load, "zipf-sample", "1.1", "16", "3000",
+                                 "8"], capture_output=True,
+                                check=True).stdout
+    assert a != other_seed
+    picks = [int(x) for x in a.split()]
+    assert all(0 <= p < 16 for p in picks)
+    counts = collections.Counter(picks)
+    ranked = [k for k, _ in counts.most_common()]
+    assert ranked[0] == 0  # rank 1 dominates
+    assert counts[0] > counts[1] > counts[3]
+    assert counts[0] / len(picks) > 0.25  # zipf(1.1): rank 1 ~ 29%
+
+
+# ---------------------------------------------------------------------------
+# live acceptance
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_journal_slo_heat_acceptance(tmp_path):
+    """ISSUE 8 acceptance on a live 1-tracker/2-storage cluster:
+
+    1. `fdfs_load download --zipf 1.1` drives skewed reads; HEAT_TOP on
+       every loaded node ranks the true hottest file first, and the
+       per-key download counts aggregated across nodes equal the
+       sampler's exact counts (the sketch is exact below capacity).
+    2. An error overload raises slo.breach (error_rate_pct) in
+       EVENT_DUMP; clean traffic then decays the EWMA to slo.recovered.
+    3. kill -9 the overloaded storage, restart it: METRICS_HISTORY
+       still returns the pre-crash window, and `fdfs_report --since
+       <pre-kill>` reconstructs the time-series including the breach
+       (journal-derived — the event ring died with the process).
+    """
+    from fastdfs_tpu.client import FdfsClient, StorageClient
+
+    tmp = str(tmp_path)
+    t0 = time.time()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    slo_path = os.path.join(tmp, "slo.conf")
+    with open(slo_path, "w") as fh:
+        fh.write(SLO_RULES)
+
+    tr = start_tracker(os.path.join(tmp, "tr"),
+                       extra="metrics_journal_mb = 4\n"
+                             "slo_eval_interval_s = 1")
+    taddr = f"127.0.0.1:{tr.port}"
+    sts = []
+    for i in range(2):
+        ip = f"127.0.0.{80 + i}"
+        sts.append(start_storage(
+            os.path.join(tmp, f"st{i}"), port=free_port(), ip=ip,
+            trackers=[taddr],
+            extra=TELEMETRY + f"slo_rules_file = {slo_path}"))
+    cli = FdfsClient([taddr])
+    load = os.path.join(BUILD, "fdfs_load")
+    try:
+        # -- corpus: 8 small flat files via the native load driver -------
+        upload_retry(cli, b"warmup" * 64)
+        res = os.path.join(tmp, "up.res")
+        out = subprocess.run(
+            [load, "upload", taddr, "8", "8192", "2", res, "8"],
+            capture_output=True, timeout=120)
+        assert out.returncode == 0, out.stderr.decode()
+        with open(res + ".ids") as fh:
+            ids = [ln.strip() for ln in fh if ln.strip()]
+        assert len(ids) == 8, ids
+
+        # every id must be readable from BOTH replicas before the zipf
+        # run, or the tracker routes everything to the source and the
+        # second node never heats up
+        def fully_replicated():
+            for st in sts:
+                try:
+                    with StorageClient(st.ip, st.port) as sc:
+                        for fid in ids:
+                            sc.download_to_buffer(fid)
+                except Exception:  # noqa: BLE001
+                    return False
+            return True
+        assert _wait(fully_replicated, timeout=40), "replication lagged"
+
+        def gather_heat():
+            out = {}
+            for st in sts:
+                with StorageClient(st.ip, st.port) as sc:
+                    out[f"{st.ip}:{st.port}"] = M.decode_heat(sc.heat_top(0))
+            return out
+
+        def dl_counts(heat):
+            agg = collections.Counter()
+            for entries in heat.values():
+                for e in entries:
+                    agg[e.key] += e.ops["download"]["count"]
+            return agg
+        before = dl_counts(gather_heat())
+
+        # -- zipf reads: deterministic sampler == aggregated heat delta --
+        n_ops, seed = 240, 42
+        dl_res = os.path.join(tmp, "dl.res")
+        out = subprocess.run(
+            [load, "download", taddr, res + ".ids", str(n_ops), "3", dl_res,
+             "--zipf", "1.1", "--zipf-seed", str(seed)],
+            capture_output=True, timeout=180)
+        assert out.returncode == 0, out.stderr.decode()
+        statuses = [int(ln.split()[2]) for ln in open(dl_res) if ln.strip()]
+        all_ok = statuses.count(0) == n_ops
+        picks = subprocess.run(
+            [load, "zipf-sample", "1.1", "8", str(n_ops), str(seed)],
+            capture_output=True, check=True).stdout.split()
+        expected = collections.Counter(ids[int(pick)] for pick in picks)
+
+        heat = gather_heat()
+        delta = dl_counts(heat)
+        delta.subtract(before)
+        if all_ok:
+            # 8 keys against 16x8 tracked slots: no evictions, so the
+            # sketch deltas are EXACT and must equal the sampler's counts
+            # key for key — and therefore so does the top-5.
+            for fid in ids:
+                assert delta[fid] == expected[fid], (
+                    fid, delta[fid], expected[fid])
+            exact_top5 = [fid for fid, _ in expected.most_common(5)]
+            sketch_top5 = [k for k, _ in delta.most_common(5)]
+            assert set(sketch_top5) == set(exact_top5)
+            assert sketch_top5[0] == ids[0]
+        else:  # transient failures: still require the skew to dominate
+            assert delta[ids[0]] > sum(delta[f] for f in ids[1:]) / 4
+        # the true hottest file (rank 1 = ids[0]) ranks FIRST on every
+        # node that served a meaningful share of the zipf run
+        loaded = 0
+        for addr, entries in heat.items():
+            node_hits = sum(e.ops["download"]["count"] for e in entries)
+            if node_hits >= 40:
+                loaded += 1
+                assert entries[0].key == ids[0], (addr, entries[:3])
+        assert loaded >= 1, heat
+
+        # -- SLO breach: error overload, then recovery -------------------
+        victim = sts[0]
+        vaddr = (victim.ip, victim.port)
+        bad_id = "group1/M00/00/00/nonexistent_nope.bin"
+
+        def drive_errors():
+            with StorageClient(*vaddr) as sc:
+                for _ in range(40):
+                    try:
+                        sc.download_to_buffer(bad_id)
+                    except Exception:  # noqa: BLE001 — errors are the point
+                        pass
+
+        def breach_event():
+            drive_errors()
+            evs = M.decode_events(cli.storage_events(*vaddr))
+            return [e for e in evs
+                    if e.type == "slo.breach"
+                    and e.key == "error_rate_pct"] or None
+        breaches = _wait(breach_event, timeout=30)
+        assert breaches, "error overload never raised slo.breach"
+        assert breaches[0].severity == "error"
+        t_breach_us = breaches[0].ts_us
+
+        def drive_good():
+            with StorageClient(*vaddr) as sc:
+                for _ in range(30):
+                    sc.download_to_buffer(ids[0])
+
+        def recovered_event():
+            drive_good()
+            evs = M.decode_events(cli.storage_events(*vaddr))
+            rec = [e for e in evs if e.type == "slo.recovered"
+                   and e.key == "error_rate_pct"]
+            return rec or None
+        rec = _wait(recovered_event, timeout=40)
+        assert rec, "clean traffic never cleared the breach"
+        assert rec[0].seq > breaches[0].seq
+
+        # -- the journal answers live, windowed ---------------------------
+        with StorageClient(*vaddr) as sc:
+            hist = M.decode_metrics_history(sc.metrics_history(0))
+            assert len(hist) >= 3
+            # the breach tick journaled a nonzero breaches_active gauge
+            assert any(h["registry"]["gauges"].get("slo.breaches_active", 0)
+                       > 0 for h in hist)
+            # windowing: a since cut mid-history returns a strict suffix
+            cut = hist[len(hist) // 2]["ts_us"]
+            windowed = M.decode_metrics_history(sc.metrics_history(cut))
+            assert windowed and windowed[0]["ts_us"] >= cut
+            assert len(windowed) < len(hist)
+        # the tracker journals too
+        from fastdfs_tpu.client import TrackerClient
+        with TrackerClient("127.0.0.1", tr.port) as tc:
+            thist = M.decode_metrics_history(tc.metrics_history(0))
+            assert thist and "server.requests" in thist[-1]["registry"][
+                "counters"]
+
+        # -- kill -9, restart, post-mortem --------------------------------
+        time.sleep(1.5)  # at least one more journal tick past recovery
+        t_kill_us = int(time.time() * 1e6)
+        os.kill(victim.proc.pid, signal.SIGKILL)
+        victim.proc.wait()
+        conf = os.path.join(tmp, "st0", "storage.conf")
+        revived = Daemon(STORAGED, conf, victim.port, ip=victim.ip)
+        sts[0] = revived
+
+        def post_restart_history():
+            try:
+                with StorageClient(revived.ip, revived.port) as sc:
+                    h = M.decode_metrics_history(sc.metrics_history(0))
+            except Exception:  # noqa: BLE001 — still booting
+                return None
+            pre = [s for s in h if s["ts_us"] < t_kill_us]
+            post = [s for s in h if s["ts_us"] >= t_kill_us]
+            return (h, pre, post) if pre and post else None
+        got = _wait(post_restart_history, timeout=20)
+        assert got, "journal lost the pre-crash window across kill -9"
+        _h, pre, _post = got
+        assert any(s["registry"]["gauges"].get("slo.breaches_active", 0) > 0
+                   for s in pre), "pre-crash breach tick missing"
+
+        # fdfs_report --since <pre-kill>: series + breach timeline from
+        # the journal (the victim's event ring died with the process)
+        out = subprocess.run(
+            [sys.executable, "-m", "fastdfs_tpu.cli", "report", taddr,
+             "--since", str(int(t0)), "--json"],
+            capture_output=True, cwd=REPO, env=env, timeout=120)
+        assert out.returncode == 0, out.stderr.decode()
+        rep = json.loads(out.stdout)
+        vnode = f"storage {victim.ip}:{victim.port}"
+        assert rep["snapshots"][vnode] >= 3
+        rows = rep["series"][vnode]
+        assert rows and any(r["ops_s"] > 0 for r in rows)
+        vbreaches = [b for b in rep["breaches"]
+                     if b["node"] == vnode and b["type"] == "slo.breach"]
+        assert vbreaches, rep["breaches"]
+        assert any("source=journal" in b["detail"] for b in vbreaches)
+        assert any(abs(b["ts_us"] - t_breach_us) < 5_000_000
+                   for b in vbreaches)
+        # the restart shows up as a flagged zero-rate row, not garbage
+        assert all(r["ops_s"] >= 0 and r["err_s"] >= 0 for r in rows)
+
+        # human-readable rendering end to end
+        out = subprocess.run(
+            [sys.executable, "-m", "fastdfs_tpu.cli", "report", taddr,
+             "--since", str(int(t0))],
+            capture_output=True, cwd=REPO, env=env, timeout=120)
+        assert out.returncode == 0, out.stderr.decode()
+        text = out.stdout.decode()
+        assert "SLO breach timeline:" in text and "slo.breach" in text
+        assert vnode in text and "hot files" in text and ids[0] in text
+
+        # fdfs_top --heat renders the hot pane + per-node table
+        out = subprocess.run(
+            [sys.executable, "-m", "fastdfs_tpu.cli", "top", taddr,
+             "--interval", "1", "--count", "1", "--heat", "--no-clear"],
+            capture_output=True, cwd=REPO, env=env, timeout=60)
+        assert out.returncode == 0, out.stderr.decode()
+        text = out.stdout.decode()
+        assert "hot files" in text and ids[0] in text
+    finally:
+        for st in sts:
+            st.stop()
+        tr.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -30,7 +30,8 @@ mkdir -p "$BUILD_DIR/obj"
 
 srcs_common="common/bytes.cc common/cdc.cc common/fileid.cc common/ini.cc
   common/lockrank.cc common/log.cc common/net.cc common/req_server.cc
-  common/stats.cc common/trace.cc common/eventlog.cc common/fsutil.cc
+  common/stats.cc common/trace.cc common/eventlog.cc common/metrog.cc
+  common/sloeval.cc common/heatsketch.cc common/fsutil.cc
   common/http_token.cc"
 srcs_storage="storage/chunkstore.cc storage/config.cc storage/store.cc
   storage/binlog.cc storage/trunk.cc storage/recovery.cc storage/scrub.cc storage/dedup.cc

@@ -32,7 +32,8 @@ else
     tests/test_chunked_storage.py tests/test_disk_recovery.py
     tests/test_multi_tracker.py tests/test_trace.py
     tests/test_dedup_upload.py tests/test_scrub.py
-    tests/test_read_path.py tests/test_observability.py)
+    tests/test_read_path.py tests/test_observability.py
+    tests/test_report.py)
 fi
 
 build_tree() {
