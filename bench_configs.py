@@ -53,9 +53,12 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 HB = "heart_beat_interval = 1\nstat_report_interval = 1"
 
 NOMINAL = {1: 1 << 30, 2: 10 << 30, 3: 50 << 30, 4: 100 << 30,
-           5: 500 << 30, 6: 10 << 30, 7: 10 << 30, 8: 10 << 30}
+           5: 500 << 30, 6: 10 << 30, 7: 10 << 30, 8: 10 << 30,
+           # config9: the ISSUE 9 small-file corpus — 100k x 4 KB.
+           9: 100_000 * 4096}
 DEFAULT_SCALE = {1: 0.25, 2: 1 / 32.0, 3: 1 / 64.0, 4: 1 / 40.0,
-                 5: 1 / 2000.0, 6: 1 / 256.0, 7: 1 / 256.0, 8: 1 / 64.0}
+                 5: 1 / 2000.0, 6: 1 / 256.0, 7: 1 / 256.0, 8: 1 / 64.0,
+                 9: 0.1}
 
 
 def emit(out_dir: str, config: int, payload: dict) -> None:
@@ -1414,10 +1417,232 @@ def config8(out_dir: str, scale: float) -> None:
     })
 
 
+def config9(out_dir: str, scale: float) -> None:
+    """Slab-packed chunk store (ISSUE 9): a small-file corpus (nominal
+    100k x 4 KB, every payload unique) ingested + downloaded through the
+    native fdfs_load driver with slab packing OFF vs ON, with
+    before/after filesystem inode counts (store.inodes_used gauge +
+    a files-on-disk walk) and daemon open-fd counts embedded.  Then a
+    delete-heavy pass on the packed store: 80% of the corpus deleted, a
+    kicked scrub pass compacts, and the artifact records the share of
+    dead slab bytes reclaimed plus byte-identical downloads of a
+    Python-verified sub-corpus throughout the compaction window.
+
+    dedup_chunk_threshold is lowered to 1 KB so 4 KB files take the
+    chunked path (recipe + content-addressed chunk) in BOTH arms — the
+    comparison is purely the layout: one chunk file + one fsync'd
+    recipe sidecar per file vs two slab records.
+    """
+    from harness import BUILD, free_port, start_storage, start_tracker
+
+    from fastdfs_tpu.client.client import FdfsClient
+    from fastdfs_tpu.client import StorageClient
+
+    file_bytes = 4096
+    n_files = max(int(NOMINAL[9] * scale) // file_bytes, 200)
+    threads = min(os.cpu_count() or 1, 4)
+    fdfs_load = os.path.join(BUILD, "fdfs_load")
+
+    base_conf = (HB
+                 + "\ndedup_chunk_threshold = 1K"
+                 + "\nscrub_interval_s = 0"
+                 + "\nchunk_gc_grace_s = 0")
+    arms = {
+        "flat": base_conf + "\nslab_chunk_threshold = 0"
+                          + "\nslab_recipe_threshold = 0",
+        "packed": base_conf + "\nslab_chunk_threshold = 64K"
+                            + "\nslab_recipe_threshold = 64K"
+                            + "\nslab_size_mb = 64"
+                            + "\nslab_compact_min_dead_pct = 25",
+    }
+
+    def run_load(*args):
+        out = subprocess.run([fdfs_load, *args], capture_output=True,
+                             timeout=3600)
+        assert out.returncode == 0, out.stderr.decode()
+        return out
+
+    def combine(*result_files):
+        out = subprocess.run([fdfs_load, "combine", *result_files],
+                             capture_output=True, timeout=600)
+        assert out.returncode == 0, out.stderr.decode()
+        return json.loads(out.stdout.decode())
+
+    def files_on_disk(base):
+        n = 0
+        for _root, _dirs, files in os.walk(os.path.join(base, "data")):
+            n += len(files)
+        return n
+
+    def gauges(st):
+        with StorageClient(st.ip, st.port) as sc:
+            return sc.stat()["gauges"]
+
+    results = {}
+    delete_heavy = None
+    wrong_bytes = 0
+    for name, conf in arms.items():
+        tmp = tempfile.mkdtemp(prefix=f"fdfs_cfg9_{name}_")
+        tr = start_tracker(os.path.join(tmp, "tr"))
+        st = start_storage(os.path.join(tmp, "st"), port=free_port(),
+                           trackers=[f"127.0.0.1:{tr.port}"],
+                           dedup_mode="cpu", extra=conf)
+        cli = FdfsClient([f"127.0.0.1:{tr.port}"])
+        base = os.path.join(tmp, "st")
+        taddr = f"127.0.0.1:{tr.port}"
+        try:
+            _upload_retry(cli, b"warmup " * 64)
+            g0 = gauges(st)
+            files_before = files_on_disk(base)
+            up_res = os.path.join(tmp, "up.result")
+            t0 = time.perf_counter()
+            run_load("upload", taddr, "--small-files", str(n_files),
+                     "--file-bytes", str(file_bytes), str(threads), up_res)
+            ingest_wall = time.perf_counter() - t0
+            ingest = combine(up_res)
+            assert ingest["errors"] == 0, ingest
+            g1 = gauges(st)
+            files_after = files_on_disk(base)
+            fd_count = len(os.listdir(f"/proc/{st.proc.pid}/fd"))
+            dl_res = os.path.join(tmp, "down.result")
+            run_load("download", taddr, up_res + ".ids", str(n_files),
+                     str(threads), dl_res)
+            download = combine(dl_res)
+            assert download["errors"] == 0, download
+            # Short logical bodies mean lost bytes — every download must
+            # return exactly file_bytes.
+            assert download["bytes"] == n_files * file_bytes, download
+            results[name] = {
+                "ingest": ingest,
+                "ingest_wall_s": round(ingest_wall, 3),
+                "download": download,
+                "inodes_used_before": g0["store.inodes_used"],
+                "inodes_used_after": g1["store.inodes_used"],
+                "files_on_disk_before": files_before,
+                "files_on_disk_after": files_after,
+                "daemon_open_fds_after_ingest": fd_count,
+                "slab": {k.split(".", 1)[1]: g1[k] for k in g1
+                         if k.startswith("slab.")},
+            }
+
+            if name == "packed":
+                # -- delete-heavy pass + compaction ----------------------
+                # A Python-verified sub-corpus pins byte-identity across
+                # the whole compaction window (fdfs_load only checks
+                # status + length).
+                rng = random.Random(9)
+                verified = {}
+                for i in range(100):
+                    data = rng.randbytes(file_bytes)
+                    verified[cli.upload_buffer(data, ext="bin")] = data
+                with open(up_res + ".ids") as fh:
+                    ids = [l.strip() for l in fh if l.strip()]
+                doomed = ids[:int(len(ids) * 0.8)]
+                doomed_path = os.path.join(tmp, "doomed.ids")
+                with open(doomed_path, "w") as fh:
+                    fh.write("\n".join(doomed) + "\n")
+                del_res = os.path.join(tmp, "del.result")
+                run_load("delete", taddr, doomed_path, str(threads),
+                         del_res)
+                deleted = combine(del_res)
+                gd = gauges(st)
+                dead_before = gd["slab.bytes_dead"]
+                cli.scrub_kick(st.ip, st.port)
+                # Byte-identical downloads WHILE the pass compacts.
+                deadline = time.perf_counter() + 120
+                during_checks = 0
+                while time.perf_counter() < deadline:
+                    for fid, data in list(verified.items())[:20]:
+                        if cli.download_to_buffer(fid) != data:
+                            wrong_bytes += 1
+                        during_checks += 1
+                    gc = gauges(st)
+                    if (gc["slab.compactions"] >= 1
+                            and gc["slab.bytes_dead"]
+                            <= dead_before * 0.2):
+                        break
+                    time.sleep(0.5)
+                gc = gauges(st)
+                for fid, data in verified.items():
+                    if cli.download_to_buffer(fid) != data:
+                        wrong_bytes += 1
+                # The surviving fdfs_load fraction still serves fully.
+                kept_path = os.path.join(tmp, "kept.ids")
+                kept = ids[int(len(ids) * 0.8):]
+                with open(kept_path, "w") as fh:
+                    fh.write("\n".join(kept) + "\n")
+                dl2 = os.path.join(tmp, "down2.result")
+                run_load("download", taddr, kept_path, str(len(kept)),
+                         str(threads), dl2)
+                after_dl = combine(dl2)
+                assert after_dl["errors"] == 0, after_dl
+                assert after_dl["bytes"] == len(kept) * file_bytes
+                delete_heavy = {
+                    "deleted_files": len(doomed),
+                    "delete_errors": deleted["errors"],
+                    "dead_bytes_before_compaction": dead_before,
+                    "dead_bytes_after_compaction": gc["slab.bytes_dead"],
+                    "reclaim_pct": round(
+                        100.0 * (1 - gc["slab.bytes_dead"]
+                                 / max(dead_before, 1)), 2),
+                    "compactions": gc["slab.compactions"],
+                    "compacted_bytes": gc["slab.compacted_bytes"],
+                    "slab_files_after": gc["slab.files"],
+                    "byte_checks_during_compaction": during_checks,
+                    "survivor_download": after_dl,
+                }
+        finally:
+            cli.close()
+            st.stop()
+            tr.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    flat_inodes = (results["flat"]["inodes_used_after"]
+                   - results["flat"]["inodes_used_before"])
+    packed_inodes = (results["packed"]["inodes_used_after"]
+                     - results["packed"]["inodes_used_before"])
+    flat_files = (results["flat"]["files_on_disk_after"]
+                  - results["flat"]["files_on_disk_before"])
+    packed_files = (results["packed"]["files_on_disk_after"]
+                    - results["packed"]["files_on_disk_before"])
+    emit(out_dir, 9, {
+        "description": "slab-packed chunk store: small-file corpus "
+                       "(unique 4 KB files) ingested + downloaded with "
+                       "slab packing off vs on, inode/fd counts "
+                       "embedded, plus a delete-heavy pass with paced "
+                       "online compaction and byte-identical downloads "
+                       "throughout",
+        "nominal_bytes": NOMINAL[9],
+        "scaled_bytes": n_files * file_bytes,
+        "files": n_files,
+        "file_bytes": file_bytes,
+        "threads": threads,
+        "host_cpus": os.cpu_count() or 1,
+        "modes": results,
+        "inode_delta_flat": flat_inodes,
+        "inode_delta_packed": packed_inodes,
+        "files_on_disk_delta_flat": flat_files,
+        "files_on_disk_delta_packed": packed_files,
+        "inode_ratio": round(flat_inodes / max(packed_inodes, 1), 2),
+        "ingest_p50_packed_vs_flat": round(
+            results["packed"]["ingest"]["lat_p50_us"]
+            / max(results["flat"]["ingest"]["lat_p50_us"], 1), 3),
+        "delete_heavy": delete_heavy,
+        "wrong_bytes": wrong_bytes,
+        "inode_win_10x": flat_inodes >= 10 * max(packed_inodes, 1),
+        "ingest_p50_no_worse": (
+            results["packed"]["ingest"]["lat_p50_us"]
+            <= results["flat"]["ingest"]["lat_p50_us"]),
+        "compaction_reclaims_80pct": (delete_heavy is not None
+                                      and delete_heavy["reclaim_pct"]
+                                      >= 80.0),
+    })
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=0,
-                    help="which config (1-8); 0 = all")
+                    help="which config (1-9); 0 = all")
     ap.add_argument("--scale", type=float, default=None,
                     help="fraction of the nominal corpus size")
     ap.add_argument("--full", action="store_true",
@@ -1426,8 +1651,8 @@ def main() -> None:
     args = ap.parse_args()
 
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8}
-    which = [args.config] if args.config else [1, 2, 3, 4, 5, 6, 7, 8]
+           6: config6, 7: config7, 8: config8, 9: config9}
+    which = [args.config] if args.config else [1, 2, 3, 4, 5, 6, 7, 8, 9]
     for c in which:
         scale = 1.0 if args.full else (
             args.scale if args.scale is not None else DEFAULT_SCALE[c])

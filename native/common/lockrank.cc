@@ -18,6 +18,8 @@ const char* LockRankName(LockRank r) {
     case LockRank::kMetricsJournal: return "metrog.journal";
     case LockRank::kSync: return "sync.manager";
     case LockRank::kChunkStripe: return "chunkstore.stripe";
+    case LockRank::kSlabStore: return "slabstore.store";
+    case LockRank::kSlabIndex: return "slabstore.index_stripe";
     case LockRank::kReadCache: return "chunkstore.read_cache";
     case LockRank::kTrunkAlloc: return "trunk.allocator";
     case LockRank::kBinlog: return "binlog.append";

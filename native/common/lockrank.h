@@ -68,9 +68,22 @@
 //                           one sanctioned same-rank multi-acquisition.
 //                           The zero-ref (GC) map lives inside each
 //                           stripe, so it shares this rank by design.
+//    92   kSlabStore        SlabStore::mu_ (active-slab fd, rollover,
+//                           per-slab byte accounting; disk IO under it
+//                           by design, like kTrunkAlloc).  ChunkStore
+//                           appends/marks-dead while holding a digest
+//                           stripe lock, so it must order AFTER
+//                           kChunkStripe; appends publish into the slot
+//                           index with mu_ held, so BEFORE kSlabIndex.
+//    94   kSlabIndex        SlabStore::IndexStripe::mu, ORDER-KEYED by
+//                           stripe index (taken one at a time today;
+//                           the key gives any future multi-stripe walk
+//                           the ascending protocol for free).
 //   100   kReadCache        ChunkStore::ReadCache::mu — always AFTER a
 //                           stripe lock (insert liveness re-check,
 //                           same-lock invalidation), never before.
+//                           Slab locks release before any cache call,
+//                           so 92/94 vs 100 never nest.
 //   110   kTrunkAlloc       TrunkAllocator::mu_ (free-slot map; logs and
 //                           does disk IO under it by design).
 //   120   kBinlog           Binlog::mu_ (append serialization).
@@ -117,6 +130,8 @@ enum class LockRank : uint16_t {
   kMetricsJournal = 74,
   kSync = 80,
   kChunkStripe = 90,
+  kSlabStore = 92,
+  kSlabIndex = 94,
   kReadCache = 100,
   kTrunkAlloc = 110,
   kBinlog = 120,

@@ -12,6 +12,7 @@
 
 #include "common/bytes.h"
 #include "common/eventlog.h"
+#include "common/fsutil.h"
 #include "common/log.h"
 
 namespace fdfs {
@@ -32,15 +33,10 @@ bool IsHex40(const std::string& s) {
 // -- recipe codec ---------------------------------------------------------
 // Layout: 8B magic, 8B logical_size BE, 8B chunk_count BE, then per chunk
 // 20B raw digest + 8B length BE.  Offsets are implicit (cumulative).
+// The buffer forms are shared between .rcp sidecar files and slab-packed
+// recipe records — identical bytes in both layouts.
 
-bool WriteRecipeFile(const std::string& path, const Recipe& r,
-                     std::string* err) {
-  std::string tmp = path + ".tmp";
-  FILE* f = fopen(tmp.c_str(), "wb");
-  if (f == nullptr) {
-    *err = "open " + tmp + ": " + strerror(errno);
-    return false;
-  }
+std::string EncodeRecipe(const Recipe& r) {
   std::string buf(kRecipeMagic, sizeof(kRecipeMagic));
   uint8_t num[8];
   PutInt64BE(r.logical_size, num);
@@ -55,6 +51,45 @@ bool WriteRecipeFile(const std::string& path, const Recipe& r,
     PutInt64BE(e.length, num);
     buf.append(reinterpret_cast<char*>(num), 8);
   }
+  return buf;
+}
+
+std::optional<Recipe> DecodeRecipe(const char* data, size_t len) {
+  if (len < 24 || memcmp(data, kRecipeMagic, sizeof(kRecipeMagic)) != 0)
+    return std::nullopt;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(data);
+  Recipe r;
+  r.logical_size = GetInt64BE(p + 8);
+  int64_t count = GetInt64BE(p + 16);
+  if (count < 0 || count > (1 << 26))  // 64M chunks ~= 0.5 PB file
+    return std::nullopt;
+  if (len < 24 + static_cast<size_t>(count) * 28) return std::nullopt;
+  static const char* kHex = "0123456789abcdef";
+  r.chunks.reserve(static_cast<size_t>(count));
+  for (int64_t i = 0; i < count; ++i) {
+    const uint8_t* rec = p + 24 + i * 28;
+    RecipeEntry e;
+    e.digest_hex.resize(40);
+    for (int b = 0; b < 20; ++b) {
+      e.digest_hex[2 * b] = kHex[rec[b] >> 4];
+      e.digest_hex[2 * b + 1] = kHex[rec[b] & 0xF];
+    }
+    e.length = GetInt64BE(rec + 20);
+    if (e.length < 0) return std::nullopt;
+    r.chunks.push_back(std::move(e));
+  }
+  return r;
+}
+
+bool WriteRecipeFile(const std::string& path, const Recipe& r,
+                     std::string* err) {
+  std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (f == nullptr) {
+    *err = "open " + tmp + ": " + strerror(errno);
+    return false;
+  }
+  std::string buf = EncodeRecipe(r);
   bool ok = fwrite(buf.data(), 1, buf.size(), f) == buf.size() &&
             fflush(f) == 0 && fsync(fileno(f)) == 0;
   fclose(f);
@@ -67,53 +102,34 @@ bool WriteRecipeFile(const std::string& path, const Recipe& r,
 }
 
 std::optional<Recipe> ReadRecipeFile(const std::string& path) {
-  FILE* f = fopen(path.c_str(), "rb");
-  if (f == nullptr) return std::nullopt;
-  char hdr[24];
-  if (fread(hdr, 1, sizeof(hdr), f) != sizeof(hdr) ||
-      memcmp(hdr, kRecipeMagic, sizeof(kRecipeMagic)) != 0) {
-    fclose(f);
-    return std::nullopt;
-  }
-  Recipe r;
-  r.logical_size = GetInt64BE(reinterpret_cast<uint8_t*>(hdr) + 8);
-  int64_t count = GetInt64BE(reinterpret_cast<uint8_t*>(hdr) + 16);
-  if (count < 0 || count > (1 << 26)) {  // 64M chunks ~= 0.5 PB file
-    fclose(f);
-    return std::nullopt;
-  }
-  static const char* kHex = "0123456789abcdef";
-  r.chunks.reserve(static_cast<size_t>(count));
-  for (int64_t i = 0; i < count; ++i) {
-    uint8_t rec[28];
-    if (fread(rec, 1, sizeof(rec), f) != sizeof(rec)) {
-      fclose(f);
-      return std::nullopt;
-    }
-    RecipeEntry e;
-    e.digest_hex.resize(40);
-    for (int b = 0; b < 20; ++b) {
-      e.digest_hex[2 * b] = kHex[rec[b] >> 4];
-      e.digest_hex[2 * b + 1] = kHex[rec[b] & 0xF];
-    }
-    e.length = GetInt64BE(rec + 20);
-    if (e.length < 0) {
-      fclose(f);
-      return std::nullopt;
-    }
-    r.chunks.push_back(std::move(e));
-  }
-  fclose(f);
-  return r;
+  std::string buf;
+  if (!ReadWholeFile(path, &buf)) return std::nullopt;
+  return DecodeRecipe(buf.data(), buf.size());
 }
 
 // -- store ----------------------------------------------------------------
 
 ChunkStore::ChunkStore(std::string store_path, int64_t gc_grace_s,
-                       int64_t read_cache_bytes)
+                       int64_t read_cache_bytes, SlabOptions slab)
     : store_path_(std::move(store_path)),
-      gc_grace_s_(gc_grace_s < 0 ? 0 : gc_grace_s) {
+      gc_grace_s_(gc_grace_s < 0 ? 0 : gc_grace_s),
+      slab_opts_(slab) {
   cache_.cap_bytes = read_cache_bytes < 0 ? 0 : read_cache_bytes;
+  // The slab store exists whenever packing is configured OR slab data
+  // is already on disk: thresholds gate only NEW writes.  An operator
+  // draining the layout (both thresholds 0, OPERATIONS.md) must keep
+  // reading slab-resident records — without this, boot would treat
+  // every chunk named only by a slab-resident recipe as an orphan and
+  // GC it: data loss, not a drain.
+  struct stat st;
+  bool slabs_on_disk =
+      stat((store_path_ + "/data/slabs").c_str(), &st) == 0 &&
+      S_ISDIR(st.st_mode);
+  if (slab_opts_.chunk_threshold > 0 || slab_opts_.recipe_threshold > 0 ||
+      slabs_on_disk)
+    slab_ = std::make_unique<SlabStore>(store_path_ + "/data/slabs",
+                                        slab_opts_.slab_bytes,
+                                        slab_opts_.compact_min_dead_pct);
   // Stripe locks share one rank; the index is the ascending-protocol
   // order key the FDFS_LOCKRANK checker validates RefAll against.
   for (int i = 0; i < kStripes; ++i) stripes_[i].mu.set_order_key(i);
@@ -173,9 +189,31 @@ bool WriteChunkFile(const std::string& path, const char* data, size_t len,
 
 }  // namespace
 
+bool ChunkStore::WriteChunkPayloadLocked(const std::string& digest_hex,
+                                         const char* data, size_t len,
+                                         std::string* err) {
+  // stripe mu held.  The shared payload landing path: first writes,
+  // heal-on-upload, and replica repair all route here so the slab-vs-
+  // flat layout decision lives in exactly one place.
+  if (SlabChunkEligible(static_cast<int64_t>(len))) {
+    // Replace semantics mark any older record (a quarantined original,
+    // a pre-repair copy) dead in place; a stale flat twin from before a
+    // threshold change is dropped so it can never shadow the record.
+    if (!slab_->Append(kSlabKindChunk, digest_hex, data, len,
+                       /*durable=*/false, err))
+      return false;
+    unlink(ChunkPath(digest_hex).c_str());
+    return true;
+  }
+  std::string path = ChunkPath(digest_hex);
+  EnsureParentDirs(path);
+  if (!WriteChunkFile(path, data, len, err)) return false;
+  if (slab_ != nullptr) slab_->MarkDead(kSlabKindChunk, digest_hex);
+  return true;
+}
+
 bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
                            size_t len, bool* existed, std::string* err) {
-  std::string path = ChunkPath(digest_hex);
   Stripe& st = StripeFor(digest_hex);
   std::lock_guard<RankedMutex> lk(st.mu);
   // Heal-on-upload: these bytes hash to the digest (every caller
@@ -187,7 +225,7 @@ bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
   auto heal = [&]() {
     if (!st.quarantined.count(digest_hex)) return;
     std::string werr;
-    if (WriteChunkFile(path, data, len, &werr)) {
+    if (WriteChunkPayloadLocked(digest_hex, data, len, &werr)) {
       st.quarantined.erase(digest_hex);
       unlink(QuarantinePath(digest_hex).c_str());
       CacheInvalidate(digest_hex);
@@ -221,14 +259,9 @@ bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
     *existed = true;
     return true;
   }
-  // First reference: write the payload.
-  std::string dir1 = store_path_ + "/data/chunks";
-  std::string dir2 = dir1 + "/" + digest_hex.substr(0, 2);
-  std::string dir3 = dir2 + "/" + digest_hex.substr(2, 2);
-  mkdir(dir1.c_str(), 0755);
-  mkdir(dir2.c_str(), 0755);
-  mkdir(dir3.c_str(), 0755);
-  if (!WriteChunkFile(path, data, len, err)) return false;
+  // First reference: write the payload (slab record below the packing
+  // threshold, flat file otherwise).
+  if (!WriteChunkPayloadLocked(digest_hex, data, len, err)) return false;
   st.refs[digest_hex] = 1;
   st.lens[digest_hex] = static_cast<int64_t>(len);
   unique_bytes_ += static_cast<int64_t>(len);
@@ -307,6 +340,7 @@ void ChunkStore::RetireLocked(Stripe& s, const std::string& digest_hex,
 
 void ChunkStore::UnlinkRetiredLocked(Stripe& s,
                                      const std::string& digest_hex) {
+  if (slab_ != nullptr) slab_->MarkDead(kSlabKindChunk, digest_hex);
   unlink(ChunkPath(digest_hex).c_str());
   unlink(QuarantinePath(digest_hex).c_str());
   s.quarantined.erase(digest_hex);
@@ -330,13 +364,13 @@ void ChunkStore::UnrefAll(const Recipe& r) {
 }
 
 std::optional<Recipe> ChunkStore::ReadRecipeAndPin(const std::string& path) {
-  // The file read needs no lock (recipe files are immutable once
-  // renamed into place); the verify-refs-then-pin per chunk under its
-  // stripe lock is what closes the race with a concurrent delete.  If
-  // any chunk already lost its references (the file is mid-delete) the
+  // The recipe read needs no lock (both layouts are immutable once
+  // published); the verify-refs-then-pin per chunk under its stripe
+  // lock is what closes the race with a concurrent delete.  If any
+  // chunk already lost its references (the file is mid-delete) the
   // pins taken so far roll back and the download fails with ENOENT
   // before the first byte — never mid-stream.
-  auto r = ReadRecipeFile(path);
+  auto r = LoadRecipe(path);
   if (!r.has_value()) return std::nullopt;
   for (size_t i = 0; i < r->chunks.size(); ++i) {
     Stripe& st = StripeFor(r->chunks[i].digest_hex);
@@ -356,7 +390,7 @@ std::optional<Recipe> ChunkStore::ReadRecipeAndPin(const std::string& path) {
 std::optional<Recipe> ChunkStore::ReadRecipeAndPinRange(
     const std::string& path, int64_t offset, int64_t count,
     int64_t* skip_out) {
-  auto full = ReadRecipeFile(path);
+  auto full = LoadRecipe(path);
   if (!full.has_value() || offset < 0) return std::nullopt;
   // offset past EOF yields an EMPTY slice (no pins) rather than
   // nullopt, so the caller can distinguish "gone" (ENOENT) from "bad
@@ -448,6 +482,16 @@ void ChunkStore::UnpinRecipe(const Recipe& r) {
 
 bool ChunkStore::ReadChunk(const std::string& digest_hex, int64_t expect_len,
                            std::string* out) const {
+  // Slab-resident chunks read as extents of their slab record; the
+  // length check keeps the flat path's "short file is corrupt"
+  // semantics.  Absent from the slot index => the flat layout owns it.
+  if (slab_ != nullptr) {
+    SlabStore::Slot slot;
+    if (slab_->Lookup(kSlabKindChunk, digest_hex, &slot)) {
+      if (slot.payload_len != expect_len) return false;
+      return slab_->Read(kSlabKindChunk, digest_hex, out);
+    }
+  }
   int fd = open(ChunkPath(digest_hex).c_str(), O_RDONLY);
   if (fd < 0) return false;
   out->resize(static_cast<size_t>(expect_len));
@@ -467,6 +511,8 @@ bool ChunkStore::ReadChunk(const std::string& digest_hex, int64_t expect_len,
 bool ChunkStore::ReadChunkSlice(const std::string& digest_hex,
                                 int64_t offset, int64_t len,
                                 char* dst) const {
+  if (slab_ != nullptr && slab_->Has(kSlabKindChunk, digest_hex))
+    return slab_->ReadSlice(kSlabKindChunk, digest_hex, offset, len, dst);
   int fd = open(ChunkPath(digest_hex).c_str(), O_RDONLY);
   if (fd < 0) return false;
   int64_t got = 0;
@@ -662,10 +708,34 @@ ChunkStore::QuarantineResult ChunkStore::Quarantine(
   if (st.refs.find(digest_hex) == st.refs.end())
     return QuarantineResult::kGone;  // deleted since the snapshot
   if (st.pins.count(digest_hex)) return QuarantineResult::kPinned;
-  // Re-verify under the lock: the scrubber's verify read ran lock-free,
-  // so it may have raced a delete + re-upload of this digest and hashed
-  // a half-gone file.  No writer of this digest can interleave with
-  // this read, so a clean hash here is authoritative.
+  // Slab-resident chunk: re-verify the record extent under the lock,
+  // then preserve the bad bytes in quarantine/ (the flat path's rename
+  // equivalent — forensics plus the heal/repair contract) and kill the
+  // slot.  Compaction reclaims the dead extent later; the quarantine
+  // mark is what routes re-uploads and replica repairs to the heal
+  // path, exactly as for flat files.
+  if (slab_ != nullptr && slab_->Has(kSlabKindChunk, digest_hex)) {
+    std::string payload;
+    bool readable = slab_->Read(kSlabKindChunk, digest_hex, &payload);
+    if (readable && Sha1(payload.data(), payload.size()).Hex() == digest_hex)
+      return QuarantineResult::kClean;
+    mkdir((store_path_ + "/data/quarantine").c_str(), 0755);
+    if (readable) {
+      std::string werr;
+      if (!WriteChunkFile(QuarantinePath(digest_hex), payload.data(),
+                          payload.size(), &werr))
+        FDFS_LOG_WARN("quarantine copy of slab chunk %s: %s",
+                      digest_hex.c_str(), werr.c_str());
+    }
+    slab_->MarkDead(kSlabKindChunk, digest_hex);
+    st.quarantined.insert(digest_hex);
+    CacheInvalidate(digest_hex);
+    return QuarantineResult::kQuarantined;
+  }
+  // Flat chunk: re-verify under the lock — the scrubber's verify read
+  // ran lock-free, so it may have raced a delete + re-upload of this
+  // digest and hashed a half-gone file.  No writer of this digest can
+  // interleave with this read, so a clean hash here is authoritative.
   {
     int fd = open(ChunkPath(digest_hex).c_str(), O_RDONLY);
     if (fd >= 0) {
@@ -704,7 +774,7 @@ bool ChunkStore::RepairChunk(const std::string& digest_hex, const char* data,
     *err = "no longer referenced";
     return false;
   }
-  if (!WriteChunkFile(ChunkPath(digest_hex), data, len, err)) return false;
+  if (!WriteChunkPayloadLocked(digest_hex, data, len, err)) return false;
   st.quarantined.erase(digest_hex);
   unlink(QuarantinePath(digest_hex).c_str());
   st.lens[digest_hex] = static_cast<int64_t>(len);
@@ -713,6 +783,148 @@ bool ChunkStore::RepairChunk(const std::string& digest_hex, const char* data,
   // an entry that predates a quarantine episode.
   CacheInvalidate(digest_hex);
   return true;
+}
+
+// -- recipe sidecars (slab-aware) -----------------------------------------
+
+std::string ChunkStore::RecipeSlabKey(const std::string& rcp_path) const {
+  // Keys are store-root-relative so replicas (different absolute roots)
+  // and relocated stores derive identical keys from identical layouts.
+  if (rcp_path.compare(0, store_path_.size(), store_path_) == 0) {
+    size_t start = store_path_.size();
+    while (start < rcp_path.size() && rcp_path[start] == '/') ++start;
+    return rcp_path.substr(start);
+  }
+  return rcp_path;
+}
+
+bool ChunkStore::StoreRecipe(const std::string& rcp_path, const Recipe& r,
+                             std::string* err) {
+  std::string key = RecipeSlabKey(rcp_path);
+  // Size-probe arithmetically (24B header + 28B/chunk) so a recipe that
+  // stays flat — every file past ~19 MB at default thresholds — is not
+  // encoded twice on the upload hot path.
+  int64_t encoded_size = 24 + 28 * static_cast<int64_t>(r.chunks.size());
+  if (slab_ != nullptr && slab_opts_.recipe_threshold > 0 &&
+      key.size() <= kSlabKeyMaxLen &&
+      encoded_size < slab_opts_.recipe_threshold) {
+    std::string buf = EncodeRecipe(r);
+    // durable: recipes keep WriteRecipeFile's fsync guarantee — the
+    // recipe IS the file's existence, chunks are resurrectable.
+    if (!slab_->Append(kSlabKindRecipe, key, buf.data(), buf.size(),
+                       /*durable=*/true, err))
+      return false;
+    // A flat sidecar from before a threshold change must not shadow
+    // (or double-count refs for) the slab record.
+    unlink(rcp_path.c_str());
+    return true;
+  }
+  // Flat sidecar: the recipe is the only thing that needs the file-id
+  // directory fan-out, so the dirs are created HERE, not by callers — a
+  // slab-resident recipe must cost zero inodes, fan-out dirs included
+  // (they dominate the inode bill on small-file corpora otherwise).
+  EnsureParentDirs(rcp_path);
+  if (!WriteRecipeFile(rcp_path, r, err)) return false;
+  if (slab_ != nullptr) slab_->MarkDead(kSlabKindRecipe, key);
+  return true;
+}
+
+std::optional<Recipe> ChunkStore::LoadRecipe(
+    const std::string& rcp_path) const {
+  if (slab_ != nullptr) {
+    std::string payload;
+    if (slab_->Read(kSlabKindRecipe, RecipeSlabKey(rcp_path), &payload))
+      return DecodeRecipe(payload.data(), payload.size());
+  }
+  return ReadRecipeFile(rcp_path);
+}
+
+bool ChunkStore::HasRecipe(const std::string& rcp_path) const {
+  if (slab_ != nullptr &&
+      slab_->Has(kSlabKindRecipe, RecipeSlabKey(rcp_path)))
+    return true;
+  struct stat st;
+  return stat(rcp_path.c_str(), &st) == 0;
+}
+
+bool ChunkStore::RemoveRecipe(const std::string& rcp_path,
+                              int64_t* bytes_out) {
+  bool found = false;
+  int64_t bytes = 0;
+  if (slab_ != nullptr) {
+    int64_t payload_len = 0;
+    if (slab_->MarkDead(kSlabKindRecipe, RecipeSlabKey(rcp_path),
+                        &payload_len)) {
+      found = true;
+      bytes += payload_len;
+    }
+  }
+  struct stat st;
+  if (stat(rcp_path.c_str(), &st) == 0 && unlink(rcp_path.c_str()) == 0) {
+    found = true;
+    bytes += st.st_size;
+  }
+  if (bytes_out != nullptr) *bytes_out = bytes;
+  return found;
+}
+
+int64_t ChunkStore::CompactSlabs(const std::function<void(int64_t)>& pace,
+                                 const std::function<bool()>& stop,
+                                 std::vector<ChunkInfo>* corrupt,
+                                 int64_t* reclaimed) {
+  if (slab_ == nullptr) return 0;
+  SlabStore::CompactResult res = slab_->Compact(pace, stop);
+  if (reclaimed != nullptr) *reclaimed += res.reclaimed_bytes;
+  // Copy-time re-verify failures ride the standard quarantine/heal
+  // machinery: the caller (scrub pass) runs HandleCorrupt on each,
+  // which quarantines the slot (marking it dead — letting the next
+  // compaction finish the slab) and repairs from a group replica.
+  if (corrupt != nullptr) {
+    for (const std::string& dig : res.corrupt_chunk_keys) {
+      int64_t len = 0;
+      {
+        const Stripe& st = StripeFor(dig);
+        std::lock_guard<RankedMutex> lk(st.mu);
+        auto it = st.lens.find(dig);
+        if (it != st.lens.end()) len = it->second;
+      }
+      corrupt->push_back({dig, len});
+    }
+  }
+  for (const std::string& key : res.corrupt_recipe_keys) {
+    // Preserve the bytes for forensics, then KILL the slot: a live
+    // corrupt recipe would keep HasRecipe() true, which blocks the
+    // idempotent sync-replay re-store and recovery's resume check —
+    // the file would stay unreadable forever despite healthy replicas,
+    // and its slab could never finish compacting.  Dead, the name
+    // reads as absent and replica re-sync/recovery recreates it.
+    std::string payload, werr;
+    if (slab_->Read(kSlabKindRecipe, key, &payload)) {
+      mkdir((store_path_ + "/data/quarantine").c_str(), 0755);
+      std::string qname = key;
+      for (char& c : qname)
+        if (c == '/') c = '_';
+      if (!WriteChunkFile(store_path_ + "/data/quarantine/recipe_" + qname,
+                          payload.data(), payload.size(), &werr))
+        FDFS_LOG_WARN("slab compact: quarantine copy of recipe %s: %s",
+                      key.c_str(), werr.c_str());
+    }
+    slab_->MarkDead(kSlabKindRecipe, key);
+    FDFS_LOG_ERROR("slab compact: recipe record %s failed re-verify — "
+                   "slot killed (bytes preserved under data/quarantine/); "
+                   "replica re-sync/recovery recreates the file",
+                   key.c_str());
+    if (events_ != nullptr)
+      events_->Record(EventSeverity::kError, "slab.recipe_corrupt", key,
+                      "bytes=" + std::to_string(payload.size()));
+  }
+  if (events_ != nullptr && res.slabs_compacted > 0)
+    events_->Record(EventSeverity::kInfo, "slab.compact", store_path_,
+                    "slabs=" + std::to_string(res.slabs_compacted) +
+                        " reclaimed_bytes=" +
+                        std::to_string(res.reclaimed_bytes) +
+                        " copied=" + std::to_string(res.copied_records));
+  return res.slabs_compacted;
 }
 
 int64_t ChunkStore::GcSweep(int64_t now_s, int64_t* bytes) {
@@ -741,6 +953,7 @@ int64_t ChunkStore::GcSweep(int64_t now_s, int64_t* bytes) {
 namespace {
 
 void WalkRecipes(const std::string& dir,
+                 const std::function<bool(const std::string&)>& skip_flat,
                  std::unordered_map<std::string, int64_t>* refs,
                  std::unordered_map<std::string, int64_t>* lens) {
   DIR* d = opendir(dir.c_str());
@@ -753,10 +966,12 @@ void WalkRecipes(const std::string& dir,
     struct stat st;
     if (stat(path.c_str(), &st) != 0) continue;
     if (S_ISDIR(st.st_mode)) {
-      if (name != "chunks" && name != "sync" && name != "tmp")
-        WalkRecipes(path, refs, lens);
+      if (name != "chunks" && name != "sync" && name != "tmp" &&
+          name != "slabs")
+        WalkRecipes(path, skip_flat, refs, lens);
     } else if (name.size() > 4 &&
                name.compare(name.size() - 4, 4, ".rcp") == 0) {
+      if (skip_flat != nullptr && skip_flat(path)) continue;
       auto r = ReadRecipeFile(path);
       if (!r.has_value()) {
         FDFS_LOG_WARN("unreadable recipe %s ignored", path.c_str());
@@ -774,8 +989,44 @@ void WalkRecipes(const std::string& dir,
 }  // namespace
 
 void ChunkStore::RebuildFromRecipes() {
+  // Slab slot index first: recipes may live there, and the orphan scan
+  // below needs the chunk records indexed.  Same no-binlog philosophy —
+  // the slab headers on disk are the ground truth.
+  if (slab_ != nullptr) slab_->ScanRebuild();
+
   std::unordered_map<std::string, int64_t> refs, lens;
-  WalkRecipes(store_path_ + "/data", &refs, &lens);
+  // Cross-layout dedup: a crash inside StoreRecipe (between the slab
+  // append and the flat-twin unlink, or vice versa) can leave BOTH
+  // representations of one recipe on disk.  They encode the identical
+  // Recipe (one StoreRecipe call wrote both), so count refs from the
+  // slab copy only and drop the flat twin — double-counting would pin
+  // the file's chunks with refs that no single delete can release.
+  auto skip_flat = [this](const std::string& rcp_path) {
+    if (slab_ == nullptr ||
+        !slab_->Has(kSlabKindRecipe, RecipeSlabKey(rcp_path)))
+      return false;
+    FDFS_LOG_INFO("recipe %s exists in both layouts (crash window): "
+                  "keeping the slab record, dropping the flat twin",
+                  rcp_path.c_str());
+    unlink(rcp_path.c_str());
+    return true;
+  };
+  WalkRecipes(store_path_ + "/data", skip_flat, &refs, &lens);
+  if (slab_ != nullptr) {
+    slab_->ForEachLive(
+        kSlabKindRecipe,
+        [&](const std::string& key, const std::string& payload) {
+          auto r = DecodeRecipe(payload.data(), payload.size());
+          if (!r.has_value()) {
+            FDFS_LOG_WARN("unreadable slab recipe %s ignored", key.c_str());
+            return;
+          }
+          for (const RecipeEntry& e : r->chunks) {
+            refs[e.digest_hex]++;
+            lens[e.digest_hex] = e.length;
+          }
+        });
+  }
 
   // GC pass: any chunk file not named by a recipe is an orphan — a
   // crash leftover, or (with a GC grace window) a deliberately-retired
@@ -824,6 +1075,31 @@ void ChunkStore::RebuildFromRecipes() {
     }
     closedir(d1);
   }
+  // Slab-resident orphans: live chunk records no recipe names.  Grace
+  // mode parks them (aged by the record's mtime, so the window is
+  // crash-safe like the flat path's file-mtime aging); eager mode marks
+  // the slots dead on the spot.
+  if (slab_ != nullptr) {
+    std::vector<std::string> dead;
+    slab_->ForEachLiveMeta(
+        kSlabKindChunk, [&](const SlabStore::RecordMeta& m) {
+          if (refs.find(m.key) != refs.end()) {
+            lens.emplace(m.key, m.payload_len);
+            return;
+          }
+          if (gc_grace_s_ > 0) {
+            zero[m.key] = ZeroRef{m.payload_len,
+                                  m.mtime > 0 ? m.mtime : time(nullptr)};
+            lens[m.key] = m.payload_len;
+            ++parked;
+          } else {
+            dead.push_back(m.key);
+            ++orphans;
+          }
+        });
+    for (const std::string& key : dead)
+      slab_->MarkDead(kSlabKindChunk, key);
+  }
 
   // Quarantine survives restarts: a referenced digest whose bytes sit in
   // quarantine/ must keep reading as missing (and healable), or a
@@ -837,11 +1113,17 @@ void ChunkStore::RebuildFromRecipes() {
     while ((qe = readdir(qd)) != nullptr) {
       std::string name = qe->d_name;
       if (name[0] == '.') continue;
+      // Forensic copies of corrupt slab RECIPES (CompactSlabs) keep
+      // their bytes across restarts — the operator drains them by hand
+      // like chunk quarantine files.
+      if (name.compare(0, 7, "recipe_") == 0) continue;
       if (IsHex40(name) && refs.find(name) != refs.end()) {
         struct stat st;
-        if (stat(ChunkPath(name).c_str(), &st) == 0) {
-          // A healed copy already lives in chunks/ (crash between the
-          // repair write and the quarantine unlink): prefer it.
+        if (stat(ChunkPath(name).c_str(), &st) == 0 ||
+            (slab_ != nullptr && slab_->Has(kSlabKindChunk, name))) {
+          // A healed copy already lives in chunks/ or the slab store
+          // (crash between the repair write and the quarantine
+          // unlink): prefer it.
           unlink((qroot + "/" + name).c_str());
         } else {
           quarantined.insert(name);

@@ -45,7 +45,21 @@
 // re-check refs+quarantine UNDER the digest's stripe lock, and
 // Quarantine(), RepairChunk(), and the GC/delete unlink invalidate
 // under that same lock — a quarantined or swept chunk can never be
-// served from the cache afterward.
+// served from the cache afterward.  Slab-resident chunks key the cache
+// identically to flat ones (by digest), so the same invalidation
+// points cover both layouts.
+//
+// Slab packing (ISSUE 9 / ROADMAP item 1): chunks below
+// slab_chunk_threshold and recipe payloads below slab_recipe_threshold
+// live as records inside <store_path>/data/slabs/*.slab
+// (storage/slabstore.h) instead of per-object inodes.  Every
+// per-digest invariant is unchanged — the slab store is a payload
+// landing zone consulted under the SAME stripe-lock acquisitions that
+// previously wrote/unlinked flat files (slab lock ranks sit between
+// kChunkStripe and kReadCache).  Recipes load/store through
+// StoreRecipe/LoadRecipe, which route small ones into the slab keyed
+// by their sidecar path relative to the store root (mixed stores read
+// both layouts, so flipping the thresholds is always safe).
 //
 // Reference anchor: replaces the inode-per-file write in
 // storage/storage_dio.c:dio_write_file() for deduplicated uploads.
@@ -56,6 +70,7 @@
 #include "common/lockrank.h"
 #include <atomic>
 #include <cstdint>
+#include <functional>
 #include <list>
 #include <memory>
 #include <mutex>
@@ -64,6 +79,8 @@
 #include <unordered_map>
 #include <unordered_set>
 #include <vector>
+
+#include "storage/slabstore.h"
 
 namespace fdfs {
 
@@ -77,10 +94,24 @@ struct Recipe {
   std::vector<RecipeEntry> chunks;
 };
 
-// Recipe file codec ("FDFSRCP1" magic + BE fields; see chunkstore.cc).
+// Recipe codec ("FDFSRCP1" magic + BE fields; see chunkstore.cc).  The
+// buffer forms are the shared core: recipe files and slab-resident
+// recipe records carry identical bytes.
+std::string EncodeRecipe(const Recipe& r);
+std::optional<Recipe> DecodeRecipe(const char* data, size_t len);
 bool WriteRecipeFile(const std::string& path, const Recipe& r,
                      std::string* err);
 std::optional<Recipe> ReadRecipeFile(const std::string& path);
+
+// Slab-packing knobs (storage.conf slab_* keys; see slabstore.h).
+// Thresholds of 0 disable packing for that record class; both 0 = no
+// slab store at all (the pre-slab flat layout).
+struct SlabOptions {
+  int64_t chunk_threshold = 0;   // chunks below this pack into slabs
+  int64_t recipe_threshold = 0;  // encoded recipes below this pack too
+  int64_t slab_bytes = 64LL << 20;
+  int compact_min_dead_pct = 25;
+};
 
 class ChunkStore {
  public:
@@ -89,7 +120,8 @@ class ChunkStore {
   // the pre-scrubber behavior).  read_cache_bytes bounds the hot-chunk
   // LRU read cache (0 = off).
   explicit ChunkStore(std::string store_path, int64_t gc_grace_s = 0,
-                      int64_t read_cache_bytes = 0);
+                      int64_t read_cache_bytes = 0,
+                      SlabOptions slab = SlabOptions{});
 
   // Flight recorder (common/eventlog.h; may stay null): the store
   // reports heal-on-upload — a quarantined chunk restored by an
@@ -204,6 +236,37 @@ class ChunkStore {
   std::string ChunkPath(const std::string& digest_hex) const;
   std::string QuarantinePath(const std::string& digest_hex) const;
 
+  // -- recipe sidecars (slab-aware; storage/slabstore.h) -----------------
+  // All take the recipe's SIDECAR PATH (<local>.rcp) like the old
+  // file-level codec did; small recipes land as slab records keyed by
+  // that path relative to the store root, large ones stay flat files.
+  // Loads consult both layouts, so a threshold change never strands
+  // existing data.
+  bool StoreRecipe(const std::string& rcp_path, const Recipe& r,
+                   std::string* err);
+  std::optional<Recipe> LoadRecipe(const std::string& rcp_path) const;
+  bool HasRecipe(const std::string& rcp_path) const;
+  // Remove whichever representation exists; *bytes_out (optional) gets
+  // the on-disk bytes reclaimed (scrub.bytes_reclaimed accounting).
+  // False when no recipe existed under the path.
+  bool RemoveRecipe(const std::string& rcp_path, int64_t* bytes_out);
+
+  // -- slab packing ------------------------------------------------------
+  bool slab_enabled() const { return slab_ != nullptr; }
+  SlabStore* slab() { return slab_.get(); }  // tests / stats plumbing
+  // slab.* registry gauges (all 0 when packing is off).
+  int64_t slab_files() const { return slab_ ? slab_->files() : 0; }
+  int64_t slab_slots_live() const { return slab_ ? slab_->slots_live() : 0; }
+  int64_t slab_slots_dead() const { return slab_ ? slab_->slots_dead() : 0; }
+  int64_t slab_bytes_live() const { return slab_ ? slab_->bytes_live() : 0; }
+  int64_t slab_bytes_dead() const { return slab_ ? slab_->bytes_dead() : 0; }
+  int64_t slab_compactions() const {
+    return slab_ ? slab_->compactions() : 0;
+  }
+  int64_t slab_compacted_bytes() const {
+    return slab_ ? slab_->compacted_bytes() : 0;
+  }
+
   // -- integrity engine (storage/scrub.*) --------------------------------
   struct ChunkInfo {
     std::string digest_hex;
@@ -246,6 +309,18 @@ class ChunkStore {
   // Returns the number of chunks unlinked; *bytes accumulates sizes.
   int64_t GcSweep(int64_t now_s, int64_t* bytes);
 
+  // Paced online compaction of dead slab space (driven from the scrub
+  // pass, sharing its token bucket via `pace` and its shutdown flag via
+  // `stop`).  Chunk records that failed the copy-time re-verify come
+  // back in *corrupt so the caller can route them through the standard
+  // quarantine/repair machinery (ScrubManager::HandleCorrupt); corrupt
+  // recipe records are only counted — their files fail loudly on read
+  // and heal via replica re-sync.  Returns slabs reclaimed; *reclaimed
+  // accumulates unlinked slab-file bytes.  No-op when packing is off.
+  int64_t CompactSlabs(const std::function<void(int64_t)>& pace,
+                       const std::function<bool()>& stop,
+                       std::vector<ChunkInfo>* corrupt, int64_t* reclaimed);
+
   int64_t unique_chunks() const;
   int64_t unique_bytes() const { return unique_bytes_.load(); }
   int64_t gc_pending_chunks() const;
@@ -280,9 +355,22 @@ class ChunkStore {
   // (gc_grace_s_ == 0 and unpinned).
   void RetireLocked(Stripe& s, const std::string& digest_hex,
                     int64_t length);
-  // stripe mu held.  Unlink a zero-ref chunk's bytes (chunks/ and
-  // quarantine/) and invalidate any cached copy.
+  // stripe mu held.  Unlink a zero-ref chunk's bytes (chunks/,
+  // quarantine/, and any slab record) and invalidate any cached copy.
   void UnlinkRetiredLocked(Stripe& s, const std::string& digest_hex);
+  // Should a fresh chunk payload of this size land in the slab store?
+  bool SlabChunkEligible(int64_t len) const {
+    return slab_ != nullptr && slab_opts_.chunk_threshold > 0 &&
+           len < slab_opts_.chunk_threshold;
+  }
+  // stripe mu held.  Write/replace a chunk payload in whichever layout
+  // its size selects (slab record or flat file) — the shared landing
+  // path of PutAndRef's first write, heal-on-upload, and RepairChunk.
+  bool WriteChunkPayloadLocked(const std::string& digest_hex,
+                               const char* data, size_t len,
+                               std::string* err);
+  // Slab key for a recipe sidecar path (relative to the store root).
+  std::string RecipeSlabKey(const std::string& rcp_path) const;
 
   // -- read cache internals ----------------------------------------------
   struct CacheEntry {
@@ -309,6 +397,8 @@ class ChunkStore {
 
   std::string store_path_;
   int64_t gc_grace_s_ = 0;
+  SlabOptions slab_opts_;
+  std::unique_ptr<SlabStore> slab_;  // null = flat layout only
   class EventLog* events_ = nullptr;
   std::array<Stripe, kStripes> stripes_;
   std::atomic<int64_t> unique_bytes_{0};

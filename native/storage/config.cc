@@ -101,6 +101,38 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
   }
   chunk_gc_grace_s = ini.GetSeconds("chunk_gc_grace_s", chunk_gc_grace_s);
   if (chunk_gc_grace_s < 0) chunk_gc_grace_s = 0;
+  slab_chunk_threshold =
+      ini.GetBytes("slab_chunk_threshold", slab_chunk_threshold);
+  if (slab_chunk_threshold < 0) slab_chunk_threshold = 0;
+  slab_recipe_threshold =
+      ini.GetBytes("slab_recipe_threshold", slab_recipe_threshold);
+  if (slab_recipe_threshold < 0) slab_recipe_threshold = 0;
+  slab_size_mb = static_cast<int>(ini.GetInt("slab_size_mb", slab_size_mb));
+  if (slab_size_mb < 1) {
+    note("slab_size_mb raised to 1");
+    slab_size_mb = 1;
+  }
+  // 1 GB cap: compaction rewrites a whole victim slab per pass slice,
+  // and a bigger slab only dilutes the dead-share trigger.
+  if (slab_size_mb > 1024) {
+    note("slab_size_mb clamped to 1024");
+    slab_size_mb = 1024;
+  }
+  // A record must FIT a slab with room to spare or the active slab
+  // rolls on every append; cap both thresholds at half the slab.
+  int64_t slab_cap = (static_cast<int64_t>(slab_size_mb) << 20) / 2;
+  if (slab_chunk_threshold > slab_cap) {
+    note("slab_chunk_threshold clamped to slab_size_mb/2");
+    slab_chunk_threshold = slab_cap;
+  }
+  if (slab_recipe_threshold > slab_cap) {
+    note("slab_recipe_threshold clamped to slab_size_mb/2");
+    slab_recipe_threshold = slab_cap;
+  }
+  slab_compact_min_dead_pct = static_cast<int>(
+      ini.GetInt("slab_compact_min_dead_pct", slab_compact_min_dead_pct));
+  if (slab_compact_min_dead_pct < 1) slab_compact_min_dead_pct = 1;
+  if (slab_compact_min_dead_pct > 100) slab_compact_min_dead_pct = 100;
   read_cache_mb = static_cast<int>(ini.GetInt("read_cache_mb",
                                               read_cache_mb));
   if (read_cache_mb < 0) read_cache_mb = 0;

@@ -84,6 +84,19 @@ struct StorageConfig {
   int scrub_interval_s = 86400;
   int scrub_bandwidth_mb_s = 0;
   int64_t chunk_gc_grace_s = 0;
+  // Slab packing (storage/slabstore.h; OPERATIONS.md "Slab packing &
+  // compaction"): chunks below slab_chunk_threshold and encoded
+  // recipes below slab_recipe_threshold are appended into
+  // slab_size_mb slab files under <store_path>/data/slabs/ instead of
+  // per-object inodes — the billion-small-files layout.  Thresholds of
+  // 0 disable packing for that class (both 0 = flat layout only).
+  // slab_compact_min_dead_pct: a slab becomes a compaction victim once
+  // deletes mark that share of its bytes dead (the scrub pass drives
+  // paced compaction).
+  int64_t slab_chunk_threshold = 64 * 1024;
+  int64_t slab_recipe_threshold = 64 * 1024;
+  int slab_size_mb = 64;
+  int slab_compact_min_dead_pct = 25;
   // Hot-chunk read cache (per store path): bounded LRU of chunk
   // payloads consulted by DOWNLOAD_FILE / FETCH_CHUNK, invalidated on
   // quarantine and GC unlink (OPERATIONS.md "Read path, caching &

@@ -546,12 +546,13 @@ bool RecoveryManager::StoreRecovered(const std::string& remote,
     unlink(tmp_path.c_str());
     return false;
   }
-  StoreManager::EnsureParentDirs(*local);
   // Dedup parity with the upload/sync paths: chunk-eligible recovered
   // files go through the chunk store (recipe + content-addressed chunks)
   // so a rebuilt node deduplicates like its peers; failure of any kind
   // falls back to the flat copy.  Appenders stay flat everywhere
   // (mutable: later APPEND/MODIFY ops open the flat file in place).
+  // Parent fan-out dirs materialize only with a flat inode — a
+  // slab-resident recipe costs zero inodes, dirs included.
   struct stat st;
   if (chunked_store_ && chunk_threshold_ > 0 &&
       !(parts.has_value() && parts->appender) &&
@@ -561,6 +562,7 @@ bool RecoveryManager::StoreRecovered(const std::string& remote,
       return true;
     }
   }
+  StoreManager::EnsureParentDirs(*local);
   if (rename(tmp_path.c_str(), local->c_str()) != 0) {
     unlink(tmp_path.c_str());
     return false;

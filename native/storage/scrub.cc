@@ -247,6 +247,33 @@ void ScrubManager::RunPass() {
         events_->Record(EventSeverity::kInfo, "gc.sweep", key, detail);
       }
     }
+
+    // Slab compaction (ISSUE 9): right after GC marked slots dead, copy
+    // the live records out of the deadest slabs and unlink them —
+    // paced by the SAME token bucket as verify reads, so compaction IO
+    // never starves foreground traffic either.  Records that fail the
+    // copy-time re-verify come back here and ride the standard
+    // quarantine -> replica-repair machinery (HandleCorrupt marks the
+    // slot dead, so the next pass finishes the slab).
+    std::vector<ChunkStore::ChunkInfo> slab_corrupt;
+    int64_t slab_reclaimed = 0;
+    int64_t compacted = cs->CompactSlabs(
+        [&](int64_t b) {
+          paced += b;
+          Pace(paced, start_us);
+        },
+        [this]() {
+          std::lock_guard<RankedMutex> lk(mu_);
+          return stop_;
+        },
+        &slab_corrupt, &slab_reclaimed);
+    for (const auto& info : slab_corrupt)
+      HandleCorrupt(static_cast<int>(spi), info);
+    if (compacted > 0)
+      FDFS_LOG_INFO("scrub: compacted %lld slabs on store path %zu "
+                    "(%lld bytes reclaimed)",
+                    static_cast<long long>(compacted), spi,
+                    static_cast<long long>(slab_reclaimed));
   }
 
   int64_t dur = WallUs() - start_us;

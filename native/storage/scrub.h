@@ -21,7 +21,12 @@
 //      scrub.corrupt_unrepairable (retried every pass);
 //   3. GC: reclaims zero-ref chunks older than chunk_gc_grace_s
 //      (ChunkStore::GcSweep — the pin probe shares the unlink's lock,
-//      so phase-1 upload-session pins are race-free exempt).
+//      so phase-1 upload-session pins are race-free exempt);
+//   4. SLAB COMPACTION (ISSUE 9): copies live records out of slab
+//      files whose dead share crossed slab_compact_min_dead_pct and
+//      unlinks them (ChunkStore::CompactSlabs), paced by the same
+//      token bucket; copy-time re-verify failures feed back into the
+//      quarantine/repair machinery above.
 //
 // Observable through the SCRUB_STATUS opcode (kScrubStatNames blob),
 // the stats registry (scrub.* gauges), and the trace ring (scrub.pass

@@ -139,10 +139,15 @@ bool StorageServer::Init(std::string* error) {
   if (dedup_ != nullptr && cfg_.dedup_chunk_threshold > 0) {
     // Chunk-level dedup: one content-addressed store per store path;
     // refcounts rebuilt from recipes (doubles as orphan GC).
+    SlabOptions sopts;
+    sopts.chunk_threshold = cfg_.slab_chunk_threshold;
+    sopts.recipe_threshold = cfg_.slab_recipe_threshold;
+    sopts.slab_bytes = static_cast<int64_t>(cfg_.slab_size_mb) << 20;
+    sopts.compact_min_dead_pct = cfg_.slab_compact_min_dead_pct;
     for (int i = 0; i < store_.store_path_count(); ++i) {
       chunk_stores_.push_back(std::make_unique<ChunkStore>(
           store_.store_path(i), cfg_.chunk_gc_grace_s,
-          static_cast<int64_t>(cfg_.read_cache_mb) << 20));
+          static_cast<int64_t>(cfg_.read_cache_mb) << 20, sopts));
       chunk_stores_.back()->set_events(events_.get());
       chunk_stores_.back()->RebuildFromRecipes();
     }
@@ -299,7 +304,6 @@ bool StorageServer::Init(std::string* error) {
                              const std::string& remote) {
             auto local = LocalPath(store_.store_path(spi), remote);
             if (!local.has_value()) return false;
-            StoreManager::EnsureParentDirs(*local);
             int64_t saved = 0, hits = 0;
             return ChunkedStoreWith(rec_plugin, tmp, spi, size,
                                     *local + ".rcp",
@@ -321,14 +325,13 @@ bool StorageServer::Init(std::string* error) {
             ChunkStore* cs = chunk_stores_[spi].get();
             auto local = LocalPath(store_.store_path(spi), remote);
             if (!local.has_value()) return false;
-            // Resumed recovery: both write paths are atomic
-            // (write-then-rename), so an existing file/recipe is
+            // Resumed recovery: both write paths are atomic (rename /
+            // append-then-publish), so an existing file/recipe is
             // complete — re-storing would only inflate chunk refs.
             struct stat st;
             if (stat(local->c_str(), &st) == 0 ||
-                stat((*local + ".rcp").c_str(), &st) == 0)
+                cs->HasRecipe(*local + ".rcp"))
               return true;
-            StoreManager::EnsureParentDirs(*local);
             Recipe done;  // every ref taken so far (rollback set)
             done.logical_size = r.logical_size;
             auto fail = [&]() {
@@ -383,7 +386,7 @@ bool StorageServer::Init(std::string* error) {
               }
             }
             std::string err;
-            if (!WriteRecipeFile(*local + ".rcp", r, &err)) return fail();
+            if (!cs->StoreRecipe(*local + ".rcp", r, &err)) return fail();
             // Sidecar mode: re-register the file with the dedup engine
             // (near-dup signature + attributions) exactly as an upload
             // would — zero extra wire, the bytes are local now.  The
@@ -683,6 +686,12 @@ void StorageServer::InitStatsRegistry() {
   RefreshDiskUsedPct();
   registry_.GaugeFn("store.disk_used_pct",
                     [this] { return disk_used_pct_.load(); });
+  // Filesystem inodes in use — refreshed off the registry lock exactly
+  // like disk_used_pct (gauge-fns must never statvfs a stalled mount
+  // under the registry mutex).  The number the slab-packing layout
+  // (ISSUE 9) exists to flatten on small-file corpora.
+  registry_.GaugeFn("store.inodes_used",
+                    [this] { return inodes_used_.load(); });
   // Tracing health: ring throughput/overwrite pressure and the slow gate.
   registry_.GaugeFn("trace.spans_recorded", [this] {
     return trace_ != nullptr ? trace_->recorded() : int64_t{0};
@@ -744,6 +753,30 @@ void StorageServer::InitStatsRegistry() {
   });
   registry_.GaugeFn("cache.capacity_bytes", [cache_sum] {
     return cache_sum(&ChunkStore::cache_capacity_bytes);
+  });
+  // Slab packing (ISSUE 9): slot/byte live-vs-dead accounting plus the
+  // compactor's lifetime work, summed over the per-store-path slab
+  // stores (all zero when slab_*_threshold = 0).
+  registry_.GaugeFn("slab.files", [cache_sum] {
+    return cache_sum(&ChunkStore::slab_files);
+  });
+  registry_.GaugeFn("slab.slots_live", [cache_sum] {
+    return cache_sum(&ChunkStore::slab_slots_live);
+  });
+  registry_.GaugeFn("slab.slots_dead", [cache_sum] {
+    return cache_sum(&ChunkStore::slab_slots_dead);
+  });
+  registry_.GaugeFn("slab.bytes_live", [cache_sum] {
+    return cache_sum(&ChunkStore::slab_bytes_live);
+  });
+  registry_.GaugeFn("slab.bytes_dead", [cache_sum] {
+    return cache_sum(&ChunkStore::slab_bytes_dead);
+  });
+  registry_.GaugeFn("slab.compactions", [cache_sum] {
+    return cache_sum(&ChunkStore::slab_compactions);
+  });
+  registry_.GaugeFn("slab.compacted_bytes", [cache_sum] {
+    return cache_sum(&ChunkStore::slab_compacted_bytes);
   });
 
   // Snapshot-time mirrors of live state.  The restart-persisted op
@@ -833,6 +866,8 @@ std::string StorageServer::BuildStatsJson() {
 
 void StorageServer::RefreshDiskUsedPct() {
   int64_t worst = 0;
+  int64_t inodes = 0;
+  std::vector<unsigned long> seen_fsids;
   for (int i = 0; i < store_.store_path_count(); ++i) {
     struct statvfs vfs;
     if (statvfs(store_.store_path(i).c_str(), &vfs) != 0 ||
@@ -842,8 +877,19 @@ void StorageServer::RefreshDiskUsedPct() {
         100.0 * (1.0 - static_cast<double>(vfs.f_bavail) /
                            static_cast<double>(vfs.f_blocks)));
     if (pct > worst) worst = pct;
+    // Inodes in use, deduped by filesystem id (two store paths on one
+    // filesystem must not double-count): the store.inodes_used gauge
+    // that the slab-packing bench (config9) reads before/after.
+    bool dup = false;
+    for (unsigned long id : seen_fsids) dup = dup || id == vfs.f_fsid;
+    if (!dup) {
+      seen_fsids.push_back(vfs.f_fsid);
+      if (vfs.f_files >= vfs.f_ffree)
+        inodes += static_cast<int64_t>(vfs.f_files - vfs.f_ffree);
+    }
   }
   disk_used_pct_.store(worst);
+  inodes_used_.store(inodes);
 }
 
 void StorageServer::MetricsTick() {
@@ -2226,11 +2272,13 @@ void StorageServer::SyncCreateComplete(Conn* c) {
       Respond(c, 22);
       return;
     }
-    StoreManager::EnsureParentDirs(local);
     // Replicas dedup too: chunk-eligible synced files go through the
     // chunk store (same cut-points cluster-wide), others stay flat.
     // Appenders stay flat everywhere (mutable: later SYNC_APPEND/MODIFY
     // ops open the flat file in place — a recipe would break them).
+    // Parent dirs only materialize when a flat inode is written (the
+    // recipe store handles its own sidecar): slab-resident replicas
+    // must cost zero fan-out directories too.
     struct stat st;
     if (!(tparts.has_value() && tparts->appender) &&
         stat(c->tmp_path.c_str(), &st) == 0 && ChunkEligible(st.st_size)) {
@@ -2248,6 +2296,7 @@ void StorageServer::SyncCreateComplete(Conn* c) {
         return;
       }
     }
+    StoreManager::EnsureParentDirs(local);
     if (rename(c->tmp_path.c_str(), local.c_str()) != 0) {
       unlink(c->tmp_path.c_str());
       Respond(c, 5);
@@ -2313,7 +2362,7 @@ void StorageServer::HandleFetchRecipe(Conn* c) {
     Respond(c, 22);
     return;
   }
-  auto r = ReadRecipeFile(local + ".rcp");
+  auto r = LoadRecipeFor(local);
   if (!r.has_value()) {
     Respond(c, 2 /*ENOENT: flat or gone*/);
     return;
@@ -2782,8 +2831,7 @@ void StorageServer::UploadChunksComplete(Conn* c) {
     fail(ok ? 22 : 5);
     return;
   }
-  StoreManager::EnsureParentDirs(*local);
-  if (!WriteRecipeFile(*local + ".rcp", done, &err)) {
+  if (!s->cs->StoreRecipe(*local + ".rcp", done, &err)) {
     FDFS_LOG_ERROR("negotiated upload recipe write: %s", err.c_str());
     s->cs->UnrefAll(done);
     fail(5);
@@ -2841,14 +2889,12 @@ void StorageServer::SyncRecipeComplete(Conn* c) {
   }
   // Idempotent replay: already materialized (flat or recipe) => done.
   struct stat st;
-  if (stat(local.c_str(), &st) == 0 ||
-      stat((local + ".rcp").c_str(), &st) == 0) {
+  if (stat(local.c_str(), &st) == 0 || RecipeExistsFor(local)) {
     unlink(c->tmp_path.c_str());
     binlog_.Append('c', c->sync_remote);
     Respond(c, 0);
     return;
   }
-  StoreManager::EnsureParentDirs(local);
   ChunkStore* cs = chunk_stores_[c->store_path_index].get();
   const uint8_t* entries = p + 48 + name_len;
   // Validate every declared length BEFORE any side effects: an oversized
@@ -2930,7 +2976,7 @@ void StorageServer::SyncRecipeComplete(Conn* c) {
   c->tmp_path.clear();
   std::string err;
   if (!ok || covered != logical ||
-      !WriteRecipeFile(local + ".rcp", recipe, &err)) {
+      !cs->StoreRecipe(local + ".rcp", recipe, &err)) {
     cs->UnrefAll(recipe);  // roll back what this replay referenced
     Respond(c, ok ? (covered != logical ? 22 : 5) : fail_status);
     return;
@@ -3315,7 +3361,7 @@ bool StorageServer::RemoteExists(const std::string& group,
   }
   struct stat st;
   return stat(local.c_str(), &st) == 0 ||
-         stat((local + ".rcp").c_str(), &st) == 0;  // chunk recipe
+         RecipeExistsFor(local);  // chunk recipe (flat or slab record)
 }
 
 // FETCH_ONE_PATH_BINLOG (26): binlog records whose file lives on the
@@ -3404,7 +3450,9 @@ void StorageServer::FinishUpload(Conn* c) {
       std::string local = LocalPath(store_.store_path(c->store_path_index),
                                     parts->RemoteFilename())
                               .value();
-      StoreManager::EnsureParentDirs(local);
+      // No EnsureParentDirs here: a slab-resident recipe needs no
+      // fan-out directory (StoreRecipe creates the chain only for the
+      // flat sidecar; the flat-store fallback below makes its own).
       int64_t saved = 0, hits = 0;
       ChunkStageUs st;
       if (StoreChunkedFromTmp(c->tmp_path, c->store_path_index, c->file_size,
@@ -3539,13 +3587,27 @@ bool StorageServer::ChunkEligible(int64_t size) const {
          size >= cfg_.dedup_chunk_threshold && !chunk_stores_.empty();
 }
 
-ChunkStore* StorageServer::StoreForLocal(const std::string& local) {
+ChunkStore* StorageServer::StoreForLocal(const std::string& local) const {
   for (int i = 0; i < store_.store_path_count() &&
                   i < static_cast<int>(chunk_stores_.size()); ++i) {
     const std::string& sp = store_.store_path(i);
     if (local.compare(0, sp.size(), sp) == 0) return chunk_stores_[i].get();
   }
   return nullptr;
+}
+
+std::optional<Recipe> StorageServer::LoadRecipeFor(
+    const std::string& local) const {
+  ChunkStore* cs = StoreForLocal(local);
+  return cs != nullptr ? cs->LoadRecipe(local + ".rcp")
+                       : ReadRecipeFile(local + ".rcp");
+}
+
+bool StorageServer::RecipeExistsFor(const std::string& local) const {
+  ChunkStore* cs = StoreForLocal(local);
+  if (cs != nullptr) return cs->HasRecipe(local + ".rcp");
+  struct stat st;
+  return stat((local + ".rcp").c_str(), &st) == 0;
 }
 
 bool StorageServer::StoreChunkedFromTmp(const std::string& tmp_path, int spi,
@@ -3635,7 +3697,7 @@ bool StorageServer::ChunkedStoreWith(DedupPlugin* plugin,
   }
   close(fd);
   std::string err;
-  if (!ok || !WriteRecipeFile(rcp_path, recipe, &err)) {
+  if (!ok || !cs->StoreRecipe(rcp_path, recipe, &err)) {
     if (ok) FDFS_LOG_ERROR("recipe write: %s", err.c_str());
     // Roll back references taken so far; untouched chunks stay for
     // other recipes, newly-written orphans fall to the startup GC.
@@ -3650,7 +3712,7 @@ bool StorageServer::ChunkedStoreWith(DedupPlugin* plugin,
 int64_t StorageServer::LogicalSize(const std::string& local) const {
   struct stat st;
   if (stat(local.c_str(), &st) == 0) return st.st_size;
-  auto r = ReadRecipeFile(local + ".rcp");
+  auto r = LoadRecipeFor(local);
   return r.has_value() ? r->logical_size : -1;
 }
 
@@ -3662,14 +3724,25 @@ int StorageServer::OpenLogical(const std::string& local, int64_t* size) {
     *size = st.st_size;
     return fd;
   }
-  auto r = ReadRecipeFile(local + ".rcp");
+  auto r = LoadRecipeFor(local);
   if (!r.has_value()) return -1;
   ChunkStore* cs = StoreForLocal(local);
   if (cs == nullptr) return -1;
   // Materialize into an unlinked temp file: downstream sendfile paths
   // (downloads, sync replication) keep working unchanged, and the bytes
-  // are reclaimed automatically on close.
-  std::string tmp = local + ".assm." + std::to_string(getpid());
+  // are reclaimed automatically on close.  The temp lives under the
+  // store path's always-present tmp/ dir, NOT next to `local` — a
+  // slab-resident recipe's fan-out directory may never have existed
+  // (lazy dirs are the slab layout's inode win).
+  std::string tmp;
+  for (int i = 0; i < store_.store_path_count(); ++i) {
+    const std::string& sp = store_.store_path(i);
+    if (local.compare(0, sp.size(), sp) == 0) {
+      tmp = store_.NewTmpPath(i);
+      break;
+    }
+  }
+  if (tmp.empty()) tmp = local + ".assm." + std::to_string(getpid());
   fd = open(tmp.c_str(), O_CREAT | O_RDWR | O_TRUNC, 0600);
   if (fd < 0) return -1;
   unlink(tmp.c_str());
@@ -3700,15 +3773,21 @@ int StorageServer::RemoveLogical(const std::string& local,
                                  const std::string& file_ref) {
   // Delete the recipe sidecar WITH the file id and account its bytes to
   // the integrity engine (scrub.bytes_reclaimed / recipes_reclaimed):
-  // the .rcp is real disk the delete reclaims, same as the chunks GC
-  // frees later.
+  // the recipe — flat .rcp inode or slab record — is real disk the
+  // delete reclaims, same as the chunks GC frees later (slab records go
+  // dead now and the compactor returns the bytes).
   auto drop_recipe = [this, &local, &file_ref](const std::string& rcp) {
-    struct stat st;
-    int64_t rcp_bytes = stat(rcp.c_str(), &st) == 0 ? st.st_size : 0;
-    auto r = ReadRecipeFile(rcp);
-    if (!r.has_value()) return 2;
-    if (unlink(rcp.c_str()) != 0 && errno != ENOENT) return 5;
     ChunkStore* cs = StoreForLocal(local);
+    auto r = cs != nullptr ? cs->LoadRecipe(rcp) : ReadRecipeFile(rcp);
+    if (!r.has_value()) return 2;
+    int64_t rcp_bytes = 0;
+    if (cs != nullptr) {
+      if (!cs->RemoveRecipe(rcp, &rcp_bytes)) return 5;
+    } else {
+      struct stat st;
+      rcp_bytes = stat(rcp.c_str(), &st) == 0 ? st.st_size : 0;
+      if (unlink(rcp.c_str()) != 0 && errno != ENOENT) return 5;
+    }
     if (cs != nullptr) cs->UnrefAll(*r);
     if (dedup_ != nullptr) dedup_->ForgetChunked(file_ref);
     if (scrub_ != nullptr) scrub_->NoteRecipeReclaimed(rcp_bytes);
@@ -3718,9 +3797,8 @@ int StorageServer::RemoveLogical(const std::string& local,
   if (unlink(local.c_str()) == 0) {
     // Flat inode gone; also clear any stale recipe sidecar left under
     // the same name (belt-and-braces — the two should never coexist,
-    // but a leaked .rcp would hold chunk refs forever).
-    struct stat st;
-    if (stat(rcp.c_str(), &st) == 0) drop_recipe(rcp);
+    // but a leaked recipe would hold chunk refs forever).
+    if (RecipeExistsFor(local)) drop_recipe(rcp);
     return 0;
   }
   if (errno != ENOENT) return 5;
@@ -4437,11 +4515,17 @@ void StorageServer::HandleCreateLink(Conn* c) {
     // taking a reference on each chunk.
     bool linked = false;
     if (errno == ENOENT) {
-      auto r = ReadRecipeFile(sl + ".rcp");
+      auto r = LoadRecipeFor(sl);
       ChunkStore* cs = StoreForLocal(sl);
+      ChunkStore* tcs = StoreForLocal(tl);
       if (r.has_value() && cs != nullptr && cs->RefAll(*r)) {
         std::string err;
-        if (WriteRecipeFile(tl + ".rcp", *r, &err)) {
+        // Store through the TARGET path's store so LoadRecipeFor(tl)
+        // finds it in the same slab index it will later consult.
+        bool stored = tcs != nullptr
+                          ? tcs->StoreRecipe(tl + ".rcp", *r, &err)
+                          : WriteRecipeFile(tl + ".rcp", *r, &err);
+        if (stored) {
           linked = true;
         } else {
           cs->UnrefAll(*r);

@@ -36,6 +36,12 @@
 //                normalized rule table "name threshold clear enabled"
 //                — pins conf/slo.conf parsing across languages against
 //                fastdfs_tpu.monitor.parse_slo_rules)
+//   fdfs_codec slab-layout     (golden slab record + slot-index
+//                encoding: one fixture chunk record and one recipe
+//                record emitted as hex, then re-scanned with the boot
+//                decoder into index lines — pins the on-disk slab
+//                layout (storage/slabstore.h) against the Python
+//                parser in tests/harness.py / tests/test_slab.py)
 #include <time.h>
 
 #include <cstdio>
@@ -56,6 +62,7 @@
 #include "common/sloeval.h"
 #include "common/stats.h"
 #include "common/trace.h"
+#include "storage/slabstore.h"
 
 using namespace fdfs;
 
@@ -456,6 +463,59 @@ int main(int argc, char** argv) {
     for (const SloRule& r : SloEvaluator::LoadRules(ini))
       printf("%s %.6g %.6g %d\n", r.name.c_str(), r.threshold, r.clear,
              r.enabled ? 1 : 0);
+    return 0;
+  }
+  if (cmd == "slab-layout") {
+    // Fixed fixture — tests/test_slab.py builds the same records with
+    // the Python encoder (struct + zlib.crc32) and compares hex for
+    // hex, then parses them back with tests/harness.py's header
+    // scanner; the index lines below come from the C++ boot decoder,
+    // pinning BOTH directions of the slab layout across languages.
+    auto hex = [](const std::string& s) {
+      static const char* k = "0123456789abcdef";
+      std::string out;
+      for (unsigned char c : s) {
+        out.push_back(k[c >> 4]);
+        out.push_back(k[c & 0xF]);
+      }
+      return out;
+    };
+    const int64_t mtime = 1700000000;
+    std::string chunk_payload = "slab golden chunk payload 0123456789";
+    std::string chunk_key =
+        Sha1(chunk_payload.data(), chunk_payload.size()).Hex();
+    std::string recipe_payload("FDFSRCP1golden-recipe-bytes\x00\x7f\x01",
+                               30);
+    std::string recipe_key = "data/00/1A/golden.bin.rcp";
+    std::string buf =
+        SlabEncodeRecord(kSlabKindChunk, chunk_key, chunk_payload.data(),
+                         chunk_payload.size(), mtime) +
+        SlabEncodeRecord(kSlabKindRecipe, recipe_key,
+                         recipe_payload.data(), recipe_payload.size(),
+                         mtime);
+    printf("chunk_record=%s\n",
+           hex(buf.substr(0, kSlabRecordHeaderSize + chunk_key.size() +
+                                 chunk_payload.size()))
+               .c_str());
+    printf("recipe_record=%s\n",
+           hex(buf.substr(kSlabRecordHeaderSize + chunk_key.size() +
+                          chunk_payload.size()))
+               .c_str());
+    size_t off = 0;
+    while (off < buf.size()) {
+      SlabRecordView v;
+      if (!SlabDecodeRecord(buf.data() + off, buf.size() - off, &v)) {
+        printf("decode_error_at=%zu\n", off);
+        return 1;
+      }
+      printf("index=kind:%u key:%s record_off:%zu payload_off:%zu "
+             "payload_len:%lld crc:%u mtime:%lld flags:%u\n",
+             v.kind, v.key.c_str(), off,
+             off + kSlabRecordHeaderSize + v.key.size(),
+             static_cast<long long>(v.payload_len), v.payload_crc32,
+             static_cast<long long>(v.mtime), v.flags);
+      off += static_cast<size_t>(v.record_len);
+    }
     return 0;
   }
   if (cmd == "b64e" && argc == 3) {

@@ -12,6 +12,12 @@
 // Usage:
 //   fdfs_load upload   <tracker ip:port> <n_ops> <size> <threads> <result>
 //                      [unique_payloads]   (0/absent = every op unique)
+//   fdfs_load upload   <tracker ip:port> --small-files N --file-bytes B
+//                      <threads> <result>
+//                      (small-file corpus mode, ISSUE 9: N unique files
+//                      of B bytes each — the ingest arm of the slab-
+//                      packing bench, equivalent to n_ops=N size=B with
+//                      every payload unique)
 //   fdfs_load download <tracker ip:port> <ids_file> <n_ops> <threads> <result>
 //                      [--zipf <s> [--zipf-keys N] [--zipf-seed S]]
 //   fdfs_load delete   <tracker ip:port> <ids_file> <threads> <result>
@@ -489,6 +495,29 @@ int main(int argc, char** argv) {
   }
 
   Shared sh;
+  if (mode == "upload" && argc >= 7 &&
+      std::string(argv[3]) == "--small-files") {
+    // Small-file corpus mode (ISSUE 9 / config9): --small-files N
+    // --file-bytes B <threads> <result>.  Every payload unique — the
+    // worst case for per-object inodes, the best case for slabs.
+    if (!SplitAddr(argv[2], &sh.tracker_host, &sh.tracker_port)) return 2;
+    if (argc < 9 || std::string(argv[5]) != "--file-bytes") {
+      fprintf(stderr,
+              "usage: fdfs_load upload <tracker> --small-files N "
+              "--file-bytes B <threads> <result>\n");
+      return 2;
+    }
+    sh.n_ops = atoll(argv[4]);
+    sh.size = atoll(argv[6]);
+    if (sh.n_ops <= 0 || sh.size <= 0) {
+      fprintf(stderr, "--small-files and --file-bytes must be positive\n");
+      return 2;
+    }
+    int threads = atoi(argv[7]);
+    sh.unique = 0;
+    RunWorkers(&sh, threads, UploadWorker);
+    return WriteResults(sh, argv[8], /*with_ids=*/true) ? 0 : 1;
+  }
   if (mode == "upload" && argc >= 7) {
     if (!SplitAddr(argv[2], &sh.tracker_host, &sh.tracker_port)) return 2;
     sh.n_ops = atoll(argv[3]);

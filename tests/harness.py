@@ -196,8 +196,10 @@ def start_tracker(tmp_path, port: int | None = None, **kw) -> Daemon:
 
 
 def chunk_files(base_dir: str) -> list[str]:
-    """Every content-addressed chunk payload file under a storage's base
-    dir (``<base>/data/chunks/<d0d1>/<d2d3>/<40-hex>``)."""
+    """Every FLAT content-addressed chunk payload file under a storage's
+    base dir (``<base>/data/chunks/<d0d1>/<d2d3>/<40-hex>``).  Chunks
+    below ``slab_chunk_threshold`` live inside slab files instead — use
+    :func:`chunk_digests` for the layout-agnostic inventory."""
     import glob
     return sorted(
         f for f in glob.glob(os.path.join(str(base_dir), "data", "chunks",
@@ -205,25 +207,166 @@ def chunk_files(base_dir: str) -> list[str]:
         if os.path.isfile(f) and len(os.path.basename(f)) == 40)
 
 
+# -- slab store parsing (native/storage/slabstore.h record layout) ----------
+# Per record: 4s magic "FSLB", u8 version, u8 kind (1 chunk | 2 recipe),
+# u8 flags (bit0 dead), u8 key_len, u64 alloc_len, u64 payload_len,
+# u32 payload_crc32, u64 mtime, u32 header_crc32 (flags zeroed), then key
+# and payload.  Pinned cross-language by `fdfs_codec slab-layout`.
+SLAB_HEADER = ">4sBBBBqqIqI"
+SLAB_HEADER_SIZE = 40
+SLAB_KIND_CHUNK, SLAB_KIND_RECIPE = 1, 2
+
+
+def slab_files(base_dir: str) -> list[str]:
+    import glob
+    return sorted(glob.glob(os.path.join(str(base_dir), "data", "slabs",
+                                         "*.slab")))
+
+
+def slab_records(base_dir: str) -> list[dict]:
+    """Scan every slab file's record headers (the same walk the daemon's
+    boot rescan does).  Returns dicts with kind/key/flags/payload
+    offsets — the slot-index dump the slab-aware test helpers build on.
+    Stops at the first unparseable record of a file (torn tail)."""
+    import struct
+    import zlib
+    out = []
+    for path in slab_files(base_dir):
+        with open(path, "rb") as fh:
+            blob = fh.read()
+        off = 0
+        while off + SLAB_HEADER_SIZE <= len(blob):
+            (magic, ver, kind, flags, key_len, alloc_len, payload_len,
+             payload_crc, mtime, header_crc) = struct.unpack_from(
+                SLAB_HEADER, blob, off)
+            hdr = bytearray(blob[off:off + 36])
+            hdr[6] = 0  # header CRC is computed with flags zeroed
+            if (magic != b"FSLB" or ver != 1
+                    or zlib.crc32(bytes(hdr)) & 0xFFFFFFFF != header_crc
+                    or off + SLAB_HEADER_SIZE + key_len + alloc_len
+                    > len(blob)):
+                break  # torn tail
+            key = blob[off + SLAB_HEADER_SIZE:
+                       off + SLAB_HEADER_SIZE + key_len]
+            out.append({
+                "path": path,
+                "kind": kind,
+                "key": key.decode("latin-1"),
+                "flags": flags,
+                "dead": bool(flags & 1),
+                "record_off": off,
+                "payload_off": off + SLAB_HEADER_SIZE + key_len,
+                "payload_len": payload_len,
+                "payload_crc32": payload_crc,
+                "mtime": mtime,
+            })
+            off += SLAB_HEADER_SIZE + key_len + alloc_len
+    return out
+
+
+def chunk_digests(base_dir: str) -> dict[str, int]:
+    """Layout-agnostic live-chunk inventory: ``{digest: byte length}``
+    across flat chunk files AND live slab records.  The slab-aware twin
+    of :func:`chunk_files` (newest slab record wins a duplicate key,
+    matching the daemon's boot-rescan resolution)."""
+    inv = {os.path.basename(f): os.path.getsize(f)
+           for f in chunk_files(base_dir)}
+    # One ordered walk; the LAST record for a key is authoritative (a
+    # replace appends the new copy before the old record's dead mark).
+    latest: dict[str, tuple[bool, int]] = {}
+    for rec in slab_records(base_dir):
+        if rec["kind"] == SLAB_KIND_CHUNK:
+            latest[rec["key"]] = (rec["dead"], rec["payload_len"])
+    for key, (dead, length) in latest.items():
+        if not dead:
+            inv[key] = length
+        # A dead slab record does NOT erase a flat twin: the daemon's
+        # read path falls back to the flat file when the slot index
+        # misses (heal/repair in drain mode writes flat + kills the
+        # slab record), so a flat-backed digest stays live here too.
+    return inv
+
+
+def recipe_keys(base_dir: str) -> set[str]:
+    """Live recipe identities across both layouts: basenames of flat
+    ``*.rcp`` sidecars plus live slab recipe-record keys' basenames."""
+    import glob
+    names = {os.path.basename(p) for p in glob.glob(
+        os.path.join(str(base_dir), "data", "**", "*.rcp"), recursive=True)}
+    latest: dict[str, bool] = {}
+    for rec in slab_records(base_dir):
+        if rec["kind"] == SLAB_KIND_RECIPE:
+            latest[rec["key"]] = rec["dead"]
+    for key, dead in latest.items():
+        if not dead:
+            names.add(os.path.basename(key))
+    return names
+
+
+def read_chunk_payload(base_dir: str, digest: str) -> bytes:
+    """The live payload bytes of one chunk, whichever layout holds it
+    (flat file, or the newest live slab record)."""
+    flat = os.path.join(str(base_dir), "data", "chunks", digest[:2],
+                        digest[2:4], digest)
+    if os.path.isfile(flat):
+        with open(flat, "rb") as fh:
+            return fh.read()
+    target = None
+    for rec in slab_records(base_dir):
+        if (rec["kind"] == SLAB_KIND_CHUNK and rec["key"] == digest
+                and not rec["dead"]):
+            target = rec
+    if target is None:
+        raise FileNotFoundError(f"no live payload for {digest} under "
+                                f"{base_dir}")
+    with open(target["path"], "rb") as fh:
+        fh.seek(target["payload_off"])
+        return fh.read(target["payload_len"])
+
+
 def corrupt_chunk(base_dir: str, digest: str | None = None) -> tuple[str, str]:
-    """Flip one byte inside a stored chunk file — the bit-rot injection
-    for scrub tests.  Picks the first chunk on disk (or the named
-    ``digest``); returns ``(digest, path)``.  The file's length is
-    preserved so only the content hash betrays the damage."""
+    """Flip one byte inside a stored chunk payload — the bit-rot
+    injection for scrub tests.  Slab-aware: flat chunk files are
+    patched in place as before; a slab-resident chunk is located via
+    the record-header scan and its payload byte flipped inside the slab
+    file.  Picks the first live chunk (or the named ``digest``);
+    returns ``(digest, path)``.  Lengths are preserved so only the
+    content hash betrays the damage."""
     if digest is not None:
         path = os.path.join(str(base_dir), "data", "chunks", digest[:2],
                             digest[2:4], digest)
-        files = [path] if os.path.isfile(path) else []
+        if os.path.isfile(path):
+            files = [path]
+        else:
+            files = []
     else:
         files = chunk_files(base_dir)
-    if not files:
-        raise FileNotFoundError(f"no chunk files under {base_dir}")
-    path = files[0]
-    with open(path, "r+b") as fh:
+    if files:
+        path = files[0]
+        with open(path, "r+b") as fh:
+            first = fh.read(1)
+            fh.seek(0)
+            fh.write(bytes([first[0] ^ 0xFF]))
+        return os.path.basename(path), path
+    # Slab-resident: the newest LIVE record for the digest (or, with no
+    # digest named, the last live chunk record in scan order).
+    target = None
+    for rec in slab_records(base_dir):
+        if (rec["kind"] != SLAB_KIND_CHUNK or rec["payload_len"] <= 0
+                or rec["dead"]):
+            continue
+        if digest is not None and rec["key"] != digest:
+            continue
+        target = rec
+    if target is None:
+        raise FileNotFoundError(f"no chunk payload for {digest!r} under "
+                                f"{base_dir}")
+    with open(target["path"], "r+b") as fh:
+        fh.seek(target["payload_off"])
         first = fh.read(1)
-        fh.seek(0)
+        fh.seek(target["payload_off"])
         fh.write(bytes([first[0] ^ 0xFF]))
-    return os.path.basename(path), path
+    return target["key"], target["path"]
 
 
 def upload_retry(cli, data, timeout=20.0, **kw):

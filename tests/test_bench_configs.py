@@ -108,3 +108,24 @@ def test_config4_referee_smoke(tmp_path):
     assert art["recall_at_5_vs_truth"] >= art["recall_at_1_vs_truth"]
     assert art["referee_top1_agreement_acc_vs_textbook"] >= 0.98
     assert art["recall_pass"] is True
+
+
+def test_config9_slab_packing_smoke(tmp_path):
+    # The slab-packing scenario end-to-end at tiny scale: both layout
+    # arms ingest + download cleanly, the packed arm leaves slab files
+    # instead of per-object inodes (>= 10x fewer new files on disk even
+    # at 200 files), and the delete-heavy pass compacts >= 80% of the
+    # dead slab bytes with zero wrong bytes throughout.
+    bc.config9(str(tmp_path), scale=0.002)  # 200 x 4 KB per arm
+    with open(os.path.join(str(tmp_path), "config9.json")) as fh:
+        art = json.load(fh)
+    assert art["wrong_bytes"] == 0
+    assert art["modes"]["flat"]["slab"]["files"] == 0
+    assert art["modes"]["packed"]["slab"]["files"] >= 1
+    assert art["modes"]["packed"]["slab"]["slots_live"] >= 400  # 2/file
+    assert art["files_on_disk_delta_flat"] >= 10 * max(
+        art["files_on_disk_delta_packed"], 1)
+    assert art["delete_heavy"] is not None
+    assert art["delete_heavy"]["reclaim_pct"] >= 80.0
+    assert art["delete_heavy"]["survivor_download"]["errors"] == 0
+    assert art["ingest_p50_packed_vs_flat"] > 0

@@ -30,7 +30,8 @@ import time
 import numpy as np
 import pytest
 
-from harness import upload_retry, start_storage, start_tracker, wait_port  # noqa: E402
+from harness import (chunk_digests, recipe_keys, upload_retry,  # noqa: E402
+                     start_storage, start_tracker, wait_port)
 
 from fastdfs_tpu.client.client import FdfsClient
 
@@ -46,18 +47,11 @@ def _mk_payloads(seed=1, shared_mb=1, tail_kb=96):
     return a, b
 
 
-def _chunk_files(base):
-    return [f for f in glob.glob(os.path.join(base, "data", "chunks", "**",
-                                              "*"), recursive=True)
-            if os.path.isfile(f)]
-
-
 def _recipe_for(base, fid):
+    # Slab-aware: recipes may be flat .rcp sidecars OR slab records.
     remote = fid.split("/", 1)[1]
-    hits = glob.glob(os.path.join(base, "data", "**",
-                                  os.path.basename(remote) + ".rcp"),
-                     recursive=True)
-    return hits[0] if hits else None
+    name = os.path.basename(remote) + ".rcp"
+    return name if name in recipe_keys(base) else None
 
 
 def _flat_for(base, fid):
@@ -139,7 +133,7 @@ def test_chunked_upload_dedups_and_gc(tmp_path, mode):
         assert _flat_for(st_base, fa) is None
 
         # content-addressed store holds (far) less than the logical bytes
-        unique = sum(os.path.getsize(f) for f in _chunk_files(st_base))
+        unique = sum(chunk_digests(st_base).values())
         logical = len(a) + len(b)
         assert unique < logical * 0.7, (unique, logical)
 
@@ -157,16 +151,16 @@ def test_chunked_upload_dedups_and_gc(tmp_path, mode):
         assert _wait(saved), "dedup_bytes_saved never reported"
 
         # delete the first file: its exclusive chunks go, shared stay
-        n_before = len(_chunk_files(st_base))
+        n_before = len(chunk_digests(st_base))
         cli.delete_file(fa)
-        assert _wait(lambda: len(_chunk_files(st_base)) < n_before)
+        assert _wait(lambda: len(chunk_digests(st_base)) < n_before)
         assert cli.download_to_buffer(fb) == b
         with pytest.raises(Exception):
             cli.download_to_buffer(fa)
 
         # deleting the survivor empties the store entirely
         cli.delete_file(fb)
-        assert _wait(lambda: len(_chunk_files(st_base)) == 0)
+        assert _wait(lambda: len(chunk_digests(st_base)) == 0)
     finally:
         st.stop()
         tr.stop()
@@ -207,7 +201,7 @@ def test_restart_rebuilds_refcounts_and_collects_orphans(tmp_path):
             cli.delete_file(fa)
             assert cli.download_to_buffer(fb) == b
             cli.delete_file(fb)
-            assert _wait(lambda: len(_chunk_files(st_base)) == 0)
+            assert _wait(lambda: len(chunk_digests(st_base)) == 0)
         finally:
             st2.stop()
     finally:
@@ -403,7 +397,7 @@ def test_recovery_rebuilds_chunked(tmp_path_factory):
             len(t.query_fetch_all(f)) == 2 for f in (fa, fb)), timeout=30), \
             "seed data never fully replicated"
         # both nodes hold recipes + shared chunks
-        assert len(_chunk_files(str(s2dir))) > 0
+        assert len(chunk_digests(str(s2dir))) > 0
 
         s2.stop()
         data_dir = os.path.join(str(s2dir), "data")
@@ -425,7 +419,7 @@ def test_recovery_rebuilds_chunked(tmp_path_factory):
         assert _wait(lambda: _recipe_for(str(s2dir), fa) is not None and
                      _recipe_for(str(s2dir), fb) is not None, timeout=30), \
             "recovered files were stored flat (dedup parity lost)"
-        unique = sum(os.path.getsize(f) for f in _chunk_files(str(s2dir)))
+        unique = sum(chunk_digests(str(s2dir)).values())
         assert unique < len(a + b) * 0.7, (unique, len(a + b))
 
         # and it still serves the content (direct read from s2)

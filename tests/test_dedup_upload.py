@@ -360,8 +360,8 @@ def test_upload_session_timeout_releases_pins(tmp_path_factory):
     try:
         upload_retry(cli, b"warmup " * 64, ext="bin")
         fid = cli.upload_buffer_dedup(payload, ext="bin", min_dup_ratio=0)
-        chunk_dir = os.path.join(str(stdir), "data", "chunks")
-        n_chunks = sum(len(fs) for _, _, fs in os.walk(chunk_dir))
+        from harness import chunk_digests
+        n_chunks = len(chunk_digests(str(stdir)))
         assert n_chunks > 0
 
         # Phase 1 on a raw socket, then "vanish" (no phase 2).
@@ -383,15 +383,15 @@ def test_upload_session_timeout_releases_pins(tmp_path_factory):
         # Delete the only file referencing those chunks: refs drop to 0
         # but the session's pins defer every unlink.
         cli.delete_file(fid)
-        still = sum(len(fs) for _, _, fs in os.walk(chunk_dir))
+        still = len(chunk_digests(str(stdir)))
         assert still == n_chunks, "pinned chunks were unlinked by delete"
 
         sock.close()  # the vanished client
         # timeout=1s + 2s sweep granularity: pins released, unlinks done.
         assert _wait(lambda: _ingest_counters(
             "127.0.0.1", storage.port)[1] == 0, timeout=10)
-        assert _wait(lambda: sum(
-            len(fs) for _, _, fs in os.walk(chunk_dir)) == 0, timeout=10), \
+        assert _wait(lambda: len(chunk_digests(str(stdir))) == 0,
+                     timeout=10), \
             "deferred unlinks never completed after session expiry"
         c, _ = _ingest_counters("127.0.0.1", storage.port)
         assert c.get("ingest.recipe_fallbacks", 0) >= 1  # the expiry

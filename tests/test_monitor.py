@@ -94,7 +94,15 @@ def _sample_registry() -> dict:
                    "scrub.corrupt_unrepairable": 1,
                    "scrub.quarantined": 1, "scrub.gc_pending_bytes": 8192,
                    "scrub.chunks_reclaimed": 9,
-                   "scrub.bytes_reclaimed": 73728},
+                   "scrub.bytes_reclaimed": 73728,
+                   # slab packing (ISSUE 9): slot/byte accounting, the
+                   # compactor's lifetime work, and the inode gauge the
+                   # layout exists to flatten
+                   "slab.files": 2, "slab.slots_live": 300,
+                   "slab.slots_dead": 17, "slab.bytes_live": 1228800,
+                   "slab.bytes_dead": 69632, "slab.compactions": 3,
+                   "slab.compacted_bytes": 524288,
+                   "store.inodes_used": 4242},
         "histograms": {
             "op.upload_file.latency_us": {
                 "bounds": [100, 1000, 10000],
@@ -249,6 +257,18 @@ def test_prometheus_exposition_parses():
     assert series["fdfs_cache_capacity_bytes"][0][1] == 67108864.0
     assert series["fdfs_download_ranged_requests"][0][1] == 8.0
     assert series["fdfs_download_ranged_bytes"][0][1] == 4194304.0
+    # Slab-packing golden (ISSUE 9): live/dead slot+byte accounting, the
+    # compactor's lifetime work, and the inode gauge export per-storage
+    # so dashboards can chart dead-space ratio and the inode win.
+    assert series["fdfs_slab_files"][0] == (
+        '{storage="127.0.0.1:23000"}', 2.0)
+    assert series["fdfs_slab_slots_live"][0][1] == 300.0
+    assert series["fdfs_slab_slots_dead"][0][1] == 17.0
+    assert series["fdfs_slab_bytes_live"][0][1] == 1228800.0
+    assert series["fdfs_slab_bytes_dead"][0][1] == 69632.0
+    assert series["fdfs_slab_compactions"][0][1] == 3.0
+    assert series["fdfs_slab_compacted_bytes"][0][1] == 524288.0
+    assert series["fdfs_store_inodes_used"][0][1] == 4242.0
     buckets = series["fdfs_op_upload_file_latency_us_bucket"]
     values = [v for _, v in buckets]
     assert values == sorted(values), "histogram buckets must be cumulative"
@@ -372,6 +392,11 @@ def test_stat_opcodes_and_monitor_cli(tmp_path):
         dup = cli.upload_buffer(data, ext="bin")   # whole-file dedup hit
         assert cli.download_to_buffer(fid) == data
         cli.delete_file(dup)
+        # A chunk-eligible upload so the slab gauges below read a live
+        # store (its sub-64K chunks + recipe pack into slab records).
+        big = os.urandom(128 << 10)
+        fid_big = cli.upload_buffer(big, ext="bin")
+        assert cli.download_to_buffer(fid_big) == big
 
         # -- storage-side STAT: per-opcode counters + latency histograms
         with StorageClient("127.0.0.1", storage.port) as sc:
@@ -394,6 +419,15 @@ def test_stat_opcodes_and_monitor_cli(tmp_path):
         for fname in ("passes", "chunks_verified", "chunks_corrupt",
                       "bytes_reclaimed", "corrupt_unrepairable"):
             assert reg["gauges"][f"scrub.{fname}"] >= 0
+        # slab packing (ISSUE 9): the chunked upload above is made of
+        # sub-threshold chunks + a small recipe, so the default-on slab
+        # store holds live slots and at least one slab file; the inode
+        # gauge reads a real statvfs-backed value.
+        assert reg["gauges"]["slab.files"] >= 1
+        assert reg["gauges"]["slab.slots_live"] >= 1
+        assert reg["gauges"]["slab.bytes_live"] > 0
+        assert reg["gauges"]["slab.slots_dead"] >= 0
+        assert reg["gauges"]["store.inodes_used"] > 0
 
         # -- tracker-side cluster stat: capacity, liveness, beat payload
         with TrackerClient("127.0.0.1", tracker.port) as tc:

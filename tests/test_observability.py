@@ -28,8 +28,9 @@ import pytest
 
 from fastdfs_tpu import monitor as M
 from fastdfs_tpu.common import protocol as P
-from tests.harness import (BUILD, REPO, STORAGED, TRACKERD, chunk_files,
-                           corrupt_chunk, free_port, start_storage,
+from tests.harness import (BUILD, REPO, STORAGED, TRACKERD,
+                           chunk_digests, corrupt_chunk, free_port,
+                           start_storage,
                            start_tracker, upload_retry)
 
 _HAVE_TOOLCHAIN = ((shutil.which("cmake") is not None
@@ -238,7 +239,8 @@ def test_saturation_flight_recorder_and_top(tmp_path):
     try:
         data = os.urandom(1 << 20)
         fid = upload_retry(cli, data, ext="bin")
-        assert _wait(lambda: all(chunk_files(b) for b in bases), timeout=40)
+        assert _wait(lambda: all(chunk_digests(b) for b in bases),
+                     timeout=40)
 
         # -- traced upload: the dio.queue_wait child span -----------------
         tfid, tracer = T.traced_upload(cli, os.urandom(256 << 10), ext="bin")
@@ -259,8 +261,8 @@ def test_saturation_flight_recorder_and_top(tmp_path):
         victim = 0
 
         def replicated_digest():
-            common = ({os.path.basename(p) for p in chunk_files(bases[0])}
-                      & {os.path.basename(p) for p in chunk_files(bases[1])})
+            common = (set(chunk_digests(bases[0]))
+                      & set(chunk_digests(bases[1])))
             return sorted(common)[0] if common else None
 
         dig = _wait(replicated_digest, timeout=40)

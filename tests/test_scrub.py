@@ -24,9 +24,11 @@ import time
 import pytest
 
 from fastdfs_tpu.common import protocol as P
-from tests.harness import (BUILD, REPO, STORAGED, TRACKERD, chunk_files,
-                           corrupt_chunk, free_port, start_storage,
-                           start_tracker, upload_retry)
+from tests.harness import (BUILD, REPO, STORAGED, TRACKERD,
+                           chunk_digests, corrupt_chunk, free_port,
+                           read_chunk_payload, recipe_keys,
+                           slab_records, start_storage, start_tracker,
+                           upload_retry)
 
 _HAVE_TOOLCHAIN = ((shutil.which("cmake") is not None
                     and shutil.which("ninja") is not None)
@@ -161,7 +163,8 @@ def test_corruption_lifecycle_and_gc_two_storages(tmp_path):
         data = os.urandom(1 << 20)  # well over dedup_chunk_threshold
         fid = upload_retry(cli, data, ext="bin")
         # Replication done: the replica holds chunk files too.
-        assert _wait(lambda: all(chunk_files(b) for b in bases), timeout=40)
+        assert _wait(lambda: all(chunk_digests(b) for b in bases),
+                     timeout=40)
         # Both members hold every chunk after replication; rot node 0.
         victim = 0
         dig, path = corrupt_chunk(bases[victim])
@@ -176,10 +179,11 @@ def test_corruption_lifecycle_and_gc_two_storages(tmp_path):
         assert st["chunks_verified"] >= 1
         assert st["bytes_verified"] > 0
         assert st["quarantined"] == 0  # repair clears the quarantine
-        # The repaired chunk file is back with the right content hash.
+        # The repaired chunk payload (flat file or slab record) is back
+        # with the right content hash.
         import hashlib
-        with open(path, "rb") as fh:
-            assert hashlib.sha1(fh.read()).hexdigest() == dig
+        assert hashlib.sha1(
+            read_chunk_payload(bases[victim], dig)).hexdigest() == dig
         # Byte-identical download straight from the scrubbed node.
         with StorageClient(ip, port) as sc:
             assert sc.download_to_buffer(fid) == data
@@ -203,7 +207,7 @@ def test_corruption_lifecycle_and_gc_two_storages(tmp_path):
                             else None)(cli.scrub_status(ip, port)))
         assert st, cli.scrub_status(ip, port)
         assert st["bytes_reclaimed"] > 0
-        assert _wait(lambda: not chunk_files(bases[victim]))
+        assert _wait(lambda: not chunk_digests(bases[victim]))
 
         # The registry mirrors the scrub stats (fdfs_monitor surface)...
         with StorageClient(ip, port) as sc:
@@ -243,7 +247,7 @@ def test_single_replica_corruption_is_unrepairable_not_hung(tmp_path):
     try:
         data = os.urandom(256 << 10)
         fid = upload_retry(cli, data, ext="bin")
-        assert chunk_files(base)
+        assert chunk_digests(base)
         corrupt_chunk(base)
         cli.scrub_kick("127.0.0.1", st.port)
         status = _wait(
@@ -289,14 +293,23 @@ def test_delete_removes_recipe_sidecar_and_counts_bytes(tmp_path):
     base = os.path.join(tmp, "st")
 
     def recipes():
-        return glob.glob(os.path.join(base, "data", "**", "*.rcp"),
+        # Slab-aware: flat .rcp sidecars OR live slab recipe records.
+        return sorted(recipe_keys(base))
+
+    def recipe_bytes():
+        flat = glob.glob(os.path.join(base, "data", "**", "*.rcp"),
                          recursive=True)
+        if flat:
+            return os.path.getsize(flat[0])
+        live = [r for r in slab_records(base)
+                if r["kind"] == 2 and not r["dead"]]
+        return live[0]["payload_len"] if live else 0
 
     try:
         data = os.urandom(200 << 10)
         fid = upload_retry(cli, data, ext="bin")
         assert _wait(recipes), "chunk-eligible upload left no recipe"
-        rcp_bytes = os.path.getsize(recipes()[0])
+        rcp_bytes = recipe_bytes()
         assert rcp_bytes > 0
         cli.delete_file(fid)
         assert _wait(lambda: not recipes()), "recipe sidecar leaked"

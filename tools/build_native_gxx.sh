@@ -33,7 +33,8 @@ srcs_common="common/bytes.cc common/cdc.cc common/fileid.cc common/ini.cc
   common/stats.cc common/trace.cc common/eventlog.cc common/metrog.cc
   common/sloeval.cc common/heatsketch.cc common/fsutil.cc
   common/http_token.cc"
-srcs_storage="storage/chunkstore.cc storage/config.cc storage/store.cc
+srcs_storage="storage/chunkstore.cc storage/slabstore.cc
+  storage/config.cc storage/store.cc
   storage/binlog.cc storage/trunk.cc storage/recovery.cc storage/scrub.cc storage/dedup.cc
   storage/server.cc storage/sync.cc storage/tracker_client.cc"
 srcs_tracker="tracker/cluster.cc tracker/relationship.cc tracker/server.cc"
@@ -60,8 +61,8 @@ link storage/main.cc "$BUILD_DIR/obj/libfdfs_storage.a" \
   "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/fdfs_storaged" &
 link tracker/main.cc "$BUILD_DIR/obj/libfdfs_tracker.a" \
   "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/fdfs_trackerd" &
-link tools/codec_cli.cc "$BUILD_DIR/obj/libfdfs_common.a" \
-  -o "$BUILD_DIR/fdfs_codec" &
+link tools/codec_cli.cc "$BUILD_DIR/obj/storage_slabstore.o" \
+  "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/fdfs_codec" &
 link tools/load_cli.cc "$BUILD_DIR/obj/libfdfs_common.a" \
   -o "$BUILD_DIR/fdfs_load" &
 link tests/common_test.cc "$BUILD_DIR/obj/libfdfs_common.a" \

@@ -127,6 +127,18 @@ _SIDE_CAR = ("sidecar-local RPC (unix socket, same-host); layout asserted "
 _REPLICATION = ("replication/recovery wire asserted byte-level by "
                 "test_replication.py / test_disk_recovery.py fixtures")
 
+# ---------------------------------------------------------------------------
+# Node-local LAYOUT goldens: fdfs_codec subcommands that pin on-disk
+# formats (not wire opcodes, so the manifest never names them).  Each
+# must exist as a codec subcommand AND be referenced by a test, exactly
+# like the wire goldens — a layout that boot rescans from raw headers is
+# a cross-version contract even though it never crosses the network.
+# ---------------------------------------------------------------------------
+
+EXTRA_GOLDENS = (
+    "slab-layout",  # slab slot-header + index-record encoding (ISSUE 9)
+)
+
 GOLDEN_ALLOWLIST = {
     # tracker: cluster management
     "TrackerCmd.STORAGE_JOIN": _FIXED_FIELDS,
@@ -565,6 +577,20 @@ def check_golden_coverage(root: str) -> list[Finding]:
                     "golden-coverage", "tests", 0,
                     f"golden '{golden}' ({qual}) is referenced by no test "
                     f"under tests/ — an unexercised golden pins nothing"))
+    # Node-local layout goldens (EXTRA_GOLDENS) carry the same
+    # subcommand + test-reference obligations as wire goldens.
+    for golden in EXTRA_GOLDENS:
+        if codec is not None and f'"{golden}"' not in codec:
+            out.append(Finding(
+                "golden-coverage", "native/tools/codec_cli.cc", 0,
+                f"layout golden '{golden}' (EXTRA_GOLDENS) is not an "
+                f"fdfs_codec subcommand"))
+        if tests_text and golden not in tests_text:
+            out.append(Finding(
+                "golden-coverage", "tests", 0,
+                f"layout golden '{golden}' (EXTRA_GOLDENS) is referenced "
+                f"by no test under tests/ — an unexercised golden pins "
+                f"nothing"))
     return out
 
 
