@@ -55,10 +55,12 @@ HB = "heart_beat_interval = 1\nstat_report_interval = 1"
 NOMINAL = {1: 1 << 30, 2: 10 << 30, 3: 50 << 30, 4: 100 << 30,
            5: 500 << 30, 6: 10 << 30, 7: 10 << 30, 8: 10 << 30,
            # config9: the ISSUE 9 small-file corpus — 100k x 4 KB.
-           9: 100_000 * 4096}
+           9: 100_000 * 4096,
+           # config10: ISSUE 11 multi-group open-loop corpus (64 KB files).
+           10: 4 << 30}
 DEFAULT_SCALE = {1: 0.25, 2: 1 / 32.0, 3: 1 / 64.0, 4: 1 / 40.0,
                  5: 1 / 2000.0, 6: 1 / 256.0, 7: 1 / 256.0, 8: 1 / 64.0,
-                 9: 0.1}
+                 9: 0.1, 10: 1 / 64.0}
 
 
 def emit(out_dir: str, config: int, payload: dict) -> None:
@@ -1639,10 +1641,171 @@ def config9(out_dir: str, scale: float) -> None:
     })
 
 
+def config10(out_dir: str, scale: float) -> None:
+    """Multi-group scale-out (ISSUE 11): the SAME open-loop zipfian
+    download load offered to a 1-group and a 3-group cluster, tracker in
+    placement mode (store_lookup 3; the keyless preload round-robins, so
+    the corpus spreads evenly).  The offered rate is calibrated once —
+    70% of the 1-group arm's measured closed-loop QPS — and replayed
+    open-loop (`fdfs_load --open-loop --rate R`) against both arms, so
+    latency includes schedule lateness (no coordinated omission): when
+    an arm cannot absorb the rate, the backlog lands in its percentiles
+    instead of silently throttling the generator.  Headline: the
+    preload spread puts every group within 10 points of 1/3 and both
+    arms absorb the offered rate with zero errors; on a multi-core host
+    the 3-group arm's tail should be no worse (three daemons share the
+    work), while on a single core the extra daemons contend for the
+    same CPU — the artifact records host_cpus so the p99 ratio reads in
+    context.  A final phase drains group3 and clocks the migrator
+    emptying it: files/bytes moved, wall time, and the realized pace
+    against its bandwidth budget.
+    """
+    from harness import BUILD, free_port, start_storage, start_tracker
+
+    from fastdfs_tpu.client.client import FdfsClient
+
+    file_bytes = 64 * 1024
+    n_files = max(int(NOMINAL[10] * scale) // file_bytes, 60)
+    n_ops = n_files * 2
+    threads = min(os.cpu_count() or 1, 8)
+    zipf_s = 1.1
+    fdfs_load = os.path.join(BUILD, "fdfs_load")
+
+    def run_load(*args):
+        out = subprocess.run([fdfs_load, *args], capture_output=True,
+                             timeout=3600)
+        assert out.returncode == 0, out.stderr.decode()
+        return out
+
+    def combine(*result_files):
+        out = subprocess.run([fdfs_load, "combine", *result_files],
+                             capture_output=True, timeout=600)
+        assert out.returncode == 0, out.stderr.decode()
+        return json.loads(out.stdout.decode())
+
+    arms = {"one_group": ["group1"],
+            "three_groups": ["group1", "group2", "group3"]}
+    results = {}
+    offered_rate = 0.0
+    for name, groups in arms.items():
+        tmp = tempfile.mkdtemp(prefix=f"fdfs_cfg10_{name}_")
+        tr = start_tracker(os.path.join(tmp, "tr"), store_lookup=3)
+        taddr = f"127.0.0.1:{tr.port}"
+        storages = [start_storage(os.path.join(tmp, g), port=free_port(),
+                                  group=g, trackers=[taddr], extra=HB)
+                    for g in groups]
+        cli = FdfsClient([taddr])
+        try:
+            _upload_retry(cli, b"warmup " * 64)
+            up_res = os.path.join(tmp, "up.result")
+            run_load("upload", taddr, str(n_files), str(file_bytes),
+                     str(threads), up_res)
+            preload = combine(up_res)
+            assert preload["errors"] == 0, preload
+            with open(up_res + ".ids") as fh:
+                ids = [ln.strip() for ln in fh if ln.strip()]
+            spread = {}
+            for fid in ids:
+                g = fid.split("/", 1)[0]
+                spread[g] = spread.get(g, 0) + 1
+            if name == "one_group":
+                # Calibrate the offered rate once, on the small arm's
+                # closed-loop capacity; both arms then get the SAME rate.
+                cal_res = os.path.join(tmp, "cal.result")
+                run_load("download", taddr, up_res + ".ids", str(n_ops),
+                         str(threads), cal_res, "--zipf", str(zipf_s))
+                cal = combine(cal_res)
+                assert cal["errors"] == 0, cal
+                offered_rate = max(round(cal["qps"] * 0.7, 1), 1.0)
+            dl_res = os.path.join(tmp, "down.result")
+            run_load("download", taddr, up_res + ".ids", str(n_ops),
+                     str(threads), dl_res, "--zipf", str(zipf_s),
+                     "--open-loop", "--rate", str(offered_rate))
+            open_dl = combine(dl_res)
+            assert open_dl["errors"] == 0, open_dl
+            results[name] = {
+                "groups": len(groups),
+                "preload": preload,
+                "group_spread": spread,
+                "open_download": open_dl,
+            }
+            if name == "three_groups":
+                # Drain pace: retire one group and clock the migrator
+                # emptying it (budget: rebalance_bandwidth_mb_s, default
+                # 8 — the wall time also carries beat/retire latency, so
+                # the measured pace reads as a floor).
+                t0 = time.perf_counter()
+                cli.group_drain("group3")
+                deadline = t0 + 600
+                while time.perf_counter() < deadline:
+                    table = cli.query_placement()
+                    if any(g["group"] == "group3" and g["state"] == 2
+                           for g in table["groups"]):
+                        break
+                    time.sleep(0.5)
+                wall = time.perf_counter() - t0
+                cs = cli.cluster_stat("group3")
+                st = cs["groups"][0]["storages"][0]["stats"]
+                results[name]["drain"] = {
+                    "files_moved": st["rebalance_files_moved"],
+                    "bytes_moved": st["rebalance_bytes_moved"],
+                    "errors": st["rebalance_errors"],
+                    "done": st["rebalance_done"],
+                    "wall_s": round(wall, 2),
+                    "pace_mb_s": round(st["rebalance_bytes_moved"] / 1e6
+                                       / max(wall, 1e-9), 2),
+                    "bandwidth_budget_mb_s": 8,
+                }
+        finally:
+            cli.close()
+            for st in storages:
+                st.stop()
+            tr.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    spread3 = results["three_groups"]["group_spread"]
+    emit(out_dir, 10, {
+        "description": "multi-group scale-out: identical open-loop "
+                       "zipfian download load (rate = 70% of the "
+                       "1-group closed-loop QPS) against 1 vs 3 groups "
+                       "under a placement-mode tracker; latency counts "
+                       "from the scheduled instant, so falling behind "
+                       "the offered rate shows up in the percentiles",
+        "nominal_bytes": NOMINAL[10],
+        "scaled_bytes": n_files * file_bytes,
+        "files": n_files,
+        "file_bytes": file_bytes,
+        "open_loop_ops": n_ops,
+        "threads": threads,
+        "zipf_s": zipf_s,
+        "offered_rate_qps": offered_rate,
+        "host_cpus": os.cpu_count() or 1,
+        "arms": results,
+        "p99_three_vs_one": round(
+            results["three_groups"]["open_download"]["lat_p99_us"]
+            / max(results["one_group"]["open_download"]["lat_p99_us"], 1),
+            3),
+        "zero_errors": all(
+            r["preload"]["errors"] == 0 and r["open_download"]["errors"] == 0
+            for r in results.values()),
+        "three_group_spread_within_10pct": all(
+            abs(spread3.get(g, 0) / max(n_files, 1) - 1 / 3) <= 0.10
+            for g in ("group1", "group2", "group3")),
+        "open_loop_rate_met_3g": (
+            results["three_groups"]["open_download"]["qps"]
+            >= 0.85 * offered_rate),
+        "drain_relocated_all": (
+            results["three_groups"]["drain"]["done"] == 1
+            and results["three_groups"]["drain"]["errors"] == 0
+            and results["three_groups"]["drain"]["files_moved"]
+            >= spread3.get("group3", 0)),
+    })
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=0,
-                    help="which config (1-9); 0 = all")
+                    help="which config (1-10); 0 = all")
     ap.add_argument("--scale", type=float, default=None,
                     help="fraction of the nominal corpus size")
     ap.add_argument("--full", action="store_true",
@@ -1651,8 +1814,8 @@ def main() -> None:
     args = ap.parse_args()
 
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8, 9: config9}
-    which = [args.config] if args.config else [1, 2, 3, 4, 5, 6, 7, 8, 9]
+           6: config6, 7: config7, 8: config8, 9: config9, 10: config10}
+    which = [args.config] if args.config else list(range(1, 11))
     for c in which:
         scale = 1.0 if args.full else (
             args.scale if args.scale is not None else DEFAULT_SCALE[c])

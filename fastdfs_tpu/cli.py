@@ -561,6 +561,106 @@ def cmd_scrub(c: FdfsClient, args: list[str]) -> int:
         return 0
 
 
+def cmd_group(c: FdfsClient, args: list[str]) -> int:
+    """Group lifecycle console (multi-group scale-out): the placement
+    epoch with per-group state and, for draining groups, each member's
+    rebalance progress from its last beat.
+
+    Forms: group <tracker> status [--json] [--watch [s]]
+           group <tracker> drain <name>
+           group <tracker> reactivate <name>
+    """
+    import time as _time
+
+    from fastdfs_tpu import monitor as M
+
+    usage = ("usage: group <tracker> status [--json] [--watch [s]] | "
+             "drain <name> | reactivate <name>")
+    if not args:
+        print(usage, file=sys.stderr)
+        return 2
+    verb = args[0]
+
+    if verb in ("drain", "reactivate"):
+        if len(args) < 2 or args[1].startswith("--"):
+            print(usage, file=sys.stderr)
+            return 2
+        name = args[1]
+        fn = c.group_drain if verb == "drain" else c.group_reactivate
+        version = fn(name)
+        print(f"group {name} {verb} accepted: placement version {version}")
+        return 0
+    if verb != "status":
+        print(usage, file=sys.stderr)
+        return 2
+
+    interval = 0.0
+    if "--watch" in args:
+        i = args.index("--watch")
+        interval = 2.0
+        if i + 1 < len(args) and not args[i + 1].startswith("--"):
+            try:
+                interval = float(args[i + 1])
+            except ValueError:
+                pass
+
+    _REB = ("rebalance_files_moved", "rebalance_bytes_moved",
+            "rebalance_files_pending", "rebalance_errors", "rebalance_done")
+
+    def render_once() -> int:
+        table = c.query_placement()
+        # Rebalance progress rides the beat: pull each member's last-beat
+        # stat slots out of the tracker's cluster dump (one RPC).
+        beats: dict[str, dict] = {}
+        try:
+            cs = c.cluster_stat()
+            for g in cs.get("groups", []):
+                for s in g.get("storages", []):
+                    beats[f"{s['ip']}:{s['port']}"] = \
+                        M.beat_stats_from_storage(s)
+        except Exception as e:  # noqa: BLE001 — progress is best-effort
+            print(f"warning: cluster_stat: {e}", file=sys.stderr)
+        if "--json" in args:
+            out = {"version": table["version"], "groups": []}
+            for g in table["groups"]:
+                row = dict(g)
+                row["rebalance"] = {
+                    f"{m['ip']}:{m['port']}": {
+                        k: beats.get(f"{m['ip']}:{m['port']}", {}).get(k, 0)
+                        for k in _REB}
+                    for m in g["members"]}
+                out["groups"].append(row)
+            print(json.dumps(out, indent=2, sort_keys=True))
+            return 0
+        print(f"placement version {table['version']}  "
+              f"({len(table['groups'])} groups)")
+        for g in table["groups"]:
+            print(f"{g['group']:<16} {g['state_name']:<9} "
+                  f"members={len(g['members'])}")
+            for m in g["members"]:
+                addr = f"{m['ip']}:{m['port']}"
+                b = beats.get(addr)
+                if b is None or g["state_name"] == "active":
+                    continue
+                done = "yes" if b.get("rebalance_done", 0) else "no"
+                print(f"  {addr}  moved={b.get('rebalance_files_moved', 0)} "
+                      f"({b.get('rebalance_bytes_moved', 0)} bytes)  "
+                      f"pending={b.get('rebalance_files_pending', 0)}  "
+                      f"errors={b.get('rebalance_errors', 0)}  done={done}")
+        return 0
+
+    if interval <= 0:
+        return render_once()
+    try:
+        while True:
+            if "--json" not in args:  # keep --watch --json parseable
+                print(f"-- groups @ {_time.strftime('%H:%M:%S')} --")
+            render_once()
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 TOOLS = {
     "upload": cmd_upload,
     "download": cmd_download,
@@ -579,6 +679,7 @@ TOOLS = {
     "near_dups": cmd_near_dups,
     "trace": cmd_trace,
     "scrub": cmd_scrub,
+    "group": cmd_group,
 }
 
 

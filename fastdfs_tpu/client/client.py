@@ -14,9 +14,11 @@ from collections import OrderedDict
 
 from fastdfs_tpu.client.conn import ConnectionPool, ProtocolError, StatusError
 from fastdfs_tpu.client.storage_client import RemoteFileInfo, StorageClient
-from fastdfs_tpu.client.tracker_client import FetchTarget, TrackerClient
+from fastdfs_tpu.client.tracker_client import (FetchTarget, StoreTarget,
+                                               TrackerClient)
 from fastdfs_tpu.common.ini_config import IniConfig
-from fastdfs_tpu.common.jumphash import replica_for_range
+from fastdfs_tpu.common.jumphash import (jump_hash, placement_key,
+                                         replica_for_range)
 
 
 class FdfsClient:
@@ -29,7 +31,8 @@ class FdfsClient:
                  dedup_min_ratio: float = 0.05,
                  dedup_digest_cache: int = 1 << 16,
                  parallel_downloads: int = 1,
-                 download_range_bytes: int = 4 << 20):
+                 download_range_bytes: int = 4 << 20,
+                 use_placement: bool = False):
         if isinstance(tracker_addrs, str):
             tracker_addrs = [tracker_addrs]
         if not tracker_addrs:
@@ -66,6 +69,17 @@ class FdfsClient:
         # classic single-stream download transparently on any failure.
         self.parallel_downloads = max(int(parallel_downloads), 1)
         self.download_range_bytes = max(int(download_range_bytes), 64 * 1024)
+        # Placement routing (opt-in, store_lookup = 3 clusters): keyed
+        # uploads route straight to a storage of the jump-hash home group
+        # computed over a cached placement epoch (QUERY_PLACEMENT) — no
+        # per-upload tracker round-trip.  Any refusal (the epoch drifted:
+        # a group started draining and answers EBUSY, a member moved)
+        # drops the cache and falls back to the classic tracker hop,
+        # which always carries the key so the TRACKER applies the same
+        # hash — routing stays correct, only the shortcut is lost.
+        self.use_placement = bool(use_placement)
+        self._placement: dict | None = None
+        self._placement_rr = 0
 
     @classmethod
     def from_conf(cls, conf_path: str) -> "FdfsClient":
@@ -79,7 +93,8 @@ class FdfsClient:
                    dedup_min_ratio=float(cfg.get("dedup_min_ratio", 0.05)),
                    parallel_downloads=int(cfg.get("parallel_downloads", 1)),
                    download_range_bytes=int(
-                       cfg.get_bytes("download_range_bytes", 4 << 20)))
+                       cfg.get_bytes("download_range_bytes", 4 << 20)),
+                   use_placement=bool(cfg.get_bool("use_placement", False)))
 
     def close(self) -> None:
         if self.pool is not None:
@@ -153,18 +168,60 @@ class FdfsClient:
     # -- operations --------------------------------------------------------
 
     def upload_buffer(self, data: bytes, ext: str = "",
-                      group: str | None = None, appender: bool = False) -> str:
+                      group: str | None = None, appender: bool = False,
+                      key: str | None = None) -> str:
+        """``key``: optional placement key (store_lookup = 3 clusters).
+        The tracker — or this client directly, with ``use_placement`` —
+        jump-hashes it over the placement epoch so the same key always
+        homes in the same group; other cluster policies ignore it."""
         if self.dedup_uploads and not appender:
-            return self.upload_buffer_dedup(data, ext=ext, group=group)
+            return self.upload_buffer_dedup(data, ext=ext, group=group,
+                                            key=key)
         return self._upload_buffer_plain(data, ext=ext, group=group,
-                                         appender=appender)
+                                         appender=appender, key=key)
+
+    def _placement_route(self, key: str) -> StoreTarget | None:
+        """Storage target for ``key`` from the cached placement epoch —
+        or None when no epoch is available (tracker too old, no active
+        group), which means: take the classic tracker hop."""
+        table = self._placement
+        if table is None:
+            try:
+                table = self._with_tracker(lambda t: t.query_placement())
+            except (StatusError, ProtocolError, ConnectionError, OSError):
+                return None
+            self._placement = table
+        active = [g for g in table["groups"]
+                  if g["state"] == 0 and g["members"]]
+        if not active:
+            return None
+        g = active[jump_hash(placement_key(key), len(active))]
+        self._placement_rr += 1
+        m = g["members"][self._placement_rr % len(g["members"])]
+        return StoreTarget(group=g["group"], ip=m["ip"], port=m["port"],
+                           store_path_index=0xFF)
 
     def _upload_buffer_plain(self, data: bytes, ext: str = "",
                              group: str | None = None,
-                             appender: bool = False) -> str:
+                             appender: bool = False,
+                             key: str | None = None) -> str:
         # The classic single-RTT path; also every dedup fallback's target
         # (it must never re-enter the dedup gate, or a fallback recurses).
-        tgt = self._with_tracker(lambda t: t.query_store(group))
+        if key is not None and group is None and self.use_placement:
+            tgt = self._placement_route(key)
+            if tgt is not None:
+                try:
+                    with self._storage(tgt) as s:
+                        return s.upload_buffer(
+                            data, ext=ext,
+                            store_path_index=tgt.store_path_index,
+                            appender=appender)
+                except (StatusError, ProtocolError, OSError):
+                    # Epoch drift (EBUSY from a now-draining group) or a
+                    # dead member: forget the cache, fall through to the
+                    # tracker, which re-hashes the key itself.
+                    self._placement = None
+        tgt = self._with_tracker(lambda t: t.query_store(group, key=key))
         with self._storage(tgt) as s:
             return s.upload_buffer(data, ext=ext,
                                    store_path_index=tgt.store_path_index,
@@ -181,7 +238,8 @@ class FdfsClient:
     def upload_buffer_dedup(self, data: bytes, ext: str = "",
                             group: str | None = None,
                             min_dup_ratio: float | None = None,
-                            stats: dict | None = None) -> str:
+                            stats: dict | None = None,
+                            key: str | None = None) -> str:
         """Dedup-aware negotiated upload (UPLOAD_RECIPE/UPLOAD_CHUNKS):
         fingerprint locally, then ship only chunks the storage daemon's
         content-addressed store lacks — a warm re-upload moves ~0 data
@@ -201,7 +259,8 @@ class FdfsClient:
                        else min_dup_ratio)
         if len(data) < self.dedup_min_bytes:
             stats.update(fallback="small", bytes_sent=len(data))
-            return self._upload_buffer_plain(data, ext=ext, group=group)
+            return self._upload_buffer_plain(data, ext=ext, group=group,
+                                             key=key)
         from fastdfs_tpu.client.fingerprint import fingerprint_buffer
         chunks = [(fp.length, fp.digest) for fp in fingerprint_buffer(data)]
         if ratio_floor > 0:
@@ -211,9 +270,10 @@ class FdfsClient:
             if estimate < ratio_floor:
                 self._remember_digests(chunks)
                 stats.update(fallback="low_estimate", bytes_sent=len(data))
-                return self._upload_buffer_plain(data, ext=ext, group=group)
+                return self._upload_buffer_plain(data, ext=ext, group=group,
+                                                 key=key)
         self._remember_digests(chunks)
-        tgt = self._with_tracker(lambda t: t.query_store(group))
+        tgt = self._with_tracker(lambda t: t.query_store(group, key=key))
         with self._storage(tgt) as s:
             return s.upload_buffer_dedup(
                 data, ext=ext, store_path_index=tgt.store_path_index,
@@ -458,6 +518,55 @@ class FdfsClient:
         """Force a scrub pass on one storage daemon (SCRUB_KICK)."""
         with self._storage(FetchTarget(ip=ip, port=port)) as s:
             s.scrub_kick()
+
+    # -- placement epoch / group lifecycle ---------------------------------
+
+    def _leader_call(self, fn):
+        """Run ``fn(tracker_client)`` against the tracker LEADER
+        (followers refuse leader-only admin ops with EBUSY=16 rather
+        than proxying): ask any tracker who leads, target it, then fall
+        back to trying each tracker in turn.  A deterministic refusal
+        (unknown group, invalid transition) propagates immediately —
+        another tracker would only repeat it."""
+        leader = self._with_tracker(
+            lambda t: t.get_tracker_status().get("leader", ""))
+        if leader:
+            host, _, p = leader.rpartition(":")
+            try:
+                with TrackerClient(host, int(p), self.timeout) as t:
+                    return fn(t)
+            except StatusError as e:
+                if e.status != 16:
+                    raise
+            except OSError:
+                pass
+        last: Exception | None = None
+        for host, p in self.trackers:
+            try:
+                with TrackerClient(host, p, self.timeout) as t:
+                    return fn(t)
+            except StatusError as e:
+                if e.status != 16:
+                    raise
+                last = e
+            except OSError as e:
+                last = e
+        raise last if last else ConnectionError("no tracker accepted the call")
+
+    def query_placement(self) -> dict:
+        """The placement epoch (group order + lifecycle states + active
+        members), as any tracker serves it (QUERY_PLACEMENT)."""
+        return self._with_tracker(lambda t: t.query_placement())
+
+    def group_drain(self, group: str) -> int:
+        """Start draining ``group`` (leader-routed GROUP_DRAIN).  Returns
+        the new placement version."""
+        return self._leader_call(lambda t: t.group_drain(group))
+
+    def group_reactivate(self, group: str) -> int:
+        """Cancel a drain (leader-routed GROUP_REACTIVATE).  Returns the
+        new placement version."""
+        return self._leader_call(lambda t: t.group_reactivate(group))
 
 
 def _parse_addr(addr: str) -> tuple[str, int]:

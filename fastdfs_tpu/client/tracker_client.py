@@ -62,12 +62,17 @@ class TrackerClient:
 
     # -- service queries (upload/download routing) -------------------------
 
-    def query_store(self, group: str | None = None) -> StoreTarget:
+    def query_store(self, group: str | None = None,
+                    key: str | None = None) -> StoreTarget:
         """Which storage should take an upload (reference:
         tracker_query_storage_store).  Resp: 16B group + 16B ip + 8B port +
-        1B store path index."""
+        1B store path index.  ``key`` (groupless form only) is the client's
+        placement key — store_lookup = 3 trackers jump-hash it over the
+        placement epoch; other policies ignore it."""
         if group is None:
-            self.conn.send_request(TrackerCmd.SERVICE_QUERY_STORE_WITHOUT_GROUP_ONE)
+            self.conn.send_request(
+                TrackerCmd.SERVICE_QUERY_STORE_WITHOUT_GROUP_ONE,
+                key.encode() if key else b"")
         else:
             self.conn.send_request(TrackerCmd.SERVICE_QUERY_STORE_WITH_GROUP_ONE,
                                    pack_group_name(group))
@@ -189,6 +194,64 @@ class TrackerClient:
         body = pack_group_name(group) + f"{ip}:{port}".encode()
         self.conn.send_request(TrackerCmd.SERVER_SET_TRUNK_SERVER, body)
         self.conn.recv_response("set_trunk_server")
+
+    # -- placement epoch / group lifecycle (fastdfs_tpu extension) ---------
+
+    def query_placement(self) -> dict:
+        """The placement epoch (QUERY_PLACEMENT 64): version + the ordered
+        group list with lifecycle states and each group's ACTIVE members.
+        Wire: 8B BE version + 8B BE entry count + per entry (16B group +
+        1B state + 8B BE member count + per member (16B ip + 8B port))."""
+        self.conn.send_request(TrackerCmd.QUERY_PLACEMENT)
+        resp = self.conn.recv_response("query_placement")
+        if len(resp) < 16:
+            raise ProtocolError(f"short query_placement response: {len(resp)}")
+        version = buff2long(resp, 0)
+        count = buff2long(resp, 8)
+        off = 16
+        names = {0: "active", 1: "draining", 2: "retired"}
+        groups = []
+        for _ in range(count):
+            if off + GROUP_NAME_MAX_LEN + 9 > len(resp):
+                raise ProtocolError("truncated query_placement entry")
+            group = unpack_group_name(resp[off:off + 16])
+            state = resp[off + 16]
+            members_n = buff2long(resp, off + 17)
+            off += GROUP_NAME_MAX_LEN + 9
+            rec = IP_ADDRESS_SIZE + 8
+            if members_n < 0 or members_n > (len(resp) - off) // rec:
+                raise ProtocolError(f"bad member count {members_n}")
+            members = []
+            for m in range(members_n):
+                p = off + m * rec
+                members.append({"ip": resp[p:p + 16].rstrip(b"\x00").decode(),
+                                "port": buff2long(resp, p + 16)})
+            off += members_n * rec
+            groups.append({"group": group, "state": state,
+                           "state_name": names.get(state, "?"),
+                           "members": members})
+        return {"version": version, "groups": groups}
+
+    def _group_admin(self, cmd: int, group: str, what: str) -> int:
+        self.conn.send_request(cmd, pack_group_name(group))
+        resp = self.conn.recv_response(what)
+        if len(resp) < 8:
+            raise ProtocolError(f"short {what} response: {len(resp)}")
+        return buff2long(resp, 0)
+
+    def group_drain(self, group: str) -> int:
+        """Start draining a group (GROUP_DRAIN 65, tracker leader only):
+        no new writes land there; its members migrate every file to its
+        jump-hash home and the leader auto-retires the group when all
+        report done.  Returns the new placement version."""
+        return self._group_admin(TrackerCmd.GROUP_DRAIN, group, "group_drain")
+
+    def group_reactivate(self, group: str) -> int:
+        """Cancel a drain (GROUP_REACTIVATE 66, leader only).  Retired
+        groups are refused (StatusError 22) — their data already moved.
+        Returns the new placement version."""
+        return self._group_admin(TrackerCmd.GROUP_REACTIVATE, group,
+                                 "group_reactivate")
 
     def active_test(self) -> bool:
         self.conn.send_request(TrackerCmd.ACTIVE_TEST)

@@ -33,6 +33,22 @@ def jump_hash(key: int, num_buckets: int) -> int:
     return b
 
 
+def placement_key(key: str) -> int:
+    """64-bit jump-hash key for upload placement (store_lookup = 3): the
+    first 8 bytes (big-endian) of SHA1(client key).  Mirrored bit-exactly
+    by native/common/jumphash.h PlacementKey (fdfs_codec placement-wire
+    golden)."""
+    h = hashlib.sha1(key.encode()).digest()
+    return int.from_bytes(h[:8], "big")
+
+
+def group_for_key(key: str, num_active_groups: int) -> int:
+    """Index into the placement epoch's ordered ACTIVE-group list for one
+    client key — the pick the tracker, the rebalance migrator, and a
+    placement-routing client all agree on."""
+    return jump_hash(placement_key(key), num_active_groups)
+
+
 def range_key(file_id: str, range_index: int) -> int:
     """64-bit jump-hash key for one byte range of one file: the first 8
     bytes (big-endian) of SHA1("<file_id>#<range_index>")."""

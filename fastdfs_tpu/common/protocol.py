@@ -74,6 +74,11 @@ BEAT_STAT_FIELDS = (
     "recovery_files",
     "fetch_chunk_batches",
     "dedup_chunk_misses",
+    "rebalance_files_moved",
+    "rebalance_bytes_moved",
+    "rebalance_files_pending",
+    "rebalance_errors",
+    "rebalance_done",
 )
 BEAT_STAT_COUNT = len(BEAT_STAT_FIELDS)
 
@@ -248,6 +253,32 @@ class TrackerCmd(enum.IntEnum):
     # decision from the elected tracker leader instead of electing locally
     # (upstream: only the leader calls tracker_mem_find_trunk_server).
     TRACKER_GET_TRUNK_SERVER = 74
+
+    # fastdfs_tpu extension: consistent-placement epoch fetch (the
+    # store_lookup = 3 subsystem; arXiv:1406.2294 jump hash over the
+    # ordered group list).  Empty request body -> response = 8B BE
+    # placement version + 8B BE entry count + per entry (16B group name +
+    # 1B state [0 active / 1 draining / 2 retired] + 8B BE member count +
+    # per member (16B ip + 8B BE port)), members being the group's ACTIVE
+    # storages.  Clients cache the table and compute
+    # jump_hash(sha1(key)[:8], n_active) locally to route uploads without
+    # a tracker round-trip; any routing failure or EBUSY refresh-and-
+    # falls-back to the classic QUERY_STORE path.  Entry order is the
+    # epoch contract: groups append on first join and NEVER reorder, so
+    # adding group N+1 remaps only ~1/(N+1) of keys.  Followers serve
+    # their last table adopted from the leader.  Pinned by the fdfs_codec
+    # placement-wire cross-language golden.
+    QUERY_PLACEMENT = 64
+    # fastdfs_tpu extension: group lifecycle admin (leader-only; EBUSY
+    # from a follower, like SERVER_SET_TRUNK_SERVER).  Request body =
+    # 16B group name; OK response body = 8B BE new placement version.
+    # DRAIN moves active -> draining (no new writes placed there; reads
+    # and replication continue; storages start the paced rebalance
+    # migrator), REACTIVATE moves draining -> active.  Idempotent; ENOENT
+    # for an unknown group.  Pinned by the fdfs_codec group-admin
+    # cross-language golden.
+    GROUP_DRAIN = 65
+    GROUP_REACTIVATE = 66
 
     # fastdfs_tpu extension: distributed-tracing context prefix frame
     # (see TRACE_CTX_LEN above).  Deliberately the SAME value on both
@@ -477,6 +508,9 @@ WIRE_GOLDENS = {
     "StorageCmd.SCRUB_STATUS": "scrub-status",
     "StorageCmd.UPLOAD_RECIPE": "ingest-wire",
     "StorageCmd.UPLOAD_CHUNKS": "ingest-wire",
+    "TrackerCmd.QUERY_PLACEMENT": "placement-wire",
+    "TrackerCmd.GROUP_DRAIN": "group-admin",
+    "TrackerCmd.GROUP_REACTIVATE": "group-admin",
 }
 
 
@@ -516,11 +550,19 @@ class StorageStatus(enum.IntEnum):
 
 
 class StoreLookup(enum.IntEnum):
-    """Upload group-selection policy (reference: tracker.conf store_lookup)."""
+    """Upload group-selection policy (reference: tracker.conf store_lookup).
+
+    JUMP_CONSISTENT is a fastdfs_tpu extension (no upstream equivalent):
+    uploads place by jump_hash(sha1(client_key)) over the ordered list of
+    ACTIVE groups in the placement epoch (TrackerCmd.QUERY_PLACEMENT), so
+    adding group N+1 remaps only ~1/(N+1) of keys and draining a group
+    has a deterministic re-placement target for every file.
+    """
 
     ROUND_ROBIN = 0
     SPECIFIED_GROUP = 1
     LOAD_BALANCE = 2
+    JUMP_CONSISTENT = 3
 
 
 class StorePathPolicy(enum.IntEnum):

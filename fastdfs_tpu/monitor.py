@@ -554,6 +554,25 @@ def render_top(cur: TopSample, rates: dict[str, dict],
             f"{r['out_mb_s']:>8} {hit:>6} {_fmt_us(r['loop_p99_us']):>9} "
             f"{_fmt_us(r['dio_wait_p99_us']):>9} {depth:>5} {r['conns']:>5}"
             f"{mark}")
+    # GROUPS line: shown only while a group is draining/retired — the
+    # aggregate rebalance progress of the multi-group scale-out story.
+    drains = [g for g in (cur.cluster or {}).get("groups", [])
+              if g.get("state", "active") != "active"]
+    if drains:
+        parts = []
+        for g in drains:
+            moved = pending = errors = done = n = 0
+            for s in g.get("storages", []):
+                st = beat_stats_from_storage(s)
+                moved += st.get("rebalance_files_moved", 0)
+                pending += st.get("rebalance_files_pending", 0)
+                errors += st.get("rebalance_errors", 0)
+                done += 1 if st.get("rebalance_done", 0) else 0
+                n += 1
+            parts.append(f"{g['name']} {g['state']}: moved={moved} "
+                         f"pending={pending} errors={errors} done={done}/{n}")
+        lines.append("")
+        lines.append("GROUPS: " + "; ".join(parts))
     # ALERTS line: one glance answers "is anything red right now".
     # Event-tracked alerts name their rules; nodes whose breach predates
     # this fdfs_top (no slo.breach event seen, only the gauge) fall back
@@ -622,8 +641,9 @@ def render_text(snap: ClusterSnapshot) -> str:
     ]
     for g in snap.groups:
         lines.append("")
+        state = g.get("state", "active")
         lines.append(
-            f"Group: {g['name']}  members={g['members']} "
+            f"Group: {g['name']}  state={state}  members={g['members']} "
             f"active={g['active']} free={g['free_mb']}MB "
             f"trunk_server={g.get('trunk_server', '') or '-'}")
         for s in g.get("storages", []):
@@ -642,6 +662,12 @@ def render_text(snap: ClusterSnapshot) -> str:
                 f"sync_lag={st['sync_lag_s']}s "
                 f"recovery={st['recovery_chunks_fetched']}f/"
                 f"{st['recovery_chunks_local']}l")
+            if state != "active":
+                done = " done" if st.get("rebalance_done", 0) else ""
+                lines[-1] += (
+                    f" rebalance={st.get('rebalance_files_moved', 0)}moved/"
+                    f"{st.get('rebalance_files_pending', 0)}pending"
+                    f"{done}")
             reg = snap.storage_stats.get(addr)
             if reg is not None:
                 ops = []
@@ -770,6 +796,7 @@ def to_prometheus(snap: ClusterSnapshot, prefix: str = "fdfs") -> str:
 # Beat fields that are levels, not monotonic totals.
 _BEAT_GAUGES = frozenset({
     "last_source_update", "connections", "sync_lag_s",
+    "rebalance_files_pending", "rebalance_done",
 })
 
 

@@ -1,0 +1,45 @@
+// Jump consistent hash (Lamping & Veach, arXiv:1406.2294), the bit-exact
+// mirror of fastdfs_tpu/common/jumphash.py — both sides run the paper's
+// LCG loop with the SAME double-precision math, so a Python client and
+// the C++ tracker/migrator agree on every key's bucket by construction.
+// The agreement is pinned by the `fdfs_codec placement-wire` golden,
+// which prints jump buckets for fixture keys that the Python suite
+// recomputes.
+//
+// Header-only on purpose: fdfs_codec links only the common library, and
+// the hash has no state worth a TU.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <string_view>
+
+#include "common/bytes.h"
+
+namespace fdfs {
+
+// ch(key, num_buckets) from the paper: bucket in [0, num_buckets).
+// Callers guarantee num_buckets >= 1.
+inline int32_t JumpHash(uint64_t key, int32_t num_buckets) {
+  int64_t b = -1, j = 0;
+  while (j < num_buckets) {
+    b = j;
+    key = key * 2862933555777941757ULL + 1;
+    j = static_cast<int64_t>(
+        static_cast<double>(b + 1) *
+        (static_cast<double>(int64_t{1} << 31) /
+         static_cast<double>((key >> 33) + 1)));
+  }
+  return static_cast<int32_t>(b);
+}
+
+// 64-bit jump key for a placement string: the first 8 bytes of SHA1(key),
+// big-endian (the Python side's int.from_bytes(sha1(key)[:8], "big")).
+inline uint64_t PlacementKey(std::string_view key) {
+  Sha1Digest d = Sha1(key.data(), key.size());
+  uint64_t k = 0;
+  for (int i = 0; i < 8; ++i) k = (k << 8) | d.bytes[i];
+  return k;
+}
+
+}  // namespace fdfs

@@ -38,6 +38,11 @@
 //                           cluster params, pending sync reports).
 //    30   kScrub            ScrubManager::mu_ (stop/kick signalling only;
 //                           passes run with it released).
+//    34   kRebalance        RebalanceManager::mu_ (stop/kick signalling
+//                           only, the kScrub discipline; migration
+//                           passes run with it released and take
+//                           kTrackerReporter/kBinlog/stripe locks on
+//                           their own).
 //    40   kRelationship     RelationshipManager::mu_ (tracker leader
 //                           state; logs under it -> before kLog).
 //    50   kDedupEngine      CpuDedup::mu_ (digest maps).
@@ -122,6 +127,7 @@ enum class LockRank : uint16_t {
   kTrunkRole = 10,
   kTrackerReporter = 20,
   kScrub = 30,
+  kRebalance = 34,
   kRelationship = 40,
   kDedupEngine = 50,
   kDedupPool = 60,

@@ -1,0 +1,480 @@
+#include "storage/rebalance.h"
+
+#include <string.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <fstream>
+#include <map>
+#include <sstream>
+
+#include "common/bytes.h"
+#include "common/eventlog.h"
+#include "common/jumphash.h"
+#include "common/log.h"
+#include "common/net.h"
+#include "common/protocol_gen.h"
+#include "storage/binlog.h"
+#include "storage/tracker_client.h"
+
+namespace fdfs {
+
+namespace {
+
+constexpr int kRpcTimeoutMs = 30000;
+// Loopback + peer payloads: a single file's bytes plus framing.
+constexpr int64_t kMaxRpcBody = 1LL << 31;
+
+std::string PackGroup16(const std::string& group) {
+  std::string out(16, '\0');
+  memcpy(out.data(), group.data(), std::min<size_t>(group.size(), 16));
+  return out;
+}
+
+// Extension carried into the new file id, from the old remote name
+// ("M00/aa/bb/xxx.bin" -> "bin"); the 6-byte field every upload wire
+// uses.
+std::string Ext6(const std::string& remote) {
+  std::string ext;
+  size_t dot = remote.rfind('.');
+  if (dot != std::string::npos && remote.size() - dot - 1 <= 6 &&
+      remote.find('/', dot) == std::string::npos)
+    ext = remote.substr(dot + 1);
+  std::string out(6, '\0');
+  memcpy(out.data(), ext.data(), std::min<size_t>(ext.size(), 6));
+  return out;
+}
+
+}  // namespace
+
+RebalanceManager::Conn::~Conn() { Close(); }
+
+void RebalanceManager::Conn::Reset(const std::string& h, int p) {
+  if (h == host && p == port) return;
+  Close();
+  host = h;
+  port = p;
+}
+
+void RebalanceManager::Conn::Close() {
+  if (fd >= 0) {
+    close(fd);
+    fd = -1;
+  }
+}
+
+bool RebalanceManager::Conn::Call(uint8_t cmd, const std::string& body,
+                                  std::string* resp, uint8_t* status) {
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    if (fd < 0) {
+      std::string err;
+      fd = TcpConnect(host, port, kRpcTimeoutMs, &err);
+      if (fd < 0) return false;
+    }
+    if (NetRpc(fd, cmd, body, resp, status, kMaxRpcBody, kRpcTimeoutMs))
+      return true;
+    Close();  // stale keepalive: one reconnect
+  }
+  return false;
+}
+
+RebalanceManager::RebalanceManager(RebalanceOptions opts,
+                                   TrackerReporter* reporter, EventLog* events)
+    : opts_(std::move(opts)), reporter_(reporter), events_(events) {
+  self_.Reset("127.0.0.1", opts_.port);
+}
+
+RebalanceManager::~RebalanceManager() { Stop(); }
+
+void RebalanceManager::Start() {
+  thread_ = std::thread([this] { ThreadMain(); });
+}
+
+void RebalanceManager::Stop() {
+  {
+    std::lock_guard<RankedMutex> lk(mu_);
+    stop_ = true;
+  }
+  cv_.notify_all();
+  if (thread_.joinable()) thread_.join();
+}
+
+void RebalanceManager::Kick() {
+  {
+    std::lock_guard<RankedMutex> lk(mu_);
+    kicked_ = true;
+  }
+  cv_.notify_all();
+}
+
+bool RebalanceManager::Stopped() {
+  std::lock_guard<RankedMutex> lk(mu_);
+  return stop_;
+}
+
+void RebalanceManager::ThreadMain() {
+  std::unique_lock<RankedMutex> lk(mu_);
+  while (!stop_) {
+    cv_.wait_for(lk,
+                 std::chrono::seconds(std::max(1, opts_.poll_interval_s)),
+                 [this] { return stop_ || kicked_; });
+    if (stop_) return;
+    kicked_ = false;
+    // Drop mu_ (rank 34) before touching the reporter: group_state()
+    // takes the reporter mutex (rank 20), which must never be acquired
+    // under a higher-ranked lock.
+    lk.unlock();
+    int state = reporter_ != nullptr ? reporter_->group_state() : 0;
+    if (state == 1) {  // draining: migrate
+      RunPass();
+    } else if (state == 0) {
+      // Reactivated (or never drained): a stale done/pending report
+      // would re-trigger the tracker's auto-retire the moment the
+      // group drains again, before any pass ran.
+      done_.store(0);
+      files_pending_.store(0);
+    }
+    // state 2 (retired): nothing left to do; done_ stays truthful.
+    lk.lock();
+  }
+}
+
+std::vector<std::string> RebalanceManager::LoadInventory() {
+  // Replay the whole binlog: the last non-delete op's case decides
+  // ownership (uppercase = this member was the op's source — the sync
+  // threads' partitioning, so exactly one live member migrates each
+  // file).  Deletes drop the entry, which is also how finished
+  // migrations leave the inventory (the loopback DELETE_FILE logs D).
+  std::map<std::string, bool> files;  // filename -> we are source
+  for (int idx = 0;; ++idx) {
+    char name[32];
+    std::snprintf(name, sizeof(name), "/binlog.%03d", idx);
+    std::ifstream in(opts_.sync_dir + name);
+    if (!in) break;
+    std::string line;
+    while (std::getline(in, line)) {
+      auto rec = ParseBinlogRecord(line);
+      if (!rec) continue;
+      char op = rec->op;
+      if (op == 'D' || op == 'd') {
+        files.erase(rec->filename);
+      } else {
+        files[rec->filename] = (op >= 'A' && op <= 'Z');
+      }
+    }
+  }
+  std::vector<std::string> out;
+  for (const auto& [fn, source] : files)
+    if (source) out.push_back(fn);
+  return out;
+}
+
+bool RebalanceManager::FetchPlacement(std::vector<TargetGroup>* active) {
+  for (const std::string& addr : opts_.trackers) {
+    size_t c = addr.rfind(':');
+    if (c == std::string::npos) continue;
+    Conn t;
+    t.Reset(addr.substr(0, c), atoi(addr.c_str() + c + 1));
+    std::string resp;
+    uint8_t status = 0;
+    if (!t.Call(static_cast<uint8_t>(TrackerCmd::kQueryPlacement), "", &resp,
+                &status) ||
+        status != 0 || resp.size() < 16)
+      continue;
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(resp.data());
+    int64_t entries = GetInt64BE(p + 8);
+    size_t off = 16;
+    active->clear();
+    bool ok = true;
+    for (int64_t i = 0; i < entries && ok; ++i) {
+      if (resp.size() < off + 25) {
+        ok = false;
+        break;
+      }
+      TargetGroup g;
+      g.name = GetFixedField(p + off, 16);
+      uint8_t state = p[off + 16];
+      int64_t members = GetInt64BE(p + off + 17);
+      off += 25;
+      if (members < 0 ||
+          static_cast<size_t>(members) > (resp.size() - off) / 24) {
+        ok = false;
+        break;
+      }
+      for (int64_t m = 0; m < members; ++m) {
+        g.members.emplace_back(
+            GetFixedField(p + off, 16),
+            static_cast<int>(GetInt64BE(p + off + 16)));
+        off += 24;
+      }
+      // Only ACTIVE groups (and never this draining one) receive
+      // migrated files; epoch ORDER is the jump-hash bucket order.
+      if (state == 0 && g.name != opts_.group_name && !g.members.empty())
+        active->push_back(std::move(g));
+    }
+    if (ok) return true;
+  }
+  return false;
+}
+
+void RebalanceManager::Pace(int64_t bytes_done, int64_t pass_start_us) {
+  int64_t bw = static_cast<int64_t>(bandwidth_mb_s_.load()) * 1024 * 1024;
+  if (bw <= 0) return;
+  // Divide before scaling to microseconds (the scrub overflow lesson).
+  int64_t budget_us =
+      bytes_done / bw * 1000000 + (bytes_done % bw) * 1000000 / bw;
+  int64_t ahead_us = budget_us - (MonoUs() - pass_start_us);
+  while (ahead_us > 0) {
+    if (Stopped()) return;
+    usleep(static_cast<useconds_t>(std::min<int64_t>(ahead_us, 50000)));
+    ahead_us = budget_us - (MonoUs() - pass_start_us);
+  }
+}
+
+bool RebalanceManager::UploadToTarget(Conn* target, const std::string& remote,
+                                      const std::string& bytes,
+                                      std::string* new_id) {
+  std::string ext = Ext6(remote);
+  uint8_t num[8];
+  // Negotiated path: if the source stored a recipe, offer it to the
+  // target so only chunks its store lacks cross the wire (a dup-heavy
+  // drain moves ~unique bytes).  Any refusal — no chunk store
+  // (ENOTSUP), an old daemon (EINVAL), a died session (ENOENT), or a
+  // recipe/byte mismatch — falls through to the flat upload.
+  std::string recipe;
+  uint8_t status = 0;
+  if (self_.Call(static_cast<uint8_t>(StorageCmd::kFetchRecipe),
+                 PackGroup16(opts_.group_name) + remote, &recipe, &status) &&
+      status == 0 && recipe.size() >= 16) {
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(recipe.data());
+    int64_t logical = GetInt64BE(p);
+    int64_t count = GetInt64BE(p + 8);
+    if (logical == static_cast<int64_t>(bytes.size()) && count > 0 &&
+        static_cast<size_t>(count) <= (recipe.size() - 16) / 28) {
+      std::string req;
+      req.push_back(static_cast<char>(0xFF));  // server picks store path
+      req += ext;
+      PutInt64BE(Crc32(bytes.data(), bytes.size()), num);
+      req.append(reinterpret_cast<char*>(num), 8);
+      PutInt64BE(logical, num);
+      req.append(reinterpret_cast<char*>(num), 8);
+      req.append(reinterpret_cast<const char*>(p + 8), 8);  // chunk count
+      req.append(recipe, 16, static_cast<size_t>(count) * 28);
+      std::string resp;
+      if (target->Call(static_cast<uint8_t>(StorageCmd::kUploadRecipe), req,
+                       &resp, &status) &&
+          status == 0 && resp.size() >= 8 + static_cast<size_t>(count)) {
+        std::string payloads;
+        int64_t off = 0;
+        bool sliced = true;
+        for (int64_t i = 0; i < count; ++i) {
+          int64_t len = GetInt64BE(p + 16 + i * 28 + 20);
+          if (len < 0 || off + len > logical) {
+            sliced = false;
+            break;
+          }
+          if (resp[8 + i] != 0)
+            payloads.append(bytes, static_cast<size_t>(off),
+                            static_cast<size_t>(len));
+          off += len;
+        }
+        if (sliced && off == logical) {
+          std::string req2 = resp.substr(0, 8);  // session id
+          PutInt64BE(static_cast<int64_t>(payloads.size()), num);
+          req2.append(reinterpret_cast<char*>(num), 8);
+          req2 += payloads;
+          std::string resp2;
+          if (target->Call(static_cast<uint8_t>(StorageCmd::kUploadChunks),
+                           req2, &resp2, &status) &&
+              status == 0 && resp2.size() > 16) {
+            std::string g(resp2.c_str(), strnlen(resp2.c_str(), 16));
+            *new_id = g + "/" + resp2.substr(16);
+            return true;
+          }
+        }
+      }
+    }
+  }
+  // Flat upload: 1B spi + 8B size + 6B ext + payload.
+  std::string req;
+  req.reserve(15 + bytes.size());
+  req.push_back(0);
+  PutInt64BE(static_cast<int64_t>(bytes.size()), num);
+  req.append(reinterpret_cast<char*>(num), 8);
+  req += ext;
+  req += bytes;
+  std::string resp;
+  if (!target->Call(static_cast<uint8_t>(StorageCmd::kUploadFile), req, &resp,
+                    &status) ||
+      status != 0 || resp.size() <= 16)
+    return false;
+  std::string g(resp.c_str(), strnlen(resp.c_str(), 16));
+  *new_id = g + "/" + resp.substr(16);
+  return true;
+}
+
+bool RebalanceManager::VerifyRemote(Conn* target, const std::string& new_id,
+                                    const std::string& expect_bytes) {
+  size_t slash = new_id.find('/');
+  if (slash == std::string::npos) return false;
+  std::string body(16, '\0');  // offset 0, length 0 (= to EOF)
+  body += PackGroup16(new_id.substr(0, slash)) + new_id.substr(slash + 1);
+  std::string resp;
+  uint8_t status = 0;
+  if (!target->Call(static_cast<uint8_t>(StorageCmd::kDownloadFile), body,
+                    &resp, &status) ||
+      status != 0)
+    return false;
+  return resp == expect_bytes;
+}
+
+void RebalanceManager::AppendMap(const std::string& old_id,
+                                 const std::string& new_id) {
+  std::ofstream out(opts_.base_path + "/data/rebalance.map",
+                    std::ios::app);
+  out << old_id << ' ' << new_id << '\n';
+  out.flush();
+}
+
+bool RebalanceManager::MigrateOne(const std::string& remote,
+                                  const std::vector<TargetGroup>& active,
+                                  int64_t seq,
+                                  const std::string& mapped_new_id) {
+  const std::string old_id = opts_.group_name + "/" + remote;
+  std::string body(16, '\0');
+  body += PackGroup16(opts_.group_name) + remote;
+  std::string bytes;
+  uint8_t status = 0;
+  if (!self_.Call(static_cast<uint8_t>(StorageCmd::kDownloadFile), body,
+                  &bytes, &status))
+    return false;
+  if (status != 0) {
+    // Gone locally (raced with a client delete): nothing to move.
+    return status == 2;
+  }
+  pass_paced_ += static_cast<int64_t>(bytes.size());
+  Pace(pass_paced_, pass_start_us_);
+
+  const TargetGroup& tg =
+      active[JumpHash(PlacementKey(old_id),
+                      static_cast<int32_t>(active.size()))];
+  std::string new_id = mapped_new_id;
+  if (!new_id.empty()) {
+    // Crash recovery: the map committed before the source delete did.
+    // If the target copy verifies, finish the delete; if the target
+    // never got the file, fall through and migrate afresh.
+    size_t slash = new_id.find('/');
+    std::string ngroup =
+        slash == std::string::npos ? "" : new_id.substr(0, slash);
+    for (const TargetGroup& g : active) {
+      if (g.name != ngroup) continue;
+      Conn& t = target_;
+      t.Reset(g.members[seq % g.members.size()].first,
+              g.members[seq % g.members.size()].second);
+      if (VerifyRemote(&t, new_id, bytes)) {
+        std::string resp;
+        if (self_.Call(static_cast<uint8_t>(StorageCmd::kDeleteFile),
+                       PackGroup16(opts_.group_name) + remote, &resp,
+                       &status) &&
+            (status == 0 || status == 2)) {
+          files_moved_.fetch_add(1);
+          return true;
+        }
+      }
+      break;
+    }
+    new_id.clear();
+  }
+
+  const auto& member = tg.members[seq % tg.members.size()];
+  target_.Reset(member.first, member.second);
+  if (!UploadToTarget(&target_, remote, bytes, &new_id)) return false;
+  pass_paced_ += static_cast<int64_t>(bytes.size());
+  Pace(pass_paced_, pass_start_us_);
+  // Byte identity BEFORE the source copy is touched: a migration that
+  // cannot re-read what it wrote deletes nothing.
+  if (!VerifyRemote(&target_, new_id, bytes)) {
+    FDFS_LOG_ERROR("rebalance: %s -> %s failed verify, keeping source",
+                   old_id.c_str(), new_id.c_str());
+    return false;
+  }
+  AppendMap(old_id, new_id);
+  std::string resp;
+  if (!self_.Call(static_cast<uint8_t>(StorageCmd::kDeleteFile),
+                  PackGroup16(opts_.group_name) + remote, &resp, &status) ||
+      (status != 0 && status != 2))
+    return false;
+  files_moved_.fetch_add(1);
+  bytes_moved_.fetch_add(static_cast<int64_t>(bytes.size()));
+  return true;
+}
+
+void RebalanceManager::RunPass() {
+  passes_.fetch_add(1);
+  pass_start_us_ = MonoUs();
+  pass_paced_ = 0;
+  std::vector<std::string> inventory = LoadInventory();
+  files_pending_.store(static_cast<int64_t>(inventory.size()));
+  if (inventory.empty()) {
+    if (done_.exchange(1) == 0) {
+      FDFS_LOG_INFO("rebalance: drain of %s complete (%lld files moved)",
+                    opts_.group_name.c_str(),
+                    static_cast<long long>(files_moved_.load()));
+      if (events_ != nullptr)
+        events_->Record(EventSeverity::kInfo, "rebalance.done",
+                        opts_.group_name,
+                        "files_moved=" + std::to_string(files_moved_.load()) +
+                            " bytes_moved=" +
+                            std::to_string(bytes_moved_.load()));
+    }
+    return;
+  }
+  done_.store(0);
+  std::vector<TargetGroup> active;
+  if (!FetchPlacement(&active) || active.empty()) {
+    // No reachable tracker / nowhere to put files: not per-file errors,
+    // just retry the pass later.
+    FDFS_LOG_WARN("rebalance: no active target groups visible, waiting");
+    return;
+  }
+  // Crash-recovery record: old ids whose new id already committed.
+  std::map<std::string, std::string> moved_map;
+  {
+    std::ifstream in(opts_.base_path + "/data/rebalance.map");
+    std::string o, n;
+    while (in >> o >> n) moved_map[o] = n;
+  }
+  int64_t seq = 0;
+  int64_t pass_errors = 0;
+  for (const std::string& remote : inventory) {
+    if (Stopped()) return;
+    auto it = moved_map.find(opts_.group_name + "/" + remote);
+    bool ok = MigrateOne(remote, active, seq++,
+                         it != moved_map.end() ? it->second : "");
+    if (ok) {
+      files_pending_.fetch_add(-1);
+    } else {
+      ++pass_errors;
+      errors_.fetch_add(1);
+    }
+  }
+  if (events_ != nullptr)
+    events_->Record(EventSeverity::kInfo, "rebalance.pass", opts_.group_name,
+                    "pending=" + std::to_string(files_pending_.load()) +
+                        " errors=" + std::to_string(pass_errors));
+  if (files_pending_.load() == 0 && done_.exchange(1) == 0) {
+    FDFS_LOG_INFO("rebalance: drain of %s complete (%lld files moved)",
+                  opts_.group_name.c_str(),
+                  static_cast<long long>(files_moved_.load()));
+    if (events_ != nullptr)
+      events_->Record(EventSeverity::kInfo, "rebalance.done",
+                      opts_.group_name,
+                      "files_moved=" + std::to_string(files_moved_.load()) +
+                          " bytes_moved=" +
+                          std::to_string(bytes_moved_.load()));
+  }
+}
+
+}  // namespace fdfs

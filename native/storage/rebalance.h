@@ -1,0 +1,158 @@
+// Rebalance migrator: drain a group by re-placing its files into their
+// jump-hash target groups (ISSUE 11 / ROADMAP multi-group scale-out).
+//
+// When the tracker marks this group DRAINING (placement epoch, served in
+// the beat trailer), every member runs migration passes over the files
+// it was the binlog SOURCE for (uppercase ops — the same partitioning
+// the sync threads use, so exactly one member owns each file and the
+// group migrates in parallel without coordination).  Per file:
+//
+//   1. read the bytes via a loopback DOWNLOAD_FILE on this daemon (the
+//      server materializes recipes, checks quarantine — one read path);
+//   2. pick the target group: jump_hash(placement key of the old file
+//      id) over the epoch's ACTIVE groups (QUERY_PLACEMENT), so a
+//      drain spreads its files exactly like fresh mode-3 uploads;
+//   3. upload to a target member — negotiated when possible (loopback
+//      FETCH_RECIPE, then UPLOAD_RECIPE / UPLOAD_CHUNKS shipping only
+//      the chunks the target lacks), flat UPLOAD_FILE otherwise;
+//   4. verify byte identity (download the new copy, compare SHA1)
+//      BEFORE touching the source;
+//   5. append "<old_id> <new_id>" to <base_path>/data/rebalance.map
+//      (the operator/client forwarding record), then delete the source
+//      copy via a loopback DELETE_FILE (binlog D + replication + chunk
+//      unref all ride the standard path).
+//
+// The map append lands before the source delete, so a crash between
+// them re-runs as: map says moved -> verify target -> delete only.
+// Passes are paced by the scrub token-bucket discipline
+// (rebalance_bandwidth_mb_s, a cluster param the tracker serves); a
+// pass that drains the inventory reports done=1 in the beat stats and
+// the tracker leader auto-retires the group once every ACTIVE member
+// agrees.
+//
+// Reference departure: upstream FastDFS cannot shrink a cluster —
+// groups are forever and "migration" is rsync plus prayer.  This
+// manager makes drain a first-class, verified, paced operation.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <mutex>
+
+#include "common/lockrank.h"
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace fdfs {
+
+class EventLog;
+class TrackerReporter;
+
+struct RebalanceOptions {
+  std::string group_name;
+  std::string base_path;    // rebalance.map home
+  std::string sync_dir;     // <base_path>/data/sync (binlog inventory)
+  int port = 23000;         // loopback self RPCs
+  std::vector<std::string> trackers;  // "ip:port" for QUERY_PLACEMENT
+  int poll_interval_s = 2;  // drain-state poll cadence
+};
+
+class RebalanceManager {
+ public:
+  RebalanceManager(RebalanceOptions opts, TrackerReporter* reporter,
+                   EventLog* events = nullptr);
+  ~RebalanceManager();
+
+  void Start();
+  void Stop();
+  // Run a migration pass now if the group is draining (tests/operators).
+  void Kick();
+
+  // Cluster-param delivery (tracker kStorageParameterReq ->
+  // RefreshClusterParams): migration byte pace, 0 = unpaced.
+  void set_bandwidth_mb_s(int v) { bandwidth_mb_s_.store(v); }
+
+  // Beat stat slots (protocol_gen.h kBeatStatNames rebalance_*).
+  int64_t files_moved() const { return files_moved_.load(); }
+  int64_t bytes_moved() const { return bytes_moved_.load(); }
+  int64_t files_pending() const { return files_pending_.load(); }
+  int64_t errors() const { return errors_.load(); }
+  // 1 once a pass emptied the inventory while draining; cleared when
+  // the group leaves the draining state.
+  int64_t done() const { return done_.load(); }
+  int64_t passes() const { return passes_.load(); }
+
+ private:
+  // Placement epoch as QUERY_PLACEMENT serves it, reduced to what
+  // migration needs: the ACTIVE groups in epoch order + their members.
+  struct TargetGroup {
+    std::string name;
+    std::vector<std::pair<std::string, int>> members;  // ip, port
+  };
+  // One lazily-(re)connected peer; Call retries once on a stale fd.
+  struct Conn {
+    std::string host;
+    int port = 0;
+    int fd = -1;
+    ~Conn();
+    void Reset(const std::string& h, int p);
+    bool Call(uint8_t cmd, const std::string& body, std::string* resp,
+              uint8_t* status);
+    void Close();
+  };
+
+  void ThreadMain();
+  void RunPass();
+  bool Stopped();
+  // Binlog walk: files this member is SOURCE for and has not deleted.
+  std::vector<std::string> LoadInventory();
+  // QUERY_PLACEMENT against any reachable tracker; false when none
+  // answers (the pass aborts and retries later).
+  bool FetchPlacement(std::vector<TargetGroup>* active);
+  // Move one file; already_mapped = rebalance.map already records a new
+  // id for it (crash recovery: verify + delete only).  Returns false on
+  // any failure (retried next pass; the source copy is never deleted
+  // before the target copy verified).
+  bool MigrateOne(const std::string& remote,
+                  const std::vector<TargetGroup>& active, int64_t seq,
+                  const std::string& mapped_new_id);
+  // Upload `bytes` for old file `remote` to `member`; *new_id gets
+  // "group/remote" on success.  Negotiates via the recipe when the
+  // source stored one, flat UPLOAD_FILE otherwise.
+  bool UploadToTarget(Conn* target, const std::string& remote,
+                      const std::string& bytes, std::string* new_id);
+  bool VerifyRemote(Conn* target, const std::string& new_id,
+                    const std::string& expect_bytes);
+  void AppendMap(const std::string& old_id, const std::string& new_id);
+  // Scrub-style token bucket over cumulative migrated bytes.
+  void Pace(int64_t bytes_done, int64_t pass_start_us);
+
+  RebalanceOptions opts_;
+  TrackerReporter* reporter_;
+  EventLog* events_;
+
+  std::thread thread_;
+  RankedMutex mu_{LockRank::kRebalance};  // stop/kick signalling only
+  std::condition_variable_any cv_;
+  bool stop_ = false;
+  bool kicked_ = false;
+
+  Conn self_;    // loopback reads/deletes
+  Conn target_;  // current upload destination (re-resolved on change)
+
+  // Current pass's pacing state (migration-thread only).
+  int64_t pass_paced_ = 0;
+  int64_t pass_start_us_ = 0;
+
+  std::atomic<int> bandwidth_mb_s_{0};
+  std::atomic<int64_t> files_moved_{0};
+  std::atomic<int64_t> bytes_moved_{0};
+  std::atomic<int64_t> files_pending_{0};
+  std::atomic<int64_t> errors_{0};
+  std::atomic<int64_t> done_{0};
+  std::atomic<int64_t> passes_{0};
+};
+
+}  // namespace fdfs

@@ -435,6 +435,23 @@ bool StorageServer::Init(std::string* error) {
     scrub_->Start();
   }
 
+  // Rebalance migrator (ISSUE 11): idle until the tracker marks this
+  // group DRAINING in the beat trailer, then migrates the files this
+  // member was binlog source for into their jump-hash target groups.
+  // Needs the reporter (drain signal + trackers) — standalone daemons
+  // have nowhere to drain to.
+  if (reporter_ != nullptr) {
+    RebalanceOptions ropts;
+    ropts.group_name = cfg_.group_name;
+    ropts.base_path = cfg_.base_path;
+    ropts.sync_dir = cfg_.base_path + "/data/sync";
+    ropts.port = cfg_.port;
+    ropts.trackers = cfg_.tracker_servers;
+    rebalance_ = std::make_unique<RebalanceManager>(ropts, reporter_.get(),
+                                                    events_.get());
+    rebalance_->Start();
+  }
+
   // Periodic maintenance (reference: sched_thread entries — binlog flush,
   // stat write, dedup snapshot).
   // Per-request access log (storage.conf:use_access_log).
@@ -513,6 +530,9 @@ void StorageServer::Stop() {
   // The scrubber may be mid-pass against the chunk stores; it checks
   // its stop flag between batches, so this join is bounded.
   if (scrub_ != nullptr) scrub_->Stop();
+  // The migrator checks its stop flag between files (and inside its
+  // pacing sleeps), so this join is bounded too.
+  if (rebalance_ != nullptr) rebalance_->Stop();
   if (recovery_ != nullptr) recovery_->Stop();
   if (sync_ != nullptr) sync_->Stop();  // persists .mark cursors
   if (reporter_ != nullptr) reporter_->Stop();
@@ -525,6 +545,10 @@ void StorageServer::Stop() {
     if (t->thread.joinable()) t->thread.join();
   }
   loop_.Stop();
+}
+
+bool StorageServer::DrainingRefusal() const {
+  return reporter_ != nullptr && reporter_->group_state() != 0;
 }
 
 std::string StorageServer::MyIp() const {
@@ -782,7 +806,7 @@ void StorageServer::InitStatsRegistry() {
   // Snapshot-time mirrors of live state.  The restart-persisted op
   // totals keep their wire names (kBeatStatNames) under "store." so the
   // STAT JSON and the tracker's beat feed agree field-for-field.
-  static_assert(kBeatStatCount == 28, "update FillBeatStats + gauges");
+  static_assert(kBeatStatCount == 33, "update FillBeatStats + gauges");
   for (int i = 0; i < StorageStats::kPersisted; ++i) {
     registry_.GaugeFn(std::string("store.") + kBeatStatNames[i], [this, i] {
       int64_t v[StorageStats::kPersisted] = {0};
@@ -824,6 +848,23 @@ void StorageServer::InitStatsRegistry() {
                                                  : int64_t{0};
                       });
   }
+  // Rebalance migrator (ISSUE 11): same names as the beat slots so
+  // fdfs_monitor/fdfs_top read drain progress from either feed.
+  registry_.GaugeFn("rebalance.files_moved", [this] {
+    return rebalance_ != nullptr ? rebalance_->files_moved() : int64_t{0};
+  });
+  registry_.GaugeFn("rebalance.bytes_moved", [this] {
+    return rebalance_ != nullptr ? rebalance_->bytes_moved() : int64_t{0};
+  });
+  registry_.GaugeFn("rebalance.files_pending", [this] {
+    return rebalance_ != nullptr ? rebalance_->files_pending() : int64_t{0};
+  });
+  registry_.GaugeFn("rebalance.errors", [this] {
+    return rebalance_ != nullptr ? rebalance_->errors() : int64_t{0};
+  });
+  registry_.GaugeFn("rebalance.done", [this] {
+    return rebalance_ != nullptr ? rebalance_->done() : int64_t{0};
+  });
 }
 
 int64_t StorageServer::MaxSyncLagS() const {
@@ -929,6 +970,14 @@ void StorageServer::FillBeatStats(int64_t* out) {
                 ? ctr_chunkfetch_batches_->load() : 0;
   out[27] = ctr_dedup_chunk_misses_ != nullptr
                 ? ctr_dedup_chunk_misses_->load() : 0;
+  // Rebalance migrator progress (ISSUE 11): the tracker leader's
+  // auto-retire decision reads slots 30 (pending) and 32 (done) from
+  // every ACTIVE member of a draining group.
+  out[28] = rebalance_ != nullptr ? rebalance_->files_moved() : 0;
+  out[29] = rebalance_ != nullptr ? rebalance_->bytes_moved() : 0;
+  out[30] = rebalance_ != nullptr ? rebalance_->files_pending() : 0;
+  out[31] = rebalance_ != nullptr ? rebalance_->errors() : 0;
+  out[32] = rebalance_ != nullptr ? rebalance_->done() : 0;
 }
 
 // -- nio ------------------------------------------------------------------
@@ -1859,6 +1908,14 @@ void StorageServer::OnHeaderComplete(Conn* c) {
     case StorageCmd::kUploadFile:
     case StorageCmd::kUploadAppenderFile:
       stats_.total_upload++;
+      // Placement drain (ISSUE 11): a draining group takes no NEW
+      // files — EBUSY sends the client back to the tracker, which no
+      // longer routes stores here.  Replication (kSync*) and the
+      // rebalance migrator's loopback reads/deletes stay allowed.
+      if (DrainingRefusal()) {
+        RespondError(c, 16 /*EBUSY*/);
+        return;
+      }
       if (c->pkg_len < 15) {
         RespondError(c, 22 /*EINVAL*/);
         return;
@@ -1895,6 +1952,10 @@ void StorageServer::OnHeaderComplete(Conn* c) {
       break;
     case StorageCmd::kUploadSlaveFile:
       stats_.total_upload++;
+      if (DrainingRefusal()) {  // drain: no new files (see kUploadFile)
+        RespondError(c, 16 /*EBUSY*/);
+        return;
+      }
       // 16B group + 8B master_len + 8B size + 16B prefix + 6B ext, master
       c->fixed_need = 16 + 8 + 8 + 16 + 6;
       break;
@@ -2151,6 +2212,13 @@ void StorageServer::OnFixedComplete(Conn* c) {
       return;
     }
     case StorageCmd::kUploadRecipe: {
+      // Drain refusal at session START only: an in-flight session's
+      // kUploadChunks may still commit (the file predates the drain
+      // decision and migrates with everything else).
+      if (DrainingRefusal()) {
+        Respond(c, 16 /*EBUSY*/);
+        return;
+      }
       // Chunk-store probe + pin: cheap, but it contends on the store
       // mutex with every concurrent upload's PutAndRef — keep it off
       // the nio loop like the other chunk-store servers.
@@ -3061,6 +3129,12 @@ void StorageServer::RefreshClusterParams() {
   slot_min_size_ = get("slot_min_size", slot_min_size_);
   slot_max_size_ = get("slot_max_size", slot_max_size_);
   trunk_file_size_ = get("trunk_file_size", trunk_file_size_);
+  // Migrator pacing is a cluster param (tracker.conf:
+  // rebalance_bandwidth_mb_s) so every member of a draining group
+  // drains at the operator's one configured pace.
+  if (rebalance_ != nullptr)
+    rebalance_->set_bandwidth_mb_s(
+        static_cast<int>(get("rebalance_bandwidth_mb_s", 8)));
   auto [tip, tport] = reporter_->trunk_server();
   trunk_ip_ = tip;
   trunk_port_ = tport;

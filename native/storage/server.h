@@ -42,6 +42,7 @@
 #include "storage/config.h"
 #include "storage/dedup.h"
 #include "storage/recovery.h"
+#include "storage/rebalance.h"
 #include "storage/scrub.h"
 #include "storage/store.h"
 #include "storage/sync.h"
@@ -455,6 +456,10 @@ class StorageServer {
   // Delete logical content: plain unlink, or recipe removal + chunk
   // unref.  Returns errno-style status (0 ok, 2 missing, 5 io).
   int RemoveLogical(const std::string& local, const std::string& file_ref);
+  // True when the tracker marked this group draining/retired in the
+  // beat trailer: new-file uploads answer EBUSY (reads, replication,
+  // and the migrator's loopback ops stay allowed).
+  bool DrainingRefusal() const;
 
   StorageConfig cfg_;
   StoreManager store_;
@@ -469,6 +474,10 @@ class StorageServer {
   // the batched DEDUP_VERIFY path (plugins are not thread-safe).
   std::unique_ptr<DedupPlugin> scrub_dedup_;
   std::unique_ptr<ScrubManager> scrub_;
+  // Rebalance migrator (ISSUE 11): drains this group's files into
+  // their jump-hash target groups once the tracker marks the group
+  // DRAINING (storage/rebalance.h; rebalance_* beat slots).
+  std::unique_ptr<RebalanceManager> rebalance_;
   std::unique_ptr<TrackerReporter> reporter_;
   std::unique_ptr<SyncManager> sync_;
   std::unique_ptr<RecoveryManager> recovery_;

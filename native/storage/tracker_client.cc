@@ -143,12 +143,25 @@ bool TrackerReporter::ParsePeers(const std::string& body,
   std::string tip;
   int tport = 0;
   int64_t tepoch = 0;
+  // Placement trailer extension (append-only, prefix-tolerant like the
+  // trunk fields): 1B group placement state + 8B placement epoch
+  // version.  Absent on old trackers — keep the last value rather than
+  // resetting, so a mixed-version tracker set cannot flap a draining
+  // group back to accepting writes.
+  bool have_state = body.size() >= tail + kIpAddressSize + 17;
+  int gstate = 0;
+  int64_t pversion = 0;
   if (have_trailer) {
     const uint8_t* q = p + tail;
     tip = GetFixedField(q, kIpAddressSize);
     tport = static_cast<int>(GetInt64BE(q + kIpAddressSize));
     if (body.size() >= tail + kIpAddressSize + 16)
       tepoch = GetInt64BE(q + kIpAddressSize + 8);
+    if (have_state) {
+      gstate = q[kIpAddressSize + 16];
+      if (body.size() >= tail + kIpAddressSize + 25)
+        pversion = GetInt64BE(q + kIpAddressSize + 17);
+    }
   }
   {
     std::lock_guard<RankedMutex> lk(mu_);
@@ -158,6 +171,10 @@ bool TrackerReporter::ParsePeers(const std::string& body,
       trunk_ip_ = tip;
       trunk_port_ = tport;
       trunk_epoch_ = tepoch;
+      if (have_state) {
+        group_state_ = gstate;
+        placement_version_ = pversion;
+      }
     }
   }
   return true;
@@ -180,6 +197,16 @@ std::pair<std::string, int> TrackerReporter::trunk_server() const {
 int64_t TrackerReporter::trunk_epoch() const {
   std::lock_guard<RankedMutex> lk(mu_);
   return trunk_epoch_;
+}
+
+int TrackerReporter::group_state() const {
+  std::lock_guard<RankedMutex> lk(mu_);
+  return group_state_;
+}
+
+int64_t TrackerReporter::placement_version() const {
+  std::lock_guard<RankedMutex> lk(mu_);
+  return placement_version_;
 }
 
 bool TrackerReporter::DoJoin(int fd, int64_t* chlog_off) {

@@ -60,6 +60,12 @@ class TrackerReporter {
   // Group's elected trunk server from the latest beat ("" / 0 when none).
   std::pair<std::string, int> trunk_server() const;
   int64_t trunk_epoch() const;  // fencing token for trunk RPCs
+  // This group's placement state from the latest beat trailer
+  // (0 active / 1 draining / 2 retired; tracker/placement.h GroupState).
+  // Draining means: refuse new client-facing writes, keep serving reads,
+  // and the rebalance migrator should be moving files out.
+  int group_state() const;
+  int64_t placement_version() const;  // placement epoch seen in that beat
 
  private:
   void ThreadMain(std::string host, int port);
@@ -103,6 +109,8 @@ class TrackerReporter {
   std::string trunk_ip_;
   int trunk_port_ = 0;
   int64_t trunk_epoch_ = 0;
+  int group_state_ = 0;           // GroupState numeric, 0 = active
+  int64_t placement_version_ = 0;
   // Identity recorded at process start (read once, BEFORE any thread
   // rewrites the identity file): every tracker thread must send the
   // rename RPC from the same old->new view, or slower threads would read

@@ -177,13 +177,16 @@ static void TestProtocolConstants() {
   CHECK_EQ(kHeaderSize, 10);
   // Beat-blob naming contract: one name per slot, the named headline
   // stats present (the Python side asserts the same list).
-  CHECK_EQ(kBeatStatCount, 28);
+  CHECK_EQ(kBeatStatCount, 33);
   CHECK_EQ(std::string(kBeatStatNames[0]), std::string("total_upload"));
   CHECK_EQ(std::string(kBeatStatNames[17]),
            std::string("dedup_bytes_saved"));
   CHECK_EQ(std::string(kBeatStatNames[21]), std::string("sync_lag_s"));
   CHECK_EQ(std::string(kBeatStatNames[23]),
            std::string("recovery_chunks_fetched"));
+  CHECK_EQ(std::string(kBeatStatNames[28]),
+           std::string("rebalance_files_moved"));
+  CHECK_EQ(std::string(kBeatStatNames[32]), std::string("rebalance_done"));
 }
 
 static void TestStatsRegistry() {

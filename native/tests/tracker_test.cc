@@ -5,9 +5,12 @@
 // the live-socket version of this).
 #include <cstdio>
 #include <string>
+#include <vector>
 
+#include "common/jumphash.h"
 #include "common/protocol_gen.h"
 #include "tracker/cluster.h"
+#include "tracker/placement.h"
 
 static int g_failures = 0;
 
@@ -65,9 +68,160 @@ static void TestShortBeatKeepsTail() {
   CHECK(json.find(tail) != std::string::npos);
 }
 
+static void TestStoreLookup2Hysteresis() {
+  // store_lookup = 2 flapping fix: the previous pick holds until a rival
+  // leads its free space by MORE than the hysteresis delta.
+  Cluster c(2);
+  c.set_balance_hysteresis_mb(100);
+  CHECK(c.Join("g1", "10.0.0.1", 23000, 1, 1000).has_value());
+  CHECK(c.Join("g2", "10.0.0.2", 23000, 1, 1000).has_value());
+  CHECK(c.UpdateDiskUsage("g1", "10.0.0.1", 23000, 10000, 5000));
+  CHECK(c.UpdateDiskUsage("g2", "10.0.0.2", 23000, 10000, 5040));
+  auto t = c.QueryStore("");
+  CHECK(t.has_value() && t->group == "g2");  // no prior pick: most free wins
+  // g1 beat: now ahead by 60 MB — inside the 100 MB band, pick holds.
+  CHECK(c.UpdateDiskUsage("g1", "10.0.0.1", 23000, 10000, 5100));
+  t = c.QueryStore("");
+  CHECK(t.has_value() && t->group == "g2");
+  // Lead grows past the band — pick moves.
+  CHECK(c.UpdateDiskUsage("g1", "10.0.0.1", 23000, 10000, 5200));
+  t = c.QueryStore("");
+  CHECK(t.has_value() && t->group == "g1");
+  // Symmetric: g2 nosing back ahead must not flap the pick back.
+  CHECK(c.UpdateDiskUsage("g2", "10.0.0.2", 23000, 10000, 5250));
+  t = c.QueryStore("");
+  CHECK(t.has_value() && t->group == "g1");
+}
+
+static void TestPlacementLifecycle() {
+  PlacementTable pt;
+  CHECK(pt.EnsureGroup("g1"));
+  CHECK_EQ(pt.version(), 1);
+  CHECK(pt.EnsureGroup("g2"));
+  CHECK(pt.EnsureGroup("g3"));
+  CHECK(!pt.EnsureGroup("g2"));  // re-join: no append, no version bump
+  CHECK_EQ(pt.version(), 3);
+  CHECK_EQ(pt.entries().size(), 3u);
+  CHECK_EQ(pt.Drain("nope"), 2);
+  CHECK_EQ(pt.Drain("g2"), 0);
+  CHECK_EQ(pt.version(), 4);
+  CHECK_EQ(pt.Drain("g2"), 0);  // idempotent: no second bump
+  CHECK_EQ(pt.version(), 4);
+  auto active = pt.ActiveGroups();
+  CHECK_EQ(active.size(), 2u);
+  CHECK(active[0] == "g1" && active[1] == "g3");
+  CHECK_EQ(pt.Retire("g1"), 22);  // active cannot retire directly
+  CHECK_EQ(pt.Retire("g2"), 0);
+  CHECK_EQ(pt.Reactivate("g2"), 22);  // retired is terminal
+  CHECK_EQ(pt.Drain("g2"), 22);
+  CHECK_EQ(pt.Reactivate("g3"), 0);  // already active: idempotent
+  CHECK_EQ(pt.version(), 5);
+}
+
+static void TestPlacementJumpStability() {
+  PlacementTable pt;
+  pt.EnsureGroup("g1");
+  pt.EnsureGroup("g2");
+  pt.EnsureGroup("g3");
+  // PickGroup IS jump_hash(sha1(key)) over the active list — the same
+  // function the Python client and the rebalance migrator compute.
+  std::vector<std::string> active = pt.ActiveGroups();
+  for (const char* key : {"alpha", "bravo", "charlie", "delta"}) {
+    int32_t b = JumpHash(PlacementKey(key), 3);
+    CHECK_EQ(pt.PickGroup(key), active[b]);
+  }
+  // Adding a 4th group moves ~1/4 of keys, and every moved key lands IN
+  // the new group — no key shuffles between two old groups.
+  std::vector<std::string> before;
+  for (int i = 0; i < 1000; ++i)
+    before.push_back(pt.PickGroup("key-" + std::to_string(i)));
+  pt.EnsureGroup("g4");
+  int moved = 0;
+  for (int i = 0; i < 1000; ++i) {
+    std::string now = pt.PickGroup("key-" + std::to_string(i));
+    if (now != before[i]) {
+      ++moved;
+      CHECK_EQ(now, std::string("g4"));
+    }
+  }
+  CHECK(moved > 150 && moved < 350);  // expectation: 250 of 1000
+}
+
+static void TestPlacementWireRoundTrip() {
+  PlacementTable pt;
+  pt.EnsureGroup("g1");
+  pt.EnsureGroup("g2");
+  CHECK_EQ(pt.Drain("g2"), 0);
+  std::vector<std::vector<PlacementTable::WireMember>> members(2);
+  members[0].push_back({"10.0.0.1", 23000});
+  std::string wire = pt.PackWire(members);
+  PlacementTable follower;
+  CHECK(follower.AdoptWire(wire));
+  CHECK_EQ(follower.version(), pt.version());
+  CHECK_EQ(follower.entries().size(), 2u);
+  CHECK(follower.entries()[0].group == "g1" &&
+        follower.entries()[0].state == GroupState::kActive);
+  CHECK(follower.entries()[1].group == "g2" &&
+        follower.entries()[1].state == GroupState::kDraining);
+  // A truncated body is refused and leaves the table untouched.
+  CHECK(!follower.AdoptWire(wire.substr(0, wire.size() - 1)));
+  CHECK_EQ(follower.entries().size(), 2u);
+  CHECK(!follower.AdoptWire(""));
+}
+
+static void TestPlacementSaveLoad() {
+  PlacementTable pt;
+  pt.EnsureGroup("g1");
+  pt.EnsureGroup("g2");
+  CHECK_EQ(pt.Drain("g1"), 0);
+  const char* path = "/tmp/fdfs_tracker_test_placement.dat";
+  CHECK(pt.Save(path));
+  PlacementTable in;
+  CHECK(in.Load(path));
+  CHECK_EQ(in.version(), pt.version());
+  CHECK_EQ(in.entries().size(), 2u);
+  CHECK(in.entries()[0].group == "g1" &&
+        in.entries()[0].state == GroupState::kDraining);
+  std::remove(path);
+  PlacementTable fresh;
+  CHECK(fresh.Load(path));  // missing file = OK-empty
+  CHECK_EQ(fresh.entries().size(), 0u);
+}
+
+static void TestQueryStoreHonorsPlacement() {
+  // store_lookup = 3: keyed uploads route by the epoch's jump hash,
+  // draining groups take no new writes, keyless clients still work.
+  PlacementTable pt;
+  Cluster c(3);
+  c.set_placement(&pt);
+  CHECK(c.Join("g1", "10.0.0.1", 23000, 1, 1000).has_value());
+  CHECK(c.Join("g2", "10.0.0.2", 23000, 1, 1000).has_value());
+  CHECK_EQ(pt.entries().size(), 2u);  // Join appended both to the epoch
+  const std::string key = "alpha";
+  auto t = c.QueryStore("", key);
+  CHECK(t.has_value());
+  CHECK_EQ(t->group, pt.PickGroup(key));
+  // Drain the hashed group: the key re-homes to the remaining one.
+  CHECK_EQ(pt.Drain(t->group), 0);
+  auto t2 = c.QueryStore("", key);
+  CHECK(t2.has_value() && t2->group != t->group);
+  CHECK_EQ(t2->group, pt.PickGroup(key));
+  // A group-pinned upload cannot dodge the drain...
+  CHECK(!c.QueryStore(t->group).has_value());
+  // ...and a keyless legacy client round-robins over active groups only.
+  auto t3 = c.QueryStore("");
+  CHECK(t3.has_value() && t3->group != t->group);
+}
+
 int main() {
   TestBeatStatsRoundTripJson();
   TestShortBeatKeepsTail();
+  TestStoreLookup2Hysteresis();
+  TestPlacementLifecycle();
+  TestPlacementJumpStability();
+  TestPlacementWireRoundTrip();
+  TestPlacementSaveLoad();
+  TestQueryStoreHonorsPlacement();
   if (g_failures == 0) {
     std::printf("tracker_test: ALL PASS\n");
     return 0;

@@ -36,6 +36,15 @@
 //                normalized rule table "name threshold clear enabled"
 //                — pins conf/slo.conf parsing across languages against
 //                fastdfs_tpu.monitor.parse_slo_rules)
+//   fdfs_codec placement-wire  (golden QUERY_PLACEMENT response: a fixed
+//                placement epoch packed through PlacementTable::PackWire
+//                as hex, plus jump=<key>:<bucket> lines from the native
+//                jump-hash — compared against the Python decoder and
+//                fastdfs_tpu.common.jumphash, pinning both the wire
+//                layout and the placement function across languages)
+//   fdfs_codec group-admin     (golden GROUP_DRAIN / GROUP_REACTIVATE
+//                bodies: the 16-byte group-name request and the 8-byte
+//                new-version response as hex)
 //   fdfs_codec slab-layout     (golden slab record + slot-index
 //                encoding: one fixture chunk record and one recipe
 //                record emitted as hex, then re-scanned with the boot
@@ -61,8 +70,10 @@
 #include "common/protocol_gen.h"
 #include "common/sloeval.h"
 #include "common/stats.h"
+#include "common/jumphash.h"
 #include "common/trace.h"
 #include "storage/slabstore.h"
+#include "tracker/placement.h"
 
 using namespace fdfs;
 
@@ -329,6 +340,66 @@ int main(int argc, char** argv) {
     PutInt64BE(static_cast<int64_t>(lens[0] + lens[2]), num);
     pre.append(reinterpret_cast<char*>(num), 8);
     printf("chunks_prefix=%s\n", hex(pre).c_str());
+    return 0;
+  }
+  if (cmd == "placement-wire") {
+    // Fixed placement epoch — tests/test_groups.py decodes the hex with
+    // the Python client's QUERY_PLACEMENT parser and re-derives every
+    // jump line with fastdfs_tpu.common.jumphash, pinning the
+    // store_lookup=3 contract (wire layout AND bucket function) across
+    // languages.
+    PlacementTable table;
+    table.EnsureGroup("group1");
+    table.EnsureGroup("group2");
+    table.EnsureGroup("group3");
+    table.Drain("group2");  // version 4: three joins + one drain
+    std::vector<std::vector<PlacementTable::WireMember>> members(3);
+    members[0].push_back({"10.0.0.1", 23000});
+    members[1].push_back({"10.0.0.2", 23001});
+    members[2].push_back({"10.0.0.3", 23002});
+    members[2].push_back({"10.0.0.4", 23003});
+    auto hex = [](const std::string& s) {
+      static const char* k = "0123456789abcdef";
+      std::string out;
+      for (unsigned char c : s) {
+        out.push_back(k[c >> 4]);
+        out.push_back(k[c & 0xF]);
+      }
+      return out;
+    };
+    printf("version=%lld\n", static_cast<long long>(table.version()));
+    printf("response=%s\n", hex(table.PackWire(members)).c_str());
+    // Bucket function over the 2 ACTIVE groups (epoch order), plus the
+    // raw 64-bit placement keys so both layers pin independently.
+    const char* keys[4] = {"alpha", "bravo", "charlie", "delta"};
+    for (const char* key : keys) {
+      uint64_t pk = PlacementKey(key);
+      printf("key=%s placement_key=%llu jump=%d\n", key,
+             static_cast<unsigned long long>(pk), JumpHash(pk, 2));
+    }
+    return 0;
+  }
+  if (cmd == "group-admin") {
+    // GROUP_DRAIN / GROUP_REACTIVATE admin bodies: 16B group-name
+    // request, 8B big-endian new-placement-version OK response.
+    auto hex = [](const std::string& s) {
+      static const char* k = "0123456789abcdef";
+      std::string out;
+      for (unsigned char c : s) {
+        out.push_back(k[c >> 4]);
+        out.push_back(k[c & 0xF]);
+      }
+      return out;
+    };
+    std::string req;
+    PutFixedField(&req, "group2", kGroupNameMaxLen);
+    printf("drain_request=%s\n", hex(req).c_str());
+    printf("reactivate_request=%s\n", hex(req).c_str());
+    std::string resp;
+    uint8_t num[8];
+    PutInt64BE(4, num);  // the placement version the fixture drain minted
+    resp.append(reinterpret_cast<char*>(num), 8);
+    printf("ok_response=%s\n", hex(resp).c_str());
     return 0;
   }
   if (cmd == "event-json") {

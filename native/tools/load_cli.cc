@@ -29,6 +29,16 @@
 // `upload` also appends the minted file ids to <result>.ids for the
 // download/delete phases.
 //
+// --open-loop --rate R (upload and download, any position after the
+// mode): open-loop arrival mode (ISSUE 11's cluster load harness).
+// Op i is SCHEDULED at t0 + i/R seconds across ALL threads combined,
+// and its latency clock starts at the scheduled instant, not when a
+// worker got around to it — so when the cluster falls behind the
+// offered rate, the backlog lands in the latency percentiles instead
+// of silently throttling the load (the closed-loop coordinated-
+// omission failure).  Threads (<threads> = the concurrency cap) only
+// bound how many ops may be in flight at once.
+//
 // --zipf <s>: key-popularity mode for downloads (ISSUE 8 / ROADMAP
 // item 2's load harness seed).  Instead of round-robin over the ids
 // file, op i fetches the id Zipf(s) picks over a bounded key universe
@@ -221,9 +231,28 @@ struct Shared {
   int64_t unique = 0;  // 0 = every payload unique
   std::vector<std::string> ids;  // download/delete input
   std::unique_ptr<ZipfPicker> zipf;  // download key-popularity mode
+  // Open-loop mode (--open-loop --rate R): op i is SCHEDULED at
+  // t0 + i/R regardless of how slow earlier ops were, and its latency
+  // clock starts at the scheduled time — so server-side queueing shows
+  // up in the percentiles instead of silently throttling the offered
+  // load (the coordinated-omission fix; closed-loop when rate == 0).
+  double rate = 0;
+  int64_t t0_us = 0;
   RankedMutex out_mu{LockRank::kToolOutput};
   std::vector<OpRecord> records;
 };
+
+// Open-loop gate for op i: sleep until its scheduled instant and return
+// it as the latency-clock origin; closed-loop ops just start now.
+int64_t OpStartUs(Shared* sh, int64_t i) {
+  if (sh->rate <= 0) return MonoUs();
+  int64_t sched = sh->t0_us +
+                  static_cast<int64_t>(static_cast<double>(i) * 1e6 / sh->rate);
+  int64_t now = MonoUs();
+  if (now < sched)
+    usleep(static_cast<useconds_t>(sched - now));
+  return sched;
+}
 
 void Emit(Shared* sh, std::vector<OpRecord>* local) {
   std::lock_guard<RankedMutex> lk(sh->out_mu);
@@ -257,11 +286,12 @@ void UploadWorker(Shared* sh) {
   for (;;) {
     int64_t i = sh->next.fetch_add(1);
     if (i >= sh->n_ops) break;
+    int64_t start = OpStartUs(sh, i);
     int64_t pid = sh->unique > 0 ? (i % sh->unique) : i;
     FillPayload(pid, &payload);
     // bytes stays 0 unless the daemon ACCEPTED the upload — failed ops
     // must not inflate combine's throughput.
-    OpRecord rec{MonoUs(), 0, -1, 0, ""};
+    OpRecord rec{start, 0, -1, 0, ""};
     std::string group, ip;
     int port = 0;
     uint8_t spi = 0;
@@ -309,11 +339,12 @@ void DownloadWorker(Shared* sh) {
   for (;;) {
     int64_t i = sh->next.fetch_add(1);
     if (i >= sh->n_ops) break;
+    int64_t start = OpStartUs(sh, i);
     const std::string& fid =
         sh->zipf != nullptr
             ? sh->ids[sh->zipf->Pick(i) % sh->ids.size()]
             : sh->ids[i % sh->ids.size()];
-    OpRecord rec{MonoUs(), 0, -1, 0, fid};
+    OpRecord rec{start, 0, -1, 0, fid};
     std::string ip;
     int port = 0;
     if (QueryFetch(&tracker,
@@ -407,10 +438,43 @@ bool LoadIds(const std::string& path, std::vector<std::string>* ids) {
 }
 
 int RunWorkers(Shared* sh, int threads, void (*fn)(Shared*)) {
+  sh->t0_us = MonoUs();  // open-loop schedule origin
   std::vector<std::thread> ts;
   for (int t = 0; t < threads; ++t) ts.emplace_back(fn, sh);
   for (auto& t : ts) t.join();
   return 0;
+}
+
+// Strip --open-loop / --rate R (valid anywhere after the mode word)
+// out of argv, compacting the rest so positional parsing below stays
+// oblivious.  --rate alone implies open-loop; --open-loop without a
+// rate is an error rather than a guess.
+bool StripOpenLoopFlags(int* argc, char** argv, Shared* sh) {
+  bool open_loop = false;
+  double rate = 0;
+  int w = 0;
+  for (int a = 0; a < *argc; ++a) {
+    std::string flag = argv[a];
+    if (flag == "--open-loop") {
+      open_loop = true;
+    } else if (flag == "--rate" && a + 1 < *argc) {
+      char* end = nullptr;
+      rate = strtod(argv[++a], &end);
+      if (end == argv[a] || rate <= 0) {
+        fprintf(stderr, "--rate wants a positive ops/sec, got %s\n", argv[a]);
+        return false;
+      }
+    } else {
+      argv[w++] = argv[a];
+    }
+  }
+  *argc = w;
+  if (open_loop && rate <= 0) {
+    fprintf(stderr, "--open-loop needs --rate <ops/sec>\n");
+    return false;
+  }
+  sh->rate = rate;
+  return true;
 }
 
 int64_t Pct(const std::vector<int64_t>& sorted, double q) {
@@ -495,6 +559,7 @@ int main(int argc, char** argv) {
   }
 
   Shared sh;
+  if (!StripOpenLoopFlags(&argc, argv, &sh)) return 2;
   if (mode == "upload" && argc >= 7 &&
       std::string(argv[3]) == "--small-files") {
     // Small-file corpus mode (ISSUE 9 / config9): --small-files N

@@ -53,6 +53,9 @@ std::optional<std::vector<StorageNode>> Cluster::Join(
     int store_path_count, int64_t now, bool recovering) {
   GroupInfo& g = groups_[group];
   g.name = group;
+  // First sighting of a group appends it to the placement epoch (order
+  // is the consistency contract — see tracker/placement.h).
+  if (placement_ != nullptr) placement_->EnsureGroup(group);
   std::string addr = ip + ":" + std::to_string(port);
   // One member per IP: the file-ID source field identifies servers by IP
   // alone, so a second port on the same IP would corrupt read routing.
@@ -415,25 +418,58 @@ bool Cluster::DeleteStorage(const std::string& group, const std::string& addr) {
 
 // -- routing --------------------------------------------------------------
 
-std::optional<StoreTarget> Cluster::QueryStore(const std::string& group_hint) {
-  // Pick a group by policy over groups with >=1 ACTIVE member.
+GroupState Cluster::PlacementState(const std::string& group) const {
+  if (placement_ == nullptr) return GroupState::kActive;
+  const PlacementTable::Entry* e = placement_->Find(group);
+  return e == nullptr ? GroupState::kActive : e->state;
+}
+
+std::optional<StoreTarget> Cluster::QueryStore(const std::string& group_hint,
+                                               const std::string& key) {
+  // Pick a group by policy over groups with >=1 ACTIVE member.  Groups a
+  // placement epoch marks draining/retired take NO new writes (they keep
+  // serving reads — QueryFetch/QueryUpdate do not filter).
   std::vector<GroupInfo*> candidates;
   for (auto& [name, g] : groups_)
-    if (g.ActiveCount() > 0) candidates.push_back(&g);
+    if (g.ActiveCount() > 0 && PlacementState(name) == GroupState::kActive)
+      candidates.push_back(&g);
   if (candidates.empty()) return std::nullopt;
 
   GroupInfo* g = nullptr;
   if (!group_hint.empty()) {
     g = FindGroup(group_hint);
     if (g == nullptr || g->ActiveCount() == 0) return std::nullopt;
+    if (PlacementState(group_hint) != GroupState::kActive)
+      return std::nullopt;  // pinned uploads cannot dodge a drain
   } else if (store_lookup_ == 1 && !store_group_.empty()) {
     g = FindGroup(store_group_);
     if (g == nullptr || g->ActiveCount() == 0) return std::nullopt;
   } else if (store_lookup_ == 2) {
-    // load balance: most free space (reference: store_lookup=2)
-    for (GroupInfo* c : candidates)
-      if (g == nullptr || c->FreeMb() > g->FreeMb()) g = c;
+    // load balance: most free space (reference: store_lookup=2), with
+    // hysteresis — the previous pick is kept until a rival leads by more
+    // than balance_hysteresis_mb_, so two near-equal groups stop
+    // flapping the target every upload.
+    GroupInfo* best = nullptr;
+    GroupInfo* prev = nullptr;
+    for (GroupInfo* c : candidates) {
+      if (best == nullptr || c->FreeMb() > best->FreeMb()) best = c;
+      if (c->name == balance_group_) prev = c;
+    }
+    g = (prev != nullptr && best->FreeMb() <= prev->FreeMb() +
+                                                  balance_hysteresis_mb_)
+            ? prev
+            : best;
+    balance_group_ = g->name;
+  } else if (store_lookup_ == 3 && placement_ != nullptr && !key.empty()) {
+    // Consistent placement: jump-hash the client key over the epoch's
+    // ACTIVE list.  The hashed group not being servable right now (no
+    // ACTIVE member) is an honest routing failure — falling back to a
+    // different group would scatter the key's replicas across homes.
+    g = FindGroup(placement_->PickGroup(key));
+    if (g == nullptr || g->ActiveCount() == 0) return std::nullopt;
   } else {
+    // round-robin — also the keyless fallback under store_lookup = 3
+    // (legacy clients that ship no placement key still upload).
     g = candidates[rr_group_++ % candidates.size()];
   }
 
@@ -509,11 +545,12 @@ std::vector<StoreTarget> Cluster::QueryFetchAll(const std::string& group,
   return out;
 }
 
-std::vector<StoreTarget> Cluster::QueryStoreAll(const std::string& group_hint) {
+std::vector<StoreTarget> Cluster::QueryStoreAll(const std::string& group_hint,
+                                                const std::string& key) {
   // Same group pick as QueryStore, but every ACTIVE member is returned
   // (upstream QUERY_STORE_*_ALL: client chooses / retries among them).
   std::vector<StoreTarget> out;
-  auto one = QueryStore(group_hint);
+  auto one = QueryStore(group_hint, key);
   if (!one.has_value()) return out;
   GroupInfo* g = FindGroup(one->group);
   for (const auto& [addr, s] : g->storages) {
@@ -572,13 +609,14 @@ static void AppendStorageJson(std::string* out, const StorageNode& s,
   *out += buf;
 }
 
-static std::string GroupJson(const GroupInfo& g) {
-  char buf[320];
+static std::string GroupJson(const GroupInfo& g, GroupState state) {
+  char buf[352];
   std::snprintf(buf, sizeof(buf),
                 "{\"name\":\"%s\",\"members\":%zu,\"active\":%d,"
-                "\"free_mb\":%lld,\"trunk_server\":\"%s\"}",
+                "\"free_mb\":%lld,\"trunk_server\":\"%s\",\"state\":\"%s\"}",
                 g.name.c_str(), g.storages.size(), g.ActiveCount(),
-                static_cast<long long>(g.FreeMb()), g.trunk_addr.c_str());
+                static_cast<long long>(g.FreeMb()), g.trunk_addr.c_str(),
+                GroupStateName(state));
   return buf;
 }
 
@@ -588,14 +626,15 @@ std::string Cluster::GroupsJson() const {
   for (const auto& [name, g] : groups_) {
     if (!first) out += ",";
     first = false;
-    out += GroupJson(g);
+    out += GroupJson(g, PlacementState(name));
   }
   return out + "]";
 }
 
 std::string Cluster::OneGroupJson(const std::string& group) const {
   auto it = groups_.find(group);
-  return it == groups_.end() ? "{}" : GroupJson(it->second);
+  return it == groups_.end() ? "{}"
+                             : GroupJson(it->second, PlacementState(group));
 }
 
 std::string Cluster::StoragesJson(const std::string& group) const {
@@ -660,11 +699,12 @@ std::string Cluster::ClusterStatJson(int64_t now,
     std::snprintf(buf, sizeof(buf),
                   "{\"name\":\"%s\",\"members\":%zu,\"active\":%d,"
                   "\"free_mb\":%lld,\"trunk_server\":\"%s\","
-                  "\"trunk_epoch\":%lld,\"storages\":[",
+                  "\"trunk_epoch\":%lld,\"state\":\"%s\",\"storages\":[",
                   JsonEscape(g.name).c_str(), g.storages.size(),
                   g.ActiveCount(), static_cast<long long>(g.FreeMb()),
                   JsonEscape(g.trunk_addr).c_str(),
-                  static_cast<long long>(g.trunk_epoch));
+                  static_cast<long long>(g.trunk_epoch),
+                  GroupStateName(PlacementState(gname)));
     out += buf;
     bool sfirst = true;
     for (const auto& [addr, s] : g.storages) {

@@ -24,6 +24,7 @@
 #include <vector>
 
 #include "common/protocol_gen.h"  // kBeatStatCount / kBeatStatNames
+#include "tracker/placement.h"
 
 namespace fdfs {
 
@@ -87,6 +88,20 @@ class Cluster {
   // beat-timeout OFFLINE, back-online — become structured cluster
   // events behind TrackerCmd::kEventDump.  Set once before serving.
   void set_events(class EventLog* events) { events_ = events; }
+
+  // Placement epoch (may stay null = every group active): Join() appends
+  // new groups, QueryStore routes around draining/retired groups and —
+  // store_lookup = 3 — jump-hashes the client key over its active list.
+  // Owned by TrackerServer (persisted with the rest of its state).
+  void set_placement(PlacementTable* p) { placement_ = p; }
+
+  // store_lookup = 2 flapping fix: the previous pick is kept until a
+  // rival group leads its free space by MORE than this delta (MB).
+  void set_balance_hysteresis_mb(int64_t mb) { balance_hysteresis_mb_ = mb; }
+
+  // Lifecycle state this cluster's routing honors for `group` (kActive
+  // when no placement table is attached or the group is unknown to it).
+  GroupState PlacementState(const std::string& group) const;
 
   // -- membership (tracker_mem_add_storage / beats) ----------------------
   // nullopt: rejected (another member already owns this IP on a different
@@ -154,7 +169,11 @@ class Cluster {
   std::string CurrentTrunkAddr(const std::string& group) const;
 
   // -- routing (tracker_get_writable_storage & co.) ----------------------
-  std::optional<StoreTarget> QueryStore(const std::string& group_hint);
+  // `key`: optional client placement key (store_lookup = 3 appends it to
+  // the classic empty QUERY_STORE body); ignored by the other policies
+  // and when a group hint pins the pick.
+  std::optional<StoreTarget> QueryStore(const std::string& group_hint,
+                                        const std::string& key = "");
   std::optional<StoreTarget> QueryFetch(const std::string& group,
                                         const std::string& remote);
   std::optional<StoreTarget> QueryUpdate(const std::string& group,
@@ -162,7 +181,8 @@ class Cluster {
   // ALL-variant queries (cmds 105/106/107): every valid candidate at once.
   std::vector<StoreTarget> QueryFetchAll(const std::string& group,
                                          const std::string& remote);
-  std::vector<StoreTarget> QueryStoreAll(const std::string& group_hint);
+  std::vector<StoreTarget> QueryStoreAll(const std::string& group_hint,
+                                         const std::string& key = "");
 
   // Server-ID alias table (storage_ids.conf): ip -> stable id, shown by
   // the monitor feed.
@@ -192,6 +212,11 @@ class Cluster {
                                  const std::string& exclude_addr) const;
   GroupInfo* FindGroup(const std::string& name);
   size_t group_count() const { return groups_.size(); }
+  std::vector<std::string> GroupNames() const {
+    std::vector<std::string> out;
+    for (const auto& [name, g] : groups_) out.push_back(name);
+    return out;
+  }
 
  private:
   StorageNode* FindNode(const std::string& group, const std::string& addr);
@@ -203,6 +228,11 @@ class Cluster {
   bool trunk_enabled_;
   size_t rr_group_ = 0;
   class EventLog* events_ = nullptr;
+  PlacementTable* placement_ = nullptr;
+  // store_lookup = 2 hysteresis state: the group the last upload went to
+  // and the free-space lead a rival needs before the pick moves.
+  std::string balance_group_;
+  int64_t balance_hysteresis_mb_ = 1024;
 };
 
 }  // namespace fdfs

@@ -37,6 +37,13 @@ int main(int argc, char** argv) {
   cfg.base_path = ini.GetStr("base_path", "");
   cfg.store_lookup = static_cast<int>(ini.GetInt("store_lookup", 0));
   cfg.store_group = ini.GetStr("store_group", "");
+  cfg.placement_hysteresis_free_mb = ini.GetInt(
+      "placement_hysteresis_free_mb", cfg.placement_hysteresis_free_mb);
+  if (cfg.placement_hysteresis_free_mb < 0)
+    cfg.placement_hysteresis_free_mb = 0;
+  cfg.rebalance_bandwidth_mb_s = static_cast<int>(ini.GetInt(
+      "rebalance_bandwidth_mb_s", cfg.rebalance_bandwidth_mb_s));
+  if (cfg.rebalance_bandwidth_mb_s < 0) cfg.rebalance_bandwidth_mb_s = 0;
   cfg.check_active_interval_s =
       static_cast<int>(ini.GetSeconds("check_active_interval", 100));
   cfg.save_interval_s = static_cast<int>(ini.GetSeconds("save_interval", 30));

@@ -1,0 +1,198 @@
+#include "tracker/placement.h"
+
+#include <cstdio>
+#include <cstring>
+
+#include "common/bytes.h"
+#include "common/jumphash.h"
+#include "common/log.h"
+#include "common/protocol_gen.h"
+
+namespace fdfs {
+
+const char* GroupStateName(GroupState s) {
+  switch (s) {
+    case GroupState::kActive: return "active";
+    case GroupState::kDraining: return "draining";
+    case GroupState::kRetired: return "retired";
+  }
+  return "?";
+}
+
+PlacementTable::Entry* PlacementTable::FindMutable(const std::string& group) {
+  for (Entry& e : entries_)
+    if (e.group == group) return &e;
+  return nullptr;
+}
+
+const PlacementTable::Entry* PlacementTable::Find(
+    const std::string& group) const {
+  for (const Entry& e : entries_)
+    if (e.group == group) return &e;
+  return nullptr;
+}
+
+bool PlacementTable::EnsureGroup(const std::string& group) {
+  if (Find(group) != nullptr) return false;
+  entries_.push_back({group, GroupState::kActive});
+  ++version_;
+  FDFS_LOG_INFO("placement: group %s joined epoch at slot %zu (version %lld)",
+                group.c_str(), entries_.size() - 1,
+                static_cast<long long>(version_));
+  return true;
+}
+
+int PlacementTable::Drain(const std::string& group) {
+  Entry* e = FindMutable(group);
+  if (e == nullptr) return 2;
+  if (e->state == GroupState::kDraining) return 0;  // idempotent
+  if (e->state == GroupState::kRetired) return 22;
+  e->state = GroupState::kDraining;
+  ++version_;
+  FDFS_LOG_INFO("placement: group %s draining (version %lld)", group.c_str(),
+                static_cast<long long>(version_));
+  return 0;
+}
+
+int PlacementTable::Reactivate(const std::string& group) {
+  Entry* e = FindMutable(group);
+  if (e == nullptr) return 2;
+  if (e->state == GroupState::kActive) return 0;  // idempotent
+  // Retired groups left the hash domain with their data already moved
+  // elsewhere; silently re-activating one would shift every key's
+  // bucket without anything re-homing files into it.
+  if (e->state == GroupState::kRetired) return 22;
+  e->state = GroupState::kActive;
+  ++version_;
+  FDFS_LOG_INFO("placement: group %s reactivated (version %lld)",
+                group.c_str(), static_cast<long long>(version_));
+  return 0;
+}
+
+int PlacementTable::Retire(const std::string& group) {
+  Entry* e = FindMutable(group);
+  if (e == nullptr) return 2;
+  if (e->state == GroupState::kRetired) return 0;  // idempotent
+  if (e->state != GroupState::kDraining) return 22;
+  e->state = GroupState::kRetired;
+  ++version_;
+  FDFS_LOG_INFO("placement: group %s retired (version %lld)", group.c_str(),
+                static_cast<long long>(version_));
+  return 0;
+}
+
+std::vector<std::string> PlacementTable::ActiveGroups() const {
+  std::vector<std::string> out;
+  for (const Entry& e : entries_)
+    if (e.state == GroupState::kActive) out.push_back(e.group);
+  return out;
+}
+
+std::string PlacementTable::PickGroup(std::string_view key) const {
+  std::vector<std::string> active = ActiveGroups();
+  if (active.empty()) return "";
+  return active[JumpHash(PlacementKey(key),
+                         static_cast<int32_t>(active.size()))];
+}
+
+// -- wire -----------------------------------------------------------------
+
+std::string PlacementTable::PackWire(
+    const std::vector<std::vector<WireMember>>& members_per_entry) const {
+  std::string out;
+  char buf[8];
+  PutInt64BE(version_, reinterpret_cast<uint8_t*>(buf));
+  out.append(buf, 8);
+  PutInt64BE(static_cast<int64_t>(entries_.size()),
+             reinterpret_cast<uint8_t*>(buf));
+  out.append(buf, 8);
+  for (size_t i = 0; i < entries_.size(); ++i) {
+    PutFixedField(&out, entries_[i].group, kGroupNameMaxLen);
+    out.push_back(static_cast<char>(entries_[i].state));
+    const std::vector<WireMember>* members =
+        i < members_per_entry.size() ? &members_per_entry[i] : nullptr;
+    int64_t n = members == nullptr ? 0 : static_cast<int64_t>(members->size());
+    PutInt64BE(n, reinterpret_cast<uint8_t*>(buf));
+    out.append(buf, 8);
+    for (int64_t m = 0; m < n; ++m) {
+      PutFixedField(&out, (*members)[m].ip, kIpAddressSize);
+      PutInt64BE((*members)[m].port, reinterpret_cast<uint8_t*>(buf));
+      out.append(buf, 8);
+    }
+  }
+  return out;
+}
+
+bool PlacementTable::AdoptWire(const std::string& body) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(body.data());
+  size_t len = body.size();
+  if (len < 16) return false;
+  int64_t version = GetInt64BE(p);
+  int64_t count = GetInt64BE(p + 8);
+  size_t off = 16;
+  // Divide-don't-multiply bounds sanity: a minimal entry is 25 bytes.
+  if (count < 0 ||
+      static_cast<uint64_t>(count) > (len - off) / (kGroupNameMaxLen + 9))
+    return false;
+  std::vector<Entry> entries;
+  for (int64_t i = 0; i < count; ++i) {
+    if (off + kGroupNameMaxLen + 9 > len) return false;
+    Entry e;
+    e.group = GetFixedField(p + off, kGroupNameMaxLen);
+    uint8_t st = p[off + kGroupNameMaxLen];
+    if (st > static_cast<uint8_t>(GroupState::kRetired)) return false;
+    e.state = static_cast<GroupState>(st);
+    off += kGroupNameMaxLen + 1;
+    int64_t members = GetInt64BE(p + off);
+    off += 8;
+    const size_t rec = kIpAddressSize + 8;
+    if (members < 0 || static_cast<uint64_t>(members) > (len - off) / rec)
+      return false;
+    off += static_cast<size_t>(members) * rec;  // followers keep only the epoch
+    entries.push_back(std::move(e));
+  }
+  entries_ = std::move(entries);
+  version_ = version;
+  return true;
+}
+
+// -- persistence ----------------------------------------------------------
+
+bool PlacementTable::Save(const std::string& path) const {
+  std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (f == nullptr) return false;
+  fprintf(f, "version %lld\n", static_cast<long long>(version_));
+  for (const Entry& e : entries_)
+    fprintf(f, "group %s %d\n", e.group.c_str(), static_cast<int>(e.state));
+  fclose(f);
+  return rename(tmp.c_str(), path.c_str()) == 0;
+}
+
+bool PlacementTable::Load(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (f == nullptr) return true;  // nothing saved yet
+  char line[512];
+  std::vector<Entry> entries;
+  int64_t version = 0;
+  while (fgets(line, sizeof(line), f) != nullptr) {
+    char name[256];
+    long long v = 0;
+    int st = 0;
+    if (sscanf(line, "version %lld", &v) == 1) {
+      version = v;
+      continue;
+    }
+    if (sscanf(line, "group %255s %d", name, &st) == 2 && st >= 0 &&
+        st <= static_cast<int>(GroupState::kRetired))
+      entries.push_back({name, static_cast<GroupState>(st)});
+  }
+  fclose(f);
+  entries_ = std::move(entries);
+  version_ = version;
+  FDFS_LOG_INFO("placement epoch loaded: %zu groups, version %lld",
+                entries_.size(), static_cast<long long>(version_));
+  return true;
+}
+
+}  // namespace fdfs

@@ -1,0 +1,91 @@
+// Placement epoch for store_lookup = 3 (consistent jump-hash placement;
+// fastdfs_tpu extension, SURVEY §0 "scale by adding groups").
+//
+// The epoch is the ORDERED list of groups the cluster has ever seen plus
+// each group's lifecycle state (active / draining / retired), stamped
+// with a version that bumps on every change.  Order is the contract:
+// groups append on first join and never reorder or compact, so
+// jump_hash(sha1(key), n_active) over the active sublist moves only
+// ~1/(N+1) of keys when group N+1 joins (arXiv:1406.2294), and a
+// draining group's files have a deterministic re-placement target that
+// the tracker, the storage-side rebalance migrator, and a
+// placement-routing Python client all compute independently.
+//
+// Single-threaded by design: all mutation and reads happen on the
+// tracker's event loop (like Cluster), so there is no mutex here.  The
+// table persists under base_path/data/placement.dat and is served to
+// clients/storages via TrackerCmd::kQueryPlacement; followers adopt the
+// leader's table wholesale (Adopt) instead of mutating locally.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <string_view>
+#include <vector>
+
+namespace fdfs {
+
+// Wire values (QUERY_PLACEMENT entry state byte; protocol.py contract).
+enum class GroupState : uint8_t {
+  kActive = 0,    // placed by jump hash, serves reads + writes
+  kDraining = 1,  // no new writes; reads + replication continue; migrating
+  kRetired = 2,   // drain finished: out of the hash domain, no data left
+};
+
+const char* GroupStateName(GroupState s);
+
+class PlacementTable {
+ public:
+  struct Entry {
+    std::string group;
+    GroupState state = GroupState::kActive;
+  };
+
+  // Append-on-first-join (Cluster::Join hook).  Returns true when the
+  // group was new (version bumped) — order preserved forever after.
+  bool EnsureGroup(const std::string& group);
+
+  // Admin transitions (GROUP_DRAIN / GROUP_REACTIVATE / auto-retire).
+  // Errno-style returns: 0 ok (idempotent repeats included), 2 unknown
+  // group, 22 invalid transition (reactivating a retired group is the
+  // one refused move — its data is gone, re-adding must re-join).
+  int Drain(const std::string& group);
+  int Reactivate(const std::string& group);
+  int Retire(const std::string& group);
+
+  const Entry* Find(const std::string& group) const;
+  // Groups currently in the jump-hash domain, in epoch order.
+  std::vector<std::string> ActiveGroups() const;
+  // jump_hash(sha1(key)) over ActiveGroups(); "" when none are active.
+  std::string PickGroup(std::string_view key) const;
+
+  int64_t version() const { return version_; }
+  const std::vector<Entry>& entries() const { return entries_; }
+
+  // QUERY_PLACEMENT response body: 8B version + 8B entry count + per
+  // entry (16B group + 1B state + 8B member count + per member (16B ip
+  // + 8B port)).  Members (a group's ACTIVE storages) come from the
+  // caller because membership lives in Cluster, not here.
+  struct WireMember {
+    std::string ip;
+    int port = 0;
+  };
+  std::string PackWire(
+      const std::vector<std::vector<WireMember>>& members_per_entry) const;
+  // Follower adoption: parse a leader's PackWire body and replace the
+  // whole table (members are routing hints for clients; the follower
+  // keeps only the epoch).  False on a malformed body (table untouched).
+  bool AdoptWire(const std::string& body);
+
+  // Text persistence under the tracker's base_path (atomic tmp+rename,
+  // the Cluster::Save discipline).  Load of a missing file is OK-empty.
+  bool Save(const std::string& path) const;
+  bool Load(const std::string& path);
+
+ private:
+  Entry* FindMutable(const std::string& group);
+  std::vector<Entry> entries_;
+  int64_t version_ = 0;
+};
+
+}  // namespace fdfs

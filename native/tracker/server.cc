@@ -89,6 +89,9 @@ const char* TrackerOpName(uint8_t cmd) {
     case TrackerCmd::kServerClusterStat: return "tracker.cluster_stat";
     case TrackerCmd::kServerListAllGroups: return "tracker.list_groups";
     case TrackerCmd::kStorageSyncReport: return "tracker.sync_report";
+    case TrackerCmd::kQueryPlacement: return "tracker.query_placement";
+    case TrackerCmd::kGroupDrain: return "tracker.group_drain";
+    case TrackerCmd::kGroupReactivate: return "tracker.group_reactivate";
     default: return nullptr;
   }
 }
@@ -109,6 +112,11 @@ bool TrackerServer::Init(std::string* error) {
   cluster_ = std::make_unique<Cluster>(cfg_.store_lookup, cfg_.store_group,
                                        cfg_.use_trunk_file);
   cluster_->set_events(events_.get());
+  cluster_->set_balance_hysteresis_mb(cfg_.placement_hysteresis_free_mb);
+  placement_ = std::make_unique<PlacementTable>();
+  placement_path_ = cfg_.base_path + "/data/placement.dat";
+  placement_->Load(placement_path_);
+  cluster_->set_placement(placement_.get());
 
   // Telemetry history + SLOs (ISSUE 8): the same journal/evaluator pair
   // the storage daemon runs, minus the storage-only rules (their
@@ -207,6 +215,11 @@ bool TrackerServer::Init(std::string* error) {
   state_path_ = cfg_.base_path + "/data/storage_servers.dat";
   changelog_path_ = cfg_.base_path + "/data/changelog.dat";
   cluster_->Load(state_path_);
+  // A lost/older placement.dat must not orphan groups the cluster state
+  // remembers: backfill them (in name order — the one arbitrary choice,
+  // made identically by every tracker replaying the same state).
+  for (const std::string& g : cluster_->GroupNames())
+    placement_->EnsureGroup(g);
 
   server_ = std::make_unique<RequestServer>(
       &loop_, [this](uint8_t cmd, const std::string& body,
@@ -258,11 +271,15 @@ bool TrackerServer::Init(std::string* error) {
   loop_.AddTimer(1000, [this]() {
     cluster_->CheckAlive(time(nullptr), cfg_.check_active_interval_s);
   });
+  // Drain endgame: only the leader decides a drain is complete (it owns
+  // every other epoch transition too).
+  loop_.AddTimer(2000, [this]() { MaybeAutoRetire(); });
   if (cfg_.slo_eval_interval_s > 0 && (metrics_ != nullptr || slo_ != nullptr))
     loop_.AddTimer(cfg_.slo_eval_interval_s * 1000,
                    [this]() { MetricsTick(); });
   loop_.AddTimer(cfg_.save_interval_s * 1000, [this]() {
     cluster_->Save(state_path_);
+    placement_->Save(placement_path_);
     // Periodic status file (tracker_write_status_file analogue).
     std::string tmp = cfg_.base_path + "/data/tracker_status.dat.tmp";
     FILE* f = fopen(tmp.c_str(), "w");
@@ -377,8 +394,81 @@ std::string TrackerServer::ResolveTrunkServer(const std::string& group) {
 
 void TrackerServer::Stop() {
   cluster_->Save(state_path_);
+  placement_->Save(placement_path_);
   if (relationship_ != nullptr) relationship_->Stop();
   loop_.Stop();
+}
+
+std::string TrackerServer::PackPlacement() const {
+  std::vector<std::vector<PlacementTable::WireMember>> members;
+  for (const PlacementTable::Entry& e : placement_->entries()) {
+    std::vector<PlacementTable::WireMember> ms;
+    for (const StorageNode& s : cluster_->Peers(e.group, "")) {
+      if (s.status != static_cast<int>(StorageStatus::kActive)) continue;
+      ms.push_back({s.ip, s.port});
+    }
+    members.push_back(std::move(ms));
+  }
+  return placement_->PackWire(members);
+}
+
+void TrackerServer::MaybeAdoptPlacement() {
+  if (relationship_ == nullptr || relationship_->am_leader()) return;
+  // The ResolveTrunkServer discipline: at most one leader round-trip a
+  // second, ~10s backoff when the leader is unreachable, and the last
+  // adopted epoch keeps serving meanwhile.
+  int64_t now_ms = NowMs();
+  if (now_ms - placement_fetched_ms_ < 1000) return;
+  placement_fetched_ms_ = now_ms;
+  std::string resp;
+  uint8_t status = 0;
+  if (relationship_->RpcLeader(
+          static_cast<uint8_t>(TrackerCmd::kQueryPlacement), "", &resp,
+          &status, /*timeout_ms=*/300) &&
+      status == 0) {
+    if (!placement_->AdoptWire(resp))
+      FDFS_LOG_WARN("placement: malformed epoch body from leader (%zu bytes)",
+                    resp.size());
+  } else {
+    placement_fetched_ms_ = now_ms + 9000;
+  }
+}
+
+void TrackerServer::MaybeAutoRetire() {
+  if (relationship_ != nullptr && !relationship_->am_leader()) return;
+  // Index the rebalance beat slots once (the names are the contract;
+  // the positions are generated).
+  static const int pending_slot = [] {
+    for (int i = 0; i < kBeatStatCount; ++i)
+      if (strcmp(kBeatStatNames[i], "rebalance_files_pending") == 0) return i;
+    return -1;
+  }();
+  static const int done_slot = [] {
+    for (int i = 0; i < kBeatStatCount; ++i)
+      if (strcmp(kBeatStatNames[i], "rebalance_done") == 0) return i;
+    return -1;
+  }();
+  if (pending_slot < 0 || done_slot < 0) return;
+  for (const PlacementTable::Entry& e : placement_->entries()) {
+    if (e.state != GroupState::kDraining) continue;
+    int actives = 0;
+    bool all_done = true;
+    for (const StorageNode& s : cluster_->Peers(e.group, "")) {
+      if (s.status != static_cast<int>(StorageStatus::kActive)) continue;
+      ++actives;
+      if (s.stats[done_slot] != 1 || s.stats[pending_slot] != 0)
+        all_done = false;
+    }
+    // No ACTIVE member means no evidence — a group of crashed storages
+    // must not be declared empty.
+    if (actives == 0 || !all_done) continue;
+    if (placement_->Retire(e.group) == 0) {
+      placement_->Save(placement_path_);
+      if (events_ != nullptr)
+        events_->Record(EventSeverity::kInfo, "group.retired", e.group,
+                        "version=" + std::to_string(placement_->version()));
+    }
+  }
 }
 
 void TrackerServer::DumpState() {
@@ -456,6 +546,15 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       PutInt64BE(cluster_->TrunkEpoch(group),
                  reinterpret_cast<uint8_t*>(pbuf));
       out.append(pbuf, 8);
+      // +1B group lifecycle state + 8B placement version (append-only
+      // trailer extension, prefix-tolerant at the storage): how a member
+      // learns its group started draining and must refuse new writes /
+      // run the rebalance migrator.
+      MaybeAdoptPlacement();  // followers: keep the served state fresh
+      out.push_back(
+          static_cast<char>(cluster_->PlacementState(group)));
+      PutInt64BE(placement_->version(), reinterpret_cast<uint8_t*>(pbuf));
+      out.append(pbuf, 8);
       return {0, out};
     }
 
@@ -484,7 +583,10 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
     }
 
     case TrackerCmd::kServiceQueryStoreWithoutGroupOne: {
-      auto t = cluster_->QueryStore("");
+      // Optional body = the client's placement key (store_lookup = 3
+      // jump-hashes it; other policies ignore it).  Legacy clients send
+      // an empty body and round-robin.
+      auto t = cluster_->QueryStore("", body);
       if (!t.has_value()) return {2, ""};
       return {0, PackStoreTarget(*t)};
     }
@@ -504,7 +606,7 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
         if (body.size() < 16) return {22, ""};
         hint = FixedGroup(p);
       }
-      auto ts = cluster_->QueryStoreAll(hint);
+      auto ts = cluster_->QueryStoreAll(hint, hint.empty() ? body : "");
       if (ts.empty()) return {2, ""};
       return {0, PackTargetList(ts[0].group, 0xFF, ts)};
     }
@@ -597,11 +699,13 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
           buf, sizeof(buf),
           "store_lookup=%d\ncheck_active_interval=%d\n"
           "use_trunk_file=%d\nslot_min_size=%d\nslot_max_size=%d\n"
-          "trunk_file_size=%lld\nreserved_storage_space=%lld\n",
+          "trunk_file_size=%lld\nreserved_storage_space=%lld\n"
+          "rebalance_bandwidth_mb_s=%d\n",
           cfg_.store_lookup, cfg_.check_active_interval_s,
           cfg_.use_trunk_file ? 1 : 0, cfg_.slot_min_size, cfg_.slot_max_size,
           static_cast<long long>(cfg_.trunk_file_size),
-          static_cast<long long>(cfg_.reserved_storage_space_mb));
+          static_cast<long long>(cfg_.reserved_storage_space_mb),
+          cfg_.rebalance_bandwidth_mb_s);
       return {0, buf};
     }
 
@@ -776,6 +880,38 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
     case TrackerCmd::kServerListStorage: {
       if (body.size() < 16) return {22, ""};
       return {0, cluster_->StoragesJson(FixedGroup(p))};
+    }
+
+    case TrackerCmd::kQueryPlacement:
+      // Placement epoch fetch (empty body): clients route uploads from
+      // the returned table without a tracker round-trip; storages learn
+      // the active list the rebalance migrator re-places against.
+      MaybeAdoptPlacement();
+      return {0, PackPlacement()};
+
+    case TrackerCmd::kGroupDrain:
+    case TrackerCmd::kGroupReactivate: {
+      // 16B group.  Leader-only (the kServerSetTrunkServer rationale:
+      // epoch transitions decided in two places would fork the hash
+      // domain); a follower refuses with EBUSY and the client retries
+      // against its other trackers.
+      if (body.size() < 16) return {22, ""};
+      if (relationship_ != nullptr && !relationship_->am_leader())
+        return {16 /*EBUSY: not the leader*/, ""};
+      std::string group = FixedGroup(p);
+      bool drain = static_cast<TrackerCmd>(cmd) == TrackerCmd::kGroupDrain;
+      int rc = drain ? placement_->Drain(group)
+                     : placement_->Reactivate(group);
+      if (rc != 0) return {static_cast<uint8_t>(rc), ""};
+      placement_->Save(placement_path_);
+      if (events_ != nullptr)
+        events_->Record(EventSeverity::kInfo,
+                        drain ? "group.drain" : "group.reactivate", group,
+                        "version=" + std::to_string(placement_->version()));
+      std::string out(8, '\0');
+      PutInt64BE(placement_->version(),
+                 reinterpret_cast<uint8_t*>(out.data()));
+      return {0, out};
     }
 
     case TrackerCmd::kServerDeleteStorage: {

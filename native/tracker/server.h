@@ -25,8 +25,16 @@ struct TrackerConfig {
   std::string bind_addr;
   int port = 22122;
   std::string base_path;
-  int store_lookup = 0;        // 0 rr, 1 specified, 2 load-balance
+  int store_lookup = 0;        // 0 rr, 1 specified, 2 load-balance, 3 jump
   std::string store_group;
+  // store_lookup = 2 hysteresis: a rival group must lead the current
+  // pick's free space by more than this before the target switches
+  // (tracker.conf:placement_hysteresis_free_mb).
+  int64_t placement_hysteresis_free_mb = 1024;
+  // Rebalance migrator pacing, served to every storage via
+  // kStorageParameterReq (tracker.conf:rebalance_bandwidth_mb_s;
+  // 0 = unpaced).
+  int rebalance_bandwidth_mb_s = 8;
   // Beat timeout => OFFLINE.  Must exceed the storage heartbeat default
   // (30s); upstream uses 100s.
   int check_active_interval_s = 100;
@@ -91,6 +99,17 @@ class TrackerServer {
   // locally — independent elections from transiently-diverged ACTIVE sets
   // can double-allocate trunk slots.
   std::string ResolveTrunkServer(const std::string& group);
+  // Placement epoch plumbing (store_lookup = 3 subsystem).  The leader
+  // owns transitions (admin opcodes, join appends, auto-retire); a
+  // follower refreshes its adopted copy from the leader at most once a
+  // second (the ResolveTrunkServer discipline — stale-but-consistent).
+  void MaybeAdoptPlacement();
+  // QUERY_PLACEMENT response body: epoch entries + each group's ACTIVE
+  // members as routing hints.
+  std::string PackPlacement() const;
+  // Leader timer: a draining group whose every ACTIVE member reports
+  // rebalance done (and nothing pending) retires out of the epoch.
+  void MaybeAutoRetire();
 
   TrackerConfig cfg_;
   std::map<std::string, int64_t> trunk_fetched_ms_;  // follower cache age
@@ -117,6 +136,9 @@ class TrackerServer {
   std::atomic<int64_t>* ctr_errors_ = nullptr;
   StatHistogram* hist_request_us_ = nullptr;
   std::unique_ptr<Cluster> cluster_;
+  std::unique_ptr<PlacementTable> placement_;
+  std::string placement_path_;
+  int64_t placement_fetched_ms_ = 0;  // follower adoption throttle
   std::unique_ptr<RelationshipManager> relationship_;
   EventLoop loop_;
   std::unique_ptr<RequestServer> server_;

@@ -129,3 +129,25 @@ def test_config9_slab_packing_smoke(tmp_path):
     assert art["delete_heavy"]["reclaim_pct"] >= 80.0
     assert art["delete_heavy"]["survivor_download"]["errors"] == 0
     assert art["ingest_p50_packed_vs_flat"] > 0
+
+
+def test_config10_multi_group_smoke(tmp_path):
+    # The multi-group open-loop scenario end-to-end at tiny scale: both
+    # arms come up (1 vs 3 groups under a placement-mode tracker), the
+    # keyless preload spreads the 3-group corpus, the SAME calibrated
+    # open-loop rate replays against both, and no op errors.  (The tail-
+    # latency comparison is asserted on the checked-in artifact, not
+    # here — sub-ms percentiles at smoke scale are noise.)
+    bc.config10(str(tmp_path), scale=0.001)  # ~67 x 64 KB per arm
+    with open(os.path.join(str(tmp_path), "config10.json")) as fh:
+        art = json.load(fh)
+    assert art["zero_errors"] is True
+    assert art["offered_rate_qps"] > 0
+    assert art["arms"]["one_group"]["groups"] == 1
+    assert art["arms"]["three_groups"]["groups"] == 3
+    assert art["three_group_spread_within_10pct"] is True
+    assert art["arms"]["three_groups"]["open_download"]["ops"] >= 100
+    assert art["p99_three_vs_one"] > 0
+    drain = art["arms"]["three_groups"]["drain"]
+    assert art["drain_relocated_all"] is True
+    assert drain["files_moved"] >= 1 and drain["pace_mb_s"] > 0

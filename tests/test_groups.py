@@ -1,0 +1,280 @@
+"""Multi-group scale-out acceptance (ISSUE 11): jump-hash placement
+(store_lookup = 3), group lifecycle (drain / reactivate / auto-retire),
+and the tracker-coordinated rebalance migrator.
+
+Live-cluster layers:
+- 3-group placement: keyed uploads land exactly where the Python jump
+  hash says (per-group share within 10 points of 1/N under uniform
+  keys), and the tracker + client hash the SAME epoch order;
+- elasticity: adding a 4th group widens the hash domain for new keys but
+  relocates no existing file (rebalance stays idle, old reads intact);
+- drain -> rebalance -> retire: every file of a drained group re-homes
+  to its jump-hash target with byte-identical content, the source copy
+  is reclaimed, the map sidecar records old->new ids, and the group
+  auto-retires; mid-drain keyed uploads transparently re-route with zero
+  client-visible errors (including a placement-routing client holding a
+  STALE epoch cache, bounced by the storage-side EBUSY write refusal).
+
+Wired into tools/run_sanitizers.sh (TSan + FDFS_LOCKRANK legs): the
+migrator thread races live upload/download/beat traffic here.
+"""
+
+import os
+import shutil
+import time
+
+import pytest
+
+from fastdfs_tpu.client.client import FdfsClient
+from fastdfs_tpu.client.conn import StatusError
+from fastdfs_tpu.client.storage_client import StorageClient
+from fastdfs_tpu.common.jumphash import jump_hash, placement_key
+from tests.harness import (STORAGED, TRACKERD, start_storage, start_tracker,
+                           upload_retry)
+
+_HAVE_TOOLCHAIN = ((shutil.which("cmake") is not None
+                    and shutil.which("ninja") is not None)
+                   or shutil.which("g++") is not None)
+_HAVE_BINARIES = os.path.exists(STORAGED) and os.path.exists(TRACKERD)
+needs_native = pytest.mark.skipif(
+    not (_HAVE_TOOLCHAIN or _HAVE_BINARIES),
+    reason="no native toolchain and no prebuilt daemons")
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+
+
+def _wait(cond, timeout=60, interval=0.25):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(interval)
+    return cond()
+
+
+def _payload(i: int) -> bytes:
+    # Deterministic mixed sizes: mostly small, every 5th ~48 KB so the
+    # migrator moves both tiny and chunk-sized content.
+    seed = (i * 2654435761) & 0xFFFFFFFF
+    size = 48 * 1024 if i % 5 == 0 else 120 + (i % 64)
+    return seed.to_bytes(4, "big") * ((size + 3) // 4)
+
+
+def _active_order(cli: FdfsClient) -> list[str]:
+    """ACTIVE group names in epoch order — the jump-hash domain."""
+    table = cli.query_placement()
+    return [g["group"] for g in table["groups"] if g["state"] == 0]
+
+
+def _expected_group(cli_actives: list[str], key: str) -> str:
+    return cli_actives[jump_hash(placement_key(key), len(cli_actives))]
+
+
+def _beat_row(cli: FdfsClient, group: str) -> dict:
+    cs = cli.cluster_stat(group)
+    for g in cs.get("groups", []):
+        for s in g.get("storages", []):
+            return s
+    return {}
+
+
+def _beat_stats(cli: FdfsClient, group: str) -> dict:
+    return _beat_row(cli, group).get("stats", {})
+
+
+def _start_cluster(tmp, groups):
+    tr = start_tracker(tmp / "tracker", store_lookup=3)
+    taddr = f"127.0.0.1:{tr.port}"
+    daemons = {"tracker": tr}
+    dirs = {}
+    for g in groups:
+        dirs[g] = tmp / g
+        daemons[g] = start_storage(dirs[g], group=g, trackers=[taddr],
+                                   extra=HB)
+    return daemons, dirs, taddr
+
+
+def _stop_all(daemons):
+    for d in daemons.values():
+        d.stop()
+
+
+@needs_native
+def test_jump_placement_and_elastic_add(tmp_path):
+    daemons, _, taddr = _start_cluster(tmp_path, ["group1", "group2",
+                                                  "group3"])
+    try:
+        cli = FdfsClient([taddr])
+        # Wait for all three groups to enter the placement epoch BEFORE
+        # the first keyed upload — the jump-hash domain grows as groups
+        # join, and we assert against the final 3-group domain.
+        actives = _wait(lambda: (lambda a: a if len(a) == 3 else None)(
+            _active_order(cli)))
+        assert actives and len(actives) == 3
+        first = upload_retry(cli, _payload(0), key="key-0")
+        assert first.split("/")[0] == _expected_group(actives, "key-0")
+
+        # Uniform keys: every upload lands exactly where the Python jump
+        # hash says, and the per-group share sits within 10 points of
+        # 1/3 (deterministic for this key set — sha1 keys, no RNG).
+        n = 150
+        fids: dict[str, tuple[str, bytes]] = {"key-0": (first, _payload(0))}
+        for i in range(1, n):
+            key = f"key-{i}"
+            data = _payload(i)
+            fids[key] = (cli.upload_buffer(data, key=key), data)
+        counts: dict[str, int] = {}
+        for key, (fid, _) in fids.items():
+            group = fid.split("/")[0]
+            assert group == _expected_group(actives, key), key
+            counts[group] = counts.get(group, 0) + 1
+        for g in actives:
+            share = counts.get(g, 0) / n
+            assert abs(share - 1 / 3) <= 0.10, (g, counts)
+
+        # Elastic add: a 4th group widens the domain for NEW keys only.
+        daemons["group4"] = start_storage(tmp_path / "group4",
+                                          group="group4", trackers=[taddr],
+                                          extra=HB)
+        actives4 = _wait(lambda: (lambda a: a if len(a) == 4 else None)(
+            _active_order(cli)))
+        assert actives4 == actives + ["group4"]  # epoch order: append-only
+        got4 = False
+        for i in range(40):
+            key = f"new-{i}"
+            fid = upload_retry(cli, _payload(i), key=key)
+            assert fid.split("/")[0] == _expected_group(actives4, key)
+            got4 = got4 or fid.startswith("group4/")
+        assert got4  # the new group takes its keys...
+        # ...but NO existing file moved: every old id still serves its
+        # exact bytes and no member ran any rebalance.
+        for key, (fid, data) in fids.items():
+            assert cli.download_to_buffer(fid) == data, key
+        for g in actives4:
+            assert _beat_stats(cli, g).get("rebalance_files_moved", 0) == 0, g
+
+        # Drain + immediate reactivate: the cancel lands before anything
+        # moves; the group returns to the hash domain and takes writes.
+        v1 = cli.group_drain("group4")
+        v2 = cli.group_reactivate("group4")
+        assert v2 > v1
+        assert _wait(lambda: "group4" in _active_order(cli))
+        time.sleep(3)  # a beat + a migrator poll: prove nothing moved
+        assert _beat_stats(cli, "group4").get("rebalance_files_moved",
+                                              0) == 0
+        for key, (fid, data) in fids.items():
+            assert cli.download_to_buffer(fid) == data, key
+    finally:
+        _stop_all(daemons)
+
+
+@needs_native
+def test_drain_rebalance_retire(tmp_path):
+    daemons, dirs, taddr = _start_cluster(tmp_path, ["group1", "group2",
+                                                     "group3"])
+    try:
+        cli = FdfsClient([taddr])
+        upload_retry(cli, b"warmup", key="warmup")
+        actives = _wait(lambda: (lambda a: a if len(a) == 3 else None)(
+            _active_order(cli)))
+        assert actives and len(actives) == 3
+
+        fids: dict[str, tuple[str, bytes]] = {}
+        for i in range(45):
+            key = f"dkey-{i}"
+            data = _payload(i)
+            fids[key] = (cli.upload_buffer(data, key=key), data)
+        by_group: dict[str, list[str]] = {}
+        for key, (fid, _) in fids.items():
+            by_group.setdefault(fid.split("/")[0], []).append(key)
+        drained = max(by_group, key=lambda g: len(by_group[g]))
+        victims = by_group[drained]
+        assert len(victims) >= 5
+
+        # A placement-routing client primes its epoch cache BEFORE the
+        # drain — it must survive the drift transparently below.
+        stale = FdfsClient([taddr], use_placement=True)
+        pre = stale.upload_buffer(b"prime", key="prime-key")
+        assert pre.split("/")[0] == _expected_group(actives, "prime-key")
+
+        v0 = cli.query_placement()["version"]
+        v1 = cli.group_drain(drained)
+        assert v1 > v0
+        assert cli.group_drain(drained) == v1  # idempotent
+        table = cli.query_placement()
+        assert any(g["group"] == drained and g["state"] == 1
+                   for g in table["groups"])
+
+        # Wait for the member to LEARN its state (next beat): it starts
+        # refusing new writes with EBUSY.
+        member = _beat_row(cli, drained)
+        tgt_ip, tgt_port = member["ip"], member["port"]
+
+        def _refused():
+            try:
+                with StorageClient(tgt_ip, tgt_port, 10.0) as s:
+                    junk = s.upload_buffer(b"should-bounce", ext="bin")
+                cli.delete_file(junk)  # deletes stay allowed while draining
+                return False
+            except StatusError as e:
+                return e.status == 16
+        assert _wait(_refused, timeout=15)
+
+        # Mid-drain keyed uploads: zero client-visible errors, and none
+        # lands in the draining group (the tracker re-hashed the domain).
+        remaining = [g for g in actives if g != drained]
+        for i in range(10):
+            key = f"mid-{i}"
+            fid = cli.upload_buffer(_payload(i), key=key)
+            assert fid.split("/")[0] == _expected_group(remaining, key)
+        # The stale placement-routing client too: its cached epoch may
+        # point at the draining group; EBUSY bounces it to the tracker.
+        for i in range(8):
+            fid = stale.upload_buffer(_payload(i), key=f"stale-{i}")
+            assert fid.split("/")[0] != drained
+        # Reads from the healthy groups keep working all along.
+        for g in remaining:
+            key = by_group.get(g, [None])[0]
+            if key is not None:
+                assert cli.download_to_buffer(fids[key][0]) == fids[key][1]
+
+        # Rebalance runs to completion and the leader auto-retires.
+        assert _wait(lambda: any(
+            g["group"] == drained and g["state"] == 2
+            for g in cli.query_placement()["groups"]), timeout=120)
+        st = _beat_stats(cli, drained)
+        assert st.get("rebalance_done", 0) == 1
+        assert st.get("rebalance_files_pending", 0) == 0
+        assert st.get("rebalance_errors", 0) == 0
+        assert st.get("rebalance_files_moved", 0) >= len(victims)
+
+        # The map sidecar hands over every victim: old id -> new id in a
+        # NON-drained group, byte-identical content, source reclaimed.
+        map_path = os.path.join(str(dirs[drained]), "data", "rebalance.map")
+        moved: dict[str, str] = {}
+        with open(map_path) as fh:
+            for line in fh:
+                old_id, _, new_id = line.strip().partition(" ")
+                if old_id and new_id:
+                    moved[old_id] = new_id
+        for key in victims:
+            old_id, data = fids[key]
+            assert old_id in moved, key
+            new_id = moved[old_id]
+            assert new_id.split("/")[0] in remaining
+            assert cli.download_to_buffer(new_id) == data, key
+            with pytest.raises(StatusError) as e:
+                cli.download_to_buffer(old_id)
+            assert e.value.status == 2  # source copy reclaimed
+        # Files of the other groups never moved.
+        for g in remaining:
+            for key in by_group.get(g, []):
+                assert cli.download_to_buffer(fids[key][0]) == fids[key][1]
+
+        # Retired is terminal: reactivation is refused (EINVAL).
+        with pytest.raises(StatusError) as e:
+            cli.group_reactivate(drained)
+        assert e.value.status == 22
+    finally:
+        _stop_all(daemons)

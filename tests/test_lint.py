@@ -389,7 +389,8 @@ def test_manifest_golden_names_resolve():
                for e in mani["enums"][enum] if e.get("golden")}
     assert goldens == {"stats-json", "trace-json", "trace-ctx",
                        "event-json", "scrub-status", "ingest-wire",
-                       "metrics-history", "heat-top"}
+                       "metrics-history", "heat-top", "placement-wire",
+                       "group-admin"}
 
 
 if __name__ == "__main__":

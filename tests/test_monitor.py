@@ -41,11 +41,12 @@ HB = "heart_beat_interval = 1\nstat_report_interval = 1"
 # ---------------------------------------------------------------------------
 
 def test_beat_stat_fields_shape():
-    assert BEAT_STAT_COUNT == len(BEAT_STAT_FIELDS) == 28
+    assert BEAT_STAT_COUNT == len(BEAT_STAT_FIELDS) == 33
     assert len(set(BEAT_STAT_FIELDS)) == BEAT_STAT_COUNT  # no dup names
     # The issue's headline stats are first-class named fields, not logs.
     for required in ("dedup_bytes_saved", "sync_lag_s",
-                     "recovery_chunks_fetched", "sync_bytes_saved_wire"):
+                     "recovery_chunks_fetched", "sync_bytes_saved_wire",
+                     "rebalance_files_moved", "rebalance_done"):
         assert required in BEAT_STAT_FIELDS
 
 
@@ -53,9 +54,9 @@ def test_beat_stats_tolerates_short_and_long_vectors():
     named = M.beat_stats([1, 2, 3])
     assert named["total_upload"] == 1
     assert named["success_upload"] == 2
-    assert named["dedup_chunk_misses"] == 0  # missing tail reads 0
+    assert named["rebalance_done"] == 0  # missing tail reads 0
     named = M.beat_stats(list(range(BEAT_STAT_COUNT + 5)))  # future fields
-    assert named["dedup_chunk_misses"] == BEAT_STAT_COUNT - 1
+    assert named["rebalance_done"] == BEAT_STAT_COUNT - 1
 
 
 # ---------------------------------------------------------------------------

@@ -122,3 +122,80 @@ def test_base64_matches():
     got = _run("b64e", raw.hex())
     want = base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
     assert got == want
+
+
+def _parse_kv_lines(out: str) -> dict:
+    """Parse `key=value` codec output; repeated keys collect into lists."""
+    kv: dict = {}
+    for line in out.splitlines():
+        line = line.strip()
+        if not line or "=" not in line:
+            continue
+        k, _, v = line.partition("=")
+        if k in kv:
+            if not isinstance(kv[k], list):
+                kv[k] = [kv[k]]
+            kv[k].append(v)
+        else:
+            kv[k] = v
+    return kv
+
+
+def test_placement_wire_golden():
+    # `fdfs_codec placement-wire` drives the REAL C++ epoch packer
+    # (tracker/placement.cc PackWire) over a 3-group fixture with group2
+    # draining; the hex must decode under the Python QUERY_PLACEMENT
+    # parser and the per-key jump picks must match the Python jump hash.
+    from fastdfs_tpu.common.jumphash import jump_hash, placement_key
+    from fastdfs_tpu.common.protocol import buff2long, unpack_group_name
+    out = _run("placement-wire")
+    lines = out.splitlines()
+    kv = _parse_kv_lines(out)
+    assert kv["version"] == "4"
+    body = bytes.fromhex(kv["response"])
+    # Wire: 8B version + 8B count + per entry (16B group + 1B state +
+    # 8B member count + per member (16B ip + 8B port)).
+    assert buff2long(body, 0) == 4
+    assert buff2long(body, 8) == 3
+    off = 16
+    entries = []
+    for _ in range(3):
+        group = unpack_group_name(body[off:off + 16])
+        state = body[off + 16]
+        members_n = buff2long(body, off + 17)
+        off += 25
+        members = []
+        for _ in range(members_n):
+            members.append((body[off:off + 16].rstrip(b"\x00").decode(),
+                            buff2long(body, off + 16)))
+            off += 24
+        entries.append((group, state, members))
+    assert off == len(body)
+    assert entries == [
+        ("group1", 0, [("10.0.0.1", 23000)]),
+        ("group2", 1, [("10.0.0.2", 23001)]),
+        ("group3", 0, [("10.0.0.3", 23002), ("10.0.0.4", 23003)]),
+    ]
+    # jump lines: C++ PlacementKey/JumpHash vs the Python twins, over
+    # the 2 ACTIVE groups (group2 is draining).
+    checked = 0
+    for line in lines:
+        if not line.startswith("key="):
+            continue
+        parts = dict(p.split("=", 1) for p in line.split())
+        assert int(parts["placement_key"]) == placement_key(parts["key"])
+        assert int(parts["jump"]) == jump_hash(placement_key(parts["key"]), 2)
+        checked += 1
+    assert checked == 4
+
+
+def test_group_admin_golden():
+    # `fdfs_codec group-admin` pins the GROUP_DRAIN / GROUP_REACTIVATE
+    # request body (16B group) and the OK response (8B BE new version)
+    # against the Python packers.
+    from fastdfs_tpu.common.protocol import long2buff, pack_group_name
+    kv = _parse_kv_lines(_run("group-admin"))
+    want_req = pack_group_name("group2").hex()
+    assert kv["drain_request"] == want_req
+    assert kv["reactivate_request"] == want_req
+    assert kv["ok_response"] == long2buff(4).hex()
