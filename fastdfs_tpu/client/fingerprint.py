@@ -86,19 +86,27 @@ def fingerprint_buffer(
     min_size: int = gear_cdc.DEFAULT_MIN_SIZE,
     avg_bits: int = gear_cdc.DEFAULT_AVG_BITS,
     max_size: int = gear_cdc.DEFAULT_MAX_SIZE,
+    cdc_policy: int = gear_cdc.CDC_POLICY_DEFAULT,
 ) -> list[ChunkFingerprint]:
     """CDC-chunk ``data`` and SHA1 each chunk, exactly as the daemons do.
 
     Returns one :class:`ChunkFingerprint` per chunk, in stream order
     (lengths sum to ``len(data)``).  Empty input -> empty list.
+
+    ``cdc_policy`` must match the target group's policy (the default is
+    the frozen ref-identical rule); a client chunking under a different
+    policy than the daemon simply gets zero dedup hits — never
+    corruption, since the daemon re-verifies every digest.
     """
     if not data:
         return []
     use_tpu = _tpu_up()
     if use_tpu:
-        cuts = gear_cdc.chunk_stream(data, min_size, avg_bits, max_size)
+        cuts = gear_cdc.chunk_stream(data, min_size, avg_bits, max_size,
+                                     cdc_policy=cdc_policy)
     else:
-        cuts = gear_cdc.chunk_stream_np(data, min_size, avg_bits, max_size)
+        cuts = gear_cdc.chunk_stream_np(data, min_size, avg_bits, max_size,
+                                        cdc_policy=cdc_policy)
     digests = _digests_tpu(data, cuts) if use_tpu else None
     if digests is None:
         digests = []

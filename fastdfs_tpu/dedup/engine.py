@@ -57,6 +57,16 @@ class DedupConfig:
     # None = auto: Pallas kernels on TPU, XLA reference elsewhere.  The
     # two paths are bit-identical (tests/test_pallas_kernels.py).
     use_pallas: bool | None = None
+    # Cut-selection policy: CDC_POLICY_DEFAULT (frozen, ref-identical) or
+    # the opt-in CDC_POLICY_SKIPMIN.  NEVER change on a live index — the
+    # policies are distinct content-address namespaces (the sidecar
+    # discards snapshots on mismatch, same as a spec bump).
+    cdc_policy: int = gear_cdc.CDC_POLICY_DEFAULT
+    # Fingerprint fan-out: shard each (row_tile, blen) batch's rows over
+    # this many local devices via parallel.make_fingerprint_step.
+    # None = auto (all local devices when >1 and a TPU backend is up;
+    # otherwise 1); 1 = single-device paths.  row_tile must divide by it.
+    fan_out: int | None = None
 
 
 @dataclass
@@ -121,6 +131,9 @@ class DedupEngine:
 
     def __init__(self, config: DedupConfig | None = None) -> None:
         self.config = config or DedupConfig()
+        if self.config.cdc_policy not in (gear_cdc.CDC_POLICY_DEFAULT,
+                                          gear_cdc.CDC_POLICY_SKIPMIN):
+            raise ValueError(f"unknown cdc_policy {self.config.cdc_policy}")
         self.exact = ExactDigestIndex()
         self.near = MinHashLSHIndex(self.config.num_perms, self.config.lsh_bands)
         use_pallas = self.config.use_pallas
@@ -129,11 +142,42 @@ class DedupEngine:
             # width; other widths take the (bit-identical) XLA reference.
             use_pallas = _tpu_available() and self.config.shingle == 5
         self._use_pallas = use_pallas
+        fan = self.config.fan_out
+        if fan is None:
+            # Auto fan-out only where it pays: a multi-chip TPU host.  On
+            # CPU hosts the XLA sha1 compile cost per bucket shape (~2 min
+            # each) dwarfs any parallel win, so auto stays single-path —
+            # tests opt in explicitly with tiny geometries.
+            if self._use_pallas:
+                import jax
+                fan = len(jax.local_devices())
+            else:
+                fan = 1
+        if fan > 1 and self.config.row_tile % fan:
+            raise ValueError(f"row_tile {self.config.row_tile} must divide "
+                             f"by fan_out {fan}")
+        self._fan_out = fan
+        self._fp_step = None  # built lazily: jitted multi-device step
 
     def _fingerprint_batch(self, batch: np.ndarray, lens: np.ndarray):
         """Dispatch one (row_tile, blen) batch; returns device arrays
         (futures) so callers can overlap multiple buckets in flight."""
         cfg = self.config
+        if self._fan_out > 1:
+            # Multi-chip fan-out: rows shard over every local device via
+            # ONE jitted shard_map (parallel.make_fingerprint_step) —
+            # bit-identical digests/signatures to the single-device
+            # paths (tests/test_cdc_kernels.py pins this).
+            if self._fp_step is None:
+                from fastdfs_tpu.parallel.ingest_step import (
+                    fingerprint_mesh, make_fingerprint_step)
+                self._fp_step = make_fingerprint_step(
+                    fingerprint_mesh(self._fan_out),
+                    cfg.num_perms, cfg.shingle)
+            # jit owns the transfer here: it splits the rows across the
+            # mesh per in_specs, so a manual single-device device_put
+            # would only add a copy.
+            return self._fp_step(batch, lens.astype(np.int32))
         if self._use_pallas:
             import jax
 
@@ -177,7 +221,8 @@ class DedupEngine:
         cfg = self.config
         if cuts is None:
             cuts = gear_cdc.chunk_stream(data, cfg.min_size, cfg.avg_bits,
-                                         cfg.max_size)
+                                         cfg.max_size,
+                                         cdc_policy=cfg.cdc_policy)
         spans: list[tuple[int, int]] = []
         last = 0
         for c in cuts:

@@ -22,7 +22,22 @@ hash at each chunk start) holds whenever ``min_size >= 32``: every position
 eligible for a cut is at least ``min_size`` bytes past the previous cut, so
 the 32-byte window never straddles a chunk boundary.  This is the
 "blockwise CDC with seam fixup" design from SURVEY.md §5, validated
-property-based in ``tests/test_gear_cdc.py``.
+property-based in ``tests/test_gear_cdc.py`` / ``tests/test_cdc_kernels.py``.
+
+Two throughput refinements from the vector-chunking literature (round 13):
+
+- **Lane-parallel hashing** (arXiv:2505.21194): the jax path folds the
+  byte stream into a ``(LANES, cols)`` grid with a 31-byte halo carried
+  from the previous row, so the windowed sum vectorizes across both the
+  TPU sublane and lane axes instead of one long roll chain.  Bit-identical
+  to the 1-D formulation (the halo makes every kept window complete).
+- **Skip-min evaluation** (arXiv:2508.05797): hash evaluation *skips* the
+  ``min_size`` bytes after every accepted cut instead of rolling through
+  them, restarting the hash at the first eligible position.  This moves
+  boundaries relative to the default policy — cuts are content addresses —
+  so it ships strictly as opt-in ``cdc_policy=CDC_POLICY_SKIPMIN`` with
+  its own serial referee (``chunk_stream_skipmin_ref``), never as a
+  default.  See OPERATIONS.md "Ingest kernels & chunking policies".
 """
 
 from __future__ import annotations
@@ -54,6 +69,14 @@ def _fmix32(x: np.ndarray) -> np.ndarray:
 # unaffected — chunk stores are content-addressed).
 CDC_SPEC_VERSION = 2
 
+# Cut-selection policies.  Policy is orthogonal to the spec version: the
+# DEFAULT policy under spec v2 is frozen (golden-pinned), and SKIPMIN is
+# a distinct, explicitly-chosen policy with different boundaries — state
+# built under one policy must never be queried under the other (the
+# sidecar discards snapshots on policy mismatch, like spec mismatch).
+CDC_POLICY_DEFAULT = 1   # serial-equivalent rolling evaluation (frozen)
+CDC_POLICY_SKIPMIN = 2   # skip min_size bytes after each cut (arXiv:2508.05797)
+
 # Deterministic 256-entry gear table, defined as fmix32(byte+1) so it is
 # COMPUTABLE, not just storable: a 256-entry gather lowers to a slow
 # scalar loop on TPU (~45 MB/s measured on this chip), while the same
@@ -64,6 +87,13 @@ CDC_SPEC_VERSION = 2
 GEAR_TABLE = _fmix32(np.arange(1, 257, dtype=np.uint32))
 
 WINDOW = 32
+_HALO = WINDOW - 1
+
+# Lane-parallel fold geometry: 256 rows keeps the row length >= the halo
+# for every pow2 buffer >= 8 KiB while giving XLA a (256, cols) grid that
+# tiles the 8x128 VPU cleanly (sublane axis full, lane axis contiguous).
+_LANES = 256
+_LANE_MIN_BYTES = _LANES * WINDOW  # smallest fold where cols >= WINDOW > halo
 
 # Reusable host staging buffers for device_put: on a remote-accelerator
 # link, transferring a FRESH host allocation pays per-buffer setup
@@ -93,6 +123,17 @@ def staging_buffer(size: int, slot: int = 0) -> np.ndarray:
         buf = bufs[key] = np.zeros(size, dtype=np.uint8)
     return buf
 
+
+def staging_buffer_stats() -> dict:
+    """Introspection for the growth audit: count + total bytes of live
+    staging buffers on THIS thread (tests assert reuse, not realloc)."""
+    bufs = getattr(_staging, "bufs", None) or {}
+    return {
+        "buffers": len(bufs),
+        "bytes": int(sum(b.nbytes for b in bufs.values())),
+        "keys": sorted(bufs.keys()),
+    }
+
 # Default chunking geometry (bytes).  avg 8 KiB => 13 mask bits.
 DEFAULT_MIN_SIZE = 2048
 DEFAULT_AVG_BITS = 13
@@ -111,22 +152,17 @@ def gear_hashes_ref(data: bytes | np.ndarray) -> np.ndarray:
     return out
 
 
-@functools.partial(jax.jit, static_argnames=())
-def gear_hashes(data: jax.Array) -> jax.Array:
-    """Position-parallel gear hashes: ``h[i]`` for every byte position.
-
-    ``data`` is uint8 of shape ``(n,)``; returns uint32 ``(n,)`` equal to the
-    serial rolling value at each position (exactly, for all positions).
-
-    The table lookup is computed as inline fmix32 arithmetic (see
-    ``GEAR_TABLE``) — pure vector ops, no gather.
-    """
+def _inline_gear(data: jax.Array) -> jax.Array:
+    """Gear table values as inline fmix32 arithmetic (no gather)."""
     x = data.astype(jnp.uint32) + jnp.uint32(1)
     x = x ^ (x >> jnp.uint32(16))
     x = x * jnp.uint32(0x85EBCA6B)
     x = x ^ (x >> jnp.uint32(13))
     x = x * jnp.uint32(0xC2B2AE35)
-    g = x ^ (x >> jnp.uint32(16))
+    return x ^ (x >> jnp.uint32(16))
+
+
+def _windowed_sum_1d(g: jax.Array) -> jax.Array:
     # Prefix doubling: S_w[i] = sum_{k<w} g[i-k] << k satisfies
     # S_2w[i] = S_w[i] + (S_w[i-w] << w), so the 32-term window needs
     # log2(32) = 5 shifted adds, not 31.
@@ -137,6 +173,46 @@ def gear_hashes(data: jax.Array) -> jax.Array:
         h = h + (shifted << np.uint32(w))
         w <<= 1
     return h
+
+
+def _windowed_sum_rows(g_ext: jax.Array) -> jax.Array:
+    """Row-wise prefix-doubling windowed sum over ``(rows, cols)``."""
+    h = g_ext
+    w = 1
+    while w < WINDOW:
+        shifted = jnp.pad(h, ((0, 0), (w, 0)))[:, :-w]
+        h = h + (shifted << np.uint32(w))
+        w <<= 1
+    return h
+
+
+@functools.partial(jax.jit, static_argnames=())
+def gear_hashes(data: jax.Array) -> jax.Array:
+    """Position-parallel gear hashes: ``h[i]`` for every byte position.
+
+    ``data`` is uint8 of shape ``(n,)``; returns uint32 ``(n,)`` equal to the
+    serial rolling value at each position (exactly, for all positions).
+
+    The table lookup is computed as inline fmix32 arithmetic (see
+    ``GEAR_TABLE``) — pure vector ops, no gather.  Buffers large enough to
+    fold are hashed lane-parallel: the stream reshapes to ``(_LANES,
+    cols)`` and each row carries a 31-value halo from its predecessor, so
+    every kept window is complete and the result is bit-identical to the
+    1-D chain while the shifted adds vectorize across both grid axes
+    (arXiv:2505.21194's row-folded formulation).
+    """
+    g = _inline_gear(data)
+    n = data.shape[0]
+    if n >= _LANE_MIN_BYTES and n % _LANES == 0:
+        cols = n // _LANES
+        g2 = g.reshape(_LANES, cols)
+        # Row r's halo = the 31 trailing values of row r-1 (zeros for r=0):
+        # exactly the bytes a 32-wide window at the row head reaches back to.
+        halo = jnp.pad(g2[:-1, -_HALO:], ((1, 0), (0, 0)))
+        g_ext = jnp.concatenate([halo, g2], axis=1)
+        h = _windowed_sum_rows(g_ext)[:, _HALO:]
+        return h.reshape(n)
+    return _windowed_sum_1d(g)
 
 
 def candidate_mask(hashes: jax.Array, avg_bits: int = DEFAULT_AVG_BITS) -> jax.Array:
@@ -199,11 +275,78 @@ def select_cuts(
     return cuts
 
 
+def select_cuts_skipmin(
+    data: bytes | np.ndarray,
+    candidates: np.ndarray,
+    n: int,
+    min_size: int = DEFAULT_MIN_SIZE,
+    avg_bits: int = DEFAULT_AVG_BITS,
+    max_size: int = DEFAULT_MAX_SIZE,
+) -> list[int]:
+    """Skip-min cut selection from precomputed *windowed* candidates.
+
+    Skip-min restarts the hash at the first eligible position after each
+    cut (``last + min_size - 1``), so the hash at position ``p`` covers
+    ``[start, p]`` clamped to the 32-byte window.  For
+    ``p >= start + WINDOW - 1`` the window is full and the restart hash
+    EQUALS the continuous windowed hash — the global candidate list
+    applies verbatim.  Only the ``<= 31`` warm-up positions per chunk
+    (partial windows) need fresh hashing, done vectorized on the slice.
+
+    Needs the byte buffer (for warm-up hashing) in addition to the
+    candidate list.  ``candidates`` must cover ``[0, n)`` densely (every
+    windowed-hash candidate), as produced by ``gear_candidates`` /
+    ``gear_candidates_np``.
+    """
+    if min_size < 1:
+        raise ValueError("min_size must be >= 1")
+    if max_size < min_size:
+        raise ValueError("max_size must be >= min_size")
+    buf = (np.frombuffer(bytes(data), dtype=np.uint8)
+           if isinstance(data, (bytes, bytearray, memoryview))
+           else np.asarray(data, dtype=np.uint8))
+    mask = np.uint32((1 << avg_bits) - 1)
+    cand = np.asarray(candidates, dtype=np.int64)
+    cuts: list[int] = []
+    last = 0
+    while n - last > 0:
+        if n - last < min_size:
+            cuts.append(n)
+            break
+        start = last + min_size - 1       # first position a cut may land on
+        forced = last + max_size - 1      # reaching this position always cuts
+        cutpos = -1
+        # Warm-up region: partial-window restart hashes, <= 31 positions.
+        warm_end = min(start + WINDOW - 2, forced, n - 1)
+        if warm_end >= start:
+            wh = gear_hashes_np(buf[start:warm_end + 1])
+            hits = np.nonzero((wh & mask) == 0)[0]
+            if len(hits):
+                cutpos = start + int(hits[0])
+        if cutpos < 0:
+            # Full-window region: reuse the global windowed candidates.
+            lo = np.searchsorted(cand, start + WINDOW - 1, side="left")
+            hi = np.searchsorted(cand, min(forced, n - 1), side="right")
+            if lo < hi:
+                cutpos = int(cand[lo])
+        if cutpos >= 0:
+            cuts.append(cutpos + 1)
+            last = cutpos + 1
+        elif n - last >= max_size:
+            cuts.append(last + max_size)
+            last = last + max_size
+        else:
+            cuts.append(n)
+            break
+    return cuts
+
+
 def chunk_stream(
     data: bytes,
     min_size: int = DEFAULT_MIN_SIZE,
     avg_bits: int = DEFAULT_AVG_BITS,
     max_size: int = DEFAULT_MAX_SIZE,
+    cdc_policy: int = CDC_POLICY_DEFAULT,
     _k_override: int | None = None,
 ) -> list[int]:
     """TPU-parallel CDC: returns exclusive chunk end offsets for ``data``.
@@ -217,7 +360,14 @@ def chunk_stream(
     ``2**-avg_bits``, fetched with 4x headroom); if a pathological input
     exceeds the headroom, the dense mask path recovers exactly.
     ``_k_override`` exists so tests can force that fallback.
+
+    ``cdc_policy`` selects the boundary rule: ``CDC_POLICY_DEFAULT`` is
+    cut-identical to ``chunk_stream_ref`` (the frozen content-address
+    contract); ``CDC_POLICY_SKIPMIN`` is the opt-in skip-min rule checked
+    against ``chunk_stream_skipmin_ref``.  Both share one hash pass.
     """
+    if cdc_policy not in (CDC_POLICY_DEFAULT, CDC_POLICY_SKIPMIN):
+        raise ValueError(f"unknown cdc_policy {cdc_policy}")
     if not data:
         return []
     n = len(data)
@@ -240,6 +390,9 @@ def chunk_stream(
         # rather than risk missed cut points.
         hashes = np.asarray(gear_hashes(dev))[:n]
         cand = np.flatnonzero(np.asarray(candidate_mask(hashes, avg_bits)))
+    if cdc_policy == CDC_POLICY_SKIPMIN:
+        return select_cuts_skipmin(buf[:n], cand, n, min_size, avg_bits,
+                                   max_size)
     return select_cuts(cand, n, min_size, max_size)
 
 
@@ -267,20 +420,105 @@ def gear_hashes_np(data: bytes | np.ndarray) -> np.ndarray:
     return h
 
 
+# Host-path scan tile: large enough to amortize the 5 shifted-add passes,
+# small enough that the working set (2 uint32 work buffers per byte) stays
+# near L2 instead of streaming 4 B/byte of hashes through main memory.
+_NP_TILE = 1 << 20
+
+# Staging slots for the tiled host scan's two uint32 work buffers (hash
+# accumulator + shift temporary).  Slots 0/1 are the engine's
+# double-buffered device staging; keep these disjoint so a client that
+# chunks AND fingerprints on one thread never aliases them.
+_NP_WORK_SLOTS = (16, 17)
+
+
+def _gear_hashes_np_into(buf_slice: np.ndarray, work_h: np.ndarray,
+                         work_t: np.ndarray) -> np.ndarray:
+    """``gear_hashes_np`` computed in-place inside caller-owned uint32
+    work buffers (no per-call temporaries) — the tiled scan's inner loop.
+    Returns a view of ``work_h``."""
+    m = len(buf_slice)
+    h = work_h[:m]
+    tmp = work_t[:m]
+    with np.errstate(over="ignore"):
+        np.copyto(h, buf_slice)          # uint8 widens into the uint32 buffer
+        h += np.uint32(1)
+        h ^= h >> np.uint32(16)
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> np.uint32(13)
+        h *= np.uint32(0xC2B2AE35)
+        h ^= h >> np.uint32(16)
+        w = 1
+        while w < WINDOW:
+            tmp[w:] = h[:-w]
+            tmp[:w] = 0
+            tmp <<= np.uint32(w)
+            h += tmp
+            w <<= 1
+    return h
+
+
+def gear_candidates_np(data: bytes | np.ndarray,
+                       avg_bits: int = DEFAULT_AVG_BITS) -> np.ndarray:
+    """Windowed-hash candidate positions, scanned in cache-sized tiles.
+
+    Equal to ``np.nonzero(candidate_mask(gear_hashes_np(data)))[0]`` but
+    never materializes the full 4-bytes-per-input-byte hash array: each
+    1 MiB tile is hashed with a 31-byte halo from its predecessor (so
+    every emitted position sees a full window) and only the sparse
+    candidate indices survive.  This is the host-path analogue of the
+    lane fold — same math, tiled for cache instead of lanes.  The two
+    uint32 work buffers come from the thread-local staging pool, so
+    repeated calls at any input size reuse ONE fixed allocation
+    (asserted by tests/test_cdc_kernels.py's growth audit).
+    """
+    buf = (np.frombuffer(bytes(data), dtype=np.uint8)
+           if isinstance(data, (bytes, bytearray, memoryview))
+           else np.asarray(data, dtype=np.uint8))
+    n = len(buf)
+    mask = np.uint32((1 << avg_bits) - 1)
+    if n <= 4096:
+        # Tiny inputs: a per-call temporary beats pinning the ~8 MB
+        # work pair for a client that only ever chunks small buffers.
+        h = gear_hashes_np(buf)
+        return np.nonzero((h & mask) == 0)[0]
+    span = min(n, _NP_TILE + _HALO)
+    work_h = staging_buffer(4 * (_NP_TILE + _HALO),
+                            slot=_NP_WORK_SLOTS[0]).view(np.uint32)[:span]
+    work_t = staging_buffer(4 * (_NP_TILE + _HALO),
+                            slot=_NP_WORK_SLOTS[1]).view(np.uint32)[:span]
+    out: list[np.ndarray] = []
+    for t in range(0, n, _NP_TILE):
+        lo = max(0, t - _HALO)
+        h = _gear_hashes_np_into(buf[lo:t + _NP_TILE], work_h, work_t)
+        seg = h[t - lo:]
+        idx = np.nonzero((seg & mask) == 0)[0]
+        if len(idx):
+            out.append(idx.astype(np.int64) + t)
+    if not out:
+        return np.zeros(0, dtype=np.int64)
+    return np.concatenate(out)
+
+
 def chunk_stream_np(
     data: bytes,
     min_size: int = DEFAULT_MIN_SIZE,
     avg_bits: int = DEFAULT_AVG_BITS,
     max_size: int = DEFAULT_MAX_SIZE,
+    cdc_policy: int = CDC_POLICY_DEFAULT,
 ) -> list[int]:
     """CPU-vectorized CDC with the exact cut points of ``chunk_stream`` /
-    ``chunk_stream_ref`` (same table, window, and selection rule)."""
+    ``chunk_stream_ref`` (same table, window, and selection rule), or of
+    ``chunk_stream_skipmin_ref`` under ``cdc_policy=CDC_POLICY_SKIPMIN``."""
+    if cdc_policy not in (CDC_POLICY_DEFAULT, CDC_POLICY_SKIPMIN):
+        raise ValueError(f"unknown cdc_policy {cdc_policy}")
     n = len(data)
     if n == 0:
         return []
-    h = gear_hashes_np(data)
-    mask = np.uint32((1 << avg_bits) - 1)
-    candidates = np.nonzero((h & mask) == 0)[0]
+    candidates = gear_candidates_np(data, avg_bits)
+    if cdc_policy == CDC_POLICY_SKIPMIN:
+        return select_cuts_skipmin(data, candidates, n, min_size, avg_bits,
+                                   max_size)
     return select_cuts(candidates, n, min_size, max_size)
 
 
@@ -313,4 +551,51 @@ def chunk_stream_ref(
             pos += 1
     if last < n:
         cuts.append(n)
+    return cuts
+
+
+def chunk_stream_skipmin_ref(
+    data: bytes,
+    min_size: int = DEFAULT_MIN_SIZE,
+    avg_bits: int = DEFAULT_AVG_BITS,
+    max_size: int = DEFAULT_MAX_SIZE,
+) -> list[int]:
+    """Serial referee for the skip-min policy (``cdc_policy=2``).
+
+    After each accepted cut the scanner JUMPS ``min_size - 1`` bytes and
+    restarts the hash at the first eligible position — the skipped bytes
+    are never hashed (that is the throughput win: ~``min/avg`` of the
+    stream is skipped).  A cut lands at the first restart-hash candidate,
+    or is forced at ``max_size``.  Boundaries differ from the default
+    policy, so this is a distinct content-address namespace.
+    """
+    if min_size < 1:
+        raise ValueError("min_size must be >= 1")
+    if max_size < min_size:
+        raise ValueError("max_size must be >= min_size")
+    mask = np.uint32((1 << avg_bits) - 1)
+    table = GEAR_TABLE
+    buf = np.frombuffer(data, dtype=np.uint8)
+    n = len(buf)
+    cuts: list[int] = []
+    last = 0
+    with np.errstate(over="ignore"):
+        while n - last > 0:
+            if n - last < min_size:
+                cuts.append(n)
+                break
+            h = np.uint32(0)
+            cut = -1
+            end = min(last + max_size - 1, n - 1)
+            for pos in range(last + min_size - 1, end + 1):
+                h = np.uint32(h << np.uint32(1)) + table[buf[pos]]
+                if (h & mask) == 0:
+                    cut = pos + 1
+                    break
+            if cut < 0:
+                cut = last + max_size if n - last >= max_size else n
+            cuts.append(cut)
+            if cut >= n:
+                break
+            last = cut
     return cuts

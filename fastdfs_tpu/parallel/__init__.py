@@ -19,6 +19,9 @@ storage wire protocol, not a tensor exchange.
 
 from fastdfs_tpu.parallel.mesh import make_mesh, factorize_devices  # noqa: F401
 from fastdfs_tpu.parallel.ingest_step import (  # noqa: F401
+    distributed_fingerprint,
     distributed_ingest_step,
+    fingerprint_mesh,
+    make_fingerprint_step,
     make_ingest_step,
 )

@@ -38,10 +38,20 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fastdfs_tpu.ops.gear_cdc import GEAR_TABLE, WINDOW
-from fastdfs_tpu.ops.minhash import EMPTY, _perm_constants, survivor_segmin
+from fastdfs_tpu.ops.minhash import (EMPTY, _perm_constants, minhash_batch,
+                                     survivor_segmin)
 from fastdfs_tpu.ops.sha1 import _sha1_padded
 
 HALO = WINDOW - 1
+
+
+def _shard_mapped(fn, **specs):
+    """``shard_map`` across the jax API move (>=0.6 top-level / check_vma,
+    older experimental module / check_rep)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, **specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(fn, **specs, check_rep=False)
 
 
 def _gear_from_g(g: jax.Array) -> jax.Array:
@@ -123,17 +133,72 @@ def make_ingest_step(mesh: Mesh, num_perms: int = 64, avg_bits: int = 13,
         best = jax.lax.pmax(local_best, "dp")                    # (N,)
         return cand, digests, sigs, best
 
-    specs = dict(
+    sharded = _shard_mapped(
+        step_local,
         mesh=mesh,
         in_specs=(P("dp", "sp", None), P("dp", None), P("dp"), P("dp", None)),
         out_specs=(P("dp", "sp", None), P(), P(), P()),
     )
-    if hasattr(jax, "shard_map"):  # jax >= 0.6: top-level API, check_vma
-        sharded = jax.shard_map(step_local, **specs, check_vma=False)
-    else:  # older jax: experimental module, the flag is check_rep
-        from jax.experimental.shard_map import shard_map as _shard_map
-        sharded = _shard_map(step_local, **specs, check_rep=False)
     return jax.jit(sharded)
+
+
+def fingerprint_mesh(n_devices: int | None = None) -> Mesh:
+    """A 1-D ``dp`` mesh over the local devices, for the fingerprint
+    fan-out (chunk rows are the abundant parallelism; no collectives are
+    needed, so one axis is the whole story)."""
+    devs = jax.local_devices()
+    n = len(devs) if n_devices is None else n_devices
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), ("dp",))
+
+
+def make_fingerprint_step(mesh: Mesh, num_perms: int = 64, shingle: int = 5):
+    """Build the jitted multi-chip fingerprint step for a 1-D ``dp`` mesh.
+
+    Returns ``step(chunk_batch (N, L) uint8, chunk_lens (N,) int32) ->
+    (digests (N, 5) uint32, sigs (N, num_perms) uint32)``.  ``N`` must
+    divide by ``mesh.shape['dp']``.
+
+    This is the ingest hot loop's scale-out: rows shard across every
+    local device and each chip runs batched SHA1 (``_sha1_padded``) plus
+    the survivor-sketch MinHash (``minhash_batch``) on its slice — pure
+    map parallelism, zero collectives, so aggregate throughput is
+    ``n_devices x`` the per-chip rate minus transfer overlap.  Outputs
+    stay sharded (``P('dp', None)``); fetching reassembles them.  Both
+    kernels are the XLA references that the Pallas twins are pinned
+    bit-identical to (tests/test_pallas_kernels.py), so the fan-out path
+    produces byte-for-byte the digests/signatures of the single-chip
+    paths — verified across mesh sizes in tests/test_cdc_kernels.py.
+    """
+    def fp_local(chunk_batch, chunk_lens):
+        digests = _sha1_padded(chunk_batch, chunk_lens,
+                               int(chunk_batch.shape[1]))
+        sigs = minhash_batch(chunk_batch, chunk_lens, num_perms, shingle)
+        return digests, sigs
+
+    sharded = _shard_mapped(
+        fp_local,
+        mesh=mesh,
+        in_specs=(P("dp", None), P("dp")),
+        out_specs=(P("dp", None), P("dp", None)),
+    )
+    return jax.jit(sharded)
+
+
+@functools.cache
+def _cached_fingerprint_step(mesh_key, num_perms, shingle):
+    mesh, _ = mesh_key
+    return make_fingerprint_step(mesh, num_perms, shingle)
+
+
+def distributed_fingerprint(mesh: Mesh, chunk_batch, chunk_lens,
+                            num_perms: int = 64, shingle: int = 5):
+    """Convenience wrapper: build (cached) and run the fan-out step."""
+    step = _cached_fingerprint_step(
+        (mesh, str(mesh.devices.tolist())), num_perms, shingle)
+    return step(jnp.asarray(chunk_batch, dtype=jnp.uint8),
+                jnp.asarray(chunk_lens, dtype=jnp.int32))
 
 
 @functools.cache

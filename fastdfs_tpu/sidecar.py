@@ -147,13 +147,16 @@ class DedupSidecar:
         if os.path.exists(files_p):
             with open(files_p) as fh:
                 blob = json.load(fh)
-            # Current format: {"cdc_spec": N, "files": {...}}; round-4
-            # snapshots were the flat files dict (spec 1 implicitly).
+            # Current format: {"cdc_spec": N, "cdc_policy": P,
+            # "files": {...}}; round-4 snapshots were the flat files dict
+            # (spec 1 implicitly); pre-round-13 ones carry no policy
+            # field (policy 1 implicitly).
             if isinstance(blob, dict) and "files" in blob:
                 spec = int(blob.get("cdc_spec", 1))
+                policy = int(blob.get("cdc_policy", 1))
                 files = blob["files"]
             else:
-                spec, files = 1, blob
+                spec, policy, files = 1, 1, blob
             if spec != CDC_SPEC_VERSION:
                 # Stale chunker spec: the same bytes now chunk at
                 # different offsets, so every stored chunk digest would
@@ -163,6 +166,15 @@ class DedupSidecar:
                 print(f"dedup sidecar: discarding snapshot built with "
                       f"chunker spec v{spec} (current v{CDC_SPEC_VERSION})",
                       flush=True)
+                return
+            if policy != self.engine.config.cdc_policy:
+                # Same rule for the cut-selection policy: default and
+                # skip-min cuts are different content-address namespaces,
+                # so an index built under one is dead weight (and silent
+                # ~0% dedup) under the other.
+                print(f"dedup sidecar: discarding snapshot built with "
+                      f"cdc_policy {policy} (engine runs policy "
+                      f"{self.engine.config.cdc_policy})", flush=True)
                 return
             self.files = files
             self.by_file = {v: k for k, v in self.files.items()}
@@ -201,6 +213,7 @@ class DedupSidecar:
             tmp = files_p + ".tmp"
             with open(tmp, "w") as fh:
                 json.dump({"cdc_spec": CDC_SPEC_VERSION,
+                           "cdc_policy": self.engine.config.cdc_policy,
                            "files": self.files}, fh)
             os.replace(tmp, files_p)
             self.engine.save(exact_p, near_p)
@@ -575,13 +588,26 @@ def main(argv: list[str] | None = None) -> int:
                          "Guards against client-side transfer leaks on "
                          "experimental backends; the daemon fails open "
                          "during the restart window.")
+    ap.add_argument("--cdc-policy", type=int, default=1, choices=(1, 2),
+                    help="cut-selection policy: 1 = default (frozen, "
+                         "ref-identical cuts), 2 = skip-min "
+                         "(arXiv:2508.05797; different boundaries — new "
+                         "groups only, see OPERATIONS.md).  Snapshots "
+                         "built under another policy are discarded at "
+                         "load.")
+    ap.add_argument("--fan-out", type=int, default=None,
+                    help="shard each fingerprint batch's rows over this "
+                         "many local devices (default: auto — all local "
+                         "devices on a multi-chip TPU host, else 1)")
     args = ap.parse_args(argv)
 
     if args.platform:
         import jax
         jax.config.update("jax_platforms", args.platform)
 
-    sidecar = DedupSidecar(args.socket, state_dir=args.state_dir)
+    config = DedupConfig(cdc_policy=args.cdc_policy, fan_out=args.fan_out)
+    sidecar = DedupSidecar(args.socket, state_dir=args.state_dir,
+                           config=config)
     # Restart-loop guard: a limit below the process's natural baseline
     # (misconfiguration) would otherwise re-exec every tick forever,
     # each cycle costing a warmup of degraded-to-flat service.  After

@@ -283,6 +283,49 @@ def test_golden_coverage_catches_phantom_golden(tmp_path):
                and "subcommand" in f.message for f in findings), findings
 
 
+def test_golden_coverage_catches_missing_fixture_golden(tmp_path):
+    # A mini tree with a valid manifest but NO tests/goldens/cdc_cuts.json:
+    # every FIXTURE_GOLDENS entry must be reported missing.
+    _write(tmp_path, "native/protocol_manifest.json", MINI_MANIFEST)
+    _write(tmp_path, "native/tools/codec_cli.cc", "")
+    findings = _checks(tmp_path, "golden-coverage")
+    assert any(f.check == "golden-coverage"
+               and "cdc_cuts.json" in f.path
+               and "missing" in f.message for f in findings), findings
+
+
+def test_golden_coverage_catches_corrupt_fixture_golden(tmp_path):
+    _write(tmp_path, "native/protocol_manifest.json", MINI_MANIFEST)
+    _write(tmp_path, "native/tools/codec_cli.cc", "")
+    _write(tmp_path, "tests/goldens/cdc_cuts.json", "{not json")
+    findings = _checks(tmp_path, "golden-coverage")
+    assert any(f.check == "golden-coverage"
+               and "cdc_cuts.json" in f.path
+               and "not valid JSON" in f.message for f in findings), findings
+
+
+def test_golden_coverage_catches_fixture_without_contract_keys(tmp_path):
+    _write(tmp_path, "native/protocol_manifest.json", MINI_MANIFEST)
+    _write(tmp_path, "native/tools/codec_cli.cc", "")
+    _write(tmp_path, "tests/goldens/cdc_cuts.json", '{"cdc_spec": 2}')
+    findings = _checks(tmp_path, "golden-coverage")
+    assert any(f.check == "golden-coverage"
+               and "cases" in f.message
+               and "contract keys" in f.message for f in findings), findings
+
+
+def test_golden_coverage_catches_unexercised_fixture_golden(tmp_path):
+    _write(tmp_path, "native/protocol_manifest.json", MINI_MANIFEST)
+    _write(tmp_path, "native/tools/codec_cli.cc", "")
+    _write(tmp_path, "tests/goldens/cdc_cuts.json",
+           '{"cdc_spec": 2, "cases": []}')
+    _write(tmp_path, "tests/test_something.py", "def test_x():\n    pass\n")
+    findings = _checks(tmp_path, "golden-coverage")
+    assert any(f.check == "golden-coverage"
+               and "cdc_cuts.json" in f.message
+               and "no test" in f.message for f in findings), findings
+
+
 def test_lock_raw_mutex_catches_raw_declaration(tmp_path):
     _write(tmp_path, "native/storage/widget.h", '''
 class Widget {

@@ -139,6 +139,16 @@ EXTRA_GOLDENS = (
     "slab-layout",  # slab slot-header + index-record encoding (ISSUE 9)
 )
 
+# Checked-in fixture goldens: JSON files under tests/ pinning kernel
+# behavior byte-for-byte (vs the live-computed cross-language goldens
+# above).  Each must exist, parse, carry the listed top-level keys, and
+# be referenced by at least one test — silent drift in what they pin
+# (e.g. CDC cut offsets, which are content addresses) must fail CI
+# loudly (ISSUE 13).
+FIXTURE_GOLDENS = {
+    "tests/goldens/cdc_cuts.json": ("cdc_spec", "cases"),
+}
+
 GOLDEN_ALLOWLIST = {
     # tracker: cluster management
     "TrackerCmd.STORAGE_JOIN": _FIXED_FIELDS,
@@ -591,6 +601,33 @@ def check_golden_coverage(root: str) -> list[Finding]:
                 f"layout golden '{golden}' (EXTRA_GOLDENS) is referenced "
                 f"by no test under tests/ — an unexercised golden pins "
                 f"nothing"))
+    # Checked-in fixture goldens (FIXTURE_GOLDENS): must exist, parse,
+    # carry their contract keys, and be exercised by a test.
+    for rel, keys in FIXTURE_GOLDENS.items():
+        text = _read(root, rel)
+        if text is None:
+            out.append(Finding(
+                "golden-coverage", rel, 0,
+                f"fixture golden missing (FIXTURE_GOLDENS in "
+                f"tools/fdfs_lint.py expects it)"))
+            continue
+        try:
+            blob = json.loads(text)
+        except ValueError:
+            out.append(Finding("golden-coverage", rel, 0,
+                               "fixture golden is not valid JSON"))
+            continue
+        missing = [k for k in keys if k not in blob]
+        if missing:
+            out.append(Finding(
+                "golden-coverage", rel, 0,
+                f"fixture golden lacks contract keys {missing}"))
+        base = os.path.basename(rel)
+        if tests_text and base not in tests_text:
+            out.append(Finding(
+                "golden-coverage", "tests", 0,
+                f"fixture golden '{base}' is referenced by no test under "
+                f"tests/ — an unexercised golden pins nothing"))
     return out
 
 
