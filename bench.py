@@ -77,9 +77,13 @@ CONTENTION_SPREAD = 0.30  # (max-min)/median above this => contended
 
 def _provenance() -> dict:
     """Fields every artifact carries: the cut policy the repo defaults
-    to and the device count the number was measured on."""
+    to, the device count the number was measured on, and the host CPU
+    count — a "CPU baseline" from a 4-core runner and one from a
+    96-core host are different numbers, and without this field the
+    artifact can't say which it is."""
     from fastdfs_tpu.ops.gear_cdc import CDC_POLICY_DEFAULT
-    prov = {"cdc_policy": CDC_POLICY_DEFAULT, "smoke": _SMOKE}
+    prov = {"cdc_policy": CDC_POLICY_DEFAULT, "smoke": _SMOKE,
+            "host_cpus": os.cpu_count()}
     try:
         import jax
         prov["n_devices"] = len(jax.local_devices())
@@ -87,6 +91,41 @@ def _provenance() -> dict:
     except Exception:
         prov["n_devices"] = None
     return prov
+
+
+def _ru():
+    """getrusage snapshot for per-phase CPU accounting, or None where
+    the stdlib resource module is unavailable (non-POSIX)."""
+    try:
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF)
+    except Exception:
+        return None
+
+
+def _ru_delta(a, b) -> dict | None:
+    """Named user/system CPU seconds burned between two _ru() snaps.
+    Pairs with phase_wall_s: a phase whose wall time dwarfs its CPU
+    time was WAITING (device, disk, contention), not computing — the
+    distinction phase_wall_s alone cannot make."""
+    if a is None or b is None:
+        return None
+    return {"utime_s": round(b.ru_utime - a.ru_utime, 3),
+            "stime_s": round(b.ru_stime - a.ru_stime, 3)}
+
+
+def _phase_rusage(marks: dict) -> dict:
+    """{"phase_rusage": {...}, "maxrss_kb": N} from ordered phase-name
+    -> _ru() snapshot marks (first mark is the baseline)."""
+    names = list(marks)
+    out = {}
+    for prev, cur in zip(names, names[1:]):
+        d = _ru_delta(marks[prev], marks[cur])
+        if d is not None:
+            out[cur] = d
+    last = marks[names[-1]]
+    return {"phase_rusage": out,
+            "maxrss_kb": getattr(last, "ru_maxrss", None)}
 
 
 def _bench_tpu() -> dict:
@@ -101,6 +140,7 @@ def _bench_tpu() -> dict:
     lens = np.full(N_CHUNKS, L, dtype=np.int32)
 
     t_gen = time.perf_counter()
+    ru = {"start": _ru()}
     dev_chunks = jax.device_put(chunks)
     dev_lens = jax.device_put(lens)
     jax.block_until_ready((dev_chunks, dev_lens))
@@ -111,8 +151,10 @@ def _bench_tpu() -> dict:
 
     # warmup/compile (and force one full execution)
     t_warm = time.perf_counter()
+    ru["device_put"] = _ru()
     jax.device_get(step(dev_chunks, dev_lens))
     t_measure = time.perf_counter()
+    ru["warmup_compile"] = _ru()
 
     rates = []
     t_total = 0.0
@@ -159,6 +201,8 @@ def _bench_tpu() -> dict:
         "warmup": {"rounds": 1, "wall_s": round(t_measure - t_warm, 3),
                    "in_measure": False},
     }
+    ru["measure"] = _ru()
+    out.update(_phase_rusage(ru))
     if contended:
         # Steady-state estimate when the capture straddled a contention
         # episode: the slow rounds are tunnel stalls, not kernel time.
@@ -197,8 +241,10 @@ def _bench_cpu_fallback() -> dict:
     lens = np.full(n, L, dtype=np.int32)
     rows = [row.tobytes() for row in chunks]
     t_warm = time.perf_counter()
+    ru = {"start": _ru()}
     np.asarray(minhash_batch(chunks, lens))  # compile outside the clock
     t_measure = time.perf_counter()
+    ru["warmup_compile"] = _ru()
     rates = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -207,6 +253,7 @@ def _bench_cpu_fallback() -> dict:
         jax.block_until_ready(minhash_batch(chunks, lens))
         rates.append(n * L / (time.perf_counter() - t0) / 1e9)
     srt = sorted(rates)
+    ru["measure"] = _ru()
     return {
         "value": round(srt[len(srt) // 2], 4),
         "rounds": len(srt),
@@ -220,6 +267,7 @@ def _bench_cpu_fallback() -> dict:
         },
         "warmup": {"rounds": 1, "wall_s": round(t_measure - t_warm, 3),
                    "in_measure": False},
+        **_phase_rusage(ru),
     }
 
 
@@ -254,6 +302,7 @@ def _bench_multichip() -> dict:
 
     legs = {}
     t_warm_total = 0.0
+    ru = {"start": _ru()}
     for k in sorted({1, n_dev}):
         mesh = fingerprint_mesh(k)
         step = make_fingerprint_step(mesh, num_perms=64, shingle=5)
@@ -277,6 +326,7 @@ def _bench_multichip() -> dict:
             "rounds": len(srt),
             "dispersion": {"min": round(srt[0], 4), "max": round(srt[-1], 4)},
         }
+    ru["measure"] = _ru()
     agg_1 = legs[1]["aggregate_GBps"]
     agg_n = legs[n_dev]["aggregate_GBps"]
     out = {
@@ -288,6 +338,7 @@ def _bench_multichip() -> dict:
         "legs": {str(k): v for k, v in legs.items()},
         "rows": n_rows, "row_bytes": L,
         "warmup": {"wall_s": round(t_warm_total, 3), "in_measure": False},
+        **_phase_rusage(ru),
     }
     if n_dev == 1:
         out["note"] = ("single local device: scaling leg degenerate "
@@ -398,9 +449,13 @@ def main() -> None:
         }))
         return
     t_cpu = time.perf_counter()
+    ru_cpu0 = _ru()
     cpu_gbps = _bench_cpu()
     tpu["phase_wall_s"]["cpu_baseline"] = round(
         time.perf_counter() - t_cpu, 3)
+    d = _ru_delta(ru_cpu0, _ru())
+    if d is not None:
+        tpu.setdefault("phase_rusage", {})["cpu_baseline"] = d
     print(json.dumps({
         "metric": "dedup_ingest_GBps_per_chip",
         "unit": "GB/s",

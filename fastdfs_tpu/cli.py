@@ -156,6 +156,10 @@ def cmd_top(c: FdfsClient, args: list[str]) -> int:
            --events N     events-pane depth (default 10)
            --heat [N]     per-node hot-file pane (HEAT_TOP; top N rows,
                           default 5)
+           --threads [N]  per-node THREADS pane: the thread ledger from
+                          the thread.* gauges already in each STAT
+                          snapshot (top N by cpu%, default 8; no extra
+                          RPC)
            --json         one machine-readable JSON object per frame
                           instead of the table
            --no-clear     never emit the ANSI clear (append frames)
@@ -178,6 +182,8 @@ def cmd_top(c: FdfsClient, args: list[str]) -> int:
     max_events = int(flag("--events", "10"))
     with_heat = "--heat" in args
     heat_rows = int(flag("--heat", "5") or 5) if with_heat else 5
+    with_threads = "--threads" in args
+    thread_rows = int(flag("--threads", "8") or 8) if with_threads else 8
     as_json = "--json" in args
     clear = "--no-clear" not in args and not as_json and sys.stdout.isatty()
 
@@ -224,6 +230,11 @@ def cmd_top(c: FdfsClient, args: list[str]) -> int:
                             c.storage_heat_top(ip, int(port), heat_rows))
                     except Exception:  # noqa: BLE001 — heat off / old node
                         heat[node] = []
+            threads = None
+            if with_threads:
+                threads = {node: M.thread_ledger(ns.registry)
+                           for node, ns in cur.nodes.items()
+                           if ns.registry is not None}
             if as_json:
                 print(json.dumps({
                     "ts": cur.ts,
@@ -233,11 +244,15 @@ def cmd_top(c: FdfsClient, args: list[str]) -> int:
                     "heat": ({n: [vars(h) for h in hs]
                               for n, hs in heat.items()}
                              if heat is not None else None),
+                    "threads": ({n: rows[:thread_rows]
+                                 for n, rows in threads.items()}
+                                if threads is not None else None),
                 }, sort_keys=True), flush=True)
             else:
                 frame = M.render_top(cur, rates, recent, max_events,
                                      alerts=alerts, heat=heat,
-                                     heat_rows=heat_rows)
+                                     heat_rows=heat_rows, threads=threads,
+                                     thread_rows=thread_rows)
                 if clear:
                     print("\x1b[2J\x1b[H" + frame, flush=True)
                 else:
@@ -463,6 +478,83 @@ def cmd_trace(c: FdfsClient, args: list[str]) -> int:
     return 0 if matched else 1
 
 
+def cmd_profile(c: FdfsClient, args: list[str]) -> int:
+    """One-shot CPU profile of a daemon (fdfs_profile): arm the
+    in-daemon SIGPROF sampler, wait out the capture window, pull the
+    folded-stack dump, and print it — collapsed-stack text by default
+    (pipe straight into flamegraph.pl or load into speedscope), raw
+    dump JSON with --json.
+
+    Usage: profile <tracker> <ip:port> [--tracker] [flags]
+
+           <ip:port>      the daemon to profile (a storage node, or
+                          with --tracker a tracker)
+           --hz N         sample rate (default 97 — prime, so it can't
+                          alias against 10ms timer wheels; clamped to
+                          the daemon's profile_max_hz)
+           --seconds N    capture window (default 5; the daemon
+                          auto-disarms at the deadline either way)
+           --folded       collapsed-stack output (the default)
+           --json         raw PROFILE_DUMP JSON instead
+           --no-wait      arm and exit (dump later with --dump-only)
+           --dump-only    skip arming; dump whatever the last capture
+                          holds
+           --stop         disarm early and exit
+
+    ENOTSUP (status 95) means profiling is off at the daemon: set
+    profile_max_hz > 0 in its conf (see OPERATIONS.md "Profiling & the
+    thread ledger" — the feature costs nothing until armed).
+    """
+    import time as _time
+
+    from fastdfs_tpu import monitor as M
+    from fastdfs_tpu.client.tracker_client import TrackerClient
+
+    def flag(name, default=None):
+        return _flag(args, name, default)
+
+    node = next((a for a in args if not a.startswith("--")
+                 and ":" in a), None)
+    if node is None:
+        print("usage: profile <tracker> <ip:port> [--tracker] [--hz N] "
+              "[--seconds N] [--folded|--json] [--stop]", file=sys.stderr)
+        return 2
+    ip, _, port_s = node.rpartition(":")
+    port = int(port_s)
+    hz = int(flag("--hz", "97"))
+    seconds = int(flag("--seconds", "5"))
+    is_tracker = "--tracker" in args
+
+    def ctl(what, *a):
+        if is_tracker:
+            with TrackerClient(ip, port, c.timeout) as t:
+                return getattr(t, what)(*a)
+        return getattr(c, f"storage_{what}")(ip, port, *a)
+
+    if "--stop" in args:
+        print(json.dumps(ctl("profile_stop"), sort_keys=True))
+        return 0
+    if "--dump-only" not in args:
+        ack = ctl("profile_start", hz, seconds)
+        print(f"armed {node} at {ack.get('hz', hz)} Hz for {seconds}s",
+              file=sys.stderr)
+        if "--no-wait" in args:
+            return 0
+        # The daemon disarms itself at the deadline; the slack covers
+        # the last in-flight SIGPROF and tick jitter.
+        _time.sleep(seconds + 0.5)
+    raw = ctl("profile_dump")
+    dump = M.decode_profile(raw)
+    if dump.dropped:
+        print(f"warning: {dump.dropped} samples dropped (slab full) — "
+              "the busiest window is under-represented", file=sys.stderr)
+    if "--json" in args:
+        print(json.dumps(raw, sort_keys=True))
+    else:
+        print(M.render_folded(dump))
+    return 0
+
+
 def cmd_scrub(c: FdfsClient, args: list[str]) -> int:
     """Integrity engine (anti-entropy) console: per-storage scrub status
     from the SCRUB_STATUS blob, with optional kick and watch modes.
@@ -678,6 +770,7 @@ TOOLS = {
     "tracker_status": cmd_tracker_status,
     "near_dups": cmd_near_dups,
     "trace": cmd_trace,
+    "profile": cmd_profile,
     "scrub": cmd_scrub,
     "group": cmd_group,
 }

@@ -80,6 +80,14 @@ class FdfsClient:
         self.use_placement = bool(use_placement)
         self._placement: dict | None = None
         self._placement_rr = 0
+        # Client-side resilience accounting (stats()): lifetime counts
+        # of every transparent fallback this client took.  The paths are
+        # silent by design — correctness never depended on the fast
+        # path — so without these an operator cannot tell "dedup is
+        # winning" from "dedup quietly gave up on every upload".
+        self._fallbacks = {"dedup_fallback_plain": 0,
+                           "placement_fallback_tracker": 0,
+                           "ranged_fallback_single": 0}
 
     @classmethod
     def from_conf(cls, conf_path: str) -> "FdfsClient":
@@ -99,6 +107,15 @@ class FdfsClient:
     def close(self) -> None:
         if self.pool is not None:
             self.pool.close_all()
+
+    def stats(self) -> dict:
+        """Lifetime client-side fallback counters: how often the dedup
+        upload fell back to a plain UPLOAD_FILE, the placement shortcut
+        fell back to the tracker hop, and a parallel ranged download
+        fell back to the classic single stream.  The fallbacks are
+        transparent (the call still succeeds), so this is the only
+        place their frequency is visible."""
+        return dict(self._fallbacks)
 
     def _wire_ctx(self):
         return self.tracer.wire_ctx() if self.tracer is not None else None
@@ -221,6 +238,7 @@ class FdfsClient:
                     # dead member: forget the cache, fall through to the
                     # tracker, which re-hashes the key itself.
                     self._placement = None
+                    self._fallbacks["placement_fallback_tracker"] += 1
         tgt = self._with_tracker(lambda t: t.query_store(group, key=key))
         with self._storage(tgt) as s:
             return s.upload_buffer(data, ext=ext,
@@ -259,6 +277,7 @@ class FdfsClient:
                        else min_dup_ratio)
         if len(data) < self.dedup_min_bytes:
             stats.update(fallback="small", bytes_sent=len(data))
+            self._fallbacks["dedup_fallback_plain"] += 1
             return self._upload_buffer_plain(data, ext=ext, group=group,
                                              key=key)
         from fastdfs_tpu.client.fingerprint import fingerprint_buffer
@@ -270,14 +289,21 @@ class FdfsClient:
             if estimate < ratio_floor:
                 self._remember_digests(chunks)
                 stats.update(fallback="low_estimate", bytes_sent=len(data))
+                self._fallbacks["dedup_fallback_plain"] += 1
                 return self._upload_buffer_plain(data, ext=ext, group=group,
                                                  key=key)
         self._remember_digests(chunks)
         tgt = self._with_tracker(lambda t: t.query_store(group, key=key))
         with self._storage(tgt) as s:
-            return s.upload_buffer_dedup(
+            fid = s.upload_buffer_dedup(
                 data, ext=ext, store_path_index=tgt.store_path_index,
                 chunks=chunks, stats=stats)
+        # StorageClient-level bail-outs (daemon lacks the opcodes / a
+        # chunk store, mid-session failure) report through the same
+        # stats dict — one counter covers every dedup→plain path.
+        if stats.get("fallback"):
+            self._fallbacks["dedup_fallback_plain"] += 1
+        return fid
 
     def download_to_buffer(self, file_id: str, offset: int = 0,
                            length: int = 0) -> bytes:
@@ -389,6 +415,7 @@ class FdfsClient:
                     f.result()  # re-raise the first failure
             return bytes(buf)
         except Exception:  # noqa: BLE001 — transparent whole-file fallback
+            self._fallbacks["ranged_fallback_single"] += 1
             return self._download_single(file_id, offset, length)
 
     def delete_file(self, file_id: str) -> None:
@@ -508,6 +535,23 @@ class FdfsClient:
         """One storage daemon's hot-file top-K (HEAT_TOP)."""
         with self._storage(FetchTarget(ip=ip, port=port)) as s:
             return s.heat_top(k)
+
+    def storage_profile_start(self, ip: str, port: int, hz: int = 97,
+                              duration_s: int = 30) -> dict:
+        """Arm one storage daemon's sampling profiler (PROFILE_CTL)."""
+        with self._storage(FetchTarget(ip=ip, port=port)) as s:
+            return s.profile_start(hz, duration_s)
+
+    def storage_profile_stop(self, ip: str, port: int) -> dict:
+        """Disarm one storage daemon's profiler early (idempotent)."""
+        with self._storage(FetchTarget(ip=ip, port=port)) as s:
+            return s.profile_stop()
+
+    def storage_profile_dump(self, ip: str, port: int) -> dict:
+        """One storage daemon's folded-stack dump (PROFILE_DUMP); shape
+        per fastdfs_tpu.monitor.decode_profile."""
+        with self._storage(FetchTarget(ip=ip, port=port)) as s:
+            return s.profile_dump()
 
     def scrub_status(self, ip: str, port: int) -> dict[str, int]:
         """One storage daemon's integrity-engine status (SCRUB_STATUS)."""

@@ -25,6 +25,7 @@ from fastdfs_tpu.common.protocol import (
     pack_group_name,
     pack_metadata,
     pack_prefix_name,
+    pack_profile_ctl,
     unpack_group_name,
     unpack_metadata,
     unpack_scrub_stats,
@@ -495,6 +496,31 @@ class StorageClient:
         even when periodic scrubbing (scrub_interval_s) is off."""
         self.conn.send_request(StorageCmd.SCRUB_KICK)
         self.conn.recv_response("scrub_kick")
+
+    def profile_start(self, hz: int = 97, duration_s: int = 30) -> dict:
+        """Arm the in-daemon sampling profiler (PROFILE_CTL 141) for
+        ``duration_s`` seconds at ``hz`` samples/s (clamped to the
+        daemon's profile_max_hz).  The daemon auto-disarms at the
+        deadline, so a dropped connection cannot leave the timer armed.
+        Returns the ack {"active": true, "hz": <armed hz>};
+        StatusError(95) when profiling is off (profile_max_hz = 0)."""
+        self.conn.send_request(StorageCmd.PROFILE_CTL,
+                               pack_profile_ctl(True, hz, duration_s))
+        return json.loads(self.conn.recv_response("profile_start") or b"{}")
+
+    def profile_stop(self) -> dict:
+        """Disarm the profiler early (PROFILE_CTL 141, action 0); the
+        captured samples stay available to profile_dump.  Idempotent."""
+        self.conn.send_request(StorageCmd.PROFILE_CTL,
+                               pack_profile_ctl(False))
+        return json.loads(self.conn.recv_response("profile_stop") or b"{}")
+
+    def profile_dump(self) -> dict:
+        """Folded-stack dump of the last capture (PROFILE_DUMP 142).
+        Shape per fastdfs_tpu.monitor.decode_profile; StatusError(95)
+        while no capture was ever started this daemon lifetime."""
+        self.conn.send_request(StorageCmd.PROFILE_DUMP)
+        return json.loads(self.conn.recv_response("profile_dump") or b"{}")
 
 
 def _split_id(file_id: str) -> tuple[str, str]:

@@ -18,6 +18,7 @@ from fastdfs_tpu.common.protocol import (
     TrackerCmd,
     buff2long,
     pack_group_name,
+    pack_profile_ctl,
     unpack_group_name,
 )
 
@@ -287,6 +288,29 @@ class TrackerClient:
         body = long2buff(since_us) if since_us else b""
         self.conn.send_request(TrackerCmd.METRICS_HISTORY, body)
         return json.loads(self.conn.recv_response("metrics_history") or b"{}")
+
+    def profile_start(self, hz: int = 97, duration_s: int = 30) -> dict:
+        """Arm the tracker's sampling profiler (PROFILE_CTL 67); same
+        contract as StorageClient.profile_start — ack {"active", "hz"},
+        StatusError(95) when profile_max_hz = 0, auto-disarm at the
+        duration deadline."""
+        self.conn.send_request(TrackerCmd.PROFILE_CTL,
+                               pack_profile_ctl(True, hz, duration_s))
+        return json.loads(self.conn.recv_response("profile_start") or b"{}")
+
+    def profile_stop(self) -> dict:
+        """Disarm early (PROFILE_CTL 67, action 0); idempotent, samples
+        kept for profile_dump."""
+        self.conn.send_request(TrackerCmd.PROFILE_CTL,
+                               pack_profile_ctl(False))
+        return json.loads(self.conn.recv_response("profile_stop") or b"{}")
+
+    def profile_dump(self) -> dict:
+        """Folded-stack dump (PROFILE_DUMP 68).  Shape per
+        fastdfs_tpu.monitor.decode_profile; StatusError(95) while no
+        capture was ever started."""
+        self.conn.send_request(TrackerCmd.PROFILE_DUMP)
+        return json.loads(self.conn.recv_response("profile_dump") or b"{}")
 
     def get_tracker_status(self) -> dict:
         """Multi-tracker relationship probe (TRACKER_GET_STATUS 70):

@@ -132,6 +132,17 @@ def unpack_scrub_stats(buf: bytes) -> dict[str, int]:
     vals += [0] * (SCRUB_STAT_COUNT - len(vals))
     return dict(zip(SCRUB_STAT_FIELDS, vals))
 
+
+PROFILE_CTL_LEN = 17
+
+
+def pack_profile_ctl(start: bool, hz: int = 0, duration_s: int = 0) -> bytes:
+    """PROFILE_CTL request body: 1B action (1 = start, 0 = stop) + 8B BE
+    hz + 8B BE duration seconds.  Stop ignores the numbers but still
+    carries the full 17-byte shape (fixed-size bodies keep the daemon's
+    recv path branch-free; pinned by the fdfs_codec profile-ctl golden)."""
+    return bytes([1 if start else 0]) + long2buff(hz) + long2buff(duration_s)
+
 # Largest request body a daemon will buffer in memory (larger bodies
 # stream to disk, or the connection is closed).  A WIRE contract, not a
 # tuning knob: senders of inline-only commands (e.g. the chunk-aware
@@ -279,6 +290,23 @@ class TrackerCmd(enum.IntEnum):
     # cross-language golden.
     GROUP_DRAIN = 65
     GROUP_REACTIVATE = 66
+    # fastdfs_tpu extension: in-daemon sampling profiler + thread ledger
+    # (OPERATIONS.md "Profiling & the thread ledger").  CTL body = 1B
+    # action (1 = start, 0 = stop) + 8B BE hz + 8B BE duration seconds
+    # (stop ignores the numbers; the 17-byte shape is pinned by the
+    # fdfs_codec profile-ctl cross-language golden).  Start is
+    # idempotent (re-arming restarts the capture window) and the daemon
+    # auto-stops at the duration so a vanished client cannot leave the
+    # timer armed.  ENOTSUP unless profile_max_hz > 0.  NOTE: the design
+    # doc assigned the tracker 100/101, but 100 is RESP and 101 is
+    # SERVICE_QUERY_STORE_WITHOUT_GROUP_ONE (both upstream-fixed), so
+    # the tracker pair lives at 67/68 next to the other fastdfs_tpu
+    # admin extensions; the storage pair keeps its planned 141/142.
+    PROFILE_CTL = 67
+    # Folded-stack dump: empty body -> JSON per
+    # fastdfs_tpu.monitor.decode_profile (pinned by the fdfs_codec
+    # profile-json golden).  ENOTSUP while a capture was never started.
+    PROFILE_DUMP = 68
 
     # fastdfs_tpu extension: distributed-tracing context prefix frame
     # (see TRACE_CTX_LEN above).  Deliberately the SAME value on both
@@ -465,6 +493,12 @@ class StorageCmd(enum.IntEnum):
     # response = text lines "<file_id> <score>".  ENOTSUP when the dedup
     # mode has no near index.
     NEAR_DUPS = 124
+    # Sampling profiler + thread ledger, same contract as the tracker
+    # pair (TrackerCmd.PROFILE_CTL / PROFILE_DUMP — CTL semantics and
+    # body shape documented there; both pinned by the profile-ctl /
+    # profile-json cross-language goldens).
+    PROFILE_CTL = 141
+    PROFILE_DUMP = 142
 
     RESP = 100
     ACTIVE_TEST = 111
@@ -511,6 +545,10 @@ WIRE_GOLDENS = {
     "TrackerCmd.QUERY_PLACEMENT": "placement-wire",
     "TrackerCmd.GROUP_DRAIN": "group-admin",
     "TrackerCmd.GROUP_REACTIVATE": "group-admin",
+    "TrackerCmd.PROFILE_CTL": "profile-ctl",
+    "TrackerCmd.PROFILE_DUMP": "profile-json",
+    "StorageCmd.PROFILE_CTL": "profile-ctl",
+    "StorageCmd.PROFILE_DUMP": "profile-json",
 }
 
 

@@ -225,6 +225,96 @@ def decode_heat(obj: dict) -> list[HeatEntry]:
 
 
 # ---------------------------------------------------------------------------
+# profile decoding (PROFILE_DUMP; native/common/profiler.h).  The wire
+# shape is pinned cross-language by the fdfs_codec profile-json golden.
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ProfileStack:
+    """One folded stack: ``thread;outermost;...;leaf`` with how many
+    SIGPROF samples landed there."""
+    stack: str
+    count: int
+
+    @property
+    def thread(self) -> str:
+        return self.stack.split(";", 1)[0]
+
+
+@dataclass(frozen=True)
+class ProfileDump:
+    role: str            # "storage" | "tracker"
+    port: int
+    active: bool         # capture still armed at dump time
+    hz: int              # as armed (post profile_max_hz clamp)
+    duration_s: int
+    samples: int         # handler captures (kept + aggregated)
+    dropped: int         # slab-overflow drops — nonzero means the
+    #                      profile under-represents the busiest window
+    overhead_us: int     # cumulative handler wall time
+    max_frames: int      # stack truncation depth (deeper frames lost)
+    stacks: tuple        # ProfileStack, count-descending
+
+
+def decode_profile(obj: dict) -> ProfileDump:
+    """Validate and decode one daemon's PROFILE_DUMP JSON (stacks arrive
+    sorted by count descending; unknown extra keys are ignored — the
+    wire contract is append-only)."""
+    if not isinstance(obj, dict) or not isinstance(obj.get("stacks"), list):
+        raise ValueError(f"profile dump must have a stacks list: {obj!r}")
+    rows: list[ProfileStack] = []
+    for s in obj["stacks"]:
+        try:
+            rows.append(ProfileStack(stack=str(s["stack"]),
+                                     count=int(s["count"])))
+        except (KeyError, TypeError, ValueError) as err:
+            raise ValueError(f"malformed profile stack {s!r}: {err}") from None
+    if any(a.count < b.count for a, b in zip(rows, rows[1:])):
+        raise ValueError("profile stacks not sorted by count descending")
+    try:
+        return ProfileDump(
+            role=str(obj["role"]), port=int(obj["port"]),
+            active=bool(obj["active"]), hz=int(obj["hz"]),
+            duration_s=int(obj["duration_s"]), samples=int(obj["samples"]),
+            dropped=int(obj["dropped"]),
+            overhead_us=int(obj.get("overhead_us", 0)),
+            max_frames=int(obj.get("max_frames", 0)),
+            stacks=tuple(rows))
+    except (KeyError, TypeError, ValueError) as err:
+        raise ValueError(f"malformed profile dump: {err}") from None
+
+
+def render_folded(dump: ProfileDump) -> str:
+    """Collapsed-stack text: one ``frames count`` line per row, the
+    input format of flamegraph.pl and speedscope (OPERATIONS.md
+    "Profiling & the thread ledger" has the full recipe)."""
+    return "\n".join(f"{s.stack} {s.count}" for s in dump.stacks)
+
+
+_THREAD_GAUGE_SUFFIXES = (".cpu_pct", ".utime_ms", ".stime_ms")
+
+
+def thread_ledger(reg: dict) -> list[dict]:
+    """Per-thread CPU rows from one registry snapshot's ``thread.*``
+    gauges (ThreadRegistry::SampleInto), cpu%-descending then by name.
+    Thread names contain dots and slashes (``dio.worker/1``), so parse
+    by stripping the known prefix and suffix — never by splitting."""
+    rows: dict[str, dict] = {}
+    for name, v in reg.get("gauges", {}).items():
+        if not name.startswith("thread."):
+            continue
+        for suffix in _THREAD_GAUGE_SUFFIXES:
+            if name.endswith(suffix):
+                tname = name[len("thread."):-len(suffix)]
+                rows.setdefault(tname, {"name": tname, "cpu_pct": 0,
+                                        "utime_ms": 0, "stime_ms": 0})
+                rows[tname][suffix[1:]] = v
+                break
+    return sorted(rows.values(),
+                  key=lambda r: (-r["cpu_pct"], r["name"]))
+
+
+# ---------------------------------------------------------------------------
 # SLO rule table (mirror of native/common/sloeval.cc; the fdfs_codec
 # slo-conf golden pins the two parsers against each other)
 # ---------------------------------------------------------------------------
@@ -531,11 +621,15 @@ def render_top(cur: TopSample, rates: dict[str, dict],
                max_events: int = 10,
                alerts: dict[str, list[str]] | None = None,
                heat: dict[str, list["HeatEntry"]] | None = None,
-               heat_rows: int = 5) -> str:
+               heat_rows: int = 5,
+               threads: dict[str, list[dict]] | None = None,
+               thread_rows: int = 8) -> str:
     """The fdfs_top frame: a per-node saturation table, an ALERTS line
     (active SLO breaches per node), the scrolling recent-events pane,
-    and — with ``heat`` — a per-node hot-file pane.  Pure string
-    building so tests (and --json consumers) can drive it headless."""
+    with ``heat`` a per-node hot-file pane, and with ``threads`` a
+    per-node THREADS pane (the thread ledger, cpu%-descending).  Pure
+    string building so tests (and --json consumers) can drive it
+    headless."""
     cols = (f"{'node':<32} {'ops/s':>8} {'err/s':>6} {'in MB/s':>8} "
             f"{'out MB/s':>8} {'hit%':>6} {'loop p99':>9} {'dio p99':>9} "
             f"{'depth':>5} {'conns':>5}")
@@ -604,6 +698,11 @@ def render_top(cur: TopSample, rates: dict[str, dict],
         lines.append(f"hot files (top {heat_rows} per node, "
                      "hits / err-bound / MB / ops):")
         lines.extend(_heat_table_lines(heat, heat_rows))
+    if threads is not None:
+        lines.append("")
+        lines.append(f"THREADS (top {thread_rows} per node, "
+                     "cpu% / user ms / sys ms):")
+        lines.extend(_thread_table_lines(threads, thread_rows))
     return "\n".join(lines)
 
 
@@ -624,6 +723,23 @@ def _heat_table_lines(heat: dict[str, list["HeatEntry"]],
                            if c["count"] > 0)
             lines.append(f"    {he.hits:>8} ±{he.err_bound:<6} "
                          f"{he.bytes / 1e6:>8.1f}MB  {he.key}  [{ops}]")
+    return lines
+
+
+def _thread_table_lines(threads: dict[str, list[dict]],
+                        thread_rows: int) -> list[str]:
+    """Per-node thread-ledger table body (rows from thread_ledger),
+    shared so fdfs_top's THREADS pane and any report renderer show the
+    same numbers identically."""
+    lines: list[str] = []
+    for node in sorted(threads):
+        lines.append(f"  {node}:")
+        rows = threads[node][:thread_rows]
+        if not rows:
+            lines.append("    (none)")
+        for r in rows:
+            lines.append(f"    {r['cpu_pct']:>4}% {r['utime_ms']:>8}u "
+                         f"{r['stime_ms']:>8}s  {r['name']}")
     return lines
 
 

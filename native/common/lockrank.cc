@@ -14,6 +14,8 @@ const char* LockRankName(LockRank r) {
     case LockRank::kRelationship: return "tracker.relationship";
     case LockRank::kDedupEngine: return "dedup.engine";
     case LockRank::kDedupPool: return "dedup.sidecar_pool";
+    case LockRank::kThreadRegistry: return "threadreg.registry";
+    case LockRank::kProfiler: return "profiler.control";
     case LockRank::kStatsRegistry: return "stats.registry";
     case LockRank::kHeatStripe: return "heatsketch.stripe";
     case LockRank::kMetricsJournal: return "metrog.journal";

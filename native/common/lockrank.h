@@ -47,6 +47,18 @@
 //                           state; logs under it -> before kLog).
 //    50   kDedupEngine      CpuDedup::mu_ (digest maps).
 //    60   kDedupPool        SidecarDedup::mu_ (idle-fd pool).
+//    64   kThreadRegistry   ThreadRegistry::mu_ (threadreg.h) — the
+//                           per-thread CPU ledger.  SampleInto copies
+//                           the slot table under it, releases, then
+//                           writes gauges (kStatsRegistry), so it must
+//                           order BEFORE kStatsRegistry; Join/Leave run
+//                           at thread birth/death with nothing held.
+//    66   kProfiler         Profiler::mu_ (profiler.h) — arming state,
+//                           the slab, and the capture window for
+//                           PROFILE_CTL/PROFILE_DUMP.  Start/Stop/Dump
+//                           log under it -> before kLog; the SIGPROF
+//                           handler itself NEVER touches it (atomics
+//                           only — a signal cannot wait on a mutex).
 //    70   kStatsRegistry    StatsRegistry::mu_ — gauge-fn callbacks run
 //                           UNDER it and read sync lag, chunk-store
 //                           stripe aggregates, the read cache, worker
@@ -131,6 +143,8 @@ enum class LockRank : uint16_t {
   kRelationship = 40,
   kDedupEngine = 50,
   kDedupPool = 60,
+  kThreadRegistry = 64,
+  kProfiler = 66,
   kStatsRegistry = 70,
   kHeatStripe = 72,
   kMetricsJournal = 74,

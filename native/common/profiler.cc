@@ -1,0 +1,349 @@
+#include "common/profiler.h"
+
+#include <cxxabi.h>
+#include <errno.h>
+#include <execinfo.h>
+#include <sched.h>
+#include <signal.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/time.h>
+#include <time.h>
+
+#include <algorithm>
+#include <map>
+#include <vector>
+
+#include "common/log.h"
+#include "common/threadreg.h"
+
+namespace fdfs {
+
+namespace {
+
+// Monotonic nanoseconds via clock_gettime — async-signal-safe, unlike
+// the chrono plumbing behind net.h's MonoUs.
+int64_t MonoNsSafe() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000000 + ts.tv_nsec;
+}
+
+Profiler* g_profiler = nullptr;  // set before the first sigaction install
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+// "./fdfs_storaged(_ZN4fdfs12StorageServer6OnReadEv+0x1f) [0x55...]"
+// -> demangled symbol when present, "binary+0xoffset" when the symbol
+// table has nothing (static functions), bare line otherwise.
+std::string FrameName(const char* symbolized) {
+  const char* open = strchr(symbolized, '(');
+  if (open != nullptr && open[1] != '\0' && open[1] != ')' &&
+      open[1] != '+') {
+    const char* end = open + 1;
+    while (*end != '\0' && *end != '+' && *end != ')') ++end;
+    std::string mangled(open + 1, end);
+    int status = 0;
+    char* dem =
+        abi::__cxa_demangle(mangled.c_str(), nullptr, nullptr, &status);
+    if (status == 0 && dem != nullptr) {
+      std::string out(dem);
+      free(dem);
+      return out;
+    }
+    if (dem != nullptr) free(dem);
+    return mangled;
+  }
+  // No symbol: keep "binary+0xoffset" (strip the path and the trailing
+  // " [0xaddr]" so folded stacks stay stable across ASLR runs when the
+  // offset is available).
+  std::string line(symbolized);
+  size_t bracket = line.rfind(" [");
+  std::string head = bracket == std::string::npos ? line : line.substr(0, bracket);
+  size_t slash = head.rfind('/');
+  if (slash != std::string::npos) head = head.substr(slash + 1);
+  if (!head.empty()) return head;
+  return line;
+}
+
+}  // namespace
+
+// The SIGPROF handler body.  Async-signal-safe by construction: atomics,
+// the preallocated slab, thread-locals, clock_gettime, setitimer, and
+// backtrace (primed at arm time so libgcc's unwinder is already loaded —
+// its lazy first-call initialization is the one part of backtrace that
+// allocates).
+void ProfSignalHandlerImpl(Profiler* p) {
+  // Register in flight BEFORE the active_ gate: the control path
+  // disarms, then spins in_handler_ to 0, so any handler it must wait
+  // for is already counted by the time it observes active_ == true.
+  p->in_handler_.fetch_add(1, std::memory_order_acq_rel);
+  do {
+    if (!p->active_.load(std::memory_order_acquire)) break;
+    int64_t t0 = MonoNsSafe();
+    if (t0 / 1000 >= p->deadline_us_.load(std::memory_order_relaxed)) {
+      // Auto-stop: disarm the timer from the handler (setitimer is
+      // async-signal-safe) so a client that armed and vanished cannot
+      // leave the daemon signaling forever.  Stop()/Start() later
+      // re-disarm harmlessly.
+      struct itimerval off;
+      memset(&off, 0, sizeof(off));
+      setitimer(ITIMER_PROF, &off, nullptr);
+      p->active_.store(false, std::memory_order_release);
+      break;
+    }
+    Profiler::Sample* slab = p->slab_.load(std::memory_order_acquire);
+    if (slab == nullptr) break;  // racing a first-arm; drop silently
+    uint64_t idx = p->write_idx_.fetch_add(1, std::memory_order_relaxed);
+    if (idx >= Profiler::kSlabSlots) {
+      p->dropped_.fetch_add(1, std::memory_order_relaxed);
+      break;
+    }
+    Profiler::Sample& s = slab[idx];
+    s.tid = CurrentTid();
+    const char* name = CurrentThreadName();
+    size_t i = 0;
+    for (; i + 1 < sizeof(s.thread) && name[i] != '\0'; ++i)
+      s.thread[i] = name[i];
+    s.thread[i] = '\0';
+    s.depth = backtrace(s.pc, Profiler::kMaxFrames);
+    s.done.store(true, std::memory_order_release);
+    p->samples_.fetch_add(1, std::memory_order_relaxed);
+    p->handler_ns_.fetch_add(MonoNsSafe() - t0, std::memory_order_relaxed);
+  } while (false);
+  p->in_handler_.fetch_sub(1, std::memory_order_release);
+}
+
+namespace {
+
+extern "C" void ProfSigAction(int, siginfo_t*, void*) {
+  int saved_errno = errno;
+  Profiler* p = g_profiler;
+  if (p != nullptr) ProfSignalHandlerImpl(p);
+  errno = saved_errno;
+}
+
+}  // namespace
+
+Profiler& Profiler::Global() {
+  static Profiler* g = new Profiler();  // leaked: SIGPROF may outlive main
+  return *g;
+}
+
+void Profiler::DisarmLocked() {
+  struct itimerval off;
+  memset(&off, 0, sizeof(off));
+  setitimer(ITIMER_PROF, &off, nullptr);
+  active_.store(false, std::memory_order_release);
+}
+
+int Profiler::Start(int hz, int duration_s) {
+  int max_hz = max_hz_.load();
+  if (max_hz <= 0) return 95;  // ENOTSUP: profile_max_hz gates the feature
+  if (hz <= 0 || duration_s <= 0) return 22;
+  if (hz > max_hz) hz = max_hz;
+  if (duration_s > kMaxDurationS) duration_s = kMaxDurationS;
+
+  std::lock_guard<RankedMutex> lk(mu_);
+  // Re-arm (idempotent start): quiesce the running capture first so the
+  // window reset below cannot interleave with a handler mid-sample.
+  // Disarming stops NEW handlers at the active_ gate, but a SIGPROF
+  // delivered to another thread may already be past it and writing its
+  // slot — wait those out (handlers run for microseconds).  A SIGPROF
+  // landing on THIS thread during the spin sees active_ == false and
+  // bails, so the spin cannot self-deadlock.
+  DisarmLocked();
+  while (in_handler_.load(std::memory_order_acquire) != 0) sched_yield();
+
+  if (slab_.load(std::memory_order_acquire) == nullptr) {
+    // First arm ever: allocate the slab (never freed — a SIGPROF in
+    // flight on another thread must never race a reallocation) and
+    // prime backtrace so its lazy libgcc load happens HERE, on the
+    // control thread, not inside the first signal.
+    Sample* slab = new Sample[kSlabSlots];
+    void* prime[4];
+    backtrace(prime, 4);
+    slab_.store(slab, std::memory_order_release);
+  }
+  if (!sigaction_installed_) {
+    g_profiler = this;
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_sigaction = ProfSigAction;
+    sa.sa_flags = SA_SIGINFO | SA_RESTART;
+    sigemptyset(&sa.sa_mask);
+    if (sigaction(SIGPROF, &sa, nullptr) != 0) return 5;
+    sigaction_installed_ = true;
+  }
+
+  // Reset the capture window.  write_idx_ last: the slab's done flags
+  // were cleared while disarmed, so a stale consumer cannot observe a
+  // half-reset window.
+  Sample* slab = slab_.load(std::memory_order_acquire);
+  uint64_t used = write_idx_.load(std::memory_order_acquire);
+  if (used > kSlabSlots) used = kSlabSlots;
+  for (uint64_t i = 0; i < used; ++i) {
+    slab[i].done.store(false, std::memory_order_relaxed);
+    slab[i].depth = 0;
+  }
+  samples_.store(0);
+  dropped_.store(0);
+  handler_ns_.store(0);
+  hz_.store(hz);
+  duration_s_.store(duration_s);
+  deadline_us_.store(MonoNsSafe() / 1000 +
+                     static_cast<int64_t>(duration_s) * 1000000);
+  write_idx_.store(0, std::memory_order_release);
+  ever_started_.store(true, std::memory_order_release);
+  active_.store(true, std::memory_order_release);
+
+  struct itimerval tv;
+  memset(&tv, 0, sizeof(tv));
+  tv.it_interval.tv_sec = 0;
+  tv.it_interval.tv_usec = std::max(1000000 / hz, 1000);  // >= 1ms: kernel floor
+  tv.it_value = tv.it_interval;
+  if (setitimer(ITIMER_PROF, &tv, nullptr) != 0) {
+    active_.store(false, std::memory_order_release);
+    return 5;
+  }
+  FDFS_LOG_INFO("profiler: armed %d Hz for %d s (max_hz=%d, slab=%u slots)",
+           hz, duration_s, max_hz, kSlabSlots);
+  return 0;
+}
+
+int Profiler::Stop() {
+  std::lock_guard<RankedMutex> lk(mu_);
+  bool was_active = active_.load(std::memory_order_acquire);
+  DisarmLocked();
+  if (was_active)
+    FDFS_LOG_INFO("profiler: stopped (%lld samples, %lld dropped)",
+             static_cast<long long>(samples_.load()),
+             static_cast<long long>(dropped_.load()));
+  return 0;
+}
+
+int Profiler::DumpJson(const std::string& role, int port, std::string* out) {
+  std::lock_guard<RankedMutex> lk(mu_);
+  if (!ever_started_.load(std::memory_order_acquire)) return 95;
+
+  Sample* slab = slab_.load(std::memory_order_acquire);
+  uint64_t used = write_idx_.load(std::memory_order_acquire);
+  if (used > kSlabSlots) used = kSlabSlots;
+
+  // Pass 1: collect unique pcs so backtrace_symbols runs once over the
+  // whole set (it mallocs per call — dump time only, never the handler).
+  std::map<void*, std::string> names;
+  {
+    std::vector<void*> pcs;
+    for (uint64_t i = 0; i < used && slab != nullptr; ++i) {
+      Sample& s = slab[i];
+      if (!s.done.load(std::memory_order_acquire)) continue;  // mid-write
+      for (int f = 0; f < s.depth; ++f) names[s.pc[f]];
+    }
+    pcs.reserve(names.size());
+    for (auto& [pc, _] : names) pcs.push_back(pc);
+    if (!pcs.empty()) {
+      char** sym = backtrace_symbols(pcs.data(), static_cast<int>(pcs.size()));
+      if (sym != nullptr) {
+        for (size_t i = 0; i < pcs.size(); ++i) names[pcs[i]] = FrameName(sym[i]);
+        free(sym);
+      }
+    }
+  }
+
+  // Pass 2: fold.  Stack string is "thread;outermost;...;leaf" (the
+  // flamegraph.pl order), so frames reverse backtrace()'s leaf-first
+  // layout.  The top of every captured stack is the handler itself plus
+  // the kernel's signal trampoline — skip down to the first frame past
+  // a trampoline/handler symbol (fixed skip of 2 when unrecognizable).
+  std::map<std::string, int64_t> folded;
+  int64_t aggregated = 0;
+  for (uint64_t i = 0; i < used && slab != nullptr; ++i) {
+    Sample& s = slab[i];
+    if (!s.done.load(std::memory_order_acquire)) continue;
+    int start = 0;
+    for (int f = 0; f < s.depth; ++f) {
+      const std::string& n = names[s.pc[f]];
+      if (n.find("ProfSig") != std::string::npos ||
+          n.find("ProfSignalHandler") != std::string::npos ||
+          n.find("restore_rt") != std::string::npos ||
+          n.find("__kernel_") != std::string::npos) {
+        start = f + 1;
+      }
+    }
+    if (start == 0 && s.depth > 2) start = 2;  // handler + trampoline
+    std::string key = s.thread[0] != '\0' ? s.thread : "unnamed";
+    for (int f = s.depth - 1; f >= start; --f) {
+      key += ';';
+      key += names[s.pc[f]];
+    }
+    ++folded[key];
+    ++aggregated;
+  }
+
+  std::vector<FoldedStack> rows;
+  rows.reserve(folded.size());
+  for (const auto& [stack, count] : folded)
+    rows.push_back(FoldedStack{stack, count});
+  *out = ProfileJson(role, port, active_.load(), hz_.load(),
+                     duration_s_.load(), aggregated, dropped_.load(),
+                     handler_ns_.load() / 1000, std::move(rows));
+  return 0;
+}
+
+std::string ProfileJson(const std::string& role, int port, bool active,
+                        int hz, int duration_s, int64_t samples,
+                        int64_t dropped, int64_t overhead_us,
+                        std::vector<FoldedStack> rows) {
+  // Deterministic order: count desc, then stack asc — dump output diffs
+  // cleanly between captures.
+  std::sort(rows.begin(), rows.end(),
+            [](const FoldedStack& a, const FoldedStack& b) {
+              if (a.count != b.count) return a.count > b.count;
+              return a.stack < b.stack;
+            });
+  std::string j;
+  j.reserve(4096);
+  j += "{\"role\":\"" + JsonEscape(role) + "\",";
+  j += "\"port\":" + std::to_string(port) + ",";
+  j += "\"active\":" + std::string(active ? "true" : "false") + ",";
+  j += "\"hz\":" + std::to_string(hz) + ",";
+  j += "\"duration_s\":" + std::to_string(duration_s) + ",";
+  j += "\"samples\":" + std::to_string(samples) + ",";
+  j += "\"dropped\":" + std::to_string(dropped) + ",";
+  j += "\"overhead_us\":" + std::to_string(overhead_us) + ",";
+  j += "\"max_frames\":" + std::to_string(Profiler::kMaxFrames) + ",";
+  j += "\"stacks\":[";
+  bool first = true;
+  for (const FoldedStack& r : rows) {
+    if (!first) j += ',';
+    first = false;
+    j += "{\"stack\":\"" + JsonEscape(r.stack) +
+         "\",\"count\":" + std::to_string(r.count) + "}";
+  }
+  j += "]}";
+  return j;
+}
+
+}  // namespace fdfs

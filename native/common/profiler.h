@@ -1,0 +1,134 @@
+// On-demand in-daemon sampling profiler (OPERATIONS.md "Profiling & the
+// thread ledger"): SIGPROF/ITIMER_PROF samples whichever thread the
+// kernel charges with CPU time, the async-signal-safe handler captures
+// a raw backtrace into a preallocated lock-free slab, and aggregation +
+// symbolization happen only at dump time — on the dio pool in the
+// storage daemon, inline (bounded) in the tracker.
+//
+// Wire surface: PROFILE_CTL (start with hz+duration / stop; idempotent;
+// the HANDLER auto-disarms at the duration deadline so a vanished
+// client can never leave the timer armed) and PROFILE_DUMP (JSON of
+// folded stacks "thread;frame1;frame2" + drop/overhead counters,
+// decoded by fastdfs_tpu.monitor.decode_profile).  The profile_max_hz
+// conf key gates the whole feature: 0 (the default) refuses to arm and
+// costs nothing — no slab, no timer, no signal handler.
+//
+// Handler discipline (the whole design): the SIGPROF handler touches
+// ONLY atomics, the preallocated slab, thread-locals, and
+// async-signal-safe calls (clock_gettime, setitimer, backtrace after
+// its one-time prime) — no malloc, no locks, no formatting.  On slab
+// overflow it bumps a drop counter and returns.  The slab is allocated
+// at first arm and NEVER freed or moved, so a signal in flight on
+// another thread can never race a reallocation.
+//
+// Per-sample thread attribution reads threadreg.h's thread_local name
+// buffer (the "per-thread" half of the slab: samples carry their
+// thread's ledger name; the claim itself is one fetch_add on a shared
+// preallocated pool — lock-free without per-thread arenas to sweep).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "common/lockrank.h"
+
+namespace fdfs {
+
+// One folded stack ("thread;outermost;...;leaf") with its sample count.
+struct FoldedStack {
+  std::string stack;
+  int64_t count = 0;
+};
+
+// The PROFILE_DUMP body emitter, shared by Profiler::DumpJson and the
+// fdfs_codec profile-json golden (which feeds it a fixture row set so
+// the wire shape is pinned against monitor.decode_profile without a
+// live capture).  Sorts rows count-desc then stack-asc.
+std::string ProfileJson(const std::string& role, int port, bool active,
+                        int hz, int duration_s, int64_t samples,
+                        int64_t dropped, int64_t overhead_us,
+                        std::vector<FoldedStack> rows);
+
+class Profiler {
+ public:
+  // Process-wide instance: SIGPROF is process-global, so its slab is
+  // too.  Never destroyed.
+  static Profiler& Global();
+
+  // Conf gate (profile_max_hz), set once at daemon init before any
+  // request can reach Start.  0 = feature off.
+  void set_max_hz(int max_hz) { max_hz_.store(max_hz); }
+  int max_hz() const { return max_hz_.load(); }
+
+  // Arm a capture: hz clamped to max_hz, duration clamped to
+  // [1, kMaxDurationS].  Errno-style status: 0 ok, 22 bad params,
+  // 95 feature off (profile_max_hz = 0).  Re-arming while active is
+  // legal (idempotent start): the running capture's samples are
+  // discarded and the window restarts with the new parameters.
+  int Start(int hz, int duration_s);
+
+  // Disarm (keeps the captured samples for PROFILE_DUMP).  Idempotent;
+  // 0 always.
+  int Stop();
+
+  // Aggregate + symbolize the captured slab into the PROFILE_DUMP JSON
+  // (see monitor.decode_profile).  Status 95 while never started —
+  // callers answer ENOTSUP with no body.
+  int DumpJson(const std::string& role, int port, std::string* out);
+
+  // Registry gauge feeds (profile.samples/dropped/active).
+  int64_t samples() const { return samples_.load(); }
+  int64_t dropped() const { return dropped_.load(); }
+  bool active() const { return active_.load(); }
+  bool ever_started() const { return ever_started_.load(); }
+  int64_t overhead_us() const { return handler_ns_.load() / 1000; }
+
+  // Test hook: the capture window's parameters as last armed.
+  int armed_hz() const { return hz_.load(); }
+
+  static constexpr int kMaxFrames = 30;
+  static constexpr int kMaxDurationS = 600;
+  // Slab capacity: 97 Hz x 5 s is ~500 samples; 16K slots absorb a
+  // max-rate capture for minutes before dropping, at ~5 MB — allocated
+  // lazily at first arm, never when the feature is off.
+  static constexpr uint32_t kSlabSlots = 16384;
+
+  struct Sample {
+    std::atomic<bool> done{false};  // release-published by the handler
+    int tid = 0;
+    int depth = 0;
+    char thread[40] = {0};          // ledger name at capture time
+    void* pc[kMaxFrames] = {nullptr};
+  };
+
+ private:
+  Profiler() = default;
+  friend void ProfSignalHandlerImpl(Profiler* p);
+
+  void DisarmLocked();  // mu_ held: stop timer, active_ = false
+
+  // Control path (PROFILE_CTL/PROFILE_DUMP); the handler never takes it.
+  RankedMutex mu_{LockRank::kProfiler};
+  bool sigaction_installed_ = false;
+
+  std::atomic<int> max_hz_{0};
+  std::atomic<int> hz_{0};
+  std::atomic<int> duration_s_{0};
+  std::atomic<int64_t> deadline_us_{0};  // mono; handler auto-disarms past it
+  std::atomic<bool> active_{false};
+  std::atomic<bool> ever_started_{false};
+  std::atomic<Sample*> slab_{nullptr};   // set once, never freed/moved
+  std::atomic<uint64_t> write_idx_{0};
+  std::atomic<int64_t> samples_{0};
+  std::atomic<int64_t> dropped_{0};
+  std::atomic<int64_t> handler_ns_{0};   // cumulative handler wall time
+  // Handlers in flight on OTHER threads: a SIGPROF past the active_
+  // gate may still be writing its slot after the timer is disarmed, so
+  // the control path spins this to 0 before resetting the window.
+  std::atomic<int> in_handler_{0};
+};
+
+}  // namespace fdfs

@@ -1,0 +1,177 @@
+#include "common/threadreg.h"
+
+#include <stdio.h>
+#include <string.h>
+#include <sys/resource.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include "common/net.h"  // MonoUs
+#include "common/stats.h"
+
+namespace fdfs {
+
+namespace {
+
+// Mirror of the current thread's registered name for lock-free readers
+// (profiler signal handler, slow-request logger).  Fixed buffer, not a
+// std::string: the signal handler may read it mid-Leave, and a racing
+// read must at worst see a truncated NUL-terminated name, never a
+// freed heap pointer.
+constexpr size_t kNameBufLen = 48;
+thread_local char t_name[kNameBufLen] = {0};
+
+int64_t TicksPerSecond() {
+  static const int64_t hz = [] {
+    long v = sysconf(_SC_CLK_TCK);
+    return v > 0 ? static_cast<int64_t>(v) : 100;
+  }();
+  return hz;
+}
+
+}  // namespace
+
+int CurrentTid() {
+  static thread_local int tid = static_cast<int>(syscall(SYS_gettid));
+  return tid;
+}
+
+const char* CurrentThreadName() { return t_name; }
+
+bool ReadThreadCpuTicks(int tid, int64_t* utime_ticks, int64_t* stime_ticks) {
+  char path[64];
+  snprintf(path, sizeof(path), "/proc/self/task/%d/stat", tid);
+  FILE* f = fopen(path, "r");
+  if (f != nullptr) {
+    char buf[512];
+    size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+    fclose(f);
+    if (n > 0) {
+      buf[n] = '\0';
+      // comm (field 2) may contain spaces and parens; everything before
+      // the LAST ')' is pid+comm, fields count from state after it.
+      char* p = strrchr(buf, ')');
+      if (p != nullptr) {
+        ++p;
+        // skip fields 3..13 (state .. cmajflt): 11 fields.
+        long long ut = -1, st = -1;
+        if (sscanf(p,
+                   " %*c %*d %*d %*d %*d %*d %*u %*u %*u %*u %*u %llu %llu",
+                   &ut, &st) == 2) {
+          *utime_ticks = static_cast<int64_t>(ut);
+          *stime_ticks = static_cast<int64_t>(st);
+          return true;
+        }
+      }
+    }
+  }
+  // /proc unavailable (or unparsable): RUSAGE_THREAD can still answer
+  // for the CALLING thread — the documented fallback, so at least the
+  // sampling thread's own row survives on /proc-less systems.
+  if (tid == CurrentTid()) {
+    struct rusage ru;
+    if (getrusage(RUSAGE_THREAD, &ru) == 0) {
+      int64_t hz = TicksPerSecond();
+      *utime_ticks = (static_cast<int64_t>(ru.ru_utime.tv_sec) * 1000000 +
+                      ru.ru_utime.tv_usec) * hz / 1000000;
+      *stime_ticks = (static_cast<int64_t>(ru.ru_stime.tv_sec) * 1000000 +
+                      ru.ru_stime.tv_usec) * hz / 1000000;
+      return true;
+    }
+  }
+  return false;
+}
+
+ThreadRegistry& ThreadRegistry::Global() {
+  static ThreadRegistry* g = new ThreadRegistry();  // never destroyed:
+  // daemon threads may outlive main()'s static teardown order.
+  return *g;
+}
+
+int64_t ThreadRegistry::Join(const std::string& name) {
+  int tid = CurrentTid();
+  strncpy(t_name, name.c_str(), kNameBufLen - 1);
+  t_name[kNameBufLen - 1] = '\0';
+  std::lock_guard<RankedMutex> lk(mu_);
+  int64_t id = next_id_++;
+  Slot& s = slots_[id];
+  s.name = name;
+  s.tid = tid;
+  return id;
+}
+
+void ThreadRegistry::Leave(int64_t id) {
+  t_name[0] = '\0';
+  std::lock_guard<RankedMutex> lk(mu_);
+  slots_.erase(id);
+}
+
+std::vector<ThreadRegistry::Entry> ThreadRegistry::Entries() const {
+  std::vector<Entry> out;
+  std::lock_guard<RankedMutex> lk(mu_);
+  out.reserve(slots_.size());
+  for (const auto& [id, s] : slots_) out.push_back(Entry{s.name, s.tid});
+  return out;
+}
+
+size_t ThreadRegistry::size() const {
+  std::lock_guard<RankedMutex> lk(mu_);
+  return slots_.size();
+}
+
+void ThreadRegistry::SampleInto(StatsRegistry* reg) {
+  struct Reading {
+    std::string name;
+    int64_t cpu_pct = 0;
+    int64_t utime_ms = 0;
+    int64_t stime_ms = 0;
+  };
+  std::vector<Reading> readings;
+  int64_t now_us = MonoUs();
+  int64_t hz = TicksPerSecond();
+  {
+    // Sample under mu_ (the delta base lives in the slots), but never
+    // with the stats-registry mutex held: gauges are written after
+    // release (kThreadRegistry orders BEFORE kStatsRegistry).
+    std::lock_guard<RankedMutex> lk(mu_);
+    readings.reserve(slots_.size());
+    for (auto& [id, s] : slots_) {
+      int64_t ut = 0, st = 0;
+      if (!ReadThreadCpuTicks(s.tid, &ut, &st)) continue;  // exiting thread
+      Reading r;
+      r.name = s.name;
+      r.utime_ms = ut * 1000 / hz;
+      r.stime_ms = st * 1000 / hz;
+      int64_t cpu_ticks = ut + st;
+      if (s.last_sample_us > 0 && now_us > s.last_sample_us) {
+        int64_t dticks = cpu_ticks - s.last_cpu_ticks;
+        int64_t dwall_us = now_us - s.last_sample_us;
+        if (dticks < 0) dticks = 0;
+        r.cpu_pct = dticks * 1000000 * 100 / hz / dwall_us;
+        if (r.cpu_pct > 100) r.cpu_pct = 100;  // tick-granularity jitter
+      }
+      s.last_cpu_ticks = cpu_ticks;
+      s.last_sample_us = now_us;
+      readings.push_back(std::move(r));
+    }
+  }
+  std::vector<std::string> keep;
+  keep.reserve(readings.size());
+  for (const Reading& r : readings) {
+    std::string base = "thread." + r.name + ".";
+    reg->SetGauge(base + "cpu_pct", r.cpu_pct);
+    reg->SetGauge(base + "utime_ms", r.utime_ms);
+    reg->SetGauge(base + "stime_ms", r.stime_ms);
+    keep.push_back(std::move(base));
+  }
+  // Dead threads' gauges die with them (the sync.peer.* discipline:
+  // bounded metric cardinality on a long-lived daemon).
+  reg->PruneGauges("thread.", keep);
+}
+
+ScopedThreadName::ScopedThreadName(const std::string& name)
+    : id_(ThreadRegistry::Global().Join(name)) {}
+
+ScopedThreadName::~ScopedThreadName() { ThreadRegistry::Global().Leave(id_); }
+
+}  // namespace fdfs

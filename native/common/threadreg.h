@@ -1,0 +1,116 @@
+// ThreadRegistry: the per-thread CPU ledger (OPERATIONS.md "Profiling &
+// the thread ledger").  Every daemon thread joins at spawn with a stable
+// name ("nio.loop/0", "dio.worker/2", "scrub", "sync.<peer>", ...); the
+// metrics tick samples each registered thread's utime/stime from
+// /proc/self/task/<tid>/stat (RUSAGE_THREAD fallback for the sampling
+// thread's own row when /proc is unavailable) and publishes
+//
+//   thread.<name>.cpu_pct    CPU share since the previous tick (percent)
+//   thread.<name>.utime_ms   cumulative user CPU, milliseconds
+//   thread.<name>.stime_ms   cumulative system CPU, milliseconds
+//
+// into the StatsRegistry — from where the metrics journal persists them,
+// so fdfs_report reconstructs per-thread CPU history across restarts and
+// fdfs_top's THREADS pane ranks the live values.
+//
+// Reference departure: upstream FastDFS has no introspection into its
+// thread model at all (storage_nio.c threads are anonymous); before
+// ROADMAP item 5 shards the event loop further, "the nio loop is the
+// ceiling" must be measurable per thread, not inferred from aggregate
+// loop-lag histograms.
+//
+// Concurrency: Join/Leave and the tick-time sample take mu_
+// (LockRank::kThreadRegistry, BEFORE kStatsRegistry: SampleInto copies
+// the table under mu_, releases, then writes gauges).  The registered
+// name is also mirrored into a thread_local buffer so the SIGPROF
+// handler (profiler.h) and the slow-request logger can read the CURRENT
+// thread's name with no lock at all.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "common/lockrank.h"
+
+namespace fdfs {
+
+class StatsRegistry;
+
+class ThreadRegistry {
+ public:
+  // The process-wide instance every daemon thread joins.  A plain
+  // function-local static: threads outlive no registry reads (Leave
+  // runs before thread exit via ScopedThreadName).
+  static ThreadRegistry& Global();
+
+  // Register the CALLING thread under `name`; returns a registration id
+  // for Leave.  Names should be stable across restarts (the ledger's
+  // journal identity); duplicates are legal (two "sync.<peer>" epochs)
+  // — the ledger keys gauges by name, so the LAST sampled duplicate
+  // wins for the tick.
+  int64_t Join(const std::string& name);
+  void Leave(int64_t id);
+
+  struct Entry {
+    std::string name;
+    int tid = 0;
+  };
+  std::vector<Entry> Entries() const;
+  size_t size() const;
+
+  // Sample every registered thread's CPU usage and publish the ledger
+  // gauges into `reg` (see header comment for names).  cpu_pct is the
+  // share of ONE core since this thread's previous sample; departed
+  // threads' gauges are pruned.  Call from the metrics tick (any one
+  // thread; per-slot delta state lives here).
+  void SampleInto(StatsRegistry* reg);
+
+ private:
+  struct Slot {
+    std::string name;
+    int tid = 0;
+    // Delta base for cpu_pct: previous sample's cumulative CPU ticks
+    // and its monotonic stamp.  0 stamp = never sampled (first tick
+    // reports cpu_pct 0 rather than a since-birth average).
+    int64_t last_cpu_ticks = 0;
+    int64_t last_sample_us = 0;
+  };
+
+  mutable RankedMutex mu_{LockRank::kThreadRegistry};
+  std::map<int64_t, Slot> slots_;
+  int64_t next_id_ = 1;
+};
+
+// RAII registration: declare on the thread's stack at entry —
+//   ScopedThreadName reg("dio.worker/2");
+// joins ThreadRegistry::Global() and mirrors the name into the
+// thread_local read by CurrentThreadName(); the destructor undoes both.
+class ScopedThreadName {
+ public:
+  explicit ScopedThreadName(const std::string& name);
+  ~ScopedThreadName();
+  ScopedThreadName(const ScopedThreadName&) = delete;
+  ScopedThreadName& operator=(const ScopedThreadName&) = delete;
+
+ private:
+  int64_t id_;
+};
+
+// The calling thread's registered name, "" when unregistered.  Plain
+// thread_local buffer read: safe from any context on the OWNING thread,
+// including the SIGPROF handler (no lock, no allocation).
+const char* CurrentThreadName();
+
+// This thread's kernel tid (cached gettid()).
+int CurrentTid();
+
+// Read a thread's cumulative CPU from /proc/self/task/<tid>/stat
+// (fields 14/15, clock ticks).  Falls back to RUSAGE_THREAD when the
+// tid is the calling thread and /proc is unavailable.  False when the
+// thread is gone.  Exposed for tests.
+bool ReadThreadCpuTicks(int tid, int64_t* utime_ticks, int64_t* stime_ticks);
+
+}  // namespace fdfs

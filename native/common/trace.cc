@@ -7,6 +7,7 @@
 
 #include "common/bytes.h"
 #include "common/protocol_gen.h"
+#include "common/threadreg.h"
 
 namespace fdfs {
 
@@ -117,17 +118,23 @@ std::string TraceRing::Json(const std::string& role, int port) const {
 std::string SlowRequestJson(const std::string& role, const char* op,
                             const TraceSpan& root, const std::string& peer,
                             int64_t bytes) {
-  char buf[384];
+  // Emitted on the handling thread, so the ledger name identifies WHICH
+  // nio loop / dio worker served the slow request — cross-reference
+  // against thread.<name>.cpu_pct to tell "this loop is saturated" from
+  // "this one request was slow".
+  const char* thread = CurrentThreadName();
+  char buf[448];
   std::snprintf(buf, sizeof(buf),
                 "{\"event\":\"slow_request\",\"role\":\"%s\",\"op\":\"%s\","
                 "\"trace_id\":\"%016llx\",\"span_id\":\"%08x\","
                 "\"start_us\":%lld,\"dur_us\":%lld,\"status\":%d,"
-                "\"peer\":\"%s\",\"bytes\":%lld}",
+                "\"peer\":\"%s\",\"bytes\":%lld,\"thread\":\"%s\"}",
                 role.c_str(), op,
                 static_cast<unsigned long long>(root.trace_id), root.span_id,
                 static_cast<long long>(root.start_us),
                 static_cast<long long>(root.dur_us), root.status,
-                peer.c_str(), static_cast<long long>(bytes));
+                peer.c_str(), static_cast<long long>(bytes),
+                thread[0] != '\0' ? thread : "unnamed");
   return buf;
 }
 

@@ -11,22 +11,35 @@
 #include <condition_variable>
 #include <deque>
 #include <functional>
+#include <memory>
 #include <mutex>
+#include <string>
 #include <thread>
 #include <vector>
 
 #include "common/net.h"  // MonoUs: the shared latency clock
 #include "common/lockrank.h"
 #include "common/stats.h"
+#include "common/threadreg.h"
 
 namespace fdfs {
 
 class WorkerPool {
  public:
-  explicit WorkerPool(int threads) {
+  // Workers join the thread ledger as "<name_prefix>/<name_base + i>"
+  // ("dio.worker/0", "dio.worker/1", ...); name_base lets a caller with
+  // several pools (one per store path) number them in one global
+  // sequence.  Empty prefix = unregistered (tools, tests).
+  explicit WorkerPool(int threads, const std::string& name_prefix = "",
+                      int name_base = 0) {
     if (threads < 1) threads = 1;
-    for (int i = 0; i < threads; ++i)
-      threads_.emplace_back([this] { Main(); });
+    for (int i = 0; i < threads; ++i) {
+      std::string name =
+          name_prefix.empty()
+              ? std::string()
+              : name_prefix + "/" + std::to_string(name_base + i);
+      threads_.emplace_back([this, name] { Main(name); });
+    }
   }
 
   ~WorkerPool() { Stop(); }
@@ -76,7 +89,12 @@ class WorkerPool {
     int64_t enqueue_us = 0;
   };
 
-  void Main() {
+  void Main(const std::string& ledger_name) {
+    // Optional because tools construct throwaway pools; the destructor
+    // must run before the thread exits, hence the stack scope here.
+    std::unique_ptr<ScopedThreadName> reg;
+    if (!ledger_name.empty())
+      reg = std::make_unique<ScopedThreadName>(ledger_name);
     for (;;) {
       Task task;
       StatHistogram* hw;

@@ -164,6 +164,15 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
       ini.GetSeconds("slo_eval_interval_s", slo_eval_interval_s));
   if (slo_eval_interval_s < 0) slo_eval_interval_s = 0;
   slo_rules_file = ini.GetStr("slo_rules_file", "");
+  profile_max_hz = static_cast<int>(
+      ini.GetInt("profile_max_hz", profile_max_hz));
+  if (profile_max_hz < 0) profile_max_hz = 0;
+  // ITIMER_PROF has ~1ms kernel granularity, so rates past 1000 Hz only
+  // add handler overhead without adding samples.
+  if (profile_max_hz > 1000) {
+    note("profile_max_hz clamped to 1000");
+    profile_max_hz = 1000;
+  }
   heat_top_k = static_cast<int>(ini.GetInt("heat_top_k", heat_top_k));
   if (heat_top_k < 0) heat_top_k = 0;
   // heat_top_k is the sketch's PER-STRIPE capacity, and a full stripe

@@ -119,6 +119,11 @@ struct StorageConfig {
   int slo_eval_interval_s = 5;
   std::string slo_rules_file;
   int heat_top_k = 32;
+  // Sampling-profiler ceiling (common/profiler.h; OPERATIONS.md
+  // "Profiling & the thread ledger"): the maximum PROFILE_CTL sampling
+  // rate this daemon will arm.  0 (the default) disables the profiler
+  // entirely — no signal handler, no slab, PROFILE_CTL answers ENOTSUP.
+  int profile_max_hz = 0;
   // Config values Load() silently clamped or corrected — surfaced as
   // "config.anomaly" flight-recorder events at startup so a daemon
   // running on not-what-the-operator-wrote config is diagnosable.

@@ -14,6 +14,7 @@
 #include "common/eventlog.h"
 #include "common/jumphash.h"
 #include "common/log.h"
+#include "common/threadreg.h"
 #include "common/net.h"
 #include "common/protocol_gen.h"
 #include "storage/binlog.h"
@@ -115,6 +116,7 @@ bool RebalanceManager::Stopped() {
 }
 
 void RebalanceManager::ThreadMain() {
+  ScopedThreadName ledger("rebalance");
   std::unique_lock<RankedMutex> lk(mu_);
   while (!stop_) {
     cv_.wait_for(lk,

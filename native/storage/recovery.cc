@@ -14,6 +14,7 @@
 #include "common/fileid.h"
 #include "common/fsutil.h"
 #include "common/log.h"
+#include "common/threadreg.h"
 #include "common/net.h"
 #include "common/protocol_gen.h"
 #include "storage/binlog.h"
@@ -177,6 +178,7 @@ std::vector<RecoveryManager::TrackerReply> RecoveryManager::TrackerRpcAll(
 }
 
 void RecoveryManager::ThreadMain() {
+  ScopedThreadName ledger("recovery");
   // Wait for the reporter to join a tracker and learn the peer list.
   std::vector<PeerInfo> peers;
   for (int i = 0; i < 300 && !stop_; ++i) {

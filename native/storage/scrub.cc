@@ -10,6 +10,7 @@
 #include "common/bytes.h"
 #include "common/eventlog.h"
 #include "common/log.h"
+#include "common/threadreg.h"
 #include "common/net.h"
 
 namespace fdfs {
@@ -109,6 +110,7 @@ int64_t ScrubManager::StatValue(int i) const {
 }
 
 void ScrubManager::ThreadMain() {
+  ScopedThreadName ledger("scrub");
   std::unique_lock<RankedMutex> lk(mu_);
   while (!stop_) {
     bool due;

@@ -16,7 +16,9 @@
 
 #include "common/fileid.h"
 #include "common/log.h"
+#include "common/profiler.h"
 #include "common/protocol_gen.h"
+#include "common/threadreg.h"
 
 namespace fdfs {
 
@@ -168,8 +170,9 @@ bool StorageServer::Init(std::string* error) {
     nio_.push_back(std::move(t));
   }
   for (int i = 0; i < store_.store_path_count(); ++i)
-    dio_pools_.push_back(
-        std::make_unique<WorkerPool>(cfg_.disk_writer_threads));
+    dio_pools_.push_back(std::make_unique<WorkerPool>(
+        cfg_.disk_writer_threads, "dio.worker",
+        i * cfg_.disk_writer_threads));
 
   // Trace ring before the registry (its gauges read the ring) and before
   // the sync/recovery subsystems (they record spans into it).
@@ -180,17 +183,28 @@ bool StorageServer::Init(std::string* error) {
   // beat callback only touch pre-registered atomic pointers.
   InitStatsRegistry();
 
+  // Profiler ceiling (0 keeps the feature entirely off: no handler, no
+  // slab); the singleton is process-global like SIGPROF itself.
+  Profiler::Global().set_max_hz(cfg_.profile_max_hz);
+
   // Saturation telemetry (ISSUE 6): every nio event loop observes its
   // per-iteration callback time into one shared loop-lag histogram (the
   // stall a slow handler inflicts on every other conn of its loop), and
   // the per-store-path dio pools observe queue wait + service time.
-  auto nio_hook = [this](int64_t busy_us, int n_events) {
-    hist_nio_lag_->Observe(busy_us);
-    if (n_events > 0)
-      ctr_nio_dispatched_->fetch_add(n_events, std::memory_order_relaxed);
+  // Each loop also accumulates its own busy time so the metrics tick
+  // can publish a per-loop duty cycle (nio.loop_busy_pct.<i>) — the
+  // signal the shared lag histogram cannot attribute to one loop.
+  auto make_hook = [this](std::atomic<int64_t>* busy) {
+    return [this, busy](int64_t busy_us, int n_events) {
+      hist_nio_lag_->Observe(busy_us);
+      busy->fetch_add(busy_us, std::memory_order_relaxed);
+      if (n_events > 0)
+        ctr_nio_dispatched_->fetch_add(n_events, std::memory_order_relaxed);
+    };
   };
-  loop_.set_iteration_hook(nio_hook);  // accept + timers loop
-  for (auto& t : nio_) t->loop->set_iteration_hook(nio_hook);
+  loop_.set_iteration_hook(make_hook(&main_loop_busy_us_));  // accept+timers
+  for (auto& t : nio_) t->loop->set_iteration_hook(make_hook(&t->busy_us));
+  loop_busy_last_.assign(nio_.size() + 1, 0);
   for (auto& pool : dio_pools_)
     pool->SetStats(hist_dio_wait_, hist_dio_service_);
 
@@ -511,9 +525,16 @@ bool StorageServer::Init(std::string* error) {
 void StorageServer::Run() {
   // nio work threads (reference: storage_nio.c one-epoll-per-thread).
   // Started here — after Init and any daemonize fork — and joined in
-  // Stop(); the main loop keeps accept + timers.
-  for (auto& t : nio_)
-    t->thread = std::thread([lp = t->loop.get()] { lp->Run(); });
+  // Stop(); the main loop keeps accept + timers.  Every loop thread
+  // joins the CPU ledger under its stable name (threadreg.h).
+  for (size_t i = 0; i < nio_.size(); ++i) {
+    EventLoop* lp = nio_[i]->loop.get();
+    nio_[i]->thread = std::thread([lp, i] {
+      ScopedThreadName ledger("nio.loop/" + std::to_string(i));
+      lp->Run();
+    });
+  }
+  ScopedThreadName ledger("main.loop");
   loop_.Run();
 }
 
@@ -632,6 +653,8 @@ constexpr ServedOp kServedOps[] = {
     {StorageCmd::kTrunkAllocSpace, "trunk_alloc_space"},
     {StorageCmd::kTrunkAllocConfirm, "trunk_alloc_confirm"},
     {StorageCmd::kTrunkFreeSpace, "trunk_free_space"},
+    {StorageCmd::kProfileCtl, "profile_ctl"},
+    {StorageCmd::kProfileDump, "profile_dump"},
 };
 
 }  // namespace
@@ -672,6 +695,16 @@ void StorageServer::InitStatsRegistry() {
   });
   registry_.GaugeFn("events.dropped", [this] {
     return events_ != nullptr ? events_->dropped() : int64_t{0};
+  });
+  // Sampling profiler health (profiler.h): capture counters while a
+  // window is armed, drop pressure when the slab overflows, and the
+  // armed flag operators alert on (a profiler left running is overhead).
+  registry_.GaugeFn("profile.samples",
+                    [] { return Profiler::Global().samples(); });
+  registry_.GaugeFn("profile.dropped",
+                    [] { return Profiler::Global().dropped(); });
+  registry_.GaugeFn("profile.active", [] {
+    return static_cast<int64_t>(Profiler::Global().active() ? 1 : 0);
   });
   // SLO engine: how many rules are red right now (the one-read health
   // check fdfs_top's ALERTS line and scrapers key off).
@@ -939,9 +972,32 @@ void StorageServer::MetricsTick() {
   // breach from the retained history.
   RefreshDiskUsedPct();
   RefreshPeerGauges();
+  int64_t now_mono = MonoUs();
+  // Per-thread CPU ledger: one /proc pass per tick, published as
+  // thread.<name>.* gauges so the journal snapshot below persists them.
+  ThreadRegistry::Global().SampleInto(&registry_);
+  // Per-loop duty cycle: busy-us delta over the tick's wall time.
+  // Index 0 = the accept/timers loop, 1 + i = nio_[i].
+  if (loop_busy_last_.size() == nio_.size() + 1) {
+    int64_t dwall = now_mono - last_tick_mono_us_;
+    bool have_base = last_tick_mono_us_ > 0 && dwall > 0;
+    for (size_t i = 0; i < loop_busy_last_.size(); ++i) {
+      int64_t busy = i == 0 ? main_loop_busy_us_.load(std::memory_order_relaxed)
+                            : nio_[i - 1]->busy_us.load(std::memory_order_relaxed);
+      if (have_base) {
+        int64_t pct = (busy - loop_busy_last_[i]) * 100 / dwall;
+        if (pct < 0) pct = 0;
+        if (pct > 100) pct = 100;
+        registry_.SetGauge(
+            i == 0 ? "nio.loop_busy_pct.main"
+                   : "nio.loop_busy_pct." + std::to_string(i - 1),
+            pct);
+      }
+      loop_busy_last_[i] = busy;  // first tick seeds the delta base
+    }
+  }
   StatsSnapshot snap;
   registry_.Snapshot(&snap);
-  int64_t now_mono = MonoUs();
   if (metrics_ != nullptr) metrics_->Append(TraceWallUs(), snap);
   if (slo_ != nullptr && have_tick_snap_) {
     double dt_s = static_cast<double>(now_mono - last_tick_mono_us_) / 1e6;
@@ -1858,6 +1914,39 @@ void StorageServer::OnHeaderComplete(Conn* c) {
       c->fixed_need = 8;
       c->state = ConnState::kRecvFixed;
       return;
+    case StorageCmd::kProfileCtl:
+      // Profiler control: 17B fixed body = 1B action (1=start, 0=stop)
+      // + 8B BE hz + 8B BE duration seconds (protocol.py PROFILE_CTL).
+      if (c->pkg_len != 17) {
+        CloseConn(c);
+        return;
+      }
+      c->fixed_need = 17;
+      c->state = ConnState::kRecvFixed;
+      return;
+    case StorageCmd::kProfileDump:
+      // Folded-stack dump: empty body -> JSON (monitor.decode_profile;
+      // fdfs_codec profile-json golden).  Aggregation + symbolization
+      // walk the whole slab and malloc per frame, so run on the dio
+      // pool, not this nio loop (the metrics-history discipline).
+      // ENOTSUP while no capture was ever started.
+      if (c->pkg_len != 0) {
+        CloseConn(c);
+        return;
+      }
+      if (!Profiler::Global().ever_started()) {
+        RespondError(c, 95 /*ENOTSUP*/);
+        return;
+      }
+      OffloadToDio(c, 0, [this, c] {
+        std::string j;
+        int rc = Profiler::Global().DumpJson("storage", cfg_.port, &j);
+        if (rc != 0)
+          RespondError(c, static_cast<uint8_t>(rc));
+        else
+          Respond(c, 0, j);
+      });
+      return;
     case StorageCmd::kScrubStatus: {
       // Integrity-engine status: empty body -> kScrubStatCount BE int64
       // slots (kScrubStatNames).  Atomics + per-store gauge reads only,
@@ -2043,6 +2132,36 @@ void StorageServer::OnFixedComplete(Conn* c) {
       if (k <= 0 || k > 65536) k = cfg_.heat_top_k;
       Respond(c, 0, heat_->TopJson("storage", cfg_.port,
                                    static_cast<int>(k)));
+      return;
+    }
+    case StorageCmd::kProfileCtl: {
+      const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+      uint8_t action = p[0];
+      int64_t hz = GetInt64BE(p + 1);
+      int64_t secs = GetInt64BE(p + 9);
+      int rc;
+      if (action == 1) {
+        // Range guard before the int narrowing; Start clamps to
+        // profile_max_hz / kMaxDurationS on top of this.
+        if (hz <= 0 || hz > 100000 || secs <= 0 || secs > 86400)
+          rc = 22;
+        else
+          rc = Profiler::Global().Start(static_cast<int>(hz),
+                                        static_cast<int>(secs));
+      } else if (action == 0) {
+        rc = Profiler::Global().Stop();
+      } else {
+        rc = 22;
+      }
+      if (rc != 0) {
+        RespondError(c, static_cast<uint8_t>(rc));
+        return;
+      }
+      // Ack with what actually took effect (hz may have been clamped).
+      Profiler& prof = Profiler::Global();
+      Respond(c, 0,
+              std::string("{\"active\":") + (prof.active() ? "true" : "false") +
+                  ",\"hz\":" + std::to_string(prof.armed_hz()) + "}");
       return;
     }
     case StorageCmd::kUploadFile:

@@ -258,6 +258,10 @@ class StorageServer {
     std::thread thread;
     std::unordered_map<int, std::unique_ptr<Conn>> conns;  // loop-thread only
     std::vector<std::unique_ptr<Conn>> zombies;            // await dio done
+    // Cumulative handler time, fed by this loop's iteration hook and
+    // read by the metrics tick for nio.loop_busy_pct.<i> (the per-loop
+    // duty cycle the shared loop-lag histogram cannot attribute).
+    std::atomic<int64_t> busy_us{0};
   };
   // Honest divergence from the reference's fast_task_queue.c pooled-task
   // buffers: each Conn owns its recv/send std::strings, which retain
@@ -539,6 +543,12 @@ class StorageServer {
   StatsSnapshot last_tick_snap_;
   bool have_tick_snap_ = false;
   int64_t last_tick_mono_us_ = 0;
+  // Per-loop duty cycle (nio.loop_busy_pct.*): the accept/timers loop's
+  // busy accumulator plus per-tick deltas for it and every nio loop
+  // (main-loop only, like last_tick_snap_).  Index 0 = the main loop,
+  // 1 + i = nio_[i].
+  std::atomic<int64_t> main_loop_busy_us_{0};
+  std::vector<int64_t> loop_busy_last_;
   // Saturation telemetry handles (nio loop lag / dio queue health),
   // pre-registered so the per-iteration hook touches only atomics.
   StatHistogram* hist_nio_lag_ = nullptr;

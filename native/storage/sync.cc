@@ -11,6 +11,7 @@
 #include "common/bytes.h"
 #include "common/eventlog.h"
 #include "common/log.h"
+#include "common/threadreg.h"
 #include "common/net.h"
 #include "common/protocol_gen.h"
 
@@ -131,6 +132,7 @@ std::vector<SyncPeerState> SyncManager::States() const {
 }
 
 void SyncManager::WorkerMain(Worker* w) {
+  ScopedThreadName ledger("sync." + w->ip);
   const std::string mark_path =
       sync_dir_ + "/" + w->ip + "_" + std::to_string(w->port) + ".mark";
   BinlogReader reader;

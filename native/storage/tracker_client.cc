@@ -10,6 +10,7 @@
 
 #include "common/bytes.h"
 #include "common/log.h"
+#include "common/threadreg.h"
 #include "common/net.h"
 #include "common/protocol_gen.h"
 
@@ -431,6 +432,7 @@ bool TrackerReporter::DoDiskReport(int fd) {
 }
 
 void TrackerReporter::ThreadMain(std::string host, int port) {
+  ScopedThreadName ledger("reporter." + host);
   int fd = -1;
   bool joined = false;
   int64_t last_beat = 0, last_disk = 0;

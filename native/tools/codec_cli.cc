@@ -45,6 +45,22 @@
 //   fdfs_codec group-admin     (golden GROUP_DRAIN / GROUP_REACTIVATE
 //                bodies: the 16-byte group-name request and the 8-byte
 //                new-version response as hex)
+//   fdfs_codec profile-ctl     (golden PROFILE_CTL bodies: the 17-byte
+//                start(hz,duration) and stop requests as hex, plus the
+//                ack JSON — pins the control wire layout against
+//                fastdfs_tpu.common.protocol's packers)
+//   fdfs_codec profile-json    (golden PROFILE_DUMP body: a fixture
+//                folded-stack row set through the daemon's real JSON
+//                emitter (common/profiler.h ProfileJson) — compared
+//                field-for-field against
+//                fastdfs_tpu.monitor.decode_profile/render_folded)
+//   fdfs_codec thread-ledger   (golden per-thread CPU ledger gauge
+//                naming: two fixture threads join the registry, one
+//                SampleInto pass, and the resulting thread.* gauge
+//                keys print sorted; after both leave, a second pass
+//                must prune every row — pins the thread.<name>.cpu_pct
+//                /utime_ms/stime_ms contract the journal and fdfs_top
+//                THREADS pane key on)
 //   fdfs_codec slab-layout     (golden slab record + slot-index
 //                encoding: one fixture chunk record and one recipe
 //                record emitted as hex, then re-scanned with the boot
@@ -53,10 +69,12 @@
 //                parser in tests/harness.py / tests/test_slab.py)
 #include <time.h>
 
+#include <atomic>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "common/bytes.h"
@@ -67,7 +85,9 @@
 #include "common/http_token.h"
 #include "common/ini.h"
 #include "common/metrog.h"
+#include "common/profiler.h"
 #include "common/protocol_gen.h"
+#include "common/threadreg.h"
 #include "common/sloeval.h"
 #include "common/stats.h"
 #include "common/jumphash.h"
@@ -534,6 +554,90 @@ int main(int argc, char** argv) {
     for (const SloRule& r : SloEvaluator::LoadRules(ini))
       printf("%s %.6g %.6g %d\n", r.name.c_str(), r.threshold, r.clear,
              r.enabled ? 1 : 0);
+    return 0;
+  }
+  if (cmd == "profile-ctl") {
+    // PROFILE_CTL wire bodies (protocol.py): 1B action + 8B BE hz +
+    // 8B BE duration seconds.  tests/test_profile.py builds the same
+    // bytes with the Python packer and compares hex for hex.
+    auto hex = [](const std::string& s) {
+      static const char* k = "0123456789abcdef";
+      std::string out;
+      for (unsigned char c : s) {
+        out.push_back(k[c >> 4]);
+        out.push_back(k[c & 0xF]);
+      }
+      return out;
+    };
+    auto body = [](uint8_t action, int64_t hz, int64_t secs) {
+      std::string b(1, static_cast<char>(action));
+      uint8_t num[8];
+      PutInt64BE(hz, num);
+      b.append(reinterpret_cast<char*>(num), 8);
+      PutInt64BE(secs, num);
+      b.append(reinterpret_cast<char*>(num), 8);
+      return b;
+    };
+    printf("start_request=%s\n", hex(body(1, 97, 5)).c_str());
+    printf("stop_request=%s\n", hex(body(0, 0, 0)).c_str());
+    printf("ack=%s\n", "{\"active\":true,\"hz\":97}");
+    return 0;
+  }
+  if (cmd == "profile-json") {
+    // Fixture folded stacks through the daemon's REAL dump emitter —
+    // tests/test_profile.py decodes with monitor.decode_profile and
+    // asserts every field plus the render_folded flamegraph lines.
+    std::vector<FoldedStack> rows;
+    rows.push_back({"nio.loop/0;EventLoop::Run;epoll_wait", 41});
+    rows.push_back({"dio.worker/1;WorkerPool::Main;pwrite64", 17});
+    rows.push_back({"dio.worker/0;WorkerPool::Main;ChunkStore::Put;fdfs::Sha1",
+                    17});
+    // Escaping coverage: a hostile frame must stay valid JSON.
+    rows.push_back({"scrub;frame\"with\\escapes", 2});
+    printf("%s\n", ProfileJson("storage", 23000, false, 97, 5, 77, 3, 1234,
+                               std::move(rows))
+                       .c_str());
+    return 0;
+  }
+  if (cmd == "thread-ledger") {
+    // Ledger gauge-naming golden: two named fixture threads join, one
+    // sample pass publishes their rows, and after both leave a second
+    // pass must prune them.  Values are timing-dependent, so the golden
+    // pins NAMES (the journal/fdfs_top contract), not numbers.
+    StatsRegistry reg;
+    std::atomic<bool> stop{false};
+    std::atomic<int> ready{0};
+    auto worker = [&](const char* name) {
+      ScopedThreadName ledger(name);
+      ready.fetch_add(1);
+      while (!stop.load()) {
+      }
+    };
+    std::thread t1(worker, "nio.loop/0");
+    std::thread t2(worker, "dio.worker/1");
+    while (ready.load() < 2) {
+    }
+    ThreadRegistry::Global().SampleInto(&reg);
+    StatsSnapshot snap;
+    reg.Snapshot(&snap);
+    std::string keys;
+    for (const auto& [name, v] : snap.gauges) {
+      if (name.rfind("thread.", 0) != 0) continue;
+      if (!keys.empty()) keys += ',';
+      keys += name;
+    }
+    printf("gauges=%s\n", keys.c_str());
+    stop.store(true);
+    t1.join();
+    t2.join();
+    ThreadRegistry::Global().SampleInto(&reg);
+    StatsSnapshot after;
+    reg.Snapshot(&after);
+    int left = 0;
+    for (const auto& [name, v] : after.gauges)
+      if (name.rfind("thread.", 0) == 0) ++left;
+    printf("after_leave=%d\n", left);
+    printf("registered_while_live=%d\n", 2);
     return 0;
   }
   if (cmd == "slab-layout") {

@@ -88,6 +88,10 @@ int main(int argc, char** argv) {
       ini.GetSeconds("slo_eval_interval_s", cfg.slo_eval_interval_s));
   if (cfg.slo_eval_interval_s < 0) cfg.slo_eval_interval_s = 0;
   cfg.slo_rules_file = ini.GetStr("slo_rules_file", "");
+  cfg.profile_max_hz = static_cast<int>(
+      ini.GetInt("profile_max_hz", cfg.profile_max_hz));
+  if (cfg.profile_max_hz < 0) cfg.profile_max_hz = 0;
+  if (cfg.profile_max_hz > 1000) cfg.profile_max_hz = 1000;  // ~1ms timer floor
   if (cfg.base_path.empty()) {
     std::fprintf(stderr, "config error: base_path is required\n");
     return 1;

@@ -7,6 +7,7 @@
 
 #include "common/bytes.h"
 #include "common/log.h"
+#include "common/threadreg.h"
 #include "common/net.h"
 #include "common/protocol_gen.h"
 
@@ -210,6 +211,7 @@ void RelationshipManager::RunElection() {
 }
 
 void RelationshipManager::ThreadMain() {
+  ScopedThreadName ledger("relationship");
   while (!stop_) {
     std::string leader = leader_addr();
     if (leader.empty()) {

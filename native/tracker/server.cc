@@ -6,6 +6,8 @@
 
 #include "common/bytes.h"
 #include "common/log.h"
+#include "common/profiler.h"
+#include "common/threadreg.h"
 #include "common/protocol_gen.h"
 #include "common/fsutil.h"
 
@@ -92,6 +94,8 @@ const char* TrackerOpName(uint8_t cmd) {
     case TrackerCmd::kQueryPlacement: return "tracker.query_placement";
     case TrackerCmd::kGroupDrain: return "tracker.group_drain";
     case TrackerCmd::kGroupReactivate: return "tracker.group_reactivate";
+    case TrackerCmd::kProfileCtl: return "tracker.profile_ctl";
+    case TrackerCmd::kProfileDump: return "tracker.profile_dump";
     default: return nullptr;
   }
 }
@@ -189,8 +193,19 @@ bool TrackerServer::Init(std::string* error) {
   });
   loop_.set_iteration_hook([this](int64_t busy_us, int n_events) {
     hist_nio_lag_->Observe(busy_us);
+    loop_busy_us_.fetch_add(busy_us, std::memory_order_relaxed);
     if (n_events > 0)
       ctr_nio_dispatched_->fetch_add(n_events, std::memory_order_relaxed);
+  });
+  // Profiler ceiling (0 keeps the feature entirely off) + health gauges,
+  // same names as the storage daemon so fdfs_top reads one contract.
+  Profiler::Global().set_max_hz(cfg_.profile_max_hz);
+  registry_.GaugeFn("profile.samples",
+                    [] { return Profiler::Global().samples(); });
+  registry_.GaugeFn("profile.dropped",
+                    [] { return Profiler::Global().dropped(); });
+  registry_.GaugeFn("profile.active", [] {
+    return static_cast<int64_t>(Profiler::Global().active() ? 1 : 0);
   });
   if (cfg_.use_storage_id && !cfg_.storage_ids_file.empty()) {
     // storage_ids.conf: "<id> <group> <ip>" per line (fdfs_shared_func.c:
@@ -337,14 +352,29 @@ bool TrackerServer::Init(std::string* error) {
   return true;
 }
 
-void TrackerServer::Run() { loop_.Run(); }
+void TrackerServer::Run() {
+  // The tracker is one event loop; its ledger row is the whole daemon.
+  ScopedThreadName ledger("tracker.loop");
+  loop_.Run();
+}
 
 void TrackerServer::MetricsTick() {
   // One snapshot feeds both consumers (journal + SLO engine), so a
   // post-mortem can re-derive every breach from the retained history.
+  int64_t now_mono = MonoUs();
+  // Per-thread CPU ledger (threadreg.h): published before the snapshot
+  // below so the journal persists this tick's thread.* gauges.
+  ThreadRegistry::Global().SampleInto(&registry_);
+  int64_t busy = loop_busy_us_.load(std::memory_order_relaxed);
+  if (last_tick_mono_us_ > 0 && now_mono > last_tick_mono_us_) {
+    int64_t pct = (busy - loop_busy_last_) * 100 / (now_mono - last_tick_mono_us_);
+    if (pct < 0) pct = 0;
+    if (pct > 100) pct = 100;
+    registry_.SetGauge("nio.loop_busy_pct.main", pct);
+  }
+  loop_busy_last_ = busy;
   StatsSnapshot snap;
   registry_.Snapshot(&snap);
-  int64_t now_mono = MonoUs();
   if (metrics_ != nullptr) metrics_->Append(TraceWallUs(), snap);
   if (slo_ != nullptr && have_tick_snap_) {
     double dt_s = static_cast<double>(now_mono - last_tick_mono_us_) / 1e6;
@@ -856,6 +886,44 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       int64_t since = body.size() == 8 ? GetInt64BE(p) : 0;
       return {0, metrics_->DumpJson("tracker", cfg_.port,
                                     since < 0 ? 0 : since)};
+    }
+
+    case TrackerCmd::kProfileCtl: {
+      // Profiler control: 17B body = 1B action (1=start, 0=stop) + 8B BE
+      // hz + 8B BE duration seconds (protocol.py PROFILE_CTL).
+      if (body.size() != 17) return {22 /*EINVAL*/, ""};
+      uint8_t action = p[0];
+      int64_t hz = GetInt64BE(p + 1);
+      int64_t secs = GetInt64BE(p + 9);
+      int rc;
+      if (action == 1) {
+        if (hz <= 0 || hz > 100000 || secs <= 0 || secs > 86400)
+          rc = 22;
+        else
+          rc = Profiler::Global().Start(static_cast<int>(hz),
+                                        static_cast<int>(secs));
+      } else if (action == 0) {
+        rc = Profiler::Global().Stop();
+      } else {
+        rc = 22;
+      }
+      if (rc != 0) return {static_cast<uint8_t>(rc), ""};
+      Profiler& prof = Profiler::Global();
+      return {0, std::string("{\"active\":") +
+                     (prof.active() ? "true" : "false") +
+                     ",\"hz\":" + std::to_string(prof.armed_hz()) + "}"};
+    }
+
+    case TrackerCmd::kProfileDump: {
+      // Folded-stack dump (empty body -> JSON, monitor.decode_profile).
+      // Symbolization is bounded by unique pcs, so inline on this loop
+      // is acceptable — the kMetricsHistory precedent.  ENOTSUP while a
+      // capture was never started.
+      if (!body.empty()) return {22 /*EINVAL*/, ""};
+      std::string j;
+      int rc = Profiler::Global().DumpJson("tracker", cfg_.port, &j);
+      if (rc != 0) return {static_cast<uint8_t>(rc), ""};
+      return {0, j};
     }
 
     case TrackerCmd::kServerClusterStat: {

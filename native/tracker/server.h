@@ -78,6 +78,10 @@ struct TrackerConfig {
   int metrics_journal_mb = 4;
   int slo_eval_interval_s = 5;
   std::string slo_rules_file;
+  // Sampling-profiler ceiling (common/profiler.h): maximum PROFILE_CTL
+  // rate this daemon will arm.  0 (default) = profiler entirely off
+  // (no signal handler, no slab; PROFILE_CTL answers ENOTSUP).
+  int profile_max_hz = 0;
 };
 
 class TrackerServer {
@@ -130,6 +134,10 @@ class TrackerServer {
   bool have_tick_snap_ = false;
   int64_t last_tick_mono_us_ = 0;
   void MetricsTick();
+  // Loop duty cycle (nio.loop_busy_pct.main): the iteration hook
+  // accumulates busy time, the tick publishes the per-tick delta.
+  std::atomic<int64_t> loop_busy_us_{0};
+  int64_t loop_busy_last_ = 0;
   StatHistogram* hist_nio_lag_ = nullptr;
   std::atomic<int64_t>* ctr_nio_dispatched_ = nullptr;
   std::atomic<int64_t>* ctr_requests_ = nullptr;

@@ -1,0 +1,155 @@
+"""FdfsClient.stats(): the client-side fallback counters.
+
+Every resilience path in the client is transparent — the call still
+succeeds — so these counters are the ONLY place their frequency shows.
+Each test drives exactly one fallback with monkeypatched internals (no
+daemons): dedup upload -> plain, placement shortcut -> tracker hop,
+parallel ranged download -> single stream.
+"""
+
+from fastdfs_tpu.client.client import FdfsClient
+from fastdfs_tpu.client.conn import StatusError
+from fastdfs_tpu.client.tracker_client import StoreTarget
+
+
+def _client(**kw) -> FdfsClient:
+    # Nothing here may touch the network; use_pool off keeps teardown
+    # trivial and any accidental connect fails fast.
+    return FdfsClient("127.0.0.1:1", timeout=0.1, use_pool=False, **kw)
+
+
+def test_stats_starts_zero_and_copies():
+    c = _client()
+    s = c.stats()
+    assert s == {"dedup_fallback_plain": 0,
+                 "placement_fallback_tracker": 0,
+                 "ranged_fallback_single": 0}
+    s["dedup_fallback_plain"] = 99  # a snapshot, not the live dict
+    assert c.stats()["dedup_fallback_plain"] == 0
+
+
+def test_dedup_small_payload_counts_plain_fallback(monkeypatch):
+    c = _client(dedup_uploads=True, dedup_min_bytes=1024)
+    monkeypatch.setattr(
+        c, "_upload_buffer_plain",
+        lambda data, ext="", group=None, appender=False, key=None: "g/p")
+    stats: dict = {}
+    assert c.upload_buffer_dedup(b"tiny", stats=stats) == "g/p"
+    assert stats["fallback"] == "small"
+    assert c.stats()["dedup_fallback_plain"] == 1
+
+
+def test_dedup_low_estimate_counts_plain_fallback(monkeypatch):
+    c = _client(dedup_uploads=True, dedup_min_bytes=8, dedup_min_ratio=0.5)
+    monkeypatch.setattr(
+        c, "_upload_buffer_plain",
+        lambda data, ext="", group=None, appender=False, key=None: "g/p")
+    # A cold digest cache means the estimated dup ratio is 0 < 0.5.
+    stats: dict = {}
+    assert c.upload_buffer_dedup(b"x" * 4096, stats=stats) == "g/p"
+    assert stats["fallback"] == "low_estimate"
+    assert c.stats()["dedup_fallback_plain"] == 1
+
+
+def test_dedup_storage_level_fallback_counts(monkeypatch):
+    # The StorageClient session can itself bail to plain (daemon lacks
+    # the opcodes / chunk store); it reports through the stats dict and
+    # must land in the SAME counter.
+    c = _client(dedup_uploads=True, dedup_min_bytes=8, dedup_min_ratio=0)
+    tgt = StoreTarget(group="g1", ip="127.0.0.1", port=2,
+                      store_path_index=0)
+    monkeypatch.setattr(c, "_with_tracker", lambda fn: tgt)
+
+    class FakeStorage:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def upload_buffer_dedup(self, data, ext="", store_path_index=0,
+                                chunks=None, stats=None):
+            stats.update(fallback="status95", bytes_sent=len(data))
+            return "g1/plain"
+
+    monkeypatch.setattr(c, "_storage", lambda tgt: FakeStorage())
+    stats: dict = {}
+    assert c.upload_buffer_dedup(b"x" * 4096, stats=stats) == "g1/plain"
+    assert c.stats()["dedup_fallback_plain"] == 1
+
+
+def test_dedup_negotiated_success_counts_nothing(monkeypatch):
+    c = _client(dedup_uploads=True, dedup_min_bytes=8, dedup_min_ratio=0)
+    tgt = StoreTarget(group="g1", ip="127.0.0.1", port=2,
+                      store_path_index=0)
+    monkeypatch.setattr(c, "_with_tracker", lambda fn: tgt)
+
+    class FakeStorage:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def upload_buffer_dedup(self, data, ext="", store_path_index=0,
+                                chunks=None, stats=None):
+            stats.update(fallback="", bytes_sent=0)
+            return "g1/dedup"
+
+    monkeypatch.setattr(c, "_storage", lambda tgt: FakeStorage())
+    assert c.upload_buffer_dedup(b"x" * 4096) == "g1/dedup"
+    assert c.stats()["dedup_fallback_plain"] == 0
+
+
+def test_placement_route_failure_counts_tracker_fallback(monkeypatch):
+    c = _client(use_placement=True)
+    route = StoreTarget(group="g1", ip="127.0.0.1", port=2,
+                        store_path_index=0xFF)
+    monkeypatch.setattr(c, "_placement_route", lambda key: route)
+    tracker_tgt = StoreTarget(group="g1", ip="127.0.0.1", port=3,
+                              store_path_index=0)
+    monkeypatch.setattr(c, "_with_tracker", lambda fn: tracker_tgt)
+
+    class Storage:
+        def __init__(self, port):
+            self.port = port
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def upload_buffer(self, data, ext="", store_path_index=0,
+                          appender=False):
+            if self.port == 2:  # the placement-routed member is gone
+                raise StatusError("upload_file", 16)
+            return "g1/via-tracker"
+
+    monkeypatch.setattr(c, "_storage", lambda tgt: Storage(tgt.port))
+    assert c._upload_buffer_plain(b"data", key="k") == "g1/via-tracker"
+    assert c.stats()["placement_fallback_tracker"] == 1
+    assert c._placement is None  # the stale epoch cache was dropped
+
+
+def test_ranged_failure_counts_single_fallback(monkeypatch):
+    c = _client(parallel_downloads=4)
+
+    def boom(fn):
+        raise ConnectionError("no tracker")
+
+    monkeypatch.setattr(c, "_with_tracker", boom)
+    monkeypatch.setattr(c, "_download_single",
+                        lambda file_id, offset=0, length=0: b"whole")
+    assert c.download_ranged("g1/x", parallel=4) == b"whole"
+    assert c.stats()["ranged_fallback_single"] == 1
+
+
+def test_ranged_single_range_is_not_a_fallback(monkeypatch):
+    # Degenerate splits (parallel <= 1) take the single stream BY
+    # DESIGN, not as a failure — they must not pollute the counter.
+    c = _client()
+    monkeypatch.setattr(c, "_download_single",
+                        lambda file_id, offset=0, length=0: b"whole")
+    assert c.download_ranged("g1/x", parallel=1) == b"whole"
+    assert c.stats()["ranged_fallback_single"] == 0

@@ -433,7 +433,7 @@ def test_manifest_golden_names_resolve():
     assert goldens == {"stats-json", "trace-json", "trace-ctx",
                        "event-json", "scrub-status", "ingest-wire",
                        "metrics-history", "heat-top", "placement-wire",
-                       "group-admin"}
+                       "group-admin", "profile-ctl", "profile-json"}
 
 
 if __name__ == "__main__":

@@ -1,0 +1,437 @@
+"""In-daemon sampling profiler + per-thread CPU ledger (ISSUE 15).
+
+Layers:
+- pure-Python contract tests: PROFILE_CTL body packing, PROFILE_DUMP
+  decoding (monitor.decode_profile), folded-stack rendering, and the
+  thread.* gauge-name parsing behind fdfs_top's THREADS pane;
+- cross-language goldens: `fdfs_codec profile-ctl` (the 17-byte CTL
+  body and its ack), `fdfs_codec profile-json` (the dump JSON emitter
+  vs decode_profile), `fdfs_codec thread-ledger` (the gauge naming
+  scheme monitor.thread_ledger parses back apart);
+- live acceptance on a 1-tracker/1-storage cluster: a capture armed
+  under upload load names hot frames in LEDGER-NAMED threads, the
+  per-thread CPU ledger shows up in STAT and in the metrics journal,
+  profile_max_hz = 0 means ENOTSUP and zero gauges (the zero-cost-off
+  proof), and the tracker's CTL/DUMP pair round-trips too.
+
+Runs under TSan + FDFS_LOCKRANK via tools/run_sanitizers.sh (the
+async-signal-safety hammer itself is native: common_test's
+TestProfilerCtlHammerAgainstLiveThreads).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import threading
+import time
+
+import pytest
+
+from fastdfs_tpu import monitor as M
+from fastdfs_tpu.common import protocol as P
+from fastdfs_tpu.common.protocol import pack_profile_ctl
+from tests.harness import (BUILD, STORAGED, TRACKERD, free_port,
+                           start_storage, start_tracker, upload_retry)
+
+_HAVE_TOOLCHAIN = ((shutil.which("cmake") is not None
+                    and shutil.which("ninja") is not None)
+                   or shutil.which("g++") is not None)
+_HAVE_BINARIES = os.path.exists(STORAGED) and os.path.exists(TRACKERD)
+needs_native = pytest.mark.skipif(
+    not (_HAVE_TOOLCHAIN or _HAVE_BINARIES),
+    reason="no native toolchain and no prebuilt daemons")
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+# 1 s metrics ticks so the ledger gauges appear fast; journal on so the
+# ledger's journal leg is checkable; profiling armed via a generous cap.
+PROF = (HB + "\nslo_eval_interval_s = 1\nmetrics_journal_mb = 4"
+        + "\nprofile_max_hz = 250")
+
+# Thread names the storage daemon's ledger registers (threadreg.h): a
+# captured stack's thread must be one of these (prefix match covers the
+# indexed/peer-suffixed families).
+LEDGER_PREFIXES = ("main.loop", "nio.loop/", "dio.worker/", "scrub",
+                   "rebalance", "recovery", "sync.", "reporter.",
+                   "unnamed")
+
+
+def _wait(cond, timeout=30, interval=0.25):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(interval)
+    return cond()
+
+
+def _codec(*args):
+    exe = os.path.join(BUILD, "fdfs_codec")
+    if not os.path.exists(exe):
+        from tests.harness import ensure_native_built
+        ensure_native_built((exe,))
+    out = subprocess.run([exe, *args], capture_output=True, timeout=60)
+    assert out.returncode == 0, out.stderr.decode()
+    return out.stdout.decode()
+
+
+# ---------------------------------------------------------------------------
+# wire contract (pure Python)
+# ---------------------------------------------------------------------------
+
+def test_profile_opcodes():
+    assert P.StorageCmd.PROFILE_CTL == 141
+    assert P.StorageCmd.PROFILE_DUMP == 142
+    # The tracker pair lives at 67/68 (100/101 are upstream-fixed RESP /
+    # SERVICE_QUERY_STORE_WITHOUT_GROUP_ONE — see protocol.py).
+    assert P.TrackerCmd.PROFILE_CTL == 67
+    assert P.TrackerCmd.PROFILE_DUMP == 68
+
+
+def test_pack_profile_ctl_golden_bytes():
+    assert P.PROFILE_CTL_LEN == 17
+    start = pack_profile_ctl(True, 97, 5)
+    assert len(start) == 17
+    assert start.hex() == "0100000000000000610000000000000005"
+    stop = pack_profile_ctl(False)
+    assert len(stop) == 17
+    assert stop == b"\x00" * 17
+
+
+def _dump_fixture() -> dict:
+    return {
+        "role": "storage", "port": 23000, "active": False, "hz": 97,
+        "duration_s": 5, "samples": 77, "dropped": 3,
+        "overhead_us": 1234, "max_frames": 30,
+        "stacks": [
+            {"stack": "nio.loop/0;EventLoop::Run;epoll_wait", "count": 41},
+            {"stack": "dio.worker/1;WorkerPool::Main;pwrite64",
+             "count": 17},
+            {"stack": "scrub;fdfs::Sha1", "count": 2},
+        ],
+    }
+
+
+def test_decode_profile_roundtrip():
+    d = M.decode_profile(_dump_fixture())
+    assert (d.role, d.port, d.active) == ("storage", 23000, False)
+    assert (d.hz, d.duration_s) == (97, 5)
+    assert (d.samples, d.dropped, d.overhead_us) == (77, 3, 1234)
+    assert d.max_frames == 30
+    assert [s.count for s in d.stacks] == [41, 17, 2]
+    assert d.stacks[0].thread == "nio.loop/0"
+    assert d.stacks[1].thread == "dio.worker/1"
+
+
+def test_decode_profile_ignores_unknown_keys():
+    obj = _dump_fixture()
+    obj["future_field"] = {"x": 1}  # append-only wire contract
+    obj["stacks"][0]["future"] = 9
+    assert M.decode_profile(obj).samples == 77
+
+
+def test_decode_profile_validation():
+    with pytest.raises(ValueError):
+        M.decode_profile({"role": "storage"})  # no stacks list
+    with pytest.raises(ValueError):
+        M.decode_profile({"stacks": [{"count": 1}]})  # stack missing
+    bad = _dump_fixture()
+    del bad["hz"]
+    with pytest.raises(ValueError):
+        M.decode_profile(bad)
+    unsorted = _dump_fixture()
+    unsorted["stacks"] = list(reversed(unsorted["stacks"]))
+    with pytest.raises(ValueError):
+        M.decode_profile(unsorted)
+
+
+def test_render_folded():
+    d = M.decode_profile(_dump_fixture())
+    lines = M.render_folded(d).splitlines()
+    assert lines[0] == "nio.loop/0;EventLoop::Run;epoll_wait 41"
+    assert lines[-1] == "scrub;fdfs::Sha1 2"
+    # flamegraph.pl's input grammar: everything before the last space is
+    # the semicolon-joined stack, the last token the count.
+    for ln in lines:
+        stack, _, count = ln.rpartition(" ")
+        assert stack and int(count) > 0
+
+
+def test_thread_ledger_parses_dotted_slashed_names():
+    reg = {"gauges": {
+        "thread.dio.worker/11.cpu_pct": 55,
+        "thread.dio.worker/11.utime_ms": 120,
+        "thread.dio.worker/11.stime_ms": 30,
+        "thread.nio.loop/0.cpu_pct": 12,
+        "thread.nio.loop/0.utime_ms": 40,
+        "thread.nio.loop/0.stime_ms": 8,
+        "thread.sync.127.0.0.71.cpu_pct": 2,   # ledger names contain IPs
+        "thread.sync.127.0.0.71.utime_ms": 5,
+        "thread.sync.127.0.0.71.stime_ms": 1,
+        "nio.conns_active": 3,                 # non-ledger gauge: ignored
+    }}
+    rows = M.thread_ledger(reg)
+    assert [r["name"] for r in rows] == \
+        ["dio.worker/11", "nio.loop/0", "sync.127.0.0.71"]
+    assert rows[0] == {"name": "dio.worker/11", "cpu_pct": 55,
+                      "utime_ms": 120, "stime_ms": 30}
+
+
+def test_render_top_threads_pane():
+    cur = M.TopSample(ts=1700000000.0)
+    frame = M.render_top(cur, {}, [], threads={
+        "storage 127.0.0.70:23000": [
+            {"name": "dio.worker/0", "cpu_pct": 80, "utime_ms": 900,
+             "stime_ms": 100},
+            {"name": "nio.loop/0", "cpu_pct": 10, "utime_ms": 80,
+             "stime_ms": 40},
+        ],
+        "tracker 127.0.0.1:22122": [],
+    }, thread_rows=1)
+    assert "THREADS (top 1 per node" in frame
+    assert "dio.worker/0" in frame
+    assert "nio.loop/0" not in frame  # capped at thread_rows
+    assert "(none)" in frame          # the empty tracker row says so
+
+
+# ---------------------------------------------------------------------------
+# cross-language goldens (fdfs_codec profile-ctl / profile-json /
+# thread-ledger — golden coverage enforced by tools/fdfs_lint.py)
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_profile_ctl_golden():
+    lines = dict(l.split("=", 1)
+                 for l in _codec("profile-ctl").splitlines() if "=" in l)
+    # The C++ side must parse exactly the bytes pack_profile_ctl emits.
+    assert lines["start_request"] == pack_profile_ctl(True, 97, 5).hex()
+    assert lines["stop_request"] == pack_profile_ctl(False).hex()
+    ack = json.loads(lines["ack"])
+    assert ack == {"active": True, "hz": 97}
+
+
+@needs_native
+def test_profile_json_golden():
+    d = M.decode_profile(json.loads(_codec("profile-json")))
+    assert (d.role, d.port) == ("storage", 23000)
+    assert (d.hz, d.duration_s, d.active) == (97, 5, False)
+    assert (d.samples, d.dropped, d.overhead_us) == (77, 3, 1234)
+    assert d.max_frames == 30
+    # Fixture rows arrive count-desc then stack-asc — the order
+    # decode_profile enforces; ties broken deterministically.
+    assert [s.count for s in d.stacks] == [41, 17, 17, 2]
+    assert d.stacks[0].stack == "nio.loop/0;EventLoop::Run;epoll_wait"
+    assert d.stacks[1].stack < d.stacks[2].stack
+    # JSON string escaping survives frame names with quotes/backslashes.
+    assert d.stacks[3].stack == 'scrub;frame"with\\escapes'
+    assert M.render_folded(d).splitlines()[0].endswith(" 41")
+
+
+@needs_native
+def test_thread_ledger_golden():
+    lines = dict(l.split("=", 1)
+                 for l in _codec("thread-ledger").splitlines() if "=" in l)
+    gauges = lines["gauges"].split(",")
+    # The exact naming scheme thread_ledger() parses back apart.
+    assert "thread.nio.loop/0.cpu_pct" in gauges
+    assert "thread.dio.worker/1.utime_ms" in gauges
+    assert len(gauges) == 6  # 2 live threads x 3 gauges
+    rows = M.thread_ledger({"gauges": {g: 1 for g in gauges}})
+    assert [r["name"] for r in rows] == ["dio.worker/1", "nio.loop/0"]
+    # Leaving a ScopedThreadName prunes the thread's gauges; the two
+    # registrations-while-live prove names are visible to SampleInto.
+    assert lines["after_leave"] == "0"
+    assert lines["registered_while_live"] == "2"
+
+
+# ---------------------------------------------------------------------------
+# live acceptance
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_live_profile_and_thread_ledger(tmp_path):
+    """The ISSUE 15 acceptance path: arm a capture under upload load and
+    the folded stacks name frames in ledger-named threads; the per-thread
+    CPU ledger appears in STAT and in the metrics journal; stop is
+    idempotent; the tracker's profiler round-trips too."""
+    from fastdfs_tpu.client import FdfsClient, StorageClient, TrackerClient
+
+    tmp = str(tmp_path)
+    tr = start_tracker(os.path.join(tmp, "tr"),
+                       extra="slo_eval_interval_s = 1\n"
+                             "metrics_journal_mb = 4\n"
+                             "profile_max_hz = 250")
+    taddr = f"127.0.0.1:{tr.port}"
+    st = start_storage(os.path.join(tmp, "st"), port=free_port(),
+                       trackers=[taddr], dedup_mode="cpu", extra=PROF)
+    cli = FdfsClient([taddr])
+    stop_load = threading.Event()
+
+    def load_loop():
+        c = FdfsClient([taddr])
+        i = 0
+        while not stop_load.is_set():
+            try:
+                c.upload_buffer(os.urandom(256 << 10), ext="bin")
+            except Exception:  # noqa: BLE001 — shutdown races are fine
+                pass
+            i += 1
+        c.close()
+
+    loader = threading.Thread(target=load_loop, daemon=True)
+    try:
+        upload_retry(cli, os.urandom(64 << 10), ext="bin")
+        loader.start()
+
+        with StorageClient("127.0.0.1", st.port) as sc:
+            # -- ledger in STAT: named threads with sane cpu% ------------
+            def ledger_rows():
+                return M.thread_ledger(M.decode_registry(sc.stat()))
+            rows = _wait(lambda: [r for r in ledger_rows()
+                                  if r["name"].startswith(("nio.loop/",
+                                                           "dio.worker/"))],
+                         timeout=30)
+            names = {r["name"] for r in ledger_rows()}
+            assert any(n.startswith("nio.loop/") for n in names), names
+            assert any(n.startswith("dio.worker/") for n in names), names
+            assert "main.loop" in names, names
+            assert all(0 <= r["cpu_pct"] <= 100 for r in ledger_rows())
+            # nio.loop_busy_pct satellite: per-loop busy gauges appear
+            # from the SECOND tick (the first only seeds the delta base).
+            def busy_gauges():
+                return {k: v for k, v in
+                        M.decode_registry(sc.stat())["gauges"].items()
+                        if k.startswith("nio.loop_busy_pct.")}
+            busy = _wait(busy_gauges, timeout=15)
+            assert "nio.loop_busy_pct.main" in busy, busy
+            assert all(0 <= v <= 100 for v in busy.values()), busy
+
+            # -- live capture under load --------------------------------
+            ack = sc.profile_start(hz=97, duration_s=30)
+            assert ack == {"active": True, "hz": 97}
+            # Burn daemon CPU inside the window (SIGPROF is CPU-time
+            # driven: an idle daemon takes no samples).
+            deadline = time.time() + 8.0
+            dump = None
+            while time.time() < deadline:
+                time.sleep(1.0)
+                dump = M.decode_profile(sc.profile_dump())
+                if dump.samples >= 5 and dump.stacks:
+                    break
+            assert dump is not None and dump.samples >= 5, vars(dump)
+            assert dump.role == "storage" and dump.hz == 97
+            assert dump.stacks, "no folded stacks despite samples"
+            for s in dump.stacks:
+                assert s.thread.startswith(LEDGER_PREFIXES), s.stack
+            # Hot frames are NAMED (symbolized, not bare hex): under
+            # sustained upload load at least one multi-frame stack in a
+            # ledger-named thread resolves a real symbol.
+            assert any(";" in s.stack and "0x" not in
+                       s.stack.split(";", 1)[1][:2]
+                       for s in dump.stacks), \
+                [s.stack for s in dump.stacks[:5]]
+
+            # profile gauges flow through the registry too
+            reg = M.decode_registry(sc.stat())
+            assert reg["gauges"].get("profile.active") == 1
+            assert reg["gauges"].get("profile.samples", 0) >= dump.samples
+
+            # -- stop: idempotent; samples survive for later dumps ------
+            assert sc.profile_stop()["active"] is False
+            assert sc.profile_stop()["active"] is False
+            after = M.decode_profile(sc.profile_dump())
+            assert after.active is False and after.samples > 0
+
+            # -- ledger in the metrics journal --------------------------
+            def journal_has_ledger():
+                snaps = M.decode_metrics_history(sc.metrics_history())
+                return any(
+                    any(k.startswith("thread.")
+                        for k in s["registry"]["gauges"])
+                    for s in snaps)
+            assert _wait(journal_has_ledger, timeout=20)
+
+        # -- tracker profiler round-trip --------------------------------
+        with TrackerClient("127.0.0.1", tr.port) as tc:
+            ack = tc.profile_start(hz=29, duration_s=5)
+            assert ack["active"] is True and ack["hz"] == 29
+            time.sleep(1.0)
+            tdump = M.decode_profile(tc.profile_dump())
+            assert tdump.role == "tracker" and tdump.hz == 29
+            assert tc.profile_stop()["active"] is False
+            tnames = {r["name"] for r in
+                      M.thread_ledger(M.decode_registry(tc.stat()))}
+            assert any(n.startswith(("tracker.loop", "relationship"))
+                       for n in tnames), tnames
+    finally:
+        stop_load.set()
+        if loader.is_alive():
+            loader.join(timeout=10)
+        cli.close()
+        st.stop()
+        tr.stop()
+
+
+@needs_native
+def test_live_profile_off_is_enotsup(tmp_path):
+    """Zero-cost-off proof: with profile_max_hz unset (default 0) the
+    daemon refuses to arm with ENOTSUP, PROFILE_DUMP answers ENOTSUP
+    while nothing was ever captured, and no profiler state exists —
+    profile.active reads 0 and no thread is ever sampled by SIGPROF."""
+    from fastdfs_tpu.client import StorageClient
+    from fastdfs_tpu.client.conn import StatusError
+
+    tmp = str(tmp_path)
+    tr = start_tracker(os.path.join(tmp, "tr"))
+    taddr = f"127.0.0.1:{tr.port}"
+    st = start_storage(os.path.join(tmp, "st"), port=free_port(),
+                       trackers=[taddr],
+                       extra=HB + "\nslo_eval_interval_s = 1")
+    try:
+        with StorageClient("127.0.0.1", st.port) as sc:
+            with pytest.raises(StatusError) as ei:
+                sc.profile_start(hz=97, duration_s=5)
+            assert ei.value.status == 95
+            with pytest.raises(StatusError) as ei:
+                sc.profile_dump()
+            assert ei.value.status == 95
+            reg = M.decode_registry(sc.stat())
+            assert reg["gauges"].get("profile.active", 0) == 0
+            assert reg["gauges"].get("profile.samples", 0) == 0
+            # The LEDGER is not gated (it is passive /proc sampling, no
+            # signals): thread gauges still appear.
+            assert _wait(lambda: M.thread_ledger(
+                M.decode_registry(sc.stat())), timeout=20)
+    finally:
+        st.stop()
+        tr.stop()
+
+
+@needs_native
+def test_profile_ctl_rejects_bad_params(tmp_path):
+    """EINVAL (22) for out-of-range hz/duration; clamping happens at
+    the conf cap, not silently at the wire."""
+    from fastdfs_tpu.client import StorageClient
+    from fastdfs_tpu.client.conn import StatusError
+
+    tmp = str(tmp_path)
+    tr = start_tracker(os.path.join(tmp, "tr"))
+    st = start_storage(os.path.join(tmp, "st"), port=free_port(),
+                       trackers=[f"127.0.0.1:{tr.port}"],
+                       extra=HB + "\nprofile_max_hz = 50")
+    try:
+        with StorageClient("127.0.0.1", st.port) as sc:
+            for hz, secs in ((0, 5), (-1, 5), (97, 0), (97, -3),
+                             (200000, 5), (97, 100000)):
+                with pytest.raises(StatusError) as ei:
+                    sc.profile_start(hz=hz, duration_s=secs)
+                assert ei.value.status == 22, (hz, secs)
+            # Over-cap hz is CLAMPED (a client asking for more detail
+            # than allowed still gets a capture at the cap).
+            ack = sc.profile_start(hz=97, duration_s=2)
+            assert ack == {"active": True, "hz": 50}
+            assert sc.profile_stop()["active"] is False
+    finally:
+        st.stop()
+        tr.stop()

@@ -32,6 +32,7 @@ srcs_common="common/bytes.cc common/cdc.cc common/fileid.cc common/ini.cc
   common/lockrank.cc common/log.cc common/net.cc common/req_server.cc
   common/stats.cc common/trace.cc common/eventlog.cc common/metrog.cc
   common/sloeval.cc common/heatsketch.cc common/fsutil.cc
+  common/threadreg.cc common/profiler.cc
   common/http_token.cc"
 srcs_storage="storage/chunkstore.cc storage/slabstore.cc
   storage/config.cc storage/store.cc
@@ -56,7 +57,10 @@ ar rcs "$BUILD_DIR/obj/libfdfs_common.a" "$BUILD_DIR"/obj/common_*.o
 ar rcs "$BUILD_DIR/obj/libfdfs_storage.a" "$BUILD_DIR"/obj/storage_*.o
 ar rcs "$BUILD_DIR/obj/libfdfs_tracker.a" "$BUILD_DIR"/obj/tracker_*.o
 
-link() { g++ $FLAGS "$@" -lpthread; }
+# -rdynamic: the sampling profiler symbolizes via backtrace_symbols,
+# which reads the DYNAMIC symbol table — without this every frame in a
+# PROFILE_DUMP is a bare hex address.
+link() { g++ $FLAGS -rdynamic "$@" -lpthread; }
 link storage/main.cc "$BUILD_DIR/obj/libfdfs_storage.a" \
   "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/fdfs_storaged" &
 link tracker/main.cc "$BUILD_DIR/obj/libfdfs_tracker.a" \

@@ -137,6 +137,11 @@ _REPLICATION = ("replication/recovery wire asserted byte-level by "
 
 EXTRA_GOLDENS = (
     "slab-layout",  # slab slot-header + index-record encoding (ISSUE 9)
+    # Thread-ledger gauge naming (thread.<name>.cpu_pct/...): not a wire
+    # opcode, but monitor.thread_ledger and fdfs_top's THREADS pane
+    # parse these names back apart, so the scheme is a cross-language
+    # contract (ISSUE 15).
+    "thread-ledger",
 )
 
 # Checked-in fixture goldens: JSON files under tests/ pinning kernel
