@@ -57,10 +57,12 @@ NOMINAL = {1: 1 << 30, 2: 10 << 30, 3: 50 << 30, 4: 100 << 30,
            # config9: the ISSUE 9 small-file corpus — 100k x 4 KB.
            9: 100_000 * 4096,
            # config10: ISSUE 11 multi-group open-loop corpus (64 KB files).
-           10: 4 << 30}
+           10: 4 << 30,
+           # config11: ISSUE 16 erasure-coded cold tier (256 KB files).
+           11: 2 << 30}
 DEFAULT_SCALE = {1: 0.25, 2: 1 / 32.0, 3: 1 / 64.0, 4: 1 / 40.0,
                  5: 1 / 2000.0, 6: 1 / 256.0, 7: 1 / 256.0, 8: 1 / 64.0,
-                 9: 0.1, 10: 1 / 64.0}
+                 9: 0.1, 10: 1 / 64.0, 11: 1 / 256.0}
 
 
 def emit(out_dir: str, config: int, payload: dict) -> None:
@@ -1802,10 +1804,257 @@ def config10(out_dir: str, scale: float) -> None:
     })
 
 
+def config11(out_dir: str, scale: float) -> None:
+    """Erasure-coded cold tier (ISSUE 16): what the RS(3, 2) tier buys
+    and what it costs.  A two-member group ingests an incompressible
+    corpus under 2x replication, then both members EC_KICK: cold chunks
+    stripe into RS(3+2) and the verify-then-release handover drops the
+    replica copies.  Headline: physical/logical falls from ~2x
+    (replication) to <= (k+m)/k + 5% on the demoted corpus, while
+    downloads stay byte-identical — the EC-phase p50/p99 records the
+    decode-path price next to the replicated baseline.  A second
+    single-node phase measures reconstruction throughput: every stripe
+    loses m=2 shard files and a scrub pass rebuilds them from parity,
+    once unpaced (ec_bandwidth_mb_s = 0) and once against a 2 MB/s
+    budget — the paced run must realize no more than its budget (the
+    token bucket keeps repair from starving foreground traffic), the
+    unpaced run shows the hardware ceiling.
+
+    Physical bytes are the LIVE payload inventory (flat chunk files +
+    live slab records + EC shard/manifest files): dead slab slots are
+    excluded because the compactor reclaims them asynchronously and
+    their transient slack would charge the EC tier for slab-layout
+    behavior it does not own.
+    """
+    from harness import (chunk_files, free_port, slab_records,
+                         start_storage, start_tracker, stripe_files)
+
+    from fastdfs_tpu.client.client import FdfsClient
+
+    file_bytes = 256 * 1024
+    n_files = max(int(NOMINAL[11] * scale) // file_bytes, 12)
+    ec_k, ec_m = 3, 2
+    pace_budget_mb_s = 2
+    ec_conf = ("\nscrub_interval_s = 0\nchunk_gc_grace_s = 1"
+               f"\nec_k = {ec_k}\nec_m = {ec_m}\nec_demote_age_s = 86400")
+
+    def physical_bytes(base):
+        total = sum(os.path.getsize(f) for f in chunk_files(base))
+        total += sum(r["payload_len"] for r in slab_records(base)
+                     if r["kind"] == 1 and not r["dead"])
+        for st in stripe_files(base).values():
+            total += sum(os.path.getsize(p) for p in st["shards"].values())
+            total += os.path.getsize(st["manifest"])
+        return total
+
+    def timed_downloads(cli, fids, blobs, n_ops):
+        lats, wrong = [], 0
+        rnd = random.Random(11)
+        t0 = time.perf_counter()
+        for _ in range(n_ops):
+            fid = rnd.choice(fids)
+            s = time.perf_counter()
+            got = cli.download_to_buffer(fid)
+            lats.append((time.perf_counter() - s) * 1e6)
+            if got != blobs[fid]:
+                wrong += 1
+        wall = time.perf_counter() - t0
+        lats.sort()
+        return {"ops": n_ops, "wrong": wrong,
+                "qps": round(n_ops / max(wall, 1e-9), 1),
+                "lat_p50_us": round(lats[len(lats) // 2], 1),
+                "lat_p99_us": round(lats[min(len(lats) - 1,
+                                             int(len(lats) * 0.99))], 1)}
+
+    def wait_for(cond, timeout=180):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            got = cond()
+            if got:
+                return got
+            time.sleep(0.3)
+        return cond()
+
+    # -- phase 1: replicated vs EC on a two-member group -------------------
+    tmp = tempfile.mkdtemp(prefix="fdfs_cfg11_group_")
+    tr = start_tracker(os.path.join(tmp, "tr"))
+    taddr = f"127.0.0.1:{tr.port}"
+    storages = [start_storage(os.path.join(tmp, f"st{i}"), port=free_port(),
+                              ip=f"127.0.0.{80 + i}", trackers=[taddr],
+                              dedup_mode="cpu", extra=HB + ec_conf)
+                for i in range(2)]
+    bases = [os.path.join(tmp, f"st{i}") for i in range(2)]
+    cli = FdfsClient([taddr])
+    rnd = random.Random(16)
+    try:
+        blobs = {}
+        t0 = time.perf_counter()
+        for _ in range(n_files):
+            data = rnd.randbytes(file_bytes)
+            blobs[_upload_retry(cli, data, ext="bin")] = data
+        ingest_s = time.perf_counter() - t0
+        fids = list(blobs)
+        logical = n_files * file_bytes
+        # Replication done: both members hold every chunk payload.
+        from harness import chunk_digests
+        assert wait_for(lambda: all(chunk_digests(b) for b in bases)
+                        and len(chunk_digests(bases[0]))
+                        == len(chunk_digests(bases[1])))
+        inv = set(chunk_digests(bases[0]))
+        replicated_phys = sum(physical_bytes(b) for b in bases)
+        n_ops = min(len(fids) * 4, 200)
+        replicated_dl = timed_downloads(cli, fids, blobs, n_ops)
+
+        for s in storages:
+            cli.ec_kick(s.ip, s.port)
+
+        def demoted():
+            maps = [set(chunk_digests(b)) for b in bases]
+            stats = [cli.ec_status(s.ip, s.port) for s in storages]
+            if any(maps):  # replicas/payloads still resident somewhere
+                return None
+            if sum(st["demoted_chunks"] for st in stats) < len(inv):
+                return None
+            return stats
+        stats = wait_for(demoted)
+        assert stats, [cli.ec_status(s.ip, s.port) for s in storages]
+        ec_phys = sum(physical_bytes(b) for b in bases)
+        ec_dl = timed_downloads(cli, fids, blobs, n_ops)
+        group_result = {
+            "members": 2,
+            "files": n_files,
+            "logical_bytes": logical,
+            "ingest_mb_s": round(logical / 1e6 / max(ingest_s, 1e-9), 2),
+            "replicated_physical_bytes": replicated_phys,
+            "replicated_physical_over_logical": round(
+                replicated_phys / logical, 3),
+            "ec_physical_bytes": ec_phys,
+            "ec_physical_over_logical": round(ec_phys / logical, 3),
+            "released_chunks": sum(st["released_chunks"] for st in stats),
+            "remote_reads_after_dl": sum(
+                cli.ec_status(s.ip, s.port)["remote_reads"]
+                for s in storages),
+            "replicated_download": replicated_dl,
+            "ec_download": ec_dl,
+        }
+    finally:
+        cli.close()
+        for s in storages:
+            s.stop()
+        tr.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # -- phase 2: reconstruction MB/s, paced vs unpaced --------------------
+    recon = {}
+    for arm, budget in (("unpaced", 0), ("paced", pace_budget_mb_s)):
+        tmp = tempfile.mkdtemp(prefix=f"fdfs_cfg11_{arm}_")
+        tr = start_tracker(os.path.join(tmp, "tr"))
+        st = start_storage(os.path.join(tmp, "st"), port=free_port(),
+                           trackers=[f"127.0.0.1:{tr.port}"],
+                           dedup_mode="cpu",
+                           extra=HB + ec_conf
+                           + f"\nec_bandwidth_mb_s = {budget}")
+        base = os.path.join(tmp, "st")
+        cli = FdfsClient([f"127.0.0.1:{tr.port}"])
+        try:
+            blobs = {}
+            for _ in range(n_files):
+                data = rnd.randbytes(file_bytes)
+                blobs[_upload_retry(cli, data, ext="bin")] = data
+            cli.ec_kick("127.0.0.1", st.port)
+            # Demotion settles when every chunk payload left the
+            # flat/slab tier (the corpus spans several 4 MB stripe
+            # batches — "stripes >= 1" would snapshot mid-demote).
+            from harness import chunk_digests as _cd
+            assert wait_for(lambda: cli.ec_status(
+                "127.0.0.1", st.port)["stripes"] >= 1 and not _cd(base))
+            # Kill m shards of EVERY stripe, then clock one repair pass.
+            full = {sid: sorted(s["shards"])
+                    for sid, s in stripe_files(base).items()}
+            for sid, idxs in full.items():
+                for idx in idxs[:ec_m]:
+                    os.unlink(stripe_files(base)[sid]["shards"][idx])
+            before = cli.ec_status("127.0.0.1", st.port)
+            passes0 = cli.scrub_status("127.0.0.1", st.port)["passes"]
+            t0 = time.perf_counter()
+            cli.scrub_kick("127.0.0.1", st.port)
+            # Clock the WHOLE repair pass, not first-file-back: the token
+            # bucket pays its bandwidth debt after each stripe's shards
+            # are already durable, so file existence alone would credit
+            # the paced arm with unpaced throughput.
+            assert wait_for(lambda: (
+                cli.scrub_status("127.0.0.1", st.port)["passes"] > passes0
+                and all(sorted(s["shards"]) == full[sid]
+                        for sid, s in stripe_files(base).items())))
+            wall = time.perf_counter() - t0
+            after = cli.ec_status("127.0.0.1", st.port)
+            rebuilt = after["reconstructed_bytes"] \
+                - before["reconstructed_bytes"]
+            wrong = sum(1 for fid, want in blobs.items()
+                        if cli.download_to_buffer(fid) != want)
+            recon[arm] = {
+                "bandwidth_budget_mb_s": budget,
+                "stripes": len(full),
+                "shards_rebuilt": after["reconstructed_shards"]
+                - before["reconstructed_shards"],
+                "rebuilt_bytes": rebuilt,
+                "wall_s": round(wall, 3),
+                "rebuild_mb_s": round(rebuilt / 1e6 / max(wall, 1e-9), 2),
+                "repair_fallback_chunks": after["repair_fallback_chunks"],
+                "wrong_bytes_after": wrong,
+            }
+        finally:
+            cli.close()
+            st.stop()
+            tr.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    ec_overhead_bound = (ec_k + ec_m) / ec_k * 1.05
+    emit(out_dir, 11, {
+        "description": "erasure-coded cold tier: 2x-replicated corpus "
+                       "demoted into RS(3+2) stripes with group-wide "
+                       "replica release (physical/logical vs the "
+                       "replica multiple, download p50/p99 both ways), "
+                       "plus kill-m-shards reconstruction throughput "
+                       "paced vs unpaced",
+        "nominal_bytes": NOMINAL[11],
+        "scaled_bytes": n_files * file_bytes,
+        "file_bytes": file_bytes,
+        "ec_k": ec_k,
+        "ec_m": ec_m,
+        "host_cpus": os.cpu_count() or 1,
+        "group": group_result,
+        "reconstruction": recon,
+        "ec_overhead_bound": round(ec_overhead_bound, 3),
+        "efficiency_pass": (
+            group_result["ec_physical_over_logical"] <= ec_overhead_bound
+            and group_result["ec_physical_over_logical"]
+            < group_result["replicated_physical_over_logical"]),
+        "replication_near_2x": (
+            1.8 <= group_result["replicated_physical_over_logical"] <= 2.3),
+        "zero_wrong_bytes": (
+            group_result["replicated_download"]["wrong"] == 0
+            and group_result["ec_download"]["wrong"] == 0
+            and all(r["wrong_bytes_after"] == 0 for r in recon.values())),
+        "reconstruct_from_parity_only": all(
+            r["repair_fallback_chunks"] == 0 for r in recon.values()),
+        "paced_within_budget": (
+            recon["paced"]["rebuild_mb_s"]
+            <= pace_budget_mb_s * 1.25 + 0.5),
+        "pacing_effective": (
+            recon["unpaced"]["rebuild_mb_s"]
+            > recon["paced"]["rebuild_mb_s"]),
+        "ec_download_p99_vs_replicated": round(
+            group_result["ec_download"]["lat_p99_us"]
+            / max(group_result["replicated_download"]["lat_p99_us"], 1),
+            3),
+    })
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=0,
-                    help="which config (1-10); 0 = all")
+                    help="which config (1-11); 0 = all")
     ap.add_argument("--scale", type=float, default=None,
                     help="fraction of the nominal corpus size")
     ap.add_argument("--full", action="store_true",
@@ -1814,8 +2063,9 @@ def main() -> None:
     args = ap.parse_args()
 
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8, 9: config9, 10: config10}
-    which = [args.config] if args.config else list(range(1, 11))
+           6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
+           11: config11}
+    which = [args.config] if args.config else list(range(1, 12))
     for c in which:
         scale = 1.0 if args.full else (
             args.scale if args.scale is not None else DEFAULT_SCALE[c])

@@ -14,6 +14,7 @@ import os
 import sys
 
 from fastdfs_tpu.client import FdfsClient
+from fastdfs_tpu.client.conn import StatusError
 from fastdfs_tpu.common.fileid import decode_file_id
 
 
@@ -653,6 +654,120 @@ def cmd_scrub(c: FdfsClient, args: list[str]) -> int:
         return 0
 
 
+def cmd_ec(c: FdfsClient, args: list[str]) -> int:
+    """Erasure-coding cold-tier console: per-storage EC status from the
+    EC_STATUS blob — stripe inventory, demotion/release accounting, and
+    reconstruction counters — with optional kick and watch modes.
+
+    Flags: --kick          force an EC demotion pass on every storage
+                           first (EC_KICK: age gate dropped to 0 for
+                           one pass, then the scrubber is kicked)
+           --watch [s]     re-render every s seconds (default 2) until
+                           interrupted
+           --group <name>  limit to one group
+           --json          machine-readable {addr: {field: value}}
+
+    Daemons with EC off (ec_k = 0, nothing striped on disk) answer
+    StatusError(95) and render as "ec off" rows rather than errors.
+    """
+    import time as _time
+
+    group = None
+    if "--group" in args:
+        i = args.index("--group")
+        if i + 1 >= len(args) or args[i + 1].startswith("--"):
+            print("usage: ec <tracker> [--kick] [--watch [s]] "
+                  "[--group <name>] [--json]", file=sys.stderr)
+            return 2
+        group = args[i + 1]
+    interval = 0.0
+    if "--watch" in args:
+        i = args.index("--watch")
+        interval = 2.0
+        if i + 1 < len(args) and not args[i + 1].startswith("--"):
+            try:
+                interval = float(args[i + 1])
+            except ValueError:
+                pass
+
+    def storages():
+        cs = c.cluster_stat(group)
+        return [(s["ip"], s["port"])
+                for g in cs.get("groups", [])
+                for s in g.get("storages", [])]
+
+    members = storages()
+    if not members:
+        print("no storages known to the tracker", file=sys.stderr)
+        return 1
+    if "--kick" in args:
+        for ip, port in members:
+            try:
+                c.ec_kick(ip, port)
+                print(f"kicked {ip}:{port}", file=sys.stderr)
+            except StatusError as e:
+                if e.status == 95:  # EC off here — not a failure
+                    print(f"skip {ip}:{port}: ec off", file=sys.stderr)
+                else:
+                    print(f"kick {ip}:{port} failed: {e}", file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 — keep kicking the rest
+                print(f"kick {ip}:{port} failed: {e}", file=sys.stderr)
+
+    def render_once() -> int:
+        rows: dict[str, dict] = {}
+        off: list[str] = []
+        errors: dict[str, str] = {}
+        for ip, port in members:
+            addr = f"{ip}:{port}"
+            try:
+                rows[addr] = c.ec_status(ip, port)
+            except StatusError as e:
+                if e.status == 95:
+                    off.append(addr)
+                else:
+                    errors[addr] = str(e)
+            except Exception as e:  # noqa: BLE001 — a dead node is a row
+                errors[addr] = str(e)
+        if "--json" in args:
+            merged: dict[str, dict] = dict(rows)
+            merged.update({a: {"enabled": 0} for a in off})
+            merged.update({a: {"error": e} for a, e in errors.items()})
+            print(json.dumps(merged, indent=2, sort_keys=True))
+        else:
+            for addr, st in sorted(rows.items()):
+                scheme = (f"RS({st['k']}+{st['m']})" if st["enabled"]
+                          else "draining")
+                print(f"{addr}  {scheme}  stripes={st['stripes']} "
+                      f"chunks={st['stripe_chunks']} "
+                      f"data={st['data_bytes']}B "
+                      f"parity={st['parity_bytes']}B")
+                print(f"  demoted: {st['demoted_chunks']} chunks "
+                      f"({st['demoted_bytes']} bytes)   released: "
+                      f"{st['released_chunks']} chunks "
+                      f"({st['released_bytes']} bytes)   remote reads: "
+                      f"{st['remote_reads']}")
+                print(f"  reconstructed: {st['reconstructed_shards']} "
+                      f"shards ({st['reconstructed_bytes']} bytes)   "
+                      f"repair fallbacks: {st['repair_fallback_chunks']}"
+                      f"   last demote: {st['last_demote_unix']}")
+            for addr in sorted(off):
+                print(f"{addr}  ec off")
+            for addr, err in sorted(errors.items()):
+                print(f"{addr}  error: {err}")
+        return 0 if not errors else 1
+
+    if interval <= 0:
+        return render_once()
+    try:
+        while True:
+            if "--json" not in args:  # keep --watch --json parseable
+                print(f"-- ec @ {_time.strftime('%H:%M:%S')} --")
+            render_once()
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_group(c: FdfsClient, args: list[str]) -> int:
     """Group lifecycle console (multi-group scale-out): the placement
     epoch with per-group state and, for draining groups, each member's
@@ -772,6 +887,7 @@ TOOLS = {
     "trace": cmd_trace,
     "profile": cmd_profile,
     "scrub": cmd_scrub,
+    "ec": cmd_ec,
     "group": cmd_group,
 }
 

@@ -563,6 +563,16 @@ class FdfsClient:
         with self._storage(FetchTarget(ip=ip, port=port)) as s:
             s.scrub_kick()
 
+    def ec_status(self, ip: str, port: int) -> dict[str, int]:
+        """One storage daemon's erasure-coding status (EC_STATUS)."""
+        with self._storage(FetchTarget(ip=ip, port=port)) as s:
+            return s.ec_status()
+
+    def ec_kick(self, ip: str, port: int) -> None:
+        """Force an EC demotion pass on one storage daemon (EC_KICK)."""
+        with self._storage(FetchTarget(ip=ip, port=port)) as s:
+            s.ec_kick()
+
     # -- placement epoch / group lifecycle ---------------------------------
 
     def _leader_call(self, fn):

@@ -28,6 +28,7 @@ from fastdfs_tpu.common.protocol import (
     pack_profile_ctl,
     unpack_group_name,
     unpack_metadata,
+    unpack_ec_stats,
     unpack_scrub_stats,
 )
 
@@ -496,6 +497,23 @@ class StorageClient:
         even when periodic scrubbing (scrub_interval_s) is off."""
         self.conn.send_request(StorageCmd.SCRUB_KICK)
         self.conn.recv_response("scrub_kick")
+
+    def ec_status(self) -> dict[str, int]:
+        """Erasure-coding cold-tier status (EC_STATUS 143): named stripe/
+        demotion/reconstruction counters decoded from the fixed int64
+        blob (EC_STAT_FIELDS).  StatusError(95) when EC is off
+        (ec_k = 0) AND no stripes survive on disk — a drained daemon
+        still answers so operators can watch the drain finish."""
+        self.conn.send_request(StorageCmd.EC_STATUS)
+        return unpack_ec_stats(self.conn.recv_response("ec_status"))
+
+    def ec_kick(self) -> None:
+        """Force an EC demotion pass now (EC_KICK 144): the next scrub
+        pass treats ec_demote_age_s as 0 so every demotable cold chunk
+        stripes immediately — then kick the scrubber itself.
+        StatusError(95) when EC is off (ec_k = 0)."""
+        self.conn.send_request(StorageCmd.EC_KICK)
+        self.conn.recv_response("ec_kick")
 
     def profile_start(self, hz: int = 97, duration_s: int = 30) -> dict:
         """Arm the in-daemon sampling profiler (PROFILE_CTL 141) for

@@ -133,6 +133,56 @@ def unpack_scrub_stats(buf: bytes) -> dict[str, int]:
     return dict(zip(SCRUB_STAT_FIELDS, vals))
 
 
+# ---------------------------------------------------------------------------
+# Erasure-coding status blob (fastdfs_tpu extension; no reference
+# equivalent — upstream FastDFS only replicates).
+#
+# The ``StorageCmd.EC_STATUS`` response body carries EC_STAT_COUNT
+# big-endian int64 slots; slot i is named EC_STAT_FIELDS[i].  The C++
+# daemon compiles against the generated mirror (protocol_gen.h
+# kEcStatNames), and the layout is pinned by the ``fdfs_codec
+# ec-status`` cross-language golden.  Append-only like the beat and
+# scrub blobs: new fields go at the end, decoders read missing tail
+# slots as 0.
+# ---------------------------------------------------------------------------
+
+EC_STAT_FIELDS = (
+    "enabled",                 # ec_k > 0 on this daemon
+    "k",                       # data shards per stripe
+    "m",                       # parity shards per stripe
+    "stripes",                 # live stripes in this node's EC store
+    "stripe_chunks",           # live chunks resident in those stripes
+    "data_bytes",              # logical chunk bytes inside live stripes
+    "parity_bytes",            # parity + padding overhead bytes on disk
+    "demoted_chunks",          # cumulative chunks encoded into stripes
+    "demoted_bytes",
+    "released_chunks",         # replica copies dropped after EC handover
+    "released_bytes",
+    "reconstructed_shards",    # shards rebuilt from parity by scrub
+    "reconstructed_bytes",
+    "repair_fallback_chunks",  # stripes past parity, refilled via FETCH_CHUNK
+    "remote_reads",            # released-chunk reads served via a peer fetch
+    "last_demote_unix",
+)
+EC_STAT_COUNT = len(EC_STAT_FIELDS)
+
+
+def pack_ec_stats(stats: dict[str, int]) -> bytes:
+    """EC_STATUS response body from named values (tests/goldens; the
+    production encoder is the C++ daemon)."""
+    return b"".join(long2buff(int(stats.get(name, 0)))
+                    for name in EC_STAT_FIELDS)
+
+
+def unpack_ec_stats(buf: bytes) -> dict[str, int]:
+    """Name an EC_STATUS blob; missing tail slots read 0 (append-only
+    wire contract, same discipline as the scrub blob)."""
+    n = len(buf) // 8
+    vals = [buff2long(buf, i * 8) for i in range(min(n, EC_STAT_COUNT))]
+    vals += [0] * (EC_STAT_COUNT - len(vals))
+    return dict(zip(EC_STAT_FIELDS, vals))
+
+
 PROFILE_CTL_LEN = 17
 
 
@@ -499,6 +549,33 @@ class StorageCmd(enum.IntEnum):
     # profile-json cross-language goldens).
     PROFILE_CTL = 141
     PROFILE_DUMP = 142
+    # Erasure-coded cold tier (fastdfs_tpu extension; see
+    # native/storage/ecstore.*).  Cold chunks past ec_demote_age_s are
+    # encoded into RS(k+m) stripes by scrub stage 5, then the replicated
+    # copies are released group-wide via a verify-then-release handover.
+    #   EC_STATUS: empty body -> EC_STAT_COUNT big-endian int64 slots
+    #     named by EC_STAT_FIELDS (append-only; cross-language golden:
+    #     fdfs_codec ec-status).  ENOTSUP when EC is off (ec_k = 0) or
+    #     the daemon has no chunk store.
+    #   EC_KICK: empty body -> status 0 once an EC demote sweep has been
+    #     scheduled with the next scrub pass (runs even when
+    #     scrub_interval_s = 0, so operators and tests can drive
+    #     demotion deterministically).  ENOTSUP when ec_k = 0.
+    #   EC_RELEASE: the stripe owner tells a replica peer that a batch
+    #     of chunk digests is now parity-protected on the owner, so the
+    #     peer may drop its replicated payload bytes (refs and recipe
+    #     metadata are retained; reads re-fetch via FETCH_CHUNK).  Body
+    #     = 16B group + 8B BE count + count x (20B raw digest + 8B BE
+    #     length); response = count bytes (0 = released, 1 = kept —
+    #     e.g. pinned by an in-flight upload session or unknown here).
+    #     Sent only AFTER the owner verified the stripe decodes
+    #     byte-identical (rebalance.map discipline: release.map is
+    #     fsynced before the first peer sees the batch).  Pinned by the
+    #     fdfs_codec ec-stripe-layout cross-language golden alongside
+    #     the on-disk stripe framing it protects.
+    EC_STATUS = 143
+    EC_KICK = 144
+    EC_RELEASE = 145
 
     RESP = 100
     ACTIVE_TEST = 111
@@ -524,6 +601,7 @@ NO_WIRE_BODY = frozenset({
     "TrackerCmd.ACTIVE_TEST",     # empty ping, status-only answer
     "StorageCmd.RESP",
     "StorageCmd.ACTIVE_TEST",
+    "StorageCmd.EC_KICK",         # empty body, status-only answer
 })
 
 WIRE_GOLDENS = {
@@ -549,6 +627,8 @@ WIRE_GOLDENS = {
     "TrackerCmd.PROFILE_DUMP": "profile-json",
     "StorageCmd.PROFILE_CTL": "profile-ctl",
     "StorageCmd.PROFILE_DUMP": "profile-json",
+    "StorageCmd.EC_STATUS": "ec-status",
+    "StorageCmd.EC_RELEASE": "ec-stripe-layout",
 }
 
 

@@ -1,0 +1,245 @@
+"""Reed-Solomon RS(k, m) erasure coding over GF(2^8), vectorized.
+
+The erasure-coded cold tier (native/storage/ecstore.*, scrub stage 5)
+encodes k data shards into m parity shards so a stripe survives any m
+shard losses at (k+m)/k storage overhead instead of the N-way replica
+multiple — the "lightweight metadata + cheap parity" disaster-recovery
+design of arXiv:2602.22237, with the GF matrix math treated as an
+accelerator kernel in the arXiv:1202.3669 storage-engine framing.
+
+Both encode and reconstruct are the SAME primitive — a (rows x k)
+GF(2^8) matrix applied to k shards of length L:
+
+    out[r, l] = XOR_i  mul(M[r, i], shards[i, l])
+
+so this module ships one matmul in three disciplines (the gear_cdc
+layout):
+
+- ``gf_matmul_ref``  — serial Python referee, bit-for-bit the spec.
+- ``gf_matmul_np``   — tiled NumPy: the 256x256 product table turns
+  field mul into a gather, XOR-reduced across the k axis; columns are
+  tiled cache-sized so the (rows, k, tile) intermediate stays in L2.
+- ``gf_matmul``      — jax: the same gather expressed as advanced
+  indexing into the product table (a (rows, k, 256) -> (rows, k, L)
+  take) + an XOR lane reduction, jit-compiled per shape bucket.  Host
+  bytes stage through the shared ``staging_buffer`` pool and move with
+  ``device_put`` (gear_cdc discipline: reused staging streams at link
+  speed where fresh allocations pay per-buffer setup).
+
+The generator matrix is systematic Cauchy ([I; C] with C[j][i] =
+inv(x_i ^ a_j), x_i = i, a_j = k + j — tables from the generated
+``gf256`` module, pinned by the fdfs_codec gf-tables golden), so every
+k x k submatrix is invertible and ANY k surviving shards reconstruct
+the stripe.  ``decode_matrix`` inverts the surviving rows with
+Gauss-Jordan over the field (k <= 32: host-side, microseconds).
+
+Equivalence of all three paths on adversarial shapes is asserted by
+tests/test_ec.py; the C++ codec (native/storage/ecstore.cc) runs the
+same tables, checked by the native storage_test RS unit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .gf256 import GF_EXP, GF_LOG, cauchy_coeff, gf_inv, gf_mul
+from .gear_cdc import staging_buffer
+
+# RS geometry bounds.  k + m <= 255 is the field limit (Cauchy points
+# must be distinct bytes); the practical clamp lives in storage config
+# (ec_k <= 32, ec_m <= 8) — stripes wider than that stop paying.
+MAX_SHARDS = 255
+
+# 256x256 product table: PROD[a, b] = a * b in GF(2^8).  64 KiB — built
+# once at import from the generated exp/log tables, shared by the NumPy
+# and jax paths (the jax path closes over it as an on-device constant).
+_EXP = np.asarray(GF_EXP, dtype=np.uint8)
+_LOG = np.asarray(GF_LOG, dtype=np.int32)
+PROD_TABLE = _EXP[_LOG[:, None] + _LOG[None, :]]
+PROD_TABLE[0, :] = 0
+PROD_TABLE[:, 0] = 0
+
+# NumPy tiling: columns per tile.  The (rows, k, tile) gather
+# intermediate for the worst supported geometry (k=32, rows=40) stays
+# ~40 MB at 32 KiB columns — resident in LLC on the host CPUs we run.
+_NP_TILE = 32 << 10
+
+
+# ---------------------------------------------------------------------------
+# Generator / decode matrices (host-side, tiny)
+# ---------------------------------------------------------------------------
+
+def parity_matrix(k: int, m: int) -> np.ndarray:
+    """(m, k) systematic Cauchy parity coefficients for RS(k, m)."""
+    if k <= 0 or m < 0 or k + m > MAX_SHARDS:
+        raise ValueError(f"bad RS geometry k={k} m={m}")
+    return np.array([[cauchy_coeff(k, j, i) for i in range(k)]
+                     for j in range(m)], dtype=np.uint8)
+
+
+def encode_matrix(k: int, m: int) -> np.ndarray:
+    """(k+m, k) full generator [I; C]: row s of the product is shard s."""
+    return np.concatenate([np.eye(k, dtype=np.uint8), parity_matrix(k, m)])
+
+
+def gf_invert_matrix(a: np.ndarray) -> np.ndarray:
+    """Gauss-Jordan inverse of a k x k matrix over GF(2^8).
+
+    Raises ValueError on a singular matrix — impossible for Cauchy
+    submatrices, so hitting it means corrupted shard indices.
+    """
+    a = np.array(a, dtype=np.uint8, copy=True)
+    k = a.shape[0]
+    if a.shape != (k, k):
+        raise ValueError(f"not square: {a.shape}")
+    inv = np.eye(k, dtype=np.uint8)
+    for col in range(k):
+        pivot = next((r for r in range(col, k) if a[r, col]), None)
+        if pivot is None:
+            raise ValueError(f"singular at column {col}")
+        if pivot != col:
+            a[[col, pivot]] = a[[pivot, col]]
+            inv[[col, pivot]] = inv[[pivot, col]]
+        scale = gf_inv(int(a[col, col]))
+        a[col] = PROD_TABLE[scale, a[col]]
+        inv[col] = PROD_TABLE[scale, inv[col]]
+        for r in range(k):
+            f = int(a[r, col])
+            if r != col and f:
+                a[r] ^= PROD_TABLE[f, a[col]]
+                inv[r] ^= PROD_TABLE[f, inv[col]]
+    return inv
+
+
+def decode_matrix(k: int, m: int, present: "list[int]") -> np.ndarray:
+    """(k, k) matrix mapping k surviving shards back to the data shards.
+
+    ``present`` names the k surviving shard indices (0..k-1 data,
+    k..k+m-1 parity), in the order their rows will be stacked.
+    """
+    if len(present) != k:
+        raise ValueError(f"need exactly k={k} present shards, got "
+                         f"{len(present)}")
+    if len(set(present)) != k or not all(0 <= s < k + m for s in present):
+        raise ValueError(f"bad present set {present}")
+    gen = encode_matrix(k, m)
+    return gf_invert_matrix(gen[np.asarray(present, dtype=np.intp)])
+
+
+# ---------------------------------------------------------------------------
+# The GF matmul, three ways
+# ---------------------------------------------------------------------------
+
+def gf_matmul_ref(matrix: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    """Serial referee: out[r, l] = XOR_i mul(M[r, i], shards[i, l])."""
+    matrix = np.asarray(matrix, dtype=np.uint8)
+    shards = np.atleast_2d(np.asarray(shards, dtype=np.uint8))
+    rows, k = matrix.shape
+    if shards.shape[0] != k:
+        raise ValueError(f"matrix k={k} vs shards {shards.shape}")
+    out = np.zeros((rows, shards.shape[1]), dtype=np.uint8)
+    for r in range(rows):
+        for i in range(k):
+            c = int(matrix[r, i])
+            for col in range(shards.shape[1]):
+                out[r, col] ^= gf_mul(c, int(shards[i, col]))
+    return out
+
+
+def gf_matmul_np(matrix: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    """Tiled NumPy path: product-table gather + XOR reduce over k."""
+    matrix = np.asarray(matrix, dtype=np.uint8)
+    shards = np.atleast_2d(np.ascontiguousarray(shards, dtype=np.uint8))
+    rows, k = matrix.shape
+    if shards.shape[0] != k:
+        raise ValueError(f"matrix k={k} vs shards {shards.shape}")
+    length = shards.shape[1]
+    out = np.empty((rows, length), dtype=np.uint8)
+    for lo in range(0, length, _NP_TILE):
+        tile = shards[:, lo:lo + _NP_TILE]        # (k, T)
+        # (rows, k, T) product gather, XOR-reduced across the k axis
+        prod = PROD_TABLE[matrix[:, :, None], tile[None, :, :]]
+        out[:, lo:lo + _NP_TILE] = np.bitwise_xor.reduce(prod, axis=1)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("rows", "k"))
+def _gf_matmul_jit(matrix: jnp.ndarray, shards: jnp.ndarray,
+                   table: jnp.ndarray, rows: int, k: int) -> jnp.ndarray:
+    # (rows, k, L) gather via advanced indexing into the product table,
+    # then an XOR reduction across the k axis.  Padding columns are
+    # zero and mul(c, 0) == 0, so they XOR away silently.
+    prod = table[matrix[:, :, None], shards[None, :, :]]
+    return jax.lax.reduce(prod, np.uint8(0), jax.lax.bitwise_xor, (1,))
+
+
+def _pow2_pad(n: int) -> int:
+    p = 1024
+    while p < n:
+        p <<= 1
+    return p
+
+
+def gf_matmul(matrix: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    """jax path: pads the shard length to a pow2 bucket (compile-once
+    per geometry), stages host bytes through the shared pool, and runs
+    the gather/XOR kernel on the default backend."""
+    matrix = np.asarray(matrix, dtype=np.uint8)
+    shards = np.atleast_2d(np.asarray(shards, dtype=np.uint8))
+    rows, k = matrix.shape
+    if shards.shape[0] != k:
+        raise ValueError(f"matrix k={k} vs shards {shards.shape}")
+    length = shards.shape[1]
+    if length == 0:
+        return np.zeros((rows, 0), dtype=np.uint8)
+    padded = _pow2_pad(length)
+    stage = staging_buffer(k * padded, slot=4).reshape(k, padded)
+    stage[:, :length] = shards
+    stage[:, length:] = 0
+    dev = jax.device_put(stage)
+    out = _gf_matmul_jit(jax.device_put(matrix), dev,
+                         jax.device_put(PROD_TABLE), rows, k)
+    return np.asarray(out)[:, :length]
+
+
+# ---------------------------------------------------------------------------
+# Stripe-level helpers (shared by tests, the Python client, and goldens)
+# ---------------------------------------------------------------------------
+
+def split_stripe(data: bytes, k: int) -> np.ndarray:
+    """(k, shard_len) data shards: concatenated payload bytes split into
+    k equal shards, the last zero-padded (shard_len = ceil(len/k); the
+    on-disk manifest records the true data_len so padding never leaks
+    back out).  Empty input yields shard_len 0."""
+    if k <= 0:
+        raise ValueError(f"bad k={k}")
+    shard_len = -(-len(data) // k) if data else 0
+    buf = np.zeros(k * shard_len, dtype=np.uint8)
+    buf[:len(data)] = np.frombuffer(data, dtype=np.uint8)
+    return buf.reshape(k, shard_len)
+
+
+def rs_encode(data_shards: np.ndarray, m: int, path: str = "jax") -> np.ndarray:
+    """(m, shard_len) parity shards for (k, shard_len) data shards."""
+    data_shards = np.atleast_2d(np.asarray(data_shards, dtype=np.uint8))
+    k = data_shards.shape[0]
+    pm = parity_matrix(k, m)
+    fn = {"ref": gf_matmul_ref, "np": gf_matmul_np, "jax": gf_matmul}[path]
+    return fn(pm, data_shards)
+
+
+def rs_reconstruct(present_shards: np.ndarray, present: "list[int]",
+                   k: int, m: int, path: str = "jax") -> np.ndarray:
+    """All k data shards from any k surviving shards.
+
+    ``present_shards`` rows correspond 1:1 to the ``present`` indices
+    (data rows 0..k-1, parity rows k..k+m-1, any order).
+    """
+    present_shards = np.atleast_2d(np.asarray(present_shards, dtype=np.uint8))
+    dm = decode_matrix(k, m, present)
+    fn = {"ref": gf_matmul_ref, "np": gf_matmul_np, "jax": gf_matmul}[path]
+    return fn(dm, present_shards)

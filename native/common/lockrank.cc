@@ -23,6 +23,7 @@ const char* LockRankName(LockRank r) {
     case LockRank::kChunkStripe: return "chunkstore.stripe";
     case LockRank::kSlabStore: return "slabstore.store";
     case LockRank::kSlabIndex: return "slabstore.index_stripe";
+    case LockRank::kEcStore: return "ecstore.store";
     case LockRank::kReadCache: return "chunkstore.read_cache";
     case LockRank::kTrunkAlloc: return "trunk.allocator";
     case LockRank::kBinlog: return "binlog.append";

@@ -96,6 +96,13 @@
 //                           stripe index (taken one at a time today;
 //                           the key gives any future multi-stripe walk
 //                           the ascending protocol for free).
+//    96   kEcStore          EcStore::mu_ (stripe manifests, digest ->
+//                           stripe index, shard-file IO under it by
+//                           design — a cold tier; see ecstore.h).
+//                           ChunkStore queries/marks-dead while holding
+//                           a digest stripe lock, so AFTER kChunkStripe;
+//                           releases before any read-cache call, so
+//                           BEFORE kReadCache.
 //   100   kReadCache        ChunkStore::ReadCache::mu — always AFTER a
 //                           stripe lock (insert liveness re-check,
 //                           same-lock invalidation), never before.
@@ -152,6 +159,7 @@ enum class LockRank : uint16_t {
   kChunkStripe = 90,
   kSlabStore = 92,
   kSlabIndex = 94,
+  kEcStore = 96,
   kReadCache = 100,
   kTrunkAlloc = 110,
   kBinlog = 120,

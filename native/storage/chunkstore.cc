@@ -110,7 +110,8 @@ std::optional<Recipe> ReadRecipeFile(const std::string& path) {
 // -- store ----------------------------------------------------------------
 
 ChunkStore::ChunkStore(std::string store_path, int64_t gc_grace_s,
-                       int64_t read_cache_bytes, SlabOptions slab)
+                       int64_t read_cache_bytes, SlabOptions slab, int ec_k,
+                       int ec_m)
     : store_path_(std::move(store_path)),
       gc_grace_s_(gc_grace_s < 0 ? 0 : gc_grace_s),
       slab_opts_(slab) {
@@ -130,6 +131,16 @@ ChunkStore::ChunkStore(std::string store_path, int64_t gc_grace_s,
     slab_ = std::make_unique<SlabStore>(store_path_ + "/data/slabs",
                                         slab_opts_.slab_bytes,
                                         slab_opts_.compact_min_dead_pct);
+  // Same drain discipline for the EC tier: ec_k = 0 with stripes on
+  // disk mounts the store read-only (Rescan adopts the on-disk
+  // geometry; EncodeStripe refuses) so demoted chunks stay readable
+  // while scrub repair / deletes drain the stripes.
+  bool ec_on_disk = stat((store_path_ + "/data/ec").c_str(), &st) == 0 &&
+                    S_ISDIR(st.st_mode);
+  if (ec_k > 0 || ec_on_disk)
+    ec_ = std::make_unique<EcStore>(store_path_ + "/data/ec",
+                                    ec_k > 0 ? ec_k : 0,
+                                    ec_k > 0 ? ec_m : 0);
   // Stripe locks share one rank; the index is the ascending-protocol
   // order key the FDFS_LOCKRANK checker validates RefAll against.
   for (int i = 0; i < kStripes; ++i) stripes_[i].mu.set_order_key(i);
@@ -239,9 +250,22 @@ bool ChunkStore::PutAndRef(const std::string& digest_hex, const char* data,
                     digest_hex.c_str(), werr.c_str());
     }
   };
+  // Released chunks heal the same way: the upload carries verified
+  // bytes, so the local replica returns and the remote-fetch dependency
+  // on the group owner ends.
+  auto unrelease = [&]() {
+    if (!st.released.count(digest_hex)) return;
+    std::string werr;
+    if (WriteChunkPayloadLocked(digest_hex, data, len, &werr))
+      UnreleaseLocked(st, digest_hex, static_cast<int64_t>(len));
+    else
+      FDFS_LOG_WARN("released chunk %s re-materialize failed: %s",
+                    digest_hex.c_str(), werr.c_str());
+  };
   auto it = st.refs.find(digest_hex);
   if (it != st.refs.end()) {
     heal();
+    unrelease();
     it->second++;
     *existed = true;
     return true;
@@ -338,16 +362,31 @@ void ChunkStore::RetireLocked(Stripe& s, const std::string& digest_hex,
   zero_ref_bytes_ += length;
 }
 
-void ChunkStore::UnlinkRetiredLocked(Stripe& s,
-                                     const std::string& digest_hex) {
+void ChunkStore::DropPayloadLocked(Stripe& s,
+                                   const std::string& digest_hex) {
+  (void)s;  // the stripe lock is the contract, not an input
   if (slab_ != nullptr) slab_->MarkDead(kSlabKindChunk, digest_hex);
   unlink(ChunkPath(digest_hex).c_str());
+  // Strict cache coherence: a dropped payload must never be served from
+  // the read cache (a later re-materialization re-admits it).
+  CacheInvalidate(digest_hex);
+}
+
+void ChunkStore::UnlinkRetiredLocked(Stripe& s,
+                                     const std::string& digest_hex) {
+  DropPayloadLocked(s, digest_hex);
   unlink(QuarantinePath(digest_hex).c_str());
   s.quarantined.erase(digest_hex);
+  // Full retirement also reclaims the chunk's EC slot (parity bytes
+  // come back when its stripe's last live chunk dies) and any released
+  // mark — a deleted chunk needs no remote serve path.
+  if (ec_ != nullptr) ec_->MarkDead(digest_hex, nullptr);
+  if (s.released.erase(digest_hex) > 0) {
+    released_chunks_--;
+    auto l = s.lens.find(digest_hex);
+    released_bytes_ -= l != s.lens.end() ? l->second : 0;
+  }
   s.lens.erase(digest_hex);
-  // Strict cache coherence: a swept chunk must never be served from the
-  // read cache (a later re-upload of the same digest re-admits it).
-  CacheInvalidate(digest_hex);
 }
 
 void ChunkStore::UnrefAll(const Recipe& r) {
@@ -493,19 +532,47 @@ bool ChunkStore::ReadChunk(const std::string& digest_hex, int64_t expect_len,
     }
   }
   int fd = open(ChunkPath(digest_hex).c_str(), O_RDONLY);
-  if (fd < 0) return false;
-  out->resize(static_cast<size_t>(expect_len));
-  size_t off = 0;
-  while (off < out->size()) {
-    ssize_t r = read(fd, out->data() + off, out->size() - off);
-    if (r <= 0) {
-      close(fd);
-      return false;
+  if (fd >= 0) {
+    out->resize(static_cast<size_t>(expect_len));
+    size_t off = 0;
+    while (off < out->size()) {
+      ssize_t r = read(fd, out->data() + off, out->size() - off);
+      if (r <= 0) {
+        close(fd);
+        return false;
+      }
+      off += static_cast<size_t>(r);
     }
-    off += static_cast<size_t>(r);
+    close(fd);
+    return true;
   }
-  close(fd);
-  return true;
+  // Cold-tier fallthrough: an EC-resident chunk (payload demoted into a
+  // local RS stripe) decodes transparently.
+  if (ec_ != nullptr && ec_->ReadChunk(digest_hex, out) &&
+      static_cast<int64_t>(out->size()) == expect_len)
+    return true;
+  // Released replica: the group owner holds the bytes (in parity);
+  // fetch them back over the wire, SHA1-gated.  The hook runs with NO
+  // lock held — network IO under a stripe lock would convoy the store.
+  if (remote_fetch_ != nullptr) {
+    bool released;
+    {
+      const Stripe& st = StripeFor(digest_hex);
+      std::lock_guard<RankedMutex> lk(st.mu);
+      released = st.released.count(digest_hex) != 0;
+    }
+    if (released) {
+      std::string buf;
+      if (remote_fetch_(digest_hex, expect_len, &buf) &&
+          static_cast<int64_t>(buf.size()) == expect_len &&
+          Sha1(buf.data(), buf.size()).Hex() == digest_hex) {
+        remote_reads_.fetch_add(1, std::memory_order_relaxed);
+        *out = std::move(buf);
+        return true;
+      }
+    }
+  }
+  return false;
 }
 
 bool ChunkStore::ReadChunkSlice(const std::string& digest_hex,
@@ -514,19 +581,51 @@ bool ChunkStore::ReadChunkSlice(const std::string& digest_hex,
   if (slab_ != nullptr && slab_->Has(kSlabKindChunk, digest_hex))
     return slab_->ReadSlice(kSlabKindChunk, digest_hex, offset, len, dst);
   int fd = open(ChunkPath(digest_hex).c_str(), O_RDONLY);
-  if (fd < 0) return false;
-  int64_t got = 0;
-  while (got < len) {
-    ssize_t r = pread(fd, dst + got, static_cast<size_t>(len - got),
-                      offset + got);
-    if (r <= 0) {
-      close(fd);
-      return false;
+  if (fd >= 0) {
+    int64_t got = 0;
+    while (got < len) {
+      ssize_t r = pread(fd, dst + got, static_cast<size_t>(len - got),
+                        offset + got);
+      if (r <= 0) {
+        close(fd);
+        return false;
+      }
+      got += r;
     }
-    got += r;
+    close(fd);
+    return true;
   }
-  close(fd);
-  return true;
+  // EC cold tier: positional reads are offset math over 1-2 data
+  // shards (no decode on the healthy path).
+  if (ec_ != nullptr && ec_->ReadChunkSlice(digest_hex, offset, len, dst))
+    return true;
+  // Released replica: fetch the WHOLE chunk from the group owner (the
+  // wire round is per-chunk; slicing happens here) so the bytes can be
+  // digest-verified before any of them reach the caller.
+  if (remote_fetch_ != nullptr) {
+    bool released = false;
+    int64_t full_len = 0;
+    {
+      const Stripe& st = StripeFor(digest_hex);
+      std::lock_guard<RankedMutex> lk(st.mu);
+      if (st.released.count(digest_hex)) {
+        released = true;
+        auto l = st.lens.find(digest_hex);
+        full_len = l != st.lens.end() ? l->second : 0;
+      }
+    }
+    if (released && offset >= 0 && len >= 0 && offset + len <= full_len) {
+      std::string buf;
+      if (remote_fetch_(digest_hex, full_len, &buf) &&
+          static_cast<int64_t>(buf.size()) == full_len &&
+          Sha1(buf.data(), buf.size()).Hex() == digest_hex) {
+        remote_reads_.fetch_add(1, std::memory_order_relaxed);
+        memcpy(dst, buf.data() + offset, static_cast<size_t>(len));
+        return true;
+      }
+    }
+  }
+  return false;
 }
 
 // -- hot-chunk read cache -------------------------------------------------
@@ -675,6 +774,9 @@ std::vector<ChunkStore::ChunkInfo> ChunkStore::SnapshotLive(
     for (const auto& [dig, n] : st.refs) {
       if (prefix >= 0 && (dig[0] != p0 || dig[1] != p1)) continue;
       if (st.quarantined.count(dig)) continue;
+      // Released chunks have no local bytes to verify — their integrity
+      // lives with the group owner's stripe (EC repair stage).
+      if (st.released.count(dig)) continue;
       auto l = st.lens.find(dig);
       out.push_back({dig, l != st.lens.end() ? l->second : 0});
     }
@@ -778,11 +880,173 @@ bool ChunkStore::RepairChunk(const std::string& digest_hex, const char* data,
   st.quarantined.erase(digest_hex);
   unlink(QuarantinePath(digest_hex).c_str());
   st.lens[digest_hex] = static_cast<int64_t>(len);
+  // A repair RE-PROMOTES the chunk to the replicated tier: the local
+  // payload is authoritative again, so any released mark clears and any
+  // stale EC slot dies (the scrubber's kLost fallback routes here — the
+  // stripe it came from is being dropped).
+  if (st.released.count(digest_hex))
+    UnreleaseLocked(st, digest_hex, static_cast<int64_t>(len));
+  if (ec_ != nullptr) ec_->MarkDead(digest_hex, nullptr);
   // The repaired payload hashes to the digest, so a cached copy would
   // be byte-identical — but drop it anyway: the cache must never hold
   // an entry that predates a quarantine episode.
   CacheInvalidate(digest_hex);
   return true;
+}
+
+// -- erasure-coded cold tier ----------------------------------------------
+
+void ChunkStore::AppendReleasedLog(const std::string& records) const {
+  int fd = open(ReleasedLogPath().c_str(), O_CREAT | O_WRONLY | O_APPEND,
+                0644);
+  if (fd < 0) {
+    FDFS_LOG_WARN("released.log open: %s", strerror(errno));
+    return;
+  }
+  if (write(fd, records.data(), records.size()) !=
+          static_cast<ssize_t>(records.size()) ||
+      fsync(fd) != 0)
+    FDFS_LOG_WARN("released.log append: %s", strerror(errno));
+  close(fd);
+}
+
+void ChunkStore::UnreleaseLocked(Stripe& s, const std::string& digest_hex,
+                                 int64_t len) {
+  if (s.released.erase(digest_hex) == 0) return;
+  released_chunks_--;
+  released_bytes_ -= len;
+  AppendReleasedLog("H " + digest_hex + "\n");
+}
+
+bool ChunkStore::IsReleased(const std::string& digest_hex) const {
+  const Stripe& st = StripeFor(digest_hex);
+  std::lock_guard<RankedMutex> lk(st.mu);
+  return st.released.count(digest_hex) != 0;
+}
+
+std::vector<ChunkStore::ChunkInfo> ChunkStore::SnapshotDemotable(
+    int64_t now_s, int64_t age_s) const {
+  std::vector<ChunkInfo> out;
+  if (ec_ == nullptr) return out;
+  // Pass 1 (locked, per stripe): the cheap state filters.  The EC probe
+  // runs under the stripe lock by rank (90 -> 96), and pins are the one
+  // liveness signal demotion respects in advance — an EC-resident read
+  // still serves pinned streams, but skipping hot pinned chunks avoids
+  // demoting what a session is actively shipping.
+  std::vector<ChunkInfo> candidates;
+  for (const Stripe& st : stripes_) {
+    std::lock_guard<RankedMutex> lk(st.mu);
+    for (const auto& [dig, n] : st.refs) {
+      if (st.quarantined.count(dig) || st.released.count(dig) ||
+          st.pins.count(dig))
+        continue;
+      if (ec_->Has(dig)) continue;
+      auto l = st.lens.find(dig);
+      candidates.push_back({dig, l != st.lens.end() ? l->second : 0});
+    }
+  }
+  // Pass 2 (lock-free): coldness by payload mtime — flat file stat, or
+  // the slab record's meta.  A chunk that vanished between the passes
+  // simply fails both probes and drops out.
+  for (ChunkInfo& c : candidates) {
+    int64_t mtime = -1;
+    if (slab_ != nullptr) {
+      SlabStore::Slot slot;
+      if (slab_->Lookup(kSlabKindChunk, c.digest_hex, &slot))
+        mtime = slot.mtime;
+    }
+    if (mtime < 0) {
+      struct stat fst;
+      if (stat(ChunkPath(c.digest_hex).c_str(), &fst) == 0)
+        mtime = static_cast<int64_t>(fst.st_mtime);
+    }
+    if (mtime >= 0 && now_s - mtime >= age_s)
+      out.push_back(std::move(c));
+  }
+  return out;
+}
+
+int64_t ChunkStore::DemoteToEc(const std::vector<ChunkInfo>& chunks,
+                               int64_t* chunks_demoted,
+                               int64_t* bytes_demoted, std::string* err) {
+  if (ec_ == nullptr) {
+    *err = "ec tier disabled";
+    return -1;
+  }
+  // Phase 1 (lock-free): read + SHA1-verify each candidate — the
+  // stripe must never inherit bytes that would fail their own digest.
+  std::vector<std::pair<std::string, std::string>> batch;
+  for (const ChunkInfo& c : chunks) {
+    std::string payload;
+    if (!ReadChunk(c.digest_hex, c.length, &payload)) continue;
+    if (Sha1(payload.data(), payload.size()).Hex() != c.digest_hex)
+      continue;  // scrub's verify stage owns corruption; skip here
+    if (ec_->Has(c.digest_hex)) continue;
+    batch.emplace_back(c.digest_hex, std::move(payload));
+  }
+  if (batch.empty()) {
+    *err = "no demotable chunks survived re-verify";
+    return -1;
+  }
+  int64_t id = ec_->EncodeStripe(batch, err);
+  if (id < 0) return -1;
+  // Verify-then-release, local half: re-read the stripe from disk
+  // through the decode path before ANY copy (local or replica) is
+  // surrendered.
+  if (!ec_->VerifyStripe(id, err)) {
+    ec_->DropStripe(id, nullptr);
+    return -1;
+  }
+  // Phase 2 (locked per digest): drop the local payload; refs/lens stay
+  // and reads fall through to the stripe.  A digest deleted since phase
+  // 1 has no refs — kill its freshly-encoded EC slot too, or the
+  // content-addressed index would resurrect a deleted chunk.
+  for (auto& [dig, payload] : batch) {
+    Stripe& st = StripeFor(dig);
+    std::lock_guard<RankedMutex> lk(st.mu);
+    if (st.refs.find(dig) == st.refs.end()) {
+      ec_->MarkDead(dig, nullptr);
+      continue;
+    }
+    if (st.quarantined.count(dig)) continue;  // repair machinery owns it
+    DropPayloadLocked(st, dig);
+    if (chunks_demoted != nullptr) ++*chunks_demoted;
+    if (bytes_demoted != nullptr)
+      *bytes_demoted += static_cast<int64_t>(payload.size());
+  }
+  return id;
+}
+
+std::string ChunkStore::ReleaseChunks(const std::vector<ChunkInfo>& chunks) {
+  std::string kept(chunks.size(), '\0');
+  std::string journal;
+  for (size_t i = 0; i < chunks.size(); ++i) {
+    const std::string& dig = chunks[i].digest_hex;
+    Stripe& st = StripeFor(dig);
+    std::lock_guard<RankedMutex> lk(st.mu);
+    auto it = st.refs.find(dig);
+    if (it == st.refs.end()) continue;      // never held: nothing retained
+    if (st.released.count(dig)) continue;   // idempotent replay
+    if (st.pins.count(dig) || st.quarantined.count(dig)) {
+      // An in-flight stream still reads the local bytes, or the
+      // quarantine/repair lifecycle owns them — keep the replica; the
+      // owner keeps full-copy coverage for this digest and may retry
+      // next pass.
+      kept[i] = 1;
+      continue;
+    }
+    DropPayloadLocked(st, dig);
+    st.released.insert(dig);
+    released_chunks_++;
+    released_bytes_ += chunks[i].length;
+    journal += "R " + dig + " " + std::to_string(chunks[i].length) + "\n";
+  }
+  // One durable append for the whole batch BEFORE the response: the
+  // owner treats a 0 byte as permission to count this replica gone, so
+  // the mark must survive a crash (or a restart would serve the digest
+  // as locally-missing instead of remote-fetching).
+  if (!journal.empty()) AppendReleasedLog(journal);
+  return kept;
 }
 
 // -- recipe sidecars (slab-aware) -----------------------------------------
@@ -967,7 +1231,7 @@ void WalkRecipes(const std::string& dir,
     if (stat(path.c_str(), &st) != 0) continue;
     if (S_ISDIR(st.st_mode)) {
       if (name != "chunks" && name != "sync" && name != "tmp" &&
-          name != "slabs")
+          name != "slabs" && name != "ec")
         WalkRecipes(path, skip_flat, refs, lens);
     } else if (name.size() > 4 &&
                name.compare(name.size() - 4, 4, ".rcp") == 0) {
@@ -993,6 +1257,9 @@ void ChunkStore::RebuildFromRecipes() {
   // below needs the chunk records indexed.  Same no-binlog philosophy —
   // the slab headers on disk are the ground truth.
   if (slab_ != nullptr) slab_->ScanRebuild();
+  // EC stripe manifests next (same ground-truth philosophy; also
+  // collects orphan shards from crashed encodes).
+  if (ec_ != nullptr) ec_->Rescan();
 
   std::unordered_map<std::string, int64_t> refs, lens;
   // Cross-layout dedup: a crash inside StoreRecipe (between the slab
@@ -1161,10 +1428,69 @@ void ChunkStore::RebuildFromRecipes() {
     st.zero_ref = std::move(fresh[s].zero_ref);
     st.quarantined = std::move(fresh[s].quarantined);
     st.pins.clear();
+    st.released.clear();  // re-derived from released.log below
   }
   unique_bytes_ = ub;
   zero_ref_bytes_ = zb;
   bytes = ub;
+  // released.log replay: re-mark replicas this node surrendered via
+  // EC_RELEASE.  A mark survives only while it is still true — the
+  // digest must be referenced and genuinely payload-less locally (a
+  // heal that crashed before its 'H' append shows up as bytes on disk
+  // and wins).  The journal is rewritten compacted with the surviving
+  // set, so it never grows unboundedly across release/heal churn.
+  released_chunks_ = 0;
+  released_bytes_ = 0;
+  {
+    std::unordered_map<std::string, int64_t> marks;
+    std::string jbuf;
+    if (ReadWholeFile(ReleasedLogPath(), &jbuf)) {
+      size_t pos = 0;
+      while (pos < jbuf.size()) {
+        size_t eol = jbuf.find('\n', pos);
+        if (eol == std::string::npos) eol = jbuf.size();
+        std::string line = jbuf.substr(pos, eol - pos);
+        pos = eol + 1;
+        if (line.size() >= 42 && line[1] == ' ' &&
+            IsHex40(line.substr(2, 40))) {
+          if (line[0] == 'R')
+            marks[line.substr(2, 40)] =
+                strtoll(line.c_str() + 42, nullptr, 10);
+          else if (line[0] == 'H')
+            marks.erase(line.substr(2, 40));
+        }
+      }
+    }
+    std::string compacted;
+    for (const auto& [dig, mlen] : marks) {
+      Stripe& st = stripes_[StripeIndex(dig)];
+      std::lock_guard<RankedMutex> lk(st.mu);
+      if (st.refs.find(dig) == st.refs.end()) continue;  // deleted
+      struct stat fst;
+      if (stat(ChunkPath(dig).c_str(), &fst) == 0 ||
+          (slab_ != nullptr && slab_->Has(kSlabKindChunk, dig)))
+        continue;  // bytes came back (heal crashed pre-'H'): not released
+      st.released.insert(dig);
+      int64_t l = mlen;
+      auto li = st.lens.find(dig);
+      if (li != st.lens.end()) l = li->second;
+      released_chunks_++;
+      released_bytes_ += l;
+      compacted += "R " + dig + " " + std::to_string(l) + "\n";
+    }
+    if (marks.empty() && compacted.empty()) {
+      unlink(ReleasedLogPath().c_str());
+    } else {
+      std::string tmp = ReleasedLogPath() + ".tmp";
+      std::string werr;
+      if (WriteChunkFile(tmp, compacted.data(), compacted.size(), &werr)) {
+        if (rename(tmp.c_str(), ReleasedLogPath().c_str()) != 0)
+          FDFS_LOG_WARN("released.log rewrite: %s", strerror(errno));
+      } else {
+        FDFS_LOG_WARN("released.log rewrite: %s", werr.c_str());
+      }
+    }
+  }
   CacheClear();
   if (unique > 0 || orphans > 0 || parked > 0 || !quarantined.empty())
     FDFS_LOG_INFO("chunk store: %zu unique chunks (%lld bytes), %lld "

@@ -61,6 +61,24 @@
 // by their sidecar path relative to the store root (mixed stores read
 // both layouts, so flipping the thresholds is always safe).
 //
+// Erasure-coded cold tier (ISSUE 16 / ROADMAP item 2): when ec_k > 0
+// the store owns an EcStore (<store_path>/data/ec/, storage/ecstore.h)
+// and three new per-digest states exist.  EC-RESIDENT (owner): the
+// payload was demoted into an RS(k, m) stripe and the local flat/slab
+// copy dropped — refs/lens are unchanged and reads fall through
+// flat -> slab -> EC transparently.  RELEASED (peer): scrub stage 5's
+// verify-then-release handover (EC_RELEASE) dropped this node's replica
+// because the group owner holds the bytes in parity — refs/lens are
+// unchanged, presence answers (HaveMask/PinAndMask) still report the
+// chunk held (it is, group-wide), and a local read remote-fetches from
+// the owner via the set_remote_fetch hook (SHA1-verified, cache-
+// warmed).  Released marks survive restarts via data/released.log
+// ("R <digest> <len>" / "H <digest>" records, replayed by
+// RebuildFromRecipes); heal paths (PutAndRef, RepairChunk) clear the
+// mark the moment verified bytes land locally again.  Deletes reclaim
+// parity through EcStore::MarkDead from the same stripe-lock unlink
+// path that reclaims flat/slab bytes.
+//
 // Reference anchor: replaces the inode-per-file write in
 // storage/storage_dio.c:dio_write_file() for deduplicated uploads.
 #pragma once
@@ -80,6 +98,7 @@
 #include <unordered_set>
 #include <vector>
 
+#include "storage/ecstore.h"
 #include "storage/slabstore.h"
 
 namespace fdfs {
@@ -118,10 +137,14 @@ class ChunkStore {
   // gc_grace_s: how long a zero-ref chunk's bytes linger on disk before
   // a GcSweep may reclaim them (0 = unlink eagerly on the last unref,
   // the pre-scrubber behavior).  read_cache_bytes bounds the hot-chunk
-  // LRU read cache (0 = off).
+  // LRU read cache (0 = off).  ec_k/ec_m enable the erasure-coded cold
+  // tier (storage.conf ec_k/ec_m; 0 = off — like the slab store, an
+  // EcStore also mounts read-only when data/ec/ already holds stripes,
+  // so flipping ec_k to 0 drains the tier instead of stranding it).
   explicit ChunkStore(std::string store_path, int64_t gc_grace_s = 0,
                       int64_t read_cache_bytes = 0,
-                      SlabOptions slab = SlabOptions{});
+                      SlabOptions slab = SlabOptions{}, int ec_k = 0,
+                      int ec_m = 0);
 
   // Flight recorder (common/eventlog.h; may stay null): the store
   // reports heal-on-upload — a quarantined chunk restored by an
@@ -267,11 +290,66 @@ class ChunkStore {
     return slab_ ? slab_->compacted_bytes() : 0;
   }
 
-  // -- integrity engine (storage/scrub.*) --------------------------------
+  // -- erasure-coded cold tier (storage/ecstore.h) -----------------------
   struct ChunkInfo {
     std::string digest_hex;
     int64_t length = 0;
   };
+  bool ec_enabled() const { return ec_ != nullptr; }
+  EcStore* ec() { return ec_.get(); }  // scrub stage 5 / tests / stats
+  const EcStore* ec() const { return ec_.get(); }
+  // ec.* registry gauges (all 0 when the tier is off).
+  int64_t ec_stripes() const { return ec_ ? ec_->stripes() : 0; }
+  int64_t ec_stripe_chunks() const {
+    return ec_ ? ec_->stripe_chunks() : 0;
+  }
+  int64_t ec_data_bytes() const { return ec_ ? ec_->data_bytes() : 0; }
+  int64_t ec_parity_bytes() const { return ec_ ? ec_->parity_bytes() : 0; }
+  int64_t released_chunks() const { return released_chunks_.load(); }
+  int64_t released_bytes() const { return released_bytes_.load(); }
+  int64_t ec_remote_reads() const { return remote_reads_.load(); }
+
+  // Remote-replica fetch for RELEASED chunks: the server installs a
+  // group-peer FETCH_CHUNK round here at startup.  Called WITHOUT any
+  // lock held (it does network IO); the returned bytes are SHA1-checked
+  // by the caller before serving.  Null = released chunks read as
+  // missing (single-node stores).
+  using RemoteFetchFn = std::function<bool(
+      const std::string& digest_hex, int64_t length, std::string* out)>;
+  void set_remote_fetch(RemoteFetchFn fn) { remote_fetch_ = std::move(fn); }
+
+  // Demotion candidates for scrub stage 5: live, unpinned,
+  // unquarantined, unreleased, not yet EC-resident, and COLD — payload
+  // mtime (flat file stat / slab record meta) at or past age_s seconds
+  // old at now_s.  The mtime probes run lock-free after a locked
+  // candidate scan, so a many-million-chunk store never stats under a
+  // stripe lock.
+  std::vector<ChunkInfo> SnapshotDemotable(int64_t now_s,
+                                           int64_t age_s) const;
+
+  // Owner-side demotion: read + SHA1-verify each chunk, encode ONE
+  // RS(k, m) stripe, re-verify it from disk through the decode path,
+  // then drop the local flat/slab payloads (refs/lens stay — reads fall
+  // through to the stripe).  Chunks that vanished, fail their hash, or
+  // are already EC-resident are skipped silently (the next pass
+  // re-snapshots).  Returns the stripe id, or -1 with *err (nothing
+  // demoted — a failed verify also unwinds the stripe).
+  int64_t DemoteToEc(const std::vector<ChunkInfo>& chunks,
+                     int64_t* chunks_demoted, int64_t* bytes_demoted,
+                     std::string* err);
+
+  // Peer-side EC_RELEASE: drop the local replica of chunks the group
+  // owner now holds in parity.  Byte i of the result is 0 when chunk i
+  // is released here (or was never held — nothing retained either way),
+  // 1 when it is KEPT (pinned by an in-flight stream, or quarantined —
+  // the scrub repair machinery owns that lifecycle).  Idempotent: a
+  // replayed release of an already-released digest answers 0.  Released
+  // marks are journaled to data/released.log before the response so a
+  // crash cannot resurrect a dropped replica as "held".
+  std::string ReleaseChunks(const std::vector<ChunkInfo>& chunks);
+  bool IsReleased(const std::string& digest_hex) const;
+
+  // -- integrity engine (storage/scrub.*) --------------------------------
   // Live (referenced, non-quarantined) chunks for a verify pass.
   // prefix -1 snapshots everything in one call; 0..255 filters to
   // digests whose first byte equals it, so a scrubber walking the 256
@@ -341,6 +419,9 @@ class ChunkStore {
     std::unordered_map<std::string, int64_t> pins;  // in-flight streams
     std::unordered_map<std::string, ZeroRef> zero_ref;  // awaiting GC
     std::unordered_set<std::string> quarantined;
+    // Replica dropped via EC_RELEASE (group owner holds the bytes in
+    // parity); refs/lens entries remain, reads remote-fetch.
+    std::unordered_set<std::string> released;
   };
   static constexpr int kStripes = 16;
   static int StripeIndex(const std::string& digest_hex);
@@ -356,8 +437,27 @@ class ChunkStore {
   void RetireLocked(Stripe& s, const std::string& digest_hex,
                     int64_t length);
   // stripe mu held.  Unlink a zero-ref chunk's bytes (chunks/,
-  // quarantine/, and any slab record) and invalidate any cached copy.
+  // quarantine/, any slab record, any EC slot, any released mark) and
+  // invalidate any cached copy.
   void UnlinkRetiredLocked(Stripe& s, const std::string& digest_hex);
+  // stripe mu held.  Drop just the LOCAL PAYLOAD (flat file / slab
+  // record + cached copy), keeping refs/lens/quarantine state — the
+  // shared core of UnlinkRetiredLocked (full retirement), DemoteToEc
+  // (bytes now live in the EC stripe), and ReleaseChunks (bytes now
+  // live on the group owner).
+  void DropPayloadLocked(Stripe& s, const std::string& digest_hex);
+  // stripe mu held.  Clear a released mark because verified bytes just
+  // landed locally (heal-on-upload, replica repair); journals 'H'.
+  void UnreleaseLocked(Stripe& s, const std::string& digest_hex,
+                       int64_t len);
+  std::string ReleasedLogPath() const {
+    return store_path_ + "/data/released.log";
+  }
+  // Append released.log records ('R' digest len / 'H' digest) with one
+  // fsync per call — ReleaseChunks batches a whole EC_RELEASE body into
+  // one append so the journal is durable before the response commits
+  // the owner to dropping coverage.
+  void AppendReleasedLog(const std::string& records) const;
   // Should a fresh chunk payload of this size land in the slab store?
   bool SlabChunkEligible(int64_t len) const {
     return slab_ != nullptr && slab_opts_.chunk_threshold > 0 &&
@@ -399,10 +499,16 @@ class ChunkStore {
   int64_t gc_grace_s_ = 0;
   SlabOptions slab_opts_;
   std::unique_ptr<SlabStore> slab_;  // null = flat layout only
+  std::unique_ptr<EcStore> ec_;      // null = no erasure-coded tier
+  RemoteFetchFn remote_fetch_;
   class EventLog* events_ = nullptr;
   std::array<Stripe, kStripes> stripes_;
   std::atomic<int64_t> unique_bytes_{0};
   std::atomic<int64_t> zero_ref_bytes_{0};
+  std::atomic<int64_t> released_chunks_{0};
+  std::atomic<int64_t> released_bytes_{0};
+  // Counted from const read paths (the fallthrough serve), hence mutable.
+  mutable std::atomic<int64_t> remote_reads_{0};
   mutable ReadCache cache_;
 };
 

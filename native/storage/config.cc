@@ -160,6 +160,35 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
     note("metrics_journal_mb clamped to 256");
     metrics_journal_mb = 256;
   }
+  ec_k = static_cast<int>(ini.GetInt("ec_k", ec_k));
+  if (ec_k < 0) ec_k = 0;
+  // 32 data shards already puts a single chunk read across up to 2 of
+  // 32 files; wider stripes only grow the blast radius of a stripe
+  // loss without improving the (k+m)/k overhead much past k=16.
+  if (ec_k > 32) {
+    note("ec_k clamped to 32");
+    ec_k = 32;
+  }
+  ec_m = static_cast<int>(ini.GetInt("ec_m", ec_m));
+  if (ec_m < 1) {
+    note("ec_m raised to 1");
+    ec_m = 1;
+  }
+  // The Cauchy construction needs k + m <= 256 over GF(2^8); 8 parity
+  // shards is beyond any sane durability target at group scale.
+  if (ec_m > 8) {
+    note("ec_m clamped to 8");
+    ec_m = 8;
+  }
+  ec_demote_age_s = ini.GetSeconds("ec_demote_age_s", ec_demote_age_s);
+  if (ec_demote_age_s < 0) ec_demote_age_s = 0;
+  ec_bandwidth_mb_s = static_cast<int>(
+      ini.GetInt("ec_bandwidth_mb_s", ec_bandwidth_mb_s));
+  if (ec_bandwidth_mb_s < 0) ec_bandwidth_mb_s = 0;
+  if (ec_bandwidth_mb_s > (1 << 20)) {
+    note("ec_bandwidth_mb_s clamped to 1 TB/s");
+    ec_bandwidth_mb_s = 1 << 20;
+  }
   slo_eval_interval_s = static_cast<int>(
       ini.GetSeconds("slo_eval_interval_s", slo_eval_interval_s));
   if (slo_eval_interval_s < 0) slo_eval_interval_s = 0;

@@ -119,6 +119,17 @@ struct StorageConfig {
   int slo_eval_interval_s = 5;
   std::string slo_rules_file;
   int heat_top_k = 32;
+  // Erasure-coded cold tier (storage/ecstore.h; OPERATIONS.md
+  // "Erasure-coded cold tier").  ec_k/ec_m: RS(k, m) stripe geometry —
+  // ec_k = 0 (default) disables demotion entirely (existing stripes
+  // still serve, repair, and drain).  ec_demote_age_s: chunk payload
+  // mtime age before scrub stage 5 may demote it.  ec_bandwidth_mb_s:
+  // demote/repair IO pace, a SEPARATE token bucket from
+  // scrub_bandwidth_mb_s (0 = unlimited).
+  int ec_k = 0;
+  int ec_m = 2;
+  int64_t ec_demote_age_s = 7 * 86400;
+  int ec_bandwidth_mb_s = 0;
   // Sampling-profiler ceiling (common/profiler.h; OPERATIONS.md
   // "Profiling & the thread ledger"): the maximum PROFILE_CTL sampling
   // rate this daemon will arm.  0 (the default) disables the profiler

@@ -1,0 +1,760 @@
+#include "storage/ecstore.h"
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+
+#include "common/bytes.h"
+#include "common/eventlog.h"
+#include "common/fsutil.h"
+#include "common/gf256.h"
+#include "common/log.h"
+
+namespace fdfs {
+
+namespace {
+
+constexpr char kShardMagic[8] = {'F', 'D', 'F', 'S', 'E', 'C', 'S', '1'};
+constexpr char kManifestMagic[8] = {'F', 'D', 'F', 'S', 'E', 'C', 'M', '1'};
+constexpr size_t kShardHeader = 52;
+constexpr size_t kManifestFixed = 40;
+constexpr size_t kManifestPerChunk = 37;
+
+// 256x256 product table: field mul as one gather instead of two log
+// lookups + an add — the XOR inner loops below touch it per byte.
+// Built once, 64 KiB, read-only afterwards.
+const uint8_t* MulTable() {
+  static const uint8_t* table = [] {
+    auto* t = new uint8_t[256 * 256];
+    for (int a = 0; a < 256; ++a)
+      for (int b = 0; b < 256; ++b)
+        t[a * 256 + b] = gf256::Mul(static_cast<uint8_t>(a),
+                                    static_cast<uint8_t>(b));
+    return t;
+  }();
+  return table;
+}
+
+// out ^= c * src over shard_len bytes (the RS inner loop).
+void XorMulInto(uint8_t c, const uint8_t* src, uint8_t* out, int64_t len) {
+  if (c == 0) return;
+  const uint8_t* row = MulTable() + static_cast<size_t>(c) * 256;
+  if (c == 1) {
+    for (int64_t i = 0; i < len; ++i) out[i] ^= src[i];
+    return;
+  }
+  for (int64_t i = 0; i < len; ++i) out[i] ^= row[src[i]];
+}
+
+// Gauss-Jordan inverse over GF(2^8).  k <= 255 and typically <= 32, so
+// the cubic cost is microseconds; singular is impossible for Cauchy
+// submatrices (any-k property) — hitting it means corrupted indices.
+bool InvertMatrix(std::vector<uint8_t>* a_io, int k) {
+  std::vector<uint8_t>& a = *a_io;
+  std::vector<uint8_t> inv(static_cast<size_t>(k) * k, 0);
+  for (int i = 0; i < k; ++i) inv[i * k + i] = 1;
+  for (int col = 0; col < k; ++col) {
+    int pivot = -1;
+    for (int r = col; r < k; ++r)
+      if (a[r * k + col] != 0) { pivot = r; break; }
+    if (pivot < 0) return false;
+    if (pivot != col) {
+      for (int c = 0; c < k; ++c) {
+        std::swap(a[col * k + c], a[pivot * k + c]);
+        std::swap(inv[col * k + c], inv[pivot * k + c]);
+      }
+    }
+    uint8_t scale = gf256::Inv(a[col * k + col]);
+    for (int c = 0; c < k; ++c) {
+      a[col * k + c] = gf256::Mul(scale, a[col * k + c]);
+      inv[col * k + c] = gf256::Mul(scale, inv[col * k + c]);
+    }
+    for (int r = 0; r < k; ++r) {
+      uint8_t f = a[r * k + col];
+      if (r == col || f == 0) continue;
+      for (int c = 0; c < k; ++c) {
+        a[r * k + c] ^= gf256::Mul(f, a[col * k + c]);
+        inv[r * k + c] ^= gf256::Mul(f, inv[col * k + c]);
+      }
+    }
+  }
+  a = std::move(inv);
+  return true;
+}
+
+bool WriteFileDurable(const std::string& path, const std::string& buf,
+                      std::string* err) {
+  std::string tmp = path + ".tmp";
+  int fd = open(tmp.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644);
+  if (fd < 0) {
+    *err = "open " + tmp + ": " + strerror(errno);
+    return false;
+  }
+  size_t off = 0;
+  while (off < buf.size()) {
+    ssize_t w = write(fd, buf.data() + off, buf.size() - off);
+    if (w <= 0) {
+      *err = "write " + tmp + ": " + strerror(errno);
+      close(fd);
+      unlink(tmp.c_str());
+      return false;
+    }
+    off += static_cast<size_t>(w);
+  }
+  bool ok = fsync(fd) == 0;
+  close(fd);
+  if (!ok || rename(tmp.c_str(), path.c_str()) != 0) {
+    *err = "commit " + path + ": " + strerror(errno);
+    unlink(tmp.c_str());
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+// -- RS codec -------------------------------------------------------------
+
+std::vector<std::string> RsEncode(const std::vector<std::string>& data,
+                                  int m) {
+  int k = static_cast<int>(data.size());
+  int64_t shard_len = k > 0 ? static_cast<int64_t>(data[0].size()) : 0;
+  std::vector<std::string> parity(static_cast<size_t>(m),
+                                  std::string(shard_len, '\0'));
+  for (int j = 0; j < m; ++j) {
+    auto* out = reinterpret_cast<uint8_t*>(parity[j].data());
+    for (int i = 0; i < k; ++i)
+      XorMulInto(gf256::CauchyCoeff(k, j, i),
+                 reinterpret_cast<const uint8_t*>(data[i].data()), out,
+                 shard_len);
+  }
+  return parity;
+}
+
+bool RsReconstruct(std::vector<std::string>* shards, int k, int m,
+                   int64_t shard_len) {
+  std::vector<std::string>& sh = *shards;
+  if (static_cast<int>(sh.size()) != k + m) return false;
+  // Pick the first k present shards as the decode basis (any k work —
+  // the Cauchy any-k property).
+  std::vector<int> present;
+  for (int s = 0; s < k + m && static_cast<int>(present.size()) < k; ++s)
+    if (!sh[s].empty()) present.push_back(s);
+  if (static_cast<int>(present.size()) < k) return false;
+  bool data_missing = false;
+  for (int i = 0; i < k; ++i)
+    if (sh[i].empty()) data_missing = true;
+  std::vector<std::string> data(static_cast<size_t>(k));
+  if (!data_missing) {
+    for (int i = 0; i < k; ++i) data[i] = sh[i];
+  } else {
+    // rows of [I; C] for the present basis, inverted
+    std::vector<uint8_t> mat(static_cast<size_t>(k) * k, 0);
+    for (int r = 0; r < k; ++r) {
+      int s = present[r];
+      for (int i = 0; i < k; ++i)
+        mat[r * k + i] = s < k ? (i == s ? 1 : 0)
+                               : gf256::CauchyCoeff(k, s - k, i);
+    }
+    if (!InvertMatrix(&mat, k)) return false;
+    for (int i = 0; i < k; ++i) {
+      if (!sh[i].empty()) {
+        data[i] = sh[i];
+        continue;
+      }
+      data[i].assign(static_cast<size_t>(shard_len), '\0');
+      auto* out = reinterpret_cast<uint8_t*>(data[i].data());
+      for (int r = 0; r < k; ++r)
+        XorMulInto(mat[i * k + r],
+                   reinterpret_cast<const uint8_t*>(sh[present[r]].data()),
+                   out, shard_len);
+    }
+  }
+  for (int i = 0; i < k; ++i)
+    if (sh[i].empty()) sh[i] = data[i];
+  // Missing parity shards re-encode from the (now complete) data rows.
+  for (int j = 0; j < m; ++j) {
+    if (!sh[k + j].empty()) continue;
+    sh[k + j].assign(static_cast<size_t>(shard_len), '\0');
+    auto* out = reinterpret_cast<uint8_t*>(sh[k + j].data());
+    for (int i = 0; i < k; ++i)
+      XorMulInto(gf256::CauchyCoeff(k, j, i),
+                 reinterpret_cast<const uint8_t*>(data[i].data()), out,
+                 shard_len);
+  }
+  return true;
+}
+
+// -- store ----------------------------------------------------------------
+
+EcStore::EcStore(std::string dir, int k, int m)
+    : dir_(std::move(dir)), k_(k), m_(m) {
+  // ChunkStore mounts this before its data/ tree necessarily exists
+  // (first boot on a fresh store path) — own the whole prefix.
+  MakeDirs(dir_);
+}
+
+std::string EcStore::ShardPath(int64_t stripe_id, int shard_idx) const {
+  char buf[64];
+  snprintf(buf, sizeof(buf), "/%010lld.s%02d",
+           static_cast<long long>(stripe_id), shard_idx);
+  return dir_ + buf;
+}
+
+std::string EcStore::ManifestPath(int64_t stripe_id) const {
+  char buf[64];
+  snprintf(buf, sizeof(buf), "/%010lld.mft",
+           static_cast<long long>(stripe_id));
+  return dir_ + buf;
+}
+
+int64_t EcStore::Rescan() {
+  std::lock_guard<RankedMutex> lk(mu_);
+  MakeDirs(dir_);
+  stripes_.clear();
+  index_.clear();
+  next_stripe_id_ = 0;
+  std::vector<std::string> shard_files;
+  DIR* d = opendir(dir_.c_str());
+  if (d != nullptr) {
+    struct dirent* de;
+    while ((de = readdir(d)) != nullptr) {
+      std::string name = de->d_name;
+      if (name.size() == 14 &&
+          name.compare(name.size() - 4, 4, ".mft") == 0) {
+        int64_t id = strtoll(name.c_str(), nullptr, 10);
+        std::string buf;
+        if (!ReadWholeFile(dir_ + "/" + name, &buf) ||
+            buf.size() < kManifestFixed + 4 ||
+            memcmp(buf.data(), kManifestMagic, 8) != 0) {
+          FDFS_LOG_WARN("ec: unreadable manifest %s ignored", name.c_str());
+          continue;
+        }
+        const uint8_t* p = reinterpret_cast<const uint8_t*>(buf.data());
+        uint32_t crc = GetInt32BE(p + buf.size() - 4);
+        if (Crc32(buf.data(), buf.size() - 4) != crc) {
+          FDFS_LOG_WARN("ec: manifest %s failed crc — stripe ignored "
+                        "(shards kept for forensics)", name.c_str());
+          continue;
+        }
+        Stripe s;
+        s.k = static_cast<int>(GetInt32BE(p + 8));
+        s.m = static_cast<int>(GetInt32BE(p + 12));
+        s.shard_len = GetInt64BE(p + 16);
+        s.data_len = GetInt64BE(p + 24);
+        int64_t count = GetInt64BE(p + 32);
+        // Drain mode (ec_k = 0 with stripes on disk): adopt the on-disk
+        // geometry so existing stripes stay readable; EncodeStripe still
+        // refuses, so the tier only shrinks.
+        if (k_ == 0 && s.k > 0 && s.k + s.m <= 255) {
+          k_ = s.k;
+          m_ = s.m;
+          drained_ = true;
+        }
+        if (s.k != k_ || s.m != m_) {
+          FDFS_LOG_ERROR("ec: stripe %lld has geometry %d+%d but this "
+                         "daemon runs %d+%d — stripe ignored (set ec_k/"
+                         "ec_m back, or drain before re-gearing)",
+                         static_cast<long long>(id), s.k, s.m, k_, m_);
+          continue;
+        }
+        if (count < 0 ||
+            buf.size() !=
+                kManifestFixed +
+                    static_cast<size_t>(count) * kManifestPerChunk + 4)
+          continue;
+        for (int64_t c = 0; c < count; ++c) {
+          const uint8_t* rec = p + kManifestFixed + c * kManifestPerChunk;
+          ChunkSlot slot;
+          slot.digest_hex = BytesToHex(rec, 20);
+          slot.offset = GetInt64BE(rec + 20);
+          slot.length = GetInt64BE(rec + 28);
+          slot.dead = rec[36] != 0;
+          s.chunks.push_back(std::move(slot));
+        }
+        for (size_t c = 0; c < s.chunks.size(); ++c)
+          if (!s.chunks[c].dead)
+            index_[s.chunks[c].digest_hex] =
+                Loc{id, static_cast<int32_t>(c)};
+        if (id >= next_stripe_id_) next_stripe_id_ = id + 1;
+        stripes_[id] = std::move(s);
+      } else if (name.size() > 4 && name[0] != '.' &&
+                 name.find(".s") == 10) {
+        shard_files.push_back(name);
+      }
+    }
+    closedir(d);
+  }
+  // Orphan shard files — a crash before the manifest commit.  Shards of
+  // a manifest that failed CRC are NOT orphans (the id is known): those
+  // stay for the operator / a future repair pass.
+  int64_t orphans = 0;
+  for (const std::string& name : shard_files) {
+    int64_t id = strtoll(name.c_str(), nullptr, 10);
+    struct stat st;
+    if (stat(ManifestPath(id).c_str(), &st) != 0) {
+      unlink((dir_ + "/" + name).c_str());
+      ++orphans;
+      if (id >= next_stripe_id_) next_stripe_id_ = id + 1;
+    }
+  }
+  RecountLocked();
+  if (!stripes_.empty() || orphans > 0)
+    FDFS_LOG_INFO("ec store: %zu stripes, %zu live chunks, %lld orphan "
+                  "shard files collected",
+                  stripes_.size(), index_.size(),
+                  static_cast<long long>(orphans));
+  return static_cast<int64_t>(stripes_.size());
+}
+
+void EcStore::RecountLocked() {
+  int64_t chunks = 0, data = 0, physical = 0;
+  for (const auto& [id, s] : stripes_) {
+    (void)id;
+    for (const ChunkSlot& c : s.chunks) {
+      if (c.dead) continue;
+      ++chunks;
+      data += c.length;
+    }
+    physical += static_cast<int64_t>(s.k + s.m) *
+                (s.shard_len + static_cast<int64_t>(kShardHeader));
+  }
+  stripes_gauge_.store(static_cast<int64_t>(stripes_.size()));
+  chunks_gauge_.store(chunks);
+  data_bytes_gauge_.store(data);
+  parity_bytes_gauge_.store(physical > data ? physical - data : 0);
+}
+
+bool EcStore::WriteShardLocked(int64_t stripe_id, const Stripe& s, int idx,
+                               const std::string& payload,
+                               std::string* err) const {
+  std::string buf(kShardHeader, '\0');
+  memcpy(buf.data(), kShardMagic, 8);
+  auto* p = reinterpret_cast<uint8_t*>(buf.data());
+  PutInt64BE(stripe_id, p + 8);
+  PutInt32BE(static_cast<uint32_t>(idx), p + 16);
+  PutInt32BE(static_cast<uint32_t>(s.k), p + 20);
+  PutInt32BE(static_cast<uint32_t>(s.m), p + 24);
+  PutInt64BE(s.shard_len, p + 28);
+  PutInt64BE(s.data_len, p + 36);
+  PutInt32BE(Crc32(payload.data(), payload.size()), p + 44);
+  PutInt32BE(Crc32(buf.data(), 48), p + 48);
+  buf += payload;
+  return WriteFileDurable(ShardPath(stripe_id, idx), buf, err);
+}
+
+bool EcStore::WriteManifestLocked(int64_t stripe_id, const Stripe& s,
+                                  std::string* err) const {
+  std::string buf(kManifestFixed, '\0');
+  memcpy(buf.data(), kManifestMagic, 8);
+  auto* p = reinterpret_cast<uint8_t*>(buf.data());
+  PutInt32BE(static_cast<uint32_t>(s.k), p + 8);
+  PutInt32BE(static_cast<uint32_t>(s.m), p + 12);
+  PutInt64BE(s.shard_len, p + 16);
+  PutInt64BE(s.data_len, p + 24);
+  PutInt64BE(static_cast<int64_t>(s.chunks.size()), p + 32);
+  for (const ChunkSlot& c : s.chunks) {
+    std::string raw;
+    HexToBytes(c.digest_hex, &raw);
+    raw.resize(20, '\0');
+    buf += raw;
+    uint8_t num[8];
+    PutInt64BE(c.offset, num);
+    buf.append(reinterpret_cast<char*>(num), 8);
+    PutInt64BE(c.length, num);
+    buf.append(reinterpret_cast<char*>(num), 8);
+    buf.push_back(c.dead ? '\x01' : '\x00');
+  }
+  uint8_t crc[4];
+  PutInt32BE(Crc32(buf.data(), buf.size()), crc);
+  buf.append(reinterpret_cast<char*>(crc), 4);
+  return WriteFileDurable(ManifestPath(stripe_id), buf, err);
+}
+
+int64_t EcStore::EncodeStripe(
+    const std::vector<std::pair<std::string, std::string>>& chunks,
+    std::string* err) {
+  if (chunks.empty()) {
+    *err = "empty stripe";
+    return -1;
+  }
+  std::lock_guard<RankedMutex> lk(mu_);
+  if (k_ <= 0 || m_ <= 0 || drained_) {
+    *err = "ec tier is read-only (ec_k = 0: drain mode)";
+    return -1;
+  }
+  Stripe s;
+  s.k = k_;
+  s.m = m_;
+  for (const auto& [dig, payload] : chunks) {
+    ChunkSlot slot;
+    slot.digest_hex = dig;
+    slot.offset = s.data_len;
+    slot.length = static_cast<int64_t>(payload.size());
+    s.data_len += slot.length;
+    s.chunks.push_back(std::move(slot));
+  }
+  s.shard_len = (s.data_len + k_ - 1) / k_;
+  if (s.shard_len == 0) s.shard_len = 1;  // degenerate all-empty chunks
+  // Concatenate + split into k data shards (zero-padded tail).
+  std::vector<std::string> data(static_cast<size_t>(k_),
+                                std::string(s.shard_len, '\0'));
+  {
+    int64_t off = 0;
+    for (const auto& [dig, payload] : chunks) {
+      (void)dig;
+      for (size_t i = 0; i < payload.size(); ++i, ++off)
+        data[off / s.shard_len][off % s.shard_len] = payload[i];
+    }
+  }
+  std::vector<std::string> parity = RsEncode(data, m_);
+  int64_t id = next_stripe_id_++;
+  for (int i = 0; i < k_; ++i)
+    if (!WriteShardLocked(id, s, i, data[i], err)) return -1;
+  for (int j = 0; j < m_; ++j)
+    if (!WriteShardLocked(id, s, k_ + j, parity[j], err)) return -1;
+  // Manifest rename = commit.  Before it, the shard files are invisible
+  // to Rescan; after it, the stripe is fully durable.
+  if (!WriteManifestLocked(id, s, err)) return -1;
+  for (size_t c = 0; c < s.chunks.size(); ++c)
+    index_[s.chunks[c].digest_hex] = Loc{id, static_cast<int32_t>(c)};
+  stripes_[id] = std::move(s);
+  RecountLocked();
+  if (events_ != nullptr)
+    events_->Record(EventSeverity::kInfo, "ec.stripe_encoded",
+                    std::to_string(id),
+                    "chunks=" + std::to_string(chunks.size()) + " bytes=" +
+                        std::to_string(stripes_[id].data_len));
+  return id;
+}
+
+bool EcStore::ReadShardLocked(int64_t stripe_id, const Stripe& s, int idx,
+                              std::string* out) const {
+  std::string buf;
+  if (!ReadWholeFile(ShardPath(stripe_id, idx), &buf)) return false;
+  if (buf.size() != kShardHeader + static_cast<size_t>(s.shard_len) ||
+      memcmp(buf.data(), kShardMagic, 8) != 0)
+    return false;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(buf.data());
+  if (GetInt32BE(p + 48) != Crc32(buf.data(), 48)) return false;
+  if (GetInt64BE(p + 8) != stripe_id ||
+      static_cast<int>(GetInt32BE(p + 16)) != idx ||
+      GetInt64BE(p + 28) != s.shard_len)
+    return false;
+  if (GetInt32BE(p + 44) !=
+      Crc32(buf.data() + kShardHeader, static_cast<size_t>(s.shard_len)))
+    return false;
+  out->assign(buf, kShardHeader, static_cast<size_t>(s.shard_len));
+  return true;
+}
+
+bool EcStore::LoadDataShardsLocked(int64_t stripe_id, const Stripe& s,
+                                   std::vector<std::string>* data) const {
+  std::vector<std::string> shards(static_cast<size_t>(s.k + s.m));
+  int present = 0;
+  for (int i = 0; i < s.k + s.m && present < s.k; ++i)
+    if (ReadShardLocked(stripe_id, s, i, &shards[i])) ++present;
+  if (present < s.k) return false;
+  if (!RsReconstruct(&shards, s.k, s.m, s.shard_len)) return false;
+  data->assign(shards.begin(), shards.begin() + s.k);
+  return true;
+}
+
+bool EcStore::Has(const std::string& digest_hex) const {
+  std::lock_guard<RankedMutex> lk(mu_);
+  return index_.find(digest_hex) != index_.end();
+}
+
+bool EcStore::ReadChunk(const std::string& digest_hex,
+                        std::string* out) const {
+  std::lock_guard<RankedMutex> lk(mu_);
+  auto it = index_.find(digest_hex);
+  if (it == index_.end()) return false;
+  const Stripe& s = stripes_.at(it->second.stripe_id);
+  const ChunkSlot& c = s.chunks[static_cast<size_t>(it->second.slot)];
+  // Healthy path: offset math over the 1-2 data shard files that cover
+  // [offset, offset+length), no field arithmetic.
+  out->resize(static_cast<size_t>(c.length));
+  bool ok = true;
+  {
+    int64_t off = c.offset, got = 0;
+    std::string shard;
+    int cached_idx = -1;
+    while (got < c.length && ok) {
+      int idx = static_cast<int>(off / s.shard_len);
+      int64_t in_shard = off % s.shard_len;
+      int64_t take = s.shard_len - in_shard;
+      if (take > c.length - got) take = c.length - got;
+      if (idx != cached_idx) {
+        ok = ReadShardLocked(it->second.stripe_id, s, idx, &shard);
+        cached_idx = idx;
+      }
+      if (ok) memcpy(out->data() + got, shard.data() + in_shard,
+                     static_cast<size_t>(take));
+      got += take;
+      off += take;
+    }
+  }
+  if (ok && Sha1(out->data(), out->size()).Hex() == digest_hex) return true;
+  // Shard lost or bytes rotted: decode the stripe from parity.
+  std::vector<std::string> data;
+  if (!LoadDataShardsLocked(it->second.stripe_id, s, &data)) return false;
+  for (int64_t i = 0; i < c.length; ++i) {
+    int64_t off = c.offset + i;
+    (*out)[static_cast<size_t>(i)] =
+        data[static_cast<size_t>(off / s.shard_len)]
+            [static_cast<size_t>(off % s.shard_len)];
+  }
+  return Sha1(out->data(), out->size()).Hex() == digest_hex;
+}
+
+bool EcStore::ReadChunkSlice(const std::string& digest_hex, int64_t offset,
+                             int64_t len, char* dst) const {
+  std::lock_guard<RankedMutex> lk(mu_);
+  auto it = index_.find(digest_hex);
+  if (it == index_.end()) return false;
+  const Stripe& s = stripes_.at(it->second.stripe_id);
+  const ChunkSlot& c = s.chunks[static_cast<size_t>(it->second.slot)];
+  if (offset < 0 || len < 0 || offset + len > c.length) return false;
+  std::string shard;
+  int cached_idx = -1;
+  bool ok = true;
+  int64_t off = c.offset + offset, got = 0;
+  while (got < len && ok) {
+    int idx = static_cast<int>(off / s.shard_len);
+    int64_t in_shard = off % s.shard_len;
+    int64_t take = s.shard_len - in_shard;
+    if (take > len - got) take = len - got;
+    if (idx != cached_idx) {
+      ok = ReadShardLocked(it->second.stripe_id, s, idx, &shard);
+      cached_idx = idx;
+    }
+    if (ok) memcpy(dst + got, shard.data() + in_shard,
+                   static_cast<size_t>(take));
+    got += take;
+    off += take;
+  }
+  if (ok) return true;
+  std::vector<std::string> data;
+  if (!LoadDataShardsLocked(it->second.stripe_id, s, &data)) return false;
+  for (int64_t i = 0; i < len; ++i) {
+    int64_t o = c.offset + offset + i;
+    dst[i] = data[static_cast<size_t>(o / s.shard_len)]
+                 [static_cast<size_t>(o % s.shard_len)];
+  }
+  return true;
+}
+
+bool EcStore::MarkDead(const std::string& digest_hex,
+                       int64_t* reclaimed_bytes) {
+  std::lock_guard<RankedMutex> lk(mu_);
+  auto it = index_.find(digest_hex);
+  if (it == index_.end()) return false;
+  int64_t id = it->second.stripe_id;
+  Stripe& s = stripes_[id];
+  s.chunks[static_cast<size_t>(it->second.slot)].dead = true;
+  index_.erase(it);
+  bool any_live = false;
+  for (const ChunkSlot& c : s.chunks)
+    if (!c.dead) any_live = true;
+  if (!any_live) {
+    int64_t freed = 0;
+    for (int i = 0; i < s.k + s.m; ++i) {
+      struct stat st;
+      if (stat(ShardPath(id, i).c_str(), &st) == 0) freed += st.st_size;
+      unlink(ShardPath(id, i).c_str());
+    }
+    struct stat st;
+    if (stat(ManifestPath(id).c_str(), &st) == 0) freed += st.st_size;
+    unlink(ManifestPath(id).c_str());
+    stripes_.erase(id);
+    if (reclaimed_bytes != nullptr) *reclaimed_bytes += freed;
+    RecountLocked();
+    return true;
+  }
+  // Dead flag must survive a restart (or GC'd chunks resurrect into the
+  // index at Rescan); manifest rewrite is tmp+rename like the commit.
+  std::string err;
+  if (!WriteManifestLocked(id, s, &err))
+    FDFS_LOG_WARN("ec: manifest rewrite after MarkDead(%s): %s",
+                  digest_hex.c_str(), err.c_str());
+  RecountLocked();
+  return true;
+}
+
+bool EcStore::VerifyStripe(int64_t stripe_id, std::string* err) {
+  std::lock_guard<RankedMutex> lk(mu_);
+  auto it = stripes_.find(stripe_id);
+  if (it == stripes_.end()) {
+    *err = "no such stripe";
+    return false;
+  }
+  const Stripe& s = it->second;
+  // Parity-heavy decode basis: take the LAST k shards, so every parity
+  // shard participates and the check exercises real reconstruction
+  // (data-only would just re-read the bytes we wrote).
+  std::vector<std::string> shards(static_cast<size_t>(s.k + s.m));
+  for (int i = s.k + s.m - 1, kept = 0; i >= 0 && kept < s.k; --i) {
+    if (!ReadShardLocked(stripe_id, s, i, &shards[i])) {
+      *err = "shard " + std::to_string(i) + " unreadable";
+      return false;
+    }
+    ++kept;
+  }
+  if (!RsReconstruct(&shards, s.k, s.m, s.shard_len)) {
+    *err = "reconstruct failed";
+    return false;
+  }
+  for (const ChunkSlot& c : s.chunks) {
+    if (c.dead) continue;
+    std::string payload(static_cast<size_t>(c.length), '\0');
+    for (int64_t i = 0; i < c.length; ++i) {
+      int64_t off = c.offset + i;
+      payload[static_cast<size_t>(i)] =
+          shards[static_cast<size_t>(off / s.shard_len)]
+                [static_cast<size_t>(off % s.shard_len)];
+    }
+    if (Sha1(payload.data(), payload.size()).Hex() != c.digest_hex) {
+      *err = "chunk " + c.digest_hex + " decodes wrong";
+      return false;
+    }
+  }
+  return true;
+}
+
+std::vector<int64_t> EcStore::StripeIds() const {
+  std::lock_guard<RankedMutex> lk(mu_);
+  std::vector<int64_t> ids;
+  ids.reserve(stripes_.size());
+  for (const auto& [id, s] : stripes_) {
+    (void)s;
+    ids.push_back(id);
+  }
+  return ids;
+}
+
+EcStore::StripeHealth EcStore::VerifyRepairStripe(
+    int64_t stripe_id, std::vector<ChunkRef>* lost_live,
+    int64_t* shards_rebuilt, int64_t* bytes_rebuilt, int64_t* bytes_read) {
+  std::lock_guard<RankedMutex> lk(mu_);
+  auto it = stripes_.find(stripe_id);
+  if (it == stripes_.end()) return StripeHealth::kHealthy;
+  const Stripe& s = it->second;
+  std::vector<std::string> shards(static_cast<size_t>(s.k + s.m));
+  std::vector<int> bad;
+  for (int i = 0; i < s.k + s.m; ++i) {
+    if (ReadShardLocked(stripe_id, s, i, &shards[i]))
+      *bytes_read += s.shard_len;
+    else
+      bad.push_back(i);
+  }
+  if (bad.empty()) return StripeHealth::kHealthy;
+  if (static_cast<int>(bad.size()) > s.m ||
+      !RsReconstruct(&shards, s.k, s.m, s.shard_len)) {
+    // Past parity: report the live chunks so the scrubber refills them
+    // from group replicas (FETCH_CHUNK) and re-promotes to the
+    // replicated tier.
+    for (const ChunkSlot& c : s.chunks)
+      if (!c.dead) lost_live->push_back({c.digest_hex, c.length});
+    if (events_ != nullptr)
+      events_->Record(EventSeverity::kError, "ec.stripe_lost",
+                      std::to_string(stripe_id),
+                      "bad_shards=" + std::to_string(bad.size()));
+    return StripeHealth::kLost;
+  }
+  for (int i : bad) {
+    std::string err;
+    if (!WriteShardLocked(stripe_id, s, i, shards[i], &err)) {
+      FDFS_LOG_WARN("ec: shard %lld.%d rewrite failed: %s",
+                    static_cast<long long>(stripe_id), i, err.c_str());
+      continue;
+    }
+    ++*shards_rebuilt;
+    *bytes_rebuilt += s.shard_len;
+  }
+  if (events_ != nullptr)
+    events_->Record(EventSeverity::kWarn, "ec.stripe_repaired",
+                    std::to_string(stripe_id),
+                    "shards=" + std::to_string(bad.size()));
+  return StripeHealth::kRepaired;
+}
+
+void EcStore::DropStripe(int64_t stripe_id, int64_t* reclaimed_bytes) {
+  std::lock_guard<RankedMutex> lk(mu_);
+  auto it = stripes_.find(stripe_id);
+  if (it == stripes_.end()) return;
+  int64_t freed = 0;
+  for (int i = 0; i < it->second.k + it->second.m; ++i) {
+    struct stat st;
+    if (stat(ShardPath(stripe_id, i).c_str(), &st) == 0)
+      freed += st.st_size;
+    unlink(ShardPath(stripe_id, i).c_str());
+  }
+  struct stat st;
+  if (stat(ManifestPath(stripe_id).c_str(), &st) == 0) freed += st.st_size;
+  unlink(ManifestPath(stripe_id).c_str());
+  for (const ChunkSlot& c : it->second.chunks)
+    if (!c.dead) index_.erase(c.digest_hex);
+  stripes_.erase(it);
+  if (reclaimed_bytes != nullptr) *reclaimed_bytes += freed;
+  RecountLocked();
+}
+
+// -- release.map ----------------------------------------------------------
+// Text journal, one "digest_hex length" line per pending chunk: the
+// owner appends + fsyncs BEFORE the first EC_RELEASE goes out, so a
+// crash mid-handover replays the batch next pass (the RPC is
+// idempotent on peers).  Truncated once every peer answered.
+
+bool EcStore::AppendReleaseMap(
+    const std::vector<std::pair<std::string, int64_t>>& batch,
+    std::string* err) {
+  std::lock_guard<RankedMutex> lk(mu_);
+  std::string path = dir_ + "/release.map";
+  int fd = open(path.c_str(), O_CREAT | O_WRONLY | O_APPEND, 0644);
+  if (fd < 0) {
+    *err = "open " + path + ": " + strerror(errno);
+    return false;
+  }
+  std::string buf;
+  for (const auto& [dig, len] : batch)
+    buf += dig + " " + std::to_string(len) + "\n";
+  bool ok = write(fd, buf.data(), buf.size()) ==
+                static_cast<ssize_t>(buf.size()) &&
+            fsync(fd) == 0;
+  close(fd);
+  if (!ok) *err = "append " + path + ": " + strerror(errno);
+  return ok;
+}
+
+std::vector<std::pair<std::string, int64_t>> EcStore::PendingReleases()
+    const {
+  std::lock_guard<RankedMutex> lk(mu_);
+  std::vector<std::pair<std::string, int64_t>> out;
+  std::string buf;
+  if (!ReadWholeFile(dir_ + "/release.map", &buf)) return out;
+  size_t pos = 0;
+  while (pos < buf.size()) {
+    size_t eol = buf.find('\n', pos);
+    if (eol == std::string::npos) eol = buf.size();
+    std::string line = buf.substr(pos, eol - pos);
+    pos = eol + 1;
+    size_t sp = line.find(' ');
+    if (sp != 40) continue;  // torn tail line from a crash mid-append
+    out.emplace_back(line.substr(0, 40),
+                     strtoll(line.c_str() + 41, nullptr, 10));
+  }
+  return out;
+}
+
+void EcStore::ClearReleaseMap() {
+  std::lock_guard<RankedMutex> lk(mu_);
+  unlink((dir_ + "/release.map").c_str());
+}
+
+}  // namespace fdfs

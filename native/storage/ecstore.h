@@ -1,0 +1,231 @@
+// Erasure-coded cold tier: RS(k, m) stripes of cold chunk payloads.
+//
+// North star (ROADMAP item 2 / ISSUE 16): full intra-group replication
+// pays 2-3x bytes for every chunk forever.  Cold chunks past
+// ec_demote_age_s are concatenated into stripes, split into k equal
+// data shards, and extended with m systematic Cauchy parity shards
+// (GF(2^8) tables from tools/gen_gf_tables.py — the same field the
+// Python kernels in fastdfs_tpu/ops/rs_code.py run, pinned by the
+// fdfs_codec gf-tables golden).  The stripe survives ANY m shard
+// losses at (k+m)/k overhead; the replicated copies are then released
+// group-wide by scrub stage 5's verify-then-release handover.
+//
+// Disk layout, under <store_path>/data/ec/ :
+//
+//   <10-digit-id>.s<NN>   shard files (NN = 00..k+m-1), CRC-framed:
+//     0   8B  magic "FDFSECS1"
+//     8   8B  stripe id BE
+//     16  4B  shard index BE
+//     20  4B  k BE
+//     24  4B  m BE
+//     28  8B  shard_len BE
+//     36  8B  data_len BE (logical bytes in the stripe's data region)
+//     44  4B  payload crc32 BE
+//     48  4B  header crc32 BE (over bytes 0..47)
+//     52      shard payload (shard_len bytes)
+//
+//   <10-digit-id>.mft     stripe manifest, keyed by chunk digests:
+//     0   8B  magic "FDFSECM1"
+//     8   4B  k BE
+//     12  4B  m BE
+//     16  8B  shard_len BE
+//     24  8B  data_len BE
+//     32  8B  chunk count BE
+//     40      per chunk: 20B raw digest + 8B offset BE + 8B length BE
+//             + 1B dead flag                              (37B each)
+//     end 4B  crc32 BE over everything before it
+//
+//   release.map           verify-then-release journal (see below)
+//   released.log          peer-side released-chunk journal (owned by
+//                         ChunkStore, documented here for the layout)
+//
+// The MANIFEST RENAME IS THE COMMIT POINT (the recipe-file discipline):
+// shard files are written first, the manifest lands tmp+rename+fsync,
+// and Rescan() unlinks any shard file whose stripe has no manifest — a
+// crash mid-encode costs nothing but orphan cleanup.  The data region
+// is the chunks' payloads concatenated; shard_len = ceil(data_len / k)
+// with zero padding, so a healthy chunk read is pure offset math over
+// 1-2 data shard files (no field arithmetic).  Parity decode runs only
+// when a shard read fails or a full-chunk read fails its SHA1 check.
+//
+// Deletes (Quarantine/GC/DELETE reclaiming parity bytes): MarkDead
+// flips the chunk's dead flag and rewrites the manifest; when the last
+// live chunk dies the WHOLE stripe — parity included — is unlinked and
+// its physical bytes reported reclaimed.  Partially-dead stripes keep
+// their bytes (EC stripe compaction is deferred work; the parity_bytes
+// gauge makes the dead fraction visible — OPERATIONS.md runbook).
+//
+// release.map (rebalance.map discipline): before any peer is told to
+// drop its replica of a freshly-encoded batch (EC_RELEASE), the batch
+// is appended here and fsynced.  A crash between the EC commit and the
+// peer handover replays the batch next pass — the release RPC is
+// idempotent on the peer — and the file is truncated once every peer
+// answered.
+//
+// Locking: one mutex (LockRank::kEcStore = 96), self-locked, calls
+// nothing that locks.  Shard-file IO runs under it by design (the
+// kTrunkAlloc/kSlabStore precedent) — this is a COLD tier; hot reads
+// hit the replicated layouts or the read cache first, and an EC read
+// that serializes behind another is still a disk-bound cold read.
+// ChunkStore calls in while holding a digest stripe lock (rank 90), so
+// 96 sits between kSlabIndex and kReadCache.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <string>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+#include "common/lockrank.h"
+
+namespace fdfs {
+
+// -- RS(k, m) codec over GF(2^8) (common/gf256.h tables) ------------------
+// Shared with fdfs_codec (gf-tables golden) and storage_test units.
+
+// m parity shards for k equal-length data shards (systematic Cauchy).
+std::vector<std::string> RsEncode(const std::vector<std::string>& data,
+                                  int m);
+// Fill the absent entries of `shards` (size k+m; absent = empty string,
+// present entries all shard_len bytes) by decoding any k present
+// shards.  False when fewer than k are present.  Rebuilds data AND
+// parity shards.
+bool RsReconstruct(std::vector<std::string>* shards, int k, int m,
+                   int64_t shard_len);
+
+class EventLog;
+
+class EcStore {
+ public:
+  // dir = <store_path>/data/ec.  Geometry is fixed per store lifetime;
+  // Rescan() refuses manifests with a different k/m (operator error —
+  // re-silvering across geometries is not built).
+  EcStore(std::string dir, int k, int m);
+
+  void set_events(EventLog* events) { events_ = events; }
+
+  int k() const { return k_; }
+  int m() const { return m_; }
+
+  // Boot scan: load every manifest (CRC-checked), index live chunks,
+  // unlink orphan shard files from crashed encodes.  Returns stripes.
+  int64_t Rescan();
+
+  // Encode chunks (digest_hex, payload) into one committed stripe.
+  // Returns the stripe id, or -1 with *err.  The caller owns candidate
+  // selection and pacing; digests already EC-resident are a caller bug
+  // (the index keeps the OLD location — content-addressed, same bytes).
+  int64_t EncodeStripe(
+      const std::vector<std::pair<std::string, std::string>>& chunks,
+      std::string* err);
+
+  // The "verify" of verify-then-release: re-read every shard from disk,
+  // CRC-check, reconstruct the data region from a parity-heavy subset
+  // of k shards (exercising the decode path, not just the write-back),
+  // and SHA1-check every live chunk against its digest.
+  bool VerifyStripe(int64_t stripe_id, std::string* err);
+
+  bool Has(const std::string& digest_hex) const;
+  // Full chunk payload; SHA1-verified, reconstructing from parity when
+  // a shard is missing/corrupt.  False when not EC-resident (or the
+  // stripe lost more than m shards).
+  bool ReadChunk(const std::string& digest_hex, std::string* out) const;
+  // Positional read; trusts shard bytes (no SHA1 — slices cannot be
+  // digest-checked), reconstructing only on IO failure.
+  bool ReadChunkSlice(const std::string& digest_hex, int64_t offset,
+                      int64_t len, char* dst) const;
+
+  // Flip the chunk dead; unlink the whole stripe when its last live
+  // chunk dies (*reclaimed_bytes += physical bytes freed then).  False
+  // when the digest is not EC-resident.
+  bool MarkDead(const std::string& digest_hex, int64_t* reclaimed_bytes);
+
+  // -- scrub repair --------------------------------------------------------
+  std::vector<int64_t> StripeIds() const;
+  enum class StripeHealth { kHealthy, kRepaired, kLost };
+  struct ChunkRef {
+    std::string digest_hex;
+    int64_t length = 0;
+  };
+  // CRC-verify every shard of a stripe; <= m bad/missing shards are
+  // reconstructed from parity and rewritten in place (kRepaired); more
+  // are unrecoverable (kLost) and *lost_live gets the stripe's live
+  // chunks so the caller can refill them via FETCH_CHUNK.  *bytes_read
+  // reports IO for the caller's pacing.
+  StripeHealth VerifyRepairStripe(int64_t stripe_id,
+                                  std::vector<ChunkRef>* lost_live,
+                                  int64_t* shards_rebuilt,
+                                  int64_t* bytes_rebuilt,
+                                  int64_t* bytes_read);
+  // Drop a stripe entirely (after a kLost fallback re-promoted its
+  // chunks to the replicated tier).
+  void DropStripe(int64_t stripe_id, int64_t* reclaimed_bytes);
+
+  // -- release.map ---------------------------------------------------------
+  bool AppendReleaseMap(
+      const std::vector<std::pair<std::string, int64_t>>& batch,
+      std::string* err);
+  std::vector<std::pair<std::string, int64_t>> PendingReleases() const;
+  void ClearReleaseMap();
+
+  // -- gauges (atomics: read by stats gauge-fns, must never block) ---------
+  int64_t stripes() const { return stripes_gauge_.load(); }
+  int64_t stripe_chunks() const { return chunks_gauge_.load(); }
+  int64_t data_bytes() const { return data_bytes_gauge_.load(); }
+  // Physical bytes on disk beyond the live chunks' logical bytes:
+  // parity shards + padding + dead (deleted-but-unreclaimed) regions.
+  int64_t parity_bytes() const { return parity_bytes_gauge_.load(); }
+
+ private:
+  struct ChunkSlot {
+    std::string digest_hex;
+    int64_t offset = 0;  // into the stripe's data region
+    int64_t length = 0;
+    bool dead = false;
+  };
+  struct Stripe {
+    int k = 0, m = 0;
+    int64_t shard_len = 0;
+    int64_t data_len = 0;
+    std::vector<ChunkSlot> chunks;
+  };
+  struct Loc {
+    int64_t stripe_id = 0;
+    int32_t slot = 0;
+  };
+
+  std::string ShardPath(int64_t stripe_id, int shard_idx) const;
+  std::string ManifestPath(int64_t stripe_id) const;
+  // mu_ held.  Read + CRC-check one shard's payload; false on any
+  // mismatch (caller reconstructs).
+  bool ReadShardLocked(int64_t stripe_id, const Stripe& s, int idx,
+                       std::string* out) const;
+  // mu_ held.  All k data shards of a stripe, reconstructing from
+  // parity when needed; false past parity.
+  bool LoadDataShardsLocked(int64_t stripe_id, const Stripe& s,
+                            std::vector<std::string>* data) const;
+  bool WriteShardLocked(int64_t stripe_id, const Stripe& s, int idx,
+                        const std::string& payload, std::string* err) const;
+  bool WriteManifestLocked(int64_t stripe_id, const Stripe& s,
+                           std::string* err) const;
+  void RecountLocked();
+
+  std::string dir_;
+  int k_ = 0, m_ = 0;
+  // Constructed with k = 0 over existing stripes: geometry adopted from
+  // the manifests at Rescan, encodes refused (read-only drain).
+  bool drained_ = false;
+  EventLog* events_ = nullptr;
+  mutable RankedMutex mu_{LockRank::kEcStore};
+  std::map<int64_t, Stripe> stripes_;              // ordered for StripeIds
+  std::unordered_map<std::string, Loc> index_;     // live digests only
+  int64_t next_stripe_id_ = 0;
+  std::atomic<int64_t> stripes_gauge_{0}, chunks_gauge_{0},
+      data_bytes_gauge_{0}, parity_bytes_gauge_{0};
+};
+
+}  // namespace fdfs

@@ -9,9 +9,11 @@
 
 #include "common/bytes.h"
 #include "common/eventlog.h"
+#include "common/jumphash.h"
 #include "common/log.h"
 #include "common/threadreg.h"
 #include "common/net.h"
+#include "storage/ecstore.h"
 
 namespace fdfs {
 
@@ -22,11 +24,31 @@ constexpr int kRpcTimeoutMs = 10000;
 // the RPC, small enough that a batch never holds more than a few MB.
 constexpr size_t kBatchChunks = 64;
 constexpr int64_t kBatchBytes = 4 << 20;
+// Demote batch bounds: a stripe wants enough chunks that the k-way
+// split does not degenerate, but one batch must never pin more than a
+// few MB of payloads in memory while encoding.
+constexpr size_t kEcBatchChunks = 512;
+constexpr int64_t kEcBatchBytes = 4 << 20;
 
 int64_t WallUs() {
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
   return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+// Jump-hash key for demote ownership: the first 8 raw digest bytes.
+// Every group member derives the same key from the same digest, so the
+// sorted-member-list jump hash names exactly one demoter per chunk.
+uint64_t DigestOwnerKey(const std::string& digest_hex) {
+  std::string raw;
+  if (digest_hex.size() < 16 ||
+      !HexToBytes(std::string_view(digest_hex).substr(0, 16), &raw) ||
+      raw.size() != 8)
+    return 0;
+  uint64_t key = 0;
+  for (int i = 0; i < 8; ++i)
+    key = (key << 8) | static_cast<uint8_t>(raw[i]);
+  return key;
 }
 
 }  // namespace
@@ -61,6 +83,11 @@ void ScrubManager::Kick() {
     kicked_ = true;
   }
   cv_.notify_all();
+}
+
+void ScrubManager::EcKick() {
+  ec_kicked_ = true;
+  Kick();
 }
 
 void ScrubManager::NoteRecipeReclaimed(int64_t bytes) {
@@ -109,6 +136,65 @@ int64_t ScrubManager::StatValue(int i) const {
   }
 }
 
+void ScrubManager::FillEcStats(int64_t* out) const {
+  static_assert(kEcStatCount == 16, "update EcStatValue + protocol.py");
+  for (int i = 0; i < kEcStatCount; ++i) out[i] = EcStatValue(i);
+}
+
+int64_t ScrubManager::EcStatValue(int i) const {
+  switch (i) {  // kEcStatNames order
+    case 0: {
+      for (ChunkStore* cs : stores_)
+        if (cs->ec_enabled()) return 1;
+      return 0;
+    }
+    case 1: return opts_.ec_k;
+    case 2: return opts_.ec_m;
+    case 3: {
+      int64_t n = 0;
+      for (ChunkStore* cs : stores_) n += cs->ec_stripes();
+      return n;
+    }
+    case 4: {
+      int64_t n = 0;
+      for (ChunkStore* cs : stores_) n += cs->ec_stripe_chunks();
+      return n;
+    }
+    case 5: {
+      int64_t n = 0;
+      for (ChunkStore* cs : stores_) n += cs->ec_data_bytes();
+      return n;
+    }
+    case 6: {
+      int64_t n = 0;
+      for (ChunkStore* cs : stores_) n += cs->ec_parity_bytes();
+      return n;
+    }
+    case 7: return ec_demoted_chunks_.load();
+    case 8: return ec_demoted_bytes_.load();
+    case 9: {
+      int64_t n = 0;
+      for (ChunkStore* cs : stores_) n += cs->released_chunks();
+      return n;
+    }
+    case 10: {
+      int64_t n = 0;
+      for (ChunkStore* cs : stores_) n += cs->released_bytes();
+      return n;
+    }
+    case 11: return ec_reconstructed_shards_.load();
+    case 12: return ec_reconstructed_bytes_.load();
+    case 13: return ec_repair_fallback_chunks_.load();
+    case 14: {
+      int64_t n = 0;
+      for (ChunkStore* cs : stores_) n += cs->ec_remote_reads();
+      return n;
+    }
+    case 15: return ec_last_demote_unix_.load();
+    default: return 0;
+  }
+}
+
 void ScrubManager::ThreadMain() {
   ScopedThreadName ledger("scrub");
   std::unique_lock<RankedMutex> lk(mu_);
@@ -151,6 +237,21 @@ void ScrubManager::Pace(int64_t bytes_read, int64_t pass_start_us) {
   }
 }
 
+void ScrubManager::PaceEc(int64_t bytes, int64_t pass_start_us) {
+  if (opts_.ec_bandwidth_bytes_s <= 0) return;
+  int64_t bw = opts_.ec_bandwidth_bytes_s;
+  int64_t budget_us = bytes / bw * 1000000 + (bytes % bw) * 1000000 / bw;
+  int64_t ahead_us = budget_us - (WallUs() - pass_start_us);
+  while (ahead_us > 0) {
+    {
+      std::lock_guard<RankedMutex> lk(mu_);
+      if (stop_) return;
+    }
+    usleep(static_cast<useconds_t>(std::min<int64_t>(ahead_us, 50000)));
+    ahead_us = budget_us - (WallUs() - pass_start_us);
+  }
+}
+
 void ScrubManager::RunPass() {
   running_ = true;
   int64_t start_us = WallUs();
@@ -171,6 +272,11 @@ void ScrubManager::RunPass() {
     pass_chunks_total_ += cs->unique_chunks();
 
   int64_t paced = 0;
+  int64_t ec_paced = 0;
+  // EC_KICK's one-shot age-gate override is consumed ONCE per pass,
+  // before the store loop, so every store path demotes under it.
+  int64_t ec_age =
+      ec_kicked_.exchange(false) ? 0 : opts_.ec_demote_age_s;
   bool aborted = false;
   for (size_t spi = 0; spi < stores_.size() && !aborted; ++spi) {
     ChunkStore* cs = stores_[spi];
@@ -203,6 +309,17 @@ void ScrubManager::RunPass() {
         while (i < live.size() && batch.size() < kBatchChunks &&
                batch_bytes < kBatchBytes) {
           const auto& info = live[i++];
+          // Demoted chunks are NOT re-verified through the transparent
+          // decode path: each such read rebuilds its whole stripe (k
+          // shard reads + an RS decode per CHUNK — quadratic over a
+          // stripe's chunks, and unpaced).  Their integrity engine is
+          // stage 5: VerifyRepairStripe CRCs every shard (header +
+          // payload) and repairs from parity under the ec bandwidth
+          // budget.
+          if (cs->ec_enabled() && cs->ec()->Has(info.digest_hex)) {
+            pass_chunks_done_.fetch_add(1, std::memory_order_relaxed);
+            continue;
+          }
           batch.push_back(info);
           payloads.emplace_back();
           // A missing or short chunk file is corruption too (truncation,
@@ -276,6 +393,17 @@ void ScrubManager::RunPass() {
                     "(%lld bytes reclaimed)",
                     static_cast<long long>(compacted), spi,
                     static_cast<long long>(slab_reclaimed));
+
+    // Stage 5 — erasure-coded cold tier (ISSUE 16).  Repair existing
+    // stripes from parity FIRST (the cheapest path back to full
+    // durability), then demote newly-cold chunks and hand the
+    // replicated copies over for release.  Paced by the SEPARATE
+    // ec_bandwidth bucket so stripe IO and verify reads do not fight
+    // over one budget.
+    if (cs->ec_enabled()) {
+      RunEcRepair(static_cast<int>(spi), start_us, &ec_paced);
+      RunEcDemote(static_cast<int>(spi), ec_age, start_us, &ec_paced);
+    }
   }
 
   int64_t dur = WallUs() - start_us;
@@ -469,6 +597,272 @@ bool ScrubManager::FetchFromReplica(int spi, const std::string& digest_hex,
     return true;
   }
   return false;
+}
+
+void ScrubManager::RunEcRepair(int spi, int64_t pass_start_us,
+                               int64_t* ec_paced) {
+  ChunkStore* cs = stores_[spi];
+  EcStore* ec = cs->ec();
+  if (ec == nullptr) return;
+  for (int64_t id : ec->StripeIds()) {
+    {
+      std::lock_guard<RankedMutex> lk(mu_);
+      if (stop_) return;
+    }
+    std::vector<EcStore::ChunkRef> lost;
+    int64_t rebuilt = 0, rebuilt_bytes = 0, bytes_read = 0;
+    EcStore::StripeHealth h =
+        ec->VerifyRepairStripe(id, &lost, &rebuilt, &rebuilt_bytes,
+                               &bytes_read);
+    if (h == EcStore::StripeHealth::kRepaired) {
+      // Publish the counters BEFORE paying the bandwidth debt: the
+      // rebuilt shards are already durable on disk, and a paced sleep
+      // here would leave EC_STATUS under-reporting finished repairs
+      // for seconds.
+      ec_reconstructed_shards_.fetch_add(rebuilt,
+                                         std::memory_order_relaxed);
+      ec_reconstructed_bytes_.fetch_add(rebuilt_bytes,
+                                        std::memory_order_relaxed);
+      FDFS_LOG_INFO("scrub ec: stripe %lld on store path %d rebuilt "
+                    "%lld shards (%lld bytes) from parity",
+                    static_cast<long long>(id), spi,
+                    static_cast<long long>(rebuilt),
+                    static_cast<long long>(rebuilt_bytes));
+    }
+    *ec_paced += bytes_read + rebuilt_bytes;
+    PaceEc(*ec_paced, pass_start_us);
+    if (h == EcStore::StripeHealth::kLost) {
+      // More than m shards gone: parity cannot help.  Re-promote every
+      // live chunk to the replicated tier via FETCH_CHUNK (the released
+      // peers fall through to OTHER stripes or remote owners), and only
+      // drop the carcass once every chunk is safe again.
+      FDFS_LOG_ERROR("scrub ec: stripe %lld on store path %d lost more "
+                     "than %d shards — re-promoting %zu chunks from "
+                     "replicas",
+                     static_cast<long long>(id), spi, ec->m(),
+                     lost.size());
+      bool all_recovered = true;
+      for (const EcStore::ChunkRef& ref : lost) {
+        {
+          std::lock_guard<RankedMutex> lk(mu_);
+          if (stop_) return;
+        }
+        std::string payload;
+        std::string err;
+        if (!FetchFromReplica(spi, ref.digest_hex, ref.length, &payload)) {
+          all_recovered = false;
+          corrupt_unrepairable_.fetch_add(1, std::memory_order_relaxed);
+          FDFS_LOG_ERROR("scrub ec: chunk %s unrecoverable — stripe lost "
+                         "and no replica serves it",
+                         ref.digest_hex.c_str());
+          if (events_ != nullptr)
+            events_->Record(EventSeverity::kError, "ec.chunk_lost",
+                            ref.digest_hex,
+                            "spi=" + std::to_string(spi) +
+                                " stripe=" + std::to_string(id));
+          continue;
+        }
+        *ec_paced += ref.length;
+        PaceEc(*ec_paced, pass_start_us);
+        if (cs->RepairChunk(ref.digest_hex, payload.data(), payload.size(),
+                            &err)) {
+          ec_repair_fallback_chunks_.fetch_add(1,
+                                               std::memory_order_relaxed);
+          if (events_ != nullptr)
+            events_->Record(EventSeverity::kWarn, "ec.chunk_repromoted",
+                            ref.digest_hex,
+                            "spi=" + std::to_string(spi) +
+                                " stripe=" + std::to_string(id));
+        } else if (err == "no longer referenced") {
+          // Deleted since the stripe was cut — nothing left to save.
+        } else {
+          all_recovered = false;
+          corrupt_unrepairable_.fetch_add(1, std::memory_order_relaxed);
+          FDFS_LOG_ERROR("scrub ec: chunk %s re-promotion write failed: %s",
+                         ref.digest_hex.c_str(), err.c_str());
+        }
+      }
+      if (all_recovered) {
+        int64_t reclaimed = 0;
+        ec->DropStripe(id, &reclaimed);
+        FDFS_LOG_INFO("scrub ec: dropped lost stripe %lld (%lld bytes) — "
+                      "all chunks re-promoted",
+                      static_cast<long long>(id),
+                      static_cast<long long>(reclaimed));
+      }
+    }
+  }
+}
+
+void ScrubManager::RunEcDemote(int spi, int64_t age_s, int64_t pass_start_us,
+                               int64_t* ec_paced) {
+  ChunkStore* cs = stores_[spi];
+  EcStore* ec = cs->ec();
+  if (ec == nullptr) return;
+
+  // Replay the release debt from an earlier pass (or a crash between
+  // demote and handover) BEFORE taking on more: release.map is cleared
+  // only once every peer answered, and the release RPC is idempotent.
+  auto pending = ec->PendingReleases();
+  if (!pending.empty()) {
+    if (!SendReleaseToPeers(spi, pending)) {
+      FDFS_LOG_WARN("scrub ec: %zu pending releases not delivered to all "
+                    "peers; retrying next pass",
+                    pending.size());
+      return;  // peers down — do not grow the debt
+    }
+    ec->ClearReleaseMap();
+  }
+  if (ec->k() <= 0) return;  // drained geometry: repairs only
+
+  auto cands = cs->SnapshotDemotable(time(nullptr), age_s);
+  if (cands.empty()) return;
+
+  // Exactly one group member demotes a given digest: jump hash over the
+  // SORTED member list (everyone computes the same list from the same
+  // peer set, so ownership is consistent without coordination).
+  std::vector<std::string> members =
+      peers_ != nullptr ? peers_() : std::vector<std::string>();
+  members.push_back(opts_.self_id);
+  std::sort(members.begin(), members.end());
+  members.erase(std::unique(members.begin(), members.end()), members.end());
+  int32_t self_idx = static_cast<int32_t>(
+      std::find(members.begin(), members.end(), opts_.self_id) -
+      members.begin());
+  int32_t n = static_cast<int32_t>(members.size());
+
+  bool peers_ok = true;
+  std::vector<ChunkStore::ChunkInfo> batch;
+  int64_t batch_bytes = 0;
+  auto flush = [&]() {
+    if (batch.empty()) return;
+    *ec_paced += batch_bytes;
+    PaceEc(*ec_paced, pass_start_us);
+    int64_t nchunks = 0, nbytes = 0;
+    std::string err;
+    int64_t id = cs->DemoteToEc(batch, &nchunks, &nbytes, &err);
+    if (id < 0) {
+      FDFS_LOG_WARN("scrub ec: demote batch (%zu chunks) failed on store "
+                    "path %d: %s",
+                    batch.size(), spi, err.c_str());
+      batch.clear();
+      batch_bytes = 0;
+      return;
+    }
+    ec_demoted_chunks_.fetch_add(nchunks, std::memory_order_relaxed);
+    ec_demoted_bytes_.fetch_add(nbytes, std::memory_order_relaxed);
+    ec_last_demote_unix_ = time(nullptr);
+    FDFS_LOG_INFO("scrub ec: demoted %lld chunks (%lld bytes) into stripe "
+                  "%lld on store path %d",
+                  static_cast<long long>(nchunks),
+                  static_cast<long long>(nbytes),
+                  static_cast<long long>(id), spi);
+    if (events_ != nullptr)
+      events_->Record(EventSeverity::kInfo, "ec.demoted",
+                      "M" + std::to_string(spi),
+                      "stripe=" + std::to_string(id) +
+                          " chunks=" + std::to_string(nchunks) +
+                          " bytes=" + std::to_string(nbytes));
+    // Verify-then-release: only chunks the EC tier actually holds may
+    // lose their replicas (DemoteToEc skips vanished/corrupt entries —
+    // releasing those would orphan the only good copies).
+    std::vector<std::pair<std::string, int64_t>> rel;
+    for (const ChunkStore::ChunkInfo& info : batch)
+      if (ec->Has(info.digest_hex))
+        rel.emplace_back(info.digest_hex, info.length);
+    if (!rel.empty()) {
+      std::string jerr;
+      if (!ec->AppendReleaseMap(rel, &jerr)) {
+        // No journal, no release: peers keep their replicas (pure
+        // over-replication — safe, reclaimed once the map writes again).
+        FDFS_LOG_ERROR("scrub ec: release.map append failed: %s — "
+                       "replicas kept",
+                       jerr.c_str());
+      } else if (!peers_ok) {
+        // A peer already failed this pass: journal the debt and let the
+        // next pass's replay deliver it.
+      } else if (SendReleaseToPeers(spi, rel)) {
+        ec->ClearReleaseMap();
+      } else {
+        peers_ok = false;
+      }
+    }
+    batch.clear();
+    batch_bytes = 0;
+  };
+
+  for (const ChunkStore::ChunkInfo& info : cands) {
+    {
+      std::lock_guard<RankedMutex> lk(mu_);
+      if (stop_) return;
+    }
+    if (n > 1 && JumpHash(DigestOwnerKey(info.digest_hex), n) != self_idx)
+      continue;
+    batch.push_back(info);
+    batch_bytes += info.length;
+    if (batch.size() >= kEcBatchChunks || batch_bytes >= kEcBatchBytes)
+      flush();
+  }
+  flush();
+}
+
+bool ScrubManager::SendReleaseToPeers(
+    int spi, const std::vector<std::pair<std::string, int64_t>>& batch) {
+  (void)spi;  // releases are digest-addressed; the peer finds the store
+  if (batch.empty()) return true;
+  std::vector<std::string> addrs =
+      peers_ != nullptr ? peers_() : std::vector<std::string>();
+  if (addrs.empty()) return true;  // single-node group: nothing to drop
+  std::string body;
+  PutFixedField(&body, group_name_, kGroupNameMaxLen);
+  uint8_t num[8];
+  PutInt64BE(static_cast<int64_t>(batch.size()), num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  for (const auto& chunk : batch) {
+    if (!HexToBytes(chunk.first, &body)) return false;
+    PutInt64BE(chunk.second, num);
+    body.append(reinterpret_cast<char*>(num), 8);
+  }
+  bool all = true;
+  for (const std::string& addr : addrs) {
+    size_t colon = addr.rfind(':');
+    if (colon == std::string::npos) {
+      all = false;
+      continue;
+    }
+    std::string err;
+    int fd = TcpConnect(addr.substr(0, colon),
+                        atoi(addr.c_str() + colon + 1), 3000, &err);
+    if (fd < 0) {
+      all = false;
+      FDFS_LOG_WARN("scrub ec: release peer %s unreachable: %s",
+                    addr.c_str(), err.c_str());
+      continue;
+    }
+    std::string resp;
+    uint8_t status = 0;
+    bool ok = NetRpc(fd, static_cast<uint8_t>(StorageCmd::kEcRelease), body,
+                     &resp, &status,
+                     static_cast<int64_t>(batch.size()) + 1024,
+                     kRpcTimeoutMs);
+    close(fd);
+    if (!ok || status != 0 || resp.size() != batch.size()) {
+      all = false;
+      FDFS_LOG_WARN("scrub ec: release to %s failed (status=%d)",
+                    addr.c_str(), static_cast<int>(status));
+      continue;
+    }
+    int64_t kept = 0;
+    for (char c : resp) kept += (c != 0) ? 1 : 0;
+    if (kept > 0)
+      // Pinned/quarantined chunks the peer retained keep full-replica
+      // coverage there; the owner's stripe is redundant for them, which
+      // is safe (over-replication, not exposure).
+      FDFS_LOG_INFO("scrub ec: peer %s kept %lld of %zu released chunks",
+                    addr.c_str(), static_cast<long long>(kept),
+                    batch.size());
+  }
+  return all;
 }
 
 }  // namespace fdfs

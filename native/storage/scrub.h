@@ -58,6 +58,14 @@ struct ScrubOptions {
   int interval_s = 0;          // 0 = no periodic passes (kick still works)
   int64_t bandwidth_bytes_s = 0;  // verify read pace; 0 = unlimited
   // (the GC grace window lives in ChunkStore — GcSweep enforces it)
+
+  // Erasure-coded cold tier (stage 5; storage.conf ec_* keys).  ec_k =
+  // 0 disables demotion (existing stripes still repair + drain).
+  int ec_k = 0;
+  int ec_m = 0;
+  int64_t ec_demote_age_s = 0;        // payload mtime age gate
+  int64_t ec_bandwidth_bytes_s = 0;   // demote/repair IO pace; 0 = unlimited
+  std::string self_id;  // this node's "ip:port" for jump-hash ownership
 };
 
 class ScrubManager {
@@ -82,9 +90,19 @@ class ScrubManager {
   // Schedule a full verify+repair+GC pass now (SCRUB_KICK).
   void Kick();
 
+  // EC_KICK: schedule a pass whose stage 5 demotes every eligible cold
+  // chunk IMMEDIATELY (the age gate drops to 0 for that one pass) — the
+  // operator's "drain the replicated tier now" lever, and what makes
+  // the kill-and-reconstruct acceptance test runnable without waiting
+  // out ec_demote_age_s.
+  void EcKick();
+
   // Fill kScrubStatCount slots in kScrubStatNames order (SCRUB_STATUS
   // body).
   void FillStats(int64_t* out) const;
+  // Fill kEcStatCount slots in kEcStatNames order (EC_STATUS body).
+  void FillEcStats(int64_t* out) const;
+  int64_t EcStatValue(int i) const;
   // One slot on its own — the registry's per-gauge read path, so a
   // snapshot evaluating 18 scrub gauges does not pay 18 full fills
   // (each store-derived slot costs one chunk-store lock per store;
@@ -127,6 +145,23 @@ class ScrubManager {
   // Token-bucket pacing for verify reads (sleeps in small stop_-aware
   // slices so shutdown never waits on a bandwidth debt).
   void Pace(int64_t bytes_read, int64_t pass_start_us);
+  // Same token-bucket shape over the SEPARATE ec_bandwidth budget, so
+  // stripe encodes/repairs pace independently of verify reads.
+  void PaceEc(int64_t bytes, int64_t pass_start_us);
+
+  // Stage 5a: repair every local stripe (CRC shards; <= m bad rebuilt
+  // from parity in place, > m falls back to per-chunk FETCH_CHUNK
+  // re-promotion + DropStripe).
+  void RunEcRepair(int spi, int64_t pass_start_us, int64_t* ec_paced);
+  // Stage 5b: demote cold chunks this node owns (jump hash over the
+  // sorted group member list) into RS(k, m) stripes, then release the
+  // replicated copies group-wide via the release.map handover.
+  void RunEcDemote(int spi, int64_t age_s, int64_t pass_start_us,
+                   int64_t* ec_paced);
+  // One EC_RELEASE round: ship the batch to every group peer; true only
+  // when EVERY peer answered (the bar for clearing release.map).
+  bool SendReleaseToPeers(
+      int spi, const std::vector<std::pair<std::string, int64_t>>& batch);
 
   ScrubOptions opts_;
   std::string group_name_;
@@ -141,6 +176,8 @@ class ScrubManager {
   std::condition_variable_any cv_;
   bool stop_ = false;
   bool kicked_ = false;
+  // One-shot age-gate override armed by EcKick().
+  std::atomic<bool> ec_kicked_{false};
 
   // SCRUB_STATUS counters (kScrubStatNames).  Plain atomics: written by
   // the scrub thread, snapshotted by nio loops serving SCRUB_STATUS.
@@ -159,6 +196,15 @@ class ScrubManager {
   std::atomic<int64_t> recipes_reclaimed_{0};
   std::atomic<int64_t> last_pass_unix_{0};
   std::atomic<int64_t> last_pass_dur_us_{0};
+
+  // EC_STATUS counters (kEcStatNames; store-derived slots read the
+  // chunk stores directly in EcStatValue).
+  std::atomic<int64_t> ec_demoted_chunks_{0};
+  std::atomic<int64_t> ec_demoted_bytes_{0};
+  std::atomic<int64_t> ec_reconstructed_shards_{0};
+  std::atomic<int64_t> ec_reconstructed_bytes_{0};
+  std::atomic<int64_t> ec_repair_fallback_chunks_{0};
+  std::atomic<int64_t> ec_last_demote_unix_{0};
 
   // Current pass's trace context (scrub.repair children attach to it).
   TraceCtx pass_ctx_;

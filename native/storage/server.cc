@@ -149,9 +149,19 @@ bool StorageServer::Init(std::string* error) {
     for (int i = 0; i < store_.store_path_count(); ++i) {
       chunk_stores_.push_back(std::make_unique<ChunkStore>(
           store_.store_path(i), cfg_.chunk_gc_grace_s,
-          static_cast<int64_t>(cfg_.read_cache_mb) << 20, sopts));
+          static_cast<int64_t>(cfg_.read_cache_mb) << 20, sopts,
+          cfg_.ec_k, cfg_.ec_m));
       chunk_stores_.back()->set_events(events_.get());
       chunk_stores_.back()->RebuildFromRecipes();
+      // Released chunks (EC cold tier): the replica lives with the
+      // stripe's owner now — reads round-robin the group peers via
+      // FETCH_CHUNK (the owner's ReadChunk falls through to its EC
+      // stripes, so the bytes come back decoded + SHA1-gated).
+      chunk_stores_.back()->set_remote_fetch(
+          [this, i](const std::string& digest_hex, int64_t len,
+                    std::string* out) {
+            return FetchChunkFromPeers(i, digest_hex, len, out);
+          });
     }
   }
 
@@ -432,6 +442,14 @@ bool StorageServer::Init(std::string* error) {
     sopts.interval_s = cfg_.scrub_interval_s;
     sopts.bandwidth_bytes_s =
         static_cast<int64_t>(cfg_.scrub_bandwidth_mb_s) << 20;
+    sopts.ec_k = cfg_.ec_k;
+    sopts.ec_m = cfg_.ec_m;
+    sopts.ec_demote_age_s = cfg_.ec_demote_age_s;
+    sopts.ec_bandwidth_bytes_s =
+        static_cast<int64_t>(cfg_.ec_bandwidth_mb_s) << 20;
+    // Demote ownership (jump hash) hashes over peers + self; this MUST
+    // be the same "ip:port" the peers' sync lists carry for this node.
+    sopts.self_id = MyIp() + ":" + std::to_string(cfg_.port);
     std::vector<ChunkStore*> stores;
     for (auto& cs : chunk_stores_) stores.push_back(cs.get());
     scrub_ = std::make_unique<ScrubManager>(
@@ -649,6 +667,9 @@ constexpr ServedOp kServedOps[] = {
     {StorageCmd::kHeatTop, "heat_top"},
     {StorageCmd::kScrubStatus, "scrub_status"},
     {StorageCmd::kScrubKick, "scrub_kick"},
+    {StorageCmd::kEcStatus, "ec_status"},
+    {StorageCmd::kEcKick, "ec_kick"},
+    {StorageCmd::kEcRelease, "ec_release"},
     {StorageCmd::kFetchOnePathBinlog, "fetch_one_path_binlog"},
     {StorageCmd::kTrunkAllocSpace, "trunk_alloc_space"},
     {StorageCmd::kTrunkAllocConfirm, "trunk_alloc_confirm"},
@@ -880,6 +901,14 @@ void StorageServer::InitStatsRegistry() {
                         return scrub_ != nullptr ? scrub_->StatValue(i)
                                                  : int64_t{0};
                       });
+  }
+  // Erasure-coded cold tier (ISSUE 16): mirror the EC_STATUS blob the
+  // same way — kEcStatNames under the ec. prefix, all zero when the
+  // tier is off (no stripes and no scrubber).
+  for (int i = 0; i < kEcStatCount; ++i) {
+    registry_.GaugeFn(std::string("ec.") + kEcStatNames[i], [this, i] {
+      return scrub_ != nullptr ? scrub_->EcStatValue(i) : int64_t{0};
+    });
   }
   // Rebalance migrator (ISSUE 11): same names as the beat slots so
   // fdfs_monitor/fdfs_top read drain progress from either feed.
@@ -1983,6 +2012,40 @@ void StorageServer::OnHeaderComplete(Conn* c) {
       scrub_->Kick();
       Respond(c, 0);
       return;
+    case StorageCmd::kEcStatus: {
+      // Cold-tier status: empty body -> kEcStatCount BE int64 slots
+      // (kEcStatNames).  ENOTSUP when the tier is off AND no drained
+      // stripes exist — same shape as SCRUB_STATUS.
+      if (c->pkg_len != 0) {
+        CloseConn(c);
+        return;
+      }
+      if (scrub_ == nullptr || (cfg_.ec_k <= 0 && scrub_->EcStatValue(0) == 0)) {
+        Respond(c, 95 /*ENOTSUP*/);
+        return;
+      }
+      int64_t vals[kEcStatCount] = {0};
+      scrub_->FillEcStats(vals);
+      std::string body(kEcStatCount * 8, '\0');
+      for (int i = 0; i < kEcStatCount; ++i)
+        PutInt64BE(vals[i], reinterpret_cast<uint8_t*>(body.data()) + i * 8);
+      Respond(c, 0, body);
+      return;
+    }
+    case StorageCmd::kEcKick:
+      // Force a scrub pass whose demote stage ignores the age gate —
+      // the operator's "drain the replicated tier NOW" lever.
+      if (c->pkg_len != 0) {
+        CloseConn(c);
+        return;
+      }
+      if (scrub_ == nullptr || cfg_.ec_k <= 0) {
+        Respond(c, 95 /*ENOTSUP*/);
+        return;
+      }
+      scrub_->EcKick();
+      Respond(c, 0);
+      return;
     case StorageCmd::kTraceCtx:
       // Trace-context prefix frame: 16B body, NO response; the context
       // applies to the next request on this connection.  A wrong length
@@ -2068,6 +2131,7 @@ void StorageServer::OnHeaderComplete(Conn* c) {
     case StorageCmd::kTrunkAllocConfirm:
     case StorageCmd::kTrunkFreeSpace:
     case StorageCmd::kFetchOnePathBinlog:
+    case StorageCmd::kEcRelease:
       if (c->pkg_len > kMaxInlineBody) {
         CloseConn(c);
         return;
@@ -2330,6 +2394,13 @@ void StorageServer::OnFixedComplete(Conn* c) {
       OffloadToDio(c, spi, [this, c] { HandleFetchChunk(c); });
       return;
     }
+    case StorageCmd::kEcRelease:
+      // Chunk-store drops + released.log fsync — dio work.  Releases
+      // are digest-addressed (no store-path routing: each store drops
+      // what it holds), so pool 0 serializes them, which is fine for a
+      // scrub-paced background RPC.
+      OffloadToDio(c, 0, [this, c] { HandleEcRelease(c); });
+      return;
     case StorageCmd::kUploadRecipe: {
       // Drain refusal at session START only: an in-flight session's
       // kUploadChunks may still commit (the file predates the drain
@@ -2651,6 +2722,94 @@ void StorageServer::HandleFetchChunk(Conn* c) {
     ctr_chunkfetch_bytes_->fetch_add(total, std::memory_order_relaxed);
   }
   Respond(c, 0, out);
+}
+
+// EC_RELEASE (145): a group peer finished encoding these chunks into a
+// verified RS stripe — drop this node's replicated copies.  Body: 16B
+// group + 8B count + count x (20B raw digest + 8B BE length); response
+// is count bytes (0 = released, 1 = kept — pinned or quarantined
+// chunks retain full-replica coverage here, which the owner treats as
+// safe over-replication).  The drop is journaled to released.log (one
+// fsync'd batch append) BEFORE the response, so a restart rebuilds the
+// released marks and reads keep routing to the owner.
+void StorageServer::HandleEcRelease(Conn* c) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(c->fixed.data());
+  if (c->fixed.size() < kGroupNameMaxLen + 8) {
+    Respond(c, 22);
+    return;
+  }
+  std::string group = GroupFromField(p);
+  int64_t count = GetInt64BE(p + kGroupNameMaxLen);
+  size_t base = kGroupNameMaxLen + 8;
+  if (group != cfg_.group_name || count <= 0 ||
+      static_cast<size_t>(count) != (c->fixed.size() - base) / 28 ||
+      (c->fixed.size() - base) % 28 != 0) {
+    Respond(c, 22);
+    return;
+  }
+  if (chunk_stores_.empty()) {
+    Respond(c, 95 /*ENOTSUP*/);
+    return;
+  }
+  std::vector<ChunkStore::ChunkInfo> chunks;
+  chunks.reserve(static_cast<size_t>(count));
+  for (int64_t i = 0; i < count; ++i) {
+    const uint8_t* e = p + base + i * 28;
+    ChunkStore::ChunkInfo info;
+    info.digest_hex = BytesToHex(e, 20);
+    info.length = GetInt64BE(e + 20);
+    chunks.push_back(std::move(info));
+  }
+  // Digest-addressed: every store drops what it holds; a digest kept by
+  // ANY store answers kept (the owner may not reclaim its coverage).
+  std::string mask(static_cast<size_t>(count), '\0');
+  for (auto& cs : chunk_stores_) {
+    std::string m = cs->ReleaseChunks(chunks);
+    for (int64_t i = 0; i < count && i < static_cast<int64_t>(m.size()); ++i)
+      if (m[static_cast<size_t>(i)]) mask[static_cast<size_t>(i)] = 1;
+  }
+  Respond(c, 0, mask);
+}
+
+// Remote read of a released chunk: round-robin the group peers with a
+// single-chunk FETCH_CHUNK.  The stripe owner's ReadChunk falls through
+// to its EC tier, so this works whichever peer holds the stripe; the
+// payload is SHA1-gated by the caller (ChunkStore::ReadChunk).
+bool StorageServer::FetchChunkFromPeers(int spi,
+                                        const std::string& digest_hex,
+                                        int64_t len, std::string* out) {
+  if (len <= 0 || sync_ == nullptr) return false;
+  char remote[16];
+  snprintf(remote, sizeof(remote), "M%02X/ecread", spi);
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  uint8_t num[8];
+  PutInt64BE(static_cast<int64_t>(strlen(remote)), num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  body += remote;
+  PutInt64BE(1, num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  if (!HexToBytes(digest_hex, &body)) return false;
+  PutInt64BE(len, num);
+  body.append(reinterpret_cast<char*>(num), 8);
+  for (const SyncPeerState& s : sync_->States()) {
+    size_t colon = s.addr.rfind(':');
+    if (colon == std::string::npos) continue;
+    std::string err;
+    int fd = TcpConnect(s.addr.substr(0, colon),
+                        atoi(s.addr.c_str() + colon + 1), 3000, &err);
+    if (fd < 0) continue;
+    std::string resp;
+    uint8_t status = 0;
+    bool ok = NetRpc(fd, static_cast<uint8_t>(StorageCmd::kFetchChunk), body,
+                     &resp, &status, len + 1024, cfg_.network_timeout_ms);
+    close(fd);
+    if (!ok || status != 0 || static_cast<int64_t>(resp.size()) != len)
+      continue;
+    out->swap(resp);
+    return true;
+  }
+  return false;
 }
 
 // SYNC_QUERY_CHUNKS (126): which of these digests does this node's

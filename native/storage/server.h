@@ -360,6 +360,11 @@ class StorageServer {
   // a rebuilding peer pull recipes and only the chunk bytes it lacks.
   void HandleFetchRecipe(Conn* c);
   void HandleFetchChunk(Conn* c);
+  // Erasure-coded cold tier (EC_RELEASE receiver + the released-chunk
+  // remote read hook installed on every chunk store).
+  void HandleEcRelease(Conn* c);       // dio worker
+  bool FetchChunkFromPeers(int spi, const std::string& digest_hex,
+                           int64_t len, std::string* out);
   // Dedup-aware negotiated upload (UPLOAD_RECIPE / UPLOAD_CHUNKS; both
   // run on the store path's dio pool): phase 1 probes + pins + parks a
   // session, phase 2 verifies the shipped chunks and assembles the file.

@@ -15,6 +15,7 @@
 #include "common/bytes.h"
 #include "storage/binlog.h"
 #include "storage/chunkstore.h"
+#include "storage/ecstore.h"
 #include "storage/dedup.h"
 #include "storage/store.h"
 #include "storage/trunk.h"
@@ -973,6 +974,230 @@ static void TestChunkStoreStripedConcurrency() {
   }
 }
 
+static void TestRsCodecKillAnyM() {
+  // RS(k, m) must survive EVERY combination of m shard losses, not a
+  // lucky subset — walk all C(k+m, m) loss patterns for a small
+  // geometry and a couple of ragged lengths.
+  const int k = 4, m = 2;
+  for (int64_t shard_len : {int64_t{1}, int64_t{31}, int64_t{256}}) {
+    std::vector<std::string> data;
+    for (int i = 0; i < k; ++i) {
+      std::string s(static_cast<size_t>(shard_len), '\0');
+      for (int64_t b = 0; b < shard_len; ++b)
+        s[static_cast<size_t>(b)] =
+            static_cast<char>((i * 131 + b * 29 + 7) & 0xFF);
+      data.push_back(std::move(s));
+    }
+    std::vector<std::string> parity = RsEncode(data, m);
+    CHECK(static_cast<int>(parity.size()) == m);
+    std::vector<std::string> full = data;
+    for (auto& p : parity) full.push_back(p);
+    for (int a = 0; a < k + m; ++a) {
+      for (int b = a + 1; b < k + m; ++b) {
+        std::vector<std::string> shards = full;
+        shards[a].clear();
+        shards[b].clear();
+        CHECK(RsReconstruct(&shards, k, m, shard_len));
+        for (int i = 0; i < k + m; ++i) CHECK(shards[i] == full[i]);
+      }
+    }
+    // m + 1 losses must FAIL, not fabricate bytes.
+    std::vector<std::string> shards = full;
+    shards[0].clear();
+    shards[2].clear();
+    shards[5].clear();
+    CHECK(!RsReconstruct(&shards, k, m, shard_len));
+  }
+}
+
+static void TestEcStoreStripeLifecycle() {
+  std::string dir = TempDir();
+  std::vector<std::pair<std::string, std::string>> chunks;
+  for (int i = 0; i < 3; ++i) {
+    std::string pay(200 + 37 * i, static_cast<char>('p' + i));
+    chunks.emplace_back(Sha1HexOf(pay), pay);
+  }
+  int64_t id = -1;
+  {
+    EcStore ec(dir, 3, 2);
+    std::string err;
+    id = ec.EncodeStripe(chunks, &err);
+    CHECK(id >= 0);
+    CHECK(ec.VerifyStripe(id, &err));
+    CHECK(ec.stripes() == 1);
+    CHECK(ec.stripe_chunks() == 3);
+    for (auto& c : chunks) {
+      std::string out;
+      CHECK(ec.Has(c.first));
+      CHECK(ec.ReadChunk(c.first, &out) && out == c.second);
+      // Positional read across the whole payload and a mid slice.
+      std::string slice(5, '\0');
+      CHECK(ec.ReadChunkSlice(c.first, 3, 5, slice.data()));
+      CHECK(slice == c.second.substr(3, 5));
+    }
+  }
+  // Cold restart adopts the stripe from the manifest alone.
+  EcStore ec(dir, 3, 2);
+  CHECK(ec.Rescan() == 1);
+  CHECK(ec.stripe_chunks() == 3);
+  // Corrupt one shard payload in place: the scrub repair must detect
+  // it via CRC and rebuild it from parity, in place.
+  {
+    char shard[64];
+    snprintf(shard, sizeof(shard), "/%010lld.s01", (long long)id);
+    FlipFirstByte(dir + shard);  // header magic => header CRC fail
+  }
+  std::vector<EcStore::ChunkRef> lost;
+  int64_t rebuilt = 0, rb = 0, rd = 0;
+  CHECK(ec.VerifyRepairStripe(id, &lost, &rebuilt, &rb, &rd) ==
+        EcStore::StripeHealth::kRepaired);
+  CHECK(rebuilt == 1 && rb > 0);
+  CHECK(ec.VerifyRepairStripe(id, &lost, &rebuilt, &rb, &rd) ==
+        EcStore::StripeHealth::kHealthy);
+  // Lose MORE than m shards: kLost must list the live chunks so the
+  // caller can re-promote them, and DropStripe reclaims the carcass.
+  for (int s = 0; s < 3; ++s) {
+    char shard[64];
+    snprintf(shard, sizeof(shard), "/%010lld.s%02d", (long long)id, s);
+    unlink((dir + shard).c_str());
+  }
+  lost.clear();
+  CHECK(ec.VerifyRepairStripe(id, &lost, &rebuilt, &rb, &rd) ==
+        EcStore::StripeHealth::kLost);
+  CHECK(lost.size() == 3);
+  int64_t reclaimed = 0;
+  ec.DropStripe(id, &reclaimed);
+  CHECK(ec.stripes() == 0);
+  CHECK(!ec.Has(chunks[0].first));
+
+  // MarkDead reclaims the whole stripe when its last live chunk dies.
+  std::string err;
+  int64_t id2 = ec.EncodeStripe(chunks, &err);
+  CHECK(id2 >= 0);
+  int64_t freed = 0;
+  CHECK(ec.MarkDead(chunks[0].first, &freed) && freed == 0);
+  CHECK(ec.MarkDead(chunks[1].first, &freed) && freed == 0);
+  CHECK(ec.MarkDead(chunks[2].first, &freed));
+  CHECK(freed > 0);  // parity included
+  CHECK(ec.stripes() == 0);
+
+  // release.map: append + torn-tail-tolerant replay + clear.
+  std::vector<std::pair<std::string, int64_t>> batch = {
+      {chunks[0].first, 200}, {chunks[1].first, 237}};
+  CHECK(ec.AppendReleaseMap(batch, &err));
+  auto pending = ec.PendingReleases();
+  CHECK(pending.size() == 2 && pending[1].second == 237);
+  ec.ClearReleaseMap();
+  CHECK(ec.PendingReleases().empty());
+}
+
+static void TestChunkStoreEcDemoteReleaseRemoteRead() {
+  // Owner side: demote cold chunks into a stripe, reads fall through.
+  std::string owner_dir = ChunkStoreDir();
+  ChunkStore owner(owner_dir, 0, 0, SlabOptions{}, /*ec_k=*/2, /*ec_m=*/1);
+  CHECK(owner.ec_enabled());
+  Recipe r;
+  std::vector<std::string> payloads, digs;
+  bool existed = false;
+  std::string err;
+  for (int i = 0; i < 4; ++i) {
+    payloads.emplace_back(500 + i, static_cast<char>('e' + i));
+    digs.push_back(Sha1HexOf(payloads.back()));
+    CHECK(owner.PutAndRef(digs[i], payloads[i].data(), payloads[i].size(),
+                          &existed, &err));
+    r.chunks.push_back({digs[i], static_cast<int64_t>(payloads[i].size())});
+    r.logical_size += static_cast<int64_t>(payloads[i].size());
+  }
+  CHECK(WriteRecipeFile(owner_dir + "/data/ec.rcp", r, &err));
+  auto cands = owner.SnapshotDemotable(time(nullptr) + 10, 1);
+  CHECK(cands.size() == 4);
+  int64_t nchunks = 0, nbytes = 0;
+  int64_t sid = owner.DemoteToEc(cands, &nchunks, &nbytes, &err);
+  CHECK(sid >= 0);
+  CHECK(nchunks == 4);
+  CHECK(owner.ec_stripes() == 1);
+  // The flat payloads are gone; reads decode from the stripe.
+  for (int i = 0; i < 4; ++i) {
+    CHECK(!FileExists(owner.ChunkPath(digs[i])));
+    std::string back;
+    CHECK(owner.ReadChunk(digs[i], static_cast<int64_t>(payloads[i].size()),
+                          &back));
+    CHECK(back == payloads[i]);
+  }
+  // Demoted chunks are NOT demotable again.
+  CHECK(owner.SnapshotDemotable(time(nullptr) + 10, 1).empty());
+
+  // Peer side: EC_RELEASE drops the replica, journaled; reads route to
+  // the remote-fetch hook (which the server wires to FETCH_CHUNK).
+  std::string peer_dir = ChunkStoreDir();
+  {
+    ChunkStore peer(peer_dir, 0);
+    for (int i = 0; i < 4; ++i)
+      CHECK(peer.PutAndRef(digs[i], payloads[i].data(), payloads[i].size(),
+                           &existed, &err));
+    CHECK(WriteRecipeFile(peer_dir + "/data/ec.rcp", r, &err));
+    std::vector<ChunkStore::ChunkInfo> infos;
+    for (int i = 0; i < 4; ++i)
+      infos.push_back({digs[i], static_cast<int64_t>(payloads[i].size())});
+    std::string mask = peer.ReleaseChunks(infos);
+    CHECK(mask == std::string(4, '\0'));
+    CHECK(peer.released_chunks() == 4);
+    CHECK(peer.IsReleased(digs[0]));
+    CHECK(!FileExists(peer.ChunkPath(digs[0])));
+    // Releasing again is idempotent (the replayed-handover case).
+    CHECK(peer.ReleaseChunks(infos) == std::string(4, '\0'));
+    // No hook: the read fails clean instead of fabricating bytes.
+    std::string back;
+    CHECK(!peer.ReadChunk(digs[0],
+                          static_cast<int64_t>(payloads[0].size()), &back));
+    int fetches = 0;
+    peer.set_remote_fetch([&](const std::string& dig, int64_t len,
+                              std::string* out) {
+      ++fetches;
+      std::string got;
+      if (!owner.ReadChunk(dig, len, &got)) return false;
+      out->swap(got);
+      return true;
+    });
+    CHECK(peer.ReadChunk(digs[0],
+                         static_cast<int64_t>(payloads[0].size()), &back));
+    CHECK(back == payloads[0] && fetches == 1);
+    CHECK(peer.ec_remote_reads() == 1);
+    // Slice reads work through the hook too.
+    std::string slice(7, '\0');
+    CHECK(peer.ReadChunkSlice(digs[1], 11, 7, slice.data()));
+    CHECK(slice == payloads[1].substr(11, 7));
+    // A re-uploaded payload UNRELEASES: local bytes win again.
+    CHECK(peer.PutAndRef(digs[2], payloads[2].data(), payloads[2].size(),
+                         &existed, &err));
+    CHECK(!peer.IsReleased(digs[2]));
+    CHECK(peer.released_chunks() == 3);
+  }
+  // Restart replays released.log: marks survive for referenced digests
+  // with no local payload, and the re-uploaded chunk stays local.
+  ChunkStore peer2(peer_dir, 0);
+  peer2.RebuildFromRecipes();
+  CHECK(peer2.released_chunks() == 3);
+  CHECK(peer2.IsReleased(digs[0]) && !peer2.IsReleased(digs[2]));
+  std::string back;
+  CHECK(peer2.ReadChunk(digs[2], static_cast<int64_t>(payloads[2].size()),
+                        &back));
+  CHECK(back == payloads[2]);
+
+  // Owner restart rescans the stripe and still serves decoded reads.
+  ChunkStore owner2(owner_dir, 0, 0, SlabOptions{}, 2, 1);
+  owner2.RebuildFromRecipes();
+  CHECK(owner2.ec_stripes() == 1);
+  CHECK(owner2.ReadChunk(digs[3], static_cast<int64_t>(payloads[3].size()),
+                         &back));
+  CHECK(back == payloads[3]);
+  // DELETE reclaims parity: with no grace window the last unref retires
+  // the chunks eagerly, and the last live chunk takes the stripe with it.
+  owner2.UnrefAll(r);
+  CHECK(owner2.ec_stripes() == 0);
+  CHECK(owner2.ec_parity_bytes() == 0);
+}
+
 int main() {
   TestBinlogRecordCodec();
   TestBinlogWriteReadResume();
@@ -993,6 +1218,9 @@ int main() {
   TestChunkStoreSlabEndToEnd();
   TestChunkStoreSlabConcurrency();
   TestChunkStoreStripedConcurrency();
+  TestRsCodecKillAnyM();
+  TestEcStoreStripeLifecycle();
+  TestChunkStoreEcDemoteReleaseRemoteRead();
   if (g_failures == 0) {
     std::printf("storage_test: ALL PASS\n");
     return 0;

@@ -67,6 +67,18 @@
 //                decoder into index lines — pins the on-disk slab
 //                layout (storage/slabstore.h) against the Python
 //                parser in tests/harness.py / tests/test_slab.py)
+//   fdfs_codec gf-tables       (golden GF(2^8) field contract: table
+//                CRCs + sample Mul/Inv/CauchyCoeff entries — pins
+//                common/gf256.h against fastdfs_tpu/ops/gf256.py so a
+//                regenerated table that drifts fails loudly)
+//   fdfs_codec ec-status       (golden EC_STATUS blob: fixture value
+//                per slot in kEcStatNames order + hex wire blob)
+//   fdfs_codec ec-stripe-layout (golden EC stripe: a fixture RS(3,2)
+//                encode through EcStore emitted as shard/manifest hex,
+//                decoded back byte-identically — with 2 shards
+//                deleted — plus the EC_RELEASE wire body; pins the
+//                on-disk stripe layout AND the release wire contract
+//                against tests/harness.py / tests/test_ec.py)
 #include <time.h>
 
 #include <atomic>
@@ -92,6 +104,8 @@
 #include "common/stats.h"
 #include "common/jumphash.h"
 #include "common/trace.h"
+#include "common/gf256.h"
+#include "storage/ecstore.h"
 #include "storage/slabstore.h"
 #include "tracker/placement.h"
 
@@ -692,6 +706,150 @@ int main(int argc, char** argv) {
       off += static_cast<size_t>(v.record_len);
     }
     return 0;
+  }
+  if (cmd == "gf-tables") {
+    // Field-contract golden: tools/gen_gf_tables.py generates BOTH
+    // common/gf256.h and fastdfs_tpu/ops/gf256.py from one source of
+    // truth; tests/test_ec.py recomputes these CRCs and samples from
+    // the Python tables so a drifted regeneration fails loudly.
+    printf("poly=0x%X\n", gf256::kPoly);
+    printf("exp_crc32=%u\n", Crc32(gf256::kExp, sizeof(gf256::kExp)));
+    printf("log_crc32=%u\n", Crc32(gf256::kLog, sizeof(gf256::kLog)));
+    printf("exp_1=%u exp_254=%u exp_255=%u exp_509=%u\n", gf256::kExp[1],
+           gf256::kExp[254], gf256::kExp[255], gf256::kExp[509]);
+    printf("log_2=%u log_142=%u log_255=%u\n", gf256::kLog[2],
+           gf256::kLog[142], gf256::kLog[255]);
+    printf("mul_7_9=%u mul_255_255=%u inv_2=%u div_5_7=%u\n",
+           gf256::Mul(7, 9), gf256::Mul(255, 255), gf256::Inv(2),
+           gf256::Div(5, 7));
+    // The RS(3, 2) Cauchy parity matrix the stripe golden encodes with.
+    for (int j = 0; j < 2; ++j)
+      for (int i = 0; i < 3; ++i)
+        printf("cauchy_3_%d_%d=%u\n", j, i, gf256::CauchyCoeff(3, j, i));
+    return 0;
+  }
+  if (cmd == "ec-status") {
+    // EC_STATUS wire golden (the scrub-status pattern): fixture value
+    // per slot in kEcStatNames order + the hex blob; tests/test_ec.py
+    // decodes with fastdfs_tpu.common.protocol.unpack_ec_stats.
+    std::string blob;
+    for (int i = 0; i < kEcStatCount; ++i) {
+      int64_t v = 1000 + 13 * i;
+      uint8_t num[8];
+      PutInt64BE(v, num);
+      blob.append(reinterpret_cast<char*>(num), 8);
+      printf("%s=%lld\n", kEcStatNames[i], static_cast<long long>(v));
+    }
+    static const char* kHex = "0123456789abcdef";
+    std::string hex;
+    for (unsigned char ch : blob) {
+      hex.push_back(kHex[ch >> 4]);
+      hex.push_back(kHex[ch & 0xF]);
+    }
+    printf("blob=%s\n", hex.c_str());
+    return 0;
+  }
+  if (cmd == "ec-stripe-layout") {
+    // On-disk stripe golden: one fixture RS(3, 2) encode through the
+    // REAL EcStore (not a reimplementation), every shard + the manifest
+    // emitted as hex for tests/test_ec.py to rebuild byte-for-byte with
+    // the Python struct encoder, then decoded back with m = 2 shards
+    // deleted — pinning layout AND reconstruction in one fixture.
+    // Finishes with the EC_RELEASE wire body for the same chunks.
+    auto hex = [](const std::string& s) {
+      static const char* k = "0123456789abcdef";
+      std::string out;
+      for (unsigned char c : s) {
+        out.push_back(k[c >> 4]);
+        out.push_back(k[c & 0xF]);
+      }
+      return out;
+    };
+    char dir_tmpl[] = "/tmp/fdfs_ec_golden_XXXXXX";
+    char* dir = mkdtemp(dir_tmpl);
+    if (dir == nullptr) {
+      fprintf(stderr, "mkdtemp failed\n");
+      return 1;
+    }
+    std::vector<std::pair<std::string, std::string>> chunks;
+    // Unequal lengths on purpose: chunk 1 spans a shard boundary and
+    // the tail shard carries zero padding.
+    std::string payloads[3] = {
+        std::string(37, '\0'), "ec-golden-b",
+        std::string("ec golden chunk payload C with some padding tail !"),
+    };
+    for (int i = 0; i < 37; ++i)
+      payloads[0][static_cast<size_t>(i)] = static_cast<char>('A' + i % 23);
+    for (const std::string& p : payloads) {
+      chunks.emplace_back(Sha1(p.data(), p.size()).Hex(), p);
+      printf("chunk=%s len=%zu\n", chunks.back().first.c_str(), p.size());
+    }
+    std::string err;
+    int64_t rc = 1;
+    {
+      EcStore ec(dir, 3, 2);
+      int64_t id = ec.EncodeStripe(chunks, &err);
+      if (id < 0) {
+        fprintf(stderr, "encode: %s\n", err.c_str());
+        return 1;
+      }
+      printf("stripe_id=%lld verify=%d\n", static_cast<long long>(id),
+             ec.VerifyStripe(id, &err) ? 1 : 0);
+      rc = 0;
+    }
+    std::vector<std::string> files;
+    for (int s = 0; s < 5; ++s) {
+      char name[32];
+      snprintf(name, sizeof(name), "0000000000.s%02d", s);
+      files.push_back(name);
+    }
+    files.push_back("0000000000.mft");
+    for (const std::string& name : files) {
+      FILE* f = fopen((std::string(dir) + "/" + name).c_str(), "rb");
+      if (f == nullptr) {
+        fprintf(stderr, "missing %s\n", name.c_str());
+        return 1;
+      }
+      std::string bytes;
+      char buf[4096];
+      size_t n;
+      while ((n = fread(buf, 1, sizeof(buf), f)) > 0) bytes.append(buf, n);
+      fclose(f);
+      printf("file=%s bytes=%s\n", name.c_str(), hex(bytes).c_str());
+    }
+    // Kill-and-reconstruct in miniature: drop m = 2 shards (one data,
+    // one parity), rescan cold, and every chunk must read back
+    // byte-identical through the parity decode.
+    remove((std::string(dir) + "/0000000000.s01").c_str());
+    remove((std::string(dir) + "/0000000000.s04").c_str());
+    {
+      EcStore ec2(dir, 3, 2);
+      ec2.Rescan();
+      for (size_t i = 0; i < chunks.size(); ++i) {
+        std::string out;
+        bool ok = ec2.ReadChunk(chunks[i].first, &out) &&
+                  out == chunks[i].second;
+        printf("reconstruct_%zu=%d\n", i, ok ? 1 : 0);
+        if (!ok) rc = 1;
+      }
+    }
+    for (const std::string& name : files)
+      remove((std::string(dir) + "/" + name).c_str());
+    remove(dir);
+    // EC_RELEASE wire body for the same chunks: 16B group + 8B count +
+    // per chunk 20B raw digest + 8B BE length.
+    std::string body;
+    PutFixedField(&body, "group1", kGroupNameMaxLen);
+    uint8_t num[8];
+    PutInt64BE(static_cast<int64_t>(chunks.size()), num);
+    body.append(reinterpret_cast<char*>(num), 8);
+    for (const auto& ch : chunks) {
+      HexToBytes(ch.first, &body);
+      PutInt64BE(static_cast<int64_t>(ch.second.size()), num);
+      body.append(reinterpret_cast<char*>(num), 8);
+    }
+    printf("release_body=%s\n", hex(body).c_str());
+    return static_cast<int>(rc);
   }
   if (cmd == "b64e" && argc == 3) {
     std::string hex = argv[2];

@@ -369,6 +369,100 @@ def corrupt_chunk(base_dir: str, digest: str | None = None) -> tuple[str, str]:
     return target["key"], target["path"]
 
 
+# -- EC stripe parsing (native/storage/ecstore.cc on-disk layout) -----------
+# Shard file <base>/data/ec/<%010d>.s<%02d>: 52-byte header — 8s magic
+# "FDFSECS1", i64 stripe_id, u32 shard_idx, u32 k, u32 m, i64 shard_len,
+# i64 data_len, u32 payload crc32, u32 header crc32 (of the first 48
+# bytes) — then shard_len payload bytes.  Manifest <%010d>.mft: 8s magic
+# "FDFSECM1", u32 k, u32 m, i64 shard_len, i64 data_len, i64 chunk_count,
+# then per chunk 20s raw digest + i64 offset + i64 length + u8 dead, then
+# a trailing crc32 of everything before it.  All big-endian; pinned
+# cross-language by `fdfs_codec ec-stripe-layout`.
+EC_SHARD_HEADER = ">8sqIIIqqII"
+EC_SHARD_HEADER_SIZE = 52
+EC_MANIFEST_FIXED = 40
+EC_MANIFEST_PER_CHUNK = 37
+
+
+def stripe_files(base_dir: str) -> dict[int, dict]:
+    """EC stripe inventory under ``<base>/data/ec/``: per stripe id, the
+    manifest-decoded geometry + live chunk map and every shard file
+    present on disk — ``{id: {"k", "m", "shard_len", "data_len",
+    "chunks": {digest: (offset, length, dead)}, "shards": {idx: path},
+    "manifest": path}}``.  Stripes whose manifest fails its CRC are
+    skipped, matching the daemon's boot-rescan behavior."""
+    import glob
+    import struct
+    import zlib
+    ec_dir = os.path.join(str(base_dir), "data", "ec")
+    out: dict[int, dict] = {}
+    for path in sorted(glob.glob(os.path.join(ec_dir, "*.mft"))):
+        sid = int(os.path.basename(path)[:10])
+        with open(path, "rb") as fh:
+            blob = fh.read()
+        if (len(blob) < EC_MANIFEST_FIXED + 4 or blob[:8] != b"FDFSECM1"
+                or zlib.crc32(blob[:-4]) & 0xFFFFFFFF
+                != struct.unpack(">I", blob[-4:])[0]):
+            continue
+        k, m = struct.unpack_from(">II", blob, 8)
+        shard_len, data_len, count = struct.unpack_from(">qqq", blob, 16)
+        chunks: dict[str, tuple[int, int, bool]] = {}
+        for c in range(count):
+            off = EC_MANIFEST_FIXED + c * EC_MANIFEST_PER_CHUNK
+            raw = blob[off:off + 20]
+            coff, clen = struct.unpack_from(">qq", blob, off + 20)
+            chunks[raw.hex()] = (coff, clen, blob[off + 36] != 0)
+        shards = {}
+        for sp in sorted(glob.glob(os.path.join(
+                ec_dir, f"{sid:010d}.s[0-9][0-9]"))):
+            shards[int(sp[-2:])] = sp
+        out[sid] = {"k": k, "m": m, "shard_len": shard_len,
+                    "data_len": data_len, "chunks": chunks,
+                    "shards": shards, "manifest": path}
+    return out
+
+
+def shard_digests(base_dir: str) -> dict[str, tuple[int, int]]:
+    """Layout map of EC-resident chunks: ``{digest: (stripe_id,
+    chunk_index)}`` across every live manifest slot — the EC twin of
+    :func:`chunk_digests` for asserting demotion coverage."""
+    out: dict[str, tuple[int, int]] = {}
+    for sid, st in stripe_files(base_dir).items():
+        for i, (digest, (_, _, dead)) in enumerate(st["chunks"].items()):
+            if not dead:
+                out[digest] = (sid, i)
+    return out
+
+
+def corrupt_shard(base_dir: str, stripe_id: int | None = None,
+                  shard_idx: int | None = None,
+                  delete: bool = False) -> tuple[int, int, str]:
+    """Shard-loss injection for reconstruction tests: flip one payload
+    byte inside (or with ``delete=True`` unlink) one shard file of one
+    stripe.  Defaults to the first stripe's first present shard; returns
+    ``(stripe_id, shard_idx, path)``.  A flip leaves the 52-byte header
+    intact so only the payload CRC betrays the damage — the same failure
+    scrub's VerifyRepairStripe is built to catch."""
+    stripes = stripe_files(base_dir)
+    if not stripes:
+        raise FileNotFoundError(f"no EC stripes under {base_dir}")
+    sid = stripe_id if stripe_id is not None else sorted(stripes)[0]
+    shards = stripes[sid]["shards"]
+    if not shards:
+        raise FileNotFoundError(f"stripe {sid} has no shard files left")
+    idx = shard_idx if shard_idx is not None else sorted(shards)[0]
+    path = shards[idx]
+    if delete:
+        os.unlink(path)
+        return sid, idx, path
+    with open(path, "r+b") as fh:
+        fh.seek(EC_SHARD_HEADER_SIZE)
+        first = fh.read(1)
+        fh.seek(EC_SHARD_HEADER_SIZE)
+        fh.write(bytes([first[0] ^ 0xFF]))
+    return sid, idx, path
+
+
 def upload_retry(cli, data, timeout=20.0, **kw):
     """Upload with retries while a fresh daemon joins/activates (the
     tracker refuses query_store until the storage reports in)."""

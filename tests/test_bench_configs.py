@@ -151,3 +151,31 @@ def test_config10_multi_group_smoke(tmp_path):
     drain = art["arms"]["three_groups"]["drain"]
     assert art["drain_relocated_all"] is True
     assert drain["files_moved"] >= 1 and drain["pace_mb_s"] > 0
+
+
+def test_config11_ec_cold_tier_smoke(tmp_path):
+    # The erasure-coding scenario end-to-end at tiny scale: the
+    # replicated corpus demotes into RS(3+2) stripes on both members,
+    # the physical/logical ratio drops from ~2x to <= (k+m)/k + 5%,
+    # every download stays byte-identical through demotion AND after
+    # killing m shards per stripe, and both reconstruction arms rebuild
+    # purely from parity.  The arms clock the whole repair pass, so even
+    # at smoke scale the paced arm must sit at/below its budget while
+    # the unpaced arm runs free.
+    bc.config11(str(tmp_path), scale=0.0015)  # 12 x 256 KB
+    with open(os.path.join(str(tmp_path), "config11.json")) as fh:
+        art = json.load(fh)
+    assert art["zero_wrong_bytes"] is True
+    assert art["efficiency_pass"] is True
+    assert art["replication_near_2x"] is True
+    assert art["reconstruct_from_parity_only"] is True
+    g = art["group"]
+    assert g["ec_physical_over_logical"] <= art["ec_overhead_bound"]
+    assert g["released_chunks"] >= 1
+    assert g["ec_download"]["ops"] >= 48 and g["ec_download"]["wrong"] == 0
+    for arm in ("unpaced", "paced"):
+        r = art["reconstruction"][arm]
+        assert r["shards_rebuilt"] >= r["stripes"] * 2
+        assert r["rebuilt_bytes"] > 0 and r["wall_s"] > 0
+    assert art["paced_within_budget"] is True
+    assert art["pacing_effective"] is True

@@ -1,0 +1,623 @@
+"""Erasure-coded cold tier (ISSUE 16): vectorized Reed-Solomon stripes,
+scrub-driven demotion, kill-and-reconstruct recovery.
+
+Layers:
+- pure-Python contract tests (EC_STATUS blob naming/codec, GF(2^8)
+  generator reproducibility, RS field properties);
+- cross-language goldens: `fdfs_codec gf-tables` (the field contract),
+  `fdfs_codec ec-status` (blob slot order AND count), and `fdfs_codec
+  ec-stripe-layout` (the C++ EcStore's shard + manifest files rebuilt
+  byte-for-byte by the Python RS kernels + struct encoders, plus the
+  EC_RELEASE wire body);
+- kernel equivalence: gf_matmul_ref == gf_matmul_np == gf_matmul (jax)
+  on adversarial shapes, and the any-k reconstruction property;
+- live clusters: the kill-and-reconstruct acceptance path (upload ->
+  EC_KICK demotes cold chunks into RS(k, m) stripes -> delete any m
+  shard files -> downloads stay byte-identical -> a scrub pass rebuilds
+  the lost shards from parity), the two-node verify-then-release
+  replica handover with remote reads, and a demote-vs-traffic race
+  (the TSan target in tools/run_sanitizers.sh).
+"""
+
+import os
+import shutil
+import struct
+import subprocess
+import sys
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from fastdfs_tpu.common import protocol as P
+from tests.harness import (BUILD, EC_SHARD_HEADER_SIZE, REPO, STORAGED,
+                           TRACKERD, chunk_digests, corrupt_shard,
+                           free_port, shard_digests, start_storage,
+                           start_tracker, stripe_files, upload_retry)
+
+_HAVE_TOOLCHAIN = ((shutil.which("cmake") is not None
+                    and shutil.which("ninja") is not None)
+                   or shutil.which("g++") is not None)
+_HAVE_BINARIES = os.path.exists(STORAGED) and os.path.exists(TRACKERD)
+needs_native = pytest.mark.skipif(
+    not (_HAVE_TOOLCHAIN or _HAVE_BINARIES),
+    reason="no native toolchain and no prebuilt daemons")
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+# EC config for tests: no periodic scrub (kicks drive everything
+# deterministically); the demote age gate is a day so ONLY an EC_KICK
+# (which drops it to 0 for one pass) ever demotes — making every
+# demotion in these tests an explicit, observable act.
+EC = (HB + "\nscrub_interval_s = 0\nchunk_gc_grace_s = 1"
+      "\nec_k = 3\nec_m = 2\nec_demote_age_s = 86400")
+
+
+def _wait(cond, timeout=30, interval=0.25):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(interval)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# wire contract
+# ---------------------------------------------------------------------------
+
+def test_ec_stat_fields_shape():
+    assert P.EC_STAT_COUNT == len(P.EC_STAT_FIELDS) == 16
+    assert len(set(P.EC_STAT_FIELDS)) == P.EC_STAT_COUNT
+    # The issue's headline stats are first-class named fields.
+    for required in ("stripes", "parity_bytes", "demoted_chunks",
+                     "released_chunks", "reconstructed_shards",
+                     "repair_fallback_chunks", "remote_reads"):
+        assert required in P.EC_STAT_FIELDS
+    assert P.StorageCmd.EC_STATUS == 143
+    assert P.StorageCmd.EC_KICK == 144
+    assert P.StorageCmd.EC_RELEASE == 145
+
+
+def test_ec_stats_pack_unpack_roundtrip():
+    vals = {name: i * 7 + 1 for i, name in enumerate(P.EC_STAT_FIELDS)}
+    blob = P.pack_ec_stats(vals)
+    assert len(blob) == 8 * P.EC_STAT_COUNT
+    assert P.unpack_ec_stats(blob) == vals
+    # Append-only: a shorter (older daemon) blob reads missing slots 0,
+    # a longer (newer daemon) blob's extra tail is ignored.
+    short = P.unpack_ec_stats(blob[:24])
+    assert short["enabled"] == vals["enabled"]
+    assert short["k"] == vals["k"]
+    assert short["m"] == vals["m"]
+    assert short["stripes"] == 0
+    assert P.unpack_ec_stats(blob + P.long2buff(999)) == vals
+
+
+# ---------------------------------------------------------------------------
+# GF(2^8) tables: generator reproducibility + field properties
+# ---------------------------------------------------------------------------
+
+def _gen_module():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import gen_gf_tables
+    finally:
+        sys.path.pop(0)
+    return gen_gf_tables
+
+
+def test_gf_tables_generator_reproducible():
+    # Both checked-in artifacts are exactly what the generator renders
+    # (the protocol_gen.h discipline: stale generated code fails CI).
+    gen = _gen_module()
+    exp, log = gen.build_tables()
+    with open(gen.PY_PATH) as fh:
+        assert fh.read() == gen.render_py(exp, log), (
+            "fastdfs_tpu/ops/gf256.py is stale; run tools/gen_gf_tables.py")
+    with open(gen.H_PATH) as fh:
+        assert fh.read() == gen.render_h(exp, log), (
+            "native/common/gf256.h is stale; run tools/gen_gf_tables.py")
+
+
+def test_gf_field_properties():
+    from fastdfs_tpu.ops import gf256 as G
+    assert G.POLY == 0x11D
+    assert len(G.GF_EXP) == 510 and len(G.GF_LOG) == 256
+    assert G.GF_EXP[255:] == G.GF_EXP[:255]  # doubled, no reduction
+    rng = np.random.default_rng(16)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert G.gf_mul(a, b) == G.gf_mul(b, a)
+        assert G.gf_mul(a, G.gf_mul(b, c)) == G.gf_mul(G.gf_mul(a, b), c)
+        if a:
+            assert G.gf_mul(a, G.gf_inv(a)) == 1
+            assert G.gf_div(G.gf_mul(b, a), a) == b
+    # mul distributes over XOR (the field's addition) — the property the
+    # whole shard-XOR accumulation in gf_matmul rests on.
+    for _ in range(100):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert G.gf_mul(a, b ^ c) == G.gf_mul(a, b) ^ G.gf_mul(a, c)
+
+
+def test_cauchy_any_k_submatrix_invertible():
+    # The design guarantee behind "lose any m shards": every k x k
+    # submatrix of [I; C] inverts.  Exhaustive over loss patterns for a
+    # few geometries, including the config clamp corner k=32, m=8.
+    import itertools
+
+    from fastdfs_tpu.ops import rs_code as R
+    for k, m in ((1, 1), (3, 2), (4, 2), (5, 3)):
+        gen = R.encode_matrix(k, m)
+        for present in itertools.combinations(range(k + m), k):
+            R.gf_invert_matrix(gen[np.asarray(present)])  # raises if singular
+    R.parity_matrix(32, 8)  # the clamp corner constructs
+    with pytest.raises(ValueError):
+        R.parity_matrix(250, 6)  # k + m > 255 breaks point distinctness
+
+
+# ---------------------------------------------------------------------------
+# RS kernels: three disciplines, one answer
+# ---------------------------------------------------------------------------
+
+def test_rs_matmul_paths_agree_adversarial_shapes():
+    from fastdfs_tpu.ops import rs_code as R
+    rng = np.random.default_rng(7)
+    # Shapes chosen to poke the seams: k=1 degenerate, pow2 +/- 1 around
+    # the jax pad bucket, a tile-boundary-straddling length, zero length.
+    cases = [(1, 1, 1), (2, 1, 3), (3, 2, 33), (4, 2, 1023),
+             (5, 3, 1024), (8, 4, 1025), (17, 5, 4099), (32, 8, 257)]
+    for k, m, length in cases:
+        shards = rng.integers(0, 256, (k, length), dtype=np.uint8)
+        mat = R.encode_matrix(k, m)
+        want = R.gf_matmul_np(mat, shards)
+        assert np.array_equal(want, R.gf_matmul(mat, shards)), (k, m, length)
+        if k * length <= 4096:  # referee is O(rows*k*L) pure Python
+            assert np.array_equal(want, R.gf_matmul_ref(mat, shards))
+    # Zero-length stripes are legal (empty chunk batch) and shape-stable.
+    empty = np.zeros((3, 0), dtype=np.uint8)
+    mat = R.parity_matrix(3, 2)
+    assert R.gf_matmul(mat, empty).shape == (2, 0)
+    assert R.gf_matmul_np(mat, empty).shape == (2, 0)
+
+
+def test_rs_any_m_losses_reconstruct():
+    import itertools
+
+    from fastdfs_tpu.ops import rs_code as R
+    rng = np.random.default_rng(42)
+    k, m, length = 4, 2, 511
+    data = rng.integers(0, 256, (k, length), dtype=np.uint8)
+    parity = R.rs_encode(data, m, path="np")
+    all_shards = np.concatenate([data, parity])
+    for lost in itertools.combinations(range(k + m), m):
+        present = [s for s in range(k + m) if s not in lost][:k]
+        for path in ("np", "jax"):
+            got = R.rs_reconstruct(all_shards[np.asarray(present)],
+                                   present, k, m, path=path)
+            assert np.array_equal(got, data), (lost, path)
+    # m+1 losses leave fewer than k rows: decode_matrix must refuse.
+    with pytest.raises(ValueError):
+        R.decode_matrix(k, m, [0, 1, 2])
+
+
+def test_split_stripe_padding_roundtrip():
+    from fastdfs_tpu.ops import rs_code as R
+    data = bytes(range(98))  # 98 = 3*33 - 1: forces one pad byte
+    shards = R.split_stripe(data, 3)
+    assert shards.shape == (3, 33)
+    assert bytes(shards.reshape(-1))[:98] == data
+    assert shards[2, -1] == 0
+    assert R.split_stripe(b"", 3).shape == (3, 0)
+
+
+# ---------------------------------------------------------------------------
+# cross-language goldens
+# ---------------------------------------------------------------------------
+
+def _codec(*args) -> str:
+    exe = os.path.join(BUILD, "fdfs_codec")
+    return subprocess.run([exe, *args], capture_output=True,
+                          check=True).stdout.decode()
+
+
+@needs_native
+def test_gf_tables_cross_language_golden():
+    # `fdfs_codec gf-tables` emits the C++ view of the field: the table
+    # CRCs and arithmetic samples must match the Python module exactly —
+    # any drift means shards written by one language won't decode in the
+    # other.
+    from fastdfs_tpu.ops import gf256 as G
+    raw = _codec("gf-tables")
+    # Every token in the dump is key=value (lines carry several).
+    toks = dict(t.split("=", 1) for t in raw.split() if "=" in t)
+    assert int(toks["poly"], 16) == G.POLY
+    assert int(toks["exp_crc32"]) == zlib.crc32(bytes(G.GF_EXP))
+    assert int(toks["log_crc32"]) == zlib.crc32(bytes(G.GF_LOG))
+    assert (int(toks["exp_1"]), int(toks["exp_254"]), int(toks["exp_255"]),
+            int(toks["exp_509"])) == (G.GF_EXP[1], G.GF_EXP[254],
+                                      G.GF_EXP[255], G.GF_EXP[509])
+    assert int(toks["mul_7_9"]) == G.gf_mul(7, 9)
+    assert int(toks["mul_255_255"]) == G.gf_mul(255, 255)
+    assert int(toks["inv_2"]) == G.gf_inv(2)
+    assert int(toks["div_5_7"]) == G.gf_div(5, 7)
+    assert int(toks["log_2"]) == G.GF_LOG[2]
+    assert int(toks["log_142"]) == G.GF_LOG[142]
+    assert int(toks["log_255"]) == G.GF_LOG[255]
+    for j in range(2):
+        for i in range(3):
+            assert int(toks[f"cauchy_3_{j}_{i}"]) == G.cauchy_coeff(3, j, i)
+
+
+@needs_native
+def test_ec_status_cross_language_golden():
+    out = _codec("ec-status")
+    lines = dict(line.split("=", 1) for line in out.splitlines() if line)
+    blob = bytes.fromhex(lines.pop("blob"))
+    # The C++ emitter walked kEcStatNames; the names and their order
+    # must be the Python tuple, and the wire blob must decode to the
+    # same fixture values.
+    assert list(lines) == list(P.EC_STAT_FIELDS)
+    expect = {name: 1000 + 13 * i for i, name in enumerate(P.EC_STAT_FIELDS)}
+    assert {k: int(v) for k, v in lines.items()} == expect
+    assert P.unpack_ec_stats(blob) == expect
+
+
+def _rebuild_stripe_bytes(payloads, digests, k, m):
+    """Python encoder for the EcStore on-disk stripe: returns
+    {filename: bytes} for shards s00..s(k+m-1) + the manifest, built
+    from the SAME layout harness.stripe_files parses."""
+    from fastdfs_tpu.ops import rs_code as R
+    data = b"".join(payloads)
+    data_shards = R.split_stripe(data, k)
+    parity = R.rs_encode(data_shards, m, path="np")
+    shard_len = data_shards.shape[1]
+    out = {}
+    for idx, payload in enumerate(np.concatenate([data_shards, parity])):
+        body = bytes(payload)
+        hdr = struct.pack(">8sqIIIqq", b"FDFSECS1", 0, idx, k, m,
+                          shard_len, len(data))
+        hdr += struct.pack(">I", zlib.crc32(body))
+        hdr += struct.pack(">I", zlib.crc32(hdr))
+        assert len(hdr) == EC_SHARD_HEADER_SIZE
+        out[f"0000000000.s{idx:02d}"] = hdr + body
+    mft = struct.pack(">8sIIqqq", b"FDFSECM1", k, m, shard_len,
+                      len(data), len(payloads))
+    off = 0
+    for payload, digest in zip(payloads, digests):
+        mft += bytes.fromhex(digest) + struct.pack(">qqB", off,
+                                                   len(payload), 0)
+        off += len(payload)
+    mft += struct.pack(">I", zlib.crc32(mft))
+    out["0000000000.mft"] = mft
+    return out
+
+
+@needs_native
+def test_ec_stripe_layout_cross_language_golden():
+    # `fdfs_codec ec-stripe-layout` drives the REAL C++ EcStore through
+    # a fixture RS(3, 2) stripe and dumps every file it wrote; the
+    # Python RS kernels + struct encoders must reproduce each file
+    # byte-for-byte — pinning the shard header, the manifest, the Cauchy
+    # matrix, AND the field tables in one golden.  It then deletes m
+    # shards, rescans cold, and proves reconstruction.
+    out = _codec("ec-stripe-layout")
+    payloads = [bytes((ord("A") + i % 23) for i in range(37)),
+                b"ec-golden-b",
+                b"ec golden chunk payload C with some padding tail !"]
+    import hashlib
+    digests = [hashlib.sha1(p).hexdigest() for p in payloads]
+    chunk_lines = [ln for ln in out.splitlines() if ln.startswith("chunk=")]
+    assert [ln.split()[0][6:] for ln in chunk_lines] == digests
+    assert "stripe_id=0 verify=1" in out
+    files = dict(ln[5:].split(" bytes=", 1)
+                 for ln in out.splitlines() if ln.startswith("file="))
+    want = _rebuild_stripe_bytes(payloads, digests, 3, 2)
+    assert sorted(files) == sorted(want)
+    for name, blob_hex in files.items():
+        assert bytes.fromhex(blob_hex) == want[name], name
+    # After losing m=2 shards, a cold rescan still reads every chunk.
+    for i in range(3):
+        assert f"reconstruct_{i}=1" in out
+    # The EC_RELEASE wire body: 16B group + count + per-chunk raw
+    # digest + length, exactly what HandleEcRelease parses.
+    body = P.pack_group_name("group1") + P.long2buff(3)
+    for p, d in zip(payloads, digests):
+        body += bytes.fromhex(d) + P.long2buff(len(p))
+    release = [ln for ln in out.splitlines()
+               if ln.startswith("release_body=")][0][13:]
+    assert bytes.fromhex(release) == body
+
+
+# ---------------------------------------------------------------------------
+# live clusters
+# ---------------------------------------------------------------------------
+
+def test_harness_stripe_parsers_roundtrip(tmp_path):
+    # The harness EC inventory understands exactly the bytes the golden
+    # encoder writes (no daemon needed).
+    import hashlib
+    payloads = [b"x" * 37, b"yy" * 8, b"z" * 129]
+    digests = [hashlib.sha1(p).hexdigest() for p in payloads]
+    ec_dir = os.path.join(str(tmp_path), "data", "ec")
+    os.makedirs(ec_dir)
+    for name, blob in _rebuild_stripe_bytes(payloads, digests, 3, 2).items():
+        with open(os.path.join(ec_dir, name), "wb") as fh:
+            fh.write(blob)
+    stripes = stripe_files(str(tmp_path))
+    assert list(stripes) == [0]
+    st = stripes[0]
+    assert (st["k"], st["m"]) == (3, 2)
+    assert st["data_len"] == sum(len(p) for p in payloads)
+    assert sorted(st["shards"]) == [0, 1, 2, 3, 4]
+    assert shard_digests(str(tmp_path)) == {
+        d: (0, i) for i, d in enumerate(digests)}
+    sid, idx, path = corrupt_shard(str(tmp_path), delete=True)
+    assert (sid, idx) == (0, 0) and not os.path.exists(path)
+    assert sorted(stripe_files(str(tmp_path))[0]["shards"]) == [1, 2, 3, 4]
+
+
+@needs_native
+def test_kill_and_reconstruct_single_node(tmp_path):
+    """The acceptance path: cold chunks demote into RS(3, 2) stripes on
+    an EC_KICK, the replicated flat/slab payloads are dropped, deleting
+    ANY m=2 shard files still yields byte-identical downloads (on-the-
+    fly any-k decode), and a scrub pass rebuilds the lost shards from
+    parity — kill-and-reconstruct without ever touching a full replica."""
+    import itertools
+
+    from fastdfs_tpu.client import FdfsClient, StorageClient
+
+    tmp = str(tmp_path)
+    tr = start_tracker(os.path.join(tmp, "tr"))
+    st = start_storage(os.path.join(tmp, "st"),
+                       trackers=[f"127.0.0.1:{tr.port}"],
+                       dedup_mode="cpu", extra=EC)
+    cli = FdfsClient([f"127.0.0.1:{tr.port}"])
+    base = os.path.join(tmp, "st")
+    try:
+        blobs = [os.urandom(n) for n in (64 << 10, 192 << 10, 300 << 10)]
+        fids = [upload_retry(cli, b, ext="bin") for b in blobs]
+        flat_before = chunk_digests(base)
+        assert flat_before
+
+        # Nothing demotes on an ordinary scrub pass: the age gate holds.
+        cli.scrub_kick("127.0.0.1", st.port)
+        _wait(lambda: cli.scrub_status("127.0.0.1", st.port)["passes"] >= 1)
+        assert cli.ec_status("127.0.0.1", st.port)["stripes"] == 0
+
+        # EC_KICK drops the age gate for one pass: everything stripes.
+        cli.ec_kick("127.0.0.1", st.port)
+        ec = _wait(lambda: (lambda s: s if s["stripes"] >= 1 else None)(
+            cli.ec_status("127.0.0.1", st.port)), timeout=40)
+        assert ec, cli.ec_status("127.0.0.1", st.port)
+        assert ec["enabled"] == 1 and (ec["k"], ec["m"]) == (3, 2)
+        assert ec["demoted_chunks"] >= len(flat_before)
+        assert ec["demoted_bytes"] >= sum(flat_before.values())
+        assert ec["last_demote_unix"] > 0
+        # Every chunk is now EC-resident; the replicated payloads are
+        # gone — this is where the (k+m)/k storage saving comes from.
+        ec_map = shard_digests(base)
+        assert set(flat_before) <= set(ec_map)
+        assert _wait(lambda: not chunk_digests(base))
+        # Parity accounting: overhead stays near (k+m)/k — the physical
+        # bytes are data + parity/padding, never a 2x replica multiple.
+        assert 0 < ec["parity_bytes"] < ec["data_bytes"]
+
+        # Reads decode transparently from the stripes.
+        for fid, blob in zip(fids, blobs):
+            assert cli.download_to_buffer(fid) == blob
+
+        # Kill ANY m shards of one stripe: downloads must not notice.
+        sid = sorted(stripe_files(base))[0]
+        all_idx = sorted(stripe_files(base)[sid]["shards"])
+        lost = list(itertools.combinations(all_idx, 2))[0]
+        for idx in lost:
+            corrupt_shard(base, stripe_id=sid, shard_idx=idx, delete=True)
+        for fid, blob in zip(fids, blobs):
+            assert cli.download_to_buffer(fid) == blob
+
+        # A scrub pass rebuilds the lost shards from parity (never a
+        # full-replica fetch: repair_fallback_chunks stays 0).
+        cli.scrub_kick("127.0.0.1", st.port)
+        ec = _wait(lambda: (lambda s: s
+                            if s["reconstructed_shards"] >= 2 else None)(
+            cli.ec_status("127.0.0.1", st.port)), timeout=40)
+        assert ec, cli.ec_status("127.0.0.1", st.port)
+        assert ec["reconstructed_bytes"] > 0
+        assert ec["repair_fallback_chunks"] == 0
+        assert sorted(stripe_files(base)[sid]["shards"]) == all_idx
+
+        # Bit-rot inside a shard payload: same rebuild path.
+        corrupt_shard(base, stripe_id=sid, shard_idx=all_idx[0])
+        cli.scrub_kick("127.0.0.1", st.port)
+        ec = _wait(lambda: (lambda s: s
+                            if s["reconstructed_shards"] >= 3 else None)(
+            cli.ec_status("127.0.0.1", st.port)), timeout=40)
+        assert ec, cli.ec_status("127.0.0.1", st.port)
+        for fid, blob in zip(fids, blobs):
+            assert cli.download_to_buffer(fid) == blob
+
+        # DELETE reclaims parity bytes: dropping the last ref retires
+        # the chunks from their stripes and GC frees the shard files.
+        before_parity = ec["parity_bytes"]
+        for fid in fids:
+            cli.delete_file(fid)
+        time.sleep(1.2)  # gc grace
+        cli.scrub_kick("127.0.0.1", st.port)
+        ec = _wait(lambda: (lambda s: s if s["stripes"] == 0 else None)(
+            cli.ec_status("127.0.0.1", st.port)), timeout=40)
+        assert ec, cli.ec_status("127.0.0.1", st.port)
+        assert ec["parity_bytes"] == 0 < before_parity
+        assert not stripe_files(base)
+
+        # The registry mirrors the EC stats (fdfs_monitor surface)...
+        with StorageClient("127.0.0.1", st.port) as sc:
+            gauges = sc.stat()["gauges"]
+        assert gauges["ec.enabled"] == 1
+        assert gauges["ec.demoted_chunks"] >= len(flat_before)
+        assert gauges["ec.reconstructed_shards"] >= 3
+        # ...and the operator CLI renders the tier.
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-m", "fastdfs_tpu.cli", "ec",
+             f"127.0.0.1:{tr.port}"],
+            capture_output=True, cwd=REPO, env=env, timeout=60)
+        assert out.returncode == 0, out.stderr.decode()
+        text = out.stdout.decode()
+        assert "RS(3+2)" in text and "reconstructed: " in text
+    finally:
+        st.stop()
+        tr.stop()
+
+
+@needs_native
+def test_ec_status_enotsup_when_off(tmp_path):
+    """A daemon with ec_k = 0 and nothing striped answers EC_STATUS and
+    EC_KICK with ENOTSUP(95) — misconfiguration surfaces loudly rather
+    than as silent zeros."""
+    from fastdfs_tpu.client import FdfsClient
+    from fastdfs_tpu.client.conn import StatusError
+
+    tmp = str(tmp_path)
+    tr = start_tracker(os.path.join(tmp, "tr"))
+    st = start_storage(os.path.join(tmp, "st"),
+                       trackers=[f"127.0.0.1:{tr.port}"],
+                       dedup_mode="cpu", extra=HB)
+    cli = FdfsClient([f"127.0.0.1:{tr.port}"])
+    try:
+        upload_retry(cli, b"warm", ext="bin")  # daemon is fully up
+        with pytest.raises(StatusError) as e:
+            cli.ec_status("127.0.0.1", st.port)
+        assert e.value.status == 95
+        with pytest.raises(StatusError) as e:
+            cli.ec_kick("127.0.0.1", st.port)
+        assert e.value.status == 95
+    finally:
+        st.stop()
+        tr.stop()
+
+
+@needs_native
+def test_release_handover_two_nodes(tmp_path):
+    """Group-wide replica release: with two members each chunk has one
+    jump-hash owner; after both EC_KICK, the owner holds the stripe and
+    the peer RELEASES its replica (verify-then-release handover), yet
+    reads at the released peer still serve bytes via a remote decode
+    from the owner (ec.remote_reads)."""
+    from fastdfs_tpu.client import FdfsClient, StorageClient
+
+    tmp = str(tmp_path)
+    tr = start_tracker(os.path.join(tmp, "tr"))
+    taddr = f"127.0.0.1:{tr.port}"
+    sts = []
+    for i in range(2):
+        ip = f"127.0.0.{70 + i}"
+        sts.append(start_storage(os.path.join(tmp, f"st{i}"),
+                                 port=free_port(), ip=ip, trackers=[taddr],
+                                 dedup_mode="cpu", extra=EC))
+    cli = FdfsClient([taddr])
+    bases = [os.path.join(tmp, f"st{i}") for i in range(2)]
+    try:
+        data = os.urandom(512 << 10)
+        fid = upload_retry(cli, data, ext="bin")
+        # Replication done: both members hold every chunk.
+        assert _wait(lambda: all(chunk_digests(b) for b in bases),
+                     timeout=40)
+        inv = chunk_digests(bases[0])
+        assert inv == chunk_digests(bases[1])
+
+        for s in sts:
+            cli.ec_kick(s.ip, s.port)
+
+        def handover_done():
+            stats = [cli.ec_status(s.ip, s.port) for s in sts]
+            if sum(st["demoted_chunks"] for st in stats) < len(inv):
+                return None
+            if sum(st["released_chunks"] for st in stats) < 1:
+                return None
+            return stats
+        stats = _wait(handover_done, timeout=60)
+        assert stats, [cli.ec_status(s.ip, s.port) for s in sts]
+        # Ownership partitions the digest set: each chunk is EC-resident
+        # on exactly one member, and the peer's replica is gone.
+        maps = [shard_digests(b) for b in bases]
+        assert set(maps[0]) | set(maps[1]) >= set(inv)
+        assert not (set(maps[0]) & set(maps[1]))
+        # Released bytes really left the disk on at least one side.
+        assert any(not chunk_digests(b) or
+                   set(chunk_digests(b)) < set(inv) for b in bases)
+
+        # Reads at BOTH members stay byte-identical — the released side
+        # proxies chunk reads to the stripe owner.
+        for s in sts:
+            with StorageClient(s.ip, s.port) as sc:
+                assert sc.download_to_buffer(fid) == data
+        assert sum(cli.ec_status(s.ip, s.port)["remote_reads"]
+                   for s in sts) >= 1
+    finally:
+        for s in sts:
+            s.stop()
+        tr.stop()
+
+
+@needs_native
+def test_demote_races_uploads_and_downloads(tmp_path):
+    """Demotion under live traffic: EC kicks race concurrent uploads and
+    downloads for several seconds; every download is byte-identical and
+    the daemon survives (the TSan/lock-rank target)."""
+    from fastdfs_tpu.client import FdfsClient
+
+    tmp = str(tmp_path)
+    tr = start_tracker(os.path.join(tmp, "tr"))
+    st = start_storage(os.path.join(tmp, "st"),
+                       trackers=[f"127.0.0.1:{tr.port}"],
+                       dedup_mode="cpu", extra=EC)
+    cli = FdfsClient([f"127.0.0.1:{tr.port}"])
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def kicker():
+        while not stop.is_set():
+            try:
+                cli.ec_kick("127.0.0.1", st.port)
+                cli.scrub_kick("127.0.0.1", st.port)
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"kick: {e}")
+            time.sleep(0.1)
+
+    try:
+        corpus = {upload_retry(cli, os.urandom(96 << 10), ext="bin"): None
+                  for _ in range(3)}
+        blobs = {}
+        for fid in corpus:
+            blobs[fid] = cli.download_to_buffer(fid)
+        t = threading.Thread(target=kicker)
+        t.start()
+        deadline = time.time() + 8
+        rng = np.random.default_rng(3)
+        while time.time() < deadline:
+            data = os.urandom(int(rng.integers(1, 128)) << 10)
+            fid = cli.upload_buffer(data, ext="bin")
+            blobs[fid] = data
+            for f, want in list(blobs.items()):
+                got = cli.download_to_buffer(f)
+                if got != want:
+                    errors.append(f"mismatch on {f}")
+        stop.set()
+        t.join()
+        assert not errors, errors[:5]
+        # The tier did real work while traffic flowed.
+        assert cli.ec_status("127.0.0.1", st.port)["demoted_chunks"] > 0
+        for f, want in blobs.items():
+            assert cli.download_to_buffer(f) == want
+    finally:
+        stop.set()
+        st.stop()
+        tr.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -433,7 +433,8 @@ def test_manifest_golden_names_resolve():
     assert goldens == {"stats-json", "trace-json", "trace-ctx",
                        "event-json", "scrub-status", "ingest-wire",
                        "metrics-history", "heat-top", "placement-wire",
-                       "group-admin", "profile-ctl", "profile-json"}
+                       "group-admin", "profile-ctl", "profile-json",
+                       "ec-status", "ec-stripe-layout"}
 
 
 if __name__ == "__main__":

@@ -103,7 +103,19 @@ def _sample_registry() -> dict:
                    "slab.slots_dead": 17, "slab.bytes_live": 1228800,
                    "slab.bytes_dead": 69632, "slab.compactions": 3,
                    "slab.compacted_bytes": 524288,
-                   "store.inodes_used": 4242},
+                   "store.inodes_used": 4242,
+                   # erasure-coded cold tier (ISSUE 16): stripe
+                   # inventory, demotion/release accounting, and the
+                   # reconstruction counters operators alert on
+                   "ec.enabled": 1, "ec.k": 3, "ec.m": 2,
+                   "ec.stripes": 5, "ec.stripe_chunks": 40,
+                   "ec.data_bytes": 5242880, "ec.parity_bytes": 3495253,
+                   "ec.demoted_chunks": 40, "ec.demoted_bytes": 5242880,
+                   "ec.released_chunks": 12, "ec.released_bytes": 1572864,
+                   "ec.reconstructed_shards": 2,
+                   "ec.reconstructed_bytes": 349525,
+                   "ec.repair_fallback_chunks": 1, "ec.remote_reads": 9,
+                   "ec.last_demote_unix": 1700000000},
         "histograms": {
             "op.upload_file.latency_us": {
                 "bounds": [100, 1000, 10000],
@@ -270,6 +282,20 @@ def test_prometheus_exposition_parses():
     assert series["fdfs_slab_compactions"][0][1] == 3.0
     assert series["fdfs_slab_compacted_bytes"][0][1] == 524288.0
     assert series["fdfs_store_inodes_used"][0][1] == 4242.0
+    # Erasure-coding golden (ISSUE 16): the cold tier's stripe/parity
+    # accounting and reconstruction counters export per-storage so
+    # dashboards can chart the (k+m)/k storage win and alert on stripes
+    # that needed repair.
+    assert series["fdfs_ec_enabled"][0] == (
+        '{storage="127.0.0.1:23000"}', 1.0)
+    assert series["fdfs_ec_stripes"][0][1] == 5.0
+    assert series["fdfs_ec_data_bytes"][0][1] == 5242880.0
+    assert series["fdfs_ec_parity_bytes"][0][1] == 3495253.0
+    assert series["fdfs_ec_demoted_chunks"][0][1] == 40.0
+    assert series["fdfs_ec_released_bytes"][0][1] == 1572864.0
+    assert series["fdfs_ec_reconstructed_shards"][0][1] == 2.0
+    assert series["fdfs_ec_repair_fallback_chunks"][0][1] == 1.0
+    assert series["fdfs_ec_remote_reads"][0][1] == 9.0
     buckets = series["fdfs_op_upload_file_latency_us_bucket"]
     values = [v for _, v in buckets]
     assert values == sorted(values), "histogram buckets must be cumulative"

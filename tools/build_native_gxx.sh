@@ -34,7 +34,7 @@ srcs_common="common/bytes.cc common/cdc.cc common/fileid.cc common/ini.cc
   common/sloeval.cc common/heatsketch.cc common/fsutil.cc
   common/threadreg.cc common/profiler.cc
   common/http_token.cc"
-srcs_storage="storage/chunkstore.cc storage/slabstore.cc
+srcs_storage="storage/chunkstore.cc storage/slabstore.cc storage/ecstore.cc
   storage/config.cc storage/store.cc
   storage/binlog.cc storage/trunk.cc storage/recovery.cc storage/rebalance.cc storage/scrub.cc storage/dedup.cc
   storage/server.cc storage/sync.cc storage/tracker_client.cc"
@@ -66,6 +66,7 @@ link storage/main.cc "$BUILD_DIR/obj/libfdfs_storage.a" \
 link tracker/main.cc "$BUILD_DIR/obj/libfdfs_tracker.a" \
   "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/fdfs_trackerd" &
 link tools/codec_cli.cc "$BUILD_DIR/obj/storage_slabstore.o" \
+  "$BUILD_DIR/obj/storage_ecstore.o" \
   "$BUILD_DIR/obj/tracker_placement.o" \
   "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/fdfs_codec" &
 link tools/load_cli.cc "$BUILD_DIR/obj/libfdfs_common.a" \

@@ -142,6 +142,11 @@ EXTRA_GOLDENS = (
     # parse these names back apart, so the scheme is a cross-language
     # contract (ISSUE 15).
     "thread-ledger",
+    # GF(2^8) arithmetic-table contract (poly 0x11D, generator 2):
+    # native/common/gf256.h and fastdfs_tpu/ops/gf256.py are generated
+    # from the same tool, and every RS shard on disk assumes this exact
+    # field — the golden pins table CRCs + sample products (ISSUE 16).
+    "gf-tables",
 )
 
 # Checked-in fixture goldens: JSON files under tests/ pinning kernel
