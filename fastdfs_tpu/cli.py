@@ -768,6 +768,101 @@ def cmd_ec(c: FdfsClient, args: list[str]) -> int:
         return 0
 
 
+def cmd_health(c: FdfsClient, args: list[str]) -> int:
+    """Gray-failure health console: the tracker's N x N differential
+    matrix (HEALTH_MATRIX — each node's self-reported score against what
+    its group peers score it, with the tracker's verdict) and, with
+    --detail, every storage's own HEALTH_STATUS table (per-peer, per-op
+    EWMA latency / error% / timeout%, disk-probe latencies, stalled
+    threads).
+
+    Verdicts: ok      both views at/above the gray threshold
+              gray    peers score it below threshold while its own
+                      trailer claims healthy — the signature gray
+                      failure (slow disk, flaky NIC, wedged thread)
+              sick    its own trailer admits a score below threshold
+              unknown no health data yet (old storage, or just booted)
+
+    Flags: --detail        also query each storage's HEALTH_STATUS
+           --watch [s]     re-render every s seconds (default 2) until
+                           interrupted
+           --json          machine-readable {matrix: ..., status: ...}
+    """
+    import time as _time
+
+    from fastdfs_tpu import monitor as M
+
+    interval = 0.0
+    if "--watch" in args:
+        i = args.index("--watch")
+        interval = 2.0
+        if i + 1 < len(args) and not args[i + 1].startswith("--"):
+            try:
+                interval = float(args[i + 1])
+            except ValueError:
+                pass
+
+    def render_once() -> int:
+        raw = c.health_matrix()
+        matrix = M.decode_health_matrix(raw)
+        detail: dict[str, dict] = {}
+        errors: dict[str, str] = {}
+        if "--detail" in args:
+            for n in matrix.nodes:
+                ip, _, port = n.addr.rpartition(":")
+                try:
+                    detail[n.addr] = c.storage_health_status(ip, int(port))
+                except Exception as e:  # noqa: BLE001 — a dead node is a row
+                    errors[n.addr] = str(e)
+        if "--json" in args:
+            print(json.dumps({"matrix": raw, "status": detail,
+                              "errors": errors}, indent=2, sort_keys=True))
+            return 0 if not errors else 1
+        print(f"gray threshold: {matrix.gray_threshold}  "
+              f"(score 0..100, 100 = healthy)")
+        cols = (f"{'node':<28} {'verdict':<8} {'self':>5} {'peers':>6} "
+                f"{'reports':>7} {'age':>5}")
+        print(cols)
+        print("-" * len(cols))
+        order = {"gray": 0, "sick": 1, "unknown": 2, "ok": 3}
+        flagged = 0
+        for n in sorted(matrix.nodes,
+                        key=lambda n: (order[n.verdict], n.addr)):
+            if n.verdict in ("gray", "sick"):
+                flagged += 1
+            self_s = "-" if n.self_score < 0 else str(n.self_score)
+            peer_s = "-" if n.peer_avg < 0 else str(n.peer_avg)
+            age = "-" if n.age_s < 0 else f"{n.age_s}s"
+            print(f"{n.group + '/' + n.addr:<28} {n.verdict:<8} "
+                  f"{self_s:>5} {peer_s:>6} {n.reports:>7} {age:>5}")
+        for addr, raw_st in sorted(detail.items()):
+            st = M.decode_health_status(raw_st)
+            print(f"\n{addr}  self={st.score}  stalled={st.stalled_threads}"
+                  f"  probe read={st.probe_read_us}us "
+                  f"write={st.probe_write_us}us "
+                  f"(threshold {st.probe_threshold_ms}ms)")
+            for p in st.peers:
+                print(f"  {p.addr:<24} {p.op:<6} score={p.score:<4} "
+                      f"ewma={p.rpc_ewma_us}us err={p.error_pct}% "
+                      f"timeout={p.timeout_pct}% "
+                      f"ops={p.ops}/{p.errors}e/{p.timeouts}t "
+                      f"age={p.age_s}s")
+        for addr, err in sorted(errors.items()):
+            print(f"\n{addr}  error: {err}")
+        return 0 if not errors else 1
+
+    if interval <= 0:
+        return render_once()
+    try:
+        while True:
+            if "--json" not in args:  # keep --watch --json parseable
+                print(f"-- health @ {_time.strftime('%H:%M:%S')} --")
+            render_once()
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_group(c: FdfsClient, args: list[str]) -> int:
     """Group lifecycle console (multi-group scale-out): the placement
     epoch with per-group state and, for draining groups, each member's
@@ -888,6 +983,7 @@ TOOLS = {
     "profile": cmd_profile,
     "scrub": cmd_scrub,
     "ec": cmd_ec,
+    "health": cmd_health,
     "group": cmd_group,
 }
 

@@ -32,7 +32,8 @@ class FdfsClient:
                  dedup_digest_cache: int = 1 << 16,
                  parallel_downloads: int = 1,
                  download_range_bytes: int = 4 << 20,
-                 use_placement: bool = False):
+                 use_placement: bool = False,
+                 dead_peer_cooldown_s: float = 30.0):
         if isinstance(tracker_addrs, str):
             tracker_addrs = [tracker_addrs]
         if not tracker_addrs:
@@ -42,7 +43,12 @@ class FdfsClient:
         # Pooled, health-checked connections per endpoint (reference:
         # connection_pool.c / client.conf:use_connection_pool); every
         # operation borrows and parks instead of reconnecting twice.
-        self.pool = ConnectionPool() if use_pool else None
+        # The pool also keeps the dead-peer cooldown map: endpoints that
+        # failed at the transport level are deprioritized for
+        # dead_peer_cooldown_s so each operation does not re-pay a
+        # connect timeout against the same silent peer.
+        self.pool = (ConnectionPool(dead_peer_cooldown=dead_peer_cooldown_s)
+                     if use_pool else None)
         # Distributed tracing: a fastdfs_tpu.trace.Tracer (or None).
         # While set, every tracker/storage connection this client
         # acquires carries the tracer's current wire context, so daemon
@@ -87,7 +93,8 @@ class FdfsClient:
         # winning" from "dedup quietly gave up on every upload".
         self._fallbacks = {"dedup_fallback_plain": 0,
                            "placement_fallback_tracker": 0,
-                           "ranged_fallback_single": 0}
+                           "ranged_fallback_single": 0,
+                           "dead_peer_skips": 0}
 
     @classmethod
     def from_conf(cls, conf_path: str) -> "FdfsClient":
@@ -102,7 +109,9 @@ class FdfsClient:
                    parallel_downloads=int(cfg.get("parallel_downloads", 1)),
                    download_range_bytes=int(
                        cfg.get_bytes("download_range_bytes", 4 << 20)),
-                   use_placement=bool(cfg.get_bool("use_placement", False)))
+                   use_placement=bool(cfg.get_bool("use_placement", False)),
+                   dead_peer_cooldown_s=float(
+                       cfg.get_seconds("dead_peer_cooldown_s", 30)))
 
     def close(self) -> None:
         if self.pool is not None:
@@ -111,10 +120,11 @@ class FdfsClient:
     def stats(self) -> dict:
         """Lifetime client-side fallback counters: how often the dedup
         upload fell back to a plain UPLOAD_FILE, the placement shortcut
-        fell back to the tracker hop, and a parallel ranged download
-        fell back to the classic single stream.  The fallbacks are
-        transparent (the call still succeeds), so this is the only
-        place their frequency is visible."""
+        fell back to the tracker hop, a parallel ranged download fell
+        back to the classic single stream, and routing skipped a peer
+        inside its dead-peer cooldown in favor of a live one.  The
+        fallbacks are transparent (the call still succeeds), so this is
+        the only place their frequency is visible."""
         return dict(self._fallbacks)
 
     def _wire_ctx(self):
@@ -122,9 +132,18 @@ class FdfsClient:
 
     def _tracker(self) -> TrackerClient:
         # Random start + failover (reference: tracker_get_connection's
-        # round-robin over the tracker group).
+        # round-robin over the tracker group).  Trackers inside their
+        # dead-peer cooldown sort last: they are still tried — the mark
+        # is advisory, and with every tracker dead the order is simply
+        # unchanged — but a live sibling wins without paying a connect
+        # timeout first.
         addrs = self.trackers[:]
         random.shuffle(addrs)
+        if self.pool is not None and len(addrs) > 1:
+            dead = [a for a in addrs if self.pool.is_dead(*a)]
+            if dead and len(dead) < len(addrs):
+                addrs = [a for a in addrs if a not in dead] + dead
+                self._fallbacks["dead_peer_skips"] += len(dead)
         last_err: Exception | None = None
         for host, port in addrs:
             try:
@@ -138,6 +157,8 @@ class FdfsClient:
                 return t
             except OSError as e:
                 last_err = e
+                if self.pool is not None:
+                    self.pool.mark_dead(host, port)
         raise ConnectionError(f"no tracker reachable: {last_err}")
 
     def _with_tracker(self, fn):
@@ -170,6 +191,7 @@ class FdfsClient:
                 last = e
                 if self.pool is not None:
                     self.pool.purge(*endpoint)
+                    self.pool.mark_dead(*endpoint)
         raise last if last is not None else ConnectionError("no tracker")
 
     def _storage(self, tgt) -> StorageClient:
@@ -214,7 +236,21 @@ class FdfsClient:
             return None
         g = active[jump_hash(placement_key(key), len(active))]
         self._placement_rr += 1
-        m = g["members"][self._placement_rr % len(g["members"])]
+        members = g["members"]
+        idx = self._placement_rr % len(members)
+        if (self.pool is not None
+                and self.pool.is_dead(members[idx]["ip"],
+                                      members[idx]["port"])):
+            # Round-robin landed on a member inside its dead-peer
+            # cooldown: advance to the next live one (all-dead keeps the
+            # pick — the upload path's own fallback covers the failure).
+            live = [i for i in range(len(members))
+                    if not self.pool.is_dead(members[i]["ip"],
+                                             members[i]["port"])]
+            if live:
+                idx = live[self._placement_rr % len(live)]
+                self._fallbacks["dead_peer_skips"] += 1
+        m = members[idx]
         return StoreTarget(group=g["group"], ip=m["ip"], port=m["port"],
                            store_path_index=0xFF)
 
@@ -401,12 +437,32 @@ class FdfsClient:
             mv = memoryview(buf)
 
             def fetch(idx: int, off: int, ln: int) -> None:
-                tgt = replicas[replica_for_range(file_id, idx,
-                                                 len(replicas))]
-                with self._storage(tgt) as s:
-                    s.download_into(file_id,
-                                    mv[off - offset:off - offset + ln],
-                                    offset=off)
+                # Cache-affinity pick first; a replica inside its
+                # dead-peer cooldown yields to the next live one (the
+                # affinity win is worthless against a connect timeout).
+                # All-dead keeps the original pick — the mark is
+                # advisory, and the outer fallback still covers failure.
+                k = replica_for_range(file_id, idx, len(replicas))
+                if (self.pool is not None
+                        and self.pool.is_dead(replicas[k].ip,
+                                              replicas[k].port)):
+                    for step in range(1, len(replicas)):
+                        alt = (k + step) % len(replicas)
+                        if not self.pool.is_dead(replicas[alt].ip,
+                                                 replicas[alt].port):
+                            k = alt
+                            self._fallbacks["dead_peer_skips"] += 1
+                            break
+                tgt = replicas[k]
+                try:
+                    with self._storage(tgt) as s:
+                        s.download_into(file_id,
+                                        mv[off - offset:off - offset + ln],
+                                        offset=off)
+                except OSError:
+                    if self.pool is not None:
+                        self.pool.mark_dead(tgt.ip, tgt.port)
+                    raise
 
             with concurrent.futures.ThreadPoolExecutor(
                     min(parallel, len(ranges))) as ex:
@@ -552,6 +608,17 @@ class FdfsClient:
         per fastdfs_tpu.monitor.decode_profile."""
         with self._storage(FetchTarget(ip=ip, port=port)) as s:
             return s.profile_dump()
+
+    def health_matrix(self) -> dict:
+        """The tracker's gray-failure differential matrix
+        (HEALTH_MATRIX); shape per monitor.decode_health_matrix."""
+        return self._with_tracker(lambda t: t.health_matrix())
+
+    def storage_health_status(self, ip: str, port: int) -> dict:
+        """One storage daemon's gray-failure health view (HEALTH_STATUS);
+        shape per monitor.decode_health_status."""
+        with self._storage(FetchTarget(ip=ip, port=port)) as s:
+            return s.health_status()
 
     def scrub_status(self, ip: str, port: int) -> dict[str, int]:
         """One storage daemon's integrity-engine status (SCRUB_STATUS)."""

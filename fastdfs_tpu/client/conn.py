@@ -248,13 +248,23 @@ class ConnectionPool:
     closed by the peer (EOF) or desynced (stray bytes) — both discard.
     Connections marked ``broken`` by mid-message failures are never
     pooled.  Thread-safe; callers acquire/release around each operation.
+
+    Dead-peer backoff: after a transport failure the caller reports the
+    endpoint via ``mark_dead``; for ``dead_peer_cooldown`` seconds
+    ``is_dead`` answers True so routing layers can deprioritize the
+    endpoint instead of paying a connect timeout per operation.  The
+    mark is advisory — callers with no alternative still connect, and a
+    successful fresh connect clears it early.
     """
 
     def __init__(self, max_idle_per_endpoint: int = 8,
-                 max_idle_seconds: float = 300.0):
+                 max_idle_seconds: float = 300.0,
+                 dead_peer_cooldown: float = 30.0):
         self.max_idle_per_endpoint = max_idle_per_endpoint
         self.max_idle_seconds = max_idle_seconds
+        self.dead_peer_cooldown = dead_peer_cooldown
         self._idle: dict[tuple[str, int], deque] = {}
+        self._dead: dict[tuple[str, int], float] = {}
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
@@ -277,7 +287,36 @@ class ConnectionPool:
             return conn
         with self._lock:
             self.misses += 1
-        return Connection(host, port, timeout)
+        conn = Connection(host, port, timeout)
+        # A fresh connect succeeding is live proof: clear any cooldown
+        # early rather than waiting out the timer.
+        with self._lock:
+            self._dead.pop((host, port), None)
+        return conn
+
+    # -- dead-peer backoff -------------------------------------------------
+
+    def mark_dead(self, host: str, port: int) -> None:
+        """Start (or extend) the cooldown for one endpoint after a
+        transport failure.  No-op when the cooldown is disabled (<= 0)."""
+        if self.dead_peer_cooldown <= 0:
+            return
+        with self._lock:
+            self._dead[(host, port)] = (time.monotonic()
+                                        + self.dead_peer_cooldown)
+
+    def is_dead(self, host: str, port: int) -> bool:
+        """True while the endpoint is inside its failure cooldown.
+        Expired marks are dropped on read, so a peer that stays quiet
+        past the cooldown costs nothing."""
+        with self._lock:
+            deadline = self._dead.get((host, port))
+            if deadline is None:
+                return False
+            if time.monotonic() >= deadline:
+                del self._dead[(host, port)]
+                return False
+            return True
 
     def release(self, conn: Connection) -> None:
         conn.trace_ctx = None  # a parked conn must not carry a stale trace

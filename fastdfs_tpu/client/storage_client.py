@@ -485,6 +485,14 @@ class StorageClient:
         self.conn.send_request(StorageCmd.HEAT_TOP, body)
         return json.loads(self.conn.recv_response("heat_top") or b"{}")
 
+    def health_status(self) -> dict:
+        """Gray-failure health view (HEALTH_STATUS 146): this daemon's
+        own gray score (watchdog stalls + disk-path probes) and its
+        per-(peer, op class) RPC health table.  Shape per
+        fastdfs_tpu.monitor.decode_health_status."""
+        self.conn.send_request(StorageCmd.HEALTH_STATUS)
+        return json.loads(self.conn.recv_response("health_status") or b"{}")
+
     def scrub_status(self) -> dict[str, int]:
         """Integrity-engine status (SCRUB_STATUS 134): named scrub/GC
         counters decoded from the fixed int64 blob (SCRUB_STAT_FIELDS).

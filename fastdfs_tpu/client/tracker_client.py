@@ -278,6 +278,15 @@ class TrackerClient:
         self.conn.send_request(TrackerCmd.EVENT_DUMP)
         return json.loads(self.conn.recv_response("event_dump") or b"{}")
 
+    def health_matrix(self) -> dict:
+        """Gray-failure differential matrix (HEALTH_MATRIX 69): every
+        storage's self-reported gray score from its beat trailer against
+        what its group peers score it, with the tracker's verdict
+        (ok/gray/sick/unknown).  Shape per
+        fastdfs_tpu.monitor.decode_health_matrix."""
+        self.conn.send_request(TrackerCmd.HEALTH_MATRIX)
+        return json.loads(self.conn.recv_response("health_matrix") or b"{}")
+
     def metrics_history(self, since_us: int = 0) -> dict:
         """Metrics-journal window dump (METRICS_HISTORY 99): the
         tracker's retained registry snapshots with ts_us >= since_us

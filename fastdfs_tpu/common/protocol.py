@@ -357,6 +357,18 @@ class TrackerCmd(enum.IntEnum):
     # fastdfs_tpu.monitor.decode_profile (pinned by the fdfs_codec
     # profile-json golden).  ENOTSUP while a capture was never started.
     PROFILE_DUMP = 68
+    # fastdfs_tpu extension: N x N differential gray-failure matrix
+    # (OPERATIONS.md "Health, probes & gray failure").  Every storage
+    # appends a health trailer to its beat (self gray score + its EWMA
+    # scores ABOUT each group peer, append-only past the pinned stat
+    # slots); the tracker folds those into per-node rows so a node most
+    # *peers* report slow is flagged gray even while it self-reports
+    # healthy.  Empty body -> JSON {"role","port","gray_threshold",
+    # "nodes":[{"group","addr","self","peer_avg","reports","verdict",
+    # "age_s","peers":{addr:score}}]} with verdict one of ok | gray |
+    # sick | unknown.  Shape per fastdfs_tpu.monitor.decode_health_matrix;
+    # pinned by the fdfs_codec health-matrix cross-language golden.
+    HEALTH_MATRIX = 69
 
     # fastdfs_tpu extension: distributed-tracing context prefix frame
     # (see TRACE_CTX_LEN above).  Deliberately the SAME value on both
@@ -576,6 +588,18 @@ class StorageCmd(enum.IntEnum):
     EC_STATUS = 143
     EC_KICK = 144
     EC_RELEASE = 145
+    # Gray-failure health snapshot (fastdfs_tpu extension; see
+    # native/common/healthmon.*).  The daemon's local view: the per-peer
+    # EWMA RPC health table (fed passively from every outbound NetRpc
+    # plus an active ACTIVE_TEST probe loop), the per-store-path disk
+    # probe latencies, and the thread-watchdog state.  Empty body ->
+    # JSON {"role","port","score","stalled_threads","probe":
+    # {"read_us","write_us","threshold_ms"},"peers":[{"addr","op",
+    # "score","rpc_ewma_us","error_pct","timeout_pct","ops","errors",
+    # "timeouts","age_s"}]}.  Shape per
+    # fastdfs_tpu.monitor.decode_health_status; pinned by the fdfs_codec
+    # health-status cross-language golden.
+    HEALTH_STATUS = 146
 
     RESP = 100
     ACTIVE_TEST = 111
@@ -629,6 +653,8 @@ WIRE_GOLDENS = {
     "StorageCmd.PROFILE_DUMP": "profile-json",
     "StorageCmd.EC_STATUS": "ec-status",
     "StorageCmd.EC_RELEASE": "ec-stripe-layout",
+    "TrackerCmd.HEALTH_MATRIX": "health-matrix",
+    "StorageCmd.HEALTH_STATUS": "health-status",
 }
 
 

@@ -315,6 +315,133 @@ def thread_ledger(reg: dict) -> list[dict]:
 
 
 # ---------------------------------------------------------------------------
+# gray-failure health decoding (HEALTH_STATUS / HEALTH_MATRIX;
+# native/common/healthmon.h + tracker/cluster.cc).  Wire shapes pinned
+# cross-language by the fdfs_codec health-status / health-matrix goldens.
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class HealthPeerRow:
+    """One (peer address, op class) row from a daemon's health table.
+    ``score`` is 0..100 (100 = healthy); the peer's composite score is
+    the MINIMUM across its op classes."""
+    addr: str
+    op: str
+    score: int
+    rpc_ewma_us: int
+    error_pct: int
+    timeout_pct: int
+    ops: int
+    errors: int
+    timeouts: int
+    age_s: int
+
+
+@dataclass(frozen=True)
+class HealthStatus:
+    """One daemon's HEALTH_STATUS view: its own gray score (watchdog +
+    disk probes) plus its per-peer RPC health table."""
+    role: str
+    port: int
+    score: int           # SelfScore: 0..100
+    stalled_threads: int
+    probe_read_us: int
+    probe_write_us: int
+    probe_threshold_ms: int
+    peers: tuple         # HealthPeerRow, (addr, op)-sorted
+
+
+def decode_health_status(obj: dict) -> HealthStatus:
+    """Validate and decode one daemon's HEALTH_STATUS JSON (rows arrive
+    (addr, op)-sorted; unknown extra keys are ignored — the wire
+    contract is append-only)."""
+    if not isinstance(obj, dict) or not isinstance(obj.get("peers"), list):
+        raise ValueError(f"health status must have a peers list: {obj!r}")
+    rows: list[HealthPeerRow] = []
+    for p in obj["peers"]:
+        try:
+            rows.append(HealthPeerRow(
+                addr=str(p["addr"]), op=str(p["op"]), score=int(p["score"]),
+                rpc_ewma_us=int(p["rpc_ewma_us"]),
+                error_pct=int(p["error_pct"]),
+                timeout_pct=int(p["timeout_pct"]), ops=int(p["ops"]),
+                errors=int(p["errors"]), timeouts=int(p["timeouts"]),
+                age_s=int(p["age_s"])))
+        except (KeyError, TypeError, ValueError) as err:
+            raise ValueError(f"malformed health peer {p!r}: {err}") from None
+    if any((a.addr, a.op) > (b.addr, b.op) for a, b in zip(rows, rows[1:])):
+        raise ValueError("health peers not (addr, op)-sorted")
+    try:
+        probe = dict(obj.get("probe", {}))
+        return HealthStatus(
+            role=str(obj["role"]), port=int(obj["port"]),
+            score=int(obj["score"]),
+            stalled_threads=int(obj["stalled_threads"]),
+            probe_read_us=int(probe.get("read_us", 0)),
+            probe_write_us=int(probe.get("write_us", 0)),
+            probe_threshold_ms=int(probe.get("threshold_ms", 0)),
+            peers=tuple(rows))
+    except (KeyError, TypeError, ValueError) as err:
+        raise ValueError(f"malformed health status: {err}") from None
+
+
+_HEALTH_VERDICTS = ("ok", "gray", "sick", "unknown")
+
+
+@dataclass(frozen=True)
+class HealthMatrixNode:
+    """One node's row in the tracker's N x N differential matrix:
+    what it SAYS about itself (``self_score``, -1 = never reported)
+    against what its group peers SAY about it (``peer_avg``, -1 = no
+    reports).  ``verdict`` is the tracker's call: a "gray" node claims
+    healthy while peers score it under the threshold."""
+    group: str
+    addr: str
+    self_score: int
+    peer_avg: int
+    reports: int
+    verdict: str
+    age_s: int
+    peers: dict  # addr -> score THIS node reported about its peers
+
+
+@dataclass(frozen=True)
+class HealthMatrix:
+    role: str
+    port: int
+    gray_threshold: int
+    nodes: tuple  # HealthMatrixNode
+
+
+def decode_health_matrix(obj: dict) -> HealthMatrix:
+    """Validate and decode the tracker's HEALTH_MATRIX JSON (unknown
+    extra keys are ignored — the wire contract is append-only)."""
+    if not isinstance(obj, dict) or not isinstance(obj.get("nodes"), list):
+        raise ValueError(f"health matrix must have a nodes list: {obj!r}")
+    nodes: list[HealthMatrixNode] = []
+    for n in obj["nodes"]:
+        try:
+            verdict = str(n["verdict"])
+            if verdict not in _HEALTH_VERDICTS:
+                raise ValueError(f"unknown verdict {verdict!r}")
+            nodes.append(HealthMatrixNode(
+                group=str(n["group"]), addr=str(n["addr"]),
+                self_score=int(n["self"]), peer_avg=int(n["peer_avg"]),
+                reports=int(n["reports"]), verdict=verdict,
+                age_s=int(n["age_s"]),
+                peers={str(a): int(s)
+                       for a, s in dict(n.get("peers", {})).items()}))
+        except (KeyError, TypeError, ValueError) as err:
+            raise ValueError(f"malformed matrix node {n!r}: {err}") from None
+    try:
+        return HealthMatrix(
+            role=str(obj["role"]), port=int(obj["port"]),
+            gray_threshold=int(obj["gray_threshold"]), nodes=tuple(nodes))
+    except (KeyError, TypeError, ValueError) as err:
+        raise ValueError(f"malformed health matrix: {err}") from None
+
+
+# ---------------------------------------------------------------------------
 # SLO rule table (mirror of native/common/sloeval.cc; the fdfs_codec
 # slo-conf golden pins the two parsers against each other)
 # ---------------------------------------------------------------------------
@@ -330,6 +457,8 @@ DEFAULT_SLO_RULES = (
     ("sync_lag_s", 300.0, 150.0),
     ("scrub_unrepairable", 0.5, 0.25),
     ("disk_fill_pct", 90.0, 85.0),
+    ("peer_rpc_p99_ms", 1000.0, 500.0),
+    ("probe_write_ms", 1000.0, 500.0),
 )
 
 _SLO_TRUE = {"1", "yes", "true", "on"}
@@ -602,8 +731,31 @@ def top_rates(prev: TopSample | None, cur: TopSample) -> dict[str, dict]:
             "dio_depth": reg["gauges"].get("dio.queue_depth"),
             "conns": reg["gauges"].get("nio.conns_active", 0),
             "slo_breaches": reg["gauges"].get("slo.breaches_active", 0),
+            # Gray-failure health gauges (healthmon.h PublishGauges).
+            # None = this daemon publishes no health (tracker, or a
+            # storage predating the health layer) — the HEALTH pane
+            # skips it rather than showing a fake 100.
+            "health_score": reg["gauges"].get("health.score"),
+            "stalled_threads": reg["gauges"].get(
+                "watchdog.stalled_threads", 0),
+            "worst_peer": _worst_peer_gauge(reg),
         }
     return out
+
+
+def _worst_peer_gauge(reg: dict) -> tuple[str, int] | None:
+    """(addr, score) of the lowest-scored peer in this registry's
+    ``peer.<addr>.score`` gauge family, or None when the family is
+    empty.  Addresses contain dots and colons, so parse by stripping
+    the known prefix and suffix — never by splitting."""
+    worst: tuple[str, int] | None = None
+    for name, v in reg["gauges"].items():
+        if not name.startswith("peer.") or not name.endswith(".score"):
+            continue
+        addr = name[len("peer."):-len(".score")]
+        if worst is None or v < worst[1]:
+            worst = (addr, v)
+    return worst
 
 
 def _fmt_us(v: float | None) -> str:
@@ -685,6 +837,25 @@ def render_top(cur: TopSample, rates: dict[str, dict],
     if parts:
         lines.append("")
         lines.append("ALERTS: " + "; ".join(parts))
+    # HEALTH line: the gray-failure glance — each health-publishing
+    # node's self score, stalled-thread count, and its worst-scored
+    # peer.  Sorted worst-first so the gray node leads the line.
+    health = []
+    for node, r in rates.items():
+        if r.get("health_score") is None:
+            continue
+        part = f"{node}: self={r['health_score']}"
+        if r.get("stalled_threads"):
+            part += f" stalled={r['stalled_threads']}"
+        if r.get("worst_peer") is not None:
+            paddr, pscore = r["worst_peer"]
+            part += f" worst-peer={paddr}={pscore}"
+        health.append((r["health_score"], part))
+    if health:
+        lines.append("")
+        lines.append("HEALTH: " +
+                     "; ".join(p for _, p in sorted(
+                         health, key=lambda h: (h[0], h[1]))))
     lines.append("")
     lines.append(f"recent events (last {max_events}):")
     for e in recent_events[-max_events:]:
@@ -878,12 +1049,27 @@ def to_prometheus(snap: ClusterSnapshot, prefix: str = "fdfs") -> str:
     counters: dict[str, list] = {}
     gauges: dict[str, list] = {}
     hists: dict[str, list] = {}
+    # peer.<addr>.<metric> health gauges become ONE labeled family per
+    # metric ({storage, peer}) instead of one mangled metric name per
+    # peer address — the generic sanitizer would mint unbounded metric
+    # names as peers churn, which scrapers treat as new series forever.
+    peer_rows: dict[str, list] = {}
+    _PEER_METRICS = ("score", "rpc_ewma_us", "error_pct", "timeout_pct")
     for addr in sorted(snap.storage_stats):
         reg = snap.storage_stats[addr]
         for name, v in reg["counters"].items():
             counters.setdefault(name, []).append((addr, v))
         for name, v in reg["gauges"].items():
-            gauges.setdefault(name, []).append((addr, v))
+            peered = False
+            if name.startswith("peer."):
+                for m in _PEER_METRICS:
+                    if name.endswith("." + m):
+                        peer = name[len("peer."):-len(m) - 1]
+                        peer_rows.setdefault(m, []).append((addr, peer, v))
+                        peered = True
+                        break
+            if not peered:
+                gauges.setdefault(name, []).append((addr, v))
         for name, h in reg["histograms"].items():
             hists.setdefault(name, []).append((addr, h))
     for name in sorted(counters):
@@ -892,6 +1078,10 @@ def to_prometheus(snap: ClusterSnapshot, prefix: str = "fdfs") -> str:
     for name in sorted(gauges):
         emit(_metric_name(name, prefix), "gauge",
              [(_labels(storage=addr), v) for addr, v in gauges[name]])
+    for m in sorted(peer_rows):
+        emit(f"{prefix}_peer_{m}", "gauge",
+             [(_labels(storage=addr, peer=peer), v)
+              for addr, peer, v in peer_rows[m]])
     for name in sorted(hists):
         base = _metric_name(name, prefix)
         out.append(f"# TYPE {base} histogram")

@@ -1,0 +1,357 @@
+#include "common/healthmon.h"
+
+#include <algorithm>
+
+#include "common/bytes.h"
+#include "common/net.h"
+#include "common/protocol_gen.h"
+#include "common/stats.h"
+
+namespace fdfs {
+
+namespace {
+
+// EWMA smoothing: ~5 samples to move most of the way to a new regime —
+// fast enough to flag a peer within two beat intervals, slow enough
+// that one dropped packet doesn't gray a healthy node.
+constexpr double kAlpha = 0.2;
+
+// Bounded table: a storage talks to its group (few peers) + trackers;
+// 64 entries is an order of magnitude of headroom, and eviction keeps a
+// long-lived daemon's memory and beat-trailer size flat even if
+// addresses churn (tests, DHCP'd lab clusters).
+constexpr size_t kMaxPeers = 64;
+
+constexpr uint8_t kTrailerVersion = 1;
+constexpr size_t kTrailerPeerLen = 16 + 8 + 8;  // ip + port + score
+
+void AppendInt64(std::string* out, int64_t v) {
+  uint8_t buf[8];
+  PutInt64BE(v, buf);
+  out->append(reinterpret_cast<const char*>(buf), sizeof(buf));
+}
+
+void RpcObserverFn(int fd, uint8_t cmd, bool ok, uint8_t /*status*/,
+                   int64_t elapsed_us, int timeout_ms) {
+  std::string ip = PeerIp(fd);
+  if (ip.empty()) return;  // fd already dead; connect-failure paths feed
+                           // explicitly with the intended address
+  int port = PeerPort(fd);
+  HealthMonitor::Global().Feed(ip + ":" + std::to_string(port),
+                               HealthMonitor::OpClassFor(cmd), ok,
+                               elapsed_us, timeout_ms);
+}
+
+}  // namespace
+
+HealthMonitor& HealthMonitor::Global() {
+  static HealthMonitor* g = new HealthMonitor();  // never destroyed (the
+  // NetRpc observer may fire from daemon threads past static teardown)
+  return *g;
+}
+
+void HealthMonitor::InstallRpcObserver() { SetRpcObserver(&RpcObserverFn); }
+
+const char* HealthMonitor::OpClassFor(uint8_t cmd) {
+  // The cmd byte alone is enough: tracker- and storage-port opcodes
+  // overlap only where the meaning matches (ACTIVE_TEST, TRACE_CTX).
+  switch (cmd) {
+    case static_cast<uint8_t>(StorageCmd::kActiveTest):
+      return "probe";
+    case static_cast<uint8_t>(TrackerCmd::kStorageBeat):
+      return "beat";
+    case static_cast<uint8_t>(StorageCmd::kFetchOnePathBinlog):
+    case static_cast<uint8_t>(StorageCmd::kFetchRecipe):
+    case static_cast<uint8_t>(StorageCmd::kFetchChunk):
+      return "fetch";
+    case static_cast<uint8_t>(StorageCmd::kEcRelease):
+      return "ec";
+    case static_cast<uint8_t>(StorageCmd::kSyncCreateFile):
+    case static_cast<uint8_t>(StorageCmd::kSyncDeleteFile):
+    case static_cast<uint8_t>(StorageCmd::kSyncUpdateFile):
+    case static_cast<uint8_t>(StorageCmd::kSyncCreateLink):
+    case static_cast<uint8_t>(StorageCmd::kSyncAppendFile):
+    case static_cast<uint8_t>(StorageCmd::kSyncModifyFile):
+    case static_cast<uint8_t>(StorageCmd::kSyncTruncateFile):
+    case static_cast<uint8_t>(StorageCmd::kSyncQueryChunks):
+    case static_cast<uint8_t>(StorageCmd::kSyncCreateRecipe):
+      return "sync";
+    default:
+      return "rpc";
+  }
+}
+
+void HealthMonitor::Feed(const std::string& addr, const std::string& op,
+                         bool ok, int64_t elapsed_us, int timeout_ms) {
+  if (addr.empty()) return;
+  // Timeout heuristic: transport failures that burned >= 90% of the
+  // timeout budget are timeout-shaped (peer limping), the rest are hard
+  // failures (RST, EOF — peer down or restarting).
+  bool timed_out = !ok && timeout_ms > 0 &&
+                   elapsed_us >= static_cast<int64_t>(timeout_ms) * 900;
+  int64_t now = MonoUs();
+  std::lock_guard<RankedMutex> lk(mu_);
+  auto it = peers_.find(addr);
+  if (it == peers_.end()) {
+    if (peers_.size() >= kMaxPeers) {
+      auto oldest = peers_.begin();
+      for (auto pit = peers_.begin(); pit != peers_.end(); ++pit)
+        if (pit->second.last_us < oldest->second.last_us) oldest = pit;
+      peers_.erase(oldest);
+    }
+    it = peers_.emplace(addr, PeerEntry{}).first;
+  }
+  if (ok) {
+    StatHistogram* hist = rpc_hist_.load(std::memory_order_relaxed);
+    if (hist != nullptr) hist->Observe(elapsed_us);
+  }
+  PeerEntry& e = it->second;
+  e.last_us = now;
+  OpHealth& h = e.ops[op];
+  ++h.ops;
+  if (!ok) ++h.errors;
+  if (timed_out) ++h.timeouts;
+  if (ok) h.ewma_us = h.ops == 1 ? static_cast<double>(elapsed_us)
+                                 : (1 - kAlpha) * h.ewma_us +
+                                       kAlpha * static_cast<double>(elapsed_us);
+  h.err_ewma = (1 - kAlpha) * h.err_ewma + (ok ? 0.0 : kAlpha);
+  h.timeout_ewma = (1 - kAlpha) * h.timeout_ewma + (timed_out ? kAlpha : 0.0);
+  h.last_us = now;
+}
+
+void HealthMonitor::SetRpcHistogram(StatHistogram* h) {
+  rpc_hist_.store(h, std::memory_order_relaxed);
+}
+
+void HealthMonitor::SetStalledThreads(int n) {
+  stalled_threads_.store(n, std::memory_order_relaxed);
+  self_signal_seen_.store(true, std::memory_order_relaxed);
+}
+
+void HealthMonitor::SetProbe(int64_t read_us, int64_t write_us,
+                             int threshold_ms) {
+  probe_read_us_.store(read_us, std::memory_order_relaxed);
+  probe_write_us_.store(write_us, std::memory_order_relaxed);
+  probe_threshold_ms_.store(threshold_ms, std::memory_order_relaxed);
+  self_signal_seen_.store(true, std::memory_order_relaxed);
+}
+
+int64_t HealthMonitor::OpScore(const OpHealth& h) {
+  int64_t score = 100;
+  score -= static_cast<int64_t>(h.err_ewma * 60 + 0.5);
+  score -= static_cast<int64_t>(h.timeout_ewma * 40 + 0.5);
+  // 10 points per 100ms of EWMA latency, capped: slowness alone can
+  // take a peer to the gray edge but only errors/timeouts push it hard.
+  int64_t lat_pen = static_cast<int64_t>(h.ewma_us / 100000.0 * 10.0);
+  score -= std::min<int64_t>(30, lat_pen);
+  return std::max<int64_t>(0, std::min<int64_t>(100, score));
+}
+
+int64_t HealthMonitor::PeerScoreLocked(const PeerEntry& e) const {
+  int64_t worst = 100;
+  for (const auto& [op, h] : e.ops) worst = std::min(worst, OpScore(h));
+  return worst;
+}
+
+int64_t HealthMonitor::SelfScore() const {
+  int64_t score = 100;
+  score -= 50ll * stalled_threads_.load(std::memory_order_relaxed);
+  int thr_ms = probe_threshold_ms_.load(std::memory_order_relaxed);
+  if (thr_ms > 0) {
+    int64_t worst = std::max(probe_read_us_.load(std::memory_order_relaxed),
+                             probe_write_us_.load(std::memory_order_relaxed));
+    int64_t thr_us = static_cast<int64_t>(thr_ms) * 1000;
+    if (worst > 4 * thr_us)
+      score -= 75;
+    else if (worst > thr_us)
+      score -= 50;
+  }
+  return std::max<int64_t>(0, std::min<int64_t>(100, score));
+}
+
+int64_t HealthMonitor::PeerScore(const std::string& addr) const {
+  std::lock_guard<RankedMutex> lk(mu_);
+  auto it = peers_.find(addr);
+  if (it == peers_.end()) return -1;
+  return PeerScoreLocked(it->second);
+}
+
+std::vector<HealthMonitor::PeerRow> HealthMonitor::Snapshot() const {
+  std::vector<PeerRow> out;
+  int64_t now = MonoUs();
+  std::lock_guard<RankedMutex> lk(mu_);
+  for (const auto& [addr, e] : peers_) {
+    for (const auto& [op, h] : e.ops) {
+      PeerRow r;
+      r.addr = addr;
+      r.op = op;
+      r.score = OpScore(h);
+      r.rpc_ewma_us = static_cast<int64_t>(h.ewma_us);
+      r.error_pct = static_cast<int64_t>(h.err_ewma * 100 + 0.5);
+      r.timeout_pct = static_cast<int64_t>(h.timeout_ewma * 100 + 0.5);
+      r.ops = h.ops;
+      r.errors = h.errors;
+      r.timeouts = h.timeouts;
+      r.age_s = h.last_us > 0 ? (now - h.last_us) / 1000000 : -1;
+      out.push_back(std::move(r));
+    }
+  }
+  // std::map iteration is already (addr, op)-sorted — pinned here
+  // because the JSON/golden shape depends on it.
+  return out;
+}
+
+std::string HealthMonitor::Json(const std::string& role, int port) const {
+  std::vector<PeerRow> rows = Snapshot();
+  std::string out = "{\"role\":";
+  AppendJsonString(&out, role);
+  out += ",\"port\":" + std::to_string(port);
+  out += ",\"score\":" + std::to_string(SelfScore());
+  out += ",\"stalled_threads\":" +
+         std::to_string(stalled_threads_.load(std::memory_order_relaxed));
+  out += ",\"probe\":{\"read_us\":" +
+         std::to_string(probe_read_us_.load(std::memory_order_relaxed)) +
+         ",\"write_us\":" +
+         std::to_string(probe_write_us_.load(std::memory_order_relaxed)) +
+         ",\"threshold_ms\":" +
+         std::to_string(probe_threshold_ms_.load(std::memory_order_relaxed)) +
+         "}";
+  out += ",\"peers\":[";
+  bool first = true;
+  for (const PeerRow& r : rows) {
+    if (!first) out += ",";
+    first = false;
+    out += "{\"addr\":";
+    AppendJsonString(&out, r.addr);
+    out += ",\"op\":";
+    AppendJsonString(&out, r.op);
+    out += ",\"score\":" + std::to_string(r.score) +
+           ",\"rpc_ewma_us\":" + std::to_string(r.rpc_ewma_us) +
+           ",\"error_pct\":" + std::to_string(r.error_pct) +
+           ",\"timeout_pct\":" + std::to_string(r.timeout_pct) +
+           ",\"ops\":" + std::to_string(r.ops) +
+           ",\"errors\":" + std::to_string(r.errors) +
+           ",\"timeouts\":" + std::to_string(r.timeouts) +
+           ",\"age_s\":" + std::to_string(r.age_s) + "}";
+  }
+  out += "]}";
+  return out;
+}
+
+std::string HealthMonitor::PackBeatTrailer() const {
+  struct Scored {
+    std::string ip;
+    int64_t port;
+    int64_t score;
+  };
+  std::vector<Scored> scored;
+  {
+    std::lock_guard<RankedMutex> lk(mu_);
+    if (peers_.empty() &&
+        !self_signal_seen_.load(std::memory_order_relaxed))
+      return std::string();  // nothing to say: beat stays trailerless
+    scored.reserve(peers_.size());
+    for (const auto& [addr, e] : peers_) {
+      size_t colon = addr.rfind(':');
+      if (colon == std::string::npos || colon == 0) continue;
+      Scored s;
+      s.ip = addr.substr(0, colon);
+      s.port = atoll(addr.c_str() + colon + 1);
+      if (s.ip.size() >= 16 || s.port <= 0) continue;
+      s.score = PeerScoreLocked(e);
+      scored.push_back(std::move(s));
+    }
+  }
+  std::string out;
+  out.push_back(static_cast<char>(kTrailerVersion));
+  AppendInt64(&out, SelfScore());
+  AppendInt64(&out, static_cast<int64_t>(scored.size()));
+  for (const Scored& s : scored) {
+    PutFixedField(&out, s.ip, 16);
+    AppendInt64(&out, s.port);
+    AppendInt64(&out, s.score);
+  }
+  return out;
+}
+
+void HealthMonitor::PublishGauges(StatsRegistry* reg) const {
+  // Per-ADDR (not per op class) to bound gauge cardinality; the full
+  // per-op table stays available via HEALTH_STATUS.
+  struct AddrGauge {
+    std::string addr;
+    int64_t score;
+    int64_t worst_ewma_us = 0;
+    int64_t worst_error_pct = 0;
+    int64_t worst_timeout_pct = 0;
+  };
+  std::vector<AddrGauge> gauges;
+  {
+    std::lock_guard<RankedMutex> lk(mu_);
+    gauges.reserve(peers_.size());
+    for (const auto& [addr, e] : peers_) {
+      AddrGauge g;
+      g.addr = addr;
+      g.score = PeerScoreLocked(e);
+      for (const auto& [op, h] : e.ops) {
+        g.worst_ewma_us =
+            std::max(g.worst_ewma_us, static_cast<int64_t>(h.ewma_us));
+        g.worst_error_pct = std::max(
+            g.worst_error_pct, static_cast<int64_t>(h.err_ewma * 100 + 0.5));
+        g.worst_timeout_pct =
+            std::max(g.worst_timeout_pct,
+                     static_cast<int64_t>(h.timeout_ewma * 100 + 0.5));
+      }
+      gauges.push_back(std::move(g));
+    }
+  }
+  // Registry writes AFTER mu_ release: kHealthMon (195) must never hold
+  // across a kStatsRegistry (70) acquisition.
+  std::vector<std::string> keep;
+  keep.reserve(gauges.size());
+  for (const AddrGauge& g : gauges) {
+    std::string base = "peer." + g.addr + ".";
+    reg->SetGauge(base + "score", g.score);
+    reg->SetGauge(base + "rpc_ewma_us", g.worst_ewma_us);
+    reg->SetGauge(base + "error_pct", g.worst_error_pct);
+    reg->SetGauge(base + "timeout_pct", g.worst_timeout_pct);
+    keep.push_back(std::move(base));
+  }
+  reg->PruneGauges("peer.", keep);
+  reg->SetGauge("health.score", SelfScore());
+}
+
+void HealthMonitor::Reset() {
+  std::lock_guard<RankedMutex> lk(mu_);
+  peers_.clear();
+  rpc_hist_.store(nullptr, std::memory_order_relaxed);
+  stalled_threads_.store(0, std::memory_order_relaxed);
+  probe_read_us_.store(0, std::memory_order_relaxed);
+  probe_write_us_.store(0, std::memory_order_relaxed);
+  probe_threshold_ms_.store(0, std::memory_order_relaxed);
+  self_signal_seen_.store(false, std::memory_order_relaxed);
+}
+
+bool ParseBeatHealthTrailer(const char* p, size_t len,
+                            BeatHealthTrailer* out) {
+  if (len < 1 + 8 + 8) return false;
+  const uint8_t* u = reinterpret_cast<const uint8_t*>(p);
+  if (u[0] != kTrailerVersion) return false;
+  out->self_score = GetInt64BE(u + 1);
+  int64_t n = GetInt64BE(u + 9);
+  if (n < 0 || static_cast<size_t>(n) > kMaxPeers ||
+      len < 17 + static_cast<size_t>(n) * kTrailerPeerLen)
+    return false;
+  const uint8_t* q = u + 17;
+  out->peers.clear();
+  out->peers.reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i, q += kTrailerPeerLen) {
+    std::string ip = GetFixedField(q, 16);
+    int64_t port = GetInt64BE(q + 16);
+    int64_t score = GetInt64BE(q + 24);
+    if (ip.empty() || port <= 0 || port > 65535) continue;
+    out->peers.emplace_back(ip + ":" + std::to_string(port), score);
+  }
+  return true;
+}
+
+}  // namespace fdfs

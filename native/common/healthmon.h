@@ -1,0 +1,182 @@
+// HealthMonitor: the gray-failure detection core (OPERATIONS.md "Health,
+// probes & gray failure").
+//
+// A node that is *down* is easy — connects fail and the tracker ages it
+// out.  A node that is *gray* (disk taking seconds per fsync, NIC
+// dropping half its packets, one wedged thread) keeps beating and keeps
+// accepting work it then serves slowly; nothing upstream of this layer
+// can see it.  The reference codebase has no equivalent: upstream
+// FastDFS trusts the heartbeat bit alone.
+//
+// Three signal sources feed one table:
+//
+//   passive RPC health   Every native outbound RPC already funnels
+//                        through NetRpc (common/net.h) — sync ship,
+//                        tracker beats, recovery/rebalance/scrub
+//                        FETCH_*, EC_RELEASE fan-out — so a single
+//                        process-global observer (InstallRpcObserver)
+//                        sees per-(peer, op-class) latency and
+//                        transport failures for free.  Only TRANSPORT
+//                        failure counts as an error: a nonzero header
+//                        status byte is an application answer from a
+//                        live peer, not peer sickness.
+//   active probes        The owning daemon's probe loop feeds
+//                        ACTIVE_TEST round-trips (op class "probe") and
+//                        connect failures through Feed(), so an idle
+//                        cluster still converges on peer health.
+//   self signals         The server pushes its own watchdog stall count
+//                        and worst disk-probe latencies into setter
+//                        atomics; SelfScore() folds them into the gray
+//                        score the beat trailer carries.
+//
+// Scores are 0..100, 100 = healthy.  Per-op peer score:
+//
+//   100 - 60*error_ewma - 40*timeout_ewma - min(30, 10 per 100ms EWMA
+//   latency), clamped to [0, 100]
+//
+// and a peer's composite score is the MINIMUM across its op classes
+// (one sick op class — say EC fan-out timing out while probes still
+// answer — is exactly the gray-failure shape).  SelfScore() starts at
+// 100 and loses 50 per stalled thread and 50 (75 past 4x) when the
+// worst disk probe exceeds the configured threshold, so any single
+// injected fault drops a node below the default gray threshold of 60.
+//
+// The beat trailer (PackBeatTrailer / ParseBeatHealthTrailer) rides the
+// APPEND-ONLY region of the storage beat body past the pinned stat
+// slots: 1B version + 8B self score + 8B N + N x (16B peer ip + 8B port
+// + 8B score), all BE.  The tracker folds every reporter's trailer into
+// the N x N differential matrix (HEALTH_MATRIX): a node most *peers*
+// score low is gray even while its own trailer says healthy.
+//
+// Concurrency: one RankedMutex at LockRank::kHealthMon (195) — the
+// observer fires while RPC callers hold sync/scrub/rebalance/reporter
+// locks, so the table ranks after ALL of those; snapshots are copied
+// out and published to the stats registry (rank 70) only after release.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "common/lockrank.h"
+
+namespace fdfs {
+
+class StatsRegistry;
+class StatHistogram;
+
+class HealthMonitor {
+ public:
+  // The process-wide instance the NetRpc observer feeds.  Same
+  // never-destroyed discipline as ThreadRegistry::Global().
+  static HealthMonitor& Global();
+
+  // Install the passive NetRpc observer targeting Global().  Daemons
+  // call this once at Init; CLI tools never do, so their RPCs pay one
+  // relaxed atomic load and nothing else.
+  static void InstallRpcObserver();
+
+  // Record one RPC outcome against `addr` ("ip:port") under op class
+  // `op`.  ok = transport success (framed response received); a timeout
+  // is inferred from !ok with elapsed_us >= 90% of the timeout budget.
+  // Also the entry point for the active prober's connect failures and
+  // sync.cc's manually-framed shipments (which bypass NetRpc).
+  void Feed(const std::string& addr, const std::string& op, bool ok,
+            int64_t elapsed_us, int timeout_ms);
+
+  // Optional latency histogram: successful Feed() samples are Observed
+  // into it (pre-registered StatsRegistry histogram, e.g. peer.rpc_us)
+  // so the SLO engine can evaluate a peer-RPC p99 without re-walking
+  // the EWMA table.  Histograms are internally locked; the pointer
+  // itself is a relaxed atomic so Feed never takes a second mutex.
+  void SetRpcHistogram(StatHistogram* h);
+
+  // Self-signal setters (storage server: watchdog scan + disk probes).
+  void SetStalledThreads(int n);
+  void SetProbe(int64_t read_us, int64_t write_us, int threshold_ms);
+
+  int64_t SelfScore() const;
+  // Composite (min across op classes) score for a peer; -1 = never fed.
+  int64_t PeerScore(const std::string& addr) const;
+
+  struct PeerRow {
+    std::string addr;
+    std::string op;
+    int64_t score = 100;
+    int64_t rpc_ewma_us = 0;
+    int64_t error_pct = 0;
+    int64_t timeout_pct = 0;
+    int64_t ops = 0;
+    int64_t errors = 0;
+    int64_t timeouts = 0;
+    int64_t age_s = 0;  // since last sample
+  };
+  // One row per (addr, op class), sorted by (addr, op) for determinism.
+  std::vector<PeerRow> Snapshot() const;
+
+  // HEALTH_STATUS wire body (shape pinned by the fdfs_codec
+  // health-status golden; decoded by monitor.decode_health_status).
+  std::string Json(const std::string& role, int port) const;
+
+  // The beat-trailer bytes (format in the header comment; empty when
+  // the table is empty AND no self signal has ever been set — old-style
+  // beats stay byte-identical until health has something to say).
+  std::string PackBeatTrailer() const;
+
+  // health.score + per-addr peer.* gauge families; snapshot is taken
+  // under mu_ and gauges written after release (rank 195 -> 70 would
+  // otherwise invert).  Departed peers' gauges are pruned.
+  void PublishGauges(StatsRegistry* reg) const;
+
+  // Drop all state (tests; also used between harness daemon restarts
+  // sharing a process in unit tests).
+  void Reset();
+
+  // Opcode -> op-class bucketing for the passive observer ("probe",
+  // "beat", "fetch", "ec", "sync", default "rpc").  Exposed for tests.
+  static const char* OpClassFor(uint8_t cmd);
+
+ private:
+  struct OpHealth {
+    double ewma_us = 0;       // latency EWMA over SUCCESSFUL RPCs
+    double err_ewma = 0;      // transport-failure rate EWMA
+    double timeout_ewma = 0;  // timeout-shaped-failure rate EWMA
+    int64_t ops = 0;
+    int64_t errors = 0;
+    int64_t timeouts = 0;
+    int64_t last_us = 0;
+  };
+  struct PeerEntry {
+    std::map<std::string, OpHealth> ops;
+    int64_t last_us = 0;
+  };
+
+  static int64_t OpScore(const OpHealth& h);
+  int64_t PeerScoreLocked(const PeerEntry& e) const;
+
+  mutable RankedMutex mu_{LockRank::kHealthMon};
+  std::map<std::string, PeerEntry> peers_;
+
+  std::atomic<StatHistogram*> rpc_hist_{nullptr};
+  std::atomic<int> stalled_threads_{0};
+  std::atomic<int64_t> probe_read_us_{0};
+  std::atomic<int64_t> probe_write_us_{0};
+  std::atomic<int> probe_threshold_ms_{0};
+  std::atomic<bool> self_signal_seen_{false};
+};
+
+// Tracker-side decode of the beat trailer.  `p/len` is the beat body
+// region PAST the pinned stat slots; false on a version or framing
+// mismatch (the tracker then ignores the trailer — an older storage's
+// trailerless beat parses as len == 0 and is simply "no health data").
+struct BeatHealthTrailer {
+  int64_t self_score = -1;
+  std::vector<std::pair<std::string, int64_t>> peers;  // "ip:port" -> score
+};
+bool ParseBeatHealthTrailer(const char* p, size_t len,
+                            BeatHealthTrailer* out);
+
+}  // namespace fdfs

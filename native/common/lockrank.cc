@@ -34,6 +34,7 @@ const char* LockRankName(LockRank r) {
     case LockRank::kTraceCorrelator: return "trace.correlator";
     case LockRank::kAccessLog: return "server.access_log";
     case LockRank::kTraceSlot: return "trace.ring_slot";
+    case LockRank::kHealthMon: return "health.monitor";
     case LockRank::kEventSlot: return "eventlog.ring_slot";
     case LockRank::kLog: return "log.global";
     case LockRank::kToolOutput: return "tool.output";

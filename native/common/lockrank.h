@@ -122,6 +122,15 @@
 //   180   kAccessLog        StorageServer::log_mu_ (access.log writes).
 //   190   kTraceSlot        TraceRing per-slot spinlock (bounded-copy
 //                           critical sections only).
+//   195   kHealthMon        HealthMonitor::mu_ (per-peer EWMA health
+//                           table; fed from the NetRpc observer — which
+//                           can fire while RPC callers hold sync /
+//                           scrub / rebalance locks — so AFTER all of
+//                           those; snapshots are copied out and
+//                           published to the stats registry only after
+//                           release, so nothing below is acquired
+//                           under it except the flight-recorder slot
+//                           and the logger).
 //   200   kEventSlot        EventLog per-slot spinlock (recorded under
 //                           chunk-store stripe locks: heal-on-upload).
 //   210   kLog              logger global mutex — the ultimate leaf;
@@ -170,6 +179,7 @@ enum class LockRank : uint16_t {
   kTraceCorrelator = 170,
   kAccessLog = 180,
   kTraceSlot = 190,
+  kHealthMon = 195,
   kEventSlot = 200,
   kLog = 210,
   kToolOutput = 220,

@@ -16,6 +16,7 @@
 #include <vector>
 
 #include "common/bytes.h"
+#include "common/threadreg.h"
 
 namespace fdfs {
 
@@ -113,6 +114,11 @@ int TcpConnect(const std::string& host, int port, int timeout_ms,
 bool SendAll(int fd, const void* data, size_t len, int timeout_ms) {
   const uint8_t* p = static_cast<const uint8_t*>(data);
   while (len > 0) {
+    // A beat per poll round: socket IO that makes PROGRESS is a live
+    // thread (large sync shipments legitimately sit here for longer
+    // than any watchdog threshold); a wedged fd times out the poll and
+    // returns, so a genuinely stuck caller stops beating.
+    BeatThreadHeartbeat();
     struct pollfd pfd = {fd, POLLOUT, 0};
     int rc = poll(&pfd, 1, timeout_ms);
     if (rc <= 0) return false;
@@ -130,6 +136,7 @@ bool SendAll(int fd, const void* data, size_t len, int timeout_ms) {
 bool RecvAll(int fd, void* data, size_t len, int timeout_ms) {
   uint8_t* p = static_cast<uint8_t*>(data);
   while (len > 0) {
+    BeatThreadHeartbeat();  // see SendAll
     struct pollfd pfd = {fd, POLLIN, 0};
     int rc = poll(&pfd, 1, timeout_ms);
     if (rc <= 0) return false;
@@ -144,8 +151,13 @@ bool RecvAll(int fd, void* data, size_t len, int timeout_ms) {
   return true;
 }
 
-bool NetRpc(int fd, uint8_t cmd, const std::string& body, std::string* resp,
-            uint8_t* status, int64_t max_resp, int timeout_ms) {
+namespace {
+
+std::atomic<RpcObserver> g_rpc_observer{nullptr};
+
+bool NetRpcInner(int fd, uint8_t cmd, const std::string& body,
+                 std::string* resp, uint8_t* status, int64_t max_resp,
+                 int timeout_ms) {
   // 10-byte header framing shared with protocol_gen.h kHeaderSize; kept
   // as a literal here so net.{h,cc} stays below the generated header in
   // the include graph.
@@ -164,6 +176,27 @@ bool NetRpc(int fd, uint8_t cmd, const std::string& body, std::string* resp,
   if (len > 0 && !RecvAll(fd, resp->data(), resp->size(), timeout_ms))
     return false;
   return true;
+}
+
+}  // namespace
+
+void SetRpcObserver(RpcObserver obs) {
+  g_rpc_observer.store(obs, std::memory_order_release);
+}
+
+bool NetRpc(int fd, uint8_t cmd, const std::string& body, std::string* resp,
+            uint8_t* status, int64_t max_resp, int timeout_ms) {
+  RpcObserver obs = g_rpc_observer.load(std::memory_order_acquire);
+  if (obs == nullptr)
+    return NetRpcInner(fd, cmd, body, resp, status, max_resp, timeout_ms);
+  *status = 0;
+  int64_t t0 = MonoUs();
+  bool ok = NetRpcInner(fd, cmd, body, resp, status, max_resp, timeout_ms);
+  // On transport failure the status byte is whatever was (or wasn't)
+  // parsed — report 0 so the observer never mistakes garbage for an
+  // application answer.
+  obs(fd, cmd, ok, ok ? *status : 0, MonoUs() - t0, timeout_ms);
+  return ok;
 }
 
 static std::string AddrIp(const struct sockaddr_in& a) {
@@ -186,6 +219,14 @@ std::string SockIp(int fd) {
   if (getsockname(fd, reinterpret_cast<struct sockaddr*>(&a), &len) != 0)
     return "";
   return AddrIp(a);
+}
+
+int PeerPort(int fd) {
+  struct sockaddr_in a;
+  socklen_t len = sizeof(a);
+  if (getpeername(fd, reinterpret_cast<struct sockaddr*>(&a), &len) != 0)
+    return 0;
+  return static_cast<int>(ntohs(a.sin_port));
 }
 
 // -- EventLoop ------------------------------------------------------------
@@ -314,6 +355,9 @@ void EventLoop::Run() {
   running_ = true;
   std::vector<struct epoll_event> events(256);
   while (!stop_.load(std::memory_order_acquire)) {
+    // NextTimeoutMs caps at 1000ms, so an idle loop still beats its
+    // watchdog heartbeat at least once a second.
+    BeatThreadHeartbeat();
     int n = epoll_wait(epfd_, events.data(), static_cast<int>(events.size()),
                        NextTimeoutMs());
     if (n < 0) {

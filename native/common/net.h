@@ -33,6 +33,7 @@ bool SendAll(int fd, const void* data, size_t len, int timeout_ms);
 bool RecvAll(int fd, void* data, size_t len, int timeout_ms);
 std::string PeerIp(int fd);
 std::string SockIp(int fd);
+int PeerPort(int fd);
 
 // One header-framed request/response on a blocking fd — the client side
 // of the shared 10-byte wire protocol (8B BE body length + cmd +
@@ -42,6 +43,21 @@ std::string SockIp(int fd);
 // max_resp; *status carries the server's header status byte.
 bool NetRpc(int fd, uint8_t cmd, const std::string& body, std::string* resp,
             uint8_t* status, int64_t max_resp, int timeout_ms);
+
+// Passive health instrumentation: because NetRpc is the choke point for
+// every native outbound RPC (sync ship, tracker beats, recovery /
+// rebalance / scrub FETCH_*, EC_RELEASE fan-out), one process-global
+// observer sees them all.  Called after each NetRpc completes with the
+// peer fd, opcode, transport outcome (ok = framed response received;
+// the status byte is an APPLICATION answer, not peer sickness), elapsed
+// monotonic microseconds, and the caller's timeout.  Null by default —
+// CLI tools and tests that never install one pay a relaxed atomic load.
+// The observer must be cheap and lock-rank-clean for any caller context
+// (it can fire under sync/scrub/rebalance locks); healthmon.h installs
+// the only production observer.
+using RpcObserver = void (*)(int fd, uint8_t cmd, bool ok, uint8_t status,
+                             int64_t elapsed_us, int timeout_ms);
+void SetRpcObserver(RpcObserver obs);
 
 // -- epoll loop (ioevent_loop.c analogue) ---------------------------------
 class EventLoop {

@@ -109,6 +109,8 @@ std::vector<SloRule> SloEvaluator::DefaultRules() {
       // a few ticks after the count returns to 0.
       {"scrub_unrepairable", 0.5, 0.25, true},
       {"disk_fill_pct", 90.0, 85.0, true},     // fullest store path
+      {"peer_rpc_p99_ms", 1000.0, 500.0, true}, // outbound peer RPC p99
+      {"probe_write_ms", 1000.0, 500.0, true},  // worst store-path probe
   };
 }
 
@@ -197,6 +199,26 @@ bool SloEvaluator::ComputeReading(const std::string& name,
     auto it = cur.gauges.find("store.disk_used_pct");
     if (it == cur.gauges.end()) return false;
     *out = static_cast<double>(it->second);
+    return true;
+  }
+  if (name == "peer_rpc_p99_ms") {
+    // Gray-failure health (ISSUE 17): p99 across every outbound peer
+    // RPC this window (the health monitor observes each successful
+    // NetRpc into peer.rpc_us).  Absent on the tracker — never fires.
+    auto d = DeltaHists(prev, cur, [](const std::string& n) {
+      return n == "peer.rpc_us";
+    });
+    double us;
+    if (!DeltaQuantileUs(d, 0.99, &us)) return false;
+    *out = us / 1000.0;
+    return true;
+  }
+  if (name == "probe_write_ms") {
+    // Worst store-path write+fsync probe this tick: the earliest signal
+    // that a disk has gone gray (slow-but-not-dead) off the hot path.
+    auto it = cur.gauges.find("store.probe_write_us");
+    if (it == cur.gauges.end()) return false;
+    *out = static_cast<double>(it->second) / 1000.0;
     return true;
   }
   return false;  // unknown rule name: never fires

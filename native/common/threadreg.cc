@@ -21,6 +21,11 @@ namespace {
 constexpr size_t kNameBufLen = 48;
 thread_local char t_name[kNameBufLen] = {0};
 
+// The calling thread's heartbeat cell.  A shared_ptr copy of the slot's
+// cell, so the beat path stays valid even if Leave erases the slot on
+// another code path while this thread is still unwinding.
+thread_local std::shared_ptr<std::atomic<int64_t>> t_heartbeat;
+
 int64_t TicksPerSecond() {
   static const int64_t hz = [] {
     long v = sysconf(_SC_CLK_TCK);
@@ -37,6 +42,11 @@ int CurrentTid() {
 }
 
 const char* CurrentThreadName() { return t_name; }
+
+void BeatThreadHeartbeat() {
+  std::atomic<int64_t>* hb = t_heartbeat.get();
+  if (hb != nullptr) hb->store(MonoUs(), std::memory_order_relaxed);
+}
 
 bool ReadThreadCpuTicks(int tid, int64_t* utime_ticks, int64_t* stime_ticks) {
   char path[64];
@@ -92,16 +102,20 @@ int64_t ThreadRegistry::Join(const std::string& name) {
   int tid = CurrentTid();
   strncpy(t_name, name.c_str(), kNameBufLen - 1);
   t_name[kNameBufLen - 1] = '\0';
+  auto hb = std::make_shared<std::atomic<int64_t>>(0);
+  t_heartbeat = hb;
   std::lock_guard<RankedMutex> lk(mu_);
   int64_t id = next_id_++;
   Slot& s = slots_[id];
   s.name = name;
   s.tid = tid;
+  s.heartbeat = std::move(hb);
   return id;
 }
 
 void ThreadRegistry::Leave(int64_t id) {
   t_name[0] = '\0';
+  t_heartbeat.reset();
   std::lock_guard<RankedMutex> lk(mu_);
   slots_.erase(id);
 }
@@ -167,6 +181,45 @@ void ThreadRegistry::SampleInto(StatsRegistry* reg) {
   // Dead threads' gauges die with them (the sync.peer.* discipline:
   // bounded metric cardinality on a long-lived daemon).
   reg->PruneGauges("thread.", keep);
+}
+
+ThreadRegistry::WatchdogResult ThreadRegistry::WatchdogScan(
+    int64_t threshold_us) {
+  WatchdogResult out;
+  int64_t now = MonoUs();
+  std::lock_guard<RankedMutex> lk(mu_);
+  for (auto& [id, s] : slots_) {
+    if (!s.heartbeat) continue;
+    int64_t beat = s.heartbeat->load(std::memory_order_relaxed);
+    if (beat == 0) continue;  // never beaten: no heartbeat contract
+    int64_t age = now - beat;
+    if (age > threshold_us) {
+      out.stalled.push_back(Stall{s.name, s.tid, age, !s.stalled_noted});
+      s.stalled_noted = true;
+    } else if (s.stalled_noted) {
+      out.recovered.push_back(s.name);
+      s.stalled_noted = false;
+    }
+  }
+  return out;
+}
+
+std::vector<ThreadRegistry::HeartbeatEntry> ThreadRegistry::Heartbeats()
+    const {
+  std::vector<HeartbeatEntry> out;
+  int64_t now = MonoUs();
+  std::lock_guard<RankedMutex> lk(mu_);
+  out.reserve(slots_.size());
+  for (const auto& [id, s] : slots_) {
+    HeartbeatEntry e;
+    e.name = s.name;
+    e.tid = s.tid;
+    int64_t beat =
+        s.heartbeat ? s.heartbeat->load(std::memory_order_relaxed) : 0;
+    e.age_us = beat == 0 ? -1 : now - beat;
+    out.push_back(std::move(e));
+  }
+  return out;
 }
 
 ScopedThreadName::ScopedThreadName(const std::string& name)

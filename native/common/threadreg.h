@@ -27,8 +27,10 @@
 // thread's name with no lock at all.
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <vector>
@@ -68,6 +70,38 @@ class ThreadRegistry {
   // thread; per-slot delta state lives here).
   void SampleInto(StatsRegistry* reg);
 
+  // -- thread watchdog (OPERATIONS.md "Health, probes & gray failure") ----
+  //
+  // Every daemon loop body calls BeatThreadHeartbeat() (below) each
+  // iteration; WatchdogScan flags registered threads whose last beat is
+  // older than the threshold.  Threads that NEVER beat (tool/test
+  // threads, short-lived helpers) are not enrolled — a zero stamp means
+  // "no heartbeat contract", not "stalled" — so the watchdog has no
+  // false positives by construction.
+  struct Stall {
+    std::string name;
+    int tid = 0;
+    int64_t age_us = 0;
+    // True the first scan that sees this outage: the caller records ONE
+    // flight-recorder event per outage, not one per tick (the sync
+    // stall_noted discipline).
+    bool newly = false;
+  };
+  struct WatchdogResult {
+    std::vector<Stall> stalled;
+    std::vector<std::string> recovered;  // outages that ended since last scan
+  };
+  WatchdogResult WatchdogScan(int64_t threshold_us);
+
+  // Heartbeat ages for the SIGUSR1 DumpState ledger print.  age_us -1 =
+  // registered but never beaten (no heartbeat contract).
+  struct HeartbeatEntry {
+    std::string name;
+    int tid = 0;
+    int64_t age_us = -1;
+  };
+  std::vector<HeartbeatEntry> Heartbeats() const;
+
  private:
   struct Slot {
     std::string name;
@@ -77,6 +111,12 @@ class ThreadRegistry {
     // reports cpu_pct 0 rather than a since-birth average).
     int64_t last_cpu_ticks = 0;
     int64_t last_sample_us = 0;
+    // Watchdog heartbeat, MonoUs of the thread's last loop-body beat
+    // (0 = never).  shared_ptr so the owning thread's lock-free beat
+    // path keeps a stable target even if the slot is erased while the
+    // thread is mid-exit.
+    std::shared_ptr<std::atomic<int64_t>> heartbeat;
+    bool stalled_noted = false;  // one watchdog.stall event per outage
   };
 
   mutable RankedMutex mu_{LockRank::kThreadRegistry};
@@ -106,6 +146,12 @@ const char* CurrentThreadName();
 
 // This thread's kernel tid (cached gettid()).
 int CurrentTid();
+
+// Stamp the calling thread's watchdog heartbeat (MonoUs).  One relaxed
+// atomic store through a thread_local pointer: safe from ANY context —
+// inside poll loops, while holding any mutex — and a no-op on threads
+// that never joined the registry.  Call from every daemon loop body.
+void BeatThreadHeartbeat();
 
 // Read a thread's cumulative CPU from /proc/self/task/<tid>/stat
 // (fields 14/15, clock ticks).  Falls back to RUSAGE_THREAD when the

@@ -8,6 +8,7 @@
 // posted back to the owning connection's EventLoop.
 #pragma once
 
+#include <chrono>
 #include <condition_variable>
 #include <deque>
 #include <functional>
@@ -61,7 +62,7 @@ class WorkerPool {
       if (stopping_) return;
       queue_.push_back(Task{std::move(fn), MonoUs()});
     }
-    cv_.notify_one();
+    Wake();
   }
 
   // Drain-then-join: queued tasks still run (a queued chunk write must
@@ -72,7 +73,7 @@ class WorkerPool {
       if (stopping_) return;
       stopping_ = true;
     }
-    cv_.notify_all();
+    Wake();
     for (auto& t : threads_)
       if (t.joinable()) t.join();
     threads_.clear();
@@ -97,16 +98,49 @@ class WorkerPool {
       reg = std::make_unique<ScopedThreadName>(ledger_name);
     for (;;) {
       Task task;
-      StatHistogram* hw;
-      StatHistogram* hs;
+      StatHistogram* hw = nullptr;
+      StatHistogram* hs = nullptr;
+      bool have = false;
+      // Snapshot the wake generation BEFORE checking the queue: a
+      // Submit that lands after the snapshot bumps it, so the idle
+      // wait below returns immediately instead of missing the wakeup.
+      uint64_t gen;
       {
-        std::unique_lock<RankedMutex> lk(mu_);
-        cv_.wait(lk, [this] { return stopping_ || !queue_.empty(); });
-        if (queue_.empty()) return;  // stopping and drained
-        task = std::move(queue_.front());
-        queue_.pop_front();
-        hw = hist_wait_;
-        hs = hist_service_;
+        std::lock_guard<std::mutex> wl(wake_->mu);  // NOLINT(lock-raw-mutex)
+        gen = wake_->gen;
+      }
+      {
+        std::lock_guard<RankedMutex> lk(mu_);
+        if (!queue_.empty()) {
+          task = std::move(queue_.front());
+          queue_.pop_front();
+          hw = hist_wait_;
+          hs = hist_service_;
+          have = true;
+        } else if (stopping_) {
+          return;  // stopping and drained
+        }
+      }
+      // One beat per dequeue or idle round (~1/s): an idle worker keeps
+      // beating its watchdog heartbeat, while a worker wedged INSIDE
+      // task.fn() (stuck fsync) stops beating and gets flagged.
+      BeatThreadHeartbeat();
+      if (!have) {
+        // The idle wait lives on its own plain mutex, never nested
+        // with mu_: condition_variable_any's timed wait re-locks the
+        // outer (ranked) mutex while still holding its internal one —
+        // a real lock-order inversion TSan rightly flags.  The deadline
+        // is system_clock on purpose: a steady-clock wait_for lowers to
+        // pthread_cond_clockwait, which older libtsan does not
+        // intercept (phantom double-lock/race reports); the wall-clock
+        // worst case is one early or late heartbeat slice, nothing
+        // correctness-bearing.
+        std::unique_lock<std::mutex> wl(wake_->mu);  // NOLINT(lock-raw-mutex)
+        wake_->cv.wait_until(wl,
+                             std::chrono::system_clock::now() +
+                                 std::chrono::seconds(1),
+                             [this, gen] { return wake_->gen != gen; });
+        continue;
       }
       int64_t t0 = MonoUs();
       if (hw != nullptr) hw->Observe(t0 - task.enqueue_us);
@@ -115,13 +149,32 @@ class WorkerPool {
     }
   }
 
+  void Wake() {
+    {
+      std::lock_guard<std::mutex> wl(wake_->mu);  // NOLINT(lock-raw-mutex)
+      ++wake_->gen;
+    }
+    wake_->cv.notify_all();
+  }
+
   mutable RankedMutex mu_{LockRank::kWorkers};
-  std::condition_variable_any cv_;
   std::deque<Task> queue_;
   std::vector<std::thread> threads_;
   bool stopping_ = false;
   StatHistogram* hist_wait_ = nullptr;     // guarded by mu_ (read at dequeue)
   StatHistogram* hist_service_ = nullptr;
+  // Wakeup channel, deliberately OUTSIDE the ranked-lock world: taken
+  // alone by both sides (Submit/Stop after releasing mu_, workers
+  // before taking mu_), so no ordering with mu_ exists at all.
+  // Heap-allocated: a stack-resident sync object can inherit a dead
+  // prior frame's TSan metadata (atomics have no destroy hook), while
+  // freed heap ranges are always scrubbed.
+  struct WakeChannel {
+    std::mutex mu;               // NOLINT(lock-raw-mutex): rankless by design
+    std::condition_variable cv;  // NOLINT(lock-raw-mutex): pairs with mu
+    uint64_t gen = 0;            // guarded by mu
+  };
+  std::unique_ptr<WakeChannel> wake_ = std::make_unique<WakeChannel>();
 };
 
 }  // namespace fdfs

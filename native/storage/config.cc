@@ -202,6 +202,24 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
     note("profile_max_hz clamped to 1000");
     profile_max_hz = 1000;
   }
+  health_probe_interval_s = static_cast<int>(
+      ini.GetSeconds("health_probe_interval_s", health_probe_interval_s));
+  if (health_probe_interval_s < 0) health_probe_interval_s = 0;
+  probe_slow_threshold_ms = static_cast<int>(
+      ini.GetInt("probe_slow_threshold_ms", probe_slow_threshold_ms));
+  if (probe_slow_threshold_ms < 0) probe_slow_threshold_ms = 0;
+  watchdog_stall_threshold_ms = static_cast<int>(
+      ini.GetInt("watchdog_stall_threshold_ms", watchdog_stall_threshold_ms));
+  if (watchdog_stall_threshold_ms < 0) watchdog_stall_threshold_ms = 0;
+  // Sub-second thresholds false-positive on the 1s-bounded idle waits
+  // every loop uses between beats.
+  if (watchdog_stall_threshold_ms > 0 && watchdog_stall_threshold_ms < 2000) {
+    note("watchdog_stall_threshold_ms raised to 2000");
+    watchdog_stall_threshold_ms = 2000;
+  }
+  watchdog_inject_stall_ms = static_cast<int>(
+      ini.GetInt("watchdog_inject_stall_ms", watchdog_inject_stall_ms));
+  if (watchdog_inject_stall_ms < 0) watchdog_inject_stall_ms = 0;
   heat_top_k = static_cast<int>(ini.GetInt("heat_top_k", heat_top_k));
   if (heat_top_k < 0) heat_top_k = 0;
   // heat_top_k is the sketch's PER-STRIPE capacity, and a full stripe

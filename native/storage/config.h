@@ -135,6 +135,23 @@ struct StorageConfig {
   // rate this daemon will arm.  0 (the default) disables the profiler
   // entirely — no signal handler, no slab, PROFILE_CTL answers ENOTSUP.
   int profile_max_hz = 0;
+  // Gray-failure health layer (common/healthmon.h; OPERATIONS.md
+  // "Health, probes & gray failure").  health_probe_interval_s: cadence
+  // of the active probe loop — ACTIVE_TEST pings to the trackers + the
+  // group's ACTIVE peers plus a per-store-path disk probe (4 KB
+  // tmp-write+fsync, then read back); 0 disables active probing (the
+  // passive NetRpc table and watchdog still run).
+  // probe_slow_threshold_ms: a disk probe slower than this records a
+  // disk.gray flight-recorder event and halves the node's gray score.
+  // watchdog_stall_threshold_ms: a registered daemon thread whose
+  // heartbeat is older than this is reported stalled (watchdog.stall
+  // event + gauge + gray score); 0 disables the watchdog.
+  // watchdog_inject_stall_ms: DEBUG — spawn a thread that beats once
+  // then sleeps forever, guaranteeing one watchdog trip (tests only).
+  int health_probe_interval_s = 30;
+  int probe_slow_threshold_ms = 1000;
+  int watchdog_stall_threshold_ms = 5000;
+  int watchdog_inject_stall_ms = 0;
   // Config values Load() silently clamped or corrected — surfaced as
   // "config.anomaly" flight-recorder events at startup so a daemon
   // running on not-what-the-operator-wrote config is diagnosable.

@@ -119,9 +119,16 @@ void RebalanceManager::ThreadMain() {
   ScopedThreadName ledger("rebalance");
   std::unique_lock<RankedMutex> lk(mu_);
   while (!stop_) {
-    cv_.wait_for(lk,
-                 std::chrono::seconds(std::max(1, opts_.poll_interval_s)),
-                 [this] { return stop_ || kicked_; });
+    BeatThreadHeartbeat();
+    // Sliced to <= 1s waits so the thread heartbeat stays fresh for the
+    // watchdog (threadreg.h) while parked between polls.
+    for (int waited = 0, total = std::max(1, opts_.poll_interval_s);
+         waited < total; ++waited) {
+      if (cv_.wait_for(lk, std::chrono::seconds(1),
+                       [this] { return stop_ || kicked_; }))
+        break;
+      BeatThreadHeartbeat();
+    }
     if (stop_) return;
     kicked_ = false;
     // Drop mu_ (rank 34) before touching the reporter: group_state()
@@ -230,6 +237,7 @@ void RebalanceManager::Pace(int64_t bytes_done, int64_t pass_start_us) {
   int64_t ahead_us = budget_us - (MonoUs() - pass_start_us);
   while (ahead_us > 0) {
     if (Stopped()) return;
+    BeatThreadHeartbeat();  // pacing sleep, not a stall
     usleep(static_cast<useconds_t>(std::min<int64_t>(ahead_us, 50000)));
     ahead_us = budget_us - (MonoUs() - pass_start_us);
   }

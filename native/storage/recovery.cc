@@ -147,7 +147,10 @@ bool RecoveryManager::NotifyAllTrackers(const std::string& self) {
                     "tracker(s); their holds clear on our next JOIN");
       return true;
     }
-    for (int i = 0; i < backoff_ms / 100 && !stop_; ++i) usleep(100 * 1000);
+    for (int i = 0; i < backoff_ms / 100 && !stop_; ++i) {
+      BeatThreadHeartbeat();  // backed off, not stalled
+      usleep(100 * 1000);
+    }
     backoff_ms = std::min(backoff_ms * 2, 10000);
   }
   return false;
@@ -184,6 +187,7 @@ void RecoveryManager::ThreadMain() {
   for (int i = 0; i < 300 && !stop_; ++i) {
     peers = reporter_->peers();
     if (!peers.empty()) break;
+    BeatThreadHeartbeat();  // waiting on the reporter, not stalled
     usleep(100 * 1000);
   }
 
@@ -226,6 +230,7 @@ void RecoveryManager::ThreadMain() {
     bool have_source = false;
     bool settled = false;
     while (!stop_) {
+      BeatThreadHeartbeat();
       auto replies = TrackerRpcAll(
           static_cast<uint8_t>(TrackerCmd::kStorageSyncDestQuery), self);
       int reached = 0, settled_count = 0;
@@ -260,7 +265,10 @@ void RecoveryManager::ThreadMain() {
     if (all_ok) break;
     FDFS_LOG_WARN("disk recovery round failed: retrying in %d ms",
                   backoff_ms);
-    for (int i = 0; i < backoff_ms / 100 && !stop_; ++i) usleep(100 * 1000);
+    for (int i = 0; i < backoff_ms / 100 && !stop_; ++i) {
+      BeatThreadHeartbeat();  // backed off, not stalled
+      usleep(100 * 1000);
+    }
     backoff_ms = std::min(backoff_ms * 2, 30000);
   }
 

@@ -199,12 +199,26 @@ void ScrubManager::ThreadMain() {
   ScopedThreadName ledger("scrub");
   std::unique_lock<RankedMutex> lk(mu_);
   while (!stop_) {
+    BeatThreadHeartbeat();
+    // Waits are sliced to <= 1s so the thread heartbeat stays fresh for
+    // the watchdog (threadreg.h): an idle scrubber parked on its cv for
+    // a day must not read as stalled.  due = the FULL interval elapsed
+    // without a kick (same semantics as the old single wait_for).
     bool due;
     if (opts_.interval_s > 0) {
-      due = !cv_.wait_for(lk, std::chrono::seconds(opts_.interval_s),
-                          [this] { return stop_ || kicked_; });
+      due = true;
+      for (int64_t waited_s = 0; waited_s < opts_.interval_s; ++waited_s) {
+        if (cv_.wait_for(lk, std::chrono::seconds(1),
+                         [this] { return stop_ || kicked_; })) {
+          due = false;
+          break;
+        }
+        BeatThreadHeartbeat();
+      }
     } else {
-      cv_.wait(lk, [this] { return stop_ || kicked_; });
+      while (!cv_.wait_for(lk, std::chrono::seconds(1),
+                           [this] { return stop_ || kicked_; }))
+        BeatThreadHeartbeat();
       due = false;
     }
     if (stop_) return;
@@ -232,6 +246,7 @@ void ScrubManager::Pace(int64_t bytes_read, int64_t pass_start_us) {
       std::lock_guard<RankedMutex> lk(mu_);
       if (stop_) return;
     }
+    BeatThreadHeartbeat();  // pacing sleep, not a stall
     usleep(static_cast<useconds_t>(std::min<int64_t>(ahead_us, 50000)));
     ahead_us = budget_us - (WallUs() - pass_start_us);
   }
@@ -247,6 +262,7 @@ void ScrubManager::PaceEc(int64_t bytes, int64_t pass_start_us) {
       std::lock_guard<RankedMutex> lk(mu_);
       if (stop_) return;
     }
+    BeatThreadHeartbeat();  // pacing sleep, not a stall
     usleep(static_cast<useconds_t>(std::min<int64_t>(ahead_us, 50000)));
     ahead_us = budget_us - (WallUs() - pass_start_us);
   }
@@ -294,6 +310,7 @@ void ScrubManager::RunPass() {
       auto live = cs->SnapshotLive(prefix);
       size_t i = 0;
       while (i < live.size()) {
+        BeatThreadHeartbeat();  // verifying at full speed, not stalled
         {
           std::lock_guard<RankedMutex> lk(mu_);
           if (stop_) {

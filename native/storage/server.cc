@@ -15,6 +15,7 @@
 #include <cstdio>
 
 #include "common/fileid.h"
+#include "common/healthmon.h"
 #include "common/log.h"
 #include "common/profiler.h"
 #include "common/protocol_gen.h"
@@ -193,6 +194,15 @@ bool StorageServer::Init(std::string* error) {
   // beat callback only touch pre-registered atomic pointers.
   InitStatsRegistry();
 
+  // Gray-failure health layer (common/healthmon.h): install the passive
+  // NetRpc observer before any subsystem that makes outbound RPCs
+  // starts — reporter beats, sync ships, scrub/recovery FETCH_*, EC
+  // fan-out all funnel through NetRpc, so from here on every one of
+  // them feeds the per-peer health table for free.  The peer.rpc_us
+  // histogram was registered by InitStatsRegistry just above.
+  HealthMonitor::InstallRpcObserver();
+  HealthMonitor::Global().SetRpcHistogram(hist_peer_rpc_);
+
   // Profiler ceiling (0 keeps the feature entirely off: no handler, no
   // slab); the singleton is process-global like SIGPROF itself.
   Profiler::Global().set_max_hz(cfg_.profile_max_hz);
@@ -298,6 +308,12 @@ bool StorageServer::Init(std::string* error) {
         [this](const std::vector<PeerInfo>& peers) {
           sync_->UpdatePeers(peers);
         });
+    // Health trailer: every beat carries this node's gray score + its
+    // view of its peers, in the append-only region past the pinned stat
+    // slots — the tracker folds all reporters' trailers into the N x N
+    // HEALTH_MATRIX.
+    reporter_->set_health_trailer_fn(
+        [] { return HealthMonitor::Global().PackBeatTrailer(); });
     // Disk recovery (storage_disk_recovery.c): a wiped store path on a
     // server with prior sync state rebuilds itself from a group peer in
     // the background.  Decided BEFORE the first JOIN so the recovering
@@ -534,6 +550,33 @@ bool StorageServer::Init(std::string* error) {
     alloc->ReclaimEmptyFiles(/*keep=*/1);
   });
 
+  // Active health probes: a dedicated thread so a stalled disk or
+  // unreachable peer can never block the request path or the timers.
+  probe_slow_noted_.assign(static_cast<size_t>(store_.store_path_count()),
+                           false);
+  if (cfg_.health_probe_interval_s > 0)
+    health_probe_thread_ = std::thread([this] { HealthProbeMain(); });
+  // DEBUG stall injection (watchdog_inject_stall_ms): a registered
+  // thread that beats once, then sleeps past the watchdog threshold
+  // without beating, then beats again — a deterministic stall+recovery
+  // cycle for the watchdog tests.  Never enable in production.
+  if (cfg_.watchdog_inject_stall_ms > 0) {
+    inject_stall_thread_ = std::thread([this] {
+      ScopedThreadName ledger("debug.stall");
+      int64_t inject_us = static_cast<int64_t>(cfg_.watchdog_inject_stall_ms) *
+                          1000;
+      while (!health_stop_.load(std::memory_order_relaxed)) {
+        BeatThreadHeartbeat();
+        // The "stall": sit without beating for inject_ms, in small
+        // sleeps so Stop() stays bounded.
+        for (int64_t slept = 0;
+             slept < inject_us && !health_stop_.load(std::memory_order_relaxed);
+             slept += 50000)
+          usleep(50000);
+      }
+    });
+  }
+
   FDFS_LOG_INFO("storage daemon up: group=%s port=%d store_paths=%d dedup=%s",
                 cfg_.group_name.c_str(), cfg_.port, store_.store_path_count(),
                 dedup_ != nullptr ? dedup_->Name() : "none");
@@ -566,6 +609,12 @@ void StorageServer::Stop() {
     access_log_ = nullptr;
   }
   binlog_.Flush();
+  // Health threads check their stop flag inside short sleep slices
+  // (and the prober between probes), so these joins are bounded even
+  // mid-probe against a slow disk.
+  health_stop_.store(true, std::memory_order_relaxed);
+  if (health_probe_thread_.joinable()) health_probe_thread_.join();
+  if (inject_stall_thread_.joinable()) inject_stall_thread_.join();
   // The scrubber may be mid-pass against the chunk stores; it checks
   // its stop flag between batches, so this join is bounded.
   if (scrub_ != nullptr) scrub_->Stop();
@@ -619,6 +668,20 @@ void StorageServer::DumpState() {
   if (events_ != nullptr)
     FDFS_LOG_INFO("event dump: %s",
                   events_->Json("storage", cfg_.port).c_str());
+  // Thread ledger with heartbeat ages: which registered thread last
+  // proved liveness and how long ago — "never" marks request-scoped
+  // threads that don't beat (tools, short-lived workers).  The SIGUSR1
+  // face of the watchdog (OPERATIONS.md "Health, probes & gray
+  // failure").
+  std::string ledger;
+  for (const ThreadRegistry::HeartbeatEntry& hb :
+       ThreadRegistry::Global().Heartbeats()) {
+    if (!ledger.empty()) ledger += " ";
+    ledger += hb.name + "(" + std::to_string(hb.tid) + ")=";
+    ledger += hb.age_us < 0 ? std::string("never")
+                            : std::to_string(hb.age_us / 1000) + "ms";
+  }
+  FDFS_LOG_INFO("thread ledger: %s", ledger.c_str());
 }
 
 // -- stats registry -------------------------------------------------------
@@ -676,6 +739,7 @@ constexpr ServedOp kServedOps[] = {
     {StorageCmd::kTrunkFreeSpace, "trunk_free_space"},
     {StorageCmd::kProfileCtl, "profile_ctl"},
     {StorageCmd::kProfileDump, "profile_dump"},
+    {StorageCmd::kHealthStatus, "health_status"},
 };
 
 }  // namespace
@@ -779,6 +843,20 @@ void StorageServer::InitStatsRegistry() {
   });
   registry_.GaugeFn("trace.slow_requests",
                     [this] { return slow_request_count_.load(); });
+  // Gray-failure health layer (ISSUE 17).  peer.rpc_us: outbound RPC
+  // latency across every op class, fed by the health monitor's NetRpc
+  // observer — the peer_rpc_p99_ms SLO rule's input.  The probe and
+  // watchdog gauge-fns only read atomics the "health.probe" thread and
+  // the metrics tick refresh (the store.disk_used_pct discipline: a
+  // gauge-fn must never touch a disk or a lock that can stall).
+  hist_peer_rpc_ = registry_.Histogram("peer.rpc_us",
+                                       StatsRegistry::LatencyBucketsUs());
+  registry_.GaugeFn("store.probe_read_us",
+                    [this] { return probe_read_us_.load(); });
+  registry_.GaugeFn("store.probe_write_us",
+                    [this] { return probe_write_us_.load(); });
+  registry_.GaugeFn("watchdog.stalled_threads",
+                    [this] { return stalled_threads_.load(); });
   hist_upload_bytes_ = registry_.Histogram(
       "upload.size_bytes", StatsRegistry::SizeBucketsBytes());
   hist_download_bytes_ = registry_.Histogram(
@@ -964,6 +1042,7 @@ void StorageServer::RefreshPeerGauges() {
 
 std::string StorageServer::BuildStatsJson() {
   RefreshPeerGauges();
+  HealthMonitor::Global().PublishGauges(&registry_);
   return registry_.Json();
 }
 
@@ -995,6 +1074,136 @@ void StorageServer::RefreshDiskUsedPct() {
   inodes_used_.store(inodes);
 }
 
+// -- gray-failure health layer (ISSUE 17) ---------------------------------
+
+void StorageServer::HealthProbeMain() {
+  ScopedThreadName ledger("health.probe");
+  // First round 2s after startup (daemon fully up, reporter joined),
+  // then per the conf cadence.  Sleeps are 250ms slices so Stop() stays
+  // bounded, and each slice beats the heartbeat — the prober must never
+  // look stalled to the watchdog it feeds.
+  int64_t next_due = MonoUs() + 2 * 1000000;
+  while (!health_stop_.load(std::memory_order_relaxed)) {
+    BeatThreadHeartbeat();
+    if (MonoUs() < next_due) {
+      usleep(250000);
+      continue;
+    }
+    RunHealthProbes();
+    next_due = MonoUs() +
+               static_cast<int64_t>(cfg_.health_probe_interval_s) * 1000000;
+  }
+}
+
+void StorageServer::RunHealthProbes() {
+  // Disk probes: one 4 KB tmp-write+fsync and one read-back per store
+  // path, timed wall-clock.  A probe CAN block for seconds on a gray
+  // mount — that's the measurement — which is why it runs on this
+  // dedicated thread and publishes through atomics (gauge-fns and the
+  // request path never touch the disk for health).
+  int64_t thr_us = static_cast<int64_t>(cfg_.probe_slow_threshold_ms) * 1000;
+  // A FAILED probe (open/write/fsync/read error) reads as slower than
+  // any threshold: the disk.gray event fires and the score drops, which
+  // is exactly what a dead mount deserves.
+  int64_t fail_us = thr_us > 0 ? 8 * thr_us : 10 * 1000000;
+  int64_t worst_read = 0, worst_write = 0;
+  for (int i = 0; i < store_.store_path_count(); ++i) {
+    std::string path = store_.store_path(i) + "/data/.health_probe.tmp";
+    char block[4096];
+    memset(block, 0x5a, sizeof(block));
+    int64_t t0 = MonoUs();
+    int64_t write_us = fail_us, read_us = fail_us;
+    int fd = open(path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd >= 0) {
+      if (write(fd, block, sizeof(block)) ==
+              static_cast<ssize_t>(sizeof(block)) &&
+          fsync(fd) == 0)
+        write_us = MonoUs() - t0;
+      close(fd);
+    }
+    BeatThreadHeartbeat();
+    t0 = MonoUs();
+    fd = open(path.c_str(), O_RDONLY);
+    if (fd >= 0) {
+      if (read(fd, block, sizeof(block)) ==
+          static_cast<ssize_t>(sizeof(block)))
+        read_us = MonoUs() - t0;
+      close(fd);
+    }
+    BeatThreadHeartbeat();
+    worst_write = std::max(worst_write, write_us);
+    worst_read = std::max(worst_read, read_us);
+    // One disk.gray event per outage per path (not per probe round):
+    // probe_slow_noted_ is probe-thread-only state.
+    bool slow = thr_us > 0 && std::max(write_us, read_us) > thr_us;
+    if (slow && !probe_slow_noted_[static_cast<size_t>(i)]) {
+      probe_slow_noted_[static_cast<size_t>(i)] = true;
+      FDFS_LOG_WARN("gray disk: %s probe write=%lldus read=%lldus (>%dms)",
+                    store_.store_path(i).c_str(),
+                    static_cast<long long>(write_us),
+                    static_cast<long long>(read_us),
+                    cfg_.probe_slow_threshold_ms);
+      if (events_ != nullptr)
+        events_->Record(EventSeverity::kWarn, "disk.gray",
+                        store_.store_path(i),
+                        "probe write=" + std::to_string(write_us / 1000) +
+                            "ms read=" + std::to_string(read_us / 1000) +
+                            "ms threshold=" +
+                            std::to_string(cfg_.probe_slow_threshold_ms) +
+                            "ms");
+    } else if (!slow && probe_slow_noted_[static_cast<size_t>(i)]) {
+      probe_slow_noted_[static_cast<size_t>(i)] = false;
+      if (events_ != nullptr)
+        events_->Record(EventSeverity::kInfo, "disk.recovered",
+                        store_.store_path(i), "");
+    }
+  }
+  probe_read_us_.store(worst_read);
+  probe_write_us_.store(worst_write);
+  HealthMonitor::Global().SetProbe(worst_read, worst_write,
+                                   cfg_.probe_slow_threshold_ms);
+
+  // Active peer probes: ACTIVE_TEST to every tracker + group sync peer,
+  // so an otherwise-idle cluster still converges on peer health.  The
+  // NetRpc observer records each round-trip; only CONNECT failures
+  // (no fd, so the observer never sees them) are fed explicitly.
+  std::vector<std::pair<std::string, int>> targets;
+  for (const std::string& t : cfg_.tracker_servers) {
+    size_t colon = t.rfind(':');
+    if (colon == std::string::npos || colon == 0) continue;
+    targets.emplace_back(t.substr(0, colon), atoi(t.c_str() + colon + 1));
+  }
+  if (sync_ != nullptr) {
+    for (const SyncPeerState& s : sync_->States()) {
+      size_t colon = s.addr.rfind(':');
+      if (colon == std::string::npos || colon == 0) continue;
+      targets.emplace_back(s.addr.substr(0, colon),
+                           atoi(s.addr.c_str() + colon + 1));
+    }
+  }
+  for (const auto& [host, port] : targets) {
+    if (health_stop_.load(std::memory_order_relaxed)) return;
+    BeatThreadHeartbeat();
+    int64_t t0 = MonoUs();
+    std::string err;
+    int fd = TcpConnect(host, port, 2000, &err);
+    if (fd < 0) {
+      HealthMonitor::Global().Feed(host + ":" + std::to_string(port),
+                                   "probe", false, MonoUs() - t0, 2000);
+      continue;
+    }
+    std::string resp;
+    uint8_t status = 0;
+    NetRpc(fd, static_cast<uint8_t>(StorageCmd::kActiveTest), "", &resp,
+           &status, 1024, 2000);
+    close(fd);
+  }
+}
+
+std::string StorageServer::HealthStatusJson() {
+  return HealthMonitor::Global().Json("storage", cfg_.port);
+}
+
 void StorageServer::MetricsTick() {
   // One snapshot feeds both consumers: what the journal persists IS
   // what the SLO engine judged, so a post-mortem can re-derive every
@@ -1005,6 +1214,36 @@ void StorageServer::MetricsTick() {
   // Per-thread CPU ledger: one /proc pass per tick, published as
   // thread.<name>.* gauges so the journal snapshot below persists them.
   ThreadRegistry::Global().SampleInto(&registry_);
+  // Watchdog scan (gray-failure layer): a registered daemon thread
+  // whose heartbeat is older than the threshold is stalled — wedged on
+  // a lock, a dead NFS mount, an unbounded syscall.  Each transition
+  // records one flight-recorder event (newly stalled / recovered), and
+  // the live count feeds the gauge + this node's gray score.
+  if (cfg_.watchdog_stall_threshold_ms > 0) {
+    ThreadRegistry::WatchdogResult wd = ThreadRegistry::Global().WatchdogScan(
+        static_cast<int64_t>(cfg_.watchdog_stall_threshold_ms) * 1000);
+    stalled_threads_.store(static_cast<int64_t>(wd.stalled.size()));
+    HealthMonitor::Global().SetStalledThreads(
+        static_cast<int>(wd.stalled.size()));
+    if (events_ != nullptr) {
+      for (const ThreadRegistry::Stall& s : wd.stalled) {
+        if (!s.newly) continue;
+        FDFS_LOG_WARN("watchdog: thread %s (tid %d) stalled %llds",
+                      s.name.c_str(), s.tid,
+                      static_cast<long long>(s.age_us / 1000000));
+        events_->Record(EventSeverity::kWarn, "watchdog.stall", s.name,
+                        "heartbeat " + std::to_string(s.age_us / 1000) +
+                            "ms old (threshold " +
+                            std::to_string(cfg_.watchdog_stall_threshold_ms) +
+                            "ms)");
+      }
+      for (const std::string& name : wd.recovered)
+        events_->Record(EventSeverity::kInfo, "watchdog.recovered", name, "");
+    }
+  }
+  // Health gauges (health.score + peer.* families) refresh here so the
+  // journal snapshot below persists them every tick.
+  HealthMonitor::Global().PublishGauges(&registry_);
   // Per-loop duty cycle: busy-us delta over the tick's wall time.
   // Index 0 = the accept/timers loop, 1 + i = nio_[i].
   if (loop_busy_last_.size() == nio_.size() + 1) {
@@ -1975,6 +2214,17 @@ void StorageServer::OnHeaderComplete(Conn* c) {
         else
           Respond(c, 0, j);
       });
+      return;
+    case StorageCmd::kHealthStatus:
+      // Gray-failure health table: empty body -> JSON (peer EWMA rows +
+      // disk probes + watchdog counts; monitor.decode_health_status;
+      // fdfs_codec health-status golden).  One bounded-size snapshot
+      // under the health mutex — fine on the nio loop.
+      if (c->pkg_len != 0) {
+        CloseConn(c);
+        return;
+      }
+      Respond(c, 0, HealthStatusJson());
       return;
     case StorageCmd::kScrubStatus: {
       // Integrity-engine status: empty body -> kScrubStatCount BE int64

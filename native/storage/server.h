@@ -345,6 +345,17 @@ class StorageServer {
   // gauge-fn itself: gauge-fns run under the registry mutex on the nio
   // loop, and statvfs on a stalled mount can block for seconds.
   void RefreshDiskUsedPct();
+  // -- gray-failure health layer (common/healthmon.h; HEALTH_STATUS) -----
+  // Dedicated "health.probe" thread: every health_probe_interval_s it
+  // ACTIVE_TESTs the trackers + the group's sync peers (feeding the
+  // passive per-peer table through the NetRpc observer) and runs the
+  // per-store-path disk probes (4 KB tmp-write+fsync + read-back) —
+  // off the request path, the store.disk_used_pct discipline.
+  void HealthProbeMain();
+  void RunHealthProbes();
+  // HEALTH_STATUS wire body (healthmon Json: peer table + probes +
+  // watchdog counts).
+  std::string HealthStatusJson();
 
   // -- dispatch ----------------------------------------------------------
   void OnHeaderComplete(Conn* c);
@@ -505,6 +516,19 @@ class StorageServer {
   // store.inodes_used gauge is what the slab-packing win (ISSUE 9) is
   // judged against on small-file corpora.
   std::atomic<int64_t> inodes_used_{0};
+  // Gray-failure health layer (ISSUE 17).  Probe latencies are the
+  // worst store path's most recent round (gauge-fns read the atomics,
+  // never the disk — the disk_used_pct discipline); stalled_threads_
+  // mirrors the last watchdog scan for the watchdog.stalled_threads
+  // gauge.  probe_slow_noted_ is probe-thread-only state for
+  // one-disk.gray-event-per-outage.
+  std::atomic<int64_t> probe_read_us_{0};
+  std::atomic<int64_t> probe_write_us_{0};
+  std::atomic<int64_t> stalled_threads_{0};
+  std::atomic<bool> health_stop_{false};
+  std::thread health_probe_thread_;
+  std::thread inject_stall_thread_;  // watchdog_inject_stall_ms debug aid
+  std::vector<bool> probe_slow_noted_;  // per store path; probe thread only
   // dio pools, one per store path (storage.conf:disk_writer_threads;
   // reference: storage_dio.c per-path reader/writer queues).
   std::vector<std::unique_ptr<WorkerPool>> dio_pools_;
@@ -560,6 +584,9 @@ class StorageServer {
   std::atomic<int64_t>* ctr_nio_dispatched_ = nullptr;
   StatHistogram* hist_dio_wait_ = nullptr;
   StatHistogram* hist_dio_service_ = nullptr;
+  // Outbound peer-RPC latency (all op classes), Observed by the health
+  // monitor on every successful NetRpc — the peer_rpc_p99_ms SLO input.
+  StatHistogram* hist_peer_rpc_ = nullptr;
   StatHistogram* hist_upload_bytes_ = nullptr;
   StatHistogram* hist_download_bytes_ = nullptr;
   std::atomic<int64_t>* ctr_sync_bytes_saved_wire_ = nullptr;

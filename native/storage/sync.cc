@@ -10,6 +10,7 @@
 
 #include "common/bytes.h"
 #include "common/eventlog.h"
+#include "common/healthmon.h"
 #include "common/log.h"
 #include "common/threadreg.h"
 #include "common/net.h"
@@ -146,10 +147,19 @@ void SyncManager::WorkerMain(Worker* w) {
 
   bool stall_noted = false;  // one event per outage, not per retry
   while (!w->stop) {
+    BeatThreadHeartbeat();
     if (fd < 0) {
+      int64_t t0 = MonoUs();
       fd = TcpConnect(w->ip, w->port, kConnectTimeoutMs, &err);
       if (fd < 0) {
         w->connected = false;
+        // Connect failures never reach the NetRpc observer (no live
+        // fd), so feed the gray-failure table explicitly: a peer whose
+        // replication port stops answering is exactly what the health
+        // matrix exists to show.
+        HealthMonitor::Global().Feed(w->ip + ":" + std::to_string(w->port),
+                                     "sync", false, MonoUs() - t0,
+                                     kConnectTimeoutMs);
         // Flight recorder: the FIRST failed (re)connect of an outage is
         // the stall signal; the exponential-backoff retries after it are
         // noise the bounded ring should not drown in.
@@ -161,8 +171,10 @@ void SyncManager::WorkerMain(Worker* w) {
               pending.has_value() ? "reason=connect_failed mid_record=1"
                                   : "reason=connect_failed");
         }
-        for (int i = 0; i < backoff_ms / 50 && !w->stop; ++i)
+        for (int i = 0; i < backoff_ms / 50 && !w->stop; ++i) {
+          BeatThreadHeartbeat();  // backed off, not stalled
           usleep(50 * 1000);
+        }
         backoff_ms = std::min(backoff_ms * 2, 5000);
         continue;
       }
@@ -206,7 +218,10 @@ void SyncManager::WorkerMain(Worker* w) {
         cbs_.report(w->ip, w->port, safe);
       }
       int wait = std::max(cfg_.sync_interval_ms, 20);
-      for (int i = 0; i < wait / 20 && !w->stop; ++i) usleep(20 * 1000);
+      for (int i = 0; i < wait / 20 && !w->stop; ++i) {
+        BeatThreadHeartbeat();  // idle-polling, not stalled
+        usleep(20 * 1000);
+      }
       continue;
     }
 
@@ -217,7 +232,15 @@ void SyncManager::WorkerMain(Worker* w) {
       continue;
     }
 
-    if (!Replay(w, &fd, *pending)) {
+    // Replication ships are manually framed (SendAll/RecvAll, not
+    // NetRpc), so they feed the gray-failure table explicitly: per-ship
+    // outcome + wall time against the peer, op class "sync".
+    int64_t ship_t0 = MonoUs();
+    bool shipped = Replay(w, &fd, *pending);
+    HealthMonitor::Global().Feed(w->ip + ":" + std::to_string(w->port),
+                                 "sync", shipped, MonoUs() - ship_t0,
+                                 kIoTimeoutMs);
+    if (!shipped) {
       // Transient failure: reconnect and retry this same record.
       if (fd >= 0) {
         close(fd);

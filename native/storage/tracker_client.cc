@@ -373,6 +373,11 @@ bool TrackerReporter::DoBeat(int fd, int64_t* chlog_off) {
   int64_t stats[kBeatStatCount] = {0};
   if (stats_fn_) stats_fn_(stats);
   for (int i = 0; i < kBeatStatCount; ++i) AppendInt64(&body, stats[i]);
+  // Health trailer rides the append-only region past the pinned stat
+  // slots (the tracker reads min(available, kBeatStatCount) slots and
+  // parses anything further as a versioned trailer; an older tracker
+  // ignores it entirely).
+  if (health_trailer_fn_) body += health_trailer_fn_();
   std::string resp;
   uint8_t status;
   if (!Rpc(fd, static_cast<uint8_t>(TrackerCmd::kStorageBeat), body, &resp,
@@ -438,6 +443,7 @@ void TrackerReporter::ThreadMain(std::string host, int port) {
   int64_t last_beat = 0, last_disk = 0;
   int64_t chlog_off = 0;  // per-tracker changelog resume cursor
   while (!stop_) {
+    BeatThreadHeartbeat();  // 200ms cadence loop (watchdog enrollment)
     if (fd < 0) {
       std::string err;
       fd = TcpConnect(host, port, 3000, &err);

@@ -44,6 +44,15 @@ class TrackerReporter {
 
   void Start();
   void Stop();
+  // Health trailer provider (common/healthmon.h PackBeatTrailer): bytes
+  // appended AFTER the kBeatStatCount stat slots in every beat body.
+  // The tracker's beat parser reads min(available, kBeatStatCount)
+  // slots and ignores the rest, so the append is wire-compatible both
+  // ways (append-only contract, the PR 10 discipline).  Set before
+  // Start(); empty return = trailerless beat.
+  void set_health_trailer_fn(std::function<std::string()> fn) {
+    health_trailer_fn_ = std::move(fn);
+  }
   // Disk recovery in progress: JOINs carry the recovering flag (tracker
   // holds the node in WAIT_SYNC) and the join-time sync negotiation is
   // left to the recovery thread.  Cleared when the rebuild completes.
@@ -93,6 +102,7 @@ class TrackerReporter {
   StorageConfig cfg_;
   StatsSnapshotFn stats_fn_;
   PeersCallback peers_cb_;
+  std::function<std::string()> health_trailer_fn_;  // set before Start()
   std::atomic<bool> stop_{false};
   std::atomic<bool> recovering_{false};
   std::vector<std::thread> threads_;

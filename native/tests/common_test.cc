@@ -18,6 +18,7 @@
 #include "common/bytes.h"
 #include "common/eventlog.h"
 #include "common/fileid.h"
+#include "common/healthmon.h"
 #include "common/heatsketch.h"
 #include "common/ini.h"
 #include "common/lockrank.h"
@@ -1019,6 +1020,164 @@ static void TestProfilerCtlHammerAgainstLiveThreads() {
   prof.set_max_hz(0);
 }
 
+// -- gray-failure health layer (common/healthmon.h) ------------------------
+
+static void TestHealthMonitorScoresAndTrailer() {
+  HealthMonitor& hm = HealthMonitor::Global();
+  hm.Reset();
+  // No peers and no self signal yet: the beat stays trailerless (old
+  // trackers see exactly the pre-health wire).
+  CHECK(hm.PackBeatTrailer().empty());
+
+  // Self score: each stalled thread costs 50; a probe past the
+  // threshold costs 50, past 4x costs 75; clamped to [0, 100].
+  hm.SetStalledThreads(0);
+  hm.SetProbe(1500, 2500, 1000);  // 2.5ms probes, 1s threshold: clean
+  CHECK_EQ(hm.SelfScore(), 100);
+  hm.SetProbe(1500, 2500000, 1000);  // 2.5s write probe: gray disk
+  CHECK_EQ(hm.SelfScore(), 50);
+  hm.SetProbe(1500, 4100000, 1000);  // > 4x threshold: hard-degraded
+  CHECK_EQ(hm.SelfScore(), 25);
+  hm.SetStalledThreads(2);
+  CHECK_EQ(hm.SelfScore(), 0);  // 100 - 100 - 75, clamped
+
+  // The codec-golden fixture (tools/codec_cli.cc health-status and
+  // tests/test_health.py assert the same arithmetic).
+  hm.SetStalledThreads(1);
+  hm.SetProbe(1500, 2500, 1000);
+  for (int i = 0; i < 3; ++i)
+    hm.Feed("10.0.0.2:23000", "fetch", true, 50000, 1000);
+  hm.Feed("10.0.0.2:23000", "fetch", false, 950000, 1000);  // timeout-shaped
+  hm.Feed("10.0.0.2:23000", "beat", true, 2000, 2000);
+  hm.Feed("10.0.0.2:23000", "beat", true, 2000, 2000);
+  hm.Feed("10.0.0.9:23001", "probe", false, 100, 2000);  // fast hard fail
+  // fetch: 100 - round(.2*60) - round(.2*40) - 50ms latency penalty = 75;
+  // beat stays 100; the composite per peer is the MIN across op classes.
+  CHECK_EQ(hm.PeerScore("10.0.0.2:23000"), 75);
+  CHECK_EQ(hm.PeerScore("10.0.0.9:23001"), 88);  // errors only, no latency
+  CHECK_EQ(hm.PeerScore("1.2.3.4:1"), -1);       // never seen
+
+  auto rows = hm.Snapshot();
+  CHECK_EQ(rows.size(), 3u);  // (addr, op)-sorted
+  CHECK(rows[0].addr == "10.0.0.2:23000" && rows[0].op == "beat");
+  CHECK_EQ(rows[0].score, 100);
+  CHECK_EQ(rows[0].ops, 2);
+  CHECK(rows[1].op == "fetch");
+  CHECK_EQ(rows[1].score, 75);
+  CHECK_EQ(rows[1].rpc_ewma_us, 50000);  // failures never move latency
+  CHECK_EQ(rows[1].error_pct, 20);
+  CHECK_EQ(rows[1].timeout_pct, 20);
+  CHECK(rows[1].ops == 4 && rows[1].errors == 1 && rows[1].timeouts == 1);
+  CHECK(rows[2].addr == "10.0.0.9:23001" && rows[2].op == "probe");
+  CHECK_EQ(rows[2].score, 88);
+  CHECK(rows[2].errors == 1 && rows[2].timeouts == 0);
+
+  // Beat-trailer roundtrip: 1B version + 8B self + 8B n + n x 32B.
+  std::string t = hm.PackBeatTrailer();
+  CHECK_EQ(t.size(), static_cast<size_t>(17 + 2 * 32));
+  BeatHealthTrailer ht;
+  CHECK(ParseBeatHealthTrailer(t.data(), t.size(), &ht));
+  CHECK_EQ(ht.self_score, 50);
+  CHECK_EQ(ht.peers.size(), 2u);
+  CHECK(ht.peers[0].first == "10.0.0.2:23000" && ht.peers[0].second == 75);
+  CHECK(ht.peers[1].first == "10.0.0.9:23001" && ht.peers[1].second == 88);
+  std::string bad = t;
+  bad[0] = 9;  // unknown version: refuse, don't guess
+  CHECK(!ParseBeatHealthTrailer(bad.data(), bad.size(), &ht));
+  CHECK(!ParseBeatHealthTrailer(t.data(), 16, &ht));          // short header
+  CHECK(!ParseBeatHealthTrailer(t.data(), t.size() - 1, &ht));  // torn entry
+
+  // Gauges publish per ADDR (min score across ops) and prune on Reset.
+  StatsRegistry reg;
+  hm.PublishGauges(&reg);
+  std::string json = reg.Json();
+  CHECK(json.find("\"peer.10.0.0.2:23000.score\":75") != std::string::npos);
+  CHECK(json.find("\"peer.10.0.0.9:23001.score\":88") != std::string::npos);
+  CHECK(json.find("\"health.score\":50") != std::string::npos);
+  hm.Reset();
+  hm.PublishGauges(&reg);
+  CHECK(reg.Json().find("peer.10.0.0.2") == std::string::npos);
+
+  // Op-class bucketing: the opcode -> class mapping is part of the
+  // cross-language contract (mirrored in the health-status golden).
+  CHECK(std::string(HealthMonitor::OpClassFor(111)) == "probe");
+  CHECK(std::string(HealthMonitor::OpClassFor(83)) == "beat");
+  CHECK(std::string(HealthMonitor::OpClassFor(129)) == "fetch");
+  CHECK(std::string(HealthMonitor::OpClassFor(145)) == "ec");
+  CHECK(std::string(HealthMonitor::OpClassFor(16)) == "sync");
+  CHECK(std::string(HealthMonitor::OpClassFor(11)) == "rpc");
+
+  CHECK(hm.PackBeatTrailer().empty());  // Reset cleared the self signal
+}
+
+static void TestThreadRegistryWatchdog() {
+  ThreadRegistry& tr = ThreadRegistry::Global();
+  std::atomic<bool> stop{false};
+  std::atomic<bool> do_beat{false};
+  std::thread victim([&] {
+    ScopedThreadName ledger("watchdog.victim");
+    BeatThreadHeartbeat();
+    while (!stop.load()) {
+      if (do_beat.exchange(false)) BeatThreadHeartbeat();
+      usleep(2000);
+    }
+  });
+  // A never-beating thread has NO heartbeat contract: the watchdog must
+  // not enroll it (false-positive-free by construction).
+  std::atomic<bool> stop_quiet{false};
+  std::thread quiet([&] {
+    ScopedThreadName ledger("watchdog.quiet");
+    while (!stop_quiet.load()) usleep(2000);
+  });
+  usleep(60 * 1000);  // victim's last beat is now ~60ms old
+  ThreadRegistry::WatchdogResult wd = tr.WatchdogScan(30 * 1000);
+  bool victim_stalled = false, victim_newly = false, quiet_stalled = false;
+  for (const ThreadRegistry::Stall& s : wd.stalled) {
+    if (s.name == "watchdog.victim") {
+      victim_stalled = true;
+      victim_newly = s.newly;
+      CHECK(s.age_us >= 30 * 1000);
+    }
+    if (s.name == "watchdog.quiet") quiet_stalled = true;
+  }
+  CHECK(victim_stalled && victim_newly);
+  CHECK(!quiet_stalled);
+  // Second scan: still stalled, but no longer NEW (one event per outage).
+  wd = tr.WatchdogScan(30 * 1000);
+  victim_newly = true;
+  for (const ThreadRegistry::Stall& s : wd.stalled)
+    if (s.name == "watchdog.victim") victim_newly = s.newly;
+  CHECK(!victim_newly);
+  // The thread beats again: the outage ends and is reported ONCE.
+  do_beat.store(true);
+  for (int i = 0; i < 100 && do_beat.load(); ++i) usleep(2000);
+  wd = tr.WatchdogScan(30 * 1000);
+  bool recovered = false;
+  for (const std::string& n : wd.recovered)
+    if (n == "watchdog.victim") recovered = true;
+  CHECK(recovered);
+  for (const ThreadRegistry::Stall& s : wd.stalled)
+    CHECK(s.name != "watchdog.victim");
+  // Heartbeats(): the DumpState ledger view — victim has an age, the
+  // never-beating thread reads -1.
+  bool saw_victim = false, saw_quiet = false;
+  for (const ThreadRegistry::HeartbeatEntry& h : tr.Heartbeats()) {
+    if (h.name == "watchdog.victim") {
+      saw_victim = true;
+      CHECK(h.age_us >= 0);
+    }
+    if (h.name == "watchdog.quiet") {
+      saw_quiet = true;
+      CHECK_EQ(h.age_us, -1);
+    }
+  }
+  CHECK(saw_victim && saw_quiet);
+  stop.store(true);
+  stop_quiet.store(true);
+  victim.join();
+  quiet.join();
+}
+
 int main(int argc, char** argv) {
   if (argc > 1 && std::strncmp(argv[1], "--lockrank-", 11) == 0)
     return RunLockRankViolation(argv[1]);
@@ -1056,6 +1215,8 @@ int main(int argc, char** argv) {
   TestThreadRegistrySampleThreaded();
   TestProfilerGateAndCapture();
   TestProfilerCtlHammerAgainstLiveThreads();
+  TestHealthMonitorScoresAndTrailer();
+  TestThreadRegistryWatchdog();
   if (g_failures == 0) {
     std::printf("common_test: ALL PASS\n");
     return 0;

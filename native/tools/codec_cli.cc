@@ -79,6 +79,17 @@
 //                deleted — plus the EC_RELEASE wire body; pins the
 //                on-disk stripe layout AND the release wire contract
 //                against tests/harness.py / tests/test_ec.py)
+//   fdfs_codec health-status   (golden HEALTH_STATUS body: a fixture
+//                Feed sequence through the REAL HealthMonitor -> wire
+//                JSON, plus the beat-trailer bytes as hex and their
+//                parse-back — pins scores, EWMA rounding, and the
+//                trailer layout against fastdfs_tpu.monitor.
+//                decode_health_status / tests/test_health.py)
+//   fdfs_codec health-matrix   (golden HEALTH_MATRIX body: fixture
+//                trailer reports folded through the REAL tracker
+//                Cluster -> the N x N differential matrix JSON — pins
+//                the gray/sick/ok/unknown verdict rules across
+//                languages against monitor.decode_health_matrix)
 #include <time.h>
 
 #include <atomic>
@@ -93,6 +104,7 @@
 #include "common/cdc.h"
 #include "common/eventlog.h"
 #include "common/fileid.h"
+#include "common/healthmon.h"
 #include "common/heatsketch.h"
 #include "common/http_token.h"
 #include "common/ini.h"
@@ -107,6 +119,7 @@
 #include "common/gf256.h"
 #include "storage/ecstore.h"
 #include "storage/slabstore.h"
+#include "tracker/cluster.h"
 #include "tracker/placement.h"
 
 using namespace fdfs;
@@ -850,6 +863,79 @@ int main(int argc, char** argv) {
     }
     printf("release_body=%s\n", hex(body).c_str());
     return static_cast<int>(rc);
+  }
+  if (cmd == "health-status") {
+    // Fixed fixture through the REAL HealthMonitor — tests/test_health.py
+    // rebuilds the expected JSON (score formula, EWMA rounding, row
+    // order) with the Python mirror and decodes the trailer hex with the
+    // documented layout, pinning HEALTH_STATUS and the beat trailer
+    // across languages in one golden.
+    HealthMonitor& hm = HealthMonitor::Global();
+    hm.Reset();
+    hm.SetStalledThreads(1);
+    hm.SetProbe(1500, 2500, 1000);  // under threshold: no self penalty
+    // Peer A: three clean fetches, then one timeout-shaped failure.
+    for (int i = 0; i < 3; ++i)
+      hm.Feed("10.0.0.2:23000", "fetch", true, 50000, 1000);
+    hm.Feed("10.0.0.2:23000", "fetch", false, 950000, 1000);
+    // Same peer, a healthy op class: composite must take the MIN.
+    hm.Feed("10.0.0.2:23000", "beat", true, 2000, 2000);
+    hm.Feed("10.0.0.2:23000", "beat", true, 2000, 2000);
+    // Peer B: one hard connect failure (fast fail, not timeout-shaped).
+    hm.Feed("10.0.0.9:23001", "probe", false, 100, 2000);
+    printf("%s\n", hm.Json("storage", 23000).c_str());
+    printf("self_score=%lld\n", static_cast<long long>(hm.SelfScore()));
+    printf("peer_a=%lld peer_b=%lld\n",
+           static_cast<long long>(hm.PeerScore("10.0.0.2:23000")),
+           static_cast<long long>(hm.PeerScore("10.0.0.9:23001")));
+    std::string trailer = hm.PackBeatTrailer();
+    static const char* kHex = "0123456789abcdef";
+    std::string hex;
+    for (unsigned char ch : trailer) {
+      hex.push_back(kHex[ch >> 4]);
+      hex.push_back(kHex[ch & 0xF]);
+    }
+    printf("trailer=%s\n", hex.c_str());
+    BeatHealthTrailer ht;
+    bool parsed = ParseBeatHealthTrailer(trailer.data(), trailer.size(), &ht);
+    printf("parsed=%d parsed_self=%lld\n", parsed ? 1 : 0,
+           static_cast<long long>(ht.self_score));
+    for (const auto& [addr, score] : ht.peers)
+      printf("parsed_peer=%s:%lld\n", addr.c_str(),
+             static_cast<long long>(score));
+    // Op-class bucketing is part of the cross-language contract too
+    // (tests assert the same opcode -> class mapping).
+    printf("opclass_111=%s opclass_83=%s opclass_129=%s opclass_145=%s "
+           "opclass_16=%s opclass_11=%s\n",
+           HealthMonitor::OpClassFor(111), HealthMonitor::OpClassFor(83),
+           HealthMonitor::OpClassFor(129), HealthMonitor::OpClassFor(145),
+           HealthMonitor::OpClassFor(16), HealthMonitor::OpClassFor(11));
+    hm.Reset();
+    return parsed ? 0 : 1;
+  }
+  if (cmd == "health-matrix") {
+    // Fixture trailer reports folded through the REAL tracker Cluster:
+    // one healthy node, one signature gray (claims 90, peers say ~37),
+    // one self-admitted sick, one silent (never sent a trailer).
+    Cluster cl;
+    const int64_t now = 1700000000;
+    cl.Join("group1", "10.0.0.1", 23000, 1, now - 500);
+    cl.Join("group1", "10.0.0.2", 23000, 1, now - 500);
+    cl.Join("group1", "10.0.0.3", 23000, 1, now - 500);
+    cl.Join("group1", "10.0.0.4", 23000, 1, now - 500);
+    cl.UpdateHealth("group1", "10.0.0.1", 23000, 100,
+                    {{"10.0.0.2:23000", 40}, {"10.0.0.3:23000", 95}},
+                    now - 10);
+    cl.UpdateHealth("group1", "10.0.0.2", 23000, 90,
+                    {{"10.0.0.1:23000", 100}, {"10.0.0.3:23000", 92}},
+                    now - 8);
+    cl.UpdateHealth("group1", "10.0.0.3", 23000, 30,
+                    {{"10.0.0.1:23000", 98}, {"10.0.0.2:23000", 35}},
+                    now - 5);
+    printf("{\"role\":\"tracker\",\"port\":22122,\"gray_threshold\":60,"
+           "\"nodes\":%s}\n",
+           cl.HealthMatrixJson(now, 60).c_str());
+    return 0;
   }
   if (cmd == "b64e" && argc == 3) {
     std::string hex = argv[2];

@@ -150,6 +150,21 @@ bool Cluster::UpdateDiskUsage(const std::string& group, const std::string& ip,
   return true;
 }
 
+bool Cluster::UpdateHealth(
+    const std::string& group, const std::string& ip, int port,
+    int64_t self_score,
+    const std::vector<std::pair<std::string, int64_t>>& peers, int64_t now) {
+  StorageNode* n = FindNode(group, ip + ":" + std::to_string(port));
+  if (n == nullptr) return false;
+  n->health_self = self_score;
+  n->health_ts = now;
+  // Replace, don't merge: the trailer carries the reporter's WHOLE
+  // current table, so a peer it stopped talking to ages out here too.
+  n->health_peer_scores.clear();
+  for (const auto& [addr, score] : peers) n->health_peer_scores[addr] = score;
+  return true;
+}
+
 bool Cluster::SyncReport(const std::string& group, const std::string& src,
                          const std::string& dest, int64_t ts) {
   StorageNode* n = FindNode(group, dest);
@@ -734,6 +749,65 @@ std::string Cluster::ClusterStatJson(int64_t now,
       out += "}}";
     }
     out += "]}";
+  }
+  return out + "]";
+}
+
+std::string Cluster::HealthMatrixJson(int64_t now,
+                                      int64_t gray_threshold) const {
+  // Differential verdict per node: what the node SAYS about itself
+  // (health_self from its trailer) against what its group peers SAY
+  // about it (average of their trailer scores naming its address).
+  // Disagreement in one direction is the whole point — a gray node
+  // keeps reporting itself healthy while every peer watches its RPCs
+  // time out.
+  std::string out = "[";
+  bool first = true;
+  char buf[256];
+  for (const auto& [gname, g] : groups_) {
+    for (const auto& [addr, s] : g.storages) {
+      if (s.status == kDeleted) continue;
+      int64_t sum = 0, reports = 0;
+      for (const auto& [paddr, p] : g.storages) {
+        if (paddr == addr || p.status == kDeleted) continue;
+        auto it = p.health_peer_scores.find(addr);
+        if (it == p.health_peer_scores.end()) continue;
+        sum += it->second;
+        ++reports;
+      }
+      int64_t peer_avg = reports > 0 ? sum / reports : -1;
+      const char* verdict;
+      if (s.health_self < 0 && peer_avg < 0)
+        verdict = "unknown";
+      else if (s.health_self >= 0 && s.health_self < gray_threshold)
+        verdict = "sick";  // the node itself admits it
+      else if (peer_avg >= 0 && peer_avg < gray_threshold)
+        verdict = "gray";  // peers see what the node does not report
+      else
+        verdict = "ok";
+      if (!first) out += ",";
+      first = false;
+      std::snprintf(buf, sizeof(buf),
+                    "{\"group\":\"%s\",\"addr\":\"%s\",\"self\":%lld,"
+                    "\"peer_avg\":%lld,\"reports\":%lld,\"verdict\":\"%s\","
+                    "\"age_s\":%lld,\"peers\":{",
+                    JsonEscape(gname).c_str(), JsonEscape(addr).c_str(),
+                    static_cast<long long>(s.health_self),
+                    static_cast<long long>(peer_avg),
+                    static_cast<long long>(reports), verdict,
+                    static_cast<long long>(
+                        s.health_ts > 0 ? now - s.health_ts : -1));
+      out += buf;
+      bool pfirst = true;
+      for (const auto& [paddr, score] : s.health_peer_scores) {
+        std::snprintf(buf, sizeof(buf), "%s\"%s\":%lld", pfirst ? "" : ",",
+                      JsonEscape(paddr).c_str(),
+                      static_cast<long long>(score));
+        pfirst = false;
+        out += buf;
+      }
+      out += "}}";
+    }
   }
   return out + "]";
 }

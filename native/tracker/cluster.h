@@ -21,6 +21,7 @@
 #include <map>
 #include <optional>
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "common/protocol_gen.h"  // kBeatStatCount / kBeatStatNames
@@ -48,6 +49,15 @@ struct StorageNode {
   // this node must sync past before promotion to ACTIVE.
   std::string sync_src_addr;
   int64_t sync_until_ts = 0;
+  // Gray-failure health (ISSUE 17): the node's self-reported gray score
+  // from its latest beat trailer (-1 = never carried one — an older
+  // storage, or health had nothing to say yet), when it arrived, and
+  // this node's view of its PEERS ("ip:port" -> score 0..100).  The
+  // differential matrix reads: a node every peer scores low while its
+  // own trailer says healthy is gray.
+  int64_t health_self = -1;
+  int64_t health_ts = 0;
+  std::map<std::string, int64_t> health_peer_scores;
 
   std::string Addr() const { return ip + ":" + std::to_string(port); }
 };
@@ -119,6 +129,15 @@ class Cluster {
             const int64_t* stats, int nstats, int64_t now);
   bool UpdateDiskUsage(const std::string& group, const std::string& ip,
                        int port, int64_t total_mb, int64_t free_mb);
+  // Health trailer from a storage beat (common/healthmon.h
+  // ParseBeatHealthTrailer): the reporter's own gray score + its scores
+  // about its peers.  Peer addresses outside the reporter's group
+  // (trackers it probes) are kept too — HealthMatrixJson simply shows
+  // them; only group members participate in the gray verdict.
+  bool UpdateHealth(const std::string& group, const std::string& ip, int port,
+                    int64_t self_score,
+                    const std::vector<std::pair<std::string, int64_t>>& peers,
+                    int64_t now);
   // Source "src" reports dest has synced its binlog through ts.
   bool SyncReport(const std::string& group, const std::string& src_addr,
                   const std::string& dest_addr, int64_t ts);
@@ -203,6 +222,19 @@ class Cluster {
   // complete named last-beat stat payload (kBeatStatNames).  `group`
   // filters to one group when non-empty.
   std::string ClusterStatJson(int64_t now, const std::string& group = "") const;
+  // The N x N differential health view — the "nodes" array of the
+  // HEALTH_MATRIX body (the server wraps role/port/gray_threshold
+  // around it; fdfs_codec health-matrix golden; cli.py health
+  // renderer).  Per node: its
+  // self-reported score, the average of what its GROUP PEERS score it
+  // (peer_avg, -1 when nobody has reported about it), how many peers
+  // reported, and the verdict against `gray_threshold`:
+  //   "gray"    peers score it below threshold while it claims healthy
+  //             (the signature gray failure — or a lying/blind node)
+  //   "sick"    its own trailer admits a score below threshold
+  //   "ok"      both views at/above threshold
+  //   "unknown" no health data at all (old storage, or too early)
+  std::string HealthMatrixJson(int64_t now, int64_t gray_threshold) const;
 
   // -- persistence (tracker_save_storages analogue) ----------------------
   bool Save(const std::string& path) const;

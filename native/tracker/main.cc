@@ -92,6 +92,12 @@ int main(int argc, char** argv) {
       ini.GetInt("profile_max_hz", cfg.profile_max_hz));
   if (cfg.profile_max_hz < 0) cfg.profile_max_hz = 0;
   if (cfg.profile_max_hz > 1000) cfg.profile_max_hz = 1000;  // ~1ms timer floor
+  // Gray-failure verdict threshold (HEALTH_MATRIX; scores are 0..100,
+  // so clamp into that range — 0 means "never call anything gray").
+  cfg.health_gray_threshold = static_cast<int>(
+      ini.GetInt("health_gray_threshold", cfg.health_gray_threshold));
+  if (cfg.health_gray_threshold < 0) cfg.health_gray_threshold = 0;
+  if (cfg.health_gray_threshold > 100) cfg.health_gray_threshold = 100;
   if (cfg.base_path.empty()) {
     std::fprintf(stderr, "config error: base_path is required\n");
     return 1;

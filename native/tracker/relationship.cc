@@ -213,6 +213,7 @@ void RelationshipManager::RunElection() {
 void RelationshipManager::ThreadMain() {
   ScopedThreadName ledger("relationship");
   while (!stop_) {
+    BeatThreadHeartbeat();
     std::string leader = leader_addr();
     if (leader.empty()) {
       RunElection();
@@ -237,7 +238,10 @@ void RelationshipManager::ThreadMain() {
         }
       }
     }
-    for (int i = 0; i < 10 && !stop_; ++i) usleep(100 * 1000);
+    for (int i = 0; i < 10 && !stop_; ++i) {
+      BeatThreadHeartbeat();  // idle between leader pings, not stalled
+      usleep(100 * 1000);
+    }
   }
 }
 

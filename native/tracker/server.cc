@@ -5,6 +5,7 @@
 #include <cstring>
 
 #include "common/bytes.h"
+#include "common/healthmon.h"
 #include "common/log.h"
 #include "common/profiler.h"
 #include "common/threadreg.h"
@@ -96,6 +97,7 @@ const char* TrackerOpName(uint8_t cmd) {
     case TrackerCmd::kGroupReactivate: return "tracker.group_reactivate";
     case TrackerCmd::kProfileCtl: return "tracker.profile_ctl";
     case TrackerCmd::kProfileDump: return "tracker.profile_dump";
+    case TrackerCmd::kHealthMatrix: return "tracker.health_matrix";
     default: return nullptr;
   }
 }
@@ -508,6 +510,17 @@ void TrackerServer::DumpState() {
   if (events_ != nullptr)
     FDFS_LOG_INFO("event dump: %s",
                   events_->Json("tracker", cfg_.port).c_str());
+  // Thread ledger with heartbeat ages (threadreg.h): the SIGUSR1 face
+  // of the watchdog — "never" marks threads that don't beat.
+  std::string ledger;
+  for (const ThreadRegistry::HeartbeatEntry& hb :
+       ThreadRegistry::Global().Heartbeats()) {
+    if (!ledger.empty()) ledger += " ";
+    ledger += hb.name + "(" + std::to_string(hb.tid) + ")=";
+    ledger += hb.age_us < 0 ? std::string("never")
+                            : std::to_string(hb.age_us / 1000) + "ms";
+  }
+  FDFS_LOG_INFO("thread ledger: %s", ledger.c_str());
 }
 
 std::pair<uint8_t, std::string> TrackerServer::Handle(
@@ -556,6 +569,21 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       }
       if (!cluster_->Beat(group, ip, static_cast<int>(port), sp, nstats, now))
         return {2, ""};  // unknown: storage must re-JOIN
+      // Health trailer (common/healthmon.h): the append-only region
+      // past the pinned stat slots carries the reporter's own gray
+      // score plus its scores about its peers — one beat feeds one row
+      // of the N x N HEALTH_MATRIX.  Absent from older storages (and
+      // from beats before health has anything to say); a malformed
+      // trailer is ignored, never an error — health must not be able
+      // to break heartbeats.
+      size_t stats_end = 40 + 8 * static_cast<size_t>(kBeatStatCount);
+      if (body.size() > stats_end) {
+        BeatHealthTrailer ht;
+        if (ParseBeatHealthTrailer(body.data() + stats_end,
+                                   body.size() - stats_end, &ht))
+          cluster_->UpdateHealth(group, ip, static_cast<int>(port),
+                                 ht.self_score, ht.peers, now);
+      }
       auto peers = cluster_->Peers(group, ip + ":" + std::to_string(port));
       // Trailer: the group's elected trunk server (zeros when trunk is
       // off) — how every member learns where to RPC slot allocations.
@@ -925,6 +953,20 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       if (rc != 0) return {static_cast<uint8_t>(rc), ""};
       return {0, j};
     }
+
+    case TrackerCmd::kHealthMatrix:
+      // Gray-failure matrix (empty body -> JSON): every node's
+      // self-reported score vs the average of what its group peers
+      // score it, with the verdict against health_gray_threshold
+      // (monitor.decode_health_matrix; fdfs_codec health-matrix golden;
+      // cli.py health renderer).
+      if (!body.empty()) return {22 /*EINVAL*/, ""};
+      return {0,
+              "{\"role\":\"tracker\",\"port\":" + std::to_string(cfg_.port) +
+                  ",\"gray_threshold\":" +
+                  std::to_string(cfg_.health_gray_threshold) + ",\"nodes\":" +
+                  cluster_->HealthMatrixJson(now, cfg_.health_gray_threshold) +
+                  "}"};
 
     case TrackerCmd::kServerClusterStat: {
       // One-RPC observability dump: tracker role + every group/storage

@@ -82,6 +82,11 @@ struct TrackerConfig {
   // rate this daemon will arm.  0 (default) = profiler entirely off
   // (no signal handler, no slab; PROFILE_CTL answers ENOTSUP).
   int profile_max_hz = 0;
+  // Gray-failure health (ISSUE 17; OPERATIONS.md "Health, probes & gray
+  // failure"): the score below which HEALTH_MATRIX calls a node gray
+  // (peers score it under this while its own trailer claims healthy)
+  // or sick (its own score is under this).  Scores are 0..100.
+  int health_gray_threshold = 60;
 };
 
 class TrackerServer {

@@ -23,7 +23,8 @@ def test_stats_starts_zero_and_copies():
     s = c.stats()
     assert s == {"dedup_fallback_plain": 0,
                  "placement_fallback_tracker": 0,
-                 "ranged_fallback_single": 0}
+                 "ranged_fallback_single": 0,
+                 "dead_peer_skips": 0}
     s["dedup_fallback_plain"] = 99  # a snapshot, not the live dict
     assert c.stats()["dedup_fallback_plain"] == 0
 

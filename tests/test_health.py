@@ -1,0 +1,707 @@
+"""Gray-failure health layer (ISSUE 17).
+
+Layers:
+- pure-Python contract tests: HEALTH_STATUS / HEALTH_MATRIX decoding
+  (monitor.decode_health_status / decode_health_matrix), the fdfs_top
+  HEALTH line plumbing, the labeled fdfs_peer_* Prometheus families,
+  and the two new SLO rules;
+- client dead-peer backoff: the ConnectionPool cooldown map, tracker
+  failover ordering, and the stats()["dead_peer_skips"] counter
+  (no daemons needed — plain sockets);
+- cross-language goldens: `fdfs_codec health-status` (score formula,
+  EWMA rounding, beat-trailer byte layout, opcode -> op-class map) and
+  `fdfs_codec health-matrix` (the gray/sick/ok/unknown verdict rules
+  through the REAL tracker Cluster);
+- live acceptance: a healthy 3-node cluster converges to all-ok with
+  zero false positives; a SIGSTOPped storage (beats frozen, port still
+  accepting — the signature gray failure from the peers' view) is
+  flagged gray by the tracker matrix and `cli.py health` while its
+  group peers stay ok; an injected watchdog stall turns a node sick
+  with watchdog.stall events in EVENT_DUMP.
+
+Runs under TSan + FDFS_LOCKRANK via tools/run_sanitizers.sh (the
+monitor-side unit coverage is native: common_test's
+TestHealthMonitorScoresAndTrailer / TestThreadRegistryWatchdog).
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import struct
+import subprocess
+import time
+
+import pytest
+
+from fastdfs_tpu import monitor as M
+from fastdfs_tpu.common import protocol as P
+from tests.harness import (BUILD, STORAGED, TRACKERD, free_port,
+                           start_storage, start_tracker, upload_retry)
+
+_HAVE_TOOLCHAIN = ((shutil.which("cmake") is not None
+                    and shutil.which("ninja") is not None)
+                   or shutil.which("g++") is not None)
+_HAVE_BINARIES = os.path.exists(STORAGED) and os.path.exists(TRACKERD)
+needs_native = pytest.mark.skipif(
+    not (_HAVE_TOOLCHAIN or _HAVE_BINARIES),
+    reason="no native toolchain and no prebuilt daemons")
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+# Fast everything: 1 s probes and metrics ticks so the layer converges
+# within a test timeout instead of a deployment's minutes.
+HEALTH = (HB + "\nslo_eval_interval_s = 1"
+          + "\nhealth_probe_interval_s = 1"
+          + "\nwatchdog_stall_threshold_ms = 2000")
+
+
+def _wait(cond, timeout=30, interval=0.25):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(interval)
+    return cond()
+
+
+def _codec(*args):
+    exe = os.path.join(BUILD, "fdfs_codec")
+    if not os.path.exists(exe):
+        from tests.harness import ensure_native_built
+        ensure_native_built((exe,))
+    out = subprocess.run([exe, *args], capture_output=True, timeout=60)
+    assert out.returncode == 0, out.stderr.decode()
+    return out.stdout.decode()
+
+
+# ---------------------------------------------------------------------------
+# wire contract (pure Python)
+# ---------------------------------------------------------------------------
+
+def test_health_opcodes():
+    assert P.StorageCmd.HEALTH_STATUS == 146
+    assert P.TrackerCmd.HEALTH_MATRIX == 69
+    # The probe loop rides the upstream-fixed ACTIVE_TEST ping.
+    assert P.StorageCmd.ACTIVE_TEST == 111
+    assert P.TrackerCmd.ACTIVE_TEST == 111
+
+
+def _status_fixture() -> dict:
+    return {
+        "role": "storage", "port": 23000, "score": 50,
+        "stalled_threads": 1,
+        "probe": {"read_us": 1500, "write_us": 2500, "threshold_ms": 1000},
+        "peers": [
+            {"addr": "10.0.0.2:23000", "op": "beat", "score": 100,
+             "rpc_ewma_us": 2000, "error_pct": 0, "timeout_pct": 0,
+             "ops": 2, "errors": 0, "timeouts": 0, "age_s": 0},
+            {"addr": "10.0.0.2:23000", "op": "fetch", "score": 75,
+             "rpc_ewma_us": 50000, "error_pct": 20, "timeout_pct": 20,
+             "ops": 4, "errors": 1, "timeouts": 1, "age_s": 0},
+            {"addr": "10.0.0.9:23001", "op": "probe", "score": 88,
+             "rpc_ewma_us": 0, "error_pct": 20, "timeout_pct": 0,
+             "ops": 1, "errors": 1, "timeouts": 0, "age_s": 3},
+        ],
+    }
+
+
+def test_decode_health_status_roundtrip():
+    st = M.decode_health_status(_status_fixture())
+    assert (st.role, st.port, st.score, st.stalled_threads) == \
+        ("storage", 23000, 50, 1)
+    assert (st.probe_read_us, st.probe_write_us, st.probe_threshold_ms) == \
+        (1500, 2500, 1000)
+    assert [(p.addr, p.op, p.score) for p in st.peers] == [
+        ("10.0.0.2:23000", "beat", 100),
+        ("10.0.0.2:23000", "fetch", 75),
+        ("10.0.0.9:23001", "probe", 88)]
+    assert st.peers[1].rpc_ewma_us == 50000
+    assert (st.peers[1].ops, st.peers[1].errors, st.peers[1].timeouts) == \
+        (4, 1, 1)
+
+
+def test_decode_health_status_ignores_unknown_keys():
+    obj = _status_fixture()
+    obj["future_field"] = {"x": 1}  # append-only wire contract
+    obj["peers"][0]["future"] = 9
+    assert M.decode_health_status(obj).score == 50
+
+
+def test_decode_health_status_validation():
+    with pytest.raises(ValueError):
+        M.decode_health_status({"role": "storage"})  # no peers list
+    with pytest.raises(ValueError):
+        M.decode_health_status({"peers": [{"addr": "a"}]})  # malformed row
+    unsorted = _status_fixture()
+    unsorted["peers"] = list(reversed(unsorted["peers"]))
+    with pytest.raises(ValueError):
+        M.decode_health_status(unsorted)  # rows must be (addr, op)-sorted
+    bad = _status_fixture()
+    del bad["score"]
+    with pytest.raises(ValueError):
+        M.decode_health_status(bad)
+
+
+def _matrix_fixture() -> dict:
+    # The codec health-matrix fixture: one healthy node, one signature
+    # gray (claims 90, peers average 37), one self-admitted sick, one
+    # silent.
+    return {
+        "role": "tracker", "port": 22122, "gray_threshold": 60,
+        "nodes": [
+            {"group": "group1", "addr": "10.0.0.1:23000", "self": 100,
+             "peer_avg": 99, "reports": 2, "verdict": "ok", "age_s": 10,
+             "peers": {"10.0.0.2:23000": 40, "10.0.0.3:23000": 95}},
+            {"group": "group1", "addr": "10.0.0.2:23000", "self": 90,
+             "peer_avg": 37, "reports": 2, "verdict": "gray", "age_s": 8,
+             "peers": {"10.0.0.1:23000": 100, "10.0.0.3:23000": 92}},
+            {"group": "group1", "addr": "10.0.0.3:23000", "self": 30,
+             "peer_avg": 93, "reports": 2, "verdict": "sick", "age_s": 5,
+             "peers": {"10.0.0.1:23000": 98, "10.0.0.2:23000": 35}},
+            {"group": "group1", "addr": "10.0.0.4:23000", "self": -1,
+             "peer_avg": -1, "reports": 0, "verdict": "unknown",
+             "age_s": -1, "peers": {}},
+        ],
+    }
+
+
+def test_decode_health_matrix_roundtrip():
+    m = M.decode_health_matrix(_matrix_fixture())
+    assert (m.role, m.port, m.gray_threshold) == ("tracker", 22122, 60)
+    assert [n.verdict for n in m.nodes] == ["ok", "gray", "sick", "unknown"]
+    assert m.nodes[1].self_score == 90 and m.nodes[1].peer_avg == 37
+    assert m.nodes[1].peers == {"10.0.0.1:23000": 100, "10.0.0.3:23000": 92}
+    assert m.nodes[3].reports == 0 and m.nodes[3].age_s == -1
+
+
+def test_decode_health_matrix_validation():
+    with pytest.raises(ValueError):
+        M.decode_health_matrix({"role": "tracker"})  # no nodes list
+    bad = _matrix_fixture()
+    bad["nodes"][0]["verdict"] = "mauve"  # unknown verdict
+    with pytest.raises(ValueError):
+        M.decode_health_matrix(bad)
+    bad = _matrix_fixture()
+    del bad["gray_threshold"]
+    with pytest.raises(ValueError):
+        M.decode_health_matrix(bad)
+
+
+def test_default_slo_rules_cover_health():
+    names = [r[0] for r in M.DEFAULT_SLO_RULES]
+    assert "peer_rpc_p99_ms" in names
+    assert "probe_write_ms" in names
+    # Append-only: the slo-conf golden compares the two parsers line by
+    # line, so the new rules must sit at the END of the table.
+    assert names[-2:] == ["peer_rpc_p99_ms", "probe_write_ms"]
+
+
+# ---------------------------------------------------------------------------
+# fdfs_top HEALTH line + Prometheus peer families (pure Python)
+# ---------------------------------------------------------------------------
+
+def _health_registry() -> dict:
+    return {"counters": {}, "histograms": {}, "gauges": {
+        "health.score": 50,
+        "watchdog.stalled_threads": 1,
+        "peer.10.0.0.2:23000.score": 75,
+        "peer.10.0.0.2:23000.rpc_ewma_us": 50000,
+        "peer.10.0.0.2:23000.error_pct": 20,
+        "peer.10.0.0.2:23000.timeout_pct": 20,
+        "peer.10.0.0.9:23001.score": 88,
+        "peer.10.0.0.9:23001.rpc_ewma_us": 0,
+        "peer.10.0.0.9:23001.error_pct": 20,
+        "peer.10.0.0.9:23001.timeout_pct": 0,
+    }}
+
+
+def test_worst_peer_gauge():
+    assert M._worst_peer_gauge(_health_registry()) == ("10.0.0.2:23000", 75)
+    assert M._worst_peer_gauge({"gauges": {}}) is None
+    # Addresses contain dots and colons: prefix/suffix strip, not split.
+    reg = {"gauges": {"peer.2001:db8::1:23000.score": 42}}
+    assert M._worst_peer_gauge(reg) == ("2001:db8::1:23000", 42)
+
+
+def test_top_rates_health_fields_and_render():
+    cur = M.TopSample(ts=1700000000.0, nodes={
+        "storage a:1": M.NodeSample(role="storage", addr="a:1",
+                                    registry=_health_registry()),
+        "storage b:2": M.NodeSample(role="storage", addr="b:2",
+                                    registry={"counters": {}, "gauges": {},
+                                              "histograms": {}}),
+    })
+    rates = M.top_rates(None, cur)
+    assert rates["storage a:1"]["health_score"] == 50
+    assert rates["storage a:1"]["stalled_threads"] == 1
+    assert rates["storage a:1"]["worst_peer"] == ("10.0.0.2:23000", 75)
+    # No health gauges = the daemon predates the layer: None, not 100.
+    assert rates["storage b:2"]["health_score"] is None
+    frame = M.render_top(cur, rates, [])
+    assert "HEALTH:" in frame
+    assert "storage a:1: self=50 stalled=1 worst-peer=10.0.0.2:23000=75" \
+        in frame
+    assert "storage b:2: self=" not in frame  # skipped, not faked
+
+
+def test_prometheus_peer_families():
+    snap = M.ClusterSnapshot(
+        storage_stats={"127.0.0.1:23000": _health_registry()})
+    text = M.to_prometheus(snap)
+    # peer.* gauges become ONE labeled family per metric, not one
+    # mangled metric name per peer address.
+    assert ('fdfs_peer_score{storage="127.0.0.1:23000",'
+            'peer="10.0.0.2:23000"} 75') in text
+    assert ('fdfs_peer_rpc_ewma_us{storage="127.0.0.1:23000",'
+            'peer="10.0.0.2:23000"} 50000') in text
+    assert text.count("# TYPE fdfs_peer_score gauge") == 1
+    assert "fdfs_gauge_health_score" in text or \
+        "fdfs_health_score" in text
+    # No mangled per-address metric names leaked through.
+    assert "fdfs_peer_10_0_0_2" not in text
+
+
+# ---------------------------------------------------------------------------
+# client dead-peer backoff (ConnectionPool cooldown; no daemons)
+# ---------------------------------------------------------------------------
+
+def test_pool_dead_peer_cooldown_expires():
+    from fastdfs_tpu.client.conn import ConnectionPool
+    pool = ConnectionPool(dead_peer_cooldown=0.2)
+    assert not pool.is_dead("10.0.0.1", 23000)
+    pool.mark_dead("10.0.0.1", 23000)
+    assert pool.is_dead("10.0.0.1", 23000)
+    assert not pool.is_dead("10.0.0.1", 23001)  # per-endpoint
+    time.sleep(0.25)
+    assert not pool.is_dead("10.0.0.1", 23000)  # cooldown expired
+    # Disabled cooldown: mark_dead is a no-op.
+    off = ConnectionPool(dead_peer_cooldown=0)
+    off.mark_dead("10.0.0.1", 23000)
+    assert not off.is_dead("10.0.0.1", 23000)
+
+
+def test_pool_acquire_clears_dead_mark():
+    from fastdfs_tpu.client.conn import ConnectionPool
+    with socket.socket() as srv:
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        port = srv.getsockname()[1]
+        pool = ConnectionPool(dead_peer_cooldown=300)
+        pool.mark_dead("127.0.0.1", port)
+        assert pool.is_dead("127.0.0.1", port)
+        conn = pool.acquire("127.0.0.1", port, timeout=5)
+        try:
+            # A successful fresh connect is live proof: no cooldown wait.
+            assert not pool.is_dead("127.0.0.1", port)
+        finally:
+            conn.close()
+
+
+def test_client_tracker_failover_skips_dead_peer():
+    from fastdfs_tpu.client import FdfsClient
+    with socket.socket() as srv:
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(8)
+        live = srv.getsockname()[1]
+        dead = free_port()  # nothing listens here
+        cli = FdfsClient([f"127.0.0.1:{dead}", f"127.0.0.1:{live}"],
+                         timeout=5)
+        try:
+            cli.pool.mark_dead("127.0.0.1", dead)
+            # The dead tracker sorts last: the live one wins without a
+            # connect attempt, and the skip is counted.
+            for i in range(3):
+                t = cli._tracker()
+                port = t.conn.port
+                t.close()
+                assert port == live
+            assert cli.stats()["dead_peer_skips"] == 3
+            # ALL dead: the mark is advisory — the order is unchanged,
+            # every tracker is still tried, and the live one connects.
+            cli.pool.mark_dead("127.0.0.1", live)
+            t = cli._tracker()
+            port = t.conn.port
+            t.close()
+            assert port == live
+            assert cli.stats()["dead_peer_skips"] == 3  # no skip counted
+        finally:
+            cli.close()
+
+
+def test_client_marks_unreachable_tracker_dead():
+    from fastdfs_tpu.client import FdfsClient
+    with socket.socket() as srv:
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(8)
+        live = srv.getsockname()[1]
+        dead = free_port()
+        cli = FdfsClient([f"127.0.0.1:{dead}", f"127.0.0.1:{live}"],
+                         timeout=5)
+        try:
+            # Failover may or may not hit the dead tracker first (the
+            # start is random); drive until the connect failure has been
+            # seen and marked.
+            for _ in range(12):
+                t = cli._tracker()
+                t.close()
+                if cli.pool.is_dead("127.0.0.1", dead):
+                    break
+            assert cli.pool.is_dead("127.0.0.1", dead)
+            assert not cli.pool.is_dead("127.0.0.1", live)
+        finally:
+            cli.close()
+
+
+def test_client_conf_parses_dead_peer_cooldown(tmp_path):
+    from fastdfs_tpu.client import FdfsClient
+    conf = tmp_path / "client.conf"
+    conf.write_text("tracker_server = 127.0.0.1:22122\n"
+                    "dead_peer_cooldown_s = 7\n")
+    cli = FdfsClient.from_conf(str(conf))
+    try:
+        assert cli.pool.dead_peer_cooldown == 7.0
+        assert cli.stats()["dead_peer_skips"] == 0
+    finally:
+        cli.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-language goldens (fdfs_codec health-status / health-matrix —
+# golden coverage enforced by tools/fdfs_lint.py)
+# ---------------------------------------------------------------------------
+
+def _parse_trailer(raw: bytes) -> tuple[int, list[tuple[str, int]]]:
+    """Python mirror of ParseBeatHealthTrailer: 1B version + 8B BE self
+    + 8B BE n + n x (16B zero-padded ip + 8B BE port + 8B BE score)."""
+    assert raw[0] == 1, "trailer version"
+    self_score, n = struct.unpack_from(">qq", raw, 1)
+    peers = []
+    off = 17
+    for _ in range(n):
+        ip = raw[off:off + 16].split(b"\0", 1)[0].decode()
+        port, score = struct.unpack_from(">qq", raw, off + 16)
+        peers.append((f"{ip}:{port}", score))
+        off += 32
+    assert off == len(raw), "trailer length"
+    return self_score, peers
+
+
+@needs_native
+def test_health_status_golden():
+    out = _codec("health-status").splitlines()
+    st = M.decode_health_status(json.loads(out[0]))
+    # The fixture arithmetic, mirrored here by hand: fetch = 100 -
+    # round(0.2*60) - round(0.2*40) - 50ms latency = 75 (latency EWMA
+    # untouched by the failure); beat = 100; probe peer = 88 (errors
+    # only, no latency sample); self = 100 - 50 (one stall) - 0 (probes
+    # under threshold) = 50.
+    assert (st.role, st.port, st.score, st.stalled_threads) == \
+        ("storage", 23000, 50, 1)
+    assert (st.probe_read_us, st.probe_write_us, st.probe_threshold_ms) == \
+        (1500, 2500, 1000)
+    assert [(p.addr, p.op, p.score) for p in st.peers] == [
+        ("10.0.0.2:23000", "beat", 100),
+        ("10.0.0.2:23000", "fetch", 75),
+        ("10.0.0.9:23001", "probe", 88)]
+    fetch = st.peers[1]
+    assert (fetch.rpc_ewma_us, fetch.error_pct, fetch.timeout_pct) == \
+        (50000, 20, 20)
+    assert (fetch.ops, fetch.errors, fetch.timeouts) == (4, 1, 1)
+    probe = st.peers[2]
+    assert (probe.error_pct, probe.timeout_pct, probe.rpc_ewma_us) == \
+        (20, 0, 0)
+
+    lines = dict(l.split("=", 1) for l in out[1:] if "=" in l)
+    assert lines["self_score"] == "50"
+    assert out[2] == "peer_a=75 peer_b=88"
+    # The trailer bytes decode in Python with the documented layout and
+    # agree with the C++ parse-back printed below them.
+    self_score, peers = _parse_trailer(bytes.fromhex(lines["trailer"]))
+    assert self_score == 50
+    assert peers == [("10.0.0.2:23000", 75), ("10.0.0.9:23001", 88)]
+    assert "parsed=1 parsed_self=50" in out
+    assert [l for l in out if l.startswith("parsed_peer=")] == [
+        "parsed_peer=10.0.0.2:23000:75", "parsed_peer=10.0.0.9:23001:88"]
+    # Opcode -> op-class bucketing is part of the contract.
+    assert out[-1] == ("opclass_111=probe opclass_83=beat "
+                       "opclass_129=fetch opclass_145=ec "
+                       "opclass_16=sync opclass_11=rpc")
+
+
+@needs_native
+def test_health_matrix_golden():
+    m = M.decode_health_matrix(json.loads(_codec("health-matrix")))
+    assert (m.role, m.port, m.gray_threshold) == ("tracker", 22122, 60)
+    by_addr = {n.addr: n for n in m.nodes}
+    assert len(by_addr) == 4
+    # .1: healthy both ways.
+    n = by_addr["10.0.0.1:23000"]
+    assert (n.verdict, n.self_score, n.peer_avg, n.reports, n.age_s) == \
+        ("ok", 100, 99, 2, 10)  # (100 + 98) // 2
+    # .2: the signature gray — claims 90, peers average (40 + 35) // 2.
+    n = by_addr["10.0.0.2:23000"]
+    assert (n.verdict, n.self_score, n.peer_avg) == ("gray", 90, 37)
+    # .3: self-admitted sick beats the healthy peer view.
+    n = by_addr["10.0.0.3:23000"]
+    assert (n.verdict, n.self_score, n.peer_avg) == ("sick", 30, 93)
+    # .4: never reported and nobody scored it.
+    n = by_addr["10.0.0.4:23000"]
+    assert (n.verdict, n.self_score, n.peer_avg, n.reports, n.age_s) == \
+        ("unknown", -1, -1, 0, -1)
+    assert n.peers == {}
+    # Each node's row carries what IT said about its peers (the matrix'
+    # differential raw material).
+    assert by_addr["10.0.0.1:23000"].peers["10.0.0.2:23000"] == 40
+
+
+# ---------------------------------------------------------------------------
+# live acceptance
+# ---------------------------------------------------------------------------
+
+def _cluster(tmp, n=3, tracker_extra="health_gray_threshold = 60",
+             check_active=100):
+    """1 tracker + n storages in one group on loopback aliases, health
+    layer at test cadence (1 s probes/ticks, 1 s beats)."""
+    tr = start_tracker(os.path.join(tmp, "tr"), check_active=check_active,
+                       extra=tracker_extra)
+    taddr = f"127.0.0.1:{tr.port}"
+    sts = [start_storage(os.path.join(tmp, f"st{i}"), port=free_port(),
+                         ip=f"127.0.0.{71 + i}", trackers=[taddr],
+                         extra=HEALTH)
+           for i in range(n)]
+    return tr, taddr, sts
+
+
+def _matrix(taddr):
+    from fastdfs_tpu.client import FdfsClient
+    c = FdfsClient([taddr])
+    try:
+        return M.decode_health_matrix(c.health_matrix())
+    finally:
+        c.close()
+
+
+@needs_native
+def test_live_health_converges_all_ok(tmp_path):
+    """A healthy 3-node cluster converges to verdict ok on every node
+    with ZERO false positives: full self scores, peer reports flowing
+    through the beat trailer, probe gauges live, no watchdog/disk
+    events."""
+    from fastdfs_tpu.client import FdfsClient, StorageClient
+
+    tr, taddr, sts = _cluster(str(tmp_path))
+    cli = FdfsClient([taddr])
+    try:
+        upload_retry(cli, os.urandom(64 << 10), ext="bin")
+
+        def all_ok():
+            m = _matrix(taddr)
+            if len(m.nodes) != 3:
+                return None
+            if any(n.verdict != "ok" for n in m.nodes):
+                return None
+            # Peer reports must actually be flowing (not vacuous ok) —
+            # wait until EVERY node has been scored by some peer, not
+            # just the early reporters.
+            if any(n.reports < 1 for n in m.nodes):
+                return None
+            return m
+        m = _wait(all_ok, timeout=60)
+        assert m, [f"{n.addr}:{n.verdict}" for n in _matrix(taddr).nodes]
+        for n in m.nodes:
+            assert n.verdict == "ok"
+            assert n.self_score >= 60
+            assert 0 <= n.age_s <= 30
+        # Every node got scored by at least one peer within the window.
+        assert all(n.reports >= 1 for n in m.nodes), \
+            [(n.addr, n.reports) for n in m.nodes]
+
+        with StorageClient(sts[0].ip, sts[0].port) as sc:
+            st = M.decode_health_status(sc.health_status())
+            assert st.role == "storage" and st.score >= 60
+            assert st.stalled_threads == 0
+            assert st.probe_write_us > 0 and st.probe_read_us > 0
+            assert st.probe_threshold_ms == 1000
+            # The passive table saw real peers (probes at minimum).
+            assert st.peers, "no per-peer rows despite active probes"
+            assert all(p.score >= 60 for p in st.peers), \
+                [(p.addr, p.op, p.score) for p in st.peers]
+            # Health gauges flow through STAT for fdfs_top/Prometheus.
+            reg = M.decode_registry(sc.stat())
+            assert reg["gauges"].get("health.score") == st.score
+            assert reg["gauges"].get("watchdog.stalled_threads") == 0
+            assert any(k.startswith("peer.") and k.endswith(".score")
+                       for k in reg["gauges"]), reg["gauges"].keys()
+            # Zero false positives: no stall / gray-disk events fired.
+            evs = M.decode_events(sc.event_dump())
+            assert not [e for e in evs
+                        if e.type in ("watchdog.stall", "disk.gray")], evs
+    finally:
+        cli.close()
+        for st in sts:
+            st.stop()
+        tr.stop()
+
+
+@needs_native
+def test_live_gray_storage_flagged(tmp_path, capsys):
+    """The acceptance path: SIGSTOP one storage — its beat freezes at a
+    healthy self score while its peers' RPCs to it start timing out (the
+    kernel still completes handshakes on the listen backlog, so this IS
+    the gray shape: reachable but unresponsive).  The tracker matrix
+    flags exactly that node gray; `cli.py health` prints it; the two
+    healthy peers never leave ok (zero false positives)."""
+    from fastdfs_tpu.cli import main as cli_main
+    from fastdfs_tpu.client import FdfsClient
+
+    tr, taddr, sts = _cluster(str(tmp_path))
+    cli = FdfsClient([taddr])
+    victim = sts[2]
+    stopped = False
+    try:
+        upload_retry(cli, os.urandom(64 << 10), ext="bin")
+        # Healthy baseline first: the victim must have reported a good
+        # self score before the freeze (gray = claims fine, serves
+        # badly; without a baseline it would read unknown, not gray).
+        assert _wait(lambda: (m := _matrix(taddr))
+                     and len(m.nodes) == 3
+                     and all(n.verdict == "ok" for n in m.nodes) and m,
+                     timeout=60), \
+            [f"{n.addr}:{n.verdict}" for n in _matrix(taddr).nodes]
+
+        os.kill(victim.proc.pid, signal.SIGSTOP)
+        stopped = True
+        vaddr = f"{victim.ip}:{victim.port}"
+
+        def victim_gray():
+            m = _matrix(taddr)
+            by = {n.addr: n for n in m.nodes}
+            v = by.get(vaddr)
+            if v is None or v.verdict != "gray":
+                return None
+            return m
+        m = _wait(victim_gray, timeout=90, interval=1.0)
+        assert m, [f"{n.addr}:{n.verdict}/{n.peer_avg}"
+                   for n in _matrix(taddr).nodes]
+        by = {n.addr: n for n in m.nodes}
+        # The gray signature: frozen (stale-healthy) self report, peers
+        # scoring it under the threshold.
+        assert by[vaddr].self_score >= 60
+        assert 0 <= by[vaddr].peer_avg < 60
+        assert by[vaddr].reports >= 1
+        # Zero false positives: both live peers still read ok.
+        for st in sts[:2]:
+            n = by[f"{st.ip}:{st.port}"]
+            assert n.verdict == "ok", (n.addr, n.verdict, n.peer_avg)
+        # The operator view agrees: `cli.py health` leads with the gray
+        # node (worst-verdict-first sort) and marks exactly one gray.
+        assert cli_main(["health", taddr]) == 0
+        out = capsys.readouterr().out
+        assert out.count("gray ") >= 1
+        rows = [l for l in out.splitlines() if l.startswith("group1/")]
+        assert rows and vaddr in rows[0] and " gray" in rows[0], out
+    finally:
+        if stopped:
+            os.kill(victim.proc.pid, signal.SIGCONT)
+        cli.close()
+        for st in sts:
+            st.stop()
+        tr.stop()
+
+
+@needs_native
+def test_live_cli_health_renders_matrix(tmp_path, capsys):
+    """`cli.py health` end-to-end: the matrix table renders with ok
+    verdicts, --detail adds per-node HEALTH_STATUS blocks, --json emits
+    the machine view decode_health_matrix accepts."""
+    from fastdfs_tpu.cli import main as cli_main
+    from fastdfs_tpu.client import FdfsClient
+
+    tr, taddr, sts = _cluster(str(tmp_path), n=2)
+    cli = FdfsClient([taddr])
+    try:
+        upload_retry(cli, os.urandom(16 << 10), ext="bin")
+        assert _wait(lambda: (m := _matrix(taddr)) and len(m.nodes) == 2
+                     and all(n.verdict == "ok" for n in m.nodes),
+                     timeout=60)
+        assert cli_main(["health", taddr]) == 0
+        out = capsys.readouterr().out
+        assert "gray threshold: 60" in out
+        assert out.count(" ok ") >= 2 or out.count("ok") >= 2
+        for st in sts:
+            assert f"group1/{st.ip}:{st.port}" in out
+        assert cli_main(["health", taddr, "--detail"]) == 0
+        out = capsys.readouterr().out
+        assert "probe read=" in out and "stalled=0" in out
+        assert cli_main(["health", taddr, "--json"]) == 0
+        m = M.decode_health_matrix(
+            json.loads(capsys.readouterr().out)["matrix"])
+        assert len(m.nodes) == 2
+    finally:
+        cli.close()
+        for st in sts:
+            st.stop()
+        tr.stop()
+
+
+@needs_native
+def test_live_watchdog_stall_turns_node_sick(tmp_path):
+    """watchdog_inject_stall_ms end-to-end: the injected stall is
+    counted in watchdog.stalled_threads, recorded as a watchdog.stall
+    event, drops the self score to 50, and the tracker verdict goes
+    sick — the self-admitted failure mode, distinct from gray."""
+    from fastdfs_tpu.client import StorageClient
+
+    tmp = str(tmp_path)
+    tr = start_tracker(os.path.join(tmp, "tr"),
+                       extra="health_gray_threshold = 60")
+    taddr = f"127.0.0.1:{tr.port}"
+    # A 10-minute injected stall: past the 2 s threshold it stays
+    # stalled for the whole test — no flapping between scans.
+    st = start_storage(os.path.join(tmp, "st"), port=free_port(),
+                       trackers=[taddr],
+                       extra=HEALTH + "\nwatchdog_inject_stall_ms = 600000")
+    try:
+        with StorageClient("127.0.0.1", st.port) as sc:
+            def stalled():
+                reg = M.decode_registry(sc.stat())
+                return reg["gauges"].get("watchdog.stalled_threads", 0) >= 1
+            assert _wait(stalled, timeout=30)
+            hs = M.decode_health_status(sc.health_status())
+            assert hs.stalled_threads >= 1
+            assert hs.score <= 50
+            evs = M.decode_events(sc.event_dump())
+            stalls = [e for e in evs if e.type == "watchdog.stall"]
+            assert stalls, [e.type for e in evs]
+            assert stalls[0].key == "debug.stall"
+            assert stalls[0].severity == "warn"
+            # One event per outage, not one per scan tick.
+            time.sleep(3)
+            evs = M.decode_events(sc.event_dump())
+            assert len([e for e in evs if e.type == "watchdog.stall"
+                        and e.key == "debug.stall"]) == 1
+
+        def sick():
+            m = _matrix(taddr)
+            by = {n.addr: n for n in m.nodes}
+            v = by.get(f"127.0.0.1:{st.port}")
+            return v is not None and v.verdict == "sick"
+        assert _wait(sick, timeout=30), \
+            [f"{n.addr}:{n.verdict}/{n.self_score}"
+             for n in _matrix(taddr).nodes]
+
+        # SIGUSR1 DumpState prints the thread ledger with heartbeat
+        # ages — the injected thread shows up by name.
+        os.kill(st.proc.pid, signal.SIGUSR1)
+        assert _wait(lambda: "debug.stall" in st.stderr_text, timeout=10), \
+            st.stderr_text[-2000:]
+    finally:
+        st.stop()
+        tr.stop()
+
+
+if __name__ == "__main__":
+    import sys
+    pytest.main([__file__, "-v"])

@@ -434,7 +434,8 @@ def test_manifest_golden_names_resolve():
                        "event-json", "scrub-status", "ingest-wire",
                        "metrics-history", "heat-top", "placement-wire",
                        "group-admin", "profile-ctl", "profile-json",
-                       "ec-status", "ec-stripe-layout"}
+                       "ec-status", "ec-stripe-layout",
+                       "health-status", "health-matrix"}
 
 
 if __name__ == "__main__":

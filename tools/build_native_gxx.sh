@@ -32,7 +32,7 @@ srcs_common="common/bytes.cc common/cdc.cc common/fileid.cc common/ini.cc
   common/lockrank.cc common/log.cc common/net.cc common/req_server.cc
   common/stats.cc common/trace.cc common/eventlog.cc common/metrog.cc
   common/sloeval.cc common/heatsketch.cc common/fsutil.cc
-  common/threadreg.cc common/profiler.cc
+  common/threadreg.cc common/profiler.cc common/healthmon.cc
   common/http_token.cc"
 srcs_storage="storage/chunkstore.cc storage/slabstore.cc storage/ecstore.cc
   storage/config.cc storage/store.cc
@@ -68,6 +68,7 @@ link tracker/main.cc "$BUILD_DIR/obj/libfdfs_tracker.a" \
 link tools/codec_cli.cc "$BUILD_DIR/obj/storage_slabstore.o" \
   "$BUILD_DIR/obj/storage_ecstore.o" \
   "$BUILD_DIR/obj/tracker_placement.o" \
+  "$BUILD_DIR/obj/tracker_cluster.o" \
   "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/fdfs_codec" &
 link tools/load_cli.cc "$BUILD_DIR/obj/libfdfs_common.a" \
   -o "$BUILD_DIR/fdfs_load" &
