@@ -59,10 +59,13 @@ NOMINAL = {1: 1 << 30, 2: 10 << 30, 3: 50 << 30, 4: 100 << 30,
            # config10: ISSUE 11 multi-group open-loop corpus (64 KB files).
            10: 4 << 30,
            # config11: ISSUE 16 erasure-coded cold tier (256 KB files).
-           11: 2 << 30}
+           11: 2 << 30,
+           # config12: ISSUE 18 serving-edge open-loop corpus (256 KB
+           # files, 4 KB chunks, cache off).
+           12: 2 << 30}
 DEFAULT_SCALE = {1: 0.25, 2: 1 / 32.0, 3: 1 / 64.0, 4: 1 / 40.0,
                  5: 1 / 2000.0, 6: 1 / 256.0, 7: 1 / 256.0, 8: 1 / 64.0,
-                 9: 0.1, 10: 1 / 64.0, 11: 1 / 256.0}
+                 9: 0.1, 10: 1 / 64.0, 11: 1 / 256.0, 12: 1 / 128.0}
 
 
 def emit(out_dir: str, config: int, payload: dict) -> None:
@@ -2051,10 +2054,313 @@ def config11(out_dir: str, scale: float) -> None:
     })
 
 
+def config12(out_dir: str, scale: float) -> None:
+    """Serving-edge concurrency (ISSUE 18): the same open-loop download
+    load offered to a 1-reactor and a 4-reactor daemon (SO_REUSEPORT
+    sharded accept), each driven by a single shared storage connection
+    (`fdfs_load --conns 1`) and by a multiplexed pool (`--conns
+    <threads>`).  The offered rates are calibrated once — 40% and 70%
+    of the 1-reactor arm's closed-loop QPS — and replayed open-loop
+    against every (reactors x client) cell, so schedule lateness lands
+    in the percentiles (no coordinated omission).  The corpus is
+    4 KB-chunked 256 KB files with the read cache off, so every
+    download walks the cold recipe path and the vectored pread batcher
+    must show dio.preadv_spans > dio.preadv_batches.  Alongside the
+    latency table the artifact records: a held-socket burst sampling
+    the per-reactor nio.conns.<i> gauges (the kernel's accept spread
+    must keep every reactor within 2x of the mean, no reactor idle);
+    the fdfs_load pool's own budget evidence (conns_peak == budget for
+    --conns 1); a byte-identity sweep through the Python client's
+    parallel ranged downloader under a 2-conn endpoint cap (zero wrong
+    bytes, zero single-stream fallbacks); and a flamegraph pair —
+    `cli.py profile` folded stacks captured MID-LOAD on each arm,
+    written next to this artifact as config12_reactors{1,4}.folded
+    with the live-conn dispersion sampled during the capture window,
+    so each flamegraph reads against how spread the serving actually
+    was while it sampled.
+    """
+    import socket as socketlib
+
+    from harness import BUILD, free_port, start_storage, start_tracker
+
+    from fastdfs_tpu.client.client import FdfsClient
+    from fastdfs_tpu.client.storage_client import StorageClient
+
+    file_bytes = 256 * 1024
+    n_files = max(int(NOMINAL[12] * scale) // file_bytes, 24)
+    n_ops = n_files * 4
+    # Load workers are blocking network clients, not CPU burners: floor
+    # at 4 even on a small host, or the multiplexed arm (--conns
+    # <threads>) degenerates into the single-conn arm.
+    threads = min(max(os.cpu_count() or 1, 4), 8)
+    reactors_hi = 4
+    burst_conns = 64
+    profile_hz = 97
+    profile_seconds = 3
+    fdfs_load = os.path.join(BUILD, "fdfs_load")
+    daemon_conf = (HB
+                   + "\ndedup_chunk_threshold = 4K"   # 256 KB => ~64 chunks
+                   + "\nread_cache_mb = 0"            # force the cold path
+                   + "\nprofile_max_hz = 200")
+
+    def run_load(*args):
+        """Run fdfs_load and hand back its pool-stats line (the
+        `{"conns_budget": ...}` JSON fdfs_load prints on stdout after
+        the workers join)."""
+        out = subprocess.run([fdfs_load, *args], capture_output=True,
+                             timeout=3600)
+        assert out.returncode == 0, out.stderr.decode()
+        conns = None
+        for line in out.stdout.decode().splitlines():
+            if line.startswith('{"conns_budget"'):
+                conns = json.loads(line)
+        return conns
+
+    def combine(*result_files):
+        out = subprocess.run([fdfs_load, "combine", *result_files],
+                             capture_output=True, timeout=600)
+        assert out.returncode == 0, out.stderr.decode()
+        return json.loads(out.stdout.decode())
+
+    def daemon_stat(st):
+        with StorageClient(st.ip, st.port) as sc:
+            return sc.stat()
+
+    def reactor_family(gauges, prefix):
+        # nio.conns.0, nio.conns.1, ... -> {0: v0, 1: v1, ...}
+        out = {}
+        for name, v in gauges.items():
+            if name.startswith(prefix) and name[len(prefix):].isdigit():
+                out[int(name[len(prefix):])] = v
+        return out
+
+    os.makedirs(out_dir, exist_ok=True)
+    results = {}
+    rates: list[float] = []
+    budget_ok = True
+    wrong_bytes = 0
+    for reactors in (1, reactors_hi):
+        arm = f"reactors{reactors}"
+        tmp = tempfile.mkdtemp(prefix=f"fdfs_cfg12_{arm}_")
+        tr = start_tracker(os.path.join(tmp, "tr"))
+        taddr = f"127.0.0.1:{tr.port}"
+        st = start_storage(os.path.join(tmp, "st"), port=free_port(),
+                           trackers=[taddr], dedup_mode="cpu",
+                           extra=daemon_conf
+                           + f"\nwork_threads = {reactors}")
+        cli = FdfsClient([taddr])
+        try:
+            _upload_retry(cli, b"warmup " * 64)
+            up_res = os.path.join(tmp, "up.result")
+            run_load("upload", taddr, str(n_files), str(file_bytes),
+                     str(threads), up_res)
+            preload = combine(up_res)
+            assert preload["errors"] == 0, preload
+            ids_path = up_res + ".ids"
+            if not rates:
+                # Calibrate once, on the 1-reactor arm's closed-loop
+                # capacity; every cell then replays the SAME rates.
+                cal_res = os.path.join(tmp, "cal.result")
+                run_load("download", taddr, ids_path, str(n_ops),
+                         str(threads), cal_res)
+                cal = combine(cal_res)
+                assert cal["errors"] == 0, cal
+                rates = [max(round(cal["qps"] * f, 1), 1.0)
+                         for f in (0.4, 0.7)]
+            clients = {}
+            for client_name, budget in (("single_conn", 1),
+                                        ("multiplexed", threads)):
+                sweep = []
+                for rate in rates:
+                    res = os.path.join(tmp, f"{client_name}_{rate}.result")
+                    conns = run_load("download", taddr, ids_path,
+                                     str(n_ops), str(threads), res,
+                                     "--conns", str(budget),
+                                     "--open-loop", "--rate", str(rate))
+                    agg = combine(res)
+                    assert agg["errors"] == 0, agg
+                    # --conns 1 serializes the storage edge: the pool
+                    # must never open a second conn, whatever the rate.
+                    budget_ok = budget_ok and (
+                        conns is not None
+                        and conns["conns_budget"] == budget
+                        and conns["conns_peak"] <= budget
+                        and (budget != 1 or conns["conns_peak"] == 1))
+                    sweep.append({"offered_rate_qps": rate,
+                                  "qps": agg["qps"],
+                                  "lat_p50_us": agg["lat_p50_us"],
+                                  "lat_p99_us": agg["lat_p99_us"],
+                                  "errors": agg["errors"],
+                                  "pool": conns})
+                clients[client_name] = sweep
+
+            # Byte identity through the multiplexed ranged client: the
+            # parallel downloader under a 2-conn endpoint cap must
+            # produce exactly the single-stream bytes, with zero
+            # single-stream fallbacks (the cap waits, it never breaks
+            # the ranged plan).
+            ver = FdfsClient([taddr], parallel_downloads=4,
+                             download_range_bytes=64 * 1024,
+                             max_conns_per_endpoint=2)
+            with open(ids_path) as fh:
+                ids = [ln.strip() for ln in fh if ln.strip()]
+            arm_wrong = 0
+            for fid in ids[:min(len(ids), 24)]:
+                base = cli.download_to_buffer(fid)
+                if (len(base) != file_bytes
+                        or ver.download_to_buffer(fid) != base):
+                    arm_wrong += 1
+            ranged_fallbacks = ver.stats()["ranged_fallback_single"]
+            ver.close()
+            wrong_bytes += arm_wrong
+
+            # Accept-spread probe: hold a burst of raw sockets and read
+            # the per-reactor live-conn gauges.  With SO_REUSEPORT the
+            # kernel hashes the 4-tuple, so "within 2x of the mean and
+            # no reactor idle" is the fair-spread bar (the exact split
+            # is the kernel's dice).
+            probes = [socketlib.create_connection((st.ip, st.port),
+                                                  timeout=10)
+                      for _ in range(burst_conns)]
+            try:
+                time.sleep(0.5)  # fallback-mode adoption is a Post
+                g = daemon_stat(st)["gauges"]
+            finally:
+                for s in probes:
+                    s.close()
+            conns_per = reactor_family(g, "nio.conns.")
+            accepts_per = reactor_family(g, "nio.accepts.")
+            vals = list(conns_per.values())
+            mean = sum(vals) / max(len(vals), 1)
+            spread_ok = (len(vals) == reactors
+                         and all(v > 0 for v in vals)
+                         and max(vals) <= 2 * mean)
+
+            # Flamegraph pair: arm the in-daemon sampler THROUGH the
+            # CLI while an open-loop run is in flight, and record the
+            # live-conn dispersion sampled inside the capture window —
+            # the folded stacks only mean something next to how spread
+            # the serving was while SIGPROF ticked.
+            flame_rate = rates[-1]
+            flame_ops = max(int(flame_rate * 8), n_ops)
+            bg = subprocess.Popen(
+                [fdfs_load, "download", taddr, ids_path, str(flame_ops),
+                 str(threads), os.path.join(tmp, "flame.result"),
+                 "--conns", str(threads),
+                 "--open-loop", "--rate", str(flame_rate)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            try:
+                time.sleep(0.5)
+                env = dict(os.environ)
+                env["PYTHONPATH"] = (REPO + os.pathsep
+                                     + env.get("PYTHONPATH", ""))
+                prof = subprocess.run(
+                    [sys.executable, "-m", "fastdfs_tpu.cli", "profile",
+                     taddr, f"{st.ip}:{st.port}",
+                     "--hz", str(profile_hz),
+                     "--seconds", str(profile_seconds)],
+                    capture_output=True, timeout=120, env=env)
+                # The open-loop schedule keeps the load alive past the
+                # capture deadline, so this sample still sees it.
+                disp = reactor_family(daemon_stat(st)["gauges"],
+                                      "nio.conns.")
+            finally:
+                bg.wait(timeout=600)
+            assert bg.returncode == 0
+            assert prof.returncode == 0, prof.stderr.decode()
+            folded = prof.stdout.decode()
+            flame_name = f"config12_{arm}.folded"
+            with open(os.path.join(out_dir, flame_name), "w") as fh:
+                fh.write(folded)
+            samples = sum(int(ln.rsplit(" ", 1)[1])
+                          for ln in folded.splitlines() if " " in ln)
+
+            ctr = daemon_stat(st)["counters"]
+            results[arm] = {
+                "reactors": reactors,
+                "reuseport_active": g.get("nio.reuseport_active", 0),
+                "preload": preload,
+                "clients": clients,
+                "ranged_verify": {
+                    "files": min(len(ids), 24),
+                    "wrong": arm_wrong,
+                    "ranged_fallbacks": ranged_fallbacks,
+                },
+                "accept_burst": {
+                    "held_sockets": burst_conns,
+                    "conns_per_reactor": conns_per,
+                    "accepts_per_reactor": accepts_per,
+                    "spread_within_2x": spread_ok,
+                },
+                "preadv": {
+                    "batches": ctr.get("dio.preadv_batches", 0),
+                    "spans": ctr.get("dio.preadv_spans", 0),
+                    "spans_per_batch": round(
+                        ctr.get("dio.preadv_spans", 0)
+                        / max(ctr.get("dio.preadv_batches", 0), 1), 2),
+                },
+                "flamegraph": {
+                    "folded_file": flame_name,
+                    "hz": profile_hz,
+                    "seconds": profile_seconds,
+                    "samples": samples,
+                    "stacks": len(folded.splitlines()),
+                    "capture_note": (
+                        f"captured mid-load at {flame_rate} q/s "
+                        f"(--conns {threads}); live conns per reactor "
+                        f"sampled inside the window: {disp}"),
+                },
+            }
+        finally:
+            cli.close()
+            st.stop()
+            tr.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    hi = results[f"reactors{reactors_hi}"]
+    lo = results["reactors1"]
+    top = len(rates) - 1
+    emit(out_dir, 12, {
+        "description": "serving-edge concurrency: open-loop download "
+                       "p99 vs offered rate (40%/70% of the 1-reactor "
+                       "closed-loop QPS) across 1 vs 4 accept reactors "
+                       "and single vs multiplexed client connections, "
+                       "with accept-spread, preadv-coalescing, "
+                       "byte-identity, and mid-load flamegraph "
+                       "evidence per arm",
+        "nominal_bytes": NOMINAL[12],
+        "scaled_bytes": n_files * file_bytes,
+        "files": n_files,
+        "file_bytes": file_bytes,
+        "open_loop_ops": n_ops,
+        "threads": threads,
+        "host_cpus": os.cpu_count() or 1,
+        "offered_rates_qps": rates,
+        "arms": results,
+        "zero_errors": all(
+            cell["errors"] == 0
+            for r in results.values()
+            for sweep in r["clients"].values()
+            for cell in sweep),
+        "wrong_bytes": wrong_bytes,
+        "conn_budget_honored": budget_ok,
+        "accept_spread_within_2x": hi["accept_burst"]["spread_within_2x"],
+        "preadv_spans_exceed_batches": all(
+            r["preadv"]["spans"] > r["preadv"]["batches"] > 0
+            for r in results.values()),
+        "p99_multiplexed_vs_single_4r": round(
+            hi["clients"]["multiplexed"][top]["lat_p99_us"]
+            / max(hi["clients"]["single_conn"][top]["lat_p99_us"], 1), 3),
+        "p99_4r_vs_1r_multiplexed": round(
+            hi["clients"]["multiplexed"][top]["lat_p99_us"]
+            / max(lo["clients"]["multiplexed"][top]["lat_p99_us"], 1), 3),
+    })
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=0,
-                    help="which config (1-11); 0 = all")
+                    help="which config (1-12); 0 = all")
     ap.add_argument("--scale", type=float, default=None,
                     help="fraction of the nominal corpus size")
     ap.add_argument("--full", action="store_true",
@@ -2064,8 +2370,8 @@ def main() -> None:
 
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11}
-    which = [args.config] if args.config else list(range(1, 12))
+           11: config11, 12: config12}
+    which = [args.config] if args.config else list(range(1, 13))
     for c in which:
         scale = 1.0 if args.full else (
             args.scale if args.scale is not None else DEFAULT_SCALE[c])

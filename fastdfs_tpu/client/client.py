@@ -33,7 +33,9 @@ class FdfsClient:
                  parallel_downloads: int = 1,
                  download_range_bytes: int = 4 << 20,
                  use_placement: bool = False,
-                 dead_peer_cooldown_s: float = 30.0):
+                 dead_peer_cooldown_s: float = 30.0,
+                 max_conns_per_endpoint: int = 0,
+                 pool_idle_ttl_s: float = 300.0):
         if isinstance(tracker_addrs, str):
             tracker_addrs = [tracker_addrs]
         if not tracker_addrs:
@@ -47,7 +49,16 @@ class FdfsClient:
         # failed at the transport level are deprioritized for
         # dead_peer_cooldown_s so each operation does not re-pay a
         # connect timeout against the same silent peer.
-        self.pool = (ConnectionPool(dead_peer_cooldown=dead_peer_cooldown_s)
+        # Multiplexing (ISSUE 18): max_conns_per_endpoint bounds idle +
+        # in-use per (host, port) — concurrent borrowers (parallel
+        # ranged downloads, threaded callers) grow the pool under load
+        # up to the cap instead of serializing through one socket —
+        # and pool_idle_ttl_s ages parked sockets out even for
+        # endpoints that left the cluster.
+        self.pool = (ConnectionPool(dead_peer_cooldown=dead_peer_cooldown_s,
+                                    max_conns_per_endpoint=int(
+                                        max_conns_per_endpoint),
+                                    max_idle_seconds=float(pool_idle_ttl_s))
                      if use_pool else None)
         # Distributed tracing: a fastdfs_tpu.trace.Tracer (or None).
         # While set, every tracker/storage connection this client
@@ -111,7 +122,11 @@ class FdfsClient:
                        cfg.get_bytes("download_range_bytes", 4 << 20)),
                    use_placement=bool(cfg.get_bool("use_placement", False)),
                    dead_peer_cooldown_s=float(
-                       cfg.get_seconds("dead_peer_cooldown_s", 30)))
+                       cfg.get_seconds("dead_peer_cooldown_s", 30)),
+                   max_conns_per_endpoint=int(
+                       cfg.get("max_conns_per_endpoint", 0)),
+                   pool_idle_ttl_s=float(
+                       cfg.get_seconds("pool_idle_ttl_s", 300)))
 
     def close(self) -> None:
         if self.pool is not None:

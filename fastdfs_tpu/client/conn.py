@@ -255,44 +255,121 @@ class ConnectionPool:
     endpoint instead of paying a connect timeout per operation.  The
     mark is advisory — callers with no alternative still connect, and a
     successful fresh connect clears it early.
+
+    Multiplexing (ISSUE 18): the pool tracks in-use connections per
+    endpoint; ``max_conns_per_endpoint`` caps idle + in-use together
+    (0 = unbounded).  At the cap, ``acquire`` waits up to
+    ``cap_wait_seconds`` for a release before connecting over the cap
+    anyway (recorded in ``cap_overflows``) — a soft bound, so a leaked
+    borrow degrades to the uncapped behavior instead of deadlocking a
+    download.
+
+    Hygiene (ISSUE 18): a time-gated ``sweep`` — run from ``release``
+    and ``acquire``, or called directly — closes idle connections past
+    ``max_idle_seconds`` and drops expired ``_dead`` marks even for
+    endpoints no caller ever touches again (peers that left the
+    cluster), and ``max_idle_total`` caps the pool-wide parked count by
+    evicting the oldest idle connection across all endpoints.
     """
 
     def __init__(self, max_idle_per_endpoint: int = 8,
                  max_idle_seconds: float = 300.0,
-                 dead_peer_cooldown: float = 30.0):
+                 dead_peer_cooldown: float = 30.0,
+                 max_conns_per_endpoint: int = 0,
+                 max_idle_total: int = 64,
+                 cap_wait_seconds: float = 5.0,
+                 sweep_interval: float = 5.0):
         self.max_idle_per_endpoint = max_idle_per_endpoint
         self.max_idle_seconds = max_idle_seconds
         self.dead_peer_cooldown = dead_peer_cooldown
+        self.max_conns_per_endpoint = max_conns_per_endpoint
+        self.max_idle_total = max_idle_total
+        self.cap_wait_seconds = cap_wait_seconds
+        self.sweep_interval = sweep_interval
         self._idle: dict[tuple[str, int], deque] = {}
         self._dead: dict[tuple[str, int], float] = {}
+        self._in_use: dict[tuple[str, int], int] = {}
         self._lock = threading.Lock()
+        self._released = threading.Condition(self._lock)
+        self._last_sweep = time.monotonic()
         self.hits = 0
         self.misses = 0
+        self.cap_overflows = 0
+        self.swept_idle = 0
 
     def acquire(self, host: str, port: int,
                 timeout: float = 30.0) -> Connection:
-        now = time.monotonic()
+        self._maybe_sweep()
+        key = (host, port)
+        deadline = None
         while True:
+            now = time.monotonic()
             with self._lock:
-                q = self._idle.get((host, port))
+                q = self._idle.get(key)
                 entry = q.popleft() if q else None
+                if entry is not None:
+                    self._in_use[key] = self._in_use.get(key, 0) + 1
+                elif (self.max_conns_per_endpoint > 0 and
+                      self._in_use.get(key, 0) >=
+                      self.max_conns_per_endpoint):
+                    # At the cap with nothing parked: wait for a release
+                    # (bounded), then overflow rather than deadlock.
+                    if deadline is None:
+                        deadline = now + max(0.0, self.cap_wait_seconds)
+                    remaining = deadline - time.monotonic()
+                    if remaining > 0:
+                        self._released.wait(remaining)
+                        continue
+                    self.cap_overflows += 1
+                    self._in_use[key] = self._in_use.get(key, 0) + 1
+                else:
+                    self._in_use[key] = self._in_use.get(key, 0) + 1
             if entry is None:
                 break
             conn, parked_at = entry
             if now - parked_at > self.max_idle_seconds or not _quiet(conn):
                 conn.close()
+                with self._lock:
+                    # The dead parked conn is not a borrow; retry the
+                    # idle queue without double-counting.
+                    self._dec_in_use(key)
                 continue
             with self._lock:
                 self.hits += 1
             return conn
         with self._lock:
             self.misses += 1
-        conn = Connection(host, port, timeout)
+        try:
+            conn = Connection(host, port, timeout)
+        except OSError:
+            with self._lock:
+                self._dec_in_use(key)
+            raise
         # A fresh connect succeeding is live proof: clear any cooldown
         # early rather than waiting out the timer.
         with self._lock:
-            self._dead.pop((host, port), None)
+            self._dead.pop(key, None)
         return conn
+
+    def _dec_in_use(self, key: tuple[str, int]) -> None:
+        # _lock held.  Floor at zero: a double release (or a release of
+        # a connection acquired before a pool reconfigure) must never
+        # wedge the cap accounting negative.
+        n = self._in_use.get(key, 0) - 1
+        if n > 0:
+            self._in_use[key] = n
+        else:
+            self._in_use.pop(key, None)
+        self._released.notify()
+
+    def in_use_count(self, host: str | None = None,
+                     port: int | None = None) -> int:
+        """Borrowed (not yet released) connections — one endpoint when
+        given, pool-wide otherwise."""
+        with self._lock:
+            if host is not None:
+                return self._in_use.get((host, port), 0)
+            return sum(self._in_use.values())
 
     # -- dead-peer backoff -------------------------------------------------
 
@@ -320,16 +397,73 @@ class ConnectionPool:
 
     def release(self, conn: Connection) -> None:
         conn.trace_ctx = None  # a parked conn must not carry a stale trace
+        key = (conn.host, conn.port)
         if conn.broken:
             conn.close()
+            with self._lock:
+                self._dec_in_use(key)
+            self._maybe_sweep()
             return
-        key = (conn.host, conn.port)
+        to_close = []
         with self._lock:
+            self._dec_in_use(key)
             q = self._idle.setdefault(key, deque())
+            if any(c is conn for c, _ in q):
+                # Double release: parking the same connection twice
+                # would hand one socket to two future borrowers.  The
+                # deque is bounded (max_idle_per_endpoint), so the scan
+                # is O(8).
+                return
             if len(q) >= self.max_idle_per_endpoint:
-                oldest, _ = q.popleft()
-                oldest.close()
+                to_close.append(q.popleft()[0])
             q.append((conn, time.monotonic()))
+            # Pool-wide idle cap: evict the globally oldest parked conn
+            # so one hot endpoint cannot strand dozens of sockets on
+            # endpoints that went quiet.
+            while (self.max_idle_total > 0 and
+                   sum(len(d) for d in self._idle.values()) >
+                   self.max_idle_total):
+                oldest_key = min(
+                    (k for k, d in self._idle.items() if d),
+                    key=lambda k: self._idle[k][0][1])
+                to_close.append(self._idle[oldest_key].popleft()[0])
+                if not self._idle[oldest_key]:
+                    del self._idle[oldest_key]
+        for old in to_close:
+            old.close()
+        self._maybe_sweep()
+
+    # -- hygiene (ISSUE 18) ------------------------------------------------
+
+    def _maybe_sweep(self) -> None:
+        with self._lock:
+            due = (time.monotonic() - self._last_sweep
+                   >= self.sweep_interval)
+        if due:
+            self.sweep()
+
+    def sweep(self, now: float | None = None) -> None:
+        """Close idle connections past their TTL and drop expired
+        ``_dead`` marks — including for endpoints that left the cluster
+        and will never be acquired again (the leak this fixes: TTLs
+        were previously only checked at acquire time)."""
+        if now is None:
+            now = time.monotonic()
+        to_close = []
+        with self._lock:
+            self._last_sweep = now
+            for key in list(self._idle):
+                q = self._idle[key]
+                while q and now - q[0][1] > self.max_idle_seconds:
+                    to_close.append(q.popleft()[0])
+                if not q:
+                    del self._idle[key]
+            for key in list(self._dead):
+                if now >= self._dead[key]:
+                    del self._dead[key]
+            self.swept_idle += len(to_close)
+        for conn in to_close:
+            conn.close()
 
     def purge(self, host: str, port: int) -> None:
         """Drop every idle connection to one endpoint (called after an
@@ -351,6 +485,12 @@ class ConnectionPool:
     def idle_count(self) -> int:
         with self._lock:
             return sum(len(q) for q in self._idle.values())
+
+    def dead_mark_count(self) -> int:
+        """Endpoints currently carrying a dead-peer cooldown mark
+        (expired marks linger until a read or a sweep drops them)."""
+        with self._lock:
+            return len(self._dead)
 
 
 def _quiet(conn: Connection) -> bool:

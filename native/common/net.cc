@@ -31,14 +31,11 @@ bool SetNonBlocking(int fd) {
   return flags >= 0 && fcntl(fd, F_SETFL, flags | O_NONBLOCK) == 0;
 }
 
-int TcpListen(const std::string& bind_addr, int port, std::string* error) {
-  int fd = socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) {
-    *error = std::string("socket: ") + strerror(errno);
-    return -1;
-  }
-  int one = 1;
-  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+namespace {
+
+// Shared bind+listen tail of the two listen variants.
+int ListenOn(int fd, const std::string& bind_addr, int port,
+             std::string* error) {
   struct sockaddr_in addr;
   memset(&addr, 0, sizeof(addr));
   addr.sin_family = AF_INET;
@@ -61,6 +58,43 @@ int TcpListen(const std::string& bind_addr, int port, std::string* error) {
     return -1;
   }
   return fd;
+}
+
+}  // namespace
+
+int TcpListen(const std::string& bind_addr, int port, std::string* error) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    *error = std::string("socket: ") + strerror(errno);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  return ListenOn(fd, bind_addr, port, error);
+}
+
+int TcpListenReuseport(const std::string& bind_addr, int port,
+                       std::string* error) {
+#ifndef SO_REUSEPORT
+  *error = "SO_REUSEPORT not supported on this platform";
+  return -1;
+#else
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    *error = std::string("socket: ") + strerror(errno);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  // The refusal callers fall back on: an old kernel (< 3.9) or a
+  // filtered sockopt answers here, before any bind happens.
+  if (setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one)) != 0) {
+    *error = std::string("setsockopt(SO_REUSEPORT): ") + strerror(errno);
+    close(fd);
+    return -1;
+  }
+  return ListenOn(fd, bind_addr, port, error);
+#endif
 }
 
 int TcpConnect(const std::string& host, int port, int timeout_ms,

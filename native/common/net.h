@@ -24,6 +24,13 @@ namespace fdfs {
 // -- blocking socket helpers (sockopt.c analogues) ------------------------
 bool SetNonBlocking(int fd);
 int TcpListen(const std::string& bind_addr, int port, std::string* error);
+// SO_REUSEPORT variant for sharded accept reactors: every listener of a
+// reactor group binds the same (addr, port) with the flag set and the
+// kernel spreads incoming connections across them.  Fails (-1 + *error)
+// when the kernel refuses the option, so callers can fall back to a
+// single acceptor.
+int TcpListenReuseport(const std::string& bind_addr, int port,
+                       std::string* error);
 // Blocking connect with timeout (ms); returns fd or -1.
 int TcpConnect(const std::string& host, int port, int timeout_ms,
                std::string* error);

@@ -628,6 +628,45 @@ bool ChunkStore::ReadChunkSlice(const std::string& digest_hex,
   return false;
 }
 
+bool ChunkStore::ReadChunkSlices(const SliceReq* reqs, size_t n,
+                                 int64_t* vec_batches, int64_t* vec_spans,
+                                 std::string* failed) const {
+  // Partition by residence: only slab-resident chunks can share a
+  // preadv (flat chunks live one per inode, EC/released ones decode or
+  // fetch).  Membership is probed lock-free like ReadChunkSlice; a
+  // chunk that moves between the probe and the vectored read simply
+  // falls back below.
+  std::vector<SlabStore::SliceRead> slab_reqs;
+  std::vector<size_t> slab_idx;
+  for (size_t i = 0; i < n; ++i) {
+    const SliceReq& r = reqs[i];
+    if (slab_ != nullptr && slab_->Has(kSlabKindChunk, *r.digest_hex)) {
+      slab_reqs.push_back(
+          SlabStore::SliceRead{r.digest_hex, r.offset, r.len, r.dst});
+      slab_idx.push_back(i);
+    } else if (!ReadChunkSlice(*r.digest_hex, r.offset, r.len, r.dst)) {
+      *failed = *r.digest_hex;
+      return false;
+    }
+  }
+  if (!slab_reqs.empty()) {
+    std::unique_ptr<bool[]> ok(new bool[slab_reqs.size()]());
+    slab_->ReadSlices(kSlabKindChunk, slab_reqs.data(), slab_reqs.size(),
+                      ok.get(), vec_batches, vec_spans);
+    for (size_t j = 0; j < slab_reqs.size(); ++j) {
+      if (ok[j]) continue;
+      // Raced a compaction (or the chunk left the slab): the full
+      // fallthrough owns the retry.
+      const SliceReq& r = reqs[slab_idx[j]];
+      if (!ReadChunkSlice(*r.digest_hex, r.offset, r.len, r.dst)) {
+        *failed = *r.digest_hex;
+        return false;
+      }
+    }
+  }
+  return true;
+}
+
 // -- hot-chunk read cache -------------------------------------------------
 
 std::shared_ptr<const std::string> ChunkStore::CacheGet(

@@ -195,6 +195,23 @@ class ChunkStore {
   bool ReadChunkSlice(const std::string& digest_hex, int64_t offset,
                       int64_t len, char* dst) const;
 
+  // One request of a batched cold-span round (ISSUE 18).
+  struct SliceReq {
+    const std::string* digest_hex = nullptr;  // borrowed for the call
+    int64_t offset = 0;
+    int64_t len = 0;
+    char* dst = nullptr;
+  };
+  // Batched positional reads for one RecipeStream response round:
+  // slab-resident chunks route through SlabStore::ReadSlices (one
+  // preadv per contiguous slab run), everything else — flat, EC,
+  // released — takes the per-request fallthrough.  *vec_batches /
+  // *vec_spans accumulate the preadv syscall count and the requests
+  // they served (the dio.preadv_* counter feed).  False on the first
+  // unreadable chunk, with *failed naming its digest.
+  bool ReadChunkSlices(const SliceReq* reqs, size_t n, int64_t* vec_batches,
+                       int64_t* vec_spans, std::string* failed) const;
+
   // -- hot-chunk read cache ----------------------------------------------
   bool cache_enabled() const { return cache_.cap_bytes > 0; }
   // Cache lookup + disk read-through + insert, for DOWNLOAD_FILE: the

@@ -54,6 +54,7 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
     note("work_threads clamped to 64");
     work_threads = 64;
   }
+  nio_reuseport = ini.GetBool("nio_reuseport", nio_reuseport);
   disk_writer_threads = static_cast<int>(
       ini.GetInt("disk_writer_threads", disk_writer_threads));
   if (disk_writer_threads < 1) disk_writer_threads = 1;

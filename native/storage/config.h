@@ -29,6 +29,13 @@ struct StorageConfig {
   // threads (with 1, all connections share one nio thread; the main
   // loop only accepts).
   int work_threads = 4;
+  // Sharded accept (ISSUE 18): each nio loop binds its own SO_REUSEPORT
+  // listening socket and owns every connection it accepts — no
+  // cross-loop handoff, accept pressure spread by the kernel.  When the
+  // kernel refuses the option the daemon falls back to the single
+  // main-loop acceptor with round-robin handoff (an anomaly notes the
+  // fallback).  0 disables sharding outright.
+  bool nio_reuseport = true;
   // dio pool size PER STORE PATH (reference storage.conf:
   // disk_writer_threads / storage_dio.c): chunk-store writes,
   // fingerprint RPCs, trunk allocation, and deletes run here.

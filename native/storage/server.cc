@@ -81,6 +81,7 @@ StorageServer::~StorageServer() {
       if (c->send_fd >= 0) close(c->send_fd);
       close(fd);
     }
+    if (t->listen_fd >= 0) close(t->listen_fd);
   }
   if (listen_fd_ >= 0) close(listen_fd_);
 }
@@ -166,11 +167,6 @@ bool StorageServer::Init(std::string* error) {
     }
   }
 
-  listen_fd_ = TcpListen(cfg_.bind_addr, cfg_.port, error);
-  if (listen_fd_ < 0) return false;
-  SetNonBlocking(listen_fd_);
-  loop_.Add(listen_fd_, EPOLLIN, [this](uint32_t ev) { OnAccept(ev); });
-
   // nio work threads + per-store-path dio pools (reference:
   // storage_nio.c / storage_dio.c; storage.conf:work_threads,
   // disk_writer_threads).  Loops are created here, threads start in
@@ -179,6 +175,42 @@ bool StorageServer::Init(std::string* error) {
     auto t = std::make_unique<NioThread>();
     t->loop = std::make_unique<EventLoop>();
     nio_.push_back(std::move(t));
+  }
+
+  // Sharded accept (ISSUE 18): one SO_REUSEPORT listener per reactor,
+  // each added to its loop BEFORE the thread starts (EventLoop::Add is
+  // safe pre-Run).  All listeners of the port must carry the flag, so
+  // a refusal on ANY of them unwinds the whole group and falls back to
+  // the single main-loop acceptor + round-robin handoff.
+  if (cfg_.nio_reuseport && !nio_.empty()) {
+    std::string rp_err;
+    for (auto& t : nio_) {
+      t->listen_fd = TcpListenReuseport(cfg_.bind_addr, cfg_.port, &rp_err);
+      if (t->listen_fd < 0) break;
+      SetNonBlocking(t->listen_fd);
+    }
+    if (nio_.back()->listen_fd >= 0) {
+      reuseport_active_ = true;
+      for (auto& t : nio_) {
+        NioThread* raw = t.get();
+        t->loop->Add(raw->listen_fd, EPOLLIN,
+                     [this, raw](uint32_t) { OnReactorAccept(raw); });
+      }
+    } else {
+      for (auto& t : nio_) {
+        if (t->listen_fd >= 0) close(t->listen_fd);
+        t->listen_fd = -1;
+      }
+      FDFS_LOG_WARN("nio_reuseport: kernel refused (%s); "
+                    "falling back to single-acceptor round-robin",
+                    rp_err.c_str());
+    }
+  }
+  if (!reuseport_active_) {
+    listen_fd_ = TcpListen(cfg_.bind_addr, cfg_.port, error);
+    if (listen_fd_ < 0) return false;
+    SetNonBlocking(listen_fd_);
+    loop_.Add(listen_fd_, EPOLLIN, [this](uint32_t ev) { OnAccept(ev); });
   }
   for (int i = 0; i < store_.store_path_count(); ++i)
     dio_pools_.push_back(std::make_unique<WorkerPool>(
@@ -643,6 +675,10 @@ std::string StorageServer::MyIp() const {
   if (reporter_ != nullptr) return reporter_->my_ip();
   if (!cfg_.bind_addr.empty() && cfg_.bind_addr != "0.0.0.0")
     return cfg_.bind_addr;
+  // Acquire pairs with AdmitConn's release-publish: state 2 means
+  // my_ip_ is immutable from here on (any accept thread may have been
+  // the writer under sharded accept).
+  if (my_ip_state_.load(std::memory_order_acquire) != 2) return "127.0.0.1";
   return my_ip_.empty() ? "127.0.0.1" : my_ip_;
 }
 
@@ -765,6 +801,18 @@ void StorageServer::InitStatsRegistry() {
                                       StatsRegistry::LatencyBucketsUs());
   ctr_nio_dispatched_ = registry_.Counter("nio.dispatched_ops");
   registry_.GaugeFn("nio.conns_active", [this] { return conn_count_.load(); });
+  // Per-reactor accept spread (ISSUE 18): fed by both accept modes, so
+  // a skewed nio.accepts.<i> distribution under reuseport is the kernel
+  // hashing poorly, and under fallback it's the round-robin cursor.
+  registry_.GaugeFn("nio.reuseport_active",
+                    [this] { return reuseport_active_ ? 1 : 0; });
+  for (size_t i = 0; i < nio_.size(); ++i) {
+    NioThread* t = nio_[i].get();
+    registry_.GaugeFn("nio.accepts." + std::to_string(i),
+                      [t] { return t->accepts.load(); });
+    registry_.GaugeFn("nio.conns." + std::to_string(i),
+                      [t] { return t->live_conns.load(); });
+  }
   hist_dio_wait_ = registry_.Histogram("dio.queue_wait_us",
                                        StatsRegistry::LatencyBucketsUs());
   hist_dio_service_ = registry_.Histogram("dio.service_us",
@@ -885,6 +933,8 @@ void StorageServer::InitStatsRegistry() {
   ctr_download_ranged_requests_ =
       registry_.Counter("download.ranged_requests");
   ctr_download_ranged_bytes_ = registry_.Counter("download.ranged_bytes");
+  ctr_dio_preadv_batches_ = registry_.Counter("dio.preadv_batches");
+  ctr_dio_preadv_spans_ = registry_.Counter("dio.preadv_spans");
   auto cache_sum = [this](int64_t (ChunkStore::*fn)() const) {
     int64_t n = 0;
     for (const auto& cs : chunk_stores_) n += (cs.get()->*fn)();
@@ -1306,6 +1356,39 @@ void StorageServer::FillBeatStats(int64_t* out) {
 
 // -- nio ------------------------------------------------------------------
 
+bool StorageServer::AdmitConn(int fd) {
+  SetNonBlocking(fd);
+  if (cfg_.max_connections > 0 &&
+      conn_count_.load() >= cfg_.max_connections) {
+    // Polite refusal (reference: fast_task_queue pool exhaustion):
+    // one EBUSY response header, then close.  A fresh socket's send
+    // buffer always takes 10 bytes, so a blocking write is safe.
+    uint8_t hdr[kHeaderSize] = {0};
+    hdr[8] = static_cast<uint8_t>(StorageCmd::kResp);
+    hdr[9] = 16;  // EBUSY
+    (void)!write(fd, hdr, sizeof(hdr));
+    close(fd);
+    refused_conn_count_++;
+    return false;
+  }
+  // First-conn local-ip capture, lock-free: with sharded accept this
+  // races across reactor threads, so one writer wins the 0->1 CAS and
+  // release-publishes state 2; MyIp() acquires before reading.
+  int st = 0;
+  if (my_ip_state_.load(std::memory_order_relaxed) == 0 &&
+      my_ip_state_.compare_exchange_strong(st, 1,
+                                           std::memory_order_relaxed)) {
+    my_ip_ = SockIp(fd);
+    my_ip_state_.store(2, std::memory_order_release);
+  }
+  // Count at accept time, not adoption: a connect burst drains the
+  // whole backlog here before any nio thread runs its posted
+  // AdoptConn, so a later increment would let the burst sail past the
+  // cap.  CloseConn owns the decrement.
+  conn_count_++;
+  return true;
+}
+
 void StorageServer::OnAccept(uint32_t) {
   for (;;) {
     int fd = accept(listen_fd_, nullptr, nullptr);
@@ -1314,30 +1397,29 @@ void StorageServer::OnAccept(uint32_t) {
       FDFS_LOG_WARN("accept: %s", strerror(errno));
       return;
     }
-    SetNonBlocking(fd);
-    if (cfg_.max_connections > 0 &&
-        conn_count_.load() >= cfg_.max_connections) {
-      // Polite refusal (reference: fast_task_queue pool exhaustion):
-      // one EBUSY response header, then close.  A fresh socket's send
-      // buffer always takes 10 bytes, so a blocking write is safe.
-      uint8_t hdr[kHeaderSize] = {0};
-      hdr[8] = static_cast<uint8_t>(StorageCmd::kResp);
-      hdr[9] = 16;  // EBUSY
-      (void)!write(fd, hdr, sizeof(hdr));
-      close(fd);
-      refused_conn_count_++;
-      continue;
-    }
-    if (my_ip_.empty()) my_ip_ = SockIp(fd);
+    if (!AdmitConn(fd)) continue;
     // Round-robin handoff to a nio work thread (reference:
     // storage_nio.c pipe-notify from the accept thread).
-    // Count at accept time, not adoption: a connect burst drains the
-    // whole backlog here before any nio thread runs its posted
-    // AdoptConn, so a later increment would let the burst sail past the
-    // cap.  CloseConn owns the decrement.
-    conn_count_++;
     NioThread* t = nio_[next_nio_++ % nio_.size()].get();
+    t->accepts.fetch_add(1, std::memory_order_relaxed);
     t->loop->Post([this, t, fd] { AdoptConn(t, fd); });
+  }
+}
+
+void StorageServer::OnReactorAccept(NioThread* t) {
+  // Runs on t's own loop thread: the kernel spread the connection to
+  // this reactor's SO_REUSEPORT listener, so adoption is inline — no
+  // cross-loop Post, no shared next_nio_ cursor.
+  for (;;) {
+    int fd = accept(t->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      FDFS_LOG_WARN("accept (reactor): %s", strerror(errno));
+      return;
+    }
+    if (!AdmitConn(fd)) continue;
+    t->accepts.fetch_add(1, std::memory_order_relaxed);
+    AdoptConn(t, fd);
   }
 }
 
@@ -1347,6 +1429,7 @@ void StorageServer::AdoptConn(NioThread* t, int fd) {
   conn->owner = t;
   Conn* raw = conn.get();
   t->conns[fd] = std::move(conn);  // conn_count_ was taken at accept
+  t->live_conns.fetch_add(1, std::memory_order_relaxed);
   t->loop->Add(fd, EPOLLIN, [this, raw](uint32_t ev) { OnConnEvent(raw, ev); });
 }
 
@@ -1435,6 +1518,7 @@ void StorageServer::CloseConn(Conn* c) {
   ConnLoop(c)->Del(fd);
   close(fd);
   conn_count_--;
+  c->owner->live_conns.fetch_sub(1, std::memory_order_relaxed);
   if (c->async_pending) {
     // A dio worker still references this conn: keep the object alive as
     // a zombie until its completion callback reaps it.
@@ -1929,15 +2013,30 @@ bool StorageServer::RefillRecipeSpans(RecipeStream* rs) {
     }
   }
   // The pool is final-sized before any cold read, so span offsets into
-  // it stay valid for the whole round.
+  // it stay valid for the whole round.  The whole cold set goes down as
+  // ONE batched call: slab-resident spans coalesce into preadv runs
+  // (one syscall per contiguous slab extent) instead of one pread per
+  // span (ISSUE 18).
   rs->pool.resize(pool_bytes);
-  for (size_t i = 0; i < n_cold; ++i) {
-    const RecipeEntry& e = rs->recipe.chunks[cold[i].entry];
-    RecipeStream::Span& sp = rs->spans[cold[i].span];
-    if (!rs->cs->ReadChunkSlice(e.digest_hex, cold[i].file_off,
-                                static_cast<int64_t>(sp.len),
-                                rs->pool.data() + sp.off)) {
-      FDFS_LOG_ERROR("missing chunk %s mid-download", e.digest_hex.c_str());
+  if (n_cold > 0) {
+    ChunkStore::SliceReq creqs[kMaxSpans];
+    for (size_t i = 0; i < n_cold; ++i) {
+      const RecipeEntry& e = rs->recipe.chunks[cold[i].entry];
+      RecipeStream::Span& sp = rs->spans[cold[i].span];
+      creqs[i] = ChunkStore::SliceReq{&e.digest_hex, cold[i].file_off,
+                                      static_cast<int64_t>(sp.len),
+                                      rs->pool.data() + sp.off};
+    }
+    int64_t batches = 0, vec_spans = 0;
+    std::string failed;
+    bool read_ok =
+        rs->cs->ReadChunkSlices(creqs, n_cold, &batches, &vec_spans, &failed);
+    if (batches > 0) {
+      ctr_dio_preadv_batches_->fetch_add(batches, std::memory_order_relaxed);
+      ctr_dio_preadv_spans_->fetch_add(vec_spans, std::memory_order_relaxed);
+    }
+    if (!read_ok) {
+      FDFS_LOG_ERROR("missing chunk %s mid-download", failed.c_str());
       return false;
     }
   }

@@ -262,6 +262,17 @@ class StorageServer {
     // read by the metrics tick for nio.loop_busy_pct.<i> (the per-loop
     // duty cycle the shared loop-lag histogram cannot attribute).
     std::atomic<int64_t> busy_us{0};
+    // Sharded accept (ISSUE 18): this reactor's own SO_REUSEPORT
+    // listening fd (-1 in round-robin fallback mode, where the main
+    // loop accepts and posts).
+    int listen_fd = -1;
+    // Per-reactor spread telemetry, fed by BOTH accept modes (the
+    // reactor's own accept handler, or the main-loop round-robin
+    // assignment) so nio.accepts.<i> / nio.conns.<i> always mean "this
+    // reactor's share".  Read by gauge-fns under the registry mutex —
+    // atomics only.
+    std::atomic<int64_t> accepts{0};
+    std::atomic<int64_t> live_conns{0};
   };
   // Honest divergence from the reference's fast_task_queue.c pooled-task
   // buffers: each Conn owns its recv/send std::strings, which retain
@@ -277,6 +288,12 @@ class StorageServer {
   // not touch the socket/epoll), then the conn resumes on its loop.
   void OffloadToDio(Conn* c, int spi, std::function<void()> work);
   void OnAccept(uint32_t events);
+  // Reactor-owned accept (reuseport mode): runs ON t's loop thread, so
+  // the accepted conn is adopted inline — no cross-loop Post.
+  void OnReactorAccept(NioThread* t);
+  // Shared accept tail of both modes: cap refusal + first-conn local-ip
+  // capture.  Returns false when the conn was refused (and closed).
+  bool AdmitConn(int fd);
   void OnConnEvent(Conn* c, uint32_t events);
   void ReadConn(Conn* c);
   bool WriteConn(Conn* c);          // false => conn closed
@@ -503,10 +520,13 @@ class StorageServer {
   std::unique_ptr<RecoveryManager> recovery_;
   EventLoop loop_;                      // main: accept + timers
   int listen_fd_ = -1;
-  // nio work threads (storage.conf:work_threads); connections are
-  // assigned round-robin at accept and live on one loop for their
-  // whole lifetime (reference: storage_nio.c per-thread epoll loops).
+  // nio work threads (storage.conf:work_threads); each reactor owns the
+  // connections it accepts for their whole lifetime (reference:
+  // storage_nio.c per-thread epoll loops).  With nio_reuseport active
+  // every reactor accepts on its own SO_REUSEPORT listener; otherwise
+  // the main loop accepts and assigns round-robin.
   std::vector<std::unique_ptr<NioThread>> nio_;
+  bool reuseport_active_ = false;       // set once in Init
   size_t next_nio_ = 0;                 // main-loop only (accept)
   std::atomic<int64_t> conn_count_{0};
   std::atomic<int64_t> refused_conn_count_{0};  // over max_connections
@@ -609,12 +629,24 @@ class StorageServer {
   // bytes they actually served.
   std::atomic<int64_t>* ctr_download_ranged_requests_ = nullptr;
   std::atomic<int64_t>* ctr_download_ranged_bytes_ = nullptr;
+  // Vectored cold-span reads (ISSUE 18): per RecipeStream refill round
+  // the slab-resident cold spans batch into one preadv per (slab file,
+  // contiguous run).  spans > batches is the syscall-reduction proof on
+  // a chunked corpus; per-span pread fallbacks don't count here.
+  std::atomic<int64_t>* ctr_dio_preadv_batches_ = nullptr;
+  std::atomic<int64_t>* ctr_dio_preadv_spans_ = nullptr;
   // Parked phase-1 sessions keyed by id (ingest_mu_); swept by timer.
   RankedMutex ingest_mu_{LockRank::kIngestSessions};
   std::unordered_map<int64_t, std::unique_ptr<UploadSession>>
       ingest_sessions_;
   std::atomic<int64_t> next_ingest_session_{1};
+  // Local IP as seen by the first accepted connection, published
+  // lock-free: with sharded accept ANY reactor thread may capture it
+  // while handlers on other threads read it.  State 0 = empty, 1 = a
+  // writer owns the string, 2 = set (release-published; readers acquire
+  // before touching my_ip_).
   std::string my_ip_;
+  std::atomic<int> my_ip_state_{0};
 
   // Trunk state (cluster-global params from the tracker; SURVEY §2.3).
   // Guarded by trunk_mu_: mutated by the main-loop param timer, read by

@@ -4,6 +4,7 @@
 #include <fcntl.h>
 #include <string.h>
 #include <sys/stat.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -37,6 +38,28 @@ bool PreadAll(int fd, char* dst, int64_t len, int64_t offset) {
                       offset + got);
     if (r <= 0) return false;
     got += r;
+  }
+  return true;
+}
+
+// Vectored read of the full iov chain at offset; false on any short
+// read or error.  Advances through partial reads like PreadAll.
+bool PreadvAll(int fd, struct iovec* iov, int iovcnt, int64_t offset) {
+  while (iovcnt > 0) {
+    ssize_t r = preadv(fd, iov, iovcnt, offset);
+    if (r <= 0) return false;
+    offset += r;
+    while (r > 0 && iovcnt > 0) {
+      if (static_cast<size_t>(r) >= iov->iov_len) {
+        r -= static_cast<ssize_t>(iov->iov_len);
+        ++iov;
+        --iovcnt;
+      } else {
+        iov->iov_base = static_cast<char*>(iov->iov_base) + r;
+        iov->iov_len -= static_cast<size_t>(r);
+        r = 0;
+      }
+    }
   }
   return true;
 }
@@ -343,6 +366,79 @@ bool SlabStore::ReadSlice(uint8_t kind, const std::string& key,
     if (ok) return true;
   }
   return false;
+}
+
+void SlabStore::ReadSlices(uint8_t kind, const SliceRead* reqs, size_t n,
+                           bool* ok, int64_t* batches,
+                           int64_t* vec_spans) const {
+  // Records appended back-to-back sit header + key apart on disk, so
+  // recipe-adjacent chunks coalesce once gaps up to a few records are
+  // bridged; 4 KB keeps the wasted read under one page per seam.
+  constexpr int64_t kMaxGap = 4096;
+  constexpr size_t kMaxRunItems = 60;  // + bridge iovs stays far under IOV_MAX
+  struct Item {
+    int64_t start = 0;  // absolute file offset of the slice
+    int64_t len = 0;
+    char* dst = nullptr;
+    size_t req = 0;
+  };
+  std::map<int64_t, std::vector<Item>> by_slab;
+  for (size_t i = 0; i < n; ++i) {
+    ok[i] = false;
+    Slot s;
+    if (!Lookup(kind, *reqs[i].key, &s)) continue;
+    if (reqs[i].offset < 0 || reqs[i].len < 0 ||
+        reqs[i].offset + reqs[i].len > s.payload_len)
+      continue;
+    by_slab[s.slab_id].push_back(Item{s.payload_off + reqs[i].offset,
+                                      reqs[i].len, reqs[i].dst, i});
+  }
+  std::string scrap(static_cast<size_t>(kMaxGap), '\0');
+  for (auto& [slab_id, items] : by_slab) {
+    int fd = open(SlabPath(slab_id).c_str(), O_RDONLY);
+    if (fd < 0) continue;  // compaction unlinked it; per-req retry path
+    std::sort(items.begin(), items.end(),
+              [](const Item& a, const Item& b) { return a.start < b.start; });
+    size_t run_begin = 0;
+    while (run_begin < items.size()) {
+      // Grow the run while the next slice starts past the current end
+      // (preadv only reads forward) within bridging distance.
+      size_t run_end = run_begin + 1;
+      int64_t end_off = items[run_begin].start + items[run_begin].len;
+      while (run_end < items.size() &&
+             run_end - run_begin < kMaxRunItems &&
+             items[run_end].start >= end_off &&
+             items[run_end].start - end_off <= kMaxGap) {
+        end_off = items[run_end].start + items[run_end].len;
+        ++run_end;
+      }
+      struct iovec iov[2 * kMaxRunItems + 1];
+      int iovcnt = 0;
+      int64_t cursor = items[run_begin].start;
+      for (size_t i = run_begin; i < run_end; ++i) {
+        if (items[i].start > cursor) {
+          // Bridge the inter-record gap into the scrap buffer; every
+          // gap may share it — the bytes are discarded.
+          iov[iovcnt].iov_base = scrap.data();
+          iov[iovcnt].iov_len = static_cast<size_t>(items[i].start - cursor);
+          ++iovcnt;
+        }
+        iov[iovcnt].iov_base = items[i].dst;
+        iov[iovcnt].iov_len = static_cast<size_t>(items[i].len);
+        ++iovcnt;
+        cursor = items[i].start + items[i].len;
+      }
+      if (PreadvAll(fd, iov, iovcnt, items[run_begin].start)) {
+        *batches += 1;
+        *vec_spans += static_cast<int64_t>(run_end - run_begin);
+        for (size_t i = run_begin; i < run_end; ++i) ok[items[i].req] = true;
+      }
+      // A failed run leaves its requests ok = false: the caller's
+      // per-request ReadSlice retry owns compaction races.
+      run_begin = run_end;
+    }
+    close(fd);
+  }
 }
 
 bool SlabStore::MarkDead(uint8_t kind, const std::string& key,

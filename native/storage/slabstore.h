@@ -142,6 +142,27 @@ class SlabStore {
   bool ReadSlice(uint8_t kind, const std::string& key, int64_t offset,
                  int64_t len, char* dst) const;
 
+  // One request of a vectored slice batch (ISSUE 18): [offset,
+  // offset+len) of key's payload lands in dst.  The key pointer is
+  // borrowed for the call.
+  struct SliceRead {
+    const std::string* key = nullptr;
+    int64_t offset = 0;
+    int64_t len = 0;
+    char* dst = nullptr;
+  };
+  // Vectored positional reads for one response round: requests group by
+  // slab file, sort by file offset, and offset-contiguous runs (small
+  // inter-record gaps — header + key — bridged through a scrap buffer)
+  // coalesce into ONE preadv each.  Per-request outcomes land in ok[n];
+  // a request whose lookup or preadv raced a compaction simply reports
+  // ok[i] = false here and retries through the per-request ReadSlice
+  // path (same fresh-lookup semantics as Read).  *batches accumulates
+  // preadv syscalls issued, *vec_spans the requests a successful preadv
+  // served — the dio.preadv_* counter feed.
+  void ReadSlices(uint8_t kind, const SliceRead* reqs, size_t n, bool* ok,
+                  int64_t* batches, int64_t* vec_spans) const;
+
   // Delete: drop the index entry, flip the on-disk dead flag, account
   // the bytes.  False when the key is not indexed.  *payload_len_out
   // (optional) reports the payload size for reclaim accounting.

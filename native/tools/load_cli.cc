@@ -39,6 +39,18 @@
 // omission failure).  Threads (<threads> = the concurrency cap) only
 // bound how many ops may be in flight at once.
 //
+// --conns N (upload/download/delete, any position after the mode):
+// shared storage-connection budget across ALL worker threads.  Workers
+// check a connection out of a pool per op; when every slot is busy the
+// worker blocks until one is returned, so `--conns 1` serializes all
+// storage traffic through one socket (the pre-multiplexing client
+// shape) while `--conns >= threads` restores full parallelism — the
+// knob that makes client-side multiplexing wins measurable from the
+// harness side.  0/absent = unlimited (one conn per worker, the old
+// behaviour).  Every run prints a `{"conns_budget": ...}` JSON line to
+// stdout with the EFFECTIVE counts (opened/peak/waits) so the bench
+// harness can verify the topology it asked for is the one it got.
+//
 // --zipf <s>: key-popularity mode for downloads (ISSUE 8 / ROADMAP
 // item 2's load harness seed).  Instead of round-robin over the ids
 // file, op i fetches the id Zipf(s) picks over a bounded key universe
@@ -55,9 +67,11 @@
 #include <algorithm>
 #include <atomic>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
 #include <fstream>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -143,11 +157,112 @@ class Peer {
       fd_ = -1;
     }
   }
+  const std::string& host() const { return host_; }
+  int port() const { return port_; }
 
  private:
   std::string host_;
   int port_;
   int fd_ = -1;
+};
+
+// Shared storage-connection pool (--conns N).  All workers draw their
+// storage connections from here; `budget` caps the LIVE connection
+// count across every endpoint, and a worker whose op finds the budget
+// exhausted blocks until someone returns one.  Idle conns are parked
+// per endpoint and reused LIFO (warmest socket first); when the cap is
+// tight and the op targets an endpoint with no idle conn, an idle conn
+// to a DIFFERENT endpoint is retired to free budget instead of
+// deadlocking on endpoint churn.  budget <= 0 = unlimited, which
+// degenerates to the old one-conn-per-worker shape (each worker gets
+// back the conn it just returned).
+class StoragePool {
+ public:
+  ~StoragePool() {
+    for (Peer* p : all_) delete p;
+  }
+  // Must be called before workers start; not thread-safe.
+  void set_budget(int budget) { budget_ = budget; }
+  int budget() const { return budget_; }
+
+  Peer* Checkout(const std::string& host, int port) {
+    std::unique_lock<RankedMutex> lk(mu_);
+    const std::string key = host + ":" + std::to_string(port);
+    for (;;) {
+      auto it = idle_.find(key);
+      if (it != idle_.end() && !it->second.empty()) {
+        Peer* p = it->second.back();
+        it->second.pop_back();
+        return p;
+      }
+      if (budget_ <= 0 || live_ < budget_) {
+        ++live_;
+        ++opened_;
+        peak_ = std::max(peak_, live_);
+        Peer* p = new Peer(host, port);
+        all_.push_back(p);
+        return p;
+      }
+      // Cap reached, nothing idle for THIS endpoint: retire an idle
+      // conn to another endpoint if one exists, else wait for a return.
+      bool retired = false;
+      for (auto& [k, v] : idle_) {
+        (void)k;
+        if (!v.empty()) {
+          v.back()->Close();  // freed via all_ at exit
+          v.pop_back();
+          --live_;
+          retired = true;
+          break;
+        }
+      }
+      if (retired) continue;
+      ++waits_;
+      cv_.wait(lk);
+    }
+  }
+
+  void Return(Peer* p) {
+    std::lock_guard<RankedMutex> lk(mu_);
+    idle_[p->host() + ":" + std::to_string(p->port())].push_back(p);
+    cv_.notify_one();
+  }
+
+  // Effective-count report for the harness; call after workers join.
+  void PrintStats() const {
+    printf(
+        "{\"conns_budget\": %d, \"conns_opened\": %lld, "
+        "\"conns_peak\": %d, \"conn_waits\": %lld}\n",
+        budget_, static_cast<long long>(opened_), peak_,
+        static_cast<long long>(waits_));
+  }
+
+ private:
+  mutable RankedMutex mu_{LockRank::kToolOutput};
+  std::condition_variable_any cv_;
+  int budget_ = 0;
+  int live_ = 0;     // created minus retired (checked out or idle)
+  int peak_ = 0;     // max live_ ever
+  int64_t opened_ = 0;  // total connections ever created
+  int64_t waits_ = 0;   // checkouts that had to block on the cap
+  std::map<std::string, std::vector<Peer*>> idle_;
+  std::vector<Peer*> all_;  // owns every Peer ever created
+};
+
+// RAII checkout so early-exit paths in the workers cannot leak a
+// pooled connection (which under --conns 1 would wedge every worker).
+class PooledPeer {
+ public:
+  PooledPeer(StoragePool* pool, const std::string& host, int port)
+      : pool_(pool), peer_(pool->Checkout(host, port)) {}
+  ~PooledPeer() { pool_->Return(peer_); }
+  PooledPeer(const PooledPeer&) = delete;
+  PooledPeer& operator=(const PooledPeer&) = delete;
+  Peer* operator->() { return peer_; }
+
+ private:
+  StoragePool* pool_;
+  Peer* peer_;
 };
 
 // tracker query_store (cmd 101): resp = 16B group + 16B ip + 8B port +
@@ -238,6 +353,12 @@ struct Shared {
   // load (the coordinated-omission fix; closed-loop when rate == 0).
   double rate = 0;
   int64_t t0_us = 0;
+  // Storage connections are drawn from this shared pool; --conns N
+  // caps it (0 = unlimited).  Tracker connections stay per-worker —
+  // they are tiny metadata RPCs and capping them would only measure
+  // tracker queueing, not the storage-edge multiplexing this knob is
+  // for.
+  StoragePool pool;
   RankedMutex out_mu{LockRank::kToolOutput};
   std::vector<OpRecord> records;
 };
@@ -277,10 +398,6 @@ void FillPayload(int64_t payload_id, std::string* buf) {
 
 void UploadWorker(Shared* sh) {
   Peer tracker(sh->tracker_host, sh->tracker_port);
-  // One storage connection, re-resolved when the target changes (one
-  // group + round-robin tracker policies keep it stable in practice).
-  std::string cur_addr;
-  Peer* storage = nullptr;
   std::string payload(static_cast<size_t>(sh->size), '\0');
   std::vector<OpRecord> local;
   for (;;) {
@@ -296,12 +413,7 @@ void UploadWorker(Shared* sh) {
     int port = 0;
     uint8_t spi = 0;
     if (QueryStore(&tracker, &group, &ip, &port, &spi)) {
-      std::string addr = ip + ":" + std::to_string(port);
-      if (storage == nullptr || addr != cur_addr) {
-        delete storage;
-        storage = new Peer(ip, port);
-        cur_addr = addr;
-      }
+      PooledPeer storage(&sh->pool, ip, port);
       // upload wire: 1B spi, 8B size, 6B ext, body
       std::string body;
       body.reserve(15 + payload.size());
@@ -328,13 +440,10 @@ void UploadWorker(Shared* sh) {
     if (local.size() >= 1024) Emit(sh, &local);
   }
   Emit(sh, &local);
-  delete storage;
 }
 
 void DownloadWorker(Shared* sh) {
   Peer tracker(sh->tracker_host, sh->tracker_port);
-  std::string cur_addr;
-  Peer* storage = nullptr;
   std::vector<OpRecord> local;
   for (;;) {
     int64_t i = sh->next.fetch_add(1);
@@ -350,12 +459,7 @@ void DownloadWorker(Shared* sh) {
     if (QueryFetch(&tracker,
                    static_cast<uint8_t>(TrackerCmd::kServiceQueryFetchOne),
                    fid, &ip, &port)) {
-      std::string addr = ip + ":" + std::to_string(port);
-      if (storage == nullptr || addr != cur_addr) {
-        delete storage;
-        storage = new Peer(ip, port);
-        cur_addr = addr;
-      }
+      PooledPeer storage(&sh->pool, ip, port);
       std::string group, remote;
       SplitId(fid, &group, &remote);
       uint8_t num[16] = {0};  // offset 0, length 0 (= to EOF)
@@ -374,13 +478,10 @@ void DownloadWorker(Shared* sh) {
     if (local.size() >= 1024) Emit(sh, &local);
   }
   Emit(sh, &local);
-  delete storage;
 }
 
 void DeleteWorker(Shared* sh) {
   Peer tracker(sh->tracker_host, sh->tracker_port);
-  std::string cur_addr;
-  Peer* storage = nullptr;
   std::vector<OpRecord> local;
   for (;;) {
     int64_t i = sh->next.fetch_add(1);
@@ -392,12 +493,7 @@ void DeleteWorker(Shared* sh) {
     if (QueryFetch(&tracker,
                    static_cast<uint8_t>(TrackerCmd::kServiceQueryUpdate),
                    fid, &ip, &port)) {
-      std::string addr = ip + ":" + std::to_string(port);
-      if (storage == nullptr || addr != cur_addr) {
-        delete storage;
-        storage = new Peer(ip, port);
-        cur_addr = addr;
-      }
+      PooledPeer storage(&sh->pool, ip, port);
       std::string group, remote;
       SplitId(fid, &group, &remote);
       std::string resp;
@@ -411,7 +507,6 @@ void DeleteWorker(Shared* sh) {
     if (local.size() >= 1024) Emit(sh, &local);
   }
   Emit(sh, &local);
-  delete storage;
 }
 
 bool WriteResults(const Shared& sh, const std::string& path, bool with_ids) {
@@ -442,14 +537,20 @@ int RunWorkers(Shared* sh, int threads, void (*fn)(Shared*)) {
   std::vector<std::thread> ts;
   for (int t = 0; t < threads; ++t) ts.emplace_back(fn, sh);
   for (auto& t : ts) t.join();
+  // Effective connection counts on stdout (records go to the result
+  // file, so stdout is free): the harness asserts the topology it
+  // asked for — e.g. `--conns 1` really did run one storage socket —
+  // is the one the run actually had.
+  sh->pool.PrintStats();
   return 0;
 }
 
-// Strip --open-loop / --rate R (valid anywhere after the mode word)
-// out of argv, compacting the rest so positional parsing below stays
-// oblivious.  --rate alone implies open-loop; --open-loop without a
-// rate is an error rather than a guess.
-bool StripOpenLoopFlags(int* argc, char** argv, Shared* sh) {
+// Strip the mode-independent flags (valid anywhere after the mode
+// word) out of argv, compacting the rest so positional parsing below
+// stays oblivious: --open-loop / --rate R (--rate alone implies
+// open-loop; --open-loop without a rate is an error rather than a
+// guess) and --conns N (shared storage-connection budget).
+bool StripGlobalFlags(int* argc, char** argv, Shared* sh) {
   bool open_loop = false;
   double rate = 0;
   int w = 0;
@@ -464,6 +565,15 @@ bool StripOpenLoopFlags(int* argc, char** argv, Shared* sh) {
         fprintf(stderr, "--rate wants a positive ops/sec, got %s\n", argv[a]);
         return false;
       }
+    } else if (flag == "--conns" && a + 1 < *argc) {
+      char* end = nullptr;
+      long conns = strtol(argv[++a], &end, 10);
+      if (end == argv[a] || conns < 0) {
+        fprintf(stderr, "--conns wants a non-negative count, got %s\n",
+                argv[a]);
+        return false;
+      }
+      sh->pool.set_budget(static_cast<int>(conns));
     } else {
       argv[w++] = argv[a];
     }
@@ -559,7 +669,7 @@ int main(int argc, char** argv) {
   }
 
   Shared sh;
-  if (!StripOpenLoopFlags(&argc, argv, &sh)) return 2;
+  if (!StripGlobalFlags(&argc, argv, &sh)) return 2;
   if (mode == "upload" && argc >= 7 &&
       std::string(argv[3]) == "--small-files") {
     // Small-file corpus mode (ISSUE 9 / config9): --small-files N

@@ -153,6 +153,46 @@ def test_config10_multi_group_smoke(tmp_path):
     assert drain["files_moved"] >= 1 and drain["pace_mb_s"] > 0
 
 
+def test_config12_serving_edge_smoke(tmp_path):
+    # The serving-edge scenario end-to-end at tiny scale: both reactor
+    # arms come up (reuseport sharded accept), the open-loop sweep runs
+    # every (reactors x client) cell with zero errors, the fdfs_load
+    # pool honors --conns 1 exactly (conns_peak == 1), the 4 KB-chunked
+    # cold corpus drives the vectored pread batcher (spans > batches),
+    # the held-socket burst lands on every reactor within 2x of the
+    # mean, the parallel ranged client returns not one wrong byte, and
+    # both mid-load flamegraphs captured real samples.  (The latency
+    # ordering itself is asserted on the checked-in artifact, not here
+    # — sub-ms percentiles at smoke scale are noise.)
+    bc.config12(str(tmp_path), scale=0.0008)  # 24 x 256 KB per arm
+    with open(os.path.join(str(tmp_path), "config12.json")) as fh:
+        art = json.load(fh)
+    assert art["zero_errors"] is True
+    assert art["wrong_bytes"] == 0
+    assert art["conn_budget_honored"] is True
+    assert art["preadv_spans_exceed_batches"] is True
+    assert art["accept_spread_within_2x"] is True
+    assert len(art["offered_rates_qps"]) == 2
+    for arm_name, reactors in (("reactors1", 1), ("reactors4", 4)):
+        arm = art["arms"][arm_name]
+        assert arm["reactors"] == reactors
+        burst = arm["accept_burst"]
+        assert len(burst["conns_per_reactor"]) == reactors
+        assert sum(burst["conns_per_reactor"].values()) >= 64
+        assert arm["ranged_verify"]["wrong"] == 0
+        assert arm["ranged_verify"]["ranged_fallbacks"] == 0
+        assert arm["preadv"]["spans"] > arm["preadv"]["batches"] > 0
+        flame = arm["flamegraph"]
+        assert flame["samples"] > 0
+        assert os.path.exists(os.path.join(str(tmp_path),
+                                           flame["folded_file"]))
+        for sweep in arm["clients"].values():
+            assert all(cell["pool"]["conns_opened"] >= 1
+                       for cell in sweep)
+    single = art["arms"]["reactors4"]["clients"]["single_conn"]
+    assert all(cell["pool"]["conns_peak"] == 1 for cell in single)
+
+
 def test_config11_ec_cold_tier_smoke(tmp_path):
     # The erasure-coding scenario end-to-end at tiny scale: the
     # replicated corpus demotes into RS(3+2) stripes on both members,

@@ -1,14 +1,20 @@
-"""FdfsClient.stats(): the client-side fallback counters.
+"""FdfsClient.stats(): the client-side fallback counters, plus the
+connection pool's multiplexing-cap and hygiene behavior (ISSUE 18).
 
 Every resilience path in the client is transparent — the call still
 succeeds — so these counters are the ONLY place their frequency shows.
 Each test drives exactly one fallback with monkeypatched internals (no
 daemons): dedup upload -> plain, placement shortcut -> tracker hop,
-parallel ranged download -> single stream.
+parallel ranged download -> single stream.  The pool tests drive
+acquire/release/sweep with fake connections and injected clocks — no
+sockets, no sleeps beyond the bounded cap wait.
 """
 
+import threading
+import time
+
 from fastdfs_tpu.client.client import FdfsClient
-from fastdfs_tpu.client.conn import StatusError
+from fastdfs_tpu.client.conn import ConnectionPool, StatusError
 from fastdfs_tpu.client.tracker_client import StoreTarget
 
 
@@ -154,3 +160,125 @@ def test_ranged_single_range_is_not_a_fallback(monkeypatch):
                         lambda file_id, offset=0, length=0: b"whole")
     assert c.download_ranged("g1/x", parallel=1) == b"whole"
     assert c.stats()["ranged_fallback_single"] == 0
+
+
+# ---------------------------------------------------------------------------
+# connection pool: multiplexing cap + hygiene (ISSUE 18) — no daemons
+# ---------------------------------------------------------------------------
+
+class FakeConn:
+    """Stands in for conn.Connection: the pool only touches host/port/
+    broken/trace_ctx/close, plus .sock through _quiet (patched out)."""
+
+    def __init__(self, host="127.0.0.1", port=9, timeout=0.0):
+        self.host = host
+        self.port = port
+        self.broken = False
+        self.trace_ctx = None
+        self.closed = False
+        self.sock = None
+
+    def close(self):
+        self.closed = True
+
+
+def _patched_pool(monkeypatch, **kw):
+    monkeypatch.setattr("fastdfs_tpu.client.conn.Connection", FakeConn)
+    monkeypatch.setattr("fastdfs_tpu.client.conn._quiet", lambda c: True)
+    kw.setdefault("sweep_interval", 1e9)  # sweeps only when tests say so
+    return ConnectionPool(**kw)
+
+
+def test_pool_sweep_closes_idle_past_ttl(monkeypatch):
+    pool = _patched_pool(monkeypatch, max_idle_seconds=10)
+    conn = pool.acquire("127.0.0.1", 9)
+    pool.release(conn)
+    assert pool.idle_count() == 1
+    # Not stale yet: a sweep inside the TTL keeps it parked.
+    pool.sweep(now=time.monotonic() + 9)
+    assert pool.idle_count() == 1 and not conn.closed
+    # Past the TTL the sweep closes it — even though no caller ever
+    # acquires this endpoint again (the leak sweeps exist to fix).
+    pool.sweep(now=time.monotonic() + 11)
+    assert pool.idle_count() == 0
+    assert conn.closed
+    assert pool.swept_idle == 1
+
+
+def test_pool_sweep_drops_expired_dead_marks(monkeypatch):
+    pool = _patched_pool(monkeypatch, dead_peer_cooldown=5)
+    pool.mark_dead("10.0.0.1", 23000)
+    pool.mark_dead("10.0.0.2", 23000)
+    assert pool.dead_mark_count() == 2
+    # Inside the cooldown the marks survive a sweep.
+    pool.sweep(now=time.monotonic() + 4)
+    assert pool.dead_mark_count() == 2
+    # Past it they are dropped without anyone calling is_dead on the
+    # departed endpoints.
+    pool.sweep(now=time.monotonic() + 6)
+    assert pool.dead_mark_count() == 0
+
+
+def test_pool_cap_waits_then_overflows(monkeypatch):
+    pool = _patched_pool(monkeypatch, max_conns_per_endpoint=1,
+                         cap_wait_seconds=0.05)
+    a = pool.acquire("127.0.0.1", 9)
+    t0 = time.monotonic()
+    b = pool.acquire("127.0.0.1", 9)  # cap held by a: wait, then overflow
+    assert time.monotonic() - t0 >= 0.04
+    assert a is not b
+    assert pool.cap_overflows == 1
+    assert pool.in_use_count("127.0.0.1", 9) == 2
+    # A different endpoint is not throttled by this one's cap.
+    pool.acquire("127.0.0.2", 9)
+    assert pool.cap_overflows == 1
+
+
+def test_pool_release_unblocks_capped_waiter(monkeypatch):
+    pool = _patched_pool(monkeypatch, max_conns_per_endpoint=1,
+                         cap_wait_seconds=30)
+    a = pool.acquire("127.0.0.1", 9)
+    got = {}
+
+    def waiter():
+        got["conn"] = pool.acquire("127.0.0.1", 9)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    assert "conn" not in got  # parked on the cap, not overflowing
+    pool.release(a)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    # The waiter multiplexed onto the RELEASED socket — no new connect,
+    # no overflow.
+    assert got["conn"] is a
+    assert pool.cap_overflows == 0
+    assert pool.in_use_count() == 1
+
+
+def test_pool_idle_total_evicts_globally_oldest(monkeypatch):
+    pool = _patched_pool(monkeypatch, max_idle_total=2)
+    conns = [pool.acquire("127.0.0.1", 9000 + i) for i in range(3)]
+    for c in conns:
+        pool.release(c)
+    # The pool-wide cap closed the OLDEST parked conn (first released),
+    # not the newest.
+    assert pool.idle_count() == 2
+    assert conns[0].closed
+    assert not conns[1].closed and not conns[2].closed
+
+
+def test_pool_double_release_never_wedges_the_cap(monkeypatch):
+    pool = _patched_pool(monkeypatch, max_conns_per_endpoint=1,
+                         cap_wait_seconds=0.05)
+    a = pool.acquire("127.0.0.1", 9)
+    pool.release(a)
+    pool.release(a)  # buggy caller: must floor at zero, not go to -1
+    assert pool.in_use_count() == 0
+    # Accounting intact: the endpoint still hands out its one slot
+    # instantly and enforces the cap for a second borrower.
+    b = pool.acquire("127.0.0.1", 9)
+    assert b is a
+    pool.acquire("127.0.0.1", 9)
+    assert pool.cap_overflows == 1

@@ -72,7 +72,12 @@ def _sample_registry() -> dict:
                      "ingest.recipe_fallbacks": 2,
                      # ranged-download traffic (PR 5 parallel client)
                      "download.ranged_requests": 8,
-                     "download.ranged_bytes": 4194304},
+                     "download.ranged_bytes": 4194304,
+                     # vectored cold-span reads (ISSUE 18): syscalls vs
+                     # the spans they carried — spans/batches is the
+                     # coalescing factor dashboards chart
+                     "dio.preadv_batches": 5,
+                     "dio.preadv_spans": 37},
         "gauges": {"server.connections": 2, "sync.peer.10.0.0.2:23000.lag_s": 7,
                    "ingest.sessions_active": 1,
                    # hot-chunk read cache (PR 5): hit/miss/eviction flow
@@ -89,6 +94,11 @@ def _sample_registry() -> dict:
                    # depth, flight-recorder throughput
                    "nio.conns_active": 2, "dio.queue_depth": 1,
                    "events.recorded": 7, "events.dropped": 0,
+                   # sharded accept reactors (ISSUE 18): mode flag plus
+                   # per-reactor accept/live-conn spread
+                   "nio.reuseport_active": 1,
+                   "nio.accepts.0": 13, "nio.accepts.1": 12,
+                   "nio.conns.0": 1, "nio.conns.1": 1,
                    # integrity engine (PR 4): scrub/quarantine/GC health
                    "scrub.chunks_verified": 500, "scrub.chunks_corrupt": 2,
                    "scrub.chunks_repaired": 1,
@@ -318,6 +328,18 @@ def test_prometheus_exposition_parses():
     assert series["fdfs_nio_conns_active"][0][1] == 2.0
     assert series["fdfs_dio_queue_depth"][0][1] == 1.0
     assert series["fdfs_events_recorded"][0][1] == 7.0
+    # Serving-edge golden (ISSUE 18): the per-reactor families keep the
+    # reactor index in the metric NAME (the registry has no labels), so
+    # each reactor exports as its own sanitized series, and the preadv
+    # counters export so dashboards can chart spans/batches coalescing.
+    assert series["fdfs_nio_reuseport_active"][0] == (
+        '{storage="127.0.0.1:23000"}', 1.0)
+    assert series["fdfs_nio_accepts_0"][0][1] == 13.0
+    assert series["fdfs_nio_accepts_1"][0][1] == 12.0
+    assert series["fdfs_nio_conns_0"][0][1] == 1.0
+    assert series["fdfs_nio_conns_1"][0][1] == 1.0
+    assert series["fdfs_dio_preadv_batches"][0][1] == 5.0
+    assert series["fdfs_dio_preadv_spans"][0][1] == 37.0
 
 
 def test_prometheus_multi_storage_groups_by_metric_name():
