@@ -863,6 +863,90 @@ def cmd_health(c: FdfsClient, args: list[str]) -> int:
         return 0
 
 
+def cmd_admission(c: FdfsClient, args: list[str]) -> int:
+    """Overload-control console: every daemon's admission-ladder status
+    (ADMISSION_STATUS) — the tracker's plus each storage's shed level,
+    pressure EWMA against its tighten/relax thresholds, and lifetime
+    per-class shed counts.  The status opcode is born control-class, so
+    it answers even from a daemon at reads-only.
+
+    Flags: --watch [s]     re-render every s seconds (default 2) until
+                           interrupted
+           --json          machine-readable {addr: {field: value}}
+    """
+    import time as _time
+
+    from fastdfs_tpu import monitor as M
+
+    interval = 0.0
+    if "--watch" in args:
+        i = args.index("--watch")
+        interval = 2.0
+        if i + 1 < len(args) and not args[i + 1].startswith("--"):
+            try:
+                interval = float(args[i + 1])
+            except ValueError:
+                pass
+
+    def storages():
+        cs = c.cluster_stat()
+        return [(s["ip"], s["port"])
+                for g in cs.get("groups", [])
+                for s in g.get("storages", [])]
+
+    members = storages()
+
+    def render_once() -> int:
+        rows: dict[str, dict] = {}
+        errors: dict[str, str] = {}
+        try:
+            raw = c.tracker_admission_status()
+            rows[f"tracker {raw['port']}"] = raw
+        except Exception as e:  # noqa: BLE001 — a dead node is a row
+            errors["tracker"] = str(e)
+        for ip, port in members:
+            addr = f"{ip}:{port}"
+            try:
+                rows[addr] = c.storage_admission_status(ip, port)
+            except Exception as e:  # noqa: BLE001
+                errors[addr] = str(e)
+        if "--json" in args:
+            merged: dict[str, dict] = dict(rows)
+            merged.update({a: {"error": e} for a, e in errors.items()})
+            print(json.dumps(merged, indent=2, sort_keys=True))
+            return 0 if rows and not errors else 1
+        cols = (f"{'node':<24} {'level':<16} {'ewma':>6} {'thresh':>11} "
+                f"{'admitted':>9} {'shed':>7} {'retry':>7}")
+        print(cols)
+        print("-" * len(cols))
+        for addr, raw_st in sorted(rows.items()):
+            st = M.decode_admission(raw_st)
+            off = "" if st.enabled else " (DISABLED)"
+            thresh = f"{st.relax_threshold}/{st.tighten_threshold}"
+            print(f"{addr:<24} {st.level_name:<16} {st.ewma:>6.2f} "
+                  f"{thresh:>11} {st.admitted:>9} {st.shed:>7} "
+                  f"{st.retry_after_ms:>5}ms{off}")
+            shed = {k: v for k, v in sorted(st.shed_by_class.items())
+                    if v}
+            if shed:
+                print("  shed by class: " +
+                      "  ".join(f"{k}={v}" for k, v in shed.items()))
+        for addr, err in sorted(errors.items()):
+            print(f"{addr}  error: {err}")
+        return 0 if rows and not errors else 1
+
+    if interval <= 0:
+        return render_once()
+    try:
+        while True:
+            if "--json" not in args:  # keep --watch --json parseable
+                print(f"-- admission @ {_time.strftime('%H:%M:%S')} --")
+            render_once()
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_group(c: FdfsClient, args: list[str]) -> int:
     """Group lifecycle console (multi-group scale-out): the placement
     epoch with per-group state and, for draining groups, each member's
@@ -984,6 +1068,7 @@ TOOLS = {
     "scrub": cmd_scrub,
     "ec": cmd_ec,
     "health": cmd_health,
+    "admission": cmd_admission,
     "group": cmd_group,
 }
 

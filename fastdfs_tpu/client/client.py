@@ -10,6 +10,7 @@ from __future__ import annotations
 import concurrent.futures
 import os
 import random
+import time
 from collections import OrderedDict
 
 from fastdfs_tpu.client.conn import ConnectionPool, ProtocolError, StatusError
@@ -35,7 +36,9 @@ class FdfsClient:
                  use_placement: bool = False,
                  dead_peer_cooldown_s: float = 30.0,
                  max_conns_per_endpoint: int = 0,
-                 pool_idle_ttl_s: float = 300.0):
+                 pool_idle_ttl_s: float = 300.0,
+                 priority: int | None = None,
+                 admission_retries: int = 2):
         if isinstance(tracker_addrs, str):
             tracker_addrs = [tracker_addrs]
         if not tracker_addrs:
@@ -66,6 +69,16 @@ class FdfsClient:
         # spans stitch under the client's open span (trace.traced_upload
         # installs one around a single operation).
         self.tracer = None
+        # Request QoS (ISSUE 19): when set (a protocol.PriorityClass
+        # int, 0 control .. 4 background), every tracker/storage request
+        # this client sends carries a PRIORITY prefix frame so the
+        # daemons' admission ladders shed by the caller's declared class
+        # instead of the opcode default.  admission_retries bounds how
+        # many times an operation shed with a retry-after hint is
+        # retried (after honoring the jittered hint) before the EBUSY
+        # propagates.
+        self.priority = priority
+        self.admission_retries = max(int(admission_retries), 0)
         # Dedup-aware negotiated uploads (opt-in): when enabled,
         # upload_buffer routes through upload_buffer_dedup.  The
         # negotiation costs one extra round-trip, so small payloads
@@ -105,7 +118,8 @@ class FdfsClient:
         self._fallbacks = {"dedup_fallback_plain": 0,
                            "placement_fallback_tracker": 0,
                            "ranged_fallback_single": 0,
-                           "dead_peer_skips": 0}
+                           "dead_peer_skips": 0,
+                           "admission_retry_waits": 0}
 
     @classmethod
     def from_conf(cls, conf_path: str) -> "FdfsClient":
@@ -126,7 +140,11 @@ class FdfsClient:
                    max_conns_per_endpoint=int(
                        cfg.get("max_conns_per_endpoint", 0)),
                    pool_idle_ttl_s=float(
-                       cfg.get_seconds("pool_idle_ttl_s", 300)))
+                       cfg.get_seconds("pool_idle_ttl_s", 300)),
+                   priority=(int(cfg.get("request_priority", -1))
+                             if int(cfg.get("request_priority", -1)) >= 0
+                             else None),
+                   admission_retries=int(cfg.get("admission_retries", 2)))
 
     def close(self) -> None:
         if self.pool is not None:
@@ -144,6 +162,44 @@ class FdfsClient:
 
     def _wire_ctx(self):
         return self.tracer.wire_ctx() if self.tracer is not None else None
+
+    def _admission_wait(self, e: StatusError) -> None:
+        """Honor an admission shed's retry-after hint: sleep the hinted
+        interval plus up to 25% jitter, so a fleet of clients shed in
+        the same tick does not stampede back in lockstep.  EBUSY
+        WITHOUT a hint (max_connections refusal, non-leader, drain)
+        never sleeps — those are answered by a different endpoint, not
+        by waiting."""
+        if e.retry_after_ms > 0:
+            self._fallbacks["admission_retry_waits"] += 1
+            time.sleep((e.retry_after_ms / 1000.0)
+                       * (1.0 + 0.25 * random.random()))
+
+    def _shed_retry(self, fn):
+        """Run ``fn()``; when the admission ladder sheds it (StatusError
+        EBUSY carrying a retry-after hint) sleep the jittered hint and
+        re-run the WHOLE operation — including the tracker hop, which
+        may well route the retry to a less-loaded peer — up to
+        admission_retries times before the EBUSY propagates.  A shed
+        happens at request-header stage, before any response body
+        moves, so every operation here is safe to re-issue."""
+        for _ in range(self.admission_retries):
+            try:
+                return fn()
+            except StatusError as e:
+                if e.status != 16 or e.retry_after_ms <= 0:
+                    raise
+                self._admission_wait(e)
+        return fn()
+
+    def _routed(self, query, op):
+        """The classic two-hop dance (tracker query -> storage op) with
+        admission-shed retry wrapped around the whole pair."""
+        def run():
+            tgt = self._with_tracker(query)
+            with self._storage(tgt) as s:
+                return op(s)
+        return self._shed_retry(run)
 
     def _tracker(self) -> TrackerClient:
         # Random start + failover (reference: tracker_get_connection's
@@ -165,10 +221,12 @@ class FdfsClient:
                 if self.pool is not None:
                     conn = self.pool.acquire(host, port, self.timeout)
                     conn.trace_ctx = self._wire_ctx()
+                    conn.priority = self.priority
                     return TrackerClient(host, port, self.timeout,
                                          conn=conn, release=self.pool.release)
                 t = TrackerClient(host, port, self.timeout)
                 t.conn.trace_ctx = self._wire_ctx()
+                t.conn.priority = self.priority
                 return t
             except OSError as e:
                 last_err = e
@@ -198,10 +256,16 @@ class FdfsClient:
                 # it.  EBUSY (16) is the exception — endpoint-specific
                 # load (max_connections refusal, non-leader) that another
                 # tracker may well answer; retry WITHOUT purging (the
-                # transport is fine).
+                # transport is fine).  Crucially it must NOT mark the
+                # endpoint dead either — an admission shed means "alive
+                # but shedding", and a dead-mark would steer the next
+                # dead_peer_cooldown_s of traffic away from a healthy
+                # tracker.  A shed's retry-after hint is honored
+                # (jittered) before the next attempt.
                 if e.status != 16:
                     raise
                 last = e
+                self._admission_wait(e)
             except (OSError, ProtocolError) as e:
                 last = e
                 if self.pool is not None:
@@ -213,10 +277,12 @@ class FdfsClient:
         if self.pool is not None:
             conn = self.pool.acquire(tgt.ip, tgt.port, self.timeout)
             conn.trace_ctx = self._wire_ctx()
+            conn.priority = self.priority
             return StorageClient(tgt.ip, tgt.port, self.timeout,
                                  conn=conn, release=self.pool.release)
         s = StorageClient(tgt.ip, tgt.port, self.timeout)
         s.conn.trace_ctx = self._wire_ctx()
+        s.conn.priority = self.priority
         return s
 
     # -- operations --------------------------------------------------------
@@ -290,11 +356,15 @@ class FdfsClient:
                     # tracker, which re-hashes the key itself.
                     self._placement = None
                     self._fallbacks["placement_fallback_tracker"] += 1
-        tgt = self._with_tracker(lambda t: t.query_store(group, key=key))
-        with self._storage(tgt) as s:
-            return s.upload_buffer(data, ext=ext,
-                                   store_path_index=tgt.store_path_index,
-                                   appender=appender)
+
+        def run():
+            tgt = self._with_tracker(
+                lambda t: t.query_store(group, key=key))
+            with self._storage(tgt) as s:
+                return s.upload_buffer(data, ext=ext,
+                                       store_path_index=tgt.store_path_index,
+                                       appender=appender)
+        return self._shed_retry(run)
 
     def _remember_digests(self, chunks) -> None:
         cache = self._seen_digests
@@ -367,18 +437,19 @@ class FdfsClient:
         # The classic one-connection path; also the ranged download's
         # transparent fallback target (it must never re-enter the
         # parallel gate, or a fallback recurses).
-        tgt = self._with_tracker(lambda t: t.query_fetch(file_id))
-        with self._storage(tgt) as s:
-            return s.download_to_buffer(file_id, offset, length)
+        return self._routed(lambda t: t.query_fetch(file_id),
+                            lambda s: s.download_to_buffer(file_id, offset,
+                                                           length))
 
     def download_stream(self, file_id: str, fh, offset: int = 0,
                         length: int = 0) -> int:
         """Stream (part of) a file into ``fh`` with O(segment) client
         memory (StorageClient.download_stream underneath).  Returns the
-        byte count written."""
-        tgt = self._with_tracker(lambda t: t.query_fetch(file_id))
-        with self._storage(tgt) as s:
-            return s.download_stream(file_id, fh, offset, length)
+        byte count written.  Shed-retry is safe here: a shed answers
+        the request header, so no body byte has reached ``fh`` yet."""
+        return self._routed(lambda t: t.query_fetch(file_id),
+                            lambda s: s.download_stream(file_id, fh, offset,
+                                                        length))
 
     def download_to_file(self, file_id: str, local_path: str,
                          offset: int = 0, length: int = 0,
@@ -405,9 +476,9 @@ class FdfsClient:
             return len(data)
         # Single stream: StorageClient owns the temp-file + rename
         # discipline (one implementation of the no-partial-file rule).
-        tgt = self._with_tracker(lambda t: t.query_fetch(file_id))
-        with self._storage(tgt) as s:
-            return s.download_to_file(file_id, local_path, offset, length)
+        return self._routed(lambda t: t.query_fetch(file_id),
+                            lambda s: s.download_to_file(file_id, local_path,
+                                                         offset, length))
 
     def download_ranged(self, file_id: str, offset: int = 0,
                         length: int = 0, parallel: int | None = None,
@@ -490,14 +561,12 @@ class FdfsClient:
             return self._download_single(file_id, offset, length)
 
     def delete_file(self, file_id: str) -> None:
-        tgt = self._with_tracker(lambda t: t.query_update(file_id))
-        with self._storage(tgt) as s:
-            s.delete_file(file_id)
+        self._routed(lambda t: t.query_update(file_id),
+                     lambda s: s.delete_file(file_id))
 
     def query_file_info(self, file_id: str) -> RemoteFileInfo:
-        tgt = self._with_tracker(lambda t: t.query_fetch(file_id))
-        with self._storage(tgt) as s:
-            return s.query_file_info(file_id)
+        return self._routed(lambda t: t.query_fetch(file_id),
+                            lambda s: s.query_file_info(file_id))
 
     def near_dups(self, file_id: str) -> list[tuple[str, float]]:
         """Ranked (file_id, score) near-duplicates of a stored file
@@ -508,14 +577,12 @@ class FdfsClient:
 
     def set_metadata(self, file_id: str, meta: dict[str, str],
                      merge: bool = False) -> None:
-        tgt = self._with_tracker(lambda t: t.query_update(file_id))
-        with self._storage(tgt) as s:
-            s.set_metadata(file_id, meta, merge)
+        self._routed(lambda t: t.query_update(file_id),
+                     lambda s: s.set_metadata(file_id, meta, merge))
 
     def get_metadata(self, file_id: str) -> dict[str, str]:
-        tgt = self._with_tracker(lambda t: t.query_fetch(file_id))
-        with self._storage(tgt) as s:
-            return s.get_metadata(file_id)
+        return self._routed(lambda t: t.query_fetch(file_id),
+                            lambda s: s.get_metadata(file_id))
 
     def upload_appender_buffer(self, data: bytes, ext: str = "",
                                group: str | None = None) -> str:
@@ -524,19 +591,16 @@ class FdfsClient:
     def append_buffer(self, file_id: str, data: bytes) -> None:
         """Append to an appender file (routed to the source server, like
         every mutation — reference query_fetch_update update path)."""
-        tgt = self._with_tracker(lambda t: t.query_update(file_id))
-        with self._storage(tgt) as s:
-            s.append_buffer(file_id, data)
+        self._routed(lambda t: t.query_update(file_id),
+                     lambda s: s.append_buffer(file_id, data))
 
     def modify_buffer(self, file_id: str, offset: int, data: bytes) -> None:
-        tgt = self._with_tracker(lambda t: t.query_update(file_id))
-        with self._storage(tgt) as s:
-            s.modify_buffer(file_id, offset, data)
+        self._routed(lambda t: t.query_update(file_id),
+                     lambda s: s.modify_buffer(file_id, offset, data))
 
     def truncate_file(self, file_id: str, new_size: int = 0) -> None:
-        tgt = self._with_tracker(lambda t: t.query_update(file_id))
-        with self._storage(tgt) as s:
-            s.truncate_file(file_id, new_size)
+        self._routed(lambda t: t.query_update(file_id),
+                     lambda s: s.truncate_file(file_id, new_size))
 
     def upload_slave_buffer(self, master_id: str, prefix: str, data: bytes,
                             ext: str = "") -> str:
@@ -634,6 +698,18 @@ class FdfsClient:
         shape per monitor.decode_health_status."""
         with self._storage(FetchTarget(ip=ip, port=port)) as s:
             return s.health_status()
+
+    def storage_admission_status(self, ip: str, port: int) -> dict:
+        """One storage daemon's admission-ladder status
+        (ADMISSION_STATUS); shape per monitor.decode_admission.  Born
+        control-class server-side, so it answers even at reads-only."""
+        with self._storage(FetchTarget(ip=ip, port=port)) as s:
+            return s.admission_status()
+
+    def tracker_admission_status(self) -> dict:
+        """The tracker's own admission-ladder status (ADMISSION_STATUS);
+        shape per monitor.decode_admission."""
+        return self._with_tracker(lambda t: t.admission_status())
 
     def scrub_status(self, ip: str, port: int) -> dict[str, int]:
         """One storage daemon's integrity-engine status (SCRUB_STATUS)."""

@@ -10,7 +10,9 @@ import threading
 import time
 from collections import deque
 
-from fastdfs_tpu.common.protocol import HEADER_SIZE, Header, pack_header, unpack_header
+from fastdfs_tpu.common.protocol import (HEADER_SIZE, Header, pack_header,
+                                         priority_frame, unpack_header,
+                                         unpack_retry_after)
 
 
 class ProtocolError(Exception):
@@ -18,10 +20,17 @@ class ProtocolError(Exception):
 
 
 class StatusError(ProtocolError):
-    """Non-zero status byte in a response header."""
+    """Non-zero status byte in a response header.
 
-    def __init__(self, status: int, context: str = ""):
+    ``retry_after_ms``: for EBUSY (16) refusals from the admission
+    ladder the daemon's error body carries a retry-after hint; 0 for
+    every other status (and for EBUSY sources that predate the hint —
+    max_connections refusals, drain refusals)."""
+
+    def __init__(self, status: int, context: str = "",
+                 retry_after_ms: int = 0):
         self.status = status
+        self.retry_after_ms = retry_after_ms
         super().__init__(f"server returned status {status}"
                          + (f" ({context})" if context else ""))
 
@@ -39,6 +48,13 @@ class Connection:
         # the pool clears it on release so a parked connection never
         # leaks one caller's trace onto the next.
         self.trace_ctx = None
+        # Request QoS: when set (a PriorityClass int), every request is
+        # prefixed with its 1-byte PRIORITY frame so the daemon's
+        # admission ladder knows the class (untagged requests get an
+        # opcode-derived default server-side).  Sticky like trace_ctx —
+        # the daemon consumes one tag per request, so the frame is
+        # re-sent each time — and cleared by the pool on release.
+        self.priority = None
         self.sock = self._connect()
 
     def _connect(self) -> socket.socket:
@@ -82,6 +98,9 @@ class Connection:
             # applies it to this request (it sends no response of its
             # own, so request/response pairing is unchanged).
             hdr = self.trace_ctx.frame() + hdr
+        if self.priority is not None:
+            # Same prefix-frame discipline for the QoS class byte.
+            hdr = priority_frame(self.priority) + hdr
         first = hdr if streaming else hdr + bytes(body)
         try:
             self.sock.sendall(first)
@@ -181,10 +200,14 @@ class Connection:
 
     def _raise_status(self, hdr: Header, context: str) -> None:
         # Error responses may carry a (small) body; drain it so the
-        # connection stays framed and reusable.
-        if hdr.pkg_len:
-            self.recv_exact(hdr.pkg_len)
-        raise StatusError(hdr.status, context)
+        # connection stays framed and reusable.  An EBUSY body is the
+        # admission ladder's 8-byte retry-after hint — surface it on
+        # the exception (unpack_retry_after answers 0 for the short or
+        # absent bodies older EBUSY sources send).
+        body = self.recv_exact(hdr.pkg_len) if hdr.pkg_len else b""
+        raise StatusError(hdr.status, context,
+                          retry_after_ms=(unpack_retry_after(body)
+                                          if hdr.status == 16 else 0))
 
     def recv_response_into(self, mv: memoryview, context: str = "") -> None:
         """Response whose body lands in a caller buffer of EXACTLY the
@@ -397,6 +420,7 @@ class ConnectionPool:
 
     def release(self, conn: Connection) -> None:
         conn.trace_ctx = None  # a parked conn must not carry a stale trace
+        conn.priority = None   # ...nor a stale QoS class
         key = (conn.host, conn.port)
         if conn.broken:
             conn.close()

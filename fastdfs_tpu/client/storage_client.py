@@ -493,6 +493,14 @@ class StorageClient:
         self.conn.send_request(StorageCmd.HEALTH_STATUS)
         return json.loads(self.conn.recv_response("health_status") or b"{}")
 
+    def admission_status(self) -> dict:
+        """Admission-ladder status (ADMISSION_STATUS 148): current shed
+        level, pressure EWMA, per-class shed counts.  Shape per
+        fastdfs_tpu.monitor.decode_admission."""
+        self.conn.send_request(StorageCmd.ADMISSION_STATUS)
+        return json.loads(self.conn.recv_response("admission_status")
+                          or b"{}")
+
     def scrub_status(self) -> dict[str, int]:
         """Integrity-engine status (SCRUB_STATUS 134): named scrub/GC
         counters decoded from the fixed int64 blob (SCRUB_STAT_FIELDS).

@@ -287,6 +287,14 @@ class TrackerClient:
         self.conn.send_request(TrackerCmd.HEALTH_MATRIX)
         return json.loads(self.conn.recv_response("health_matrix") or b"{}")
 
+    def admission_status(self) -> dict:
+        """Admission-ladder status (ADMISSION_STATUS 148): current shed
+        level, pressure EWMA, per-class shed counts.  Shape per
+        fastdfs_tpu.monitor.decode_admission."""
+        self.conn.send_request(TrackerCmd.ADMISSION_STATUS)
+        return json.loads(self.conn.recv_response("admission_status")
+                          or b"{}")
+
     def metrics_history(self, since_us: int = 0) -> dict:
         """Metrics-journal window dump (METRICS_HISTORY 99): the
         tracker's retained registry snapshots with ts_us >= since_us

@@ -233,6 +233,116 @@ def unpack_trace_ctx(buf: bytes) -> tuple[int, int, int]:
         raise ValueError(f"short trace ctx: {len(buf)} < {TRACE_CTX_LEN}")
     return _TRACE_CTX_STRUCT.unpack_from(buf)
 
+# ---------------------------------------------------------------------------
+# Request QoS / admission control (fastdfs_tpu extension; no reference
+# equivalent — upstream FastDFS queues past saturation unboundedly).
+#
+# Every request has a priority class.  A tagged request is prefixed by
+# one PRIORITY frame: a normal 10-byte header with cmd=PRIORITY and
+# pkg_len=PRIORITY_FRAME_LEN whose body is the single class byte.  Like
+# TRACE_CTX the frame elicits NO response; the daemon stashes the class
+# on the connection and applies it to the NEXT request.  Untagged
+# requests default by opcode class (DefaultPriorityClass below —
+# scrub/rebalance/sync traffic is born BACKGROUND), so an un-upgraded
+# client is byte-identical to the pre-QoS protocol and still gets sane
+# shedding behavior.
+#
+# The admission ladder (native/storage/admission.h AdmissionController):
+#   level 0  admit everything
+#   level 1  shed BACKGROUND
+#   level 2  shed BULK + BACKGROUND
+#   level 3  shed everything but CONTROL + INTERACTIVE (reads)
+# i.e. a class is admitted at level L iff  class + L <= 4.  A shed
+# request is answered EBUSY with an 8-byte big-endian retry-after hint
+# in milliseconds as the response body; the client backs off (with
+# jitter) instead of hammering a saturated daemon.
+# ---------------------------------------------------------------------------
+
+PRIORITY_FRAME_LEN = 1
+
+
+class PriorityClass(enum.IntEnum):
+    """Request priority classes, best (never shed) first."""
+
+    CONTROL = 0      # stats/health/admin plane — how operators see in
+    INTERACTIVE = 1  # client reads: downloads, metadata, file info
+    NORMAL = 2       # client writes: uploads, appends, deletes
+    BULK = 3         # negotiated bulk ingest (recipe/chunk uploads)
+    BACKGROUND = 4   # replication, recovery fetches, EC release
+
+
+def admitted_at_level(priority_class: int, level: int) -> bool:
+    """The ladder contract: class c is admitted at level L iff c + L <= 4
+    (level 0 admits all; level 3 admits only control + reads).  Mirrors
+    AdmissionController::Admit — pinned by the fdfs_codec
+    admission-ladder golden."""
+    return level <= 0 or priority_class + level <= PriorityClass.BACKGROUND
+
+
+def pack_priority(priority_class: int) -> bytes:
+    """1-byte PRIORITY frame body."""
+    if not 0 <= priority_class <= 0xFF:
+        raise ValueError(f"bad priority class: {priority_class}")
+    return bytes([priority_class])
+
+
+def unpack_priority(buf: bytes) -> int:
+    if len(buf) < PRIORITY_FRAME_LEN:
+        raise ValueError("short priority frame")
+    return buf[0]
+
+
+def priority_frame(priority_class: int) -> bytes:
+    """The full prefix frame (header + class byte) sent before a tagged
+    request; elicits no response."""
+    return pack_header(PRIORITY_FRAME_LEN, StorageCmd.PRIORITY) \
+        + pack_priority(priority_class)
+
+
+def pack_retry_after(retry_after_ms: int) -> bytes:
+    """EBUSY shed-response body: the daemon's backoff hint."""
+    return long2buff(int(retry_after_ms))
+
+
+def unpack_retry_after(buf: bytes) -> int:
+    """Retry-after ms from an EBUSY body; 0 when the body carries none
+    (older daemons and non-admission EBUSYs answer status-only)."""
+    if len(buf) < 8:
+        return 0
+    return max(buff2long(buf), 0)
+
+
+# Untagged requests default by opcode (the C++ mirror is
+# DefaultPriorityClass in native/storage/admission.cc; the two tables
+# are pinned against each other by the fdfs_codec priority-frame
+# golden).  Keyed by raw cmd value; anything unlisted is NORMAL.
+_STORAGE_PRIORITY_DEFAULTS: dict[int, int] = {}
+
+
+def default_priority_class(cmd: int) -> int:
+    """Born-priority of an untagged storage-port request."""
+    if not _STORAGE_PRIORITY_DEFAULTS:
+        S, P = StorageCmd, PriorityClass
+        for c in (S.STAT, S.TRACE_DUMP, S.EVENT_DUMP, S.METRICS_HISTORY,
+                  S.HEAT_TOP, S.SCRUB_STATUS, S.SCRUB_KICK, S.EC_STATUS,
+                  S.EC_KICK, S.HEALTH_STATUS, S.ADMISSION_STATUS,
+                  S.PROFILE_CTL, S.PROFILE_DUMP, S.ACTIVE_TEST,
+                  S.QUERY_FILE_INFO):
+            _STORAGE_PRIORITY_DEFAULTS[int(c)] = int(P.CONTROL)
+        for c in (S.DOWNLOAD_FILE, S.GET_METADATA, S.NEAR_DUPS):
+            _STORAGE_PRIORITY_DEFAULTS[int(c)] = int(P.INTERACTIVE)
+        for c in (S.UPLOAD_RECIPE, S.UPLOAD_CHUNKS):
+            _STORAGE_PRIORITY_DEFAULTS[int(c)] = int(P.BULK)
+        for c in (S.SYNC_CREATE_FILE, S.SYNC_DELETE_FILE,
+                  S.SYNC_UPDATE_FILE, S.SYNC_CREATE_LINK,
+                  S.SYNC_APPEND_FILE, S.SYNC_MODIFY_FILE,
+                  S.SYNC_TRUNCATE_FILE, S.SYNC_QUERY_CHUNKS,
+                  S.SYNC_CREATE_RECIPE, S.FETCH_ONE_PATH_BINLOG,
+                  S.FETCH_RECIPE, S.FETCH_CHUNK, S.EC_RELEASE):
+            _STORAGE_PRIORITY_DEFAULTS[int(c)] = int(P.BACKGROUND)
+    return _STORAGE_PRIORITY_DEFAULTS.get(int(cmd), int(PriorityClass.NORMAL))
+
+
 _HEADER_STRUCT = struct.Struct(">qBB")
 
 
@@ -374,6 +484,22 @@ class TrackerCmd(enum.IntEnum):
     # (see TRACE_CTX_LEN above).  Deliberately the SAME value on both
     # ports (StorageCmd.TRACE_CTX) so framing code is shared.
     TRACE_CTX = 140
+    # fastdfs_tpu extension: request-priority prefix frame (see
+    # PRIORITY_FRAME_LEN above).  Same value on both ports
+    # (StorageCmd.PRIORITY) so framing code is shared.  On the tracker
+    # the class gates the EXPENSIVE observability dumps (cluster stat,
+    # metrics history, trace/event/profile dumps are born BULK) while
+    # beats, joins, and service queries stay CONTROL — a lagging
+    # single-loop tracker sheds dashboards before it sheds the cluster.
+    PRIORITY = 147
+    # fastdfs_tpu extension: admission-controller snapshot.  Empty body
+    # -> JSON {"role","port","enabled","level","level_name","pressure",
+    # "ewma","tighten_threshold","relax_threshold","tightens","relaxes",
+    # "retry_after_ms","admitted","shed","shed_by_class":{...}} per
+    # fastdfs_tpu.monitor.decode_admission; pinned by the fdfs_codec
+    # admission-json cross-language golden.  Same contract as
+    # StorageCmd.ADMISSION_STATUS.
+    ADMISSION_STATUS = 148
 
 
 class StorageCmd(enum.IntEnum):
@@ -600,6 +726,18 @@ class StorageCmd(enum.IntEnum):
     # fastdfs_tpu.monitor.decode_health_status; pinned by the fdfs_codec
     # health-status cross-language golden.
     HEALTH_STATUS = 146
+    # Request-priority prefix frame (same value as TrackerCmd.PRIORITY;
+    # body = the single class byte, no response — see the admission
+    # section above).  The class applies to the NEXT request on the
+    # connection; untagged requests default by opcode
+    # (default_priority_class), so sync/recovery/EC traffic is born
+    # BACKGROUND and shed first when the admission ladder tightens.
+    PRIORITY = 147
+    # Admission-controller snapshot (contract documented on
+    # TrackerCmd.ADMISSION_STATUS; pinned by the fdfs_codec
+    # admission-json cross-language golden).  Always answers, even
+    # while shedding — it is CONTROL class by construction.
+    ADMISSION_STATUS = 148
 
     RESP = 100
     ACTIVE_TEST = 111
@@ -655,6 +793,10 @@ WIRE_GOLDENS = {
     "StorageCmd.EC_RELEASE": "ec-stripe-layout",
     "TrackerCmd.HEALTH_MATRIX": "health-matrix",
     "StorageCmd.HEALTH_STATUS": "health-status",
+    "StorageCmd.PRIORITY": "priority-frame",
+    "TrackerCmd.PRIORITY": "priority-frame",
+    "StorageCmd.ADMISSION_STATUS": "admission-json",
+    "TrackerCmd.ADMISSION_STATUS": "admission-json",
 }
 
 

@@ -442,6 +442,73 @@ def decode_health_matrix(obj: dict) -> HealthMatrix:
 
 
 # ---------------------------------------------------------------------------
+# admission-ladder decoding (ADMISSION_STATUS; native/storage/admission.h).
+# Wire shape pinned cross-language by the fdfs_codec admission-json golden.
+# ---------------------------------------------------------------------------
+
+# Ladder rung names, index == level (mirror of AdmissionController's
+# level_name(); level L sheds every class c with c + L > 4).
+ADMISSION_LEVELS = ("admit-all", "shed-background", "shed-bulk", "reads-only")
+
+# Priority-class names, index == class byte (mirror of
+# PriorityClassName / protocol.PriorityClass).
+PRIORITY_CLASSES = ("control", "interactive", "normal", "bulk", "background")
+
+
+@dataclass(frozen=True)
+class AdmissionStatus:
+    """One daemon's ADMISSION_STATUS view: where the shed ladder sits
+    right now and what it has refused so far."""
+    role: str
+    port: int
+    enabled: bool
+    level: int
+    level_name: str
+    pressure: float
+    ewma: float
+    tighten_threshold: float
+    relax_threshold: float
+    tightens: int
+    relaxes: int
+    retry_after_ms: int
+    admitted: int
+    shed: int
+    shed_by_class: dict  # class name -> lifetime shed count
+
+
+def decode_admission(obj: dict) -> AdmissionStatus:
+    """Validate and decode one daemon's ADMISSION_STATUS JSON (unknown
+    extra keys are ignored — the wire contract is append-only)."""
+    if not isinstance(obj, dict):
+        raise ValueError(f"admission status must be an object: {obj!r}")
+    try:
+        level = int(obj["level"])
+        name = str(obj["level_name"])
+        if not 0 <= level < len(ADMISSION_LEVELS):
+            raise ValueError(f"level {level} out of range")
+        if name != ADMISSION_LEVELS[level]:
+            raise ValueError(f"level_name {name!r} does not match "
+                             f"level {level}")
+        by_class = {str(k): int(v)
+                    for k, v in dict(obj.get("shed_by_class", {})).items()}
+        if any(k not in PRIORITY_CLASSES for k in by_class):
+            unknown = sorted(set(by_class) - set(PRIORITY_CLASSES))
+            raise ValueError(f"unknown shed classes {unknown}")
+        return AdmissionStatus(
+            role=str(obj["role"]), port=int(obj["port"]),
+            enabled=bool(obj["enabled"]), level=level, level_name=name,
+            pressure=float(obj["pressure"]), ewma=float(obj["ewma"]),
+            tighten_threshold=float(obj["tighten_threshold"]),
+            relax_threshold=float(obj["relax_threshold"]),
+            tightens=int(obj["tightens"]), relaxes=int(obj["relaxes"]),
+            retry_after_ms=int(obj["retry_after_ms"]),
+            admitted=int(obj["admitted"]), shed=int(obj["shed"]),
+            shed_by_class=by_class)
+    except (KeyError, TypeError, ValueError) as err:
+        raise ValueError(f"malformed admission status: {err}") from None
+
+
+# ---------------------------------------------------------------------------
 # SLO rule table (mirror of native/common/sloeval.cc; the fdfs_codec
 # slo-conf golden pins the two parsers against each other)
 # ---------------------------------------------------------------------------
@@ -739,6 +806,13 @@ def top_rates(prev: TopSample | None, cur: TopSample) -> dict[str, dict]:
             "stalled_threads": reg["gauges"].get(
                 "watchdog.stalled_threads", 0),
             "worst_peer": _worst_peer_gauge(reg),
+            # Admission-ladder gauges (admission.h PublishGauges).
+            # None = this daemon predates the admission layer — the
+            # ADMISSION pane skips it rather than inventing level 0.
+            "admission_level": reg["gauges"].get("admission.level"),
+            "shed_s": round(crate(gauge(reg, "admission.shed_total"),
+                                  gauge(preg, "admission.shed_total")
+                                  if preg else 0), 1),
         }
     return out
 
@@ -856,6 +930,22 @@ def render_top(cur: TopSample, rates: dict[str, dict],
         lines.append("HEALTH: " +
                      "; ".join(p for _, p in sorted(
                          health, key=lambda h: (h[0], h[1]))))
+    # ADMISSION line: shown only while some node is actually shedding
+    # (level > 0 or a nonzero shed rate) — at admit-all it is noise.
+    # Sorted tightest-first so the overloaded node leads the line.
+    admission = []
+    for node, r in rates.items():
+        lvl = r.get("admission_level")
+        if lvl is None or (lvl == 0 and not r.get("shed_s")):
+            continue
+        name = (ADMISSION_LEVELS[lvl] if 0 <= lvl < len(ADMISSION_LEVELS)
+                else str(lvl))
+        admission.append(
+            (-lvl, node, f"{node}: {name} shed/s={r.get('shed_s', 0)}"))
+    if admission:
+        lines.append("")
+        lines.append("ADMISSION: " +
+                     "; ".join(p for _, _, p in sorted(admission)))
     lines.append("")
     lines.append(f"recent events (last {max_events}):")
     for e in recent_events[-max_events:]:

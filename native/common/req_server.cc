@@ -161,6 +161,42 @@ void RequestServer::Dispatch(Conn* c) {
     c->body.clear();
     return;  // ReadConn keeps going: next bytes are the traced request
   }
+  if (c->cmd == static_cast<uint8_t>(TrackerCmd::kPriority)) {
+    // Priority prefix frame (the TRACE_CTX pattern): 1B class byte,
+    // no response, tags the next request on this connection.
+    if (c->pkg_len != kPriorityFrameLen) {
+      CloseConn(c);
+      return;
+    }
+    c->priority = static_cast<uint8_t>(c->body[0]);
+    c->header_got = 0;
+    c->in_body = false;
+    c->body.clear();
+    return;
+  }
+  const uint8_t tagged = c->priority;
+  c->priority = 0xFF;  // one frame tags one request
+  if (gate_) {
+    int64_t retry_ms = 0;
+    if (!gate_(c->cmd, tagged, &retry_ms)) {
+      // Shed: EBUSY + the 8-byte BE retry-after hint.  The connection
+      // stays usable — forcing a reconnect would ADD load during the
+      // very overload the gate exists to relieve.
+      c->trace = TraceCtx{};
+      c->header_got = 0;
+      c->in_body = false;
+      c->body.clear();
+      c->out.resize(kHeaderSize + 8);
+      PutInt64BE(8, reinterpret_cast<uint8_t*>(c->out.data()));
+      c->out[8] = static_cast<char>(TrackerCmd::kResp);
+      c->out[9] = 16;  // EBUSY
+      PutInt64BE(retry_ms,
+                 reinterpret_cast<uint8_t*>(c->out.data()) + kHeaderSize);
+      c->out_off = 0;
+      FlushConn(c);
+      return;
+    }
+  }
   dispatched_count_++;
   int64_t start_us = trace_hook_ ? TraceWallUs() : 0;
   auto [status, resp] = handler_(c->cmd, c->body, c->peer_ip);

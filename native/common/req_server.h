@@ -33,6 +33,14 @@ class RequestServer {
                                        int64_t start_us, int64_t dur_us,
                                        uint8_t status,
                                        const std::string& peer_ip)>;
+  // Admission gate, consulted before every dispatch (never for prefix
+  // frames): (cmd, tagged_class, out retry_after_ms) -> admit?
+  // tagged_class is the raw byte from a PRIORITY prefix frame (0xFF =
+  // untagged; the owner resolves the opcode default — this layer knows
+  // nothing about class tables).  False => the server answers EBUSY
+  // with the 8-byte BE retry-after hint and keeps the connection.
+  using Gate =
+      std::function<bool(uint8_t cmd, uint8_t tagged_class, int64_t* retry_ms)>;
 
   RequestServer(EventLoop* loop, Handler handler, int64_t max_body = 16 << 20)
       : loop_(loop), handler_(std::move(handler)), max_body_(max_body) {}
@@ -46,6 +54,7 @@ class RequestServer {
   void set_max_connections(int n) { max_connections_ = n; }
   int64_t refused_count() const { return refused_count_; }
   void set_trace_hook(TraceHook hook) { trace_hook_ = std::move(hook); }
+  void set_gate(Gate gate) { gate_ = std::move(gate); }
   // Saturation gauges (ISSUE 6): live connections and requests served.
   // Loop-thread values read by registry gauge-fns at snapshot time —
   // the snapshot RPC itself runs on this loop, so no extra locking.
@@ -67,6 +76,9 @@ class RequestServer {
     // Trace context from a TRACE_CTX prefix frame; applies to (and is
     // consumed by) the next dispatched request.
     TraceCtx trace;
+    // Raw class byte from a PRIORITY prefix frame (0xFF = untagged);
+    // consumed by the next dispatched request like trace.
+    uint8_t priority = 0xFF;
   };
 
   void OnAccept(uint32_t events);
@@ -79,6 +91,7 @@ class RequestServer {
   EventLoop* loop_;
   Handler handler_;
   TraceHook trace_hook_;
+  Gate gate_;
   int64_t max_body_;
   int listen_fd_ = -1;
   int max_connections_ = 256;

@@ -1,0 +1,190 @@
+#include "storage/admission.h"
+
+#include <cstdio>
+
+#include "common/protocol_gen.h"
+
+namespace fdfs {
+
+namespace {
+
+// %.6g like sloeval's event details, so thresholds read identically in
+// slo.breach and admission.tighten events.
+std::string Fmt6g(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%.6g", v);
+  return buf;
+}
+
+}  // namespace
+
+const char* PriorityClassName(uint8_t cls) {
+  switch (cls) {
+    case kPriorityControl: return "control";
+    case kPriorityInteractive: return "interactive";
+    case kPriorityNormal: return "normal";
+    case kPriorityBulk: return "bulk";
+    default: return "background";
+  }
+}
+
+uint8_t DefaultPriorityClass(uint8_t storage_cmd) {
+  switch (static_cast<StorageCmd>(storage_cmd)) {
+    // Observability/admin plane: always answer — it is how operators
+    // (and the admission subsystem's own status op) see in.
+    case StorageCmd::kStat:
+    case StorageCmd::kTraceDump:
+    case StorageCmd::kEventDump:
+    case StorageCmd::kMetricsHistory:
+    case StorageCmd::kHeatTop:
+    case StorageCmd::kScrubStatus:
+    case StorageCmd::kScrubKick:
+    case StorageCmd::kEcStatus:
+    case StorageCmd::kEcKick:
+    case StorageCmd::kHealthStatus:
+    case StorageCmd::kAdmissionStatus:
+    case StorageCmd::kProfileCtl:
+    case StorageCmd::kProfileDump:
+    case StorageCmd::kActiveTest:
+    case StorageCmd::kQueryFileInfo:
+      return kPriorityControl;
+    // Client reads survive to the last rung.
+    case StorageCmd::kDownloadFile:
+    case StorageCmd::kGetMetadata:
+    case StorageCmd::kNearDups:
+      return kPriorityInteractive;
+    // Negotiated bulk ingest: the big-payload path, shed before plain
+    // writes.
+    case StorageCmd::kUploadRecipe:
+    case StorageCmd::kUploadChunks:
+      return kPriorityBulk;
+    // Replication, recovery, and EC traffic is born background: peers
+    // retry from their binlog cursors, so shedding it first trades
+    // sync lag (bounded, measured, recoverable) for client latency.
+    case StorageCmd::kSyncCreateFile:
+    case StorageCmd::kSyncDeleteFile:
+    case StorageCmd::kSyncUpdateFile:
+    case StorageCmd::kSyncCreateLink:
+    case StorageCmd::kSyncAppendFile:
+    case StorageCmd::kSyncModifyFile:
+    case StorageCmd::kSyncTruncateFile:
+    case StorageCmd::kSyncQueryChunks:
+    case StorageCmd::kSyncCreateRecipe:
+    case StorageCmd::kFetchOnePathBinlog:
+    case StorageCmd::kFetchRecipe:
+    case StorageCmd::kFetchChunk:
+    case StorageCmd::kEcRelease:
+      return kPriorityBackground;
+    default:
+      return kPriorityNormal;  // client writes: uploads, appends, deletes
+  }
+}
+
+uint8_t DefaultTrackerPriorityClass(uint8_t tracker_cmd) {
+  switch (static_cast<TrackerCmd>(tracker_cmd)) {
+    // The expensive observability dumps: a lagging single-loop tracker
+    // sheds dashboards before it sheds beats or lookups.
+    case TrackerCmd::kServerClusterStat:
+    case TrackerCmd::kTraceDump:
+    case TrackerCmd::kEventDump:
+    case TrackerCmd::kMetricsHistory:
+    case TrackerCmd::kProfileDump:
+    case TrackerCmd::kHealthMatrix:
+      return kPriorityBulk;
+    default:
+      // Beats, joins, sync negotiation, service queries, leader RPCs:
+      // the cluster's control plane, never shed by default.
+      return kPriorityControl;
+  }
+}
+
+double AdmissionController::PressureScore(const AdmissionConfig& cfg,
+                                          const AdmissionSignals& s) {
+  // One active SLO breach reads as 1.0 — a sustained breach alone walks
+  // the ladder up; multiple concurrent breaches push harder.
+  double score = static_cast<double>(s.breaches_active);
+  if (cfg.queue_depth_high > 0)
+    score = std::max(score, static_cast<double>(s.queue_depth) /
+                                static_cast<double>(cfg.queue_depth_high));
+  if (cfg.loop_lag_high_ms > 0 && s.loop_lag_p99_ms >= 0)
+    score = std::max(score, s.loop_lag_p99_ms / cfg.loop_lag_high_ms);
+  if (cfg.inflight_high_bytes > 0)
+    score = std::max(score, static_cast<double>(s.inflight_bytes) /
+                                static_cast<double>(cfg.inflight_high_bytes));
+  return score;
+}
+
+int AdmissionController::Tick(const AdmissionSignals& s) {
+  if (!cfg_.enabled) return 0;
+  double score = PressureScore(cfg_, s);
+  ewma_ = have_ewma_ ? kAlpha * score + (1 - kAlpha) * ewma_ : score;
+  have_ewma_ = true;
+  pressure_milli_.store(static_cast<int64_t>(score * 1000),
+                        std::memory_order_relaxed);
+  ewma_milli_.store(static_cast<int64_t>(ewma_ * 1000),
+                    std::memory_order_relaxed);
+  int lvl = level_.load(std::memory_order_relaxed);
+  if (ewma_ > cfg_.tighten_threshold && lvl < kMaxLevel) {
+    level_.store(lvl + 1, std::memory_order_relaxed);
+    tightens_.fetch_add(1, std::memory_order_relaxed);
+    return +1;
+  }
+  if (ewma_ <= cfg_.relax_threshold && lvl > 0) {
+    level_.store(lvl - 1, std::memory_order_relaxed);
+    relaxes_.fetch_add(1, std::memory_order_relaxed);
+    return -1;
+  }
+  return 0;
+}
+
+bool AdmissionController::AdmitOrShed(uint8_t cls, int64_t* retry_after_ms) {
+  if (WouldAdmit(cls)) {
+    admitted_.fetch_add(1, std::memory_order_relaxed);
+    return true;
+  }
+  shed_.fetch_add(1, std::memory_order_relaxed);
+  shed_class_[ClampClass(cls)].fetch_add(1, std::memory_order_relaxed);
+  if (retry_after_ms) *retry_after_ms = this->retry_after_ms();
+  return false;
+}
+
+const char* AdmissionController::level_name() const {
+  switch (level()) {
+    case 0: return "admit-all";
+    case 1: return "shed-background";
+    case 2: return "shed-bulk";
+    default: return "reads-only";
+  }
+}
+
+std::string AdmissionController::StatusJson(const char* role,
+                                            int port) const {
+  std::string out = "{\"role\":\"";
+  out += role;
+  out += "\",\"port\":" + std::to_string(port);
+  out += ",\"enabled\":";
+  out += cfg_.enabled ? "true" : "false";
+  out += ",\"level\":" + std::to_string(level());
+  out += ",\"level_name\":\"";
+  out += level_name();
+  out += "\",\"pressure\":" + Fmt6g(pressure_milli() / 1000.0);
+  out += ",\"ewma\":" + Fmt6g(ewma_milli() / 1000.0);
+  out += ",\"tighten_threshold\":" + Fmt6g(cfg_.tighten_threshold);
+  out += ",\"relax_threshold\":" + Fmt6g(cfg_.relax_threshold);
+  out += ",\"tightens\":" + std::to_string(tightens());
+  out += ",\"relaxes\":" + std::to_string(relaxes());
+  out += ",\"retry_after_ms\":" + std::to_string(retry_after_ms());
+  out += ",\"admitted\":" + std::to_string(admitted());
+  out += ",\"shed\":" + std::to_string(shed_total());
+  out += ",\"shed_by_class\":{";
+  for (int c = 0; c < kPriorityClassCount; ++c) {
+    if (c) out += ",";
+    out += "\"";
+    out += PriorityClassName(static_cast<uint8_t>(c));
+    out += "\":" + std::to_string(shed_by_class(c));
+  }
+  out += "}}";
+  return out;
+}
+
+}  // namespace fdfs

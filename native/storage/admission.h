@@ -1,0 +1,167 @@
+// Admission control & request QoS: the subsystem that sheds load BEFORE
+// saturation kills every caller's p99 (ROADMAP item 5; upstream FastDFS
+// queues past capacity unboundedly and collapses for everyone at once).
+//
+// Every request has a 5-class priority (protocol.py PriorityClass —
+// control, interactive reads, normal writes, bulk ingest, background).
+// A client may tag a request explicitly with a PRIORITY prefix frame
+// (the TRACE_CTX pattern: one class byte, no response, applies to the
+// next request); untagged requests default by opcode
+// (DefaultPriorityClass) so replication/recovery/EC traffic is born
+// background and an un-upgraded client still degrades sanely.
+//
+// The controller runs an admission-level LADDER:
+//   level 0  admit everything
+//   level 1  shed background
+//   level 2  shed bulk + background
+//   level 3  shed everything but control + interactive reads
+// (class c admitted at level L iff c + L <= 4).  The level moves at
+// most one rung per metrics tick, driven by a composite pressure score
+// — SLO breach count (sloeval), dio queue depth, reactor loop-lag p99,
+// and admitted-but-unanswered request bytes, each normalized so 1.0
+// means "at the configured limit" — smoothed through the SAME
+// EWMA+hysteresis discipline as sloeval (alpha 0.5; tighten only when
+// the EWMA exceeds tighten_threshold, relax only when it falls to
+// relax_threshold < tighten_threshold), so one noisy sample can
+// neither shed nor un-shed and the ladder cannot flap.
+//
+// A shed request is answered EBUSY with an 8-byte big-endian
+// retry-after hint (ms, level-scaled) as the response body; the Python
+// client honors it with jittered backoff and does NOT dead-mark the
+// peer (an admission EBUSY is the daemon protecting itself, not dying).
+//
+// Concurrency: Tick() runs on the owning daemon's main loop only (the
+// metrics timer).  Admit()/AdmitOrShed() run on any nio thread and read
+// one atomic level; counters are relaxed atomics read by registry
+// gauge-fns.  No locks, no new ranks.
+#pragma once
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <string>
+
+namespace fdfs {
+
+// Mirrors fastdfs_tpu.common.protocol.PriorityClass (pinned by the
+// fdfs_codec priority-frame golden).
+constexpr uint8_t kPriorityControl = 0;
+constexpr uint8_t kPriorityInteractive = 1;
+constexpr uint8_t kPriorityNormal = 2;
+constexpr uint8_t kPriorityBulk = 3;
+constexpr uint8_t kPriorityBackground = 4;
+constexpr int kPriorityClassCount = 5;
+// Conn-level sentinel: no PRIORITY frame seen, resolve by opcode.
+constexpr uint8_t kPriorityUntagged = 0xFF;
+
+const char* PriorityClassName(uint8_t cls);
+
+// Born-priority of an untagged request, by opcode.  The Python mirror
+// is protocol.default_priority_class; the two tables are pinned
+// against each other by the fdfs_codec priority-frame golden.
+uint8_t DefaultPriorityClass(uint8_t storage_cmd);
+// Tracker port: the expensive observability dumps are born bulk, the
+// cluster-critical plane (beats, joins, service queries) control.
+uint8_t DefaultTrackerPriorityClass(uint8_t tracker_cmd);
+
+struct AdmissionConfig {
+  bool enabled = true;
+  // Ladder movement: tighten a level when the pressure EWMA exceeds
+  // tighten_threshold, relax one when it falls to relax_threshold.
+  // The gap between them is the hysteresis band where the level holds.
+  double tighten_threshold = 0.9;
+  double relax_threshold = 0.45;
+  // Normalization points: the signal value that reads as 1.0 pressure.
+  int64_t queue_depth_high = 64;        // dio jobs pending
+  double loop_lag_high_ms = 100.0;      // reactor loop-lag p99
+  int64_t inflight_high_bytes = 256ll << 20;  // admitted unanswered bytes
+  // Base backoff hint; the wire carries base * current level.
+  int64_t retry_after_ms = 500;
+};
+
+// One tick's worth of pressure inputs, computed by the owning daemon
+// (the storage server reads its SLO engine, dio pools, loop-lag
+// histograms, and in-flight byte ledger; the tracker its single loop).
+// loop_lag_p99_ms < 0 means "unavailable this tick" (no traffic
+// crossed the window) and the component is skipped.
+struct AdmissionSignals {
+  int64_t breaches_active = 0;
+  int64_t queue_depth = 0;
+  double loop_lag_p99_ms = -1.0;
+  int64_t inflight_bytes = 0;
+};
+
+class AdmissionController {
+ public:
+  explicit AdmissionController(const AdmissionConfig& cfg) : cfg_(cfg) {}
+
+  // Evaluate one tick: fold the signals into the pressure EWMA and move
+  // the ladder at most one rung.  Returns +1 (tightened), -1 (relaxed),
+  // or 0.  Main-loop only (single caller by contract).
+  int Tick(const AdmissionSignals& s);
+
+  // Header-stage consult (any thread).  `cls` must already be resolved
+  // (never kPriorityUntagged).  True = admit.  On shed, bumps the
+  // per-class counter and writes the level-scaled retry-after hint.
+  bool AdmitOrShed(uint8_t cls, int64_t* retry_after_ms);
+  bool WouldAdmit(uint8_t cls) const {
+    int lvl = level_.load(std::memory_order_relaxed);
+    return !cfg_.enabled || lvl <= 0 || ClampClass(cls) + lvl <= kPriorityBackground;
+  }
+
+  int level() const { return level_.load(std::memory_order_relaxed); }
+  const char* level_name() const;
+  int64_t retry_after_ms() const {
+    return cfg_.retry_after_ms * std::max(level(), 1);
+  }
+  // Milli-units so gauge-fns stay integer (pressure 1.0 -> 1000).
+  int64_t pressure_milli() const {
+    return pressure_milli_.load(std::memory_order_relaxed);
+  }
+  int64_t ewma_milli() const {
+    return ewma_milli_.load(std::memory_order_relaxed);
+  }
+  int64_t tightens() const { return tightens_.load(std::memory_order_relaxed); }
+  int64_t relaxes() const { return relaxes_.load(std::memory_order_relaxed); }
+  int64_t admitted() const { return admitted_.load(std::memory_order_relaxed); }
+  int64_t shed_total() const { return shed_.load(std::memory_order_relaxed); }
+  int64_t shed_by_class(int cls) const {
+    return shed_class_[ClampClass(static_cast<uint8_t>(cls))].load(
+        std::memory_order_relaxed);
+  }
+
+  // ADMISSION_STATUS response body (JSON; decoded by
+  // fastdfs_tpu.monitor.decode_admission, pinned by the fdfs_codec
+  // admission-json golden).
+  std::string StatusJson(const char* role, int port) const;
+
+  const AdmissionConfig& config() const { return cfg_; }
+
+  // The composite score: max over normalized components, so the most
+  // pressured dimension drives the ladder (a saturated dio queue must
+  // not be averaged away by an idle network loop).
+  static double PressureScore(const AdmissionConfig& cfg,
+                              const AdmissionSignals& s);
+
+  static constexpr double kAlpha = 0.5;  // EWMA weight of the new sample
+  static constexpr int kMaxLevel = 3;
+
+ private:
+  static uint8_t ClampClass(uint8_t cls) {
+    return cls > kPriorityBackground ? kPriorityBackground : cls;
+  }
+
+  AdmissionConfig cfg_;
+  double ewma_ = 0;       // main-loop state
+  bool have_ewma_ = false;
+  std::atomic<int> level_{0};
+  std::atomic<int64_t> pressure_milli_{0};
+  std::atomic<int64_t> ewma_milli_{0};
+  std::atomic<int64_t> tightens_{0};
+  std::atomic<int64_t> relaxes_{0};
+  std::atomic<int64_t> admitted_{0};
+  std::atomic<int64_t> shed_{0};
+  std::atomic<int64_t> shed_class_[kPriorityClassCount] = {};
+};
+
+}  // namespace fdfs

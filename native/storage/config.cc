@@ -221,6 +221,38 @@ bool StorageConfig::Load(const IniConfig& ini, std::string* error) {
   watchdog_inject_stall_ms = static_cast<int>(
       ini.GetInt("watchdog_inject_stall_ms", watchdog_inject_stall_ms));
   if (watchdog_inject_stall_ms < 0) watchdog_inject_stall_ms = 0;
+  admission_control = ini.GetBool("admission_control", admission_control);
+  admission_tighten_pct = static_cast<int>(
+      ini.GetInt("admission_tighten_pct", admission_tighten_pct));
+  admission_relax_pct = static_cast<int>(
+      ini.GetInt("admission_relax_pct", admission_relax_pct));
+  if (admission_tighten_pct < 1) {
+    note("admission_tighten_pct raised to 1");
+    admission_tighten_pct = 1;
+  }
+  // The relax threshold must sit strictly below tighten or the ladder
+  // oscillates every tick — the exact flap the hysteresis band exists
+  // to forbid (same clamp discipline as sloeval's clear <= threshold).
+  if (admission_relax_pct >= admission_tighten_pct) {
+    note("admission_relax_pct clamped below admission_tighten_pct");
+    admission_relax_pct = admission_tighten_pct / 2;
+  }
+  if (admission_relax_pct < 0) admission_relax_pct = 0;
+  admission_queue_depth_high =
+      ini.GetInt("admission_queue_depth_high", admission_queue_depth_high);
+  if (admission_queue_depth_high < 0) admission_queue_depth_high = 0;
+  admission_loop_lag_high_ms =
+      ini.GetInt("admission_loop_lag_high_ms", admission_loop_lag_high_ms);
+  if (admission_loop_lag_high_ms < 0) admission_loop_lag_high_ms = 0;
+  admission_inflight_high_bytes = ini.GetBytes(
+      "admission_inflight_high_bytes", admission_inflight_high_bytes);
+  if (admission_inflight_high_bytes < 0) admission_inflight_high_bytes = 0;
+  admission_retry_after_ms =
+      ini.GetInt("admission_retry_after_ms", admission_retry_after_ms);
+  if (admission_retry_after_ms < 1) {
+    note("admission_retry_after_ms raised to 1");
+    admission_retry_after_ms = 1;
+  }
   heat_top_k = static_cast<int>(ini.GetInt("heat_top_k", heat_top_k));
   if (heat_top_k < 0) heat_top_k = 0;
   // heat_top_k is the sketch's PER-STRIPE capacity, and a full stripe

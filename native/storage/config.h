@@ -159,6 +159,25 @@ struct StorageConfig {
   int probe_slow_threshold_ms = 1000;
   int watchdog_stall_threshold_ms = 5000;
   int watchdog_inject_stall_ms = 0;
+  // Admission control & request QoS (storage/admission.h; OPERATIONS.md
+  // "Overload control & request QoS").  admission_control gates the
+  // whole subsystem (requests are still priority-classified and counted
+  // when off, but nothing is shed).  The ladder moves one rung per
+  // metrics tick when the pressure EWMA crosses admission_tighten_pct /
+  // admission_relax_pct (percent of the 1.0 "at the configured limit"
+  // score; relax must sit below tighten — that gap is the anti-flap
+  // hysteresis band).  The *_high knobs are the normalization points
+  // where each raw signal reads as 100% pressure: total dio jobs
+  // pending, reactor loop-lag p99, and admitted-but-unanswered request
+  // bytes.  admission_retry_after_ms is the base EBUSY backoff hint;
+  // the wire carries base x current level.
+  bool admission_control = true;
+  int admission_tighten_pct = 90;
+  int admission_relax_pct = 45;
+  int64_t admission_queue_depth_high = 64;
+  int64_t admission_loop_lag_high_ms = 100;
+  int64_t admission_inflight_high_bytes = 256LL << 20;
+  int64_t admission_retry_after_ms = 500;
   // Config values Load() silently clamped or corrected — surfaced as
   // "config.anomaly" flight-recorder events at startup so a daemon
   // running on not-what-the-operator-wrote config is diagnosable.

@@ -139,6 +139,22 @@ bool StorageServer::Init(std::string* error) {
   }
   if (cfg_.heat_top_k > 0)
     heat_ = std::make_unique<HeatSketch>(cfg_.heat_top_k);
+  // Admission control (ISSUE 19): always constructed — with
+  // admission_control = 0 the controller still classifies and counts
+  // every request (ADMISSION_STATUS and the admission.* gauges stay
+  // live for triage) but never sheds.
+  {
+    AdmissionConfig acfg;
+    acfg.enabled = cfg_.admission_control;
+    acfg.tighten_threshold = cfg_.admission_tighten_pct / 100.0;
+    acfg.relax_threshold = cfg_.admission_relax_pct / 100.0;
+    acfg.queue_depth_high = cfg_.admission_queue_depth_high;
+    acfg.loop_lag_high_ms =
+        static_cast<double>(cfg_.admission_loop_lag_high_ms);
+    acfg.inflight_high_bytes = cfg_.admission_inflight_high_bytes;
+    acfg.retry_after_ms = cfg_.admission_retry_after_ms;
+    admission_ = std::make_unique<AdmissionController>(acfg);
+  }
   dedup_ = MakeDedupPlugin(cfg_.dedup_mode, cfg_.base_path, cfg_.dedup_sidecar);
   if (dedup_ != nullptr && cfg_.dedup_chunk_threshold > 0) {
     // Chunk-level dedup: one content-addressed store per store path;
@@ -847,6 +863,46 @@ void StorageServer::InitStatsRegistry() {
   registry_.GaugeFn("slo.breach_transitions", [this] {
     return slo_ != nullptr ? slo_->breach_transitions() : int64_t{0};
   });
+  // Admission control & request QoS (ISSUE 19): ladder position, the
+  // pressure score feeding it (milli-units — gauge-fns are int64), and
+  // the admit/shed ledgers.  All atomic reads (the gauge-fn contract).
+  registry_.GaugeFn("admission.level", [this] {
+    return static_cast<int64_t>(admission_ != nullptr ? admission_->level()
+                                                      : 0);
+  });
+  registry_.GaugeFn("admission.pressure_milli", [this] {
+    return admission_ != nullptr ? admission_->pressure_milli() : int64_t{0};
+  });
+  registry_.GaugeFn("admission.ewma_milli", [this] {
+    return admission_ != nullptr ? admission_->ewma_milli() : int64_t{0};
+  });
+  registry_.GaugeFn("admission.tightens", [this] {
+    return admission_ != nullptr ? admission_->tightens() : int64_t{0};
+  });
+  registry_.GaugeFn("admission.relaxes", [this] {
+    return admission_ != nullptr ? admission_->relaxes() : int64_t{0};
+  });
+  registry_.GaugeFn("admission.admitted", [this] {
+    return admission_ != nullptr ? admission_->admitted() : int64_t{0};
+  });
+  registry_.GaugeFn("admission.shed_total", [this] {
+    return admission_ != nullptr ? admission_->shed_total() : int64_t{0};
+  });
+  registry_.GaugeFn("admission.retry_after_ms", [this] {
+    return admission_ != nullptr ? admission_->retry_after_ms() : int64_t{0};
+  });
+  registry_.GaugeFn("admission.inflight_bytes", [this] {
+    return inflight_bytes_.load(std::memory_order_relaxed);
+  });
+  for (int i = 0; i < kPriorityClassCount; ++i) {
+    registry_.GaugeFn(
+        std::string("admission.shed.") +
+            PriorityClassName(static_cast<uint8_t>(i)),
+        [this, i] {
+          return admission_ != nullptr ? admission_->shed_by_class(i)
+                                       : int64_t{0};
+        });
+  }
   // Metrics journal health: retained bytes vs the conf cap, and how
   // many ticks this process has persisted.
   registry_.GaugeFn("metrics.journal_bytes", [this] {
@@ -1317,9 +1373,36 @@ void StorageServer::MetricsTick() {
   StatsSnapshot snap;
   registry_.Snapshot(&snap);
   if (metrics_ != nullptr) metrics_->Append(TraceWallUs(), snap);
+  double dt_s = static_cast<double>(now_mono - last_tick_mono_us_) / 1e6;
+  if (dt_s <= 0) dt_s = 1.0;
   if (slo_ != nullptr && have_tick_snap_) {
-    double dt_s = static_cast<double>(now_mono - last_tick_mono_us_) / 1e6;
-    slo_->Tick(last_tick_snap_, snap, dt_s > 0 ? dt_s : 1.0);
+    slo_->Tick(last_tick_snap_, snap, dt_s);
+  }
+  // Admission ladder tick AFTER the SLO tick: breaches_active then
+  // reflects THIS snapshot's verdicts, so the ladder reacts the same
+  // tick a breach starts.  One rung at most per tick; tighten/relax
+  // transitions land in the flight recorder (the sloeval discipline).
+  if (admission_ != nullptr) {
+    AdmissionSignals sig;
+    sig.breaches_active = slo_ != nullptr ? slo_->breaches_active() : 0;
+    for (const auto& p : dio_pools_)
+      sig.queue_depth += static_cast<int64_t>(p->pending());
+    sig.inflight_bytes = inflight_bytes_.load(std::memory_order_relaxed);
+    double lag_ms = 0;
+    if (have_tick_snap_ &&
+        SloEvaluator::ComputeReading("loop_lag_p99_ms", last_tick_snap_,
+                                     snap, dt_s, &lag_ms))
+      sig.loop_lag_p99_ms = lag_ms;
+    int moved = admission_->Tick(sig);
+    if (moved != 0 && events_ != nullptr) {
+      char detail[128];
+      snprintf(detail, sizeof(detail), "level=%d ewma=%.6g pressure=%.6g",
+               admission_->level(), admission_->ewma_milli() / 1000.0,
+               admission_->pressure_milli() / 1000.0);
+      events_->Record(moved > 0 ? EventSeverity::kWarn : EventSeverity::kInfo,
+                      moved > 0 ? "admission.tighten" : "admission.relax",
+                      admission_->level_name(), detail);
+    }
   }
   last_tick_snap_ = std::move(snap);
   have_tick_snap_ = true;
@@ -1512,6 +1595,12 @@ void StorageServer::CloseConn(Conn* c) {
   auto it = conns.find(c->fd);
   if (it == conns.end() || it->second.get() != c) return;
   AbortFileOp(c);  // disconnect mid-op: same rollback as an explicit error
+  // Mid-request death: the admitted bytes never reached LogAccess —
+  // release them here or the in-flight ledger leaks upward forever.
+  if (c->inflight_acct != 0) {
+    inflight_bytes_.fetch_sub(c->inflight_acct, std::memory_order_relaxed);
+    c->inflight_acct = 0;
+  }
   if (c->send_fd >= 0) close(c->send_fd);
   c->rstream.reset();
   int fd = c->fd;
@@ -1551,6 +1640,9 @@ void StorageServer::ResetForNextRequest(Conn* c) {
   c->slave_prefix.clear();
   c->discarding = false;
   c->pending_status = 0;
+  c->pending_body.clear();
+  c->priority = kPriorityUntagged;
+  c->resolved_priority = 0;
   c->out.clear();
   c->out_off = 0;
   c->send_fd = -1;
@@ -1634,6 +1726,27 @@ void StorageServer::RespondError(Conn* c, uint8_t status) {
   c->state = ConnState::kRecvFile;
 }
 
+void StorageServer::ShedRequest(Conn* c, int64_t retry_after_ms) {
+  // Admission shed: EBUSY + an 8-byte BE retry-after-ms hint the client
+  // honors with jittered backoff.  Same drain discipline as
+  // RespondError (the connection stays usable — a shed must not force
+  // a reconnect, which would ADD load under overload), but the hint
+  // body has to survive the drain, hence pending_body.
+  AbortFileOp(c);
+  c->shed_resp = true;
+  std::string hint(8, '\0');
+  PutInt64BE(retry_after_ms, reinterpret_cast<uint8_t*>(hint.data()));
+  if (c->body_consumed >= c->pkg_len) {
+    Respond(c, 16 /*EBUSY*/, hint);
+    return;
+  }
+  c->discarding = true;
+  c->pending_status = 16;
+  c->pending_body = std::move(hint);
+  c->file_remaining = c->pkg_len - c->body_consumed;
+  c->state = ConnState::kRecvFile;
+}
+
 void StorageServer::Respond(Conn* c, uint8_t status, const std::string& body) {
   LogAccess(c, status, static_cast<int64_t>(body.size()));
   c->out.resize(kHeaderSize);
@@ -1657,6 +1770,13 @@ void StorageServer::NoteHeat(Conn* c, HeatOp op, const std::string& key) {
 
 void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
   if (c->req_start_us == 0) return;  // one accounting pass per request
+  // The request is answered: its bytes leave the admission in-flight
+  // ledger (zeroing the field makes the subtract single-shot even if a
+  // CloseConn follows).
+  if (c->inflight_acct != 0) {
+    inflight_bytes_.fetch_sub(c->inflight_acct, std::memory_order_relaxed);
+    c->inflight_acct = 0;
+  }
   int64_t now_us = MonoUs();
   // Heat telemetry: one Touch per request at the accounting choke point
   // (handlers that resolved a file-id stamped heat_key).  Uploads
@@ -1671,8 +1791,14 @@ void StorageServer::LogAccess(Conn* c, uint8_t status, int64_t bytes) {
   // Registry side (always on): per-opcode count/error/latency plus the
   // transfer-size histograms.  Handles are pre-registered atomics —
   // callable from nio loops and dio workers alike.
+  // Shed requests stay out of the op stats entirely: the SLO engine
+  // reads error_rate_pct / request_p99_ms off these counters, and a
+  // ladder whose refusals raise the very breach that feeds its
+  // pressure score would latch itself tight (the admission gauges
+  // already count every shed).  The access log below still records
+  // them for forensics.
   const OpStats& os = op_stats_[c->cmd];
-  if (os.count != nullptr) {
+  if (os.count != nullptr && !c->shed_resp) {
     os.count->fetch_add(1, std::memory_order_relaxed);
     if (status != 0) os.errors->fetch_add(1, std::memory_order_relaxed);
     os.latency_us->Observe(now_us - c->req_start_us);
@@ -2197,6 +2323,7 @@ void StorageServer::OnHeaderComplete(Conn* c) {
   // negative latencies).  Always stamped: the stats registry's
   // per-opcode latency histograms run even without the access log.
   c->req_start_us = MonoUs();
+  c->shed_resp = false;
   if (c->peer_ip.empty()) c->peer_ip = PeerIp(c->fd);
   if (c->pkg_len < 0) {
     FDFS_LOG_WARN("negative pkg_len from %s", PeerIp(c->fd).c_str());
@@ -2204,6 +2331,31 @@ void StorageServer::OnHeaderComplete(Conn* c) {
     return;
   }
   auto cmd = static_cast<StorageCmd>(c->cmd);
+  // Admission consult (ISSUE 19) at the header stage — before any body
+  // byte is read, so a shed request costs one drain, not one disk op.
+  // Prefix frames (TRACE_CTX / PRIORITY) carry metadata for the NEXT
+  // request and are never consulted themselves.  The class comes from a
+  // PRIORITY frame when one preceded this header (consumed here) or the
+  // opcode-class table; CONTROL survives every ladder rung, so the
+  // observability plane stays reachable during the overload it exists
+  // to diagnose.
+  if (cmd != StorageCmd::kTraceCtx && cmd != StorageCmd::kPriority) {
+    uint8_t cls = c->priority != kPriorityUntagged
+                      ? c->priority
+                      : DefaultPriorityClass(c->cmd);
+    c->priority = kPriorityUntagged;  // one frame tags one request
+    if (cls > kPriorityBackground) cls = kPriorityBackground;
+    c->resolved_priority = cls;
+    int64_t retry_ms = 0;
+    if (!admission_->AdmitOrShed(cls, &retry_ms)) {
+      ShedRequest(c, retry_ms);
+      return;
+    }
+    // Admitted: this request's declared bytes join the in-flight ledger
+    // (a pressure signal — bytes accepted but not yet answered).
+    c->inflight_acct = c->pkg_len;
+    inflight_bytes_.fetch_add(c->inflight_acct, std::memory_order_relaxed);
+  }
   switch (cmd) {
     case StorageCmd::kActiveTest:
       if (c->pkg_len != 0) {
@@ -2406,6 +2558,27 @@ void StorageServer::OnHeaderComplete(Conn* c) {
       c->fixed_need = static_cast<size_t>(kTraceCtxLen);
       c->state = ConnState::kRecvFixed;
       return;
+    case StorageCmd::kPriority:
+      // Priority prefix frame (the TRACE_CTX pattern): 1B class, NO
+      // response; tags the next request on this connection.  A wrong
+      // length cannot be resynced mid-stream — close.
+      if (c->pkg_len != kPriorityFrameLen) {
+        CloseConn(c);
+        return;
+      }
+      c->fixed_need = static_cast<size_t>(kPriorityFrameLen);
+      c->state = ConnState::kRecvFixed;
+      return;
+    case StorageCmd::kAdmissionStatus:
+      // Admission-controller state dump: empty body -> JSON (ladder
+      // level, pressure/EWMA, per-class shed counts;
+      // monitor.decode_admission; fdfs_codec admission-json golden).
+      if (c->pkg_len != 0) {
+        CloseConn(c);
+        return;
+      }
+      Respond(c, 0, admission_->StatusJson("storage", cfg_.port));
+      return;
     case StorageCmd::kUploadFile:
     case StorageCmd::kUploadAppenderFile:
       stats_.total_upload++;
@@ -2520,6 +2693,22 @@ void StorageServer::OnFixedComplete(Conn* c) {
           ParseTraceCtx(reinterpret_cast<const uint8_t*>(c->fixed.data()));
       c->traced = c->trace_ctx.valid();
       c->trace_span = c->traced ? trace_->NextSpanId() : 0;
+      c->state = ConnState::kRecvHeader;
+      c->header_got = 0;
+      c->fixed.clear();
+      c->fixed_need = 0;
+      c->pkg_len = 0;
+      c->body_consumed = 0;
+      c->req_start_us = 0;
+      return;
+    }
+    case StorageCmd::kPriority: {
+      // Stash the class for the next request (out-of-range bytes clamp
+      // to background — garbage priority must never OUTRANK honest
+      // traffic).  Minimal reset like kTraceCtx: the very next bytes
+      // are the tagged request's header.
+      uint8_t cls = static_cast<uint8_t>(c->fixed[0]);
+      c->priority = cls > kPriorityBackground ? kPriorityBackground : cls;
       c->state = ConnState::kRecvHeader;
       c->header_got = 0;
       c->fixed.clear();
@@ -2779,7 +2968,7 @@ void StorageServer::OnFixedComplete(Conn* c) {
 void StorageServer::OnFileComplete(Conn* c) {
   c->recv_done_us = MonoUs();  // recv-stage end (access log AND spans)
   if (c->discarding) {  // rejected request: body drained, send the verdict
-    Respond(c, c->pending_status);
+    Respond(c, c->pending_status, c->pending_body);
     return;
   }
   auto cmd = static_cast<StorageCmd>(c->cmd);

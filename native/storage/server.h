@@ -37,6 +37,7 @@
 #include "common/bytes.h"
 #include "common/protocol_gen.h"
 #include "common/net.h"
+#include "storage/admission.h"
 #include "storage/binlog.h"
 #include "storage/chunkstore.h"
 #include "storage/config.h"
@@ -202,6 +203,7 @@ class StorageServer {
     std::string slave_prefix;   // UPLOAD_SLAVE_FILE name prefix
     bool discarding = false;    // draining a rejected request's body bytes
     uint8_t pending_status = 0; // error to send once the drain completes
+    std::string pending_body;   // response body for that error (shed hint)
     std::string busy_key;       // in-place-mutated file this conn holds
     // send
     std::string out;
@@ -251,6 +253,22 @@ class StorageServer {
     TraceCtx trace_ctx;
     bool traced = false;
     uint32_t trace_span = 0;
+    // Request QoS: class from a PRIORITY prefix frame (kPriorityUntagged
+    // = none seen; the dispatch then defaults by opcode).  Consumed by
+    // the next request like trace_ctx.  resolved_priority is the class
+    // the admission consult actually used, kept for the access log.
+    uint8_t priority = 0xFF;
+    uint8_t resolved_priority = 0;
+    // Bytes this request added to the server-wide in-flight ledger at
+    // admission (its pkg_len); subtracted exactly once when the request
+    // finishes (LogAccess) or the conn dies mid-request (CloseConn).
+    int64_t inflight_acct = 0;
+    // This request was refused by the admission ladder: keep it out of
+    // the per-opcode count/error/latency stats — a shed EBUSY feeding
+    // the error_rate_pct SLO would hold the breach (= pressure 1.0)
+    // active and the ladder could never relax off its own refusals.
+    // The admission controller's shed counters carry the accounting.
+    bool shed_resp = false;
   };
 
   struct NioThread {
@@ -311,6 +329,9 @@ class StorageServer {
   // Error response that may leave unread request bytes: drains them (the
   // connection stays usable) and rolls back any in-flight file write.
   void RespondError(Conn* c, uint8_t status);
+  // Admission shed: EBUSY carrying the 8-byte BE retry-after-ms hint —
+  // RespondError's drain discipline, plus a staged response body.
+  void ShedRequest(Conn* c, int64_t retry_after_ms);
   void AbortFileOp(Conn* c);
   // Per-file writer exclusion for streamed in-place mutations: two appends
   // to one appender file interleaving across epoll rounds would corrupt it.
@@ -587,6 +608,14 @@ class StorageServer {
   std::unique_ptr<MetricsJournal> metrics_;
   std::unique_ptr<SloEvaluator> slo_;
   std::unique_ptr<HeatSketch> heat_;
+  // Admission control & request QoS (ISSUE 19; storage/admission.h):
+  // consulted at the request-header stage on every nio thread, ticked
+  // on the metrics timer from the same snapshots as slo_.
+  // inflight_bytes_ is the admitted-but-unanswered request-byte ledger
+  // (one of the controller's pressure signals, and the
+  // admission.inflight_bytes gauge).
+  std::unique_ptr<AdmissionController> admission_;
+  std::atomic<int64_t> inflight_bytes_{0};
   // Previous tick's snapshot (main-loop only: the tick timer is the
   // sole reader/writer) — the delta base for SLO readings.
   StatsSnapshot last_tick_snap_;

@@ -90,6 +90,17 @@
 //                Cluster -> the N x N differential matrix JSON — pins
 //                the gray/sick/ok/unknown verdict rules across
 //                languages against monitor.decode_health_matrix)
+//   fdfs_codec priority-frame  (golden PRIORITY prefix frame per class,
+//                the full 256-entry storage + tracker born-priority
+//                tables, the ladder admit matrix off a REAL controller,
+//                and the retry-after body — pins protocol.py's
+//                priority_frame/default_priority_class/
+//                admitted_at_level against storage/admission.cc)
+//   fdfs_codec admission-json  (golden ADMISSION_STATUS body: a fixture
+//                controller driven through climb / hysteresis-hold /
+//                relax with a per-tick transcript, then the wire JSON —
+//                pins the EWMA+hysteresis ladder discipline and
+//                monitor.decode_admission across languages)
 #include <time.h>
 
 #include <atomic>
@@ -117,6 +128,7 @@
 #include "common/jumphash.h"
 #include "common/trace.h"
 #include "common/gf256.h"
+#include "storage/admission.h"
 #include "storage/ecstore.h"
 #include "storage/slabstore.h"
 #include "tracker/cluster.h"
@@ -935,6 +947,99 @@ int main(int argc, char** argv) {
     printf("{\"role\":\"tracker\",\"port\":22122,\"gray_threshold\":60,"
            "\"nodes\":%s}\n",
            cl.HealthMatrixJson(now, 60).c_str());
+    return 0;
+  }
+  if (cmd == "priority-frame") {
+    // Golden PRIORITY prefix frame + the born-priority tables
+    // (tests/test_admission.py rebuilds every line with the protocol.py
+    // mirrors: priority_frame(), default_priority_class(),
+    // admitted_at_level(), pack_retry_after()).  The 256-entry digit
+    // strings pin the FULL opcode -> class mapping in both directions —
+    // a class added on one side only shifts a digit and fails loudly.
+    auto hex = [](const std::string& s) {
+      static const char* k = "0123456789abcdef";
+      std::string o;
+      for (unsigned char ch : s) {
+        o.push_back(k[ch >> 4]);
+        o.push_back(k[ch & 0xF]);
+      }
+      return o;
+    };
+    for (int c = 0; c < kPriorityClassCount; ++c) {
+      std::string frame(kHeaderSize + kPriorityFrameLen, '\0');
+      PutInt64BE(kPriorityFrameLen,
+                 reinterpret_cast<uint8_t*>(frame.data()));
+      frame[8] = static_cast<char>(StorageCmd::kPriority);
+      frame[9] = 0;
+      frame[10] = static_cast<char>(c);
+      printf("frame_%s=%s\n", PriorityClassName(static_cast<uint8_t>(c)),
+             hex(frame).c_str());
+    }
+    std::string sdef, tdef;
+    for (int i = 0; i < 256; ++i) {
+      sdef.push_back(
+          static_cast<char>('0' + DefaultPriorityClass(static_cast<uint8_t>(i))));
+      tdef.push_back(static_cast<char>(
+          '0' + DefaultTrackerPriorityClass(static_cast<uint8_t>(i))));
+    }
+    printf("storage_defaults=%s\n", sdef.c_str());
+    printf("tracker_defaults=%s\n", tdef.c_str());
+    // Ladder admit matrix straight off a REAL controller walked up rung
+    // by rung (sustained breach pressure), not off the formula — pins
+    // WouldAdmit at every level.
+    AdmissionConfig acfg;
+    AdmissionController ac(acfg);
+    AdmissionSignals breach;
+    breach.breaches_active = 1;
+    for (int lvl = 0;; ++lvl) {
+      std::string row;
+      for (int c = 0; c < kPriorityClassCount; ++c)
+        row.push_back(ac.WouldAdmit(static_cast<uint8_t>(c)) ? '1' : '0');
+      printf("admit_level%d=%s\n", lvl, row.c_str());
+      if (lvl >= AdmissionController::kMaxLevel) break;
+      ac.Tick(breach);  // ewma jumps to 1.0 > 0.9: one rung per tick
+    }
+    std::string retry(8, '\0');
+    PutInt64BE(1500, reinterpret_cast<uint8_t*>(retry.data()));
+    printf("retry_after_1500=%s\n", hex(retry).c_str());
+    return 0;
+  }
+  if (cmd == "admission-json") {
+    // Golden ADMISSION_STATUS body + the EWMA/hysteresis transcript: a
+    // fixture controller driven through climb, hold (the hysteresis
+    // band between relax and tighten — NO flap), and relax, with the
+    // ladder position printed after every tick, then the exact wire
+    // JSON (monitor.decode_admission parses it back field-for-field).
+    AdmissionConfig acfg;
+    acfg.retry_after_ms = 250;
+    AdmissionController ac(acfg);
+    auto tick = [&](double breaches) {
+      AdmissionSignals s;
+      s.breaches_active = static_cast<int64_t>(breaches);
+      int moved = ac.Tick(s);
+      printf("tick breaches=%d moved=%+d level=%d ewma_milli=%lld\n",
+             static_cast<int>(breaches), moved, ac.level(),
+             static_cast<long long>(ac.ewma_milli()));
+    };
+    // Climb: sustained breach -> ewma 1.0 every tick, one rung each.
+    tick(1);
+    tick(1);
+    tick(1);
+    tick(1);  // already at kMaxLevel: moved=0
+    // Sheds at reads-only: normal/bulk/background bounce, control and
+    // interactive pass (and the retry hint is level-scaled: 250 * 3).
+    int64_t retry_ms = 0;
+    for (int c = 0; c < kPriorityClassCount; ++c) {
+      bool ok = ac.AdmitOrShed(static_cast<uint8_t>(c), &retry_ms);
+      printf("admit class=%d ok=%d retry_ms=%lld\n", c, ok ? 1 : 0,
+             static_cast<long long>(ok ? 0 : retry_ms));
+    }
+    // Recovery: first zero tick decays the EWMA to 0.5 — inside the
+    // hysteresis band, the ladder HOLDS (this line is the no-flap pin);
+    // the second reaches 0.25 <= 0.45 and relaxes one rung.
+    tick(0);
+    tick(0);
+    printf("%s\n", ac.StatusJson("storage", 23000).c_str());
     return 0;
   }
   if (cmd == "b64e" && argc == 3) {

@@ -7,7 +7,11 @@
 // merges any number of result files.
 //
 // Result record format (one line per op):
-//   <start_us> <latency_us> <status> <bytes> <file_id>
+//   <start_us> <latency_us> <status> <bytes> <class> <file_id>
+// where <class> is the priority class the op was tagged with on the
+// wire (0..4) or 255 for untagged (the daemon applies its opcode
+// default).  `combine` also accepts the older five-field format
+// (records before the class column existed count as untagged).
 //
 // Usage:
 //   fdfs_load upload   <tracker ip:port> <n_ops> <size> <threads> <result>
@@ -38,6 +42,19 @@
 // of silently throttling the load (the closed-loop coordinated-
 // omission failure).  Threads (<threads> = the concurrency cap) only
 // bound how many ops may be in flight at once.
+//
+// --priority P (upload/download/delete, any position after the mode):
+// tag every storage op with priority class P (0 control .. 4
+// background) via the 1-byte PRIORITY prefix frame, so the admission
+// ladder sheds by the declared class instead of the opcode default.
+// --priority-mix <spec> instead assigns classes probabilistically:
+// spec is comma-separated `[label:]class:weight` entries (e.g.
+// `read:2:0.7,write:3:0.3` — labels are documentation only); op i is
+// hashed deterministically onto the weight distribution, so a run's
+// class assignment is reproducible regardless of thread interleaving
+// (the zipf-picker discipline).  `combine` reports per-class op
+// counts, admitted/shed splits (shed = EBUSY 16), and latency
+// percentiles under "by_class".
 //
 // --conns N (upload/download/delete, any position after the mode):
 // shared storage-connection budget across ALL worker threads.  Workers
@@ -94,11 +111,14 @@ int64_t MonoUs() {
   return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
 }
 
+constexpr int kUntagged = 255;
+
 struct OpRecord {
   int64_t start_us;
   int64_t latency_us;
   int status;  // 0 ok, errno-style otherwise; -1 = transport failure
   int64_t bytes;
+  int cls;     // wire priority class, kUntagged when no frame was sent
   std::string file_id;
 };
 
@@ -139,12 +159,24 @@ class Peer {
   Peer(std::string host, int port) : host_(std::move(host)), port_(port) {}
   ~Peer() { Close(); }
   bool Call(uint8_t cmd, const std::string& body, std::string* resp,
-            uint8_t* status) {
+            uint8_t* status, int cls = kUntagged) {
     for (int attempt = 0; attempt < 2; ++attempt) {
       if (fd_ < 0) {
         std::string err;
         fd_ = TcpConnect(host_, port_, kTimeoutMs, &err);
         if (fd_ < 0) return false;
+      }
+      if (cls != kUntagged) {
+        // PRIORITY prefix frame (no response of its own): 10B header
+        // with pkg_len=1 + the class byte, tagging the next request.
+        uint8_t frame[kHeaderSize + 1] = {0};
+        PutInt64BE(kPriorityFrameLen, frame);
+        frame[8] = static_cast<uint8_t>(StorageCmd::kPriority);
+        frame[kHeaderSize] = static_cast<uint8_t>(cls);
+        if (!SendAll(fd_, frame, sizeof(frame), kTimeoutMs)) {
+          Close();
+          continue;
+        }
       }
       if (Rpc(fd_, cmd, body, resp, status)) return true;
       Close();  // stale/broken connection: one reconnect attempt
@@ -353,6 +385,27 @@ struct Shared {
   // load (the coordinated-omission fix; closed-loop when rate == 0).
   double rate = 0;
   int64_t t0_us = 0;
+  // Request QoS (--priority / --priority-mix): either one fixed class
+  // for every op, or a weighted distribution op i is hashed onto
+  // deterministically (thread-schedule independent, the ZipfPicker
+  // discipline).  kUntagged = send no frame.
+  int priority = kUntagged;
+  std::vector<std::pair<int, double>> prio_cdf;  // (class, cumulative wt)
+  int ClassFor(int64_t i) const {
+    if (prio_cdf.empty()) return priority;
+    uint64_t x = 0x5eedULL + 0x9E3779B97F4A7C15ULL *
+                 (static_cast<uint64_t>(i) + 1);
+    x ^= x >> 30;
+    x *= 0xBF58476D1CE4E5B9ULL;
+    x ^= x >> 27;
+    x *= 0x94D049BB133111EBULL;
+    x ^= x >> 31;
+    double u = static_cast<double>(x >> 11) * (1.0 / 9007199254740992.0) *
+               prio_cdf.back().second;
+    for (const auto& [cls, acc] : prio_cdf)
+      if (u < acc) return cls;
+    return prio_cdf.back().first;
+  }
   // Storage connections are drawn from this shared pool; --conns N
   // caps it (0 = unlimited).  Tracker connections stay per-worker —
   // they are tiny metadata RPCs and capping them would only measure
@@ -408,7 +461,8 @@ void UploadWorker(Shared* sh) {
     FillPayload(pid, &payload);
     // bytes stays 0 unless the daemon ACCEPTED the upload — failed ops
     // must not inflate combine's throughput.
-    OpRecord rec{start, 0, -1, 0, ""};
+    int cls = sh->ClassFor(i);
+    OpRecord rec{start, 0, -1, 0, cls, ""};
     std::string group, ip;
     int port = 0;
     uint8_t spi = 0;
@@ -426,7 +480,7 @@ void UploadWorker(Shared* sh) {
       std::string resp;
       uint8_t status = 0;
       if (storage->Call(static_cast<uint8_t>(StorageCmd::kUploadFile), body,
-                        &resp, &status)) {
+                        &resp, &status, cls)) {
         rec.status = status;
         if (status == 0 && resp.size() > 16) {
           std::string g(resp.c_str(), strnlen(resp.c_str(), 16));
@@ -453,7 +507,8 @@ void DownloadWorker(Shared* sh) {
         sh->zipf != nullptr
             ? sh->ids[sh->zipf->Pick(i) % sh->ids.size()]
             : sh->ids[i % sh->ids.size()];
-    OpRecord rec{start, 0, -1, 0, fid};
+    int cls = sh->ClassFor(i);
+    OpRecord rec{start, 0, -1, 0, cls, fid};
     std::string ip;
     int port = 0;
     if (QueryFetch(&tracker,
@@ -468,7 +523,7 @@ void DownloadWorker(Shared* sh) {
       std::string resp;
       uint8_t status = 0;
       if (storage->Call(static_cast<uint8_t>(StorageCmd::kDownloadFile),
-                        body, &resp, &status)) {
+                        body, &resp, &status, cls)) {
         rec.status = status;
         rec.bytes = static_cast<int64_t>(resp.size());
       }
@@ -487,7 +542,8 @@ void DeleteWorker(Shared* sh) {
     int64_t i = sh->next.fetch_add(1);
     if (i >= static_cast<int64_t>(sh->ids.size())) break;
     const std::string& fid = sh->ids[i];
-    OpRecord rec{MonoUs(), 0, -1, 0, fid};
+    int cls = sh->ClassFor(i);
+    OpRecord rec{MonoUs(), 0, -1, 0, cls, fid};
     std::string ip;
     int port = 0;
     if (QueryFetch(&tracker,
@@ -499,7 +555,7 @@ void DeleteWorker(Shared* sh) {
       std::string resp;
       uint8_t status = 0;
       if (storage->Call(static_cast<uint8_t>(StorageCmd::kDeleteFile),
-                        PackGroup(group) + remote, &resp, &status))
+                        PackGroup(group) + remote, &resp, &status, cls))
         rec.status = status;
     }
     rec.latency_us = MonoUs() - rec.start_us;
@@ -516,7 +572,7 @@ bool WriteResults(const Shared& sh, const std::string& path, bool with_ids) {
   if (with_ids) ids.open(path + ".ids");
   for (const auto& r : sh.records) {
     out << r.start_us << ' ' << r.latency_us << ' ' << r.status << ' '
-        << r.bytes << ' ' << r.file_id << '\n';
+        << r.bytes << ' ' << r.cls << ' ' << r.file_id << '\n';
     if (with_ids && r.status == 0 && !r.file_id.empty())
       ids << r.file_id << '\n';
   }
@@ -574,6 +630,53 @@ bool StripGlobalFlags(int* argc, char** argv, Shared* sh) {
         return false;
       }
       sh->pool.set_budget(static_cast<int>(conns));
+    } else if (flag == "--priority" && a + 1 < *argc) {
+      char* end = nullptr;
+      long cls = strtol(argv[++a], &end, 10);
+      if (end == argv[a] || cls < 0 || cls > 4) {
+        fprintf(stderr, "--priority wants a class 0..4, got %s\n", argv[a]);
+        return false;
+      }
+      sh->priority = static_cast<int>(cls);
+    } else if (flag == "--priority-mix" && a + 1 < *argc) {
+      // Comma-separated `[label:]class:weight` entries; a malformed
+      // spec must be an ERROR, not a silent fall-through to untagged —
+      // the per-class verdicts downstream would be measuring nothing.
+      std::string spec = argv[++a];
+      double acc = 0;
+      size_t pos = 0;
+      while (pos <= spec.size()) {
+        size_t comma = spec.find(',', pos);
+        std::string entry = spec.substr(
+            pos, comma == std::string::npos ? std::string::npos : comma - pos);
+        pos = comma == std::string::npos ? spec.size() + 1 : comma + 1;
+        if (entry.empty()) continue;
+        size_t c2 = entry.rfind(':');
+        size_t c1 = c2 == std::string::npos ? std::string::npos
+                                            : entry.rfind(':', c2 - 1);
+        // label:class:weight has two colons; class:weight has one (then
+        // c1 is npos and the class starts at 0).
+        size_t cls_at = c1 == std::string::npos ? 0 : c1 + 1;
+        char* end = nullptr;
+        long cls = c2 == std::string::npos
+                       ? -1
+                       : strtol(entry.c_str() + cls_at, &end, 10);
+        double wt = c2 == std::string::npos
+                        ? 0
+                        : strtod(entry.c_str() + c2 + 1, nullptr);
+        if (cls < 0 || cls > 4 || end != entry.c_str() + c2 || wt <= 0) {
+          fprintf(stderr,
+                  "--priority-mix wants [label:]class:weight entries "
+                  "(class 0..4, weight > 0), got %s\n", entry.c_str());
+          return false;
+        }
+        acc += wt;
+        sh->prio_cdf.emplace_back(static_cast<int>(cls), acc);
+      }
+      if (sh->prio_cdf.empty()) {
+        fprintf(stderr, "--priority-mix spec is empty\n");
+        return false;
+      }
     } else {
       argv[w++] = argv[a];
     }
@@ -594,12 +697,33 @@ int64_t Pct(const std::vector<int64_t>& sorted, double q) {
   return sorted[i];
 }
 
+const char* ClassName(int cls) {
+  switch (cls) {
+    case 0: return "control";
+    case 1: return "interactive";
+    case 2: return "normal";
+    case 3: return "bulk";
+    case 4: return "background";
+    default: return "untagged";
+  }
+}
+
 // combine: merge result files -> one JSON line (combine_result.c
 // analogue).  QPS uses the union wall-clock window (min start .. max
-// end) so multi-process runs aggregate honestly.
+// end) so multi-process runs aggregate honestly.  Records carry an
+// optional priority-class column (older five-field files parse as
+// untagged); "by_class" reports per-class admitted/shed splits (shed =
+// the admission ladder's EBUSY 16) with latency percentiles over the
+// ADMITTED ops — a shed answers in microseconds, and folding those
+// into the percentiles would make an overloaded run look fast.
 int Combine(int argc, char** argv) {
+  struct ClassAgg {
+    std::vector<int64_t> lat;  // admitted (status 0) only
+    int64_t ops = 0, shed = 0, errors = 0;
+  };
   std::vector<int64_t> lat;
-  int64_t errors = 0, bytes = 0, t_min = INT64_MAX, t_max = 0;
+  std::map<int, ClassAgg> by_class;
+  int64_t errors = 0, shed = 0, bytes = 0, t_min = INT64_MAX, t_max = 0;
   for (int a = 0; a < argc; ++a) {
     std::ifstream in(argv[a]);
     if (!in) {
@@ -611,8 +735,25 @@ int Combine(int argc, char** argv) {
     std::string rest;
     while (in >> start >> latency >> status >> b) {
       std::getline(in, rest);
+      // Sniff the class column: a bare-integer first token is the
+      // class, anything else (a file id, or nothing) is the legacy
+      // five-field shape.
+      int cls = kUntagged;
+      size_t tok = rest.find_first_not_of(' ');
+      if (tok != std::string::npos) {
+        size_t end = rest.find(' ', tok);
+        std::string first = rest.substr(
+            tok, end == std::string::npos ? std::string::npos : end - tok);
+        if (!first.empty() &&
+            first.find_first_not_of("0123456789") == std::string::npos)
+          cls = atoi(first.c_str());
+      }
       lat.push_back(latency);
-      if (status != 0) errors++;
+      auto& agg = by_class[cls];
+      agg.ops++;
+      if (status == 0) agg.lat.push_back(latency);
+      else if (status == 16) { shed++; agg.shed++; errors++; }
+      else { agg.errors++; errors++; }
       bytes += b;
       t_min = std::min(t_min, start);
       t_max = std::max(t_max, start + latency);
@@ -626,12 +767,31 @@ int Combine(int argc, char** argv) {
   double wall_s = static_cast<double>(t_max - t_min) / 1e6;
   int64_t sum = 0;
   for (int64_t v : lat) sum += v;
+  std::string classes;
+  for (auto& [cls, agg] : by_class) {
+    std::sort(agg.lat.begin(), agg.lat.end());
+    char buf[256];
+    snprintf(buf, sizeof(buf),
+             "%s\"%s\": {\"ops\": %lld, \"admitted\": %lld, "
+             "\"shed\": %lld, \"errors\": %lld, \"lat_p50_us\": %lld, "
+             "\"lat_p99_us\": %lld}",
+             classes.empty() ? "" : ", ", ClassName(cls),
+             static_cast<long long>(agg.ops),
+             static_cast<long long>(agg.lat.size()),
+             static_cast<long long>(agg.shed),
+             static_cast<long long>(agg.errors),
+             static_cast<long long>(Pct(agg.lat, 0.50)),
+             static_cast<long long>(Pct(agg.lat, 0.99)));
+    classes += buf;
+  }
   printf(
-      "{\"ops\": %zu, \"errors\": %lld, \"wall_seconds\": %.3f, "
+      "{\"ops\": %zu, \"errors\": %lld, \"shed\": %lld, "
+      "\"wall_seconds\": %.3f, "
       "\"qps\": %.1f, \"bytes\": %lld, \"GBps\": %.4f, "
       "\"lat_mean_us\": %lld, \"lat_p50_us\": %lld, \"lat_p95_us\": %lld, "
-      "\"lat_p99_us\": %lld, \"lat_max_us\": %lld}\n",
-      lat.size(), static_cast<long long>(errors), wall_s,
+      "\"lat_p99_us\": %lld, \"lat_max_us\": %lld, \"by_class\": {%s}}\n",
+      lat.size(), static_cast<long long>(errors),
+      static_cast<long long>(shed), wall_s,
       lat.size() / std::max(wall_s, 1e-9),
       static_cast<long long>(bytes),
       bytes / std::max(wall_s, 1e-9) / 1e9,
@@ -639,7 +799,7 @@ int Combine(int argc, char** argv) {
       static_cast<long long>(Pct(lat, 0.50)),
       static_cast<long long>(Pct(lat, 0.95)),
       static_cast<long long>(Pct(lat, 0.99)),
-      static_cast<long long>(lat.back()));
+      static_cast<long long>(lat.back()), classes.c_str());
   return 0;
 }
 

@@ -98,6 +98,23 @@ int main(int argc, char** argv) {
       ini.GetInt("health_gray_threshold", cfg.health_gray_threshold));
   if (cfg.health_gray_threshold < 0) cfg.health_gray_threshold = 0;
   if (cfg.health_gray_threshold > 100) cfg.health_gray_threshold = 100;
+  // Admission control (ISSUE 19): relax must sit strictly below tighten
+  // or the hysteresis band vanishes and the ladder can flap.
+  cfg.admission_control = ini.GetBool("admission_control", true);
+  cfg.admission_tighten_pct = static_cast<int>(
+      ini.GetInt("admission_tighten_pct", cfg.admission_tighten_pct));
+  if (cfg.admission_tighten_pct < 1) cfg.admission_tighten_pct = 1;
+  cfg.admission_relax_pct = static_cast<int>(
+      ini.GetInt("admission_relax_pct", cfg.admission_relax_pct));
+  if (cfg.admission_relax_pct >= cfg.admission_tighten_pct)
+    cfg.admission_relax_pct = cfg.admission_tighten_pct / 2;
+  if (cfg.admission_relax_pct < 0) cfg.admission_relax_pct = 0;
+  cfg.admission_loop_lag_high_ms = ini.GetInt(
+      "admission_loop_lag_high_ms", cfg.admission_loop_lag_high_ms);
+  if (cfg.admission_loop_lag_high_ms < 0) cfg.admission_loop_lag_high_ms = 0;
+  cfg.admission_retry_after_ms = ini.GetInt(
+      "admission_retry_after_ms", cfg.admission_retry_after_ms);
+  if (cfg.admission_retry_after_ms < 1) cfg.admission_retry_after_ms = 1;
   if (cfg.base_path.empty()) {
     std::fprintf(stderr, "config error: base_path is required\n");
     return 1;

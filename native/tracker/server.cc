@@ -98,6 +98,7 @@ const char* TrackerOpName(uint8_t cmd) {
     case TrackerCmd::kProfileCtl: return "tracker.profile_ctl";
     case TrackerCmd::kProfileDump: return "tracker.profile_dump";
     case TrackerCmd::kHealthMatrix: return "tracker.health_matrix";
+    case TrackerCmd::kAdmissionStatus: return "tracker.admission_status";
     default: return nullptr;
   }
 }
@@ -157,6 +158,41 @@ bool TrackerServer::Init(std::string* error) {
       rules = SloEvaluator::DefaultRules();
     }
     slo_ = std::make_unique<SloEvaluator>(std::move(rules), events_.get());
+  }
+  // Admission control (ISSUE 19): always constructed — when disabled it
+  // still classifies and counts (ADMISSION_STATUS + gauges stay live)
+  // but never sheds.
+  {
+    AdmissionConfig acfg;
+    acfg.enabled = cfg_.admission_control;
+    acfg.tighten_threshold = cfg_.admission_tighten_pct / 100.0;
+    acfg.relax_threshold = cfg_.admission_relax_pct / 100.0;
+    acfg.loop_lag_high_ms =
+        static_cast<double>(cfg_.admission_loop_lag_high_ms);
+    acfg.retry_after_ms = cfg_.admission_retry_after_ms;
+    admission_ = std::make_unique<AdmissionController>(acfg);
+  }
+  registry_.GaugeFn("admission.level", [this] {
+    return static_cast<int64_t>(admission_->level());
+  });
+  registry_.GaugeFn("admission.pressure_milli",
+                    [this] { return admission_->pressure_milli(); });
+  registry_.GaugeFn("admission.ewma_milli",
+                    [this] { return admission_->ewma_milli(); });
+  registry_.GaugeFn("admission.tightens",
+                    [this] { return admission_->tightens(); });
+  registry_.GaugeFn("admission.relaxes",
+                    [this] { return admission_->relaxes(); });
+  registry_.GaugeFn("admission.admitted",
+                    [this] { return admission_->admitted(); });
+  registry_.GaugeFn("admission.shed_total",
+                    [this] { return admission_->shed_total(); });
+  registry_.GaugeFn("admission.retry_after_ms",
+                    [this] { return admission_->retry_after_ms(); });
+  for (int i = 0; i < kPriorityClassCount; ++i) {
+    registry_.GaugeFn(std::string("admission.shed.") +
+                          PriorityClassName(static_cast<uint8_t>(i)),
+                      [this, i] { return admission_->shed_by_class(i); });
   }
   registry_.GaugeFn("slo.breaches_active", [this] {
     return slo_ != nullptr ? slo_->breaches_active() : int64_t{0};
@@ -242,6 +278,14 @@ bool TrackerServer::Init(std::string* error) {
       &loop_, [this](uint8_t cmd, const std::string& body,
                      const std::string& peer) { return Handle(cmd, body, peer); });
   server_->set_max_connections(cfg_.max_connections);
+  // Admission gate: resolve the class (PRIORITY-frame byte, else the
+  // tracker opcode table) and consult the ladder.  Runs on the single
+  // loop thread, but AdmitOrShed is thread-safe anyway.
+  server_->set_gate([this](uint8_t cmd, uint8_t tagged, int64_t* retry_ms) {
+    uint8_t cls = tagged != kPriorityUntagged ? tagged
+                                              : DefaultTrackerPriorityClass(cmd);
+    return admission_->AdmitOrShed(cls, retry_ms);
+  });
   // Span recording: one span per traced request (TRACE_CTX prefix) or
   // per slow request (force-retained with kTraceFlagSlow + one
   // structured JSON log line), dumped via kTraceDump.
@@ -378,9 +422,33 @@ void TrackerServer::MetricsTick() {
   StatsSnapshot snap;
   registry_.Snapshot(&snap);
   if (metrics_ != nullptr) metrics_->Append(TraceWallUs(), snap);
+  double dt_s = static_cast<double>(now_mono - last_tick_mono_us_) / 1e6;
+  if (dt_s <= 0) dt_s = 1.0;
   if (slo_ != nullptr && have_tick_snap_) {
-    double dt_s = static_cast<double>(now_mono - last_tick_mono_us_) / 1e6;
-    slo_->Tick(last_tick_snap_, snap, dt_s > 0 ? dt_s : 1.0);
+    slo_->Tick(last_tick_snap_, snap, dt_s);
+  }
+  // Admission ladder tick AFTER the SLO tick (breaches_active reflects
+  // this snapshot's verdicts).  The tracker's pressure inputs are its
+  // breach count and single-loop lag p99 — it has no dio pools and no
+  // streamed-body ledger.
+  if (admission_ != nullptr) {
+    AdmissionSignals sig;
+    sig.breaches_active = slo_ != nullptr ? slo_->breaches_active() : 0;
+    double lag_ms = 0;
+    if (have_tick_snap_ &&
+        SloEvaluator::ComputeReading("loop_lag_p99_ms", last_tick_snap_,
+                                     snap, dt_s, &lag_ms))
+      sig.loop_lag_p99_ms = lag_ms;
+    int moved = admission_->Tick(sig);
+    if (moved != 0 && events_ != nullptr) {
+      char detail[128];
+      snprintf(detail, sizeof(detail), "level=%d ewma=%.6g pressure=%.6g",
+               admission_->level(), admission_->ewma_milli() / 1000.0,
+               admission_->pressure_milli() / 1000.0);
+      events_->Record(moved > 0 ? EventSeverity::kWarn : EventSeverity::kInfo,
+                      moved > 0 ? "admission.tighten" : "admission.relax",
+                      admission_->level_name(), detail);
+    }
   }
   last_tick_snap_ = std::move(snap);
   have_tick_snap_ = true;
@@ -893,6 +961,14 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
       // Stats-registry snapshot (empty body): same JSON contract as
       // StorageCmd::kStat — the tracker's loop-lag/request telemetry.
       return {0, registry_.Json()};
+
+    case TrackerCmd::kAdmissionStatus:
+      // Admission-controller state dump (empty body -> JSON): ladder
+      // level, pressure/EWMA, per-class shed counts — the same contract
+      // as the storage daemon's (monitor.decode_admission; fdfs_codec
+      // admission-json golden).
+      if (!body.empty()) return {22 /*EINVAL*/, ""};
+      return {0, admission_->StatusJson("tracker", cfg_.port)};
 
     case TrackerCmd::kEventDump:
       // Flight-recorder dump (empty body): membership transitions and

@@ -16,6 +16,7 @@
 #include "common/req_server.h"
 #include "common/sloeval.h"
 #include "common/stats.h"
+#include "storage/admission.h"
 #include "tracker/cluster.h"
 #include "tracker/relationship.h"
 
@@ -87,6 +88,17 @@ struct TrackerConfig {
   // (peers score it under this while its own trailer claims healthy)
   // or sick (its own score is under this).  Scores are 0..100.
   int health_gray_threshold = 60;
+  // Admission control (ISSUE 19; OPERATIONS.md "Overload control &
+  // request QoS"): the tracker runs the same ladder controller as the
+  // storage daemon on its single loop, so expensive dumps (born bulk
+  // per DefaultTrackerPriorityClass) shed before beats and routing
+  // queries queue behind them.  No dio/in-flight signals here — loop
+  // lag and SLO breaches drive the ladder.
+  bool admission_control = true;
+  int admission_tighten_pct = 90;
+  int admission_relax_pct = 45;
+  int64_t admission_loop_lag_high_ms = 100;
+  int64_t admission_retry_after_ms = 500;
 };
 
 class TrackerServer {
@@ -135,6 +147,9 @@ class TrackerServer {
   // them); the evaluator emits slo.breach/recovered into events_.
   std::unique_ptr<MetricsJournal> metrics_;
   std::unique_ptr<SloEvaluator> slo_;
+  // Admission gate (ISSUE 19): consulted by the RequestServer before
+  // every dispatch; ticked with the SLO engine from the same snapshots.
+  std::unique_ptr<AdmissionController> admission_;
   StatsSnapshot last_tick_snap_;
   bool have_tick_snap_ = false;
   int64_t last_tick_mono_us_ = 0;

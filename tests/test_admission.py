@@ -1,0 +1,495 @@
+"""SLO-driven admission control & request QoS (ISSUE 19).
+
+Layers:
+- pure-Python wire contract: the PRIORITY (147) prefix frame and the
+  ADMISSION_STATUS (148) opcode, the class ladder rule (class c admitted
+  at level L iff c + L <= 4), the retry-after EBUSY body, and the
+  per-opcode born-priority defaults;
+- cross-language goldens: `fdfs_codec priority-frame` (frame bytes per
+  class, the FULL 256-entry storage/tracker default tables, the admit
+  matrix off a REAL controller walked rung by rung, the retry-after
+  body) and `fdfs_codec admission-json` (the EWMA climb / hysteresis
+  hold / relax transcript plus the ADMISSION_STATUS JSON that
+  monitor.decode_admission parses back field-for-field);
+- decode_admission validation (level/name agreement, known class keys,
+  append-only unknown-field tolerance);
+- live acceptance: a storage pinned past its in-flight-bytes limit
+  walks the ladder up one rung per tick, sheds BACKGROUND before
+  NORMAL while interactive reads and the control plane survive to
+  reads-only, answers sheds with the level-scaled retry-after hint the
+  Python client honors (jittered) until the ladder relaxes, and
+  records the whole excursion in gauges + flight-recorder events +
+  `cli.py admission`.
+
+Runs under TSan + FDFS_LOCKRANK via tools/run_sanitizers.sh.
+"""
+
+import os
+import shutil
+import socket
+import subprocess
+import time
+
+import pytest
+
+from fastdfs_tpu import monitor as M
+from fastdfs_tpu.common import protocol as P
+from fastdfs_tpu.client.conn import StatusError
+from tests.harness import (BUILD, STORAGED, TRACKERD, start_storage,
+                           start_tracker, upload_retry)
+
+_HAVE_TOOLCHAIN = ((shutil.which("cmake") is not None
+                    and shutil.which("ninja") is not None)
+                   or shutil.which("g++") is not None)
+_HAVE_BINARIES = os.path.exists(STORAGED) and os.path.exists(TRACKERD)
+needs_native = pytest.mark.skipif(
+    not (_HAVE_TOOLCHAIN or _HAVE_BINARIES),
+    reason="no native toolchain and no prebuilt daemons")
+
+
+def _codec(*args):
+    exe = os.path.join(BUILD, "fdfs_codec")
+    if not os.path.exists(exe):
+        from tests.harness import ensure_native_built
+        ensure_native_built((exe,))
+    out = subprocess.run([exe, *args], capture_output=True, timeout=60)
+    assert out.returncode == 0, out.stderr.decode()
+    return out.stdout.decode()
+
+
+def _wait(cond, timeout=30, interval=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(interval)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# wire contract (pure Python)
+# ---------------------------------------------------------------------------
+
+def test_admission_opcodes():
+    # Same values on both ports: a client tags and introspects the
+    # tracker exactly as it does a storage.
+    assert P.StorageCmd.PRIORITY == P.TrackerCmd.PRIORITY == 147
+    assert P.StorageCmd.ADMISSION_STATUS == \
+        P.TrackerCmd.ADMISSION_STATUS == 148
+
+
+def test_priority_class_values():
+    PC = P.PriorityClass
+    assert [int(c) for c in (PC.CONTROL, PC.INTERACTIVE, PC.NORMAL,
+                             PC.BULK, PC.BACKGROUND)] == [0, 1, 2, 3, 4]
+    # monitor's name tables index by class byte / ladder level.
+    assert M.PRIORITY_CLASSES == ("control", "interactive", "normal",
+                                  "bulk", "background")
+    assert M.ADMISSION_LEVELS == ("admit-all", "shed-background",
+                                  "shed-bulk", "reads-only")
+
+
+def test_ladder_rule():
+    # Level 0 admits everything; each rung sheds exactly one more class
+    # from the bottom; CONTROL and INTERACTIVE survive every rung.
+    for c in range(5):
+        assert P.admitted_at_level(c, 0)
+    assert [P.admitted_at_level(c, 1) for c in range(5)] == \
+        [True, True, True, True, False]
+    assert [P.admitted_at_level(c, 2) for c in range(5)] == \
+        [True, True, True, False, False]
+    assert [P.admitted_at_level(c, 3) for c in range(5)] == \
+        [True, True, False, False, False]
+
+
+def test_priority_frame_shape():
+    frame = P.priority_frame(P.PriorityClass.BULK)
+    assert len(frame) == P.HEADER_SIZE + P.PRIORITY_FRAME_LEN
+    hdr = P.unpack_header(frame[:P.HEADER_SIZE])
+    assert hdr.cmd == P.StorageCmd.PRIORITY
+    assert hdr.pkg_len == P.PRIORITY_FRAME_LEN
+    assert hdr.status == 0
+    assert P.unpack_priority(frame[P.HEADER_SIZE:]) == 3
+    with pytest.raises(ValueError):
+        P.unpack_priority(b"")
+    with pytest.raises(ValueError):
+        P.pack_priority(256)
+
+
+def test_retry_after_body():
+    assert P.pack_retry_after(1500) == (1500).to_bytes(8, "big")
+    assert P.unpack_retry_after(P.pack_retry_after(750)) == 750
+    # Hint-less EBUSY sources (max_connections, drain, non-leader, old
+    # daemons) answer status-only: that reads as "no hint", never an
+    # error, and negative garbage clamps to 0.
+    assert P.unpack_retry_after(b"") == 0
+    assert P.unpack_retry_after(b"\x01\x02") == 0
+    assert P.unpack_retry_after((-5).to_bytes(8, "big", signed=True)) == 0
+
+
+def test_default_priority_classes():
+    S, PC = P.StorageCmd, P.PriorityClass
+    # Spot the semantic anchors; the codec golden pins all 256 entries.
+    for cmd in (S.STAT, S.ADMISSION_STATUS, S.HEALTH_STATUS,
+                S.ACTIVE_TEST):
+        assert P.default_priority_class(cmd) == PC.CONTROL
+    for cmd in (S.DOWNLOAD_FILE, S.GET_METADATA):
+        assert P.default_priority_class(cmd) == PC.INTERACTIVE
+    assert P.default_priority_class(S.UPLOAD_FILE) == PC.NORMAL
+    assert P.default_priority_class(S.UPLOAD_RECIPE) == PC.BULK
+    for cmd in (S.SYNC_CREATE_FILE, S.FETCH_CHUNK, S.EC_RELEASE):
+        assert P.default_priority_class(cmd) == PC.BACKGROUND
+    # Unknown / future opcodes are born NORMAL, not shed-proof.
+    assert P.default_priority_class(200) == PC.NORMAL
+
+
+# ---------------------------------------------------------------------------
+# decode_admission (monitor side)
+# ---------------------------------------------------------------------------
+
+def _status_fixture() -> dict:
+    return {
+        "role": "storage", "port": 23000, "enabled": True,
+        "level": 2, "level_name": "shed-bulk",
+        "pressure": 1.25, "ewma": 0.97,
+        "tighten_threshold": 0.9, "relax_threshold": 0.45,
+        "tightens": 4, "relaxes": 2, "retry_after_ms": 1000,
+        "admitted": 120, "shed": 17,
+        "shed_by_class": {"control": 0, "interactive": 0, "normal": 2,
+                          "bulk": 6, "background": 9},
+    }
+
+
+def test_decode_admission_roundtrip():
+    st = M.decode_admission(_status_fixture())
+    assert (st.role, st.port, st.enabled) == ("storage", 23000, True)
+    assert (st.level, st.level_name) == (2, "shed-bulk")
+    assert (st.pressure, st.ewma) == (1.25, 0.97)
+    assert (st.tighten_threshold, st.relax_threshold) == (0.9, 0.45)
+    assert (st.tightens, st.relaxes) == (4, 2)
+    assert (st.retry_after_ms, st.admitted, st.shed) == (1000, 120, 17)
+    assert st.shed_by_class["background"] == 9
+
+
+def test_decode_admission_ignores_unknown_keys():
+    obj = _status_fixture()
+    obj["future_field"] = [1, 2, 3]  # append-only wire contract
+    assert M.decode_admission(obj).level == 2
+
+
+def test_decode_admission_validation():
+    with pytest.raises(ValueError):
+        M.decode_admission({"role": "storage"})  # missing fields
+    bad = _status_fixture()
+    bad["level"] = 7  # off the ladder
+    with pytest.raises(ValueError):
+        M.decode_admission(bad)
+    bad = _status_fixture()
+    bad["level_name"] = "reads-only"  # name disagrees with level 2
+    with pytest.raises(ValueError):
+        M.decode_admission(bad)
+    bad = _status_fixture()
+    bad["shed_by_class"] = {"mauve": 1}  # unknown class
+    with pytest.raises(ValueError):
+        M.decode_admission(bad)
+
+
+def test_top_rates_admission_fields_and_render():
+    """fdfs_top's ADMISSION pane: shed/s is a rate off the lifetime
+    counter, the tightest node leads the line, and daemons publishing
+    no admission gauges (or idle at admit-all) are skipped, not shown
+    as a fake level 0."""
+    def reg(level=None, shed=0):
+        g = {} if level is None else {"admission.level": level,
+                                      "admission.shed_total": shed}
+        return {"counters": {}, "gauges": g, "histograms": {}}
+
+    prev = M.TopSample(ts=1700000000.0, nodes={
+        "storage a:1": M.NodeSample(role="storage", addr="a:1",
+                                    registry=reg(0, 10)),
+        "storage b:2": M.NodeSample(role="storage", addr="b:2",
+                                    registry=reg(1, 0)),
+        "storage c:3": M.NodeSample(role="storage", addr="c:3",
+                                    registry=reg()),
+    })
+    cur = M.TopSample(ts=1700000002.0, nodes={
+        "storage a:1": M.NodeSample(role="storage", addr="a:1",
+                                    registry=reg(3, 40)),
+        "storage b:2": M.NodeSample(role="storage", addr="b:2",
+                                    registry=reg(1, 0)),
+        "storage c:3": M.NodeSample(role="storage", addr="c:3",
+                                    registry=reg()),
+    })
+    rates = M.top_rates(prev, cur)
+    assert rates["storage a:1"]["admission_level"] == 3
+    assert rates["storage a:1"]["shed_s"] == 15.0  # (40-10)/2s
+    assert rates["storage c:3"]["admission_level"] is None
+    frame = M.render_top(cur, rates, [])
+    assert "ADMISSION:" in frame
+    # Tightest-first ordering: a:1 at reads-only leads b:2's rung 1.
+    assert frame.index("storage a:1: reads-only shed/s=15.0") < \
+        frame.index("storage b:2: shed-background shed/s=0")
+    assert "storage c:3:" not in frame.split("ADMISSION:")[1].split("\n")[0]
+    # All quiet at admit-all: the pane disappears entirely.
+    calm = {n: dict(r, admission_level=0, shed_s=0.0)
+            for n, r in rates.items()}
+    assert "ADMISSION:" not in M.render_top(cur, calm, [])
+
+
+# ---------------------------------------------------------------------------
+# cross-language goldens (fdfs_codec priority-frame / admission-json)
+# ---------------------------------------------------------------------------
+
+def _parse_kv(text: str) -> dict[str, str]:
+    out = {}
+    for line in text.splitlines():
+        if "=" in line and " " not in line.split("=", 1)[0]:
+            k, v = line.split("=", 1)
+            out[k] = v
+    return out
+
+
+def test_priority_frame_golden():
+    """Every line of `fdfs_codec priority-frame` rebuilt from the
+    protocol.py mirrors: the frame bytes per class, BOTH full 256-entry
+    born-priority tables, the admit matrix off a real controller walked
+    rung by rung, and the retry-after body."""
+    kv = _parse_kv(_codec("priority-frame"))
+    for cls in P.PriorityClass:
+        name = M.PRIORITY_CLASSES[int(cls)]
+        assert kv[f"frame_{name}"] == P.priority_frame(int(cls)).hex(), name
+    # The full storage table: one digit per opcode value.  A class
+    # moved on either side shifts a digit and fails loudly.
+    assert kv["storage_defaults"] == \
+        "".join(str(P.default_priority_class(i)) for i in range(256))
+    # Tracker table: the expensive observability dumps are born BULK (a
+    # lagging single-loop tracker sheds dashboards first); everything
+    # else — beats, joins, lookups, leader RPCs — is control-plane.
+    T = P.TrackerCmd
+    tracker_bulk = {int(T.SERVER_CLUSTER_STAT), int(T.TRACE_DUMP),
+                    int(T.EVENT_DUMP), int(T.METRICS_HISTORY),
+                    int(T.PROFILE_DUMP), int(T.HEALTH_MATRIX)}
+    assert kv["tracker_defaults"] == \
+        "".join("3" if i in tracker_bulk else "0" for i in range(256))
+    # Admit matrix: the C++ controller at each rung == the Python rule.
+    for lvl in range(4):
+        assert kv[f"admit_level{lvl}"] == \
+            "".join("1" if P.admitted_at_level(c, lvl) else "0"
+                    for c in range(5)), lvl
+    assert kv["retry_after_1500"] == P.pack_retry_after(1500).hex()
+
+
+def test_admission_json_golden():
+    """The `fdfs_codec admission-json` transcript: EWMA climb one rung
+    per tick, HOLD inside the hysteresis band (the no-flap pin), relax
+    below the threshold — then the ADMISSION_STATUS JSON decoded
+    field-for-field by monitor.decode_admission."""
+    lines = _codec("admission-json").splitlines()
+    ticks = [l for l in lines if l.startswith("tick ")]
+    # Climb: sustained breach jumps the EWMA to 1.0 > 0.9 every tick;
+    # one rung each; the fourth tick is pinned at the top (moved=0).
+    assert ticks[:4] == [
+        "tick breaches=1 moved=+1 level=1 ewma_milli=1000",
+        "tick breaches=1 moved=+1 level=2 ewma_milli=1000",
+        "tick breaches=1 moved=+1 level=3 ewma_milli=1000",
+        "tick breaches=1 moved=+0 level=3 ewma_milli=1000",
+    ]
+    # Recovery: first zero-pressure tick decays the EWMA to 0.5 —
+    # INSIDE the band (0.45 < 0.5 <= 0.9), so the ladder holds (this
+    # line is the hysteresis pin); the second reaches 0.25 <= 0.45 and
+    # relaxes exactly one rung.
+    assert ticks[4:] == [
+        "tick breaches=0 moved=+0 level=3 ewma_milli=500",
+        "tick breaches=0 moved=-1 level=2 ewma_milli=250",
+    ]
+    # At reads-only: control + interactive pass, the rest bounce with
+    # the level-scaled hint (fixture base 250 ms x level 3).
+    admits = [l for l in lines if l.startswith("admit ")]
+    assert admits == [
+        "admit class=0 ok=1 retry_ms=0",
+        "admit class=1 ok=1 retry_ms=0",
+        "admit class=2 ok=0 retry_ms=750",
+        "admit class=3 ok=0 retry_ms=750",
+        "admit class=4 ok=0 retry_ms=750",
+    ]
+    st = M.decode_admission(__import__("json").loads(lines[-1]))
+    assert (st.role, st.port, st.enabled) == ("storage", 23000, True)
+    assert (st.level, st.level_name) == (2, "shed-bulk")
+    assert st.ewma == 0.25
+    assert (st.tighten_threshold, st.relax_threshold) == (0.9, 0.45)
+    assert (st.tightens, st.relaxes) == (3, 1)
+    assert st.retry_after_ms == 500  # base 250 x current level 2
+    assert (st.admitted, st.shed) == (2, 3)
+    assert st.shed_by_class == {"control": 0, "interactive": 0,
+                                "normal": 1, "bulk": 1, "background": 1}
+
+
+# ---------------------------------------------------------------------------
+# live acceptance
+# ---------------------------------------------------------------------------
+
+# Fast ladder: 1 s ticks, a 4 MB in-flight limit one stalled request
+# can pin, and a short base hint so the shed-retry path completes
+# inside a test timeout.
+ADMISSION = ("heart_beat_interval = 1\nstat_report_interval = 1"
+             "\nslo_eval_interval_s = 1"
+             "\nadmission_inflight_high_bytes = 4M"
+             "\nadmission_retry_after_ms = 200")
+
+
+def _stall_upload(ip: str, port: int, declared: int = 8 << 20) -> socket.socket:
+    """Open a connection that declares a large upload and never sends
+    the body: the declared bytes sit in the daemon's admission
+    in-flight ledger (accepted but unanswered) and pin the pressure
+    score above 1.0 until the socket closes."""
+    s = socket.create_connection((ip, port), timeout=10)
+    s.sendall(P.pack_header(declared, P.StorageCmd.UPLOAD_FILE))
+    return s
+
+
+def _admission(ip, port):
+    from fastdfs_tpu.client import StorageClient
+    with StorageClient(ip, port) as sc:
+        return M.decode_admission(sc.admission_status())
+
+
+@needs_native
+def test_live_ladder_sheds_and_recovers(tmp_path, capsys):
+    """The acceptance arc: pinned in-flight bytes walk the ladder up one
+    rung per tick; background sheds before normal while interactive
+    reads and the control plane answer at every rung; sheds carry the
+    level-scaled retry-after hint; the client's jittered shed-retry
+    rides out the excursion; the ladder relaxes once the pressure
+    drains; gauges, flight-recorder events, and `cli.py admission` all
+    show the excursion."""
+    from fastdfs_tpu.cli import main as cli_main
+    from fastdfs_tpu.client import FdfsClient, StorageClient
+
+    tr = start_tracker(os.path.join(str(tmp_path), "tr"))
+    taddr = f"127.0.0.1:{tr.port}"
+    st = start_storage(os.path.join(str(tmp_path), "st"), trackers=[taddr],
+                       extra=ADMISSION)
+    # admission_retries=0: sheds propagate immediately so the test sees
+    # the raw refusal instead of the client riding it out.
+    c0 = FdfsClient([taddr], admission_retries=0)
+    stall = None
+    try:
+        file_id = upload_retry(c0, os.urandom(16 << 10), ext="bin")
+        assert c0.download_to_buffer(file_id)
+
+        # Baseline: zero sheds at idle, ladder at admit-all.
+        a = _admission(st.ip, st.port)
+        assert (a.enabled, a.level, a.shed) == (True, 0, 0)
+        tr_a = M.decode_admission(c0.tracker_admission_status())
+        assert (tr_a.role, tr_a.enabled, tr_a.level) == ("tracker", True, 0)
+
+        stall = _stall_upload(st.ip, st.port)
+
+        # Mid-climb (level >= 1): BACKGROUND sheds first...
+        a = _wait(lambda: (x := _admission(st.ip, st.port)).level >= 1
+                  and x, timeout=30)
+        assert a and a.level >= 1, a
+        with StorageClient(st.ip, st.port) as sc:
+            sc.conn.priority = int(P.PriorityClass.BACKGROUND)
+            with pytest.raises(StatusError) as ei:
+                sc.download_to_buffer(file_id)
+            assert ei.value.status == 16
+            # The hint is the base scaled by the CURRENT level.
+            assert ei.value.retry_after_ms >= 200
+            assert ei.value.retry_after_ms % 200 == 0
+        # ...while an untagged download (born interactive) still lands
+        # on the very same connection shape.
+        with StorageClient(st.ip, st.port) as sc:
+            assert sc.download_to_buffer(file_id)
+
+        # Top of the ladder: writes shed too (reads-only)...
+        a = _wait(lambda: (x := _admission(st.ip, st.port)).level == 3
+                  and x, timeout=30)
+        assert a and a.level == 3 and a.level_name == "reads-only", a
+        with pytest.raises(StatusError) as ei:
+            c0.upload_buffer(os.urandom(1 << 10), ext="bin")
+        assert ei.value.status == 16 and ei.value.retry_after_ms == 600
+        # ...reads and the whole control plane survive.
+        assert c0.download_to_buffer(file_id)
+        with StorageClient(st.ip, st.port) as sc:
+            reg = M.decode_registry(sc.stat())
+            assert reg["gauges"]["admission.level"] == 3
+            assert reg["gauges"]["admission.shed_total"] >= 2
+            assert reg["gauges"]["admission.shed.background"] >= 1
+            assert reg["gauges"]["admission.shed.normal"] >= 1
+            assert reg["gauges"]["admission.inflight_bytes"] >= 8 << 20
+            evs = M.decode_events(sc.event_dump())
+            tightens = [e for e in evs if e.type == "admission.tighten"]
+            assert len(tightens) >= 3
+            assert any("ewma=" in e.detail for e in tightens)
+        # The operator console renders the excursion (admission status
+        # is control-class: it answers FROM a reads-only daemon).
+        assert cli_main(["admission", taddr]) == 0
+        out = capsys.readouterr().out
+        assert "reads-only" in out
+        assert "shed by class:" in out
+
+        # Recovery: drop the stalled upload and immediately retry a
+        # write through the shed-retry client — its first attempts are
+        # refused with hints it must honor (jittered), then the ladder
+        # relaxes past shed-bulk and the write lands.
+        stall.close()
+        stall = None
+        cr = FdfsClient([taddr], admission_retries=20)
+        try:
+            assert cr.upload_buffer(os.urandom(1 << 10), ext="bin")
+            assert cr.stats()["admission_retry_waits"] >= 1
+        finally:
+            cr.close()
+
+        # The ladder walks all the way home and counts both directions.
+        a = _wait(lambda: (x := _admission(st.ip, st.port)).level == 0
+                  and x, timeout=30)
+        assert a and a.level == 0, a
+        assert a.tightens >= 3 and a.relaxes >= 3
+        assert a.shed_by_class["interactive"] == 0
+        assert a.shed_by_class["control"] == 0
+        assert upload_retry(c0, os.urandom(1 << 10), ext="bin")
+    finally:
+        if stall is not None:
+            stall.close()
+        c0.close()
+        st.stop()
+        tr.stop()
+
+
+@needs_native
+def test_live_admission_disabled_never_sheds(tmp_path):
+    """admission_control = 0: the controller still classifies and
+    publishes (status answers, gauges pinned at level 0) but the gate
+    never refuses — the pre-QoS behavior, byte-for-byte."""
+    from fastdfs_tpu.client import FdfsClient
+
+    tr = start_tracker(os.path.join(str(tmp_path), "tr"))
+    taddr = f"127.0.0.1:{tr.port}"
+    st = start_storage(os.path.join(str(tmp_path), "st"), trackers=[taddr],
+                       extra=ADMISSION + "\nadmission_control = 0")
+    c = FdfsClient([taddr], admission_retries=0)
+    stall = None
+    try:
+        file_id = upload_retry(c, os.urandom(16 << 10), ext="bin")
+        stall = _stall_upload(st.ip, st.port)
+        # Give the tick loop time to see the pinned pressure; the
+        # DISABLED ladder must not move or shed.
+        time.sleep(2.5)
+        a = _admission(st.ip, st.port)
+        assert (a.enabled, a.level, a.shed) == (False, 0, 0)
+        assert c.download_to_buffer(file_id)
+        assert c.upload_buffer(os.urandom(1 << 10), ext="bin")
+    finally:
+        if stall is not None:
+            stall.close()
+        c.close()
+        st.stop()
+        tr.stop()
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -219,3 +219,36 @@ def test_config11_ec_cold_tier_smoke(tmp_path):
         assert r["rebuilt_bytes"] > 0 and r["wall_s"] > 0
     assert art["paced_within_budget"] is True
     assert art["pacing_effective"] is True
+
+
+def test_config13_admission_control_smoke(tmp_path):
+    # The admission-control scenario end-to-end at tiny scale: capacity
+    # and the loop-lag SLO threshold calibrate off the baseline arm's
+    # own saturated histograms, the half-capacity arm sheds NOTHING,
+    # the 1.7x overload arm drives the ladder (tightens >= 1, sheds
+    # background/normal but never interactive/control), every error is
+    # a shed (EBUSY 16, no transport or op failures), and the admitted
+    # interactive p99 beats the admission-off baseline's collapse at
+    # the same offered rate.  (The exact collapse RATIO is asserted on
+    # the checked-in artifact, not here — it is hardware-dependent.)
+    bc.config13(str(tmp_path), scale=0.0015)  # 12 x 1 MB, ~45 s of load
+    with open(os.path.join(str(tmp_path), "config13.json")) as fh:
+        art = json.load(fh)
+    assert art["zero_sheds_at_half_capacity"] is True
+    assert art["sheds_under_overload"] is True
+    assert art["ladder_engaged"] is True
+    assert art["zero_non_shed_errors"] is True
+    assert art["interactive_never_shed"] is True
+    assert art["shed_prefers_background"] is True
+    assert art["admitted_p99_bounded_vs_baseline"] is True
+    assert art["capacity_qps"] > 0
+    assert art["offered_rates_qps"]["overload"] > \
+        art["offered_rates_qps"]["half"]
+    over = art["arms"]["admission"]["overload"]
+    assert over["shed"] > 0 and over["goodput_qps"] > 0
+    assert over["by_class"]["interactive"]["shed"] == 0
+    g = art["arms"]["admission"]["gauges_after_overload"]
+    assert g["admission.tightens"] >= 1
+    assert g["admission.shed_total"] == over["shed"]
+    half = art["arms"]["admission"]["half"]
+    assert half["shed"] == 0 and half["non_shed_errors"] == 0

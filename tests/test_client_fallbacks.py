@@ -13,6 +13,8 @@ sockets, no sleeps beyond the bounded cap wait.
 import threading
 import time
 
+import pytest
+
 from fastdfs_tpu.client.client import FdfsClient
 from fastdfs_tpu.client.conn import ConnectionPool, StatusError
 from fastdfs_tpu.client.tracker_client import StoreTarget
@@ -30,7 +32,8 @@ def test_stats_starts_zero_and_copies():
     assert s == {"dedup_fallback_plain": 0,
                  "placement_fallback_tracker": 0,
                  "ranged_fallback_single": 0,
-                 "dead_peer_skips": 0}
+                 "dead_peer_skips": 0,
+                 "admission_retry_waits": 0}
     s["dedup_fallback_plain"] = 99  # a snapshot, not the live dict
     assert c.stats()["dedup_fallback_plain"] == 0
 
@@ -163,18 +166,172 @@ def test_ranged_single_range_is_not_a_fallback(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# admission sheds (EBUSY + retry-after): the client-side QoS contract
+# — an admission refusal is "alive but shedding", NEVER a dead peer
+# ---------------------------------------------------------------------------
+
+class _SheddingTracker:
+    """Stands in for the TrackerClient context: holds a conn identity so
+    _with_tracker can name the endpoint it would (wrongly) condemn."""
+
+    def __init__(self, host="127.0.0.1", port=1):
+        self.conn = FakeConn(host, port)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def test_admission_shed_never_marks_tracker_dead(monkeypatch):
+    # The satellite-1 contract: EBUSY + hint must not trip
+    # dead_peer_cooldown_s — a shed proves the peer is ALIVE, and a
+    # dead-mark would steer a cooldown's worth of traffic toward its
+    # equally-loaded (or worse) siblings.  Transport failures still
+    # mark dead; that path is pinned further down.
+    c = FdfsClient(["127.0.0.1:1", "127.0.0.1:2"], timeout=0.1,
+                   use_pool=True)
+    sleeps: list[float] = []
+    monkeypatch.setattr("fastdfs_tpu.client.client.time",
+                        type("T", (), {"sleep": staticmethod(sleeps.append)}))
+
+    def fake_tracker():
+        return _SheddingTracker()
+    monkeypatch.setattr(c, "_tracker", fake_tracker)
+
+    def shed(t):
+        raise StatusError(16, "query_store", retry_after_ms=40)
+    with pytest.raises(StatusError) as ei:
+        c._with_tracker(shed)
+    assert ei.value.status == 16 and ei.value.retry_after_ms == 40
+    # No endpoint was condemned, no idle socket purged.
+    assert c.pool.dead_mark_count() == 0
+    # Every failover attempt honored the hint with bounded jitter:
+    # hint <= sleep <= hint * 1.25 (the stampede-breaking spread).
+    assert sleeps, "shed retries never slept the retry-after hint"
+    assert all(0.040 <= s <= 0.050001 for s in sleeps), sleeps
+    assert c.stats()["admission_retry_waits"] == len(sleeps)
+
+
+def test_ebusy_without_hint_fails_over_without_sleeping(monkeypatch):
+    # Hint-less EBUSY predates admission (max_connections refusals,
+    # drain, non-leader): failover must stay eager — sleeping would
+    # slow the classic path — and still never mark dead.
+    c = FdfsClient(["127.0.0.1:1", "127.0.0.1:2"], timeout=0.1,
+                   use_pool=True)
+    sleeps: list[float] = []
+    monkeypatch.setattr("fastdfs_tpu.client.client.time",
+                        type("T", (), {"sleep": staticmethod(sleeps.append)}))
+    monkeypatch.setattr(c, "_tracker", lambda: _SheddingTracker())
+
+    def busy(t):
+        raise StatusError(16, "query_store")  # no retry_after body
+    with pytest.raises(StatusError):
+        c._with_tracker(busy)
+    assert not sleeps
+    assert c.pool.dead_mark_count() == 0
+    assert c.stats()["admission_retry_waits"] == 0
+
+
+def test_transport_failure_still_marks_dead(monkeypatch):
+    # The counter-case guarding the contract above: an OSError mid-op
+    # IS a transport failure and must keep tripping the cooldown.
+    c = FdfsClient(["127.0.0.1:1", "127.0.0.1:2"], timeout=0.1,
+                   use_pool=True)
+    monkeypatch.setattr(c, "_tracker", lambda: _SheddingTracker())
+
+    def die(t):
+        raise ConnectionResetError("peer vanished")
+    with pytest.raises((OSError, ConnectionError)):
+        c._with_tracker(die)
+    assert c.pool.dead_mark_count() >= 1
+
+
+def test_shed_retry_reruns_whole_operation_then_propagates(monkeypatch):
+    # _shed_retry re-runs the FULL two-hop closure (a shed answers at
+    # request-header stage, so nothing partial ever happened) up to
+    # admission_retries times, sleeping the jittered hint between
+    # attempts, then lets the EBUSY reach the caller.
+    c = _client(admission_retries=2)
+    waited: list[int] = []
+    monkeypatch.setattr(c, "_admission_wait",
+                        lambda e: waited.append(e.retry_after_ms))
+    calls = {"n": 0}
+
+    def always_shed():
+        calls["n"] += 1
+        raise StatusError(16, "upload", retry_after_ms=25)
+    with pytest.raises(StatusError):
+        c._shed_retry(always_shed)
+    assert calls["n"] == 3          # 2 retries + the final propagation run
+    assert waited == [25, 25]
+
+    # Success on a retry returns the value and stops consuming budget.
+    calls["n"] = 0
+
+    def shed_once():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise StatusError(16, "upload", retry_after_ms=25)
+        return "g1/ok"
+    assert c._shed_retry(shed_once) == "g1/ok"
+    assert calls["n"] == 2
+
+    # Non-admission errors (wrong status, or EBUSY without a hint)
+    # propagate immediately — no silent retry of a real failure.
+    for err in (StatusError(2, "missing"), StatusError(16, "maxconn")):
+        calls["n"] = 0
+
+        def other():
+            calls["n"] += 1
+            raise err
+        with pytest.raises(StatusError):
+            c._shed_retry(other)
+        assert calls["n"] == 1
+
+
+def test_admission_retries_zero_disables_retry(monkeypatch):
+    c = _client(admission_retries=0)
+    monkeypatch.setattr(c, "_admission_wait",
+                        lambda e: pytest.fail("waited with retries off"))
+    calls = {"n": 0}
+
+    def shed():
+        calls["n"] += 1
+        raise StatusError(16, "upload", retry_after_ms=25)
+    with pytest.raises(StatusError):
+        c._shed_retry(shed)
+    assert calls["n"] == 1
+
+
+def test_pool_release_clears_sticky_priority(monkeypatch):
+    # A parked conn must not carry the previous borrower's QoS class
+    # any more than its trace ctx — the next borrower may be an
+    # untagged (per-opcode default) client.
+    pool = _patched_pool(monkeypatch)
+    conn = pool.acquire("127.0.0.1", 9)
+    conn.priority = 4
+    conn.trace_ctx = object()
+    pool.release(conn)
+    assert conn.priority is None and conn.trace_ctx is None
+
+
+# ---------------------------------------------------------------------------
 # connection pool: multiplexing cap + hygiene (ISSUE 18) — no daemons
 # ---------------------------------------------------------------------------
 
 class FakeConn:
     """Stands in for conn.Connection: the pool only touches host/port/
-    broken/trace_ctx/close, plus .sock through _quiet (patched out)."""
+    broken/trace_ctx/priority/close, plus .sock through _quiet (patched
+    out)."""
 
     def __init__(self, host="127.0.0.1", port=9, timeout=0.0):
         self.host = host
         self.port = port
         self.broken = False
         self.trace_ctx = None
+        self.priority = None
         self.closed = False
         self.sock = None
 

@@ -161,6 +161,7 @@ def test_iterable_body_requires_length_and_checks_it():
             self.timeout = 1
             self.broken = False
             self.trace_ctx = None
+            self.priority = None
             self.sent = bytearray()
             self.sock = self
 

@@ -435,7 +435,8 @@ def test_manifest_golden_names_resolve():
                        "metrics-history", "heat-top", "placement-wire",
                        "group-admin", "profile-ctl", "profile-json",
                        "ec-status", "ec-stripe-layout",
-                       "health-status", "health-matrix"}
+                       "health-status", "health-matrix",
+                       "priority-frame", "admission-json"}
 
 
 if __name__ == "__main__":

@@ -125,7 +125,17 @@ def _sample_registry() -> dict:
                    "ec.reconstructed_shards": 2,
                    "ec.reconstructed_bytes": 349525,
                    "ec.repair_fallback_chunks": 1, "ec.remote_reads": 9,
-                   "ec.last_demote_unix": 1700000000},
+                   "ec.last_demote_unix": 1700000000,
+                   # admission ladder (ISSUE 19): current rung + pressure
+                   # inputs, lifetime admit/shed flow, per-class refusals
+                   "admission.level": 2, "admission.pressure_milli": 950,
+                   "admission.ewma_milli": 910, "admission.tightens": 3,
+                   "admission.relaxes": 1, "admission.admitted": 240,
+                   "admission.shed_total": 17,
+                   "admission.retry_after_ms": 500,
+                   "admission.inflight_bytes": 4194304,
+                   "admission.shed.background": 11,
+                   "admission.shed.bulk": 6},
         "histograms": {
             "op.upload_file.latency_us": {
                 "bounds": [100, 1000, 10000],
@@ -306,6 +316,22 @@ def test_prometheus_exposition_parses():
     assert series["fdfs_ec_reconstructed_shards"][0][1] == 2.0
     assert series["fdfs_ec_repair_fallback_chunks"][0][1] == 1.0
     assert series["fdfs_ec_remote_reads"][0][1] == 9.0
+    # Admission-control golden (ISSUE 19): the shed ladder's rung,
+    # pressure/EWMA inputs, and per-class refusal counters export
+    # per-storage so dashboards can chart shed rates and alert when a
+    # node sits at reads-only.
+    assert series["fdfs_admission_level"][0] == (
+        '{storage="127.0.0.1:23000"}', 2.0)
+    assert series["fdfs_admission_pressure_milli"][0][1] == 950.0
+    assert series["fdfs_admission_ewma_milli"][0][1] == 910.0
+    assert series["fdfs_admission_tightens"][0][1] == 3.0
+    assert series["fdfs_admission_relaxes"][0][1] == 1.0
+    assert series["fdfs_admission_admitted"][0][1] == 240.0
+    assert series["fdfs_admission_shed_total"][0][1] == 17.0
+    assert series["fdfs_admission_retry_after_ms"][0][1] == 500.0
+    assert series["fdfs_admission_inflight_bytes"][0][1] == 4194304.0
+    assert series["fdfs_admission_shed_background"][0][1] == 11.0
+    assert series["fdfs_admission_shed_bulk"][0][1] == 6.0
     buckets = series["fdfs_op_upload_file_latency_us_bucket"]
     values = [v for _, v in buckets]
     assert values == sorted(values), "histogram buckets must be cumulative"

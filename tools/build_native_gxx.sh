@@ -34,7 +34,7 @@ srcs_common="common/bytes.cc common/cdc.cc common/fileid.cc common/ini.cc
   common/sloeval.cc common/heatsketch.cc common/fsutil.cc
   common/threadreg.cc common/profiler.cc common/healthmon.cc
   common/http_token.cc"
-srcs_storage="storage/chunkstore.cc storage/slabstore.cc storage/ecstore.cc
+srcs_storage="storage/admission.cc storage/chunkstore.cc storage/slabstore.cc storage/ecstore.cc
   storage/config.cc storage/store.cc
   storage/binlog.cc storage/trunk.cc storage/recovery.cc storage/rebalance.cc storage/scrub.cc storage/dedup.cc
   storage/server.cc storage/sync.cc storage/tracker_client.cc"
@@ -64,9 +64,11 @@ link() { g++ $FLAGS -rdynamic "$@" -lpthread; }
 link storage/main.cc "$BUILD_DIR/obj/libfdfs_storage.a" \
   "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/fdfs_storaged" &
 link tracker/main.cc "$BUILD_DIR/obj/libfdfs_tracker.a" \
+  "$BUILD_DIR/obj/storage_admission.o" \
   "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/fdfs_trackerd" &
 link tools/codec_cli.cc "$BUILD_DIR/obj/storage_slabstore.o" \
   "$BUILD_DIR/obj/storage_ecstore.o" \
+  "$BUILD_DIR/obj/storage_admission.o" \
   "$BUILD_DIR/obj/tracker_placement.o" \
   "$BUILD_DIR/obj/tracker_cluster.o" \
   "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/fdfs_codec" &
@@ -77,6 +79,7 @@ link tests/common_test.cc "$BUILD_DIR/obj/libfdfs_common.a" \
 link tests/storage_test.cc "$BUILD_DIR/obj/libfdfs_storage.a" \
   "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/storage_test" &
 link tests/tracker_test.cc "$BUILD_DIR/obj/libfdfs_tracker.a" \
+  "$BUILD_DIR/obj/storage_admission.o" \
   "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/tracker_test" &
 wait
 echo "native build complete: $(ls "$BUILD_DIR/fdfs_storaged" "$BUILD_DIR/fdfs_trackerd")"

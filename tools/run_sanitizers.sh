@@ -35,7 +35,8 @@ else
     tests/test_read_path.py tests/test_observability.py
     tests/test_report.py tests/test_slab.py tests/test_groups.py
     tests/test_cdc_kernels.py tests/test_profile.py tests/test_ec.py
-    tests/test_health.py tests/test_serving_edge.py)
+    tests/test_health.py tests/test_serving_edge.py
+    tests/test_admission.py)
 fi
 
 build_tree() {
