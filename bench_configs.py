@@ -66,11 +66,15 @@ NOMINAL = {1: 1 << 30, 2: 10 << 30, 3: 50 << 30, 4: 100 << 30,
            # config13: ISSUE 19 admission-control overload corpus
            # (1 MB files, 4 KB chunks, cache off; run length is
            # rate x seconds, the corpus only bounds the working set).
-           13: 1 << 30}
+           13: 1 << 30,
+           # config14: ISSUE 20 elastic hot replication corpus (8 KB
+           # flat files; one file takes 90% of the reads — the corpus
+           # only bounds the cold tail).
+           14: 2 << 30}
 DEFAULT_SCALE = {1: 0.25, 2: 1 / 32.0, 3: 1 / 64.0, 4: 1 / 40.0,
                  5: 1 / 2000.0, 6: 1 / 256.0, 7: 1 / 256.0, 8: 1 / 64.0,
                  9: 0.1, 10: 1 / 64.0, 11: 1 / 256.0, 12: 1 / 128.0,
-                 13: 1 / 128.0}
+                 13: 1 / 128.0, 14: 1 / 64.0}
 
 
 def emit(out_dir: str, config: int, payload: dict) -> None:
@@ -2604,10 +2608,350 @@ def config13(out_dir: str, scale: float) -> None:
     })
 
 
+def config14(out_dir: str, scale: float) -> None:
+    """Heat-driven elastic replication (ISSUE 20): the same two-tier
+    key-popularity read mix (one file takes 90% of the reads —
+    `--hot-keys 1:90`) against a 3-group cluster with the hot-map
+    policy OFF and ON.  Each arm preloads 8 KB flat files round-robin
+    across the groups (small objects make the per-read RPC structure —
+    not bulk data movement — the dominant cost, which is exactly the
+    regime hot keys hurt in: every classic read is a tracker hop plus
+    a storage hop, all piling onto one tracker and one home group),
+    warms the heat ledger with an fdfs_load `--hot-keys` leg (its
+    per-key-class combine section is recorded: that is the classic
+    tracker-hop path), then — ON arm only — waits for the tracker to
+    publish the promoted entry (which happens only after the fan-out
+    byte-verified every extra copy), and finally runs the measured
+    legs of hot-routing Python readers driving the IDENTICAL hot/cold
+    mix through FdfsClient.  The read spread is client-side by design
+    (the tracker's query_fetch never consults the hot map), so the
+    measured arms must go through the client library; the readers
+    write fdfs_load-format record files with hot/cold key-class tags
+    and `fdfs_load combine` prices both arms with the same percentile
+    code.  Each arm measures twice: a closed-loop calibration leg
+    (capacity), then an open-loop latency window at the SAME offered
+    rate on both arms — 75% of the OFF arm's calibrated capacity —
+    with latency taken from each op's scheduled start (wrk2-style
+    coordinated-omission correction).  The matched rate is the point:
+    closed-loop percentile comparisons self-penalize the faster arm,
+    which completes more ops against the same CPUs and buys its
+    throughput win with a deeper saturation tail.  Per-group read
+    shares come from the tracker's own beat-stat ledger
+    (success_download deltas across the window).  The artifact pins:
+    the ON arm published the promotion; the post-promotion per-group
+    read spread lands within 10 percentage points (the OFF arm's
+    spread — the pile-up on the home group — is recorded for
+    contrast); at the matched offered rate the hot-key p99 on the ON
+    arm sits under the OFF arm's (routed reads skip the per-read
+    tracker hop, so the same rate costs less CPU and queues less);
+    routed reads actually flowed; zero read errors everywhere.
+    host_cpus is recorded with a single-host honesty note."""
+    import threading
+
+    from harness import BUILD, start_storage, start_tracker
+
+    from fastdfs_tpu.client.client import FdfsClient
+
+    file_bytes = 8 << 10
+    n_files = max(int(NOMINAL[14] * scale) // file_bytes, 12)
+    hot_spec = "1:90"
+    hot_frac = 0.90
+    reader_threads = 8
+    measure_seconds = 10.0
+    calib_seconds = 4.0
+    warm_ops = max(min(n_files * 20, 12000), 1200)
+    warm_threads = 8
+    group_names = ("group1", "group2", "group3")
+    fdfs_load = os.path.join(BUILD, "fdfs_load")
+    storage_conf = (HB
+                    + "\nheat_top_k = 16"
+                    + "\nwork_threads = 1")
+    hot_conf = ("\nhot_promote_threshold = 3"
+                "\nhot_demote_threshold = 1"
+                "\nhot_max_extra_replicas = 2"
+                "\nhot_map_capacity = 8")
+
+    def run_load(*args):
+        out = subprocess.run([fdfs_load, *args], capture_output=True,
+                             timeout=3600)
+        assert out.returncode == 0, out.stderr.decode()
+
+    def combine(*result_files):
+        out = subprocess.run([fdfs_load, "combine", *result_files],
+                             capture_output=True, timeout=600)
+        assert out.returncode == 0, out.stderr.decode()
+        return json.loads(out.stdout.decode())
+
+    def group_reads(cli):
+        """Per-group success_download totals from the tracker's
+        beat-stat ledger (cluster_stat) — deltas across the measured
+        window are the spread measurement."""
+        out = {}
+        for g in cli.cluster_stat().get("groups", []):
+            out[g["name"]] = sum(int(s["stats"].get("success_download", 0))
+                                 for s in g.get("storages", []))
+        return out
+
+    def wait_all_active(cli):
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            gr = cli.cluster_stat().get("groups", [])
+            if (len(gr) == len(group_names)
+                    and all(g.get("active", 0) >= 1 for g in gr)):
+                return
+            time.sleep(0.3)
+        raise AssertionError("storage groups never all joined")
+
+    def measured_window(taddr, ids, tmp, tag, seconds, rate_qps=None):
+        """reader_threads hot-routing clients drive the same 1:90 mix
+        for `seconds`; each writes an fdfs_load-format record file
+        (trailing hot/cold key-class tag) so `fdfs_load combine` prices
+        the window with the shared percentile code.
+
+        rate_qps=None runs closed-loop — that measures CAPACITY, but
+        comparing latency percentiles between closed-loop arms is
+        unsound: the faster arm completes more ops per second against
+        the same CPUs, pushes itself deeper into saturation, and buys
+        its throughput win with a fatter self-inflicted tail.  With
+        rate_qps set the readers pace an open-loop schedule at that
+        fixed offered rate and latency is measured from each op's
+        SCHEDULED start (wrk2-style coordinated-omission correction:
+        a reader that falls behind charges the backlog to the system
+        instead of silently dropping load), so two arms offered the
+        identical rate compare percentile-for-percentile."""
+        hot_fid, cold = ids[0], ids[1:]
+        lines = [[] for _ in range(reader_threads)]
+        # Default hot_map_ttl_s (5 s): the map is already published and
+        # stable by the time the window opens, and a short TTL would put
+        # inline refresh RPCs inside the timed reads — at 0.5 s that is
+        # ~20 inflated samples per reader, a visible bite out of the p99
+        # bucket that steady-state readers never pay.
+        clis = [FdfsClient([taddr]) for _ in range(reader_threads)]
+        for c in clis:
+            # Pre-warm outside the clock: the first hot reads fetch the
+            # hot map and rotate the replica round-robin across every
+            # promoted copy, the first cold reads open the pooled
+            # connections to the remaining groups.
+            for fid in [hot_fid] * 3 + list(cold[:3]):
+                c.download_to_buffer(fid)
+        interval = (reader_threads / rate_qps) if rate_qps else 0.0
+        start_mono = time.monotonic()
+        start_wall = time.time()
+        stop_at = start_mono + seconds
+
+        def reader(w):
+            rng = random.Random(0x40F0 + w)
+            cli = clis[w]
+            k = 0
+            while True:
+                sched = start_mono + k * interval
+                k += 1
+                if sched >= stop_at:
+                    break
+                now = time.monotonic()
+                if interval and sched > now:
+                    time.sleep(sched - now)
+                elif not interval:
+                    if now >= stop_at:
+                        break
+                    sched = now
+                if rng.random() < hot_frac:
+                    fid, tagk = hot_fid, "hot"
+                else:
+                    fid, tagk = cold[rng.randrange(len(cold))], "cold"
+                try:
+                    data = cli.download_to_buffer(fid)
+                    status = 0 if len(data) == file_bytes else 22
+                except Exception:  # noqa: BLE001 — priced as an error
+                    data, status = b"", 1
+                lat = max(
+                    int((time.monotonic() - sched) * 1e6), 1)
+                sched_us = int((start_wall + (sched - start_mono)) * 1e6)
+                lines[w].append(f"{sched_us} {lat} {status} "
+                                f"{len(data)} 0 {fid} {tagk}")
+
+        threads = [threading.Thread(target=reader, args=(w,))
+                   for w in range(reader_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        paths = []
+        for w in range(reader_threads):
+            p = os.path.join(tmp, f"{tag}.reader{w}.result")
+            with open(p, "w") as fh:
+                fh.write("".join(ln + "\n" for ln in lines[w]))
+            paths.append(p)
+        routed = sum(c.stats()["hot_route_reads"] for c in clis)
+        fallbacks = sum(c.stats()["hot_fallback_reads"] for c in clis)
+        for c in clis:
+            c.close()
+        return combine(*paths), routed, fallbacks
+
+    def run_arm(promotion_on, offered_rate=None):
+        tag = "on" if promotion_on else "off"
+        tmp = tempfile.mkdtemp(prefix=f"fdfs_cfg14_{tag}_")
+        tr = start_tracker(os.path.join(tmp, "tr"),
+                           extra="slo_eval_interval_s = 1"
+                                 + (hot_conf if promotion_on else ""))
+        taddr = f"127.0.0.1:{tr.port}"
+        daemons = [tr]
+        try:
+            for g in group_names:
+                daemons.append(start_storage(
+                    os.path.join(tmp, g), group=g, trackers=[taddr],
+                    extra=storage_conf))
+            cli = FdfsClient([taddr])
+            _upload_retry(cli, b"warmup " * 64)
+            wait_all_active(cli)
+            # Deterministic distinct payloads (no cross-file dedup
+            # collapsing the chunk store), uploaded round-robin across
+            # the groups by the tracker (store_lookup 0).
+            ids = [_upload_retry(cli,
+                                 random.Random(0xC14 + i).randbytes(
+                                     file_bytes))
+                   for i in range(n_files)]
+            ids_path = os.path.join(tmp, "corpus.ids")
+            with open(ids_path, "w") as fh:
+                fh.write("".join(fid + "\n" for fid in ids))
+            hot_fid = ids[0]
+
+            # Classic-path warm leg: fdfs_load --hot-keys drives the
+            # two-tier mix through the tracker hop, feeding the heat
+            # ledger; its combine output prices the per-key-class
+            # latency split on the CLASSIC path for this arm.
+            warm_res = os.path.join(tmp, "warm.result")
+            run_load("download", taddr, ids_path, str(warm_ops),
+                     str(warm_threads), warm_res, "--hot-keys", hot_spec)
+            warm = combine(warm_res)
+            assert warm["errors"] == 0, warm
+
+            published_groups = []
+            if promotion_on:
+                deadline = time.time() + 120
+                while time.time() < deadline and not published_groups:
+                    m = cli.query_hot_map()
+                    published_groups = next(
+                        (list(e["groups"]) for e in m["entries"]
+                         if e["key"] == hot_fid and e["groups"]), [])
+                    if not published_groups:
+                        # keep the EWMA warm while the fan-out verifies
+                        cli.download_to_buffer(hot_fid)
+                        time.sleep(0.2)
+                assert published_groups, "hot entry never published"
+
+            # Closed-loop calibration leg: this arm's capacity with the
+            # same readers.  The OFF arm's calibration sets the shared
+            # offered rate (75% of it) for BOTH arms' open-loop windows,
+            # so the latency comparison is at identical load.
+            calib, _, _ = measured_window(taddr, ids, tmp,
+                                          tag + "_calib", calib_seconds)
+            rate = offered_rate or max(int(calib["qps"] * 0.75), 100)
+
+            time.sleep(2.5)  # let the last pre-window beats land
+            before = group_reads(cli)
+            agg, routed, fallbacks = measured_window(
+                taddr, ids, tmp, tag, measure_seconds, rate)
+            time.sleep(2.5)  # and the final post-window beats
+            after = group_reads(cli)
+            deltas = {g: after.get(g, 0) - before.get(g, 0) for g in after}
+            total = max(sum(deltas.values()), 1)
+            shares = {g: round(d / total, 4) for g, d in deltas.items()}
+            spread_pp = round(
+                (max(shares.values()) - min(shares.values())) * 100.0, 2)
+            gauges = cli._with_tracker(lambda t: t.stat()).get("gauges", {})
+            cli.close()
+            return {
+                "closed_loop_capacity_qps": calib["qps"],
+                "offered_rate_qps": rate,
+                "classic_hot_keys_leg": {
+                    "ops": warm["ops"], "qps": warm["qps"],
+                    "errors": warm["errors"],
+                    "by_key_class": warm.get("by_key_class", {})},
+                "measured": {
+                    "ops": agg["ops"], "qps": agg["qps"],
+                    "errors": agg["errors"],
+                    "lat_p50_us": agg["lat_p50_us"],
+                    "lat_p99_us": agg["lat_p99_us"],
+                    "by_key_class": agg.get("by_key_class", {})},
+                "hot_route_reads": routed,
+                "hot_fallback_reads": fallbacks,
+                "published_extra_groups": published_groups,
+                "group_read_deltas": deltas,
+                "group_read_shares": shares,
+                "group_spread_pp": spread_pp,
+                "hot_gauges": {k: v for k, v in gauges.items()
+                               if k.startswith("hot.")},
+            }
+        finally:
+            for d in reversed(daemons):
+                d.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    os.makedirs(out_dir, exist_ok=True)
+    off = run_arm(False)
+    on = run_arm(True, offered_rate=off["offered_rate_qps"])
+    on_hot = on["measured"]["by_key_class"].get("hot", {})
+    off_hot = off["measured"]["by_key_class"].get("hot", {})
+    emit(out_dir, 14, {
+        "description": "Heat-driven elastic replication: the same "
+                       "1:90 hot/cold read mix against the hot-map "
+                       "policy off vs on — post-promotion per-group "
+                       "read spread within 10 pp where the off arm "
+                       "piles onto the home group, hot-key p99 "
+                       "flattened, routed reads flowing, zero errors "
+                       "through the whole arc",
+        "nominal_bytes": NOMINAL[14],
+        "scaled_bytes": n_files * file_bytes,
+        "files": n_files,
+        "file_bytes": file_bytes,
+        "hot_keys_spec": hot_spec,
+        "warm_ops": warm_ops,
+        "reader_threads": reader_threads,
+        "measure_seconds": measure_seconds,
+        "offered_rate_qps": off["offered_rate_qps"],
+        "off_capacity_qps": off["closed_loop_capacity_qps"],
+        "on_capacity_qps": on["closed_loop_capacity_qps"],
+        "open_loop_note":
+            "each arm first runs a closed-loop calibration leg "
+            "(closed_loop_capacity_qps); the latency window is then "
+            "open-loop at the SAME offered rate on both arms (75% of "
+            "the off arm's capacity) with latency measured from each "
+            "op's scheduled start, because closed-loop percentiles "
+            "self-penalize the faster arm: it completes more ops "
+            "against the same CPUs and buys its throughput win with a "
+            "deeper saturation tail",
+        "host_cpus": os.cpu_count() or 1,
+        "single_host_note":
+            "all three storage groups, the tracker, the fdfs_load "
+            "driver and the Python readers share this one host's CPUs, "
+            "so the absolute qps columns are machine numbers, not "
+            "cluster numbers; the transferable results are the "
+            "per-group read-share spread and the ON-vs-OFF hot-key "
+            "latency comparison, both measured identically on the two "
+            "arms",
+        "arms": {"off": off, "on": on},
+        "hot_promotion_published": bool(on["published_extra_groups"]),
+        "routed_reads_flowed": on["hot_route_reads"] > 0,
+        "off_group_spread_pp": off["group_spread_pp"],
+        "on_group_spread_pp": on["group_spread_pp"],
+        "post_promotion_spread_within_10pp":
+            on["group_spread_pp"] <= 10.0,
+        "hot_p99_off_us": off_hot.get("lat_p99_us", 0),
+        "hot_p99_on_us": on_hot.get("lat_p99_us", 0),
+        "hot_p99_flatter_with_promotion":
+            0 < on_hot.get("lat_p99_us", 0)
+            < off_hot.get("lat_p99_us", 1),
+        "zero_read_errors":
+            off["measured"]["errors"] == 0
+            and on["measured"]["errors"] == 0,
+    })
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=0,
-                    help="which config (1-13); 0 = all")
+                    help="which config (1-14); 0 = all")
     ap.add_argument("--scale", type=float, default=None,
                     help="fraction of the nominal corpus size")
     ap.add_argument("--full", action="store_true",
@@ -2617,8 +2961,8 @@ def main() -> None:
 
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12, 13: config13}
-    which = [args.config] if args.config else list(range(1, 14))
+           11: config11, 12: config12, 13: config13, 14: config14}
+    which = [args.config] if args.config else list(range(1, 15))
     for c in which:
         scale = 1.0 if args.full else (
             args.scale if args.scale is not None else DEFAULT_SCALE[c])

@@ -236,6 +236,12 @@ def cmd_top(c: FdfsClient, args: list[str]) -> int:
                 threads = {node: M.thread_ledger(ns.registry)
                            for node, ns in cur.nodes.items()
                            if ns.registry is not None}
+            # HOT line data: the tracker's published hot map (elastic
+            # replication); best-effort — an old tracker has no opcode.
+            try:
+                hot_map = c.query_hot_map()
+            except Exception:  # noqa: BLE001
+                hot_map = None
             if as_json:
                 print(json.dumps({
                     "ts": cur.ts,
@@ -248,12 +254,14 @@ def cmd_top(c: FdfsClient, args: list[str]) -> int:
                     "threads": ({n: rows[:thread_rows]
                                  for n, rows in threads.items()}
                                 if threads is not None else None),
+                    "hot_map": hot_map,
                 }, sort_keys=True), flush=True)
             else:
                 frame = M.render_top(cur, rates, recent, max_events,
                                      alerts=alerts, heat=heat,
                                      heat_rows=heat_rows, threads=threads,
-                                     thread_rows=thread_rows)
+                                     thread_rows=thread_rows,
+                                     hot_map=hot_map)
                 if clear:
                     print("\x1b[2J\x1b[H" + frame, flush=True)
                 else:
@@ -947,6 +955,118 @@ def cmd_admission(c: FdfsClient, args: list[str]) -> int:
         return 0
 
 
+def cmd_hot(c: FdfsClient, args: list[str]) -> int:
+    """Elastic hot-replication console (ISSUE 20): the tracker's
+    published hot map (QUERY_HOT_MAP — every promoted file and the
+    extra groups serving it), the tracker's promotion/demotion ledger
+    gauges, each storage's fan-out progress gauges, and a per-node
+    hot-file pane straight from the heat sketches (the same table
+    fdfs_top --heat renders).
+
+    Flags: --watch [s]     re-render every s seconds (default 2) until
+                           interrupted
+           --rows N        heat-pane rows per node (default 5)
+           --json          machine-readable {map: ..., tracker: ...,
+                           storages: ..., heat: ...}
+    """
+    import time as _time
+
+    from fastdfs_tpu import monitor as M
+
+    interval = 0.0
+    if "--watch" in args:
+        i = args.index("--watch")
+        interval = 2.0
+        if i + 1 < len(args) and not args[i + 1].startswith("--"):
+            try:
+                interval = float(args[i + 1])
+            except ValueError:
+                pass
+    rows = int(_flag(args, "--rows", "5") or 5)
+
+    _TRACKER_GAUGES = ("hot.map_version", "hot.promoted", "hot.pending",
+                       "hot.retiring", "hot.promotions_total",
+                       "hot.demotions_total", "hot.tracked_keys")
+    _STORAGE_GAUGES = ("hot.fanout_replicated", "hot.fanout_dropped",
+                       "hot.fanout_verify_failures", "hot.fanout_failures",
+                       "hot.fanout_queue")
+
+    def members():
+        cs = c.cluster_stat()
+        return [(s["ip"], s["port"])
+                for g in cs.get("groups", [])
+                for s in g.get("storages", [])]
+
+    def render_once() -> int:
+        hot_map = c.query_hot_map()
+        tracker_gauges: dict[str, int] = {}
+        try:
+            reg = c._with_tracker(lambda t: t.stat())
+            tracker_gauges = {k: v for k, v in reg.get("gauges", {}).items()
+                              if k in _TRACKER_GAUGES}
+        except Exception as e:  # noqa: BLE001 — gauges are best-effort
+            print(f"warning: tracker stat: {e}", file=sys.stderr)
+        storages: dict[str, dict] = {}
+        heat: dict[str, list] = {}
+        for ip, port in members():
+            addr = f"{ip}:{port}"
+            try:
+                reg = c.storage_stat(ip, port)
+                storages[addr] = {k: v
+                                  for k, v in reg.get("gauges", {}).items()
+                                  if k in _STORAGE_GAUGES}
+            except Exception as e:  # noqa: BLE001 — a dead node is a row
+                storages[addr] = {"error": str(e)}
+            try:
+                heat[addr] = M.decode_heat(c.storage_heat_top(ip, port,
+                                                              rows))
+            except Exception:  # noqa: BLE001 — heat off / old node
+                heat[addr] = []
+        if "--json" in args:
+            print(json.dumps({
+                "map": hot_map,
+                "tracker": tracker_gauges,
+                "storages": storages,
+                "heat": {n: [vars(h) for h in hs]
+                         for n, hs in heat.items()},
+            }, indent=2, sort_keys=True))
+            return 0
+        print(f"hot map v{hot_map['version']} "
+              f"({len(hot_map['entries'])} published):")
+        if not hot_map["entries"]:
+            print("  (none)")
+        for e in hot_map["entries"]:
+            print(f"  {e['key']} -> {','.join(e['groups'])}")
+        if tracker_gauges:
+            print("tracker: " +
+                  "  ".join(f"{k.removeprefix('hot.')}={v}"
+                            for k, v in sorted(tracker_gauges.items())))
+        print("fan-out (per elected storage):")
+        for addr, st in sorted(storages.items()):
+            if "error" in st:
+                print(f"  {addr}  error: {st['error']}")
+                continue
+            print(f"  {addr}  " +
+                  "  ".join(f"{k.removeprefix('hot.fanout_')}={v}"
+                            for k, v in sorted(st.items())))
+        print(f"hot files (top {rows} per node, "
+              "hits / err-bound / MB / ops):")
+        for line in M._heat_table_lines(heat, rows):
+            print(line)
+        return 0
+
+    if interval <= 0:
+        return render_once()
+    try:
+        while True:
+            if "--json" not in args:  # keep --watch --json parseable
+                print(f"-- hot @ {_time.strftime('%H:%M:%S')} --")
+            render_once()
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_group(c: FdfsClient, args: list[str]) -> int:
     """Group lifecycle console (multi-group scale-out): the placement
     epoch with per-group state and, for draining groups, each member's
@@ -1070,6 +1190,7 @@ TOOLS = {
     "health": cmd_health,
     "admission": cmd_admission,
     "group": cmd_group,
+    "hot": cmd_hot,
 }
 
 

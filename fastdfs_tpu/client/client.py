@@ -38,7 +38,9 @@ class FdfsClient:
                  max_conns_per_endpoint: int = 0,
                  pool_idle_ttl_s: float = 300.0,
                  priority: int | None = None,
-                 admission_retries: int = 2):
+                 admission_retries: int = 2,
+                 hot_routing: bool = True,
+                 hot_map_ttl_s: float = 5.0):
         if isinstance(tracker_addrs, str):
             tracker_addrs = [tracker_addrs]
         if not tracker_addrs:
@@ -119,7 +121,19 @@ class FdfsClient:
                            "placement_fallback_tracker": 0,
                            "ranged_fallback_single": 0,
                            "dead_peer_skips": 0,
-                           "admission_retry_waits": 0}
+                           "admission_retry_waits": 0,
+                           "hot_route_reads": 0,
+                           "hot_fallback_reads": 0}
+        # Elastic hot replication (ISSUE 20): reads consult a cached
+        # QUERY_HOT_MAP snapshot (TTL'd, delta-refreshed) and spread a
+        # hot file's downloads across home + extra replica groups with
+        # the same stateless jump-hash every client agrees on.  The map
+        # is advisory: any miss, stale route, or tracker too old to
+        # answer falls back to the classic tracker-routed read.
+        self.hot_routing = bool(hot_routing)
+        self.hot_map_ttl_s = max(float(hot_map_ttl_s), 0.5)
+        self._hot_state: dict | None = None
+        self._hot_rr = 0
 
     @classmethod
     def from_conf(cls, conf_path: str) -> "FdfsClient":
@@ -144,7 +158,9 @@ class FdfsClient:
                    priority=(int(cfg.get("request_priority", -1))
                              if int(cfg.get("request_priority", -1)) >= 0
                              else None),
-                   admission_retries=int(cfg.get("admission_retries", 2)))
+                   admission_retries=int(cfg.get("admission_retries", 2)),
+                   hot_routing=bool(cfg.get_bool("hot_routing", True)),
+                   hot_map_ttl_s=float(cfg.get_seconds("hot_map_ttl_s", 5)))
 
     def close(self) -> None:
         if self.pool is not None:
@@ -157,7 +173,11 @@ class FdfsClient:
         back to the classic single stream, and routing skipped a peer
         inside its dead-peer cooldown in favor of a live one.  The
         fallbacks are transparent (the call still succeeds), so this is
-        the only place their frequency is visible."""
+        the only place their frequency is visible.  ``hot_route_reads``
+        counts downloads served by an elastic hot replica (ISSUE 20)
+        and ``hot_fallback_reads`` the routed attempts that fell back
+        to the classic tracker hop (stale map after a demotion, dead
+        member)."""
         return dict(self._fallbacks)
 
     def _wire_ctx(self):
@@ -436,10 +456,126 @@ class FdfsClient:
                          length: int = 0) -> bytes:
         # The classic one-connection path; also the ranged download's
         # transparent fallback target (it must never re-enter the
-        # parallel gate, or a fallback recurses).
+        # parallel gate, or a fallback recurses).  Hot routing rides in
+        # front: when the cached hot map lists extra replica groups for
+        # this file and the spread hash picks one, the read goes there
+        # directly; None (not hot, home pick, or any failure) falls
+        # through to the tracker hop.
+        if self.hot_routing:
+            data = self._hot_download(file_id, offset, length)
+            if data is not None:
+                return data
         return self._routed(lambda t: t.query_fetch(file_id),
                             lambda s: s.download_to_buffer(file_id, offset,
                                                            length))
+
+    def _hot_groups(self, file_id: str) -> list[str] | None:
+        """Extra replica groups for ``file_id`` from the cached hot map,
+        refreshing it at most once per ``hot_map_ttl_s`` (delta query
+        carrying the cached version; a tombstone delta entry — zero
+        groups — evicts a demoted key).  Every refresh failure keeps the
+        stale map and waits for the next TTL window: the map is
+        advisory, never load-bearing."""
+        now = time.monotonic()
+        st = self._hot_state
+        if st is None:
+            st = {"version": -1, "entries": {}, "fetched": float("-inf")}
+            self._hot_state = st
+        if now - st["fetched"] >= self.hot_map_ttl_s:
+            st["fetched"] = now  # one attempt per window, pass or fail
+            try:
+                since = st["version"] if st["version"] >= 0 else None
+                resp = self._with_tracker(lambda t: t.query_hot_map(since))
+                if resp["full"]:
+                    st["entries"] = {e["key"]: e["groups"]
+                                     for e in resp["entries"] if e["groups"]}
+                else:
+                    for e in resp["entries"]:
+                        if e["groups"]:
+                            st["entries"][e["key"]] = e["groups"]
+                        else:
+                            st["entries"].pop(e["key"], None)
+                st["version"] = resp["version"]
+            except Exception:  # noqa: BLE001 — advisory map, incl. old
+                # trackers (unknown command) and monkeypatched mocks;
+                # back off harder on a protocol-level refusal so a
+                # pre-hot-map tracker is not re-asked every window.
+                st["fetched"] = now + 11 * self.hot_map_ttl_s
+        return st["entries"].get(file_id)
+
+    def _hot_member(self, group: str) -> StoreTarget | None:
+        """An ACTIVE member of ``group`` from the cached placement epoch
+        (round-robin across members, dead peers skipped) — or None when
+        the group is unknown/empty, meaning: no hot shortcut."""
+        table = self._placement
+        if table is None:
+            try:
+                table = self._with_tracker(lambda t: t.query_placement())
+            except Exception:  # noqa: BLE001 — shortcut only
+                return None
+            if not isinstance(table, dict) or "groups" not in table:
+                return None  # monkeypatched tracker hop: no shortcut
+            self._placement = table
+        for g in table["groups"]:
+            if g["group"] != group or g["state"] != 0 or not g["members"]:
+                continue
+            members = g["members"]
+            self._placement_rr += 1
+            idx = self._placement_rr % len(members)
+            if (self.pool is not None
+                    and self.pool.is_dead(members[idx]["ip"],
+                                          members[idx]["port"])):
+                live = [i for i in range(len(members))
+                        if not self.pool.is_dead(members[i]["ip"],
+                                                 members[i]["port"])]
+                if live:
+                    idx = live[self._placement_rr % len(live)]
+                    self._fallbacks["dead_peer_skips"] += 1
+            m = members[idx]
+            return StoreTarget(group=group, ip=m["ip"], port=m["port"],
+                               store_path_index=0xFF)
+        return None
+
+    def _hot_download(self, file_id: str, offset: int,
+                      length: int) -> bytes | None:
+        """One hot-routed read attempt; None means 'take the classic
+        path' (not hot, the spread hash picked the home group, no
+        placement info, or the routed attempt failed — stale map after
+        a demotion, member down).  The replica set is home + the map's
+        extra groups in map order, so every client spreads reads with
+        the same ``jump_hash(sha1(file_id#i), n_replicas)`` choice and
+        per-replica caches accumulate hits."""
+        groups = self._hot_groups(file_id)
+        if not groups or "/" not in file_id:
+            return None
+        home, remote = file_id.split("/", 1)
+        replicas = [home] + [g for g in groups if g != home]
+        if len(replicas) < 2:
+            return None
+        self._hot_rr += 1
+        pick = replicas[replica_for_range(file_id, self._hot_rr,
+                                          len(replicas))]
+        if pick == home:
+            return None  # the classic tracker hop serves home reads
+        tgt = self._hot_member(pick)
+        if tgt is None:
+            return None
+        try:
+            with self._storage(tgt) as s:
+                data = s.download_to_buffer(f"{pick}/{remote}", offset,
+                                            length)
+            self._fallbacks["hot_route_reads"] += 1
+            return data
+        except Exception:  # noqa: BLE001 — transparent fallback
+            # A stale route (the copy was demoted and dropped after the
+            # map was cached) or a dead member: evict the cached entry
+            # so this file stops routing until the next refresh, and
+            # let the classic path serve the read.
+            st = self._hot_state
+            if st is not None:
+                st["entries"].pop(file_id, None)
+            self._fallbacks["hot_fallback_reads"] += 1
+            return None
 
     def download_stream(self, file_id: str, fh, offset: int = 0,
                         length: int = 0) -> int:
@@ -769,6 +905,12 @@ class FdfsClient:
         """The placement epoch (group order + lifecycle states + active
         members), as any tracker serves it (QUERY_PLACEMENT)."""
         return self._with_tracker(lambda t: t.query_placement())
+
+    def query_hot_map(self, since_version: int | None = None) -> dict:
+        """The elastic hot-replication map (QUERY_HOT_MAP): published
+        hot files and the extra groups serving each; ``since_version``
+        asks for a delta (zero-group entries are tombstones)."""
+        return self._with_tracker(lambda t: t.query_hot_map(since_version))
 
     def group_drain(self, group: str) -> int:
         """Start draining ``group`` (leader-routed GROUP_DRAIN).  Returns

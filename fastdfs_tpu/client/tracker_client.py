@@ -17,6 +17,7 @@ from fastdfs_tpu.common.protocol import (
     IP_ADDRESS_SIZE,
     TrackerCmd,
     buff2long,
+    long2buff,
     pack_group_name,
     pack_profile_ctl,
     unpack_group_name,
@@ -232,6 +233,49 @@ class TrackerClient:
                            "state_name": names.get(state, "?"),
                            "members": members})
         return {"version": version, "groups": groups}
+
+    def query_hot_map(self, since_version: int | None = None) -> dict:
+        """The elastic hot-replication map (QUERY_HOT_MAP 75): published
+        hot entries and the extra replica groups serving each.  Empty
+        body = full snapshot; 8B BE since_version = delta of changes
+        after that version (a delta entry with zero groups is a
+        tombstone — the key was demoted).  The tracker falls back to a
+        full snapshot when the requested delta predates its changelog.
+        Wire: 8B BE version + 1B full flag + 8B BE entry count + per
+        entry (8B BE key_len + key + 8B BE group count + n x 16B group
+        names)."""
+        body = b"" if since_version is None else long2buff(since_version)
+        self.conn.send_request(TrackerCmd.QUERY_HOT_MAP, body)
+        resp = self.conn.recv_response("query_hot_map")
+        if len(resp) < 17:
+            raise ProtocolError(f"short query_hot_map response: {len(resp)}")
+        version = buff2long(resp, 0)
+        full = resp[8] != 0
+        count = buff2long(resp, 9)
+        off = 17
+        entries = []
+        for _ in range(count):
+            if off + 8 > len(resp):
+                raise ProtocolError("truncated query_hot_map entry")
+            key_len = buff2long(resp, off)
+            off += 8
+            if key_len < 0 or off + key_len + 8 > len(resp):
+                raise ProtocolError(f"bad hot-map key length {key_len}")
+            key = resp[off:off + key_len].decode()
+            off += key_len
+            ngroups = buff2long(resp, off)
+            off += 8
+            if ngroups < 0 or \
+                    ngroups > (len(resp) - off) // GROUP_NAME_MAX_LEN:
+                raise ProtocolError(f"bad hot-map group count {ngroups}")
+            groups = []
+            for g in range(ngroups):
+                p = off + g * GROUP_NAME_MAX_LEN
+                groups.append(
+                    unpack_group_name(resp[p:p + GROUP_NAME_MAX_LEN]))
+            off += ngroups * GROUP_NAME_MAX_LEN
+            entries.append({"key": key, "groups": groups})
+        return {"version": version, "full": full, "entries": entries}
 
     def _group_admin(self, cmd: int, group: str, what: str) -> int:
         self.conn.send_request(cmd, pack_group_name(group))

@@ -480,6 +480,38 @@ class TrackerCmd(enum.IntEnum):
     # pinned by the fdfs_codec health-matrix cross-language golden.
     HEALTH_MATRIX = 69
 
+    # fastdfs_tpu extension: the elastic hot-replication map
+    # (OPERATIONS.md "Elastic hot replication").  The tracker leader's
+    # heat policy merges the per-node heat trailers riding each storage
+    # beat (append-only past the health trailer: 1B version=2 + 8B BE
+    # entry count + per entry (8B BE key_len + key + 8B BE cumulative
+    # read hits + 8B BE cumulative read bytes)), promotes file-ids whose
+    # windowed cluster-wide read EWMA crosses hot_promote_threshold to
+    # extra replica groups, and serves the epoch-versioned map here.
+    # Request body = empty (full map) or 8B BE since_version (delta).
+    # Response = 8B BE map version + 1B full flag (1 = full snapshot;
+    # 0 = delta relative to the requested since_version) + 8B BE entry
+    # count + per entry (8B BE key_len + key + 8B BE extra-group count +
+    # per group 16B group name).  A delta entry with ZERO extra groups is
+    # a tombstone: the key was demoted — drop it from the cache.  Full
+    # snapshots carry only live (published) entries.  Clients route hot
+    # reads across home + extra replicas by
+    # jump_hash(sha1("<file_id>#<range_index>")[:8], n_replicas) — the
+    # established cache-affinity pick — and fall back to the classic
+    # tracker path on any failure.  Pinned by the fdfs_codec hot-map
+    # cross-language golden.
+    QUERY_HOT_MAP = 75
+    # fastdfs_tpu extension: storage -> tracker ack completing a hot
+    # fan-out task (the tracker tasks the home group's elected member
+    # via a beat-response trailer; the member pushes + byte-verifies,
+    # then acks here, and ONLY then does the tracker publish the map
+    # entry — verify-then-publish, so a routed read can never miss).
+    # Body = 16B home group + 1B task type (1 = replicate, 2 = drop) +
+    # 8B BE key_len + key + 8B BE verified-group count + per group 16B
+    # group name.  OK response body = empty.  Pinned by the fdfs_codec
+    # hot-map cross-language golden.
+    HOT_FANOUT_DONE = 80
+
     # fastdfs_tpu extension: distributed-tracing context prefix frame
     # (see TRACE_CTX_LEN above).  Deliberately the SAME value on both
     # ports (StorageCmd.TRACE_CTX) so framing code is shared.
@@ -792,6 +824,8 @@ WIRE_GOLDENS = {
     "StorageCmd.EC_STATUS": "ec-status",
     "StorageCmd.EC_RELEASE": "ec-stripe-layout",
     "TrackerCmd.HEALTH_MATRIX": "health-matrix",
+    "TrackerCmd.QUERY_HOT_MAP": "hot-map",
+    "TrackerCmd.HOT_FANOUT_DONE": "hot-map",
     "StorageCmd.HEALTH_STATUS": "health-status",
     "StorageCmd.PRIORITY": "priority-frame",
     "TrackerCmd.PRIORITY": "priority-frame",

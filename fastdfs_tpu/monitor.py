@@ -24,7 +24,8 @@ import re
 import time
 from dataclasses import dataclass, field
 
-from fastdfs_tpu.common.protocol import BEAT_STAT_COUNT, BEAT_STAT_FIELDS
+from fastdfs_tpu.common.protocol import (BEAT_STAT_COUNT, BEAT_STAT_FIELDS,
+                                         GROUP_NAME_MAX_LEN, buff2long)
 
 
 # ---------------------------------------------------------------------------
@@ -222,6 +223,48 @@ def decode_heat(obj: dict) -> list[HeatEntry]:
     if any(a.hits < b.hits for a, b in zip(out, out[1:])):
         raise ValueError("heat entries not sorted by hits descending")
     return out
+
+
+# ---------------------------------------------------------------------------
+# hot-map decoding (QUERY_HOT_MAP; native/common/heatwire.h).  The wire
+# shape is pinned cross-language by the fdfs_codec hot-map golden.
+# ---------------------------------------------------------------------------
+
+def decode_hot_map(body: bytes) -> dict:
+    """Decode a QUERY_HOT_MAP response body (the elastic-hot-replication
+    map, ISSUE 20): 8B BE version + 1B full flag + 8B BE entry count +
+    per entry (8B BE key_len + key + 8B BE group count + n x 16B group
+    names).  ``full`` False means a delta, where an entry with zero
+    groups is a tombstone (the key was demoted).  Raises ValueError on
+    shape violations so a truncated payload fails loudly."""
+    if len(body) < 17:
+        raise ValueError(f"hot-map body too short: {len(body)}")
+    version = buff2long(body, 0)
+    full = body[8] != 0
+    count = buff2long(body, 9)
+    off = 17
+    entries = []
+    for _ in range(count):
+        if off + 8 > len(body):
+            raise ValueError("truncated hot-map entry")
+        key_len = buff2long(body, off)
+        off += 8
+        if key_len < 0 or off + key_len + 8 > len(body):
+            raise ValueError(f"bad hot-map key length {key_len}")
+        key = body[off:off + key_len].decode()
+        off += key_len
+        ngroups = buff2long(body, off)
+        off += 8
+        if ngroups < 0 or ngroups > (len(body) - off) // GROUP_NAME_MAX_LEN:
+            raise ValueError(f"bad hot-map group count {ngroups}")
+        groups = []
+        for g in range(ngroups):
+            p = off + g * GROUP_NAME_MAX_LEN
+            groups.append(
+                body[p:p + GROUP_NAME_MAX_LEN].rstrip(b"\x00").decode())
+        off += ngroups * GROUP_NAME_MAX_LEN
+        entries.append({"key": key, "groups": groups})
+    return {"version": version, "full": full, "entries": entries}
 
 
 # ---------------------------------------------------------------------------
@@ -849,7 +892,8 @@ def render_top(cur: TopSample, rates: dict[str, dict],
                heat: dict[str, list["HeatEntry"]] | None = None,
                heat_rows: int = 5,
                threads: dict[str, list[dict]] | None = None,
-               thread_rows: int = 8) -> str:
+               thread_rows: int = 8,
+               hot_map: dict | None = None) -> str:
     """The fdfs_top frame: a per-node saturation table, an ALERTS line
     (active SLO breaches per node), the scrolling recent-events pane,
     with ``heat`` a per-node hot-file pane, and with ``threads`` a
@@ -946,6 +990,19 @@ def render_top(cur: TopSample, rates: dict[str, dict],
         lines.append("")
         lines.append("ADMISSION: " +
                      "; ".join(p for _, _, p in sorted(admission)))
+    # HOT line: the elastic-replication glance — shown only while the
+    # tracker's hot map actually publishes entries (a decoded
+    # QUERY_HOT_MAP snapshot, monitor.decode_hot_map shape).
+    if hot_map and hot_map.get("entries"):
+        shown = hot_map["entries"][:3]
+        parts = [f"{e['key']}->{','.join(e['groups'])}" for e in shown]
+        extra = len(hot_map["entries"]) - len(shown)
+        if extra > 0:
+            parts.append(f"(+{extra} more)")
+        lines.append("")
+        lines.append(f"HOT: v{hot_map.get('version', 0)} "
+                     f"published={len(hot_map['entries'])}; "
+                     + "; ".join(parts))
     lines.append("")
     lines.append(f"recent events (last {max_events}):")
     for e in recent_events[-max_events:]:

@@ -1,0 +1,191 @@
+#include "common/heatwire.h"
+
+#include "common/bytes.h"
+
+namespace fdfs {
+namespace {
+
+constexpr size_t kGroupNameLen = 16;
+
+void AppendInt64(std::string* out, int64_t v) {
+  uint8_t buf[8];
+  PutInt64BE(v, buf);
+  out->append(reinterpret_cast<const char*>(buf), 8);
+}
+
+// Reads an 8B BE length-prefixed key at *off, bounds- and sanity-checked.
+bool ReadKey(const uint8_t* p, size_t len, size_t* off, std::string* key) {
+  if (*off + 8 > len) return false;
+  int64_t klen = GetInt64BE(p + *off);
+  *off += 8;
+  if (klen <= 0 || klen > static_cast<int64_t>(kHotKeyMaxLen)) return false;
+  if (*off + static_cast<size_t>(klen) > len) return false;
+  key->assign(reinterpret_cast<const char*>(p + *off),
+              static_cast<size_t>(klen));
+  *off += static_cast<size_t>(klen);
+  return true;
+}
+
+bool ReadGroups(const uint8_t* p, size_t len, size_t* off, size_t max_groups,
+                std::vector<std::string>* groups) {
+  if (*off + 8 > len) return false;
+  int64_t n = GetInt64BE(p + *off);
+  *off += 8;
+  if (n < 0 || n > static_cast<int64_t>(max_groups)) return false;
+  if (*off + static_cast<size_t>(n) * kGroupNameLen > len) return false;
+  groups->clear();
+  groups->reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) {
+    groups->push_back(GetFixedField(p + *off, kGroupNameLen));
+    *off += kGroupNameLen;
+  }
+  return true;
+}
+
+}  // namespace
+
+std::string PackHeatTrailer(const std::vector<HeatTrailerEntry>& entries) {
+  if (entries.empty()) return "";
+  std::string out;
+  out.push_back(static_cast<char>(kHeatTrailerVersion));
+  size_t n = entries.size();
+  if (n > kHeatTrailerMaxEntries) n = kHeatTrailerMaxEntries;
+  AppendInt64(&out, static_cast<int64_t>(n));
+  for (size_t i = 0; i < n; ++i) {
+    const HeatTrailerEntry& e = entries[i];
+    AppendInt64(&out, static_cast<int64_t>(e.key.size()));
+    out.append(e.key);
+    AppendInt64(&out, e.hits);
+    AppendInt64(&out, e.bytes);
+  }
+  return out;
+}
+
+bool ParseHeatTrailer(const uint8_t* p, size_t len,
+                      std::vector<HeatTrailerEntry>* out) {
+  out->clear();
+  if (len < 9) return false;
+  if (p[0] != kHeatTrailerVersion) return false;
+  int64_t n = GetInt64BE(p + 1);
+  if (n < 0 || n > static_cast<int64_t>(kHeatTrailerMaxEntries)) return false;
+  size_t off = 9;
+  out->reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) {
+    HeatTrailerEntry e;
+    if (!ReadKey(p, len, &off, &e.key)) {
+      out->clear();
+      return false;
+    }
+    if (off + 16 > len) {
+      out->clear();
+      return false;
+    }
+    e.hits = GetInt64BE(p + off);
+    e.bytes = GetInt64BE(p + off + 8);
+    off += 16;
+    out->push_back(std::move(e));
+  }
+  return true;
+}
+
+int64_t FindHeatTrailer(const uint8_t* p, size_t len) {
+  if (len == 0) return -1;
+  if (p[0] == kHeatTrailerVersion) return 0;
+  if (p[0] != 1) return -1;  // neither health (1) nor heat (2): unknown
+  // Skip the health trailer by its self-described length:
+  // 1B ver + 8B self score + 8B peer count + N x (16B ip + 8B port + 8B score).
+  if (len < 17) return -1;
+  int64_t peers = GetInt64BE(p + 9);
+  if (peers < 0 || peers > 4096) return -1;
+  size_t skip = 17 + static_cast<size_t>(peers) * 32;
+  if (skip >= len) return -1;
+  if (p[skip] != kHeatTrailerVersion) return -1;
+  return static_cast<int64_t>(skip);
+}
+
+std::string PackHotTasks(const std::vector<HotTask>& tasks) {
+  if (tasks.empty()) return "";
+  std::string out;
+  out.push_back(static_cast<char>(kHotTaskTrailerVersion));
+  size_t n = tasks.size();
+  if (n > kHotTaskMaxTasks) n = kHotTaskMaxTasks;
+  AppendInt64(&out, static_cast<int64_t>(n));
+  for (size_t i = 0; i < n; ++i) {
+    const HotTask& t = tasks[i];
+    out.push_back(static_cast<char>(t.type));
+    AppendInt64(&out, static_cast<int64_t>(t.key.size()));
+    out.append(t.key);
+    AppendInt64(&out, static_cast<int64_t>(t.groups.size()));
+    for (const std::string& g : t.groups) PutFixedField(&out, g, kGroupNameLen);
+  }
+  return out;
+}
+
+bool ParseHotTasks(const uint8_t* p, size_t len, std::vector<HotTask>* out) {
+  out->clear();
+  if (len < 9) return false;
+  if (p[0] != kHotTaskTrailerVersion) return false;
+  int64_t n = GetInt64BE(p + 1);
+  if (n < 0 || n > static_cast<int64_t>(kHotTaskMaxTasks)) return false;
+  size_t off = 9;
+  out->reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) {
+    HotTask t;
+    if (off + 1 > len) {
+      out->clear();
+      return false;
+    }
+    t.type = p[off];
+    off += 1;
+    if ((t.type != kHotTaskReplicate && t.type != kHotTaskDrop) ||
+        !ReadKey(p, len, &off, &t.key) ||
+        !ReadGroups(p, len, &off, 64, &t.groups)) {
+      out->clear();
+      return false;
+    }
+    out->push_back(std::move(t));
+  }
+  return true;
+}
+
+std::string PackHotMap(int64_t version, bool full,
+                       const std::vector<HotMapEntry>& entries) {
+  std::string out;
+  AppendInt64(&out, version);
+  out.push_back(full ? 1 : 0);
+  size_t n = entries.size();
+  if (n > kHotMapMaxEntries) n = kHotMapMaxEntries;
+  AppendInt64(&out, static_cast<int64_t>(n));
+  for (size_t i = 0; i < n; ++i) {
+    const HotMapEntry& e = entries[i];
+    AppendInt64(&out, static_cast<int64_t>(e.key.size()));
+    out.append(e.key);
+    AppendInt64(&out, static_cast<int64_t>(e.groups.size()));
+    for (const std::string& g : e.groups) PutFixedField(&out, g, kGroupNameLen);
+  }
+  return out;
+}
+
+bool ParseHotMap(const uint8_t* p, size_t len, int64_t* version, bool* full,
+                 std::vector<HotMapEntry>* out) {
+  out->clear();
+  if (len < 17) return false;
+  *version = GetInt64BE(p);
+  *full = p[8] != 0;
+  int64_t n = GetInt64BE(p + 9);
+  if (n < 0 || n > static_cast<int64_t>(kHotMapMaxEntries)) return false;
+  size_t off = 17;
+  out->reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) {
+    HotMapEntry e;
+    if (!ReadKey(p, len, &off, &e.key) ||
+        !ReadGroups(p, len, &off, 64, &e.groups)) {
+      out->clear();
+      return false;
+    }
+    out->push_back(std::move(e));
+  }
+  return true;
+}
+
+}  // namespace fdfs

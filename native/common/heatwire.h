@@ -1,0 +1,84 @@
+// Elastic hot-replication wire codecs, shared by the tracker (policy +
+// QUERY_HOT_MAP server), the storage daemon (beat heat trailer + fan-out
+// tasking), and fdfs_codec (the hot-map cross-language golden).
+//
+// Three append-only, absent-tolerated layouts ride existing channels:
+//
+//  1. Beat HEAT trailer (storage -> tracker), appended AFTER the health
+//     trailer in the append-only region past the pinned beat stat slots:
+//       1B version=2 + 8B BE entry count + per entry
+//       (8B BE key_len + key + 8B BE cumulative read hits +
+//        8B BE cumulative read bytes)
+//     Counts are CUMULATIVE since boot (the heat sketch's view); the
+//     tracker computes windowed deltas between consecutive snapshots
+//     with a counter-reset clamp (the monitor.top_rates discipline), so
+//     yesterday's hot file cannot outrank today's.  The trailer version
+//     byte disambiguates it from the health trailer (version 1); either
+//     trailer may be absent, and an old tracker ignores both.
+//
+//  2. Beat-response HOT-TASK trailer (tracker -> elected storage),
+//     appended after the placement-version field (prefix-tolerant):
+//       1B version=1 + 8B BE task count + per task
+//       (1B type [1 replicate | 2 drop] + 8B BE key_len + key +
+//        8B BE group count + per group 16B group name)
+//
+//  3. QUERY_HOT_MAP response (tracker -> client):
+//       8B BE map version + 1B full flag (1 full | 0 delta) +
+//       8B BE entry count + per entry (8B BE key_len + key +
+//       8B BE extra-group count + per group 16B group name)
+//     A delta entry with zero groups is a tombstone (demoted key).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace fdfs {
+
+constexpr uint8_t kHeatTrailerVersion = 2;   // health trailer owns 1
+constexpr uint8_t kHotTaskTrailerVersion = 1;
+constexpr uint8_t kHotTaskReplicate = 1;
+constexpr uint8_t kHotTaskDrop = 2;
+constexpr size_t kHeatTrailerMaxEntries = 256;
+constexpr size_t kHotTaskMaxTasks = 256;
+constexpr size_t kHotMapMaxEntries = 1 << 16;
+constexpr size_t kHotKeyMaxLen = 512;  // group + "/" + remote filename
+
+struct HeatTrailerEntry {
+  std::string key;      // "<group>/<remote filename>"
+  int64_t hits = 0;     // cumulative read (download) count
+  int64_t bytes = 0;    // cumulative read bytes
+};
+
+std::string PackHeatTrailer(const std::vector<HeatTrailerEntry>& entries);
+// Parses a heat trailer at p; trailing bytes beyond the declared entry
+// count are ignored (append-only).  False = not a heat trailer / torn.
+bool ParseHeatTrailer(const uint8_t* p, size_t len,
+                      std::vector<HeatTrailerEntry>* out);
+
+// The beat body's trailer region can hold the health trailer, the heat
+// trailer, or both (health first).  Returns the offset of the heat
+// trailer inside [p, p+len) or -1 when absent — skipping a well-formed
+// health trailer by its self-described length.
+int64_t FindHeatTrailer(const uint8_t* p, size_t len);
+
+struct HotTask {
+  uint8_t type = kHotTaskReplicate;
+  std::string key;
+  std::vector<std::string> groups;  // targets (replicate) / holders (drop)
+};
+
+std::string PackHotTasks(const std::vector<HotTask>& tasks);
+bool ParseHotTasks(const uint8_t* p, size_t len, std::vector<HotTask>* out);
+
+struct HotMapEntry {
+  std::string key;
+  std::vector<std::string> groups;  // extra replica groups; empty = tombstone
+};
+
+std::string PackHotMap(int64_t version, bool full,
+                       const std::vector<HotMapEntry>& entries);
+bool ParseHotMap(const uint8_t* p, size_t len, int64_t* version, bool* full,
+                 std::vector<HotMapEntry>* out);
+
+}  // namespace fdfs

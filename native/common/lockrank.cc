@@ -10,6 +10,7 @@ const char* LockRankName(LockRank r) {
     case LockRank::kTrunkRole: return "server.trunk_role";
     case LockRank::kTrackerReporter: return "tracker_client.reporter";
     case LockRank::kScrub: return "scrub.manager";
+    case LockRank::kHotRepl: return "hotrepl.manager";
     case LockRank::kRebalance: return "rebalance.manager";
     case LockRank::kRelationship: return "tracker.relationship";
     case LockRank::kDedupEngine: return "dedup.engine";

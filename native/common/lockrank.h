@@ -155,6 +155,7 @@ enum class LockRank : uint16_t {
   kTrunkRole = 10,
   kTrackerReporter = 20,
   kScrub = 30,
+  kHotRepl = 32,
   kRebalance = 34,
   kRelationship = 40,
   kDedupEngine = 50,

@@ -23,6 +23,12 @@ namespace fdfs {
 
 // -- blocking socket helpers (sockopt.c analogues) ------------------------
 bool SetNonBlocking(int fd);
+// TCP_NODELAY on a connected socket.  Every daemon writes responses as a
+// small header write followed by the body, so an accepted socket left
+// with Nagle on serializes each response against the peer's delayed ACK
+// (~40 ms per round-trip on a steadily reused connection).  Outbound
+// connects (TcpConnect) already set it; accept paths must too.
+void SetNoDelay(int fd);
 int TcpListen(const std::string& bind_addr, int port, std::string* error);
 // SO_REUSEPORT variant for sharded accept reactors: every listener of a
 // reactor group binds the same (addr, port) with the flag set and the

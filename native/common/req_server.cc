@@ -29,6 +29,7 @@ void RequestServer::OnAccept(uint32_t) {
     int fd = accept(listen_fd_, nullptr, nullptr);
     if (fd < 0) return;
     SetNonBlocking(fd);
+    SetNoDelay(fd);  // responses are header-write + body-write pairs
     if (max_connections_ > 0 &&
         conns_.size() >= static_cast<size_t>(max_connections_)) {
       // Polite refusal: a fresh socket's send buffer always takes the

@@ -1,0 +1,371 @@
+#include "storage/hotrepl.h"
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstring>
+
+#include "common/bytes.h"
+#include "common/log.h"
+#include "common/net.h"
+#include "common/protocol_gen.h"
+#include "common/threadreg.h"
+
+namespace fdfs {
+
+namespace {
+
+constexpr int kIoTimeoutMs = 30 * 1000;
+constexpr int kConnectTimeoutMs = 3000;
+
+void AppendInt64(std::string* out, int64_t v) {
+  uint8_t buf[8];
+  PutInt64BE(v, buf);
+  out->append(reinterpret_cast<const char*>(buf), 8);
+}
+
+bool SendHeader(int fd, uint8_t cmd, int64_t pkg_len) {
+  uint8_t hdr[kHeaderSize];
+  PutInt64BE(pkg_len, hdr);
+  hdr[8] = cmd;
+  hdr[9] = 0;
+  return SendAll(fd, hdr, sizeof(hdr), kIoTimeoutMs);
+}
+
+bool SendFileBytes(int fd, int local_fd, int64_t offset, int64_t count) {
+  char buf[256 * 1024];
+  if (lseek(local_fd, offset, SEEK_SET) != offset) return false;
+  while (count > 0) {
+    size_t want = static_cast<size_t>(
+        std::min<int64_t>(count, static_cast<int64_t>(sizeof(buf))));
+    ssize_t n = read(local_fd, buf, want);
+    if (n <= 0) return false;
+    if (!SendAll(fd, buf, static_cast<size_t>(n), kIoTimeoutMs)) return false;
+    count -= n;
+  }
+  return true;
+}
+
+// Header-only response with a small drained body (the sync.cc idiom).
+bool RecvStatus(int fd, uint8_t* status) {
+  uint8_t hdr[kHeaderSize];
+  if (!RecvAll(fd, hdr, sizeof(hdr), kIoTimeoutMs)) return false;
+  int64_t len = GetInt64BE(hdr);
+  *status = hdr[9];
+  if (len < 0 || len > (1 << 20)) return false;
+  if (len > 0) {
+    std::string drain(static_cast<size_t>(len), '\0');
+    if (!RecvAll(fd, drain.data(), drain.size(), kIoTimeoutMs)) return false;
+  }
+  return true;
+}
+
+int ConnectAddr(const std::string& addr) {
+  size_t colon = addr.rfind(':');
+  if (colon == std::string::npos) return -1;
+  std::string err;
+  return TcpConnect(addr.substr(0, colon), atoi(addr.c_str() + colon + 1),
+                    kConnectTimeoutMs, &err);
+}
+
+std::string SplitRemote(const std::string& key) {
+  size_t slash = key.find('/');
+  return slash == std::string::npos ? std::string() : key.substr(slash + 1);
+}
+
+}  // namespace
+
+HotReplManager::HotReplManager(const StorageConfig& cfg, HotReplCallbacks cbs)
+    : cfg_(cfg), cbs_(std::move(cbs)) {}
+
+HotReplManager::~HotReplManager() { Stop(); }
+
+void HotReplManager::Start() {
+  stop_ = false;
+  thread_ = std::thread([this] { ThreadMain(); });
+}
+
+void HotReplManager::Stop() {
+  stop_ = true;
+  cv_.notify_all();
+  if (thread_.joinable()) thread_.join();
+}
+
+void HotReplManager::Enqueue(const std::string& tracker_addr,
+                             const std::vector<HotTask>& tasks) {
+  std::lock_guard<RankedMutex> lk(mu_);
+  for (const HotTask& t : tasks) {
+    std::string id = std::to_string(t.type) + ":" + t.key;
+    if (inflight_.count(id) != 0) continue;
+    inflight_.insert(id);
+    queue_.push_back({tracker_addr, t});
+  }
+  cv_.notify_one();
+}
+
+int64_t HotReplManager::queue_depth() const {
+  std::lock_guard<RankedMutex> lk(mu_);
+  return static_cast<int64_t>(queue_.size());
+}
+
+void HotReplManager::ThreadMain() {
+  ScopedThreadName ledger("hotrepl");
+  while (!stop_) {
+    Job job;
+    {
+      std::unique_lock<RankedMutex> lk(mu_);
+      cv_.wait_for(lk, std::chrono::milliseconds(500),
+                   [this] { return stop_ || !queue_.empty(); });
+      BeatThreadHeartbeat();
+      if (stop_) return;
+      if (queue_.empty()) continue;
+      job = std::move(queue_.front());
+      queue_.pop_front();
+    }
+    bool ok = job.task.type == kHotTaskDrop ? RunDrop(job) : RunReplicate(job);
+    {
+      // Completed or failed either way: release the dedup slot so the
+      // tracker's next re-delivery (it re-sends until acked) retries.
+      std::lock_guard<RankedMutex> lk(mu_);
+      inflight_.erase(std::to_string(job.task.type) + ":" + job.task.key);
+    }
+    if (!ok) {
+      failures_total_.fetch_add(1, std::memory_order_relaxed);
+      if (cbs_.events != nullptr)
+        cbs_.events->Record(EventSeverity::kWarn, "hot.fanout_failed",
+                            job.task.key,
+                            std::string("type=") +
+                                (job.task.type == kHotTaskDrop ? "drop"
+                                                               : "replicate"));
+    }
+  }
+}
+
+bool HotReplManager::QueryGroupMembers(
+    const std::string& tracker_addr, const std::string& group,
+    std::vector<std::pair<std::string, int>>* members) {
+  members->clear();
+  int fd = ConnectAddr(tracker_addr);
+  if (fd < 0) return false;
+  bool ok = SendHeader(fd, static_cast<uint8_t>(TrackerCmd::kQueryPlacement),
+                       0);
+  uint8_t hdr[kHeaderSize];
+  std::string body;
+  if (ok) ok = RecvAll(fd, hdr, sizeof(hdr), kIoTimeoutMs);
+  if (ok) {
+    int64_t len = GetInt64BE(hdr);
+    ok = hdr[9] == 0 && len >= 16 && len <= (1 << 26);
+    if (ok) {
+      body.resize(static_cast<size_t>(len));
+      ok = RecvAll(fd, body.data(), body.size(), kIoTimeoutMs);
+    }
+  }
+  close(fd);
+  if (!ok) return false;
+  // QUERY_PLACEMENT: 8B version + 8B entry count + per entry (16B group
+  // + 1B state + 8B member count + members x (16B ip + 8B port)).
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(body.data());
+  int64_t count = GetInt64BE(p + 8);
+  size_t off = 16;
+  for (int64_t i = 0; i < count; ++i) {
+    if (off + kGroupNameMaxLen + 9 > body.size()) return false;
+    std::string g = GetFixedField(p + off, kGroupNameMaxLen);
+    off += kGroupNameMaxLen + 1;
+    int64_t n = GetInt64BE(p + off);
+    off += 8;
+    const size_t rec = kIpAddressSize + 8;
+    if (n < 0 || static_cast<uint64_t>(n) > (body.size() - off) / rec)
+      return false;
+    for (int64_t m = 0; m < n; ++m) {
+      if (g == group)
+        members->push_back(
+            {GetFixedField(p + off, kIpAddressSize),
+             static_cast<int>(GetInt64BE(p + off + kIpAddressSize))});
+      off += rec;
+    }
+  }
+  return !members->empty();
+}
+
+bool HotReplManager::PushCopy(const std::string& ip, int port,
+                              const std::string& group,
+                              const std::string& remote) {
+  auto h = cbs_.open_content ? cbs_.open_content(remote) : std::nullopt;
+  if (!h.has_value()) return false;
+  std::string err;
+  int fd = TcpConnect(ip, port, kConnectTimeoutMs, &err);
+  if (fd < 0) {
+    close(h->fd);
+    return false;
+  }
+  // kSyncCreateFile with the TARGET group in the group field: the
+  // receiver's own-group check passes and it stores the copy in its own
+  // tree as a replica op (binlog 'c' — never re-shipped).
+  std::string body;
+  PutFixedField(&body, group, kGroupNameMaxLen);
+  AppendInt64(&body, static_cast<int64_t>(remote.size()));
+  AppendInt64(&body, h->size);
+  body += remote;
+  bool ok = SendHeader(fd, static_cast<uint8_t>(StorageCmd::kSyncCreateFile),
+                       static_cast<int64_t>(body.size()) + h->size) &&
+            SendAll(fd, body.data(), body.size(), kIoTimeoutMs) &&
+            SendFileBytes(fd, h->fd, h->offset, h->size);
+  close(h->fd);
+  uint8_t status = 0;
+  ok = ok && RecvStatus(fd, &status) && status == 0;
+  close(fd);
+  return ok;
+}
+
+bool HotReplManager::VerifyCopy(const std::string& ip, int port,
+                                const std::string& group,
+                                const std::string& remote,
+                                const std::string& want_sha1,
+                                int64_t want_size) {
+  std::string err;
+  int fd = TcpConnect(ip, port, kConnectTimeoutMs, &err);
+  if (fd < 0) return false;
+  std::string body;
+  AppendInt64(&body, 0);  // offset
+  AppendInt64(&body, 0);  // count = whole file
+  PutFixedField(&body, group, kGroupNameMaxLen);
+  body += remote;
+  bool ok = SendHeader(fd, static_cast<uint8_t>(StorageCmd::kDownloadFile),
+                       static_cast<int64_t>(body.size())) &&
+            SendAll(fd, body.data(), body.size(), kIoTimeoutMs);
+  uint8_t hdr[kHeaderSize];
+  int64_t got = 0;
+  Sha1Stream sha;
+  if (ok) ok = RecvAll(fd, hdr, sizeof(hdr), kIoTimeoutMs);
+  if (ok) {
+    int64_t len = GetInt64BE(hdr);
+    ok = hdr[9] == 0 && len == want_size;
+    char buf[256 * 1024];
+    while (ok && got < len) {
+      size_t want = static_cast<size_t>(
+          std::min<int64_t>(len - got, static_cast<int64_t>(sizeof(buf))));
+      ok = RecvAll(fd, buf, want, kIoTimeoutMs);
+      if (ok) {
+        sha.Update(buf, want);
+        got += static_cast<int64_t>(want);
+      }
+    }
+  }
+  close(fd);
+  return ok && sha.Final().Hex() == want_sha1;
+}
+
+bool HotReplManager::AckTracker(const std::string& tracker_addr, uint8_t type,
+                                const std::string& key,
+                                const std::vector<std::string>& groups) {
+  int fd = ConnectAddr(tracker_addr);
+  if (fd < 0) return false;
+  std::string body;
+  PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
+  body.push_back(static_cast<char>(type));
+  AppendInt64(&body, static_cast<int64_t>(key.size()));
+  body += key;
+  AppendInt64(&body, static_cast<int64_t>(groups.size()));
+  for (const std::string& g : groups) PutFixedField(&body, g, kGroupNameMaxLen);
+  bool ok = SendHeader(fd, static_cast<uint8_t>(TrackerCmd::kHotFanoutDone),
+                       static_cast<int64_t>(body.size())) &&
+            SendAll(fd, body.data(), body.size(), kIoTimeoutMs);
+  uint8_t status = 0;
+  ok = ok && RecvStatus(fd, &status) && status == 0;
+  close(fd);
+  return ok;
+}
+
+bool HotReplManager::RunReplicate(const Job& job) {
+  const std::string remote = SplitRemote(job.task.key);
+  if (remote.empty()) return false;
+  // Local truth first: size + SHA-1 of the logical bytes, the verify
+  // baseline for every pushed copy.
+  auto h = cbs_.open_content ? cbs_.open_content(remote) : std::nullopt;
+  if (!h.has_value()) return false;  // gone since promotion
+  Sha1Stream sha;
+  char buf[256 * 1024];
+  int64_t left = h->size;
+  if (lseek(h->fd, h->offset, SEEK_SET) != h->offset) {
+    close(h->fd);
+    return false;
+  }
+  while (left > 0) {
+    ssize_t n = read(h->fd, buf,
+                     static_cast<size_t>(std::min<int64_t>(
+                         left, static_cast<int64_t>(sizeof(buf)))));
+    if (n <= 0) {
+      close(h->fd);
+      return false;
+    }
+    sha.Update(buf, static_cast<size_t>(n));
+    left -= n;
+  }
+  int64_t size = h->size;
+  close(h->fd);
+  std::string want_sha1 = sha.Final().Hex();
+
+  std::vector<std::string> verified;
+  for (const std::string& group : job.task.groups) {
+    std::vector<std::pair<std::string, int>> members;
+    if (!QueryGroupMembers(job.tracker_addr, group, &members)) break;
+    bool group_ok = true;
+    for (const auto& [ip, port] : members) {
+      if (!PushCopy(ip, port, group, remote) ||
+          !VerifyCopy(ip, port, group, remote, want_sha1, size)) {
+        verify_failures_.fetch_add(1, std::memory_order_relaxed);
+        group_ok = false;
+        break;
+      }
+    }
+    if (group_ok) verified.push_back(group);
+  }
+  if (verified.size() != job.task.groups.size()) return false;
+  if (!AckTracker(job.tracker_addr, kHotTaskReplicate, job.task.key, verified))
+    return false;
+  replicated_total_.fetch_add(1, std::memory_order_relaxed);
+  FDFS_LOG_INFO("hotrepl: replicated %s to %zu group(s), verified",
+                job.task.key.c_str(), verified.size());
+  if (cbs_.events != nullptr)
+    cbs_.events->Record(EventSeverity::kInfo, "hot.replicated", job.task.key,
+                        "groups=" + std::to_string(verified.size()));
+  return true;
+}
+
+bool HotReplManager::RunDrop(const Job& job) {
+  const std::string remote = SplitRemote(job.task.key);
+  if (remote.empty()) return false;
+  for (const std::string& group : job.task.groups) {
+    std::vector<std::pair<std::string, int>> members;
+    if (!QueryGroupMembers(job.tracker_addr, group, &members)) return false;
+    for (const auto& [ip, port] : members) {
+      std::string err;
+      int fd = TcpConnect(ip, port, kConnectTimeoutMs, &err);
+      if (fd < 0) return false;
+      std::string body;
+      PutFixedField(&body, group, kGroupNameMaxLen);
+      body += remote;
+      bool ok =
+          SendHeader(fd, static_cast<uint8_t>(StorageCmd::kSyncDeleteFile),
+                     static_cast<int64_t>(body.size())) &&
+          SendAll(fd, body.data(), body.size(), kIoTimeoutMs);
+      uint8_t status = 0;
+      ok = ok && RecvStatus(fd, &status);
+      close(fd);
+      // ENOENT (2) is fine: the member never had the copy.
+      if (!ok || (status != 0 && status != 2)) return false;
+    }
+  }
+  if (!AckTracker(job.tracker_addr, kHotTaskDrop, job.task.key,
+                  job.task.groups))
+    return false;
+  dropped_total_.fetch_add(1, std::memory_order_relaxed);
+  FDFS_LOG_INFO("hotrepl: dropped extra copies of %s", job.task.key.c_str());
+  if (cbs_.events != nullptr)
+    cbs_.events->Record(EventSeverity::kInfo, "hot.copies_dropped",
+                        job.task.key, "");
+  return true;
+}
+
+}  // namespace fdfs

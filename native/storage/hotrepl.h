@@ -1,0 +1,119 @@
+// Hot-replication fan-out worker (ISSUE 20): executes the tracker's
+// replicate/drop assignments for keys this node was elected to handle
+// (jump-hash over the home group's sorted ACTIVE members, tracker-side).
+//
+// Replicate: push the file to every ACTIVE member of each target group
+// via the established sync-create path — with the TARGET group's name
+// in the wire group field, so the receiver stores the copy in its own
+// tree under the same remote name and serves it at
+// "<target group>/<remote>" with zero read-path changes.  The receiver
+// logs it as a replica op ('c'), so the copy never re-ships.  Then
+// byte-verify: download each copy back and compare SHA-1 against the
+// local bytes, and only after every assigned group verifies, ack the
+// tracker (HOT_FANOUT_DONE) — which is what publishes the map entry
+// (verify-then-publish: a routed read can never miss).
+//
+// Drop: SYNC_DELETE_FILE to every ACTIVE member of each listed group
+// (ENOENT tolerated — the copy may predate a member), then ack.  The
+// tracker only issues drops a full epoch after the tombstone, so no
+// client still holds the route.
+//
+// Tasks arrive from the beat thread (TrackerReporter hot-task trailer)
+// and are re-sent every beat until acked, so the queue dedups by
+// (type, key) and failures simply wait for the next beat's re-delivery.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <optional>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common/eventlog.h"
+#include "common/heatwire.h"
+#include "common/lockrank.h"
+#include "storage/config.h"
+#include "storage/sync.h"  // ContentHandle
+
+namespace fdfs {
+
+struct HotReplCallbacks {
+  // Trunk/recipe-aware logical-content opener (the sync ReplayCreate
+  // source); nullopt = the file is gone (task acked as failed — the
+  // tracker keeps or retires the entry on its own evidence).
+  std::function<std::optional<ContentHandle>(const std::string& remote)>
+      open_content;
+  EventLog* events = nullptr;
+};
+
+class HotReplManager {
+ public:
+  HotReplManager(const StorageConfig& cfg, HotReplCallbacks cbs);
+  ~HotReplManager();
+
+  void Start();
+  void Stop();
+
+  // Beat-thread entry: enqueue this beat's assignments.  Duplicates of
+  // queued or in-flight work are ignored (at-least-once delivery from
+  // the tracker, exactly-once execution here per cycle).
+  void Enqueue(const std::string& tracker_addr,
+               const std::vector<HotTask>& tasks);
+
+  int64_t replicated_total() const {
+    return replicated_total_.load(std::memory_order_relaxed);
+  }
+  int64_t dropped_total() const {
+    return dropped_total_.load(std::memory_order_relaxed);
+  }
+  int64_t verify_failures() const {
+    return verify_failures_.load(std::memory_order_relaxed);
+  }
+  int64_t failures_total() const {
+    return failures_total_.load(std::memory_order_relaxed);
+  }
+  int64_t queue_depth() const;
+
+ private:
+  struct Job {
+    std::string tracker_addr;
+    HotTask task;
+  };
+
+  void ThreadMain();
+  bool RunReplicate(const Job& job);
+  bool RunDrop(const Job& job);
+  // QUERY_PLACEMENT against the issuing tracker: ACTIVE members of one
+  // group ("ip:port" pairs).
+  bool QueryGroupMembers(const std::string& tracker_addr,
+                         const std::string& group,
+                         std::vector<std::pair<std::string, int>>* members);
+  bool PushCopy(const std::string& ip, int port, const std::string& group,
+                const std::string& remote);
+  bool VerifyCopy(const std::string& ip, int port, const std::string& group,
+                  const std::string& remote, const std::string& want_sha1,
+                  int64_t want_size);
+  bool AckTracker(const std::string& tracker_addr, uint8_t type,
+                  const std::string& key,
+                  const std::vector<std::string>& groups);
+
+  StorageConfig cfg_;
+  HotReplCallbacks cbs_;
+  std::atomic<bool> stop_{false};
+  std::thread thread_;
+  mutable RankedMutex mu_{LockRank::kHotRepl};
+  std::condition_variable_any cv_;
+  std::deque<Job> queue_;
+  std::set<std::string> inflight_;  // "<type>:<key>" dedup across beats
+  std::atomic<int64_t> replicated_total_{0};
+  std::atomic<int64_t> dropped_total_{0};
+  std::atomic<int64_t> verify_failures_{0};
+  std::atomic<int64_t> failures_total_{0};
+};
+
+}  // namespace fdfs

@@ -286,8 +286,12 @@ bool StorageServer::Init(std::string* error) {
       if (reporter_ != nullptr) reporter_->ReportSyncProgress(ip, port, ts);
     };
     scbs.binlog_quiescent = [this]() { return binlog_.Quiescent(); };
-    scbs.open_content =
-        [this](const std::string& remote) -> std::optional<ContentHandle> {
+    // Shared by the sync replayer and the hot-replication fan-out
+    // worker: both ship logical bytes (trunk slots and chunk recipes
+    // materialize; the receiver re-chunks under its own config).
+    std::function<std::optional<ContentHandle>(const std::string&)>
+        open_content_fn =
+            [this](const std::string& remote) -> std::optional<ContentHandle> {
       auto parts = DecodeFileId(cfg_.group_name + "/" + remote);
       if (parts.has_value() && parts->trunk_loc.has_value()) {
         const TrunkLocation& loc = *parts->trunk_loc;
@@ -321,6 +325,7 @@ bool StorageServer::Init(std::string* error) {
       out.size = size;
       return out;
     };
+    scbs.open_content = open_content_fn;
     // Chunk-aware replication hooks: recipe-stored files ship their
     // recipe + only-missing chunks to peers instead of logical bytes.
     scbs.pin_recipe =
@@ -362,6 +367,36 @@ bool StorageServer::Init(std::string* error) {
     // HEALTH_MATRIX.
     reporter_->set_health_trailer_fn(
         [] { return HealthMonitor::Global().PackBeatTrailer(); });
+    // Heat trailer (ISSUE 20): the sketch's cumulative download
+    // counters ride every beat after the health trailer; the tracker
+    // windows them per node (reset-clamped), so the wire stays
+    // stateless and beat loss only costs freshness.
+    reporter_->set_heat_trailer_fn([this]() -> std::string {
+      if (heat_ == nullptr) return std::string();
+      std::vector<HeatTrailerEntry> entries;
+      for (const auto& t : heat_->Top(cfg_.heat_top_k)) {
+        int op = static_cast<int>(HeatOp::kDownload);
+        if (t.op_count[op] <= 0) continue;
+        HeatTrailerEntry he;
+        he.key = t.key;
+        he.hits = t.op_count[op];
+        he.bytes = t.op_bytes[op];
+        entries.push_back(std::move(he));
+      }
+      return PackHeatTrailer(entries);
+    });
+    // Hot-replication fan-out worker: beat responses electing this node
+    // for replicate/drop work feed its queue; it pushes copies over the
+    // sync-create path, byte-verifies them, and acks the tracker
+    // (which is what publishes the widened replica set).
+    HotReplCallbacks hcbs;
+    hcbs.open_content = open_content_fn;
+    hcbs.events = events_.get();
+    hotrepl_ = std::make_unique<HotReplManager>(cfg_, std::move(hcbs));
+    reporter_->set_hot_tasks_fn([this](const std::string& tracker_addr,
+                                       const std::vector<HotTask>& tasks) {
+      if (hotrepl_ != nullptr) hotrepl_->Enqueue(tracker_addr, tasks);
+    });
     // Disk recovery (storage_disk_recovery.c): a wiped store path on a
     // server with prior sync state rebuilds itself from a group peer in
     // the background.  Decided BEFORE the first JOIN so the recovering
@@ -489,6 +524,7 @@ bool StorageServer::Init(std::string* error) {
     }
     bool needs_recovery = recovery_->NeedsRecovery(store_.any_path_was_fresh());
     reporter_->set_recovering(needs_recovery);
+    hotrepl_->Start();
     reporter_->Start();
     if (needs_recovery) recovery_->Start();
   }
@@ -671,6 +707,9 @@ void StorageServer::Stop() {
   if (rebalance_ != nullptr) rebalance_->Stop();
   if (recovery_ != nullptr) recovery_->Stop();
   if (sync_ != nullptr) sync_->Stop();  // persists .mark cursors
+  // The fan-out worker checks its stop flag between jobs and inside
+  // its socket timeouts, so this join is bounded.
+  if (hotrepl_ != nullptr) hotrepl_->Stop();
   if (reporter_ != nullptr) reporter_->Stop();
   // Order matters: dio pools drain first (their completions post to the
   // nio loops, which must still be running), then the nio loops stop and
@@ -1111,6 +1150,23 @@ void StorageServer::InitStatsRegistry() {
   registry_.GaugeFn("rebalance.done", [this] {
     return rebalance_ != nullptr ? rebalance_->done() : int64_t{0};
   });
+  // Hot-replication fan-out worker (ISSUE 20): elected-member progress
+  // counters; all zero on nodes never elected (or trackerless runs).
+  registry_.GaugeFn("hot.fanout_replicated", [this] {
+    return hotrepl_ != nullptr ? hotrepl_->replicated_total() : int64_t{0};
+  });
+  registry_.GaugeFn("hot.fanout_dropped", [this] {
+    return hotrepl_ != nullptr ? hotrepl_->dropped_total() : int64_t{0};
+  });
+  registry_.GaugeFn("hot.fanout_verify_failures", [this] {
+    return hotrepl_ != nullptr ? hotrepl_->verify_failures() : int64_t{0};
+  });
+  registry_.GaugeFn("hot.fanout_failures", [this] {
+    return hotrepl_ != nullptr ? hotrepl_->failures_total() : int64_t{0};
+  });
+  registry_.GaugeFn("hot.fanout_queue", [this] {
+    return hotrepl_ != nullptr ? hotrepl_->queue_depth() : int64_t{0};
+  });
 }
 
 int64_t StorageServer::MaxSyncLagS() const {
@@ -1441,6 +1497,7 @@ void StorageServer::FillBeatStats(int64_t* out) {
 
 bool StorageServer::AdmitConn(int fd) {
   SetNonBlocking(fd);
+  SetNoDelay(fd);  // responses are header-write + body-write pairs
   if (cfg_.max_connections > 0 &&
       conn_count_.load() >= cfg_.max_connections) {
     // Polite refusal (reference: fast_task_queue pool exhaustion):

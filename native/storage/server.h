@@ -42,6 +42,7 @@
 #include "storage/chunkstore.h"
 #include "storage/config.h"
 #include "storage/dedup.h"
+#include "storage/hotrepl.h"
 #include "storage/recovery.h"
 #include "storage/rebalance.h"
 #include "storage/scrub.h"
@@ -539,6 +540,9 @@ class StorageServer {
   std::unique_ptr<TrackerReporter> reporter_;
   std::unique_ptr<SyncManager> sync_;
   std::unique_ptr<RecoveryManager> recovery_;
+  // Hot-replication fan-out worker (ISSUE 20): runs the tracker's
+  // replicate/drop elections delivered in beat-response trailers.
+  std::unique_ptr<HotReplManager> hotrepl_;
   EventLoop loop_;                      // main: accept + timers
   int listen_fd_ = -1;
   // nio work threads (storage.conf:work_threads); each reactor owns the

@@ -115,8 +115,8 @@ void TrackerReporter::ReportSyncProgress(const std::string& dest_ip,
   pending_sync_reports_.push_back({dest_ip, dest_port, ts});
 }
 
-bool TrackerReporter::ParsePeers(const std::string& body,
-                                 bool* peers_changed) {
+bool TrackerReporter::ParsePeers(const std::string& body, bool* peers_changed,
+                                 std::vector<HotTask>* hot_tasks) {
   if (body.size() < 8) return false;
   const uint8_t* p = reinterpret_cast<const uint8_t*>(body.data());
   int64_t count = GetInt64BE(p);
@@ -163,6 +163,13 @@ bool TrackerReporter::ParsePeers(const std::string& body,
       if (body.size() >= tail + kIpAddressSize + 25)
         pversion = GetInt64BE(q + kIpAddressSize + 17);
     }
+    // Hot-task trailer (common/heatwire.h, ISSUE 20): replicate/drop
+    // assignments for keys this node was elected to fan out.  Appended
+    // after the placement fields; absent on old trackers and on beats
+    // with nothing assigned here.
+    size_t hot_off = tail + kIpAddressSize + 25;
+    if (hot_tasks != nullptr && body.size() > hot_off)
+      ParseHotTasks(p + hot_off, body.size() - hot_off, hot_tasks);
   }
   {
     std::lock_guard<RankedMutex> lk(mu_);
@@ -365,7 +372,8 @@ std::map<std::string, std::string> TrackerReporter::cluster_params() const {
   return cluster_params_;
 }
 
-bool TrackerReporter::DoBeat(int fd, int64_t* chlog_off) {
+bool TrackerReporter::DoBeat(int fd, int64_t* chlog_off,
+                             const std::string& tracker_addr) {
   std::string body;
   PutFixedField(&body, cfg_.group_name, kGroupNameMaxLen);
   PutFixedField(&body, my_ip(), kIpAddressSize);
@@ -378,6 +386,9 @@ bool TrackerReporter::DoBeat(int fd, int64_t* chlog_off) {
   // parses anything further as a versioned trailer; an older tracker
   // ignores it entirely).
   if (health_trailer_fn_) body += health_trailer_fn_();
+  // Heat trailer after the health trailer (version bytes disambiguate;
+  // the tracker's FindHeatTrailer skips a well-formed health trailer).
+  if (heat_trailer_fn_) body += heat_trailer_fn_();
   std::string resp;
   uint8_t status;
   if (!Rpc(fd, static_cast<uint8_t>(TrackerCmd::kStorageBeat), body, &resp,
@@ -385,7 +396,10 @@ bool TrackerReporter::DoBeat(int fd, int64_t* chlog_off) {
     return false;
   if (status != 0) return false;  // tracker lost us: re-JOIN
   bool changed = false;
-  ParsePeers(resp, &changed);
+  std::vector<HotTask> hot_tasks;
+  ParsePeers(resp, &changed, &hot_tasks);
+  if (!hot_tasks.empty() && hot_tasks_fn_)
+    hot_tasks_fn_(tracker_addr, hot_tasks);
   if (changed) {
     // A changed peer list may be a renamed peer: apply the changelog
     // first so its sync cursor is renamed before a fresh worker (with a
@@ -470,7 +484,7 @@ void TrackerReporter::ThreadMain(std::string host, int port) {
         last_disk = now;
       }
     } else if (now - last_beat >= cfg_.heart_beat_interval_s) {
-      ok = DoBeat(fd, &chlog_off);
+      ok = DoBeat(fd, &chlog_off, host + ":" + std::to_string(port));
       if (!ok) joined = false;  // status!=0 or IO error: rejoin
       last_beat = now;
     } else if (now - last_disk >= cfg_.stat_report_interval_s) {

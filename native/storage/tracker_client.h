@@ -17,6 +17,7 @@
 #include <thread>
 #include <vector>
 
+#include "common/heatwire.h"
 #include "storage/config.h"
 
 namespace fdfs {
@@ -53,6 +54,23 @@ class TrackerReporter {
   void set_health_trailer_fn(std::function<std::string()> fn) {
     health_trailer_fn_ = std::move(fn);
   }
+  // Heat trailer provider (common/heatwire.h PackHeatTrailer): the heat
+  // sketch's cumulative top-K read counters, appended AFTER the health
+  // trailer in every beat (either may be empty; same append-only
+  // contract).  Set before Start().
+  void set_heat_trailer_fn(std::function<std::string()> fn) {
+    heat_trailer_fn_ = std::move(fn);
+  }
+  // Hot-replication tasking (ISSUE 20): invoked from the beat thread
+  // whenever a beat response carries a hot-task trailer — this node is
+  // the elected fan-out member for those keys.  tracker_addr is the
+  // issuing tracker ("host:port"), where HOT_FANOUT_DONE acks go.
+  // Set before Start().
+  void set_hot_tasks_fn(
+      std::function<void(const std::string& tracker_addr,
+                         const std::vector<HotTask>&)> fn) {
+    hot_tasks_fn_ = std::move(fn);
+  }
   // Disk recovery in progress: JOINs carry the recovering flag (tracker
   // holds the node in WAIT_SYNC) and the join-time sync negotiation is
   // left to the recovery thread.  Cleared when the rebuild completes.
@@ -81,7 +99,7 @@ class TrackerReporter {
   // chlog_off: per-tracker changelog resume offset (each tracker keeps an
   // independent changelog file, so the cursor lives in its thread).
   bool DoJoin(int fd, int64_t* chlog_off);
-  bool DoBeat(int fd, int64_t* chlog_off);
+  bool DoBeat(int fd, int64_t* chlog_off, const std::string& tracker_addr);
   bool DoDiskReport(int fd);
   void DoSyncDestReq(int fd);
   void DoParameterReq(int fd);
@@ -96,13 +114,17 @@ class TrackerReporter {
   // a fresh zero-position mark would win over the rename and re-replay
   // the whole binlog.
   void DoChangelogReq(int fd, int64_t* chlog_off);
-  bool ParsePeers(const std::string& body, bool* peers_changed = nullptr);
+  bool ParsePeers(const std::string& body, bool* peers_changed = nullptr,
+                  std::vector<HotTask>* hot_tasks = nullptr);
   void NotifyPeersChanged();
 
   StorageConfig cfg_;
   StatsSnapshotFn stats_fn_;
   PeersCallback peers_cb_;
   std::function<std::string()> health_trailer_fn_;  // set before Start()
+  std::function<std::string()> heat_trailer_fn_;    // set before Start()
+  std::function<void(const std::string&, const std::vector<HotTask>&)>
+      hot_tasks_fn_;  // set before Start()
   std::atomic<bool> stop_{false};
   std::atomic<bool> recovering_{false};
   std::vector<std::thread> threads_;

@@ -7,9 +7,11 @@
 #include <string>
 #include <vector>
 
+#include "common/heatwire.h"
 #include "common/jumphash.h"
 #include "common/protocol_gen.h"
 #include "tracker/cluster.h"
+#include "tracker/hotmap.h"
 #include "tracker/placement.h"
 
 static int g_failures = 0;
@@ -213,8 +215,99 @@ static void TestQueryStoreHonorsPlacement() {
   CHECK(t3.has_value() && t3->group != t->group);
 }
 
+static int64_t BE64At(const std::string& s, size_t off) {
+  int64_t v = 0;
+  for (size_t i = 0; i < 8; ++i)
+    v = (v << 8) | static_cast<uint8_t>(s[off + i]);
+  return v;
+}
+
+// ISSUE 20: the heat window's counter-reset clamp and the
+// verify-then-publish / one-epoch-drop-gap entry lifecycle — the two
+// invariants the routed read path leans on.
+static void TestHotMapWindowClampAndLifecycle() {
+  HotMap::Config cfg;
+  cfg.promote_threshold = 5;  // reads/s
+  cfg.demote_threshold = 1;
+  cfg.max_extra_replicas = 2;
+  cfg.capacity = 4;
+  HotMap hm(cfg);
+  const std::string key = "group1/M00/00/01/f.bin";
+  auto pick = [](const std::string& home, int want) {
+    (void)home;
+    std::vector<std::string> out{"group2", "group3"};
+    if (static_cast<int>(out.size()) > want) out.resize(want);
+    return out;
+  };
+
+  // Two nodes' cumulative beat counters fold into one cluster window:
+  // 100 hits over a 1 s tick -> ewma 0.3*100 = 30/s >= 5 -> promoted.
+  hm.NoteHeat("10.0.0.1:23000", {{key, 60, 60 << 10}});
+  hm.NoteHeat("10.0.0.2:23000", {{key, 40, 40 << 10}});
+  hm.Tick(1.0, pick, true);
+  const HotMap::Entry* e = hm.Find(key);
+  CHECK(e != nullptr && e->state == HotMap::State::kPending);
+  CHECK_EQ(hm.promotions_total(), 1);
+  // Pending entries are INVISIBLE (verify-then-publish): a full
+  // snapshot carries zero entries until the fan-out is byte-verified.
+  CHECK_EQ(BE64At(hm.PackWire(-1), 9), 0);
+  auto tasks = hm.TasksForGroup("group1");
+  CHECK_EQ(tasks.size(), 1u);
+  CHECK(tasks[0].type == kHotTaskReplicate);
+  // A short verified set must NOT publish...
+  CHECK(!hm.AckReplicate(key, {"group2"}));
+  CHECK(hm.Find(key)->state == HotMap::State::kPending);
+  // ...the full one does, and the entry becomes visible.
+  CHECK(hm.AckReplicate(key, {"group2", "group3"}));
+  CHECK(hm.Find(key)->state == HotMap::State::kPublished);
+  CHECK_EQ(BE64At(hm.PackWire(-1), 9), 1);
+  int64_t v_pub = hm.version();
+  CHECK(v_pub >= 1);
+
+  // Counter-reset clamp: node 1 restarts and its cumulative counter
+  // shrinks 60 -> 40.  The window must take the new ABSOLUTE (40), not
+  // the negative delta (-20): ewma = 0.3*40 + 0.7*30 = 33 > 30, while
+  // the unclamped fold would sag to 15.
+  hm.NoteHeat("10.0.0.1:23000", {{key, 40, 40 << 10}});
+  hm.Tick(1.0, pick, true);
+  CHECK(hm.Find(key)->ewma > 30.0);
+
+  // Reads served off an extra replica are credited to the HOME key
+  // (alias map), so a routed read cannot cascade-promote its own copy.
+  hm.NoteHeat("10.0.0.3:23000", {{"group2/M00/00/01/f.bin", 50, 50 << 10}});
+  hm.Tick(1.0, pick, true);
+  CHECK(hm.Find("group2/M00/00/01/f.bin") == nullptr);
+  CHECK(hm.Find(key) != nullptr);
+
+  // Idle ticks decay the EWMA below hot_demote_threshold -> retiring
+  // tombstone (version bump), extra copies still on disk.
+  int64_t v_before = hm.version();
+  for (int i = 0;
+       i < 16 && hm.Find(key)->state == HotMap::State::kPublished; ++i)
+    hm.Tick(1.0, pick, true);
+  CHECK(hm.Find(key)->state == HotMap::State::kRetiring);
+  CHECK(hm.version() > v_before);
+  CHECK_EQ(hm.demotions_total(), 1);
+  // The delta since publish is a tombstone: full flag 0, one entry,
+  // zero groups.
+  std::string delta = hm.PackWire(v_pub);
+  CHECK_EQ(delta[8], 0);
+  CHECK_EQ(BE64At(delta, 9), 1);
+  CHECK_EQ(BE64At(delta, 17 + 8 + key.size()), 0);
+  // One-epoch gap: no drop task on the demote tick itself...
+  CHECK(hm.TasksForGroup("group1").empty());
+  hm.Tick(1.0, pick, true);
+  // ...one tick later the bytes may go.
+  auto drops = hm.TasksForGroup("group1");
+  CHECK_EQ(drops.size(), 1u);
+  CHECK(drops[0].type == kHotTaskDrop);
+  CHECK(hm.AckDrop(key));
+  CHECK(hm.Find(key) == nullptr);
+}
+
 int main() {
   TestBeatStatsRoundTripJson();
+  TestHotMapWindowClampAndLifecycle();
   TestShortBeatKeepsTail();
   TestStoreLookup2Hysteresis();
   TestPlacementLifecycle();

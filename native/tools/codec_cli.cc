@@ -101,6 +101,16 @@
 //                relax with a per-tick transcript, then the wire JSON —
 //                pins the EWMA+hysteresis ladder discipline and
 //                monitor.decode_admission across languages)
+//   fdfs_codec hot-map         (golden elastic-hot-replication wire set:
+//                a fixture QUERY_HOT_MAP full snapshot + delta-with-
+//                tombstone through PackHotMap, the beat heat trailer
+//                through PackHeatTrailer with its parse-back, the
+//                beat-response hot-task trailer through PackHotTasks
+//                with its parse-back, and the HOT_FANOUT_DONE ack body
+//                — all as hex; tests/test_hot_replication.py decodes
+//                them with fastdfs_tpu.monitor.decode_hot_map and the
+//                documented layouts, pinning ISSUE 20's wire contracts
+//                across languages)
 #include <time.h>
 
 #include <atomic>
@@ -117,6 +127,7 @@
 #include "common/fileid.h"
 #include "common/healthmon.h"
 #include "common/heatsketch.h"
+#include "common/heatwire.h"
 #include "common/http_token.h"
 #include "common/ini.h"
 #include "common/metrog.h"
@@ -1041,6 +1052,85 @@ int main(int argc, char** argv) {
     tick(0);
     printf("%s\n", ac.StatusJson("storage", 23000).c_str());
     return 0;
+  }
+  if (cmd == "hot-map") {
+    // Elastic hot-replication wire goldens (ISSUE 20) — every blob the
+    // tracker, the elected storage, and the client exchange, from the
+    // REAL codecs in common/heatwire.h.
+    auto hex = [](const std::string& s) {
+      static const char* k = "0123456789abcdef";
+      std::string out;
+      for (unsigned char c : s) {
+        out.push_back(k[c >> 4]);
+        out.push_back(k[c & 0xF]);
+      }
+      return out;
+    };
+    // QUERY_HOT_MAP full snapshot at version 7.
+    std::vector<HotMapEntry> full;
+    full.push_back({"group1/M00/00/01/hotfile.bin", {"group2", "group3"}});
+    full.push_back({"group2/M00/00/02/warmfile.bin", {"group1"}});
+    printf("full_response=%s\n", hex(PackHotMap(7, true, full)).c_str());
+    // Delta since version 7 -> 9: one new publish + one tombstone (the
+    // zero-group entry that tells clients "demoted, stop routing").
+    std::vector<HotMapEntry> delta;
+    delta.push_back({"group3/M00/00/05/risen.bin", {"group1"}});
+    delta.push_back({"group1/M00/00/01/hotfile.bin", {}});
+    printf("delta_response=%s\n", hex(PackHotMap(9, false, delta)).c_str());
+    std::string since(8, '\0');
+    PutInt64BE(7, reinterpret_cast<uint8_t*>(since.data()));
+    printf("delta_request=%s\n", hex(since).c_str());
+    // Beat heat trailer: cumulative download counters, parse-back pins
+    // both directions.
+    std::vector<HeatTrailerEntry> heat;
+    heat.push_back({"group1/M00/00/01/hotfile.bin", 9, 36864});
+    heat.push_back({"group2/M00/00/02/warmfile.bin", 4, 4096});
+    std::string ht = PackHeatTrailer(heat);
+    printf("heat_trailer=%s\n", hex(ht).c_str());
+    std::vector<HeatTrailerEntry> heat_back;
+    bool hok = ParseHeatTrailer(
+        reinterpret_cast<const uint8_t*>(ht.data()), ht.size(), &heat_back);
+    printf("heat_parsed=%d\n", hok ? 1 : 0);
+    for (const auto& e : heat_back)
+      printf("heat_entry=%s:%lld:%lld\n", e.key.c_str(),
+             static_cast<long long>(e.hits),
+             static_cast<long long>(e.bytes));
+    // Beat-response hot-task trailer: one replicate election + one drop.
+    std::vector<HotTask> tasks;
+    tasks.push_back({kHotTaskReplicate, "group1/M00/00/01/hotfile.bin",
+                     {"group2", "group3"}});
+    tasks.push_back({kHotTaskDrop, "group2/M00/00/02/warmfile.bin",
+                     {"group1"}});
+    std::string tt = PackHotTasks(tasks);
+    printf("task_trailer=%s\n", hex(tt).c_str());
+    std::vector<HotTask> tasks_back;
+    bool tok = ParseHotTasks(
+        reinterpret_cast<const uint8_t*>(tt.data()), tt.size(), &tasks_back);
+    printf("task_parsed=%d\n", tok ? 1 : 0);
+    for (const auto& t : tasks_back) {
+      std::string gs;
+      for (const auto& g : t.groups) {
+        if (!gs.empty()) gs += ',';
+        gs += g;
+      }
+      printf("task_entry=%u:%s:%s\n", t.type, t.key.c_str(), gs.c_str());
+    }
+    // HOT_FANOUT_DONE ack: 16B home group + 1B type + 8B key_len + key
+    // + 8B verified-group count + n x 16B names.
+    std::string ack;
+    PutFixedField(&ack, "group1", kGroupNameMaxLen);
+    ack.push_back(static_cast<char>(kHotTaskReplicate));
+    uint8_t num[8];
+    const std::string key = "group1/M00/00/01/hotfile.bin";
+    PutInt64BE(static_cast<int64_t>(key.size()), num);
+    ack.append(reinterpret_cast<char*>(num), 8);
+    ack += key;
+    PutInt64BE(2, num);
+    ack.append(reinterpret_cast<char*>(num), 8);
+    PutFixedField(&ack, "group2", kGroupNameMaxLen);
+    PutFixedField(&ack, "group3", kGroupNameMaxLen);
+    printf("ack_body=%s\n", hex(ack).c_str());
+    return (hok && tok) ? 0 : 1;
   }
   if (cmd == "b64e" && argc == 3) {
     std::string hex = argv[2];

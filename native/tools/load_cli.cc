@@ -24,6 +24,7 @@
 //                      every payload unique)
 //   fdfs_load download <tracker ip:port> <ids_file> <n_ops> <threads> <result>
 //                      [--zipf <s> [--zipf-keys N] [--zipf-seed S]]
+//                      [--hot-keys K:pct]
 //   fdfs_load delete   <tracker ip:port> <ids_file> <threads> <result>
 //   fdfs_load combine  <result files...>     (prints one JSON line)
 //   fdfs_load zipf-sample <s> <keys> <n> [seed]   (prints n key indices,
@@ -76,6 +77,18 @@
 // fixed seed (--zipf-seed, default 42), so a run is DETERMINISTIC
 // regardless of thread count or interleaving — the heat-sketch
 // acceptance test replays the exact same skew every time.
+//
+// --hot-keys K:pct (download; ISSUE 20's elastic-replication bench
+// mode): the FIRST K ids in the file form a hot set that receives
+// pct% of the ops (uniform within the set); the rest spread uniformly
+// over the remaining ids.  Unlike --zipf's smooth rank curve this
+// pins an exact hot-set size and traffic share, so a promotion
+// threshold can be aimed at precisely K keys.  Mutually exclusive
+// with --zipf.  Each record's trailing token marks its key class
+// ("hot"/"cold"), and `combine` reports per-key-class op counts and
+// latency percentiles under "by_key_class" — the number the bench
+// compares across the promotion-on/off arms.  Deterministic on the op
+// index (the zipf-picker discipline).
 #include <stdio.h>
 #include <string.h>
 #include <time.h>
@@ -120,6 +133,9 @@ struct OpRecord {
   int64_t bytes;
   int cls;     // wire priority class, kUntagged when no frame was sent
   std::string file_id;
+  // "hot"/"cold" under --hot-keys, "" otherwise (a trailing record
+  // token; absent = unclassed, the append-only record discipline).
+  std::string key_class;
 };
 
 // One request/response on a blocking fd.  Returns false on transport
@@ -378,6 +394,33 @@ struct Shared {
   int64_t unique = 0;  // 0 = every payload unique
   std::vector<std::string> ids;  // download/delete input
   std::unique_ptr<ZipfPicker> zipf;  // download key-popularity mode
+  // Hot-set mode (--hot-keys K:pct): op i aims at one of the first
+  // hot_keys ids with probability hot_frac, else uniformly at the
+  // rest.  Hashed on the op index (deterministic regardless of thread
+  // interleaving, the ZipfPicker discipline).
+  int64_t hot_keys = 0;
+  double hot_frac = 0;
+  size_t HotPick(int64_t i, bool* hot) const {
+    uint64_t x = 0x40fULL + 0x9E3779B97F4A7C15ULL *
+                 (static_cast<uint64_t>(i) + 1);
+    x ^= x >> 30;
+    x *= 0xBF58476D1CE4E5B9ULL;
+    x ^= x >> 27;
+    x *= 0x94D049BB133111EBULL;
+    x ^= x >> 31;
+    double u = static_cast<double>(x >> 11) * (1.0 / 9007199254740992.0);
+    uint64_t r = x * 0xD1342543DE82EF95ULL + 0x2545F4914F6CDD1DULL;
+    size_t n = ids.size();
+    size_t k = static_cast<size_t>(std::min<int64_t>(
+        hot_keys, static_cast<int64_t>(n)));
+    *hot = u < hot_frac && k > 0;
+    if (*hot) return r % k;
+    if (k >= n) {  // every id is hot: nothing cold to aim at
+      *hot = true;
+      return r % n;
+    }
+    return k + r % (n - k);
+  }
   // Open-loop mode (--open-loop --rate R): op i is SCHEDULED at
   // t0 + i/R regardless of how slow earlier ops were, and its latency
   // clock starts at the scheduled time — so server-side queueing shows
@@ -503,12 +546,20 @@ void DownloadWorker(Shared* sh) {
     int64_t i = sh->next.fetch_add(1);
     if (i >= sh->n_ops) break;
     int64_t start = OpStartUs(sh, i);
-    const std::string& fid =
-        sh->zipf != nullptr
-            ? sh->ids[sh->zipf->Pick(i) % sh->ids.size()]
-            : sh->ids[i % sh->ids.size()];
+    std::string key_class;
+    size_t pick;
+    if (sh->hot_keys > 0) {
+      bool hot = false;
+      pick = sh->HotPick(i, &hot) % sh->ids.size();
+      key_class = hot ? "hot" : "cold";
+    } else if (sh->zipf != nullptr) {
+      pick = sh->zipf->Pick(i) % sh->ids.size();
+    } else {
+      pick = static_cast<size_t>(i) % sh->ids.size();
+    }
+    const std::string& fid = sh->ids[pick];
     int cls = sh->ClassFor(i);
-    OpRecord rec{start, 0, -1, 0, cls, fid};
+    OpRecord rec{start, 0, -1, 0, cls, fid, key_class};
     std::string ip;
     int port = 0;
     if (QueryFetch(&tracker,
@@ -572,7 +623,9 @@ bool WriteResults(const Shared& sh, const std::string& path, bool with_ids) {
   if (with_ids) ids.open(path + ".ids");
   for (const auto& r : sh.records) {
     out << r.start_us << ' ' << r.latency_us << ' ' << r.status << ' '
-        << r.bytes << ' ' << r.cls << ' ' << r.file_id << '\n';
+        << r.bytes << ' ' << r.cls << ' ' << r.file_id;
+    if (!r.key_class.empty()) out << ' ' << r.key_class;
+    out << '\n';
     if (with_ids && r.status == 0 && !r.file_id.empty())
       ids << r.file_id << '\n';
   }
@@ -723,6 +776,7 @@ int Combine(int argc, char** argv) {
   };
   std::vector<int64_t> lat;
   std::map<int, ClassAgg> by_class;
+  std::map<std::string, ClassAgg> by_key_class;
   int64_t errors = 0, shed = 0, bytes = 0, t_min = INT64_MAX, t_max = 0;
   for (int a = 0; a < argc; ++a) {
     std::ifstream in(argv[a]);
@@ -748,12 +802,30 @@ int Combine(int argc, char** argv) {
             first.find_first_not_of("0123456789") == std::string::npos)
           cls = atoi(first.c_str());
       }
+      // A trailing "hot"/"cold" token (--hot-keys runs) tags the key
+      // class; anything else is an untagged record and contributes no
+      // by_key_class row.
+      std::string key_class;
+      size_t last_end = rest.find_last_not_of(' ');
+      if (last_end != std::string::npos) {
+        size_t last_sp = rest.find_last_of(' ', last_end);
+        std::string last_tok =
+            rest.substr(last_sp + 1, last_end - last_sp);
+        if (last_tok == "hot" || last_tok == "cold") key_class = last_tok;
+      }
       lat.push_back(latency);
       auto& agg = by_class[cls];
       agg.ops++;
       if (status == 0) agg.lat.push_back(latency);
       else if (status == 16) { shed++; agg.shed++; errors++; }
       else { agg.errors++; errors++; }
+      if (!key_class.empty()) {
+        auto& kagg = by_key_class[key_class];
+        kagg.ops++;
+        if (status == 0) kagg.lat.push_back(latency);
+        else if (status == 16) kagg.shed++;
+        else kagg.errors++;
+      }
       bytes += b;
       t_min = std::min(t_min, start);
       t_max = std::max(t_max, start + latency);
@@ -784,12 +856,39 @@ int Combine(int argc, char** argv) {
              static_cast<long long>(Pct(agg.lat, 0.99)));
     classes += buf;
   }
+  // Per-key-class (hot/cold) percentiles: the headline number for the
+  // elastic-replication bench is "hot-key p99 with promotion on vs
+  // off", so the hot rows need their own latency distribution rather
+  // than being smeared into the global percentiles.  Emitted only when
+  // at least one record carried a key-class tag, so legacy runs keep
+  // their exact JSON shape.
+  std::string keyclasses;
+  for (auto& [kc, agg] : by_key_class) {
+    std::sort(agg.lat.begin(), agg.lat.end());
+    char buf[320];
+    snprintf(buf, sizeof(buf),
+             "%s\"%s\": {\"ops\": %lld, \"admitted\": %lld, "
+             "\"shed\": %lld, \"errors\": %lld, \"lat_p50_us\": %lld, "
+             "\"lat_p95_us\": %lld, \"lat_p99_us\": %lld}",
+             keyclasses.empty() ? "" : ", ", kc.c_str(),
+             static_cast<long long>(agg.ops),
+             static_cast<long long>(agg.lat.size()),
+             static_cast<long long>(agg.shed),
+             static_cast<long long>(agg.errors),
+             static_cast<long long>(Pct(agg.lat, 0.50)),
+             static_cast<long long>(Pct(agg.lat, 0.95)),
+             static_cast<long long>(Pct(agg.lat, 0.99)));
+    keyclasses += buf;
+  }
+  std::string key_section;
+  if (!keyclasses.empty())
+    key_section = ", \"by_key_class\": {" + keyclasses + "}";
   printf(
       "{\"ops\": %zu, \"errors\": %lld, \"shed\": %lld, "
       "\"wall_seconds\": %.3f, "
       "\"qps\": %.1f, \"bytes\": %lld, \"GBps\": %.4f, "
       "\"lat_mean_us\": %lld, \"lat_p50_us\": %lld, \"lat_p95_us\": %lld, "
-      "\"lat_p99_us\": %lld, \"lat_max_us\": %lld, \"by_class\": {%s}}\n",
+      "\"lat_p99_us\": %lld, \"lat_max_us\": %lld, \"by_class\": {%s}%s}\n",
       lat.size(), static_cast<long long>(errors),
       static_cast<long long>(shed), wall_s,
       lat.size() / std::max(wall_s, 1e-9),
@@ -799,7 +898,8 @@ int Combine(int argc, char** argv) {
       static_cast<long long>(Pct(lat, 0.50)),
       static_cast<long long>(Pct(lat, 0.95)),
       static_cast<long long>(Pct(lat, 0.99)),
-      static_cast<long long>(lat.back()), classes.c_str());
+      static_cast<long long>(lat.back()), classes.c_str(),
+      key_section.c_str());
   return 0;
 }
 
@@ -875,9 +975,31 @@ int main(int argc, char** argv) {
     double zipf_s = 0;
     int64_t zipf_keys = 0;
     uint64_t zipf_seed = 42;
+    int64_t hot_keys = 0;
+    double hot_pct = 0;
     for (int a = 7; a < argc; ++a) {
       std::string flag = argv[a];
-      if (flag == "--zipf" && a + 1 < argc) {
+      if (flag == "--hot-keys" && a + 1 < argc) {
+        // Same error discipline as --zipf: a malformed spec must fail
+        // loudly, not silently degrade to uniform traffic.
+        std::string spec = argv[++a];
+        size_t colon = spec.find(':');
+        int64_t k = 0;
+        double pct = 0;
+        if (colon != std::string::npos) {
+          k = strtoll(spec.c_str(), nullptr, 10);
+          pct = strtod(spec.c_str() + colon + 1, nullptr);
+        }
+        if (colon == std::string::npos || k <= 0 || pct <= 0 ||
+            pct > 100) {
+          fprintf(stderr,
+                  "--hot-keys wants K:pct with K>0 and 0<pct<=100, got %s\n",
+                  spec.c_str());
+          return 2;
+        }
+        hot_keys = k;
+        hot_pct = pct;
+      } else if (flag == "--zipf" && a + 1 < argc) {
         // A bad exponent must be an ERROR, not a silent fall-through to
         // round-robin: this flag exists to measure skew, and "measured
         // unskewed traffic believing it was zipfian" poisons the
@@ -897,6 +1019,14 @@ int main(int argc, char** argv) {
         fprintf(stderr, "bad download flag %s\n", flag.c_str());
         return 2;
       }
+    }
+    if (hot_keys > 0 && zipf_s > 0) {
+      fprintf(stderr, "--hot-keys and --zipf are mutually exclusive\n");
+      return 2;
+    }
+    if (hot_keys > 0) {
+      sh.hot_keys = hot_keys;
+      sh.hot_frac = hot_pct / 100.0;
     }
     if (zipf_s > 0) {
       size_t universe = static_cast<size_t>(

@@ -1,0 +1,157 @@
+// Elastic hot-replication map (ROADMAP item 3: act on the heat the
+// cluster already sees).  The leader folds per-node HEAT_TOP beat
+// trailers into a windowed, counter-reset-clamped ledger, keeps a read
+// EWMA per key, and promotes keys whose cluster-wide rate crosses
+// hot_promote_threshold to extra replica groups — demoting with
+// hysteresis when the EWMA decays below hot_demote_threshold, so the
+// map cannot flap (the SLO/admission discipline).
+//
+// Entry lifecycle is the verify-then-publish contract the routed read
+// path depends on:
+//
+//   pending   — targets chosen, replicate tasks flowing to the home
+//               group's elected member; NOT visible to clients.
+//   published — fan-out byte-verified and acked; version bumped; entry
+//               served in full snapshots and deltas.
+//   retiring  — tombstone published (version bump) but extra copies
+//               still on disk; drop tasks are issued only on a LATER
+//               policy tick, so every client polling at the map cadence
+//               sees the route die one epoch before the bytes do.
+//   (purged)  — drop acked; changelog keeps the tombstone for deltas.
+//
+// Single-threaded by design, like PlacementTable: all calls happen on
+// the tracker's event loop.  Persists next to placement.dat
+// (base_path/data/hotmap.dat, atomic tmp+rename); followers rebuild
+// from their own beats after failover, so persistence is a warm-start
+// hint rather than a correctness requirement.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "common/heatwire.h"
+
+namespace fdfs {
+
+class HotMap {
+ public:
+  struct Config {
+    double promote_threshold = 0;  // reads/s; 0 disables promotion
+    double demote_threshold = 0;   // reads/s; must stay < promote
+    int max_extra_replicas = 2;
+    int capacity = 128;        // max pending+published+retiring entries
+    double ewma_alpha = 0.3;   // per-tick smoothing
+  };
+
+  enum class State : uint8_t { kPending = 0, kPublished = 1, kRetiring = 2 };
+
+  struct Entry {
+    std::string key;                  // "<home group>/<remote filename>"
+    std::vector<std::string> groups;  // extra replica groups (assignment)
+    State state = State::kPending;
+    double ewma = 0;                // cluster-wide reads/s
+    int64_t published_version = 0;  // map version that published the entry
+    int64_t retired_version = 0;    // map version of the tombstone
+    int64_t retire_tick = 0;        // policy tick that demoted it
+  };
+
+  explicit HotMap(const Config& cfg) : cfg_(cfg) {}
+
+  // Fold one node's cumulative heat snapshot (beat trailer) into the
+  // window.  node is "ip:port"; per-key deltas are clamped at zero and a
+  // shrinking counter (daemon restart) is treated as starting over — the
+  // monitor.top_rates reset discipline.  Keys naming a published extra
+  // replica are credited to the home key, so a routed read cannot
+  // cascade-promote its own copy.
+  void NoteHeat(const std::string& node,
+                const std::vector<HeatTrailerEntry>& entries);
+
+  // One policy pass (each metrics tick): fold the window into EWMAs,
+  // then — only when run_policy (leader) — promote and demote.
+  // pick_targets(home_group, want) returns up to `want` under-loaded
+  // active groups != home (empty means defer the promotion — no
+  // capacity right now).  Followers fold with run_policy=false so their
+  // ledgers stay warm for failover without diverging the map.
+  void Tick(double dt_s,
+            const std::function<std::vector<std::string>(
+                const std::string& home_group, int want)>& pick_targets,
+            bool run_policy = true);
+
+  // Replicate tasks for pending entries plus drop tasks for retiring
+  // entries whose tombstone is at least one tick old, restricted to keys
+  // homed in `group`.  Re-issued every beat until acked (idempotent).
+  std::vector<HotTask> TasksForGroup(const std::string& group) const;
+
+  // HOT_FANOUT_DONE replicate ack: publishes the entry (version bump)
+  // once every assigned group is byte-verified.  False = unknown key or
+  // verified set short (entry stays pending; tasks keep flowing).
+  bool AckReplicate(const std::string& key,
+                    const std::vector<std::string>& groups);
+  // Drop ack: purge the retiring entry.  False = unknown key.
+  bool AckDrop(const std::string& key);
+
+  // QUERY_HOT_MAP body.  since_version < 0 → full snapshot (published
+  // entries only).  Otherwise a delta of changelog records newer than
+  // since_version (latest per key wins; empty groups = tombstone) — or a
+  // full snapshot when the changelog no longer reaches back that far.
+  std::string PackWire(int64_t since_version) const;
+
+  // Follower adoption (the MaybeAdoptPlacement discipline): replace the
+  // whole published set with a leader full snapshot.  False on a
+  // malformed or non-full body (map untouched).
+  bool AdoptFull(const std::string& body);
+
+  // Extra-replica assignments per target group (pending + published +
+  // retiring), for the under-loaded-target spread heuristic.
+  std::map<std::string, int64_t> GroupLoad() const;
+
+  bool Save(const std::string& path) const;
+  bool Load(const std::string& path);
+
+  int64_t version() const { return version_; }
+  const std::map<std::string, Entry>& entries() const { return entries_; }
+  // Home-group published routes for a key, for gauges/tests.
+  const Entry* Find(const std::string& key) const;
+  int64_t promotions_total() const { return promotions_total_; }
+  int64_t demotions_total() const { return demotions_total_; }
+  int64_t tracked_keys() const { return static_cast<int64_t>(ledger_.size()); }
+  int64_t CountState(State s) const;
+
+ private:
+  struct LedgerRow {
+    double ewma = 0;
+    int64_t window_hits = 0;
+    int64_t window_bytes = 0;
+  };
+  struct ChangeRec {
+    int64_t version = 0;
+    std::string key;
+    std::vector<std::string> groups;  // empty = tombstone
+  };
+
+  void RecordChange(const std::string& key,
+                    const std::vector<std::string>& groups);
+  std::string HomeGroup(const std::string& key) const;
+
+  Config cfg_;
+  int64_t version_ = 0;
+  int64_t tick_ = 0;
+  int64_t promotions_total_ = 0;
+  int64_t demotions_total_ = 0;
+  std::map<std::string, Entry> entries_;
+  std::map<std::string, LedgerRow> ledger_;
+  // node -> key -> last cumulative {hits, bytes} snapshot.
+  std::map<std::string, std::map<std::string, std::pair<int64_t, int64_t>>>
+      last_seen_;
+  // "extra_group/remote" -> home key, for heat canonicalization.
+  std::map<std::string, std::string> alias_;
+  std::vector<ChangeRec> changelog_;
+  // Deltas are answerable only for since_version >= trimmed_below_;
+  // older pollers get a full snapshot.
+  int64_t trimmed_below_ = 0;
+};
+
+}  // namespace fdfs

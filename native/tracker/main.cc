@@ -115,6 +115,25 @@ int main(int argc, char** argv) {
   cfg.admission_retry_after_ms = ini.GetInt(
       "admission_retry_after_ms", cfg.admission_retry_after_ms);
   if (cfg.admission_retry_after_ms < 1) cfg.admission_retry_after_ms = 1;
+  // Elastic hot replication (ISSUE 20): promote threshold 0 keeps the
+  // feature off; with it on, demote must sit strictly below promote or
+  // the hysteresis band vanishes and the hot map can flap.
+  cfg.hot_promote_threshold = static_cast<int>(
+      ini.GetInt("hot_promote_threshold", cfg.hot_promote_threshold));
+  if (cfg.hot_promote_threshold < 0) cfg.hot_promote_threshold = 0;
+  cfg.hot_demote_threshold = static_cast<int>(
+      ini.GetInt("hot_demote_threshold", cfg.hot_demote_threshold));
+  if (cfg.hot_demote_threshold >= cfg.hot_promote_threshold)
+    cfg.hot_demote_threshold = cfg.hot_promote_threshold / 2;
+  if (cfg.hot_demote_threshold < 0) cfg.hot_demote_threshold = 0;
+  cfg.hot_max_extra_replicas = static_cast<int>(
+      ini.GetInt("hot_max_extra_replicas", cfg.hot_max_extra_replicas));
+  if (cfg.hot_max_extra_replicas < 1) cfg.hot_max_extra_replicas = 1;
+  if (cfg.hot_max_extra_replicas > 16) cfg.hot_max_extra_replicas = 16;
+  cfg.hot_map_capacity = static_cast<int>(
+      ini.GetInt("hot_map_capacity", cfg.hot_map_capacity));
+  if (cfg.hot_map_capacity < 1) cfg.hot_map_capacity = 1;
+  if (cfg.hot_map_capacity > 65536) cfg.hot_map_capacity = 65536;
   if (cfg.base_path.empty()) {
     std::fprintf(stderr, "config error: base_path is required\n");
     return 1;

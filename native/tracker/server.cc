@@ -2,10 +2,13 @@
 
 #include <time.h>
 
+#include <algorithm>
 #include <cstring>
 
 #include "common/bytes.h"
 #include "common/healthmon.h"
+#include "common/heatwire.h"
+#include "common/jumphash.h"
 #include "common/log.h"
 #include "common/profiler.h"
 #include "common/threadreg.h"
@@ -99,6 +102,8 @@ const char* TrackerOpName(uint8_t cmd) {
     case TrackerCmd::kProfileDump: return "tracker.profile_dump";
     case TrackerCmd::kHealthMatrix: return "tracker.health_matrix";
     case TrackerCmd::kAdmissionStatus: return "tracker.admission_status";
+    case TrackerCmd::kQueryHotMap: return "tracker.query_hot_map";
+    case TrackerCmd::kHotFanoutDone: return "tracker.hot_fanout_done";
     default: return nullptr;
   }
 }
@@ -124,6 +129,19 @@ bool TrackerServer::Init(std::string* error) {
   placement_path_ = cfg_.base_path + "/data/placement.dat";
   placement_->Load(placement_path_);
   cluster_->set_placement(placement_.get());
+  // Elastic hot replication (ISSUE 20): always constructed — with
+  // promotion off (the default) it still folds beat heat and serves an
+  // empty map, so QUERY_HOT_MAP and the hot.* gauges stay live.
+  {
+    HotMap::Config hcfg;
+    hcfg.promote_threshold = cfg_.hot_promote_threshold;
+    hcfg.demote_threshold = cfg_.hot_demote_threshold;
+    hcfg.max_extra_replicas = cfg_.hot_max_extra_replicas;
+    hcfg.capacity = cfg_.hot_map_capacity;
+    hotmap_ = std::make_unique<HotMap>(hcfg);
+    hotmap_path_ = cfg_.base_path + "/data/hotmap.dat";
+    hotmap_->Load(hotmap_path_);
+  }
 
   // Telemetry history + SLOs (ISSUE 8): the same journal/evaluator pair
   // the storage daemon runs, minus the storage-only rules (their
@@ -194,6 +212,22 @@ bool TrackerServer::Init(std::string* error) {
                           PriorityClassName(static_cast<uint8_t>(i)),
                       [this, i] { return admission_->shed_by_class(i); });
   }
+  registry_.GaugeFn("hot.map_version", [this] { return hotmap_->version(); });
+  registry_.GaugeFn("hot.promoted", [this] {
+    return hotmap_->CountState(HotMap::State::kPublished);
+  });
+  registry_.GaugeFn("hot.pending", [this] {
+    return hotmap_->CountState(HotMap::State::kPending);
+  });
+  registry_.GaugeFn("hot.retiring", [this] {
+    return hotmap_->CountState(HotMap::State::kRetiring);
+  });
+  registry_.GaugeFn("hot.promotions_total",
+                    [this] { return hotmap_->promotions_total(); });
+  registry_.GaugeFn("hot.demotions_total",
+                    [this] { return hotmap_->demotions_total(); });
+  registry_.GaugeFn("hot.tracked_keys",
+                    [this] { return hotmap_->tracked_keys(); });
   registry_.GaugeFn("slo.breaches_active", [this] {
     return slo_ != nullptr ? slo_->breaches_active() : int64_t{0};
   });
@@ -341,6 +375,7 @@ bool TrackerServer::Init(std::string* error) {
   loop_.AddTimer(cfg_.save_interval_s * 1000, [this]() {
     cluster_->Save(state_path_);
     placement_->Save(placement_path_);
+    hotmap_->Save(hotmap_path_);
     // Periodic status file (tracker_write_status_file analogue).
     std::string tmp = cfg_.base_path + "/data/tracker_status.dat.tmp";
     FILE* f = fopen(tmp.c_str(), "w");
@@ -453,6 +488,79 @@ void TrackerServer::MetricsTick() {
   last_tick_snap_ = std::move(snap);
   have_tick_snap_ = true;
   last_tick_mono_us_ = now_mono;
+  // HeatPolicy pass (ISSUE 20): fold the beat-trailer heat window into
+  // EWMAs every tick; only the leader promotes/demotes (followers keep
+  // their ledgers warm for failover without diverging the map).
+  bool leader = relationship_ == nullptr || relationship_->am_leader();
+  int64_t hot_version_before = hotmap_->version();
+  hotmap_->Tick(
+      dt_s,
+      [this](const std::string& home, int want) {
+        return PickHotTargets(home, want);
+      },
+      leader);
+  if (hotmap_->version() != hot_version_before) {
+    hotmap_->Save(hotmap_path_);
+    if (events_ != nullptr)
+      events_->Record(EventSeverity::kInfo, "hot.map_changed",
+                      "version=" + std::to_string(hotmap_->version()),
+                      "promoted=" + std::to_string(hotmap_->CountState(
+                                        HotMap::State::kPublished)) +
+                          " retiring=" +
+                          std::to_string(hotmap_->CountState(
+                              HotMap::State::kRetiring)));
+  }
+}
+
+std::vector<std::string> TrackerServer::PickHotTargets(const std::string& home,
+                                                       int want) {
+  struct Cand {
+    std::string group;
+    int64_t assigned;
+    int64_t free_mb;
+  };
+  std::map<std::string, int64_t> load = hotmap_->GroupLoad();
+  std::vector<Cand> cands;
+  for (const std::string& g : placement_->ActiveGroups()) {
+    if (g == home) continue;
+    const GroupInfo* gi = cluster_->FindGroup(g);
+    if (gi == nullptr || gi->ActiveCount() == 0) continue;
+    cands.push_back({g, load.count(g) != 0 ? load[g] : 0, gi->FreeMb()});
+  }
+  // Fewest existing hot assignments first (ops/s spread), then most
+  // free space (capacity), then name for determinism.
+  std::sort(cands.begin(), cands.end(), [](const Cand& a, const Cand& b) {
+    if (a.assigned != b.assigned) return a.assigned < b.assigned;
+    if (a.free_mb != b.free_mb) return a.free_mb > b.free_mb;
+    return a.group < b.group;
+  });
+  std::vector<std::string> out;
+  for (const Cand& c : cands) {
+    if (static_cast<int>(out.size()) >= want) break;
+    out.push_back(c.group);
+  }
+  return out;
+}
+
+void TrackerServer::MaybeAdoptHotMap() {
+  if (relationship_ == nullptr || relationship_->am_leader()) return;
+  // The MaybeAdoptPlacement discipline: at most one leader round-trip a
+  // second, ~10s backoff when unreachable, last adopted map serves on.
+  int64_t now_ms = NowMs();
+  if (now_ms - hotmap_fetched_ms_ < 1000) return;
+  hotmap_fetched_ms_ = now_ms;
+  std::string resp;
+  uint8_t status = 0;
+  if (relationship_->RpcLeader(
+          static_cast<uint8_t>(TrackerCmd::kQueryHotMap), "", &resp, &status,
+          /*timeout_ms=*/300) &&
+      status == 0) {
+    if (!hotmap_->AdoptFull(resp))
+      FDFS_LOG_WARN("hotmap: malformed snapshot from leader (%zu bytes)",
+                    resp.size());
+  } else {
+    hotmap_fetched_ms_ = now_ms + 9000;
+  }
 }
 
 std::string TrackerServer::ResolveTrunkServer(const std::string& group) {
@@ -495,6 +603,7 @@ std::string TrackerServer::ResolveTrunkServer(const std::string& group) {
 void TrackerServer::Stop() {
   cluster_->Save(state_path_);
   placement_->Save(placement_path_);
+  hotmap_->Save(hotmap_path_);
   if (relationship_ != nullptr) relationship_->Stop();
   loop_.Stop();
 }
@@ -651,6 +760,19 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
                                    body.size() - stats_end, &ht))
           cluster_->UpdateHealth(group, ip, static_cast<int>(port),
                                  ht.self_score, ht.peers, now);
+        // Heat trailer (common/heatwire.h): the reporter's HEAT_TOP
+        // cumulative read counters, appended after the health trailer
+        // (either may be absent).  Same tolerance contract: malformed
+        // heat must never break heartbeats.
+        int64_t hoff = FindHeatTrailer(p + stats_end, body.size() - stats_end);
+        if (hoff >= 0) {
+          std::vector<HeatTrailerEntry> heat;
+          if (ParseHeatTrailer(p + stats_end + hoff,
+                               body.size() - stats_end -
+                                   static_cast<size_t>(hoff),
+                               &heat))
+            hotmap_->NoteHeat(ip + ":" + std::to_string(port), heat);
+        }
       }
       auto peers = cluster_->Peers(group, ip + ":" + std::to_string(port));
       // Trailer: the group's elected trunk server (zeros when trunk is
@@ -681,6 +803,30 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
           static_cast<char>(cluster_->PlacementState(group)));
       PutInt64BE(placement_->version(), reinterpret_cast<uint8_t*>(pbuf));
       out.append(pbuf, 8);
+      // Hot-task trailer (append-only, prefix-tolerant at the storage):
+      // replicate/drop assignments for keys homed in this group, but
+      // only to each key's ELECTED member — jump-hash over the sorted
+      // ACTIVE member addrs, so exactly one node runs a fan-out and an
+      // offline elect re-routes on the next beat.  Leader-only: a
+      // follower's adopted map has no pending/retiring entries anyway.
+      if (relationship_ == nullptr || relationship_->am_leader()) {
+        std::vector<HotTask> tasks = hotmap_->TasksForGroup(group);
+        if (!tasks.empty()) {
+          std::vector<std::string> addrs;
+          for (const StorageNode& s : cluster_->Peers(group, ""))
+            if (s.status == static_cast<int>(StorageStatus::kActive))
+              addrs.push_back(s.ip + ":" + std::to_string(s.port));
+          std::sort(addrs.begin(), addrs.end());
+          std::string me = ip + ":" + std::to_string(port);
+          std::vector<HotTask> mine;
+          for (HotTask& t : tasks)
+            if (!addrs.empty() &&
+                addrs[JumpHash(PlacementKey(t.key),
+                               static_cast<int32_t>(addrs.size()))] == me)
+              mine.push_back(std::move(t));
+          out += PackHotTasks(mine);  // "" when none elected here
+        }
+      }
       return {0, out};
     }
 
@@ -1043,6 +1189,56 @@ std::pair<uint8_t, std::string> TrackerServer::Handle(
                   std::to_string(cfg_.health_gray_threshold) + ",\"nodes\":" +
                   cluster_->HealthMatrixJson(now, cfg_.health_gray_threshold) +
                   "}"};
+
+    case TrackerCmd::kQueryHotMap: {
+      // Hot-map query: empty body = full snapshot, 8B since_version =
+      // compact delta (empty-groups entry = tombstone).  Followers
+      // refresh their adopted copy from the leader first (throttled).
+      if (body.size() != 0 && body.size() != 8) return {22 /*EINVAL*/, ""};
+      MaybeAdoptHotMap();
+      int64_t since = body.size() == 8 ? GetInt64BE(p) : -1;
+      return {0, hotmap_->PackWire(since)};
+    }
+
+    case TrackerCmd::kHotFanoutDone: {
+      // Fan-out ack from the home group's elected member: 16B home
+      // group + 1B task type + 8B key_len + key + 8B verified-group
+      // count + count x 16B group names.  Replicate acks publish the
+      // entry (verify-then-publish); drop acks purge it.  Re-acks after
+      // a state change are idempotent successes, so a slow duplicate
+      // never errors the storage.
+      if (body.size() < 33) return {22 /*EINVAL*/, ""};
+      uint8_t type = p[16];
+      int64_t klen = GetInt64BE(p + 17);
+      if (klen <= 0 || klen > static_cast<int64_t>(kHotKeyMaxLen) ||
+          25 + static_cast<size_t>(klen) + 8 > body.size())
+        return {22, ""};
+      std::string key = body.substr(25, static_cast<size_t>(klen));
+      size_t off = 25 + static_cast<size_t>(klen);
+      int64_t ngroups = GetInt64BE(p + off);
+      off += 8;
+      if (ngroups < 0 || ngroups > 64 ||
+          off + static_cast<size_t>(ngroups) * kGroupNameMaxLen > body.size())
+        return {22, ""};
+      std::vector<std::string> groups;
+      for (int64_t i = 0; i < ngroups; ++i) {
+        groups.push_back(GetFixedField(p + off, kGroupNameMaxLen));
+        off += kGroupNameMaxLen;
+      }
+      bool changed = type == kHotTaskDrop
+                         ? hotmap_->AckDrop(key)
+                         : hotmap_->AckReplicate(key, groups);
+      if (changed) {
+        hotmap_->Save(hotmap_path_);
+        if (events_ != nullptr)
+          events_->Record(EventSeverity::kInfo,
+                          type == kHotTaskDrop ? "hot.dropped"
+                                               : "hot.published",
+                          key,
+                          "version=" + std::to_string(hotmap_->version()));
+      }
+      return {0, ""};
+    }
 
     case TrackerCmd::kServerClusterStat: {
       // One-RPC observability dump: tracker role + every group/storage

@@ -18,6 +18,7 @@
 #include "common/stats.h"
 #include "storage/admission.h"
 #include "tracker/cluster.h"
+#include "tracker/hotmap.h"
 #include "tracker/relationship.h"
 
 namespace fdfs {
@@ -99,6 +100,15 @@ struct TrackerConfig {
   int admission_relax_pct = 45;
   int64_t admission_loop_lag_high_ms = 100;
   int64_t admission_retry_after_ms = 500;
+  // Elastic hot replication (ISSUE 20; OPERATIONS.md "Elastic hot
+  // replication"): cluster-wide read EWMA thresholds (reads/s) for
+  // promoting a file to extra replica groups and demoting it back —
+  // demote must sit well under promote (hysteresis) so the map cannot
+  // flap.  0 promote threshold = feature off (the default).
+  int hot_promote_threshold = 0;
+  int hot_demote_threshold = 0;
+  int hot_max_extra_replicas = 2;
+  int hot_map_capacity = 128;
 };
 
 class TrackerServer {
@@ -167,6 +177,16 @@ class TrackerServer {
   std::unique_ptr<PlacementTable> placement_;
   std::string placement_path_;
   int64_t placement_fetched_ms_ = 0;  // follower adoption throttle
+  // Elastic hot replication (ISSUE 20): the leader's promotion map plus
+  // its heat ledger; followers adopt published entries from the leader
+  // (MaybeAdoptHotMap) and fold beats locally for failover warmth.
+  std::unique_ptr<HotMap> hotmap_;
+  std::string hotmap_path_;
+  int64_t hotmap_fetched_ms_ = 0;
+  void MaybeAdoptHotMap();
+  // Under-loaded active groups != home for a promotion: fewest existing
+  // hot assignments first, most free space second.
+  std::vector<std::string> PickHotTargets(const std::string& home, int want);
   std::unique_ptr<RelationshipManager> relationship_;
   EventLoop loop_;
   std::unique_ptr<RequestServer> server_;

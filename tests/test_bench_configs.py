@@ -252,3 +252,33 @@ def test_config13_admission_control_smoke(tmp_path):
     assert g["admission.shed_total"] == over["shed"]
     half = art["arms"]["admission"]["half"]
     assert half["shed"] == 0 and half["non_shed_errors"] == 0
+
+
+def test_config14_hot_replication_smoke(tmp_path):
+    # The elastic-hot-replication scenario end-to-end at tiny scale:
+    # the ON arm promotes the 90%-of-reads file (published only after
+    # the byte-verified fan-out), hot-routing readers actually spread
+    # (routed reads flowed, per-group read shares within 10 pp from
+    # the tracker's own beat ledger), the OFF arm's pile-up on the
+    # home group is visibly wider, and every read on every leg
+    # succeeds.  (The hot-key p99 ON < OFF comparison is asserted on
+    # the checked-in artifact, not here — at smoke scale on a loaded
+    # CI host the queueing gap can drown in scheduler noise.)
+    bc.config14(str(tmp_path), scale=0.0015)  # 12 x 8 KB files
+    with open(os.path.join(str(tmp_path), "config14.json")) as fh:
+        art = json.load(fh)
+    assert art["hot_promotion_published"] is True
+    assert art["routed_reads_flowed"] is True
+    assert art["post_promotion_spread_within_10pp"] is True
+    assert art["zero_read_errors"] is True
+    assert art["on_group_spread_pp"] < art["off_group_spread_pp"]
+    on = art["arms"]["on"]
+    assert 1 <= len(on["published_extra_groups"]) <= 2
+    assert on["hot_gauges"].get("hot.promotions_total", 0) >= 1
+    # both measured windows price the same two key classes
+    for arm in ("off", "on"):
+        kc = art["arms"][arm]["measured"]["by_key_class"]
+        assert kc["hot"]["ops"] > kc["cold"]["ops"] > 0
+        # and the classic fdfs_load --hot-keys leg tagged its records
+        wkc = art["arms"][arm]["classic_hot_keys_leg"]["by_key_class"]
+        assert wkc["hot"]["ops"] > wkc["cold"]["ops"] > 0

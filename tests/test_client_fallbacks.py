@@ -33,7 +33,9 @@ def test_stats_starts_zero_and_copies():
                  "placement_fallback_tracker": 0,
                  "ranged_fallback_single": 0,
                  "dead_peer_skips": 0,
-                 "admission_retry_waits": 0}
+                 "admission_retry_waits": 0,
+                 "hot_route_reads": 0,
+                 "hot_fallback_reads": 0}
     s["dedup_fallback_plain"] = 99  # a snapshot, not the live dict
     assert c.stats()["dedup_fallback_plain"] == 0
 

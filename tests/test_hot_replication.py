@@ -1,0 +1,559 @@
+"""Heat-driven elastic replication (ISSUE 20).
+
+Layers:
+- pure-Python contracts: the QUERY_HOT_MAP / HOT_FANOUT_DONE opcodes,
+  the jump-hash routing property the spread relies on (growing the
+  replica set 1 -> R only ADDS destinations — no read ever moves
+  between existing replicas, so promotion cannot thrash caches), and
+  the client's hot-routing state machine (routing, spread, tombstone
+  eviction, transparent fallback + counters) against mocked daemons;
+- cross-language golden: `fdfs_codec hot-map` emits every wire blob the
+  tracker, the elected storage, and the client exchange (full map,
+  delta with tombstone, beat heat trailer, beat-response task trailer,
+  HOT_FANOUT_DONE ack) from the REAL C++ codecs; this file rebuilds
+  each layout byte-for-byte in Python and decodes the map bodies with
+  fastdfs_tpu.monitor.decode_hot_map;
+- fdfs_load: the --hot-keys K:pct two-tier key picker's record tagging
+  and `combine`'s per-key-class percentile section (plus the flag's
+  loud-error contract);
+- live acceptance (the churn test): a 3-group cluster promotes a
+  hammered file — the entry is published only after the copies are
+  byte-identical on every listed extra group (verify-then-publish,
+  checked the instant the entry first appears), routed reads flow and
+  spread, then the key cools, the tombstone retires the route a full
+  epoch before the bytes drop, and a reader that keeps reading through
+  the whole promote -> demote -> drop churn sees ZERO failed reads and
+  ZERO wrong bytes.
+
+The windowed-delta / counter-reset-clamp ledger and the one-epoch drop
+gap are pinned deterministically by the native unit test
+(tracker_test.cc TestHotMapWindowClampAndLifecycle); the live test here
+pins their end-to-end consequences.  Runs under TSan + FDFS_LOCKRANK
+via tools/run_sanitizers.sh — the fan-out worker adds a thread + lock
+(LockRank::kHotRepl) to the storage daemon.
+"""
+
+import json
+import os
+import shutil
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from fastdfs_tpu import monitor as M
+from fastdfs_tpu.client import FdfsClient
+from fastdfs_tpu.common import protocol as P
+from fastdfs_tpu.common.jumphash import replica_for_range
+from tests.harness import (BUILD, STORAGED, TRACKERD, start_storage,
+                           start_tracker, upload_retry)
+
+_HAVE_TOOLCHAIN = ((shutil.which("cmake") is not None
+                    and shutil.which("ninja") is not None)
+                   or shutil.which("g++") is not None)
+_HAVE_BINARIES = os.path.exists(STORAGED) and os.path.exists(TRACKERD)
+needs_native = pytest.mark.skipif(
+    not (_HAVE_TOOLCHAIN or _HAVE_BINARIES),
+    reason="no native toolchain and no prebuilt daemons")
+
+HB = "heart_beat_interval = 1\nstat_report_interval = 1"
+# Fast policy: 1 s metrics ticks, promote at 2 reads/s, demote below
+# 1 read/s, so the whole promote -> demote -> drop arc fits a test
+# timeout instead of a deployment's minutes.
+HOT_TRACKER = ("slo_eval_interval_s = 1"
+               "\nhot_promote_threshold = 2"
+               "\nhot_demote_threshold = 1"
+               "\nhot_max_extra_replicas = 2"
+               "\nhot_map_capacity = 8")
+HOT_STORAGE = HB + "\nheat_top_k = 16"
+
+
+def _wait(cond, timeout=60, interval=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(interval)
+    return cond()
+
+
+def _codec(*args):
+    exe = os.path.join(BUILD, "fdfs_codec")
+    if not os.path.exists(exe):
+        from tests.harness import ensure_native_built
+        ensure_native_built((exe,))
+    out = subprocess.run([exe, *args], capture_output=True, timeout=60)
+    assert out.returncode == 0, out.stderr.decode()
+    return out.stdout.decode()
+
+
+def _load_exe():
+    exe = os.path.join(BUILD, "fdfs_load")
+    if not os.path.exists(exe):
+        from tests.harness import ensure_native_built
+        ensure_native_built((exe,))
+    return exe
+
+
+# ---------------------------------------------------------------------------
+# wire contract (pure Python)
+# ---------------------------------------------------------------------------
+
+def test_hot_opcodes():
+    assert P.TrackerCmd.QUERY_HOT_MAP == 75
+    assert P.TrackerCmd.HOT_FANOUT_DONE == 80
+    # Both ride the fdfs_codec hot-map cross-language golden.
+    assert P.WIRE_GOLDENS["TrackerCmd.QUERY_HOT_MAP"] == "hot-map"
+    assert P.WIRE_GOLDENS["TrackerCmd.HOT_FANOUT_DONE"] == "hot-map"
+
+
+def test_replica_spread_is_adds_only():
+    """Jump-hash monotonicity, the property the whole promotion scheme
+    leans on: when the replica set grows 1 -> R, a (file, range-index)
+    assignment either stays put or moves to the NEWLY ADDED replica.
+    Nothing ever reshuffles between existing replicas, so promoting a
+    file cannot evict warm cache entries anywhere."""
+    fids = [f"group{1 + (i % 3)}/M00/00/{i:02X}/wk{i:04d}.bin"
+            for i in range(24)]
+    for fid in fids:
+        for i in range(48):
+            prev = replica_for_range(fid, i, 1)
+            assert prev == 0
+            for n in range(2, 7):
+                cur = replica_for_range(fid, i, n)
+                assert cur == prev or cur == n - 1, \
+                    f"{fid}#{i}: {prev} -> {cur} at n={n} (not adds-only)"
+                prev = cur
+    # Spread sanity: with 3 replicas every bucket takes a useful share
+    # of the range indices (the whole point of widening the set).
+    counts = [0, 0, 0]
+    for fid in fids:
+        for i in range(48):
+            counts[replica_for_range(fid, i, 3)] += 1
+    total = sum(counts)
+    for c in counts:
+        assert 0.15 < c / total < 0.55, counts
+
+
+# ---------------------------------------------------------------------------
+# client hot routing (mocked daemons)
+# ---------------------------------------------------------------------------
+
+_FID = "group1/M00/00/01/hotobj.bin"
+
+
+class _FakeTracker:
+    def __init__(self, responses):
+        # responses: list of hot-map response dicts, served in order
+        # (last one repeats); query_placement is static.
+        self.responses = responses
+        self.calls = 0
+
+    def query_hot_map(self, since=None):
+        r = self.responses[min(self.calls, len(self.responses) - 1)]
+        self.calls += 1
+        return r
+
+    def query_placement(self):
+        return {"epoch": 1, "groups": [
+            {"group": f"group{i + 1}", "state": 0,
+             "members": [{"ip": "127.0.0.1", "port": 23001 + i}]}
+            for i in range(3)]}
+
+
+class _FakeStorage:
+    def __init__(self, tgt, log, fail):
+        self.tgt, self.log, self.fail = tgt, log, fail
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def download_to_buffer(self, fid, offset=0, length=0):
+        if self.fail:
+            raise OSError("replica down")
+        self.log.append((self.tgt.group, fid))
+        return b"replica:" + fid.encode()
+
+
+def _hot_client(monkeypatch, responses, fail_routed=False):
+    c = FdfsClient("127.0.0.1:1", timeout=0.1, use_pool=False)
+    tr = _FakeTracker(responses)
+    calls = []
+    monkeypatch.setattr(c, "_with_tracker", lambda fn: fn(tr))
+    monkeypatch.setattr(
+        c, "_storage", lambda tgt: _FakeStorage(tgt, calls, fail_routed))
+    monkeypatch.setattr(c, "_routed", lambda q, op: b"home")
+    return c, tr, calls
+
+
+def test_client_routes_and_spreads(monkeypatch):
+    full = {"version": 3, "full": True,
+            "entries": [{"key": _FID, "groups": ["group2", "group3"]}]}
+    c, _, calls = _hot_client(monkeypatch, [full])
+    results = [c.download_to_buffer(_FID) for _ in range(60)]
+    st = c.stats()
+    assert st["hot_route_reads"] > 0
+    assert st["hot_fallback_reads"] == 0
+    # Routed reads fetch the REPLICA id from an extra group; home picks
+    # take the classic tracker hop.
+    assert all(g in ("group2", "group3") for g, _ in calls)
+    assert all(fid == f"{g}/M00/00/01/hotobj.bin" for g, fid in calls)
+    # The spread uses both extra groups AND leaves home traffic.
+    assert {g for g, _ in calls} == {"group2", "group3"}
+    assert any(r == b"home" for r in results)
+    assert len(calls) == st["hot_route_reads"]
+    # A file the map does not list never routes.
+    assert c.download_to_buffer("group1/M00/00/02/cold.bin") == b"home"
+    assert c.stats()["hot_route_reads"] == st["hot_route_reads"]
+
+
+def test_client_tombstone_evicts_route(monkeypatch):
+    full = {"version": 3, "full": True,
+            "entries": [{"key": _FID, "groups": ["group2", "group3"]}]}
+    tomb = {"version": 5, "full": False,
+            "entries": [{"key": _FID, "groups": []}]}
+    c, tr, calls = _hot_client(monkeypatch, [full, tomb])
+    for _ in range(30):
+        c.download_to_buffer(_FID)
+    assert c.stats()["hot_route_reads"] > 0
+    # Force the next TTL window: the delta carries the tombstone and the
+    # route dies client-side.
+    c._hot_state["fetched"] = float("-inf")
+    routed_before = len(calls)
+    for _ in range(30):
+        assert c.download_to_buffer(_FID) == b"home"
+    assert len(calls) == routed_before
+    # The delta query carried the cached version (windowed, not full).
+    assert tr.calls >= 2
+
+
+def test_client_falls_back_and_evicts_on_failure(monkeypatch):
+    full = {"version": 3, "full": True,
+            "entries": [{"key": _FID, "groups": ["group2", "group3"]}]}
+    c, _, _ = _hot_client(monkeypatch, [full], fail_routed=True)
+    results = [c.download_to_buffer(_FID) for _ in range(40)]
+    st = c.stats()
+    # Every read still answered (transparent fallback)...
+    assert all(r == b"home" for r in results)
+    # ...exactly one routed attempt failed before the eviction stopped
+    # further routing for this key.
+    assert st["hot_fallback_reads"] == 1
+    assert st["hot_route_reads"] == 0
+    assert _FID not in c._hot_state["entries"]
+
+
+def test_client_survives_hot_map_refusal(monkeypatch):
+    """A pre-hot-map tracker (unknown command) must cost ONE failed
+    probe per backoff window, never a failed read."""
+    c = FdfsClient("127.0.0.1:1", timeout=0.1, use_pool=False)
+
+    class _Refuses:
+        def query_hot_map(self, since=None):
+            raise RuntimeError("unknown command")
+
+    probes = []
+
+    def with_tracker(fn):
+        probes.append(1)
+        return fn(_Refuses())
+
+    monkeypatch.setattr(c, "_with_tracker", with_tracker)
+    monkeypatch.setattr(c, "_routed", lambda q, op: b"home")
+    for _ in range(50):
+        assert c.download_to_buffer(_FID) == b"home"
+    assert len(probes) == 1  # backed off, not hammering
+
+
+# ---------------------------------------------------------------------------
+# cross-language golden (fdfs_codec hot-map)
+# ---------------------------------------------------------------------------
+
+def _pack_group(name: str) -> bytes:
+    return name.encode().ljust(P.GROUP_NAME_MAX_LEN, b"\x00")
+
+
+def _pack_hot_map(version: int, full: bool, entries) -> bytes:
+    out = struct.pack(">q", version) + bytes([1 if full else 0])
+    out += struct.pack(">q", len(entries))
+    for key, groups in entries:
+        out += struct.pack(">q", len(key)) + key.encode()
+        out += struct.pack(">q", len(groups))
+        for g in groups:
+            out += _pack_group(g)
+    return out
+
+
+@needs_native
+def test_hot_map_wire_golden():
+    lines = dict(ln.split("=", 1) for ln in _codec("hot-map").splitlines()
+                 if "=" in ln and not ln.startswith(("heat_entry",
+                                                     "task_entry")))
+    raw = _codec("hot-map").splitlines()
+
+    # QUERY_HOT_MAP full snapshot: C++ bytes == the documented layout.
+    full_entries = [("group1/M00/00/01/hotfile.bin", ["group2", "group3"]),
+                    ("group2/M00/00/02/warmfile.bin", ["group1"])]
+    assert lines["full_response"] == _pack_hot_map(7, True,
+                                                   full_entries).hex()
+    dec = M.decode_hot_map(bytes.fromhex(lines["full_response"]))
+    assert dec["version"] == 7 and dec["full"]
+    assert [(e["key"], e["groups"]) for e in dec["entries"]] == full_entries
+
+    # Delta with a tombstone (zero groups = demoted key).
+    delta_entries = [("group3/M00/00/05/risen.bin", ["group1"]),
+                     ("group1/M00/00/01/hotfile.bin", [])]
+    assert lines["delta_response"] == _pack_hot_map(9, False,
+                                                    delta_entries).hex()
+    dec = M.decode_hot_map(bytes.fromhex(lines["delta_response"]))
+    assert not dec["full"]
+    assert dec["entries"][1]["groups"] == []
+    # The since-version request body is one 8B BE integer.
+    assert lines["delta_request"] == struct.pack(">q", 7).hex()
+
+    # Beat heat trailer: 1B ver=2 + 8B count + per entry
+    # (8B key_len + key + 8B hits + 8B bytes); C++ parse-back agrees.
+    k1, k2 = "group1/M00/00/01/hotfile.bin", "group2/M00/00/02/warmfile.bin"
+    ht = bytes([2]) + struct.pack(">q", 2)
+    for key, hits, nbytes in ((k1, 9, 36864), (k2, 4, 4096)):
+        ht += struct.pack(">q", len(key)) + key.encode()
+        ht += struct.pack(">qq", hits, nbytes)
+    assert lines["heat_trailer"] == ht.hex()
+    assert lines["heat_parsed"] == "1"
+    assert f"heat_entry={k1}:9:36864" in raw
+    assert f"heat_entry={k2}:4:4096" in raw
+
+    # Beat-response hot-task trailer: 1B ver=1 + 8B count + per task
+    # (1B type + 8B key_len + key + 8B ngroups + n x 16B groups).
+    tt = bytes([1]) + struct.pack(">q", 2)
+    tt += bytes([1]) + struct.pack(">q", len(k1)) + k1.encode()
+    tt += struct.pack(">q", 2) + _pack_group("group2") + _pack_group("group3")
+    tt += bytes([2]) + struct.pack(">q", len(k2)) + k2.encode()
+    tt += struct.pack(">q", 1) + _pack_group("group1")
+    assert lines["task_trailer"] == tt.hex()
+    assert lines["task_parsed"] == "1"
+    assert f"task_entry=1:{k1}:group2,group3" in raw
+    assert f"task_entry=2:{k2}:group1" in raw
+
+    # HOT_FANOUT_DONE ack: 16B home group + 1B type + 8B key_len + key
+    # + 8B verified-group count + n x 16B names.
+    ack = _pack_group("group1") + bytes([1])
+    ack += struct.pack(">q", len(k1)) + k1.encode()
+    ack += struct.pack(">q", 2) + _pack_group("group2") + _pack_group("group3")
+    assert lines["ack_body"] == ack.hex()
+
+
+# ---------------------------------------------------------------------------
+# fdfs_load --hot-keys + combine per-key-class percentiles
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_load_combine_by_key_class(tmp_path):
+    # Two shards of tagged records: hot ops fast, cold ops slow, one
+    # cold error — the split the promotion bench reads off.
+    f1 = tmp_path / "r1.txt"
+    f2 = tmp_path / "r2.txt"
+    f1.write_text("".join(
+        f"{1000 + i * 100} {200 + i} 0 1024 0 group1/M00/00/01/h.bin hot\n"
+        for i in range(10)))
+    f2.write_text(
+        "".join(f"{2000 + i * 100} {5000 + i} 0 2048 0 "
+                f"group2/M00/00/02/c{i}.bin cold\n" for i in range(5))
+        + "9000 7000 5 0 0 group2/M00/00/02/cbad.bin cold\n")
+    out = subprocess.run([_load_exe(), "combine", str(f1), str(f2)],
+                         capture_output=True, timeout=60)
+    assert out.returncode == 0, out.stderr.decode()
+    rep = json.loads(out.stdout)
+    assert rep["ops"] == 16
+    kc = rep["by_key_class"]
+    assert kc["hot"]["ops"] == 10 and kc["hot"]["errors"] == 0
+    assert kc["cold"]["ops"] == 6 and kc["cold"]["errors"] == 1
+    assert kc["cold"]["admitted"] == 5
+    # Percentiles are PER CLASS over admitted ops: hot stays in the
+    # 200 us band, cold in the 5 ms band — the global p99 hides this.
+    assert kc["hot"]["lat_p99_us"] < 300
+    assert kc["cold"]["lat_p50_us"] >= 5000
+    for q in ("lat_p50_us", "lat_p95_us", "lat_p99_us"):
+        assert q in kc["hot"] and q in kc["cold"]
+
+
+@needs_native
+def test_load_combine_untagged_has_no_key_section(tmp_path):
+    f = tmp_path / "r.txt"
+    f.write_text("1000 300 0 1024 0 group1/M00/00/01/a.bin\n")
+    out = subprocess.run([_load_exe(), "combine", str(f)],
+                         capture_output=True, timeout=60)
+    assert out.returncode == 0
+    assert "by_key_class" not in json.loads(out.stdout)
+
+
+@needs_native
+def test_load_hot_keys_flag_errors(tmp_path):
+    ids = tmp_path / "ids.txt"
+    ids.write_text("group1/M00/00/01/a.bin\n")
+    base = [_load_exe(), "download", "127.0.0.1:1", str(ids), "1", "1",
+            str(tmp_path / "out.txt")]
+    for bad in (["--hot-keys", "nope"], ["--hot-keys", "0:50"],
+                ["--hot-keys", "4:0"], ["--hot-keys", "4:101"],
+                ["--hot-keys", "4:50", "--zipf", "1.1"]):
+        out = subprocess.run(base + bad, capture_output=True, timeout=60)
+        assert out.returncode == 2, (bad, out.stderr.decode())
+
+
+# ---------------------------------------------------------------------------
+# live acceptance: the promote -> route -> demote -> drop churn
+# ---------------------------------------------------------------------------
+
+def _tracker_gauges(cli):
+    st = cli._with_tracker(lambda t: t.stat())
+    return st.get("gauges", {})
+
+
+@needs_native
+def test_promotion_routes_and_demotion_churn(tmp_path):
+    tr = start_tracker(tmp_path / "tracker", extra=HOT_TRACKER)
+    taddr = f"127.0.0.1:{tr.port}"
+    daemons = [tr]
+    for g in ("group1", "group2", "group3"):
+        daemons.append(start_storage(tmp_path / g, group=g, trackers=[taddr],
+                                     extra=HOT_STORAGE))
+    reader_stop = threading.Event()
+    reader_slow = threading.Event()
+    try:
+        cli = FdfsClient([taddr], hot_map_ttl_s=0.5)
+        payload = bytes((i * 31 + 7) & 0xFF for i in range(32768))
+        fid = upload_retry(cli, payload, timeout=60)
+        home, remote = fid.split("/", 1)
+
+        # The churn reader: hammers the file (hot phase), then throttles
+        # (cool phase), verifying EVERY byte of EVERY read.  Its client
+        # keeps its own hot map, so it exercises exactly the stale-map
+        # windows around promotion and demotion.
+        reader_cli = FdfsClient([taddr], hot_map_ttl_s=0.5)
+        tally = {"reads": 0, "failed": 0, "wrong": 0}
+
+        def reader():
+            while not reader_stop.is_set():
+                try:
+                    data = reader_cli.download_to_buffer(fid)
+                    tally["reads"] += 1
+                    if data != payload:
+                        tally["wrong"] += 1
+                except Exception:
+                    tally["failed"] += 1
+                if reader_slow.is_set():
+                    time.sleep(2.0)  # ~0.5 reads/s < hot_demote_threshold
+                else:
+                    time.sleep(0.04)  # ~25 reads/s >> hot_promote_threshold
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+
+        # Promotion: the published entry appears in QUERY_HOT_MAP.
+        def published():
+            m = cli.query_hot_map()
+            for e in m["entries"]:
+                if e["key"] == fid and e["groups"]:
+                    return e
+            return None
+        entry = _wait(published, timeout=90)
+        assert entry, "file never promoted"
+        assert home not in entry["groups"]
+        assert 1 <= len(entry["groups"]) <= 2
+
+        # Verify-then-publish: the INSTANT the entry is visible, every
+        # listed extra group must already hold byte-identical content —
+        # fetch each replica id directly, bypassing hot routing.
+        direct = FdfsClient([taddr], hot_routing=False)
+        for g in entry["groups"]:
+            got = direct.download_to_buffer(f"{g}/{remote}")
+            assert got == payload, f"replica on {g} differs at publish time"
+
+        # Routed reads flow through the widened set.
+        assert _wait(lambda: reader_cli.stats()["hot_route_reads"] > 0,
+                     timeout=30), "no reads ever routed to an extra replica"
+
+        # `cli.py hot --json` sees the same map.
+        from fastdfs_tpu.cli import main as cli_main
+        assert cli_main(["hot", taddr, "--json"]) == 0
+        # Tracker ledger gauges count the promotion.
+        g = _tracker_gauges(cli)
+        assert g.get("hot.promotions_total", 0) >= 1
+        assert g.get("hot.map_version", 0) >= 1
+
+        # Cool the key: the EWMA decays below hot_demote_threshold, the
+        # tombstone retires the route, and only a full epoch later do
+        # the extra copies drop.  The reader keeps reading throughout —
+        # through its own stale cached route — and must never fail.
+        reader_slow.set()
+        version_at_publish = cli.query_hot_map()["version"]
+
+        def demoted():
+            m = cli.query_hot_map()
+            return all(e["key"] != fid or not e["groups"]
+                       for e in m["entries"]) and m["version"] > \
+                version_at_publish
+        assert _wait(demoted, timeout=120), "file never demoted"
+        # The delta since publish carries the tombstone.
+        delta = cli.query_hot_map(since_version=version_at_publish)
+        if not delta["full"]:
+            assert any(e["key"] == fid and not e["groups"]
+                       for e in delta["entries"])
+
+        # The drop lands AFTER the tombstone (one-epoch gap): the extra
+        # copies disappear from the target groups.
+        def dropped():
+            for grp in entry["groups"]:
+                try:
+                    direct.download_to_buffer(f"{grp}/{remote}")
+                    return False
+                except Exception:
+                    continue
+            return True
+        assert _wait(dropped, timeout=90), "extra copies never dropped"
+        gauges = _tracker_gauges(cli)
+        assert gauges.get("hot.demotions_total", 0) >= 1
+
+        # Let the reader ride the post-drop window with its possibly
+        # stale map, then close the books: zero failed, zero wrong.
+        time.sleep(3)
+        reader_stop.set()
+        t.join(timeout=30)
+        assert tally["reads"] > 50
+        assert tally["failed"] == 0, tally
+        assert tally["wrong"] == 0, tally
+        # The home copy is untouched.
+        assert direct.download_to_buffer(fid) == payload
+    finally:
+        reader_stop.set()
+        for d in daemons:
+            d.stop()
+
+
+@needs_native
+def test_query_hot_map_empty_and_fanout_gauges(tmp_path):
+    """A quiet cluster serves an empty full map at version 0, and the
+    storage fan-out gauges exist (zero) from boot."""
+    tr = start_tracker(tmp_path / "tracker", extra=HOT_TRACKER)
+    taddr = f"127.0.0.1:{tr.port}"
+    st = start_storage(tmp_path / "s1", trackers=[taddr], extra=HOT_STORAGE)
+    try:
+        cli = FdfsClient([taddr])
+        m = _wait(lambda: cli.query_hot_map(), timeout=30)
+        assert m["full"] and m["entries"] == []
+        assert m["version"] == 0
+        reg = _wait(
+            lambda: cli.storage_stat("127.0.0.1", st.port), timeout=30)
+        gauges = reg.get("gauges", {})
+        for name in ("hot.fanout_replicated", "hot.fanout_dropped",
+                     "hot.fanout_verify_failures", "hot.fanout_failures",
+                     "hot.fanout_queue"):
+            assert gauges.get(name, None) == 0, name
+    finally:
+        tr.stop()
+        st.stop()

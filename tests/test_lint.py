@@ -436,7 +436,7 @@ def test_manifest_golden_names_resolve():
                        "group-admin", "profile-ctl", "profile-json",
                        "ec-status", "ec-stripe-layout",
                        "health-status", "health-matrix",
-                       "priority-frame", "admission-json"}
+                       "priority-frame", "admission-json", "hot-map"}
 
 
 if __name__ == "__main__":

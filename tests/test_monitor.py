@@ -135,7 +135,13 @@ def _sample_registry() -> dict:
                    "admission.retry_after_ms": 500,
                    "admission.inflight_bytes": 4194304,
                    "admission.shed.background": 11,
-                   "admission.shed.bulk": 6},
+                   "admission.shed.bulk": 6,
+                   # elastic hot replication (ISSUE 20): the fan-out
+                   # worker's lifetime verified pushes/drops and the
+                   # failure counters operators alert on
+                   "hot.fanout_replicated": 4, "hot.fanout_dropped": 2,
+                   "hot.fanout_verify_failures": 1,
+                   "hot.fanout_failures": 1, "hot.fanout_queue": 3},
         "histograms": {
             "op.upload_file.latency_us": {
                 "bounds": [100, 1000, 10000],
@@ -332,6 +338,15 @@ def test_prometheus_exposition_parses():
     assert series["fdfs_admission_inflight_bytes"][0][1] == 4194304.0
     assert series["fdfs_admission_shed_background"][0][1] == 11.0
     assert series["fdfs_admission_shed_bulk"][0][1] == 6.0
+    # Elastic-hot-replication golden (ISSUE 20): the fan-out worker's
+    # progress/failure gauges export per-storage so dashboards can chart
+    # promotion churn and alert when a verify keeps failing.
+    assert series["fdfs_hot_fanout_replicated"][0] == (
+        '{storage="127.0.0.1:23000"}', 4.0)
+    assert series["fdfs_hot_fanout_dropped"][0][1] == 2.0
+    assert series["fdfs_hot_fanout_verify_failures"][0][1] == 1.0
+    assert series["fdfs_hot_fanout_failures"][0][1] == 1.0
+    assert series["fdfs_hot_fanout_queue"][0][1] == 3.0
     buckets = series["fdfs_op_upload_file_latency_us_bucket"]
     values = [v for _, v in buckets]
     assert values == sorted(values), "histogram buckets must be cumulative"

@@ -33,12 +33,12 @@ srcs_common="common/bytes.cc common/cdc.cc common/fileid.cc common/ini.cc
   common/stats.cc common/trace.cc common/eventlog.cc common/metrog.cc
   common/sloeval.cc common/heatsketch.cc common/fsutil.cc
   common/threadreg.cc common/profiler.cc common/healthmon.cc
-  common/http_token.cc"
+  common/heatwire.cc common/http_token.cc"
 srcs_storage="storage/admission.cc storage/chunkstore.cc storage/slabstore.cc storage/ecstore.cc
   storage/config.cc storage/store.cc
-  storage/binlog.cc storage/trunk.cc storage/recovery.cc storage/rebalance.cc storage/scrub.cc storage/dedup.cc
+  storage/binlog.cc storage/trunk.cc storage/hotrepl.cc storage/recovery.cc storage/rebalance.cc storage/scrub.cc storage/dedup.cc
   storage/server.cc storage/sync.cc storage/tracker_client.cc"
-srcs_tracker="tracker/cluster.cc tracker/placement.cc tracker/relationship.cc tracker/server.cc"
+srcs_tracker="tracker/cluster.cc tracker/hotmap.cc tracker/placement.cc tracker/relationship.cc tracker/server.cc"
 
 pids=""
 for f in $srcs_common $srcs_storage $srcs_tracker; do
@@ -71,6 +71,7 @@ link tools/codec_cli.cc "$BUILD_DIR/obj/storage_slabstore.o" \
   "$BUILD_DIR/obj/storage_admission.o" \
   "$BUILD_DIR/obj/tracker_placement.o" \
   "$BUILD_DIR/obj/tracker_cluster.o" \
+  "$BUILD_DIR/obj/tracker_hotmap.o" \
   "$BUILD_DIR/obj/libfdfs_common.a" -o "$BUILD_DIR/fdfs_codec" &
 link tools/load_cli.cc "$BUILD_DIR/obj/libfdfs_common.a" \
   -o "$BUILD_DIR/fdfs_load" &

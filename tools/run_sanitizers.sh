@@ -36,7 +36,7 @@ else
     tests/test_report.py tests/test_slab.py tests/test_groups.py
     tests/test_cdc_kernels.py tests/test_profile.py tests/test_ec.py
     tests/test_health.py tests/test_serving_edge.py
-    tests/test_admission.py)
+    tests/test_admission.py tests/test_hot_replication.py)
 fi
 
 build_tree() {
